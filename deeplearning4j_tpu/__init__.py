@@ -1,0 +1,27 @@
+"""deeplearning4j_tpu — a TPU-native deep learning framework.
+
+A brand-new JAX/XLA/Pallas framework with the capability surface of
+Deeplearning4j 0.9.x (reference: MelvinZang/deeplearning4j), re-designed
+TPU-first:
+
+- typed layer/network configuration DSL with JSON round-trip
+  (ref: deeplearning4j-nn/.../conf/NeuralNetConfiguration.java)
+- sequential + DAG network runtimes with ``fit``/``output``/``evaluate``
+  (ref: MultiLayerNetwork.java, ComputationGraph.java)
+- the full layer set lowered to XLA instead of cuDNN
+  (ref: deeplearning4j-cuda helpers)
+- data-parallel training via ``jax.sharding`` + dense allreduce over ICI/DCN
+  (ref: deeplearning4j-scaleout ParallelWrapper / Spark / Aeron stack)
+- Keras HDF5 + DL4J-zip model import, model zoo, evaluation / early stopping /
+  transfer learning, training observability.
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.nn.conf import (  # noqa: F401
+    NeuralNetConfiguration,
+    MultiLayerConfiguration,
+    ComputationGraphConfiguration,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: F401
+from deeplearning4j_tpu.nn.graph import ComputationGraph  # noqa: F401

@@ -1,0 +1,12 @@
+"""Data pipeline: DataSet container, iterators, fetchers.
+
+TPU-native equivalent of ND4J DataSet + deeplearning4j-core datasets/*
+(RecordReaderDataSetIterator, MnistDataSetIterator, AsyncDataSetIterator...).
+"""
+
+from deeplearning4j_tpu.datasets.dataset import DataSet  # noqa: F401
+from deeplearning4j_tpu.datasets.iterators import (  # noqa: F401
+    ArrayDataSetIterator,
+    AsyncDataSetIterator,
+    ExistingDataSetIterator,
+)

@@ -1,0 +1,53 @@
+"""DataSet: one minibatch of (features, labels, masks).
+
+TPU-native equivalent of ND4J org.nd4j.linalg.dataset.DataSet as consumed by
+the reference's fit loops (MultiLayerNetwork.java:1204 hot loop). A plain
+container of numpy/jax arrays; conversion to device arrays happens at the
+jit boundary so host-side pipelines stay numpy-fast.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+
+@dataclass
+class DataSet:
+    features: np.ndarray
+    labels: Optional[np.ndarray] = None
+    features_mask: Optional[np.ndarray] = None
+    labels_mask: Optional[np.ndarray] = None
+
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    def split_test_and_train(self, n_train: int):
+        a = DataSet(self.features[:n_train],
+                    None if self.labels is None else self.labels[:n_train])
+        b = DataSet(self.features[n_train:],
+                    None if self.labels is None else self.labels[n_train:])
+        return a, b
+
+    def shuffle(self, seed: Optional[int] = None):
+        rng = np.random.default_rng(seed)
+        idx = rng.permutation(self.num_examples())
+        self.features = self.features[idx]
+        if self.labels is not None:
+            self.labels = self.labels[idx]
+        if self.features_mask is not None:
+            self.features_mask = self.features_mask[idx]
+        if self.labels_mask is not None:
+            self.labels_mask = self.labels_mask[idx]
+
+    def batch_by(self, batch_size: int):
+        n = self.num_examples()
+        for s in range(0, n, batch_size):
+            yield DataSet(
+                self.features[s:s + batch_size],
+                None if self.labels is None else self.labels[s:s + batch_size],
+                None if self.features_mask is None else self.features_mask[s:s + batch_size],
+                None if self.labels_mask is None else self.labels_mask[s:s + batch_size],
+            )

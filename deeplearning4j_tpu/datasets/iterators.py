@@ -1,0 +1,162 @@
+"""DataSet iterators.
+
+TPU-native equivalent of the reference's iterator zoo:
+- AsyncDataSetIterator (deeplearning4j-nn/.../datasets/iterator/
+  AsyncDataSetIterator.java) — background prefetch so host ETL overlaps device
+  compute; here a daemon thread + bounded queue (the device-affinity
+  machinery of the ref's MagicQueue is unnecessary: JAX moves arrays at
+  dispatch and overlaps H2D with compute).
+- ExistingDataSetIterator, MultipleEpochsIterator, EarlyTerminationIterator,
+  SamplingDataSetIterator (ref: datasets/iterator/*.java).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+class DataSetIterator:
+    """Iterator protocol matching the reference's DataSetIterator semantics
+    (reset + iteration)."""
+
+    def reset(self):
+        pass
+
+    def __iter__(self) -> Iterator[DataSet]:
+        raise NotImplementedError
+
+
+class ArrayDataSetIterator(DataSetIterator):
+    """Batches over in-memory arrays."""
+
+    def __init__(self, features, labels=None, batch_size: int = 32,
+                 features_mask=None, labels_mask=None, shuffle: bool = False,
+                 seed: int = 0):
+        self.features = np.asarray(features)
+        self.labels = None if labels is None else np.asarray(labels)
+        self.features_mask = None if features_mask is None else np.asarray(features_mask)
+        self.labels_mask = None if labels_mask is None else np.asarray(labels_mask)
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self._seed = seed
+        self._epoch = 0
+
+    def __iter__(self):
+        n = self.features.shape[0]
+        idx = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng(self._seed + self._epoch)
+            rng.shuffle(idx)
+        self._epoch += 1
+        for s in range(0, n, self.batch_size):
+            sel = idx[s:s + self.batch_size]
+            yield DataSet(
+                self.features[sel],
+                None if self.labels is None else self.labels[sel],
+                None if self.features_mask is None else self.features_mask[sel],
+                None if self.labels_mask is None else self.labels_mask[sel],
+            )
+
+
+class ExistingDataSetIterator(DataSetIterator):
+    """Wraps a list/iterable of DataSets (ref: ExistingDataSetIterator.java)."""
+
+    def __init__(self, datasets: Sequence[DataSet]):
+        self.datasets = list(datasets)
+
+    def __iter__(self):
+        return iter(self.datasets)
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-prefetch wrapper (ref: AsyncDataSetIterator.java, default
+    queue depth 2 per device in the ref's fit loop :1161)."""
+
+    _SENTINEL = object()
+
+    def __init__(self, base: DataSetIterator, prefetch: int = 2):
+        self.base = base
+        self.prefetch = prefetch
+
+    def reset(self):
+        self.base.reset()
+
+    def __iter__(self):
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        err: List[BaseException] = []
+
+        def worker():
+            try:
+                for ds in self.base:
+                    q.put(ds)
+            except BaseException as e:  # surface worker errors to consumer
+                err.append(e)
+            finally:
+                q.put(self._SENTINEL)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is self._SENTINEL:
+                if err:
+                    raise err[0]
+                return
+            yield item
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Repeat a base iterator N times (ref: MultipleEpochsIterator.java)."""
+
+    def __init__(self, epochs: int, base: DataSetIterator):
+        self.epochs = epochs
+        self.base = base
+
+    def __iter__(self):
+        for _ in range(self.epochs):
+            self.base.reset()
+            yield from self.base
+
+
+class EarlyTerminationDataSetIterator(DataSetIterator):
+    """Cap the number of minibatches (ref: EarlyTerminationDataSetIterator.java)."""
+
+    def __init__(self, base: DataSetIterator, max_batches: int):
+        self.base = base
+        self.max_batches = max_batches
+
+    def reset(self):
+        self.base.reset()
+
+    def __iter__(self):
+        for i, ds in enumerate(self.base):
+            if i >= self.max_batches:
+                return
+            yield ds
+
+
+class SamplingDataSetIterator(DataSetIterator):
+    """Sample batches with replacement from a full DataSet
+    (ref: SamplingDataSetIterator.java)."""
+
+    def __init__(self, full: DataSet, batch_size: int, total_batches: int, seed: int = 0):
+        self.full = full
+        self.batch_size = batch_size
+        self.total_batches = total_batches
+        self.seed = seed
+
+    def __iter__(self):
+        rng = np.random.default_rng(self.seed)
+        n = self.full.num_examples()
+        for _ in range(self.total_batches):
+            sel = rng.integers(0, n, self.batch_size)
+            yield DataSet(
+                self.full.features[sel],
+                None if self.full.labels is None else self.full.labels[sel],
+            )

@@ -1,0 +1,8 @@
+from deeplearning4j_tpu.eval.evaluation import (  # noqa: F401
+    Evaluation,
+    RegressionEvaluation,
+    ConfusionMatrix,
+)
+from deeplearning4j_tpu.eval.roc import ROC, ROCBinary, ROCMultiClass  # noqa: F401
+from deeplearning4j_tpu.eval.binary import EvaluationBinary  # noqa: F401
+from deeplearning4j_tpu.eval.calibration import EvaluationCalibration  # noqa: F401

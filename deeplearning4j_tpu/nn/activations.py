@@ -1,0 +1,106 @@
+"""Activation functions.
+
+TPU-native equivalent of the ND4J activation set the reference dispatches to
+(referenced from layer configs, e.g. deeplearning4j-nn/src/main/java/org/
+deeplearning4j/nn/conf/layers/Layer.java `activation` field). On TPU every
+activation is a pure jnp function fused by XLA into the surrounding matmul —
+there is no per-activation native kernel to manage (ref's cuDNN fused
+bias+activation, CudnnConvolutionHelper.java:435-436, comes for free here).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["get", "register", "ACTIVATIONS"]
+
+
+def _identity(x):
+    return x
+
+
+def _cube(x):
+    return x ** 3
+
+
+def _hardsigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def _hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def _leakyrelu(x, alpha=0.01):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+def _rationaltanh(x):
+    # 1.7159 * tanh(2x/3) approximated rationally (ND4J ActivationRationalTanh)
+    ax = jnp.abs(2.0 * x / 3.0)
+    tanh_approx = jnp.sign(x) * (1.0 - 1.0 / (1.0 + ax + ax * ax + 1.41645 * ax ** 4))
+    return 1.7159 * tanh_approx
+
+
+def _rectifiedtanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+def _softsign(x):
+    return x / (1.0 + jnp.abs(x))
+
+
+def _swish(x):
+    return x * jax.nn.sigmoid(x)
+
+
+def _gelu(x):
+    return jax.nn.gelu(x)
+
+
+def _softmax(x):
+    return jax.nn.softmax(x, axis=1 if x.ndim > 1 else -1)
+
+
+def _thresholdedrelu(x, theta=1.0):
+    return jnp.where(x > theta, x, 0.0)
+
+
+ACTIVATIONS = {
+    "identity": _identity,
+    "linear": _identity,
+    "cube": _cube,
+    "elu": jax.nn.elu,
+    "hardsigmoid": _hardsigmoid,
+    "hardtanh": _hardtanh,
+    "leakyrelu": _leakyrelu,
+    "rationaltanh": _rationaltanh,
+    "rectifiedtanh": _rectifiedtanh,
+    "relu": jax.nn.relu,
+    "relu6": lambda x: jnp.clip(x, 0.0, 6.0),
+    "sigmoid": jax.nn.sigmoid,
+    "softmax": _softmax,
+    "softplus": jax.nn.softplus,
+    "softsign": _softsign,
+    "tanh": jnp.tanh,
+    "selu": jax.nn.selu,
+    "swish": _swish,
+    "gelu": _gelu,
+    "thresholdedrelu": _thresholdedrelu,
+}
+
+
+def register(name: str, fn) -> None:
+    """Register a custom activation under ``name``."""
+    ACTIVATIONS[name.lower()] = fn
+
+
+def get(name):
+    """Resolve an activation by name (case-insensitive) or pass through callables."""
+    if callable(name):
+        return name
+    key = str(name).lower()
+    if key not in ACTIVATIONS:
+        raise ValueError(f"Unknown activation '{name}'. Known: {sorted(ACTIVATIONS)}")
+    return ACTIVATIONS[key]

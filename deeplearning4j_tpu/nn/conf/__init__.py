@@ -1,0 +1,15 @@
+"""Network configuration DSL.
+
+TPU-native equivalent of deeplearning4j-nn/src/main/java/org/deeplearning4j/nn/conf:
+typed, JSON-round-trippable configs built with a fluent builder
+(ref: NeuralNetConfiguration.java:570-1138, MultiLayerConfiguration.java,
+ComputationGraphConfiguration.java).
+"""
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType  # noqa: F401
+from deeplearning4j_tpu.nn.conf.layers import *  # noqa: F401,F403
+from deeplearning4j_tpu.nn.conf.network import (  # noqa: F401
+    NeuralNetConfiguration,
+    MultiLayerConfiguration,
+    ComputationGraphConfiguration,
+)

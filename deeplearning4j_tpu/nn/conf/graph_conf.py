@@ -1,0 +1,411 @@
+"""Graph vertices + GraphBuilder for DAG networks.
+
+TPU-native equivalent of nn/conf/graph/* and nn/graph/vertex/impl/*
+(LayerVertex, MergeVertex, ElementWiseVertex, SubsetVertex, Stack/Unstack,
+Scale/Shift, L2NormalizeVertex, L2Vertex, PreprocessorVertex,
+rnn/LastTimeStepVertex, rnn/DuplicateToTimeSeriesVertex) and of
+ComputationGraphConfiguration.GraphBuilder (addInputs/addLayer/addVertex/
+setOutputs — ComputationGraphConfiguration.java GraphBuilder).
+
+Vertices are pure functions of their input activations; autodiff handles the
+reverse-topo epsilon accumulation the reference hand-writes
+(ComputationGraph.calcBackpropGradients :1629).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    LayerConf,
+    layer_from_dict,
+    layer_to_dict,
+)
+from deeplearning4j_tpu.nn.conf.preprocessors import (
+    Preprocessor,
+    preprocessor_from_dict,
+    preprocessor_to_dict,
+)
+
+VERTEX_REGISTRY: Dict[str, type] = {}
+
+
+def register_vertex(cls):
+    VERTEX_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def vertex_to_dict(v) -> dict:
+    d = {"@class": type(v).__name__}
+    for f in dataclasses.fields(v):
+        val = getattr(v, f.name)
+        if isinstance(val, LayerConf):
+            val = layer_to_dict(val)
+        elif isinstance(val, Preprocessor):
+            val = preprocessor_to_dict(val)
+        elif isinstance(val, tuple):
+            val = list(val)
+        d[f.name] = val
+    return d
+
+
+def vertex_from_dict(d: dict):
+    d = dict(d)
+    cls = VERTEX_REGISTRY[d.pop("@class")]
+    names = {f.name for f in dataclasses.fields(cls)}
+    kwargs = {k: v for k, v in d.items() if k in names}
+    return cls(**kwargs)
+
+
+@dataclass
+class GraphVertexConf:
+    """Base vertex: pure function of input activation list."""
+
+    def output_type(self, its: List[InputType]) -> InputType:
+        return its[0]
+
+    def init(self, key, its: List[InputType]):
+        return {}, {}
+
+    def apply(self, params, xs: List, state, *, train=False, rng=None, mask=None):
+        raise NotImplementedError
+
+    def output_mask(self, masks, its):
+        for m in masks:
+            if m is not None:
+                return m
+        return None
+
+
+@register_vertex
+@dataclass
+class LayerVertex(GraphVertexConf):
+    """Wraps a layer conf (+ optional preprocessor)
+    (ref: nn/graph/vertex/impl/LayerVertex.java)."""
+
+    layer: Any = None  # LayerConf | dict
+    preprocessor: Any = None  # Preprocessor | dict | None
+
+    def __post_init__(self):
+        if isinstance(self.layer, dict):
+            self.layer = layer_from_dict(self.layer)
+        if isinstance(self.preprocessor, dict):
+            self.preprocessor = preprocessor_from_dict(self.preprocessor)
+
+    def output_type(self, its):
+        it = its[0]
+        if self.preprocessor is not None:
+            it = self.preprocessor.output_type(it)
+        return self.layer.output_type(it)
+
+    def init(self, key, its):
+        it = its[0]
+        if self.preprocessor is not None:
+            it = self.preprocessor.output_type(it)
+        return self.layer.init(key, it)
+
+    def apply(self, params, xs, state, *, train=False, rng=None, mask=None):
+        x = xs[0]
+        if self.preprocessor is not None:
+            x = self.preprocessor.apply(x, mask)
+        return self.layer.apply(params, x, state, train=train, rng=rng, mask=mask)
+
+    def output_mask(self, masks, its):
+        m = masks[0] if masks else None
+        it = its[0]
+        if self.preprocessor is not None:
+            m = self.preprocessor.output_mask(m, it)
+            it = self.preprocessor.output_type(it)
+        return self.layer.output_mask(m, it)
+
+
+@register_vertex
+@dataclass
+class MergeVertex(GraphVertexConf):
+    """Concatenate along the feature axis (axis 1 for FF/CNN/RNN — DL4J
+    merges on depth/features; ref: vertex/impl/MergeVertex.java)."""
+
+    def output_type(self, its):
+        first = its[0]
+        if first.kind == "cnn":
+            ch = sum(it.channels for it in its)
+            return InputType.convolutional(first.height, first.width, ch)
+        if first.kind == "rnn":
+            return InputType.recurrent(sum(it.size for it in its), first.timesteps)
+        return InputType.feed_forward(sum(it.flat_size() for it in its))
+
+    def apply(self, params, xs, state, *, train=False, rng=None, mask=None):
+        return jnp.concatenate(xs, axis=1), state
+
+
+@register_vertex
+@dataclass
+class ElementWiseVertex(GraphVertexConf):
+    """Element-wise op across inputs: Add/Subtract/Product/Average/Max
+    (ref: vertex/impl/ElementWiseVertex.java)."""
+
+    op: str = "add"
+
+    def apply(self, params, xs, state, *, train=False, rng=None, mask=None):
+        op = self.op.lower()
+        y = xs[0]
+        if op == "add":
+            for x in xs[1:]:
+                y = y + x
+        elif op in ("subtract", "sub"):
+            y = xs[0] - xs[1]
+        elif op in ("product", "mul"):
+            for x in xs[1:]:
+                y = y * x
+        elif op in ("average", "avg"):
+            y = sum(xs) / float(len(xs))
+        elif op == "max":
+            for x in xs[1:]:
+                y = jnp.maximum(y, x)
+        else:
+            raise ValueError(f"unknown elementwise op {self.op}")
+        return y, state
+
+
+@register_vertex
+@dataclass
+class SubsetVertex(GraphVertexConf):
+    """Take features [from, to] inclusive (ref: vertex/impl/SubsetVertex.java)."""
+
+    from_index: int = 0
+    to_index: int = 0
+
+    def output_type(self, its):
+        n = self.to_index - self.from_index + 1
+        it = its[0]
+        if it.kind == "rnn":
+            return InputType.recurrent(n, it.timesteps)
+        if it.kind == "cnn":
+            return InputType.convolutional(it.height, it.width, n)
+        return InputType.feed_forward(n)
+
+    def apply(self, params, xs, state, *, train=False, rng=None, mask=None):
+        return xs[0][:, self.from_index:self.to_index + 1], state
+
+
+@register_vertex
+@dataclass
+class StackVertex(GraphVertexConf):
+    """Stack inputs along batch axis (ref: vertex/impl/StackVertex.java)."""
+
+    def apply(self, params, xs, state, *, train=False, rng=None, mask=None):
+        return jnp.concatenate(xs, axis=0), state
+
+
+@register_vertex
+@dataclass
+class UnstackVertex(GraphVertexConf):
+    """Take stack slice `from_index` of `stack_size` (ref: UnstackVertex.java)."""
+
+    from_index: int = 0
+    stack_size: int = 1
+
+    def apply(self, params, xs, state, *, train=False, rng=None, mask=None):
+        x = xs[0]
+        step = x.shape[0] // self.stack_size
+        return x[self.from_index * step:(self.from_index + 1) * step], state
+
+
+@register_vertex
+@dataclass
+class ScaleVertex(GraphVertexConf):
+    """Multiply by scalar (ref: ScaleVertex.java)."""
+
+    scale: float = 1.0
+
+    def apply(self, params, xs, state, *, train=False, rng=None, mask=None):
+        return xs[0] * self.scale, state
+
+
+@register_vertex
+@dataclass
+class ShiftVertex(GraphVertexConf):
+    """Add scalar (ref: ShiftVertex.java)."""
+
+    shift: float = 0.0
+
+    def apply(self, params, xs, state, *, train=False, rng=None, mask=None):
+        return xs[0] + self.shift, state
+
+
+@register_vertex
+@dataclass
+class L2NormalizeVertex(GraphVertexConf):
+    """Normalize each example to unit L2 norm (ref: L2NormalizeVertex.java)."""
+
+    eps: float = 1e-8
+
+    def apply(self, params, xs, state, *, train=False, rng=None, mask=None):
+        x = xs[0]
+        axes = tuple(range(1, x.ndim))
+        n = jnp.sqrt(jnp.sum(x * x, axis=axes, keepdims=True) + self.eps)
+        return x / n, state
+
+
+@register_vertex
+@dataclass
+class L2Vertex(GraphVertexConf):
+    """Pairwise L2 distance between two inputs (ref: L2Vertex.java)."""
+
+    eps: float = 1e-8
+
+    def output_type(self, its):
+        return InputType.feed_forward(1)
+
+    def apply(self, params, xs, state, *, train=False, rng=None, mask=None):
+        a, b = xs[0], xs[1]
+        axes = tuple(range(1, a.ndim))
+        d = jnp.sqrt(jnp.sum((a - b) ** 2, axis=axes, keepdims=False) + self.eps)
+        return d[:, None], state
+
+
+@register_vertex
+@dataclass
+class PreprocessorVertex(GraphVertexConf):
+    """Standalone preprocessor vertex (ref: PreprocessorVertex.java)."""
+
+    preprocessor: Any = None
+
+    def __post_init__(self):
+        if isinstance(self.preprocessor, dict):
+            self.preprocessor = preprocessor_from_dict(self.preprocessor)
+
+    def output_type(self, its):
+        return self.preprocessor.output_type(its[0])
+
+    def apply(self, params, xs, state, *, train=False, rng=None, mask=None):
+        return self.preprocessor.apply(xs[0], mask), state
+
+
+@register_vertex
+@dataclass
+class LastTimeStepVertex(GraphVertexConf):
+    """[N,C,T] -> [N,C] at the last unmasked step
+    (ref: rnn/LastTimeStepVertex.java)."""
+
+    mask_input: Optional[str] = None
+
+    def output_type(self, its):
+        return InputType.feed_forward(its[0].size)
+
+    def output_mask(self, masks, its):
+        return None
+
+    def apply(self, params, xs, state, *, train=False, rng=None, mask=None):
+        x = xs[0]
+        if mask is None:
+            return x[:, :, -1], state
+        idx = jnp.clip(jnp.sum(mask > 0, axis=1).astype(jnp.int32) - 1, 0,
+                       x.shape[2] - 1)
+        return jnp.take_along_axis(x, idx[:, None, None], axis=2)[:, :, 0], state
+
+
+@register_vertex
+@dataclass
+class DuplicateToTimeSeriesVertex(GraphVertexConf):
+    """[N,C] -> [N,C,T], duplicating across time
+    (ref: rnn/DuplicateToTimeSeriesVertex.java). T is taken from a reference
+    RNN input at apply time via the `timesteps` attribute set by the graph."""
+
+    ts_input: Optional[str] = None
+    timesteps: int = 1
+
+    def output_type(self, its):
+        return InputType.recurrent(its[0].flat_size(), self.timesteps)
+
+    def apply(self, params, xs, state, *, train=False, rng=None, mask=None):
+        x = xs[0]
+        t = self.timesteps
+        if len(xs) > 1 and xs[1].ndim == 3:  # reference sequence provided
+            t = xs[1].shape[2]
+        return jnp.repeat(x[:, :, None], t, axis=2), state
+
+
+@register_vertex
+@dataclass
+class PoolHelperVertex(GraphVertexConf):
+    """Strip first row/col of a CNN activation (GoogLeNet compat shim;
+    ref: PoolHelperVertex.java)."""
+
+    def output_type(self, its):
+        it = its[0]
+        return InputType.convolutional(it.height - 1, it.width - 1, it.channels)
+
+    def apply(self, params, xs, state, *, train=False, rng=None, mask=None):
+        return xs[0][:, :, 1:, 1:], state
+
+
+@register_vertex
+@dataclass
+class ReshapeVertex(GraphVertexConf):
+    """Reshape to a fixed per-example shape."""
+
+    shape: Sequence[int] = ()
+
+    def apply(self, params, xs, state, *, train=False, rng=None, mask=None):
+        return xs[0].reshape((xs[0].shape[0],) + tuple(self.shape)), state
+
+
+class GraphBuilder:
+    """Fluent DAG builder (ref: ComputationGraphConfiguration.GraphBuilder)."""
+
+    def __init__(self, parent):
+        from deeplearning4j_tpu.nn.conf.network import ComputationGraphConfiguration
+        self._parent = parent
+        self._conf = ComputationGraphConfiguration(
+            seed=parent._seed,
+            updater=parent._updater,
+            gradient_normalization=parent._grad_norm,
+            gradient_normalization_threshold=parent._grad_norm_threshold,
+        )
+        self._defaults = parent._defaults
+
+    def add_inputs(self, *names: str):
+        self._conf.network_inputs.extend(names)
+        return self
+
+    def set_input_types(self, *its: InputType):
+        for name, it in zip(self._conf.network_inputs, its):
+            self._conf.input_types[name] = it
+        return self
+
+    def add_layer(self, name: str, layer: LayerConf, *inputs: str,
+                  preprocessor: Optional[Preprocessor] = None):
+        from deeplearning4j_tpu.nn.conf.network import apply_global_defaults
+        apply_global_defaults(layer, self._defaults)
+        layer.name = name
+        self._conf.vertices[name] = LayerVertex(layer=layer, preprocessor=preprocessor)
+        self._conf.vertex_inputs[name] = list(inputs)
+        return self
+
+    def add_vertex(self, name: str, vertex: GraphVertexConf, *inputs: str):
+        self._conf.vertices[name] = vertex
+        self._conf.vertex_inputs[name] = list(inputs)
+        return self
+
+    def set_outputs(self, *names: str):
+        self._conf.network_outputs = list(names)
+        return self
+
+    def build(self):
+        conf = self._conf
+        if not conf.network_inputs:
+            raise ValueError("graph has no inputs")
+        if not conf.network_outputs:
+            raise ValueError("graph has no outputs")
+        for name in conf.vertices:
+            for i in conf.vertex_inputs.get(name, []):
+                if i not in conf.vertices and i not in conf.network_inputs:
+                    raise ValueError(f"vertex '{name}' input '{i}' is undefined")
+        conf.topological_order()  # validates acyclicity
+        return conf

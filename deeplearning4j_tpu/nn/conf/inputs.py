@@ -1,0 +1,90 @@
+"""Input type shape inference.
+
+TPU-native equivalent of nn/conf/inputs/InputType.java — carries the
+per-example logical shape between layers so configs can infer nIn and
+auto-insert preprocessors (ref: InputTypeUtil.java,
+MultiLayerConfiguration setInputType path).
+
+Conventions (matching the reference):
+- feed-forward activations: [batch, size]
+- recurrent activations:    [batch, size, timeSeriesLength]  (DL4J NCW)
+- convolutional activations: [batch, channels, height, width] (NCHW)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+
+@dataclass(frozen=True)
+class InputType:
+    kind: str  # "ff" | "rnn" | "cnn" | "cnn_flat" | "cnn3d"
+    size: Optional[int] = None  # ff/rnn feature size
+    timesteps: Optional[int] = None  # rnn sequence length (None = variable)
+    channels: Optional[int] = None
+    height: Optional[int] = None
+    width: Optional[int] = None
+    depth: Optional[int] = None  # cnn3d
+
+    # ---- factories (mirror InputType.feedForward / recurrent / convolutional) ----
+    @staticmethod
+    def feed_forward(size: int) -> "InputType":
+        return InputType(kind="ff", size=int(size))
+
+    @staticmethod
+    def recurrent(size: int, timesteps: Optional[int] = None) -> "InputType":
+        return InputType(kind="rnn", size=int(size),
+                         timesteps=None if timesteps is None else int(timesteps))
+
+    @staticmethod
+    def convolutional(height: int, width: int, channels: int) -> "InputType":
+        return InputType(kind="cnn", channels=int(channels), height=int(height),
+                         width=int(width))
+
+    @staticmethod
+    def convolutional_flat(height: int, width: int, channels: int) -> "InputType":
+        return InputType(kind="cnn_flat", channels=int(channels), height=int(height),
+                         width=int(width), size=int(height) * int(width) * int(channels))
+
+    @staticmethod
+    def convolutional_3d(depth: int, height: int, width: int, channels: int) -> "InputType":
+        return InputType(kind="cnn3d", channels=int(channels), depth=int(depth),
+                         height=int(height), width=int(width))
+
+    # ---- helpers ----
+    def flat_size(self) -> int:
+        if self.kind in ("ff", "cnn_flat"):
+            return int(self.size)
+        if self.kind == "rnn":
+            return int(self.size)
+        if self.kind == "cnn":
+            return int(self.channels) * int(self.height) * int(self.width)
+        if self.kind == "cnn3d":
+            return int(self.channels) * int(self.depth) * int(self.height) * int(self.width)
+        raise ValueError(f"no flat size for {self}")
+
+    def example_shape(self, batch: int = 1) -> Tuple[int, ...]:
+        """Array shape for a batch of this input type."""
+        if self.kind in ("ff", "cnn_flat"):
+            return (batch, int(self.size))
+        if self.kind == "rnn":
+            return (batch, int(self.size), int(self.timesteps or 1))
+        if self.kind == "cnn":
+            return (batch, int(self.channels), int(self.height), int(self.width))
+        if self.kind == "cnn3d":
+            return (batch, int(self.channels), int(self.depth), int(self.height),
+                    int(self.width))
+        raise ValueError(f"no example shape for {self}")
+
+    def to_dict(self) -> dict:
+        d = {"kind": self.kind}
+        for f in ("size", "timesteps", "channels", "height", "width", "depth"):
+            v = getattr(self, f)
+            if v is not None:
+                d[f] = v
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "InputType":
+        return InputType(**d)

@@ -1,0 +1,163 @@
+"""Input preprocessors — shape adapters between layer families.
+
+TPU-native equivalent of nn/conf/preprocessor/* (CnnToFeedForwardPreProcessor,
+FeedForwardToCnnPreProcessor, RnnToFeedForwardPreProcessor, ...). Each is a
+pure reshape/transpose the reference implements with explicit
+preProcess/backprop pairs; here autodiff inverts them automatically.
+
+Layout conventions (matching the reference): FF [N,F]; CNN [N,C,H,W];
+RNN [N,F,T].
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+PREPROCESSOR_REGISTRY: Dict[str, type] = {}
+
+
+def register_preprocessor(cls):
+    PREPROCESSOR_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def preprocessor_to_dict(p) -> dict:
+    d = {"@class": type(p).__name__}
+    for f in dataclasses.fields(p):
+        v = getattr(p, f.name)
+        if isinstance(v, tuple):
+            v = list(v)
+        d[f.name] = v
+    return d
+
+
+def preprocessor_from_dict(d: dict):
+    d = dict(d)
+    cls = PREPROCESSOR_REGISTRY[d.pop("@class")]
+    names = {f.name for f in dataclasses.fields(cls)}
+    return cls(**{k: v for k, v in d.items() if k in names})
+
+
+@dataclass
+class Preprocessor:
+    def apply(self, x, mask=None):
+        raise NotImplementedError
+
+    def output_type(self, it: InputType) -> InputType:
+        raise NotImplementedError
+
+    def output_mask(self, mask, it: InputType):
+        return mask
+
+
+@register_preprocessor
+@dataclass
+class CnnToFeedForwardPreProcessor(Preprocessor):
+    """[N,C,H,W] -> [N, C*H*W] (ref: CnnToFeedForwardPreProcessor.java)."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def apply(self, x, mask=None):
+        return x.reshape(x.shape[0], -1)
+
+    def output_type(self, it):
+        return InputType.feed_forward(it.flat_size())
+
+
+@register_preprocessor
+@dataclass
+class FeedForwardToCnnPreProcessor(Preprocessor):
+    """[N, C*H*W] -> [N,C,H,W] (ref: FeedForwardToCnnPreProcessor.java)."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def apply(self, x, mask=None):
+        if x.ndim == 4:
+            return x
+        return x.reshape(x.shape[0], self.channels, self.height, self.width)
+
+    def output_type(self, it):
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@register_preprocessor
+@dataclass
+class RnnToFeedForwardPreProcessor(Preprocessor):
+    """[N,F,T] -> [N*T, F] (time folded into batch;
+    ref: RnnToFeedForwardPreProcessor.java)."""
+
+    def apply(self, x, mask=None):
+        n, f, t = x.shape
+        return jnp.transpose(x, (0, 2, 1)).reshape(n * t, f)
+
+    def output_type(self, it):
+        return InputType.feed_forward(it.size)
+
+
+@register_preprocessor
+@dataclass
+class FeedForwardToRnnPreProcessor(Preprocessor):
+    """[N*T, F] -> [N,F,T] (ref: FeedForwardToRnnPreProcessor.java)."""
+
+    timesteps: int = 1
+
+    def apply(self, x, mask=None):
+        nt, f = x.shape
+        n = nt // self.timesteps
+        return jnp.transpose(x.reshape(n, self.timesteps, f), (0, 2, 1))
+
+    def output_type(self, it):
+        return InputType.recurrent(it.size, self.timesteps)
+
+
+@register_preprocessor
+@dataclass
+class CnnToRnnPreProcessor(Preprocessor):
+    """[N,C,H,W] -> [N, C*H*W, T=1]... ref semantics: treat each example's
+    flattened conv features as one timestep element of a sequence whose time
+    dim comes from the width axis (ref: CnnToRnnPreProcessor.java maps
+    [mb,C,H,W] -> [mb, C*H, W] is NOT what DL4J does — DL4J reshapes to
+    [mb, C*H*W] per step of an outer time series). Here we implement the
+    common DL4J usage: input [N*T,C,H,W] -> [N, C*H*W, T]."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+    timesteps: int = 1
+
+    def apply(self, x, mask=None):
+        nt = x.shape[0]
+        n = nt // self.timesteps
+        flat = x.reshape(nt, -1)
+        return jnp.transpose(flat.reshape(n, self.timesteps, -1), (0, 2, 1))
+
+    def output_type(self, it):
+        return InputType.recurrent(it.flat_size(), self.timesteps)
+
+
+@register_preprocessor
+@dataclass
+class RnnToCnnPreProcessor(Preprocessor):
+    """[N,F,T] -> [N*T, C, H, W] (ref: RnnToCnnPreProcessor.java)."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def apply(self, x, mask=None):
+        n, f, t = x.shape
+        flat = jnp.transpose(x, (0, 2, 1)).reshape(n * t, f)
+        return flat.reshape(n * t, self.channels, self.height, self.width)
+
+    def output_type(self, it):
+        return InputType.convolutional(self.height, self.width, self.channels)

@@ -1,0 +1,7 @@
+"""Functional layer math (forward only — backward comes from jax.grad).
+
+TPU-native replacement for deeplearning4j-nn/.../nn/layers/* hand-written
+forward/backward pairs and the deeplearning4j-cuda cuDNN helpers: each op here
+is a pure function lowered by XLA onto the MXU/VPU; autodiff replaces every
+`backpropGradient` in the reference.
+"""

@@ -1,0 +1,164 @@
+"""Convolution + pooling ops (NCHW, matching the reference's layout).
+
+TPU-native equivalent of:
+- CudnnConvolutionHelper (deeplearning4j-cuda/.../convolution/CudnnConvolutionHelper.java:54-480)
+  and the im2col+gemm fallback (ConvolutionLayer.java:197-221)
+  -> `jax.lax.conv_general_dilated`, which XLA tiles directly onto the MXU —
+  no algo selection, workspace management, or im2col materialization needed.
+- CudnnSubsamplingHelper (.../subsampling/CudnnSubsamplingHelper.java:49-280)
+  -> `jax.lax.reduce_window`.
+
+ConvolutionMode semantics (ref: nn/conf/ConvolutionMode.java + InputTypeUtil.java):
+- "truncate": explicit padding, out = floor((in + 2p - k)/s) + 1
+- "strict":   explicit padding, requires (in + 2p - k) % s == 0
+- "same":     out = ceil(in/s), asymmetric padding computed by XLA ("SAME")
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+DIMSPEC_2D = ("NCHW", "OIHW", "NCHW")
+DIMSPEC_1D = ("NCW", "OIW", "NCW")
+
+
+def conv_out_size(in_size: int, k: int, s: int, p: int, d: int, mode: str) -> int:
+    eff_k = k + (k - 1) * (d - 1)
+    if mode == "same":
+        return -(-in_size // s)  # ceil
+    if mode == "strict":
+        if (in_size + 2 * p - eff_k) % s != 0:
+            raise ValueError(
+                f"ConvolutionMode strict: (in={in_size} + 2*p={p} - k={eff_k}) "
+                f"not divisible by stride {s}"
+            )
+        return (in_size + 2 * p - eff_k) // s + 1
+    # truncate
+    return (in_size + 2 * p - eff_k) // s + 1
+
+
+def _padding_arg(kernel, stride, padding, dilation, mode: str):
+    if mode == "same":
+        return "SAME"
+    return [(int(p), int(p)) for p in padding]
+
+
+def conv2d(
+    x: jax.Array,
+    w: jax.Array,
+    b: jax.Array | None,
+    stride: Sequence[int],
+    padding: Sequence[int],
+    dilation: Sequence[int] = (1, 1),
+    mode: str = "truncate",
+) -> jax.Array:
+    """2-D convolution, x:[N,C,H,W], w:[O,I,kH,kW] -> [N,O,H',W']."""
+    y = lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=tuple(int(s) for s in stride),
+        padding=_padding_arg(w.shape[2:], stride, padding, dilation, mode),
+        rhs_dilation=tuple(int(d) for d in dilation),
+        dimension_numbers=DIMSPEC_2D,
+    )
+    if b is not None:
+        y = y + b.reshape(1, -1, 1, 1)
+    return y
+
+
+def deconv2d(
+    x: jax.Array,
+    w: jax.Array,
+    b: jax.Array | None,
+    stride: Sequence[int],
+    padding: Sequence[int],
+    mode: str = "truncate",
+) -> jax.Array:
+    """2-D transposed convolution ("deconvolution", ref Deconvolution2D layer)."""
+    pad = "SAME" if mode == "same" else [(int(p), int(p)) for p in padding]
+    y = lax.conv_transpose(
+        x,
+        w,
+        strides=tuple(int(s) for s in stride),
+        padding=pad,
+        dimension_numbers=DIMSPEC_2D,
+        transpose_kernel=True,
+    )
+    if b is not None:
+        y = y + b.reshape(1, -1, 1, 1)
+    return y
+
+
+def conv1d(x, w, b, stride: int, padding: int, dilation: int = 1, mode: str = "truncate"):
+    """1-D convolution over [N, C, W]."""
+    pad = "SAME" if mode == "same" else [(int(padding), int(padding))]
+    y = lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=(int(stride),),
+        padding=pad,
+        rhs_dilation=(int(dilation),),
+        dimension_numbers=DIMSPEC_1D,
+    )
+    if b is not None:
+        y = y + b.reshape(1, -1, 1)
+    return y
+
+
+def _pool_padding(mode: str, padding, nd: int):
+    if mode == "same":
+        return "SAME"
+    return [(0, 0), (0, 0)] + [(int(p), int(p)) for p in padding]
+
+
+def max_pool2d(x, kernel, stride, padding, mode="truncate"):
+    dims = (1, 1) + tuple(int(k) for k in kernel)
+    strides = (1, 1) + tuple(int(s) for s in stride)
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max, dims, strides, _pool_padding(mode, padding, 2)
+    )
+
+
+def avg_pool2d(x, kernel, stride, padding, mode="truncate", count_include_pad=True):
+    dims = (1, 1) + tuple(int(k) for k in kernel)
+    strides = (1, 1) + tuple(int(s) for s in stride)
+    pad = _pool_padding(mode, padding, 2)
+    summed = lax.reduce_window(x, 0.0, lax.add, dims, strides, pad)
+    if count_include_pad and mode != "same":
+        denom = float(kernel[0] * kernel[1])
+        return summed / denom
+    ones = jnp.ones_like(x)
+    counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pad)
+    return summed / counts
+
+
+def pnorm_pool2d(x, kernel, stride, padding, p: float, mode="truncate", eps=1e-8):
+    """P-norm pooling (ref: SubsamplingLayer PoolingType.PNORM)."""
+    dims = (1, 1) + tuple(int(k) for k in kernel)
+    strides = (1, 1) + tuple(int(s) for s in stride)
+    pad = _pool_padding(mode, padding, 2)
+    powed = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, dims, strides, pad)
+    return jnp.clip(powed, eps, None) ** (1.0 / p)
+
+
+def upsample2d(x, size: Sequence[int]):
+    """Nearest-neighbour upsampling (ref: Upsampling2D layer)."""
+    sh, sw = int(size[0]), int(size[1])
+    return jnp.repeat(jnp.repeat(x, sh, axis=2), sw, axis=3)
+
+
+def zero_pad2d(x, pad: Sequence[int]):
+    """Zero padding [top, bottom, left, right] (ref: ZeroPaddingLayer)."""
+    t, bm, l, r = (int(p) for p in pad)
+    return jnp.pad(x, ((0, 0), (0, 0), (t, bm), (l, r)))
+
+
+def space_to_depth(x, block: int):
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // block, block, w // block, block)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * block * block, h // block, w // block)

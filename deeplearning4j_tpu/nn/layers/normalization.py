@@ -1,0 +1,81 @@
+"""Normalization ops: batch norm + local response normalization.
+
+TPU-native equivalent of:
+- CudnnBatchNormalizationHelper (deeplearning4j-cuda/.../normalization/
+  CudnnBatchNormalizationHelper.java:45-234) and BatchNormalization.java —
+  fused by XLA; running mean/var are explicit state (pytree), replacing the
+  ref's mutable param-view entries.
+- CudnnLocalResponseNormalizationHelper (.../CudnnLocalResponseNormalizationHelper.java)
+  — composed from pad+reduce_window; XLA fuses the window sum into the
+  normalization arithmetic.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def batch_norm(
+    x: jax.Array,
+    gamma: jax.Array,
+    beta: jax.Array,
+    running_mean: jax.Array,
+    running_var: jax.Array,
+    train: bool,
+    eps: float = 1e-5,
+    decay: float = 0.9,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Batch normalization over all-but-channel axes.
+
+    x is [N,F] (channel=axis 1) or [N,C,H,W] (channel=axis 1, DL4J NCHW).
+    Returns (y, new_running_mean, new_running_var). Running stats update uses
+    the reference's decay semantics: new = decay*old + (1-decay)*batch
+    (ref: BatchNormalization.java `decay` field, default 0.9).
+    """
+    axes = tuple(i for i in range(x.ndim) if i != 1)
+    bshape = [1] * x.ndim
+    bshape[1] = x.shape[1]
+
+    if train:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        new_mean = decay * running_mean + (1.0 - decay) * mean
+        new_var = decay * running_var + (1.0 - decay) * var
+    else:
+        mean, var = running_mean, running_var
+        new_mean, new_var = running_mean, running_var
+
+    inv = lax.rsqrt(var + eps)
+    y = (x - mean.reshape(bshape)) * inv.reshape(bshape)
+    y = y * gamma.reshape(bshape) + beta.reshape(bshape)
+    return y, new_mean, new_var
+
+
+def lrn(
+    x: jax.Array,
+    k: float = 2.0,
+    n: int = 5,
+    alpha: float = 1e-4,
+    beta: float = 0.75,
+) -> jax.Array:
+    """Local response normalization across channels (ref: LocalResponseNormalization
+    layer, defaults k=2 n=5 alpha=1e-4 beta=0.75).
+
+    y = x / (k + alpha * sum_{j in window n} x_j^2)^beta, window centered per channel.
+    """
+    sq = x * x
+    half = n // 2
+    # window-sum across the channel axis via reduce_window
+    win = lax.reduce_window(
+        sq,
+        0.0,
+        lax.add,
+        window_dimensions=(1, n, 1, 1),
+        window_strides=(1, 1, 1, 1),
+        padding=[(0, 0), (half, half), (0, 0), (0, 0)],
+    )
+    return x / (k + alpha * win) ** beta

@@ -1,0 +1,138 @@
+"""Recurrent ops: LSTM family as `lax.scan` with fused gate matmuls.
+
+TPU-native equivalent of:
+- LSTMHelpers (deeplearning4j-nn/.../recurrent/LSTMHelpers.java:58-785) —
+  the per-timestep Java loop becomes one `lax.scan`; the input projection
+  x@W for ALL timesteps is hoisted out of the scan into a single large
+  matmul that XLA tiles onto the MXU (the same fusion cuDNN's fused RNN
+  path performs, CudnnLSTMHelper.java:588).
+- GravesLSTM peepholes (ref: GravesLSTM.java / LSTMParamInitializer peephole
+  columns).
+- GravesBidirectionalLSTM (ref: GravesBidirectionalLSTM.java:219 — forward and
+  backward passes are SUMMED, output width = nOut).
+
+Gate order convention here is (i, f, c, o) — input gate, forget gate, cell
+candidate, output gate — i.e. Keras order, so Keras HDF5 import is a direct
+copy; the DL4J-zip importer permutes from DL4J's ordering.
+
+Data layout matches the reference: activations [batch, features, time] (NCW).
+Masking follows the ref's variable-length semantics: masked steps carry state
+through unchanged and output zeros (ref: LSTMHelpers maskArray handling).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn import activations as _act
+
+
+def lstm_scan(
+    x: jax.Array,  # [N, C, T]
+    w: jax.Array,  # [C, 4H] gate order (i, f, c, o)
+    rw: jax.Array,  # [H, 4H]
+    b: jax.Array,  # [4H]
+    h0: Optional[jax.Array] = None,  # [N, H]
+    c0: Optional[jax.Array] = None,  # [N, H]
+    peephole: Optional[jax.Array] = None,  # [3, H] rows (pI, pF, pO) — GravesLSTM
+    mask: Optional[jax.Array] = None,  # [N, T]
+    gate_act: str = "sigmoid",
+    cell_act: str = "tanh",
+    reverse: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Run an LSTM over the full sequence. Returns (out [N,H,T], hT, cT)."""
+    n, _, t = x.shape
+    h = rw.shape[0]
+    gact = _act.get(gate_act)
+    cact = _act.get(cell_act)
+
+    if h0 is None:
+        h0 = jnp.zeros((n, h), x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((n, h), x.dtype)
+
+    # Hoist the input projection out of the scan: one [T*N, C] @ [C, 4H] matmul.
+    xt = jnp.transpose(x, (2, 0, 1))  # [T, N, C]
+    zx = xt.reshape(t * n, -1) @ w
+    zx = zx.reshape(t, n, 4 * h) + b
+
+    if mask is not None:
+        mt = jnp.transpose(mask, (1, 0))[:, :, None].astype(x.dtype)  # [T, N, 1]
+    else:
+        mt = None
+
+    def step(carry, inputs):
+        h_prev, c_prev = carry
+        if mt is None:
+            z_t = inputs
+            m_t = None
+        else:
+            z_t, m_t = inputs
+        z = z_t + h_prev @ rw
+        zi, zf, zc, zo = jnp.split(z, 4, axis=-1)
+        if peephole is not None:
+            zi = zi + peephole[0] * c_prev
+            zf = zf + peephole[1] * c_prev
+        i = gact(zi)
+        f = gact(zf)
+        g = cact(zc)
+        c_new = f * c_prev + i * g
+        if peephole is not None:
+            zo = zo + peephole[2] * c_new
+        o = gact(zo)
+        h_new = o * cact(c_new)
+        if m_t is not None:
+            h_new = h_new * m_t + h_prev * (1.0 - m_t)
+            c_new = c_new * m_t + c_prev * (1.0 - m_t)
+            out = h_new * m_t
+        else:
+            out = h_new
+        return (h_new, c_new), out
+
+    xs = zx if mt is None else (zx, mt)
+    (h_fin, c_fin), outs = lax.scan(step, (h0, c0), xs, reverse=reverse)
+    return jnp.transpose(outs, (1, 2, 0)), h_fin, c_fin
+
+
+def bidirectional_sum(
+    x, wf, rwf, bf, wb, rwb, bb, peep_f=None, peep_b=None, mask=None,
+    gate_act="sigmoid", cell_act="tanh",
+):
+    """GravesBidirectionalLSTM: forward + backward LSTM outputs SUMMED."""
+    out_f, _, _ = lstm_scan(x, wf, rwf, bf, peephole=peep_f, mask=mask,
+                            gate_act=gate_act, cell_act=cell_act, reverse=False)
+    out_b, _, _ = lstm_scan(x, wb, rwb, bb, peephole=peep_b, mask=mask,
+                            gate_act=gate_act, cell_act=cell_act, reverse=True)
+    return out_f + out_b
+
+
+def simple_rnn_scan(x, w, rw, b, h0=None, mask=None, act="tanh"):
+    """Vanilla RNN: h_t = act(x_t @ W + h_{t-1} @ RW + b)."""
+    n, _, t = x.shape
+    h = rw.shape[0]
+    a = _act.get(act)
+    if h0 is None:
+        h0 = jnp.zeros((n, h), x.dtype)
+    xt = jnp.transpose(x, (2, 0, 1))
+    zx = xt.reshape(t * n, -1) @ w
+    zx = zx.reshape(t, n, h) + b
+    mt = None if mask is None else jnp.transpose(mask, (1, 0))[:, :, None].astype(x.dtype)
+
+    def step(h_prev, inputs):
+        if mt is None:
+            z_t, m_t = inputs, None
+        else:
+            z_t, m_t = inputs
+        h_new = a(z_t + h_prev @ rw)
+        if m_t is not None:
+            h_new = h_new * m_t + h_prev * (1.0 - m_t)
+            return h_new, h_new * m_t
+        return h_new, h_new
+
+    xs = zx if mt is None else (zx, mt)
+    h_fin, outs = lax.scan(step, h0, xs)
+    return jnp.transpose(outs, (1, 2, 0)), h_fin

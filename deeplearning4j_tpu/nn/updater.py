@@ -1,0 +1,318 @@
+"""Updaters (learning rules) + learning-rate schedules + gradient normalization.
+
+TPU-native equivalent of the reference's updater stack:
+- learning rules (ND4J org.nd4j.linalg.learning GradientUpdater impls, applied
+  per-block by deeplearning4j-nn/.../nn/updater/UpdaterBlock.java:104-114)
+- LR schedules (NeuralNetConfiguration learningRatePolicy)
+- gradient normalization/clipping (ref: GradientNormalization enum applied in
+  BaseMultiLayerUpdater.preApply)
+
+Instead of the reference's flat-view-array blocks mutated in place, updater
+state is an explicit pytree threaded through a pure `update` function — the
+idiomatic JAX formulation (optax-style), which jit/pjit can shard alongside
+params. Each updater dataclass serializes to JSON with the net config.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+UPDATER_REGISTRY: Dict[str, type] = {}
+
+
+def register_updater(cls):
+    UPDATER_REGISTRY[cls.__name__] = cls
+    UPDATER_REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+def updater_to_dict(u) -> dict:
+    d = {"@class": type(u).__name__}
+    for f in dataclasses.fields(u):
+        d[f.name] = getattr(u, f.name)
+    return d
+
+
+def updater_from_dict(d) -> "Updater":
+    if isinstance(d, Updater):
+        return d
+    d = dict(d)
+    cls = UPDATER_REGISTRY[d.pop("@class")]
+    names = {f.name for f in dataclasses.fields(cls)}
+    return cls(**{k: v for k, v in d.items() if k in names})
+
+
+# ---------------------------------------------------------------------------
+# schedules (ref: LearningRatePolicy: None, Exponential, Inverse, Poly,
+# Sigmoid, Step, Schedule(map))
+# ---------------------------------------------------------------------------
+
+
+def schedule_lr(base_lr, policy: Optional[str], iteration, *, decay_rate=0.0,
+                power=1.0, steps=1.0, max_iter=10000):
+    """Compute the scheduled LR at `iteration` (traceable)."""
+    if not policy or policy == "none":
+        return base_lr
+    it = jnp.asarray(iteration, jnp.float32)
+    p = policy.lower()
+    if p == "exponential":
+        return base_lr * decay_rate ** it
+    if p == "inverse":
+        return base_lr / (1.0 + decay_rate * it) ** power
+    if p == "poly":
+        return base_lr * (1.0 - it / max_iter) ** power
+    if p == "sigmoid":
+        return base_lr / (1.0 + jnp.exp(-decay_rate * (it - steps)))
+    if p == "step":
+        return base_lr * decay_rate ** jnp.floor(it / steps)
+    raise ValueError(f"unknown LR policy {policy}")
+
+
+# ---------------------------------------------------------------------------
+# updaters
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Updater:
+    """Base learning rule. init_state/update operate on a whole pytree."""
+
+    learning_rate: float = 1e-3
+
+    def init_state(self, params):
+        return {}
+
+    def update(self, grads, state, params, lr_scale=1.0):
+        """Return (updates_to_subtract, new_state)."""
+        raise NotImplementedError
+
+    def _lr(self, lr_scale):
+        return self.learning_rate * lr_scale
+
+
+@register_updater
+@dataclass
+class Sgd(Updater):
+    learning_rate: float = 0.1
+
+    def update(self, grads, state, params, lr_scale=1.0):
+        lr = self._lr(lr_scale)
+        return jax.tree_util.tree_map(lambda g: lr * g, grads), state
+
+
+@register_updater
+@dataclass
+class Nesterovs(Updater):
+    """Nesterov momentum (ref semantics: ND4J NesterovsUpdater —
+    v = mu*v - lr*g; update = -(mu*v_prev - (1+mu)*v_new) equivalent form)."""
+
+    learning_rate: float = 0.1
+    momentum: float = 0.9
+
+    def init_state(self, params):
+        return {"v": jax.tree_util.tree_map(jnp.zeros_like, params)}
+
+    def update(self, grads, state, params, lr_scale=1.0):
+        lr = self._lr(lr_scale)
+        mu = self.momentum
+
+        def upd(g, v):
+            v_new = mu * v - lr * g
+            step = -(mu * v_new - lr * g)  # lookahead step
+            return step, v_new
+
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat_v = treedef.flatten_up_to(state["v"])
+        pairs = [upd(g, v) for g, v in zip(flat_g, flat_v)]
+        steps = treedef.unflatten([p[0] for p in pairs])
+        vs = treedef.unflatten([p[1] for p in pairs])
+        return steps, {"v": vs}
+
+
+@register_updater
+@dataclass
+class Adam(Updater):
+    learning_rate: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init_state(self, params):
+        z = jax.tree_util.tree_map(jnp.zeros_like, params)
+        return {"m": z, "v": jax.tree_util.tree_map(jnp.zeros_like, params),
+                "t": jnp.zeros((), jnp.int32)}
+
+    def update(self, grads, state, params, lr_scale=1.0):
+        lr = self._lr(lr_scale)
+        t = state["t"] + 1
+        b1, b2 = self.beta1, self.beta2
+        m = jax.tree_util.tree_map(lambda m_, g: b1 * m_ + (1 - b1) * g, state["m"], grads)
+        v = jax.tree_util.tree_map(lambda v_, g: b2 * v_ + (1 - b2) * g * g, state["v"], grads)
+        tf = t.astype(jnp.float32)
+        corr = jnp.sqrt(1.0 - b2 ** tf) / (1.0 - b1 ** tf)
+        steps = jax.tree_util.tree_map(
+            lambda m_, v_: lr * corr * m_ / (jnp.sqrt(v_) + self.epsilon), m, v)
+        return steps, {"m": m, "v": v, "t": t}
+
+
+@register_updater
+@dataclass
+class AdaMax(Updater):
+    learning_rate: float = 2e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init_state(self, params):
+        return {"m": jax.tree_util.tree_map(jnp.zeros_like, params),
+                "u": jax.tree_util.tree_map(jnp.zeros_like, params),
+                "t": jnp.zeros((), jnp.int32)}
+
+    def update(self, grads, state, params, lr_scale=1.0):
+        lr = self._lr(lr_scale)
+        t = state["t"] + 1
+        b1, b2 = self.beta1, self.beta2
+        m = jax.tree_util.tree_map(lambda m_, g: b1 * m_ + (1 - b1) * g, state["m"], grads)
+        u = jax.tree_util.tree_map(lambda u_, g: jnp.maximum(b2 * u_, jnp.abs(g)),
+                                   state["u"], grads)
+        tf = t.astype(jnp.float32)
+        steps = jax.tree_util.tree_map(
+            lambda m_, u_: lr / (1 - b1 ** tf) * m_ / (u_ + self.epsilon), m, u)
+        return steps, {"m": m, "u": u, "t": t}
+
+
+@register_updater
+@dataclass
+class Nadam(Updater):
+    learning_rate: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init_state(self, params):
+        return {"m": jax.tree_util.tree_map(jnp.zeros_like, params),
+                "v": jax.tree_util.tree_map(jnp.zeros_like, params),
+                "t": jnp.zeros((), jnp.int32)}
+
+    def update(self, grads, state, params, lr_scale=1.0):
+        lr = self._lr(lr_scale)
+        t = state["t"] + 1
+        b1, b2 = self.beta1, self.beta2
+        tf = t.astype(jnp.float32)
+        m = jax.tree_util.tree_map(lambda m_, g: b1 * m_ + (1 - b1) * g, state["m"], grads)
+        v = jax.tree_util.tree_map(lambda v_, g: b2 * v_ + (1 - b2) * g * g, state["v"], grads)
+
+        def step(m_, v_, g):
+            mhat = b1 * m_ / (1 - b1 ** (tf + 1)) + (1 - b1) * g / (1 - b1 ** tf)
+            vhat = v_ / (1 - b2 ** tf)
+            return lr * mhat / (jnp.sqrt(vhat) + self.epsilon)
+
+        steps = jax.tree_util.tree_map(step, m, v, grads)
+        return steps, {"m": m, "v": v, "t": t}
+
+
+@register_updater
+@dataclass
+class RmsProp(Updater):
+    learning_rate: float = 1e-1
+    rms_decay: float = 0.95
+    epsilon: float = 1e-8
+
+    def init_state(self, params):
+        return {"g2": jax.tree_util.tree_map(jnp.zeros_like, params)}
+
+    def update(self, grads, state, params, lr_scale=1.0):
+        lr = self._lr(lr_scale)
+        d = self.rms_decay
+        g2 = jax.tree_util.tree_map(lambda a, g: d * a + (1 - d) * g * g,
+                                    state["g2"], grads)
+        steps = jax.tree_util.tree_map(
+            lambda g, a: lr * g / jnp.sqrt(a + self.epsilon), grads, g2)
+        return steps, {"g2": g2}
+
+
+@register_updater
+@dataclass
+class AdaGrad(Updater):
+    learning_rate: float = 1e-1
+    epsilon: float = 1e-6
+
+    def init_state(self, params):
+        return {"h": jax.tree_util.tree_map(jnp.zeros_like, params)}
+
+    def update(self, grads, state, params, lr_scale=1.0):
+        lr = self._lr(lr_scale)
+        h = jax.tree_util.tree_map(lambda a, g: a + g * g, state["h"], grads)
+        steps = jax.tree_util.tree_map(
+            lambda g, a: lr * g / (jnp.sqrt(a) + self.epsilon), grads, h)
+        return steps, {"h": h}
+
+
+@register_updater
+@dataclass
+class AdaDelta(Updater):
+    learning_rate: float = 1.0  # unused by the rule itself (kept for API parity)
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def init_state(self, params):
+        return {"g2": jax.tree_util.tree_map(jnp.zeros_like, params),
+                "dx2": jax.tree_util.tree_map(jnp.zeros_like, params)}
+
+    def update(self, grads, state, params, lr_scale=1.0):
+        rho, eps = self.rho, self.epsilon
+        g2 = jax.tree_util.tree_map(lambda a, g: rho * a + (1 - rho) * g * g,
+                                    state["g2"], grads)
+
+        def step(g, a, d):
+            s = jnp.sqrt(d + eps) / jnp.sqrt(a + eps) * g
+            return s
+
+        steps = jax.tree_util.tree_map(step, grads, g2, state["dx2"])
+        dx2 = jax.tree_util.tree_map(lambda d, s: rho * d + (1 - rho) * s * s,
+                                     state["dx2"], steps)
+        return steps, {"g2": g2, "dx2": dx2}
+
+
+@register_updater
+@dataclass
+class NoOp(Updater):
+    def update(self, grads, state, params, lr_scale=1.0):
+        return jax.tree_util.tree_map(jnp.zeros_like, grads), state
+
+
+# ---------------------------------------------------------------------------
+# gradient normalization (ref: GradientNormalization enum)
+# ---------------------------------------------------------------------------
+
+
+def normalize_gradients(grads, method: Optional[str], threshold: float = 1.0):
+    """Apply the reference's GradientNormalization semantics to a grad pytree."""
+    if not method or method == "none":
+        return grads
+    m = method.lower()
+    leaves = jax.tree_util.tree_leaves(grads)
+    if m == "renormalizel2pergradient" or m == "renormalize_l2_per_gradient":
+        gnorm = jnp.sqrt(sum(jnp.sum(g * g) for g in leaves) + 1e-12)
+        return jax.tree_util.tree_map(lambda g: g / gnorm, grads)
+    if m in ("renormalizel2perparamtype", "renormalize_l2_per_param_type"):
+        return jax.tree_util.tree_map(
+            lambda g: g / jnp.sqrt(jnp.sum(g * g) + 1e-12), grads)
+    if m in ("clipelementwiseabsolutevalue", "clip_element_wise_absolute_value"):
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, -threshold, threshold), grads)
+    if m in ("clipl2pergradient", "clip_l2_per_gradient"):
+        gnorm = jnp.sqrt(sum(jnp.sum(g * g) for g in leaves) + 1e-12)
+        scale = jnp.minimum(1.0, threshold / gnorm)
+        return jax.tree_util.tree_map(lambda g: g * scale, grads)
+    if m in ("clipl2perparamtype", "clip_l2_per_param_type"):
+        def clip(g):
+            n = jnp.sqrt(jnp.sum(g * g) + 1e-12)
+            return g * jnp.minimum(1.0, threshold / n)
+        return jax.tree_util.tree_map(clip, grads)
+    raise ValueError(f"unknown gradient normalization {method}")

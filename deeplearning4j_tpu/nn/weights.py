@@ -1,0 +1,146 @@
+"""Weight initialization.
+
+TPU-native equivalent of the reference's WeightInit enum + WeightInitUtil
+(deeplearning4j-nn/src/main/java/org/deeplearning4j/nn/weights/WeightInit.java,
+WeightInitUtil.java). Semantics follow the reference's fan-in/fan-out formulas;
+the implementation is pure `jax.random` so initialization itself runs on device
+and is reproducible from a single PRNG key (replacing the ref's global
+Nd4j RNG seed, NeuralNetConfiguration.Builder#seed).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["init_weights", "WEIGHT_INITS"]
+
+WEIGHT_INITS = (
+    "zero",
+    "ones",
+    "uniform",
+    "sigmoid_uniform",
+    "xavier",
+    "xavier_uniform",
+    "xavier_fan_in",
+    "xavier_legacy",
+    "relu",
+    "relu_uniform",
+    "lecun_normal",
+    "lecun_uniform",
+    "normal",
+    "truncated_normal",
+    "var_scaling_normal_fan_in",
+    "var_scaling_normal_fan_out",
+    "var_scaling_normal_fan_avg",
+    "var_scaling_uniform_fan_in",
+    "var_scaling_uniform_fan_out",
+    "var_scaling_uniform_fan_avg",
+    "distribution",
+    "identity",
+)
+
+
+def init_weights(
+    key: jax.Array,
+    shape: Sequence[int],
+    fan_in: float,
+    fan_out: float,
+    scheme: str = "xavier",
+    distribution: Optional[dict] = None,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Initialize a weight array with the named scheme.
+
+    fan_in/fan_out follow WeightInitUtil semantics: for dense [nIn, nOut]
+    fan_in=nIn fan_out=nOut; for conv kernels fan_in = inChannels*kH*kW,
+    fan_out = outChannels*kH*kW.
+    """
+    scheme = str(scheme).lower()
+    shape = tuple(int(s) for s in shape)
+
+    if scheme == "zero":
+        return jnp.zeros(shape, dtype)
+    if scheme == "ones":
+        return jnp.ones(shape, dtype)
+    if scheme == "identity":
+        if len(shape) != 2 or shape[0] != shape[1]:
+            raise ValueError("identity init requires a square 2-D shape")
+        return jnp.eye(shape[0], dtype=dtype)
+    if scheme == "uniform":
+        a = 1.0 / math.sqrt(fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == "sigmoid_uniform":
+        r = 4.0 * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -r, r)
+    if scheme == "xavier":
+        std = math.sqrt(2.0 / (fan_in + fan_out))
+        return std * jax.random.normal(key, shape, dtype)
+    if scheme == "xavier_uniform":
+        s = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -s, s)
+    if scheme == "xavier_fan_in":
+        return jax.random.normal(key, shape, dtype) / math.sqrt(fan_in)
+    if scheme == "xavier_legacy":
+        std = math.sqrt(1.0 / (fan_in + fan_out))
+        return std * jax.random.normal(key, shape, dtype)
+    if scheme == "relu":
+        return math.sqrt(2.0 / fan_in) * jax.random.normal(key, shape, dtype)
+    if scheme == "relu_uniform":
+        u = math.sqrt(6.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -u, u)
+    if scheme == "lecun_normal":
+        return jax.random.normal(key, shape, dtype) / math.sqrt(fan_in)
+    if scheme == "lecun_uniform":
+        b = 3.0 / math.sqrt(fan_in)
+        return jax.random.uniform(key, shape, dtype, -b, b)
+    if scheme == "normal":
+        return jax.random.normal(key, shape, dtype) / math.sqrt(fan_in)
+    if scheme == "truncated_normal":
+        return jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype) / math.sqrt(fan_in)
+    if scheme.startswith("var_scaling"):
+        if scheme.endswith("fan_in"):
+            denom = fan_in
+        elif scheme.endswith("fan_out"):
+            denom = fan_out
+        else:
+            denom = 0.5 * (fan_in + fan_out)
+        if "normal" in scheme:
+            return jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype) * math.sqrt(
+                1.0 / denom
+            )
+        lim = math.sqrt(3.0 / denom)
+        return jax.random.uniform(key, shape, dtype, -lim, lim)
+    if scheme == "distribution":
+        return _sample_distribution(key, shape, distribution or {}, dtype)
+    raise ValueError(f"Unknown weight init scheme '{scheme}'")
+
+
+def _sample_distribution(key, shape, dist: dict, dtype):
+    """Sample from a configured distribution (ref: nn/conf/distribution/*)."""
+    kind = str(dist.get("type", "normal")).lower()
+    if kind in ("normal", "gaussian"):
+        mean = float(dist.get("mean", 0.0))
+        std = float(dist.get("std", 1.0))
+        return mean + std * jax.random.normal(key, shape, dtype)
+    if kind == "uniform":
+        lower = float(dist.get("lower", -1.0))
+        upper = float(dist.get("upper", 1.0))
+        return jax.random.uniform(key, shape, dtype, lower, upper)
+    if kind == "binomial":
+        n = int(dist.get("trials", 1))
+        p = float(dist.get("probability", 0.5))
+        out = jnp.zeros(shape, dtype)
+        for sub in jax.random.split(key, n):
+            out = out + jax.random.bernoulli(sub, p, shape).astype(dtype)
+        return out
+    if kind == "constant":
+        return jnp.full(shape, float(dist.get("value", 0.0)), dtype)
+    if kind in ("truncated_normal", "truncatednormal"):
+        mean = float(dist.get("mean", 0.0))
+        std = float(dist.get("std", 1.0))
+        return mean + std * jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype)
+    raise ValueError(f"Unknown distribution type '{kind}'")

@@ -1,0 +1,142 @@
+"""Training listeners.
+
+TPU-native equivalent of optimize/api/IterationListener + TrainingListener and
+the listener zoo in optimize/listeners/* (ScoreIterationListener,
+PerformanceListener, EvaluativeListener, CollectScoresIterationListener,
+TimeIterationListener, ComposableIterationListener).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, List, Optional
+
+log = logging.getLogger(__name__)
+
+
+class TrainingListener:
+    """Observer of the training loop (ref: optimize/api/TrainingListener.java)."""
+
+    def iteration_done(self, model, iteration: int, score: float):
+        pass
+
+    def on_epoch_start(self, model, epoch: int):
+        pass
+
+    def on_epoch_end(self, model, epoch: int):
+        pass
+
+    def on_forward_pass(self, model, activations):
+        pass
+
+    def on_backward_pass(self, model):
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    """Log score every N iterations (ref: ScoreIterationListener.java)."""
+
+    def __init__(self, print_iterations: int = 10, printer: Callable = None):
+        self.print_iterations = max(1, print_iterations)
+        self.printer = printer or (lambda s: log.info(s))
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.print_iterations == 0:
+            self.printer(f"Score at iteration {iteration} is {score}")
+
+
+class PerformanceListener(TrainingListener):
+    """Throughput tracking: samples/sec, batches/sec
+    (ref: PerformanceListener.java)."""
+
+    def __init__(self, frequency: int = 1, report: Callable = None):
+        self.frequency = max(1, frequency)
+        self.report = report or (lambda s: log.info(s))
+        self._last_time = None
+        self._last_iter = None
+        self._samples = 0
+        self.samples_per_sec = 0.0
+        self.batches_per_sec = 0.0
+
+    def record_batch(self, num_examples: int):
+        self._samples += num_examples
+
+    def iteration_done(self, model, iteration, score):
+        now = time.perf_counter()
+        if self._last_time is not None and iteration % self.frequency == 0:
+            dt = now - self._last_time
+            iters = iteration - (self._last_iter or 0)
+            if dt > 0 and iters > 0:
+                self.batches_per_sec = iters / dt
+                self.samples_per_sec = self._samples / dt
+                self.report(
+                    f"iteration {iteration}: {self.samples_per_sec:.1f} samples/sec, "
+                    f"{self.batches_per_sec:.2f} batches/sec, score={score:.5f}")
+            self._last_time = now
+            self._last_iter = iteration
+            self._samples = 0
+        elif self._last_time is None:
+            self._last_time = now
+            self._last_iter = iteration
+            self._samples = 0
+
+
+class CollectScoresIterationListener(TrainingListener):
+    """Collect (iteration, score) pairs (ref: CollectScoresIterationListener.java)."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.scores: List[tuple] = []
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, float(score)))
+
+
+class TimeIterationListener(TrainingListener):
+    """Estimate remaining time (ref: TimeIterationListener.java)."""
+
+    def __init__(self, total_iterations: int):
+        self.total = total_iterations
+        self.start = time.perf_counter()
+
+    def iteration_done(self, model, iteration, score):
+        elapsed = time.perf_counter() - self.start
+        if iteration > 0:
+            remaining = elapsed / iteration * (self.total - iteration)
+            log.info("Remaining time estimate: %.1fs", remaining)
+
+
+class EvaluativeListener(TrainingListener):
+    """Periodically evaluate on a held-out iterator (ref: EvaluativeListener.java)."""
+
+    def __init__(self, iterator, frequency: int = 1, on_epoch: bool = False):
+        self.iterator = iterator
+        self.frequency = max(1, frequency)
+        self.on_epoch = on_epoch
+        self.evaluations: List = []
+
+    def _eval(self, model):
+        e = model.evaluate(self.iterator)
+        self.evaluations.append(e)
+        log.info("\n%s", e.stats())
+
+    def iteration_done(self, model, iteration, score):
+        if not self.on_epoch and iteration > 0 and iteration % self.frequency == 0:
+            self._eval(model)
+
+    def on_epoch_end(self, model, epoch):
+        if self.on_epoch and (epoch + 1) % self.frequency == 0:
+            self._eval(model)
+
+
+class ComposableIterationListener(TrainingListener):
+    """Fan-out to child listeners (ref: ComposableIterationListener.java)."""
+
+    def __init__(self, *listeners: TrainingListener):
+        self.listeners = list(listeners)
+
+    def iteration_done(self, model, iteration, score):
+        for l in self.listeners:
+            l.iteration_done(model, iteration, score)

@@ -1,0 +1,17 @@
+"""Test configuration: force CPU with 8 virtual devices so multi-chip sharding
+logic is testable without TPU hardware (SURVEY §4: the reference tests
+distributed semantics in-process with local[N]; the JAX equivalent is
+xla_force_host_platform_device_count)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+# float64 needed for finite-difference gradient checks
+jax.config.update("jax_enable_x64", True)

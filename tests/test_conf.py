@@ -1,0 +1,152 @@
+"""Config DSL + JSON round-trip tests (ref test model: nn/conf tests in
+deeplearning4j-core, e.g. MultiLayerNeuralNetConfigurationTest)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf import (
+    InputType,
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+    ComputationGraphConfiguration,
+)
+from deeplearning4j_tpu.nn.conf.layers import (
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    LSTM,
+    OutputLayer,
+    RnnOutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.conf.preprocessors import CnnToFeedForwardPreProcessor
+from deeplearning4j_tpu.nn.updater import Adam, Nesterovs, Sgd, updater_from_dict
+
+
+def lenet_conf():
+    return (NeuralNetConfiguration.Builder()
+            .seed(42)
+            .updater(Nesterovs(learning_rate=0.01, momentum=0.9))
+            .weight_init("xavier")
+            .list()
+            .layer(ConvolutionLayer(n_out=20, kernel=(5, 5), activation="identity"))
+            .layer(SubsamplingLayer(pooling_type="max", kernel=(2, 2), stride=(2, 2)))
+            .layer(ConvolutionLayer(n_out=50, kernel=(5, 5), activation="identity"))
+            .layer(SubsamplingLayer(pooling_type="max", kernel=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=500, activation="relu"))
+            .layer(OutputLayer(n_out=10, loss="mcxent", activation="softmax"))
+            .set_input_type(InputType.convolutional(28, 28, 1))
+            .build())
+
+
+class TestBuilder:
+    def test_lenet_builds(self):
+        conf = lenet_conf()
+        assert len(conf.layers) == 6
+        # conv shapes inferred: 28 -> 24 -> 12 -> 8 -> 4
+        its = conf.layer_input_types()
+        assert its[0].kind == "cnn"
+        out = conf.layers[3].output_type(its[3])
+        assert (out.height, out.width, out.channels) == (4, 4, 50)
+        # preprocessor auto-inserted before dense layer
+        assert 4 in conf.preprocessors
+        assert isinstance(conf.preprocessors[4], CnnToFeedForwardPreProcessor)
+        assert conf.layers[4].n_in == 4 * 4 * 50
+
+    def test_global_defaults_cascade(self):
+        conf = (NeuralNetConfiguration.Builder()
+                .weight_init("relu")
+                .activation("tanh")
+                .l2(1e-4)
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=3))
+                .layer(OutputLayer(n_out=2, loss="mse", activation="identity"))
+                .build())
+        assert conf.layers[0].weight_init == "relu"
+        assert conf.layers[0].activation == "tanh"
+        assert conf.layers[0].l2 == 1e-4
+        # explicit per-layer value wins
+        assert conf.layers[1].activation == "identity"
+
+    def test_output_type_chain(self):
+        conf = lenet_conf()
+        assert conf.output_type().kind == "ff"
+        assert conf.output_type().size == 10
+
+
+class TestJsonRoundTrip:
+    def test_mln_json_roundtrip(self):
+        conf = lenet_conf()
+        s = conf.to_json()
+        conf2 = MultiLayerConfiguration.from_json(s)
+        assert conf2.to_json() == s
+        assert len(conf2.layers) == 6
+        assert isinstance(conf2.updater, Nesterovs)
+        assert conf2.updater.momentum == 0.9
+        assert conf2.layers[0].kernel == [5, 5]
+
+    def test_rnn_conf_roundtrip(self):
+        conf = (NeuralNetConfiguration.Builder()
+                .updater(Adam(learning_rate=1e-3))
+                .list()
+                .layer(LSTM(n_out=8))
+                .layer(RnnOutputLayer(n_out=3, loss="mcxent", activation="softmax"))
+                .set_input_type(InputType.recurrent(5, 7))
+                .tbptt(10)
+                .build())
+        conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+        assert conf2.tbptt and conf2.tbptt_fwd_length == 10
+        assert conf2.layers[0].n_in == 5
+
+    def test_updater_serde(self):
+        for u in (Sgd(0.1), Adam(1e-3), Nesterovs(0.01, momentum=0.85)):
+            from deeplearning4j_tpu.nn.updater import updater_to_dict
+            u2 = updater_from_dict(updater_to_dict(u))
+            assert type(u2) is type(u)
+            assert u2.learning_rate == u.learning_rate
+
+
+class TestGraphConf:
+    def test_graph_builder_and_topo(self):
+        from deeplearning4j_tpu.nn.conf.graph_conf import MergeVertex
+        conf = (NeuralNetConfiguration.Builder()
+                .graph_builder()
+                .add_inputs("in")
+                .set_input_types(InputType.feed_forward(4))
+                .add_layer("a", DenseLayer(n_out=3, activation="relu"), "in")
+                .add_layer("b", DenseLayer(n_out=3, activation="tanh"), "in")
+                .add_vertex("merge", MergeVertex(), "a", "b")
+                .add_layer("out", OutputLayer(n_out=2, loss="mse",
+                                              activation="identity"), "merge")
+                .set_outputs("out")
+                .build())
+        order = conf.topological_order()
+        assert order.index("merge") > order.index("a")
+        assert order.index("merge") > order.index("b")
+        assert order.index("out") > order.index("merge")
+
+    def test_graph_json_roundtrip(self):
+        from deeplearning4j_tpu.nn.conf.graph_conf import ElementWiseVertex
+        conf = (NeuralNetConfiguration.Builder()
+                .graph_builder()
+                .add_inputs("in")
+                .set_input_types(InputType.feed_forward(4))
+                .add_layer("d1", DenseLayer(n_out=4, activation="relu"), "in")
+                .add_vertex("add", ElementWiseVertex(op="add"), "d1", "in")
+                .add_layer("out", OutputLayer(n_out=2, loss="mse",
+                                              activation="identity"), "add")
+                .set_outputs("out")
+                .build())
+        s = conf.to_json()
+        conf2 = ComputationGraphConfiguration.from_json(s)
+        assert conf2.to_json() == s
+        assert conf2.network_outputs == ["out"]
+
+    def test_cycle_detection(self):
+        conf = ComputationGraphConfiguration()
+        from deeplearning4j_tpu.nn.conf.graph_conf import ElementWiseVertex
+        conf.network_inputs = ["in"]
+        conf.vertices = {"a": ElementWiseVertex(), "b": ElementWiseVertex()}
+        conf.vertex_inputs = {"a": ["b"], "b": ["a"]}
+        with pytest.raises(ValueError):
+            conf.topological_order()

@@ -1,0 +1,229 @@
+"""Layer forward-shape + semantics tests (ref test model:
+deeplearning4j-core nn/layers tests: ConvolutionLayerTest, SubsamplingLayerTest,
+BatchNormalizationTest, LSTMTest...)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ActivationLayer,
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    DropoutLayer,
+    EmbeddingLayer,
+    GlobalPoolingLayer,
+    GravesBidirectionalLSTM,
+    GravesLSTM,
+    LocalResponseNormalization,
+    LSTM,
+    SubsamplingLayer,
+    Upsampling2DLayer,
+    ZeroPaddingLayer,
+)
+
+KEY = jax.random.PRNGKey(0)
+
+
+def apply_layer(layer, it, x, train=False, rng=None, mask=None, state=None):
+    p, s = layer.init(KEY, it)
+    if state is not None:
+        s = state
+    y, s2 = layer.apply(p, jnp.asarray(x), s, train=train, rng=rng, mask=mask)
+    return np.asarray(y), s2
+
+
+class TestDense:
+    def test_shapes_and_math(self):
+        it = InputType.feed_forward(4)
+        layer = DenseLayer(n_out=3, activation="identity", weight_init="ones",
+                           bias_init=1.0)
+        x = np.ones((2, 4), np.float32)
+        y, _ = apply_layer(layer, it, x)
+        assert y.shape == (2, 3)
+        np.testing.assert_allclose(y, 5.0)  # 4*1 + 1
+
+    def test_activation(self):
+        it = InputType.feed_forward(2)
+        layer = DenseLayer(n_out=2, activation="relu", weight_init="xavier")
+        x = np.random.randn(3, 2).astype(np.float32)
+        y, _ = apply_layer(layer, it, x)
+        assert (y >= 0).all()
+
+
+class TestConvolution:
+    def test_lenet_conv_shape(self):
+        it = InputType.convolutional(28, 28, 1)
+        layer = ConvolutionLayer(n_out=20, kernel=(5, 5))
+        x = np.random.randn(2, 1, 28, 28).astype(np.float32)
+        y, _ = apply_layer(layer, it, x)
+        assert y.shape == (2, 20, 24, 24)
+        assert layer.output_type(it).height == 24
+
+    def test_same_mode(self):
+        it = InputType.convolutional(7, 7, 3)
+        layer = ConvolutionLayer(n_out=4, kernel=(3, 3), stride=(2, 2),
+                                 convolution_mode="same")
+        x = np.random.randn(1, 3, 7, 7).astype(np.float32)
+        y, _ = apply_layer(layer, it, x)
+        assert y.shape == (1, 4, 4, 4)
+
+    def test_known_values(self):
+        # 1x1 input channel, identity-ish kernel
+        it = InputType.convolutional(3, 3, 1)
+        layer = ConvolutionLayer(n_out=1, kernel=(3, 3), weight_init="ones",
+                                 has_bias=False, activation="identity")
+        x = np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3)
+        y, _ = apply_layer(layer, it, x)
+        np.testing.assert_allclose(y.reshape(()), x.sum())
+
+
+class TestPooling:
+    def test_max_pool(self):
+        it = InputType.convolutional(4, 4, 1)
+        layer = SubsamplingLayer(pooling_type="max", kernel=(2, 2), stride=(2, 2))
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        y, _ = apply_layer(layer, it, x)
+        np.testing.assert_allclose(y.reshape(2, 2), [[5, 7], [13, 15]])
+
+    def test_avg_pool(self):
+        it = InputType.convolutional(2, 2, 1)
+        layer = SubsamplingLayer(pooling_type="avg", kernel=(2, 2), stride=(2, 2))
+        x = np.array([[1, 2], [3, 4]], np.float32).reshape(1, 1, 2, 2)
+        y, _ = apply_layer(layer, it, x)
+        np.testing.assert_allclose(y.reshape(()), 2.5)
+
+    def test_global_pooling_cnn(self):
+        it = InputType.convolutional(4, 4, 3)
+        layer = GlobalPoolingLayer(pooling_type="avg")
+        x = np.random.randn(2, 3, 4, 4).astype(np.float32)
+        y, _ = apply_layer(layer, it, x)
+        assert y.shape == (2, 3)
+        np.testing.assert_allclose(y, x.mean(axis=(2, 3)), rtol=1e-5)
+
+    def test_global_pooling_rnn_masked(self):
+        it = InputType.recurrent(3, 5)
+        layer = GlobalPoolingLayer(pooling_type="avg")
+        x = np.ones((2, 3, 5), np.float32)
+        x[:, :, 3:] = 100.0  # masked region
+        mask = np.zeros((2, 5), np.float32)
+        mask[:, :3] = 1.0
+        y, _ = apply_layer(layer, it, x, mask=jnp.asarray(mask))
+        np.testing.assert_allclose(y, 1.0)
+
+
+class TestNorm:
+    def test_batchnorm_train_normalizes(self):
+        it = InputType.feed_forward(4)
+        layer = BatchNormalization()
+        x = np.random.randn(64, 4).astype(np.float32) * 3 + 7
+        p, s = layer.init(KEY, it)
+        y, s2 = layer.apply(p, jnp.asarray(x), s, train=True)
+        y = np.asarray(y)
+        np.testing.assert_allclose(y.mean(axis=0), 0.0, atol=1e-4)
+        np.testing.assert_allclose(y.std(axis=0), 1.0, atol=1e-2)
+        # running stats moved toward batch stats
+        assert not np.allclose(np.asarray(s2["mean"]), 0.0)
+
+    def test_batchnorm_inference_uses_running(self):
+        it = InputType.feed_forward(2)
+        layer = BatchNormalization()
+        p, s = layer.init(KEY, it)
+        s = {"mean": jnp.array([1.0, 2.0]), "var": jnp.array([4.0, 9.0])}
+        x = jnp.array([[1.0, 2.0]])
+        y, _ = layer.apply(p, x, s, train=False)
+        np.testing.assert_allclose(np.asarray(y), 0.0, atol=1e-3)
+
+    def test_batchnorm_cnn_per_channel(self):
+        it = InputType.convolutional(4, 4, 3)
+        layer = BatchNormalization()
+        p, s = layer.init(KEY, it)
+        assert p["gamma"].shape == (3,)
+        x = np.random.randn(8, 3, 4, 4).astype(np.float32)
+        y, _ = layer.apply(p, jnp.asarray(x), s, train=True)
+        assert y.shape == x.shape
+
+    def test_lrn_shape(self):
+        it = InputType.convolutional(4, 4, 8)
+        layer = LocalResponseNormalization()
+        x = np.random.randn(2, 8, 4, 4).astype(np.float32)
+        y, _ = apply_layer(layer, it, x)
+        assert y.shape == x.shape
+        # LRN shrinks magnitude
+        assert np.abs(y).sum() <= np.abs(x).sum()
+
+
+class TestRecurrent:
+    def test_lstm_shapes(self):
+        it = InputType.recurrent(4, 6)
+        layer = LSTM(n_out=5)
+        x = np.random.randn(3, 4, 6).astype(np.float32)
+        y, _ = apply_layer(layer, it, x)
+        assert y.shape == (3, 5, 6)
+
+    def test_graves_lstm_has_peepholes(self):
+        it = InputType.recurrent(4, 6)
+        layer = GravesLSTM(n_out=5)
+        p, _ = layer.init(KEY, it)
+        assert "P" in p and p["P"].shape == (3, 5)
+
+    def test_bidirectional_shapes(self):
+        it = InputType.recurrent(4, 6)
+        layer = GravesBidirectionalLSTM(n_out=5)
+        x = np.random.randn(2, 4, 6).astype(np.float32)
+        y, _ = apply_layer(layer, it, x)
+        assert y.shape == (2, 5, 6)
+
+    def test_lstm_masking_freezes_state(self):
+        it = InputType.recurrent(3, 5)
+        layer = LSTM(n_out=4)
+        x = np.random.randn(2, 3, 5).astype(np.float32)
+        mask_full = np.ones((2, 5), np.float32)
+        mask_part = mask_full.copy()
+        mask_part[:, 3:] = 0.0
+        p, s = layer.init(KEY, it)
+        y_part, _ = layer.apply(p, jnp.asarray(x), s, mask=jnp.asarray(mask_part))
+        # masked outputs are zero
+        np.testing.assert_allclose(np.asarray(y_part)[:, :, 3:], 0.0)
+        # unmasked prefix equals the prefix of a full pass
+        y_full, _ = layer.apply(p, jnp.asarray(x), s, mask=jnp.asarray(mask_full))
+        np.testing.assert_allclose(np.asarray(y_part)[:, :, :3],
+                                   np.asarray(y_full)[:, :, :3], rtol=1e-5)
+
+
+class TestMisc:
+    def test_embedding(self):
+        it = InputType.feed_forward(10)
+        layer = EmbeddingLayer(n_in=10, n_out=4, has_bias=False)
+        p, s = layer.init(KEY, it)
+        idx = np.array([[0], [3], [9]], np.int32)
+        y, _ = layer.apply(p, jnp.asarray(idx), s)
+        assert y.shape == (3, 4)
+        np.testing.assert_allclose(np.asarray(y)[1], np.asarray(p["W"])[3])
+
+    def test_dropout_train_vs_test(self):
+        it = InputType.feed_forward(100)
+        layer = DropoutLayer(dropout=0.5)
+        x = np.ones((4, 100), np.float32)
+        y_test, _ = apply_layer(layer, it, x, train=False)
+        np.testing.assert_allclose(y_test, 1.0)
+        y_train, _ = apply_layer(layer, it, x, train=True,
+                                 rng=jax.random.PRNGKey(7))
+        assert (np.asarray(y_train) == 0).any()
+        # inverted dropout preserves expectation approximately
+        assert abs(np.asarray(y_train).mean() - 1.0) < 0.15
+
+    def test_zero_padding_and_upsampling(self):
+        it = InputType.convolutional(2, 2, 1)
+        pad = ZeroPaddingLayer(padding=(1, 1, 1, 1))
+        x = np.ones((1, 1, 2, 2), np.float32)
+        y, _ = apply_layer(pad, it, x)
+        assert y.shape == (1, 1, 4, 4)
+        assert y[0, 0, 0, 0] == 0.0
+        up = Upsampling2DLayer(size=(2, 2))
+        y2, _ = apply_layer(up, it, x)
+        assert y2.shape == (1, 1, 4, 4)
+        np.testing.assert_allclose(y2, 1.0)
