@@ -639,7 +639,7 @@ class LSTM(FeedForwardLayerConf):
             peephole=params.get("P"), mask=mask,
             gate_act=self.gate_activation, cell_act=self.activation,
         )
-        return out, state
+        return out, {**state, "h": h_fin, "c": c_fin}
 
 
 @register_layer

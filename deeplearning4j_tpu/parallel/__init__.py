@@ -1,0 +1,15 @@
+"""Parallelism: device meshes, sharded training, parallel inference.
+
+TPU-native replacement for deeplearning4j-scaleout (SURVEY §2.5): the four
+reference strategies (ParallelWrapper averaging / encoded gradient sharing,
+Spark parameter averaging, Aeron async parameter server) collapse into
+sharded jit over a `jax.sharding.Mesh` — gradients are allreduced densely
+over ICI by XLA-inserted collectives, which is the BASELINE.json north star.
+"""
+
+from deeplearning4j_tpu.parallel.mesh import (  # noqa: F401
+    default_mesh,
+    make_mesh,
+)
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper  # noqa: F401
+from deeplearning4j_tpu.parallel.inference import ParallelInference  # noqa: F401

@@ -1,0 +1,55 @@
+"""Device mesh construction.
+
+TPU-native replacement for the reference's device discovery/affinity layer
+(ParallelWrapper.java:124-143 attachThreadToDevice; Nd4j AffinityManager):
+on TPU, devices form a logical mesh (`jax.sharding.Mesh`) with named axes and
+XLA handles placement — no thread pinning, no per-device model replicas.
+
+Axis convention (scaling-book style): "data" for batch/data parallelism,
+"model" for tensor-model parallelism. Collectives ride ICI within a slice;
+multi-host meshes extend over DCN via jax.distributed (see distributed.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(shape: Optional[Sequence[int]] = None,
+              axis_names: Sequence[str] = ("data", "model"),
+              devices=None) -> Mesh:
+    """Build a mesh over the given (or all) devices.
+
+    shape=None → all devices on the "data" axis (pure data parallelism,
+    the ParallelWrapper-equivalent default).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if shape is None:
+        shape = (n,)
+        axis_names = (axis_names[0],)
+    shape = tuple(int(s) for s in shape)
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh shape {shape} != device count {n}")
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, tuple(axis_names[:len(shape)]))
+
+
+def default_mesh(num_devices: Optional[int] = None) -> Mesh:
+    devices = jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    return make_mesh(devices=devices)
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch-dim sharding for inputs: [B, ...] split over the data axis."""
+    return NamedSharding(mesh, P("data"))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,151 @@
+"""Model checkpointing.
+
+TPU-native equivalent of deeplearning4j-nn/.../util/ModelSerializer.java:37-214:
+a zip containing `configuration.json` (full config JSON :90) plus parameter
+and updater-state arrays. The reference stores ONE flat float vector
+(`coefficients.bin` :95, `updaterState.bin` :107); here each pytree leaf is a
+named .npy entry (params/<layer>/<name>.npy) — same information, but
+shard-friendly and layout-independent (no flat-view ordering to get wrong).
+
+`restore_multi_layer_network` / `restore_computation_graph` mirror
+ModelSerializer.restoreMultiLayerNetwork :137. A separate DL4J-zip importer
+(modelimport/dl4j.py) reads the reference's own flat-vector format.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+CONFIG_JSON = "configuration.json"
+MODEL_TYPE_KEY = "model_type"
+
+
+def _write_tree(zf: zipfile.ZipFile, prefix: str, tree) -> None:
+    flat = _flatten_with_paths(tree)
+    for path, arr in flat.items():
+        buf = io.BytesIO()
+        np.save(buf, np.asarray(arr))
+        zf.writestr(f"{prefix}/{path}.npy", buf.getvalue())
+
+
+def _flatten_with_paths(tree, prefix="") -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten_with_paths(v, f"{prefix}{k}/"))
+    elif tree is None:
+        pass
+    elif hasattr(tree, "shape"):
+        out[prefix[:-1]] = tree
+    else:
+        out[prefix[:-1]] = np.asarray(tree)
+    return out
+
+
+def _read_tree(zf: zipfile.ZipFile, prefix: str) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    plen = len(prefix) + 1
+    for name in zf.namelist():
+        if not name.startswith(prefix + "/") or not name.endswith(".npy"):
+            continue
+        path = name[plen:-4].split("/")
+        arr = np.load(io.BytesIO(zf.read(name)))
+        d = out
+        for seg in path[:-1]:
+            d = d.setdefault(seg, {})
+        d[path[-1]] = jnp.asarray(arr)
+    return out
+
+
+def write_model(model, path: str, save_updater: bool = True) -> None:
+    """Save a MultiLayerNetwork or ComputationGraph
+    (ref: ModelSerializer.writeModel :79)."""
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    if isinstance(model, MultiLayerNetwork):
+        mtype = "MultiLayerNetwork"
+    elif isinstance(model, ComputationGraph):
+        mtype = "ComputationGraph"
+    else:
+        raise ValueError(f"cannot serialize {type(model)}")
+
+    meta = {
+        MODEL_TYPE_KEY: mtype,
+        "iteration_count": model.iteration_count,
+        "epoch_count": model.epoch_count,
+        "framework": "deeplearning4j_tpu",
+    }
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr(CONFIG_JSON, model.conf.to_json())
+        zf.writestr("meta.json", json.dumps(meta))
+        _write_tree(zf, "params", model.params)
+        _write_tree(zf, "state", model.state)
+        if save_updater:
+            _write_tree(zf, "updater", model.updater_state)
+
+
+def _merge_state(init_state, loaded):
+    """Use loaded state where present, else initialized values (handles
+    checkpoints written without updater state)."""
+    if not loaded:
+        return init_state
+    return loaded
+
+
+def restore_multi_layer_network(path: str, load_updater: bool = True):
+    """ref: ModelSerializer.restoreMultiLayerNetwork :137."""
+    from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    with zipfile.ZipFile(path) as zf:
+        conf = MultiLayerConfiguration.from_json(zf.read(CONFIG_JSON).decode())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        net.params = _read_tree(zf, "params")
+        net.state = _merge_state(net.state, _read_tree(zf, "state"))
+        meta = json.loads(zf.read("meta.json"))
+        net.iteration_count = meta.get("iteration_count", 0)
+        net.epoch_count = meta.get("epoch_count", 0)
+        if load_updater:
+            upd = _read_tree(zf, "updater")
+            if upd:
+                net.updater_state = upd
+    return net
+
+
+def restore_computation_graph(path: str, load_updater: bool = True):
+    """ref: ModelSerializer.restoreComputationGraph."""
+    from deeplearning4j_tpu.nn.conf.network import ComputationGraphConfiguration
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    with zipfile.ZipFile(path) as zf:
+        conf = ComputationGraphConfiguration.from_json(zf.read(CONFIG_JSON).decode())
+        net = ComputationGraph(conf)
+        net.init()
+        net.params = _read_tree(zf, "params")
+        net.state = _merge_state(net.state, _read_tree(zf, "state"))
+        meta = json.loads(zf.read("meta.json"))
+        net.iteration_count = meta.get("iteration_count", 0)
+        net.epoch_count = meta.get("epoch_count", 0)
+        if load_updater:
+            upd = _read_tree(zf, "updater")
+            if upd:
+                net.updater_state = upd
+    return net
+
+
+def restore_model(path: str, load_updater: bool = True):
+    """Sniff model type and restore (ref: core ModelGuesser)."""
+    with zipfile.ZipFile(path) as zf:
+        meta = json.loads(zf.read("meta.json"))
+    if meta[MODEL_TYPE_KEY] == "MultiLayerNetwork":
+        return restore_multi_layer_network(path, load_updater)
+    return restore_computation_graph(path, load_updater)

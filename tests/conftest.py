@@ -1,11 +1,18 @@
 """Test configuration: force CPU with 8 virtual devices so multi-chip sharding
 logic is testable without TPU hardware (SURVEY §4: the reference tests
 distributed semantics in-process with local[N]; the JAX equivalent is
-xla_force_host_platform_device_count)."""
+xla_force_host_platform_device_count).
+
+Note: this environment preloads jax with a TPU PJRT plugin via sitecustomize
+and sets JAX_PLATFORMS before Python starts, so plain env-var overrides are
+too late — the platform must be switched through jax.config (the backend
+itself initializes lazily, so this works as long as it runs before any
+device use). Unit tests (notably float64 finite-difference gradient checks)
+need the host backend; bench.py is what exercises the real chip.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -13,5 +20,6 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax
 
+jax.config.update("jax_platforms", "cpu")
 # float64 needed for finite-difference gradient checks
 jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,228 @@
+"""Gradient-check suites — the correctness backbone (ref: deeplearning4j-core
+gradientcheck/*: CNNGradientCheckTest, LSTMGradientCheckTests,
+BNGradientCheckTest, GradientCheckTests, LossFunctionGradientCheck...).
+
+Central finite differences vs jax.grad on small nets, float64.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (
+    ActivationLayer,
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    GlobalPoolingLayer,
+    GravesBidirectionalLSTM,
+    GravesLSTM,
+    LocalResponseNormalization,
+    LSTM,
+    OutputLayer,
+    RnnOutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Sgd
+from deeplearning4j_tpu.util.gradient_check import check_gradients
+
+RNG = np.random.default_rng(12345)
+
+
+def onehot(n, k):
+    y = np.zeros((n, k))
+    y[np.arange(n), RNG.integers(0, k, n)] = 1.0
+    return y
+
+
+def build_mln(layers, input_type):
+    b = NeuralNetConfiguration.Builder().seed(42).updater(Sgd(0.1)).list()
+    for l in layers:
+        b.layer(l)
+    conf = b.set_input_type(input_type).build()
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+class TestDenseGradients:
+    def test_mlp_mcxent(self):
+        net = build_mln(
+            [DenseLayer(n_out=6, activation="tanh"),
+             OutputLayer(n_out=3, loss="mcxent", activation="softmax")],
+            InputType.feed_forward(4))
+        ds = DataSet(RNG.standard_normal((5, 4)), onehot(5, 3))
+        assert check_gradients(net, ds)
+
+    def test_mlp_mse_identity(self):
+        net = build_mln(
+            [DenseLayer(n_out=5, activation="sigmoid"),
+             OutputLayer(n_out=2, loss="mse", activation="identity")],
+            InputType.feed_forward(3))
+        ds = DataSet(RNG.standard_normal((4, 3)), RNG.standard_normal((4, 2)))
+        assert check_gradients(net, ds)
+
+    def test_mlp_xent_sigmoid(self):
+        net = build_mln(
+            [DenseLayer(n_out=4, activation="elu"),
+             OutputLayer(n_out=2, loss="xent", activation="sigmoid")],
+            InputType.feed_forward(3))
+        labels = (RNG.random((4, 2)) > 0.5).astype(np.float64)
+        ds = DataSet(RNG.standard_normal((4, 3)), labels)
+        assert check_gradients(net, ds)
+
+    def test_l1_l2_regularization(self):
+        net = build_mln(
+            [DenseLayer(n_out=4, activation="tanh", l1=0.01, l2=0.02),
+             OutputLayer(n_out=2, loss="mse", activation="identity", l2=0.05)],
+            InputType.feed_forward(3))
+        ds = DataSet(RNG.standard_normal((4, 3)), RNG.standard_normal((4, 2)))
+        assert check_gradients(net, ds)
+
+    @pytest.mark.parametrize("act", ["relu", "leakyrelu", "softplus", "swish",
+                                     "hardtanh", "cube", "rationaltanh"])
+    def test_activations(self, act):
+        net = build_mln(
+            [DenseLayer(n_out=4, activation=act),
+             OutputLayer(n_out=2, loss="mse", activation="identity")],
+            InputType.feed_forward(3))
+        # offset inputs away from relu kink
+        ds = DataSet(RNG.standard_normal((4, 3)) + 0.1, RNG.standard_normal((4, 2)))
+        assert check_gradients(net, ds, max_rel_error=5e-3)
+
+
+class TestCnnGradients:
+    def test_conv_pool_dense(self):
+        net = build_mln(
+            [ConvolutionLayer(n_out=3, kernel=(2, 2), activation="tanh"),
+             SubsamplingLayer(pooling_type="max", kernel=(2, 2), stride=(2, 2)),
+             DenseLayer(n_out=5, activation="relu"),
+             OutputLayer(n_out=2, loss="mcxent", activation="softmax")],
+            InputType.convolutional(6, 6, 2))
+        ds = DataSet(RNG.standard_normal((3, 2, 6, 6)), onehot(3, 2))
+        assert check_gradients(net, ds)
+
+    def test_avg_pool(self):
+        net = build_mln(
+            [ConvolutionLayer(n_out=2, kernel=(3, 3), activation="sigmoid"),
+             SubsamplingLayer(pooling_type="avg", kernel=(2, 2), stride=(2, 2)),
+             OutputLayer(n_out=2, loss="mse", activation="identity")],
+            InputType.convolutional(6, 6, 1))
+        ds = DataSet(RNG.standard_normal((2, 1, 6, 6)), RNG.standard_normal((2, 2)))
+        assert check_gradients(net, ds)
+
+    def test_batchnorm_cnn(self):
+        net = build_mln(
+            [ConvolutionLayer(n_out=3, kernel=(2, 2), activation="identity"),
+             BatchNormalization(),
+             ActivationLayer(activation="relu"),
+             GlobalPoolingLayer(pooling_type="avg"),
+             OutputLayer(n_out=2, loss="mcxent", activation="softmax")],
+            InputType.convolutional(5, 5, 2))
+        ds = DataSet(RNG.standard_normal((4, 2, 5, 5)), onehot(4, 2))
+        assert check_gradients(net, ds)
+
+    def test_lrn(self):
+        net = build_mln(
+            [ConvolutionLayer(n_out=4, kernel=(2, 2), activation="relu"),
+             LocalResponseNormalization(),
+             GlobalPoolingLayer(pooling_type="max"),
+             OutputLayer(n_out=2, loss="mse", activation="identity")],
+            InputType.convolutional(4, 4, 1))
+        ds = DataSet(RNG.standard_normal((2, 1, 4, 4)) + 0.2,
+                     RNG.standard_normal((2, 2)))
+        assert check_gradients(net, ds, max_rel_error=5e-3)
+
+
+class TestRnnGradients:
+    def test_lstm_rnn_output(self):
+        net = build_mln(
+            [LSTM(n_out=4),
+             RnnOutputLayer(n_out=3, loss="mcxent", activation="softmax")],
+            InputType.recurrent(3, 4))
+        n, t, k = 2, 4, 3
+        labels = np.zeros((n, k, t))
+        for i in range(n):
+            for s in range(t):
+                labels[i, RNG.integers(0, k), s] = 1.0
+        ds = DataSet(RNG.standard_normal((n, 3, t)), labels)
+        assert check_gradients(net, ds)
+
+    def test_graves_lstm_peepholes(self):
+        net = build_mln(
+            [GravesLSTM(n_out=3),
+             RnnOutputLayer(n_out=2, loss="mse", activation="identity")],
+            InputType.recurrent(2, 3))
+        ds = DataSet(RNG.standard_normal((2, 2, 3)), RNG.standard_normal((2, 2, 3)))
+        assert check_gradients(net, ds)
+
+    def test_bidirectional(self):
+        net = build_mln(
+            [GravesBidirectionalLSTM(n_out=3),
+             RnnOutputLayer(n_out=2, loss="mse", activation="identity")],
+            InputType.recurrent(2, 3))
+        ds = DataSet(RNG.standard_normal((2, 2, 3)), RNG.standard_normal((2, 2, 3)))
+        assert check_gradients(net, ds)
+
+    def test_lstm_masked(self):
+        """Masking gradient check (ref: GradientCheckTestsMasking)."""
+        net = build_mln(
+            [LSTM(n_out=3),
+             RnnOutputLayer(n_out=2, loss="mse", activation="identity")],
+            InputType.recurrent(2, 4))
+        mask = np.ones((2, 4))
+        mask[0, 2:] = 0.0
+        ds = DataSet(RNG.standard_normal((2, 2, 4)),
+                     RNG.standard_normal((2, 2, 4)),
+                     features_mask=mask, labels_mask=mask)
+        assert check_gradients(net, ds)
+
+    def test_lstm_global_pool(self):
+        net = build_mln(
+            [LSTM(n_out=3),
+             GlobalPoolingLayer(pooling_type="avg"),
+             OutputLayer(n_out=2, loss="mcxent", activation="softmax")],
+            InputType.recurrent(2, 4))
+        ds = DataSet(RNG.standard_normal((2, 2, 4)), onehot(2, 2))
+        assert check_gradients(net, ds)
+
+
+class TestLossFunctions:
+    """Loss-function gradient checks (ref: LossFunctionGradientCheck.java)."""
+
+    @pytest.mark.parametrize("loss,act,label_kind", [
+        ("mse", "identity", "real"),
+        ("l1", "identity", "real"),
+        ("mcxent", "softmax", "onehot"),
+        ("xent", "sigmoid", "binary"),
+        ("hinge", "identity", "pm1"),
+        ("squared_hinge", "identity", "pm1"),
+        ("poisson", "softplus", "count"),
+        ("kl_divergence", "softmax", "dist"),
+        ("cosine_proximity", "identity", "real"),
+    ])
+    def test_loss(self, loss, act, label_kind):
+        k = 3
+        net = build_mln(
+            [DenseLayer(n_out=4, activation="tanh"),
+             OutputLayer(n_out=k, loss=loss, activation=act)],
+            InputType.feed_forward(3))
+        n = 4
+        if label_kind == "onehot":
+            y = onehot(n, k)
+        elif label_kind == "binary":
+            y = (RNG.random((n, k)) > 0.5).astype(np.float64)
+        elif label_kind == "pm1":
+            y = np.sign(RNG.standard_normal((n, k)))
+        elif label_kind == "count":
+            y = RNG.integers(0, 5, (n, k)).astype(np.float64)
+        elif label_kind == "dist":
+            y = RNG.random((n, k)) + 0.1
+            y /= y.sum(axis=1, keepdims=True)
+        else:
+            y = RNG.standard_normal((n, k))
+        ds = DataSet(RNG.standard_normal((n, 3)), y)
+        assert check_gradients(net, ds, max_rel_error=5e-3)

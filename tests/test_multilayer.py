@@ -1,0 +1,190 @@
+"""MultiLayerNetwork end-to-end tests (ref test model: deeplearning4j-core
+nn/multilayer/: MultiLayerTest, BackPropMLPTest, MultiLayerTestRNN,
+TestVariableLengthTS)."""
+
+import os
+import tempfile
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    LSTM,
+    OutputLayer,
+    RnnOutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Adam, Nesterovs, Sgd
+from deeplearning4j_tpu.optimize.listeners import (
+    CollectScoresIterationListener,
+    ScoreIterationListener,
+)
+
+RNG = np.random.default_rng(7)
+
+
+def xor_data(n=200):
+    x = RNG.random((n, 2)).astype(np.float32)
+    y_bit = ((x[:, 0] > 0.5) ^ (x[:, 1] > 0.5)).astype(int)
+    y = np.zeros((n, 2), np.float32)
+    y[np.arange(n), y_bit] = 1.0
+    return x, y
+
+
+def mlp(updater=None, seed=42):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(seed)
+            .updater(updater or Adam(learning_rate=0.01))
+            .weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=2, loss="mcxent", activation="softmax"))
+            .set_input_type(InputType.feed_forward(2))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestTraining:
+    def test_xor_converges(self):
+        x, y = xor_data(400)
+        net = mlp()
+        collector = CollectScoresIterationListener()
+        net.set_listeners(collector)
+        net.fit(x, y, epochs=60, batch_size=64)
+        e = net.evaluate(DataSet(x, y))
+        assert e.accuracy() > 0.9, e.stats()
+        # score decreased
+        first = collector.scores[0][1]
+        last = collector.scores[-1][1]
+        assert last < first * 0.5
+
+    def test_updaters_all_step(self):
+        from deeplearning4j_tpu.nn.updater import (AdaDelta, AdaGrad, AdaMax,
+                                                   Nadam, RmsProp)
+        x, y = xor_data(64)
+        for upd in (Sgd(0.1), Nesterovs(0.1, momentum=0.9), Adam(0.01),
+                    AdaMax(0.01), Nadam(0.01), RmsProp(0.01), AdaGrad(0.05),
+                    AdaDelta()):
+            net = mlp(updater=upd)
+            s0 = net.score(DataSet(x, y))
+            net.fit(x, y, epochs=5, batch_size=32)
+            s1 = net.score(DataSet(x, y))
+            assert np.isfinite(s1), type(upd).__name__
+            assert s1 < s0 * 1.5, f"{type(upd).__name__} diverged: {s0} -> {s1}"
+
+    def test_deterministic_with_seed(self):
+        x, y = xor_data(64)
+        n1, n2 = mlp(seed=99), mlp(seed=99)
+        n1.fit(x, y, epochs=3, batch_size=32)
+        n2.fit(x, y, epochs=3, batch_size=32)
+        for k in n1.params:
+            for pk in n1.params[k]:
+                np.testing.assert_array_equal(np.asarray(n1.params[k][pk]),
+                                              np.asarray(n2.params[k][pk]))
+
+    def test_batchnorm_training(self):
+        x, y = xor_data(256)
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(1).updater(Adam(0.01)).list()
+                .layer(DenseLayer(n_out=16, activation="identity"))
+                .layer(BatchNormalization())
+                .layer(DenseLayer(n_out=16, activation="relu"))
+                .layer(OutputLayer(n_out=2, loss="mcxent", activation="softmax"))
+                .set_input_type(InputType.feed_forward(2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(x, y, epochs=30, batch_size=64)
+        # running stats were updated
+        assert not np.allclose(np.asarray(net.state["1"]["mean"]), 0.0)
+        assert net.evaluate(DataSet(x, y)).accuracy() > 0.85
+
+
+class TestRnnTraining:
+    def test_sequence_classification(self):
+        # classify by sign of sum over sequence
+        n, f, t = 128, 3, 6
+        x = RNG.standard_normal((n, f, t)).astype(np.float32)
+        s = x.sum(axis=(1, 2))
+        y = np.zeros((n, 2, t), np.float32)
+        y[s > 0, 1, :] = 1.0
+        y[s <= 0, 0, :] = 1.0
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(3).updater(Adam(0.02)).list()
+                .layer(LSTM(n_out=8))
+                .layer(RnnOutputLayer(n_out=2, loss="mcxent", activation="softmax"))
+                .set_input_type(InputType.recurrent(f, t))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        s0 = net.score(DataSet(x, y))
+        net.fit(x, y, epochs=20, batch_size=32)
+        assert net.score(DataSet(x, y)) < s0 * 0.7
+
+    def test_tbptt_runs(self):
+        n, f, t = 16, 2, 12
+        x = RNG.standard_normal((n, f, t)).astype(np.float32)
+        y = RNG.standard_normal((n, 2, t)).astype(np.float32)
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(5).updater(Sgd(0.01)).list()
+                .layer(LSTM(n_out=4))
+                .layer(RnnOutputLayer(n_out=2, loss="mse", activation="identity"))
+                .set_input_type(InputType.recurrent(f, t))
+                .tbptt(4)
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(x, y, epochs=2, batch_size=8)
+        assert np.isfinite(net.score_value)
+
+    def test_rnn_time_step_streaming(self):
+        """Streaming rnn_time_step must equal the full-sequence forward
+        (ref: MultiLayerTestRNN#testRnnTimeStep)."""
+        f, t = 3, 5
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(11).updater(Sgd(0.1)).list()
+                .layer(LSTM(n_out=4))
+                .layer(RnnOutputLayer(n_out=2, loss="mse", activation="identity"))
+                .set_input_type(InputType.recurrent(f, t))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = RNG.standard_normal((2, f, t)).astype(np.float32)
+        full = np.asarray(net.output(x))
+        net.rnn_clear_previous_state()
+        steps = []
+        for s in range(t):
+            out = net.rnn_time_step(x[:, :, s:s + 1])
+            steps.append(np.asarray(out))
+        streamed = np.concatenate(steps, axis=2)
+        np.testing.assert_allclose(streamed, full, rtol=1e-4, atol=1e-5)
+
+
+class TestPersistence:
+    def test_save_restore_roundtrip(self):
+        from deeplearning4j_tpu.util.model_serializer import (
+            restore_multi_layer_network, write_model)
+        x, y = xor_data(64)
+        net = mlp()
+        net.fit(x, y, epochs=3, batch_size=32)
+        out_before = np.asarray(net.output(x[:8]))
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "model.zip")
+            write_model(net, path)
+            net2 = restore_multi_layer_network(path)
+        out_after = np.asarray(net2.output(x[:8]))
+        np.testing.assert_allclose(out_before, out_after, rtol=1e-6)
+        assert net2.iteration_count == net.iteration_count
+        # training can continue (updater state restored)
+        net2.fit(x, y, epochs=1, batch_size=32)
+
+    def test_summary(self):
+        net = mlp()
+        s = net.summary()
+        assert "DenseLayer" in s and "Total params" in s
+        assert net.num_params() == 2 * 16 + 16 + 16 * 16 + 16 + 16 * 2 + 2
