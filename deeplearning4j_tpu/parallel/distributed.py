@@ -1,0 +1,122 @@
+"""Multi-host distributed backend.
+
+TPU-native replacement for the reference's multi-node stacks (SURVEY §2.5
+strategies 3-4): Spark parameter averaging (ParameterAveragingTrainingMaster)
+and the Aeron UDP VoidParameterServer (SharedTrainingMaster/
+SharedTrainingWrapper.java:206-244, SilentTrainingDriver threshold-compressed
+async updates).
+
+On TPU both collapse to the same synchronous SPMD program: `jax.distributed`
+brings up the gRPC coordination service over DCN; every host runs the SAME
+jitted train step over a global mesh whose "data" axis spans all chips in the
+job; XLA routes gradient allreduce over ICI within a slice and DCN across
+slices. Gradient compression (EncodingHandler thresholdEncode) is dropped by
+design — dense bf16/fp32 allreduce over ICI is faster than the reference's
+sparse codec over UDP (BASELINE.json north star).
+
+Spark's remaining role — data sharding — maps to per-host input pipelines:
+each host feeds only its local shard of the global batch
+(`host_local_batch`), like Spark executors reading their RDD partitions.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class VoidConfiguration:
+    """Connection info for the coordination service — name kept for API
+    parity with the reference's VoidConfiguration (SharedTrainingMaster.java:58),
+    but it configures jax.distributed (gRPC over DCN), not Aeron UDP."""
+
+    coordinator_address: Optional[str] = None  # "host:port" of process 0
+    num_processes: int = 1
+    process_id: int = 0
+    local_device_ids: Optional[Sequence[int]] = None
+
+
+_initialized = False
+
+
+def initialize(config: Optional[VoidConfiguration] = None) -> None:
+    """Bring up the multi-host runtime (ref equivalent: VoidParameterServer
+    .init at SharedTrainingWrapper.java:206-214 / Spark context setup).
+
+    With config=None, settings come from the standard env vars
+    (JAX_COORDINATOR_ADDRESS, JAX_NUM_PROCESSES, JAX_PROCESS_ID) or the
+    cloud TPU metadata that jax.distributed auto-detects.
+    """
+    global _initialized
+    if _initialized:
+        return
+    if config is None or config.coordinator_address is None:
+        if os.environ.get("JAX_COORDINATOR_ADDRESS") or _on_cloud_tpu():
+            jax.distributed.initialize()
+            _initialized = True
+        else:
+            log.info("single-process mode (no coordinator configured)")
+        return
+    jax.distributed.initialize(
+        coordinator_address=config.coordinator_address,
+        num_processes=config.num_processes,
+        process_id=config.process_id,
+        local_device_ids=config.local_device_ids,
+    )
+    _initialized = True
+
+
+def _on_cloud_tpu() -> bool:
+    return bool(os.environ.get("TPU_WORKER_HOSTNAMES") or
+                os.environ.get("TPU_NAME"))
+
+
+def shutdown() -> None:
+    global _initialized
+    if _initialized:
+        jax.distributed.shutdown()
+        _initialized = False
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def global_mesh(axis_names: Sequence[str] = ("data",),
+                shape: Optional[Sequence[int]] = None):
+    """Mesh over ALL devices in the job (every host's chips). With the
+    default shape, the "data" axis spans the whole pod — the multi-host
+    analogue of SparkDl4jMultiLayer's cluster-wide data parallelism."""
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    return make_mesh(shape=shape, axis_names=axis_names, devices=jax.devices())
+
+
+def host_local_batch(global_batch_size: int) -> int:
+    """Per-host share of a global batch (Spark-executor-partition analogue)."""
+    n = jax.process_count()
+    if global_batch_size % n:
+        raise ValueError(f"global batch {global_batch_size} not divisible by "
+                         f"{n} processes")
+    return global_batch_size // n
+
+
+def make_global_array(local_batch: np.ndarray, mesh, spec=None):
+    """Assemble a globally-sharded array from per-host local shards
+    (jax.make_array_from_process_local_data) — the DCN-era equivalent of
+    Spark broadcasting/partitioning DataSets to executors."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sharding = NamedSharding(mesh, spec if spec is not None
+                             else P("data", *([None] * (local_batch.ndim - 1))))
+    return jax.make_array_from_process_local_data(sharding, local_batch)

@@ -1,0 +1,237 @@
+"""Data-parallel training over a device mesh.
+
+TPU-native replacement for deeplearning4j-scaleout's ParallelWrapper
+(deeplearning4j-scaleout-parallelwrapper/.../ParallelWrapper.java:58-898) and
+its two training modes:
+
+- TrainingMode.SHARED_GRADIENTS (:68, EncodedGradientsAccumulator /
+  EncodingHandler threshold-compressed async exchange) → here the NORTH STAR
+  (BASELINE.json): ONE jitted SPMD train step with the batch sharded over the
+  mesh "data" axis and params replicated; XLA inserts a dense allreduce
+  (psum) of gradients over ICI. No worker threads, no replicas, no
+  compression — ICI bandwidth makes dense exchange faster than the
+  reference's sparse codec path.
+
+- TrainingMode.AVERAGING (:59-74, averageModels every averagingFrequency
+  iters :251-257) → `shard_map` formulation: each mesh shard runs
+  `averaging_frequency` LOCAL updater steps on its own microbatches
+  (lax.scan), then params/updater-state are psum-averaged. Kept for parity
+  testing (the reference invariant
+  TestCompareParameterAveragingSparkVsSingleMachine: freq=1 averaging ==
+  single-machine result holds here exactly for SGD).
+
+The reference's worker thread pool, device pinning (attachThreadToDevice
+:137) and MagicQueue feeding disappear: SPMD partitioning is the scheduler.
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator, AsyncDataSetIterator
+from deeplearning4j_tpu.nn.updater import normalize_gradients
+from deeplearning4j_tpu.parallel.mesh import default_mesh
+
+log = logging.getLogger(__name__)
+
+
+def _strip_rnn_state(state):
+    """Remove per-batch RNN carries (h/c) so pytree structure is stable
+    across shard_map in/out specs."""
+    return {k: {kk: vv for kk, vv in v.items() if kk not in ("h", "c")}
+            if isinstance(v, dict) else v for k, v in state.items()}
+
+
+class ParallelWrapper:
+    """Multi-device trainer wrapping a MultiLayerNetwork or ComputationGraph
+    (ref: ParallelWrapper.Builder / fit :468)."""
+
+    def __init__(self, model, mesh: Optional[Mesh] = None,
+                 training_mode: str = "allreduce",
+                 averaging_frequency: int = 5,
+                 prefetch_buffer: int = 2,
+                 report_score_after_averaging: bool = True):
+        self.model = model
+        self.mesh = mesh if mesh is not None else default_mesh()
+        self.training_mode = training_mode
+        self.averaging_frequency = max(1, averaging_frequency)
+        self.prefetch_buffer = prefetch_buffer
+        self.n_devices = int(np.prod(self.mesh.devices.shape))
+        self._jit_cache: Dict[Any, Any] = {}
+        if not model._initialized:
+            model.init()
+
+    # ------------------------------------------------------------------
+    def _shard_batch(self, arr):
+        """Pad batch to a multiple of n_devices and device_put sharded on
+        the data axis."""
+        arr = np.asarray(arr)
+        n = arr.shape[0]
+        rem = n % self.n_devices
+        if rem:
+            pad = self.n_devices - rem
+            arr = np.concatenate([arr, np.repeat(arr[-1:], pad, axis=0)], axis=0)
+        sh = NamedSharding(self.mesh, P("data", *([None] * (arr.ndim - 1))))
+        return jax.device_put(arr, sh)
+
+    def _replicate(self, tree):
+        sh = NamedSharding(self.mesh, P())
+        return jax.device_put(tree, sh)
+
+    # ------------------------------------------------------------------
+    # allreduce mode (north star)
+    # ------------------------------------------------------------------
+    def _fit_batch_allreduce(self, ds: DataSet):
+        """One global SPMD step: inputs sharded, params replicated — the
+        jitted step from the wrapped model works unchanged, XLA partitions
+        it and inserts the ICI allreduce."""
+        m = self.model
+        step = m._get_train_step(False)
+        rng = m._next_rng()
+        x = self._shard_batch(ds.features)
+        y = self._shard_batch(ds.labels)
+        fmask = None if ds.features_mask is None else self._shard_batch(ds.features_mask)
+        lmask = None if ds.labels_mask is None else self._shard_batch(ds.labels_mask)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        if isinstance(m, MultiLayerNetwork):
+            m.params, m.state, m.updater_state, loss = step(
+                m.params, m.state, m.updater_state, x, y, rng, fmask, lmask)
+        else:
+            inputs = {m.conf.network_inputs[0]: x}
+            labels = {m.conf.network_outputs[0]: y}
+            fmasks = None if fmask is None else {m.conf.network_inputs[0]: fmask}
+            lmasks = None if lmask is None else {m.conf.network_outputs[0]: lmask}
+            m.params, m.state, m.updater_state, loss = step(
+                m.params, m.state, m.updater_state, inputs, labels, rng,
+                fmasks, lmasks)
+        m.score_value = float(loss)
+        for lst in m.listeners:
+            if hasattr(lst, "record_batch"):
+                lst.record_batch(ds.num_examples())
+            lst.iteration_done(m, m.iteration_count, m.score_value)
+        m.iteration_count += 1
+
+    # ------------------------------------------------------------------
+    # averaging mode (parity with ParameterAveraging semantics)
+    # ------------------------------------------------------------------
+    def _get_averaging_step(self):
+        if "avg" in self._jit_cache:
+            return self._jit_cache["avg"]
+        m = self.model
+        conf = m.conf
+        mesh = self.mesh
+        freq = self.averaging_frequency
+        nd = self.n_devices
+
+        def local_round(params, state, upd_state, xs, ys, rngs):
+            """Runs on ONE shard: `freq` sequential local steps over the
+            leading microbatch axis, then cross-shard param average."""
+
+            def one(carry, inp):
+                p, s, u = carry
+                x, y, rng = inp
+                rng = rng.reshape(2)  # per-shard slice [1,2] -> legacy key (2,)
+                (loss, s2), grads = jax.value_and_grad(
+                    lambda pp: m._loss(pp, s, x, y, rng, None, None, train=True),
+                    has_aux=True)(p)
+                grads = normalize_gradients(grads, conf.gradient_normalization,
+                                            conf.gradient_normalization_threshold)
+                steps, u2 = conf.updater.update(grads, u, p)
+                p2 = jax.tree_util.tree_map(lambda a, b: a - b, p, steps)
+                return (p2, _strip_rnn_state(s2), u2), loss
+
+            (p_f, s_f, u_f), losses = jax.lax.scan(one, (params, state, upd_state),
+                                                   (xs, ys, rngs))
+            s_f = _strip_rnn_state(s_f)
+            # parameter averaging across the mesh (ref: averageModels :339)
+            p_avg = jax.tree_util.tree_map(lambda a: jax.lax.pmean(a, "data"), p_f)
+            u_avg = jax.tree_util.tree_map(
+                lambda a: jax.lax.pmean(a.astype(jnp.float32), "data").astype(a.dtype)
+                if jnp.issubdtype(a.dtype, jnp.integer) else jax.lax.pmean(a, "data"),
+                u_f)
+            s_avg = jax.tree_util.tree_map(lambda a: jax.lax.pmean(a, "data"), s_f)
+            return p_avg, s_avg, u_avg, jnp.mean(losses)
+
+        def rep(x):
+            return jax.tree_util.tree_map(lambda _: P(), x)
+
+        def rounds(params, state, upd_state, xs, ys, rngs):
+            fn = shard_map(
+                local_round, mesh=mesh,
+                in_specs=(rep(params), rep(state), rep(upd_state),
+                          P(None, "data"), P(None, "data"), P(None, "data")),
+                out_specs=(rep(params), rep(state), rep(upd_state), P()),
+                check_rep=False)
+            return fn(params, state, upd_state, xs, ys, rngs)
+
+        self._jit_cache["avg"] = jax.jit(rounds)
+        return self._jit_cache["avg"]
+
+    def _fit_round_averaging(self, batches):
+        """Consume `averaging_frequency * n_devices` microbatches as one
+        round (ref: ParameterAveragingTrainingMaster split sizing :287-298)."""
+        m = self.model
+        freq = len(batches) // self.n_devices
+        xs = np.stack([np.stack([b.features for b in
+                                 batches[f * self.n_devices:(f + 1) * self.n_devices]],
+                                axis=0) for f in range(freq)], axis=0)
+        ys = np.stack([np.stack([b.labels for b in
+                                 batches[f * self.n_devices:(f + 1) * self.n_devices]],
+                                axis=0) for f in range(freq)], axis=0)
+        # xs: [freq, n_dev, B, ...] — shard axis 1, scan axis 0, flatten device dim
+        xs = xs.reshape((freq, self.n_devices * xs.shape[2]) + xs.shape[3:])
+        ys = ys.reshape((freq, self.n_devices * ys.shape[2]) + ys.shape[3:])
+        # one rng per (scan step, shard): [freq, n_dev, 2], shard axis = 1
+        rngs = np.asarray(jax.random.split(m._next_rng(), freq * self.n_devices))
+        rngs = rngs.reshape(freq, self.n_devices, -1)
+        step = self._get_averaging_step()
+        m.state = _strip_rnn_state(m.state)
+        m.params, m.state, m.updater_state, loss = step(
+            m.params, m.state, m.updater_state, jnp.asarray(xs), jnp.asarray(ys),
+            jnp.asarray(rngs))
+        m.score_value = float(loss)
+        for lst in m.listeners:
+            lst.iteration_done(m, m.iteration_count, m.score_value)
+        m.iteration_count += freq
+
+    # ------------------------------------------------------------------
+    def fit(self, data, labels=None, epochs: int = 1, batch_size: int = 32):
+        """Train across the mesh (ref: ParallelWrapper.fit :468). The
+        iterator is wrapped in async prefetch like the reference's
+        ADSI-per-device feeding."""
+        m = self.model
+        if labels is not None:
+            it = ArrayDataSetIterator(data, labels, batch_size)
+        elif isinstance(data, DataSet):
+            it = ArrayDataSetIterator(data.features, data.labels, batch_size)
+        else:
+            it = data
+
+        for _ in range(epochs):
+            src = AsyncDataSetIterator(it, prefetch=self.prefetch_buffer) \
+                if self.prefetch_buffer else it
+            if self.training_mode == "averaging":
+                pend = []
+                round_size = self.averaging_frequency * self.n_devices
+                for ds in src:
+                    pend.append(ds)
+                    if len(pend) == round_size:
+                        self._fit_round_averaging(pend)
+                        pend = []
+                # trailing partial round: fall back to allreduce steps
+                for ds in pend:
+                    self._fit_batch_allreduce(ds)
+            else:
+                for ds in src:
+                    self._fit_batch_allreduce(ds)
+            m.epoch_count += 1
+        return m

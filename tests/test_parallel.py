@@ -1,0 +1,142 @@
+"""Parallelism tests on the 8-virtual-device CPU mesh (SURVEY §4: reference
+tests distributed semantics in-process; key invariant from
+TestCompareParameterAveragingSparkVsSingleMachine — multi-device result ==
+single-machine result)."""
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Sgd
+from deeplearning4j_tpu.parallel import ParallelInference, ParallelWrapper
+from deeplearning4j_tpu.parallel.mesh import default_mesh, make_mesh
+
+RNG = np.random.default_rng(99)
+
+
+def make_net(seed=42, lr=0.1):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(seed)
+            .updater(Sgd(lr))
+            .weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, loss="mcxent", activation="softmax"))
+            .set_input_type(InputType.feed_forward(5))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def data(n=64):
+    x = RNG.standard_normal((n, 5)).astype(np.float32)
+    y = np.zeros((n, 3), np.float32)
+    y[np.arange(n), RNG.integers(0, 3, n)] = 1.0
+    return x, y
+
+
+class TestMesh:
+    def test_eight_virtual_devices(self):
+        assert len(jax.devices()) == 8
+
+    def test_mesh_shapes(self):
+        m = default_mesh()
+        assert m.devices.shape == (8,)
+        m2 = make_mesh((4, 2), ("data", "model"))
+        assert m2.axis_names == ("data", "model")
+
+
+class TestAllReduce:
+    def test_sharded_equals_single_device(self):
+        """Data-parallel allreduce step must produce EXACTLY the same params
+        as the same global batch on one device (the reference invariant,
+        made exact by dense allreduce)."""
+        x, y = data(64)
+        single = make_net(seed=7)
+        multi = make_net(seed=7)
+        # identical initial params
+        for k in single.params:
+            for pk in single.params[k]:
+                np.testing.assert_array_equal(np.asarray(single.params[k][pk]),
+                                              np.asarray(multi.params[k][pk]))
+        single.fit(x, y, epochs=2, batch_size=64)
+        pw = ParallelWrapper(multi, training_mode="allreduce")
+        pw.fit(x, y, epochs=2, batch_size=64)
+        for k in single.params:
+            for pk in single.params[k]:
+                np.testing.assert_allclose(np.asarray(single.params[k][pk]),
+                                           np.asarray(multi.params[k][pk]),
+                                           rtol=1e-5, atol=1e-6)
+
+    def test_training_reduces_loss(self):
+        x, y = data(256)
+        net = make_net()
+        pw = ParallelWrapper(net)
+        s0 = net.score(DataSet(x, y))
+        pw.fit(x, y, epochs=10, batch_size=64)
+        assert net.score(DataSet(x, y)) < s0
+
+
+class TestAveraging:
+    def test_averaging_freq1_equals_single(self):
+        """averagingFrequency=1 parameter averaging == single-machine step on
+        the concatenated batch, for plain SGD (ref:
+        TestCompareParameterAveragingSparkVsSingleMachine)."""
+        n_dev = 8
+        micro = 4
+        x, y = data(n_dev * micro)
+        single = make_net(seed=13)
+        multi = make_net(seed=13)
+        single.fit(x, y, epochs=1, batch_size=n_dev * micro)
+        pw = ParallelWrapper(multi, training_mode="averaging",
+                             averaging_frequency=1, prefetch_buffer=0)
+        pw.fit(x, y, epochs=1, batch_size=micro)
+        for k in single.params:
+            for pk in single.params[k]:
+                np.testing.assert_allclose(np.asarray(single.params[k][pk]),
+                                           np.asarray(multi.params[k][pk]),
+                                           rtol=1e-4, atol=1e-5)
+
+    def test_averaging_freq5_trains(self):
+        x, y = data(320)
+        net = make_net()
+        pw = ParallelWrapper(net, training_mode="averaging",
+                             averaging_frequency=5, prefetch_buffer=0)
+        s0 = net.score(DataSet(x, y))
+        pw.fit(x, y, epochs=5, batch_size=8)
+        assert net.score(DataSet(x, y)) < s0
+
+
+class TestParallelInference:
+    def test_matches_direct_output(self):
+        net = make_net()
+        pi = ParallelInference(net, max_batch_size=32)
+        x, _ = data(20)
+        out_pi = pi.output(x)
+        out_direct = np.asarray(net.output(x))
+        np.testing.assert_allclose(out_pi, out_direct, rtol=1e-5)
+        pi.shutdown()
+
+    def test_concurrent_requests_batch(self):
+        import threading
+        net = make_net()
+        pi = ParallelInference(net, max_batch_size=64, batch_timeout_ms=20)
+        x, _ = data(40)
+        results = {}
+
+        def worker(i):
+            results[i] = pi.output(x[i * 4:(i + 1) * 4])
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(10)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        direct = np.asarray(net.output(x))
+        for i in range(10):
+            np.testing.assert_allclose(results[i], direct[i * 4:(i + 1) * 4],
+                                       rtol=1e-5)
+        pi.shutdown()
