@@ -170,7 +170,7 @@ class ParallelWrapper:
                 in_specs=(rep(params), rep(state), rep(upd_state),
                           P(None, "data"), P(None, "data"), P(None, "data")),
                 out_specs=(rep(params), rep(state), rep(upd_state), P()),
-                check_rep=False)
+                check_vma=False)
             return fn(params, state, upd_state, xs, ys, rngs)
 
         self._jit_cache["avg"] = jax.jit(rounds)
