@@ -38,7 +38,13 @@ def conv_out_size(in_size: int, k: int, s: int, p: int, d: int, mode: str) -> in
             )
         return (in_size + 2 * p - eff_k) // s + 1
     # truncate
-    return (in_size + 2 * p - eff_k) // s + 1
+    out = (in_size + 2 * p - eff_k) // s + 1
+    if out < 1:
+        raise ValueError(
+            f"Conv/pool output size {out} < 1 (in={in_size}, kernel={eff_k}, "
+            f"stride={s}, padding={p}) — input too small for this architecture"
+        )
+    return out
 
 
 def _padding_arg(kernel, stride, padding, dilation, mode: str):

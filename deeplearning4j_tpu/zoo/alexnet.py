@@ -1,0 +1,51 @@
+"""AlexNet (ref: zoo/model/AlexNet.java — the 2-pool LRN variant: conv11x11/4
+→ LRN → pool → conv5x5 → LRN → pool → 3×conv3x3 → pool → 2×dense4096 w/
+dropout → softmax)."""
+
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer, DenseLayer,
+                                               LocalResponseNormalization,
+                                               OutputLayer, SubsamplingLayer)
+from deeplearning4j_tpu.nn.updater import Nesterovs
+from deeplearning4j_tpu.zoo.base import ZooModel, register_model
+
+
+@register_model
+class AlexNet(ZooModel):
+    def __init__(self, num_classes: int = 1000, seed: int = 12345,
+                 height: int = 224, width: int = 224, channels: int = 3, **kw):
+        super().__init__(num_classes, seed, **kw)
+        self.height, self.width, self.channels = height, width, channels
+
+    def conf(self):
+        return (NeuralNetConfiguration.Builder()
+                .seed(self.seed)
+                .updater(self.kwargs.get("updater",
+                                         Nesterovs(1e-2, momentum=0.9)))
+                .weight_init("relu")
+                .list()
+                .layer(ConvolutionLayer(n_out=96, kernel=(11, 11), stride=(4, 4),
+                                        padding=(3, 3), activation="relu"))
+                .layer(LocalResponseNormalization())
+                .layer(SubsamplingLayer(pooling_type="max", kernel=(3, 3),
+                                        stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=256, kernel=(5, 5), stride=(1, 1),
+                                        padding=(2, 2), activation="relu"))
+                .layer(LocalResponseNormalization())
+                .layer(SubsamplingLayer(pooling_type="max", kernel=(3, 3),
+                                        stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=384, kernel=(3, 3), padding=(1, 1),
+                                        activation="relu"))
+                .layer(ConvolutionLayer(n_out=384, kernel=(3, 3), padding=(1, 1),
+                                        activation="relu"))
+                .layer(ConvolutionLayer(n_out=256, kernel=(3, 3), padding=(1, 1),
+                                        activation="relu"))
+                .layer(SubsamplingLayer(pooling_type="max", kernel=(3, 3),
+                                        stride=(2, 2)))
+                .layer(DenseLayer(n_out=4096, activation="relu", dropout=0.5))
+                .layer(DenseLayer(n_out=4096, activation="relu", dropout=0.5))
+                .layer(OutputLayer(n_out=self.num_classes, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.convolutional(self.height, self.width,
+                                                        self.channels))
+                .build())

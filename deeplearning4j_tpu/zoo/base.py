@@ -1,0 +1,76 @@
+"""ZooModel base.
+
+TPU-native equivalent of zoo/ZooModel.java:28-81: `init()` builds the fresh
+network; `init_pretrained()` downloads a checkpoint zip with checksum
+validation then restores (ref :52-81 pretrainedUrl + ModelSerializer.restore).
+In a zero-egress environment the download path raises a clear error; local
+checkpoint paths are always accepted.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import urllib.request
+from typing import Dict, Optional, Type
+
+MODEL_REGISTRY: Dict[str, Type["ZooModel"]] = {}
+
+
+def register_model(cls):
+    MODEL_REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+def get_model(name: str) -> Type["ZooModel"]:
+    return MODEL_REGISTRY[name.lower()]
+
+
+class ZooModel:
+    """Base for zoo models (ref: InstantiableModel)."""
+
+    #: override: url + sha256 per pretrained flavor (ref: pretrainedUrl /
+    #: pretrainedChecksum in each zoo model)
+    pretrained: Dict[str, Dict[str, str]] = {}
+
+    def __init__(self, num_classes: int = 1000, seed: int = 12345, **kwargs):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.kwargs = kwargs
+
+    def conf(self):
+        raise NotImplementedError
+
+    def init(self):
+        """Build + initialize the network (ref: ZooModel.init())."""
+        conf = self.conf()
+        from deeplearning4j_tpu.nn.conf.network import (
+            ComputationGraphConfiguration, MultiLayerConfiguration)
+        if isinstance(conf, MultiLayerConfiguration):
+            from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+            return MultiLayerNetwork(conf).init()
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        return ComputationGraph(conf).init()
+
+    def init_pretrained(self, flavor: str = "imagenet",
+                        cache_dir: Optional[str] = None,
+                        local_path: Optional[str] = None):
+        """Load pretrained weights (ref: ZooModel.initPretrained :40-81)."""
+        from deeplearning4j_tpu.util.model_serializer import restore_model
+        if local_path:
+            return restore_model(local_path)
+        if flavor not in self.pretrained:
+            raise ValueError(f"{type(self).__name__} has no pretrained '{flavor}'")
+        spec = self.pretrained[flavor]
+        cache_dir = cache_dir or os.path.expanduser("~/.dl4jtpu/models")
+        os.makedirs(cache_dir, exist_ok=True)
+        fname = os.path.join(cache_dir,
+                             f"{type(self).__name__.lower()}_{flavor}.zip")
+        if not os.path.exists(fname):
+            urllib.request.urlretrieve(spec["url"], fname)  # zero-egress envs raise here
+        if "sha256" in spec:
+            h = hashlib.sha256(open(fname, "rb").read()).hexdigest()
+            if h != spec["sha256"]:
+                os.remove(fname)
+                raise IOError(f"checksum mismatch for {fname}")
+        return restore_model(fname)

@@ -1,0 +1,88 @@
+"""GoogLeNet / Inception-v1 (ref: zoo/model/GoogLeNet.java — inception
+modules with 1x1/3x3/5x5 branches + pool branch merged on depth)."""
+
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph_conf import MergeVertex
+from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer, DenseLayer,
+                                               GlobalPoolingLayer,
+                                               LocalResponseNormalization,
+                                               OutputLayer, SubsamplingLayer)
+from deeplearning4j_tpu.nn.updater import Nesterovs
+from deeplearning4j_tpu.zoo.base import ZooModel, register_model
+
+
+@register_model
+class GoogLeNet(ZooModel):
+    def __init__(self, num_classes: int = 1000, seed: int = 12345,
+                 height: int = 224, width: int = 224, channels: int = 3, **kw):
+        super().__init__(num_classes, seed, **kw)
+        self.height, self.width, self.channels = height, width, channels
+
+    def _inception(self, g, name, inp, c1, c3r, c3, c5r, c5, pp):
+        """Inception module (ref: GoogLeNet.java inception builder)."""
+        g.add_layer(f"{name}_1x1",
+                    ConvolutionLayer(n_out=c1, kernel=(1, 1), activation="relu"),
+                    inp)
+        g.add_layer(f"{name}_3x3r",
+                    ConvolutionLayer(n_out=c3r, kernel=(1, 1), activation="relu"),
+                    inp)
+        g.add_layer(f"{name}_3x3",
+                    ConvolutionLayer(n_out=c3, kernel=(3, 3), padding=(1, 1),
+                                     activation="relu"), f"{name}_3x3r")
+        g.add_layer(f"{name}_5x5r",
+                    ConvolutionLayer(n_out=c5r, kernel=(1, 1), activation="relu"),
+                    inp)
+        g.add_layer(f"{name}_5x5",
+                    ConvolutionLayer(n_out=c5, kernel=(5, 5), padding=(2, 2),
+                                     activation="relu"), f"{name}_5x5r")
+        g.add_layer(f"{name}_pool",
+                    SubsamplingLayer(pooling_type="max", kernel=(3, 3),
+                                     stride=(1, 1), padding=(1, 1)), inp)
+        g.add_layer(f"{name}_poolproj",
+                    ConvolutionLayer(n_out=pp, kernel=(1, 1), activation="relu"),
+                    f"{name}_pool")
+        g.add_vertex(f"{name}", MergeVertex(), f"{name}_1x1", f"{name}_3x3",
+                     f"{name}_5x5", f"{name}_poolproj")
+        return name
+
+    def conf(self):
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(self.kwargs.get("updater", Nesterovs(1e-2, momentum=0.9)))
+             .weight_init("relu")
+             .graph_builder()
+             .add_inputs("input")
+             .set_input_types(InputType.convolutional(self.height, self.width,
+                                                      self.channels)))
+        g.add_layer("c1", ConvolutionLayer(n_out=64, kernel=(7, 7), stride=(2, 2),
+                                           padding=(3, 3), activation="relu"),
+                    "input")
+        g.add_layer("p1", SubsamplingLayer(pooling_type="max", kernel=(3, 3),
+                                           stride=(2, 2), padding=(1, 1)), "c1")
+        g.add_layer("lrn1", LocalResponseNormalization(), "p1")
+        g.add_layer("c2r", ConvolutionLayer(n_out=64, kernel=(1, 1),
+                                            activation="relu"), "lrn1")
+        g.add_layer("c2", ConvolutionLayer(n_out=192, kernel=(3, 3),
+                                           padding=(1, 1), activation="relu"),
+                    "c2r")
+        g.add_layer("lrn2", LocalResponseNormalization(), "c2")
+        g.add_layer("p2", SubsamplingLayer(pooling_type="max", kernel=(3, 3),
+                                           stride=(2, 2), padding=(1, 1)), "lrn2")
+        x = self._inception(g, "i3a", "p2", 64, 96, 128, 16, 32, 32)
+        x = self._inception(g, "i3b", x, 128, 128, 192, 32, 96, 64)
+        g.add_layer("p3", SubsamplingLayer(pooling_type="max", kernel=(3, 3),
+                                           stride=(2, 2), padding=(1, 1)), x)
+        x = self._inception(g, "i4a", "p3", 192, 96, 208, 16, 48, 64)
+        x = self._inception(g, "i4b", x, 160, 112, 224, 24, 64, 64)
+        x = self._inception(g, "i4c", x, 128, 128, 256, 24, 64, 64)
+        x = self._inception(g, "i4d", x, 112, 144, 288, 32, 64, 64)
+        x = self._inception(g, "i4e", x, 256, 160, 320, 32, 128, 128)
+        g.add_layer("p4", SubsamplingLayer(pooling_type="max", kernel=(3, 3),
+                                           stride=(2, 2), padding=(1, 1)), x)
+        x = self._inception(g, "i5a", "p4", 256, 160, 320, 32, 128, 128)
+        x = self._inception(g, "i5b", x, 384, 192, 384, 48, 128, 128)
+        g.add_layer("gap", GlobalPoolingLayer(pooling_type="avg"), x)
+        g.add_layer("output",
+                    OutputLayer(n_out=self.num_classes, loss="mcxent",
+                                activation="softmax", dropout=0.6), "gap")
+        return g.set_outputs("output").build()

@@ -1,0 +1,142 @@
+"""InceptionResNetV1 + FaceNetNN4Small2 (ref: zoo/model/InceptionResNetV1.java,
+FaceNetNN4Small2.java with helper/{InceptionResNetHelper,FaceNetHelper}.java —
+face-embedding networks trained with center loss / triplet-style objectives,
+L2-normalized embedding output).
+
+The builders here produce faithful-capability (stem + residual-inception
+blocks + embedding head) graphs scaled by `blocks_per_stage` so tests can
+instantiate small variants; defaults give the full-size networks.
+"""
+
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph_conf import (ElementWiseVertex,
+                                                   L2NormalizeVertex,
+                                                   MergeVertex, ScaleVertex)
+from deeplearning4j_tpu.nn.conf.layers import (ActivationLayer,
+                                               BatchNormalization,
+                                               CenterLossOutputLayer,
+                                               ConvolutionLayer, DenseLayer,
+                                               GlobalPoolingLayer, OutputLayer,
+                                               SubsamplingLayer)
+from deeplearning4j_tpu.nn.updater import Adam
+from deeplearning4j_tpu.zoo.base import ZooModel, register_model
+
+
+@register_model
+class InceptionResNetV1(ZooModel):
+    def __init__(self, num_classes: int = 1000, seed: int = 12345,
+                 height: int = 160, width: int = 160, channels: int = 3,
+                 embedding_size: int = 128, blocks_per_stage=(5, 10, 5), **kw):
+        super().__init__(num_classes, seed, **kw)
+        self.height, self.width, self.channels = height, width, channels
+        self.embedding_size = embedding_size
+        self.blocks = blocks_per_stage
+
+    def _conv_bn(self, g, name, inp, n_out, kernel, stride=(1, 1), pad=(0, 0)):
+        g.add_layer(f"{name}_c",
+                    ConvolutionLayer(n_out=n_out, kernel=kernel, stride=stride,
+                                     padding=pad, activation="identity",
+                                     has_bias=False), inp)
+        g.add_layer(f"{name}_bn", BatchNormalization(), f"{name}_c")
+        g.add_layer(f"{name}", ActivationLayer(activation="relu"), f"{name}_bn")
+        return name
+
+    def _res_block(self, g, name, inp, branch_defs, n_channels, scale=0.17):
+        """Residual inception block (ref: InceptionResNetHelper block35/17/8):
+        parallel conv branches → merge → 1x1 up-proj → scaled residual add."""
+        outs = []
+        for bi, defs in enumerate(branch_defs):
+            x = inp
+            for li, (n_out, kernel, pad) in enumerate(defs):
+                x = self._conv_bn(g, f"{name}_b{bi}l{li}", x, n_out, kernel,
+                                  pad=pad)
+            outs.append(x)
+        g.add_vertex(f"{name}_merge", MergeVertex(), *outs)
+        g.add_layer(f"{name}_up",
+                    ConvolutionLayer(n_out=n_channels, kernel=(1, 1),
+                                     activation="identity"), f"{name}_merge")
+        g.add_vertex(f"{name}_scale", ScaleVertex(scale=scale), f"{name}_up")
+        g.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), inp,
+                     f"{name}_scale")
+        g.add_layer(f"{name}", ActivationLayer(activation="relu"), f"{name}_add")
+        return name
+
+    def conf(self):
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(self.kwargs.get("updater", Adam(1e-3)))
+             .weight_init("relu")
+             .graph_builder()
+             .add_inputs("input")
+             .set_input_types(InputType.convolutional(self.height, self.width,
+                                                      self.channels)))
+        # stem (ref: InceptionResNetV1.java stem)
+        x = self._conv_bn(g, "stem1", "input", 32, (3, 3), stride=(2, 2))
+        x = self._conv_bn(g, "stem2", x, 32, (3, 3))
+        x = self._conv_bn(g, "stem3", x, 64, (3, 3), pad=(1, 1))
+        g.add_layer("stem_pool", SubsamplingLayer(pooling_type="max",
+                                                  kernel=(3, 3), stride=(2, 2)),
+                    x)
+        x = self._conv_bn(g, "stem4", "stem_pool", 80, (1, 1))
+        x = self._conv_bn(g, "stem5", x, 192, (3, 3))
+        x = self._conv_bn(g, "stem6", x, 256, (3, 3), stride=(2, 2))
+        # stage A: block35-style
+        for i in range(self.blocks[0]):
+            x = self._res_block(
+                g, f"a{i}", x,
+                [[(32, (1, 1), (0, 0))],
+                 [(32, (1, 1), (0, 0)), (32, (3, 3), (1, 1))],
+                 [(32, (1, 1), (0, 0)), (32, (3, 3), (1, 1)),
+                  (32, (3, 3), (1, 1))]],
+                n_channels=256, scale=0.17)
+        # reduction A
+        g.add_layer("redA_pool", SubsamplingLayer(pooling_type="max",
+                                                  kernel=(3, 3), stride=(2, 2)),
+                    x)
+        ra = self._conv_bn(g, "redA_c", x, 384, (3, 3), stride=(2, 2))
+        g.add_vertex("redA", MergeVertex(), "redA_pool", ra)
+        x = "redA"
+        # stage B: block17-style
+        for i in range(self.blocks[1]):
+            x = self._res_block(
+                g, f"b{i}", x,
+                [[(128, (1, 1), (0, 0))],
+                 [(128, (1, 1), (0, 0)), (128, (1, 7), (0, 3)),
+                  (128, (7, 1), (3, 0))]],
+                n_channels=640, scale=0.10)
+        # reduction B
+        g.add_layer("redB_pool", SubsamplingLayer(pooling_type="max",
+                                                  kernel=(3, 3), stride=(2, 2)),
+                    x)
+        rb = self._conv_bn(g, "redB_c", x, 256, (3, 3), stride=(2, 2))
+        g.add_vertex("redB", MergeVertex(), "redB_pool", rb)
+        x = "redB"
+        # stage C: block8-style
+        for i in range(self.blocks[2]):
+            x = self._res_block(
+                g, f"c{i}", x,
+                [[(192, (1, 1), (0, 0))],
+                 [(192, (1, 1), (0, 0)), (192, (1, 3), (0, 1)),
+                  (192, (3, 1), (1, 0))]],
+                n_channels=896, scale=0.20)
+        g.add_layer("gap", GlobalPoolingLayer(pooling_type="avg"), x)
+        g.add_layer("emb", DenseLayer(n_out=self.embedding_size,
+                                      activation="identity"), "gap")
+        g.add_vertex("emb_norm", L2NormalizeVertex(), "emb")
+        g.add_layer("output",
+                    CenterLossOutputLayer(n_out=self.num_classes, loss="mcxent",
+                                          activation="softmax"), "emb_norm")
+        return g.set_outputs("output").build()
+
+
+@register_model
+class FaceNetNN4Small2(InceptionResNetV1):
+    """Compact face-embedding variant (ref: zoo/model/FaceNetNN4Small2.java —
+    nn4.small2 architecture; here realized as a reduced InceptionResNet with
+    96x96 input and the same L2-normalized embedding + center-loss head)."""
+
+    def __init__(self, num_classes: int = 1000, seed: int = 12345, **kw):
+        kw.setdefault("height", 96)
+        kw.setdefault("width", 96)
+        super().__init__(num_classes, seed, blocks_per_stage=(2, 4, 2),
+                         embedding_size=kw.pop("embedding_size", 128), **kw)

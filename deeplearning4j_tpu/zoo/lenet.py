@@ -1,0 +1,38 @@
+"""LeNet (ref: deeplearning4j-zoo/.../zoo/model/LeNet.java — conv5x5(20) →
+maxpool2 → conv5x5(50) → maxpool2 → dense(500,relu) → softmax). The first
+BASELINE config (LeNet MNIST MultiLayerNetwork)."""
+
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer, DenseLayer,
+                                               OutputLayer, SubsamplingLayer)
+from deeplearning4j_tpu.nn.updater import Adam
+from deeplearning4j_tpu.zoo.base import ZooModel, register_model
+
+
+@register_model
+class LeNet(ZooModel):
+    def __init__(self, num_classes: int = 10, seed: int = 12345,
+                 height: int = 28, width: int = 28, channels: int = 1, **kw):
+        super().__init__(num_classes, seed, **kw)
+        self.height, self.width, self.channels = height, width, channels
+
+    def conf(self):
+        return (NeuralNetConfiguration.Builder()
+                .seed(self.seed)
+                .updater(self.kwargs.get("updater", Adam(1e-3)))
+                .weight_init("xavier")
+                .list()
+                .layer(ConvolutionLayer(n_out=20, kernel=(5, 5), stride=(1, 1),
+                                        activation="identity"))
+                .layer(SubsamplingLayer(pooling_type="max", kernel=(2, 2),
+                                        stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=50, kernel=(5, 5), stride=(1, 1),
+                                        activation="identity"))
+                .layer(SubsamplingLayer(pooling_type="max", kernel=(2, 2),
+                                        stride=(2, 2)))
+                .layer(DenseLayer(n_out=500, activation="relu"))
+                .layer(OutputLayer(n_out=self.num_classes, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.convolutional(self.height, self.width,
+                                                        self.channels))
+                .build())

@@ -1,0 +1,92 @@
+"""ResNet50 (ref: zoo/model/ResNet50.java — bottleneck residual blocks as a
+ComputationGraph; conv/identity blocks with BN, ElementWiseVertex(Add) skip
+connections). The BASELINE north-star model.
+
+TPU notes: the whole graph compiles to one XLA program; BN+ReLU fuse into
+the convs; on real runs prefer bf16 params via the network dtype (fp32
+accumulation is XLA's default for bf16 convs on MXU).
+"""
+
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph_conf import ElementWiseVertex
+from deeplearning4j_tpu.nn.conf.layers import (ActivationLayer,
+                                               BatchNormalization,
+                                               ConvolutionLayer, DenseLayer,
+                                               GlobalPoolingLayer, OutputLayer,
+                                               SubsamplingLayer,
+                                               ZeroPaddingLayer)
+from deeplearning4j_tpu.nn.updater import Nesterovs
+from deeplearning4j_tpu.zoo.base import ZooModel, register_model
+
+
+@register_model
+class ResNet50(ZooModel):
+    def __init__(self, num_classes: int = 1000, seed: int = 12345,
+                 height: int = 224, width: int = 224, channels: int = 3, **kw):
+        super().__init__(num_classes, seed, **kw)
+        self.height, self.width, self.channels = height, width, channels
+
+    # -- block builders (ref: ResNet50.java convBlock/identityBlock) --------
+    def _conv_bn(self, g, name, n_out, kernel, stride, pad, inp,
+                 activation="relu"):
+        g.add_layer(f"{name}_conv",
+                    ConvolutionLayer(n_out=n_out, kernel=kernel, stride=stride,
+                                     padding=pad, activation="identity",
+                                     has_bias=False),
+                    inp)
+        g.add_layer(f"{name}_bn", BatchNormalization(), f"{name}_conv")
+        if activation:
+            g.add_layer(f"{name}_act", ActivationLayer(activation=activation),
+                        f"{name}_bn")
+            return f"{name}_act"
+        return f"{name}_bn"
+
+    def _bottleneck(self, g, name, inp, filters, stride=(1, 1), downsample=False):
+        f1, f2, f3 = filters
+        x = self._conv_bn(g, f"{name}_a", f1, (1, 1), stride, (0, 0), inp)
+        x = self._conv_bn(g, f"{name}_b", f2, (3, 3), (1, 1), (1, 1), x)
+        x = self._conv_bn(g, f"{name}_c", f3, (1, 1), (1, 1), (0, 0), x,
+                          activation=None)
+        if downsample:
+            skip = self._conv_bn(g, f"{name}_skip", f3, (1, 1), stride, (0, 0),
+                                 inp, activation=None)
+        else:
+            skip = inp
+        g.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), x, skip)
+        g.add_layer(f"{name}_out", ActivationLayer(activation="relu"),
+                    f"{name}_add")
+        return f"{name}_out"
+
+    def conf(self):
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(self.kwargs.get("updater", Nesterovs(1e-1, momentum=0.9)))
+             .weight_init("relu")
+             .graph_builder()
+             .add_inputs("input")
+             .set_input_types(InputType.convolutional(self.height, self.width,
+                                                      self.channels)))
+        # stem: 7x7/2 conv + BN + relu + 3x3/2 maxpool (ref stem)
+        g.add_layer("stem_pad", ZeroPaddingLayer(padding=(3, 3, 3, 3)), "input")
+        x = self._conv_bn(g, "stem", 64, (7, 7), (2, 2), (0, 0), "stem_pad")
+        g.add_layer("stem_pool",
+                    SubsamplingLayer(pooling_type="max", kernel=(3, 3),
+                                     stride=(2, 2), padding=(1, 1)), x)
+        x = "stem_pool"
+        # stages (ref: 3,4,6,3 bottlenecks)
+        stages = [
+            ("s2", [64, 64, 256], 3, (1, 1)),
+            ("s3", [128, 128, 512], 4, (2, 2)),
+            ("s4", [256, 256, 1024], 6, (2, 2)),
+            ("s5", [512, 512, 2048], 3, (2, 2)),
+        ]
+        for sname, filters, reps, stride in stages:
+            x = self._bottleneck(g, f"{sname}b0", x, filters, stride=stride,
+                                 downsample=True)
+            for r in range(1, reps):
+                x = self._bottleneck(g, f"{sname}b{r}", x, filters)
+        g.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), x)
+        g.add_layer("output",
+                    OutputLayer(n_out=self.num_classes, loss="mcxent",
+                                activation="softmax"), "avgpool")
+        return g.set_outputs("output").build()

@@ -1,0 +1,41 @@
+"""SimpleCNN (ref: zoo/model/SimpleCNN.java — small conv stack with
+batch norm, for quick experiments)."""
+
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (BatchNormalization,
+                                               ConvolutionLayer, DenseLayer,
+                                               OutputLayer, SubsamplingLayer)
+from deeplearning4j_tpu.nn.updater import Adam
+from deeplearning4j_tpu.zoo.base import ZooModel, register_model
+
+
+@register_model
+class SimpleCNN(ZooModel):
+    def __init__(self, num_classes: int = 10, seed: int = 12345,
+                 height: int = 48, width: int = 48, channels: int = 3, **kw):
+        super().__init__(num_classes, seed, **kw)
+        self.height, self.width, self.channels = height, width, channels
+
+    def conf(self):
+        return (NeuralNetConfiguration.Builder()
+                .seed(self.seed)
+                .updater(self.kwargs.get("updater", Adam(1e-3)))
+                .weight_init("relu")
+                .list()
+                .layer(ConvolutionLayer(n_out=16, kernel=(3, 3), padding=(1, 1),
+                                        activation="identity"))
+                .layer(BatchNormalization())
+                .layer(ConvolutionLayer(n_out=16, kernel=(3, 3), padding=(1, 1),
+                                        activation="relu"))
+                .layer(SubsamplingLayer(pooling_type="max", kernel=(2, 2),
+                                        stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=32, kernel=(3, 3), padding=(1, 1),
+                                        activation="relu"))
+                .layer(SubsamplingLayer(pooling_type="max", kernel=(2, 2),
+                                        stride=(2, 2)))
+                .layer(DenseLayer(n_out=128, activation="relu", dropout=0.5))
+                .layer(OutputLayer(n_out=self.num_classes, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.convolutional(self.height, self.width,
+                                                        self.channels))
+                .build())

@@ -1,0 +1,34 @@
+"""TextGenerationLSTM (ref: zoo/model/TextGenerationLSTM.java — stacked
+GravesLSTM character model with softmax-over-vocab output, tBPTT)."""
+
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+from deeplearning4j_tpu.nn.updater import RmsProp
+from deeplearning4j_tpu.zoo.base import ZooModel, register_model
+
+
+@register_model
+class TextGenerationLSTM(ZooModel):
+    def __init__(self, vocab_size: int = 77, seed: int = 12345,
+                 hidden: int = 256, layers: int = 2, max_length: int = 40, **kw):
+        super().__init__(vocab_size, seed, **kw)
+        self.vocab_size = vocab_size
+        self.hidden = hidden
+        self.layers = layers
+        self.max_length = max_length
+
+    def conf(self):
+        b = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(self.kwargs.get("updater", RmsProp(1e-2)))
+             .weight_init("xavier")
+             .gradient_normalization("clipelementwiseabsolutevalue", 1.0)
+             .list())
+        for _ in range(self.layers):
+            b.layer(GravesLSTM(n_out=self.hidden, activation="tanh"))
+        b.layer(RnnOutputLayer(n_out=self.vocab_size, loss="mcxent",
+                               activation="softmax"))
+        return (b.set_input_type(InputType.recurrent(self.vocab_size,
+                                                     self.max_length))
+                .tbptt(self.max_length)
+                .build())

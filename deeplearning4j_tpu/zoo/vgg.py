@@ -1,0 +1,49 @@
+"""VGG16 / VGG19 (ref: zoo/model/VGG16.java, VGG19.java — 3x3 conv blocks
+with 2x2 max pools, two 4096 dense layers, softmax). BASELINE config[1]."""
+
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (ConvolutionLayer, DenseLayer,
+                                               OutputLayer, SubsamplingLayer)
+from deeplearning4j_tpu.nn.updater import Nesterovs
+from deeplearning4j_tpu.zoo.base import ZooModel, register_model
+
+VGG16_BLOCKS = [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]
+VGG19_BLOCKS = [(2, 64), (2, 128), (4, 256), (4, 512), (4, 512)]
+
+
+class _VGG(ZooModel):
+    blocks = VGG16_BLOCKS
+
+    def __init__(self, num_classes: int = 1000, seed: int = 12345,
+                 height: int = 224, width: int = 224, channels: int = 3, **kw):
+        super().__init__(num_classes, seed, **kw)
+        self.height, self.width, self.channels = height, width, channels
+
+    def conf(self):
+        b = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(self.kwargs.get("updater", Nesterovs(1e-2, momentum=0.9)))
+             .weight_init("relu")
+             .list())
+        for n_convs, ch in self.blocks:
+            for _ in range(n_convs):
+                b.layer(ConvolutionLayer(n_out=ch, kernel=(3, 3), stride=(1, 1),
+                                         padding=(1, 1), activation="relu"))
+            b.layer(SubsamplingLayer(pooling_type="max", kernel=(2, 2),
+                                     stride=(2, 2)))
+        b.layer(DenseLayer(n_out=4096, activation="relu", dropout=0.5))
+        b.layer(DenseLayer(n_out=4096, activation="relu", dropout=0.5))
+        b.layer(OutputLayer(n_out=self.num_classes, loss="mcxent",
+                            activation="softmax"))
+        return (b.set_input_type(InputType.convolutional(
+            self.height, self.width, self.channels)).build())
+
+
+@register_model
+class VGG16(_VGG):
+    blocks = VGG16_BLOCKS
+
+
+@register_model
+class VGG19(_VGG):
+    blocks = VGG19_BLOCKS

@@ -1,0 +1,116 @@
+"""Zoo model tests: build, forward-shape, and a small train step for each
+family (ref: deeplearning4j-zoo TestInstantiation)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.zoo import (AlexNet, FaceNetNN4Small2, GoogLeNet,
+                                    InceptionResNetV1, LeNet, ResNet50,
+                                    SimpleCNN, TextGenerationLSTM, VGG16,
+                                    VGG19, get_model)
+
+RNG = np.random.default_rng(0)
+
+
+def onehot(n, k):
+    y = np.zeros((n, k), np.float32)
+    y[np.arange(n), RNG.integers(0, k, n)] = 1.0
+    return y
+
+
+class TestBuild:
+    def test_registry(self):
+        assert get_model("lenet") is LeNet
+        assert get_model("resnet50") is ResNet50
+
+    def test_lenet_shapes_and_count(self):
+        net = LeNet(num_classes=10).init()
+        # param count: conv(1*20*25+20) + conv(20*50*25+50) + dense(800*500+500)
+        # + out(500*10+10) = 431080 (matches the classic LeNet DL4J count)
+        assert net.num_params() == 431080
+        x = RNG.standard_normal((2, 1, 28, 28)).astype(np.float32)
+        out = np.asarray(net.output(x))
+        assert out.shape == (2, 10)
+        np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)
+
+    def test_lenet_trains(self):
+        net = LeNet(num_classes=10).init()
+        x = RNG.standard_normal((16, 1, 28, 28)).astype(np.float32)
+        y = onehot(16, 10)
+        s0 = net.score(DataSet(x, y))
+        net.fit(x, y, epochs=3, batch_size=16)
+        assert net.score(DataSet(x, y)) < s0
+
+    def test_simple_cnn(self):
+        net = SimpleCNN(num_classes=5, height=16, width=16).init()
+        x = RNG.standard_normal((2, 3, 16, 16)).astype(np.float32)
+        assert np.asarray(net.output(x)).shape == (2, 5)
+
+
+class TestBigModels:
+    """Small-input builds of the big models (full-size forward is bench
+    territory, not unit-test territory)."""
+
+    def test_alexnet_builds(self):
+        net = AlexNet(num_classes=10, height=64, width=64).init()
+        x = RNG.standard_normal((1, 3, 64, 64)).astype(np.float32)
+        assert np.asarray(net.output(x)).shape == (1, 10)
+
+    def test_vgg16_structure(self):
+        conf = VGG16(num_classes=10, height=32, width=32).conf()
+        # 13 conv + 5 pool + 2 dense + 1 out
+        assert len(conf.layers) == 21
+        conf19 = VGG19(num_classes=10, height=32, width=32).conf()
+        assert len(conf19.layers) == 24
+
+    def test_resnet50_builds_and_runs(self):
+        net = ResNet50(num_classes=7, height=32, width=32).init()
+        # 16 bottleneck blocks + stem
+        x = RNG.standard_normal((1, 3, 32, 32)).astype(np.float32)
+        out = np.asarray(net.output(x))
+        assert out.shape == (1, 7)
+        np.testing.assert_allclose(out.sum(), 1.0, rtol=1e-4)
+
+    def test_resnet50_full_size_param_count(self):
+        """ResNet50 ImageNet must have ~25.6M params (sanity vs the
+        published architecture the reference implements)."""
+        net = ResNet50(num_classes=1000).init()
+        n = net.num_params()
+        assert 25.0e6 < n < 26.5e6, n
+
+    def test_googlenet_builds(self):
+        net = GoogLeNet(num_classes=6, height=64, width=64).init()
+        x = RNG.standard_normal((1, 3, 64, 64)).astype(np.float32)
+        assert np.asarray(net.output(x)).shape == (1, 6)
+
+    def test_inception_resnet_small(self):
+        net = InceptionResNetV1(num_classes=4, height=96, width=96,
+                                blocks_per_stage=(1, 1, 1)).init()
+        x = RNG.standard_normal((2, 3, 96, 96)).astype(np.float32)
+        out = np.asarray(net.output(x))
+        assert out.shape == (2, 4)
+
+    def test_facenet_small_trains(self):
+        net = FaceNetNN4Small2(num_classes=3).init()  # default 96x96
+        x = RNG.standard_normal((4, 3, 96, 96)).astype(np.float32)
+        y = onehot(4, 3)
+        net.fit(x, y, epochs=1, batch_size=4)
+        assert np.isfinite(net.score_value)
+
+
+class TestTextLSTM:
+    def test_builds_and_trains(self):
+        m = TextGenerationLSTM(vocab_size=20, hidden=16, layers=2, max_length=8)
+        net = m.init()
+        n, v, t = 4, 20, 8
+        x = np.zeros((n, v, t), np.float32)
+        y = np.zeros((n, v, t), np.float32)
+        for i in range(n):
+            for s in range(t):
+                x[i, RNG.integers(0, v), s] = 1.0
+                y[i, RNG.integers(0, v), s] = 1.0
+        net.fit(x, y, epochs=1, batch_size=4)
+        assert np.isfinite(net.score_value)
+        out = np.asarray(net.output(x))
+        assert out.shape == (n, v, t)
