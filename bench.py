@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Benchmark: ResNet50 training throughput (images/sec/chip) on real TPU.
+
+BASELINE.json metric: "ResNet50 ImageNet images/sec/chip; top-1 parity vs
+deeplearning4j-cuda". The reference publishes no numbers (BASELINE.md), so
+vs_baseline is reported against DL4J_CUDA_REF_IMG_S below — a representative
+figure for the reference's cuDNN path on a contemporary GPU (ResNet50/ImageNet
+fwd+bwd, fp32, single card) used as the provisional bar until a measured
+reference number exists.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+# keep the chip's default platform (axon/tpu); fall back to cpu cleanly
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DL4J_CUDA_REF_IMG_S = 200.0  # provisional reference bar (see module docstring)
+
+BATCH = int(os.environ.get("BENCH_BATCH", "64"))
+IMAGE = int(os.environ.get("BENCH_IMAGE", "224"))
+CLASSES = 1000
+WARMUP = int(os.environ.get("BENCH_WARMUP", "3"))
+STEPS = int(os.environ.get("BENCH_STEPS", "10"))
+
+
+def main():
+    from deeplearning4j_tpu.zoo import ResNet50
+    from deeplearning4j_tpu.nn.updater import Nesterovs
+
+    model = ResNet50(num_classes=CLASSES, height=IMAGE, width=IMAGE,
+                     updater=Nesterovs(0.1, momentum=0.9))
+    net = model.init()
+    net.conf.dtype = "bfloat16"  # MXU path, fp32 master params + accum
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((BATCH, 3, IMAGE, IMAGE)).astype(np.float32)
+    y = np.zeros((BATCH, CLASSES), np.float32)
+    y[np.arange(BATCH), rng.integers(0, CLASSES, BATCH)] = 1.0
+
+    step = net._get_train_step(False)
+    inputs = {net.conf.network_inputs[0]: jnp.asarray(x)}
+    labels = {net.conf.network_outputs[0]: jnp.asarray(y)}
+    key = jax.random.PRNGKey(0)
+
+    params, state, upd = net.params, net.state, net.updater_state
+    for _ in range(WARMUP):
+        params, state, upd, loss = step(params, state, upd, inputs, labels,
+                                        key, None, None)
+    jax.block_until_ready(params)
+
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        params, state, upd, loss = step(params, state, upd, inputs, labels,
+                                        key, None, None)
+    jax.block_until_ready(params)
+    dt = time.perf_counter() - t0
+
+    img_s = BATCH * STEPS / dt
+    print(json.dumps({
+        "metric": "ResNet50 ImageNet train images/sec/chip (bf16 compute)",
+        "value": round(img_s, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_s / DL4J_CUDA_REF_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

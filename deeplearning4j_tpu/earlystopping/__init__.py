@@ -1,0 +1,17 @@
+from deeplearning4j_tpu.earlystopping.core import (  # noqa: F401
+    EarlyStoppingConfiguration,
+    EarlyStoppingResult,
+    EarlyStoppingTrainer,
+    # termination conditions
+    MaxEpochsTerminationCondition,
+    MaxTimeTerminationCondition,
+    MaxScoreTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+    InvalidScoreTerminationCondition,
+    # savers
+    InMemoryModelSaver,
+    LocalFileModelSaver,
+    # score calculators
+    DataSetLossCalculator,
+    ClassificationScoreCalculator,
+)

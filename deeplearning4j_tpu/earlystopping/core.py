@@ -1,0 +1,290 @@
+"""Early stopping.
+
+TPU-native equivalent of deeplearning4j-nn/.../earlystopping/*:
+EarlyStoppingConfiguration, trainer/BaseEarlyStoppingTrainer.java:76-196
+(epoch loop :100, saveBestModel :196), saver/ (LocalFile/InMemory),
+scorecalc/ (DataSetLossCalculator), termination/ (MaxEpochs,
+ScoreImprovementEpochs, MaxTime, MaxScore, InvalidScore).
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# termination conditions (ref: earlystopping/termination/*)
+# ---------------------------------------------------------------------------
+
+
+class EpochTerminationCondition:
+    def initialize(self):
+        pass
+
+    def terminate(self, epoch: int, score: float) -> bool:
+        raise NotImplementedError
+
+
+class IterationTerminationCondition:
+    def initialize(self):
+        pass
+
+    def terminate(self, iteration: int, score: float) -> bool:
+        raise NotImplementedError
+
+
+class MaxEpochsTerminationCondition(EpochTerminationCondition):
+    def __init__(self, max_epochs: int):
+        self.max_epochs = max_epochs
+
+    def terminate(self, epoch, score):
+        return epoch + 1 >= self.max_epochs
+
+
+class ScoreImprovementEpochTerminationCondition(EpochTerminationCondition):
+    """Stop after N epochs without improvement (ref:
+    ScoreImprovementEpochTerminationCondition.java)."""
+
+    def __init__(self, max_epochs_without_improvement: int, min_improvement: float = 0.0):
+        self.max_no_improve = max_epochs_without_improvement
+        self.min_improvement = min_improvement
+        self.best = None
+        self.since = 0
+
+    def initialize(self):
+        self.best = None
+        self.since = 0
+
+    def terminate(self, epoch, score):
+        if self.best is None or self.best - score > self.min_improvement:
+            self.best = score
+            self.since = 0
+            return False
+        self.since += 1
+        return self.since > self.max_no_improve
+
+
+class MaxTimeTerminationCondition(IterationTerminationCondition,
+                                  EpochTerminationCondition):
+    def __init__(self, max_seconds: float):
+        self.max_seconds = max_seconds
+        self.start = None
+
+    def initialize(self):
+        self.start = time.time()
+
+    def terminate(self, _i, _s):
+        return (time.time() - self.start) > self.max_seconds
+
+
+class MaxScoreTerminationCondition(IterationTerminationCondition,
+                                   EpochTerminationCondition):
+    """Abort if score exceeds a bound (divergence guard)."""
+
+    def __init__(self, max_score: float):
+        self.max_score = max_score
+
+    def terminate(self, _i, score):
+        return score > self.max_score
+
+
+class InvalidScoreTerminationCondition(IterationTerminationCondition,
+                                       EpochTerminationCondition):
+    def terminate(self, _i, score):
+        return not np.isfinite(score)
+
+
+# ---------------------------------------------------------------------------
+# model savers (ref: earlystopping/saver/*)
+# ---------------------------------------------------------------------------
+
+
+class InMemoryModelSaver:
+    def __init__(self):
+        self.best = None
+        self.latest = None
+
+    def save_best(self, model, score):
+        self.best = (copy_model(model), score)
+
+    def save_latest(self, model, score):
+        self.latest = (copy_model(model), score)
+
+    def get_best(self):
+        return self.best[0] if self.best else None
+
+    def get_latest(self):
+        return self.latest[0] if self.latest else None
+
+
+class LocalFileModelSaver:
+    """Persist best/latest checkpoints to a directory
+    (ref: LocalFileModelSaver.java)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, name):
+        return os.path.join(self.directory, name)
+
+    def save_best(self, model, score):
+        from deeplearning4j_tpu.util.model_serializer import write_model
+        write_model(model, self._path("bestModel.zip"))
+
+    def save_latest(self, model, score):
+        from deeplearning4j_tpu.util.model_serializer import write_model
+        write_model(model, self._path("latestModel.zip"))
+
+    def get_best(self):
+        from deeplearning4j_tpu.util.model_serializer import restore_model
+        p = self._path("bestModel.zip")
+        return restore_model(p) if os.path.exists(p) else None
+
+    def get_latest(self):
+        from deeplearning4j_tpu.util.model_serializer import restore_model
+        p = self._path("latestModel.zip")
+        return restore_model(p) if os.path.exists(p) else None
+
+
+def copy_model(model):
+    """Deep-copy a network's learned arrays (host-side snapshot)."""
+    import jax
+    m2 = copy.copy(model)
+    m2.params = jax.tree_util.tree_map(np.asarray, model.params)
+    m2.state = jax.tree_util.tree_map(np.asarray, model.state)
+    return m2
+
+
+# ---------------------------------------------------------------------------
+# score calculators (ref: earlystopping/scorecalc/*)
+# ---------------------------------------------------------------------------
+
+
+class DataSetLossCalculator:
+    """Average loss over a validation iterator (ref: DataSetLossCalculator.java)."""
+
+    def __init__(self, iterator, average: bool = True):
+        self.iterator = iterator
+        self.average = average
+
+    def calculate_score(self, model) -> float:
+        total, n = 0.0, 0
+        for ds in self.iterator:
+            total += model.score(ds) * ds.num_examples()
+            n += ds.num_examples()
+        return total / n if (self.average and n) else total
+
+
+class ClassificationScoreCalculator:
+    """1 - accuracy so that lower is better (ref: ClassificationScoreCalculator)."""
+
+    def __init__(self, iterator):
+        self.iterator = iterator
+
+    def calculate_score(self, model) -> float:
+        e = model.evaluate(self.iterator)
+        return 1.0 - e.accuracy()
+
+
+# ---------------------------------------------------------------------------
+# configuration + trainer (ref: EarlyStoppingConfiguration / BaseEarlyStoppingTrainer)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class EarlyStoppingConfiguration:
+    epoch_termination_conditions: List[EpochTerminationCondition] = field(
+        default_factory=list)
+    iteration_termination_conditions: List[IterationTerminationCondition] = field(
+        default_factory=list)
+    score_calculator: Any = None
+    model_saver: Any = field(default_factory=InMemoryModelSaver)
+    save_last_model: bool = False
+    evaluate_every_n_epochs: int = 1
+
+
+@dataclass
+class EarlyStoppingResult:
+    termination_reason: str
+    termination_details: str
+    total_epochs: int
+    best_model_epoch: int
+    best_model_score: float
+    score_vs_epoch: dict
+    best_model: Any
+
+
+class EarlyStoppingTrainer:
+    """Epoch loop with termination checks (ref: BaseEarlyStoppingTrainer.fit
+    :100)."""
+
+    def __init__(self, config: EarlyStoppingConfiguration, model, train_iterator):
+        self.config = config
+        self.model = model
+        self.train_iterator = train_iterator
+
+    def fit(self) -> EarlyStoppingResult:
+        cfg = self.config
+        for c in cfg.epoch_termination_conditions:
+            c.initialize()
+        for c in cfg.iteration_termination_conditions:
+            c.initialize()
+        best_score, best_epoch = None, -1
+        scores = {}
+        epoch = 0
+        reason, details = "MaxEpochs", ""
+        while True:
+            # one epoch of training with per-iteration checks
+            aborted = False
+            for ds in self.train_iterator:
+                self.model._fit_batch(ds) if hasattr(self.model, "_fit_batch") \
+                    else self.model.fit(ds)
+                s = self.model.score_value
+                for c in cfg.iteration_termination_conditions:
+                    if c.terminate(self.model.iteration_count, s):
+                        reason = "IterationTerminationCondition"
+                        details = type(c).__name__
+                        aborted = True
+                        break
+                if aborted:
+                    break
+            if aborted:
+                break
+            # score on validation
+            if cfg.score_calculator is not None and \
+                    epoch % cfg.evaluate_every_n_epochs == 0:
+                score = cfg.score_calculator.calculate_score(self.model)
+            else:
+                score = self.model.score_value
+            scores[epoch] = score
+            if best_score is None or score < best_score:
+                best_score, best_epoch = score, epoch
+                cfg.model_saver.save_best(self.model, score)
+            if cfg.save_last_model:
+                cfg.model_saver.save_latest(self.model, score)
+            term = False
+            for c in cfg.epoch_termination_conditions:
+                if c.terminate(epoch, score):
+                    reason = "EpochTerminationCondition"
+                    details = type(c).__name__
+                    term = True
+                    break
+            if term:
+                break
+            epoch += 1
+        return EarlyStoppingResult(
+            termination_reason=reason,
+            termination_details=details,
+            total_epochs=epoch + 1,
+            best_model_epoch=best_epoch,
+            best_model_score=best_score if best_score is not None else float("nan"),
+            score_vs_epoch=scores,
+            best_model=cfg.model_saver.get_best(),
+        )

@@ -8,6 +8,8 @@ ComputationGraphConfiguration.java).
 
 from deeplearning4j_tpu.nn.conf.inputs import InputType  # noqa: F401
 from deeplearning4j_tpu.nn.conf.layers import *  # noqa: F401,F403
+from deeplearning4j_tpu.nn.conf.variational import VariationalAutoencoder  # noqa: F401
+from deeplearning4j_tpu.nn.conf.objdetect import Yolo2OutputLayer  # noqa: F401
 from deeplearning4j_tpu.nn.conf.network import (  # noqa: F401
     NeuralNetConfiguration,
     MultiLayerConfiguration,

@@ -572,9 +572,15 @@ class BatchNormalization(FeedForwardLayerConf):
         gamma = params.get("gamma", jnp.full((nf,), self.gamma, x.dtype))
         beta = params.get("beta", jnp.full((nf,), self.beta, x.dtype))
         y, new_mean, new_var = _norm.batch_norm(
-            x, gamma, beta, state["mean"], state["var"], train, self.eps, self.decay
+            x, gamma.astype(x.dtype), beta.astype(x.dtype),
+            state["mean"].astype(x.dtype), state["var"].astype(x.dtype),
+            train, self.eps, self.decay
         )
-        new_state = {"mean": new_mean, "var": new_var} if train else state
+        if train:  # running stats kept in fp32 regardless of compute dtype
+            new_state = {"mean": new_mean.astype(jnp.float32),
+                         "var": new_var.astype(jnp.float32)}
+        else:
+            new_state = state
         return _act.get(self.activation)(y), new_state
 
 

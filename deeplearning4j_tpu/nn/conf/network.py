@@ -366,6 +366,7 @@ class ComputationGraphConfiguration:
     tbptt_back_length: int = 20
     gradient_normalization: Optional[str] = None
     gradient_normalization_threshold: float = 1.0
+    dtype: str = "float32"
 
     def topological_order(self) -> List[str]:
         """Kahn topo sort (ref: ComputationGraph.topologicalSortOrder :1190)."""
@@ -404,6 +405,7 @@ class ComputationGraphConfiguration:
             "tbptt_back_length": self.tbptt_back_length,
             "gradient_normalization": self.gradient_normalization,
             "gradient_normalization_threshold": self.gradient_normalization_threshold,
+            "dtype": self.dtype,
         }
 
     def to_json(self) -> str:
@@ -425,6 +427,7 @@ class ComputationGraphConfiguration:
             tbptt_back_length=d.get("tbptt_back_length", 20),
             gradient_normalization=d.get("gradient_normalization"),
             gradient_normalization_threshold=d.get("gradient_normalization_threshold", 1.0),
+            dtype=d.get("dtype", "float32"),
         )
 
     @staticmethod

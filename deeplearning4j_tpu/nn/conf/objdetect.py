@@ -1,0 +1,200 @@
+"""YOLOv2 object detection output layer + NMS utilities.
+
+TPU-native equivalent of nn/conf/layers/objdetect/Yolo2OutputLayer (config)
++ nn/layers/objdetect/Yolo2OutputLayer.java (714 LoC: YOLOv2 loss,
+DetectedObject extraction, NMS). The reference hand-writes the loss gradient;
+here the loss is a pure function over the [N, B*(5+C), H, W] activation grid
+and jax.grad differentiates it.
+
+Label format (matching the reference): [N, 4+C, H, W] where channels 0-3 are
+the object bounding box (x1,y1,x2,y2) in GRID units for the cell responsible,
+and 4..4+C is the one-hot class, zero elsewhere; an object mask is derived
+from the class channels.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import LayerConf, register_layer
+
+
+@register_layer
+@dataclass
+class Yolo2OutputLayer(LayerConf):
+    """YOLOv2 loss head (ref: conf/layers/objdetect/Yolo2OutputLayer.java
+    Builder: lambdaCoord=5, lambdaNoObj=0.5, boundingBoxPriors)."""
+
+    anchors: Sequence[Sequence[float]] = ((1.0, 1.0),)  # [B, 2] grid units
+    lambda_coord: float = 5.0
+    lambda_no_obj: float = 0.5
+    num_classes: Optional[int] = None
+
+    def output_type(self, it):
+        return it
+
+    def _split(self, x, n_boxes, n_cls):
+        """x: [N, B*(5+C), H, W] -> xy, wh, conf, cls predictions."""
+        n, _, h, w = x.shape
+        x = x.reshape(n, n_boxes, 5 + n_cls, h, w)
+        txy = x[:, :, 0:2]
+        twh = x[:, :, 2:4]
+        tconf = x[:, :, 4]
+        tcls = x[:, :, 5:]
+        return txy, twh, tconf, tcls
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        return x, state
+
+    def activate_predictions(self, x):
+        """Raw activations -> (xy in cell, wh in grid units, confidence,
+        class probs) (ref: YoloUtils.activate)."""
+        anchors = jnp.asarray(self.anchors)
+        b = anchors.shape[0]
+        n, c, h, w = x.shape
+        n_cls = c // b - 5
+        txy, twh, tconf, tcls = self._split(x, b, n_cls)
+        xy = jax.nn.sigmoid(txy)
+        wh = jnp.exp(jnp.clip(twh, -10, 6)) * anchors[None, :, :, None, None]
+        conf = jax.nn.sigmoid(tconf)
+        cls = jax.nn.softmax(tcls, axis=2)
+        return xy, wh, conf, cls
+
+    def compute_score(self, labels, preout, mask=None):
+        """YOLOv2 loss (ref: Yolo2OutputLayer.computeLoss): squared-error on
+        xy/sqrt(wh) for responsible boxes (λcoord), confidence loss with IOU
+        targets, λnoobj elsewhere, squared-error class loss."""
+        anchors = jnp.asarray(self.anchors, preout.dtype)
+        b = anchors.shape[0]
+        n, c, h, w = preout.shape
+        n_cls = c // b - 5
+        xy, wh, conf, cls = self.activate_predictions(preout)
+
+        lab_box = labels[:, 0:4]  # x1,y1,x2,y2 grid units
+        lab_cls = labels[:, 4:]
+        obj_mask = (jnp.sum(lab_cls, axis=1) > 0).astype(preout.dtype)  # [N,H,W]
+
+        # ground-truth center/size in grid units
+        gt_cx = 0.5 * (lab_box[:, 0] + lab_box[:, 2])
+        gt_cy = 0.5 * (lab_box[:, 1] + lab_box[:, 3])
+        gt_w = jnp.clip(lab_box[:, 2] - lab_box[:, 0], 1e-6, None)
+        gt_h = jnp.clip(lab_box[:, 3] - lab_box[:, 1], 1e-6, None)
+        # offset within responsible cell
+        gt_tx = gt_cx - jnp.floor(gt_cx)
+        gt_ty = gt_cy - jnp.floor(gt_cy)
+
+        # IOU of each anchor box prediction vs ground truth (shape [N,B,H,W])
+        pw, ph_ = wh[:, :, 0], wh[:, :, 1]
+        inter_w = jnp.minimum(pw, gt_w[:, None])
+        inter_h = jnp.minimum(ph_, gt_h[:, None])
+        inter = inter_w * inter_h
+        union = pw * ph_ + (gt_w * gt_h)[:, None] - inter
+        iou = inter / jnp.clip(union, 1e-6, None)
+
+        # responsible anchor = argmax IOU per cell (stop-grad, like the ref's
+        # discrete assignment)
+        best = jax.lax.stop_gradient(jnp.argmax(iou, axis=1))  # [N,H,W]
+        resp = jax.nn.one_hot(best, b, dtype=preout.dtype,
+                              axis=1) * obj_mask[:, None]  # [N,B,H,W]
+
+        # coordinate loss
+        dxy = (xy[:, :, 0] - gt_tx[:, None]) ** 2 + (xy[:, :, 1] - gt_ty[:, None]) ** 2
+        dwh = (jnp.sqrt(jnp.clip(wh[:, :, 0], 1e-6, None)) -
+               jnp.sqrt(gt_w)[:, None]) ** 2 + \
+              (jnp.sqrt(jnp.clip(wh[:, :, 1], 1e-6, None)) -
+               jnp.sqrt(gt_h)[:, None]) ** 2
+        coord_loss = self.lambda_coord * jnp.sum(resp * (dxy + dwh))
+
+        # confidence loss: target IOU for responsible, 0 for the rest
+        conf_target = jax.lax.stop_gradient(iou)
+        conf_loss = jnp.sum(resp * (conf - conf_target) ** 2) + \
+            self.lambda_no_obj * jnp.sum((1.0 - resp) * conf ** 2)
+
+        # class loss over responsible cells
+        cls_err = jnp.sum((cls - lab_cls[:, None]) ** 2, axis=2)  # [N,B,H,W]
+        cls_loss = jnp.sum(resp * cls_err)
+
+        return (coord_loss + conf_loss + cls_loss) / n
+
+    # convenience: output layers elsewhere expose preout
+    def preout(self, params, x, *, train=False, rng=None):
+        return x
+
+
+@dataclass
+class DetectedObject:
+    """One detection (ref: nn/layers/objdetect/DetectedObject.java)."""
+
+    example: int
+    center_x: float
+    center_y: float
+    width: float
+    height: float
+    predicted_class: int
+    confidence: float
+
+    def top_left(self):
+        return (self.center_x - self.width / 2, self.center_y - self.height / 2)
+
+    def bottom_right(self):
+        return (self.center_x + self.width / 2, self.center_y + self.height / 2)
+
+
+def get_predicted_objects(layer: Yolo2OutputLayer, preout,
+                          threshold: float = 0.5) -> List[DetectedObject]:
+    """Extract detections above a confidence threshold
+    (ref: Yolo2OutputLayer.getPredictedObjects)."""
+    xy, wh, conf, cls = layer.activate_predictions(jnp.asarray(preout))
+    xy, wh, conf, cls = (np.asarray(a) for a in (xy, wh, conf, cls))
+    n, b, _, h, w = xy.shape
+    out: List[DetectedObject] = []
+    cell_x = np.arange(w)[None, None, None, :]
+    cell_y = np.arange(h)[None, None, :, None]
+    score = conf * cls.max(axis=2)
+    for i, bi, yi, xi in zip(*np.where(score > threshold)):
+        out.append(DetectedObject(
+            example=int(i),
+            center_x=float(xy[i, bi, 0, yi, xi] + xi),
+            center_y=float(xy[i, bi, 1, yi, xi] + yi),
+            width=float(wh[i, bi, 0, yi, xi]),
+            height=float(wh[i, bi, 1, yi, xi]),
+            predicted_class=int(cls[i, bi, :, yi, xi].argmax()),
+            confidence=float(conf[i, bi, yi, xi]),
+        ))
+    return out
+
+
+def non_max_suppression(objs: List[DetectedObject],
+                        iou_threshold: float = 0.45) -> List[DetectedObject]:
+    """Greedy NMS (ref: YoloUtils.nms)."""
+    objs = sorted(objs, key=lambda o: -o.confidence)
+    keep: List[DetectedObject] = []
+    for o in objs:
+        ok = True
+        for k in keep:
+            if k.example != o.example or k.predicted_class != o.predicted_class:
+                continue
+            if _iou(o, k) > iou_threshold:
+                ok = False
+                break
+        if ok:
+            keep.append(o)
+    return keep
+
+
+def _iou(a: DetectedObject, b: DetectedObject) -> float:
+    ax1, ay1 = a.top_left()
+    ax2, ay2 = a.bottom_right()
+    bx1, by1 = b.top_left()
+    bx2, by2 = b.bottom_right()
+    iw = max(0.0, min(ax2, bx2) - max(ax1, bx1))
+    ih = max(0.0, min(ay2, by2) - max(ay1, by1))
+    inter = iw * ih
+    union = (ax2 - ax1) * (ay2 - ay1) + (bx2 - bx1) * (by2 - by1) - inter
+    return inter / union if union > 0 else 0.0

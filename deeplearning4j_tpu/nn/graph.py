@@ -111,7 +111,7 @@ class ComputationGraph:
                 v_state = {k: val for k, val in v_state.items() if k not in ("h", "c")}
             rng_i = jax.random.fold_in(rng, i) if rng is not None else None
             if preout_of == name and isinstance(v, LayerVertex) and \
-                    isinstance(v.layer, BaseOutputLayerConf):
+                    hasattr(v.layer, "compute_score"):
                 x = xs[0]
                 if v.preprocessor is not None:
                     x = v.preprocessor.apply(x, mask)
@@ -137,6 +137,11 @@ class ComputationGraph:
     def _loss(self, params, state, inputs, labels: Dict[str, Any], rng,
               fmasks, lmasks, *, train=True, carry_rnn=False):
         """Sum of output-layer losses + regularization."""
+        if self.conf.dtype in ("bfloat16", "bf16"):
+            cast = lambda a: a.astype(jnp.bfloat16) \
+                if jnp.issubdtype(a.dtype, jnp.floating) else a
+            params = jax.tree_util.tree_map(cast, params)
+            inputs = {k: cast(v) for k, v in inputs.items()}
         # find features feeding each output layer by running forward with preout
         total = 0.0
         new_state = state
@@ -146,7 +151,7 @@ class ComputationGraph:
                 carry_rnn=carry_rnn, preout_of=out_name)
             v = self.conf.vertices[out_name]
             if not (isinstance(v, LayerVertex) and
-                    isinstance(v.layer, BaseOutputLayerConf)):
+                    hasattr(v.layer, "compute_score")):
                 raise ValueError(f"output vertex {out_name} is not an output layer")
             y = labels[out_name]
             lmask = (lmasks or {}).get(out_name)
@@ -154,7 +159,8 @@ class ComputationGraph:
                 ins = self.conf.vertex_inputs[out_name]
                 lmask = next((masks.get(i_) for i_ in ins if masks.get(i_) is not None),
                              None)
-            total = total + v.layer.compute_score(y, acts[out_name], lmask)
+            total = total + v.layer.compute_score(
+                y, acts[out_name].astype(jnp.float32), lmask)
             if isinstance(v.layer, CenterLossOutputLayer):
                 ins = self.conf.vertex_inputs[out_name]
                 feats = acts[ins[0]]

@@ -137,10 +137,23 @@ class MultiLayerNetwork:
             new_state[str(i)] = state.get(str(i), {})
         return acts, new_state
 
+    def _cast_compute(self, params, x):
+        """Mixed precision: when conf.dtype is bfloat16, run forward in bf16
+        (master params stay fp32 — grads flow back through the cast). On TPU
+        this keeps matmuls/convs on the MXU bf16 path with fp32 accumulation
+        (XLA default), the same fp16-compute policy the reference's cuDNN
+        helpers select (BaseCudnnHelper dataType)."""
+        if self.conf.dtype in ("bfloat16", "bf16"):
+            cast = lambda a: a.astype(jnp.bfloat16) \
+                if jnp.issubdtype(a.dtype, jnp.floating) else a
+            return jax.tree_util.tree_map(cast, params), cast(x)
+        return params, x
+
     def _loss(self, params, state, x, y, rng, fmask, lmask, *, train=True,
               carry_rnn=False):
         """Scalar loss (data loss + L1/L2) and new state
         (ref: computeGradientAndScore :2206 + calcL1/L2 terms)."""
+        params, x = self._cast_compute(params, x)
         out_idx = len(self.layers) - 1
         out_layer = self.layers[out_idx]
         acts, new_state = self._forward(params, state, x, train=train, rng=rng,
@@ -152,9 +165,10 @@ class MultiLayerNetwork:
         if pre is not None:
             h = pre.apply(h, fmask)
         rng_o = jax.random.fold_in(rng, out_idx) if rng is not None else None
-        if not isinstance(out_layer, BaseOutputLayerConf):
+        if not hasattr(out_layer, "compute_score"):
             raise ValueError("last layer must be an output layer to compute loss")
         preout = out_layer.preout(params[str(out_idx)], h, train=train, rng=rng_o)
+        preout = preout.astype(jnp.float32)  # loss in fp32 under mixed precision
         score = out_layer.compute_score(y, preout, mask)
         o_state = state.get(str(out_idx), {})
         if isinstance(out_layer, CenterLossOutputLayer):
@@ -426,6 +440,6 @@ class MultiLayerNetwork:
         net = MultiLayerNetwork(MultiLayerConfiguration.from_dict(self.conf.to_dict()))
         if self._initialized:
             net.init()
-            net.params = jax.tree_util.tree_map(lambda a: a, self.params)
-            net.state = jax.tree_util.tree_map(lambda a: a, self.state)
+            net.params = jax.tree_util.tree_map(lambda a: jnp.array(a), self.params)
+            net.state = jax.tree_util.tree_map(lambda a: jnp.array(a), self.state)
         return net

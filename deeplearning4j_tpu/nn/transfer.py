@@ -1,0 +1,198 @@
+"""Transfer learning: surgery on trained networks.
+
+TPU-native equivalent of nn/transferlearning/TransferLearning.java (Builder
+:59: fineTuneConfiguration :73, setFeatureExtractor :84 freeze, nOutReplace
+:98-175, add/remove layers), FineTuneConfiguration, and
+TransferLearningHelper (featurize + fit the unfrozen tail).
+
+Params are pytrees, so surgery = structural edits on (conf, params) pairs —
+no flat-view re-slicing like the reference.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.layers import FrozenLayer, LayerConf, layer_from_dict, layer_to_dict
+from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+@dataclass
+class FineTuneConfiguration:
+    """Overrides applied to every non-frozen layer (ref:
+    FineTuneConfiguration.java)."""
+
+    updater: Any = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    dropout: Optional[float] = None
+    seed: Optional[int] = None
+
+    def apply(self, conf: MultiLayerConfiguration):
+        if self.updater is not None:
+            conf.updater = self.updater
+        if self.seed is not None:
+            conf.seed = self.seed
+        for layer in conf.layers:
+            if isinstance(layer, FrozenLayer):
+                continue
+            for f in ("l1", "l2", "dropout"):
+                v = getattr(self, f)
+                if v is not None and hasattr(layer, f):
+                    setattr(layer, f, v)
+
+
+class TransferLearning:
+    """Namespace matching the reference entry point."""
+
+    class Builder:
+        def __init__(self, net: MultiLayerNetwork):
+            self._net = net
+            self._conf = MultiLayerConfiguration.from_dict(net.conf.to_dict())
+            # materialize copies: the source net's buffers get donated by its
+            # own train steps, so sharing references would alias deleted arrays
+            self._params = jax.tree_util.tree_map(lambda a: jax.numpy.array(a),
+                                                  net.params)
+            self._state = jax.tree_util.tree_map(lambda a: jax.numpy.array(a),
+                                                 net.state)
+            self._freeze_upto: Optional[int] = None
+            self._fine_tune: Optional[FineTuneConfiguration] = None
+            self._nout_replace: Dict[int, tuple] = {}
+            self._remove_from: Optional[int] = None
+            self._appended: List[LayerConf] = []
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._fine_tune = ftc
+            return self
+
+        def set_feature_extractor(self, layer_index: int):
+            """Freeze layers [0..layer_index] (ref: setFeatureExtractor :84)."""
+            self._freeze_upto = layer_index
+            return self
+
+        def n_out_replace(self, layer_index: int, n_out: int,
+                          weight_init: str = "xavier"):
+            """Replace a layer's output width, re-initializing it and the
+            next layer's n_in (ref: nOutReplace :98-175)."""
+            self._nout_replace[layer_index] = (n_out, weight_init)
+            return self
+
+        def remove_layers_from_output(self, n: int):
+            """Remove the last n layers (ref: removeLayersFromOutput)."""
+            self._remove_from = len(self._conf.layers) - n
+            return self
+
+        def remove_output_layer(self):
+            return self.remove_layers_from_output(1)
+
+        def add_layer(self, layer: LayerConf):
+            self._appended.append(layer)
+            return self
+
+        def build(self) -> MultiLayerNetwork:
+            conf = self._conf
+            params = dict(self._params)
+            state = dict(self._state)
+
+            # 1. remove tail layers
+            if self._remove_from is not None:
+                for i in range(self._remove_from, len(conf.layers)):
+                    params.pop(str(i), None)
+                    state.pop(str(i), None)
+                conf.layers = conf.layers[: self._remove_from]
+                conf.preprocessors = {k: v for k, v in conf.preprocessors.items()
+                                      if k < self._remove_from}
+
+            # 2. append new layers
+            n0 = len(conf.layers)
+            conf.layers.extend(self._appended)
+
+            # 3. nOut replacement (re-init changed layers + downstream n_in)
+            reinit = set(range(n0, len(conf.layers)))
+            for idx, (n_out, w_init) in self._nout_replace.items():
+                layer = conf.layers[idx]
+                layer.n_out = n_out
+                layer.weight_init = w_init
+                reinit.add(idx)
+                if idx + 1 < len(conf.layers):
+                    nxt = conf.layers[idx + 1]
+                    if hasattr(nxt, "n_in"):
+                        nxt.n_in = None  # re-infer
+                        reinit.add(idx + 1)
+
+            # 4. freeze prefix
+            if self._freeze_upto is not None:
+                for i in range(self._freeze_upto + 1):
+                    if not isinstance(conf.layers[i], FrozenLayer):
+                        conf.layers[i] = FrozenLayer(inner=conf.layers[i])
+
+            # 5. fine-tune overrides
+            if self._fine_tune is not None:
+                self._fine_tune.apply(conf)
+
+            # 6. build net; re-init params for changed layers, keep the rest
+            from deeplearning4j_tpu.nn.conf.network import _infer_shapes_and_preprocessors
+            net = MultiLayerNetwork(conf)
+            net.init()
+            for i in range(len(conf.layers)):
+                k = str(i)
+                if i not in reinit and k in params:
+                    net.params[k] = params[k]
+                    if k in state and state[k]:
+                        net.state[k] = state[k]
+            net.updater_state = conf.updater.init_state(net.params)
+            return net
+
+
+class TransferLearningHelper:
+    """Featurize-then-train on the unfrozen tail (ref:
+    TransferLearningHelper.java). The frozen prefix runs once per batch
+    (inference-only), the tail trains on cached features — the same split the
+    reference uses to avoid recomputing the frozen body."""
+
+    def __init__(self, net: MultiLayerNetwork, frozen_until: int):
+        self.full_net = net
+        self.frozen_until = frozen_until
+        # tail network over the remaining layers
+        tail_conf = MultiLayerConfiguration.from_dict(net.conf.to_dict())
+        tail_conf.layers = tail_conf.layers[frozen_until + 1:]
+        tail_conf.preprocessors = {
+            k - (frozen_until + 1): v for k, v in net.conf.preprocessors.items()
+            if k > frozen_until}
+        its = net.conf.layer_input_types()
+        tail_conf.input_type = net.conf.layers[frozen_until].output_type(
+            its[frozen_until])
+        self.tail = MultiLayerNetwork(tail_conf)
+        self.tail.init()
+        for i in range(frozen_until + 1, len(net.conf.layers)):
+            self.tail.params[str(i - frozen_until - 1)] = net.params[str(i)]
+            self.tail.state[str(i - frozen_until - 1)] = net.state[str(i)]
+        self.tail.updater_state = tail_conf.updater.init_state(self.tail.params)
+
+    def featurize(self, ds):
+        """Run the frozen prefix (ref: TransferLearningHelper.featurize)."""
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        acts, _ = self.full_net._forward(
+            self.full_net.params, self.full_net.state, jnp.asarray(ds.features),
+            train=False, rng=None, upto=self.frozen_until + 1)
+        return DataSet(np.asarray(acts[-1]), ds.labels)
+
+    def fit_featurized(self, ds, epochs: int = 1, batch_size: int = 32):
+        self.tail.fit(ds.features, ds.labels, epochs=epochs,
+                      batch_size=batch_size)
+        # write tail params back into the full net
+        for i in range(self.frozen_until + 1, len(self.full_net.conf.layers)):
+            self.full_net.params[str(i)] = self.tail.params[str(i - self.frozen_until - 1)]
+
+    def output_from_featurized(self, features):
+        return self.tail.output(features)
+
+    def unfrozen_network(self):
+        return self.tail
