@@ -1,0 +1,264 @@
+"""Keras HDF5 import tests (ref: deeplearning4j-modelimport test suites).
+
+Fixtures are hand-written HDF5 files in the Keras 2 on-disk format
+(model_config attr + model_weights groups); expected outputs are computed
+with an independent pure-numpy channels_last reference implementation, so
+these tests validate the importer's layout conversions (HWIO→OIHW kernels,
+HWC→CHW flatten permutation, gate ordering) end to end.
+"""
+
+import json
+import os
+import tempfile
+
+import h5py
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport import KerasModelImport
+
+RNG = np.random.default_rng(3)
+
+
+# ---------------------------------------------------------------------------
+# independent numpy NHWC reference ops
+# ---------------------------------------------------------------------------
+
+def conv2d_nhwc(x, k, b, stride=1):
+    n, h, w, cin = x.shape
+    kh, kw, _, cout = k.shape
+    oh = (h - kh) // stride + 1
+    ow = (w - kw) // stride + 1
+    out = np.zeros((n, oh, ow, cout))
+    for i in range(oh):
+        for j in range(ow):
+            patch = x[:, i * stride:i * stride + kh, j * stride:j * stride + kw, :]
+            out[:, i, j, :] = np.tensordot(patch, k, axes=([1, 2, 3], [0, 1, 2]))
+    return out + b
+
+
+def maxpool_nhwc(x, size=2):
+    n, h, w, c = x.shape
+    oh, ow = h // size, w // size
+    out = np.zeros((n, oh, ow, c))
+    for i in range(oh):
+        for j in range(ow):
+            out[:, i, j] = x[:, i * size:(i + 1) * size,
+                             j * size:(j + 1) * size].max(axis=(1, 2))
+    return out
+
+
+def softmax(z):
+    e = np.exp(z - z.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+# ---------------------------------------------------------------------------
+# fixture writer: minimal Keras-2-format h5
+# ---------------------------------------------------------------------------
+
+def write_keras_h5(path, model_config: dict, weights: dict):
+    """weights: {layer_name: [(weight_name, array), ...]}"""
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(model_config)
+        f.attrs["keras_version"] = "2.3.1"
+        mw = f.create_group("model_weights")
+        mw.attrs["layer_names"] = np.array([n.encode() for n in weights])
+        for lname, ws in weights.items():
+            g = mw.create_group(lname)
+            g.attrs["weight_names"] = np.array(
+                [f"{lname}/{wn}".encode() for wn, _ in ws])
+            for wn, arr in ws:
+                g.create_dataset(f"{lname}/{wn}", data=arr)
+
+
+def seq_config(layers):
+    return {"class_name": "Sequential", "config": {"layers": layers}}
+
+
+class TestSequentialImport:
+    def test_mlp_import_outputs_match(self):
+        """Dense-only model: import and compare vs numpy."""
+        w1 = RNG.standard_normal((5, 8)).astype(np.float32)
+        b1 = RNG.standard_normal(8).astype(np.float32)
+        w2 = RNG.standard_normal((8, 3)).astype(np.float32)
+        b2 = RNG.standard_normal(3).astype(np.float32)
+        cfg = seq_config([
+            {"class_name": "Dense",
+             "config": {"name": "d1", "units": 8, "activation": "tanh",
+                        "use_bias": True, "batch_input_shape": [None, 5]}},
+            {"class_name": "Dense",
+             "config": {"name": "d2", "units": 3, "activation": "softmax",
+                        "use_bias": True}},
+        ])
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "mlp.h5")
+            write_keras_h5(path, cfg, {
+                "d1": [("kernel:0", w1), ("bias:0", b1)],
+                "d2": [("kernel:0", w2), ("bias:0", b2)],
+            })
+            net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+        x = RNG.standard_normal((4, 5)).astype(np.float32)
+        expected = softmax(np.tanh(x @ w1 + b1) @ w2 + b2)
+        got = np.asarray(net.output(x))
+        np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+    def test_cnn_import_layout_conversion(self):
+        """Conv+pool+flatten+dense: validates HWIO→OIHW and HWC→CHW flatten
+        permutation against a pure-numpy channels_last reference."""
+        k = RNG.standard_normal((3, 3, 2, 4)).astype(np.float32)  # HWIO
+        kb = RNG.standard_normal(4).astype(np.float32)
+        dw = RNG.standard_normal((2 * 2 * 4, 3)).astype(np.float32)  # keras HWC rows
+        db = RNG.standard_normal(3).astype(np.float32)
+        cfg = seq_config([
+            {"class_name": "Conv2D",
+             "config": {"name": "c1", "filters": 4, "kernel_size": [3, 3],
+                        "strides": [1, 1], "padding": "valid",
+                        "activation": "relu", "use_bias": True,
+                        "batch_input_shape": [None, 6, 6, 2]}},
+            {"class_name": "MaxPooling2D",
+             "config": {"name": "p1", "pool_size": [2, 2], "strides": [2, 2],
+                        "padding": "valid"}},
+            {"class_name": "Flatten", "config": {"name": "f1"}},
+            {"class_name": "Dense",
+             "config": {"name": "d1", "units": 3, "activation": "softmax",
+                        "use_bias": True}},
+        ])
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "cnn.h5")
+            write_keras_h5(path, cfg, {
+                "c1": [("kernel:0", k), ("bias:0", kb)],
+                "d1": [("kernel:0", dw), ("bias:0", db)],
+            })
+            net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+        # NHWC input for the reference; NCHW for our net
+        x_nhwc = RNG.standard_normal((3, 6, 6, 2)).astype(np.float32)
+        ref = np.maximum(conv2d_nhwc(x_nhwc, k, kb), 0.0)
+        ref = maxpool_nhwc(ref, 2)
+        ref = softmax(ref.reshape(3, -1) @ dw + db)
+        x_nchw = np.transpose(x_nhwc, (0, 3, 1, 2))
+        got = np.asarray(net.output(x_nchw))
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+    def test_lstm_import(self):
+        """LSTM gate-order pass-through (keras ifco == native order)."""
+        units, feat, t = 4, 3, 5
+        kw = RNG.standard_normal((feat, 4 * units)).astype(np.float32)
+        rw = RNG.standard_normal((units, 4 * units)).astype(np.float32)
+        b = RNG.standard_normal(4 * units).astype(np.float32)
+        cfg = seq_config([
+            {"class_name": "LSTM",
+             "config": {"name": "l1", "units": units, "activation": "tanh",
+                        "recurrent_activation": "sigmoid",
+                        "batch_input_shape": [None, t, feat]}},
+            {"class_name": "Dense",
+             "config": {"name": "d1", "units": 2, "activation": "identity",
+                        "use_bias": True}},
+        ])
+        dw = RNG.standard_normal((units, 2)).astype(np.float32)
+        db = np.zeros(2, np.float32)
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "lstm.h5")
+            write_keras_h5(path, cfg, {
+                "l1": [("kernel:0", kw), ("recurrent_kernel:0", rw),
+                       ("bias:0", b)],
+                "d1": [("kernel:0", dw), ("bias:0", db)],
+            })
+            net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+        # independent numpy LSTM (keras semantics, i f c o)
+        x = RNG.standard_normal((2, feat, t)).astype(np.float32)  # our NCW
+        h = np.zeros((2, units))
+        c = np.zeros((2, units))
+        sig = lambda z: 1 / (1 + np.exp(-z))
+        for s in range(t):
+            z = x[:, :, s] @ kw + h @ rw + b
+            i, f, g, o = (z[:, :units], z[:, units:2 * units],
+                          z[:, 2 * units:3 * units], z[:, 3 * units:])
+            c = sig(f) * c + sig(i) * np.tanh(g)
+            h = sig(o) * np.tanh(c)
+        # our net: LSTM output at last step feeds... net output is per-step;
+        # check the last timestep against numpy h
+        params = net.params["0"]
+        np.testing.assert_allclose(np.asarray(params["W"]), kw)
+        from deeplearning4j_tpu.nn.layers.recurrent import lstm_scan
+        import jax.numpy as jnp
+        out, hT, _ = lstm_scan(jnp.asarray(x), params["W"], params["RW"],
+                               params["b"])
+        np.testing.assert_allclose(np.asarray(hT), h, rtol=1e-4, atol=1e-5)
+
+    def test_batchnorm_import(self):
+        gamma = RNG.standard_normal(5).astype(np.float32)
+        beta = RNG.standard_normal(5).astype(np.float32)
+        mean = RNG.standard_normal(5).astype(np.float32)
+        var = np.abs(RNG.standard_normal(5)).astype(np.float32) + 0.5
+        cfg = seq_config([
+            {"class_name": "Dense",
+             "config": {"name": "d1", "units": 5, "activation": "linear",
+                        "use_bias": True, "batch_input_shape": [None, 5]}},
+            {"class_name": "BatchNormalization",
+             "config": {"name": "bn", "epsilon": 1e-3, "momentum": 0.99}},
+        ])
+        w = np.eye(5, dtype=np.float32)
+        b0 = np.zeros(5, np.float32)
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "bn.h5")
+            write_keras_h5(path, cfg, {
+                "d1": [("kernel:0", w), ("bias:0", b0)],
+                "bn": [("gamma:0", gamma), ("beta:0", beta),
+                       ("moving_mean:0", mean), ("moving_variance:0", var)],
+            })
+            # output layer requirement: append none; just import + forward
+            net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+        x = RNG.standard_normal((6, 5)).astype(np.float32)
+        expected = gamma * (x - mean) / np.sqrt(var + 1e-3) + beta
+        got = np.asarray(net.output(x))
+        np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-4)
+
+
+class TestFunctionalImport:
+    def test_functional_graph_import(self):
+        """Functional model with two branches merged by Add."""
+        w1 = RNG.standard_normal((4, 6)).astype(np.float32)
+        w2 = RNG.standard_normal((4, 6)).astype(np.float32)
+        w3 = RNG.standard_normal((6, 2)).astype(np.float32)
+        cfg = {
+            "class_name": "Model",
+            "config": {
+                "name": "m",
+                "layers": [
+                    {"class_name": "InputLayer", "name": "in",
+                     "config": {"name": "in",
+                                "batch_input_shape": [None, 4]},
+                     "inbound_nodes": []},
+                    {"class_name": "Dense", "name": "a",
+                     "config": {"name": "a", "units": 6, "activation": "relu",
+                                "use_bias": False},
+                     "inbound_nodes": [[["in", 0, 0, {}]]]},
+                    {"class_name": "Dense", "name": "b",
+                     "config": {"name": "b", "units": 6, "activation": "tanh",
+                                "use_bias": False},
+                     "inbound_nodes": [[["in", 0, 0, {}]]]},
+                    {"class_name": "Add", "name": "add",
+                     "config": {"name": "add"},
+                     "inbound_nodes": [[["a", 0, 0, {}], ["b", 0, 0, {}]]]},
+                    {"class_name": "Dense", "name": "out",
+                     "config": {"name": "out", "units": 2,
+                                "activation": "identity", "use_bias": False},
+                     "inbound_nodes": [[["add", 0, 0, {}]]]},
+                ],
+                "input_layers": [["in", 0, 0]],
+                "output_layers": [["out", 0, 0]],
+            },
+        }
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "func.h5")
+            write_keras_h5(path, cfg, {
+                "a": [("kernel:0", w1)],
+                "b": [("kernel:0", w2)],
+                "out": [("kernel:0", w3)],
+            })
+            net = KerasModelImport.import_keras_model_and_weights(path)
+        x = RNG.standard_normal((3, 4)).astype(np.float32)
+        expected = (np.maximum(x @ w1, 0) + np.tanh(x @ w2)) @ w3
+        got = np.asarray(net.output(x))
+        np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
