@@ -64,6 +64,25 @@ def _pair(v):
     return (int(v), int(v))
 
 
+def _keras3_history_names(obj) -> List[str]:
+    """Recursively collect source-layer names from Keras-3 serialized call
+    args (each tensor dict carries config.keras_history = [layer, node,
+    tensor_index])."""
+    out: List[str] = []
+    if isinstance(obj, dict):
+        hist = obj.get("config", {}).get("keras_history") \
+            if isinstance(obj.get("config"), dict) else None
+        if isinstance(hist, list) and hist and isinstance(hist[0], str):
+            out.append(hist[0])
+        else:
+            for v in obj.values():
+                out.extend(_keras3_history_names(v))
+    elif isinstance(obj, (list, tuple)):
+        for v in obj:
+            out.extend(_keras3_history_names(v))
+    return out
+
+
 class KerasLayerMapper:
     """Maps one Keras layer config to a LayerConf (+ required info)
     (ref: KerasLayer.java registry + layers/* mapping classes)."""
@@ -79,9 +98,12 @@ class KerasLayerMapper:
 
     # --- core ---
     def _map_dense(self, c):
-        return L.DenseLayer(n_out=int(c["units"]),
+        # keras 1: output_dim / "bias"; keras 2: units / use_bias
+        n_out = c.get("units", c.get("output_dim"))
+        has_bias = c.get("use_bias", c.get("bias", True))
+        return L.DenseLayer(n_out=int(n_out),
                             activation=_act(c.get("activation", "linear")),
-                            has_bias=c.get("use_bias", True),
+                            has_bias=bool(has_bias),
                             name=c.get("name"))
 
     def _map_activation(self, c):
@@ -89,7 +111,10 @@ class KerasLayerMapper:
                                  name=c.get("name"))
 
     def _map_leakyrelu(self, c):
-        return L.ActivationLayer(activation="leakyrelu", name=c.get("name"))
+        # keras default alpha=0.3 (ours is 0.01) — carry it explicitly
+        alpha = float(c.get("alpha", c.get("negative_slope", 0.3)))
+        return L.ActivationLayer(activation=f"leakyrelu({alpha})",
+                                 name=c.get("name"))
 
     def _map_dropout(self, c):
         # Keras rate = DROP prob; our field = RETAIN prob (DL4J semantics)
@@ -225,16 +250,22 @@ class KerasModelImport:
                                                   enforce_training_config=False):
         """ref: importKerasSequentialModelAndWeights :74-87."""
         model = _KerasH5(path)
-        return model.to_multi_layer_network()
+        try:
+            return model.to_multi_layer_network()
+        finally:
+            model.close()
 
     @staticmethod
     def import_keras_model_and_weights(path: str, enforce_training_config=False):
         """ref: importKerasModelAndWeights :103-123. Sniffs Sequential vs
         Functional like KerasModel.java."""
         model = _KerasH5(path)
-        if model.model_class == "Sequential":
-            return model.to_multi_layer_network()
-        return model.to_computation_graph()
+        try:
+            if model.model_class == "Sequential":
+                return model.to_multi_layer_network()
+            return model.to_computation_graph()
+        finally:
+            model.close()
 
 
 class _KerasH5:
@@ -256,6 +287,16 @@ class _KerasH5:
             kv = kv.decode()
         self.keras_version = 1 if str(kv).startswith("1") else 2
         self.mapper = KerasLayerMapper(self.keras_version)
+        # channels_first models need different input interpretation + no
+        # HWC→CHW flatten permutation (kernel layout is HWIO either way)
+        self.channels_first = '"channels_first"' in json.dumps(self.config) \
+            or '"dim_ordering": "th"' in json.dumps(self.config)
+
+    def close(self) -> None:
+        try:
+            self.f.close()
+        except Exception:
+            pass
 
     # ------------------------------------------------------------------
     def _layer_configs(self) -> List[dict]:
@@ -265,14 +306,20 @@ class _KerasH5:
         return cfg  # keras 1 sequential: list directly
 
     def _input_type_from_shape(self, shape) -> InputType:
-        """Keras input shape (channels_last) → our InputType."""
-        shape = [s for s in shape if s is not None]
-        if len(shape) == 3:  # H, W, C (channels_last default)
-            h, w, c = shape
+        """Keras per-example input shape → our InputType. Positional: a rank-3
+        shape is an image (layout per data_format), rank-2 is (timesteps,
+        features) — interior None (variable timesteps) is preserved, not
+        stripped (ref: KerasInput.java shape handling)."""
+        shape = list(shape)
+        if len(shape) == 3:
+            if self.channels_first:  # C, H, W
+                c, h, w = shape
+            else:                    # H, W, C (channels_last default)
+                h, w, c = shape
             return InputType.convolutional(h, w, c)
-        if len(shape) == 2:  # T, F  (recurrent)
+        if len(shape) == 2:  # T, F — T may be None (variable length)
             t, f = shape
-            return InputType.recurrent(f, t)
+            return InputType.recurrent(int(f), t)
         return InputType.feed_forward(int(shape[0]))
 
     # ------------------------------------------------------------------
@@ -281,7 +328,6 @@ class _KerasH5:
         layer_cfgs = self._layer_configs()
         conf = MultiLayerConfiguration(updater=Sgd(0.01))
         input_type = None
-        names: List[Optional[str]] = []
         for lc in layer_cfgs:
             kcls = lc["class_name"]
             c = _cfg(lc)
@@ -294,10 +340,8 @@ class _KerasH5:
                 continue
             mapped = self.mapper.map(kcls, c)
             if mapped is None:  # Flatten/Reshape -> preprocessor inserted later
-                names.append(("__flatten__", c.get("name")))
                 continue
             conf.layers.append(mapped)
-            names.append((None, c.get("name")))
         conf.input_type = input_type
         net = MultiLayerNetwork(conf)
         net.init()
@@ -321,10 +365,10 @@ class _KerasH5:
                 if isinstance(node, list):
                     for conn in node:
                         src.append(conn[0] if isinstance(conn, list) else conn)
-                elif isinstance(node, dict):  # keras 3 style
-                    args = node.get("args", [])
-                    for a in args:
-                        pass
+                elif isinstance(node, dict):
+                    # keras 3 style: tensors serialized as dicts carrying
+                    # {"config": {"keras_history": [layer_name, node, tensor]}}
+                    src.extend(_keras3_history_names(node.get("args", [])))
             inbound[name] = src
             if kcls == "InputLayer":
                 g_conf.network_inputs.append(name)
@@ -347,6 +391,11 @@ class _KerasH5:
                 g_conf.vertex_inputs[name] = src
                 continue
             mapped = self.mapper.map(kcls, c)
+            if mapped is None:
+                raise ValueError(
+                    f"Keras layer {kcls} ('{name}') has no graph-vertex "
+                    "mapping (shape adapters beyond Flatten are unsupported "
+                    "in functional-model import)")
             g_conf.vertices[name] = LayerVertex(layer=mapped)
             g_conf.vertex_inputs[name] = src
         outs = cfg.get("output_layers", [])
@@ -363,28 +412,36 @@ class _KerasH5:
         return self.f["model_weights"] if "model_weights" in self.f else self.f
 
     def _layer_weights(self, lname: str) -> List[np.ndarray]:
+        return [a for _, a in self._layer_weights_named(lname)]
+
+    def _layer_weights_named(self, lname: str) -> List[Tuple[str, np.ndarray]]:
+        """(weight_name, array) pairs in the file's declared order."""
         g = self._weight_group()
         if lname not in g:
             return []
         lg = g[lname]
         wn = lg.attrs.get("weight_names")
-        arrays = []
+        pairs: List[Tuple[str, np.ndarray]] = []
         if wn is not None:
             for n in wn:
                 n = n.decode() if isinstance(n, bytes) else n
-                arrays.append(np.asarray(lg[n.split("/", 1)[-1]]
-                                         if n.split("/", 1)[-1] in lg else lg[n]))
+                short = n.split("/", 1)[-1]
+                arr = np.asarray(lg[short] if short in lg else lg[n])
+                pairs.append((n, arr))
         else:
-            def visit(_, obj):
+            def visit(vname, obj):
                 import h5py
                 if isinstance(obj, h5py.Dataset):
-                    arrays.append(np.asarray(obj))
+                    pairs.append((vname, np.asarray(obj)))
             lg.visititems(visit)
-        return arrays
+        return pairs
 
-    def _assign(self, layer: L.LayerConf, params: dict, weights: List[np.ndarray]):
+    def _assign(self, layer: L.LayerConf, params: dict,
+                weights: List[np.ndarray],
+                names: Optional[List[str]] = None):
         """Map Keras weight arrays into our named params (layout conversions
-        documented in the module docstring)."""
+        documented in the module docstring). `names` (parallel to `weights`)
+        disambiguates optional slots like BN gamma/beta."""
         import jax.numpy as jnp
         if isinstance(layer, L.ConvolutionLayer) and not isinstance(
                 layer, L.Convolution1DLayer):
@@ -404,11 +461,29 @@ class _KerasH5:
             if len(weights) > 1 and "b" in params:
                 params["b"] = jnp.asarray(weights[1])
         elif isinstance(layer, L.BatchNormalization):
-            # keras order: gamma, beta, moving_mean, moving_var
-            params["gamma"] = jnp.asarray(weights[0])
-            params["beta"] = jnp.asarray(weights[1])
-            params["__mean__"] = jnp.asarray(weights[2])
-            params["__var__"] = jnp.asarray(weights[3])
+            # keras order: gamma, beta, moving_mean, moving_var — but
+            # scale=False / center=False omit gamma / beta, so map by the
+            # declared weight names when available (names parallel `weights`)
+            slots = {"gamma": "gamma", "beta": "beta",
+                     "moving_mean": "__mean__", "moving_variance": "__var__",
+                     "running_mean": "__mean__", "running_std": "__var__"}
+            assigned = False
+            if names and len(names) == len(weights):
+                for n, w in zip(names, weights):
+                    base = n.rsplit("/", 1)[-1].split(":")[0]
+                    if base in slots:
+                        params[slots[base]] = jnp.asarray(w)
+                        assigned = True
+            if not assigned:
+                if len(weights) != 4:
+                    raise ValueError(
+                        "BatchNormalization with %d weight arrays and no "
+                        "recognizable weight names — cannot infer layout"
+                        % len(weights))
+                params["gamma"] = jnp.asarray(weights[0])
+                params["beta"] = jnp.asarray(weights[1])
+                params["__mean__"] = jnp.asarray(weights[2])
+                params["__var__"] = jnp.asarray(weights[3])
         elif isinstance(layer, L.LSTM):
             # keras: kernel [in,4H], recurrent_kernel [H,4H], bias [4H]
             # gate order (i,f,c,o) == ours: direct copy
@@ -438,14 +513,18 @@ class _KerasH5:
                 continue
             layer = net.layers[li]
             lname = lc.get("name", c.get("name"))
-            weights = self._layer_weights(lname)
+            named = self._layer_weights_named(lname)
+            wnames = [n for n, _ in named]
+            weights = [a for _, a in named]
             if weights:
                 # Dense directly after a conv flatten: Keras flattened HWC
                 # (channels_last) but our CnnToFeedForward flattens CHW —
                 # permute kernel rows (ref: KerasModelUtils / the reference's
                 # preprocessor-aware weight mapping; SURVEY §7 hard parts)
+                # channels_first models already flatten CHW like we do
                 pre = net.conf.preprocessors.get(li)
-                if isinstance(layer, (L.DenseLayer, L.OutputLayer)) and \
+                if not self.channels_first and \
+                        isinstance(layer, (L.DenseLayer, L.OutputLayer)) and \
                         isinstance(pre, CnnToFeedForwardPreProcessor) and \
                         pre.height and weights[0].ndim == 2:
                     h_, w_, c_ = pre.height, pre.width, pre.channels
@@ -453,7 +532,7 @@ class _KerasH5:
                     weights = [k.transpose(2, 0, 1, 3).reshape(h_ * w_ * c_, -1)
                                ] + list(weights[1:])
                 p = dict(net.params[str(li)])
-                p = self._assign(layer, p, weights)
+                p = self._assign(layer, p, weights, wnames)
                 mean = p.pop("__mean__", None)
                 var = p.pop("__var__", None)
                 net.params[str(li)] = p
@@ -465,11 +544,12 @@ class _KerasH5:
         for name, v in net.conf.vertices.items():
             if not isinstance(v, LayerVertex) or v.layer is None:
                 continue
-            weights = self._layer_weights(name)
-            if not weights:
+            named = self._layer_weights_named(name)
+            if not named:
                 continue
+            weights = [a for _, a in named]
             p = dict(net.params[name])
-            p = self._assign(v.layer, p, weights)
+            p = self._assign(v.layer, p, weights, [n for n, _ in named])
             mean = p.pop("__mean__", None)
             var = p.pop("__var__", None)
             net.params[name] = p

@@ -1,0 +1,46 @@
+"""NLP stack: embeddings-as-XLA-ops with host-side text processing.
+
+TPU-native equivalent of deeplearning4j-nlp-parent (SURVEY §2.6). The
+reference trains embeddings with hogwild threads mutating syn0/syn1 arrays
+through native aggregates (SkipGram.java, CBOW.java); here training pairs are
+batched on host and a single jitted update step performs the gather /
+scatter-add math on device — same objective, MXU/VPU-friendly execution.
+"""
+
+from deeplearning4j_tpu.nlp.tokenization import (
+    Tokenizer, DefaultTokenizer, NGramTokenizer, TokenizerFactory,
+    DefaultTokenizerFactory, NGramTokenizerFactory, CommonPreprocessor,
+    EndingPreProcessor, StopWords,
+)
+from deeplearning4j_tpu.nlp.sentence import (
+    SentenceIterator, CollectionSentenceIterator, BasicLineIterator,
+    FileSentenceIterator, LabelledDocument, LabelAwareIterator,
+    SimpleLabelAwareIterator, FileLabelAwareIterator,
+)
+from deeplearning4j_tpu.nlp.vocab import VocabWord, VocabCache, VocabConstructor
+from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+from deeplearning4j_tpu.nlp.glove import Glove
+from deeplearning4j_tpu.nlp.serializer import (
+    write_word_vectors, read_word_vectors, write_word2vec_binary,
+    read_word2vec_binary,
+)
+from deeplearning4j_tpu.nlp.bagofwords import (
+    BagOfWordsVectorizer, TfidfVectorizer,
+)
+from deeplearning4j_tpu.nlp.cnn_sentence import CnnSentenceDataSetIterator
+
+__all__ = [
+    "Tokenizer", "DefaultTokenizer", "NGramTokenizer", "TokenizerFactory",
+    "DefaultTokenizerFactory", "NGramTokenizerFactory", "CommonPreprocessor",
+    "EndingPreProcessor", "StopWords",
+    "SentenceIterator", "CollectionSentenceIterator", "BasicLineIterator",
+    "FileSentenceIterator", "LabelledDocument", "LabelAwareIterator",
+    "SimpleLabelAwareIterator", "FileLabelAwareIterator",
+    "VocabWord", "VocabCache", "VocabConstructor",
+    "SequenceVectors", "Word2Vec", "ParagraphVectors", "Glove",
+    "write_word_vectors", "read_word_vectors", "write_word2vec_binary",
+    "read_word2vec_binary",
+    "BagOfWordsVectorizer", "TfidfVectorizer", "CnnSentenceDataSetIterator",
+]

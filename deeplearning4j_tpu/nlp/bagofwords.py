@@ -1,0 +1,84 @@
+"""Bag-of-words and TF-IDF vectorizers.
+
+Equivalent of deeplearning4j-nlp bagofwords/vectorizer/
+(BagOfWordsVectorizer.java, TfidfVectorizer.java): fit a vocab over a
+corpus, then transform texts into count / tf-idf vectors (and labelled
+DataSets for classifier training).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizerFactory, TokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabConstructor
+
+
+class BagOfWordsVectorizer:
+    """ref: BagOfWordsVectorizer.java — transform(text) -> count vector."""
+
+    def __init__(self, tokenizer_factory: Optional[TokenizerFactory] = None,
+                 min_word_frequency: int = 1,
+                 stop_words: Sequence[str] = ()):
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+        self.min_word_frequency = min_word_frequency
+        self.stop_words = stop_words
+        self.vocab: Optional[VocabCache] = None
+        self.n_docs = 0
+        self._doc_freq = {}
+
+    def fit(self, texts: Iterable[str]) -> "BagOfWordsVectorizer":
+        texts = list(texts)
+        seqs = [self.tokenizer_factory.create(t).get_tokens() for t in texts]
+        self.vocab = VocabConstructor(
+            self.min_word_frequency, stop_words=self.stop_words,
+            build_huffman_tree=False).build(seqs)
+        self.n_docs = len(texts)
+        for seq in seqs:
+            for w in set(seq):
+                if self.vocab.contains_word(w):
+                    self._doc_freq[w] = self._doc_freq.get(w, 0) + 1
+        return self
+
+    def transform(self, text: str) -> np.ndarray:
+        v = np.zeros(self.vocab.num_words(), np.float32)
+        for tok in self.tokenizer_factory.create(text):
+            i = self.vocab.index_of(tok)
+            if i >= 0:
+                v[i] += 1.0
+        return v
+
+    def vectorize(self, texts: Iterable[str],
+                  labels: Optional[Sequence[int]] = None,
+                  num_classes: Optional[int] = None) -> DataSet:
+        """ref: vectorize() -> DataSet with one-hot labels."""
+        X = np.stack([self.transform(t) for t in texts])
+        if labels is None:
+            return DataSet(X, np.zeros((len(X), 1), np.float32))
+        k = num_classes or (max(labels) + 1)
+        Y = np.zeros((len(X), k), np.float32)
+        Y[np.arange(len(X)), np.asarray(labels)] = 1.0
+        return DataSet(X, Y)
+
+
+class TfidfVectorizer(BagOfWordsVectorizer):
+    """ref: TfidfVectorizer.java — tf·idf weighting, idf = log(N/df)."""
+
+    def transform(self, text: str) -> np.ndarray:
+        counts = super().transform(text)
+        total = counts.sum() or 1.0
+        out = np.zeros_like(counts)
+        nz = np.nonzero(counts)[0]
+        for i in nz:
+            w = self.vocab.word_at_index(int(i))
+            df = self._doc_freq.get(w, 0)
+            if df > 0:
+                idf = math.log(self.n_docs / df)
+                out[i] = (counts[i] / total) * idf
+        return out

@@ -1,0 +1,73 @@
+"""Sentence → CNN tensor iterator.
+
+Equivalent of deeplearning4j-nlp iterator/CnnSentenceDataSetIterator.java:516
+— embeds each token with a word-vector model and stacks into
+[mb, 1, max_len, vector_size] image-like tensors (sentences along height,
+the reference default) with a per-timestep feature mask, one-hot labels.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizerFactory, TokenizerFactory,
+)
+
+
+class CnnSentenceDataSetIterator:
+    def __init__(self, word_vectors: SequenceVectors,
+                 sentences: Sequence[Tuple[str, str]],
+                 labels: Sequence[str],
+                 batch_size: int = 32,
+                 max_sentence_length: int = 64,
+                 tokenizer_factory: Optional[TokenizerFactory] = None,
+                 sentences_along_height: bool = True):
+        """sentences: (text, label) pairs; labels: ordered label set."""
+        self.wv = word_vectors
+        self.sentences = list(sentences)
+        self.labels = list(labels)
+        self.batch_size = batch_size
+        self.max_len = max_sentence_length
+        self.tf = tokenizer_factory or DefaultTokenizerFactory()
+        self.along_height = sentences_along_height
+        self._pos = 0
+
+    @property
+    def vector_size(self) -> int:
+        return self.wv.layer_size
+
+    def reset(self) -> None:
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < len(self.sentences)
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        batch = self.sentences[self._pos:self._pos + self.batch_size]
+        self._pos += len(batch)
+        D, L = self.vector_size, self.max_len
+        mb = len(batch)
+        feats = np.zeros((mb, 1, L, D), np.float32)
+        fmask = np.zeros((mb, L), np.float32)
+        labels = np.zeros((mb, len(self.labels)), np.float32)
+        for bi, (text, label) in enumerate(batch):
+            toks = [t for t in self.tf.create(text)
+                    if self.wv.vocab.contains_word(t)][:L]
+            for ti, tok in enumerate(toks):
+                feats[bi, 0, ti] = self.wv.get_word_vector(tok)
+                fmask[bi, ti] = 1.0
+            labels[bi, self.labels.index(label)] = 1.0
+        if not self.along_height:  # [mb,1,D,L]
+            feats = feats.transpose(0, 1, 3, 2)
+        return DataSet(feats, labels, features_mask=fmask)

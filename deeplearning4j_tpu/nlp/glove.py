@@ -1,0 +1,131 @@
+"""GloVe: co-occurrence counting + AdaGrad weighted least-squares.
+
+Equivalent of deeplearning4j-nlp models/glove/Glove.java:429 +
+AbstractCoOccurrences.java:646 (window-weighted counts) +
+learning/impl/elements/GloVe.java:406 (AdaGrad update with
+f(X) = (X/xMax)^alpha weighting, xMax=100, alpha=0.75).
+
+Counts are built on host (hash map — the reference shuffles shard files;
+corpora here fit in memory); the factorization step is one jitted batch
+update: gathers, per-pair dots, scatter-add of AdaGrad-scaled gradients.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from functools import partial
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
+
+
+@partial(jax.jit, static_argnames=())
+def _glove_step(w, b, hist_w, hist_b, rows_i, rows_j, logX, fX, valid, lr):
+    """AdaGrad step on J = f(X)·(w_i·w_j + b_i + b_j − log X)² for a batch.
+    Both word and context roles share one table (ref GloVe.java trains
+    syn0 only, symmetric co-occurrences)."""
+    wi, wj = w[rows_i], w[rows_j]                    # [B,D]
+    diff = jnp.einsum("bd,bd->b", wi, wj) + b[rows_i] + b[rows_j] - logX
+    fdiff = fX * diff * valid                        # [B]
+    gi = fdiff[:, None] * wj                         # dJ/dwi
+    gj = fdiff[:, None] * wi
+    gb = fdiff
+    # AdaGrad accumulators
+    hist_w = hist_w.at[rows_i].add(gi * gi).at[rows_j].add(gj * gj)
+    hist_b = hist_b.at[rows_i].add(gb * gb).at[rows_j].add(gb * gb)
+    upd_i = lr * gi / jnp.sqrt(hist_w[rows_i] + 1e-8)
+    upd_j = lr * gj / jnp.sqrt(hist_w[rows_j] + 1e-8)
+    upd_bi = lr * gb / jnp.sqrt(hist_b[rows_i] + 1e-8)
+    upd_bj = lr * gb / jnp.sqrt(hist_b[rows_j] + 1e-8)
+    w = w.at[rows_i].add(-upd_i).at[rows_j].add(-upd_j)
+    b = b.at[rows_i].add(-upd_bi).at[rows_j].add(-upd_bj)
+    loss = 0.5 * jnp.sum(fX * diff * diff * valid)
+    return w, b, hist_w, hist_b, loss
+
+
+class Glove(SequenceVectors):
+    """ref: Glove.java Builder — xMax :~, alpha, symmetric window counts."""
+
+    def __init__(self, layer_size: int = 100, window: int = 5,
+                 learning_rate: float = 0.05, x_max: float = 100.0,
+                 alpha: float = 0.75, epochs: int = 5,
+                 batch_size: int = 1024, min_word_frequency: int = 1,
+                 symmetric: bool = True, shuffle: bool = True,
+                 seed: int = 42, **kwargs):
+        super().__init__(layer_size=layer_size, window=window,
+                         learning_rate=learning_rate, epochs=epochs,
+                         batch_size=batch_size,
+                         min_word_frequency=min_word_frequency,
+                         seed=seed, **kwargs)
+        self.x_max = x_max
+        self.alpha = alpha
+        self.symmetric = symmetric
+        self.shuffle = shuffle
+        self.bias = None
+        self._cooc: Optional[Dict[Tuple[int, int], float]] = None
+        self.loss_history: List[float] = []
+
+    # -- co-occurrences (ref AbstractCoOccurrences.java: 1/distance) -------
+    def count_cooccurrences(self, sequences: Iterable[Sequence[str]]) -> None:
+        cooc: Dict[Tuple[int, int], float] = defaultdict(float)
+        for seq in sequences:
+            idxs = [self.vocab.index_of(t) for t in seq]
+            idxs = [i for i in idxs if i >= 0]
+            n = len(idxs)
+            for pos in range(n):
+                for off in range(1, self.window + 1):
+                    c = pos + off
+                    if c >= n:
+                        break
+                    wgt = 1.0 / off
+                    a, b_ = idxs[pos], idxs[c]
+                    cooc[(a, b_)] += wgt
+                    if self.symmetric:
+                        cooc[(b_, a)] += wgt
+        self._cooc = dict(cooc)
+
+    def fit(self, sequences: Iterable[Sequence[str]], **_) -> "Glove":
+        seqs = sequences if isinstance(sequences, list) else list(sequences)
+        if self.vocab is None:
+            self.build_vocab(seqs)
+        if self._cooc is None:
+            self.count_cooccurrences(seqs)
+        V, D = self.vocab.num_words(), self.layer_size
+        rnd = np.random.default_rng(self.seed)
+        if self.syn0 is None or self.syn0.shape != (V, D):
+            self.syn0 = jnp.asarray(
+                (rnd.random((V, D), np.float32) - 0.5) / D)
+        self.bias = jnp.zeros((V,), jnp.float32)
+        hist_w = jnp.full((V, D), 1e-8, jnp.float32)
+        hist_b = jnp.full((V,), 1e-8, jnp.float32)
+
+        pairs = np.asarray(list(self._cooc.keys()), np.int32)
+        counts = np.asarray(list(self._cooc.values()), np.float32)
+        logX = np.log(counts)
+        fX = np.minimum(1.0, (counts / self.x_max) ** self.alpha) \
+            .astype(np.float32)
+        n = len(pairs)
+        B = self.batch_size
+        order = np.arange(n)
+        for _ in range(self.epochs):
+            if self.shuffle:
+                rnd.shuffle(order)
+            total = 0.0
+            for s in range(0, n, B):
+                sel = order[s:s + B]
+                valid = np.ones(B, np.float32)
+                if len(sel) < B:
+                    valid[len(sel):] = 0.0
+                    sel = np.pad(sel, (0, B - len(sel)))
+                self.syn0, self.bias, hist_w, hist_b, loss = _glove_step(
+                    self.syn0, self.bias, hist_w, hist_b,
+                    jnp.asarray(pairs[sel, 0]), jnp.asarray(pairs[sel, 1]),
+                    jnp.asarray(logX[sel]), jnp.asarray(fX[sel]),
+                    jnp.asarray(valid), jnp.float32(self.learning_rate))
+                total += float(loss)
+            self.loss_history.append(total / max(1, n))
+        return self

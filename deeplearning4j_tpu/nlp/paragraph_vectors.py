@@ -1,0 +1,119 @@
+"""ParagraphVectors (doc2vec): DM and DBOW.
+
+Equivalent of deeplearning4j-nlp models/paragraphvectors/
+ParagraphVectors.java:1449 + learning/impl/sequence/{DM,DBOW}.java.
+Doc labels live in the same lookup table as words (is_label rows);
+DBOW trains label→word skip-gram pairs, DM folds the label vector into the
+CBOW context average. inferVector trains ONLY a fresh row with the output
+tables frozen (ref: ParagraphVectors.inferVector).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.sentence import LabelAwareIterator, LabelledDocument
+from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizerFactory, TokenizerFactory,
+)
+
+
+class ParagraphVectors(SequenceVectors):
+    """sequence_learning_algorithm: "dbow" (default, ref DBOW.java) or
+    "dm" (ref DM.java)."""
+
+    def __init__(self, label_aware_iterator: Optional[LabelAwareIterator] = None,
+                 tokenizer_factory: Optional[TokenizerFactory] = None,
+                 sequence_learning_algorithm: str = "dbow",
+                 train_words: bool = False, **kwargs):
+        algo = sequence_learning_algorithm.lower()
+        if algo not in ("dbow", "dm"):
+            raise ValueError(f"unknown sequence learning algorithm {algo!r}")
+        kwargs.setdefault("elements_learning_algorithm",
+                          "skipgram" if algo == "dbow" else "cbow")
+        super().__init__(**kwargs)
+        self.seq_algo = algo
+        self.train_words = train_words
+        self.label_aware_iterator = label_aware_iterator
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+        self._docs: List[LabelledDocument] = []
+
+    # -- training ----------------------------------------------------------
+    def fit(self, documents: Optional[Iterable[LabelledDocument]] = None,
+            **_) -> "ParagraphVectors":
+        docs = list(documents) if documents is not None else \
+            list(self.label_aware_iterator or [])
+        if not docs:
+            raise RuntimeError("no documents to fit")
+        self._docs = docs
+        seqs = [self.tokenizer_factory.create(d.content).get_tokens()
+                for d in docs]
+        labels = [d.labels for d in docs]
+        all_labels = [l for ls in labels for l in ls]
+        if self.vocab is None:
+            self.build_vocab(seqs, extra_labels=all_labels)
+        if self.seq_algo == "dbow":
+            SequenceVectors.fit(self, seqs, labels_per_sequence=labels,
+                                train_words=self.train_words,
+                                train_labels=True)
+        else:  # DM: label joins CBOW context; words co-train by nature
+            SequenceVectors.fit(self, seqs, labels_per_sequence=labels)
+        return self
+
+    # -- queries -----------------------------------------------------------
+    def get_label_vector(self, label: str) -> Optional[np.ndarray]:
+        return self.get_word_vector(label)
+
+    def nearest_labels(self, text_or_vec, top_n: int = 5) -> List[str]:
+        if isinstance(text_or_vec, str):
+            v = self.infer_vector(text_or_vec)
+        else:
+            v = np.asarray(text_or_vec, np.float32)
+        labels = [w for w in self.vocab.vocab_words() if w.is_label]
+        if not labels:
+            return []
+        syn0 = np.asarray(self.syn0)
+        sims = []
+        for vw in labels:
+            u = syn0[vw.index]
+            s = float(u @ v / ((np.linalg.norm(u) * np.linalg.norm(v)) + 1e-12))
+            sims.append((s, vw.word))
+        sims.sort(reverse=True)
+        return [w for _, w in sims[:top_n]]
+
+    def infer_vector(self, text: str, learning_rate: float = 0.01,
+                     min_learning_rate: float = 0.001,
+                     iterations: int = 5) -> np.ndarray:
+        """Train a fresh doc row with word/output tables frozen
+        (ref: ParagraphVectors.inferVector :~1050)."""
+        toks = self.tokenizer_factory.create(text).get_tokens()
+        idxs = self._to_indices(toks)
+        if idxs.size == 0:
+            return np.zeros(self.layer_size, np.float32)
+        rnd = np.random.default_rng(self.seed)
+        # append scratch row for the inferred doc
+        row = self.syn0.shape[0]
+        saved0, saved1, saved1n = self.syn0, self.syn1, self.syn1neg
+        self.syn0 = jnp.concatenate(
+            [self.syn0, jnp.asarray((rnd.random((1, self.layer_size),
+                                                np.float32) - 0.5)
+                                    / self.layer_size)], 0)
+        if self.use_hs:
+            pass  # syn1 indexed by inner nodes only — unchanged
+        try:
+            n_steps = max(1, iterations)
+            for it in range(n_steps):
+                alpha = max(min_learning_rate,
+                            learning_rate * (1 - it / n_steps))
+                before1, before1n = self.syn1, self.syn1neg
+                self._train_skipgram(idxs, alpha, [row], train_words=False,
+                                     train_labels=True)
+                # freeze output tables: restore them after the step
+                self.syn1, self.syn1neg = before1, before1n
+            return np.asarray(self.syn0[row])
+        finally:
+            self.syn0, self.syn1, self.syn1neg = saved0, saved1, saved1n

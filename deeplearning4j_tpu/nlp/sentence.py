@@ -1,0 +1,127 @@
+"""Sentence / document iterators.
+
+Equivalent of deeplearning4j-nlp text/sentenceiterator/ and
+text/documentiterator/ (SURVEY §2.6): streams of sentences (strings) for
+Word2Vec, and label-aware document streams for ParagraphVectors.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Iterator, List, Optional
+
+
+class SentenceIterator:
+    """ref: SentenceIterator.java (nextSentence/hasNext/reset +
+    SentencePreProcessor)."""
+
+    def __init__(self, preprocessor: Optional[Callable[[str], str]] = None):
+        self.preprocessor = preprocessor
+
+    def _raw(self) -> Iterator[str]:
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[str]:
+        for s in self._raw():
+            yield self.preprocessor(s) if self.preprocessor else s
+
+    def reset(self) -> None:  # iterators here are restartable generators
+        pass
+
+
+class CollectionSentenceIterator(SentenceIterator):
+    """ref: CollectionSentenceIterator.java."""
+
+    def __init__(self, sentences: Iterable[str],
+                 preprocessor: Optional[Callable[[str], str]] = None):
+        super().__init__(preprocessor)
+        self._sentences = list(sentences)
+
+    def _raw(self) -> Iterator[str]:
+        return iter(self._sentences)
+
+
+class BasicLineIterator(SentenceIterator):
+    """One sentence per line of a file (ref: BasicLineIterator.java)."""
+
+    def __init__(self, path: str,
+                 preprocessor: Optional[Callable[[str], str]] = None):
+        super().__init__(preprocessor)
+        self.path = path
+
+    def _raw(self) -> Iterator[str]:
+        with open(self.path, "r", encoding="utf-8", errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield line
+
+
+class FileSentenceIterator(SentenceIterator):
+    """Every line of every file under a directory
+    (ref: FileSentenceIterator.java)."""
+
+    def __init__(self, root: str,
+                 preprocessor: Optional[Callable[[str], str]] = None):
+        super().__init__(preprocessor)
+        self.root = root
+
+    def _raw(self) -> Iterator[str]:
+        for dirpath, _, files in sorted(os.walk(self.root)):
+            for name in sorted(files):
+                with open(os.path.join(dirpath, name), "r",
+                          encoding="utf-8", errors="replace") as f:
+                    for line in f:
+                        line = line.strip()
+                        if line:
+                            yield line
+
+
+@dataclass
+class LabelledDocument:
+    """ref: documentiterator/LabelledDocument.java."""
+    content: str
+    labels: List[str] = field(default_factory=list)
+
+    @property
+    def label(self) -> Optional[str]:
+        return self.labels[0] if self.labels else None
+
+
+class LabelAwareIterator:
+    """ref: documentiterator/LabelAwareIterator.java."""
+
+    def __iter__(self) -> Iterator[LabelledDocument]:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+
+class SimpleLabelAwareIterator(LabelAwareIterator):
+    """In-memory labelled docs (ref: SimpleLabelAwareIterator.java)."""
+
+    def __init__(self, documents: Iterable[LabelledDocument]):
+        self._docs = list(documents)
+
+    def __iter__(self) -> Iterator[LabelledDocument]:
+        return iter(self._docs)
+
+
+class FileLabelAwareIterator(LabelAwareIterator):
+    """Directory-per-label corpus: root/<label>/<doc>.txt
+    (ref: FileLabelAwareIterator.java)."""
+
+    def __init__(self, root: str):
+        self.root = root
+
+    def __iter__(self) -> Iterator[LabelledDocument]:
+        for label in sorted(os.listdir(self.root)):
+            d = os.path.join(self.root, label)
+            if not os.path.isdir(d):
+                continue
+            for name in sorted(os.listdir(d)):
+                with open(os.path.join(d, name), "r", encoding="utf-8",
+                          errors="replace") as f:
+                    yield LabelledDocument(f.read(), [label])

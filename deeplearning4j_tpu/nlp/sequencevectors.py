@@ -1,0 +1,408 @@
+"""SequenceVectors: generic embedding trainer over sequences of elements.
+
+Equivalent of deeplearning4j-nlp SequenceVectors.java:1244 (buildVocab :108,
+fit :192, pluggable learning algos :56) + the SkipGram/CBOW elements learning
+algorithms and InMemoryLookupTable syn0/syn1/syn1Neg storage.
+
+TPU-first design: the reference trains via hogwild threads issuing native
+AggregateSkipGram ops one pair at a time (SkipGram.java); here the host packs
+(input, label) pairs + presampled negatives into fixed-shape int32 batches and
+ONE jitted step does the whole batch on device — gathers, a [B,K+1,D]·[B,D]
+batched dot (MXU), and scatter-adds back into the tables. In-batch index
+collisions sum their updates (vs. sequential overwrite in hogwild) — same
+stochastic objective.
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.vocab import (
+    VocabCache, VocabConstructor, VocabWord, codes_points_arrays,
+    make_unigram_table,
+)
+
+log = logging.getLogger(__name__)
+
+
+# --------------------------------------------------------------------------
+# Device kernels
+# --------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=())
+def _ns_step(syn0, syn1neg, inputs, targets, labels, valid, lr):
+    """Negative-sampling update for a batch of pairs.
+
+    inputs [B] int32 — rows of syn0 (context words / doc vectors)
+    targets [B,K1] int32 — col 0 = positive word, cols 1.. = negatives
+    labels [B,K1] float32 — 1 for positive, 0 for negatives
+    valid [B] float32 — 0 for trailing pad rows (their update is zeroed).
+    """
+    l1 = syn0[inputs]                      # [B,D]
+    w = syn1neg[targets]                   # [B,K1,D]
+    f = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", l1, w))
+    g = (labels - f) * lr * valid[:, None]  # [B,K1]
+    grad_l1 = jnp.einsum("bk,bkd->bd", g, w)
+    grad_w = g[..., None] * l1[:, None, :]  # [B,K1,D]
+    syn0 = syn0.at[inputs].add(grad_l1)
+    syn1neg = syn1neg.at[targets.reshape(-1)].add(
+        grad_w.reshape(-1, grad_w.shape[-1]))
+    return syn0, syn1neg
+
+
+@partial(jax.jit, static_argnames=())
+def _hs_step(syn0, syn1, inputs, points, codes, mask, lr):
+    """Hierarchical-softmax update for a batch of pairs.
+
+    points [B,L] int32 — inner-node rows along the label word's huffman path
+    codes [B,L] float32 — path bits; mask [B,L] zeroes padded path slots.
+    """
+    l1 = syn0[inputs]                      # [B,D]
+    w = syn1[points]                       # [B,L,D]
+    f = jax.nn.sigmoid(jnp.einsum("bd,bld->bl", l1, w))
+    g = (1.0 - codes - f) * lr * mask      # [B,L]
+    grad_l1 = jnp.einsum("bl,bld->bd", g, w)
+    grad_w = g[..., None] * l1[:, None, :]
+    syn0 = syn0.at[inputs].add(grad_l1)
+    syn1 = syn1.at[points.reshape(-1)].add(grad_w.reshape(-1, w.shape[-1]))
+    return syn0, syn1
+
+
+@partial(jax.jit, static_argnames=())
+def _cbow_ns_step(syn0, syn1neg, ctx, ctx_mask, targets, labels, valid, lr):
+    """CBOW with negative sampling: input = mean of context rows
+    (ref: CBOW.java — sums context + optional label vectors)."""
+    denom = jnp.maximum(ctx_mask.sum(-1, keepdims=True), 1.0)  # [B,1]
+    vecs = syn0[ctx] * ctx_mask[..., None]  # [B,C,D]
+    l1 = vecs.sum(1) / denom                # [B,D]
+    w = syn1neg[targets]                    # [B,K1,D]
+    f = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", l1, w))
+    g = (labels - f) * lr * valid[:, None]
+    grad_l1 = jnp.einsum("bk,bkd->bd", g, w) / denom   # distribute mean grad
+    grad_w = g[..., None] * l1[:, None, :]
+    grad_ctx = grad_l1[:, None, :] * ctx_mask[..., None]  # [B,C,D]
+    syn0 = syn0.at[ctx.reshape(-1)].add(
+        grad_ctx.reshape(-1, grad_ctx.shape[-1]))
+    syn1neg = syn1neg.at[targets.reshape(-1)].add(
+        grad_w.reshape(-1, grad_w.shape[-1]))
+    return syn0, syn1neg
+
+
+@partial(jax.jit, static_argnames=())
+def _cbow_hs_step(syn0, syn1, ctx, ctx_mask, points, codes, mask, lr):
+    denom = jnp.maximum(ctx_mask.sum(-1, keepdims=True), 1.0)
+    vecs = syn0[ctx] * ctx_mask[..., None]
+    l1 = vecs.sum(1) / denom
+    w = syn1[points]
+    f = jax.nn.sigmoid(jnp.einsum("bd,bld->bl", l1, w))
+    g = (1.0 - codes - f) * lr * mask
+    grad_l1 = jnp.einsum("bl,bld->bd", g, w) / denom
+    grad_w = g[..., None] * l1[:, None, :]
+    grad_ctx = grad_l1[:, None, :] * ctx_mask[..., None]
+    syn0 = syn0.at[ctx.reshape(-1)].add(
+        grad_ctx.reshape(-1, grad_ctx.shape[-1]))
+    syn1 = syn1.at[points.reshape(-1)].add(grad_w.reshape(-1, w.shape[-1]))
+    return syn0, syn1
+
+
+# --------------------------------------------------------------------------
+# Engine
+# --------------------------------------------------------------------------
+
+class SequenceVectors:
+    """Trains element embeddings over sequences (ref: SequenceVectors.java
+    Builder defaults :375-386 — lr .025, minLr 1e-4, layerSize 100,
+    window 5, negative 0 → hierarchical softmax on by default)."""
+
+    def __init__(self, layer_size: int = 100, window: int = 5,
+                 learning_rate: float = 0.025,
+                 min_learning_rate: float = 1e-4,
+                 negative: int = 0, sampling: float = 0.0,
+                 min_word_frequency: int = 1, epochs: int = 1,
+                 iterations: int = 1, batch_size: int = 512,
+                 elements_learning_algorithm: str = "skipgram",
+                 use_hierarchic_softmax: Optional[bool] = None,
+                 seed: int = 42, stop_words: Sequence[str] = (),
+                 vocab_limit: int = 0):
+        self.layer_size = layer_size
+        self.window = window
+        self.learning_rate = learning_rate
+        self.min_learning_rate = min_learning_rate
+        self.negative = int(negative)
+        self.sampling = sampling
+        self.min_word_frequency = min_word_frequency
+        self.epochs = epochs
+        self.iterations = iterations
+        self.batch_size = batch_size
+        algo = elements_learning_algorithm.lower()
+        if algo not in ("skipgram", "cbow"):
+            raise ValueError(f"unknown elements learning algorithm {algo!r}")
+        self.algo = algo
+        # ref semantics: negative>0 switches to NS unless HS explicitly kept
+        self.use_hs = (self.negative == 0) if use_hierarchic_softmax is None \
+            else use_hierarchic_softmax
+        self.seed = seed
+        self.stop_words = stop_words
+        self.vocab_limit = vocab_limit
+
+        self.vocab: Optional[VocabCache] = None
+        self.syn0 = None            # [V,D] jnp
+        self.syn1 = None            # HS inner nodes
+        self.syn1neg = None         # NS output table
+        self._codes = self._points = self._path_mask = None
+        self._table: Optional[np.ndarray] = None
+        self._rng = np.random.default_rng(seed)
+
+    # -- vocab + weights ---------------------------------------------------
+    def build_vocab(self, sequences: Iterable[Sequence[str]],
+                    extra_labels: Sequence[str] = ()) -> None:
+        """ref: SequenceVectors.buildVocab :108 via VocabConstructor."""
+        ctor = VocabConstructor(self.min_word_frequency,
+                                stop_words=self.stop_words,
+                                build_huffman_tree=True,
+                                vocab_limit=self.vocab_limit)
+        self.vocab = ctor.build(sequences)
+        for lb in extra_labels:
+            if not self.vocab.contains_word(lb):
+                vw = VocabWord(lb, frequency=1.0, is_label=True)
+                self.vocab.add_token(vw)
+        if extra_labels:
+            self.vocab.build_index(order_by_frequency=False)
+            from deeplearning4j_tpu.nlp.vocab import build_huffman
+            build_huffman(self.vocab)
+        self._reset_weights()
+
+    def _reset_weights(self) -> None:
+        """ref: InMemoryLookupTable.resetWeights — syn0 ~ U(-.5,.5)/D,
+        syn1/syn1Neg zero."""
+        V, D = self.vocab.num_words(), self.layer_size
+        rnd = np.random.default_rng(self.seed)
+        self.syn0 = jnp.asarray(
+            (rnd.random((V, D), np.float32) - 0.5) / D)
+        if self.use_hs:
+            self.syn1 = jnp.zeros((max(V - 1, 1), D), jnp.float32)
+            c, p, m = codes_points_arrays(self.vocab)
+            self._codes, self._points, self._path_mask = c, p, m
+        if self.negative > 0:
+            self.syn1neg = jnp.zeros((V, D), jnp.float32)
+            self._table = make_unigram_table(self.vocab)
+
+    # -- training ----------------------------------------------------------
+    def fit(self, sequences: Iterable[Sequence[str]],
+            labels_per_sequence: Optional[List[Sequence[str]]] = None,
+            train_words: bool = True, train_labels: bool = False) -> None:
+        """ref: SequenceVectors.fit :192. `labels_per_sequence` attaches doc
+        labels (ParagraphVectors DBOW/DM use them as extra input rows)."""
+        if self.vocab is None:
+            raise RuntimeError("call build_vocab first")
+        seqs = sequences if isinstance(sequences, list) else list(sequences)
+        total_words = sum(len(s) for s in seqs) * max(1, self.epochs)
+        words_seen = 0
+        for epoch in range(self.epochs):
+            for si, seq in enumerate(seqs):
+                idxs = self._to_indices(seq)
+                words_seen += len(seq)
+                if len(idxs) == 0:
+                    continue
+                alpha = self._alpha(words_seen, total_words)
+                lbl = None
+                if labels_per_sequence is not None:
+                    lbl = [self.vocab.index_of(l)
+                           for l in labels_per_sequence[si]
+                           if self.vocab.index_of(l) >= 0]
+                for _ in range(self.iterations):
+                    if self.algo == "skipgram":
+                        self._train_skipgram(idxs, alpha, lbl,
+                                             train_words=train_words,
+                                             train_labels=train_labels)
+                    else:
+                        self._train_cbow(idxs, alpha, lbl)
+
+    def _alpha(self, seen: int, total: int) -> float:
+        frac = min(1.0, seen / max(1, total))
+        return max(self.min_learning_rate,
+                   self.learning_rate * (1.0 - frac))
+
+    def _to_indices(self, seq: Sequence[str]) -> np.ndarray:
+        out = []
+        t = self.sampling
+        total = max(1.0, self.vocab.total_word_count)
+        for tok in seq:
+            i = self.vocab.index_of(tok)
+            if i < 0:
+                continue
+            if t > 0:  # word2vec subsampling (ref SkipGram.applySubsampling)
+                f = self.vocab.word_frequency(tok) / total
+                keep = (np.sqrt(f / t) + 1) * (t / f) if f > 0 else 1.0
+                if keep < self._rng.random():
+                    continue
+            out.append(i)
+        return np.asarray(out, np.int32)
+
+    def _pairs(self, idxs: np.ndarray, label_rows: Optional[List[int]]):
+        """(input=context-or-label row, predict=center word) pairs,
+        mirroring word2vec C / SkipGram.java windowing with random window
+        shrink b ∈ [0, window)."""
+        ins, outs = [], []
+        n = len(idxs)
+        for pos in range(n):
+            b = int(self._rng.integers(0, self.window))
+            for off in range(b - self.window + 1, self.window - b):
+                if off == 0:
+                    continue
+                c = pos + off
+                if 0 <= c < n:
+                    ins.append(idxs[c])
+                    outs.append(idxs[pos])
+        if label_rows:
+            for lr_ in label_rows:  # DBOW: label row predicts every word
+                for w in idxs:
+                    ins.append(lr_)
+                    outs.append(w)
+        return np.asarray(ins, np.int32), np.asarray(outs, np.int32)
+
+    def _train_skipgram(self, idxs, alpha, label_rows=None, *,
+                        train_words=True, train_labels=False) -> None:
+        if not train_words:
+            ins, outs = (np.empty(0, np.int32),) * 2
+        else:
+            ins, outs = self._pairs(idxs, None)
+        if train_labels and label_rows:
+            li, lo = self._pairs(idxs, label_rows)
+            # keep only the label→word pairs when words are frozen
+            if not train_words:
+                keep = np.isin(li, label_rows)
+                li, lo = li[keep], lo[keep]
+            ins = np.concatenate([ins, li]) if ins.size else li
+            outs = np.concatenate([outs, lo]) if outs.size else lo
+        for s in range(0, len(ins), self.batch_size):
+            bi, bo = ins[s:s + self.batch_size], outs[s:s + self.batch_size]
+            bi, bo, pad = self._pad(bi, bo)
+            if self.negative > 0:
+                targets, labels = self._sample_negatives(bo)
+                self.syn0, self.syn1neg = _ns_step(
+                    self.syn0, self.syn1neg, jnp.asarray(bi),
+                    jnp.asarray(targets), jnp.asarray(labels),
+                    jnp.asarray(1.0 - pad), jnp.float32(alpha))
+            if self.use_hs:
+                pts = self._points[bo]
+                cds = self._codes[bo]
+                msk = self._path_mask[bo] * (1.0 - pad[:, None])
+                self.syn0, self.syn1 = _hs_step(
+                    self.syn0, self.syn1, jnp.asarray(bi), jnp.asarray(pts),
+                    jnp.asarray(cds), jnp.asarray(msk), jnp.float32(alpha))
+
+    def _train_cbow(self, idxs, alpha, label_rows=None) -> None:
+        n = len(idxs)
+        C = 2 * self.window + (len(label_rows) if label_rows else 0)
+        ctxs = np.zeros((n, C), np.int32)
+        cmask = np.zeros((n, C), np.float32)
+        centers = idxs.copy()
+        for pos in range(n):
+            b = int(self._rng.integers(0, self.window))
+            k = 0
+            for off in range(b - self.window + 1, self.window - b):
+                if off == 0:
+                    continue
+                c = pos + off
+                if 0 <= c < n:
+                    ctxs[pos, k] = idxs[c]
+                    cmask[pos, k] = 1.0
+                    k += 1
+            if label_rows:  # DM: doc vector joins the context average
+                for lr_ in label_rows:
+                    ctxs[pos, k] = lr_
+                    cmask[pos, k] = 1.0
+                    k += 1
+        for s in range(0, n, self.batch_size):
+            bc = centers[s:s + self.batch_size]
+            bx = ctxs[s:s + self.batch_size]
+            bm = cmask[s:s + self.batch_size]
+            pad_n = 0
+            if len(bc) < self.batch_size:
+                pad_n = self.batch_size - len(bc)
+                bc = np.pad(bc, (0, pad_n))
+                bx = np.pad(bx, ((0, pad_n), (0, 0)))
+                bm = np.pad(bm, ((0, pad_n), (0, 0)))
+            pad = np.zeros(self.batch_size, np.float32)
+            if pad_n:
+                pad[-pad_n:] = 1.0
+            if self.negative > 0:
+                targets, labels = self._sample_negatives(bc)
+                self.syn0, self.syn1neg = _cbow_ns_step(
+                    self.syn0, self.syn1neg, jnp.asarray(bx), jnp.asarray(bm),
+                    jnp.asarray(targets), jnp.asarray(labels),
+                    jnp.asarray(1.0 - pad), jnp.float32(alpha))
+            if self.use_hs:
+                pts, cds = self._points[bc], self._codes[bc]
+                msk = self._path_mask[bc] * (1.0 - pad[:, None])
+                self.syn0, self.syn1 = _cbow_hs_step(
+                    self.syn0, self.syn1, jnp.asarray(bx), jnp.asarray(bm),
+                    jnp.asarray(pts), jnp.asarray(cds), jnp.asarray(msk),
+                    jnp.float32(alpha))
+
+    def _pad(self, bi: np.ndarray, bo: np.ndarray):
+        """Pad a trailing partial batch to `batch_size` (static shapes for
+        jit); returns pad mask (1 where padded)."""
+        pad = np.zeros(self.batch_size, np.float32)
+        if len(bi) < self.batch_size:
+            n = self.batch_size - len(bi)
+            pad[len(bi):] = 1.0
+            bi = np.pad(bi, (0, n))
+            bo = np.pad(bo, (0, n))
+        return bi, bo, pad
+
+    def _sample_negatives(self, bo: np.ndarray):
+        """Unigram-table negatives; col 0 is the positive word. Pad rows are
+        zeroed inside the kernels via the `valid` mask."""
+        K = self.negative
+        B = len(bo)
+        negs = self._table[self._rng.integers(0, len(self._table), (B, K))]
+        targets = np.concatenate([bo[:, None], negs], axis=1).astype(np.int32)
+        labels = np.zeros((B, K + 1), np.float32)
+        labels[:, 0] = 1.0
+        return targets, labels
+
+    # -- queries (ref: BasicModelUtils.java wordsNearest/similarity) -------
+    def get_word_vector(self, word: str) -> Optional[np.ndarray]:
+        i = self.vocab.index_of(word)
+        if i < 0:
+            return None
+        return np.asarray(self.syn0[i])
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.get_word_vector(a), self.get_word_vector(b)
+        if va is None or vb is None:
+            return float("nan")
+        denom = (np.linalg.norm(va) * np.linalg.norm(vb)) or 1e-12
+        return float(va @ vb / denom)
+
+    def words_nearest(self, word_or_vec, top_n: int = 10,
+                      exclude: Sequence[str] = ()) -> List[str]:
+        if isinstance(word_or_vec, str):
+            v = self.get_word_vector(word_or_vec)
+            exclude = list(exclude) + [word_or_vec]
+            if v is None:
+                return []
+        else:
+            v = np.asarray(word_or_vec, np.float32)
+        syn0 = np.asarray(self.syn0)
+        norms = np.linalg.norm(syn0, axis=1) + 1e-12
+        sims = syn0 @ v / (norms * (np.linalg.norm(v) + 1e-12))
+        order = np.argsort(-sims)
+        out = []
+        for i in order:
+            w = self.vocab.word_at_index(int(i))
+            vw = self.vocab.element_at_index(int(i))
+            if w in exclude or (vw is not None and vw.is_label):
+                continue
+            out.append(w)
+            if len(out) >= top_n:
+                break
+        return out

@@ -1,0 +1,96 @@
+"""Word-vector model serialization.
+
+Equivalent of deeplearning4j-nlp models/embeddings/loader/
+WordVectorSerializer.java:2824 — text format ("word v1 v2 ...", one per
+line, optional header) and the Google word2vec binary format
+(header "V D\\n", then per word: name, space, D little-endian float32).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord
+
+
+def write_word_vectors(vectors: SequenceVectors, path: str,
+                       write_header: bool = True) -> None:
+    """ref: WordVectorSerializer.writeWordVectors (text)."""
+    syn0 = np.asarray(vectors.syn0)
+    words = vectors.vocab.vocab_words()
+    with open(path, "w", encoding="utf-8") as f:
+        if write_header:
+            f.write(f"{len(words)} {syn0.shape[1]}\n")
+        for w in words:
+            vec = " ".join(f"{v:.6f}" for v in syn0[w.index])
+            f.write(f"{w.word} {vec}\n")
+
+
+def read_word_vectors(path: str) -> SequenceVectors:
+    """ref: WordVectorSerializer.readWord2VecModel / loadTxtVectors."""
+    words, rows = [], []
+    with open(path, "r", encoding="utf-8") as f:
+        first = f.readline().rstrip("\n")
+        parts = first.split(" ")
+        header = len(parts) == 2 and all(p.isdigit() for p in parts)
+        if not header and parts:
+            words.append(parts[0])
+            rows.append([float(x) for x in parts[1:]])
+        for line in f:
+            parts = line.rstrip("\n").split(" ")
+            if len(parts) < 2:
+                continue
+            words.append(parts[0])
+            rows.append([float(x) for x in parts[1:]])
+    return _from_arrays(words, np.asarray(rows, np.float32))
+
+
+def write_word2vec_binary(vectors: SequenceVectors, path: str) -> None:
+    """Google word2vec .bin format (ref: WordVectorSerializer.writeWord2Vec
+    binary branch)."""
+    syn0 = np.asarray(vectors.syn0, np.float32)
+    words = vectors.vocab.vocab_words()
+    with open(path, "wb") as f:
+        f.write(f"{len(words)} {syn0.shape[1]}\n".encode())
+        for w in words:
+            f.write(w.word.encode("utf-8") + b" ")
+            f.write(syn0[w.index].astype("<f4").tobytes())
+            f.write(b"\n")
+
+
+def read_word2vec_binary(path: str) -> SequenceVectors:
+    """ref: WordVectorSerializer.readBinaryModel."""
+    with open(path, "rb") as f:
+        header = f.readline().decode().split()
+        V, D = int(header[0]), int(header[1])
+        words, rows = [], np.empty((V, D), np.float32)
+        for i in range(V):
+            name = bytearray()
+            while True:
+                c = f.read(1)
+                if c in (b" ", b""):
+                    break
+                if c != b"\n":
+                    name.extend(c)
+            words.append(name.decode("utf-8"))
+            rows[i] = np.frombuffer(f.read(4 * D), "<f4")
+            nl = f.read(1)
+            if nl not in (b"\n", b""):
+                f.seek(-1, 1)
+    return _from_arrays(words, rows)
+
+
+def _from_arrays(words, syn0: np.ndarray) -> SequenceVectors:
+    sv = SequenceVectors(layer_size=syn0.shape[1])
+    cache = VocabCache()
+    for w in words:
+        cache.add_token(VocabWord(w))
+    cache.build_index(order_by_frequency=False)
+    sv.vocab = cache
+    sv.syn0 = jnp.asarray(syn0)
+    return sv

@@ -1,0 +1,147 @@
+"""Tokenizer / TokenizerFactory SPI + preprocessors + stopwords.
+
+Equivalent of deeplearning4j-nlp text/tokenization/ (SURVEY §2.6): a
+Tokenizer walks one string, a TokenizerFactory makes tokenizers (so vocab
+construction and training can tokenize in parallel), and a TokenPreProcess
+normalizes each token. Mirrors DefaultTokenizer/NGramTokenizerFactory/
+CommonPreprocessor/EndingPreProcessor from the reference.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Iterable, List, Optional
+
+_PUNCT_RE = re.compile(r"[\d.:,\"'()\[\]|/?!;]+")
+
+
+class CommonPreprocessor:
+    """Lowercase + strip punctuation/digits (ref: CommonPreprocessor.java)."""
+
+    def pre_process(self, token: str) -> str:
+        return _PUNCT_RE.sub("", token).lower()
+
+    __call__ = pre_process
+
+
+class EndingPreProcessor:
+    """Crude English stemmer (ref: EndingPreProcessor.java: strips plural
+    s/ed/ing/ly endings)."""
+
+    def pre_process(self, token: str) -> str:
+        if token.endswith("s") and not token.endswith("ss"):
+            token = token[:-1]
+        if token.endswith("ed"):
+            token = token[:-2]
+        if token.endswith("ing"):
+            token = token[:-3]
+        if token.endswith("ly"):
+            token = token[:-2]
+        return token
+
+    __call__ = pre_process
+
+
+class Tokenizer:
+    """One pass over one string (ref: Tokenizer.java iface: hasMoreTokens/
+    nextToken/getTokens/countTokens)."""
+
+    def __init__(self, tokens: List[str],
+                 preprocessor: Optional[Callable[[str], str]] = None):
+        if preprocessor is not None:
+            tokens = [preprocessor(t) for t in tokens]
+            tokens = [t for t in tokens if t]
+        self._tokens = tokens
+        self._pos = 0
+
+    def has_more_tokens(self) -> bool:
+        return self._pos < len(self._tokens)
+
+    def next_token(self) -> str:
+        t = self._tokens[self._pos]
+        self._pos += 1
+        return t
+
+    def count_tokens(self) -> int:
+        return len(self._tokens)
+
+    def get_tokens(self) -> List[str]:
+        return list(self._tokens)
+
+    def __iter__(self):
+        return iter(self._tokens)
+
+
+class DefaultTokenizer(Tokenizer):
+    """Whitespace tokenizer (ref: DefaultTokenizer.java wraps Java
+    StringTokenizer)."""
+
+    def __init__(self, text: str,
+                 preprocessor: Optional[Callable[[str], str]] = None):
+        super().__init__(text.split(), preprocessor)
+
+
+class NGramTokenizer(Tokenizer):
+    """Emits n-grams (joined by space) from an underlying tokenizer
+    (ref: NGramTokenizer.java, n-grams of min..max length)."""
+
+    def __init__(self, base: Tokenizer, min_n: int, max_n: int):
+        words = base.get_tokens()
+        out: List[str] = []
+        for n in range(min_n, max_n + 1):
+            if n == 1:
+                out.extend(words)
+            else:
+                out.extend(" ".join(words[i:i + n])
+                           for i in range(len(words) - n + 1))
+        super().__init__(out)
+
+
+class TokenizerFactory:
+    """ref: TokenizerFactory.java iface."""
+
+    def __init__(self, preprocessor: Optional[Callable[[str], str]] = None):
+        self._pre = preprocessor
+
+    def set_token_pre_processor(self, pre: Callable[[str], str]) -> None:
+        self._pre = pre
+
+    def create(self, text: str) -> Tokenizer:
+        raise NotImplementedError
+
+
+class DefaultTokenizerFactory(TokenizerFactory):
+    def create(self, text: str) -> Tokenizer:
+        return DefaultTokenizer(text, self._pre)
+
+
+class NGramTokenizerFactory(TokenizerFactory):
+    def __init__(self, min_n: int = 1, max_n: int = 2,
+                 preprocessor: Optional[Callable[[str], str]] = None):
+        super().__init__(preprocessor)
+        self.min_n, self.max_n = min_n, max_n
+
+    def create(self, text: str) -> Tokenizer:
+        return NGramTokenizer(DefaultTokenizer(text, self._pre),
+                              self.min_n, self.max_n)
+
+
+class StopWords:
+    """English stopword list (ref: text/stopwords/StopWords.java loads
+    stopwords resource file)."""
+
+    _WORDS = frozenset("""a an and are as at be but by for if in into is it no
+    not of on or such that the their then there these they this to was will
+    with i me my we our you your he him his she her its who whom which what
+    so than too very can just should now were been being have has had do does
+    did doing would could from up down out over under again further once here
+    all any both each few more most other some own same s t don shouldn
+    """.split())
+
+    @classmethod
+    def get_stop_words(cls) -> frozenset:
+        return cls._WORDS
+
+    @classmethod
+    def is_stop_word(cls, w: str) -> bool:
+        return w.lower() in cls._WORDS

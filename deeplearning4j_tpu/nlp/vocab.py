@@ -1,0 +1,212 @@
+"""Vocabulary construction + Huffman coding.
+
+Equivalent of deeplearning4j-nlp wordstore/ (SURVEY §2.6):
+VocabConstructor.java:611 (frequency counting + min-freq pruning),
+AbstractCache.java:478 (index/word/frequency store), and the Huffman tree in
+models/word2vec/Huffman.java that assigns each word its hierarchical-softmax
+code path. Host-side pure Python — the trained tables live on device as JAX
+arrays (sequencevectors.py).
+"""
+
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+MAX_CODE_LENGTH = 40  # ref: Huffman.java MAX_CODE_LENGTH
+
+
+@dataclass
+class VocabWord:
+    """ref: word2vec/VocabWord.java — element frequency + HS code path."""
+    word: str
+    frequency: float = 1.0
+    index: int = -1
+    codes: List[int] = field(default_factory=list)      # huffman code bits
+    points: List[int] = field(default_factory=list)     # inner-node indices
+    is_label: bool = False                               # paravec doc labels
+
+    def increment(self, by: float = 1.0) -> None:
+        self.frequency += by
+
+
+class VocabCache:
+    """ref: wordstore/inmemory/AbstractCache.java — word<->index maps,
+    frequencies, total counts."""
+
+    def __init__(self):
+        self._words: Dict[str, VocabWord] = {}
+        self._index: List[VocabWord] = []
+        self.total_word_count: float = 0.0
+
+    # -- construction ------------------------------------------------------
+    def add_token(self, vw: VocabWord) -> VocabWord:
+        existing = self._words.get(vw.word)
+        if existing is not None:
+            existing.increment(vw.frequency)
+            return existing
+        self._words[vw.word] = vw
+        return vw
+
+    def update_words_occurrences(self, count: float = 1.0) -> None:
+        self.total_word_count += count
+
+    def build_index(self, order_by_frequency: bool = True) -> None:
+        words = list(self._words.values())
+        if order_by_frequency:
+            words.sort(key=lambda w: (-w.frequency, w.word))
+        for i, w in enumerate(words):
+            w.index = i
+        self._index = words
+
+    # -- queries (ref AbstractCache API names) -----------------------------
+    def contains_word(self, word: str) -> bool:
+        return word in self._words
+
+    def word_frequency(self, word: str) -> float:
+        w = self._words.get(word)
+        return w.frequency if w else 0.0
+
+    def index_of(self, word: str) -> int:
+        w = self._words.get(word)
+        return w.index if w else -1
+
+    def word_at_index(self, index: int) -> Optional[str]:
+        if 0 <= index < len(self._index):
+            return self._index[index].word
+        return None
+
+    def word_for(self, word: str) -> Optional[VocabWord]:
+        return self._words.get(word)
+
+    def element_at_index(self, index: int) -> Optional[VocabWord]:
+        if 0 <= index < len(self._index):
+            return self._index[index]
+        return None
+
+    def num_words(self) -> int:
+        return len(self._index) or len(self._words)
+
+    def words(self) -> List[str]:
+        return [w.word for w in self._index] if self._index \
+            else list(self._words)
+
+    def vocab_words(self) -> List[VocabWord]:
+        return list(self._index) if self._index else list(self._words.values())
+
+    def remove(self, word: str) -> None:
+        self._words.pop(word, None)
+
+    def __len__(self) -> int:
+        return self.num_words()
+
+
+def build_huffman(cache: VocabCache) -> None:
+    """Assign huffman codes/points to every vocab word
+    (ref: models/word2vec/Huffman.java applyIndexes/build: classic word2vec
+    two-min-heap merge; `points` are inner-node rows of syn1, `codes` the
+    left/right bits along the root→leaf path)."""
+    words = cache.vocab_words()
+    n = len(words)
+    if n == 0:
+        return
+    # heap of (frequency, tiebreak, node_id); leaves are 0..n-1,
+    # inner nodes n..2n-2
+    count = [w.frequency for w in words] + [0.0] * (n - 1)
+    parent = [0] * (2 * n - 1)
+    binary = [0] * (2 * n - 1)
+    heap = [(words[i].frequency, i, i) for i in range(n)]
+    heapq.heapify(heap)
+    next_id = n
+    while len(heap) > 1:
+        f1, _, a = heapq.heappop(heap)
+        f2, _, b = heapq.heappop(heap)
+        count[next_id] = f1 + f2
+        parent[a] = next_id
+        parent[b] = next_id
+        binary[b] = 1
+        heapq.heappush(heap, (f1 + f2, next_id, next_id))
+        next_id += 1
+    root = 2 * n - 2
+    for i, w in enumerate(words):
+        codes: List[int] = []
+        points: List[int] = []
+        node = i
+        while node != root:
+            codes.append(binary[node])
+            node = parent[node]
+            points.append(node - n)  # inner-node row in syn1
+        codes.reverse()
+        points.reverse()
+        w.codes = codes[:MAX_CODE_LENGTH]
+        w.points = points[:MAX_CODE_LENGTH]
+
+
+class VocabConstructor:
+    """Builds a VocabCache from token sequences
+    (ref: VocabConstructor.java:611 — addSource(iterator, minWordFrequency),
+    buildJointVocabulary; parallel counting collapses to one pass here)."""
+
+    def __init__(self, min_word_frequency: int = 1,
+                 stop_words: Sequence[str] = (),
+                 build_huffman_tree: bool = True,
+                 vocab_limit: int = 0):
+        self.min_word_frequency = min_word_frequency
+        self.stop_words = frozenset(stop_words)
+        self.build_huffman_tree = build_huffman_tree
+        self.vocab_limit = vocab_limit
+
+    def build(self, sequences: Iterable[Sequence[str]]) -> VocabCache:
+        cache = VocabCache()
+        for seq in sequences:
+            for tok in seq:
+                if not tok or tok in self.stop_words:
+                    continue
+                cache.add_token(VocabWord(tok))
+                cache.update_words_occurrences()
+        if self.min_word_frequency > 1:
+            for w in list(cache._words.values()):
+                if w.frequency < self.min_word_frequency and not w.is_label:
+                    cache.remove(w.word)
+        cache.build_index()
+        if self.vocab_limit and cache.num_words() > self.vocab_limit:
+            keep = cache.vocab_words()[:self.vocab_limit]
+            cache._words = {w.word: w for w in keep}
+            cache.build_index()
+        if self.build_huffman_tree:
+            build_huffman(cache)
+        return cache
+
+
+def make_unigram_table(cache: VocabCache, table_size: int = 1 << 20,
+                       power: float = 0.75) -> np.ndarray:
+    """Negative-sampling unigram table (ref: InMemoryLookupTable.java
+    makeTable: index repeated proportionally to freq^0.75)."""
+    n = cache.num_words()
+    freqs = np.array([w.frequency for w in cache.vocab_words()], np.float64)
+    probs = freqs ** power
+    probs /= probs.sum()
+    counts = np.maximum(1, np.round(probs * table_size)).astype(np.int64)
+    table = np.repeat(np.arange(n), counts)
+    return table.astype(np.int32)
+
+
+def codes_points_arrays(cache: VocabCache):
+    """Pad every word's huffman path to a fixed length for device-side HS:
+    returns (codes [V,L] float32, points [V,L] int32, mask [V,L] float32)."""
+    words = cache.vocab_words()
+    maxlen = max((len(w.codes) for w in words), default=1)
+    maxlen = max(maxlen, 1)
+    V = len(words)
+    codes = np.zeros((V, maxlen), np.float32)
+    points = np.zeros((V, maxlen), np.int32)
+    mask = np.zeros((V, maxlen), np.float32)
+    for i, w in enumerate(words):
+        L = len(w.codes)
+        codes[i, :L] = w.codes
+        points[i, :L] = w.points
+        mask[i, :L] = 1.0
+    return codes, points, mask

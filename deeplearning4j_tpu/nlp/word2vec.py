@@ -1,0 +1,47 @@
+"""Word2Vec facade over SequenceVectors.
+
+Equivalent of deeplearning4j-nlp models/word2vec/Word2Vec.java:621 — a
+builder that wires a SentenceIterator + TokenizerFactory into the generic
+SequenceVectors engine (SkipGram/CBOW, HS or negative sampling).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence
+
+from deeplearning4j_tpu.nlp.sentence import SentenceIterator
+from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizerFactory, TokenizerFactory,
+)
+
+
+class Word2Vec(SequenceVectors):
+    """ref: Word2Vec.java Builder — iterate(SentenceIterator),
+    tokenizerFactory, then fit(). Defaults follow SequenceVectors.java
+    :375-386 (lr .025, layerSize 100, window 5)."""
+
+    def __init__(self, sentence_iterator: Optional[SentenceIterator] = None,
+                 tokenizer_factory: Optional[TokenizerFactory] = None,
+                 min_word_frequency: int = 5, **kwargs):
+        super().__init__(min_word_frequency=min_word_frequency, **kwargs)
+        self.sentence_iterator = sentence_iterator
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+
+    def _tokenized(self) -> List[List[str]]:
+        if self.sentence_iterator is None:
+            raise RuntimeError("no sentence iterator configured")
+        return [self.tokenizer_factory.create(s).get_tokens()
+                for s in self.sentence_iterator]
+
+    def fit(self, sequences: Optional[Iterable[Sequence[str]]] = None,
+            **kwargs) -> "Word2Vec":
+        seqs = list(sequences) if sequences is not None else self._tokenized()
+        if self.vocab is None:
+            self.build_vocab(seqs)
+        super().fit(seqs, **kwargs)
+        return self
+
+    # DL4J naming convenience
+    def vec(self, word: str):
+        return self.get_word_vector(word)

@@ -10,6 +10,8 @@ bias+activation, CudnnConvolutionHelper.java:435-436, comes for free here).
 
 from __future__ import annotations
 
+import re
+
 import jax
 import jax.numpy as jnp
 
@@ -97,10 +99,21 @@ def register(name: str, fn) -> None:
 
 
 def get(name):
-    """Resolve an activation by name (case-insensitive) or pass through callables."""
+    """Resolve an activation by name (case-insensitive) or pass through
+    callables. Parameterized form "name(0.3)" binds the function's second
+    positional parameter (e.g. leakyrelu alpha, thresholdedrelu theta) —
+    mirrors the reference's IActivation configs carrying an alpha
+    (ActivationLReLU.java)."""
     if callable(name):
         return name
     key = str(name).lower()
+    m = re.fullmatch(r"(\w+)\(([-+0-9.e]+)\)", key)
+    if m:
+        base, param = m.group(1), float(m.group(2))
+        if base not in ACTIVATIONS:
+            raise ValueError(f"Unknown activation '{base}'")
+        fn = ACTIVATIONS[base]
+        return lambda x: fn(x, param)
     if key not in ACTIVATIONS:
         raise ValueError(f"Unknown activation '{name}'. Known: {sorted(ACTIVATIONS)}")
     return ACTIVATIONS[key]

@@ -159,8 +159,9 @@ class ComputationGraph:
                 ins = self.conf.vertex_inputs[out_name]
                 lmask = next((masks.get(i_) for i_ in ins if masks.get(i_) is not None),
                              None)
-            total = total + v.layer.compute_score(
-                y, acts[out_name].astype(jnp.float32), lmask)
+            a_out = acts[out_name]
+            a_out = a_out.astype(jnp.promote_types(a_out.dtype, jnp.float32))
+            total = total + v.layer.compute_score(y, a_out, lmask)
             if isinstance(v.layer, CenterLossOutputLayer):
                 ins = self.conf.vertex_inputs[out_name]
                 feats = acts[ins[0]]

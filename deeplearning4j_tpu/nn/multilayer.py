@@ -168,7 +168,8 @@ class MultiLayerNetwork:
         if not hasattr(out_layer, "compute_score"):
             raise ValueError("last layer must be an output layer to compute loss")
         preout = out_layer.preout(params[str(out_idx)], h, train=train, rng=rng_o)
-        preout = preout.astype(jnp.float32)  # loss in fp32 under mixed precision
+        # loss in >=fp32 under mixed precision (keeps f64 for gradient checks)
+        preout = preout.astype(jnp.promote_types(preout.dtype, jnp.float32))
         score = out_layer.compute_score(y, preout, mask)
         o_state = state.get(str(out_idx), {})
         if isinstance(out_layer, CenterLossOutputLayer):

@@ -34,7 +34,20 @@ def check_gradients_fn(loss_fn, params, eps: float = DEFAULT_EPS,
     loss_fn: params_pytree -> scalar. Checks up to `max_per_param` randomly
     chosen elements per parameter array (the reference checks every element;
     sampling keeps large nets tractable — pass max_per_param=0 for all).
+
+    Runs under a local enable_x64 scope: central differences with eps=1e-5
+    are meaningless in float32 (the reference runs on float64 ND4J arrays,
+    GradientCheckUtil.java:112 requires DataBuffer.Type.DOUBLE).
     """
+    with jax.enable_x64(True):
+        return _check_gradients_fn_x64(loss_fn, params, eps, max_rel_error,
+                                       min_abs_error, max_per_param, seed,
+                                       print_failures)
+
+
+def _check_gradients_fn_x64(loss_fn, params, eps, max_rel_error,
+                            min_abs_error, max_per_param, seed,
+                            print_failures) -> bool:
     params = jax.tree_util.tree_map(lambda a: jnp.asarray(a, jnp.float64), params)
     analytic = jax.grad(loss_fn)(params)
     flat_p, treedef = jax.tree_util.tree_flatten(params)
@@ -87,6 +100,15 @@ def check_gradients(net, ds, eps: float = DEFAULT_EPS,
 
     if not net._initialized:
         net.init()
+    with jax.enable_x64(True):
+        return _check_gradients_x64(net, ds, eps, max_rel_error,
+                                    min_abs_error, max_per_param, seed)
+
+
+def _check_gradients_x64(net, ds, eps, max_rel_error, min_abs_error,
+                         max_per_param, seed) -> bool:
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
     x = jnp.asarray(ds.features, jnp.float64)
     y = jnp.asarray(ds.labels, jnp.float64)
     fmask = None if ds.features_mask is None else jnp.asarray(ds.features_mask)

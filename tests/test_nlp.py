@@ -1,0 +1,215 @@
+"""NLP stack tests (ref test models: deeplearning4j-nlp-parent tests —
+Word2VecTests, ParagraphVectorsTest, GloveTest, TsneTest patterns: train on
+a tiny synthetic corpus, assert related words are nearer than unrelated)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    BagOfWordsVectorizer, BasicLineIterator, CollectionSentenceIterator,
+    CommonPreprocessor, CnnSentenceDataSetIterator, DefaultTokenizerFactory,
+    Glove, LabelledDocument, NGramTokenizerFactory, ParagraphVectors,
+    SimpleLabelAwareIterator, StopWords, TfidfVectorizer, VocabConstructor,
+    Word2Vec, read_word2vec_binary, read_word_vectors, write_word2vec_binary,
+    write_word_vectors,
+)
+from deeplearning4j_tpu.nlp.vocab import build_huffman
+
+
+# deterministic synthetic corpus: two topic clusters
+def corpus(n=300, seed=7):
+    rng = np.random.default_rng(seed)
+    animals = ["cat", "dog", "mouse", "horse"]
+    foods = ["bread", "cheese", "apple", "milk"]
+    sents = []
+    for _ in range(n):
+        if rng.random() < 0.5:
+            sents.append(" ".join(rng.choice(animals, 6)))
+        else:
+            sents.append(" ".join(rng.choice(foods, 6)))
+    return sents
+
+
+class TestTokenization:
+    def test_default_tokenizer(self):
+        tf = DefaultTokenizerFactory(CommonPreprocessor())
+        t = tf.create("Hello, World! 123 foo")
+        assert t.get_tokens() == ["hello", "world", "foo"]
+
+    def test_ngram(self):
+        tf = NGramTokenizerFactory(1, 2)
+        toks = tf.create("a b c").get_tokens()
+        assert "a b" in toks and "b c" in toks and "a" in toks
+
+    def test_stopwords(self):
+        assert StopWords.is_stop_word("the")
+        assert not StopWords.is_stop_word("cat")
+
+
+class TestVocab:
+    def test_min_frequency_and_index(self):
+        cache = VocabConstructor(min_word_frequency=2).build(
+            [["a", "a", "b", "b", "b", "c"]])
+        assert cache.contains_word("a") and cache.contains_word("b")
+        assert not cache.contains_word("c")
+        # index ordered by frequency
+        assert cache.index_of("b") == 0
+
+    def test_huffman_codes(self):
+        cache = VocabConstructor().build(
+            [["a"] * 8 + ["b"] * 4 + ["c"] * 2 + ["d"]])
+        # most frequent word gets shortest code
+        wa = cache.word_for("a")
+        wd = cache.word_for("d")
+        assert len(wa.codes) <= len(wd.codes)
+        # prefix-free: no code is a prefix of another
+        codes = ["".join(map(str, w.codes)) for w in cache.vocab_words()]
+        for i, c1 in enumerate(codes):
+            for j, c2 in enumerate(codes):
+                if i != j:
+                    assert not c2.startswith(c1)
+
+
+class TestWord2Vec:
+    @pytest.mark.parametrize("kwargs", [
+        dict(negative=5, use_hierarchic_softmax=False),  # negative sampling
+        dict(negative=0),                                # hierarchical softmax
+        dict(negative=5, use_hierarchic_softmax=False,
+             elements_learning_algorithm="cbow"),
+    ])
+    def test_clusters(self, kwargs):
+        w2v = Word2Vec(
+            sentence_iterator=CollectionSentenceIterator(corpus()),
+            min_word_frequency=1, layer_size=16, window=3, epochs=3,
+            learning_rate=0.05, seed=1, **kwargs)
+        w2v.fit()
+        sim_in = w2v.similarity("cat", "dog")
+        sim_out = w2v.similarity("cat", "bread")
+        assert sim_in > sim_out
+        assert "dog" in w2v.words_nearest("cat", top_n=3)
+
+    def test_serialization_roundtrip(self, tmp_path):
+        w2v = Word2Vec(
+            sentence_iterator=CollectionSentenceIterator(corpus(50)),
+            min_word_frequency=1, layer_size=8, epochs=1, negative=2,
+            use_hierarchic_softmax=False)
+        w2v.fit()
+        txt = tmp_path / "vecs.txt"
+        write_word_vectors(w2v, str(txt))
+        loaded = read_word_vectors(str(txt))
+        np.testing.assert_allclose(loaded.get_word_vector("cat"),
+                                   w2v.get_word_vector("cat"), atol=1e-5)
+        binp = tmp_path / "vecs.bin"
+        write_word2vec_binary(w2v, str(binp))
+        loaded_b = read_word2vec_binary(str(binp))
+        np.testing.assert_allclose(loaded_b.get_word_vector("dog"),
+                                   w2v.get_word_vector("dog"), atol=1e-6)
+
+    def test_basic_line_iterator(self, tmp_path):
+        p = tmp_path / "corpus.txt"
+        p.write_text("\n".join(corpus(20)))
+        w2v = Word2Vec(sentence_iterator=BasicLineIterator(str(p)),
+                       min_word_frequency=1, layer_size=4, epochs=1)
+        w2v.fit()
+        assert w2v.get_word_vector("cat") is not None
+
+
+class TestParagraphVectors:
+    def _docs(self, n=120, seed=3):
+        rng = np.random.default_rng(seed)
+        docs = []
+        for i in range(n):
+            if rng.random() < 0.5:
+                docs.append(LabelledDocument(
+                    " ".join(rng.choice(["cat", "dog", "mouse"], 8)),
+                    [f"animal_{i}"]))
+            else:
+                docs.append(LabelledDocument(
+                    " ".join(rng.choice(["bread", "cheese", "apple"], 8)),
+                    [f"food_{i}"]))
+        return docs
+
+    @pytest.mark.parametrize("algo", ["dbow", "dm"])
+    def test_doc_vectors_cluster(self, algo):
+        docs = self._docs()
+        pv = ParagraphVectors(
+            label_aware_iterator=SimpleLabelAwareIterator(docs),
+            sequence_learning_algorithm=algo, layer_size=12, epochs=3,
+            negative=4, use_hierarchic_softmax=False, learning_rate=0.05,
+            min_word_frequency=1, seed=1)
+        pv.fit()
+        va = [pv.get_label_vector(d.label) for d in docs
+              if d.label.startswith("animal")][:20]
+        vf = [pv.get_label_vector(d.label) for d in docs
+              if d.label.startswith("food")][:20]
+
+        def cos(a, b):
+            return a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12)
+
+        within = np.mean([cos(va[i], va[j]) for i in range(5)
+                          for j in range(5, 10)])
+        across = np.mean([cos(va[i], vf[j]) for i in range(5)
+                          for j in range(5)])
+        assert within > across
+
+    def test_infer_vector(self):
+        docs = self._docs(60)
+        pv = ParagraphVectors(
+            label_aware_iterator=SimpleLabelAwareIterator(docs),
+            layer_size=12, epochs=2, negative=4,
+            use_hierarchic_softmax=False, min_word_frequency=1, seed=1)
+        pv.fit()
+        v = pv.infer_vector("cat dog cat mouse dog")
+        assert v.shape == (12,)
+        assert np.isfinite(v).all()
+        # inferring must not grow the vocab table
+        assert pv.syn0.shape[0] == pv.vocab.num_words()
+
+
+class TestGlove:
+    def test_loss_decreases_and_clusters(self):
+        g = Glove(layer_size=12, window=3, epochs=8, learning_rate=0.1,
+                  min_word_frequency=1, seed=1)
+        seqs = [s.split() for s in corpus(200)]
+        g.fit(seqs)
+        assert g.loss_history[-1] < g.loss_history[0]
+        assert g.similarity("cat", "dog") > g.similarity("cat", "bread")
+
+
+class TestVectorizers:
+    def test_bow_counts(self):
+        bow = BagOfWordsVectorizer().fit(["a b a", "b c"])
+        v = bow.transform("a a c")
+        assert v[bow.vocab.index_of("a")] == 2
+        assert v[bow.vocab.index_of("c")] == 1
+
+    def test_tfidf(self):
+        tv = TfidfVectorizer().fit(["a b", "a c", "a d"])
+        v = tv.transform("a b")
+        # "a" appears in all docs → idf 0; "b" in one → positive
+        assert v[tv.vocab.index_of("a")] == 0.0
+        assert v[tv.vocab.index_of("b")] > 0.0
+
+    def test_vectorize_dataset(self):
+        bow = BagOfWordsVectorizer().fit(["a b", "c d"])
+        ds = bow.vectorize(["a b", "c d"], labels=[0, 1])
+        assert ds.features.shape[0] == 2
+        assert ds.labels.shape == (2, 2)
+
+
+class TestCnnSentence:
+    def test_shapes_and_mask(self):
+        w2v = Word2Vec(
+            sentence_iterator=CollectionSentenceIterator(corpus(30)),
+            min_word_frequency=1, layer_size=8, epochs=1)
+        w2v.fit()
+        it = CnnSentenceDataSetIterator(
+            w2v, [("cat dog", "animal"), ("bread cheese apple", "food")],
+            labels=["animal", "food"], batch_size=2, max_sentence_length=5)
+        ds = next(iter(it))
+        assert ds.features.shape == (2, 1, 5, 8)
+        assert ds.features_mask[0].sum() == 2  # "cat dog"
+        assert ds.features_mask[1].sum() == 3
+        assert ds.labels[0, 0] == 1.0 and ds.labels[1, 1] == 1.0
