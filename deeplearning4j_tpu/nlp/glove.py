@@ -61,6 +61,9 @@ class Glove(SequenceVectors):
                          batch_size=batch_size,
                          min_word_frequency=min_word_frequency,
                          seed=seed, **kwargs)
+        # GloVe factorizes co-occurrences directly — no HS/NS output tables,
+        # so skip the Huffman build + syn1 allocation in _reset_weights
+        self.use_hs = False
         self.x_max = x_max
         self.alpha = alpha
         self.symmetric = symmetric

@@ -245,26 +245,31 @@ class SequenceVectors:
             out.append(i)
         return np.asarray(out, np.int32)
 
-    def _pairs(self, idxs: np.ndarray, label_rows: Optional[List[int]]):
-        """(input=context-or-label row, predict=center word) pairs,
-        mirroring word2vec C / SkipGram.java windowing with random window
-        shrink b ∈ [0, window)."""
+    def _pairs(self, idxs: np.ndarray):
+        """(input=context row, predict=center word) window pairs, mirroring
+        word2vec C / SkipGram.java windowing with random window shrink
+        b ∈ [0, window): offsets b-window .. window-b inclusive, skip 0."""
         ins, outs = [], []
         n = len(idxs)
         for pos in range(n):
             b = int(self._rng.integers(0, self.window))
-            for off in range(b - self.window + 1, self.window - b):
+            for off in range(b - self.window, self.window - b + 1):
                 if off == 0:
                     continue
                 c = pos + off
                 if 0 <= c < n:
                     ins.append(idxs[c])
                     outs.append(idxs[pos])
-        if label_rows:
-            for lr_ in label_rows:  # DBOW: label row predicts every word
-                for w in idxs:
-                    ins.append(lr_)
-                    outs.append(w)
+        return np.asarray(ins, np.int32), np.asarray(outs, np.int32)
+
+    @staticmethod
+    def _label_pairs(idxs: np.ndarray, label_rows: List[int]):
+        """DBOW: each label row predicts every word of the sequence."""
+        ins, outs = [], []
+        for lr_ in label_rows:
+            for w in idxs:
+                ins.append(lr_)
+                outs.append(w)
         return np.asarray(ins, np.int32), np.asarray(outs, np.int32)
 
     def _train_skipgram(self, idxs, alpha, label_rows=None, *,
@@ -272,13 +277,9 @@ class SequenceVectors:
         if not train_words:
             ins, outs = (np.empty(0, np.int32),) * 2
         else:
-            ins, outs = self._pairs(idxs, None)
+            ins, outs = self._pairs(idxs)
         if train_labels and label_rows:
-            li, lo = self._pairs(idxs, label_rows)
-            # keep only the label→word pairs when words are frozen
-            if not train_words:
-                keep = np.isin(li, label_rows)
-                li, lo = li[keep], lo[keep]
+            li, lo = self._label_pairs(idxs, label_rows)
             ins = np.concatenate([ins, li]) if ins.size else li
             outs = np.concatenate([outs, lo]) if outs.size else lo
         for s in range(0, len(ins), self.batch_size):
@@ -307,7 +308,7 @@ class SequenceVectors:
         for pos in range(n):
             b = int(self._rng.integers(0, self.window))
             k = 0
-            for off in range(b - self.window + 1, self.window - b):
+            for off in range(b - self.window, self.window - b + 1):
                 if off == 0:
                     continue
                 c = pos + off

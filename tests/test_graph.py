@@ -1,0 +1,143 @@
+"""Graph module tests (ref: deeplearning4j-graph/src/test — TestGraph,
+TestGraphLoading, DeepWalkGradientCheck/TestDeepWalk)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graph import (
+    DeepWalk, Graph, GraphLoader, GraphVectors, NoEdgeHandling,
+    RandomWalkIterator, WeightedRandomWalkIterator,
+)
+from deeplearning4j_tpu.graph.walks import generate_walks
+
+
+def ring_graph(n=10):
+    g = Graph(n)
+    for i in range(n):
+        g.add_edge(i, (i + 1) % n)
+    return g
+
+
+class TestGraphStructure:
+    def test_adjacency(self):
+        g = ring_graph(10)
+        assert g.num_vertices() == 10
+        assert sorted(g.get_connected_vertices(0)) == [1, 9]
+        assert g.get_degree(0) == 2
+
+    def test_directed(self):
+        g = Graph(3, directed=True)
+        g.add_edge(0, 1)
+        assert g.get_connected_vertices(0) == [1]
+        assert g.get_connected_vertices(1) == []
+
+    def test_edge_out_of_range(self):
+        g = Graph(3)
+        with pytest.raises(ValueError):
+            g.add_edge(0, 5)
+
+    def test_loader_edge_list(self):
+        lines = ["0 1", "1 2", "# comment", "2 0"]
+        g = GraphLoader.load_edge_list(lines, num_vertices=3)
+        assert g.get_degree(0) == 2
+
+    def test_loader_weighted_edge_list(self):
+        g = GraphLoader.load_edge_list(["0 1 2.5", "1 2 0.5"],
+                                       num_vertices=3, weighted=True)
+        assert g.get_connected_vertex_weights(0) == [(1, 2.5)]
+
+    def test_loader_adjacency_list(self):
+        g = GraphLoader.load_adjacency_list(["0 1 2", "1 2", "2"])
+        assert g.num_vertices() == 3
+        assert sorted(g.get_connected_vertices(0)) == [1, 2]
+
+
+class TestWalks:
+    def test_walk_length_and_coverage(self):
+        g = ring_graph(8)
+        it = RandomWalkIterator(g, walk_length=5, seed=1)
+        walks = list(it)
+        assert len(walks) == 8
+        starts = sorted(w[0] for w in walks)
+        assert starts == list(range(8))  # one walk per vertex
+        for w in walks:
+            assert len(w) == 6
+            for a, b in zip(w, w[1:]):  # ring: steps move +-1 mod n
+                assert (b - a) % 8 in (1, 7)
+
+    def test_disconnected_self_loop(self):
+        g = Graph(3)
+        g.add_edge(0, 1)
+        it = RandomWalkIterator(g, walk_length=3, seed=0)
+        for w in it:
+            if w[0] == 2:
+                assert w == [2, 2, 2, 2]
+
+    def test_disconnected_exception(self):
+        g = Graph(2)
+        it = RandomWalkIterator(
+            g, walk_length=1,
+            no_edge_handling=NoEdgeHandling.EXCEPTION_ON_DISCONNECTED)
+        with pytest.raises(RuntimeError):
+            list(it)
+
+    def test_weighted_walks_follow_weights(self):
+        # vertex 0 connects to 1 (weight 100) and 2 (weight ~0)
+        g = Graph(3, directed=True)
+        g.add_edge(0, 1, weight=100.0, directed=True)
+        g.add_edge(0, 2, weight=1e-9, directed=True)
+        g.add_edge(1, 0, directed=True)
+        g.add_edge(2, 0, directed=True)
+        it = WeightedRandomWalkIterator(g, walk_length=1, seed=3)
+        hits = [w[1] for w in it if w[0] == 0]
+        assert hits == [1]
+
+    def test_generate_walks_multiple(self):
+        g = ring_graph(5)
+        walks = generate_walks(g, walk_length=3, walks_per_vertex=4)
+        assert len(walks) == 20
+
+
+class TestDeepWalk:
+    def test_two_clusters_embedding(self):
+        # two cliques joined by one edge: vertices embed near own clique
+        n = 6
+        g = Graph(2 * n)
+        for base in (0, n):
+            for i in range(n):
+                for j in range(i + 1, n):
+                    g.add_edge(base + i, base + j)
+        g.add_edge(0, n)
+        dw = DeepWalk(vector_size=16, window_size=3, walk_length=10,
+                      walks_per_vertex=8, epochs=3, seed=7,
+                      learning_rate=0.05)
+        gv = dw.fit(g)
+        assert gv.vectors.shape == (2 * n, 16)
+        # same-clique similarity should beat cross-clique on average
+        same = np.mean([gv.similarity(1, 2), gv.similarity(2, 3),
+                        gv.similarity(n + 1, n + 2)])
+        cross = np.mean([gv.similarity(1, n + 1), gv.similarity(2, n + 2),
+                         gv.similarity(3, n + 3)])
+        assert same > cross
+
+    def test_isolated_vertex_gets_vector(self):
+        g = Graph(4)
+        g.add_edge(0, 1)
+        g.add_edge(1, 2)
+        dw = DeepWalk(vector_size=8, walk_length=4, epochs=1, seed=0)
+        gv = dw.fit(g)
+        assert gv.vectors.shape == (4, 8)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        gv = GraphVectors(np.random.default_rng(0)
+                          .standard_normal((5, 4)).astype(np.float32))
+        p = str(tmp_path / "gv.txt")
+        gv.save(p)
+        gv2 = GraphVectors.load(p)
+        np.testing.assert_allclose(gv.vectors, gv2.vectors, rtol=1e-5)
+
+    def test_nearest(self):
+        vecs = np.eye(4, dtype=np.float32)
+        vecs[1] = [0.9, 0.1, 0, 0]
+        gv = GraphVectors(vecs)
+        assert gv.vertices_nearest(0, top_n=1) == [1]

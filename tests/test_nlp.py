@@ -213,3 +213,29 @@ class TestCnnSentence:
         assert ds.features_mask[0].sum() == 2  # "cat dog"
         assert ds.features_mask[1].sum() == 3
         assert ds.labels[0, 0] == 1.0 and ds.labels[1, 1] == 1.0
+
+
+class TestWindowingRegression:
+    def test_window1_generates_pairs(self):
+        # regression: offsets must span b-window..window-b inclusive, so
+        # window=1 (b always 0) still yields the +-1 context pairs
+        from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
+        sv = SequenceVectors(layer_size=8, window=1, min_word_frequency=0,
+                             epochs=1, seed=0)
+        seqs = [["a", "b", "c", "d"]] * 3
+        sv.build_vocab(seqs)
+        ins, outs = sv._pairs(np.arange(4, dtype=np.int32))
+        assert len(ins) == 6  # interior words give 2 pairs, ends give 1
+
+    def test_label_pairs_not_duplicating_words(self):
+        from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
+        idxs = np.arange(5, dtype=np.int32)
+        li, lo = SequenceVectors._label_pairs(idxs, [7, 9])
+        assert len(li) == 10 and set(li.tolist()) == {7, 9}
+        assert lo.tolist() == idxs.tolist() * 2
+
+    def test_glove_skips_hs_tables(self):
+        from deeplearning4j_tpu.nlp import Glove
+        gl = Glove(layer_size=8, epochs=1)
+        gl.build_vocab([["x", "y", "z"]] * 2)
+        assert gl.syn1 is None
