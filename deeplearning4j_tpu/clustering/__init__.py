@@ -1,0 +1,19 @@
+"""Nearest neighbors + clustering.
+
+TPU-native equivalent of deeplearning4j-nearestneighbors-parent (SURVEY §2.10):
+clustering/kdtree/KDTree.java, vptree/VPTree.java (+VPTreeFillSearch),
+sptree/SpTree.java, quadtree/QuadTree.java, kmeans/KMeansClustering.java and
+the BaseClusteringAlgorithm strategy/condition framework.
+
+The idiomatic TPU fast path is batched brute force — one [Q,N] distance
+matrix per query block rides the MXU (knn.py), and the k-means assignment
+step is the same kernel. The tree structures (KD/VP/Quad/SP) are host-side:
+they exist for API parity, CPU-bound callers, and Barnes-Hut t-SNE.
+"""
+
+from deeplearning4j_tpu.clustering.knn import NearestNeighbors, knn_search  # noqa: F401
+from deeplearning4j_tpu.clustering.kdtree import KDTree  # noqa: F401
+from deeplearning4j_tpu.clustering.vptree import VPTree, VPTreeFillSearch  # noqa: F401
+from deeplearning4j_tpu.clustering.quadtree import QuadTree  # noqa: F401
+from deeplearning4j_tpu.clustering.sptree import SpTree  # noqa: F401
+from deeplearning4j_tpu.clustering.kmeans import KMeansClustering, ClusterSet  # noqa: F401

@@ -1,0 +1,90 @@
+"""KD-tree (host-side) for exact low-dimensional nearest neighbor.
+
+Equivalent of nearestneighbor-core clustering/kdtree/KDTree.java (insert,
+nn search, knn, delete). Host numpy — tree traversal is pointer-chasing,
+which does not map to XLA; the device path for bulk queries is
+clustering.knn.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class _Node:
+    __slots__ = ("point", "left", "right")
+
+    def __init__(self, point: np.ndarray):
+        self.point = point
+        self.left: Optional["_Node"] = None
+        self.right: Optional["_Node"] = None
+
+
+class KDTree:
+    """ref: KDTree.java — axis cycles with depth; Euclidean metric."""
+
+    def __init__(self, dims: int):
+        self.dims = dims
+        self._root: Optional[_Node] = None
+        self._size = 0
+
+    def size(self) -> int:
+        return self._size
+
+    def insert(self, point) -> None:
+        p = np.asarray(point, np.float64)
+        if p.shape != (self.dims,):
+            raise ValueError(f"expected point of dim {self.dims}")
+        self._size += 1
+        if self._root is None:
+            self._root = _Node(p)
+            return
+        node, depth = self._root, 0
+        while True:
+            axis = depth % self.dims
+            if p[axis] < node.point[axis]:
+                if node.left is None:
+                    node.left = _Node(p)
+                    return
+                node = node.left
+            else:
+                if node.right is None:
+                    node.right = _Node(p)
+                    return
+                node = node.right
+            depth += 1
+
+    def nn(self, point) -> Tuple[Optional[np.ndarray], float]:
+        """Nearest neighbor (ref: KDTree.nn)."""
+        res = self.knn(point, 1)
+        return (res[0][1], res[0][0]) if res else (None, float("inf"))
+
+    def knn(self, point, k: int) -> List[Tuple[float, np.ndarray]]:
+        """k nearest as [(distance, point)] sorted ascending."""
+        q = np.asarray(point, np.float64)
+        heap: List[Tuple[float, int, np.ndarray]] = []  # max-heap by -dist
+        counter = [0]
+
+        def visit(node: Optional[_Node], depth: int):
+            if node is None:
+                return
+            d = float(np.linalg.norm(node.point - q))
+            if len(heap) < k:
+                heapq.heappush(heap, (-d, counter[0], node.point))
+                counter[0] += 1
+            elif d < -heap[0][0]:
+                heapq.heapreplace(heap, (-d, counter[0], node.point))
+                counter[0] += 1
+            axis = depth % self.dims
+            diff = q[axis] - node.point[axis]
+            near, far = (node.left, node.right) if diff < 0 \
+                else (node.right, node.left)
+            visit(near, depth + 1)
+            if len(heap) < k or abs(diff) < -heap[0][0]:
+                visit(far, depth + 1)
+
+        visit(self._root, 0)
+        return sorted([(-nd, pt) for nd, _, pt in heap], key=lambda t: t[0])

@@ -1,0 +1,123 @@
+"""K-means clustering with device-side assignment + update steps.
+
+Equivalent of nearestneighbor-core clustering/kmeans/KMeansClustering.java and
+the BaseClusteringAlgorithm framework (ClusteringStrategy, iteration
+conditions — algorithm/BaseClusteringAlgorithm.java, condition
+VarianceVariationCondition / FixedIterationCountCondition).
+
+TPU-first: the reference loops point-by-point over a ClusterSet; here each
+iteration is two jitted kernels — a [N,K] distance matmul + argmin
+(assignment, MXU) and a segment-sum centroid update — so the whole Lloyd
+step runs on device regardless of N.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _lloyd_step(points, centroids, k: int):
+    """One Lloyd iteration: assign to nearest centroid, recompute means.
+    Empty clusters keep their previous centroid."""
+    p2 = jnp.sum(points * points, axis=1)
+    c2 = jnp.sum(centroids * centroids, axis=1)
+    d2 = p2[:, None] - 2.0 * points @ centroids.T + c2[None, :]  # [N,K]
+    assign = jnp.argmin(d2, axis=1)                              # [N]
+    sums = jax.ops.segment_sum(points, assign, num_segments=k)   # [K,D]
+    counts = jax.ops.segment_sum(jnp.ones(points.shape[0]), assign,
+                                 num_segments=k)                 # [K]
+    new_c = jnp.where(counts[:, None] > 0,
+                      sums / jnp.maximum(counts[:, None], 1.0), centroids)
+    cost = jnp.sum(jnp.take_along_axis(d2, assign[:, None], axis=1))
+    return new_c, assign, cost
+
+
+@dataclass
+class Cluster:
+    """One cluster: centroid + member point indices
+    (ref: cluster/Cluster.java)."""
+    center: np.ndarray
+    point_indices: List[int] = field(default_factory=list)
+
+
+@dataclass
+class ClusterSet:
+    """Result of clustering (ref: cluster/ClusterSet.java)."""
+    clusters: List[Cluster]
+    assignments: np.ndarray
+    cost: float
+
+    def get_cluster_count(self) -> int:
+        return len(self.clusters)
+
+    def nearest_cluster(self, point) -> int:
+        centers = np.stack([c.center for c in self.clusters])
+        d = np.linalg.norm(centers - np.asarray(point), axis=1)
+        return int(np.argmin(d))
+
+
+class KMeansClustering:
+    """ref: KMeansClustering.setup(clusterCount, maxIterationCount, ...) /
+    setup(clusterCount, minDistributionVariationRate, ...) — both stopping
+    strategies supported."""
+
+    def __init__(self, cluster_count: int, max_iterations: int = 100,
+                 min_variation_rate: Optional[float] = None,
+                 init: str = "kmeans++", seed: int = 42):
+        self.k = cluster_count
+        self.max_iterations = max_iterations
+        self.min_variation_rate = min_variation_rate
+        self.init = init
+        self.seed = seed
+        self.cost_history: List[float] = []
+
+    def apply_to(self, points) -> ClusterSet:
+        pts = np.asarray(points, np.float32)
+        n = pts.shape[0]
+        if n < self.k:
+            raise ValueError(f"need >= {self.k} points, got {n}")
+        centroids = jnp.asarray(self._init_centroids(pts))
+        dev_pts = jnp.asarray(pts)
+        self.cost_history = []
+        assign = None
+        prev_cost = None
+        for _ in range(self.max_iterations):
+            centroids, assign, cost = _lloyd_step(dev_pts, centroids, self.k)
+            cost = float(cost)
+            self.cost_history.append(cost)
+            if prev_cost is not None:
+                if cost == 0.0 or (
+                        self.min_variation_rate is not None and
+                        abs(prev_cost - cost) / max(prev_cost, 1e-12)
+                        < self.min_variation_rate):
+                    break
+                if cost == prev_cost:
+                    break
+            prev_cost = cost
+        assign_np = np.asarray(assign)
+        cent_np = np.asarray(centroids)
+        clusters = [Cluster(cent_np[i],
+                            np.nonzero(assign_np == i)[0].tolist())
+                    for i in range(self.k)]
+        return ClusterSet(clusters, assign_np, self.cost_history[-1])
+
+    def _init_centroids(self, pts: np.ndarray) -> np.ndarray:
+        rng = np.random.default_rng(self.seed)
+        if self.init == "random":
+            idx = rng.choice(pts.shape[0], self.k, replace=False)
+            return pts[idx]
+        # k-means++ (ref picks random initial centers; ++ strictly improves)
+        centers = [pts[rng.integers(0, pts.shape[0])]]
+        for _ in range(1, self.k):
+            d2 = np.min(
+                [np.sum((pts - c) ** 2, axis=1) for c in centers], axis=0)
+            probs = d2 / max(d2.sum(), 1e-12)
+            centers.append(pts[rng.choice(pts.shape[0], p=probs)])
+        return np.stack(centers)

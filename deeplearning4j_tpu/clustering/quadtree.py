@@ -1,0 +1,106 @@
+"""Quad-tree over 2-D points (Barnes-Hut helper).
+
+Equivalent of nearestneighbor-core clustering/quadtree/QuadTree.java:
+bounded cells with center-of-mass, subdivide at capacity, used by 2-D
+Barnes-Hut t-SNE gradient approximation.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+QT_NODE_CAPACITY = 1  # ref: QuadTree.java QT_NO_DIMS=2, capacity 1
+
+
+class Cell:
+    """Axis-aligned half-width box (ref: quadtree/Cell.java)."""
+
+    def __init__(self, x: float, y: float, hw: float, hh: float):
+        self.x, self.y, self.hw, self.hh = x, y, hw, hh
+
+    def contains(self, px: float, py: float) -> bool:
+        return (self.x - self.hw <= px <= self.x + self.hw and
+                self.y - self.hh <= py <= self.y + self.hh)
+
+
+class QuadTree:
+    """ref: QuadTree.java — insert, subdivide, computeNonEdgeForces."""
+
+    def __init__(self, data: Optional[np.ndarray] = None,
+                 cell: Optional[Cell] = None):
+        self.cell = cell
+        self.size = 0
+        self.center_of_mass = np.zeros(2)
+        self.point: Optional[np.ndarray] = None
+        self.children: List[Optional["QuadTree"]] = [None] * 4
+        self.is_leaf = True
+        if data is not None:
+            data = np.asarray(data, np.float64)
+            mean = data.mean(axis=0)
+            span = np.maximum(np.abs(data - mean).max(axis=0), 1e-5)
+            self.cell = Cell(mean[0], mean[1], span[0] + 1e-5, span[1] + 1e-5)
+            for p in data:
+                self.insert(p)
+
+    def insert(self, p) -> bool:
+        p = np.asarray(p, np.float64)
+        if self.cell is not None and not self.cell.contains(p[0], p[1]):
+            return False
+        # update center of mass
+        self.center_of_mass = (self.center_of_mass * self.size + p) / (self.size + 1)
+        self.size += 1
+        if self.is_leaf and self.point is None:
+            self.point = p
+            return True
+        if self.is_leaf:
+            if np.allclose(self.point, p):
+                return True  # duplicate point joins this leaf's mass
+            self._subdivide()
+        for ch in self.children:
+            if ch.insert(p):
+                return True
+        return False
+
+    def _subdivide(self) -> None:
+        c = self.cell
+        hw, hh = c.hw / 2, c.hh / 2
+        quads = [(-hw, hh), (hw, hh), (-hw, -hh), (hw, -hh)]
+        self.children = [
+            QuadTree(cell=Cell(c.x + dx, c.y + dy, hw, hh))
+            for dx, dy in quads]
+        old = self.point
+        self.point = None
+        self.is_leaf = False
+        for ch in self.children:
+            if ch.insert(old):
+                break
+
+    def compute_non_edge_forces(self, point, theta: float,
+                                neg: np.ndarray) -> float:
+        """Barnes-Hut repulsive force accumulation
+        (ref: QuadTree.computeNonEdgeForces). Returns the partial sum_Q."""
+        if self.size == 0:
+            return 0.0
+        p = np.asarray(point, np.float64)
+        diff = p - self.center_of_mass
+        d2 = float(diff @ diff)
+        if self.is_leaf and self.point is not None and \
+                np.allclose(self.point, p):
+            n_here = self.size - 1  # exclude the query point itself
+            if n_here <= 0:
+                return 0.0
+            q = 1.0 / (1.0 + d2)
+            neg += n_here * q * q * diff
+            return n_here * q
+        max_width = max(self.cell.hw, self.cell.hh) * 2
+        if self.is_leaf or (d2 > 0 and max_width / np.sqrt(d2) < theta):
+            q = 1.0 / (1.0 + d2)
+            neg += self.size * q * q * diff
+            return self.size * q
+        s = 0.0
+        for ch in self.children:
+            if ch is not None:
+                s += ch.compute_non_edge_forces(p, theta, neg)
+        return s

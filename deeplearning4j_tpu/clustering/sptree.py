@@ -1,0 +1,100 @@
+"""SP-tree: n-dimensional Barnes-Hut space-partitioning tree.
+
+Equivalent of nearestneighbor-core clustering/sptree/SpTree.java — the
+generalized (any-D) octree used by BarnesHutTsne: cells with
+center-of-mass, 2^D children, computeNonEdgeForces/computeEdgeForces.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+class SpTree:
+    """ref: SpTree.java — node capacity 1, duplicate points merge mass."""
+
+    def __init__(self, data: Optional[np.ndarray] = None, *,
+                 center: Optional[np.ndarray] = None,
+                 width: Optional[np.ndarray] = None):
+        if data is not None:
+            data = np.asarray(data, np.float64)
+            lo, hi = data.min(axis=0), data.max(axis=0)
+            center = (lo + hi) / 2
+            width = (hi - lo) / 2 + 1e-5
+        self.center = np.asarray(center, np.float64)
+        self.width = np.asarray(width, np.float64)
+        self.dims = len(self.center)
+        self.size = 0
+        self.center_of_mass = np.zeros(self.dims)
+        self.point: Optional[np.ndarray] = None
+        self.children: Optional[List["SpTree"]] = None
+        if data is not None:
+            for p in data:
+                self.insert(p)
+
+    @property
+    def is_leaf(self) -> bool:
+        return self.children is None
+
+    def insert(self, p) -> bool:
+        p = np.asarray(p, np.float64)
+        if np.any(np.abs(p - self.center) > self.width + 1e-12):
+            return False
+        self.center_of_mass = (self.center_of_mass * self.size + p) / (self.size + 1)
+        self.size += 1
+        if self.is_leaf and self.point is None:
+            self.point = p
+            return True
+        if self.is_leaf:
+            if np.allclose(self.point, p):
+                return True
+            self._subdivide()
+        child = self.children[self._child_index(p)]
+        return child.insert(p)
+
+    def _child_index(self, p: np.ndarray) -> int:
+        idx = 0
+        for d in range(self.dims):
+            if p[d] > self.center[d]:
+                idx |= (1 << d)
+        return idx
+
+    def _subdivide(self) -> None:
+        half = self.width / 2
+        self.children = []
+        for i in range(1 << self.dims):
+            offs = np.array([half[d] if (i >> d) & 1 else -half[d]
+                             for d in range(self.dims)])
+            self.children.append(
+                SpTree(center=self.center + offs, width=half))
+        old = self.point
+        self.point = None
+        self.children[self._child_index(old)].insert(old)
+
+    def compute_non_edge_forces(self, point, theta: float,
+                                neg: np.ndarray) -> float:
+        """Accumulate Barnes-Hut repulsive forces into ``neg``; returns the
+        partial normalization sum_Q (ref: SpTree.computeNonEdgeForces)."""
+        if self.size == 0:
+            return 0.0
+        p = np.asarray(point, np.float64)
+        diff = p - self.center_of_mass
+        d2 = float(diff @ diff)
+        if self.is_leaf and self.point is not None and np.allclose(self.point, p):
+            n_here = self.size - 1
+            if n_here <= 0:
+                return 0.0
+            q = 1.0 / (1.0 + d2)
+            neg += n_here * q * q * diff
+            return n_here * q
+        max_width = float(self.width.max()) * 2
+        if self.is_leaf or (d2 > 0 and max_width / np.sqrt(d2) < theta):
+            q = 1.0 / (1.0 + d2)
+            neg += self.size * q * q * diff
+            return self.size * q
+        s = 0.0
+        for ch in self.children:
+            s += ch.compute_non_edge_forces(p, theta, neg)
+        return s

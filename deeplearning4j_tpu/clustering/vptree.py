@@ -1,0 +1,114 @@
+"""Vantage-point tree for metric-space nearest neighbor.
+
+Equivalent of nearestneighbor-core clustering/vptree/VPTree.java (random
+vantage point, median-distance split, tau-pruned search) and
+VPTreeFillSearch (collect >=k candidates then exact-sort).
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _metric(name: str):
+    if name in ("euclidean", "l2"):
+        return lambda a, b: float(np.linalg.norm(a - b))
+    if name == "manhattan":
+        return lambda a, b: float(np.abs(a - b).sum())
+    if name == "cosine":
+        def cos(a, b):
+            den = np.linalg.norm(a) * np.linalg.norm(b)
+            return 1.0 - float(np.dot(a, b) / den) if den > 0 else 1.0
+        return cos
+    raise ValueError(f"unknown metric {name!r}")
+
+
+class _VPNode:
+    __slots__ = ("index", "threshold", "inside", "outside")
+
+    def __init__(self, index: int):
+        self.index = index
+        self.threshold = 0.0
+        self.inside: Optional["_VPNode"] = None
+        self.outside: Optional["_VPNode"] = None
+
+
+class VPTree:
+    """ref: VPTree.java — buildFromData with median split; search prunes
+    with the running kth distance (tau)."""
+
+    def __init__(self, points, similarity_function: str = "euclidean",
+                 seed: int = 123):
+        self.items = np.asarray(points, np.float64)
+        self.dist = _metric(similarity_function)
+        self._rng = np.random.default_rng(seed)
+        idxs = list(range(len(self.items)))
+        self._root = self._build(idxs)
+
+    def _build(self, idxs: List[int]) -> Optional[_VPNode]:
+        if not idxs:
+            return None
+        vp_pos = int(self._rng.integers(0, len(idxs)))
+        idxs[0], idxs[vp_pos] = idxs[vp_pos], idxs[0]
+        node = _VPNode(idxs[0])
+        rest = idxs[1:]
+        if rest:
+            vp = self.items[node.index]
+            dists = [self.dist(vp, self.items[i]) for i in rest]
+            order = np.argsort(dists)
+            median_pos = len(rest) // 2
+            node.threshold = dists[order[median_pos]]
+            inside = [rest[j] for j in order[:median_pos + 1]]
+            outside = [rest[j] for j in order[median_pos + 1:]]
+            node.inside = self._build(inside)
+            node.outside = self._build(outside)
+        return node
+
+    def search(self, target, k: int) -> Tuple[List[int], List[float]]:
+        """k nearest item indices + distances, ascending."""
+        q = np.asarray(target, np.float64)
+        heap: List[Tuple[float, int]] = []  # max-heap (-dist, idx)
+        tau = [float("inf")]
+
+        def visit(node: Optional[_VPNode]):
+            if node is None:
+                return
+            d = self.dist(self.items[node.index], q)
+            if len(heap) < k:
+                heapq.heappush(heap, (-d, node.index))
+                if len(heap) == k:
+                    tau[0] = -heap[0][0]
+            elif d < tau[0]:
+                heapq.heapreplace(heap, (-d, node.index))
+                tau[0] = -heap[0][0]
+            if d < node.threshold:
+                visit(node.inside)
+                if d + tau[0] >= node.threshold:
+                    visit(node.outside)
+            else:
+                visit(node.outside)
+                if d - tau[0] <= node.threshold:
+                    visit(node.inside)
+
+        visit(self._root)
+        out = sorted([(-nd, i) for nd, i in heap])
+        return [i for _, i in out], [d for d, _ in out]
+
+
+class VPTreeFillSearch:
+    """Collect at least k results then exact-rank
+    (ref: vptree/VPTreeFillSearch.java)."""
+
+    def __init__(self, tree: VPTree, k: int, target):
+        self.tree = tree
+        self.k = k
+        self.target = np.asarray(target, np.float64)
+        self.results: List[int] = []
+        self.distances: List[float] = []
+
+    def search(self) -> None:
+        idx, d = self.tree.search(self.target, self.k)
+        self.results, self.distances = idx, d

@@ -5,10 +5,9 @@ walks with GraphHuffman hierarchical softmax, GraphVectorsImpl +
 InMemoryGraphLookupTable, GraphVectorSerializer).
 
 TPU-first: the reference trains one (vertex, context) pair at a time through a
-Java HS tree loop; here walks are generated vectorised on host and the
-hierarchical-softmax updates run as batched device steps through the shared
-SequenceVectors kernels (gather → [B,L,D]·[B,D] dots on the MXU → scatter-add),
-exactly like the Word2Vec path.
+Java HS tree loop; here the hierarchical-softmax updates run as batched device
+steps through the shared SequenceVectors kernels (gather → [B,L,D]·[B,D] dots
+on the MXU → scatter-add), exactly like the Word2Vec path.
 """
 
 from __future__ import annotations
@@ -126,11 +125,12 @@ class DeepWalk:
         sv.build_vocab(seqs)
         sv.fit(seqs)
         self._sv = sv
+        syn0 = np.asarray(sv.syn0)  # one bulk device→host transfer
         vecs = np.zeros((graph.num_vertices(), self.vector_size), np.float32)
         for i in range(graph.num_vertices()):
-            v = sv.get_word_vector(str(i))
-            if v is not None:
-                vecs[i] = v
+            row = sv.vocab.index_of(str(i))
+            if row >= 0:
+                vecs[i] = syn0[row]
         self.graph_vectors = GraphVectors(vecs)
         return self.graph_vectors
 
@@ -142,7 +142,7 @@ class DeepWalk:
         self._require_fit()
         return self.graph_vectors.similarity(a, b)
 
-    def verticesNearest(self, idx: int, top_n: int = 10) -> List[int]:
+    def vertices_nearest(self, idx: int, top_n: int = 10) -> List[int]:
         self._require_fit()
         return self.graph_vectors.vertices_nearest(idx, top_n)
 

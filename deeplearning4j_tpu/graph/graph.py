@@ -116,7 +116,7 @@ class GraphLoader:
             parts = [int(p) for p in line.split(delimiter)]
             rows.append(parts)
         if num_vertices is None:
-            num_vertices = 1 + max(max(r) for r in rows)
+            num_vertices = 1 + max(max(r) for r in rows) if rows else 0
         g = Graph(num_vertices, directed=True)
         for row in rows:
             for nb in row[1:]:

@@ -2,9 +2,8 @@
 
 Equivalent of deeplearning4j-graph iterator/RandomWalkIterator.java and
 WeightedRandomWalkIterator.java (+ GraphWalkIteratorProvider parallel
-providers). Walks are generated vectorised on host with numpy — one
-``next_batch`` call advances MANY walks in lockstep so the downstream
-device-side skip-gram step always sees full batches.
+providers). Walk generation is host-side Python (irregular adjacency);
+the device work is downstream in DeepWalk's batched skip-gram steps.
 """
 
 from __future__ import annotations

@@ -1,0 +1,7 @@
+"""Embedding visualization: t-SNE.
+
+TPU-native equivalent of deeplearning4j-core plot/BarnesHutTsne.java (868)
+and plot/Tsne.java (423).
+"""
+
+from deeplearning4j_tpu.plot.tsne import Tsne, BarnesHutTsne  # noqa: F401

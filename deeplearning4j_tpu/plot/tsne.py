@@ -1,0 +1,214 @@
+"""t-SNE: exact (device) and Barnes-Hut (host tree + device kNN) variants.
+
+Equivalent of deeplearning4j-core plot/Tsne.java:423 (exact gradient with
+momentum + adaptive gains) and plot/BarnesHutTsne.java:868 (theta-approximate
+gradient via SpTree, sparse input similarities from nearest neighbors).
+
+TPU-first split: the exact variant is one jitted step — the [N,N] student-t
+kernel is two matmuls that ride the MXU, so exact t-SNE stays on device far
+past the N where the reference must switch to Barnes-Hut. The BH variant
+keeps the reference's O(N log N) host algorithm (tree traversal doesn't map
+to XLA) but gets its kNN graph from the device brute-force kernel.
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.clustering.knn import knn_search
+from deeplearning4j_tpu.clustering.sptree import SpTree
+
+log = logging.getLogger(__name__)
+
+
+# -- shared: perplexity calibration (binary search over beta) ---------------
+
+def _cond_probs(d2_row: np.ndarray, perplexity: float, tol: float = 1e-5,
+                max_tries: int = 50) -> np.ndarray:
+    """Row conditional probabilities at the beta matching log(perplexity)
+    (ref: Tsne.hBeta / BarnesHutTsne.computeGaussianPerplexity)."""
+    beta, beta_min, beta_max = 1.0, -np.inf, np.inf
+    log_u = np.log(perplexity)
+    p = np.zeros_like(d2_row)
+    for _ in range(max_tries):
+        p = np.exp(-d2_row * beta)
+        sum_p = max(p.sum(), 1e-12)
+        h = np.log(sum_p) + beta * float((d2_row * p).sum()) / sum_p
+        diff = h - log_u
+        if abs(diff) < tol:
+            break
+        if diff > 0:
+            beta_min = beta
+            beta = beta * 2 if beta_max == np.inf else (beta + beta_max) / 2
+        else:
+            beta_max = beta
+            beta = beta / 2 if beta_min == -np.inf else (beta + beta_min) / 2
+    return p / max(p.sum(), 1e-12)
+
+
+@partial(jax.jit, static_argnames=())
+def _exact_step(Y, P, gains, y_inc, momentum, lr, min_gain, max_gain):
+    """One exact t-SNE gradient step with adaptive gains
+    (ref: Tsne.gradient + step). Gains are clipped to [min_gain, max_gain]:
+    without an upper cap, sign oscillation near convergence grows gains
+    without bound and the embedding diverges to overflow."""
+    sum_y = jnp.sum(Y * Y, axis=1)
+    num = 1.0 / (1.0 + sum_y[:, None] - 2.0 * Y @ Y.T + sum_y[None, :])
+    num = num * (1.0 - jnp.eye(Y.shape[0]))
+    Q = jnp.maximum(num / jnp.sum(num), 1e-12)
+    PQ = (P - Q) * num                        # [N,N]
+    grad = 4.0 * (jnp.diag(PQ.sum(axis=1)) - PQ) @ Y
+    gains = jnp.where(jnp.sign(grad) != jnp.sign(y_inc),
+                      gains + 0.2, gains * 0.8)
+    gains = jnp.clip(gains, min_gain, max_gain)
+    y_inc = momentum * y_inc - lr * gains * grad
+    Y = Y + y_inc
+    Y = Y - jnp.mean(Y, axis=0)
+    kl = jnp.sum(P * jnp.log(jnp.maximum(P, 1e-12) / Q))
+    return Y, gains, y_inc, kl
+
+
+class Tsne:
+    """Exact t-SNE, device-resident (ref: plot/Tsne.java Builder —
+    maxIter 1000, realMin/perplexity/initialMomentum .5/finalMomentum .8,
+    switchMomentumIteration 100, learningRate 500, early exaggeration)."""
+
+    def __init__(self, n_components: int = 2, perplexity: float = 30.0,
+                 max_iter: int = 1000, learning_rate: float = 500.0,
+                 initial_momentum: float = 0.5, final_momentum: float = 0.8,
+                 switch_momentum_iteration: int = 100,
+                 stop_lying_iteration: int = 250, exaggeration: float = 12.0,
+                 min_gain: float = 0.01, max_gain: float = 5.0,
+                 seed: int = 42):
+        self.n_components = n_components
+        self.perplexity = perplexity
+        self.max_iter = max_iter
+        self.learning_rate = learning_rate
+        self.initial_momentum = initial_momentum
+        self.final_momentum = final_momentum
+        self.switch_momentum_iteration = switch_momentum_iteration
+        self.stop_lying_iteration = stop_lying_iteration
+        self.exaggeration = exaggeration
+        self.min_gain = min_gain
+        self.max_gain = max_gain
+        self.seed = seed
+        self.kl_history: list = []
+        self.Y: Optional[np.ndarray] = None
+
+    def _joint_p(self, X: np.ndarray) -> np.ndarray:
+        d2 = np.sum(X * X, 1)[:, None] - 2 * X @ X.T + np.sum(X * X, 1)[None, :]
+        n = X.shape[0]
+        P = np.zeros((n, n))
+        for i in range(n):
+            row = np.delete(d2[i], i)
+            p = _cond_probs(row, self.perplexity)
+            P[i, np.arange(n) != i] = p
+        P = (P + P.T) / (2 * n)
+        return np.maximum(P, 1e-12)
+
+    def fit_transform(self, X) -> np.ndarray:
+        X = np.asarray(X, np.float64)
+        n = X.shape[0]
+        P = jnp.asarray(self._joint_p(X) * self.exaggeration)
+        rng = np.random.default_rng(self.seed)
+        Y = jnp.asarray(rng.standard_normal((n, self.n_components)) * 1e-4)
+        gains = jnp.ones_like(Y)
+        y_inc = jnp.zeros_like(Y)
+        self.kl_history = []
+        for it in range(self.max_iter):
+            momentum = (self.initial_momentum
+                        if it < self.switch_momentum_iteration
+                        else self.final_momentum)
+            if it == self.stop_lying_iteration:
+                P = P / self.exaggeration
+            Y, gains, y_inc, kl = _exact_step(
+                Y, P, gains, y_inc, jnp.asarray(momentum),
+                jnp.asarray(self.learning_rate), jnp.asarray(self.min_gain),
+                jnp.asarray(self.max_gain))
+            if it % 50 == 0:
+                self.kl_history.append(float(kl))
+        self.Y = np.asarray(Y)
+        return self.Y
+
+
+class BarnesHutTsne(Tsne):
+    """theta-approximate t-SNE (ref: plot/BarnesHutTsne.java — theta 0.5,
+    sparse P over 3*perplexity neighbors, SpTree repulsive forces).
+
+    ``theta=0`` falls back to the exact device path.
+    """
+
+    def __init__(self, theta: float = 0.5, **kwargs):
+        kwargs.setdefault("learning_rate", 200.0)
+        super().__init__(**kwargs)
+        self.theta = theta
+
+    def fit_transform(self, X) -> np.ndarray:
+        if self.theta <= 0:
+            return super().fit_transform(X)
+        X = np.asarray(X, np.float64)
+        n = X.shape[0]
+        k = min(int(3 * self.perplexity), n - 1)
+        # kNN graph from the device kernel
+        idx, dist = knn_search(X.astype(np.float32), X.astype(np.float32),
+                               k + 1)
+        rows, cols, vals = [], [], []
+        for i in range(n):
+            nbrs = [j for j in idx[i] if j != i][:k]
+            d2 = np.array([np.sum((X[i] - X[j]) ** 2) for j in nbrs])
+            p = _cond_probs(d2, self.perplexity)
+            rows.extend([i] * len(nbrs))
+            cols.extend(nbrs)
+            vals.extend(p)
+        P = {}
+        for r, c, v in zip(rows, cols, vals):
+            P[(r, c)] = P.get((r, c), 0.0) + v / 2
+            P[(c, r)] = P.get((c, r), 0.0) + v / 2
+        tot = sum(P.values())
+        for key in P:
+            P[key] = max(P[key] / tot, 1e-12)
+
+        rng = np.random.default_rng(self.seed)
+        Y = rng.standard_normal((n, self.n_components)) * 1e-4
+        gains = np.ones_like(Y)
+        y_inc = np.zeros_like(Y)
+        p_items = [(r, c, v) for (r, c), v in P.items()]
+        pr = np.array([t[0] for t in p_items])
+        pc = np.array([t[1] for t in p_items])
+        pv = np.array([t[2] for t in p_items])
+        exagg = self.exaggeration
+        self.kl_history = []
+        for it in range(self.max_iter):
+            momentum = (self.initial_momentum
+                        if it < self.switch_momentum_iteration
+                        else self.final_momentum)
+            ex = exagg if it < self.stop_lying_iteration else 1.0
+            # attractive (edge) forces from sparse P
+            diff = Y[pr] - Y[pc]                       # [E,C]
+            qz = 1.0 / (1.0 + np.sum(diff * diff, axis=1))
+            w = (ex * pv * qz)[:, None] * diff
+            pos = np.zeros_like(Y)
+            np.add.at(pos, pr, w)
+            # repulsive via SpTree
+            tree = SpTree(Y)
+            neg = np.zeros_like(Y)
+            sum_q = 0.0
+            for i in range(n):
+                buf = np.zeros(self.n_components)
+                sum_q += tree.compute_non_edge_forces(Y[i], self.theta, buf)
+                neg[i] = buf
+            grad = pos - neg / max(sum_q, 1e-12)
+            gains = np.where(np.sign(grad) != np.sign(y_inc),
+                             gains + 0.2, gains * 0.8)
+            gains = np.clip(gains, self.min_gain, self.max_gain)
+            y_inc = momentum * y_inc - self.learning_rate * gains * grad
+            Y = Y + y_inc
+            Y = Y - Y.mean(axis=0)
+        self.Y = Y
+        return Y

@@ -1,0 +1,219 @@
+"""Nearest-neighbors / clustering / t-SNE tests (ref:
+nearestneighbor-core src/test — KDTreeTest, VPTreeTest, SpTreeTest,
+QuadTreeTest, KMeansTest; core plot tsne tests)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (
+    KDTree, KMeansClustering, NearestNeighbors, QuadTree, SpTree, VPTree,
+    VPTreeFillSearch, knn_search,
+)
+from deeplearning4j_tpu.plot import BarnesHutTsne, Tsne
+
+
+def brute_knn(points, q, k):
+    d = np.linalg.norm(points - q, axis=1)
+    return np.argsort(d)[:k]
+
+
+class TestKnnDevice:
+    def test_matches_brute_force(self):
+        rng = np.random.default_rng(0)
+        pts = rng.standard_normal((200, 16)).astype(np.float32)
+        qs = rng.standard_normal((7, 16)).astype(np.float32)
+        idx, dist = knn_search(pts, qs, k=5)
+        for i, q in enumerate(qs):
+            np.testing.assert_array_equal(idx[i], brute_knn(pts, q, 5))
+            assert np.all(np.diff(dist[i]) >= -1e-5)
+
+    def test_cosine_metric(self):
+        pts = np.array([[1, 0], [0, 1], [0.9, 0.1]], np.float32)
+        idx, _ = knn_search(pts, np.array([[1.0, 0.0]], np.float32), k=2,
+                            metric="cosine")
+        assert idx[0][0] == 0 and idx[0][1] == 2
+
+    def test_query_point_index_excludes_self(self):
+        pts = np.array([[0, 0], [1, 0], [2, 0]], np.float32)
+        nn = NearestNeighbors(pts)
+        idx, d = nn.query_point_index(1, k=1)
+        assert 1 not in idx
+        assert idx[0] in (0, 2)
+
+
+class TestKDTree:
+    def test_knn_matches_brute(self):
+        rng = np.random.default_rng(1)
+        pts = rng.standard_normal((100, 3))
+        tree = KDTree(3)
+        for p in pts:
+            tree.insert(p)
+        assert tree.size() == 100
+        q = rng.standard_normal(3)
+        res = tree.knn(q, 4)
+        expect = pts[brute_knn(pts, q, 4)]
+        got = np.stack([pt for _, pt in res])
+        np.testing.assert_allclose(np.sort(got, axis=0),
+                                   np.sort(expect, axis=0), atol=1e-12)
+
+    def test_nn(self):
+        tree = KDTree(2)
+        for p in [[0, 0], [5, 5], [10, 10]]:
+            tree.insert(p)
+        pt, d = tree.nn([4.8, 5.1])
+        np.testing.assert_allclose(pt, [5, 5])
+
+    def test_dim_check(self):
+        tree = KDTree(2)
+        with pytest.raises(ValueError):
+            tree.insert([1, 2, 3])
+
+
+class TestVPTree:
+    def test_search_matches_brute(self):
+        rng = np.random.default_rng(2)
+        pts = rng.standard_normal((150, 8))
+        tree = VPTree(pts, seed=0)
+        q = rng.standard_normal(8)
+        idx, dist = tree.search(q, 6)
+        np.testing.assert_array_equal(np.sort(idx),
+                                      np.sort(brute_knn(pts, q, 6)))
+        assert np.all(np.diff(dist) >= 0)
+
+    def test_fill_search(self):
+        rng = np.random.default_rng(3)
+        pts = rng.standard_normal((60, 4))
+        tree = VPTree(pts, seed=1)
+        fs = VPTreeFillSearch(tree, 5, pts[0])
+        fs.search()
+        assert len(fs.results) == 5
+        assert fs.results[0] == 0  # the point itself is its own nearest
+
+    def test_cosine(self):
+        pts = np.array([[1, 0], [0, 1], [0.95, 0.05]])
+        tree = VPTree(pts, similarity_function="cosine", seed=0)
+        idx, _ = tree.search([1.0, 0.0], 2)
+        assert set(idx) == {0, 2}
+
+
+class TestTrees:
+    def test_sptree_mass_and_count(self):
+        rng = np.random.default_rng(4)
+        pts = rng.standard_normal((50, 3))
+        tree = SpTree(pts)
+        assert tree.size == 50
+        np.testing.assert_allclose(tree.center_of_mass, pts.mean(axis=0),
+                                   atol=1e-9)
+
+    def test_sptree_duplicates(self):
+        pts = np.array([[1.0, 1.0], [1.0, 1.0], [2.0, 2.0]])
+        tree = SpTree(pts)
+        assert tree.size == 3
+
+    def test_sptree_forces_match_exact_small_theta(self):
+        # theta→0 must reproduce the exact repulsive force sums
+        rng = np.random.default_rng(5)
+        Y = rng.standard_normal((30, 2))
+        tree = SpTree(Y)
+        for i in [0, 7, 29]:
+            buf = np.zeros(2)
+            sum_q = tree.compute_non_edge_forces(Y[i], 0.0, buf)
+            diff = Y[i] - Y
+            d2 = np.sum(diff * diff, axis=1)
+            q = 1.0 / (1.0 + d2)
+            q[i] = 0
+            exact = ((q * q)[:, None] * diff).sum(axis=0)
+            np.testing.assert_allclose(buf, exact, atol=1e-8)
+            np.testing.assert_allclose(sum_q, q.sum(), atol=1e-8)
+
+    def test_quadtree_insert_and_forces(self):
+        rng = np.random.default_rng(6)
+        pts = rng.standard_normal((40, 2))
+        tree = QuadTree(pts)
+        assert tree.size == 40
+        buf = np.zeros(2)
+        s = tree.compute_non_edge_forces(pts[3], 0.0, buf)
+        diff = pts[3] - pts
+        d2 = np.sum(diff * diff, axis=1)
+        q = 1.0 / (1.0 + d2)
+        q[3] = 0
+        np.testing.assert_allclose(s, q.sum(), atol=1e-8)
+
+
+def three_blobs(n=30, d=4, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = np.array([[8.0] * d, [-8.0] * d, [8.0] * (d // 2) + [-8.0] * (d - d // 2)])
+    X = np.concatenate([c + rng.standard_normal((n, d)) for c in centers])
+    labels = np.repeat(np.arange(3), n)
+    return X, labels
+
+
+class TestKMeans:
+    def test_recovers_blobs(self):
+        X, labels = three_blobs()
+        km = KMeansClustering(cluster_count=3, max_iterations=50, seed=1)
+        cs = km.apply_to(X)
+        assert cs.get_cluster_count() == 3
+        # each true blob maps to exactly one cluster
+        for lbl in range(3):
+            a = cs.assignments[labels == lbl]
+            assert len(set(a.tolist())) == 1
+        # cost decreases monotonically (Lloyd guarantee)
+        assert all(b <= a + 1e-3 for a, b in
+                   zip(km.cost_history, km.cost_history[1:]))
+
+    def test_variation_stop(self):
+        X, _ = three_blobs()
+        km = KMeansClustering(cluster_count=3, max_iterations=500,
+                              min_variation_rate=1e-4, seed=2)
+        km.apply_to(X)
+        assert len(km.cost_history) < 500
+
+    def test_nearest_cluster(self):
+        X, labels = three_blobs()
+        km = KMeansClustering(cluster_count=3, max_iterations=30, seed=3)
+        cs = km.apply_to(X)
+        assert cs.nearest_cluster(X[0]) == cs.assignments[0]
+
+    def test_too_few_points(self):
+        with pytest.raises(ValueError):
+            KMeansClustering(cluster_count=5).apply_to(np.zeros((3, 2)))
+
+
+class TestTsne:
+    def test_exact_separates_blobs(self):
+        X, labels = three_blobs(n=20)
+        ts = Tsne(perplexity=10, max_iter=500, learning_rate=100.0,
+                  exaggeration=4.0, stop_lying_iteration=100, seed=0)
+        Y = ts.fit_transform(X)
+        assert Y.shape == (60, 2)
+        # blob centroids in embedding space should be farther apart than
+        # the mean within-blob spread
+        cents = np.stack([Y[labels == i].mean(axis=0) for i in range(3)])
+        spread = np.mean([np.linalg.norm(Y[labels == i] - cents[i], axis=1).mean()
+                          for i in range(3)])
+        min_sep = min(np.linalg.norm(cents[i] - cents[j])
+                      for i in range(3) for j in range(i + 1, 3))
+        assert min_sep > 2 * spread
+        # KL should improve after de-exaggeration (entries from iter>=100)
+        assert ts.kl_history[-1] < ts.kl_history[2]
+
+    def test_barnes_hut_separates_blobs(self):
+        X, labels = three_blobs(n=20)
+        ts = BarnesHutTsne(theta=0.5, perplexity=10, max_iter=400,
+                           learning_rate=100.0, exaggeration=4.0,
+                           stop_lying_iteration=100, seed=0)
+        Y = ts.fit_transform(X)
+        assert Y.shape == (60, 2)
+        cents = np.stack([Y[labels == i].mean(axis=0) for i in range(3)])
+        spread = np.mean([np.linalg.norm(Y[labels == i] - cents[i], axis=1).mean()
+                          for i in range(3)])
+        min_sep = min(np.linalg.norm(cents[i] - cents[j])
+                      for i in range(3) for j in range(i + 1, 3))
+        assert min_sep > 2 * spread
+
+    def test_theta_zero_falls_back_to_exact(self):
+        X, _ = three_blobs(n=5)
+        ts = BarnesHutTsne(theta=0.0, perplexity=5, max_iter=20, seed=0)
+        Y = ts.fit_transform(X)
+        assert Y.shape == (15, 2)
