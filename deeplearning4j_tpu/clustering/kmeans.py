@@ -118,6 +118,9 @@ class KMeansClustering:
         for _ in range(1, self.k):
             d2 = np.min(
                 [np.sum((pts - c) ** 2, axis=1) for c in centers], axis=0)
-            probs = d2 / max(d2.sum(), 1e-12)
-            centers.append(pts[rng.choice(pts.shape[0], p=probs)])
+            tot = d2.sum()
+            if tot <= 0:  # fewer distinct points than k: fall back uniform
+                centers.append(pts[rng.integers(0, pts.shape[0])])
+                continue
+            centers.append(pts[rng.choice(pts.shape[0], p=d2 / tot)])
         return np.stack(centers)

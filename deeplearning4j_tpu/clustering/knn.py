@@ -35,11 +35,15 @@ def _knn_kernel(points, queries, k: int, metric: str):
         sim = qn @ pn.T
         top, idx = jax.lax.top_k(sim, k)
         return idx, 1.0 - top
-    elif metric == "manhattan":
-        d = jnp.sum(jnp.abs(queries[:, None, :] - points[None, :, :]), axis=-1)
-        neg, idx = jax.lax.top_k(-d, k)
-        return idx, -neg
     raise ValueError(f"unknown metric {metric!r}")
+
+
+@jax.jit
+def _manhattan_block(points_blk, queries):
+    """[Q,B] L1 distances for one block of points — the [Q,B,D] intermediate
+    is bounded by the block size (L1 has no matmul trick like L2)."""
+    return jnp.sum(jnp.abs(queries[:, None, :] - points_blk[None, :, :]),
+                   axis=-1)
 
 
 def knn_search(points, queries, k: int, metric: str = "euclidean",
@@ -54,7 +58,18 @@ def knn_search(points, queries, k: int, metric: str = "euclidean",
     idx_out, d_out = [], []
     for s in range(0, queries.shape[0], query_block):
         q = jnp.asarray(queries[s:s + query_block])
-        idx, d = _knn_kernel(points, q, k, metric)
+        if metric == "manhattan":
+            point_block = max(1, (1 << 22) // max(1, q.shape[0]))
+            dists = np.concatenate(
+                [np.asarray(_manhattan_block(points[ps:ps + point_block], q))
+                 for ps in range(0, points.shape[0], point_block)], axis=1)
+            idx = np.argpartition(dists, k - 1, axis=1)[:, :k]
+            d = np.take_along_axis(dists, idx, axis=1)
+            order = np.argsort(d, axis=1)
+            idx, d = (np.take_along_axis(idx, order, axis=1),
+                      np.take_along_axis(d, order, axis=1))
+        else:
+            idx, d = _knn_kernel(points, q, k, metric)
         idx_out.append(np.asarray(idx))
         d_out.append(np.asarray(d))
     return np.concatenate(idx_out), np.concatenate(d_out)

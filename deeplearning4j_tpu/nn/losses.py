@@ -99,6 +99,7 @@ LOSSES = {
     "binary_crossentropy": _xent,
     "mcxent": _mcxent,
     "negativeloglikelihood": _mcxent,
+    "categorical_crossentropy": _mcxent,  # Keras-familiar alias
     "kl_divergence": _kld,
     "reconstruction_crossentropy": _xent,
     "hinge": _hinge,

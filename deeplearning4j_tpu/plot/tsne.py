@@ -161,7 +161,7 @@ class BarnesHutTsne(Tsne):
         rows, cols, vals = [], [], []
         for i in range(n):
             nbrs = [j for j in idx[i] if j != i][:k]
-            d2 = np.array([np.sum((X[i] - X[j]) ** 2) for j in nbrs])
+            d2 = np.sum((X[i] - X[nbrs]) ** 2, axis=1)
             p = _cond_probs(d2, self.perplexity)
             rows.extend([i] * len(nbrs))
             cols.extend(nbrs)

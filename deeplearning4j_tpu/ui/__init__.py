@@ -1,0 +1,13 @@
+"""Training observability: stats collection, storage, web UI.
+
+TPU-native equivalent of deeplearning4j-ui-parent (SURVEY §2.11):
+StatsListener (ui/stats/BaseStatsListener.java), StatsStorage impls
+(ui/storage/ InMemory/File/SQLite), PlayUIServer + train modules, and
+RemoteUIStatsStorageRouter / RemoteReceiverModule.
+"""
+
+from deeplearning4j_tpu.ui.stats import StatsListener, StatsReport  # noqa: F401
+from deeplearning4j_tpu.ui.storage import (  # noqa: F401
+    StatsStorage, InMemoryStatsStorage, FileStatsStorage,
+)
+from deeplearning4j_tpu.ui.server import UIServer, RemoteUIStatsStorageRouter  # noqa: F401

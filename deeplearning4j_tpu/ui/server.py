@@ -1,0 +1,280 @@
+"""Web UI server + remote stats routing.
+
+Equivalent of ui/play/PlayUIServer.java (RoutingDsl routes :112-155, port
+:274), api/UIServer.java SPI, module/train/TrainModule.java (overview/model
+pages), module/remote/RemoteReceiverModule.java, and core
+api/storage/impl/RemoteUIStatsStorageRouter.java:1-355 (HTTP POST of stats
+to a remote UI).
+
+The Play framework is replaced by stdlib http.server on a daemon thread;
+charts render client-side from the JSON endpoints with inline JS (no
+external assets — zero-egress friendly).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from deeplearning4j_tpu.ui.stats import StatsReport
+from deeplearning4j_tpu.ui.storage import StatsStorage
+
+log = logging.getLogger(__name__)
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>deeplearning4j_tpu training UI</title>
+<style>
+body{font-family:sans-serif;margin:20px;background:#fafafa}
+h1{font-size:20px} h2{font-size:16px;margin-top:24px}
+.chart{border:1px solid #ccc;background:#fff;margin:8px 0}
+#meta{color:#555;font-size:13px}
+table{border-collapse:collapse;font-size:13px}
+td,th{border:1px solid #ddd;padding:4px 8px}
+</style></head>
+<body>
+<h1>Training overview</h1>
+<div id="meta"></div>
+<h2>Score vs iteration</h2>
+<canvas id="score" class="chart" width="900" height="260"></canvas>
+<h2>Parameter mean magnitudes</h2>
+<canvas id="pmm" class="chart" width="900" height="260"></canvas>
+<h2>Performance</h2>
+<table id="perf"></table>
+<script>
+function drawSeries(cv, series, labels){
+  const ctx = cv.getContext('2d');
+  ctx.clearRect(0,0,cv.width,cv.height);
+  let xs=[], ys=[];
+  series.forEach(s=>{s.pts.forEach(p=>{xs.push(p[0]); ys.push(p[1]);});});
+  if(!xs.length) return;
+  const xmin=Math.min(...xs), xmax=Math.max(...xs,xmin+1);
+  const ymin=Math.min(...ys), ymax=Math.max(...ys,ymin+1e-9);
+  const X=x=>40+(x-xmin)/(xmax-xmin)*(cv.width-60);
+  const Y=y=>cv.height-25-(y-ymin)/(ymax-ymin)*(cv.height-45);
+  ctx.strokeStyle='#999';ctx.strokeRect(40,20,cv.width-60,cv.height-45);
+  ctx.fillStyle='#333';ctx.font='11px sans-serif';
+  ctx.fillText(ymax.toPrecision(4),2,25);
+  ctx.fillText(ymin.toPrecision(4),2,cv.height-25);
+  ctx.fillText(String(xmax),cv.width-40,cv.height-8);
+  const colors=['#1976d2','#e53935','#43a047','#fb8c00','#8e24aa','#00897b'];
+  series.forEach((s,i)=>{
+    ctx.strokeStyle=colors[i%colors.length];ctx.beginPath();
+    s.pts.forEach((p,j)=>{j?ctx.lineTo(X(p[0]),Y(p[1])):ctx.moveTo(X(p[0]),Y(p[1]))});
+    ctx.stroke();
+    ctx.fillStyle=colors[i%colors.length];
+    ctx.fillText(s.name,50+i*150,14);
+  });
+}
+async function refresh(){
+  const sessions = await (await fetch('/train/sessions')).json();
+  if(!sessions.length) return;
+  const sid = sessions[sessions.length-1];
+  const ov = await (await fetch('/train/overview?sid='+
+                    encodeURIComponent(sid))).json();
+  document.getElementById('meta').textContent =
+    'session '+sid+' — '+(ov.modelClass||'?')+', '+
+    (ov.numParams||'?')+' params, '+ov.scores.length+' reports';
+  drawSeries(document.getElementById('score'),
+    [{name:'score',pts:ov.scores}]);
+  const pseries = Object.entries(ov.paramMeanMagnitudes).slice(0,6)
+    .map(([k,v])=>({name:k,pts:v}));
+  drawSeries(document.getElementById('pmm'), pseries);
+  const perf=document.getElementById('perf');
+  perf.replaceChildren();
+  const hdr=perf.insertRow(), row=perf.insertRow();
+  [['last iteration',ov.lastIteration],
+   ['iter time (ms)',ov.lastIterTimeMs],
+   ['memory RSS (MB)',ov.memoryRssMb]].forEach(([h,v])=>{
+    const th=document.createElement('th'); th.textContent=h;
+    hdr.appendChild(th);
+    row.insertCell().textContent=(v==null)?'-':String(v);
+  });
+}
+refresh(); setInterval(refresh, 3000);
+</script></body></html>
+"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "dl4jtpu-ui/0.1"
+
+    def log_message(self, fmt, *args):  # quiet
+        log.debug("ui: " + fmt, *args)
+
+    def _json(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        storages: List[StatsStorage] = self.server.storages
+        path, _, query = self.path.partition("?")
+        params = dict(p.split("=", 1) for p in query.split("&") if "=" in p)
+        if path in ("/", "/train", "/train/overview.html"):
+            body = _PAGE.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if path == "/train/sessions":
+            sids = sorted({s for st in storages for s in st.list_session_ids()})
+            return self._json(sids)
+        if path == "/train/overview":
+            sid = params.get("sid")
+            if sid is None:
+                return self._json({"error": "sid required"}, 400)
+            return self._json(self._overview(storages, sid))
+        self._json({"error": "not found"}, 404)
+
+    def do_POST(self):
+        # remote stats receiver (ref: RemoteReceiverModule.java)
+        if self.path.rstrip("/") != "/remoteReceive":
+            return self._json({"error": "not found"}, 404)
+        if not self.server.remote_enabled:
+            return self._json({"error": "remote receiver disabled"}, 403)
+        if not self.server.storages:
+            return self._json({"error": "no storage attached"}, 503)
+        n = int(self.headers.get("Content-Length", 0))
+        payload = json.loads(self.rfile.read(n) or b"{}")
+        storage = self.server.storages[0]
+        kind = payload.get("type")
+        if kind == "staticInfo":
+            storage.put_static_info(payload["sessionId"], payload["data"])
+        elif kind == "update":
+            storage.put_update(StatsReport.from_dict(payload["data"]))
+        else:
+            return self._json({"error": f"unknown type {kind!r}"}, 400)
+        self._json({"status": "ok"})
+
+    @staticmethod
+    def _overview(storages: List[StatsStorage], sid: str) -> dict:
+        static = None
+        updates: List[StatsReport] = []
+        for st in storages:
+            static = static or st.get_static_info(sid)
+            updates.extend(st.get_all_updates(sid))
+        updates.sort(key=lambda r: r.iteration)
+
+        def num(v):  # reports may come from untrusted remote POSTs
+            try:
+                return None if v is None else float(v)
+            except (TypeError, ValueError):
+                return None
+
+        pmm: dict = {}
+        for r in updates:
+            for k, v in r.param_mean_magnitudes.items():
+                pmm.setdefault(str(k), []).append([int(r.iteration), num(v)])
+        last = updates[-1] if updates else None
+        return {
+            "sessionId": sid,
+            "modelClass": str((static or {}).get("modelClass") or "")[:200],
+            "numParams": num((static or {}).get("numParams")),
+            "scores": [[int(r.iteration), num(r.score)] for r in updates],
+            "paramMeanMagnitudes": pmm,
+            "lastIteration": int(last.iteration) if last else None,
+            "lastIterTimeMs": num(last.iteration_time_ms) if last else None,
+            "memoryRssMb": num(last.memory_rss_mb) if last else None,
+        }
+
+
+class UIServer:
+    """Singleton UI server (ref: api/UIServer.java — getInstance(),
+    attach(statsStorage), enableRemoteListener())."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self, port: int = 9000):
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self._httpd.storages = []
+        self._httpd.remote_enabled = False
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        log.info("UI server at http://127.0.0.1:%d/train", self.port)
+
+    @classmethod
+    def get_instance(cls, port: int = 9000) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = UIServer(port)
+        return cls._instance
+
+    def attach(self, storage: StatsStorage) -> None:
+        if storage not in self._httpd.storages:
+            self._httpd.storages.append(storage)
+
+    def detach(self, storage: StatsStorage) -> None:
+        if storage in self._httpd.storages:
+            self._httpd.storages.remove(storage)
+
+    def enable_remote_listener(self, storage: Optional[StatsStorage] = None):
+        """ref: UIServer.enableRemoteListener — POSTs to /remoteReceive land
+        in the first attached storage (or the one given here); with no
+        storage at all an InMemoryStatsStorage is created, like the
+        reference."""
+        if storage is not None:
+            self._httpd.storages.insert(0, storage)
+        elif not self._httpd.storages:
+            from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+            self._httpd.storages.append(InMemoryStatsStorage())
+        self._httpd.remote_enabled = True
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._thread.join(timeout=5)
+        self._httpd.server_close()  # release the listening socket
+        if UIServer._instance is self:
+            UIServer._instance = None
+
+
+class RemoteUIStatsStorageRouter(StatsStorage):
+    """Client that routes stats to a remote UIServer over HTTP POST
+    (ref: core api/storage/impl/RemoteUIStatsStorageRouter.java:1-355 —
+    retry with backoff on failure; here: bounded retries, then drop+warn)."""
+
+    def __init__(self, url: str, retries: int = 3, timeout: float = 5.0):
+        self.url = url.rstrip("/") + "/remoteReceive"
+        self.retries = retries
+        self.timeout = timeout
+
+    def _post(self, payload: dict) -> bool:
+        data = json.dumps(payload).encode()
+        for attempt in range(self.retries):
+            try:
+                req = urllib.request.Request(
+                    self.url, data=data,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                    return r.status == 200
+            except Exception as e:  # noqa: BLE001
+                if attempt == self.retries - 1:
+                    log.warning("remote stats post failed: %s", e)
+        return False
+
+    def put_static_info(self, session_id, info):
+        self._post({"type": "staticInfo", "sessionId": session_id,
+                    "data": info})
+
+    def put_update(self, report: StatsReport):
+        self._post({"type": "update", "data": report.to_dict()})
+
+    # remote router is write-only (ref: RemoteUIStatsStorageRouter is a
+    # StatsStorageRouter, not a StatsStorage)
+    def list_session_ids(self):
+        return []
+
+    def get_static_info(self, session_id):
+        return None
+
+    def get_all_updates(self, session_id):
+        return []

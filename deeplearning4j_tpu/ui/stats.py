@@ -1,0 +1,150 @@
+"""Stats collection listener.
+
+Equivalent of ui-model ui/stats/BaseStatsListener.java (:233 onForwardPass,
+:291 onBackwardPass, :296 iterationDone — score, param/gradient/update
+histograms and mean magnitudes, memory, timings) + SbeStatsReport.
+
+The SBE binary wire format is replaced by plain dict records (JSON-ready);
+the storage layer handles persistence. Histograms are computed on host from
+the (already device-resident) param pytree — one bulk transfer per report,
+throttled by ``frequency`` exactly like the reference's listenerFrequency.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+
+try:
+    import resource
+except ImportError:  # non-posix
+    resource = None
+
+
+@dataclass
+class StatsReport:
+    """One iteration's stats record (ref: impl/SbeStatsReport.java)."""
+    session_id: str
+    worker_id: str
+    iteration: int
+    timestamp: float
+    score: float
+    # mean magnitude per param tensor name
+    param_mean_magnitudes: Dict[str, float] = field(default_factory=dict)
+    update_mean_magnitudes: Dict[str, float] = field(default_factory=dict)
+    # histograms: name -> (bin_edges list, counts list)
+    param_histograms: Dict[str, Any] = field(default_factory=dict)
+    memory_rss_mb: Optional[float] = None
+    iteration_time_ms: Optional[float] = None
+    samples_per_sec: Optional[float] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "sessionId": self.session_id, "workerId": self.worker_id,
+            "iteration": self.iteration, "timestamp": self.timestamp,
+            "score": self.score,
+            "paramMeanMagnitudes": self.param_mean_magnitudes,
+            "updateMeanMagnitudes": self.update_mean_magnitudes,
+            "paramHistograms": self.param_histograms,
+            "memoryRssMb": self.memory_rss_mb,
+            "iterationTimeMs": self.iteration_time_ms,
+            "samplesPerSec": self.samples_per_sec,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "StatsReport":
+        return cls(session_id=d["sessionId"], worker_id=d["workerId"],
+                   iteration=d["iteration"], timestamp=d["timestamp"],
+                   score=d["score"],
+                   param_mean_magnitudes=d.get("paramMeanMagnitudes", {}),
+                   update_mean_magnitudes=d.get("updateMeanMagnitudes", {}),
+                   param_histograms=d.get("paramHistograms", {}),
+                   memory_rss_mb=d.get("memoryRssMb"),
+                   iteration_time_ms=d.get("iterationTimeMs"),
+                   samples_per_sec=d.get("samplesPerSec"))
+
+
+def _flatten_params(params, prefix="") -> Dict[str, np.ndarray]:
+    out = {}
+    if isinstance(params, dict):
+        for k, v in params.items():
+            out.update(_flatten_params(v, f"{prefix}{k}."))
+    else:
+        out[prefix.rstrip(".")] = np.asarray(params)
+    return out
+
+
+class StatsListener(TrainingListener):
+    """Collects per-iteration stats into a StatsStorage
+    (ref: BaseStatsListener.java; listenerFrequency semantics)."""
+
+    def __init__(self, storage, frequency: int = 1,
+                 session_id: Optional[str] = None, worker_id: str = "worker-0",
+                 collect_histograms: bool = True, histogram_bins: int = 20,
+                 collect_mean_magnitudes: bool = True):
+        self.storage = storage
+        self.frequency = max(1, frequency)
+        self.session_id = session_id or f"session-{int(time.time() * 1000)}"
+        self.worker_id = worker_id
+        self.collect_histograms = collect_histograms
+        self.histogram_bins = histogram_bins
+        self.collect_mean_magnitudes = collect_mean_magnitudes
+        self._last_iter_time: Optional[float] = None
+        self._init_posted = False
+
+    def iteration_done(self, model, iteration: int, score: float):
+        now = time.time()
+        it_ms = None
+        if self._last_iter_time is not None:
+            it_ms = (now - self._last_iter_time) * 1000.0
+        self._last_iter_time = now
+        if iteration % self.frequency != 0:
+            return
+        if not self._init_posted:
+            self.storage.put_static_info(self.session_id, {
+                "sessionId": self.session_id,
+                "workerId": self.worker_id,
+                "startTime": now,
+                "modelClass": type(model).__name__,
+                "numParams": getattr(model, "num_params", lambda: None)(),
+                "configJson": self._config_json(model),
+            })
+            self._init_posted = True
+
+        report = StatsReport(self.session_id, self.worker_id, iteration,
+                             now, float(score), iteration_time_ms=it_ms)
+        params = getattr(model, "params", None)
+        if params:
+            flat = _flatten_params(params)
+            if self.collect_mean_magnitudes:
+                report.param_mean_magnitudes = {
+                    k: float(np.mean(np.abs(v))) for k, v in flat.items()}
+            if self.collect_histograms:
+                for k, v in flat.items():
+                    counts, edges = np.histogram(v, bins=self.histogram_bins)
+                    report.param_histograms[k] = {
+                        "bins": [float(e) for e in edges],
+                        "counts": [int(c) for c in counts]}
+        if resource is not None:
+            rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            # linux reports KiB, darwin reports bytes
+            divisor = 1024.0 * 1024.0 if sys.platform == "darwin" else 1024.0
+            report.memory_rss_mb = rss / divisor
+        self.storage.put_update(report)
+
+    @staticmethod
+    def _config_json(model) -> Optional[str]:
+        conf = getattr(model, "conf", None)
+        to_json = getattr(conf, "to_json", None)
+        if callable(to_json):
+            try:
+                return to_json()
+            except Exception:
+                return None
+        return None

@@ -1,0 +1,151 @@
+"""Stats storage backends.
+
+Equivalent of the StatsStorage API (core api/storage/StatsStorage.java:222,
+StatsStorageRouter) and its impls (ui/storage/InMemoryStatsStorage,
+FileStatsStorage (MapDB), sqlite/J7FileStatsStorage). FileStatsStorage here
+uses stdlib sqlite3 — the idiomatic equivalent of linking MapDB/SQLite.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+from collections import defaultdict
+from typing import Any, Callable, Dict, List, Optional
+
+from deeplearning4j_tpu.ui.stats import StatsReport
+
+
+class StatsStorage:
+    """Persistence-agnostic stats routing API
+    (ref: api/storage/StatsStorage.java). Also the router: listeners call
+    ``put_update``/``put_static_info`` directly."""
+
+    def put_static_info(self, session_id: str, info: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def put_update(self, report: StatsReport) -> None:
+        raise NotImplementedError
+
+    def list_session_ids(self) -> List[str]:
+        raise NotImplementedError
+
+    def get_static_info(self, session_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def get_all_updates(self, session_id: str) -> List[StatsReport]:
+        raise NotImplementedError
+
+    def get_latest_update(self, session_id: str) -> Optional[StatsReport]:
+        ups = self.get_all_updates(session_id)
+        return ups[-1] if ups else None
+
+    # listener registration (ref: StatsStorage.registerStatsStorageListener)
+    def register_listener(self, cb: Callable[[str], None]) -> None:
+        if not hasattr(self, "_listeners"):
+            self._listeners = []
+        self._listeners.append(cb)
+
+    def _notify(self, session_id: str) -> None:
+        for cb in getattr(self, "_listeners", []):
+            cb(session_id)
+
+    def close(self) -> None:
+        pass
+
+
+class InMemoryStatsStorage(StatsStorage):
+    """ref: ui/storage/InMemoryStatsStorage.java."""
+
+    def __init__(self):
+        self._static: Dict[str, Dict[str, Any]] = {}
+        self._updates: Dict[str, List[StatsReport]] = defaultdict(list)
+        self._lock = threading.Lock()
+
+    def put_static_info(self, session_id, info):
+        with self._lock:
+            self._static[session_id] = dict(info)
+        self._notify(session_id)
+
+    def put_update(self, report):
+        with self._lock:
+            self._updates[report.session_id].append(report)
+        self._notify(report.session_id)
+
+    def list_session_ids(self):
+        with self._lock:
+            keys = set(self._static) | set(self._updates)
+        return sorted(keys)
+
+    def get_static_info(self, session_id):
+        with self._lock:
+            return self._static.get(session_id)
+
+    def get_all_updates(self, session_id):
+        with self._lock:
+            return list(self._updates.get(session_id, []))
+
+
+class FileStatsStorage(StatsStorage):
+    """SQLite-backed storage (ref: ui/storage/FileStatsStorage.java /
+    sqlite J7FileStatsStorage). One file, survives restarts, readable by a
+    UIServer attached later."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        with self._lock:
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS static_info "
+                "(session_id TEXT PRIMARY KEY, json TEXT)")
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS updates "
+                "(session_id TEXT, iteration INTEGER, json TEXT)")
+            self._conn.execute(
+                "CREATE INDEX IF NOT EXISTS idx_updates ON updates "
+                "(session_id, iteration)")
+            self._conn.commit()
+
+    def put_static_info(self, session_id, info):
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO static_info VALUES (?, ?)",
+                (session_id, json.dumps(info)))
+            self._conn.commit()
+        self._notify(session_id)
+
+    def put_update(self, report):
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO updates VALUES (?, ?, ?)",
+                (report.session_id, report.iteration,
+                 json.dumps(report.to_dict())))
+            self._conn.commit()
+        self._notify(report.session_id)
+
+    def list_session_ids(self):
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT session_id FROM static_info UNION "
+                "SELECT DISTINCT session_id FROM updates").fetchall()
+        return sorted(r[0] for r in rows)
+
+    def get_static_info(self, session_id):
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT json FROM static_info WHERE session_id=?",
+                (session_id,)).fetchone()
+        return json.loads(row[0]) if row else None
+
+    def get_all_updates(self, session_id):
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT json FROM updates WHERE session_id=? "
+                "ORDER BY iteration", (session_id,)).fetchall()
+        return [StatsReport.from_dict(json.loads(r[0])) for r in rows]
+
+    def close(self):
+        with self._lock:
+            self._conn.close()

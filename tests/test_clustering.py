@@ -217,3 +217,22 @@ class TestTsne:
         ts = BarnesHutTsne(theta=0.0, perplexity=5, max_iter=20, seed=0)
         Y = ts.fit_transform(X)
         assert Y.shape == (15, 2)
+
+
+class TestReviewRegressions:
+    def test_manhattan_metric_blocked(self):
+        rng = np.random.default_rng(9)
+        pts = rng.standard_normal((300, 6)).astype(np.float32)
+        qs = rng.standard_normal((5, 6)).astype(np.float32)
+        idx, d = knn_search(pts, qs, k=4, metric="manhattan")
+        for i, q in enumerate(qs):
+            brute = np.argsort(np.abs(pts - q).sum(axis=1))[:4]
+            np.testing.assert_array_equal(idx[i], brute)
+            assert np.all(np.diff(d[i]) >= -1e-5)
+
+    def test_kmeanspp_duplicate_points(self):
+        # fewer distinct points than k must not crash the ++ init
+        pts = np.repeat(np.array([[0.0, 0.0], [5.0, 5.0]]), 10, axis=0)
+        km = KMeansClustering(cluster_count=3, max_iterations=10, seed=0)
+        cs = km.apply_to(pts)
+        assert cs.get_cluster_count() == 3

@@ -1,0 +1,156 @@
+"""Observability tests (ref: deeplearning4j-ui-parent tests:
+TestStatsListener, TestStatsStorage, TestRemoteReceiver)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ui import (
+    FileStatsStorage, InMemoryStatsStorage, RemoteUIStatsStorageRouter,
+    StatsListener, StatsReport, UIServer,
+)
+
+
+class FakeModel:
+    def __init__(self):
+        self.params = {"0": {"W": np.ones((3, 2)), "b": np.zeros(2)}}
+        self.conf = None
+
+    def num_params(self):
+        return 8
+
+
+def make_report(i, sid="s1", score=None):
+    return StatsReport(session_id=sid, worker_id="w0", iteration=i,
+                       timestamp=1000.0 + i, score=score or 1.0 / (i + 1),
+                       param_mean_magnitudes={"0.W": 0.5})
+
+
+class TestStorage:
+    @pytest.mark.parametrize("make", [
+        lambda tmp: InMemoryStatsStorage(),
+        lambda tmp: FileStatsStorage(str(tmp / "stats.db")),
+    ], ids=["memory", "sqlite"])
+    def test_roundtrip(self, make, tmp_path):
+        st = make(tmp_path)
+        st.put_static_info("s1", {"modelClass": "MLN", "numParams": 42})
+        for i in range(5):
+            st.put_update(make_report(i))
+        assert st.list_session_ids() == ["s1"]
+        assert st.get_static_info("s1")["numParams"] == 42
+        ups = st.get_all_updates("s1")
+        assert [u.iteration for u in ups] == list(range(5))
+        assert st.get_latest_update("s1").iteration == 4
+        st.close()
+
+    def test_sqlite_persists(self, tmp_path):
+        p = str(tmp_path / "stats.db")
+        st = FileStatsStorage(p)
+        st.put_update(make_report(0))
+        st.close()
+        st2 = FileStatsStorage(p)
+        assert len(st2.get_all_updates("s1")) == 1
+        st2.close()
+
+    def test_listener_notification(self):
+        st = InMemoryStatsStorage()
+        seen = []
+        st.register_listener(seen.append)
+        st.put_update(make_report(1))
+        assert seen == ["s1"]
+
+
+class TestStatsListener:
+    def test_collects_score_params_memory(self):
+        st = InMemoryStatsStorage()
+        lst = StatsListener(st, frequency=2)
+        model = FakeModel()
+        for i in range(6):
+            lst.iteration_done(model, i, 0.5 - 0.01 * i)
+        ups = st.get_all_updates(lst.session_id)
+        assert [u.iteration for u in ups] == [0, 2, 4]  # frequency throttle
+        u = ups[-1]
+        assert u.score == pytest.approx(0.46)
+        assert u.param_mean_magnitudes["0.W"] == pytest.approx(1.0)
+        assert u.param_mean_magnitudes["0.b"] == pytest.approx(0.0)
+        assert "bins" in u.param_histograms["0.W"]
+        assert u.memory_rss_mb is None or u.memory_rss_mb > 0
+        static = st.get_static_info(lst.session_id)
+        assert static["numParams"] == 8
+
+    def test_works_in_real_training(self):
+        # integration: listener attached to an actual fit loop
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(12345)
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+                .layer(OutputLayer(n_in=8, n_out=3,
+                                   activation="softmax",
+                                   loss="categorical_crossentropy"))
+                .build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        st = InMemoryStatsStorage()
+        net.set_listeners(StatsListener(st))
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((30, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 30)]
+        net.fit(DataSet(x, y), epochs=2)
+        sids = st.list_session_ids()
+        assert len(sids) == 1
+        ups = st.get_all_updates(sids[0])
+        assert len(ups) >= 2
+        assert all(np.isfinite(u.score) for u in ups)
+        assert any("W" in k for u in ups for k in u.param_mean_magnitudes)
+
+
+class TestUIServer:
+    def test_http_endpoints_and_remote(self):
+        server = UIServer(port=0)  # ephemeral port
+        try:
+            st = InMemoryStatsStorage()
+            server.attach(st)
+            st.put_static_info("s1", {"modelClass": "MLN", "numParams": 10})
+            for i in range(3):
+                st.put_update(make_report(i))
+            base = f"http://127.0.0.1:{server.port}"
+            with urllib.request.urlopen(base + "/train/sessions") as r:
+                assert json.load(r) == ["s1"]
+            with urllib.request.urlopen(base + "/train/overview?sid=s1") as r:
+                ov = json.load(r)
+            assert ov["numParams"] == 10
+            assert len(ov["scores"]) == 3
+            assert ov["paramMeanMagnitudes"]["0.W"][0] == [0, 0.5]
+            with urllib.request.urlopen(base + "/train") as r:
+                assert b"Training overview" in r.read()
+
+            # remote receiver path: disabled → 403, enabled → lands in storage
+            router = RemoteUIStatsStorageRouter(base, retries=1)
+            router.put_update(make_report(9, sid="remote"))
+            assert "remote" not in st.list_session_ids()
+            server.enable_remote_listener()
+            router.put_static_info("remote", {"modelClass": "CG"})
+            router.put_update(make_report(9, sid="remote"))
+            assert st.get_static_info("remote")["modelClass"] == "CG"
+            assert st.get_all_updates("remote")[0].iteration == 9
+        finally:
+            server.stop()
+
+    def test_get_instance_singleton(self):
+        a = UIServer.get_instance(port=0)
+        try:
+            assert UIServer.get_instance() is a
+        finally:
+            a.stop()
+        b = UIServer.get_instance(port=0)
+        try:
+            assert b is not a
+        finally:
+            b.stop()
