@@ -59,7 +59,9 @@ def knn_search(points, queries, k: int, metric: str = "euclidean",
     for s in range(0, queries.shape[0], query_block):
         q = jnp.asarray(queries[s:s + query_block])
         if metric == "manhattan":
-            point_block = max(1, (1 << 22) // max(1, q.shape[0]))
+            # bound the [Q,B,D] intermediate to ~4M elements
+            point_block = max(1, (1 << 22) //
+                              max(1, q.shape[0] * points.shape[1]))
             dists = np.concatenate(
                 [np.asarray(_manhattan_block(points[ps:ps + point_block], q))
                  for ps in range(0, points.shape[0], point_block)], axis=1)

@@ -1,0 +1,198 @@
+"""Sequence/context parallelism: ring attention + all-to-all (Ulysses).
+
+The reference (2017-era DL4J) has no attention and no sequence parallelism
+(SURVEY §5 "Long-context"): its long-sequence story is truncated BPTT +
+masking, which this framework already implements. This module is the
+forward-looking long-context subsystem the TPU build treats as first-class:
+
+- **Ring attention** (blockwise attention with KV rotation over the ICI
+  ring): each device holds a sequence shard; K/V blocks rotate around the
+  mesh axis via ``jax.lax.ppermute`` while a streaming (online-softmax)
+  accumulator keeps the attention numerically exact. Memory per device is
+  O(T_local²-free): only the local Q block and one in-flight KV block live
+  in HBM, so context length scales linearly with the number of devices.
+- **Ulysses / all-to-all attention**: ``jax.lax.all_to_all`` reshards from
+  sequence-sharded to head-sharded, runs full local attention on each
+  device's head slice, then reshards back. Cheaper collectives for models
+  with enough heads; attention itself is unchanged.
+
+Both are exact — outputs match single-device attention to float tolerance
+(tested on an 8-device CPU mesh).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() gradients clean
+
+
+def reference_attention(q, k, v, causal: bool = False):
+    """Plain single-device scaled-dot-product attention, [B,H,T,D] layout.
+    The correctness oracle for both parallel paths."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool))
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
+    """Per-shard ring attention body (runs under shard_map).
+
+    q,k,v: [B,H,T_local,D] — this device's sequence shard. K/V blocks
+    rotate ring-wise; a streaming softmax (running max m, normalizer l,
+    weighted sum o) accumulates exact attention over the full sequence.
+    """
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    scale = jnp.float32(1.0 / np.sqrt(q.shape[-1]))
+    B, H, T, D = q.shape
+    qf = q.astype(jnp.float32)
+
+    m0 = jnp.full((B, H, T), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, T), jnp.float32)
+    o0 = jnp.zeros((B, H, T, D), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    q_pos = my * T + jnp.arange(T)                     # global query positions
+
+    def body(step, carry):
+        k_c, v_c, m, l, o = carry
+        src = (my - step) % n                          # origin shard of k_c
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf,
+                       k_c.astype(jnp.float32)) * scale
+        if causal:
+            k_pos = src * T + jnp.arange(T)
+            mask = q_pos[:, None] >= k_pos[None, :]    # [T,T]
+            s = jnp.where(mask[None, None], s, NEG_INF)
+        blk_max = jnp.max(s, axis=-1)                  # [B,H,T]
+        m_new = jnp.maximum(m, blk_max)
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_c.astype(jnp.float32))
+        k_r = jax.lax.ppermute(k_c, axis_name, perm)
+        v_r = jax.lax.ppermute(v_c, axis_name, perm)
+        return k_r, v_r, m_new, l_new, o_new
+
+    _, _, m, l, o = jax.lax.fori_loop(0, n, body, (k, v, m0, l0, o0))
+    # fully-masked rows (can't happen for causal with step 0 = own block,
+    # but guard anyway) normalize to zero
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "data",
+                   causal: bool = False):
+    """Exact attention over a sequence sharded on ``mesh[axis]``.
+
+    q/k/v: [B,H,T,D] global arrays (T divisible by the axis size). Returns
+    [B,H,T,D]. Under jit the ppermutes ride ICI neighbor links — the
+    canonical ring schedule.
+    """
+    spec = P(None, None, axis, None)
+    fn = shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
+
+
+def _ulysses_local(q, k, v, *, axis_name: str, causal: bool):
+    """Per-shard Ulysses body: all_to_all seq→head shards, local full
+    attention, all_to_all back. q,k,v: [B,H,T_local,D]; H divisible by n."""
+    def seq_to_heads(x):
+        # [B,H,T_local,D] -> [B,H/n,T_global,D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = reference_attention(qh.astype(jnp.float32),
+                              kh.astype(jnp.float32),
+                              vh.astype(jnp.float32), causal=causal)
+    return heads_to_seq(out.astype(q.dtype))
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "data",
+                      causal: bool = False):
+    """All-to-all (DeepSpeed-Ulysses-style) sequence-parallel attention.
+    Requires num_heads % axis_size == 0."""
+    n = mesh.shape[axis]
+    if q.shape[1] % n != 0:
+        raise ValueError(
+            f"ulysses needs heads ({q.shape[1]}) divisible by mesh axis "
+            f"'{axis}' size ({n}); use ring_attention otherwise")
+    spec = P(None, None, axis, None)
+    fn = shard_map(
+        functools.partial(_ulysses_local, axis_name=axis, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
+
+
+class MultiHeadSelfAttention:
+    """Minimal MHA block wired for sequence parallelism: projections are
+    plain (replicated) matmuls; the attention core is ring/ulysses/local.
+
+    x: [B,T,E] → [B,T,E]. A post-parity extension (the reference has no
+    attention layer); exists so long-context models can be built and the
+    sequence-parallel paths exercised end-to-end in training steps.
+    """
+
+    def __init__(self, embed_dim: int, num_heads: int,
+                 impl: str = "ring", causal: bool = True):
+        if embed_dim % num_heads:
+            raise ValueError("embed_dim must divide by num_heads")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        if impl not in ("ring", "ulysses", "local"):
+            raise ValueError(f"unknown attention impl {impl!r}")
+        self.impl = impl
+        self.causal = causal
+
+    def init(self, rng: jax.Array):
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        s = 1.0 / np.sqrt(self.embed_dim)
+        E = self.embed_dim
+        return {
+            "wq": jax.random.normal(k1, (E, E)) * s,
+            "wk": jax.random.normal(k2, (E, E)) * s,
+            "wv": jax.random.normal(k3, (E, E)) * s,
+            "wo": jax.random.normal(k4, (E, E)) * s,
+        }
+
+    def apply(self, params, x, mesh: Optional[Mesh] = None,
+              axis: str = "data"):
+        B, T, E = x.shape
+        H, D = self.num_heads, self.head_dim
+
+        def heads(u):  # [B,T,E] -> [B,H,T,D]
+            return u.reshape(B, T, H, D).transpose(0, 2, 1, 3)
+
+        q, k, v = (heads(x @ params[w]) for w in ("wq", "wk", "wv"))
+        if self.impl == "local" or mesh is None:
+            o = reference_attention(q, k, v, causal=self.causal)
+        elif self.impl == "ring":
+            o = ring_attention(q, k, v, mesh, axis=axis, causal=self.causal)
+        else:
+            o = ulysses_attention(q, k, v, mesh, axis=axis,
+                                  causal=self.causal)
+        o = o.transpose(0, 2, 1, 3).reshape(B, T, E)
+        return o @ params["wo"]

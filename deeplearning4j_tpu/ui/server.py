@@ -16,6 +16,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
@@ -116,7 +117,8 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         storages: List[StatsStorage] = self.server.storages
         path, _, query = self.path.partition("?")
-        params = dict(p.split("=", 1) for p in query.split("&") if "=" in p)
+        params = {k: v[0] for k, v in
+                  urllib.parse.parse_qs(query).items()}
         if path in ("/", "/train", "/train/overview.html"):
             body = _PAGE.encode()
             self.send_response(200)
@@ -143,16 +145,20 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json({"error": "remote receiver disabled"}, 403)
         if not self.server.storages:
             return self._json({"error": "no storage attached"}, 503)
-        n = int(self.headers.get("Content-Length", 0))
-        payload = json.loads(self.rfile.read(n) or b"{}")
         storage = self.server.storages[0]
-        kind = payload.get("type")
-        if kind == "staticInfo":
-            storage.put_static_info(payload["sessionId"], payload["data"])
-        elif kind == "update":
-            storage.put_update(StatsReport.from_dict(payload["data"]))
-        else:
-            return self._json({"error": f"unknown type {kind!r}"}, 400)
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(n) or b"{}")
+            kind = payload.get("type")
+            if kind == "staticInfo":
+                storage.put_static_info(str(payload["sessionId"]),
+                                        dict(payload["data"]))
+            elif kind == "update":
+                storage.put_update(StatsReport.from_dict(payload["data"]))
+            else:
+                return self._json({"error": f"unknown type {kind!r}"}, 400)
+        except (KeyError, TypeError, ValueError) as e:
+            return self._json({"error": f"malformed payload: {e}"}, 400)
         self._json({"status": "ok"})
 
     @staticmethod
@@ -223,6 +229,8 @@ class UIServer:
         storage at all an InMemoryStatsStorage is created, like the
         reference."""
         if storage is not None:
+            if storage in self._httpd.storages:
+                self._httpd.storages.remove(storage)
             self._httpd.storages.insert(0, storage)
         elif not self._httpd.storages:
             from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
