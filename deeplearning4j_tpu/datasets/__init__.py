@@ -10,3 +10,9 @@ from deeplearning4j_tpu.datasets.iterators import (  # noqa: F401
     AsyncDataSetIterator,
     ExistingDataSetIterator,
 )
+from deeplearning4j_tpu.datasets.fetchers import (  # noqa: F401
+    CifarDataSetIterator,
+    EmnistDataSetIterator,
+    IrisDataSetIterator,
+    MnistDataSetIterator,
+)

@@ -1,0 +1,207 @@
+"""Dataset fetchers/iterators: MNIST, EMNIST, CIFAR-10, Iris.
+
+Equivalent of deeplearning4j-core base/MnistFetcher.java, EmnistFetcher.java,
+datasets/fetchers/MnistDataFetcher.java, datasets/iterator/impl/
+{Mnist,Emnist,Cifar,Iris}DataSetIterator and the datasets/mnist/ IDX readers.
+
+The reference downloads archives at construction time; this environment is
+zero-egress, so fetchers read from a local data directory
+(``data_dir`` arg or ``$DL4J_TPU_DATA_DIR``, default ``~/.dl4jtpu/data``).
+Binary decode + normalization + batch assembly run through the native C++
+IO runtime (deeplearning4j_tpu.native). ``synthetic=True`` generates a
+deterministic stand-in dataset with the real shapes for pipeline testing
+without the files.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import shutil
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+from deeplearning4j_tpu.native import read_idx, u8_to_f32
+
+DEFAULT_DATA_DIR = os.environ.get(
+    "DL4J_TPU_DATA_DIR", os.path.expanduser("~/.dl4jtpu/data"))
+
+MNIST_FILES = {
+    True: ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+    False: ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
+}
+
+
+def _resolve(data_dir: Optional[str], name: str) -> str:
+    """Find ``name`` (or name.gz, decompressing next to it) under data_dir."""
+    base = data_dir or DEFAULT_DATA_DIR
+    path = os.path.join(base, name)
+    if os.path.exists(path):
+        return path
+    gz = path + ".gz"
+    if os.path.exists(gz):
+        # decompress to a temp name then rename: an interrupted extraction
+        # must not leave a truncated file at the final path
+        tmp = path + f".tmp.{os.getpid()}"
+        try:
+            with gzip.open(gz, "rb") as fin, open(tmp, "wb") as fout:
+                shutil.copyfileobj(fin, fout)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        return path
+    raise FileNotFoundError(
+        f"dataset file {name!r} not found under {base!r}. This build is "
+        f"zero-egress: place the file there manually (or pass "
+        f"synthetic=True for a deterministic stand-in).")
+
+
+def _one_hot(labels: np.ndarray, n: int) -> np.ndarray:
+    out = np.zeros((labels.shape[0], n), np.float32)
+    out[np.arange(labels.shape[0]), labels.astype(np.int64)] = 1.0
+    return out
+
+
+def _synthetic_images(n: int, shape: Tuple[int, ...], classes: int,
+                      seed: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic class-dependent image-like data (NOT real data)."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, classes, n)
+    imgs = rng.integers(0, 256, (n,) + shape, np.uint8)
+    # plant a class-dependent mean shift so models can actually learn
+    imgs = np.clip(imgs.astype(np.int32) +
+                   (labels * (128 // classes))[:, None, None]
+                   .reshape((n,) + (1,) * len(shape)), 0, 255)
+    return imgs.astype(np.uint8), labels
+
+
+class MnistDataSetIterator(ArrayDataSetIterator):
+    """MNIST minibatches, features scaled to [0,1], labels one-hot
+    (ref: MnistDataSetIterator + MnistDataFetcher semantics).
+
+    Features are [N, 784] row vectors like the reference (use
+    ``FeedForwardToCnnPreProcessor``/reshape for CNNs).
+    """
+
+    NUM_CLASSES = 10
+
+    def __init__(self, batch_size: int, train: bool = True,
+                 data_dir: Optional[str] = None, shuffle: Optional[bool] = None,
+                 seed: int = 123, synthetic: bool = False,
+                 num_examples: Optional[int] = None, flatten: bool = True):
+        if synthetic:
+            imgs, labels = _synthetic_images(
+                num_examples or (6000 if train else 1000), (28, 28),
+                self.NUM_CLASSES, seed)
+        else:
+            img_f, lbl_f = MNIST_FILES[train]
+            imgs = read_idx(_resolve(data_dir, img_f))
+            labels = read_idx(_resolve(data_dir, lbl_f))
+            if num_examples:
+                imgs, labels = imgs[:num_examples], labels[:num_examples]
+        x = u8_to_f32(imgs)  # native threaded [0,1] scaling
+        x = x.reshape(x.shape[0], -1) if flatten \
+            else x.reshape(x.shape[0], 1, *imgs.shape[1:])
+        y = _one_hot(labels, self.NUM_CLASSES)
+        super().__init__(x, y, batch_size=batch_size,
+                         shuffle=(train if shuffle is None else shuffle),
+                         seed=seed)
+
+
+class EmnistDataSetIterator(MnistDataSetIterator):
+    """EMNIST (ref: EmnistDataSetIterator.java). Same IDX format; split
+    selects the file set and class count."""
+
+    SPLITS = {"balanced": 47, "byclass": 62, "bymerge": 47, "digits": 10,
+              "letters": 26, "mnist": 10}
+
+    def __init__(self, batch_size: int, split: str = "balanced",
+                 train: bool = True, data_dir: Optional[str] = None,
+                 shuffle: Optional[bool] = None, seed: int = 123,
+                 synthetic: bool = False,
+                 num_examples: Optional[int] = None, flatten: bool = True):
+        if split not in self.SPLITS:
+            raise ValueError(f"unknown EMNIST split {split!r}; "
+                             f"one of {sorted(self.SPLITS)}")
+        self.NUM_CLASSES = self.SPLITS[split]
+        part = "train" if train else "test"
+        files = (f"emnist-{split}-{part}-images-idx3-ubyte",
+                 f"emnist-{split}-{part}-labels-idx1-ubyte")
+        if synthetic:
+            super().__init__(batch_size, train=train, data_dir=data_dir,
+                             shuffle=shuffle, seed=seed, synthetic=True,
+                             num_examples=num_examples, flatten=flatten)
+            return
+        imgs = read_idx(_resolve(data_dir, files[0]))
+        labels = read_idx(_resolve(data_dir, files[1]))
+        if split == "letters":  # letters labels are 1-based
+            labels = labels - 1
+        if num_examples:
+            imgs, labels = imgs[:num_examples], labels[:num_examples]
+        x = u8_to_f32(imgs)
+        x = x.reshape(x.shape[0], -1) if flatten \
+            else x.reshape(x.shape[0], 1, *imgs.shape[1:])
+        y = _one_hot(labels, self.NUM_CLASSES)
+        ArrayDataSetIterator.__init__(
+            self, x, y, batch_size=batch_size,
+            shuffle=(train if shuffle is None else shuffle), seed=seed)
+
+
+class CifarDataSetIterator(ArrayDataSetIterator):
+    """CIFAR-10 from the python/bin binary batches
+    (ref: CifarDataSetIterator.java). Features [N,3,32,32] in [0,1]."""
+
+    NUM_CLASSES = 10
+    TRAIN_FILES = [f"data_batch_{i}.bin" for i in range(1, 6)]
+    TEST_FILES = ["test_batch.bin"]
+
+    def __init__(self, batch_size: int, train: bool = True,
+                 data_dir: Optional[str] = None, seed: int = 123,
+                 synthetic: bool = False,
+                 num_examples: Optional[int] = None):
+        if synthetic:
+            imgs, labels = _synthetic_images(
+                num_examples or 2000, (3, 32, 32), self.NUM_CLASSES, seed)
+        else:
+            parts = []
+            for name in (self.TRAIN_FILES if train else self.TEST_FILES):
+                raw = np.fromfile(_resolve(data_dir, name), np.uint8)
+                parts.append(raw.reshape(-1, 3073))  # [label + 3072 pixels]
+            recs = np.concatenate(parts)
+            if num_examples:
+                recs = recs[:num_examples]
+            labels = recs[:, 0]
+            imgs = recs[:, 1:].reshape(-1, 3, 32, 32)
+        x = u8_to_f32(np.ascontiguousarray(imgs)).reshape(-1, 3, 32, 32)
+        y = _one_hot(labels, self.NUM_CLASSES)
+        super().__init__(x, y, batch_size=batch_size, shuffle=train,
+                         seed=seed)
+
+
+class IrisDataSetIterator(ArrayDataSetIterator):
+    """Iris (ref: IrisDataSetIterator.java). Reads ``iris.csv``
+    (4 features + integer label per row) from the data dir; without the
+    file, generates a deterministic 3-class Gaussian stand-in with the
+    iris shape (150x4) — synthetic, clearly not Fisher's measurements."""
+
+    def __init__(self, batch_size: int = 150, num_examples: int = 150,
+                 data_dir: Optional[str] = None, seed: int = 6):
+        try:
+            from deeplearning4j_tpu.native import read_csv
+            data = read_csv(_resolve(data_dir, "iris.csv"))
+            x, labels = data[:, :4], data[:, 4].astype(np.int64)
+        except FileNotFoundError:
+            rng = np.random.default_rng(seed)
+            centers = np.array([[5.0, 3.4, 1.5, 0.2],
+                                [5.9, 2.8, 4.3, 1.3],
+                                [6.6, 3.0, 5.6, 2.0]], np.float32)
+            labels = np.repeat(np.arange(3), 50)
+            x = (centers[labels] +
+                 rng.normal(0, 0.3, (150, 4))).astype(np.float32)
+        x, labels = x[:num_examples], labels[:num_examples]
+        super().__init__(x.astype(np.float32), _one_hot(labels, 3),
+                         batch_size=batch_size, shuffle=False, seed=seed)

@@ -1,0 +1,13 @@
+"""Native C++ IO runtime bindings.
+
+The reference reaches native code through JavaCPP JNI (SURVEY §2.1); here the
+host-side data-pipeline hot loops (IDX/CSV decode, u8→f32 normalization,
+batch gather) live in C++ (native/src/io.cpp) behind a flat C ABI loaded via
+ctypes. ctypes releases the GIL during calls, so decode overlaps Python-side
+work and XLA compute. Everything has a numpy fallback — the native lib is an
+accelerator, not a dependency.
+"""
+
+from deeplearning4j_tpu.native.io import (  # noqa: F401
+    native_available, read_idx, read_csv, u8_to_f32, gather_rows,
+)

@@ -1,0 +1,183 @@
+"""ctypes bindings for native/src/io.cpp with numpy fallbacks."""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libdl4jtpu_io.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_attempted = False
+
+_IDX_DTYPES = {0x08: np.uint8, 0x09: np.int8, 0x0B: np.dtype(">i2"),
+               0x0C: np.dtype(">i4"), 0x0D: np.dtype(">f4"),
+               0x0E: np.dtype(">f8")}
+_IDX_HOST = {0x08: np.uint8, 0x09: np.int8, 0x0B: np.int16,
+             0x0C: np.int32, 0x0D: np.float32, 0x0E: np.float64}
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    """Load the native lib, building it with make on first use."""
+    global _lib, _build_attempted
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO_PATH) and not _build_attempted:
+            _build_attempted = True
+            try:
+                subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                               capture_output=True, timeout=120)
+            except Exception as e:  # noqa: BLE001
+                log.info("native build unavailable (%s); using numpy "
+                         "fallbacks", e)
+                return None
+        if not os.path.exists(_SO_PATH):
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError as e:
+            log.info("native lib load failed (%s); using numpy fallbacks", e)
+            return None
+        lib.dl4j_idx_info.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_int)]
+        lib.dl4j_idx_read.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_long, ctypes.c_int]
+        lib.dl4j_csv_count_rows.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.dl4j_csv_count_rows.restype = ctypes.c_long
+        lib.dl4j_csv_read.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_long, ctypes.c_long,
+            ctypes.c_int]
+        lib.dl4j_u8_to_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_ubyte), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_long, ctypes.c_float, ctypes.c_int]
+        lib.dl4j_gather_rows_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_long, ctypes.c_long,
+            ctypes.c_int]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+# ---------------------------------------------------------------------------
+
+def read_idx(path: str, nthreads: int = 0) -> np.ndarray:
+    """Decode an IDX file (MNIST family) into a host-order numpy array."""
+    lib = _load()
+    if lib is None:
+        return _read_idx_numpy(path)
+    ndim = ctypes.c_int()
+    dtype = ctypes.c_int()
+    dims = (ctypes.c_long * 8)()
+    rc = lib.dl4j_idx_info(path.encode(), ctypes.byref(ndim), dims,
+                           ctypes.byref(dtype))
+    if rc != 0:
+        raise IOError(f"bad IDX file {path!r} (code {rc})")
+    shape = tuple(dims[i] for i in range(ndim.value))
+    out = np.empty(shape, dtype=_IDX_HOST[dtype.value])
+    rc = lib.dl4j_idx_read(path.encode(),
+                           out.ctypes.data_as(ctypes.c_void_p),
+                           out.nbytes, nthreads)
+    if rc != 0:
+        raise IOError(f"IDX read failed for {path!r} (code {rc})")
+    return out
+
+
+def _read_idx_numpy(path: str) -> np.ndarray:
+    with open(path, "rb") as f:
+        magic = f.read(4)
+        if len(magic) != 4 or magic[0] != 0 or magic[1] != 0:
+            raise IOError(f"bad IDX file {path!r}")
+        dtype, nd = magic[2], magic[3]
+        if dtype not in _IDX_DTYPES or not (1 <= nd <= 8):
+            raise IOError(f"bad IDX file {path!r}")
+        shape = tuple(int.from_bytes(f.read(4), "big") for _ in range(nd))
+        data = np.frombuffer(f.read(), dtype=_IDX_DTYPES[dtype])
+        expect = int(np.prod(shape))
+        if data.size != expect:
+            raise IOError(f"IDX payload mismatch in {path!r}")
+    return data.reshape(shape).astype(_IDX_HOST[dtype], copy=False)
+
+
+def read_csv(path: str, skip_header: bool = False, delimiter: str = ",",
+             nthreads: int = 0) -> np.ndarray:
+    """Parse a numeric CSV into a [rows, cols] float32 array."""
+    lib = _load()
+    if lib is None:
+        return np.loadtxt(path, delimiter=delimiter, dtype=np.float32,
+                          skiprows=1 if skip_header else 0, ndmin=2)
+    rows = lib.dl4j_csv_count_rows(path.encode(), int(skip_header))
+    if rows < 0:
+        raise IOError(f"cannot read {path!r}")
+    if rows == 0:
+        return np.empty((0, 0), np.float32)
+    with open(path) as f:
+        if skip_header:
+            f.readline()
+        first = f.readline()
+    cols = len([t for t in first.replace(delimiter, " ").split() if t])
+    out = np.empty((rows, cols), np.float32)
+    rc = lib.dl4j_csv_read(
+        path.encode(), int(skip_header), delimiter.encode()[:1],
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), rows, cols,
+        nthreads)
+    if rc != 0:
+        raise IOError(f"CSV parse failed for {path!r} (code {rc})")
+    return out
+
+
+def u8_to_f32(arr: np.ndarray, scale: float = 1.0 / 255.0,
+              nthreads: int = 0) -> np.ndarray:
+    """Normalize uint8 image data to float32 (threaded in C++)."""
+    arr = np.ascontiguousarray(arr, np.uint8)
+    lib = _load()
+    if lib is None:
+        return arr.astype(np.float32) * np.float32(scale)
+    out = np.empty(arr.shape, np.float32)
+    lib.dl4j_u8_to_f32(
+        arr.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        arr.size, scale, nthreads)
+    return out
+
+
+def gather_rows(arr: np.ndarray, indices: np.ndarray,
+                nthreads: int = 0) -> np.ndarray:
+    """out[i] = arr[indices[i]] — shuffled minibatch assembly."""
+    arr = np.ascontiguousarray(arr, np.float32)
+    idx = np.ascontiguousarray(indices, np.int64)
+    if idx.size and (idx.min() < 0 or idx.max() >= arr.shape[0]):
+        raise IndexError("gather index out of range")
+    lib = _load()
+    if lib is None:
+        return arr[idx]
+    row_elems = int(np.prod(arr.shape[1:])) if arr.ndim > 1 else 1
+    out = np.empty((idx.shape[0],) + arr.shape[1:], np.float32)
+    rc = lib.dl4j_gather_rows_f32(
+        arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        idx.shape[0], row_elems, nthreads)
+    if rc != 0:
+        raise IndexError("gather index out of range")
+    return out

@@ -229,9 +229,10 @@ class UIServer:
         storage at all an InMemoryStatsStorage is created, like the
         reference."""
         if storage is not None:
-            if storage in self._httpd.storages:
-                self._httpd.storages.remove(storage)
-            self._httpd.storages.insert(0, storage)
+            # atomic list swap: handler threads index storages[0] and must
+            # never observe a transiently-empty list
+            self._httpd.storages = [storage] + [
+                s for s in self._httpd.storages if s is not storage]
         elif not self._httpd.storages:
             from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
             self._httpd.storages.append(InMemoryStatsStorage())

@@ -1,0 +1,238 @@
+// dl4jtpu native IO runtime.
+//
+// TPU-native equivalent of the reference's native data path: DL4J consumes
+// libnd4j/JavaCPP native readers (SURVEY §2.1 — IDX readers
+// deeplearning4j-core datasets/mnist/, DataVec record readers, MagicQueue
+// device feeders). Here the host-side hot loops — binary dataset decode,
+// CSV parsing, u8→f32 normalization, batch row-gather — run in C++ with a
+// thread pool, releasing the Python GIL at the ctypes boundary so the input
+// pipeline overlaps with XLA compute (AsyncDataSetIterator's overlap goal,
+// SURVEY §5 "Async host input pipeline").
+//
+// Exposed as a flat C ABI for ctypes (no pybind11 in the image).
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---- thread helpers -------------------------------------------------------
+
+int clamp_threads(int nthreads, long work_items) {
+  unsigned hw = std::thread::hardware_concurrency();
+  if (hw == 0) hw = 4;
+  long n = nthreads > 0 ? nthreads : static_cast<long>(hw);
+  if (n > work_items) n = work_items;
+  if (n < 1) n = 1;
+  return static_cast<int>(n);
+}
+
+template <typename F>
+void parallel_for(long n, int nthreads, F&& fn) {
+  nthreads = clamp_threads(nthreads, n);
+  if (nthreads <= 1) {
+    fn(0L, n);
+    return;
+  }
+  std::vector<std::thread> pool;
+  long chunk = (n + nthreads - 1) / nthreads;
+  for (int t = 0; t < nthreads; ++t) {
+    long lo = t * chunk;
+    long hi = lo + chunk > n ? n : lo + chunk;
+    if (lo >= hi) break;
+    pool.emplace_back([lo, hi, &fn] { fn(lo, hi); });
+  }
+  for (auto& th : pool) th.join();
+}
+
+uint32_t be32(const unsigned char* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+long idx_elem_size(int dtype) {
+  switch (dtype) {
+    case 0x08: case 0x09: return 1;  // u8 / i8
+    case 0x0B: return 2;             // i16
+    case 0x0C: case 0x0D: return 4;  // i32 / f32
+    case 0x0E: return 8;             // f64
+    default: return -1;
+  }
+}
+
+struct FileCloser {
+  FILE* f;
+  ~FileCloser() { if (f) fclose(f); }
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---- IDX (MNIST-family) reader -------------------------------------------
+// format: magic [0,0,dtype,ndim], ndim big-endian u32 dims, big-endian data
+// (ref: deeplearning4j-core datasets/mnist/MnistDbFile + MnistImageFile)
+
+// Reads header. Returns 0 on success; fills ndim, dims[<=8], dtype code.
+int dl4j_idx_info(const char* path, int* ndim, long* dims, int* dtype) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  FileCloser fc{f};
+  unsigned char magic[4];
+  if (fread(magic, 1, 4, f) != 4) return -2;
+  if (magic[0] != 0 || magic[1] != 0) return -3;
+  *dtype = magic[2];
+  int nd = magic[3];
+  if (nd < 1 || nd > 8) return -4;
+  *ndim = nd;
+  for (int i = 0; i < nd; ++i) {
+    unsigned char b[4];
+    if (fread(b, 1, 4, f) != 4) return -5;
+    dims[i] = be32(b);
+  }
+  return idx_elem_size(*dtype) > 0 ? 0 : -6;
+}
+
+// Reads payload into out (caller sized via dl4j_idx_info), converting
+// big-endian to host for multi-byte types. Returns 0 on success.
+int dl4j_idx_read(const char* path, void* out, long out_bytes,
+                  int nthreads) {
+  int ndim, dtype;
+  long dims[8];
+  int rc = dl4j_idx_info(path, &ndim, dims, &dtype);
+  if (rc != 0) return rc;
+  long elems = 1;
+  for (int i = 0; i < ndim; ++i) elems *= dims[i];
+  long esize = idx_elem_size(dtype);
+  if (elems * esize != out_bytes) return -7;
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  FileCloser fc{f};
+  if (fseek(f, 4 + 4 * ndim, SEEK_SET) != 0) return -8;
+  if (fread(out, 1, static_cast<size_t>(out_bytes), f) !=
+      static_cast<size_t>(out_bytes))
+    return -9;
+  if (esize > 1) {  // byteswap big-endian -> little-endian host
+    unsigned char* p = static_cast<unsigned char*>(out);
+    parallel_for(elems, nthreads, [p, esize](long lo, long hi) {
+      for (long i = lo; i < hi; ++i) {
+        unsigned char* e = p + i * esize;
+        for (long a = 0, b = esize - 1; a < b; ++a, --b)
+          std::swap(e[a], e[b]);
+      }
+    });
+  }
+  return 0;
+}
+
+// ---- CSV numeric reader ---------------------------------------------------
+// (ref: DataVec CSVRecordReader consumed by RecordReaderDataSetIterator)
+
+// Counts data rows (non-empty lines minus optional header). -1 on error.
+long dl4j_csv_count_rows(const char* path, int skip_header) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  FileCloser fc{f};
+  long rows = 0;
+  bool in_line = false;
+  char buf[1 << 16];
+  size_t got;
+  while ((got = fread(buf, 1, sizeof buf, f)) > 0) {
+    for (size_t i = 0; i < got; ++i) {
+      if (buf[i] == '\n') {
+        if (in_line) ++rows;
+        in_line = false;
+      } else if (buf[i] != '\r') {
+        in_line = true;
+      }
+    }
+  }
+  if (in_line) ++rows;
+  return rows - (skip_header ? 1 : 0);
+}
+
+// Parses a numeric CSV into out[rows*cols] row-major f32. Threads split by
+// row ranges after an initial newline scan. Returns 0 on success.
+int dl4j_csv_read(const char* path, int skip_header, char delim,
+                  float* out, long rows, long cols, int nthreads) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  FileCloser fc{f};
+  fseek(f, 0, SEEK_END);
+  long fsize = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  std::vector<char> data(static_cast<size_t>(fsize) + 1);
+  if (fsize > 0 &&
+      fread(data.data(), 1, static_cast<size_t>(fsize), f) !=
+          static_cast<size_t>(fsize))
+    return -2;
+  data[static_cast<size_t>(fsize)] = '\0';
+
+  // index line starts
+  std::vector<long> starts;
+  starts.reserve(static_cast<size_t>(rows) + 2);
+  bool at_start = true;
+  for (long i = 0; i < fsize; ++i) {
+    if (at_start && data[static_cast<size_t>(i)] != '\n' &&
+        data[static_cast<size_t>(i)] != '\r') {
+      starts.push_back(i);
+      at_start = false;
+    }
+    if (data[static_cast<size_t>(i)] == '\n') at_start = true;
+  }
+  long first = skip_header ? 1 : 0;
+  if (static_cast<long>(starts.size()) - first < rows) return -3;
+
+  std::atomic<int> err{0};
+  parallel_for(rows, nthreads, [&](long lo, long hi) {
+    for (long r = lo; r < hi; ++r) {
+      const char* p = data.data() + starts[static_cast<size_t>(r + first)];
+      for (long c = 0; c < cols; ++c) {
+        char* end = nullptr;
+        float v = strtof(p, &end);
+        if (end == p) { err.store(-4); return; }
+        out[r * cols + c] = v;
+        p = end;
+        while (*p == delim || *p == ' ' || *p == '\t') ++p;
+      }
+    }
+  });
+  return err.load();
+}
+
+// ---- batch assembly kernels ----------------------------------------------
+// (ref: MagicQueue per-device feed + Nd4j scaled conversion)
+
+// u8 -> f32 with scale (e.g. 1/255 normalization), threaded.
+int dl4j_u8_to_f32(const unsigned char* in, float* out, long n,
+                   float scale, int nthreads) {
+  parallel_for(n, nthreads, [=](long lo, long hi) {
+    for (long i = lo; i < hi; ++i)
+      out[i] = static_cast<float>(in[i]) * scale;
+  });
+  return 0;
+}
+
+// Gather rows: out[i,:] = in[idx[i],:] — minibatch assembly after shuffle.
+int dl4j_gather_rows_f32(const float* in, const long* idx, float* out,
+                         long nrows_out, long row_elems, int nthreads) {
+  std::atomic<int> err{0};
+  parallel_for(nrows_out, nthreads, [&](long lo, long hi) {
+    for (long i = lo; i < hi; ++i) {
+      long src = idx[i];
+      if (src < 0) { err.store(-1); return; }
+      std::memcpy(out + i * row_elems, in + src * row_elems,
+                  static_cast<size_t>(row_elems) * sizeof(float));
+    }
+  });
+  return err.load();
+}
+
+int dl4j_native_version() { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,155 @@
+"""Dataset fetcher tests (ref: deeplearning4j-core datasets tests,
+MnistFetcherTest pattern — local IDX fixtures instead of downloads)."""
+
+import gzip
+import struct
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import (
+    CifarDataSetIterator, EmnistDataSetIterator, IrisDataSetIterator,
+    MnistDataSetIterator,
+)
+
+
+def write_idx(path, arr):
+    codes = {np.uint8: 0x08, np.int32: 0x0C}
+    with open(path, "wb") as f:
+        f.write(bytes([0, 0, codes[arr.dtype.type], arr.ndim]))
+        for d in arr.shape:
+            f.write(struct.pack(">I", d))
+        f.write(arr.astype(arr.dtype.newbyteorder(">")).tobytes())
+
+
+@pytest.fixture
+def mnist_dir(tmp_path):
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, (40, 28, 28), np.uint8)
+    labels = rng.integers(0, 10, 40).astype(np.uint8)
+    write_idx(str(tmp_path / "train-images-idx3-ubyte"), imgs)
+    write_idx(str(tmp_path / "train-labels-idx1-ubyte"), labels)
+    # test split stored gzipped to exercise the .gz path
+    t_imgs = rng.integers(0, 256, (10, 28, 28), np.uint8)
+    t_labels = rng.integers(0, 10, 10).astype(np.uint8)
+    write_idx(str(tmp_path / "_ti"), t_imgs)
+    write_idx(str(tmp_path / "_tl"), t_labels)
+    for src, dst in (("_ti", "t10k-images-idx3-ubyte.gz"),
+                     ("_tl", "t10k-labels-idx1-ubyte.gz")):
+        with open(tmp_path / src, "rb") as fin, \
+                gzip.open(tmp_path / dst, "wb") as fout:
+            fout.write(fin.read())
+    return str(tmp_path), imgs, labels
+
+
+class TestMnist:
+    def test_batches(self, mnist_dir):
+        d, imgs, labels = mnist_dir
+        it = MnistDataSetIterator(16, train=True, data_dir=d, shuffle=False)
+        batches = list(it)
+        assert [b.features.shape[0] for b in batches] == [16, 16, 8]
+        assert batches[0].features.shape == (16, 784)
+        assert batches[0].labels.shape == (16, 10)
+        np.testing.assert_allclose(
+            batches[0].features[0], imgs[0].reshape(-1) / 255.0, atol=1e-6)
+        assert batches[0].labels[0].argmax() == labels[0]
+        assert batches[0].features.min() >= 0 and batches[0].features.max() <= 1
+
+    def test_gz_decompression(self, mnist_dir):
+        d, _, _ = mnist_dir
+        it = MnistDataSetIterator(10, train=False, data_dir=d)
+        assert sum(b.features.shape[0] for b in it) == 10
+
+    def test_channels_shape(self, mnist_dir):
+        d, _, _ = mnist_dir
+        it = MnistDataSetIterator(8, train=True, data_dir=d, flatten=False)
+        b = next(iter(it))
+        assert b.features.shape == (8, 1, 28, 28)
+
+    def test_missing_files_error(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="zero-egress"):
+            MnistDataSetIterator(8, data_dir=str(tmp_path))
+
+    def test_synthetic(self):
+        it = MnistDataSetIterator(32, synthetic=True, num_examples=64)
+        b = next(iter(it))
+        assert b.features.shape == (32, 784)
+
+
+class TestEmnist:
+    def test_letters_split(self, tmp_path):
+        rng = np.random.default_rng(1)
+        imgs = rng.integers(0, 256, (20, 28, 28), np.uint8)
+        labels = (rng.integers(0, 26, 20) + 1).astype(np.uint8)  # 1-based
+        write_idx(str(tmp_path / "emnist-letters-train-images-idx3-ubyte"),
+                  imgs)
+        write_idx(str(tmp_path / "emnist-letters-train-labels-idx1-ubyte"),
+                  labels)
+        it = EmnistDataSetIterator(10, split="letters", train=True,
+                                   data_dir=str(tmp_path))
+        b = next(iter(it))
+        assert b.labels.shape[1] == 26  # 0-based one-hot after shift
+
+    def test_unknown_split(self):
+        with pytest.raises(ValueError, match="unknown EMNIST split"):
+            EmnistDataSetIterator(8, split="bogus")
+
+
+class TestCifar:
+    def test_binary_format(self, tmp_path):
+        rng = np.random.default_rng(2)
+        n = 12
+        recs = np.zeros((n, 3073), np.uint8)
+        recs[:, 0] = rng.integers(0, 10, n)
+        recs[:, 1:] = rng.integers(0, 256, (n, 3072))
+        for name in CifarDataSetIterator.TRAIN_FILES:
+            recs.tofile(str(tmp_path / name))
+        it = CifarDataSetIterator(8, train=True, data_dir=str(tmp_path),
+                                  seed=3)
+        total = 0
+        for b in it:
+            assert b.features.shape[1:] == (3, 32, 32)
+            assert b.features.max() <= 1.0
+            total += b.features.shape[0]
+        assert total == n * 5
+
+    def test_synthetic(self):
+        it = CifarDataSetIterator(16, synthetic=True, num_examples=32)
+        b = next(iter(it))
+        assert b.features.shape == (16, 3, 32, 32)
+
+
+class TestIris:
+    def test_csv_loading(self, tmp_path):
+        rng = np.random.default_rng(4)
+        rows = np.column_stack([rng.standard_normal((30, 4)),
+                                rng.integers(0, 3, 30)])
+        np.savetxt(str(tmp_path / "iris.csv"), rows, delimiter=",",
+                   fmt="%.5g")
+        it = IrisDataSetIterator(batch_size=30, num_examples=30,
+                                 data_dir=str(tmp_path))
+        b = next(iter(it))
+        assert b.features.shape == (30, 4)
+        assert b.labels.shape == (30, 3)
+        np.testing.assert_allclose(b.features, rows[:, :4], rtol=1e-4)
+
+    def test_fallback_trains(self):
+        # synthetic iris should be learnable by a small softmax net
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        it = IrisDataSetIterator(batch_size=150)  # full batch: file is
+        # ordered by class, and per-class minibatches destabilize SGD
+        conf = (NeuralNetConfiguration.Builder().seed(0).list()
+                .layer(DenseLayer(n_in=4, n_out=16, activation="tanh"))
+                .layer(OutputLayer(n_in=16, n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        net.fit(it, epochs=80)
+        b = next(iter(IrisDataSetIterator(batch_size=150)))
+        acc = (np.asarray(net.output(b.features)).argmax(1)
+               == b.labels.argmax(1)).mean()
+        assert acc > 0.85, f"iris accuracy {acc}"

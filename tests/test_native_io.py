@@ -1,0 +1,98 @@
+"""Native IO runtime tests: C++ path vs numpy fallback equivalence
+(the backend-vs-backend pattern of SURVEY §4: CuDNN-vs-builtin)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.native import io as nio
+from deeplearning4j_tpu.native import (
+    gather_rows, native_available, read_csv, read_idx, u8_to_f32,
+)
+
+
+def write_idx(path, arr):
+    """Write an IDX file (big-endian payload)."""
+    codes = {np.uint8: 0x08, np.int8: 0x09, np.int16: 0x0B,
+             np.int32: 0x0C, np.float32: 0x0D, np.float64: 0x0E}
+    code = codes[arr.dtype.type]
+    with open(path, "wb") as f:
+        f.write(bytes([0, 0, code, arr.ndim]))
+        for d in arr.shape:
+            f.write(struct.pack(">I", d))
+        f.write(arr.astype(arr.dtype.newbyteorder(">")).tobytes())
+
+
+def test_native_lib_builds():
+    assert native_available(), "C++ IO lib failed to build/load"
+
+
+@pytest.mark.parametrize("dtype,shape", [
+    (np.uint8, (10, 5, 5)),
+    (np.int32, (7, 3)),
+    (np.float32, (4, 6)),
+    (np.float64, (9,)),
+])
+def test_idx_roundtrip(tmp_path, dtype, shape):
+    rng = np.random.default_rng(0)
+    if np.issubdtype(dtype, np.integer):
+        arr = rng.integers(0, 100, shape).astype(dtype)
+    else:
+        arr = rng.standard_normal(shape).astype(dtype)
+    p = str(tmp_path / "data.idx")
+    write_idx(p, arr)
+    out = read_idx(p)
+    np.testing.assert_array_equal(out, arr)
+    # native and numpy fallback agree
+    np.testing.assert_array_equal(out, nio._read_idx_numpy(p))
+
+
+def test_idx_bad_magic(tmp_path):
+    p = str(tmp_path / "bad.idx")
+    with open(p, "wb") as f:
+        f.write(b"\x01\x02\x03\x04junk")
+    with pytest.raises(IOError):
+        read_idx(p)
+
+
+def test_csv_read(tmp_path):
+    rng = np.random.default_rng(1)
+    data = rng.standard_normal((50, 7)).astype(np.float32)
+    p = str(tmp_path / "data.csv")
+    np.savetxt(p, data, delimiter=",", fmt="%.6g",
+               header="a,b,c,d,e,f,g", comments="")
+    out = read_csv(p, skip_header=True)
+    assert out.shape == (50, 7)
+    np.testing.assert_allclose(out, data, rtol=1e-4, atol=1e-6)
+
+
+def test_csv_crlf_and_threads(tmp_path):
+    p = str(tmp_path / "crlf.csv")
+    with open(p, "wb") as f:
+        f.write(b"1.5,2.5\r\n3.5,4.5\r\n\r\n5.5,6.5\r\n")
+    out = read_csv(p, nthreads=4)
+    np.testing.assert_allclose(out, [[1.5, 2.5], [3.5, 4.5], [5.5, 6.5]])
+
+
+def test_u8_to_f32():
+    rng = np.random.default_rng(2)
+    arr = rng.integers(0, 256, (32, 28, 28), np.uint8)
+    out = u8_to_f32(arr)
+    assert out.dtype == np.float32 and out.shape == arr.shape
+    np.testing.assert_allclose(out, arr.astype(np.float32) / 255.0,
+                               rtol=1e-6)
+
+
+def test_gather_rows():
+    rng = np.random.default_rng(3)
+    arr = rng.standard_normal((100, 3, 4)).astype(np.float32)
+    idx = rng.permutation(100)[:17]
+    out = gather_rows(arr, idx, nthreads=3)
+    np.testing.assert_array_equal(out, arr[idx])
+
+
+def test_gather_rows_bounds():
+    arr = np.zeros((5, 2), np.float32)
+    with pytest.raises(IndexError):
+        gather_rows(arr, np.array([0, 9]))
