@@ -8,8 +8,8 @@ jit boundary so host-side pipelines stay numpy-fast.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Optional
+from dataclasses import dataclass, field
+from typing import List, Optional
 
 import numpy as np
 
@@ -51,3 +51,17 @@ class DataSet:
                 None if self.features_mask is None else self.features_mask[s:s + batch_size],
                 None if self.labels_mask is None else self.labels_mask[s:s + batch_size],
             )
+
+
+@dataclass
+class MultiDataSet:
+    """Multiple named-position inputs/outputs for ComputationGraph training
+    (ref: org.nd4j.linalg.dataset.MultiDataSet as consumed by
+    ComputationGraph.fit(MultiDataSetIterator))."""
+    features: List[np.ndarray] = field(default_factory=list)
+    labels: List[np.ndarray] = field(default_factory=list)
+    features_masks: Optional[List[Optional[np.ndarray]]] = None
+    labels_masks: Optional[List[Optional[np.ndarray]]] = None
+
+    def num_examples(self) -> int:
+        return int(self.features[0].shape[0]) if self.features else 0

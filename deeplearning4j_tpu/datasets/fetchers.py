@@ -92,15 +92,17 @@ class MnistDataSetIterator(ArrayDataSetIterator):
     def __init__(self, batch_size: int, train: bool = True,
                  data_dir: Optional[str] = None, shuffle: Optional[bool] = None,
                  seed: int = 123, synthetic: bool = False,
-                 num_examples: Optional[int] = None, flatten: bool = True):
+                 num_examples: Optional[int] = None, flatten: bool = True,
+                 _files: Optional[Tuple[str, str]] = None,
+                 _label_offset: int = 0):
         if synthetic:
             imgs, labels = _synthetic_images(
                 num_examples or (6000 if train else 1000), (28, 28),
                 self.NUM_CLASSES, seed)
         else:
-            img_f, lbl_f = MNIST_FILES[train]
+            img_f, lbl_f = _files or MNIST_FILES[train]
             imgs = read_idx(_resolve(data_dir, img_f))
-            labels = read_idx(_resolve(data_dir, lbl_f))
+            labels = read_idx(_resolve(data_dir, lbl_f)) - _label_offset
             if num_examples:
                 imgs, labels = imgs[:num_examples], labels[:num_examples]
         x = u8_to_f32(imgs)  # native threaded [0,1] scaling
@@ -131,24 +133,11 @@ class EmnistDataSetIterator(MnistDataSetIterator):
         part = "train" if train else "test"
         files = (f"emnist-{split}-{part}-images-idx3-ubyte",
                  f"emnist-{split}-{part}-labels-idx1-ubyte")
-        if synthetic:
-            super().__init__(batch_size, train=train, data_dir=data_dir,
-                             shuffle=shuffle, seed=seed, synthetic=True,
-                             num_examples=num_examples, flatten=flatten)
-            return
-        imgs = read_idx(_resolve(data_dir, files[0]))
-        labels = read_idx(_resolve(data_dir, files[1]))
-        if split == "letters":  # letters labels are 1-based
-            labels = labels - 1
-        if num_examples:
-            imgs, labels = imgs[:num_examples], labels[:num_examples]
-        x = u8_to_f32(imgs)
-        x = x.reshape(x.shape[0], -1) if flatten \
-            else x.reshape(x.shape[0], 1, *imgs.shape[1:])
-        y = _one_hot(labels, self.NUM_CLASSES)
-        ArrayDataSetIterator.__init__(
-            self, x, y, batch_size=batch_size,
-            shuffle=(train if shuffle is None else shuffle), seed=seed)
+        super().__init__(
+            batch_size, train=train, data_dir=data_dir, shuffle=shuffle,
+            seed=seed, synthetic=synthetic, num_examples=num_examples,
+            flatten=flatten, _files=files,
+            _label_offset=1 if split == "letters" else 0)  # letters: 1-based
 
 
 class CifarDataSetIterator(ArrayDataSetIterator):

@@ -64,6 +64,7 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_char_p, ctypes.c_int, ctypes.c_char,
             ctypes.POINTER(ctypes.c_float), ctypes.c_long, ctypes.c_long,
             ctypes.c_int]
+        lib.dl4j_native_version.restype = ctypes.c_int
         lib.dl4j_u8_to_f32.argtypes = [
             ctypes.POINTER(ctypes.c_ubyte), ctypes.POINTER(ctypes.c_float),
             ctypes.c_long, ctypes.c_float, ctypes.c_int]
@@ -119,26 +120,39 @@ def _read_idx_numpy(path: str) -> np.ndarray:
     return data.reshape(shape).astype(_IDX_HOST[dtype], copy=False)
 
 
-def read_csv(path: str, skip_header: bool = False, delimiter: str = ",",
+def read_csv(path: str, skip_header=False, delimiter: str = ",",
              nthreads: int = 0) -> np.ndarray:
-    """Parse a numeric CSV into a [rows, cols] float32 array."""
+    """Parse a numeric CSV into a [rows, cols] float32 array.
+
+    skip_header: bool (skip one line) or int (skip that many lines).
+    """
+    skip = int(skip_header)
     lib = _load()
     if lib is None:
         return np.loadtxt(path, delimiter=delimiter, dtype=np.float32,
-                          skiprows=1 if skip_header else 0, ndmin=2)
-    rows = lib.dl4j_csv_count_rows(path.encode(), int(skip_header))
+                          skiprows=skip, ndmin=2)
+    rows = lib.dl4j_csv_count_rows(path.encode(), skip)
     if rows < 0:
         raise IOError(f"cannot read {path!r}")
     if rows == 0:
         return np.empty((0, 0), np.float32)
+    cols = 0
     with open(path) as f:
-        if skip_header:
-            f.readline()
-        first = f.readline()
-    cols = len([t for t in first.replace(delimiter, " ").split() if t])
+        skipped = 0
+        for line in f:
+            if not line.strip():
+                continue  # row counter ignores blank lines; sniff must too
+            if skipped < skip:
+                skipped += 1
+                continue
+            cols = len([t for t in line.replace(delimiter, " ").split()
+                        if t])
+            break
+    if cols == 0:
+        return np.empty((0, 0), np.float32)
     out = np.empty((rows, cols), np.float32)
     rc = lib.dl4j_csv_read(
-        path.encode(), int(skip_header), delimiter.encode()[:1],
+        path.encode(), skip, delimiter.encode()[:1],
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), rows, cols,
         nthreads)
     if rc != 0:

@@ -133,8 +133,9 @@ int dl4j_idx_read(const char* path, void* out, long out_bytes,
 // ---- CSV numeric reader ---------------------------------------------------
 // (ref: DataVec CSVRecordReader consumed by RecordReaderDataSetIterator)
 
-// Counts data rows (non-empty lines minus optional header). -1 on error.
-long dl4j_csv_count_rows(const char* path, int skip_header) {
+// Counts data rows (non-empty lines minus skip_lines header rows).
+// -1 on error.
+long dl4j_csv_count_rows(const char* path, int skip_lines) {
   FILE* f = fopen(path, "rb");
   if (!f) return -1;
   FileCloser fc{f};
@@ -153,12 +154,15 @@ long dl4j_csv_count_rows(const char* path, int skip_header) {
     }
   }
   if (in_line) ++rows;
-  return rows - (skip_header ? 1 : 0);
+  rows -= skip_lines;
+  return rows < 0 ? 0 : rows;
 }
 
 // Parses a numeric CSV into out[rows*cols] row-major f32. Threads split by
-// row ranges after an initial newline scan. Returns 0 on success.
-int dl4j_csv_read(const char* path, int skip_header, char delim,
+// row ranges after an initial newline scan. A row with fewer than `cols`
+// fields is an error (-5) — values never bleed across lines. Returns 0 on
+// success.
+int dl4j_csv_read(const char* path, int skip_lines, char delim,
                   float* out, long rows, long cols, int nthreads) {
   FILE* f = fopen(path, "rb");
   if (!f) return -1;
@@ -185,20 +189,30 @@ int dl4j_csv_read(const char* path, int skip_header, char delim,
     }
     if (data[static_cast<size_t>(i)] == '\n') at_start = true;
   }
-  long first = skip_header ? 1 : 0;
+  long first = skip_lines;
   if (static_cast<long>(starts.size()) - first < rows) return -3;
 
   std::atomic<int> err{0};
   parallel_for(rows, nthreads, [&](long lo, long hi) {
     for (long r = lo; r < hi; ++r) {
-      const char* p = data.data() + starts[static_cast<size_t>(r + first)];
+      size_t si = static_cast<size_t>(r + first);
+      const char* p = data.data() + starts[si];
+      // values must come from this line only (strtof would otherwise skip
+      // the newline and pull fields from the next row)
+      const char* line_end = data.data() +
+          (si + 1 < starts.size() ? starts[si + 1] : fsize);
       for (long c = 0; c < cols; ++c) {
+        while (p < line_end && (*p == delim || *p == ' ' || *p == '\t'))
+          ++p;
+        if (p >= line_end || *p == '\n' || *p == '\r') {
+          err.store(-5);  // short row
+          return;
+        }
         char* end = nullptr;
         float v = strtof(p, &end);
-        if (end == p) { err.store(-4); return; }
+        if (end == p || end > line_end) { err.store(-4); return; }
         out[r * cols + c] = v;
         p = end;
-        while (*p == delim || *p == ' ' || *p == '\t') ++p;
       }
     }
   });

@@ -96,3 +96,27 @@ def test_gather_rows_bounds():
     arr = np.zeros((5, 2), np.float32)
     with pytest.raises(IndexError):
         gather_rows(arr, np.array([0, 9]))
+
+
+def test_csv_leading_blank_line(tmp_path):
+    p = str(tmp_path / "blank.csv")
+    with open(p, "wb") as f:
+        f.write(b"\n1,2\n3,4\n")
+    out = read_csv(p)
+    np.testing.assert_allclose(out, [[1, 2], [3, 4]])
+
+
+def test_csv_short_row_errors(tmp_path):
+    p = str(tmp_path / "ragged.csv")
+    with open(p, "wb") as f:
+        f.write(b"1,2,3\n4,5\n7,8,9\n")
+    with pytest.raises(IOError):
+        read_csv(p)
+
+
+def test_csv_skip_multiple_lines(tmp_path):
+    p = str(tmp_path / "hdr2.csv")
+    with open(p, "wb") as f:
+        f.write(b"header one\nheader two\n1,2\n3,4\n")
+    out = read_csv(p, skip_header=2)
+    np.testing.assert_allclose(out, [[1, 2], [3, 4]])

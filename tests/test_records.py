@@ -1,0 +1,155 @@
+"""RecordReader stack tests (ref: deeplearning4j-core
+datasets/datavec/RecordReaderDataSetiteratorTest,
+RecordReaderMultiDataSetIteratorTest patterns)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.records import (
+    AlignmentMode, CollectionRecordReader, CollectionSequenceRecordReader,
+    CSVRecordReader, CSVSequenceRecordReader, RecordReaderDataSetIterator,
+    RecordReaderMultiDataSetIterator, SequenceRecordReaderDataSetIterator,
+)
+
+
+@pytest.fixture
+def csv_file(tmp_path):
+    rng = np.random.default_rng(0)
+    data = np.column_stack([rng.standard_normal((20, 4)),
+                            rng.integers(0, 3, 20)])
+    p = str(tmp_path / "data.csv")
+    np.savetxt(p, data, delimiter=",", fmt="%.6g")
+    return p, data
+
+
+class TestRecordReaderDataSetIterator:
+    def test_classification(self, csv_file):
+        p, data = csv_file
+        it = RecordReaderDataSetIterator(CSVRecordReader(p), batch_size=8,
+                                         label_index=4, num_classes=3)
+        batches = list(it)
+        assert [b.features.shape[0] for b in batches] == [8, 8, 4]
+        assert batches[0].features.shape == (8, 4)
+        assert batches[0].labels.shape == (8, 3)
+        np.testing.assert_allclose(batches[0].features[0], data[0, :4],
+                                   rtol=1e-4)
+        assert batches[0].labels[0].argmax() == int(data[0, 4])
+
+    def test_regression_range(self):
+        rows = [[1, 2, 3, 4, 5], [6, 7, 8, 9, 10]]
+        it = RecordReaderDataSetIterator(
+            CollectionRecordReader(rows), batch_size=2, label_index=3,
+            label_index_to=4, regression=True)
+        b = next(iter(it))
+        np.testing.assert_allclose(b.features, [[1, 2, 3], [6, 7, 8]])
+        np.testing.assert_allclose(b.labels, [[4, 5], [9, 10]])
+
+    def test_label_mid_column(self):
+        rows = [[1, 9, 2], [3, 8, 4]]
+        it = RecordReaderDataSetIterator(
+            CollectionRecordReader(rows), batch_size=2, label_index=1,
+            regression=True)
+        b = next(iter(it))
+        np.testing.assert_allclose(b.features, [[1, 2], [3, 4]])
+        np.testing.assert_allclose(b.labels, [[9], [8]])
+
+    def test_unlabeled(self):
+        it = RecordReaderDataSetIterator(
+            CollectionRecordReader([[1, 2], [3, 4]]), batch_size=2)
+        b = next(iter(it))
+        assert b.labels is None
+
+    def test_needs_num_classes(self):
+        with pytest.raises(ValueError, match="num_classes"):
+            RecordReaderDataSetIterator(CollectionRecordReader([[1]]),
+                                        batch_size=1, label_index=0)
+
+
+class TestSequenceIterator:
+    def test_embedded_labels_and_masks(self):
+        # two sequences of different length; last column = class
+        s1 = np.array([[0.1, 0.2, 0], [0.3, 0.4, 1], [0.5, 0.6, 2]])
+        s2 = np.array([[1.0, 2.0, 1], [3.0, 4.0, 0]])
+        it = SequenceRecordReaderDataSetIterator(
+            CollectionSequenceRecordReader([s1, s2]), batch_size=2,
+            num_classes=3)
+        b = next(iter(it))
+        assert b.features.shape == (2, 2, 3)   # [N, C, T]
+        assert b.labels.shape == (2, 3, 3)
+        np.testing.assert_allclose(b.features_mask, [[1, 1, 1], [1, 1, 0]])
+        np.testing.assert_allclose(b.features[1, :, 0], [1.0, 2.0])
+        assert b.labels[0, :, 2].argmax() == 2
+        # padded slot is zero
+        np.testing.assert_allclose(b.features[1, :, 2], [0, 0])
+
+    def test_align_end(self):
+        s1 = np.array([[1.0, 0], [2.0, 1], [3.0, 0]])
+        s2 = np.array([[9.0, 1]])
+        it = SequenceRecordReaderDataSetIterator(
+            CollectionSequenceRecordReader([s1, s2]), batch_size=2,
+            num_classes=2, alignment=AlignmentMode.ALIGN_END)
+        b = next(iter(it))
+        np.testing.assert_allclose(b.features_mask, [[1, 1, 1], [0, 0, 1]])
+        np.testing.assert_allclose(b.features[1, 0], [0, 0, 9.0])
+
+    def test_separate_label_reader_csv(self, tmp_path):
+        fpaths, lpaths = [], []
+        rng = np.random.default_rng(1)
+        for i in range(3):
+            t = 4 + i
+            f = rng.standard_normal((t, 2))
+            l = rng.integers(0, 2, (t, 1))
+            fp, lp = str(tmp_path / f"f{i}.csv"), str(tmp_path / f"l{i}.csv")
+            np.savetxt(fp, f, delimiter=",", fmt="%.5g")
+            np.savetxt(lp, l, delimiter=",", fmt="%d")
+            fpaths.append(fp)
+            lpaths.append(lp)
+        it = SequenceRecordReaderDataSetIterator(
+            CSVSequenceRecordReader(fpaths), batch_size=3, num_classes=2,
+            label_reader=CSVSequenceRecordReader(lpaths))
+        b = next(iter(it))
+        assert b.features.shape == (3, 2, 6)
+        assert b.labels.shape == (3, 2, 6)
+        np.testing.assert_allclose(b.features_mask.sum(axis=1), [4, 5, 6])
+
+    def test_equal_length_enforced(self):
+        it = SequenceRecordReaderDataSetIterator(
+            CollectionSequenceRecordReader([np.zeros((3, 2))]),
+            batch_size=1, num_classes=2,
+            label_reader=CollectionSequenceRecordReader([np.zeros((2, 1))]),
+            alignment=AlignmentMode.EQUAL_LENGTH)
+        with pytest.raises(ValueError, match="EQUAL_LENGTH"):
+            next(iter(it))
+
+
+class TestMultiDataSetIterator:
+    def test_named_inputs_outputs(self, csv_file):
+        p, data = csv_file
+        it = (RecordReaderMultiDataSetIterator.Builder(batch_size=10)
+              .add_reader("csv", CSVRecordReader(p))
+              .add_input("csv", 0, 1)
+              .add_input("csv", 2, 3)
+              .add_output_one_hot("csv", 4, 3)
+              .build())
+        mds = next(iter(it))
+        assert len(mds.features) == 2 and len(mds.labels) == 1
+        assert mds.features[0].shape == (10, 2)
+        assert mds.features[1].shape == (10, 2)
+        assert mds.labels[0].shape == (10, 3)
+        np.testing.assert_allclose(mds.features[1][0], data[0, 2:4],
+                                   rtol=1e-4)
+
+    def test_regression_output_and_full_input(self):
+        rows = [[1, 2, 3], [4, 5, 6]]
+        it = (RecordReaderMultiDataSetIterator.Builder(batch_size=2)
+              .add_reader("r", CollectionRecordReader(rows))
+              .add_input("r")
+              .add_output("r", 2, 2)
+              .build())
+        mds = next(iter(it))
+        np.testing.assert_allclose(mds.features[0], rows)
+        np.testing.assert_allclose(mds.labels[0], [[3], [6]])
+
+    def test_no_readers(self):
+        with pytest.raises(ValueError, match="no readers"):
+            RecordReaderMultiDataSetIterator.Builder(2).build()
