@@ -1,0 +1,133 @@
+"""Nearest-neighbors REST server + client.
+
+Equivalent of deeplearning4j-nearestneighbors-parent nearestneighbor-server
+(283 LoC Play REST server over a VPTree), nearestneighbors-client, and the
+-model JSON DTOs (Base64NDArrayBody etc., SURVEY §2.10).
+
+The Play server becomes stdlib http.server; the VPTree index becomes the
+device brute-force kNN (clustering.knn.NearestNeighbors) — the TPU-idiomatic
+fast path. DTOs are plain JSON (points as number lists; the reference's
+base64-NDArray encoding existed for JVM interop and has no value here).
+
+Endpoints (mirroring the reference's routes):
+- POST /knn       {"index": i, "k": n}              → neighbors of a stored point
+- POST /knnnew    {"point": [...], "k": n}          → neighbors of a new point
+- GET  /status    → {"numPoints": N, "dim": D, "metric": "..."}
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.clustering.knn import NearestNeighbors
+
+log = logging.getLogger(__name__)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "dl4jtpu-knn/0.1"
+
+    def log_message(self, fmt, *args):
+        log.debug("knn: " + fmt, *args)
+
+    def _json(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        nn: NearestNeighbors = self.server.nn
+        if self.path.rstrip("/") == "/status":
+            return self._json({
+                "numPoints": int(nn.points.shape[0]),
+                "dim": int(nn.points.shape[1]),
+                "metric": nn.metric,
+            })
+        self._json({"error": "not found"}, 404)
+
+    def do_POST(self):
+        nn: NearestNeighbors = self.server.nn
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(n) or b"{}")
+            k = int(payload.get("k", 1))
+            if self.path.rstrip("/") == "/knn":
+                index = int(payload["index"])
+                n_pts = int(nn.points.shape[0])
+                if not 0 <= index < n_pts:  # jnp indexing would clamp OOB
+                    return self._json(
+                        {"error": f"index {index} outside [0, {n_pts})"},
+                        400)
+                idx, d = nn.query_point_index(index, k=k)
+            elif self.path.rstrip("/") == "/knnnew":
+                point = np.asarray(payload["point"], np.float32)
+                if point.ndim != 1 or point.shape[0] != nn.points.shape[1]:
+                    return self._json(
+                        {"error": f"point must have dim "
+                                  f"{int(nn.points.shape[1])}"}, 400)
+                ii, dd = nn.query(point, k=k)
+                idx, d = ii[0], dd[0]
+            else:
+                return self._json({"error": "not found"}, 404)
+        except (KeyError, TypeError, ValueError, IndexError) as e:
+            return self._json({"error": f"bad request: {e}"}, 400)
+        self._json({"results": [
+            {"index": int(i), "distance": float(x)}
+            for i, x in zip(idx, d)]})
+
+
+class NearestNeighborsServer:
+    """ref: nearestneighbor-server NearestNeighborsServer.java —
+    runs until stop(), serving kNN over the given points."""
+
+    def __init__(self, points, port: int = 9100,
+                 metric: str = "euclidean"):
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self._httpd.nn = NearestNeighbors(points, metric=metric)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        log.info("kNN server at http://127.0.0.1:%d", self.port)
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._thread.join(timeout=5)
+        self._httpd.server_close()
+
+
+class NearestNeighborsClient:
+    """ref: nearestneighbors-client NearestNeighborsClient.java."""
+
+    def __init__(self, url: str, timeout: float = 10.0):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+
+    def _post(self, route: str, payload: dict) -> dict:
+        req = urllib.request.Request(
+            self.url + route, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json.load(r)
+
+    def knn(self, index: int, k: int = 1) -> dict:
+        return self._post("/knn", {"index": index, "k": k})
+
+    def knn_new(self, point, k: int = 1) -> dict:
+        return self._post("/knnnew",
+                          {"point": np.asarray(point).tolist(), "k": k})
+
+    def status(self) -> dict:
+        with urllib.request.urlopen(self.url + "/status",
+                                    timeout=self.timeout) as r:
+            return json.load(r)

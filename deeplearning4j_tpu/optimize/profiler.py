@@ -1,0 +1,99 @@
+"""Profiling/tracing listeners.
+
+SURVEY §5 "Tracing/profiling": the reference profiles via listener timing
+(PerformanceListener ETL/iteration timing, BaseStatsListener sections) and
+ND4J's OpProfiler below the repo line. The TPU-native equivalents:
+
+- ProfilerListener: captures a JAX/XLA XPlane trace (viewable in
+  TensorBoard / xprof) for a window of training iterations —
+  jax.profiler.start_trace/stop_trace around the fit loop's hot section.
+- TimingListener: wall-clock section timing (ETL vs step) without any
+  trace overhead, mirroring PerformanceListener's lastEtlTime idea.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+
+log = logging.getLogger(__name__)
+
+
+class ProfilerListener(TrainingListener):
+    """Capture an XPlane trace for iterations [start_iteration,
+    start_iteration + num_iterations). Output dir is TensorBoard-loadable.
+    """
+
+    def __init__(self, log_dir: str, start_iteration: int = 2,
+                 num_iterations: int = 3):
+        self.log_dir = log_dir
+        self.start_iteration = start_iteration
+        self.num_iterations = num_iterations
+        self._active = False
+        self._done = False
+
+    def iteration_done(self, model, iteration: int, score: float):
+        if self._done:
+            return
+        if not self._active and iteration >= self.start_iteration:
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+            self._stop_at = iteration + self.num_iterations
+            return
+        if self._active and iteration >= self._stop_at:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+            log.info("profiler trace written to %s", self.log_dir)
+
+    def on_epoch_end(self, model, epoch: int):
+        # never leave a trace open across epochs
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+
+
+class TimingListener(TrainingListener):
+    """Wall-clock iteration timing with simple section accounting
+    (ref: PerformanceListener ETL-time measurement,
+    MultiLayerNetwork.java:1203-1209)."""
+
+    def __init__(self, window: int = 50):
+        self.window = window
+        self.iteration_ms: List[float] = []
+        self._last: Optional[float] = None
+
+    def iteration_done(self, model, iteration: int, score: float):
+        now = time.perf_counter()
+        if self._last is not None:
+            self.iteration_ms.append((now - self._last) * 1000.0)
+            if len(self.iteration_ms) > self.window:
+                self.iteration_ms.pop(0)
+        self._last = now
+
+    def summary(self) -> Dict[str, float]:
+        if not self.iteration_ms:
+            return {}
+        arr = sorted(self.iteration_ms)
+        n = len(arr)
+        return {
+            "mean_ms": sum(arr) / n,
+            "p50_ms": arr[n // 2],
+            "p95_ms": arr[min(n - 1, int(n * 0.95))],
+            "iterations": n,
+        }
+
+
+def annotate(name: str):
+    """Named trace span for host-side code (shows up in the XPlane trace):
+
+        with annotate("etl"):
+            batch = next(it)
+    """
+    return jax.profiler.TraceAnnotation(name)

@@ -140,15 +140,16 @@ long dl4j_csv_count_rows(const char* path, int skip_lines) {
   if (!f) return -1;
   FileCloser fc{f};
   long rows = 0;
-  bool in_line = false;
+  bool in_line = false;  // line has a non-whitespace char
   char buf[1 << 16];
   size_t got;
   while ((got = fread(buf, 1, sizeof buf, f)) > 0) {
     for (size_t i = 0; i < got; ++i) {
-      if (buf[i] == '\n') {
+      char c = buf[i];
+      if (c == '\n') {
         if (in_line) ++rows;
         in_line = false;
-      } else if (buf[i] != '\r') {
+      } else if (c != '\r' && c != ' ' && c != '\t') {
         in_line = true;
       }
     }
@@ -177,17 +178,19 @@ int dl4j_csv_read(const char* path, int skip_lines, char delim,
     return -2;
   data[static_cast<size_t>(fsize)] = '\0';
 
-  // index line starts
+  // index the first non-whitespace char of every non-blank line (blank =
+  // whitespace-only, matching dl4j_csv_count_rows and the Python sniff)
   std::vector<long> starts;
   starts.reserve(static_cast<size_t>(rows) + 2);
-  bool at_start = true;
+  bool line_recorded = false;
   for (long i = 0; i < fsize; ++i) {
-    if (at_start && data[static_cast<size_t>(i)] != '\n' &&
-        data[static_cast<size_t>(i)] != '\r') {
+    char c = data[static_cast<size_t>(i)];
+    if (c == '\n') {
+      line_recorded = false;
+    } else if (!line_recorded && c != '\r' && c != ' ' && c != '\t') {
       starts.push_back(i);
-      at_start = false;
+      line_recorded = true;
     }
-    if (data[static_cast<size_t>(i)] == '\n') at_start = true;
   }
   long first = skip_lines;
   if (static_cast<long>(starts.size()) - first < rows) return -3;

@@ -1,0 +1,201 @@
+"""Auxiliary-parity tests: EvaluationTools HTML export, memory reports,
+profiler listeners (SURVEY §2.2 memory, §2.4 EvaluationTools, §5 tracing)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.eval.evaluation import Evaluation
+from deeplearning4j_tpu.eval.roc import ROC
+from deeplearning4j_tpu.eval.tools import (
+    export_evaluation_to_html_file, export_roc_charts_to_html_file,
+)
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.memory import (
+    compiled_memory_analysis, get_memory_report,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Adam
+from deeplearning4j_tpu.optimize.profiler import (
+    ProfilerListener, TimingListener, annotate,
+)
+
+
+def small_net():
+    conf = (NeuralNetConfiguration.Builder().seed(0)
+            .updater(Adam(learning_rate=0.01)).list()
+            .layer(DenseLayer(n_in=5, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+def toy(n=30):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((n, 5)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+    return DataSet(x, y)
+
+
+class TestEvaluationTools:
+    def test_roc_export(self, tmp_path):
+        rng = np.random.default_rng(1)
+        labels = rng.integers(0, 2, 200)
+        scores = np.clip(labels * 0.6 + rng.random(200) * 0.5, 0, 1)
+        roc = ROC()
+        roc.eval(labels.astype(float), scores)
+        p = str(tmp_path / "roc.html")
+        export_roc_charts_to_html_file(p, roc)
+        html = open(p).read()
+        assert "<svg" in html and "AUC=" in html
+        auc = roc.calculate_auc()
+        assert f"{auc:.4f}" in html
+
+    def test_confusion_export(self, tmp_path):
+        ev = Evaluation(num_classes=3)
+        labels = np.eye(3)[[0, 1, 2, 0, 1, 2, 0]]
+        preds = np.eye(3)[[0, 1, 2, 0, 2, 2, 1]]
+        ev.eval(labels, preds)
+        p = str(tmp_path / "cm.html")
+        export_evaluation_to_html_file(p, ev, class_names=["a", "b", "c"])
+        html = open(p).read()
+        assert "accuracy" in html and "<table>" in html and ">a<" in html
+
+    def test_escapes_names(self, tmp_path):
+        ev = Evaluation(num_classes=2)
+        ev.eval(np.eye(2)[[0, 1]], np.eye(2)[[0, 1]])
+        p = str(tmp_path / "x.html")
+        export_evaluation_to_html_file(p, ev,
+                                       class_names=["<script>", "b"])
+        assert "<script>" not in open(p).read()
+
+
+class TestMemoryReport:
+    def test_report_counts_params(self):
+        net = small_net()
+        rep = get_memory_report(net, batch_size=16)
+        # dense 5*8+8 + output 8*2+2 = 66
+        assert rep.total_params == net.num_params() == 66
+        assert len(rep.layer_reports) == 2
+        assert rep.total_bytes(16) > rep.total_params * 4
+        s = rep.to_string(16)
+        assert "TOTAL" in s and "66" in s
+
+    def test_updater_multiplier(self):
+        net = small_net()  # Adam → 2x state
+        rep = get_memory_report(net)
+        assert rep.layer_reports[0].updater_state_size == \
+            2 * rep.layer_reports[0].num_params
+
+    def test_compiled_memory_analysis(self):
+        import jax
+        import jax.numpy as jnp
+        f = jax.jit(lambda x: (x @ x.T).sum())
+        out = compiled_memory_analysis(f, jnp.ones((64, 64)))
+        assert out is None or isinstance(out, dict)
+
+
+class TestProfiling:
+    def test_timing_listener(self):
+        net = small_net()
+        tl = TimingListener()
+        net.set_listeners(tl)
+        net.fit(toy(), epochs=5)
+        s = tl.summary()
+        assert s["iterations"] >= 3
+        assert s["mean_ms"] > 0 and s["p95_ms"] >= s["p50_ms"]
+
+    def test_profiler_listener_writes_trace(self, tmp_path):
+        net = small_net()
+        net.set_listeners(ProfilerListener(str(tmp_path), start_iteration=1,
+                                           num_iterations=2))
+        net.fit(toy(), epochs=6)
+        # trace dir should contain xplane artifacts
+        found = []
+        for root, _dirs, files in os.walk(str(tmp_path)):
+            found.extend(files)
+        assert any("xplane" in f or f.endswith(".trace.json.gz")
+                   for f in found), f"no trace files in {found}"
+
+    def test_annotate_context(self):
+        with annotate("etl"):
+            x = sum(range(100))
+        assert x == 4950
+
+
+class TestNode2Vec:
+    def test_biased_walks_prefer_backtrack_small_p(self):
+        from deeplearning4j_tpu.graph import Graph
+        from deeplearning4j_tpu.graph.node2vec import node2vec_walks
+        # path graph 0-1-2: from 1 after arriving from 0, small p biases
+        # back to 0, large q discourages going on to 2
+        g = Graph(3)
+        g.add_edge(0, 1)
+        g.add_edge(1, 2)
+        backs = ons = 0
+        walks = node2vec_walks(g, walk_length=2, walks_per_vertex=200,
+                               p=0.05, q=10.0, seed=0)
+        for w in walks:
+            if w[0] == 0 and w[1] == 1:
+                if w[2] == 0:
+                    backs += 1
+                elif w[2] == 2:
+                    ons += 1
+        assert backs > 5 * max(ons, 1), (backs, ons)
+
+    def test_embeddings_cluster(self):
+        from deeplearning4j_tpu.graph import Graph
+        from deeplearning4j_tpu.graph.node2vec import Node2Vec
+        n = 5
+        g = Graph(2 * n)
+        for base in (0, n):
+            for i in range(n):
+                for j in range(i + 1, n):
+                    g.add_edge(base + i, base + j)
+        g.add_edge(0, n)
+        nv = Node2Vec(p=1.0, q=0.5, vector_size=16, window_size=3,
+                      walk_length=10, walks_per_vertex=6, epochs=3,
+                      seed=4, learning_rate=0.05)
+        gv = nv.fit(g)
+        same = gv.similarity(1, 2)
+        cross = gv.similarity(1, n + 1)
+        assert same > cross
+
+
+class TestKnnServer:
+    def test_rest_roundtrip(self):
+        import numpy as np
+        from deeplearning4j_tpu.clustering.server import (
+            NearestNeighborsClient, NearestNeighborsServer)
+        rng = np.random.default_rng(0)
+        pts = rng.standard_normal((50, 8)).astype(np.float32)
+        srv = NearestNeighborsServer(pts, port=0)
+        try:
+            cli = NearestNeighborsClient(f"http://127.0.0.1:{srv.port}")
+            st = cli.status()
+            assert st == {"numPoints": 50, "dim": 8, "metric": "euclidean"}
+            res = cli.knn(index=3, k=4)["results"]
+            assert len(res) == 4 and all(r["index"] != 3 for r in res)
+            brute = np.argsort(np.linalg.norm(pts - pts[3], axis=1))[1:5]
+            assert [r["index"] for r in res] == brute.tolist()
+            res2 = cli.knn_new(pts[7] + 0.01, k=1)["results"]
+            assert res2[0]["index"] == 7
+            # malformed requests -> 400, not connection drop
+            import urllib.request, urllib.error, json as _json
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/knnnew",
+                data=_json.dumps({"point": [1.0]}).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req)
+                assert False, "expected 400"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        finally:
+            srv.stop()
