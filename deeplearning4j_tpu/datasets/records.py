@@ -15,6 +15,7 @@ adapters in deeplearning4j-core (SURVEY §2.4):
 
 from __future__ import annotations
 
+import itertools
 import os
 from enum import Enum
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
@@ -191,13 +192,13 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
                 lab = seq[:, -1:]
                 yield feat, self._encode(lab)
         else:
-            feats = list(self.reader.sequences())
-            labs = list(self.label_reader.sequences())
-            if len(feats) != len(labs):  # ref throws on count mismatch too
-                raise ValueError(
-                    f"feature reader has {len(feats)} sequences but label "
-                    f"reader has {len(labs)}")
-            for feat, lab in zip(feats, labs):
+            sentinel = object()  # ref throws on count mismatch; stay lazy
+            for feat, lab in itertools.zip_longest(
+                    self.reader.sequences(), self.label_reader.sequences(),
+                    fillvalue=sentinel):
+                if feat is sentinel or lab is sentinel:
+                    raise ValueError("feature and label readers yield "
+                                     "different sequence counts")
                 yield np.asarray(feat, np.float32), self._encode(lab)
 
     def _encode(self, lab: np.ndarray) -> np.ndarray:

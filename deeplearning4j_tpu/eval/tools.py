@@ -89,7 +89,8 @@ def export_roc_charts_to_html_file(path: str, rocs, titles=None) -> None:
     or a list (e.g. ROCMultiClass per-class curves)."""
     if not isinstance(rocs, (list, tuple)):
         rocs = [rocs]
-    titles = titles or [f"class {i}" for i in range(len(rocs))]
+    titles = list(titles) if titles else []
+    titles += [f"class {i}" for i in range(len(titles), len(rocs))]
     body = "".join(roc_chart_html(r, t) for r, t in zip(rocs, titles))
     _write(path, "ROC", body)
 

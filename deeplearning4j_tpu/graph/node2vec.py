@@ -23,36 +23,37 @@ def node2vec_walks(graph: Graph, walk_length: int, walks_per_vertex: int,
     """Second-order biased walks: transition weight from (prev → cur → nxt)
     scaled by 1/p if nxt == prev, 1 if nxt adjacent to prev, else 1/q."""
     rng = np.random.default_rng(seed)
-    nbrs = [graph.get_connected_vertex_weights(v)
-            for v in range(graph.num_vertices())]
-    nbr_sets = [set(x for x, _ in lst) for lst in nbrs]
+    # precompute per-vertex neighbor/weight arrays once — the walk loop
+    # must not rebuild them at every step
+    n_v = graph.num_vertices()
+    nbr_nodes, nbr_weights, nbr_sets = [], [], []
+    for v in range(n_v):
+        lst = graph.get_connected_vertex_weights(v)
+        nbr_nodes.append(np.array([x for x, _ in lst], np.int64))
+        nbr_weights.append(np.array([wt for _, wt in lst], np.float64))
+        nbr_sets.append(set(x for x, _ in lst))
     walks = []
     for _rep in range(walks_per_vertex):
-        for start in rng.permutation(graph.num_vertices()):
+        for start in rng.permutation(n_v):
             walk = [int(start)]
             while len(walk) < walk_length + 1:
                 cur = walk[-1]
-                cand = nbrs[cur]
-                if not cand:
+                nodes = nbr_nodes[cur]
+                if nodes.size == 0:
                     walk.append(cur)  # self-loop on disconnected
                     continue
-                if len(walk) == 1:
-                    nodes = np.array([x for x, _ in cand])
-                    w = np.array([wt for _, wt in cand], np.float64)
-                else:
+                w = nbr_weights[cur]
+                if len(walk) > 1:
                     prev = walk[-2]
-                    nodes = np.array([x for x, _ in cand])
-                    w = np.empty(len(cand), np.float64)
-                    for i, (nxt, wt) in enumerate(cand):
-                        if nxt == prev:
-                            w[i] = wt / p
-                        elif nxt in nbr_sets[prev]:
-                            w[i] = wt
-                        else:
-                            w[i] = wt / q
+                    prev_set = nbr_sets[prev]
+                    bias = np.array(
+                        [1.0 / p if nxt == prev
+                         else (1.0 if nxt in prev_set else 1.0 / q)
+                         for nxt in nodes], np.float64)
+                    w = w * bias
                 tot = w.sum()
                 if tot <= 0:
-                    walk.append(int(nodes[rng.integers(0, len(nodes))]))
+                    walk.append(int(nodes[rng.integers(0, nodes.size)]))
                 else:
                     walk.append(int(rng.choice(nodes, p=w / tot)))
             walks.append(walk)

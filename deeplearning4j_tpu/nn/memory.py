@@ -74,13 +74,30 @@ def get_memory_report(net, batch_size: int = 32) -> NetworkMemoryReport:
     counts from the live pytrees; activation sizes from a traced forward
     (jax.eval_shape — no allocation)."""
     import jax
-    import jax.numpy as jnp
 
     report = NetworkMemoryReport()
     upd_mult = _updater_state_multiplier(net)
     layers = net.conf.layers if hasattr(net.conf, "layers") else \
         list(net.conf.layer_confs.values())
-    for key, p in sorted(net.params.items(), key=lambda kv: str(kv[0])):
+    # true per-layer activation sizes via InputType shape inference when
+    # the config carries an input type (conv layers: channels*H*W, not
+    # just n_out)
+    act_elems: Dict[str, int] = {}
+    if hasattr(net.conf, "layers") and \
+            getattr(net.conf, "input_type", None) is not None:
+        it = net.conf.input_type
+        for i, lconf in enumerate(net.conf.layers):
+            try:
+                it = lconf.output_type(it)
+                act_elems[str(i)] = it.flat_size()
+            except Exception:  # noqa: BLE001 - keep estimating past gaps
+                break
+
+    def order(kv):  # numeric keys in numeric order, then named keys
+        k = str(kv[0])
+        return (0, int(k), "") if k.isdigit() else (1, 0, k)
+
+    for key, p in sorted(net.params.items(), key=order):
         n_params = sum(int(np.prod(x.shape))
                        for x in jax.tree_util.tree_leaves(p))
         try:
@@ -89,7 +106,7 @@ def get_memory_report(net, batch_size: int = 32) -> NetworkMemoryReport:
         except (ValueError, KeyError, IndexError):
             lconf = None
         ltype = type(lconf).__name__ if lconf is not None else "?"
-        act = _activation_elements(lconf)
+        act = act_elems.get(str(key), _activation_elements(lconf))
         report.layer_reports.append(LayerMemoryReport(
             layer_name=str(key), layer_type=ltype, num_params=n_params,
             updater_state_size=n_params * upd_mult,
@@ -107,11 +124,10 @@ def _updater_state_multiplier(net) -> int:
 
 
 def _activation_elements(lconf) -> int:
-    for attr in ("n_out",):
-        v = getattr(lconf, attr, None)
-        if v:
-            return int(v)
-    return 0
+    """Fallback when no InputType is available: n_out alone (exact for
+    dense/recurrent layers; conv layers need the InputType path above)."""
+    v = getattr(lconf, "n_out", None)
+    return int(v) if v else 0
 
 
 def compiled_memory_analysis(jitted_fn, *args) -> Optional[Dict]:

@@ -1,0 +1,85 @@
+"""Early stopping over mesh-parallel training.
+
+Equivalent of deeplearning4j-scaleout EarlyStoppingParallelTrainer.java:373
+(SURVEY §2.5): the early-stopping epoch loop driving a ParallelWrapper
+instead of single-device fit. On TPU the "parallel" part is the sharded
+train step; termination/scoring/saving semantics are identical to
+earlystopping.core.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from deeplearning4j_tpu.earlystopping.core import (
+    EarlyStoppingConfiguration, EarlyStoppingResult, EarlyStoppingTrainer,
+)
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+
+class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
+    """ref: EarlyStoppingParallelTrainer.java — wraps the model in a
+    ParallelWrapper; each early-stopping epoch trains data-parallel across
+    the mesh, then scoring/termination run on the (replicated) params."""
+
+    def __init__(self, config: EarlyStoppingConfiguration, model,
+                 train_iterator, mesh=None,
+                 training_mode: str = "allreduce",
+                 averaging_frequency: int = 5,
+                 prefetch_buffer: int = 2,
+                 wrapper: Optional[ParallelWrapper] = None):
+        super().__init__(config, model, train_iterator)
+        self.wrapper = wrapper or ParallelWrapper(
+            model, mesh=mesh, training_mode=training_mode,
+            averaging_frequency=averaging_frequency,
+            prefetch_buffer=prefetch_buffer)
+
+    def fit(self) -> EarlyStoppingResult:
+        cfg = self.config
+        for c in cfg.epoch_termination_conditions:
+            c.initialize()
+        for c in cfg.iteration_termination_conditions:
+            c.initialize()
+        best_score, best_epoch = None, -1
+        scores = {}
+        epoch = 0
+        reason, details = "MaxEpochs", ""
+        while True:
+            self.wrapper.fit(self.train_iterator, epochs=1)
+            s = self.model.score_value
+            aborted = False
+            for c in cfg.iteration_termination_conditions:
+                if c.terminate(self.model.iteration_count, s):
+                    reason = "IterationTerminationCondition"
+                    details = type(c).__name__
+                    aborted = True
+                    break
+            if aborted:
+                break
+            if cfg.score_calculator is not None and \
+                    epoch % cfg.evaluate_every_n_epochs == 0:
+                score = cfg.score_calculator.calculate_score(self.model)
+            else:
+                score = s
+            scores[epoch] = score
+            if best_score is None or score < best_score:
+                best_score, best_epoch = score, epoch
+                cfg.model_saver.save_best(self.model, score)
+            if cfg.save_last_model:
+                cfg.model_saver.save_latest(self.model, score)
+            term = False
+            for c in cfg.epoch_termination_conditions:
+                if c.terminate(epoch, score):
+                    reason = "EpochTerminationCondition"
+                    details = type(c).__name__
+                    term = True
+                    break
+            if term:
+                break
+            epoch += 1
+        return EarlyStoppingResult(
+            termination_reason=reason, termination_details=details,
+            total_epochs=epoch + 1, best_model_epoch=best_epoch,
+            best_model_score=(best_score if best_score is not None
+                              else float("nan")),
+            score_vs_epoch=scores, best_model=cfg.model_saver.get_best())

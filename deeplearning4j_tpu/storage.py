@@ -1,0 +1,142 @@
+"""Object-store dataset/model IO.
+
+Equivalent of deeplearning4j-aws (SURVEY §2.5): s3/uploader/S3Uploader.java,
+s3/reader/S3Downloader.java (dataset/checkpoint transfer) and — in role —
+the EC2 ClusterSetup provisioning (which on TPU is the platform's job:
+queued resources / GKE, not framework code; documented here, not mimicked).
+
+URLs select the backend: ``file://`` (or a bare path) works everywhere;
+``s3://`` needs boto3 and ``gs://`` needs google-cloud-storage — neither is
+baked into this image, so those imports are gated with a clear error.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import List
+from urllib.parse import urlparse
+
+
+def _backend(url: str):
+    scheme = urlparse(url).scheme
+    if scheme in ("", "file"):
+        return _FileBackend()
+    if scheme == "s3":
+        return _S3Backend()
+    if scheme == "gs":
+        return _GSBackend()
+    raise ValueError(f"unsupported storage scheme {scheme!r} in {url!r}")
+
+
+class _FileBackend:
+    @staticmethod
+    def _path(url: str) -> str:
+        p = urlparse(url)
+        return p.path if p.scheme else url
+
+    def upload(self, local: str, url: str):
+        dst = self._path(url)
+        os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+        shutil.copyfile(local, dst)
+
+    def download(self, url: str, local: str):
+        os.makedirs(os.path.dirname(local) or ".", exist_ok=True)
+        shutil.copyfile(self._path(url), local)
+
+    def list(self, url: str) -> List[str]:
+        base = self._path(url)
+        if not os.path.isdir(base):
+            return []
+        return sorted(os.path.join(base, f) for f in os.listdir(base))
+
+
+class _S3Backend:
+    def __init__(self):
+        try:
+            import boto3  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                "s3:// URLs need boto3, which is not installed in this "
+                "image; use file:// paths or install boto3") from e
+        import boto3
+        self._s3 = boto3.client("s3")
+
+    @staticmethod
+    def _split(url: str):
+        p = urlparse(url)
+        return p.netloc, p.path.lstrip("/")
+
+    def upload(self, local: str, url: str):
+        bucket, key = self._split(url)
+        self._s3.upload_file(local, bucket, key)
+
+    def download(self, url: str, local: str):
+        bucket, key = self._split(url)
+        os.makedirs(os.path.dirname(local) or ".", exist_ok=True)
+        self._s3.download_file(bucket, key, local)
+
+    def list(self, url: str) -> List[str]:
+        bucket, prefix = self._split(url)
+        resp = self._s3.list_objects_v2(Bucket=bucket, Prefix=prefix)
+        return [f"s3://{bucket}/{o['Key']}"
+                for o in resp.get("Contents", [])]
+
+
+class _GSBackend:
+    def __init__(self):
+        try:
+            from google.cloud import storage  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                "gs:// URLs need google-cloud-storage, which is not "
+                "installed in this image; use file:// paths") from e
+        from google.cloud import storage
+        self._client = storage.Client()
+
+    @staticmethod
+    def _split(url: str):
+        p = urlparse(url)
+        return p.netloc, p.path.lstrip("/")
+
+    def upload(self, local: str, url: str):
+        bucket, key = self._split(url)
+        self._client.bucket(bucket).blob(key).upload_from_filename(local)
+
+    def download(self, url: str, local: str):
+        bucket, key = self._split(url)
+        os.makedirs(os.path.dirname(local) or ".", exist_ok=True)
+        self._client.bucket(bucket).blob(key).download_to_filename(local)
+
+    def list(self, url: str) -> List[str]:
+        bucket, prefix = self._split(url)
+        return [f"gs://{bucket}/{b.name}"
+                for b in self._client.list_blobs(bucket, prefix=prefix)]
+
+
+class Uploader:
+    """ref: S3Uploader.java — push local files to object storage."""
+
+    def upload(self, local_path: str, url: str) -> None:
+        _backend(url).upload(local_path, url)
+
+    def upload_directory(self, local_dir: str, url_prefix: str) -> int:
+        n = 0
+        for root, _dirs, files in os.walk(local_dir):
+            for f in files:
+                local = os.path.join(root, f)
+                rel = os.path.relpath(local, local_dir)
+                self.upload(local, url_prefix.rstrip("/") + "/" + rel)
+                n += 1
+        return n
+
+
+class Downloader:
+    """ref: S3Downloader.java — fetch remote objects to local paths."""
+
+    def download(self, url: str, local_path: str) -> str:
+        _backend(url).download(url, local_path)
+        return local_path
+
+    def list(self, url_prefix: str) -> List[str]:
+        return _backend(url_prefix).list(url_prefix)

@@ -1,0 +1,162 @@
+"""Array streaming + model-serving routes.
+
+Equivalent of deeplearning4j-streaming (SURVEY §2.5): Kafka+Camel NDArray
+pub/sub (kafka/NDArrayKafkaClient.java) and the serving route
+(routes/DL4jServeRouteBuilder.java — consume arrays, run a model, publish
+predictions).
+
+Kafka/Camel are JVM infrastructure; the TPU-native equivalent keeps the
+same roles with stdlib primitives:
+- ArrayPublisher/ArraySubscriber: length-prefixed npz frames over TCP —
+  the pub/sub transport (works cross-process on one host or across hosts).
+- ServeRoute: subscribe → model.output → publish, the serving route.
+If a kafka client library is available it can be slotted in by implementing
+the same two-method transport interface; none is baked into this image.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import socket
+import socketserver
+import struct
+import threading
+from typing import Callable, List, Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_MAGIC = b"DL4J"
+
+
+def _pack(arrays: dict) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    payload = buf.getvalue()
+    return _MAGIC + struct.pack(">I", len(payload)) + payload
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    out = b""
+    while len(out) < n:
+        chunk = sock.recv(n - len(out))
+        if not chunk:
+            raise ConnectionError("stream closed mid-frame")
+        out += chunk
+    return out
+
+
+def _unpack_stream(sock: socket.socket) -> dict:
+    header = _read_exact(sock, 8)
+    if header[:4] != _MAGIC:
+        raise IOError("bad frame magic")
+    (length,) = struct.unpack(">I", header[4:])
+    payload = _read_exact(sock, length)
+    with np.load(io.BytesIO(payload)) as z:
+        return {k: z[k] for k in z.files}
+
+
+class ArrayHub:
+    """Broker: accepts subscriber connections and fans out published
+    frames (the Kafka-topic role). One hub ≈ one topic."""
+
+    def __init__(self, port: int = 0):
+        self._subs: List[socket.socket] = []
+        self._lock = threading.Lock()
+        hub = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                with hub._lock:
+                    hub._subs.append(self.request)
+                # hold the connection open until the hub closes it
+                try:
+                    while self.request.recv(1):
+                        pass
+                except OSError:
+                    pass
+
+        self._server = socketserver.ThreadingTCPServer(
+            ("127.0.0.1", port), _Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def publish(self, **arrays) -> int:
+        """Send a frame to all connected subscribers; returns how many
+        received it."""
+        frame = _pack(arrays)
+        sent = 0
+        with self._lock:
+            alive = []
+            for s in self._subs:
+                try:
+                    s.sendall(frame)
+                    alive.append(s)
+                    sent += 1
+                except OSError:
+                    s.close()
+            self._subs = alive
+        return sent
+
+    def close(self):
+        self._server.shutdown()
+        self._server.server_close()
+        with self._lock:
+            for s in self._subs:
+                s.close()
+            self._subs = []
+
+
+class ArraySubscriber:
+    """Blocking subscriber to an ArrayHub (NDArrayKafkaClient consume
+    role)."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1",
+                 timeout: Optional[float] = None):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+
+    def next(self) -> dict:
+        return _unpack_stream(self._sock)
+
+    def close(self):
+        self._sock.close()
+
+
+class ServeRoute:
+    """Model-serving route (ref: DL4jServeRouteBuilder): consume feature
+    frames from an input hub, run the model, publish prediction frames to
+    an output hub."""
+
+    def __init__(self, model_fn: Callable[[np.ndarray], np.ndarray],
+                 in_port: int, out_hub: "ArrayHub",
+                 feature_key: str = "features",
+                 prediction_key: str = "predictions"):
+        self.model_fn = model_fn
+        self.out_hub = out_hub
+        self.feature_key = feature_key
+        self.prediction_key = prediction_key
+        self._sub = ArraySubscriber(in_port)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                frame = self._sub.next()
+            except (ConnectionError, OSError):
+                break
+            preds = np.asarray(self.model_fn(frame[self.feature_key]))
+            out = dict(frame)
+            out[self.prediction_key] = preds
+            self.out_hub.publish(**out)
+
+    def stop(self):
+        self._stop.set()
+        self._sub.close()
+        self._thread.join(timeout=5)

@@ -199,3 +199,33 @@ class TestKnnServer:
                 assert e.code == 400
         finally:
             srv.stop()
+
+
+class TestMemoryReportShapes:
+    def test_conv_activation_sizes_use_input_type(self):
+        # CNN memory report must count channels*H*W, not just n_out
+        from deeplearning4j_tpu.zoo import LeNet
+        from deeplearning4j_tpu.nn.memory import get_memory_report
+        net = LeNet(num_classes=10).init()
+        rep = get_memory_report(net, batch_size=32)
+        conv_rows = [r for r in rep.layer_reports
+                     if "Convolution" in r.layer_type]
+        assert conv_rows, "no conv rows found"
+        # first LeNet conv: 20 channels on 28x28 -> far more than 20
+        assert conv_rows[0].activation_elements_per_example > 1000
+
+    def test_numeric_key_ordering(self):
+        from deeplearning4j_tpu.nn.memory import get_memory_report
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        b = NeuralNetConfiguration.Builder().seed(0).list()
+        for _ in range(11):
+            b = b.layer(DenseLayer(n_in=4, n_out=4, activation="relu"))
+        b = b.layer(OutputLayer(n_in=4, n_out=2, activation="softmax",
+                                loss="mcxent"))
+        net = MultiLayerNetwork(b.build())
+        net.init()
+        rep = get_memory_report(net)
+        names = [r.layer_name for r in rep.layer_reports]
+        assert names == [str(i) for i in range(12)]

@@ -1,0 +1,158 @@
+"""Scaleout auxiliary tests: EarlyStoppingParallelTrainer, CLI main,
+streaming pub/sub + serving route, object-store IO (SURVEY §2.5)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+from deeplearning4j_tpu.earlystopping.core import (
+    EarlyStoppingConfiguration, MaxEpochsTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Adam
+from deeplearning4j_tpu.parallel.early_stopping import (
+    EarlyStoppingParallelTrainer,
+)
+from deeplearning4j_tpu.storage import Downloader, Uploader
+from deeplearning4j_tpu.streaming import ArrayHub, ArraySubscriber, ServeRoute
+
+
+def small_net():
+    conf = (NeuralNetConfiguration.Builder().seed(0)
+            .updater(Adam(learning_rate=0.02)).list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+def toy_iter(n=64, batch=16):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((n, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+    return ArrayDataSetIterator(x, y, batch_size=batch)
+
+
+class TestEarlyStoppingParallel:
+    def test_trains_and_terminates(self):
+        net = small_net()
+        cfg = EarlyStoppingConfiguration(
+            epoch_termination_conditions=[
+                MaxEpochsTerminationCondition(6),
+                ScoreImprovementEpochTerminationCondition(3)],
+        )
+        trainer = EarlyStoppingParallelTrainer(cfg, net, toy_iter(),
+                                               prefetch_buffer=0)
+        res = trainer.fit()
+        assert res.total_epochs <= 7
+        assert res.best_model is not None
+        assert np.isfinite(res.best_model_score)
+        assert res.score_vs_epoch  # recorded every epoch
+
+
+class TestParallelWrapperMain:
+    def test_cli_end_to_end(self, tmp_path):
+        from deeplearning4j_tpu.parallel.main import main
+        from deeplearning4j_tpu.util import model_serializer
+
+        # save a model + CSV, then run the CLI
+        net = small_net()
+        model_in = str(tmp_path / "model.zip")
+        model_out = str(tmp_path / "trained.zip")
+        model_serializer.write_model(net, model_in)
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((60, 4))
+        y = (x.sum(1) > 0).astype(int)
+        csv = str(tmp_path / "train.csv")
+        np.savetxt(csv, np.column_stack([x, y]), delimiter=",", fmt="%.6g")
+
+        rc = main(["--model", model_in, "--data", csv,
+                   "--label-index", "4", "--num-classes", "2",
+                   "--batch-size", "16", "--epochs", "3",
+                   "--prefetch-buffer", "0", "--output", model_out])
+        assert rc == 0
+        assert os.path.exists(model_out)
+        restored = model_serializer.restore_model(model_out)
+        assert restored.iteration_count > 0
+
+    def test_parser_validates(self):
+        from deeplearning4j_tpu.parallel.main import build_parser
+        with pytest.raises(SystemExit):
+            build_parser().parse_args(["--model", "m.zip"])  # missing args
+
+
+class TestStreaming:
+    def test_pub_sub_roundtrip(self):
+        hub = ArrayHub()
+        try:
+            sub = ArraySubscriber(hub.port, timeout=5)
+            time.sleep(0.05)  # let the hub register the subscriber
+            x = np.arange(12, dtype=np.float32).reshape(3, 4)
+            assert hub.publish(features=x, step=np.int64(7)) == 1
+            frame = sub.next()
+            np.testing.assert_array_equal(frame["features"], x)
+            assert int(frame["step"]) == 7
+            sub.close()
+        finally:
+            hub.close()
+
+    def test_serve_route(self):
+        in_hub, out_hub = ArrayHub(), ArrayHub()
+        route = None
+        try:
+            out_sub = ArraySubscriber(out_hub.port, timeout=5)
+            time.sleep(0.05)
+            route = ServeRoute(lambda f: f @ np.ones((4, 2), np.float32),
+                               in_port=in_hub.port, out_hub=out_hub)
+            time.sleep(0.05)
+            x = np.ones((5, 4), np.float32)
+            assert in_hub.publish(features=x) == 1
+            frame = out_sub.next()
+            np.testing.assert_allclose(frame["predictions"],
+                                       np.full((5, 2), 4.0))
+            out_sub.close()
+        finally:
+            if route:
+                route.stop()
+            in_hub.close()
+            out_hub.close()
+
+
+class TestObjectStore:
+    def test_file_backend_roundtrip(self, tmp_path):
+        src = tmp_path / "a.bin"
+        src.write_bytes(b"hello")
+        up, down = Uploader(), Downloader()
+        url = f"file://{tmp_path}/store/a.bin"
+        up.upload(str(src), url)
+        out = str(tmp_path / "back.bin")
+        down.download(url, out)
+        assert open(out, "rb").read() == b"hello"
+        assert any("a.bin" in u
+                   for u in down.list(f"file://{tmp_path}/store"))
+
+    def test_upload_directory(self, tmp_path):
+        d = tmp_path / "data"
+        (d / "sub").mkdir(parents=True)
+        (d / "x.txt").write_text("1")
+        (d / "sub" / "y.txt").write_text("2")
+        n = Uploader().upload_directory(str(d), f"file://{tmp_path}/dst")
+        assert n == 2
+        assert (tmp_path / "dst" / "sub" / "y.txt").read_text() == "2"
+
+    def test_s3_requires_boto(self):
+        with pytest.raises((RuntimeError, Exception)):
+            Uploader().upload("/tmp/x", "s3://bucket/key")
+
+    def test_unknown_scheme(self):
+        with pytest.raises(ValueError, match="unsupported"):
+            Downloader().list("ftp://host/x")
