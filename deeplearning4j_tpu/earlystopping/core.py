@@ -230,6 +230,19 @@ class EarlyStoppingTrainer:
         self.model = model
         self.train_iterator = train_iterator
 
+    def _fit_epoch(self):
+        """Train one epoch with per-iteration termination checks. Returns
+        (aborted, condition_name) — subclasses override just this
+        (EarlyStoppingParallelTrainer trains across the mesh)."""
+        for ds in self.train_iterator:
+            self.model._fit_batch(ds) if hasattr(self.model, "_fit_batch") \
+                else self.model.fit(ds)
+            s = self.model.score_value
+            for c in self.config.iteration_termination_conditions:
+                if c.terminate(self.model.iteration_count, s):
+                    return True, type(c).__name__
+        return False, None
+
     def fit(self) -> EarlyStoppingResult:
         cfg = self.config
         for c in cfg.epoch_termination_conditions:
@@ -241,21 +254,10 @@ class EarlyStoppingTrainer:
         epoch = 0
         reason, details = "MaxEpochs", ""
         while True:
-            # one epoch of training with per-iteration checks
-            aborted = False
-            for ds in self.train_iterator:
-                self.model._fit_batch(ds) if hasattr(self.model, "_fit_batch") \
-                    else self.model.fit(ds)
-                s = self.model.score_value
-                for c in cfg.iteration_termination_conditions:
-                    if c.terminate(self.model.iteration_count, s):
-                        reason = "IterationTerminationCondition"
-                        details = type(c).__name__
-                        aborted = True
-                        break
-                if aborted:
-                    break
+            aborted, details_ = self._fit_epoch()
             if aborted:
+                reason = "IterationTerminationCondition"
+                details = details_
                 break
             # score on validation
             if cfg.score_calculator is not None and \

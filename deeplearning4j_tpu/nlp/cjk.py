@@ -1,0 +1,176 @@
+"""CJK tokenizers: Chinese, Japanese, Korean.
+
+Equivalent of the reference's language-specific tokenizer modules (SURVEY
+§2.6: deeplearning4j-nlp-chinese 9.5k (ansj), -japanese 6.8k (kuromoji
+fork), -korean 141 LoC). Those wrap large dictionary-driven morphological
+analyzers; this module provides dependency-free segmenters with the same
+TokenizerFactory SPI so CJK corpora flow through Word2Vec/ParagraphVectors:
+
+- Chinese: forward-maximum-match over a user dictionary when given one,
+  character (or character-bigram) segmentation otherwise — the standard
+  dictionary-free baseline for embeddings.
+- Japanese: character-class run segmentation (kanji / hiragana / katakana /
+  latin / digits), splitting at script boundaries — kuromoji-lite.
+- Korean: whitespace segmentation with optional particle (josa) stripping,
+  mirroring the reference's Korean module (which is itself 141 lines of
+  twitter-text wrapping).
+
+A real morphological analyzer (e.g. a mecab/kuromoji port) can be slotted
+in by subclassing TokenizerFactory — the SPI is the integration point.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Set
+
+from deeplearning4j_tpu.nlp.tokenization import Tokenizer, TokenizerFactory
+
+
+def _is_cjk(ch: str) -> bool:
+    return "一" <= ch <= "鿿" or "㐀" <= ch <= "䶿"
+
+
+def _is_hiragana(ch: str) -> bool:
+    return "぀" <= ch <= "ゟ"
+
+
+def _is_katakana(ch: str) -> bool:
+    return "゠" <= ch <= "ヿ" or ch == "ー"
+
+
+def _char_class(ch: str) -> str:
+    if _is_cjk(ch):
+        return "kanji"
+    if _is_hiragana(ch):
+        return "hiragana"
+    if _is_katakana(ch):
+        return "katakana"
+    if ch.isdigit():
+        return "digit"
+    if ch.isalpha():
+        return "latin"
+    if ch.isspace():
+        return "space"
+    return "other"
+
+
+class ChineseTokenizerFactory(TokenizerFactory):
+    """ref: deeplearning4j-nlp-chinese ChineseTokenizerFactory (ansj).
+
+    With a dictionary: greedy forward maximum match. Without: single
+    characters (``bigrams=True`` adds overlapping bigrams, a strong
+    baseline for embedding training).
+    """
+
+    def __init__(self, dictionary: Optional[Iterable[str]] = None,
+                 bigrams: bool = False, preprocessor=None):
+        super().__init__(preprocessor)
+        self.dictionary: Set[str] = set(dictionary or ())
+        self.max_word = max((len(w) for w in self.dictionary), default=1)
+        self.bigrams = bigrams
+
+    def create(self, text: str) -> Tokenizer:
+        tokens: List[str] = []
+        for run, cls in _runs(text):
+            if cls != "han":
+                tokens.extend(run.split())
+                continue
+            if self.dictionary:
+                tokens.extend(self._max_match(run))
+            else:
+                tokens.extend(run)
+                if self.bigrams:
+                    tokens.extend(run[i:i + 2]
+                                  for i in range(len(run) - 1))
+        return Tokenizer(tokens, self._pre)
+
+    def _max_match(self, run: str) -> List[str]:
+        out, i = [], 0
+        while i < len(run):
+            for ln in range(min(self.max_word, len(run) - i), 1, -1):
+                if run[i:i + ln] in self.dictionary:
+                    out.append(run[i:i + ln])
+                    i += ln
+                    break
+            else:
+                out.append(run[i])
+                i += 1
+        return out
+
+
+def _runs(text: str):
+    """Split text into (run, 'han'|'other') spans."""
+    out = []
+    cur, cur_han = "", None
+    for ch in text:
+        han = _is_cjk(ch)
+        if cur_han is None or han == cur_han:
+            cur += ch
+        else:
+            out.append((cur, "han" if cur_han else "other"))
+            cur = ch
+        cur_han = han
+    if cur:
+        out.append((cur, "han" if cur_han else "other"))
+    return out
+
+
+class JapaneseTokenizerFactory(TokenizerFactory):
+    """ref: deeplearning4j-nlp-japanese (kuromoji fork). Segments at
+    character-class boundaries: kanji runs, hiragana runs, katakana runs,
+    latin words, digit runs."""
+
+    def __init__(self, preprocessor=None, split_kanji_chars: bool = False):
+        super().__init__(preprocessor)
+        self.split_kanji_chars = split_kanji_chars
+
+    def create(self, text: str) -> Tokenizer:
+        tokens: List[str] = []
+        cur, cur_cls = "", None
+        for ch in text:
+            cls = _char_class(ch)
+            if cls == "space" or cls == "other":
+                if cur:
+                    tokens.append(cur)
+                    cur, cur_cls = "", None
+                continue
+            if cur_cls is None or cls == cur_cls:
+                cur += ch
+                cur_cls = cls
+            else:
+                tokens.append(cur)
+                cur, cur_cls = ch, cls
+        if cur:
+            tokens.append(cur)
+        if self.split_kanji_chars:
+            tokens = [c for t in tokens
+                      for c in (t if all(map(_is_cjk, t)) else [t])]
+        return Tokenizer(tokens, self._pre)
+
+
+# common single-syllable josa (particles) stripped from token ends
+_KOREAN_JOSA = ("은", "는", "이", "가", "을", "를", "에", "의", "로", "와",
+                "과", "도", "만", "에서", "으로", "까지", "부터", "하고")
+
+
+class KoreanTokenizerFactory(TokenizerFactory):
+    """ref: deeplearning4j-nlp-korean KoreanTokenizerFactory. Whitespace
+    tokens with optional trailing-particle stripping."""
+
+    def __init__(self, strip_josa: bool = True, preprocessor=None):
+        super().__init__(preprocessor)
+        self.strip_josa = strip_josa
+
+    def create(self, text: str) -> Tokenizer:
+        tokens = []
+        for tok in text.split():
+            tok = tok.strip("。，.,!?“”\"'()[]")
+            if not tok:
+                continue
+            if self.strip_josa and len(tok) > 1:
+                for josa in sorted(_KOREAN_JOSA, key=len, reverse=True):
+                    if tok.endswith(josa) and len(tok) > len(josa):
+                        tok = tok[:-len(josa)]
+                        break
+            tokens.append(tok)
+        return Tokenizer(tokens, self._pre)

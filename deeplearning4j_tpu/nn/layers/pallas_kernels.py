@@ -1,0 +1,161 @@
+"""Pallas TPU kernels: fused LSTM recurrence.
+
+The reference accelerates LSTM through cuDNN's fused RNN path
+(CudnnLSTMHelper.java:588 cudnnRNNForwardTraining — SURVEY §2.1), loaded as
+an optional helper behind the composed implementation. This module is the
+TPU analog: a Pallas kernel for the recurrent half of the LSTM that keeps
+the [H,4H] recurrent weights and the (h, c) carry resident in VMEM across
+ALL timesteps (grid iterations on TPU run sequentially on one core, so VMEM
+scratch persists), instead of the scan-based path where each iteration
+re-reads weights from HBM.
+
+Like the reference's helper hook (ConvolutionLayer.java:74-84 reflective
+load), the kernel is optional: `lstm_recurrence` falls back to lax.scan
+when shapes/dtypes don't meet the TPU tiling constraints (H % 128, N % 8)
+or when running on CPU (where it uses the Pallas interpreter only under
+test). Parity with the scan path is covered by tests mirroring
+ValidateCudnnLSTM.java (SURVEY §4 backend-vs-backend pattern).
+
+Gate order matches nn/layers/recurrent.py: (i, f, c, o).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _lstm_kernel(zx_ref, rw_ref, h0_ref, c0_ref,
+                 out_ref, hT_ref, cT_ref, h_scr, c_scr, *, t_total: int):
+    """One grid step = one timestep. zx_ref: [N,4H] (input projection +
+    bias, precomputed), rw_ref: [H,4H] resident across steps, scratch
+    carries (h, c) in fp32."""
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        h_scr[:] = h0_ref[:].astype(jnp.float32)
+        c_scr[:] = c0_ref[:].astype(jnp.float32)
+
+    h_prev = h_scr[:]
+    gates = zx_ref[0].astype(jnp.float32) + \
+        jax.lax.dot(h_prev, rw_ref[:].astype(jnp.float32),
+                    preferred_element_type=jnp.float32)
+    hdim = h_prev.shape[-1]
+    i = jax.nn.sigmoid(gates[:, 0 * hdim:1 * hdim])
+    f = jax.nn.sigmoid(gates[:, 1 * hdim:2 * hdim])
+    g = jnp.tanh(gates[:, 2 * hdim:3 * hdim])
+    o = jax.nn.sigmoid(gates[:, 3 * hdim:4 * hdim])
+    c = f * c_scr[:] + i * g
+    h = o * jnp.tanh(c)
+    h_scr[:] = h
+    c_scr[:] = c
+    out_ref[0] = h.astype(out_ref.dtype)
+
+    @pl.when(t == t_total - 1)
+    def _final():
+        hT_ref[:] = h.astype(hT_ref.dtype)
+        cT_ref[:] = c.astype(cT_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pallas_lstm_recurrence(zx: jax.Array, rw: jax.Array, h0: jax.Array,
+                           c0: jax.Array, interpret: bool = False
+                           ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused LSTM recurrence.
+
+    zx: [T, N, 4H] input projections (x@W + b for every step, computed as
+        one big MXU matmul outside), rw: [H, 4H], h0/c0: [N, H].
+    Returns (out [T, N, H], hT [N, H], cT [N, H]).
+    """
+    t, n, four_h = zx.shape
+    h = four_h // 4
+    kernel = functools.partial(_lstm_kernel, t_total=t)
+    out, hT, cT = pl.pallas_call(
+        kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, n, four_h), lambda i: (i, 0, 0)),   # zx step i
+            pl.BlockSpec((h, four_h), lambda i: (0, 0)),         # rw resident
+            pl.BlockSpec((n, h), lambda i: (0, 0)),              # h0
+            pl.BlockSpec((n, h), lambda i: (0, 0)),              # c0
+        ],
+        out_specs=[
+            pl.BlockSpec((1, n, h), lambda i: (i, 0, 0)),        # out step i
+            pl.BlockSpec((n, h), lambda i: (0, 0)),              # hT
+            pl.BlockSpec((n, h), lambda i: (0, 0)),              # cT
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, n, h), zx.dtype),
+            jax.ShapeDtypeStruct((n, h), zx.dtype),
+            jax.ShapeDtypeStruct((n, h), zx.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((n, h), jnp.float32),   # h carry
+            pltpu.VMEM((n, h), jnp.float32),   # c carry
+        ],
+        interpret=interpret,
+    )(zx, rw, h0, c0)
+    return out, hT, cT
+
+
+def pallas_lstm_supported(n: int, h: int, *, peephole, mask, gate_act: str,
+                          cell_act: str) -> bool:
+    """Static eligibility: standard gates, no peephole/mask, tile-friendly
+    shapes (TPU tiling: lanes of 128, sublanes of 8)."""
+    if peephole is not None or mask is not None:
+        return False
+    if gate_act != "sigmoid" or cell_act != "tanh":
+        return False
+    if h % 128 != 0 or n % 8 != 0:
+        return False
+    return True
+
+
+def _scan_recurrence(zx, rw, h0, c0):
+    """Pure-JAX recurrence with identical math — the AD path and the
+    non-TPU fallback."""
+    hdim = rw.shape[0]
+
+    def step(carry, z):
+        h_prev, c_prev = carry
+        g = z + h_prev @ rw
+        i = jax.nn.sigmoid(g[:, :hdim])
+        f = jax.nn.sigmoid(g[:, hdim:2 * hdim])
+        cc = jnp.tanh(g[:, 2 * hdim:3 * hdim])
+        o = jax.nn.sigmoid(g[:, 3 * hdim:])
+        c = f * c_prev + i * cc
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+    (hT, cT), outs = jax.lax.scan(step, (h0, c0), zx)
+    return outs, hT, cT
+
+
+@jax.custom_vjp
+def lstm_recurrence(zx, rw, h0, c0):
+    """Fused LSTM recurrence with autodiff support: forward runs the
+    Pallas kernel on TPU (scan elsewhere); backward recomputes through the
+    scan implementation (Pallas grid-carried VMEM scratch has no
+    reverse-mode rule — custom_vjp hides the kernel from AD)."""
+    if jax.default_backend() == "tpu":
+        return pallas_lstm_recurrence(zx, rw, h0, c0)
+    return _scan_recurrence(zx, rw, h0, c0)
+
+
+def _lstm_fwd(zx, rw, h0, c0):
+    return lstm_recurrence(zx, rw, h0, c0), (zx, rw, h0, c0)
+
+
+def _lstm_bwd(res, grads):
+    zx, rw, h0, c0 = res
+    _, vjp = jax.vjp(_scan_recurrence, zx, rw, h0, c0)
+    return vjp(grads)
+
+
+lstm_recurrence.defvjp(_lstm_fwd, _lstm_bwd)

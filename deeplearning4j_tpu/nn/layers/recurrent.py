@@ -43,6 +43,7 @@ def lstm_scan(
     gate_act: str = "sigmoid",
     cell_act: str = "tanh",
     reverse: bool = False,
+    use_pallas: bool = True,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Run an LSTM over the full sequence. Returns (out [N,H,T], hT, cT)."""
     n, _, t = x.shape
@@ -59,6 +60,20 @@ def lstm_scan(
     xt = jnp.transpose(x, (2, 0, 1))  # [T, N, C]
     zx = xt.reshape(t * n, -1) @ w
     zx = zx.reshape(t, n, 4 * h) + b
+
+    # optional fused Pallas recurrence (cuDNN-fused-LSTM analog): keeps rw
+    # and the (h,c) carry in VMEM across timesteps on TPU; gradients flow
+    # through a custom_vjp that recomputes via scan. Same math — parity
+    # tested against the scan path below.
+    from deeplearning4j_tpu.nn.layers import pallas_kernels as _pk
+    if use_pallas and _pk.pallas_lstm_supported(
+            n, h, peephole=peephole, mask=mask, gate_act=gate_act,
+            cell_act=cell_act):
+        zxk = zx[::-1] if reverse else zx
+        outs, h_fin, c_fin = _pk.lstm_recurrence(zxk, rw, h0, c0)
+        if reverse:
+            outs = outs[::-1]
+        return jnp.transpose(outs, (1, 2, 0)), h_fin, c_fin
 
     if mask is not None:
         mt = jnp.transpose(mask, (1, 0))[:, :, None].astype(x.dtype)  # [T, N, 1]

@@ -2,9 +2,8 @@
 
 Equivalent of deeplearning4j-scaleout EarlyStoppingParallelTrainer.java:373
 (SURVEY §2.5): the early-stopping epoch loop driving a ParallelWrapper
-instead of single-device fit. On TPU the "parallel" part is the sharded
-train step; termination/scoring/saving semantics are identical to
-earlystopping.core.
+instead of single-device fit. Only the train-one-epoch step differs —
+termination/scoring/saving live in earlystopping.core.
 """
 
 from __future__ import annotations
@@ -12,7 +11,7 @@ from __future__ import annotations
 from typing import Optional
 
 from deeplearning4j_tpu.earlystopping.core import (
-    EarlyStoppingConfiguration, EarlyStoppingResult, EarlyStoppingTrainer,
+    EarlyStoppingConfiguration, EarlyStoppingTrainer,
 )
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
 
@@ -20,7 +19,8 @@ from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
 class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
     """ref: EarlyStoppingParallelTrainer.java — wraps the model in a
     ParallelWrapper; each early-stopping epoch trains data-parallel across
-    the mesh, then scoring/termination run on the (replicated) params."""
+    the mesh. Iteration termination conditions are checked once per epoch
+    (the sharded step doesn't surface per-batch host callbacks)."""
 
     def __init__(self, config: EarlyStoppingConfiguration, model,
                  train_iterator, mesh=None,
@@ -34,52 +34,10 @@ class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
             averaging_frequency=averaging_frequency,
             prefetch_buffer=prefetch_buffer)
 
-    def fit(self) -> EarlyStoppingResult:
-        cfg = self.config
-        for c in cfg.epoch_termination_conditions:
-            c.initialize()
-        for c in cfg.iteration_termination_conditions:
-            c.initialize()
-        best_score, best_epoch = None, -1
-        scores = {}
-        epoch = 0
-        reason, details = "MaxEpochs", ""
-        while True:
-            self.wrapper.fit(self.train_iterator, epochs=1)
-            s = self.model.score_value
-            aborted = False
-            for c in cfg.iteration_termination_conditions:
-                if c.terminate(self.model.iteration_count, s):
-                    reason = "IterationTerminationCondition"
-                    details = type(c).__name__
-                    aborted = True
-                    break
-            if aborted:
-                break
-            if cfg.score_calculator is not None and \
-                    epoch % cfg.evaluate_every_n_epochs == 0:
-                score = cfg.score_calculator.calculate_score(self.model)
-            else:
-                score = s
-            scores[epoch] = score
-            if best_score is None or score < best_score:
-                best_score, best_epoch = score, epoch
-                cfg.model_saver.save_best(self.model, score)
-            if cfg.save_last_model:
-                cfg.model_saver.save_latest(self.model, score)
-            term = False
-            for c in cfg.epoch_termination_conditions:
-                if c.terminate(epoch, score):
-                    reason = "EpochTerminationCondition"
-                    details = type(c).__name__
-                    term = True
-                    break
-            if term:
-                break
-            epoch += 1
-        return EarlyStoppingResult(
-            termination_reason=reason, termination_details=details,
-            total_epochs=epoch + 1, best_model_epoch=best_epoch,
-            best_model_score=(best_score if best_score is not None
-                              else float("nan")),
-            score_vs_epoch=scores, best_model=cfg.model_saver.get_best())
+    def _fit_epoch(self):
+        self.wrapper.fit(self.train_iterator, epochs=1)
+        s = self.model.score_value
+        for c in self.config.iteration_termination_conditions:
+            if c.terminate(self.model.iteration_count, s):
+                return True, type(c).__name__
+        return False, None

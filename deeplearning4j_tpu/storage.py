@@ -18,15 +18,25 @@ from typing import List
 from urllib.parse import urlparse
 
 
+_BACKEND_CACHE = {}
+
+
 def _backend(url: str):
+    """Backend per scheme, cached — client construction (boto3/GCS auth)
+    must not repeat per object."""
     scheme = urlparse(url).scheme
+    if scheme in _BACKEND_CACHE:
+        return _BACKEND_CACHE[scheme]
     if scheme in ("", "file"):
-        return _FileBackend()
-    if scheme == "s3":
-        return _S3Backend()
-    if scheme == "gs":
-        return _GSBackend()
-    raise ValueError(f"unsupported storage scheme {scheme!r} in {url!r}")
+        b = _FileBackend()
+    elif scheme == "s3":
+        b = _S3Backend()
+    elif scheme == "gs":
+        b = _GSBackend()
+    else:
+        raise ValueError(f"unsupported storage scheme {scheme!r} in {url!r}")
+    _BACKEND_CACHE[scheme] = b
+    return b
 
 
 class _FileBackend:

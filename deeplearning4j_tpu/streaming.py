@@ -62,9 +62,10 @@ class ArrayHub:
     """Broker: accepts subscriber connections and fans out published
     frames (the Kafka-topic role). One hub ≈ one topic."""
 
-    def __init__(self, port: int = 0):
+    def __init__(self, port: int = 0, send_timeout: float = 5.0):
         self._subs: List[socket.socket] = []
         self._lock = threading.Lock()
+        self.send_timeout = send_timeout
         hub = self
 
         class _Handler(socketserver.BaseRequestHandler):
@@ -88,19 +89,26 @@ class ArrayHub:
 
     def publish(self, **arrays) -> int:
         """Send a frame to all connected subscribers; returns how many
-        received it."""
+        received it. Sends happen OUTSIDE the lock with a timeout so one
+        stalled subscriber can't wedge the hub; timed-out/dead subscribers
+        are dropped."""
         frame = _pack(arrays)
-        sent = 0
         with self._lock:
-            alive = []
-            for s in self._subs:
-                try:
-                    s.sendall(frame)
-                    alive.append(s)
-                    sent += 1
-                except OSError:
+            targets = list(self._subs)
+        sent, dead = 0, []
+        for s in targets:
+            try:
+                s.settimeout(self.send_timeout)
+                s.sendall(frame)
+                sent += 1
+            except OSError:
+                dead.append(s)
+        if dead:
+            with self._lock:
+                for s in dead:
+                    if s in self._subs:
+                        self._subs.remove(s)
                     s.close()
-            self._subs = alive
         return sent
 
     def close(self):

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Test entry point (ref: the reference repo's runtests.sh — mvn clean test,
+# then a second matrix leg). Here: the full pytest suite on the virtual
+# 8-device CPU mesh, then the driver entry points compile-checked.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+python -m pytest tests/ -q "$@"
+
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+python - <<'EOF'
+import __graft_entry__ as ge
+ge.dryrun_multichip(8)
+import jax
+fn, args = ge.entry()
+jax.jit(fn).lower(*args)
+print("entry points OK")
+EOF

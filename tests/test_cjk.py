@@ -1,0 +1,64 @@
+"""CJK tokenizer tests (ref: nlp-chinese/japanese/korean test patterns)."""
+
+from deeplearning4j_tpu.nlp.cjk import (
+    ChineseTokenizerFactory, JapaneseTokenizerFactory,
+    KoreanTokenizerFactory,
+)
+
+
+class TestChinese:
+    def test_char_segmentation(self):
+        toks = ChineseTokenizerFactory().create("我爱北京").get_tokens()
+        assert toks == ["我", "爱", "北", "京"]
+
+    def test_bigrams(self):
+        toks = ChineseTokenizerFactory(bigrams=True).create("我爱北京")
+        assert "我爱" in toks.get_tokens() and "北京" in toks.get_tokens()
+
+    def test_dictionary_max_match(self):
+        tf = ChineseTokenizerFactory(dictionary=["北京", "天安门"])
+        toks = tf.create("我爱北京天安门").get_tokens()
+        assert toks == ["我", "爱", "北京", "天安门"]
+
+    def test_mixed_text(self):
+        toks = ChineseTokenizerFactory(dictionary=["北京"]).create(
+            "hello 北京 world").get_tokens()
+        assert toks == ["hello", "北京", "world"]
+
+
+class TestJapanese:
+    def test_script_boundaries(self):
+        toks = JapaneseTokenizerFactory().create(
+            "東京タワーはすごい").get_tokens()
+        assert toks == ["東京", "タワー", "はすごい"]
+
+    def test_latin_digits(self):
+        toks = JapaneseTokenizerFactory().create("JR山手線30分").get_tokens()
+        assert toks == ["JR", "山手線", "30", "分"]
+
+    def test_prolonged_sound_mark_stays_katakana(self):
+        toks = JapaneseTokenizerFactory().create("コーヒー").get_tokens()
+        assert toks == ["コーヒー"]
+
+
+class TestKorean:
+    def test_whitespace_and_josa(self):
+        toks = KoreanTokenizerFactory().create("나는 학교에 간다").get_tokens()
+        assert toks == ["나", "학교", "간다"]
+
+    def test_no_strip(self):
+        toks = KoreanTokenizerFactory(strip_josa=False).create(
+            "나는 학교에").get_tokens()
+        assert toks == ["나는", "학교에"]
+
+    def test_word2vec_integration(self):
+        # CJK tokens flow through the embedding stack
+        from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
+        tf = ChineseTokenizerFactory(bigrams=False)
+        corpus = ["我 爱 学习", "我 爱 工作", "猫 吃 鱼"]
+        seqs = [tf.create(s.replace(" ", "")).get_tokens() for s in corpus]
+        sv = SequenceVectors(layer_size=8, window=2, min_word_frequency=0,
+                             epochs=2, seed=0)
+        sv.build_vocab(seqs)
+        sv.fit(seqs)
+        assert sv.get_word_vector("我") is not None
