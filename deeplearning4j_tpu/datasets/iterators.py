@@ -12,6 +12,7 @@ TPU-native equivalent of the reference's iterator zoo:
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from typing import Callable, Iterable, Iterator, List, Optional, Sequence
@@ -160,3 +161,84 @@ class SamplingDataSetIterator(DataSetIterator):
                 self.full.features[sel],
                 None if self.full.labels is None else self.full.labels[sel],
             )
+
+
+class JointParallelDataSetIterator(DataSetIterator):
+    """Interleave several iterators (ref: datasets/iterator/parallel/
+    JointParallelDataSetIterator.java — per-device feeds merged into one
+    stream; inequality-terminating: stops at the shortest by default,
+    continues through the longest with ``stop_on_first_exhausted=False``)."""
+
+    def __init__(self, *iterators, stop_on_first_exhausted: bool = True):
+        if not iterators:
+            raise ValueError("need at least one iterator")
+        self.iterators = list(iterators)
+        self.stop_on_first_exhausted = stop_on_first_exhausted
+
+    def reset(self):
+        for it in self.iterators:
+            it.reset()
+
+    def __iter__(self):
+        its = [iter(i) for i in self.iterators]
+        alive = [True] * len(its)
+        while any(alive):
+            for k, it in enumerate(its):
+                if not alive[k]:
+                    continue
+                try:
+                    yield next(it)
+                except StopIteration:
+                    alive[k] = False
+                    if self.stop_on_first_exhausted:
+                        return
+
+
+class FileSplitParallelDataSetIterator(DataSetIterator):
+    """Batches from a directory of .npy/.npz shard files, decoded by a
+    thread pool ahead of consumption (ref: datasets/iterator/parallel/
+    FileSplitParallelDataSetIterator.java). Each .npz holds ``features``
+    and optional ``labels``; a .npy holds features only."""
+
+    def __init__(self, root_dir: str, pattern: str = "*.np[yz]",
+                 batch_size: int = 32, num_threads: int = 2):
+        import fnmatch
+        self.paths = sorted(
+            os.path.join(root_dir, f) for f in os.listdir(root_dir)
+            if fnmatch.fnmatch(f, pattern))
+        if not self.paths:
+            raise FileNotFoundError(
+                f"no files matching {pattern!r} under {root_dir!r}")
+        self.batch_size = batch_size
+        self.num_threads = max(1, num_threads)
+
+    @staticmethod
+    def _load(path):
+        if path.endswith(".npz"):
+            with np.load(path) as z:
+                return z["features"], (z["labels"] if "labels" in z.files
+                                       else None)
+        return np.load(path), None
+
+    def __iter__(self):
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+        window = self.num_threads * 2  # bounded prefetch, not whole dataset
+        with ThreadPoolExecutor(self.num_threads) as pool:
+            pending = deque()
+            paths = iter(self.paths)
+            for p in paths:
+                pending.append(pool.submit(self._load, p))
+                if len(pending) >= window:
+                    break
+            while pending:
+                feats, labels = pending.popleft().result()
+                nxt = next(paths, None)
+                if nxt is not None:
+                    pending.append(pool.submit(self._load, nxt))
+                n = feats.shape[0]
+                for s in range(0, n, self.batch_size):
+                    yield DataSet(
+                        feats[s:s + self.batch_size],
+                        None if labels is None
+                        else labels[s:s + self.batch_size])

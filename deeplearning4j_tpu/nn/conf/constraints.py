@@ -1,0 +1,124 @@
+"""Weight constraints applied after each parameter update.
+
+Equivalent of deeplearning4j-nn nn/conf/constraint/ (MaxNormConstraint,
+MinMaxNormConstraint, NonNegativeConstraint, UnitNormConstraint — SURVEY
+§2.2 "Dropout/noise/constraints"). Constraints are projected inside the
+jitted train step right after the updater applies the step, matching the
+reference's applyConstraints call at the end of each iteration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+@dataclass
+class LayerConstraint:
+    """Base (ref: api/layers/LayerConstraint.java). ``dimensions`` are the
+    axes the norm is taken over — DL4J's default for dense weights is the
+    input dimension (axis 0)."""
+    dimensions: Tuple[int, ...] = (0,)
+    apply_to_weights: bool = True
+    apply_to_biases: bool = False
+
+    def applies_to(self, param_name: str) -> bool:
+        if param_name.startswith("b"):
+            return self.apply_to_biases
+        return self.apply_to_weights
+
+    def apply(self, w):
+        raise NotImplementedError
+
+    def to_dict(self) -> dict:
+        d = {"@constraint": type(self).__name__}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            d[f.name] = list(v) if isinstance(v, tuple) else v
+        return d
+
+    def _norm(self, w):
+        dims = tuple(d for d in self.dimensions if d < w.ndim)
+        if not dims:
+            dims = (0,)
+        return jnp.sqrt(jnp.sum(w * w, axis=dims, keepdims=True) + 1e-12)
+
+
+@dataclass
+class MaxNormConstraint(LayerConstraint):
+    """Rescale columns whose norm exceeds max_norm
+    (ref: constraint/MaxNormConstraint.java)."""
+    max_norm: float = 1.0
+
+    def apply(self, w):
+        n = self._norm(w)
+        scale = jnp.minimum(1.0, self.max_norm / n)
+        return w * scale
+
+
+@dataclass
+class MinMaxNormConstraint(LayerConstraint):
+    """Clamp norms into [min, max] with interpolation rate
+    (ref: constraint/MinMaxNormConstraint.java)."""
+    min_norm: float = 0.0
+    max_norm: float = 1.0
+    rate: float = 1.0
+
+    def apply(self, w):
+        n = self._norm(w)
+        clipped = jnp.clip(n, self.min_norm, self.max_norm)
+        target = w * (clipped / n)
+        return w + self.rate * (target - w)
+
+
+@dataclass
+class NonNegativeConstraint(LayerConstraint):
+    """Project weights onto >= 0 (ref: constraint/NonNegativeConstraint.java)."""
+
+    def apply(self, w):
+        return jnp.maximum(w, 0.0)
+
+
+@dataclass
+class UnitNormConstraint(LayerConstraint):
+    """Normalize to unit norm (ref: constraint/UnitNormConstraint.java)."""
+
+    def apply(self, w):
+        return w / self._norm(w)
+
+
+_CONSTRAINT_REGISTRY = {c.__name__: c for c in
+                        (MaxNormConstraint, MinMaxNormConstraint,
+                         NonNegativeConstraint, UnitNormConstraint)}
+
+
+def constraint_from_dict(d: dict) -> LayerConstraint:
+    cls = _CONSTRAINT_REGISTRY[d["@constraint"]]
+    kwargs = {k: (tuple(v) if k == "dimensions" else v)
+              for k, v in d.items() if not k.startswith("@")}
+    return cls(**kwargs)
+
+
+def apply_constraints(layer_confs, params: dict) -> dict:
+    """Apply each layer's constraints to its param subtree (pure — usable
+    inside jit). ``params`` maps layer key -> {param name -> array}."""
+    out = dict(params)
+    for key, sub in params.items():
+        try:
+            lconf = layer_confs[int(key)] if isinstance(layer_confs, list) \
+                else layer_confs.get(key)
+        except (ValueError, KeyError, IndexError):
+            lconf = None
+        cons = getattr(lconf, "constraints", None)
+        if not cons or not isinstance(sub, dict):
+            continue
+        new_sub = dict(sub)
+        for c in cons:
+            for pname, w in new_sub.items():
+                if c.applies_to(pname) and hasattr(w, "ndim") and w.ndim >= 1:
+                    new_sub[pname] = c.apply(w)
+        out[key] = new_sub
+    return out

@@ -1,0 +1,156 @@
+"""Dropout variants + weight noise.
+
+Equivalent of deeplearning4j-nn nn/conf/dropout/ (Dropout, AlphaDropout,
+GaussianDropout, GaussianNoise — IDropout impls) and nn/conf/weightnoise/
+(DropConnect, WeightNoise) — SURVEY §2.2 "Dropout/noise/constraints".
+
+A layer's ``dropout`` field accepts the DL4J float shorthand (retain
+probability) or one of these IDropout objects; ``weight_noise`` takes an
+IWeightNoise applied to the layer's parameters during training.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# input dropout (ref: nn/conf/dropout/IDropout.java)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class IDropout:
+    def apply_dropout(self, x, rng):
+        raise NotImplementedError
+
+    def to_dict(self) -> dict:
+        d = {"@dropout": type(self).__name__}
+        for f in dataclasses.fields(self):
+            d[f.name] = getattr(self, f.name)
+        return d
+
+
+@dataclass
+class Dropout(IDropout):
+    """Inverted dropout; p = RETAIN probability (ref: Dropout.java)."""
+    p: float = 0.5
+
+    def apply_dropout(self, x, rng):
+        m = jax.random.bernoulli(rng, self.p, x.shape)
+        return jnp.where(m, x / self.p, 0.0)
+
+
+@dataclass
+class AlphaDropout(IDropout):
+    """SELU-preserving dropout (ref: AlphaDropout.java; Klambauer et al.):
+    dropped units are set to alpha', then affine-corrected so mean/variance
+    of SELU activations are preserved. p = retain probability."""
+    p: float = 0.5
+    # fixed SELU constants (ref: AlphaDropout.java DEFAULT_ALPHA/LAMBDA)
+    ALPHA = 1.6732632423543772
+    LAMBDA = 1.0507009873554805
+
+    def apply_dropout(self, x, rng):
+        ap = -self.LAMBDA * self.ALPHA  # alpha'
+        p = self.p
+        a = (p + ap * ap * p * (1 - p)) ** -0.5
+        b = -a * (1 - p) * ap
+        keep = jax.random.bernoulli(rng, p, x.shape)
+        return a * jnp.where(keep, x, ap) + b
+
+
+@dataclass
+class GaussianDropout(IDropout):
+    """Multiplicative Gaussian noise N(1, sqrt(rate/(1-rate)))
+    (ref: GaussianDropout.java)."""
+    rate: float = 0.5
+
+    def apply_dropout(self, x, rng):
+        std = (self.rate / (1.0 - self.rate)) ** 0.5
+        return x * (1.0 + std * jax.random.normal(rng, x.shape))
+
+
+@dataclass
+class GaussianNoise(IDropout):
+    """Additive Gaussian noise (ref: GaussianNoise.java)."""
+    stddev: float = 0.1
+
+    def apply_dropout(self, x, rng):
+        return x + self.stddev * jax.random.normal(rng, x.shape)
+
+
+# ---------------------------------------------------------------------------
+# weight noise (ref: nn/conf/weightnoise/IWeightNoise.java)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class IWeightNoise:
+    def apply_to_params(self, params: dict, rng) -> dict:
+        raise NotImplementedError
+
+    def to_dict(self) -> dict:
+        d = {"@weight_noise": type(self).__name__}
+        for f in dataclasses.fields(self):
+            d[f.name] = getattr(self, f.name)
+        return d
+
+
+@dataclass
+class DropConnect(IWeightNoise):
+    """Drop individual WEIGHTS at train time; p = retain probability
+    (ref: weightnoise/DropConnect.java). Biases are left intact like the
+    reference's applyToBiases=false default."""
+    p: float = 0.5
+    apply_to_biases: bool = False
+
+    def apply_to_params(self, params, rng):
+        out = {}
+        for i, (k, v) in enumerate(sorted(params.items())):
+            if k.startswith("b") and not self.apply_to_biases:
+                out[k] = v
+                continue
+            m = jax.random.bernoulli(jax.random.fold_in(rng, i), self.p,
+                                     v.shape)
+            out[k] = jnp.where(m, v / self.p, 0.0)
+        return out
+
+
+@dataclass
+class WeightNoise(IWeightNoise):
+    """Additive (or multiplicative) Gaussian noise on the weights
+    (ref: weightnoise/WeightNoise.java with a normal distribution)."""
+    stddev: float = 0.01
+    additive: bool = True
+    apply_to_biases: bool = False
+
+    def apply_to_params(self, params, rng):
+        out = {}
+        for i, (k, v) in enumerate(sorted(params.items())):
+            if k.startswith("b") and not self.apply_to_biases:
+                out[k] = v
+                continue
+            noise = self.stddev * jax.random.normal(
+                jax.random.fold_in(rng, i), v.shape)
+            out[k] = v + noise if self.additive else v * (1.0 + noise)
+        return out
+
+
+_DROPOUT_REGISTRY = {c.__name__: c for c in
+                     (Dropout, AlphaDropout, GaussianDropout, GaussianNoise)}
+_NOISE_REGISTRY = {c.__name__: c for c in (DropConnect, WeightNoise)}
+
+
+def dropout_from_dict(d: dict) -> IDropout:
+    cls = _DROPOUT_REGISTRY[d["@dropout"]]
+    kwargs = {k: v for k, v in d.items() if not k.startswith("@")}
+    return cls(**kwargs)
+
+
+def weight_noise_from_dict(d: dict) -> IWeightNoise:
+    cls = _NOISE_REGISTRY[d["@weight_noise"]]
+    kwargs = {k: v for k, v in d.items() if not k.startswith("@")}
+    return cls(**kwargs)

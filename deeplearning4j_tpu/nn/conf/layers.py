@@ -47,7 +47,11 @@ def layer_to_dict(layer) -> dict:
     d = {"@class": type(layer).__name__}
     for f in dataclasses.fields(layer):
         v = getattr(layer, f.name)
-        if isinstance(v, tuple):
+        if f.name == "constraints" and v:  # list OR tuple of constraints
+            v = [c.to_dict() for c in v]
+        elif hasattr(v, "to_dict") and f.name in ("dropout", "weight_noise"):
+            v = v.to_dict()
+        elif isinstance(v, tuple):
             v = list(v)
         d[f.name] = v
     return d
@@ -61,6 +65,17 @@ def layer_from_dict(d: dict):
         raise ValueError(f"Unknown layer class '{cls_name}'")
     names = {f.name for f in dataclasses.fields(cls)}
     kwargs = {k: v for k, v in d.items() if k in names}
+    if isinstance(kwargs.get("dropout"), dict):
+        from deeplearning4j_tpu.nn.conf.dropout import dropout_from_dict
+        kwargs["dropout"] = dropout_from_dict(kwargs["dropout"])
+    if isinstance(kwargs.get("weight_noise"), dict):
+        from deeplearning4j_tpu.nn.conf.dropout import weight_noise_from_dict
+        kwargs["weight_noise"] = weight_noise_from_dict(kwargs["weight_noise"])
+    if kwargs.get("constraints"):
+        from deeplearning4j_tpu.nn.conf.constraints import constraint_from_dict
+        kwargs["constraints"] = [
+            constraint_from_dict(c) if isinstance(c, dict) else c
+            for c in kwargs["constraints"]]
     return cls(**kwargs)
 
 
@@ -82,7 +97,13 @@ class LayerConf:
     name: Optional[str] = None
     # DL4J semantics: `dropout` is the RETAIN probability applied to the layer
     # INPUT during training (ref: conf/dropout/Dropout.java); 0.0 = disabled.
-    dropout: float = 0.0
+    # Also accepts an IDropout object (AlphaDropout, GaussianDropout, ...).
+    dropout: Any = 0.0
+    # optional IWeightNoise (DropConnect/WeightNoise) applied to this
+    # layer's params during training (ref: conf/weightnoise/)
+    weight_noise: Any = None
+    # weight constraints projected after each update (ref: conf/constraint/)
+    constraints: Any = None
 
     # -- protocol ----------------------------------------------------------
     def output_type(self, it: InputType) -> InputType:
@@ -108,7 +129,11 @@ class LayerConf:
         return {}
 
     def maybe_dropout_input(self, x, train, rng):
-        if train and 0.0 < self.dropout < 1.0 and rng is not None:
+        if not train or rng is None:
+            return x
+        if hasattr(self.dropout, "apply_dropout"):  # IDropout object
+            return self.dropout.apply_dropout(x, rng)
+        if isinstance(self.dropout, (int, float)) and 0.0 < self.dropout < 1.0:
             keep = self.dropout
             m = jax.random.bernoulli(rng, keep, x.shape)
             return jnp.where(m, x / keep, 0.0)

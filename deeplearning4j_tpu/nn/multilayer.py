@@ -127,7 +127,13 @@ class MultiLayerNetwork:
             rng_i = None
             if rng is not None:
                 rng_i = jax.random.fold_in(rng, i)
-            h, s_new = layer.apply(params[str(i)], h, li_state, train=train,
+            p_i = params[str(i)]
+            wn = getattr(layer, "weight_noise", None)
+            if wn is not None and train and rng_i is not None and \
+                    isinstance(p_i, dict):
+                p_i = wn.apply_to_params(
+                    p_i, jax.random.fold_in(rng_i, 987))
+            h, s_new = layer.apply(p_i, h, li_state, train=train,
                                    rng=rng_i, mask=mask)
             mask = layer.output_mask(mask, its[i])
             new_state[str(i)] = s_new
@@ -212,6 +218,10 @@ class MultiLayerNetwork:
                                             conf.gradient_normalization_threshold)
                 steps, new_upd = conf.updater.update(grads, upd_state, params)
                 new_params = _tree_sub(params, steps)
+                if any(getattr(l, "constraints", None) for l in self.layers):
+                    from deeplearning4j_tpu.nn.conf.constraints import \
+                        apply_constraints
+                    new_params = apply_constraints(self.layers, new_params)
                 return new_params, new_state, new_upd, loss
 
             self._jit_cache[key] = jax.jit(step, donate_argnums=(0, 2))

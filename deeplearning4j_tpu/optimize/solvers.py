@@ -1,0 +1,221 @@
+"""Second-order / line-search optimizers.
+
+Equivalent of deeplearning4j-nn optimize/solvers/ (SURVEY §2.2 "Solvers"):
+ConjugateGradient.java, LBFGS.java, LineGradientDescent.java driven by
+BackTrackLineSearch.java. (StochasticGradientDescent is the jitted train
+step in the networks themselves.)
+
+These are full-batch algorithms over the flattened parameter vector —
+the classical use is small-data refinement (the reference defaults
+them for pretrain layers). Loss and gradient come from one jitted
+value_and_grad over the network's loss; the algorithm outer loop stays in
+Python (data-dependent convergence checks don't belong inside jit).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+def _flatten(params) -> Tuple[jnp.ndarray, Callable]:
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    shapes = [l.shape for l in leaves]
+    sizes = [int(np.prod(s)) for s in shapes]
+
+    def unflatten(vec):
+        outs, off = [], 0
+        for s, n in zip(shapes, sizes):
+            outs.append(vec[off:off + n].reshape(s))
+            off += n
+        return jax.tree_util.tree_unflatten(treedef, outs)
+
+    vec = jnp.concatenate([l.reshape(-1) for l in leaves]) if leaves \
+        else jnp.zeros((0,))
+    return vec, unflatten
+
+
+class BackTrackLineSearch:
+    """Armijo backtracking (ref: BackTrackLineSearch.java — optimize()
+    with c1 slope condition, step halving)."""
+
+    def __init__(self, c1: float = 1e-4, shrink: float = 0.5,
+                 max_steps: int = 20, initial_step: float = 1.0):
+        self.c1 = c1
+        self.shrink = shrink
+        self.max_steps = max_steps
+        self.initial_step = initial_step
+
+    def search(self, f, x, fx, g, direction):
+        slope = float(jnp.dot(g, direction))
+        if slope >= 0:
+            direction = -g  # not a descent direction: fall back to steepest
+            slope = float(jnp.dot(g, direction))
+
+        def armijo(step, f_new):
+            return np.isfinite(f_new) and \
+                f_new <= float(fx) + self.c1 * step * slope
+
+        step = self.initial_step
+        for k in range(self.max_steps):
+            f_new = float(f(x + step * direction))
+            if armijo(step, f_new):
+                if k == 0:
+                    # accepted at first try: expand while it keeps helping —
+                    # prevents a poorly-scaled direction (e.g. LBFGS gamma
+                    # poisoned by one tiny step) from crawling forever
+                    best_step, best_f = step, f_new
+                    for _ in range(self.max_steps):
+                        trial = best_step / self.shrink
+                        f_trial = float(f(x + trial * direction))
+                        if armijo(trial, f_trial) and f_trial < best_f:
+                            best_step, best_f = trial, f_trial
+                        else:
+                            break
+                    return x + best_step * direction, best_f, best_step
+                return x + step * direction, f_new, step
+            step *= self.shrink
+        return x, float(fx), 0.0  # no progress
+
+
+class BaseSecondOrderOptimizer:
+    """Shared outer loop (ref: BaseOptimizer.java optimize())."""
+
+    def __init__(self, max_iterations: int = 100, tolerance: float = 1e-5,
+                 line_search: Optional[BackTrackLineSearch] = None):
+        self.max_iterations = max_iterations
+        self.tolerance = tolerance
+        self.line_search = line_search or BackTrackLineSearch()
+        self.score_history: List[float] = []
+
+    # subclass hook
+    def _direction(self, g, state):
+        raise NotImplementedError
+
+    def _update_memory(self, state, x_old, x_new, g_old, g_new):
+        return state
+
+    def optimize_fn(self, value_and_grad, x0):
+        """Minimize a flat function. Returns (x, final_value)."""
+        x = x0
+        fx, g = value_and_grad(x)
+        state: dict = {}
+        self.score_history = [float(fx)]
+        f_only = lambda v: value_and_grad(v)[0]  # noqa: E731
+        just_restarted = False
+        for it in range(self.max_iterations):
+            d = self._direction(g, state)
+            x_new, f_new, step = self.line_search.search(f_only, x, fx, g, d)
+            if step == 0.0:
+                if not just_restarted:  # stale memory can poison directions
+                    state = {}
+                    just_restarted = True
+                    continue
+                log.info("line search made no progress at iter %d", it)
+                break
+            just_restarted = False
+            _, g_new = value_and_grad(x_new)
+            state = self._update_memory(state, x, x_new, g, g_new)
+            improved = float(fx) - f_new
+            x, fx, g = x_new, f_new, g_new
+            self.score_history.append(float(fx))
+            if abs(improved) < self.tolerance:
+                break
+        return x, float(fx)
+
+    def optimize(self, net, dataset) -> float:
+        """Full-batch optimize a network's loss in place (the reference's
+        Solver.optimize with this ConvexOptimizer)."""
+        x = jnp.asarray(dataset.features)
+        y = jnp.asarray(dataset.labels)
+        fmask = None if dataset.features_mask is None \
+            else jnp.asarray(dataset.features_mask)
+        lmask = None if dataset.labels_mask is None \
+            else jnp.asarray(dataset.labels_mask)
+        vec0, unflatten = _flatten(net.params)
+
+        @jax.jit
+        def vg(vec):
+            loss, _ = net._loss(unflatten(vec), net.state, x, y, None,
+                                fmask, lmask, train=False)
+            return loss
+
+        value_and_grad = jax.jit(jax.value_and_grad(vg))
+        vec, final = self.optimize_fn(lambda v: value_and_grad(v), vec0)
+        net.params = unflatten(vec)
+        net.score_value = final
+        return final
+
+
+class LineGradientDescent(BaseSecondOrderOptimizer):
+    """Steepest descent + line search (ref: LineGradientDescent.java)."""
+
+    def _direction(self, g, state):
+        return -g
+
+
+class ConjugateGradient(BaseSecondOrderOptimizer):
+    """Nonlinear CG, Polak-Ribière with restart
+    (ref: ConjugateGradient.java)."""
+
+    def _direction(self, g, state):
+        if "g_prev" not in state:
+            d = -g
+        else:
+            g_prev, d_prev = state["g_prev"], state["d_prev"]
+            beta = float(jnp.dot(g, g - g_prev) /
+                         jnp.maximum(jnp.dot(g_prev, g_prev), 1e-20))
+            beta = max(0.0, beta)  # PR+ restart
+            d = -g + beta * d_prev
+        state["_d_used"] = d  # cached for _update_memory
+        return d
+
+    def _update_memory(self, state, x_old, x_new, g_old, g_new):
+        return {"g_prev": g_old, "d_prev": state["_d_used"]}
+
+
+class LBFGS(BaseSecondOrderOptimizer):
+    """Limited-memory BFGS, two-loop recursion (ref: LBFGS.java, default
+    memory m=10)."""
+
+    def __init__(self, memory: int = 10, **kwargs):
+        super().__init__(**kwargs)
+        self.memory = memory
+
+    def _direction(self, g, state):
+        s_list = state.get("s", [])
+        y_list = state.get("y", [])
+        q = g
+        alphas = []
+        for s, yv in zip(reversed(s_list), reversed(y_list)):
+            rho = 1.0 / float(jnp.maximum(jnp.dot(yv, s), 1e-20))
+            a = rho * float(jnp.dot(s, q))
+            alphas.append((a, rho, s, yv))
+            q = q - a * yv
+        if y_list:
+            y_last, s_last = y_list[-1], s_list[-1]
+            gamma = float(jnp.dot(s_last, y_last) /
+                          jnp.maximum(jnp.dot(y_last, y_last), 1e-20))
+        else:
+            gamma = 1.0
+        r = gamma * q
+        for a, rho, s, yv in reversed(alphas):
+            b = rho * float(jnp.dot(yv, r))
+            r = r + (a - b) * s
+        return -r
+
+    def _update_memory(self, state, x_old, x_new, g_old, g_new):
+        s = x_new - x_old
+        yv = g_new - g_old
+        if float(jnp.dot(s, yv)) > 1e-10:  # curvature condition
+            s_list = state.get("s", []) + [s]
+            y_list = state.get("y", []) + [yv]
+            state = {"s": s_list[-self.memory:],
+                     "y": y_list[-self.memory:]}
+        return state

@@ -64,7 +64,8 @@ class ArrayHub:
 
     def __init__(self, port: int = 0, send_timeout: float = 5.0):
         self._subs: List[socket.socket] = []
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()       # subscriber list
+        self._pub_lock = threading.Lock()   # one publisher at a time
         self.send_timeout = send_timeout
         hub = self
 
@@ -93,6 +94,10 @@ class ArrayHub:
         stalled subscriber can't wedge the hub; timed-out/dead subscribers
         are dropped."""
         frame = _pack(arrays)
+        with self._pub_lock:  # serialize publishers (frame interleaving)
+            return self._publish_frame(frame)
+
+    def _publish_frame(self, frame: bytes) -> int:
         with self._lock:
             targets = list(self._subs)
         sent, dead = 0, []

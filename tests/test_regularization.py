@@ -1,0 +1,238 @@
+"""Dropout variants, weight noise, constraints, second-order solvers,
+parallel iterators (SURVEY §2.2 dropout/noise/constraints + solvers,
+§2.2 async/parallel iterators)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import (
+    ArrayDataSetIterator, FileSplitParallelDataSetIterator,
+    JointParallelDataSetIterator,
+)
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.constraints import (
+    MaxNormConstraint, NonNegativeConstraint, UnitNormConstraint,
+    apply_constraints,
+)
+from deeplearning4j_tpu.nn.conf.dropout import (
+    AlphaDropout, DropConnect, Dropout, GaussianDropout, GaussianNoise,
+    WeightNoise, dropout_from_dict,
+)
+from deeplearning4j_tpu.nn.conf.layers import (
+    DenseLayer, OutputLayer, layer_from_dict, layer_to_dict,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Adam
+from deeplearning4j_tpu.optimize.solvers import (
+    LBFGS, BackTrackLineSearch, ConjugateGradient, LineGradientDescent,
+)
+
+
+class TestDropoutVariants:
+    def setup_method(self):
+        self.x = jnp.ones((2000,))
+        self.rng = jax.random.PRNGKey(0)
+
+    def test_dropout_scales(self):
+        y = Dropout(p=0.8).apply_dropout(self.x, self.rng)
+        kept = float((y > 0).mean())
+        assert 0.74 < kept < 0.86
+        assert float(y.mean()) == pytest.approx(1.0, abs=0.1)
+
+    def test_alpha_dropout_preserves_moments(self):
+        rng = jax.random.PRNGKey(1)
+        x = jax.random.normal(rng, (20000,))  # SELU-style activations
+        y = AlphaDropout(p=0.9).apply_dropout(x, jax.random.PRNGKey(2))
+        assert float(y.mean()) == pytest.approx(float(x.mean()), abs=0.05)
+        assert float(y.std()) == pytest.approx(float(x.std()), abs=0.1)
+
+    def test_gaussian_dropout_mean_preserving(self):
+        y = GaussianDropout(rate=0.3).apply_dropout(self.x, self.rng)
+        assert float(y.mean()) == pytest.approx(1.0, abs=0.05)
+        assert float(y.std()) > 0.1
+
+    def test_gaussian_noise(self):
+        y = GaussianNoise(stddev=0.2).apply_dropout(self.x, self.rng)
+        assert float(y.std()) == pytest.approx(0.2, abs=0.03)
+
+    def test_layer_integration_and_serde(self):
+        layer = DenseLayer(n_in=4, n_out=8, activation="relu",
+                           dropout=GaussianDropout(rate=0.4))
+        d = layer_to_dict(layer)
+        assert d["dropout"]["@dropout"] == "GaussianDropout"
+        back = layer_from_dict(d)
+        assert isinstance(back.dropout, GaussianDropout)
+        assert back.dropout.rate == 0.4
+
+    def test_dropout_from_dict_roundtrip(self):
+        for obj in (Dropout(0.7), AlphaDropout(0.9),
+                    GaussianDropout(0.2), GaussianNoise(0.05)):
+            back = dropout_from_dict(obj.to_dict())
+            assert back == obj
+
+
+class TestWeightNoise:
+    def test_dropconnect_drops_weights_not_biases(self):
+        params = {"W": jnp.ones((10, 10)), "b": jnp.ones((10,))}
+        out = DropConnect(p=0.5).apply_to_params(params,
+                                                 jax.random.PRNGKey(0))
+        frac = float((out["W"] == 0).mean())
+        assert 0.3 < frac < 0.7
+        np.testing.assert_array_equal(np.asarray(out["b"]), np.ones(10))
+
+    def test_weight_noise_additive(self):
+        params = {"W": jnp.zeros((50, 50))}
+        out = WeightNoise(stddev=0.1).apply_to_params(params,
+                                                      jax.random.PRNGKey(1))
+        assert float(jnp.std(out["W"])) == pytest.approx(0.1, abs=0.02)
+
+    def test_training_with_weight_noise_runs(self):
+        conf = (NeuralNetConfiguration.Builder().seed(0)
+                .updater(Adam(0.01)).list()
+                .layer(DenseLayer(n_in=4, n_out=8, activation="tanh",
+                                  weight_noise=DropConnect(p=0.9)))
+                .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((20, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 20)]
+        net.fit(DataSet(x, y), epochs=3)
+        assert np.isfinite(net.score_value)
+
+
+class TestConstraints:
+    def test_max_norm(self):
+        w = jnp.ones((4, 3)) * 2.0  # column norm 4
+        out = MaxNormConstraint(max_norm=1.0).apply(w)
+        norms = jnp.linalg.norm(out, axis=0)
+        np.testing.assert_allclose(np.asarray(norms), 1.0, rtol=1e-5)
+
+    def test_non_negative(self):
+        w = jnp.array([[-1.0, 2.0], [3.0, -4.0]])
+        out = NonNegativeConstraint().apply(w)
+        assert float(out.min()) == 0.0
+
+    def test_unit_norm(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (5, 3)) * 7
+        out = UnitNormConstraint().apply(w)
+        np.testing.assert_allclose(np.asarray(jnp.linalg.norm(out, axis=0)),
+                                   1.0, rtol=1e-4)
+
+    def test_training_respects_constraint(self):
+        layers = [DenseLayer(n_in=4, n_out=8, activation="tanh",
+                             constraints=[MaxNormConstraint(max_norm=0.5)]),
+                  OutputLayer(n_in=8, n_out=2, activation="softmax",
+                              loss="mcxent")]
+        conf = (NeuralNetConfiguration.Builder().seed(0)
+                .updater(Adam(0.05)).list()
+                .layer(layers[0]).layer(layers[1]).build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((30, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 30)]
+        net.fit(DataSet(x, y), epochs=5)
+        w = np.asarray(net.params["0"]["W"])
+        norms = np.linalg.norm(w, axis=0)
+        assert (norms <= 0.5 + 1e-4).all(), norms
+
+    def test_apply_constraints_skips_unconstrained(self):
+        layers = [DenseLayer(n_in=2, n_out=2)]
+        params = {"0": {"W": jnp.ones((2, 2)) * 9}}
+        out = apply_constraints(layers, params)
+        np.testing.assert_array_equal(np.asarray(out["0"]["W"]),
+                                      np.ones((2, 2)) * 9)
+
+
+def rosenbrock(v):
+    return (1 - v[0]) ** 2 + 100.0 * (v[1] - v[0] ** 2) ** 2
+
+
+class TestSecondOrderSolvers:
+    @pytest.mark.parametrize("opt_cls,iters", [
+        (LineGradientDescent, 2000), (ConjugateGradient, 500), (LBFGS, 200)])
+    def test_rosenbrock(self, opt_cls, iters):
+        opt = opt_cls(max_iterations=iters, tolerance=1e-12)
+        vg = jax.jit(jax.value_and_grad(rosenbrock))
+        x, fx = opt.optimize_fn(lambda v: vg(v), jnp.array([-1.2, 1.0]))
+        assert fx < 1e-3, f"{opt_cls.__name__} got {fx}"
+        # score history is monotone non-increasing
+        hist = opt.score_history
+        assert all(b <= a + 1e-9 for a, b in zip(hist, hist[1:]))
+
+    def test_lbfgs_beats_gd_on_budget(self):
+        vg = jax.jit(jax.value_and_grad(rosenbrock))
+        x0 = jnp.array([-1.2, 1.0])
+        _, f_gd = LineGradientDescent(max_iterations=100,
+                                      tolerance=0).optimize_fn(
+            lambda v: vg(v), x0)
+        _, f_lb = LBFGS(max_iterations=100, tolerance=0).optimize_fn(
+            lambda v: vg(v), x0)
+        assert f_lb < f_gd
+
+    def test_optimizes_network(self):
+        conf = (NeuralNetConfiguration.Builder().seed(0).list()
+                .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((60, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+        ds = DataSet(x, y)
+        s0 = net.score(ds)
+        final = LBFGS(max_iterations=50).optimize(net, ds)
+        assert final < s0 * 0.5
+
+    def test_line_search_rejects_uphill(self):
+        ls = BackTrackLineSearch()
+        f = lambda v: float(jnp.sum(v ** 2))  # noqa: E731
+        x = jnp.array([1.0, 1.0])
+        g = 2 * x
+        x_new, f_new, step = ls.search(f, x, f(x), g, g)  # uphill direction
+        assert f_new <= f(x)  # fell back to steepest descent
+
+
+class TestParallelIterators:
+    def test_joint_interleaves(self):
+        a = ArrayDataSetIterator(np.zeros((4, 2)), np.zeros((4, 1)),
+                                 batch_size=2)
+        b = ArrayDataSetIterator(np.ones((4, 2)), np.ones((4, 1)),
+                                 batch_size=2)
+        out = list(JointParallelDataSetIterator(a, b))
+        assert len(out) == 4
+        assert out[0].features[0, 0] == 0 and out[1].features[0, 0] == 1
+
+    def test_joint_stop_on_first(self):
+        a = ArrayDataSetIterator(np.zeros((2, 2)), batch_size=2)  # 1 batch
+        b = ArrayDataSetIterator(np.ones((6, 2)), batch_size=2)   # 3 batches
+        # stop mode: a1, b1, then a exhausts -> stop
+        assert len(list(JointParallelDataSetIterator(a, b))) == 2
+        assert len(list(JointParallelDataSetIterator(
+            a, b, stop_on_first_exhausted=False))) == 4
+
+    def test_file_split(self, tmp_path):
+        rng = np.random.default_rng(0)
+        for i in range(3):
+            np.savez(tmp_path / f"shard{i}.npz",
+                     features=rng.standard_normal((10, 4)).astype(np.float32),
+                     labels=np.eye(2, dtype=np.float32)[
+                         rng.integers(0, 2, 10)])
+        it = FileSplitParallelDataSetIterator(str(tmp_path), batch_size=4,
+                                              num_threads=2)
+        batches = list(it)
+        assert sum(b.features.shape[0] for b in batches) == 30
+        assert all(b.labels is not None for b in batches)
+
+    def test_file_split_missing(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            FileSplitParallelDataSetIterator(str(tmp_path))
