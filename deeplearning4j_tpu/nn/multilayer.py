@@ -287,6 +287,9 @@ class MultiLayerNetwork:
     def _fit_batch(self, ds: DataSet, carry_rnn: bool = False):
         step = self._get_train_step(carry_rnn)
         rng = self._next_rng()
+        if any(getattr(l, "needs_batch_features", False)
+               for l in self.listeners):
+            self._last_batch_features = ds.features  # for viz listeners
         fmask = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
         lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
         self.params, self.state, self.updater_state, loss = step(

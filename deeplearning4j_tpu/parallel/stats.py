@@ -1,0 +1,127 @@
+"""Distributed-training phase statistics + HTML timeline export.
+
+Equivalent of deeplearning4j-scaleout spark/api/stats/
+CommonSparkTrainingStats.java and spark/stats/StatsUtils.exportStatsAsHtml
+(SURVEY §2.5 "Spark stats"): wall-clock accounting of the training phases
+(data feed / ETL vs device step vs host sync) with an HTML timeline export.
+
+On TPU the phases differ from Spark's (no broadcast/repartition), so the
+categories are the ones that matter here: etl (host batch prep + transfer),
+step (jitted train step), listener (host callbacks).
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class PhaseEvent:
+    phase: str
+    start: float
+    duration_ms: float
+
+
+@dataclass
+class TrainingStats:
+    """Collects (phase, start, duration) events
+    (ref: CommonSparkTrainingStats collects per-phase timing lists)."""
+    events: List[PhaseEvent] = field(default_factory=list)
+    _open: Dict[str, float] = field(default_factory=dict)
+
+    def start_phase(self, phase: str) -> None:
+        self._open[phase] = time.perf_counter()
+
+    def end_phase(self, phase: str) -> None:
+        t0 = self._open.pop(phase, None)
+        if t0 is not None:
+            now = time.perf_counter()
+            self.events.append(PhaseEvent(phase, t0, (now - t0) * 1000.0))
+
+    class _Timer:
+        def __init__(self, stats, phase):
+            self.stats, self.phase = stats, phase
+
+        def __enter__(self):
+            self.stats.start_phase(self.phase)
+
+        def __exit__(self, *exc):
+            self.stats.end_phase(self.phase)
+
+    def time_phase(self, phase: str) -> "TrainingStats._Timer":
+        return TrainingStats._Timer(self, phase)
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        agg: Dict[str, List[float]] = defaultdict(list)
+        for e in self.events:
+            agg[e.phase].append(e.duration_ms)
+        out = {}
+        for phase, ds in agg.items():
+            ds_sorted = sorted(ds)
+            n = len(ds_sorted)
+            out[phase] = {
+                "count": n,
+                "total_ms": sum(ds_sorted),
+                "mean_ms": sum(ds_sorted) / n,
+                "p50_ms": ds_sorted[n // 2],
+                "max_ms": ds_sorted[-1],
+            }
+        return out
+
+    def export_html(self, path: str) -> None:
+        """Standalone HTML: per-phase summary table + SVG timeline
+        (ref: StatsUtils.exportStatsAsHtml timeline chart)."""
+        summ = self.summary()
+        colors = {"etl": "#fb8c00", "step": "#1976d2", "listener": "#43a047"}
+        rows = "".join(
+            f"<tr><td>{html.escape(p)}</td><td>{s['count']}</td>"
+            f"<td>{s['total_ms']:.1f}</td><td>{s['mean_ms']:.2f}</td>"
+            f"<td>{s['p50_ms']:.2f}</td><td>{s['max_ms']:.2f}</td></tr>"
+            for p, s in sorted(summ.items()))
+        svg = self._timeline_svg(colors)
+        with open(path, "w") as f:
+            f.write(f"""<!DOCTYPE html><html><head><title>Training stats</title>
+<style>body{{font-family:sans-serif;margin:20px}}
+table{{border-collapse:collapse;font-size:13px}}
+td,th{{border:1px solid #ccc;padding:4px 10px;text-align:right}}
+th{{background:#f4f4f4}}</style></head><body>
+<h1>Training phase stats</h1>
+<table><tr><th>phase</th><th>count</th><th>total ms</th><th>mean ms</th>
+<th>p50 ms</th><th>max ms</th></tr>{rows}</table>
+<h2>Timeline</h2>{svg}</body></html>""")
+
+    def _timeline_svg(self, colors: Dict[str, str], width: int = 1000,
+                      row_h: int = 26) -> str:
+        if not self.events:
+            return "<p>no events</p>"
+        t0 = min(e.start for e in self.events)
+        t1 = max(e.start + e.duration_ms / 1000.0 for e in self.events)
+        span = max(t1 - t0, 1e-9)
+        phases = sorted({e.phase for e in self.events})
+        h = row_h * len(phases) + 30
+        parts = [f'<svg width="{width}" height="{h}" '
+                 f'xmlns="http://www.w3.org/2000/svg">']
+        for ri, p in enumerate(phases):
+            y = ri * row_h + 20
+            parts.append(f'<text x="2" y="{y + 14}" font-size="12">'
+                         f'{html.escape(p)}</text>')
+            col = colors.get(p, "#8e24aa")
+            for e in self.events:
+                if e.phase != p:
+                    continue
+                x = 80 + (e.start - t0) / span * (width - 90)
+                w = max(1.0, e.duration_ms / 1000.0 / span * (width - 90))
+                parts.append(f'<rect x="{x:.1f}" y="{y}" width="{w:.1f}" '
+                             f'height="{row_h - 6}" fill="{col}"/>')
+        parts.append("</svg>")
+        return "".join(parts)
+
+    def to_json(self) -> str:
+        return json.dumps({"events": [
+            {"phase": e.phase, "start": e.start,
+             "durationMs": e.duration_ms} for e in self.events]})

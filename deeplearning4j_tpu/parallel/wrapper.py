@@ -59,7 +59,8 @@ class ParallelWrapper:
                  training_mode: str = "allreduce",
                  averaging_frequency: int = 5,
                  prefetch_buffer: int = 2,
-                 report_score_after_averaging: bool = True):
+                 report_score_after_averaging: bool = True,
+                 collect_stats: bool = False):
         self.model = model
         self.mesh = mesh if mesh is not None else default_mesh()
         self.training_mode = training_mode
@@ -67,6 +68,11 @@ class ParallelWrapper:
         self.prefetch_buffer = prefetch_buffer
         self.n_devices = int(np.prod(self.mesh.devices.shape))
         self._jit_cache: Dict[Any, Any] = {}
+        # phase timing (ref: CommonSparkTrainingStats role)
+        self.stats = None
+        if collect_stats:
+            from deeplearning4j_tpu.parallel.stats import TrainingStats
+            self.stats = TrainingStats()
         if not model._initialized:
             model.init()
 
@@ -97,6 +103,9 @@ class ParallelWrapper:
         m = self.model
         step = m._get_train_step(False)
         rng = m._next_rng()
+        if any(getattr(l, "needs_batch_features", False)
+               for l in m.listeners):
+            m._last_batch_features = ds.features  # for viz listeners
         x = self._shard_batch(ds.features)
         y = self._shard_batch(ds.labels)
         fmask = None if ds.features_mask is None else self._shard_batch(ds.features_mask)
@@ -216,22 +225,36 @@ class ParallelWrapper:
         else:
             it = data
 
+        from contextlib import nullcontext
+
+        def timer(phase):  # no-op when stats are off — single shared loop
+            return self.stats.time_phase(phase) if self.stats is not None \
+                else nullcontext()
+
         for _ in range(epochs):
             src = AsyncDataSetIterator(it, prefetch=self.prefetch_buffer) \
                 if self.prefetch_buffer else it
-            if self.training_mode == "averaging":
-                pend = []
-                round_size = self.averaging_frequency * self.n_devices
-                for ds in src:
+            averaging = self.training_mode == "averaging"
+            round_size = self.averaging_frequency * self.n_devices
+            pend = []
+            src_it = iter(src)
+            while True:
+                with timer("etl"):
+                    ds = next(src_it, None)
+                if ds is None:
+                    break
+                if averaging:
                     pend.append(ds)
                     if len(pend) == round_size:
-                        self._fit_round_averaging(pend)
+                        with timer("step"):
+                            self._fit_round_averaging(pend)
                         pend = []
-                # trailing partial round: fall back to allreduce steps
-                for ds in pend:
-                    self._fit_batch_allreduce(ds)
-            else:
-                for ds in src:
+                else:
+                    with timer("step"):
+                        self._fit_batch_allreduce(ds)
+            # trailing partial averaging round: fall back to allreduce steps
+            for ds in pend:
+                with timer("step"):
                     self._fit_batch_allreduce(ds)
             m.epoch_count += 1
         return m

@@ -1,0 +1,92 @@
+"""Convolutional activation visualization.
+
+Equivalent of deeplearning4j-ui ConvolutionalIterationListener
+(ui/weights/ConvolutionalIterationListener.java — SURVEY §2.11 "ui legacy
+bits") and the ConvolutionalListenerModule tab: every N iterations, run the
+first sample of the current batch through the network, tile each conv
+layer's channel activations into one grayscale grid image, and write PNGs
+(or hand them to the UI server for display).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+
+log = logging.getLogger(__name__)
+
+
+def tile_activations(act: np.ndarray, pad: int = 1,
+                     max_channels: int = 64) -> np.ndarray:
+    """[C, H, W] activations → one [rows*H, cols*W] uint8 grid, each
+    channel min-max normalized (ref: ConvolutionalIterationListener
+    rasterizeConvoLayers)."""
+    act = np.asarray(act)
+    if act.ndim != 3:
+        raise ValueError(f"expected [C,H,W] activations, got {act.shape}")
+    c = min(act.shape[0], max_channels)
+    act = act[:c]
+    cols = int(math.ceil(math.sqrt(c)))
+    rows = int(math.ceil(c / cols))
+    h, w = act.shape[1], act.shape[2]
+    grid = np.zeros((rows * (h + pad) - pad, cols * (w + pad) - pad),
+                    np.uint8)
+    for i in range(c):
+        a = act[i]
+        lo, hi = float(a.min()), float(a.max())
+        img = ((a - lo) / (hi - lo) * 255.0 if hi > lo
+               else np.zeros_like(a)).astype(np.uint8)
+        r, col = divmod(i, cols)
+        grid[r * (h + pad): r * (h + pad) + h,
+             col * (w + pad): col * (w + pad) + w] = img
+    return grid
+
+
+class ConvolutionalIterationListener(TrainingListener):
+    """Write per-conv-layer activation grids every ``frequency`` iterations
+    (PNG files under ``output_dir``, named it<iter>_layer<i>.png)."""
+
+    # networks stash the current batch only when a listener asks for it
+    needs_batch_features = True
+
+    def __init__(self, output_dir: str, frequency: int = 10,
+                 max_channels: int = 64):
+        self.output_dir = output_dir
+        self.frequency = max(1, frequency)
+        self.max_channels = max_channels
+        os.makedirs(output_dir, exist_ok=True)
+        self._last_input = None
+
+    def iteration_done(self, model, iteration: int, score: float):
+        if iteration % self.frequency != 0:
+            return
+        x = getattr(model, "_last_batch_features", None)
+        if x is None:
+            return
+        try:
+            from PIL import Image  # optional dep ([viz] extra)
+            acts = self._conv_activations(model, np.asarray(x)[:1])
+            for li, act in acts:
+                grid = tile_activations(act, max_channels=self.max_channels)
+                Image.fromarray(grid, mode="L").save(os.path.join(
+                    self.output_dir, f"it{iteration}_layer{li}.png"))
+        except Exception as e:  # noqa: BLE001 - visualization must not kill fit
+            log.debug("conv listener skipped: %s", e)
+
+    @staticmethod
+    def _conv_activations(model, x) -> List:
+        """(layer index, [C,H,W]) for each 4-D activation."""
+        acts, _ = model._forward(model.params, model.state, x,
+                                 train=False, rng=None)
+        out = []
+        for i, a in enumerate(acts):
+            a = np.asarray(a)
+            if a.ndim == 4:  # [1, C, H, W]
+                out.append((i, a[0]))
+        return out

@@ -154,3 +154,83 @@ class TestUIServer:
             assert b is not a
         finally:
             b.stop()
+
+
+class TestConvolutionalListener:
+    def test_tile_activations(self):
+        from deeplearning4j_tpu.ui.convolutional import tile_activations
+        act = np.random.default_rng(0).standard_normal((9, 5, 4))
+        grid = tile_activations(act, pad=1)
+        assert grid.dtype == np.uint8
+        assert grid.shape == (3 * 6 - 1, 3 * 5 - 1)
+
+    def test_writes_pngs_during_training(self, tmp_path):
+        import os
+        from deeplearning4j_tpu.ui.convolutional import (
+            ConvolutionalIterationListener)
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (
+            ConvolutionLayer, OutputLayer)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        conf = (NeuralNetConfiguration.Builder().seed(0).list()
+                .layer(ConvolutionLayer(n_out=4, kernel=(3, 3)))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.convolutional(8, 8, 1))
+                .build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        net.set_listeners(ConvolutionalIterationListener(
+            str(tmp_path), frequency=1))
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((6, 1, 8, 8)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 6)]
+        net.fit(DataSet(x, y), epochs=2)
+        pngs = [f for f in os.listdir(str(tmp_path)) if f.endswith(".png")]
+        assert pngs, "no activation grids written"
+
+
+class TestTrainingStats:
+    def test_phase_collection_and_html(self, tmp_path):
+        import time as _time
+        from deeplearning4j_tpu.parallel.stats import TrainingStats
+        st = TrainingStats()
+        for _ in range(3):
+            with st.time_phase("etl"):
+                _time.sleep(0.002)
+            with st.time_phase("step"):
+                _time.sleep(0.005)
+        s = st.summary()
+        assert s["etl"]["count"] == 3 and s["step"]["count"] == 3
+        assert s["step"]["mean_ms"] > s["etl"]["mean_ms"]
+        p = str(tmp_path / "stats.html")
+        st.export_html(p)
+        html = open(p).read()
+        assert "<svg" in html and "etl" in html and "step" in html
+
+    def test_wrapper_collects(self):
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+        conf = (NeuralNetConfiguration.Builder().seed(0).list()
+                .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        rng = np.random.default_rng(0)
+        it = ArrayDataSetIterator(
+            rng.standard_normal((64, 4)).astype(np.float32),
+            np.eye(2, dtype=np.float32)[rng.integers(0, 2, 64)],
+            batch_size=16)
+        pw = ParallelWrapper(net, prefetch_buffer=0, collect_stats=True)
+        pw.fit(it, epochs=2)
+        s = pw.stats.summary()
+        assert s["step"]["count"] == 8
+        assert "etl" in s
