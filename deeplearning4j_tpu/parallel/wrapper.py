@@ -93,6 +93,18 @@ class ParallelWrapper:
         sh = NamedSharding(self.mesh, P())
         return jax.device_put(tree, sh)
 
+    def _timer(self, phase: str):
+        """Phase timer; no-op when stats collection is off."""
+        from contextlib import nullcontext
+        return self.stats.time_phase(phase) if self.stats is not None \
+            else nullcontext()
+
+    def _stash_batch_for_viz(self, ds: DataSet):
+        m = self.model
+        if any(getattr(l, "needs_batch_features", False)
+               for l in m.listeners):
+            m._last_batch_features = ds.features
+
     # ------------------------------------------------------------------
     # allreduce mode (north star)
     # ------------------------------------------------------------------
@@ -103,30 +115,30 @@ class ParallelWrapper:
         m = self.model
         step = m._get_train_step(False)
         rng = m._next_rng()
-        if any(getattr(l, "needs_batch_features", False)
-               for l in m.listeners):
-            m._last_batch_features = ds.features  # for viz listeners
-        x = self._shard_batch(ds.features)
-        y = self._shard_batch(ds.labels)
-        fmask = None if ds.features_mask is None else self._shard_batch(ds.features_mask)
-        lmask = None if ds.labels_mask is None else self._shard_batch(ds.labels_mask)
-        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-        if isinstance(m, MultiLayerNetwork):
-            m.params, m.state, m.updater_state, loss = step(
-                m.params, m.state, m.updater_state, x, y, rng, fmask, lmask)
-        else:
-            inputs = {m.conf.network_inputs[0]: x}
-            labels = {m.conf.network_outputs[0]: y}
-            fmasks = None if fmask is None else {m.conf.network_inputs[0]: fmask}
-            lmasks = None if lmask is None else {m.conf.network_outputs[0]: lmask}
-            m.params, m.state, m.updater_state, loss = step(
-                m.params, m.state, m.updater_state, inputs, labels, rng,
-                fmasks, lmasks)
-        m.score_value = float(loss)
-        for lst in m.listeners:
-            if hasattr(lst, "record_batch"):
-                lst.record_batch(ds.num_examples())
-            lst.iteration_done(m, m.iteration_count, m.score_value)
+        self._stash_batch_for_viz(ds)
+        with self._timer("step"):
+            x = self._shard_batch(ds.features)
+            y = self._shard_batch(ds.labels)
+            fmask = None if ds.features_mask is None else self._shard_batch(ds.features_mask)
+            lmask = None if ds.labels_mask is None else self._shard_batch(ds.labels_mask)
+            from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+            if isinstance(m, MultiLayerNetwork):
+                m.params, m.state, m.updater_state, loss = step(
+                    m.params, m.state, m.updater_state, x, y, rng, fmask, lmask)
+            else:
+                inputs = {m.conf.network_inputs[0]: x}
+                labels = {m.conf.network_outputs[0]: y}
+                fmasks = None if fmask is None else {m.conf.network_inputs[0]: fmask}
+                lmasks = None if lmask is None else {m.conf.network_outputs[0]: lmask}
+                m.params, m.state, m.updater_state, loss = step(
+                    m.params, m.state, m.updater_state, inputs, labels, rng,
+                    fmasks, lmasks)
+            m.score_value = float(loss)
+        with self._timer("listener"):
+            for lst in m.listeners:
+                if hasattr(lst, "record_batch"):
+                    lst.record_batch(ds.num_examples())
+                lst.iteration_done(m, m.iteration_count, m.score_value)
         m.iteration_count += 1
 
     # ------------------------------------------------------------------
@@ -189,6 +201,7 @@ class ParallelWrapper:
         """Consume `averaging_frequency * n_devices` microbatches as one
         round (ref: ParameterAveragingTrainingMaster split sizing :287-298)."""
         m = self.model
+        self._stash_batch_for_viz(batches[-1])
         freq = len(batches) // self.n_devices
         xs = np.stack([np.stack([b.features for b in
                                  batches[f * self.n_devices:(f + 1) * self.n_devices]],
@@ -203,13 +216,15 @@ class ParallelWrapper:
         rngs = np.asarray(jax.random.split(m._next_rng(), freq * self.n_devices))
         rngs = rngs.reshape(freq, self.n_devices, -1)
         step = self._get_averaging_step()
-        m.state = _strip_rnn_state(m.state)
-        m.params, m.state, m.updater_state, loss = step(
-            m.params, m.state, m.updater_state, jnp.asarray(xs), jnp.asarray(ys),
-            jnp.asarray(rngs))
-        m.score_value = float(loss)
-        for lst in m.listeners:
-            lst.iteration_done(m, m.iteration_count, m.score_value)
+        with self._timer("step"):
+            m.state = _strip_rnn_state(m.state)
+            m.params, m.state, m.updater_state, loss = step(
+                m.params, m.state, m.updater_state, jnp.asarray(xs),
+                jnp.asarray(ys), jnp.asarray(rngs))
+            m.score_value = float(loss)
+        with self._timer("listener"):
+            for lst in m.listeners:
+                lst.iteration_done(m, m.iteration_count, m.score_value)
         m.iteration_count += freq
 
     # ------------------------------------------------------------------
@@ -225,12 +240,6 @@ class ParallelWrapper:
         else:
             it = data
 
-        from contextlib import nullcontext
-
-        def timer(phase):  # no-op when stats are off — single shared loop
-            return self.stats.time_phase(phase) if self.stats is not None \
-                else nullcontext()
-
         for _ in range(epochs):
             src = AsyncDataSetIterator(it, prefetch=self.prefetch_buffer) \
                 if self.prefetch_buffer else it
@@ -239,22 +248,19 @@ class ParallelWrapper:
             pend = []
             src_it = iter(src)
             while True:
-                with timer("etl"):
+                with self._timer("etl"):
                     ds = next(src_it, None)
                 if ds is None:
                     break
                 if averaging:
                     pend.append(ds)
                     if len(pend) == round_size:
-                        with timer("step"):
-                            self._fit_round_averaging(pend)
+                        self._fit_round_averaging(pend)  # times itself
                         pend = []
                 else:
-                    with timer("step"):
-                        self._fit_batch_allreduce(ds)
+                    self._fit_batch_allreduce(ds)  # times itself
             # trailing partial averaging round: fall back to allreduce steps
             for ds in pend:
-                with timer("step"):
-                    self._fit_batch_allreduce(ds)
+                self._fit_batch_allreduce(ds)
             m.epoch_count += 1
         return m

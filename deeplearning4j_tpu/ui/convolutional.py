@@ -61,7 +61,7 @@ class ConvolutionalIterationListener(TrainingListener):
         self.frequency = max(1, frequency)
         self.max_channels = max_channels
         os.makedirs(output_dir, exist_ok=True)
-        self._last_input = None
+        self._warned = False
 
     def iteration_done(self, model, iteration: int, score: float):
         if iteration % self.frequency != 0:
@@ -77,13 +77,16 @@ class ConvolutionalIterationListener(TrainingListener):
                 Image.fromarray(grid, mode="L").save(os.path.join(
                     self.output_dir, f"it{iteration}_layer{li}.png"))
         except Exception as e:  # noqa: BLE001 - visualization must not kill fit
-            log.debug("conv listener skipped: %s", e)
+            if not self._warned:  # surface the reason once, then go quiet
+                log.warning("ConvolutionalIterationListener disabled: %s", e)
+                self._warned = True
+            else:
+                log.debug("conv listener skipped: %s", e)
 
     @staticmethod
     def _conv_activations(model, x) -> List:
         """(layer index, [C,H,W]) for each 4-D activation."""
-        acts, _ = model._forward(model.params, model.state, x,
-                                 train=False, rng=None)
+        acts = model.feed_forward(x, train=False)
         out = []
         for i, a in enumerate(acts):
             a = np.asarray(a)

@@ -1,0 +1,73 @@
+"""BASELINE.md config integration tests at test scale (SURVEY §6):
+config[0] LeNet MNIST through the full pipeline; config[4]
+ParallelWrapper CNN across the 8-device mesh vs single device."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import MnistDataSetIterator
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+from deeplearning4j_tpu.eval.evaluation import Evaluation
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+from deeplearning4j_tpu.zoo import LeNet
+
+
+class TestBaselineLeNetMnist:
+    def test_full_pipeline_learns(self):
+        """fetcher → iterator → LeNet fit → evaluate (BASELINE config[0]).
+        Synthetic MNIST plants a class-dependent mean, so a working
+        pipeline must beat chance clearly."""
+        train = MnistDataSetIterator(64, train=True, synthetic=True,
+                                     num_examples=512, flatten=False)
+        net = LeNet(num_classes=10, height=28, width=28).init()
+        net.fit(train, epochs=6)
+        test_it = MnistDataSetIterator(64, train=False, synthetic=True,
+                                       num_examples=256, flatten=False,
+                                       seed=999)
+        ev = Evaluation(num_classes=10)
+        for b in test_it:
+            preds = np.asarray(net.output(b.features))
+            ev.eval(b.labels, preds)
+        assert ev.accuracy() > 0.2, f"accuracy {ev.accuracy()}"  # 10% = chance
+        assert np.isfinite(net.score_value)
+
+
+class TestBaselineParallelCnn:
+    def test_mesh_training_matches_single_device(self):
+        """BASELINE config[4] invariant at test scale (the
+        TestCompareParameterAveragingSparkVsSingleMachine pattern):
+        8-shard allreduce step == single-device step on the same batch."""
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((16, 1, 16, 16)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 16)]
+
+        net_a = LeNet(num_classes=10, height=16, width=16).init()
+        net_b = LeNet(num_classes=10, height=16, width=16).init()
+        # identical init (same seed)
+        for k in net_a.params:
+            for pk in net_a.params[k]:
+                np.testing.assert_allclose(np.asarray(net_a.params[k][pk]),
+                                           np.asarray(net_b.params[k][pk]))
+
+        net_a._fit_batch(DataSet(x, y))
+        pw = ParallelWrapper(net_b, prefetch_buffer=0)
+        pw._fit_batch_allreduce(DataSet(x, y))
+
+        out_a = np.asarray(net_a.output(x))
+        out_b = np.asarray(net_b.output(x))
+        np.testing.assert_allclose(out_a, out_b, atol=1e-4, rtol=1e-4)
+
+    def test_mesh_cnn_trains(self):
+        rng = np.random.default_rng(1)
+        n = 64
+        x = rng.standard_normal((n, 1, 16, 16)).astype(np.float32)
+        labels = (x.mean(axis=(1, 2, 3)) > 0).astype(int)
+        y = np.eye(10, dtype=np.float32)[labels]
+        net = LeNet(num_classes=10, height=16, width=16).init()
+        pw = ParallelWrapper(net, prefetch_buffer=0, collect_stats=True)
+        it = ArrayDataSetIterator(x, y, batch_size=16)
+        pw.fit(it, epochs=12)
+        acc = (np.asarray(net.output(x)).argmax(1) == labels).mean()
+        assert acc > 0.7, acc
+        assert pw.stats.summary()["step"]["count"] == 48

@@ -165,6 +165,7 @@ class TestConvolutionalListener:
         assert grid.shape == (3 * 6 - 1, 3 * 5 - 1)
 
     def test_writes_pngs_during_training(self, tmp_path):
+        pytest.importorskip("PIL")
         import os
         from deeplearning4j_tpu.ui.convolutional import (
             ConvolutionalIterationListener)
