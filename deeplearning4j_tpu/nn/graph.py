@@ -312,6 +312,35 @@ class ComputationGraph:
             e.eval(ds.labels, np.asarray(out), mask=ds.labels_mask)
         return e
 
+
+    def rnn_time_step(self, *inputs):
+        """Stateful streaming inference over the graph, carrying RNN h/c in
+        self.state across calls (ref: ComputationGraph.rnnTimeStep)."""
+        key = ("rnn_step",)
+        if key not in self._jit_cache:
+            def fwd(params, state, ins, rng):
+                acts, new_state, _ = self._forward(params, state, ins,
+                                                   train=False, rng=rng,
+                                                   carry_rnn=True)
+                return [acts[o] for o in self.conf.network_outputs], new_state
+
+            self._jit_cache[key] = jax.jit(fwd)
+        if len(inputs) == 1 and isinstance(inputs[0], dict):
+            ins = self._as_input_dict(inputs[0])
+        else:
+            ins = self._as_input_dict(list(inputs))
+        outs, new_state = self._jit_cache[key](self.params, self.state, ins,
+                                               jax.random.PRNGKey(0))
+        self.state = new_state
+        return outs[0] if len(outs) == 1 else outs
+
+    def rnn_clear_previous_state(self):
+        """ref: ComputationGraph.rnnClearPreviousState."""
+        for k, s in self.state.items():
+            if isinstance(s, dict):
+                self.state[k] = {kk: vv for kk, vv in s.items()
+                                 if kk not in ("h", "c")}
+
     def summary(self) -> str:
         self._infer_types()
         lines = ["=" * 80,
@@ -331,3 +360,4 @@ class ComputationGraph:
         lines.append(f"Total params: {total}")
         lines.append("=" * 80)
         return "\n".join(lines)
+

@@ -188,3 +188,38 @@ class TestPersistence:
         s = net.summary()
         assert "DenseLayer" in s and "Total params" in s
         assert net.num_params() == 2 * 16 + 16 + 16 * 16 + 16 + 16 * 2 + 2
+
+
+class TestComputationGraphRnnTimeStep:
+    def test_streaming_matches_full_sequence(self):
+        """CG rnn_time_step over split chunks == one full-sequence output
+        (ref: ComputationGraph rnnTimeStep semantics)."""
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.conf import ComputationGraphConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import LSTM, RnnOutputLayer
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        conf = (ComputationGraphConfiguration.GraphBuilder()
+                .add_inputs("in")
+                .add_layer("lstm", LSTM(n_out=6), "in")
+                .add_layer("out", RnnOutputLayer(n_out=2, loss="mcxent",
+                                                 activation="softmax"),
+                           "lstm")
+                .set_outputs("out")
+                .set_input_types(InputType.recurrent(3, 8))
+                .build())
+        net = ComputationGraph(conf).init()
+        x = RNG.standard_normal((2, 3, 8)).astype(np.float32)
+        full = np.asarray(net.output(x))
+
+        net.rnn_clear_previous_state()
+        o1 = np.asarray(net.rnn_time_step(x[:, :, :5]))
+        o2 = np.asarray(net.rnn_time_step(x[:, :, 5:]))
+        stream = np.concatenate([o1, o2], axis=-1)
+        np.testing.assert_allclose(stream, full, atol=1e-5, rtol=1e-5)
+
+        # clearing state resets the stream
+        net.rnn_clear_previous_state()
+        o1b = np.asarray(net.rnn_time_step(x[:, :, :5]))
+        np.testing.assert_allclose(o1b, o1, atol=1e-6)
