@@ -14,43 +14,53 @@ from typing import List, Optional
 import numpy as np
 
 
+def _sel(x, idx):
+    """Index rows; dict-aware (ComputationGraph dict-keyed arrays)."""
+    if x is None:
+        return None
+    if isinstance(x, dict):
+        return {k: v[idx] for k, v in x.items()}
+    return x[idx]
+
+
 @dataclass
 class DataSet:
+    """features/labels are arrays, or dicts keyed by input/output name for
+    ComputationGraph multi-input/-output batches."""
+
     features: np.ndarray
     labels: Optional[np.ndarray] = None
     features_mask: Optional[np.ndarray] = None
     labels_mask: Optional[np.ndarray] = None
 
     def num_examples(self) -> int:
-        return int(self.features.shape[0])
+        f = self.features
+        if isinstance(f, dict):
+            f = next(iter(f.values()))
+        return int(f.shape[0])
 
     def split_test_and_train(self, n_train: int):
-        a = DataSet(self.features[:n_train],
-                    None if self.labels is None else self.labels[:n_train])
-        b = DataSet(self.features[n_train:],
-                    None if self.labels is None else self.labels[n_train:])
+        a = DataSet(_sel(self.features, slice(None, n_train)),
+                    _sel(self.labels, slice(None, n_train)))
+        b = DataSet(_sel(self.features, slice(n_train, None)),
+                    _sel(self.labels, slice(n_train, None)))
         return a, b
 
     def shuffle(self, seed: Optional[int] = None):
         rng = np.random.default_rng(seed)
         idx = rng.permutation(self.num_examples())
-        self.features = self.features[idx]
-        if self.labels is not None:
-            self.labels = self.labels[idx]
-        if self.features_mask is not None:
-            self.features_mask = self.features_mask[idx]
-        if self.labels_mask is not None:
-            self.labels_mask = self.labels_mask[idx]
+        self.features = _sel(self.features, idx)
+        self.labels = _sel(self.labels, idx)
+        self.features_mask = _sel(self.features_mask, idx)
+        self.labels_mask = _sel(self.labels_mask, idx)
 
     def batch_by(self, batch_size: int):
         n = self.num_examples()
         for s in range(0, n, batch_size):
-            yield DataSet(
-                self.features[s:s + batch_size],
-                None if self.labels is None else self.labels[s:s + batch_size],
-                None if self.features_mask is None else self.features_mask[s:s + batch_size],
-                None if self.labels_mask is None else self.labels_mask[s:s + batch_size],
-            )
+            sl = slice(s, s + batch_size)
+            yield DataSet(_sel(self.features, sl), _sel(self.labels, sl),
+                          _sel(self.features_mask, sl),
+                          _sel(self.labels_mask, sl))
 
 
 @dataclass

@@ -33,23 +33,47 @@ class DataSetIterator:
         raise NotImplementedError
 
 
+def _as_arrays(x):
+    """np.asarray, mapped over dicts (MultiDataSet-style named arrays)."""
+    if x is None:
+        return None
+    if isinstance(x, dict):
+        return {k: np.asarray(v) for k, v in x.items()}
+    return np.asarray(x)
+
+
+def _take(x, sel):
+    if x is None:
+        return None
+    if isinstance(x, dict):
+        return {k: v[sel] for k, v in x.items()}
+    return x[sel]
+
+
+def _num_examples(x):
+    if isinstance(x, dict):
+        return next(iter(x.values())).shape[0]
+    return x.shape[0]
+
+
 class ArrayDataSetIterator(DataSetIterator):
-    """Batches over in-memory arrays."""
+    """Batches over in-memory arrays. Features/labels may be dicts keyed by
+    input/output name (ComputationGraph MultiDataSet equivalent)."""
 
     def __init__(self, features, labels=None, batch_size: int = 32,
                  features_mask=None, labels_mask=None, shuffle: bool = False,
                  seed: int = 0):
-        self.features = np.asarray(features)
-        self.labels = None if labels is None else np.asarray(labels)
-        self.features_mask = None if features_mask is None else np.asarray(features_mask)
-        self.labels_mask = None if labels_mask is None else np.asarray(labels_mask)
+        self.features = _as_arrays(features)
+        self.labels = _as_arrays(labels)
+        self.features_mask = _as_arrays(features_mask)
+        self.labels_mask = _as_arrays(labels_mask)
         self.batch_size = batch_size
         self.shuffle = shuffle
         self._seed = seed
         self._epoch = 0
 
     def __iter__(self):
-        n = self.features.shape[0]
+        n = _num_examples(self.features)
         idx = np.arange(n)
         if self.shuffle:
             rng = np.random.default_rng(self._seed + self._epoch)
@@ -58,10 +82,10 @@ class ArrayDataSetIterator(DataSetIterator):
         for s in range(0, n, self.batch_size):
             sel = idx[s:s + self.batch_size]
             yield DataSet(
-                self.features[sel],
-                None if self.labels is None else self.labels[sel],
-                None if self.features_mask is None else self.features_mask[sel],
-                None if self.labels_mask is None else self.labels_mask[sel],
+                _take(self.features, sel),
+                _take(self.labels, sel),
+                _take(self.features_mask, sel),
+                _take(self.labels_mask, sel),
             )
 
 
@@ -91,25 +115,45 @@ class AsyncDataSetIterator(DataSetIterator):
     def __iter__(self):
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         err: List[BaseException] = []
+        stop = threading.Event()
 
         def worker():
             try:
                 for ds in self.base:
-                    q.put(ds)
+                    # bounded put with a stop check so an abandoned consumer
+                    # (e.g. early-termination break) can't pin the producer
+                    # on a full queue forever
+                    while not stop.is_set():
+                        try:
+                            q.put(ds, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
             except BaseException as e:  # surface worker errors to consumer
                 err.append(e)
             finally:
-                q.put(self._SENTINEL)
+                while not stop.is_set():
+                    try:
+                        q.put(self._SENTINEL, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is self._SENTINEL:
-                if err:
-                    raise err[0]
-                return
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is self._SENTINEL:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            # generator closed (break/GC): release the producer thread
+            stop.set()
 
 
 class MultipleEpochsIterator(DataSetIterator):

@@ -215,6 +215,20 @@ class KerasLayerMapper:
         return L.Upsampling2DLayer(size=_pair(c.get("size", (2, 2))),
                                    name=c.get("name"))
 
+    def _map_zeropadding1d(self, c):
+        p = c.get("padding", 1)
+        if isinstance(p, (list, tuple)):
+            pads = (int(p[0]), int(p[1] if len(p) > 1 else p[0]))
+        else:
+            pads = (int(p), int(p))
+        return L.ZeroPadding1DLayer(padding=pads, name=c.get("name"))
+
+    def _map_upsampling1d(self, c):
+        size = c.get("size", c.get("length", 2))
+        if isinstance(size, (list, tuple)):
+            size = size[0]
+        return L.Upsampling1DLayer(size=int(size), name=c.get("name"))
+
     # --- norm ---
     def _map_batchnormalization(self, c):
         return L.BatchNormalization(eps=float(c.get("epsilon", 1e-3)),

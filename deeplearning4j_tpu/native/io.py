@@ -37,7 +37,10 @@ def _load() -> Optional[ctypes.CDLL]:
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_SO_PATH) and not _build_attempted:
+        src = os.path.join(_NATIVE_DIR, "src", "io.cpp")
+        stale = (os.path.exists(_SO_PATH) and os.path.exists(src)
+                 and os.path.getmtime(src) > os.path.getmtime(_SO_PATH))
+        if (not os.path.exists(_SO_PATH) or stale) and not _build_attempted:
             _build_attempted = True
             try:
                 subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
@@ -45,7 +48,8 @@ def _load() -> Optional[ctypes.CDLL]:
             except Exception as e:  # noqa: BLE001
                 log.info("native build unavailable (%s); using numpy "
                          "fallbacks", e)
-                return None
+                if not os.path.exists(_SO_PATH):
+                    return None
         if not os.path.exists(_SO_PATH):
             return None
         try:

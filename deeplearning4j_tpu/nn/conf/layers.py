@@ -485,6 +485,49 @@ class Upsampling2DLayer(LayerConf):
 
 @register_layer
 @dataclass
+class Upsampling1DLayer(LayerConf):
+    """Nearest-neighbour upsampling along time, [N, C, T] → [N, C, T*size]
+    (ref: conf/layers/Upsampling1D.java; Keras UpSampling1D)."""
+
+    size: int = 2
+
+    def output_type(self, it):
+        t = it.timesteps * self.size if it.timesteps is not None else None
+        return InputType.recurrent(it.size, t)
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        return jnp.repeat(x, self.size, axis=2), state
+
+
+@register_layer
+@dataclass
+class ZeroPadding1DLayer(LayerConf):
+    """Zero padding along time, [N, C, T] → [N, C, left+T+right]
+    (ref: conf/layers/ZeroPadding1DLayer.java; Keras ZeroPadding1D)."""
+
+    padding: Sequence[int] = (1, 1)  # (left, right); int means symmetric
+
+    def _pads(self):
+        p = self.padding
+        if isinstance(p, int):
+            return (p, p)
+        p = list(p)
+        if len(p) == 1:
+            return (int(p[0]), int(p[0]))
+        return (int(p[0]), int(p[1]))
+
+    def output_type(self, it):
+        l, r = self._pads()
+        t = it.timesteps + l + r if it.timesteps is not None else None
+        return InputType.recurrent(it.size, t)
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        l, r = self._pads()
+        return jnp.pad(x, ((0, 0), (0, 0), (l, r))), state
+
+
+@register_layer
+@dataclass
 class ZeroPaddingLayer(LayerConf):
     """Zero padding [top, bottom, left, right] (ref: conf/layers/ZeroPaddingLayer.java)."""
 

@@ -47,7 +47,7 @@ _EXPECTS = {
             "Yolo2OutputLayer", "SpaceToDepthLayer"},
     "rnn": {"LSTM", "GravesLSTM", "GravesBidirectionalLSTM", "SimpleRnn",
             "RnnOutputLayer", "Convolution1DLayer", "Subsampling1DLayer",
-            "LastTimeStepLayer"},
+            "LastTimeStepLayer", "ZeroPadding1DLayer", "Upsampling1DLayer"},
 }
 
 

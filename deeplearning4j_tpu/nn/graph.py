@@ -94,9 +94,15 @@ class ComputationGraph:
     # ------------------------------------------------------------------
     def _forward(self, params, state, inputs: Dict[str, Any], *, train, rng,
                  fmasks: Optional[Dict[str, Any]] = None, carry_rnn=False,
-                 preout_of: Optional[str] = None):
+                 preout_of=None):
         """Topo-order forward (ref: feedForward :1361). Returns
-        (vertex_activations dict, new_state, masks dict)."""
+        (vertex_activations dict, new_state, masks dict). `preout_of` is a
+        vertex name or a collection of names whose output layers should
+        yield pre-activation outputs — the loss computes every output's
+        preout in this ONE pass (ref: computeGradientAndScore :1298 runs a
+        single feedForward for all outputs)."""
+        preout_set = ({preout_of} if isinstance(preout_of, str)
+                      else set(preout_of or ()))
         acts: Dict[str, Any] = dict(inputs)
         masks: Dict[str, Any] = dict(fmasks or {})
         new_state: Dict[str, Any] = {}
@@ -110,7 +116,7 @@ class ComputationGraph:
             if not carry_rnn:
                 v_state = {k: val for k, val in v_state.items() if k not in ("h", "c")}
             rng_i = jax.random.fold_in(rng, i) if rng is not None else None
-            if preout_of == name and isinstance(v, LayerVertex) and \
+            if name in preout_set and isinstance(v, LayerVertex) and \
                     hasattr(v.layer, "compute_score"):
                 x = xs[0]
                 if v.preprocessor is not None:
@@ -142,13 +148,14 @@ class ComputationGraph:
                 if jnp.issubdtype(a.dtype, jnp.floating) else a
             params = jax.tree_util.tree_map(cast, params)
             inputs = {k: cast(v) for k, v in inputs.items()}
-        # find features feeding each output layer by running forward with preout
+        # ONE forward pass yields every output layer's preout (stateful
+        # vertices update exactly once per step, matching the reference's
+        # single feedForward in computeGradientAndScore :1298)
         total = 0.0
-        new_state = state
+        acts, new_state, masks = self._forward(
+            params, state, inputs, train=train, rng=rng, fmasks=fmasks,
+            carry_rnn=carry_rnn, preout_of=self.conf.network_outputs)
         for out_name in self.conf.network_outputs:
-            acts, new_state, masks = self._forward(
-                params, new_state, inputs, train=train, rng=rng, fmasks=fmasks,
-                carry_rnn=carry_rnn, preout_of=out_name)
             v = self.conf.vertices[out_name]
             if not (isinstance(v, LayerVertex) and
                     hasattr(v.layer, "compute_score")):

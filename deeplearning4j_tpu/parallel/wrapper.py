@@ -68,6 +68,8 @@ class ParallelWrapper:
         self.prefetch_buffer = prefetch_buffer
         self.n_devices = int(np.prod(self.mesh.devices.shape))
         self._jit_cache: Dict[Any, Any] = {}
+        self._warned_small_batch = False
+        self._warned_remainder_drop = False
         # phase timing (ref: CommonSparkTrainingStats role)
         self.stats = None
         if collect_stats:
@@ -78,16 +80,45 @@ class ParallelWrapper:
 
     # ------------------------------------------------------------------
     def _shard_batch(self, arr):
-        """Pad batch to a multiple of n_devices and device_put sharded on
-        the data axis."""
+        """Make the batch divisible by n_devices and device_put sharded on
+        the data axis. Non-divisible remainders are DROPPED (the reference
+        drops/queues leftovers rather than duplicating examples —
+        duplicate-padding would silently over-weight the repeated sample in
+        the gradient). Batches smaller than the mesh still pad by repetition
+        as the only way to occupy every device; that case is logged once."""
         arr = np.asarray(arr)
         n = arr.shape[0]
         rem = n % self.n_devices
         if rem:
-            pad = self.n_devices - rem
-            arr = np.concatenate([arr, np.repeat(arr[-1:], pad, axis=0)], axis=0)
+            if n >= self.n_devices:
+                if not self._warned_remainder_drop:
+                    log.warning(
+                        "batch of %d not divisible by %d devices: dropping "
+                        "the %d trailing example(s) each step (size batches "
+                        "to a multiple of the mesh to use all data)",
+                        n, self.n_devices, rem)
+                    self._warned_remainder_drop = True
+                arr = arr[:n - rem]
+            else:
+                if not self._warned_small_batch:
+                    log.warning(
+                        "batch of %d < %d devices: padding by repetition "
+                        "(repeated examples are over-weighted this step)",
+                        n, self.n_devices)
+                    self._warned_small_batch = True
+                pad = self.n_devices - n
+                arr = np.concatenate(
+                    [arr, np.repeat(arr[-1:], pad, axis=0)], axis=0)
         sh = NamedSharding(self.mesh, P("data", *([None] * (arr.ndim - 1))))
         return jax.device_put(arr, sh)
+
+    def _effective_examples(self, ds: DataSet) -> int:
+        """Examples that actually contribute to the step after the
+        divisibility trim (listener stats must not count dropped rows)."""
+        n = ds.num_examples()
+        if n >= self.n_devices:
+            return (n // self.n_devices) * self.n_devices
+        return n
 
     def _replicate(self, tree):
         sh = NamedSharding(self.mesh, P())
@@ -137,7 +168,7 @@ class ParallelWrapper:
         with self._timer("listener"):
             for lst in m.listeners:
                 if hasattr(lst, "record_batch"):
-                    lst.record_batch(ds.num_examples())
+                    lst.record_batch(self._effective_examples(ds))
                 lst.iteration_done(m, m.iteration_count, m.score_value)
         m.iteration_count += 1
 

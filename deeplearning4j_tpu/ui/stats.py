@@ -70,6 +70,18 @@ class StatsReport:
                    samples_per_sec=d.get("samplesPerSec"))
 
 
+def _current_rss_mb() -> Optional[float]:
+    """Current (not peak) resident set size from /proc/self/status VmRSS."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return float(line.split()[1]) / 1024.0  # kB -> MB
+    except OSError:
+        pass
+    return None
+
+
 def _flatten_params(params, prefix="") -> Dict[str, np.ndarray]:
     out = {}
     if isinstance(params, dict):
@@ -131,11 +143,15 @@ class StatsListener(TrainingListener):
                     report.param_histograms[k] = {
                         "bins": [float(e) for e in edges],
                         "counts": [int(c) for c in counts]}
-        if resource is not None:
+        rss_mb = _current_rss_mb()
+        if rss_mb is None and resource is not None:
+            # fallback: peak RSS (never decreases) when /proc is unavailable
             rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
             # linux reports KiB, darwin reports bytes
             divisor = 1024.0 * 1024.0 if sys.platform == "darwin" else 1024.0
-            report.memory_rss_mb = rss / divisor
+            rss_mb = rss / divisor
+        if rss_mb is not None:
+            report.memory_rss_mb = rss_mb
         self.storage.put_update(report)
 
     @staticmethod

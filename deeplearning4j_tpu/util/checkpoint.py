@@ -75,8 +75,19 @@ def restore_checkpoint(net, path: str, step: Optional[int] = None):
     if not _HAVE_ORBAX:
         raise RuntimeError("orbax is not available")
     path = os.path.abspath(path)
-    step_dir = os.path.join(path, f"step_{step}" if step is not None
-                            else "latest")
+    if step is None:
+        # CheckpointListener writes only step_N dirs; fall back to the
+        # newest one when no explicit "latest" dir exists
+        latest = os.path.join(path, "latest")
+        if os.path.exists(latest):
+            step_dir = latest
+        else:
+            steps = list_checkpoints(path)
+            if not steps:
+                raise FileNotFoundError(f"no checkpoints under {path}")
+            step_dir = os.path.join(path, f"step_{steps[-1]}")
+    else:
+        step_dir = os.path.join(path, f"step_{step}")
     with ocp.PyTreeCheckpointer() as ckptr:
         restored = ckptr.restore(step_dir, _net_state_tree(net))
     net.params = restored["params"]

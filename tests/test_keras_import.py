@@ -262,3 +262,211 @@ class TestFunctionalImport:
         expected = (np.maximum(x @ w1, 0) + np.tanh(x @ w2)) @ w3
         got = np.asarray(net.output(x))
         np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+class Test1DLayers:
+    def test_zeropadding1d_and_upsampling1d_import(self):
+        """ZeroPadding1D / UpSampling1D are in the reference's supported set
+        (KerasLayerConfiguration.java:52,70)."""
+        w = RNG.standard_normal((3, 4, 5)).astype(np.float32)  # [k, cin, cout]
+        cfg = seq_config([
+            {"class_name": "ZeroPadding1D",
+             "config": {"name": "zp", "padding": [2, 1],
+                        "batch_input_shape": [None, 6, 4]}},
+            {"class_name": "UpSampling1D",
+             "config": {"name": "up", "size": 2}},
+            {"class_name": "Conv1D",
+             "config": {"name": "c1", "filters": 5, "kernel_size": [3],
+                        "strides": [1], "padding": "valid",
+                        "activation": "identity", "use_bias": False}},
+            {"class_name": "GlobalMaxPooling1D", "config": {"name": "gmp"}},
+            {"class_name": "Dense",
+             "config": {"name": "d", "units": 2, "activation": "identity",
+                        "use_bias": False}},
+        ])
+        wd = RNG.standard_normal((5, 2)).astype(np.float32)
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "m1d.h5")
+            write_keras_h5(path, cfg, {
+                "c1": [("kernel:0", w)],
+                "d": [("kernel:0", wd)],
+            })
+            net = KerasModelImport.import_keras_model_and_weights(path)
+
+        x = RNG.standard_normal((2, 6, 4)).astype(np.float32)  # NWC (Keras)
+        # numpy reference in Keras NWC semantics
+        xp = np.pad(x, ((0, 0), (2, 1), (0, 0)))
+        xu = np.repeat(xp, 2, axis=1)
+        T = xu.shape[1] - 2
+        conv = np.zeros((2, T, 5))
+        for t in range(T):
+            conv[:, t] = np.tensordot(xu[:, t:t + 3, :], w,
+                                      axes=([1, 2], [0, 1]))
+        want = conv.max(axis=1) @ wd
+        got = np.asarray(net.output(np.transpose(x, (0, 2, 1))))  # ours NCW
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def _iv3_config_and_weights(classes=10):
+    """Programmatic InceptionV3 functional graph (the real topology from the
+    Keras application: 94 conv/BN pairs, 11 mixed concat blocks) with random
+    weights — BASELINE config[3]'s import shape, generated in-process since
+    the environment has no egress for the real .h5."""
+    layers = []
+    weights = {}
+    counter = {"n": 0}
+
+    def conv_bn(x_name, cout, kh, kw, stride=1, padding="valid"):
+        i = counter["n"]; counter["n"] += 1
+        cname, bname, aname = f"conv{i}", f"bn{i}", f"act{i}"
+        layers.append({"class_name": "Conv2D", "name": cname,
+                       "config": {"name": cname, "filters": cout,
+                                  "kernel_size": [kh, kw],
+                                  "strides": [stride, stride],
+                                  "padding": padding, "use_bias": False,
+                                  "activation": "identity"},
+                       "inbound_nodes": [[[x_name, 0, 0, {}]]]})
+        cin = _iv3_channels[x_name]
+        weights[cname] = [("kernel:0",
+                           (RNG.standard_normal((kh, kw, cin, cout)) *
+                            0.05).astype(np.float32))]
+        layers.append({"class_name": "BatchNormalization", "name": bname,
+                       "config": {"name": bname, "epsilon": 1e-3,
+                                  "momentum": 0.99, "scale": False},
+                       "inbound_nodes": [[[cname, 0, 0, {}]]]})
+        weights[bname] = [
+            ("beta:0", np.zeros(cout, np.float32)),
+            ("moving_mean:0", np.zeros(cout, np.float32)),
+            ("moving_variance:0", np.ones(cout, np.float32))]
+        layers.append({"class_name": "Activation", "name": aname,
+                       "config": {"name": aname, "activation": "relu"},
+                       "inbound_nodes": [[[bname, 0, 0, {}]]]})
+        for n in (cname, bname, aname):
+            _iv3_channels[n] = cout
+        return aname
+
+    def pool(x_name, kind, size, stride, padding="valid"):
+        i = counter["n"]; counter["n"] += 1
+        name = f"pool{i}"
+        layers.append({"class_name": kind, "name": name,
+                       "config": {"name": name, "pool_size": [size, size],
+                                  "strides": [stride, stride],
+                                  "padding": padding},
+                       "inbound_nodes": [[[x_name, 0, 0, {}]]]})
+        _iv3_channels[name] = _iv3_channels[x_name]
+        return name
+
+    def concat(names):
+        i = counter["n"]; counter["n"] += 1
+        name = f"mixed{i}"
+        layers.append({"class_name": "Concatenate", "name": name,
+                       "config": {"name": name, "axis": -1},
+                       "inbound_nodes": [[[n, 0, 0, {}] for n in names]]})
+        _iv3_channels[name] = sum(_iv3_channels[n] for n in names)
+        return name
+
+    _iv3_channels = {"in": 3}
+    layers.append({"class_name": "InputLayer", "name": "in",
+                   "config": {"name": "in",
+                              "batch_input_shape": [None, 75, 75, 3]},
+                   "inbound_nodes": []})
+
+    x = conv_bn("in", 32, 3, 3, stride=2)
+    x = conv_bn(x, 32, 3, 3)
+    x = conv_bn(x, 64, 3, 3, padding="same")
+    x = pool(x, "MaxPooling2D", 3, 2)
+    x = conv_bn(x, 80, 1, 1)
+    x = conv_bn(x, 192, 3, 3)
+    x = pool(x, "MaxPooling2D", 3, 2)
+
+    # mixed 0..2 (35x35 blocks)
+    for pool_ch in (32, 64, 64):
+        b1 = conv_bn(x, 64, 1, 1, padding="same")
+        b5 = conv_bn(conv_bn(x, 48, 1, 1, padding="same"), 64, 5, 5,
+                     padding="same")
+        b3 = conv_bn(conv_bn(conv_bn(x, 64, 1, 1, padding="same"),
+                             96, 3, 3, padding="same"), 96, 3, 3,
+                     padding="same")
+        bp = conv_bn(pool(x, "AveragePooling2D", 3, 1, "same"),
+                     pool_ch, 1, 1, padding="same")
+        x = concat([b1, b5, b3, bp])
+
+    # mixed 3 (reduce to 17x17)
+    b3 = conv_bn(x, 384, 3, 3, stride=2)
+    bd = conv_bn(conv_bn(conv_bn(x, 64, 1, 1, padding="same"),
+                         96, 3, 3, padding="same"), 96, 3, 3, stride=2)
+    x = concat([b3, bd, pool(x, "MaxPooling2D", 3, 2)])
+
+    # mixed 4..7 (17x17 factorized-7x7 blocks)
+    for c7 in (128, 160, 160, 192):
+        b1 = conv_bn(x, 192, 1, 1, padding="same")
+        b7 = conv_bn(conv_bn(conv_bn(x, c7, 1, 1, padding="same"),
+                             c7, 1, 7, padding="same"), 192, 7, 1,
+                     padding="same")
+        bd = conv_bn(conv_bn(conv_bn(conv_bn(conv_bn(
+            x, c7, 1, 1, padding="same"), c7, 7, 1, padding="same"),
+            c7, 1, 7, padding="same"), c7, 7, 1, padding="same"),
+            192, 1, 7, padding="same")
+        bp = conv_bn(pool(x, "AveragePooling2D", 3, 1, "same"),
+                     192, 1, 1, padding="same")
+        x = concat([b1, b7, bd, bp])
+
+    # mixed 8 (reduce to 8x8)
+    b3 = conv_bn(conv_bn(x, 192, 1, 1, padding="same"), 320, 3, 3, stride=2)
+    b7 = conv_bn(conv_bn(conv_bn(conv_bn(x, 192, 1, 1, padding="same"),
+                                 192, 1, 7, padding="same"),
+                         192, 7, 1, padding="same"), 192, 3, 3, stride=2)
+    x = concat([b3, b7, pool(x, "MaxPooling2D", 3, 2)])
+
+    # mixed 9,10 (8x8 expanded-filter blocks)
+    for _ in range(2):
+        b1 = conv_bn(x, 320, 1, 1, padding="same")
+        b3a = conv_bn(x, 384, 1, 1, padding="same")
+        b3 = concat([conv_bn(b3a, 384, 1, 3, padding="same"),
+                     conv_bn(b3a, 384, 3, 1, padding="same")])
+        bda = conv_bn(conv_bn(x, 448, 1, 1, padding="same"),
+                      384, 3, 3, padding="same")
+        bd = concat([conv_bn(bda, 384, 1, 3, padding="same"),
+                     conv_bn(bda, 384, 3, 1, padding="same")])
+        bp = conv_bn(pool(x, "AveragePooling2D", 3, 1, "same"),
+                     192, 1, 1, padding="same")
+        x = concat([b1, b3, bd, bp])
+
+    layers.append({"class_name": "GlobalAveragePooling2D", "name": "gap",
+                   "config": {"name": "gap"},
+                   "inbound_nodes": [[[x, 0, 0, {}]]]})
+    _iv3_channels["gap"] = _iv3_channels[x]
+    layers.append({"class_name": "Dense", "name": "preds",
+                   "config": {"name": "preds", "units": classes,
+                              "activation": "softmax", "use_bias": True},
+                   "inbound_nodes": [[["gap", 0, 0, {}]]]})
+    weights["preds"] = [
+        ("kernel:0", (RNG.standard_normal((_iv3_channels["gap"], classes)) *
+                      0.05).astype(np.float32)),
+        ("bias:0", np.zeros(classes, np.float32))]
+
+    cfg = {"class_name": "Model",
+           "config": {"name": "inception_v3", "layers": layers,
+                      "input_layers": [["in", 0, 0]],
+                      "output_layers": [["preds", 0, 0]]}}
+    return cfg, weights, _iv3_channels[x]
+
+
+class TestInceptionV3Scale:
+    def test_inceptionv3_functional_import(self):
+        """BASELINE config[3] shape: the full InceptionV3 topology (11 mixed
+        blocks, 94 conv/BN pairs, asymmetric 1x7/7x1 kernels, avg-pool
+        towers) through the functional importer, inference end to end."""
+        cfg, weights, final_ch = _iv3_config_and_weights(classes=10)
+        assert final_ch == 2048  # real InceptionV3 final concat width
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "iv3.h5")
+            write_keras_h5(path, cfg, weights)
+            net = KerasModelImport.import_keras_model_and_weights(path)
+        n_convs = sum(1 for v in net.conf.vertices if v.startswith("conv"))
+        assert n_convs == 94  # the real InceptionV3 conv count
+        x = RNG.standard_normal((1, 3, 75, 75)).astype(np.float32)
+        out = np.asarray(net.output(x))
+        assert out.shape == (1, 10)
+        assert np.all(np.isfinite(out))
+        np.testing.assert_allclose(out.sum(), 1.0, atol=1e-4)

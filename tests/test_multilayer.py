@@ -223,3 +223,76 @@ class TestComputationGraphRnnTimeStep:
         net.rnn_clear_previous_state()
         o1b = np.asarray(net.rnn_time_step(x[:, :, :5]))
         np.testing.assert_allclose(o1b, o1, atol=1e-6)
+
+
+class TestComputationGraphMultiOutput:
+    def test_single_forward_updates_bn_state_once(self):
+        """A 2-output CG with BatchNormalization in the shared trunk must run
+        ONE forward per train step (ref: ComputationGraph
+        computeGradientAndScore :1298) — the BN running mean after one step
+        equals exactly one EMA update, not one per output layer."""
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.conf import ComputationGraphConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (BatchNormalization,
+                                                       DenseLayer, OutputLayer)
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        conf = (ComputationGraphConfiguration.GraphBuilder()
+                .add_inputs("in")
+                .add_layer("trunk", DenseLayer(n_out=5, activation="identity"),
+                           "in")
+                .add_layer("bn", BatchNormalization(), "trunk")
+                .add_layer("outA", OutputLayer(n_out=2, loss="mcxent",
+                                               activation="softmax"), "bn")
+                .add_layer("outB", OutputLayer(n_out=3, loss="mcxent",
+                                               activation="softmax"), "bn")
+                .set_outputs("outA", "outB")
+                .set_input_types(InputType.feed_forward(4))
+                .build())
+        net = ComputationGraph(conf).init()
+
+        x = RNG.standard_normal((16, 4)).astype(np.float32)
+        ya = np.zeros((16, 2), np.float32); ya[:, 0] = 1
+        yb = np.zeros((16, 3), np.float32); yb[:, 1] = 1
+
+        # expected single-EMA update of the running mean from zeros
+        trunk_out = x @ np.asarray(net.params["trunk"]["W"]) + \
+            np.asarray(net.params["trunk"]["b"])
+        decay = conf.vertices["bn"].layer.decay
+        want_mean = (1.0 - decay) * trunk_out.mean(axis=0)
+
+        net._fit_batch(DataSet({"in": x}, {"outA": ya, "outB": yb}))
+        got_mean = np.asarray(net.state["bn"]["mean"])
+        np.testing.assert_allclose(got_mean, want_mean, rtol=1e-4, atol=1e-6)
+
+    def test_multi_output_losses_sum(self):
+        from deeplearning4j_tpu.nn.conf import ComputationGraphConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        conf = (ComputationGraphConfiguration.GraphBuilder()
+                .add_inputs("in")
+                .add_layer("trunk", DenseLayer(n_out=6), "in")
+                .add_layer("outA", OutputLayer(n_out=2, loss="mcxent",
+                                               activation="softmax"), "trunk")
+                .add_layer("outB", OutputLayer(n_out=2, loss="mse",
+                                               activation="identity"), "trunk")
+                .set_outputs("outA", "outB")
+                .set_input_types(InputType.feed_forward(3))
+                .build())
+        net = ComputationGraph(conf).init()
+        x = RNG.standard_normal((8, 3)).astype(np.float32)
+        ya = np.zeros((8, 2), np.float32); ya[:, 0] = 1
+        yb = RNG.standard_normal((8, 2)).astype(np.float32)
+        before = None
+        for _ in range(30):
+            net._fit_batch(DataSet({"in": x}, {"outA": ya, "outB": yb}))
+            if before is None:
+                before = net.score_value
+        assert net.score_value < before
+        outs = net.output({"in": x})
+        assert outs[0].shape == (8, 2) and outs[1].shape == (8, 2)
