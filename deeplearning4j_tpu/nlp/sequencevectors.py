@@ -42,12 +42,14 @@ def _ns_step(syn0, syn1neg, inputs, targets, labels, valid, lr):
     inputs [B] int32 — rows of syn0 (context words / doc vectors)
     targets [B,K1] int32 — col 0 = positive word, cols 1.. = negatives
     labels [B,K1] float32 — 1 for positive, 0 for negatives
-    valid [B] float32 — 0 for trailing pad rows (their update is zeroed).
+    valid [B] float32 — 0 for trailing pad rows (their update is zeroed)
+    lr [B] float32 — per-pair learning rate (pairs from different points of
+    the corpus share one device batch but keep their own decayed alpha).
     """
     l1 = syn0[inputs]                      # [B,D]
     w = syn1neg[targets]                   # [B,K1,D]
     f = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", l1, w))
-    g = (labels - f) * lr * valid[:, None]  # [B,K1]
+    g = (labels - f) * (lr * valid)[:, None]  # [B,K1]
     grad_l1 = jnp.einsum("bk,bkd->bd", g, w)
     grad_w = g[..., None] * l1[:, None, :]  # [B,K1,D]
     syn0 = syn0.at[inputs].add(grad_l1)
@@ -62,11 +64,12 @@ def _hs_step(syn0, syn1, inputs, points, codes, mask, lr):
 
     points [B,L] int32 — inner-node rows along the label word's huffman path
     codes [B,L] float32 — path bits; mask [B,L] zeroes padded path slots.
+    lr [B] float32 — per-pair learning rate.
     """
     l1 = syn0[inputs]                      # [B,D]
     w = syn1[points]                       # [B,L,D]
     f = jax.nn.sigmoid(jnp.einsum("bd,bld->bl", l1, w))
-    g = (1.0 - codes - f) * lr * mask      # [B,L]
+    g = (1.0 - codes - f) * lr[:, None] * mask  # [B,L]
     grad_l1 = jnp.einsum("bl,bld->bd", g, w)
     grad_w = g[..., None] * l1[:, None, :]
     syn0 = syn0.at[inputs].add(grad_l1)
@@ -83,7 +86,7 @@ def _cbow_ns_step(syn0, syn1neg, ctx, ctx_mask, targets, labels, valid, lr):
     l1 = vecs.sum(1) / denom                # [B,D]
     w = syn1neg[targets]                    # [B,K1,D]
     f = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", l1, w))
-    g = (labels - f) * lr * valid[:, None]
+    g = (labels - f) * (lr * valid)[:, None]
     grad_l1 = jnp.einsum("bk,bkd->bd", g, w) / denom   # distribute mean grad
     grad_w = g[..., None] * l1[:, None, :]
     grad_ctx = grad_l1[:, None, :] * ctx_mask[..., None]  # [B,C,D]
@@ -101,7 +104,7 @@ def _cbow_hs_step(syn0, syn1, ctx, ctx_mask, points, codes, mask, lr):
     l1 = vecs.sum(1) / denom
     w = syn1[points]
     f = jax.nn.sigmoid(jnp.einsum("bd,bld->bl", l1, w))
-    g = (1.0 - codes - f) * lr * mask
+    g = (1.0 - codes - f) * lr[:, None] * mask
     grad_l1 = jnp.einsum("bl,bld->bd", g, w) / denom
     grad_w = g[..., None] * l1[:, None, :]
     grad_ctx = grad_l1[:, None, :] * ctx_mask[..., None]
@@ -198,12 +201,20 @@ class SequenceVectors:
             labels_per_sequence: Optional[List[Sequence[str]]] = None,
             train_words: bool = True, train_labels: bool = False) -> None:
         """ref: SequenceVectors.fit :192. `labels_per_sequence` attaches doc
-        labels (ParagraphVectors DBOW/DM use them as extra input rows)."""
+        labels (ParagraphVectors DBOW/DM use them as extra input rows).
+
+        The reference dispatches one native op per (pair, thread) from a
+        worker pool (SequenceVectors.java:192 fit); here pairs ACCUMULATE
+        across sequences into fixed-shape device batches and one jit step
+        consumes each full batch — the device sees a few large dispatches
+        per epoch instead of one tiny dispatch per sentence."""
         if self.vocab is None:
             raise RuntimeError("call build_vocab first")
         seqs = sequences if isinstance(sequences, list) else list(sequences)
         total_words = sum(len(s) for s in seqs) * max(1, self.epochs)
         words_seen = 0
+        sg = self.algo == "skipgram"
+        buf = _BatchBuffer()
         for epoch in range(self.epochs):
             for si, seq in enumerate(seqs):
                 idxs = self._to_indices(seq)
@@ -217,12 +228,30 @@ class SequenceVectors:
                            for l in labels_per_sequence[si]
                            if self.vocab.index_of(l) >= 0]
                 for _ in range(self.iterations):
-                    if self.algo == "skipgram":
-                        self._train_skipgram(idxs, alpha, lbl,
-                                             train_words=train_words,
-                                             train_labels=train_labels)
+                    if sg:
+                        if train_words:
+                            ins, outs = self._pairs(idxs)
+                            buf.add_sg(ins, outs, alpha)
+                        if train_labels and lbl:
+                            li, lo = self._label_pairs(idxs, lbl)
+                            buf.add_sg(li, lo, alpha)
                     else:
-                        self._train_cbow(idxs, alpha, lbl)
+                        ctxs, cmask, centers = self._cbow_contexts(idxs, lbl)
+                        buf.add_cbow(ctxs, cmask, centers, alpha)
+                # dispatch every full batch currently buffered
+                if sg:
+                    for bi, bo, ba in buf.drain_sg(self.batch_size):
+                        self._dispatch_sg(bi, bo, ba)
+                else:
+                    for bx, bm, bc, ba in buf.drain_cbow(self.batch_size):
+                        self._dispatch_cbow(bx, bm, bc, ba)
+        # trailing partial batch
+        if sg:
+            for bi, bo, ba in buf.drain_sg(self.batch_size, final=True):
+                self._dispatch_sg(bi, bo, ba)
+        else:
+            for bx, bm, bc, ba in buf.drain_cbow(self.batch_size, final=True):
+                self._dispatch_cbow(bx, bm, bc, ba)
 
     def _alpha(self, seen: int, total: int) -> float:
         frac = min(1.0, seen / max(1, total))
@@ -248,19 +277,85 @@ class SequenceVectors:
     def _pairs(self, idxs: np.ndarray):
         """(input=context row, predict=center word) window pairs, mirroring
         word2vec C / SkipGram.java windowing with random window shrink
-        b ∈ [0, window): offsets b-window .. window-b inclusive, skip 0."""
-        ins, outs = [], []
+        b ∈ [0, window): offsets b-window .. window-b inclusive, skip 0.
+        Vectorized: one [n, 2w] mask instead of a per-position Python loop."""
         n = len(idxs)
-        for pos in range(n):
-            b = int(self._rng.integers(0, self.window))
-            for off in range(b - self.window, self.window - b + 1):
-                if off == 0:
-                    continue
-                c = pos + off
-                if 0 <= c < n:
-                    ins.append(idxs[c])
-                    outs.append(idxs[pos])
-        return np.asarray(ins, np.int32), np.asarray(outs, np.int32)
+        w = self.window
+        if n == 0:
+            return (np.empty(0, np.int32),) * 2
+        b = self._rng.integers(0, w, n)                      # [n]
+        offs = np.concatenate([np.arange(-w, 0), np.arange(1, w + 1)])  # [2w]
+        pos = np.arange(n)[:, None]                          # [n,1]
+        c = pos + offs[None, :]                              # [n,2w]
+        valid = (np.abs(offs)[None, :] <= (w - b)[:, None]) & \
+            (c >= 0) & (c < n)
+        ins = idxs[c.clip(0, n - 1)][valid]
+        outs = np.broadcast_to(idxs[:, None], c.shape)[valid]
+        return ins.astype(np.int32), outs.astype(np.int32)
+
+    def _cbow_contexts(self, idxs: np.ndarray, label_rows=None):
+        """Per-center context rows + mask, vectorized like _pairs.
+        Returns (ctxs [n,C], cmask [n,C], centers [n])."""
+        n = len(idxs)
+        w = self.window
+        n_lbl = len(label_rows) if label_rows else 0
+        C = 2 * w + n_lbl
+        b = self._rng.integers(0, w, n)
+        offs = np.concatenate([np.arange(-w, 0), np.arange(1, w + 1)])
+        pos = np.arange(n)[:, None]
+        c = pos + offs[None, :]
+        valid = (np.abs(offs)[None, :] <= (w - b)[:, None]) & \
+            (c >= 0) & (c < n)
+        ctxs = np.zeros((n, C), np.int32)
+        cmask = np.zeros((n, C), np.float32)
+        ctxs[:, :2 * w] = idxs[c.clip(0, n - 1)] * valid
+        cmask[:, :2 * w] = valid
+        if n_lbl:  # DM: doc vector(s) join the context average
+            ctxs[:, 2 * w:] = np.asarray(label_rows, np.int32)[None, :]
+            cmask[:, 2 * w:] = 1.0
+        return ctxs, cmask, idxs.astype(np.int32)
+
+    def _dispatch_sg(self, bi, bo, alphas):
+        """One device step on a full/padded skip-gram batch."""
+        bi, bo, alphas, pad = self._pad(bi, bo, alphas)
+        lr = jnp.asarray(alphas)
+        if self.negative > 0:
+            targets, labels = self._sample_negatives(bo)
+            self.syn0, self.syn1neg = _ns_step(
+                self.syn0, self.syn1neg, jnp.asarray(bi),
+                jnp.asarray(targets), jnp.asarray(labels),
+                jnp.asarray(1.0 - pad), lr)
+        if self.use_hs:
+            pts = self._points[bo]
+            cds = self._codes[bo]
+            msk = self._path_mask[bo] * (1.0 - pad[:, None])
+            self.syn0, self.syn1 = _hs_step(
+                self.syn0, self.syn1, jnp.asarray(bi), jnp.asarray(pts),
+                jnp.asarray(cds), jnp.asarray(msk), lr)
+
+    def _dispatch_cbow(self, bx, bm, bc, alphas):
+        B = self.batch_size
+        pad = np.zeros(B, np.float32)
+        k = len(bc)
+        if k < B:
+            pad[k:] = 1.0
+            bc = np.pad(bc, (0, B - k))
+            bx = np.pad(bx, ((0, B - k), (0, 0)))
+            bm = np.pad(bm, ((0, B - k), (0, 0)))
+            alphas = np.pad(alphas, (0, B - k))
+        lr = jnp.asarray(alphas.astype(np.float32))
+        if self.negative > 0:
+            targets, labels = self._sample_negatives(bc)
+            self.syn0, self.syn1neg = _cbow_ns_step(
+                self.syn0, self.syn1neg, jnp.asarray(bx), jnp.asarray(bm),
+                jnp.asarray(targets), jnp.asarray(labels),
+                jnp.asarray(1.0 - pad), lr)
+        if self.use_hs:
+            pts, cds = self._points[bc], self._codes[bc]
+            msk = self._path_mask[bc] * (1.0 - pad[:, None])
+            self.syn0, self.syn1 = _cbow_hs_step(
+                self.syn0, self.syn1, jnp.asarray(bx), jnp.asarray(bm),
+                jnp.asarray(pts), jnp.asarray(cds), jnp.asarray(msk), lr)
 
     @staticmethod
     def _label_pairs(idxs: np.ndarray, label_rows: List[int]):
