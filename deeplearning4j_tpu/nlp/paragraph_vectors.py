@@ -110,8 +110,7 @@ class ParagraphVectors(SequenceVectors):
                 alpha = max(min_learning_rate,
                             learning_rate * (1 - it / n_steps))
                 before1, before1n = self.syn1, self.syn1neg
-                self._train_skipgram(idxs, alpha, [row], train_words=False,
-                                     train_labels=True)
+                self._train_label_pairs(idxs, alpha, [row])
                 # freeze output tables: restore them after the step
                 self.syn1, self.syn1neg = before1, before1n
             return np.asarray(self.syn0[row])

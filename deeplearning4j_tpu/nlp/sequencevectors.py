@@ -115,6 +115,92 @@ def _cbow_hs_step(syn0, syn1, ctx, ctx_mask, points, codes, mask, lr):
 
 
 # --------------------------------------------------------------------------
+# Host-side batch accumulation
+# --------------------------------------------------------------------------
+
+class _BatchBuffer:
+    """Accumulates (pair, alpha) examples across many sequences into
+    fixed-shape device batches, so the device sees one large jit dispatch
+    per `batch_size` examples instead of one tiny dispatch per sentence
+    (the reference amortizes per-pair cost with a hogwild worker pool,
+    SequenceVectors.java:192; on TPU batching is the equivalent lever)."""
+
+    def __init__(self):
+        self._sg = []        # list of (ins [n], outs [n], lr [n])
+        self._n_sg = 0
+        self._cb = []        # list of (ctxs [n,C], cmask [n,C], centers [n], lr [n])
+        self._n_cb = 0
+
+    # -- skip-gram ---------------------------------------------------------
+    def add_sg(self, ins: np.ndarray, outs: np.ndarray,
+               alpha: float) -> None:
+        n = len(ins)
+        if n == 0:
+            return
+        self._sg.append((ins.astype(np.int32), outs.astype(np.int32),
+                         np.full(n, alpha, np.float32)))
+        self._n_sg += n
+
+    def drain_sg(self, batch_size: int, final: bool = False):
+        """Yield (ins, outs, lr) chunks of exactly `batch_size` rows; with
+        final=True also yield the trailing partial chunk. Rows that don't
+        fill a batch stay buffered for the next call."""
+        if self._n_sg == 0 or (self._n_sg < batch_size and not final):
+            return
+        ins = np.concatenate([t[0] for t in self._sg])
+        outs = np.concatenate([t[1] for t in self._sg])
+        lr = np.concatenate([t[2] for t in self._sg])
+        self._sg, self._n_sg = [], 0
+        stop = len(ins) if final else len(ins) // batch_size * batch_size
+        for s in range(0, stop, batch_size):
+            yield ins[s:s + batch_size], outs[s:s + batch_size], \
+                lr[s:s + batch_size]
+        if stop < len(ins):  # keep the remainder buffered
+            self._sg.append((ins[stop:], outs[stop:], lr[stop:]))
+            self._n_sg = len(ins) - stop
+
+    # -- CBOW --------------------------------------------------------------
+    def add_cbow(self, ctxs: np.ndarray, cmask: np.ndarray,
+                 centers: np.ndarray, alpha: float) -> None:
+        n = len(centers)
+        if n == 0:
+            return
+        self._cb.append((ctxs.astype(np.int32), cmask.astype(np.float32),
+                         centers.astype(np.int32),
+                         np.full(n, alpha, np.float32)))
+        self._n_cb += n
+
+    def drain_cbow(self, batch_size: int, final: bool = False):
+        if self._n_cb == 0 or (self._n_cb < batch_size and not final):
+            return
+        # context width can differ when some sequences carry doc labels
+        # (DM) and others don't — pad every chunk to the buffered max so
+        # one concatenated array feeds fixed-shape kernels
+        C = max(t[0].shape[1] for t in self._cb)
+
+        def widen(a, fill=0):
+            if a.shape[1] == C:
+                return a
+            return np.pad(a, ((0, 0), (0, C - a.shape[1])),
+                          constant_values=fill)
+
+        ctxs = np.concatenate([widen(t[0]) for t in self._cb])
+        cmask = np.concatenate([widen(t[1]) for t in self._cb])
+        centers = np.concatenate([t[2] for t in self._cb])
+        lr = np.concatenate([t[3] for t in self._cb])
+        self._cb, self._n_cb = [], 0
+        stop = len(centers) if final \
+            else len(centers) // batch_size * batch_size
+        for s in range(0, stop, batch_size):
+            yield ctxs[s:s + batch_size], cmask[s:s + batch_size], \
+                centers[s:s + batch_size], lr[s:s + batch_size]
+        if stop < len(centers):
+            self._cb.append((ctxs[stop:], cmask[stop:], centers[stop:],
+                             lr[stop:]))
+            self._n_cb = len(centers) - stop
+
+
+# --------------------------------------------------------------------------
 # Engine
 # --------------------------------------------------------------------------
 
@@ -143,6 +229,7 @@ class SequenceVectors:
         self.epochs = epochs
         self.iterations = iterations
         self.batch_size = batch_size
+        self._eff_batch = batch_size  # collision-bounded in _reset_weights
         algo = elements_learning_algorithm.lower()
         if algo not in ("skipgram", "cbow"):
             raise ValueError(f"unknown elements learning algorithm {algo!r}")
@@ -195,6 +282,33 @@ class SequenceVectors:
         if self.negative > 0:
             self.syn1neg = jnp.zeros((V, D), jnp.float32)
             self._table = make_unigram_table(self.vocab)
+        # In-batch index collisions SUM their updates (hogwild would
+        # interleave them); on a tiny vocab a big batch revisits each row
+        # so often that summed stale gradients overshoot and collapse the
+        # embedding. Bound expected collisions per table row: each batch
+        # row touches `traffic` table entries (CBOW context width /
+        # negatives+positive / huffman path), spread over the non-label
+        # vocab. (DBOW label rows DO self-collide — every pair of a doc
+        # shares its label input — but those collisions are bounded by the
+        # doc's length, not the batch size, and match the reference's
+        # per-sequence AggregateSkipGram batching, so they're excluded
+        # here.) Real vocabs (>=10k) keep the full configured batch.
+        v_words = sum(1 for vw in self.vocab.vocab_words()
+                      if not vw.is_label) or V
+        in_traffic = 2 * self.window if self.algo == "cbow" else 1
+        out_traffic = 1
+        if self.negative > 0:
+            out_traffic = max(out_traffic, self.negative + 1)
+        if self.use_hs:  # worst-case huffman path length actually built
+            out_traffic = max(out_traffic, int(self._codes.shape[1]))
+        traffic = max(in_traffic, out_traffic)
+        self._eff_batch = min(self.batch_size,
+                              max(64, (8 * v_words) // traffic))
+        if self._eff_batch < self.batch_size:
+            log.info(
+                "dispatch batch clamped %d -> %d (vocab %d words, "
+                "traffic %d/row) to bound in-batch update collisions",
+                self.batch_size, self._eff_batch, v_words, traffic)
 
     # -- training ----------------------------------------------------------
     def fit(self, sequences: Iterable[Sequence[str]],
@@ -240,17 +354,17 @@ class SequenceVectors:
                         buf.add_cbow(ctxs, cmask, centers, alpha)
                 # dispatch every full batch currently buffered
                 if sg:
-                    for bi, bo, ba in buf.drain_sg(self.batch_size):
+                    for bi, bo, ba in buf.drain_sg(self._eff_batch):
                         self._dispatch_sg(bi, bo, ba)
                 else:
-                    for bx, bm, bc, ba in buf.drain_cbow(self.batch_size):
+                    for bx, bm, bc, ba in buf.drain_cbow(self._eff_batch):
                         self._dispatch_cbow(bx, bm, bc, ba)
         # trailing partial batch
         if sg:
-            for bi, bo, ba in buf.drain_sg(self.batch_size, final=True):
+            for bi, bo, ba in buf.drain_sg(self._eff_batch, final=True):
                 self._dispatch_sg(bi, bo, ba)
         else:
-            for bx, bm, bc, ba in buf.drain_cbow(self.batch_size, final=True):
+            for bx, bm, bc, ba in buf.drain_cbow(self._eff_batch, final=True):
                 self._dispatch_cbow(bx, bm, bc, ba)
 
     def _alpha(self, seen: int, total: int) -> float:
@@ -334,7 +448,7 @@ class SequenceVectors:
                 jnp.asarray(cds), jnp.asarray(msk), lr)
 
     def _dispatch_cbow(self, bx, bm, bc, alphas):
-        B = self.batch_size
+        B = self._eff_batch
         pad = np.zeros(B, np.float32)
         k = len(bc)
         if k < B:
@@ -367,91 +481,31 @@ class SequenceVectors:
                 outs.append(w)
         return np.asarray(ins, np.int32), np.asarray(outs, np.int32)
 
-    def _train_skipgram(self, idxs, alpha, label_rows=None, *,
-                        train_words=True, train_labels=False) -> None:
-        if not train_words:
-            ins, outs = (np.empty(0, np.int32),) * 2
-        else:
-            ins, outs = self._pairs(idxs)
-        if train_labels and label_rows:
-            li, lo = self._label_pairs(idxs, label_rows)
-            ins = np.concatenate([ins, li]) if ins.size else li
-            outs = np.concatenate([outs, lo]) if outs.size else lo
-        for s in range(0, len(ins), self.batch_size):
-            bi, bo = ins[s:s + self.batch_size], outs[s:s + self.batch_size]
-            bi, bo, pad = self._pad(bi, bo)
-            if self.negative > 0:
-                targets, labels = self._sample_negatives(bo)
-                self.syn0, self.syn1neg = _ns_step(
-                    self.syn0, self.syn1neg, jnp.asarray(bi),
-                    jnp.asarray(targets), jnp.asarray(labels),
-                    jnp.asarray(1.0 - pad), jnp.float32(alpha))
-            if self.use_hs:
-                pts = self._points[bo]
-                cds = self._codes[bo]
-                msk = self._path_mask[bo] * (1.0 - pad[:, None])
-                self.syn0, self.syn1 = _hs_step(
-                    self.syn0, self.syn1, jnp.asarray(bi), jnp.asarray(pts),
-                    jnp.asarray(cds), jnp.asarray(msk), jnp.float32(alpha))
+    def _train_label_pairs(self, idxs, alpha, label_rows) -> None:
+        """DBOW-style label->word updates for a single sequence, dispatched
+        immediately (used by ParagraphVectors.infer_vector, where the output
+        tables are frozen between steps so buffering across calls would
+        change semantics)."""
+        ins, outs = self._label_pairs(idxs, label_rows)
+        for s in range(0, len(ins), self._eff_batch):
+            bi, bo = ins[s:s + self._eff_batch], outs[s:s + self._eff_batch]
+            alphas = np.full(len(bi), alpha, np.float32)
+            self._dispatch_sg(bi, bo, alphas)
 
-    def _train_cbow(self, idxs, alpha, label_rows=None) -> None:
-        n = len(idxs)
-        C = 2 * self.window + (len(label_rows) if label_rows else 0)
-        ctxs = np.zeros((n, C), np.int32)
-        cmask = np.zeros((n, C), np.float32)
-        centers = idxs.copy()
-        for pos in range(n):
-            b = int(self._rng.integers(0, self.window))
-            k = 0
-            for off in range(b - self.window, self.window - b + 1):
-                if off == 0:
-                    continue
-                c = pos + off
-                if 0 <= c < n:
-                    ctxs[pos, k] = idxs[c]
-                    cmask[pos, k] = 1.0
-                    k += 1
-            if label_rows:  # DM: doc vector joins the context average
-                for lr_ in label_rows:
-                    ctxs[pos, k] = lr_
-                    cmask[pos, k] = 1.0
-                    k += 1
-        for s in range(0, n, self.batch_size):
-            bc = centers[s:s + self.batch_size]
-            bx = ctxs[s:s + self.batch_size]
-            bm = cmask[s:s + self.batch_size]
-            pad_n = 0
-            if len(bc) < self.batch_size:
-                pad_n = self.batch_size - len(bc)
-                bc = np.pad(bc, (0, pad_n))
-                bx = np.pad(bx, ((0, pad_n), (0, 0)))
-                bm = np.pad(bm, ((0, pad_n), (0, 0)))
-            pad = np.zeros(self.batch_size, np.float32)
-            if pad_n:
-                pad[-pad_n:] = 1.0
-            if self.negative > 0:
-                targets, labels = self._sample_negatives(bc)
-                self.syn0, self.syn1neg = _cbow_ns_step(
-                    self.syn0, self.syn1neg, jnp.asarray(bx), jnp.asarray(bm),
-                    jnp.asarray(targets), jnp.asarray(labels),
-                    jnp.asarray(1.0 - pad), jnp.float32(alpha))
-            if self.use_hs:
-                pts, cds = self._points[bc], self._codes[bc]
-                msk = self._path_mask[bc] * (1.0 - pad[:, None])
-                self.syn0, self.syn1 = _cbow_hs_step(
-                    self.syn0, self.syn1, jnp.asarray(bx), jnp.asarray(bm),
-                    jnp.asarray(pts), jnp.asarray(cds), jnp.asarray(msk),
-                    jnp.float32(alpha))
-
-    def _pad(self, bi: np.ndarray, bo: np.ndarray):
+    def _pad(self, bi: np.ndarray, bo: np.ndarray, alphas=None):
         """Pad a trailing partial batch to `batch_size` (static shapes for
-        jit); returns pad mask (1 where padded)."""
-        pad = np.zeros(self.batch_size, np.float32)
-        if len(bi) < self.batch_size:
-            n = self.batch_size - len(bi)
+        jit); returns pad mask (1 where padded). With `alphas` given, the
+        per-pair lr array is padded too and returned before the mask."""
+        pad = np.zeros(self._eff_batch, np.float32)
+        if len(bi) < self._eff_batch:
+            n = self._eff_batch - len(bi)
             pad[len(bi):] = 1.0
             bi = np.pad(bi, (0, n))
             bo = np.pad(bo, (0, n))
+            if alphas is not None:
+                alphas = np.pad(alphas, (0, n))
+        if alphas is not None:
+            return bi, bo, alphas.astype(np.float32), pad
         return bi, bo, pad
 
     def _sample_negatives(self, bo: np.ndarray):
