@@ -128,7 +128,10 @@ class LayerVertex(GraphVertexConf):
 @dataclass
 class MergeVertex(GraphVertexConf):
     """Concatenate along the feature axis (axis 1 for FF/CNN/RNN — DL4J
-    merges on depth/features; ref: vertex/impl/MergeVertex.java)."""
+    merges on depth/features; ref: vertex/impl/MergeVertex.java). Under
+    internal NHWC, 4-D inputs carry channels on the last axis."""
+
+    data_format: str = "NCHW"
 
     def output_type(self, its):
         first = its[0]
@@ -140,7 +143,8 @@ class MergeVertex(GraphVertexConf):
         return InputType.feed_forward(sum(it.flat_size() for it in its))
 
     def apply(self, params, xs, state, *, train=False, rng=None, mask=None):
-        return jnp.concatenate(xs, axis=1), state
+        axis = 3 if (self.data_format == "NHWC" and xs[0].ndim == 4) else 1
+        return jnp.concatenate(xs, axis=axis), state
 
 
 @register_vertex
@@ -179,6 +183,7 @@ class SubsetVertex(GraphVertexConf):
 
     from_index: int = 0
     to_index: int = 0
+    data_format: str = "NCHW"  # feature axis of 4-D input moves under NHWC
 
     def output_type(self, its):
         n = self.to_index - self.from_index + 1
@@ -190,7 +195,11 @@ class SubsetVertex(GraphVertexConf):
         return InputType.feed_forward(n)
 
     def apply(self, params, xs, state, *, train=False, rng=None, mask=None):
-        return xs[0][:, self.from_index:self.to_index + 1], state
+        x = xs[0]
+        sl = slice(self.from_index, self.to_index + 1)
+        if self.data_format == "NHWC" and x.ndim == 4:
+            return x[..., sl], state
+        return x[:, sl], state
 
 
 @register_vertex
@@ -337,11 +346,15 @@ class PoolHelperVertex(GraphVertexConf):
     """Strip first row/col of a CNN activation (GoogLeNet compat shim;
     ref: PoolHelperVertex.java)."""
 
+    data_format: str = "NCHW"
+
     def output_type(self, its):
         it = its[0]
         return InputType.convolutional(it.height - 1, it.width - 1, it.channels)
 
     def apply(self, params, xs, state, *, train=False, rng=None, mask=None):
+        if self.data_format == "NHWC":
+            return xs[0][:, 1:, 1:, :], state
         return xs[0][:, :, 1:, 1:], state
 
 

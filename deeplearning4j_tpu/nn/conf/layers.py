@@ -296,6 +296,7 @@ class ConvolutionLayer(FeedForwardLayerConf):
     dilation: Sequence[int] = (1, 1)
     convolution_mode: str = "truncate"  # truncate | strict | same
     has_bias: bool = True
+    data_format: str = "NCHW"  # internal activation layout; NHWC = TPU-fast
 
     def output_type(self, it):
         if it.kind != "cnn":
@@ -325,7 +326,7 @@ class ConvolutionLayer(FeedForwardLayerConf):
         x = self.maybe_dropout_input(x, train, rng)
         y = _conv.conv2d(x, params["W"], params.get("b"), _pair(self.stride),
                          _pair(self.padding), _pair(self.dilation),
-                         self.convolution_mode)
+                         self.convolution_mode, self.data_format)
         return _act.get(self.activation)(y), state
 
 
@@ -400,7 +401,8 @@ class Deconvolution2DLayer(ConvolutionLayer):
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
         x = self.maybe_dropout_input(x, train, rng)
         y = _conv.deconv2d(x, params["W"], params.get("b"), _pair(self.stride),
-                           _pair(self.padding), self.convolution_mode)
+                           _pair(self.padding), self.convolution_mode,
+                           self.data_format)
         return _act.get(self.activation)(y), state
 
 
@@ -416,6 +418,7 @@ class SubsamplingLayer(LayerConf):
     padding: Sequence[int] = (0, 0)
     convolution_mode: str = "truncate"
     pnorm: float = 2.0
+    data_format: str = "NCHW"
 
     def output_type(self, it):
         kh, kw = _pair(self.kernel)
@@ -427,15 +430,20 @@ class SubsamplingLayer(LayerConf):
 
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
         k, s, p = _pair(self.kernel), _pair(self.stride), _pair(self.padding)
+        df = self.data_format
         pt = self.pooling_type.lower()
         if pt == "max":
-            y = _conv.max_pool2d(x, k, s, p, self.convolution_mode)
+            y = _conv.max_pool2d(x, k, s, p, self.convolution_mode,
+                                 data_format=df)
         elif pt == "avg":
-            y = _conv.avg_pool2d(x, k, s, p, self.convolution_mode)
+            y = _conv.avg_pool2d(x, k, s, p, self.convolution_mode,
+                                 data_format=df)
         elif pt == "pnorm":
-            y = _conv.pnorm_pool2d(x, k, s, p, self.pnorm, self.convolution_mode)
+            y = _conv.pnorm_pool2d(x, k, s, p, self.pnorm,
+                                   self.convolution_mode, data_format=df)
         elif pt == "sum":
-            y = _conv.avg_pool2d(x, k, s, p, self.convolution_mode) * (k[0] * k[1])
+            y = _conv.avg_pool2d(x, k, s, p, self.convolution_mode,
+                                 data_format=df) * (k[0] * k[1])
         else:
             raise ValueError(f"unknown pooling type {self.pooling_type}")
         return y, state
@@ -474,13 +482,14 @@ class Upsampling2DLayer(LayerConf):
     """Nearest-neighbour upsampling (ref: conf/layers/Upsampling2D.java)."""
 
     size: Sequence[int] = (2, 2)
+    data_format: str = "NCHW"
 
     def output_type(self, it):
         sh, sw = _pair(self.size)
         return InputType.convolutional(it.height * sh, it.width * sw, it.channels)
 
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
-        return _conv.upsample2d(x, _pair(self.size)), state
+        return _conv.upsample2d(x, _pair(self.size), self.data_format), state
 
 
 @register_layer
@@ -532,6 +541,7 @@ class ZeroPaddingLayer(LayerConf):
     """Zero padding [top, bottom, left, right] (ref: conf/layers/ZeroPaddingLayer.java)."""
 
     padding: Sequence[int] = (0, 0, 0, 0)
+    data_format: str = "NCHW"
 
     def _pads(self):
         p = list(self.padding)
@@ -544,7 +554,7 @@ class ZeroPaddingLayer(LayerConf):
         return InputType.convolutional(it.height + t + b, it.width + l + r, it.channels)
 
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
-        return _conv.zero_pad2d(x, self._pads()), state
+        return _conv.zero_pad2d(x, self._pads(), self.data_format), state
 
 
 @register_layer
@@ -557,6 +567,7 @@ class GlobalPoolingLayer(LayerConf):
     pooling_type: str = "max"  # max | avg | sum | pnorm
     pnorm: float = 2.0
     collapse_dimensions: bool = True
+    data_format: str = "NCHW"  # layout of 4-D (CNN) input
 
     def output_type(self, it):
         if it.kind == "rnn":
@@ -583,8 +594,8 @@ class GlobalPoolingLayer(LayerConf):
                 else:
                     y = jnp.sum(jnp.abs(x * m) ** self.pnorm, axis=2) ** (1.0 / self.pnorm)
                 return y, state
-        elif x.ndim == 4:  # [N, C, H, W]
-            axes = (2, 3)
+        elif x.ndim == 4:  # [N, C, H, W] (or [N, H, W, C] internal NHWC)
+            axes = (2, 3) if self.data_format == "NCHW" else (1, 2)
         else:
             axes = tuple(range(1, x.ndim))
         if pt == "max":
@@ -617,6 +628,7 @@ class BatchNormalization(FeedForwardLayerConf):
     lock_gamma_beta: bool = False
     gamma: float = 1.0
     beta: float = 0.0
+    data_format: str = "NCHW"
 
     def output_type(self, it):
         return it
@@ -639,10 +651,11 @@ class BatchNormalization(FeedForwardLayerConf):
         nf = state["mean"].shape[0]
         gamma = params.get("gamma", jnp.full((nf,), self.gamma, x.dtype))
         beta = params.get("beta", jnp.full((nf,), self.beta, x.dtype))
+        ch_axis = 3 if (self.data_format == "NHWC" and x.ndim == 4) else 1
         y, new_mean, new_var = _norm.batch_norm(
             x, gamma.astype(x.dtype), beta.astype(x.dtype),
             state["mean"].astype(x.dtype), state["var"].astype(x.dtype),
-            train, self.eps, self.decay
+            train, self.eps, self.decay, channel_axis=ch_axis
         )
         if train:  # running stats kept in fp32 regardless of compute dtype
             new_state = {"mean": new_mean.astype(jnp.float32),
@@ -663,9 +676,12 @@ class LocalResponseNormalization(LayerConf):
     n: int = 5
     alpha: float = 1e-4
     beta: float = 0.75
+    data_format: str = "NCHW"
 
     def apply(self, params, x, state, *, train=False, rng=None, mask=None):
-        return _norm.lrn(x, self.k, self.n, self.alpha, self.beta), state
+        ch_axis = 3 if self.data_format == "NHWC" else 1
+        return _norm.lrn(x, self.k, self.n, self.alpha, self.beta,
+                         channel_axis=ch_axis), state
 
 
 # ---------------------------------------------------------------------------

@@ -59,13 +59,19 @@ class Preprocessor:
 @register_preprocessor
 @dataclass
 class CnnToFeedForwardPreProcessor(Preprocessor):
-    """[N,C,H,W] -> [N, C*H*W] (ref: CnnToFeedForwardPreProcessor.java)."""
+    """[N,C,H,W] -> [N, C*H*W] (ref: CnnToFeedForwardPreProcessor.java).
+    Under internal NHWC the incoming tensor is [N,H,W,C]; transpose back to
+    NCHW first so the flat feature order stays DL4J-compatible (checkpoint
+    and Keras-import parity depend on it)."""
 
     height: int = 0
     width: int = 0
     channels: int = 0
+    data_format: str = "NCHW"
 
     def apply(self, x, mask=None):
+        if self.data_format == "NHWC" and x.ndim == 4:
+            x = x.transpose(0, 3, 1, 2)
         return x.reshape(x.shape[0], -1)
 
     def output_type(self, it):
@@ -75,16 +81,20 @@ class CnnToFeedForwardPreProcessor(Preprocessor):
 @register_preprocessor
 @dataclass
 class FeedForwardToCnnPreProcessor(Preprocessor):
-    """[N, C*H*W] -> [N,C,H,W] (ref: FeedForwardToCnnPreProcessor.java)."""
+    """[N, C*H*W] -> [N,C,H,W] (ref: FeedForwardToCnnPreProcessor.java);
+    emits [N,H,W,C] instead under internal NHWC."""
 
     height: int = 0
     width: int = 0
     channels: int = 0
+    data_format: str = "NCHW"
 
     def apply(self, x, mask=None):
-        if x.ndim == 4:
-            return x
-        return x.reshape(x.shape[0], self.channels, self.height, self.width)
+        if x.ndim != 4:
+            x = x.reshape(x.shape[0], self.channels, self.height, self.width)
+        if self.data_format == "NHWC":
+            x = x.transpose(0, 2, 3, 1)
+        return x
 
     def output_type(self, it):
         return InputType.convolutional(self.height, self.width, self.channels)
@@ -134,8 +144,11 @@ class CnnToRnnPreProcessor(Preprocessor):
     width: int = 0
     channels: int = 0
     timesteps: int = 1
+    data_format: str = "NCHW"
 
     def apply(self, x, mask=None):
+        if self.data_format == "NHWC" and x.ndim == 4:
+            x = x.transpose(0, 3, 1, 2)
         nt = x.shape[0]
         n = nt // self.timesteps
         flat = x.reshape(nt, -1)
@@ -153,11 +166,15 @@ class RnnToCnnPreProcessor(Preprocessor):
     height: int = 0
     width: int = 0
     channels: int = 0
+    data_format: str = "NCHW"
 
     def apply(self, x, mask=None):
         n, f, t = x.shape
         flat = jnp.transpose(x, (0, 2, 1)).reshape(n * t, f)
-        return flat.reshape(n * t, self.channels, self.height, self.width)
+        y = flat.reshape(n * t, self.channels, self.height, self.width)
+        if self.data_format == "NHWC":
+            y = y.transpose(0, 2, 3, 1)
+        return y
 
     def output_type(self, it):
         return InputType.convolutional(self.height, self.width, self.channels)

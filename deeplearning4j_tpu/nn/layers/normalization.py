@@ -28,29 +28,40 @@ def batch_norm(
     train: bool,
     eps: float = 1e-5,
     decay: float = 0.9,
+    channel_axis: int = 1,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Batch normalization over all-but-channel axes.
 
-    x is [N,F] (channel=axis 1) or [N,C,H,W] (channel=axis 1, DL4J NCHW).
+    x is [N,F] (channel=axis 1), [N,C,H,W] (channel=axis 1, DL4J NCHW), or
+    [N,H,W,C] with channel_axis=3 (internal NHWC mode — channel-minor keeps
+    the per-channel stat reductions lane-aligned on the TPU VPU).
     Returns (y, new_running_mean, new_running_var). Running stats update uses
     the reference's decay semantics: new = decay*old + (1-decay)*batch
     (ref: BatchNormalization.java `decay` field, default 0.9).
     """
-    axes = tuple(i for i in range(x.ndim) if i != 1)
+    axes = tuple(i for i in range(x.ndim) if i != channel_axis)
     bshape = [1] * x.ndim
-    bshape[1] = x.shape[1]
+    bshape[channel_axis] = x.shape[channel_axis]
 
     if train:
-        mean = jnp.mean(x, axis=axes)
-        var = jnp.var(x, axis=axes)
+        # one-pass stats: E[x] and E[x^2] fuse into a single read of x
+        # (vs. jnp.var's subtract-mean second pass — on TPU the big
+        # activation tensors are HBM-bandwidth-bound, so one fewer pass
+        # is a direct win). Accumulate in >=fp32 under mixed precision.
+        acc_t = jnp.promote_types(x.dtype, jnp.float32)
+        xf = x.astype(acc_t)
+        mean = jnp.mean(xf, axis=axes)
+        # clamp: E[x^2]-mean^2 can round negative in fp32 when |mean| is
+        # large and true variance tiny, which would NaN the rsqrt below
+        var = jnp.maximum(jnp.mean(xf * xf, axis=axes) - mean * mean, 0.0)
         new_mean = decay * running_mean + (1.0 - decay) * mean
         new_var = decay * running_var + (1.0 - decay) * var
     else:
         mean, var = running_mean, running_var
         new_mean, new_var = running_mean, running_var
 
-    inv = lax.rsqrt(var + eps)
-    y = (x - mean.reshape(bshape)) * inv.reshape(bshape)
+    inv = lax.rsqrt(var + eps).astype(x.dtype)
+    y = (x - mean.astype(x.dtype).reshape(bshape)) * inv.reshape(bshape)
     y = y * gamma.reshape(bshape) + beta.reshape(bshape)
     return y, new_mean, new_var
 
@@ -61,6 +72,7 @@ def lrn(
     n: int = 5,
     alpha: float = 1e-4,
     beta: float = 0.75,
+    channel_axis: int = 1,
 ) -> jax.Array:
     """Local response normalization across channels (ref: LocalResponseNormalization
     layer, defaults k=2 n=5 alpha=1e-4 beta=0.75).
@@ -70,12 +82,16 @@ def lrn(
     sq = x * x
     half = n // 2
     # window-sum across the channel axis via reduce_window
+    wd = [1, 1, 1, 1]
+    wd[channel_axis] = n
+    pads = [(0, 0)] * 4
+    pads[channel_axis] = (half, half)
     win = lax.reduce_window(
         sq,
         0.0,
         lax.add,
-        window_dimensions=(1, n, 1, 1),
+        window_dimensions=tuple(wd),
         window_strides=(1, 1, 1, 1),
-        padding=[(0, 0), (half, half), (0, 0), (0, 0)],
+        padding=pads,
     )
     return x / (k + alpha * win) ** beta

@@ -16,6 +16,14 @@ or when running on CPU (where it uses the Pallas interpreter only under
 test). Parity with the scan path is covered by tests mirroring
 ValidateCudnnLSTM.java (SURVEY §4 backend-vs-backend pattern).
 
+Measured on a real v5e chip (T=256, N=64, H=256): outputs match scan
+exactly (0.0 max diff), but the per-timestep grid dispatch costs
+~218us/step against ~16us/step for XLA's scan — scan wins ~14x, because
+XLA already keeps the [H,4H] recurrent weights cached across scan
+iterations and pipelines the carry. lstm_scan therefore defaults to the
+scan path (use_pallas=False); this kernel remains the opt-in reference
+for the fused-RNN pattern.
+
 Gate order matches nn/layers/recurrent.py: (i, f, c, o).
 """
 

@@ -42,8 +42,14 @@ class ZooModel:
         raise NotImplementedError
 
     def init(self):
-        """Build + initialize the network (ref: ZooModel.init())."""
+        """Build + initialize the network (ref: ZooModel.init()).
+
+        Pass `data_format="NHWC"` to the model constructor to run the CNN
+        stack in the TPU-fast internal layout (public API stays NCHW)."""
         conf = self.conf()
+        fmt = self.kwargs.get("data_format")
+        if fmt:
+            conf.use_cnn_data_format(fmt)
         from deeplearning4j_tpu.nn.conf.network import (
             ComputationGraphConfiguration, MultiLayerConfiguration)
         if isinstance(conf, MultiLayerConfiguration):

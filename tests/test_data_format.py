@@ -1,0 +1,185 @@
+"""Internal NHWC layout mode: numerical equivalence with the NCHW default.
+
+The public API stays NCHW (inputs [N,C,H,W], weights [O,I,kH,kW], flat
+feature order); use_cnn_data_format("NHWC") only changes the internal
+activation layout, so outputs and training trajectories must match the
+NCHW run to float tolerance.
+"""
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.graph_conf import ElementWiseVertex, MergeVertex
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.network import (
+    MultiLayerConfiguration, NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Sgd
+
+
+def _small_cnn_conf():
+    return (NeuralNetConfiguration.Builder()
+            .seed(7)
+            .updater(Sgd(0.05))
+            .list()
+            .layer(L.ConvolutionLayer(n_out=8, kernel=(3, 3), stride=(1, 1),
+                                      convolution_mode="same",
+                                      activation="relu"))
+            .layer(L.BatchNormalization())
+            .layer(L.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+            .layer(L.LocalResponseNormalization(n=3))
+            .layer(L.ZeroPaddingLayer(padding=(1, 1, 1, 1)))
+            .layer(L.Upsampling2DLayer(size=(2, 2)))
+            .layer(L.GlobalPoolingLayer(pooling_type="avg"))
+            .layer(L.OutputLayer(n_out=5, activation="softmax",
+                                 loss="negativeloglikelihood"))
+            .set_input_type(InputType.convolutional(12, 12, 3))
+            .build())
+
+
+def _data(n=4, c=3, h=12, w=12, k=5, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, c, h, w)).astype(np.float32)
+    y = np.zeros((n, k), np.float32)
+    y[np.arange(n), rng.integers(0, k, n)] = 1.0
+    return x, y
+
+
+class TestMultiLayerNhwc:
+    def test_output_equivalence(self):
+        x, _ = _data()
+        net_a = MultiLayerNetwork(_small_cnn_conf()).init()
+        net_b = MultiLayerNetwork(
+            _small_cnn_conf().use_cnn_data_format("NHWC")).init()
+        ya = np.asarray(net_a.output(x))
+        yb = np.asarray(net_b.output(x))
+        np.testing.assert_allclose(ya, yb, atol=1e-5)
+
+    def test_training_equivalence(self):
+        x, y = _data()
+        net_a = MultiLayerNetwork(_small_cnn_conf()).init()
+        net_b = MultiLayerNetwork(
+            _small_cnn_conf().use_cnn_data_format("NHWC")).init()
+        net_a.fit(x, y, epochs=3, batch_size=4)
+        net_b.fit(x, y, epochs=3, batch_size=4)
+        np.testing.assert_allclose(np.asarray(net_a.output(x)),
+                                   np.asarray(net_b.output(x)), atol=1e-4)
+
+    def test_json_roundtrip_preserves_format(self):
+        conf = _small_cnn_conf().use_cnn_data_format("NHWC")
+        conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+        assert conf2.layers[0].data_format == "NHWC"
+        assert conf2.preprocessors[0].data_format == "NHWC"
+
+    def test_cnn_input_to_dense_entry_flatten_stays_nchw(self):
+        """CNN input straight into a dense layer: the entry CnnToFF
+        preprocessor consumes the public NCHW input and must keep DL4J
+        flat order even when the net is switched to NHWC."""
+        def conf():
+            return (NeuralNetConfiguration.Builder()
+                    .seed(5).updater(Sgd(0.1)).list()
+                    .layer(L.DenseLayer(n_out=6, activation="relu"))
+                    .layer(L.OutputLayer(n_out=3, activation="softmax",
+                                         loss="negativeloglikelihood"))
+                    .set_input_type(InputType.convolutional(4, 4, 2))
+                    .build())
+        x = np.random.default_rng(2).standard_normal(
+            (3, 2, 4, 4)).astype(np.float32)
+        net_a = MultiLayerNetwork(conf()).init()
+        net_b = MultiLayerNetwork(conf().use_cnn_data_format("NHWC")).init()
+        np.testing.assert_allclose(np.asarray(net_a.output(x)),
+                                   np.asarray(net_b.output(x)), atol=1e-6)
+
+    def test_one_pass_bn_large_mean_no_nan(self):
+        """fp32 cancellation in E[x^2]-mean^2 must not NaN the rsqrt."""
+        from deeplearning4j_tpu.nn.layers.normalization import batch_norm
+        import jax.numpy as jnp
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(1000.0 + 1e-3 * rng.standard_normal((8, 4, 16, 16)),
+                        jnp.float32)
+        g = jnp.ones(4); b = jnp.zeros(4)
+        y, m, v = batch_norm(x, g, b, jnp.zeros(4), jnp.ones(4), train=True)
+        assert np.isfinite(np.asarray(y)).all()
+        assert np.isfinite(np.asarray(v)).all() and (np.asarray(v) >= 0).all()
+
+
+def _residual_graph_conf():
+    return (NeuralNetConfiguration.Builder()
+            .seed(3)
+            .updater(Sgd(0.05))
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.convolutional(8, 8, 3))
+            .add_layer("c1", L.ConvolutionLayer(n_out=8, kernel=(3, 3),
+                                                convolution_mode="same"),
+                       "in")
+            .add_layer("bn1", L.BatchNormalization(activation="relu"), "c1")
+            .add_layer("c2", L.ConvolutionLayer(n_out=8, kernel=(3, 3),
+                                                convolution_mode="same"),
+                       "bn1")
+            .add_vertex("res", ElementWiseVertex(op="add"), "bn1", "c2")
+            .add_vertex("mrg", MergeVertex(), "res", "bn1")
+            .add_layer("gp", L.GlobalPoolingLayer(pooling_type="avg"), "mrg")
+            .add_layer("out", L.OutputLayer(n_out=4, activation="softmax",
+                                            loss="negativeloglikelihood"),
+                       "gp")
+            .set_outputs("out")
+            .build())
+
+
+class TestGraphNhwc:
+    def test_output_and_training_equivalence(self):
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((4, 3, 8, 8)).astype(np.float32)
+        y = np.zeros((4, 4), np.float32)
+        y[np.arange(4), rng.integers(0, 4, 4)] = 1.0
+
+        net_a = ComputationGraph(_residual_graph_conf()).init()
+        net_b = ComputationGraph(
+            _residual_graph_conf().use_cnn_data_format("NHWC")).init()
+        np.testing.assert_allclose(
+            np.asarray(net_a.output(x)[0]), np.asarray(net_b.output(x)[0]),
+            atol=1e-5)
+        for _ in range(3):
+            net_a._fit_batch(DataSet({"in": x}, {"out": y}))
+            net_b._fit_batch(DataSet({"in": x}, {"out": y}))
+        np.testing.assert_allclose(
+            np.asarray(net_a.output(x)[0]), np.asarray(net_b.output(x)[0]),
+            atol=1e-4)
+
+    def test_subset_poolhelper_nhwc(self):
+        """SubsetVertex/PoolHelperVertex slice the right axes under NHWC."""
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.conf.graph_conf import (
+            PoolHelperVertex, SubsetVertex,
+        )
+        x_nchw = jnp.arange(2 * 6 * 4 * 4, dtype=jnp.float32
+                            ).reshape(2, 6, 4, 4)
+        x_nhwc = x_nchw.transpose(0, 2, 3, 1)
+        sv_a = SubsetVertex(from_index=1, to_index=3)
+        sv_b = SubsetVertex(from_index=1, to_index=3, data_format="NHWC")
+        ya, _ = sv_a.apply({}, [x_nchw], {})
+        yb, _ = sv_b.apply({}, [x_nhwc], {})
+        np.testing.assert_allclose(np.asarray(ya),
+                                   np.asarray(yb.transpose(0, 3, 1, 2)))
+        ph_a = PoolHelperVertex()
+        ph_b = PoolHelperVertex(data_format="NHWC")
+        ya, _ = ph_a.apply({}, [x_nchw], {})
+        yb, _ = ph_b.apply({}, [x_nhwc], {})
+        np.testing.assert_allclose(np.asarray(ya),
+                                   np.asarray(yb.transpose(0, 3, 1, 2)))
+
+    def test_zoo_resnet_nhwc(self):
+        from deeplearning4j_tpu.zoo import ResNet50
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 3, 32, 32)).astype(np.float32)
+        net_a = ResNet50(num_classes=7, height=32, width=32).init()
+        net_b = ResNet50(num_classes=7, height=32, width=32,
+                         data_format="NHWC").init()
+        # same seed -> same params; outputs must agree across layouts
+        ya = np.asarray(net_a.output(x)[0])
+        yb = np.asarray(net_b.output(x)[0])
+        np.testing.assert_allclose(ya, yb, atol=1e-4)
