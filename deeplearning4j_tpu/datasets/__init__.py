@@ -14,5 +14,7 @@ from deeplearning4j_tpu.datasets.fetchers import (  # noqa: F401
     CifarDataSetIterator,
     EmnistDataSetIterator,
     IrisDataSetIterator,
+    LFWDataSetIterator,
     MnistDataSetIterator,
+    SvhnDataSetIterator,
 )

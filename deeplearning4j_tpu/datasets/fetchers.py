@@ -1,8 +1,9 @@
-"""Dataset fetchers/iterators: MNIST, EMNIST, CIFAR-10, Iris.
+"""Dataset fetchers/iterators: MNIST, EMNIST, CIFAR-10, LFW, SVHN, Iris.
 
 Equivalent of deeplearning4j-core base/MnistFetcher.java, EmnistFetcher.java,
 datasets/fetchers/MnistDataFetcher.java, datasets/iterator/impl/
-{Mnist,Emnist,Cifar,Iris}DataSetIterator and the datasets/mnist/ IDX readers.
+{Mnist,Emnist,Cifar,LFW,Iris}DataSetIterator, base/LFWDataFetcher.java and
+the datasets/mnist/ IDX readers.
 
 The reference downloads archives at construction time; this environment is
 zero-egress, so fetchers read from a local data directory
@@ -160,6 +161,117 @@ class CifarDataSetIterator(ArrayDataSetIterator):
             labels = recs[:, 0]
             imgs = recs[:, 1:].reshape(-1, 3, 32, 32)
         x = u8_to_f32(np.ascontiguousarray(imgs)).reshape(-1, 3, 32, 32)
+        y = _one_hot(labels, self.NUM_CLASSES)
+        super().__init__(x, y, batch_size=batch_size, shuffle=train,
+                         seed=seed)
+
+
+class LFWDataSetIterator(ArrayDataSetIterator):
+    """Labeled Faces in the Wild (ref: datasets/iterator/impl/
+    LFWDataSetIterator.java + fetchers/LFWDataFetcher.java).
+
+    Reads the standard extracted layout ``<data_dir>/lfw/<person>/<img>``
+    (one directory per identity, jpg/png inside), decodes + resizes on the
+    host, labels = identity index sorted by name. ``num_labels`` keeps the
+    N most-frequent identities like the reference's subset mode.
+    Features [N, C, H, W] scaled to [0,1]. ``synthetic=True`` generates a
+    deterministic stand-in with the real shapes (zero-egress testing).
+    """
+
+    def __init__(self, batch_size: int, image_shape: Tuple[int, int, int] = (250, 250, 3),
+                 num_examples: Optional[int] = None,
+                 num_labels: Optional[int] = None, train: bool = True,
+                 split_train_test: float = 1.0,
+                 data_dir: Optional[str] = None, seed: int = 123,
+                 synthetic: bool = False):
+        h, w, c = image_shape
+        if synthetic:
+            n = num_examples or 200
+            classes = num_labels or 10
+            imgs, labels = _synthetic_images(n, (c, h, w), classes, seed)
+            x = u8_to_f32(np.ascontiguousarray(imgs)).reshape(-1, c, h, w)
+            self.num_classes = classes
+            self.label_names = [f"person_{i}" for i in range(classes)]
+        else:
+            from PIL import Image
+            base = os.path.join(data_dir or DEFAULT_DATA_DIR, "lfw")
+            if not os.path.isdir(base):
+                raise FileNotFoundError(
+                    f"LFW directory {base!r} not found. This build is "
+                    "zero-egress: extract lfw.tgz there manually (or pass "
+                    "synthetic=True).")
+            exts = (".jpg", ".jpeg", ".png")
+            people = sorted(d for d in os.listdir(base)
+                            if os.path.isdir(os.path.join(base, d)))
+            counts = {p: sum(1 for f in os.listdir(os.path.join(base, p))
+                             if f.lower().endswith(exts))
+                      for p in people}
+            if num_labels:
+                people = sorted(sorted(people, key=lambda p: -counts[p])
+                                [:num_labels])
+            self.label_names = people
+            self.num_classes = len(people)
+            xs, ys = [], []
+            for li, person in enumerate(people):
+                pdir = os.path.join(base, person)
+                for fn in sorted(os.listdir(pdir)):
+                    if not fn.lower().endswith(exts):
+                        continue
+                    img = Image.open(os.path.join(pdir, fn))
+                    img = img.convert("RGB" if c == 3 else "L")
+                    img = img.resize((w, h))
+                    a = np.asarray(img, np.uint8)
+                    if c == 1:
+                        a = a[:, :, None]
+                    xs.append(a.transpose(2, 0, 1))  # HWC -> CHW
+                    ys.append(li)
+                    if num_examples and len(xs) >= num_examples:
+                        break
+                if num_examples and len(xs) >= num_examples:
+                    break
+            imgs = np.stack(xs)
+            labels = np.asarray(ys)
+            x = u8_to_f32(np.ascontiguousarray(imgs)).reshape(imgs.shape)
+        if split_train_test < 1.0:
+            cut = int(len(x) * split_train_test)
+            rng = np.random.default_rng(seed)
+            order = rng.permutation(len(x))
+            keep = order[:cut] if train else order[cut:]
+            x, labels = x[keep], labels[keep]
+        y = _one_hot(labels, self.num_classes)
+        super().__init__(x, y, batch_size=batch_size, shuffle=train,
+                         seed=seed)
+
+
+class SvhnDataSetIterator(ArrayDataSetIterator):
+    """Street View House Numbers, cropped-digits format (ref: the
+    SVHN fetcher family in later DL4J; 0.9.x lists SVHN in its dataset
+    roster). Reads the stanford ``train_32x32.mat``/``test_32x32.mat``
+    (matlab v5 via scipy.io) from the data dir. Features [N,3,32,32] in
+    [0,1]; label "10" (zero digit) remapped to class 0 like the usual
+    SVHN convention."""
+
+    NUM_CLASSES = 10
+
+    def __init__(self, batch_size: int, train: bool = True,
+                 data_dir: Optional[str] = None, seed: int = 123,
+                 synthetic: bool = False,
+                 num_examples: Optional[int] = None):
+        if synthetic:
+            imgs, labels = _synthetic_images(
+                num_examples or 2000, (3, 32, 32), self.NUM_CLASSES, seed)
+            x = u8_to_f32(np.ascontiguousarray(imgs)).reshape(-1, 3, 32, 32)
+        else:
+            from scipy.io import loadmat
+            name = "train_32x32.mat" if train else "test_32x32.mat"
+            mat = loadmat(_resolve(data_dir, name))
+            imgs = mat["X"]            # [32, 32, 3, N]
+            labels = mat["y"].ravel().astype(np.int64)
+            labels[labels == 10] = 0   # '0' digit stored as 10
+            imgs = np.ascontiguousarray(imgs.transpose(3, 2, 0, 1))  # NCHW
+            if num_examples:
+                imgs, labels = imgs[:num_examples], labels[:num_examples]
+            x = u8_to_f32(imgs).reshape(imgs.shape)
         y = _one_hot(labels, self.NUM_CLASSES)
         super().__init__(x, y, batch_size=batch_size, shuffle=train,
                          seed=seed)

@@ -19,6 +19,7 @@ from deeplearning4j_tpu.nlp.sentence import (
 )
 from deeplearning4j_tpu.nlp.vocab import VocabWord, VocabCache, VocabConstructor
 from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
+from deeplearning4j_tpu.nlp.distributed import DistributedSequenceVectors
 from deeplearning4j_tpu.nlp.word2vec import Word2Vec
 from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
 from deeplearning4j_tpu.nlp.glove import Glove
@@ -40,6 +41,7 @@ __all__ = [
     "SimpleLabelAwareIterator", "FileLabelAwareIterator",
     "VocabWord", "VocabCache", "VocabConstructor",
     "SequenceVectors", "Word2Vec", "ParagraphVectors", "Glove",
+    "DistributedSequenceVectors",
     "write_word_vectors", "read_word_vectors", "write_word2vec_binary",
     "read_word2vec_binary",
     "BagOfWordsVectorizer", "TfidfVectorizer", "CnnSentenceDataSetIterator",

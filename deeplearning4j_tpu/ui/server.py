@@ -99,6 +99,45 @@ refresh(); setInterval(refresh, 3000);
 </script></body></html>
 """
 
+_TSNE_PAGE = """<!DOCTYPE html>
+<html><head><title>t-SNE — deeplearning4j_tpu UI</title>
+<style>
+body{font-family:sans-serif;margin:20px;background:#fafafa}
+h1{font-size:20px} #meta{color:#555;font-size:13px}
+canvas{border:1px solid #ccc;background:#fff}
+</style></head>
+<body>
+<h1>t-SNE plot</h1>
+<div id="meta"></div>
+<canvas id="plot" width="800" height="800"></canvas>
+<script>
+async function refresh(){
+  const sids = await (await fetch('/tsne/sessions')).json();
+  if(!sids.length){document.getElementById('meta').textContent=
+    'no t-SNE data uploaded (POST /tsne/upload)'; return;}
+  const sid = sids[sids.length-1];
+  const d = await (await fetch('/tsne/coords?sid='+
+                   encodeURIComponent(sid))).json();
+  document.getElementById('meta').textContent =
+    'session '+sid+' — '+d.coords.length+' points';
+  const cv=document.getElementById('plot'), ctx=cv.getContext('2d');
+  ctx.clearRect(0,0,cv.width,cv.height);
+  const xs=d.coords.map(p=>p[0]), ys=d.coords.map(p=>p[1]);
+  const xmin=Math.min(...xs), xmax=Math.max(...xs,xmin+1e-9);
+  const ymin=Math.min(...ys), ymax=Math.max(...ys,ymin+1e-9);
+  const X=x=>20+(x-xmin)/(xmax-xmin)*(cv.width-40);
+  const Y=y=>cv.height-20-(y-ymin)/(ymax-ymin)*(cv.height-40);
+  ctx.font='10px sans-serif'; ctx.fillStyle='#1976d2';
+  d.coords.forEach((p,i)=>{
+    ctx.beginPath();ctx.arc(X(p[0]),Y(p[1]),2,0,6.3);ctx.fill();
+    if(d.labels && d.labels[i]!=null)
+      ctx.fillText(String(d.labels[i]),X(p[0])+3,Y(p[1])-3);
+  });
+}
+refresh(); setInterval(refresh, 5000);
+</script></body></html>
+"""
+
 
 class _Handler(BaseHTTPRequestHandler):
     server_version = "dl4jtpu-ui/0.1"
@@ -135,11 +174,50 @@ class _Handler(BaseHTTPRequestHandler):
             if sid is None:
                 return self._json({"error": "sid required"}, 400)
             return self._json(self._overview(storages, sid))
+        # t-SNE module (ref: ui/module/tsne/TsneModule.java — upload +
+        # per-session coordinate plots)
+        if path in ("/tsne", "/tsne/"):
+            body = _TSNE_PAGE.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if path == "/tsne/sessions":
+            return self._json(list(self.server.tsne_sessions))
+        if path == "/tsne/coords":
+            sid = params.get("sid")
+            data = self.server.tsne_sessions.get(sid)
+            if data is None:
+                return self._json({"error": f"unknown session {sid!r}"}, 404)
+            return self._json(data)
         self._json({"error": "not found"}, 404)
 
     def do_POST(self):
+        path = self.path.partition("?")[0].rstrip("/")
+        # t-SNE upload (ref: TsneModule.java POST /tsne/upload/:sid)
+        if path == "/tsne/upload":
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                if not isinstance(payload, dict):
+                    raise ValueError("body must be a JSON object")
+                sid = str(payload.get("sessionId", "uploaded"))
+                coords = [[float(a), float(b)]
+                          for a, b in payload["coords"]]
+                labels = payload.get("labels")
+                if labels is not None:
+                    labels = [str(l) for l in labels]
+                    if len(labels) != len(coords):
+                        raise ValueError("labels/coords length mismatch")
+            except (KeyError, TypeError, ValueError) as e:
+                return self._json({"error": f"malformed payload: {e}"}, 400)
+            self.server.tsne_sessions[sid] = {"coords": coords,
+                                              "labels": labels}
+            return self._json({"status": "ok", "sessionId": sid})
         # remote stats receiver (ref: RemoteReceiverModule.java)
-        if self.path.rstrip("/") != "/remoteReceive":
+        if path != "/remoteReceive":
             return self._json({"error": "not found"}, 404)
         if not self.server.remote_enabled:
             return self._json({"error": "remote receiver disabled"}, 403)
@@ -203,6 +281,7 @@ class UIServer:
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
         self._httpd.storages = []
         self._httpd.remote_enabled = False
+        self._httpd.tsne_sessions = {}
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
@@ -222,6 +301,22 @@ class UIServer:
     def detach(self, storage: StatsStorage) -> None:
         if storage in self._httpd.storages:
             self._httpd.storages.remove(storage)
+
+    def upload_tsne(self, coords, labels=None,
+                    session_id: str = "uploaded") -> None:
+        """Publish 2-D t-SNE coordinates to the /tsne tab (ref:
+        TsneModule.uploadFile — here arrays instead of a coord file;
+        pair with plot.tsne.Tsne/BarnesHutTsne.fit_transform)."""
+        import numpy as _np
+        c = _np.asarray(coords, float)
+        if c.ndim != 2 or c.shape[1] < 2:
+            raise ValueError("coords must be [N, 2+]")
+        data = {"coords": c[:, :2].tolist(),
+                "labels": None if labels is None
+                else [str(l) for l in labels]}
+        if data["labels"] is not None and len(data["labels"]) != len(c):
+            raise ValueError("labels/coords length mismatch")
+        self._httpd.tsne_sessions[session_id] = data
 
     def enable_remote_listener(self, storage: Optional[StatsStorage] = None):
         """ref: UIServer.enableRemoteListener — POSTs to /remoteReceive land

@@ -2,6 +2,7 @@
 MnistFetcherTest pattern — local IDX fixtures instead of downloads)."""
 
 import gzip
+import os
 import struct
 
 import numpy as np
@@ -153,3 +154,100 @@ class TestIris:
         acc = (np.asarray(net.output(b.features)).argmax(1)
                == b.labels.argmax(1)).mean()
         assert acc > 0.85, f"iris accuracy {acc}"
+
+
+class TestLFW:
+    def _make_lfw(self, root):
+        """Tiny lfw/ tree: 3 people, 2-4 images each."""
+        from PIL import Image
+        base = os.path.join(root, "lfw")
+        rng = np.random.default_rng(5)
+        counts = {"Aaron_A": 4, "Betty_B": 2, "Carl_C": 3}
+        for person, n in counts.items():
+            d = os.path.join(base, person)
+            os.makedirs(d)
+            for i in range(n):
+                a = rng.integers(0, 256, (40, 30, 3), np.uint8)
+                Image.fromarray(a).save(os.path.join(d, f"{person}_{i}.jpg"))
+        return base
+
+    def test_directory_layout(self, tmp_path):
+        from deeplearning4j_tpu.datasets import LFWDataSetIterator
+        self._make_lfw(str(tmp_path))
+        it = LFWDataSetIterator(batch_size=4, image_shape=(24, 24, 3),
+                                data_dir=str(tmp_path))
+        assert it.num_classes == 3
+        assert it.label_names == ["Aaron_A", "Betty_B", "Carl_C"]
+        ds = next(iter(it))
+        assert ds.features.shape == (4, 3, 24, 24)
+        assert ds.labels.shape == (4, 3)
+        assert 0.0 <= ds.features.min() and ds.features.max() <= 1.0
+
+    def test_num_labels_subset(self, tmp_path):
+        from deeplearning4j_tpu.datasets import LFWDataSetIterator
+        self._make_lfw(str(tmp_path))
+        it = LFWDataSetIterator(batch_size=4, image_shape=(16, 16, 1),
+                                data_dir=str(tmp_path), num_labels=2,
+                                train=False, split_train_test=0.5)
+        # 2 most frequent identities: Aaron_A (4), Carl_C (3)
+        assert it.label_names == ["Aaron_A", "Carl_C"]
+        ds = next(iter(it))
+        assert ds.features.shape[1:] == (1, 16, 16)
+
+    def test_synthetic(self):
+        from deeplearning4j_tpu.datasets import LFWDataSetIterator
+        it = LFWDataSetIterator(batch_size=8, image_shape=(32, 32, 3),
+                                num_examples=24, num_labels=4,
+                                synthetic=True)
+        batches = list(it)
+        assert sum(b.features.shape[0] for b in batches) == 24
+        assert batches[0].labels.shape[1] == 4
+
+
+class TestSvhn:
+    def test_mat_format(self, tmp_path):
+        from scipy.io import savemat
+        from deeplearning4j_tpu.datasets import SvhnDataSetIterator
+        rng = np.random.default_rng(3)
+        n = 12
+        X = rng.integers(0, 256, (32, 32, 3, n), np.uint8)
+        y = rng.integers(1, 11, (n, 1))  # matlab labels 1..10
+        savemat(os.path.join(tmp_path, "train_32x32.mat"), {"X": X, "y": y})
+        it = SvhnDataSetIterator(batch_size=6, data_dir=str(tmp_path),
+                                 train=True)
+        ds = next(iter(it))
+        assert ds.features.shape == (6, 3, 32, 32)
+        assert ds.labels.shape == (6, 10)
+
+    def test_label_ten_remaps_to_zero(self, tmp_path):
+        from scipy.io import savemat
+        from deeplearning4j_tpu.datasets import SvhnDataSetIterator
+        X = np.zeros((32, 32, 3, 2), np.uint8)
+        savemat(os.path.join(tmp_path, "test_32x32.mat"),
+                {"X": X, "y": np.array([[10], [3]])})
+        it = SvhnDataSetIterator(batch_size=2, data_dir=str(tmp_path),
+                                 train=False)
+        labels = np.asarray(next(iter(it)).labels)
+        assert labels[0].argmax() == 0 and labels[0].sum() == 1
+        assert labels[1].argmax() == 3
+
+    def test_pixel_transpose_correct(self, tmp_path):
+        """X[h,w,c,n] must land at features[n,c,h,w]."""
+        from scipy.io import savemat
+        from deeplearning4j_tpu.datasets import SvhnDataSetIterator
+        X = np.zeros((32, 32, 3, 1), np.uint8)
+        X[2, 5, 1, 0] = 255  # h=2, w=5, channel=1
+        savemat(os.path.join(tmp_path, "test_32x32.mat"),
+                {"X": X, "y": np.array([[3]])})
+        it = SvhnDataSetIterator(batch_size=1, data_dir=str(tmp_path),
+                                 train=False)
+        ds = next(iter(it))
+        f = np.asarray(ds.features)
+        assert f[0, 1, 2, 5] == 1.0 and f.sum() == 1.0
+
+    def test_synthetic(self):
+        from deeplearning4j_tpu.datasets import SvhnDataSetIterator
+        it = SvhnDataSetIterator(batch_size=16, synthetic=True,
+                                 num_examples=32)
+        ds = next(iter(it))
+        assert ds.features.shape == (16, 3, 32, 32)

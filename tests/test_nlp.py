@@ -239,3 +239,72 @@ class TestWindowingRegression:
         gl = Glove(layer_size=8, epochs=1)
         gl.build_vocab([["x", "y", "z"]] * 2)
         assert gl.syn1 is None
+
+
+class TestDistributedSequenceVectors:
+    """TPU-native stand-in for dl4j-spark-nlp cluster Word2Vec: SPMD
+    shard_map dispatch over an 8-virtual-device mesh (SURVEY §2.5 map)."""
+
+    def _mesh(self):
+        import jax
+        from jax.sharding import Mesh
+        return Mesh(np.array(jax.devices()[:8]), ("data",))
+
+    def test_matches_single_device_exactly(self):
+        """Distributed step == single-device step on the same global batch
+        (the Spark-vs-single-machine equivalence invariant)."""
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nlp.sequencevectors import _ns_step
+        from deeplearning4j_tpu.nlp.distributed import DistributedSequenceVectors
+        from deeplearning4j_tpu.nlp import Word2Vec, CollectionSentenceIterator
+
+        w2v = Word2Vec(sentence_iterator=CollectionSentenceIterator(corpus(40)),
+                       min_word_frequency=1, layer_size=16, negative=3,
+                       use_hierarchic_softmax=False, seed=4)
+        w2v.build_vocab([s.split() for s in corpus(40)])
+        dist = DistributedSequenceVectors(w2v, self._mesh())
+        rng = np.random.default_rng(0)
+        B = w2v._eff_batch
+        V = w2v.vocab.num_words()
+        bi = rng.integers(0, V, B).astype(np.int32)
+        bo = rng.integers(0, V, B).astype(np.int32)
+        alphas = np.full(B, 0.02, np.float32)
+        syn0_before = jnp.asarray(w2v.syn0)
+        syn1_before = jnp.asarray(w2v.syn1neg)
+        # single-device reference on the same batch + same negatives
+        state = np.random.default_rng(99)
+        w2v._rng = np.random.default_rng(7)
+        targets, labels = w2v._sample_negatives(bo)
+        ref0, ref1 = _ns_step(syn0_before, syn1_before, jnp.asarray(bi),
+                              jnp.asarray(targets), jnp.asarray(labels),
+                              jnp.ones(B, np.float32),
+                              jnp.asarray(alphas))
+        # distributed on the same batch: re-seed so negatives match
+        w2v._rng = np.random.default_rng(7)
+        dist._dispatch_sg(bi, bo, alphas)
+        np.testing.assert_allclose(np.asarray(w2v.syn0), np.asarray(ref0),
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(w2v.syn1neg), np.asarray(ref1),
+                                   atol=1e-6)
+
+    @pytest.mark.parametrize("kwargs", [
+        dict(negative=5, use_hierarchic_softmax=False),
+        dict(negative=0),  # hierarchical softmax
+    ])
+    def test_trains_and_clusters_on_mesh(self, kwargs):
+        from deeplearning4j_tpu.nlp import Word2Vec, CollectionSentenceIterator
+        from deeplearning4j_tpu.nlp.distributed import DistributedSequenceVectors
+        w2v = Word2Vec(sentence_iterator=CollectionSentenceIterator(corpus()),
+                       min_word_frequency=1, layer_size=16, window=3,
+                       epochs=3, learning_rate=0.05, seed=1, **kwargs)
+        dist = DistributedSequenceVectors(w2v, self._mesh())
+        dist.fit()
+        assert dist.similarity("cat", "dog") > dist.similarity("cat", "bread")
+
+    def test_cbow_rejected(self):
+        from deeplearning4j_tpu.nlp import Word2Vec, CollectionSentenceIterator
+        from deeplearning4j_tpu.nlp.distributed import DistributedSequenceVectors
+        w2v = Word2Vec(sentence_iterator=CollectionSentenceIterator(corpus(5)),
+                       elements_learning_algorithm="cbow")
+        with pytest.raises(NotImplementedError):
+            DistributedSequenceVectors(w2v, self._mesh())

@@ -2,6 +2,7 @@
 TestStatsListener, TestStatsStorage, TestRemoteReceiver)."""
 
 import json
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -235,3 +236,54 @@ class TestTrainingStats:
         s = pw.stats.summary()
         assert s["step"]["count"] == 8
         assert "etl" in s
+
+
+class TestTsneModule:
+    """ref: deeplearning4j-ui-parent ui/module/tsne/TsneModule.java —
+    upload coordinates, list sessions, fetch per-session coords, HTML tab."""
+
+    def test_upload_and_fetch(self):
+        import json as _json
+        from deeplearning4j_tpu.plot.tsne import Tsne
+        srv = UIServer(port=0)
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            # programmatic upload via the plot pipeline
+            rng = np.random.default_rng(0)
+            X = np.concatenate([rng.normal(0, 1, (10, 5)),
+                                rng.normal(8, 1, (10, 5))])
+            Y = Tsne(n_components=2, perplexity=5.0, max_iter=30,
+                     seed=1).fit_transform(X)
+            srv.upload_tsne(Y, labels=[f"p{i}" for i in range(20)],
+                            session_id="words")
+            with urllib.request.urlopen(base + "/tsne/sessions") as r:
+                assert _json.loads(r.read()) == ["words"]
+            with urllib.request.urlopen(base + "/tsne/coords?sid=words") as r:
+                d = _json.loads(r.read())
+            assert len(d["coords"]) == 20 and len(d["coords"][0]) == 2
+            assert d["labels"][3] == "p3"
+            # HTTP upload path (remote client)
+            payload = _json.dumps({"sessionId": "up2",
+                                   "coords": [[0.0, 1.0], [2.0, 3.0]],
+                                   "labels": ["a", "b"]}).encode()
+            req = urllib.request.Request(base + "/tsne/upload", data=payload,
+                                         method="POST")
+            with urllib.request.urlopen(req) as r:
+                assert _json.loads(r.read())["status"] == "ok"
+            with urllib.request.urlopen(base + "/tsne/coords?sid=up2") as r:
+                assert _json.loads(r.read())["coords"] == [[0.0, 1.0],
+                                                           [2.0, 3.0]]
+            # the tab renders
+            with urllib.request.urlopen(base + "/tsne") as r:
+                assert b"t-SNE" in r.read()
+            # malformed upload rejected
+            bad = urllib.request.Request(
+                base + "/tsne/upload", data=b'{"coords": "nope"}',
+                method="POST")
+            try:
+                urllib.request.urlopen(bad)
+                assert False, "expected 400"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        finally:
+            srv.stop()
