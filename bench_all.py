@@ -8,12 +8,15 @@ Prints one JSON line per config:
   tokens/sec through the jitted train step (lax.scan recurrence — measured
   14x faster than the pallas per-step kernel on v5e, see PERF.md)
 - lenet_train: LeNet MNIST-shape throughput (BASELINE config[0])
+- vgg16_train: VGG16 training throughput (BASELINE config[1])
+- keras_inceptionv3_infer: InceptionV3-topology .h5 import -> batched
+  inference (BASELINE config[3]; graph built programmatically, zero-egress)
 - scaling_8dev: data-parallel ResNet step on an 8-device mesh. On real
   multi-chip hardware this measures ICI allreduce scaling; on a single-chip
   host it falls back to the 8-virtual-CPU-device mesh and reports
   correctness-path throughput only (flagged "virtual").
 
-Usage: python bench_all.py [resnet|lstm|lenet|scaling]...
+Usage: python bench_all.py [resnet|lstm|lenet|vgg16|inception|scaling]...
 """
 
 import json
@@ -114,6 +117,77 @@ def bench_lenet():
                       "unit": "images/sec"}))
 
 
+def bench_vgg16():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning4j_tpu.zoo import VGG16
+    from deeplearning4j_tpu.nn.updater import Nesterovs
+
+    B = int(os.environ.get("BENCH_VGG_BATCH", "64"))
+    net = VGG16(num_classes=1000, updater=Nesterovs(0.01, momentum=0.9),
+                data_format="NHWC").init()
+    net.conf.dtype = "bfloat16"
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((B, 3, 224, 224)).astype(np.float32))
+    y = np.zeros((B, 1000), np.float32)
+    y[np.arange(B), rng.integers(0, 1000, B)] = 1.0
+    step = net._get_train_step(False)
+    key = jax.random.PRNGKey(0)
+    if hasattr(net.conf, "network_inputs"):  # graph
+        args = (net.params, net.state, net.updater_state,
+                {net.conf.network_inputs[0]: x},
+                {net.conf.network_outputs[0]: jnp.asarray(y)}, key,
+                None, None)
+    else:
+        args = (net.params, net.state, net.updater_state, x,
+                jnp.asarray(y), key, None, None)
+    _, args = _sync_time(step, args, 3)
+    dt, _ = _sync_time(step, args, 10)
+    print(json.dumps({"metric": "vgg16_train", "value": round(B * 10 / dt, 1),
+                      "unit": "images/sec"}))
+
+
+def bench_keras_inception():
+    """BASELINE config[3]: InceptionV3-topology .h5 import -> inference."""
+    import sys as _sys
+    import tempfile
+    import jax.numpy as jnp
+    import numpy as np
+    tests_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tests")
+    _sys.path.insert(0, tests_dir)
+    try:
+        from test_keras_import import (
+            _iv3_config_and_weights, write_keras_h5,
+        )
+    finally:
+        _sys.path.remove(tests_dir)
+    from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+
+    cfg, weights, _ = _iv3_config_and_weights(classes=1000)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "iv3.h5")
+        write_keras_h5(path, cfg, weights)
+        net = KerasModelImport.import_keras_model_and_weights(path)
+    B = int(os.environ.get("BENCH_IV3_BATCH", "32"))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((B, 3, 299, 299)).astype(np.float32))
+    def head(o):  # output() returns an array (single output) or a list
+        return o[0] if isinstance(o, (list, tuple)) else o
+
+    out = net.output(x)  # warmup/compile
+    float(jnp.sum(head(out)[:1, :1]))
+    t0 = time.perf_counter()
+    n = 10
+    for _ in range(n):
+        out = net.output(x)
+    float(jnp.sum(head(out)[:1, :1]))
+    dt = time.perf_counter() - t0
+    print(json.dumps({"metric": "keras_inceptionv3_infer",
+                      "value": round(B * n / dt, 1), "unit": "images/sec"}))
+
+
 def bench_scaling():
     import jax
     virtual = jax.device_count() < 8
@@ -162,9 +236,11 @@ def bench_scaling():
 
 
 ALL = {"resnet": bench_resnet, "lstm": bench_lstm, "lenet": bench_lenet,
+       "vgg16": bench_vgg16, "inception": bench_keras_inception,
        "scaling": bench_scaling}
 
 if __name__ == "__main__":
-    names = sys.argv[1:] or ["resnet", "lstm", "lenet", "scaling"]
+    names = sys.argv[1:] or ["resnet", "lstm", "lenet", "vgg16",
+                             "inception", "scaling"]
     for n in names:
         ALL[n]()

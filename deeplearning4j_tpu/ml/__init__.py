@@ -1,0 +1,19 @@
+"""ML-pipeline glue: scikit-learn-style estimator wrappers.
+
+TPU-native equivalent of deeplearning4j-scaleout/spark/dl4j-spark-ml
+(SparkDl4jNetwork.scala / SparkDl4jModel — Spark ML Estimator/Model pair
+fitting a MultiLayerConfiguration on a DataFrame, argmax `predict`,
+`output` vector; AutoEncoder.scala / AutoEncoderWrapper — unsupervised
+estimator exposing `compress`/`reconstruct`). The idiomatic Python
+pipeline framework is scikit-learn's fit/predict/transform protocol, so
+these wrappers target it (duck-typed — sklearn itself is not required);
+cluster training via Spark maps to mesh training via ParallelWrapper.
+"""
+
+from deeplearning4j_tpu.ml.sklearn import (
+    NetworkClassifier,
+    NetworkRegressor,
+    AutoEncoderEstimator,
+)
+
+__all__ = ["NetworkClassifier", "NetworkRegressor", "AutoEncoderEstimator"]

@@ -1,0 +1,177 @@
+"""scikit-learn-protocol estimators over MultiLayerNetwork.
+
+Mirrors dl4j-spark-ml's surface (SparkDl4jNetwork.scala train->model,
+SparkDl4jModel.predict = argmax / output = raw vector;
+AutoEncoderWrapper.scala compress/reconstruct) in the fit/predict/
+predict_proba/transform/score protocol. `mesh=` trains data-parallel via
+ParallelWrapper the way the reference's trainingMaster trains via Spark.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import one_hot
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+class _BaseNetworkEstimator:
+    def __init__(self, conf, epochs: int = 1, batch_size: int = 32,
+                 mesh=None, listeners: Sequence = ()):
+        self.conf = conf
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.mesh = mesh
+        self.listeners = list(listeners)
+        self.network_: Optional[MultiLayerNetwork] = None
+
+    # sklearn protocol pieces --------------------------------------------
+    def get_params(self, deep: bool = True) -> dict:
+        return {"conf": self.conf, "epochs": self.epochs,
+                "batch_size": self.batch_size, "mesh": self.mesh,
+                "listeners": self.listeners}
+
+    def set_params(self, **params) -> "_BaseNetworkEstimator":
+        valid = set(self.get_params())
+        for k, v in params.items():
+            if k not in valid:
+                raise ValueError(f"unknown parameter {k!r}; "
+                                 f"valid: {sorted(valid)}")
+            setattr(self, k, v)
+        return self
+
+    def _check_fitted(self):
+        if self.network_ is None:
+            raise RuntimeError("estimator is not fitted yet; call fit first")
+
+    def _fit_arrays(self, x: np.ndarray, y: np.ndarray) -> None:
+        net = MultiLayerNetwork(self.conf).init()
+        for lst in self.listeners:
+            net.add_listener(lst)
+        if self.mesh is not None:
+            from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+            pw = ParallelWrapper(net, mesh=self.mesh,
+                                 training_mode="allreduce")
+            pw.fit(x, y, epochs=self.epochs, batch_size=self.batch_size)
+        else:
+            net.fit(x, y, epochs=self.epochs, batch_size=self.batch_size)
+        self.network_ = net
+
+
+class NetworkClassifier(_BaseNetworkEstimator):
+    """Classification estimator (ref: SparkDl4jNetwork + SparkDl4jModel —
+    predict() argmax, output() raw network vector).
+
+    fit accepts integer class labels [N] or one-hot [N, K].
+    """
+
+    def fit(self, x, y) -> "NetworkClassifier":
+        x = np.asarray(x, np.float32)
+        y = np.asarray(y)
+        if y.ndim == 1:
+            self.classes_ = np.unique(y)
+            idx = np.searchsorted(self.classes_, y)
+            y = one_hot(idx, len(self.classes_))
+        else:
+            self.classes_ = np.arange(y.shape[1])
+        self._fit_arrays(x, y.astype(np.float32))
+        return self
+
+    def predict_proba(self, x) -> np.ndarray:
+        self._check_fitted()
+        return np.asarray(self.network_.output(np.asarray(x, np.float32)))
+
+    def predict(self, x) -> np.ndarray:
+        self._check_fitted()
+        return self.classes_[self.predict_proba(x).argmax(axis=1)]
+
+    def output(self, x) -> np.ndarray:
+        """Raw network output vector (ref: SparkDl4jModel.output)."""
+        return self.predict_proba(x)
+
+    def score(self, x, y) -> float:
+        y = np.asarray(y)
+        if y.ndim == 2:
+            y = self.classes_[y.argmax(axis=1)]
+        return float(np.mean(self.predict(x) == y))
+
+
+class NetworkRegressor(_BaseNetworkEstimator):
+    """Regression estimator (the reference's predict() returns the
+    continuous head output for regression nets)."""
+
+    def fit(self, x, y) -> "NetworkRegressor":
+        x = np.asarray(x, np.float32)
+        y = np.asarray(y, np.float32)
+        if y.ndim == 1:
+            y = y[:, None]
+        self._fit_arrays(x, y)
+        return self
+
+    def predict(self, x) -> np.ndarray:
+        self._check_fitted()
+        out = np.asarray(self.network_.output(np.asarray(x, np.float32)))
+        return out[:, 0] if out.shape[1] == 1 else out
+
+    def score(self, x, y) -> float:
+        """R^2, the sklearn regressor convention."""
+        y = np.asarray(y, np.float32)
+        if y.ndim == 2 and y.shape[1] == 1:
+            y = y[:, 0]
+        pred = self.predict(x)
+        ss_res = float(np.sum((y - pred) ** 2))
+        ss_tot = float(np.sum((y - np.mean(y)) ** 2)) or 1e-12
+        return 1.0 - ss_res / ss_tot
+
+
+class AutoEncoderEstimator(_BaseNetworkEstimator):
+    """Unsupervised autoencoder estimator (ref: AutoEncoder.scala /
+    AutoEncoderWrapper — fit on features only, `compress` to the bottleneck
+    activations, `reconstruct` back to input space).
+
+    `compress_layer` selects the bottleneck: index into the network's
+    layer activations (default = middle layer).
+    """
+
+    def __init__(self, conf, epochs: int = 1, batch_size: int = 32,
+                 mesh=None, listeners: Sequence = (),
+                 compress_layer: Optional[int] = None):
+        super().__init__(conf, epochs, batch_size, mesh, listeners)
+        self.compress_layer = compress_layer
+
+    def get_params(self, deep: bool = True) -> dict:
+        p = super().get_params(deep)
+        p["compress_layer"] = self.compress_layer
+        return p
+
+    def fit(self, x, y=None) -> "AutoEncoderEstimator":
+        x = np.asarray(x, np.float32)
+        self._fit_arrays(x, x)  # reconstruction target = input
+        return self
+
+    def _bottleneck_index(self) -> int:
+        if self.compress_layer is not None:
+            return self.compress_layer
+        return (len(self.network_.layers) - 1) // 2
+
+    def compress(self, x) -> np.ndarray:
+        """Bottleneck activations (ref: AutoEncoderWrapper.compress)."""
+        self._check_fitted()
+        acts = self.network_.feed_forward(np.asarray(x, np.float32))
+        return np.asarray(acts[self._bottleneck_index()])
+
+    transform = compress  # sklearn.Transformer spelling
+
+    def reconstruct(self, x) -> np.ndarray:
+        """Full forward pass back to input space
+        (ref: AutoEncoderWrapper.reconstruct)."""
+        self._check_fitted()
+        return np.asarray(self.network_.output(np.asarray(x, np.float32)))
+
+    def score(self, x, y=None) -> float:
+        """Negative mean reconstruction MSE (higher is better, sklearn
+        convention for unsupervised scores)."""
+        x = np.asarray(x, np.float32)
+        return -float(np.mean((self.reconstruct(x) - x) ** 2))

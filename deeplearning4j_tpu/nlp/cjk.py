@@ -6,24 +6,53 @@ fork), -korean 141 LoC). Those wrap large dictionary-driven morphological
 analyzers; this module provides dependency-free segmenters with the same
 TokenizerFactory SPI so CJK corpora flow through Word2Vec/ParagraphVectors:
 
-- Chinese: forward-maximum-match over a user dictionary when given one,
-  character (or character-bigram) segmentation otherwise — the standard
-  dictionary-free baseline for embeddings.
-- Japanese: character-class run segmentation (kanji / hiragana / katakana /
-  latin / digits), splitting at script boundaries — kuromoji-lite.
+- Chinese: with a dictionary, minimum-cost Viterbi over the word lattice
+  (ansj/jieba's algorithm — `nlp.lattice`); frequencies weight the path
+  like jieba's max-probability DAG. Greedy forward-maximum-match stays
+  available as ``engine="fmm"``. Without a dictionary: character (or
+  character-bigram) segmentation, the standard dictionary-free baseline.
+- Japanese: with a dictionary, the same lattice engine with kuromoji-style
+  unknown-word grouping by character class; without one, character-class
+  run segmentation (kanji / hiragana / katakana / latin / digits).
 - Korean: whitespace segmentation with optional particle (josa) stripping,
   mirroring the reference's Korean module (which is itself 141 lines of
   twitter-text wrapping).
 
-A real morphological analyzer (e.g. a mecab/kuromoji port) can be slotted
-in by subclassing TokenizerFactory — the SPI is the integration point.
+What is NOT shipped is the reference's multi-megabyte system dictionaries
+(ipadic / ansj library data) — load your own via
+``load_user_dictionary(path)`` (jieba-style ``word [freq] [pos]`` lines).
 """
 
 from __future__ import annotations
 
 from typing import Iterable, List, Optional, Set
 
+from deeplearning4j_tpu.nlp.lattice import (
+    Entry, ViterbiLattice, dict_from_frequencies,
+)
 from deeplearning4j_tpu.nlp.tokenization import Tokenizer, TokenizerFactory
+
+
+def load_user_dictionary(path: str):
+    """Parse a jieba/mecab-style user dictionary: one entry per line,
+    ``word [freq] [pos]`` (freq defaults to 1). Returns {word: (freq, pos)}."""
+    out = {}
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            parts = line.strip().split()
+            if not parts or parts[0].startswith("#"):
+                continue
+            word = parts[0]
+            freq = 1.0
+            pos = ""
+            if len(parts) > 1:
+                try:
+                    freq = float(parts[1])
+                    pos = parts[2] if len(parts) > 2 else ""
+                except ValueError:
+                    pos = parts[1]
+            out[word] = (freq, pos)
+    return out
 
 
 def _is_cjk(ch: str) -> bool:
@@ -57,17 +86,35 @@ def _char_class(ch: str) -> str:
 class ChineseTokenizerFactory(TokenizerFactory):
     """ref: deeplearning4j-nlp-chinese ChineseTokenizerFactory (ansj).
 
-    With a dictionary: greedy forward maximum match. Without: single
-    characters (``bigrams=True`` adds overlapping bigrams, a strong
-    baseline for embedding training).
+    With a dictionary: minimum-cost lattice segmentation (ansj/jieba
+    algorithm); pass ``frequencies={word: count}`` to weight the path by
+    corpus statistics, or a plain word iterable for uniform costs.
+    ``engine="fmm"`` selects greedy forward maximum match instead.
+    Without a dictionary: single characters (``bigrams=True`` adds
+    overlapping bigrams, a strong baseline for embedding training).
     """
 
     def __init__(self, dictionary: Optional[Iterable[str]] = None,
-                 bigrams: bool = False, preprocessor=None):
+                 frequencies: Optional[dict] = None,
+                 bigrams: bool = False, engine: str = "viterbi",
+                 preprocessor=None):
         super().__init__(preprocessor)
-        self.dictionary: Set[str] = set(dictionary or ())
+        if frequencies:
+            freqs = {w: (f[0] if isinstance(f, tuple) else f)
+                     for w, f in frequencies.items()}
+            for w in dictionary or ():  # plain words join at count 1
+                freqs.setdefault(w, 1.0)
+            self.dictionary: Set[str] = set(freqs)
+            entries = dict_from_frequencies(freqs)
+        else:
+            self.dictionary = set(dictionary or ())
+            entries = {w: Entry(cost=4.0) for w in self.dictionary}
         self.max_word = max((len(w) for w in self.dictionary), default=1)
         self.bigrams = bigrams
+        if engine not in ("viterbi", "fmm"):
+            raise ValueError(f"unknown engine {engine!r}")
+        self.engine = engine
+        self._lattice = ViterbiLattice(entries) if entries else None
 
     def create(self, text: str) -> Tokenizer:
         tokens: List[str] = []
@@ -76,7 +123,10 @@ class ChineseTokenizerFactory(TokenizerFactory):
                 tokens.extend(run.split())
                 continue
             if self.dictionary:
-                tokens.extend(self._max_match(run))
+                if self.engine == "viterbi":
+                    tokens.extend(s for s, _ in self._lattice.segment(run))
+                else:
+                    tokens.extend(self._max_match(run))
             else:
                 tokens.extend(run)
                 if self.bigrams:
@@ -116,15 +166,50 @@ def _runs(text: str):
 
 
 class JapaneseTokenizerFactory(TokenizerFactory):
-    """ref: deeplearning4j-nlp-japanese (kuromoji fork). Segments at
-    character-class boundaries: kanji runs, hiragana runs, katakana runs,
-    latin words, digit runs."""
+    """ref: deeplearning4j-nlp-japanese (kuromoji fork). With a
+    dictionary ({word: cost | (freq, pos)} or word iterable): kuromoji's
+    lattice algorithm — dictionary edges + unknown edges grouped by
+    character class, minimum-cost Viterbi path. Without one: segmentation
+    at character-class boundaries (kanji / hiragana / katakana / latin /
+    digit runs)."""
 
-    def __init__(self, preprocessor=None, split_kanji_chars: bool = False):
+    def __init__(self, preprocessor=None, split_kanji_chars: bool = False,
+                 dictionary=None):
         super().__init__(preprocessor)
         self.split_kanji_chars = split_kanji_chars
+        self._lattice = None
+        if dictionary:
+            if isinstance(dictionary, dict):
+                tuples = {w: v for w, v in dictionary.items()
+                          if isinstance(v, tuple)}
+                entries = {w: Entry(cost=float(v))
+                           for w, v in dictionary.items()
+                           if not isinstance(v, tuple)}
+                if tuples:  # (freq, pos) entries -> -log(p) like Chinese
+                    costs = dict_from_frequencies(
+                        {w: v[0] for w, v in tuples.items()})
+                    for w, e in costs.items():
+                        entries[w] = Entry(cost=e.cost, pos=tuples[w][1])
+            else:
+                entries = {w: Entry(cost=4.0) for w in dictionary}
+            self._lattice = ViterbiLattice(
+                entries, unknown_cost=9.0, char_class=_char_class,
+                group_unknown=True)
 
     def create(self, text: str) -> Tokenizer:
+        if self._lattice is not None:
+            tokens = []
+            for chunk in text.split():
+                for surf, pos in self._lattice.segment(chunk):
+                    if self.split_kanji_chars and pos == "UNK" and \
+                            all(map(_is_cjk, surf)):
+                        tokens.extend(surf)
+                    else:
+                        tokens.append(surf)
+            return Tokenizer(tokens, self._pre)
+        return self._runs_create(text)
+
+    def _runs_create(self, text: str) -> Tokenizer:
         tokens: List[str] = []
         cur, cur_cls = "", None
         for ch in text:

@@ -1,0 +1,162 @@
+"""Lattice (Viterbi) word segmentation — the core algorithm of the
+reference's dictionary-driven CJK analyzers (deeplearning4j-nlp-japanese's
+kuromoji fork and -chinese's ansj both build a word lattice over the
+sentence from a dictionary trie and take the minimum-cost path; their
+19.6k LoC is dominated by shipped dictionary data and codecs, not
+algorithm).
+
+Components:
+- Trie: prefix dictionary with common-prefix search (kuromoji
+  DoubleArrayTrie role, plain dict-of-dicts here).
+- ViterbiLattice: builds edges = dictionary words starting at each
+  position (+ unknown-word edges grouped by character class, kuromoji's
+  UnknownDictionary role) and runs shortest-path DP over
+  word_cost(edge) + connection_cost(prev_edge, edge).
+
+Costs: entries carry an explicit cost (mecab/kuromoji convention: lower =
+more likely). `dict_from_frequencies` converts count dictionaries
+(jieba-style) to -log(p) costs so "maximum probability path" and
+"minimum cost path" coincide.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+
+class Trie:
+    """Prefix dictionary: word -> payload, with all-prefix lookup."""
+
+    __slots__ = ("_root",)
+    _LEAF = 0  # key for payload inside a node dict
+
+    def __init__(self, items: Optional[Iterable[Tuple[str, object]]] = None):
+        self._root: Dict = {}
+        for w, v in items or ():
+            self.insert(w, v)
+
+    def insert(self, word: str, value: object) -> None:
+        node = self._root
+        for ch in word:
+            node = node.setdefault(ch, {})
+        node[self._LEAF] = value
+
+    def prefixes(self, text: str, start: int = 0):
+        """Yield (end_index, value) for every dictionary word that begins
+        at text[start] (kuromoji commonPrefixSearch)."""
+        node = self._root
+        i = start
+        n = len(text)
+        while i < n:
+            node = node.get(text[i])
+            if node is None:
+                return
+            i += 1
+            if self._LEAF in node:
+                yield i, node[self._LEAF]
+
+    def __contains__(self, word: str) -> bool:
+        node = self._root
+        for ch in word:
+            node = node.get(ch)
+            if node is None:
+                return False
+        return self._LEAF in node
+
+
+@dataclass
+class Entry:
+    """Dictionary entry: segmentation cost (lower = preferred) and an
+    optional part-of-speech tag carried through to the token."""
+
+    cost: float
+    pos: str = ""
+
+
+def dict_from_frequencies(freqs: Dict[str, float]) -> Dict[str, Entry]:
+    """jieba-style count dictionary -> -log(p) costs."""
+    total = sum(freqs.values()) or 1.0
+    return {w: Entry(cost=-math.log(max(c, 1e-12) / total))
+            for w, c in freqs.items()}
+
+
+@dataclass
+class _Node:
+    end: int
+    surface: str
+    cost: float          # edge cost
+    pos: str
+    total: float = math.inf   # best path cost up to and including this edge
+    prev: Optional["_Node"] = None
+
+
+class ViterbiLattice:
+    """Minimum-cost segmentation of a text run.
+
+    unknown_cost(ch) -> (cost, pos) prices a single-character unknown
+    edge; group_unknown merges ADJACENT unknown chars of the same
+    character class into one token after the DP (kuromoji's unknown-word
+    grouping), controlled by char_class.
+    """
+
+    def __init__(self, entries: Dict[str, Entry],
+                 unknown_cost: float = 12.0,
+                 connection_cost: Optional[Callable[[str, str], float]] = None,
+                 char_class: Optional[Callable[[str], str]] = None,
+                 group_unknown: bool = True):
+        self.trie = Trie((w, e) for w, e in entries.items())
+        self.unknown_cost = unknown_cost
+        self.conn = connection_cost or (lambda a, b: 0.0)
+        self.char_class = char_class
+        self.group_unknown = group_unknown and char_class is not None
+
+    def segment(self, text: str) -> List[Tuple[str, str]]:
+        """Return [(surface, pos)] along the minimum-cost path."""
+        n = len(text)
+        if n == 0:
+            return []
+        # ending[i] = edges that end at position i
+        ending: List[List[_Node]] = [[] for _ in range(n + 1)]
+        bos = _Node(0, "", 0.0, "BOS", total=0.0)
+        ending[0].append(bos)
+        for i in range(n):
+            if not ending[i]:
+                continue
+            # dictionary edges
+            edges = [_Node(end, text[i:end], e.cost, e.pos)
+                     for end, e in self.trie.prefixes(text, i)]
+            # unknown single-char edge (always available: no dead ends)
+            edges.append(_Node(i + 1, text[i], self.unknown_cost, "UNK"))
+            for node in edges:
+                best, best_prev = math.inf, None
+                for p in ending[i]:
+                    c = p.total + node.cost + self.conn(p.pos, node.pos)
+                    if c < best:
+                        best, best_prev = c, p
+                node.total, node.prev = best, best_prev
+                ending[node.end].append(node)
+        tail = min(ending[n], key=lambda nd: nd.total)
+        path: List[_Node] = []
+        while tail is not None and tail.surface:
+            path.append(tail)
+            tail = tail.prev
+        path.reverse()
+        toks = [(nd.surface, nd.pos) for nd in path]
+        if self.group_unknown:
+            toks = self._group(toks)
+        return toks
+
+    def _group(self, toks: List[Tuple[str, str]]) -> List[Tuple[str, str]]:
+        """Merge adjacent UNK tokens of the same character class
+        (kuromoji UnknownDictionary.GROUPING behavior)."""
+        out: List[Tuple[str, str]] = []
+        for surf, pos in toks:
+            if (pos == "UNK" and out and out[-1][1] == "UNK" and
+                    self.char_class(out[-1][0][-1]) ==
+                    self.char_class(surf[0])):
+                out[-1] = (out[-1][0] + surf, "UNK")
+            else:
+                out.append((surf, pos))
+        return out

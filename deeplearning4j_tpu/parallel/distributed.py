@@ -60,8 +60,14 @@ def initialize(config: Optional[VoidConfiguration] = None) -> None:
         return
     if config is None or config.coordinator_address is None:
         if os.environ.get("JAX_COORDINATOR_ADDRESS") or _on_cloud_tpu():
-            jax.distributed.initialize()
-            _initialized = True
+            try:
+                jax.distributed.initialize()
+                _initialized = True
+            except (ValueError, RuntimeError) as e:
+                # TPU-ish env vars present but no resolvable coordinator
+                # (e.g. a single tunneled chip) — run single-process
+                log.info("multi-host auto-init unavailable (%s); "
+                         "single-process mode", e)
         else:
             log.info("single-process mode (no coordinator configured)")
         return

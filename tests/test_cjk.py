@@ -62,3 +62,60 @@ class TestKorean:
         sv.build_vocab(seqs)
         sv.fit(seqs)
         assert sv.get_word_vector("我") is not None
+
+
+class TestLattice:
+    """Lattice/Viterbi engine (kuromoji/ansj core algorithm)."""
+
+    def test_ambiguity_resolved_by_frequency(self):
+        """jieba's classic case: 研究/生命 vs 研究生/命 — corpus counts
+        decide, not greedy longest match."""
+        freqs = {"研究": 100, "研究生": 5, "生命": 80, "命": 10,
+                 "起源": 50, "的": 200}
+        tf = ChineseTokenizerFactory(frequencies=freqs)
+        toks = tf.create("研究生命的起源").get_tokens()
+        assert toks == ["研究", "生命", "的", "起源"]
+        # greedy FMM gets this wrong — documents why viterbi is default
+        fmm = ChineseTokenizerFactory(dictionary=list(freqs),
+                                      engine="fmm")
+        assert fmm.create("研究生命的起源").get_tokens() == \
+            ["研究生", "命", "的", "起源"]
+
+    def test_unknown_chars_pass_through(self):
+        tf = ChineseTokenizerFactory(frequencies={"北京": 10})
+        toks = tf.create("我爱北京烤鸭").get_tokens()
+        assert "北京" in toks
+        assert "".join(toks) == "我爱北京烤鸭"
+
+    def test_japanese_dictionary_splits_inside_runs(self):
+        """Character-class runs can't split 東京/大学 (one kanji run);
+        the lattice with a dictionary can."""
+        runs = JapaneseTokenizerFactory().create("東京大学").get_tokens()
+        assert runs == ["東京大学"]
+        tf = JapaneseTokenizerFactory(dictionary=["東京", "大学"])
+        assert tf.create("東京大学").get_tokens() == ["東京", "大学"]
+
+    def test_japanese_unknown_grouping_by_class(self):
+        """OOV spans group by script like kuromoji's unknown dictionary."""
+        tf = JapaneseTokenizerFactory(dictionary=["東京"])
+        toks = tf.create("東京タワーすごい").get_tokens()
+        assert toks[0] == "東京"
+        assert "タワー" in toks  # katakana run grouped, not char-split
+
+    def test_user_dictionary_file(self, tmp_path):
+        from deeplearning4j_tpu.nlp.cjk import load_user_dictionary
+        p = tmp_path / "dict.txt"
+        p.write_text("# comment\n北京 100 ns\n烤鸭 20\n天安门\n",
+                     encoding="utf-8")
+        d = load_user_dictionary(str(p))
+        assert d["北京"] == (100.0, "ns")
+        assert d["烤鸭"] == (20.0, "")
+        assert d["天安门"] == (1.0, "")
+        tf = ChineseTokenizerFactory(frequencies=d)
+        assert tf.create("北京烤鸭").get_tokens() == ["北京", "烤鸭"]
+
+    def test_trie_prefix_search(self):
+        from deeplearning4j_tpu.nlp.lattice import Trie
+        t = Trie([("ab", 1), ("abc", 2), ("b", 3)])
+        assert list(t.prefixes("abcd")) == [(2, 1), (3, 2)]
+        assert "ab" in t and "abc" in t and "a" not in t

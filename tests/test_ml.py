@@ -1,0 +1,120 @@
+"""ML-pipeline glue tests (ref: dl4j-spark-ml SparkDl4jNetworkTest /
+AutoEncoderNetworkTest patterns — fit an estimator on a small frame,
+predict, check the model surface)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ml import (
+    AutoEncoderEstimator, NetworkClassifier, NetworkRegressor,
+)
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.updater import Adam
+
+
+def _blobs(n=120, seed=0):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 3, n)
+    centers = np.array([[0, 0], [4, 4], [0, 4]], np.float32)
+    x = centers[y] + rng.normal(0, 0.4, (n, 2)).astype(np.float32)
+    return x, y
+
+
+def _clf_conf():
+    return (NeuralNetConfiguration.Builder()
+            .seed(1).updater(Adam(0.05)).list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, loss="mcxent", activation="softmax"))
+            .set_input_type(InputType.feed_forward(2))
+            .build())
+
+
+class TestNetworkClassifier:
+    def test_fit_predict_score(self):
+        x, y = _blobs()
+        clf = NetworkClassifier(_clf_conf(), epochs=30, batch_size=32)
+        clf.fit(x, y)
+        assert clf.score(x, y) > 0.9
+        proba = clf.predict_proba(x[:5])
+        assert proba.shape == (5, 3)
+        np.testing.assert_allclose(proba.sum(1), 1.0, atol=1e-4)
+        # ref SparkDl4jModel.output returns the raw vector
+        np.testing.assert_allclose(clf.output(x[:5]), proba)
+
+    def test_string_labels(self):
+        x, y = _blobs(60)
+        names = np.array(["ant", "bee", "cat"])[y]
+        clf = NetworkClassifier(_clf_conf(), epochs=25, batch_size=32)
+        clf.fit(x, names)
+        assert set(clf.predict(x[:10])) <= {"ant", "bee", "cat"}
+        assert clf.score(x, names) > 0.8
+
+    def test_one_hot_labels_and_params(self):
+        x, y = _blobs(60)
+        onehot = np.eye(3, dtype=np.float32)[y]
+        clf = NetworkClassifier(_clf_conf(), epochs=5)
+        clf.set_params(epochs=20, batch_size=16).fit(x, onehot)
+        assert clf.get_params()["epochs"] == 20
+        with pytest.raises(ValueError):
+            clf.set_params(bogus=1)
+
+    def test_unfitted_raises(self):
+        clf = NetworkClassifier(_clf_conf())
+        with pytest.raises(RuntimeError):
+            clf.predict(np.zeros((1, 2), np.float32))
+
+    def test_mesh_training(self):
+        import jax
+        from deeplearning4j_tpu.parallel.mesh import make_mesh
+        x, y = _blobs(128)
+        mesh = make_mesh(devices=jax.devices()[:8])
+        clf = NetworkClassifier(_clf_conf(), epochs=30, batch_size=64,
+                                mesh=mesh)
+        clf.fit(x, y)
+        assert clf.score(x, y) > 0.9
+
+
+class TestNetworkRegressor:
+    def test_fit_r2(self):
+        rng = np.random.default_rng(2)
+        x = rng.uniform(-1, 1, (200, 3)).astype(np.float32)
+        y = (x @ np.array([1.5, -2.0, 0.5], np.float32) + 0.3)
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(2).updater(Adam(0.02)).list()
+                .layer(DenseLayer(n_out=16, activation="tanh"))
+                .layer(OutputLayer(n_out=1, loss="mse",
+                                   activation="identity"))
+                .set_input_type(InputType.feed_forward(3))
+                .build())
+        reg = NetworkRegressor(conf, epochs=60, batch_size=32)
+        reg.fit(x, y)
+        assert reg.score(x, y) > 0.9
+        assert reg.predict(x[:7]).shape == (7,)
+
+
+class TestAutoEncoderEstimator:
+    def test_compress_reconstruct(self):
+        rng = np.random.default_rng(4)
+        # data on a 2-D manifold inside 8-D space
+        z = rng.uniform(-1, 1, (300, 2)).astype(np.float32)
+        proj = rng.normal(0, 1, (2, 8)).astype(np.float32)
+        x = np.tanh(z @ proj)
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(4).updater(Adam(0.01)).list()
+                .layer(DenseLayer(n_out=4, activation="tanh"))
+                .layer(DenseLayer(n_out=2, activation="tanh"))
+                .layer(DenseLayer(n_out=4, activation="tanh"))
+                .layer(OutputLayer(n_out=8, loss="mse",
+                                   activation="identity"))
+                .set_input_type(InputType.feed_forward(8))
+                .build())
+        ae = AutoEncoderEstimator(conf, epochs=80, batch_size=64,
+                                  compress_layer=1)
+        ae.fit(x)
+        code = ae.compress(x[:10])
+        assert code.shape == (10, 2)           # bottleneck width
+        assert ae.transform(x[:3]).shape == (3, 2)
+        rec = ae.reconstruct(x[:10])
+        assert rec.shape == (10, 8)
+        assert ae.score(x) > -0.1              # reconstructs reasonably

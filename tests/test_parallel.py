@@ -140,3 +140,58 @@ class TestParallelInference:
             np.testing.assert_allclose(results[i], direct[i * 4:(i + 1) * 4],
                                        rtol=1e-5)
         pi.shutdown()
+
+
+class TestDistributedBackend:
+    """parallel.distributed multi-host utilities, exercised in their
+    single-process mode on the 8-virtual-device mesh (the reference tests
+    distributed semantics in-process too, SURVEY §4 local[N])."""
+
+    def test_initialize_single_process_noop(self):
+        from deeplearning4j_tpu.parallel import distributed as d
+        d.initialize()  # no coordinator configured -> logs + no-op
+        assert d.process_count() == 1
+        assert d.process_index() == 0
+
+    def test_global_mesh_and_local_batch(self):
+        from deeplearning4j_tpu.parallel import distributed as d
+        mesh = d.global_mesh()
+        assert int(np.prod(mesh.devices.shape)) == len(jax.devices())
+        assert d.host_local_batch(64) == 64  # one process owns it all
+
+    def test_make_global_array_feeds_train_step(self):
+        """Host-local shards -> globally sharded array -> PW train step;
+        result equals feeding the plain numpy batch."""
+        from deeplearning4j_tpu.parallel import distributed as d
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        mesh = d.global_mesh()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((16, 4)).astype(np.float32)
+        y = np.zeros((16, 3), np.float32)
+        y[np.arange(16), rng.integers(0, 3, 16)] = 1.0
+
+        gx = d.make_global_array(x, mesh)
+        assert gx.shape == (16, 4)
+        np.testing.assert_allclose(np.asarray(gx), x)
+
+        def build():
+            return MultiLayerNetwork(
+                (NeuralNetConfiguration.Builder()
+                 .seed(4).updater(Sgd(0.1)).list()
+                 .layer(DenseLayer(n_out=5, activation="tanh"))
+                 .layer(OutputLayer(n_out=3, loss="mcxent",
+                                    activation="softmax"))
+                 .set_input_type(InputType.feed_forward(4))
+                 .build())).init()
+
+        pw = ParallelWrapper(build(), mesh=mesh, training_mode="allreduce",
+                             prefetch_buffer=0)
+        pw.fit(x, y, epochs=2, batch_size=16)
+        out_mesh = np.asarray(pw.model.output(x))
+
+        single = build()
+        single.fit(x, y, epochs=2, batch_size=16)
+        np.testing.assert_allclose(out_mesh, np.asarray(single.output(x)),
+                                   atol=1e-5)
