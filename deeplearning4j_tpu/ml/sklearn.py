@@ -92,6 +92,7 @@ class NetworkClassifier(_BaseNetworkEstimator):
         return self.predict_proba(x)
 
     def score(self, x, y) -> float:
+        self._check_fitted()
         y = np.asarray(y)
         if y.ndim == 2:
             y = self.classes_[y.argmax(axis=1)]
@@ -122,7 +123,9 @@ class NetworkRegressor(_BaseNetworkEstimator):
             y = y[:, 0]
         pred = self.predict(x)
         ss_res = float(np.sum((y - pred) ** 2))
-        ss_tot = float(np.sum((y - np.mean(y)) ** 2)) or 1e-12
+        ss_tot = float(np.sum((y - np.mean(y)) ** 2))
+        if ss_tot == 0.0:  # constant targets: sklearn r2_score convention
+            return 1.0 if ss_res == 0.0 else 0.0
         return 1.0 - ss_res / ss_tot
 
 

@@ -95,9 +95,9 @@ class ChineseTokenizerFactory(TokenizerFactory):
     """
 
     def __init__(self, dictionary: Optional[Iterable[str]] = None,
+                 bigrams: bool = False, preprocessor=None, *,
                  frequencies: Optional[dict] = None,
-                 bigrams: bool = False, engine: str = "viterbi",
-                 preprocessor=None):
+                 engine: str = "viterbi"):
         super().__init__(preprocessor)
         if frequencies:
             freqs = {w: (f[0] if isinstance(f, tuple) else f)

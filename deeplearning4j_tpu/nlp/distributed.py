@@ -31,6 +31,47 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
 
+def make_distributed_glove_step(mesh: Mesh, data_axis: str = "data"):
+    """SPMD version of glove._glove_step: the pair batch is sharded over
+    the mesh, each device computes its shard's gradient rows, the
+    (row, grad) pairs are all-gathered and the AdaGrad scatter-update is
+    applied identically on every replica — same summed-update semantics
+    as the single-device step on the whole global batch (dl4j-spark-nlp's
+    Glove-on-Spark role)."""
+
+    def gather(a):
+        return jax.lax.all_gather(a, data_axis, tiled=True)
+
+    repl, shard = P(), P(data_axis)
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(repl, repl, repl, repl, shard, shard, shard, shard,
+                       shard, repl),
+             out_specs=(repl, repl, repl, repl, repl), check_vma=False)
+    def step(w, b, hist_w, hist_b, rows_i, rows_j, logX, fX, valid, lr):
+        wi, wj = w[rows_i], w[rows_j]
+        diff = jnp.einsum("bd,bd->b", wi, wj) + b[rows_i] + b[rows_j] - logX
+        fdiff = fX * diff * valid
+        gi = fdiff[:, None] * wj
+        gj = fdiff[:, None] * wi
+        gb = fdiff
+        ri, rj = gather(rows_i), gather(rows_j)
+        gi, gj, gb = gather(gi), gather(gj), gather(gb)
+        hist_w = hist_w.at[ri].add(gi * gi).at[rj].add(gj * gj)
+        hist_b = hist_b.at[ri].add(gb * gb).at[rj].add(gb * gb)
+        upd_i = lr * gi / jnp.sqrt(hist_w[ri] + 1e-8)
+        upd_j = lr * gj / jnp.sqrt(hist_w[rj] + 1e-8)
+        upd_bi = lr * gb / jnp.sqrt(hist_b[ri] + 1e-8)
+        upd_bj = lr * gb / jnp.sqrt(hist_b[rj] + 1e-8)
+        w = w.at[ri].add(-upd_i).at[rj].add(-upd_j)
+        b = b.at[ri].add(-upd_bi).at[rj].add(-upd_bj)
+        loss = jax.lax.psum(0.5 * jnp.sum(fX * diff * diff * valid),
+                            data_axis)
+        return w, b, hist_w, hist_b, loss
+
+    return jax.jit(step)
+
+
 class DistributedSequenceVectors:
     """Wrap a SequenceVectors-family model so its device dispatches run
     SPMD across `mesh` (skip-gram NS/HS paths — the Word2Vec defaults).

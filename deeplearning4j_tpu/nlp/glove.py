@@ -55,7 +55,7 @@ class Glove(SequenceVectors):
                  alpha: float = 0.75, epochs: int = 5,
                  batch_size: int = 1024, min_word_frequency: int = 1,
                  symmetric: bool = True, shuffle: bool = True,
-                 seed: int = 42, **kwargs):
+                 seed: int = 42, mesh=None, **kwargs):
         super().__init__(layer_size=layer_size, window=window,
                          learning_rate=learning_rate, epochs=epochs,
                          batch_size=batch_size,
@@ -71,6 +71,10 @@ class Glove(SequenceVectors):
         self.bias = None
         self._cooc: Optional[Dict[Tuple[int, int], float]] = None
         self.loss_history: List[float] = []
+        # mesh: run the factorization step SPMD across devices (the
+        # dl4j-spark-nlp Glove-on-Spark role; see nlp/distributed.py)
+        self.mesh = mesh
+        self._dist_step = None
 
     # -- co-occurrences (ref AbstractCoOccurrences.java: 1/distance) -------
     def count_cooccurrences(self, sequences: Iterable[Sequence[str]]) -> None:
@@ -113,6 +117,16 @@ class Glove(SequenceVectors):
             .astype(np.float32)
         n = len(pairs)
         B = self.batch_size
+        step_fn = _glove_step
+        if self.mesh is not None:
+            from deeplearning4j_tpu.nlp.distributed import (
+                make_distributed_glove_step,
+            )
+            ndev = int(np.prod(self.mesh.devices.shape))
+            B = -(-B // ndev) * ndev  # mesh-divisible (pad rows masked)
+            if self._dist_step is None:
+                self._dist_step = make_distributed_glove_step(self.mesh)
+            step_fn = self._dist_step
         order = np.arange(n)
         for _ in range(self.epochs):
             if self.shuffle:
@@ -124,7 +138,7 @@ class Glove(SequenceVectors):
                 if len(sel) < B:
                     valid[len(sel):] = 0.0
                     sel = np.pad(sel, (0, B - len(sel)))
-                self.syn0, self.bias, hist_w, hist_b, loss = _glove_step(
+                self.syn0, self.bias, hist_w, hist_b, loss = step_fn(
                     self.syn0, self.bias, hist_w, hist_b,
                     jnp.asarray(pairs[sel, 0]), jnp.asarray(pairs[sel, 1]),
                     jnp.asarray(logX[sel]), jnp.asarray(fX[sel]),

@@ -11,3 +11,8 @@ from deeplearning4j_tpu.ui.storage import (  # noqa: F401
     StatsStorage, InMemoryStatsStorage, FileStatsStorage,
 )
 from deeplearning4j_tpu.ui.server import UIServer, RemoteUIStatsStorageRouter  # noqa: F401
+from deeplearning4j_tpu.ui.components import (  # noqa: F401
+    ChartHistogram, ChartHorizontalBar, ChartLine, ChartScatter,
+    ChartStackedArea, ChartTimeline, Component, ComponentDiv,
+    ComponentTable, ComponentText, DecoratorAccordion, Style, render_page,
+)

@@ -308,3 +308,32 @@ class TestDistributedSequenceVectors:
                        elements_learning_algorithm="cbow")
         with pytest.raises(NotImplementedError):
             DistributedSequenceVectors(w2v, self._mesh())
+
+
+class TestDistributedGlove:
+    def test_mesh_matches_single_device(self):
+        """Glove(mesh=...) == plain Glove, same data/seed (the
+        Spark-vs-single-machine invariant for the GloVe engine)."""
+        import jax
+        from jax.sharding import Mesh
+        sents = [s.split() for s in corpus(120)]
+        a = Glove(layer_size=12, epochs=2, batch_size=64,
+                  min_word_frequency=1, seed=3, shuffle=False)
+        a.fit(sents)
+        mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+        b = Glove(layer_size=12, epochs=2, batch_size=64,
+                  min_word_frequency=1, seed=3, shuffle=False, mesh=mesh)
+        b.fit(sents)
+        np.testing.assert_allclose(np.asarray(a.syn0), np.asarray(b.syn0),
+                                   atol=1e-4)
+        np.testing.assert_allclose(a.loss_history, b.loss_history,
+                                   rtol=1e-4)
+
+    def test_mesh_clusters(self):
+        import jax
+        from jax.sharding import Mesh
+        mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+        g = Glove(layer_size=16, epochs=20, batch_size=128, window=3,
+                  min_word_frequency=1, seed=1, mesh=mesh)
+        g.fit([s.split() for s in corpus()])
+        assert g.similarity("cat", "dog") > g.similarity("cat", "bread")

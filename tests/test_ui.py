@@ -287,3 +287,56 @@ class TestTsneModule:
                 assert e.code == 400
         finally:
             srv.stop()
+
+
+class TestUiComponents:
+    """ui-components DSL (ref: deeplearning4j-ui-components chart/table/
+    text/decorator classes + StaticPageUtil.renderHTML)."""
+
+    def test_chart_json_roundtrip_fields(self):
+        from deeplearning4j_tpu.ui import ChartLine, Style
+        c = (ChartLine("loss", Style(width=400, height=200))
+             .add_series("train", [0, 1, 2], [1.0, 0.5, 0.25])
+             .add_series("val", [0, 1, 2], [1.1, 0.7, 0.5]))
+        d = json.loads(c.to_json())
+        assert d["componentType"] == "ChartLine"
+        assert [s["name"] for s in d["series"]] == ["train", "val"]
+        assert d["style"]["width"] == 400
+
+    def test_series_length_mismatch(self):
+        from deeplearning4j_tpu.ui import ChartScatter
+        with pytest.raises(ValueError):
+            ChartScatter("s").add_series("a", [1, 2], [1])
+
+    def test_render_page_standalone(self):
+        from deeplearning4j_tpu.ui import (
+            ChartHistogram, ChartHorizontalBar, ChartLine, ChartScatter,
+            ChartTimeline, ComponentDiv, ComponentTable, ComponentText,
+            DecoratorAccordion, render_page,
+        )
+        comps = [
+            ChartLine("score").add_series("s", [0, 1], [2.0, 1.0]),
+            ChartScatter("tsne").add_series("pts", [0, 1], [0, 1]),
+            ChartHistogram("weights").add_bin(-1, 0, 5).add_bin(0, 1, 7),
+            ChartHorizontalBar("f1").add_bar("classA", 0.9),
+            ChartTimeline("phases").add_lane("w0", [(0, 5, "fit")]),
+            ComponentTable(header=["k", "v"], rows=[["acc", "0.93"]],
+                           title="summary"),
+            DecoratorAccordion("details", [ComponentText("hello", "txt")]),
+            ComponentDiv([ComponentText("inner")], title="box"),
+        ]
+        page = render_page(comps, title="report")
+        assert page.startswith("<!DOCTYPE html>")
+        for frag in ("dl4jChart", "dl4jHistogram", "dl4jHBar",
+                     "dl4jTimeline", "classA", "summary", "details",
+                     "hello"):
+            assert frag in page
+        # scripts reference per-component canvas ids
+        assert 'id="c0"' in page and 'id="c4"' in page
+
+    def test_html_escaping(self):
+        from deeplearning4j_tpu.ui import ComponentText, render_page
+        page = render_page([ComponentText("<script>alert(1)</script>",
+                                          title="<b>t</b>")])
+        assert "<script>alert(1)</script>" not in page
+        assert "&lt;script&gt;" in page
