@@ -10,6 +10,12 @@ from deeplearning4j_tpu.datasets.iterators import (  # noqa: F401
     AsyncDataSetIterator,
     ExistingDataSetIterator,
 )
+from deeplearning4j_tpu.datasets.normalizers import (  # noqa: F401
+    ImagePreProcessingScaler,
+    NormalizerMinMaxScaler,
+    NormalizerStandardize,
+    normalizer_from_dict,
+)
 from deeplearning4j_tpu.datasets.fetchers import (  # noqa: F401
     CifarDataSetIterator,
     EmnistDataSetIterator,

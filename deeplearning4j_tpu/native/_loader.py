@@ -1,0 +1,70 @@
+"""Shared native-library loader: locate the .so under native/build/,
+rebuild via make when the source is newer, fall back to None (callers use
+numpy fallbacks) when the toolchain is unavailable."""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Callable, Optional
+
+log = logging.getLogger(__name__)
+
+NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "native")
+
+_build_lock = threading.Lock()
+_build_attempted = False
+
+
+class NativeLib:
+    """Lazily-loaded native library handle."""
+
+    def __init__(self, so_name: str, src_name: str,
+                 configure: Callable[[ctypes.CDLL], None]):
+        self.so_path = os.path.join(NATIVE_DIR, "build", so_name)
+        self.src_path = os.path.join(NATIVE_DIR, "src", src_name)
+        self._configure = configure
+        self._lib: Optional[ctypes.CDLL] = None
+        self._lock = threading.Lock()
+
+    def load(self) -> Optional[ctypes.CDLL]:
+        global _build_attempted
+        if self._lib is not None:
+            return self._lib
+        with self._lock:
+            if self._lib is not None:
+                return self._lib
+            stale = (os.path.exists(self.so_path) and
+                     os.path.exists(self.src_path) and
+                     os.path.getmtime(self.src_path) >
+                     os.path.getmtime(self.so_path))
+            if not os.path.exists(self.so_path) or stale:
+                with _build_lock:
+                    if not _build_attempted:
+                        _build_attempted = True
+                        try:
+                            subprocess.run(["make", "-C", NATIVE_DIR],
+                                           check=True, capture_output=True,
+                                           timeout=120)
+                        except Exception as e:  # noqa: BLE001
+                            log.info("native build unavailable (%s); "
+                                     "using numpy fallbacks", e)
+            if not os.path.exists(self.so_path):
+                return None
+            try:
+                lib = ctypes.CDLL(self.so_path)
+            except OSError as e:
+                log.info("native lib %s load failed (%s); numpy fallbacks",
+                         self.so_path, e)
+                return None
+            self._configure(lib)
+            self._lib = lib
+            return self._lib
+
+    def available(self) -> bool:
+        return self.load() is not None

@@ -1,0 +1,152 @@
+"""ctypes bindings for the native image pipeline (native/src/image.cpp):
+batch bilinear resize, crop+flip augmentation, fused u8 NHWC -> f32 NCHW
+per-channel normalization. Numpy fallbacks keep behavior identical when
+the toolchain is absent.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.native._loader import NativeLib
+
+log = logging.getLogger(__name__)
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    u8p = ctypes.POINTER(ctypes.c_ubyte)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    lp = ctypes.POINTER(ctypes.c_long)
+    lib.dl4j_resize_bilinear_u8.argtypes = [
+        u8p, ctypes.c_long, ctypes.c_long, ctypes.c_long, ctypes.c_long,
+        u8p, ctypes.c_long, ctypes.c_long, ctypes.c_int]
+    lib.dl4j_resize_bilinear_u8.restype = ctypes.c_int
+    lib.dl4j_crop_flip_u8.argtypes = [
+        u8p, ctypes.c_long, ctypes.c_long, ctypes.c_long, ctypes.c_long,
+        u8p, ctypes.c_long, ctypes.c_long, lp, lp, u8p, ctypes.c_int]
+    lib.dl4j_crop_flip_u8.restype = ctypes.c_int
+    lib.dl4j_u8hwc_to_f32chw.argtypes = [
+        u8p, ctypes.c_long, ctypes.c_long, ctypes.c_long, ctypes.c_long,
+        f32p, ctypes.c_float, f32p, f32p, ctypes.c_int]
+    lib.dl4j_u8hwc_to_f32chw.restype = ctypes.c_int
+
+
+_NATIVE = NativeLib("libdl4jtpu_image.so", "image.cpp", _configure)
+
+
+def _load():
+    return _NATIVE.load()
+
+
+def native_available() -> bool:
+    return _NATIVE.available()
+
+
+def _as_u8_nhwc(imgs: np.ndarray) -> np.ndarray:
+    a = np.ascontiguousarray(imgs)
+    if a.dtype != np.uint8 or a.ndim != 4:
+        raise ValueError("expected uint8 [N,H,W,C] batch")
+    return a
+
+
+def resize_bilinear(imgs: np.ndarray, out_h: int, out_w: int,
+                    nthreads: int = 0) -> np.ndarray:
+    """Batch bilinear resize, uint8 [N,H,W,C] -> [N,out_h,out_w,C]
+    (half-pixel centers, edge clamp)."""
+    a = _as_u8_nhwc(imgs)
+    n, h, w, c = a.shape
+    lib = _load()
+    out = np.empty((n, out_h, out_w, c), np.uint8)
+    if lib is not None:
+        rc = lib.dl4j_resize_bilinear_u8(
+            a.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)), n, h, w, c,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+            out_h, out_w, nthreads)
+        if rc == 0:
+            return out
+    # numpy fallback: identical sampling
+    sy = h / out_h
+    sx = w / out_w
+    fy = np.clip((np.arange(out_h) + 0.5) * sy - 0.5, 0, None)
+    fx = np.clip((np.arange(out_w) + 0.5) * sx - 0.5, 0, None)
+    y0 = fy.astype(np.int64)
+    x0 = fx.astype(np.int64)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (fy - y0)[None, :, None, None]
+    wx = (fx - x0)[None, None, :, None]
+    af = a.astype(np.float64)
+    top = af[:, y0][:, :, x0] * (1 - wx) + af[:, y0][:, :, x1] * wx
+    bot = af[:, y1][:, :, x0] * (1 - wx) + af[:, y1][:, :, x1] * wx
+    return (top * (1 - wy) + bot * wy + 0.5).astype(np.uint8)
+
+
+def crop_flip(imgs: np.ndarray, crop_h: int, crop_w: int,
+              offsets_y: np.ndarray, offsets_x: np.ndarray,
+              flips: Optional[np.ndarray] = None,
+              nthreads: int = 0) -> np.ndarray:
+    """Batch crop to [crop_h, crop_w] at per-image offsets with optional
+    per-image horizontal flip (uint8 NHWC)."""
+    a = _as_u8_nhwc(imgs)
+    n, h, w, c = a.shape
+    oy = np.ascontiguousarray(offsets_y, np.int64)
+    ox = np.ascontiguousarray(offsets_x, np.int64)
+    if oy.shape != (n,) or ox.shape != (n,):
+        raise ValueError("offsets must be [N]")
+    if np.any(oy < 0) or np.any(oy + crop_h > h) or np.any(ox < 0) or \
+            np.any(ox + crop_w > w):
+        raise ValueError("crop window out of bounds")
+    fl = None if flips is None else np.ascontiguousarray(flips, np.uint8)
+    lib = _load()
+    out = np.empty((n, crop_h, crop_w, c), np.uint8)
+    if lib is not None:
+        u8p = ctypes.POINTER(ctypes.c_ubyte)
+        rc = lib.dl4j_crop_flip_u8(
+            a.ctypes.data_as(u8p), n, h, w, c, out.ctypes.data_as(u8p),
+            crop_h, crop_w,
+            oy.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+            ox.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+            None if fl is None else fl.ctypes.data_as(u8p), nthreads)
+        if rc == 0:
+            return out
+    for i in range(n):
+        win = a[i, oy[i]:oy[i] + crop_h, ox[i]:ox[i] + crop_w]
+        out[i] = win[:, ::-1] if (fl is not None and fl[i]) else win
+    return out
+
+
+def u8hwc_to_f32chw(imgs: np.ndarray, scale: float = 1.0 / 255.0,
+                    mean: Optional[np.ndarray] = None,
+                    std: Optional[np.ndarray] = None,
+                    nthreads: int = 0) -> np.ndarray:
+    """Fused uint8 [N,H,W,C] -> float32 [N,C,H,W]:
+    (x*scale - mean[c]) / std[c]."""
+    a = _as_u8_nhwc(imgs)
+    n, h, w, c = a.shape
+    m = None if mean is None else np.ascontiguousarray(mean, np.float32)
+    s = None if std is None else np.ascontiguousarray(std, np.float32)
+    if m is not None and m.shape != (c,):
+        raise ValueError(f"mean must be [{c}]")
+    if s is not None and s.shape != (c,):
+        raise ValueError(f"std must be [{c}]")
+    lib = _load()
+    out = np.empty((n, c, h, w), np.float32)
+    if lib is not None:
+        f32p = ctypes.POINTER(ctypes.c_float)
+        rc = lib.dl4j_u8hwc_to_f32chw(
+            a.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)), n, h, w, c,
+            out.ctypes.data_as(f32p), scale,
+            None if m is None else m.ctypes.data_as(f32p),
+            None if s is None else s.ctypes.data_as(f32p), nthreads)
+        if rc == 0:
+            return out
+    x = a.astype(np.float32) * scale
+    if m is not None:
+        x = x - m
+    if s is not None:
+        x = x / np.where(s == 0, 1.0, s)
+    return np.ascontiguousarray(x.transpose(0, 3, 1, 2))

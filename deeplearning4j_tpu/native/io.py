@@ -5,11 +5,11 @@ from __future__ import annotations
 import ctypes
 import logging
 import os
-import subprocess
-import threading
 from typing import Optional, Tuple
 
 import numpy as np
+
+from deeplearning4j_tpu.native import _loader as _loader_mod
 
 log = logging.getLogger(__name__)
 
@@ -18,10 +18,6 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 _SO_PATH = os.path.join(_NATIVE_DIR, "build", "libdl4jtpu_io.so")
 
-_lib = None
-_lib_lock = threading.Lock()
-_build_attempted = False
-
 _IDX_DTYPES = {0x08: np.uint8, 0x09: np.int8, 0x0B: np.dtype(">i2"),
                0x0C: np.dtype(">i4"), 0x0D: np.dtype(">f4"),
                0x0E: np.dtype(">f8")}
@@ -29,59 +25,37 @@ _IDX_HOST = {0x08: np.uint8, 0x09: np.int8, 0x0B: np.int16,
              0x0C: np.int32, 0x0D: np.float32, 0x0E: np.float64}
 
 
-def _load() -> Optional[ctypes.CDLL]:
-    """Load the native lib, building it with make on first use."""
-    global _lib, _build_attempted
-    if _lib is not None:
-        return _lib
-    with _lib_lock:
-        if _lib is not None:
-            return _lib
-        src = os.path.join(_NATIVE_DIR, "src", "io.cpp")
-        stale = (os.path.exists(_SO_PATH) and os.path.exists(src)
-                 and os.path.getmtime(src) > os.path.getmtime(_SO_PATH))
-        if (not os.path.exists(_SO_PATH) or stale) and not _build_attempted:
-            _build_attempted = True
-            try:
-                subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                               capture_output=True, timeout=120)
-            except Exception as e:  # noqa: BLE001
-                log.info("native build unavailable (%s); using numpy "
-                         "fallbacks", e)
-                if not os.path.exists(_SO_PATH):
-                    return None
-        if not os.path.exists(_SO_PATH):
-            return None
-        try:
-            lib = ctypes.CDLL(_SO_PATH)
-        except OSError as e:
-            log.info("native lib load failed (%s); using numpy fallbacks", e)
-            return None
-        lib.dl4j_idx_info.argtypes = [
-            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
-            ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_int)]
-        lib.dl4j_idx_read.argtypes = [
-            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_long, ctypes.c_int]
-        lib.dl4j_csv_count_rows.argtypes = [ctypes.c_char_p, ctypes.c_int]
-        lib.dl4j_csv_count_rows.restype = ctypes.c_long
-        lib.dl4j_csv_read.argtypes = [
-            ctypes.c_char_p, ctypes.c_int, ctypes.c_char,
-            ctypes.POINTER(ctypes.c_float), ctypes.c_long, ctypes.c_long,
-            ctypes.c_int]
-        lib.dl4j_native_version.restype = ctypes.c_int
-        lib.dl4j_u8_to_f32.argtypes = [
-            ctypes.POINTER(ctypes.c_ubyte), ctypes.POINTER(ctypes.c_float),
-            ctypes.c_long, ctypes.c_float, ctypes.c_int]
-        lib.dl4j_gather_rows_f32.argtypes = [
-            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_long),
-            ctypes.POINTER(ctypes.c_float), ctypes.c_long, ctypes.c_long,
-            ctypes.c_int]
-        _lib = lib
-        return _lib
+def _configure(lib):
+    lib.dl4j_idx_info.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_int)]
+    lib.dl4j_idx_read.argtypes = [
+        ctypes.c_char_p, ctypes.c_void_p, ctypes.c_long, ctypes.c_int]
+    lib.dl4j_csv_count_rows.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.dl4j_csv_count_rows.restype = ctypes.c_long
+    lib.dl4j_csv_read.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_char,
+        ctypes.POINTER(ctypes.c_float), ctypes.c_long, ctypes.c_long,
+        ctypes.c_int]
+    lib.dl4j_native_version.restype = ctypes.c_int
+    lib.dl4j_u8_to_f32.argtypes = [
+        ctypes.POINTER(ctypes.c_ubyte), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_long, ctypes.c_float, ctypes.c_int]
+    lib.dl4j_gather_rows_f32.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_long),
+        ctypes.POINTER(ctypes.c_float), ctypes.c_long, ctypes.c_long,
+        ctypes.c_int]
+
+
+_NATIVE = _loader_mod.NativeLib("libdl4jtpu_io.so", "io.cpp", _configure)
+
+
+def _load():
+    return _NATIVE.load()
 
 
 def native_available() -> bool:
-    return _load() is not None
+    return _NATIVE.available()
 
 
 # ---------------------------------------------------------------------------

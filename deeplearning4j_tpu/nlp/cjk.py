@@ -94,10 +94,13 @@ class ChineseTokenizerFactory(TokenizerFactory):
     overlapping bigrams, a strong baseline for embedding training).
     """
 
-    def __init__(self, dictionary: Optional[Iterable[str]] = None,
-                 bigrams: bool = False, preprocessor=None, *,
+    def __init__(self, dictionary: Optional[Iterable[str]] = None, *,
+                 bigrams: bool = False, preprocessor=None,
                  frequencies: Optional[dict] = None,
                  engine: str = "viterbi"):
+        # everything after `dictionary` is keyword-only: the parameter set
+        # grew this round, and positional binding against the old order
+        # would silently misassign
         super().__init__(preprocessor)
         if frequencies:
             freqs = {w: (f[0] if isinstance(f, tuple) else f)

@@ -92,6 +92,29 @@ def write_model(model, path: str, save_updater: bool = True) -> None:
             _write_tree(zf, "updater", model.updater_state)
 
 
+NORMALIZER_JSON = "normalizer.json"
+
+
+def add_normalizer_to_model(path: str, normalizer) -> None:
+    """Embed a fitted normalizer in an existing checkpoint zip
+    (ref: ModelSerializer.addNormalizerToModel — inference then applies
+    identical preprocessing)."""
+    with zipfile.ZipFile(path, "a", zipfile.ZIP_DEFLATED) as zf:
+        if NORMALIZER_JSON in zf.namelist():
+            raise ValueError(f"{path} already contains a normalizer")
+        zf.writestr(NORMALIZER_JSON, normalizer.to_json())
+
+
+def restore_normalizer_from_file(path: str):
+    """ref: ModelSerializer.restoreNormalizerFromFile — None when the
+    checkpoint has no embedded normalizer."""
+    from deeplearning4j_tpu.datasets.normalizers import normalizer_from_dict
+    with zipfile.ZipFile(path, "r") as zf:
+        if NORMALIZER_JSON not in zf.namelist():
+            return None
+        return normalizer_from_dict(json.loads(zf.read(NORMALIZER_JSON)))
+
+
 def _merge_state(init_state, loaded):
     """Use loaded state where present, else initialized values (handles
     checkpoints written without updater state)."""

@@ -1,0 +1,159 @@
+// dl4jtpu native image pipeline.
+//
+// TPU-native equivalent of the reference's native image path: DL4J feeds
+// CNNs through DataVec's JavaCPP-wrapped native image loaders and ND4J's
+// normalizers (ImagePreProcessingScaler / NormalizerStandardize apply
+// their stats in native ops). Here the host-side per-pixel hot loops —
+// bilinear resize, crop+flip augmentation, fused u8->f32 per-channel
+// normalize with HWC->CHW packing — run in C++ with the same thread-pool
+// used by io.cpp, so the image ETL overlaps XLA compute instead of
+// serializing behind the Python interpreter.
+//
+// Flat C ABI for ctypes (no pybind11 in the image). All batch arrays are
+// dense row-major; images are uint8 NHWC unless stated otherwise.
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+int clamp_threads(int nthreads, long work_items) {
+  unsigned hw = std::thread::hardware_concurrency();
+  if (hw == 0) hw = 4;
+  long n = nthreads > 0 ? nthreads : static_cast<long>(hw);
+  if (n > work_items) n = work_items;
+  if (n < 1) n = 1;
+  return static_cast<int>(n);
+}
+
+template <typename F>
+void parallel_for(long n, int nthreads, F&& fn) {
+  nthreads = clamp_threads(nthreads, n);
+  if (nthreads <= 1) {
+    fn(0L, n);
+    return;
+  }
+  std::vector<std::thread> pool;
+  long chunk = (n + nthreads - 1) / nthreads;
+  for (int t = 0; t < nthreads; ++t) {
+    long lo = t * chunk;
+    long hi = lo + chunk > n ? n : lo + chunk;
+    if (lo >= hi) break;
+    pool.emplace_back([lo, hi, &fn] { fn(lo, hi); });
+  }
+  for (auto& th : pool) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- batch bilinear resize (u8 NHWC -> u8 NHWC) ---------------------------
+// Half-pixel-center sampling (the OpenCV/PIL convention), edges clamped.
+int dl4j_resize_bilinear_u8(const uint8_t* src, long n, long h, long w,
+                            long c, uint8_t* dst, long oh, long ow,
+                            int nthreads) {
+  if (!src || !dst || n < 0 || h <= 0 || w <= 0 || c <= 0 || oh <= 0 ||
+      ow <= 0)
+    return -1;
+  const double sy = static_cast<double>(h) / oh;
+  const double sx = static_cast<double>(w) / ow;
+  parallel_for(n, nthreads, [&](long lo, long hi) {
+    for (long i = lo; i < hi; ++i) {
+      const uint8_t* im = src + i * h * w * c;
+      uint8_t* out = dst + i * oh * ow * c;
+      for (long y = 0; y < oh; ++y) {
+        double fy = (y + 0.5) * sy - 0.5;
+        if (fy < 0) fy = 0;
+        long y0 = static_cast<long>(fy);
+        long y1 = y0 + 1 < h ? y0 + 1 : h - 1;
+        double wy = fy - y0;
+        for (long x = 0; x < ow; ++x) {
+          double fx = (x + 0.5) * sx - 0.5;
+          if (fx < 0) fx = 0;
+          long x0 = static_cast<long>(fx);
+          long x1 = x0 + 1 < w ? x0 + 1 : w - 1;
+          double wx = fx - x0;
+          const uint8_t* p00 = im + (y0 * w + x0) * c;
+          const uint8_t* p01 = im + (y0 * w + x1) * c;
+          const uint8_t* p10 = im + (y1 * w + x0) * c;
+          const uint8_t* p11 = im + (y1 * w + x1) * c;
+          uint8_t* q = out + (y * ow + x) * c;
+          for (long k = 0; k < c; ++k) {
+            double v = (1 - wy) * ((1 - wx) * p00[k] + wx * p01[k]) +
+                       wy * ((1 - wx) * p10[k] + wx * p11[k]);
+            q[k] = static_cast<uint8_t>(v + 0.5);
+          }
+        }
+      }
+    }
+  });
+  return 0;
+}
+
+// ---- batch crop + horizontal flip (u8 NHWC -> u8 NHWC) --------------------
+// offsets_y/offsets_x: per-image crop origin; flips: per-image 0/1.
+int dl4j_crop_flip_u8(const uint8_t* src, long n, long h, long w, long c,
+                      uint8_t* dst, long ch, long cw, const long* offs_y,
+                      const long* offs_x, const uint8_t* flips,
+                      int nthreads) {
+  if (!src || !dst || !offs_y || !offs_x || n < 0 || ch > h || cw > w ||
+      ch <= 0 || cw <= 0 || c <= 0)
+    return -1;
+  for (long i = 0; i < n; ++i)
+    if (offs_y[i] < 0 || offs_y[i] + ch > h || offs_x[i] < 0 ||
+        offs_x[i] + cw > w)
+      return -2;
+  parallel_for(n, nthreads, [&](long lo, long hi) {
+    for (long i = lo; i < hi; ++i) {
+      const uint8_t* im = src + i * h * w * c;
+      uint8_t* out = dst + i * ch * cw * c;
+      const long oy = offs_y[i], ox = offs_x[i];
+      const bool flip = flips && flips[i];
+      for (long y = 0; y < ch; ++y) {
+        const uint8_t* row = im + ((oy + y) * w + ox) * c;
+        uint8_t* q = out + y * cw * c;
+        if (!flip) {
+          std::memcpy(q, row, cw * c);
+        } else {
+          for (long x = 0; x < cw; ++x)
+            std::memcpy(q + x * c, row + (cw - 1 - x) * c, c);
+        }
+      }
+    }
+  });
+  return 0;
+}
+
+// ---- fused u8 NHWC -> f32 NCHW normalize ----------------------------------
+// dst[i,k,y,x] = (src[i,y,x,k] * scale - mean[k]) / std[k]
+// (ImagePreProcessingScaler: scale=1/255, mean=0, std=1;
+//  NormalizerStandardize-on-images: per-channel stats.)
+int dl4j_u8hwc_to_f32chw(const uint8_t* src, long n, long h, long w, long c,
+                         float* dst, float scale, const float* mean,
+                         const float* stdev, int nthreads) {
+  if (!src || !dst || n < 0 || h <= 0 || w <= 0 || c <= 0) return -1;
+  parallel_for(n, nthreads, [&](long lo, long hi) {
+    for (long i = lo; i < hi; ++i) {
+      const uint8_t* im = src + i * h * w * c;
+      float* out = dst + i * c * h * w;
+      for (long k = 0; k < c; ++k) {
+        const float m = mean ? mean[k] : 0.0f;
+        const float s = stdev ? stdev[k] : 1.0f;
+        const float inv = 1.0f / (s == 0.0f ? 1.0f : s);
+        float* plane = out + k * h * w;
+        for (long y = 0; y < h; ++y) {
+          const uint8_t* row = im + y * w * c + k;
+          float* orow = plane + y * w;
+          for (long x = 0; x < w; ++x)
+            orow[x] = (row[x * c] * scale - m) * inv;
+        }
+      }
+    }
+  });
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,157 @@
+"""Normalizer + native image pipeline tests (ref: ND4J normalizer tests +
+ModelSerializer.addNormalizerToModel round-trip)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import (
+    ImagePreProcessingScaler, NormalizerMinMaxScaler, NormalizerStandardize,
+)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+from deeplearning4j_tpu.datasets.normalizers import normalizer_from_dict
+from deeplearning4j_tpu.native import image as nimg
+
+RNG = np.random.default_rng(5)
+
+
+class TestNormalizerStandardize:
+    def test_fit_transform_revert(self):
+        x = RNG.normal(5.0, 3.0, (200, 4)).astype(np.float32)
+        n = NormalizerStandardize().fit(x)
+        z = n.transform(x)
+        np.testing.assert_allclose(z.mean(0), 0.0, atol=1e-4)
+        np.testing.assert_allclose(z.std(0), 1.0, atol=1e-4)
+        np.testing.assert_allclose(n.revert_features(z), x, atol=1e-4)
+
+    def test_per_channel_on_images(self):
+        x = RNG.normal(0, 1, (16, 3, 8, 8)).astype(np.float32)
+        x[:, 1] += 10.0
+        n = NormalizerStandardize().fit(x)
+        z = n.transform(x)
+        assert abs(z[:, 1].mean()) < 1e-3  # channel axis stats
+
+    def test_iterator_fit_and_dataset_transform(self):
+        x = RNG.normal(2, 4, (64, 5)).astype(np.float32)
+        y = RNG.normal(0, 1, (64, 2)).astype(np.float32)
+        it = ArrayDataSetIterator(x, y, batch_size=16)
+        n = NormalizerStandardize()
+        n.fit_label(True)
+        n.fit(it)
+        ds = DataSet(x[:8].copy(), y[:8].copy())
+        n.transform(ds)
+        assert abs(np.asarray(ds.features).mean()) < 0.5
+        np.testing.assert_allclose(n.revert_labels(ds.labels), y[:8],
+                                   atol=1e-4)
+
+    def test_json_roundtrip(self):
+        import json
+        x = RNG.normal(1, 2, (50, 3)).astype(np.float32)
+        n = NormalizerStandardize().fit(x)
+        n2 = normalizer_from_dict(json.loads(n.to_json()))
+        np.testing.assert_allclose(n2.transform(x), n.transform(x))
+
+    def test_unfitted_raises(self):
+        with pytest.raises(RuntimeError):
+            NormalizerStandardize().transform(np.zeros((2, 2), np.float32))
+
+
+class TestMinMaxScaler:
+    def test_range(self):
+        x = RNG.uniform(-7, 3, (100, 4)).astype(np.float32)
+        n = NormalizerMinMaxScaler(lo=-1, hi=1).fit(x)
+        z = n.transform(x)
+        np.testing.assert_allclose(z.min(0), -1.0, atol=1e-5)
+        np.testing.assert_allclose(z.max(0), 1.0, atol=1e-5)
+        np.testing.assert_allclose(n.revert_features(z), x, atol=1e-4)
+
+
+class TestImageScaler:
+    def test_u8_batch_native_path(self):
+        imgs = RNG.integers(0, 256, (6, 10, 12, 3), np.uint8)
+        n = ImagePreProcessingScaler()
+        out = n.transform(imgs)
+        assert out.shape == (6, 3, 10, 12)  # NHWC u8 -> NCHW f32
+        assert out.dtype == np.float32
+        np.testing.assert_allclose(
+            out, imgs.transpose(0, 3, 1, 2).astype(np.float32) / 255.0,
+            atol=1e-6)
+
+    def test_float_passthrough_range(self):
+        x = np.full((2, 3, 4, 4), 255.0, np.float32)
+        n = ImagePreProcessingScaler(lo=-1, hi=1)
+        np.testing.assert_allclose(n.transform(x), 1.0, atol=1e-6)
+        np.testing.assert_allclose(n.revert_features(n.transform(x)), x,
+                                   atol=1e-3)
+
+
+class TestCheckpointEmbed:
+    def test_add_and_restore(self):
+        from deeplearning4j_tpu.nn.conf import (
+            InputType, NeuralNetConfiguration,
+        )
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.util.model_serializer import (
+            add_normalizer_to_model, restore_normalizer_from_file,
+            restore_multi_layer_network, write_model,
+        )
+        conf = (NeuralNetConfiguration.Builder().seed(1).list()
+                .layer(DenseLayer(n_out=4))
+                .layer(OutputLayer(n_out=2, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.feed_forward(3))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = RNG.normal(3, 2, (40, 3)).astype(np.float32)
+        norm = NormalizerStandardize().fit(x)
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "m.zip")
+            write_model(net, p)
+            assert restore_normalizer_from_file(p) is None
+            add_normalizer_to_model(p, norm)
+            with pytest.raises(ValueError):
+                add_normalizer_to_model(p, norm)  # double-embed rejected
+            restored = restore_normalizer_from_file(p)
+            np.testing.assert_allclose(restored.transform(x),
+                                       norm.transform(x))
+            # the model itself still restores
+            net2 = restore_multi_layer_network(p)
+            assert net2 is not None
+
+
+class TestNativeImageOps:
+    def test_resize_native_matches_fallback(self):
+        imgs = RNG.integers(0, 256, (3, 17, 23, 3), np.uint8)
+        a = nimg.resize_bilinear(imgs, 8, 12)
+        assert a.shape == (3, 8, 12, 3)
+        if nimg.native_available():
+            # force fallback and compare
+            nat = nimg._NATIVE
+            lib, nat._lib = nat._lib, None
+            so, nat.so_path = nat.so_path, "/nonexistent.so"
+            try:
+                b = nimg.resize_bilinear(imgs, 8, 12)
+            finally:
+                nat._lib, nat.so_path = lib, so
+            assert np.max(np.abs(a.astype(int) - b.astype(int))) <= 1
+
+    def test_crop_flip(self):
+        imgs = np.arange(2 * 6 * 6 * 1, dtype=np.uint8).reshape(2, 6, 6, 1)
+        out = nimg.crop_flip(imgs, 4, 4, np.array([1, 0]), np.array([2, 1]),
+                             flips=np.array([0, 1], np.uint8))
+        np.testing.assert_array_equal(out[0], imgs[0, 1:5, 2:6])
+        np.testing.assert_array_equal(out[1], imgs[1, 0:4, 1:5][:, ::-1])
+        with pytest.raises(ValueError):
+            nimg.crop_flip(imgs, 4, 4, np.array([3, 0]), np.array([0, 0]))
+
+    def test_fused_normalize_pack(self):
+        imgs = RNG.integers(0, 256, (4, 5, 6, 3), np.uint8)
+        mean = np.array([0.4, 0.5, 0.6], np.float32)
+        std = np.array([0.2, 0.3, 0.1], np.float32)
+        out = nimg.u8hwc_to_f32chw(imgs, mean=mean, std=std)
+        ref = (imgs.astype(np.float32) / 255.0 - mean) / std
+        np.testing.assert_allclose(out, ref.transpose(0, 3, 1, 2), atol=1e-5)
