@@ -16,7 +16,7 @@ Prints one JSON line per config:
   host it falls back to the 8-virtual-CPU-device mesh and reports
   correctness-path throughput only (flagged "virtual").
 
-Usage: python bench_all.py [resnet|lstm|lenet|vgg16|inception|scaling]...
+Usage: python bench_all.py [resnet|lstm|lenet|vgg16|inception|attention|scaling]...
 """
 
 import json
@@ -188,6 +188,34 @@ def bench_keras_inception():
                       "value": round(B * n / dt, 1), "unit": "images/sec"}))
 
 
+def bench_attention():
+    """Long-context single-chip attention: blockwise (flash-style) causal
+    attention at T=32k — the naive [T,T] path would need ~4GB/head and
+    OOM; the blockwise scan runs it in O(T*block) memory."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning4j_tpu.parallel.sequence import blockwise_attention
+
+    B, H, T, D = 1, 8, int(os.environ.get("BENCH_ATTN_T", "32768")), 128
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.bfloat16)
+    f = jax.jit(lambda q, k, v: blockwise_attention(q, k, v, causal=True,
+                                                    block_size=4096))
+    o = f(q, k, v)
+    float(jnp.float32(o[0, 0, 0, 0]))
+    t0 = time.perf_counter()
+    n = 5
+    for _ in range(n):
+        o = f(q, k, v)
+    float(jnp.float32(o[0, 0, 0, 0]))
+    dt = (time.perf_counter() - t0) / n
+    print(json.dumps({"metric": f"blockwise_attention_T{T}",
+                      "value": round(B * T / dt, 1), "unit": "tokens/sec"}))
+
+
 def bench_scaling():
     import jax
     virtual = jax.device_count() < 8
@@ -237,10 +265,10 @@ def bench_scaling():
 
 ALL = {"resnet": bench_resnet, "lstm": bench_lstm, "lenet": bench_lenet,
        "vgg16": bench_vgg16, "inception": bench_keras_inception,
-       "scaling": bench_scaling}
+       "attention": bench_attention, "scaling": bench_scaling}
 
 if __name__ == "__main__":
     names = sys.argv[1:] or ["resnet", "lstm", "lenet", "vgg16",
-                             "inception", "scaling"]
+                             "inception", "attention", "scaling"]
     for n in names:
         ALL[n]()

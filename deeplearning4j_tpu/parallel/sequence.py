@@ -47,6 +47,60 @@ def reference_attention(q, k, v, causal: bool = False):
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
+def blockwise_attention(q, k, v, causal: bool = False,
+                        block_size: int = 512):
+    """Single-device flash-style attention: lax.scan over KV blocks with
+    an online-softmax accumulator — O(T·block) live memory instead of the
+    [T,T] score matrix, so one chip handles long contexts that would OOM
+    the naive path (32k+ at bf16). Exact to float tolerance vs
+    reference_attention; XLA keeps each block's QK^T / PV matmuls on the
+    MXU and the running (m, l, o) update fuses into their epilogue.
+
+    q,k,v: [B,H,T,D]. T is padded internally to a block multiple; padded
+    keys are masked with NEG_INF so results are unaffected.
+    """
+    B, H, T, D = q.shape
+    bs = int(min(block_size, T))
+    pad = (-T) % bs
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    n_blocks = (T + pad) // bs
+    scale = jnp.float32(1.0 / np.sqrt(D))
+    qf = q.astype(jnp.float32)
+    kb = k.reshape(B, H, n_blocks, bs, D).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(B, H, n_blocks, bs, D).transpose(2, 0, 1, 3, 4)
+    q_pos = jnp.arange(T)
+
+    def body(carry, blk):
+        m, l, o = carry
+        kc, vc, idx = blk
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf,
+                       kc.astype(jnp.float32)) * scale
+        k_pos = idx * bs + jnp.arange(bs)
+        valid = k_pos < T                                # pad mask
+        if causal:
+            valid = valid[None, :] & (q_pos[:, None] >= k_pos[None, :])
+        else:
+            valid = jnp.broadcast_to(valid[None, :], (T, bs))
+        s = jnp.where(valid[None, None], s, NEG_INF)
+        blk_max = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, blk_max)
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32))
+        return (m_new, l_new, o_new), None
+
+    m0 = jnp.full((B, H, T), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, T), jnp.float32)
+    o0 = jnp.zeros((B, H, T, D), jnp.float32)
+    (m, l, o), _ = jax.lax.scan(
+        body, (m0, l0, o0), (kb, vb, jnp.arange(n_blocks)))
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
 def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
     """Per-shard ring attention body (runs under shard_map).
 
@@ -162,7 +216,7 @@ class MultiHeadSelfAttention:
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.head_dim = embed_dim // num_heads
-        if impl not in ("ring", "ulysses", "local"):
+        if impl not in ("ring", "ulysses", "local", "blockwise"):
             raise ValueError(f"unknown attention impl {impl!r}")
         self.impl = impl
         self.causal = causal
@@ -187,7 +241,12 @@ class MultiHeadSelfAttention:
             return u.reshape(B, T, H, D).transpose(0, 2, 1, 3)
 
         q, k, v = (heads(x @ params[w]) for w in ("wq", "wk", "wv"))
-        if self.impl == "local" or mesh is None:
+        # no mesh: ring/ulysses fall back to the single-device blockwise
+        # kernel (exact to float tolerance; memory-safe for long T)
+        if self.impl == "blockwise" or \
+                (mesh is None and self.impl != "local"):
+            o = blockwise_attention(q, k, v, causal=self.causal)
+        elif self.impl == "local":
             o = reference_attention(q, k, v, causal=self.causal)
         elif self.impl == "ring":
             o = ring_attention(q, k, v, mesh, axis=axis, causal=self.causal)

@@ -7,6 +7,7 @@ NCHW run to float tolerance.
 """
 
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.nn.conf import layers as L
@@ -183,3 +184,40 @@ class TestGraphNhwc:
         ya = np.asarray(net_a.output(x)[0])
         yb = np.asarray(net_b.output(x)[0])
         np.testing.assert_allclose(ya, yb, atol=1e-4)
+
+
+class TestZooNhwcEquivalence:
+    """Every CNN zoo model must produce identical outputs under the
+    internal NHWC mode — exercises format-aware Merge/Subset/PoolHelper
+    vertices, LRN, and every preprocessor in real topologies."""
+
+    @pytest.mark.parametrize("name,kwargs,in_shape", [
+        ("LeNet", dict(num_classes=10), (2, 1, 28, 28)),
+        ("SimpleCNN", dict(num_classes=5, height=48, width=48),
+         (2, 3, 48, 48)),
+        ("AlexNet", dict(num_classes=7, height=96, width=96),
+         (2, 3, 96, 96)),
+        ("VGG16", dict(num_classes=6, height=48, width=48), (2, 3, 48, 48)),
+        ("VGG19", dict(num_classes=6, height=48, width=48), (2, 3, 48, 48)),
+        ("GoogLeNet", dict(num_classes=8, height=64, width=64),
+         (2, 3, 64, 64)),
+        ("ResNet50", dict(num_classes=4, height=32, width=32),
+         (2, 3, 32, 32)),
+        ("InceptionResNetV1", dict(num_classes=5, height=96, width=96),
+         (1, 3, 96, 96)),
+        ("FaceNetNN4Small2", dict(num_classes=5), (1, 3, 96, 96)),
+    ])
+    def test_output_matches(self, name, kwargs, in_shape):
+        import deeplearning4j_tpu.zoo as zoo
+        cls = getattr(zoo, name)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal(in_shape).astype(np.float32)
+
+        def out(net):
+            o = net.output(x)
+            return np.asarray(o[0] if isinstance(o, (list, tuple)) else o)
+
+        a = out(cls(**kwargs).init())
+        b = out(cls(**kwargs, data_format="NHWC").init())
+        np.testing.assert_allclose(a, b, atol=2e-4,
+                                   err_msg=f"{name} NHWC != NCHW")
