@@ -1,0 +1,70 @@
+"""Import a Keras .h5 model and run inference.
+
+ref journey: dl4j-examples Keras import (BASELINE config[3]). Pass a real
+.h5 path as argv[1]; without one, a tiny Sequential model is built
+in-process with the Keras JSON/weight conventions to demonstrate the
+round trip end to end.
+
+Run: python examples/keras_import_inference.py [model.h5]
+"""
+
+import json
+import sys
+import tempfile
+
+import numpy as np
+
+from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+
+
+def _demo_h5(path: str):
+    import h5py
+    cfg = {"class_name": "Sequential", "config": {"name": "demo", "layers": [
+        {"class_name": "InputLayer",
+         "config": {"batch_input_shape": [None, 8], "name": "in"}},
+        {"class_name": "Dense",
+         "config": {"name": "d1", "units": 16, "activation": "relu",
+                    "use_bias": True}},
+        {"class_name": "Dense",
+         "config": {"name": "d2", "units": 3, "activation": "softmax",
+                    "use_bias": True}},
+    ]}}
+    rng = np.random.default_rng(0)
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(cfg)
+        mw = f.create_group("model_weights")
+        mw.attrs["layer_names"] = [b"in", b"d1", b"d2"]
+        for name, cin, cout in (("d1", 8, 16), ("d2", 16, 3)):
+            g = mw.create_group(name)
+            g.attrs["weight_names"] = [f"{name}/kernel:0".encode(),
+                                       f"{name}/bias:0".encode()]
+            g.create_dataset(f"{name}/kernel:0",
+                             data=rng.standard_normal((cin, cout))
+                             .astype(np.float32) * 0.3)
+            g.create_dataset(f"{name}/bias:0",
+                             data=np.zeros(cout, np.float32))
+
+
+def main(path: str | None = None):
+    cleanup = None
+    if path is None:
+        tmp = tempfile.NamedTemporaryFile(suffix=".h5", delete=False)
+        tmp.close()
+        _demo_h5(tmp.name)
+        path = cleanup = tmp.name
+        print("no .h5 given — built a demo Sequential model")
+    try:
+        net = KerasModelImport.import_keras_model_and_weights(path)
+    finally:
+        if cleanup:
+            import os
+            os.unlink(cleanup)
+    x = np.random.default_rng(1).standard_normal((4, 8)).astype(np.float32)
+    out = net.output(x)
+    out = out[0] if isinstance(out, (list, tuple)) else out
+    print("output:", np.asarray(out))
+    return net
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else None)

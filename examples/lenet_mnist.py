@@ -1,0 +1,49 @@
+"""Train LeNet on MNIST, evaluate, and save the model.
+
+The BASELINE config[0] journey (ref: dl4j-examples LenetMnistExample).
+Uses the synthetic MNIST stand-in when the IDX files aren't in
+~/.dl4jtpu/data (zero-egress default); drop the real files there for the
+true dataset.
+
+Run: python examples/lenet_mnist.py
+"""
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets import MnistDataSetIterator
+from deeplearning4j_tpu.eval import Evaluation
+from deeplearning4j_tpu.util.model_serializer import write_model
+from deeplearning4j_tpu.zoo import LeNet
+
+
+def main(epochs: int = 2, batch_size: int = 128, synthetic: bool | None = None):
+    if synthetic is None:  # auto-detect: use real files only if BOTH exist
+        try:
+            MnistDataSetIterator(1, train=True, num_examples=1, flatten=True)
+            MnistDataSetIterator(1, train=False, num_examples=1, flatten=True)
+            synthetic = False
+        except FileNotFoundError:
+            print("MNIST files not found — using the synthetic stand-in")
+            synthetic = True
+    train_it = MnistDataSetIterator(batch_size, train=True, flatten=False,
+                                    synthetic=synthetic)
+    test_it = MnistDataSetIterator(batch_size, train=False, flatten=False,
+                                   synthetic=synthetic)
+
+    net = LeNet(num_classes=10).init()
+    for epoch in range(epochs):
+        net.fit(train_it)
+        print(f"epoch {epoch}: loss {net.score_value:.4f}")
+
+    ev = Evaluation(10)
+    for ds in test_it:
+        ev.eval(np.asarray(ds.labels), np.asarray(net.output(ds.features)))
+    print(ev.stats())
+
+    write_model(net, "lenet-mnist.zip")
+    print("saved lenet-mnist.zip")
+    return ev.accuracy()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""Word2Vec on a text corpus + nearest-word queries.
+
+ref journey: dl4j-examples Word2VecRawTextExample. Distributed variant:
+wrap the model in DistributedSequenceVectors(mesh) to train SPMD across
+a device mesh (see examples/mesh_training.py for mesh setup).
+
+Run: python examples/word2vec_text.py [corpus.txt]
+"""
+
+import sys
+
+from deeplearning4j_tpu.nlp import (
+    BasicLineIterator, CollectionSentenceIterator, Word2Vec,
+)
+
+
+def main(corpus_path: str | None = None):
+    if corpus_path:
+        it = BasicLineIterator(corpus_path)
+    else:  # tiny built-in demo corpus
+        sents = ["the quick brown fox jumps over the lazy dog",
+                 "the fox likes the dog", "a brown dog chased the fox",
+                 "cats and dogs are animals", "the cat sat on the mat",
+                 "dogs chase cats", "the animal ran"] * 30
+        it = CollectionSentenceIterator(sents)
+
+    w2v = Word2Vec(sentence_iterator=it, min_word_frequency=2,
+                   layer_size=64, window=5, epochs=5, negative=5,
+                   use_hierarchic_softmax=False, learning_rate=0.05)
+    w2v.fit()
+    for word in ("dog", "fox"):
+        if w2v.get_word_vector(word) is not None:
+            print(word, "->", w2v.words_nearest(word, top_n=5))
+    return w2v
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else None)

@@ -1,0 +1,38 @@
+"""Smoke tests: every example script runs end to end with its defaults
+(the dl4j-examples role — user journeys stay executable)."""
+
+import importlib.util
+import os
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def _run(name, *args, **kwargs):
+    spec = importlib.util.spec_from_file_location(
+        f"example_{name}", os.path.join(EXAMPLES, name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main(*args, **kwargs)
+
+
+def test_lenet_mnist(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)  # checkpoint lands in tmp
+    acc = _run("lenet_mnist", epochs=1, batch_size=64,
+               synthetic=True)  # hermetic regardless of local data files
+    assert acc > 0.2
+    assert os.path.exists(tmp_path / "lenet-mnist.zip")
+
+
+def test_word2vec_text():
+    w2v = _run("word2vec_text")
+    assert w2v.get_word_vector("dog") is not None
+
+
+def test_mesh_training():
+    acc = _run("mesh_training", steps=20)
+    assert acc > 0.5
+
+
+def test_keras_import_inference():
+    net = _run("keras_import_inference")
+    assert net is not None
