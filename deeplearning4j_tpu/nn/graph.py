@@ -82,6 +82,11 @@ class ComputationGraph:
         self._initialized = True
         return self
 
+    def add_listener(self, listener):
+        """Append a training listener (parity with MultiLayerNetwork)."""
+        self.listeners.append(listener)
+        return self
+
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
         return self
@@ -239,9 +244,11 @@ class ComputationGraph:
                 lst.on_epoch_start(self, self.epoch_count)
             for ds in it:
                 self._fit_batch(ds)
-            for lst in self.listeners:
-                lst.on_epoch_end(self, self.epoch_count)
+            # completed-epoch ordering: see multilayer.py fit
+            epoch_idx = self.epoch_count
             self.epoch_count += 1
+            for lst in self.listeners:
+                lst.on_epoch_end(self, epoch_idx)
         return self
 
     def _fit_batch(self, ds: DataSet):

@@ -279,9 +279,14 @@ class MultiLayerNetwork:
                     self._fit_tbptt(ds)
                 else:
                     self._fit_batch(ds)
-            for lst in self.listeners:
-                lst.on_epoch_end(self, self.epoch_count)
+            # increment BEFORE listeners fire: a CheckpointListener save in
+            # on_epoch_end must record this epoch as COMPLETED, or resume
+            # re-trains it (off-by-one). Listeners still receive the
+            # pre-increment epoch index.
+            epoch_idx = self.epoch_count
             self.epoch_count += 1
+            for lst in self.listeners:
+                lst.on_epoch_end(self, epoch_idx)
         return self
 
     def _fit_batch(self, ds: DataSet, carry_rnn: bool = False):

@@ -44,6 +44,10 @@ def _net_state_tree(net) -> Dict[str, Any]:
             "iteration": np.int64(net.iteration_count),
             "epoch": np.int64(net.epoch_count),
         },
+        # the dropout/noise RNG stream: without it, resume would replay
+        # the interrupted epoch with different masks than a straight run
+        "rng": np.asarray(net._rng) if getattr(net, "_rng", None)
+        is not None else np.zeros(2, np.uint32),
     }
 
 
@@ -95,6 +99,10 @@ def restore_checkpoint(net, path: str, step: Optional[int] = None):
     net.updater_state = restored["updater_state"]
     net.iteration_count = int(restored["counters"]["iteration"])
     net.epoch_count = int(restored["counters"]["epoch"])
+    rng = restored.get("rng")
+    if rng is not None and hasattr(net, "_rng"):
+        import jax.numpy as jnp
+        net._rng = jnp.asarray(rng)
     return net
 
 

@@ -1,0 +1,109 @@
+"""Fault-tolerant training: checkpoint-based automatic restart.
+
+SURVEY §5 ("Failure/elastic recovery"): the reference has essentially no
+fault tolerance beyond Spark task retry; on TPU the idiomatic equivalent
+is checkpoint-restart — preemption and crash recovery both reduce to
+"resume from the latest checkpoint and keep going". This wrapper owns
+that loop:
+
+    trainer = FaultTolerantTrainer(net, checkpoint_dir,
+                                   save_every_n_iterations=100)
+    trainer.fit(iterator, epochs=10)        # resumes automatically
+
+- On entry, if the checkpoint dir has saved steps, the newest one is
+  restored (params, optimizer state, BN stats, iteration/epoch counters)
+  and training continues from the NEXT epoch boundary.
+- During fit a CheckpointListener persists periodically.
+- `max_restarts` bounds in-process retries of transient failures
+  (`retry_on` exception types), re-restoring from the latest checkpoint
+  between attempts — the single-host analogue of an elastic scheduler
+  relaunching a preempted worker.
+
+The exact resume==straight-run invariant holds for EPOCH-BOUNDARY
+checkpoints (save_every_epoch=True, the default — the state tree incl.
+the RNG stream restores exactly; tests/test_recovery.py). Iteration-based
+checkpoints (save_every_n_iterations without epoch saves) give
+approximate continuation: the interrupted epoch's already-consumed
+batches are replayed on resume — standard practice, but not bit-equal to
+an uninterrupted run; fit() logs a warning in that configuration.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Tuple, Type
+
+from deeplearning4j_tpu.util.checkpoint import (
+    CheckpointListener, list_checkpoints, restore_checkpoint,
+)
+
+log = logging.getLogger(__name__)
+
+
+class FaultTolerantTrainer:
+    def __init__(self, net, checkpoint_dir: str,
+                 save_every_n_iterations: Optional[int] = None,
+                 save_every_epoch: bool = True, keep_last: int = 3,
+                 max_restarts: int = 2,
+                 retry_on: Tuple[Type[BaseException], ...] = (RuntimeError,)):
+        self.net = net
+        self.dir = checkpoint_dir
+        self.max_restarts = max_restarts
+        self.retry_on = retry_on
+        self._listener = CheckpointListener(
+            checkpoint_dir, save_every_n_iterations=save_every_n_iterations,
+            save_every_epoch=save_every_epoch, keep_last=keep_last)
+        if not save_every_epoch:
+            log.warning(
+                "iteration-only checkpoints: resume replays the "
+                "interrupted epoch's consumed batches (approximate "
+                "continuation, not bit-exact — see module docstring)")
+
+    # -- recovery ---------------------------------------------------------
+    def resume_if_possible(self) -> Optional[int]:
+        """Restore the newest checkpoint if one exists; returns the
+        restored step or None (fresh start)."""
+        steps = list_checkpoints(self.dir)
+        if not steps:
+            return None
+        step = steps[-1]
+        restore_checkpoint(self.net, self.dir, step=step)
+        log.info("resumed from checkpoint step %d (epoch %d)", step,
+                 self.net.epoch_count)
+        return step
+
+    # -- training ---------------------------------------------------------
+    def fit(self, data, labels=None, epochs: int = 1, batch_size: int = 32):
+        """Train to `epochs` TOTAL epochs (counting any epochs already in
+        the restored state), restarting from the latest checkpoint on
+        transient failures up to `max_restarts` times."""
+        if self._listener not in getattr(self.net, "listeners", []):
+            self.net.add_listener(self._listener)
+        self.resume_if_possible()
+        attempts = 0
+        while True:
+            remaining = epochs - self.net.epoch_count
+            if remaining <= 0:
+                log.info("target of %d epochs already reached", epochs)
+                return self.net
+            try:
+                self.net.fit(data, labels=labels, epochs=remaining,
+                             batch_size=batch_size)
+                # terminal checkpoint so a later run resumes cleanly
+                # (skip when the epoch-end listener just wrote this step)
+                steps = list_checkpoints(self.dir)
+                if not steps or steps[-1] != self.net.iteration_count:
+                    self._listener._save(self.net,
+                                         self.net.iteration_count)
+                return self.net
+            except self.retry_on as e:
+                attempts += 1
+                if attempts > self.max_restarts:
+                    log.error("giving up after %d restarts", attempts - 1)
+                    raise
+                log.warning("training failed (%s); restart %d/%d from "
+                            "latest checkpoint", e, attempts,
+                            self.max_restarts)
+                if self.resume_if_possible() is None:
+                    log.warning("no checkpoint yet — restarting from "
+                                "current in-memory state")

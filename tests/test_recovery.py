@@ -1,0 +1,153 @@
+"""Fault-tolerant training tests (SURVEY §5 failure/elastic recovery:
+checkpoint-restart is the TPU-idiomatic equivalent of elastic workers)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Adam
+from deeplearning4j_tpu.util.recovery import FaultTolerantTrainer
+
+
+def _conf():
+    return (NeuralNetConfiguration.Builder()
+            .seed(3).updater(Adam(0.01)).list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=2, loss="mcxent", activation="softmax"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+
+
+def _data(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 4)).astype(np.float32)
+    y = np.zeros((n, 2), np.float32)
+    y[np.arange(n), (x[:, 0] > 0).astype(int)] = 1.0
+    return x, y
+
+
+class _CrashListener:
+    """Raises after N epochs to simulate preemption mid-run."""
+
+    def __init__(self, crash_after_epoch):
+        self.crash_after = crash_after_epoch
+        self.armed = True
+
+    def on_epoch_end(self, model, epoch):
+        if self.armed and epoch + 1 >= self.crash_after:
+            self.armed = False
+            raise RuntimeError("simulated preemption")
+
+    def __getattr__(self, name):  # other listener hooks: no-ops
+        return lambda *a, **k: None
+
+
+class TestFaultTolerantTrainer:
+    def test_crash_restart_matches_straight_run(self, tmp_path):
+        x, y = _data()
+        # straight run: 6 epochs, checkpointing but never crashing
+        net_a = MultiLayerNetwork(_conf()).init()
+        FaultTolerantTrainer(net_a, str(tmp_path / "a"),
+                             save_every_epoch=True).fit(
+            x, y, epochs=6, batch_size=64)
+
+        # crashing run: dies after epoch 3, auto-restarts from checkpoint
+        net_b = MultiLayerNetwork(_conf()).init()
+        crash = _CrashListener(crash_after_epoch=3)
+        net_b.add_listener(crash)
+        FaultTolerantTrainer(net_b, str(tmp_path / "b"),
+                             save_every_epoch=True).fit(
+            x, y, epochs=6, batch_size=64)
+        assert not crash.armed  # the crash actually fired
+        assert net_b.epoch_count == 6
+        np.testing.assert_allclose(np.asarray(net_a.output(x)),
+                                   np.asarray(net_b.output(x)), atol=1e-5)
+
+    def test_separate_process_resume(self, tmp_path):
+        """Second trainer instance (fresh net) picks up where the first
+        stopped — the cross-process restart story."""
+        x, y = _data()
+        net1 = MultiLayerNetwork(_conf()).init()
+        FaultTolerantTrainer(net1, str(tmp_path / "c"),
+                             save_every_epoch=True).fit(
+            x, y, epochs=3, batch_size=64)
+
+        net2 = MultiLayerNetwork(_conf()).init()
+        t2 = FaultTolerantTrainer(net2, str(tmp_path / "c"),
+                                  save_every_epoch=True)
+        t2.fit(x, y, epochs=7, batch_size=64)
+        assert net2.epoch_count == 7
+
+        # already-done target: no further training
+        net3 = MultiLayerNetwork(_conf()).init()
+        FaultTolerantTrainer(net3, str(tmp_path / "c"),
+                             save_every_epoch=True).fit(
+            x, y, epochs=5, batch_size=64)
+        assert net3.epoch_count == 7  # restored, not rewound
+
+    def test_gives_up_after_max_restarts(self, tmp_path):
+        x, y = _data()
+        net = MultiLayerNetwork(_conf()).init()
+
+        class _AlwaysCrash(_CrashListener):
+            def on_epoch_end(self, model, epoch):
+                raise RuntimeError("hard failure")
+
+        net.add_listener(_AlwaysCrash(1))
+        with pytest.raises(RuntimeError, match="hard failure"):
+            FaultTolerantTrainer(net, str(tmp_path / "d"),
+                                 save_every_epoch=True,
+                                 max_restarts=2).fit(
+                x, y, epochs=3, batch_size=64)
+
+
+class TestGraphRecovery:
+    def test_graph_crash_restart(self, tmp_path):
+        """ComputationGraph path: add_listener + resume both work."""
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(5).updater(Adam(0.01))
+                .graph_builder()
+                .add_inputs("in")
+                .set_input_types(InputType.feed_forward(4))
+                .add_layer("d", DenseLayer(n_out=6, activation="tanh"), "in")
+                .add_layer("out", OutputLayer(n_out=2, loss="mcxent",
+                                              activation="softmax"), "d")
+                .set_outputs("out")
+                .build())
+        x, y = _data()
+        net = ComputationGraph(conf).init()
+        net.add_listener(_CrashListener(crash_after_epoch=2))
+        FaultTolerantTrainer(net, str(tmp_path / "g")).fit(
+            x, y, epochs=4, batch_size=64)
+        assert net.epoch_count == 4
+
+
+class TestRngCheckpointed:
+    def test_dropout_stream_survives_resume(self, tmp_path):
+        """Stochastic nets: the RNG stream is part of the checkpoint, so
+        crash-restart == straight run even with dropout."""
+        from deeplearning4j_tpu.nn.conf.dropout import Dropout
+
+        def dconf():
+            return (NeuralNetConfiguration.Builder()
+                    .seed(11).updater(Adam(0.01)).list()
+                    .layer(DenseLayer(n_out=16, activation="relu",
+                                      dropout=Dropout(0.5)))
+                    .layer(OutputLayer(n_out=2, loss="mcxent",
+                                       activation="softmax"))
+                    .set_input_type(InputType.feed_forward(4))
+                    .build())
+        x, y = _data()
+        a = MultiLayerNetwork(dconf()).init()
+        FaultTolerantTrainer(a, str(tmp_path / "ra")).fit(
+            x, y, epochs=6, batch_size=64)
+
+        b = MultiLayerNetwork(dconf()).init()
+        b.add_listener(_CrashListener(crash_after_epoch=3))
+        FaultTolerantTrainer(b, str(tmp_path / "rb")).fit(
+            x, y, epochs=6, batch_size=64)
+        np.testing.assert_allclose(np.asarray(a.output(x)),
+                                   np.asarray(b.output(x)), atol=1e-5)
