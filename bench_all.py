@@ -170,6 +170,10 @@ def bench_keras_inception():
         path = os.path.join(d, "iv3.h5")
         write_keras_h5(path, cfg, weights)
         net = KerasModelImport.import_keras_model_and_weights(path)
+    # imported graphs take the internal NHWC layout + bf16 like native
+    # zoo models (outputs equal to the NCHW import, tested)
+    net.conf.use_cnn_data_format("NHWC")
+    net.conf.dtype = "bfloat16"
     B = int(os.environ.get("BENCH_IV3_BATCH", "32"))
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.standard_normal((B, 3, 299, 299)).astype(np.float32))

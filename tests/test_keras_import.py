@@ -470,3 +470,19 @@ class TestInceptionV3Scale:
         assert out.shape == (1, 10)
         assert np.all(np.isfinite(out))
         np.testing.assert_allclose(out.sum(), 1.0, atol=1e-4)
+
+
+class TestImportedGraphNhwc:
+    def test_imported_graph_switches_layout(self):
+        """Keras-imported graphs accept the internal NHWC mode with
+        identical outputs (bench_all.py relies on this)."""
+        cfg, weights, _ = _iv3_config_and_weights(classes=7)
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "iv3.h5")
+            write_keras_h5(path, cfg, weights)
+            a = KerasModelImport.import_keras_model_and_weights(path)
+            b = KerasModelImport.import_keras_model_and_weights(path)
+        b.conf.use_cnn_data_format("NHWC")
+        x = RNG.standard_normal((1, 3, 75, 75)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(a.output(x)),
+                                   np.asarray(b.output(x)), atol=1e-4)
