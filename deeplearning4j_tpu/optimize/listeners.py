@@ -140,3 +140,77 @@ class ComposableIterationListener(TrainingListener):
     def iteration_done(self, model, iteration, score):
         for l in self.listeners:
             l.iteration_done(model, iteration, score)
+
+
+class ParamAndGradientIterationListener(TrainingListener):
+    """Per-iteration parameter/update statistics to the log or a
+    tab-separated file (ref: ParamAndGradientIterationListener.java —
+    the reference logs mean-magnitude of params and gradients; gradients
+    are internal to the jitted step here, so the per-iteration param
+    DELTA, i.e. the applied update, fills that column)."""
+
+    def __init__(self, frequency: int = 1, output_file: str = None,
+                 log_stats: bool = True):
+        self.frequency = max(1, frequency)
+        self.output_file = output_file
+        self.log_stats = log_stats
+        self._prev = None
+        if output_file:
+            with open(output_file, "w") as f:
+                f.write("iteration\tscore\tparam_mean_mag\tupdate_mean_mag\n")
+
+    @staticmethod
+    def _leaves(tree, path=""):
+        import numpy as np
+        if isinstance(tree, dict):
+            for k in sorted(tree):
+                yield from ParamAndGradientIterationListener._leaves(
+                    tree[k], path + "/" + str(k))
+        elif tree is not None:
+            yield path, np.asarray(tree)
+
+    @classmethod
+    def _mean_mag(cls, leaves):
+        import numpy as np
+        total = sum(float(np.abs(a).sum()) for _, a in leaves)
+        count = sum(a.size for _, a in leaves)
+        return total / max(1, count)
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.frequency:
+            return  # keep _prev: the update column spans the report interval
+        leaves = list(self._leaves(model.params))
+        pm = self._mean_mag(leaves)
+        um = float("nan")
+        if self._prev is not None and len(self._prev) == len(leaves):
+            um = self._mean_mag([(p, a - b)
+                                 for (p, a), (_, b)
+                                 in zip(leaves, self._prev)])
+        # safe to keep without copying: jax arrays are immutable and the
+        # train step REPLACES model.params each iteration, so these
+        # snapshots can't be mutated underneath us
+        self._prev = leaves
+        if self.log_stats:
+            log.info("iter %d: score %.5f, |param| %.3e, |update| %.3e",
+                     iteration, score, pm, um)
+        if self.output_file:
+            with open(self.output_file, "a") as f:
+                f.write(f"{iteration}\t{score:.6f}\t{pm:.6e}\t{um:.6e}\n")
+
+
+class SleepyTrainingListener(TrainingListener):
+    """Inject sleeps into the training loop for debugging/throttling
+    (ref: SleepyTrainingListener.java timerIteration/timerEpoch)."""
+
+    def __init__(self, sleep_iteration_ms: float = 0.0,
+                 sleep_epoch_ms: float = 0.0):
+        self.sleep_iteration_ms = sleep_iteration_ms
+        self.sleep_epoch_ms = sleep_epoch_ms
+
+    def iteration_done(self, model, iteration, score):
+        if self.sleep_iteration_ms > 0:
+            time.sleep(self.sleep_iteration_ms / 1000.0)
+
+    def on_epoch_end(self, model, epoch):
+        if self.sleep_epoch_ms > 0:
+            time.sleep(self.sleep_epoch_ms / 1000.0)

@@ -156,3 +156,48 @@ class TestObjectStore:
     def test_unknown_scheme(self):
         with pytest.raises(ValueError, match="unsupported"):
             Downloader().list("ftp://host/x")
+
+
+class TestNewListeners:
+    def test_param_and_gradient_listener(self, tmp_path):
+        from deeplearning4j_tpu.optimize.listeners import (
+            ParamAndGradientIterationListener,
+        )
+        from deeplearning4j_tpu.nn.conf import (
+            InputType, NeuralNetConfiguration,
+        )
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.updater import Sgd
+        import numpy as np
+
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(2).updater(Sgd(0.1)).list()
+                .layer(DenseLayer(n_out=4))
+                .layer(OutputLayer(n_out=2, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.feed_forward(3))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        out = str(tmp_path / "stats.tsv")
+        net.add_listener(ParamAndGradientIterationListener(output_file=out))
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((32, 3)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 32)]
+        net.fit(x, y, epochs=3, batch_size=16)
+        lines = open(out).read().strip().splitlines()
+        assert lines[0].startswith("iteration\tscore")
+        assert len(lines) >= 4
+        # update column becomes finite once history exists
+        last = lines[-1].split("\t")
+        assert float(last[2]) > 0 and np.isfinite(float(last[3]))
+
+    def test_sleepy_listener(self):
+        import time as _time
+        from deeplearning4j_tpu.optimize.listeners import (
+            SleepyTrainingListener,
+        )
+        sl = SleepyTrainingListener(sleep_iteration_ms=30)
+        t0 = _time.perf_counter()
+        sl.iteration_done(None, 1, 0.0)
+        assert _time.perf_counter() - t0 >= 0.025
