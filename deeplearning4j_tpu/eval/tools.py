@@ -107,3 +107,66 @@ def _write(path: str, title: str, body: str) -> None:
         f.write(f"<!DOCTYPE html><html><head><title>{title}</title>"
                 f"<style>{_STYLE}</style></head><body><h1>{title}</h1>"
                 f"{body}</body></html>")
+
+
+def evaluation_report_components(evaluation=None, rocs=None, roc_titles=None,
+                                 scores=None, class_names=None):
+    """Build a ui-components report for evaluation results (the DSL from
+    ui/components.py — ref: the reference renders its eval exports through
+    the ui-components chart classes). Returns a list of Components; pass
+    to ui.components.render_page for a standalone HTML page.
+
+    evaluation: eval/Evaluation -> confusion table + per-class F1 bars
+    rocs: ROC or list of ROCs -> one scatter/line chart per curve
+    scores: [(iteration, score)] -> training-score line chart
+    """
+    from deeplearning4j_tpu.ui.components import (
+        ChartHorizontalBar, ChartLine, ComponentTable, ComponentText,
+    )
+    comps = []
+    if scores:
+        chart = ChartLine("Training score")
+        chart.add_series("score", [s[0] for s in scores],
+                         [s[1] for s in scores])
+        comps.append(chart)
+    if evaluation is not None:
+        cm = evaluation.confusion.matrix
+        n = cm.shape[0]
+        names = [str(c) for c in (class_names or range(n))]
+        comps.append(ComponentTable(
+            header=["actual \\ predicted"] + names,
+            rows=[[names[i]] + [int(cm[i, j]) for j in range(n)]
+                  for i in range(n)],
+            title="Confusion matrix"))
+        bars = ChartHorizontalBar("Per-class F1")
+        for i in range(n):
+            bars.add_bar(names[i], float(evaluation.f1(i)))
+        comps.append(bars)
+        comps.append(ComponentText(
+            f"accuracy {evaluation.accuracy():.4f}, "
+            f"precision {evaluation.precision():.4f}, "
+            f"recall {evaluation.recall():.4f}, "
+            f"F1 {evaluation.f1():.4f}", title="Summary"))
+    if rocs is not None:
+        if not isinstance(rocs, (list, tuple)):
+            rocs = [rocs]
+        titles = list(roc_titles or [])
+        titles += [f"class {i}" for i in range(len(titles), len(rocs))]
+        for roc, title in zip(rocs, titles):
+            _, fpr, tpr = roc.get_roc_curve()
+            chart = ChartLine(f"ROC — {title} "
+                              f"(AUC {roc.calculate_auc():.4f})")
+            chart.add_series("roc", [float(v) for v in fpr],
+                             [float(v) for v in tpr])
+            chart.add_series("chance", [0.0, 1.0], [0.0, 1.0])
+            comps.append(chart)
+    return comps
+
+
+def export_report_to_html_file(path: str, **kwargs) -> None:
+    """One-call evaluation report through the ui-components DSL
+    (kwargs = evaluation_report_components arguments)."""
+    from deeplearning4j_tpu.ui.components import render_page
+    with open(path, "w") as f:
+        f.write(render_page(evaluation_report_components(**kwargs),
+                            title="Evaluation report"))

@@ -340,3 +340,36 @@ class TestUiComponents:
                                           title="<b>t</b>")])
         assert "<script>alert(1)</script>" not in page
         assert "&lt;script&gt;" in page
+
+
+class TestEvaluationReport:
+    def test_components_report(self, tmp_path):
+        """eval/tools renders through the ui-components DSL (ref: the
+        reference's EvaluationTools -> ui-components chain)."""
+        import numpy as np
+        from deeplearning4j_tpu.eval import Evaluation, ROC
+        from deeplearning4j_tpu.eval.tools import (
+            evaluation_report_components, export_report_to_html_file,
+        )
+        rng = np.random.default_rng(3)
+        ev = Evaluation(3)
+        y = np.eye(3)[rng.integers(0, 3, 90)]
+        probs = np.abs(y * 0.7 + rng.random((90, 3)) * 0.3)
+        probs /= probs.sum(1, keepdims=True)
+        ev.eval(y, probs)
+        roc = ROC()
+        roc.eval(y[:, 0], probs[:, 0])
+
+        comps = evaluation_report_components(
+            evaluation=ev, rocs=roc, scores=[(0, 1.5), (5, 0.9)],
+            class_names=["ant", "bee", "cat"])
+        kinds = [type(c).__name__ for c in comps]
+        assert "ComponentTable" in kinds and "ChartHorizontalBar" in kinds
+        assert sum(k == "ChartLine" for k in kinds) == 2  # scores + roc
+
+        path = str(tmp_path / "rep.html")
+        export_report_to_html_file(path, evaluation=ev, rocs=roc,
+                                   class_names=["ant", "bee", "cat"])
+        html = open(path).read()
+        assert "AUC" in html and "Confusion matrix" in html
+        assert "ant" in html and html.startswith("<!DOCTYPE html>")
