@@ -16,7 +16,7 @@ Prints one JSON line per config:
   host it falls back to the 8-virtual-CPU-device mesh and reports
   correctness-path throughput only (flagged "virtual").
 
-Usage: python bench_all.py [resnet|lstm|lenet|vgg16|inception|attention|scaling]...
+Usage: python bench_all.py [resnet|lstm|lenet|vgg16|inception|attention|transformer|scaling]...
 """
 
 import json
@@ -220,6 +220,40 @@ def bench_attention():
                       "value": round(B * T / dt, 1), "unit": "tokens/sec"}))
 
 
+def bench_transformer():
+    """Long-context decoder-only LM training on one chip: 6-layer E=512
+    TextGenerationTransformer at T=8192 (blockwise attention + per-block
+    remat keep HBM bounded)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning4j_tpu.zoo import TextGenerationTransformer
+    from deeplearning4j_tpu.nn.updater import Adam
+
+    V = 256
+    T = int(os.environ.get("BENCH_TFM_T", "8192"))
+    B = int(os.environ.get("BENCH_TFM_B", "4"))
+    net = TextGenerationTransformer(
+        vocab_size=V, embed_dim=512, n_heads=8, n_layers=6, max_length=T,
+        block_size=1024, updater=Adam(3e-4)).init()
+    net.conf.dtype = "bfloat16"
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, V, (B, T))
+    x = np.zeros((B, V, T), np.float32)
+    x[np.arange(B)[:, None], ids, np.arange(T)[None, :]] = 1.0
+    y = np.roll(x, -1, axis=2)
+    step = net._get_train_step(False)
+    key = jax.random.PRNGKey(0)
+    args = (net.params, net.state, net.updater_state,
+            {net.conf.network_inputs[0]: jnp.asarray(x)},
+            {net.conf.network_outputs[0]: jnp.asarray(y)}, key, None, None)
+    _, args = _sync_time(step, args, 3)
+    dt, _ = _sync_time(step, args, 10)
+    print(json.dumps({"metric": f"transformer_train_T{T}",
+                      "value": round(B * T * 10 / dt, 1),
+                      "unit": "tokens/sec"}))
+
+
 def bench_scaling():
     import jax
     virtual = jax.device_count() < 8
@@ -269,10 +303,12 @@ def bench_scaling():
 
 ALL = {"resnet": bench_resnet, "lstm": bench_lstm, "lenet": bench_lenet,
        "vgg16": bench_vgg16, "inception": bench_keras_inception,
-       "attention": bench_attention, "scaling": bench_scaling}
+       "attention": bench_attention, "transformer": bench_transformer,
+       "scaling": bench_scaling}
 
 if __name__ == "__main__":
     names = sys.argv[1:] or ["resnet", "lstm", "lenet", "vgg16",
-                             "inception", "attention", "scaling"]
+                             "inception", "attention", "transformer",
+                             "scaling"]
     for n in names:
         ALL[n]()

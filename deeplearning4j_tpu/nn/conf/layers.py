@@ -667,6 +667,106 @@ class BatchNormalization(FeedForwardLayerConf):
 
 @register_layer
 @dataclass
+class LayerNormalization(FeedForwardLayerConf):
+    """Layer normalization over the feature axis, per example (and per
+    timestep for RNN-format input [N,F,T]). A post-parity layer the
+    transformer stack needs (the reference predates it); gain/bias
+    params follow the BatchNormalization naming.
+    """
+
+    eps: float = 1e-5
+
+    def output_type(self, it):
+        return it
+
+    def init(self, key, it):
+        nf = it.size if it.kind == "rnn" else it.flat_size()
+        self.n_in = self.n_out = nf
+        return {"gamma": jnp.ones((nf,), jnp.float32),
+                "beta": jnp.zeros((nf,), jnp.float32)}, {}
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        # feature axis is 1 for both [N,F] and [N,F,T]
+        acc = jnp.promote_types(x.dtype, jnp.float32)
+        xf = x.astype(acc)
+        mean = xf.mean(axis=1, keepdims=True)
+        var = jnp.maximum((xf * xf).mean(axis=1, keepdims=True)
+                          - mean * mean, 0.0)
+        y = ((xf - mean) * jax.lax.rsqrt(var + self.eps)).astype(x.dtype)
+        shape = [1] * x.ndim
+        shape[1] = -1
+        y = y * params["gamma"].reshape(shape) + params["beta"].reshape(shape)
+        return _act.get(self.activation)(y), state
+
+
+@register_layer
+@dataclass
+class SelfAttentionLayer(FeedForwardLayerConf):
+    """Multi-head self-attention over RNN-format input [N,F,T] (a
+    post-parity layer — the 2017 reference has no attention). The
+    attention core is the flash-style blockwise kernel
+    (parallel/sequence.blockwise_attention), so long sequences run in
+    O(T·block) memory on one chip; under a mesh the same layer math is
+    what ring/Ulysses parallelize.
+
+    Params: Wq/Wk/Wv/Wo [F,F] + bq/bk/bv/bo. `causal` masks the future
+    (LM decoding); `n_heads` must divide n_out.
+    """
+
+    n_heads: int = 4
+    causal: bool = True
+    block_size: int = 512
+
+    def output_type(self, it):
+        if it.kind != "rnn":
+            raise ValueError("SelfAttentionLayer needs RNN input [N,F,T]")
+        return InputType.recurrent(self.n_out or it.size, it.timesteps)
+
+    def init(self, key, it):
+        if self.n_in is None:
+            self.n_in = it.size
+        if self.n_out is None:
+            self.n_out = self.n_in
+        if self.n_out % self.n_heads:
+            raise ValueError(f"n_out {self.n_out} not divisible by "
+                             f"n_heads {self.n_heads}")
+        keys = jax.random.split(key, 4)
+        p = {}
+        for i, name in enumerate(("q", "k", "v", "o")):
+            n_in = self.n_in if name != "o" else self.n_out
+            n_out = self.n_out
+            p["W" + name] = init_weights(keys[i], (n_in, n_out), n_in,
+                                         n_out, self.weight_init, self.dist)
+            p["b" + name] = jnp.zeros((n_out,), jnp.float32)
+        return p, {}
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        from deeplearning4j_tpu.parallel.sequence import blockwise_attention
+        x = self.maybe_dropout_input(x, train, rng)
+        n, f, t = x.shape
+        h = self.n_heads
+        d = self.n_out // h
+        xt = jnp.transpose(x, (0, 2, 1))                    # [N,T,F]
+
+        def proj(name):
+            y = xt @ params["W" + name] + params["b" + name]
+            return y.reshape(n, t, h, d).transpose(0, 2, 1, 3)  # [N,H,T,D]
+
+        q, k, v = proj("q"), proj("k"), proj("v")
+        if mask is not None:  # padded timesteps contribute nothing
+            m = mask[:, None, :, None].astype(q.dtype)
+            k = k * m
+            v = v * m
+        o = blockwise_attention(q, k, v, causal=self.causal,
+                                block_size=self.block_size)
+        o = o.transpose(0, 2, 1, 3).reshape(n, t, self.n_out)
+        o = o @ params["Wo"] + params["bo"]
+        y = jnp.transpose(o, (0, 2, 1))                     # [N,F,T]
+        return _act.get(self.activation)(y), state
+
+
+@register_layer
+@dataclass
 class LocalResponseNormalization(LayerConf):
     """LRN across channels (ref: conf/layers/LocalResponseNormalization.java;
     native path CudnnLocalResponseNormalizationHelper.java). Defaults k=2,

@@ -96,8 +96,13 @@ def blockwise_attention(q, k, v, causal: bool = False,
     m0 = jnp.full((B, H, T), NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, H, T), jnp.float32)
     o0 = jnp.zeros((B, H, T, D), jnp.float32)
+    # remat the block body: reverse-mode through a plain scan would save
+    # every block's [T, block] score/softmax matrices (OOM at long T);
+    # checkpointing recomputes them in backward so only the (m, l, o)
+    # carries persist — the flash-attention backward memory profile.
     (m, l, o), _ = jax.lax.scan(
-        body, (m0, l0, o0), (kb, vb, jnp.arange(n_blocks)))
+        jax.checkpoint(body), (m0, l0, o0),
+        (kb, vb, jnp.arange(n_blocks)))
     return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 

@@ -16,4 +16,5 @@ from deeplearning4j_tpu.zoo.vgg import VGG16, VGG19  # noqa: F401
 from deeplearning4j_tpu.zoo.resnet import ResNet50  # noqa: F401
 from deeplearning4j_tpu.zoo.googlenet import GoogLeNet  # noqa: F401
 from deeplearning4j_tpu.zoo.inception_resnet import InceptionResNetV1, FaceNetNN4Small2  # noqa: F401
-from deeplearning4j_tpu.zoo.text_lstm import TextGenerationLSTM  # noqa: F401
+from deeplearning4j_tpu.zoo.text_lstm import TextGenerationLSTM
+from deeplearning4j_tpu.zoo.transformer import TextGenerationTransformer  # noqa: F401

@@ -1,0 +1,105 @@
+"""TextGenerationTransformer: a decoder-only character/token LM.
+
+Post-parity zoo model (the 2017 reference's sequence model is
+TextGenerationLSTM; this is its modern long-context counterpart built
+from the same config DSL): pre-LN transformer blocks —
+LN → causal multi-head SelfAttentionLayer → residual add →
+LN → position-wise FFN (Convolution1D kernel=1) → residual add —
+over RNN-format [N, V, T] one-hot input, RnnOutputLayer softmax head.
+The attention core is the flash-style blockwise kernel, so contexts of
+tens of thousands of tokens train on a single chip; sequence sharding
+over a mesh uses ring/Ulysses attention on the same math
+(parallel/sequence.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.graph_conf import ElementWiseVertex
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    Convolution1DLayer, LayerNormalization, RnnOutputLayer,
+    SelfAttentionLayer,
+)
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.updater import Adam
+from deeplearning4j_tpu.zoo.base import ZooModel, register_model
+
+
+@register_model
+class TextGenerationTransformer(ZooModel):
+    def __init__(self, vocab_size: int = 128, seed: int = 12345,
+                 embed_dim: int = 256, n_heads: int = 8, n_layers: int = 4,
+                 ffn_mult: int = 4, max_length: int = 1024,
+                 block_size: int = 512, **kw):
+        super().__init__(vocab_size, seed, **kw)
+        if embed_dim % n_heads:
+            raise ValueError("embed_dim must divide by n_heads")
+        self.vocab_size = vocab_size
+        self.embed_dim = embed_dim
+        self.n_heads = n_heads
+        self.n_layers = n_layers
+        self.ffn_mult = ffn_mult
+        self.max_length = max_length
+        self.block_size = block_size
+
+    def conf(self):
+        E = self.embed_dim
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(self.kwargs.get("updater", Adam(3e-4)))
+             .weight_init("xavier")
+             .graph_builder()
+             .add_inputs("in")
+             .set_input_types(InputType.recurrent(self.vocab_size,
+                                                  self.max_length)))
+        # token projection: one-hot [N,V,T] -> [N,E,T] (kernel-1 conv =
+        # position-wise embedding matmul)
+        g.add_layer("embed", Convolution1DLayer(
+            n_out=E, kernel=1, convolution_mode="same",
+            activation="identity"), "in")
+        prev = "embed"
+        for i in range(self.n_layers):
+            g.add_layer(f"ln{i}a", LayerNormalization(), prev)
+            g.add_layer(f"attn{i}", SelfAttentionLayer(
+                n_out=E, n_heads=self.n_heads, causal=True,
+                block_size=self.block_size, activation="identity"),
+                f"ln{i}a")
+            g.add_vertex(f"res{i}a", ElementWiseVertex(op="add"),
+                         prev, f"attn{i}")
+            g.add_layer(f"ln{i}b", LayerNormalization(), f"res{i}a")
+            g.add_layer(f"ffn{i}a", Convolution1DLayer(
+                n_out=E * self.ffn_mult, kernel=1,
+                convolution_mode="same", activation="gelu"), f"ln{i}b")
+            g.add_layer(f"ffn{i}b", Convolution1DLayer(
+                n_out=E, kernel=1, convolution_mode="same",
+                activation="identity"), f"ffn{i}a")
+            g.add_vertex(f"res{i}b", ElementWiseVertex(op="add"),
+                         f"res{i}a", f"ffn{i}b")
+            prev = f"res{i}b"
+        g.add_layer("ln_f", LayerNormalization(), prev)
+        g.add_layer("out", RnnOutputLayer(
+            n_out=self.vocab_size, loss="mcxent", activation="softmax"),
+            "ln_f")
+        return g.set_outputs("out").build()
+
+    # -- convenience: sampling (ref TextGenerationLSTM usage pattern) ------
+    @staticmethod
+    def sample(net, seed_ids, steps: int, vocab_size: int,
+               rng: np.random.Generator = None, temperature: float = 1.0):
+        """Autoregressive sampling from a trained net: feed the growing
+        one-hot sequence, take the last-step distribution each time."""
+        rng = rng or np.random.default_rng(0)
+        ids = list(seed_ids)
+        for _ in range(steps):
+            x = np.zeros((1, vocab_size, len(ids)), np.float32)
+            x[0, ids, np.arange(len(ids))] = 1.0
+            out = net.output(x)
+            probs = np.asarray(out[0] if isinstance(out, (list, tuple))
+                               else out)[0, :, -1]
+            logits = np.log(np.clip(probs, 1e-9, None)) / temperature
+            p = np.exp(logits - logits.max())
+            p /= p.sum()
+            ids.append(int(rng.choice(vocab_size, p=p)))
+        return ids
