@@ -677,6 +677,11 @@ class LayerNormalization(FeedForwardLayerConf):
     eps: float = 1e-5
 
     def output_type(self, it):
+        if it.kind == "cnn":
+            raise ValueError(
+                "LayerNormalization supports FF [N,F] and RNN [N,F,T] "
+                "input (per-feature axis 1); use BatchNormalization for "
+                "CNN activations")
         return it
 
     def init(self, key, it):
@@ -695,7 +700,37 @@ class LayerNormalization(FeedForwardLayerConf):
         y = ((xf - mean) * jax.lax.rsqrt(var + self.eps)).astype(x.dtype)
         shape = [1] * x.ndim
         shape[1] = -1
-        y = y * params["gamma"].reshape(shape) + params["beta"].reshape(shape)
+        y = y * params["gamma"].astype(x.dtype).reshape(shape) + \
+            params["beta"].astype(x.dtype).reshape(shape)
+        return _act.get(self.activation)(y), state
+
+
+@register_layer
+@dataclass
+class PositionalEmbeddingLayer(FeedForwardLayerConf):
+    """Adds a learned positional embedding to RNN-format input [N,F,T]
+    (post-parity; attention is position-agnostic without it). Params:
+    P [F, max_length]; positions beyond max_length are rejected at
+    trace time by the slice."""
+
+    max_length: int = 1024
+
+    def output_type(self, it):
+        if it.kind != "rnn":
+            raise ValueError("PositionalEmbeddingLayer needs RNN input")
+        return it
+
+    def init(self, key, it):
+        self.n_in = self.n_out = it.size
+        p = 0.02 * jax.random.normal(key, (it.size, self.max_length))
+        return {"P": p.astype(jnp.float32)}, {}
+
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+        t = x.shape[2]
+        if t > self.max_length:
+            raise ValueError(f"sequence length {t} exceeds max_length "
+                             f"{self.max_length}")
+        y = x + params["P"][None, :, :t].astype(x.dtype)
         return _act.get(self.activation)(y), state
 
 
@@ -753,12 +788,10 @@ class SelfAttentionLayer(FeedForwardLayerConf):
             return y.reshape(n, t, h, d).transpose(0, 2, 1, 3)  # [N,H,T,D]
 
         q, k, v = proj("q"), proj("k"), proj("v")
-        if mask is not None:  # padded timesteps contribute nothing
-            m = mask[:, None, :, None].astype(q.dtype)
-            k = k * m
-            v = v * m
+        # variable-length batches: mask KEYS with -inf score bias (zeroed
+        # K/V would still receive softmax mass)
         o = blockwise_attention(q, k, v, causal=self.causal,
-                                block_size=self.block_size)
+                                block_size=self.block_size, key_mask=mask)
         o = o.transpose(0, 2, 1, 3).reshape(n, t, self.n_out)
         o = o @ params["Wo"] + params["bo"]
         y = jnp.transpose(o, (0, 2, 1))                     # [N,F,T]

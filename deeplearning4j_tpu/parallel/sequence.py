@@ -48,7 +48,7 @@ def reference_attention(q, k, v, causal: bool = False):
 
 
 def blockwise_attention(q, k, v, causal: bool = False,
-                        block_size: int = 512):
+                        block_size: int = 512, key_mask=None):
     """Single-device flash-style attention: lax.scan over KV blocks with
     an online-softmax accumulator — O(T·block) live memory instead of the
     [T,T] score matrix, so one chip handles long contexts that would OOM
@@ -57,7 +57,10 @@ def blockwise_attention(q, k, v, causal: bool = False,
     MXU and the running (m, l, o) update fuses into their epilogue.
 
     q,k,v: [B,H,T,D]. T is padded internally to a block multiple; padded
-    keys are masked with NEG_INF so results are unaffected.
+    keys are masked with NEG_INF so results are unaffected. `key_mask`
+    [B,T] (1=valid) additionally NEG_INF-masks padded KEY positions of
+    variable-length batches (zeroing K/V would still receive softmax
+    mass — score 0 can exceed valid negative scores).
     """
     B, H, T, D = q.shape
     bs = int(min(block_size, T))
@@ -65,6 +68,9 @@ def blockwise_attention(q, k, v, causal: bool = False,
     if pad:
         k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    if key_mask is not None:
+        km = jnp.pad(key_mask.astype(bool), ((0, 0), (0, pad)))
+        kmb = km.reshape(B, -1, bs).transpose(1, 0, 2)   # [n_blocks,B,bs]
     n_blocks = (T + pad) // bs
     scale = jnp.float32(1.0 / np.sqrt(D))
     qf = q.astype(jnp.float32)
@@ -74,7 +80,11 @@ def blockwise_attention(q, k, v, causal: bool = False,
 
     def body(carry, blk):
         m, l, o = carry
-        kc, vc, idx = blk
+        if key_mask is not None:
+            kc, vc, idx, kmc = blk
+        else:
+            kc, vc, idx = blk
+            kmc = None
         s = jnp.einsum("bhqd,bhkd->bhqk", qf,
                        kc.astype(jnp.float32)) * scale
         k_pos = idx * bs + jnp.arange(bs)
@@ -84,6 +94,8 @@ def blockwise_attention(q, k, v, causal: bool = False,
         else:
             valid = jnp.broadcast_to(valid[None, :], (T, bs))
         s = jnp.where(valid[None, None], s, NEG_INF)
+        if kmc is not None:  # variable-length key mask [B,bs]
+            s = jnp.where(kmc[:, None, None, :], s, NEG_INF)
         blk_max = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m, blk_max)
         p = jnp.exp(s - m_new[..., None])
@@ -100,9 +112,10 @@ def blockwise_attention(q, k, v, causal: bool = False,
     # every block's [T, block] score/softmax matrices (OOM at long T);
     # checkpointing recomputes them in backward so only the (m, l, o)
     # carries persist — the flash-attention backward memory profile.
-    (m, l, o), _ = jax.lax.scan(
-        jax.checkpoint(body), (m0, l0, o0),
-        (kb, vb, jnp.arange(n_blocks)))
+    xs = (kb, vb, jnp.arange(n_blocks))
+    if key_mask is not None:
+        xs = xs + (kmb,)
+    (m, l, o), _ = jax.lax.scan(jax.checkpoint(body), (m0, l0, o0), xs)
     return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 

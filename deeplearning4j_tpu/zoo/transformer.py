@@ -19,8 +19,8 @@ import numpy as np
 from deeplearning4j_tpu.nn.conf.graph_conf import ElementWiseVertex
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers import (
-    Convolution1DLayer, LayerNormalization, RnnOutputLayer,
-    SelfAttentionLayer,
+    Convolution1DLayer, LayerNormalization, PositionalEmbeddingLayer,
+    RnnOutputLayer, SelfAttentionLayer,
 )
 from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.updater import Adam
@@ -59,7 +59,9 @@ class TextGenerationTransformer(ZooModel):
         g.add_layer("embed", Convolution1DLayer(
             n_out=E, kernel=1, convolution_mode="same",
             activation="identity"), "in")
-        prev = "embed"
+        g.add_layer("pos", PositionalEmbeddingLayer(
+            max_length=self.max_length), "embed")
+        prev = "pos"
         for i in range(self.n_layers):
             g.add_layer(f"ln{i}a", LayerNormalization(), prev)
             g.add_layer(f"attn{i}", SelfAttentionLayer(
@@ -85,21 +87,29 @@ class TextGenerationTransformer(ZooModel):
         return g.set_outputs("out").build()
 
     # -- convenience: sampling (ref TextGenerationLSTM usage pattern) ------
-    @staticmethod
-    def sample(net, seed_ids, steps: int, vocab_size: int,
+    def sample(self, net, seed_ids, steps: int, vocab_size: int = None,
                rng: np.random.Generator = None, temperature: float = 1.0):
-        """Autoregressive sampling from a trained net: feed the growing
-        one-hot sequence, take the last-step distribution each time."""
+        """Autoregressive sampling from a trained net. The input is padded
+        to max_length so XLA compiles ONE shape (causal attention + the
+        per-position layers make trailing zero padding inert for the
+        position being read)."""
+        V = vocab_size or self.vocab_size
+        L = self.max_length
         rng = rng or np.random.default_rng(0)
         ids = list(seed_ids)
+        x = np.zeros((1, V, L), np.float32)
+        x[0, ids, np.arange(len(ids))] = 1.0
         for _ in range(steps):
-            x = np.zeros((1, vocab_size, len(ids)), np.float32)
-            x[0, ids, np.arange(len(ids))] = 1.0
+            pos = len(ids) - 1
+            if pos + 1 >= L:
+                break
             out = net.output(x)
             probs = np.asarray(out[0] if isinstance(out, (list, tuple))
-                               else out)[0, :, -1]
+                               else out)[0, :, pos]
             logits = np.log(np.clip(probs, 1e-9, None)) / temperature
             p = np.exp(logits - logits.max())
             p /= p.sum()
-            ids.append(int(rng.choice(vocab_size, p=p)))
+            nxt = int(rng.choice(V, p=p))
+            ids.append(nxt)
+            x[0, nxt, len(ids) - 1] = 1.0
         return ids

@@ -93,6 +93,42 @@ class TestSelfAttentionLayer:
             layer.init(jax.random.PRNGKey(0), InputType.recurrent(10, 4))
 
 
+class TestPositionalEmbedding:
+    def test_adds_position_signal(self):
+        from deeplearning4j_tpu.nn.conf.layers import (
+            PositionalEmbeddingLayer,
+        )
+        layer = PositionalEmbeddingLayer(max_length=16)
+        p, _ = layer.init(jax.random.PRNGKey(0), InputType.recurrent(4, 8))
+        x = jnp.zeros((2, 4, 8), jnp.float32)
+        y, _ = layer.apply(p, x, {})
+        # identical inputs at different positions now differ
+        assert not np.allclose(np.asarray(y)[:, :, 0],
+                               np.asarray(y)[:, :, 1])
+        with pytest.raises(ValueError):
+            layer.apply(p, jnp.zeros((1, 4, 20), jnp.float32), {})
+
+
+class TestBlockwiseKeyMask:
+    def test_key_mask_matches_truncation(self):
+        """Masked trailing keys == attention over the truncated sequence
+        (for the valid query positions)."""
+        from deeplearning4j_tpu.parallel.sequence import (
+            blockwise_attention,
+        )
+        B, H, T, D, TV = 2, 2, 12, 8, 9  # TV = valid length
+        q = jnp.asarray(RNG.standard_normal((B, H, T, D)), jnp.float32)
+        k = jnp.asarray(RNG.standard_normal((B, H, T, D)), jnp.float32)
+        v = jnp.asarray(RNG.standard_normal((B, H, T, D)), jnp.float32)
+        km = jnp.asarray(np.arange(T)[None, :] < TV).repeat(B, 0)
+        out = blockwise_attention(q, k, v, causal=False, block_size=5,
+                                  key_mask=km)
+        ref = blockwise_attention(q[:, :, :TV], k[:, :, :TV], v[:, :, :TV],
+                                  causal=False, block_size=5)
+        np.testing.assert_allclose(np.asarray(out)[:, :, :TV],
+                                   np.asarray(ref), atol=1e-5)
+
+
 class TestTextGenerationTransformer:
     def test_learns_copy_task(self):
         """Tiny LM learns 'next token = current token' far above chance."""
@@ -127,6 +163,5 @@ class TestTextGenerationTransformer:
                                           n_heads=2, n_layers=1,
                                           max_length=8)
         net = model.init()
-        ids = TextGenerationTransformer.sample(net, [1, 2], steps=5,
-                                               vocab_size=V)
+        ids = model.sample(net, [1, 2], steps=5)
         assert len(ids) == 7 and all(0 <= i < V for i in ids)
