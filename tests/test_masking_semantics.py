@@ -1,0 +1,124 @@
+"""Variable-length masking invariants (ref test models:
+deeplearning4j-core nn/multilayer/TestVariableLengthTS.java and
+TestMasking.java — the SURVEY §7 'hard part': garbage in masked
+timesteps must not leak into loss, gradients, or valid outputs)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (
+    GravesLSTM, LSTM, RnnOutputLayer, SelfAttentionLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Sgd
+
+RNG = np.random.default_rng(0)
+
+
+def _lstm_conf(layer_cls=LSTM, f=4, k=3):
+    return (NeuralNetConfiguration.Builder()
+            .seed(9).updater(Sgd(0.1)).list()
+            .layer(layer_cls(n_out=6, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=k, loss="mcxent",
+                                  activation="softmax"))
+            .set_input_type(InputType.recurrent(f, None))
+            .build())
+
+
+def _masked_batch(f=4, k=3, t=8, valid=5):
+    x = RNG.standard_normal((2, f, t)).astype(np.float32)
+    y = np.zeros((2, k, t), np.float32)
+    y[:, 0, :] = 1.0
+    fmask = np.zeros((2, t), np.float32)
+    fmask[:, :valid] = 1.0
+    return x, y, fmask
+
+
+class TestMaskedRegionsInert:
+    @pytest.mark.parametrize("layer_cls", [LSTM, GravesLSTM])
+    def test_loss_ignores_masked_garbage(self, layer_cls):
+        """ref TestVariableLengthTS.testVariableLengthSimple: changing
+        data in masked timesteps must not change the score."""
+        x, y, fmask = _masked_batch()
+        net = MultiLayerNetwork(_lstm_conf(layer_cls)).init()
+        ds1 = DataSet(x, y, features_mask=fmask, labels_mask=fmask)
+        s1 = net.score(ds1)
+        x2 = x.copy()
+        x2[:, :, 5:] = 1e3  # garbage where masked
+        s2 = net.score(DataSet(x2, y, features_mask=fmask,
+                               labels_mask=fmask))
+        assert abs(s1 - s2) < 1e-5, (s1, s2)
+
+    @pytest.mark.parametrize("layer_cls", [LSTM, GravesLSTM])
+    def test_gradients_ignore_masked_garbage(self, layer_cls):
+        """Training on masked-garbage batches must produce identical
+        parameter updates."""
+        x, y, fmask = _masked_batch()
+        net_a = MultiLayerNetwork(_lstm_conf(layer_cls)).init()
+        net_b = MultiLayerNetwork(_lstm_conf(layer_cls)).init()
+        x2 = x.copy()
+        x2[:, :, 5:] = -777.0
+        net_a._fit_batch(DataSet(x, y, features_mask=fmask,
+                                 labels_mask=fmask))
+        net_b._fit_batch(DataSet(x2, y, features_mask=fmask,
+                                 labels_mask=fmask))
+        for k in net_a.params:
+            for pk in net_a.params[k]:
+                np.testing.assert_allclose(
+                    np.asarray(net_a.params[k][pk]),
+                    np.asarray(net_b.params[k][pk]), atol=1e-5,
+                    err_msg=f"{k}/{pk}")
+
+    def test_valid_outputs_match_truncated_run(self):
+        """Output at valid positions == running the truncated sequence
+        (ref TestVariableLengthTS.testVariableLengthTSOutput)."""
+        f, k, t, valid = 4, 3, 8, 5
+        x, y, fmask = _masked_batch(f, k, t, valid)
+        net = MultiLayerNetwork(_lstm_conf()).init()
+        out_masked = np.asarray(net.output(x, mask=fmask))
+        out_trunc = np.asarray(net.output(x[:, :, :valid]))
+        np.testing.assert_allclose(out_masked[:, :, :valid], out_trunc,
+                                   atol=1e-5)
+
+    def test_attention_layer_masked(self):
+        """SelfAttentionLayer (non-causal) must not attend to masked
+        keys: loss invariant to garbage there."""
+        f, k, t, valid = 4, 3, 8, 5
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(2).updater(Sgd(0.1)).list()
+                .layer(SelfAttentionLayer(n_out=8, n_heads=2, causal=False,
+                                          activation="identity"))
+                .layer(RnnOutputLayer(n_out=k, loss="mcxent",
+                                      activation="softmax"))
+                .set_input_type(InputType.recurrent(f, None))
+                .build())
+        x, y, fmask = _masked_batch(f, k, t, valid)
+        net = MultiLayerNetwork(conf).init()
+        s1 = net.score(DataSet(x, y, features_mask=fmask,
+                               labels_mask=fmask))
+        x2 = x.copy()
+        x2[:, :, valid:] = 500.0
+        s2 = net.score(DataSet(x2, y, features_mask=fmask,
+                               labels_mask=fmask))
+        assert abs(s1 - s2) < 1e-4, (s1, s2)
+
+    def test_label_mask_weights_loss(self):
+        """Label mask excludes positions from the loss: score over
+        mask=[1,1,0...] equals score over the first two steps only."""
+        f, k, t = 4, 3, 6
+        x = RNG.standard_normal((2, f, t)).astype(np.float32)
+        y = np.zeros((2, k, t), np.float32)
+        y[:, 1, :] = 1.0
+        lmask = np.zeros((2, t), np.float32)
+        lmask[:, :2] = 1.0
+        net = MultiLayerNetwork(_lstm_conf()).init()
+        s_masked = net.score(DataSet(x, y, labels_mask=lmask))
+        # full-mask score over the same positions: build explicit compare
+        full = np.ones((2, t), np.float32)
+        s_full = net.score(DataSet(x, y, labels_mask=full))
+        assert not np.isclose(s_masked, s_full)
+        assert np.isfinite(s_masked)
