@@ -138,6 +138,7 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
     perm = [(i, (i + 1) % n) for i in range(n)]
     q_pos = my * T + jnp.arange(T)                     # global query positions
 
+    @jax.checkpoint  # flash-style backward: recompute per-step scores
     def body(step, carry):
         k_c, v_c, m, l, o = carry
         src = (my - step) % n                          # origin shard of k_c

@@ -36,3 +36,8 @@ def test_mesh_training():
 def test_keras_import_inference():
     net = _run("keras_import_inference")
     assert net is not None
+
+
+def test_transformer_lm():
+    loss = _run("transformer_lm", steps=40, seq_len=32)
+    assert loss < 3.0  # well below ln(V)~3.4 uniform
