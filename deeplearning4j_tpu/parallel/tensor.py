@@ -1,0 +1,137 @@
+"""Tensor (model) parallelism: Megatron-style sharded transformer blocks.
+
+Beyond the reference's data-parallel-only scale-out (SURVEY §2.5 — all
+four reference strategies shard the BATCH), TPU meshes make intra-layer
+model sharding first-class: this module shards attention heads and FFN
+hidden units over a "model" mesh axis with the canonical Megatron
+layout —
+
+- attention: Wq/Wk/Wv column-sharded (each device owns H/n heads, runs
+  its heads' attention locally), Wo row-sharded, one psum to rebuild the
+  residual stream;
+- MLP: W1 column-sharded (hidden/n per device), W2 row-sharded, one psum.
+
+Two collectives per block, both riding ICI. Composes with the "data"
+axis (dp x tp meshes) and with sequence parallelism (parallel/sequence)
+on the same mesh. Exactness vs the single-device math is tested on the
+virtual 8-device mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from deeplearning4j_tpu.parallel.sequence import blockwise_attention
+
+
+def shard_mha_params(params: Dict, mesh: Mesh, axis: str = "model"):
+    """Place MultiHeadSelfAttention-style params {wq,wk,wv,wo} (or the
+    SelfAttentionLayer spelling {Wq,...,bq,...}) with the Megatron
+    layout: q/k/v column-sharded, o row-sharded."""
+    col = NamedSharding(mesh, P(None, axis))
+    row = NamedSharding(mesh, P(axis, None))
+    vec = NamedSharding(mesh, P(axis))
+    rep = NamedSharding(mesh, P())
+    out = {}
+    for k, v in params.items():
+        lk = k.lower()
+        if lk in ("wq", "wk", "wv"):
+            out[k] = jax.device_put(v, col)
+        elif lk == "wo":
+            out[k] = jax.device_put(v, row)
+        elif lk in ("bq", "bk", "bv"):
+            out[k] = jax.device_put(v, vec)
+        else:  # bo and anything else replicated
+            out[k] = jax.device_put(v, rep)
+    return out
+
+
+def tp_mha(params: Dict, x, mesh: Mesh, n_heads: int,
+           axis: str = "model", causal: bool = True,
+           block_size: int = 512, batch_axis: str = None):
+    """Tensor-parallel multi-head self-attention.
+
+    x: [B,T,E]; params as in shard_mha_params (keys wq/wk/wv/wo +
+    optional biases, any capitalization; missing biases are treated as
+    zero). Each device computes its H/n heads with the blockwise kernel;
+    the row-sharded output projection psums (over the model axis only)
+    back to the full residual. `batch_axis` additionally shards B over a
+    data axis of the same mesh (dp x tp composition). Output == the
+    unsharded math.
+    """
+    n = mesh.shape[axis]
+    if n_heads % n:
+        raise ValueError(f"n_heads {n_heads} not divisible by mesh axis "
+                         f"'{axis}' size {n}")
+    E = x.shape[-1]
+    keys = {k.lower(): k for k in params}
+
+    def get(name, width):
+        if name in keys:
+            return params[keys[name]]
+        return jnp.zeros((width,), x.dtype)  # absent bias = zero
+
+    xspec = P(batch_axis, None, None) if batch_axis else P()
+    col, row, colb, rep = P(None, axis), P(axis, None), P(axis), P()
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(xspec, col, col, col, row, colb, colb, colb, rep),
+             out_specs=xspec, check_vma=False)
+    def fwd(x, wq, wk, wv, wo, bq, bk, bv, bo):
+        B, T, _ = x.shape
+        h_local = n_heads // n
+        d = E // n_heads
+
+        def proj(w, b):
+            y = x @ w + b  # [B,T,E/n]
+            return y.reshape(B, T, h_local, d).transpose(0, 2, 1, 3)
+
+        q, k, v = proj(wq, bq), proj(wk, bk), proj(wv, bv)
+        o = blockwise_attention(q, k, v, causal=causal,
+                                block_size=block_size)
+        o = o.transpose(0, 2, 1, 3).reshape(B, T, E // n)
+        out = jax.lax.psum(o @ wo, axis)  # row-parallel projection
+        return out + bo
+
+    return fwd(x, params[keys["wq"]], params[keys["wk"]],
+               params[keys["wv"]], params[keys["wo"]],
+               get("bq", E), get("bk", E), get("bv", E), get("bo", E))
+
+
+def tp_mlp(params: Dict, x, mesh: Mesh, axis: str = "model",
+           activation=jax.nn.gelu, batch_axis: str = None):
+    """Tensor-parallel position-wise MLP: W1 [E,F] column-sharded,
+    W2 [F,E] row-sharded, biases b1 sharded / b2 replicated. One psum
+    (over the model axis only — composes with `batch_axis` dp)."""
+    xspec = P(batch_axis, None, None) if batch_axis else P()
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(xspec, P(None, axis), P(axis), P(axis, None), P()),
+             out_specs=xspec, check_vma=False)
+    def fwd(x, w1, b1, w2, b2):
+        h = activation(x @ w1 + b1)
+        return jax.lax.psum(h @ w2, axis) + b2
+
+    return fwd(x, params["W1"], params["b1"], params["W2"], params["b2"])
+
+
+def make_tp_mesh(n_data: int, n_model: int, devices=None) -> Mesh:
+    """2-D dp x tp mesh ("data", "model") — the composed layout the
+    dryrun exercises. Thin wrapper over parallel.mesh.make_mesh (which
+    validates the device count)."""
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    devices = devices if devices is not None \
+        else jax.devices()[:n_data * n_model]
+    return make_mesh(shape=(n_data, n_model),
+                     axis_names=("data", "model"), devices=devices)
