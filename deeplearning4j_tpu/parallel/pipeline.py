@@ -1,0 +1,111 @@
+"""Pipeline (stage) parallelism: GPipe-style microbatched execution.
+
+Completes the parallelism suite (data parallel — parallel/wrapper;
+sequence parallel — parallel/sequence; tensor parallel — parallel/tensor)
+with the fourth axis: each device of a "pipe" mesh axis owns ONE STAGE of
+the network; microbatches stream through the stages, activations hop to
+the next stage over ICI with `ppermute`. The schedule is the classic
+GPipe fill-drain loop: with S stages and M microbatches, the loop runs
+S+M-1 ticks, each device computing its stage on the microbatch currently
+resident (or idling in the bubble); bubble fraction (S-1)/(S+M-1)
+shrinks as M grows.
+
+All stages must share one apply signature (params, x) -> y with equal
+activation shapes (classic homogeneous-block pipelining, the transformer
+case). Exactness vs sequentially composing the stages is tested on the
+virtual mesh; gradients flow through the ppermutes so the same program
+trains under jax.grad.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+def shard_stage_params(stage_params: list, mesh: Mesh, axis: str = "pipe"):
+    """Stack per-stage param pytrees along a new leading axis and shard it
+    over the pipe axis (device s holds stage s's params)."""
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *stage_params)
+    sh = lambda a: NamedSharding(  # noqa: E731
+        mesh, P(*([axis] + [None] * (a.ndim - 1))))
+    return jax.tree.map(lambda a: jax.device_put(a, sh(a)), stacked)
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh,
+                   axis: str = "pipe", n_microbatches: int = None):
+    """Run `stage_fn(params_s, h)` for stages s=0..S-1 over the pipe axis.
+
+    stacked_params: pytree with leading stage axis (shard_stage_params).
+    x: [B, ...] global batch; B must divide by n_microbatches (default =
+    number of stages). Returns the final stage's output for the full
+    batch. Differentiable (fori_loop-free: a lax.scan drives the
+    schedule, ppermute moves activations stage->stage).
+    """
+    S = mesh.shape[axis]
+    n_stages = jax.tree.leaves(stacked_params)[0].shape[0]
+    if n_stages != S:
+        raise ValueError(
+            f"{n_stages} stacked stages but the '{axis}' mesh axis has "
+            f"{S} devices — one stage per device (a larger multiple "
+            "would silently drop stages)")
+    M = n_microbatches or S
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible into {M} microbatches")
+    mb = B // M
+    micro = x.reshape(M, mb, *x.shape[1:])
+
+    # params: each device sees its own stage's slice (leading axis 1)
+    param_specs = jax.tree.map(
+        lambda a: P(*([axis] + [None] * (a.ndim - 1))), stacked_params)
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(param_specs, P()), out_specs=P(),
+             check_vma=False)
+    def run(params, micro):
+        me = jax.lax.axis_index(axis)
+        p_local = jax.tree.map(lambda a: a[0], params)  # my stage's params
+        n_ticks = S + M - 1
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            buf, outs = carry
+            # which microbatch enters stage 0 this tick (garbage when
+            # t >= M; masked out below)
+            feed = micro[jnp.minimum(t, M - 1)]
+            h_in = jnp.where(me == 0,
+                             jnp.where(t < M, feed, jnp.zeros_like(feed)),
+                             buf)
+            h_out = stage_fn(p_local, h_in)
+            # last stage finishes microbatch t-(S-1) at tick t
+            done_idx = t - (S - 1)
+            valid = (done_idx >= 0) & (done_idx < M)
+            outs = jax.lax.cond(
+                valid,
+                lambda o: o.at[jnp.clip(done_idx, 0, M - 1)].set(h_out),
+                lambda o: o, outs)
+            buf_next = jax.lax.ppermute(h_out, axis, fwd_perm)
+            return (buf_next, outs), None
+
+        buf0 = jnp.zeros_like(micro[0])
+        outs0 = jnp.zeros_like(micro)
+        (buf, outs), _ = jax.lax.scan(tick, (buf0, outs0),
+                                      jnp.arange(n_ticks))
+        # only the LAST stage's outs are real; broadcast them to everyone
+        # so the out_spec P() (replicated) holds
+        last = jax.lax.psum(
+            jnp.where(me == S - 1, outs, jnp.zeros_like(outs)), axis)
+        return last
+
+    outs = run(stacked_params, micro)
+    return outs.reshape(B, *x.shape[1:])

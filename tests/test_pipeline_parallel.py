@@ -1,0 +1,118 @@
+"""Pipeline parallelism tests: GPipe microbatching over the virtual mesh
+must equal sequential stage composition, including gradients."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.parallel.pipeline import (
+    pipeline_apply, shard_stage_params,
+)
+
+RNG = np.random.default_rng(0)
+
+
+def _mesh(n=4):
+    return Mesh(np.asarray(jax.devices()[:n]), ("pipe",))
+
+
+def _stage_fn(p, h):
+    return jnp.tanh(h @ p["W"] + p["b"])
+
+
+def _stages(n, width, seed=0):
+    keys = jax.random.split(jax.random.PRNGKey(seed), n)
+    return [{"W": (jax.random.normal(k, (width, width)) * 0.3
+                   ).astype(jnp.float32),
+             "b": jnp.full((width,), 0.01, jnp.float32)} for k in keys]
+
+
+def _sequential(stages, x):
+    h = x
+    for p in stages:
+        h = _stage_fn(p, h)
+    return h
+
+
+class TestPipelineApply:
+    @pytest.mark.parametrize("n_stages,n_micro", [(2, 2), (4, 4), (4, 8),
+                                                  (8, 8)])
+    def test_matches_sequential(self, n_stages, n_micro):
+        mesh = _mesh(n_stages)
+        W = 16
+        stages = _stages(n_stages, W)
+        stacked = shard_stage_params(stages, mesh)
+        x = jnp.asarray(RNG.standard_normal((n_micro * 2, W)), jnp.float32)
+        out = pipeline_apply(_stage_fn, stacked, x, mesh,
+                             n_microbatches=n_micro)
+        ref = _sequential(stages, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
+
+    def test_gradients_match_sequential(self):
+        mesh = _mesh(4)
+        W = 8
+        stages = _stages(4, W, seed=3)
+        x = jnp.asarray(RNG.standard_normal((8, W)), jnp.float32)
+        y = jnp.asarray(RNG.standard_normal((8, W)), jnp.float32)
+
+        def loss_pipe(stages):
+            stacked = shard_stage_params(stages, mesh)
+            out = pipeline_apply(_stage_fn, stacked, x, mesh)
+            return jnp.mean((out - y) ** 2)
+
+        def loss_seq(stages):
+            return jnp.mean((_sequential(stages, x) - y) ** 2)
+
+        l1, g1 = jax.value_and_grad(loss_pipe)(stages)
+        l2, g2 = jax.value_and_grad(loss_seq)(stages)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+        for s in range(4):
+            for k in ("W", "b"):
+                np.testing.assert_allclose(np.asarray(g1[s][k]),
+                                           np.asarray(g2[s][k]),
+                                           atol=1e-5,
+                                           err_msg=f"stage{s}/{k}")
+
+    def test_batch_divisibility(self):
+        mesh = _mesh(4)
+        stages = _stages(4, 8)
+        stacked = shard_stage_params(stages, mesh)
+        with pytest.raises(ValueError):
+            pipeline_apply(_stage_fn, stacked,
+                           jnp.zeros((7, 8)), mesh)
+
+    def test_trains(self):
+        """End-to-end: pipeline SGD reduces the loss."""
+        mesh = _mesh(4)
+        W = 8
+        stages = _stages(4, W, seed=9)
+        x = jnp.asarray(RNG.standard_normal((16, W)), jnp.float32)
+        y = jnp.tanh(x * 0.5)
+
+        @jax.jit
+        def step(stages):
+            def loss(stages):
+                stacked = shard_stage_params(stages, mesh)
+                out = pipeline_apply(_stage_fn, stacked, x, mesh)
+                return jnp.mean((out - y) ** 2)
+            l, g = jax.value_and_grad(loss)(stages)
+            return l, jax.tree.map(lambda a, b: a - 0.2 * b, stages, g)
+
+        l0, stages = step(stages)
+        for _ in range(30):
+            l, stages = step(stages)
+        assert float(l) < float(l0) * 0.5
+
+
+def test_stage_count_must_match_axis():
+    """More stacked stages than pipe devices must raise, not silently
+    drop stages."""
+    mesh = _mesh(4)
+    stages = _stages(8, 8)
+    stacked = shard_stage_params(stages, mesh)
+    with pytest.raises(ValueError, match="stacked stages"):
+        pipeline_apply(_stage_fn, stacked, jnp.zeros((8, 8), jnp.float32),
+                       mesh)
