@@ -5,7 +5,8 @@ TPU-native equivalent of ND4J DataSet + deeplearning4j-core datasets/*
 """
 
 from deeplearning4j_tpu.datasets.dataset import DataSet  # noqa: F401
-from deeplearning4j_tpu.datasets.iterators import (  # noqa: F401
+from deeplearning4j_tpu.datasets.iterators import (
+    BenchmarkDataSetIterator,  # noqa: F401
     ArrayDataSetIterator,
     AsyncDataSetIterator,
     ExistingDataSetIterator,

@@ -7,7 +7,8 @@ TPU-native equivalent of the reference's iterator zoo:
   machinery of the ref's MagicQueue is unnecessary: JAX moves arrays at
   dispatch and overlaps H2D with compute).
 - ExistingDataSetIterator, MultipleEpochsIterator, EarlyTerminationIterator,
-  SamplingDataSetIterator (ref: datasets/iterator/*.java).
+  SamplingDataSetIterator, BenchmarkDataSetIterator
+  (ref: datasets/iterator/*.java + impl/BenchmarkDataSetIterator.java).
 """
 
 from __future__ import annotations
@@ -154,6 +155,28 @@ class AsyncDataSetIterator(DataSetIterator):
         finally:
             # generator closed (break/GC): release the producer thread
             stop.set()
+
+
+class BenchmarkDataSetIterator(DataSetIterator):
+    """Synthetic fixed-shape batches for benchmarking the training loop
+    (ref: datasets/iterator/impl/BenchmarkDataSetIterator.java — yields
+    the SAME pre-generated batch n times so the harness measures compute,
+    not data generation)."""
+
+    def __init__(self, features_shape, num_labels: int, total_batches: int,
+                 seed: int = 42):
+        import numpy as _np
+        rng = _np.random.default_rng(seed)
+        n = features_shape[0]
+        x = rng.standard_normal(features_shape).astype(_np.float32)
+        y = _np.zeros((n, num_labels), _np.float32)
+        y[_np.arange(n), rng.integers(0, num_labels, n)] = 1.0
+        self.batch = DataSet(x, y)
+        self.total_batches = total_batches
+
+    def __iter__(self):
+        for _ in range(self.total_batches):
+            yield self.batch
 
 
 class MultipleEpochsIterator(DataSetIterator):

@@ -251,3 +251,15 @@ class TestSvhn:
                                  num_examples=32)
         ds = next(iter(it))
         assert ds.features.shape == (16, 3, 32, 32)
+
+
+class TestBenchmarkIterator:
+    def test_same_batch_repeated(self):
+        from deeplearning4j_tpu.datasets import BenchmarkDataSetIterator
+        it = BenchmarkDataSetIterator((8, 3, 16, 16), num_labels=5,
+                                      total_batches=4)
+        batches = list(it)
+        assert len(batches) == 4
+        assert batches[0].features.shape == (8, 3, 16, 16)
+        assert batches[0] is batches[3]  # the SAME object: zero ETL cost
+        assert batches[0].labels.sum() == 8
