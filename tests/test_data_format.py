@@ -221,3 +221,62 @@ class TestZooNhwcEquivalence:
         b = out(cls(**kwargs, data_format="NHWC").init())
         np.testing.assert_allclose(a, b, atol=2e-4,
                                    err_msg=f"{name} NHWC != NCHW")
+
+
+class TestHybridPreprocessorsNhwc:
+    def test_cnn_to_rnn_hybrid(self):
+        """Conv -> CnnToRnn -> LSTM nets must be layout-invariant (the
+        preprocessor converts back to NCHW flat order before the time
+        reshape)."""
+        from deeplearning4j_tpu.nn.conf.preprocessors import (
+            CnnToRnnPreProcessor,
+        )
+
+        def conf():
+            b = (NeuralNetConfiguration.Builder()
+                 .seed(3).updater(Sgd(0.1)).list()
+                 .layer(L.ConvolutionLayer(n_out=4, kernel=(3, 3),
+                                           convolution_mode="same",
+                                           activation="relu"))
+                 .layer(L.LSTM(n_out=6, activation="tanh"))
+                 .layer(L.RnnOutputLayer(n_out=3, loss="mcxent",
+                                         activation="softmax")))
+            b.input_preprocessor(1, CnnToRnnPreProcessor(
+                height=6, width=5, channels=4, timesteps=4))
+            return b.set_input_type(
+                InputType.convolutional(6, 5, 2)).build()
+
+        x = np.random.default_rng(0).standard_normal(
+            (8, 2, 6, 5)).astype(np.float32)
+        a = MultiLayerNetwork(conf()).init()
+        b = MultiLayerNetwork(conf().use_cnn_data_format("NHWC")).init()
+        np.testing.assert_allclose(np.asarray(a.output(x)),
+                                   np.asarray(b.output(x)), atol=1e-5)
+
+    def test_rnn_to_cnn_hybrid(self):
+        """LSTM -> RnnToCnn -> Conv nets: the preprocessor emits the
+        internal layout."""
+        from deeplearning4j_tpu.nn.conf.preprocessors import (
+            RnnToCnnPreProcessor,
+        )
+
+        def conf():
+            b = (NeuralNetConfiguration.Builder()
+                 .seed(4).updater(Sgd(0.1)).list()
+                 .layer(L.LSTM(n_out=12, activation="tanh"))
+                 .layer(L.ConvolutionLayer(n_out=3, kernel=(2, 2),
+                                           convolution_mode="same",
+                                           activation="relu"))
+                 .layer(L.GlobalPoolingLayer(pooling_type="avg"))
+                 .layer(L.OutputLayer(n_out=2, loss="mcxent",
+                                      activation="softmax")))
+            b.input_preprocessor(1, RnnToCnnPreProcessor(
+                height=4, width=3, channels=1))
+            return b.set_input_type(InputType.recurrent(5, 6)).build()
+
+        x = np.random.default_rng(1).standard_normal(
+            (2, 5, 6)).astype(np.float32)
+        a = MultiLayerNetwork(conf()).init()
+        b = MultiLayerNetwork(conf().use_cnn_data_format("NHWC")).init()
+        np.testing.assert_allclose(np.asarray(a.output(x)),
+                                   np.asarray(b.output(x)), atol=1e-5)
