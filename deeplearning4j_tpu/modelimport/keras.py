@@ -235,6 +235,23 @@ class KerasLayerMapper:
                                     decay=float(c.get("momentum", 0.99)),
                                     name=c.get("name"))
 
+    def _map_layernormalization(self, c):
+        # keras normalizes the LAST axis (features); our LayerNormalization
+        # normalizes the feature axis in both [N,F] and [N,F,T] layouts, so
+        # the semantics line up after the importer's layout conversion.
+        # Saved configs carry either -1 or the POSITIVE last-axis index
+        # (keras >= 2.4 serializes e.g. axis=[2] for 3-D input) — a single
+        # axis is accepted as the feature axis; multi-axis LN is not
+        # representable here.
+        axis = c.get("axis", -1)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        if len(axes) != 1:
+            raise ValueError(
+                f"LayerNormalization over multiple axes {axis!r} "
+                "unsupported (single feature axis only)")
+        return L.LayerNormalization(eps=float(c.get("epsilon", 1e-3)),
+                                    name=c.get("name"))
+
     # --- recurrent ---
     def _map_lstm(self, c):
         return L.LSTM(n_out=int(c.get("units", c.get("output_dim"))),
@@ -498,6 +515,20 @@ class _KerasH5:
                 params["beta"] = jnp.asarray(weights[1])
                 params["__mean__"] = jnp.asarray(weights[2])
                 params["__var__"] = jnp.asarray(weights[3])
+        elif isinstance(layer, L.LayerNormalization):
+            slots = {"gamma": "gamma", "beta": "beta"}
+            assigned = False
+            if names and len(names) == len(weights):
+                for n, w in zip(names, weights):
+                    base = n.rsplit("/", 1)[-1].split(":")[0]
+                    if base in slots:
+                        params[slots[base]] = jnp.asarray(w)
+                        assigned = True
+            if not assigned and len(weights) >= 2:
+                params["gamma"] = jnp.asarray(weights[0])
+                params["beta"] = jnp.asarray(weights[1])
+            elif not assigned and len(weights) == 1:
+                params["gamma"] = jnp.asarray(weights[0])
         elif isinstance(layer, L.LSTM):
             # keras: kernel [in,4H], recurrent_kernel [H,4H], bias [4H]
             # gate order (i,f,c,o) == ours: direct copy

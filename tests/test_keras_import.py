@@ -486,3 +486,72 @@ class TestImportedGraphNhwc:
         x = RNG.standard_normal((1, 3, 75, 75)).astype(np.float32)
         np.testing.assert_allclose(np.asarray(a.output(x)),
                                    np.asarray(b.output(x)), atol=1e-4)
+
+
+class TestLayerNormalizationImport:
+    def test_dense_ln_dense(self):
+        """Keras LayerNormalization (last-axis) imports with gamma/beta and
+        matches manual computation."""
+        rng = np.random.default_rng(4)
+        F = 6
+        w1 = rng.standard_normal((4, F)).astype(np.float32)
+        gamma = rng.uniform(0.5, 1.5, F).astype(np.float32)
+        beta = rng.uniform(-0.2, 0.2, F).astype(np.float32)
+        cfg = {"class_name": "Sequential", "config": {"name": "m", "layers": [
+            {"class_name": "InputLayer",
+             "config": {"batch_input_shape": [None, 4], "name": "in"}},
+            {"class_name": "Dense",
+             "config": {"name": "d1", "units": F, "activation": "linear",
+                        "use_bias": False}},
+            {"class_name": "LayerNormalization",
+             "config": {"name": "ln", "axis": -1, "epsilon": 1e-3}},
+        ]}}
+        weights = {"d1": [("d1/kernel:0", w1)],
+                   "ln": [("ln/gamma:0", gamma), ("ln/beta:0", beta)]}
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "ln.h5")
+            write_keras_h5(path, cfg, weights)
+            net = KerasModelImport.import_keras_model_and_weights(path)
+        x = rng.standard_normal((3, 4)).astype(np.float32)
+        h = x @ w1
+        mu = h.mean(1, keepdims=True)
+        sd = np.sqrt(h.var(1, keepdims=True) + 1e-3)
+        want = (h - mu) / sd * gamma + beta
+        got = np.asarray(net.output(x))
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_positive_last_axis_accepted(self):
+        """keras >= 2.4 serializes axis as the positive index, e.g. [1]
+        for 2-D input — must import like -1."""
+        cfg = {"class_name": "Sequential", "config": {"name": "m", "layers": [
+            {"class_name": "InputLayer",
+             "config": {"batch_input_shape": [None, 4], "name": "in"}},
+            {"class_name": "LayerNormalization",
+             "config": {"name": "ln", "axis": [1], "epsilon": 1e-3}},
+        ]}}
+        g = np.ones(4, np.float32) * 2.0
+        b = np.zeros(4, np.float32)
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "ln.h5")
+            write_keras_h5(path, cfg, {"ln": [("ln/gamma:0", g),
+                                              ("ln/beta:0", b)]})
+            net = KerasModelImport.import_keras_model_and_weights(path)
+        x = np.random.default_rng(0).standard_normal((3, 4)).astype(np.float32)
+        mu = x.mean(1, keepdims=True)
+        sd = np.sqrt(x.var(1, keepdims=True) + 1e-3)
+        np.testing.assert_allclose(np.asarray(net.output(x)),
+                                   (x - mu) / sd * 2.0, atol=1e-4)
+
+    def test_multi_axis_rejected(self):
+        cfg = {"class_name": "Sequential", "config": {"name": "m", "layers": [
+            {"class_name": "InputLayer",
+             "config": {"batch_input_shape": [None, 4], "name": "in"}},
+            {"class_name": "LayerNormalization",
+             "config": {"name": "ln", "axis": [1, 2]}},
+        ]}}
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "ln.h5")
+            write_keras_h5(path, cfg, {"ln": [("ln/gamma:0",
+                                               np.ones(4, np.float32))]})
+            with pytest.raises(ValueError, match="axes"):
+                KerasModelImport.import_keras_model_and_weights(path)
