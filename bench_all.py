@@ -73,7 +73,7 @@ def bench_lstm():
     from deeplearning4j_tpu.zoo import TextGenerationLSTM
     from deeplearning4j_tpu.nn.updater import RmsProp
 
-    B = int(os.environ.get("BENCH_LSTM_BATCH", "64"))
+    B = int(os.environ.get("BENCH_LSTM_BATCH", "256"))
     T = int(os.environ.get("BENCH_LSTM_SEQ", "256"))
     V = 128  # character vocab (ref TextGenerationLSTM totalUniqueCharacters)
     net = TextGenerationLSTM(vocab_size=V, max_length=T,
