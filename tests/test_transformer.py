@@ -165,3 +165,48 @@ class TestTextGenerationTransformer:
         net = model.init()
         ids = model.sample(net, [1, 2], steps=5)
         assert len(ids) == 7 and all(0 <= i < V for i in ids)
+
+
+class TestTransformerSerde:
+    def test_config_json_roundtrip(self):
+        """New layer confs (LN / attention / positional embedding) survive
+        the config JSON round trip with their fields intact."""
+        from deeplearning4j_tpu.nn.conf.network import (
+            ComputationGraphConfiguration,
+        )
+        conf = TextGenerationTransformer(
+            vocab_size=16, embed_dim=16, n_heads=2, n_layers=1,
+            max_length=8).conf()
+        conf2 = ComputationGraphConfiguration.from_json(conf.to_json())
+        assert {k: type(v).__name__ for k, v in conf.vertices.items()} == \
+            {k: type(v).__name__ for k, v in conf2.vertices.items()}
+        at = conf2.vertices["attn0"].layer
+        assert (at.n_heads, at.causal, at.block_size) == (2, True, 512)
+        assert conf2.vertices["pos"].layer.max_length == 8
+        assert conf2.vertices["ln0a"].layer.eps == 1e-5
+
+    def test_checkpoint_roundtrip(self):
+        """write_model/restore on the transformer: identical outputs."""
+        import os
+        import tempfile
+        from deeplearning4j_tpu.util.model_serializer import (
+            restore_computation_graph, write_model,
+        )
+        model = TextGenerationTransformer(vocab_size=10, embed_dim=16,
+                                          n_heads=2, n_layers=1,
+                                          max_length=6)
+        net = model.init()
+        x = np.zeros((2, 10, 6), np.float32)
+        ids = RNG.integers(0, 10, (2, 6))
+        x[np.arange(2)[:, None], ids, np.arange(6)[None, :]] = 1.0
+        before = np.asarray(net.output(x)[0] if isinstance(net.output(x),
+                                                           (list, tuple))
+                            else net.output(x))
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "t.zip")
+            write_model(net, p)
+            net2 = restore_computation_graph(p)
+        out2 = net2.output(x)
+        after = np.asarray(out2[0] if isinstance(out2, (list, tuple))
+                           else out2)
+        np.testing.assert_allclose(before, after, atol=1e-6)
