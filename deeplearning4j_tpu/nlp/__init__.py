@@ -31,6 +31,14 @@ from deeplearning4j_tpu.nlp.bagofwords import (
     BagOfWordsVectorizer, TfidfVectorizer,
 )
 from deeplearning4j_tpu.nlp.cnn_sentence import CnnSentenceDataSetIterator
+from deeplearning4j_tpu.nlp.annotation import (
+    AnalysisEngine, AnnotatedDocument, Annotation, AnnotationSentenceIterator,
+    AnnotationTokenizerFactory, PosFilterTokenizerFactory,
+    StemmingPreprocessor, SWN3, porter_stem,
+)
+from deeplearning4j_tpu.nlp.trees import (
+    Tree, ChunkTreeParser, TreeVectorizer, TreeIterator, HeadWordFinder,
+)
 
 __all__ = [
     "Tokenizer", "DefaultTokenizer", "NGramTokenizer", "TokenizerFactory",
@@ -45,4 +53,10 @@ __all__ = [
     "write_word_vectors", "read_word_vectors", "write_word2vec_binary",
     "read_word2vec_binary",
     "BagOfWordsVectorizer", "TfidfVectorizer", "CnnSentenceDataSetIterator",
+    "AnalysisEngine", "AnnotatedDocument", "Annotation",
+    "AnnotationSentenceIterator", "AnnotationTokenizerFactory",
+    "PosFilterTokenizerFactory", "StemmingPreprocessor", "SWN3",
+    "porter_stem",
+    "Tree", "ChunkTreeParser", "TreeVectorizer", "TreeIterator",
+    "HeadWordFinder",
 ]

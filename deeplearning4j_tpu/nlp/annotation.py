@@ -1,0 +1,659 @@
+"""Text annotation pipeline (the deeplearning4j-nlp-uima module's role).
+
+TPU-framework equivalent of the reference's UIMA glue (SURVEY §2.6,
+deeplearning4j-nlp-parent/deeplearning4j-nlp-uima): a CAS-like annotated
+document, a pipeline of annotators (sentence segmentation, tokenization,
+stemming, part-of-speech tagging), sentence iterators and tokenizer
+factories driven by the pipeline, and the SentiWordNet scorer.
+
+Reference mapping (file → here):
+- text/uima/UimaResource.java            → AnalysisEngine (owns the pipeline)
+- text/annotator/SentenceAnnotator.java  → SentenceAnnotator
+- text/annotator/TokenizerAnnotator.java → TokenizerAnnotator
+- text/annotator/StemmerAnnotator.java   → StemmerAnnotator (Porter)
+- text/annotator/PoStagger.java          → PosAnnotator
+- text/sentenceiterator/UimaSentenceIterator.java → AnnotationSentenceIterator
+- text/tokenization/tokenizerfactory/UimaTokenizerFactory.java
+                                         → AnnotationTokenizerFactory
+- text/tokenization/tokenizer/PosUimaTokenizer.java → PosFilterTokenizer
+  ("any not valid part of speech tags become NONE"; optional stripNones)
+- text/tokenization/tokenizer/preprocessor/StemmingPreprocessor.java
+                                         → StemmingPreprocessor
+- text/corpora/sentiwordnet/SWN3.java    → SWN3
+
+The reference reaches these capabilities through Apache UIMA + OpenNLP
+maxent models + the Snowball stemmer; here the pipeline machinery and data
+model are first-class, the stemmer is a full Porter implementation, and the
+POS tagger is a lexicon+suffix tagger (no bundled maxent model — zero
+egress). Tag inventory is Penn Treebank, same as the reference's models.
+"""
+
+from __future__ import annotations
+
+import bisect
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Sequence
+
+from deeplearning4j_tpu.nlp.sentence import SentenceIterator
+from deeplearning4j_tpu.nlp.tokenization import Tokenizer, TokenizerFactory
+
+# ---------------------------------------------------------------------------
+# Data model (CAS equivalent)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Annotation:
+    """A typed text span with features (UIMA AnnotationFS equivalent).
+
+    `type` is "sentence" or "token"; tokens may carry `pos`, `stem`,
+    `lemma` features (ref Token type has getPos/getStem/getLemma —
+    PosUimaTokenizer.java:75-81)."""
+
+    begin: int
+    end: int
+    type: str
+    features: Dict[str, str] = field(default_factory=dict)
+
+    def covered_text(self, text: str) -> str:
+        return text[self.begin:self.end]
+
+
+class AnnotatedDocument:
+    """Document text + annotation index (UIMA CAS equivalent).
+
+    select/covered mirror JCasUtil.select / JCasUtil.selectCovered, the two
+    access patterns every reference consumer uses (SWN3.java:203-204,
+    PosUimaTokenizer.java:72-73)."""
+
+    def __init__(self, text: str):
+        self.text = text
+        self.annotations: List[Annotation] = []
+        self._by_type: Dict[str, List[Annotation]] = {}
+        self._sorted: Dict[str, bool] = {}
+
+    def add(self, ann: Annotation) -> Annotation:
+        self.annotations.append(ann)
+        bucket = self._by_type.setdefault(ann.type, [])
+        # annotators emit in document order; only mark dirty when not
+        if bucket and (bucket[-1].begin, bucket[-1].end) > (ann.begin,
+                                                            ann.end):
+            self._sorted[ann.type] = False
+        bucket.append(ann)
+        return ann
+
+    def select(self, type: str) -> List[Annotation]:
+        """All annotations of a type, in document order."""
+        bucket = self._by_type.get(type, [])
+        if not self._sorted.get(type, True):
+            bucket.sort(key=lambda a: (a.begin, a.end))
+            self._sorted[type] = True
+        return list(bucket)
+
+    def covered(self, cover: Annotation, type: str) -> List[Annotation]:
+        """Annotations of `type` fully inside `cover` (selectCovered)."""
+        bucket = self.select(type)
+        lo = bisect.bisect_left(bucket, (cover.begin,),
+                                key=lambda a: (a.begin,))
+        out = []
+        for a in bucket[lo:]:
+            if a.begin > cover.end:
+                break
+            if a.end <= cover.end:
+                out.append(a)
+        return out
+
+    def covered_text(self, ann: Annotation) -> str:
+        return ann.covered_text(self.text)
+
+
+# ---------------------------------------------------------------------------
+# Annotators
+# ---------------------------------------------------------------------------
+
+
+class Annotator:
+    """One analysis step over a document (UIMA AnalysisComponent role)."""
+
+    def process(self, doc: AnnotatedDocument) -> None:
+        raise NotImplementedError
+
+
+# candidate boundary: terminator (+ closing quotes) then whitespace then a
+# sentence-start character
+_SENT_BOUNDARY = re.compile(r"[.!?…][\"')\]]*\s+(?=[\"'(\[]?[A-Z0-9])")
+_ABBREVIATIONS = frozenset({"mr", "ms", "mrs", "dr", "st", "vs", "etc", "jr",
+                            "sr", "inc", "co", "no", "prof", "gen", "rep",
+                            "sen", "e.g", "i.e", "al"})
+
+
+class SentenceAnnotator(Annotator):
+    """Sentence segmentation (ref SentenceAnnotator.java wraps OpenNLP's
+    SentenceDetector; here rule-based boundary detection that keeps
+    abbreviations and single-letter initials intact)."""
+
+    @staticmethod
+    def _is_boundary(text: str, dot: int) -> bool:
+        if text[dot] != ".":
+            return True  # !, ?, … always end a sentence
+        word = re.search(r"[\w.]*$", text[:dot]).group(0).lower()
+        if word in _ABBREVIATIONS:
+            return False
+        if len(word) == 1 and word.isalpha():  # initial: "J. Smith"
+            return False
+        return True
+
+    def process(self, doc: AnnotatedDocument) -> None:
+        text = doc.text
+        start = 0
+        ends = [m for m in _SENT_BOUNDARY.finditer(text)
+                if self._is_boundary(text, m.start())]
+        for m in ends + [None]:
+            seg = text[start:(m.end() if m else len(text))]
+            stripped = seg.strip()
+            if stripped:
+                b = start + seg.index(stripped[0])
+                doc.add(Annotation(b, b + len(stripped), "sentence"))
+            start = m.end() if m else len(text)
+
+
+_TOKEN_RE = re.compile(
+    r"<\/?[A-Z]+>"            # markup tokens (PosUimaTokenizer strips these)
+    r"|[A-Za-z]+(?:'[A-Za-z]+)?"  # words incl. contractions
+    r"|\d+(?:[.,]\d+)*"       # numbers
+    r"|[^\sA-Za-z\d]")        # single punctuation
+
+
+class TokenizerAnnotator(Annotator):
+    """Token spans inside each sentence (ref TokenizerAnnotator.java wraps
+    the ClearTK/OpenNLP tokenizer)."""
+
+    def process(self, doc: AnnotatedDocument) -> None:
+        sentences = doc.select("sentence") or [
+            Annotation(0, len(doc.text), "sentence")]
+        for s in sentences:
+            for m in _TOKEN_RE.finditer(doc.text[s.begin:s.end]):
+                doc.add(Annotation(s.begin + m.start(), s.begin + m.end(),
+                                   "token"))
+
+
+class StemmerAnnotator(Annotator):
+    """Stores a Porter stem on each token's `stem` feature (ref
+    StemmerAnnotator.java wraps the Snowball English stemmer)."""
+
+    def process(self, doc: AnnotatedDocument) -> None:
+        for t in doc.select("token"):
+            t.features["stem"] = porter_stem(doc.covered_text(t).lower())
+
+
+class PosAnnotator(Annotator):
+    """Penn-Treebank POS tags on each token's `pos` feature.
+
+    Ref PoStagger.java loads an OpenNLP maxent model; this tagger combines
+    a closed-class lexicon with suffix/shape rules — the standard baseline
+    tagger shape. Swap in a custom `lexicon` for domain text."""
+
+    #: closed-class + frequent-word lexicon (Penn tags)
+    LEXICON: Dict[str, str] = {
+        "the": "DT", "a": "DT", "an": "DT", "this": "DT", "that": "DT",
+        "these": "DT", "those": "DT", "some": "DT", "any": "DT", "no": "DT",
+        "each": "DT", "every": "DT",
+        "i": "PRP", "you": "PRP", "he": "PRP", "she": "PRP", "it": "PRP",
+        "we": "PRP", "they": "PRP", "me": "PRP", "him": "PRP", "her": "PRP",
+        "us": "PRP", "them": "PRP",
+        "my": "PRP$", "your": "PRP$", "his": "PRP$", "its": "PRP$",
+        "our": "PRP$", "their": "PRP$",
+        "and": "CC", "or": "CC", "but": "CC", "nor": "CC", "yet": "CC",
+        "in": "IN", "on": "IN", "at": "IN", "by": "IN", "with": "IN",
+        "from": "IN", "of": "IN", "for": "IN", "as": "IN", "into": "IN",
+        "over": "IN", "under": "IN", "after": "IN", "before": "IN",
+        "if": "IN", "because": "IN", "while": "IN", "than": "IN",
+        "to": "TO",
+        "is": "VBZ", "are": "VBP", "was": "VBD", "were": "VBD", "be": "VB",
+        "been": "VBN", "being": "VBG", "am": "VBP",
+        "has": "VBZ", "have": "VBP", "had": "VBD", "having": "VBG",
+        "do": "VBP", "does": "VBZ", "did": "VBD", "done": "VBN",
+        "will": "MD", "would": "MD", "can": "MD", "could": "MD",
+        "shall": "MD", "should": "MD", "may": "MD", "might": "MD",
+        "must": "MD",
+        "not": "RB", "n't": "RB", "very": "RB", "too": "RB", "also": "RB",
+        "never": "RB", "always": "RB", "often": "RB", "here": "RB",
+        "there": "EX", "when": "WRB", "where": "WRB", "why": "WRB",
+        "how": "WRB", "who": "WP", "whom": "WP", "what": "WP",
+        "which": "WDT", "whose": "WP$",
+        "good": "JJ", "new": "JJ", "old": "JJ", "big": "JJ", "small": "JJ",
+        "many": "JJ", "much": "JJ", "other": "JJ", "such": "JJ",
+        # frequent irregular past forms (no -ed suffix to key on)
+        "sat": "VBD", "ran": "VBD", "went": "VBD", "saw": "VBD",
+        "said": "VBD", "made": "VBD", "took": "VBD", "got": "VBD",
+        "came": "VBD", "gave": "VBD", "found": "VBD", "told": "VBD",
+        "left": "VBD", "put": "VBD", "kept": "VBD", "began": "VBD",
+        "wrote": "VBD", "stood": "VBD", "heard": "VBD", "let": "VBD",
+        "meant": "VBD", "set": "VBD", "met": "VBD", "paid": "VBD",
+        "held": "VBD", "knew": "VBD", "thought": "VBD", "felt": "VBD",
+        "brought": "VBD", "bought": "VBD", "caught": "VBD",
+    }
+
+    def __init__(self, lexicon: Optional[Dict[str, str]] = None):
+        self.lexicon = dict(self.LEXICON)
+        if lexicon:
+            self.lexicon.update(lexicon)
+
+    _PUNCT = {".": ".", ",": ",", ":": ":", ";": ":", "?": ".", "!": ".",
+              "(": "-LRB-", ")": "-RRB-", "``": "``", "''": "''",
+              '"': "''", "'": "POS", "$": "$", "#": "#"}
+
+    def _tag(self, word: str, prev_tag: Optional[str]) -> str:
+        if word in self._PUNCT:
+            return self._PUNCT[word]
+        low = word.lower()
+        if low in self.lexicon:
+            return self.lexicon[low]
+        if re.fullmatch(r"\d+(?:[.,]\d+)*", word):
+            return "CD"
+        # suffix/shape rules (ordered)
+        if word[0].isupper() and prev_tag not in (None, ".",):
+            return "NNPS" if low.endswith("s") else "NNP"
+        if low.endswith("ing"):
+            return "VBG"
+        if low.endswith("ed"):
+            return "VBN" if prev_tag in ("VBZ", "VBP", "VBD") else "VBD"
+        if low.endswith("ly"):
+            return "RB"
+        if low.endswith(("ous", "ful", "ible", "able", "al", "ive", "ic")):
+            return "JJ"
+        if low.endswith("est"):
+            return "JJS"
+        if low.endswith("er") and prev_tag == "DT":
+            return "NN"
+        if low.endswith("s") and not low.endswith(("ss", "us", "is")):
+            # after a modal/to it's a verb; default plural noun
+            return "VBZ" if prev_tag in ("PRP", "NNP", "WDT") else "NNS"
+        if prev_tag in ("TO", "MD"):
+            return "VB"
+        return "NN"
+
+    def process(self, doc: AnnotatedDocument) -> None:
+        for s in doc.select("sentence") or [Annotation(0, len(doc.text),
+                                                       "sentence")]:
+            prev = None
+            for t in doc.covered(s, "token"):
+                tag = self._tag(doc.covered_text(t), prev)
+                t.features["pos"] = tag
+                prev = tag
+
+
+class AnalysisEngine:
+    """Ordered annotator pipeline over raw text (UimaResource.java role:
+    owns the engine, `process(text)` returns a populated document).
+
+    Factory methods mirror the reference's canned pipelines:
+    - UimaSentenceIterator.segmenter() → AnalysisEngine.segmenter()
+    - UimaTokenizerFactory default engine (tokenizer+stemmer)
+      → AnalysisEngine.tokenizer()
+    - PosUimaTokenizerFactory engine (sentence+token+pos)
+      → AnalysisEngine.pos_tagger()
+    """
+
+    def __init__(self, annotators: Sequence[Annotator]):
+        self.annotators = list(annotators)
+
+    def process(self, text: str) -> AnnotatedDocument:
+        doc = AnnotatedDocument(text)
+        for a in self.annotators:
+            a.process(doc)
+        return doc
+
+    @classmethod
+    def segmenter(cls) -> "AnalysisEngine":
+        return cls([SentenceAnnotator()])
+
+    @classmethod
+    def tokenizer(cls, stem: bool = True) -> "AnalysisEngine":
+        anns: List[Annotator] = [SentenceAnnotator(), TokenizerAnnotator()]
+        if stem:
+            anns.append(StemmerAnnotator())
+        return cls(anns)
+
+    @classmethod
+    def pos_tagger(cls) -> "AnalysisEngine":
+        return cls([SentenceAnnotator(), TokenizerAnnotator(),
+                    StemmerAnnotator(), PosAnnotator()])
+
+
+# ---------------------------------------------------------------------------
+# Iterator / tokenizer-factory adapters (the reference module's public face)
+# ---------------------------------------------------------------------------
+
+
+class AnnotationSentenceIterator(SentenceIterator):
+    """Sentence stream produced by the segmentation pipeline over documents
+    (ref UimaSentenceIterator.java: segments blobs of text into sentences)."""
+
+    def __init__(self, documents: Iterable[str],
+                 engine: Optional[AnalysisEngine] = None,
+                 preprocessor: Optional[Callable[[str], str]] = None):
+        super().__init__(preprocessor)
+        self._documents = list(documents)
+        self._engine = engine or AnalysisEngine.segmenter()
+
+    def _raw(self) -> Iterator[str]:
+        for text in self._documents:
+            doc = self._engine.process(text)
+            for s in doc.select("sentence"):
+                yield doc.covered_text(s)
+
+
+class AnnotationTokenizerFactory(TokenizerFactory):
+    """Tokenizers driven by the annotation pipeline; emits stems when the
+    engine ran a StemmerAnnotator (ref UimaTokenizerFactory.java +
+    UimaTokenizer.java: checkForLabel + lemma/stem preference)."""
+
+    def __init__(self, engine: Optional[AnalysisEngine] = None,
+                 preprocessor: Optional[Callable[[str], str]] = None,
+                 use_stems: bool = True):
+        super().__init__(preprocessor)
+        self.engine = engine or AnalysisEngine.tokenizer()
+        self.use_stems = use_stems
+
+    def _words(self, text: str) -> List[str]:
+        doc = self.engine.process(text)
+        out = []
+        for t in doc.select("token"):
+            word = doc.covered_text(t)
+            if re.fullmatch(r"</?[A-Z]+>", word):  # markup label guard
+                continue
+            if self.use_stems and "stem" in t.features:
+                word = t.features["stem"]
+            out.append(word)
+        return out
+
+    def create(self, text: str) -> Tokenizer:
+        return Tokenizer(self._words(text), self._pre)
+
+
+class PosFilterTokenizer(Tokenizer):
+    """Tokens whose POS is not in `allowed_pos_tags` become "NONE"
+    (ref PosUimaTokenizer.java:44-84: invalid → "NONE"; strip_nones drops
+    them instead)."""
+
+    def __init__(self, text: str, engine: AnalysisEngine,
+                 allowed_pos_tags: Sequence[str],
+                 strip_nones: bool = False,
+                 preprocessor: Optional[Callable[[str], str]] = None):
+        allowed = set(allowed_pos_tags)
+        doc = engine.process(text)
+        tokens = []
+        for t in doc.select("token"):
+            word = doc.covered_text(t)
+            valid = (not re.fullmatch(r"</?[A-Z]+>", word)
+                     and t.features.get("pos") in allowed)
+            if valid:
+                tokens.append(t.features.get("lemma")
+                              or t.features.get("stem") or word)
+            elif not strip_nones:
+                tokens.append("NONE")
+        super().__init__(tokens, preprocessor)
+
+
+class PosFilterTokenizerFactory(TokenizerFactory):
+    """ref PosUimaTokenizerFactory.java."""
+
+    def __init__(self, allowed_pos_tags: Sequence[str],
+                 engine: Optional[AnalysisEngine] = None,
+                 strip_nones: bool = False,
+                 preprocessor: Optional[Callable[[str], str]] = None):
+        super().__init__(preprocessor)
+        self.engine = engine or AnalysisEngine.pos_tagger()
+        self.allowed_pos_tags = list(allowed_pos_tags)
+        self.strip_nones = strip_nones
+
+    def create(self, text: str) -> Tokenizer:
+        return PosFilterTokenizer(text, self.engine, self.allowed_pos_tags,
+                                  self.strip_nones, self._pre)
+
+
+class StemmingPreprocessor:
+    """Token preprocessor applying the Porter stemmer (ref
+    StemmingPreprocessor.java chains CommonPreprocessor → SnowballProgram;
+    compose with CommonPreprocessor the same way)."""
+
+    def pre_process(self, token: str) -> str:
+        return porter_stem(token.lower())
+
+    __call__ = pre_process
+
+
+# ---------------------------------------------------------------------------
+# Porter stemmer (standard algorithm; used by StemmerAnnotator)
+# ---------------------------------------------------------------------------
+
+_VOWELS = set("aeiou")
+
+
+def _is_cons(word: str, i: int) -> bool:
+    c = word[i]
+    if c in _VOWELS:
+        return False
+    if c == "y":
+        return i == 0 or not _is_cons(word, i - 1)
+    return True
+
+
+def _measure(stem: str) -> int:
+    """Number of VC sequences ([C](VC)^m[V])."""
+    m, i, n = 0, 0, len(stem)
+    while i < n and _is_cons(stem, i):
+        i += 1
+    while i < n:
+        while i < n and not _is_cons(stem, i):
+            i += 1
+        if i >= n:
+            break
+        m += 1
+        while i < n and _is_cons(stem, i):
+            i += 1
+    return m
+
+
+def _has_vowel(stem: str) -> bool:
+    return any(not _is_cons(stem, i) for i in range(len(stem)))
+
+
+def _ends_double_cons(word: str) -> bool:
+    return (len(word) >= 2 and word[-1] == word[-2]
+            and _is_cons(word, len(word) - 1))
+
+
+def _ends_cvc(word: str) -> bool:
+    if len(word) < 3:
+        return False
+    return (_is_cons(word, len(word) - 3)
+            and not _is_cons(word, len(word) - 2)
+            and _is_cons(word, len(word) - 1)
+            and word[-1] not in "wxy")
+
+
+def porter_stem(word: str) -> str:
+    """Porter (1980) stemming algorithm, steps 1a-5b."""
+    if len(word) <= 2:
+        return word
+    w = word
+
+    # step 1a
+    if w.endswith("sses"):
+        w = w[:-2]
+    elif w.endswith("ies"):
+        w = w[:-2]
+    elif not w.endswith("ss") and w.endswith("s"):
+        w = w[:-1]
+
+    # step 1b
+    if w.endswith("eed"):
+        if _measure(w[:-3]) > 0:
+            w = w[:-1]
+    else:
+        flag = False
+        if w.endswith("ed") and _has_vowel(w[:-2]):
+            w, flag = w[:-2], True
+        elif w.endswith("ing") and _has_vowel(w[:-3]):
+            w, flag = w[:-3], True
+        if flag:
+            if w.endswith(("at", "bl", "iz")):
+                w += "e"
+            elif _ends_double_cons(w) and w[-1] not in "lsz":
+                w = w[:-1]
+            elif _measure(w) == 1 and _ends_cvc(w):
+                w += "e"
+
+    # step 1c
+    if w.endswith("y") and _has_vowel(w[:-1]):
+        w = w[:-1] + "i"
+
+    # step 2
+    for suf, rep in (("ational", "ate"), ("tional", "tion"), ("enci", "ence"),
+                     ("anci", "ance"), ("izer", "ize"), ("abli", "able"),
+                     ("alli", "al"), ("entli", "ent"), ("eli", "e"),
+                     ("ousli", "ous"), ("ization", "ize"), ("ation", "ate"),
+                     ("ator", "ate"), ("alism", "al"), ("iveness", "ive"),
+                     ("fulness", "ful"), ("ousness", "ous"), ("aliti", "al"),
+                     ("iviti", "ive"), ("biliti", "ble")):
+        if w.endswith(suf):
+            if _measure(w[:-len(suf)]) > 0:
+                w = w[:-len(suf)] + rep
+            break
+
+    # step 3
+    for suf, rep in (("icate", "ic"), ("ative", ""), ("alize", "al"),
+                     ("iciti", "ic"), ("ical", "ic"), ("ful", ""),
+                     ("ness", "")):
+        if w.endswith(suf):
+            if _measure(w[:-len(suf)]) > 0:
+                w = w[:-len(suf)] + rep
+            break
+
+    # step 4
+    for suf in ("al", "ance", "ence", "er", "ic", "able", "ible", "ant",
+                "ement", "ment", "ent", "ou", "ism", "ate", "iti", "ous",
+                "ive", "ize"):
+        if w.endswith(suf):
+            if _measure(w[:-len(suf)]) > 1:
+                w = w[:-len(suf)]
+            break
+    else:
+        if w.endswith("ion") and len(w) > 3 and w[-4] in "st":
+            if _measure(w[:-3]) > 1:
+                w = w[:-3]
+
+    # step 5a
+    if w.endswith("e"):
+        stem = w[:-1]
+        if _measure(stem) > 1 or (_measure(stem) == 1
+                                  and not _ends_cvc(stem)):
+            w = stem
+    # step 5b
+    if _measure(w) > 1 and _ends_double_cons(w) and w.endswith("l"):
+        w = w[:-1]
+
+    return w
+
+
+# ---------------------------------------------------------------------------
+# SentiWordNet scorer
+# ---------------------------------------------------------------------------
+
+
+class SWN3:
+    """SentiWordNet 3 polarity scorer (ref SWN3.java).
+
+    Loads the standard SentiWordNet TSV format
+    (``pos\tid\tPosScore\tNegScore\tterm#rank [term#rank...]\tgloss``),
+    collapsing each word#pos's per-sense scores with the reference's
+    harmonic rank weighting (SWN3.java:104-117:
+    score = Σ score_i/(i+1) / Σ 1/i). Sentence scoring sums token scores
+    and flips the sign when any negation word appears
+    (SWN3.java:180-197)."""
+
+    #: bare negators; contractions ("isn't", "don't") are caught by the
+    #: n't-suffix check in score_tokens (the tokenizer keeps them whole)
+    NEGATION_WORDS = frozenset({
+        "not", "no", "never", "cannot", "cant", "wont", "neither",
+        "nor", "nothing", "nobody", "none", "without",
+    })
+
+    @classmethod
+    def _is_negation(cls, token: str) -> bool:
+        t = token.lower()
+        return t in cls.NEGATION_WORDS or t.endswith("n't")
+
+    #: classForScore thresholds (SWN3.java:156-171). The reference's literal
+    #: if-chain leaves (0, 0.25) and (-0.75, -0.5) unreachable/neutral and
+    #: routes (0.5, 0.75) to weak_positive; here the same band edges form a
+    #: monotone chain instead.
+    _CLASSES = (
+        (0.75, "strong_positive"), (0.25, "positive"), (0.0, "weak_positive"),
+        (-0.25, "weak_negative"), (-0.75, "negative"),
+    )
+
+    def __init__(self, path: Optional[str] = None,
+                 engine: Optional[AnalysisEngine] = None):
+        self._dict: Dict[str, float] = {}
+        self._by_term: Dict[str, float] = {}  # term -> sum over POS entries
+        self.engine = engine or AnalysisEngine.tokenizer(stem=False)
+        if path is not None:
+            self.load(path)
+
+    def load(self, path: str) -> None:
+        temp: Dict[str, Dict[int, float]] = {}
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if not line or line.startswith("#"):
+                    continue
+                data = line.split("\t")
+                if len(data) < 5 or not data[2] or not data[3]:
+                    continue
+                score = float(data[2]) - float(data[3])
+                for w in data[4].split(" "):
+                    if not w or "#" not in w:
+                        continue
+                    term, rank = w.rsplit("#", 1)
+                    key = f"{term}#{data[0]}"
+                    temp.setdefault(key, {})[int(rank) - 1] = score
+        for key, senses in temp.items():
+            num = sum(s / (i + 1) for i, s in senses.items())
+            den = sum(1.0 / i for i in range(1, max(senses) + 2))
+            score = num / den if den else 0.0
+            self._dict[key] = score
+            term = key.rsplit("#", 1)[0]
+            self._by_term[term] = self._by_term.get(term, 0.0) + score
+
+    def extract(self, word: str) -> float:
+        """Sum of the word's scores across POS entries (SWN3.extract)."""
+        return self._by_term.get(word, 0.0)
+
+    def score_tokens(self, tokens: Sequence[str]) -> float:
+        total = sum(self.extract(t.lower()) for t in tokens)
+        if any(self._is_negation(t) for t in tokens):
+            total *= -1.0  # negation context flip (SWN3.java:190-194)
+        return total
+
+    def score(self, text: str) -> float:
+        doc = self.engine.process(text)
+        total = 0.0
+        for s in doc.select("sentence"):
+            total += self.score_tokens(
+                [doc.covered_text(t) for t in doc.covered(s, "token")])
+        return total
+
+    def class_for_score(self, score: float) -> str:
+        if score == 0.0:
+            return "neutral"
+        for bound, name in self._CLASSES:
+            if score > bound:
+                return name
+        return "strong_negative"
+
+    def classify(self, text: str) -> str:
+        return self.class_for_score(self.score(text))

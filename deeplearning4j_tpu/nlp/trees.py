@@ -1,0 +1,328 @@
+"""Constituency trees + vectorization for recursive models.
+
+TPU-framework equivalent of the reference's tree-parser corpus tooling
+(deeplearning4j-nlp-uima text/corpora/treeparser/, SURVEY §2.6):
+
+- Tree                    ← nn/layers/feedforward/autoencoder/recursive/Tree.java
+                            (label/value/children/tokens/vector/goldLabel/error)
+- ChunkTreeParser         ← TreeParser.java (the reference drives external
+                            OpenNLP/cogcomp parser models; here a POS-driven
+                            chunk parser builds S → NP/VP/PP → POS → token)
+- BinarizeTreeTransformer ← transformer/BinarizeTreeTransformer.java
+- CollapseUnaries         ← CollapseUnaries.java
+- HeadWordFinder          ← HeadWordFinder.java (same PTB head-rule tables)
+- TreeVectorizer          ← TreeVectorizer.java (parse → binarize → collapse
+                            unaries → attach labels/word vectors)
+- TreeIterator            ← TreeIterator.java (batched tree stream)
+
+Trees come out CNF-shaped (≤2 children after binarization) with word
+vectors attached at the leaves — ready for a scan-based recursive net.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.annotation import AnalysisEngine
+
+# ---------------------------------------------------------------------------
+# Tree
+# ---------------------------------------------------------------------------
+
+
+class Tree:
+    """Labelled ordered tree (ref Tree.java:32-409)."""
+
+    def __init__(self, label: str = "", children: Optional[List["Tree"]] = None,
+                 value: Optional[str] = None, begin: int = 0, end: int = 0):
+        self.label = label            # syntactic category (getType/label)
+        self.value = value            # surface word at leaves (value())
+        self.children: List[Tree] = children or []
+        self.begin, self.end = begin, end
+        self.gold_label: Optional[int] = None
+        self.prediction: Optional[np.ndarray] = None
+        self.vector: Optional[np.ndarray] = None
+        self.error: float = 0.0
+        self.tokens: List[str] = []
+
+    # --- structure queries (Tree.java:147-177,300-323) ---
+    def is_leaf(self) -> bool:
+        return not self.children
+
+    def is_preterminal(self) -> bool:
+        return len(self.children) == 1 and self.children[0].is_leaf()
+
+    def first_child(self) -> Optional["Tree"]:
+        return self.children[0] if self.children else None
+
+    def last_child(self) -> Optional["Tree"]:
+        return self.children[-1] if self.children else None
+
+    def leaves(self) -> List["Tree"]:
+        if self.is_leaf():
+            return [self]
+        out: List[Tree] = []
+        for c in self.children:
+            out.extend(c.leaves())
+        return out
+
+    def yield_words(self) -> List[str]:
+        """Surface string of the subtree (ref Tree.yield)."""
+        return [leaf.value or "" for leaf in self.leaves()]
+
+    def depth(self) -> int:
+        if self.is_leaf():
+            return 0
+        return 1 + max(c.depth() for c in self.children)
+
+    def parent(self, root: "Tree") -> Optional["Tree"]:
+        """Parent of this node under `root` (ref Tree.parent(root))."""
+        return root.parent_of(self)
+
+    def parent_of(self, node: "Tree") -> Optional["Tree"]:
+        for c in self.children:
+            if c is node:
+                return self
+            p = c.parent_of(node)
+            if p is not None:
+                return p
+        return None
+
+    def error_sum(self) -> float:
+        """Total error over the subtree (ref Tree.errorSum:278)."""
+        return self.error + sum(c.error_sum() for c in self.children)
+
+    def clone(self) -> "Tree":
+        t = Tree(self.label, [c.clone() for c in self.children], self.value,
+                 self.begin, self.end)
+        t.gold_label, t.error = self.gold_label, self.error
+        t.tokens = list(self.tokens)
+        if self.vector is not None:
+            t.vector = np.array(self.vector)
+        return t
+
+    def __repr__(self) -> str:  # PTB-style bracketing
+        if self.is_leaf():
+            return self.value or ""
+        kids = " ".join(repr(c) for c in self.children)
+        return f"({self.label} {kids})"
+
+
+# ---------------------------------------------------------------------------
+# Parser: POS-driven chunking into a shallow constituency tree
+# ---------------------------------------------------------------------------
+
+#: chunk → POS-tag membership, tried in order within a sentence sweep
+_CHUNK_RULES = (
+    ("NP", {"DT", "PRP$", "JJ", "JJR", "JJS", "NN", "NNS", "NNP", "NNPS",
+            "PRP", "CD", "EX", "WP", "WDT"}),
+    ("VP", {"MD", "VB", "VBD", "VBG", "VBN", "VBP", "VBZ", "TO", "RB"}),
+    ("PP", {"IN"}),
+    ("ADJP", {"JJ", "JJR", "JJS"}),
+    ("ADVP", {"RB", "RBR", "RBS", "WRB"}),
+)
+
+
+class ChunkTreeParser:
+    """Sentence → constituency tree via POS chunking (ref TreeParser.java
+    builds trees from an external parser's output; the chunk grammar here
+    produces the same Tree shape for downstream vectorization)."""
+
+    def __init__(self, engine: Optional[AnalysisEngine] = None):
+        self.engine = engine or AnalysisEngine.pos_tagger()
+
+    def _chunk_label(self, tag: str) -> str:
+        for label, members in _CHUNK_RULES:
+            if tag in members:
+                return label
+        return "X"
+
+    def parse_sentence(self, tagged: Sequence[tuple]) -> Tree:
+        """tagged: [(word, pos, begin, end), ...] → S tree."""
+        chunks: List[Tree] = []
+        current: Optional[Tree] = None
+        for word, tag, b, e in tagged:
+            leaf = Tree(value=word, begin=b, end=e)
+            pre = Tree(tag, [leaf], begin=b, end=e)
+            label = self._chunk_label(tag)
+            if current is not None and current.label == label:
+                current.children.append(pre)
+                current.end = e
+            else:
+                current = Tree(label, [pre], begin=b, end=e)
+                chunks.append(current)
+        root_b = chunks[0].begin if chunks else 0
+        root_e = chunks[-1].end if chunks else 0
+        root = Tree("S", chunks, begin=root_b, end=root_e)
+        root.tokens = [w for w, _, _, _ in tagged]
+        return root
+
+    def get_trees(self, text: str) -> List[Tree]:
+        """All sentence trees in `text` (ref TreeParser.getTrees)."""
+        doc = self.engine.process(text)
+        out = []
+        for s in doc.select("sentence"):
+            tagged = [(doc.covered_text(t), t.features.get("pos", "NN"),
+                       t.begin, t.end) for t in doc.covered(s, "token")]
+            if tagged:
+                out.append(self.parse_sentence(tagged))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Transformers
+# ---------------------------------------------------------------------------
+
+
+class TreeTransformer:
+    """ref transformer/TreeTransformer.java."""
+
+    def transform(self, tree: Tree) -> Tree:
+        raise NotImplementedError
+
+
+class BinarizeTreeTransformer(TreeTransformer):
+    """Left-factored binarization: n>2 children become a right-leaning
+    spine of @Label intermediates (ref BinarizeTreeTransformer.java)."""
+
+    def transform(self, tree: Tree) -> Tree:
+        children = [self.transform(c) for c in tree.children]
+        while len(children) > 2:
+            right = Tree(f"@{tree.label}", children[-2:],
+                         begin=children[-2].begin, end=children[-1].end)
+            children = children[:-2] + [right]
+        out = Tree(tree.label, children, tree.value, tree.begin, tree.end)
+        out.gold_label, out.tokens = tree.gold_label, list(tree.tokens)
+        return out
+
+
+class CollapseUnaries(TreeTransformer):
+    """Collapse unary chains X→Y→... to the bottom non-unary node,
+    keeping the top label (ref CollapseUnaries.java; preterminals stay)."""
+
+    def transform(self, tree: Tree) -> Tree:
+        if tree.is_leaf() or tree.is_preterminal():
+            return tree
+        node = tree
+        while len(node.children) == 1 and not node.is_preterminal():
+            node = node.children[0]
+        children = [self.transform(c) for c in node.children]
+        out = Tree(tree.label, children, node.value, tree.begin, tree.end)
+        out.gold_label, out.tokens = tree.gold_label, list(tree.tokens)
+        return out
+
+
+class HeadWordFinder:
+    """Per-constituent head word via PTB head-percolation rules
+    (ref HeadWordFinder.java:30-48 — same parent/child priority tables)."""
+
+    HEAD1 = {"ADJP JJ", "ADJP JJR", "ADJP JJS", "ADVP RB", "ADVP RBB",
+             "LST LS", "NAC NNS", "NAC NN", "NAC PRP", "NAC NNPS", "NAC NNP",
+             "NX NNS", "NX NN", "NX PRP", "NX NNPS", "NX NNP", "NP NNS",
+             "NP NN", "NP PRP", "NP NNPS", "NP NNP", "NP POS", "NP $",
+             "PP IN", "PP TO", "PP RP", "PRT RP", "S VP", "S1 S", "SBAR IN",
+             "SBAR WHNP", "SBARQ SQ", "SBARQ VP", "SINV VP", "SQ MD",
+             "SQ AUX", "VP VB", "VP VBZ", "VP VBP", "VP VBG", "VP VBN",
+             "VP VBD", "VP AUX", "VP AUXG", "VP TO", "VP MD", "WHADJP WRB",
+             "WHADVP WRB", "WHNP WP", "WHNP WDT", "WHNP WP$", "WHPP IN",
+             "WHPP TO"}
+    HEAD2 = {"ADJP VBN", "ADJP RB", "NAC NP", "NAC CD", "NAC FW", "NAC ADJP",
+             "NAC JJ", "NX NP", "NX CD", "NX FW", "NX ADJP", "NX JJ",
+             "NP CD", "NP ADJP", "NP JJ", "S SINV", "S SBARQ", "S X",
+             "PRT RB", "PRT IN", "SBAR WHADJP", "SBAR WHADVP", "SBAR WHPP",
+             "SBARQ S", "SBARQ SINV", "SBARQ X", "SINV SBAR", "SQ VP"}
+
+    def find_head(self, tree: Tree) -> Optional[Tree]:
+        """Head LEAF of the constituent (ref findHeadWord)."""
+        node = tree
+        while not node.is_leaf():
+            node = self._head_child(node)
+        return node
+
+    def _head_child(self, tree: Tree) -> Tree:
+        if tree.is_preterminal():
+            return tree.children[0]
+        for rules in (self.HEAD1, self.HEAD2):
+            for c in tree.children:
+                if f"{tree.label} {self._cat(c)}" in rules:
+                    return c
+        # fallback: rightmost child (PTB convention for head-final misses)
+        return tree.children[-1]
+
+    @staticmethod
+    def _cat(t: Tree) -> str:
+        return t.label if t.label else (t.value or "")
+
+
+# ---------------------------------------------------------------------------
+# Vectorization
+# ---------------------------------------------------------------------------
+
+
+class TreeVectorizer:
+    """Parse → binarize → collapse-unaries → attach labels + word vectors
+    (ref TreeVectorizer.java:33-86: BinarizeTreeTransformer then
+    CollapseUnaries over TreeParser output, goldLabel from the sentence
+    label)."""
+
+    def __init__(self, parser: Optional[ChunkTreeParser] = None,
+                 lookup: Optional[Dict[str, np.ndarray]] = None):
+        self.parser = parser or ChunkTreeParser()
+        self.binarizer = BinarizeTreeTransformer()
+        self.collapser = CollapseUnaries()
+        self.lookup = lookup or {}
+
+    def _finalize(self, tree: Tree) -> Tree:
+        tree = self.collapser.transform(self.binarizer.transform(tree))
+        if self.lookup:
+            dim = len(next(iter(self.lookup.values())))
+            for leaf in tree.leaves():
+                vec = self.lookup.get((leaf.value or "").lower())
+                leaf.vector = (np.asarray(vec, np.float32)
+                               if vec is not None
+                               else np.zeros((dim,), np.float32))
+        return tree
+
+    def get_trees(self, text: str) -> List[Tree]:
+        return [self._finalize(t) for t in self.parser.get_trees(text)]
+
+    def get_trees_with_labels(self, text: str, label: str,
+                              labels: Sequence[str]) -> List[Tree]:
+        """Trees with goldLabel = index of `label` in `labels` (ref
+        getTreesWithLabels: label index propagated to every node)."""
+        idx = list(labels).index(label)
+        trees = self.get_trees(text)
+        for t in trees:
+            stack = [t]
+            while stack:
+                node = stack.pop()
+                node.gold_label = idx
+                stack.extend(node.children)
+        return trees
+
+
+class TreeIterator:
+    """Batched tree stream over labelled documents (ref TreeIterator.java:
+    next(num) pulls sentences, vectorizes, returns tree batches)."""
+
+    def __init__(self, documents: Iterable[tuple], labels: Sequence[str],
+                 vectorizer: Optional[TreeVectorizer] = None,
+                 batch_size: int = 32):
+        self._docs = list(documents)  # (text, label) pairs
+        self.labels = list(labels)
+        self.vectorizer = vectorizer or TreeVectorizer()
+        self.batch_size = batch_size
+
+    def __iter__(self) -> Iterator[List[Tree]]:
+        batch: List[Tree] = []
+        for text, label in self._docs:
+            for t in self.vectorizer.get_trees_with_labels(
+                    text, label, self.labels):
+                batch.append(t)
+                if len(batch) >= self.batch_size:
+                    yield batch
+                    batch = []
+        if batch:
+            yield batch

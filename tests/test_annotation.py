@@ -1,0 +1,288 @@
+"""Annotation pipeline + tree corpus tests (the dl4j-nlp-uima role).
+
+Mirrors the reference module's observable behavior: sentence segmentation,
+token spans, stemming, POS filtering with "NONE" substitution
+(PosUimaTokenizer.java), SentiWordNet scoring with negation flip and the
+harmonic sense weighting (SWN3.java), and the tree pipeline
+(TreeVectorizer.java: binarize + collapse unaries + gold labels).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.annotation import (
+    AnalysisEngine, Annotation, AnnotatedDocument, AnnotationSentenceIterator,
+    AnnotationTokenizerFactory, PosAnnotator, PosFilterTokenizerFactory,
+    SWN3, SentenceAnnotator, StemmingPreprocessor, TokenizerAnnotator,
+    porter_stem,
+)
+from deeplearning4j_tpu.nlp.trees import (
+    BinarizeTreeTransformer, ChunkTreeParser, CollapseUnaries,
+    HeadWordFinder, Tree, TreeIterator, TreeVectorizer,
+)
+
+
+class TestSentenceAnnotator:
+    def test_splits_on_boundaries(self):
+        doc = AnalysisEngine.segmenter().process(
+            "The cat sat. The dog barked! Did it rain? Yes.")
+        sents = doc.select("sentence")
+        texts = [doc.covered_text(s) for s in sents]
+        assert texts == ["The cat sat.", "The dog barked!", "Did it rain?",
+                         "Yes."]
+
+    def test_abbreviations_kept_whole(self):
+        doc = AnalysisEngine.segmenter().process(
+            "Dr. Smith arrived. He sat down.")
+        texts = [doc.covered_text(s) for s in doc.select("sentence")]
+        assert texts == ["Dr. Smith arrived.", "He sat down."]
+
+    def test_spans_index_into_text(self):
+        text = "One two.  Three four."
+        doc = AnalysisEngine.segmenter().process(text)
+        for s in doc.select("sentence"):
+            assert text[s.begin:s.end] == doc.covered_text(s)
+
+
+class TestTokenizerAnnotator:
+    def test_token_spans(self):
+        text = "It's 3.5 degrees, okay?"
+        doc = AnalysisEngine.tokenizer(stem=False).process(text)
+        words = [doc.covered_text(t) for t in doc.select("token")]
+        assert words == ["It's", "3.5", "degrees", ",", "okay", "?"]
+
+    def test_covered_tokens_per_sentence(self):
+        doc = AnalysisEngine.tokenizer(stem=False).process(
+            "First here. Second there.")
+        sents = doc.select("sentence")
+        assert len(sents) == 2
+        first = [doc.covered_text(t) for t in doc.covered(sents[0], "token")]
+        assert first == ["First", "here", "."]
+
+
+class TestPorterStemmer:
+    # classic Porter (1980) reference pairs
+    @pytest.mark.parametrize("word,stem", [
+        ("caresses", "caress"), ("ponies", "poni"), ("cats", "cat"),
+        ("feed", "feed"), ("agreed", "agre"), ("plastered", "plaster"),
+        ("motoring", "motor"), ("sing", "sing"), ("conflated", "conflat"),
+        ("troubling", "troubl"), ("sized", "size"), ("hopping", "hop"),
+        ("falling", "fall"), ("hissing", "hiss"), ("happy", "happi"),
+        ("relational", "relat"), ("conditional", "condit"),
+        ("vietnamization", "vietnam"), ("predication", "predic"),
+        ("operator", "oper"), ("feudalism", "feudal"),
+        ("decisiveness", "decis"), ("hopefulness", "hope"),
+        ("formality", "formal"), ("sensitivity", "sensit"),
+        ("triplicate", "triplic"), ("formative", "form"),
+        ("formalize", "formal"), ("electrical", "electr"),
+        ("hopeful", "hope"), ("goodness", "good"),
+        ("revival", "reviv"), ("allowance", "allow"),
+        ("inference", "infer"), ("airliner", "airlin"),
+        ("adjustable", "adjust"), ("defensible", "defens"),
+        ("replacement", "replac"), ("adjustment", "adjust"),
+        ("dependent", "depend"), ("adoption", "adopt"),
+        ("activate", "activ"), ("effective", "effect"),
+        ("probate", "probat"), ("rate", "rate"), ("cease", "ceas"),
+        ("controll", "control"), ("roll", "roll"),
+    ])
+    def test_reference_pairs(self, word, stem):
+        assert porter_stem(word) == stem
+
+    def test_preprocessor(self):
+        pre = StemmingPreprocessor()
+        assert pre("Running".lower()) == "run"
+
+
+class TestPosAnnotator:
+    def test_basic_tags(self):
+        doc = AnalysisEngine.pos_tagger().process("The cat quickly ate food.")
+        tags = {doc.covered_text(t): t.features["pos"]
+                for t in doc.select("token")}
+        assert tags["The"] == "DT"
+        assert tags["quickly"] == "RB"
+        assert tags["cat"] == "NN"
+        assert tags["."] == "."
+
+    def test_verb_after_modal(self):
+        doc = AnalysisEngine.pos_tagger().process("it can jump")
+        tags = [t.features["pos"] for t in doc.select("token")]
+        assert tags == ["PRP", "MD", "VB"]
+
+
+class TestIteratorsAndFactories:
+    def test_sentence_iterator(self):
+        it = AnnotationSentenceIterator(
+            ["A first one. A second one.", "A third one."])
+        assert list(it) == ["A first one.", "A second one.", "A third one."]
+
+    def test_tokenizer_factory_stems(self):
+        fac = AnnotationTokenizerFactory()
+        toks = fac.create("The cats were running").get_tokens()
+        assert "cat" in toks and "run" in toks
+
+    def test_tokenizer_factory_raw(self):
+        fac = AnnotationTokenizerFactory(use_stems=False)
+        assert fac.create("The cats ran").get_tokens() == \
+            ["The", "cats", "ran"]
+
+    def test_pos_filter_none_substitution(self):
+        # ref PosUimaTokenizer: "any not valid part of speech tags become
+        # NONE"
+        fac = PosFilterTokenizerFactory(["NN", "NNS"],
+                                        engine=AnalysisEngine([
+                                            SentenceAnnotator(),
+                                            TokenizerAnnotator(),
+                                            PosAnnotator()]))
+        toks = fac.create("the cat sat").get_tokens()
+        assert toks == ["NONE", "cat", "NONE"]
+
+    def test_pos_filter_strip_nones(self):
+        fac = PosFilterTokenizerFactory(["NN", "NNS"], strip_nones=True,
+                                        engine=AnalysisEngine([
+                                            SentenceAnnotator(),
+                                            TokenizerAnnotator(),
+                                            PosAnnotator()]))
+        assert fac.create("the cat sat").get_tokens() == ["cat"]
+
+
+SWN_FIXTURE = """# POS\tID\tPosScore\tNegScore\tSynsetTerms\tGloss
+a\t00001\t0.75\t0\tgood#1\tfine quality
+a\t00002\t0.5\t0.125\tgood#2 great#1\tsecond sense
+a\t00003\t0\t0.875\tbad#1\tpoor quality
+n\t00004\t0\t0.25\tbad#2\tnoun sense
+"""
+
+
+class TestSWN3(object):
+    @pytest.fixture
+    def swn(self, tmp_path):
+        p = tmp_path / "swn.tsv"
+        p.write_text(SWN_FIXTURE)
+        return SWN3(str(p))
+
+    def test_harmonic_sense_weighting(self, swn):
+        # good#a: senses 1:0.75, 2:0.375 → (0.75/1 + 0.375/2)/(1 + 1/2)
+        expected = (0.75 + 0.375 / 2) / 1.5
+        assert swn._dict["good#a"] == pytest.approx(expected)
+
+    def test_extract_sums_pos_entries(self, swn):
+        # bad appears as adjective and noun; extract() sums both
+        assert swn.extract("bad") == pytest.approx(
+            swn._dict["bad#a"] + swn._dict["bad#n"])
+
+    def test_score_and_classify(self, swn):
+        assert swn.score("A good day") > 0
+        assert swn.classify("A good day").endswith("positive")
+        assert swn.score("A bad day") < 0
+
+    def test_negation_flips(self, swn):
+        plain = swn.score("It is good")
+        negated = swn.score("It is not good")
+        assert negated == pytest.approx(-plain)
+
+    def test_contracted_negation_flips(self, swn):
+        # the tokenizer keeps "isn't" whole; the n't-suffix check must fire
+        plain = swn.score("It is good")
+        negated = swn.score("It isn't good")
+        assert negated == pytest.approx(-plain)
+
+    def test_class_boundaries(self, swn):
+        assert swn.class_for_score(0.8) == "strong_positive"
+        assert swn.class_for_score(0.4) == "positive"
+        assert swn.class_for_score(0.1) == "weak_positive"
+        assert swn.class_for_score(0.0) == "neutral"
+        assert swn.class_for_score(-0.1) == "weak_negative"
+        assert swn.class_for_score(-0.4) == "negative"
+        assert swn.class_for_score(-0.9) == "strong_negative"
+
+
+class TestTrees:
+    def test_parse_produces_chunked_tree(self):
+        trees = ChunkTreeParser().get_trees("The cat sat on the mat.")
+        assert len(trees) == 1
+        t = trees[0]
+        assert t.label == "S"
+        assert t.yield_words() == ["The", "cat", "sat", "on", "the", "mat",
+                                   "."]
+        labels = [c.label for c in t.children]
+        assert "NP" in labels and "VP" in labels and "PP" in labels
+
+    def test_spans_cover_text(self):
+        text = "Dogs chase cats."
+        t = ChunkTreeParser().get_trees(text)[0]
+        for leaf in t.leaves():
+            assert text[leaf.begin:leaf.end] == leaf.value
+
+    def test_binarize_max_two_children(self):
+        wide = Tree("S", [Tree("A", [Tree(value=str(i))]) for i in range(5)])
+        out = BinarizeTreeTransformer().transform(wide)
+        stack = [out]
+        while stack:
+            n = stack.pop()
+            assert len(n.children) <= 2
+            stack.extend(n.children)
+        # surface order preserved
+        assert out.yield_words() == [str(i) for i in range(5)]
+
+    def test_collapse_unaries(self):
+        chain = Tree("S", [Tree("NP", [Tree("NX", [
+            Tree("NN", [Tree(value="cat")]),
+            Tree("NN", [Tree(value="dog")])])])])
+        out = CollapseUnaries().transform(chain)
+        # S→NP→NX chain collapsed: top label kept, bottom node's children
+        # promoted
+        assert out.label == "S"
+        assert len(out.children) == 2
+        assert all(c.is_preterminal() for c in out.children)
+        assert out.yield_words() == ["cat", "dog"]
+
+    def test_preterminals_survive_collapse(self):
+        pre = Tree("NN", [Tree(value="cat")])
+        assert CollapseUnaries().transform(pre).is_preterminal()
+
+    def test_head_word_finder(self):
+        # (S (NP (DT the) (NN cat)) (VP (VBD sat)))
+        t = Tree("S", [
+            Tree("NP", [Tree("DT", [Tree(value="the")]),
+                        Tree("NN", [Tree(value="cat")])]),
+            Tree("VP", [Tree("VBD", [Tree(value="sat")])])])
+        finder = HeadWordFinder()
+        assert finder.find_head(t).value == "sat"      # S → VP → VBD
+        assert finder.find_head(t.children[0]).value == "cat"  # NP → NN
+
+    def test_vectorizer_labels_and_vectors(self):
+        lookup = {"cats": np.ones(4, np.float32),
+                  "sleep": np.full(4, 2.0, np.float32)}
+        vec = TreeVectorizer(lookup=lookup)
+        trees = vec.get_trees_with_labels("Cats sleep.", "pos",
+                                          ["neg", "pos"])
+        t = trees[0]
+        stack = [t]
+        while stack:
+            n = stack.pop()
+            assert n.gold_label == 1
+            assert len(n.children) <= 2
+            stack.extend(n.children)
+        leaf_vecs = {leaf.value: leaf.vector for leaf in t.leaves()}
+        np.testing.assert_array_equal(leaf_vecs["Cats"], np.ones(4))
+        np.testing.assert_array_equal(leaf_vecs["sleep"], np.full(4, 2.0))
+        # OOV leaf (the period) gets a zero vector of the right dim
+        np.testing.assert_array_equal(leaf_vecs["."], np.zeros(4))
+
+    def test_tree_iterator_batches(self):
+        docs = [("One cat sat. Two dogs ran.", "pos"),
+                ("It was bad.", "neg")]
+        batches = list(TreeIterator(docs, ["neg", "pos"], batch_size=2))
+        trees = [t for b in batches for t in b]
+        assert len(trees) == 3
+        assert trees[0].gold_label == 1 and trees[2].gold_label == 0
+        assert all(len(b) <= 2 for b in batches)
+
+    def test_error_sum_and_clone(self):
+        t = Tree("S", [Tree("NN", [Tree(value="x")])])
+        t.error, t.children[0].error = 1.5, 2.0
+        assert t.error_sum() == pytest.approx(3.5)
+        c = t.clone()
+        assert repr(c) == repr(t)
+        c.children[0].error = 0.0
+        assert t.children[0].error == 2.0
