@@ -1,0 +1,328 @@
+"""Pallas TPU flash attention: fused multi-head attention kernel.
+
+The framework's long-context attention hot op. The lax.scan blockwise path
+(parallel/sequence.py blockwise_attention) is exact but leaves perf on the
+table: every scan step computes scores for ALL T queries against one KV
+block (no query blocking), fully-masked causal blocks are still computed,
+and the accumulators round-trip through HBM between steps. This kernel is
+the standard flash-attention schedule on the TPU memory hierarchy:
+
+- grid (B, H, nq, nk), KV innermost: the [bq, D] query block and the
+  (m, l, acc) online-softmax state live in VMEM scratch across all KV
+  steps — one HBM read per Q/K/V block, one HBM write per output block.
+- causal blocks strictly above the diagonal are skipped (roughly 2x for
+  long causal sequences), and in-block masking handles the diagonal.
+- QK^T / PV matmuls run on the MXU in the input dtype (bf16) with fp32
+  accumulation; softmax statistics are fp32 throughout.
+- backward is the recompute form (Dao et al. 2022): forward saves only
+  the [B,H,T] logsumexp; dq and dk/dv kernels rebuild the probabilities
+  per block — the same memory profile the cuDNN fused-attention path
+  gives the reference's GPU stack (SURVEY §2.1 fused-op parity row).
+
+Layout [B, H, T, D], same as parallel/sequence.py. Exactness vs
+reference_attention is covered by tests/test_pallas_attention.py; the
+real-chip numbers live in PERF.md.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # finite: (-inf) - (-inf) = nan inside exp would poison rows
+
+# 1024/1024 measured fastest on v5e at T=8k/D=128 (sweep in PERF.md)
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
+
+
+def _causal_needed(i, j, bq, bk):
+    """Is KV block j visible to any query in Q block i? (block-skip test)"""
+    return i * bq + bq - 1 >= j * bk
+
+
+def _block_mask(i, j, bq, bk, causal: bool, kmask_row):
+    """[bq, bk] validity mask for one (Q block, KV block) pair.
+    kmask_row: [1, bk]."""
+    valid = jnp.broadcast_to(kmask_row.astype(bool), (bq, bk))
+    if causal:
+        q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        valid = valid & (q_pos >= k_pos)
+    return valid
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref,
+                acc_scr, m_scr, l_scr, *, scale, causal, bq, bk, nk):
+    i, j = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _compute():
+        s = jax.lax.dot_general(
+            q_ref[0, 0], k_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale        # [bq, bk]
+        valid = _block_mask(i, j, bq, bk, causal, km_ref[0])
+        s = jnp.where(valid, s, NEG_INF)
+        m_prev = m_scr[:][:, :1]                               # [bq, 1]
+        l_prev = l_scr[:][:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        # explicit zeroing: if a whole row is masked, exp(NEG_INF-NEG_INF)
+        # would be 1 — the mask multiply keeps such rows at p=0
+        p = jnp.exp(s - m_new) * valid.astype(jnp.float32)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                # [bq, D]
+        acc_scr[:] = acc_scr[:] * corr + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:  # skip blocks strictly above the diagonal
+        pl.when(_causal_needed(i, j, bq, bk))(_compute)
+    else:
+        _compute()
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        m = m_scr[:][:, :1]
+        l = l_scr[:][:, :1]
+        o_ref[0, 0] = (acc_scr[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse_ref[0, 0] = m + jnp.log(jnp.maximum(l, 1e-30))
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, d_ref,
+                   dq_ref, dq_scr, *, scale, causal, bq, bk, nk):
+    i, j = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _compute():
+        s = jax.lax.dot_general(
+            q_ref[0, 0], k_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        valid = _block_mask(i, j, bq, bk, causal, km_ref[0])
+        # mask BEFORE exp (as forward does): a masked raw score above the
+        # row lse would overflow exp to inf and 0*inf = NaN in the grads
+        s = jnp.where(valid, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0, 0]) * valid.astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do_ref[0, 0], v_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)                # [bq, bk]
+        ds = p * (dp - d_ref[0, 0]) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(_causal_needed(i, j, bq, bk))(_compute)
+    else:
+        _compute()
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, d_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale, causal, bq, bk, nq):
+    j, i = pl.program_id(2), pl.program_id(3)   # Q innermost here
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _compute():
+        s = jax.lax.dot_general(
+            q_ref[0, 0], k_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale        # [bq, bk]
+        valid = _block_mask(i, j, bq, bk, causal, km_ref[0])
+        s = jnp.where(valid, s, NEG_INF)       # see _bwd_dq_kernel note
+        p = jnp.exp(s - lse_ref[0, 0]) * valid.astype(jnp.float32)
+        pt = p.astype(do_ref.dtype)
+        dv_scr[:] += jax.lax.dot_general(
+            pt, do_ref[0, 0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                # [bk, D]
+        dp = jax.lax.dot_general(
+            do_ref[0, 0], v_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - d_ref[0, 0]) * scale).astype(q_ref.dtype)
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q_ref[0, 0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                # [bk, D]
+
+    if causal:
+        pl.when(_causal_needed(i, j, bq, bk))(_compute)
+    else:
+        _compute()
+
+    @pl.when(i == nq - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _qkv_spec(bq_or_bk, D, axis):
+    """Block spec for q/k/v: (1,1,block,D), selecting grid axis 2 or 3."""
+    if axis == 2:
+        return pl.BlockSpec((1, 1, bq_or_bk, D),
+                            lambda b, h, i, j: (b, h, i, 0))
+    return pl.BlockSpec((1, 1, bq_or_bk, D),
+                        lambda b, h, i, j: (b, h, j, 0))
+
+
+def _row_spec(block, axis):
+    """Block spec for per-row stats [B,H,T,1]: (1,1,block,1) — trailing
+    dim 1 satisfies the Mosaic tiling rule (block dim == array dim)."""
+    if axis == 2:
+        return pl.BlockSpec((1, 1, block, 1), lambda b, h, i, j: (b, h, i, 0))
+    return pl.BlockSpec((1, 1, block, 1), lambda b, h, i, j: (b, h, j, 0))
+
+
+def _km_spec(bk, axis):
+    """Block spec for the key mask [B,1,T]: (1,1,bk), KV-indexed."""
+    if axis == 3:
+        return pl.BlockSpec((1, 1, bk), lambda b, h, i, j: (b, 0, j))
+    return pl.BlockSpec((1, 1, bk), lambda b, h, i, j: (b, 0, i))
+
+
+def _pad_t(x, bs):
+    pad = (-x.shape[2]) % bs
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    return x
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, key_mask, causal, bq, bk, interpret):
+    o, _ = _flash_fwd(q, k, v, key_mask, causal, bq, bk, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, key_mask, causal, bq, bk, interpret):
+    B, H, T, D = q.shape
+    scale = float(1.0 / np.sqrt(D))
+    nq, nk = T // bq, T // bk
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               bq=bq, bk=bk, nk=nk)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(B, H, nq, nk),
+        in_specs=[_qkv_spec(bq, D, 2), _qkv_spec(bk, D, 3),
+                  _qkv_spec(bk, D, 3), _km_spec(bk, 3)],
+        out_specs=[_qkv_spec(bq, D, 2), _row_spec(bq, 2)],
+        out_shape=[jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
+                   jax.ShapeDtypeStruct((B, H, T, 1), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32),
+                        pltpu.VMEM((bq, 128), jnp.float32),
+                        pltpu.VMEM((bq, 128), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, key_mask)
+    return o, (q, k, v, key_mask, o, lse)
+
+
+def _flash_bwd(causal, bq, bk, interpret, res, do):
+    q, k, v, key_mask, o, lse = res
+    B, H, T, D = q.shape
+    scale = float(1.0 / np.sqrt(D))
+    nq, nk = T // bq, T // bk
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)                     # [B,H,T,1]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nk=nk),
+        grid=(B, H, nq, nk),
+        in_specs=[_qkv_spec(bq, D, 2), _qkv_spec(bk, D, 3),
+                  _qkv_spec(bk, D, 3), _km_spec(bk, 3),
+                  _qkv_spec(bq, D, 2), _row_spec(bq, 2), _row_spec(bq, 2)],
+        out_specs=_qkv_spec(bq, D, 2),
+        out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, key_mask, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nq=nq),
+        # KV block is the carried axis; Q innermost
+        grid=(B, H, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk), lambda b, h, j, i: (b, 0, j)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, j, i: (b, h, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((B, H, T, D), k.dtype),
+                   jax.ShapeDtypeStruct((B, H, T, D), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, key_mask, do, lse, delta)
+
+    return dq, dk, dv, jnp.zeros_like(key_mask)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention_supported(q_shape: Tuple[int, ...],
+                              block_q: int = DEFAULT_BLOCK_Q,
+                              block_k: int = DEFAULT_BLOCK_K) -> bool:
+    """Shape gate (mirrors pallas_lstm_supported's role): head dim must be
+    lane-tileable and T large enough to block."""
+    if len(q_shape) != 4:
+        return False
+    _, _, T, D = q_shape
+    return D in (64, 128, 256) and T >= 128
+
+
+def flash_attention(q, k, v, causal: bool = False, key_mask=None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    interpret: bool = False):
+    """Fused flash attention. q,k,v: [B,H,T,D]; key_mask: [B,T] (1=valid).
+
+    T is padded internally to a block multiple (padded keys masked out,
+    padded query rows sliced off). Differentiable via the recompute-form
+    custom VJP. Use `interpret=True` on CPU (tests)."""
+    B, H, T, D = q.shape
+    # blocks stay sublane/lane-tile aligned (multiples of 128) even for
+    # short sequences — T is padded up to the block grid below
+    t128 = ((T + 127) // 128) * 128
+    bq = int(min(block_q, t128))
+    bk = int(min(block_k, t128))
+    # pad to a common multiple so both block sizes tile the padded length
+    L = int(np.lcm(bq, bk))
+    q, k, v = _pad_t(q, L), _pad_t(k, L), _pad_t(v, L)
+    Tp = q.shape[2]
+    if key_mask is None:
+        km = (jnp.arange(Tp) < T).astype(jnp.float32)[None, None, :]
+        km = jnp.broadcast_to(km, (B, 1, Tp))
+    else:
+        km = key_mask.astype(jnp.float32)[:, None, :]
+        km = jnp.pad(km, ((0, 0), (0, 0), (0, Tp - km.shape[2])))
+    out = _flash(q, k, v, km, causal, bq, bk, interpret)
+    return out[:, :, :T, :]

@@ -1,0 +1,161 @@
+"""Pallas flash attention vs reference math (backend-vs-backend pattern,
+the ValidateCudnnLSTM.java role for the attention hot op).
+
+Runs the kernel in interpreter mode on CPU: same kernel code path the TPU
+compiles, exactness asserted against reference_attention and jax.grad
+through it. Real-chip perf lives in bench_all.py / PERF.md.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.layers.pallas_attention import (
+    flash_attention, flash_attention_supported,
+)
+from deeplearning4j_tpu.parallel.sequence import reference_attention
+
+
+def _qkv(B=2, H=2, T=256, D=64, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(  # noqa: E731
+        rng.standard_normal((B, H, T, D)) * 0.5, dtype)
+    return mk(), mk(), mk()
+
+
+class TestForward:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, causal):
+        q, k, v = _qkv()
+        out = flash_attention(q, k, v, causal=causal, block_q=128,
+                              block_k=128, interpret=True)
+        ref = reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_unequal_blocks(self):
+        q, k, v = _qkv(T=512)
+        out = flash_attention(q, k, v, causal=True, block_q=256,
+                              block_k=128, interpret=True)
+        ref = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_ragged_t_padding(self):
+        # T not a multiple of the block: padded internally, sliced back
+        q, k, v = _qkv(T=200)
+        out = flash_attention(q, k, v, causal=True, block_q=128,
+                              block_k=128, interpret=True)
+        ref = reference_attention(q, k, v, causal=True)
+        assert out.shape == q.shape
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_key_mask(self):
+        B, T = 2, 256
+        q, k, v = _qkv(B=B, T=T)
+        rng = np.random.default_rng(3)
+        lengths = rng.integers(T // 4, T, B)
+        km = jnp.asarray(np.arange(T)[None, :] < lengths[:, None],
+                         jnp.float32)
+        out = flash_attention(q, k, v, key_mask=km, block_q=128,
+                              block_k=128, interpret=True)
+        # reference: NEG_INF-mask the padded keys
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(q.shape[-1])
+        s = jnp.where(km[:, None, None, :] > 0, s, -1e30)
+        ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_bf16_inputs(self):
+        q, k, v = _qkv(dtype=jnp.bfloat16)
+        out = flash_attention(q, k, v, causal=True, block_q=128,
+                              block_k=128, interpret=True)
+        ref = reference_attention(q.astype(jnp.float32),
+                                  k.astype(jnp.float32),
+                                  v.astype(jnp.float32), causal=True)
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(out.astype(jnp.float32), ref,
+                                   atol=3e-2, rtol=3e-2)
+
+    def test_supported_gate(self):
+        assert flash_attention_supported((2, 4, 1024, 128))
+        assert flash_attention_supported((2, 4, 1024, 64))
+        assert not flash_attention_supported((2, 4, 1024, 80))
+        assert not flash_attention_supported((2, 4, 32, 64))
+        assert not flash_attention_supported((4, 1024, 128))
+
+
+class TestBackward:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_reference(self, causal):
+        q, k, v = _qkv(B=1, H=2, T=256, D=64, seed=7)
+        tgt = jnp.asarray(
+            np.random.default_rng(9).standard_normal(q.shape), jnp.float32)
+
+        def loss_flash(q, k, v):
+            o = flash_attention(q, k, v, causal=causal, block_q=128,
+                                block_k=128, interpret=True)
+            return jnp.sum((o - tgt) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum((reference_attention(q, k, v, causal=causal)
+                            - tgt) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gf, gr, "qkv"):
+            np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4,
+                                       err_msg=f"d{name}")
+
+    def test_grads_with_ragged_t(self):
+        q, k, v = _qkv(B=1, H=1, T=200, D=64, seed=11)
+
+        def loss_flash(q):
+            return jnp.sum(flash_attention(q, k, v, causal=True,
+                                           block_q=128, block_k=128,
+                                           interpret=True) ** 2)
+
+        def loss_ref(q):
+            return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+        np.testing.assert_allclose(jax.grad(loss_flash)(q),
+                                   jax.grad(loss_ref)(q),
+                                   atol=5e-4, rtol=5e-4)
+
+    def test_zero_length_row_grads_finite(self):
+        # a batch row whose key_mask is all zeros must not NaN the grads
+        # (masked raw scores above the row lse would overflow exp if the
+        # backward kernels exponentiated unmasked scores)
+        B, T = 2, 128
+        q, k, v = _qkv(B=B, T=T, seed=17)
+        km = jnp.stack([jnp.ones((T,)), jnp.zeros((T,))]).astype(jnp.float32)
+
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, key_mask=km,
+                                           block_q=128, block_k=128,
+                                           interpret=True) ** 2)
+
+        grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        for g in grads:
+            assert np.all(np.isfinite(np.asarray(g)))
+
+    def test_grads_with_key_mask(self):
+        B, T = 2, 128
+        q, k, v = _qkv(B=B, T=T, seed=13)
+        km = jnp.asarray(np.arange(T)[None, :] < np.array([100, 64])[:, None],
+                         jnp.float32)
+
+        def loss_flash(k, v):
+            return jnp.sum(flash_attention(q, k, v, key_mask=km,
+                                           block_q=128, block_k=128,
+                                           interpret=True) ** 2)
+
+        def loss_ref(k, v):
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(q.shape[-1])
+            s = jnp.where(km[:, None, None, :] > 0, s, -1e30)
+            o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+            return jnp.sum(o ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1))(k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1))(k, v)
+        for a, b, name in zip(gf, gr, ("k", "v")):
+            np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4,
+                                       err_msg=f"d{name}")
