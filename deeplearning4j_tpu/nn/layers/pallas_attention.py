@@ -12,6 +12,9 @@ the standard flash-attention schedule on the TPU memory hierarchy:
   steps — one HBM read per Q/K/V block, one HBM write per output block.
 - causal blocks strictly above the diagonal are skipped (roughly 2x for
   long causal sequences), and in-block masking handles the diagonal.
+- blocks that need no masking at all (fully below the diagonal, no key
+  padding, no user mask) take a fast path with zero mask VPU ops — the
+  exp is the VPU bottleneck, so iota/compare/select per score matter.
 - QK^T / PV matmuls run on the MXU in the input dtype (bf16) with fp32
   accumulation; softmax statistics are fp32 throughout.
 - backward is the recompute form (Dao et al. 2022): forward saves only
@@ -58,8 +61,40 @@ def _block_mask(i, j, bq, bk, causal: bool, kmask_row):
     return valid
 
 
+def _dispatch(i, j, fast_fn, masked_fn, *, causal, bq, bk, nk,
+              first_pad, user_mask):
+    """Run the fast (no mask VPU ops) or masked block body.
+
+    Masking is needed only for diagonal-straddling causal blocks, KV
+    blocks containing padded keys (j >= first_pad — padding can span
+    multiple tail blocks when lcm(bq,bk) > bk), or when a user key mask
+    exists (then always). Fully-above-diagonal causal blocks are skipped
+    entirely."""
+    if user_mask:
+        if causal:
+            pl.when(_causal_needed(i, j, bq, bk))(masked_fn)
+        else:
+            masked_fn()
+        return
+    tail = (j >= first_pad) if first_pad is not None else None
+    if causal:
+        needed = _causal_needed(i, j, bq, bk)
+        interior = i * bq >= j * bk + bk - 1   # no in-block causal mask
+        fast = jnp.logical_and(needed, interior)
+        if tail is not None:
+            fast = jnp.logical_and(fast, jnp.logical_not(tail))
+        pl.when(fast)(fast_fn)
+        pl.when(jnp.logical_and(needed, jnp.logical_not(fast)))(masked_fn)
+    elif tail is None:
+        fast_fn()
+    else:
+        pl.when(jnp.logical_not(tail))(fast_fn)
+        pl.when(tail)(masked_fn)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref,
-                acc_scr, m_scr, l_scr, *, scale, causal, bq, bk, nk):
+                acc_scr, m_scr, l_scr, *, scale, causal, bq, bk, nk,
+                first_pad, user_mask):
     i, j = pl.program_id(2), pl.program_id(3)
 
     @pl.when(j == 0)
@@ -68,18 +103,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    def _compute():
+    def _compute(masked: bool):
         s = jax.lax.dot_general(
             q_ref[0, 0], k_ref[0, 0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale        # [bq, bk]
-        valid = _block_mask(i, j, bq, bk, causal, km_ref[0])
-        s = jnp.where(valid, s, NEG_INF)
+        if masked:
+            valid = _block_mask(i, j, bq, bk, causal, km_ref[0])
+            s = jnp.where(valid, s, NEG_INF)
         m_prev = m_scr[:][:, :1]                               # [bq, 1]
         l_prev = l_scr[:][:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        # explicit zeroing: if a whole row is masked, exp(NEG_INF-NEG_INF)
-        # would be 1 — the mask multiply keeps such rows at p=0
-        p = jnp.exp(s - m_new) * valid.astype(jnp.float32)
+        p = jnp.exp(s - m_new)
+        if masked:
+            # explicit zeroing: if a whole row is masked,
+            # exp(NEG_INF - NEG_INF) would be 1 — keep such rows at p=0
+            p = p * valid.astype(jnp.float32)
         corr = jnp.exp(m_prev - m_new)
         l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
         pv = jax.lax.dot_general(
@@ -89,10 +127,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref,
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    if causal:  # skip blocks strictly above the diagonal
-        pl.when(_causal_needed(i, j, bq, bk))(_compute)
-    else:
-        _compute()
+    _dispatch(i, j, lambda: _compute(False), lambda: _compute(True),
+              causal=causal, bq=bq, bk=bk, nk=nk, first_pad=first_pad,
+              user_mask=user_mask)
 
     @pl.when(j == nk - 1)
     def _finish():
@@ -103,22 +140,26 @@ def _fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, d_ref,
-                   dq_ref, dq_scr, *, scale, causal, bq, bk, nk):
+                   dq_ref, dq_scr, *, scale, causal, bq, bk, nk,
+                   first_pad, user_mask):
     i, j = pl.program_id(2), pl.program_id(3)
 
     @pl.when(j == 0)
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    def _compute():
+    def _compute(masked: bool):
         s = jax.lax.dot_general(
             q_ref[0, 0], k_ref[0, 0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        valid = _block_mask(i, j, bq, bk, causal, km_ref[0])
-        # mask BEFORE exp (as forward does): a masked raw score above the
-        # row lse would overflow exp to inf and 0*inf = NaN in the grads
-        s = jnp.where(valid, s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0, 0]) * valid.astype(jnp.float32)
+        if masked:
+            # mask BEFORE exp (as forward does): a masked raw score above
+            # the row lse would overflow exp to inf and 0*inf = NaN
+            valid = _block_mask(i, j, bq, bk, causal, km_ref[0])
+            s = jnp.where(valid, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0, 0])
+        if masked:
+            p = p * valid.astype(jnp.float32)
         dp = jax.lax.dot_general(
             do_ref[0, 0], v_ref[0, 0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)                # [bq, bk]
@@ -127,10 +168,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, d_ref,
             ds.astype(k_ref.dtype), k_ref[0, 0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        pl.when(_causal_needed(i, j, bq, bk))(_compute)
-    else:
-        _compute()
+    _dispatch(i, j, lambda: _compute(False), lambda: _compute(True),
+              causal=causal, bq=bq, bk=bk, nk=nk, first_pad=first_pad,
+              user_mask=user_mask)
 
     @pl.when(j == nk - 1)
     def _finish():
@@ -139,7 +179,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, d_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, d_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, scale, causal, bq, bk, nq):
+                    *, scale, causal, bq, bk, nq, nk,
+                    first_pad, user_mask):
     j, i = pl.program_id(2), pl.program_id(3)   # Q innermost here
 
     @pl.when(i == 0)
@@ -147,13 +188,16 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, d_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    def _compute():
+    def _compute(masked: bool):
         s = jax.lax.dot_general(
             q_ref[0, 0], k_ref[0, 0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale        # [bq, bk]
-        valid = _block_mask(i, j, bq, bk, causal, km_ref[0])
-        s = jnp.where(valid, s, NEG_INF)       # see _bwd_dq_kernel note
-        p = jnp.exp(s - lse_ref[0, 0]) * valid.astype(jnp.float32)
+        if masked:
+            valid = _block_mask(i, j, bq, bk, causal, km_ref[0])
+            s = jnp.where(valid, s, NEG_INF)   # see _bwd_dq_kernel note
+        p = jnp.exp(s - lse_ref[0, 0])
+        if masked:
+            p = p * valid.astype(jnp.float32)
         pt = p.astype(do_ref.dtype)
         dv_scr[:] += jax.lax.dot_general(
             pt, do_ref[0, 0], (((0,), (0,)), ((), ())),
@@ -166,10 +210,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, d_ref,
             ds, q_ref[0, 0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)                # [bk, D]
 
-    if causal:
-        pl.when(_causal_needed(i, j, bq, bk))(_compute)
-    else:
-        _compute()
+    _dispatch(i, j, lambda: _compute(False), lambda: _compute(True),
+              causal=causal, bq=bq, bk=bk, nk=nk, first_pad=first_pad,
+              user_mask=user_mask)
 
     @pl.when(i == nq - 1)
     def _finish():
@@ -208,18 +251,22 @@ def _pad_t(x, bs):
     return x
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q, k, v, key_mask, causal, bq, bk, interpret):
-    o, _ = _flash_fwd(q, k, v, key_mask, causal, bq, bk, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, key_mask, causal, bq, bk, first_pad, user_mask,
+           interpret):
+    o, _ = _flash_fwd(q, k, v, key_mask, causal, bq, bk, first_pad,
+                      user_mask, interpret)
     return o
 
 
-def _flash_fwd(q, k, v, key_mask, causal, bq, bk, interpret):
+def _flash_fwd(q, k, v, key_mask, causal, bq, bk, first_pad, user_mask,
+               interpret):
     B, H, T, D = q.shape
     scale = float(1.0 / np.sqrt(D))
     nq, nk = T // bq, T // bk
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               bq=bq, bk=bk, nk=nk)
+                               bq=bq, bk=bk, nk=nk, first_pad=first_pad,
+                               user_mask=user_mask)
     o, lse = pl.pallas_call(
         kernel,
         grid=(B, H, nq, nk),
@@ -236,7 +283,7 @@ def _flash_fwd(q, k, v, key_mask, causal, bq, bk, interpret):
     return o, (q, k, v, key_mask, o, lse)
 
 
-def _flash_bwd(causal, bq, bk, interpret, res, do):
+def _flash_bwd(causal, bq, bk, first_pad, user_mask, interpret, res, do):
     q, k, v, key_mask, o, lse = res
     B, H, T, D = q.shape
     scale = float(1.0 / np.sqrt(D))
@@ -246,7 +293,8 @@ def _flash_bwd(causal, bq, bk, interpret, res, do):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk),
+                          bq=bq, bk=bk, nk=nk, first_pad=first_pad,
+                          user_mask=user_mask),
         grid=(B, H, nq, nk),
         in_specs=[_qkv_spec(bq, D, 2), _qkv_spec(bk, D, 3),
                   _qkv_spec(bk, D, 3), _km_spec(bk, 3),
@@ -259,7 +307,8 @@ def _flash_bwd(causal, bq, bk, interpret, res, do):
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq),
+                          bq=bq, bk=bk, nq=nq, nk=nk, first_pad=first_pad,
+                          user_mask=user_mask),
         # KV block is the carried axis; Q innermost
         grid=(B, H, nk, nq),
         in_specs=[
@@ -318,11 +367,16 @@ def flash_attention(q, k, v, causal: bool = False, key_mask=None,
     L = int(np.lcm(bq, bk))
     q, k, v = _pad_t(q, L), _pad_t(k, L), _pad_t(v, L)
     Tp = q.shape[2]
+    # index of the first KV block containing a padded key; padding can
+    # span several tail blocks when lcm(bq, bk) > bk
+    first_pad = (T // bk) if Tp != T else None
+    user_mask = key_mask is not None
     if key_mask is None:
         km = (jnp.arange(Tp) < T).astype(jnp.float32)[None, None, :]
         km = jnp.broadcast_to(km, (B, 1, Tp))
     else:
         km = key_mask.astype(jnp.float32)[:, None, :]
         km = jnp.pad(km, ((0, 0), (0, 0), (0, Tp - km.shape[2])))
-    out = _flash(q, k, v, km, causal, bq, bk, interpret)
+    out = _flash(q, k, v, km, causal, bq, bk, first_pad, user_mask,
+                 interpret)
     return out[:, :, :T, :]

@@ -40,6 +40,16 @@ class TestForward:
         ref = reference_attention(q, k, v, causal=True)
         np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
+    def test_ragged_t_multi_block_padding_noncausal(self):
+        # unequal blocks pad T to lcm(bq,bk)=256, so padded keys span TWO
+        # KV blocks (300->512, blocks j=2,3 at bk=128); every padded block
+        # must take the masked path, not just the last one
+        q, k, v = _qkv(T=300)
+        out = flash_attention(q, k, v, causal=False, block_q=256,
+                              block_k=128, interpret=True)
+        ref = reference_attention(q, k, v, causal=False)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
     def test_ragged_t_padding(self):
         # T not a multiple of the block: padded internally, sliced back
         q, k, v = _qkv(T=200)
