@@ -109,11 +109,17 @@ class LayerVertex(GraphVertexConf):
             it = self.preprocessor.output_type(it)
         return self.layer.init(key, it)
 
-    def apply(self, params, xs, state, *, train=False, rng=None, mask=None):
+    @property
+    def supports_streaming(self):
+        return getattr(self.layer, "supports_streaming", False)
+
+    def apply(self, params, xs, state, *, train=False, rng=None, mask=None,
+              **extra):
         x = xs[0]
         if self.preprocessor is not None:
             x = self.preprocessor.apply(x, mask)
-        return self.layer.apply(params, x, state, train=train, rng=rng, mask=mask)
+        return self.layer.apply(params, x, state, train=train, rng=rng,
+                                mask=mask, **extra)
 
     def output_mask(self, masks, its):
         m = masks[0] if masks else None

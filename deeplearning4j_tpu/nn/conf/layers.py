@@ -31,6 +31,35 @@ from deeplearning4j_tpu.nn.layers import normalization as _norm
 from deeplearning4j_tpu.nn.layers import recurrent as _rnn
 from deeplearning4j_tpu.nn.weights import init_weights
 
+import numpy as np
+
+#: per-layer state keys carried only by the streaming rnn_time_step path
+#: (stripped on ordinary forwards; cleared by rnn_clear_previous_state):
+#: LSTM h/c, attention KV cache, positional-embedding offset
+STREAM_STATE_KEYS = frozenset(
+    {"h", "c", "kv_k", "kv_v", "kv_pos", "pos_offset"})
+
+
+def check_stream_budget(net, t: int, layers) -> None:
+    """Host-side guard for streaming inference: dynamic_update_slice
+    CLAMPS out-of-range starts, so streaming past a layer's KV-cache /
+    positional capacity would silently corrupt instead of erroring.
+    Tracks net._stream_pos (reset by rnn_clear_previous_state)."""
+    net._stream_pos = getattr(net, "_stream_pos", 0) + int(t)
+    limit = None
+    for l in layers:
+        if not getattr(l, "supports_streaming", False):
+            continue
+        for cap in (getattr(l, "cache_length", 0),
+                    getattr(l, "max_length", 0)):
+            if cap:
+                limit = cap if limit is None else min(limit, cap)
+    if limit is not None and net._stream_pos > limit:
+        raise ValueError(
+            f"streamed {net._stream_pos} positions, exceeding the smallest "
+            f"streaming capacity ({limit}); call rnn_clear_previous_state() "
+            "or raise cache_length/max_length")
+
 # ---------------------------------------------------------------------------
 # registry + serde
 # ---------------------------------------------------------------------------
@@ -710,10 +739,18 @@ class LayerNormalization(FeedForwardLayerConf):
 class PositionalEmbeddingLayer(FeedForwardLayerConf):
     """Adds a learned positional embedding to RNN-format input [N,F,T]
     (post-parity; attention is position-agnostic without it). Params:
-    P [F, max_length]; positions beyond max_length are rejected at
-    trace time by the slice."""
+    P [F, max_length]; a full-sequence forward longer than max_length is
+    rejected at trace time.
+
+    Streaming (rnn_time_step): carries "pos_offset" so each chunk gets
+    the embeddings for its absolute positions — the attention-era
+    equivalent of LSTM h/c carry (MultiLayerNetwork.rnnTimeStep). The
+    dynamic slice CLAMPS past max_length, so the network-level
+    check_stream_budget guard enforces the capacity host-side."""
 
     max_length: int = 1024
+
+    supports_streaming = True
 
     def output_type(self, it):
         if it.kind != "rnn":
@@ -725,13 +762,25 @@ class PositionalEmbeddingLayer(FeedForwardLayerConf):
         p = 0.02 * jax.random.normal(key, (it.size, self.max_length))
         return {"P": p.astype(jnp.float32)}, {}
 
-    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None,
+              stream=False):
         t = x.shape[2]
         if t > self.max_length:
             raise ValueError(f"sequence length {t} exceeds max_length "
                              f"{self.max_length}")
-        y = x + params["P"][None, :, :t].astype(x.dtype)
-        return _act.get(self.activation)(y), state
+        if stream:
+            off = state.get("pos_offset")
+            if off is None:
+                off = jnp.zeros((), jnp.int32)
+            z = jnp.zeros((), off.dtype)
+            emb = jax.lax.dynamic_slice(
+                params["P"], (z, off), (params["P"].shape[0], t))
+            y = x + emb[None].astype(x.dtype)
+            new_state = {**state, "pos_offset": off + t}
+        else:
+            y = x + params["P"][None, :, :t].astype(x.dtype)
+            new_state = state
+        return _act.get(self.activation)(y), new_state
 
 
 @register_layer
@@ -746,11 +795,20 @@ class SelfAttentionLayer(FeedForwardLayerConf):
 
     Params: Wq/Wk/Wv/Wo [F,F] + bq/bk/bv/bo. `causal` masks the future
     (LM decoding); `n_heads` must divide n_out.
+
+    Streaming (rnn_time_step): set `cache_length` and the layer carries a
+    KV cache ("kv_k"/"kv_v"/"kv_pos") across calls — incremental decoding
+    attends each new token against the cached keys instead of re-running
+    the full context, the attention-era counterpart of the reference's
+    stored-state rnnTimeStep (MultiLayerNetwork.java rnnTimeStep).
     """
 
     n_heads: int = 4
     causal: bool = True
     block_size: int = 512
+    cache_length: int = 0
+
+    supports_streaming = True
 
     def output_type(self, it):
         if it.kind != "rnn":
@@ -775,7 +833,8 @@ class SelfAttentionLayer(FeedForwardLayerConf):
             p["b" + name] = jnp.zeros((n_out,), jnp.float32)
         return p, {}
 
-    def apply(self, params, x, state, *, train=False, rng=None, mask=None):
+    def apply(self, params, x, state, *, train=False, rng=None, mask=None,
+              stream=False):
         from deeplearning4j_tpu.parallel.sequence import blockwise_attention
         x = self.maybe_dropout_input(x, train, rng)
         n, f, t = x.shape
@@ -788,14 +847,55 @@ class SelfAttentionLayer(FeedForwardLayerConf):
             return y.reshape(n, t, h, d).transpose(0, 2, 1, 3)  # [N,H,T,D]
 
         q, k, v = proj("q"), proj("k"), proj("v")
-        # variable-length batches: mask KEYS with -inf score bias (zeroed
-        # K/V would still receive softmax mass)
-        o = blockwise_attention(q, k, v, causal=self.causal,
-                                block_size=self.block_size, key_mask=mask)
+        if stream:
+            o, state = self._stream_attend(q, k, v, state)
+        else:
+            # variable-length batches: mask KEYS with -inf score bias
+            # (zeroed K/V would still receive softmax mass)
+            o = blockwise_attention(q, k, v, causal=self.causal,
+                                    block_size=self.block_size,
+                                    key_mask=mask)
         o = o.transpose(0, 2, 1, 3).reshape(n, t, self.n_out)
         o = o @ params["Wo"] + params["bo"]
         y = jnp.transpose(o, (0, 2, 1))                     # [N,F,T]
         return _act.get(self.activation)(y), state
+
+    def _stream_attend(self, q, k, v, state):
+        """Incremental decode: append k/v to the carried cache, attend q
+        against it. Positions past cache_length are a caller error (the
+        dynamic_update_slice would clamp) — size cache_length to the max
+        generation length."""
+        if self.cache_length <= 0:
+            raise ValueError(
+                "SelfAttentionLayer streaming needs cache_length > 0")
+        if not self.causal:
+            raise ValueError("streaming decode requires causal=True")
+        n, h, t, d = q.shape
+        L = self.cache_length
+        kc = state.get("kv_k")
+        if kc is None:
+            kc = jnp.zeros((n, h, L, d), q.dtype)
+            vc = jnp.zeros((n, h, L, d), q.dtype)
+            pos = jnp.zeros((), jnp.int32)
+        else:
+            vc, pos = state["kv_v"], state["kv_pos"]
+        z = jnp.zeros((), pos.dtype)
+        kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
+                                          (z, z, pos, z))
+        vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
+                                          (z, z, pos, z))
+        scale = 1.0 / np.sqrt(d)
+        s = jnp.einsum("nhtd,nhld->nhtl", q.astype(jnp.float32),
+                       kc.astype(jnp.float32)) * scale
+        # query at absolute position pos+i sees cache slots <= pos+i
+        k_idx = jnp.arange(L)
+        q_pos = pos + jnp.arange(t)
+        valid = k_idx[None, :] <= q_pos[:, None]            # [T, L]
+        s = jnp.where(valid[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("nhtl,nhld->nhtd", p,
+                       vc.astype(jnp.float32)).astype(q.dtype)
+        return o, {**state, "kv_k": kc, "kv_v": vc, "kv_pos": pos + t}
 
 
 @register_layer

@@ -21,7 +21,9 @@ from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
 from deeplearning4j_tpu.nn.conf.graph_conf import LayerVertex
 from deeplearning4j_tpu.nn.conf.inputs import InputType
-from deeplearning4j_tpu.nn.conf.layers import BaseOutputLayerConf, CenterLossOutputLayer
+from deeplearning4j_tpu.nn.conf.layers import (
+    STREAM_STATE_KEYS, BaseOutputLayerConf, CenterLossOutputLayer,
+    check_stream_budget)
 from deeplearning4j_tpu.nn.conf.network import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.updater import normalize_gradients
 
@@ -99,7 +101,7 @@ class ComputationGraph:
     # ------------------------------------------------------------------
     def _forward(self, params, state, inputs: Dict[str, Any], *, train, rng,
                  fmasks: Optional[Dict[str, Any]] = None, carry_rnn=False,
-                 preout_of=None):
+                 stream=False, preout_of=None):
         """Topo-order forward (ref: feedForward :1361). Returns
         (vertex_activations dict, new_state, masks dict). `preout_of` is a
         vertex name or a collection of names whose output layers should
@@ -119,7 +121,8 @@ class ComputationGraph:
             mask = next((m for m in in_masks if m is not None), None)
             v_state = state.get(name, {})
             if not carry_rnn:
-                v_state = {k: val for k, val in v_state.items() if k not in ("h", "c")}
+                v_state = {k: val for k, val in v_state.items()
+                           if k not in STREAM_STATE_KEYS}
             rng_i = jax.random.fold_in(rng, i) if rng is not None else None
             if name in preout_set and isinstance(v, LayerVertex) and \
                     hasattr(v.layer, "compute_score"):
@@ -130,8 +133,12 @@ class ComputationGraph:
                                             train=train, rng=rng_i)
                 new_state[name] = v_state
             else:
+                # stream (inference KV-cache decode) is distinct from
+                # carry_rnn (tbptt h/c carry)
+                extra = ({"stream": stream}
+                         if getattr(v, "supports_streaming", False) else {})
                 y, s_new = v.apply(params[name], xs, v_state, train=train,
-                                   rng=rng_i, mask=mask)
+                                   rng=rng_i, mask=mask, **extra)
                 acts[name] = y
                 new_state[name] = s_new
             masks[name] = v.output_mask(in_masks, self._vertex_input_types[name])
@@ -335,7 +342,8 @@ class ComputationGraph:
             def fwd(params, state, ins, rng):
                 acts, new_state, _ = self._forward(params, state, ins,
                                                    train=False, rng=rng,
-                                                   carry_rnn=True)
+                                                   carry_rnn=True,
+                                                   stream=True)
                 return [acts[o] for o in self.conf.network_outputs], new_state
 
             self._jit_cache[key] = jax.jit(fwd)
@@ -343,6 +351,10 @@ class ComputationGraph:
             ins = self._as_input_dict(inputs[0])
         else:
             ins = self._as_input_dict(list(inputs))
+        check_stream_budget(
+            self, next(iter(ins.values())).shape[-1],
+            [v.layer for v in self.conf.vertices.values()
+             if getattr(v, "layer", None) is not None])
         outs, new_state = self._jit_cache[key](self.params, self.state, ins,
                                                jax.random.PRNGKey(0))
         self.state = new_state
@@ -350,10 +362,11 @@ class ComputationGraph:
 
     def rnn_clear_previous_state(self):
         """ref: ComputationGraph.rnnClearPreviousState."""
+        self._stream_pos = 0
         for k, s in self.state.items():
             if isinstance(s, dict):
                 self.state[k] = {kk: vv for kk, vv in s.items()
-                                 if kk not in ("h", "c")}
+                                 if kk not in STREAM_STATE_KEYS}
 
     def summary(self) -> str:
         self._infer_types()

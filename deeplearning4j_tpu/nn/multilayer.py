@@ -28,6 +28,8 @@ import numpy as np
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator, DataSetIterator
 from deeplearning4j_tpu.nn.conf.layers import (
+    STREAM_STATE_KEYS,
+    check_stream_budget,
     AutoEncoder,
     BaseOutputLayerConf,
     CenterLossOutputLayer,
@@ -104,7 +106,8 @@ class MultiLayerNetwork:
     # forward
     # ------------------------------------------------------------------
     def _forward(self, params, state, x, *, train, rng, fmask=None,
-                 carry_rnn=False, upto: Optional[int] = None):
+                 carry_rnn=False, stream=False,
+                 upto: Optional[int] = None):
         """Pure forward pass. Returns (activation_list, new_state).
 
         activation_list[i] is the OUTPUT of layer i (post preprocessor+layer).
@@ -123,7 +126,8 @@ class MultiLayerNetwork:
                 mask = pre.output_mask(mask, its[i])
             li_state = state.get(str(i), {})
             if not carry_rnn:
-                li_state = {k: v for k, v in li_state.items() if k not in ("h", "c")}
+                li_state = {k: v for k, v in li_state.items()
+                            if k not in STREAM_STATE_KEYS}
             rng_i = None
             if rng is not None:
                 rng_i = jax.random.fold_in(rng, i)
@@ -133,8 +137,13 @@ class MultiLayerNetwork:
                     isinstance(p_i, dict):
                 p_i = wn.apply_to_params(
                     p_i, jax.random.fold_in(rng_i, 987))
+            # stream (inference KV-cache decode) is distinct from
+            # carry_rnn (tbptt h/c carry during training): tbptt trains
+            # attention full-context per chunk
+            extra = ({"stream": stream}
+                     if getattr(layer, "supports_streaming", False) else {})
             h, s_new = layer.apply(p_i, h, li_state, train=train,
-                                   rng=rng_i, mask=mask)
+                                   rng=rng_i, mask=mask, **extra)
             mask = layer.output_mask(mask, its[i])
             new_state[str(i)] = s_new
             acts.append(h)
@@ -227,13 +236,15 @@ class MultiLayerNetwork:
             self._jit_cache[key] = jax.jit(step, donate_argnums=(0, 2))
         return self._jit_cache[key]
 
-    def _get_output_fn(self, train: bool, carry_rnn: bool):
-        key = ("out", train, carry_rnn)
+    def _get_output_fn(self, train: bool, carry_rnn: bool,
+                       stream: bool = False):
+        key = ("out", train, carry_rnn, stream)
         if key not in self._jit_cache:
             def fwd(params, state, x, rng, fmask):
                 acts, new_state = self._forward(params, state, x, train=train,
                                                 rng=rng, fmask=fmask,
-                                                carry_rnn=carry_rnn)
+                                                carry_rnn=carry_rnn,
+                                                stream=stream)
                 return acts[-1], new_state
 
             self._jit_cache[key] = jax.jit(fwd)
@@ -379,16 +390,21 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     def rnn_time_step(self, x):
         """Stateful streaming inference: feeds one (or more) timesteps,
-        carrying h/c across calls (ref: rnnTimeStep)."""
-        fn = self._get_output_fn(False, True)
-        out, new_state = fn(self.params, self.state, jnp.asarray(x),
+        carrying h/c (and attention KV caches) across calls
+        (ref: rnnTimeStep)."""
+        x = jnp.asarray(x)
+        check_stream_budget(self, x.shape[-1], self.layers)
+        fn = self._get_output_fn(False, True, stream=True)
+        out, new_state = fn(self.params, self.state, x,
                             jax.random.PRNGKey(0), None)
         self.state = new_state
         return out
 
     def rnn_clear_previous_state(self):
+        self._stream_pos = 0
         for k, s in self.state.items():
-            self.state[k] = {kk: vv for kk, vv in s.items() if kk not in ("h", "c")}
+            self.state[k] = {kk: vv for kk, vv in s.items()
+                             if kk not in STREAM_STATE_KEYS}
 
     # ------------------------------------------------------------------
     # layerwise pretraining (ref: MultiLayerNetwork.pretrain :220)

@@ -27,6 +27,14 @@ from deeplearning4j_tpu.nn.updater import Adam
 from deeplearning4j_tpu.zoo.base import ZooModel, register_model
 
 
+def _draw(probs, temperature: float, rng: np.random.Generator) -> int:
+    """Temperature-sample one token id from a softmax distribution."""
+    logits = np.log(np.clip(probs, 1e-9, None)) / temperature
+    p = np.exp(logits - logits.max())
+    p /= p.sum()
+    return int(rng.choice(len(p), p=p))
+
+
 @register_model
 class TextGenerationTransformer(ZooModel):
     def __init__(self, vocab_size: int = 128, seed: int = 12345,
@@ -66,8 +74,8 @@ class TextGenerationTransformer(ZooModel):
             g.add_layer(f"ln{i}a", LayerNormalization(), prev)
             g.add_layer(f"attn{i}", SelfAttentionLayer(
                 n_out=E, n_heads=self.n_heads, causal=True,
-                block_size=self.block_size, activation="identity"),
-                f"ln{i}a")
+                block_size=self.block_size, activation="identity",
+                cache_length=self.max_length), f"ln{i}a")
             g.add_vertex(f"res{i}a", ElementWiseVertex(op="add"),
                          prev, f"attn{i}")
             g.add_layer(f"ln{i}b", LayerNormalization(), f"res{i}a")
@@ -106,10 +114,39 @@ class TextGenerationTransformer(ZooModel):
             out = net.output(x)
             probs = np.asarray(out[0] if isinstance(out, (list, tuple))
                                else out)[0, :, pos]
-            logits = np.log(np.clip(probs, 1e-9, None)) / temperature
-            p = np.exp(logits - logits.max())
-            p /= p.sum()
-            nxt = int(rng.choice(V, p=p))
+            nxt = _draw(probs, temperature, rng)
             ids.append(nxt)
             x[0, nxt, len(ids) - 1] = 1.0
+        return ids
+
+    def sample_stream(self, net, seed_ids, steps: int,
+                      vocab_size: int = None,
+                      rng: np.random.Generator = None,
+                      temperature: float = 1.0):
+        """KV-cache incremental decoding via the streaming rnn_time_step
+        state machinery (the attention-era rnnTimeStep): the seed primes
+        the caches in one call, then each new token is a single-position
+        forward against the cached keys — O(steps) instead of the padded
+        full-forward-per-token of `sample`. Identical distribution
+        (tests/test_transformer.py asserts streaming == full logits)."""
+        V = vocab_size or self.vocab_size
+        rng = rng or np.random.default_rng(0)
+        ids = list(seed_ids)
+        net.rnn_clear_previous_state()
+
+        def one_hot(seq):
+            x = np.zeros((1, V, len(seq)), np.float32)
+            x[0, seq, np.arange(len(seq))] = 1.0
+            return x
+
+        out = net.rnn_time_step(one_hot(ids))     # prime the KV caches
+        for i in range(steps):
+            if len(ids) >= self.max_length:
+                break
+            probs = np.asarray(out[0] if isinstance(out, (list, tuple))
+                               else out)[0, :, -1]
+            nxt = _draw(probs, temperature, rng)
+            ids.append(nxt)
+            if i + 1 < steps and len(ids) < self.max_length:
+                out = net.rnn_time_step(one_hot([nxt]))  # single-token step
         return ids

@@ -210,3 +210,116 @@ class TestTransformerSerde:
         after = np.asarray(out2[0] if isinstance(out2, (list, tuple))
                            else out2)
         np.testing.assert_allclose(before, after, atol=1e-6)
+
+
+class TestStreamingDecode:
+    """KV-cache incremental decoding (rnn_time_step) == full forward.
+
+    The attention-era analog of the reference's rnnTimeStep streaming
+    equivalence (MultiLayerNetwork.rnnTimeStep: streamed outputs match the
+    full-sequence forward at every position)."""
+
+    def _net(self):
+        model = TextGenerationTransformer(vocab_size=12, embed_dim=16,
+                                          n_heads=2, n_layers=2,
+                                          max_length=16)
+        return model, model.init()
+
+    def test_streaming_matches_full_forward(self):
+        model, net = self._net()
+        V, T = 12, 10
+        ids = RNG.integers(0, V, T)
+        x = np.zeros((1, V, T), np.float32)
+        x[0, ids, np.arange(T)] = 1.0
+        out = net.output(x)
+        full = np.asarray(out[0] if isinstance(out, (list, tuple)) else out)
+
+        def one_hot(seq):
+            h = np.zeros((1, V, len(seq)), np.float32)
+            h[0, seq, np.arange(len(seq))] = 1.0
+            return h
+
+        # prime with the first 4 tokens, then stream one at a time
+        net.rnn_clear_previous_state()
+        got = np.asarray(net.rnn_time_step(one_hot(ids[:4])))
+        np.testing.assert_allclose(got[0], full[0, :, :4], atol=1e-4)
+        for t in range(4, T):
+            got = np.asarray(net.rnn_time_step(one_hot(ids[t:t + 1])))
+            np.testing.assert_allclose(got[0, :, 0], full[0, :, t],
+                                       atol=1e-4,
+                                       err_msg=f"position {t}")
+
+    def test_clear_state_resets(self):
+        model, net = self._net()
+        V = 12
+        x = np.zeros((1, V, 3), np.float32)
+        x[0, [1, 2, 3], np.arange(3)] = 1.0
+        a = np.asarray(net.rnn_time_step(x))
+        net.rnn_clear_previous_state()
+        b = np.asarray(net.rnn_time_step(x))
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+    def test_sample_stream_runs(self):
+        model, net = self._net()
+        ids = model.sample_stream(net, [1, 2, 3], steps=5)
+        assert len(ids) == 8
+        assert all(0 <= i < 12 for i in ids)
+
+    def test_streaming_state_stripped_from_training(self):
+        """A training step after streaming must not see the KV cache."""
+        model, net = self._net()
+        V = 12
+        x = np.zeros((1, V, 3), np.float32)
+        x[0, [1, 2, 3], np.arange(3)] = 1.0
+        net.rnn_time_step(x)
+        assert any("kv_k" in s for s in net.state.values()
+                   if isinstance(s, dict))
+        y = np.roll(x, -1, axis=2)
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        net.fit(DataSet(x, y))           # must not raise / use the cache
+        net.rnn_clear_previous_state()
+        assert not any("kv_k" in s for s in net.state.values()
+                       if isinstance(s, dict))
+
+    def test_stream_budget_guard(self):
+        """Streaming past cache_length must raise host-side (the device
+        dynamic_update_slice would silently clamp)."""
+        import pytest
+        model = TextGenerationTransformer(vocab_size=8, embed_dim=16,
+                                          n_heads=2, n_layers=1,
+                                          max_length=4)
+        net = model.init()
+        x = np.zeros((1, 8, 2), np.float32)
+        x[0, [1, 2], np.arange(2)] = 1.0
+        net.rnn_time_step(x)
+        net.rnn_time_step(x)                      # exactly at capacity
+        with pytest.raises(ValueError, match="streaming capacity"):
+            net.rnn_time_step(x)
+        net.rnn_clear_previous_state()
+        net.rnn_time_step(x)                      # counter reset
+
+    def test_tbptt_with_attention_trains(self):
+        """carry_rnn (tbptt) must NOT enter the streaming decode path:
+        a MultiLayerNetwork with attention + tbptt trains full-context
+        per chunk (cache_length unset)."""
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (
+            RnnOutputLayer, SelfAttentionLayer,
+        )
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        conf = (NeuralNetConfiguration.Builder().seed(0).list()
+                .layer(SelfAttentionLayer(n_in=8, n_out=8, n_heads=2,
+                                          causal=True))
+                .layer(RnnOutputLayer(n_in=8, n_out=3, loss="mcxent",
+                                      activation="softmax"))
+                .set_input_type(InputType.recurrent(8, 12))
+                .tbptt(4, 4)
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = RNG.standard_normal((2, 8, 12)).astype(np.float32)
+        y = np.zeros((2, 3, 12), np.float32)
+        y[:, 0, :] = 1.0
+        net.fit(DataSet(x, y))
+        assert np.isfinite(net.score_value)
