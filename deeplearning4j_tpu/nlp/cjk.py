@@ -89,7 +89,10 @@ class ChineseTokenizerFactory(TokenizerFactory):
     With a dictionary: minimum-cost lattice segmentation (ansj/jieba
     algorithm); pass ``frequencies={word: count}`` to weight the path by
     corpus statistics, or a plain word iterable for uniform costs.
-    ``engine="fmm"`` selects greedy forward maximum match instead.
+    ``dictionary="builtin"`` loads the embedded core-vocabulary
+    frequency dictionary (nlp/cjk_data.py — the small-footprint stand-in
+    for the reference's bundled ansj tables). ``engine="fmm"`` selects
+    greedy forward maximum match instead.
     Without a dictionary: single characters (``bigrams=True`` adds
     overlapping bigrams, a strong baseline for embedding training).
     """
@@ -102,6 +105,15 @@ class ChineseTokenizerFactory(TokenizerFactory):
         # grew this round, and positional binding against the old order
         # would silently misassign
         super().__init__(preprocessor)
+        if isinstance(dictionary, str):
+            if dictionary != "builtin":
+                raise ValueError(
+                    f"unknown dictionary {dictionary!r} (only the "
+                    "\"builtin\" sentinel is accepted as a string; for a "
+                    "dictionary file use load_user_dictionary)")
+            from deeplearning4j_tpu.nlp.cjk_data import ZH_FREQ
+            dictionary = None
+            frequencies = {**ZH_FREQ, **(frequencies or {})}
         if frequencies:
             freqs = {w: (f[0] if isinstance(f, tuple) else f)
                      for w, f in frequencies.items()}
@@ -172,15 +184,30 @@ class JapaneseTokenizerFactory(TokenizerFactory):
     """ref: deeplearning4j-nlp-japanese (kuromoji fork). With a
     dictionary ({word: cost | (freq, pos)} or word iterable): kuromoji's
     lattice algorithm — dictionary edges + unknown edges grouped by
-    character class, minimum-cost Viterbi path. Without one: segmentation
-    at character-class boundaries (kanji / hiragana / katakana / latin /
+    character class, minimum-cost Viterbi path.
+    ``dictionary="builtin"`` loads the embedded core vocabulary
+    (nlp/cjk_data.py, (freq, POS) entries — the small-footprint stand-in
+    for the bundled IPADIC data). Without one: segmentation at
+    character-class boundaries (kanji / hiragana / katakana / latin /
     digit runs)."""
 
     def __init__(self, preprocessor=None, split_kanji_chars: bool = False,
-                 dictionary=None):
+                 dictionary=None, user_entries: Optional[dict] = None):
         super().__init__(preprocessor)
         self.split_kanji_chars = split_kanji_chars
         self._lattice = None
+        if isinstance(dictionary, str):
+            if dictionary != "builtin":
+                raise ValueError(
+                    f"unknown dictionary {dictionary!r} (only the "
+                    "\"builtin\" sentinel is accepted as a string; for a "
+                    "dictionary file use load_user_dictionary)")
+            from deeplearning4j_tpu.nlp.cjk_data import JA_ENTRIES
+            dictionary = dict(JA_ENTRIES)
+        if user_entries:  # domain terms layered over the dictionary
+            if dictionary and not isinstance(dictionary, dict):
+                dictionary = {w: 4.0 for w in dictionary}
+            dictionary = {**(dictionary or {}), **user_entries}
         if dictionary:
             if isinstance(dictionary, dict):
                 tuples = {w: v for w, v in dictionary.items()

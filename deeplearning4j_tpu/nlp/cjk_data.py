@@ -1,0 +1,87 @@
+"""Compact built-in CJK dictionaries for the lattice tokenizers.
+
+The reference's CJK analyzers ship multi-megabyte system dictionaries
+(deeplearning4j-nlp-japanese bundles the kuromoji/IPADIC data,
+deeplearning4j-nlp-chinese the ansj/jieba tables) — most of their 19.6k
+LoC + resources is dictionary data. This module is the zero-egress
+counterpart: a hand-curated core-vocabulary dictionary (~700 Chinese
+words with relative frequencies, ~350 Japanese entries with POS) that
+makes `ChineseTokenizerFactory(dictionary="builtin")` /
+`JapaneseTokenizerFactory(dictionary="builtin")` segment everyday text
+sensibly out of the box. It is deliberately small: domain text should
+add `load_user_dictionary` entries on top (jieba-style lines), exactly
+as the reference's user-dictionary mechanism works.
+
+Frequencies are rank-bucketed relative weights (only ratios matter —
+dict_from_frequencies converts to -log(p) costs), ordered by the
+well-known frequency structure of modern Chinese/Japanese corpora.
+"""
+
+# --- Chinese: word -> relative frequency -------------------------------
+
+_ZH_BUCKETS = (
+    # function words / pronouns (highest band)
+    (50000, "的 是 不 了 在 有 我 他 这 个 们 中 来 上 大 为 和 国 地 到"),
+    (30000, "你 她 它 我们 他们 你们 就 说 要 也 都 而 去 能 会 着 没有 看 好 自己"),
+    (20000, "这个 那个 什么 一个 没 很 再 可以 因为 所以 但是 如果 虽然 还是 或者 而且 然后 现在 已经 还"),
+    # common verbs
+    (12000, "知道 觉得 认为 希望 喜欢 开始 成为 进行 出现 发现 使用 需要 应该 可能 表示 通过 作为 得到 发展 工作"),
+    (9000, "学习 生活 研究 生产 管理 服务 建设 活动 经济 问题 时候 时间 地方 今天 明天 昨天 每天 以后 以前 之间"),
+    # common nouns
+    (7000, "中国 北京 上海 美国 日本 世界 国家 人民 政府 社会 历史 文化 教育 科学 技术 信息 系统 公司 市场 银行"),
+    (5000, "大学 学校 学生 老师 先生 朋友 孩子 父母 家庭 城市 农村 电话 电脑 网络 汽车 火车 飞机 医院 医生 音乐"),
+    (4000, "东西 事情 方面 方法 结果 原因 情况 条件 关系 内容 标准 水平 能力 机会 力量 影响 作用 意义 目的 过程"),
+    # segmentation classics + frequent bigrams
+    (3000, "研究生 生命 起源 天安门 长城 电影 电视 新闻 报纸 杂志 小说 故事 节目 比赛 运动 足球 篮球 游戏 旅游 天气"),
+    (2500, "春天 夏天 秋天 冬天 早上 上午 中午 下午 晚上 星期 月份 年代 世纪 小时 分钟 左右 前面 后面 里面 外面"),
+    (2000, "非常 特别 十分 比较 更加 越来越 几乎 差不多 大概 也许 当然 一定 必须 只有 只要 无论 即使 尽管 不过 否则"),
+    (1500, "高兴 快乐 幸福 难过 生气 担心 害怕 奇怪 重要 容易 困难 简单 复杂 漂亮 美丽 干净 安静 热闹 方便 舒服"),
+    (1200, "吃饭 喝水 睡觉 起床 上班 下班 上课 下课 回家 出门 买东西 做饭 洗澡 跑步 走路 说话 唱歌 跳舞 画画 写字"),
+    (1000, "经过 根据 关于 对于 由于 为了 按照 随着 除了 以及 并且 甚至 尤其 例如 比如 总之 另外 同时 首先 最后"),
+    (800, "增加 减少 提高 降低 改变 改革 开放 发达 先进 落后 成功 失败 胜利 解决 决定 选择 准备 参加 组织 举行"),
+    (600, "数学 物理 化学 生物 语文 英语 汉语 外语 历史课 地理 体育 艺术 哲学 法律 政治 军事 宗教 环境 资源 能源"),
+    (500, "苹果 香蕉 西瓜 牛奶 面包 米饭 面条 饺子 茶叶 咖啡 啤酒 蔬菜 水果 鸡蛋 牛肉 羊肉 鱼肉 糖果 蛋糕 早饭"),
+)
+
+ZH_FREQ = {}
+for _f, _words in _ZH_BUCKETS:
+    for _w in _words.split():
+        ZH_FREQ.setdefault(_w, _f)
+
+# --- Japanese: word -> (relative frequency, POS) -----------------------
+
+_JA_BUCKETS = (
+    # particles (highest band — the backbone of the lattice)
+    (50000, "助詞", "の は が を に で と も へ や か ね よ から まで など しか だけ ほど より って"),
+    # copula / auxiliaries / frequent verb endings
+    (30000, "助動詞", "です ます でした ました ません でしょう だ である だった ない なかった たい たく れる られる せる させる"),
+    # frequent verbs (dictionary + common conjugated surfaces)
+    (15000, "動詞",
+     "する した して します しました いる いた いて います ある あった あり なる なった なって なります"),
+    (10000, "動詞",
+     "行く 行った 行きます 来る 来た 来ます 見る 見た 見ます 言う 言った 思う 思った 思います 分かる 分かった 知る 知って 食べる 食べた 飲む 読む 書く 聞く 話す 使う 作る 買う 持つ 待つ 会う 帰る 出る 入る 住む 働く 学ぶ 遊ぶ 泳ぐ 歩く 走る 休む 始まる 終わる できる"),
+    # pronouns / demonstratives / adverbs
+    (12000, "代名詞", "これ それ あれ どれ ここ そこ あそこ どこ この その あの どの 私 僕 君 彼 彼女 誰 何"),
+    (8000, "副詞", "とても もっと すこし 少し たくさん よく もう まだ また すぐ いつも 今日 明日 昨日 今 毎日 時々 全然 多分 本当に 一緒に"),
+    # common nouns
+    (7000, "名詞",
+     "日本 東京 大阪 京都 中国 アメリカ 世界 国 人 方 時 年 月 日 時間 今年 去年 来年 午前 午後"),
+    (5000, "名詞",
+     "学生 先生 学校 大学 会社 仕事 電車 駅 車 家 部屋 店 料理 水 お金 映画 音楽 写真 電話 手紙"),
+    (4000, "名詞",
+     "友達 家族 父 母 子供 男 女 犬 猫 山 川 海 空 雨 雪 風 花 木 本 言葉"),
+    (3000, "名詞",
+     "問題 質問 答え 意味 名前 気持ち 天気 気温 朝ご飯 昼ご飯 晩ご飯 朝 昼 夜 週末 旅行 買い物 勉強 練習 試験"),
+    # i-adjectives / na-adjectives
+    (4000, "形容詞",
+     "いい 良い 悪い 大きい 小さい 高い 安い 新しい 古い 長い 短い 早い 遅い 近い 遠い 暑い 寒い 楽しい 面白い 難しい 易しい 美味しい 忙しい 嬉しい 悲しい"),
+    (3000, "形容動詞", "元気 静か 有名 便利 大変 大切 簡単 綺麗 親切 丁寧 好き 嫌い 上手 下手 必要"),
+    # katakana loanwords
+    (3000, "名詞",
+     "コーヒー テレビ パソコン スマホ インターネット ニュース ホテル レストラン バス タクシー カメラ ゲーム スポーツ サッカー テニス"),
+)
+
+JA_ENTRIES = {}
+for _f, _pos, _words in _JA_BUCKETS:
+    for _w in _words.split():
+        JA_ENTRIES.setdefault(_w, (_f, _pos))

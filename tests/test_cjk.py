@@ -119,3 +119,56 @@ class TestLattice:
         t = Trie([("ab", 1), ("abc", 2), ("b", 3)])
         assert list(t.prefixes("abcd")) == [(2, 1), (3, 2)]
         assert "ab" in t and "abc" in t and "a" not in t
+
+
+class TestBuiltinDictionaries:
+    """The embedded core-vocabulary dictionaries (nlp/cjk_data.py) — the
+    zero-egress stand-in for the reference's bundled ansj/IPADIC data."""
+
+    def test_chinese_builtin_segments_common_text(self):
+        tf = ChineseTokenizerFactory(dictionary="builtin")
+        toks = tf.create("我们喜欢北京的文化").get_tokens()
+        assert "我们" in toks and "喜欢" in toks and "北京" in toks \
+            and "文化" in toks
+
+    def test_chinese_builtin_ambiguity(self):
+        # the classic: 研究生命起源 = 研究 / 生命 / 起源 (greedy FMM would
+        # wrongly take 研究生)
+        tf = ChineseTokenizerFactory(dictionary="builtin")
+        assert tf.create("研究生命起源").get_tokens() == ["研究", "生命",
+                                                          "起源"]
+
+    def test_chinese_builtin_user_words_extend(self):
+        tf = ChineseTokenizerFactory(dictionary="builtin",
+                                     frequencies={"深度学习": 5000})
+        assert "深度学习" in tf.create("我们研究深度学习").get_tokens()
+
+    def test_japanese_builtin_particles(self):
+        tf = JapaneseTokenizerFactory(dictionary="builtin")
+        toks = tf.create("私は学校に行きます").get_tokens()
+        assert toks == ["私", "は", "学校", "に", "行きます"]
+
+    def test_japanese_builtin_copula(self):
+        tf = JapaneseTokenizerFactory(dictionary="builtin")
+        toks = tf.create("これは本です").get_tokens()
+        assert toks == ["これ", "は", "本", "です"]
+
+    def test_japanese_builtin_user_entries(self):
+        tf = JapaneseTokenizerFactory(dictionary="builtin",
+                                      user_entries={"人工知能": (4000,
+                                                                "名詞")})
+        toks = tf.create("人工知能は面白い").get_tokens()
+        assert toks[0] == "人工知能"
+
+    def test_unknown_dictionary_string_rejected(self):
+        import pytest
+        with pytest.raises(ValueError, match="builtin"):
+            ChineseTokenizerFactory(dictionary="biultin")
+        with pytest.raises(ValueError, match="builtin"):
+            JapaneseTokenizerFactory(dictionary="/some/path.dic")
+
+    def test_japanese_builtin_unknown_words_grouped(self):
+        # an OOV katakana word must come out as one grouped unknown token
+        tf = JapaneseTokenizerFactory(dictionary="builtin")
+        toks = tf.create("ブロックチェーンは面白い").get_tokens()
+        assert toks[0] == "ブロックチェーン"
