@@ -301,14 +301,49 @@ def bench_scaling():
                       "value": round(B * 10 / dt, 1), "unit": "images/sec"}))
 
 
+def bench_word2vec():
+    """Word2Vec skip-gram/NS embedding training throughput (words/sec):
+    host pair-gen + batched device scatter-add steps (the reference's
+    multithreaded SequenceVectors engine role)."""
+    import string
+
+    import numpy as np
+
+    from deeplearning4j_tpu.nlp.sentence import CollectionSentenceIterator
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    rng = np.random.default_rng(0)
+    letters = np.array(list(string.ascii_lowercase))
+    vocab = np.asarray(["".join(rng.choice(letters, 6))
+                        for _ in range(20000)])
+    probs = 1.0 / np.arange(1, len(vocab) + 1)
+    probs /= probs.sum()
+    sents = [" ".join(rng.choice(vocab, size=20, p=probs))
+             for _ in range(int(os.environ.get("BENCH_W2V_SENTS", "20000")))]
+    total_words = 20 * len(sents)
+    w2v = Word2Vec(sentence_iterator=CollectionSentenceIterator(sents),
+                   layer_size=128, window=5, min_word_frequency=1,
+                   iterations=1, epochs=1, negative=5, seed=1,
+                   batch_size=65536)  # collision clamp bounds per vocab
+    w2v.fit()        # warmup epoch: jit compiles + backend init
+    float(np.asarray(w2v.syn0[0, 0]))
+    t0 = time.perf_counter()
+    w2v.fit()
+    # scalar host fetch: dispatches are async, the queue must drain
+    float(np.asarray(w2v.syn0[0, 0]))
+    dt = time.perf_counter() - t0
+    print(json.dumps({"metric": "word2vec_train", "unit": "words/sec",
+                      "value": round(total_words / dt, 1)}))
+
+
 ALL = {"resnet": bench_resnet, "lstm": bench_lstm, "lenet": bench_lenet,
        "vgg16": bench_vgg16, "inception": bench_keras_inception,
        "attention": bench_attention, "transformer": bench_transformer,
-       "scaling": bench_scaling}
+       "scaling": bench_scaling, "word2vec": bench_word2vec}
 
 if __name__ == "__main__":
     names = sys.argv[1:] or ["resnet", "lstm", "lenet", "vgg16",
                              "inception", "attention", "transformer",
-                             "scaling"]
+                             "scaling", "word2vec"]
     for n in names:
         ALL[n]()

@@ -214,7 +214,7 @@ class SequenceVectors:
                  min_learning_rate: float = 1e-4,
                  negative: int = 0, sampling: float = 0.0,
                  min_word_frequency: int = 1, epochs: int = 1,
-                 iterations: int = 1, batch_size: int = 512,
+                 iterations: int = 1, batch_size: int = 4096,
                  elements_learning_algorithm: str = "skipgram",
                  use_hierarchic_softmax: Optional[bool] = None,
                  seed: int = 42, stop_words: Sequence[str] = (),
@@ -253,6 +253,13 @@ class SequenceVectors:
     def build_vocab(self, sequences: Iterable[Sequence[str]],
                     extra_labels: Sequence[str] = ()) -> None:
         """ref: SequenceVectors.buildVocab :108 via VocabConstructor."""
+        if not isinstance(sequences, list):
+            sequences = list(sequences)
+        if sequences and isinstance(sequences[0], str):
+            raise TypeError(
+                "build_vocab expects sequences of tokens (List[List[str]]);"
+                " got strings — tokenize first, or use Word2Vec with a "
+                "sentence_iterator/tokenizer_factory")
         ctor = VocabConstructor(self.min_word_frequency,
                                 stop_words=self.stop_words,
                                 build_huffman_tree=True,
@@ -325,6 +332,14 @@ class SequenceVectors:
         if self.vocab is None:
             raise RuntimeError("call build_vocab first")
         seqs = sequences if isinstance(sequences, list) else list(sequences)
+        if seqs and isinstance(seqs[0], str):
+            # a raw string would be iterated character-by-character and
+            # silently train a character vocab — Word2Vec tokenizes
+            # sentence strings; SequenceVectors wants token sequences
+            raise TypeError(
+                "SequenceVectors.fit expects sequences of tokens "
+                "(List[List[str]]); got strings — tokenize first, or use "
+                "Word2Vec with a sentence_iterator/tokenizer_factory")
         total_words = sum(len(s) for s in seqs) * max(1, self.epochs)
         words_seen = 0
         sg = self.algo == "skipgram"

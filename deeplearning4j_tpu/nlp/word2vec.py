@@ -28,15 +28,23 @@ class Word2Vec(SequenceVectors):
         self.sentence_iterator = sentence_iterator
         self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
 
+    def _tokenize(self, sentences: Iterable[str]) -> List[List[str]]:
+        return [self.tokenizer_factory.create(s).get_tokens()
+                for s in sentences]
+
     def _tokenized(self) -> List[List[str]]:
         if self.sentence_iterator is None:
             raise RuntimeError("no sentence iterator configured")
-        return [self.tokenizer_factory.create(s).get_tokens()
-                for s in self.sentence_iterator]
+        return self._tokenize(self.sentence_iterator)
 
     def fit(self, sequences: Optional[Iterable[Sequence[str]]] = None,
             **kwargs) -> "Word2Vec":
         seqs = list(sequences) if sequences is not None else self._tokenized()
+        if seqs and isinstance(seqs[0], str):
+            # sentence strings (or a SentenceIterator passed positionally):
+            # tokenize — iterating a string directly would silently train
+            # a character vocab
+            seqs = self._tokenize(seqs)
         if self.vocab is None:
             self.build_vocab(seqs)
         super().fit(seqs, **kwargs)

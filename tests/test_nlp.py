@@ -337,3 +337,22 @@ class TestDistributedGlove:
                   min_word_frequency=1, seed=1, mesh=mesh)
         g.fit([s.split() for s in corpus()])
         assert g.similarity("cat", "dog") > g.similarity("cat", "bread")
+
+
+class TestStringSequenceGuard:
+    """Raw sentence strings must not silently train a character vocab."""
+
+    def test_word2vec_tokenizes_string_sentences(self):
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+        w2v = Word2Vec(layer_size=8, min_word_frequency=1, epochs=1,
+                       negative=2, seed=3)
+        w2v.fit(["the cat sat", "the dog ran", "the cat ran"])
+        assert w2v.vocab.contains_word("cat")
+        assert not w2v.vocab.contains_word("c")
+
+    def test_sequencevectors_rejects_strings(self):
+        import pytest
+        from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
+        sv = SequenceVectors(min_word_frequency=1)
+        with pytest.raises(TypeError, match="tokenize"):
+            sv.build_vocab(["the cat sat"])
