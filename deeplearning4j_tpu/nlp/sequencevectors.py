@@ -15,6 +15,7 @@ stochastic objective.
 
 from __future__ import annotations
 
+import itertools
 import logging
 from functools import partial
 from typing import Dict, Iterable, List, Optional, Sequence
@@ -253,9 +254,16 @@ class SequenceVectors:
     def build_vocab(self, sequences: Iterable[Sequence[str]],
                     extra_labels: Sequence[str] = ()) -> None:
         """ref: SequenceVectors.buildVocab :108 via VocabConstructor."""
-        if not isinstance(sequences, list):
-            sequences = list(sequences)
-        if sequences and isinstance(sequences[0], str):
+        # type-check the FIRST element only, preserving streaming for
+        # generator corpora (VocabConstructor.build is single-pass)
+        if isinstance(sequences, (list, tuple)):
+            first = sequences[0] if sequences else None
+        else:
+            it = iter(sequences)
+            first = next(it, None)
+            sequences = itertools.chain([first], it) if first is not None \
+                else []
+        if isinstance(first, str):
             raise TypeError(
                 "build_vocab expects sequences of tokens (List[List[str]]);"
                 " got strings — tokenize first, or use Word2Vec with a "

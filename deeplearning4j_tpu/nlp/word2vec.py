@@ -37,14 +37,21 @@ class Word2Vec(SequenceVectors):
             raise RuntimeError("no sentence iterator configured")
         return self._tokenize(self.sentence_iterator)
 
-    def fit(self, sequences: Optional[Iterable[Sequence[str]]] = None,
-            **kwargs) -> "Word2Vec":
+    def _coerce(self, sequences) -> List[List[str]]:
+        """Accept token lists, sentence strings, or a SentenceIterator —
+        strings are tokenized (iterating one directly would silently
+        train a character vocab)."""
         seqs = list(sequences) if sequences is not None else self._tokenized()
         if seqs and isinstance(seqs[0], str):
-            # sentence strings (or a SentenceIterator passed positionally):
-            # tokenize — iterating a string directly would silently train
-            # a character vocab
             seqs = self._tokenize(seqs)
+        return seqs
+
+    def build_vocab(self, sequences=None, extra_labels=()) -> None:
+        super().build_vocab(self._coerce(sequences), extra_labels)
+
+    def fit(self, sequences: Optional[Iterable[Sequence[str]]] = None,
+            **kwargs) -> "Word2Vec":
+        seqs = self._coerce(sequences)
         if self.vocab is None:
             self.build_vocab(seqs)
         super().fit(seqs, **kwargs)

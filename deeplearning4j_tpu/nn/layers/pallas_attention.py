@@ -251,45 +251,17 @@ def _pad_t(x, bs):
     return x
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
-def _flash(q, k, v, key_mask, causal, bq, bk, first_pad, user_mask,
-           interpret):
-    o, _ = _flash_fwd(q, k, v, key_mask, causal, bq, bk, first_pad,
-                      user_mask, interpret)
-    return o
 
-
-def _flash_fwd(q, k, v, key_mask, causal, bq, bk, first_pad, user_mask,
-               interpret):
+def _run_bwd_kernels(q, k, v, key_mask, do, lse, d_eff, *, causal, bq, bk,
+                     first_pad, user_mask, interpret):
+    """The dq and dk/dv pallas calls shared by both VJPs. `d_eff` sits in
+    the delta slot: plain backward passes delta = rowsum(do*o); the
+    lse-differentiable variant passes delta - dlse. Query and key lengths
+    are independent (cross-/chunked attention)."""
     B, H, T, D = q.shape
+    Tk = k.shape[2]
     scale = float(1.0 / np.sqrt(D))
-    nq, nk = T // bq, T // bk
-    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               bq=bq, bk=bk, nk=nk, first_pad=first_pad,
-                               user_mask=user_mask)
-    o, lse = pl.pallas_call(
-        kernel,
-        grid=(B, H, nq, nk),
-        in_specs=[_qkv_spec(bq, D, 2), _qkv_spec(bk, D, 3),
-                  _qkv_spec(bk, D, 3), _km_spec(bk, 3)],
-        out_specs=[_qkv_spec(bq, D, 2), _row_spec(bq, 2)],
-        out_shape=[jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
-                   jax.ShapeDtypeStruct((B, H, T, 1), jnp.float32)],
-        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32),
-                        pltpu.VMEM((bq, 128), jnp.float32),
-                        pltpu.VMEM((bq, 128), jnp.float32)],
-        interpret=interpret,
-    )(q, k, v, key_mask)
-    return o, (q, k, v, key_mask, o, lse)
-
-
-def _flash_bwd(causal, bq, bk, first_pad, user_mask, interpret, res, do):
-    q, k, v, key_mask, o, lse = res
-    B, H, T, D = q.shape
-    scale = float(1.0 / np.sqrt(D))
-    nq, nk = T // bq, T // bk
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1, keepdims=True)                     # [B,H,T,1]
+    nq, nk = T // bq, Tk // bk
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
@@ -303,7 +275,7 @@ def _flash_bwd(causal, bq, bk, first_pad, user_mask, interpret, res, do):
         out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, key_mask, do, lse, delta)
+    )(q, k, v, key_mask, do, lse, d_eff)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
@@ -324,17 +296,119 @@ def _flash_bwd(causal, bq, bk, first_pad, user_mask, interpret, res, do):
             pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),
             pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),
         ],
-        out_shape=[jax.ShapeDtypeStruct((B, H, T, D), k.dtype),
-                   jax.ShapeDtypeStruct((B, H, T, D), v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((B, H, Tk, D), k.dtype),
+                   jax.ShapeDtypeStruct((B, H, Tk, D), v.dtype)],
         scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
                         pltpu.VMEM((bk, D), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, key_mask, do, lse, delta)
+    )(q, k, v, key_mask, do, lse, d_eff)
+    return dq, dk, dv
 
+
+def _flash_fwd(q, k, v, key_mask, causal, bq, bk, first_pad, user_mask,
+               interpret):
+    B, H, T, D = q.shape
+    scale = float(1.0 / np.sqrt(D))
+    nq, nk = T // bq, k.shape[2] // bk
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               bq=bq, bk=bk, nk=nk, first_pad=first_pad,
+                               user_mask=user_mask)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(B, H, nq, nk),
+        in_specs=[_qkv_spec(bq, D, 2), _qkv_spec(bk, D, 3),
+                  _qkv_spec(bk, D, 3), _km_spec(bk, 3)],
+        out_specs=[_qkv_spec(bq, D, 2), _row_spec(bq, 2)],
+        out_shape=[jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
+                   jax.ShapeDtypeStruct((B, H, T, 1), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32),
+                        pltpu.VMEM((bq, 128), jnp.float32),
+                        pltpu.VMEM((bq, 128), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, key_mask)
+    return o, (q, k, v, key_mask, o, lse)
+
+
+# -- (o, lse) variant: for cross-chunk combination (ring attention) --------
+#
+# Exposing the logsumexp differentiably costs one line of math:
+# d lse_i / d s_ij = p_ij, so the score cotangent becomes
+# ds = p * (dp - delta + dlse) = p * (dp - (delta - dlse)) — the existing
+# backward kernels run unchanged with d_eff = delta - dlse in the delta
+# slot (dv is independent of lse).
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_lse(q, k, v, key_mask, causal, bq, bk, first_pad, user_mask,
+               interpret):
+    (o, lse), _ = _flash_lse_fwd(q, k, v, key_mask, causal, bq, bk,
+                                 first_pad, user_mask, interpret)
+    return o, lse
+
+
+def _flash_lse_fwd(q, k, v, key_mask, causal, bq, bk, first_pad, user_mask,
+                   interpret):
+    o, res = _flash_fwd(q, k, v, key_mask, causal, bq, bk, first_pad,
+                        user_mask, interpret)
+    lse = res[-1]
+    return (o, lse), res
+
+
+def _flash_lse_bwd(causal, bq, bk, first_pad, user_mask, interpret, res,
+                   cotangents):
+    do, dlse = cotangents
+    q, k, v, key_mask, o, lse = res
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    d_eff = delta - dlse.astype(jnp.float32)
+    dq, dk, dv = _run_bwd_kernels(q, k, v, key_mask, do, lse, d_eff,
+                                  causal=causal, bq=bq, bk=bk,
+                                  first_pad=first_pad, user_mask=user_mask,
+                                  interpret=interpret)
     return dq, dk, dv, jnp.zeros_like(key_mask)
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_lse(q, k, v, causal: bool = False, key_mask=None,
+                        block_q: int = DEFAULT_BLOCK_Q,
+                        block_k: int = DEFAULT_BLOCK_K,
+                        interpret: bool = False):
+    """Like flash_attention but also returns the per-row logsumexp
+    [B,H,Tq] (fp32) — differentiable through both outputs, for combining
+    attention over KV chunks (ring attention: merge (o_i, lse_i) pairs
+    with the standard logaddexp rule)."""
+    q, k, v, km, bq, bk, first_pad, user_mask, Tq = _prep(
+        q, k, v, key_mask, causal, block_q, block_k)
+    o, lse = _flash_lse(q, k, v, km, causal, bq, bk, first_pad, user_mask,
+                        interpret)
+    return o[:, :, :Tq, :], lse[:, :, :Tq, 0]
+
+
+def _prep(q, k, v, key_mask, causal, block_q, block_k):
+    """Pad q to a block_q multiple and k/v to a block_k multiple
+    (independently — Tq need not equal Tk for non-causal / chunked use),
+    build the padded-key mask, and pick tile-aligned block sizes."""
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    if causal and Tq != Tk:
+        raise ValueError("causal flash attention needs Tq == Tk "
+                         f"(got {Tq} vs {Tk})")
+    bq = int(min(block_q, ((Tq + 127) // 128) * 128))
+    bk = int(min(block_k, ((Tk + 127) // 128) * 128))
+    q = _pad_t(q, bq)
+    k, v = _pad_t(k, bk), _pad_t(v, bk)
+    Tkp = k.shape[2]
+    first_pad = (Tk // bk) if Tkp != Tk else None
+    user_mask = key_mask is not None
+    if key_mask is None:
+        km = (jnp.arange(Tkp) < Tk).astype(jnp.float32)[None, None, :]
+        km = jnp.broadcast_to(km, (B, 1, Tkp))
+    else:
+        km = key_mask.astype(jnp.float32)[:, None, :]
+        km = jnp.pad(km, ((0, 0), (0, 0), (0, Tkp - km.shape[2])))
+    return q, k, v, km, bq, bk, first_pad, user_mask, Tq
 
 
 def flash_attention_supported(q_shape: Tuple[int, ...],
@@ -352,31 +426,17 @@ def flash_attention(q, k, v, causal: bool = False, key_mask=None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
                     interpret: bool = False):
-    """Fused flash attention. q,k,v: [B,H,T,D]; key_mask: [B,T] (1=valid).
+    """Fused flash attention. q: [B,H,Tq,D]; k,v: [B,H,Tk,D]; key_mask:
+    [B,Tk] (1=valid). Tq and Tk may differ (cross-/chunked attention)
+    except under causal, which requires aligned lengths.
 
-    T is padded internally to a block multiple (padded keys masked out,
-    padded query rows sliced off). Differentiable via the recompute-form
-    custom VJP. Use `interpret=True` on CPU (tests)."""
-    B, H, T, D = q.shape
-    # blocks stay sublane/lane-tile aligned (multiples of 128) even for
-    # short sequences — T is padded up to the block grid below
-    t128 = ((T + 127) // 128) * 128
-    bq = int(min(block_q, t128))
-    bk = int(min(block_k, t128))
-    # pad to a common multiple so both block sizes tile the padded length
-    L = int(np.lcm(bq, bk))
-    q, k, v = _pad_t(q, L), _pad_t(k, L), _pad_t(v, L)
-    Tp = q.shape[2]
-    # index of the first KV block containing a padded key; padding can
-    # span several tail blocks when lcm(bq, bk) > bk
-    first_pad = (T // bk) if Tp != T else None
-    user_mask = key_mask is not None
-    if key_mask is None:
-        km = (jnp.arange(Tp) < T).astype(jnp.float32)[None, None, :]
-        km = jnp.broadcast_to(km, (B, 1, Tp))
-    else:
-        km = key_mask.astype(jnp.float32)[:, None, :]
-        km = jnp.pad(km, ((0, 0), (0, 0), (0, Tp - km.shape[2])))
-    out = _flash(q, k, v, km, causal, bq, bk, first_pad, user_mask,
-                 interpret)
-    return out[:, :, :T, :]
+    Lengths are padded internally to block multiples (padded keys masked
+    out, padded query rows sliced off). Differentiable via the
+    recompute-form custom VJP. Use `interpret=True` on CPU (tests)."""
+    q, k, v, km, bq, bk, first_pad, user_mask, Tq = _prep(
+        q, k, v, key_mask, causal, block_q, block_k)
+    # single custom_vjp serves both entry points: when the lse output is
+    # unused JAX feeds a zeros cotangent, so d_eff = delta - 0 = delta
+    out, _ = _flash_lse(q, k, v, km, causal, bq, bk, first_pad, user_mask,
+                        interpret)
+    return out[:, :, :Tq, :]

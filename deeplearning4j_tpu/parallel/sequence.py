@@ -181,20 +181,92 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
     return out.astype(q.dtype)
 
 
+def _ring_attention_local_flash(q, k, v, *, axis_name: str, causal: bool,
+                                interpret: bool):
+    """Ring attention with the Pallas flash kernel as the per-chunk
+    engine: each ring step computes (o_i, lse_i) for this device's
+    queries against the visiting KV chunk and merges with the running
+    accumulator by the logaddexp rule. Ring causality reduces to three
+    whole-chunk cases (origin shard before / at / after this shard), so
+    the kernel only ever sees aligned causal or full attention — no
+    offset plumbing. lax.switch runs exactly one branch per step, so
+    fully-future chunks cost nothing but the ppermute."""
+    from deeplearning4j_tpu.nn.layers.pallas_attention import (
+        flash_attention_lse)
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    B, H, T, D = q.shape
+
+    o0 = jnp.zeros((B, H, T, D), jnp.float32)
+    lse0 = jnp.full((B, H, T), NEG_INF, jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def _full(ops):
+        o, lse = flash_attention_lse(q, ops[0], ops[1], causal=False,
+                                     interpret=interpret)
+        return o.astype(jnp.float32), lse
+
+    def _diag(ops):
+        o, lse = flash_attention_lse(q, ops[0], ops[1], causal=True,
+                                     interpret=interpret)
+        return o.astype(jnp.float32), lse
+
+    def _skip(ops):
+        return o0, lse0
+
+    def body(step, carry):
+        k_c, v_c, o, lse = carry
+        src = (my - step) % n                      # origin shard of k_c
+        if causal:
+            branch = jnp.where(src < my, 0, jnp.where(src == my, 1, 2))
+            o_i, lse_i = jax.lax.switch(branch, [_full, _diag, _skip],
+                                        (k_c, v_c))
+        else:
+            o_i, lse_i = _full((k_c, v_c))
+        lse_new = jnp.logaddexp(lse, lse_i)
+        w_old = jnp.exp(lse - lse_new)[..., None]
+        w_new = jnp.exp(lse_i - lse_new)[..., None]
+        o = o * w_old + o_i * w_new
+        k_r = jax.lax.ppermute(k_c, axis_name, perm)
+        v_r = jax.lax.ppermute(v_c, axis_name, perm)
+        return k_r, v_r, o, lse_new
+
+    _, _, o, lse = jax.lax.fori_loop(0, n, body, (k, v, o0, lse0))
+    return o.astype(q.dtype)
+
+
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "data",
-                   causal: bool = False):
+                   causal: bool = False,
+                   use_flash: Optional[bool] = None,
+                   interpret: bool = False):
     """Exact attention over a sequence sharded on ``mesh[axis]``.
 
     q/k/v: [B,H,T,D] global arrays (T divisible by the axis size). Returns
     [B,H,T,D]. Under jit the ppermutes ride ICI neighbor links — the
     canonical ring schedule.
-    """
+
+    On TPU with supported shapes the per-chunk engine is the Pallas flash
+    kernel (_ring_attention_local_flash: per-chunk (o, lse) merged by
+    logaddexp); otherwise the lax online-softmax body. `use_flash`
+    None=auto, and `interpret=True` runs the kernel in interpreter mode
+    (tests on CPU)."""
+    from deeplearning4j_tpu.nn.layers.pallas_attention import (
+        flash_attention_supported)
+    size = mesh.shape[axis]
+    if use_flash is None:
+        local = (q.shape[0], q.shape[1], q.shape[2] // size, q.shape[3])
+        use_flash = (jax.default_backend() == "tpu"
+                     and flash_attention_supported(local))
     spec = P(None, None, axis, None)
-    fn = shard_map(
-        functools.partial(_ring_attention_local, axis_name=axis,
-                          causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+    if use_flash:
+        local_fn = functools.partial(_ring_attention_local_flash,
+                                     axis_name=axis, causal=causal,
+                                     interpret=interpret)
+    else:
+        local_fn = functools.partial(_ring_attention_local, axis_name=axis,
+                                     causal=causal)
+    fn = shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec, check_vma=False)
     return fn(q, k, v)
 
 
