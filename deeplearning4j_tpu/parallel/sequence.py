@@ -283,9 +283,12 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool):
                                   tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    out = reference_attention(qh.astype(jnp.float32),
-                              kh.astype(jnp.float32),
-                              vh.astype(jnp.float32), causal=causal)
+    # blockwise core: O(T·block) memory for the full-length local
+    # attention (the naive [T,T] score matrix defeats the point of
+    # sharding long sequences), and the Pallas flash kernel on TPU.
+    # No fp32 pre-cast: both engines accumulate in fp32 internally, and
+    # bf16 inputs keep the MXU rate / halve the gathered-copy traffic.
+    out = blockwise_attention(qh, kh, vh, causal=causal)
     return heads_to_seq(out.astype(q.dtype))
 
 

@@ -59,6 +59,12 @@ def main(path: str | None = None, steps: int = 120, seq_len: int = 64):
     out_ids = model.sample(net, [stoi[c] for c in seed], steps=60,
                            temperature=0.7)
     print("sample:", "".join(chars[i] for i in out_ids))
+
+    # KV-cache incremental decoding: one single-position forward per
+    # token instead of a padded full forward (rnn_time_step streaming)
+    out_ids = model.sample_stream(net, [stoi[c] for c in seed], steps=60,
+                                  temperature=0.7)
+    print("stream:", "".join(chars[i] for i in out_ids))
     return net.score_value
 
 
