@@ -15,6 +15,13 @@ import sys
 
 import numpy as np
 
+# this environment preloads a TPU plugin and sets JAX_PLATFORMS before
+# Python starts, so the env var is too late — switch via jax.config (the
+# tests/conftest.py gotcha); fixtures are generated on CPU
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 ROOT = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.join(ROOT, "..", ".."))
 
@@ -77,6 +84,26 @@ def cg():
     return net, x
 
 
+def tfm():
+    """Transformer stack fixture (v1, added later than mln/cg): pins the
+    SelfAttentionLayer / LayerNormalization / PositionalEmbeddingLayer
+    serde + checkpoint formats."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.zoo import TextGenerationTransformer
+
+    model = TextGenerationTransformer(vocab_size=12, seed=303, embed_dim=16,
+                                      n_heads=2, n_layers=2, max_length=10,
+                                      updater=Adam(0.001))
+    net = model.init()
+    rng = np.random.default_rng(11)
+    ids = rng.integers(0, 12, (2, 10))
+    x = np.zeros((2, 12, 10), np.float32)
+    x[np.arange(2)[:, None], ids, np.arange(10)[None, :]] = 1.0
+    y = np.roll(x, -1, axis=2)
+    net.fit(DataSet(x, y))   # non-trivial updater state in the fixture
+    return net, x
+
+
 def params_sha256(params) -> str:
     """Deterministic digest over the param pytree (sorted path order,
     float32 little-endian bytes) — pins the decode path bit-exactly."""
@@ -96,30 +123,58 @@ def params_sha256(params) -> str:
     return h.hexdigest()
 
 
-def main():
+def main(which=("mln", "cg", "tfm")):
     import json
 
-    net, x = mln()
-    write_model(net, os.path.join(ROOT, "regression_mln_v1.zip"))
-    np.save(os.path.join(ROOT, "regression_mln_v1_input.npy"), x)
-    np.save(os.path.join(ROOT, "regression_mln_v1_output.npy"),
-            np.asarray(net.output(x)))
-    with open(os.path.join(ROOT, "regression_mln_v1.json"), "w") as f:
-        f.write(net.conf.to_json())
+    try:
+        with open(os.path.join(ROOT, "regression_checksums.json")) as f:
+            sums = json.load(f)
+    except FileNotFoundError:
+        sums = {}
 
-    g, xg = cg()
-    write_model(g, os.path.join(ROOT, "regression_cg_v1.zip"))
-    np.save(os.path.join(ROOT, "regression_cg_v1_input.npy"), xg)
-    np.save(os.path.join(ROOT, "regression_cg_v1_output.npy"),
-            np.asarray(g.output(xg)[0]))
-    with open(os.path.join(ROOT, "regression_cg_v1.json"), "w") as f:
-        f.write(g.conf.to_json())
+    unknown = set(which) - {"mln", "cg", "tfm"}
+    if unknown:
+        sys.exit(f"unknown fixture name(s): {sorted(unknown)} "
+                 "(choose from mln, cg, tfm)")
+
+    if "mln" in which:
+        net, x = mln()
+        write_model(net, os.path.join(ROOT, "regression_mln_v1.zip"))
+        np.save(os.path.join(ROOT, "regression_mln_v1_input.npy"), x)
+        np.save(os.path.join(ROOT, "regression_mln_v1_output.npy"),
+                np.asarray(net.output(x)))
+        with open(os.path.join(ROOT, "regression_mln_v1.json"), "w") as f:
+            f.write(net.conf.to_json())
+        sums["mln_v1_params"] = params_sha256(net.params)
+
+    if "cg" in which:
+        g, xg = cg()
+        write_model(g, os.path.join(ROOT, "regression_cg_v1.zip"))
+        np.save(os.path.join(ROOT, "regression_cg_v1_input.npy"), xg)
+        out = g.output(xg)
+        np.save(os.path.join(ROOT, "regression_cg_v1_output.npy"),
+                np.asarray(out[0] if isinstance(out, (list, tuple))
+                           else out))
+        with open(os.path.join(ROOT, "regression_cg_v1.json"), "w") as f:
+            f.write(g.conf.to_json())
+        sums["cg_v1_params"] = params_sha256(g.params)
+
+    if "tfm" in which:
+        t, xt = tfm()
+        write_model(t, os.path.join(ROOT, "regression_tfm_v1.zip"))
+        np.save(os.path.join(ROOT, "regression_tfm_v1_input.npy"), xt)
+        out = t.output(xt)
+        np.save(os.path.join(ROOT, "regression_tfm_v1_output.npy"),
+                np.asarray(out[0] if isinstance(out, (list, tuple))
+                           else out))
+        with open(os.path.join(ROOT, "regression_tfm_v1.json"), "w") as f:
+            f.write(t.conf.to_json())
+        sums["tfm_v1_params"] = params_sha256(t.params)
 
     with open(os.path.join(ROOT, "regression_checksums.json"), "w") as f:
-        json.dump({"mln_v1_params": params_sha256(net.params),
-                   "cg_v1_params": params_sha256(g.params)}, f, indent=2)
-    print("fixtures written to", ROOT)
+        json.dump(sums, f, indent=2)
+    print("fixtures written to", ROOT, "(", ", ".join(which), ")")
 
 
 if __name__ == "__main__":
-    main()
+    main(tuple(sys.argv[1:]) or ("mln", "cg", "tfm"))

@@ -72,8 +72,11 @@ class TestGraphFixture:
         net = restore_computation_graph(_p("regression_cg_v1.zip"))
         x = np.load(_p("regression_cg_v1_input.npy"))
         expected = np.load(_p("regression_cg_v1_output.npy"))
-        np.testing.assert_allclose(np.asarray(net.output(x)[0]), expected,
-                                   atol=OUT_ATOL)
+        out = net.output(x)
+        got = np.asarray(out[0] if isinstance(out, (list, tuple)) else out)
+        # full batch pinned (the original pin sliced batch element 0)
+        assert got.shape == expected.shape == (3, 4, 7)
+        np.testing.assert_allclose(got, expected, atol=OUT_ATOL)
 
     def test_params_bit_exact(self):
         import sys
@@ -106,3 +109,35 @@ def _leaves(tree):
             yield from _leaves(v)
     elif tree is not None and hasattr(tree, "shape"):
         yield tree
+
+
+class TestTransformerFixture:
+    """Pins the transformer-stack formats added after mln/cg v1:
+    SelfAttentionLayer / LayerNormalization / PositionalEmbeddingLayer
+    serde + checkpoint layout."""
+
+    def test_checkpoint_loads_and_matches_output(self):
+        net = restore_computation_graph(_p("regression_tfm_v1.zip"))
+        x = np.load(_p("regression_tfm_v1_input.npy"))
+        expected = np.load(_p("regression_tfm_v1_output.npy"))
+        out = net.output(x)
+        got = np.asarray(out[0] if isinstance(out, (list, tuple)) else out)
+        np.testing.assert_allclose(got, expected, atol=OUT_ATOL)
+
+    def test_params_bit_exact(self):
+        import sys
+        sys.path.insert(0, FIX)
+        from generate_regression_fixtures import params_sha256
+        net = restore_computation_graph(_p("regression_tfm_v1.zip"))
+        assert params_sha256(net.params) == _checksums()["tfm_v1_params"]
+
+    def test_config_json_parses(self):
+        with open(_p("regression_tfm_v1.json")) as f:
+            conf = ComputationGraphConfiguration.from_json(f.read())
+        attn = conf.vertices["attn0"].layer
+        assert type(attn).__name__ == "SelfAttentionLayer"
+        assert attn.causal and attn.n_heads == 2
+        assert attn.cache_length == 10       # streaming cache pinned too
+        assert type(conf.vertices["ln0a"].layer).__name__ == \
+            "LayerNormalization"
+        assert conf.vertices["pos"].layer.max_length == 10
