@@ -17,6 +17,9 @@ from deeplearning4j_tpu.datasets.normalizers import (  # noqa: F401
     NormalizerStandardize,
     normalizer_from_dict,
 )
+from deeplearning4j_tpu.datasets.formatter import (  # noqa: F401
+    LocalUnstructuredDataFormatter,
+)
 from deeplearning4j_tpu.datasets.fetchers import (  # noqa: F401
     CifarDataSetIterator,
     EmnistDataSetIterator,

@@ -147,29 +147,22 @@ def main(which=("mln", "cg", "tfm")):
             f.write(net.conf.to_json())
         sums["mln_v1_params"] = params_sha256(net.params)
 
-    if "cg" in which:
-        g, xg = cg()
-        write_model(g, os.path.join(ROOT, "regression_cg_v1.zip"))
-        np.save(os.path.join(ROOT, "regression_cg_v1_input.npy"), xg)
+    def write_graph_fixture(name, builder):
+        g, xg = builder()
+        write_model(g, os.path.join(ROOT, f"regression_{name}_v1.zip"))
+        np.save(os.path.join(ROOT, f"regression_{name}_v1_input.npy"), xg)
         out = g.output(xg)
-        np.save(os.path.join(ROOT, "regression_cg_v1_output.npy"),
+        np.save(os.path.join(ROOT, f"regression_{name}_v1_output.npy"),
                 np.asarray(out[0] if isinstance(out, (list, tuple))
                            else out))
-        with open(os.path.join(ROOT, "regression_cg_v1.json"), "w") as f:
+        with open(os.path.join(ROOT, f"regression_{name}_v1.json"),
+                  "w") as f:
             f.write(g.conf.to_json())
-        sums["cg_v1_params"] = params_sha256(g.params)
+        sums[f"{name}_v1_params"] = params_sha256(g.params)
 
-    if "tfm" in which:
-        t, xt = tfm()
-        write_model(t, os.path.join(ROOT, "regression_tfm_v1.zip"))
-        np.save(os.path.join(ROOT, "regression_tfm_v1_input.npy"), xt)
-        out = t.output(xt)
-        np.save(os.path.join(ROOT, "regression_tfm_v1_output.npy"),
-                np.asarray(out[0] if isinstance(out, (list, tuple))
-                           else out))
-        with open(os.path.join(ROOT, "regression_tfm_v1.json"), "w") as f:
-            f.write(t.conf.to_json())
-        sums["tfm_v1_params"] = params_sha256(t.params)
+    for name, builder in (("cg", cg), ("tfm", tfm)):
+        if name in which:
+            write_graph_fixture(name, builder)
 
     with open(os.path.join(ROOT, "regression_checksums.json"), "w") as f:
         json.dump(sums, f, indent=2)

@@ -263,3 +263,75 @@ class TestBenchmarkIterator:
         assert batches[0].features.shape == (8, 3, 16, 16)
         assert batches[0] is batches[3]  # the SAME object: zero ETL cost
         assert batches[0].labels.sum() == 8
+
+
+class TestLocalUnstructuredDataFormatter:
+    """ref: datasets/rearrange/LocalUnstructuredDataFormatter.java."""
+
+    def _corpus(self, tmp_path):
+        src = tmp_path / "raw"
+        for cls in ("cats", "dogs"):
+            d = src / cls
+            d.mkdir(parents=True)
+            for i in range(5):
+                (d / f"img{i:02d}-{cls[:-1]}.jpg").write_bytes(b"x" * 10)
+        return src
+
+    def test_directory_labeling_split(self, tmp_path):
+        from deeplearning4j_tpu.datasets.formatter import (
+            LocalUnstructuredDataFormatter,
+        )
+        src = self._corpus(tmp_path)
+        f = LocalUnstructuredDataFormatter(str(tmp_path / "out"), str(src),
+                                           labeling_type="directory",
+                                           percent_train=0.8, seed=1)
+        f.rearrange()
+        assert f.get_num_examples_total() == 10
+        assert f.get_num_examples_to_train_on() == 8
+        assert f.get_num_test_examples() == 2
+        import os
+        train_files = [os.path.join(d, n) for d, _, ns in
+                       os.walk(tmp_path / "out" / "split" / "train")
+                       for n in ns]
+        test_files = [os.path.join(d, n) for d, _, ns in
+                      os.walk(tmp_path / "out" / "split" / "test")
+                      for n in ns]
+        assert len(train_files) == 8 and len(test_files) == 2
+        # labels are parent dir names
+        labels = {os.path.basename(os.path.dirname(p))
+                  for p in train_files + test_files}
+        assert labels <= {"cats", "dogs"}
+
+    def test_name_labeling(self, tmp_path):
+        from deeplearning4j_tpu.datasets.formatter import (
+            LocalUnstructuredDataFormatter,
+        )
+        src = self._corpus(tmp_path)
+        f = LocalUnstructuredDataFormatter(str(tmp_path / "out"), str(src),
+                                           labeling_type="name",
+                                           percent_train=0.5, seed=2)
+        assert f.get_name_label("a/img00-cat.jpg") == "cat"
+        f.rearrange()
+        import os
+        labels = set(os.listdir(tmp_path / "out" / "split" / "train"))
+        assert labels <= {"cat", "dog"}
+
+    def test_existing_split_rejected(self, tmp_path):
+        import pytest
+        from deeplearning4j_tpu.datasets.formatter import (
+            LocalUnstructuredDataFormatter,
+        )
+        (tmp_path / "out" / "split").mkdir(parents=True)
+        with pytest.raises(RuntimeError, match="already exists"):
+            LocalUnstructuredDataFormatter(str(tmp_path / "out"),
+                                           str(tmp_path))
+
+    def test_get_new_destination(self, tmp_path):
+        from deeplearning4j_tpu.datasets.formatter import (
+            LocalUnstructuredDataFormatter,
+        )
+        f = LocalUnstructuredDataFormatter(str(tmp_path / "out"),
+                                           str(tmp_path / "raw"),
+                                           labeling_type="directory")
+        dst = f.get_new_destination("/data/cats/a.jpg", train=True)
+        assert dst.endswith("split/train/cats/a.jpg")

@@ -122,6 +122,8 @@ class TestTransformerFixture:
         expected = np.load(_p("regression_tfm_v1_output.npy"))
         out = net.output(x)
         got = np.asarray(out[0] if isinstance(out, (list, tuple)) else out)
+        # explicit shape guard: assert_allclose broadcasts
+        assert got.shape == expected.shape == (2, 12, 10)
         np.testing.assert_allclose(got, expected, atol=OUT_ATOL)
 
     def test_params_bit_exact(self):
