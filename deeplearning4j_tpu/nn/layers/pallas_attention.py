@@ -30,7 +30,7 @@ real-chip numbers live in PERF.md.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
