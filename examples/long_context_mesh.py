@@ -1,0 +1,66 @@
+"""Long-context training with sequence parallelism over a device mesh.
+
+The first-class long-context journey: activations are sharded along the
+SEQUENCE axis, so context length scales linearly with chip count — ring
+attention rotates KV chunks over the ICI ring (each chunk computed by the
+Pallas flash kernel on TPU), keeping attention exact while no device ever
+holds the full sequence. On a CPU-only machine simulate the mesh with
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu.
+
+Run: python examples/long_context_mesh.py
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import make_mesh
+from deeplearning4j_tpu.parallel.sequence import MultiHeadSelfAttention
+
+
+def main(steps: int = 120, embed: int = 32, heads: int = 4,
+         t_per_device: int = 64):
+    mesh = make_mesh(devices=jax.devices())
+    n = len(jax.devices())
+    T = t_per_device * n
+    print(f"mesh over {n} device(s); global context T={T}, "
+          f"{t_per_device} per device")
+
+    mha = MultiHeadSelfAttention(embed, heads, impl="ring", causal=True)
+    params = mha.init(jax.random.PRNGKey(0))
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, T, embed)), jnp.float32)
+    # reconstruction target: content-based attention can learn to attend
+    # to itself (a pure-attention block has no positional signal, so
+    # position-shift targets would be unlearnable)
+    y = x
+    shard = NamedSharding(mesh, P(None, "data", None))
+    x, y = jax.device_put(x, shard), jax.device_put(y, shard)
+
+    @jax.jit
+    def train_step(params, x, y):
+        def loss(p):
+            out = mha.apply(p, x, mesh=mesh)     # ring attention over ICI
+            return jnp.mean((out - y) ** 2)
+
+        l, g = jax.value_and_grad(loss)(params)
+        return l, jax.tree.map(lambda p, g: p - 0.5 * g, params, g)
+
+    first = None
+    for i in range(steps):
+        l, params = train_step(params, x, y)
+        if first is None:
+            first = float(l)
+        if i % 5 == 0:
+            print(f"step {i}: loss {float(l):.5f}")
+    final = float(l)
+    print(f"loss {first:.4f} -> {final:.4f}")
+    print(f"final loss {final:.5f} — activations stayed sequence-sharded "
+          "the whole time")
+    return final
+
+
+if __name__ == "__main__":
+    main()
