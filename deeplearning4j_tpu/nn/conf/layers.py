@@ -801,12 +801,19 @@ class SelfAttentionLayer(FeedForwardLayerConf):
     attends each new token against the cached keys instead of re-running
     the full context, the attention-era counterpart of the reference's
     stored-state rnnTimeStep (MultiLayerNetwork.java rnnTimeStep).
+
+    `n_kv_heads` < n_heads selects grouped-query attention: K/V carry
+    only n_kv_heads heads (each shared by n_heads/n_kv_heads query
+    heads), shrinking Wk/Wv and — the point — the streaming KV cache by
+    the same factor. n_kv_heads == n_heads (default None) is standard
+    MHA; n_kv_heads == 1 is multi-query attention.
     """
 
     n_heads: int = 4
     causal: bool = True
     block_size: int = 512
     cache_length: int = 0
+    n_kv_heads: Optional[int] = None
 
     supports_streaming = True
 
@@ -823,11 +830,19 @@ class SelfAttentionLayer(FeedForwardLayerConf):
         if self.n_out % self.n_heads:
             raise ValueError(f"n_out {self.n_out} not divisible by "
                              f"n_heads {self.n_heads}")
+        if self.n_kv_heads is not None and self.n_kv_heads < 1:
+            raise ValueError(f"n_kv_heads must be >= 1, got "
+                             f"{self.n_kv_heads}")
+        hkv = self.n_kv_heads or self.n_heads
+        if self.n_heads % hkv:
+            raise ValueError(f"n_heads {self.n_heads} not divisible by "
+                             f"n_kv_heads {hkv}")
+        d = self.n_out // self.n_heads
         keys = jax.random.split(key, 4)
         p = {}
         for i, name in enumerate(("q", "k", "v", "o")):
             n_in = self.n_in if name != "o" else self.n_out
-            n_out = self.n_out
+            n_out = hkv * d if name in ("k", "v") else self.n_out
             p["W" + name] = init_weights(keys[i], (n_in, n_out), n_in,
                                          n_out, self.weight_init, self.dist)
             p["b" + name] = jnp.zeros((n_out,), jnp.float32)
@@ -839,17 +854,22 @@ class SelfAttentionLayer(FeedForwardLayerConf):
         x = self.maybe_dropout_input(x, train, rng)
         n, f, t = x.shape
         h = self.n_heads
+        hkv = self.n_kv_heads or h
         d = self.n_out // h
         xt = jnp.transpose(x, (0, 2, 1))                    # [N,T,F]
 
-        def proj(name):
+        def proj(name, heads):
             y = xt @ params["W" + name] + params["b" + name]
-            return y.reshape(n, t, h, d).transpose(0, 2, 1, 3)  # [N,H,T,D]
+            return y.reshape(n, t, heads, d).transpose(0, 2, 1, 3)
 
-        q, k, v = proj("q"), proj("k"), proj("v")
+        q = proj("q", h)                                    # [N,H,T,D]
+        k, v = proj("k", hkv), proj("v", hkv)               # [N,Hkv,T,D]
         if stream:
+            # cache the Hkv-sized K/V (the GQA memory win), expand at
+            # attend time inside _stream_attend
             o, state = self._stream_attend(q, k, v, state)
         else:
+            k, v = self._expand_kv(k, v)
             # variable-length batches: mask KEYS with -inf score bias
             # (zeroed K/V would still receive softmax mass)
             o = blockwise_attention(q, k, v, causal=self.causal,
@@ -870,12 +890,13 @@ class SelfAttentionLayer(FeedForwardLayerConf):
                 "SelfAttentionLayer streaming needs cache_length > 0")
         if not self.causal:
             raise ValueError("streaming decode requires causal=True")
-        n, h, t, d = q.shape
+        n, _, t, d = q.shape
+        hkv = k.shape[1]                 # cache holds n_kv_heads heads
         L = self.cache_length
         kc = state.get("kv_k")
         if kc is None:
-            kc = jnp.zeros((n, h, L, d), q.dtype)
-            vc = jnp.zeros((n, h, L, d), q.dtype)
+            kc = jnp.zeros((n, hkv, L, d), q.dtype)
+            vc = jnp.zeros((n, hkv, L, d), q.dtype)
             pos = jnp.zeros((), jnp.int32)
         else:
             vc, pos = state["kv_v"], state["kv_pos"]
@@ -884,18 +905,32 @@ class SelfAttentionLayer(FeedForwardLayerConf):
                                           (z, z, pos, z))
         vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
                                           (z, z, pos, z))
+        # grouped attend against the UN-expanded cache: q reshaped to
+        # [N, Hkv, reps, T, D] — materializing a repeated cache would
+        # forfeit GQA's decode bandwidth win
+        reps = self.n_heads // hkv
+        qg = q.astype(jnp.float32).reshape(n, hkv, reps, t, d)
         scale = 1.0 / np.sqrt(d)
-        s = jnp.einsum("nhtd,nhld->nhtl", q.astype(jnp.float32),
+        s = jnp.einsum("ngrtd,ngld->ngrtl", qg,
                        kc.astype(jnp.float32)) * scale
         # query at absolute position pos+i sees cache slots <= pos+i
         k_idx = jnp.arange(L)
         q_pos = pos + jnp.arange(t)
         valid = k_idx[None, :] <= q_pos[:, None]            # [T, L]
-        s = jnp.where(valid[None, None], s, -1e30)
+        s = jnp.where(valid[None, None, None], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("nhtl,nhld->nhtd", p,
-                       vc.astype(jnp.float32)).astype(q.dtype)
+        o = jnp.einsum("ngrtl,ngld->ngrtd", p,
+                       vc.astype(jnp.float32))
+        o = o.reshape(n, self.n_heads, t, d).astype(q.dtype)
         return o, {**state, "kv_k": kc, "kv_v": vc, "kv_pos": pos + t}
+
+    def _expand_kv(self, k, v):
+        """Repeat K/V heads up to n_heads for grouped-query attention
+        (no-op for standard MHA)."""
+        reps = self.n_heads // k.shape[1]
+        if reps == 1:
+            return k, v
+        return (jnp.repeat(k, reps, axis=1), jnp.repeat(v, reps, axis=1))
 
 
 @register_layer

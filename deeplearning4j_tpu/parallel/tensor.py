@@ -39,6 +39,14 @@ def shard_mha_params(params: Dict, mesh: Mesh, axis: str = "model"):
     """Place MultiHeadSelfAttention-style params {wq,wk,wv,wo} (or the
     SelfAttentionLayer spelling {Wq,...,bq,...}) with the Megatron
     layout: q/k/v column-sharded, o row-sharded."""
+    wq = next((v for k, v in params.items() if k.lower() == "wq"), None)
+    wk = next((v for k, v in params.items() if k.lower() == "wk"), None)
+    if wq is not None and wk is not None and wq.shape != wk.shape:
+        raise ValueError(
+            "grouped-query attention params (n_kv_heads < n_heads: Wk/Wv "
+            f"width {wk.shape[1]} != {wq.shape[1]}) are not supported by "
+            "the Megatron head sharding — use n_kv_heads=None for tensor "
+            "parallelism")
     col = NamedSharding(mesh, P(None, axis))
     row = NamedSharding(mesh, P(axis, None))
     vec = NamedSharding(mesh, P(axis))

@@ -323,3 +323,110 @@ class TestStreamingDecode:
         y[:, 0, :] = 1.0
         net.fit(DataSet(x, y))
         assert np.isfinite(net.score_value)
+
+
+class TestGroupedQueryAttention:
+    """n_kv_heads < n_heads: grouped-query attention — K/V params and the
+    streaming cache shrink by n_heads/n_kv_heads."""
+
+    def _layer(self, n_kv, cache=0):
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        layer = SelfAttentionLayer(n_out=16, n_heads=4, n_kv_heads=n_kv,
+                                   causal=True, activation="identity",
+                                   cache_length=cache)
+        p, s = layer.init(jax.random.PRNGKey(5), InputType.recurrent(16, 8))
+        return layer, p, s
+
+    def test_param_shapes_shrink(self):
+        layer, p, _ = self._layer(2)
+        assert p["Wq"].shape == (16, 16)
+        assert p["Wk"].shape == (16, 8)     # 2 kv heads x d=4
+        assert p["Wv"].shape == (16, 8)
+        assert p["bk"].shape == (8,)
+
+    def test_equals_mha_when_kv_heads_match(self):
+        # n_kv_heads=n_heads must be numerically identical to the default
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        full = SelfAttentionLayer(n_out=16, n_heads=4, causal=True,
+                                  activation="identity")
+        gqa = SelfAttentionLayer(n_out=16, n_heads=4, n_kv_heads=4,
+                                 causal=True, activation="identity")
+        p1, _ = full.init(jax.random.PRNGKey(7), InputType.recurrent(16, 8))
+        p2, _ = gqa.init(jax.random.PRNGKey(7), InputType.recurrent(16, 8))
+        x = jnp.asarray(RNG.standard_normal((2, 16, 8)), jnp.float32)
+        y1, _ = full.apply(p1, x, {})
+        y2, _ = gqa.apply(p2, x, {})
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                                   atol=1e-6)
+
+    def test_gqa_matches_explicit_repeat(self):
+        # GQA == MHA run with the K/V heads explicitly repeated
+        layer, p, _ = self._layer(2)
+        x = jnp.asarray(RNG.standard_normal((2, 16, 8)), jnp.float32)
+        y, _ = layer.apply(p, x, {})
+
+        # build the equivalent full-head params by tiling Wk/Wv per group
+        import numpy as onp
+        d = 4
+        wk = onp.asarray(p["Wk"]).reshape(16, 2, d)
+        wv = onp.asarray(p["Wv"]).reshape(16, 2, d)
+        wk_full = onp.repeat(wk, 2, axis=1).reshape(16, 16)
+        wv_full = onp.repeat(wv, 2, axis=1).reshape(16, 16)
+        bk = onp.repeat(onp.asarray(p["bk"]).reshape(2, d), 2, 0).reshape(-1)
+        bv = onp.repeat(onp.asarray(p["bv"]).reshape(2, d), 2, 0).reshape(-1)
+        full = SelfAttentionLayer(n_out=16, n_heads=4, causal=True,
+                                  activation="identity")
+        pf = {"Wq": p["Wq"], "bq": p["bq"], "Wo": p["Wo"], "bo": p["bo"],
+              "Wk": jnp.asarray(wk_full), "bk": jnp.asarray(bk),
+              "Wv": jnp.asarray(wv_full), "bv": jnp.asarray(bv)}
+        yf, _ = full.apply(pf, x, {})
+        np.testing.assert_allclose(np.asarray(y), np.asarray(yf),
+                                   atol=1e-5)
+
+    def test_streaming_cache_shrinks_and_matches_full(self):
+        layer, p, _ = self._layer(2, cache=8)
+        x = jnp.asarray(RNG.standard_normal((1, 16, 6)), jnp.float32)
+        full, _ = layer.apply(p, x, {})
+        state = {}
+        outs = []
+        for t in range(6):
+            y, state = layer.apply(p, x[:, :, t:t + 1], state, stream=True)
+            outs.append(np.asarray(y)[:, :, 0])
+        assert state["kv_k"].shape == (1, 2, 8, 4)   # Hkv=2, not 4
+        np.testing.assert_allclose(np.stack(outs, -1), np.asarray(full),
+                                   atol=1e-4)
+
+    def test_bad_divisibility_rejected(self):
+        import pytest
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        layer = SelfAttentionLayer(n_out=16, n_heads=4, n_kv_heads=3)
+        with pytest.raises(ValueError, match="n_kv_heads"):
+            layer.init(jax.random.PRNGKey(0), InputType.recurrent(16, 8))
+
+    def test_serde_round_trip(self):
+        from deeplearning4j_tpu.nn.conf.layers import (
+            layer_from_dict, layer_to_dict,
+        )
+        layer = SelfAttentionLayer(n_out=16, n_heads=8, n_kv_heads=2,
+                                   cache_length=64)
+        back = layer_from_dict(layer_to_dict(layer))
+        assert back.n_kv_heads == 2 and back.cache_length == 64
+
+    def test_zero_and_negative_kv_heads_rejected(self):
+        import pytest
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        for bad in (0, -2):
+            layer = SelfAttentionLayer(n_out=16, n_heads=4, n_kv_heads=bad)
+            with pytest.raises(ValueError, match="n_kv_heads"):
+                layer.init(jax.random.PRNGKey(0),
+                           InputType.recurrent(16, 8))
+
+    def test_tensor_parallel_rejects_gqa_params(self):
+        import pytest
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.parallel.mesh import make_mesh
+        from deeplearning4j_tpu.parallel.tensor import shard_mha_params
+        layer, p, _ = self._layer(2)
+        mesh = make_mesh(shape=(8,), axis_names=("model",))
+        with pytest.raises(ValueError, match="grouped-query"):
+            shard_mha_params(p, mesh)
