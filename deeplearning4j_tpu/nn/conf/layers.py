@@ -807,6 +807,12 @@ class SelfAttentionLayer(FeedForwardLayerConf):
     heads), shrinking Wk/Wv and — the point — the streaming KV cache by
     the same factor. n_kv_heads == n_heads (default None) is standard
     MHA; n_kv_heads == 1 is multi-query attention.
+
+    `rope=True` applies rotary position embeddings to q/k (RoFormer):
+    positions enter through rotation of the head channels, so scores
+    depend only on RELATIVE offsets — no learned position table, clean
+    extrapolation, and streaming decode rotates by absolute kv_pos
+    (cached keys are rotated at insert time). Head dim must be even.
     """
 
     n_heads: int = 4
@@ -814,6 +820,8 @@ class SelfAttentionLayer(FeedForwardLayerConf):
     block_size: int = 512
     cache_length: int = 0
     n_kv_heads: Optional[int] = None
+    rope: bool = False
+    rope_base: float = 10000.0
 
     supports_streaming = True
 
@@ -838,6 +846,10 @@ class SelfAttentionLayer(FeedForwardLayerConf):
             raise ValueError(f"n_heads {self.n_heads} not divisible by "
                              f"n_kv_heads {hkv}")
         d = self.n_out // self.n_heads
+        if self.rope and d % 2:
+            raise ValueError(f"rope needs an even head dim, got {d} "
+                             f"(n_out {self.n_out} / n_heads "
+                             f"{self.n_heads})")
         keys = jax.random.split(key, 4)
         p = {}
         for i, name in enumerate(("q", "k", "v", "o")):
@@ -864,6 +876,10 @@ class SelfAttentionLayer(FeedForwardLayerConf):
 
         q = proj("q", h)                                    # [N,H,T,D]
         k, v = proj("k", hkv), proj("v", hkv)               # [N,Hkv,T,D]
+        if self.rope and not stream:
+            pos = jnp.arange(t)
+            q = self._rope(q, pos)
+            k = self._rope(k, pos)
         if stream:
             # cache the Hkv-sized K/V (the GQA memory win), expand at
             # attend time inside _stream_attend
@@ -900,6 +916,10 @@ class SelfAttentionLayer(FeedForwardLayerConf):
             pos = jnp.zeros((), jnp.int32)
         else:
             vc, pos = state["kv_v"], state["kv_pos"]
+        if self.rope:
+            abs_pos = pos + jnp.arange(t)
+            q = self._rope(q, abs_pos)
+            k = self._rope(k, abs_pos)
         z = jnp.zeros((), pos.dtype)
         kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
                                           (z, z, pos, z))
@@ -923,6 +943,23 @@ class SelfAttentionLayer(FeedForwardLayerConf):
                        vc.astype(jnp.float32))
         o = o.reshape(n, self.n_heads, t, d).astype(q.dtype)
         return o, {**state, "kv_k": kc, "kv_v": vc, "kv_pos": pos + t}
+
+    def _rope(self, x, positions):
+        """Rotary position embedding (RoFormer rotate-half convention):
+        x [N,H,T,D], positions [T] absolute. Pairs channel i with channel
+        i + D/2 and rotates by positions * base^(-2i/D)."""
+        d = x.shape[-1]
+        if d % 2:
+            raise ValueError(f"rope needs an even head dim, got {d}")
+        half = d // 2
+        inv = self.rope_base ** (-jnp.arange(half, dtype=jnp.float32)
+                                 / half)
+        ang = positions.astype(jnp.float32)[:, None] * inv[None, :]  # [T,half]
+        cos = jnp.cos(ang)[None, None].astype(x.dtype)
+        sin = jnp.sin(ang)[None, None].astype(x.dtype)
+        x1, x2 = x[..., :half], x[..., half:]
+        return jnp.concatenate([x1 * cos - x2 * sin,
+                                x1 * sin + x2 * cos], axis=-1)
 
     def _expand_kv(self, k, v):
         """Repeat K/V heads up to n_heads for grouped-query attention

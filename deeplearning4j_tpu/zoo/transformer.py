@@ -40,7 +40,8 @@ class TextGenerationTransformer(ZooModel):
     def __init__(self, vocab_size: int = 128, seed: int = 12345,
                  embed_dim: int = 256, n_heads: int = 8, n_layers: int = 4,
                  ffn_mult: int = 4, max_length: int = 1024,
-                 block_size: int = 512, **kw):
+                 block_size: int = 512, positional: str = "learned",
+                 n_kv_heads=None, **kw):
         super().__init__(vocab_size, seed, **kw)
         if embed_dim % n_heads:
             raise ValueError("embed_dim must divide by n_heads")
@@ -51,6 +52,10 @@ class TextGenerationTransformer(ZooModel):
         self.ffn_mult = ffn_mult
         self.max_length = max_length
         self.block_size = block_size
+        if positional not in ("learned", "rope"):
+            raise ValueError(f"unknown positional {positional!r}")
+        self.positional = positional
+        self.n_kv_heads = n_kv_heads
 
     def conf(self):
         E = self.embed_dim
@@ -67,15 +72,20 @@ class TextGenerationTransformer(ZooModel):
         g.add_layer("embed", Convolution1DLayer(
             n_out=E, kernel=1, convolution_mode="same",
             activation="identity"), "in")
-        g.add_layer("pos", PositionalEmbeddingLayer(
-            max_length=self.max_length), "embed")
-        prev = "pos"
+        if self.positional == "learned":
+            g.add_layer("pos", PositionalEmbeddingLayer(
+                max_length=self.max_length), "embed")
+            prev = "pos"
+        else:  # rope: positions enter inside attention, no table
+            prev = "embed"
         for i in range(self.n_layers):
             g.add_layer(f"ln{i}a", LayerNormalization(), prev)
             g.add_layer(f"attn{i}", SelfAttentionLayer(
                 n_out=E, n_heads=self.n_heads, causal=True,
                 block_size=self.block_size, activation="identity",
-                cache_length=self.max_length), f"ln{i}a")
+                cache_length=self.max_length,
+                n_kv_heads=self.n_kv_heads,
+                rope=self.positional == "rope"), f"ln{i}a")
             g.add_vertex(f"res{i}a", ElementWiseVertex(op="add"),
                          prev, f"attn{i}")
             g.add_layer(f"ln{i}b", LayerNormalization(), f"res{i}a")

@@ -430,3 +430,99 @@ class TestGroupedQueryAttention:
         mesh = make_mesh(shape=(8,), axis_names=("model",))
         with pytest.raises(ValueError, match="grouped-query"):
             shard_mha_params(p, mesh)
+
+
+class TestRope:
+    """Rotary position embeddings on SelfAttentionLayer."""
+
+    def _layer(self, **kw):
+        layer = SelfAttentionLayer(n_out=16, n_heads=2, causal=True,
+                                   activation="identity", rope=True, **kw)
+        p, s = layer.init(jax.random.PRNGKey(3), InputType.recurrent(16, 8))
+        return layer, p
+
+    def test_rope_changes_output(self):
+        layer, p = self._layer()
+        plain = SelfAttentionLayer(n_out=16, n_heads=2, causal=True,
+                                   activation="identity")
+        x = jnp.asarray(RNG.standard_normal((1, 16, 8)), jnp.float32)
+        y_rope, _ = layer.apply(p, x, {})
+        y_plain, _ = plain.apply(p, x, {})
+        assert float(jnp.max(jnp.abs(y_rope - y_plain))) > 1e-3
+
+    def test_rotation_preserves_norm(self):
+        layer, p = self._layer()
+        q = jnp.asarray(RNG.standard_normal((1, 2, 8, 8)), jnp.float32)
+        rq = layer._rope(q, jnp.arange(8))
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(q), axis=-1),
+            np.linalg.norm(np.asarray(rq), axis=-1), rtol=1e-5)
+
+    def test_scores_depend_on_relative_position_only(self):
+        # the defining property: <rope(q, i), rope(k, j)> is a function of
+        # (i - j), so shifting both positions leaves the score unchanged
+        layer, p = self._layer()
+        rng = np.random.default_rng(5)
+        q = jnp.asarray(rng.standard_normal((1, 1, 1, 8)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((1, 1, 1, 8)), jnp.float32)
+
+        def score(i, j):
+            qi = layer._rope(q, jnp.array([i]))
+            kj = layer._rope(k, jnp.array([j]))
+            return float(jnp.sum(qi * kj))
+
+        assert abs(score(5, 2) - score(105, 102)) < 1e-3
+        assert abs(score(5, 2) - score(5, 3)) > 1e-4  # but offset matters
+
+    def test_streaming_matches_full(self):
+        layer, p = self._layer(cache_length=8)
+        x = jnp.asarray(RNG.standard_normal((1, 16, 6)), jnp.float32)
+        full, _ = layer.apply(p, x, {})
+        state, outs = {}, []
+        for t in range(6):
+            y, state = layer.apply(p, x[:, :, t:t + 1], state, stream=True)
+            outs.append(np.asarray(y)[:, :, 0])
+        np.testing.assert_allclose(np.stack(outs, -1), np.asarray(full),
+                                   atol=1e-4)
+
+    def test_odd_head_dim_rejected_at_init(self):
+        layer = SelfAttentionLayer(n_out=6, n_heads=2, rope=True,
+                                   activation="identity")
+        with pytest.raises(ValueError, match="even head dim"):
+            layer.init(jax.random.PRNGKey(0), InputType.recurrent(6, 4))
+
+    def test_serde_round_trip(self):
+        from deeplearning4j_tpu.nn.conf.layers import (
+            layer_from_dict, layer_to_dict,
+        )
+        layer = SelfAttentionLayer(n_out=16, rope=True, rope_base=5e5)
+        back = layer_from_dict(layer_to_dict(layer))
+        assert back.rope and back.rope_base == 5e5
+
+
+class TestRopeTransformer:
+    def test_rope_variant_trains_and_streams(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        model = TextGenerationTransformer(vocab_size=12, embed_dim=16,
+                                          n_heads=2, n_layers=2,
+                                          max_length=16,
+                                          positional="rope", n_kv_heads=1)
+        net = model.init()
+        assert "pos" not in net.conf.vertices      # no position table
+        V, T = 12, 10
+        ids = RNG.integers(0, V, (1, T))
+        x = np.zeros((1, V, T), np.float32)
+        x[0, ids[0], np.arange(T)] = 1.0
+        y = np.roll(x, -1, axis=2)
+        net.fit(DataSet(x, y))
+        assert np.isfinite(net.score_value)
+        # streaming decode == full forward (rope absolute offsets correct)
+        out = net.output(x)
+        full = np.asarray(out[0] if isinstance(out, (list, tuple)) else out)
+        net.rnn_clear_previous_state()
+        for t in range(T):
+            h = np.zeros((1, V, 1), np.float32)
+            h[0, ids[0, t], 0] = 1.0
+            got = np.asarray(net.rnn_time_step(h))
+            np.testing.assert_allclose(got[0, :, 0], full[0, :, t],
+                                       atol=1e-4, err_msg=f"pos {t}")
