@@ -49,7 +49,8 @@ def reference_attention(q, k, v, causal: bool = False):
 
 def blockwise_attention(q, k, v, causal: bool = False,
                         block_size: int = 512, key_mask=None,
-                        use_pallas: Optional[bool] = None):
+                        use_pallas: Optional[bool] = None,
+                        window: Optional[int] = None):
     """Single-device flash-style attention: lax.scan over KV blocks with
     an online-softmax accumulator — O(T·block) live memory instead of the
     [T,T] score matrix, so one chip handles long contexts that would OOM
@@ -68,14 +69,26 @@ def blockwise_attention(q, k, v, causal: bool = False,
     keys are masked with NEG_INF so results are unaffected. `key_mask`
     [B,T] (1=valid) additionally NEG_INF-masks padded KEY positions of
     variable-length batches (zeroing K/V would still receive softmax
-    mass — score 0 can exceed valid negative scores).
+    mass — score 0 can exceed valid negative scores). `window=W` (causal
+    only) restricts each query to its W most recent keys — Mistral-style
+    local attention SEMANTICS; the scan still visits every KV block, so
+    cost stays O(T²) (skipping out-of-window blocks needs the
+    query-blocked schedule the Pallas kernel uses — future kernel work).
     """
     from deeplearning4j_tpu.nn.layers.pallas_attention import (
         flash_attention, flash_attention_supported)
+    if window is not None:
+        if not causal:
+            raise ValueError("window attention requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     if use_pallas is None:
-        use_pallas = (jax.default_backend() == "tpu"
+        use_pallas = (jax.default_backend() == "tpu" and window is None
                       and flash_attention_supported(q.shape))
     if use_pallas:
+        if window is not None:
+            raise ValueError("the Pallas kernel does not implement "
+                             "sliding windows; use use_pallas=False")
         return flash_attention(q, k, v, causal=causal, key_mask=key_mask)
     B, H, T, D = q.shape
     bs = int(min(block_size, T))
@@ -106,6 +119,9 @@ def blockwise_attention(q, k, v, causal: bool = False,
         valid = k_pos < T                                # pad mask
         if causal:
             valid = valid[None, :] & (q_pos[:, None] >= k_pos[None, :])
+            if window is not None:
+                # sliding window: query i sees keys (i-window, i]
+                valid = valid & (q_pos[:, None] - k_pos[None, :] < window)
         else:
             valid = jnp.broadcast_to(valid[None, :], (T, bs))
         s = jnp.where(valid[None, None], s, NEG_INF)

@@ -273,3 +273,49 @@ class TestFlashLse:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=5e-4, rtol=5e-4,
                                        err_msg=f"d{name}")
+
+
+class TestWindowAttention:
+    """Sliding-window (Mistral-style local) attention on the scan path."""
+
+    def test_matches_masked_reference(self):
+        from deeplearning4j_tpu.parallel.sequence import blockwise_attention
+        q, k, v = qkv(T=64, seed=31)
+        W = 16
+        out = blockwise_attention(q, k, v, causal=True, window=W,
+                                  block_size=16, use_pallas=False)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(q.shape[-1])
+        idx = jnp.arange(64)
+        valid = (idx[:, None] >= idx[None, :]) & \
+                (idx[:, None] - idx[None, :] < W)
+        s = jnp.where(valid[None, None], s, -1e30)
+        ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_window_one_is_self_only(self):
+        from deeplearning4j_tpu.parallel.sequence import blockwise_attention
+        q, k, v = qkv(T=16, seed=33)
+        out = blockwise_attention(q, k, v, causal=True, window=1,
+                                  use_pallas=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(v),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_requires_causal(self):
+        from deeplearning4j_tpu.parallel.sequence import blockwise_attention
+        q, k, v = qkv(T=16)
+        with pytest.raises(ValueError, match="causal"):
+            blockwise_attention(q, k, v, causal=False, window=4,
+                                use_pallas=False)
+
+    def test_grads_flow(self):
+        from deeplearning4j_tpu.parallel.sequence import blockwise_attention
+        q, k, v = qkv(B=1, H=1, T=32, seed=35)
+
+        def loss(q):
+            return jnp.sum(blockwise_attention(q, k, v, causal=True,
+                                               window=8,
+                                               use_pallas=False) ** 2)
+
+        g = jax.grad(loss)(q)
+        assert np.all(np.isfinite(np.asarray(g)))
