@@ -822,6 +822,10 @@ class SelfAttentionLayer(FeedForwardLayerConf):
     n_kv_heads: Optional[int] = None
     rope: bool = False
     rope_base: float = 10000.0
+    #: sliding-window width (causal only): each query sees its `window`
+    #: most recent positions (Mistral-style local attention; the Pallas
+    #: kernel skips out-of-window blocks). None = full attention.
+    window: Optional[int] = None
 
     supports_streaming = True
 
@@ -850,6 +854,11 @@ class SelfAttentionLayer(FeedForwardLayerConf):
             raise ValueError(f"rope needs an even head dim, got {d} "
                              f"(n_out {self.n_out} / n_heads "
                              f"{self.n_heads})")
+        if self.window is not None:
+            if not self.causal:
+                raise ValueError("window attention requires causal=True")
+            if self.window < 1:
+                raise ValueError(f"window must be >= 1, got {self.window}")
         keys = jax.random.split(key, 4)
         p = {}
         for i, name in enumerate(("q", "k", "v", "o")):
@@ -890,7 +899,7 @@ class SelfAttentionLayer(FeedForwardLayerConf):
             # (zeroed K/V would still receive softmax mass)
             o = blockwise_attention(q, k, v, causal=self.causal,
                                     block_size=self.block_size,
-                                    key_mask=mask)
+                                    key_mask=mask, window=self.window)
         o = o.transpose(0, 2, 1, 3).reshape(n, t, self.n_out)
         o = o @ params["Wo"] + params["bo"]
         y = jnp.transpose(o, (0, 2, 1))                     # [N,F,T]
@@ -937,6 +946,8 @@ class SelfAttentionLayer(FeedForwardLayerConf):
         k_idx = jnp.arange(L)
         q_pos = pos + jnp.arange(t)
         valid = k_idx[None, :] <= q_pos[:, None]            # [T, L]
+        if self.window is not None:
+            valid = valid & (q_pos[:, None] - k_idx[None, :] < self.window)
         s = jnp.where(valid[None, None, None], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("ngrtl,ngld->ngrtd", p,

@@ -30,7 +30,7 @@ real-chip numbers live in PERF.md.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -45,12 +45,18 @@ DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 
 
-def _causal_needed(i, j, bq, bk):
-    """Is KV block j visible to any query in Q block i? (block-skip test)"""
-    return i * bq + bq - 1 >= j * bk
+def _causal_needed(i, j, bq, bk, window=None):
+    """Is KV block j visible to any query in Q block i? (block-skip test:
+    causal upper bound, plus the sliding-window lower bound when set)"""
+    needed = i * bq + bq - 1 >= j * bk
+    if window is not None:
+        # some key in the block is within (q - window, q] for some query
+        needed = jnp.logical_and(needed,
+                                 j * bk + bk - 1 > i * bq - window)
+    return needed
 
 
-def _block_mask(i, j, bq, bk, causal: bool, kmask_row):
+def _block_mask(i, j, bq, bk, causal: bool, kmask_row, window=None):
     """[bq, bk] validity mask for one (Q block, KV block) pair.
     kmask_row: [1, bk]."""
     valid = jnp.broadcast_to(kmask_row.astype(bool), (bq, bk))
@@ -58,28 +64,35 @@ def _block_mask(i, j, bq, bk, causal: bool, kmask_row):
         q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         valid = valid & (q_pos >= k_pos)
+        if window is not None:
+            valid = valid & (q_pos - k_pos < window)
     return valid
 
 
 def _dispatch(i, j, fast_fn, masked_fn, *, causal, bq, bk, nk,
-              first_pad, user_mask):
+              first_pad, user_mask, window=None):
     """Run the fast (no mask VPU ops) or masked block body.
 
-    Masking is needed only for diagonal-straddling causal blocks, KV
-    blocks containing padded keys (j >= first_pad — padding can span
-    multiple tail blocks when lcm(bq,bk) > bk), or when a user key mask
-    exists (then always). Fully-above-diagonal causal blocks are skipped
-    entirely."""
+    Masking is needed only for diagonal-straddling causal blocks, blocks
+    straddling a sliding-window edge, KV blocks containing padded keys
+    (j >= first_pad — padding can span multiple tail blocks when the
+    block sizes differ), or when a user key mask exists (then always).
+    Blocks fully above the causal diagonal or fully OUTSIDE the window
+    are skipped entirely — with `window` set, cost is O(T*W)."""
     if user_mask:
         if causal:
-            pl.when(_causal_needed(i, j, bq, bk))(masked_fn)
+            pl.when(_causal_needed(i, j, bq, bk, window))(masked_fn)
         else:
             masked_fn()
         return
     tail = (j >= first_pad) if first_pad is not None else None
     if causal:
-        needed = _causal_needed(i, j, bq, bk)
+        needed = _causal_needed(i, j, bq, bk, window)
         interior = i * bq >= j * bk + bk - 1   # no in-block causal mask
+        if window is not None:
+            # every pair also inside the window: max(q) - min(k) < W
+            interior = jnp.logical_and(
+                interior, i * bq + bq - 1 - j * bk < window)
         fast = jnp.logical_and(needed, interior)
         if tail is not None:
             fast = jnp.logical_and(fast, jnp.logical_not(tail))
@@ -94,7 +107,7 @@ def _dispatch(i, j, fast_fn, masked_fn, *, causal, bq, bk, nk,
 
 def _fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref,
                 acc_scr, m_scr, l_scr, *, scale, causal, bq, bk, nk,
-                first_pad, user_mask):
+                first_pad, user_mask, window=None):
     i, j = pl.program_id(2), pl.program_id(3)
 
     @pl.when(j == 0)
@@ -108,7 +121,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref,
             q_ref[0, 0], k_ref[0, 0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale        # [bq, bk]
         if masked:
-            valid = _block_mask(i, j, bq, bk, causal, km_ref[0])
+            valid = _block_mask(i, j, bq, bk, causal, km_ref[0], window)
             s = jnp.where(valid, s, NEG_INF)
         m_prev = m_scr[:][:, :1]                               # [bq, 1]
         l_prev = l_scr[:][:, :1]
@@ -129,7 +142,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref,
 
     _dispatch(i, j, lambda: _compute(False), lambda: _compute(True),
               causal=causal, bq=bq, bk=bk, nk=nk, first_pad=first_pad,
-              user_mask=user_mask)
+              user_mask=user_mask, window=window)
 
     @pl.when(j == nk - 1)
     def _finish():
@@ -141,7 +154,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, d_ref,
                    dq_ref, dq_scr, *, scale, causal, bq, bk, nk,
-                   first_pad, user_mask):
+                   first_pad, user_mask, window=None):
     i, j = pl.program_id(2), pl.program_id(3)
 
     @pl.when(j == 0)
@@ -155,7 +168,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, d_ref,
         if masked:
             # mask BEFORE exp (as forward does): a masked raw score above
             # the row lse would overflow exp to inf and 0*inf = NaN
-            valid = _block_mask(i, j, bq, bk, causal, km_ref[0])
+            valid = _block_mask(i, j, bq, bk, causal, km_ref[0], window)
             s = jnp.where(valid, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0, 0])
         if masked:
@@ -170,7 +183,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, d_ref,
 
     _dispatch(i, j, lambda: _compute(False), lambda: _compute(True),
               causal=causal, bq=bq, bk=bk, nk=nk, first_pad=first_pad,
-              user_mask=user_mask)
+              user_mask=user_mask, window=window)
 
     @pl.when(j == nk - 1)
     def _finish():
@@ -180,7 +193,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, d_ref,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, d_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr,
                     *, scale, causal, bq, bk, nq, nk,
-                    first_pad, user_mask):
+                    first_pad, user_mask, window=None):
     j, i = pl.program_id(2), pl.program_id(3)   # Q innermost here
 
     @pl.when(i == 0)
@@ -193,7 +206,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, d_ref,
             q_ref[0, 0], k_ref[0, 0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale        # [bq, bk]
         if masked:
-            valid = _block_mask(i, j, bq, bk, causal, km_ref[0])
+            valid = _block_mask(i, j, bq, bk, causal, km_ref[0], window)
             s = jnp.where(valid, s, NEG_INF)   # see _bwd_dq_kernel note
         p = jnp.exp(s - lse_ref[0, 0])
         if masked:
@@ -212,7 +225,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, d_ref,
 
     _dispatch(i, j, lambda: _compute(False), lambda: _compute(True),
               causal=causal, bq=bq, bk=bk, nk=nk, first_pad=first_pad,
-              user_mask=user_mask)
+              user_mask=user_mask, window=window)
 
     @pl.when(i == nq - 1)
     def _finish():
@@ -253,7 +266,7 @@ def _pad_t(x, bs):
 
 
 def _run_bwd_kernels(q, k, v, key_mask, do, lse, d_eff, *, causal, bq, bk,
-                     first_pad, user_mask, interpret):
+                     first_pad, user_mask, interpret, window=None):
     """The dq and dk/dv pallas calls shared by both VJPs. `d_eff` sits in
     the delta slot: plain backward passes delta = rowsum(do*o); the
     lse-differentiable variant passes delta - dlse. Query and key lengths
@@ -266,7 +279,7 @@ def _run_bwd_kernels(q, k, v, key_mask, do, lse, d_eff, *, causal, bq, bk,
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nk=nk, first_pad=first_pad,
-                          user_mask=user_mask),
+                          user_mask=user_mask, window=window),
         grid=(B, H, nq, nk),
         in_specs=[_qkv_spec(bq, D, 2), _qkv_spec(bk, D, 3),
                   _qkv_spec(bk, D, 3), _km_spec(bk, 3),
@@ -280,7 +293,7 @@ def _run_bwd_kernels(q, k, v, key_mask, do, lse, d_eff, *, causal, bq, bk,
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nq=nq, nk=nk, first_pad=first_pad,
-                          user_mask=user_mask),
+                          user_mask=user_mask, window=window),
         # KV block is the carried axis; Q innermost
         grid=(B, H, nk, nq),
         in_specs=[
@@ -306,13 +319,13 @@ def _run_bwd_kernels(q, k, v, key_mask, do, lse, d_eff, *, causal, bq, bk,
 
 
 def _flash_fwd(q, k, v, key_mask, causal, bq, bk, first_pad, user_mask,
-               interpret):
+               interpret, window=None):
     B, H, T, D = q.shape
     scale = float(1.0 / np.sqrt(D))
     nq, nk = T // bq, k.shape[2] // bk
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                bq=bq, bk=bk, nk=nk, first_pad=first_pad,
-                               user_mask=user_mask)
+                               user_mask=user_mask, window=window)
     o, lse = pl.pallas_call(
         kernel,
         grid=(B, H, nq, nk),
@@ -338,24 +351,25 @@ def _flash_fwd(q, k, v, key_mask, causal, bq, bk, first_pad, user_mask,
 # slot (dv is independent of lse).
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
 def _flash_lse(q, k, v, key_mask, causal, bq, bk, first_pad, user_mask,
-               interpret):
+               interpret, window):
     (o, lse), _ = _flash_lse_fwd(q, k, v, key_mask, causal, bq, bk,
-                                 first_pad, user_mask, interpret)
+                                 first_pad, user_mask, interpret, window)
     return o, lse
 
 
 def _flash_lse_fwd(q, k, v, key_mask, causal, bq, bk, first_pad, user_mask,
-                   interpret):
+                   interpret, window):
     o, res = _flash_fwd(q, k, v, key_mask, causal, bq, bk, first_pad,
-                        user_mask, interpret)
+                        user_mask, interpret, window)
     lse = res[-1]
     return (o, lse), res
 
 
-def _flash_lse_bwd(causal, bq, bk, first_pad, user_mask, interpret, res,
-                   cotangents):
+def _flash_lse_bwd(causal, bq, bk, first_pad, user_mask, interpret, window,
+                   res, cotangents):
     do, dlse = cotangents
     q, k, v, key_mask, o, lse = res
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
@@ -364,7 +378,7 @@ def _flash_lse_bwd(causal, bq, bk, first_pad, user_mask, interpret, res,
     dq, dk, dv = _run_bwd_kernels(q, k, v, key_mask, do, lse, d_eff,
                                   causal=causal, bq=bq, bk=bk,
                                   first_pad=first_pad, user_mask=user_mask,
-                                  interpret=interpret)
+                                  interpret=interpret, window=window)
     return dq, dk, dv, jnp.zeros_like(key_mask)
 
 
@@ -382,7 +396,7 @@ def flash_attention_lse(q, k, v, causal: bool = False, key_mask=None,
     q, k, v, km, bq, bk, first_pad, user_mask, Tq = _prep(
         q, k, v, key_mask, causal, block_q, block_k)
     o, lse = _flash_lse(q, k, v, km, causal, bq, bk, first_pad, user_mask,
-                        interpret)
+                        interpret, None)
     return o[:, :, :Tq, :], lse[:, :, :Tq, 0]
 
 
@@ -425,7 +439,8 @@ def flash_attention_supported(q_shape: Tuple[int, ...],
 def flash_attention(q, k, v, causal: bool = False, key_mask=None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
-                    interpret: bool = False):
+                    interpret: bool = False,
+                    window: Optional[int] = None):
     """Fused flash attention. q: [B,H,Tq,D]; k,v: [B,H,Tk,D]; key_mask:
     [B,Tk] (1=valid). Tq and Tk may differ (cross-/chunked attention)
     except under causal, which requires aligned lengths.
@@ -433,10 +448,12 @@ def flash_attention(q, k, v, causal: bool = False, key_mask=None,
     Lengths are padded internally to block multiples (padded keys masked
     out, padded query rows sliced off). Differentiable via the
     recompute-form custom VJP. Use `interpret=True` on CPU (tests)."""
+    if window is not None and not causal:
+        raise ValueError("window attention requires causal=True")
     q, k, v, km, bq, bk, first_pad, user_mask, Tq = _prep(
         q, k, v, key_mask, causal, block_q, block_k)
     # single custom_vjp serves both entry points: when the lse output is
     # unused JAX feeds a zeros cotangent, so d_eff = delta - 0 = delta
     out, _ = _flash_lse(q, k, v, km, causal, bq, bk, first_pad, user_mask,
-                        interpret)
+                        interpret, window)
     return out[:, :, :Tq, :]

@@ -71,9 +71,9 @@ def blockwise_attention(q, k, v, causal: bool = False,
     variable-length batches (zeroing K/V would still receive softmax
     mass — score 0 can exceed valid negative scores). `window=W` (causal
     only) restricts each query to its W most recent keys — Mistral-style
-    local attention SEMANTICS; the scan still visits every KV block, so
-    cost stays O(T²) (skipping out-of-window blocks needs the
-    query-blocked schedule the Pallas kernel uses — future kernel work).
+    local attention. On the Pallas kernel path, blocks fully outside the
+    window are SKIPPED, so cost is O(T·W); the scan fallback applies the
+    mask but still visits every block (O(T²) semantics-only).
     """
     from deeplearning4j_tpu.nn.layers.pallas_attention import (
         flash_attention, flash_attention_supported)
@@ -83,13 +83,11 @@ def blockwise_attention(q, k, v, causal: bool = False,
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
     if use_pallas is None:
-        use_pallas = (jax.default_backend() == "tpu" and window is None
+        use_pallas = (jax.default_backend() == "tpu"
                       and flash_attention_supported(q.shape))
     if use_pallas:
-        if window is not None:
-            raise ValueError("the Pallas kernel does not implement "
-                             "sliding windows; use use_pallas=False")
-        return flash_attention(q, k, v, causal=causal, key_mask=key_mask)
+        return flash_attention(q, k, v, causal=causal, key_mask=key_mask,
+                               window=window)
     B, H, T, D = q.shape
     bs = int(min(block_size, T))
     pad = (-T) % bs

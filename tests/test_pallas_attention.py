@@ -195,3 +195,78 @@ class TestBackward:
         for a, b, name in zip(gf, gr, ("k", "v")):
             np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4,
                                        err_msg=f"d{name}")
+
+
+class TestWindowKernel:
+    """Sliding-window block skipping in the flash kernel."""
+
+    def _masked_ref(self, q, k, v, W):
+        T = q.shape[2]
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(q.shape[-1])
+        idx = jnp.arange(T)
+        valid = (idx[:, None] >= idx[None, :]) & \
+                (idx[:, None] - idx[None, :] < W)
+        s = jnp.where(valid[None, None], s, -1e30)
+        return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+
+    @pytest.mark.parametrize("W", [64, 128, 200])
+    def test_matches_masked_reference(self, W):
+        q, k, v = _qkv(T=512, seed=41)
+        out = flash_attention(q, k, v, causal=True, window=W,
+                              block_q=128, block_k=128, interpret=True)
+        np.testing.assert_allclose(out, self._masked_ref(q, k, v, W),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_grads_match_masked_reference(self):
+        q, k, v = _qkv(B=1, H=1, T=256, D=64, seed=43)
+        W = 96
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True, window=W,
+                                           block_q=128, block_k=128,
+                                           interpret=True) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(self._masked_ref(q, k, v, W) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gf, gr, "qkv"):
+            np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4,
+                                       err_msg=f"d{name}")
+
+    def test_window_with_key_mask(self):
+        B, T, W = 2, 256, 64
+        q, k, v = _qkv(B=B, T=T, seed=45)
+        km = jnp.asarray(np.arange(T)[None, :] <
+                         np.array([220, 130])[:, None], jnp.float32)
+        out = flash_attention(q, k, v, causal=True, window=W, key_mask=km,
+                              block_q=128, block_k=128, interpret=True)
+        idx = jnp.arange(T)
+        valid = (idx[:, None] >= idx[None, :]) & \
+                (idx[:, None] - idx[None, :] < W)
+        valid = valid[None, None] & (km[:, None, None, :] > 0)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(q.shape[-1])
+        s = jnp.where(valid, s, -1e30)
+        ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+        row_ok = np.broadcast_to(np.asarray(valid.any(-1)),
+                                 (B, q.shape[1], T))
+        np.testing.assert_allclose(np.asarray(out)[row_ok],
+                                   np.asarray(ref)[row_ok],
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_scan_and_kernel_agree(self):
+        from deeplearning4j_tpu.parallel.sequence import blockwise_attention
+        q, k, v = _qkv(T=256, seed=47)
+        a = blockwise_attention(q, k, v, causal=True, window=80,
+                                use_pallas=False)
+        b = flash_attention(q, k, v, causal=True, window=80,
+                            block_q=128, block_k=128, interpret=True)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_noncausal_window_rejected(self):
+        q, k, v = _qkv(T=128)
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, k, v, causal=False, window=32,
+                            interpret=True)

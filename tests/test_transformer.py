@@ -526,3 +526,33 @@ class TestRopeTransformer:
             got = np.asarray(net.rnn_time_step(h))
             np.testing.assert_allclose(got[0, :, 0], full[0, :, t],
                                        atol=1e-4, err_msg=f"pos {t}")
+
+
+class TestWindowLayer:
+    def test_window_streaming_matches_full(self):
+        layer = SelfAttentionLayer(n_out=16, n_heads=2, causal=True,
+                                   activation="identity", window=3,
+                                   cache_length=10)
+        p, _ = layer.init(jax.random.PRNGKey(9), InputType.recurrent(16, 8))
+        x = jnp.asarray(RNG.standard_normal((1, 16, 8)), jnp.float32)
+        full, _ = layer.apply(p, x, {})
+        state, outs = {}, []
+        for t in range(8):
+            y, state = layer.apply(p, x[:, :, t:t + 1], state, stream=True)
+            outs.append(np.asarray(y)[:, :, 0])
+        np.testing.assert_allclose(np.stack(outs, -1), np.asarray(full),
+                                   atol=1e-4)
+
+    def test_window_serde(self):
+        from deeplearning4j_tpu.nn.conf.layers import (
+            layer_from_dict, layer_to_dict,
+        )
+        layer = SelfAttentionLayer(n_out=16, window=128)
+        assert layer_from_dict(layer_to_dict(layer)).window == 128
+
+    def test_bad_window_rejected_at_init(self):
+        for bad_kw in ({"causal": False, "window": 4}, {"window": 0}):
+            layer = SelfAttentionLayer(n_out=16, n_heads=2, **bad_kw)
+            with pytest.raises(ValueError, match="window|causal"):
+                layer.init(jax.random.PRNGKey(0),
+                           InputType.recurrent(16, 8))
