@@ -37,7 +37,7 @@ import numpy as np
 #: (stripped on ordinary forwards; cleared by rnn_clear_previous_state):
 #: LSTM h/c, attention KV cache, positional-embedding offset
 STREAM_STATE_KEYS = frozenset(
-    {"h", "c", "kv_k", "kv_v", "kv_pos", "pos_offset"})
+    {"h", "c", "kv_k", "kv_v", "kv_pos", "kv_abs", "pos_offset"})
 
 
 def check_stream_budget(net, t: int, layers) -> None:
@@ -50,8 +50,11 @@ def check_stream_budget(net, t: int, layers) -> None:
     for l in layers:
         if not getattr(l, "supports_streaming", False):
             continue
-        for cap in (getattr(l, "cache_length", 0),
-                    getattr(l, "max_length", 0)):
+        windowed = getattr(l, "window", None) is not None
+        caps = [getattr(l, "max_length", 0)]
+        if not windowed:   # rolling window cache never fills up
+            caps.append(getattr(l, "cache_length", 0))
+        for cap in caps:
             if cap:
                 limit = cap if limit is None else min(limit, cap)
     if limit is not None and net._stream_pos > limit:
@@ -929,6 +932,10 @@ class SelfAttentionLayer(FeedForwardLayerConf):
             abs_pos = pos + jnp.arange(t)
             q = self._rope(q, abs_pos)
             k = self._rope(k, abs_pos)
+        if self.window is not None:
+            return self._stream_attend_rolling(
+                q, k, v, state, kc, vc, pos,
+                fresh=state.get("kv_k") is None)
         z = jnp.zeros((), pos.dtype)
         kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
                                           (z, z, pos, z))
@@ -937,23 +944,73 @@ class SelfAttentionLayer(FeedForwardLayerConf):
         # grouped attend against the UN-expanded cache: q reshaped to
         # [N, Hkv, reps, T, D] — materializing a repeated cache would
         # forfeit GQA's decode bandwidth win
+        # query at absolute position pos+i sees cache slots <= pos+i
+        k_idx = jnp.arange(L)
+        q_pos = pos + jnp.arange(t)
+        valid = k_idx[None, :] <= q_pos[:, None]            # [T, L]
+        o = self._grouped_attend(q, kc, vc, valid)
+        return o, {**state, "kv_k": kc, "kv_v": vc, "kv_pos": pos + t}
+
+    def _grouped_attend(self, q, kc, vc, valid):
+        """Masked attention of [N,H,T,D] queries against the un-expanded
+        [N,Hkv,L,D] cache (GQA groups share KV heads); valid: [T, L]."""
+        n, _, t, d = q.shape
+        hkv = kc.shape[1]
+        reps = self.n_heads // hkv
+        qg = q.astype(jnp.float32).reshape(n, hkv, reps, t, d)
+        s = jnp.einsum("ngrtd,ngld->ngrtl", qg,
+                       kc.astype(jnp.float32)) / np.sqrt(d)
+        s = jnp.where(valid[None, None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("ngrtl,ngld->ngrtd", p, vc.astype(jnp.float32))
+        return o.reshape(n, self.n_heads, t, d).astype(q.dtype)
+
+    def _stream_attend_rolling(self, q, k, v, state, kc, vc, pos, *,
+                               fresh):
+        """Windowed streaming with a ROLLING cache: slots are reused
+        modulo cache_length, so generation length is unbounded with
+        bounded memory (cache_length >= window keeps every in-window key
+        resident; evicted keys are out of the window by construction).
+        kv_abs tracks each slot's absolute position (-1 = empty)."""
+        n, _, t, d = q.shape
+        hkv = k.shape[1]
+        L = self.cache_length
+        if L < self.window:
+            raise ValueError(
+                f"rolling window streaming needs cache_length >= window "
+                f"({L} < {self.window})")
+        if fresh:
+            # empty cache: writes never evict needed keys; any t <= L ok
+            if t > L:
+                raise ValueError(f"priming chunk of {t} positions exceeds "
+                                 f"cache_length {L}")
+        elif t > L - self.window + 1:
+            # mid-stream, a larger chunk would overwrite slots still
+            # inside earlier queries' windows BEFORE they attend
+            raise ValueError(
+                f"mid-stream chunk of {t} positions would evict in-window "
+                f"keys; max is cache_length - window + 1 = "
+                f"{L - self.window + 1} (or raise cache_length)")
+        kv_abs = state.get("kv_abs")
+        if kv_abs is None:
+            kv_abs = jnp.full((L,), -1, jnp.int32)
+        q_pos = pos + jnp.arange(t)
+        slots = q_pos % L
+        kc = kc.at[:, :, slots, :].set(k.astype(kc.dtype))
+        vc = vc.at[:, :, slots, :].set(v.astype(vc.dtype))
+        kv_abs = kv_abs.at[slots].set(q_pos)
         reps = self.n_heads // hkv
         qg = q.astype(jnp.float32).reshape(n, hkv, reps, t, d)
         scale = 1.0 / np.sqrt(d)
         s = jnp.einsum("ngrtd,ngld->ngrtl", qg,
                        kc.astype(jnp.float32)) * scale
-        # query at absolute position pos+i sees cache slots <= pos+i
-        k_idx = jnp.arange(L)
-        q_pos = pos + jnp.arange(t)
-        valid = k_idx[None, :] <= q_pos[:, None]            # [T, L]
-        if self.window is not None:
-            valid = valid & (q_pos[:, None] - k_idx[None, :] < self.window)
+        valid = (kv_abs[None, :] >= 0) &                 (kv_abs[None, :] <= q_pos[:, None]) &                 (q_pos[:, None] - kv_abs[None, :] < self.window)
         s = jnp.where(valid[None, None, None], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("ngrtl,ngld->ngrtd", p,
-                       vc.astype(jnp.float32))
+        o = jnp.einsum("ngrtl,ngld->ngrtd", p, vc.astype(jnp.float32))
         o = o.reshape(n, self.n_heads, t, d).astype(q.dtype)
-        return o, {**state, "kv_k": kc, "kv_v": vc, "kv_pos": pos + t}
+        return o, {**state, "kv_k": kc, "kv_v": vc, "kv_abs": kv_abs,
+                   "kv_pos": pos + t}
 
     def _rope(self, x, positions):
         """Rotary position embedding (RoFormer rotate-half convention):

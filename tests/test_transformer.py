@@ -556,3 +556,91 @@ class TestWindowLayer:
             with pytest.raises(ValueError, match="window|causal"):
                 layer.init(jax.random.PRNGKey(0),
                            InputType.recurrent(16, 8))
+
+
+class TestRollingWindowStreaming:
+    """Windowed streaming with a rolling cache: unbounded generation with
+    bounded memory (cache_length >= window)."""
+
+    def _layer(self, W=4, L=6, rope=False):
+        layer = SelfAttentionLayer(n_out=16, n_heads=2, causal=True,
+                                   activation="identity", window=W,
+                                   cache_length=L, rope=rope)
+        p, _ = layer.init(jax.random.PRNGKey(11),
+                          InputType.recurrent(16, 8))
+        return layer, p
+
+    @pytest.mark.parametrize("rope", [False, True])
+    def test_streaming_past_cache_matches_full(self, rope):
+        # stream T=16 tokens through an L=6 cache: far past capacity —
+        # the rolling slots must keep every in-window key resident
+        layer, p = self._layer(W=4, L=6, rope=rope)
+        T = 16
+        x = jnp.asarray(RNG.standard_normal((1, 16, T)), jnp.float32)
+        full, _ = layer.apply(p, x, {})
+        state, outs = {}, []
+        for t in range(T):
+            y, state = layer.apply(p, x[:, :, t:t + 1], state, stream=True)
+            outs.append(np.asarray(y)[:, :, 0])
+        np.testing.assert_allclose(np.stack(outs, -1), np.asarray(full),
+                                   atol=1e-4)
+
+    def test_chunked_priming_with_wrap(self):
+        # prime with a chunk, then single steps crossing the wrap boundary
+        layer, p = self._layer(W=3, L=4)
+        T = 11
+        x = jnp.asarray(RNG.standard_normal((1, 16, T)), jnp.float32)
+        full, _ = layer.apply(p, x, {})
+        y, state = layer.apply(p, x[:, :, :4], {}, stream=True)
+        got = [np.asarray(y)]
+        for t in range(4, T):
+            y, state = layer.apply(p, x[:, :, t:t + 1], state, stream=True)
+            got.append(np.asarray(y))
+        np.testing.assert_allclose(np.concatenate(got, -1),
+                                   np.asarray(full), atol=1e-4)
+
+    def test_no_stream_budget_limit(self):
+        # windowed layers are exempt from the capacity guard: a network of
+        # them streams arbitrarily long
+        from deeplearning4j_tpu.nn.conf.layers import check_stream_budget
+
+        class Net:
+            pass
+
+        layer, _ = self._layer(W=4, L=6)
+        net = Net()
+        for _ in range(10):          # 10 x 8 positions >> cache_length 6
+            check_stream_budget(net, 8, [layer])
+
+    def test_cache_smaller_than_window_rejected(self):
+        layer, p = self._layer(W=8, L=4)
+        x = jnp.asarray(RNG.standard_normal((1, 16, 1)), jnp.float32)
+        with pytest.raises(ValueError, match="cache_length >= window"):
+            layer.apply(p, x, {}, stream=True)
+
+    def test_midstream_chunk_eviction_rejected(self):
+        # the reviewer's trace: W=3, L=4, positions 0-3 streamed singly,
+        # then a 3-token chunk would overwrite slot 2 (key 2, still in
+        # position 4's window) before attending — must be rejected
+        layer, p = self._layer(W=3, L=4)
+        x = jnp.asarray(RNG.standard_normal((1, 16, 7)), jnp.float32)
+        state = {}
+        for t in range(4):
+            _, state = layer.apply(p, x[:, :, t:t + 1], state, stream=True)
+        with pytest.raises(ValueError, match="evict in-window"):
+            layer.apply(p, x[:, :, 4:7], state, stream=True)
+
+    def test_midstream_chunk_at_safe_bound_matches_full(self):
+        # chunks up to L - W + 1 positions are safe mid-stream
+        layer, p = self._layer(W=3, L=6)   # safe chunk = 4
+        T = 12
+        x = jnp.asarray(RNG.standard_normal((1, 16, T)), jnp.float32)
+        full, _ = layer.apply(p, x, {})
+        y, state = layer.apply(p, x[:, :, :4], {}, stream=True)
+        got = [np.asarray(y)]
+        for s0 in range(4, T, 4):
+            y, state = layer.apply(p, x[:, :, s0:s0 + 4], state,
+                                   stream=True)
+            got.append(np.asarray(y))
+        np.testing.assert_allclose(np.concatenate(got, -1),
+                                   np.asarray(full), atol=1e-4)
