@@ -302,6 +302,46 @@ def bench_scaling():
                       "value": round(B * 10 / dt, 1), "unit": "images/sec"}))
 
 
+def bench_window_attention():
+    """Sliding-window local attention at long T: the kernel skips blocks
+    outside the window, so cost is O(T*W) — compare against full causal
+    attention at the same length."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.parallel.sequence import blockwise_attention
+
+    B, H, T, D = 1, 8, int(os.environ.get("BENCH_ATTN_T", "32768")), 128
+    W = int(os.environ.get("BENCH_ATTN_W", "4096"))
+    rng = np.random.default_rng(0)
+    q0 = jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.bfloat16)
+
+    def bench(step, n=10):
+        x = step(q0)
+        float(jnp.sum(x.astype(jnp.float32)))
+        t0 = time.perf_counter()
+        for _ in range(n):
+            x = step(x)          # chained: defeats execution caching
+        float(jnp.sum(x.astype(jnp.float32)))
+        return (time.perf_counter() - t0) / n
+
+    # blockwise_attention dispatches to the Pallas kernel on TPU and
+    # degrades to the scan path elsewhere (like the sibling benches)
+    full = jax.jit(lambda q: 0.5 * q +
+                   0.5 * blockwise_attention(q, k, v, causal=True,
+                                             block_size=4096))
+    local = jax.jit(lambda q: 0.5 * q +
+                    0.5 * blockwise_attention(q, k, v, causal=True,
+                                              window=W, block_size=4096))
+    tf, tl = bench(full), bench(local)
+    print(json.dumps({"metric": f"window_attention_T{T}_W{W}",
+                      "value": round(B * T / tl, 1), "unit": "tokens/sec",
+                      "full_causal_tokens_per_sec": round(B * T / tf, 1)}))
+
+
 def bench_word2vec():
     """Word2Vec skip-gram/NS embedding training throughput (words/sec):
     host pair-gen + batched device scatter-add steps (the reference's
@@ -340,7 +380,8 @@ def bench_word2vec():
 ALL = {"resnet": bench_resnet, "lstm": bench_lstm, "lenet": bench_lenet,
        "vgg16": bench_vgg16, "inception": bench_keras_inception,
        "attention": bench_attention, "transformer": bench_transformer,
-       "scaling": bench_scaling, "word2vec": bench_word2vec}
+       "scaling": bench_scaling, "word2vec": bench_word2vec,
+       "window": bench_window_attention}
 
 if __name__ == "__main__":
     names = sys.argv[1:] or ["resnet", "lstm", "lenet", "vgg16",

@@ -41,7 +41,7 @@ class TextGenerationTransformer(ZooModel):
                  embed_dim: int = 256, n_heads: int = 8, n_layers: int = 4,
                  ffn_mult: int = 4, max_length: int = 1024,
                  block_size: int = 512, positional: str = "learned",
-                 n_kv_heads=None, **kw):
+                 n_kv_heads=None, window=None, **kw):
         super().__init__(vocab_size, seed, **kw)
         if embed_dim % n_heads:
             raise ValueError("embed_dim must divide by n_heads")
@@ -56,6 +56,7 @@ class TextGenerationTransformer(ZooModel):
             raise ValueError(f"unknown positional {positional!r}")
         self.positional = positional
         self.n_kv_heads = n_kv_heads
+        self.window = window
 
     def conf(self):
         E = self.embed_dim
@@ -84,7 +85,7 @@ class TextGenerationTransformer(ZooModel):
                 n_out=E, n_heads=self.n_heads, causal=True,
                 block_size=self.block_size, activation="identity",
                 cache_length=self.max_length,
-                n_kv_heads=self.n_kv_heads,
+                n_kv_heads=self.n_kv_heads, window=self.window,
                 rope=self.positional == "rope"), f"ln{i}a")
             g.add_vertex(f"res{i}a", ElementWiseVertex(op="add"),
                          prev, f"attn{i}")

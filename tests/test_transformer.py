@@ -644,3 +644,10 @@ class TestRollingWindowStreaming:
             got.append(np.asarray(y))
         np.testing.assert_allclose(np.concatenate(got, -1),
                                    np.asarray(full), atol=1e-4)
+
+
+def test_zoo_window_passthrough():
+    model = TextGenerationTransformer(vocab_size=8, embed_dim=16, n_heads=2,
+                                      n_layers=1, max_length=16, window=8)
+    conf = model.conf()
+    assert conf.vertices["attn0"].layer.window == 8
