@@ -18,6 +18,7 @@ import dataclasses
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.nn.conf.inputs import InputType
@@ -128,6 +129,86 @@ class LayerVertex(GraphVertexConf):
             m = self.preprocessor.output_mask(m, it)
             it = self.preprocessor.output_type(it)
         return self.layer.output_mask(m, it)
+
+
+@register_vertex
+@dataclass
+class CrossAttentionVertex(GraphVertexConf):
+    """Multi-head cross attention: queries from input 0, keys/values from
+    input 1 (both RNN-format [N,F,T]) — the encoder-decoder bridge the
+    2017 reference predates. Scores/outputs run through
+    blockwise_attention (Pallas flash kernel on TPU — its query/key
+    lengths are independent, so decoder and encoder lengths may differ);
+    input 1's feature mask masks encoder padding KEYS.
+
+    Params: Wq [Fq,E]+bq from input 0; Wk/Wv [Fkv,E]+bk/bv from input 1;
+    Wo [E,E]+bo. `n_out` defaults to input 0's size; `n_heads` must
+    divide it."""
+
+    n_out: Optional[int] = None
+    n_heads: int = 4
+    block_size: int = 512
+    weight_init: str = "xavier"
+
+    def output_type(self, its):
+        if any(it.kind != "rnn" for it in its[:2]):
+            raise ValueError("CrossAttentionVertex needs two RNN inputs")
+        return InputType.recurrent(self.n_out or its[0].size,
+                                   its[0].timesteps)
+
+    def init(self, key, its):
+        if len(its) < 2:
+            raise ValueError("CrossAttentionVertex needs two inputs "
+                             "(queries, memory)")
+        from deeplearning4j_tpu.nn.weights import init_weights
+        E = self.n_out or its[0].size
+        if E % self.n_heads:
+            raise ValueError(f"n_out {E} not divisible by n_heads "
+                             f"{self.n_heads}")
+        self.n_out = E
+        fq, fkv = its[0].size, its[1].size
+        keys = jax.random.split(key, 4)
+        p = {}
+        for i, (name, f_in) in enumerate((("q", fq), ("k", fkv),
+                                          ("v", fkv), ("o", E))):
+            p["W" + name] = init_weights(keys[i], (f_in, E), f_in, E,
+                                         self.weight_init, None)
+            p["b" + name] = jnp.zeros((E,), jnp.float32)
+        return p, {}
+
+    #: graph passes the full per-input mask list (encoder mask = keys)
+    wants_all_masks = True
+
+    def apply(self, params, xs, state, *, train=False, rng=None, mask=None):
+        # intentionally parallel to SelfAttentionLayer.apply's project/
+        # split/attend/merge sequence (nn/conf/layers.py) — kept separate
+        # because the layer variant carries GQA/rope/streaming/window
+        # behavior this two-input vertex deliberately does not
+        from deeplearning4j_tpu.parallel.sequence import blockwise_attention
+        xq, xkv = xs[0], xs[1]
+        kv_mask = mask[1] if isinstance(mask, (list, tuple)) and \
+            len(mask) > 1 else None
+        n, _, tq = xq.shape
+        tk = xkv.shape[2]
+        h = self.n_heads
+        d = self.n_out // h
+
+        def proj(x, t, name):
+            y = jnp.transpose(x, (0, 2, 1)) @ params["W" + name] + \
+                params["b" + name]
+            return y.reshape(n, t, h, d).transpose(0, 2, 1, 3)
+
+        q = proj(xq, tq, "q")
+        k, v = proj(xkv, tk, "k"), proj(xkv, tk, "v")
+        o = blockwise_attention(q, k, v, causal=False,
+                                block_size=self.block_size,
+                                key_mask=kv_mask)
+        o = o.transpose(0, 2, 1, 3).reshape(n, tq, self.n_out)
+        o = o @ params["Wo"] + params["bo"]
+        return jnp.transpose(o, (0, 2, 1)), state
+
+    def output_mask(self, masks, its):
+        return masks[0] if masks else None   # query-side mask propagates
 
 
 @register_vertex

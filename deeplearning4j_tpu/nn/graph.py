@@ -118,7 +118,10 @@ class ComputationGraph:
             ins = self.conf.vertex_inputs.get(name, [])
             xs = [acts[i_] for i_ in ins]
             in_masks = [masks.get(i_) for i_ in ins]
-            mask = next((m for m in in_masks if m is not None), None)
+            if getattr(v, "wants_all_masks", False):
+                mask = in_masks      # e.g. cross attention: keys = input 1
+            else:
+                mask = next((m for m in in_masks if m is not None), None)
             v_state = state.get(name, {})
             if not carry_rnn:
                 v_state = {k: val for k, val in v_state.items()

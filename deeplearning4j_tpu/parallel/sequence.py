@@ -89,15 +89,18 @@ def blockwise_attention(q, k, v, causal: bool = False,
         return flash_attention(q, k, v, causal=causal, key_mask=key_mask,
                                window=window)
     B, H, T, D = q.shape
-    bs = int(min(block_size, T))
-    pad = (-T) % bs
+    Tk = k.shape[2]                     # may differ (cross attention)
+    if causal and T != Tk:
+        raise ValueError(f"causal attention needs Tq == Tk ({T} vs {Tk})")
+    bs = int(min(block_size, Tk))
+    pad = (-Tk) % bs
     if pad:
         k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
     if key_mask is not None:
         km = jnp.pad(key_mask.astype(bool), ((0, 0), (0, pad)))
         kmb = km.reshape(B, -1, bs).transpose(1, 0, 2)   # [n_blocks,B,bs]
-    n_blocks = (T + pad) // bs
+    n_blocks = (Tk + pad) // bs
     scale = jnp.float32(1.0 / np.sqrt(D))
     qf = q.astype(jnp.float32)
     kb = k.reshape(B, H, n_blocks, bs, D).transpose(2, 0, 1, 3, 4)
@@ -114,7 +117,7 @@ def blockwise_attention(q, k, v, causal: bool = False,
         s = jnp.einsum("bhqd,bhkd->bhqk", qf,
                        kc.astype(jnp.float32)) * scale
         k_pos = idx * bs + jnp.arange(bs)
-        valid = k_pos < T                                # pad mask
+        valid = k_pos < Tk                               # pad mask
         if causal:
             valid = valid[None, :] & (q_pos[:, None] >= k_pos[None, :])
             if window is not None:

@@ -1,6 +1,8 @@
 """Graph module tests (ref: deeplearning4j-graph/src/test — TestGraph,
 TestGraphLoading, DeepWalkGradientCheck/TestDeepWalk)."""
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -141,3 +143,106 @@ class TestDeepWalk:
         vecs[1] = [0.9, 0.1, 0, 0]
         gv = GraphVectors(vecs)
         assert gv.vertices_nearest(0, top_n=1) == [1]
+
+
+class TestCrossAttentionVertex:
+    """Encoder-decoder bridge: queries from input 0, keys/values from
+    input 1 (lengths may differ); input 1's mask hides encoder padding."""
+
+    def _vertex_and_params(self, E=16, H=2, fq=16, fkv=12, seed=0):
+        from deeplearning4j_tpu.nn.conf.graph_conf import (
+            CrossAttentionVertex,
+        )
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        v = CrossAttentionVertex(n_out=E, n_heads=H)
+        p, s = v.init(jax.random.PRNGKey(seed),
+                      [InputType.recurrent(fq, 6),
+                       InputType.recurrent(fkv, 9)])
+        return v, p
+
+    def test_matches_reference_math(self):
+        import numpy as onp
+        v, p = self._vertex_and_params()
+        rng = onp.random.default_rng(1)
+        xq = jnp.asarray(rng.standard_normal((2, 16, 6)), jnp.float32)
+        xkv = jnp.asarray(rng.standard_normal((2, 12, 9)), jnp.float32)
+        out, _ = v.apply(p, [xq, xkv], {})
+        assert out.shape == (2, 16, 6)
+
+        # naive reference
+        def prj(x, w, b):
+            return onp.einsum("nft,fe->nte", onp.asarray(x),
+                              onp.asarray(w)) + onp.asarray(b)
+        q = prj(xq, p["Wq"], p["bq"]).reshape(2, 6, 2, 8)
+        k = prj(xkv, p["Wk"], p["bk"]).reshape(2, 9, 2, 8)
+        vv = prj(xkv, p["Wv"], p["bv"]).reshape(2, 9, 2, 8)
+        s = onp.einsum("nqhd,nkhd->nhqk", q, k) / onp.sqrt(8)
+        w = onp.exp(s - s.max(-1, keepdims=True))
+        w /= w.sum(-1, keepdims=True)
+        o = onp.einsum("nhqk,nkhd->nqhd", w, vv).reshape(2, 6, 16)
+        o = o @ onp.asarray(p["Wo"]) + onp.asarray(p["bo"])
+        onp.testing.assert_allclose(onp.asarray(out),
+                                    o.transpose(0, 2, 1), atol=1e-4)
+
+    def test_key_mask_hides_encoder_padding(self):
+        import numpy as onp
+        v, p = self._vertex_and_params()
+        rng = onp.random.default_rng(2)
+        xq = jnp.asarray(rng.standard_normal((1, 16, 6)), jnp.float32)
+        xkv_full = rng.standard_normal((1, 12, 9)).astype(onp.float32)
+        # padded memory with mask == truncated memory without
+        xkv_pad = onp.array(xkv_full)
+        xkv_pad[:, :, 5:] = 7.7        # garbage in padded region
+        km = onp.zeros((1, 9), onp.float32)
+        km[:, :5] = 1.0
+        out_masked, _ = v.apply(p, [xq, jnp.asarray(xkv_pad)], {},
+                                mask=[None, jnp.asarray(km)])
+        out_trunc, _ = v.apply(p, [xq, jnp.asarray(xkv_full[:, :, :5])], {})
+        onp.testing.assert_allclose(onp.asarray(out_masked),
+                                    onp.asarray(out_trunc), atol=1e-4)
+
+    def test_encoder_decoder_graph_trains(self):
+        import numpy as onp
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.graph_conf import (
+            CrossAttentionVertex,
+        )
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (
+            LSTM, RnnOutputLayer,
+        )
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        conf = (NeuralNetConfiguration.Builder().seed(3)
+                .graph_builder()
+                .add_inputs("dec", "enc")
+                .set_input_types(InputType.recurrent(8, 5),
+                                 InputType.recurrent(6, 7))
+                .add_layer("enc_l", LSTM(n_out=12), "enc")
+                .add_layer("dec_l", LSTM(n_out=12), "dec")
+                .add_vertex("xattn", CrossAttentionVertex(n_heads=2),
+                            "dec_l", "enc_l")
+                .add_layer("out", RnnOutputLayer(n_out=4, loss="mcxent",
+                                                 activation="softmax"),
+                           "xattn")
+                .set_outputs("out").build())
+        net = ComputationGraph(conf).init()
+        rng = onp.random.default_rng(0)
+        dec = rng.standard_normal((2, 8, 5)).astype(onp.float32)
+        enc = rng.standard_normal((2, 6, 7)).astype(onp.float32)
+        y = onp.zeros((2, 4, 5), onp.float32)
+        y[:, 0, :] = 1.0
+        net.fit(DataSet({"dec": dec, "enc": enc}, {"out": y}))
+        assert onp.isfinite(net.score_value)
+        out = net.output({"dec": dec, "enc": enc})
+        got = out[0] if isinstance(out, (list, tuple)) else out
+        assert onp.asarray(got).shape == (2, 4, 5)
+
+    def test_serde_round_trip(self):
+        from deeplearning4j_tpu.nn.conf.graph_conf import (
+            CrossAttentionVertex, vertex_from_dict, vertex_to_dict,
+        )
+        v = CrossAttentionVertex(n_out=32, n_heads=4)
+        back = vertex_from_dict(vertex_to_dict(v))
+        assert isinstance(back, CrossAttentionVertex)
+        assert back.n_out == 32 and back.n_heads == 4
