@@ -1,0 +1,84 @@
+"""Encoder-decoder sequence transduction with cross attention.
+
+A miniature seq2seq journey on a synthetic token-reversal task: an LSTM
+encoder reads the source, an LSTM decoder (teacher-forced) attends over
+the encoder states through CrossAttentionVertex, and the model learns to
+emit the source sequence reversed (truncated to the target length).
+Source and target lengths DIFFER (10 vs 8 by default) — the attention
+core handles unequal query/key lengths natively.
+
+Run: python examples/seq2seq_translation.py
+"""
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph_conf import CrossAttentionVertex
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import LSTM, RnnOutputLayer
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.updater import Adam
+
+
+def make_batch(rng, B, V, t_src, t_tgt):
+    """Source: random tokens; target: source reversed, truncated or
+    0-padded to t_tgt. Decoder input is the target shifted right
+    (teacher forcing, BOS = one-hot 0)."""
+    src_ids = rng.integers(1, V, (B, t_src))
+    rev = src_ids[:, ::-1]
+    if t_tgt <= t_src:
+        tgt_ids = rev[:, :t_tgt]
+    else:
+        tgt_ids = np.zeros((B, t_tgt), rev.dtype)   # 0 = PAD token
+        tgt_ids[:, :t_src] = rev
+
+    def one_hot(ids, t):
+        x = np.zeros((B, V, t), np.float32)
+        x[np.arange(B)[:, None], ids, np.arange(t)[None, :]] = 1.0
+        return x
+
+    enc = one_hot(src_ids, t_src)
+    y = one_hot(tgt_ids, t_tgt)
+    dec_in = np.zeros_like(y)
+    dec_in[:, 0, 0] = 1.0                  # BOS
+    dec_in[:, :, 1:] = y[:, :, :-1]        # shifted targets
+    return enc, dec_in, y
+
+
+def main(steps: int = 150, V: int = 12, t_src: int = 10,
+         t_tgt: int = 8):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(7).updater(Adam(5e-3))
+            .graph_builder()
+            .add_inputs("dec", "enc")
+            .set_input_types(InputType.recurrent(V, t_tgt),
+                             InputType.recurrent(V, t_src))
+            .add_layer("enc_l", LSTM(n_out=32), "enc")
+            .add_layer("dec_l", LSTM(n_out=32), "dec")
+            .add_vertex("xattn", CrossAttentionVertex(n_heads=4),
+                        "dec_l", "enc_l")
+            .add_layer("out", RnnOutputLayer(n_out=V, loss="mcxent",
+                                             activation="softmax"),
+                       "xattn")
+            .set_outputs("out").build())
+    net = ComputationGraph(conf).init()
+
+    rng = np.random.default_rng(0)
+    for step in range(steps):
+        enc, dec_in, y = make_batch(rng, 32, V, t_src, t_tgt)
+        net.fit(DataSet({"dec": dec_in, "enc": enc}, {"out": y}))
+        if step % 25 == 0:
+            print(f"step {step}: loss {net.score_value:.4f}")
+
+    # teacher-forced token accuracy on a fresh batch
+    enc, dec_in, y = make_batch(rng, 64, V, t_src, t_tgt)
+    out = net.output({"dec": dec_in, "enc": enc})
+    probs = np.asarray(out[0] if isinstance(out, (list, tuple)) else out)
+    acc = float((probs.argmax(1) == y.argmax(1)).mean())
+    print(f"teacher-forced token accuracy: {acc:.3f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

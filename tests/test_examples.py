@@ -48,3 +48,10 @@ def test_long_context_mesh():
     # the reconstruction task (initial loss ~1.13)
     loss = _run("long_context_mesh", steps=120, t_per_device=16)
     assert loss < 0.7
+
+
+def test_seq2seq_translation():
+    # cross attention must let the decoder copy from the encoder: the
+    # reversal task is near-perfectly solvable with attention
+    acc = _run("seq2seq_translation", steps=250)
+    assert acc > 0.85
