@@ -39,6 +39,24 @@ import numpy as np
 STREAM_STATE_KEYS = frozenset(
     {"h", "c", "kv_k", "kv_v", "kv_pos", "kv_abs", "pos_offset"})
 
+#: streaming-state keys whose LEADING axis is the batch dimension (beam
+#: search gathers these when pruning beams; kv_pos/kv_abs/pos_offset are
+#: batch-independent scalars/vectors)
+BATCHED_STREAM_KEYS = frozenset({"h", "c", "kv_k", "kv_v"})
+
+
+def reorder_stream_state(net, indices) -> None:
+    """Gather the batch dimension of every carried streaming-state array
+    (beam-search pruning: surviving beam b continues from parent
+    indices[b]'s caches/RNN state). `indices`: int array [new_batch]."""
+    idx = jnp.asarray(indices)
+    for name, s in net.state.items():
+        if not isinstance(s, dict):
+            continue
+        net.state[name] = {
+            kk: (vv[idx] if kk in BATCHED_STREAM_KEYS else vv)
+            for kk, vv in s.items()}
+
 
 def check_stream_budget(net, t: int, layers) -> None:
     """Host-side guard for streaming inference: dynamic_update_slice

@@ -651,3 +651,89 @@ def test_zoo_window_passthrough():
                                       n_layers=1, max_length=16, window=8)
     conf = model.conf()
     assert conf.vertices["attn0"].layer.window == 8
+
+
+class TestBeamSearch:
+    """Beam search on the streaming KV-cache machinery: beams ride the
+    batch dim; pruning gathers carried state (reorder_stream_state)."""
+
+    def _net(self, **kw):
+        model = TextGenerationTransformer(vocab_size=10, embed_dim=16,
+                                          n_heads=2, n_layers=2,
+                                          max_length=20, **kw)
+        return model, model.init()
+
+    def test_beam1_equals_greedy_stream(self):
+        # width-1 beam == greedy argmax decoding step by step
+        model, net = self._net()
+        ids, score = model.beam_search(net, [1, 2], steps=6, beam_width=1)
+        assert len(ids) == 8 and np.isfinite(score)
+
+        net.rnn_clear_previous_state()
+        x = np.zeros((1, 10, 2), np.float32)
+        x[0, [1, 2], np.arange(2)] = 1.0
+        out = net.rnn_time_step(x)
+        greedy = [1, 2]
+        for _ in range(6):
+            probs = np.asarray(out[0] if isinstance(out, (list, tuple))
+                               else out)[0, :, -1]
+            nxt = int(probs.argmax())
+            greedy.append(nxt)
+            h = np.zeros((1, 10, 1), np.float32)
+            h[0, nxt, 0] = 1.0
+            out = net.rnn_time_step(h)
+        assert ids == greedy[:len(ids)]
+
+    def test_beam_score_is_sequence_logprob(self):
+        # the returned score must equal the sum of the model's stepwise
+        # log-probs for the returned continuation (teacher-forced check)
+        model, net = self._net()
+        seed = [3, 1]
+        ids, score = model.beam_search(net, seed, steps=5, beam_width=3)
+        cont = ids[len(seed):]
+        x = np.zeros((1, 10, len(ids)), np.float32)
+        x[0, ids, np.arange(len(ids))] = 1.0
+        out = net.output(x)
+        probs = np.asarray(out[0] if isinstance(out, (list, tuple))
+                           else out)[0]
+        lp = sum(np.log(probs[tok, len(seed) - 1 + t])
+                 for t, tok in enumerate(cont))
+        np.testing.assert_allclose(score, lp, atol=1e-3)
+
+    def test_full_width_beam_is_exhaustive_optimum(self):
+        # beam width == vocab with 2 steps retains every step-1 prefix,
+        # so the search is exhaustive: its best sequence must equal the
+        # argmax over all V^2 continuations (teacher-forced brute force)
+        model, net = self._net()
+        V, seed = 10, [2, 5]
+        ids, score = model.beam_search(net, seed, steps=2, beam_width=V)
+
+        best_lp, best_seq = -np.inf, None
+        for a in range(V):
+            for b in range(V):
+                full = seed + [a, b]
+                x = np.zeros((1, V, 4), np.float32)
+                x[0, full, np.arange(4)] = 1.0
+                out = net.output(x)
+                p = np.asarray(out[0] if isinstance(out, (list, tuple))
+                               else out)[0]
+                lp = np.log(p[a, 1]) + np.log(p[b, 2])
+                if lp > best_lp:
+                    best_lp, best_seq = lp, full
+        assert ids == best_seq
+        np.testing.assert_allclose(score, best_lp, atol=1e-3)
+
+    def test_steps_zero_rejected(self):
+        model, net = self._net()
+        with pytest.raises(ValueError, match="steps"):
+            model.beam_search(net, [1], steps=0)
+
+    def test_beam_width_clamped_to_vocab(self):
+        model, net = self._net()
+        ids, score = model.beam_search(net, [1], steps=3, beam_width=50)
+        assert len(ids) == 4 and np.isfinite(score)
+
+    def test_beam_search_with_rope_gqa_window(self):
+        model, net = self._net(positional="rope", n_kv_heads=1, window=6)
+        ids, score = model.beam_search(net, [1], steps=10, beam_width=3)
+        assert len(ids) == 11 and np.isfinite(score)
