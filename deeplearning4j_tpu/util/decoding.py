@@ -1,0 +1,83 @@
+"""Decoding strategies over the streaming rnn_time_step machinery.
+
+Model-agnostic: works for ANY network whose rnn_time_step carries
+batch-leading streaming state — LSTM h/c (the reference's
+rnnTimeStep-based generation, MultiLayerNetwork.java rnnTimeStep) and
+attention KV caches alike. Beams ride the batch dimension; pruning
+gathers the carried state with reorder_stream_state so surviving beams
+continue from their parent's caches.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.layers import reorder_stream_state
+
+
+def _one_hot(rows: np.ndarray, vocab: int) -> np.ndarray:
+    rows = np.asarray(rows)
+    b, t = rows.shape
+    x = np.zeros((b, vocab, t), np.float32)
+    x[np.arange(b)[:, None], rows, np.arange(t)[None, :]] = 1.0
+    return x
+
+
+def _probs(out) -> np.ndarray:
+    return np.asarray(out[0] if isinstance(out, (list, tuple)) else out)
+
+
+def beam_search(net, seed_ids, steps: int, vocab_size: int,
+                beam_width: int = 4,
+                max_length: Optional[int] = None
+                ) -> Tuple[List[int], float]:
+    """Highest-log-prob continuation of `seed_ids` by beam search.
+
+    `net` needs rnn_time_step / rnn_clear_previous_state (MultiLayerNetwork
+    or ComputationGraph, single one-hot [N,V,T] input). `max_length`
+    bounds seed+generation (None = unbounded; required finite for models
+    with positional tables or non-rolling caches)."""
+    V = vocab_size
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
+    if max_length is not None and len(seed_ids) >= max_length:
+        raise ValueError(f"seed of {len(seed_ids)} tokens leaves no room "
+                         f"under max_length {max_length}")
+    W = min(beam_width, V)     # top-k can't exceed the vocab
+    net.rnn_clear_previous_state()
+
+    # prime ONCE at batch 1, then broadcast the carried state to W beams
+    out = net.rnn_time_step(_one_hot(np.asarray(seed_ids)[None, :], V))
+    reorder_stream_state(net, np.zeros(W, np.int64))
+    out = np.repeat(_probs(out)[:1], W, axis=0)
+    beams = [list(seed_ids) for _ in range(W)]
+    scores = np.zeros(W)
+    first = True
+    for i in range(steps):
+        if max_length is not None and len(beams[0]) >= max_length:
+            break
+        logp = np.log(np.clip(_probs(out)[:, :, -1], 1e-12, None))  # [W,V]
+        if first:
+            # identical primed beams must diverge: top-W FIRST tokens of
+            # beam 0, not W copies of the argmax
+            top = np.argsort(logp[0])[::-1][:W]
+            parents, tokens, scores = np.zeros(W, np.int64), top, \
+                logp[0][top]
+            first = False
+        else:
+            total = scores[:, None] + logp
+            flat = np.argsort(total.ravel())[::-1][:W]
+            parents, tokens = np.divmod(flat, V)
+            scores = total.ravel()[flat]
+        beams = [beams[p] + [int(t)] for p, t in zip(parents, tokens)]
+        more = i + 1 < steps and (max_length is None
+                                  or len(beams[0]) < max_length)
+        if more:
+            if not np.array_equal(parents, np.arange(W)):
+                reorder_stream_state(net, parents)  # inherit caches
+            out = net.rnn_time_step(_one_hot(np.asarray(tokens)[:, None],
+                                             V))
+    best = int(np.argmax(scores))
+    return beams[best], float(scores[best])

@@ -32,3 +32,14 @@ class TextGenerationLSTM(ZooModel):
                                                      self.max_length))
                 .tbptt(self.max_length)
                 .build())
+
+    def beam_search(self, net, seed_ids, steps: int, beam_width: int = 4,
+                    vocab_size: int = None):
+        """Beam-search decoding over the stored-state rnnTimeStep path
+        (shared implementation: util/decoding.beam_search; LSTM h/c is
+        the carried state). Generation length is unbounded — recurrent
+        state has no positional capacity."""
+        from deeplearning4j_tpu.util.decoding import beam_search
+        return beam_search(net, seed_ids, steps,
+                           vocab_size or self.vocab_size,
+                           beam_width=beam_width, max_length=None)

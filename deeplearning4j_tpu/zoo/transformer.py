@@ -164,64 +164,12 @@ class TextGenerationTransformer(ZooModel):
 
     def beam_search(self, net, seed_ids, steps: int, beam_width: int = 4,
                     vocab_size: int = None):
-        """Beam-search decoding on the streaming KV-cache machinery:
-        beams ride the BATCH dimension of rnn_time_step, so each beam
-        keeps its own caches, and pruning gathers the carried state with
-        reorder_stream_state (surviving beams continue from their
-        parent's caches). Returns the highest log-probability sequence.
-        """
-        from deeplearning4j_tpu.nn.conf.layers import reorder_stream_state
-        V = vocab_size or self.vocab_size
-        if steps < 1:
-            raise ValueError(f"steps must be >= 1, got {steps}")
-        if len(seed_ids) >= self.max_length:
-            raise ValueError(f"seed of {len(seed_ids)} tokens leaves no "
-                             f"room under max_length {self.max_length}")
-        W = min(beam_width, V)     # top-k can't exceed the vocab
-        net.rnn_clear_previous_state()
-
-        def one_hot(rows):             # rows: [B, t] token ids
-            rows = np.asarray(rows)
-            b, t = rows.shape
-            x = np.zeros((b, V, t), np.float32)
-            x[np.arange(b)[:, None], rows, np.arange(t)[None, :]] = 1.0
-            return x
-
-        # prime ONCE at batch 1, then broadcast the carried state to the
-        # W beams (the seed prefill is identical across beams)
-        out = net.rnn_time_step(one_hot(np.asarray(seed_ids)[None, :]))
-        reorder_stream_state(net, np.zeros(W, np.int64))
-        out_row = np.asarray(out[0] if isinstance(out, (list, tuple))
-                             else out)[:1]
-        out = np.repeat(out_row, W, axis=0)
-        beams = [list(seed_ids) for _ in range(W)]
-        # identical beams must diverge on step 1: take the top-W FIRST
-        # tokens of beam 0 rather than W copies of the argmax
-        scores = np.zeros(W)
-        first = True
-        for i in range(steps):
-            if len(beams[0]) >= self.max_length:
-                break
-            probs = np.asarray(out[0] if isinstance(out, (list, tuple))
-                               else out)[:, :, -1]          # [W, V]
-            logp = np.log(np.clip(probs, 1e-12, None))
-            if first:
-                cand = logp[0]                              # [V]
-                top = np.argsort(cand)[::-1][:W]
-                parents = np.zeros(W, np.int64)
-                tokens = top
-                scores = cand[top]
-                first = False
-            else:
-                total = scores[:, None] + logp              # [W, V]
-                flat = np.argsort(total.ravel())[::-1][:W]
-                parents, tokens = np.divmod(flat, V)
-                scores = total.ravel()[flat]
-            beams = [beams[p] + [int(t)] for p, t in zip(parents, tokens)]
-            if i + 1 < steps and len(beams[0]) < self.max_length:
-                if not np.array_equal(parents, np.arange(W)):
-                    reorder_stream_state(net, parents)  # inherit caches
-                out = net.rnn_time_step(one_hot(
-                    np.asarray(tokens)[:, None]))
-        best = int(np.argmax(scores))
-        return beams[best], float(scores[best])
+        """Beam-search decoding on the streaming KV-cache machinery
+        (shared implementation: util/decoding.beam_search — beams ride
+        the batch dimension, pruning gathers the carried state). Returns
+        (best token sequence, its log-probability)."""
+        from deeplearning4j_tpu.util.decoding import beam_search
+        return beam_search(net, seed_ids, steps,
+                           vocab_size or self.vocab_size,
+                           beam_width=beam_width,
+                           max_length=self.max_length)

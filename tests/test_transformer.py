@@ -737,3 +737,29 @@ class TestBeamSearch:
         model, net = self._net(positional="rope", n_kv_heads=1, window=6)
         ids, score = model.beam_search(net, [1], steps=10, beam_width=3)
         assert len(ids) == 11 and np.isfinite(score)
+
+    def test_lstm_beam_search(self):
+        # the same decoder drives the reference-era LSTM LM through its
+        # stored-state rnnTimeStep path (h/c carried, unbounded length)
+        from deeplearning4j_tpu.zoo import TextGenerationLSTM
+        model = TextGenerationLSTM(vocab_size=9, hidden=16, layers=1,
+                                   max_length=12)
+        net = model.init()
+        ids, score = model.beam_search(net, [1, 4], steps=20, beam_width=3)
+        assert len(ids) == 22 and np.isfinite(score) and score < 0
+
+    def test_lstm_beam_score_is_sequence_logprob(self):
+        from deeplearning4j_tpu.zoo import TextGenerationLSTM
+        model = TextGenerationLSTM(vocab_size=9, hidden=16, layers=1,
+                                   max_length=16)
+        net = model.init()
+        seed = [2, 7]
+        ids, score = model.beam_search(net, seed, steps=4, beam_width=3)
+        x = np.zeros((1, 9, len(ids)), np.float32)
+        x[0, ids, np.arange(len(ids))] = 1.0
+        out = net.output(x)
+        probs = np.asarray(out[0] if isinstance(out, (list, tuple))
+                           else out)[0]
+        lp = sum(np.log(probs[tok, len(seed) - 1 + t])
+                 for t, tok in enumerate(ids[len(seed):]))
+        np.testing.assert_allclose(score, lp, atol=1e-3)
