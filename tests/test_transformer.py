@@ -763,3 +763,17 @@ class TestBeamSearch:
         lp = sum(np.log(probs[tok, len(seed) - 1 + t])
                  for t, tok in enumerate(ids[len(seed):]))
         np.testing.assert_allclose(score, lp, atol=1e-3)
+
+
+def test_sample_and_sample_stream_identical_sequences():
+    """User-level lock on streaming==full: with identically seeded RNGs,
+    the padded full-forward sampler and the KV-cache streaming sampler
+    must emit the SAME token sequence."""
+    model = TextGenerationTransformer(vocab_size=12, embed_dim=16,
+                                      n_heads=2, n_layers=2, max_length=16)
+    net = model.init()
+    a = model.sample(net, [1, 2, 3], steps=8, temperature=0.8,
+                     rng=np.random.default_rng(42))
+    b = model.sample_stream(net, [1, 2, 3], steps=8, temperature=0.8,
+                            rng=np.random.default_rng(42))
+    assert a == b
