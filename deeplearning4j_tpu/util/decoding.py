@@ -29,6 +29,50 @@ def _probs(out) -> np.ndarray:
     return np.asarray(out[0] if isinstance(out, (list, tuple)) else out)
 
 
+def draw(probs, temperature: float, rng: np.random.Generator) -> int:
+    """Temperature-sample one token id from a softmax distribution (the
+    single draw implementation shared by every sampler)."""
+    logits = np.log(np.clip(probs, 1e-9, None)) / temperature
+    p = np.exp(logits - logits.max())
+    p /= p.sum()
+    return int(rng.choice(len(p), p=p))
+
+
+def _check_seed(seed_ids, steps, max_length):
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
+    if len(seed_ids) == 0:
+        raise ValueError("seed_ids must contain at least one token")
+    if max_length is not None and len(seed_ids) >= max_length:
+        raise ValueError(f"seed of {len(seed_ids)} tokens leaves no room "
+                         f"under max_length {max_length}")
+
+
+def sample_stream(net, seed_ids, steps: int, vocab_size: int,
+                  temperature: float = 1.0,
+                  rng: Optional[np.random.Generator] = None,
+                  max_length: Optional[int] = None) -> List[int]:
+    """Temperature sampling with KV-cache / stored-state incremental
+    decoding: prime once with the seed, then one single-position forward
+    per generated token (the reference's rnnTimeStep generation loop;
+    identical distribution to a padded full forward — tested)."""
+    _check_seed(seed_ids, steps, max_length)
+    rng = rng or np.random.default_rng(0)
+    ids = list(seed_ids)
+    net.rnn_clear_previous_state()
+    out = net.rnn_time_step(_one_hot(np.asarray(ids)[None, :], vocab_size))
+    for i in range(steps):
+        if max_length is not None and len(ids) >= max_length:
+            break
+        nxt = draw(_probs(out)[0, :, -1], temperature, rng)
+        ids.append(nxt)
+        if i + 1 < steps and (max_length is None
+                              or len(ids) < max_length):
+            out = net.rnn_time_step(_one_hot(np.asarray([[nxt]]),
+                                             vocab_size))
+    return ids
+
+
 def beam_search(net, seed_ids, steps: int, vocab_size: int,
                 beam_width: int = 4,
                 max_length: Optional[int] = None
@@ -40,11 +84,7 @@ def beam_search(net, seed_ids, steps: int, vocab_size: int,
     bounds seed+generation (None = unbounded; required finite for models
     with positional tables or non-rolling caches)."""
     V = vocab_size
-    if steps < 1:
-        raise ValueError(f"steps must be >= 1, got {steps}")
-    if max_length is not None and len(seed_ids) >= max_length:
-        raise ValueError(f"seed of {len(seed_ids)} tokens leaves no room "
-                         f"under max_length {max_length}")
+    _check_seed(seed_ids, steps, max_length)
     W = min(beam_width, V)     # top-k can't exceed the vocab
     net.rnn_clear_previous_state()
 

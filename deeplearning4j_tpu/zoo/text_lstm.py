@@ -33,6 +33,18 @@ class TextGenerationLSTM(ZooModel):
                 .tbptt(self.max_length)
                 .build())
 
+    def sample_stream(self, net, seed_ids, steps: int,
+                      vocab_size: int = None,
+                      rng=None, temperature: float = 1.0):
+        """Temperature sampling through the stored-state rnnTimeStep path
+        (the reference's character-generation loop; shared implementation
+        util/decoding.sample_stream; unbounded length)."""
+        from deeplearning4j_tpu.util.decoding import sample_stream
+        return sample_stream(net, seed_ids, steps,
+                             vocab_size or self.vocab_size,
+                             temperature=temperature, rng=rng,
+                             max_length=None)
+
     def beam_search(self, net, seed_ids, steps: int, beam_width: int = 4,
                     vocab_size: int = None):
         """Beam-search decoding over the stored-state rnnTimeStep path

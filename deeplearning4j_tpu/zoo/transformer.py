@@ -27,12 +27,7 @@ from deeplearning4j_tpu.nn.updater import Adam
 from deeplearning4j_tpu.zoo.base import ZooModel, register_model
 
 
-def _draw(probs, temperature: float, rng: np.random.Generator) -> int:
-    """Temperature-sample one token id from a softmax distribution."""
-    logits = np.log(np.clip(probs, 1e-9, None)) / temperature
-    p = np.exp(logits - logits.max())
-    p /= p.sum()
-    return int(rng.choice(len(p), p=p))
+from deeplearning4j_tpu.util.decoding import draw as _draw
 
 
 @register_model
@@ -134,33 +129,15 @@ class TextGenerationTransformer(ZooModel):
                       vocab_size: int = None,
                       rng: np.random.Generator = None,
                       temperature: float = 1.0):
-        """KV-cache incremental decoding via the streaming rnn_time_step
-        state machinery (the attention-era rnnTimeStep): the seed primes
-        the caches in one call, then each new token is a single-position
-        forward against the cached keys — O(steps) instead of the padded
-        full-forward-per-token of `sample`. Identical distribution
-        (tests/test_transformer.py asserts streaming == full logits)."""
-        V = vocab_size or self.vocab_size
-        rng = rng or np.random.default_rng(0)
-        ids = list(seed_ids)
-        net.rnn_clear_previous_state()
-
-        def one_hot(seq):
-            x = np.zeros((1, V, len(seq)), np.float32)
-            x[0, seq, np.arange(len(seq))] = 1.0
-            return x
-
-        out = net.rnn_time_step(one_hot(ids))     # prime the KV caches
-        for i in range(steps):
-            if len(ids) >= self.max_length:
-                break
-            probs = np.asarray(out[0] if isinstance(out, (list, tuple))
-                               else out)[0, :, -1]
-            nxt = _draw(probs, temperature, rng)
-            ids.append(nxt)
-            if i + 1 < steps and len(ids) < self.max_length:
-                out = net.rnn_time_step(one_hot([nxt]))  # single-token step
-        return ids
+        """KV-cache incremental decoding (shared implementation:
+        util/decoding.sample_stream) — O(steps) single-position forwards
+        instead of the padded full-forward-per-token of `sample`, with an
+        identical sampling distribution (tested)."""
+        from deeplearning4j_tpu.util.decoding import sample_stream
+        return sample_stream(net, seed_ids, steps,
+                             vocab_size or self.vocab_size,
+                             temperature=temperature, rng=rng,
+                             max_length=self.max_length)
 
     def beam_search(self, net, seed_ids, steps: int, beam_width: int = 4,
                     vocab_size: int = None):

@@ -777,3 +777,14 @@ def test_sample_and_sample_stream_identical_sequences():
     b = model.sample_stream(net, [1, 2, 3], steps=8, temperature=0.8,
                             rng=np.random.default_rng(42))
     assert a == b
+
+
+def test_lstm_sample_stream():
+    from deeplearning4j_tpu.zoo import TextGenerationLSTM
+    model = TextGenerationLSTM(vocab_size=9, hidden=16, layers=1,
+                               max_length=8)
+    net = model.init()
+    ids = model.sample_stream(net, [1, 2], steps=20, temperature=0.9,
+                              rng=np.random.default_rng(5))
+    assert len(ids) == 22                   # unbounded by max_length
+    assert all(0 <= i < 9 for i in ids)
