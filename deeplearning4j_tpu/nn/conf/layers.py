@@ -37,12 +37,13 @@ import numpy as np
 #: (stripped on ordinary forwards; cleared by rnn_clear_previous_state):
 #: LSTM h/c, attention KV cache, positional-embedding offset
 STREAM_STATE_KEYS = frozenset(
-    {"h", "c", "kv_k", "kv_v", "kv_pos", "kv_abs", "pos_offset"})
+    {"h", "c", "kv_k", "kv_v", "kv_pos", "kv_abs", "kv_mask",
+     "pos_offset"})
 
 #: streaming-state keys whose LEADING axis is the batch dimension (beam
 #: search gathers these when pruning beams; kv_pos/kv_abs/pos_offset are
 #: batch-independent scalars/vectors)
-BATCHED_STREAM_KEYS = frozenset({"h", "c", "kv_k", "kv_v"})
+BATCHED_STREAM_KEYS = frozenset({"h", "c", "kv_k", "kv_v", "kv_mask"})
 
 
 def reorder_stream_state(net, indices) -> None:
@@ -58,28 +59,42 @@ def reorder_stream_state(net, indices) -> None:
             for kk, vv in s.items()}
 
 
-def check_stream_budget(net, t: int, layers) -> None:
-    """Host-side guard for streaming inference: dynamic_update_slice
-    CLAMPS out-of-range starts, so streaming past a layer's KV-cache /
-    positional capacity would silently corrupt instead of erroring.
-    Tracks net._stream_pos (reset by rnn_clear_previous_state)."""
-    net._stream_pos = getattr(net, "_stream_pos", 0) + int(t)
+def stream_capacity(layers):
+    """Smallest streaming-position capacity over `layers` (None if
+    unbounded): max_length always caps; cache_length caps only for
+    non-windowed layers (a rolling window cache never fills up)."""
     limit = None
     for l in layers:
         if not getattr(l, "supports_streaming", False):
             continue
         windowed = getattr(l, "window", None) is not None
         caps = [getattr(l, "max_length", 0)]
-        if not windowed:   # rolling window cache never fills up
+        if not windowed:
             caps.append(getattr(l, "cache_length", 0))
         for cap in caps:
             if cap:
                 limit = cap if limit is None else min(limit, cap)
-    if limit is not None and net._stream_pos > limit:
+    return limit
+
+
+def check_stream_budget(net, t: int, layers) -> int:
+    """Host-side guard for streaming inference: dynamic_update_slice
+    CLAMPS out-of-range starts, so streaming past a layer's KV-cache /
+    positional capacity would silently corrupt instead of erroring.
+    Tracks net._stream_pos (reset by rnn_clear_previous_state).
+
+    Validates only — returns the would-be position; the caller commits
+    it to net._stream_pos AFTER the forward succeeds, so neither a
+    rejected oversized call nor a forward-raised error (e.g. a
+    mid-stream mask) inflates the counter past the real cache state."""
+    new_pos = getattr(net, "_stream_pos", 0) + int(t)
+    limit = stream_capacity(layers)
+    if limit is not None and new_pos > limit:
         raise ValueError(
-            f"streamed {net._stream_pos} positions, exceeding the smallest "
+            f"streamed {new_pos} positions, exceeding the smallest "
             f"streaming capacity ({limit}); call rnn_clear_previous_state() "
             "or raise cache_length/max_length")
+    return new_pos
 
 # ---------------------------------------------------------------------------
 # registry + serde
@@ -913,7 +928,7 @@ class SelfAttentionLayer(FeedForwardLayerConf):
         if stream:
             # cache the Hkv-sized K/V (the GQA memory win), expand at
             # attend time inside _stream_attend
-            o, state = self._stream_attend(q, k, v, state)
+            o, state = self._stream_attend(q, k, v, state, mask)
         else:
             k, v = self._expand_kv(k, v)
             # variable-length batches: mask KEYS with -inf score bias
@@ -926,11 +941,17 @@ class SelfAttentionLayer(FeedForwardLayerConf):
         y = jnp.transpose(o, (0, 2, 1))                     # [N,F,T]
         return _act.get(self.activation)(y), state
 
-    def _stream_attend(self, q, k, v, state):
+    def _stream_attend(self, q, k, v, state, mask=None):
         """Incremental decode: append k/v to the carried cache, attend q
         against it. Positions past cache_length are a caller error (the
         dynamic_update_slice would clamp) — size cache_length to the max
-        generation length."""
+        generation length.
+
+        A key mask ([N, T] per chunk, like the non-stream path's) is
+        carried in the cache as kv_mask so padded positions stay masked
+        on every later step. Masked streaming must start masked: the
+        kv_mask buffer is created on the first chunk (a mask appearing
+        mid-stream would leave earlier chunks' validity unrecorded)."""
         if self.cache_length <= 0:
             raise ValueError(
                 "SelfAttentionLayer streaming needs cache_length > 0")
@@ -947,44 +968,71 @@ class SelfAttentionLayer(FeedForwardLayerConf):
         else:
             vc, pos = state["kv_v"], state["kv_pos"]
         if self.rope:
-            abs_pos = pos + jnp.arange(t)
+            abs_pos = pos + jnp.arange(t, dtype=pos.dtype)
             q = self._rope(q, abs_pos)
             k = self._rope(k, abs_pos)
         if self.window is not None:
             return self._stream_attend_rolling(
-                q, k, v, state, kc, vc, pos,
+                q, k, v, state, kc, vc, pos, mask,
                 fresh=state.get("kv_k") is None)
         z = jnp.zeros((), pos.dtype)
         kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
                                           (z, z, pos, z))
         vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
                                           (z, z, pos, z))
+        km = self._stream_mask_update(
+            state, mask, n, t, L, fresh=state.get("kv_k") is None,
+            write=lambda km, m: jax.lax.dynamic_update_slice(km, m, (z, pos)))
         # grouped attend against the UN-expanded cache: q reshaped to
         # [N, Hkv, reps, T, D] — materializing a repeated cache would
         # forfeit GQA's decode bandwidth win
         # query at absolute position pos+i sees cache slots <= pos+i
         k_idx = jnp.arange(L)
-        q_pos = pos + jnp.arange(t)
-        valid = k_idx[None, :] <= q_pos[:, None]            # [T, L]
+        q_pos = pos + jnp.arange(t, dtype=pos.dtype)
+        valid = (k_idx[None, :] <= q_pos[:, None])[None]    # [1, T, L]
+        if km is not None:
+            valid = valid & km[:, None, :]                  # [N, T, L]
         o = self._grouped_attend(q, kc, vc, valid)
-        return o, {**state, "kv_k": kc, "kv_v": vc, "kv_pos": pos + t}
+        out = {**state, "kv_k": kc, "kv_v": vc, "kv_pos": pos + t}
+        if km is not None:
+            out["kv_mask"] = km
+        return o, out
+
+    def _stream_mask_update(self, state, mask, n, t, L, *, fresh, write):
+        """Maintain the [N, L] cached-key validity buffer. Returns the
+        updated buffer, or None when this stream has never seen a mask."""
+        km = state.get("kv_mask")
+        if mask is None and km is None:
+            return None
+        if km is None:
+            if not fresh:
+                raise ValueError(
+                    "mask passed mid-stream to a SelfAttentionLayer that "
+                    "started streaming unmasked — earlier chunks' key "
+                    "validity was never recorded; restart the stream "
+                    "(rnn_clear_previous_state) with the mask from the "
+                    "first chunk")
+            km = jnp.zeros((n, L), jnp.bool_)
+        m = (jnp.ones((n, t), jnp.bool_) if mask is None
+             else jnp.asarray(mask).reshape(n, t).astype(jnp.bool_))
+        return write(km, m)
 
     def _grouped_attend(self, q, kc, vc, valid):
         """Masked attention of [N,H,T,D] queries against the un-expanded
-        [N,Hkv,L,D] cache (GQA groups share KV heads); valid: [T, L]."""
+        [N,Hkv,L,D] cache (GQA groups share KV heads); valid: [N|1, T, L]."""
         n, _, t, d = q.shape
         hkv = kc.shape[1]
         reps = self.n_heads // hkv
         qg = q.astype(jnp.float32).reshape(n, hkv, reps, t, d)
         s = jnp.einsum("ngrtd,ngld->ngrtl", qg,
                        kc.astype(jnp.float32)) / np.sqrt(d)
-        s = jnp.where(valid[None, None, None], s, -1e30)
+        s = jnp.where(valid[:, None, None], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("ngrtl,ngld->ngrtd", p, vc.astype(jnp.float32))
         return o.reshape(n, self.n_heads, t, d).astype(q.dtype)
 
-    def _stream_attend_rolling(self, q, k, v, state, kc, vc, pos, *,
-                               fresh):
+    def _stream_attend_rolling(self, q, k, v, state, kc, vc, pos,
+                               mask=None, *, fresh):
         """Windowed streaming with a ROLLING cache: slots are reused
         modulo cache_length, so generation length is unbounded with
         bounded memory (cache_length >= window keeps every in-window key
@@ -1012,23 +1060,32 @@ class SelfAttentionLayer(FeedForwardLayerConf):
         kv_abs = state.get("kv_abs")
         if kv_abs is None:
             kv_abs = jnp.full((L,), -1, jnp.int32)
-        q_pos = pos + jnp.arange(t)
+        q_pos = pos + jnp.arange(t, dtype=pos.dtype)
         slots = q_pos % L
         kc = kc.at[:, :, slots, :].set(k.astype(kc.dtype))
         vc = vc.at[:, :, slots, :].set(v.astype(vc.dtype))
-        kv_abs = kv_abs.at[slots].set(q_pos)
+        kv_abs = kv_abs.at[slots].set(q_pos.astype(kv_abs.dtype))
+        km = self._stream_mask_update(
+            state, mask, n, t, L, fresh=fresh,
+            write=lambda km, m: km.at[:, slots].set(m))
         reps = self.n_heads // hkv
         qg = q.astype(jnp.float32).reshape(n, hkv, reps, t, d)
         scale = 1.0 / np.sqrt(d)
         s = jnp.einsum("ngrtd,ngld->ngrtl", qg,
                        kc.astype(jnp.float32)) * scale
         valid = (kv_abs[None, :] >= 0) &                 (kv_abs[None, :] <= q_pos[:, None]) &                 (q_pos[:, None] - kv_abs[None, :] < self.window)
-        s = jnp.where(valid[None, None, None], s, -1e30)
+        valid = valid[None]                                  # [1, T, L]
+        if km is not None:
+            valid = valid & km[:, None, :]                   # [N, T, L]
+        s = jnp.where(valid[:, None, None], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("ngrtl,ngld->ngrtd", p, vc.astype(jnp.float32))
         o = o.reshape(n, self.n_heads, t, d).astype(q.dtype)
-        return o, {**state, "kv_k": kc, "kv_v": vc, "kv_abs": kv_abs,
-                   "kv_pos": pos + t}
+        out = {**state, "kv_k": kc, "kv_v": vc, "kv_abs": kv_abs,
+               "kv_pos": pos + t}
+        if km is not None:
+            out["kv_mask"] = km
+        return o, out
 
     def _rope(self, x, positions):
         """Rotary position embedding (RoFormer rotate-half convention):

@@ -23,7 +23,7 @@ from deeplearning4j_tpu.nn.conf.graph_conf import LayerVertex
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers import (
     STREAM_STATE_KEYS, BaseOutputLayerConf, CenterLossOutputLayer,
-    check_stream_budget)
+    stream_capacity)
 from deeplearning4j_tpu.nn.conf.network import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.updater import normalize_gradients
 
@@ -337,14 +337,18 @@ class ComputationGraph:
         return e
 
 
-    def rnn_time_step(self, *inputs):
+    def rnn_time_step(self, *inputs, masks=None):
         """Stateful streaming inference over the graph, carrying RNN h/c in
-        self.state across calls (ref: ComputationGraph.rnnTimeStep)."""
+        self.state across calls (ref: ComputationGraph.rnnTimeStep).
+        `masks` maps network-input name -> this chunk's [N, T] key mask
+        for padded variable-length batches; attention vertices carry it
+        in the KV cache so padded positions stay masked on later steps."""
         key = ("rnn_step",)
         if key not in self._jit_cache:
-            def fwd(params, state, ins, rng):
+            def fwd(params, state, ins, rng, fmasks):
                 acts, new_state, _ = self._forward(params, state, ins,
                                                    train=False, rng=rng,
+                                                   fmasks=fmasks,
                                                    carry_rnn=True,
                                                    stream=True)
                 return [acts[o] for o in self.conf.network_outputs], new_state
@@ -354,18 +358,73 @@ class ComputationGraph:
             ins = self._as_input_dict(inputs[0])
         else:
             ins = self._as_input_dict(list(inputs))
-        check_stream_budget(
-            self, next(iter(ins.values())).shape[-1],
-            [v.layer for v in self.conf.vertices.values()
-             if getattr(v, "layer", None) is not None])
+        fmasks = None if masks is None else {
+            k: jnp.asarray(v) for k, v in masks.items() if v is not None}
+        new_pos_map = self._check_graph_stream_budget(ins)
         outs, new_state = self._jit_cache[key](self.params, self.state, ins,
-                                               jax.random.PRNGKey(0))
+                                               jax.random.PRNGKey(0), fmasks)
         self.state = new_state
+        self._stream_pos_map = new_pos_map
         return outs[0] if len(outs) == 1 else outs
+
+    def _vertex_time_lengths(self, ins):
+        """Propagate each vertex's output TIME length (None when
+        non-temporal) through the topo order for this call's inputs.
+        Temporality comes from the statically inferred output InputTypes
+        (kind == "rnn"), so time-collapsing layers/vertices (LastTimeStep,
+        GlobalPooling, …) propagate None without per-class special cases;
+        the length itself is this call's runtime chunk length, taken from
+        the first temporal input (DuplicateToTimeSeries re-expands from
+        its reference sequence, which that rule also picks: its first —
+        collapsed — input is non-temporal)."""
+        out_types = self._infer_types()
+        lens = {name: (int(a.shape[-1]) if getattr(a, "ndim", 0) == 3
+                       else None)
+                for name, a in ins.items()}
+        for name in self._topo:
+            if out_types[name].kind != "rnn":
+                lens[name] = None
+                continue
+            slens = [lens.get(s)
+                     for s in self.conf.vertex_inputs.get(name, [])]
+            lens[name] = next((l for l in slens if l is not None), None)
+        return lens
+
+    def _check_graph_stream_budget(self, ins):
+        """Per-vertex streaming budget: each streaming layer is charged
+        the time length of the activation actually reaching it — in a
+        multi-input graph (e.g. seq2seq decode re-feeding the full
+        encoder sequence each step, or an encoder path collapsed through
+        LastTimeStep+DuplicateToTimeSeries) different caches advance by
+        different amounts. Validates every vertex, returning the counter
+        updates; the caller commits them after the forward succeeds."""
+        lens = self._vertex_time_lengths(ins)
+        pos = getattr(self, "_stream_pos_map", {})
+        updates = {}
+        for name, v in self.conf.vertices.items():
+            layer = getattr(v, "layer", None)
+            if layer is None or not getattr(layer, "supports_streaming",
+                                            False):
+                continue
+            srcs = self.conf.vertex_inputs.get(name, [])
+            t = next((lens[s] for s in srcs if lens.get(s) is not None),
+                     None)
+            if t is None:
+                continue
+            new_pos = pos.get(name, 0) + t
+            cap = stream_capacity([layer])
+            if cap is not None and new_pos > cap:
+                raise ValueError(
+                    f"vertex '{name}' streamed {new_pos} positions, "
+                    f"exceeding its streaming capacity ({cap}); call "
+                    "rnn_clear_previous_state() or raise "
+                    "cache_length/max_length")
+            updates[name] = new_pos
+        return {**pos, **updates}
 
     def rnn_clear_previous_state(self):
         """ref: ComputationGraph.rnnClearPreviousState."""
-        self._stream_pos = 0
+        self._stream_pos_map = {}
         for k, s in self.state.items():
             if isinstance(s, dict):
                 self.state[k] = {kk: vv for kk, vv in s.items()

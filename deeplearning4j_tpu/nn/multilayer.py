@@ -388,15 +388,19 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     # RNN streaming state (ref: rnnTimeStep :~2300, rnnClearPreviousState)
     # ------------------------------------------------------------------
-    def rnn_time_step(self, x):
+    def rnn_time_step(self, x, mask=None):
         """Stateful streaming inference: feeds one (or more) timesteps,
         carrying h/c (and attention KV caches) across calls
-        (ref: rnnTimeStep)."""
+        (ref: rnnTimeStep). `mask` is this chunk's [N, T] key mask for
+        padded variable-length batches; attention layers carry it in the
+        KV cache so padded positions stay masked on later steps."""
         x = jnp.asarray(x)
-        check_stream_budget(self, x.shape[-1], self.layers)
+        new_pos = check_stream_budget(self, x.shape[-1], self.layers)
         fn = self._get_output_fn(False, True, stream=True)
         out, new_state = fn(self.params, self.state, x,
-                            jax.random.PRNGKey(0), None)
+                            jax.random.PRNGKey(0),
+                            None if mask is None else jnp.asarray(mask))
+        self._stream_pos = new_pos
         self.state = new_state
         return out
 

@@ -246,3 +246,171 @@ class TestCrossAttentionVertex:
         back = vertex_from_dict(vertex_to_dict(v))
         assert isinstance(back, CrossAttentionVertex)
         assert back.n_out == 32 and back.n_heads == 4
+
+
+class TestGraphStreamBudget:
+    """Multi-input graphs charge each streaming layer's budget from the
+    input(s) that actually feed it — a seq2seq decode that re-feeds the
+    full encoder sequence each step must not burn the decoder's KV-cache
+    budget at the encoder's length."""
+
+    def _net(self):
+        import numpy as onp
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (
+            LSTM, RnnOutputLayer, SelfAttentionLayer,
+        )
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        conf = (NeuralNetConfiguration.Builder().seed(5)
+                .graph_builder()
+                .add_inputs("enc", "dec")
+                .set_input_types(InputType.recurrent(6, 7),
+                                 InputType.recurrent(8, 4))
+                .add_layer("enc_l", LSTM(n_out=8), "enc")
+                .add_layer("enc_out",
+                           RnnOutputLayer(n_out=3, loss="mcxent",
+                                          activation="softmax"), "enc_l")
+                .add_layer("dec_attn",
+                           SelfAttentionLayer(n_out=8, n_heads=2,
+                                              causal=True, cache_length=4),
+                           "dec")
+                .add_layer("dec_out",
+                           RnnOutputLayer(n_out=3, loss="mcxent",
+                                          activation="softmax"), "dec_attn")
+                .set_outputs("enc_out", "dec_out").build())
+        return ComputationGraph(conf).init()
+
+    def test_encoder_length_not_charged_to_decoder_cache(self):
+        import numpy as onp
+        net = self._net()
+        rng = onp.random.default_rng(0)
+        enc = rng.standard_normal((1, 6, 7)).astype(onp.float32)  # len 7
+        step = rng.standard_normal((1, 8, 1)).astype(onp.float32)  # len 1
+        # 4 decode steps fit the decoder's cache_length=4 even though the
+        # 7-long encoder input is re-fed every call
+        for _ in range(4):
+            net.rnn_time_step({"enc": enc, "dec": step})
+        import pytest
+        with pytest.raises(ValueError, match="dec_attn"):
+            net.rnn_time_step({"enc": enc, "dec": step})
+        net.rnn_clear_previous_state()
+        net.rnn_time_step({"enc": enc, "dec": step})
+
+    def test_collapsed_encoder_path_charges_decoder_length(self):
+        """enc -> LastTimeStep -> DuplicateToTimeSeries(dec) -> Merge(dec)
+        -> attention: the attention cache must be charged at the DECODER
+        chunk length even though it transitively depends on the 7-long
+        encoder input (classic DL4J seq2seq wiring)."""
+        import numpy as onp
+        import pytest
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.graph_conf import (
+            DuplicateToTimeSeriesVertex, LastTimeStepVertex, MergeVertex,
+        )
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (
+            LSTM, RnnOutputLayer, SelfAttentionLayer,
+        )
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        conf = (NeuralNetConfiguration.Builder().seed(9)
+                .graph_builder()
+                .add_inputs("enc", "dec")
+                .set_input_types(InputType.recurrent(6, 7),
+                                 InputType.recurrent(8, 4))
+                .add_layer("enc_l", LSTM(n_out=8), "enc")
+                .add_vertex("last", LastTimeStepVertex(), "enc_l")
+                .add_vertex("dup", DuplicateToTimeSeriesVertex(),
+                            "last", "dec")
+                .add_vertex("merge", MergeVertex(), "dec", "dup")
+                .add_layer("attn",
+                           SelfAttentionLayer(n_out=8, n_heads=2,
+                                              causal=True, cache_length=4),
+                           "merge")
+                .add_layer("out",
+                           RnnOutputLayer(n_out=3, loss="mcxent",
+                                          activation="softmax"), "attn")
+                .set_outputs("out").build())
+        net = ComputationGraph(conf).init()
+        rng = onp.random.default_rng(0)
+        enc = rng.standard_normal((1, 6, 7)).astype(onp.float32)
+        step = rng.standard_normal((1, 8, 1)).astype(onp.float32)
+        for _ in range(4):       # 4 × len-1 decode steps fit the cache
+            net.rnn_time_step({"enc": enc, "dec": step})
+        with pytest.raises(ValueError, match="attn"):
+            net.rnn_time_step({"enc": enc, "dec": step})
+
+
+class TestGraphMaskedStreaming:
+    def test_graph_masked_streaming_matches_full(self):
+        """Graph attention streaming honors per-chunk key masks (carried
+        in the KV cache) == full masked forward."""
+        import numpy as onp
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (
+            RnnOutputLayer, SelfAttentionLayer,
+        )
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        conf = (NeuralNetConfiguration.Builder().seed(11)
+                .graph_builder()
+                .add_inputs("in")
+                .set_input_types(InputType.recurrent(8, 16))
+                .add_layer("attn",
+                           SelfAttentionLayer(n_out=8, n_heads=2,
+                                              causal=True, cache_length=16,
+                                              activation="identity"), "in")
+                .add_layer("out",
+                           RnnOutputLayer(n_out=4, loss="mcxent",
+                                          activation="softmax"), "attn")
+                .set_outputs("out").build())
+        net = ComputationGraph(conf).init()
+        rng = onp.random.default_rng(3)
+        x = rng.standard_normal((2, 8, 6)).astype(onp.float32)
+        mask = onp.array([[1, 1, 1, 1, 1, 1],
+                          [1, 1, 0, 0, 1, 1]], onp.float32)
+        full = onp.asarray(net.output(x, masks={"in": mask}))
+        net.rnn_clear_previous_state()
+        got = onp.asarray(net.rnn_time_step(x[:, :, :4],
+                                            masks={"in": mask[:, :4]}))
+        onp.testing.assert_allclose(got[0], full[0, :, :4], atol=1e-5)
+        for t in range(4, 6):
+            got = onp.asarray(net.rnn_time_step(
+                x[:, :, t:t + 1], masks={"in": mask[:, t:t + 1]}))
+            onp.testing.assert_allclose(got[:, :, 0], full[:, :, t],
+                                        atol=1e-5, err_msg=f"position {t}")
+
+    def test_clear_state_drops_kv_mask(self):
+        """rnn_clear_previous_state strips the carried mask buffer, so a
+        post-clear unmasked stream starts genuinely fresh."""
+        import numpy as onp
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (
+            RnnOutputLayer, SelfAttentionLayer,
+        )
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        conf = (NeuralNetConfiguration.Builder().seed(11)
+                .graph_builder()
+                .add_inputs("in")
+                .set_input_types(InputType.recurrent(8, 16))
+                .add_layer("attn",
+                           SelfAttentionLayer(n_out=8, n_heads=2,
+                                              causal=True,
+                                              cache_length=16), "in")
+                .add_layer("out",
+                           RnnOutputLayer(n_out=4, loss="mcxent",
+                                          activation="softmax"), "attn")
+                .set_outputs("out").build())
+        net = ComputationGraph(conf).init()
+        rng = onp.random.default_rng(3)
+        x = rng.standard_normal((2, 8, 2)).astype(onp.float32)
+        net.rnn_time_step(x, masks={"in": onp.ones((2, 2), onp.float32)})
+        assert any("kv_mask" in s for s in net.state.values()
+                   if isinstance(s, dict))
+        net.rnn_clear_previous_state()
+        assert not any("kv_mask" in s for s in net.state.values()
+                       if isinstance(s, dict))
+        net.rnn_time_step(x)           # unmasked restart must not raise
+        assert not any("kv_mask" in s for s in net.state.values()
+                       if isinstance(s, dict))

@@ -788,3 +788,107 @@ def test_lstm_sample_stream():
                               rng=np.random.default_rng(5))
     assert len(ids) == 22                   # unbounded by max_length
     assert all(0 <= i < 9 for i in ids)
+
+
+class TestStreamingMask:
+    """Key masks in streaming decode: carried in the KV cache so padded
+    positions stay masked on later steps (the non-stream path key-masks
+    them; pre-fix the stream path silently ignored the mask)."""
+
+    def _net(self, **kw):
+        conf = (NeuralNetConfiguration.Builder().seed(7).list()
+                .layer(SelfAttentionLayer(n_in=8, n_out=8, n_heads=2,
+                                          causal=True, cache_length=16,
+                                          activation="identity", **kw))
+                .layer(RnnOutputLayer(n_in=8, n_out=5, loss="mcxent",
+                                      activation="softmax"))
+                .set_input_type(InputType.recurrent(8, 16))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_masked_streaming_matches_full_forward(self):
+        net = self._net()
+        x = RNG.standard_normal((2, 8, 7)).astype(np.float32)
+        # row 0 fully valid; row 1 padded at positions 4,5 then a valid
+        # token at 6 — the streamed cache must keep 4,5 masked forever
+        mask = np.array([[1, 1, 1, 1, 1, 1, 1],
+                         [1, 1, 1, 1, 0, 0, 1]], np.float32)
+        full = np.asarray(net.output(x, mask=mask))
+
+        net.rnn_clear_previous_state()
+        got = np.asarray(net.rnn_time_step(x[:, :, :6], mask=mask[:, :6]))
+        np.testing.assert_allclose(got[0], full[0, :, :6], atol=1e-5)
+        np.testing.assert_allclose(got[1, :, :4], full[1, :, :4], atol=1e-5)
+        got = np.asarray(net.rnn_time_step(x[:, :, 6:7], mask=mask[:, 6:7]))
+        np.testing.assert_allclose(got[:, :, 0], full[:, :, 6], atol=1e-5)
+
+    def test_masked_streaming_rolling_window(self):
+        net = self._net(window=4)
+        x = RNG.standard_normal((2, 8, 6)).astype(np.float32)
+        mask = np.array([[1, 1, 1, 1, 1, 1],
+                         [1, 1, 1, 0, 1, 1]], np.float32)
+        full = np.asarray(net.output(x, mask=mask))
+        net.rnn_clear_previous_state()
+        got = np.asarray(net.rnn_time_step(x[:, :, :3], mask=mask[:, :3]))
+        np.testing.assert_allclose(got, full[:, :, :3], atol=1e-5)
+        for t in range(3, 6):
+            got = np.asarray(net.rnn_time_step(x[:, :, t:t + 1],
+                                               mask=mask[:, t:t + 1]))
+            np.testing.assert_allclose(got[:, :, 0], full[:, :, t],
+                                       atol=1e-5, err_msg=f"position {t}")
+
+    def test_mask_midstream_after_unmasked_start_rejected(self):
+        net = self._net()
+        x = RNG.standard_normal((1, 8, 2)).astype(np.float32)
+        net.rnn_time_step(x)                       # unmasked start
+        with pytest.raises(ValueError, match="mid-stream"):
+            net.rnn_time_step(x, mask=np.ones((1, 2), np.float32))
+
+    def test_unmasked_stream_unchanged(self):
+        """No mask anywhere: state carries no kv_mask buffer (existing
+        decode paths keep their shapes/cost)."""
+        net = self._net()
+        x = RNG.standard_normal((1, 8, 2)).astype(np.float32)
+        net.rnn_time_step(x)
+        assert not any("kv_mask" in s for s in net.state.values()
+                       if isinstance(s, dict))
+
+
+class TestStreamBudgetCommit:
+    def test_rejected_call_does_not_inflate_budget(self):
+        """An oversized rnn_time_step raises BEFORE committing its length,
+        so later within-capacity calls still work (pre-fix the counter
+        inflated permanently)."""
+        model = TextGenerationTransformer(vocab_size=8, embed_dim=16,
+                                          n_heads=2, n_layers=1,
+                                          max_length=4)
+        net = model.init()
+        big = np.zeros((1, 8, 6), np.float32)
+        big[0, 0, :] = 1.0
+        with pytest.raises(ValueError, match="streaming capacity"):
+            net.rnn_time_step(big)
+        small = np.zeros((1, 8, 1), np.float32)
+        small[0, 0, 0] = 1.0
+        for _ in range(4):                 # full capacity still available
+            net.rnn_time_step(small)
+        with pytest.raises(ValueError, match="streaming capacity"):
+            net.rnn_time_step(small)
+
+    def test_forward_error_does_not_inflate_budget(self):
+        """A forward-raised error (mid-stream mask) must not commit the
+        chunk to the stream counter — the KV cache was never updated."""
+        conf = (NeuralNetConfiguration.Builder().seed(7).list()
+                .layer(SelfAttentionLayer(n_in=8, n_out=8, n_heads=2,
+                                          causal=True, cache_length=4))
+                .layer(RnnOutputLayer(n_in=8, n_out=5, loss="mcxent",
+                                      activation="softmax"))
+                .set_input_type(InputType.recurrent(8, 16))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = RNG.standard_normal((1, 8, 2)).astype(np.float32)
+        net.rnn_time_step(x)                       # budget 2
+        with pytest.raises(ValueError, match="mid-stream"):
+            net.rnn_time_step(x, mask=np.ones((1, 2), np.float32))
+        net.rnn_time_step(x)                       # budget 4, cache holds 4
+        with pytest.raises(ValueError, match="streaming capacity"):
+            net.rnn_time_step(x)
