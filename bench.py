@@ -9,73 +9,140 @@ fwd+bwd, fp32, single card) used as the provisional bar until a measured
 reference number exists.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Failure modes are still one JSON line, distinguished by "error":
+  - "tpu-unavailable": the TPU backend failed to initialize, hung past the
+    watchdog (the tunneled platform hangs rather than erroring when the
+    tunnel is down), or only a CPU backend came up. value is null.
+  - "bench-crash": the benchmark code itself raised. value is null.
+Exit code 0 only for a real measurement.
+
+Env knobs: BENCH_BATCH/IMAGE/WARMUP/STEPS shapes; BENCH_ALLOW_CPU=1 permits
+running on a CPU backend (smoke tests with tiny shapes only);
+BENCH_PLATFORM switches the jax platform via jax.config;
+BENCH_INIT_TIMEOUT backend-init watchdog seconds (default 120);
+BENCH_TOTAL_TIMEOUT whole-run watchdog seconds (default 1800).
 """
 
 import json
 import os
 import sys
+import threading
 import time
-
-# keep the chip's default platform (axon/tpu); fall back to cpu cleanly
-import jax
-import jax.numpy as jnp
-import numpy as np
 
 DL4J_CUDA_REF_IMG_S = 200.0  # provisional reference bar (see module docstring)
 
+METRIC = "ResNet50 ImageNet train images/sec/chip (bf16 compute)"
 BATCH = int(os.environ.get("BENCH_BATCH", "128"))
 IMAGE = int(os.environ.get("BENCH_IMAGE", "224"))
 CLASSES = 1000
 WARMUP = int(os.environ.get("BENCH_WARMUP", "5"))
 STEPS = int(os.environ.get("BENCH_STEPS", "30"))
+INIT_TIMEOUT = float(os.environ.get("BENCH_INIT_TIMEOUT", "120"))
+TOTAL_TIMEOUT = float(os.environ.get("BENCH_TOTAL_TIMEOUT", "1800"))
+
+
+def _emit(value, vs_baseline, **extra):
+    print(json.dumps({"metric": METRIC, "value": value, "unit": "images/sec",
+                      "vs_baseline": vs_baseline, **extra}), flush=True)
+
+
+def _fail(kind, detail):
+    _emit(None, None, error=kind, detail=str(detail)[:300])
 
 
 def main():
-    from deeplearning4j_tpu.zoo import ResNet50
-    from deeplearning4j_tpu.nn.updater import Nesterovs
+    backend_up = threading.Event()
+    run_done = threading.Event()
 
-    # NHWC internal layout: profile-driven (see PERF.md) — BN stat
-    # reductions and channel work are lane-aligned, ~9% over NCHW.
-    model = ResNet50(num_classes=CLASSES, height=IMAGE, width=IMAGE,
-                     updater=Nesterovs(0.1, momentum=0.9),
-                     data_format=os.environ.get("BENCH_FORMAT", "NHWC"))
-    net = model.init()
-    net.conf.dtype = "bfloat16"  # MXU path, fp32 master params + accum
+    def watchdog():
+        if not backend_up.wait(INIT_TIMEOUT):
+            _fail("tpu-unavailable",
+                  f"backend init did not complete within {INIT_TIMEOUT:.0f}s "
+                  "(tunneled TPU platform hangs when the tunnel is down)")
+            os._exit(3)
+        # the tunnel can also drop MID-run: device fetches then block
+        # forever instead of raising, so the whole run gets a deadline
+        if not run_done.wait(TOTAL_TIMEOUT):
+            _fail("tpu-unavailable",
+                  f"benchmark did not complete within {TOTAL_TIMEOUT:.0f}s "
+                  "after backend init (device hang mid-run)")
+            os._exit(3)
 
-    rng = np.random.default_rng(0)
-    x = rng.standard_normal((BATCH, 3, IMAGE, IMAGE)).astype(np.float32)
-    y = np.zeros((BATCH, CLASSES), np.float32)
-    y[np.arange(BATCH), rng.integers(0, CLASSES, BATCH)] = 1.0
+    threading.Thread(target=watchdog, daemon=True).start()
 
-    step = net._get_train_step(False)
-    inputs = {net.conf.network_inputs[0]: jnp.asarray(x)}
-    labels = {net.conf.network_outputs[0]: jnp.asarray(y)}
-    key = jax.random.PRNGKey(0)
+    try:
+        import jax
+        if os.environ.get("BENCH_PLATFORM"):
+            # this image's sitecustomize pins JAX_PLATFORMS before Python
+            # starts, so env overrides are dead — jax.config is the only
+            # working switch (smoke tests: BENCH_PLATFORM=cpu)
+            jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+        devices = jax.devices()
+    except Exception as e:  # "Unable to initialize backend ..." and kin
+        backend_up.set()
+        _fail("tpu-unavailable", e)
+        return 3
+    backend_up.set()
 
-    params, state, upd = net.params, net.state, net.updater_state
-    for _ in range(WARMUP):
-        params, state, upd, loss = step(params, state, upd, inputs, labels,
-                                        key, None, None)
-    # sync on a scalar device->host fetch: it cannot complete before the
-    # whole chained computation has (block_until_ready on donated buffers
-    # returns early on the tunneled platform and under-measures wildly)
-    float(loss)
+    platform = devices[0].platform
+    if platform == "cpu" and os.environ.get("BENCH_ALLOW_CPU") != "1":
+        _fail("tpu-unavailable",
+              f"only a CPU backend is available ({devices}); refusing to "
+              "report a CPU number as the chip benchmark "
+              "(set BENCH_ALLOW_CPU=1 for smoke tests)")
+        return 3
 
-    t0 = time.perf_counter()
-    for _ in range(STEPS):
-        params, state, upd, loss = step(params, state, upd, inputs, labels,
-                                        key, None, None)
-    float(loss)
-    dt = time.perf_counter() - t0
+    try:
+        import jax.numpy as jnp
+        import numpy as np
 
-    img_s = BATCH * STEPS / dt
-    print(json.dumps({
-        "metric": "ResNet50 ImageNet train images/sec/chip (bf16 compute)",
-        "value": round(img_s, 2),
-        "unit": "images/sec",
-        "vs_baseline": round(img_s / DL4J_CUDA_REF_IMG_S, 3),
-    }))
+        from deeplearning4j_tpu.zoo import ResNet50
+        from deeplearning4j_tpu.nn.updater import Nesterovs
+
+        # NHWC internal layout: profile-driven (see PERF.md) — BN stat
+        # reductions and channel work are lane-aligned, ~9% over NCHW.
+        model = ResNet50(num_classes=CLASSES, height=IMAGE, width=IMAGE,
+                         updater=Nesterovs(0.1, momentum=0.9),
+                         data_format=os.environ.get("BENCH_FORMAT", "NHWC"))
+        net = model.init()
+        net.conf.dtype = "bfloat16"  # MXU path, fp32 master params + accum
+
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((BATCH, 3, IMAGE, IMAGE)).astype(np.float32)
+        y = np.zeros((BATCH, CLASSES), np.float32)
+        y[np.arange(BATCH), rng.integers(0, CLASSES, BATCH)] = 1.0
+
+        step = net._get_train_step(False)
+        inputs = {net.conf.network_inputs[0]: jnp.asarray(x)}
+        labels = {net.conf.network_outputs[0]: jnp.asarray(y)}
+        key = jax.random.PRNGKey(0)
+
+        params, state, upd = net.params, net.state, net.updater_state
+        for _ in range(WARMUP):
+            params, state, upd, loss = step(params, state, upd, inputs,
+                                            labels, key, None, None)
+        # sync on a scalar device->host fetch: it cannot complete before the
+        # whole chained computation has (block_until_ready on donated buffers
+        # returns early on the tunneled platform and under-measures wildly)
+        float(loss)
+
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            params, state, upd, loss = step(params, state, upd, inputs,
+                                            labels, key, None, None)
+        float(loss)
+        dt = time.perf_counter() - t0
+
+        img_s = BATCH * STEPS / dt
+        run_done.set()
+        _emit(round(img_s, 2), round(img_s / DL4J_CUDA_REF_IMG_S, 3),
+              platform=platform)
+        return 0
+    except Exception as e:
+        run_done.set()
+        _fail("bench-crash", repr(e))
+        return 4
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -54,7 +54,11 @@ class ComputationGraph:
 
     # ------------------------------------------------------------------
     def _infer_types(self) -> Dict[str, InputType]:
-        """Output InputType of every vertex, walking topo order."""
+        """Output InputType of every vertex, walking topo order.
+        Memoised — the graph is fixed after construction, and per-token
+        decode loops call this host-side."""
+        if getattr(self, "_out_types_cache", None) is not None:
+            return self._out_types_cache
         out_types: Dict[str, InputType] = {}
         for name, it in self.conf.input_types.items():
             out_types[name] = it
@@ -67,6 +71,7 @@ class ComputationGraph:
                                  "(call set_input_types on the builder)")
             self._vertex_input_types[name] = its
             out_types[name] = self.conf.vertices[name].output_type(its)
+        self._out_types_cache = out_types
         return out_types
 
     def init(self):
@@ -146,6 +151,17 @@ class ComputationGraph:
                 new_state[name] = s_new
             masks[name] = v.output_mask(in_masks, self._vertex_input_types[name])
         return acts, new_state, masks
+
+    def _as_mask_dict(self, masks) -> Optional[Dict[str, Any]]:
+        """Normalize a masks argument: a dict maps input name -> mask
+        (None entries dropped); a bare array masks the first network
+        input; None/all-None -> None."""
+        if masks is None:
+            return None
+        if not isinstance(masks, dict):
+            return {self.conf.network_inputs[0]: jnp.asarray(masks)}
+        out = {k: jnp.asarray(v) for k, v in masks.items() if v is not None}
+        return out or None
 
     def _as_input_dict(self, inputs) -> Dict[str, Any]:
         if isinstance(inputs, dict):
@@ -308,11 +324,7 @@ class ComputationGraph:
             ins = self._as_input_dict(inputs[0])
         else:
             ins = self._as_input_dict(list(inputs))
-        fmasks = None
-        if masks is not None:
-            fmasks = {k: jnp.asarray(v) for k, v in masks.items()} \
-                if isinstance(masks, dict) else \
-                {self.conf.network_inputs[0]: jnp.asarray(masks)}
+        fmasks = self._as_mask_dict(masks)
         rng = self._next_rng() if train else jax.random.PRNGKey(0)
         outs, _ = self._jit_cache[key](self.params, self.state, ins, rng, fmasks)
         return outs[0] if len(outs) == 1 else outs
@@ -358,8 +370,7 @@ class ComputationGraph:
             ins = self._as_input_dict(inputs[0])
         else:
             ins = self._as_input_dict(list(inputs))
-        fmasks = None if masks is None else {
-            k: jnp.asarray(v) for k, v in masks.items() if v is not None}
+        fmasks = self._as_mask_dict(masks)
         new_pos_map = self._check_graph_stream_budget(ins)
         outs, new_state = self._jit_cache[key](self.params, self.state, ins,
                                                jax.random.PRNGKey(0), fmasks)
