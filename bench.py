@@ -16,7 +16,8 @@ Failure modes are still one JSON line, distinguished by "error":
   - "bench-crash": the benchmark code itself raised. value is null.
 Exit code 0 only for a real measurement.
 
-Env knobs: BENCH_BATCH/IMAGE/WARMUP/STEPS shapes; BENCH_ALLOW_CPU=1 permits
+Env knobs: BENCH_BATCH/IMAGE/WARMUP/STEPS shapes; BENCH_FUSE=0 disables the
+fused bn→relu→1×1-conv bottleneck plan (A/B); BENCH_ALLOW_CPU=1 permits
 running on a CPU backend (smoke tests with tiny shapes only);
 BENCH_PLATFORM switches the jax platform via jax.config;
 BENCH_INIT_TIMEOUT backend-init watchdog seconds (default 120);
@@ -41,13 +42,28 @@ INIT_TIMEOUT = float(os.environ.get("BENCH_INIT_TIMEOUT", "120"))
 TOTAL_TIMEOUT = float(os.environ.get("BENCH_TOTAL_TIMEOUT", "1800"))
 
 
+_emit_lock = threading.Lock()
+_emitted = False
+
+
 def _emit(value, vs_baseline, **extra):
-    print(json.dumps({"metric": METRIC, "value": value, "unit": "images/sec",
-                      "vs_baseline": vs_baseline, **extra}), flush=True)
+    """Print the single JSON result line. First caller wins — the
+    watchdog thread and the main thread can race at the deadline, and
+    two lines (or a failure after a success) would break the contract.
+    Returns False when another thread already emitted."""
+    global _emitted
+    with _emit_lock:
+        if _emitted:
+            return False
+        _emitted = True
+        print(json.dumps({"metric": METRIC, "value": value,
+                          "unit": "images/sec",
+                          "vs_baseline": vs_baseline, **extra}), flush=True)
+        return True
 
 
 def _fail(kind, detail):
-    _emit(None, None, error=kind, detail=str(detail)[:300])
+    return _emit(None, None, error=kind, detail=str(detail)[:300])
 
 
 def main():
@@ -63,10 +79,11 @@ def main():
         # the tunnel can also drop MID-run: device fetches then block
         # forever instead of raising, so the whole run gets a deadline
         if not run_done.wait(TOTAL_TIMEOUT):
-            _fail("tpu-unavailable",
-                  f"benchmark did not complete within {TOTAL_TIMEOUT:.0f}s "
-                  "after backend init (device hang mid-run)")
-            os._exit(3)
+            if _fail("tpu-unavailable",
+                     f"benchmark did not complete within "
+                     f"{TOTAL_TIMEOUT:.0f}s after backend init (device "
+                     "hang mid-run)"):
+                os._exit(3)   # a finished main thread already emitted
 
     threading.Thread(target=watchdog, daemon=True).start()
 
@@ -103,7 +120,8 @@ def main():
         # reductions and channel work are lane-aligned, ~9% over NCHW.
         model = ResNet50(num_classes=CLASSES, height=IMAGE, width=IMAGE,
                          updater=Nesterovs(0.1, momentum=0.9),
-                         data_format=os.environ.get("BENCH_FORMAT", "NHWC"))
+                         data_format=os.environ.get("BENCH_FORMAT", "NHWC"),
+                         fuse=os.environ.get("BENCH_FUSE", "1") != "0")
         net = model.init()
         net.conf.dtype = "bfloat16"  # MXU path, fp32 master params + accum
 
@@ -135,8 +153,9 @@ def main():
 
         img_s = BATCH * STEPS / dt
         run_done.set()
-        _emit(round(img_s, 2), round(img_s / DL4J_CUDA_REF_IMG_S, 3),
-              platform=platform)
+        if not _emit(round(img_s, 2), round(img_s / DL4J_CUDA_REF_IMG_S, 3),
+                     platform=platform):
+            return 3          # watchdog fired first at the deadline
         return 0
     except Exception as e:
         run_done.set()

@@ -51,6 +51,97 @@ class ComputationGraph:
         self._initialized = False
         self._topo = conf.topological_order()
         self._vertex_input_types: Dict[str, List[InputType]] = {}
+        self.fuse_bn_act_conv = False
+        self._fusion_cache = None
+
+    # ------------------------------------------------------------------
+    # bn→act→conv1x1 fusion (execution-plan optimization, see
+    # nn/layers/fused.py — params/state stay keyed by the original vertex
+    # names, so serialization/import/transfer are unaffected)
+    # ------------------------------------------------------------------
+    def set_fusion(self, enabled: bool = True):
+        """Toggle the fused bn→act→1×1-conv execution plan. Changes how
+        eligible chains execute, not what they compute (equivalence is
+        test-pinned); jitted steps are rebuilt."""
+        if enabled != self.fuse_bn_act_conv:
+            self.fuse_bn_act_conv = enabled
+            self._jit_cache.clear()
+        return self
+
+    def _fusion(self):
+        """(plan, skip): plan maps a 1×1-conv vertex name to the fused
+        group executing (bn → activation → conv) in one op; skip maps the
+        absorbed bn/activation vertex names to their consuming conv.
+
+        Eligibility (conservative — anything else runs unfused): a
+        BatchNormalization vertex, optionally followed by an
+        ActivationLayer (or its own activation), feeding a kernel-1×1 /
+        stride-1 / pad-0 / dilation-1 ConvolutionLayer; every
+        intermediate has a single consumer, no preprocessors/dropout, is
+        not a network output, and the prologue activation is relu or
+        identity (the Pallas kernel's fast set)."""
+        if not self.fuse_bn_act_conv:
+            return {}, {}
+        if self._fusion_cache is not None:
+            return self._fusion_cache
+        from deeplearning4j_tpu.nn.conf.layers import (
+            ActivationLayer, BatchNormalization, ConvolutionLayer)
+        self._infer_types()
+        consumers: Dict[str, List[str]] = {}
+        for cname, srcs in self.conf.vertex_inputs.items():
+            for s in srcs:
+                consumers.setdefault(s, []).append(cname)
+        outputs = set(self.conf.network_outputs)
+
+        def layer_of(n, cls):
+            v = self.conf.vertices.get(n)
+            if (not isinstance(v, LayerVertex) or v.preprocessor is not None
+                    or n in outputs):
+                return None
+            l = v.layer
+            return l if type(l) is cls and not l.dropout else None
+
+        plan: Dict[str, Tuple[str, str, str]] = {}
+        skip: Dict[str, str] = {}
+        for bn_name in self._topo:
+            bn = layer_of(bn_name, BatchNormalization)
+            if bn is None:
+                continue
+            if len(self.conf.vertex_inputs.get(bn_name, [])) != 1:
+                continue
+            if self._vertex_input_types[bn_name][0].kind != "cnn":
+                continue
+            cons = consumers.get(bn_name, [])
+            if len(cons) != 1:
+                continue
+            nxt, act_vertex = cons[0], None
+            act = bn.activation or "identity"
+            al = layer_of(nxt, ActivationLayer)
+            if al is not None:
+                if act != "identity":
+                    continue
+                acons = consumers.get(nxt, [])
+                if len(acons) != 1:
+                    continue
+                act_vertex, act, nxt = nxt, al.activation, acons[0]
+            conv = layer_of(nxt, ConvolutionLayer)
+            if (conv is None or act not in ("relu", "identity")
+                    or tuple(conv.kernel) != (1, 1)
+                    or tuple(conv.stride) != (1, 1)
+                    or tuple(conv.padding) != (0, 0)
+                    or tuple(conv.dilation) != (1, 1)
+                    or conv.convolution_mode not in ("truncate", "same")
+                    or conv.data_format != bn.data_format):
+                continue
+            if self.conf.vertex_inputs.get(nxt) != [act_vertex or bn_name]:
+                continue
+            src = self.conf.vertex_inputs[bn_name][0]
+            plan[nxt] = (bn_name, act, src)
+            skip[bn_name] = nxt
+            if act_vertex is not None:
+                skip[act_vertex] = nxt
+        self._fusion_cache = (plan, skip)
+        return self._fusion_cache
 
     # ------------------------------------------------------------------
     def _infer_types(self) -> Dict[str, InputType]:
@@ -115,14 +206,30 @@ class ComputationGraph:
         single feedForward for all outputs)."""
         preout_set = ({preout_of} if isinstance(preout_of, str)
                       else set(preout_of or ()))
+        fused_plan, fused_skip = self._fusion()
         acts: Dict[str, Any] = dict(inputs)
         masks: Dict[str, Any] = dict(fmasks or {})
         new_state: Dict[str, Any] = {}
         for i, name in enumerate(self._topo):
             v = self.conf.vertices[name]
             ins = self.conf.vertex_inputs.get(name, [])
-            xs = [acts[i_] for i_ in ins]
             in_masks = [masks.get(i_) for i_ in ins]
+            if name in fused_skip:
+                # absorbed into a downstream fused conv: produce no
+                # activation; masks still propagate, bn state is written
+                # by the fused step
+                masks[name] = v.output_mask(
+                    in_masks, self._vertex_input_types[name])
+                new_state[name] = state.get(name, {})
+                continue
+            if name in fused_plan:
+                bn_name, p_act, src = fused_plan[name]
+                self._apply_fused(name, bn_name, p_act, acts[src], params,
+                                  state, new_state, acts, train=train)
+                masks[name] = v.output_mask(
+                    in_masks, self._vertex_input_types[name])
+                continue
+            xs = [acts[i_] for i_ in ins]
             if getattr(v, "wants_all_masks", False):
                 mask = in_masks      # e.g. cross attention: keys = input 1
             else:
@@ -152,14 +259,41 @@ class ComputationGraph:
             masks[name] = v.output_mask(in_masks, self._vertex_input_types[name])
         return acts, new_state, masks
 
-    def _as_mask_dict(self, masks) -> Optional[Dict[str, Any]]:
-        """Normalize a masks argument: a dict maps input name -> mask
-        (None entries dropped); a bare array masks the first network
-        input; None/all-None -> None."""
+    def _apply_fused(self, conv_name, bn_name, p_act, y, params, state,
+                     new_state, acts, *, train):
+        """Execute one fused bn→act→conv1x1 group (see nn/layers/fused.py):
+        y is the RAW activation feeding the bn vertex; writes the conv
+        output into acts[conv_name] and the bn running stats into
+        new_state[bn_name]."""
+        from deeplearning4j_tpu.nn.layers.fused import bn_act_conv1x1
+        from deeplearning4j_tpu.nn import activations as _act
+        bn = self.conf.vertices[bn_name].layer
+        conv = self.conf.vertices[conv_name].layer
+        bn_params = params.get(bn_name, {})
+        bn_state = state.get(bn_name, {})
+        nf = bn_state["mean"].shape[0]
+        gamma = bn_params.get("gamma", jnp.full((nf,), bn.gamma, y.dtype))
+        beta = bn_params.get("beta", jnp.full((nf,), bn.beta, y.dtype))
+        out, new_mean, new_var = bn_act_conv1x1(
+            y, gamma, beta, bn_state["mean"], bn_state["var"],
+            params[conv_name]["W"], params[conv_name].get("b"),
+            train=train, eps=bn.eps, decay=bn.decay, act=p_act,
+            data_format=conv.data_format)
+        acts[conv_name] = _act.get(conv.activation)(out)
+        new_state[bn_name] = ({"mean": new_mean, "var": new_var}
+                              if train else bn_state)
+        new_state[conv_name] = state.get(conv_name, {})
+
+    def _as_mask_dict(self, masks, default_key=None) -> Optional[Dict[str, Any]]:
+        """Normalize a masks argument: a dict maps vertex name -> mask
+        (None entries dropped); a bare array masks `default_key` (the
+        first network input unless given, e.g. an output for label
+        masks); None/all-None -> None."""
         if masks is None:
             return None
         if not isinstance(masks, dict):
-            return {self.conf.network_inputs[0]: jnp.asarray(masks)}
+            key = default_key or self.conf.network_inputs[0]
+            return {key: jnp.asarray(masks)}
         out = {k: jnp.asarray(v) for k, v in masks.items() if v is not None}
         return out or None
 
@@ -284,16 +418,9 @@ class ComputationGraph:
         labels = {self.conf.network_outputs[0]: jnp.asarray(ds.labels)} \
             if not isinstance(ds.labels, dict) else \
             {k: jnp.asarray(v) for k, v in ds.labels.items()}
-        fmasks = None
-        if ds.features_mask is not None:
-            fmasks = {self.conf.network_inputs[0]: jnp.asarray(ds.features_mask)} \
-                if not isinstance(ds.features_mask, dict) else \
-                {k: jnp.asarray(v) for k, v in ds.features_mask.items()}
-        lmasks = None
-        if ds.labels_mask is not None:
-            lmasks = {self.conf.network_outputs[0]: jnp.asarray(ds.labels_mask)} \
-                if not isinstance(ds.labels_mask, dict) else \
-                {k: jnp.asarray(v) for k, v in ds.labels_mask.items()}
+        fmasks = self._as_mask_dict(ds.features_mask)
+        lmasks = self._as_mask_dict(ds.labels_mask,
+                                    default_key=self.conf.network_outputs[0])
         self.params, self.state, self.updater_state, loss = step(
             self.params, self.state, self.updater_state, inputs, labels, rng,
             fmasks, lmasks)

@@ -1,0 +1,307 @@
+"""Fused BatchNorm→activation→1×1-convolution (the ResNet bottleneck hot
+path).
+
+Why: the ResNet50 profile (PERF.md) shows the step is HBM-bandwidth-bound
+on BatchNorm traffic, not MXU-bound — the normalize pass writes a full
+activation tensor that the next conv immediately re-reads. For the
+bn→relu→1×1-conv chains inside bottleneck blocks (the only place the
+normalized tensor has a single consumer), the normalize+activation can be
+a *prologue* of the next conv instead: read the raw conv output once,
+normalize on the fly in VMEM, and feed the MXU directly. A 1×1 conv is a
+channel matmul, so the fused op is `act(y∘a + b) @ W` with per-channel
+affine (a, b) folded from the batch-norm statistics.
+
+This out-engineers the reference's fused cuDNN path
+(deeplearning4j-cuda/.../convolution/CudnnConvolutionHelper.java:54-480,
+CudnnBatchNormalizationHelper.java:45-234): cuDNN fuses bias+activation
+into the conv epilogue; here the whole BN-apply rides the conv prologue
+and the backward recomputes the normalized tensor instead of storing it.
+
+Two implementations behind one interface:
+- a Pallas TPU kernel (`use_pallas=True`): forward reads y once per
+  output tile; the backward is ONE pass over (y, g) producing dy and
+  accumulating dW, d(scale), d(bias) in VMEM scratch — replacing the
+  separate relu-mask read, two BN reductions, and dW matmul read that
+  autodiff of the unfused chain issues.
+- a jnp formulation (fallback/CPU): the same math as dot_general, which
+  XLA can fuse the affine prologue into.
+
+Batch statistics (E[x], E[x²] one-pass, fp32) and the running-stat decay
+stay in jnp — they are a reduction XLA fuses well, and keeping them
+outside the custom_vjp lets autodiff carry the BN stats backward chain
+(d mean/d var contributions to dy) automatically.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deeplearning4j_tpu.nn.activations import get as _get_act
+
+#: rows per grid step; full C (contraction) and K (output channels) stay
+#: resident — bottleneck shapes are C<=512, K<=2048, so W + a [bm,K] fp32
+#: tile fit VMEM comfortably
+DEFAULT_BLOCK_M = 256
+
+_SUPPORTED_ACTS = ("identity", "relu")
+
+
+def fused_conv1x1_supported(C: int, K: int, act: str) -> bool:
+    """Shape/activation gate for the Pallas path: the kernel keeps the
+    whole [C, K] weight and a [block_m, K] fp32 accumulator in VMEM."""
+    return act in _SUPPORTED_ACTS and C * K <= 512 * 2048 and K <= 4096
+
+
+def _pick_bm(M: int) -> int:
+    for bm in (DEFAULT_BLOCK_M, 128, 64, 32, 16, 8):
+        if M % bm == 0:
+            return bm
+    return DEFAULT_BLOCK_M  # non-divisible: kernel masks the tail rows
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernels
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(y_ref, sc_ref, bb_ref, w_ref, b_ref, o_ref, *, act):
+    z = y_ref[...].astype(jnp.float32) * sc_ref[...] + bb_ref[...]
+    if act == "relu":
+        z = jnp.maximum(z, 0.0)
+    out = lax.dot_general(z.astype(w_ref.dtype), w_ref[...],
+                          (((1,), (0,)), ((), ())),
+                          preferred_element_type=jnp.float32)
+    o_ref[...] = (out + b_ref[...]).astype(o_ref.dtype)
+
+
+def _bwd_kernel(y_ref, sc_ref, bb_ref, w_ref, g_ref,
+                dy_ref, dsc_ref, dbb_ref, dw_ref, db_ref,
+                dw_scr, dsc_scr, dbb_scr, db_scr,
+                *, act, nm, bm, M):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        dw_scr[...] = jnp.zeros_like(dw_scr)
+        dsc_scr[...] = jnp.zeros_like(dsc_scr)
+        dbb_scr[...] = jnp.zeros_like(dbb_scr)
+        db_scr[...] = jnp.zeros_like(db_scr)
+
+    yf = y_ref[...].astype(jnp.float32)                     # [bm, C]
+    g = g_ref[...]                                          # [bm, K]
+    if M % bm:
+        # tail block: rows beyond M are garbage loads (possibly inf/nan)
+        # — select them to zero out of every reduction and of the dz
+        # that feeds dy (stores are masked by Pallas, but the scratch
+        # accumulators are not; 0*garbage would still be nan)
+        row = i * bm + lax.broadcasted_iota(jnp.int32, (bm, 1), 0)
+        valid = row < M
+        yf = jnp.where(valid, yf, 0.0)
+        g = jnp.where(valid, g, jnp.zeros((), g.dtype))
+    z0 = yf * sc_ref[...] + bb_ref[...]
+    z = jnp.maximum(z0, 0.0) if act == "relu" else z0
+    if M % bm:
+        z = jnp.where(valid, z, 0.0)
+    dz = lax.dot_general(g, w_ref[...], (((1,), (1,)), ((), ())),
+                         preferred_element_type=jnp.float32)  # [bm, C]
+    if act == "relu":
+        dz = jnp.where(z0 > 0, dz, 0.0)
+    if M % bm:
+        dz = jnp.where(valid, dz, 0.0)
+    dy_ref[...] = (dz * sc_ref[...]).astype(dy_ref.dtype)
+    dw_scr[...] += lax.dot_general(z.astype(g.dtype), g,
+                                   (((0,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+    dsc_scr[...] += jnp.sum(dz * yf, axis=0, keepdims=True)
+    dbb_scr[...] += jnp.sum(dz, axis=0, keepdims=True)
+    db_scr[...] += jnp.sum(g.astype(jnp.float32), axis=0, keepdims=True)
+
+    @pl.when(i == nm - 1)
+    def _finish():
+        dw_ref[...] = dw_scr[...].astype(dw_ref.dtype)
+        dsc_ref[...] = dsc_scr[...]
+        dbb_ref[...] = dbb_scr[...]
+        db_ref[...] = db_scr[...]
+
+
+def _row_block(bm, C):
+    return pl.BlockSpec((bm, C), lambda i: (i, 0))
+
+
+def _full_spec(r, c):
+    return pl.BlockSpec((r, c), lambda i: (0, 0))
+
+
+def _pallas_fwd(y2, sc, bb, w2, b, act, bm, interpret):
+    M, C = y2.shape
+    K = w2.shape[1]
+    nm = -(-M // bm)
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, act=act),
+        grid=(nm,),
+        in_specs=[_row_block(bm, C), _full_spec(1, C), _full_spec(1, C),
+                  _full_spec(C, K), _full_spec(1, K)],
+        out_specs=_row_block(bm, K),
+        out_shape=jax.ShapeDtypeStruct((M, K), y2.dtype),
+        scratch_shapes=[],
+        interpret=interpret,
+    )(y2, sc[None, :], bb[None, :], w2, b[None, :])
+
+
+def _pallas_bwd(y2, sc, bb, w2, g, act, bm, interpret):
+    M, C = y2.shape
+    K = w2.shape[1]
+    nm = -(-M // bm)
+    dy, dsc, dbb, dw, db = pl.pallas_call(
+        functools.partial(_bwd_kernel, act=act, nm=nm, bm=bm, M=M),
+        grid=(nm,),
+        in_specs=[_row_block(bm, C), _full_spec(1, C), _full_spec(1, C),
+                  _full_spec(C, K), _row_block(bm, K)],
+        out_specs=[_row_block(bm, C), _full_spec(1, C), _full_spec(1, C),
+                   _full_spec(C, K), _full_spec(1, K)],
+        out_shape=[jax.ShapeDtypeStruct((M, C), y2.dtype),
+                   jax.ShapeDtypeStruct((1, C), jnp.float32),
+                   jax.ShapeDtypeStruct((1, C), jnp.float32),
+                   jax.ShapeDtypeStruct((C, K), w2.dtype),
+                   jax.ShapeDtypeStruct((1, K), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((C, K), jnp.float32),
+                        pltpu.VMEM((1, C), jnp.float32),
+                        pltpu.VMEM((1, C), jnp.float32),
+                        pltpu.VMEM((1, K), jnp.float32)],
+        interpret=interpret,
+    )(y2, sc[None, :], bb[None, :], w2, g)
+    return dy, dsc[0], dbb[0], dw, db[0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _fused_matmul_pallas(y2, sc, bb, w2, b, act, bm, interpret):
+    """act(y2 ∘ sc + bb) @ w2 + b with a Pallas forward and a one-pass
+    Pallas backward. y2: [M, C]; sc/bb: [C] fp32; w2: [C, K]; b: [K]."""
+    out, _ = _fused_matmul_fwd(y2, sc, bb, w2, b, act, bm, interpret)
+    return out
+
+
+def _fused_matmul_fwd(y2, sc, bb, w2, b, act, bm, interpret):
+    out = _pallas_fwd(y2, sc, bb, w2, b, act, bm, interpret)
+    return out, (y2, sc, bb, w2)
+
+
+def _fused_matmul_bwd(act, bm, interpret, res, g):
+    y2, sc, bb, w2 = res
+    dy, dsc, dbb, dw, db = _pallas_bwd(y2, sc, bb, w2, g, act, bm,
+                                       interpret)
+    return dy, dsc, dbb, dw, db
+
+
+_fused_matmul_pallas.defvjp(_fused_matmul_fwd, _fused_matmul_bwd)
+
+
+def _fused_matmul_ref(y2, sc, bb, w2, b, act):
+    """jnp formulation (autodiff backward); same contract as the kernel.
+    Accumulation dtype follows sc (>= fp32; fp64 under x64 inputs)."""
+    z = y2.astype(sc.dtype) * sc[None, :] + bb[None, :]
+    if act == "relu":
+        z = jnp.maximum(z, 0.0)
+    elif act != "identity":
+        z = _get_act(act)(z)
+    out = lax.dot_general(z.astype(w2.dtype), w2, (((1,), (0,)), ((), ())),
+                          preferred_element_type=sc.dtype)
+    return (out + b[None, :]).astype(y2.dtype)
+
+
+# ---------------------------------------------------------------------------
+# full bn→act→conv1x1 semantics
+# ---------------------------------------------------------------------------
+
+
+def bn_act_conv1x1(
+    x: jax.Array,
+    gamma: jax.Array,
+    beta: jax.Array,
+    running_mean: jax.Array,
+    running_var: jax.Array,
+    w: jax.Array,
+    b: Optional[jax.Array],
+    *,
+    train: bool,
+    eps: float = 1e-5,
+    decay: float = 0.9,
+    act: str = "relu",
+    data_format: str = "NCHW",
+    use_pallas: Optional[bool] = None,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """BatchNorm → activation → 1×1 conv (stride 1, no padding) in one op.
+
+    x: the RAW preceding conv output [N,C,H,W] or [N,H,W,C]; w: [O,I,1,1]
+    (DL4J layout, I == C); b: conv bias [O] or None. Semantics match
+    layers.BatchNormalization.apply → ActivationLayer → ConvolutionLayer
+    (ref: BatchNormalization.java eps/decay defaults, ConvolutionLayer.java)
+    with the affine folded: y_hat∘γ+β == x∘(γ·inv) + (β − μ·γ·inv).
+    Returns (out, new_running_mean, new_running_var) — running stats fp32,
+    decay semantics `new = decay·old + (1−decay)·batch` as batch_norm().
+    """
+    ch_axis = 3 if (data_format == "NHWC" and x.ndim == 4) else 1
+    axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    # accumulate in >= fp32 like batch_norm() (fp64 under x64 inputs)
+    acc_t = jnp.promote_types(x.dtype, jnp.float32)
+    gamma32 = gamma.astype(acc_t)
+    beta32 = beta.astype(acc_t)
+    if train:
+        xf = x.astype(acc_t)
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.maximum(jnp.mean(xf * xf, axis=axes) - mean * mean, 0.0)
+        new_mean = (decay * running_mean.astype(acc_t)
+                    + (1.0 - decay) * mean)
+        new_var = (decay * running_var.astype(acc_t)
+                   + (1.0 - decay) * var)
+    else:
+        mean = running_mean.astype(acc_t)
+        var = running_var.astype(acc_t)
+        new_mean, new_var = running_mean, running_var
+    inv = lax.rsqrt(var + eps)
+    sc = gamma32 * inv
+    bb = beta32 - mean * sc
+
+    O, I = w.shape[0], w.shape[1]
+    w2 = w.reshape(O, I).T                                  # [C, K]
+    bias = jnp.zeros((O,), acc_t) if b is None else b.astype(acc_t)
+
+    if use_pallas is None:
+        use_pallas = (jax.default_backend() == "tpu"
+                      and fused_conv1x1_supported(I, O, act))
+
+    if ch_axis == 3 or x.ndim == 2:
+        shape = x.shape
+        y2 = x.reshape(-1, shape[-1])
+        if use_pallas:
+            w2c = w2.astype(x.dtype)
+            out2 = _fused_matmul_pallas(
+                y2, sc.astype(jnp.float32), bb.astype(jnp.float32), w2c,
+                bias.astype(jnp.float32), act,
+                _pick_bm(y2.shape[0]), interpret)
+        else:
+            out2 = _fused_matmul_ref(y2, sc, bb, w2, bias, act)
+        out = out2.reshape(shape[:-1] + (O,))
+    else:
+        # NCHW: keep the channel contraction as a dot_general without a
+        # materialized transpose; Pallas path needs channel-minor, so
+        # this layout always takes the XLA formulation
+        z = (x.astype(acc_t) * sc.reshape(1, -1, 1, 1)
+             + bb.reshape(1, -1, 1, 1))
+        if act == "relu":
+            z = jnp.maximum(z, 0.0)
+        elif act != "identity":
+            z = _get_act(act)(z)
+        out = jnp.einsum("nchw,oc->nohw", z.astype(x.dtype),
+                         w.reshape(O, I),
+                         preferred_element_type=acc_t)
+        out = (out + bias.reshape(1, -1, 1, 1)).astype(x.dtype)
+    return out, new_mean.astype(jnp.float32), new_var.astype(jnp.float32)

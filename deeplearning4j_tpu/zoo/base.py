@@ -54,9 +54,21 @@ class ZooModel:
             ComputationGraphConfiguration, MultiLayerConfiguration)
         if isinstance(conf, MultiLayerConfiguration):
             from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+            if self.kwargs.get("fuse", False):
+                raise ValueError(
+                    f"{type(self).__name__}: fuse=True needs a "
+                    "ComputationGraph model (the bn→act→conv fusion plan "
+                    "is a graph execution feature)")
             return MultiLayerNetwork(conf).init()
         from deeplearning4j_tpu.nn.graph import ComputationGraph
-        return ComputationGraph(conf).init()
+        return self._maybe_fuse(ComputationGraph(conf).init())
+
+    def _maybe_fuse(self, net):
+        """Apply the model's fuse kwarg to a freshly built/restored net
+        (graphs only — restore paths must honor it too)."""
+        if self.kwargs.get("fuse", False) and hasattr(net, "set_fusion"):
+            net.set_fusion(True)
+        return net
 
     def init_pretrained(self, flavor: str = "imagenet",
                         cache_dir: Optional[str] = None,
@@ -68,7 +80,7 @@ class ZooModel:
         pretrained spec may carry "url" (downloaded + checksummed, ref
         ZooModel.java:52-81) or "file" (a locally generated fixture)."""
         if local_path:
-            return _restore_any(local_path)
+            return self._maybe_fuse(_restore_any(local_path))
         if flavor not in self.pretrained:
             raise ValueError(f"{type(self).__name__} has no pretrained '{flavor}'")
         spec = self.pretrained[flavor]
@@ -87,7 +99,7 @@ class ZooModel:
                 if "url" in spec:
                     os.remove(fname)  # our cached download — refetch next call
                 raise IOError(f"checksum mismatch for {fname}")
-        return _restore_any(fname)
+        return self._maybe_fuse(_restore_any(fname))
 
     def save_pretrained_fixture(self, path: str,
                                 flavor: str = "local") -> Dict[str, str]:

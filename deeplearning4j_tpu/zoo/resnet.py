@@ -23,6 +23,10 @@ from deeplearning4j_tpu.zoo.base import ZooModel, register_model
 class ResNet50(ZooModel):
     def __init__(self, num_classes: int = 1000, seed: int = 12345,
                  height: int = 224, width: int = 224, channels: int = 3, **kw):
+        # fused bn→relu→1×1-conv execution for the bottleneck chains (the
+        # profile-driven HBM win, nn/layers/fused.py) — equivalence-pinned
+        # by tests/test_fused.py; pass fuse=False for the unfused plan
+        kw.setdefault("fuse", True)
         super().__init__(num_classes, seed, **kw)
         self.height, self.width, self.channels = height, width, channels
 

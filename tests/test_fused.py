@@ -1,0 +1,305 @@
+"""Fused bn→act→1×1-conv execution plan (nn/layers/fused.py + the
+ComputationGraph fusion planner): same numbers as the unfused graph, by
+construction and by these pins. The perf rationale is PERF.md (ResNet50
+is HBM-bound on BatchNorm traffic); the reference's analogous machinery
+is the fused cuDNN path (CudnnConvolutionHelper.java:54-480)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph_conf import ElementWiseVertex
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
+    GlobalPoolingLayer, OutputLayer,
+)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers.fused import bn_act_conv1x1
+from deeplearning4j_tpu.nn.updater import Sgd
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+RNG = np.random.default_rng(7)
+
+
+def _bottleneck_graph(fmt="NCHW"):
+    """conv → bn → relu → 1×1 conv (+ a second consumerless-bn control
+    feeding the residual add) — the ResNet bottleneck shape."""
+    conf = (NeuralNetConfiguration.Builder().seed(3).updater(Sgd(0.05))
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.convolutional(8, 8, 4))
+            .add_layer("c1", ConvolutionLayer(n_out=4, kernel=(3, 3),
+                                              padding=(1, 1),
+                                              activation="identity",
+                                              has_bias=False), "in")
+            .add_layer("bn1", BatchNormalization(), "c1")
+            .add_layer("act1", ActivationLayer(activation="relu"), "bn1")
+            .add_layer("c2", ConvolutionLayer(n_out=4, kernel=(1, 1),
+                                              activation="identity",
+                                              has_bias=False), "act1")
+            .add_layer("bn2", BatchNormalization(), "c2")
+            .add_vertex("skip", ElementWiseVertex(op="add"), "bn2", "c1")
+            .add_layer("pool", GlobalPoolingLayer(pooling_type="avg"), "skip")
+            .add_layer("out", OutputLayer(n_out=3, loss="mcxent",
+                                          activation="softmax"), "pool")
+            .set_outputs("out").build())
+    if fmt != "NCHW":
+        conf.use_cnn_data_format(fmt)
+    return conf
+
+
+def _data():
+    x = RNG.standard_normal((4, 4, 8, 8)).astype(np.float32)
+    y = np.zeros((4, 3), np.float32)
+    y[np.arange(4), RNG.integers(0, 3, 4)] = 1.0
+    return x, y
+
+
+class TestFusionPlan:
+    def test_bottleneck_chain_detected(self):
+        net = ComputationGraph(_bottleneck_graph()).init().set_fusion(True)
+        plan, skip = net._fusion()
+        assert set(plan) == {"c2"}
+        assert plan["c2"] == ("bn1", "relu", "c1")
+        assert set(skip) == {"bn1", "act1"}
+
+    def test_multi_consumer_bn_not_fused(self):
+        """A bn whose output feeds two vertices must stay materialized."""
+        conf = (NeuralNetConfiguration.Builder().seed(3)
+                .graph_builder()
+                .add_inputs("in")
+                .set_input_types(InputType.convolutional(8, 8, 4))
+                .add_layer("c1", ConvolutionLayer(n_out=8, kernel=(1, 1),
+                                                  activation="identity"),
+                           "in")
+                .add_layer("bn1", BatchNormalization(activation="relu"),
+                           "c1")
+                .add_layer("c2", ConvolutionLayer(n_out=8, kernel=(1, 1),
+                                                  activation="identity"),
+                           "bn1")
+                .add_vertex("add", ElementWiseVertex(op="add"), "c2", "bn1")
+                .add_layer("pool", GlobalPoolingLayer(pooling_type="avg"), "add")
+                .add_layer("out", OutputLayer(n_out=3, loss="mcxent",
+                                              activation="softmax"), "pool")
+                .set_outputs("out").build())
+        net = ComputationGraph(conf).init().set_fusion(True)
+        plan, skip = net._fusion()
+        assert plan == {} and skip == {}
+
+    def test_non_1x1_conv_not_fused(self):
+        conf = (NeuralNetConfiguration.Builder().seed(3)
+                .graph_builder()
+                .add_inputs("in")
+                .set_input_types(InputType.convolutional(8, 8, 4))
+                .add_layer("c1", ConvolutionLayer(n_out=8, kernel=(1, 1),
+                                                  activation="identity"),
+                           "in")
+                .add_layer("bn1", BatchNormalization(activation="relu"),
+                           "c1")
+                .add_layer("c2", ConvolutionLayer(n_out=8, kernel=(3, 3),
+                                                  padding=(1, 1)), "bn1")
+                .add_layer("pool", GlobalPoolingLayer(pooling_type="avg"), "c2")
+                .add_layer("out", OutputLayer(n_out=3, loss="mcxent",
+                                              activation="softmax"), "pool")
+                .set_outputs("out").build())
+        net = ComputationGraph(conf).init().set_fusion(True)
+        plan, _ = net._fusion()
+        assert plan == {}
+
+    def test_bn_own_activation_chain_detected(self):
+        """bn(activation=relu) → conv (no separate ActivationLayer)."""
+        conf = (NeuralNetConfiguration.Builder().seed(3)
+                .graph_builder()
+                .add_inputs("in")
+                .set_input_types(InputType.convolutional(8, 8, 4))
+                .add_layer("c1", ConvolutionLayer(n_out=8, kernel=(1, 1),
+                                                  activation="identity"),
+                           "in")
+                .add_layer("bn1", BatchNormalization(activation="relu"),
+                           "c1")
+                .add_layer("c2", ConvolutionLayer(n_out=8, kernel=(1, 1)),
+                           "bn1")
+                .add_layer("pool", GlobalPoolingLayer(pooling_type="avg"), "c2")
+                .add_layer("out", OutputLayer(n_out=3, loss="mcxent",
+                                              activation="softmax"), "pool")
+                .set_outputs("out").build())
+        net = ComputationGraph(conf).init().set_fusion(True)
+        plan, skip = net._fusion()
+        assert set(plan) == {"c2"} and plan["c2"][1] == "relu"
+        assert set(skip) == {"bn1"}
+
+    def test_resnet50_fuses_all_bottleneck_c_convs(self):
+        from deeplearning4j_tpu.zoo import ResNet50
+        net = ResNet50(num_classes=10, height=64, width=64).init()
+        plan, skip = net._fusion()
+        # 16 bottleneck blocks, each with exactly the b_bn→b_act→c_conv
+        # chain eligible (a feeds a 3×3, skip/c feed adds)
+        assert len(plan) == 16
+        assert all(k.endswith("_c_conv") for k in plan)
+
+
+class TestFusedEquivalence:
+    @pytest.mark.parametrize("fmt", ["NCHW", "NHWC"])
+    def test_forward_matches_unfused(self, fmt):
+        x, _ = _data()
+        a = ComputationGraph(_bottleneck_graph(fmt)).init()
+        b = ComputationGraph(_bottleneck_graph(fmt)).init().set_fusion(True)
+        np.testing.assert_allclose(np.asarray(a.output(x)),
+                                   np.asarray(b.output(x)),
+                                   atol=1e-5, rtol=1e-5)
+
+    @pytest.mark.parametrize("fmt", ["NCHW", "NHWC"])
+    def test_train_step_matches_unfused(self, fmt):
+        """Params, bn running stats, and score identical after fitting —
+        gradients through the fused op equal the unfused chain's."""
+        x, y = _data()
+        a = ComputationGraph(_bottleneck_graph(fmt)).init()
+        b = ComputationGraph(_bottleneck_graph(fmt)).init().set_fusion(True)
+        for _ in range(3):
+            a.fit(DataSet(x, y))
+            b.fit(DataSet(x, y))
+        assert np.isclose(a.score_value, b.score_value, atol=1e-6)
+        fa = jax.tree_util.tree_leaves(a.params)
+        fb = jax.tree_util.tree_leaves(b.params)
+        for pa, pb in zip(fa, fb):
+            np.testing.assert_allclose(np.asarray(pa), np.asarray(pb),
+                                       atol=2e-5, rtol=1e-4)
+        for name in ("bn1", "bn2"):
+            for k in ("mean", "var"):
+                np.testing.assert_allclose(
+                    np.asarray(a.state[name][k]),
+                    np.asarray(b.state[name][k]), atol=1e-5,
+                    err_msg=f"{name}.{k}")
+
+    def test_eval_mode_uses_running_stats(self):
+        x, y = _data()
+        a = ComputationGraph(_bottleneck_graph()).init()
+        b = ComputationGraph(_bottleneck_graph()).init().set_fusion(True)
+        a.fit(DataSet(x, y))
+        b.fit(DataSet(x, y))
+        x2 = RNG.standard_normal((2, 4, 8, 8)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(a.output(x2)),
+                                   np.asarray(b.output(x2)),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_resnet50_tiny_equivalence(self):
+        """The real flagship graph: fused == unfused forward (fp32) and
+        loss+gradient EXACTNESS in float64 — fp32 post-step params are
+        not comparable on a 50-layer BN net at init (backprop
+        conditioning amplifies any reassociation; verified ~1e-13 at
+        f64, so both plans compute the same mathematical function)."""
+        from deeplearning4j_tpu.zoo import ResNet50
+        x = RNG.standard_normal((2, 3, 64, 64)).astype(np.float32)
+        y = np.zeros((2, 10), np.float32)
+        y[:, 0] = 1.0
+        a = ResNet50(num_classes=10, height=64, width=64, seed=1,
+                     fuse=False).init()
+        b = ResNet50(num_classes=10, height=64, width=64, seed=1).init()
+        plan, _ = b._fusion()
+        assert len(plan) == 16
+        np.testing.assert_allclose(np.asarray(a.output(x)),
+                                   np.asarray(b.output(x)),
+                                   atol=1e-4, rtol=1e-3)
+
+        def loss_and_grads(net):
+            params = jax.tree_util.tree_map(
+                lambda p: jnp.asarray(p, jnp.float64), net.params)
+            state = jax.tree_util.tree_map(
+                lambda s: jnp.asarray(s, jnp.float64), net.state)
+            inputs = {net.conf.network_inputs[0]:
+                      jnp.asarray(x, jnp.float64)}
+            labels = {net.conf.network_outputs[0]:
+                      jnp.asarray(y, jnp.float64)}
+            return jax.value_and_grad(
+                lambda p: net._loss(p, state, inputs, labels,
+                                    jax.random.PRNGKey(0), None, None,
+                                    train=True)[0])(params)
+
+        la, ga = loss_and_grads(a)
+        lb, gb = loss_and_grads(b)
+        assert abs(float(la) - float(lb)) < 1e-10
+        for pa, pb in zip(jax.tree_util.tree_leaves(ga),
+                          jax.tree_util.tree_leaves(gb)):
+            np.testing.assert_allclose(np.asarray(pb), np.asarray(pa),
+                                       atol=1e-9, rtol=1e-7)
+
+    def test_serialization_unaffected(self):
+        """Fused execution keeps the original param/state pytree: a
+        checkpoint written fused restores into an unfused net."""
+        import os
+        import tempfile
+        from deeplearning4j_tpu.util.model_serializer import (
+            restore_computation_graph, write_model)
+        x, _ = _data()
+        b = ComputationGraph(_bottleneck_graph()).init().set_fusion(True)
+        want = np.asarray(b.output(x))
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "m.zip")
+            write_model(b, p)
+            back = restore_computation_graph(p)   # unfused by default
+        np.testing.assert_allclose(np.asarray(back.output(x)), want,
+                                   atol=1e-5, rtol=1e-5)
+
+
+class TestPallasFusedKernel:
+    """Interpret-mode exactness of the Pallas path vs the jnp formulation
+    (the TPU-compiled path reuses the identical kernel code)."""
+
+    @pytest.mark.parametrize("act", ["relu", "identity"])
+    @pytest.mark.parametrize("train", [True, False])
+    def test_kernel_matches_ref(self, act, train):
+        N, H, W, C, O = 2, 4, 4, 16, 24
+        x = jnp.asarray(RNG.standard_normal((N, H, W, C)), jnp.float32)
+        gamma = jnp.asarray(RNG.standard_normal(C) * 0.3 + 1.0, jnp.float32)
+        beta = jnp.asarray(RNG.standard_normal(C) * 0.2, jnp.float32)
+        rm = jnp.asarray(RNG.standard_normal(C) * 0.1, jnp.float32)
+        rv = jnp.asarray(np.abs(RNG.standard_normal(C)) + 0.4, jnp.float32)
+        w = jnp.asarray(RNG.standard_normal((O, C, 1, 1)) * 0.2, jnp.float32)
+        b = jnp.asarray(RNG.standard_normal(O) * 0.1, jnp.float32)
+
+        def run(use_pallas):
+            def f(x_, g_, be_, w_, b_):
+                o, nm, nv = bn_act_conv1x1(
+                    x_, g_, be_, rm, rv, w_, b_, train=train, act=act,
+                    data_format="NHWC", use_pallas=use_pallas,
+                    interpret=True)
+                return jnp.sum(jnp.sin(o)) + jnp.sum(nm) + jnp.sum(nv)
+            val, grads = jax.value_and_grad(
+                f, argnums=(0, 1, 2, 3, 4))(x, gamma, beta, w, b)
+            return val, grads
+
+        v_ref, g_ref = run(False)
+        v_pal, g_pal = run(True)
+        assert np.isclose(float(v_ref), float(v_pal), atol=1e-5)
+        for gr, gp, nm in zip(g_ref, g_pal, "x gamma beta w b".split()):
+            np.testing.assert_allclose(np.asarray(gp), np.asarray(gr),
+                                       atol=3e-5, rtol=1e-4,
+                                       err_msg=f"d{nm}")
+
+    def test_tail_rows_masked(self):
+        """M not divisible by any block size: reductions must exclude the
+        garbage tail rows."""
+        N, H, W, C, O = 1, 3, 6, 8, 8        # M = 18
+        x = jnp.asarray(RNG.standard_normal((N, H, W, C)), jnp.float32)
+        w = jnp.asarray(RNG.standard_normal((O, C, 1, 1)) * 0.2, jnp.float32)
+        gamma, beta = jnp.ones(C), jnp.zeros(C)
+        rm, rv = jnp.zeros(C), jnp.ones(C)
+
+        def f(use_pallas):
+            def loss(x_, w_):
+                o, _, _ = bn_act_conv1x1(x_, gamma, beta, rm, rv, w_, None,
+                                         train=True, act="relu",
+                                         data_format="NHWC",
+                                         use_pallas=use_pallas,
+                                         interpret=True)
+                return jnp.sum(jnp.sin(o))
+            return jax.value_and_grad(loss, argnums=(0, 1))(x, w)
+
+        (v_r, g_r), (v_p, g_p) = f(False), f(True)
+        assert np.isclose(float(v_r), float(v_p), atol=1e-5)
+        for a, b_ in zip(g_r, g_p):
+            np.testing.assert_allclose(np.asarray(b_), np.asarray(a),
+                                       atol=3e-5, rtol=1e-4)
