@@ -1,0 +1,104 @@
+"""Worker process for the REAL cross-process `jax.distributed` test.
+
+Spawned by tests/test_distributed_multiprocess.py (2 processes, localhost
+gRPC coordinator, 4 virtual CPU devices each -> 8-device global mesh).
+This is the TPU-era equivalent of the reference running Spark distribution
+tests with master=local[N] in one JVM (BaseSparkTest.java) — except here the
+workers genuinely live in SEPARATE OS processes and meet through the
+jax.distributed coordination service, so `parallel/distributed.py`'s
+initialize/host_local_batch/make_global_array path executes for real.
+
+Each worker:
+  1. brings up jax.distributed via VoidConfiguration (gRPC over localhost —
+     the DCN stand-in),
+  2. builds the same tiny MLN from the same seed,
+  3. owns only its HOST-LOCAL shard of a deterministic global batch
+     (Spark-executor-partition analogue),
+  4. assembles globally-sharded arrays with make_global_array and runs the
+     model's own jitted allreduce train step over the global mesh,
+  5. writes final params + per-step losses for the parent to compare against
+     a single-process run of the identical global batch
+     (TestCompareParameterAveragingSparkVsSingleMachine invariant).
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    coord, nproc, pid, local_dev, out_path = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
+        sys.argv[5])
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={local_dev}"
+        ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deeplearning4j_tpu.parallel import distributed as dist
+
+    dist.initialize(dist.VoidConfiguration(
+        coordinator_address=coord, num_processes=nproc, process_id=pid))
+    assert dist.process_count() == nproc, jax.process_count()
+    assert dist.process_index() == pid
+    assert jax.local_device_count() == local_dev
+    assert jax.device_count() == nproc * local_dev
+
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updater import Sgd
+
+    net = MultiLayerNetwork(
+        (NeuralNetConfiguration.Builder()
+         .seed(4).updater(Sgd(0.1)).weight_init("xavier").list()
+         .layer(DenseLayer(n_out=6, activation="tanh"))
+         .layer(OutputLayer(n_out=3, loss="mcxent", activation="softmax"))
+         .set_input_type(InputType.feed_forward(5))
+         .build())).init()
+
+    # deterministic global batch; this worker materializes ONLY its shard
+    rng = np.random.default_rng(7)
+    gx = rng.standard_normal((16, 5)).astype(np.float32)
+    gy = np.zeros((16, 3), np.float32)
+    gy[np.arange(16), rng.integers(0, 3, 16)] = 1.0
+    local_n = dist.host_local_batch(16)
+    assert local_n == 16 // nproc
+    lo = pid * local_n
+    x_local, y_local = gx[lo:lo + local_n], gy[lo:lo + local_n]
+
+    mesh = dist.global_mesh()
+    assert int(np.prod(mesh.devices.shape)) == nproc * local_dev
+
+    rep = NamedSharding(mesh, P())
+    params = jax.device_put(net.params, rep)
+    state = jax.device_put(net.state, rep)
+    upd = jax.device_put(net.updater_state, rep)
+    step = net._get_train_step(False)
+
+    losses = []
+    for _ in range(3):
+        x = dist.make_global_array(x_local, mesh)
+        y = dist.make_global_array(y_local, mesh)
+        params, state, upd, loss = step(params, state, upd, x, y,
+                                        net._next_rng(), None, None)
+        losses.append(float(loss))
+
+    flat = {}
+    for lname, lp in params.items():
+        for pname, arr in lp.items():
+            flat[f"{lname}/{pname}"] = np.asarray(arr)
+    np.savez(out_path, losses=np.array(losses), **flat)
+
+
+if __name__ == "__main__":
+    main()
