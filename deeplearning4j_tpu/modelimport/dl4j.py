@@ -47,9 +47,18 @@ name), writeInt(length), writeUTF(data type name), then the values
 big-endian. Shape info for rank r is ints [r, shape…, stride…, offset,
 elementWiseStride, order-char].
 
-Updater-state import (``updaterState.bin``) is parsed but only validated for
-length; mapping every ND4J GradientUpdater state layout is out of scope —
-training resumes with fresh updater state (documented divergence).
+Updater-state import (``updaterState.bin``): the reference lays the flat
+updater view out per UpdaterBlock (BaseMultiLayerUpdater.java:72-121) —
+contiguous (layer, variable) pairs with identical updater configuration
+combine into one block, and each block's view is [state0 | state1] where
+each state tensor spans the block's params in view order (the ND4J
+GradientUpdater contract, e.g. AdamUpdater: m = first half, v = second
+half; applied per block at UpdaterBlock.java:104-142). BatchNormalization's
+global mean/var use a NoOp updater (BatchNormalization.java:144-151,
+stateSize 0) and therefore BREAK blocks. Both directions are implemented
+here (`updater_state_from_flat` / `updater_state_to_flat`), mapping into
+our updater pytrees ({"m": tree, "v": tree, "t": n} etc.) with the same
+per-variable reshapes/gate permutations as the params themselves.
 """
 
 from __future__ import annotations
@@ -215,15 +224,18 @@ def _updater_from_dl4j(obj: Any):
     if name == "adam":
         return U.Adam(lr, beta1=float(f.get("beta1", 0.9)),
                       beta2=float(f.get("beta2", 0.999)),
-                      eps=float(f.get("epsilon", 1e-8)))
+                      epsilon=float(f.get("epsilon", 1e-8)))
     if name == "adamax":
         return U.AdaMax(lr, beta1=float(f.get("beta1", 0.9)),
-                        beta2=float(f.get("beta2", 0.999)))
+                        beta2=float(f.get("beta2", 0.999)),
+                        epsilon=float(f.get("epsilon", 1e-8)))
     if name == "nadam":
         return U.Nadam(lr, beta1=float(f.get("beta1", 0.9)),
-                       beta2=float(f.get("beta2", 0.999)))
+                       beta2=float(f.get("beta2", 0.999)),
+                       epsilon=float(f.get("epsilon", 1e-8)))
     if name == "rmsprop":
-        return U.RmsProp(lr, decay=float(f.get("rmsDecay", 0.95)))
+        return U.RmsProp(lr, rms_decay=float(f.get("rmsDecay", 0.95)),
+                         epsilon=float(f.get("epsilon", 1e-8)))
     if name == "adagrad":
         return U.AdaGrad(lr)
     if name == "adadelta":
@@ -615,6 +627,180 @@ def params_to_flat(conf, params: Dict[str, dict],
 
 
 # ---------------------------------------------------------------------------
+# updater state (updaterState.bin) <-> our updater pytrees
+# ---------------------------------------------------------------------------
+
+#: our updater state-tree keys, in the reference's view order (ND4J
+#: GradientUpdater.setStateViewArray layouts: AdamUpdater m|v, NadamUpdater
+#: m|v, AdaMaxUpdater m|u, AdaDeltaUpdater msg|msdx, NesterovsUpdater v,
+#: RmsPropUpdater lastGradient, AdaGradUpdater historicalGradient)
+_UPDATER_STATE_KEYS = {
+    "Adam": ("m", "v"), "Nadam": ("m", "v"), "AdaMax": ("m", "u"),
+    "AdaDelta": ("g2", "dx2"), "Nesterovs": ("v",), "RmsProp": ("g2",),
+    "AdaGrad": ("h",), "Sgd": (), "NoOp": (),
+}
+
+
+def _variable_layout(conf) -> List[Tuple[str, str, int, int, bool]]:
+    """The (layer_key, var, view_offset, size, has_updater_state) sequence
+    of the flat param view, mirroring params_from_flat exactly. Variables
+    with has_updater_state=False (BN global mean/var — NoOp updater per
+    BatchNormalization.java:144-151) occupy no updater-state view and break
+    updater blocks (BaseMultiLayerUpdater.java:95-99 block combining)."""
+    its = conf.layer_input_types()
+    out: List[Tuple[str, str, int, int, bool]] = []
+    pos = 0
+
+    def add(key, var, size, stateful=True):
+        nonlocal pos
+        out.append((key, var, pos, int(size), stateful))
+        pos += int(size)
+
+    for i, (layer, it) in enumerate(zip(conf.layers, its)):
+        t = type(layer).__name__
+        key = str(i)
+        if t in ("DenseLayer", "OutputLayer", "RnnOutputLayer",
+                 "EmbeddingLayer", "CenterLossOutputLayer"):
+            n_in = layer.n_in if layer.n_in else it.flat_size()
+            add(key, "W", n_in * layer.n_out)
+            if getattr(layer, "has_bias", True):
+                add(key, "b", layer.n_out)
+        elif t in ("AutoEncoder", "RBM"):
+            n_in = layer.n_in if layer.n_in else it.flat_size()
+            add(key, "W", n_in * layer.n_out)
+            add(key, "b", layer.n_out)
+            add(key, "vb", n_in)
+        elif t in ("ConvolutionLayer", "Deconvolution2DLayer"):
+            n_in = layer.n_in if layer.n_in else it.channels
+            kh, kw = (layer.kernel if isinstance(layer.kernel, (list, tuple))
+                      else (layer.kernel, layer.kernel))
+            if getattr(layer, "has_bias", True):
+                add(key, "b", layer.n_out)  # conv: bias FIRST
+            add(key, "W", layer.n_out * n_in * kh * kw)
+        elif t == "BatchNormalization":
+            nf = it.channels if it.kind == "cnn" else it.flat_size()
+            if not layer.lock_gamma_beta:
+                add(key, "gamma", nf)
+                add(key, "beta", nf)
+            add(key, "mean", nf, stateful=False)
+            add(key, "var", nf, stateful=False)
+        elif t in ("LSTM", "GravesLSTM"):
+            n_in = layer.n_in if layer.n_in else it.size
+            h = layer.n_out
+            rw_cols = 4 * h + (3 if t == "GravesLSTM" else 0)
+            add(key, "W", n_in * 4 * h)
+            add(key, "RW", h * rw_cols)
+            add(key, "b", 4 * h)
+        elif t == "GravesBidirectionalLSTM":
+            n_in = layer.n_in if layer.n_in else it.size
+            h = layer.n_out
+            for d in ("F", "B"):
+                add(key, "W" + d, n_in * 4 * h)
+                add(key, "RW" + d, h * (4 * h + 3))
+                add(key, "b" + d, 4 * h)
+    return out
+
+
+def _stateful_runs(layout):
+    """Maximal contiguous runs of stateful variables == updater blocks for
+    a uniform network-wide updater config (our conf model; the reference
+    additionally splits on per-layer LR/updater differences)."""
+    runs, cur = [], []
+    for entry in layout:
+        if entry[4]:
+            cur.append(entry)
+        elif cur:
+            runs.append(cur)
+            cur = []
+    if cur:
+        runs.append(cur)
+    return runs
+
+
+def updater_state_from_flat(conf, flat: np.ndarray, params: Dict[str, dict],
+                            iteration_count: int = 0):
+    """Decode a DL4J ``updaterState.bin`` flat view into our updater state
+    pytree (ref layout: BaseMultiLayerUpdater.java:72-121 blocks, each
+    [state0 | state1] over the block's params in view order).
+
+    `params` supplies the target structure/dtypes (our restored pytree);
+    returns None for stateless updaters (Sgd/NoOp). The iteration counter
+    (DL4J passes the model's iterationCount into applyUpdater,
+    UpdaterBlock.java:104) seeds the Adam-family "t"."""
+    import jax.numpy as jnp
+
+    updater = conf.updater
+    keys = _UPDATER_STATE_KEYS.get(type(updater).__name__)
+    if keys is None:
+        raise ValueError(
+            f"no DL4J updater-state layout for {type(updater).__name__}")
+    if not keys:
+        return None
+    k = len(keys)
+    flat = np.asarray(flat, np.float64).ravel()
+    layout = _variable_layout(conf)
+    view_len = sum(e[3] for e in layout)
+
+    # per-variable slices of each state tensor, block-interleaved
+    slices: Dict[Tuple[str, str, int], np.ndarray] = {}
+    pos = 0
+    for run in _stateful_runs(layout):
+        for j in range(k):
+            for (key, var, off, size, _) in run:
+                slices[(key, var, j)] = flat[pos:pos + size]
+                pos += size
+    if pos != flat.size:
+        raise ValueError(
+            f"updater state has {flat.size} values but the block layout "
+            f"consumed {pos} (updater {type(updater).__name__})")
+
+    # k synthetic param-view vectors -> params_from_flat applies the same
+    # per-variable reshapes/gate permutations as the params themselves
+    trees = []
+    for j in range(k):
+        synth = np.zeros((view_len,), np.float64)
+        for (key, var, off, size, stateful) in layout:
+            if stateful:
+                synth[off:off + size] = slices[(key, var, j)]
+        tree, _bn = params_from_flat(conf, synth)
+        trees.append({
+            lk: {pk: jnp.asarray(pv, params.get(lk, {}).get(
+                pk, np.zeros(1, np.float32)).dtype)
+                 for pk, pv in lp.items()}
+            for lk, lp in tree.items()})
+
+    state = dict(zip(keys, trees))
+    if type(updater).__name__ in ("Adam", "Nadam", "AdaMax"):
+        state["t"] = jnp.asarray(int(iteration_count), jnp.int32)
+    return state
+
+
+def updater_state_to_flat(conf, updater_state) -> Optional[np.ndarray]:
+    """Inverse of updater_state_from_flat: our updater pytree -> the DL4J
+    flat updater view (block-interleaved state tensors)."""
+    updater = conf.updater
+    keys = _UPDATER_STATE_KEYS.get(type(updater).__name__, None)
+    if not keys or updater_state is None:
+        return None
+    fulls = [params_to_flat(conf, updater_state[key], {}) for key in keys]
+    layout = _variable_layout(conf)
+    view_len = sum(e[3] for e in layout)
+    for full in fulls:
+        if full.size != view_len:
+            raise ValueError(
+                f"updater layout drift: param view is {full.size} values "
+                f"but _variable_layout declares {view_len}")
+    chunks: List[np.ndarray] = []
+    for run in _stateful_runs(layout):
+        for full in fulls:
+            for (key, var, off, size, _) in run:
+                chunks.append(full[off:off + size])
+    if not chunks:
+        return None
+    return np.concatenate(chunks)
+
+
+# ---------------------------------------------------------------------------
 # zip-level import / export
 # ---------------------------------------------------------------------------
 
@@ -634,8 +820,11 @@ def restore_multi_layer_network(path: str, input_type=None):
         conf_json = zf.read("configuration.json").decode()
         coeffs = (read_nd4j_array(zf.read("coefficients.bin"))
                   if "coefficients.bin" in names else None)
+        upd_flat = (read_nd4j_array(zf.read("updaterState.bin"))
+                    if "updaterState.bin" in names else None)
 
     conf = multi_layer_configuration_from_dl4j(conf_json)
+    iteration_count = int(json.loads(conf_json).get("iterationCount", 0))
     if input_type is not None:
         conf.input_type = input_type
     net = MultiLayerNetwork(conf)
@@ -652,6 +841,12 @@ def restore_multi_layer_network(path: str, input_type=None):
         for k, st in bn_state.items():
             net.state.setdefault(k, {}).update(
                 {sk: jnp.asarray(sv, jnp.float32) for sk, sv in st.items()})
+        if upd_flat is not None:
+            restored = updater_state_from_flat(conf, upd_flat, net.params,
+                                               iteration_count)
+            if restored is not None:
+                net.updater_state = restored
+    net.iteration_count = iteration_count
     return net
 
 
@@ -660,11 +855,16 @@ def save_dl4j_format(net, path: str) -> None:
     in the reference's Jackson shape + coefficients.bin flat vector). Used
     for zoo pretrained fixtures and export-to-DL4J."""
     flat = params_to_flat(net.conf, net.params, net.state)
+    conf_d = mlc_to_dl4j_json(net.conf)
+    conf_d["iterationCount"] = int(net.iteration_count)
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-        zf.writestr("configuration.json",
-                    json.dumps(mlc_to_dl4j_json(net.conf), indent=2))
+        zf.writestr("configuration.json", json.dumps(conf_d, indent=2))
         zf.writestr("coefficients.bin",
                     write_nd4j_array(flat.astype(np.float32)))
+        upd = updater_state_to_flat(net.conf, net.updater_state)
+        if upd is not None:
+            zf.writestr("updaterState.bin",
+                        write_nd4j_array(upd.astype(np.float32)))
 
 
 def _activation_to_dl4j(name: str) -> dict:
@@ -688,11 +888,40 @@ def _loss_to_dl4j(name: str) -> dict:
     return {table.get(name, "LossMSE"): {}}
 
 
-def _layer_to_dl4j(layer) -> dict:
+def _updater_to_dl4j(u) -> Optional[dict]:
+    """Our Updater → the nd4j IUpdater wrapper object (inverse of
+    _updater_from_dl4j; ref: config classes in org.nd4j.linalg.learning.config
+    serialized per-layer as the BaseLayer "iUpdater" field)."""
+    t = type(u).__name__
+    lr = {"learningRate": float(getattr(u, "learning_rate", 0.1))}
+    if t == "Sgd":
+        return {"Sgd": lr}
+    if t == "Nesterovs":
+        return {"Nesterovs": {**lr, "momentum": float(u.momentum)}}
+    if t in ("Adam", "AdaMax", "Nadam"):
+        return {t: {**lr, "beta1": float(u.beta1), "beta2": float(u.beta2),
+                    "epsilon": float(getattr(u, "epsilon", 1e-8))}}
+    if t == "RmsProp":
+        return {"RmsProp": {**lr, "rmsDecay": float(u.rms_decay),
+                            "epsilon": float(u.epsilon)}}
+    if t == "AdaGrad":
+        return {"AdaGrad": lr}
+    if t == "AdaDelta":
+        return {"AdaDelta": {"rho": float(u.rho)}}
+    if t == "NoOp":
+        return {"NoOp": {}}
+    return None
+
+
+def _layer_to_dl4j(layer, updater=None) -> dict:
     """Our LayerConf → a DL4J layer JSON wrapper object (subset of fields:
     enough for round-trip through layer_from_dl4j and real-DL4J loading)."""
     t = type(layer).__name__
     base = {"layerName": layer.name}
+    if updater is not None:
+        iu = _updater_to_dl4j(updater)
+        if iu is not None:
+            base["iUpdater"] = iu
     act = getattr(layer, "activation", None)
     if act:
         base["activationFn"] = _activation_to_dl4j(act)
@@ -766,7 +995,8 @@ def mlc_to_dl4j_json(conf) -> dict:
         "pretrain": conf.pretrain,
         "tbpttFwdLength": conf.tbptt_fwd_length,
         "tbpttBackLength": conf.tbptt_back_length,
-        "confs": [{"seed": conf.seed, "layer": _layer_to_dl4j(l)}
+        "confs": [{"seed": conf.seed,
+                   "layer": _layer_to_dl4j(l, updater=conf.updater)}
                   for l in conf.layers],
     }
     if conf.input_type is not None:

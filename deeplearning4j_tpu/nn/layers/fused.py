@@ -35,6 +35,7 @@ outside the custom_vjp lets autodiff carry the BN stats backward chain
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -48,7 +49,7 @@ from deeplearning4j_tpu.nn.activations import get as _get_act
 #: rows per grid step; full C (contraction) and K (output channels) stay
 #: resident — bottleneck shapes are C<=512, K<=2048, so W + a [bm,K] fp32
 #: tile fit VMEM comfortably
-DEFAULT_BLOCK_M = 256
+DEFAULT_BLOCK_M = int(os.environ.get("DL4JTPU_FUSED_BM", "256"))
 
 _SUPPORTED_ACTS = ("identity", "relu")
 
@@ -275,8 +276,13 @@ def bn_act_conv1x1(
     bias = jnp.zeros((O,), acc_t) if b is None else b.astype(acc_t)
 
     if use_pallas is None:
-        use_pallas = (jax.default_backend() == "tpu"
-                      and fused_conv1x1_supported(I, O, act))
+        # DL4JTPU_FUSED_PALLAS=0 pins the XLA dot_general formulation even
+        # on TPU (perf A/B of kernel vs compiler for the same fused plan)
+        if os.environ.get("DL4JTPU_FUSED_PALLAS") == "0":
+            use_pallas = False
+        else:
+            use_pallas = (jax.default_backend() == "tpu"
+                          and fused_conv1x1_supported(I, O, act))
 
     if ch_axis == 3 or x.ndim == 2:
         shape = x.shape

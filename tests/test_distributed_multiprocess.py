@@ -31,7 +31,7 @@ def _free_port() -> int:
     return port
 
 
-def _spawn(coord, nproc, pid, out, tmp):
+def _spawn(coord, nproc, pid, out):
     repo_root = os.path.dirname(os.path.dirname(WORKER))
     env = dict(os.environ)
     # the worker forces its own platform/device-count; scrub pytest-level
@@ -48,7 +48,7 @@ def _spawn(coord, nproc, pid, out, tmp):
 def test_two_process_allreduce_equals_single_process(tmp_path):
     coord = f"127.0.0.1:{_free_port()}"
     outs = [str(tmp_path / f"w{i}.npz") for i in range(2)]
-    procs = [_spawn(coord, 2, i, outs[i], tmp_path) for i in range(2)]
+    procs = [_spawn(coord, 2, i, outs[i]) for i in range(2)]
     logs = []
     for p in procs:
         try:
