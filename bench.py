@@ -16,8 +16,10 @@ Failure modes are still one JSON line, distinguished by "error":
   - "bench-crash": the benchmark code itself raised. value is null.
 Exit code 0 only for a real measurement.
 
-Env knobs: BENCH_BATCH/IMAGE/WARMUP/STEPS shapes; BENCH_FUSE=0 disables the
-fused bn→relu→1×1-conv bottleneck plan (A/B); BENCH_ALLOW_CPU=1 permits
+Env knobs: BENCH_BATCH/IMAGE/WARMUP/STEPS shapes; BENCH_FUSE=1 enables the
+fused bn→relu→1×1-conv bottleneck plan (off by default: measured SLOWER
+than XLA's own fusion of the unfused graph — see PERF.md round 3);
+BENCH_ALLOW_CPU=1 permits
 running on a CPU backend (smoke tests with tiny shapes only);
 BENCH_PLATFORM switches the jax platform via jax.config;
 BENCH_INIT_TIMEOUT backend-init watchdog seconds (default 120);
@@ -121,7 +123,7 @@ def main():
         model = ResNet50(num_classes=CLASSES, height=IMAGE, width=IMAGE,
                          updater=Nesterovs(0.1, momentum=0.9),
                          data_format=os.environ.get("BENCH_FORMAT", "NHWC"),
-                         fuse=os.environ.get("BENCH_FUSE", "1") != "0")
+                         fuse=os.environ.get("BENCH_FUSE", "0") == "1")
         net = model.init()
         net.conf.dtype = "bfloat16"  # MXU path, fp32 master params + accum
 

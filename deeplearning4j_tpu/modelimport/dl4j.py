@@ -237,9 +237,10 @@ def _updater_from_dl4j(obj: Any):
         return U.RmsProp(lr, rms_decay=float(f.get("rmsDecay", 0.95)),
                          epsilon=float(f.get("epsilon", 1e-8)))
     if name == "adagrad":
-        return U.AdaGrad(lr)
+        return U.AdaGrad(lr, epsilon=float(f.get("epsilon", 1e-6)))
     if name == "adadelta":
-        return U.AdaDelta(rho=float(f.get("rho", 0.95)))
+        return U.AdaDelta(rho=float(f.get("rho", 0.95)),
+                          epsilon=float(f.get("epsilon", 1e-6)))
     if name == "noop":
         return U.NoOp()
     return U.Sgd(lr)
@@ -780,7 +781,7 @@ def updater_state_to_flat(conf, updater_state) -> Optional[np.ndarray]:
     flat updater view (block-interleaved state tensors)."""
     updater = conf.updater
     keys = _UPDATER_STATE_KEYS.get(type(updater).__name__, None)
-    if not keys or updater_state is None:
+    if not keys or not updater_state:
         return None
     fulls = [params_to_flat(conf, updater_state[key], {}) for key in keys]
     layout = _variable_layout(conf)
@@ -905,9 +906,10 @@ def _updater_to_dl4j(u) -> Optional[dict]:
         return {"RmsProp": {**lr, "rmsDecay": float(u.rms_decay),
                             "epsilon": float(u.epsilon)}}
     if t == "AdaGrad":
-        return {"AdaGrad": lr}
+        return {"AdaGrad": {**lr, "epsilon": float(u.epsilon)}}
     if t == "AdaDelta":
-        return {"AdaDelta": {"rho": float(u.rho)}}
+        return {"AdaDelta": {"rho": float(u.rho),
+                             "epsilon": float(u.epsilon)}}
     if t == "NoOp":
         return {"NoOp": {}}
     return None

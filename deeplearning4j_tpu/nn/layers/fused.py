@@ -253,19 +253,24 @@ def bn_act_conv1x1(
     axes = tuple(i for i in range(x.ndim) if i != ch_axis)
     # accumulate in >= fp32 like batch_norm() (fp64 under x64 inputs)
     acc_t = jnp.promote_types(x.dtype, jnp.float32)
-    gamma32 = gamma.astype(acc_t)
-    beta32 = beta.astype(acc_t)
+    # match the unfused plan's precision chain exactly (BatchNormalization
+    # .apply casts params AND running stats through x.dtype before use /
+    # decay — under bf16 the persistent running stats must quantize
+    # identically or the two execution plans train diverging state)
+    gamma32 = gamma.astype(x.dtype).astype(acc_t)
+    beta32 = beta.astype(x.dtype).astype(acc_t)
+    rm_q = running_mean.astype(x.dtype)
+    rv_q = running_var.astype(x.dtype)
     if train:
         xf = x.astype(acc_t)
         mean = jnp.mean(xf, axis=axes)
         var = jnp.maximum(jnp.mean(xf * xf, axis=axes) - mean * mean, 0.0)
-        new_mean = (decay * running_mean.astype(acc_t)
-                    + (1.0 - decay) * mean)
-        new_var = (decay * running_var.astype(acc_t)
-                   + (1.0 - decay) * var)
+        # same expression as batch_norm() given x.dtype running stats:
+        # decay*old rounds in x.dtype BEFORE promoting into the fp32 sum
+        new_mean = decay * rm_q + (1.0 - decay) * mean
+        new_var = decay * rv_q + (1.0 - decay) * var
     else:
-        mean = running_mean.astype(acc_t)
-        var = running_var.astype(acc_t)
+        mean, var = rm_q.astype(acc_t), rv_q.astype(acc_t)
         new_mean, new_var = running_mean, running_var
     inv = lax.rsqrt(var + eps)
     sc = gamma32 * inv

@@ -66,7 +66,12 @@ class ZooModel:
     def _maybe_fuse(self, net):
         """Apply the model's fuse kwarg to a freshly built/restored net
         (graphs only — restore paths must honor it too)."""
-        if self.kwargs.get("fuse", False) and hasattr(net, "set_fusion"):
+        if self.kwargs.get("fuse", False):
+            if not hasattr(net, "set_fusion"):
+                raise ValueError(
+                    f"{type(self).__name__}: fuse=True needs a "
+                    "ComputationGraph model (restored checkpoint is a "
+                    f"{type(net).__name__})")
             net.set_fusion(True)
         return net
 

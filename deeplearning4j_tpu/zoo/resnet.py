@@ -23,10 +23,14 @@ from deeplearning4j_tpu.zoo.base import ZooModel, register_model
 class ResNet50(ZooModel):
     def __init__(self, num_classes: int = 1000, seed: int = 12345,
                  height: int = 224, width: int = 224, channels: int = 3, **kw):
-        # fused bn→relu→1×1-conv execution for the bottleneck chains (the
-        # profile-driven HBM win, nn/layers/fused.py) — equivalence-pinned
-        # by tests/test_fused.py; pass fuse=False for the unfused plan
-        kw.setdefault("fuse", True)
+        # fused bn→relu→1×1-conv execution for the bottleneck chains
+        # (nn/layers/fused.py) is OPT-IN: on a real v5e the fused plan
+        # measured ~2.0-2.1k img/s vs ~2.6k unfused (B=128, bf16) — XLA's
+        # own fusion of the unfused graph beats the hand prologue/kernel,
+        # whose pallas_call boundary blocks cross-op fusion (PERF.md r3).
+        # Equivalence stays pinned by tests/test_fused.py; pass fuse=True
+        # to enable.
+        kw.setdefault("fuse", False)
         super().__init__(num_classes, seed, **kw)
         self.height, self.width, self.channels = height, width, channels
 

@@ -569,3 +569,54 @@ class TestUpdaterState:
                 np.testing.assert_allclose(np.asarray(back["v"][k][pk]),
                                            np.asarray(pv), atol=1e-6,
                                            err_msg=f"{k}/{pk}")
+
+    def test_variable_layout_agrees_with_params_codec(self):
+        """Drift guard for the three hand-maintained copies of the flat
+        view layout: perturb each variable ONE at a time through
+        params_to_flat and assert the changed flat positions are exactly
+        the [offset, offset+size) window _variable_layout declares for
+        it (catches any reordering/size divergence even when the total
+        length stays equal)."""
+        from deeplearning4j_tpu.nn.conf.layers import (
+            BatchNormalization, GravesBidirectionalLSTM)
+        import jax.numpy as jnp
+        conv_conf = (NeuralNetConfiguration.Builder()
+                     .seed(3).list()
+                     .layer(ConvolutionLayer(n_out=3, kernel=[2, 2]))
+                     .layer(BatchNormalization())
+                     .layer(DenseLayer(n_out=4, activation="tanh"))
+                     .layer(OutputLayer(n_out=2, loss="mse",
+                                        activation="identity"))
+                     .set_input_type(InputType.convolutional(2, 5, 5))
+                     .build())
+        rnn_conf = (NeuralNetConfiguration.Builder()
+                    .seed(3).list()
+                    .layer(GravesLSTM(n_out=3))
+                    .layer(GravesBidirectionalLSTM(n_out=2))
+                    .layer(RnnOutputLayer(n_out=2, loss="mse",
+                                          activation="identity"))
+                    .set_input_type(InputType.recurrent(4, 6))
+                    .build())
+        for conf in (conv_conf, rnn_conf):
+            net = MultiLayerNetwork(conf).init()
+            base = d4.params_to_flat(conf, net.params, net.state)
+            layout = {(k, v): (off, size)
+                      for (k, v, off, size, _) in d4._variable_layout(conf)}
+            for lk, lp in net.params.items():
+                for pk, pv in lp.items():
+                    bumped = {k: dict(v) for k, v in net.params.items()}
+                    bumped[lk][pk] = jnp.asarray(pv) + 1.0
+                    flat2 = d4.params_to_flat(conf, bumped, net.state)
+                    changed = np.nonzero(flat2 != base)[0]
+                    # peepholes are stored as extra RW columns (one view
+                    # variable in DL4J), so P* shares RW*'s window
+                    win = {"P": "RW", "PF": "RWF", "PB": "RWB"}.get(pk, pk)
+                    off, size = layout[(lk, win)]
+                    assert changed.size == np.asarray(pv).size, (lk, pk)
+                    assert changed.min() >= off and \
+                        changed.max() < off + size, \
+                        (lk, pk, off, size, changed.min(), changed.max())
+                    if win == pk and pk not in ("RW", "RWF", "RWB"):
+                        # plain variables must span their window exactly
+                        assert changed.min() == off and \
+                            changed.max() == off + size - 1, (lk, pk)

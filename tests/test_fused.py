@@ -133,7 +133,8 @@ class TestFusionPlan:
 
     def test_resnet50_fuses_all_bottleneck_c_convs(self):
         from deeplearning4j_tpu.zoo import ResNet50
-        net = ResNet50(num_classes=10, height=64, width=64).init()
+        net = ResNet50(num_classes=10, height=64, width=64,
+                       fuse=True).init()
         plan, skip = net._fusion()
         # 16 bottleneck blocks, each with exactly the b_bn→b_act→c_conv
         # chain eligible (a feeds a 3×3, skip/c feed adds)
@@ -174,6 +175,52 @@ class TestFusedEquivalence:
                     np.asarray(b.state[name][k]), atol=1e-5,
                     err_msg=f"{name}.{k}")
 
+    def test_bf16_running_stats_quantize_like_unfused(self):
+        """Under bfloat16 the fused op must update running stats through
+        the SAME precision chain as the unfused BatchNormalization (which
+        quantizes the fp32 running mean/var through x.dtype before the
+        decay update): on one identical bf16 input the new stats are
+        bit-identical — a fused plan that kept the old stats at fp32
+        would drift systematically from the unfused plan every step."""
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.layers.fused import bn_act_conv1x1
+        from deeplearning4j_tpu.nn.layers.normalization import batch_norm
+        x = jnp.asarray(RNG.standard_normal((2, 4, 8, 8)), jnp.bfloat16)
+        gamma = jnp.asarray(RNG.standard_normal(4) * 0.1 + 1, jnp.float32)
+        beta = jnp.asarray(RNG.standard_normal(4) * 0.1, jnp.float32)
+        rm = jnp.asarray(RNG.standard_normal(4) * 0.01, jnp.float32)
+        rv = jnp.asarray(RNG.standard_normal(4) * 0.01 + 1, jnp.float32)
+        w = jnp.asarray(RNG.standard_normal((3, 4, 1, 1)), jnp.bfloat16)
+        _, fm, fv = bn_act_conv1x1(x, gamma, beta, rm, rv, w, None,
+                                   train=True)
+        _, um, uv = batch_norm(x, gamma.astype(x.dtype),
+                               beta.astype(x.dtype), rm.astype(x.dtype),
+                               rv.astype(x.dtype), True)
+        np.testing.assert_array_equal(np.asarray(fm),
+                                      np.asarray(um, np.float32))
+        np.testing.assert_array_equal(np.asarray(fv),
+                                      np.asarray(uv, np.float32))
+
+    def test_bf16_training_tracks_unfused(self):
+        """Whole-graph bf16 training: plans agree to bf16 resolution (the
+        conv itself legitimately rounds differently between plans, so
+        stats diverge by reassociation ULPs, not by systematic bias)."""
+        x, y = _data()
+        a = ComputationGraph(_bottleneck_graph())
+        b = ComputationGraph(_bottleneck_graph())
+        a.conf.dtype = b.conf.dtype = "bfloat16"
+        a.init()
+        b.init().set_fusion(True)
+        for _ in range(3):
+            a.fit(DataSet(x, y))
+            b.fit(DataSet(x, y))
+        for name in ("bn1", "bn2"):
+            for k in ("mean", "var"):
+                np.testing.assert_allclose(
+                    np.asarray(a.state[name][k]),
+                    np.asarray(b.state[name][k]), rtol=8e-3, atol=1e-5,
+                    err_msg=f"{name}.{k}")
+
     def test_eval_mode_uses_running_stats(self):
         x, y = _data()
         a = ComputationGraph(_bottleneck_graph()).init()
@@ -197,7 +244,8 @@ class TestFusedEquivalence:
         y[:, 0] = 1.0
         a = ResNet50(num_classes=10, height=64, width=64, seed=1,
                      fuse=False).init()
-        b = ResNet50(num_classes=10, height=64, width=64, seed=1).init()
+        b = ResNet50(num_classes=10, height=64, width=64, seed=1,
+                     fuse=True).init()
         plan, _ = b._fusion()
         assert len(plan) == 16
         np.testing.assert_allclose(np.asarray(a.output(x)),
