@@ -59,6 +59,39 @@ def reorder_stream_state(net, indices) -> None:
             for kk, vv in s.items()}
 
 
+#: (mesh, axis) sharding the streaming KV caches over their slot axis, or
+#: None (single-device caches). Module-level like use_cnn_data_format —
+#: set through MultiLayerNetwork/ComputationGraph.set_stream_cache_sharding,
+#: which also invalidates the nets' jit caches.
+_STREAM_CACHE_SHARDING: Optional[Tuple[Any, str]] = None
+
+
+def set_stream_cache_sharding(mesh, axis: str = "data") -> None:
+    """Shard streaming attention KV caches over the sequence (slot) axis
+    of `mesh` (None disables).
+
+    With this set, the carried kv_k/kv_v ([N,Hkv,L,D]) and kv_mask
+    ([N,L]) get a sharding constraint partitioning L across the mesh —
+    per-device cache memory is O(L/n). XLA partitions the incremental
+    cache writes and the cache attention accordingly, inserting the
+    cross-device combine for the softmax — the jit-native form of
+    sequence-parallel streaming decode (sample_stream / rnn_time_step
+    work unchanged; SURVEY §5 long-context)."""
+    global _STREAM_CACHE_SHARDING
+    _STREAM_CACHE_SHARDING = None if mesh is None else (mesh, axis)
+
+
+def _shard_cache(x, n_lead: int):
+    """Sharding-constrain a streaming-cache array whose slot axis sits at
+    position n_lead (kc/vc: 2, kv_mask: 1). No-op when unconfigured."""
+    if _STREAM_CACHE_SHARDING is None or x is None:
+        return x
+    mesh, axis = _STREAM_CACHE_SHARDING
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    spec = P(*([None] * n_lead), axis, *([None] * (x.ndim - n_lead - 1)))
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
 def stream_capacity(layers):
     """Smallest streaming-position capacity over `layers` (None if
     unbounded): max_length always caps; cache_length caps only for
@@ -980,9 +1013,11 @@ class SelfAttentionLayer(FeedForwardLayerConf):
                                           (z, z, pos, z))
         vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
                                           (z, z, pos, z))
+        kc, vc = _shard_cache(kc, 2), _shard_cache(vc, 2)
         km = self._stream_mask_update(
             state, mask, n, t, L, fresh=state.get("kv_k") is None,
             write=lambda km, m: jax.lax.dynamic_update_slice(km, m, (z, pos)))
+        km = _shard_cache(km, 1)
         # grouped attend against the UN-expanded cache: q reshaped to
         # [N, Hkv, reps, T, D] — materializing a repeated cache would
         # forfeit GQA's decode bandwidth win
@@ -1064,10 +1099,12 @@ class SelfAttentionLayer(FeedForwardLayerConf):
         slots = q_pos % L
         kc = kc.at[:, :, slots, :].set(k.astype(kc.dtype))
         vc = vc.at[:, :, slots, :].set(v.astype(vc.dtype))
+        kc, vc = _shard_cache(kc, 2), _shard_cache(vc, 2)
         kv_abs = kv_abs.at[slots].set(q_pos.astype(kv_abs.dtype))
         km = self._stream_mask_update(
             state, mask, n, t, L, fresh=fresh,
             write=lambda km, m: km.at[:, slots].set(m))
+        km = _shard_cache(km, 1)
         reps = self.n_heads // hkv
         qg = q.astype(jnp.float32).reshape(n, hkv, reps, t, d)
         scale = 1.0 / np.sqrt(d)

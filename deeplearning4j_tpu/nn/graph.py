@@ -482,7 +482,10 @@ class ComputationGraph:
         `masks` maps network-input name -> this chunk's [N, T] key mask
         for padded variable-length batches; attention vertices carry it
         in the KV cache so padded positions stay masked on later steps."""
-        key = ("rnn_step",)
+        # stream-cache sharding config keys the cache: flipping the
+        # process-wide setting retraces for every net on next use
+        from deeplearning4j_tpu.nn.conf import layers as _L
+        key = ("rnn_step", _L._STREAM_CACHE_SHARDING)
         if key not in self._jit_cache:
             def fwd(params, state, ins, rng, fmasks):
                 acts, new_state, _ = self._forward(params, state, ins,
@@ -559,6 +562,22 @@ class ComputationGraph:
                     "cache_length/max_length")
             updates[name] = new_pos
         return {**pos, **updates}
+
+
+    def set_stream_cache_sharding(self, mesh, axis: str = "data"):
+        """Shard streaming attention KV caches over the sequence axis of
+        `mesh` (None reverts to single-device caches). PROCESS-WIDE, like
+        use_cnn_data_format: the setting applies to every net, and since
+        it is part of each streaming step's jit key, any net retraces
+        with the new layout on its next streaming call — no stale
+        compiled steps. Streaming decode (rnn_time_step / sample_stream /
+        beam_search) then runs sequence-parallel: per-device cache memory
+        is O(cache_length / n_devices) and XLA inserts the cross-device
+        softmax combine."""
+        from deeplearning4j_tpu.nn.conf.layers import (
+            set_stream_cache_sharding)
+        set_stream_cache_sharding(mesh, axis)
+        return self
 
     def rnn_clear_previous_state(self):
         """ref: ComputationGraph.rnnClearPreviousState."""

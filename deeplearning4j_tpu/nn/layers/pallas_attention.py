@@ -45,23 +45,28 @@ DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 
 
-def _causal_needed(i, j, bq, bk, window=None):
+def _causal_needed(i, j, bq, bk, window=None, q_offset=0):
     """Is KV block j visible to any query in Q block i? (block-skip test:
-    causal upper bound, plus the sliding-window lower bound when set)"""
-    needed = i * bq + bq - 1 >= j * bk
+    causal upper bound, plus the sliding-window lower bound when set).
+    `q_offset` (static) shifts query positions — ring attention runs past
+    KV chunks as banded attention with q_offset = chunk distance."""
+    q0 = q_offset + i * bq
+    needed = q0 + bq - 1 >= j * bk
     if window is not None:
         # some key in the block is within (q - window, q] for some query
         needed = jnp.logical_and(needed,
-                                 j * bk + bk - 1 > i * bq - window)
+                                 j * bk + bk - 1 > q0 - window)
     return needed
 
 
-def _block_mask(i, j, bq, bk, causal: bool, kmask_row, window=None):
+def _block_mask(i, j, bq, bk, causal: bool, kmask_row, window=None,
+                q_offset=0):
     """[bq, bk] validity mask for one (Q block, KV block) pair.
     kmask_row: [1, bk]."""
     valid = jnp.broadcast_to(kmask_row.astype(bool), (bq, bk))
     if causal:
-        q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        q_pos = (q_offset + i * bq
+                 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
         k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         valid = valid & (q_pos >= k_pos)
         if window is not None:
@@ -70,7 +75,7 @@ def _block_mask(i, j, bq, bk, causal: bool, kmask_row, window=None):
 
 
 def _dispatch(i, j, fast_fn, masked_fn, *, causal, bq, bk, nk,
-              first_pad, user_mask, window=None):
+              first_pad, user_mask, window=None, q_offset=0):
     """Run the fast (no mask VPU ops) or masked block body.
 
     Masking is needed only for diagonal-straddling causal blocks, blocks
@@ -81,18 +86,20 @@ def _dispatch(i, j, fast_fn, masked_fn, *, causal, bq, bk, nk,
     are skipped entirely — with `window` set, cost is O(T*W)."""
     if user_mask:
         if causal:
-            pl.when(_causal_needed(i, j, bq, bk, window))(masked_fn)
+            pl.when(_causal_needed(i, j, bq, bk, window,
+                                   q_offset))(masked_fn)
         else:
             masked_fn()
         return
     tail = (j >= first_pad) if first_pad is not None else None
     if causal:
-        needed = _causal_needed(i, j, bq, bk, window)
-        interior = i * bq >= j * bk + bk - 1   # no in-block causal mask
+        needed = _causal_needed(i, j, bq, bk, window, q_offset)
+        q0 = q_offset + i * bq
+        interior = q0 >= j * bk + bk - 1       # no in-block causal mask
         if window is not None:
             # every pair also inside the window: max(q) - min(k) < W
             interior = jnp.logical_and(
-                interior, i * bq + bq - 1 - j * bk < window)
+                interior, q0 + bq - 1 - j * bk < window)
         fast = jnp.logical_and(needed, interior)
         if tail is not None:
             fast = jnp.logical_and(fast, jnp.logical_not(tail))
@@ -107,7 +114,7 @@ def _dispatch(i, j, fast_fn, masked_fn, *, causal, bq, bk, nk,
 
 def _fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref,
                 acc_scr, m_scr, l_scr, *, scale, causal, bq, bk, nk,
-                first_pad, user_mask, window=None):
+                first_pad, user_mask, window=None, q_offset=0):
     i, j = pl.program_id(2), pl.program_id(3)
 
     @pl.when(j == 0)
@@ -121,7 +128,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref,
             q_ref[0, 0], k_ref[0, 0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale        # [bq, bk]
         if masked:
-            valid = _block_mask(i, j, bq, bk, causal, km_ref[0], window)
+            valid = _block_mask(i, j, bq, bk, causal, km_ref[0], window,
+                                q_offset)
             s = jnp.where(valid, s, NEG_INF)
         m_prev = m_scr[:][:, :1]                               # [bq, 1]
         l_prev = l_scr[:][:, :1]
@@ -142,7 +150,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref,
 
     _dispatch(i, j, lambda: _compute(False), lambda: _compute(True),
               causal=causal, bq=bq, bk=bk, nk=nk, first_pad=first_pad,
-              user_mask=user_mask, window=window)
+              user_mask=user_mask, window=window, q_offset=q_offset)
 
     @pl.when(j == nk - 1)
     def _finish():
@@ -154,7 +162,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, d_ref,
                    dq_ref, dq_scr, *, scale, causal, bq, bk, nk,
-                   first_pad, user_mask, window=None):
+                   first_pad, user_mask, window=None, q_offset=0):
     i, j = pl.program_id(2), pl.program_id(3)
 
     @pl.when(j == 0)
@@ -168,7 +176,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, d_ref,
         if masked:
             # mask BEFORE exp (as forward does): a masked raw score above
             # the row lse would overflow exp to inf and 0*inf = NaN
-            valid = _block_mask(i, j, bq, bk, causal, km_ref[0], window)
+            valid = _block_mask(i, j, bq, bk, causal, km_ref[0], window,
+                                q_offset)
             s = jnp.where(valid, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0, 0])
         if masked:
@@ -183,7 +192,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, d_ref,
 
     _dispatch(i, j, lambda: _compute(False), lambda: _compute(True),
               causal=causal, bq=bq, bk=bk, nk=nk, first_pad=first_pad,
-              user_mask=user_mask, window=window)
+              user_mask=user_mask, window=window, q_offset=q_offset)
 
     @pl.when(j == nk - 1)
     def _finish():
@@ -193,7 +202,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, d_ref,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, d_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr,
                     *, scale, causal, bq, bk, nq, nk,
-                    first_pad, user_mask, window=None):
+                    first_pad, user_mask, window=None, q_offset=0):
     j, i = pl.program_id(2), pl.program_id(3)   # Q innermost here
 
     @pl.when(i == 0)
@@ -206,7 +215,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, d_ref,
             q_ref[0, 0], k_ref[0, 0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale        # [bq, bk]
         if masked:
-            valid = _block_mask(i, j, bq, bk, causal, km_ref[0], window)
+            valid = _block_mask(i, j, bq, bk, causal, km_ref[0], window,
+                                q_offset)
             s = jnp.where(valid, s, NEG_INF)   # see _bwd_dq_kernel note
         p = jnp.exp(s - lse_ref[0, 0])
         if masked:
@@ -225,7 +235,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, d_ref,
 
     _dispatch(i, j, lambda: _compute(False), lambda: _compute(True),
               causal=causal, bq=bq, bk=bk, nk=nk, first_pad=first_pad,
-              user_mask=user_mask, window=window)
+              user_mask=user_mask, window=window, q_offset=q_offset)
 
     @pl.when(i == nq - 1)
     def _finish():
@@ -266,7 +276,8 @@ def _pad_t(x, bs):
 
 
 def _run_bwd_kernels(q, k, v, key_mask, do, lse, d_eff, *, causal, bq, bk,
-                     first_pad, user_mask, interpret, window=None):
+                     first_pad, user_mask, interpret, window=None,
+                     q_offset=0):
     """The dq and dk/dv pallas calls shared by both VJPs. `d_eff` sits in
     the delta slot: plain backward passes delta = rowsum(do*o); the
     lse-differentiable variant passes delta - dlse. Query and key lengths
@@ -279,7 +290,8 @@ def _run_bwd_kernels(q, k, v, key_mask, do, lse, d_eff, *, causal, bq, bk,
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nk=nk, first_pad=first_pad,
-                          user_mask=user_mask, window=window),
+                          user_mask=user_mask, window=window,
+                          q_offset=q_offset),
         grid=(B, H, nq, nk),
         in_specs=[_qkv_spec(bq, D, 2), _qkv_spec(bk, D, 3),
                   _qkv_spec(bk, D, 3), _km_spec(bk, 3),
@@ -293,7 +305,8 @@ def _run_bwd_kernels(q, k, v, key_mask, do, lse, d_eff, *, causal, bq, bk,
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nq=nq, nk=nk, first_pad=first_pad,
-                          user_mask=user_mask, window=window),
+                          user_mask=user_mask, window=window,
+                          q_offset=q_offset),
         # KV block is the carried axis; Q innermost
         grid=(B, H, nk, nq),
         in_specs=[
@@ -319,13 +332,14 @@ def _run_bwd_kernels(q, k, v, key_mask, do, lse, d_eff, *, causal, bq, bk,
 
 
 def _flash_fwd(q, k, v, key_mask, causal, bq, bk, first_pad, user_mask,
-               interpret, window=None):
+               interpret, window=None, q_offset=0):
     B, H, T, D = q.shape
     scale = float(1.0 / np.sqrt(D))
     nq, nk = T // bq, k.shape[2] // bk
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                bq=bq, bk=bk, nk=nk, first_pad=first_pad,
-                               user_mask=user_mask, window=window)
+                               user_mask=user_mask, window=window,
+                               q_offset=q_offset)
     o, lse = pl.pallas_call(
         kernel,
         grid=(B, H, nq, nk),
@@ -352,24 +366,25 @@ def _flash_fwd(q, k, v, key_mask, causal, bq, bk, first_pad, user_mask,
 
 
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+                   nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
 def _flash_lse(q, k, v, key_mask, causal, bq, bk, first_pad, user_mask,
-               interpret, window):
+               interpret, window, q_offset):
     (o, lse), _ = _flash_lse_fwd(q, k, v, key_mask, causal, bq, bk,
-                                 first_pad, user_mask, interpret, window)
+                                 first_pad, user_mask, interpret, window,
+                                 q_offset)
     return o, lse
 
 
 def _flash_lse_fwd(q, k, v, key_mask, causal, bq, bk, first_pad, user_mask,
-                   interpret, window):
+                   interpret, window, q_offset):
     o, res = _flash_fwd(q, k, v, key_mask, causal, bq, bk, first_pad,
-                        user_mask, interpret, window)
+                        user_mask, interpret, window, q_offset)
     lse = res[-1]
     return (o, lse), res
 
 
 def _flash_lse_bwd(causal, bq, bk, first_pad, user_mask, interpret, window,
-                   res, cotangents):
+                   q_offset, res, cotangents):
     do, dlse = cotangents
     q, k, v, key_mask, o, lse = res
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
@@ -378,7 +393,8 @@ def _flash_lse_bwd(causal, bq, bk, first_pad, user_mask, interpret, window,
     dq, dk, dv = _run_bwd_kernels(q, k, v, key_mask, do, lse, d_eff,
                                   causal=causal, bq=bq, bk=bk,
                                   first_pad=first_pad, user_mask=user_mask,
-                                  interpret=interpret, window=window)
+                                  interpret=interpret, window=window,
+                                  q_offset=q_offset)
     return dq, dk, dv, jnp.zeros_like(key_mask)
 
 
@@ -388,25 +404,35 @@ _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 def flash_attention_lse(q, k, v, causal: bool = False, key_mask=None,
                         block_q: int = DEFAULT_BLOCK_Q,
                         block_k: int = DEFAULT_BLOCK_K,
-                        interpret: bool = False):
+                        interpret: bool = False,
+                        window: Optional[int] = None,
+                        q_offset: int = 0):
     """Like flash_attention but also returns the per-row logsumexp
     [B,H,Tq] (fp32) — differentiable through both outputs, for combining
     attention over KV chunks (ring attention: merge (o_i, lse_i) pairs
-    with the standard logaddexp rule)."""
+    with the standard logaddexp rule).
+
+    `q_offset` (static int) shifts query positions for the causal/window
+    masks: windowed ring attention runs a PAST chunk as banded attention
+    with q_offset = (global query start) - (global key start); blocks
+    outside the band are skipped, so a mostly-out-of-window chunk costs
+    almost nothing."""
     q, k, v, km, bq, bk, first_pad, user_mask, Tq = _prep(
-        q, k, v, key_mask, causal, block_q, block_k)
+        q, k, v, key_mask, causal, block_q, block_k,
+        allow_unaligned_causal=q_offset != 0)
     o, lse = _flash_lse(q, k, v, km, causal, bq, bk, first_pad, user_mask,
-                        interpret, None)
+                        interpret, window, int(q_offset))
     return o[:, :, :Tq, :], lse[:, :, :Tq, 0]
 
 
-def _prep(q, k, v, key_mask, causal, block_q, block_k):
+def _prep(q, k, v, key_mask, causal, block_q, block_k,
+          allow_unaligned_causal=False):
     """Pad q to a block_q multiple and k/v to a block_k multiple
     (independently — Tq need not equal Tk for non-causal / chunked use),
     build the padded-key mask, and pick tile-aligned block sizes."""
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
-    if causal and Tq != Tk:
+    if causal and not allow_unaligned_causal and Tq != Tk:
         raise ValueError("causal flash attention needs Tq == Tk "
                          f"(got {Tq} vs {Tk})")
     bq = int(min(block_q, ((Tq + 127) // 128) * 128))
@@ -455,5 +481,5 @@ def flash_attention(q, k, v, causal: bool = False, key_mask=None,
     # single custom_vjp serves both entry points: when the lse output is
     # unused JAX feeds a zeros cotangent, so d_eff = delta - 0 = delta
     out, _ = _flash_lse(q, k, v, km, causal, bq, bk, first_pad, user_mask,
-                        interpret, window)
+                        interpret, window, 0)
     return out[:, :, :Tq, :]

@@ -238,7 +238,12 @@ class MultiLayerNetwork:
 
     def _get_output_fn(self, train: bool, carry_rnn: bool,
                        stream: bool = False):
-        key = ("out", train, carry_rnn, stream)
+        # the process-wide stream-cache sharding config is part of the
+        # key: flipping it retraces the step for EVERY net on next use
+        # (a stale compiled step would silently keep the old layout)
+        from deeplearning4j_tpu.nn.conf import layers as _L
+        key = ("out", train, carry_rnn, stream,
+               _L._STREAM_CACHE_SHARDING if stream else None)
         if key not in self._jit_cache:
             def fwd(params, state, x, rng, fmask):
                 acts, new_state = self._forward(params, state, x, train=train,
@@ -403,6 +408,22 @@ class MultiLayerNetwork:
         self._stream_pos = new_pos
         self.state = new_state
         return out
+
+
+    def set_stream_cache_sharding(self, mesh, axis: str = "data"):
+        """Shard streaming attention KV caches over the sequence axis of
+        `mesh` (None reverts to single-device caches). PROCESS-WIDE, like
+        use_cnn_data_format: the setting applies to every net, and since
+        it is part of each streaming step's jit key, any net retraces
+        with the new layout on its next streaming call — no stale
+        compiled steps. Streaming decode (rnn_time_step / sample_stream /
+        beam_search) then runs sequence-parallel: per-device cache memory
+        is O(cache_length / n_devices) and XLA inserts the cross-device
+        softmax combine."""
+        from deeplearning4j_tpu.nn.conf.layers import (
+            set_stream_cache_sharding)
+        set_stream_cache_sharding(mesh, axis)
+        return self
 
     def rnn_clear_previous_state(self):
         self._stream_pos = 0

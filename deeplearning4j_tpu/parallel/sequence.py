@@ -34,6 +34,17 @@ from jax.sharding import Mesh, PartitionSpec as P
 NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() gradients clean
 
 
+
+def _validate_window(window, causal) -> None:
+    """Shared gate for every sliding-window entry point."""
+    if window is None:
+        return
+    if not causal:
+        raise ValueError("window attention requires causal=True")
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+
+
 def reference_attention(q, k, v, causal: bool = False):
     """Plain single-device scaled-dot-product attention, [B,H,T,D] layout.
     The correctness oracle for both parallel paths."""
@@ -77,11 +88,7 @@ def blockwise_attention(q, k, v, causal: bool = False,
     """
     from deeplearning4j_tpu.nn.layers.pallas_attention import (
         flash_attention, flash_attention_supported)
-    if window is not None:
-        if not causal:
-            raise ValueError("window attention requires causal=True")
-        if window < 1:
-            raise ValueError(f"window must be >= 1, got {window}")
+    _validate_window(window, causal)
     if use_pallas is None:
         use_pallas = (jax.default_backend() == "tpu"
                       and flash_attention_supported(q.shape))
@@ -151,14 +158,29 @@ def blockwise_attention(q, k, v, causal: bool = False,
     return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
-def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
+def _ring_steps_needed(n: int, T: int, window: Optional[int]) -> int:
+    """How many ring steps any device can need. Without a window: all n.
+    With a sliding window W, the chunk s hops back starts (s-1)*T+1
+    positions before the oldest query on every device — once that
+    exceeds W-1 no device can see ANY of it, so the loop (and its
+    ppermutes) stops: O(W) work and traffic per device."""
+    if window is None:
+        return n
+    steps = 1
+    while steps < n and (steps - 1) * T + 1 < window:
+        steps += 1
+    return steps
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, n: int,
+                          window: Optional[int] = None):
     """Per-shard ring attention body (runs under shard_map).
 
     q,k,v: [B,H,T_local,D] — this device's sequence shard. K/V blocks
     rotate ring-wise; a streaming softmax (running max m, normalizer l,
     weighted sum o) accumulates exact attention over the full sequence.
-    """
-    n = jax.lax.psum(1, axis_name)
+    The step loop is a Python loop over the STATIC axis size so a sliding
+    window truncates it (and its ppermutes) at _ring_steps_needed."""
     my = jax.lax.axis_index(axis_name)
     scale = jnp.float32(1.0 / np.sqrt(q.shape[-1]))
     B, H, T, D = q.shape
@@ -169,16 +191,18 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
     o0 = jnp.zeros((B, H, T, D), jnp.float32)
     perm = [(i, (i + 1) % n) for i in range(n)]
     q_pos = my * T + jnp.arange(T)                     # global query positions
+    steps = _ring_steps_needed(n, T, window) if causal else n
 
     @jax.checkpoint  # flash-style backward: recompute per-step scores
-    def body(step, carry):
-        k_c, v_c, m, l, o = carry
+    def attend(step, k_c, v_c, m, l, o):
         src = (my - step) % n                          # origin shard of k_c
         s = jnp.einsum("bhqd,bhkd->bhqk", qf,
                        k_c.astype(jnp.float32)) * scale
         if causal:
             k_pos = src * T + jnp.arange(T)
             mask = q_pos[:, None] >= k_pos[None, :]    # [T,T]
+            if window is not None:
+                mask = mask & (q_pos[:, None] - k_pos[None, :] < window)
             s = jnp.where(mask[None, None], s, NEG_INF)
         blk_max = jnp.max(s, axis=-1)                  # [B,H,T]
         m_new = jnp.maximum(m, blk_max)
@@ -187,11 +211,28 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
         l_new = l * corr + jnp.sum(p, axis=-1)
         o_new = o * corr[..., None] + jnp.einsum(
             "bhqk,bhkd->bhqd", p, v_c.astype(jnp.float32))
-        k_r = jax.lax.ppermute(k_c, axis_name, perm)
-        v_r = jax.lax.ppermute(v_c, axis_name, perm)
-        return k_r, v_r, m_new, l_new, o_new
+        return m_new, l_new, o_new
 
-    _, _, m, l, o = jax.lax.fori_loop(0, n, body, (k, v, m0, l0, o0))
+    if steps == n:
+        # full ring: the original rolled loop (one compiled body, not n)
+        def body(step, carry):
+            k_c, v_c, m, l, o = carry
+            m, l, o = attend(step, k_c, v_c, m, l, o)
+            k_r = jax.lax.ppermute(k_c, axis_name, perm)
+            v_r = jax.lax.ppermute(v_c, axis_name, perm)
+            return k_r, v_r, m, l, o
+
+        _, _, m, l, o = jax.lax.fori_loop(0, n, body, (k, v, m0, l0, o0))
+    else:
+        # window-truncated ring: unrolled so the loop (and its
+        # ppermutes) STOPS after `steps` hops — O(W) per device
+        m, l, o = m0, l0, o0
+        k_c, v_c = k, v
+        for step in range(steps):
+            m, l, o = attend(jnp.int32(step), k_c, v_c, m, l, o)
+            if step < steps - 1:
+                k_c = jax.lax.ppermute(k_c, axis_name, perm)
+                v_c = jax.lax.ppermute(v_c, axis_name, perm)
     # fully-masked rows (can't happen for causal with step 0 = own block,
     # but guard anyway) normalize to zero
     out = o / jnp.maximum(l, 1e-30)[..., None]
@@ -199,76 +240,126 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
 
 
 def _ring_attention_local_flash(q, k, v, *, axis_name: str, causal: bool,
-                                interpret: bool):
+                                interpret: bool, n: int,
+                                window: Optional[int] = None):
     """Ring attention with the Pallas flash kernel as the per-chunk
     engine: each ring step computes (o_i, lse_i) for this device's
     queries against the visiting KV chunk and merges with the running
-    accumulator by the logaddexp rule. Ring causality reduces to three
-    whole-chunk cases (origin shard before / at / after this shard), so
-    the kernel only ever sees aligned causal or full attention — no
-    offset plumbing. lax.switch runs exactly one branch per step, so
-    fully-future chunks cost nothing but the ppermute."""
+    accumulator by the logaddexp rule.
+
+    The step loop is a Python loop over the STATIC axis size, so the
+    per-step chunk distance is a compile-time constant: step s attends
+    the chunk s hops back as BANDED attention (causal + window masks with
+    q_offset = s*T — the kernel's block skip then prunes out-of-band
+    blocks), devices whose chunk would wrap (future chunk) take a
+    lax.cond skip, and with a sliding window the loop itself stops at
+    _ring_steps_needed — O(W) compute AND ppermute traffic per device."""
     from deeplearning4j_tpu.nn.layers.pallas_attention import (
         flash_attention_lse)
-    n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     B, H, T, D = q.shape
 
     o0 = jnp.zeros((B, H, T, D), jnp.float32)
     lse0 = jnp.full((B, H, T), NEG_INF, jnp.float32)
     perm = [(i, (i + 1) % n) for i in range(n)]
+    steps = _ring_steps_needed(n, T, window) if causal else n
 
-    def _full(ops):
-        o, lse = flash_attention_lse(q, ops[0], ops[1], causal=False,
-                                     interpret=interpret)
-        return o.astype(jnp.float32), lse
-
-    def _diag(ops):
-        o, lse = flash_attention_lse(q, ops[0], ops[1], causal=True,
-                                     interpret=interpret)
-        return o.astype(jnp.float32), lse
-
-    def _skip(ops):
-        return o0, lse0
-
-    def body(step, carry):
-        k_c, v_c, o, lse = carry
-        src = (my - step) % n                      # origin shard of k_c
-        if causal:
-            branch = jnp.where(src < my, 0, jnp.where(src == my, 1, 2))
-            o_i, lse_i = jax.lax.switch(branch, [_full, _diag, _skip],
-                                        (k_c, v_c))
-        else:
-            o_i, lse_i = _full((k_c, v_c))
+    def merge(o, lse, o_i, lse_i):
         lse_new = jnp.logaddexp(lse, lse_i)
         w_old = jnp.exp(lse - lse_new)[..., None]
         w_new = jnp.exp(lse_i - lse_new)[..., None]
-        o = o * w_old + o_i * w_new
-        k_r = jax.lax.ppermute(k_c, axis_name, perm)
-        v_r = jax.lax.ppermute(v_c, axis_name, perm)
-        return k_r, v_r, o, lse_new
+        return o * w_old + o_i * w_new, lse_new
 
-    _, _, o, lse = jax.lax.fori_loop(0, n, body, (k, v, o0, lse0))
+    if window is None:
+        # full ring: rolled loop with the full/diag/skip trichotomy —
+        # exactly TWO kernel specializations regardless of ring size
+        def _full(ops):
+            o, lse = flash_attention_lse(q, ops[0], ops[1], causal=False,
+                                         interpret=interpret)
+            return o.astype(jnp.float32), lse
+
+        def _diag(ops):
+            o, lse = flash_attention_lse(q, ops[0], ops[1], causal=True,
+                                         interpret=interpret)
+            return o.astype(jnp.float32), lse
+
+        def _skip(ops):
+            return o0, lse0
+
+        def body(step, carry):
+            k_c, v_c, o, lse = carry
+            src = (my - step) % n                  # origin shard of k_c
+            if causal:
+                branch = jnp.where(src < my, 0, jnp.where(src == my, 1, 2))
+                o_i, lse_i = jax.lax.switch(branch, [_full, _diag, _skip],
+                                            (k_c, v_c))
+            else:
+                o_i, lse_i = _full((k_c, v_c))
+            o, lse = merge(o, lse, o_i, lse_i)
+            k_r = jax.lax.ppermute(k_c, axis_name, perm)
+            v_r = jax.lax.ppermute(v_c, axis_name, perm)
+            return k_r, v_r, o, lse
+
+        _, _, o, lse = jax.lax.fori_loop(0, n, body, (k, v, o0, lse0))
+        return o.astype(q.dtype)
+
+    # windowed ring: unrolled over the (window-truncated) static step
+    # count — each step's chunk distance is a compile-time constant, so
+    # step s runs as BANDED attention with q_offset = s*T (the kernel's
+    # block skip prunes out-of-band blocks) and the loop + ppermutes stop
+    # at _ring_steps_needed: O(W) compute AND ring traffic per device
+    o, lse = o0, lse0
+    k_c, v_c = k, v
+    for step in range(steps):
+        if step == 0:
+            o_i, lse_i = flash_attention_lse(q, k_c, v_c, causal=True,
+                                             window=window,
+                                             interpret=interpret)
+            o_i = o_i.astype(jnp.float32)
+        else:
+            def _band(ops, _step=step):
+                oo, ll = flash_attention_lse(
+                    q, ops[0], ops[1], causal=True, window=window,
+                    q_offset=_step * T, interpret=interpret)
+                return oo.astype(jnp.float32), ll
+
+            def _skipw(ops):
+                return o0, lse0
+
+            # devices whose chunk-s-back wraps around see a FUTURE chunk
+            o_i, lse_i = jax.lax.cond(my >= step, _band, _skipw, (k_c, v_c))
+        o, lse = merge(o, lse, o_i, lse_i)
+        if step < steps - 1:
+            k_c = jax.lax.ppermute(k_c, axis_name, perm)
+            v_c = jax.lax.ppermute(v_c, axis_name, perm)
     return o.astype(q.dtype)
 
 
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "data",
                    causal: bool = False,
                    use_flash: Optional[bool] = None,
-                   interpret: bool = False):
+                   interpret: bool = False,
+                   window: Optional[int] = None):
     """Exact attention over a sequence sharded on ``mesh[axis]``.
 
     q/k/v: [B,H,T,D] global arrays (T divisible by the axis size). Returns
     [B,H,T,D]. Under jit the ppermutes ride ICI neighbor links — the
     canonical ring schedule.
 
+    `window=W` (causal only) gives Mistral-style sliding-window local
+    attention under sequence parallelism: ring chunks fully outside the
+    window are never visited (the step loop stops once the chunk distance
+    exceeds W), making cost — compute and ring traffic — O(W) per device
+    instead of O(T).
+
     On TPU with supported shapes the per-chunk engine is the Pallas flash
     kernel (_ring_attention_local_flash: per-chunk (o, lse) merged by
-    logaddexp); otherwise the lax online-softmax body. `use_flash`
-    None=auto, and `interpret=True` runs the kernel in interpreter mode
-    (tests on CPU)."""
+    logaddexp, with banded q_offset chunks under a window); otherwise the
+    lax online-softmax body. `use_flash` None=auto, and `interpret=True`
+    runs the kernel in interpreter mode (tests on CPU)."""
     from deeplearning4j_tpu.nn.layers.pallas_attention import (
         flash_attention_supported)
+    _validate_window(window, causal)
     size = mesh.shape[axis]
     if use_flash is None:
         local = (q.shape[0], q.shape[1], q.shape[2] // size, q.shape[3])
@@ -278,16 +369,18 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "data",
     if use_flash:
         local_fn = functools.partial(_ring_attention_local_flash,
                                      axis_name=axis, causal=causal,
-                                     interpret=interpret)
+                                     interpret=interpret, n=size,
+                                     window=window)
     else:
         local_fn = functools.partial(_ring_attention_local, axis_name=axis,
-                                     causal=causal)
+                                     causal=causal, n=size, window=window)
     fn = shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
                    out_specs=spec, check_vma=False)
     return fn(q, k, v)
 
 
-def _ulysses_local(q, k, v, *, axis_name: str, causal: bool):
+def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
+                   window: Optional[int] = None):
     """Per-shard Ulysses body: all_to_all seq→head shards, local full
     attention, all_to_all back. q,k,v: [B,H,T_local,D]; H divisible by n."""
     def seq_to_heads(x):
@@ -305,12 +398,15 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool):
     # sharding long sequences), and the Pallas flash kernel on TPU.
     # No fp32 pre-cast: both engines accumulate in fp32 internally, and
     # bf16 inputs keep the MXU rate / halve the gathered-copy traffic.
-    out = blockwise_attention(qh, kh, vh, causal=causal)
+    # A sliding window passes straight through: after the head reshard
+    # each device holds the FULL sequence, so the engine's own block
+    # skipping delivers the O(T·W) cost.
+    out = blockwise_attention(qh, kh, vh, causal=causal, window=window)
     return heads_to_seq(out.astype(q.dtype))
 
 
 def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "data",
-                      causal: bool = False):
+                      causal: bool = False, window: Optional[int] = None):
     """All-to-all (DeepSpeed-Ulysses-style) sequence-parallel attention.
     Requires num_heads % axis_size == 0."""
     n = mesh.shape[axis]
@@ -318,9 +414,11 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "data",
         raise ValueError(
             f"ulysses needs heads ({q.shape[1]}) divisible by mesh axis "
             f"'{axis}' size ({n}); use ring_attention otherwise")
+    _validate_window(window, causal)
     spec = P(None, None, axis, None)
     fn = shard_map(
-        functools.partial(_ulysses_local, axis_name=axis, causal=causal),
+        functools.partial(_ulysses_local, axis_name=axis, causal=causal,
+                          window=window),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)
@@ -336,9 +434,11 @@ class MultiHeadSelfAttention:
     """
 
     def __init__(self, embed_dim: int, num_heads: int,
-                 impl: str = "ring", causal: bool = True):
+                 impl: str = "ring", causal: bool = True,
+                 window: Optional[int] = None):
         if embed_dim % num_heads:
             raise ValueError("embed_dim must divide by num_heads")
+        _validate_window(window, causal)
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.head_dim = embed_dim // num_heads
@@ -346,6 +446,7 @@ class MultiHeadSelfAttention:
             raise ValueError(f"unknown attention impl {impl!r}")
         self.impl = impl
         self.causal = causal
+        self.window = window
 
     def init(self, rng: jax.Array):
         k1, k2, k3, k4 = jax.random.split(rng, 4)
@@ -378,18 +479,24 @@ class MultiHeadSelfAttention:
                     "dim must be one of (64, 128, 256) and T >= 128")
             if jax.default_backend() != "tpu":
                 o = blockwise_attention(q, k, v, causal=self.causal,
-                                        use_pallas=False)  # CPU fallback
+                                        use_pallas=False,  # CPU fallback
+                                        window=self.window)
             else:
-                o = flash_attention(q, k, v, causal=self.causal)
+                o = flash_attention(q, k, v, causal=self.causal,
+                                    window=self.window)
         elif self.impl == "blockwise" or \
                 (mesh is None and self.impl != "local"):
-            o = blockwise_attention(q, k, v, causal=self.causal)
+            o = blockwise_attention(q, k, v, causal=self.causal,
+                                    window=self.window)
         elif self.impl == "local":
+            if self.window is not None:
+                raise ValueError("impl='local' does not support window")
             o = reference_attention(q, k, v, causal=self.causal)
         elif self.impl == "ring":
-            o = ring_attention(q, k, v, mesh, axis=axis, causal=self.causal)
+            o = ring_attention(q, k, v, mesh, axis=axis, causal=self.causal,
+                               window=self.window)
         else:
             o = ulysses_attention(q, k, v, mesh, axis=axis,
-                                  causal=self.causal)
+                                  causal=self.causal, window=self.window)
         o = o.transpose(0, 2, 1, 3).reshape(B, T, E)
         return o @ params["wo"]
