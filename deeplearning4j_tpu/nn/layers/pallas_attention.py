@@ -417,6 +417,11 @@ def flash_attention_lse(q, k, v, causal: bool = False, key_mask=None,
     with q_offset = (global query start) - (global key start); blocks
     outside the band are skipped, so a mostly-out-of-window chunk costs
     almost nothing."""
+    if window is not None and not causal:
+        raise ValueError("window attention requires causal=True")
+    if q_offset and not causal:
+        raise ValueError("q_offset only shifts the causal/window masks; "
+                         "it requires causal=True")
     q, k, v, km, bq, bk, first_pad, user_mask, Tq = _prep(
         q, k, v, key_mask, causal, block_q, block_k,
         allow_unaligned_causal=q_offset != 0)

@@ -492,7 +492,6 @@ class TestShardedStreamingCache:
         assert kcs, "no KV cache carried"
         for kc in kcs:
             assert len(kc.sharding.device_set) == 8, kc.sharding
-        net2.set_stream_cache_sharding(None)
 
     def test_rnn_time_step_outputs_match(self, mesh):
         model = self._model()
@@ -508,7 +507,6 @@ class TestShardedStreamingCache:
         net2.set_stream_cache_sharding(mesh)
         sharded = np.asarray(net2.rnn_time_step(x))
         np.testing.assert_allclose(sharded, plain, atol=1e-5, rtol=1e-5)
-        net2.set_stream_cache_sharding(None)
 
     def test_rolling_window_cache_sharded(self, mesh):
         """The ROLLING (windowed, unbounded-generation) cache shards
@@ -525,7 +523,6 @@ class TestShardedStreamingCache:
         kcs = [s["kv_k"] for s in net2.state.values()
                if isinstance(s, dict) and "kv_k" in s]
         assert kcs and all(len(k.sharding.device_set) == 8 for k in kcs)
-        net2.set_stream_cache_sharding(None)
 
     def test_beam_search_with_sharded_cache(self, mesh):
         from deeplearning4j_tpu.util.decoding import beam_search
@@ -542,4 +539,3 @@ class TestShardedStreamingCache:
                                                  max_length=16)
         assert seq_plain == seq_sharded
         assert np.isclose(score_plain, score_sharded, atol=1e-5)
-        net2.set_stream_cache_sharding(None)
