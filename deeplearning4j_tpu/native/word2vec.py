@@ -1,0 +1,136 @@
+"""Native Word2Vec pair generation bindings.
+
+The reference trains embeddings with a multithreaded Java worker pool
+(SequenceVectors.java:192 fit); the TPU build batches the device math into
+jit steps, which left numpy pair generation as the measured host ceiling
+(~200k words/s, PERF.md). native/src/word2vec.cpp generates an epoch of
+skip-gram pairs / CBOW rows across C++ threads (ctypes releases the GIL);
+results are deterministic in (seed, sequence index) regardless of thread
+count. Falls back to None when the toolchain is unavailable — callers keep
+the numpy path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.native._loader import NativeLib
+
+_i32p = ctypes.POINTER(ctypes.c_int32)
+_i64p = ctypes.POINTER(ctypes.c_int64)
+_f32p = ctypes.POINTER(ctypes.c_float)
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    lib.w2v_sg_pairs.restype = ctypes.c_int64
+    lib.w2v_sg_pairs.argtypes = [
+        _i32p, _i64p, ctypes.c_int64, ctypes.c_int32, _f32p,
+        ctypes.c_uint64, ctypes.c_int32, _i32p, _i32p, _i32p,
+        ctypes.c_int64, ctypes.c_int32]
+    lib.w2v_cbow_rows.restype = ctypes.c_int64
+    lib.w2v_cbow_rows.argtypes = [
+        _i32p, _i64p, ctypes.c_int64, ctypes.c_int32, _f32p,
+        ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32, _i32p, _f32p,
+        _i32p, _i32p, ctypes.c_int64, ctypes.c_int32]
+
+
+_LIB = NativeLib("libdl4jtpu_word2vec.so", "word2vec.cpp", _configure)
+
+
+def native_available() -> bool:
+    return _LIB.available()
+
+
+def _threads() -> int:
+    return min(8, os.cpu_count() or 1)
+
+
+def _ptr(a: np.ndarray, ct):
+    return a.ctypes.data_as(ct)
+
+
+def sg_pairs(corpus: np.ndarray, offsets: np.ndarray, window: int,
+             keep: Optional[np.ndarray], seed: int, shrink: bool = True
+             ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Skip-gram (input=context, output=center) pairs for the whole
+    corpus. corpus: concatenated int32 vocab indices; offsets: int64
+    [n_seqs+1]; keep: per-vocab keep probability (None = no subsample).
+    Returns (ins, outs, pair_seq) or None when the native lib is absent."""
+    lib = _LIB.load()
+    if lib is None:
+        return None
+    corpus = np.ascontiguousarray(corpus, np.int32)
+    offsets = np.ascontiguousarray(offsets, np.int64)
+    n_seqs = len(offsets) - 1
+    kp = None if keep is None else np.ascontiguousarray(keep, np.float32)
+    kpp = None if kp is None else _ptr(kp, _f32p)
+    sd = ctypes.c_uint64(seed & (2**64 - 1))
+    shr = 1 if shrink else 0
+    # probe with cap=0 (counting pass only, returns -(pairs needed)) so
+    # the buffers are sized EXACTLY — no worst-case corpus*2w allocation
+    probe = np.empty(1, np.int32)
+    n = lib.w2v_sg_pairs(
+        _ptr(corpus, _i32p), _ptr(offsets, _i64p), n_seqs, window, kpp,
+        sd, shr, _ptr(probe, _i32p), _ptr(probe, _i32p),
+        _ptr(probe, _i32p), 0, _threads())
+    if n == -(2 ** 63):
+        raise ValueError(f"invalid w2v_sg_pairs arguments (window={window})")
+    need = -n if n < 0 else n
+    ins = np.empty(need, np.int32)
+    outs = np.empty(need, np.int32)
+    pair_seq = np.empty(need, np.int32)
+    if need:
+        n = lib.w2v_sg_pairs(
+            _ptr(corpus, _i32p), _ptr(offsets, _i64p), n_seqs, window, kpp,
+            sd, shr, _ptr(ins, _i32p), _ptr(outs, _i32p),
+            _ptr(pair_seq, _i32p), need, _threads())
+        if n != need:
+            raise RuntimeError(f"w2v_sg_pairs fill mismatch {n} != {need}")
+    return ins, outs, pair_seq
+
+
+def cbow_rows(corpus: np.ndarray, offsets: np.ndarray, window: int,
+              keep: Optional[np.ndarray], seed: int, row_width: int,
+              shrink: bool = True):
+    """CBOW context rows ([n, row_width] ctxs + mask, centers, row_seq)
+    with columns [-w..-1, 1..w] like SequenceVectors._cbow_contexts.
+    row_width >= 2*window (extra columns left zero for label slots).
+    Returns None when the native lib is absent."""
+    lib = _LIB.load()
+    if lib is None:
+        return None
+    corpus = np.ascontiguousarray(corpus, np.int32)
+    offsets = np.ascontiguousarray(offsets, np.int64)
+    n_seqs = len(offsets) - 1
+    kp = None if keep is None else np.ascontiguousarray(keep, np.float32)
+    kpp = None if kp is None else _ptr(kp, _f32p)
+    sd = ctypes.c_uint64(seed & (2**64 - 1))
+    shr = 1 if shrink else 0
+    probe_i = np.empty(1, np.int32)
+    probe_f = np.empty(1, np.float32)
+    n = lib.w2v_cbow_rows(
+        _ptr(corpus, _i32p), _ptr(offsets, _i64p), n_seqs, window, kpp,
+        sd, shr, row_width, _ptr(probe_i, _i32p), _ptr(probe_f, _f32p),
+        _ptr(probe_i, _i32p), _ptr(probe_i, _i32p), 0, _threads())
+    if n == -(2 ** 63):
+        raise ValueError(
+            f"invalid w2v_cbow_rows arguments (window={window}, "
+            f"row_width={row_width})")
+    need = -n if n < 0 else n
+    # np.empty is enough: the engine memsets + fills every written row
+    ctxs = np.empty((need, row_width), np.int32)
+    cmask = np.empty((need, row_width), np.float32)
+    centers = np.empty(need, np.int32)
+    row_seq = np.empty(need, np.int32)
+    if need:
+        n = lib.w2v_cbow_rows(
+            _ptr(corpus, _i32p), _ptr(offsets, _i64p), n_seqs, window, kpp,
+            sd, shr, row_width, _ptr(ctxs, _i32p), _ptr(cmask, _f32p),
+            _ptr(centers, _i32p), _ptr(row_seq, _i32p), need, _threads())
+        if n != need:
+            raise RuntimeError(f"w2v_cbow_rows fill mismatch {n} != {need}")
+    return ctxs, cmask, centers, row_seq

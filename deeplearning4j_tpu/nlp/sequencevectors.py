@@ -348,6 +348,10 @@ class SequenceVectors:
                 "SequenceVectors.fit expects sequences of tokens "
                 "(List[List[str]]); got strings — tokenize first, or use "
                 "Word2Vec with a sentence_iterator/tokenizer_factory")
+        if (train_words and not train_labels
+                and labels_per_sequence is None
+                and self._fit_native(seqs)):
+            return
         total_words = sum(len(s) for s in seqs) * max(1, self.epochs)
         words_seen = 0
         sg = self.algo == "skipgram"
@@ -389,6 +393,76 @@ class SequenceVectors:
         else:
             for bx, bm, bc, ba in buf.drain_cbow(self._eff_batch, final=True):
                 self._dispatch_cbow(bx, bm, bc, ba)
+
+    def _keep_probs(self) -> Optional[np.ndarray]:
+        """Per-vocab-index keep probability for word2vec subsampling
+        (None = no subsampling) — the vectorized form of _to_indices'
+        per-token keep computation."""
+        if self.sampling <= 0:
+            return None
+        t = self.sampling
+        total = max(1.0, self.vocab.total_word_count)
+        keep = np.ones(self.vocab.num_words(), np.float32)
+        for i in range(self.vocab.num_words()):
+            vw = self.vocab.element_at_index(i)
+            f = (vw.frequency if vw is not None else 0.0) / total
+            if f > 0:
+                keep[i] = min(1.0, (np.sqrt(f / t) + 1) * (t / f))
+        return keep
+
+    def _fit_native(self, seqs) -> bool:
+        """Epoch-at-a-time pair generation in the C++ runtime
+        (native/src/word2vec.cpp; ref: the SequenceVectors.java:192
+        multithreaded fit). Vocab lookup happens ONCE for the whole fit;
+        each epoch×iteration generates all pairs across threads and
+        dispatches the existing batched device steps. Returns False (use
+        the numpy path) when the native lib is unavailable."""
+        from deeplearning4j_tpu.native import word2vec as nw
+        if not nw.native_available():
+            return False
+        # corpus as indices, once (OOV = -1, skipped natively but still
+        # counted in the learning-rate schedule like the numpy path)
+        lens = np.asarray([len(s) for s in seqs], np.int64)
+        offsets = np.zeros(len(seqs) + 1, np.int64)
+        np.cumsum(lens, out=offsets[1:])
+        corpus = np.empty(int(offsets[-1]), np.int32)
+        at = 0
+        index_of = self.vocab.index_of
+        for seq in seqs:
+            for tok in seq:
+                corpus[at] = index_of(tok)
+                at += 1
+        keep = self._keep_probs()
+        # per-sequence alpha: the numpy path's words_seen schedule
+        total_words = int(lens.sum()) * max(1, self.epochs)
+        sg = self.algo == "skipgram"
+        B = self._eff_batch
+        for epoch in range(self.epochs):
+            seen = int(lens.sum()) * epoch + np.cumsum(lens)
+            seq_alpha = np.maximum(
+                self.min_learning_rate,
+                self.learning_rate
+                * (1.0 - np.minimum(1.0, seen / max(1, total_words)))
+            ).astype(np.float32)
+            for _ in range(self.iterations):
+                seed = int(self._rng.integers(2 ** 63))
+                if sg:
+                    ins, outs, pair_seq = nw.sg_pairs(
+                        corpus, offsets, self.window, keep, seed)
+                    alphas = seq_alpha[pair_seq]
+                    for s in range(0, len(ins), B):
+                        self._dispatch_sg(ins[s:s + B], outs[s:s + B],
+                                          alphas[s:s + B])
+                else:
+                    ctxs, cmask, centers, row_seq = nw.cbow_rows(
+                        corpus, offsets, self.window, keep, seed,
+                        row_width=2 * self.window)
+                    alphas = seq_alpha[row_seq]
+                    for s in range(0, len(centers), B):
+                        self._dispatch_cbow(ctxs[s:s + B], cmask[s:s + B],
+                                            centers[s:s + B],
+                                            alphas[s:s + B])
+        return True
 
     def _alpha(self, seen: int, total: int) -> float:
         frac = min(1.0, seen / max(1, total))

@@ -1,0 +1,231 @@
+// Word2Vec host-side pair generation (skip-gram windows, CBOW context
+// rows, subsampling, random window shrink) as a multithreaded C++ engine.
+//
+// Role: the reference trains embeddings with a multithreaded Java worker
+// pool (deeplearning4j-nlp-parent/.../sequencevectors/SequenceVectors.java
+// :192 fit; elements/SkipGram.java windowing). In the TPU build the
+// *device* math is a batched jit step (nlp/sequencevectors.py), which left
+// pair generation as the measured host-side ceiling (~200k words/s in
+// pure numpy — PERF.md round 2). This engine generates an entire epoch of
+// pairs in parallel C++ threads behind a flat C ABI (ctypes releases the
+// GIL), feeding the existing batched device dispatch.
+//
+// Determinism: every sequence derives its own splitmix64 stream from
+// (seed, sequence index), so results are independent of thread count and
+// scheduling. Python-side semantic twin: SequenceVectors._pairs /
+// _cbow_contexts (exactness pinned by tests with shrink/subsample off;
+// identical distributions otherwise).
+
+#include <atomic>
+#include <cstdint>
+#include <climits>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct SplitMix64 {
+    uint64_t s;
+    explicit SplitMix64(uint64_t seed) : s(seed) {}
+    uint64_t next() {
+        uint64_t z = (s += 0x9e3779b97f4a7c15ULL);
+        z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+        z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+        return z ^ (z >> 31);
+    }
+    // uniform in [0, 1)
+    double u01() { return (next() >> 11) * 0x1.0p-53; }
+    // uniform integer in [0, n)
+    uint32_t below(uint32_t n) {
+        return n ? static_cast<uint32_t>(next() % n) : 0;
+    }
+};
+
+inline uint64_t seq_seed(uint64_t seed, int64_t si) {
+    // decorrelate neighbouring sequences
+    return seed ^ (0x9e3779b97f4a7c15ULL * static_cast<uint64_t>(si + 1));
+}
+
+// subsample + window-shrink one sequence into `kept` (indices into the
+// vocab) and per-position shrink values; RNG order: one u01 per corpus
+// token (when keep != null), then one below(window) per KEPT token (when
+// shrink != 0) — mirrored exactly by the counting and filling passes.
+void prepare_seq(const int32_t* corpus, int64_t lo, int64_t hi,
+                 const float* keep, uint64_t rng_seed, int32_t window,
+                 int32_t shrink, std::vector<int32_t>& kept,
+                 std::vector<int32_t>& b) {
+    SplitMix64 rng(rng_seed);
+    kept.clear();
+    for (int64_t p = lo; p < hi; ++p) {
+        int32_t w = corpus[p];
+        if (w < 0) continue;
+        if (keep != nullptr && rng.u01() >= keep[w]) continue;
+        kept.push_back(w);
+    }
+    b.assign(kept.size(), 0);
+    if (shrink) {
+        for (size_t i = 0; i < kept.size(); ++i)
+            b[i] = static_cast<int32_t>(rng.below(
+                static_cast<uint32_t>(window)));
+    }
+}
+
+// sentinel distinct from -(needed): invalid arguments
+constexpr int64_t kInvalidArgs = INT64_MIN;
+
+}  // namespace
+
+extern "C" {
+
+// Skip-gram pairs for sequences [0, n_seqs): corpus is the concatenation
+// of per-sequence vocab indices, offsets[n_seqs+1] delimits sequences.
+// keep: per-vocab-index keep probability (nullptr = keep all). For each
+// kept position i with shrink b_i, emits (input=context word, output=
+// center word) for offsets in [-(w-b_i), w-b_i] \ {0} that stay in
+// range — the word2vec C / SkipGram.java windowing, and exactly
+// SequenceVectors._pairs. pair_seq records the source sequence id (for
+// per-sequence learning-rate decay).
+// Returns pairs written; if `cap` is insufficient returns -(pairs needed)
+// WITHOUT writing, so callers can size buffers exactly (cap=0 probes).
+int64_t w2v_sg_pairs(const int32_t* corpus, const int64_t* offsets,
+                     int64_t n_seqs, int32_t window, const float* keep,
+                     uint64_t seed, int32_t shrink,
+                     int32_t* ins, int32_t* outs, int32_t* pair_seq,
+                     int64_t cap, int32_t n_threads) {
+    if (window < 1 || n_seqs < 0) return kInvalidArgs;
+    if (n_threads < 1) n_threads = 1;
+    std::vector<int64_t> counts(static_cast<size_t>(n_seqs) + 1, 0);
+
+    auto count_range = [&](int64_t s0, int64_t s1) {
+        std::vector<int32_t> kept, b;
+        for (int64_t si = s0; si < s1; ++si) {
+            prepare_seq(corpus, offsets[si], offsets[si + 1], keep,
+                        seq_seed(seed, si), window, shrink, kept, b);
+            int64_t n = static_cast<int64_t>(kept.size());
+            int64_t c = 0;
+            for (int64_t i = 0; i < n; ++i) {
+                int32_t reach = window - b[i];
+                int64_t lo = i - reach < 0 ? 0 : i - reach;
+                int64_t hi = i + reach >= n ? n - 1 : i + reach;
+                c += (hi - lo);  // excludes the center itself
+            }
+            counts[si + 1] = c;
+        }
+    };
+    auto fill_range = [&](int64_t s0, int64_t s1) {
+        std::vector<int32_t> kept, b;
+        for (int64_t si = s0; si < s1; ++si) {
+            prepare_seq(corpus, offsets[si], offsets[si + 1], keep,
+                        seq_seed(seed, si), window, shrink, kept, b);
+            int64_t n = static_cast<int64_t>(kept.size());
+            int64_t at = counts[si];
+            for (int64_t i = 0; i < n; ++i) {
+                int32_t reach = window - b[i];
+                for (int64_t j = i - reach; j <= i + reach; ++j) {
+                    if (j < 0 || j >= n || j == i) continue;
+                    ins[at] = kept[j];
+                    outs[at] = kept[i];
+                    pair_seq[at] = static_cast<int32_t>(si);
+                    ++at;
+                }
+            }
+        }
+    };
+
+    auto run = [&](auto fn) {
+        int64_t per = (n_seqs + n_threads - 1) / n_threads;
+        std::vector<std::thread> ts;
+        for (int t = 0; t < n_threads; ++t) {
+            int64_t s0 = t * per;
+            int64_t s1 = s0 + per < n_seqs ? s0 + per : n_seqs;
+            if (s0 >= s1) break;
+            ts.emplace_back(fn, s0, s1);
+        }
+        for (auto& th : ts) th.join();
+    };
+
+    run(count_range);
+    for (int64_t si = 0; si < n_seqs; ++si) counts[si + 1] += counts[si];
+    if (counts[n_seqs] > cap) return -counts[n_seqs];
+    run(fill_range);
+    return counts[n_seqs];
+}
+
+// CBOW context rows: for each kept center, a row of 2*window context
+// slots (shrink/range-invalid slots zeroed with mask 0) + the center —
+// exactly SequenceVectors._cbow_contexts (without label columns, which
+// the Python side appends). Returns rows written; if `cap_rows` is
+// insufficient returns -(rows needed) without writing (cap_rows=0 probes).
+int64_t w2v_cbow_rows(const int32_t* corpus, const int64_t* offsets,
+                      int64_t n_seqs, int32_t window, const float* keep,
+                      uint64_t seed, int32_t shrink, int32_t row_width,
+                      int32_t* ctxs, float* cmask, int32_t* centers,
+                      int32_t* row_seq, int64_t cap_rows,
+                      int32_t n_threads) {
+    if (window < 1 || n_seqs < 0 || row_width < 2 * window)
+        return kInvalidArgs;
+    if (n_threads < 1) n_threads = 1;
+    std::vector<int64_t> counts(static_cast<size_t>(n_seqs) + 1, 0);
+
+    auto count_range = [&](int64_t s0, int64_t s1) {
+        std::vector<int32_t> kept, b;
+        for (int64_t si = s0; si < s1; ++si) {
+            prepare_seq(corpus, offsets[si], offsets[si + 1], keep,
+                        seq_seed(seed, si), window, shrink, kept, b);
+            counts[si + 1] = static_cast<int64_t>(kept.size());
+        }
+    };
+    auto fill_range = [&](int64_t s0, int64_t s1) {
+        std::vector<int32_t> kept, b;
+        for (int64_t si = s0; si < s1; ++si) {
+            prepare_seq(corpus, offsets[si], offsets[si + 1], keep,
+                        seq_seed(seed, si), window, shrink, kept, b);
+            int64_t n = static_cast<int64_t>(kept.size());
+            int64_t at = counts[si];
+            for (int64_t i = 0; i < n; ++i, ++at) {
+                int32_t* row = ctxs + at * row_width;
+                float* mrow = cmask + at * row_width;
+                std::memset(row, 0,
+                            sizeof(int32_t) * static_cast<size_t>(row_width));
+                std::memset(mrow, 0,
+                            sizeof(float) * static_cast<size_t>(row_width));
+                int32_t reach = window - b[i];
+                // slot layout mirrors the numpy twin: offsets
+                // [-w..-1, 1..w] map to columns [0..2w)
+                for (int32_t off = -window; off <= window; ++off) {
+                    if (off == 0) continue;
+                    int64_t j = i + off;
+                    int32_t col = off < 0 ? off + window
+                                          : off + window - 1;
+                    if (j < 0 || j >= n || off < -reach || off > reach)
+                        continue;
+                    row[col] = kept[j];
+                    mrow[col] = 1.0f;
+                }
+                centers[at] = kept[i];
+                row_seq[at] = static_cast<int32_t>(si);
+            }
+        }
+    };
+
+    auto run = [&](auto fn) {
+        int64_t per = (n_seqs + n_threads - 1) / n_threads;
+        std::vector<std::thread> ts;
+        for (int t = 0; t < n_threads; ++t) {
+            int64_t s0 = t * per;
+            int64_t s1 = s0 + per < n_seqs ? s0 + per : n_seqs;
+            if (s0 >= s1) break;
+            ts.emplace_back(fn, s0, s1);
+        }
+        for (auto& th : ts) th.join();
+    };
+
+    run(count_range);
+    for (int64_t si = 0; si < n_seqs; ++si) counts[si + 1] += counts[si];
+    if (counts[n_seqs] > cap_rows) return -counts[n_seqs];
+    run(fill_range);
+    return counts[n_seqs];
+}
+
+}  // extern "C"

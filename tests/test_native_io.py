@@ -120,3 +120,129 @@ def test_csv_skip_multiple_lines(tmp_path):
         f.write(b"header one\nheader two\n1,2\n3,4\n")
     out = read_csv(p, skip_header=2)
     np.testing.assert_allclose(out, [[1, 2], [3, 4]])
+
+
+class TestNativeWord2Vec:
+    """native/src/word2vec.cpp pair generation vs the numpy twin
+    (SequenceVectors._pairs / _cbow_contexts semantics)."""
+
+    def _numpy_sg_pairs(self, idxs, w):
+        """SequenceVectors._pairs with shrink disabled (b=0)."""
+        n = len(idxs)
+        offs = np.concatenate([np.arange(-w, 0), np.arange(1, w + 1)])
+        pos = np.arange(n)[:, None]
+        c = pos + offs[None, :]
+        valid = (c >= 0) & (c < n)
+        ins = idxs[c.clip(0, n - 1)][valid]
+        outs = np.broadcast_to(idxs[:, None], c.shape)[valid]
+        return ins.astype(np.int32), outs.astype(np.int32)
+
+    def test_sg_exact_vs_numpy_no_shrink(self):
+        from deeplearning4j_tpu.native import word2vec as nw
+        if not nw.native_available():
+            pytest.skip("native toolchain unavailable")
+        rng = np.random.default_rng(5)
+        seqs = [rng.integers(0, 50, rng.integers(1, 40)).astype(np.int32)
+                for _ in range(23)]
+        corpus = np.concatenate(seqs)
+        offsets = np.zeros(len(seqs) + 1, np.int64)
+        np.cumsum([len(s) for s in seqs], out=offsets[1:])
+        for w in (1, 3, 5):
+            ins, outs, pair_seq = nw.sg_pairs(corpus, offsets, w, None,
+                                              seed=7, shrink=False)
+            at = 0
+            for si, s in enumerate(seqs):
+                ei, eo = self._numpy_sg_pairs(s, w)
+                got_i = ins[at:at + len(ei)]
+                got_o = outs[at:at + len(eo)]
+                np.testing.assert_array_equal(got_i, ei,
+                                              err_msg=f"seq {si} w={w}")
+                np.testing.assert_array_equal(got_o, eo)
+                assert (pair_seq[at:at + len(ei)] == si).all()
+                at += len(ei)
+            assert at == len(ins)
+
+    def test_cbow_exact_vs_numpy_no_shrink(self):
+        from deeplearning4j_tpu.native import word2vec as nw
+        if not nw.native_available():
+            pytest.skip("native toolchain unavailable")
+        rng = np.random.default_rng(6)
+        seqs = [rng.integers(1, 50, rng.integers(1, 30)).astype(np.int32)
+                for _ in range(11)]
+        corpus = np.concatenate(seqs)
+        offsets = np.zeros(len(seqs) + 1, np.int64)
+        np.cumsum([len(s) for s in seqs], out=offsets[1:])
+        w = 3
+        ctxs, cmask, centers, row_seq = nw.cbow_rows(
+            corpus, offsets, w, None, seed=3, row_width=2 * w,
+            shrink=False)
+        at = 0
+        for si, idxs in enumerate(seqs):
+            n = len(idxs)
+            offs = np.concatenate([np.arange(-w, 0), np.arange(1, w + 1)])
+            c = np.arange(n)[:, None] + offs[None, :]
+            valid = (c >= 0) & (c < n)
+            ectx = (idxs[c.clip(0, n - 1)] * valid).astype(np.int32)
+            np.testing.assert_array_equal(ctxs[at:at + n], ectx,
+                                          err_msg=f"seq {si}")
+            np.testing.assert_array_equal(cmask[at:at + n],
+                                          valid.astype(np.float32))
+            np.testing.assert_array_equal(centers[at:at + n], idxs)
+            at += n
+        assert at == len(centers)
+
+    def test_shrink_pairs_subset_and_deterministic(self):
+        from deeplearning4j_tpu.native import word2vec as nw
+        if not nw.native_available():
+            pytest.skip("native toolchain unavailable")
+        idxs = np.arange(64, dtype=np.int32)
+        offsets = np.array([0, 64], np.int64)
+        w = 5
+        full_i, full_o, _ = nw.sg_pairs(idxs, offsets, w, None, seed=1,
+                                        shrink=False)
+        full = set(zip(full_i.tolist(), full_o.tolist()))
+        a = nw.sg_pairs(idxs, offsets, w, None, seed=9, shrink=True)
+        b = nw.sg_pairs(idxs, offsets, w, None, seed=9, shrink=True)
+        np.testing.assert_array_equal(a[0], b[0])  # same seed -> same pairs
+        np.testing.assert_array_equal(a[1], b[1])
+        assert len(a[0]) < len(full_i)             # shrink dropped some
+        assert set(zip(a[0].tolist(), a[1].tolist())) <= full
+        c = nw.sg_pairs(idxs, offsets, w, None, seed=10, shrink=True)
+        assert len(c[0]) != len(a[0]) or not np.array_equal(c[0], a[0])
+
+    def test_subsampling_rate(self):
+        from deeplearning4j_tpu.native import word2vec as nw
+        if not nw.native_available():
+            pytest.skip("native toolchain unavailable")
+        # word 0 keep prob 0.2, word 1 keep 1.0
+        corpus = np.tile(np.array([0, 1], np.int32), 4000)
+        offsets = np.array([0, len(corpus)], np.int64)
+        keep = np.array([0.2, 1.0], np.float32)
+        ins, outs, _ = nw.sg_pairs(corpus, offsets, 1, keep, seed=11,
+                                   shrink=False)
+        centers, counts = np.unique(outs, return_counts=True)
+        frac0 = counts[centers == 0][0] / counts[centers == 1][0]
+        assert 0.1 < frac0 < 0.35, frac0   # ~0.2 expected
+
+    def test_fit_native_matches_quality(self):
+        """End-to-end: SequenceVectors.fit through the native generator
+        learns the same co-occurrence structure the numpy path does."""
+        from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
+        from deeplearning4j_tpu.native import word2vec as nw
+        if not nw.native_available():
+            pytest.skip("native toolchain unavailable")
+        rng = np.random.default_rng(0)
+        # two clusters of interchangeable words
+        a_words = [f"a{i}" for i in range(4)]
+        b_words = [f"b{i}" for i in range(4)]
+        seqs = []
+        for _ in range(300):
+            grp = a_words if rng.random() < 0.5 else b_words
+            seqs.append([grp[rng.integers(4)] for _ in range(8)])
+        sv = SequenceVectors(layer_size=24, window=3, negative=5,
+                             epochs=6, learning_rate=0.025, seed=3)
+        sv.build_vocab(seqs)
+        sv.fit(seqs)
+        same = sv.similarity("a0", "a1")
+        cross = sv.similarity("a0", "b0")
+        assert same > cross + 0.2, (same, cross)
