@@ -437,6 +437,19 @@ class SequenceVectors:
         total_words = int(lens.sum()) * max(1, self.epochs)
         sg = self.algo == "skipgram"
         B = self._eff_batch
+        # bound host memory: generate per SHARD of sequences (~1M corpus
+        # words => tens of MB of pairs), not per whole epoch — big
+        # corpora keep the numpy path's bounded-memory property
+        shard_words = 1 << 20
+        shards = [0]
+        acc = 0
+        for si in range(len(seqs)):
+            acc += int(lens[si])
+            if acc >= shard_words:
+                shards.append(si + 1)
+                acc = 0
+        if shards[-1] != len(seqs):
+            shards.append(len(seqs))
         for epoch in range(self.epochs):
             seen = int(lens.sum()) * epoch + np.cumsum(lens)
             seq_alpha = np.maximum(
@@ -446,22 +459,27 @@ class SequenceVectors:
             ).astype(np.float32)
             for _ in range(self.iterations):
                 seed = int(self._rng.integers(2 ** 63))
-                if sg:
-                    ins, outs, pair_seq = nw.sg_pairs(
-                        corpus, offsets, self.window, keep, seed)
-                    alphas = seq_alpha[pair_seq]
-                    for s in range(0, len(ins), B):
-                        self._dispatch_sg(ins[s:s + B], outs[s:s + B],
-                                          alphas[s:s + B])
-                else:
-                    ctxs, cmask, centers, row_seq = nw.cbow_rows(
-                        corpus, offsets, self.window, keep, seed,
-                        row_width=2 * self.window)
-                    alphas = seq_alpha[row_seq]
-                    for s in range(0, len(centers), B):
-                        self._dispatch_cbow(ctxs[s:s + B], cmask[s:s + B],
-                                            centers[s:s + B],
-                                            alphas[s:s + B])
+                for s0, s1 in zip(shards[:-1], shards[1:]):
+                    sub_off = offsets[s0:s1 + 1] - offsets[s0]
+                    sub_corpus = corpus[offsets[s0]:offsets[s1]]
+                    if sg:
+                        ins, outs, pair_seq = nw.sg_pairs(
+                            sub_corpus, sub_off, self.window, keep,
+                            seed + s0)
+                        alphas = seq_alpha[pair_seq + s0]
+                        for s in range(0, len(ins), B):
+                            self._dispatch_sg(ins[s:s + B], outs[s:s + B],
+                                              alphas[s:s + B])
+                    else:
+                        ctxs, cmask, centers, row_seq = nw.cbow_rows(
+                            sub_corpus, sub_off, self.window, keep,
+                            seed + s0, row_width=2 * self.window)
+                        alphas = seq_alpha[row_seq + s0]
+                        for s in range(0, len(centers), B):
+                            self._dispatch_cbow(ctxs[s:s + B],
+                                                cmask[s:s + B],
+                                                centers[s:s + B],
+                                                alphas[s:s + B])
         return True
 
     def _alpha(self, seen: int, total: int) -> float:

@@ -48,6 +48,48 @@ def _check_seed(seed_ids, steps, max_length):
                          f"under max_length {max_length}")
 
 
+#: largest priming chunk; every prompt decomposes into descending
+#: powers of two <= this, so ALL prompt lengths share at most
+#: log2(PRIME_CHUNK_MAX)+1 distinct jit shapes (vs one trace per length)
+PRIME_CHUNK_MAX = 64
+
+
+def _prime_chunks(n: int):
+    """Greedy power-of-two decomposition of a prompt length, largest
+    chunk first (serving-friendly: a new prompt length never costs a new
+    compile once the shared chunk shapes are warm)."""
+    out = []
+    c = PRIME_CHUNK_MAX
+    while n > 0:
+        while c > n:
+            c //= 2
+        out.append(c)
+        n -= c
+    return out
+
+
+def _prime(net, ids, vocab: int):
+    """Feed the seed through rnn_time_step in bucketed chunks; returns
+    the final chunk's output (its last position is the next-token
+    distribution). Stateful streaming makes chunked == one-shot priming
+    (pinned by the streaming-vs-full-forward tests)."""
+    at, out = 0, None
+    for c in _prime_chunks(len(ids)):
+        out = net.rnn_time_step(
+            _one_hot(np.asarray(ids[at:at + c])[None, :], vocab))
+        at += c
+    return out
+
+
+def _width_bucket(w: int) -> int:
+    """Round a beam width up to the next power of two — decode-step jit
+    shapes are per-bucket, not per-width."""
+    b = 1
+    while b < w:
+        b *= 2
+    return b
+
+
 def sample_stream(net, seed_ids, steps: int, vocab_size: int,
                   temperature: float = 1.0,
                   rng: Optional[np.random.Generator] = None,
@@ -60,7 +102,7 @@ def sample_stream(net, seed_ids, steps: int, vocab_size: int,
     rng = rng or np.random.default_rng(0)
     ids = list(seed_ids)
     net.rnn_clear_previous_state()
-    out = net.rnn_time_step(_one_hot(np.asarray(ids)[None, :], vocab_size))
+    out = _prime(net, ids, vocab_size)
     for i in range(steps):
         if max_length is not None and len(ids) >= max_length:
             break
@@ -86,19 +128,22 @@ def beam_search(net, seed_ids, steps: int, vocab_size: int,
     V = vocab_size
     _check_seed(seed_ids, steps, max_length)
     W = min(beam_width, V)     # top-k can't exceed the vocab
+    Wb = _width_bucket(W)      # decode batch: per-bucket jit shape
     net.rnn_clear_previous_state()
 
-    # prime ONCE at batch 1, then broadcast the carried state to W beams
-    out = net.rnn_time_step(_one_hot(np.asarray(seed_ids)[None, :], V))
-    reorder_stream_state(net, np.zeros(W, np.int64))
-    out = np.repeat(_probs(out)[:1], W, axis=0)
+    # prime ONCE at batch 1 (bucketed chunks), then broadcast the carried
+    # state to the padded beam batch; pad rows never enter scoring (the
+    # logp slice below keeps only the first W rows)
+    out = _prime(net, seed_ids, V)
+    reorder_stream_state(net, np.zeros(Wb, np.int64))
+    out = np.repeat(_probs(out)[:1], Wb, axis=0)
     beams = [list(seed_ids) for _ in range(W)]
     scores = np.zeros(W)
     first = True
     for i in range(steps):
         if max_length is not None and len(beams[0]) >= max_length:
             break
-        logp = np.log(np.clip(_probs(out)[:, :, -1], 1e-12, None))  # [W,V]
+        logp = np.log(np.clip(_probs(out)[:W, :, -1], 1e-12, None))  # [W,V]
         if first:
             # identical primed beams must diverge: top-W FIRST tokens of
             # beam 0, not W copies of the argmax
@@ -115,9 +160,14 @@ def beam_search(net, seed_ids, steps: int, vocab_size: int,
         more = i + 1 < steps and (max_length is None
                                   or len(beams[0]) < max_length)
         if more:
-            if not np.array_equal(parents, np.arange(W)):
-                reorder_stream_state(net, parents)  # inherit caches
-            out = net.rnn_time_step(_one_hot(np.asarray(tokens)[:, None],
-                                             V))
+            # pad rows keep their own (discarded) state so the
+            # identity-parents fast path still skips the cache gather
+            pp = np.arange(Wb, dtype=np.int64)
+            pp[:W] = parents
+            if not np.array_equal(pp, np.arange(Wb)):
+                reorder_stream_state(net, pp)   # inherit caches
+            tok = np.zeros(Wb, np.int64)
+            tok[:W] = tokens
+            out = net.rnn_time_step(_one_hot(tok[:, None], V))
     best = int(np.argmax(scores))
     return beams[best], float(scores[best])

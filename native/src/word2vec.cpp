@@ -74,6 +74,20 @@ void prepare_seq(const int32_t* corpus, int64_t lo, int64_t hi,
 // sentinel distinct from -(needed): invalid arguments
 constexpr int64_t kInvalidArgs = INT64_MIN;
 
+// partition [0, n_seqs) across threads and join
+template <typename Fn>
+void run_sharded(int64_t n_seqs, int32_t n_threads, Fn fn) {
+    int64_t per = (n_seqs + n_threads - 1) / n_threads;
+    std::vector<std::thread> ts;
+    for (int32_t t = 0; t < n_threads; ++t) {
+        int64_t s0 = t * per;
+        int64_t s1 = s0 + per < n_seqs ? s0 + per : n_seqs;
+        if (s0 >= s1) break;
+        ts.emplace_back(fn, s0, s1);
+    }
+    for (auto& th : ts) th.join();
+}
+
 }  // namespace
 
 extern "C" {
@@ -133,22 +147,10 @@ int64_t w2v_sg_pairs(const int32_t* corpus, const int64_t* offsets,
         }
     };
 
-    auto run = [&](auto fn) {
-        int64_t per = (n_seqs + n_threads - 1) / n_threads;
-        std::vector<std::thread> ts;
-        for (int t = 0; t < n_threads; ++t) {
-            int64_t s0 = t * per;
-            int64_t s1 = s0 + per < n_seqs ? s0 + per : n_seqs;
-            if (s0 >= s1) break;
-            ts.emplace_back(fn, s0, s1);
-        }
-        for (auto& th : ts) th.join();
-    };
-
-    run(count_range);
+    run_sharded(n_seqs, n_threads, count_range);
     for (int64_t si = 0; si < n_seqs; ++si) counts[si + 1] += counts[si];
     if (counts[n_seqs] > cap) return -counts[n_seqs];
-    run(fill_range);
+    run_sharded(n_seqs, n_threads, fill_range);
     return counts[n_seqs];
 }
 
@@ -209,22 +211,10 @@ int64_t w2v_cbow_rows(const int32_t* corpus, const int64_t* offsets,
         }
     };
 
-    auto run = [&](auto fn) {
-        int64_t per = (n_seqs + n_threads - 1) / n_threads;
-        std::vector<std::thread> ts;
-        for (int t = 0; t < n_threads; ++t) {
-            int64_t s0 = t * per;
-            int64_t s1 = s0 + per < n_seqs ? s0 + per : n_seqs;
-            if (s0 >= s1) break;
-            ts.emplace_back(fn, s0, s1);
-        }
-        for (auto& th : ts) th.join();
-    };
-
-    run(count_range);
+    run_sharded(n_seqs, n_threads, count_range);
     for (int64_t si = 0; si < n_seqs; ++si) counts[si + 1] += counts[si];
     if (counts[n_seqs] > cap_rows) return -counts[n_seqs];
-    run(fill_range);
+    run_sharded(n_seqs, n_threads, fill_range);
     return counts[n_seqs];
 }
 
