@@ -54,10 +54,16 @@ class Evaluation:
     """Multiclass classification metrics (ref: eval/Evaluation.java)."""
 
     def __init__(self, num_classes: Optional[int] = None,
-                 labels: Optional[List[str]] = None):
+                 labels: Optional[List[str]] = None, top_n: int = 1):
         self.label_names = labels
         self.num_classes = num_classes or (len(labels) if labels else None)
         self.confusion: Optional[ConfusionMatrix] = None
+        # top-N accuracy (ref: Evaluation(List, int) constructor :130-138;
+        # an example counts correct when the true class probability is
+        # among the N highest outputs, :440-450)
+        self.top_n = max(1, int(top_n))
+        self.top_n_correct_count = 0
+        self.top_n_total_count = 0
 
     def _ensure(self, n):
         if self.confusion is None:
@@ -76,7 +82,15 @@ class Evaluation:
         if mask is not None:
             keep = np.asarray(mask).astype(bool).reshape(-1)
             actual, pred = actual[keep], pred[keep]
+            predictions = predictions[keep]
         np.add.at(self.confusion.matrix, (actual, pred), 1)
+        if self.top_n > 1:
+            n = min(self.top_n, predictions.shape[-1])
+            # true-class prob among the n highest (ref eval :440-450)
+            topn = np.argpartition(-predictions, n - 1, axis=-1)[..., :n]
+            self.top_n_correct_count += int(
+                (topn == actual[..., None]).any(axis=-1).sum())
+            self.top_n_total_count += int(actual.size)
 
     # ---- metrics ----
     def _tp(self, c):
@@ -92,6 +106,16 @@ class Evaluation:
         m = self.confusion.matrix
         total = m.sum()
         return float(np.trace(m)) / total if total else 0.0
+
+    def top_n_accuracy(self) -> float:
+        """Fraction of examples whose true class is among the top_n
+        highest-probability outputs (ref: topNAccuracy :1156-1161;
+        equals accuracy() when top_n == 1)."""
+        if self.top_n <= 1:
+            return self.accuracy()
+        if not self.top_n_total_count:
+            return 0.0
+        return self.top_n_correct_count / self.top_n_total_count
 
     def precision(self, cls: Optional[int] = None) -> float:
         if cls is not None:
@@ -129,11 +153,14 @@ class Evaluation:
         name = lambda c: (self.label_names[c] if self.label_names else str(c))
         lines = ["", "========================Evaluation Metrics========================",
                  f" # of classes:    {self.num_classes}",
-                 f" Accuracy:        {self.accuracy():.4f}",
-                 f" Precision:       {self.precision():.4f}",
-                 f" Recall:          {self.recall():.4f}",
-                 f" F1 Score:        {self.f1():.4f}",
-                 "", "=========================Confusion Matrix=========================="]
+                 f" Accuracy:        {self.accuracy():.4f}"]
+        if self.top_n > 1:  # ref stats :560-567
+            lines.append(f" Top {self.top_n} Accuracy:  "
+                         f"{self.top_n_accuracy():.4f}")
+        lines += [f" Precision:       {self.precision():.4f}",
+                  f" Recall:          {self.recall():.4f}",
+                  f" F1 Score:        {self.f1():.4f}",
+                  "", "=========================Confusion Matrix=========================="]
         lines.append(str(self.confusion))
         lines.append("==================================================================")
         return "\n".join(lines)

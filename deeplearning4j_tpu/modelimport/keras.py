@@ -140,17 +140,28 @@ class KerasLayerMapper:
         mode = "same" if c.get("padding", c.get("border_mode")) == "same" \
             else "truncate"
         n_out = int(c.get("filters", c.get("nb_filter")))
+        # Keras 2 "dilation_rate" / Keras 1 atrous "atrous_rate"
+        # (ref: KerasConvolutionUtils.getDilationRate, field names
+        # Keras2LayerConfiguration:72 / Keras1LayerConfiguration:73)
+        d = _pair(c.get("dilation_rate", c.get("atrous_rate", (1, 1))))
         return L.ConvolutionLayer(n_out=n_out, kernel=k, stride=s,
-                                  padding=(0, 0), convolution_mode=mode,
+                                  padding=(0, 0), dilation=d,
+                                  convolution_mode=mode,
                                   activation=_act(c.get("activation", "linear")),
                                   has_bias=c.get("use_bias", True),
                                   name=c.get("name"))
 
     _map_convolution2d = _map_conv2d
+    # Keras 1 AtrousConvolution2D: a Convolution2D whose dilation comes
+    # from "atrous_rate" (ref: KerasAtrousConvolution2D.java:44-138)
+    _map_atrousconvolution2d = _map_conv2d
 
     def _map_conv1d(self, c):
         mode = "same" if c.get("padding", c.get("border_mode")) == "same" \
             else "truncate"
+        d = c.get("dilation_rate", c.get("atrous_rate", 1))
+        if isinstance(d, (list, tuple)):
+            d = d[0]
         return L.Convolution1DLayer(
             n_out=int(c.get("filters", c.get("nb_filter"))),
             kernel=int(c["kernel_size"][0] if isinstance(c.get("kernel_size"),
@@ -159,11 +170,14 @@ class KerasLayerMapper:
             stride=int((c.get("strides") or [1])[0]
                        if isinstance(c.get("strides"), (list, tuple))
                        else c.get("strides", c.get("subsample_length", 1))),
+            dilation=int(d),
             convolution_mode=mode,
             activation=_act(c.get("activation", "linear")),
             name=c.get("name"))
 
     _map_convolution1d = _map_conv1d
+    # Keras 1 AtrousConvolution1D (ref: KerasAtrousConvolution1D.java)
+    _map_atrousconvolution1d = _map_conv1d
 
     def _map_maxpooling2d(self, c):
         k = _pair(c.get("pool_size", (2, 2)))

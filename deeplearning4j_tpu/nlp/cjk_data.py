@@ -5,7 +5,9 @@ The reference's CJK analyzers ship multi-megabyte system dictionaries
 deeplearning4j-nlp-chinese the ansj/jieba tables) — most of their 19.6k
 LoC + resources is dictionary data. This module is the zero-egress
 counterpart: a hand-curated core-vocabulary dictionary (~700 Chinese
-words with relative frequencies, ~350 Japanese entries with POS) that
+words with relative frequencies, ~840 Japanese entries with POS — the
+round-3 expansion generates frequency-weighted conjugated surfaces for a
+curated verb list, the stand-in for IPADIC's per-surface costs) that
 makes `ChineseTokenizerFactory(dictionary="builtin")` /
 `JapaneseTokenizerFactory(dictionary="builtin")` segment everyday text
 sensibly out of the box. It is deliberately small: domain text should
@@ -41,6 +43,30 @@ _ZH_BUCKETS = (
     (800, "增加 减少 提高 降低 改变 改革 开放 发达 先进 落后 成功 失败 胜利 解决 决定 选择 准备 参加 组织 举行"),
     (600, "数学 物理 化学 生物 语文 英语 汉语 外语 历史课 地理 体育 艺术 哲学 法律 政治 军事 宗教 环境 资源 能源"),
     (500, "苹果 香蕉 西瓜 牛奶 面包 米饭 面条 饺子 茶叶 咖啡 啤酒 蔬菜 水果 鸡蛋 牛肉 羊肉 鱼肉 糖果 蛋糕 早饭"),
+    # numbers / measure words / ordinals
+    (15000, "一 二 三 四 五 六 七 八 九 十 百 千 万 亿 零 两 第一 第二 第三 几个"),
+    (8000, "一些 一样 一起 一直 一切 一般 一点 一下 不如 一方面 有些 有的 有人 有点 许多 多少 不少 大家 大多 各种"),
+    (6000, "块 条 张 只 件 位 名 本 辆 台 层 间 套 双 对 群 批 份 页 篇"),
+    # verbs round 2
+    (9000, "打开 关闭 打电话 发送 接受 接收 收到 回答 回复 离开 到达 经历 继续 停止 完成 实现 保持 保护 支持 反对"),
+    (7000, "帮助 介绍 解释 讨论 交流 合作 竞争 顺便 检查 测试 训练 练习 记住 忘记 想起 相信 怀疑 同意 拒绝 邀请"),
+    (5000, "安排 计划 设计 建立 创造 创新 改善 扩大 缩小 加强 减轻 推动 促进 引起 导致 造成 形成 产生 消失 存在"),
+    # nouns round 2: society / economy / daily life
+    (6000, "价格 价值 质量 数量 收入 支出 利润 成本 投资 贸易 工业 农业 商业 企业 产品 项目 方案 合同 会议 报告"),
+    (5000, "政策 法规 制度 机构 部门 单位 职业 工资 经验 知识 理论 实践 观点 态度 思想 精神 传统 习惯 风俗 礼物"),
+    (4000, "房子 房间 厨房 卧室 客厅 桌子 椅子 窗户 门口 钥匙 衣服 裤子 鞋子 帽子 眼镜 手机 手表 钱包 行李 箱子"),
+    (3500, "身体 头发 眼睛 耳朵 鼻子 嘴巴 手指 肚子 心脏 健康 疾病 感冒 发烧 药品 治疗 调查 锻炼 营养 休息 睡眠"),
+    (3000, "道路 街道 桥梁 公园 广场 商店 超市 商场 邮局 图书馆 博物馆 餐厅 厕所 车站 机场 码头 宾馆 教室 办公室 工厂"),
+    # adjectives / adverbs round 2
+    (4000, "新鲜 成熟 年轻 年老 聪明 愚蠢 勇敢 胆小 诚实 虚假 认真 马虎 积极 消极 主动 被动 正式 随便 严格 宽松"),
+    (3000, "突然 立刻 马上 渐渐 慢慢 终于 果然 居然 竟然 似乎 好像 仿佛 确实 的确 明显 显然 毕竟 究竟 到底 反而"),
+    # geography / nature / science
+    (2500, "地球 月亮 太阳 星星 宇宙 空气 温度 气候 森林 沙漠 草原 湖泊 河流 海洋 岛屿 大陆 山脉 平原 土地 石头"),
+    (2000, "植物 动物 鸟类 昆虫 老虎 狮子 大象 猴子 熊猫 兔子 鸡 鸭 猪 马 牛 羊 狗 猫 鱼 虫"),
+    (1800, "电力 石油 煤炭 钢铁 机器 设备 工具 材料 零件 发动机 程序 软件 硬件 数据 文件 密码 账号 邮件 网站 屏幕"),
+    # idioms / fixed expressions (lattice stress cases)
+    (1200, "实事求是 乱七八糟 马马虎虎 认认真真 自言自语 无所谓 不好意思 没关系 对不起 谢谢 再见 欢迎 请问 麻烦 打扰 辛苦 恭喜 加油 小心 注意"),
+    (1000, "越来越多 越来越好 不得不 忍不住 来不及 算了 受不了 了不起 差一点 好不容易 说不定 怪不得 恨不得 巴不得 大不了 看不起 想不到 舍不得 用不着 免不了"),
 )
 
 ZH_FREQ = {}
@@ -81,7 +107,112 @@ _JA_BUCKETS = (
      "コーヒー テレビ パソコン スマホ インターネット ニュース ホテル レストラン バス タクシー カメラ ゲーム スポーツ サッカー テニス"),
 )
 
+_JA_EXTRA_BUCKETS = (
+    # counters / numbers
+    (12000, "名詞", "一 二 三 四 五 六 七 八 九 十 百 千 万 一つ 二つ 三つ 一人 二人 三人 一番"),
+    (5000, "名詞", "一日 二日 今週 来週 先週 今月 来月 先月 半分 全部 最初 最後 次 前 後 上 下 中 外 間"),
+    # nouns round 2
+    (4000, "名詞",
+     "病院 銀行 郵便局 図書館 公園 空港 道 橋 町 村 市 県 国際 社会 経済 政治 文化 歴史 科学 技術"),
+    (3500, "名詞",
+     "情報 番組 新聞 雑誌 辞書 教科書 宿題 授業 教室 黒板 机 椅子 鞄 傘 眼鏡 靴 服 帽子 切符 荷物"),
+    (3000, "名詞",
+     "体 頭 顔 目 耳 口 手 足 声 心 病気 薬 熱 風邪 医者 看護師 運動 散歩 休み 夢"),
+    (2500, "名詞",
+     "果物 野菜 魚 肉 卵 パン 米 酒 茶 塩 砂糖 味 朝食 昼食 夕食 弁当 箸 皿 台所 冷蔵庫"),
+    # adverbs / conjunctions round 2
+    (6000, "副詞", "そして しかし でも だから それで それから つまり 例えば もし たとえ きっと 必ず 絶対 やっと ついに ほとんど かなり ずっと やはり やっぱり"),
+    (4000, "副詞", "ゆっくり はっきり しっかり ちょっと ちゃんと なかなか そろそろ だんだん どんどん いろいろ 特に 実は 最近 先に 後で 初めて 久しぶり 突然 急に 自然に"),
+)
+
 JA_ENTRIES = {}
-for _f, _pos, _words in _JA_BUCKETS:
+for _f, _pos, _words in _JA_BUCKETS + _JA_EXTRA_BUCKETS:
     for _w in _words.split():
         JA_ENTRIES.setdefault(_w, (_f, _pos))
+
+
+# --- Japanese verb conjugation surfaces (frequency-weighted) -----------
+#
+# The kuromoji/IPADIC system dictionary lists every conjugated surface of
+# every verb with per-surface costs; the zero-egress counterpart GENERATES
+# the common surfaces for a curated verb list. Frequencies decay per form
+# (dictionary form > polite > past > te-form > negative > volitional...),
+# mirroring the corpus frequency ordering the IPADIC costs encode.
+
+#: (dictionary form, relative frequency, stem kind): "godan" consonant
+#: stem verbs keyed by final kana row, "ichidan" vowel-stem verbs
+_JA_VERBS = (
+    ("行く", 10000, "godan"), ("書く", 5000, "godan"), ("聞く", 5000, "godan"),
+    ("歩く", 3000, "godan"), ("働く", 3500, "godan"), ("泳ぐ", 1500, "godan"),
+    ("話す", 5000, "godan"), ("出す", 4000, "godan"), ("貸す", 1500, "godan"),
+    ("待つ", 3500, "godan"), ("持つ", 4500, "godan"), ("立つ", 2500, "godan"),
+    ("死ぬ", 1200, "godan"),
+    ("遊ぶ", 2000, "godan"), ("呼ぶ", 2000, "godan"), ("飛ぶ", 1500, "godan"),
+    ("読む", 4000, "godan"), ("飲む", 4000, "godan"), ("住む", 3000, "godan"),
+    ("休む", 2500, "godan"),
+    ("買う", 4500, "godan"), ("会う", 4000, "godan"), ("使う", 4000, "godan"),
+    ("思う", 8000, "godan"), ("言う", 8000, "godan"), ("習う", 1500, "godan"),
+    ("帰る", 3500, "godan"), ("入る", 3500, "godan"), ("分かる", 6000, "godan"),
+    ("作る", 4000, "godan"), ("送る", 2500, "godan"), ("乗る", 2500, "godan"),
+    ("座る", 1500, "godan"), ("走る", 2000, "godan"), ("知る", 5000, "godan"),
+    ("食べる", 5000, "ichidan"), ("見る", 6000, "ichidan"),
+    ("寝る", 3000, "ichidan"), ("起きる", 3000, "ichidan"),
+    ("出る", 4000, "ichidan"), ("着る", 2000, "ichidan"),
+    ("教える", 3000, "ichidan"), ("覚える", 2500, "ichidan"),
+    ("忘れる", 2500, "ichidan"), ("借りる", 1500, "ichidan"),
+    ("開ける", 2000, "ichidan"), ("閉める", 1500, "ichidan"),
+    ("始める", 2500, "ichidan"), ("続ける", 2000, "ichidan"),
+)
+
+#: godan final-kana -> (masu-stem kana, te/ta sound change, negative kana)
+_GODAN_ROWS = {
+    "く": ("き", ("いて", "いた"), "か"), "ぐ": ("ぎ", ("いで", "いだ"), "が"),
+    "す": ("し", ("して", "した"), "さ"), "つ": ("ち", ("って", "った"), "た"),
+    "ぬ": ("に", ("んで", "んだ"), "な"), "ぶ": ("び", ("んで", "んだ"), "ば"),
+    "む": ("み", ("んで", "んだ"), "ま"), "う": ("い", ("って", "った"), "わ"),
+    "る": ("り", ("って", "った"), "ら"),
+}
+
+#: per-form frequency multipliers (×1000): dictionary form dominates,
+#: polite/past next, rarer moods tail off
+_FORM_WEIGHTS = {
+    "dict": 1.0, "masu": 0.6, "mashita": 0.45, "te": 0.55, "ta": 0.5,
+    "nai": 0.4, "nakatta": 0.2, "masen": 0.25, "tai": 0.3,
+}
+
+
+def _conjugate(dict_form: str, kind: str):
+    """Common conjugated surfaces of one verb -> {surface: form_key}."""
+    out = {dict_form: "dict"}
+    if kind == "ichidan":
+        stem = dict_form[:-1]                      # drop る
+        out[stem + "ます"] = "masu"
+        out[stem + "ました"] = "mashita"
+        out[stem + "ません"] = "masen"
+        out[stem + "て"] = "te"
+        out[stem + "た"] = "ta"
+        out[stem + "ない"] = "nai"
+        out[stem + "なかった"] = "nakatta"
+        out[stem + "たい"] = "tai"
+        return out
+    base, last = dict_form[:-1], dict_form[-1]
+    masu_k, (te, ta), neg_k = _GODAN_ROWS[last]
+    # 行く is the te/ta irregular: 行って/行った
+    if dict_form == "行く":
+        te, ta = "って", "った"
+    out[base + masu_k + "ます"] = "masu"
+    out[base + masu_k + "ました"] = "mashita"
+    out[base + masu_k + "ません"] = "masen"
+    out[base + te] = "te"
+    out[base + ta] = "ta"
+    out[base + neg_k + "ない"] = "nai"
+    out[base + neg_k + "なかった"] = "nakatta"
+    out[base + masu_k + "たい"] = "tai"
+    return out
+
+
+for _dict_form, _freq, _kind in _JA_VERBS:
+    for _surface, _form in _conjugate(_dict_form, _kind).items():
+        _f = max(100, int(_freq * _FORM_WEIGHTS[_form]))
+        if _surface not in JA_ENTRIES or JA_ENTRIES[_surface][0] < _f:
+            JA_ENTRIES[_surface] = (_f, "動詞")

@@ -40,7 +40,12 @@ class ParallelInference:
     """
 
     def __init__(self, model, mesh=None, max_batch_size: int = 64,
-                 queue_limit: int = 64, batch_timeout_ms: float = 2.0):
+                 queue_limit: int = 64, batch_timeout_ms: float = 2.0,
+                 inference_mode: str = "batched"):
+        if inference_mode not in ("batched", "sequential"):
+            raise ValueError(
+                f"inference_mode must be 'batched' or 'sequential', got "
+                f"{inference_mode!r} (ref: ParallelInference.InferenceMode)")
         self.model = model
         if not model._initialized:
             model.init()
@@ -48,10 +53,21 @@ class ParallelInference:
         self.n_devices = int(np.prod(self.mesh.devices.shape))
         self.max_batch_size = max_batch_size
         self.batch_timeout = batch_timeout_ms / 1000.0
-        self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=queue_limit)
+        self.inference_mode = inference_mode
         self._shutdown = False
-        self._worker = threading.Thread(target=self._serve_loop, daemon=True)
-        self._worker.start()
+        if inference_mode == "batched":
+            self._queue: "queue.Queue[_Request]" = \
+                queue.Queue(maxsize=queue_limit)
+            self._worker = threading.Thread(target=self._serve_loop,
+                                            daemon=True)
+            self._worker.start()
+        else:
+            # SEQUENTIAL (ParallelInference.java:136-216): each request
+            # runs immediately, one at a time — no coalescing window, so
+            # single-stream latency is one dispatch, not dispatch+timeout
+            self._queue = None
+            self._worker = None
+            self._seq_lock = threading.Lock()
 
     # ------------------------------------------------------------------
     def _run_batch(self, x: np.ndarray):
@@ -97,9 +113,13 @@ class ParallelInference:
 
     # ------------------------------------------------------------------
     def output(self, x) -> np.ndarray:
-        """Synchronous inference through the batching queue
+        """Synchronous inference through the batching queue, or immediate
+        one-at-a-time execution in SEQUENTIAL mode
         (ref: ParallelInference.output :97-121)."""
         x = np.asarray(x)
+        if self.inference_mode == "sequential":
+            with self._seq_lock:
+                return self._run_batch(x)
         req = _Request(x)
         self._queue.put(req)
         req.event.wait()

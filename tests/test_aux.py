@@ -229,3 +229,42 @@ class TestMemoryReportShapes:
         rep = get_memory_report(net)
         names = [r.layer_name for r in rep.layer_reports]
         assert names == [str(i) for i in range(12)]
+
+
+class TestTopNAccuracy:
+    """Evaluation(top_n=...) (ref: Evaluation.java:76-138 constructor,
+    :440-450 counting, topNAccuracy :1156)."""
+
+    def test_hand_computed(self):
+        from deeplearning4j_tpu.eval import Evaluation
+        ev = Evaluation(num_classes=4, top_n=2)
+        labels = np.eye(4, dtype=np.float32)[[0, 1, 2, 3]]
+        preds = np.array([
+            [0.6, 0.3, 0.05, 0.05],   # true 0: rank 1 -> top1 & top2
+            [0.5, 0.4, 0.05, 0.05],   # true 1: rank 2 -> top2 only
+            [0.4, 0.3, 0.2, 0.1],     # true 2: rank 3 -> neither
+            [0.1, 0.2, 0.3, 0.4],     # true 3: rank 1 -> both
+        ], np.float32)
+        ev.eval(labels, preds)
+        assert ev.accuracy() == 0.5              # rows 0 and 3
+        assert ev.top_n_accuracy() == 0.75       # rows 0, 1, 3
+        assert "Top 2 Accuracy" in ev.stats()
+
+    def test_top1_equals_accuracy(self):
+        from deeplearning4j_tpu.eval import Evaluation
+        rng = np.random.default_rng(3)
+        ev = Evaluation(num_classes=5)
+        labels = np.eye(5, dtype=np.float32)[rng.integers(0, 5, 40)]
+        preds = rng.random((40, 5)).astype(np.float32)
+        ev.eval(labels, preds)
+        assert ev.top_n_accuracy() == ev.accuracy()
+        assert "Top" not in ev.stats().split("Accuracy")[0]
+
+    def test_masked_rows_excluded(self):
+        from deeplearning4j_tpu.eval import Evaluation
+        ev = Evaluation(num_classes=3, top_n=2)
+        labels = np.eye(3, dtype=np.float32)[[0, 1]]
+        preds = np.array([[0.5, 0.4, 0.1], [0.0, 0.1, 0.9]], np.float32)
+        ev.eval(labels, preds, mask=np.array([1.0, 0.0]))
+        assert ev.top_n_total_count == 1
+        assert ev.top_n_accuracy() == 1.0

@@ -555,3 +555,70 @@ class TestLayerNormalizationImport:
                                                np.ones(4, np.float32))]})
             with pytest.raises(ValueError, match="axes"):
                 KerasModelImport.import_keras_model_and_weights(path)
+
+
+class TestAtrousConvolution:
+    """Keras 1 AtrousConvolution1D/2D + Keras 2 dilation_rate mapping
+    (ref: KerasAtrousConvolution2D.java:44-138, dilation field names
+    Keras1LayerConfiguration:73 'atrous_rate' / Keras2:72 'dilation_rate')."""
+
+    def _dilated_ref(self, x_nhwc, k, kb, rate):
+        """numpy dilated conv (valid padding): insert rate-1 zeros between
+        kernel taps."""
+        kh, kw, ci, co = k.shape
+        dk_h = (kh - 1) * rate + 1
+        dk_w = (kw - 1) * rate + 1
+        kd = np.zeros((dk_h, dk_w, ci, co), k.dtype)
+        kd[::rate, ::rate] = k
+        return conv2d_nhwc(x_nhwc, kd, kb)
+
+    @pytest.mark.parametrize("cls,field", [
+        ("AtrousConvolution2D", "atrous_rate"),   # Keras 1
+        ("Conv2D", "dilation_rate"),              # Keras 2
+    ])
+    def test_dilated_conv2d_import(self, cls, field):
+        rate = 2
+        k = RNG.standard_normal((3, 3, 2, 4)).astype(np.float32)
+        kb = RNG.standard_normal(4).astype(np.float32)
+        conf = {"name": "c1", "filters": 4, "kernel_size": [3, 3],
+                "strides": [1, 1], "padding": "valid",
+                "activation": "linear", "use_bias": True,
+                "batch_input_shape": [None, 8, 8, 2], field: [rate, rate]}
+        if cls == "AtrousConvolution2D":
+            # Keras 1 spelling of the shape fields
+            conf.pop("filters"), conf.pop("kernel_size")
+            conf.update(nb_filter=4, nb_row=3, nb_col=3)
+        cfg = seq_config([{"class_name": cls, "config": conf}])
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "atrous.h5")
+            write_keras_h5(path, cfg, {
+                "c1": [("kernel:0", k), ("bias:0", kb)]})
+            net = KerasModelImport.import_keras_sequential_model_and_weights(
+                path)
+        assert tuple(net.conf.layers[0].dilation) == (rate, rate)
+        x_nhwc = RNG.standard_normal((2, 8, 8, 2)).astype(np.float32)
+        ref = self._dilated_ref(x_nhwc, k, kb, rate)
+        got = np.asarray(net.output(np.transpose(x_nhwc, (0, 3, 1, 2))))
+        np.testing.assert_allclose(got, np.transpose(ref, (0, 3, 1, 2)),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_atrous_conv1d_maps_dilation(self):
+        cfg = seq_config([
+            {"class_name": "AtrousConvolution1D",
+             "config": {"name": "c1", "nb_filter": 3, "filter_length": 3,
+                        "atrous_rate": 2, "activation": "linear",
+                        "use_bias": True,
+                        "batch_input_shape": [None, 12, 2]}}])
+        k = RNG.standard_normal((3, 2, 3)).astype(np.float32)  # [w, in, out]
+        kb = np.zeros(3, np.float32)
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "a1d.h5")
+            write_keras_h5(path, cfg, {
+                "c1": [("kernel:0", k), ("bias:0", kb)]})
+            net = KerasModelImport.import_keras_sequential_model_and_weights(
+                path)
+        assert int(net.conf.layers[0].dilation) == 2
+        x = RNG.standard_normal((2, 2, 12)).astype(np.float32)  # [N,C,T]
+        out = np.asarray(net.output(x))
+        # valid conv with dilation 2 over T=12, k=3: T_out = 12-(3-1)*2 = 8
+        assert out.shape == (2, 3, 8)

@@ -195,3 +195,43 @@ class TestDistributedBackend:
         single.fit(x, y, epochs=2, batch_size=16)
         np.testing.assert_allclose(out_mesh, np.asarray(single.output(x)),
                                    atol=1e-5)
+
+
+class TestParallelInferenceSequential:
+    """InferenceMode.SEQUENTIAL (ref: ParallelInference.java:136-216):
+    requests run immediately one at a time — no batching window."""
+
+    def test_matches_direct_output(self):
+        net = make_net()
+        pi = ParallelInference(net, inference_mode="sequential")
+        x, _ = data(20)
+        np.testing.assert_allclose(pi.output(x),
+                                   np.asarray(net.output(x)), rtol=1e-5)
+        pi.shutdown()
+
+    def test_concurrent_requests_serialize(self):
+        import threading
+        net = make_net()
+        pi = ParallelInference(net, inference_mode="sequential")
+        x, _ = data(40)
+        results = {}
+
+        def worker(i):
+            results[i] = pi.output(x[i * 4:(i + 1) * 4])
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(10)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        direct = np.asarray(net.output(x))
+        for i in range(10):
+            np.testing.assert_allclose(results[i], direct[i * 4:(i + 1) * 4],
+                                       rtol=1e-5)
+        pi.shutdown()
+
+    def test_invalid_mode_rejected(self):
+        net = make_net()
+        with pytest.raises(ValueError, match="inference_mode"):
+            ParallelInference(net, inference_mode="bogus")
