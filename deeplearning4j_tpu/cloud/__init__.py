@@ -1,0 +1,6 @@
+"""Cloud provisioning glue (ref: deeplearning4j-aws — EC2 ClusterSetup,
+HostProvisioner, S3 uploader/downloader — as TPU-VM/gcloud equivalents)."""
+
+from deeplearning4j_tpu.cloud.provision import (  # noqa: F401
+    ClusterSetup, GcsTransfer, TpuClusterSpec, workers_for,
+)

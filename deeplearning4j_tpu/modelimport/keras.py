@@ -159,9 +159,7 @@ class KerasLayerMapper:
     def _map_conv1d(self, c):
         mode = "same" if c.get("padding", c.get("border_mode")) == "same" \
             else "truncate"
-        d = c.get("dilation_rate", c.get("atrous_rate", 1))
-        if isinstance(d, (list, tuple)):
-            d = d[0]
+        d = _pair(c.get("dilation_rate", c.get("atrous_rate", 1)))[0]
         return L.Convolution1DLayer(
             n_out=int(c.get("filters", c.get("nb_filter"))),
             kernel=int(c["kernel_size"][0] if isinstance(c.get("kernel_size"),

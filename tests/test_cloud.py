@@ -1,0 +1,126 @@
+"""Cloud provisioning glue tests (ref: deeplearning4j-aws ClusterSetup /
+HostProvisioner / S3 up/downloader). Everything runs against a recording
+fake runner — zero credentials, zero egress — asserting the exact command
+plans and the jax.distributed env wiring."""
+
+import pytest
+
+from deeplearning4j_tpu.cloud import (
+    ClusterSetup, GcsTransfer, TpuClusterSpec, workers_for,
+)
+
+
+class Recorder:
+    def __init__(self):
+        self.cmds = []
+
+    def __call__(self, cmd):
+        self.cmds.append(cmd)
+        return None
+
+
+class TestSpec:
+    def test_worker_counts(self):
+        assert workers_for("v5litepod-8") == 1
+        assert workers_for("v5litepod-32") == 4
+        assert workers_for("v4-64") == 8
+        with pytest.raises(ValueError, match="accelerator"):
+            workers_for("tpu9000")
+
+    def test_spec_workers(self):
+        assert TpuClusterSpec("t", accelerator_type="v5litepod-64") \
+            .num_workers == 8
+
+
+class TestClusterSetup:
+    def _setup(self, n_type="v5litepod-32"):
+        rec = Recorder()
+        cs = ClusterSetup(TpuClusterSpec("train1", zone="us-east5-b",
+                                         accelerator_type=n_type),
+                          runner=rec)
+        return cs, rec
+
+    def test_create_plan(self):
+        cs, _ = self._setup()
+        (cmd,) = cs.create_commands()
+        assert cmd[:6] == ["gcloud", "compute", "tpus", "tpu-vm",
+                           "create", "train1"]
+        assert "--zone=us-east5-b" in cmd
+        assert "--accelerator-type=v5litepod-32" in cmd
+
+    def test_preemptible_and_network_flags(self):
+        cs = ClusterSetup(TpuClusterSpec("t", preemptible=True,
+                                         network="my-vpc"))
+        (cmd,) = cs.create_commands()
+        assert "--preemptible" in cmd and "--network=my-vpc" in cmd
+
+    def test_provision_targets_every_worker(self):
+        cs, _ = self._setup()  # 4 workers
+        cmds = cs.provision_commands("./pkg")
+        assert len(cmds) == 4
+        assert {c[-1] for c in cmds} == {f"--worker={w}" for w in range(4)}
+        assert all("scp" in c for c in cmds)
+
+    def test_worker_env_is_jax_distributed_contract(self):
+        """The launch env must be exactly what
+        parallel/distributed.initialize() consumes."""
+        cs, _ = self._setup()
+        env = cs.worker_env(2, "10.0.0.5")
+        assert env == {"JAX_COORDINATOR_ADDRESS": "10.0.0.5:8476",
+                       "JAX_NUM_PROCESSES": "4",
+                       "JAX_PROCESS_ID": "2"}
+        with pytest.raises(ValueError, match="out of range"):
+            cs.worker_env(4, "10.0.0.5")
+
+    def test_run_commands_spmd(self):
+        cs, _ = self._setup()
+        cmds = cs.run_commands("python train.py", coordinator_host="10.1.2.3")
+        assert len(cmds) == 4
+        for w, cmd in enumerate(cmds):
+            assert f"--worker={w}" in cmd
+            launch = cmd[-1]
+            assert launch.endswith("python train.py")  # same SPMD command
+            assert f"JAX_PROCESS_ID={w}" in launch
+            assert "JAX_COORDINATOR_ADDRESS=10.1.2.3:8476" in launch
+            assert "JAX_NUM_PROCESSES=4" in launch
+
+    def test_run_requires_explicit_coordinator_or_auto(self):
+        cs, _ = self._setup()
+        with pytest.raises(ValueError, match="coordinator_host"):
+            cs.run_commands("python train.py")
+        with pytest.raises(ValueError, match="not both"):
+            cs.run_commands("python train.py", coordinator_host="10.0.0.1",
+                            auto_init=True)
+        # auto_init: no JAX_* env - jax discovers via TPU-VM metadata
+        cmds = cs.run_commands("python train.py", auto_init=True)
+        assert all(c[-1] == "--command=python train.py" for c in cmds)
+
+    def test_exec_runs_full_plan_through_runner(self):
+        cs, rec = self._setup()
+        cs.exec(package_path="./pkg", setup_script="pip install -e .",
+                train_command="python train.py")
+        # create + 4 scp + 1 setup + 4 run
+        assert len(rec.cmds) == 1 + 4 + 1 + 4
+        assert rec.cmds[0][4] == "create"
+        cs.teardown()
+        assert rec.cmds[-1][4] == "delete"
+
+    def test_default_runner_fails_cleanly_without_gcloud(self, monkeypatch):
+        import shutil as _sh
+        monkeypatch.setattr(_sh, "which", lambda _: None)
+        cs = ClusterSetup(TpuClusterSpec("t"))
+        with pytest.raises(RuntimeError, match="Cloud SDK"):
+            cs.exec()
+
+
+class TestGcsTransfer:
+    def test_plans_and_validation(self):
+        rec = Recorder()
+        t = GcsTransfer(runner=rec)
+        t.upload("./data", "gs://bucket/data")
+        t.download("gs://bucket/ckpt", "./ckpt")
+        assert rec.cmds[0] == ["gcloud", "storage", "cp", "--recursive",
+                               "./data", "gs://bucket/data"]
+        assert rec.cmds[1][-2:] == ["gs://bucket/ckpt", "./ckpt"]
+        with pytest.raises(ValueError, match="gs://"):
+            t.upload("./x", "s3://nope")
