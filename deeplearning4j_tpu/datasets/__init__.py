@@ -15,6 +15,7 @@ from deeplearning4j_tpu.datasets.normalizers import (  # noqa: F401
     ImagePreProcessingScaler,
     NormalizerMinMaxScaler,
     NormalizerStandardize,
+    VGG16ImagePreProcessor,
     normalizer_from_dict,
 )
 from deeplearning4j_tpu.datasets.formatter import (  # noqa: F401

@@ -48,6 +48,14 @@ def _bshape(x: np.ndarray, v: np.ndarray):
     return v.reshape(shape)
 
 
+
+def _is_u8_nhwc(x: np.ndarray) -> bool:
+    """Unambiguous uint8 NHWC decode-order batch? (channel-minor count in
+    {1,3,4} while axis 1 is clearly spatial)."""
+    return (x.dtype == np.uint8 and x.ndim == 4
+            and x.shape[-1] in (1, 3, 4) and x.shape[1] not in (1, 3, 4))
+
+
 class _BaseNormalizer:
     """fit / transform / revert protocol (ref: DataNormalization)."""
 
@@ -258,8 +266,7 @@ class ImagePreProcessingScaler(_BaseNormalizer):
             return data
         x = np.asarray(data)
         scale = (self.hi - self.lo) / self.max_pixel
-        if x.dtype == np.uint8 and x.ndim == 4 and \
-                x.shape[-1] in (1, 3, 4) and x.shape[1] not in (1, 3, 4):
+        if _is_u8_nhwc(x):
             # unambiguous NHWC decode order -> fused native pack to NCHW
             from deeplearning4j_tpu.native.image import u8hwc_to_f32chw
             out = u8hwc_to_f32chw(x, scale=scale)
@@ -280,3 +287,71 @@ class ImagePreProcessingScaler(_BaseNormalizer):
     def _build(cls, d):
         return cls(d.get("lo", 0.0), d.get("hi", 1.0),
                    d.get("maxPixel", 255.0))
+
+
+@register_normalizer
+class VGG16ImagePreProcessor(_BaseNormalizer):
+    """Mean-subtraction preprocessing for the ImageNet VGG nets
+    (ref: org.nd4j.linalg.dataset.api.preprocessor.VGG16ImagePreProcessor,
+    used by the zoo VGG16/VGG19): subtract the ImageNet per-channel RGB
+    means — no scaling to [0,1]. Stateless (fit is a no-op). Accepts
+    3-channel images only: float NCHW [N,3,H,W], a single [3,H,W] image,
+    or uint8 NHWC decode order [N,H,W,3] (packed + subtracted in one
+    fused native pass). Output is NCHW float32."""
+
+    #: ImageNet training-set channel means, RGB order (the reference's
+    #: VGG_MEAN_OFFSET values)
+    RGB_MEANS = (123.68, 116.779, 103.939)
+
+    def _fit_arrays(self, x, y):
+        pass
+
+    def _check_rgb(self, x: np.ndarray, axis: int) -> None:
+        if x.shape[axis] != 3:
+            raise ValueError(
+                "VGG16ImagePreProcessor expects 3 RGB channels, got "
+                f"shape {x.shape} (channel axis {axis})")
+
+    def transform(self, data):
+        if isinstance(data, DataSet):
+            data.features = self.transform(np.asarray(data.features))
+            return data
+        x = np.asarray(data)
+        means = np.asarray(self.RGB_MEANS, np.float32)
+        if _is_u8_nhwc(x):
+            self._check_rgb(x, 3)
+            from deeplearning4j_tpu.native.image import u8hwc_to_f32chw
+            # one fused pass: u8 NHWC -> f32 NCHW with the mean folded in
+            return u8hwc_to_f32chw(x, scale=1.0, mean=means)
+        x = x.astype(np.float32)
+        if x.ndim == 4:                        # NCHW batch
+            self._check_rgb(x, 1)
+            return x - means[None, :, None, None]
+        if x.ndim == 3:                        # single CHW image
+            self._check_rgb(x, 0)
+            return x - means[:, None, None]
+        raise ValueError(
+            f"VGG16ImagePreProcessor expects image input, got rank "
+            f"{x.ndim} shape {x.shape}")
+
+    preprocess = transform
+
+    def revert_features(self, x) -> np.ndarray:
+        x = np.asarray(x, np.float32)
+        means = np.asarray(self.RGB_MEANS, np.float32)
+        if x.ndim == 4:
+            self._check_rgb(x, 1)
+            return x + means[None, :, None, None]
+        if x.ndim == 3:
+            self._check_rgb(x, 0)
+            return x + means[:, None, None]
+        raise ValueError(
+            f"VGG16ImagePreProcessor expects image input, got rank "
+            f"{x.ndim} shape {x.shape}")
+
+    def _stats_dict(self):
+        return {}
+
+    @classmethod
+    def _build(cls, d):
+        return cls()

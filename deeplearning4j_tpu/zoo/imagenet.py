@@ -1,0 +1,103 @@
+"""ImageNet label helper (ref: zoo/util/imagenet/ImageNetLabels.java).
+
+The reference fetches `imagenet_class_index.json` (the Keras-style
+{"0": ["n01440764", "tench"], ...} map) from a blob URL at construction
+and exposes `getLabel(n)` / `decodePredictions(output)`. Same contract
+here, with zero-egress-friendly sources: a local JSON path or file:// URL
+works exactly like the hosted blob (the download itself is plain urllib,
+cached like the zoo checkpoints)."""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.request
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+#: the reference's hosted class-index blob (ImageNetLabels.java jsonUrl);
+#: any mirror serving the standard Keras imagenet_class_index.json works
+DEFAULT_URL = "http://blob.deeplearning4j.org/utils/imagenet_class_index.json"
+
+
+class ImageNetLabels:
+    """1000-class ImageNet label table + top-k prediction decoding."""
+
+    def __init__(self, source: Optional[str] = None,
+                 cache_dir: Optional[str] = None):
+        """`source`: local path, file:// URL, or http(s) URL of a
+        class-index JSON ({"idx": [wnid, label], ...}); defaults to the
+        reference's hosted blob (requires egress; downloads are cached
+        under `cache_dir`, default ~/.dl4jtpu/labels)."""
+        src = source or DEFAULT_URL
+        if os.path.exists(src):
+            with open(src, encoding="utf-8") as f:
+                raw = json.load(f)
+        else:
+            if src.startswith(("http://", "https://")):
+                cache_dir = cache_dir or os.path.expanduser(
+                    "~/.dl4jtpu/labels")
+                os.makedirs(cache_dir, exist_ok=True)
+                fname = os.path.join(cache_dir, os.path.basename(src))
+                if not os.path.exists(fname):
+                    # download to a temp name, VALIDATE, then atomically
+                    # move into the cache — an interrupted/truncated
+                    # download must not poison every later construction
+                    tmp = fname + ".tmp"
+                    urllib.request.urlretrieve(src, tmp)
+                    try:
+                        with open(tmp, encoding="utf-8") as f:
+                            json.load(f)
+                    except ValueError:
+                        os.remove(tmp)
+                        raise IOError(
+                            f"downloaded class index from {src} is not "
+                            "valid JSON (truncated download?)")
+                    os.replace(tmp, fname)
+                with open(fname, encoding="utf-8") as f:
+                    raw = json.load(f)
+            else:  # file:// and friends — stream through urllib
+                with urllib.request.urlopen(src) as r:
+                    raw = json.loads(r.read().decode("utf-8"))
+        n = len(raw)
+        self._labels: List[str] = [""] * n
+        self._wnids: List[str] = [""] * n
+        for k, (wnid, label) in raw.items():
+            i = int(k)
+            self._wnids[i] = wnid
+            self._labels[i] = label
+
+    def __len__(self) -> int:
+        return len(self._labels)
+
+    def get_label(self, n: int) -> str:
+        """ref: getLabel(n)."""
+        return self._labels[n]
+
+    def get_wnid(self, n: int) -> str:
+        return self._wnids[n]
+
+    def decode_predictions(self, predictions, top: int = 5) -> str:
+        """Top-`top` classes + probabilities per batch row, formatted like
+        the reference's decodePredictions (ref :57-81)."""
+        p = np.asarray(predictions)
+        if p.ndim == 1:
+            p = p[None, :]
+        lines = []
+        for row in p:
+            order = np.argsort(row)[::-1][:top]
+            lines.append("Predictions for batch :")
+            lines.append(", ".join(
+                f"{float(row[i]) * 100:.3f}% {self._labels[i]}"
+                for i in order))
+        return "\n".join(lines)
+
+    def top_k(self, predictions, k: int = 5) -> List[List[str]]:
+        """Structured variant: label names of the k most probable classes
+        per row."""
+        p = np.asarray(predictions)
+        if p.ndim == 1:
+            p = p[None, :]
+        return [[self._labels[i] for i in np.argsort(row)[::-1][:k]]
+                for row in p]

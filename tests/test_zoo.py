@@ -175,3 +175,83 @@ class TestPretrainedUrlPath:
         with pytest.raises(Exception):   # BadZipFile from the sniffing
             model.init_pretrained("imagenet",
                                   cache_dir=str(tmp_path / "cache"))
+
+
+class TestImageNetLabels:
+    """zoo/util ImageNetLabels (ref: ImageNetLabels.java) against a local
+    class-index JSON — same format as the hosted blob."""
+
+    def _index_file(self, tmp_path):
+        import json
+        idx = {str(i): [f"n{i:08d}", name] for i, name in
+               enumerate(["tench", "goldfish", "shark", "hammerhead"])}
+        p = tmp_path / "imagenet_class_index.json"
+        p.write_text(json.dumps(idx), encoding="utf-8")
+        return str(p)
+
+    def test_labels_and_decode(self, tmp_path):
+        from deeplearning4j_tpu.zoo.imagenet import ImageNetLabels
+        labels = ImageNetLabels(self._index_file(tmp_path))
+        assert len(labels) == 4
+        assert labels.get_label(1) == "goldfish"
+        assert labels.get_wnid(0) == "n00000000"
+        probs = np.array([0.1, 0.6, 0.25, 0.05], np.float32)
+        out = labels.decode_predictions(probs, top=2)
+        assert "60.000% goldfish" in out and "25.000% shark" in out
+        assert labels.top_k(probs, k=2) == [["goldfish", "shark"]]
+
+    def test_file_url_source(self, tmp_path):
+        import pathlib
+        from deeplearning4j_tpu.zoo.imagenet import ImageNetLabels
+        uri = pathlib.Path(self._index_file(tmp_path)).as_uri()
+        labels = ImageNetLabels(uri)
+        assert labels.get_label(2) == "shark"
+
+
+class TestVgg16Preprocessor:
+    def test_mean_subtraction_and_revert(self):
+        from deeplearning4j_tpu.datasets import VGG16ImagePreProcessor
+        p = VGG16ImagePreProcessor()
+        x = np.full((2, 3, 4, 4), 128.0, np.float32)
+        out = p.transform(x)
+        np.testing.assert_allclose(out[:, 0], 128.0 - 123.68, rtol=1e-6)
+        np.testing.assert_allclose(out[:, 2], 128.0 - 103.939, rtol=1e-6)
+        np.testing.assert_allclose(p.revert_features(out), x, rtol=1e-5)
+
+    def test_uint8_nhwc_packs_to_nchw(self):
+        from deeplearning4j_tpu.datasets import VGG16ImagePreProcessor
+        p = VGG16ImagePreProcessor()
+        x = np.random.default_rng(0).integers(
+            0, 255, (2, 5, 6, 3), dtype=np.uint8)
+        out = p.transform(x)
+        assert out.shape == (2, 3, 5, 6)
+        np.testing.assert_allclose(
+            out[0, 1], x[0, :, :, 1].astype(np.float32) - 116.779,
+            rtol=1e-5)
+
+    def test_serde_roundtrip(self):
+        from deeplearning4j_tpu.datasets import VGG16ImagePreProcessor
+        from deeplearning4j_tpu.datasets.normalizers import (
+            normalizer_from_dict)
+        import json
+        p = VGG16ImagePreProcessor()
+        q = normalizer_from_dict(json.loads(p.to_json()))
+        assert isinstance(q, VGG16ImagePreProcessor)
+
+    def test_rejects_non_rgb(self):
+        from deeplearning4j_tpu.datasets import VGG16ImagePreProcessor
+        p = VGG16ImagePreProcessor()
+        with pytest.raises(ValueError, match="3 RGB"):
+            p.transform(np.zeros((2, 4, 8, 8), np.float32))  # RGBA NCHW
+        with pytest.raises(ValueError, match="3 RGB"):
+            p.transform(np.zeros((2, 8, 8, 4), np.uint8))    # RGBA NHWC
+        with pytest.raises(ValueError, match="rank"):
+            p.transform(np.zeros((4, 3), np.float32))
+
+    def test_single_chw_image(self):
+        from deeplearning4j_tpu.datasets import VGG16ImagePreProcessor
+        p = VGG16ImagePreProcessor()
+        x = np.full((3, 4, 4), 150.0, np.float32)
+        out = p.transform(x)
+        np.testing.assert_allclose(out[0], 150.0 - 123.68, rtol=1e-6)
+        np.testing.assert_allclose(p.revert_features(out), x, rtol=1e-5)
