@@ -306,6 +306,13 @@ class VGG16ImagePreProcessor(_BaseNormalizer):
     def _fit_arrays(self, x, y):
         pass
 
+    def fit_label(self, enabled: bool = True):
+        if enabled:
+            raise ValueError(
+                "VGG16ImagePreProcessor transforms image FEATURES only "
+                "(mean subtraction has no label analogue)")
+        return self
+
     def _check_rgb(self, x: np.ndarray, axis: int) -> None:
         if x.shape[axis] != 3:
             raise ValueError(

@@ -9,10 +9,11 @@ cached like the zoo checkpoints)."""
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import urllib.request
-from typing import List, Optional, Sequence
+from typing import List, Optional
 
 import numpy as np
 
@@ -34,37 +35,50 @@ class ImageNetLabels:
         if os.path.exists(src):
             with open(src, encoding="utf-8") as f:
                 raw = json.load(f)
-        else:
-            if src.startswith(("http://", "https://")):
-                cache_dir = cache_dir or os.path.expanduser(
-                    "~/.dl4jtpu/labels")
-                os.makedirs(cache_dir, exist_ok=True)
-                fname = os.path.join(cache_dir, os.path.basename(src))
-                if not os.path.exists(fname):
-                    # download to a temp name, VALIDATE, then atomically
-                    # move into the cache — an interrupted/truncated
-                    # download must not poison every later construction
-                    tmp = fname + ".tmp"
-                    urllib.request.urlretrieve(src, tmp)
-                    try:
-                        with open(tmp, encoding="utf-8") as f:
-                            json.load(f)
-                    except ValueError:
-                        os.remove(tmp)
-                        raise IOError(
-                            f"downloaded class index from {src} is not "
-                            "valid JSON (truncated download?)")
-                    os.replace(tmp, fname)
+        elif src.startswith(("http://", "https://")):
+            cache_dir = cache_dir or os.path.expanduser("~/.dl4jtpu/labels")
+            os.makedirs(cache_dir, exist_ok=True)
+            # url-hashed cache name: mirrors with identical basenames (or
+            # trailing-slash urls) must not collide on one entry
+            fname = os.path.join(
+                cache_dir,
+                hashlib.sha256(src.encode()).hexdigest()[:16] + ".json")
+            if os.path.exists(fname):
                 with open(fname, encoding="utf-8") as f:
                     raw = json.load(f)
-            else:  # file:// and friends — stream through urllib
-                with urllib.request.urlopen(src) as r:
-                    raw = json.loads(r.read().decode("utf-8"))
+            else:
+                # download (bounded timeout) to a temp name, VALIDATE,
+                # then atomically move into the cache — an interrupted/
+                # truncated download must not poison later constructions
+                tmp = fname + ".tmp"
+                with urllib.request.urlopen(src, timeout=60) as r, \
+                        open(tmp, "wb") as f:
+                    f.write(r.read())
+                try:
+                    with open(tmp, encoding="utf-8") as f:
+                        raw = json.load(f)
+                except ValueError:
+                    os.remove(tmp)
+                    raise IOError(
+                        f"downloaded class index from {src} is not "
+                        "valid JSON (truncated download?)")
+                os.replace(tmp, fname)
+        else:  # file:// and friends — stream through urllib
+            with urllib.request.urlopen(src, timeout=60) as r:
+                raw = json.loads(r.read().decode("utf-8"))
+        if not isinstance(raw, dict):
+            raise ValueError(
+                f"class index from {src} must be a JSON object "
+                '{"0": [wnid, label], ...}, got ' + type(raw).__name__)
         n = len(raw)
         self._labels: List[str] = [""] * n
         self._wnids: List[str] = [""] * n
         for k, (wnid, label) in raw.items():
             i = int(k)
+            if not 0 <= i < n:
+                raise ValueError(
+                    f"class index from {src} has non-dense key {k!r} "
+                    f"(expected 0..{n - 1})")
             self._wnids[i] = wnid
             self._labels[i] = label
 

@@ -4,6 +4,8 @@
 import importlib.util
 import os
 
+import numpy as np
+
 EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
 
 
@@ -55,3 +57,10 @@ def test_seq2seq_translation():
     # reversal task is near-perfectly solvable with attention
     acc = _run("seq2seq_translation", steps=250)
     assert acc > 0.85
+
+
+def test_serving_decode():
+    outs = _run("serving_decode", steps=25)
+    assert len(outs) == 4
+    for text, score in outs:
+        assert len(text) > 10 and np.isfinite(score)
