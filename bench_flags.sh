@@ -3,7 +3,7 @@
 # live. Each candidate runs the standard bench.py (unfused default);
 # failures (unknown flag / crash / tunnel drop) are tolerated and logged.
 # Results append to bench_flags.log as "<tag> <json-line>".
-set -u
+set -u -o pipefail
 cd "$(dirname "$0")"
 LOG=bench_flags.log
 run() {
