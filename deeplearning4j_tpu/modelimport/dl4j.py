@@ -1298,8 +1298,9 @@ def restore_computation_graph(path: str, input_types=None):
         upd_flat = (read_nd4j_array(zf.read("updaterState.bin"))
                     if "updaterState.bin" in names else None)
 
+    conf_dict = json.loads(conf_json)
     conf = computation_graph_configuration_from_dl4j(conf_json, input_types)
-    iteration_count = int(json.loads(conf_json).get("iterationCount", 0))
+    iteration_count = int(conf_dict.get("iterationCount", 0))
     net = ComputationGraph(conf)
     net.init()
     if coeffs is not None:

@@ -196,3 +196,180 @@ class TransferLearningHelper:
 
     def unfrozen_network(self):
         return self.tail
+
+
+class _GraphBuilderNS:
+    """Implementation of TransferLearning.GraphBuilder (ref:
+    TransferLearning.java:447-778): surgery on a trained ComputationGraph —
+    freeze a feature-extractor frontier, replace layer widths, remove
+    vertices (cascading to dependents), graft new layers/vertices, and
+    re-point outputs, keeping every untouched vertex's trained params."""
+
+    def __init__(self, net):
+        from deeplearning4j_tpu.nn.conf.network import (
+            ComputationGraphConfiguration)
+        self._conf = ComputationGraphConfiguration.from_dict(
+            net.conf.to_dict())
+        self._params = jax.tree_util.tree_map(
+            lambda a: jax.numpy.array(a), net.params)
+        self._state = jax.tree_util.tree_map(
+            lambda a: jax.numpy.array(a), net.state)
+        self._freeze_frontier: List[str] = []
+        self._fine_tune: Optional[FineTuneConfiguration] = None
+        self._nout_replace: Dict[str, tuple] = {}
+        self._removed: List[str] = []
+        self._added: List[str] = []
+
+    def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+        self._fine_tune = ftc
+        return self
+
+    def set_feature_extractor(self, *vertex_names: str):
+        """Freeze the named vertices and every ancestor
+        (ref: setFeatureExtractor :499 — 'up to and including').
+        Unknown names fail fast like the reference (a typo must not
+        silently leave the feature extractor trainable)."""
+        missing = [n for n in vertex_names if n not in self._conf.vertices]
+        if missing:
+            raise ValueError(
+                f"set_feature_extractor: unknown vertex name(s) {missing}; "
+                f"graph has {sorted(self._conf.vertices)}")
+        self._freeze_frontier = list(vertex_names)
+        return self
+
+    def n_out_replace(self, layer_name: str, n_out: int,
+                      weight_init: str = "xavier"):
+        """ref: nOutReplace :518-561 — the layer re-initializes and its
+        consumers' n_in re-infer."""
+        self._nout_replace[layer_name] = (n_out, weight_init)
+        return self
+
+    def remove_vertex_and_connections(self, vertex_name: str):
+        """Remove a vertex and (cascading) everything that consumed it
+        (ref: removeVertexAndConnections :640)."""
+        conf = self._conf
+        doomed = {vertex_name}
+        changed = True
+        while changed:
+            changed = False
+            for name, ins in conf.vertex_inputs.items():
+                if name not in doomed and any(i in doomed for i in ins):
+                    doomed.add(name)
+                    changed = True
+        for name in doomed:
+            conf.vertices.pop(name, None)
+            conf.vertex_inputs.pop(name, None)
+            self._params.pop(name, None)
+            self._state.pop(name, None)
+        conf.network_outputs = [o for o in conf.network_outputs
+                                if o not in doomed]
+        self._removed.extend(doomed)
+        return self
+
+    def add_layer(self, name: str, layer: LayerConf, *inputs: str,
+                  preprocessor=None):
+        """ref: addLayer :653-668."""
+        from deeplearning4j_tpu.nn.conf.graph_conf import LayerVertex
+        layer.name = name
+        self._conf.vertices[name] = LayerVertex(layer=layer,
+                                                preprocessor=preprocessor)
+        self._conf.vertex_inputs[name] = list(inputs)
+        self._added.append(name)
+        return self
+
+    def add_vertex(self, name: str, vertex, *inputs: str):
+        """ref: addVertex :683."""
+        self._conf.vertices[name] = vertex
+        self._conf.vertex_inputs[name] = list(inputs)
+        self._added.append(name)
+        return self
+
+    def set_outputs(self, *names: str):
+        self._conf.network_outputs = list(names)
+        return self
+
+    def _ancestors(self, frontier: List[str]) -> set:
+        out = set()
+        stack = list(frontier)
+        while stack:
+            n = stack.pop()
+            if n in out or n not in self._conf.vertices:
+                continue
+            out.add(n)
+            stack.extend(i for i in self._conf.vertex_inputs.get(n, [])
+                         if i in self._conf.vertices)
+        return out
+
+    def build(self):
+        from deeplearning4j_tpu.nn.conf.graph_conf import LayerVertex
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        conf = self._conf
+
+        reinit = set(self._added)
+        # nOut replacement: re-init the layer and every direct consumer
+        # whose n_in must re-infer
+        for name, (n_out, w_init) in self._nout_replace.items():
+            v = conf.vertices[name]
+            if not isinstance(v, LayerVertex):
+                raise ValueError(f"nOutReplace target {name!r} is not a "
+                                 "layer vertex")
+            v.layer.n_out = n_out
+            v.layer.weight_init = w_init
+            reinit.add(name)
+            # the width change propagates through parameterless vertices
+            # (merge/elementwise/subset/...) until it reaches layer
+            # vertices, whose n_in must re-infer; anything shape-touched
+            # re-initializes (the reference re-inits consumers too)
+            frontier = [name]
+            seen = {name}
+            while frontier:
+                cur = frontier.pop()
+                for cname, ins in conf.vertex_inputs.items():
+                    if cur not in ins or cname in seen:
+                        continue
+                    seen.add(cname)
+                    cv = conf.vertices[cname]
+                    if isinstance(cv, LayerVertex):
+                        if hasattr(cv.layer, "n_in"):
+                            cv.layer.n_in = None  # re-infer
+                        reinit.add(cname)
+                    else:
+                        # shape flows through; keep walking downstream
+                        reinit.add(cname)
+                        frontier.append(cname)
+
+        # freeze the ancestor closure of the frontier
+        if self._freeze_frontier:
+            for name in self._ancestors(self._freeze_frontier):
+                v = conf.vertices[name]
+                if isinstance(v, LayerVertex) and \
+                        not isinstance(v.layer, FrozenLayer):
+                    v.layer = FrozenLayer(inner=v.layer)
+
+        if self._fine_tune is not None:
+            ft = self._fine_tune
+            if ft.updater is not None:
+                conf.updater = ft.updater
+            if ft.seed is not None:
+                conf.seed = ft.seed
+            for v in conf.vertices.values():
+                layer = getattr(v, "layer", None)
+                if layer is None or isinstance(layer, FrozenLayer):
+                    continue
+                for f in ("l1", "l2", "dropout"):
+                    val = getattr(ft, f)
+                    if val is not None and hasattr(layer, f):
+                        setattr(layer, f, val)
+
+        net = ComputationGraph(conf)
+        net.init()
+        for name in conf.vertices:
+            if name not in reinit and name in self._params:
+                net.params[name] = self._params[name]
+                if name in self._state and self._state[name]:
+                    net.state[name] = self._state[name]
+        net.updater_state = conf.updater.init_state(net.params)
+        return net
+
+
+TransferLearning.GraphBuilder = _GraphBuilderNS

@@ -258,3 +258,138 @@ class TestEarlyStopping:
         result = EarlyStoppingTrainer(
             cfg, net, ArrayDataSetIterator(x, y, 16)).fit()
         assert result.termination_reason == "IterationTerminationCondition"
+
+
+class TestGraphTransferLearning:
+    """TransferLearning.GraphBuilder (ref: TransferLearning.java:447-778):
+    surgery on a trained ComputationGraph."""
+
+    def _trained_graph(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.nn.conf import (InputType,
+                                                NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                       OutputLayer)
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.updater import Sgd
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(5).updater(Sgd(0.1)).graph_builder()
+                .add_inputs("x")
+                .set_input_types(InputType.feed_forward(6))
+                .add_layer("f1", DenseLayer(n_out=8, activation="tanh"),
+                           "x")
+                .add_layer("f2", DenseLayer(n_out=6, activation="tanh"),
+                           "f1")
+                .add_layer("head", OutputLayer(n_out=3, loss="mcxent",
+                                               activation="softmax"),
+                           "f2")
+                .set_outputs("head").build())
+        net = ComputationGraph(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((32, 6)).astype(np.float32)
+        y = np.zeros((32, 3), np.float32)
+        y[np.arange(32), rng.integers(0, 3, 32)] = 1.0
+        for _ in range(5):
+            net.fit(DataSet(x, y))
+        return net, x, y
+
+    def test_freeze_frontier_keeps_params_fixed(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.nn.transfer import TransferLearning
+        net, x, y = self._trained_graph()
+        new = (TransferLearning.GraphBuilder(net)
+               .set_feature_extractor("f2")
+               .build())
+        f1_before = np.asarray(new.params["f1"]["W"]).copy()
+        f2_before = np.asarray(new.params["f2"]["W"]).copy()
+        head_before = np.asarray(new.params["head"]["W"]).copy()
+        # trained params carried over
+        np.testing.assert_array_equal(f1_before,
+                                      np.asarray(net.params["f1"]["W"]))
+        for _ in range(3):
+            new.fit(DataSet(x, y))
+        np.testing.assert_array_equal(np.asarray(new.params["f1"]["W"]),
+                                      f1_before)   # frozen ancestor
+        np.testing.assert_array_equal(np.asarray(new.params["f2"]["W"]),
+                                      f2_before)   # frozen frontier
+        assert not np.array_equal(np.asarray(new.params["head"]["W"]),
+                                  head_before)     # head still trains
+
+    def test_replace_head_and_nout(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.nn.conf.layers import OutputLayer
+        from deeplearning4j_tpu.nn.transfer import TransferLearning
+        net, x, _ = self._trained_graph()
+        new = (TransferLearning.GraphBuilder(net)
+               .set_feature_extractor("f1")
+               .n_out_replace("f2", 10)
+               .remove_vertex_and_connections("head")
+               .add_layer("head5", OutputLayer(n_out=5, loss="mcxent",
+                                               activation="softmax"),
+                          "f2")
+               .set_outputs("head5")
+               .build())
+        out = np.asarray(new.output(x))
+        assert out.shape == (32, 5)
+        assert np.asarray(new.params["f2"]["W"]).shape == (8, 10)
+        # f1 params survived the surgery; f2/head5 re-initialized
+        np.testing.assert_array_equal(np.asarray(new.params["f1"]["W"]),
+                                      np.asarray(net.params["f1"]["W"]))
+        y5 = np.zeros((32, 5), np.float32)
+        y5[:, 0] = 1.0
+        new.fit(DataSet(x, y5))  # trains end to end
+        assert np.isfinite(new.score_value)
+
+    def test_fine_tune_updater_override(self):
+        from deeplearning4j_tpu.nn.transfer import (FineTuneConfiguration,
+                                                    TransferLearning)
+        from deeplearning4j_tpu.nn.updater import Adam
+        net, _, _ = self._trained_graph()
+        new = (TransferLearning.GraphBuilder(net)
+               .fine_tune_configuration(FineTuneConfiguration(
+                   updater=Adam(1e-3)))
+               .build())
+        assert type(new.conf.updater).__name__ == "Adam"
+        assert "m" in new.updater_state
+
+    def test_unknown_frontier_name_rejected(self):
+        from deeplearning4j_tpu.nn.transfer import TransferLearning
+        net, _, _ = self._trained_graph()
+        import pytest
+        with pytest.raises(ValueError, match="unknown vertex"):
+            TransferLearning.GraphBuilder(net).set_feature_extractor(
+                "f2_typo")
+
+    def test_nout_replace_through_merge_vertex(self):
+        """Width changes propagate through parameterless vertices to the
+        consuming layers (stale-shaped trained params must not survive)."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.nn.conf import (InputType,
+                                                NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.graph_conf import MergeVertex
+        from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                       OutputLayer)
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.transfer import TransferLearning
+        from deeplearning4j_tpu.nn.updater import Sgd
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(4).updater(Sgd(0.1)).graph_builder()
+                .add_inputs("x")
+                .set_input_types(InputType.feed_forward(6))
+                .add_layer("a", DenseLayer(n_out=4, activation="tanh"),
+                           "x")
+                .add_layer("b", DenseLayer(n_out=4, activation="tanh"),
+                           "x")
+                .add_vertex("m", MergeVertex(), "a", "b")
+                .add_layer("head", OutputLayer(n_out=2, loss="mcxent",
+                                               activation="softmax"),
+                           "m")
+                .set_outputs("head").build())
+        net = ComputationGraph(conf).init()
+        x = np.random.default_rng(1).standard_normal(
+            (8, 6)).astype(np.float32)
+        new = (TransferLearning.GraphBuilder(net)
+               .n_out_replace("a", 7).build())
+        out = np.asarray(new.output(x))     # would crash on stale head W
+        assert out.shape == (8, 2)
+        assert np.asarray(new.params["head"]["W"]).shape == (11, 2)
