@@ -198,6 +198,21 @@ class TransferLearningHelper:
         return self.tail
 
 
+
+def _ancestor_closure(vertices, vertex_inputs, frontier) -> set:
+    """Frontier vertices + every ancestor (the 'up to and including'
+    freeze semantics shared by GraphBuilder and the helper)."""
+    out = set()
+    stack = list(frontier)
+    while stack:
+        n = stack.pop()
+        if n in out or n not in vertices:
+            continue
+        out.add(n)
+        stack.extend(i for i in vertex_inputs.get(n, []) if i in vertices)
+    return out
+
+
 class _GraphBuilderNS:
     """Implementation of TransferLearning.GraphBuilder (ref:
     TransferLearning.java:447-778): surgery on a trained ComputationGraph —
@@ -288,18 +303,6 @@ class _GraphBuilderNS:
         self._conf.network_outputs = list(names)
         return self
 
-    def _ancestors(self, frontier: List[str]) -> set:
-        out = set()
-        stack = list(frontier)
-        while stack:
-            n = stack.pop()
-            if n in out or n not in self._conf.vertices:
-                continue
-            out.add(n)
-            stack.extend(i for i in self._conf.vertex_inputs.get(n, [])
-                         if i in self._conf.vertices)
-        return out
-
     def build(self):
         from deeplearning4j_tpu.nn.conf.graph_conf import LayerVertex
         from deeplearning4j_tpu.nn.graph import ComputationGraph
@@ -340,7 +343,9 @@ class _GraphBuilderNS:
 
         # freeze the ancestor closure of the frontier
         if self._freeze_frontier:
-            for name in self._ancestors(self._freeze_frontier):
+            for name in _ancestor_closure(
+                    conf.vertices, conf.vertex_inputs,
+                    self._freeze_frontier):
                 v = conf.vertices[name]
                 if isinstance(v, LayerVertex) and \
                         not isinstance(v.layer, FrozenLayer):
@@ -373,3 +378,140 @@ class _GraphBuilderNS:
 
 
 TransferLearning.GraphBuilder = _GraphBuilderNS
+
+
+class GraphTransferLearningHelper:
+    """Featurize-then-train for a ComputationGraph with a frozen frontier
+    (ref: TransferLearningHelper.java CG path :52-57, initHelperGraph —
+    split the graph at the frontier; the frozen subgraph runs once per
+    batch, the unfrozen subset trains on the cached crossing activations).
+
+    `frozen_at`: vertex names to freeze at (the frontier); the frozen set
+    is their ancestor closure. Crossing edges (frozen vertex feeding an
+    unfrozen one) become the tail subgraph's network inputs. Both halves
+    get COPIES of the trained params (the jitted train steps donate their
+    buffers, so sharing references across nets aliases deleted arrays)."""
+
+    def __init__(self, net, *frozen_at: str):
+        from deeplearning4j_tpu.nn.conf.network import (
+            ComputationGraphConfiguration)
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        if not frozen_at:
+            raise ValueError("name at least one frontier vertex")
+        missing = [n for n in frozen_at if n not in net.conf.vertices]
+        if missing:
+            raise ValueError(f"unknown vertex name(s) {missing}")
+        self.full_net = net
+        conf = net.conf
+
+        frozen = _ancestor_closure(conf.vertices, conf.vertex_inputs,
+                                   frozen_at)
+        self.frozen = frozen
+        tail_names = [n for n in conf.vertices if n not in frozen]
+        if not tail_names:
+            raise ValueError("frontier freezes the whole graph")
+        out_types = net._infer_types()
+
+        def _subconf(names, inputs, input_types, outputs):
+            sub = ComputationGraphConfiguration(
+                seed=conf.seed, updater=conf.updater, dtype=conf.dtype,
+                gradient_normalization=conf.gradient_normalization,
+                gradient_normalization_threshold=(
+                    conf.gradient_normalization_threshold),
+                tbptt_fwd_length=conf.tbptt_fwd_length,
+                tbptt_back_length=conf.tbptt_back_length)
+            for n in conf.topological_order():
+                if n in names:
+                    sub.vertices[n] = conf.vertices[n]
+                    sub.vertex_inputs[n] = list(
+                        conf.vertex_inputs.get(n, []))
+            sub.network_inputs = list(inputs)
+            sub.input_types = dict(input_types)
+            sub.network_outputs = list(outputs)
+            return sub
+
+        # crossing sources: frozen vertices feeding the tail
+        crossing: List[str] = []
+        for name in conf.topological_order():
+            if name in frozen:
+                continue
+            for src in conf.vertex_inputs.get(name, []):
+                if src in frozen and src not in crossing:
+                    crossing.append(src)
+        if not crossing:
+            raise ValueError("no frozen vertex feeds the unfrozen tail")
+        self._crossing = crossing
+
+        tail_outputs = [o for o in conf.network_outputs if o in tail_names]
+        if not tail_outputs:
+            raise ValueError("no network output survives outside the "
+                             "frozen set")
+        tail_conf = _subconf(tail_names, crossing,
+                             {c: out_types[c] for c in crossing},
+                             tail_outputs)
+        # frozen subgraph: original inputs -> crossing activations ONLY
+        # (featurize must not pay for the tail's forward)
+        frozen_conf = _subconf(
+            frozen, conf.network_inputs,
+            {k: conf.input_types[k] for k in conf.network_inputs},
+            crossing)
+
+        def _copy(tree):
+            return jax.tree_util.tree_map(lambda a: jax.numpy.array(a),
+                                          tree)
+
+        self._frozen_net = ComputationGraph(frozen_conf)
+        self._frozen_net.init()
+        for n in frozen:
+            self._frozen_net.params[n] = _copy(net.params[n])
+            if net.state.get(n):
+                self._frozen_net.state[n] = _copy(net.state[n])
+
+        self.tail = ComputationGraph(tail_conf)
+        self.tail.init()
+        for n in tail_names:
+            self.tail.params[n] = _copy(net.params[n])
+            if net.state.get(n):
+                self.tail.state[n] = _copy(net.state[n])
+        self.tail.updater_state = tail_conf.updater.init_state(
+            self.tail.params)
+
+    def featurize(self, ds):
+        """Run ONLY the frozen subgraph; returns ({crossing: activation},
+        labels). Masked variable-length inputs are not supported (the
+        crossing cache would need per-input masks threaded to the tail) —
+        rejected loudly rather than silently mis-featurized."""
+        if getattr(ds, "features_mask", None) is not None or \
+                getattr(ds, "labels_mask", None) is not None:
+            raise NotImplementedError(
+                "featurize with feature/label masks is unsupported; train "
+                "the graph directly (fit handles masks) or drop the masks")
+        outs = self._frozen_net.output(ds.features)
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        feats = {c: np.asarray(o) for c, o in zip(self._crossing, outs)}
+        return feats, ds.labels
+
+    def fit_featurized(self, feats, labels, epochs: int = 1,
+                       batch_size: int = 32) -> None:
+        # ArrayDataSetIterator accepts dict features (MultiDataSet
+        # equivalent), so multi-crossing tails batch like any CG fit
+        x = feats[self._crossing[0]] if len(feats) == 1 else feats
+        self.tail.fit(x, labels, epochs=epochs, batch_size=batch_size)
+        # tail params AND state (BN running stats, centers) flow back
+        # into the full net by name — copies, not donated aliases
+        for name in self.tail.conf.vertices:
+            self.full_net.params[name] = jax.tree_util.tree_map(
+                lambda a: jax.numpy.array(a), self.tail.params[name])
+            if self.tail.state.get(name):
+                self.full_net.state[name] = jax.tree_util.tree_map(
+                    lambda a: jax.numpy.array(a), self.tail.state[name])
+
+    def output_from_featurized(self, feats):
+        if len(self._crossing) == 1:
+            return self.tail.output(feats[self._crossing[0]])
+        return self.tail.output(feats)
+
+    def unfrozen_graph(self):
+        return self.tail
+

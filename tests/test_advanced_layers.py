@@ -393,3 +393,134 @@ class TestGraphTransferLearning:
         out = np.asarray(new.output(x))     # would crash on stale head W
         assert out.shape == (8, 2)
         assert np.asarray(new.params["head"]["W"]).shape == (11, 2)
+
+
+class TestGraphTransferLearningHelper:
+    """CG featurize-then-train (ref: TransferLearningHelper.java CG path:
+    split at the frozen frontier, train the unfrozen subset on cached
+    crossing activations)."""
+
+    def _branchy_graph(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.nn.conf import (InputType,
+                                                NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.graph_conf import MergeVertex
+        from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                       OutputLayer)
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.updater import Sgd
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(6).updater(Sgd(0.1)).graph_builder()
+                .add_inputs("x")
+                .set_input_types(InputType.feed_forward(5))
+                .add_layer("trunk", DenseLayer(n_out=6, activation="tanh"),
+                           "x")
+                .add_layer("brA", DenseLayer(n_out=4, activation="tanh"),
+                           "trunk")
+                .add_layer("brB", DenseLayer(n_out=4, activation="tanh"),
+                           "trunk")
+                .add_vertex("m", MergeVertex(), "brA", "brB")
+                .add_layer("head", OutputLayer(n_out=3, loss="mcxent",
+                                               activation="softmax"), "m")
+                .set_outputs("head").build())
+        net = ComputationGraph(conf).init()
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((24, 5)).astype(np.float32)
+        y = np.zeros((24, 3), np.float32)
+        y[np.arange(24), rng.integers(0, 3, 24)] = 1.0
+        net.fit(DataSet(x, y))
+        return net, x, y
+
+    def test_featurized_training_matches_direct_tail(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.nn.transfer import (
+            GraphTransferLearningHelper)
+        net, x, y = self._branchy_graph()
+        helper = GraphTransferLearningHelper(net, "brA", "brB")
+        assert helper.frozen == {"trunk", "brA", "brB"}
+        assert sorted(helper.tail.conf.network_inputs) == ["brA", "brB"]
+
+        feats, labels = helper.featurize(DataSet(x, y))
+        assert set(feats) == {"brA", "brB"}
+        trunk_before = np.asarray(net.params["trunk"]["W"]).copy()
+        out_before = np.asarray(helper.output_from_featurized(feats))
+        helper.fit_featurized(feats, labels, epochs=4, batch_size=24)
+        # frozen side untouched; head learned
+        np.testing.assert_array_equal(
+            np.asarray(net.params["trunk"]["W"]), trunk_before)
+        out_after = np.asarray(helper.output_from_featurized(feats))
+        assert not np.allclose(out_before, out_after)
+        # full-net forward uses the newly trained head
+        full = np.asarray(net.output(x))
+        np.testing.assert_allclose(full, np.asarray(
+            helper.output_from_featurized(feats)), atol=1e-5)
+
+    def test_single_crossing_simple_api(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.nn.transfer import (
+            GraphTransferLearningHelper)
+        net, x, y = self._branchy_graph()
+        helper = GraphTransferLearningHelper(net, "trunk")
+        assert helper.tail.conf.network_inputs == ["trunk"]
+        feats, labels = helper.featurize(DataSet(x, y))
+        helper.fit_featurized(feats, labels, epochs=2, batch_size=12)
+        assert np.isfinite(helper.tail.score_value)
+
+    def test_whole_graph_frozen_rejected(self):
+        import pytest
+        from deeplearning4j_tpu.nn.transfer import (
+            GraphTransferLearningHelper)
+        net, _, _ = self._branchy_graph()
+        with pytest.raises(ValueError, match="whole graph"):
+            GraphTransferLearningHelper(net, "head")
+
+    def test_tail_bn_state_flows_back(self):
+        """Review regression: BN running stats trained in the tail must
+        write back to the full net (params alone would silently diverge
+        full_net.output from the helper's)."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.nn.conf import (InputType,
+                                                NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.layers import (BatchNormalization,
+                                                       DenseLayer,
+                                                       OutputLayer)
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.transfer import (
+            GraphTransferLearningHelper)
+        from deeplearning4j_tpu.nn.updater import Sgd
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(8).updater(Sgd(0.1)).graph_builder()
+                .add_inputs("x")
+                .set_input_types(InputType.feed_forward(5))
+                .add_layer("body", DenseLayer(n_out=6, activation="tanh"),
+                           "x")
+                .add_layer("bn", BatchNormalization(), "body")
+                .add_layer("head", OutputLayer(n_out=2, loss="mcxent",
+                                               activation="softmax"),
+                           "bn")
+                .set_outputs("head").build())
+        net = ComputationGraph(conf).init()
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((16, 5)).astype(np.float32)
+        y = np.zeros((16, 2), np.float32)
+        y[np.arange(16), rng.integers(0, 2, 16)] = 1.0
+        helper = GraphTransferLearningHelper(net, "body")
+        feats, labels = helper.featurize(DataSet(x, y))
+        helper.fit_featurized(feats, labels, epochs=3, batch_size=16)
+        # running stats moved and flowed back
+        assert float(np.abs(np.asarray(
+            net.state["bn"]["mean"])).max()) > 0.0
+        np.testing.assert_allclose(
+            np.asarray(net.output(x)),
+            np.asarray(helper.output_from_featurized(feats)), atol=1e-5)
+
+    def test_masked_featurize_rejected(self):
+        import pytest
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.nn.transfer import (
+            GraphTransferLearningHelper)
+        net, x, y = self._branchy_graph()
+        helper = GraphTransferLearningHelper(net, "trunk")
+        ds = DataSet(x, y, features_mask=np.ones((24, 1), np.float32))
+        with pytest.raises(NotImplementedError, match="mask"):
+            helper.featurize(ds)
