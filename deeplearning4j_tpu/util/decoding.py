@@ -54,6 +54,19 @@ def _check_seed(seed_ids, steps, max_length):
 PRIME_CHUNK_MAX = 64
 
 
+def set_prime_chunk_max(n: int) -> None:
+    """Raise (or lower) the largest priming chunk. Long-prompt serving
+    wants this high — a 1000-token prompt primes in 6 dispatches at 1024
+    vs 17 at the default 64 — at the cost of one extra compile per new
+    power-of-two shape the deployment actually sees. Exactness is
+    unaffected: chunks are exact prompt slices (never padded), and
+    stateful streaming makes any chunking == one-shot priming."""
+    if n < 1 or (n & (n - 1)) != 0:
+        raise ValueError(f"prime chunk max must be a power of two, got {n}")
+    global PRIME_CHUNK_MAX
+    PRIME_CHUNK_MAX = n
+
+
 def _prime_chunks(n: int):
     """Greedy power-of-two decomposition of a prompt length, largest
     chunk first (serving-friendly: a new prompt length never costs a new

@@ -920,6 +920,28 @@ class TestBucketedDecoding:
         assert _prime_chunks(100) == [64, 32, 4]
         assert sum(_prime_chunks(37)) == 37
 
+    def test_prime_chunk_max_configurable(self):
+        """Long-prompt serving can raise the chunk cap: fewer dispatches,
+        identical decode output (chunks are exact slices, never padded)."""
+        from deeplearning4j_tpu.util import decoding
+        prev = decoding.PRIME_CHUNK_MAX
+        assert decoding._prime_chunks(1000)[0] == prev  # default cap
+        try:
+            decoding.set_prime_chunk_max(1024)
+            chunks = decoding._prime_chunks(1000)
+            assert chunks == [512, 256, 128, 64, 32, 8]
+            model, net = self._net()
+            big = model.sample_stream(net, [1, 2, 3, 4, 5], steps=4)
+            decoding.set_prime_chunk_max(4)
+            model2, net2 = self._net()
+            small = model2.sample_stream(net2, [1, 2, 3, 4, 5], steps=4)
+            assert big == small
+        finally:
+            decoding.set_prime_chunk_max(prev)
+        import pytest
+        with pytest.raises(ValueError):
+            decoding.set_prime_chunk_max(48)
+
     def test_beam_widths_share_bucket_traces(self):
         from deeplearning4j_tpu.util.decoding import beam_search
         model, net = self._net()
