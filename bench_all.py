@@ -63,7 +63,7 @@ def bench_resnet():
     _, args = _sync_time(step, args, 3)  # warmup
     dt, _ = _sync_time(step, args, 10)
     print(json.dumps({"metric": "resnet50_train", "value": round(B * 10 / dt, 1),
-                      "unit": "images/sec"}))
+                      "unit": "images/sec"}), flush=True)
 
 
 def bench_lstm():
@@ -91,7 +91,7 @@ def bench_lstm():
     _, args = _sync_time(step, args, 3)
     dt, _ = _sync_time(step, args, 10)
     print(json.dumps({"metric": "lstm_train", "value": round(B * T * 10 / dt, 1),
-                      "unit": "tokens/sec"}))
+                      "unit": "tokens/sec"}), flush=True)
 
 
 def bench_lenet():
@@ -114,7 +114,7 @@ def bench_lenet():
     _, args = _sync_time(step, args, 3)
     dt, _ = _sync_time(step, args, 20)
     print(json.dumps({"metric": "lenet_train", "value": round(B * 20 / dt, 1),
-                      "unit": "images/sec"}))
+                      "unit": "images/sec"}), flush=True)
 
 
 def bench_vgg16():
@@ -146,7 +146,7 @@ def bench_vgg16():
     _, args = _sync_time(step, args, 3)
     dt, _ = _sync_time(step, args, 10)
     print(json.dumps({"metric": "vgg16_train", "value": round(B * 10 / dt, 1),
-                      "unit": "images/sec"}))
+                      "unit": "images/sec"}), flush=True)
 
 
 def bench_keras_inception():
@@ -190,7 +190,7 @@ def bench_keras_inception():
     float(jnp.sum(head(out)[:1, :1]))
     dt = time.perf_counter() - t0
     print(json.dumps({"metric": "keras_inceptionv3_infer",
-                      "value": round(B * n / dt, 1), "unit": "images/sec"}))
+                      "value": round(B * n / dt, 1), "unit": "images/sec"}), flush=True)
 
 
 def bench_attention():
@@ -207,18 +207,21 @@ def bench_attention():
     q = jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.bfloat16)
     k = jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.bfloat16)
     v = jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.bfloat16)
-    f = jax.jit(lambda q, k, v: blockwise_attention(q, k, v, causal=True,
-                                                    block_size=4096))
+    # chained (o feeds back into q) + scalar fetch: the tunnel can serve
+    # cached results for repeated identical dispatches (PERF.md)
+    f = jax.jit(lambda q, k, v: 0.5 * q +
+                0.5 * blockwise_attention(q, k, v, causal=True,
+                                          block_size=4096))
     o = f(q, k, v)
     float(jnp.float32(o[0, 0, 0, 0]))
     t0 = time.perf_counter()
-    n = 5
+    n = 10
     for _ in range(n):
-        o = f(q, k, v)
+        o = f(o, k, v)
     float(jnp.float32(o[0, 0, 0, 0]))
     dt = (time.perf_counter() - t0) / n
     print(json.dumps({"metric": f"blockwise_attention_T{T}",
-                      "value": round(B * T / dt, 1), "unit": "tokens/sec"}))
+                      "value": round(B * T / dt, 1), "unit": "tokens/sec"}), flush=True)
 
 
 def bench_transformer():
@@ -252,7 +255,7 @@ def bench_transformer():
     dt, _ = _sync_time(step, args, 10)
     print(json.dumps({"metric": f"transformer_train_T{T}",
                       "value": round(B * T * 10 / dt, 1),
-                      "unit": "tokens/sec"}))
+                      "unit": "tokens/sec"}), flush=True)
 
 
 def bench_scaling():
@@ -269,7 +272,7 @@ def bench_scaling():
             capture_output=True, text=True, timeout=900)
         ok = r.returncode == 0 and "ok" in r.stdout
         print(json.dumps({"metric": "scaling_8dev", "value": 1.0 if ok else 0.0,
-                          "unit": "dryrun_ok(virtual)"}))
+                          "unit": "dryrun_ok(virtual)"}), flush=True)
         return
     import jax.numpy as jnp
     import numpy as np
@@ -299,7 +302,7 @@ def bench_scaling():
         pw.fit([ds])
     dt = time.perf_counter() - t0
     print(json.dumps({"metric": "scaling_8dev",
-                      "value": round(B * 10 / dt, 1), "unit": "images/sec"}))
+                      "value": round(B * 10 / dt, 1), "unit": "images/sec"}), flush=True)
 
 
 def bench_window_attention():
@@ -339,7 +342,7 @@ def bench_window_attention():
     tf, tl = bench(full), bench(local)
     print(json.dumps({"metric": f"window_attention_T{T}_W{W}",
                       "value": round(B * T / tl, 1), "unit": "tokens/sec",
-                      "full_causal_tokens_per_sec": round(B * T / tf, 1)}))
+                      "full_causal_tokens_per_sec": round(B * T / tf, 1)}), flush=True)
 
 
 def bench_word2vec():
@@ -374,7 +377,7 @@ def bench_word2vec():
     float(np.asarray(w2v.syn0[0, 0]))
     dt = time.perf_counter() - t0
     print(json.dumps({"metric": "word2vec_train", "unit": "words/sec",
-                      "value": round(total_words / dt, 1)}))
+                      "value": round(total_words / dt, 1)}), flush=True)
 
 
 ALL = {"resnet": bench_resnet, "lstm": bench_lstm, "lenet": bench_lenet,

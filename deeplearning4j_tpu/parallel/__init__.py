@@ -13,3 +13,8 @@ from deeplearning4j_tpu.parallel.mesh import (  # noqa: F401
 )
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper  # noqa: F401
 from deeplearning4j_tpu.parallel.inference import ParallelInference  # noqa: F401
+from deeplearning4j_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_apply,
+    pipeline_train_step,
+    shard_stage_params,
+)

@@ -41,16 +41,11 @@ def shard_stage_params(stage_params: list, mesh: Mesh, axis: str = "pipe"):
     return jax.tree.map(lambda a: jax.device_put(a, sh(a)), stacked)
 
 
-def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh,
-                   axis: str = "pipe", n_microbatches: int = None):
-    """Run `stage_fn(params_s, h)` for stages s=0..S-1 over the pipe axis.
-
-    stacked_params: pytree with leading stage axis (shard_stage_params).
-    x: [B, ...] global batch; B must divide by n_microbatches (default =
-    number of stages). Returns the final stage's output for the full
-    batch. Differentiable (fori_loop-free: a lax.scan drives the
-    schedule, ppermute moves activations stage->stage).
-    """
+def _prepare(stacked_params, x, mesh: Mesh, axis: str,
+             n_microbatches: int):
+    """Shared schedule setup: validate one-stage-per-device and the
+    microbatch split; build the per-stage param sharding specs.
+    Returns (S, M, micro, param_specs)."""
     S = mesh.shape[axis]
     n_stages = jax.tree.leaves(stacked_params)[0].shape[0]
     if n_stages != S:
@@ -62,12 +57,26 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh,
     B = x.shape[0]
     if B % M:
         raise ValueError(f"batch {B} not divisible into {M} microbatches")
-    mb = B // M
-    micro = x.reshape(M, mb, *x.shape[1:])
-
+    micro = x.reshape(M, B // M, *x.shape[1:])
     # params: each device sees its own stage's slice (leading axis 1)
     param_specs = jax.tree.map(
         lambda a: P(*([axis] + [None] * (a.ndim - 1))), stacked_params)
+    return S, M, micro, param_specs
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh,
+                   axis: str = "pipe", n_microbatches: int = None):
+    """Run `stage_fn(params_s, h)` for stages s=0..S-1 over the pipe axis.
+
+    stacked_params: pytree with leading stage axis (shard_stage_params).
+    x: [B, ...] global batch; B must divide by n_microbatches (default =
+    number of stages). Returns the final stage's output for the full
+    batch. Differentiable (fori_loop-free: a lax.scan drives the
+    schedule, ppermute moves activations stage->stage).
+    """
+    S, M, micro, param_specs = _prepare(stacked_params, x, mesh, axis,
+                                        n_microbatches)
+    B = x.shape[0]
 
     @partial(shard_map, mesh=mesh,
              in_specs=(param_specs, P()), out_specs=P(),
@@ -109,3 +118,95 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh,
 
     outs = run(stacked_params, micro)
     return outs.reshape(B, *x.shape[1:])
+
+
+def pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
+                        stacked_params, x, y, mesh: Mesh,
+                        axis: str = "pipe", n_microbatches: int = None):
+    """One 1F1B-style pipelined train step: returns (mean loss, dparams).
+
+    `pipeline_apply` under `jax.grad` is GPipe: the scan's autodiff saves
+    residuals for every (tick, stage) — activation memory grows O(M) with
+    the microbatch count. This schedule interleaves each microbatch's
+    backward with later microbatches' forwards, so a device only holds
+    the stage INPUTS of its in-flight microbatches: at most 2S-1 of them,
+    independent of M (the 1F1B property; classic refs: PipeDream/Megatron
+    one-forward-one-backward). Backward is recompute-form — a tick's
+    backward re-runs stage_fn from the saved input under jax.vjp, the
+    same FLOP profile as a jax.checkpoint-ed GPipe — so for long trains
+    (M >> S) memory drops from O(M) to O(S) at ~S extra pipeline ticks.
+
+    stage_fn(params_s, h) -> h (homogeneous stages, as pipeline_apply);
+    loss_fn(h_out, y_mb) -> scalar mean loss of one microbatch.
+    Returns (loss, dparams): loss = mean over microbatches, dparams has
+    the same stage-stacked layout as `stacked_params` (device s
+    contributes the grads of its own stage). Input-grads (dx) are not
+    returned — this is a train step, not a general VJP.
+    """
+    S, M, micro_x, param_specs = _prepare(stacked_params, x, mesh, axis,
+                                          n_microbatches)
+    micro_y = y.reshape(M, x.shape[0] // M, *y.shape[1:])
+    K = 2 * S  # residual ring: >= max in-flight stage inputs (2S-1)
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(param_specs, P(), P()),
+             out_specs=(P(), param_specs),
+             check_vma=False)
+    def run(params, mx, my):
+        me = jax.lax.axis_index(axis)
+        p_local = jax.tree.map(lambda a: a[0], params)
+        # schedule: fwd(s, m) at tick s + m; bwd(s, m) at tick
+        # (2S - 1 - s) + m — the last stage's backward trails its forward
+        # by one tick, cotangents ppermute upstream one stage per tick
+        n_ticks = 2 * S + M - 2 + 1
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+        bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            fbuf, bbuf, resid, dp_acc, loss_acc = carry
+            # ---- forward half: microbatch m_f enters this stage
+            m_f = t - me
+            f_valid = (m_f >= 0) & (m_f < M)
+            feed = mx[jnp.clip(m_f, 0, M - 1)]
+            h_in = jnp.where(me == 0, feed, fbuf)
+            h_out = stage_fn(p_local, h_in)
+            resid = jax.lax.cond(
+                f_valid,
+                lambda r: r.at[jnp.clip(m_f, 0, M - 1) % K].set(h_in),
+                lambda r: r, resid)
+            fbuf_next = jax.lax.ppermute(h_out, axis, fwd_perm)
+
+            # ---- backward half: microbatch m_b leaves this stage
+            m_b = t - (2 * S - 1 - me)
+            b_valid = (m_b >= 0) & (m_b < M)
+            mi = jnp.clip(m_b, 0, M - 1)
+            h_saved = resid[mi % K]
+            h2, vjp_fn = jax.vjp(lambda p, h: stage_fn(p, h),
+                                 p_local, h_saved)
+            # last stage seeds the cotangent from the loss; others use
+            # the cotangent ppermuted down from stage s+1
+            y_mb = my[mi]
+            loss_mb, g_loss = jax.value_and_grad(loss_fn)(h2, y_mb)
+            cot = jnp.where(me == S - 1, g_loss, bbuf)
+            dp, dh = vjp_fn(cot)
+            dp_acc = jax.tree.map(
+                lambda acc, g: acc + jnp.where(b_valid, g, 0.0),
+                dp_acc, dp)
+            loss_acc = loss_acc + jnp.where(
+                b_valid & (me == S - 1), loss_mb, 0.0)
+            bbuf_next = jax.lax.ppermute(dh, axis, bwd_perm)
+            return (fbuf_next, bbuf_next, resid, dp_acc, loss_acc), None
+
+        z = jnp.zeros_like(mx[0])
+        resid0 = jnp.zeros((K,) + z.shape, z.dtype)
+        dp0 = jax.tree.map(jnp.zeros_like, p_local)
+        carry0 = (z, z, resid0, dp0, jnp.zeros((), jnp.float32))
+        (_, _, _, dp_acc, loss_acc), _ = jax.lax.scan(
+            tick, carry0, jnp.arange(n_ticks))
+        # objective = (1/M) sum of per-microbatch mean losses, so the
+        # accumulated per-microbatch grads average the same way
+        loss = jax.lax.psum(loss_acc, axis) / M
+        dparams = jax.tree.map(lambda a: (a / M)[None], dp_acc)
+        return loss, dparams
+
+    return run(stacked_params, micro_x, micro_y)

@@ -67,12 +67,14 @@ def set_prime_chunk_max(n: int) -> None:
     PRIME_CHUNK_MAX = n
 
 
-def _prime_chunks(n: int):
+def _prime_chunks(n: int, chunk_max: int = None):
     """Greedy power-of-two decomposition of a prompt length, largest
     chunk first (serving-friendly: a new prompt length never costs a new
     compile once the shared chunk shapes are warm)."""
     out = []
-    c = PRIME_CHUNK_MAX
+    c = chunk_max or PRIME_CHUNK_MAX
+    if c < 1 or (c & (c - 1)) != 0:
+        raise ValueError(f"prime chunk max must be a power of two, got {c}")
     while n > 0:
         while c > n:
             c //= 2
@@ -81,13 +83,13 @@ def _prime_chunks(n: int):
     return out
 
 
-def _prime(net, ids, vocab: int):
+def _prime(net, ids, vocab: int, chunk_max: int = None):
     """Feed the seed through rnn_time_step in bucketed chunks; returns
     the final chunk's output (its last position is the next-token
     distribution). Stateful streaming makes chunked == one-shot priming
     (pinned by the streaming-vs-full-forward tests)."""
     at, out = 0, None
-    for c in _prime_chunks(len(ids)):
+    for c in _prime_chunks(len(ids), chunk_max):
         out = net.rnn_time_step(
             _one_hot(np.asarray(ids[at:at + c])[None, :], vocab))
         at += c
@@ -106,16 +108,19 @@ def _width_bucket(w: int) -> int:
 def sample_stream(net, seed_ids, steps: int, vocab_size: int,
                   temperature: float = 1.0,
                   rng: Optional[np.random.Generator] = None,
-                  max_length: Optional[int] = None) -> List[int]:
+                  max_length: Optional[int] = None,
+                  prime_chunk_max: Optional[int] = None) -> List[int]:
     """Temperature sampling with KV-cache / stored-state incremental
     decoding: prime once with the seed, then one single-position forward
     per generated token (the reference's rnnTimeStep generation loop;
-    identical distribution to a padded full forward — tested)."""
+    identical distribution to a padded full forward — tested).
+    `prime_chunk_max` overrides the process default (set_prime_chunk_max)
+    for this call only."""
     _check_seed(seed_ids, steps, max_length)
     rng = rng or np.random.default_rng(0)
     ids = list(seed_ids)
     net.rnn_clear_previous_state()
-    out = _prime(net, ids, vocab_size)
+    out = _prime(net, ids, vocab_size, prime_chunk_max)
     for i in range(steps):
         if max_length is not None and len(ids) >= max_length:
             break
@@ -130,14 +135,16 @@ def sample_stream(net, seed_ids, steps: int, vocab_size: int,
 
 def beam_search(net, seed_ids, steps: int, vocab_size: int,
                 beam_width: int = 4,
-                max_length: Optional[int] = None
+                max_length: Optional[int] = None,
+                prime_chunk_max: Optional[int] = None
                 ) -> Tuple[List[int], float]:
     """Highest-log-prob continuation of `seed_ids` by beam search.
 
     `net` needs rnn_time_step / rnn_clear_previous_state (MultiLayerNetwork
     or ComputationGraph, single one-hot [N,V,T] input). `max_length`
     bounds seed+generation (None = unbounded; required finite for models
-    with positional tables or non-rolling caches)."""
+    with positional tables or non-rolling caches). `prime_chunk_max`
+    overrides the process default (set_prime_chunk_max) per call."""
     V = vocab_size
     _check_seed(seed_ids, steps, max_length)
     W = min(beam_width, V)     # top-k can't exceed the vocab
@@ -147,7 +154,7 @@ def beam_search(net, seed_ids, steps: int, vocab_size: int,
     # prime ONCE at batch 1 (bucketed chunks), then broadcast the carried
     # state to the padded beam batch; pad rows never enter scoring (the
     # logp slice below keeps only the first W rows)
-    out = _prime(net, seed_ids, V)
+    out = _prime(net, seed_ids, V, prime_chunk_max)
     reorder_stream_state(net, np.zeros(Wb, np.int64))
     out = np.repeat(_probs(out)[:1], Wb, axis=0)
     beams = [list(seed_ids) for _ in range(W)]

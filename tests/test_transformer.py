@@ -942,6 +942,23 @@ class TestBucketedDecoding:
         with pytest.raises(ValueError):
             decoding.set_prime_chunk_max(48)
 
+    def test_prime_chunk_max_per_call(self):
+        """The per-call override scopes to one decode and leaves the
+        process default untouched."""
+        from deeplearning4j_tpu.util import decoding
+        prev = decoding.PRIME_CHUNK_MAX
+        model, net = self._net()
+        a = model.sample_stream(net, [1, 2, 3, 4, 5], steps=4)
+        model2, net2 = self._net()
+        b = decoding.sample_stream(net2, [1, 2, 3, 4, 5], steps=4,
+                                   vocab_size=12, prime_chunk_max=2)
+        assert a == b
+        assert decoding.PRIME_CHUNK_MAX == prev
+        import pytest
+        with pytest.raises(ValueError):
+            decoding.sample_stream(net2, [1, 2, 3], steps=1, vocab_size=12,
+                                   prime_chunk_max=3)
+
     def test_beam_widths_share_bucket_traces(self):
         from deeplearning4j_tpu.util.decoding import beam_search
         model, net = self._net()
