@@ -4,10 +4,11 @@ The reference's CJK analyzers ship multi-megabyte system dictionaries
 (deeplearning4j-nlp-japanese bundles the kuromoji/IPADIC data,
 deeplearning4j-nlp-chinese the ansj/jieba tables) — most of their 19.6k
 LoC + resources is dictionary data. This module is the zero-egress
-counterpart: a hand-curated core-vocabulary dictionary (~700 Chinese
-words with relative frequencies, ~840 Japanese entries with POS — the
-round-3 expansion generates frequency-weighted conjugated surfaces for a
-curated verb list, the stand-in for IPADIC's per-surface costs) that
+counterpart: a hand-curated core-vocabulary dictionary (~880 Chinese
+words with relative frequencies, ~1190 Japanese entries with POS — the
+round-3 expansions generate frequency-weighted conjugated surfaces for
+curated verb and suru-noun lists, the stand-in for IPADIC's per-surface
+costs) that
 makes `ChineseTokenizerFactory(dictionary="builtin")` /
 `JapaneseTokenizerFactory(dictionary="builtin")` segment everyday text
 sensibly out of the box. It is deliberately small: domain text should
@@ -67,6 +68,19 @@ _ZH_BUCKETS = (
     # idioms / fixed expressions (lattice stress cases)
     (1200, "实事求是 乱七八糟 马马虎虎 认认真真 自言自语 无所谓 不好意思 没关系 对不起 谢谢 再见 欢迎 请问 麻烦 打扰 辛苦 恭喜 加油 小心 注意"),
     (1000, "越来越多 越来越好 不得不 忍不住 来不及 算了 受不了 了不起 差一点 好不容易 说不定 怪不得 恨不得 巴不得 大不了 看不起 想不到 舍不得 用不着 免不了"),
+    # round-3b expansion: modern/tech + media vocabulary
+    (2200, "视频 照片 图片 文章 媒体 评论 点赞 分享 关注 粉丝 直播 主播 平台 应用 下载 上传 安装 更新 升级"),
+    (2000, "人工智能 机器学习 大数据 云计算 算法 模型 芯片 机器人 自动化 数字化 智能化 虚拟 现实 科技 创业 互联网 电商 物流 快递"),
+    (1800, "支付 转账 红包 打折 优惠 免费 会员 订单 退货 客服 质保 品牌 广告 营销 推广 流量 用户 客户 消费 购物"),
+    # verbs round 3
+    (4500, "打算 决心 坚持 放弃 尝试 努力 争取 避免 防止 禁止 允许 批准 申请 报名 注册 登录 退出 取消 确认 提交"),
+    (3500, "感觉 感到 感谢 感动 激动 兴奋 紧张 放松 享受 欣赏 佩服 羡慕 嫉妒 抱怨 批评 表扬 鼓励 安慰 提醒 警告"),
+    (2800, "搬家 装修 打扫 整理 收拾 修理 保养 种植 浇水 喂养 照顾 陪伴 接送 迎接 送别 拜访 看望 聚会 庆祝 祝贺"),
+    # places / countries / travel
+    (2200, "英国 法国 德国 俄罗斯 韩国 印度 泰国 新加坡 澳大利亚 加拿大 欧洲 亚洲 非洲 南美 广州 深圳 香港 澳门 台湾 西安"),
+    (1800, "护照 签证 机票 车票 行程 导游 景点 风景 古迹 寺庙 教堂 城堡 海滩 温泉 滑雪 爬山 露营 拍照 纪念品 特产"),
+    # time / quantity refinements
+    (3200, "正在 刚才 刚刚 从前 将来 未来 目前 如今 当时 近年来 本来 原来 后来 然而 此外 于是 因此 不仅 不但 既然 哪怕"),
 )
 
 ZH_FREQ = {}
@@ -115,7 +129,7 @@ _JA_EXTRA_BUCKETS = (
     (4000, "名詞",
      "病院 銀行 郵便局 図書館 公園 空港 道 橋 町 村 市 県 国際 社会 経済 政治 文化 歴史 科学 技術"),
     (3500, "名詞",
-     "情報 番組 新聞 雑誌 辞書 教科書 宿題 授業 教室 黒板 机 椅子 鞄 傘 眼鏡 靴 服 帽子 切符 荷物"),
+     "日本語 英語 中国語 韓国語 情報 番組 新聞 雑誌 辞書 教科書 宿題 授業 教室 黒板 机 椅子 鞄 傘 眼鏡 靴 服 帽子 切符 荷物"),
     (3000, "名詞",
      "体 頭 顔 目 耳 口 手 足 声 心 病気 薬 熱 風邪 医者 看護師 運動 散歩 休み 夢"),
     (2500, "名詞",
@@ -214,5 +228,43 @@ def _conjugate(dict_form: str, kind: str):
 for _dict_form, _freq, _kind in _JA_VERBS:
     for _surface, _form in _conjugate(_dict_form, _kind).items():
         _f = max(100, int(_freq * _FORM_WEIGHTS[_form]))
+        if _surface not in JA_ENTRIES or JA_ENTRIES[_surface][0] < _f:
+            JA_ENTRIES[_surface] = (_f, "動詞")
+
+
+# --- Japanese suru-verb compounds (round-3b expansion) -----------------
+#
+# IPADIC lists サ変 nouns plus every する surface; the generator covers
+# the productive noun+する pattern the same way: the bare noun enters as
+# 名詞 (it also appears standalone), and the する compound surfaces are
+# emitted with the shared per-form decay weights, damped a further ×0.5
+# (the fused surface is rarer than the noun alone). する itself is already
+# a high-band entry, so the lattice can also split 勉強+する — the fused
+# surfaces just price the common analysis correctly.
+
+_JA_SURU_NOUNS = (
+    ("勉強", 4500), ("練習", 3000), ("運動", 2500), ("散歩", 2000),
+    ("旅行", 3000), ("買い物", 2500), ("電話", 3000), ("結婚", 2500),
+    ("研究", 3000), ("説明", 3000), ("紹介", 2500), ("質問", 2500),
+    ("連絡", 2500), ("予約", 2000), ("準備", 2500), ("掃除", 2000),
+    ("洗濯", 1800), ("料理", 2500), ("運転", 2200), ("卒業", 1800),
+    ("入学", 1500), ("出発", 2000), ("到着", 1800), ("心配", 2500),
+    ("安心", 2000), ("成功", 1800), ("失敗", 1800), ("参加", 2500),
+    ("利用", 2500), ("使用", 2200), ("発表", 2000), ("相談", 2200),
+    ("約束", 2000), ("翻訳", 1200), ("注文", 1800), ("案内", 1800),
+)
+
+_SURU_FORMS = {
+    "する": "dict", "します": "masu", "しました": "mashita",
+    "しません": "masen", "して": "te", "した": "ta", "しない": "nai",
+    "しなかった": "nakatta", "したい": "tai",
+}
+
+for _noun, _freq in _JA_SURU_NOUNS:
+    if _noun not in JA_ENTRIES or JA_ENTRIES[_noun][0] < _freq:
+        JA_ENTRIES[_noun] = (_freq, "名詞")
+    for _suffix, _form in _SURU_FORMS.items():
+        _f = max(100, int(_freq * 0.5 * _FORM_WEIGHTS[_form]))
+        _surface = _noun + _suffix
         if _surface not in JA_ENTRIES or JA_ENTRIES[_surface][0] < _f:
             JA_ENTRIES[_surface] = (_f, "動詞")

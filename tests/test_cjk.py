@@ -206,3 +206,22 @@ class TestBuiltinDictionaryScale:
         tf = ChineseTokenizerFactory(dictionary="builtin")
         toks = tf.create("我们一起去图书馆学习").get_tokens()
         assert "一起" in toks and "图书馆" in toks, toks
+
+    def test_round3b_expansion(self):
+        """Round-3b: modern zh vocabulary + ja suru-verb compounds."""
+        from deeplearning4j_tpu.nlp import cjk_data as c
+        assert len(c.ZH_FREQ) >= 850
+        assert len(c.JA_ENTRIES) >= 1100
+        for surf in ("勉強します", "電話した", "予約したい", "掃除して"):
+            assert surf in c.JA_ENTRIES, surf
+            assert c.JA_ENTRIES[surf][1] == "動詞"
+        # the bare noun outweighs its suru compounds
+        assert c.JA_ENTRIES["勉強"][0] > c.JA_ENTRIES["勉強します"][0]
+
+        tf = ChineseTokenizerFactory(dictionary="builtin")
+        toks = tf.create("人工智能改变世界").get_tokens()
+        assert "人工智能" in toks and "世界" in toks, toks
+
+        tfj = JapaneseTokenizerFactory(dictionary="builtin")
+        toks2 = tfj.create("私は毎日日本語を勉強します").get_tokens()
+        assert "勉強します" in toks2, toks2
