@@ -36,8 +36,7 @@ log = logging.getLogger(__name__)
 # Device kernels
 # --------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=())
-def _ns_step(syn0, syn1neg, inputs, targets, labels, valid, lr):
+def _ns_update(syn0, syn1neg, inputs, targets, labels, valid, lr):
     """Negative-sampling update for a batch of pairs.
 
     inputs [B] int32 — rows of syn0 (context words / doc vectors)
@@ -59,8 +58,10 @@ def _ns_step(syn0, syn1neg, inputs, targets, labels, valid, lr):
     return syn0, syn1neg
 
 
-@partial(jax.jit, static_argnames=())
-def _hs_step(syn0, syn1, inputs, points, codes, mask, lr):
+_ns_step = jax.jit(_ns_update)
+
+
+def _hs_update(syn0, syn1, inputs, points, codes, mask, lr):
     """Hierarchical-softmax update for a batch of pairs.
 
     points [B,L] int32 — inner-node rows along the label word's huffman path
@@ -76,6 +77,33 @@ def _hs_step(syn0, syn1, inputs, points, codes, mask, lr):
     syn0 = syn0.at[inputs].add(grad_l1)
     syn1 = syn1.at[points.reshape(-1)].add(grad_w.reshape(-1, w.shape[-1]))
     return syn0, syn1
+
+
+_hs_step = jax.jit(_hs_update)
+
+
+@partial(jax.jit, static_argnames=("negative", "use_hs"))
+def _sg_scan(syn0, syn1, syn1neg, inputs, targets, labels, points, codes,
+             pmask, valid, lr, *, negative: bool, use_hs: bool):
+    """Many skip-gram batches in ONE dispatch: lax.scan over the leading
+    batch axis (inputs [Nb,B], targets [Nb,B,K1], ...). Math and batch
+    order identical to Nb sequential _ns_step/_hs_step dispatches — the
+    device-side loop exists purely to cut host->device dispatch count
+    (the measured Word2Vec bottleneck through the tunneled platform,
+    PERF.md). Unused table/xs slots are passed as dummies and returned
+    untouched when the corresponding variant is off."""
+    def body(carry, xs):
+        s0, s1, s1n = carry
+        i, t, l, p, c, m, v, a = xs
+        if negative:
+            s0, s1n = _ns_update(s0, s1n, i, t, l, v, a)
+        if use_hs:
+            s0, s1 = _hs_update(s0, s1, i, p, c, m, a)
+        return (s0, s1, s1n), None
+    (syn0, syn1, syn1neg), _ = jax.lax.scan(
+        body, (syn0, syn1, syn1neg),
+        (inputs, targets, labels, points, codes, pmask, valid, lr))
+    return syn0, syn1, syn1neg
 
 
 @partial(jax.jit, static_argnames=())
@@ -467,9 +495,7 @@ class SequenceVectors:
                             sub_corpus, sub_off, self.window, keep,
                             seed + s0)
                         alphas = seq_alpha[pair_seq + s0]
-                        for s in range(0, len(ins), B):
-                            self._dispatch_sg(ins[s:s + B], outs[s:s + B],
-                                              alphas[s:s + B])
+                        self._dispatch_sg_many(ins, outs, alphas)
                     else:
                         ctxs, cmask, centers, row_seq = nw.cbow_rows(
                             sub_corpus, sub_off, self.window, keep,
@@ -561,6 +587,62 @@ class SequenceVectors:
             self.syn0, self.syn1 = _hs_step(
                 self.syn0, self.syn1, jnp.asarray(bi), jnp.asarray(pts),
                 jnp.asarray(cds), jnp.asarray(msk), lr)
+
+    #: batches per _sg_scan dispatch: bounds the per-dispatch host->device
+    #: transfer (~scan_chunk * B * (K+2+L) * 4 bytes) while still cutting
+    #: dispatch count by the same factor
+    scan_chunk = 64
+
+    def _dispatch_sg_many(self, ins, outs, alphas):
+        """Shard-sized skip-gram training: groups of `scan_chunk` full
+        batches go to the device as ONE _sg_scan dispatch each; the
+        remainder uses the per-batch step. Negatives are drawn per batch
+        in order, so the rng stream matches the per-batch path and the
+        result is numerically equivalent to dispatching every batch
+        through _dispatch_sg (pinned to 1e-6 by the equivalence test —
+        XLA may reorder float ops inside the scan body)."""
+        B = self._eff_batch
+        nb = self.scan_chunk
+        n_full = len(ins) // B
+        n_scan = (n_full // nb) * nb
+        ns, hs = self.negative > 0, self.use_hs
+        D = self.syn0.shape[1]
+        dummy1 = self.syn1 if hs else jnp.zeros((1, D), jnp.float32)
+        dummy1n = self.syn1neg if ns else jnp.zeros((1, D), jnp.float32)
+        # constant across groups: upload once, reuse every dispatch
+        valid = jnp.ones((nb, B), jnp.float32)
+        if not ns:
+            targets = jnp.zeros((nb, B, 1), jnp.int32)
+            labels = jnp.zeros((nb, B, 1), jnp.float32)
+        if not hs:
+            pts = jnp.zeros((nb, B, 1), jnp.int32)
+            cds = jnp.zeros((nb, B, 1), jnp.float32)
+            msk = jnp.zeros((nb, B, 1), jnp.float32)
+        for g0 in range(0, n_scan, nb):
+            sl = slice(g0 * B, (g0 + nb) * B)
+            bi = np.ascontiguousarray(ins[sl]).reshape(nb, B)
+            bo = np.ascontiguousarray(outs[sl]).reshape(nb, B)
+            lr = alphas[sl].astype(np.float32).reshape(nb, B)
+            if ns:
+                t_list, l_list = zip(*(self._sample_negatives(bo[j])
+                                       for j in range(nb)))
+                targets = jnp.asarray(np.stack(t_list))
+                labels = jnp.asarray(np.stack(l_list))
+            if hs:
+                pts = jnp.asarray(self._points[bo])
+                cds = jnp.asarray(self._codes[bo])
+                msk = jnp.asarray(self._path_mask[bo])
+            self.syn0, s1, s1n = _sg_scan(
+                self.syn0, dummy1, dummy1n, jnp.asarray(bi),
+                targets, labels, pts, cds, msk, valid,
+                jnp.asarray(lr), negative=ns, use_hs=hs)
+            if hs:
+                self.syn1 = dummy1 = s1
+            if ns:
+                self.syn1neg = dummy1n = s1n
+        for s in range(n_scan * B, len(ins), B):
+            self._dispatch_sg(ins[s:s + B], outs[s:s + B],
+                              alphas[s:s + B])
 
     def _dispatch_cbow(self, bx, bm, bc, alphas):
         B = self._eff_batch

@@ -90,6 +90,48 @@ class TestWord2Vec:
         assert sim_in > sim_out
         assert "dog" in w2v.words_nearest("cat", top_n=3)
 
+    @pytest.mark.parametrize("kwargs", [
+        dict(negative=3, use_hierarchic_softmax=False),
+        dict(negative=0),                                # hs
+        dict(negative=2, use_hierarchic_softmax=True),   # ns + hs together
+    ])
+    def test_scan_dispatch_matches_per_batch(self, kwargs):
+        """_dispatch_sg_many (lax.scan, one dispatch per scan_chunk
+        batches) must produce bit-for-bit the tables the per-batch
+        _dispatch_sg loop produces: same batch order, same rng stream for
+        the negatives."""
+        def make():
+            w = Word2Vec(
+                sentence_iterator=CollectionSentenceIterator(corpus(30)),
+                min_word_frequency=1, layer_size=8, window=2, seed=3,
+                batch_size=32, **kwargs)
+            w.build_vocab([s.split() for s in corpus(30)])
+            w._rng = np.random.default_rng(17)
+            return w
+        a, b = make(), make()
+        rng = np.random.default_rng(5)
+        V = a.vocab.num_words()
+        B = a._eff_batch
+        n = B * 5 + 7          # 5 full batches + a remainder
+        ins = rng.integers(0, V, n).astype(np.int32)
+        outs = rng.integers(0, V, n).astype(np.int32)
+        alphas = np.full(n, 0.025, np.float32)
+
+        a.scan_chunk = 2       # 2 scan dispatches + 1 per-batch + tail
+        a._dispatch_sg_many(ins, outs, alphas)
+        for s in range(0, n, B):
+            b._dispatch_sg(ins[s:s + B], outs[s:s + B], alphas[s:s + B])
+        np.testing.assert_allclose(np.asarray(a.syn0), np.asarray(b.syn0),
+                                   rtol=1e-6, atol=1e-7)
+        if kwargs.get("negative"):
+            np.testing.assert_allclose(np.asarray(a.syn1neg),
+                                       np.asarray(b.syn1neg),
+                                       rtol=1e-6, atol=1e-7)
+        if a.use_hs:
+            np.testing.assert_allclose(np.asarray(a.syn1),
+                                       np.asarray(b.syn1),
+                                       rtol=1e-6, atol=1e-7)
+
     def test_serialization_roundtrip(self, tmp_path):
         w2v = Word2Vec(
             sentence_iterator=CollectionSentenceIterator(corpus(50)),
