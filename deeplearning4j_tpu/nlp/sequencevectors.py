@@ -106,8 +106,8 @@ def _sg_scan(syn0, syn1, syn1neg, inputs, targets, labels, points, codes,
     return syn0, syn1, syn1neg
 
 
-@partial(jax.jit, static_argnames=())
-def _cbow_ns_step(syn0, syn1neg, ctx, ctx_mask, targets, labels, valid, lr):
+def _cbow_ns_update(syn0, syn1neg, ctx, ctx_mask, targets, labels, valid,
+                    lr):
     """CBOW with negative sampling: input = mean of context rows
     (ref: CBOW.java — sums context + optional label vectors)."""
     denom = jnp.maximum(ctx_mask.sum(-1, keepdims=True), 1.0)  # [B,1]
@@ -126,8 +126,10 @@ def _cbow_ns_step(syn0, syn1neg, ctx, ctx_mask, targets, labels, valid, lr):
     return syn0, syn1neg
 
 
-@partial(jax.jit, static_argnames=())
-def _cbow_hs_step(syn0, syn1, ctx, ctx_mask, points, codes, mask, lr):
+_cbow_ns_step = jax.jit(_cbow_ns_update)
+
+
+def _cbow_hs_update(syn0, syn1, ctx, ctx_mask, points, codes, mask, lr):
     denom = jnp.maximum(ctx_mask.sum(-1, keepdims=True), 1.0)
     vecs = syn0[ctx] * ctx_mask[..., None]
     l1 = vecs.sum(1) / denom
@@ -141,6 +143,27 @@ def _cbow_hs_step(syn0, syn1, ctx, ctx_mask, points, codes, mask, lr):
         grad_ctx.reshape(-1, grad_ctx.shape[-1]))
     syn1 = syn1.at[points.reshape(-1)].add(grad_w.reshape(-1, w.shape[-1]))
     return syn0, syn1
+
+
+_cbow_hs_step = jax.jit(_cbow_hs_update)
+
+
+@partial(jax.jit, static_argnames=("negative", "use_hs"))
+def _cbow_scan(syn0, syn1, syn1neg, ctx, cmask, targets, labels, points,
+               codes, pmask, valid, lr, *, negative: bool, use_hs: bool):
+    """Many CBOW batches in ONE dispatch (see _sg_scan)."""
+    def body(carry, xs):
+        s0, s1, s1n = carry
+        cx, cm, t, l, p, c, m, v, a = xs
+        if negative:
+            s0, s1n = _cbow_ns_update(s0, s1n, cx, cm, t, l, v, a)
+        if use_hs:
+            s0, s1 = _cbow_hs_update(s0, s1, cx, cm, p, c, m, a)
+        return (s0, s1, s1n), None
+    (syn0, syn1, syn1neg), _ = jax.lax.scan(
+        body, (syn0, syn1, syn1neg),
+        (ctx, cmask, targets, labels, points, codes, pmask, valid, lr))
+    return syn0, syn1, syn1neg
 
 
 # --------------------------------------------------------------------------
@@ -464,7 +487,6 @@ class SequenceVectors:
         # per-sequence alpha: the numpy path's words_seen schedule
         total_words = int(lens.sum()) * max(1, self.epochs)
         sg = self.algo == "skipgram"
-        B = self._eff_batch
         # bound host memory: generate per SHARD of sequences (~1M corpus
         # words => tens of MB of pairs), not per whole epoch — big
         # corpora keep the numpy path's bounded-memory property
@@ -501,11 +523,8 @@ class SequenceVectors:
                             sub_corpus, sub_off, self.window, keep,
                             seed + s0, row_width=2 * self.window)
                         alphas = seq_alpha[row_seq + s0]
-                        for s in range(0, len(centers), B):
-                            self._dispatch_cbow(ctxs[s:s + B],
-                                                cmask[s:s + B],
-                                                centers[s:s + B],
-                                                alphas[s:s + B])
+                        self._dispatch_cbow_many(ctxs, cmask, centers,
+                                                 alphas)
         return True
 
     def _alpha(self, seen: int, total: int) -> float:
@@ -593,18 +612,22 @@ class SequenceVectors:
     #: dispatch count by the same factor
     scan_chunk = 64
 
-    def _dispatch_sg_many(self, ins, outs, alphas):
-        """Shard-sized skip-gram training: groups of `scan_chunk` full
-        batches go to the device as ONE _sg_scan dispatch each; the
-        remainder uses the per-batch step. Negatives are drawn per batch
-        in order, so the rng stream matches the per-batch path and the
-        result is numerically equivalent to dispatching every batch
-        through _dispatch_sg (pinned to 1e-6 by the equivalence test —
-        XLA may reorder float ops inside the scan body)."""
+    def _run_scan_dispatch(self, rows, alphas, lead_fn, scan_fn, tail_fn):
+        """Shared scaffolding for the scan-batched dispatchers: group
+        scan_chunk full batches per device dispatch, thread the table
+        carries across groups, delegate the remainder to the per-batch
+        step. `rows` [n] are the output-table rows (sg labels / cbow
+        centers) that negatives + huffman paths are drawn from — in
+        batch order, so the rng stream matches the per-batch path and
+        the result is numerically equivalent to per-batch dispatching
+        (pinned to 1e-6 by the equivalence tests; XLA may reorder float
+        ops inside the scan body). `lead_fn(sl, nb)` supplies the
+        variant-specific leading xs (sg: inputs; cbow: ctx + mask);
+        `tail_fn(s, e)` dispatches one remainder batch."""
         B = self._eff_batch
         nb = self.scan_chunk
-        n_full = len(ins) // B
-        n_scan = (n_full // nb) * nb
+        n = len(rows)
+        n_scan = ((n // B) // nb) * nb
         ns, hs = self.negative > 0, self.use_hs
         D = self.syn0.shape[1]
         dummy1 = self.syn1 if hs else jnp.zeros((1, D), jnp.float32)
@@ -620,29 +643,56 @@ class SequenceVectors:
             msk = jnp.zeros((nb, B, 1), jnp.float32)
         for g0 in range(0, n_scan, nb):
             sl = slice(g0 * B, (g0 + nb) * B)
-            bi = np.ascontiguousarray(ins[sl]).reshape(nb, B)
-            bo = np.ascontiguousarray(outs[sl]).reshape(nb, B)
+            ro = np.ascontiguousarray(rows[sl]).reshape(nb, B)
             lr = alphas[sl].astype(np.float32).reshape(nb, B)
             if ns:
-                t_list, l_list = zip(*(self._sample_negatives(bo[j])
+                t_list, l_list = zip(*(self._sample_negatives(ro[j])
                                        for j in range(nb)))
                 targets = jnp.asarray(np.stack(t_list))
                 labels = jnp.asarray(np.stack(l_list))
             if hs:
-                pts = jnp.asarray(self._points[bo])
-                cds = jnp.asarray(self._codes[bo])
-                msk = jnp.asarray(self._path_mask[bo])
-            self.syn0, s1, s1n = _sg_scan(
-                self.syn0, dummy1, dummy1n, jnp.asarray(bi),
+                pts = jnp.asarray(self._points[ro])
+                cds = jnp.asarray(self._codes[ro])
+                msk = jnp.asarray(self._path_mask[ro])
+            self.syn0, s1, s1n = scan_fn(
+                self.syn0, dummy1, dummy1n, *lead_fn(sl, nb),
                 targets, labels, pts, cds, msk, valid,
                 jnp.asarray(lr), negative=ns, use_hs=hs)
             if hs:
                 self.syn1 = dummy1 = s1
             if ns:
                 self.syn1neg = dummy1n = s1n
-        for s in range(n_scan * B, len(ins), B):
-            self._dispatch_sg(ins[s:s + B], outs[s:s + B],
-                              alphas[s:s + B])
+        for s in range(n_scan * B, n, B):
+            tail_fn(s, s + B)
+
+    def _dispatch_sg_many(self, ins, outs, alphas):
+        """Shard-sized skip-gram training through _run_scan_dispatch."""
+        B = self._eff_batch
+
+        def lead(sl, nb):
+            return (jnp.asarray(
+                np.ascontiguousarray(ins[sl]).reshape(nb, B)),)
+
+        self._run_scan_dispatch(
+            outs, alphas, lead, _sg_scan,
+            lambda s, e: self._dispatch_sg(ins[s:e], outs[s:e],
+                                           alphas[s:e]))
+
+    def _dispatch_cbow_many(self, ctxs, cmask, centers, alphas):
+        """CBOW twin of _dispatch_sg_many (same scaffolding)."""
+        B = self._eff_batch
+        C = ctxs.shape[1]
+
+        def lead(sl, nb):
+            return (jnp.asarray(
+                        np.ascontiguousarray(ctxs[sl]).reshape(nb, B, C)),
+                    jnp.asarray(np.ascontiguousarray(
+                        cmask[sl]).astype(np.float32).reshape(nb, B, C)))
+
+        self._run_scan_dispatch(
+            centers, alphas, lead, _cbow_scan,
+            lambda s, e: self._dispatch_cbow(ctxs[s:e], cmask[s:e],
+                                             centers[s:e], alphas[s:e]))
 
     def _dispatch_cbow(self, bx, bm, bc, alphas):
         B = self._eff_batch

@@ -147,6 +147,14 @@ def pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
                                           n_microbatches)
     micro_y = y.reshape(M, x.shape[0] // M, *y.shape[1:])
     K = 2 * S  # residual ring: >= max in-flight stage inputs (2S-1)
+    # the loss accumulator carry must match what loss_fn actually
+    # returns (x64-safe): trace it abstractly on one microbatch
+    loss_dtype = jax.eval_shape(
+        lambda p, h, t: loss_fn(stage_fn(p, h), t),
+        jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype),
+                     stacked_params),
+        jax.ShapeDtypeStruct(micro_x.shape[1:], micro_x.dtype),
+        jax.ShapeDtypeStruct(micro_y.shape[1:], micro_y.dtype)).dtype
 
     @partial(shard_map, mesh=mesh,
              in_specs=(param_specs, P(), P()),
@@ -200,7 +208,7 @@ def pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
         z = jnp.zeros_like(mx[0])
         resid0 = jnp.zeros((K,) + z.shape, z.dtype)
         dp0 = jax.tree.map(jnp.zeros_like, p_local)
-        carry0 = (z, z, resid0, dp0, jnp.zeros((), jnp.float32))
+        carry0 = (z, z, resid0, dp0, jnp.zeros((), loss_dtype))
         (_, _, _, dp_acc, loss_acc), _ = jax.lax.scan(
             tick, carry0, jnp.arange(n_ticks))
         # objective = (1/M) sum of per-microbatch mean losses, so the

@@ -132,6 +132,51 @@ class TestWord2Vec:
                                        np.asarray(b.syn1),
                                        rtol=1e-6, atol=1e-7)
 
+    @pytest.mark.parametrize("kwargs", [
+        dict(negative=3, use_hierarchic_softmax=False),
+        dict(negative=0),                                # hs
+        dict(negative=2, use_hierarchic_softmax=True),   # ns + hs together
+    ])
+    def test_cbow_scan_dispatch_matches_per_batch(self, kwargs):
+        """CBOW twin of the sg scan equivalence: _dispatch_cbow_many ==
+        the per-batch _dispatch_cbow loop."""
+        def make():
+            w = Word2Vec(
+                sentence_iterator=CollectionSentenceIterator(corpus(30)),
+                min_word_frequency=1, layer_size=8, window=2, seed=3,
+                batch_size=32, elements_learning_algorithm="cbow",
+                **kwargs)
+            w.build_vocab([s.split() for s in corpus(30)])
+            w._rng = np.random.default_rng(17)
+            return w
+        a, b = make(), make()
+        rng = np.random.default_rng(5)
+        V = a.vocab.num_words()
+        B = a._eff_batch
+        C = 2 * a.window
+        n = B * 5 + 7
+        ctxs = rng.integers(0, V, (n, C)).astype(np.int32)
+        cmask = (rng.random((n, C)) < 0.8).astype(np.float32)
+        cmask[:, 0] = 1.0      # at least one live context slot per row
+        centers = rng.integers(0, V, n).astype(np.int32)
+        alphas = np.full(n, 0.025, np.float32)
+
+        a.scan_chunk = 2
+        a._dispatch_cbow_many(ctxs, cmask, centers, alphas)
+        for s in range(0, n, B):
+            b._dispatch_cbow(ctxs[s:s + B], cmask[s:s + B],
+                             centers[s:s + B], alphas[s:s + B])
+        np.testing.assert_allclose(np.asarray(a.syn0), np.asarray(b.syn0),
+                                   rtol=1e-6, atol=1e-7)
+        if kwargs.get("negative"):
+            np.testing.assert_allclose(np.asarray(a.syn1neg),
+                                       np.asarray(b.syn1neg),
+                                       rtol=1e-6, atol=1e-7)
+        if a.use_hs:
+            np.testing.assert_allclose(np.asarray(a.syn1),
+                                       np.asarray(b.syn1),
+                                       rtol=1e-6, atol=1e-7)
+
     def test_serialization_roundtrip(self, tmp_path):
         w2v = Word2Vec(
             sentence_iterator=CollectionSentenceIterator(corpus(50)),
