@@ -41,11 +41,17 @@ def shard_stage_params(stage_params: list, mesh: Mesh, axis: str = "pipe"):
     return jax.tree.map(lambda a: jax.device_put(a, sh(a)), stacked)
 
 
-def _prepare(stacked_params, x, mesh: Mesh, axis: str,
+def _prepare(stage_fn, stacked_params, x, mesh: Mesh, axis: str,
              n_microbatches: int):
     """Shared schedule setup: validate one-stage-per-device and the
     microbatch split; build the per-stage param sharding specs.
-    Returns (S, M, micro, param_specs)."""
+    Returns (S, M, micro, param_specs).
+
+    The microbatches are cast to the STAGE OUTPUT dtype (traced
+    abstractly) — the pipeline carries activations stage-to-stage, so a
+    type-stable loop needs stage output dtype == stage input dtype; with
+    mixed user dtypes (e.g. f64 params on f32 inputs under x64) the
+    widening the math would do anyway happens once, up front."""
     S = mesh.shape[axis]
     n_stages = jax.tree.leaves(stacked_params)[0].shape[0]
     if n_stages != S:
@@ -58,10 +64,21 @@ def _prepare(stacked_params, x, mesh: Mesh, axis: str,
     if B % M:
         raise ValueError(f"batch {B} not divisible into {M} microbatches")
     micro = x.reshape(M, B // M, *x.shape[1:])
+    p0 = jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype),
+                      stacked_params)
+    h = jax.ShapeDtypeStruct(micro.shape[1:], micro.dtype)
+    try:
+        h_out = jax.eval_shape(stage_fn, p0, h)
+        micro = micro.astype(h_out.dtype)
+    except Exception:
+        # stage_fn may use mesh collectives, which only trace inside the
+        # shard_map body (axes unbound here) — keep the input dtype; the
+        # user then owns type stability, as before
+        h_out = None
     # params: each device sees its own stage's slice (leading axis 1)
     param_specs = jax.tree.map(
         lambda a: P(*([axis] + [None] * (a.ndim - 1))), stacked_params)
-    return S, M, micro, param_specs
+    return S, M, micro, param_specs, h_out
 
 
 def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh,
@@ -74,8 +91,8 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh,
     batch. Differentiable (fori_loop-free: a lax.scan drives the
     schedule, ppermute moves activations stage->stage).
     """
-    S, M, micro, param_specs = _prepare(stacked_params, x, mesh, axis,
-                                        n_microbatches)
+    S, M, micro, param_specs, _ = _prepare(stage_fn, stacked_params, x,
+                                           mesh, axis, n_microbatches)
     B = x.shape[0]
 
     @partial(shard_map, mesh=mesh,
@@ -143,18 +160,25 @@ def pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
     contributes the grads of its own stage). Input-grads (dx) are not
     returned — this is a train step, not a general VJP.
     """
-    S, M, micro_x, param_specs = _prepare(stacked_params, x, mesh, axis,
-                                          n_microbatches)
+    S, M, micro_x, param_specs, h_out = _prepare(stage_fn, stacked_params,
+                                                 x, mesh, axis,
+                                                 n_microbatches)
     micro_y = y.reshape(M, x.shape[0] // M, *y.shape[1:])
     K = 2 * S  # residual ring: >= max in-flight stage inputs (2S-1)
     # the loss accumulator carry must match what loss_fn actually
-    # returns (x64-safe): trace it abstractly on one microbatch
-    loss_dtype = jax.eval_shape(
-        lambda p, h, t: loss_fn(stage_fn(p, h), t),
-        jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype),
-                     stacked_params),
-        jax.ShapeDtypeStruct(micro_x.shape[1:], micro_x.dtype),
-        jax.ShapeDtypeStruct(micro_y.shape[1:], micro_y.dtype)).dtype
+    # returns (x64-safe): trace it abstractly on the stage-output aval
+    # from _prepare; when that was untraceable (collective-using
+    # stage_fn) fall back to a dtype-promotion estimate
+    try:
+        if h_out is None:
+            raise TypeError
+        loss_dtype = jax.eval_shape(
+            loss_fn, h_out,
+            jax.ShapeDtypeStruct(micro_y.shape[1:], micro_y.dtype)).dtype
+    except Exception:
+        loss_dtype = jnp.result_type(
+            jnp.float32, micro_x.dtype, micro_y.dtype,
+            *[a.dtype for a in jax.tree.leaves(stacked_params)])
 
     @partial(shard_map, mesh=mesh,
              in_specs=(param_specs, P(), P()),

@@ -30,6 +30,11 @@ def test_word2vec_text():
     assert w2v.get_word_vector("dog") is not None
 
 
+def test_pipeline_training():
+    l0, loss = _run("pipeline_training", steps=40)
+    assert loss < 0.5 * l0
+
+
 def test_mesh_training():
     acc = _run("mesh_training", steps=20)
     assert acc > 0.5

@@ -204,6 +204,41 @@ class TestPipelineTrainStep:
         assert (gpipe_big - gpipe_small) > 2 * (ours_big - ours_small)
 
 
+class TestCollectiveStageFn:
+    """A stage_fn that uses mesh collectives (tensor-parallel math inside
+    a pipeline stage) only traces inside the shard_map body — the dtype
+    pre-trace must fall back gracefully, not crash at setup."""
+
+    def _mesh2d(self):
+        return Mesh(np.asarray(jax.devices()[:8]).reshape(4, 2),
+                    ("pipe", "model"))
+
+    def test_pipeline_with_collective_stage(self):
+        mesh = self._mesh2d()
+        W = 8
+        stages = _stages(4, W, seed=7)
+        stacked = shard_stage_params(stages, mesh)
+        x = jnp.asarray(RNG.standard_normal((8, W)), jnp.float32)
+        y = jnp.asarray(RNG.standard_normal((8, W)), jnp.float32)
+
+        def stage_fn(p, h):
+            # replicated inputs -> pmean is a numeric no-op, but it only
+            # traces where the 'model' axis is bound (inside shard_map)
+            return jax.lax.pmean(_stage_fn(p, h), "model")
+
+        out = pipeline_apply(stage_fn, stacked, x, mesh)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(_sequential(stages, x)),
+                                   atol=1e-5)
+        loss, grads = pipeline_train_step(stage_fn, _loss_fn, stacked,
+                                          x, y, mesh)
+        l_ref, g_ref = jax.value_and_grad(
+            lambda s: jnp.mean((_sequential(s, x) - y) ** 2))(stages)
+        np.testing.assert_allclose(float(loss), float(l_ref), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(grads["b"][1]),
+                                   np.asarray(g_ref[1]["b"]), atol=1e-5)
+
+
 def test_stage_count_must_match_axis():
     """More stacked stages than pipe devices must raise, not silently
     drop stages."""
