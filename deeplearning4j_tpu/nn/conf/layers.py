@@ -110,17 +110,20 @@ def stream_capacity(layers):
     return limit
 
 
-def check_stream_budget(net, t: int, layers) -> int:
+def check_stream_budget(net, t: int, layers, pad: int = 0) -> int:
     """Host-side guard for streaming inference: dynamic_update_slice
     CLAMPS out-of-range starts, so streaming past a layer's KV-cache /
     positional capacity would silently corrupt instead of erroring.
     Tracks net._stream_pos (reset by rnn_clear_previous_state).
 
+    `pad` left-pad positions (packed padded priming) are free: they
+    never enter a cache nor advance a position.
+
     Validates only — returns the would-be position; the caller commits
     it to net._stream_pos AFTER the forward succeeds, so neither a
     rejected oversized call nor a forward-raised error (e.g. a
     mid-stream mask) inflates the counter past the real cache state."""
-    new_pos = getattr(net, "_stream_pos", 0) + int(t)
+    new_pos = getattr(net, "_stream_pos", 0) + int(t) - int(pad)
     limit = stream_capacity(layers)
     if limit is not None and new_pos > limit:
         raise ValueError(
@@ -832,20 +835,34 @@ class PositionalEmbeddingLayer(FeedForwardLayerConf):
         return {"P": p.astype(jnp.float32)}, {}
 
     def apply(self, params, x, state, *, train=False, rng=None, mask=None,
-              stream=False):
+              stream=False, pad_left=None):
         t = x.shape[2]
         if t > self.max_length:
             raise ValueError(f"sequence length {t} exceeds max_length "
                              f"{self.max_length}")
+        if pad_left is not None and not stream:
+            raise ValueError("pad_left is only meaningful for streaming")
         if stream:
             off = state.get("pos_offset")
             if off is None:
                 off = jnp.zeros((), jnp.int32)
-            z = jnp.zeros((), off.dtype)
-            emb = jax.lax.dynamic_slice(
-                params["P"], (z, off), (params["P"].shape[0], t))
+            if pad_left is None:
+                z = jnp.zeros((), off.dtype)
+                emb = jax.lax.dynamic_slice(
+                    params["P"], (z, off), (params["P"].shape[0], t))
+                new_off = off + t
+            else:
+                # left-padded packed chunk: chunk position i holds the
+                # (cumsum-1)-th REAL token, so it gathers that absolute
+                # position's embedding; pads (clamped to 0) are garbage
+                # rows discarded downstream and never advance the offset
+                m0 = jnp.arange(t) >= pad_left
+                cum = jnp.cumsum(m0.astype(off.dtype))
+                idx = jnp.clip(off + cum - 1, 0, self.max_length - 1)
+                emb = params["P"][:, idx]
+                new_off = off + cum[-1]
             y = x + emb[None].astype(x.dtype)
-            new_state = {**state, "pos_offset": off + t}
+            new_state = {**state, "pos_offset": new_off}
         else:
             y = x + params["P"][None, :, :t].astype(x.dtype)
             new_state = state
@@ -939,8 +956,10 @@ class SelfAttentionLayer(FeedForwardLayerConf):
         return p, {}
 
     def apply(self, params, x, state, *, train=False, rng=None, mask=None,
-              stream=False):
+              stream=False, pad_left=None):
         from deeplearning4j_tpu.parallel.sequence import blockwise_attention
+        if pad_left is not None and not stream:
+            raise ValueError("pad_left is only meaningful for streaming")
         x = self.maybe_dropout_input(x, train, rng)
         n, f, t = x.shape
         h = self.n_heads
@@ -961,7 +980,8 @@ class SelfAttentionLayer(FeedForwardLayerConf):
         if stream:
             # cache the Hkv-sized K/V (the GQA memory win), expand at
             # attend time inside _stream_attend
-            o, state = self._stream_attend(q, k, v, state, mask)
+            o, state = self._stream_attend(q, k, v, state, mask,
+                                           pad_left=pad_left)
         else:
             k, v = self._expand_kv(k, v)
             # variable-length batches: mask KEYS with -inf score bias
@@ -974,7 +994,7 @@ class SelfAttentionLayer(FeedForwardLayerConf):
         y = jnp.transpose(o, (0, 2, 1))                     # [N,F,T]
         return _act.get(self.activation)(y), state
 
-    def _stream_attend(self, q, k, v, state, mask=None):
+    def _stream_attend(self, q, k, v, state, mask=None, pad_left=None):
         """Incremental decode: append k/v to the carried cache, attend q
         against it. Positions past cache_length are a caller error (the
         dynamic_update_slice would clamp) — size cache_length to the max
@@ -984,7 +1004,17 @@ class SelfAttentionLayer(FeedForwardLayerConf):
         carried in the cache as kv_mask so padded positions stay masked
         on every later step. Masked streaming must start masked: the
         kv_mask buffer is created on the first chunk (a mask appearing
-        mid-stream would leave earlier chunks' validity unrecorded)."""
+        mid-stream would leave earlier chunks' validity unrecorded).
+
+        `pad_left` (traced scalar) selects PACKED accounting for a
+        left-padded chunk (util/decoding's single-dispatch priming): the
+        first pad_left positions never enter the cache (their writes
+        route to an out-of-range dump slot and are dropped), real tokens
+        take consecutive slots/positions as if the pads did not exist —
+        so one bucketed jit shape serves every prompt length with
+        results identical to unpadded chunked priming. Pad queries
+        attend nothing and produce discarded rows. Mutually exclusive
+        with `mask` (pads are non-existent, not masked-but-resident)."""
         if self.cache_length <= 0:
             raise ValueError(
                 "SelfAttentionLayer streaming needs cache_length > 0")
@@ -994,41 +1024,70 @@ class SelfAttentionLayer(FeedForwardLayerConf):
         hkv = k.shape[1]                 # cache holds n_kv_heads heads
         L = self.cache_length
         kc = state.get("kv_k")
-        if kc is None:
+        fresh = kc is None
+        if fresh:
             kc = jnp.zeros((n, hkv, L, d), q.dtype)
             vc = jnp.zeros((n, hkv, L, d), q.dtype)
             pos = jnp.zeros((), jnp.int32)
         else:
             vc, pos = state["kv_v"], state["kv_pos"]
+        if pad_left is not None:
+            if mask is not None:
+                raise ValueError("pad_left and mask are mutually "
+                                 "exclusive in streaming attention")
+            if state.get("kv_mask") is not None:
+                raise ValueError(
+                    "left-padded (packed) priming cannot follow masked "
+                    "streaming — packed writes would leave the carried "
+                    "kv_mask unset for their slots; restart the stream "
+                    "(rnn_clear_previous_state)")
+            m0 = jnp.arange(t) >= pad_left              # [T] valid flags
+            cum = jnp.cumsum(m0.astype(pos.dtype))
+            q_pos = pos + cum - 1                       # pads: pos-1
+            n_new = cum[-1]
+        else:
+            m0 = None
+            q_pos = pos + jnp.arange(t, dtype=pos.dtype)
+            n_new = t
         if self.rope:
-            abs_pos = pos + jnp.arange(t, dtype=pos.dtype)
+            abs_pos = q_pos if m0 is None else jnp.maximum(q_pos, 0)
             q = self._rope(q, abs_pos)
             k = self._rope(k, abs_pos)
         if self.window is not None:
             return self._stream_attend_rolling(
-                q, k, v, state, kc, vc, pos, mask,
-                fresh=state.get("kv_k") is None)
+                q, k, v, state, kc, vc, pos, mask, fresh=fresh,
+                m0=m0, q_pos=q_pos, n_new=n_new)
         z = jnp.zeros((), pos.dtype)
-        kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
-                                          (z, z, pos, z))
-        vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
-                                          (z, z, pos, z))
+        if m0 is None:
+            kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
+                                              (z, z, pos, z))
+            vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
+                                              (z, z, pos, z))
+        else:
+            # packed scatter: pads route to the out-of-range dump slot L
+            # and are DROPPED — they never occupy cache capacity
+            slots = jnp.where(m0, q_pos, L)
+            kc = kc.at[:, :, slots, :].set(k.astype(kc.dtype), mode="drop")
+            vc = vc.at[:, :, slots, :].set(v.astype(vc.dtype), mode="drop")
         kc, vc = _shard_cache(kc, 2), _shard_cache(vc, 2)
-        km = self._stream_mask_update(
-            state, mask, n, t, L, fresh=state.get("kv_k") is None,
-            write=lambda km, m: jax.lax.dynamic_update_slice(km, m, (z, pos)))
-        km = _shard_cache(km, 1)
+        if m0 is None:
+            km = self._stream_mask_update(
+                state, mask, n, t, L, fresh=fresh,
+                write=lambda km, m: jax.lax.dynamic_update_slice(
+                    km, m, (z, pos)))
+            km = _shard_cache(km, 1)
+        else:
+            km = None
         # grouped attend against the UN-expanded cache: q reshaped to
         # [N, Hkv, reps, T, D] — materializing a repeated cache would
         # forfeit GQA's decode bandwidth win
-        # query at absolute position pos+i sees cache slots <= pos+i
+        # query at absolute position p sees cache slots <= p
         k_idx = jnp.arange(L)
-        q_pos = pos + jnp.arange(t, dtype=pos.dtype)
         valid = (k_idx[None, :] <= q_pos[:, None])[None]    # [1, T, L]
         if km is not None:
             valid = valid & km[:, None, :]                  # [N, T, L]
         o = self._grouped_attend(q, kc, vc, valid)
-        out = {**state, "kv_k": kc, "kv_v": vc, "kv_pos": pos + t}
+        out = {**state, "kv_k": kc, "kv_v": vc, "kv_pos": pos + n_new}
         if km is not None:
             out["kv_mask"] = km
         return o, out
@@ -1067,12 +1126,20 @@ class SelfAttentionLayer(FeedForwardLayerConf):
         return o.reshape(n, self.n_heads, t, d).astype(q.dtype)
 
     def _stream_attend_rolling(self, q, k, v, state, kc, vc, pos,
-                               mask=None, *, fresh):
+                               mask=None, *, fresh, m0=None, q_pos=None,
+                               n_new=None):
         """Windowed streaming with a ROLLING cache: slots are reused
         modulo cache_length, so generation length is unbounded with
         bounded memory (cache_length >= window keeps every in-window key
         resident; evicted keys are out of the window by construction).
-        kv_abs tracks each slot's absolute position (-1 = empty)."""
+        kv_abs tracks each slot's absolute position (-1 = empty).
+
+        `m0`/`q_pos`/`n_new` arrive from _stream_attend when the chunk is
+        left-padded (packed accounting — see there): pad writes route to
+        the dump slot L and are dropped, so pads consume neither slots
+        nor positions. The static chunk-size guards below use the padded
+        length t (conservative: a padded chunk needs its full bucket to
+        fit, so pick a bucket <= cache_length)."""
         n, _, t, d = q.shape
         hkv = k.shape[1]
         L = self.cache_length
@@ -1095,15 +1162,25 @@ class SelfAttentionLayer(FeedForwardLayerConf):
         kv_abs = state.get("kv_abs")
         if kv_abs is None:
             kv_abs = jnp.full((L,), -1, jnp.int32)
-        q_pos = pos + jnp.arange(t, dtype=pos.dtype)
-        slots = q_pos % L
-        kc = kc.at[:, :, slots, :].set(k.astype(kc.dtype))
-        vc = vc.at[:, :, slots, :].set(v.astype(vc.dtype))
+        if q_pos is None:
+            q_pos = pos + jnp.arange(t, dtype=pos.dtype)
+            n_new = t
+        if m0 is None:
+            slots = q_pos % L
+            kc = kc.at[:, :, slots, :].set(k.astype(kc.dtype))
+            vc = vc.at[:, :, slots, :].set(v.astype(vc.dtype))
+            kv_abs = kv_abs.at[slots].set(q_pos.astype(kv_abs.dtype))
+            km = self._stream_mask_update(
+                state, mask, n, t, L, fresh=fresh,
+                write=lambda km, m: km.at[:, slots].set(m))
+        else:
+            slots = jnp.where(m0, q_pos % L, L)      # pads -> dump, dropped
+            kc = kc.at[:, :, slots, :].set(k.astype(kc.dtype), mode="drop")
+            vc = vc.at[:, :, slots, :].set(v.astype(vc.dtype), mode="drop")
+            kv_abs = kv_abs.at[slots].set(q_pos.astype(kv_abs.dtype),
+                                          mode="drop")
+            km = None
         kc, vc = _shard_cache(kc, 2), _shard_cache(vc, 2)
-        kv_abs = kv_abs.at[slots].set(q_pos.astype(kv_abs.dtype))
-        km = self._stream_mask_update(
-            state, mask, n, t, L, fresh=fresh,
-            write=lambda km, m: km.at[:, slots].set(m))
         km = _shard_cache(km, 1)
         reps = self.n_heads // hkv
         qg = q.astype(jnp.float32).reshape(n, hkv, reps, t, d)
@@ -1119,7 +1196,7 @@ class SelfAttentionLayer(FeedForwardLayerConf):
         o = jnp.einsum("ngrtl,ngld->ngrtd", p, vc.astype(jnp.float32))
         o = o.reshape(n, self.n_heads, t, d).astype(q.dtype)
         out = {**state, "kv_k": kc, "kv_v": vc, "kv_abs": kv_abs,
-               "kv_pos": pos + t}
+               "kv_pos": pos + n_new}
         if km is not None:
             out["kv_mask"] = km
         return o, out

@@ -197,18 +197,28 @@ class ComputationGraph:
     # ------------------------------------------------------------------
     def _forward(self, params, state, inputs: Dict[str, Any], *, train, rng,
                  fmasks: Optional[Dict[str, Any]] = None, carry_rnn=False,
-                 stream=False, preout_of=None):
+                 stream=False, pad=None, preout_of=None):
         """Topo-order forward (ref: feedForward :1361). Returns
         (vertex_activations dict, new_state, masks dict). `preout_of` is a
         vertex name or a collection of names whose output layers should
         yield pre-activation outputs — the loss computes every output's
         preout in this ONE pass (ref: computeGradientAndScore :1298 runs a
-        single feedForward for all outputs)."""
+        single feedForward for all outputs).
+
+        `pad` (traced scalar) marks a left-padded streaming chunk
+        (single-input graphs): non-streaming vertices see an ordinary key
+        mask; streaming cache layers get pad_left for packed slot
+        accounting (pads never enter caches) — see
+        SelfAttentionLayer._stream_attend."""
         preout_set = ({preout_of} if isinstance(preout_of, str)
                       else set(preout_of or ()))
         fused_plan, fused_skip = self._fusion()
         acts: Dict[str, Any] = dict(inputs)
         masks: Dict[str, Any] = dict(fmasks or {})
+        if pad is not None:
+            masks = {name: jnp.broadcast_to(
+                jnp.arange(a.shape[-1]) >= pad, (a.shape[0], a.shape[-1]))
+                for name, a in inputs.items()}
         new_state: Dict[str, Any] = {}
         for i, name in enumerate(self._topo):
             v = self.conf.vertices[name]
@@ -250,10 +260,17 @@ class ComputationGraph:
             else:
                 # stream (inference KV-cache decode) is distinct from
                 # carry_rnn (tbptt h/c carry)
-                extra = ({"stream": stream}
-                         if getattr(v, "supports_streaming", False) else {})
+                extra = {}
+                m_i = mask
+                if getattr(v, "supports_streaming", False):
+                    extra["stream"] = stream
+                    if pad is not None:
+                        # packed accounting replaces the mask (see
+                        # MultiLayerNetwork._forward)
+                        extra["pad_left"] = pad
+                        m_i = None
                 y, s_new = v.apply(params[name], xs, v_state, train=train,
-                                   rng=rng_i, mask=mask, **extra)
+                                   rng=rng_i, mask=m_i, **extra)
                 acts[name] = y
                 new_state[name] = s_new
             masks[name] = v.output_mask(in_masks, self._vertex_input_types[name])
@@ -476,34 +493,64 @@ class ComputationGraph:
         return e
 
 
-    def rnn_time_step(self, *inputs, masks=None):
+    def rnn_time_step(self, *inputs, masks=None, pad_left=None):
         """Stateful streaming inference over the graph, carrying RNN h/c in
         self.state across calls (ref: ComputationGraph.rnnTimeStep).
         `masks` maps network-input name -> this chunk's [N, T] key mask
         for padded variable-length batches; attention vertices carry it
-        in the KV cache so padded positions stay masked on later steps."""
+        in the KV cache so padded positions stay masked on later steps.
+
+        `pad_left` (int, mutually exclusive with masks; single-input
+        graphs only) marks the first pad_left positions as LEFT padding
+        with packed accounting — pads never enter caches nor consume
+        streaming positions, so any prompt length primes in one dispatch
+        at a bucketed shape (see MultiLayerNetwork.rnn_time_step)."""
         # stream-cache sharding config keys the cache: flipping the
         # process-wide setting retraces for every net on next use
         from deeplearning4j_tpu.nn.conf import layers as _L
-        key = ("rnn_step", _L._STREAM_CACHE_SHARDING)
+        padded = pad_left is not None
+        key = ("rnn_step", padded, _L._STREAM_CACHE_SHARDING)
         if key not in self._jit_cache:
-            def fwd(params, state, ins, rng, fmasks):
-                acts, new_state, _ = self._forward(params, state, ins,
-                                                   train=False, rng=rng,
-                                                   fmasks=fmasks,
-                                                   carry_rnn=True,
-                                                   stream=True)
-                return [acts[o] for o in self.conf.network_outputs], new_state
+            if padded:
+                def fwd(params, state, ins, rng, pad):
+                    acts, new_state, _ = self._forward(
+                        params, state, ins, train=False, rng=rng,
+                        fmasks=None, carry_rnn=True, stream=True, pad=pad)
+                    return [acts[o] for o in
+                            self.conf.network_outputs], new_state
+            else:
+                def fwd(params, state, ins, rng, fmasks):
+                    acts, new_state, _ = self._forward(
+                        params, state, ins, train=False, rng=rng,
+                        fmasks=fmasks, carry_rnn=True, stream=True)
+                    return [acts[o] for o in
+                            self.conf.network_outputs], new_state
 
             self._jit_cache[key] = jax.jit(fwd)
         if len(inputs) == 1 and isinstance(inputs[0], dict):
             ins = self._as_input_dict(inputs[0])
         else:
             ins = self._as_input_dict(list(inputs))
-        fmasks = self._as_mask_dict(masks)
-        new_pos_map = self._check_graph_stream_budget(ins)
-        outs, new_state = self._jit_cache[key](self.params, self.state, ins,
-                                               jax.random.PRNGKey(0), fmasks)
+        if padded:
+            if masks is not None:
+                raise ValueError("pad_left and masks are mutually exclusive")
+            if len(ins) != 1:
+                raise ValueError("pad_left needs a single-input graph "
+                                 "(the pad applies to THE streamed input)")
+            pad_left = int(pad_left)
+            t = next(iter(ins.values())).shape[-1]
+            if not 0 <= pad_left < t:
+                raise ValueError(f"pad_left {pad_left} out of range for a "
+                                 f"chunk of {t} positions")
+            new_pos_map = self._check_graph_stream_budget(ins, pad=pad_left)
+            outs, new_state = self._jit_cache[key](
+                self.params, self.state, ins, jax.random.PRNGKey(0),
+                jnp.asarray(pad_left, jnp.int32))
+        else:
+            fmasks = self._as_mask_dict(masks)
+            new_pos_map = self._check_graph_stream_budget(ins)
+            outs, new_state = self._jit_cache[key](
+                self.params, self.state, ins, jax.random.PRNGKey(0), fmasks)
         self.state = new_state
         self._stream_pos_map = new_pos_map
         return outs[0] if len(outs) == 1 else outs
@@ -531,14 +578,17 @@ class ComputationGraph:
             lens[name] = next((l for l in slens if l is not None), None)
         return lens
 
-    def _check_graph_stream_budget(self, ins):
+    def _check_graph_stream_budget(self, ins, pad: int = 0):
         """Per-vertex streaming budget: each streaming layer is charged
         the time length of the activation actually reaching it — in a
         multi-input graph (e.g. seq2seq decode re-feeding the full
         encoder sequence each step, or an encoder path collapsed through
         LastTimeStep+DuplicateToTimeSeries) different caches advance by
-        different amounts. Validates every vertex, returning the counter
-        updates; the caller commits them after the forward succeeds."""
+        different amounts. `pad` left-pad positions (packed padded
+        priming; single-input graphs, so every temporal length carries
+        the same pad) are free. Validates every vertex, returning the
+        counter updates; the caller commits them after the forward
+        succeeds."""
         lens = self._vertex_time_lengths(ins)
         pos = getattr(self, "_stream_pos_map", {})
         updates = {}
@@ -552,7 +602,7 @@ class ComputationGraph:
                      None)
             if t is None:
                 continue
-            new_pos = pos.get(name, 0) + t
+            new_pos = pos.get(name, 0) + t - pad
             cap = stream_capacity([layer])
             if cap is not None and new_pos > cap:
                 raise ValueError(

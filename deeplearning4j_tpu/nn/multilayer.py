@@ -106,15 +106,23 @@ class MultiLayerNetwork:
     # forward
     # ------------------------------------------------------------------
     def _forward(self, params, state, x, *, train, rng, fmask=None,
-                 carry_rnn=False, stream=False,
+                 carry_rnn=False, stream=False, pad=None,
                  upto: Optional[int] = None):
         """Pure forward pass. Returns (activation_list, new_state).
 
         activation_list[i] is the OUTPUT of layer i (post preprocessor+layer).
+
+        `pad` (traced scalar) marks a left-padded streaming chunk:
+        non-streaming layers (LSTM h/c carry-through on masked steps) see
+        an ordinary key mask, while streaming cache layers get pad_left
+        for packed slot accounting (pads never enter caches).
         """
         acts = []
         new_state = {}
         mask = fmask
+        if pad is not None:
+            mask = jnp.broadcast_to(jnp.arange(x.shape[-1]) >= pad,
+                                    (x.shape[0], x.shape[-1]))
         its = self.conf.layer_input_types()
         h = x
         n = len(self.layers) if upto is None else upto
@@ -140,10 +148,16 @@ class MultiLayerNetwork:
             # stream (inference KV-cache decode) is distinct from
             # carry_rnn (tbptt h/c carry during training): tbptt trains
             # attention full-context per chunk
-            extra = ({"stream": stream}
-                     if getattr(layer, "supports_streaming", False) else {})
+            extra = {}
+            m_i = mask
+            if getattr(layer, "supports_streaming", False):
+                extra["stream"] = stream
+                if pad is not None:
+                    # packed accounting replaces the mask for cache layers
+                    extra["pad_left"] = pad
+                    m_i = None
             h, s_new = layer.apply(p_i, h, li_state, train=train,
-                                   rng=rng_i, mask=mask, **extra)
+                                   rng=rng_i, mask=m_i, **extra)
             mask = layer.output_mask(mask, its[i])
             new_state[str(i)] = s_new
             acts.append(h)
@@ -237,20 +251,28 @@ class MultiLayerNetwork:
         return self._jit_cache[key]
 
     def _get_output_fn(self, train: bool, carry_rnn: bool,
-                       stream: bool = False):
+                       stream: bool = False, padded: bool = False):
         # the process-wide stream-cache sharding config is part of the
         # key: flipping it retraces the step for EVERY net on next use
         # (a stale compiled step would silently keep the old layout)
         from deeplearning4j_tpu.nn.conf import layers as _L
-        key = ("out", train, carry_rnn, stream,
+        key = ("out", train, carry_rnn, stream, padded,
                _L._STREAM_CACHE_SHARDING if stream else None)
         if key not in self._jit_cache:
-            def fwd(params, state, x, rng, fmask):
-                acts, new_state = self._forward(params, state, x, train=train,
-                                                rng=rng, fmask=fmask,
-                                                carry_rnn=carry_rnn,
-                                                stream=stream)
-                return acts[-1], new_state
+            if padded:
+                # left-padded packed chunk: pad count is a TRACED scalar,
+                # so every prompt length shares this one compiled shape
+                def fwd(params, state, x, rng, pad):
+                    acts, new_state = self._forward(
+                        params, state, x, train=train, rng=rng, fmask=None,
+                        carry_rnn=carry_rnn, stream=stream, pad=pad)
+                    return acts[-1], new_state
+            else:
+                def fwd(params, state, x, rng, fmask):
+                    acts, new_state = self._forward(
+                        params, state, x, train=train, rng=rng, fmask=fmask,
+                        carry_rnn=carry_rnn, stream=stream)
+                    return acts[-1], new_state
 
             self._jit_cache[key] = jax.jit(fwd)
         return self._jit_cache[key]
@@ -393,18 +415,40 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     # RNN streaming state (ref: rnnTimeStep :~2300, rnnClearPreviousState)
     # ------------------------------------------------------------------
-    def rnn_time_step(self, x, mask=None):
+    def rnn_time_step(self, x, mask=None, pad_left=None):
         """Stateful streaming inference: feeds one (or more) timesteps,
         carrying h/c (and attention KV caches) across calls
         (ref: rnnTimeStep). `mask` is this chunk's [N, T] key mask for
         padded variable-length batches; attention layers carry it in the
-        KV cache so padded positions stay masked on later steps."""
+        KV cache so padded positions stay masked on later steps.
+
+        `pad_left` (int, mutually exclusive with mask) marks the first
+        pad_left positions as LEFT padding with packed accounting: pads
+        never enter caches nor consume streaming positions, so an
+        arbitrary-length prompt primes in ONE dispatch at a bucketed
+        shape (util/decoding pads to a power of two) with results
+        identical to unpadded chunked priming. The pad count rides the
+        jit as a traced scalar — one compiled shape per bucket."""
         x = jnp.asarray(x)
-        new_pos = check_stream_budget(self, x.shape[-1], self.layers)
-        fn = self._get_output_fn(False, True, stream=True)
-        out, new_state = fn(self.params, self.state, x,
-                            jax.random.PRNGKey(0),
-                            None if mask is None else jnp.asarray(mask))
+        if pad_left is not None:
+            if mask is not None:
+                raise ValueError("pad_left and mask are mutually exclusive")
+            pad_left = int(pad_left)
+            if not 0 <= pad_left < x.shape[-1]:
+                raise ValueError(f"pad_left {pad_left} out of range for a "
+                                 f"chunk of {x.shape[-1]} positions")
+            new_pos = check_stream_budget(self, x.shape[-1], self.layers,
+                                          pad=pad_left)
+            fn = self._get_output_fn(False, True, stream=True, padded=True)
+            out, new_state = fn(self.params, self.state, x,
+                                jax.random.PRNGKey(0),
+                                jnp.asarray(pad_left, jnp.int32))
+        else:
+            new_pos = check_stream_budget(self, x.shape[-1], self.layers)
+            fn = self._get_output_fn(False, True, stream=True)
+            out, new_state = fn(self.params, self.state, x,
+                                jax.random.PRNGKey(0),
+                                None if mask is None else jnp.asarray(mask))
         self._stream_pos = new_pos
         self.state = new_state
         return out

@@ -97,30 +97,89 @@ def _prime(net, ids, vocab: int, chunk_max: int = None):
 
 
 def _width_bucket(w: int) -> int:
-    """Round a beam width up to the next power of two — decode-step jit
-    shapes are per-bucket, not per-width."""
+    """Round up to the next power of two — jit shapes are per-bucket,
+    not per-value (beam widths for the decode step; prompt lengths for
+    the padded prime)."""
     b = 1
     while b < w:
         b *= 2
     return b
 
 
+def _stream_layers(net):
+    """Every layer of `net` that may carry streaming state: the layer
+    list of a MultiLayerNetwork, or the vertex-wrapped layers of a
+    ComputationGraph."""
+    for l in getattr(net, "layers", None) or []:
+        yield l
+    vertices = getattr(getattr(net, "conf", None), "vertices", None) or {}
+    for v in vertices.values():
+        l = getattr(v, "layer", None)
+        if l is not None:
+            yield l
+
+
+def _prime_bucket_cap(net):
+    """Largest safe padded-prime bucket: the smallest streaming capacity
+    over the net's layers, counting a windowed (rolling-cache) layer's
+    cache_length too — its FRESH priming chunk must fit the cache even
+    though its stream is otherwise unbounded. None = uncapped (no
+    capacity-bearing layers)."""
+    cap = None
+    for l in _stream_layers(net):
+        if not getattr(l, "supports_streaming", False):
+            continue
+        for a in ("max_length", "cache_length"):
+            v = getattr(l, a, 0)
+            if v:
+                cap = v if cap is None else min(cap, v)
+    return cap
+
+
+def _prime_padded(net, ids, vocab: int, chunk_max: int = None):
+    """Single-dispatch priming: LEFT-pad the prompt to its power-of-two
+    bucket and feed ONE rnn_time_step(pad_left=...) with packed pad
+    accounting — pads never enter the streaming caches nor consume
+    positions, so results are identical to chunked priming while every
+    prompt length shares at most log2(max bucket) jit shapes and exactly
+    one dispatch. The bucket is capped at the net's smallest streaming
+    capacity (padding past it would trip static capacity checks); a
+    prompt longer than that capacity — legal for rolling-window streams,
+    whose length is unbounded — falls back to chunked priming, which has
+    no minimum chunk shape."""
+    L = len(ids)
+    P = _width_bucket(L)
+    cap = _prime_bucket_cap(net)
+    if cap is not None and P > cap:
+        if cap < L:            # no padded bucket can hold this prompt
+            return _prime(net, ids, vocab, chunk_max)
+        P = cap                # pad exactly to capacity: still one shape
+    pad = P - L
+    x = _one_hot(np.asarray([0] * pad + list(ids))[None, :], vocab)
+    x[:, :, :pad] = 0.0       # pads carry no token (masked anyway)
+    return net.rnn_time_step(x, pad_left=pad)
+
+
 def sample_stream(net, seed_ids, steps: int, vocab_size: int,
                   temperature: float = 1.0,
                   rng: Optional[np.random.Generator] = None,
                   max_length: Optional[int] = None,
-                  prime_chunk_max: Optional[int] = None) -> List[int]:
+                  prime_chunk_max: Optional[int] = None,
+                  prime_padded: bool = False) -> List[int]:
     """Temperature sampling with KV-cache / stored-state incremental
     decoding: prime once with the seed, then one single-position forward
     per generated token (the reference's rnnTimeStep generation loop;
     identical distribution to a padded full forward — tested).
     `prime_chunk_max` overrides the process default (set_prime_chunk_max)
-    for this call only."""
+    for this call only; `prime_padded=True` instead primes the whole
+    prompt in ONE left-padded dispatch (see _prime_padded)."""
     _check_seed(seed_ids, steps, max_length)
     rng = rng or np.random.default_rng(0)
     ids = list(seed_ids)
     net.rnn_clear_previous_state()
-    out = _prime(net, ids, vocab_size, prime_chunk_max)
+    out = (_prime_padded(net, ids, vocab_size, prime_chunk_max)
+           if prime_padded
+           else _prime(net, ids, vocab_size, prime_chunk_max))
     for i in range(steps):
         if max_length is not None and len(ids) >= max_length:
             break
@@ -136,7 +195,8 @@ def sample_stream(net, seed_ids, steps: int, vocab_size: int,
 def beam_search(net, seed_ids, steps: int, vocab_size: int,
                 beam_width: int = 4,
                 max_length: Optional[int] = None,
-                prime_chunk_max: Optional[int] = None
+                prime_chunk_max: Optional[int] = None,
+                prime_padded: bool = False
                 ) -> Tuple[List[int], float]:
     """Highest-log-prob continuation of `seed_ids` by beam search.
 
@@ -144,7 +204,9 @@ def beam_search(net, seed_ids, steps: int, vocab_size: int,
     or ComputationGraph, single one-hot [N,V,T] input). `max_length`
     bounds seed+generation (None = unbounded; required finite for models
     with positional tables or non-rolling caches). `prime_chunk_max`
-    overrides the process default (set_prime_chunk_max) per call."""
+    overrides the process default (set_prime_chunk_max) per call;
+    `prime_padded=True` primes the whole prompt in ONE left-padded
+    dispatch (see _prime_padded)."""
     V = vocab_size
     _check_seed(seed_ids, steps, max_length)
     W = min(beam_width, V)     # top-k can't exceed the vocab
@@ -154,7 +216,9 @@ def beam_search(net, seed_ids, steps: int, vocab_size: int,
     # prime ONCE at batch 1 (bucketed chunks), then broadcast the carried
     # state to the padded beam batch; pad rows never enter scoring (the
     # logp slice below keeps only the first W rows)
-    out = _prime(net, seed_ids, V, prime_chunk_max)
+    out = (_prime_padded(net, seed_ids, V, prime_chunk_max)
+           if prime_padded
+           else _prime(net, seed_ids, V, prime_chunk_max))
     reorder_stream_state(net, np.zeros(Wb, np.int64))
     out = np.repeat(_probs(out)[:1], Wb, axis=0)
     beams = [list(seed_ids) for _ in range(W)]

@@ -35,18 +35,21 @@ class TextGenerationLSTM(ZooModel):
 
     def sample_stream(self, net, seed_ids, steps: int,
                       vocab_size: int = None,
-                      rng=None, temperature: float = 1.0):
+                      rng=None, temperature: float = 1.0,
+                      prime_padded: bool = False):
         """Temperature sampling through the stored-state rnnTimeStep path
         (the reference's character-generation loop; shared implementation
-        util/decoding.sample_stream; unbounded length)."""
+        util/decoding.sample_stream; unbounded length). `prime_padded=True`
+        primes the prompt in ONE left-padded dispatch (masked pad steps
+        pass h/c through unchanged)."""
         from deeplearning4j_tpu.util.decoding import sample_stream
         return sample_stream(net, seed_ids, steps,
                              vocab_size or self.vocab_size,
                              temperature=temperature, rng=rng,
-                             max_length=None)
+                             max_length=None, prime_padded=prime_padded)
 
     def beam_search(self, net, seed_ids, steps: int, beam_width: int = 4,
-                    vocab_size: int = None):
+                    vocab_size: int = None, prime_padded: bool = False):
         """Beam-search decoding over the stored-state rnnTimeStep path
         (shared implementation: util/decoding.beam_search; LSTM h/c is
         the carried state). Generation length is unbounded — recurrent
@@ -54,4 +57,5 @@ class TextGenerationLSTM(ZooModel):
         from deeplearning4j_tpu.util.decoding import beam_search
         return beam_search(net, seed_ids, steps,
                            vocab_size or self.vocab_size,
-                           beam_width=beam_width, max_length=None)
+                           beam_width=beam_width, max_length=None,
+                           prime_padded=prime_padded)

@@ -128,19 +128,22 @@ class TextGenerationTransformer(ZooModel):
     def sample_stream(self, net, seed_ids, steps: int,
                       vocab_size: int = None,
                       rng: np.random.Generator = None,
-                      temperature: float = 1.0):
+                      temperature: float = 1.0,
+                      prime_padded: bool = False):
         """KV-cache incremental decoding (shared implementation:
         util/decoding.sample_stream) — O(steps) single-position forwards
         instead of the padded full-forward-per-token of `sample`, with an
-        identical sampling distribution (tested)."""
+        identical sampling distribution (tested). `prime_padded=True`
+        primes the prompt in ONE left-padded dispatch."""
         from deeplearning4j_tpu.util.decoding import sample_stream
         return sample_stream(net, seed_ids, steps,
                              vocab_size or self.vocab_size,
                              temperature=temperature, rng=rng,
-                             max_length=self.max_length)
+                             max_length=self.max_length,
+                             prime_padded=prime_padded)
 
     def beam_search(self, net, seed_ids, steps: int, beam_width: int = 4,
-                    vocab_size: int = None):
+                    vocab_size: int = None, prime_padded: bool = False):
         """Beam-search decoding on the streaming KV-cache machinery
         (shared implementation: util/decoding.beam_search — beams ride
         the batch dimension, pruning gathers the carried state). Returns
@@ -149,4 +152,5 @@ class TextGenerationTransformer(ZooModel):
         return beam_search(net, seed_ids, steps,
                            vocab_size or self.vocab_size,
                            beam_width=beam_width,
-                           max_length=self.max_length)
+                           max_length=self.max_length,
+                           prime_padded=prime_padded)

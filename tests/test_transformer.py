@@ -908,7 +908,8 @@ class TestBucketedDecoding:
 
     def _stream_traces(self, net):
         from deeplearning4j_tpu.nn.conf import layers as L
-        fn = net._jit_cache.get(("rnn_step", L._STREAM_CACHE_SHARDING))
+        fn = net._jit_cache.get(
+            ("rnn_step", False, L._STREAM_CACHE_SHARDING))
         return 0 if fn is None else fn._cache_size()
 
     def test_prime_chunks(self):
