@@ -106,6 +106,65 @@ def _sg_scan(syn0, syn1, syn1neg, inputs, targets, labels, points, codes,
     return syn0, syn1, syn1neg
 
 
+@partial(jax.jit, static_argnames=("negative", "use_hs"))
+def _sg_scan_devneg(syn0, syn1, syn1neg, table, key, inputs, outs, points,
+                    codes, pmask, valid, lr, *, negative: int, use_hs: bool):
+    """_sg_scan with the unigram-table negatives drawn ON DEVICE: the
+    host ships only the pair streams (inputs/outs [Nb,B]) instead of the
+    [Nb,B,K+1] targets + labels arrays — ~5x less host->device transfer
+    per dispatch, which is the measured Word2Vec ceiling through the
+    tunneled platform (PERF.md). Same stochastic objective as the host
+    sampler (uniform draws into the same freq^0.75 table, no positive
+    dedup — matching _sample_negatives); different rng stream, so the
+    bit-exact scan==per-batch equivalence holds only for
+    device_negatives=False."""
+    B = inputs.shape[1]
+    labels = jnp.zeros((B, negative + 1), jnp.float32).at[:, 0].set(1.0)
+
+    def body(carry, xs):
+        s0, s1, s1n, k = carry
+        i, o, p, c, m, v, a = xs
+        k, sub = jax.random.split(k)
+        negs = table[jax.random.randint(sub, (B, negative), 0,
+                                        table.shape[0])]
+        t = jnp.concatenate([o[:, None], negs], axis=1)
+        s0, s1n = _ns_update(s0, s1n, i, t, labels, v, a)
+        if use_hs:
+            s0, s1 = _hs_update(s0, s1, i, p, c, m, a)
+        return (s0, s1, s1n, k), None
+
+    (syn0, syn1, syn1neg, _), _ = jax.lax.scan(
+        body, (syn0, syn1, syn1neg, key),
+        (inputs, outs, points, codes, pmask, valid, lr))
+    return syn0, syn1, syn1neg
+
+
+@partial(jax.jit, static_argnames=("negative", "use_hs"))
+def _cbow_scan_devneg(syn0, syn1, syn1neg, table, key, ctx, cmask, centers,
+                      points, codes, pmask, valid, lr, *, negative: int,
+                      use_hs: bool):
+    """CBOW twin of _sg_scan_devneg (centers are the positive targets)."""
+    B = centers.shape[1]
+    labels = jnp.zeros((B, negative + 1), jnp.float32).at[:, 0].set(1.0)
+
+    def body(carry, xs):
+        s0, s1, s1n, k = carry
+        cx, cm, o, p, c, m, v, a = xs
+        k, sub = jax.random.split(k)
+        negs = table[jax.random.randint(sub, (B, negative), 0,
+                                        table.shape[0])]
+        t = jnp.concatenate([o[:, None], negs], axis=1)
+        s0, s1n = _cbow_ns_update(s0, s1n, cx, cm, t, labels, v, a)
+        if use_hs:
+            s0, s1 = _cbow_hs_update(s0, s1, cx, cm, p, c, m, a)
+        return (s0, s1, s1n, k), None
+
+    (syn0, syn1, syn1neg, _), _ = jax.lax.scan(
+        body, (syn0, syn1, syn1neg, key),
+        (ctx, cmask, centers, points, codes, pmask, valid, lr))
+    return syn0, syn1, syn1neg
+
+
 def _cbow_ns_update(syn0, syn1neg, ctx, ctx_mask, targets, labels, valid,
                     lr):
     """CBOW with negative sampling: input = mean of context rows
@@ -270,7 +329,7 @@ class SequenceVectors:
                  elements_learning_algorithm: str = "skipgram",
                  use_hierarchic_softmax: Optional[bool] = None,
                  seed: int = 42, stop_words: Sequence[str] = (),
-                 vocab_limit: int = 0):
+                 vocab_limit: int = 0, device_negatives: bool = True):
         self.layer_size = layer_size
         self.window = window
         self.learning_rate = learning_rate
@@ -292,6 +351,10 @@ class SequenceVectors:
         self.seed = seed
         self.stop_words = stop_words
         self.vocab_limit = vocab_limit
+        #: sample NS negatives on device inside the scan dispatch (~5x
+        #: less host->device traffic); False restores the host rng stream
+        #: (bit-exact scan == per-batch equivalence)
+        self.device_negatives = device_negatives
 
         self.vocab: Optional[VocabCache] = None
         self.syn0 = None            # [V,D] jnp
@@ -348,6 +411,9 @@ class SequenceVectors:
         if self.negative > 0:
             self.syn1neg = jnp.zeros((V, D), jnp.float32)
             self._table = make_unigram_table(self.vocab)
+            self._table_dev = None          # uploaded lazily per fit
+            self._devneg_key = jax.random.PRNGKey(self.seed)
+            self._devneg_ctr = 0
         # In-batch index collisions SUM their updates (hogwild would
         # interleave them); on a tiny vocab a big batch revisits each row
         # so often that summed stale gradients overshoot and collapse the
@@ -472,17 +538,27 @@ class SequenceVectors:
         if not nw.native_available():
             return False
         # corpus as indices, once (OOV = -1, skipped natively but still
-        # counted in the learning-rate schedule like the numpy path)
+        # counted in the learning-rate schedule like the numpy path).
+        # Vectorized: one numpy searchsorted over the flattened corpus
+        # instead of 400k Python index_of calls (measured ~0.44s/400k
+        # words — a material slice of the fit at device speeds)
         lens = np.asarray([len(s) for s in seqs], np.int64)
         offsets = np.zeros(len(seqs) + 1, np.int64)
         np.cumsum(lens, out=offsets[1:])
-        corpus = np.empty(int(offsets[-1]), np.int32)
-        at = 0
+        toks = np.asarray([t for s in seqs for t in s], dtype=np.str_)
         index_of = self.vocab.index_of
-        for seq in seqs:
-            for tok in seq:
-                corpus[at] = index_of(tok)
-                at += 1
+        names = [vw.word for vw in self.vocab.vocab_words()]
+        name_arr = np.asarray(names, dtype=np.str_)
+        vidx = np.asarray([index_of(w) for w in names], np.int32)
+        order = np.argsort(name_arr)
+        sorted_names, sorted_idx = name_arr[order], vidx[order]
+        if len(toks) and len(sorted_names):
+            pos = np.searchsorted(sorted_names, toks)
+            pc = pos.clip(0, len(sorted_names) - 1)
+            corpus = np.where(sorted_names[pc] == toks, sorted_idx[pc],
+                              -1).astype(np.int32)
+        else:           # empty vocab: every token is OOV (silent no-op fit)
+            corpus = np.full(len(toks), -1, np.int32)
         keep = self._keep_probs()
         # per-sequence alpha: the numpy path's words_seen schedule
         total_words = int(lens.sum()) * max(1, self.epochs)
@@ -612,87 +688,152 @@ class SequenceVectors:
     #: dispatch count by the same factor
     scan_chunk = 64
 
-    def _run_scan_dispatch(self, rows, alphas, lead_fn, scan_fn, tail_fn):
+    @staticmethod
+    def _pad_rows(a, rows_to):
+        """Zero-pad array `a` along axis 0 to `rows_to` rows."""
+        if len(a) == rows_to:
+            return a
+        widths = [(0, rows_to - len(a))] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, widths)
+
+    def _run_scan_dispatch(self, rows, alphas, lead_fn, scan_fn,
+                           devneg_fn):
         """Shared scaffolding for the scan-batched dispatchers: group
-        scan_chunk full batches per device dispatch, thread the table
-        carries across groups, delegate the remainder to the per-batch
-        step. `rows` [n] are the output-table rows (sg labels / cbow
-        centers) that negatives + huffman paths are drawn from — in
-        batch order, so the rng stream matches the per-batch path and
-        the result is numerically equivalent to per-batch dispatching
-        (pinned to 1e-6 by the equivalence tests; XLA may reorder float
-        ops inside the scan body). `lead_fn(sl, nb)` supplies the
-        variant-specific leading xs (sg: inputs; cbow: ctx + mask);
-        `tail_fn(s, e)` dispatches one remainder batch."""
+        scan_chunk full batches per device dispatch, threading the table
+        carries across groups. The remainder runs as ONE more scan group
+        padded to a power-of-two batch count (pad rows carry lr=0 and
+        valid=0, so their update is exactly zero — and at most
+        log2(scan_chunk) extra compiled group sizes exist), instead of
+        up to scan_chunk-1 individual per-batch dispatches.
+
+        `rows` [n] are the output-table rows (sg labels / cbow centers)
+        that negatives + huffman paths are drawn from — in batch order,
+        so with device_negatives=False the rng stream matches the
+        per-batch path and the result is numerically equivalent to
+        per-batch dispatching (pinned to 1e-6 by the equivalence tests;
+        XLA may reorder float ops inside the scan body). With
+        device_negatives (default) the NS negatives are drawn on device
+        by `devneg_fn` and only the pair streams ship. `lead_fn(a, b,
+        nb)` supplies the variant-specific leading xs for rows [a:b)
+        zero-padded to nb full batches (sg: inputs; cbow: ctx + mask)."""
         B = self._eff_batch
         nb = self.scan_chunk
         n = len(rows)
-        n_scan = ((n // B) // nb) * nb
         ns, hs = self.negative > 0, self.use_hs
+        devneg = ns and self.device_negatives
         D = self.syn0.shape[1]
         dummy1 = self.syn1 if hs else jnp.zeros((1, D), jnp.float32)
         dummy1n = self.syn1neg if ns else jnp.zeros((1, D), jnp.float32)
+        if devneg and n and self._table_dev is None:
+            self._table_dev = jnp.asarray(self._table)
+        # group schedule: full scan_chunk groups, then one padded
+        # power-of-two group for the remainder
+        n_scan = ((n // B) // nb) * nb
+        groups = [(g0 * B, (g0 + nb) * B, nb)
+                  for g0 in range(0, n_scan, nb)]
+        if n_scan * B < n:
+            rem_b = -(-(n - n_scan * B) // B)       # ceil batches
+            gb = 1
+            while gb < rem_b:
+                gb *= 2
+            groups.append((n_scan * B, n, gb))
         # constant across groups: upload once, reuse every dispatch
-        valid = jnp.ones((nb, B), jnp.float32)
+        # (full groups slice nothing; the padded group slices [:g])
+        ones = jnp.ones((nb, B), jnp.float32)
         if not ns:
-            targets = jnp.zeros((nb, B, 1), jnp.int32)
-            labels = jnp.zeros((nb, B, 1), jnp.float32)
+            targets0 = jnp.zeros((nb, B, 1), jnp.int32)
+            labels0 = jnp.zeros((nb, B, 1), jnp.float32)
+        elif not devneg:
+            # NS labels are the constant [1, 0, ...] pattern — never
+            # re-ship them per group (they were ~40% of the payload)
+            lab = np.zeros((nb, B, self.negative + 1), np.float32)
+            lab[:, :, 0] = 1.0
+            labels0 = jnp.asarray(lab)
         if not hs:
-            pts = jnp.zeros((nb, B, 1), jnp.int32)
-            cds = jnp.zeros((nb, B, 1), jnp.float32)
-            msk = jnp.zeros((nb, B, 1), jnp.float32)
-        for g0 in range(0, n_scan, nb):
-            sl = slice(g0 * B, (g0 + nb) * B)
-            ro = np.ascontiguousarray(rows[sl]).reshape(nb, B)
-            lr = alphas[sl].astype(np.float32).reshape(nb, B)
-            if ns:
-                t_list, l_list = zip(*(self._sample_negatives(ro[j])
-                                       for j in range(nb)))
-                targets = jnp.asarray(np.stack(t_list))
-                labels = jnp.asarray(np.stack(l_list))
+            pts0 = jnp.zeros((nb, B, 1), jnp.int32)
+            cds0 = jnp.zeros((nb, B, 1), jnp.float32)
+            msk0 = jnp.zeros((nb, B, 1), jnp.float32)
+        for a, b, g in groups:
+            k = b - a                                # real rows
+            full = k == g * B
+            ro = self._pad_rows(
+                np.ascontiguousarray(rows[a:b]), g * B).reshape(g, B)
+            lr = self._pad_rows(alphas[a:b].astype(np.float32),
+                                g * B).reshape(g, B)
+            if full:
+                valid = ones if g == nb else ones[:g]
+                vnp = None
+            else:
+                vnp = self._pad_rows(np.ones(k, np.float32),
+                                     g * B).reshape(g, B)
+                valid = jnp.asarray(vnp)
             if hs:
+                m = self._path_mask[ro]
+                if vnp is not None:
+                    m = m * vnp[..., None]
                 pts = jnp.asarray(self._points[ro])
                 cds = jnp.asarray(self._codes[ro])
-                msk = jnp.asarray(self._path_mask[ro])
-            self.syn0, s1, s1n = scan_fn(
-                self.syn0, dummy1, dummy1n, *lead_fn(sl, nb),
-                targets, labels, pts, cds, msk, valid,
-                jnp.asarray(lr), negative=ns, use_hs=hs)
+                msk = jnp.asarray(m)
+            else:
+                pts, cds, msk = pts0[:g], cds0[:g], msk0[:g]
+            if devneg:
+                key = jax.random.fold_in(self._devneg_key,
+                                         self._devneg_ctr)
+                self._devneg_ctr += 1
+                self.syn0, s1, s1n = devneg_fn(
+                    self.syn0, dummy1, dummy1n, self._table_dev, key,
+                    *lead_fn(a, b, g), jnp.asarray(ro), pts, cds, msk,
+                    valid, jnp.asarray(lr), negative=self.negative,
+                    use_hs=hs)
+            else:
+                if ns:
+                    # sample only batches with >=1 real row: the padded
+                    # group may round up to a power of two with fully-pad
+                    # batches the per-batch path never sampled — drawing
+                    # for them would advance _rng and break the bit-exact
+                    # cross-call equivalence with per-batch dispatching
+                    real_b = -(-k // B)
+                    t_np = np.zeros((g, B, self.negative + 1), np.int32)
+                    for j in range(real_b):
+                        t_np[j] = self._sample_negatives(ro[j])[0]
+                    targets = jnp.asarray(t_np)
+                else:
+                    targets = targets0[:g]
+                self.syn0, s1, s1n = scan_fn(
+                    self.syn0, dummy1, dummy1n, *lead_fn(a, b, g),
+                    targets, labels0[:g], pts, cds, msk, valid,
+                    jnp.asarray(lr), negative=ns, use_hs=hs)
             if hs:
                 self.syn1 = dummy1 = s1
             if ns:
                 self.syn1neg = dummy1n = s1n
-        for s in range(n_scan * B, n, B):
-            tail_fn(s, s + B)
 
     def _dispatch_sg_many(self, ins, outs, alphas):
         """Shard-sized skip-gram training through _run_scan_dispatch."""
         B = self._eff_batch
 
-        def lead(sl, nb):
-            return (jnp.asarray(
-                np.ascontiguousarray(ins[sl]).reshape(nb, B)),)
+        def lead(a, b, g):
+            return (jnp.asarray(self._pad_rows(
+                np.ascontiguousarray(ins[a:b]), g * B).reshape(g, B)),)
 
-        self._run_scan_dispatch(
-            outs, alphas, lead, _sg_scan,
-            lambda s, e: self._dispatch_sg(ins[s:e], outs[s:e],
-                                           alphas[s:e]))
+        self._run_scan_dispatch(outs, alphas, lead, _sg_scan,
+                                _sg_scan_devneg)
 
     def _dispatch_cbow_many(self, ctxs, cmask, centers, alphas):
         """CBOW twin of _dispatch_sg_many (same scaffolding)."""
         B = self._eff_batch
         C = ctxs.shape[1]
 
-        def lead(sl, nb):
-            return (jnp.asarray(
-                        np.ascontiguousarray(ctxs[sl]).reshape(nb, B, C)),
-                    jnp.asarray(np.ascontiguousarray(
-                        cmask[sl]).astype(np.float32).reshape(nb, B, C)))
+        def lead(a, b, g):
+            return (jnp.asarray(self._pad_rows(
+                        np.ascontiguousarray(ctxs[a:b]),
+                        g * B).reshape(g, B, C)),
+                    jnp.asarray(self._pad_rows(
+                        np.ascontiguousarray(cmask[a:b]).astype(
+                            np.float32), g * B).reshape(g, B, C)))
 
-        self._run_scan_dispatch(
-            centers, alphas, lead, _cbow_scan,
-            lambda s, e: self._dispatch_cbow(ctxs[s:e], cmask[s:e],
-                                             centers[s:e], alphas[s:e]))
+        self._run_scan_dispatch(centers, alphas, lead, _cbow_scan,
+                                _cbow_scan_devneg)
 
     def _dispatch_cbow(self, bx, bm, bc, alphas):
         B = self._eff_batch

@@ -29,8 +29,13 @@ class Word2Vec(SequenceVectors):
         self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
 
     def _tokenize(self, sentences: Iterable[str]) -> List[List[str]]:
-        return [self.tokenizer_factory.create(s).get_tokens()
-                for s in sentences]
+        tf = self.tokenizer_factory
+        if type(tf) is DefaultTokenizerFactory and tf._pre is None:
+            # fast path: DefaultTokenizer with no preprocessor IS
+            # str.split — skip the per-sentence Tokenizer object + token
+            # list copy (measured ~35% of host time at device speeds)
+            return [s.split() for s in sentences]
+        return [tf.create(s).get_tokens() for s in sentences]
 
     def _tokenized(self) -> List[List[str]]:
         if self.sentence_iterator is None:

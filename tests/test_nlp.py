@@ -99,12 +99,13 @@ class TestWord2Vec:
         """_dispatch_sg_many (lax.scan, one dispatch per scan_chunk
         batches) must produce bit-for-bit the tables the per-batch
         _dispatch_sg loop produces: same batch order, same rng stream for
-        the negatives."""
+        the negatives (device_negatives=False — the default draws
+        negatives on device from a different stream)."""
         def make():
             w = Word2Vec(
                 sentence_iterator=CollectionSentenceIterator(corpus(30)),
                 min_word_frequency=1, layer_size=8, window=2, seed=3,
-                batch_size=32, **kwargs)
+                batch_size=32, device_negatives=False, **kwargs)
             w.build_vocab([s.split() for s in corpus(30)])
             w._rng = np.random.default_rng(17)
             return w
@@ -132,6 +133,104 @@ class TestWord2Vec:
                                        np.asarray(b.syn1),
                                        rtol=1e-6, atol=1e-7)
 
+    @pytest.mark.parametrize("algo", ["skipgram", "cbow"])
+    def test_device_negatives_learns_and_is_deterministic(self, algo):
+        """The default device-side negative sampler trains embeddings of
+        the same quality as the host sampler (co-occurring words closer
+        than non-co-occurring) and is reproducible for a fixed seed."""
+        def make():
+            return Word2Vec(
+                sentence_iterator=CollectionSentenceIterator(corpus(40)),
+                min_word_frequency=1, layer_size=8, window=2, seed=3,
+                batch_size=64, negative=3, epochs=10, learning_rate=0.03,
+                elements_learning_algorithm=algo)
+        a = make()
+        a.scan_chunk = 2            # force the scan (devneg) path
+        a.fit()
+        assert a.device_negatives
+        sim_in = a.similarity("cat", "dog")       # co-occurring
+        sim_out = a.similarity("cat", "bread")    # never co-occur
+        assert np.isfinite(sim_in) and np.isfinite(sim_out)
+        assert sim_in > sim_out                   # quality, not just finite
+        assert np.isfinite(np.asarray(a.syn0)).all()
+        b = make()
+        b.scan_chunk = 2
+        b.fit()
+        np.testing.assert_allclose(np.asarray(a.syn0), np.asarray(b.syn0),
+                                   atol=1e-6)
+
+    def test_empty_vocab_fit_is_silent_noop(self):
+        """min_word_frequency above every count yields an empty vocab;
+        fit must no-op (all tokens OOV), not crash in the vectorized
+        corpus lookup."""
+        w = Word2Vec(
+            sentence_iterator=CollectionSentenceIterator(corpus(2)),
+            min_word_frequency=10**6, layer_size=4, window=2, seed=3)
+        w.fit()                                   # must not raise
+        assert w.vocab.num_words() == 0
+
+    @pytest.mark.parametrize("kwargs", [
+        dict(negative=3, use_hierarchic_softmax=False),
+        dict(negative=2, use_hierarchic_softmax=True),
+    ])
+    def test_scan_remainder_rng_stream_matches_across_calls(self, kwargs):
+        """A padded remainder group rounded up to a power of two (e.g. 3
+        real batches -> group of 4) must NOT consume rng draws for its
+        fully-pad batches: a SECOND _dispatch_sg_many call has to see the
+        same negative stream the per-batch baseline sees."""
+        def make():
+            w = Word2Vec(
+                sentence_iterator=CollectionSentenceIterator(corpus(30)),
+                min_word_frequency=1, layer_size=8, window=2, seed=3,
+                batch_size=32, device_negatives=False, **kwargs)
+            w.build_vocab([s.split() for s in corpus(30)])
+            w._rng = np.random.default_rng(17)
+            return w
+        a, b = make(), make()
+        rng = np.random.default_rng(5)
+        V = a.vocab.num_words()
+        B = a._eff_batch
+        n = B * 3 + 5          # 3 full batches + remainder -> group of 4
+        a.scan_chunk = 8       # one padded group per call
+        for _ in range(2):     # cross-call stream equivalence
+            ins = rng.integers(0, V, n).astype(np.int32)
+            outs = rng.integers(0, V, n).astype(np.int32)
+            alphas = np.full(n, 0.025, np.float32)
+            a._dispatch_sg_many(ins, outs, alphas)
+            for s in range(0, n, B):
+                b._dispatch_sg(ins[s:s + B], outs[s:s + B],
+                               alphas[s:s + B])
+        np.testing.assert_allclose(np.asarray(a.syn0), np.asarray(b.syn0),
+                                   rtol=1e-6, atol=1e-7)
+        if kwargs.get("negative"):
+            np.testing.assert_allclose(np.asarray(a.syn1neg),
+                                       np.asarray(b.syn1neg),
+                                       rtol=1e-6, atol=1e-7)
+
+    def test_device_negatives_match_table_distribution(self):
+        """Device draws come from the same freq^0.75 unigram table as the
+        host sampler: empirical negative frequencies over many draws must
+        track the table's composition."""
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nlp.sequencevectors import (
+            _sg_scan_devneg,
+        )
+        w = Word2Vec(
+            sentence_iterator=CollectionSentenceIterator(corpus(40)),
+            min_word_frequency=1, layer_size=4, window=2, seed=3,
+            batch_size=32, negative=5)
+        w.build_vocab([s.split() for s in corpus(40)])
+        table = w._table
+        V = w.vocab.num_words()
+        # draw the same way the kernel does
+        key = jax.random.PRNGKey(0)
+        idx = jax.random.randint(key, (20000,), 0, len(table))
+        drawn = np.bincount(np.asarray(table[np.asarray(idx)]),
+                            minlength=V) / 20000.0
+        want = np.bincount(table, minlength=V) / len(table)
+        np.testing.assert_allclose(drawn, want, atol=0.02)
+
     @pytest.mark.parametrize("kwargs", [
         dict(negative=3, use_hierarchic_softmax=False),
         dict(negative=0),                                # hs
@@ -145,7 +244,7 @@ class TestWord2Vec:
                 sentence_iterator=CollectionSentenceIterator(corpus(30)),
                 min_word_frequency=1, layer_size=8, window=2, seed=3,
                 batch_size=32, elements_learning_algorithm="cbow",
-                **kwargs)
+                device_negatives=False, **kwargs)
             w.build_vocab([s.split() for s in corpus(30)])
             w._rng = np.random.default_rng(17)
             return w
