@@ -736,6 +736,10 @@ class SequenceVectors:
             gb = 1
             while gb < rem_b:
                 gb *= 2
+            # the group constants are allocated [nb, ...]: a
+            # non-power-of-two scan_chunk must not round past it
+            # (rem_b <= nb always holds)
+            gb = min(gb, nb)
             groups.append((n_scan * B, n, gb))
         # constant across groups: upload once, reuse every dispatch
         # (full groups slice nothing; the padded group slices [:g])

@@ -212,6 +212,8 @@ class ComputationGraph:
         SelfAttentionLayer._stream_attend."""
         preout_set = ({preout_of} if isinstance(preout_of, str)
                       else set(preout_of or ()))
+        if getattr(self, "_quantized", False):
+            params = self._dequantized(params)
         fused_plan, fused_skip = self._fusion()
         acts: Dict[str, Any] = dict(inputs)
         masks: Dict[str, Any] = dict(fmasks or {})
@@ -322,9 +324,21 @@ class ComputationGraph:
         return {name: jnp.asarray(x)
                 for name, x in zip(self.conf.network_inputs, inputs)}
 
+    def _dequantized(self, params):
+        """Materialize int8 QuantizedTensor leaves (W8A16 serving,
+        optimize/quantization.py) as float32 — inference activations run
+        f32 (conf.dtype is a TRAINING-cast policy); XLA fuses the int8
+        convert into each consumer, which is where the HBM saving
+        lives. Mirrors MultiLayerNetwork._dequantized."""
+        from deeplearning4j_tpu.optimize.quantization import dequantize_tree
+        return dequantize_tree(params, jnp.float32)
+
     def _loss(self, params, state, inputs, labels: Dict[str, Any], rng,
               fmasks, lmasks, *, train=True, carry_rnn=False):
         """Sum of output-layer losses + regularization."""
+        if getattr(self, "_quantized", False):
+            # scoring path; training itself is refused in _get_train_step
+            params = self._dequantized(params)
         if self.conf.dtype in ("bfloat16", "bf16"):
             cast = lambda a: a.astype(jnp.bfloat16) \
                 if jnp.issubdtype(a.dtype, jnp.floating) else a
@@ -381,6 +395,11 @@ class ComputationGraph:
     # training
     # ------------------------------------------------------------------
     def _get_train_step(self, carry_rnn: bool):
+        if getattr(self, "_quantized", False):
+            raise RuntimeError(
+                "this network was quantized for inference "
+                "(quantize_for_inference) — int8 weights have no "
+                "gradient path; train the fp checkpoint and re-quantize")
         key = ("train", carry_rnn)
         if key not in self._jit_cache:
             conf = self.conf

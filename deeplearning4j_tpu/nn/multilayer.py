@@ -120,6 +120,8 @@ class MultiLayerNetwork:
         acts = []
         new_state = {}
         mask = fmask
+        if getattr(self, "_quantized", False):
+            params = self._dequantized(params)
         if pad is not None:
             mask = jnp.broadcast_to(jnp.arange(x.shape[-1]) >= pad,
                                     (x.shape[0], x.shape[-1]))
@@ -166,12 +168,24 @@ class MultiLayerNetwork:
             new_state[str(i)] = state.get(str(i), {})
         return acts, new_state
 
+    def _dequantized(self, params):
+        """Materialize int8 QuantizedTensor leaves (W8A16 serving,
+        optimize/quantization.py) as float32 — the inference paths run
+        activations in f32 (conf.dtype is a TRAINING-cast policy), and
+        _cast_compute re-casts to bf16 after this when scoring under a
+        bf16 conf. XLA fuses the int8 convert into each consumer either
+        way, which is where the HBM saving lives."""
+        from deeplearning4j_tpu.optimize.quantization import dequantize_tree
+        return dequantize_tree(params, jnp.float32)
+
     def _cast_compute(self, params, x):
         """Mixed precision: when conf.dtype is bfloat16, run forward in bf16
         (master params stay fp32 — grads flow back through the cast). On TPU
         this keeps matmuls/convs on the MXU bf16 path with fp32 accumulation
         (XLA default), the same fp16-compute policy the reference's cuDNN
         helpers select (BaseCudnnHelper dataType)."""
+        if getattr(self, "_quantized", False):
+            params = self._dequantized(params)
         if self.conf.dtype in ("bfloat16", "bf16"):
             cast = lambda a: a.astype(jnp.bfloat16) \
                 if jnp.issubdtype(a.dtype, jnp.floating) else a
@@ -228,6 +242,11 @@ class MultiLayerNetwork:
     # jitted steps (cached per (carry_rnn, mask presence) signature)
     # ------------------------------------------------------------------
     def _get_train_step(self, carry_rnn: bool):
+        if getattr(self, "_quantized", False):
+            raise RuntimeError(
+                "this network was quantized for inference "
+                "(quantize_for_inference) — int8 weights have no "
+                "gradient path; train the fp checkpoint and re-quantize")
         key = ("train", carry_rnn)
         if key not in self._jit_cache:
             conf = self.conf
@@ -480,6 +499,11 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     def pretrain(self, iterator, epochs: int = 1):
         """Greedy layerwise pretraining of AutoEncoder/VAE layers."""
+        if getattr(self, "_quantized", False):
+            raise RuntimeError(
+                "this network was quantized for inference "
+                "(quantize_for_inference) — int8 weights have no "
+                "gradient path; train the fp checkpoint and re-quantize")
         if not self._initialized:
             self.init()
         for i, layer in enumerate(self.layers):

@@ -1,0 +1,142 @@
+"""Post-training int8 weight quantization for inference (W8A16).
+
+TPU-native post-parity serving feature (the reference's nearest hook is
+the ND4J compressor row, SURVEY §2.1 — compression there serves
+gradient transport; here the target is inference memory bandwidth).
+Per-channel symmetric int8 weights with fp32 scales: the dequantize is
+a convert+multiply that XLA fuses into the consuming matmul/conv read,
+so serving reads 1 byte per weight from HBM instead of 4 (or 2 under
+bf16). Memory-bound paths — token-by-token decode, large Dense/attention
+projections — speed up by up to the storage ratio; compute-bound convs
+keep their MXU path unchanged (weights arrive bf16/fp32 after the fused
+dequant, exactly as before).
+
+Usage:
+    net = model.init()            # or a restored checkpoint
+    quantize_for_inference(net)   # in place; training is then refused
+    net.output(x)                 # same API, int8 weights under the hood
+
+Persist the ORIGINAL checkpoint, not the quantized net — quantization
+is an inference-time transform (re-apply after restore), mirroring how
+the reference treats compression as transport encoding, not model
+state.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["QuantizedTensor", "quantize_array", "quantize_params",
+           "quantize_for_inference", "dequantize_tree"]
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedTensor:
+    """Symmetric per-channel int8 tensor: `q` int8, `scale` fp32 along
+    `axis`. Flows through jit as a pytree; layers never see it — the
+    network dequantizes at forward entry (dequantize_tree) and XLA
+    fuses the convert+multiply into each consumer."""
+
+    def __init__(self, q, scale, axis: int):
+        self.q = q
+        self.scale = scale
+        self.axis = axis
+
+    def tree_flatten(self):
+        return (self.q, self.scale), self.axis
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    def dequantize(self, dtype=jnp.float32):
+        shape = [1] * self.q.ndim
+        shape[self.axis] = -1
+        return self.q.astype(dtype) * \
+            self.scale.reshape(shape).astype(dtype)
+
+    def __repr__(self):
+        return (f"QuantizedTensor(shape={tuple(self.q.shape)}, "
+                f"axis={self.axis})")
+
+
+def quantize_array(w, axis: int) -> QuantizedTensor:
+    """Symmetric per-channel int8: scale = max|w| / 127 along every
+    non-channel axis; values round into [-127, 127] (no -128: symmetric
+    range keeps dequant exactly scale-linear)."""
+    w = jnp.asarray(w)
+    red = tuple(i for i in range(w.ndim) if i != axis)
+    amax = jnp.max(jnp.abs(w), axis=red)
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    shape = [1] * w.ndim
+    shape[axis] = -1
+    q = jnp.clip(jnp.round(w / scale.reshape(shape)),
+                 -127, 127).astype(jnp.int8)
+    return QuantizedTensor(q, scale.astype(jnp.float32), axis)
+
+
+def _channel_axis(arr) -> Optional[int]:
+    """Quantization channel axis by this repo's weight layout
+    conventions: 2-D matmul weights are [in, out] (per-output-column
+    scales — Dense/LSTM/attention), 3-D conv1d kernels are [O, I, k]
+    and 4-D conv2d kernels OIHW (per-output-filter scales, the
+    reference's ConvolutionParamInitializer layout). 0/1-D params
+    (biases, norms) stay fp."""
+    if arr.ndim == 2:
+        return 1
+    if arr.ndim in (3, 4):
+        return 0
+    return None
+
+
+def quantize_params(params, min_size: int = 4096):
+    """Quantize every floating weight of >=2 dims and >= `min_size`
+    elements in a (nested) param dict; leaves everything else alone.
+    Small tensors stay fp — their HBM traffic is negligible and tiny
+    channels quantize poorly."""
+    def walk(node):
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        arr = node
+        if (hasattr(arr, "dtype")
+                and jnp.issubdtype(arr.dtype, jnp.floating)
+                and arr.ndim >= 2
+                and int(np.prod(arr.shape)) >= min_size):
+            axis = _channel_axis(arr)
+            if axis is not None:
+                return quantize_array(arr, axis)
+        return arr
+    return walk(params)
+
+
+def dequantize_tree(params, dtype=jnp.float32):
+    """Materialize QuantizedTensor leaves as `dtype` arrays (a no-op
+    tree_map when none exist). Called at network forward entry; the
+    converts fuse into consumers under jit."""
+    return jax.tree_util.tree_map(
+        lambda l: l.dequantize(dtype)
+        if isinstance(l, QuantizedTensor) else l,
+        params, is_leaf=lambda l: isinstance(l, QuantizedTensor))
+
+
+def quantize_for_inference(net, min_size: int = 4096):
+    """Quantize `net`'s weights to int8 IN PLACE for serving and return
+    it. Training on a quantized net is refused (there is no int8
+    gradient path — re-quantize after further fp training instead);
+    output / rnn_time_step / sample_stream / evaluate work unchanged."""
+    net.params = quantize_params(net.params, min_size=min_size)
+    net._quantized = True
+    net._jit_cache.clear()      # param treedef changed: force retrace
+    return net

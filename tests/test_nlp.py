@@ -207,6 +207,33 @@ class TestWord2Vec:
                                        np.asarray(b.syn1neg),
                                        rtol=1e-6, atol=1e-7)
 
+    def test_non_pow2_scan_chunk_remainder(self):
+        """A non-power-of-two scan_chunk must not round the remainder
+        group past the preallocated [nb, ...] constants (gb caps at nb);
+        result still matches the per-batch path."""
+        def make():
+            w = Word2Vec(
+                sentence_iterator=CollectionSentenceIterator(corpus(30)),
+                min_word_frequency=1, layer_size=8, window=2, seed=3,
+                batch_size=32, negative=3, device_negatives=False)
+            w.build_vocab([s.split() for s in corpus(30)])
+            w._rng = np.random.default_rng(17)
+            return w
+        a, b = make(), make()
+        rng = np.random.default_rng(5)
+        V = a.vocab.num_words()
+        B = a._eff_batch
+        a.scan_chunk = 3            # remainder 2 batches -> gb capped at 3
+        n = B * 5 + 5               # 1 full group of 3 + remainder of 2+
+        ins = rng.integers(0, V, n).astype(np.int32)
+        outs = rng.integers(0, V, n).astype(np.int32)
+        alphas = np.full(n, 0.025, np.float32)
+        a._dispatch_sg_many(ins, outs, alphas)
+        for s in range(0, n, B):
+            b._dispatch_sg(ins[s:s + B], outs[s:s + B], alphas[s:s + B])
+        np.testing.assert_allclose(np.asarray(a.syn0), np.asarray(b.syn0),
+                                   rtol=1e-6, atol=1e-7)
+
     def test_device_negatives_match_table_distribution(self):
         """Device draws come from the same freq^0.75 unigram table as the
         host sampler: empirical negative frequencies over many draws must

@@ -1,0 +1,181 @@
+"""Post-training int8 weight quantization (optimize/quantization.py):
+W8A16 serving — per-channel symmetric int8 weights dequantized at
+forward entry, same APIs, training refused."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (
+    ConvolutionLayer, DenseLayer, OutputLayer, SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Adam
+from deeplearning4j_tpu.optimize.quantization import (
+    QuantizedTensor, dequantize_tree, quantize_array, quantize_params,
+    quantize_for_inference,
+)
+from deeplearning4j_tpu.zoo import TextGenerationTransformer
+
+RNG = np.random.default_rng(0)
+
+
+class TestQuantizeArray:
+    def test_round_trip_error_bounded(self):
+        """Per-channel symmetric int8: |w - dq(q(w))| <= scale/2 per
+        channel (half a quantization step)."""
+        w = jnp.asarray(RNG.standard_normal((64, 128)), jnp.float32)
+        qt = quantize_array(w, axis=1)
+        assert qt.q.dtype == jnp.int8
+        assert qt.scale.shape == (128,)
+        err = np.abs(np.asarray(qt.dequantize()) - np.asarray(w))
+        bound = np.asarray(qt.scale)[None, :] / 2 + 1e-7
+        assert (err <= bound).all()
+
+    def test_channel_scales_independent(self):
+        """A huge outlier in one column must not degrade the others."""
+        w = np.asarray(RNG.standard_normal((32, 4)), np.float32)
+        w[:, 0] *= 1000.0
+        qt = quantize_array(jnp.asarray(w), axis=1)
+        dq = np.asarray(qt.dequantize())
+        # unscaled columns keep fine resolution
+        np.testing.assert_allclose(dq[:, 1:], w[:, 1:], atol=0.02)
+
+    def test_symmetric_range(self):
+        w = jnp.asarray(RNG.standard_normal((16, 16)) * 3, jnp.float32)
+        qt = quantize_array(w, axis=1)
+        q = np.asarray(qt.q)
+        assert q.min() >= -127 and q.max() <= 127
+
+    def test_pytree_round_trip(self):
+        """QuantizedTensor flows through tree_map/jit as a pytree."""
+        qt = quantize_array(jnp.ones((8, 8)), axis=1)
+        leaves, treedef = jax.tree_util.tree_flatten(qt)
+        assert len(leaves) == 2
+        qt2 = jax.tree_util.tree_unflatten(treedef, leaves)
+        assert qt2.axis == qt.axis
+        out = jax.jit(lambda t: t.dequantize())(qt)
+        np.testing.assert_allclose(np.asarray(out), 1.0, atol=0.01)
+
+
+class TestQuantizeParams:
+    def test_selects_large_float_weights_only(self):
+        params = {"0": {"W": jnp.ones((128, 64)), "b": jnp.ones((64,))},
+                  "1": {"W": jnp.ones((4, 4)),
+                        "idx": jnp.ones((128, 64), jnp.int32)}}
+        q = quantize_params(params, min_size=1024)
+        assert isinstance(q["0"]["W"], QuantizedTensor)
+        assert not isinstance(q["0"]["b"], QuantizedTensor)   # 1-D
+        assert not isinstance(q["1"]["W"], QuantizedTensor)   # small
+        assert not isinstance(q["1"]["idx"], QuantizedTensor)  # int
+
+    def test_dequantize_tree_noop_on_fp(self):
+        w = jnp.ones((8, 8))
+        out = dequantize_tree({"0": {"W": w}}, jnp.float32)
+        assert out["0"]["W"].dtype == w.dtype      # untouched passthrough
+        np.testing.assert_array_equal(np.asarray(out["0"]["W"]),
+                                      np.asarray(w))
+
+
+def _mlp(seed=7):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(seed).updater(Adam(1e-3)).weight_init("xavier").list()
+            .layer(DenseLayer(n_out=128, activation="relu"))
+            .layer(DenseLayer(n_out=128, activation="relu"))
+            .layer(OutputLayer(n_out=10, loss="mcxent",
+                               activation="softmax"))
+            .set_input_type(InputType.feed_forward(64))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestQuantizedNetworks:
+    def test_mlp_outputs_close_and_argmax_agrees(self):
+        net = _mlp()
+        x = np.random.default_rng(11).standard_normal(
+            (32, 64)).astype(np.float32)
+        ref = np.asarray(net.output(x))
+        quantize_for_inference(net)
+        got = np.asarray(net.output(x))
+        assert np.abs(got - ref).max() < 0.03
+        assert (got.argmax(1) == ref.argmax(1)).mean() >= 0.97
+
+    def test_cnn_outputs_close(self):
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(3).updater(Adam(1e-3)).weight_init("xavier").list()
+                .layer(ConvolutionLayer(n_out=16, kernel=3,
+                                        convolution_mode="same",
+                                        activation="relu"))
+                .layer(SubsamplingLayer(kernel=2, stride=2))
+                .layer(OutputLayer(n_out=5, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.convolutional(8, 8, 3))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.default_rng(12).standard_normal(
+            (4, 3, 8, 8)).astype(np.float32)
+        ref = np.asarray(net.output(x))
+        quantize_for_inference(net, min_size=64)   # small conv still q
+        got = np.asarray(net.output(x))
+        assert np.abs(got - ref).max() < 0.05
+
+    def test_training_refused(self):
+        net = quantize_for_inference(_mlp())
+        x = RNG.standard_normal((8, 64)).astype(np.float32)
+        y = np.zeros((8, 10), np.float32)
+        y[:, 0] = 1.0
+        with pytest.raises(RuntimeError, match="quantized for inference"):
+            net.fit(DataSet(x, y))
+
+    def test_params_actually_shrink(self):
+        net = _mlp()
+        fp_bytes = sum(a.size * a.dtype.itemsize
+                       for a in jax.tree_util.tree_leaves(net.params))
+        quantize_for_inference(net)
+        q_bytes = sum(a.size * a.dtype.itemsize
+                      for a in jax.tree_util.tree_leaves(net.params))
+        assert q_bytes < fp_bytes * 0.35           # ~4x on the big mats
+
+    def test_transformer_graph_decode_matches(self):
+        """CG + streaming decode path: quantized sample_stream stays on
+        the fp model's token choices for a near-deterministic model."""
+        model = TextGenerationTransformer(vocab_size=16, embed_dim=32,
+                                          n_heads=2, n_layers=1,
+                                          max_length=16)
+        net = model.init()
+        prompt = [1, 2, 3]
+        ref = model.sample_stream(net, prompt, steps=4,
+                                  rng=np.random.default_rng(5),
+                                  temperature=0.05)
+        quantize_for_inference(net, min_size=512)
+        got = model.sample_stream(net, prompt, steps=4,
+                                  rng=np.random.default_rng(5),
+                                  temperature=0.05)
+        assert ref == got
+
+    def test_pretrain_refused(self):
+        from deeplearning4j_tpu.nn.conf.layers import AutoEncoder
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(3).updater(Adam(1e-3)).weight_init("xavier").list()
+                .layer(AutoEncoder(n_out=32))
+                .layer(OutputLayer(n_out=4, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.feed_forward(64))
+                .build())
+        net = quantize_for_inference(MultiLayerNetwork(conf).init(),
+                                     min_size=512)
+        with pytest.raises(RuntimeError, match="quantized for inference"):
+            net.pretrain(iter([]))
+
+    def test_evaluate_works_quantized(self):
+        net = _mlp()
+        x = RNG.standard_normal((16, 64)).astype(np.float32)
+        y = np.zeros((16, 10), np.float32)
+        y[np.arange(16), RNG.integers(0, 10, 16)] = 1.0
+        quantize_for_inference(net)
+        e = net.evaluate(DataSet(x, y))
+        assert 0.0 <= e.accuracy() <= 1.0
