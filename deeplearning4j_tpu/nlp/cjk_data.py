@@ -4,11 +4,11 @@ The reference's CJK analyzers ship multi-megabyte system dictionaries
 (deeplearning4j-nlp-japanese bundles the kuromoji/IPADIC data,
 deeplearning4j-nlp-chinese the ansj/jieba tables) — most of their 19.6k
 LoC + resources is dictionary data. This module is the zero-egress
-counterpart: a hand-curated core-vocabulary dictionary (~880 Chinese
-words with relative frequencies, ~1190 Japanese entries with POS — the
+counterpart: a hand-curated core-vocabulary dictionary (~1040 Chinese
+words with relative frequencies, ~2200 Japanese entries with POS — the
 round-3 expansions generate frequency-weighted conjugated surfaces for
-curated verb and suru-noun lists, the stand-in for IPADIC's per-surface
-costs) that
+curated verb, i-adjective and suru-noun lists, the stand-in for
+IPADIC's per-surface costs) that
 makes `ChineseTokenizerFactory(dictionary="builtin")` /
 `JapaneseTokenizerFactory(dictionary="builtin")` segment everyday text
 sensibly out of the box. It is deliberately small: domain text should
@@ -81,6 +81,22 @@ _ZH_BUCKETS = (
     (1800, "护照 签证 机票 车票 行程 导游 景点 风景 古迹 寺庙 教堂 城堡 海滩 温泉 滑雪 爬山 露营 拍照 纪念品 特产"),
     # time / quantity refinements
     (3200, "正在 刚才 刚刚 从前 将来 未来 目前 如今 当时 近年来 本来 原来 后来 然而 此外 于是 因此 不仅 不但 既然 哪怕"),
+    # round-3c expansion: family / people
+    (4200, "爸爸 妈妈 哥哥 姐姐 弟弟 妹妹 爷爷 奶奶 外公 外婆 叔叔 阿姨 丈夫 妻子 儿子 女儿 亲戚 邻居 同学 同事"),
+    # colors / shapes / senses
+    (2400, "红色 黄色 蓝色 绿色 白色 黑色 灰色 紫色 粉色 颜色 圆形 方形 形状 大小 长短 高矮 声音 味道 气味 光线"),
+    # professions
+    (2000, "工人 农民 司机 警察 军人 律师 记者 演员 歌手 画家 作家 科学家 工程师 教授 经理 秘书 售货员 服务员 厨师 翻译"),
+    # cooking / restaurant
+    (1800, "炒菜 烤肉 火锅 烧烤 调料 酱油 点菜 菜单 筷子 勺子 碗 盘子 杯子 锅 刀叉 食堂 外卖 请客 买单"),
+    # written / formal function words (news register)
+    (2600, "即 与 及 将 被 使 令 据 且 则 亦 均 尚 仍 曾 未 须 应 宜"),
+    # education / exams
+    (2200, "考试 成绩 分数 及格 毕业 入学 作业 课程 专业 学位 硕士 博士 论文 讲座 实验 实习 奖学金 辅导 复习 预习"),
+    # feelings / evaluation round 2
+    (2000, "满意 失望 后悔 骄傲 自豪 惭愧 感激 同情 信任 尊重 热情 冷淡 温柔 严肃 幽默 可爱 可怕 可惜 危险 安全"),
+    # internet / daily modern life
+    (1600, "微信 短信 邮箱 搜索 浏览 充电 信号 蓝牙 耳机 键盘 鼠标 打印 复印 扫描 截图 保存 删除 备份 恢复 设置"),
 )
 
 ZH_FREQ = {}
@@ -176,6 +192,32 @@ _JA_VERBS = (
     ("忘れる", 2500, "ichidan"), ("借りる", 1500, "ichidan"),
     ("開ける", 2000, "ichidan"), ("閉める", 1500, "ichidan"),
     ("始める", 2500, "ichidan"), ("続ける", 2000, "ichidan"),
+    # round-3c expansion
+    ("急ぐ", 1200, "godan"), ("洗う", 1500, "godan"),
+    ("歌う", 1500, "godan"), ("払う", 1500, "godan"),
+    ("笑う", 2000, "godan"), ("泣く", 1200, "godan"),
+    ("置く", 2000, "godan"), ("着く", 2000, "godan"),
+    ("動く", 1800, "godan"), ("引く", 1500, "godan"),
+    ("押す", 1500, "godan"), ("消す", 1200, "godan"),
+    ("直す", 1200, "godan"), ("返す", 1500, "godan"),
+    ("渡す", 1500, "godan"), ("勝つ", 1500, "godan"),
+    ("選ぶ", 1500, "godan"), ("運ぶ", 1200, "godan"),
+    ("並ぶ", 1200, "godan"), ("進む", 1500, "godan"),
+    ("頼む", 1500, "godan"), ("切る", 1800, "godan"),
+    ("売る", 1800, "godan"), ("降る", 1800, "godan"),
+    ("困る", 1500, "godan"), ("止まる", 1500, "godan"),
+    ("始まる", 2500, "godan"), ("終わる", 2500, "godan"),
+    ("変わる", 2000, "godan"), ("かかる", 2500, "godan"),
+    ("もらう", 2500, "godan"), ("違う", 2500, "godan"),
+    ("見せる", 1800, "ichidan"), ("見える", 2000, "ichidan"),
+    ("聞こえる", 1500, "ichidan"), ("考える", 3000, "ichidan"),
+    ("答える", 1800, "ichidan"), ("捨てる", 1200, "ichidan"),
+    ("集める", 1500, "ichidan"), ("決める", 1800, "ichidan"),
+    ("届ける", 1200, "ichidan"), ("調べる", 1800, "ichidan"),
+    ("比べる", 1500, "ichidan"), ("並べる", 1200, "ichidan"),
+    ("入れる", 2200, "ichidan"), ("生まれる", 1800, "ichidan"),
+    ("別れる", 1200, "ichidan"), ("疲れる", 1800, "ichidan"),
+    ("慣れる", 1500, "ichidan"), ("遅れる", 1500, "ichidan"),
 )
 
 #: godan final-kana -> (masu-stem kana, te/ta sound change, negative kana)
@@ -260,7 +302,20 @@ _SURU_FORMS = {
     "しなかった": "nakatta", "したい": "tai",
 }
 
-for _noun, _freq in _JA_SURU_NOUNS:
+# round-3c: more suru-nouns (business / school / communication register)
+_JA_SURU_NOUNS_3C = (
+    ("会話", 2000), ("挨拶", 1800), ("遠慮", 1500), ("招待", 1500),
+    ("返事", 1800), ("出張", 1500), ("残業", 1500), ("報告", 2000),
+    ("計算", 1800), ("録音", 1000), ("撮影", 1200), ("放送", 1500),
+    ("輸入", 1200), ("輸出", 1200), ("販売", 1500), ("生産", 1500),
+    ("建設", 1200), ("開発", 1800), ("経営", 1500), ("管理", 1800),
+    ("教育", 2000), ("訓練", 1200), ("実験", 1500), ("観察", 1000),
+    ("想像", 1500), ("記憶", 1200), ("理解", 2000), ("判断", 1500),
+    ("決定", 1500), ("選択", 1500), ("注意", 2200), ("用意", 2000),
+    ("我慢", 1500), ("感動", 1500), ("感謝", 1800), ("協力", 1800),
+)
+
+for _noun, _freq in _JA_SURU_NOUNS + _JA_SURU_NOUNS_3C:
     if _noun not in JA_ENTRIES or JA_ENTRIES[_noun][0] < _freq:
         JA_ENTRIES[_noun] = (_freq, "名詞")
     for _suffix, _form in _SURU_FORMS.items():
@@ -268,3 +323,48 @@ for _noun, _freq in _JA_SURU_NOUNS:
         _surface = _noun + _suffix
         if _surface not in JA_ENTRIES or JA_ENTRIES[_surface][0] < _f:
             JA_ENTRIES[_surface] = (_f, "動詞")
+
+
+# --- Japanese i-adjective conjugation surfaces (round-3c expansion) ----
+#
+# IPADIC enumerates adjective conjugation surfaces the same way it does
+# verbs; the generator covers the productive -i paradigm for a curated
+# list: 高い -> 高く / 高くて / 高かった / 高くない / 高くなかった.
+# いい conjugates on the よ stem (よく / よかった / よくない).
+
+_JA_I_ADJECTIVES = (
+    ("高い", 4000), ("安い", 2500), ("大きい", 3500), ("小さい", 3000),
+    ("新しい", 3000), ("古い", 2000), ("長い", 2500), ("短い", 1500),
+    ("早い", 2500), ("遅い", 1800), ("近い", 2000), ("遠い", 1500),
+    ("暑い", 1800), ("寒い", 1800), ("熱い", 1500), ("冷たい", 1500),
+    ("楽しい", 2500), ("面白い", 2500), ("難しい", 2500),
+    ("易しい", 1000), ("美味しい", 2500), ("忙しい", 2200),
+    ("嬉しい", 2000), ("悲しい", 1500), ("強い", 2000), ("弱い", 1200),
+    ("重い", 1500), ("軽い", 1200), ("広い", 1500), ("狭い", 1000),
+    ("明るい", 1500), ("暗い", 1200), ("若い", 1800), ("多い", 3000),
+    ("少ない", 2000), ("良い", 3000), ("悪い", 2500), ("いい", 5000),
+)
+
+_ADJ_FORM_WEIGHTS = {
+    "dict": 1.0, "ku": 0.5, "kute": 0.4, "katta": 0.45,
+    "kunai": 0.35, "kunakatta": 0.15,
+}
+
+
+def _conjugate_i_adj(dict_form: str):
+    """Common surfaces of one i-adjective -> {surface: form_key}."""
+    stem = "よ" if dict_form == "いい" else dict_form[:-1]
+    out = {dict_form: "dict"}
+    out[stem + "く"] = "ku"
+    out[stem + "くて"] = "kute"
+    out[stem + "かった"] = "katta"
+    out[stem + "くない"] = "kunai"
+    out[stem + "くなかった"] = "kunakatta"
+    return out
+
+
+for _dict_form, _freq in _JA_I_ADJECTIVES:
+    for _surface, _form in _conjugate_i_adj(_dict_form).items():
+        _f = max(100, int(_freq * _ADJ_FORM_WEIGHTS[_form]))
+        if _surface not in JA_ENTRIES or JA_ENTRIES[_surface][0] < _f:
+            JA_ENTRIES[_surface] = (_f, "形容詞")

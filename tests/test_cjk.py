@@ -225,3 +225,34 @@ class TestBuiltinDictionaryScale:
         tfj = JapaneseTokenizerFactory(dictionary="builtin")
         toks2 = tfj.create("私は毎日日本語を勉強します").get_tokens()
         assert "勉強します" in toks2, toks2
+
+    def test_round3c_expansion(self):
+        """Round-3c: i-adjective conjugation surfaces + verb/suru-noun
+        growth + zh family/profession/modern-life bands."""
+        from deeplearning4j_tpu.nlp import cjk_data as c
+        assert len(c.ZH_FREQ) >= 1000
+        assert len(c.JA_ENTRIES) >= 2000
+        # generated i-adjective paradigm incl. the いい -> よ irregular
+        for surf in ("高かった", "難しくない", "面白くて", "寒く",
+                     "よかった", "よくない", "美味しくなかった"):
+            assert surf in c.JA_ENTRIES, surf
+            assert c.JA_ENTRIES[surf][1] == "形容詞"
+        assert c.JA_ENTRIES["高い"][0] > c.JA_ENTRIES["高かった"][0]
+        # new verb conjugations + suru compounds
+        for surf in ("考えました", "もらって", "変わらない", "注意して",
+                     "協力します"):
+            assert surf in c.JA_ENTRIES, surf
+
+    def test_round3c_segmentation(self):
+        tfj = JapaneseTokenizerFactory(dictionary="builtin")
+        toks = tfj.create("昨日の映画は面白かった").get_tokens()
+        assert "面白かった" in toks, toks
+        toks2 = tfj.create("天気がよかったので散歩しました").get_tokens()
+        assert "よかった" in toks2 and "散歩しました" in toks2, toks2
+
+        tf = ChineseTokenizerFactory(dictionary="builtin")
+        toks3 = tf.create("爸爸妈妈都很满意").get_tokens()
+        assert "爸爸" in toks3 and "妈妈" in toks3 and "满意" in toks3, toks3
+        toks4 = tf.create("工程师用微信发照片").get_tokens()
+        assert "工程师" in toks4 and "微信" in toks4 and "照片" in toks4, \
+            toks4
