@@ -380,11 +380,56 @@ def bench_word2vec():
                       "value": round(total_words / dt, 1)}), flush=True)
 
 
+def bench_quant():
+    """int8 weight-only quantization speedup on a weight-heavy MLP
+    (optimize/quantization.py W8A16): chained forwards (chaining defeats
+    the tunnel's repeated-dispatch result cache), f32 vs int8 of the
+    SAME compute — the delta is pure weight-byte traffic."""
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updater import Adam
+    from deeplearning4j_tpu.optimize.quantization import (
+        quantize_for_inference)
+
+    H, L, B = 8192, 4, 64
+    b = (NeuralNetConfiguration.Builder()
+         .seed(1).updater(Adam(1e-3)).weight_init("xavier").list())
+    for _ in range(L):
+        b.layer(DenseLayer(n_out=H, activation="relu"))
+    b.layer(OutputLayer(n_out=64, loss="mcxent", activation="softmax"))
+    net = MultiLayerNetwork(b.set_input_type(InputType.feed_forward(H))
+                            .build()).init()
+    x0 = jnp.asarray(np.random.default_rng(0).standard_normal(
+        (B, H)).astype(np.float32))
+
+    def measure(n=30):
+        x = x0
+        out = net.output(x)
+        float(jnp.sum(out[:1, :1]))
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = net.output(x)
+            x = x.at[:, :64].add(out * 1e-9)     # chain
+        float(jnp.sum(out[:1, :1]))
+        return (time.perf_counter() - t0) / n
+
+    fp = measure()
+    quantize_for_inference(net)
+    q = measure()
+    print(json.dumps({"metric": "quant_mlp_int8_speedup",
+                      "value": round(fp / q, 2), "unit": "x",
+                      "fp32_ms": round(fp * 1e3, 2),
+                      "int8_ms": round(q * 1e3, 2)}), flush=True)
+
+
 ALL = {"resnet": bench_resnet, "lstm": bench_lstm, "lenet": bench_lenet,
        "vgg16": bench_vgg16, "inception": bench_keras_inception,
        "attention": bench_attention, "transformer": bench_transformer,
        "scaling": bench_scaling, "word2vec": bench_word2vec,
-       "window": bench_window_attention}
+       "window": bench_window_attention, "quant": bench_quant}
 
 if __name__ == "__main__":
     names = sys.argv[1:] or ["resnet", "lstm", "lenet", "vgg16",

@@ -40,6 +40,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30  # finite: (-inf) - (-inf) = nan inside exp would poison rows
 
+LOG2E = float(np.log2(np.e))   # fwd runs the online softmax in base 2:
+LN2 = float(np.log(2.0))       # exp2((s-m)*log2e) == exp(s-m) exactly, but
+#                                exp2 skips the VPU's internal x*log2e step
+#                                (one multiply per score); lse converts back
+#                                to natural log at the block boundary
+
 # 1024/1024 measured fastest on v5e at T=8k/D=128 (sweep in PERF.md)
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
@@ -124,9 +130,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     def _compute(masked: bool):
+        # scores in BASE-2 units (scale folds in log2(e)); p values are
+        # bit-for-bit the same softmax weights, m/l carry base-2 maxima
         s = jax.lax.dot_general(
             q_ref[0, 0], k_ref[0, 0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale        # [bq, bk]
+            preferred_element_type=jnp.float32) * (scale * LOG2E)
         if masked:
             valid = _block_mask(i, j, bq, bk, causal, km_ref[0], window,
                                 q_offset)
@@ -134,12 +142,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref,
         m_prev = m_scr[:][:, :1]                               # [bq, 1]
         l_prev = l_scr[:][:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
+        p = jnp.exp2(s - m_new)
         if masked:
             # explicit zeroing: if a whole row is masked,
-            # exp(NEG_INF - NEG_INF) would be 1 — keep such rows at p=0
+            # exp2(NEG_INF - NEG_INF) would be 1 — keep such rows at p=0
             p = p * valid.astype(jnp.float32)
-        corr = jnp.exp(m_prev - m_new)
+        corr = jnp.exp2(m_prev - m_new)
         l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
         pv = jax.lax.dot_general(
             p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
@@ -154,10 +162,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref,
 
     @pl.when(j == nk - 1)
     def _finish():
-        m = m_scr[:][:, :1]
+        m = m_scr[:][:, :1]                    # base-2 running max
         l = l_scr[:][:, :1]
         o_ref[0, 0] = (acc_scr[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-        lse_ref[0, 0] = m + jnp.log(jnp.maximum(l, 1e-30))
+        # public lse stays NATURAL log (backward + ring combine contract)
+        lse_ref[0, 0] = m * LN2 + jnp.log(jnp.maximum(l, 1e-30))
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, d_ref,
@@ -170,16 +179,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, d_ref,
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
     def _compute(masked: bool):
+        # base-2 probabilities like the forward: exp2(s*log2e - lse*log2e)
+        # == exp(s - lse); ds keeps the NATURAL scale (chain rule)
         s = jax.lax.dot_general(
             q_ref[0, 0], k_ref[0, 0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+            preferred_element_type=jnp.float32) * (scale * LOG2E)
         if masked:
             # mask BEFORE exp (as forward does): a masked raw score above
             # the row lse would overflow exp to inf and 0*inf = NaN
             valid = _block_mask(i, j, bq, bk, causal, km_ref[0], window,
                                 q_offset)
             s = jnp.where(valid, s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0, 0])
+        p = jnp.exp2(s - lse_ref[0, 0] * LOG2E)
         if masked:
             p = p * valid.astype(jnp.float32)
         dp = jax.lax.dot_general(
@@ -213,12 +224,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, lse_ref, d_ref,
     def _compute(masked: bool):
         s = jax.lax.dot_general(
             q_ref[0, 0], k_ref[0, 0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale        # [bq, bk]
+            preferred_element_type=jnp.float32) * (scale * LOG2E)
         if masked:
             valid = _block_mask(i, j, bq, bk, causal, km_ref[0], window,
                                 q_offset)
             s = jnp.where(valid, s, NEG_INF)   # see _bwd_dq_kernel note
-        p = jnp.exp(s - lse_ref[0, 0])
+        p = jnp.exp2(s - lse_ref[0, 0] * LOG2E)
         if masked:
             p = p * valid.astype(jnp.float32)
         pt = p.astype(do_ref.dtype)
