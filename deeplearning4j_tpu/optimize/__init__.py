@@ -1,0 +1,7 @@
+"""Optimization-adjacent utilities: solvers, listeners, profiler, and
+post-training quantization (ref layer: optimize/ Solver + listeners in
+deeplearning4j-nn; quantization is the TPU-serving post-parity add)."""
+
+from deeplearning4j_tpu.optimize.quantization import (  # noqa: F401
+    QuantizedTensor, quantize_for_inference,
+)

@@ -69,3 +69,10 @@ def test_serving_decode():
     assert len(outs) == 4
     for text, score in outs:
         assert len(text) > 10 and np.isfinite(score)
+
+
+def test_quantized_serving():
+    res = _run("quantized_serving", train_steps=30)
+    assert res["ratio"] > 3.0          # int8 weights ~4x smaller
+    assert res["refused"]              # training blocked post-quantize
+    assert len(res["q"]) == len(res["fp"])
