@@ -1,0 +1,40 @@
+"""Shared compute-dtype policy for MultiLayerNetwork / ComputationGraph.
+
+One place for the two halves of the mixed-precision contract:
+- `bf16_cast` — the conf.dtype="bfloat16" compute cast (params +
+  activations run bf16; MXU path with fp32 accumulation, the same
+  compute policy the reference's cuDNN helpers select via
+  BaseCudnnHelper dataType);
+- `f32_head` — public outputs (output / rnn_time_step) promote sub-f32
+  floats back to f32 at the jit boundary; f32/f64 pass through
+  untouched (a f64 network keeps f64 outputs).
+
+conf.dtype is part of every jitted-step cache key (the policy is baked
+into the trace — a stale compiled step would silently keep the old
+precision, the same staleness rule as _STREAM_CACHE_SHARDING).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def bf16_cast(a):
+    """Cast one floating array to bfloat16 (non-floats untouched)."""
+    return a.astype(jnp.bfloat16) \
+        if jnp.issubdtype(a.dtype, jnp.floating) else a
+
+
+def bf16_cast_tree(tree):
+    """bf16-cast every floating leaf of a pytree."""
+    return jax.tree_util.tree_map(bf16_cast, tree)
+
+
+def f32_head(a):
+    """Promote a sub-f32 floating output (bf16/f16 compute) to f32 at
+    the public boundary; f32/f64 (and non-floats) pass through."""
+    if not jnp.issubdtype(a.dtype, jnp.floating):
+        return a
+    t = jnp.promote_types(a.dtype, jnp.float32)
+    return a if t == a.dtype else a.astype(t)

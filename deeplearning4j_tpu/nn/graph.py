@@ -30,6 +30,9 @@ from deeplearning4j_tpu.nn.updater import normalize_gradients
 log = logging.getLogger(__name__)
 
 
+from deeplearning4j_tpu.nn.compute import f32_head as _f32_head  # noqa: E402
+
+
 def _tree_sub(params, steps):
     return jax.tree_util.tree_map(lambda p, s: p - s, params, steps)
 
@@ -212,8 +215,12 @@ class ComputationGraph:
         SelfAttentionLayer._stream_attend."""
         preout_set = ({preout_of} if isinstance(preout_of, str)
                       else set(preout_of or ()))
-        if getattr(self, "_quantized", False):
-            params = self._dequantized(params)
+        # inference honors the bf16 compute policy too (also applied by
+        # _loss for reg in f32 — double application is a no-op): bf16
+        # activations + weights halve HBM traffic and carried KV-cache
+        # memory; output() / rnn_time_step cast final activations back
+        # to f32 (f32_head)
+        params, inputs = self._cast_compute(params, inputs)
         fused_plan, fused_skip = self._fusion()
         acts: Dict[str, Any] = dict(inputs)
         masks: Dict[str, Any] = dict(fmasks or {})
@@ -326,24 +333,33 @@ class ComputationGraph:
 
     def _dequantized(self, params):
         """Materialize int8 QuantizedTensor leaves (W8A16 serving,
-        optimize/quantization.py) as float32 — inference activations run
-        f32 (conf.dtype is a TRAINING-cast policy); XLA fuses the int8
-        convert into each consumer, which is where the HBM saving
-        lives. Mirrors MultiLayerNetwork._dequantized."""
+        optimize/quantization.py) as float32; XLA fuses the int8 convert
+        into each consumer, which is where the HBM saving lives.
+        Mirrors MultiLayerNetwork._dequantized."""
         from deeplearning4j_tpu.optimize.quantization import dequantize_tree
         return dequantize_tree(params, jnp.float32)
+
+    def _cast_compute(self, params, inputs):
+        """Dequantize int8 leaves, then apply the bf16 compute cast to
+        params + the input dict (mirrors MultiLayerNetwork._cast_compute;
+        conf.dtype sits in every jit key, so the policy can't go stale)."""
+        from deeplearning4j_tpu.nn.compute import bf16_cast, bf16_cast_tree
+        if getattr(self, "_quantized", False):
+            params = self._dequantized(params)
+        if self.conf.dtype in ("bfloat16", "bf16"):
+            params = bf16_cast_tree(params)
+            inputs = {k: bf16_cast(jnp.asarray(v))
+                      for k, v in inputs.items()}
+        return params, inputs
 
     def _loss(self, params, state, inputs, labels: Dict[str, Any], rng,
               fmasks, lmasks, *, train=True, carry_rnn=False):
         """Sum of output-layer losses + regularization."""
+        # _forward applies the compute cast; dequantize here only so the
+        # reg term below never sees int8 leaves (scoring path — training
+        # itself is refused in _get_train_step)
         if getattr(self, "_quantized", False):
-            # scoring path; training itself is refused in _get_train_step
             params = self._dequantized(params)
-        if self.conf.dtype in ("bfloat16", "bf16"):
-            cast = lambda a: a.astype(jnp.bfloat16) \
-                if jnp.issubdtype(a.dtype, jnp.floating) else a
-            params = jax.tree_util.tree_map(cast, params)
-            inputs = {k: cast(v) for k, v in inputs.items()}
         # ONE forward pass yields every output layer's preout (stateful
         # vertices update exactly once per step, matching the reference's
         # single feedForward in computeGradientAndScore :1298)
@@ -400,7 +416,7 @@ class ComputationGraph:
                 "this network was quantized for inference "
                 "(quantize_for_inference) — int8 weights have no "
                 "gradient path; train the fp checkpoint and re-quantize")
-        key = ("train", carry_rnn)
+        key = ("train", carry_rnn, self.conf.dtype)
         if key not in self._jit_cache:
             conf = self.conf
 
@@ -475,12 +491,13 @@ class ComputationGraph:
         the graph has one output, else a list."""
         if not self._initialized:
             self.init()
-        key = ("out", train)
+        key = ("out", train, self.conf.dtype)
         if key not in self._jit_cache:
             def fwd(params, state, ins, rng, fmasks):
                 acts, new_state, _ = self._forward(params, state, ins, train=train,
                                                    rng=rng, fmasks=fmasks)
-                return [acts[o] for o in self.conf.network_outputs], new_state
+                return [_f32_head(acts[o])
+                        for o in self.conf.network_outputs], new_state
 
             self._jit_cache[key] = jax.jit(fwd)
         if len(inputs) == 1 and isinstance(inputs[0], dict):
@@ -528,21 +545,22 @@ class ComputationGraph:
         # process-wide setting retraces for every net on next use
         from deeplearning4j_tpu.nn.conf import layers as _L
         padded = pad_left is not None
-        key = ("rnn_step", padded, _L._STREAM_CACHE_SHARDING)
+        key = ("rnn_step", padded, self.conf.dtype,
+               _L._STREAM_CACHE_SHARDING)
         if key not in self._jit_cache:
             if padded:
                 def fwd(params, state, ins, rng, pad):
                     acts, new_state, _ = self._forward(
                         params, state, ins, train=False, rng=rng,
                         fmasks=None, carry_rnn=True, stream=True, pad=pad)
-                    return [acts[o] for o in
+                    return [_f32_head(acts[o]) for o in
                             self.conf.network_outputs], new_state
             else:
                 def fwd(params, state, ins, rng, fmasks):
                     acts, new_state, _ = self._forward(
                         params, state, ins, train=False, rng=rng,
                         fmasks=fmasks, carry_rnn=True, stream=True)
-                    return [acts[o] for o in
+                    return [_f32_head(acts[o]) for o in
                             self.conf.network_outputs], new_state
 
             self._jit_cache[key] = jax.jit(fwd)

@@ -120,8 +120,12 @@ class MultiLayerNetwork:
         acts = []
         new_state = {}
         mask = fmask
-        if getattr(self, "_quantized", False):
-            params = self._dequantized(params)
+        # inference honors the bf16 compute policy too (training gets it
+        # in _loss; double application is a no-op): bf16 activations +
+        # weights halve HBM traffic and the carried KV-cache memory. The
+        # public output() / rnn_time_step cast the final activation back
+        # to f32 at the jit boundary.
+        params, x = self._cast_compute(params, x)
         if pad is not None:
             mask = jnp.broadcast_to(jnp.arange(x.shape[-1]) >= pad,
                                     (x.shape[0], x.shape[-1]))
@@ -184,12 +188,11 @@ class MultiLayerNetwork:
         this keeps matmuls/convs on the MXU bf16 path with fp32 accumulation
         (XLA default), the same fp16-compute policy the reference's cuDNN
         helpers select (BaseCudnnHelper dataType)."""
+        from deeplearning4j_tpu.nn.compute import bf16_cast, bf16_cast_tree
         if getattr(self, "_quantized", False):
             params = self._dequantized(params)
         if self.conf.dtype in ("bfloat16", "bf16"):
-            cast = lambda a: a.astype(jnp.bfloat16) \
-                if jnp.issubdtype(a.dtype, jnp.floating) else a
-            return jax.tree_util.tree_map(cast, params), cast(x)
+            return bf16_cast_tree(params), bf16_cast(x)
         return params, x
 
     def _loss(self, params, state, x, y, rng, fmask, lmask, *, train=True,
@@ -247,7 +250,9 @@ class MultiLayerNetwork:
                 "this network was quantized for inference "
                 "(quantize_for_inference) — int8 weights have no "
                 "gradient path; train the fp checkpoint and re-quantize")
-        key = ("train", carry_rnn)
+        # conf.dtype is baked into the trace: key it (stale compiled
+        # steps would silently keep the old precision)
+        key = ("train", carry_rnn, self.conf.dtype)
         if key not in self._jit_cache:
             conf = self.conf
 
@@ -274,8 +279,9 @@ class MultiLayerNetwork:
         # the process-wide stream-cache sharding config is part of the
         # key: flipping it retraces the step for EVERY net on next use
         # (a stale compiled step would silently keep the old layout)
+        from deeplearning4j_tpu.nn.compute import f32_head as head
         from deeplearning4j_tpu.nn.conf import layers as _L
-        key = ("out", train, carry_rnn, stream, padded,
+        key = ("out", train, carry_rnn, stream, padded, self.conf.dtype,
                _L._STREAM_CACHE_SHARDING if stream else None)
         if key not in self._jit_cache:
             if padded:
@@ -285,26 +291,27 @@ class MultiLayerNetwork:
                     acts, new_state = self._forward(
                         params, state, x, train=train, rng=rng, fmask=None,
                         carry_rnn=carry_rnn, stream=stream, pad=pad)
-                    return acts[-1], new_state
+                    return head(acts[-1]), new_state
             else:
                 def fwd(params, state, x, rng, fmask):
                     acts, new_state = self._forward(
                         params, state, x, train=train, rng=rng, fmask=fmask,
                         carry_rnn=carry_rnn, stream=stream)
-                    return acts[-1], new_state
+                    return head(acts[-1]), new_state
 
             self._jit_cache[key] = jax.jit(fwd)
         return self._jit_cache[key]
 
     def _get_score_fn(self):
-        if "score" not in self._jit_cache:
+        key = ("score", self.conf.dtype)
+        if key not in self._jit_cache:
             def sf(params, state, x, y, fmask, lmask):
                 loss, _ = self._loss(params, state, x, y, None, fmask, lmask,
                                      train=False)
                 return loss
 
-            self._jit_cache["score"] = jax.jit(sf)
-        return self._jit_cache["score"]
+            self._jit_cache[key] = jax.jit(sf)
+        return self._jit_cache[key]
 
     # ------------------------------------------------------------------
     # training
@@ -395,10 +402,12 @@ class MultiLayerNetwork:
         return out
 
     def feed_forward(self, x, train: bool = False):
-        """All layer activations (ref: feedForward :852)."""
+        """All layer activations (ref: feedForward :852). Public outputs
+        follow the same f32 boundary as output()."""
+        from deeplearning4j_tpu.nn.compute import f32_head
         acts, _ = self._forward(self.params, self.state, jnp.asarray(x),
                                 train=train, rng=jax.random.PRNGKey(0))
-        return acts
+        return [f32_head(a) for a in acts]
 
     def score(self, ds: DataSet = None, features=None, labels=None) -> float:
         """Loss on a dataset (ref: MultiLayerNetwork.score(DataSet))."""

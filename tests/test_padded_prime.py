@@ -165,9 +165,10 @@ class TestPaddedPrimeServing:
 
     def _padded_traces(self, net):
         from deeplearning4j_tpu.nn.conf import layers as L
-        fn = net._jit_cache.get(("rnn_step", True,
+        fn = net._jit_cache.get(("rnn_step", True, net.conf.dtype,
                                  L._STREAM_CACHE_SHARDING))
-        return 0 if fn is None else fn._cache_size()
+        assert fn is not None, "rnn_step jit key drifted from the tests"
+        return fn._cache_size()
 
     def test_one_trace_per_bucket(self):
         """Different prompt lengths in one bucket share ONE compiled

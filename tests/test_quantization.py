@@ -171,6 +171,38 @@ class TestQuantizedNetworks:
         with pytest.raises(RuntimeError, match="quantized for inference"):
             net.pretrain(iter([]))
 
+    def test_bf16_inference_outputs_f32_and_close(self):
+        """conf.dtype='bfloat16' now applies to INFERENCE too: compute
+        runs bf16 (KV caches / activations) but public outputs stay f32
+        and match the f32 path to bf16 precision."""
+        net = _mlp()
+        x = np.random.default_rng(13).standard_normal(
+            (8, 64)).astype(np.float32)
+        ref = np.asarray(net.output(x))
+        net.conf.dtype = "bfloat16"     # no cache clear: dtype keys jits
+        got = np.asarray(net.output(x))
+        assert got.dtype == np.float32          # f32 at the boundary
+        assert np.abs(got - ref).max() < 0.05   # bf16-precision match
+        assert (got.argmax(1) == ref.argmax(1)).mean() >= 0.9
+        net.conf.dtype = "float32"              # flip back: f32 again
+        back = np.asarray(net.output(x))
+        np.testing.assert_allclose(back, ref, atol=1e-6)
+
+    def test_bf16_streaming_cache_is_bf16(self):
+        """bf16 streaming decode carries a bf16 KV cache (half memory)."""
+        import jax.numpy as jnp
+        model = TextGenerationTransformer(vocab_size=12, embed_dim=16,
+                                          n_heads=2, n_layers=1,
+                                          max_length=16)
+        net = model.init()
+        net.conf.dtype = "bfloat16"
+        x = np.zeros((1, 12, 3), np.float32)
+        x[0, [1, 2, 3], np.arange(3)] = 1.0
+        net.rnn_time_step(x)
+        caches = [s["kv_k"] for s in net.state.values()
+                  if isinstance(s, dict) and "kv_k" in s]
+        assert caches and all(c.dtype == jnp.bfloat16 for c in caches)
+
     def test_evaluate_works_quantized(self):
         net = _mlp()
         x = RNG.standard_normal((16, 64)).astype(np.float32)

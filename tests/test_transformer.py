@@ -909,8 +909,9 @@ class TestBucketedDecoding:
     def _stream_traces(self, net):
         from deeplearning4j_tpu.nn.conf import layers as L
         fn = net._jit_cache.get(
-            ("rnn_step", False, L._STREAM_CACHE_SHARDING))
-        return 0 if fn is None else fn._cache_size()
+            ("rnn_step", False, net.conf.dtype, L._STREAM_CACHE_SHARDING))
+        assert fn is not None, "rnn_step jit key drifted from the tests"
+        return fn._cache_size()
 
     def test_prime_chunks(self):
         from deeplearning4j_tpu.util.decoding import _prime_chunks
