@@ -29,12 +29,44 @@ def _probs(out) -> np.ndarray:
     return np.asarray(out[0] if isinstance(out, (list, tuple)) else out)
 
 
-def draw(probs, temperature: float, rng: np.random.Generator) -> int:
-    """Temperature-sample one token id from a softmax distribution (the
-    single draw implementation shared by every sampler)."""
+def draw(probs, temperature: float, rng: np.random.Generator,
+         top_k: Optional[int] = None,
+         top_p: Optional[float] = None) -> int:
+    """Sample one token id from a softmax distribution (the single draw
+    implementation shared by every sampler).
+
+    Order of operations matches the common serving convention:
+    temperature rescales the distribution first, then `top_k` keeps the
+    k most probable tokens, then `top_p` (nucleus) keeps the smallest
+    prefix of the sorted distribution whose mass reaches p (always at
+    least one token), and the survivors renormalize. top_k=1 is greedy
+    decoding regardless of temperature."""
     logits = np.log(np.clip(probs, 1e-9, None)) / temperature
     p = np.exp(logits - logits.max())
     p /= p.sum()
+    if top_k is not None:
+        if top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        if top_k < len(p):
+            # exactly k indices (a value threshold would keep every token
+            # TIED with the kth — e.g. a clipped flat tail — and sample
+            # the whole vocab precisely when users reach for top_k)
+            keep_idx = np.argpartition(p, -top_k)[-top_k:]
+            mask = np.zeros_like(p, dtype=bool)
+            mask[keep_idx] = True
+            p = np.where(mask, p, 0.0)
+            p /= p.sum()
+    if top_p is not None:
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        order = np.argsort(p)[::-1]
+        csum = np.cumsum(p[order])
+        # smallest prefix reaching top_p, never empty
+        cut = int(np.searchsorted(csum, top_p)) + 1
+        keep = np.zeros_like(p, dtype=bool)
+        keep[order[:cut]] = True
+        p = np.where(keep, p, 0.0)
+        p /= p.sum()
     return int(rng.choice(len(p), p=p))
 
 
@@ -165,14 +197,17 @@ def sample_stream(net, seed_ids, steps: int, vocab_size: int,
                   rng: Optional[np.random.Generator] = None,
                   max_length: Optional[int] = None,
                   prime_chunk_max: Optional[int] = None,
-                  prime_padded: bool = False) -> List[int]:
+                  prime_padded: bool = False,
+                  top_k: Optional[int] = None,
+                  top_p: Optional[float] = None) -> List[int]:
     """Temperature sampling with KV-cache / stored-state incremental
     decoding: prime once with the seed, then one single-position forward
     per generated token (the reference's rnnTimeStep generation loop;
     identical distribution to a padded full forward — tested).
     `prime_chunk_max` overrides the process default (set_prime_chunk_max)
     for this call only; `prime_padded=True` instead primes the whole
-    prompt in ONE left-padded dispatch (see _prime_padded)."""
+    prompt in ONE left-padded dispatch (see _prime_padded). `top_k` /
+    `top_p` filter each draw (see `draw`; top_k=1 is greedy)."""
     _check_seed(seed_ids, steps, max_length)
     rng = rng or np.random.default_rng(0)
     ids = list(seed_ids)
@@ -183,7 +218,8 @@ def sample_stream(net, seed_ids, steps: int, vocab_size: int,
     for i in range(steps):
         if max_length is not None and len(ids) >= max_length:
             break
-        nxt = draw(_probs(out)[0, :, -1], temperature, rng)
+        nxt = draw(_probs(out)[0, :, -1], temperature, rng,
+                   top_k=top_k, top_p=top_p)
         ids.append(nxt)
         if i + 1 < steps and (max_length is None
                               or len(ids) < max_length):

@@ -36,17 +36,19 @@ class TextGenerationLSTM(ZooModel):
     def sample_stream(self, net, seed_ids, steps: int,
                       vocab_size: int = None,
                       rng=None, temperature: float = 1.0,
-                      prime_padded: bool = False):
+                      prime_padded: bool = False,
+                      top_k: int = None, top_p: float = None):
         """Temperature sampling through the stored-state rnnTimeStep path
         (the reference's character-generation loop; shared implementation
         util/decoding.sample_stream; unbounded length). `prime_padded=True`
         primes the prompt in ONE left-padded dispatch (masked pad steps
-        pass h/c through unchanged)."""
+        pass h/c through unchanged); `top_k`/`top_p` filter each draw."""
         from deeplearning4j_tpu.util.decoding import sample_stream
         return sample_stream(net, seed_ids, steps,
                              vocab_size or self.vocab_size,
                              temperature=temperature, rng=rng,
-                             max_length=None, prime_padded=prime_padded)
+                             max_length=None, prime_padded=prime_padded,
+                             top_k=top_k, top_p=top_p)
 
     def beam_search(self, net, seed_ids, steps: int, beam_width: int = 4,
                     vocab_size: int = None, prime_padded: bool = False):

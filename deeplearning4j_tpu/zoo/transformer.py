@@ -102,11 +102,13 @@ class TextGenerationTransformer(ZooModel):
 
     # -- convenience: sampling (ref TextGenerationLSTM usage pattern) ------
     def sample(self, net, seed_ids, steps: int, vocab_size: int = None,
-               rng: np.random.Generator = None, temperature: float = 1.0):
+               rng: np.random.Generator = None, temperature: float = 1.0,
+               top_k: int = None, top_p: float = None):
         """Autoregressive sampling from a trained net. The input is padded
         to max_length so XLA compiles ONE shape (causal attention + the
         per-position layers make trailing zero padding inert for the
-        position being read)."""
+        position being read). `top_k`/`top_p` filter each draw exactly
+        as in sample_stream."""
         V = vocab_size or self.vocab_size
         L = self.max_length
         rng = rng or np.random.default_rng(0)
@@ -120,7 +122,7 @@ class TextGenerationTransformer(ZooModel):
             out = net.output(x)
             probs = np.asarray(out[0] if isinstance(out, (list, tuple))
                                else out)[0, :, pos]
-            nxt = _draw(probs, temperature, rng)
+            nxt = _draw(probs, temperature, rng, top_k=top_k, top_p=top_p)
             ids.append(nxt)
             x[0, nxt, len(ids) - 1] = 1.0
         return ids
@@ -129,18 +131,21 @@ class TextGenerationTransformer(ZooModel):
                       vocab_size: int = None,
                       rng: np.random.Generator = None,
                       temperature: float = 1.0,
-                      prime_padded: bool = False):
+                      prime_padded: bool = False,
+                      top_k: int = None, top_p: float = None):
         """KV-cache incremental decoding (shared implementation:
         util/decoding.sample_stream) — O(steps) single-position forwards
         instead of the padded full-forward-per-token of `sample`, with an
         identical sampling distribution (tested). `prime_padded=True`
-        primes the prompt in ONE left-padded dispatch."""
+        primes the prompt in ONE left-padded dispatch; `top_k`/`top_p`
+        filter each draw (top_k=1 is greedy)."""
         from deeplearning4j_tpu.util.decoding import sample_stream
         return sample_stream(net, seed_ids, steps,
                              vocab_size or self.vocab_size,
                              temperature=temperature, rng=rng,
                              max_length=self.max_length,
-                             prime_padded=prime_padded)
+                             prime_padded=prime_padded,
+                             top_k=top_k, top_p=top_p)
 
     def beam_search(self, net, seed_ids, steps: int, beam_width: int = 4,
                     vocab_size: int = None, prime_padded: bool = False):

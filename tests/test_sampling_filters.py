@@ -1,0 +1,96 @@
+"""top-k / top-p (nucleus) sampling filters on the shared draw()
+(util/decoding) and their passthrough on the decode entry points."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.util.decoding import draw
+from deeplearning4j_tpu.zoo import TextGenerationTransformer
+
+
+def _probs(vals):
+    p = np.asarray(vals, np.float64)
+    return p / p.sum()
+
+
+class TestDraw:
+    def test_top_k_1_is_greedy(self):
+        p = _probs([0.1, 0.5, 0.2, 0.2])
+        for seed in range(5):
+            assert draw(p, 2.0, np.random.default_rng(seed), top_k=1) == 1
+
+    def test_top_k_restricts_support(self):
+        p = _probs([0.4, 0.3, 0.2, 0.1])
+        rng = np.random.default_rng(0)
+        seen = {draw(p, 1.0, rng, top_k=2) for _ in range(200)}
+        assert seen <= {0, 1}
+        assert seen == {0, 1}          # both survivors actually drawn
+
+    def test_top_p_keeps_smallest_prefix(self):
+        # sorted mass: .4, .3, .2, .1 — top_p=.6 keeps {0,1} (prefix sums
+        # .4, .7: first prefix reaching .6 is two tokens)
+        p = _probs([0.4, 0.3, 0.2, 0.1])
+        rng = np.random.default_rng(0)
+        seen = {draw(p, 1.0, rng, top_p=0.6) for _ in range(200)}
+        assert seen == {0, 1}
+
+    def test_top_p_never_empty(self):
+        p = _probs([0.999, 0.001, 0.0001])
+        assert draw(p, 1.0, np.random.default_rng(0), top_p=0.01) == 0
+
+    def test_filters_compose(self):
+        p = _probs([0.4, 0.3, 0.2, 0.1])
+        rng = np.random.default_rng(0)
+        seen = {draw(p, 1.0, rng, top_k=3, top_p=0.5) for _ in range(200)}
+        # top_k keeps {0,1,2} renormalized to .44/.33/.22; top_p=.5 then
+        # keeps the first two (prefix sums .44, .78)
+        assert seen == {0, 1}
+
+    def test_temperature_applies_before_filtering(self):
+        # temperature ~0 concentrates everything on the argmax, so even
+        # a wide top_p draws only it
+        p = _probs([0.3, 0.31, 0.39])
+        assert draw(p, 1e-4, np.random.default_rng(0), top_p=0.99) == 2
+
+    def test_top_k_exact_even_with_ties(self):
+        """A flat (tied) tail must not survive a top_k cut: exactly k
+        indices are kept, not every token tied with the kth value."""
+        p = np.full(100, 1e-9)
+        p[7] = 1.0
+        p = p / p.sum()
+        rng = np.random.default_rng(0)
+        # top_k=3 on a 99-way-tied tail: draws come from only 3 tokens
+        seen = {draw(p, 2.0, rng, top_k=3) for _ in range(300)}
+        assert len(seen) <= 3
+        assert 7 in seen
+
+    def test_validation(self):
+        p = _probs([0.5, 0.5])
+        with pytest.raises(ValueError, match="top_k"):
+            draw(p, 1.0, np.random.default_rng(0), top_k=0)
+        with pytest.raises(ValueError, match="top_p"):
+            draw(p, 1.0, np.random.default_rng(0), top_p=0.0)
+        with pytest.raises(ValueError, match="top_p"):
+            draw(p, 1.0, np.random.default_rng(0), top_p=1.5)
+
+
+class TestEntryPoints:
+    def test_sample_stream_top_k_greedy_deterministic(self):
+        model = TextGenerationTransformer(vocab_size=12, embed_dim=16,
+                                          n_heads=2, n_layers=1,
+                                          max_length=32)
+        net = model.init()
+        a = model.sample_stream(net, [1, 2, 3], steps=5, top_k=1,
+                                rng=np.random.default_rng(0))
+        b = model.sample_stream(net, [1, 2, 3], steps=5, top_k=1,
+                                rng=np.random.default_rng(99))
+        assert a == b                  # greedy ignores the rng
+
+    def test_sample_stream_top_p_runs(self):
+        model = TextGenerationTransformer(vocab_size=12, embed_dim=16,
+                                          n_heads=2, n_layers=1,
+                                          max_length=32)
+        net = model.init()
+        ids = model.sample_stream(net, [1, 2, 3], steps=5, top_p=0.9,
+                                  rng=np.random.default_rng(1))
+        assert len(ids) == 8 and all(0 <= i < 12 for i in ids)
