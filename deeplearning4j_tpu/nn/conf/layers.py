@@ -59,6 +59,85 @@ def reorder_stream_state(net, indices) -> None:
             for kk, vv in s.items()}
 
 
+def rewind_stream_state(net, n: int) -> None:
+    """Rewind the last `n` streamed positions (speculative-decoding
+    rollback, util/decoding.speculative_sample): position counters
+    (attention kv_pos, positional-embedding pos_offset) move back by n —
+    the rejected cache slots become invisible to the position-validity
+    masks and are overwritten by the next write, so a rewound stream is
+    exactly the stream that never saw those tokens (test-pinned).
+
+    Only position-indexed state can rewind: recurrent h/c carries the
+    rejected steps irreversibly, so nets with streaming LSTM state
+    raise. Rolling (windowed) caches additionally need
+    cache_length >= window + n — a rejected write may have evicted the
+    slot n positions short of the window edge."""
+    if n == 0:
+        return
+    check_rewindable(net, n)
+    # ONE device dispatch for every counter (speculative decoding calls
+    # this per round — per-counter updates would pay dispatch latency
+    # once per layer per round)
+    refs, vals = [], []
+    for name, s in net.state.items():
+        if not isinstance(s, dict):
+            continue
+        for k in ("kv_pos", "pos_offset"):
+            if k in s:
+                refs.append((name, k))
+                vals.append(s[k])
+    if refs:
+        new_vals = _rewind_counters(vals, jnp.asarray(n, jnp.int32))
+        for (name, k), v in zip(refs, new_vals):
+            s = dict(net.state[name])
+            s[k] = v
+            net.state[name] = s
+    if hasattr(net, "_stream_pos"):
+        net._stream_pos = max(0, net._stream_pos - n)
+    pm = getattr(net, "_stream_pos_map", None)
+    if pm:
+        net._stream_pos_map = {k: max(0, v - n) for k, v in pm.items()}
+
+
+@jax.jit
+def _rewind_counters(vals, n):
+    return [jnp.maximum(v - n, 0) for v in vals]
+
+
+def check_rewindable(net, n: int) -> None:
+    """Validate that `net` can rewind up to `n` streamed positions
+    (rewind_stream_state preconditions) — speculative_sample calls this
+    ONCE at entry with n = gamma, so a non-rewindable net fails fast
+    instead of mid-generation at the first data-dependent rejection."""
+    if n < 0:
+        raise ValueError(f"rewind must be >= 0, got {n}")
+    for s in net.state.values():
+        if isinstance(s, dict) and ("h" in s or "c" in s):
+            raise ValueError(
+                "rewind_stream_state: recurrent h/c streaming state "
+                "cannot be rewound (LSTM layers do not support "
+                "speculative rollback)")
+    layers = list(getattr(net, "layers", None) or []) or [
+        getattr(v, "layer", None)
+        for v in (getattr(net.conf, "vertices", None) or {}).values()]
+    for l in layers:
+        # static check too: a freshly-cleared stream has no h/c in state
+        # yet, but the layer WILL carry it as soon as it streams
+        if getattr(l, "carries_recurrent_state", False):
+            raise ValueError(
+                "rewind_stream_state: recurrent h/c streaming state "
+                "cannot be rewound (LSTM layers do not support "
+                "speculative rollback)")
+        w = getattr(l, "window", None)
+        if w and getattr(l, "supports_streaming", False):
+            L = getattr(l, "cache_length", 0)
+            if L < w + n:
+                raise ValueError(
+                    f"rewinding {n} positions on a rolling cache needs "
+                    f"cache_length >= window + n ({L} < {w + n}) — the "
+                    "rejected writes evicted still-in-window slots")
+
+
 #: (mesh, axis) sharding the streaming KV caches over their slot axis, or
 #: None (single-device caches). Module-level like use_cnn_data_format —
 #: set through MultiLayerNetwork/ComputationGraph.set_stream_cache_sharding,
@@ -1264,6 +1343,9 @@ class LSTM(FeedForwardLayerConf):
     activation: str = "tanh"
 
     _peephole = False
+    #: streams via irreversible h/c carry — cannot rewind (speculative
+    #: decoding rollback); see check_rewindable
+    carries_recurrent_state = True
 
     def output_type(self, it):
         return InputType.recurrent(self.n_out, it.timesteps)

@@ -29,18 +29,16 @@ def _probs(out) -> np.ndarray:
     return np.asarray(out[0] if isinstance(out, (list, tuple)) else out)
 
 
-def draw(probs, temperature: float, rng: np.random.Generator,
-         top_k: Optional[int] = None,
-         top_p: Optional[float] = None) -> int:
-    """Sample one token id from a softmax distribution (the single draw
-    implementation shared by every sampler).
-
-    Order of operations matches the common serving convention:
-    temperature rescales the distribution first, then `top_k` keeps the
-    k most probable tokens, then `top_p` (nucleus) keeps the smallest
-    prefix of the sorted distribution whose mass reaches p (always at
-    least one token), and the survivors renormalize. top_k=1 is greedy
-    decoding regardless of temperature."""
+def filter_probs(probs, temperature: float,
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None) -> np.ndarray:
+    """The sampling distribution actually drawn from: temperature
+    rescales first, then `top_k` keeps exactly the k most probable
+    tokens, then `top_p` (nucleus) keeps the smallest prefix of the
+    sorted distribution whose mass reaches p (always at least one
+    token); survivors renormalize. Shared by draw() and the
+    speculative-decoding acceptance rule (which needs the filtered
+    distributions themselves, not just a sample)."""
     logits = np.log(np.clip(probs, 1e-9, None)) / temperature
     p = np.exp(logits - logits.max())
     p /= p.sum()
@@ -67,6 +65,17 @@ def draw(probs, temperature: float, rng: np.random.Generator,
         keep[order[:cut]] = True
         p = np.where(keep, p, 0.0)
         p /= p.sum()
+    return p
+
+
+def draw(probs, temperature: float, rng: np.random.Generator,
+         top_k: Optional[int] = None,
+         top_p: Optional[float] = None) -> int:
+    """Sample one token id from a softmax distribution (the single draw
+    implementation shared by every sampler); see filter_probs for the
+    temperature/top_k/top_p semantics. top_k=1 is greedy decoding
+    regardless of temperature."""
+    p = filter_probs(probs, temperature, top_k, top_p)
     return int(rng.choice(len(p), p=p))
 
 
@@ -226,6 +235,190 @@ def sample_stream(net, seed_ids, steps: int, vocab_size: int,
             out = net.rnn_time_step(_one_hot(np.asarray([[nxt]]),
                                              vocab_size))
     return ids
+
+
+def prompt_lookup_proposer(ngram: int = 3):
+    """Draft-FREE speculation proposer (prompt-lookup decoding): propose
+    the continuation of the most recent earlier occurrence of the
+    context's trailing n-gram. Costs zero device dispatches, so it wins
+    even on dispatch-latency-bound serving paths whenever generation
+    revisits earlier text (extraction, quoting, code, repetition);
+    elsewhere it degrades gracefully to ~plain decoding. Pass the
+    returned callable as speculative_sample's `draft`."""
+    if ngram < 1:
+        raise ValueError(f"ngram must be >= 1, got {ngram}")
+
+    def propose(ids, gamma):
+        if len(ids) <= ngram:
+            return []
+        tail = list(ids[-ngram:])
+        for s in range(len(ids) - ngram - 1, -1, -1):
+            if list(ids[s:s + ngram]) == tail:
+                return list(ids[s + ngram:s + ngram + gamma])
+        return []
+
+    return propose
+
+
+def speculative_sample(net, draft, seed_ids, steps: int,
+                       vocab_size: int,
+                       gamma: int = 4,
+                       temperature: float = 1.0,
+                       rng: Optional[np.random.Generator] = None,
+                       max_length: Optional[int] = None,
+                       top_k: Optional[int] = None,
+                       top_p: Optional[float] = None,
+                       prime_padded: bool = False,
+                       prime_chunk_max: Optional[int] = None) -> List[int]:
+    """Speculative decoding (Leviathan et al. 2023 rejection scheme):
+    `draft` proposes up to `gamma` tokens, the target `net` scores ALL
+    of them in ONE forward, and the longest accepted prefix is kept —
+    the target's sampling DISTRIBUTION is exactly preserved (with
+    top_k=1 the output is bit-identical to greedy sample_stream,
+    test-pinned), while the target runs once per ~(accepted+1) tokens
+    instead of once per token.
+
+    `draft` is either a same-vocab streaming net (model-based drafting —
+    wins when the target's forward is much more expensive than the
+    draft's, i.e. compute-bound serving) or a host callable
+    `(ids, gamma) -> proposals` such as prompt_lookup_proposer()
+    (draft-free — zero extra dispatches, wins whenever proposals are
+    often right, even on dispatch-latency-bound paths; a deterministic
+    proposer is a one-hot draft distribution under the rejection rule).
+
+    Rollback of rejected positions uses rewind_stream_state, so the
+    nets involved must carry only position-indexed streaming state
+    (attention KV caches + positional offsets — LSTMs are rejected
+    there). Acceptance compares the temperature/top_k/top_p-FILTERED
+    distributions (standard practice, so the filters stay
+    meaningful)."""
+    from deeplearning4j_tpu.nn.conf.layers import (check_rewindable,
+                                                   rewind_stream_state)
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    _check_seed(seed_ids, steps, max_length)
+    rng = rng or np.random.default_rng(0)
+    V = vocab_size
+    ids = list(seed_ids)
+    draft_is_fn = not hasattr(draft, "rnn_time_step")
+    if draft_is_fn and not callable(draft):
+        raise TypeError("draft must be a streaming net or a callable "
+                        "(ids, gamma) -> proposals")
+    # fail fast: a non-rewindable net would otherwise only error at the
+    # first data-dependent rejection, mid-generation
+    check_rewindable(net, gamma)
+    if not draft_is_fn:
+        check_rewindable(draft, gamma)
+    net.rnn_clear_previous_state()
+    prime = _prime_padded if prime_padded else _prime
+    out_t = prime(net, ids, V, prime_chunk_max)
+    # p_next: target's (filtered) distribution for the NEXT token given
+    # everything its cache has consumed so far
+    p_next = filter_probs(_probs(out_t)[0, :, -1], temperature,
+                          top_k, top_p)
+    if not draft_is_fn:
+        draft.rnn_clear_previous_state()
+        out_d = prime(draft, ids, V, prime_chunk_max)
+        q_next = filter_probs(_probs(out_d)[0, :, -1], temperature,
+                              top_k, top_p)
+    want = len(seed_ids) + steps
+    if max_length is not None:
+        want = min(want, max_length)
+    # the committed-but-not-yet-consumed LAST token of `ids` rides at
+    # the FRONT of the next verify chunk instead of costing its own
+    # dispatch: every round is exactly ONE target forward, so even at
+    # zero acceptance the dispatch count never exceeds plain decoding's
+    pending = None
+    while len(ids) < want:
+        g = min(gamma, want - len(ids))
+        # --- draft proposes up to g tokens + its distributions --------
+        if draft_is_fn:
+            proposals = [int(t) for t in draft(ids, g)][:g]
+            g = len(proposals)
+            # deterministic proposer == one-hot draft distribution
+            q_dists = []
+            for d in proposals:
+                one = np.zeros(V)
+                one[d] = 1.0
+                q_dists.append(one)
+        else:
+            proposals, q_dists = [], []
+            if pending is not None:
+                out_d = draft.rnn_time_step(
+                    _one_hot(np.asarray([[pending]]), V))
+                q_next = filter_probs(_probs(out_d)[0, :, -1],
+                                      temperature, top_k, top_p)
+            q = q_next
+            for _ in range(g):
+                d = int(rng.choice(V, p=q))
+                proposals.append(d)
+                q_dists.append(q)
+                out_d = draft.rnn_time_step(
+                    _one_hot(np.asarray([[d]]), V))
+                q = filter_probs(_probs(out_d)[0, :, -1], temperature,
+                                 top_k, top_p)
+        # --- target scores pending + all proposals in ONE forward -----
+        chunk = ([] if pending is None else [pending]) + proposals
+        if not chunk:                 # g == 0 and nothing pending
+            nxt = int(rng.choice(V, p=p_next))
+            ids.append(nxt)
+            pending = nxt
+            # p_next for the round after this comes from the verify
+            # forward that consumes `pending` next round
+            p_next = None
+            continue
+        out_t = net.rnn_time_step(
+            _one_hot(np.asarray(chunk)[None, :], V))
+        tp = _probs(out_t)[0]                      # [V, len(chunk)]
+        off = len(chunk) - g                       # 1 when pending rode
+        if pending is not None:
+            # pending is already IN ids (committed last round); the
+            # forward above just consumed it into the caches
+            pending = None
+            p_next = filter_probs(tp[:, off - 1], temperature,
+                                  top_k, top_p)
+        if g == 0:                    # plain step: sample from p_next
+            nxt = int(rng.choice(V, p=p_next))
+            ids.append(nxt)
+            pending = nxt
+            p_next = None
+            continue
+        p_dists = [p_next] + [
+            filter_probs(tp[:, off + i], temperature, top_k, top_p)
+            for i in range(g - 1)]
+        p_bonus = filter_probs(tp[:, off + g - 1], temperature,
+                               top_k, top_p)
+        # --- standard acceptance walk ---------------------------------
+        accepted = 0
+        replacement = None
+        for i, d in enumerate(proposals):
+            p_i, q_i = p_dists[i], q_dists[i]
+            if rng.random() < min(1.0, float(p_i[d]) /
+                                  max(float(q_i[d]), 1e-12)):
+                accepted += 1
+            else:
+                resid = np.maximum(p_i - q_i, 0.0)
+                total = resid.sum()
+                if total <= 0:        # p subsumed by q: fall back to p_i
+                    resid, total = p_i, p_i.sum()
+                replacement = int(rng.choice(V, p=resid / total))
+                break
+        ids.extend(proposals[:accepted])
+        if replacement is None:
+            # every proposal accepted: bonus token from the target's
+            # distribution one past the proposals
+            nxt = int(rng.choice(V, p=p_bonus))
+        else:
+            nxt = replacement
+        ids.append(nxt)
+        pending = nxt
+        p_next = None
+        # --- rollback rejected positions (pending rides the next
+        # round's verify forward instead of a commit dispatch) ---------
+        rewind_stream_state(net, g - accepted)
+        if not draft_is_fn:
+            rewind_stream_state(draft, g - accepted)
+    return ids[:want]
 
 
 def beam_search(net, seed_ids, steps: int, vocab_size: int,

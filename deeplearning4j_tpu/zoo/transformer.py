@@ -147,6 +147,27 @@ class TextGenerationTransformer(ZooModel):
                              prime_padded=prime_padded,
                              top_k=top_k, top_p=top_p)
 
+    def speculative_sample(self, net, draft, seed_ids, steps: int,
+                           gamma: int = 4, vocab_size: int = None,
+                           rng: np.random.Generator = None,
+                           temperature: float = 1.0,
+                           top_k: int = None, top_p: float = None,
+                           prime_padded: bool = False):
+        """Speculative decoding: `draft` proposes `gamma` tokens, this
+        model verifies them in ONE forward (shared implementation
+        util/decoding.speculative_sample — the target distribution is
+        exactly preserved; top_k=1 reproduces greedy decoding
+        bit-for-bit). `draft` is a same-vocab streaming net (typically a
+        smaller/quantized TextGenerationTransformer) or a host proposer
+        callable such as decoding.prompt_lookup_proposer()."""
+        from deeplearning4j_tpu.util.decoding import speculative_sample
+        return speculative_sample(net, draft, seed_ids, steps,
+                                  vocab_size or self.vocab_size,
+                                  gamma=gamma, temperature=temperature,
+                                  rng=rng, max_length=self.max_length,
+                                  top_k=top_k, top_p=top_p,
+                                  prime_padded=prime_padded)
+
     def beam_search(self, net, seed_ids, steps: int, beam_width: int = 4,
                     vocab_size: int = None, prime_padded: bool = False):
         """Beam-search decoding on the streaming KV-cache machinery

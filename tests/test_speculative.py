@@ -1,0 +1,227 @@
+"""Speculative decoding (util/decoding.speculative_sample) and the
+stream-state rewind primitive it builds on."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.layers import rewind_stream_state
+from deeplearning4j_tpu.util import decoding
+from deeplearning4j_tpu.zoo import TextGenerationLSTM, TextGenerationTransformer
+
+RNG = np.random.default_rng(0)
+
+
+def _tfm(layers=1, embed=16, seed=12345, window=None, cache=32):
+    return TextGenerationTransformer(vocab_size=12, embed_dim=embed,
+                                     n_heads=2, n_layers=layers,
+                                     max_length=cache, window=window,
+                                     seed=seed)
+
+
+def _one_hot(seq, vocab=12):
+    h = np.zeros((1, vocab, len(seq)), np.float32)
+    h[0, list(seq), np.arange(len(seq))] = 1.0
+    return h
+
+
+class TestRewind:
+    def test_rewind_equals_never_fed(self):
+        """Feed 3 tokens, rewind 2, re-feed different ones: outputs equal
+        a stream that never saw the rejected tokens."""
+        model = _tfm()
+        a, b = model.init(), model.init()
+        a.rnn_time_step(_one_hot([1, 2, 3]))
+        out_a = np.asarray(a.rnn_time_step(_one_hot([4, 5, 6])))
+        rewind_stream_state(a, 2)
+        got = np.asarray(a.rnn_time_step(_one_hot([7, 8])))
+
+        b.rnn_time_step(_one_hot([1, 2, 3]))
+        b.rnn_time_step(_one_hot([4]))
+        want = np.asarray(b.rnn_time_step(_one_hot([7, 8])))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_rewind_rolling_window(self):
+        model = _tfm(window=4, cache=16)
+        a, b = model.init(), model.init()
+        a.rnn_time_step(_one_hot([1, 2, 3, 4, 5]))
+        a.rnn_time_step(_one_hot([6, 7, 8]))
+        rewind_stream_state(a, 3)
+        got = np.asarray(a.rnn_time_step(_one_hot([9, 10])))
+        b.rnn_time_step(_one_hot([1, 2, 3, 4, 5]))
+        want = np.asarray(b.rnn_time_step(_one_hot([9, 10])))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_rewind_rolling_needs_headroom(self):
+        model = _tfm(window=4, cache=5)
+        net = model.init()
+        net.rnn_time_step(_one_hot([1, 2, 3]))
+        with pytest.raises(ValueError, match="cache_length >= window"):
+            rewind_stream_state(net, 2)
+
+    def test_lstm_state_rejected(self):
+        model = TextGenerationLSTM(vocab_size=10, hidden=8, layers=1,
+                                   max_length=20)
+        net = model.init()
+        net.rnn_time_step(_one_hot([1, 2], 10))
+        with pytest.raises(ValueError, match="h/c"):
+            rewind_stream_state(net, 1)
+
+    def test_budget_counter_rewinds(self):
+        model = _tfm(cache=8)
+        net = model.init()
+        net.rnn_time_step(_one_hot([1, 2, 3, 4, 5, 6]))
+        rewind_stream_state(net, 4)
+        # 2 + 6 would exceed the 8 capacity without the rewind
+        net.rnn_time_step(_one_hot([1, 2, 3, 4, 5, 6]))
+
+
+class TestSpeculativeSample:
+    def test_greedy_identical_to_regular(self):
+        """top_k=1: speculative output is bit-identical to plain greedy
+        decoding, for an UNRELATED draft model and any gamma."""
+        target = _tfm(layers=2, embed=32, seed=1)
+        draft = _tfm(layers=1, embed=16, seed=999)   # different model
+        tnet, dnet = target.init(), draft.init()
+        want = target.sample_stream(tnet, [1, 2, 3], steps=9, top_k=1,
+                                    rng=np.random.default_rng(0))
+        for gamma in (1, 3, 5):
+            got = decoding.speculative_sample(
+                tnet, dnet, [1, 2, 3], steps=9, vocab_size=12,
+                gamma=gamma, top_k=1, rng=np.random.default_rng(0))
+            assert got == want, f"gamma={gamma}"
+
+    def test_draft_equals_target_always_accepts(self):
+        """Identical draft == always-accept: gamma+1 tokens per target
+        dispatch (count the verify forwards)."""
+        target = _tfm(layers=1, embed=16, seed=7, cache=64)
+        tnet, dnet = target.init(), target.init()
+        calls = {"n": 0}
+        orig = type(tnet).rnn_time_step
+
+        def counting(self, *a, **k):
+            if self is tnet:
+                calls["n"] += 1
+            return orig(self, *a, **k)
+
+        type(tnet).rnn_time_step = counting
+        try:
+            out = decoding.speculative_sample(
+                tnet, dnet, [1, 2, 3], steps=12, vocab_size=12,
+                gamma=3, top_k=1, rng=np.random.default_rng(1))
+        finally:
+            type(tnet).rnn_time_step = orig
+        assert len(out) == 15
+        # identical models + greedy => every proposal accepted: 12 new
+        # tokens in 3 rounds of gamma+1, the committed token riding each
+        # next verify => 2 prime chunks (3 = 2+1) + 3 verify forwards.
+        # Plain decode would need 2 + 12 = 14 target calls.
+        assert calls["n"] == 5, calls["n"]
+
+    def test_sampled_mode_runs_and_is_deterministic(self):
+        target = _tfm(layers=1, embed=32, seed=3)
+        draft = _tfm(layers=1, embed=16, seed=4)
+        tnet, dnet = target.init(), draft.init()
+        a = decoding.speculative_sample(tnet, dnet, [1, 2], steps=8,
+                                        vocab_size=12, gamma=4,
+                                        temperature=0.8,
+                                        rng=np.random.default_rng(5))
+        b = decoding.speculative_sample(tnet, dnet, [1, 2], steps=8,
+                                        vocab_size=12, gamma=4,
+                                        temperature=0.8,
+                                        rng=np.random.default_rng(5))
+        assert a == b
+        assert len(a) == 10 and all(0 <= t < 12 for t in a)
+
+    def test_zoo_wrapper(self):
+        target = _tfm(layers=1, embed=32, seed=3)
+        draft = _tfm(layers=1, embed=16, seed=4)
+        tnet, dnet = target.init(), draft.init()
+        out = target.speculative_sample(tnet, dnet, [1, 2, 3], steps=6,
+                                        gamma=2, top_k=1)
+        want = target.sample_stream(tnet, [1, 2, 3], steps=6, top_k=1)
+        assert out == want
+
+    def test_respects_max_length(self):
+        target = _tfm(cache=8)
+        draft = _tfm(seed=9, cache=8)
+        tnet, dnet = target.init(), draft.init()
+        out = decoding.speculative_sample(tnet, dnet, [1, 2, 3], steps=50,
+                                          vocab_size=12, gamma=4,
+                                          max_length=8, top_k=1,
+                                          rng=np.random.default_rng(2))
+        assert len(out) == 8
+
+    def test_gamma_validated(self):
+        target = _tfm()
+        tnet = target.init()
+        with pytest.raises(ValueError, match="gamma"):
+            decoding.speculative_sample(tnet, tnet, [1], steps=2,
+                                        vocab_size=12, gamma=0)
+
+    def test_lstm_target_fails_fast(self):
+        """A non-rewindable target errors at ENTRY, before any forward
+        (not mid-generation at the first rejection)."""
+        lstm = TextGenerationLSTM(vocab_size=10, hidden=8, layers=1,
+                                  max_length=20)
+        lnet = lstm.init()
+        with pytest.raises(ValueError, match="h/c"):
+            decoding.speculative_sample(
+                lnet, decoding.prompt_lookup_proposer(), [1, 2], steps=4,
+                vocab_size=10)
+
+    def test_rolling_without_headroom_fails_fast(self):
+        target = _tfm(window=4, cache=5)
+        tnet = target.init()
+        with pytest.raises(ValueError, match="cache_length >= window"):
+            decoding.speculative_sample(
+                tnet, decoding.prompt_lookup_proposer(), [1, 2], steps=4,
+                vocab_size=12, gamma=4)
+
+
+class TestPromptLookup:
+    def test_proposer_finds_continuation(self):
+        propose = decoding.prompt_lookup_proposer(ngram=2)
+        ids = [5, 6, 7, 8, 9, 5, 6]
+        assert propose(ids, 3) == [7, 8, 9]     # continues the 5,6 match
+        assert propose(ids, 1) == [7]
+        assert propose([1, 2, 3], 4) == []      # no earlier match
+        assert propose([1], 4) == []            # too short
+
+    def test_proposer_prefers_most_recent_match(self):
+        propose = decoding.prompt_lookup_proposer(ngram=2)
+        ids = [1, 2, 3, 1, 2, 4, 1, 2]
+        assert propose(ids, 2) == [4, 1]        # latest (1,2) -> 4
+
+    def test_greedy_identical_with_prompt_lookup_draft(self):
+        """Draft-free speculation preserves greedy decoding exactly, on
+        a repetitive prompt where proposals actually fire."""
+        target = _tfm(layers=2, embed=32, seed=1, cache=64)
+        tnet = target.init()
+        prompt = [1, 2, 3, 4, 1, 2, 3, 4, 1, 2]
+        want = target.sample_stream(tnet, prompt, steps=10, top_k=1,
+                                    rng=np.random.default_rng(0))
+        got = decoding.speculative_sample(
+            tnet, decoding.prompt_lookup_proposer(ngram=2), prompt,
+            steps=10, vocab_size=12, gamma=4, top_k=1,
+            rng=np.random.default_rng(0))
+        assert got == want
+
+    def test_empty_proposals_degrade_to_plain_decoding(self):
+        """A prompt with no repeats: every round falls back to a plain
+        single-token step; output still matches greedy decoding."""
+        target = _tfm(layers=1, embed=16, seed=2, cache=64)
+        tnet = target.init()
+        propose_nothing = lambda ids, gamma: []
+        want = target.sample_stream(tnet, [1, 2, 3], steps=6, top_k=1,
+                                    rng=np.random.default_rng(0))
+        got = decoding.speculative_sample(
+            tnet, propose_nothing, [1, 2, 3], steps=6, vocab_size=12,
+            gamma=4, top_k=1, rng=np.random.default_rng(0))
+        assert got == want
+
+    def test_bad_draft_rejected(self):
+        target = _tfm()
+        tnet = target.init()
+        with pytest.raises(TypeError, match="draft"):
+            decoding.speculative_sample(tnet, object(), [1, 2], steps=2,
+                                        vocab_size=12)
