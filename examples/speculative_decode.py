@@ -1,0 +1,89 @@
+"""Speculative decoding: fewer target forwards for the same output.
+
+Trains a small character LM to (near-)memorization on repetitive text,
+then decodes greedily two ways and counts TARGET dispatches:
+- plain sample_stream: one forward per token;
+- prompt-lookup speculation (draft-free): proposals come from the
+  context's own repetition, verified gamma at a time — one forward per
+  round, each committing acceptance+1 tokens.
+
+Both outputs are IDENTICAL (greedy + exact verification). A smaller
+MODEL can draft instead (`speculative_sample(net, draft_net, ...)`) —
+that variant pays gamma draft forwards per round, so it wins only when
+the target's forward is much more expensive than the draft's
+(compute-bound serving; see PERF.md).
+
+Run: python examples/speculative_decode.py
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.updater import Adam
+from deeplearning4j_tpu.util.decoding import prompt_lookup_proposer
+from deeplearning4j_tpu.zoo import TextGenerationTransformer
+
+DEMO_TEXT = ("the quick brown fox jumps over the lazy dog. " * 60)
+
+
+def main(train_steps: int = 250, decode_steps: int = 60, gamma: int = 6):
+    chars = sorted(set(DEMO_TEXT))
+    stoi = {c: i for i, c in enumerate(chars)}
+    ids_all = np.asarray([stoi[c] for c in DEMO_TEXT], np.int32)
+    V, T, B = len(chars), 48, 16
+
+    model = TextGenerationTransformer(
+        vocab_size=V, embed_dim=64, n_heads=4, n_layers=2,
+        max_length=256, updater=Adam(3e-3))
+    net = model.init()
+    rng = np.random.default_rng(0)
+    for _ in range(train_steps):
+        starts = rng.integers(0, len(ids_all) - T - 1, B)
+        x = np.zeros((B, V, T), np.float32)
+        y = np.zeros((B, V, T), np.float32)
+        for b, s in enumerate(starts):
+            x[b, ids_all[s:s + T], np.arange(T)] = 1.0
+            y[b, ids_all[s + 1:s + T + 1], np.arange(T)] = 1.0
+        net.fit(DataSet(x, y))
+
+    prompt = [stoi[c] for c in "the quick brown fox jumps over the l"]
+
+    calls = {"n": 0}
+    orig = type(net).rnn_time_step
+
+    def counting(self, *a, **k):
+        if self is net:
+            calls["n"] += 1
+        return orig(self, *a, **k)
+
+    type(net).rnn_time_step = counting
+    try:
+        calls["n"] = 0
+        plain = model.sample_stream(net, prompt, steps=decode_steps,
+                                    top_k=1)
+        plain_calls = calls["n"]
+
+        calls["n"] = 0
+        pld = model.speculative_sample(net, prompt_lookup_proposer(3),
+                                       prompt, steps=decode_steps,
+                                       gamma=gamma, top_k=1,
+                                       rng=np.random.default_rng(1))
+        pld_calls = calls["n"]
+    finally:
+        type(net).rnn_time_step = orig
+
+    text = "".join(chars[i] for i in pld[len(prompt):])
+    print(f"continuation: {text!r}")
+    print(f"plain greedy  : {plain_calls} target forwards "
+          f"for {decode_steps} tokens")
+    print(f"prompt-lookup : {pld_calls} target forwards "
+          f"({plain_calls / pld_calls:.1f}x fewer)")
+    print("identical output:", plain == pld)
+    return {"plain_calls": plain_calls, "pld_calls": pld_calls,
+            "identical": plain == pld}
+
+
+if __name__ == "__main__":
+    main()

@@ -76,3 +76,11 @@ def test_quantized_serving():
     assert res["ratio"] > 3.0          # int8 weights ~4x smaller
     assert res["refused"]              # training blocked post-quantize
     assert len(res["q"]) == len(res["fp"])
+
+
+def test_speculative_decode():
+    res = _run("speculative_decode", train_steps=60, decode_steps=30)
+    assert res["identical"]            # exact greedy preservation
+    # worst case (zero acceptance) costs plain + 1 forwards; any
+    # acceptance pulls below plain
+    assert res["pld_calls"] <= res["plain_calls"] + 1
