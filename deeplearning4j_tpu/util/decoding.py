@@ -260,6 +260,95 @@ def prompt_lookup_proposer(ngram: int = 3):
     return propose
 
 
+def sample_stream_batch(net, prompts, steps: int, vocab_size: int,
+                        temperature: float = 1.0,
+                        rng: Optional[np.random.Generator] = None,
+                        max_length: Optional[int] = None,
+                        top_k: Optional[int] = None,
+                        top_p: Optional[float] = None) -> List[List[int]]:
+    """Decode a BATCH of prompts simultaneously: mixed-length prompts
+    LEFT-pad to the longest and prime in one masked forward (the carried
+    kv_mask keeps pad keys invisible on every later step), then every
+    decode step advances ALL rows in one dispatch — B times the serving
+    throughput of per-prompt sample_stream for the same dispatch count.
+    Shapes are bucketed like the rest of this module: the priming length
+    pads to its power-of-two bucket (extra columns are fully masked) and
+    the batch pads to a power-of-two row count, so serving reuses warm
+    compiled shapes across request mixes.
+
+    Per-row results match per-prompt sample_stream for greedy decoding
+    (top_k=1 — test-pinned) for recurrences (masked pad steps pass h/c
+    through) and attention with rope or no positions (a contiguous
+    left-pad shifts a row's absolute positions uniformly; rope scores
+    depend only on relative offsets). Under temperature SAMPLING the
+    per-row distributions are the same but the shared rng interleaves
+    draws across rows, so sequences differ from a per-prompt run with
+    the same seed. Models with LEARNED positional tables need
+    equal-length prompts (pads would shift the table lookups) —
+    enforced here.
+
+    The batch shares stream positions: every row consumes the padded
+    prompt length plus one position per step, so rows stop early (with
+    fewer than `steps` tokens) when the net's smallest streaming
+    capacity fills — per-prompt decoding of a SHORT prompt can go
+    further. Returns one continued token list per prompt."""
+    if not prompts:
+        return []
+    rng = rng or np.random.default_rng(0)
+    for p in prompts:
+        _check_seed(p, steps, max_length)
+    lens = [len(p) for p in prompts]
+    from deeplearning4j_tpu.nn.conf.layers import PositionalEmbeddingLayer
+    has_learned_pos = any(isinstance(l, PositionalEmbeddingLayer)
+                          for l in _stream_layers(net))
+    if len(set(lens)) > 1 and has_learned_pos:
+        raise ValueError(
+            "mixed-length batched decoding is not exact for "
+            "learned positional tables (left-pads shift the "
+            "lookups) — pad prompts to equal length, use a rope "
+            "model, or decode per prompt")
+    cap = _prime_bucket_cap(net)
+    if has_learned_pos:
+        T = max(lens)      # ANY left pad would shift the table lookups
+    else:
+        T = _width_bucket(max(lens))             # bucketed prime length
+        if cap is not None and T > cap >= max(lens):
+            T = cap
+    B, V = len(prompts), vocab_size
+    Bb = _width_bucket(B)                        # bucketed batch rows
+    x = np.zeros((Bb, V, T), np.float32)
+    mask = np.zeros((Bb, T), np.float32)
+    for b, p in enumerate(prompts):
+        pad = T - len(p)
+        x[b, list(p), pad + np.arange(len(p))] = 1.0
+        mask[b, pad:] = 1.0
+    net.rnn_clear_previous_state()
+    if hasattr(net, "layers"):                   # MultiLayerNetwork
+        out = net.rnn_time_step(x, mask=mask)
+    else:                                        # ComputationGraph
+        out = net.rnn_time_step(
+            x, masks={net.conf.network_inputs[0]: mask})
+    ids = [list(p) for p in prompts]
+    done_cap = (lambda b: max_length is not None
+                and len(ids[b]) >= max_length)
+    for i in range(steps):
+        probs = _probs(out)[:, :, -1]                       # [Bb, V]
+        tok = np.zeros(Bb, np.int64)
+        for b in range(B):
+            if done_cap(b):
+                continue
+            tok[b] = draw(probs[b], temperature, rng,
+                          top_k=top_k, top_p=top_p)
+            ids[b].append(int(tok[b]))
+        if all(done_cap(b) for b in range(B)):
+            break
+        if i + 1 < steps:
+            if cap is not None and T + i + 1 > cap:
+                break                  # shared stream positions full
+            out = net.rnn_time_step(_one_hot(tok[:, None], V))
+    return ids
+
+
 def speculative_sample(net, draft, seed_ids, steps: int,
                        vocab_size: int,
                        gamma: int = 4,

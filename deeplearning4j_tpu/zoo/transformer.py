@@ -147,6 +147,23 @@ class TextGenerationTransformer(ZooModel):
                              prime_padded=prime_padded,
                              top_k=top_k, top_p=top_p)
 
+    def sample_stream_batch(self, net, prompts, steps: int,
+                            vocab_size: int = None,
+                            rng: np.random.Generator = None,
+                            temperature: float = 1.0,
+                            top_k: int = None, top_p: float = None):
+        """Decode a batch of prompts in lockstep — one dispatch advances
+        every row (shared implementation
+        util/decoding.sample_stream_batch). Mixed lengths left-pad and
+        need rope positions (positional='rope'); learned-positional
+        models require equal-length prompts."""
+        from deeplearning4j_tpu.util.decoding import sample_stream_batch
+        return sample_stream_batch(net, prompts, steps,
+                                   vocab_size or self.vocab_size,
+                                   temperature=temperature, rng=rng,
+                                   max_length=self.max_length,
+                                   top_k=top_k, top_p=top_p)
+
     def speculative_sample(self, net, draft, seed_ids, steps: int,
                            gamma: int = 4, vocab_size: int = None,
                            rng: np.random.Generator = None,
