@@ -50,6 +50,20 @@ class TextGenerationLSTM(ZooModel):
                              max_length=None, prime_padded=prime_padded,
                              top_k=top_k, top_p=top_p)
 
+    def sample_stream_batch(self, net, prompts, steps: int,
+                            vocab_size: int = None, rng=None,
+                            temperature: float = 1.0,
+                            top_k: int = None, top_p: float = None):
+        """Decode a batch of prompts in lockstep (shared implementation
+        util/decoding.sample_stream_batch) — mixed lengths are exact for
+        LSTMs: masked left-pad steps pass h/c through unchanged."""
+        from deeplearning4j_tpu.util.decoding import sample_stream_batch
+        return sample_stream_batch(net, prompts, steps,
+                                   vocab_size or self.vocab_size,
+                                   temperature=temperature, rng=rng,
+                                   max_length=None,
+                                   top_k=top_k, top_p=top_p)
+
     def beam_search(self, net, seed_ids, steps: int, beam_width: int = 4,
                     vocab_size: int = None, prime_padded: bool = False):
         """Beam-search decoding over the stored-state rnnTimeStep path
