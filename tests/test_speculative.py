@@ -219,6 +219,22 @@ class TestPromptLookup:
             gamma=4, top_k=1, rng=np.random.default_rng(0))
         assert got == want
 
+    def test_quantized_draft_composes(self):
+        """The serving features compose: an int8-quantized draft model
+        proposes, the fp target verifies — greedy output still exactly
+        matches plain decoding."""
+        from deeplearning4j_tpu.optimize import quantize_for_inference
+        target = _tfm(layers=2, embed=32, seed=1)
+        draft = _tfm(layers=1, embed=16, seed=99)
+        tnet = target.init()
+        dnet = quantize_for_inference(draft.init(), min_size=256)
+        want = target.sample_stream(tnet, [1, 2, 3], steps=8, top_k=1)
+        got = decoding.speculative_sample(tnet, dnet, [1, 2, 3], steps=8,
+                                          vocab_size=12, gamma=3,
+                                          top_k=1,
+                                          rng=np.random.default_rng(0))
+        assert got == want
+
     def test_bad_draft_rejected(self):
         target = _tfm()
         tnet = target.init()
