@@ -203,6 +203,20 @@ class TestQuantizedNetworks:
                   if isinstance(s, dict) and "kv_k" in s]
         assert caches and all(c.dtype == jnp.bfloat16 for c in caches)
 
+    def test_parallel_inference_serves_quantized(self):
+        """The serving wrapper composes with quantization: a quantized
+        net behind ParallelInference returns outputs close to fp."""
+        from deeplearning4j_tpu.parallel.inference import ParallelInference
+        net = _mlp()
+        x = np.random.default_rng(21).standard_normal(
+            (4, 64)).astype(np.float32)
+        ref = np.asarray(net.output(x))
+        quantize_for_inference(net)
+        pi = ParallelInference(net, inference_mode="sequential")
+        got = np.asarray(pi.output(x))
+        assert np.abs(got - ref).max() < 0.03
+        assert (got.argmax(1) == ref.argmax(1)).all()
+
     def test_evaluate_works_quantized(self):
         net = _mlp()
         x = RNG.standard_normal((16, 64)).astype(np.float32)
