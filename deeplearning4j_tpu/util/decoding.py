@@ -208,7 +208,8 @@ def sample_stream(net, seed_ids, steps: int, vocab_size: int,
                   prime_chunk_max: Optional[int] = None,
                   prime_padded: bool = False,
                   top_k: Optional[int] = None,
-                  top_p: Optional[float] = None) -> List[int]:
+                  top_p: Optional[float] = None,
+                  stop_tokens=()) -> List[int]:
     """Temperature sampling with KV-cache / stored-state incremental
     decoding: prime once with the seed, then one single-position forward
     per generated token (the reference's rnnTimeStep generation loop;
@@ -216,9 +217,12 @@ def sample_stream(net, seed_ids, steps: int, vocab_size: int,
     `prime_chunk_max` overrides the process default (set_prime_chunk_max)
     for this call only; `prime_padded=True` instead primes the whole
     prompt in ONE left-padded dispatch (see _prime_padded). `top_k` /
-    `top_p` filter each draw (see `draw`; top_k=1 is greedy)."""
+    `top_p` filter each draw (see `draw`; top_k=1 is greedy).
+    Generation ends early when a `stop_tokens` member is drawn (the stop
+    token is kept as the final id — EOS semantics)."""
     _check_seed(seed_ids, steps, max_length)
     rng = rng or np.random.default_rng(0)
+    stop_tokens = set(stop_tokens)
     ids = list(seed_ids)
     net.rnn_clear_previous_state()
     out = (_prime_padded(net, ids, vocab_size, prime_chunk_max)
@@ -230,6 +234,8 @@ def sample_stream(net, seed_ids, steps: int, vocab_size: int,
         nxt = draw(_probs(out)[0, :, -1], temperature, rng,
                    top_k=top_k, top_p=top_p)
         ids.append(nxt)
+        if nxt in stop_tokens:
+            break
         if i + 1 < steps and (max_length is None
                               or len(ids) < max_length):
             out = net.rnn_time_step(_one_hot(np.asarray([[nxt]]),
@@ -265,7 +271,8 @@ def sample_stream_batch(net, prompts, steps: int, vocab_size: int,
                         rng: Optional[np.random.Generator] = None,
                         max_length: Optional[int] = None,
                         top_k: Optional[int] = None,
-                        top_p: Optional[float] = None) -> List[List[int]]:
+                        top_p: Optional[float] = None,
+                        stop_tokens=()) -> List[List[int]]:
     """Decode a BATCH of prompts simultaneously: mixed-length prompts
     LEFT-pad to the longest and prime in one masked forward (the carried
     kv_mask keeps pad keys invisible on every later step), then every
@@ -291,10 +298,13 @@ def sample_stream_batch(net, prompts, steps: int, vocab_size: int,
     prompt length plus one position per step, so rows stop early (with
     fewer than `steps` tokens) when the net's smallest streaming
     capacity fills — per-prompt decoding of a SHORT prompt can go
-    further. Returns one continued token list per prompt."""
+    further. A row also ends when it draws a `stop_tokens` member (kept
+    as its final id — EOS semantics); other rows continue. Returns one
+    continued token list per prompt."""
     if not prompts:
         return []
     rng = rng or np.random.default_rng(0)
+    stop_tokens = set(stop_tokens)
     for p in prompts:
         _check_seed(p, steps, max_length)
     lens = [len(p) for p in prompts]
@@ -329,18 +339,21 @@ def sample_stream_batch(net, prompts, steps: int, vocab_size: int,
         out = net.rnn_time_step(
             x, masks={net.conf.network_inputs[0]: mask})
     ids = [list(p) for p in prompts]
-    done_cap = (lambda b: max_length is not None
-                and len(ids[b]) >= max_length)
+    stopped = [False] * B
+    done = (lambda b: stopped[b] or (max_length is not None
+                                     and len(ids[b]) >= max_length))
     for i in range(steps):
         probs = _probs(out)[:, :, -1]                       # [Bb, V]
         tok = np.zeros(Bb, np.int64)
         for b in range(B):
-            if done_cap(b):
+            if done(b):
                 continue
             tok[b] = draw(probs[b], temperature, rng,
                           top_k=top_k, top_p=top_p)
             ids[b].append(int(tok[b]))
-        if all(done_cap(b) for b in range(B)):
+            if tok[b] in stop_tokens:
+                stopped[b] = True
+        if all(done(b) for b in range(B)):
             break
         if i + 1 < steps:
             if cap is not None and T + i + 1 > cap:
@@ -358,7 +371,8 @@ def speculative_sample(net, draft, seed_ids, steps: int,
                        top_k: Optional[int] = None,
                        top_p: Optional[float] = None,
                        prime_padded: bool = False,
-                       prime_chunk_max: Optional[int] = None) -> List[int]:
+                       prime_chunk_max: Optional[int] = None,
+                       stop_tokens=()) -> List[int]:
     """Speculative decoding (Leviathan et al. 2023 rejection scheme):
     `draft` proposes up to `gamma` tokens, the target `net` scores ALL
     of them in ONE forward, and the longest accepted prefix is kept —
@@ -379,8 +393,10 @@ def speculative_sample(net, draft, seed_ids, steps: int,
     nets involved must carry only position-indexed streaming state
     (attention KV caches + positional offsets — LSTMs are rejected
     there). Acceptance compares the temperature/top_k/top_p-FILTERED
-    distributions (standard practice, so the filters stay
-    meaningful)."""
+    distributions (standard practice, so the filters stay meaningful).
+    Generation ends at the first `stop_tokens` member among the
+    committed tokens (kept as the final id — identical cut to plain
+    decoding with the same stops)."""
     from deeplearning4j_tpu.nn.conf.layers import (check_rewindable,
                                                    rewind_stream_state)
     if gamma < 1:
@@ -413,6 +429,15 @@ def speculative_sample(net, draft, seed_ids, steps: int,
     want = len(seed_ids) + steps
     if max_length is not None:
         want = min(want, max_length)
+    stop_set = set(stop_tokens)
+
+    def _stop_cut(start):
+        """Index just past the first stop token at/after `start`, or -1."""
+        for j in range(start, len(ids)):
+            if ids[j] in stop_set:
+                return j + 1
+        return -1
+
     # the committed-but-not-yet-consumed LAST token of `ids` rides at
     # the FRONT of the next verify chunk instead of costing its own
     # dispatch: every round is exactly ONE target forward, so even at
@@ -451,6 +476,8 @@ def speculative_sample(net, draft, seed_ids, steps: int,
         if not chunk:                 # g == 0 and nothing pending
             nxt = int(rng.choice(V, p=p_next))
             ids.append(nxt)
+            if stop_set and nxt in stop_set:
+                return ids
             pending = nxt
             # p_next for the round after this comes from the verify
             # forward that consumes `pending` next round
@@ -469,6 +496,8 @@ def speculative_sample(net, draft, seed_ids, steps: int,
         if g == 0:                    # plain step: sample from p_next
             nxt = int(rng.choice(V, p=p_next))
             ids.append(nxt)
+            if stop_set and nxt in stop_set:
+                return ids
             pending = nxt
             p_next = None
             continue
@@ -492,6 +521,7 @@ def speculative_sample(net, draft, seed_ids, steps: int,
                     resid, total = p_i, p_i.sum()
                 replacement = int(rng.choice(V, p=resid / total))
                 break
+        base = len(ids)
         ids.extend(proposals[:accepted])
         if replacement is None:
             # every proposal accepted: bonus token from the target's
@@ -500,6 +530,12 @@ def speculative_sample(net, draft, seed_ids, steps: int,
         else:
             nxt = replacement
         ids.append(nxt)
+        if stop_set:
+            cut = _stop_cut(base)
+            if cut >= 0:
+                # cap at `want`: plain decoding would have stopped at
+                # steps before ever reaching a later stop token
+                return ids[:min(cut, want)]
         pending = nxt
         p_next = None
         # --- rollback rejected positions (pending rides the next

@@ -37,7 +37,8 @@ class TextGenerationLSTM(ZooModel):
                       vocab_size: int = None,
                       rng=None, temperature: float = 1.0,
                       prime_padded: bool = False,
-                      top_k: int = None, top_p: float = None):
+                      top_k: int = None, top_p: float = None,
+                      stop_tokens=()):
         """Temperature sampling through the stored-state rnnTimeStep path
         (the reference's character-generation loop; shared implementation
         util/decoding.sample_stream; unbounded length). `prime_padded=True`
@@ -48,12 +49,14 @@ class TextGenerationLSTM(ZooModel):
                              vocab_size or self.vocab_size,
                              temperature=temperature, rng=rng,
                              max_length=None, prime_padded=prime_padded,
-                             top_k=top_k, top_p=top_p)
+                             top_k=top_k, top_p=top_p,
+                             stop_tokens=stop_tokens)
 
     def sample_stream_batch(self, net, prompts, steps: int,
                             vocab_size: int = None, rng=None,
                             temperature: float = 1.0,
-                            top_k: int = None, top_p: float = None):
+                            top_k: int = None, top_p: float = None,
+                            stop_tokens=()):
         """Decode a batch of prompts in lockstep (shared implementation
         util/decoding.sample_stream_batch) — mixed lengths are exact for
         LSTMs: masked left-pad steps pass h/c through unchanged."""
@@ -62,7 +65,8 @@ class TextGenerationLSTM(ZooModel):
                                    vocab_size or self.vocab_size,
                                    temperature=temperature, rng=rng,
                                    max_length=None,
-                                   top_k=top_k, top_p=top_p)
+                                   top_k=top_k, top_p=top_p,
+                                   stop_tokens=stop_tokens)
 
     def beam_search(self, net, seed_ids, steps: int, beam_width: int = 4,
                     vocab_size: int = None, prime_padded: bool = False):

@@ -132,7 +132,8 @@ class TextGenerationTransformer(ZooModel):
                       rng: np.random.Generator = None,
                       temperature: float = 1.0,
                       prime_padded: bool = False,
-                      top_k: int = None, top_p: float = None):
+                      top_k: int = None, top_p: float = None,
+                      stop_tokens=()):
         """KV-cache incremental decoding (shared implementation:
         util/decoding.sample_stream) — O(steps) single-position forwards
         instead of the padded full-forward-per-token of `sample`, with an
@@ -145,13 +146,15 @@ class TextGenerationTransformer(ZooModel):
                              temperature=temperature, rng=rng,
                              max_length=self.max_length,
                              prime_padded=prime_padded,
-                             top_k=top_k, top_p=top_p)
+                             top_k=top_k, top_p=top_p,
+                             stop_tokens=stop_tokens)
 
     def sample_stream_batch(self, net, prompts, steps: int,
                             vocab_size: int = None,
                             rng: np.random.Generator = None,
                             temperature: float = 1.0,
-                            top_k: int = None, top_p: float = None):
+                            top_k: int = None, top_p: float = None,
+                            stop_tokens=()):
         """Decode a batch of prompts in lockstep — one dispatch advances
         every row (shared implementation
         util/decoding.sample_stream_batch). Mixed lengths left-pad and
@@ -162,14 +165,15 @@ class TextGenerationTransformer(ZooModel):
                                    vocab_size or self.vocab_size,
                                    temperature=temperature, rng=rng,
                                    max_length=self.max_length,
-                                   top_k=top_k, top_p=top_p)
+                                   top_k=top_k, top_p=top_p,
+                                   stop_tokens=stop_tokens)
 
     def speculative_sample(self, net, draft, seed_ids, steps: int,
                            gamma: int = 4, vocab_size: int = None,
                            rng: np.random.Generator = None,
                            temperature: float = 1.0,
                            top_k: int = None, top_p: float = None,
-                           prime_padded: bool = False):
+                           prime_padded: bool = False, stop_tokens=()):
         """Speculative decoding: `draft` proposes `gamma` tokens, this
         model verifies them in ONE forward (shared implementation
         util/decoding.speculative_sample — the target distribution is
@@ -183,7 +187,8 @@ class TextGenerationTransformer(ZooModel):
                                   gamma=gamma, temperature=temperature,
                                   rng=rng, max_length=self.max_length,
                                   top_k=top_k, top_p=top_p,
-                                  prime_padded=prime_padded)
+                                  prime_padded=prime_padded,
+                                  stop_tokens=stop_tokens)
 
     def beam_search(self, net, seed_ids, steps: int, beam_width: int = 4,
                     vocab_size: int = None, prime_padded: bool = False):

@@ -1,0 +1,81 @@
+"""EOS / stop-token semantics across the decode stack: generation ends
+at the first drawn stop token (kept as the final id), identically in
+plain, batched, and speculative decoding."""
+
+import numpy as np
+
+from deeplearning4j_tpu.util import decoding
+from deeplearning4j_tpu.zoo import TextGenerationTransformer
+
+
+def _tfm(**kw):
+    kw.setdefault("positional", "rope")
+    kw.setdefault("embed_dim", 16)
+    kw.setdefault("n_layers", 1)
+    return TextGenerationTransformer(vocab_size=12, n_heads=2,
+                                     max_length=64, **kw)
+
+
+def _greedy_first_stop(model, net, prompt, steps, stops):
+    """Reference cut: run without stops, truncate at the first stop."""
+    full = model.sample_stream(net, prompt, steps=steps, top_k=1)
+    gen = full[len(prompt):]
+    for j, t in enumerate(gen):
+        if t in stops:
+            return full[:len(prompt) + j + 1]
+    return full
+
+
+class TestStopTokens:
+    def test_sample_stream_stops_and_keeps_eos(self):
+        model = _tfm()
+        net = model.init()
+        full = model.sample_stream(net, [1, 2, 3], steps=12, top_k=1)
+        # choose a token the greedy run actually emits as the stop
+        stop = full[len([1, 2, 3]) + 2]
+        want = _greedy_first_stop(model, net, [1, 2, 3], 12, {stop})
+        got = model.sample_stream(net, [1, 2, 3], steps=12, top_k=1,
+                                  stop_tokens={stop})
+        assert got == want
+        assert got[-1] == stop
+
+    def test_batch_rows_stop_independently(self):
+        model = _tfm()
+        net = model.init()
+        prompts = [[1, 2, 3], [4, 5], [7, 8, 9, 10]]
+        full = model.sample_stream_batch(net, prompts, steps=10, top_k=1)
+        stop = full[0][len(prompts[0]) + 1]     # row 0's 2nd new token
+        got = model.sample_stream_batch(net, prompts, steps=10, top_k=1,
+                                        stop_tokens={stop})
+        for p, g, f in zip(prompts, got, full):
+            gen = f[len(p):]
+            cut = next((j for j, t in enumerate(gen) if t == stop), None)
+            want = f if cut is None else f[:len(p) + cut + 1]
+            assert g == want, p
+
+    def test_speculative_matches_plain_with_stops(self):
+        """Speculation + stops == plain greedy + stops, for model and
+        prompt-lookup drafts."""
+        target = _tfm(n_layers=2, embed_dim=32, seed=1)
+        draft = _tfm(embed_dim=16, seed=99)
+        tnet, dnet = target.init(), draft.init()
+        prompt = [1, 2, 3, 4, 1, 2, 3, 4, 1]
+        full = target.sample_stream(tnet, prompt, steps=12, top_k=1)
+        stop = full[len(prompt) + 3]
+        want = target.sample_stream(tnet, prompt, steps=12, top_k=1,
+                                    stop_tokens={stop})
+        for d in (dnet, decoding.prompt_lookup_proposer(2)):
+            got = target.speculative_sample(tnet, d, prompt, steps=12,
+                                            gamma=3, top_k=1,
+                                            stop_tokens={stop},
+                                            rng=np.random.default_rng(0))
+            assert got == want, type(d)
+
+    def test_no_stop_token_drawn_runs_full(self):
+        model = _tfm()
+        net = model.init()
+        full = model.sample_stream(net, [1, 2, 3], steps=6, top_k=1)
+        unused = next(t for t in range(12) if t not in full)
+        got = model.sample_stream(net, [1, 2, 3], steps=6, top_k=1,
+                                  stop_tokens={unused})
+        assert got == full
