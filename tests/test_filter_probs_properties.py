@@ -1,0 +1,57 @@
+"""Property tests for util/decoding.filter_probs — the distribution
+every sampler draws from must stay a distribution under any filter
+combination."""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from deeplearning4j_tpu.util.decoding import filter_probs
+
+
+def _dist(draw_vals):
+    p = np.asarray(draw_vals, np.float64) + 1e-9
+    return p / p.sum()
+
+
+probs_strategy = st.lists(
+    st.floats(min_value=0.0, max_value=1.0, allow_nan=False),
+    min_size=2, max_size=64).map(_dist)
+
+
+@settings(max_examples=200, deadline=None)
+@given(p=probs_strategy,
+       temp=st.floats(min_value=0.05, max_value=5.0),
+       top_k=st.one_of(st.none(), st.integers(min_value=1, max_value=70)),
+       top_p=st.one_of(st.none(), st.floats(min_value=0.01, max_value=1.0)))
+def test_output_is_distribution(p, temp, top_k, top_p):
+    out = filter_probs(p, temp, top_k, top_p)
+    assert out.shape == p.shape
+    assert (out >= 0).all()
+    np.testing.assert_allclose(out.sum(), 1.0, atol=1e-9)
+    assert np.count_nonzero(out) >= 1
+
+
+@settings(max_examples=200, deadline=None)
+@given(p=probs_strategy,
+       top_k=st.integers(min_value=1, max_value=70))
+def test_top_k_support_bound(p, top_k):
+    out = filter_probs(p, 1.0, top_k, None)
+    assert np.count_nonzero(out) <= min(top_k, len(p))
+
+
+@settings(max_examples=200, deadline=None)
+@given(p=probs_strategy)
+def test_identity_without_filters(p):
+    out = filter_probs(p, 1.0, None, None)
+    np.testing.assert_allclose(out, p, rtol=1e-6, atol=1e-9)
+
+
+@settings(max_examples=100, deadline=None)
+@given(p=probs_strategy,
+       top_p=st.floats(min_value=0.01, max_value=0.999))
+def test_top_p_keeps_a_most_probable_token(p, top_p):
+    """At least one maximal-probability token survives nucleus
+    filtering (with TIES the sort keeps an arbitrary one — standard
+    nucleus behavior — so the specific argmax index may be dropped)."""
+    out = filter_probs(p, 1.0, None, top_p)
+    assert out[np.isclose(p, p.max())].max() > 0
