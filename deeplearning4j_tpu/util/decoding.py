@@ -550,7 +550,8 @@ def beam_search(net, seed_ids, steps: int, vocab_size: int,
                 beam_width: int = 4,
                 max_length: Optional[int] = None,
                 prime_chunk_max: Optional[int] = None,
-                prime_padded: bool = False
+                prime_padded: bool = False,
+                stop_tokens=()
                 ) -> Tuple[List[int], float]:
     """Highest-log-prob continuation of `seed_ids` by beam search.
 
@@ -560,9 +561,19 @@ def beam_search(net, seed_ids, steps: int, vocab_size: int,
     with positional tables or non-rolling caches). `prime_chunk_max`
     overrides the process default (set_prime_chunk_max) per call;
     `prime_padded=True` primes the whole prompt in ONE left-padded
-    dispatch (see _prime_padded)."""
+    dispatch (see _prime_padded).
+
+    `stop_tokens` enables standard beam EOS semantics: a hypothesis that
+    extends with a stop token FINISHES (keeps the stop as its final id,
+    stops extending, leaves its beam slot to live candidates); the
+    search ends when every slot is finished, when no live hypothesis can
+    still beat the best finished one (log-prob totals only decrease as
+    hypotheses extend), or when the step budget runs out. The best
+    finished hypothesis wins (falling back to the best live one if
+    nothing finished)."""
     V = vocab_size
     _check_seed(seed_ids, steps, max_length)
+    stop_tokens = set(stop_tokens)
     W = min(beam_width, V)     # top-k can't exceed the vocab
     Wb = _width_bucket(W)      # decode batch: per-bucket jit shape
     net.rnn_clear_previous_state()
@@ -577,6 +588,8 @@ def beam_search(net, seed_ids, steps: int, vocab_size: int,
     out = np.repeat(_probs(out)[:1], Wb, axis=0)
     beams = [list(seed_ids) for _ in range(W)]
     scores = np.zeros(W)
+    alive = np.ones(W, bool)   # slots still extending (EOS finishes one)
+    finished = []              # (sequence, score) hypotheses that hit EOS
     first = True
     for i in range(steps):
         if max_length is not None and len(beams[0]) >= max_length:
@@ -591,10 +604,26 @@ def beam_search(net, seed_ids, steps: int, vocab_size: int,
             first = False
         else:
             total = scores[:, None] + logp
+            total[~alive] = -np.inf     # finished slots never extend
             flat = np.argsort(total.ravel())[::-1][:W]
             parents, tokens = np.divmod(flat, V)
             scores = total.ravel()[flat]
         beams = [beams[p] + [int(t)] for p, t in zip(parents, tokens)]
+        if stop_tokens:
+            alive = np.ones(W, bool)
+            for w, t in enumerate(tokens):
+                if int(t) in stop_tokens and np.isfinite(scores[w]):
+                    finished.append((beams[w], float(scores[w])))
+                    alive[w] = False
+            if not alive.any():
+                break
+            if finished:
+                # log-prob totals only decrease as hypotheses extend, so
+                # once no live beam exceeds the best finished score the
+                # winner is already known
+                best_fin = max(sc for _, sc in finished)
+                if scores[alive].max() <= best_fin:
+                    break
         more = i + 1 < steps and (max_length is None
                                   or len(beams[0]) < max_length)
         if more:
@@ -607,5 +636,10 @@ def beam_search(net, seed_ids, steps: int, vocab_size: int,
             tok = np.zeros(Wb, np.int64)
             tok[:W] = tokens
             out = net.rnn_time_step(_one_hot(tok[:, None], V))
-    best = int(np.argmax(scores))
-    return beams[best], float(scores[best])
+    live = [(beams[w], float(scores[w])) for w in range(W)
+            if alive[w] and np.isfinite(scores[w])]
+    pool = finished if finished else live
+    if not pool:
+        pool = [(beams[w], float(scores[w])) for w in range(W)]
+    best_seq, best_score = max(pool, key=lambda bs: bs[1])
+    return best_seq, best_score

@@ -69,7 +69,8 @@ class TextGenerationLSTM(ZooModel):
                                    stop_tokens=stop_tokens)
 
     def beam_search(self, net, seed_ids, steps: int, beam_width: int = 4,
-                    vocab_size: int = None, prime_padded: bool = False):
+                    vocab_size: int = None, prime_padded: bool = False,
+                    stop_tokens=()):
         """Beam-search decoding over the stored-state rnnTimeStep path
         (shared implementation: util/decoding.beam_search; LSTM h/c is
         the carried state). Generation length is unbounded — recurrent
@@ -78,4 +79,5 @@ class TextGenerationLSTM(ZooModel):
         return beam_search(net, seed_ids, steps,
                            vocab_size or self.vocab_size,
                            beam_width=beam_width, max_length=None,
-                           prime_padded=prime_padded)
+                           prime_padded=prime_padded,
+                           stop_tokens=stop_tokens)

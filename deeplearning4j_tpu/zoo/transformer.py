@@ -191,7 +191,8 @@ class TextGenerationTransformer(ZooModel):
                                   stop_tokens=stop_tokens)
 
     def beam_search(self, net, seed_ids, steps: int, beam_width: int = 4,
-                    vocab_size: int = None, prime_padded: bool = False):
+                    vocab_size: int = None, prime_padded: bool = False,
+                    stop_tokens=()):
         """Beam-search decoding on the streaming KV-cache machinery
         (shared implementation: util/decoding.beam_search — beams ride
         the batch dimension, pruning gathers the carried state). Returns
@@ -201,4 +202,5 @@ class TextGenerationTransformer(ZooModel):
                            vocab_size or self.vocab_size,
                            beam_width=beam_width,
                            max_length=self.max_length,
-                           prime_padded=prime_padded)
+                           prime_padded=prime_padded,
+                           stop_tokens=stop_tokens)

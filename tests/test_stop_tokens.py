@@ -71,6 +71,50 @@ class TestStopTokens:
                                             rng=np.random.default_rng(0))
             assert got == want, type(d)
 
+    def test_beam_search_eos_semantics(self):
+        """A hypothesis hitting EOS finishes (keeps the stop, stops
+        extending); the best finished hypothesis wins."""
+        model = _tfm(n_layers=2, embed_dim=32, seed=5)
+        net = model.init()
+        prompt = [1, 2, 3]
+        full, _ = model.beam_search(net, prompt, steps=8, beam_width=3)
+        stop = full[len(prompt) + 1]         # a token the search reaches
+        seq, score = model.beam_search(net, prompt, steps=8, beam_width=3,
+                                       stop_tokens={stop})
+        assert seq[-1] == stop
+        assert stop not in seq[len(prompt):-1]   # ends at the FIRST stop
+        assert np.isfinite(score)
+        # deterministic across calls
+        seq2, score2 = model.beam_search(net, prompt, steps=8,
+                                         beam_width=3, stop_tokens={stop})
+        assert seq == seq2 and score == score2
+
+    def test_beam_search_without_stops_unchanged(self):
+        """stop_tokens=() keeps the original selection semantics."""
+        model = _tfm()
+        net = model.init()
+        a = model.beam_search(net, [1, 2], steps=5, beam_width=3)
+        b = model.beam_search(net, [1, 2], steps=5, beam_width=3,
+                              stop_tokens=())
+        assert a == b
+
+    def test_beam_search_stop_absent_from_result_when_unfinished(self):
+        """A stop token that appears in the best beam only as EOS: if
+        the returned hypothesis does not end with the stop, nothing
+        finished, and the result must not contain the stop at all.
+        (A stop 'unused' by the best beam is NOT a no-op in general —
+        other beams may hit it, finish, and change the frontier.)"""
+        model = _tfm()
+        net = model.init()
+        full, _ = model.beam_search(net, [1, 2], steps=5, beam_width=3)
+        unused = next(t for t in range(12) if t not in full)
+        seq, score = model.beam_search(net, [1, 2], steps=5, beam_width=3,
+                                       stop_tokens={unused})
+        assert np.isfinite(score)
+        assert 3 <= len(seq) <= 7                 # seed+1 .. seed+steps
+        if seq[-1] != unused:
+            assert unused not in seq[2:]
+
     def test_no_stop_token_drawn_runs_full(self):
         model = _tfm()
         net = model.init()
