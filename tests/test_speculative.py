@@ -219,6 +219,22 @@ class TestPromptLookup:
             gamma=4, top_k=1, rng=np.random.default_rng(0))
         assert got == want
 
+    def test_padded_prime_composes(self):
+        """prime_padded=True (single-dispatch left-padded priming)
+        inside speculation matches the chunked-priming run exactly."""
+        target = _tfm(layers=1, embed=32, seed=3)
+        tnet = target.init()
+        prompt = [1, 2, 3, 4, 5]
+        a = decoding.speculative_sample(
+            tnet, decoding.prompt_lookup_proposer(2), prompt, steps=8,
+            vocab_size=12, gamma=3, top_k=1,
+            rng=np.random.default_rng(0))
+        b = decoding.speculative_sample(
+            tnet, decoding.prompt_lookup_proposer(2), prompt, steps=8,
+            vocab_size=12, gamma=3, top_k=1, prime_padded=True,
+            rng=np.random.default_rng(0))
+        assert a == b
+
     def test_quantized_draft_composes(self):
         """The serving features compose: an int8-quantized draft model
         proposes, the fp target verifies — greedy output still exactly
