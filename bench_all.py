@@ -425,11 +425,50 @@ def bench_quant():
                       "int8_ms": round(q * 1e3, 2)}), flush=True)
 
 
+def bench_decode():
+    """Serving decode throughput: per-prompt sample_stream vs batched
+    sample_stream_batch (B prompts per dispatch — the dispatch-latency
+    multiplier on this platform). Greedy, rope positions, bf16."""
+    import numpy as np
+    from deeplearning4j_tpu.zoo import TextGenerationTransformer
+
+    V, B, STEPS = 2048, 8, 48
+    model = TextGenerationTransformer(vocab_size=V, embed_dim=512,
+                                      n_heads=8, n_layers=6,
+                                      max_length=256, positional="rope")
+    net = model.init()
+    net.conf.dtype = "bfloat16"
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, V, int(n)))
+               for n in rng.integers(8, 24, B)]
+    # warm both paths — EVERY prompt once, so all priming chunk shapes
+    # compile outside the timed region (jit shapes are per chunk size)
+    for p in prompts:
+        model.sample_stream(net, p, steps=1, top_k=1)
+    model.sample_stream_batch(net, prompts, steps=4, top_k=1)
+
+    t0 = time.perf_counter()
+    for p in prompts:
+        model.sample_stream(net, p, steps=STEPS, top_k=1)
+    dt_seq = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    model.sample_stream_batch(net, prompts, steps=STEPS, top_k=1)
+    dt_batch = time.perf_counter() - t0
+    total = B * STEPS
+    print(json.dumps({"metric": "decode_batch8_vs_sequential",
+                      "value": round(total / dt_batch, 1),
+                      "unit": "tokens/sec",
+                      "sequential_tokens_per_sec": round(total / dt_seq, 1),
+                      "batch_speedup": round(dt_seq / dt_batch, 2)}),
+          flush=True)
+
+
 ALL = {"resnet": bench_resnet, "lstm": bench_lstm, "lenet": bench_lenet,
        "vgg16": bench_vgg16, "inception": bench_keras_inception,
        "attention": bench_attention, "transformer": bench_transformer,
        "scaling": bench_scaling, "word2vec": bench_word2vec,
-       "window": bench_window_attention, "quant": bench_quant}
+       "window": bench_window_attention, "quant": bench_quant,
+       "decode": bench_decode}
 
 if __name__ == "__main__":
     names = sys.argv[1:] or ["resnet", "lstm", "lenet", "vgg16",
