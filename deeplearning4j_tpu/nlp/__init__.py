@@ -25,7 +25,10 @@ from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
 from deeplearning4j_tpu.nlp.glove import Glove
 from deeplearning4j_tpu.nlp.serializer import (
     write_word_vectors, read_word_vectors, write_word2vec_binary,
-    read_word2vec_binary,
+    read_word2vec_binary, write_full_model, read_full_model,
+    write_word2vec_model, read_word2vec_model_full,
+    write_paragraph_vectors, read_paragraph_vectors,
+    write_sequence_vectors, read_sequence_vectors,
 )
 from deeplearning4j_tpu.nlp.bagofwords import (
     BagOfWordsVectorizer, TfidfVectorizer,
@@ -51,7 +54,10 @@ __all__ = [
     "SequenceVectors", "Word2Vec", "ParagraphVectors", "Glove",
     "DistributedSequenceVectors",
     "write_word_vectors", "read_word_vectors", "write_word2vec_binary",
-    "read_word2vec_binary",
+    "read_word2vec_binary", "write_full_model", "read_full_model",
+    "write_word2vec_model", "read_word2vec_model_full",
+    "write_paragraph_vectors", "read_paragraph_vectors",
+    "write_sequence_vectors", "read_sequence_vectors",
     "BagOfWordsVectorizer", "TfidfVectorizer", "CnnSentenceDataSetIterator",
     "AnalysisEngine", "AnnotatedDocument", "Annotation",
     "AnnotationSentenceIterator", "AnnotationTokenizerFactory",

@@ -69,6 +69,8 @@ class Glove(SequenceVectors):
         self.symmetric = symmetric
         self.shuffle = shuffle
         self.bias = None
+        self._hist_w = None         # AdaGrad accumulators persist across
+        self._hist_b = None         # fit calls (and through save/load)
         self._cooc: Optional[Dict[Tuple[int, int], float]] = None
         self.loss_history: List[float] = []
         # mesh: run the factorization step SPMD across devices (the
@@ -95,7 +97,15 @@ class Glove(SequenceVectors):
                         cooc[(b_, a)] += wgt
         self._cooc = dict(cooc)
 
-    def fit(self, sequences: Iterable[Sequence[str]], **_) -> "Glove":
+    def fit(self, sequences: Iterable[Sequence[str]],
+            start_epoch: Optional[int] = None,
+            stop_epoch: Optional[int] = None,
+            resume: bool = False, **_) -> "Glove":
+        """start_epoch/stop_epoch slice the epoch schedule for mid-fit
+        checkpointing (see SequenceVectors.fit): the shuffle rng, bias and
+        AdaGrad accumulators persist on the model (and through save/load),
+        so fit(stop_epoch=k); save; load; fit(start_epoch=k) equals one
+        uninterrupted fit."""
         seqs = sequences if isinstance(sequences, list) else list(sequences)
         if self.vocab is None:
             self.build_vocab(seqs)
@@ -106,9 +116,11 @@ class Glove(SequenceVectors):
         if self.syn0 is None or self.syn0.shape != (V, D):
             self.syn0 = jnp.asarray(
                 (rnd.random((V, D), np.float32) - 0.5) / D)
-        self.bias = jnp.zeros((V,), jnp.float32)
-        hist_w = jnp.full((V, D), 1e-8, jnp.float32)
-        hist_b = jnp.full((V,), 1e-8, jnp.float32)
+        if self.bias is None or self.bias.shape != (V,):
+            self.bias = jnp.zeros((V,), jnp.float32)
+        if self._hist_w is None or self._hist_w.shape != (V, D):
+            self._hist_w = jnp.full((V, D), 1e-8, jnp.float32)
+            self._hist_b = jnp.full((V,), 1e-8, jnp.float32)
 
         pairs = np.asarray(list(self._cooc.keys()), np.int32)
         counts = np.asarray(list(self._cooc.values()), np.float32)
@@ -128,9 +140,18 @@ class Glove(SequenceVectors):
                 self._dist_step = make_distributed_glove_step(self.mesh)
             step_fn = self._dist_step
         order = np.arange(n)
-        for _ in range(self.epochs):
+        if start_epoch is None:
+            e0 = self.epochs_trained if resume else 0
+        else:
+            e0 = int(start_epoch)
+        e1 = self.epochs if stop_epoch is None else int(stop_epoch)
+        for _ in range(e0, e1):
             if self.shuffle:
-                rnd.shuffle(order)
+                # fresh permutation from the model's own rng each epoch
+                # (saved/restored by the serializer): epoch k's order is a
+                # function of rng state alone, so a mid-fit save resumes
+                # with the identical visit order
+                order = self._rng.permutation(n)
             total = 0.0
             for s in range(0, n, B):
                 sel = order[s:s + B]
@@ -138,11 +159,16 @@ class Glove(SequenceVectors):
                 if len(sel) < B:
                     valid[len(sel):] = 0.0
                     sel = np.pad(sel, (0, B - len(sel)))
-                self.syn0, self.bias, hist_w, hist_b, loss = step_fn(
-                    self.syn0, self.bias, hist_w, hist_b,
-                    jnp.asarray(pairs[sel, 0]), jnp.asarray(pairs[sel, 1]),
-                    jnp.asarray(logX[sel]), jnp.asarray(fX[sel]),
-                    jnp.asarray(valid), jnp.float32(self.learning_rate))
+                # accumulators live on self so an interrupt mid-fit never
+                # leaves weights and AdaGrad state out of step
+                self.syn0, self.bias, self._hist_w, self._hist_b, loss = \
+                    step_fn(self.syn0, self.bias, self._hist_w,
+                            self._hist_b, jnp.asarray(pairs[sel, 0]),
+                            jnp.asarray(pairs[sel, 1]),
+                            jnp.asarray(logX[sel]), jnp.asarray(fX[sel]),
+                            jnp.asarray(valid),
+                            jnp.float32(self.learning_rate))
                 total += float(loss)
             self.loss_history.append(total / max(1, n))
+        self.epochs_trained = e1
         return self

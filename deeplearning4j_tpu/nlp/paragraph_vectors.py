@@ -44,7 +44,9 @@ class ParagraphVectors(SequenceVectors):
 
     # -- training ----------------------------------------------------------
     def fit(self, documents: Optional[Iterable[LabelledDocument]] = None,
-            **_) -> "ParagraphVectors":
+            start_epoch: Optional[int] = None,
+            stop_epoch: Optional[int] = None,
+            resume: bool = False, **_) -> "ParagraphVectors":
         docs = list(documents) if documents is not None else \
             list(self.label_aware_iterator or [])
         if not docs:
@@ -59,9 +61,13 @@ class ParagraphVectors(SequenceVectors):
         if self.seq_algo == "dbow":
             SequenceVectors.fit(self, seqs, labels_per_sequence=labels,
                                 train_words=self.train_words,
-                                train_labels=True)
+                                train_labels=True,
+                                start_epoch=start_epoch,
+                                stop_epoch=stop_epoch, resume=resume)
         else:  # DM: label joins CBOW context; words co-train by nature
-            SequenceVectors.fit(self, seqs, labels_per_sequence=labels)
+            SequenceVectors.fit(self, seqs, labels_per_sequence=labels,
+                                start_epoch=start_epoch,
+                                stop_epoch=stop_epoch, resume=resume)
         return self
 
     # -- queries -----------------------------------------------------------
@@ -91,16 +97,22 @@ class ParagraphVectors(SequenceVectors):
         """Train a fresh doc row with word/output tables frozen
         (ref: ParagraphVectors.inferVector :~1050)."""
         toks = self.tokenizer_factory.create(text).get_tokens()
+        # infer draws (subsampling, window shrink, negatives) from a
+        # per-call seeded stream, NOT the training rng: inference is
+        # deterministic and leaves the trainer's resumable stream untouched
+        saved_rng = self._rng
+        self._rng = np.random.default_rng(self.seed)
         idxs = self._to_indices(toks)
         if idxs.size == 0:
+            self._rng = saved_rng
             return np.zeros(self.layer_size, np.float32)
-        rnd = np.random.default_rng(self.seed)
-        # append scratch row for the inferred doc
+        # append scratch row for the inferred doc (init drawn from the same
+        # per-call stream — one rng, no correlated twin generator)
         row = self.syn0.shape[0]
         saved0, saved1, saved1n = self.syn0, self.syn1, self.syn1neg
         self.syn0 = jnp.concatenate(
-            [self.syn0, jnp.asarray((rnd.random((1, self.layer_size),
-                                                np.float32) - 0.5)
+            [self.syn0, jnp.asarray((self._rng.random((1, self.layer_size),
+                                                      np.float32) - 0.5)
                                     / self.layer_size)], 0)
         if self.use_hs:
             pass  # syn1 indexed by inner nodes only — unchanged
@@ -116,3 +128,4 @@ class ParagraphVectors(SequenceVectors):
             return np.asarray(self.syn0[row])
         finally:
             self.syn0, self.syn1, self.syn1neg = saved0, saved1, saved1n
+            self._rng = saved_rng

@@ -363,6 +363,9 @@ class SequenceVectors:
         self._codes = self._points = self._path_mask = None
         self._table: Optional[np.ndarray] = None
         self._rng = np.random.default_rng(seed)
+        #: epochs completed so far (advanced by fit; persisted by save so a
+        #: reloaded model resumes its learning-rate schedule mid-run)
+        self.epochs_trained = 0
 
     # -- vocab + weights ---------------------------------------------------
     def build_vocab(self, sequences: Iterable[Sequence[str]],
@@ -406,10 +409,21 @@ class SequenceVectors:
             (rnd.random((V, D), np.float32) - 0.5) / D)
         if self.use_hs:
             self.syn1 = jnp.zeros((max(V - 1, 1), D), jnp.float32)
+        if self.negative > 0:
+            self.syn1neg = jnp.zeros((V, D), jnp.float32)
+        self._init_tables()
+
+    def _init_tables(self) -> None:
+        """(Re)build everything derived from the vocab but not trained:
+        huffman path arrays, the NS unigram table, the device-negatives rng
+        stream, and the collision-bounded dispatch batch. Called by
+        _reset_weights on a fresh model and by the serializer after
+        restoring trained syn0/syn1/syn1neg (nlp/serializer.py)."""
+        V = self.vocab.num_words()
+        if self.use_hs:
             c, p, m = codes_points_arrays(self.vocab)
             self._codes, self._points, self._path_mask = c, p, m
         if self.negative > 0:
-            self.syn1neg = jnp.zeros((V, D), jnp.float32)
             self._table = make_unigram_table(self.vocab)
             self._table_dev = None          # uploaded lazily per fit
             self._devneg_key = jax.random.PRNGKey(self.seed)
@@ -445,7 +459,10 @@ class SequenceVectors:
     # -- training ----------------------------------------------------------
     def fit(self, sequences: Iterable[Sequence[str]],
             labels_per_sequence: Optional[List[Sequence[str]]] = None,
-            train_words: bool = True, train_labels: bool = False) -> None:
+            train_words: bool = True, train_labels: bool = False,
+            start_epoch: Optional[int] = None,
+            stop_epoch: Optional[int] = None,
+            resume: bool = False) -> None:
         """ref: SequenceVectors.fit :192. `labels_per_sequence` attaches doc
         labels (ParagraphVectors DBOW/DM use them as extra input rows).
 
@@ -453,9 +470,23 @@ class SequenceVectors:
         worker pool (SequenceVectors.java:192 fit); here pairs ACCUMULATE
         across sequences into fixed-shape device batches and one jit step
         consumes each full batch — the device sees a few large dispatches
-        per epoch instead of one tiny dispatch per sentence."""
+        per epoch instead of one tiny dispatch per sentence.
+
+        start_epoch/stop_epoch run a slice of the epoch schedule (defaults
+        0..self.epochs): the learning-rate decay and the rng streams are
+        positioned exactly as the uninterrupted run would have them, so
+        fit(stop_epoch=k); save; load; fit(start_epoch=k) equals one
+        uninterrupted fit bit for bit (save persists the rng state —
+        nlp/serializer.py trainer_state). resume=True is shorthand for
+        start_epoch=self.epochs_trained (continue a checkpointed fit);
+        a plain fit() always runs the full schedule from epoch 0."""
         if self.vocab is None:
             raise RuntimeError("call build_vocab first")
+        if start_epoch is None:
+            e0 = self.epochs_trained if resume else 0
+        else:
+            e0 = int(start_epoch)
+        e1 = self.epochs if stop_epoch is None else int(stop_epoch)
         seqs = sequences if isinstance(sequences, list) else list(sequences)
         if seqs and isinstance(seqs[0], str):
             # a raw string would be iterated character-by-character and
@@ -467,13 +498,14 @@ class SequenceVectors:
                 "Word2Vec with a sentence_iterator/tokenizer_factory")
         if (train_words and not train_labels
                 and labels_per_sequence is None
-                and self._fit_native(seqs)):
+                and self._fit_native(seqs, e0, e1)):
+            self.epochs_trained = e1
             return
         total_words = sum(len(s) for s in seqs) * max(1, self.epochs)
-        words_seen = 0
+        words_seen = sum(len(s) for s in seqs) * e0
         sg = self.algo == "skipgram"
         buf = _BatchBuffer()
-        for epoch in range(self.epochs):
+        for epoch in range(e0, e1):
             for si, seq in enumerate(seqs):
                 idxs = self._to_indices(seq)
                 words_seen += len(seq)
@@ -503,13 +535,17 @@ class SequenceVectors:
                 else:
                     for bx, bm, bc, ba in buf.drain_cbow(self._eff_batch):
                         self._dispatch_cbow(bx, bm, bc, ba)
-        # trailing partial batch
-        if sg:
-            for bi, bo, ba in buf.drain_sg(self._eff_batch, final=True):
-                self._dispatch_sg(bi, bo, ba)
-        else:
-            for bx, bm, bc, ba in buf.drain_cbow(self._eff_batch, final=True):
-                self._dispatch_cbow(bx, bm, bc, ba)
+            # trailing partial batch — flushed per EPOCH (not per fit) so
+            # the batch composition is identical whether the epoch range
+            # runs in one call or is split for mid-fit checkpointing
+            if sg:
+                for bi, bo, ba in buf.drain_sg(self._eff_batch, final=True):
+                    self._dispatch_sg(bi, bo, ba)
+            else:
+                for bx, bm, bc, ba in buf.drain_cbow(self._eff_batch,
+                                                     final=True):
+                    self._dispatch_cbow(bx, bm, bc, ba)
+        self.epochs_trained = e1
 
     def _keep_probs(self) -> Optional[np.ndarray]:
         """Per-vocab-index keep probability for word2vec subsampling
@@ -527,7 +563,7 @@ class SequenceVectors:
                 keep[i] = min(1.0, (np.sqrt(f / t) + 1) * (t / f))
         return keep
 
-    def _fit_native(self, seqs) -> bool:
+    def _fit_native(self, seqs, e0: int = 0, e1: Optional[int] = None) -> bool:
         """Epoch-at-a-time pair generation in the C++ runtime
         (native/src/word2vec.cpp; ref: the SequenceVectors.java:192
         multithreaded fit). Vocab lookup happens ONCE for the whole fit;
@@ -576,7 +612,9 @@ class SequenceVectors:
                 acc = 0
         if shards[-1] != len(seqs):
             shards.append(len(seqs))
-        for epoch in range(self.epochs):
+        if e1 is None:
+            e1 = self.epochs
+        for epoch in range(e0, e1):
             seen = int(lens.sum()) * epoch + np.cumsum(lens)
             seq_alpha = np.maximum(
                 self.min_learning_rate,
@@ -910,6 +948,23 @@ class SequenceVectors:
         labels = np.zeros((B, K + 1), np.float32)
         labels[:, 0] = 1.0
         return targets, labels
+
+    # -- persistence -------------------------------------------------------
+    def save(self, path: str) -> None:
+        """Full-model save — vocab with counts/labels, huffman codes, syn0/
+        syn1/syn1neg, trainer config AND rng state, in the reference's
+        writeWord2VecModel zip layout (ref WordVectorSerializer.java:472-677)
+        plus a trainer_state.json entry for exact mid-fit resume."""
+        from deeplearning4j_tpu.nlp import serializer
+        serializer.write_full_model(self, path)
+
+    @classmethod
+    def load(cls, path: str) -> "SequenceVectors":
+        """Restore a model saved by save() — or a reference-written
+        Word2Vec/ParagraphVectors zip (ref WordVectorSerializer
+        readWord2Vec/readParagraphVectors :811-950)."""
+        from deeplearning4j_tpu.nlp import serializer
+        return serializer.read_full_model(path, cls=cls)
 
     # -- queries (ref: BasicModelUtils.java wordsNearest/similarity) -------
     def get_word_vector(self, word: str) -> Optional[np.ndarray]:

@@ -8,8 +8,11 @@ line, optional header) and the Google word2vec binary format
 
 from __future__ import annotations
 
+import base64
+import json
 import struct
-from typing import Optional, Tuple
+import zipfile
+from typing import Dict, List, Optional, Tuple, Type
 
 import jax.numpy as jnp
 import numpy as np
@@ -94,3 +97,317 @@ def _from_arrays(words, syn0: np.ndarray) -> SequenceVectors:
     sv.vocab = cache
     sv.syn0 = jnp.asarray(syn0)
     return sv
+
+
+# ---------------------------------------------------------------------------
+# Full-model zip — the reference's writeWord2VecModel / writeParagraphVectors
+# layout (WordVectorSerializer.java:472-677 write, :811-950 read): entries
+# syn0.txt ("V D numDocs" header, then "B64:word v0 v1 ..."), syn1.txt,
+# syn1Neg.txt, codes.txt, huffman.txt, frequencies.txt, labels.txt (paravec),
+# config.json (VectorsConfiguration field names). One extra entry of ours,
+# trainer_state.json, carries the rng stream + schedule position so a
+# mid-fit save resumes bit-exactly; reference-written zips simply lack it
+# (the model still loads for inference).
+# ---------------------------------------------------------------------------
+
+def encode_b64(word: str) -> str:
+    """ref: WordVectorSerializer.encodeB64 — "B64:" + base64(utf8)."""
+    return "B64:" + base64.b64encode(word.encode("utf-8")).decode("ascii")
+
+
+def decode_b64(word: str) -> str:
+    """ref: WordVectorSerializer.decodeB64 — passthrough when unprefixed."""
+    if word.startswith("B64:"):
+        return base64.b64decode(word[4:]).decode("utf-8")
+    return word
+
+
+def _fmt(v) -> str:
+    # shortest float64 repr round-trips exactly; float32 values are exact
+    # in float64, so text storage loses no bits
+    return repr(float(v))
+
+
+def _rows_txt(arr) -> str:
+    a = np.asarray(arr, np.float32)
+    return "\n".join(" ".join(_fmt(v) for v in row) for row in a)
+
+
+def _config_json(sv: SequenceVectors) -> str:
+    """VectorsConfiguration-shaped JSON (ref VectorsConfiguration.java:26-70
+    field names) so the reference can parse our config and vice versa."""
+    cfg = {
+        "minWordFrequency": sv.min_word_frequency,
+        "learningRate": sv.learning_rate,
+        "minLearningRate": sv.min_learning_rate,
+        "layersSize": sv.layer_size,
+        "batchSize": sv.batch_size,
+        "iterations": sv.iterations,
+        "epochs": sv.epochs,
+        "window": sv.window,
+        "seed": sv.seed,
+        "negative": float(sv.negative),
+        "useHierarchicSoftmax": bool(sv.use_hs),
+        "sampling": sv.sampling,
+        "elementsLearningAlgorithm": sv.algo,
+        "vocabSize": sv.vocab.num_words() if sv.vocab is not None else 0,
+    }
+    seq_algo = getattr(sv, "seq_algo", None)
+    if seq_algo is not None:
+        cfg["sequenceLearningAlgorithm"] = seq_algo
+    return json.dumps(cfg, indent=1)
+
+
+def _trainer_state_json(sv: SequenceVectors) -> str:
+    state = {
+        "class": type(sv).__name__,
+        "rng_state": sv._rng.bit_generator.state,
+        "devneg_ctr": int(getattr(sv, "_devneg_ctr", 0)),
+        "epochs_trained": int(getattr(sv, "epochs_trained", 0)),
+        "total_word_count": float(sv.vocab.total_word_count),
+        "device_negatives": bool(sv.device_negatives),
+    }
+    if getattr(sv, "seq_algo", None) is not None:   # ParagraphVectors
+        state["train_words"] = bool(getattr(sv, "train_words", False))
+    if hasattr(sv, "x_max"):                        # Glove
+        state["x_max"] = float(sv.x_max)
+        state["alpha"] = float(sv.alpha)
+        state["symmetric"] = bool(sv.symmetric)
+        state["shuffle"] = bool(sv.shuffle)
+        state["loss_history"] = [float(x) for x in sv.loss_history]
+    return json.dumps(state)
+
+
+def write_full_model(sv: SequenceVectors, path: str) -> None:
+    """Save the COMPLETE model (ref writeWord2VecModel /
+    writeParagraphVectors — WordVectorSerializer.java:493-677, :698-809)."""
+    if sv.vocab is None or sv.syn0 is None:
+        raise RuntimeError("model has no vocab/weights to save")
+    words = sv.vocab.vocab_words()
+    syn0 = np.asarray(sv.syn0, np.float32)
+    labels = [w.word for w in words if w.is_label]
+    lines = [f"{len(words)} {syn0.shape[1]} {len(labels)}"]
+    for w in words:
+        lines.append(encode_b64(w.word) + " "
+                     + " ".join(_fmt(v) for v in syn0[w.index]))
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("syn0.txt", "\n".join(lines))
+        zf.writestr("syn1.txt",
+                    _rows_txt(sv.syn1) if sv.syn1 is not None else "")
+        zf.writestr("syn1Neg.txt",
+                    _rows_txt(sv.syn1neg) if sv.syn1neg is not None else "")
+        zf.writestr("codes.txt", "\n".join(
+            encode_b64(w.word) + ((" " + " ".join(str(c) for c in w.codes))
+                                  if w.codes else "")
+            for w in words))
+        zf.writestr("huffman.txt", "\n".join(
+            encode_b64(w.word) + ((" " + " ".join(str(p) for p in w.points))
+                                  if w.points else "")
+            for w in words))
+        zf.writestr("frequencies.txt", "\n".join(
+            f"{encode_b64(w.word)} {_fmt(w.frequency)} 0" for w in words))
+        zf.writestr("config.json", _config_json(sv))
+        if labels:
+            zf.writestr("labels.txt",
+                        "\n".join(encode_b64(l) for l in labels))
+        zf.writestr("trainer_state.json", _trainer_state_json(sv))
+        if hasattr(sv, "x_max"):   # Glove: bias + AdaGrad accumulators
+            import io as _io
+            buf = _io.BytesIO()
+            arrs = {}
+            if sv.bias is not None:
+                arrs["bias"] = np.asarray(sv.bias, np.float32)
+            if getattr(sv, "_hist_w", None) is not None:
+                arrs["hist_w"] = np.asarray(sv._hist_w, np.float32)
+                arrs["hist_b"] = np.asarray(sv._hist_b, np.float32)
+            np.savez(buf, **arrs)
+            zf.writestr("glove_state.npz", buf.getvalue())
+
+
+def _parse_cfg(cfg: Dict) -> Dict:
+    """Map VectorsConfiguration JSON → our constructor kwargs."""
+    kw = {}
+    m = {"minWordFrequency": ("min_word_frequency", int),
+         "learningRate": ("learning_rate", float),
+         "minLearningRate": ("min_learning_rate", float),
+         "layersSize": ("layer_size", int),
+         "batchSize": ("batch_size", int),
+         "iterations": ("iterations", int),
+         "epochs": ("epochs", int),
+         "window": ("window", int),
+         "seed": ("seed", int),
+         "negative": ("negative", lambda v: int(float(v))),
+         "useHierarchicSoftmax": ("use_hierarchic_softmax", bool),
+         "sampling": ("sampling", float)}
+    for src, (dst, conv) in m.items():
+        if src in cfg and cfg[src] is not None:
+            kw[dst] = conv(cfg[src])
+    algo = (cfg.get("elementsLearningAlgorithm") or "").lower()
+    if "cbow" in algo:
+        kw["elements_learning_algorithm"] = "cbow"
+    elif "skipgram" in algo:
+        kw["elements_learning_algorithm"] = "skipgram"
+    return kw
+
+
+def read_full_model(path: str, cls: Optional[Type[SequenceVectors]] = None
+                    ) -> SequenceVectors:
+    """Restore a full-model zip — ours or the reference's
+    (ref readWord2Vec :864-950 / readParagraphVectors :811-852)."""
+    with zipfile.ZipFile(path, "r") as zf:
+        names = set(zf.namelist())
+
+        def read_txt(name: str) -> str:
+            return zf.read(name).decode("utf-8") if name in names else ""
+
+        cfg = json.loads(read_txt("config.json") or "{}")
+        state = json.loads(read_txt("trainer_state.json") or "{}")
+        # -- class resolution ---------------------------------------------
+        if cls is None or cls is SequenceVectors:
+            hint = state.get("class")
+            seq_algo = (cfg.get("sequenceLearningAlgorithm") or "")
+            if cls is None:
+                cls = SequenceVectors
+            if hint or seq_algo or "labels.txt" in names:
+                from deeplearning4j_tpu.nlp.glove import Glove
+                from deeplearning4j_tpu.nlp.paragraph_vectors import (
+                    ParagraphVectors,
+                )
+                from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+                by_name = {"Word2Vec": Word2Vec, "Glove": Glove,
+                           "ParagraphVectors": ParagraphVectors,
+                           "SequenceVectors": SequenceVectors}
+                if hint in by_name:
+                    cls = by_name[hint]
+                elif seq_algo or "labels.txt" in names:
+                    cls = ParagraphVectors
+        kw = _parse_cfg(cfg)
+        from deeplearning4j_tpu.nlp.glove import Glove
+        from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+        if issubclass(cls, ParagraphVectors):
+            # java stores the learning-algo CLASS name (…impl.sequence.DM)
+            seq_algo = (cfg.get("sequenceLearningAlgorithm") or "dbow")
+            kw["sequence_learning_algorithm"] = \
+                "dm" if seq_algo.lower().split(".")[-1] == "dm" else "dbow"
+            kw["train_words"] = bool(state.get("train_words", False))
+            # keep elements_learning_algorithm if present: the constructor's
+            # setdefault only fills it when the save predates the field
+        if issubclass(cls, Glove):
+            for k in ("x_max", "alpha", "symmetric", "shuffle"):
+                if k in state:
+                    kw[k] = state[k]
+            for k in ("negative", "use_hierarchic_softmax", "sampling",
+                      "iterations"):
+                kw.pop(k, None)
+        model = cls(**kw)
+
+        # -- vocab + syn0 ---------------------------------------------------
+        syn0_lines = read_txt("syn0.txt").splitlines()
+        header = syn0_lines[0].split() if syn0_lines else ["0", "0"]
+        V, D = int(header[0]), int(header[1])
+        cache = VocabCache()
+        syn0 = np.zeros((V, D), np.float32)
+        order: List[VocabWord] = []
+        for i, line in enumerate(syn0_lines[1:V + 1]):
+            parts = line.rstrip("\n").split(" ")
+            w = VocabWord(decode_b64(parts[0]))
+            cache.add_token(w)
+            order.append(w)
+            syn0[i] = np.asarray([float(x) for x in parts[1:D + 1]],
+                                 np.float32)
+        for i, w in enumerate(order):
+            w.index = i
+        cache._index = order
+        for line in read_txt("frequencies.txt").splitlines():
+            parts = line.split(" ")
+            vw = cache.word_for(decode_b64(parts[0]))
+            if vw is not None and len(parts) > 1:
+                vw.frequency = float(parts[1])
+        for name, attr, conv in (("codes.txt", "codes", int),
+                                 ("huffman.txt", "points", int)):
+            for line in read_txt(name).splitlines():
+                parts = line.split(" ")
+                vw = cache.word_for(decode_b64(parts[0]))
+                if vw is not None:
+                    setattr(vw, attr, [conv(x) for x in parts[1:] if x])
+        for line in read_txt("labels.txt").splitlines():
+            vw = cache.word_for(decode_b64(line.strip()))
+            if vw is not None:
+                vw.is_label = True
+        cache.total_word_count = float(
+            state.get("total_word_count",
+                      sum(w.frequency for w in order)))
+        model.vocab = cache
+        model.syn0 = jnp.asarray(syn0)
+
+        # -- output tables --------------------------------------------------
+        syn1_txt = read_txt("syn1.txt").strip()
+        if syn1_txt:
+            model.syn1 = jnp.asarray(
+                [[float(x) for x in ln.split()]
+                 for ln in syn1_txt.splitlines()], jnp.float32)
+        elif model.use_hs:
+            model.syn1 = jnp.zeros((max(V - 1, 1), D), jnp.float32)
+        syn1neg_txt = read_txt("syn1Neg.txt").strip()
+        if syn1neg_txt:
+            model.syn1neg = jnp.asarray(
+                [[float(x) for x in ln.split()]
+                 for ln in syn1neg_txt.splitlines()], jnp.float32)
+        elif model.negative > 0:
+            model.syn1neg = jnp.zeros((V, D), jnp.float32)
+        model._init_tables()
+
+        # -- trainer state (exact resume) ----------------------------------
+        if "rng_state" in state:
+            rng = np.random.default_rng()
+            rng.bit_generator.state = state["rng_state"]
+            model._rng = rng
+        if model.negative > 0 and "devneg_ctr" in state:
+            model._devneg_ctr = int(state["devneg_ctr"])
+        model.epochs_trained = int(state.get("epochs_trained", 0))
+        if "device_negatives" in state:
+            model.device_negatives = bool(state["device_negatives"])
+        if "loss_history" in state:
+            model.loss_history = list(state["loss_history"])
+        if "glove_state.npz" in names:
+            import io as _io
+            npz = np.load(_io.BytesIO(zf.read("glove_state.npz")))
+            if "bias" in npz:
+                model.bias = jnp.asarray(npz["bias"])
+            if "hist_w" in npz:
+                model._hist_w = jnp.asarray(npz["hist_w"])
+                model._hist_b = jnp.asarray(npz["hist_b"])
+    return model
+
+
+# reference-named conveniences (WordVectorSerializer method names)
+def write_word2vec_model(vectors, path: str) -> None:
+    """ref: WordVectorSerializer.writeWord2VecModel :493."""
+    write_full_model(vectors, path)
+
+
+def read_word2vec_model_full(path: str):
+    """ref: WordVectorSerializer.readWord2Vec :864 (full model)."""
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+    return read_full_model(path, cls=Word2Vec)
+
+
+def write_paragraph_vectors(vectors, path: str) -> None:
+    """ref: WordVectorSerializer.writeParagraphVectors :675."""
+    write_full_model(vectors, path)
+
+
+def read_paragraph_vectors(path: str):
+    """ref: WordVectorSerializer.readParagraphVectors :811."""
+    from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+    return read_full_model(path, cls=ParagraphVectors)
+
+
+def write_sequence_vectors(vectors, path: str) -> None:
+    """ref: WordVectorSerializer.writeSequenceVectors."""
+    write_full_model(vectors, path)
+
+
+def read_sequence_vectors(path: str):
+    """ref: WordVectorSerializer.readSequenceVectors."""
+    return read_full_model(path, cls=None)

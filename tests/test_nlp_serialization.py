@@ -1,0 +1,321 @@
+"""Full-model NLP serialization (VERDICT r3 missing #1).
+
+Mirrors the reference's WordVectorSerializerTest patterns: full
+Word2Vec/ParagraphVectors/GloVe zips round-trip — vocab with counts and
+labels, huffman codes/points, syn0/syn1/syn1neg, trainer config — and a
+mid-fit save/load resumes bit-exactly (ref WordVectorSerializer.java
+writeWord2VecModel :493, writeParagraphVectors :675, readWord2Vec :864,
+readParagraphVectors :811).
+"""
+
+import json
+import zipfile
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    Glove, LabelledDocument, ParagraphVectors, SequenceVectors, Word2Vec,
+    read_full_model, read_paragraph_vectors, read_word2vec_model_full,
+    write_paragraph_vectors, write_word2vec_model,
+)
+from deeplearning4j_tpu.nlp.serializer import decode_b64, encode_b64
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog".split(),
+    "the cat sat on the mat with the dog".split(),
+    "dogs and cats are pets people keep at home".split(),
+    "foxes live in the forest far from home".split(),
+    "people walk their dogs in the park every day".split(),
+    "the park is far from the forest".split(),
+] * 4
+
+
+def _docs():
+    return [
+        LabelledDocument("the quick brown fox jumps over the lazy dog",
+                         ["DOC_animals"]),
+        LabelledDocument("people walk their dogs in the park every day",
+                         ["DOC_park"]),
+        LabelledDocument("the cat sat on the mat with the dog",
+                         ["DOC_home"]),
+        LabelledDocument("foxes live in the forest far from home",
+                         ["DOC_forest"]),
+    ]
+
+
+class TestWord2VecFullModel:
+    @pytest.mark.parametrize("kwargs", [
+        dict(negative=0),                            # hierarchical softmax
+        dict(negative=5),                            # device negatives
+        dict(negative=5, device_negatives=False),    # host rng negatives
+    ])
+    def test_roundtrip_identical(self, tmp_path, kwargs):
+        w = Word2Vec(layer_size=16, window=3, min_word_frequency=1,
+                     epochs=2, seed=7, **kwargs)
+        w.fit(CORPUS)
+        path = str(tmp_path / "w2v.zip")
+        w.save(path)
+        r = Word2Vec.load(path)
+        # vocab: words, order, counts, huffman paths
+        assert r.vocab.words() == w.vocab.words()
+        for vw in w.vocab.vocab_words():
+            rw = r.vocab.word_for(vw.word)
+            assert rw.frequency == vw.frequency
+            assert rw.codes == vw.codes
+            assert rw.points == vw.points
+            assert rw.index == vw.index
+        # tables bit-exact
+        np.testing.assert_array_equal(np.asarray(r.syn0),
+                                      np.asarray(w.syn0))
+        if w.syn1 is not None:
+            np.testing.assert_array_equal(np.asarray(r.syn1),
+                                          np.asarray(w.syn1))
+        if w.syn1neg is not None:
+            np.testing.assert_array_equal(np.asarray(r.syn1neg),
+                                          np.asarray(w.syn1neg))
+        # config round-trips
+        assert r.layer_size == w.layer_size
+        assert r.window == w.window
+        assert r.negative == w.negative
+        assert r.use_hs == w.use_hs
+        assert r.seed == w.seed
+        assert r.epochs == w.epochs
+        # queries agree
+        assert r.similarity("dog", "cat") == pytest.approx(
+            w.similarity("dog", "cat"))
+        assert r.words_nearest("dog", 3) == w.words_nearest("dog", 3)
+
+    @pytest.mark.parametrize("kwargs", [
+        dict(negative=0),
+        dict(negative=5),
+        dict(negative=5, device_negatives=False),
+        dict(negative=3, elements_learning_algorithm="cbow"),
+    ])
+    def test_midfit_save_resume_equals_uninterrupted(self, tmp_path, kwargs):
+        mk = lambda: Word2Vec(layer_size=12, window=3, min_word_frequency=1,
+                              epochs=4, seed=11, **kwargs)
+        a = mk()
+        a.fit(CORPUS)
+
+        b = mk()
+        b.build_vocab(CORPUS)
+        b.fit(CORPUS, stop_epoch=2)
+        path = str(tmp_path / "mid.zip")
+        b.save(path)
+        c = Word2Vec.load(path)
+        assert c.epochs_trained == 2
+        c.fit(CORPUS, start_epoch=2)
+
+        np.testing.assert_array_equal(np.asarray(a.syn0),
+                                      np.asarray(c.syn0))
+        if a.syn1 is not None:
+            np.testing.assert_array_equal(np.asarray(a.syn1),
+                                          np.asarray(c.syn1))
+        if a.syn1neg is not None:
+            np.testing.assert_array_equal(np.asarray(a.syn1neg),
+                                          np.asarray(c.syn1neg))
+
+    def test_resume_flag_continues_from_epochs_trained(self, tmp_path):
+        mk = lambda: Word2Vec(layer_size=12, window=3, min_word_frequency=1,
+                              epochs=4, seed=11, negative=5)
+        a = mk()
+        a.fit(CORPUS)
+        b = mk()
+        b.fit(CORPUS, stop_epoch=2)
+        path = str(tmp_path / "mid.zip")
+        b.save(path)
+        c = Word2Vec.load(path)
+        c.fit(CORPUS, resume=True)        # == start_epoch=c.epochs_trained
+        np.testing.assert_array_equal(np.asarray(a.syn0),
+                                      np.asarray(c.syn0))
+
+    def test_elements_algo_override_survives_roundtrip(self, tmp_path):
+        pv = ParagraphVectors(layer_size=8, epochs=1, min_word_frequency=1,
+                              seed=3, sequence_learning_algorithm="dbow",
+                              elements_learning_algorithm="cbow",
+                              train_words=True)
+        pv.fit(_docs())
+        path = str(tmp_path / "pv_cbow.zip")
+        pv.save(path)
+        r = ParagraphVectors.load(path)
+        assert r.algo == "cbow" and r.seq_algo == "dbow"
+
+    def test_zip_layout_matches_reference(self, tmp_path):
+        """Entry names + syn0 header follow WordVectorSerializer.java's
+        writeWord2VecModel layout, so the reference could read our zips."""
+        w = Word2Vec(layer_size=8, min_word_frequency=1, epochs=1, seed=3,
+                     negative=5)
+        w.fit(CORPUS)
+        path = str(tmp_path / "w2v.zip")
+        write_word2vec_model(w, path)
+        with zipfile.ZipFile(path) as zf:
+            names = set(zf.namelist())
+            for required in ("syn0.txt", "syn1.txt", "syn1Neg.txt",
+                             "codes.txt", "huffman.txt", "frequencies.txt",
+                             "config.json"):
+                assert required in names
+            syn0 = zf.read("syn0.txt").decode().splitlines()
+            v, d, ndocs = syn0[0].split()
+            assert int(v) == w.vocab.num_words()
+            assert int(d) == w.layer_size
+            # every word b64-wrapped like the reference
+            assert syn0[1].startswith("B64:")
+            cfg = json.loads(zf.read("config.json"))
+            assert cfg["layersSize"] == 8
+            assert cfg["negative"] == 5.0
+            assert cfg["minWordFrequency"] == 1
+
+    def test_reads_reference_written_zip(self, tmp_path):
+        """A zip with Java-style float text and NO trainer_state.json (what
+        the reference writes) still loads: vectors, codes, freqs."""
+        words = ["alpha", "beta", "gamma"]
+        vecs = [[0.5, -1.25], [3.0E-4, 2.0], [1.0, 0.125]]
+        syn0 = ["3 2 0"] + [
+            f"{encode_b64(w)} " + " ".join(str(x) for x in v)
+            for w, v in zip(words, vecs)]
+        syn1 = ["0.1 0.2", "0.3 0.4"]
+        codes = [f"{encode_b64('alpha')} 0 1", f"{encode_b64('beta')} 1",
+                 f"{encode_b64('gamma)')}"]
+        codes[2] = f"{encode_b64('gamma')} 0"
+        huff = [f"{encode_b64('alpha')} 1 0", f"{encode_b64('beta')} 0",
+                f"{encode_b64('gamma')} 1"]
+        freqs = [f"{encode_b64('alpha')} 10.0 3",
+                 f"{encode_b64('beta')} 5.0 2",
+                 f"{encode_b64('gamma')} 2.0 1"]
+        cfg = {"layersSize": 2, "negative": 0.0,
+               "useHierarchicSoftmax": True, "window": 5, "seed": 42,
+               "learningRate": 0.025, "minWordFrequency": 1}
+        path = str(tmp_path / "ref.zip")
+        with zipfile.ZipFile(path, "w") as zf:
+            zf.writestr("syn0.txt", "\n".join(syn0))
+            zf.writestr("syn1.txt", "\n".join(syn1))
+            zf.writestr("codes.txt", "\n".join(codes))
+            zf.writestr("huffman.txt", "\n".join(huff))
+            zf.writestr("frequencies.txt", "\n".join(freqs))
+            zf.writestr("config.json", json.dumps(cfg))
+        r = read_word2vec_model_full(path)
+        assert r.vocab.words() == words
+        np.testing.assert_allclose(r.get_word_vector("alpha"),
+                                   [0.5, -1.25])
+        np.testing.assert_allclose(r.get_word_vector("beta"),
+                                   [3.0e-4, 2.0], rtol=1e-6)
+        assert r.vocab.word_for("alpha").codes == [0, 1]
+        assert r.vocab.word_for("alpha").points == [1, 0]
+        assert r.vocab.word_for("alpha").frequency == 10.0
+        assert r.use_hs and r.syn1.shape == (2, 2)
+
+    def test_b64_roundtrip_unicode(self):
+        for w in ("日本語", "naïve", "a b", "B64:sneaky"):
+            assert decode_b64(encode_b64(w)) == w
+        assert decode_b64("plain") == "plain"
+
+
+class TestParagraphVectorsFullModel:
+    @pytest.mark.parametrize("algo", ["dbow", "dm"])
+    def test_save_load_infer_identical(self, tmp_path, algo):
+        pv = ParagraphVectors(layer_size=16, window=3, min_word_frequency=1,
+                              epochs=3, seed=5, negative=3,
+                              sequence_learning_algorithm=algo)
+        pv.fit(_docs())
+        text = "the dog runs in the park"
+        v1 = pv.infer_vector(text)
+        path = str(tmp_path / "pv.zip")
+        pv.save(path)
+        r = ParagraphVectors.load(path)
+        assert isinstance(r, ParagraphVectors)
+        assert r.seq_algo == algo
+        # labels survive with their flag
+        labels = sorted(w.word for w in r.vocab.vocab_words() if w.is_label)
+        assert labels == ["DOC_animals", "DOC_forest", "DOC_home",
+                          "DOC_park"]
+        np.testing.assert_array_equal(np.asarray(r.syn0),
+                                      np.asarray(pv.syn0))
+        v2 = r.infer_vector(text)
+        np.testing.assert_array_equal(v1, v2)
+        # label queries work post-load
+        assert r.get_label_vector("DOC_park") is not None
+        assert len(r.nearest_labels(text, top_n=2)) == 2
+
+    def test_midfit_resume(self, tmp_path):
+        mk = lambda: ParagraphVectors(layer_size=12, window=3, epochs=4,
+                                      min_word_frequency=1, seed=9,
+                                      negative=3)
+        a = mk()
+        a.fit(_docs())
+
+        b = mk()
+        b.fit(_docs(), stop_epoch=2)
+        path = str(tmp_path / "pv_mid.zip")
+        write_paragraph_vectors(b, path)
+        c = read_paragraph_vectors(path)
+        c.fit(_docs(), start_epoch=2)
+        np.testing.assert_array_equal(np.asarray(a.syn0),
+                                      np.asarray(c.syn0))
+
+    def test_labels_txt_written(self, tmp_path):
+        pv = ParagraphVectors(layer_size=8, epochs=1, min_word_frequency=1,
+                              seed=2)
+        pv.fit(_docs())
+        path = str(tmp_path / "pv.zip")
+        pv.save(path)
+        with zipfile.ZipFile(path) as zf:
+            labels = [decode_b64(l) for l in
+                      zf.read("labels.txt").decode().splitlines()]
+        assert sorted(labels) == ["DOC_animals", "DOC_forest", "DOC_home",
+                                  "DOC_park"]
+
+
+class TestGloveFullModel:
+    def test_roundtrip(self, tmp_path):
+        g = Glove(layer_size=12, window=3, epochs=4, learning_rate=0.1,
+                  min_word_frequency=1, seed=13)
+        g.fit(CORPUS)
+        path = str(tmp_path / "glove.zip")
+        g.save(path)
+        r = Glove.load(path)
+        assert isinstance(r, Glove)
+        assert r.x_max == g.x_max and r.alpha == g.alpha
+        np.testing.assert_array_equal(np.asarray(r.syn0), np.asarray(g.syn0))
+        np.testing.assert_array_equal(np.asarray(r.bias), np.asarray(g.bias))
+        np.testing.assert_array_equal(np.asarray(r._hist_w),
+                                      np.asarray(g._hist_w))
+        assert r.loss_history == g.loss_history
+
+    def test_midfit_resume(self, tmp_path):
+        mk = lambda: Glove(layer_size=10, window=3, epochs=4,
+                           learning_rate=0.1, min_word_frequency=1, seed=17)
+        a = mk()
+        a.fit(CORPUS)
+
+        b = mk()
+        b.fit(CORPUS, stop_epoch=2)
+        path = str(tmp_path / "glove_mid.zip")
+        b.save(path)
+        c = Glove.load(path)
+        c.fit(CORPUS, start_epoch=2)
+        np.testing.assert_array_equal(np.asarray(a.syn0), np.asarray(c.syn0))
+        np.testing.assert_array_equal(np.asarray(a.bias), np.asarray(c.bias))
+        assert a.loss_history[2:] == pytest.approx(c.loss_history[2:])
+
+
+class TestClassResolution:
+    def test_generic_read_resolves_class(self, tmp_path):
+        w = Word2Vec(layer_size=8, epochs=1, min_word_frequency=1, seed=1,
+                     negative=2)
+        w.fit(CORPUS)
+        path = str(tmp_path / "any.zip")
+        w.save(path)
+        r = read_full_model(path)
+        assert isinstance(r, Word2Vec)
+        r2 = SequenceVectors.load(path)
+        assert isinstance(r2, Word2Vec)
+
+    def test_labels_zip_resolves_to_paragraph_vectors(self, tmp_path):
+        pv = ParagraphVectors(layer_size=8, epochs=1, min_word_frequency=1,
+                              seed=1)
+        pv.fit(_docs())
+        path = str(tmp_path / "pv_any.zip")
+        pv.save(path)
+        r = read_full_model(path)
+        assert isinstance(r, ParagraphVectors)
