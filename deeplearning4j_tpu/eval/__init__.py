@@ -6,3 +6,9 @@ from deeplearning4j_tpu.eval.evaluation import (  # noqa: F401
 from deeplearning4j_tpu.eval.roc import ROC, ROCBinary, ROCMultiClass  # noqa: F401
 from deeplearning4j_tpu.eval.binary import EvaluationBinary  # noqa: F401
 from deeplearning4j_tpu.eval.calibration import EvaluationCalibration  # noqa: F401
+from deeplearning4j_tpu.eval.serde import (  # noqa: F401
+    from_dict as eval_from_dict,
+    from_json as eval_from_json,
+    to_dict as eval_to_dict,
+    to_json as eval_to_json,
+)

@@ -10,8 +10,10 @@ from typing import Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.eval.base import EvalJsonMixin
 
-class EvaluationBinary:
+
+class EvaluationBinary(EvalJsonMixin):
     def __init__(self, decision_threshold: float = 0.5):
         self.threshold = decision_threshold
         self._tp = None

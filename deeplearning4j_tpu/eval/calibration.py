@@ -8,8 +8,10 @@ from __future__ import annotations
 
 import numpy as np
 
+from deeplearning4j_tpu.eval.base import EvalJsonMixin
 
-class EvaluationCalibration:
+
+class EvaluationCalibration(EvalJsonMixin):
     def __init__(self, reliability_bins: int = 10, histogram_bins: int = 10):
         self.reliability_bins = reliability_bins
         self.histogram_bins = histogram_bins

@@ -14,8 +14,10 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.eval.base import EvalJsonMixin
 
-class ConfusionMatrix:
+
+class ConfusionMatrix(EvalJsonMixin):
     """Counts of (actual, predicted) pairs (ref: eval/ConfusionMatrix.java)."""
 
     def __init__(self, num_classes: int):
@@ -50,7 +52,7 @@ def _flatten_time(labels: np.ndarray, preds: np.ndarray, mask):
     return labels, preds, mask
 
 
-class Evaluation:
+class Evaluation(EvalJsonMixin):
     """Multiclass classification metrics (ref: eval/Evaluation.java)."""
 
     def __init__(self, num_classes: Optional[int] = None,
@@ -166,7 +168,7 @@ class Evaluation:
         return "\n".join(lines)
 
 
-class RegressionEvaluation:
+class RegressionEvaluation(EvalJsonMixin):
     """Per-column regression metrics (ref: eval/RegressionEvaluation.java):
     MSE, MAE, RMSE, RSE, correlation, R^2."""
 

@@ -12,6 +12,8 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from deeplearning4j_tpu.eval.base import EvalJsonMixin
+
 
 def _auc_from_scores(labels: np.ndarray, scores: np.ndarray) -> float:
     """Exact ROC AUC via the rank statistic."""
@@ -40,7 +42,7 @@ def _auc_from_scores(labels: np.ndarray, scores: np.ndarray) -> float:
     return float(auc)
 
 
-class ROC:
+class ROC(EvalJsonMixin):
     """Binary ROC: single-column probabilities or 2-column softmax
     (ref: eval/ROC.java)."""
 
@@ -98,7 +100,7 @@ class ROC:
         return float(np.trapezoid(precision, recall))
 
 
-class ROCBinary:
+class ROCBinary(EvalJsonMixin):
     """Per-output-column binary ROC (ref: eval/ROCBinary.java)."""
 
     def __init__(self):
@@ -117,7 +119,7 @@ class ROCBinary:
         return self._rocs[col].calculate_auc()
 
 
-class ROCMultiClass:
+class ROCMultiClass(EvalJsonMixin):
     """One-vs-all ROC per class (ref: eval/ROCMultiClass.java)."""
 
     def __init__(self):

@@ -13,6 +13,8 @@ from __future__ import annotations
 import logging
 import math
 import os
+import struct
+import zlib
 from typing import List, Optional
 
 import numpy as np
@@ -20,6 +22,25 @@ import numpy as np
 from deeplearning4j_tpu.optimize.listeners import TrainingListener
 
 log = logging.getLogger(__name__)
+
+
+def encode_png_gray(arr: np.ndarray) -> bytes:
+    """Minimal 8-bit grayscale PNG encoder (stdlib only — the HTTP
+    activations tab must not depend on the optional [viz] PIL extra)."""
+    a = np.asarray(arr, np.uint8)
+    if a.ndim != 2:
+        raise ValueError(f"expected [H,W] grayscale, got {a.shape}")
+    h, w = a.shape
+    # each scanline prefixed by filter byte 0 (None)
+    raw = b"".join(b"\x00" + a[r].tobytes() for r in range(h))
+
+    def chunk(tag: bytes, data: bytes) -> bytes:
+        return (struct.pack(">I", len(data)) + tag + data
+                + struct.pack(">I", zlib.crc32(tag + data) & 0xFFFFFFFF))
+
+    ihdr = struct.pack(">IIBBBBB", w, h, 8, 0, 0, 0, 0)  # 8-bit gray
+    return (b"\x89PNG\r\n\x1a\n" + chunk(b"IHDR", ihdr)
+            + chunk(b"IDAT", zlib.compress(raw, 6)) + chunk(b"IEND", b""))
 
 
 def tile_activations(act: np.ndarray, pad: int = 1,
@@ -49,18 +70,26 @@ def tile_activations(act: np.ndarray, pad: int = 1,
 
 
 class ConvolutionalIterationListener(TrainingListener):
-    """Write per-conv-layer activation grids every ``frequency`` iterations
-    (PNG files under ``output_dir``, named it<iter>_layer<i>.png)."""
+    """Publish per-conv-layer activation grids every ``frequency``
+    iterations — as PNG files under ``output_dir`` and/or to a UIServer's
+    /activations tab (ref: ConvolutionalIterationListener.java writes the
+    image, ConvolutionalListenerModule.java:47 serves it)."""
 
     # networks stash the current batch only when a listener asks for it
     needs_batch_features = True
 
-    def __init__(self, output_dir: str, frequency: int = 10,
-                 max_channels: int = 64):
+    def __init__(self, output_dir: Optional[str] = None, frequency: int = 10,
+                 max_channels: int = 64, ui_server=None,
+                 session_id: str = "conv-activations"):
+        if output_dir is None and ui_server is None:
+            raise ValueError("need output_dir and/or ui_server")
         self.output_dir = output_dir
         self.frequency = max(1, frequency)
         self.max_channels = max_channels
-        os.makedirs(output_dir, exist_ok=True)
+        self.ui_server = ui_server
+        self.session_id = session_id
+        if output_dir is not None:
+            os.makedirs(output_dir, exist_ok=True)
         self._warned = False
 
     def iteration_done(self, model, iteration: int, score: float):
@@ -70,12 +99,18 @@ class ConvolutionalIterationListener(TrainingListener):
         if x is None:
             return
         try:
-            from PIL import Image  # optional dep ([viz] extra)
             acts = self._conv_activations(model, np.asarray(x)[:1])
-            for li, act in acts:
-                grid = tile_activations(act, max_channels=self.max_channels)
-                Image.fromarray(grid, mode="L").save(os.path.join(
-                    self.output_dir, f"it{iteration}_layer{li}.png"))
+            grids = [(li, tile_activations(a, max_channels=self.max_channels))
+                     for li, a in acts]
+            if self.output_dir is not None:
+                for li, grid in grids:
+                    with open(os.path.join(
+                            self.output_dir,
+                            f"it{iteration}_layer{li}.png"), "wb") as f:
+                        f.write(encode_png_gray(grid))
+            if self.ui_server is not None:
+                self.ui_server.publish_activations(self.session_id,
+                                                   iteration, grids)
         except Exception as e:  # noqa: BLE001 - visualization must not kill fit
             if not self._warned:  # surface the reason once, then go quiet
                 log.warning("ConvolutionalIterationListener disabled: %s", e)

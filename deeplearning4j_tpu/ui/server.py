@@ -26,6 +26,65 @@ from deeplearning4j_tpu.ui.storage import StatsStorage
 
 log = logging.getLogger(__name__)
 
+
+def _num(v):
+    """Lenient float coercion — reports/histograms may come from untrusted
+    remote POSTs, and one malformed value must not kill a whole route."""
+    try:
+        return None if v is None else float(v)
+    except (TypeError, ValueError):
+        return None
+
+
+def _int(v, default: int = 0) -> int:
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        return default
+
+
+_CHART_JS = """
+// shared canvas plotting for all tabs (served at /chart.js)
+function drawSeries(cv, series){
+  const ctx = cv.getContext('2d');
+  ctx.clearRect(0,0,cv.width,cv.height);
+  let xs=[], ys=[];
+  series.forEach(s=>{s.pts.forEach(p=>{xs.push(p[0]); ys.push(p[1]);});});
+  if(!xs.length) return;
+  const xmin=Math.min(...xs), xmax=Math.max(...xs,xmin+1);
+  const ymin=Math.min(...ys), ymax=Math.max(...ys,ymin+1e-12);
+  const X=x=>40+(x-xmin)/(xmax-xmin)*(cv.width-60);
+  const Y=y=>cv.height-25-(y-ymin)/(ymax-ymin)*(cv.height-45);
+  ctx.strokeStyle='#999';ctx.strokeRect(40,20,cv.width-60,cv.height-45);
+  ctx.fillStyle='#333';ctx.font='11px sans-serif';
+  ctx.fillText(ymax.toPrecision(4),2,25);
+  ctx.fillText(ymin.toPrecision(4),2,cv.height-25);
+  ctx.fillText(String(xmax),cv.width-40,cv.height-8);
+  const colors=['#1976d2','#e53935','#43a047','#fb8c00','#8e24aa','#00897b'];
+  series.forEach((s,i)=>{
+    ctx.strokeStyle=colors[i%colors.length];ctx.beginPath();
+    s.pts.forEach((p,j)=>{j?ctx.lineTo(X(p[0]),Y(p[1])):ctx.moveTo(X(p[0]),Y(p[1]))});
+    ctx.stroke();
+    ctx.fillStyle=colors[i%colors.length];
+    ctx.fillText(s.name,50+i*150,14);
+  });
+}
+function drawHist(cv, bins, counts){
+  const ctx=cv.getContext('2d');ctx.clearRect(0,0,cv.width,cv.height);
+  if(!counts||!counts.length)return;
+  const cmax=Math.max(...counts,1);
+  const bw=(cv.width-60)/counts.length;
+  ctx.fillStyle='#1976d2';
+  counts.forEach((c,i)=>{
+    const h=c/cmax*(cv.height-45);
+    ctx.fillRect(40+i*bw,cv.height-25-h,bw-1,h);
+  });
+  ctx.fillStyle='#333';ctx.font='11px sans-serif';
+  ctx.fillText(bins[0].toPrecision(3),40,cv.height-8);
+  ctx.fillText(bins[bins.length-1].toPrecision(3),cv.width-60,cv.height-8);
+}
+"""
+
 _PAGE = """<!DOCTYPE html>
 <html><head><title>deeplearning4j_tpu training UI</title>
 <style>
@@ -45,31 +104,8 @@ td,th{border:1px solid #ddd;padding:4px 8px}
 <canvas id="pmm" class="chart" width="900" height="260"></canvas>
 <h2>Performance</h2>
 <table id="perf"></table>
+<script src="/chart.js"></script>
 <script>
-function drawSeries(cv, series, labels){
-  const ctx = cv.getContext('2d');
-  ctx.clearRect(0,0,cv.width,cv.height);
-  let xs=[], ys=[];
-  series.forEach(s=>{s.pts.forEach(p=>{xs.push(p[0]); ys.push(p[1]);});});
-  if(!xs.length) return;
-  const xmin=Math.min(...xs), xmax=Math.max(...xs,xmin+1);
-  const ymin=Math.min(...ys), ymax=Math.max(...ys,ymin+1e-9);
-  const X=x=>40+(x-xmin)/(xmax-xmin)*(cv.width-60);
-  const Y=y=>cv.height-25-(y-ymin)/(ymax-ymin)*(cv.height-45);
-  ctx.strokeStyle='#999';ctx.strokeRect(40,20,cv.width-60,cv.height-45);
-  ctx.fillStyle='#333';ctx.font='11px sans-serif';
-  ctx.fillText(ymax.toPrecision(4),2,25);
-  ctx.fillText(ymin.toPrecision(4),2,cv.height-25);
-  ctx.fillText(String(xmax),cv.width-40,cv.height-8);
-  const colors=['#1976d2','#e53935','#43a047','#fb8c00','#8e24aa','#00897b'];
-  series.forEach((s,i)=>{
-    ctx.strokeStyle=colors[i%colors.length];ctx.beginPath();
-    s.pts.forEach((p,j)=>{j?ctx.lineTo(X(p[0]),Y(p[1])):ctx.moveTo(X(p[0]),Y(p[1]))});
-    ctx.stroke();
-    ctx.fillStyle=colors[i%colors.length];
-    ctx.fillText(s.name,50+i*150,14);
-  });
-}
 async function refresh(){
   const sessions = await (await fetch('/train/sessions')).json();
   if(!sessions.length) return;
@@ -93,6 +129,128 @@ async function refresh(){
     const th=document.createElement('th'); th.textContent=h;
     hdr.appendChild(th);
     row.insertCell().textContent=(v==null)?'-':String(v);
+  });
+}
+refresh(); setInterval(refresh, 3000);
+</script></body></html>
+"""
+
+_MODEL_PAGE = """<!DOCTYPE html>
+<html><head><title>model — deeplearning4j_tpu UI</title>
+<style>
+body{font-family:sans-serif;margin:20px;background:#fafafa}
+h1{font-size:20px} h2{font-size:16px;margin-top:24px}
+.chart{border:1px solid #ccc;background:#fff;margin:8px 0}
+#meta{color:#555;font-size:13px}
+select{margin:8px 0}
+</style></head>
+<body>
+<h1>Model — per-layer parameters</h1>
+<div id="meta"></div>
+<select id="layer"></select>
+<h2>Mean magnitudes vs iteration</h2>
+<canvas id="mm" class="chart" width="900" height="260"></canvas>
+<h2>Parameter histogram (latest)</h2>
+<canvas id="hist" class="chart" width="900" height="260"></canvas>
+<script src="/chart.js"></script>
+<script>
+let currentLayer=null;
+async function refresh(){
+  const sessions=await (await fetch('/train/sessions')).json();
+  if(!sessions.length)return;
+  const sid=sessions[sessions.length-1];
+  const layers=await (await fetch('/train/model/layers?sid='+
+                      encodeURIComponent(sid))).json();
+  const sel=document.getElementById('layer');
+  if(sel.options.length!=layers.length){
+    sel.replaceChildren();
+    layers.forEach(l=>{const o=document.createElement('option');
+      o.value=l;o.textContent=l;sel.appendChild(o);});
+    sel.onchange=()=>{currentLayer=sel.value;refresh();};
+  }
+  const layer=currentLayer||layers[0];
+  if(!layer)return;
+  const d=await (await fetch('/train/model/data/'+
+      encodeURIComponent(layer)+'?sid='+encodeURIComponent(sid))).json();
+  document.getElementById('meta').textContent=
+    'session '+sid+' — layer '+layer;
+  drawSeries(document.getElementById('mm'),
+    Object.entries(d.meanMagnitudes).map(([k,v])=>({name:k,pts:v})));
+  const hk=Object.keys(d.histograms);
+  if(hk.length){const h=d.histograms[hk[0]];
+    drawHist(document.getElementById('hist'),h.bins,h.counts);}
+}
+refresh(); setInterval(refresh, 3000);
+</script></body></html>
+"""
+
+_SYSTEM_PAGE = """<!DOCTYPE html>
+<html><head><title>system — deeplearning4j_tpu UI</title>
+<style>
+body{font-family:sans-serif;margin:20px;background:#fafafa}
+h1{font-size:20px} h2{font-size:16px;margin-top:24px}
+.chart{border:1px solid #ccc;background:#fff;margin:8px 0}
+table{border-collapse:collapse;font-size:13px}
+td,th{border:1px solid #ddd;padding:4px 8px}
+</style></head>
+<body>
+<h1>System</h1>
+<h2>Memory RSS (MB) vs iteration</h2>
+<canvas id="mem" class="chart" width="900" height="220"></canvas>
+<h2>Iteration time (ms)</h2>
+<canvas id="it" class="chart" width="900" height="220"></canvas>
+<h2>Software / hardware</h2>
+<table id="sw"></table>
+<script src="/chart.js"></script>
+<script>
+async function refresh(){
+  const sessions=await (await fetch('/train/sessions')).json();
+  if(!sessions.length)return;
+  const sid=sessions[sessions.length-1];
+  const d=await (await fetch('/train/system/data?sid='+
+                  encodeURIComponent(sid))).json();
+  drawSeries(document.getElementById('mem'),
+    [{name:'rss',pts:d.memory}]);
+  drawSeries(document.getElementById('it'),
+    [{name:'iter ms',pts:d.iterationTimesMs}]);
+  const t=document.getElementById('sw');t.replaceChildren();
+  Object.entries(d.software).forEach(([k,v])=>{
+    const r=t.insertRow();
+    const th=document.createElement('th');th.textContent=k;
+    r.appendChild(th);r.insertCell().textContent=String(v);
+  });
+}
+refresh(); setInterval(refresh, 3000);
+</script></body></html>
+"""
+
+_ACTIVATIONS_PAGE = """<!DOCTYPE html>
+<html><head><title>activations — deeplearning4j_tpu UI</title>
+<style>
+body{font-family:sans-serif;margin:20px;background:#fafafa}
+h1{font-size:20px} #meta{color:#555;font-size:13px}
+img{border:1px solid #ccc;background:#fff;margin:8px;image-rendering:
+pixelated}
+</style></head>
+<body>
+<h1>Convolutional activations</h1>
+<div id="meta"></div>
+<div id="grids"></div>
+<script>
+async function refresh(){
+  const d=await (await fetch('/activations/data')).json();
+  if(!d.sessions.length){document.getElementById('meta').textContent=
+    'no activations published yet';return;}
+  const sid=d.sessions[d.sessions.length-1];
+  const info=d.info[sid];
+  document.getElementById('meta').textContent=
+    'session '+sid+' — iteration '+info.iteration;
+  const g=document.getElementById('grids');g.replaceChildren();
+  info.layers.forEach(l=>{
+    const img=document.createElement('img');
+    img.src='/activations/img?sid='+encodeURIComponent(sid)+
+            '&layer='+l+'&it='+info.iteration;
+    g.appendChild(img);
   });
 }
 refresh(); setInterval(refresh, 3000);
@@ -153,15 +311,25 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _html(self, page: str):
+        body = page.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):
         storages: List[StatsStorage] = self.server.storages
         path, _, query = self.path.partition("?")
         params = {k: v[0] for k, v in
                   urllib.parse.parse_qs(query).items()}
         if path in ("/", "/train", "/train/overview.html"):
-            body = _PAGE.encode()
+            return self._html(_PAGE)
+        if path == "/chart.js":
+            body = _CHART_JS.encode()
             self.send_response(200)
-            self.send_header("Content-Type", "text/html")
+            self.send_header("Content-Type", "application/javascript")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -174,6 +342,72 @@ class _Handler(BaseHTTPRequestHandler):
             if sid is None:
                 return self._json({"error": "sid required"}, 400)
             return self._json(self._overview(storages, sid))
+        # model tab (ref: TrainModule.java:98-104 — /train/model,
+        # /train/model/data/:layerId, /train/model/graph)
+        if path in ("/train/model", "/train/model/"):
+            return self._html(_MODEL_PAGE)
+        if path == "/train/model/layers":
+            sid = params.get("sid")
+            if sid is None:
+                return self._json({"error": "sid required"}, 400)
+            return self._json(self._layer_ids(storages, sid))
+        if path.startswith("/train/model/data"):
+            sid = params.get("sid")
+            if sid is None:
+                return self._json({"error": "sid required"}, 400)
+            layer_id = urllib.parse.unquote(
+                path[len("/train/model/data"):].lstrip("/"))
+            layer_id = params.get("layerId", layer_id)
+            return self._json(self._model_data(storages, sid, layer_id))
+        # system tab (ref: TrainModule.java:105-116 — /train/system,
+        # /train/system/data)
+        if path in ("/train/system", "/train/system/"):
+            return self._html(_SYSTEM_PAGE)
+        if path == "/train/system/data":
+            sid = params.get("sid")
+            if sid is None:
+                return self._json({"error": "sid required"}, 400)
+            return self._json(self._system_data(storages, sid))
+        # evaluation results stored via the router (eval/serde round-trip)
+        if path == "/train/evaluations":
+            sid = params.get("sid")
+            if sid is None:
+                return self._json({"error": "sid required"}, 400)
+            out = []
+            for st in storages:
+                try:
+                    out.extend(st.get_evaluations(sid))
+                except NotImplementedError:
+                    pass
+            return self._json(out)
+        # conv-activations tab (ref: ConvolutionalListenerModule.java:47 —
+        # /activations serves the latest tiled grids)
+        if path in ("/activations", "/activations/"):
+            return self._html(_ACTIVATIONS_PAGE)
+        if path == "/activations/data":
+            # snapshot: the fit thread may insert sessions mid-iteration
+            acts = dict(self.server.activation_sessions)
+            return self._json({
+                "sessions": sorted(acts),
+                "info": {sid: {"iteration": a["iteration"],
+                               "layers": sorted(a["pngs"])}
+                         for sid, a in acts.items()}})
+        if path == "/activations/img":
+            sid = params.get("sid")
+            a = self.server.activation_sessions.get(sid)
+            try:
+                layer = int(params.get("layer", -1))
+            except ValueError:
+                layer = -1
+            png = (a or {}).get("pngs", {}).get(layer)
+            if png is None:
+                return self._json({"error": "no such activation"}, 404)
+            self.send_response(200)
+            self.send_header("Content-Type", "image/png")
+            self.send_header("Content-Length", str(len(png)))
+            self.end_headers()
+            self.wfile.write(png)
+            return
         # t-SNE module (ref: ui/module/tsne/TsneModule.java — upload +
         # per-session coordinate plots)
         if path in ("/tsne", "/tsne/"):
@@ -233,11 +467,102 @@ class _Handler(BaseHTTPRequestHandler):
                                         dict(payload["data"]))
             elif kind == "update":
                 storage.put_update(StatsReport.from_dict(payload["data"]))
+            elif kind == "evaluation":
+                # eval/serde JSON rides the same remote route and is
+                # reloadable via GET /train/evaluations + eval_from_dict
+                storage.put_evaluation(str(payload["sessionId"]),
+                                       dict(payload["data"]))
             else:
                 return self._json({"error": f"unknown type {kind!r}"}, 400)
         except (KeyError, TypeError, ValueError) as e:
             return self._json({"error": f"malformed payload: {e}"}, 400)
         self._json({"status": "ok"})
+
+    @staticmethod
+    def _updates(storages: List[StatsStorage], sid: str) -> List[StatsReport]:
+        updates: List[StatsReport] = []
+        for st in storages:
+            updates.extend(st.get_all_updates(sid))
+        updates.sort(key=lambda r: r.iteration)
+        return updates
+
+    @classmethod
+    def _layer_ids(cls, storages, sid) -> List[str]:
+        """Top-level param-tree groups ("layer0", "layer1", ...) seen in any
+        report — the :layerId values of the model tab."""
+        layers = set()
+        for r in cls._updates(storages, sid):
+            for k in list(r.param_mean_magnitudes) + \
+                    list(r.param_histograms):
+                layers.add(str(k).split(".", 1)[0])
+        return sorted(layers)
+
+    @classmethod
+    def _model_data(cls, storages, sid, layer_id: str) -> dict:
+        """Per-layer time series + latest histograms (ref:
+        TrainModule.getModelData :~400 — mean magnitude chart, activations,
+        learning rates, param histograms per layer)."""
+        def match(name: str) -> bool:
+            return not layer_id or name == layer_id or \
+                str(name).startswith(layer_id + ".")
+
+        mm: dict = {}
+        umm: dict = {}
+        hists: dict = {}
+        for r in cls._updates(storages, sid):
+            for k, v in r.param_mean_magnitudes.items():
+                if match(str(k)):
+                    mm.setdefault(str(k), []).append(
+                        [_int(r.iteration), _num(v)])
+            for k, v in r.update_mean_magnitudes.items():
+                if match(str(k)):
+                    umm.setdefault(str(k), []).append(
+                        [_int(r.iteration), _num(v)])
+            for k, h in r.param_histograms.items():
+                if match(str(k)) and isinstance(h, dict):
+                    hists[str(k)] = {          # latest wins
+                        "iteration": _int(r.iteration),
+                        "bins": [_num(b) for b in h.get("bins", [])],
+                        "counts": [_int(c) for c in h.get("counts", [])]}
+        return {"sessionId": sid, "layerId": layer_id,
+                "meanMagnitudes": mm, "updateMeanMagnitudes": umm,
+                "histograms": hists}
+
+    @classmethod
+    def _system_data(cls, storages, sid) -> dict:
+        """Memory/timing series + software info (ref: TrainModule
+        /train/system/data — JVM memory, hardware, software tables)."""
+        mem, itms, sps = [], [], []
+        for r in cls._updates(storages, sid):
+            it = _int(r.iteration)
+            if r.memory_rss_mb is not None:
+                mem.append([it, _num(r.memory_rss_mb)])
+            if r.iteration_time_ms is not None:
+                itms.append([it, _num(r.iteration_time_ms)])
+            if r.samples_per_sec is not None:
+                sps.append([it, _num(r.samples_per_sec)])
+        import platform as _platform
+
+        import jax as _jax
+        import numpy as _np
+        software = {"python": _platform.python_version(),
+                    "jax": _jax.__version__,
+                    "numpy": _np.__version__,
+                    "platform": _platform.platform()}
+        try:
+            # device info only if a backend is ALREADY initialized —
+            # default_backend() would otherwise block initializing one
+            # (hangs when the TPU tunnel is down), and a UI route must
+            # never be the thing that first touches the accelerator
+            from jax._src import xla_bridge as _xb
+            if getattr(_xb, "_backends", None):
+                software["backend"] = _jax.default_backend()
+                software["deviceCount"] = _jax.device_count()
+        except Exception:  # noqa: BLE001 — info row is best-effort
+            pass
+        return {"sessionId": sid, "memory": mem,
+                "iterationTimesMs": itms, "samplesPerSec": sps,
+                "software": software}
 
     @staticmethod
     def _overview(storages: List[StatsStorage], sid: str) -> dict:
@@ -248,26 +573,20 @@ class _Handler(BaseHTTPRequestHandler):
             updates.extend(st.get_all_updates(sid))
         updates.sort(key=lambda r: r.iteration)
 
-        def num(v):  # reports may come from untrusted remote POSTs
-            try:
-                return None if v is None else float(v)
-            except (TypeError, ValueError):
-                return None
-
         pmm: dict = {}
         for r in updates:
             for k, v in r.param_mean_magnitudes.items():
-                pmm.setdefault(str(k), []).append([int(r.iteration), num(v)])
+                pmm.setdefault(str(k), []).append([_int(r.iteration), _num(v)])
         last = updates[-1] if updates else None
         return {
             "sessionId": sid,
             "modelClass": str((static or {}).get("modelClass") or "")[:200],
-            "numParams": num((static or {}).get("numParams")),
-            "scores": [[int(r.iteration), num(r.score)] for r in updates],
+            "numParams": _num((static or {}).get("numParams")),
+            "scores": [[_int(r.iteration), _num(r.score)] for r in updates],
             "paramMeanMagnitudes": pmm,
-            "lastIteration": int(last.iteration) if last else None,
-            "lastIterTimeMs": num(last.iteration_time_ms) if last else None,
-            "memoryRssMb": num(last.memory_rss_mb) if last else None,
+            "lastIteration": _int(last.iteration) if last else None,
+            "lastIterTimeMs": _num(last.iteration_time_ms) if last else None,
+            "memoryRssMb": _num(last.memory_rss_mb) if last else None,
         }
 
 
@@ -282,6 +601,7 @@ class UIServer:
         self._httpd.storages = []
         self._httpd.remote_enabled = False
         self._httpd.tsne_sessions = {}
+        self._httpd.activation_sessions = {}
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
@@ -317,6 +637,17 @@ class UIServer:
         if data["labels"] is not None and len(data["labels"]) != len(c):
             raise ValueError("labels/coords length mismatch")
         self._httpd.tsne_sessions[session_id] = data
+
+    def publish_activations(self, session_id: str, iteration: int,
+                            grids) -> None:
+        """Publish conv activation grids to the /activations tab (ref:
+        ConvolutionalListenerModule.java:47). `grids` is a list of
+        (layer_index, [H,W] uint8 array); the latest iteration replaces the
+        previous one, like the reference's single-image tab."""
+        from deeplearning4j_tpu.ui.convolutional import encode_png_gray
+        pngs = {int(li): encode_png_gray(g) for li, g in grids}
+        self._httpd.activation_sessions[session_id] = {
+            "iteration": int(iteration), "pngs": pngs}
 
     def enable_remote_listener(self, storage: Optional[StatsStorage] = None):
         """ref: UIServer.enableRemoteListener — POSTs to /remoteReceive land
@@ -372,6 +703,12 @@ class RemoteUIStatsStorageRouter(StatsStorage):
     def put_update(self, report: StatsReport):
         self._post({"type": "update", "data": report.to_dict()})
 
+    def put_evaluation(self, session_id, eval_dict):
+        """POST an eval/serde dict to the remote UI; reload it with
+        GET /train/evaluations + eval_from_dict."""
+        self._post({"type": "evaluation", "sessionId": session_id,
+                    "data": eval_dict})
+
     # remote router is write-only (ref: RemoteUIStatsStorageRouter is a
     # StatsStorageRouter, not a StatsStorage)
     def list_session_ids(self):
@@ -381,4 +718,7 @@ class RemoteUIStatsStorageRouter(StatsStorage):
         return None
 
     def get_all_updates(self, session_id):
+        return []
+
+    def get_evaluations(self, session_id):
         return []

@@ -41,6 +41,15 @@ class StatsStorage:
         ups = self.get_all_updates(session_id)
         return ups[-1] if ups else None
 
+    # evaluation results ride the same storage/router chain (ref: the
+    # reference persists eval JSON via eval/serde + stats storage)
+    def put_evaluation(self, session_id: str,
+                       eval_dict: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def get_evaluations(self, session_id: str) -> List[Dict[str, Any]]:
+        raise NotImplementedError
+
     # listener registration (ref: StatsStorage.registerStatsStorageListener)
     def register_listener(self, cb: Callable[[str], None]) -> None:
         if not hasattr(self, "_listeners"):
@@ -61,6 +70,7 @@ class InMemoryStatsStorage(StatsStorage):
     def __init__(self):
         self._static: Dict[str, Dict[str, Any]] = {}
         self._updates: Dict[str, List[StatsReport]] = defaultdict(list)
+        self._evals: Dict[str, List[Dict[str, Any]]] = defaultdict(list)
         self._lock = threading.Lock()
 
     def put_static_info(self, session_id, info):
@@ -86,6 +96,15 @@ class InMemoryStatsStorage(StatsStorage):
         with self._lock:
             return list(self._updates.get(session_id, []))
 
+    def put_evaluation(self, session_id, eval_dict):
+        with self._lock:
+            self._evals[session_id].append(dict(eval_dict))
+        self._notify(session_id)
+
+    def get_evaluations(self, session_id):
+        with self._lock:
+            return list(self._evals.get(session_id, []))
+
 
 class FileStatsStorage(StatsStorage):
     """SQLite-backed storage (ref: ui/storage/FileStatsStorage.java /
@@ -106,6 +125,9 @@ class FileStatsStorage(StatsStorage):
             self._conn.execute(
                 "CREATE INDEX IF NOT EXISTS idx_updates ON updates "
                 "(session_id, iteration)")
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS evaluations "
+                "(session_id TEXT, seq INTEGER, json TEXT)")
             self._conn.commit()
 
     def put_static_info(self, session_id, info):
@@ -145,6 +167,24 @@ class FileStatsStorage(StatsStorage):
                 "SELECT json FROM updates WHERE session_id=? "
                 "ORDER BY iteration", (session_id,)).fetchall()
         return [StatsReport.from_dict(json.loads(r[0])) for r in rows]
+
+    def put_evaluation(self, session_id, eval_dict):
+        with self._lock:
+            (n,) = self._conn.execute(
+                "SELECT COUNT(*) FROM evaluations WHERE session_id=?",
+                (session_id,)).fetchone()
+            self._conn.execute(
+                "INSERT INTO evaluations VALUES (?, ?, ?)",
+                (session_id, n, json.dumps(eval_dict)))
+            self._conn.commit()
+        self._notify(session_id)
+
+    def get_evaluations(self, session_id):
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT json FROM evaluations WHERE session_id=? "
+                "ORDER BY seq", (session_id,)).fetchall()
+        return [json.loads(r[0]) for r in rows]
 
     def close(self):
         with self._lock:

@@ -1,0 +1,160 @@
+"""Evaluation JSON serde (VERDICT r3 missing #3).
+
+ref: deeplearning4j-nn eval/serde/ (ROCSerializer, ROCArraySerializer,
+ConfusionMatrixSerializer/Deserializer) + BaseEvaluation.toJson/fromJson
+round-trip tests (EvalJsonTest patterns).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.eval import (
+    ConfusionMatrix, Evaluation, EvaluationBinary, EvaluationCalibration,
+    RegressionEvaluation, ROC, ROCBinary, ROCMultiClass, eval_from_dict,
+    eval_from_json, eval_to_json,
+)
+
+RNG = np.random.default_rng(42)
+
+
+def _cls_data(n=120, c=3):
+    y = np.eye(c)[RNG.integers(0, c, n)]
+    probs = np.abs(y * 0.6 + RNG.random((n, c)) * 0.4)
+    probs /= probs.sum(1, keepdims=True)
+    return y, probs
+
+
+class TestRoundTrips:
+    def test_confusion_matrix(self):
+        cm = ConfusionMatrix(3)
+        cm.add(0, 0, 5)
+        cm.add(0, 1, 2)
+        cm.add(2, 2, 7)
+        r = ConfusionMatrix.from_json(cm.to_json())
+        np.testing.assert_array_equal(r.matrix, cm.matrix)
+        assert r.num_classes == 3
+
+    def test_evaluation(self):
+        y, probs = _cls_data()
+        e = Evaluation(labels=["ant", "bee", "cat"], top_n=2)
+        e.eval(y, probs)
+        r = Evaluation.from_json(e.to_json())
+        assert r.accuracy() == e.accuracy()
+        assert r.precision() == e.precision()
+        assert r.recall() == e.recall()
+        assert r.f1() == e.f1()
+        assert r.top_n_accuracy() == e.top_n_accuracy()
+        assert r.label_names == ["ant", "bee", "cat"]
+        np.testing.assert_array_equal(r.confusion.matrix, e.confusion.matrix)
+        # reloaded object keeps accumulating
+        r.eval(y, probs)
+        assert r.confusion.matrix.sum() == 2 * e.confusion.matrix.sum()
+
+    def test_evaluation_empty(self):
+        e = Evaluation()
+        r = Evaluation.from_json(e.to_json())
+        assert r.confusion is None and r.num_classes is None
+
+    def test_regression(self):
+        reg = RegressionEvaluation()
+        y = RNG.standard_normal((50, 4))
+        p = y + 0.1 * RNG.standard_normal((50, 4))
+        reg.eval(y, p)
+        r = RegressionEvaluation.from_json(reg.to_json())
+        for col in range(4):
+            assert r.mean_squared_error(col) == reg.mean_squared_error(col)
+            assert r.mean_absolute_error(col) == reg.mean_absolute_error(col)
+            assert r.correlation_r2(col) == reg.correlation_r2(col)
+            assert r.r_squared(col) == reg.r_squared(col)
+
+    def test_roc_exact_state(self):
+        roc = ROC()
+        y, probs = _cls_data(c=2)
+        roc.eval(y, probs)
+        d = json.loads(roc.to_json())
+        # headline numbers stored up front like ROCSerializer.java
+        assert d["auc"] == pytest.approx(roc.calculate_auc())
+        assert d["auprc"] == pytest.approx(roc.calculate_auprc())
+        r = ROC.from_json(roc.to_json())
+        assert r.calculate_auc() == roc.calculate_auc()
+        assert r.calculate_auprc() == roc.calculate_auprc()
+        t1, f1_, p1 = roc.get_roc_curve()
+        t2, f2, p2 = r.get_roc_curve()
+        np.testing.assert_array_equal(t1, t2)
+        np.testing.assert_array_equal(f1_, f2)
+        np.testing.assert_array_equal(p1, p2)
+
+    def test_roc_binary_and_multiclass(self):
+        y, probs = _cls_data()
+        rb = ROCBinary()
+        rb.eval(y, probs)
+        r = ROCBinary.from_json(rb.to_json())
+        for c in range(3):
+            assert r.calculate_auc(c) == rb.calculate_auc(c)
+        rm = ROCMultiClass()
+        rm.eval(y, probs)
+        r2 = ROCMultiClass.from_json(rm.to_json())
+        assert r2.calculate_average_auc() == rm.calculate_average_auc()
+
+    def test_evaluation_binary(self):
+        eb = EvaluationBinary(decision_threshold=0.4)
+        y = (RNG.random((40, 3)) > 0.5).astype(float)
+        p = np.clip(y * 0.7 + RNG.random((40, 3)) * 0.3, 0, 1)
+        eb.eval(y, p)
+        r = EvaluationBinary.from_json(eb.to_json())
+        assert r.threshold == 0.4
+        for c in range(3):
+            assert r.accuracy(c) == eb.accuracy(c)
+            assert r.f1(c) == eb.f1(c)
+
+    def test_calibration(self):
+        ec = EvaluationCalibration(reliability_bins=8)
+        y, probs = _cls_data()
+        ec.eval(y, probs)
+        r = EvaluationCalibration.from_json(ec.to_json())
+        assert r.expected_calibration_error(1) == \
+            ec.expected_calibration_error(1)
+        a1, b1 = ec.reliability_diagram(0)
+        a2, b2 = r.reliability_diagram(0)
+        np.testing.assert_array_equal(a1, a2)
+        np.testing.assert_array_equal(b1, b2)
+
+    def test_wrong_class_raises(self):
+        e = Evaluation(2)
+        with pytest.raises(TypeError):
+            ROC.from_json(e.to_json())
+
+    def test_unknown_class_raises(self):
+        with pytest.raises(ValueError):
+            eval_from_json('{"@class": "Nope"}')
+
+
+class TestFixturePinned:
+    """Format-drift guard: a committed v1 fixture must keep parsing with
+    identical metrics (the bar regression-format fixtures set elsewhere)."""
+
+    FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                           "eval_serde_v1.json")
+
+    def test_fixture_parses_with_pinned_metrics(self):
+        with open(self.FIXTURE) as f:
+            fix = json.load(f)
+        ev = eval_from_dict(fix["evaluation"])
+        assert isinstance(ev, Evaluation)
+        assert ev.accuracy() == pytest.approx(fix["expected"]["accuracy"])
+        assert ev.f1() == pytest.approx(fix["expected"]["f1"])
+        roc = eval_from_dict(fix["roc"])
+        assert roc.calculate_auc() == pytest.approx(fix["expected"]["auc"])
+        reg = eval_from_dict(fix["regression"])
+        assert reg.mean_squared_error(0) == pytest.approx(
+            fix["expected"]["mse0"])
+
+    def test_fixture_reserializes_identically(self):
+        with open(self.FIXTURE) as f:
+            fix = json.load(f)
+        for key in ("evaluation", "roc", "regression"):
+            obj = eval_from_dict(fix[key])
+            assert json.loads(eval_to_json(obj)) == fix[key]
