@@ -97,6 +97,15 @@ _ZH_BUCKETS = (
     (2000, "满意 失望 后悔 骄傲 自豪 惭愧 感激 同情 信任 尊重 热情 冷淡 温柔 严肃 幽默 可爱 可怕 可惜 危险 安全"),
     # internet / daily modern life
     (1600, "微信 短信 邮箱 搜索 浏览 充电 信号 蓝牙 耳机 键盘 鼠标 打印 复印 扫描 截图 保存 删除 备份 恢复 设置"),
+    # round-4 expansion: verb bands (motion / transfer / perception)
+    (4800, "拿 放 给 送 带 搬 推 拉 抬 扔 捡 抱 背 提 挂 摆 递 装 卸 藏"),
+    (3800, "看见 听见 看到 听到 见到 遇到 碰到 找到 拿到 学到 想到 感到 受到 达到 做到 办到 赶到 轮到 提到 谈到"),
+    (3200, "出去 进来 出来 进去 回来 回去 上来 上去 下来 下去 过来 过去 起来 醒来 站起来 坐下 躺下 留下 剩下 落下"),
+    (2600, "打破 打断 打败 打碎 切断 折断 撕开 拆开 打包 包装 挖 埋 铺 砌 钉 锯 磨 擦 抹 刷"),
+    # verb-complement / resultative bands (segmentation stress cases)
+    (2400, "看完 吃完 做完 写完 说完 用完 听懂 看懂 读懂 学会 抓紧 抓住 停住 站住 愣住 吃饱 喝醉 睡着 累坏 吓坏"),
+    # psychological / communication verbs
+    (2800, "商量 考虑 分析 打听 询问 回忆 反思 反省 思考 琢磨 估计 预测 推测 假设 证明 否认 承认 强调 声明 宣布"),
 )
 
 ZH_FREQ = {}
@@ -368,3 +377,68 @@ for _dict_form, _freq in _JA_I_ADJECTIVES:
         _f = max(100, int(_freq * _ADJ_FORM_WEIGHTS[_form]))
         if _surface not in JA_ENTRIES or JA_ENTRIES[_surface][0] < _f:
             JA_ENTRIES[_surface] = (_f, "形容詞")
+
+
+# --- Japanese na-adjective surfaces (round-4 expansion) ----------------
+#
+# IPADIC lists 形容動詞 stems plus their copula-fused surfaces; the
+# generator emits the productive paradigm for the curated stems already
+# in the 形容動詞 band plus a round-4 extension list: 元気な / 元気に /
+# 元気だ / 元気だった / 元気じゃない / 元気です / 元気でした.
+
+_JA_NA_ADJECTIVES = (
+    ("元気", 3000), ("静か", 2500), ("有名", 2500), ("便利", 2500),
+    ("大変", 3000), ("大切", 2500), ("簡単", 2500), ("綺麗", 2500),
+    ("親切", 2000), ("丁寧", 1800), ("好き", 4000), ("嫌い", 2000),
+    ("上手", 2200), ("下手", 1500), ("必要", 2800),
+    # round-4 extension stems
+    ("大丈夫", 3000), ("無理", 2200), ("自由", 2000), ("特別", 2000),
+    ("普通", 2200), ("安全", 1800), ("危険", 1500), ("健康", 1800),
+    ("幸せ", 2000), ("残念", 1800), ("失礼", 1800), ("真面目", 1500),
+    ("熱心", 1200), ("複雑", 1500), ("十分", 1800), ("不便", 1200),
+    ("暇", 1500), ("楽", 2000), ("確か", 2000), ("変", 1800),
+)
+
+_NA_FORMS = {
+    "な": 0.8, "に": 0.6, "だ": 0.5, "だった": 0.35, "では": 0.2,
+    "じゃない": 0.3, "じゃなかった": 0.12, "です": 0.55, "でした": 0.3,
+}
+
+for _stem, _freq in _JA_NA_ADJECTIVES:
+    if _stem not in JA_ENTRIES or JA_ENTRIES[_stem][0] < _freq:
+        JA_ENTRIES[_stem] = (_freq, "形容動詞")
+    for _suffix, _w in _NA_FORMS.items():
+        _f = max(100, int(_freq * _w))
+        _surface = _stem + _suffix
+        if _surface not in JA_ENTRIES or JA_ENTRIES[_surface][0] < _f:
+            JA_ENTRIES[_surface] = (_f, "形容動詞")
+
+
+# --- Japanese counter surfaces (round-4 expansion) ---------------------
+#
+# IPADIC enumerates number+counter compounds as 名詞(数); the generator
+# crosses the numerals 1-10 (+ 何 "how many") with the everyday counter
+# suffixes. Frequencies decay with the numeral (1-3 dominate corpora)
+# and by counter band. Readings/sound changes (一本=いっぽん) are a
+# pronunciation concern; segmentation needs only the surfaces.
+
+_JA_COUNTER_NUMS = (
+    ("一", 1.0), ("二", 0.8), ("三", 0.7), ("四", 0.5), ("五", 0.5),
+    ("六", 0.35), ("七", 0.35), ("八", 0.35), ("九", 0.3), ("十", 0.45),
+    ("何", 0.6),
+)
+
+_JA_COUNTERS = (
+    ("人", 4000), ("つ", 3500), ("年", 3500), ("月", 3000), ("日", 3000),
+    ("時", 3000), ("分", 2800), ("円", 3000), ("個", 2500), ("本", 2500),
+    ("枚", 2200), ("冊", 1800), ("台", 2000), ("匹", 1800), ("回", 2800),
+    ("階", 2000), ("歳", 2200), ("番", 2200), ("杯", 1800), ("度", 2000),
+    ("秒", 1500), ("週間", 2000), ("ヶ月", 2000), ("時間", 2800),
+)
+
+for _num, _nw in _JA_COUNTER_NUMS:
+    for _ctr, _cf in _JA_COUNTERS:
+        _surface = _num + _ctr
+        _f = max(100, int(_cf * _nw))
+        if _surface not in JA_ENTRIES or JA_ENTRIES[_surface][0] < _f:
+            JA_ENTRIES[_surface] = (_f, "名詞")

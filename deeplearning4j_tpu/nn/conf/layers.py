@@ -49,17 +49,25 @@ BATCHED_STREAM_KEYS = frozenset({"h", "c", "kv_k", "kv_v", "kv_mask"})
 def reorder_stream_state(net, indices) -> None:
     """Gather the batch dimension of every carried streaming-state array
     (beam-search pruning: surviving beam b continues from parent
-    indices[b]'s caches/RNN state). `indices`: int array [new_batch]."""
+    indices[b]'s caches/RNN state). `indices`: int array [new_batch].
+    kv_pos is normally a batch-independent scalar, but a per-row rewind
+    (rewind_stream_state with an array) promotes it to [N] — gathered
+    here like the caches so reordering keeps each row's own position."""
     idx = jnp.asarray(indices)
     for name, s in net.state.items():
         if not isinstance(s, dict):
             continue
         net.state[name] = {
-            kk: (vv[idx] if kk in BATCHED_STREAM_KEYS else vv)
+            kk: (vv[idx] if kk in BATCHED_STREAM_KEYS
+                 or (kk == "kv_pos" and getattr(vv, "ndim", 0) >= 1)
+                 else vv)
             for kk, vv in s.items()}
+    rows = getattr(net, "_stream_pos_rows", None)
+    if rows is not None:         # host row-position mirror follows
+        net._stream_pos_rows = np.asarray(rows)[np.asarray(indices)]
 
 
-def rewind_stream_state(net, n: int) -> None:
+def rewind_stream_state(net, n) -> None:
     """Rewind the last `n` streamed positions (speculative-decoding
     rollback, util/decoding.speculative_sample): position counters
     (attention kv_pos, positional-embedding pos_offset) move back by n —
@@ -67,14 +75,28 @@ def rewind_stream_state(net, n: int) -> None:
     masks and are overwritten by the next write, so a rewound stream is
     exactly the stream that never saw those tokens (test-pinned).
 
+    `n` may be an int (all rows rewind together) or an int array [N]
+    (PER-ROW rewind — batched speculative decoding, where each row
+    accepts a different prefix). A per-row rewind promotes kv_pos from a
+    shared scalar to a [N] vector; the attention streaming path then
+    writes each row's next chunk at its own slots (SelfAttentionLayer.
+    _stream_attend vector-pos branch). Per-row rewind is attention-only:
+    PositionalEmbeddingLayer's pos_offset stays scalar, so nets with
+    learned positional tables reject array rewinds.
+
     Only position-indexed state can rewind: recurrent h/c carries the
     rejected steps irreversibly, so nets with streaming LSTM state
     raise. Rolling (windowed) caches additionally need
     cache_length >= window + n — a rejected write may have evicted the
     slot n positions short of the window edge."""
-    if n == 0:
+    per_row = np.ndim(n) > 0
+    if not per_row and n == 0:
         return
-    check_rewindable(net, n)
+    if per_row:
+        n = np.asarray(n, np.int32)
+        if not n.any():
+            return
+    check_rewindable(net, int(np.max(n)) if per_row else n)
     # ONE device dispatch for every counter (speculative decoding calls
     # this per round — per-counter updates would pay dispatch latency
     # once per layer per round)
@@ -84,6 +106,11 @@ def rewind_stream_state(net, n: int) -> None:
             continue
         for k in ("kv_pos", "pos_offset"):
             if k in s:
+                if per_row and k == "pos_offset":
+                    raise ValueError(
+                        "per-row rewind is attention-only: learned "
+                        "positional tables carry a shared pos_offset "
+                        "(use a rope or position-free model)")
                 refs.append((name, k))
                 vals.append(s[k])
     if refs:
@@ -92,11 +119,32 @@ def rewind_stream_state(net, n: int) -> None:
             s = dict(net.state[name])
             s[k] = v
             net.state[name] = s
-    if hasattr(net, "_stream_pos"):
-        net._stream_pos = max(0, net._stream_pos - n)
+    if per_row:
+        # exact host-side row positions: the budget counters must track
+        # max-over-rows (a min-subtraction would drift them upward and
+        # trip check_stream_budget spuriously once rows diverge; a
+        # max-subtraction would under-count and overrun the cache)
+        rows = getattr(net, "_stream_pos_rows", None)
+        if rows is None or len(rows) != len(n):
+            base = getattr(net, "_stream_pos", None)
+            if base is None:
+                pm0 = getattr(net, "_stream_pos_map", None) or {}
+                base = max(pm0.values(), default=0)
+            rows = np.full(len(n), base, np.int64)
+        new_rows = np.maximum(rows - n, 0)
+        net._stream_pos_rows = new_rows
+        n_scalar = int(rows.max()) - int(new_rows.max())
+    else:
+        n_scalar = n
+        rows = getattr(net, "_stream_pos_rows", None)
+        if rows is not None:
+            net._stream_pos_rows = np.maximum(rows - n, 0)
+    if getattr(net, "_stream_pos", None) is not None:
+        net._stream_pos = max(0, net._stream_pos - n_scalar)
     pm = getattr(net, "_stream_pos_map", None)
     if pm:
-        net._stream_pos_map = {k: max(0, v - n) for k, v in pm.items()}
+        net._stream_pos_map = {k: max(0, v - n_scalar)
+                               for k, v in pm.items()}
 
 
 @jax.jit
@@ -1110,6 +1158,8 @@ class SelfAttentionLayer(FeedForwardLayerConf):
             pos = jnp.zeros((), jnp.int32)
         else:
             vc, pos = state["kv_v"], state["kv_pos"]
+        vec = getattr(pos, "ndim", 0) >= 1    # [N] per-row positions
+        # (after a per-row rewind_stream_state — batched speculation)
         if pad_left is not None:
             if mask is not None:
                 raise ValueError("pad_left and mask are mutually "
@@ -1120,24 +1170,43 @@ class SelfAttentionLayer(FeedForwardLayerConf):
                     "streaming — packed writes would leave the carried "
                     "kv_mask unset for their slots; restart the stream "
                     "(rnn_clear_previous_state)")
+            if vec:
+                raise ValueError(
+                    "packed (pad_left) priming cannot follow a per-row "
+                    "rewind — restart the stream")
             m0 = jnp.arange(t) >= pad_left              # [T] valid flags
             cum = jnp.cumsum(m0.astype(pos.dtype))
             q_pos = pos + cum - 1                       # pads: pos-1
             n_new = cum[-1]
         else:
             m0 = None
-            q_pos = pos + jnp.arange(t, dtype=pos.dtype)
+            steps_t = jnp.arange(t, dtype=pos.dtype)
+            # [N,T] when per-row, [T] when shared
+            q_pos = pos[:, None] + steps_t if vec else pos + steps_t
             n_new = t
         if self.rope:
             abs_pos = q_pos if m0 is None else jnp.maximum(q_pos, 0)
             q = self._rope(q, abs_pos)
             k = self._rope(k, abs_pos)
         if self.window is not None:
+            if vec:
+                raise ValueError(
+                    "per-row streaming positions are not supported for "
+                    "windowed (rolling-cache) attention")
             return self._stream_attend_rolling(
                 q, k, v, state, kc, vc, pos, mask, fresh=fresh,
                 m0=m0, q_pos=q_pos, n_new=n_new)
         z = jnp.zeros((), pos.dtype)
-        if m0 is None:
+        if vec:
+            # per-row scatter at each row's own slots (advanced indexing
+            # puts the two index axes first: value is [N,T,Hkv,D]);
+            # out-of-range rows (past cache_length) drop their writes
+            bidx = jnp.arange(n)[:, None]
+            kc = kc.at[bidx, :, q_pos, :].set(
+                k.transpose(0, 2, 1, 3).astype(kc.dtype), mode="drop")
+            vc = vc.at[bidx, :, q_pos, :].set(
+                v.transpose(0, 2, 1, 3).astype(vc.dtype), mode="drop")
+        elif m0 is None:
             kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
                                               (z, z, pos, z))
             vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
@@ -1149,7 +1218,13 @@ class SelfAttentionLayer(FeedForwardLayerConf):
             kc = kc.at[:, :, slots, :].set(k.astype(kc.dtype), mode="drop")
             vc = vc.at[:, :, slots, :].set(v.astype(vc.dtype), mode="drop")
         kc, vc = _shard_cache(kc, 2), _shard_cache(vc, 2)
-        if m0 is None:
+        if vec:
+            km = self._stream_mask_update(
+                state, mask, n, t, L, fresh=fresh,
+                write=lambda km, m: km.at[jnp.arange(n)[:, None],
+                                          q_pos].set(m, mode="drop"))
+            km = _shard_cache(km, 1)
+        elif m0 is None:
             km = self._stream_mask_update(
                 state, mask, n, t, L, fresh=fresh,
                 write=lambda km, m: jax.lax.dynamic_update_slice(
@@ -1162,9 +1237,12 @@ class SelfAttentionLayer(FeedForwardLayerConf):
         # forfeit GQA's decode bandwidth win
         # query at absolute position p sees cache slots <= p
         k_idx = jnp.arange(L)
-        valid = (k_idx[None, :] <= q_pos[:, None])[None]    # [1, T, L]
+        if vec:
+            valid = k_idx[None, None, :] <= q_pos[..., None]  # [N, T, L]
+        else:
+            valid = (k_idx[None, :] <= q_pos[:, None])[None]  # [1, T, L]
         if km is not None:
-            valid = valid & km[:, None, :]                  # [N, T, L]
+            valid = valid & km[:, None, :]                    # [N, T, L]
         o = self._grouped_attend(q, kc, vc, valid)
         out = {**state, "kv_k": kc, "kv_v": vc, "kv_pos": pos + n_new}
         if km is not None:
@@ -1282,17 +1360,22 @@ class SelfAttentionLayer(FeedForwardLayerConf):
 
     def _rope(self, x, positions):
         """Rotary position embedding (RoFormer rotate-half convention):
-        x [N,H,T,D], positions [T] absolute. Pairs channel i with channel
-        i + D/2 and rotates by positions * base^(-2i/D)."""
+        x [N,H,T,D], positions [T] absolute — or [N,T] when rows carry
+        their own streaming positions (per-row rewind). Pairs channel i
+        with channel i + D/2 and rotates by positions * base^(-2i/D)."""
         d = x.shape[-1]
         if d % 2:
             raise ValueError(f"rope needs an even head dim, got {d}")
         half = d // 2
         inv = self.rope_base ** (-jnp.arange(half, dtype=jnp.float32)
                                  / half)
-        ang = positions.astype(jnp.float32)[:, None] * inv[None, :]  # [T,half]
-        cos = jnp.cos(ang)[None, None].astype(x.dtype)
-        sin = jnp.sin(ang)[None, None].astype(x.dtype)
+        ang = positions.astype(jnp.float32)[..., None] * inv  # [...,T,half]
+        if ang.ndim == 2:           # shared positions: [T,half]
+            cos = jnp.cos(ang)[None, None].astype(x.dtype)
+            sin = jnp.sin(ang)[None, None].astype(x.dtype)
+        else:                       # per-row positions: [N,T,half]
+            cos = jnp.cos(ang)[:, None].astype(x.dtype)
+            sin = jnp.sin(ang)[:, None].astype(x.dtype)
         x1, x2 = x[..., :half], x[..., half:]
         return jnp.concatenate([x1 * cos - x2 * sin,
                                 x1 * sin + x2 * cos], axis=-1)

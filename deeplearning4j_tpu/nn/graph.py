@@ -589,7 +589,13 @@ class ComputationGraph:
             outs, new_state = self._jit_cache[key](
                 self.params, self.state, ins, jax.random.PRNGKey(0), fmasks)
         self.state = new_state
+        old_max = max(getattr(self, "_stream_pos_map", {}).values(),
+                      default=0)
         self._stream_pos_map = new_pos_map
+        rows = getattr(self, "_stream_pos_rows", None)
+        if rows is not None:     # per-row positions (after per-row rewind)
+            consumed = max(new_pos_map.values(), default=0) - old_max
+            self._stream_pos_rows = rows + consumed
         return outs[0] if len(outs) == 1 else outs
 
     def _vertex_time_lengths(self, ins):
@@ -669,6 +675,7 @@ class ComputationGraph:
     def rnn_clear_previous_state(self):
         """ref: ComputationGraph.rnnClearPreviousState."""
         self._stream_pos_map = {}
+        self._stream_pos_rows = None
         for k, s in self.state.items():
             if isinstance(s, dict):
                 self.state[k] = {kk: vv for kk, vv in s.items()

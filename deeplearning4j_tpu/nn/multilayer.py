@@ -477,7 +477,12 @@ class MultiLayerNetwork:
             out, new_state = fn(self.params, self.state, x,
                                 jax.random.PRNGKey(0),
                                 None if mask is None else jnp.asarray(mask))
+        consumed = new_pos - getattr(self, "_stream_pos", 0)
         self._stream_pos = new_pos
+        rows = getattr(self, "_stream_pos_rows", None)
+        if rows is not None:     # per-row positions (after per-row rewind)
+            self._stream_pos_rows = rows + consumed
+            self._stream_pos = int(self._stream_pos_rows.max())
         self.state = new_state
         return out
 
@@ -499,6 +504,7 @@ class MultiLayerNetwork:
 
     def rnn_clear_previous_state(self):
         self._stream_pos = 0
+        self._stream_pos_rows = None
         for k, s in self.state.items():
             self.state[k] = {kk: vv for kk, vv in s.items()
                              if kk not in STREAM_STATE_KEYS}

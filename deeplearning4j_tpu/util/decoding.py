@@ -307,37 +307,8 @@ def sample_stream_batch(net, prompts, steps: int, vocab_size: int,
     stop_tokens = set(stop_tokens)
     for p in prompts:
         _check_seed(p, steps, max_length)
-    lens = [len(p) for p in prompts]
-    from deeplearning4j_tpu.nn.conf.layers import PositionalEmbeddingLayer
-    has_learned_pos = any(isinstance(l, PositionalEmbeddingLayer)
-                          for l in _stream_layers(net))
-    if len(set(lens)) > 1 and has_learned_pos:
-        raise ValueError(
-            "mixed-length batched decoding is not exact for "
-            "learned positional tables (left-pads shift the "
-            "lookups) — pad prompts to equal length, use a rope "
-            "model, or decode per prompt")
-    cap = _prime_bucket_cap(net)
-    if has_learned_pos:
-        T = max(lens)      # ANY left pad would shift the table lookups
-    else:
-        T = _width_bucket(max(lens))             # bucketed prime length
-        if cap is not None and T > cap >= max(lens):
-            T = cap
     B, V = len(prompts), vocab_size
-    Bb = _width_bucket(B)                        # bucketed batch rows
-    x = np.zeros((Bb, V, T), np.float32)
-    mask = np.zeros((Bb, T), np.float32)
-    for b, p in enumerate(prompts):
-        pad = T - len(p)
-        x[b, list(p), pad + np.arange(len(p))] = 1.0
-        mask[b, pad:] = 1.0
-    net.rnn_clear_previous_state()
-    if hasattr(net, "layers"):                   # MultiLayerNetwork
-        out = net.rnn_time_step(x, mask=mask)
-    else:                                        # ComputationGraph
-        out = net.rnn_time_step(
-            x, masks={net.conf.network_inputs[0]: mask})
+    out, T, B, Bb, cap = _batch_prime(net, prompts, V)
     ids = [list(p) for p in prompts]
     stopped = [False] * B
     done = (lambda b: stopped[b] or (max_length is not None
@@ -544,6 +515,414 @@ def speculative_sample(net, draft, seed_ids, steps: int,
         if not draft_is_fn:
             rewind_stream_state(draft, g - accepted)
     return ids[:want]
+
+
+def _batch_prime(net, prompts, vocab_size: int):
+    """Shared masked left-padded batch prime (see sample_stream_batch for
+    the exactness conditions): returns (out, T, B, Bb, cap)."""
+    lens = [len(p) for p in prompts]
+    from deeplearning4j_tpu.nn.conf.layers import PositionalEmbeddingLayer
+    has_learned_pos = any(isinstance(l, PositionalEmbeddingLayer)
+                          for l in _stream_layers(net))
+    if len(set(lens)) > 1 and has_learned_pos:
+        raise ValueError(
+            "mixed-length batched decoding is not exact for "
+            "learned positional tables (left-pads shift the "
+            "lookups) — pad prompts to equal length, use a rope "
+            "model, or decode per prompt")
+    cap = _prime_bucket_cap(net)
+    if has_learned_pos:
+        T = max(lens)      # ANY left pad would shift the table lookups
+    else:
+        T = _width_bucket(max(lens))             # bucketed prime length
+        if cap is not None and T > cap >= max(lens):
+            T = cap
+    B, V = len(prompts), vocab_size
+    Bb = _width_bucket(B)                        # bucketed batch rows
+    x = np.zeros((Bb, V, T), np.float32)
+    mask = np.zeros((Bb, T), np.float32)
+    for b, p in enumerate(prompts):
+        pad = T - len(p)
+        x[b, list(p), pad + np.arange(len(p))] = 1.0
+        mask[b, pad:] = 1.0
+    net.rnn_clear_previous_state()
+    if hasattr(net, "layers"):                   # MultiLayerNetwork
+        out = net.rnn_time_step(x, mask=mask)
+    else:                                        # ComputationGraph
+        out = net.rnn_time_step(
+            x, masks={net.conf.network_inputs[0]: mask})
+    return out, T, B, Bb, cap
+
+
+def _check_per_row_speculable(net, n: int) -> None:
+    """Entry validation for batched speculation: everything per-row
+    rewind needs, checked BEFORE any state is mutated (the fail-fast
+    spirit of speculative_sample's check_rewindable call). `n` is the
+    worst-case per-round rewind — the full uniform chunk, gamma + 1."""
+    from deeplearning4j_tpu.nn.conf.layers import (
+        PositionalEmbeddingLayer, check_rewindable,
+    )
+    check_rewindable(net, n)
+    for l in _stream_layers(net):
+        if isinstance(l, PositionalEmbeddingLayer):
+            raise ValueError(
+                "batched speculative decoding is attention-only: learned "
+                "positional tables carry a shared pos_offset that cannot "
+                "rewind per row (use a rope or position-free model)")
+        if getattr(l, "window", None) and \
+                getattr(l, "supports_streaming", False):
+            raise ValueError(
+                "batched speculative decoding does not support windowed "
+                "(rolling-cache) attention — per-row positions are not "
+                "implemented for the rolling cache write path")
+
+
+def speculative_sample_batch(net, draft, prompts, steps: int,
+                             vocab_size: int,
+                             gamma: int = 4,
+                             temperature: float = 1.0,
+                             rngs=None,
+                             max_length: Optional[int] = None,
+                             top_k: Optional[int] = None,
+                             top_p: Optional[float] = None,
+                             stop_tokens=()) -> List[List[int]]:
+    """Batched speculative decoding: every prompt speculates
+    simultaneously with PER-ROW acceptance — each round is one batched
+    draft phase plus ONE batched target verify forward, and each row
+    rewinds only its own rejected positions (rewind_stream_state with an
+    array promotes the attention kv_pos to a per-row vector; subsequent
+    cache writes land at each row's own slots). Composes the two serving
+    multipliers: speculation's (accepted+1):1 dispatch ratio × batching's
+    B rows per dispatch.
+
+    `draft` is a host proposer callable `(ids, gamma) -> proposals`
+    (e.g. prompt_lookup_proposer(); applied per row, zero dispatches) or
+    a same-vocab streaming net (model drafting: the draft streams the
+    same batch, g dispatches per round). `rngs` is one np Generator per
+    prompt (default: fresh per-row default_rng(row)); each row consumes
+    its own stream in the same order as a per-prompt speculative_sample
+    run, so with top_k=1 (greedy — every accept/replace/bonus is
+    deterministic) each row's output EQUALS its per-prompt
+    speculative_sample output for rope / position-free models
+    (test-pinned, both draft kinds). Under temperature sampling rows
+    still draw from their own rngs, but float-level batch-vs-single
+    differences can flip individual acceptance draws.
+
+    Like sample_stream_batch, rows share stream capacity from the padded
+    prompt length; per-row rewind is attention-only (LSTMs cannot
+    rewind; windowed rolling caches and learned positional tables are
+    rejected by the layer checks)."""
+    from deeplearning4j_tpu.nn.conf.layers import rewind_stream_state
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    if not prompts:
+        return []
+    for p in prompts:
+        _check_seed(p, steps, max_length)
+    B, V = len(prompts), vocab_size
+    if rngs is None:
+        rngs = [np.random.default_rng(b) for b in range(B)]
+    if len(rngs) != B:
+        raise ValueError(f"need one rng per prompt ({len(rngs)} != {B})")
+    draft_is_fn = not hasattr(draft, "rnn_time_step")
+    if draft_is_fn and not callable(draft):
+        raise TypeError("draft must be a streaming net or a callable "
+                        "(ids, gamma) -> proposals")
+    # fail fast at entry: rounds rewind up to the FULL uniform chunk
+    # (gamma + 1 — a frozen/zero-acceptance row keeps nothing), and the
+    # per-row machinery is attention-only
+    _check_per_row_speculable(net, gamma + 1)
+    if not draft_is_fn:
+        _check_per_row_speculable(draft, gamma + 1)
+
+    out_t, T, B, Bb, cap = _batch_prime(net, prompts, V)
+    if not draft_is_fn:
+        out_d, *_ = _batch_prime(draft, prompts, V)
+        q_next = [filter_probs(_probs(out_d)[b, :, -1], temperature,
+                               top_k, top_p) for b in range(B)]
+    p_next: List[Optional[np.ndarray]] = [
+        filter_probs(_probs(out_t)[b, :, -1], temperature, top_k, top_p)
+        for b in range(B)]
+
+    ids = [list(p) for p in prompts]
+    want = [len(p) + steps for p in prompts]
+    if max_length is not None:
+        want = [min(w, max_length) for w in want]
+    stop_set = set(stop_tokens)
+    done = [False] * B
+    # positions consumed per row (for the shared-capacity guard): all
+    # rows consumed T at prime; per-row rewinds subtract independently
+    row_pos = [T] * B
+    pending: List[Optional[int]] = [None] * B
+
+    def _finish(b, cut=None):
+        done[b] = True
+        if cut is not None:
+            ids[b] = ids[b][:cut]
+
+    first_round = True
+    while not all(done):
+        g = gamma
+        # --- draft proposes per row -----------------------------------
+        # row b proposes at most min(g, room_b) tokens; the verify chunk
+        # stays UNIFORM at 1+g slots (short rows pad with 0s, which sit
+        # after their real tokens — causal attention means the dummies
+        # never influence earlier positions — and are rewound)
+        proposals: List[List[int]] = [[] for _ in range(B)]
+        q_dists: List[List[np.ndarray]] = [[] for _ in range(B)]
+        room = [max(0, want[b] - len(ids[b])) for b in range(B)]
+        draft_writes = 0                    # positions the draft consumed
+        if draft_is_fn:
+            for b in range(B):
+                if done[b]:
+                    continue
+                props = [int(x) for x in draft(ids[b], min(g, room[b]))]
+                proposals[b] = props[:min(g, room[b])]
+                for d in proposals[b]:
+                    one = np.zeros(V)
+                    one[d] = 1.0
+                    q_dists[b].append(one)
+        else:
+            # rounds >= 2: one dispatch consumes every row's pending
+            # token into the draft cache (round 1 has no pendings — the
+            # prime already produced q_next)
+            if not first_round:
+                toks = np.zeros(Bb, np.int64)
+                for b in range(B):
+                    if not done[b] and pending[b] is not None:
+                        toks[b] = pending[b]
+                out_d = draft.rnn_time_step(_one_hot(toks[:, None], V))
+                draft_writes += 1
+                for b in range(B):
+                    if not done[b]:
+                        q_next[b] = filter_probs(_probs(out_d)[b, :, -1],
+                                                 temperature, top_k,
+                                                 top_p)
+            qs = list(q_next)
+            # g batched sampling dispatches advance every row together
+            for _ in range(g):
+                toks = np.zeros(Bb, np.int64)
+                for b in range(B):
+                    if done[b] or len(proposals[b]) >= min(g, room[b]):
+                        continue
+                    d = int(rngs[b].choice(V, p=qs[b]))
+                    proposals[b].append(d)
+                    q_dists[b].append(qs[b])
+                    toks[b] = d
+                out_d = draft.rnn_time_step(_one_hot(toks[:, None], V))
+                draft_writes += 1
+                for b in range(B):
+                    if not done[b]:
+                        qs[b] = filter_probs(_probs(out_d)[b, :, -1],
+                                             temperature, top_k, top_p)
+        first_round = False
+        # --- ONE batched target verify forward ------------------------
+        chunk_len = 1 + g
+        chunk = np.zeros((Bb, chunk_len), np.int64)
+        offs = np.zeros(B, np.int32)        # 1 when pending rides slot 0
+        for b in range(B):
+            if done[b]:
+                continue
+            row = ([] if pending[b] is None else [pending[b]]) + \
+                proposals[b]
+            offs[b] = 0 if pending[b] is None else 1
+            chunk[b, :len(row)] = row
+        if cap is not None and max(row_pos) + chunk_len > cap:
+            # shared stream capacity exhausted: stop everyone honestly
+            if not draft_is_fn and draft_writes:
+                rewind_stream_state(
+                    draft, np.full(Bb, draft_writes, np.int32))
+            break
+        out_t = net.rnn_time_step(_one_hot(chunk, V))
+        tp_all = _probs(out_t)               # [Bb, V, chunk_len]
+        rew = np.zeros(B, np.int32)          # target rollback per row
+        draft_keep = np.zeros(B, np.int32)   # draft slots to keep per row
+        for b in range(B):
+            if done[b]:
+                rew[b] = chunk_len           # frozen rows keep no writes
+                continue
+            row_pos[b] += chunk_len
+            tp = tp_all[b]
+            g_b = len(proposals[b])
+            off = int(offs[b])
+            if off:                          # pending consumed into cache
+                pending[b] = None
+                p_next[b] = filter_probs(tp[:, off - 1], temperature,
+                                         top_k, top_p)
+            if g_b == 0:                     # plain step from p_next
+                nxt = int(rngs[b].choice(V, p=p_next[b]))
+                ids[b].append(nxt)
+                rew[b] = chunk_len - off     # drop all proposal slots
+                if (stop_set and nxt in stop_set) or \
+                        len(ids[b]) >= want[b]:
+                    _finish(b)
+                else:
+                    pending[b] = nxt
+                    p_next[b] = None
+                continue
+            p_dists = [p_next[b]] + [
+                filter_probs(tp[:, off + i], temperature, top_k, top_p)
+                for i in range(g_b - 1)]
+            p_bonus = filter_probs(tp[:, off + g_b - 1], temperature,
+                                   top_k, top_p)
+            accepted = 0
+            replacement = None
+            for i, d in enumerate(proposals[b]):
+                p_i, q_i = p_dists[i], q_dists[b][i]
+                if rngs[b].random() < min(1.0, float(p_i[d]) /
+                                          max(float(q_i[d]), 1e-12)):
+                    accepted += 1
+                else:
+                    resid = np.maximum(p_i - q_i, 0.0)
+                    total = resid.sum()
+                    if total <= 0:
+                        resid, total = p_i, p_i.sum()
+                    replacement = int(rngs[b].choice(V, p=resid / total))
+                    break
+            base = len(ids[b])
+            ids[b].extend(proposals[b][:accepted])
+            nxt = (int(rngs[b].choice(V, p=p_bonus))
+                   if replacement is None else replacement)
+            ids[b].append(nxt)
+            rew[b] = chunk_len - off - accepted
+            draft_keep[b] = accepted
+            if stop_set:
+                cut = next((j + 1 for j in range(base, len(ids[b]))
+                            if ids[b][j] in stop_set), -1)
+                if cut >= 0:
+                    _finish(b, cut=min(cut, want[b]))
+            if not done[b] and len(ids[b]) >= want[b]:
+                ids[b] = ids[b][:want[b]]
+                _finish(b)
+            if not done[b]:
+                pending[b] = ids[b][-1]
+                p_next[b] = None
+        # --- per-row rollback (one dispatch for all counters) ---------
+        amounts = np.zeros(Bb, np.int32)
+        amounts[:B] = rew
+        amounts[B:] = chunk_len              # bucket-pad rows keep nothing
+        for b in range(B):
+            row_pos[b] -= int(rew[b])
+        rewind_stream_state(net, amounts)
+        if not draft_is_fn:
+            d_am = np.full(Bb, draft_writes, np.int32)
+            for b in range(B):
+                if not done[b] or draft_keep[b]:
+                    d_am[b] = draft_writes - int(draft_keep[b]) - \
+                        int(offs[b])
+            rewind_stream_state(draft, np.maximum(d_am, 0))
+    return ids
+
+
+def beam_search_batch(net, prompts, steps: int, vocab_size: int,
+                      beam_width: int = 4,
+                      max_length: Optional[int] = None,
+                      stop_tokens=()
+                      ) -> List[Tuple[List[int], float]]:
+    """Beam search over a BATCH of prompts: the [prompts x beams] grid
+    flattens onto the batch axis, so every decode step advances all
+    prompts' beams in ONE dispatch (per-prompt beam_search costs a
+    dispatch per prompt per step). Each prompt's search is independent —
+    per-prompt results equal beam_search (test-pinned for rope /
+    position-free models; the exactness conditions are
+    sample_stream_batch's, since priming left-pads mixed-length prompts
+    to a shared bucket). Returns [(best_sequence, log_prob)] per prompt,
+    EOS semantics matching beam_search's `stop_tokens`."""
+    if not prompts:
+        return []
+    V = vocab_size
+    for p in prompts:
+        _check_seed(p, steps, max_length)
+    stop_tokens = set(stop_tokens)
+    W = min(beam_width, V)
+    n = len(prompts)
+    out, T, _, Bb, cap = _batch_prime(net, prompts, V)
+    # expand each prompt's primed state to its own W beam rows (+ pad
+    # rows): flattened row layout is [prompt0 x Wb | prompt1 x Wb | ...]
+    Wb = _width_bucket(W)
+    expand = np.repeat(np.arange(Bb), Wb)      # [Bb*Wb]
+    reorder_stream_state(net, expand)
+    probs0 = _probs(out)                        # [Bb, V, T]
+    out = np.repeat(probs0, Wb, axis=0)         # [Bb*Wb, V, T]
+
+    beams = [[list(p) for _ in range(W)] for p in prompts]
+    scores = np.zeros((n, W))
+    alive = np.ones((n, W), bool)
+    finished: List[List[Tuple[List[int], float]]] = [[] for _ in range(n)]
+    searching = np.ones(n, bool)    # prompt-level: still extending
+    first = True
+    for i in range(steps):
+        if max_length is not None and \
+                all(len(beams[b][0]) >= max_length for b in range(n)):
+            break
+        probs = _probs(out)
+        all_parents = np.zeros((n, W), np.int64)
+        all_tokens = np.zeros((n, W), np.int64)
+        for b in range(n):
+            if not searching[b]:
+                continue
+            if max_length is not None and \
+                    len(beams[b][0]) >= max_length:
+                searching[b] = False
+                continue
+            logp = np.log(np.clip(
+                probs[b * Wb:b * Wb + W, :, -1], 1e-12, None))  # [W,V]
+            if first:
+                top = np.argsort(logp[0])[::-1][:W]
+                parents, tokens = np.zeros(W, np.int64), top
+                scores[b] = logp[0][top]
+            else:
+                total = scores[b][:, None] + logp
+                total[~alive[b]] = -np.inf
+                flat = np.argsort(total.ravel())[::-1][:W]
+                parents, tokens = np.divmod(flat, V)
+                scores[b] = total.ravel()[flat]
+            beams[b] = [beams[b][p] + [int(t)]
+                        for p, t in zip(parents, tokens)]
+            all_parents[b], all_tokens[b] = parents, tokens
+            if stop_tokens:
+                alive[b] = np.ones(W, bool)
+                for w, t in enumerate(tokens):
+                    if int(t) in stop_tokens and \
+                            np.isfinite(scores[b][w]):
+                        finished[b].append((beams[b][w],
+                                            float(scores[b][w])))
+                        alive[b][w] = False
+                if not alive[b].any():
+                    searching[b] = False
+                elif finished[b]:
+                    best_fin = max(sc for _, sc in finished[b])
+                    if scores[b][alive[b]].max() <= best_fin:
+                        searching[b] = False
+            # max_length reached AFTER this extension: stop eagerly so a
+            # fully-capped batch skips the trailing decode dispatch
+            if searching[b] and max_length is not None and \
+                    len(beams[b][0]) >= max_length:
+                searching[b] = False
+        first = False
+        if not searching.any():
+            break
+        if i + 1 < steps:
+            if cap is not None and T + i + 1 > cap:
+                break
+            # flattened gather: prompt b's parents live at rows b*Wb+.
+            pp = np.arange(Bb * Wb, dtype=np.int64)
+            tok = np.zeros(Bb * Wb, np.int64)
+            for b in range(n):
+                pp[b * Wb:b * Wb + W] = b * Wb + all_parents[b]
+                tok[b * Wb:b * Wb + W] = all_tokens[b]
+            if not np.array_equal(pp, np.arange(Bb * Wb)):
+                reorder_stream_state(net, pp)
+            out = net.rnn_time_step(_one_hot(tok[:, None], V))
+    results = []
+    for b in range(n):
+        live = [(beams[b][w], float(scores[b][w])) for w in range(W)
+                if alive[b][w] and np.isfinite(scores[b][w])]
+        pool = finished[b] if finished[b] else live
+        if not pool:
+            pool = [(beams[b][w], float(scores[b][w])) for w in range(W)]
+        results.append(max(pool, key=lambda bs: bs[1]))
+    return results
 
 
 def beam_search(net, seed_ids, steps: int, vocab_size: int,

@@ -190,6 +190,40 @@ class TextGenerationTransformer(ZooModel):
                                   prime_padded=prime_padded,
                                   stop_tokens=stop_tokens)
 
+    def speculative_sample_batch(self, net, draft, prompts, steps: int,
+                                 gamma: int = 4, vocab_size: int = None,
+                                 rngs=None, temperature: float = 1.0,
+                                 top_k: int = None, top_p: float = None,
+                                 stop_tokens=()):
+        """Batched speculative decoding with per-row acceptance (shared
+        implementation util/decoding.speculative_sample_batch): one
+        batched verify dispatch serves every prompt's speculation round,
+        each row rewinding only its own rejections. top_k=1 reproduces
+        per-prompt speculative_sample exactly. Needs rope/position-free
+        attention (per-row rewind is attention-only)."""
+        from deeplearning4j_tpu.util.decoding import speculative_sample_batch
+        return speculative_sample_batch(net, draft, prompts, steps,
+                                        vocab_size or self.vocab_size,
+                                        gamma=gamma, rngs=rngs,
+                                        temperature=temperature,
+                                        max_length=self.max_length,
+                                        top_k=top_k, top_p=top_p,
+                                        stop_tokens=stop_tokens)
+
+    def beam_search_batch(self, net, prompts, steps: int,
+                          beam_width: int = 4, vocab_size: int = None,
+                          stop_tokens=()):
+        """Beam search over a batch of prompts — the [prompts x beams]
+        grid rides the batch axis, one dispatch per step for the whole
+        batch (shared implementation util/decoding.beam_search_batch).
+        Returns [(best_sequence, log_prob)] per prompt."""
+        from deeplearning4j_tpu.util.decoding import beam_search_batch
+        return beam_search_batch(net, prompts, steps,
+                                 vocab_size or self.vocab_size,
+                                 beam_width=beam_width,
+                                 max_length=self.max_length,
+                                 stop_tokens=stop_tokens)
+
     def beam_search(self, net, seed_ids, steps: int, beam_width: int = 4,
                     vocab_size: int = None, prime_padded: bool = False,
                     stop_tokens=()):

@@ -1,0 +1,121 @@
+"""HLO-level assertions for the sharded decode paths (VERDICT r3 task 7).
+
+Round 3 verified sharded KV-cache decode and windowed-ring attention for
+correctness only, leaving XLA free to pick any collective schedule. These
+tests pin the schedule itself on the virtual 8-device mesh: the compiled
+sharded-cache decode step moves NO full cache across devices (no
+all-gather of the cache), and the windowed ring emits exactly the
+ppermutes its _ring_steps_needed bound allows — nothing beyond.
+"""
+
+import re
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.parallel.sequence import (
+    _ring_steps_needed, ring_attention,
+)
+from deeplearning4j_tpu.zoo import TextGenerationTransformer
+
+
+def _mesh():
+    devs = np.array(jax.devices()[:8]).reshape(8)
+    return Mesh(devs, ("data",))
+
+
+class TestWindowedRingPermutes:
+    def test_window_truncates_ppermutes_exactly(self):
+        """A window needing `steps` ring hops lowers to exactly
+        2*(steps-1) collective permutes (k and v per hop, none after the
+        last visited chunk) — O(W) traffic per device, statically."""
+        mesh = _mesh()
+        B, H, T, D = 1, 2, 64, 8
+        q = np.zeros((B, H, T, D), np.float32)
+        for W, in ((10,), (17,), (4,)):
+            steps = _ring_steps_needed(8, T // 8, W)
+            f = jax.jit(lambda a, b, c, W=W: ring_attention(
+                a, b, c, mesh, causal=True, window=W, use_flash=False))
+            low = f.lower(q, q, q)
+            n_stablehlo = low.as_text().count("collective_permute")
+            assert n_stablehlo == 2 * (steps - 1), \
+                f"window {W}: {n_stablehlo} permutes, steps {steps}"
+            # the compiled module keeps the same static count (no
+            # permute re-introduced by the partitioner)
+            n_compiled = low.compile().as_text().count("collective-permute(")
+            assert n_compiled == 2 * (steps - 1), \
+                f"window {W} compiled: {n_compiled}"
+
+    def test_full_ring_uses_rolled_loop(self):
+        """Unwindowed causal ring: one rolled loop body with its 2
+        ppermutes (not n unrolled copies) — the instruction count stays
+        constant in n while the loop trip count covers the ring."""
+        mesh = _mesh()
+        q = np.zeros((1, 2, 64, 8), np.float32)
+        f = jax.jit(lambda a, b, c: ring_attention(a, b, c, mesh,
+                                                   causal=True,
+                                                   use_flash=False))
+        s = f.lower(q, q, q).as_text()
+        assert s.count("collective_permute") == 2
+        assert "while" in s    # the rolled fori_loop survives lowering
+
+
+class TestShardedCacheDecode:
+    #: distinctive cache length (divisible by 8, unlikely to collide with
+    #: any other tensor dim in the tiny decode net)
+    CACHE = 160
+
+    def _compiled_decode_step(self):
+        mesh = _mesh()
+        model = TextGenerationTransformer(
+            vocab_size=16, embed_dim=16, n_heads=2, n_layers=1,
+            max_length=self.CACHE, seed=0)
+        net = model.init()
+        net.set_stream_cache_sharding(mesh, "data")
+        try:
+            V = 16
+            x = np.zeros((1, V, 4), np.float32)
+            x[0, [1, 2, 3, 4], np.arange(4)] = 1.0
+            net.rnn_time_step(x)
+            x1 = np.zeros((1, V, 1), np.float32)
+            x1[0, 5, 0] = 1.0
+            net.rnn_time_step(x1)          # trace the decode-step shape
+            fn = next(f for k, f in net._jit_cache.items()
+                      if k[0] == "rnn_step")
+            low = fn.lower(net.params, net.state,
+                           net._as_input_dict([jax.numpy.asarray(x1)]),
+                           jax.random.PRNGKey(0), net._as_mask_dict(None))
+            return low.compile().as_text()
+        finally:
+            net.set_stream_cache_sharding(None)
+
+    def test_no_all_gather_of_the_cache(self):
+        """The compiled per-token decode step never all-gathers the
+        sharded KV cache: the cache write and the cache attention stay
+        partitioned (per-device traffic O(L/n), the point of sharding)."""
+        txt = self._compiled_decode_step()
+        gathers = [l.strip() for l in txt.splitlines() if "all-gather" in l]
+        # strongest current pin: the step compiles with NO all-gather at
+        # all; if a future lowering legitimately gathers something tiny,
+        # the cache-shape check below is the invariant that must hold
+        cache_shaped = [l for l in gathers
+                        if re.search(rf"\b{self.CACHE}\b", l)]
+        assert not cache_shaped, \
+            f"cache-sized all-gather in decode step: {cache_shaped[:3]}"
+        assert not gathers, \
+            f"unexpected all-gathers in decode step: {gathers[:3]}"
+
+    def test_cache_state_is_sharded_output(self):
+        """The carried cache stays sharded across steps: the compiled
+        module's kv cache outputs keep a non-replicated sharding (the
+        partitioner did not fall back to replication)."""
+        txt = self._compiled_decode_step()
+        # GSPMD-partitioned module: per-device cache buffers are L/8 =
+        # CACHE/8 slots; the full-cache length must not appear as a
+        # parameter/result dimension of the entry computation
+        per_dev = self.CACHE // 8
+        assert re.search(rf"\b{per_dev}\b", txt), \
+            "no per-device cache shard dimension found — cache not " \
+            "partitioned"
