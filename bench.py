@@ -120,10 +120,19 @@ def main():
 
         # NHWC internal layout: profile-driven (see PERF.md) — BN stat
         # reductions and channel work are lane-aligned, ~9% over NCHW.
+        # BENCH_FUSE: 0 unfused (default/best-known), 1 bn→act→conv plan,
+        # 2 full fused-bottleneck Pallas chain (nn/layers/bottleneck.py)
+        fuse_env = os.environ.get("BENCH_FUSE", "0")
+        fuse_levels = {"0": False, "1": True,
+                       "2": "bottleneck", "bottleneck": "bottleneck"}
+        if fuse_env not in fuse_levels:
+            raise ValueError(f"BENCH_FUSE={fuse_env!r}: expected 0, 1, 2 "
+                             "or 'bottleneck'")
+        fuse = fuse_levels[fuse_env]
         model = ResNet50(num_classes=CLASSES, height=IMAGE, width=IMAGE,
                          updater=Nesterovs(0.1, momentum=0.9),
                          data_format=os.environ.get("BENCH_FORMAT", "NHWC"),
-                         fuse=os.environ.get("BENCH_FUSE", "0") == "1")
+                         fuse=fuse)
         net = model.init()
         net.conf.dtype = "bfloat16"  # MXU path, fp32 master params + accum
 

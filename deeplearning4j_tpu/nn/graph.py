@@ -62,13 +62,22 @@ class ComputationGraph:
     # nn/layers/fused.py — params/state stay keyed by the original vertex
     # names, so serialization/import/transfer are unaffected)
     # ------------------------------------------------------------------
-    def set_fusion(self, enabled: bool = True):
-        """Toggle the fused bn→act→1×1-conv execution plan. Changes how
-        eligible chains execute, not what they compute (equivalence is
-        test-pinned); jitted steps are rebuilt."""
+    def set_fusion(self, enabled=True):
+        """Select the fused execution plan: False (unfused — the
+        measured-best default), True (bn→act→1×1-conv groups,
+        nn/layers/fused.py), or "bottleneck" (whole identity-bottleneck
+        chains through the Pallas kernel cascade,
+        nn/layers/bottleneck.py). Changes how eligible chains execute,
+        not what they compute (equivalence is test-pinned); jitted steps
+        are rebuilt."""
+        if enabled not in (False, True, "bottleneck"):
+            raise ValueError(
+                f"unknown fusion level {enabled!r}: expected False, True "
+                "or 'bottleneck'")
         if enabled != self.fuse_bn_act_conv:
             self.fuse_bn_act_conv = enabled
             self._jit_cache.clear()
+            self._fusion_cache = None
         return self
 
     def _fusion(self):
@@ -84,26 +93,15 @@ class ComputationGraph:
         not a network output, and the prologue activation is relu or
         identity (the Pallas kernel's fast set)."""
         if not self.fuse_bn_act_conv:
-            return {}, {}
+            return {}, {}, {}
         if self._fusion_cache is not None:
+            return self._fusion_cache
+        if self.fuse_bn_act_conv == "bottleneck":
+            self._fusion_cache = ({}, *self._bottleneck_fusion())
             return self._fusion_cache
         from deeplearning4j_tpu.nn.conf.layers import (
             ActivationLayer, BatchNormalization, ConvolutionLayer)
-        self._infer_types()
-        consumers: Dict[str, List[str]] = {}
-        for cname, srcs in self.conf.vertex_inputs.items():
-            for s in srcs:
-                consumers.setdefault(s, []).append(cname)
-        outputs = set(self.conf.network_outputs)
-
-        def layer_of(n, cls):
-            v = self.conf.vertices.get(n)
-            if (not isinstance(v, LayerVertex) or v.preprocessor is not None
-                    or n in outputs):
-                return None
-            l = v.layer
-            return l if type(l) is cls and not l.dropout else None
-
+        consumers, layer_of = self._fusion_graph_view()
         plan: Dict[str, Tuple[str, str, str]] = {}
         skip: Dict[str, str] = {}
         for bn_name in self._topo:
@@ -143,8 +141,165 @@ class ComputationGraph:
             skip[bn_name] = nxt
             if act_vertex is not None:
                 skip[act_vertex] = nxt
-        self._fusion_cache = (plan, skip)
+        self._fusion_cache = (plan, skip, {})
         return self._fusion_cache
+
+    def _fusion_graph_view(self):
+        """Shared matcher scaffolding for the fusion plans: the
+        (consumers map, layer_of helper) both pattern matchers walk.
+        layer_of(n, cls) returns the vertex n's layer iff it is a plain
+        LayerVertex of exactly `cls` with no preprocessor/dropout and is
+        not a network output — anything else is ineligible for fusion."""
+        self._infer_types()
+        consumers: Dict[str, List[str]] = {}
+        for cname, srcs in self.conf.vertex_inputs.items():
+            for s in srcs:
+                consumers.setdefault(s, []).append(cname)
+        outputs = set(self.conf.network_outputs)
+
+        def layer_of(n, cls):
+            v = self.conf.vertices.get(n)
+            if (not isinstance(v, LayerVertex) or v.preprocessor is not None
+                    or n in outputs):
+                return None
+            l = v.layer
+            return l if type(l) is cls and not l.dropout else None
+
+        return consumers, layer_of
+
+    def _bottleneck_fusion(self):
+        """(skip, bplan) for fuse level "bottleneck": bplan maps the
+        final relu vertex of each IDENTITY bottleneck (conv1x1→bn→relu→
+        conv3x3→bn→relu→conv1x1→bn→add(x)→relu, all stride 1, identity
+        skip, NHWC) to its vertex group; skip maps every absorbed
+        intermediate to that output vertex. Anything unmatched — entry
+        blocks, other strides/layouts — runs unfused
+        (nn/layers/bottleneck.py holds the kernels + eligibility
+        rationale)."""
+        from deeplearning4j_tpu.nn.conf.graph_conf import ElementWiseVertex
+        from deeplearning4j_tpu.nn.conf.layers import (
+            ActivationLayer, BatchNormalization, ConvolutionLayer)
+        from deeplearning4j_tpu.nn.layers.bottleneck import (
+            fused_bottleneck_supported)
+        consumers, layer_of = self._fusion_graph_view()
+        outputs = set(self.conf.network_outputs)
+
+        def sole_consumer(n):
+            c = consumers.get(n, [])
+            return c[0] if len(c) == 1 else None
+
+        def chain_next(n):
+            """The one consumer of n, which must also have n as its ONE
+            input (a second input would make the unfused vertex read a
+            different xs[0] than the fused chain convolves). The residual
+            add is the only legitimately multi-input consumer and is
+            checked explicitly below."""
+            c = sole_consumer(n)
+            if c is None or self.conf.vertex_inputs.get(c, []) != [n]:
+                return None
+            return c
+
+        def conv_ok(l, kernel, padding):
+            return (l is not None and tuple(l.kernel) == kernel
+                    and tuple(l.stride) == (1, 1)
+                    and tuple(l.padding) == padding
+                    and tuple(l.dilation) == (1, 1)
+                    and not l.has_bias
+                    and l.activation in (None, "identity")
+                    and l.data_format == "NHWC")
+
+        def walk_bn_act(name):
+            """name is a conv; its single consumer must be bn (+ relu
+            act vertex or bn relu activation). Returns (bn, act_vertex,
+            following vertex) or None."""
+            bn_name = chain_next(name)
+            bn = bn_name and layer_of(bn_name, BatchNormalization)
+            if bn is None or \
+                    len(self.conf.vertex_inputs.get(bn_name, [])) != 1:
+                return None
+            nxt = chain_next(bn_name)
+            if nxt is None:
+                return None
+            act = bn.activation or "identity"
+            act_vertex = None
+            al = layer_of(nxt, ActivationLayer)
+            if al is not None and act == "identity":
+                act_vertex, act = nxt, al.activation
+                nxt = chain_next(act_vertex)
+            if act != "relu" or nxt is None:
+                return None
+            return bn_name, act_vertex, nxt
+
+        bplan: Dict[str, Dict[str, str]] = {}
+        skip: Dict[str, str] = {}
+        for ca_name in self._topo:
+            conv_a = layer_of(ca_name, ConvolutionLayer)
+            if not conv_ok(conv_a, (1, 1), (0, 0)):
+                continue
+            srcs = self.conf.vertex_inputs.get(ca_name, [])
+            if len(srcs) != 1:
+                continue
+            src = srcs[0]
+            it = self._vertex_input_types[ca_name][0]
+            if it.kind != "cnn":
+                continue
+            w1 = walk_bn_act(ca_name)
+            if w1 is None:
+                continue
+            bn_a, act_a, cb_name = w1
+            conv_b = layer_of(cb_name, ConvolutionLayer)
+            if not conv_ok(conv_b, (3, 3), (1, 1)):
+                continue
+            w2 = walk_bn_act(cb_name)
+            if w2 is None:
+                continue
+            bn_b, act_b, cc_name = w2
+            conv_c = layer_of(cc_name, ConvolutionLayer)
+            if not conv_ok(conv_c, (1, 1), (0, 0)):
+                continue
+            bn_c_name = chain_next(cc_name)
+            bn_c = bn_c_name and layer_of(bn_c_name, BatchNormalization)
+            if bn_c is None or (bn_c.activation or "identity") != "identity":
+                continue
+            add_name = sole_consumer(bn_c_name)
+            addv = add_name and self.conf.vertices.get(add_name)
+            if (not isinstance(addv, ElementWiseVertex)
+                    or addv.op.lower() != "add" or add_name in outputs):
+                continue
+            add_ins = self.conf.vertex_inputs.get(add_name, [])
+            if sorted(add_ins) != sorted([bn_c_name, src]):
+                continue                       # skip path must be identity
+            out_name = chain_next(add_name)
+            out_act = out_name and layer_of(out_name, ActivationLayer)
+            if out_act is None or out_act.activation != "relu":
+                continue
+            bns = [self.conf.vertices[n].layer
+                   for n in (bn_a, bn_b, bn_c_name)]
+            if len({(b.eps, b.decay) for b in bns}) != 1:
+                continue
+            if len({b.data_format for b in bns} | {"NHWC"}) != 1:
+                continue
+            # runtime-shape VMEM gate from the statically inferred types
+            if not fused_bottleneck_supported(
+                    (1, it.height, it.width, it.channels),
+                    conv_a.n_out, conv_c.n_out, self.conf.dtype or
+                    "float32"):
+                continue
+            group = {"src": src, "conv_a": ca_name, "bn_a": bn_a,
+                     "conv_b": cb_name, "bn_b": bn_b, "conv_c": cc_name,
+                     "bn_c": bn_c_name, "add": add_name}
+            members = [ca_name, bn_a, cb_name, bn_b, cc_name, bn_c_name,
+                       add_name]
+            if act_a:
+                members.append(act_a)
+            if act_b:
+                members.append(act_b)
+            if any(m in skip for m in members):
+                continue
+            bplan[out_name] = group
+            for m in members:
+                skip[m] = out_name
+        return skip, bplan
 
     # ------------------------------------------------------------------
     def _infer_types(self) -> Dict[str, InputType]:
@@ -221,7 +376,7 @@ class ComputationGraph:
         # memory; output() / rnn_time_step cast final activations back
         # to f32 (f32_head)
         params, inputs = self._cast_compute(params, inputs)
-        fused_plan, fused_skip = self._fusion()
+        fused_plan, fused_skip, bneck_plan = self._fusion()
         acts: Dict[str, Any] = dict(inputs)
         masks: Dict[str, Any] = dict(fmasks or {})
         if pad is not None:
@@ -245,6 +400,13 @@ class ComputationGraph:
                 bn_name, p_act, src = fused_plan[name]
                 self._apply_fused(name, bn_name, p_act, acts[src], params,
                                   state, new_state, acts, train=train)
+                masks[name] = v.output_mask(
+                    in_masks, self._vertex_input_types[name])
+                continue
+            if name in bneck_plan:
+                self._apply_fused_bottleneck(
+                    name, bneck_plan[name], params, state, new_state,
+                    acts, train=train)
                 masks[name] = v.output_mask(
                     in_masks, self._vertex_input_types[name])
                 continue
@@ -309,6 +471,63 @@ class ComputationGraph:
         new_state[bn_name] = ({"mean": new_mean, "var": new_var}
                               if train else bn_state)
         new_state[conv_name] = state.get(conv_name, {})
+
+    def _apply_fused_bottleneck(self, out_name, group, params, state,
+                                new_state, acts, *, train):
+        """Execute one fused identity-bottleneck group (see
+        nn/layers/bottleneck.py): reads the block input activation,
+        writes the final relu output into acts[out_name] and each BN's
+        running stats into new_state; params/state stay keyed by the
+        original vertex names (serialization/import unaffected)."""
+        from deeplearning4j_tpu.nn.layers.bottleneck import (
+            BnParams, fused_bottleneck)
+        x = acts[group["src"]]
+
+        def bn_params(bn_name):
+            bn = self.conf.vertices[bn_name].layer
+            p = params.get(bn_name, {})
+            s = state.get(bn_name, {})
+            nf = s["mean"].shape[0]
+            gamma = p.get("gamma", jnp.full((nf,), bn.gamma, x.dtype))
+            beta = p.get("beta", jnp.full((nf,), bn.beta, x.dtype))
+            # quantize through x.dtype exactly like the unfused
+            # BatchNormalization.apply (fused.py precision-chain note):
+            # the persistent running stats must round identically under
+            # bf16 or the two execution plans train diverging state
+            return bn, BnParams(
+                gamma=gamma.astype(x.dtype),
+                beta=beta.astype(x.dtype),
+                running_mean=s["mean"].astype(x.dtype)
+                .astype(jnp.float32),
+                running_var=s["var"].astype(x.dtype)
+                .astype(jnp.float32))
+
+        bn_a, pa = bn_params(group["bn_a"])
+        bn_b, pb = bn_params(group["bn_b"])
+        bn_c, pc = bn_params(group["bn_c"])
+        wa4 = params[group["conv_a"]]["W"]        # [O, I, 1, 1]
+        wb4 = params[group["conv_b"]]["W"]        # [O, I, 3, 3]
+        wc4 = params[group["conv_c"]]["W"]
+        wa = wa4.reshape(wa4.shape[0], wa4.shape[1]).T
+        wc = wc4.reshape(wc4.shape[0], wc4.shape[1]).T
+        # tap-major [9, Cin, Cout]: tap t = kh*3+kw matches the kernel's
+        # shifted-window order (cross-correlation, like lax.conv)
+        wb = wb4.transpose(2, 3, 1, 0).reshape(9, wb4.shape[1],
+                                               wb4.shape[0])
+        out, new_stats = fused_bottleneck(
+            x, wa, pa, wb, pb, wc, pc, train=train, eps=bn_a.eps,
+            decay=bn_a.decay,
+            interpret=jax.default_backend() != "tpu")
+        acts[out_name] = out
+        # absorbed members already got pass-through state from the
+        # fused_skip branch; only the trained BN stats and the output
+        # vertex are written here
+        if train:
+            mua, vara, mub, varb, muc, varc = new_stats
+            new_state[group["bn_a"]] = {"mean": mua, "var": vara}
+            new_state[group["bn_b"]] = {"mean": mub, "var": varb}
+            new_state[group["bn_c"]] = {"mean": muc, "var": varc}
+        new_state[out_name] = state.get(out_name, {})
 
     def _as_mask_dict(self, masks, default_key=None) -> Optional[Dict[str, Any]]:
         """Normalize a masks argument: a dict maps vertex name -> mask

@@ -65,14 +65,17 @@ class ZooModel:
 
     def _maybe_fuse(self, net):
         """Apply the model's fuse kwarg to a freshly built/restored net
-        (graphs only — restore paths must honor it too)."""
-        if self.kwargs.get("fuse", False):
+        (graphs only — restore paths must honor it too). fuse=True
+        selects the bn→act→conv plan, fuse="bottleneck" the full
+        fused-bottleneck plan (nn/layers/bottleneck.py)."""
+        level = self.kwargs.get("fuse", False)
+        if level:
             if not hasattr(net, "set_fusion"):
                 raise ValueError(
-                    f"{type(self).__name__}: fuse=True needs a "
+                    f"{type(self).__name__}: fuse={level!r} needs a "
                     "ComputationGraph model (restored checkpoint is a "
                     f"{type(net).__name__})")
-            net.set_fusion(True)
+            net.set_fusion(level)
         return net
 
     def init_pretrained(self, flavor: str = "imagenet",

@@ -61,7 +61,7 @@ def _data():
 class TestFusionPlan:
     def test_bottleneck_chain_detected(self):
         net = ComputationGraph(_bottleneck_graph()).init().set_fusion(True)
-        plan, skip = net._fusion()
+        plan, skip, _ = net._fusion()
         assert set(plan) == {"c2"}
         assert plan["c2"] == ("bn1", "relu", "c1")
         assert set(skip) == {"bn1", "act1"}
@@ -86,7 +86,7 @@ class TestFusionPlan:
                                               activation="softmax"), "pool")
                 .set_outputs("out").build())
         net = ComputationGraph(conf).init().set_fusion(True)
-        plan, skip = net._fusion()
+        plan, skip, _ = net._fusion()
         assert plan == {} and skip == {}
 
     def test_non_1x1_conv_not_fused(self):
@@ -106,7 +106,7 @@ class TestFusionPlan:
                                               activation="softmax"), "pool")
                 .set_outputs("out").build())
         net = ComputationGraph(conf).init().set_fusion(True)
-        plan, _ = net._fusion()
+        plan, _, _ = net._fusion()
         assert plan == {}
 
     def test_bn_own_activation_chain_detected(self):
@@ -127,7 +127,7 @@ class TestFusionPlan:
                                               activation="softmax"), "pool")
                 .set_outputs("out").build())
         net = ComputationGraph(conf).init().set_fusion(True)
-        plan, skip = net._fusion()
+        plan, skip, _ = net._fusion()
         assert set(plan) == {"c2"} and plan["c2"][1] == "relu"
         assert set(skip) == {"bn1"}
 
@@ -135,7 +135,7 @@ class TestFusionPlan:
         from deeplearning4j_tpu.zoo import ResNet50
         net = ResNet50(num_classes=10, height=64, width=64,
                        fuse=True).init()
-        plan, skip = net._fusion()
+        plan, skip, _ = net._fusion()
         # 16 bottleneck blocks, each with exactly the b_bn→b_act→c_conv
         # chain eligible (a feeds a 3×3, skip/c feed adds)
         assert len(plan) == 16
@@ -246,7 +246,7 @@ class TestFusedEquivalence:
                      fuse=False).init()
         b = ResNet50(num_classes=10, height=64, width=64, seed=1,
                      fuse=True).init()
-        plan, _ = b._fusion()
+        plan, _, _ = b._fusion()
         assert len(plan) == 16
         np.testing.assert_allclose(np.asarray(a.output(x)),
                                    np.asarray(b.output(x)),
