@@ -734,6 +734,12 @@ class SequenceVectors:
         widths = [(0, rows_to - len(a))] + [(0, 0)] * (a.ndim - 1)
         return np.pad(a, widths)
 
+    #: prepare+upload the NEXT scan group on a worker thread while the
+    #: current group's scan runs on device (the measured Word2Vec ceiling
+    #: was upload serialization between groups — PERF.md; the single
+    #: worker preserves the host rng draw order, so exactness holds)
+    upload_prefetch = True
+
     def _run_scan_dispatch(self, rows, alphas, lead_fn, scan_fn,
                            devneg_fn):
         """Shared scaffolding for the scan-batched dispatchers: group
@@ -753,7 +759,12 @@ class SequenceVectors:
         device_negatives (default) the NS negatives are drawn on device
         by `devneg_fn` and only the pair streams ship. `lead_fn(a, b,
         nb)` supplies the variant-specific leading xs for rows [a:b)
-        zero-padded to nb full batches (sg: inputs; cbow: ctx + mask)."""
+        zero-padded to nb full batches (sg: inputs; cbow: ctx + mask).
+
+        Payload prep + host->device upload of group i+1 runs on a
+        single-slot worker thread while group i's scan executes
+        (`upload_prefetch`; the groups' rng draws happen in prep order
+        on ONE worker, so the stream is identical to serial prep)."""
         B = self._eff_batch
         nb = self.scan_chunk
         n = len(rows)
@@ -795,7 +806,9 @@ class SequenceVectors:
             pts0 = jnp.zeros((nb, B, 1), jnp.int32)
             cds0 = jnp.zeros((nb, B, 1), jnp.float32)
             msk0 = jnp.zeros((nb, B, 1), jnp.float32)
-        for a, b, g in groups:
+        def prep(a, b, g):
+            """Build + upload one group's payload (rng draws happen
+            here, in prep order). Returns the dispatch closure inputs."""
             k = b - a                                # real rows
             full = k == g * B
             ro = self._pad_rows(
@@ -808,26 +821,25 @@ class SequenceVectors:
             else:
                 vnp = self._pad_rows(np.ones(k, np.float32),
                                      g * B).reshape(g, B)
-                valid = jnp.asarray(vnp)
+                valid = jax.device_put(vnp)
             if hs:
                 m = self._path_mask[ro]
                 if vnp is not None:
                     m = m * vnp[..., None]
-                pts = jnp.asarray(self._points[ro])
-                cds = jnp.asarray(self._codes[ro])
-                msk = jnp.asarray(m)
+                pts = jax.device_put(self._points[ro])
+                cds = jax.device_put(self._codes[ro])
+                msk = jax.device_put(m)
             else:
                 pts, cds, msk = pts0[:g], cds0[:g], msk0[:g]
+            lead = tuple(jax.device_put(np.asarray(x)) if not isinstance(
+                x, jax.Array) else x for x in lead_fn(a, b, g))
             if devneg:
                 key = jax.random.fold_in(self._devneg_key,
                                          self._devneg_ctr)
                 self._devneg_ctr += 1
-                self.syn0, s1, s1n = devneg_fn(
-                    self.syn0, dummy1, dummy1n, self._table_dev, key,
-                    *lead_fn(a, b, g), jnp.asarray(ro), pts, cds, msk,
-                    valid, jnp.asarray(lr), negative=self.negative,
-                    use_hs=hs)
+                targets = None
             else:
+                key = None
                 if ns:
                     # sample only batches with >=1 real row: the padded
                     # group may round up to a power of two with fully-pad
@@ -838,17 +850,62 @@ class SequenceVectors:
                     t_np = np.zeros((g, B, self.negative + 1), np.int32)
                     for j in range(real_b):
                         t_np[j] = self._sample_negatives(ro[j])[0]
-                    targets = jnp.asarray(t_np)
+                    targets = jax.device_put(t_np)
                 else:
                     targets = targets0[:g]
+            return (g, lead, jax.device_put(ro), pts, cds, msk, valid,
+                    jax.device_put(lr), key, targets)
+
+        def dispatch(payload):
+            nonlocal dummy1, dummy1n
+            g, lead, ro, pts, cds, msk, valid, lr, key, targets = payload
+            if devneg:
+                self.syn0, s1, s1n = devneg_fn(
+                    self.syn0, dummy1, dummy1n, self._table_dev, key,
+                    *lead, ro, pts, cds, msk, valid, lr,
+                    negative=self.negative, use_hs=hs)
+            else:
                 self.syn0, s1, s1n = scan_fn(
-                    self.syn0, dummy1, dummy1n, *lead_fn(a, b, g),
-                    targets, labels0[:g], pts, cds, msk, valid,
-                    jnp.asarray(lr), negative=ns, use_hs=hs)
+                    self.syn0, dummy1, dummy1n, *lead, targets,
+                    labels0[:g], pts, cds, msk, valid, lr,
+                    negative=ns, use_hs=hs)
             if hs:
                 self.syn1 = dummy1 = s1
             if ns:
                 self.syn1neg = dummy1n = s1n
+
+        if self.upload_prefetch and len(groups) > 1:
+            import concurrent.futures as _cf
+            if getattr(self, "_uploader", None) is None:
+                self._uploader = _cf.ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="w2v-upload")
+            # 1-deep pipeline: while group i's scan runs, the worker
+            # preps + uploads group i+1
+            fut = self._uploader.submit(prep, *groups[0])
+            for grp in groups[1:]:
+                payload = fut.result()
+                # snapshot BEFORE submitting the next prep (no concurrent
+                # mutation): if dispatch fails, the already-prepped-but-
+                # never-dispatched group's rng/counter draws are undone,
+                # keeping the save/resume stream contract intact
+                snap = (self._rng.bit_generator.state,
+                        getattr(self, "_devneg_ctr", None))
+                fut = self._uploader.submit(prep, *grp)
+                try:
+                    dispatch(payload)
+                except BaseException:
+                    try:
+                        fut.result()          # worker must finish first
+                    except Exception:         # noqa: BLE001
+                        pass
+                    self._rng.bit_generator.state = snap[0]
+                    if snap[1] is not None:
+                        self._devneg_ctr = snap[1]
+                    raise
+            dispatch(fut.result())
+        else:
+            for grp in groups:
+                dispatch(prep(*grp))
 
     def _dispatch_sg_many(self, ins, outs, alphas):
         """Shard-sized skip-gram training through _run_scan_dispatch."""
@@ -948,6 +1005,11 @@ class SequenceVectors:
         labels = np.zeros((B, K + 1), np.float32)
         labels[:, 0] = 1.0
         return targets, labels
+
+    def __del__(self):
+        up = getattr(self, "_uploader", None)
+        if up is not None:
+            up.shutdown(wait=False)
 
     # -- persistence -------------------------------------------------------
     def save(self, path: str) -> None:

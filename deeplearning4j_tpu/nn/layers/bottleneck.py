@@ -64,7 +64,10 @@ class BnParams(NamedTuple):
 
 def fused_bottleneck_supported(x_shape, c_mid: int, c_out: int,
                                dtype) -> bool:
-    """Conservative VMEM gate for the per-image whole-image blocks."""
+    """Conservative VMEM gate for the per-image whole-image blocks —
+    sized for the WORST kernel of the chain, which is the 3x3 stage's
+    backward: padded image + grad image + the [9, C, C] weight AND its
+    fp32 dW accumulator block both resident."""
     if len(x_shape) != 4:
         return False
     n, h, w, c_in = x_shape
@@ -72,13 +75,15 @@ def fused_bottleneck_supported(x_shape, c_mid: int, c_out: int,
         dtype = jnp.bfloat16
     bpe = jnp.dtype(dtype).itemsize
     img = h * w * bpe
-    # largest single-kernel residency: in-image + out-image + weight +
-    # fp32 accumulators (padded 3x3 image dominates the conv_b step)
-    worst = ((h + 2) * (w + 2) * c_mid * bpe      # padded mid image
-             + img * c_mid * 2                     # in + out images
-             + max(c_in * c_mid, c_mid * c_out, 9 * c_mid * c_mid) * bpe
-             + h * w * c_mid * 4)                  # fp32 accumulator tile
-    return worst <= _VMEM_BUDGET
+    pad_img = (h + 2) * (w + 2) * c_mid * 4       # fp32 padded recompute
+    fwd_worst = (pad_img + img * c_mid * 2
+                 + max(c_in * c_mid, c_mid * c_out,
+                       9 * c_mid * c_mid) * bpe
+                 + h * w * c_mid * 4)
+    bwd_worst = (pad_img * 2                      # z_pad + dy_pad fp32
+                 + img * c_mid * 2                # yk + dz images
+                 + 9 * c_mid * c_mid * (bpe + 4))  # w + fp32 dW block
+    return max(fwd_worst, bwd_worst) <= _VMEM_BUDGET
 
 
 # ---------------------------------------------------------------------------
@@ -87,18 +92,20 @@ def fused_bottleneck_supported(x_shape, c_mid: int, c_out: int,
 
 
 def _fwd1x1_kernel(x_ref, sc_ref, bb_ref, w_ref, o_ref, s1_ref, s2_ref,
-                   s1_scr, s2_scr, *, act, n_img):
+                   *, act, n_img):
     """One image: o = affine+act(x) @ w, with Σo / Σo² channel epilogue.
 
     x_ref [1,H,W,C]; sc/bb [1,C] fp32 (identity prologue = (1,0));
-    w [C,K]; o [1,H,W,K]; s1/s2 [1,K] fp32 accumulated across the grid.
+    w [C,K]; o [1,H,W,K]; s1/s2 [1,K] fp32 accumulated ACROSS the grid
+    directly in the (constant-index, VMEM-resident) output blocks — no
+    separate scratch doubles the accumulator footprint.
     """
     i = pl.program_id(0)
 
     @pl.when(i == 0)
     def _init():
-        s1_scr[...] = jnp.zeros_like(s1_scr)
-        s2_scr[...] = jnp.zeros_like(s2_scr)
+        s1_ref[...] = jnp.zeros_like(s1_ref)
+        s2_ref[...] = jnp.zeros_like(s2_ref)
 
     _, h, w_dim, c = x_ref.shape
     k = w_ref.shape[1]
@@ -113,17 +120,12 @@ def _fwd1x1_kernel(x_ref, sc_ref, bb_ref, w_ref, o_ref, s1_ref, s2_ref,
     # stats of the *stored* (dtype-rounded) output: the consumer
     # normalizes the rounded tensor, so the stats must see the same values
     of = o_ref[...].reshape(h * w_dim, k).astype(jnp.float32)
-    s1_scr[...] += jnp.sum(of, axis=0, keepdims=True)
-    s2_scr[...] += jnp.sum(of * of, axis=0, keepdims=True)
-
-    @pl.when(i == n_img - 1)
-    def _out():
-        s1_ref[...] = s1_scr[...]
-        s2_ref[...] = s2_scr[...]
+    s1_ref[...] += jnp.sum(of, axis=0, keepdims=True)
+    s2_ref[...] += jnp.sum(of * of, axis=0, keepdims=True)
 
 
 def _fwd3x3_kernel(x_ref, sc_ref, bb_ref, w_ref, o_ref, s1_ref, s2_ref,
-                   s1_scr, s2_scr, *, act, n_img):
+                   *, act, n_img):
     """One image: 3x3 same-pad conv of affine+act(x), stats epilogue.
 
     w_ref [9, C, K] (tap-major: dy*3+dx); the conv is nine shifted
@@ -133,8 +135,8 @@ def _fwd3x3_kernel(x_ref, sc_ref, bb_ref, w_ref, o_ref, s1_ref, s2_ref,
 
     @pl.when(i == 0)
     def _init():
-        s1_scr[...] = jnp.zeros_like(s1_scr)
-        s2_scr[...] = jnp.zeros_like(s2_scr)
+        s1_ref[...] = jnp.zeros_like(s1_ref)
+        s2_ref[...] = jnp.zeros_like(s2_ref)
 
     _, h, w_dim, c = x_ref.shape
     k = w_ref.shape[2]
@@ -153,13 +155,8 @@ def _fwd3x3_kernel(x_ref, sc_ref, bb_ref, w_ref, o_ref, s1_ref, s2_ref,
                 preferred_element_type=jnp.float32)
     o_ref[...] = acc.astype(o_ref.dtype).reshape(1, h, w_dim, k)
     of = o_ref[...].reshape(h * w_dim, k).astype(jnp.float32)
-    s1_scr[...] += jnp.sum(of, axis=0, keepdims=True)
-    s2_scr[...] += jnp.sum(of * of, axis=0, keepdims=True)
-
-    @pl.when(i == n_img - 1)
-    def _out():
-        s1_ref[...] = s1_scr[...]
-        s2_ref[...] = s2_scr[...]
+    s1_ref[...] += jnp.sum(of, axis=0, keepdims=True)
+    s2_ref[...] += jnp.sum(of * of, axis=0, keepdims=True)
 
 
 def _img_spec(h, w, c):
@@ -192,8 +189,6 @@ def _fwd_conv_stats(x, sc, bb, w, *, taps: int, act: str,
         out_shape=[jax.ShapeDtypeStruct((n, h, wd, k), x.dtype),
                    jax.ShapeDtypeStruct((1, k), jnp.float32),
                    jax.ShapeDtypeStruct((1, k), jnp.float32)],
-        scratch_shapes=[pltpu.VMEM((1, k), jnp.float32),
-                        pltpu.VMEM((1, k), jnp.float32)],
         interpret=interpret,
     )(x, sc[None, :], bb[None, :], w)
     return out, s1[0], s2[0]
@@ -218,7 +213,7 @@ def _fwd_conv_stats(x, sc, bb, w, *, taps: int, act: str,
 def _bwd1x1_kernel(yk_ref, g_ref, yprev_ref, w_ref,
                    aff_k_ref, aff_p_ref,
                    dz_ref, dw_ref, sums_ref,
-                   dw_scr, sums_scr, *, act_prev, n_img, gmode):
+                   *, act_prev, n_img, gmode):
     """One image of stage-k backward (k a 1x1 conv).
 
     yk_ref    [1,H,W,K]  raw conv_k output (for ŷ_k / relu' recompute)
@@ -236,8 +231,8 @@ def _bwd1x1_kernel(yk_ref, g_ref, yprev_ref, w_ref,
 
     @pl.when(i == 0)
     def _init():
-        dw_scr[...] = jnp.zeros_like(dw_scr)
-        sums_scr[...] = jnp.zeros_like(sums_scr)
+        dw_ref[...] = jnp.zeros_like(dw_ref)
+        sums_ref[...] = jnp.zeros_like(sums_ref)
 
     _, h, wd, c = yprev_ref.shape
     k = yk_ref.shape[3]
@@ -260,7 +255,7 @@ def _bwd1x1_kernel(yk_ref, g_ref, yprev_ref, w_ref,
     bbp = aff_p_ref[1, :][None, :]
     z0p = yp * scp + bbp
     zp = jnp.maximum(z0p, 0.0) if act_prev == "relu" else z0p
-    dw_scr[...] += lax.dot_general(
+    dw_ref[...] += lax.dot_general(
         zp.astype(yk_ref.dtype), dy.astype(yk_ref.dtype),
         (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
     dzp = lax.dot_general(dy.astype(w_ref.dtype), w_ref[...],
@@ -272,19 +267,14 @@ def _bwd1x1_kernel(yk_ref, g_ref, yprev_ref, w_ref,
     invp = aff_p_ref[2, :][None, :]
     mup = aff_p_ref[3, :][None, :]
     yhat_p = (yp - mup) * invp
-    sums_scr[0:1, :] += jnp.sum(dzp, axis=0, keepdims=True)
-    sums_scr[1:2, :] += jnp.sum(dzp * yhat_p, axis=0, keepdims=True)
-
-    @pl.when(i == n_img - 1)
-    def _out():
-        dw_ref[...] = dw_scr[...]
-        sums_ref[...] = sums_scr[...]
+    sums_ref[0:1, :] += jnp.sum(dzp, axis=0, keepdims=True)
+    sums_ref[1:2, :] += jnp.sum(dzp * yhat_p, axis=0, keepdims=True)
 
 
 def _bwd3x3_kernel(yk_ref, g_ref, yprev_ref, w_ref,
                    aff_k_ref, aff_p_ref,
                    dz_ref, dw_ref, sums_ref,
-                   dw_scr, sums_scr, *, act_prev, n_img, gmode):
+                   *, act_prev, n_img, gmode):
     """3x3 twin of _bwd1x1_kernel: w_ref [9,C,K];
     dW via nine shifted-input matmuls, dz_{k-1} via the transposed taps
     (full-correlation with the flipped kernel)."""
@@ -292,8 +282,8 @@ def _bwd3x3_kernel(yk_ref, g_ref, yprev_ref, w_ref,
 
     @pl.when(i == 0)
     def _init():
-        dw_scr[...] = jnp.zeros_like(dw_scr)
-        sums_scr[...] = jnp.zeros_like(sums_scr)
+        dw_ref[...] = jnp.zeros_like(dw_ref)
+        sums_ref[...] = jnp.zeros_like(sums_ref)
 
     _, h, wd, c = yprev_ref.shape
     k = yk_ref.shape[3]
@@ -323,7 +313,7 @@ def _bwd3x3_kernel(yk_ref, g_ref, yprev_ref, w_ref,
         dyy, dxx = divmod(t, 3)
         # dW tap t sums z_{k-1}[shifted] · dy
         xs = zp_pad[dyy:dyy + h, dxx:dxx + wd, :].reshape(hw, c)
-        dw_scr[t, :, :] += lax.dot_general(
+        dw_ref[t, :, :] += lax.dot_general(
             xs.astype(yk_ref.dtype), dy.astype(yk_ref.dtype),
             (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         # dz tap: correlation with the mirrored offset (2-dy, 2-dx)
@@ -339,13 +329,8 @@ def _bwd3x3_kernel(yk_ref, g_ref, yprev_ref, w_ref,
     invp = aff_p_ref[2, :][None, :]
     mup = aff_p_ref[3, :][None, :]
     yhat_p = (yp.reshape(hw, c) - mup) * invp
-    sums_scr[0:1, :] += jnp.sum(dzp, axis=0, keepdims=True)
-    sums_scr[1:2, :] += jnp.sum(dzp * yhat_p, axis=0, keepdims=True)
-
-    @pl.when(i == n_img - 1)
-    def _out():
-        dw_ref[...] = dw_scr[...]
-        sums_ref[...] = sums_scr[...]
+    sums_ref[0:1, :] += jnp.sum(dzp, axis=0, keepdims=True)
+    sums_ref[1:2, :] += jnp.sum(dzp * yhat_p, axis=0, keepdims=True)
 
 
 def _bwd_stage(yk, g, yprev, w, aff_k, aff_p, *, taps, act_prev, gmode,
@@ -368,8 +353,6 @@ def _bwd_stage(yk, g, yprev, w, aff_k, aff_p, *, taps, act_prev, gmode,
         out_shape=[jax.ShapeDtypeStruct((n, h, wd, c), yprev.dtype),
                    jax.ShapeDtypeStruct(dw_shape, jnp.float32),
                    jax.ShapeDtypeStruct((2, c), jnp.float32)],
-        scratch_shapes=[pltpu.VMEM(dw_shape, jnp.float32),
-                        pltpu.VMEM((2, c), jnp.float32)],
         interpret=interpret,
     )(yk, g, yprev, w, aff_k, aff_p)
     return dz, dw, sums
@@ -410,7 +393,7 @@ def _bottleneck_core(cfg, x, wa, wb, wc, ga, be_a, gb, be_b, gc, be_c):
     fused.py keeping stats outside its vjp)."""
     out, res = _bottleneck_fwd_impl(cfg, x, wa, wb, wc, ga, be_a, gb,
                                     be_b, gc, be_c)
-    return out, res[5]
+    return out, res[4]
 
 
 def _bottleneck_fwd_impl(cfg, x, wa, wb, wc, ga, be_a, gb, be_b, gc,
@@ -439,14 +422,17 @@ def _bottleneck_fwd_impl(cfg, x, wa, wb, wc, ga, be_a, gb, be_b, gc,
     pre = yc.astype(jnp.float32) * scc + bbc + x.astype(jnp.float32)
     out = jnp.maximum(pre, 0.0).astype(x.dtype)
     stats = (mua, vara, mub, varb, muc, varc)
-    return out, (x, ya, yb, yc, pre, stats)
+    # residuals: raw conv outputs only — `pre` is recomputed in the
+    # backward from yc and x (saving it would persist a full fp32
+    # activation tensor per block, against the module's design)
+    return out, (x, ya, yb, yc, stats)
 
 
 def _bottleneck_vjp_fwd(cfg, x, wa, wb, wc, ga, be_a, gb, be_b, gc,
                         be_c):
     out, res = _bottleneck_fwd_impl(cfg, x, wa, wb, wc, ga, be_a, gb,
                                     be_b, gc, be_c)
-    return (out, res[5]), \
+    return (out, res[4]), \
         res + ((wa, wb, wc, ga, gb, gc, be_a, be_b, be_c),)
 
 
@@ -454,7 +440,7 @@ def _bottleneck_vjp_bwd(cfg, res, cts):
     eps, interpret = cfg
     g, _stat_cts = cts     # stats feed running averages only: cotangents
     #                        ignored by contract (see _bottleneck_core)
-    x, ya, yb, yc, pre, stats, weights = res
+    x, ya, yb, yc, stats, weights = res
     wa, wb, wc, ga, gb, gc, be_a, be_b, be_c = weights
     mua, vara, mub, varb, muc, varc = stats
     n, h, wd, _ = x.shape
@@ -464,7 +450,9 @@ def _bottleneck_vjp_bwd(cfg, res, cts):
     scc, bbc, invc = _affine(gc, be_c, muc, varc, eps)
 
     # tail backward (elementwise + 2 channel reductions; XLA fuses):
-    # dz_c0 = g∘relu'(pre); the same tensor is the skip gradient
+    # dz_c0 = g∘relu'(pre); the same tensor is the skip gradient.
+    # pre recomputed from the saved raw tensors (elementwise, fuses)
+    pre = yc.astype(jnp.float32) * scc + bbc + x.astype(jnp.float32)
     gz = jnp.where(pre > 0, g.astype(jnp.float32), 0.0)   # [N,H,W,K3]
     dx_skip = gz
     ycf = yc.astype(jnp.float32)
@@ -551,8 +539,13 @@ def fused_bottleneck(
             cfg, x, wa, wb, wc, bn_a.gamma, bn_a.beta, bn_b.gamma,
             bn_b.beta, bn_c.gamma, bn_c.beta)
         mua, vara, mub, varb, muc, varc = batch_stats
+        # decay*old must ROUND through x.dtype exactly like the unfused
+        # BatchNormalization (fused.py precision-chain note): under bf16
+        # the persistent running stats would otherwise drift apart
+        # between the two execution plans
         new_stats = tuple(
-            decay * old + (1.0 - decay) * new
+            (decay * old.astype(x.dtype) + (1.0 - decay) * new)
+            .astype(jnp.float32)
             for old, new in ((bn_a.running_mean, mua),
                              (bn_a.running_var, vara),
                              (bn_b.running_mean, mub),

@@ -47,11 +47,15 @@ class TestForwardEquivalence:
             np.testing.assert_allclose(sf, sr, atol=1e-5, rtol=1e-5)
 
     def test_vmem_gate(self):
-        # ResNet50 interior shapes all pass; absurd shapes fail
+        # stages 2-4 of ResNet50 pass; stage 5 (c_mid=512) is rejected —
+        # its 3x3 BACKWARD needs the [9,512,512] weight plus the fp32 dW
+        # accumulator resident (~14 MB), past the budget
         assert fused_bottleneck_supported((128, 56, 56, 256), 64, 256,
                                           jnp.bfloat16)
-        assert fused_bottleneck_supported((128, 7, 7, 2048), 512, 2048,
+        assert fused_bottleneck_supported((128, 14, 14, 1024), 256, 1024,
                                           jnp.bfloat16)
+        assert not fused_bottleneck_supported((128, 7, 7, 2048), 512,
+                                              2048, jnp.bfloat16)
         assert not fused_bottleneck_supported((8, 512, 512, 512), 512,
                                               512, jnp.float32)
 
@@ -143,8 +147,29 @@ class TestGraphIntegration:
                 np.asarray(ref.state[bn]["mean"]), atol=1e-4, rtol=1e-3,
                 err_msg=bn)
 
+    def test_bf16_running_stats_track_unfused(self):
+        """Under the bf16 compute policy the decay update must round
+        through x.dtype exactly like the unfused plan — otherwise the
+        two execution plans train diverging persistent BN state."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((4, 16, 8, 8)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 4)]
+        ref = self._graph(fuse=False)
+        fus = self._graph(fuse="bottleneck")
+        ref.conf.dtype = "bfloat16"
+        fus.conf.dtype = "bfloat16"
+        for _ in range(3):
+            ref.fit(DataSet(x, y))
+            fus.fit(DataSet(x, y))
+        for bn in ("blk_a_bn", "blk_b_bn", "blk_c_bn"):
+            for key in ("mean", "var"):
+                np.testing.assert_allclose(
+                    np.asarray(fus.state[bn][key]),
+                    np.asarray(ref.state[bn][key]), atol=2e-3, rtol=2e-2,
+                    err_msg=f"{bn}.{key}")
+
     def test_nchw_stays_unfused(self):
-        from deeplearning4j_tpu.nn.conf import layers as L
         net = self._graph(fuse="bottleneck")
         # flip format AFTER building: matcher keys off layer data_format
         plan, skip, bplan = net._fusion()

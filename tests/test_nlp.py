@@ -133,6 +133,37 @@ class TestWord2Vec:
                                        np.asarray(b.syn1),
                                        rtol=1e-6, atol=1e-7)
 
+    @pytest.mark.parametrize("kwargs", [
+        dict(negative=3, use_hierarchic_softmax=False,
+             device_negatives=False),
+        dict(negative=5),                       # devneg key/ctr stream
+        dict(negative=0),                       # hs paths
+    ])
+    def test_upload_prefetch_is_bit_exact(self, kwargs):
+        """The double-buffered uploader (prep+upload of group i+1 on a
+        worker thread while group i's scan runs) must not change a single
+        bit: the single worker preserves the host rng / devneg-counter
+        order, so prefetch on == prefetch off."""
+        def make(prefetch):
+            w = Word2Vec(
+                sentence_iterator=CollectionSentenceIterator(corpus(40)),
+                min_word_frequency=1, layer_size=8, window=2, seed=3,
+                batch_size=32, epochs=2, **kwargs)
+            w.upload_prefetch = prefetch
+            w.scan_chunk = 2      # force several scan groups per shard
+            return w
+        a, b = make(True), make(False)
+        a.fit()
+        b.fit()
+        np.testing.assert_array_equal(np.asarray(a.syn0),
+                                      np.asarray(b.syn0))
+        if a.syn1neg is not None:
+            np.testing.assert_array_equal(np.asarray(a.syn1neg),
+                                          np.asarray(b.syn1neg))
+        if a.syn1 is not None:
+            np.testing.assert_array_equal(np.asarray(a.syn1),
+                                          np.asarray(b.syn1))
+
     @pytest.mark.parametrize("algo", ["skipgram", "cbow"])
     def test_device_negatives_learns_and_is_deterministic(self, algo):
         """The default device-side negative sampler trains embeddings of
