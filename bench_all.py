@@ -463,12 +463,131 @@ def bench_decode():
           flush=True)
 
 
+def bench_specdec():
+    """Prompt-lookup speculative decoding vs plain greedy decoding, on a
+    model TRAINED TO MEMORIZE its corpus (the round-3 measurement used a
+    model that never memorized — near-zero acceptance tells nothing; see
+    PERF.md/VERDICT r3 task 5). With acceptance a, speculation needs one
+    target dispatch per (a+1) tokens — the decisive lever on this
+    dispatch-latency-bound platform. Reports tokens/s both ways + the
+    measured dispatch ratio."""
+    import numpy as np
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.util import decoding
+    from deeplearning4j_tpu.zoo import TextGenerationTransformer
+
+    V, L, STEPS, GAMMA = 64, 96, 64, 4
+    model = TextGenerationTransformer(vocab_size=V, embed_dim=128,
+                                      n_heads=4, n_layers=2,
+                                      max_length=256, positional="rope",
+                                      seed=0)
+    net = model.init()
+    # a strongly periodic corpus the model can memorize quickly
+    period = list(range(2, 18))
+    seq = (period * (L // len(period) + 1))[:L + 1]
+    x = np.zeros((1, V, L), np.float32)
+    y = np.zeros((1, V, L), np.float32)
+    x[0, seq[:-1], np.arange(L)] = 1.0
+    y[0, seq[1:], np.arange(L)] = 1.0
+    ds = DataSet(x, y)
+    for _ in range(60):
+        net.fit(ds)
+    prompt = seq[:24]
+    # memorization check: greedy continuation should follow the period
+    cont = model.sample_stream(net, prompt, steps=8, top_k=1)
+    acc_probe = sum(int(cont[24 + i] == seq[24 + i]) for i in range(8))
+
+    proposer = decoding.prompt_lookup_proposer(3)
+    model.sample_stream(net, prompt, steps=2, top_k=1)        # warm
+    model.speculative_sample(net, proposer, prompt, steps=2, gamma=GAMMA,
+                             top_k=1)
+    t0 = time.perf_counter()
+    plain = model.sample_stream(net, prompt, steps=STEPS, top_k=1)
+    dt_plain = time.perf_counter() - t0
+    calls = {"n": 0}
+    orig = type(net).rnn_time_step
+
+    def counting(self, *a, **k):
+        calls["n"] += 1
+        return orig(self, *a, **k)
+
+    type(net).rnn_time_step = counting
+    try:
+        t0 = time.perf_counter()
+        spec = model.speculative_sample(net, proposer, prompt,
+                                        steps=STEPS, gamma=GAMMA, top_k=1)
+        dt_spec = time.perf_counter() - t0
+    finally:
+        type(net).rnn_time_step = orig
+    assert spec == plain, "speculative greedy must equal plain greedy"
+    print(json.dumps({
+        "metric": "specdec_prompt_lookup",
+        "value": round(STEPS / dt_spec, 1),
+        "unit": "tokens/sec",
+        "plain_tokens_per_sec": round(STEPS / dt_plain, 1),
+        "speedup": round(dt_plain / dt_spec, 2),
+        "target_dispatches": calls["n"],
+        "plain_dispatch_equiv": 1 + STEPS,
+        "memorization_probe_8": acc_probe}), flush=True)
+
+
+def bench_specbatch():
+    """Batched speculative decoding (per-row acceptance) vs per-prompt
+    speculation vs batched plain decode — the composed serving
+    multiplier (speculation's dispatch ratio x batching's rows per
+    dispatch)."""
+    import numpy as np
+    from deeplearning4j_tpu.util import decoding
+    from deeplearning4j_tpu.zoo import TextGenerationTransformer
+
+    V, B, STEPS, GAMMA = 2048, 8, 48, 4
+    model = TextGenerationTransformer(vocab_size=V, embed_dim=512,
+                                      n_heads=8, n_layers=6,
+                                      max_length=256, positional="rope")
+    net = model.init()
+    net.conf.dtype = "bfloat16"
+    rng = np.random.default_rng(0)
+    base = [list(rng.integers(1, V, 6)) for _ in range(B)]
+    prompts = [b * 3 for b in base]        # repetition: lookup can hit
+    proposer = decoding.prompt_lookup_proposer(3)
+    for p in prompts:                       # warm chunk shapes
+        model.speculative_sample(net, proposer, p, steps=2, gamma=GAMMA,
+                                 top_k=1)
+    model.speculative_sample_batch(net, proposer, prompts, steps=4,
+                                   gamma=GAMMA, top_k=1)
+    model.sample_stream_batch(net, prompts, steps=4, top_k=1)
+
+    t0 = time.perf_counter()
+    for p in prompts:
+        model.speculative_sample(net, proposer, p, steps=STEPS,
+                                 gamma=GAMMA, top_k=1)
+    dt_seq = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    model.speculative_sample_batch(net, proposer, prompts, steps=STEPS,
+                                   gamma=GAMMA, top_k=1)
+    dt_batch = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    model.sample_stream_batch(net, prompts, steps=STEPS, top_k=1)
+    dt_plainb = time.perf_counter() - t0
+    total = B * STEPS
+    print(json.dumps({
+        "metric": "specdec_batched8",
+        "value": round(total / dt_batch, 1),
+        "unit": "tokens/sec",
+        "per_prompt_spec_tokens_per_sec": round(total / dt_seq, 1),
+        "batched_plain_tokens_per_sec": round(total / dt_plainb, 1),
+        "batch_speedup_vs_per_prompt_spec": round(dt_seq / dt_batch, 2),
+        "spec_speedup_vs_batched_plain": round(dt_plainb / dt_batch, 2)}),
+        flush=True)
+
+
 ALL = {"resnet": bench_resnet, "lstm": bench_lstm, "lenet": bench_lenet,
        "vgg16": bench_vgg16, "inception": bench_keras_inception,
        "attention": bench_attention, "transformer": bench_transformer,
        "scaling": bench_scaling, "word2vec": bench_word2vec,
        "window": bench_window_attention, "quant": bench_quant,
-       "decode": bench_decode}
+       "decode": bench_decode, "specdec": bench_specdec,
+       "specbatch": bench_specbatch}
 
 if __name__ == "__main__":
     names = sys.argv[1:] or ["resnet", "lstm", "lenet", "vgg16",

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Live-window measurement playbook (round 4). Run when the TPU tunnel is
+# up; ordered by VERDICT priority so a short window still lands the
+# high-value numbers. Appends JSON lines + timing to bench_live.log.
+set -uo pipefail
+cd "$(dirname "$0")"
+LOG=${1:-bench_live.log}
+
+run() {
+  local name="$1"; shift
+  echo "=== $name $(date -u +%H:%M:%S)" | tee -a "$LOG"
+  timeout "${T:-900}" "$@" 2>&1 | tail -4 | tee -a "$LOG"
+}
+
+# 1. headline record (default env = best-known config)
+run "bench.py headline" python bench.py
+# 2. fused-bottleneck A/B (VERDICT r4 task 1)
+run "bench.py BENCH_FUSE=2" env BENCH_FUSE=2 python bench.py
+# 3. speculation re-measure with a memorized model (task 5)
+run "specdec" python bench_all.py specdec
+# 4. word2vec with the double-buffered uploader (task 6) — 3 runs for a median
+run "word2vec #1" python bench_all.py word2vec
+run "word2vec #2" python bench_all.py word2vec
+run "word2vec #3" python bench_all.py word2vec
+# 5. batched speculation + batched decode serving numbers
+run "specbatch" python bench_all.py specbatch
+run "decode" python bench_all.py decode
+# 6. entries that missed round-3's sweep
+run "window attention" python bench_all.py window
+run "headline confirm" python bench.py
