@@ -84,3 +84,15 @@ def test_speculative_decode():
     # worst case (zero acceptance) costs plain + 1 forwards; any
     # acceptance pulls below plain
     assert res["pld_calls"] <= res["plain_calls"] + 1
+
+
+def test_batched_serving():
+    res = _run("batched_serving", steps=8, beam_width=2)
+    assert len(res["speculative"]) == 4
+    assert all(np.isfinite(s) for _, s in res["beams"])
+
+
+def test_embedding_persistence(tmp_path):
+    resumed, reloaded = _run("embedding_persistence", tmpdir=str(tmp_path))
+    assert resumed.epochs_trained == 6
+    assert reloaded.get_label_vector("DOC_park") is not None
