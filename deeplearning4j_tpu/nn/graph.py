@@ -199,9 +199,9 @@ class ComputationGraph:
                 return None
             return c
 
-        def conv_ok(l, kernel, padding):
+        def conv_ok(l, kernel, padding, stride=(1, 1)):
             return (l is not None and tuple(l.kernel) == kernel
-                    and tuple(l.stride) == (1, 1)
+                    and tuple(l.stride) == stride
                     and tuple(l.padding) == padding
                     and tuple(l.dilation) == (1, 1)
                     and not l.has_bias
@@ -234,7 +234,11 @@ class ComputationGraph:
         skip: Dict[str, str] = {}
         for ca_name in self._topo:
             conv_a = layer_of(ca_name, ConvolutionLayer)
-            if not conv_ok(conv_a, (1, 1), (0, 0)):
+            if conv_a is None:
+                continue
+            stride = tuple(conv_a.stride)
+            if stride not in ((1, 1), (2, 2)) or \
+                    not conv_ok(conv_a, (1, 1), (0, 0), stride):
                 continue
             srcs = self.conf.vertex_inputs.get(ca_name, [])
             if len(srcs) != 1:
@@ -267,14 +271,40 @@ class ComputationGraph:
                     or addv.op.lower() != "add" or add_name in outputs):
                 continue
             add_ins = self.conf.vertex_inputs.get(add_name, [])
-            if sorted(add_ins) != sorted([bn_c_name, src]):
-                continue                       # skip path must be identity
+            skip_group = {}
+            if sorted(add_ins) == sorted([bn_c_name, src]):
+                if stride != (1, 1):
+                    continue          # strided main path needs a conv skip
+            else:
+                # downsample form: the other add input is src -> conv_skip
+                # (1x1, same stride) -> bn_skip (identity activation)
+                others = [i for i in add_ins if i != bn_c_name]
+                if len(add_ins) != 2 or len(others) != 1:
+                    continue
+                bn_s_name = others[0]
+                bn_s = layer_of(bn_s_name, BatchNormalization)
+                if bn_s is None or \
+                        (bn_s.activation or "identity") != "identity" or \
+                        sole_consumer(bn_s_name) != add_name:
+                    continue
+                cs_in = self.conf.vertex_inputs.get(bn_s_name, [])
+                if len(cs_in) != 1:
+                    continue
+                cs_name = cs_in[0]
+                conv_s = layer_of(cs_name, ConvolutionLayer)
+                if not conv_ok(conv_s, (1, 1), (0, 0), stride) or \
+                        chain_next(cs_name) != bn_s_name or \
+                        self.conf.vertex_inputs.get(cs_name, []) != [src]:
+                    continue
+                skip_group = {"conv_skip": cs_name, "bn_skip": bn_s_name}
             out_name = chain_next(add_name)
             out_act = out_name and layer_of(out_name, ActivationLayer)
             if out_act is None or out_act.activation != "relu":
                 continue
             bns = [self.conf.vertices[n].layer
-                   for n in (bn_a, bn_b, bn_c_name)]
+                   for n in ((bn_a, bn_b, bn_c_name)
+                             + ((skip_group["bn_skip"],)
+                                if skip_group else ()))]
             if len({(b.eps, b.decay) for b in bns}) != 1:
                 continue
             if len({b.data_format for b in bns} | {"NHWC"}) != 1:
@@ -282,14 +312,16 @@ class ComputationGraph:
             # runtime-shape VMEM gate from the statically inferred types
             if not fused_bottleneck_supported(
                     (1, it.height, it.width, it.channels),
-                    conv_a.n_out, conv_c.n_out, self.conf.dtype or
-                    "float32"):
+                    conv_a.n_out, conv_c.n_out,
+                    self.conf.dtype or "float32",
+                    stride=stride[0], has_skip=bool(skip_group)):
                 continue
             group = {"src": src, "conv_a": ca_name, "bn_a": bn_a,
                      "conv_b": cb_name, "bn_b": bn_b, "conv_c": cc_name,
-                     "bn_c": bn_c_name, "add": add_name}
+                     "bn_c": bn_c_name, "add": add_name,
+                     "stride": stride[0], **skip_group}
             members = [ca_name, bn_a, cb_name, bn_b, cc_name, bn_c_name,
-                       add_name]
+                       add_name] + list(skip_group.values())
             if act_a:
                 members.append(act_a)
             if act_b:
@@ -514,8 +546,15 @@ class ComputationGraph:
         # shifted-window order (cross-correlation, like lax.conv)
         wb = wb4.transpose(2, 3, 1, 0).reshape(9, wb4.shape[1],
                                                wb4.shape[0])
+        if "conv_skip" in group:                  # downsample (entry) form
+            ps = bn_params(group["bn_skip"])[1]
+            ws4 = params[group["conv_skip"]]["W"]
+            ws = ws4.reshape(ws4.shape[0], ws4.shape[1]).T
+        else:
+            ps = ws = None
         out, new_stats = fused_bottleneck(
-            x, wa, pa, wb, pb, wc, pc, train=train, eps=bn_a.eps,
+            x, wa, pa, wb, pb, wc, pc, w_skip=ws, bn_skip=ps,
+            stride=group.get("stride", 1), train=train, eps=bn_a.eps,
             decay=bn_a.decay,
             interpret=jax.default_backend() != "tpu")
         acts[out_name] = out
@@ -523,10 +562,13 @@ class ComputationGraph:
         # fused_skip branch; only the trained BN stats and the output
         # vertex are written here
         if train:
-            mua, vara, mub, varb, muc, varc = new_stats
+            mua, vara, mub, varb, muc, varc = new_stats[:6]
             new_state[group["bn_a"]] = {"mean": mua, "var": vara}
             new_state[group["bn_b"]] = {"mean": mub, "var": varb}
             new_state[group["bn_c"]] = {"mean": muc, "var": varc}
+            if ws is not None:
+                new_state[group["bn_skip"]] = {"mean": new_stats[6],
+                                               "var": new_stats[7]}
         new_state[out_name] = state.get(out_name, {})
 
     def _as_mask_dict(self, masks, default_key=None) -> Optional[Dict[str, Any]]:

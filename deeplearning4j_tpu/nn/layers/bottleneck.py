@@ -27,9 +27,12 @@ carried across the sequential TPU grid. The 3×3 conv is nine statically
 shifted [H·W, Cin]·[Cin, Cout] matmuls over the in-VMEM zero-padded
 image — MXU-shaped, no halo exchange, no dynamic shapes.
 
-Scope (v1, the hot 12 of ResNet50's 16 blocks): identity bottlenecks
-only — stride 1 everywhere, identity skip, ReLU activations, NHWC,
-train-mode batch stats. Entry (downsample) blocks keep the unfused path.
+Scope: identity bottlenecks (stride 1, identity skip) AND downsample
+entry blocks (stride-2 conv_a + conv shortcut with its own BN — the
+ResNet50 convBlock layout); ReLU activations, NHWC, train or inference.
+Blocks whose worst kernel would exceed the VMEM budget (ResNet50
+stage 5, c_mid=512) honestly fall back to the unfused path via
+fused_bottleneck_supported.
 
 ref: the reference's fused-conv ambition lives in
 deeplearning4j-cuda/.../CudnnConvolutionHelper.java:54-480 (cuDNN
@@ -63,27 +66,44 @@ class BnParams(NamedTuple):
 
 
 def fused_bottleneck_supported(x_shape, c_mid: int, c_out: int,
-                               dtype) -> bool:
+                               dtype, stride: int = 1,
+                               has_skip: bool = False) -> bool:
     """Conservative VMEM gate for the per-image whole-image blocks —
-    sized for the WORST kernel of the chain, which is the 3x3 stage's
-    backward: padded image + grad image + the [9, C, C] weight AND its
-    fp32 dW accumulator block both resident."""
+    sized for the WORST kernel of the chain. Candidates: the 3x3 stage's
+    backward (padded z/dy images + the [9,C,C] weight AND its fp32 dW
+    block resident) and the stage-a / conv-skip backward (full-input-
+    resolution fp32 recompute buffers at c_in channels). Strided forms
+    also require even spatial dims (the kernels subsample exactly)."""
     if len(x_shape) != 4:
         return False
     n, h, w, c_in = x_shape
+    if stride > 1 and (h % stride or w % stride):
+        return False          # kernels require exact stride divisibility
     if isinstance(dtype, str) and dtype in ("bf16", "bfloat16"):
         dtype = jnp.bfloat16
     bpe = jnp.dtype(dtype).itemsize
-    img = h * w * bpe
-    pad_img = (h + 2) * (w + 2) * c_mid * 4       # fp32 padded recompute
-    fwd_worst = (pad_img + img * c_mid * 2
+    ho, wo = h // stride, w // stride
+    mid_img = ho * wo * bpe                       # post-stride resolution
+    pad_img = (ho + 2) * (wo + 2) * c_mid * 4     # fp32 padded recompute
+    fwd_worst = (pad_img + mid_img * c_mid * 2
                  + max(c_in * c_mid, c_mid * c_out,
                        9 * c_mid * c_mid) * bpe
-                 + h * w * c_mid * 4)
-    bwd_worst = (pad_img * 2                      # z_pad + dy_pad fp32
-                 + img * c_mid * 2                # yk + dz images
-                 + 9 * c_mid * c_mid * (bpe + 4))  # w + fp32 dW block
-    return max(fwd_worst, bwd_worst) <= _VMEM_BUDGET
+                 + ho * wo * c_mid * 4)
+    bwd_3x3 = (pad_img * 2                        # z_pad + dy_pad fp32
+               + mid_img * c_mid * 2              # yk + dz images
+               + 9 * c_mid * c_mid * (bpe + 4))   # w + fp32 dW block
+    # stage-a backward (and the conv-skip backward, same shape with
+    # c_out in place of c_mid): ~3 full-res fp32 c_in buffers
+    # (yp/z0p/dz) + the dz output block + yk/g blocks + w/dw
+    def bwd_1x1(k_ch):
+        return (h * w * c_in * (3 * 4 + bpe)
+                + ho * wo * k_ch * 2 * bpe
+                + c_in * k_ch * (bpe + 4))
+
+    worst = max(fwd_worst, bwd_3x3, bwd_1x1(c_mid))
+    if has_skip:
+        worst = max(worst, bwd_1x1(c_out))
+    return worst <= _VMEM_BUDGET
 
 
 # ---------------------------------------------------------------------------
@@ -92,13 +112,16 @@ def fused_bottleneck_supported(x_shape, c_mid: int, c_out: int,
 
 
 def _fwd1x1_kernel(x_ref, sc_ref, bb_ref, w_ref, o_ref, s1_ref, s2_ref,
-                   *, act, n_img):
-    """One image: o = affine+act(x) @ w, with Σo / Σo² channel epilogue.
+                   *, act, n_img, stride=1):
+    """One image: o = affine+act(x)[::stride, ::stride] @ w, with Σo / Σo²
+    channel epilogue.
 
     x_ref [1,H,W,C]; sc/bb [1,C] fp32 (identity prologue = (1,0));
-    w [C,K]; o [1,H,W,K]; s1/s2 [1,K] fp32 accumulated ACROSS the grid
-    directly in the (constant-index, VMEM-resident) output blocks — no
-    separate scratch doubles the accumulator footprint.
+    w [C,K]; o [1,H/stride,W/stride,K]; s1/s2 [1,K] fp32 accumulated
+    ACROSS the grid directly in the (constant-index, VMEM-resident)
+    output blocks — no separate scratch doubles the accumulator
+    footprint. stride=2 is the entry-block downsample (a strided 1x1
+    conv just subsamples rows before the channel matmul).
     """
     i = pl.program_id(0)
 
@@ -109,17 +132,21 @@ def _fwd1x1_kernel(x_ref, sc_ref, bb_ref, w_ref, o_ref, s1_ref, s2_ref,
 
     _, h, w_dim, c = x_ref.shape
     k = w_ref.shape[1]
-    xf = x_ref[...].reshape(h * w_dim, c).astype(jnp.float32)
+    ho, wo = h // stride, w_dim // stride
+    xs = x_ref[...].reshape(h, w_dim, c)
+    if stride > 1:
+        xs = xs[::stride, ::stride, :]
+    xf = xs.reshape(ho * wo, c).astype(jnp.float32)
     z = xf * sc_ref[...] + bb_ref[...]
     if act == "relu":
         z = jnp.maximum(z, 0.0)
     out = lax.dot_general(z.astype(w_ref.dtype), w_ref[...],
                           (((1,), (0,)), ((), ())),
                           preferred_element_type=jnp.float32)  # [HW, K]
-    o_ref[...] = out.astype(o_ref.dtype).reshape(1, h, w_dim, k)
+    o_ref[...] = out.astype(o_ref.dtype).reshape(1, ho, wo, k)
     # stats of the *stored* (dtype-rounded) output: the consumer
     # normalizes the rounded tensor, so the stats must see the same values
-    of = o_ref[...].reshape(h * w_dim, k).astype(jnp.float32)
+    of = o_ref[...].reshape(ho * wo, k).astype(jnp.float32)
     s1_ref[...] += jnp.sum(of, axis=0, keepdims=True)
     s2_ref[...] += jnp.sum(of * of, axis=0, keepdims=True)
 
@@ -172,21 +199,28 @@ def _bcast_spec3(a, b, c):
 
 
 def _fwd_conv_stats(x, sc, bb, w, *, taps: int, act: str,
-                    interpret: bool):
+                    interpret: bool, stride: int = 1):
     """Dispatch one fused conv+stats pass. x [N,H,W,C]; w [C,K] (1x1) or
-    [9,C,K] (3x3). Returns (out [N,H,W,K], s1 [K], s2 [K])."""
+    [9,C,K] (3x3, stride-1 only). Returns (out [N,H/s,W/s,K], s1 [K],
+    s2 [K])."""
     n, h, wd, c = x.shape
     k = w.shape[-1]
-    kern = _fwd1x1_kernel if taps == 1 else _fwd3x3_kernel
-    w_spec = _bcast_spec(c, k) if taps == 1 else _bcast_spec3(9, c, k)
+    if taps == 1:
+        kern = functools.partial(_fwd1x1_kernel, stride=stride)
+        w_spec = _bcast_spec(c, k)
+    else:
+        assert stride == 1, "3x3 stage is stride-1 in ResNet bottlenecks"
+        kern = _fwd3x3_kernel
+        w_spec = _bcast_spec3(9, c, k)
+    ho, wo = h // stride, wd // stride
     out, s1, s2 = pl.pallas_call(
         functools.partial(kern, act=act, n_img=n),
         grid=(n,),
         in_specs=[_img_spec(h, wd, c), _bcast_spec(1, c), _bcast_spec(1, c),
                   w_spec],
-        out_specs=[_img_spec(h, wd, k), _bcast_spec(1, k),
+        out_specs=[_img_spec(ho, wo, k), _bcast_spec(1, k),
                    _bcast_spec(1, k)],
-        out_shape=[jax.ShapeDtypeStruct((n, h, wd, k), x.dtype),
+        out_shape=[jax.ShapeDtypeStruct((n, ho, wo, k), x.dtype),
                    jax.ShapeDtypeStruct((1, k), jnp.float32),
                    jax.ShapeDtypeStruct((1, k), jnp.float32)],
         interpret=interpret,
@@ -213,7 +247,7 @@ def _fwd_conv_stats(x, sc, bb, w, *, taps: int, act: str,
 def _bwd1x1_kernel(yk_ref, g_ref, yprev_ref, w_ref,
                    aff_k_ref, aff_p_ref,
                    dz_ref, dw_ref, sums_ref,
-                   *, act_prev, n_img, gmode):
+                   *, act_prev, n_img, gmode, stride=1):
     """One image of stage-k backward (k a 1x1 conv).
 
     yk_ref    [1,H,W,K]  raw conv_k output (for ŷ_k / relu' recompute)
@@ -236,39 +270,63 @@ def _bwd1x1_kernel(yk_ref, g_ref, yprev_ref, w_ref,
 
     _, h, wd, c = yprev_ref.shape
     k = yk_ref.shape[3]
-    hw = h * wd
-    g = g_ref[...].reshape(hw, k).astype(jnp.float32)
+    ho, wo = h // stride, wd // stride
+    hw_o = ho * wo
+    g = g_ref[...].reshape(hw_o, k).astype(jnp.float32)
     if gmode == "dz0":
-        yk = yk_ref[...].reshape(hw, k).astype(jnp.float32)
+        yk = yk_ref[...].reshape(hw_o, k).astype(jnp.float32)
         sck = aff_k_ref[0, :][None, :]
         invk = aff_k_ref[2, :][None, :]
         muk = aff_k_ref[3, :][None, :]
         m1 = aff_k_ref[4, :][None, :]
         m2 = aff_k_ref[5, :][None, :]
         yhat = (yk - muk) * invk
-        dy = sck * (g - m1 - yhat * m2)                     # [HW, K]
+        dy = sck * (g - m1 - yhat * m2)                     # [HWo, K]
     else:
         dy = g
-    # recompute z_{k-1}
-    yp = yprev_ref[...].reshape(hw, c).astype(jnp.float32)
-    scp = aff_p_ref[0, :][None, :]
-    bbp = aff_p_ref[1, :][None, :]
-    z0p = yp * scp + bbp
-    zp = jnp.maximum(z0p, 0.0) if act_prev == "relu" else z0p
+    # recompute z_{k-1} (full resolution; the conv consumed the
+    # ::stride subsample)
+    yp3 = yprev_ref[...].reshape(h, wd, c).astype(jnp.float32)
+    scp = aff_p_ref[0, :][None, None, :]
+    bbp = aff_p_ref[1, :][None, None, :]
+    z0p3 = yp3 * scp + bbp
+    zp3 = jnp.maximum(z0p3, 0.0) if act_prev == "relu" else z0p3
+    if stride > 1:
+        zp_s = zp3[::stride, ::stride, :].reshape(hw_o, c)
+    else:
+        zp_s = zp3.reshape(hw_o, c)
     dw_ref[...] += lax.dot_general(
-        zp.astype(yk_ref.dtype), dy.astype(yk_ref.dtype),
+        zp_s.astype(yk_ref.dtype), dy.astype(yk_ref.dtype),
         (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-    dzp = lax.dot_general(dy.astype(w_ref.dtype), w_ref[...],
+    dzs = lax.dot_general(dy.astype(w_ref.dtype), w_ref[...],
                           (((1,), (1,)), ((), ())),
-                          preferred_element_type=jnp.float32)  # [HW, C]
+                          preferred_element_type=jnp.float32)  # [HWo, C]
     if act_prev == "relu":
-        dzp = jnp.where(z0p > 0, dzp, 0.0)
+        z0_s = (z0p3[::stride, ::stride, :].reshape(hw_o, c)
+                if stride > 1 else z0p3.reshape(hw_o, c))
+        dzs = jnp.where(z0_s > 0, dzs, 0.0)
+    if stride > 1:
+        # interleave back to full resolution (gradient is zero at the
+        # positions the strided conv never read): pad+reshape, no scatter
+        dz3 = dzs.reshape(ho, 1, wo, 1, c)
+        dz3 = jnp.pad(dz3, ((0, 0), (0, stride - 1), (0, 0),
+                            (0, stride - 1), (0, 0)))
+        dzp = dz3.reshape(h, wd, c).reshape(h * wd, c)
+    else:
+        dzp = dzs
     dz_ref[...] = dzp.astype(dz_ref.dtype).reshape(1, h, wd, c)
     invp = aff_p_ref[2, :][None, :]
     mup = aff_p_ref[3, :][None, :]
-    yhat_p = (yp - mup) * invp
-    sums_ref[0:1, :] += jnp.sum(dzp, axis=0, keepdims=True)
-    sums_ref[1:2, :] += jnp.sum(dzp * yhat_p, axis=0, keepdims=True)
+    # sums over the full-res dz (zero at unread positions, so summing
+    # the strided values with strided yhat is exact)
+    if stride > 1:
+        yhat_s = (yp3[::stride, ::stride, :].reshape(hw_o, c) - mup) * invp
+        sums_ref[0:1, :] += jnp.sum(dzs, axis=0, keepdims=True)
+        sums_ref[1:2, :] += jnp.sum(dzs * yhat_s, axis=0, keepdims=True)
+    else:
+        yhat_p = (yp3.reshape(h * wd, c) - mup) * invp
+        sums_ref[0:1, :] += jnp.sum(dzp, axis=0, keepdims=True)
+        sums_ref[1:2, :] += jnp.sum(dzp * yhat_p, axis=0, keepdims=True)
 
 
 def _bwd3x3_kernel(yk_ref, g_ref, yprev_ref, w_ref,
@@ -334,19 +392,25 @@ def _bwd3x3_kernel(yk_ref, g_ref, yprev_ref, w_ref,
 
 
 def _bwd_stage(yk, g, yprev, w, aff_k, aff_p, *, taps, act_prev, gmode,
-               interpret):
-    """One backward stage pass. Returns (dz0_prev [N,H,W,C], dW, sums
-    [2,C] = (Σdz0_prev, Σdz0_prev∘ŷ_prev))."""
+               interpret, stride: int = 1):
+    """One backward stage pass. Returns (dz0_prev [N,H,W,C] full-res, dW,
+    sums [2,C] = (Σdz0_prev, Σdz0_prev∘ŷ_prev))."""
     n, h, wd, c = yprev.shape
     k = yk.shape[3]
-    kern = _bwd1x1_kernel if taps == 1 else _bwd3x3_kernel
-    w_spec = _bcast_spec(c, k) if taps == 1 else _bcast_spec3(9, c, k)
+    ho, wo = h // stride, wd // stride
+    if taps == 1:
+        kern = functools.partial(_bwd1x1_kernel, stride=stride)
+        w_spec = _bcast_spec(c, k)
+    else:
+        assert stride == 1
+        kern = _bwd3x3_kernel
+        w_spec = _bcast_spec3(9, c, k)
     dw_shape = (c, k) if taps == 1 else (9, c, k)
     dw_spec = _bcast_spec(c, k) if taps == 1 else _bcast_spec3(9, c, k)
     dz, dw, sums = pl.pallas_call(
         functools.partial(kern, act_prev=act_prev, n_img=n, gmode=gmode),
         grid=(n,),
-        in_specs=[_img_spec(h, wd, k), _img_spec(h, wd, k),
+        in_specs=[_img_spec(ho, wo, k), _img_spec(ho, wo, k),
                   _img_spec(h, wd, c), w_spec,
                   _bcast_spec(6, k), _bcast_spec(4, c)],
         out_specs=[_img_spec(h, wd, c), dw_spec, _bcast_spec(2, c)],
@@ -506,6 +570,140 @@ _bottleneck_core.defvjp(_bottleneck_vjp_fwd, _bottleneck_vjp_bwd)
 
 
 # ---------------------------------------------------------------------------
+# downsample (entry) blocks: conv skip + stride
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _bottleneck_ds_core(cfg, x, wa, wb, wc, ws, ga, be_a, gb, be_b, gc,
+                        be_c, gs, be_s):
+    """Downsample bottleneck: stride on conv_a and on the conv shortcut
+    (ws + its own BN). cfg = (eps, stride, interpret). Returns
+    (out, batch_stats8); stat cotangents ignored as in
+    _bottleneck_core."""
+    out, res = _bottleneck_ds_fwd_impl(cfg, x, wa, wb, wc, ws, ga, be_a,
+                                       gb, be_b, gc, be_c, gs, be_s)
+    return out, res[5]
+
+
+def _bottleneck_ds_fwd_impl(cfg, x, wa, wb, wc, ws, ga, be_a, gb, be_b,
+                            gc, be_c, gs, be_s):
+    eps, stride, interpret = cfg
+    n, h, wd, _ = x.shape
+    ho, wo = h // stride, wd // stride
+    count = n * ho * wo
+    ones_c = jnp.ones((x.shape[3],), jnp.float32)
+    zeros_c = jnp.zeros((x.shape[3],), jnp.float32)
+    ya, s1a, s2a = _fwd_conv_stats(x, ones_c, zeros_c, wa, taps=1,
+                                   act="identity", interpret=interpret,
+                                   stride=stride)
+    mua, vara = _finalize_stats(s1a, s2a, count)
+    sca, bba, inva = _affine(ga, be_a, mua, vara, eps)
+    yb, s1b, s2b = _fwd_conv_stats(ya, sca, bba, wb, taps=9, act="relu",
+                                   interpret=interpret)
+    mub, varb = _finalize_stats(s1b, s2b, count)
+    scb, bbb, invb = _affine(gb, be_b, mub, varb, eps)
+    yc, s1c, s2c = _fwd_conv_stats(yb, scb, bbb, wc, taps=1, act="relu",
+                                   interpret=interpret)
+    muc, varc = _finalize_stats(s1c, s2c, count)
+    scc, bbc, invc = _affine(gc, be_c, muc, varc, eps)
+    # conv shortcut: same input, own stride + BN
+    ys, s1s, s2s = _fwd_conv_stats(x, ones_c, zeros_c, ws, taps=1,
+                                   act="identity", interpret=interpret,
+                                   stride=stride)
+    mus, vars_ = _finalize_stats(s1s, s2s, count)
+    scs, bbs, invs = _affine(gs, be_s, mus, vars_, eps)
+    pre = (yc.astype(jnp.float32) * scc + bbc
+           + ys.astype(jnp.float32) * scs + bbs)
+    out = jnp.maximum(pre, 0.0).astype(x.dtype)
+    stats = (mua, vara, mub, varb, muc, varc, mus, vars_)
+    return out, (x, ya, yb, yc, ys, stats)
+
+
+def _bottleneck_ds_vjp_fwd(cfg, x, wa, wb, wc, ws, ga, be_a, gb, be_b,
+                           gc, be_c, gs, be_s):
+    out, res = _bottleneck_ds_fwd_impl(cfg, x, wa, wb, wc, ws, ga, be_a,
+                                       gb, be_b, gc, be_c, gs, be_s)
+    return (out, res[5]), \
+        res + ((wa, wb, wc, ws, ga, gb, gc, gs, be_a, be_b, be_c, be_s),)
+
+
+def _bottleneck_ds_vjp_bwd(cfg, res, cts):
+    eps, stride, interpret = cfg
+    g, _stat_cts = cts
+    x, ya, yb, yc, ys, stats, weights = res
+    wa, wb, wc, ws, ga, gb, gc, gs, be_a, be_b, be_c, be_s = weights
+    mua, vara, mub, varb, muc, varc, mus, vars_ = stats
+    n, h, wd, _ = x.shape
+    ho, wo = h // stride, wd // stride
+    count = n * ho * wo
+    sca, bba, inva = _affine(ga, be_a, mua, vara, eps)
+    scb, bbb, invb = _affine(gb, be_b, mub, varb, eps)
+    scc, bbc, invc = _affine(gc, be_c, muc, varc, eps)
+    scs, bbs, invs = _affine(gs, be_s, mus, vars_, eps)
+
+    pre = (yc.astype(jnp.float32) * scc + bbc
+           + ys.astype(jnp.float32) * scs + bbs)
+    gz = jnp.where(pre > 0, g.astype(jnp.float32), 0.0)
+    ycf = yc.astype(jnp.float32)
+    yhat_c = (ycf - muc) * invc
+    m1c = jnp.mean(gz, axis=(0, 1, 2))
+    m2c = jnp.mean(gz * yhat_c, axis=(0, 1, 2))
+    dgc = jnp.sum(gz * yhat_c, axis=(0, 1, 2))
+    dbc = jnp.sum(gz, axis=(0, 1, 2))
+    ysf = ys.astype(jnp.float32)
+    yhat_s = (ysf - mus) * invs
+    m1s = jnp.mean(gz, axis=(0, 1, 2))
+    m2s = jnp.mean(gz * yhat_s, axis=(0, 1, 2))
+    dgs = jnp.sum(gz * yhat_s, axis=(0, 1, 2))
+    dbs = jnp.sum(gz, axis=(0, 1, 2))
+
+    gzt = gz.astype(yc.dtype)
+    aff_c = _aff_rows_k(scc, bbc, invc, muc, m1c, m2c)
+    aff_b = _aff_rows_p(scb, bbb, invb, mub)
+    dz0b, dwc, sums_b = _bwd_stage(yc, gzt, yb, wc, aff_c, aff_b, taps=1,
+                                   act_prev="relu", gmode="dz0",
+                                   interpret=interpret)
+    m1b = sums_b[0] / count
+    m2b = sums_b[1] / count
+    dgb = sums_b[1]
+    dbb_ = sums_b[0]
+
+    aff_bk = _aff_rows_k(scb, bbb, invb, mub, m1b, m2b)
+    aff_a = _aff_rows_p(sca, bba, inva, mua)
+    dz0a, dwb, sums_a = _bwd_stage(yb, dz0b, ya, wb, aff_bk, aff_a,
+                                   taps=9, act_prev="relu", gmode="dz0",
+                                   interpret=interpret)
+    m1a = sums_a[0] / count
+    m2a = sums_a[1] / count
+    dga = sums_a[1]
+    dba = sums_a[0]
+
+    c_in = x.shape[3]
+    aff_id = _aff_rows_p(jnp.ones((c_in,)), jnp.zeros((c_in,)),
+                         jnp.ones((c_in,)), jnp.zeros((c_in,)))
+    aff_ak = _aff_rows_k(sca, bba, inva, mua, m1a, m2a)
+    dx_main, dwa, _ = _bwd_stage(ya, dz0a, x, wa, aff_ak, aff_id, taps=1,
+                                 act_prev="identity", gmode="dz0",
+                                 interpret=interpret, stride=stride)
+    aff_sk = _aff_rows_k(scs, bbs, invs, mus, m1s, m2s)
+    dx_skip, dws, _ = _bwd_stage(ys, gzt, x, ws, aff_sk, aff_id, taps=1,
+                                 act_prev="identity", gmode="dz0",
+                                 interpret=interpret, stride=stride)
+    dx = (dx_main.astype(jnp.float32)
+          + dx_skip.astype(jnp.float32)).astype(x.dtype)
+    return (dx, dwa.astype(wa.dtype), dwb.astype(wb.dtype),
+            dwc.astype(wc.dtype), dws.astype(ws.dtype),
+            dga.astype(ga.dtype), dba.astype(be_a.dtype),
+            dgb.astype(gb.dtype), dbb_.astype(be_b.dtype),
+            dgc.astype(gc.dtype), dbc.astype(be_c.dtype),
+            dgs.astype(gs.dtype), dbs.astype(be_s.dtype))
+
+
+_bottleneck_ds_core.defvjp(_bottleneck_ds_vjp_fwd, _bottleneck_ds_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
 # public entry
 # ---------------------------------------------------------------------------
 
@@ -517,42 +715,69 @@ def fused_bottleneck(
     wc: jax.Array, bn_c: BnParams,
     *,
     train: bool,
+    w_skip: jax.Array = None, bn_skip: BnParams = None,
+    stride: int = 1,
     eps: float = 1e-5,
     decay: float = 0.9,
     interpret: bool = False,
 ) -> Tuple[jax.Array, Tuple[jax.Array, ...]]:
-    """Identity ResNet bottleneck, fully fused.
+    """ResNet bottleneck, fully fused.
 
     x [N,H,W,Cin] NHWC (already post-ReLU block input); wa [Cin,Cmid],
-    wb [9,Cmid,Cmid] (tap-major 3x3), wc [Cmid,Cout] with Cout == Cin.
-    Returns (out, new_running_stats) where new_running_stats is the
-    6-tuple (mean_a, var_a, mean_b, var_b, mean_c, var_c) fp32, decayed
-    like layers.BatchNormalization (`new = decay·old + (1−decay)·batch`).
+    wb [9,Cmid,Cmid] (tap-major 3x3), wc [Cmid,Cout].
 
-    Inference (train=False) uses running stats — then the chain is pure
-    elementwise+matmul with no stats dependency, and the same kernels run
-    with the running-stat affines.
+    Identity form (w_skip=None, stride=1, Cout == Cin): out =
+    relu(norm_c(conv_c(...)) + x). Downsample (entry) form: w_skip
+    [Cin,Cout] + bn_skip give the conv shortcut, and `stride` applies to
+    conv_a AND the shortcut (the ResNet50 layout) — out =
+    relu(norm_c(...) + norm_s(conv_s(x))).
+
+    Returns (out, new_running_stats): 6 entries (mean/var for a,b,c) or
+    8 (+ skip) fp32, decayed like layers.BatchNormalization
+    (`new = decay·old + (1−decay)·batch`, rounding decay·old through
+    x.dtype exactly like the unfused plan).
+
+    Inference (train=False) uses running stats — the chain is then pure
+    elementwise+matmul with no stats dependency.
     """
-    cfg = (eps, interpret)
+    ds = w_skip is not None
+    if ds != (bn_skip is not None):
+        raise ValueError("w_skip and bn_skip go together")
+    if stride != 1 and not ds:
+        raise ValueError("stride != 1 requires the conv shortcut")
+
+    def _decayed(pairs):
+        # decay*old ROUNDS through x.dtype exactly like the unfused
+        # BatchNormalization (fused.py precision-chain note): under bf16
+        # the persistent running stats would otherwise drift apart
+        # between the two execution plans
+        return tuple(
+            (decay * old.astype(x.dtype) + (1.0 - decay) * new)
+            .astype(jnp.float32) for old, new in pairs)
+
     if train:
+        if ds:
+            cfg = (eps, stride, interpret)
+            out, bs = _bottleneck_ds_core(
+                cfg, x, wa, wb, wc, w_skip, bn_a.gamma, bn_a.beta,
+                bn_b.gamma, bn_b.beta, bn_c.gamma, bn_c.beta,
+                bn_skip.gamma, bn_skip.beta)
+            mua, vara, mub, varb, muc, varc, mus, vars_ = bs
+            return out, _decayed((
+                (bn_a.running_mean, mua), (bn_a.running_var, vara),
+                (bn_b.running_mean, mub), (bn_b.running_var, varb),
+                (bn_c.running_mean, muc), (bn_c.running_var, varc),
+                (bn_skip.running_mean, mus),
+                (bn_skip.running_var, vars_)))
+        cfg = (eps, interpret)
         out, batch_stats = _bottleneck_core(
             cfg, x, wa, wb, wc, bn_a.gamma, bn_a.beta, bn_b.gamma,
             bn_b.beta, bn_c.gamma, bn_c.beta)
         mua, vara, mub, varb, muc, varc = batch_stats
-        # decay*old must ROUND through x.dtype exactly like the unfused
-        # BatchNormalization (fused.py precision-chain note): under bf16
-        # the persistent running stats would otherwise drift apart
-        # between the two execution plans
-        new_stats = tuple(
-            (decay * old.astype(x.dtype) + (1.0 - decay) * new)
-            .astype(jnp.float32)
-            for old, new in ((bn_a.running_mean, mua),
-                             (bn_a.running_var, vara),
-                             (bn_b.running_mean, mub),
-                             (bn_b.running_var, varb),
-                             (bn_c.running_mean, muc),
-                             (bn_c.running_var, varc)))
-        return out, new_stats
+        return out, _decayed((
+            (bn_a.running_mean, mua), (bn_a.running_var, vara),
+            (bn_b.running_mean, mub), (bn_b.running_var, varb),
+            (bn_c.running_mean, muc), (bn_c.running_var, varc)))
     # inference: running-stat affines, no stats needed
     sca, bba, _ = _affine(bn_a.gamma.astype(jnp.float32),
                           bn_a.beta.astype(jnp.float32),
@@ -566,23 +791,40 @@ def fused_bottleneck(
     ones_c = jnp.ones((x.shape[3],), jnp.float32)
     zeros_c = jnp.zeros((x.shape[3],), jnp.float32)
     ya, _, _ = _fwd_conv_stats(x, ones_c, zeros_c, wa, taps=1,
-                               act="identity", interpret=interpret)
+                               act="identity", interpret=interpret,
+                               stride=stride)
     yb, _, _ = _fwd_conv_stats(ya, sca, bba, wb, taps=9, act="relu",
                                interpret=interpret)
     yc, _, _ = _fwd_conv_stats(yb, scb, bbb, wc, taps=1, act="relu",
                                interpret=interpret)
-    pre = yc.astype(jnp.float32) * scc + bbc + x.astype(jnp.float32)
+    if ds:
+        scs, bbs, _ = _affine(bn_skip.gamma.astype(jnp.float32),
+                              bn_skip.beta.astype(jnp.float32),
+                              bn_skip.running_mean, bn_skip.running_var,
+                              eps)
+        ys, _, _ = _fwd_conv_stats(x, ones_c, zeros_c, w_skip, taps=1,
+                                   act="identity", interpret=interpret,
+                                   stride=stride)
+        shortcut = ys.astype(jnp.float32) * scs + bbs
+    else:
+        shortcut = x.astype(jnp.float32)
+    pre = yc.astype(jnp.float32) * scc + bbc + shortcut
     out = jnp.maximum(pre, 0.0).astype(x.dtype)
     stats = (bn_a.running_mean, bn_a.running_var, bn_b.running_mean,
              bn_b.running_var, bn_c.running_mean, bn_c.running_var)
+    if ds:
+        stats = stats + (bn_skip.running_mean, bn_skip.running_var)
     return out, stats
 
 
 def reference_bottleneck(x, wa, bn_a, wb, bn_b, wc, bn_c, *, train,
+                         w_skip=None, bn_skip=None, stride=1,
                          eps=1e-5, decay=0.9):
     """Unfused jnp composition with IDENTICAL semantics — the equivalence
     oracle for the kernel chain (autodiff supplies its backward)."""
-    def conv1x1(z, w):
+    def conv1x1(z, w, s=1):
+        if s > 1:
+            z = z[:, ::s, ::s, :]
         return jnp.einsum("nhwc,ck->nhwk", z, w,
                           preferred_element_type=jnp.float32)
 
@@ -614,8 +856,8 @@ def reference_bottleneck(x, wa, bn_a, wb, bn_b, wc, bn_c, *, train,
                                                p.running_var), \
             (new_mean, new_var)
 
-    ya = conv1x1(x.astype(jnp.float32), wa.astype(jnp.float32)) \
-        .astype(x.dtype)
+    ya = conv1x1(x.astype(jnp.float32), wa.astype(jnp.float32),
+                 stride).astype(x.dtype)
     za, (mua, vara), ra = bn(ya, bn_a, train)
     za = jnp.maximum(za, 0.0)
     yb = conv3x3(za.astype(x.dtype).astype(jnp.float32),
@@ -625,5 +867,15 @@ def reference_bottleneck(x, wa, bn_a, wb, bn_b, wc, bn_c, *, train,
     yc = conv1x1(zb.astype(x.dtype).astype(jnp.float32),
                  wc.astype(jnp.float32)).astype(x.dtype)
     zc, (muc, varc), rc = bn(yc, bn_c, train)
-    out = jnp.maximum(zc + x.astype(jnp.float32), 0.0).astype(x.dtype)
-    return out, (ra[0], ra[1], rb[0], rb[1], rc[0], rc[1])
+    if w_skip is not None:
+        ys = conv1x1(x.astype(jnp.float32), w_skip.astype(jnp.float32),
+                     stride).astype(x.dtype)
+        zs, _, rs = bn(ys, bn_skip, train)
+        shortcut = zs
+    else:
+        shortcut = x.astype(jnp.float32)
+    out = jnp.maximum(zc + shortcut, 0.0).astype(x.dtype)
+    stats = (ra[0], ra[1], rb[0], rb[1], rc[0], rc[1])
+    if w_skip is not None:
+        stats = stats + (rs[0], rs[1])
+    return out, stats

@@ -60,6 +60,74 @@ class TestForwardEquivalence:
                                               512, jnp.float32)
 
 
+def _mk_ds(c_in=12, c_mid=6, c_out=16, n=3, hw=8, stride=2,
+           dtype=np.float32):
+    x = RNG.standard_normal((n, hw, hw, c_in)).astype(dtype)
+    wa = (RNG.standard_normal((c_in, c_mid)) * 0.2).astype(dtype)
+    wb = (RNG.standard_normal((9, c_mid, c_mid)) * 0.2).astype(dtype)
+    wc = (RNG.standard_normal((c_mid, c_out)) * 0.2).astype(dtype)
+    ws = (RNG.standard_normal((c_in, c_out)) * 0.2).astype(dtype)
+
+    def bn(c):
+        return BnParams(
+            gamma=(1.0 + 0.1 * RNG.standard_normal(c)).astype(dtype),
+            beta=(0.1 * RNG.standard_normal(c)).astype(dtype),
+            running_mean=RNG.standard_normal(c).astype(np.float32),
+            running_var=(1.0 + RNG.random(c)).astype(np.float32))
+
+    return x, wa, bn(c_mid), wb, bn(c_mid), wc, bn(c_out), ws, bn(c_out)
+
+
+class TestDownsampleBlock:
+    """Entry (downsample) bottlenecks: conv shortcut + stride on conv_a
+    and the shortcut, matching ResNet50's convBlock layout."""
+
+    @pytest.mark.parametrize("train,stride", [(True, 2), (False, 2),
+                                              (True, 1), (False, 1)])
+    def test_forward_matches_reference(self, train, stride):
+        x, wa, ba, wb, bb, wc, bc, ws, bs = _mk_ds(stride=stride)
+        out_f, stats_f = fused_bottleneck(
+            x, wa, ba, wb, bb, wc, bc, w_skip=ws, bn_skip=bs,
+            stride=stride, train=train, interpret=True)
+        out_r, stats_r = reference_bottleneck(
+            x, wa, ba, wb, bb, wc, bc, w_skip=ws, bn_skip=bs,
+            stride=stride, train=train)
+        np.testing.assert_allclose(out_f, out_r, atol=2e-5, rtol=2e-5)
+        assert len(stats_f) == 8
+        for sf, sr in zip(stats_f, stats_r):
+            np.testing.assert_allclose(sf, sr, atol=1e-5, rtol=1e-5)
+
+    def test_gradients_match_autodiff_of_reference(self):
+        x, wa, ba, wb, bb, wc, bc, ws, bs = _mk_ds()
+        names = ("x", "wa", "wb", "wc", "ws", "ga", "bea", "gb", "beb",
+                 "gc", "bec", "gs", "bes")
+
+        def wrap(fn):
+            def loss(x, wa, wb, wc, ws, ga, bea, gb, beb, gc, bec, gs,
+                     bes):
+                ba_ = BnParams(ga, bea, ba.running_mean, ba.running_var)
+                bb_ = BnParams(gb, beb, bb.running_mean, bb.running_var)
+                bc_ = BnParams(gc, bec, bc.running_mean, bc.running_var)
+                bs_ = BnParams(gs, bes, bs.running_mean, bs.running_var)
+                out, _ = fn(x, wa, ba_, wb, bb_, wc, bc_, w_skip=ws,
+                            bn_skip=bs_, stride=2, train=True)
+                return jnp.sum(out * jnp.sin(
+                    jnp.arange(out.size).reshape(out.shape) * 0.01))
+            return loss
+
+        f_fused = wrap(functools.partial(fused_bottleneck,
+                                         interpret=True))
+        f_ref = wrap(reference_bottleneck)
+        args = (x, wa, wb, wc, ws, ba.gamma, ba.beta, bb.gamma, bb.beta,
+                bc.gamma, bc.beta, bs.gamma, bs.beta)
+        gf = jax.grad(f_fused, argnums=tuple(range(13)))(*args)
+        gr = jax.grad(f_ref, argnums=tuple(range(13)))(*args)
+        for name, a, b in zip(names, gf, gr):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=3e-4, rtol=3e-4,
+                err_msg=f"gradient mismatch: {name}")
+
+
 class TestGraphIntegration:
     """The 'bottleneck' fusion level on a real ComputationGraph: the plan
     matches identity bottlenecks, the fused execution trains the same as
@@ -142,6 +210,86 @@ class TestGraphIntegration:
         np.testing.assert_allclose(out_f, out_r, atol=1e-4, rtol=1e-3)
         # trained BN running stats agree too
         for bn in ("blk_a_bn", "blk_b_bn", "blk_c_bn"):
+            np.testing.assert_allclose(
+                np.asarray(fus.state[bn]["mean"]),
+                np.asarray(ref.state[bn]["mean"]), atol=1e-4, rtol=1e-3,
+                err_msg=bn)
+
+    @staticmethod
+    def _ds_graph(fuse=False, h=8, c_in=8, c_mid=4, c_out=12):
+        """Graph with a DOWNSAMPLE bottleneck (stride-2 conv_a + conv
+        shortcut, the ResNet50 convBlock layout)."""
+        from deeplearning4j_tpu.nn.conf import (
+            InputType, NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.graph_conf import ElementWiseVertex
+        from deeplearning4j_tpu.nn.conf.layers import (
+            ActivationLayer, BatchNormalization, ConvolutionLayer,
+            GlobalPoolingLayer, OutputLayer)
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        g = (NeuralNetConfiguration.Builder().seed(9)
+             .weight_init("relu")
+             .graph_builder()
+             .add_inputs("input")
+             .set_input_types(InputType.convolutional(h, h, c_in)))
+
+        def conv_bn(name, n_out, kernel, stride, pad, inp,
+                    activation="relu"):
+            g.add_layer(f"{name}_conv",
+                        ConvolutionLayer(n_out=n_out, kernel=kernel,
+                                         stride=stride, padding=pad,
+                                         activation="identity",
+                                         has_bias=False), inp)
+            g.add_layer(f"{name}_bn", BatchNormalization(), f"{name}_conv")
+            if activation:
+                g.add_layer(f"{name}_act",
+                            ActivationLayer(activation=activation),
+                            f"{name}_bn")
+                return f"{name}_act"
+            return f"{name}_bn"
+
+        stem = conv_bn("stem", c_in, (3, 3), (1, 1), (1, 1), "input")
+        x = conv_bn("dsb_a", c_mid, (1, 1), (2, 2), (0, 0), stem)
+        x = conv_bn("dsb_b", c_mid, (3, 3), (1, 1), (1, 1), x)
+        x = conv_bn("dsb_c", c_out, (1, 1), (1, 1), (0, 0), x,
+                    activation=None)
+        sk = conv_bn("dsb_skip", c_out, (1, 1), (2, 2), (0, 0), stem,
+                     activation=None)
+        g.add_vertex("dsb_add", ElementWiseVertex(op="add"), x, sk)
+        g.add_layer("dsb_out", ActivationLayer(activation="relu"),
+                    "dsb_add")
+        g.add_layer("pool", GlobalPoolingLayer(pooling_type="avg"),
+                    "dsb_out")
+        g.add_layer("output", OutputLayer(n_out=4, loss="mcxent",
+                                          activation="softmax"), "pool")
+        conf = g.set_outputs("output").build()
+        conf.use_cnn_data_format("NHWC")
+        net = ComputationGraph(conf).init()
+        if fuse:
+            net.set_fusion(fuse)
+        return net
+
+    def test_downsample_plan_and_training_match(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        fus = self._ds_graph(fuse="bottleneck")
+        _, skip, bplan = fus._fusion()
+        assert list(bplan) == ["dsb_out"]
+        group = bplan["dsb_out"]
+        assert group["stride"] == 2
+        assert group["conv_skip"] == "dsb_skip_conv"
+        assert skip["dsb_skip_bn"] == "dsb_out"
+        ref = self._ds_graph(fuse=False)
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((4, 8, 8, 8)).astype(np.float32)
+        x = x.transpose(0, 3, 1, 2)          # NCHW user layout
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 4)]
+        for _ in range(3):
+            ref.fit(DataSet(x, y))
+            fus.fit(DataSet(x, y))
+        np.testing.assert_allclose(np.asarray(fus.output(x)),
+                                   np.asarray(ref.output(x)),
+                                   atol=1e-4, rtol=1e-3)
+        for bn in ("dsb_a_bn", "dsb_b_bn", "dsb_c_bn", "dsb_skip_bn"):
             np.testing.assert_allclose(
                 np.asarray(fus.state[bn]["mean"]),
                 np.asarray(ref.state[bn]["mean"]), atol=1e-4, rtol=1e-3,
