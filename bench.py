@@ -13,6 +13,10 @@ Failure modes are still one JSON line, distinguished by "error":
   - "tpu-unavailable": the TPU backend failed to initialize, hung past the
     watchdog (the tunneled platform hangs rather than erroring when the
     tunnel is down), or only a CPU backend came up. value is null.
+  - "probe-crash": the probe subprocess CRASHED (vs hung) twice running —
+    a broken env (e.g. bad LIBTPU_INIT_ARGS), not a down tunnel.
+  - "killed": an external timeout SIGTERMed us before a measurement
+    completed — says nothing about whether the tunnel was up.
   - "bench-crash": the benchmark code itself raised. value is null.
 Exit code 0 only for a real measurement.
 
@@ -23,7 +27,11 @@ BENCH_ALLOW_CPU=1 permits
 running on a CPU backend (smoke tests with tiny shapes only);
 BENCH_PLATFORM switches the jax platform via jax.config;
 BENCH_INIT_TIMEOUT backend-init watchdog seconds (default 120);
-BENCH_TOTAL_TIMEOUT whole-run watchdog seconds (default 1800).
+BENCH_TOTAL_TIMEOUT whole-run watchdog seconds (default 1800);
+Probe knobs (BENCH_PROBE_BUDGET/TIMEOUT/INTERVAL): see bench_probe.py —
+the loop retries killable subprocess probes until one answers "tpu", so
+a live window that opens minutes after launch still lands a record
+instead of losing the round to a single early watchdog.
 """
 
 import json
@@ -31,6 +39,8 @@ import os
 import sys
 import threading
 import time
+
+import bench_probe
 
 DL4J_CUDA_REF_IMG_S = 200.0  # provisional reference bar (see module docstring)
 
@@ -68,7 +78,45 @@ def _fail(kind, detail):
     return _emit(None, None, error=kind, detail=str(detail)[:300])
 
 
+def _term_line(signum):
+    return (json.dumps({
+        "metric": METRIC, "value": None, "unit": "images/sec",
+        "vs_baseline": None, "error": "killed",
+        "detail": f"killed by signal {signum} (external timeout) "
+                  "before a measurement completed"}) + "\n").encode()
+
+
+def _term_claim():
+    """Coordinate the SIGTERM emit with _emit's lock/_emitted pair:
+    lock free -> claim it (never released; the process is exiting);
+    lock held -> an emit is in flight on the interrupted frame — None
+    tells the handler to return so the line isn't truncated mid-write."""
+    global _emitted
+    if _emit_lock.acquire(blocking=False):
+        if _emitted:
+            return False
+        _emitted = True
+        return True
+    return None
+
+
 def main():
+    bench_probe.install_sigterm_handler(_term_line, _term_claim)
+
+    probe_info = {}
+    if (bench_probe.PROBE_BUDGET > 0
+            and not os.environ.get("BENCH_PLATFORM")
+            and os.environ.get("BENCH_ALLOW_CPU") != "1"):
+        platform, attempts, waited, perr = bench_probe.wait_for_tpu()
+        probe_info = {"probe_attempts": attempts,
+                      "probe_wait_s": round(waited, 1)}
+        if platform != "tpu":
+            _fail("probe-crash" if perr else "tpu-unavailable",
+                  perr or f"no TPU backend answered {attempts} probes "
+                  f"over {waited:.0f}s (last saw: {platform!r}); "
+                  "tunnel down")
+            return 3
+
     backend_up = threading.Event()
     run_done = threading.Event()
 
@@ -165,7 +213,7 @@ def main():
         img_s = BATCH * STEPS / dt
         run_done.set()
         if not _emit(round(img_s, 2), round(img_s / DL4J_CUDA_REF_IMG_S, 3),
-                     platform=platform):
+                     platform=platform, **probe_info):
             return 3          # watchdog fired first at the deadline
         return 0
     except Exception as e:

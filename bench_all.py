@@ -17,12 +17,21 @@ Prints one JSON line per config:
   correctness-path throughput only (flagged "virtual").
 
 Usage: python bench_all.py [resnet|lstm|lenet|vgg16|inception|attention|transformer|scaling]...
+
+Tunnel protection (shared with bench.py, see bench_probe.py): a probe
+loop gates the jax import so a down tunnel yields one JSON error line
+instead of a silent hang, and SIGTERM from an external `timeout` still
+emits that line. BENCH_ALLOW_CPU=1 or BENCH_PLATFORM=cpu skips the gate
+for CPU smoke runs (BENCH_PLATFORM is applied via jax.config — env
+overrides are dead under this image's sitecustomize).
 """
 
 import json
 import os
 import sys
 import time
+
+import bench_probe
 
 
 def _sync_time(step, args, steps):
@@ -589,7 +598,29 @@ ALL = {"resnet": bench_resnet, "lstm": bench_lstm, "lenet": bench_lenet,
        "decode": bench_decode, "specdec": bench_specdec,
        "specbatch": bench_specbatch}
 
+def _fail_line(kind, detail):
+    return json.dumps({"metric": "bench_all", "value": None, "unit": None,
+                       "error": kind, "detail": detail[:300]})
+
+
 if __name__ == "__main__":
+    bench_probe.install_sigterm_handler(
+        lambda signum: (_fail_line(
+            "killed", f"killed by signal {signum} (external timeout) "
+            "before completion") + "\n").encode())
+    if os.environ.get("BENCH_PLATFORM"):
+        import jax
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    elif (bench_probe.PROBE_BUDGET > 0
+            and os.environ.get("BENCH_ALLOW_CPU") != "1"):
+        platform, attempts, waited, perr = bench_probe.wait_for_tpu()
+        if platform != "tpu":
+            print(_fail_line(
+                "probe-crash" if perr else "tpu-unavailable",
+                perr or f"no TPU backend answered {attempts} probes "
+                f"over {waited:.0f}s (last saw: {platform!r})"),
+                flush=True)
+            sys.exit(3)
     names = sys.argv[1:] or ["resnet", "lstm", "lenet", "vgg16",
                              "inception", "attention", "transformer",
                              "scaling", "word2vec"]

@@ -6,6 +6,13 @@
 set -u -o pipefail
 cd "$(dirname "$0")"
 LOG=bench_flags.log
+# Sweep runs only during a live window: cap the probe loop well under
+# the 580s per-run timeout so a tunnel drop fails each entry in ~55s
+# with a JSON line instead of burning the full timeout probing. The
+# per-probe timeout must sit under the budget or the budget is inert
+# (one probe would blow straight through it).
+export BENCH_PROBE_BUDGET=${BENCH_PROBE_BUDGET:-60}
+export BENCH_PROBE_TIMEOUT=${BENCH_PROBE_TIMEOUT:-55}
 run() {
   local tag="$1"; shift
   echo "--- $tag ($*)" | tee -a "$LOG"

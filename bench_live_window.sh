@@ -5,6 +5,20 @@
 set -uo pipefail
 cd "$(dirname "$0")"
 LOG=${1:-bench_live.log}
+# Gate ONCE up front (a probe costs a full throwaway TPU-client init,
+# ~5-40s — paying it per entry would burn minutes of a scarce live
+# window), then disable the per-entry probe loop. If the tunnel drops
+# mid-playbook, bench.py's init/total watchdogs and both entry points'
+# SIGTERM handlers still produce parseable failure lines.
+if ! BENCH_PROBE_BUDGET=${BENCH_PROBE_BUDGET:-120} timeout 200 python -c '
+import sys, bench_probe
+p, a, w, e = bench_probe.wait_for_tpu()
+print(f"gate: platform={p!r} attempts={a} waited={w:.0f}s {e}")
+sys.exit(0 if p == "tpu" else 3)' | tee -a "$LOG"; then
+  echo "tunnel not live; aborting playbook" | tee -a "$LOG"
+  exit 3
+fi
+export BENCH_PROBE_BUDGET=0
 
 run() {
   local name="$1"; shift

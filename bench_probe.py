@@ -1,0 +1,139 @@
+"""Tunnel probe/watchdog machinery shared by bench.py and bench_all.py.
+
+The tunneled TPU platform appears and disappears without warning, and a
+jax import HANGS (not errors) while the tunnel is down: a bench process
+that imports jax directly can therefore block forever before printing
+its contractual JSON line. Each probe here is a SUBPROCESS — a hang
+costs one killable child, not the bench process — and the loop retries
+until a probe answers "tpu" or the budget runs out, so a live window
+that opens minutes after launch still produces a measurement.
+
+Env knobs (shared by both entry points):
+  BENCH_PROBE_BUDGET   total seconds to spend probing (default 1200;
+                       0 disables the loop entirely)
+  BENCH_PROBE_TIMEOUT  per-probe subprocess kill timeout (default 70 —
+                       a live tunnel answers in ~5-40s, a dead one
+                       hangs forever)
+  BENCH_PROBE_INTERVAL sleep between probe attempts (default 20)
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+PROBE_BUDGET = float(os.environ.get("BENCH_PROBE_BUDGET", "1200"))
+PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", "70"))
+PROBE_INTERVAL = float(os.environ.get("BENCH_PROBE_INTERVAL", "20"))
+
+_probe_child = None
+
+
+def probe_once():
+    """One subprocess jax-backend probe. Returns (platform, err):
+    platform is "tpu"/"cpu" on success, "" on hang or crash; err is ""
+    for a hang (the down-tunnel signature) but carries the stderr tail
+    when the child CRASHED — e.g. a bad LIBTPU_INIT_ARGS inherited from
+    a flag sweep — so callers don't misreport env bugs as tunnel-down."""
+    global _probe_child
+    try:
+        _probe_child = subprocess.Popen(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    except OSError as e:
+        return "", f"probe spawn failed: {e}"
+    try:
+        out, err = _probe_child.communicate(timeout=PROBE_TIMEOUT)
+        rc = _probe_child.returncode
+    except subprocess.TimeoutExpired:
+        _probe_child.kill()
+        try:
+            _probe_child.communicate(timeout=10)
+        except Exception:
+            pass
+        return "", ""
+    finally:
+        _probe_child = None
+    lines = (out or "").strip().splitlines()
+    platform = lines[-1].strip() if lines else ""
+    if rc != 0 and not platform:
+        tail = (err or "").strip().splitlines()
+        return "", f"probe crashed rc={rc}: {tail[-1][:200] if tail else '?'}"
+    return platform, ""
+
+
+def kill_probe_child():
+    """Kill any in-flight probe subprocess. Called from SIGTERM handlers
+    so an external timeout doesn't orphan a hung jax-import child that
+    could grab the TPU client when the tunnel returns."""
+    child = _probe_child
+    if child is not None:
+        try:
+            child.kill()
+        except Exception:
+            pass
+
+
+def wait_for_tpu():
+    """Retry probes until one answers "tpu" or PROBE_BUDGET runs out.
+    Two consecutive probe CRASHES (vs hangs) abort early — a crash means
+    the environment is broken (bad flag, missing lib), and retrying for
+    the full budget would just bury the real error as "tunnel down".
+    Returns (platform_or_None, attempts, waited_seconds, detail)."""
+    start = time.monotonic()
+    deadline = start + PROBE_BUDGET
+    attempts = 0
+    crashes = 0
+    last_err = ""
+    while True:
+        attempts += 1
+        platform, err = probe_once()
+        if platform == "tpu":
+            return platform, attempts, time.monotonic() - start, ""
+        if err:
+            crashes += 1
+            last_err = err
+            if crashes >= 2:
+                return None, attempts, time.monotonic() - start, last_err
+        else:
+            crashes = 0
+        now = time.monotonic()
+        if now >= deadline:
+            return platform or None, attempts, now - start, last_err
+        time.sleep(min(PROBE_INTERVAL, deadline - now))
+
+
+def install_sigterm_handler(make_line_bytes, try_claim=None):
+    """Install a SIGTERM handler (external `timeout` wrappers) that
+    kills any in-flight probe child and emits one pre-serialized JSON
+    line via os.write — print() into buffered stdout is not signal-safe
+    (non-reentrant lock / BufferedWriter RuntimeError).
+
+    make_line_bytes(signum) -> bytes for the failure line (with "\\n").
+    try_claim() -> True (emit, then exit 3) | False (already emitted —
+    exit without a second line) | None (an emit is IN FLIGHT on the
+    interrupted frame: return from the handler so it can finish instead
+    of truncating it mid-write; the emitter exits on its own).
+    Default claim: always emit once."""
+    claimed = [False]
+
+    def _default_claim():
+        if claimed[0]:
+            return False
+        claimed[0] = True
+        return True
+
+    claim = try_claim or _default_claim
+
+    def _handler(signum, frame):
+        kill_probe_child()
+        verdict = claim()
+        if verdict is None:
+            return
+        if verdict:
+            os.write(1, make_line_bytes(signum))
+        os._exit(3)
+
+    signal.signal(signal.SIGTERM, _handler)
