@@ -29,9 +29,20 @@ overrides are dead under this image's sitecustomize).
 import json
 import os
 import sys
+import threading
 import time
 
 import bench_probe
+
+_print_lock = threading.Lock()
+
+
+def _print_line(s, flush=True):
+    """All result lines go through this lock so the SIGTERM handler can
+    tell 'mid-print' (don't interleave/truncate — let it finish) from
+    'safe to emit the killed line'."""
+    with _print_lock:
+        print(s, flush=flush)
 
 
 def _sync_time(step, args, steps):
@@ -71,7 +82,7 @@ def bench_resnet():
             None, None)
     _, args = _sync_time(step, args, 3)  # warmup
     dt, _ = _sync_time(step, args, 10)
-    print(json.dumps({"metric": "resnet50_train", "value": round(B * 10 / dt, 1),
+    _print_line(json.dumps({"metric": "resnet50_train", "value": round(B * 10 / dt, 1),
                       "unit": "images/sec"}), flush=True)
 
 
@@ -99,7 +110,7 @@ def bench_lstm():
             jnp.asarray(y), key, None, None)
     _, args = _sync_time(step, args, 3)
     dt, _ = _sync_time(step, args, 10)
-    print(json.dumps({"metric": "lstm_train", "value": round(B * T * 10 / dt, 1),
+    _print_line(json.dumps({"metric": "lstm_train", "value": round(B * T * 10 / dt, 1),
                       "unit": "tokens/sec"}), flush=True)
 
 
@@ -122,7 +133,7 @@ def bench_lenet():
             jnp.asarray(y), key, None, None)
     _, args = _sync_time(step, args, 3)
     dt, _ = _sync_time(step, args, 20)
-    print(json.dumps({"metric": "lenet_train", "value": round(B * 20 / dt, 1),
+    _print_line(json.dumps({"metric": "lenet_train", "value": round(B * 20 / dt, 1),
                       "unit": "images/sec"}), flush=True)
 
 
@@ -154,7 +165,7 @@ def bench_vgg16():
                 jnp.asarray(y), key, None, None)
     _, args = _sync_time(step, args, 3)
     dt, _ = _sync_time(step, args, 10)
-    print(json.dumps({"metric": "vgg16_train", "value": round(B * 10 / dt, 1),
+    _print_line(json.dumps({"metric": "vgg16_train", "value": round(B * 10 / dt, 1),
                       "unit": "images/sec"}), flush=True)
 
 
@@ -198,7 +209,7 @@ def bench_keras_inception():
         out = net.output(x)
     float(jnp.sum(head(out)[:1, :1]))
     dt = time.perf_counter() - t0
-    print(json.dumps({"metric": "keras_inceptionv3_infer",
+    _print_line(json.dumps({"metric": "keras_inceptionv3_infer",
                       "value": round(B * n / dt, 1), "unit": "images/sec"}), flush=True)
 
 
@@ -229,7 +240,7 @@ def bench_attention():
         o = f(o, k, v)
     float(jnp.float32(o[0, 0, 0, 0]))
     dt = (time.perf_counter() - t0) / n
-    print(json.dumps({"metric": f"blockwise_attention_T{T}",
+    _print_line(json.dumps({"metric": f"blockwise_attention_T{T}",
                       "value": round(B * T / dt, 1), "unit": "tokens/sec"}), flush=True)
 
 
@@ -262,7 +273,7 @@ def bench_transformer():
             {net.conf.network_outputs[0]: jnp.asarray(y)}, key, None, None)
     _, args = _sync_time(step, args, 3)
     dt, _ = _sync_time(step, args, 10)
-    print(json.dumps({"metric": f"transformer_train_T{T}",
+    _print_line(json.dumps({"metric": f"transformer_train_T{T}",
                       "value": round(B * T * 10 / dt, 1),
                       "unit": "tokens/sec"}), flush=True)
 
@@ -280,7 +291,7 @@ def bench_scaling():
                 "dryrun_multichip(8); print('ok')")],
             capture_output=True, text=True, timeout=900)
         ok = r.returncode == 0 and "ok" in r.stdout
-        print(json.dumps({"metric": "scaling_8dev", "value": 1.0 if ok else 0.0,
+        _print_line(json.dumps({"metric": "scaling_8dev", "value": 1.0 if ok else 0.0,
                           "unit": "dryrun_ok(virtual)"}), flush=True)
         return
     import jax.numpy as jnp
@@ -310,7 +321,7 @@ def bench_scaling():
     for _ in range(10):
         pw.fit([ds])
     dt = time.perf_counter() - t0
-    print(json.dumps({"metric": "scaling_8dev",
+    _print_line(json.dumps({"metric": "scaling_8dev",
                       "value": round(B * 10 / dt, 1), "unit": "images/sec"}), flush=True)
 
 
@@ -349,7 +360,7 @@ def bench_window_attention():
                     0.5 * blockwise_attention(q, k, v, causal=True,
                                               window=W, block_size=4096))
     tf, tl = bench(full), bench(local)
-    print(json.dumps({"metric": f"window_attention_T{T}_W{W}",
+    _print_line(json.dumps({"metric": f"window_attention_T{T}_W{W}",
                       "value": round(B * T / tl, 1), "unit": "tokens/sec",
                       "full_causal_tokens_per_sec": round(B * T / tf, 1)}), flush=True)
 
@@ -385,7 +396,7 @@ def bench_word2vec():
     # scalar host fetch: dispatches are async, the queue must drain
     float(np.asarray(w2v.syn0[0, 0]))
     dt = time.perf_counter() - t0
-    print(json.dumps({"metric": "word2vec_train", "unit": "words/sec",
+    _print_line(json.dumps({"metric": "word2vec_train", "unit": "words/sec",
                       "value": round(total_words / dt, 1)}), flush=True)
 
 
@@ -428,7 +439,7 @@ def bench_quant():
     fp = measure()
     quantize_for_inference(net)
     q = measure()
-    print(json.dumps({"metric": "quant_mlp_int8_speedup",
+    _print_line(json.dumps({"metric": "quant_mlp_int8_speedup",
                       "value": round(fp / q, 2), "unit": "x",
                       "fp32_ms": round(fp * 1e3, 2),
                       "int8_ms": round(q * 1e3, 2)}), flush=True)
@@ -464,7 +475,7 @@ def bench_decode():
     model.sample_stream_batch(net, prompts, steps=STEPS, top_k=1)
     dt_batch = time.perf_counter() - t0
     total = B * STEPS
-    print(json.dumps({"metric": "decode_batch8_vs_sequential",
+    _print_line(json.dumps({"metric": "decode_batch8_vs_sequential",
                       "value": round(total / dt_batch, 1),
                       "unit": "tokens/sec",
                       "sequential_tokens_per_sec": round(total / dt_seq, 1),
@@ -529,7 +540,7 @@ def bench_specdec():
     finally:
         type(net).rnn_time_step = orig
     assert spec == plain, "speculative greedy must equal plain greedy"
-    print(json.dumps({
+    _print_line(json.dumps({
         "metric": "specdec_prompt_lookup",
         "value": round(STEPS / dt_spec, 1),
         "unit": "tokens/sec",
@@ -579,7 +590,7 @@ def bench_specbatch():
     model.sample_stream_batch(net, prompts, steps=STEPS, top_k=1)
     dt_plainb = time.perf_counter() - t0
     total = B * STEPS
-    print(json.dumps({
+    _print_line(json.dumps({
         "metric": "specdec_batched8",
         "value": round(total / dt_batch, 1),
         "unit": "tokens/sec",
@@ -604,10 +615,16 @@ def _fail_line(kind, detail):
 
 
 if __name__ == "__main__":
+    def _term_claim():
+        # mid-print: returning None lets the interrupted print finish
+        # instead of interleaving the killed line into it
+        return True if _print_lock.acquire(blocking=False) else None
+
     bench_probe.install_sigterm_handler(
         lambda signum: (_fail_line(
             "killed", f"killed by signal {signum} (external timeout) "
-            "before completion") + "\n").encode())
+            "before completion") + "\n").encode(),
+        _term_claim)
     if os.environ.get("BENCH_PLATFORM"):
         import jax
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])

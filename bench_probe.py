@@ -30,7 +30,7 @@ PROBE_INTERVAL = float(os.environ.get("BENCH_PROBE_INTERVAL", "20"))
 _probe_child = None
 
 
-def probe_once():
+def probe_once(timeout=None):
     """One subprocess jax-backend probe. Returns (platform, err):
     platform is "tpu"/"cpu" on success, "" on hang or crash; err is ""
     for a hang (the down-tunnel signature) but carries the stderr tail
@@ -45,7 +45,8 @@ def probe_once():
     except OSError as e:
         return "", f"probe spawn failed: {e}"
     try:
-        out, err = _probe_child.communicate(timeout=PROBE_TIMEOUT)
+        out, err = _probe_child.communicate(
+            timeout=PROBE_TIMEOUT if timeout is None else timeout)
         rc = _probe_child.returncode
     except subprocess.TimeoutExpired:
         _probe_child.kill()
@@ -76,8 +77,16 @@ def kill_probe_child():
             pass
 
 
+#: a probe needs this long to have any chance of answering (a live
+#: tunnel takes ~5-40s to init) — shorter remaining budget isn't spent
+_MIN_USEFUL_PROBE = 15.0
+
+
 def wait_for_tpu():
     """Retry probes until one answers "tpu" or PROBE_BUDGET runs out.
+    Each probe's timeout is clamped to the remaining budget (so wall
+    time can't overshoot the budget by a whole PROBE_TIMEOUT), and a
+    remainder too short for a probe to possibly succeed isn't spent.
     Two consecutive probe CRASHES (vs hangs) abort early — a crash means
     the environment is broken (bad flag, missing lib), and retrying for
     the full budget would just bury the real error as "tunnel down".
@@ -87,9 +96,12 @@ def wait_for_tpu():
     attempts = 0
     crashes = 0
     last_err = ""
+    platform = ""
     while True:
         attempts += 1
-        platform, err = probe_once()
+        remaining = deadline - time.monotonic()
+        platform, err = probe_once(
+            min(PROBE_TIMEOUT, max(remaining, _MIN_USEFUL_PROBE)))
         if platform == "tpu":
             return platform, attempts, time.monotonic() - start, ""
         if err:
@@ -100,7 +112,7 @@ def wait_for_tpu():
         else:
             crashes = 0
         now = time.monotonic()
-        if now >= deadline:
+        if deadline - now < _MIN_USEFUL_PROBE:
             return platform or None, attempts, now - start, last_err
         time.sleep(min(PROBE_INTERVAL, deadline - now))
 

@@ -30,9 +30,19 @@ image — MXU-shaped, no halo exchange, no dynamic shapes.
 Scope: identity bottlenecks (stride 1, identity skip) AND downsample
 entry blocks (stride-2 conv_a + conv shortcut with its own BN — the
 ResNet50 convBlock layout); ReLU activations, NHWC, train or inference.
-Blocks whose worst kernel would exceed the VMEM budget (ResNet50
-stage 5, c_mid=512) honestly fall back to the unfused path via
-fused_bottleneck_supported.
+
+Backward kernels whose resident weight+fp32-dW or recompute buffers
+would exceed the VMEM budget (ResNet50 stage-5 3x3 backward:
+[9,512,512] w + fp32 dW ~ 14 MB; the entry-block conv-skip backwards)
+run CHANNEL-SPLIT: grid (n_cb, n) with a C_in-slice of the weight, dW,
+dz, recompute buffers and BN sums per step. A conv backward partitions
+exactly over input channels — dW rows, dz slices, the relu' mask and
+the sum epilogues are all C-local; only dy (a function of the full
+K-dim gradient) is recomputed per slice, which at the affected 7x7/14x14
+resolutions is noise. cb is the OUTER grid dim so each dW/sums slice
+stays VMEM-resident across the whole image sweep and is written back
+exactly once — no HBM accumulation revisits anywhere. With the split,
+all 16 ResNet50 blocks pass the gate (fused_bottleneck_supported).
 
 ref: the reference's fused-conv ambition lives in
 deeplearning4j-cuda/.../CudnnConvolutionHelper.java:54-480 (cuDNN
@@ -65,15 +75,65 @@ class BnParams(NamedTuple):
     running_var: jax.Array    # [C] fp32
 
 
+def _fwd_vmem(taps, h, w, c, k, bpe, stride=1):
+    """Per-grid-step VMEM estimate for a forward conv+stats kernel:
+    one image [h,w,c] + fp32 prologue buffer, fp32 accumulator + stored
+    output at [h/s,w/s,k], and the full weight."""
+    ho, wo = h // stride, w // stride
+    if taps == 9:
+        return ((h + 2) * (w + 2) * c * 4      # padded z fp32
+                + h * w * c * bpe              # x image
+                + h * w * k * (4 + bpe)        # acc fp32 + stored out
+                + 9 * c * k * bpe)
+    return (h * w * c * (4 + bpe)              # x + fp32 affine buffer
+            + ho * wo * k * (4 + bpe)          # acc fp32 + stored out
+            + c * k * bpe)
+
+
+def _bwd_vmem(taps, h, w, c_b, k, bpe, stride=1, identity_prologue=False):
+    """Per-grid-step VMEM estimate for a backward kernel holding a
+    C_b-slice of the input channels. The full-K buffers (yk, g, dy) do
+    not shrink with the split; everything C-indexed does. The identity
+    prologue (stage-a / conv-skip backward: z_prev IS the block input)
+    skips the affine/relu recompute buffers and the sums math."""
+    ho, wo = h // stride, w // stride
+    if taps == 9:
+        return ((h + 2) * (w + 2) * (c_b + k) * 4   # z_pad slice + dy_pad
+                + h * w * k * (4 + 2 * bpe)         # dy fp32 + yk + g
+                + h * w * c_b * (2 * bpe + 8)       # yprev, dz, dzp/yhat f32
+                + 9 * c_b * k * (bpe + 4))          # w + fp32 dW slice
+    full = h * w * c_b
+    recompute = 4 if identity_prologue else 12      # fp32 z-recompute bufs
+    # at stride 1 dzp aliases dzs and the strided views don't exist
+    strided = 0 if stride == 1 else ho * wo * c_b * 8 + full * 4
+    return (full * (bpe + recompute + 4 + bpe)      # yprev, rcmp, dzs, dz
+            + strided
+            + ho * wo * k * (4 + 2 * bpe)           # dy fp32 + yk + g
+            + c_b * k * (bpe + 4))                  # w + fp32 dW slice
+
+
+def _pick_csplit(taps, h, w, c, k, bpe, stride=1, identity_prologue=False):
+    """Smallest input-channel split whose per-step footprint fits the
+    VMEM budget. Slices must stay lane-aligned (C_b a multiple of 128)
+    — returns None when no aligned split fits (caller falls back to the
+    unfused graph)."""
+    split = 1
+    while True:
+        if _bwd_vmem(taps, h, w, c // split, k, bpe, stride,
+                     identity_prologue) <= _VMEM_BUDGET:
+            return split
+        split *= 2
+        if c % split or (c // split) % 128:
+            return None
+
+
 def fused_bottleneck_supported(x_shape, c_mid: int, c_out: int,
                                dtype, stride: int = 1,
                                has_skip: bool = False) -> bool:
-    """Conservative VMEM gate for the per-image whole-image blocks —
-    sized for the WORST kernel of the chain. Candidates: the 3x3 stage's
-    backward (padded z/dy images + the [9,C,C] weight AND its fp32 dW
-    block resident) and the stage-a / conv-skip backward (full-input-
-    resolution fp32 recompute buffers at c_in channels). Strided forms
-    also require even spatial dims (the kernels subsample exactly)."""
+    """VMEM gate, per-kernel: every forward pass must fit whole-image,
+    and every backward stage must fit either whole-image or via an
+    aligned channel split (_pick_csplit). Strided forms also require
+    exact stride divisibility (the kernels subsample exactly)."""
     if len(x_shape) != 4:
         return False
     n, h, w, c_in = x_shape
@@ -83,27 +143,21 @@ def fused_bottleneck_supported(x_shape, c_mid: int, c_out: int,
         dtype = jnp.bfloat16
     bpe = jnp.dtype(dtype).itemsize
     ho, wo = h // stride, w // stride
-    mid_img = ho * wo * bpe                       # post-stride resolution
-    pad_img = (ho + 2) * (wo + 2) * c_mid * 4     # fp32 padded recompute
-    fwd_worst = (pad_img + mid_img * c_mid * 2
-                 + max(c_in * c_mid, c_mid * c_out,
-                       9 * c_mid * c_mid) * bpe
-                 + ho * wo * c_mid * 4)
-    bwd_3x3 = (pad_img * 2                        # z_pad + dy_pad fp32
-               + mid_img * c_mid * 2              # yk + dz images
-               + 9 * c_mid * c_mid * (bpe + 4))   # w + fp32 dW block
-    # stage-a backward (and the conv-skip backward, same shape with
-    # c_out in place of c_mid): ~3 full-res fp32 c_in buffers
-    # (yp/z0p/dz) + the dz output block + yk/g blocks + w/dw
-    def bwd_1x1(k_ch):
-        return (h * w * c_in * (3 * 4 + bpe)
-                + ho * wo * k_ch * 2 * bpe
-                + c_in * k_ch * (bpe + 4))
-
-    worst = max(fwd_worst, bwd_3x3, bwd_1x1(c_mid))
+    fwd = [_fwd_vmem(1, h, w, c_in, c_mid, bpe, stride),      # conv_a
+           _fwd_vmem(9, ho, wo, c_mid, c_mid, bpe),           # conv_b
+           _fwd_vmem(1, ho, wo, c_mid, c_out, bpe)]           # conv_c
     if has_skip:
-        worst = max(worst, bwd_1x1(c_out))
-    return worst <= _VMEM_BUDGET
+        fwd.append(_fwd_vmem(1, h, w, c_in, c_out, bpe, stride))
+    if max(fwd) > _VMEM_BUDGET:
+        return False
+    # (taps, h, w, C=yprev channels, K, stride, identity_prologue)
+    bwd = [(1, ho, wo, c_mid, c_out, 1, False),               # stage c
+           (9, ho, wo, c_mid, c_mid, 1, False),               # stage b
+           (1, h, w, c_in, c_mid, stride, True)]              # stage a
+    if has_skip:
+        bwd.append((1, h, w, c_in, c_out, stride, True))      # conv skip
+    return all(_pick_csplit(t, hh, ww, c, k, bpe, s, ident) is not None
+               for t, hh, ww, c, k, s, ident in bwd)
 
 
 # ---------------------------------------------------------------------------
@@ -247,8 +301,9 @@ def _fwd_conv_stats(x, sc, bb, w, *, taps: int, act: str,
 def _bwd1x1_kernel(yk_ref, g_ref, yprev_ref, w_ref,
                    aff_k_ref, aff_p_ref,
                    dz_ref, dw_ref, sums_ref,
-                   *, act_prev, n_img, gmode, stride=1):
-    """One image of stage-k backward (k a 1x1 conv).
+                   *, act_prev, n_img, gmode, stride=1, img_axis=0):
+    """One image (or one image × C-slice) of stage-k backward (k a 1x1
+    conv).
 
     yk_ref    [1,H,W,K]  raw conv_k output (for ŷ_k / relu' recompute)
     g_ref     [1,H,W,K]  dz0_k when gmode=='dz0' (already relu-masked),
@@ -260,8 +315,19 @@ def _bwd1x1_kernel(yk_ref, g_ref, yprev_ref, w_ref,
     dz_ref    [1,H,W,C]  OUT: dz0_{k-1}
     dw_ref    [C,K]      OUT: dW_k
     sums_ref  [2,C] fp32 OUT: Σdz0_{k-1}, Σdz0_{k-1}∘ŷ_{k-1}
+
+    Under a channel split every C-dim ref carries a C_b slice and the
+    grid is (n_cb, n) with img_axis=1: the math is identical because a
+    1x1 conv backward is C-local (dz columns, dw rows, the mask and the
+    sums all partition; only dy spans K and is recomputed per slice).
+
+    act_prev == "identity" asserts the FULL identity prologue (stage-a /
+    conv-skip backward: z_{k-1} IS the block input, affine rows are
+    (1,0) by construction) — the kernel then skips the affine/mask
+    recompute and leaves the (caller-discarded) sums at zero.
     """
-    i = pl.program_id(0)
+    i = pl.program_id(img_axis)
+    identity = act_prev == "identity"
 
     @pl.when(i == 0)
     def _init():
@@ -287,10 +353,13 @@ def _bwd1x1_kernel(yk_ref, g_ref, yprev_ref, w_ref,
     # recompute z_{k-1} (full resolution; the conv consumed the
     # ::stride subsample)
     yp3 = yprev_ref[...].reshape(h, wd, c).astype(jnp.float32)
-    scp = aff_p_ref[0, :][None, None, :]
-    bbp = aff_p_ref[1, :][None, None, :]
-    z0p3 = yp3 * scp + bbp
-    zp3 = jnp.maximum(z0p3, 0.0) if act_prev == "relu" else z0p3
+    if identity:
+        z0p3 = zp3 = yp3
+    else:
+        scp = aff_p_ref[0, :][None, None, :]
+        bbp = aff_p_ref[1, :][None, None, :]
+        z0p3 = yp3 * scp + bbp
+        zp3 = jnp.maximum(z0p3, 0.0) if act_prev == "relu" else z0p3
     if stride > 1:
         zp_s = zp3[::stride, ::stride, :].reshape(hw_o, c)
     else:
@@ -315,6 +384,8 @@ def _bwd1x1_kernel(yk_ref, g_ref, yprev_ref, w_ref,
     else:
         dzp = dzs
     dz_ref[...] = dzp.astype(dz_ref.dtype).reshape(1, h, wd, c)
+    if identity:
+        return    # sums are only consumed by a real BN prologue
     invp = aff_p_ref[2, :][None, :]
     mup = aff_p_ref[3, :][None, :]
     # sums over the full-res dz (zero at unread positions, so summing
@@ -332,11 +403,13 @@ def _bwd1x1_kernel(yk_ref, g_ref, yprev_ref, w_ref,
 def _bwd3x3_kernel(yk_ref, g_ref, yprev_ref, w_ref,
                    aff_k_ref, aff_p_ref,
                    dz_ref, dw_ref, sums_ref,
-                   *, act_prev, n_img, gmode):
+                   *, act_prev, n_img, gmode, img_axis=0):
     """3x3 twin of _bwd1x1_kernel: w_ref [9,C,K];
     dW via nine shifted-input matmuls, dz_{k-1} via the transposed taps
-    (full-correlation with the flipped kernel)."""
-    i = pl.program_id(0)
+    (full-correlation with the flipped kernel). Channel-split form as in
+    _bwd1x1_kernel (the zero-padding, tap shifts and mask are C-local;
+    the 3x3 stage always has a real BN prologue, so no identity path)."""
+    i = pl.program_id(img_axis)
 
     @pl.when(i == 0)
     def _init():
@@ -394,26 +467,71 @@ def _bwd3x3_kernel(yk_ref, g_ref, yprev_ref, w_ref,
 def _bwd_stage(yk, g, yprev, w, aff_k, aff_p, *, taps, act_prev, gmode,
                interpret, stride: int = 1):
     """One backward stage pass. Returns (dz0_prev [N,H,W,C] full-res, dW,
-    sums [2,C] = (Σdz0_prev, Σdz0_prev∘ŷ_prev))."""
+    sums [2,C] = (Σdz0_prev, Σdz0_prev∘ŷ_prev)).
+
+    Picks the channel split from the same VMEM model as the support
+    gate: split == 1 is the whole-image kernel on grid (n,); split > 1
+    runs grid (split, n) — cb OUTER, so each dW/sums slice is resident
+    across the image sweep and written back once. The two forms are
+    arithmetically identical (same fp32 accumulation order per slice)."""
     n, h, wd, c = yprev.shape
     k = yk.shape[3]
     ho, wo = h // stride, wd // stride
-    if taps == 1:
-        kern = functools.partial(_bwd1x1_kernel, stride=stride)
-        w_spec = _bcast_spec(c, k)
-    else:
-        assert stride == 1
-        kern = _bwd3x3_kernel
-        w_spec = _bcast_spec3(9, c, k)
+    bpe = jnp.dtype(yprev.dtype).itemsize
+    split = _pick_csplit(taps, h, wd, c, k, bpe, stride,
+                         act_prev == "identity")
+    if split is None:
+        raise ValueError(
+            f"no aligned channel split fits VMEM for backward stage "
+            f"taps={taps} h={h} w={wd} c={c} k={k} stride={stride} — "
+            "fused_bottleneck_supported should have rejected this block")
     dw_shape = (c, k) if taps == 1 else (9, c, k)
-    dw_spec = _bcast_spec(c, k) if taps == 1 else _bcast_spec3(9, c, k)
+    if split == 1:
+        if taps == 1:
+            kern = functools.partial(_bwd1x1_kernel, stride=stride)
+            w_spec = _bcast_spec(c, k)
+            dw_spec = _bcast_spec(c, k)
+        else:
+            assert stride == 1
+            kern = _bwd3x3_kernel
+            w_spec = _bcast_spec3(9, c, k)
+            dw_spec = _bcast_spec3(9, c, k)
+        grid = (n,)
+        in_specs = [_img_spec(ho, wo, k), _img_spec(ho, wo, k),
+                    _img_spec(h, wd, c), w_spec,
+                    _bcast_spec(6, k), _bcast_spec(4, c)]
+        out_specs = [_img_spec(h, wd, c), dw_spec, _bcast_spec(2, c)]
+    else:
+        c_b = c // split
+        if taps == 1:
+            kern = functools.partial(_bwd1x1_kernel, stride=stride,
+                                     img_axis=1)
+            w_spec = pl.BlockSpec((c_b, k), lambda cb, i: (cb, 0))
+            dw_spec = pl.BlockSpec((c_b, k), lambda cb, i: (cb, 0))
+        else:
+            assert stride == 1
+            kern = functools.partial(_bwd3x3_kernel, img_axis=1)
+            w_spec = pl.BlockSpec((9, c_b, k), lambda cb, i: (0, cb, 0))
+            dw_spec = pl.BlockSpec((9, c_b, k), lambda cb, i: (0, cb, 0))
+        grid = (split, n)
+        in_specs = [
+            pl.BlockSpec((1, ho, wo, k), lambda cb, i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, ho, wo, k), lambda cb, i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, h, wd, c_b), lambda cb, i: (i, 0, 0, cb)),
+            w_spec,
+            pl.BlockSpec((6, k), lambda cb, i: (0, 0)),
+            pl.BlockSpec((4, c_b), lambda cb, i: (0, cb)),
+        ]
+        out_specs = [
+            pl.BlockSpec((1, h, wd, c_b), lambda cb, i: (i, 0, 0, cb)),
+            dw_spec,
+            pl.BlockSpec((2, c_b), lambda cb, i: (0, cb)),
+        ]
     dz, dw, sums = pl.pallas_call(
         functools.partial(kern, act_prev=act_prev, n_img=n, gmode=gmode),
-        grid=(n,),
-        in_specs=[_img_spec(ho, wo, k), _img_spec(ho, wo, k),
-                  _img_spec(h, wd, c), w_spec,
-                  _bcast_spec(6, k), _bcast_spec(4, c)],
-        out_specs=[_img_spec(h, wd, c), dw_spec, _bcast_spec(2, c)],
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
         out_shape=[jax.ShapeDtypeStruct((n, h, wd, c), yprev.dtype),
                    jax.ShapeDtypeStruct(dw_shape, jnp.float32),
                    jax.ShapeDtypeStruct((2, c), jnp.float32)],
