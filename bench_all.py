@@ -35,14 +35,22 @@ import time
 import bench_probe
 
 _print_lock = threading.Lock()
+_pending_kill = [None]   # signum parked by a SIGTERM that hit mid-print
 
 
 def _print_line(s, flush=True):
     """All result lines go through this lock so the SIGTERM handler can
     tell 'mid-print' (don't interleave/truncate — let it finish) from
-    'safe to emit the killed line'."""
+    'safe to emit the killed line'. A SIGTERM that lands mid-print is
+    PARKED, not dropped: once this line is safely out, emit the killed
+    record and honor the termination."""
     with _print_lock:
         print(s, flush=flush)
+    if _pending_kill[0] is not None:
+        os.write(1, (_fail_line(
+            "killed", f"killed by signal {_pending_kill[0]} (external "
+            "timeout) before completion") + "\n").encode())
+        os._exit(3)
 
 
 def _sync_time(step, args, steps):
@@ -601,13 +609,149 @@ def bench_specbatch():
         flush=True)
 
 
+def _converge_run(net, x, y, steps, record_every):
+    """Fixed-seed training loop recording the loss trajectory. Each
+    recorded point is a scalar host fetch — a real sync (the tunneled
+    platform's block_until_ready is unreliable), and since params change
+    every step the dispatches are never cache-identical."""
+    import jax
+    import jax.numpy as jnp
+    step = net._get_train_step(False)
+    if hasattr(net.conf, "network_inputs"):
+        inputs = {net.conf.network_inputs[0]: jnp.asarray(x)}
+        labels = {net.conf.network_outputs[0]: jnp.asarray(y)}
+    else:
+        inputs, labels = jnp.asarray(x), jnp.asarray(y)
+    key = jax.random.PRNGKey(0)
+    p, s, u = net.params, net.state, net.updater_state
+    traj = []
+    for i in range(1, steps + 1):
+        p, s, u, loss = step(p, s, u, inputs, labels, key, None, None)
+        if i <= 5 or i % record_every == 0 or i == steps:
+            traj.append(round(float(loss), 6))
+    net.params, net.state, net.updater_state = p, s, u
+    return traj
+
+
+def _converge_fixture_path(name):
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tests", "fixtures", f"convergence_{name}_cpu.json")
+
+
+def _converge_report(name, traj, steps, extra=None):
+    """Compare a trajectory against the committed CPU fixture (generated
+    by running this entry with BENCH_PLATFORM=cpu BENCH_WRITE_FIXTURE=1)
+    and print the one-line record. Tolerances: the first 5 steps are
+    pre-chaos and must track within 5%; by the end the plans/platforms
+    have decorrelated chaotically, so the bar is the mean of the last 3
+    recorded losses within 15% plus a >50% total decrease on both sides
+    — the honest envelope for 'same arithmetic, same convergence'."""
+    import numpy as np
+    import jax
+    path = _converge_fixture_path(name)
+    rec = {"metric": f"converge_{name}",
+           "platform": jax.devices()[0].platform,
+           "steps_recorded": len(traj), "first": traj[0],
+           "final_mean3": round(float(np.mean(traj[-3:])), 6),
+           **(extra or {})}
+    if os.environ.get("BENCH_WRITE_FIXTURE") == "1":
+        with open(path, "w") as f:
+            json.dump({"trajectory": traj, "steps": steps,
+                       **(extra or {})}, f)
+        rec["fixture_written"] = path
+    elif os.path.exists(path):
+        with open(path) as f:
+            ref = json.load(f)
+        rt = ref["trajectory"]
+        if ref.get("steps") != steps or len(rt) != len(traj):
+            # a config mismatch is not chip-arithmetic divergence —
+            # refuse the comparison rather than misattribute it
+            rec["vs_cpu"] = (f"fixture mismatch: fixture steps="
+                             f"{ref.get('steps')}/{len(rt)} pts vs run "
+                             f"{steps}/{len(traj)} pts")
+            _print_line(json.dumps(rec), flush=True)
+            return
+        early = [abs(a - b) / max(abs(b), 1e-9)
+                 for a, b in zip(traj[:5], rt[:5])]
+        fin_a = float(np.mean(traj[-3:]))
+        fin_b = float(np.mean(rt[-3:]))
+        final_dev = abs(fin_a - fin_b) / max(abs(fin_b), 1e-9)
+        decreased = (traj[-1] < 0.5 * traj[0]
+                     and rt[-1] < 0.5 * rt[0])
+        rec["vs_cpu"] = {
+            "max_early_dev": round(max(early), 4),
+            "final_dev": round(final_dev, 4),
+            "ok": bool(max(early) < 0.05 and final_dev < 0.15
+                       and decreased)}
+    else:
+        rec["vs_cpu"] = "no fixture (generate with BENCH_WRITE_FIXTURE=1 "
+        rec["vs_cpu"] += "on cpu)"
+    _print_line(json.dumps(rec), flush=True)
+
+
+def bench_converge_lenet():
+    """On-chip convergence evidence (VERDICT r5 task 3b): LeNet trained
+    to accuracy on the deterministic synthetic MNIST stand-in (this
+    build is zero-egress — no real IDX files; the parity claim is
+    numerical: chip arithmetic trains exactly like CPU on identical
+    data). ref: deeplearning4j-zoo/.../LeNet.java + BASELINE configs[0]."""
+    import numpy as np
+    from deeplearning4j_tpu.datasets.fetchers import MnistDataSetIterator
+    from deeplearning4j_tpu.zoo import LeNet
+    from deeplearning4j_tpu.nn.updater import Adam
+
+    steps = int(os.environ.get("BENCH_CONV_STEPS", "300"))
+    it = MnistDataSetIterator(batch_size=4096, synthetic=True,
+                              num_examples=4096, shuffle=False, seed=11)
+    ds = next(iter(it))
+    x = np.asarray(ds.features).reshape(-1, 1, 28, 28)
+    y = np.asarray(ds.labels)
+    net = LeNet(num_classes=10, updater=Adam(0.001)).init()
+    traj = _converge_run(net, x[:2048], y[:2048], steps, 10)
+    # held-out accuracy on the remaining synthetic rows
+    out = np.asarray(net.output(x[2048:]))
+    acc = float((out.argmax(1) == y[2048:].argmax(1)).mean())
+    _converge_report("lenet", traj, steps, {"holdout_acc": round(acc, 4)})
+
+
+def bench_converge_resnet():
+    """On-chip convergence evidence (VERDICT r5 task 3a): fixed-seed
+    100-step ResNet50 loss trajectory, chip vs the committed CPU
+    fixture. BENCH_FUSE=2 runs the fused-bottleneck plan (same
+    comparison: the plans are equivalence-pinned; the chip run proves
+    the arithmetic on real hardware). Reduced shapes (64x64, batch 16)
+    keep the CPU fixture generable in minutes; the arithmetic exercised
+    is the full ResNet50 graph."""
+    import numpy as np
+    from deeplearning4j_tpu.zoo import ResNet50
+    from deeplearning4j_tpu.nn.updater import Nesterovs
+
+    steps = int(os.environ.get("BENCH_CONV_STEPS", "100"))
+    fuse = {"0": False, "1": True, "2": "bottleneck"}.get(
+        os.environ.get("BENCH_FUSE", "0"), False)
+    net = ResNet50(num_classes=100, height=64, width=64,
+                   updater=Nesterovs(0.005, momentum=0.9),
+                   data_format="NHWC", fuse=fuse).init()
+    net.conf.dtype = "bfloat16"
+    rng = np.random.default_rng(3)
+    labels = rng.integers(0, 100, 16)
+    x = (rng.standard_normal((16, 3, 64, 64))
+         + labels[:, None, None, None] * 0.03).astype(np.float32)
+    y = np.zeros((16, 100), np.float32)
+    y[np.arange(16), labels] = 1.0
+    traj = _converge_run(net, x, y, steps, 10)
+    _converge_report("resnet", traj, steps, {"fuse": str(fuse)})
+
+
 ALL = {"resnet": bench_resnet, "lstm": bench_lstm, "lenet": bench_lenet,
        "vgg16": bench_vgg16, "inception": bench_keras_inception,
        "attention": bench_attention, "transformer": bench_transformer,
        "scaling": bench_scaling, "word2vec": bench_word2vec,
        "window": bench_window_attention, "quant": bench_quant,
        "decode": bench_decode, "specdec": bench_specdec,
-       "specbatch": bench_specbatch}
+       "specbatch": bench_specbatch,
+       "converge_lenet": bench_converge_lenet,
+       "converge_resnet": bench_converge_resnet}
 
 def _fail_line(kind, detail):
     return json.dumps({"metric": "bench_all", "value": None, "unit": None,
@@ -616,9 +760,12 @@ def _fail_line(kind, detail):
 
 if __name__ == "__main__":
     def _term_claim():
-        # mid-print: returning None lets the interrupted print finish
-        # instead of interleaving the killed line into it
-        return True if _print_lock.acquire(blocking=False) else None
+        # mid-print: park the kill (returning None lets the interrupted
+        # print finish; _print_line then emits the killed line + exits)
+        if _print_lock.acquire(blocking=False):
+            return True
+        _pending_kill[0] = 15
+        return None
 
     bench_probe.install_sigterm_handler(
         lambda signum: (_fail_line(
@@ -632,11 +779,10 @@ if __name__ == "__main__":
             and os.environ.get("BENCH_ALLOW_CPU") != "1"):
         platform, attempts, waited, perr = bench_probe.wait_for_tpu()
         if platform != "tpu":
-            print(_fail_line(
+            _print_line(_fail_line(
                 "probe-crash" if perr else "tpu-unavailable",
                 perr or f"no TPU backend answered {attempts} probes "
-                f"over {waited:.0f}s (last saw: {platform!r})"),
-                flush=True)
+                f"over {waited:.0f}s (last saw: {platform!r})"))
             sys.exit(3)
     names = sys.argv[1:] or ["resnet", "lstm", "lenet", "vgg16",
                              "inception", "attention", "transformer",

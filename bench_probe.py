@@ -114,7 +114,11 @@ def wait_for_tpu():
         now = time.monotonic()
         if deadline - now < _MIN_USEFUL_PROBE:
             return platform or None, attempts, now - start, last_err
-        time.sleep(min(PROBE_INTERVAL, deadline - now))
+        # keep at least a useful probe's worth of budget after sleeping —
+        # sleeping into the final window and then probing anyway would
+        # overshoot the deadline by up to _MIN_USEFUL_PROBE
+        time.sleep(min(PROBE_INTERVAL,
+                       max(deadline - now - _MIN_USEFUL_PROBE, 1.0)))
 
 
 def install_sigterm_handler(make_line_bytes, try_claim=None):

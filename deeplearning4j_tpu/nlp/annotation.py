@@ -284,6 +284,31 @@ class PosAnnotator(Annotator):
                 prev = tag
 
 
+class TrainedPosAnnotator(Annotator):
+    """Penn tags from the in-repo trained perceptron (pos_tagger.py) —
+    the equivalent of PoStagger.java's trained OpenNLP maxent model,
+    measured ~+10 points token accuracy over the PosAnnotator
+    lexicon+suffix baseline on the held-out fixture sentences
+    (tests/test_pos_tagger.py). Tags whole sentences at once (the model
+    uses two-token context each side plus predicted tag history)."""
+
+    def __init__(self, tagger=None):
+        if tagger is None:
+            from deeplearning4j_tpu.nlp.pos_tagger import default_tagger
+            tagger = default_tagger()
+        self.tagger = tagger
+
+    def process(self, doc: AnnotatedDocument) -> None:
+        for s in doc.select("sentence") or [Annotation(0, len(doc.text),
+                                                       "sentence")]:
+            tokens = doc.covered(s, "token")
+            if not tokens:
+                continue
+            words = [doc.covered_text(t) for t in tokens]
+            for t, tag in zip(tokens, self.tagger.tag(words)):
+                t.features["pos"] = tag
+
+
 class AnalysisEngine:
     """Ordered annotator pipeline over raw text (UimaResource.java role:
     owns the engine, `process(text)` returns a populated document).
@@ -317,9 +342,13 @@ class AnalysisEngine:
         return cls(anns)
 
     @classmethod
-    def pos_tagger(cls) -> "AnalysisEngine":
+    def pos_tagger(cls, trained: bool = True) -> "AnalysisEngine":
+        """trained=True (default) uses the in-repo perceptron model —
+        the analogue of the reference's trained OpenNLP tagger;
+        trained=False keeps the rule/lexicon baseline."""
+        pos = TrainedPosAnnotator() if trained else PosAnnotator()
         return cls([SentenceAnnotator(), TokenizerAnnotator(),
-                    StemmerAnnotator(), PosAnnotator()])
+                    StemmerAnnotator(), pos])
 
 
 # ---------------------------------------------------------------------------

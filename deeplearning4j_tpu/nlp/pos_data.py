@@ -1,0 +1,282 @@
+"""Curated POS-annotated corpus (Penn Treebank tagset) for training the
+in-repo perceptron tagger (pos_tagger.py).
+
+The reference wraps real trained OpenNLP models
+(deeplearning4j-nlp-uima/src/main/java/org/deeplearning4j/text/annotator/
+PoStagger.java); this build is zero-egress, so the training data is
+authored in-repo: a handwritten section covering irregular morphology,
+questions, clauses and punctuation conventions, plus deterministic
+template expansions that give exact tags for regular constructions at
+volume. Sentences are (word, tag) lists; `train_test_split()` carves a
+fixed held-out set (every 5th sentence) for the A/B in
+tests/test_pos_tagger.py.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+Tagged = List[Tuple[str, str]]
+
+# ---------------------------------------------------------------------------
+# handwritten sentences — irregulars, clauses, questions, punctuation
+# ---------------------------------------------------------------------------
+
+_H = [
+    "The/DT old/JJ man/NN sat/VBD on/IN the/DT wooden/JJ bench/NN ./.",
+    "She/PRP quickly/RB wrote/VBD a/DT long/JJ letter/NN to/TO her/PRP$ "
+    "brother/NN ./.",
+    "They/PRP have/VBP been/VBN waiting/VBG for/IN hours/NNS ./.",
+    "He/PRP did/VBD not/RB know/VB what/WP to/TO say/VB ./.",
+    "What/WP do/VBP you/PRP want/VB ?/.",
+    "Where/WRB did/VBD the/DT children/NNS go/VB ?/.",
+    "The/DT committee/NN has/VBZ approved/VBN the/DT new/JJ budget/NN ./.",
+    "I/PRP think/VBP that/IN she/PRP is/VBZ right/JJ ./.",
+    "Although/IN it/PRP was/VBD raining/VBG ,/, we/PRP went/VBD "
+    "outside/RB ./.",
+    "The/DT dog/NN that/WDT bit/VBD me/PRP ran/VBD away/RB ./.",
+    "His/PRP$ answer/NN was/VBD better/JJR than/IN mine/PRP ./.",
+    "This/DT is/VBZ the/DT best/JJS result/NN we/PRP have/VBP ever/RB "
+    "seen/VBN ./.",
+    "Can/MD you/PRP help/VB me/PRP with/IN this/DT problem/NN ?/.",
+    "The/DT children/NNS were/VBD playing/VBG in/IN the/DT garden/NN ./.",
+    "Nobody/NN knew/VBD why/WRB the/DT meeting/NN was/VBD cancelled/VBN ./.",
+    "We/PRP will/MD probably/RB arrive/VB before/IN noon/NN ./.",
+    "The/DT company/NN reported/VBD strong/JJ earnings/NNS last/JJ "
+    "quarter/NN ./.",
+    "Prices/NNS rose/VBD sharply/RB in/IN March/NNP ./.",
+    "Mr./NNP Smith/NNP leads/VBZ the/DT research/NN team/NN ./.",
+    "London/NNP and/CC Paris/NNP are/VBP large/JJ cities/NNS ./.",
+    "My/PRP$ sister/NN teaches/VBZ mathematics/NN at/IN a/DT local/JJ "
+    "school/NN ./.",
+    "The/DT water/NN was/VBD too/RB cold/JJ for/IN swimming/NN ./.",
+    "He/PRP gave/VBD her/PRP the/DT keys/NNS and/CC left/VBD ./.",
+    "If/IN you/PRP see/VBP him/PRP ,/, tell/VB him/PRP to/TO call/VB "
+    "me/PRP ./.",
+    "Several/JJ students/NNS failed/VBD the/DT difficult/JJ exam/NN ./.",
+    "The/DT results/NNS were/VBD surprisingly/RB good/JJ ./.",
+    "She/PRP has/VBZ never/RB eaten/VBN sushi/NN before/RB ./.",
+    "Both/DT teams/NNS played/VBD very/RB well/RB ./.",
+    "It/PRP took/VBD three/CD years/NNS to/TO build/VB the/DT bridge/NN ./.",
+    "The/DT first/JJ chapter/NN explains/VBZ the/DT basic/JJ ideas/NNS ./.",
+    "Most/JJS people/NNS agree/VBP with/IN the/DT decision/NN ./.",
+    "He/PRP was/VBD born/VBN in/IN 1985/CD in/IN Chicago/NNP ./.",
+    "The/DT train/NN leaves/VBZ at/IN 10:30/CD every/DT morning/NN ./.",
+    "Her/PRP$ latest/JJS novel/NN sold/VBD 50,000/CD copies/NNS ./.",
+    "There/EX is/VBZ a/DT small/JJ shop/NN near/IN the/DT station/NN ./.",
+    "There/EX were/VBD many/JJ reasons/NNS for/IN the/DT delay/NN ./.",
+    "Who/WP wrote/VBD this/DT wonderful/JJ song/NN ?/.",
+    "Whose/WP$ coat/NN is/VBZ hanging/VBG by/IN the/DT door/NN ?/.",
+    "The/DT weather/NN has/VBZ been/VBN unusually/RB warm/JJ ./.",
+    "You/PRP should/MD have/VB told/VBN me/PRP earlier/RBR ./.",
+    "The/DT cat/NN slept/VBD while/IN the/DT mice/NNS played/VBD ./.",
+    "Running/VBG every/DT day/NN keeps/VBZ him/PRP healthy/JJ ./.",
+    "Broken/VBN windows/NNS were/VBD replaced/VBN immediately/RB ./.",
+    "The/DT quickly/RB moving/VBG storm/NN caused/VBD damage/NN ./.",
+    "I/PRP bought/VBD apples/NNS ,/, oranges/NNS and/CC bread/NN ./.",
+    "Neither/DT answer/NN seems/VBZ correct/JJ to/TO me/PRP ./.",
+    "The/DT book/NN on/IN the/DT table/NN belongs/VBZ to/TO John/NNP ./.",
+    "Everyone/NN enjoyed/VBD the/DT performance/NN last/JJ night/NN ./.",
+    "His/PRP$ decision/NN to/TO resign/VB shocked/VBD us/PRP all/DT ./.",
+    "The/DT more/RBR you/PRP practice/VBP ,/, the/DT better/RBR you/PRP "
+    "become/VBP ./.",
+    "Scientists/NNS discovered/VBD a/DT new/JJ species/NN of/IN frog/NN ./.",
+    "The/DT government/NN announced/VBD tax/NN cuts/NNS yesterday/NN ./.",
+    "Interest/NN rates/NNS fell/VBD to/TO 3.5/CD %/NN last/JJ week/NN ./.",
+    "She/PRP speaks/VBZ French/NNP fluently/RB ./.",
+    "Do/VBP not/RB open/VB that/DT box/NN !/.",
+    "Have/VBP you/PRP finished/VBN your/PRP$ homework/NN yet/RB ?/.",
+    "The/DT river/NN flows/VBZ through/IN four/CD countries/NNS ./.",
+    "An/DT honest/JJ answer/NN is/VBZ always/RB appreciated/VBN ./.",
+    "They/PRP had/VBD already/RB gone/VBN when/WRB we/PRP arrived/VBD ./.",
+    "The/DT fastest/JJS runner/NN won/VBD a/DT gold/NN medal/NN ./.",
+    "Our/PRP$ neighbors/NNS are/VBP building/VBG a/DT new/JJ garage/NN ./.",
+    "Some/DT birds/NNS cannot/MD fly/VB ./.",
+    "The/DT museum/NN closes/VBZ at/IN five/CD on/IN Sundays/NNPS ./.",
+    "A/DT sudden/JJ noise/NN woke/VBD the/DT sleeping/VBG baby/NN ./.",
+    "I/PRP would/MD rather/RB stay/VB home/NN tonight/NN ./.",
+    "The/DT teacher/NN explained/VBD the/DT lesson/NN again/RB ./.",
+    "Workers/NNS demanded/VBD higher/JJR wages/NNS and/CC shorter/JJR "
+    "hours/NNS ./.",
+    "That/DT was/VBD the/DT funniest/JJS joke/NN I/PRP have/VBP "
+    "heard/VBN ./.",
+    "He/PRP carefully/RB placed/VBD the/DT vase/NN on/IN the/DT "
+    "shelf/NN ./.",
+    "The/DT old/JJ bridge/NN was/VBD torn/VBN down/RP in/IN 2010/CD ./.",
+    "Children/NNS learn/VBP languages/NNS faster/RBR than/IN adults/NNS ./.",
+    "She/PRP felt/VBD happier/JJR after/IN the/DT holiday/NN ./.",
+    "The/DT committee/NN will/MD meet/VB again/RB next/JJ Tuesday/NNP ./.",
+    "Its/PRP$ engine/NN makes/VBZ a/DT strange/JJ sound/NN ./.",
+    "Nothing/NN could/MD stop/VB the/DT growing/VBG crowd/NN ./.",
+    "The/DT recently/RB published/VBN report/NN criticizes/VBZ the/DT "
+    "plan/NN ./.",
+    "Tom/NNP 's/POS car/NN is/VBZ parked/VBN outside/RB ./.",
+    "The/DT students/NNS '/POS projects/NNS impressed/VBD the/DT "
+    "judges/NNS ./.",
+    "We/PRP saw/VBD them/PRP leaving/VBG the/DT building/NN ./.",
+    "It/PRP is/VBZ hard/JJ to/TO believe/VB his/PRP$ story/NN ./.",
+    "The/DT sun/NN rises/VBZ in/IN the/DT east/NN ./.",
+    "Why/WRB are/VBP you/PRP laughing/VBG ?/.",
+    "Because/IN of/IN the/DT storm/NN ,/, flights/NNS were/VBD "
+    "delayed/VBN ./.",
+    "Each/DT player/NN receives/VBZ two/CD cards/NNS ./.",
+    "Music/NN helps/VBZ me/PRP relax/VB after/IN work/NN ./.",
+    "The/DT wounded/JJ soldier/NN slowly/RB recovered/VBD ./.",
+    "Many/JJ visitors/NNS come/VBP here/RB every/DT summer/NN ./.",
+    "A/DT loud/JJ argument/NN broke/VBD out/RP in/IN the/DT hall/NN ./.",
+    "She/PRP turned/VBD off/RP the/DT lights/NNS and/CC left/VBD ./.",
+    "He/PRP looked/VBD up/RP the/DT word/NN in/IN a/DT dictionary/NN ./.",
+    "The/DT plane/NN took/VBD off/RP on/IN time/NN ./.",
+    "Please/UH write/VB down/RP your/PRP$ name/NN ./.",
+    "Well/UH ,/, that/DT went/VBD better/RBR than/IN expected/VBN ./.",
+    "Oh/UH ,/, I/PRP nearly/RB forgot/VBD the/DT tickets/NNS ./.",
+    "The/DT data/NNS show/VBP a/DT clear/JJ trend/NN ./.",
+    "These/DT figures/NNS include/VBP all/DT overseas/JJ sales/NNS ./.",
+    "However/RB ,/, the/DT plan/NN has/VBZ serious/JJ flaws/NNS ./.",
+    "Meanwhile/RB ,/, the/DT crowd/NN grew/VBD restless/JJ ./.",
+    "About/IN twenty/CD people/NNS attended/VBD the/DT lecture/NN ./.",
+    "The/DT temperature/NN dropped/VBD below/IN zero/CD overnight/RB ./.",
+]
+
+# ---------------------------------------------------------------------------
+# deterministic template expansions — regular morphology at volume
+# ---------------------------------------------------------------------------
+
+_DETS = [("the", "DT"), ("a", "DT"), ("every", "DT"), ("this", "DT")]
+_ADJS = [("small", "JJ"), ("bright", "JJ"), ("quiet", "JJ"),
+         ("heavy", "JJ"), ("modern", "JJ"), ("narrow", "JJ")]
+_NOUNS = [("farmer", "NN"), ("engine", "NN"), ("village", "NN"),
+          ("painter", "NN"), ("market", "NN"), ("garden", "NN"),
+          ("teacher", "NN"), ("window", "NN")]
+_NOUNS_PL = [("farmers", "NNS"), ("engines", "NNS"), ("villages", "NNS"),
+             ("painters", "NNS"), ("markets", "NNS"), ("gardens", "NNS")]
+_VERBS_D = [("opened", "VBD"), ("cleaned", "VBD"), ("repaired", "VBD"),
+            ("watched", "VBD"), ("visited", "VBD"), ("painted", "VBD")]
+_VERBS_Z = [("opens", "VBZ"), ("cleans", "VBZ"), ("repairs", "VBZ"),
+            ("watches", "VBZ"), ("visits", "VBZ"), ("paints", "VBZ")]
+_ADVS = [("slowly", "RB"), ("often", "RB"), ("rarely", "RB"),
+         ("gently", "RB")]
+_PREPS = [("near", "IN"), ("behind", "IN"), ("inside", "IN"),
+          ("beyond", "IN")]
+_MODALS = [("will", "MD"), ("might", "MD"), ("should", "MD"),
+           ("can", "MD"), ("could", "MD"), ("must", "MD"),
+           ("would", "MD")]
+_PRONS = [("he", "PRP"), ("she", "PRP"), ("it", "PRP"),
+          ("they", "PRP"), ("we", "PRP"), ("you", "PRP"), ("i", "PRP")]
+_VERBS_B = [("open", "VB"), ("clean", "VB"), ("repair", "VB"),
+            ("watch", "VB"), ("visit", "VB"), ("paint", "VB")]
+_VERBS_G = [("opening", "VBG"), ("cleaning", "VBG"), ("repairing", "VBG"),
+            ("watching", "VBG"), ("visiting", "VBG"), ("painting", "VBG")]
+
+
+def _templates() -> List[Tagged]:
+    out = []
+    dot = (".", ".")
+    # Det (Adj) Noun Verb-past Det Noun .
+    for i in range(48):
+        d1 = _DETS[i % len(_DETS)]
+        a1 = _ADJS[i % len(_ADJS)]
+        n1 = _NOUNS[i % len(_NOUNS)]
+        v = _VERBS_D[(i * 5 + 1) % len(_VERBS_D)]
+        d2 = _DETS[(i + 2) % len(_DETS)]
+        n2 = _NOUNS[(i + 3) % len(_NOUNS)]
+        out.append([d1, a1, n1, v, d2, n2, dot])
+    # Det Noun Verb-s Adv .  /  Det Noun-pl Adv Verb-past .
+    for i in range(36):
+        d = _DETS[i % len(_DETS)]
+        n = _NOUNS[(i * 3 + 1) % len(_NOUNS)]
+        vz = _VERBS_Z[i % len(_VERBS_Z)]
+        adv = _ADVS[i % len(_ADVS)]
+        out.append([d, n, vz, adv, dot])
+        npl = _NOUNS_PL[i % len(_NOUNS_PL)]
+        vd = _VERBS_D[(i * 7 + 2) % len(_VERBS_D)]
+        out.append([("the", "DT"), npl, adv, vd, dot])
+    # Det Noun Modal Verb-base Prep Det Adj Noun .
+    for i in range(36):
+        d = _DETS[(i + 1) % len(_DETS)]
+        n = _NOUNS[i % len(_NOUNS)]
+        m = _MODALS[i % len(_MODALS)]
+        vb = _VERBS_B[(i * 5 + 2) % len(_VERBS_B)]
+        p = _PREPS[i % len(_PREPS)]
+        a = _ADJS[(i + 3) % len(_ADJS)]
+        n2 = _NOUNS[(i + 5) % len(_NOUNS)]
+        out.append([d, n, m, vb, p, ("the", "DT"), a, n2, dot])
+    # Pron Modal Verb-base (Det Noun) — every 3rd WITHOUT final punct
+    # (an all-"./."-final corpus teaches `nothing-follows => .`, which
+    # mis-tags the last word of unpunctuated fragments)
+    for i in range(42):
+        pr = _PRONS[i % len(_PRONS)]
+        m = _MODALS[i % len(_MODALS)]
+        vb = _VERBS_B[(i * 5 + 1) % len(_VERBS_B)]
+        d = _DETS[i % len(_DETS)]
+        n = _NOUNS[(i * 3 + 2) % len(_NOUNS)]
+        sent = [pr, m, vb, d, n]
+        if i % 3:
+            sent.append(dot)
+        out.append(sent)
+    # Pron was/were Verb-ing Det Noun .  (PRP aux progressive)
+    prons = [("he", "PRP"), ("she", "PRP"), ("it", "PRP"),
+             ("they", "PRP"), ("we", "PRP")]
+    for i in range(30):
+        pr = prons[i % len(prons)]
+        aux = ("were", "VBD") if pr[0] in ("they", "we") else ("was", "VBD")
+        vg = _VERBS_G[i % len(_VERBS_G)]
+        d = _DETS[i % len(_DETS)]
+        n = _NOUNS[(i * 3 + 2) % len(_NOUNS)]
+        out.append([pr, aux, vg, d, n, dot])
+    # Proper-noun sentences: Name Verb-s Det Noun Prep Name .
+    names = [("Anna", "NNP"), ("Berlin", "NNP"), ("Carter", "NNP"),
+             ("Diana", "NNP"), ("Edward", "NNP"), ("Tokyo", "NNP")]
+    for i in range(30):
+        nm = names[i % len(names)]
+        vz = _VERBS_Z[(i + 1) % len(_VERBS_Z)]
+        d = _DETS[i % len(_DETS)]
+        n = _NOUNS[(i * 5 + 3) % len(_NOUNS)]
+        p = _PREPS[(i + 1) % len(_PREPS)]
+        nm2 = names[(i + 2) % len(names)]
+        out.append([nm, vz, d, n, p, nm2, dot])
+    # Possessive: PRP$ Noun Verb-s/-d (Det Noun) .
+    poss = [("my", "PRP$"), ("your", "PRP$"), ("his", "PRP$"),
+            ("her", "PRP$"), ("its", "PRP$"), ("our", "PRP$"),
+            ("their", "PRP$")]
+    for i in range(35):
+        ps = poss[i % len(poss)]
+        n = _NOUNS[(i * 3 + 1) % len(_NOUNS)]
+        if i % 2:
+            v = _VERBS_Z[i % len(_VERBS_Z)]
+        else:
+            v = _VERBS_D[i % len(_VERBS_D)]
+        d = _DETS[(i + 1) % len(_DETS)]
+        n2 = _NOUNS[(i + 4) % len(_NOUNS)]
+        out.append([ps, n, v, d, n2, dot])
+    # Numeric: Det Noun Verb-d CD Noun-pl .
+    nums = [("three", "CD"), ("seven", "CD"), ("40", "CD"), ("1,200", "CD")]
+    for i in range(24):
+        d = _DETS[i % len(_DETS)]
+        n = _NOUNS[(i + 1) % len(_NOUNS)]
+        v = _VERBS_D[i % len(_VERBS_D)]
+        cd = nums[i % len(nums)]
+        npl = _NOUNS_PL[(i + 2) % len(_NOUNS_PL)]
+        out.append([d, n, v, cd, npl, dot])
+    return out
+
+
+def _parse(line: str) -> Tagged:
+    toks = []
+    for pair in line.split():
+        word, _, tag = pair.rpartition("/")
+        toks.append((word, tag))
+    return toks
+
+
+def corpus() -> List[Tagged]:
+    """The full tagged corpus: handwritten + template expansions."""
+    return [_parse(s) for s in _H] + _templates()
+
+
+def train_test_split() -> Tuple[List[Tagged], List[Tagged]]:
+    """Deterministic split: every 5th sentence held out."""
+    sents = corpus()
+    train = [s for i, s in enumerate(sents) if i % 5 != 0]
+    test = [s for i, s in enumerate(sents) if i % 5 == 0]
+    return train, test

@@ -1,0 +1,222 @@
+"""Trained POS tagger: greedy averaged perceptron (Collins 2002).
+
+The reference's UIMA annotator wraps a trained OpenNLP maxent model
+(deeplearning4j-nlp-uima/src/main/java/org/deeplearning4j/text/annotator/
+PoStagger.java:39-76 — loads en-pos-maxent.bin and tags per sentence);
+this build is zero-egress, so the equivalent is trained in-repo on the
+curated corpus in pos_data.py. Same contract: sentence in, one Penn tag
+per token, trained weights rather than rules.
+
+The model is the standard structured-perceptron feature set (word,
+affixes, shape, previous tags, surrounding words) with weight averaging
+for generalization; training is deterministic (fixed shuffle seed), so
+every build produces identical weights. `default_tagger()` trains once
+per process (<1 s on the bundled corpus) and caches.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from collections import defaultdict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def _shape(word: str) -> str:
+    if word.isdigit():
+        return "d"
+    if any(ch.isdigit() for ch in word):
+        return "mixd"
+    if word.isupper():
+        return "AA"
+    if word[:1].isupper():
+        return "Aa"
+    if "-" in word:
+        return "a-a"
+    return "a"
+
+
+def _features(i: int, word: str, context: Sequence[str],
+              prev: str, prev2: str) -> List[str]:
+    """Feature strings for token i. context is the padded word list
+    (two leading/trailing sentinels)."""
+    j = i + 2
+    low = word.lower()
+    feats = [
+        "b",                            # bias
+        "w=" + low,
+        "suf3=" + low[-3:],
+        "suf2=" + low[-2:],
+        "suf1=" + low[-1:],
+        "pre1=" + low[:1],
+        "shape=" + _shape(word),
+        "t1=" + prev,
+        "t2=" + prev2,
+        "t12=" + prev + "+" + prev2,
+        "w-1=" + context[j - 1],
+        "w-2=" + context[j - 2],
+        "w+1=" + context[j + 1],
+        "w+2=" + context[j + 2],
+        "t1w=" + prev + "+" + low,
+        "w-1suf3=" + context[j - 1][-3:],
+        "w+1suf3=" + context[j + 1][-3:],
+    ]
+    return feats
+
+
+class PerceptronPosTagger:
+    """Greedy left-to-right averaged perceptron tagger."""
+
+    START = ("-S1-", "-S2-")
+
+    def __init__(self):
+        self.weights: Dict[str, Dict[str, float]] = {}
+        self.classes: List[str] = []
+        self.tagdict: Dict[str, str] = {}   # unambiguous frequent words
+
+    # -- inference ---------------------------------------------------------
+
+    def _predict(self, feats: Sequence[str]) -> str:
+        scores = defaultdict(float)
+        for f in feats:
+            w = self.weights.get(f)
+            if not w:
+                continue
+            for tag, weight in w.items():
+                scores[tag] += weight
+        # ties broken by tag name for determinism
+        return max(self.classes, key=lambda t: (scores[t], t))
+
+    def tag(self, words: Sequence[str]) -> List[str]:
+        prev, prev2 = self.START
+        context = ["-C2-", "-C1-"] + [w.lower() for w in words] \
+            + ["+C1+", "+C2+"]
+        tags = []
+        for i, word in enumerate(words):
+            tag = self.tagdict.get(word.lower())
+            if tag is None:
+                tag = self._predict(_features(i, word, context, prev,
+                                              prev2))
+            tags.append(tag)
+            prev2, prev = prev, tag
+        return tags
+
+    # -- training ----------------------------------------------------------
+
+    def train(self, sentences: Sequence[Sequence[Tuple[str, str]]],
+              iterations: int = 8, seed: int = 13) -> None:
+        """Averaged-perceptron training with PREDICTED tag history —
+        the prev/prev2 features see the model's own greedy guesses, the
+        same regime inference runs in (gold history would train on
+        contexts the tagger never sees at test time)."""
+        self._make_tagdict(sentences)
+        self.classes = sorted({t for s in sentences for _, t in s}
+                              | set(self.tagdict.values()))
+        totals: Dict[str, Dict[str, float]] = defaultdict(
+            lambda: defaultdict(float))
+        stamps: Dict[str, Dict[str, int]] = defaultdict(
+            lambda: defaultdict(int))
+        instance = 0
+        rng = random.Random(seed)
+        order = list(sentences)
+        for _ in range(iterations):
+            rng.shuffle(order)
+            for sent in order:
+                words = [w for w, _ in sent]
+                gold = [t for _, t in sent]
+                context = ["-C2-", "-C1-"] + [w.lower() for w in words] \
+                    + ["+C1+", "+C2+"]
+                prev, prev2 = self.START
+                for i, word in enumerate(words):
+                    instance += 1
+                    dict_tag = self.tagdict.get(word.lower())
+                    # update on EVERY token, tagdict-covered or not: on
+                    # a small corpus, skipping dict words would leave
+                    # their contexts untrained (e.g. t1=MD -> VB never
+                    # gets weight when all template verbs are dict-
+                    # covered), crippling generalization to unseen words
+                    feats = _features(i, word, context, prev, prev2)
+                    guess = self._predict(feats)
+                    if guess != gold[i]:
+                        for f in feats:
+                            w = self.weights.setdefault(f, {})
+                            self._upd(totals, stamps, instance, f,
+                                      gold[i], w, 1.0)
+                            self._upd(totals, stamps, instance, f,
+                                      guess, w, -1.0)
+                    # history tag mirrors the inference regime exactly:
+                    # dict words contribute their dict tag, the rest the
+                    # model's own greedy guess
+                    prev2, prev = prev, (dict_tag if dict_tag is not None
+                                         else guess)
+        # average
+        for f, w in self.weights.items():
+            for tag in w:
+                total = totals[f][tag] \
+                    + (instance - stamps[f][tag]) * w[tag]
+                avg = total / instance
+                w[tag] = round(avg, 6)
+        self.weights = {f: {t: v for t, v in w.items() if v}
+                        for f, w in self.weights.items()}
+        self.weights = {f: w for f, w in self.weights.items() if w}
+
+    def _upd(self, totals, stamps, instance, f, tag, w, delta):
+        totals[f][tag] += (instance - stamps[f][tag]) * w.get(tag, 0.0)
+        stamps[f][tag] = instance
+        w[tag] = w.get(tag, 0.0) + delta
+
+    def _make_tagdict(self, sentences, min_count=4, ambiguity=0.99):
+        """Frequent words that are (nearly) unambiguous bypass the model
+        — the standard speed/stability trick."""
+        counts: Dict[str, Dict[str, int]] = defaultdict(
+            lambda: defaultdict(int))
+        for sent in sentences:
+            for word, tag in sent:
+                counts[word.lower()][tag] += 1
+        for word, tags in counts.items():
+            tag, n = max(tags.items(), key=lambda kv: (kv[1], kv[0]))
+            total = sum(tags.values())
+            if total >= min_count and n / total >= ambiguity:
+                self.tagdict[word] = tag
+
+    # -- serialization -----------------------------------------------------
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump({"weights": self.weights, "classes": self.classes,
+                       "tagdict": self.tagdict}, f)
+
+    @classmethod
+    def load(cls, path: str) -> "PerceptronPosTagger":
+        t = cls()
+        with open(path) as f:
+            blob = json.load(f)
+        t.weights = blob["weights"]
+        t.classes = blob["classes"]
+        t.tagdict = blob["tagdict"]
+        return t
+
+    def accuracy(self, sentences) -> float:
+        right = total = 0
+        for sent in sentences:
+            words = [w for w, _ in sent]
+            gold = [t for _, t in sent]
+            for g, p in zip(gold, self.tag(words)):
+                right += g == p
+                total += 1
+        return right / max(total, 1)
+
+
+_DEFAULT: Optional[PerceptronPosTagger] = None
+
+
+def default_tagger() -> PerceptronPosTagger:
+    """The in-repo tagger trained on the bundled corpus (cached per
+    process; deterministic weights)."""
+    global _DEFAULT
+    if _DEFAULT is None:
+        from deeplearning4j_tpu.nlp.pos_data import corpus
+        t = PerceptronPosTagger()
+        t.train(corpus())
+        _DEFAULT = t
+    return _DEFAULT

@@ -52,7 +52,8 @@ def reorder_stream_state(net, indices) -> None:
     indices[b]'s caches/RNN state). `indices`: int array [new_batch].
     kv_pos is normally a batch-independent scalar, but a per-row rewind
     (rewind_stream_state with an array) promotes it to [N] — gathered
-    here like the caches so reordering keeps each row's own position."""
+    here like the caches so reordering keeps each row's own position
+    (same for a rolling cache's kv_abs once promoted to [N, L])."""
     idx = jnp.asarray(indices)
     for name, s in net.state.items():
         if not isinstance(s, dict):
@@ -60,6 +61,7 @@ def reorder_stream_state(net, indices) -> None:
         net.state[name] = {
             kk: (vv[idx] if kk in BATCHED_STREAM_KEYS
                  or (kk == "kv_pos" and getattr(vv, "ndim", 0) >= 1)
+                 or (kk == "kv_abs" and getattr(vv, "ndim", 0) >= 2)
                  else vv)
             for kk, vv in s.items()}
     rows = getattr(net, "_stream_pos_rows", None)
@@ -1189,13 +1191,9 @@ class SelfAttentionLayer(FeedForwardLayerConf):
             q = self._rope(q, abs_pos)
             k = self._rope(k, abs_pos)
         if self.window is not None:
-            if vec:
-                raise ValueError(
-                    "per-row streaming positions are not supported for "
-                    "windowed (rolling-cache) attention")
             return self._stream_attend_rolling(
                 q, k, v, state, kc, vc, pos, mask, fresh=fresh,
-                m0=m0, q_pos=q_pos, n_new=n_new)
+                m0=m0, q_pos=q_pos, n_new=n_new, vec=vec)
         z = jnp.zeros((), pos.dtype)
         if vec:
             # per-row scatter at each row's own slots (advanced indexing
@@ -1284,7 +1282,7 @@ class SelfAttentionLayer(FeedForwardLayerConf):
 
     def _stream_attend_rolling(self, q, k, v, state, kc, vc, pos,
                                mask=None, *, fresh, m0=None, q_pos=None,
-                               n_new=None):
+                               n_new=None, vec=False):
         """Windowed streaming with a ROLLING cache: slots are reused
         modulo cache_length, so generation length is unbounded with
         bounded memory (cache_length >= window keeps every in-window key
@@ -1296,7 +1294,16 @@ class SelfAttentionLayer(FeedForwardLayerConf):
         the dump slot L and are dropped, so pads consume neither slots
         nor positions. The static chunk-size guards below use the padded
         length t (conservative: a padded chunk needs its full bucket to
-        fit, so pick a bucket <= cache_length)."""
+        fit, so pick a bucket <= cache_length).
+
+        vec=True is the per-row-positions regime (after a per-row
+        rewind_stream_state — batched speculation): q_pos is [N,T], each
+        row writes at its own modular slots, and kv_abs promotes from
+        the shared [L] to [N,L] on the first per-row write (exact:
+        before rows diverge every row's slot->abs map is identical).
+        The validity test stays the same per-row recency arithmetic, so
+        a rewound row's stale future entries are invisible to that row
+        while other rows keep seeing their accepted keys."""
         n, _, t, d = q.shape
         hkv = k.shape[1]
         L = self.cache_length
@@ -1320,9 +1327,28 @@ class SelfAttentionLayer(FeedForwardLayerConf):
         if kv_abs is None:
             kv_abs = jnp.full((L,), -1, jnp.int32)
         if q_pos is None:
-            q_pos = pos + jnp.arange(t, dtype=pos.dtype)
+            steps_t = jnp.arange(t, dtype=pos.dtype)
+            q_pos = pos[:, None] + steps_t if vec else pos + steps_t
             n_new = t
-        if m0 is None:
+        if vec:
+            if m0 is not None:
+                raise ValueError(
+                    "packed (pad_left) priming cannot follow a per-row "
+                    "rewind — restart the stream")
+            if kv_abs.ndim == 1:
+                kv_abs = jnp.broadcast_to(kv_abs, (n, L))
+            slots = q_pos % L                              # [N, T]
+            bidx = jnp.arange(n)[:, None]
+            kc = kc.at[bidx, :, slots, :].set(
+                k.transpose(0, 2, 1, 3).astype(kc.dtype))
+            vc = vc.at[bidx, :, slots, :].set(
+                v.transpose(0, 2, 1, 3).astype(vc.dtype))
+            kv_abs = kv_abs.at[bidx, slots].set(
+                q_pos.astype(kv_abs.dtype))
+            km = self._stream_mask_update(
+                state, mask, n, t, L, fresh=fresh,
+                write=lambda km, m: km.at[bidx, slots].set(m))
+        elif m0 is None:
             slots = q_pos % L
             kc = kc.at[:, :, slots, :].set(k.astype(kc.dtype))
             vc = vc.at[:, :, slots, :].set(v.astype(vc.dtype))
@@ -1344,8 +1370,17 @@ class SelfAttentionLayer(FeedForwardLayerConf):
         scale = 1.0 / np.sqrt(d)
         s = jnp.einsum("ngrtd,ngld->ngrtl", qg,
                        kc.astype(jnp.float32)) * scale
-        valid = (kv_abs[None, :] >= 0) &                 (kv_abs[None, :] <= q_pos[:, None]) &                 (q_pos[:, None] - kv_abs[None, :] < self.window)
-        valid = valid[None]                                  # [1, T, L]
+        if vec:
+            abs_r = kv_abs[:, None, :]                       # [N, 1, L]
+            valid = ((abs_r >= 0)
+                     & (abs_r <= q_pos[..., None])
+                     & (q_pos[..., None] - abs_r < self.window))
+            # [N, T, L] — each row against its own slot->abs map
+        else:
+            valid = ((kv_abs[None, :] >= 0)
+                     & (kv_abs[None, :] <= q_pos[:, None])
+                     & (q_pos[:, None] - kv_abs[None, :] < self.window))
+            valid = valid[None]                              # [1, T, L]
         if km is not None:
             valid = valid & km[:, None, :]                   # [N, T, L]
         s = jnp.where(valid[:, None, None], s, -1e30)

@@ -569,12 +569,11 @@ def _check_per_row_speculable(net, n: int) -> None:
                 "batched speculative decoding is attention-only: learned "
                 "positional tables carry a shared pos_offset that cannot "
                 "rewind per row (use a rope or position-free model)")
-        if getattr(l, "window", None) and \
-                getattr(l, "supports_streaming", False):
-            raise ValueError(
-                "batched speculative decoding does not support windowed "
-                "(rolling-cache) attention — per-row positions are not "
-                "implemented for the rolling cache write path")
+        # windowed (rolling-cache) attention is fine: per-row positions
+        # write each row's own modular slots and kv_abs promotes to
+        # [N, L] (SelfAttentionLayer._stream_attend_rolling vec branch);
+        # check_rewindable above already enforced
+        # cache_length >= window + gamma + 1
 
 
 def speculative_sample_batch(net, draft, prompts, steps: int,
@@ -610,8 +609,11 @@ def speculative_sample_batch(net, draft, prompts, steps: int,
 
     Like sample_stream_batch, rows share stream capacity from the padded
     prompt length; per-row rewind is attention-only (LSTMs cannot
-    rewind; windowed rolling caches and learned positional tables are
-    rejected by the layer checks)."""
+    rewind; learned positional tables are rejected by the layer checks).
+    Windowed (rolling-cache) attention IS supported: each row writes its
+    own modular slots and the slot->absolute-position map promotes to
+    per-row on the first rewind (cache_length >= window + gamma + 1
+    enforced at entry)."""
     from deeplearning4j_tpu.nn.conf.layers import rewind_stream_state
     if gamma < 1:
         raise ValueError(f"gamma must be >= 1, got {gamma}")

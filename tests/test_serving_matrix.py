@@ -17,11 +17,11 @@ PROMPTS = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [2, 4]]
 
 
 def _tfm(layers=1, embed=16, seed=12345, cache=64, positional="rope",
-         vocab=12):
+         vocab=12, window=None):
     return TextGenerationTransformer(vocab_size=vocab, embed_dim=embed,
                                      n_heads=2, n_layers=layers,
                                      max_length=cache, seed=seed,
-                                     positional=positional)
+                                     positional=positional, window=window)
 
 
 class TestPerRowRewind:
@@ -118,6 +118,48 @@ class TestBatchedSpeculative:
         prompts = PROMPTS[:3]
         want = []
         for b, p in enumerate(prompts):
+            want.append(decoding.speculative_sample(
+                tnet, dnet, p, steps=8, vocab_size=12, gamma=3, top_k=1,
+                rng=np.random.default_rng(b)))
+        got = decoding.speculative_sample_batch(
+            tnet, dnet, prompts, steps=8, vocab_size=12, gamma=3,
+            top_k=1, rngs=[np.random.default_rng(b)
+                           for b in range(len(prompts))])
+        assert got == want
+
+    @pytest.mark.parametrize("n_prompts", [1, 3])
+    def test_windowed_prompt_lookup_greedy_equals_per_prompt(
+            self, n_prompts):
+        """Per-row rolling-cache writes (VERDICT r4 task 7): batched x
+        speculative == per-prompt speculative on a WINDOWED rope net —
+        each row writes its own modular slots and kv_abs promotes to
+        [N, L] after the first per-row rewind."""
+        model = _tfm(layers=2, embed=32, seed=3, window=6, cache=64)
+        net = model.init()
+        prompts = [p * 3 for p in PROMPTS[:n_prompts]]
+        want = []
+        for p in prompts:
+            net.rnn_clear_previous_state()
+            want.append(decoding.speculative_sample(
+                net, decoding.prompt_lookup_proposer(2), p, steps=8,
+                vocab_size=12, gamma=3, top_k=1,
+                rng=np.random.default_rng(0)))
+        got = decoding.speculative_sample_batch(
+            net, decoding.prompt_lookup_proposer(2), prompts, steps=8,
+            vocab_size=12, gamma=3, top_k=1)
+        assert got == want
+
+    def test_windowed_model_draft_greedy_equals_per_prompt(self):
+        """Same bar with a MODEL draft that is itself windowed (both
+        nets run per-row rolling-cache rewinds every round)."""
+        target = _tfm(layers=2, embed=32, seed=1, window=6, cache=64)
+        draft = _tfm(layers=1, embed=16, seed=999, window=5, cache=64)
+        tnet, dnet = target.init(), draft.init()
+        prompts = PROMPTS[:3]
+        want = []
+        for b, p in enumerate(prompts):
+            tnet.rnn_clear_previous_state()
+            dnet.rnn_clear_previous_state()
             want.append(decoding.speculative_sample(
                 tnet, dnet, p, steps=8, vocab_size=12, gamma=3, top_k=1,
                 rng=np.random.default_rng(b)))
@@ -230,14 +272,12 @@ class TestBudgetTracking:
         # both rows well inside the 64 cache: more streaming still works
         net.rnn_time_step(chunk)
 
-    def test_windowed_net_rejected_at_entry(self):
-        model = _tfm(layers=1, embed=16, seed=3)
-        win = TextGenerationTransformer(vocab_size=12, embed_dim=16,
-                                        n_heads=2, n_layers=1,
-                                        max_length=32, window=8, seed=3,
-                                        positional="rope")
-        net = win.init()
-        with pytest.raises(ValueError, match="windowed"):
+    def test_windowed_small_cache_rejected_at_entry(self):
+        """A rolling cache without rewind headroom (cache_length <
+        window + gamma + 1) still fails fast — per-row writes don't
+        change the eviction arithmetic."""
+        net = _tfm(layers=1, embed=16, seed=3, window=8, cache=10).init()
+        with pytest.raises(ValueError, match="rolling cache"):
             decoding.speculative_sample_batch(
                 net, decoding.prompt_lookup_proposer(2), [[1, 2]],
                 steps=4, vocab_size=12, gamma=2, top_k=1)
