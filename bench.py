@@ -86,7 +86,7 @@ def _term_line(signum):
                   "before a measurement completed"}) + "\n").encode()
 
 
-def _term_claim():
+def _term_claim(signum):
     """Coordinate the SIGTERM emit with _emit's lock/_emitted pair:
     lock free -> claim it (never released; the process is exiting);
     lock held -> an emit is in flight on the interrupted frame — None

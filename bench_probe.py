@@ -128,14 +128,15 @@ def install_sigterm_handler(make_line_bytes, try_claim=None):
     (non-reentrant lock / BufferedWriter RuntimeError).
 
     make_line_bytes(signum) -> bytes for the failure line (with "\\n").
-    try_claim() -> True (emit, then exit 3) | False (already emitted —
-    exit without a second line) | None (an emit is IN FLIGHT on the
-    interrupted frame: return from the handler so it can finish instead
-    of truncating it mid-write; the emitter exits on its own).
-    Default claim: always emit once."""
+    try_claim(signum) -> True (emit, then exit 3) | False (already
+    emitted — exit without a second line) | None (an emit is IN FLIGHT
+    on the interrupted frame: return from the handler so it can finish
+    instead of truncating it mid-write; the claimant is responsible for
+    honoring the parked kill afterwards). Default claim: always emit
+    once."""
     claimed = [False]
 
-    def _default_claim():
+    def _default_claim(signum):
         if claimed[0]:
             return False
         claimed[0] = True
@@ -145,7 +146,7 @@ def install_sigterm_handler(make_line_bytes, try_claim=None):
 
     def _handler(signum, frame):
         kill_probe_child()
-        verdict = claim()
+        verdict = claim(signum)
         if verdict is None:
             return
         if verdict:

@@ -4,11 +4,13 @@ The reference's CJK analyzers ship multi-megabyte system dictionaries
 (deeplearning4j-nlp-japanese bundles the kuromoji/IPADIC data,
 deeplearning4j-nlp-chinese the ansj/jieba tables) — most of their 19.6k
 LoC + resources is dictionary data. This module is the zero-egress
-counterpart: a hand-curated core-vocabulary dictionary (~1040 Chinese
-words with relative frequencies, ~2200 Japanese entries with POS — the
-round-3 expansions generate frequency-weighted conjugated surfaces for
-curated verb, i-adjective and suru-noun lists, the stand-in for
-IPADIC's per-surface costs) that
+counterpart: a hand-curated core-vocabulary dictionary (~1295 Chinese
+words with relative frequencies, ~3996 Japanese entries with POS — the
+round-3..5 expansions generate frequency-weighted conjugated surfaces
+for curated verb, i/na-adjective, suru-noun, counter and keigo lists:
+core + extended paradigms (progressive, potential, passive, causative,
+volitional, conditionals, imperative), the stand-in for IPADIC's
+per-surface costs) that
 makes `ChineseTokenizerFactory(dictionary="builtin")` /
 `JapaneseTokenizerFactory(dictionary="builtin")` segment everyday text
 sensibly out of the box. It is deliberately small: domain text should
@@ -106,6 +108,16 @@ _ZH_BUCKETS = (
     (2400, "看完 吃完 做完 写完 说完 用完 听懂 看懂 读懂 学会 抓紧 抓住 停住 站住 愣住 吃饱 喝醉 睡着 累坏 吓坏"),
     # psychological / communication verbs
     (2800, "商量 考虑 分析 打听 询问 回忆 反思 反省 思考 琢磨 估计 预测 推测 假设 证明 否认 承认 强调 声明 宣布"),
+    # round-5 expansion: measure words / classifiers
+    (5500, "座 棵 朵 头 艘 架 部 所 款 项 笔 幅 盏 扇 枚 粒 滴 串 束 堆"),
+    (4500, "排 队 顿 场 阵 圈 趟 遍 声 句 段 节 道 副 把 根 支 枝 瓶 叠"),
+    (4000, "袋 盒 包 桶 篮 筐 罐 壶 锅 炉 床 幢 栋 捆 亩 吨 克 千克 公斤 公里"),
+    # number+measure fused surfaces (jieba-style lexicalized compounds)
+    (3500, "一次 两次 三次 一遍 一场 一段 一句 一声 一道 一笔 一项 一部 一家 一座 一根 一把 一瓶 一杯 一碗 一顿"),
+    (2800, "两个 三个 四个 五个 几次 几天 几年 一会儿 一阵子 一辈子 半天 半年 多年 多次 每次 每年 每月 每周 每个 整个"),
+    # round-5 chengyu (classic 4-char idioms, lattice stress cases)
+    (900, "一心一意 三心二意 四面八方 五颜六色 七上八下 十全十美 百发百中 千方百计 万无一失 半途而废 画蛇添足 守株待兔 井底之蛙 亡羊补牢 对牛弹琴 狐假虎威 掩耳盗铃 杯弓蛇影 刻舟求剑 自相矛盾"),
+    (800, "理所当然 迫不及待 情不自禁 恍然大悟 全力以赴 聚精会神 专心致志 一丝不苟 精益求精 持之以恒 再接再厉 勇往直前 坚持不懈 脚踏实地 实话实说 将心比心 设身处地 风和日丽 阳光明媚 春暖花开"),
 )
 
 ZH_FREQ = {}
@@ -442,3 +454,159 @@ for _num, _nw in _JA_COUNTER_NUMS:
         _f = max(100, int(_cf * _nw))
         if _surface not in JA_ENTRIES or JA_ENTRIES[_surface][0] < _f:
             JA_ENTRIES[_surface] = (_f, "名詞")
+
+
+# --- Japanese extended verb paradigms (round-5 expansion) --------------
+#
+# IPADIC prices every inflected surface; the round-3 generator covered
+# the plain/polite/te/ta/negative core. This pass adds the remaining
+# everyday paradigm: progressive ている (+polite/past + spoken てる
+# contraction), potential, passive, causative, volitional, the two
+# conditionals (-ば / -たら) and the plain imperative, derived from the
+# same godan rows (plus their e/o-row kana below).
+
+_GODAN_EO = {  # final kana -> (e-row kana, o-row kana)
+    "く": ("け", "こ"), "ぐ": ("げ", "ご"), "す": ("せ", "そ"),
+    "つ": ("て", "と"), "ぬ": ("ね", "の"), "ぶ": ("べ", "ぼ"),
+    "む": ("め", "も"), "う": ("え", "お"), "る": ("れ", "ろ"),
+}
+
+_EXT_FORM_WEIGHTS = {
+    "teiru": 0.5, "teimasu": 0.3, "teita": 0.25, "teru": 0.2,
+    "potential": 0.25, "passive": 0.2, "causative": 0.1,
+    "volitional": 0.15, "ba": 0.15, "tara": 0.2, "imperative": 0.07,
+}
+
+
+def _conjugate_ext(dict_form: str, kind: str):
+    """Extended-paradigm surfaces of one verb -> {surface: form_key}."""
+    out = {}
+    if kind == "ichidan":
+        stem = dict_form[:-1]
+        te = stem + "て"
+        out[stem + "られる"] = "potential"     # doubles as the passive
+        out[stem + "させる"] = "causative"
+        out[stem + "よう"] = "volitional"
+        out[stem + "れば"] = "ba"
+        out[stem + "たら"] = "tara"
+        out[stem + "ろ"] = "imperative"
+    else:
+        base, last = dict_form[:-1], dict_form[-1]
+        _, (te_s, ta_s), neg_k = _GODAN_ROWS[last]
+        if dict_form == "行く":
+            te_s, ta_s = "って", "った"
+        e_k, o_k = _GODAN_EO[last]
+        te = base + te_s
+        out[base + e_k + "る"] = "potential"
+        out[base + neg_k + "れる"] = "passive"
+        out[base + neg_k + "せる"] = "causative"
+        out[base + o_k + "う"] = "volitional"
+        out[base + e_k + "ば"] = "ba"
+        out[base + ta_s + "ら"] = "tara"
+        out[base + e_k] = "imperative"
+    out[te + "いる"] = "teiru"
+    out[te + "います"] = "teimasu"
+    out[te + "いた"] = "teita"
+    out[te + "る"] = "teru"                    # spoken contraction
+    return out
+
+
+for _dict_form, _freq, _kind in _JA_VERBS:
+    for _surface, _form in _conjugate_ext(_dict_form, _kind).items():
+        _f = max(100, int(_freq * _EXT_FORM_WEIGHTS[_form]))
+        if _surface not in JA_ENTRIES or JA_ENTRIES[_surface][0] < _f:
+            JA_ENTRIES[_surface] = (_f, "動詞")
+
+
+# --- Japanese keigo (round-5 expansion) --------------------------------
+#
+# The honorific/humble lexicon the reference's IPADIC carries as regular
+# entries: the irregular -aru keigo verbs (いらっしゃる etc. take the
+# い masu-stem), the suppletive humble/honorific verbs, the fixed polite
+# formulas, and the productive お/ご noun prefixes.
+
+_JA_KEIGO_ARU5 = (  # -aru row keigo: masu-stem い, って/った te/ta
+    ("いらっしゃる", 2500), ("おっしゃる", 2000), ("なさる", 1800),
+    ("くださる", 2200),
+)
+
+_JA_KEIGO_VERBS = (  # suppletive keigo that conjugates regularly
+    ("召し上がる", 1200, "godan"), ("伺う", 1500, "godan"),
+    ("参る", 1500, "godan"), ("申す", 1500, "godan"),
+    ("申し上げる", 1200, "ichidan"), ("いただく", 3000, "godan"),
+    ("さしあげる", 1000, "ichidan"), ("おる", 1800, "godan"),
+)
+
+_JA_KEIGO_FIXED = (
+    ("ございます", 2500), ("でございます", 1500), ("おります", 1500),
+    ("ご覧になる", 1000), ("ご覧ください", 800), ("拝見する", 800),
+    ("拝見しました", 600), ("お願いします", 3000),
+    ("お願いいたします", 1500), ("いただきます", 2500),
+    ("いたします", 2000), ("いたしました", 1200),
+    ("恐れ入ります", 800), ("お疲れ様です", 1500),
+    ("お疲れ様でした", 1200), ("かしこまりました", 1000),
+    ("承知しました", 1000), ("承知いたしました", 700),
+    ("お世話になります", 1200), ("お世話になりました", 1000),
+    ("よろしくお願いします", 2000), ("お待たせしました", 1000),
+    ("お待ちください", 1200), ("ご遠慮ください", 700),
+)
+
+_JA_HONORIFIC_NOUNS = (  # お/ご prefixed everyday nouns
+    ("お茶", 2500), ("お金", 3000), ("お水", 1800), ("お店", 2200),
+    ("お仕事", 1800), ("お名前", 2000), ("お時間", 1500),
+    ("お電話", 1500), ("お話", 1800), ("お部屋", 1500),
+    ("お客様", 2500), ("お子さん", 1500), ("お宅", 1000),
+    ("お土産", 1500), ("お弁当", 1800), ("お風呂", 1800),
+    ("お祭り", 1200), ("お正月", 1200), ("ご飯", 3000),
+    ("ご家族", 1200), ("ご連絡", 1800), ("ご案内", 1500),
+    ("ご質問", 1500), ("ご利用", 1800), ("ご注意", 1500),
+    ("ご意見", 1500), ("ご協力", 1200), ("ご確認", 1500),
+    ("ご予約", 1200), ("ご紹介", 1200),
+)
+
+for _surface, _freq in _JA_KEIGO_ARU5:
+    _base = _surface[:-1]
+    for _sfx, _w in (("", 1.0), ("います", 0.8), ("いました", 0.5),
+                     ("いませ", 0.3), ("って", 0.5), ("った", 0.4),
+                     ("らない", 0.15)):
+        _f = max(100, int(_freq * _w))
+        _s = _surface if _sfx == "" else _base + _sfx
+        if _s not in JA_ENTRIES or JA_ENTRIES[_s][0] < _f:
+            JA_ENTRIES[_s] = (_f, "動詞")
+
+for _dict_form, _freq, _kind in _JA_KEIGO_VERBS:
+    for _surface, _form in _conjugate(_dict_form, _kind).items():
+        _f = max(100, int(_freq * _FORM_WEIGHTS[_form]))
+        if _surface not in JA_ENTRIES or JA_ENTRIES[_surface][0] < _f:
+            JA_ENTRIES[_surface] = (_f, "動詞")
+
+for _surface, _freq in _JA_KEIGO_FIXED:
+    if _surface not in JA_ENTRIES or JA_ENTRIES[_surface][0] < _freq:
+        JA_ENTRIES[_surface] = (_freq, "感動詞")
+
+for _surface, _freq in _JA_HONORIFIC_NOUNS:
+    if _surface not in JA_ENTRIES or JA_ENTRIES[_surface][0] < _freq:
+        JA_ENTRIES[_surface] = (_freq, "名詞")
+
+
+# --- Japanese grammar formulae (round-5) -------------------------------
+# Lexicalized multi-morpheme patterns IPADIC carries as entries; without
+# them the lattice falls back to kana singletons (ように -> よ/う/に).
+
+_JA_GRAMMAR = (
+    ("ように", 3000), ("ような", 2500), ("ようです", 1500),
+    ("ようになる", 1200), ("ようにする", 1000), ("そうです", 2000),
+    ("そうだ", 1500), ("かもしれない", 1800), ("かもしれません", 1500),
+    ("でしょう", 2500), ("だろう", 2200), ("はずです", 1200),
+    ("はずだ", 1000), ("つもりです", 1200), ("つもりだ", 1000),
+    ("ことができる", 1500), ("ことができます", 1200),
+    ("なければならない", 1200), ("なければなりません", 1000),
+    ("たほうがいい", 1000), ("ながら", 1800), ("について", 2200),
+    ("によって", 2000), ("にとって", 1800), ("に対して", 1500),
+    ("として", 2200), ("とともに", 1200), ("のために", 1800),
+    ("のように", 1500), ("ばかり", 1500), ("だけでなく", 1000),
+)
+
+for _surface, _freq in _JA_GRAMMAR:
+    if _surface not in JA_ENTRIES or JA_ENTRIES[_surface][0] < _freq:
+        JA_ENTRIES[_surface] = (_freq, "助詞")

@@ -256,3 +256,33 @@ class TestBuiltinDictionaryScale:
         toks4 = tf.create("工程师用微信发照片").get_tokens()
         assert "工程师" in toks4 and "微信" in toks4 and "照片" in toks4, \
             toks4
+
+
+class TestRound5Expansions:
+    """Round-5 dictionary growth: zh measure words + chengyu, ja
+    extended verb paradigms + keigo (VERDICT r4 task 9)."""
+
+    def test_chinese_chengyu_segment_whole(self):
+        tf = ChineseTokenizerFactory(dictionary="builtin")
+        toks = tf.create("我们一心一意全力以赴").get_tokens()
+        assert "一心一意" in toks and "全力以赴" in toks
+
+    def test_chinese_measure_compounds(self):
+        tf = ChineseTokenizerFactory(dictionary="builtin")
+        toks = tf.create("他去过三次北京").get_tokens()
+        assert "三次" in toks and "北京" in toks
+
+    def test_japanese_progressive_and_potential(self):
+        tf = JapaneseTokenizerFactory(dictionary="builtin")
+        toks = tf.create("本を読んでいる").get_tokens()
+        assert "読んでいる" in toks or ("読んで" in toks and
+                                        "いる" in toks)
+        toks = tf.create("日本語が話せる").get_tokens()
+        assert "話せる" in toks
+
+    def test_japanese_keigo_surfaces(self):
+        tf = JapaneseTokenizerFactory(dictionary="builtin")
+        toks = tf.create("先生がいらっしゃいます").get_tokens()
+        assert "いらっしゃいます" in toks
+        toks = tf.create("お客様にご連絡します").get_tokens()
+        assert "お客様" in toks and "ご連絡" in toks
