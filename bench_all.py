@@ -685,11 +685,14 @@ def _converge_report(name, traj, steps, extra=None):
         decreased = (traj[-1] < 0.5 * traj[0]
                      and rt[-1] < 0.5 * rt[0])
         # when BOTH runs collapsed the loss to noise level (<2% of the
-        # starting loss), the relative final_dev is comparing bf16
-        # noise against bf16 noise — both-collapsed IS the parity
-        # verdict there, so the relative gate only applies above floor
+        # starting loss) AND land within an order of magnitude of each
+        # other, the relative final_dev is comparing bf16 noise against
+        # bf16 noise — both-collapsed IS the parity verdict there. The
+        # ratio cap keeps a plateau-at-floor bug (e.g. 0.12 vs 2e-4,
+        # both technically under floor) from being waved through.
         floor = 0.02 * rt[0]
-        collapsed = fin_a < floor and fin_b < floor
+        lo, hi = sorted((max(fin_a, 1e-9), max(fin_b, 1e-9)))
+        collapsed = fin_a < floor and fin_b < floor and hi <= 10 * lo
         rec["vs_cpu"] = {
             "max_early_dev": round(max(early), 4),
             "final_dev": round(final_dev, 4),

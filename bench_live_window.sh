@@ -39,6 +39,11 @@ run "word2vec #3" python bench_all.py word2vec
 # 5. batched speculation + batched decode serving numbers
 run "specbatch" python bench_all.py specbatch
 run "decode" python bench_all.py decode
-# 6. entries that missed round-3's sweep
+# 6. on-chip convergence evidence (VERDICT r5 task 3): fixed-seed
+#    trajectories vs the committed CPU fixtures
+run "converge lenet" python bench_all.py converge_lenet
+run "converge resnet unfused" python bench_all.py converge_resnet
+run "converge resnet fused" env BENCH_FUSE=2 python bench_all.py converge_resnet
+# 7. entries that missed round-3's sweep
 run "window attention" python bench_all.py window
 run "headline confirm" python bench.py

@@ -5,7 +5,7 @@ The reference's CJK analyzers ship multi-megabyte system dictionaries
 deeplearning4j-nlp-chinese the ansj/jieba tables) — most of their 19.6k
 LoC + resources is dictionary data. This module is the zero-egress
 counterpart: a hand-curated core-vocabulary dictionary (~1295 Chinese
-words with relative frequencies, ~3996 Japanese entries with POS — the
+words with relative frequencies, ~4026 Japanese entries with POS — the
 round-3..5 expansions generate frequency-weighted conjugated surfaces
 for curated verb, i/na-adjective, suru-noun, counter and keigo lists:
 core + extended paradigms (progressive, potential, passive, causative,

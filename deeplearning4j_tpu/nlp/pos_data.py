@@ -136,6 +136,18 @@ _H = [
     "Meanwhile/RB ,/, the/DT crowd/NN grew/VBD restless/JJ ./.",
     "About/IN twenty/CD people/NNS attended/VBD the/DT lecture/NN ./.",
     "The/DT temperature/NN dropped/VBD below/IN zero/CD overnight/RB ./.",
+    # modal questions with an interposed subject (MD ... VB)
+    "Can/MD the/DT team/NN finish/VB the/DT project/NN ?/.",
+    "Will/MD the/DT students/NNS pass/VB the/DT test/NN ?/.",
+    "Should/MD the/DT committee/NN approve/VB the/DT plan/NN ?/.",
+    "Could/MD your/PRP$ sister/NN drive/VB us/PRP home/NN ?/.",
+    "Did/VBD the/DT driver/NN stop/VB at/IN the/DT light/NN ?/.",
+    # prenominal participles (CD/DT + VBN + NNS)
+    "Three/CD stolen/VBN cars/NNS were/VBD found/VBN ./.",
+    "The/DT fallen/VBN leaves/NNS covered/VBD the/DT path/NN ./.",
+    "Two/CD broken/VBN chairs/NNS stood/VBD in/IN the/DT corner/NN ./.",
+    "Five/CD injured/VBN players/NNS left/VBD the/DT game/NN ./.",
+    "Several/JJ frozen/VBN pipes/NNS burst/VBD last/JJ winter/NN ./.",
 ]
 
 # ---------------------------------------------------------------------------

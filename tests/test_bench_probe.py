@@ -1,0 +1,216 @@
+"""bench_probe unit tests: the tunnel probe/retry loop and SIGTERM
+machinery that bench.py/bench_all.py gate their jax imports on (VERDICT
+r4 task 1 — a short live window must still produce a driver record, and
+every failure mode must yield the one-JSON-line contract).
+
+The real probe spawns a jax subprocess; these tests monkeypatch
+probe_once/time so the loop logic is pinned without tunnel access.
+"""
+
+import importlib
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import bench_probe  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    importlib.reload(bench_probe)
+    yield
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+        self.sleeps = []
+
+    def monotonic(self):
+        return self.t
+
+    def sleep(self, s):
+        self.sleeps.append(s)
+        self.t += s
+
+
+def _patch_probe(monkeypatch, results, cost=5.0):
+    """probe_once returns successive entries from `results`, each
+    advancing the fake clock by `cost` (or per-entry cost)."""
+    clock = FakeClock()
+    monkeypatch.setattr(bench_probe.time, "monotonic", clock.monotonic)
+    monkeypatch.setattr(bench_probe.time, "sleep", clock.sleep)
+    seq = list(results)
+    calls = []
+
+    def fake_probe(timeout=None):
+        calls.append(timeout)
+        item = seq.pop(0) if seq else ("", "")
+        c = item[2] if len(item) > 2 else cost
+        clock.t += c
+        return item[0], item[1]
+
+    monkeypatch.setattr(bench_probe, "probe_once", fake_probe)
+    return clock, calls
+
+
+class TestWaitForTpu:
+    def test_first_probe_success(self, monkeypatch):
+        _patch_probe(monkeypatch, [("tpu", "")])
+        platform, attempts, waited, err = bench_probe.wait_for_tpu()
+        assert platform == "tpu" and attempts == 1 and err == ""
+
+    def test_retries_until_live(self, monkeypatch):
+        monkeypatch.setattr(bench_probe, "PROBE_BUDGET", 300.0)
+        clock, _ = _patch_probe(
+            monkeypatch, [("", ""), ("", ""), ("tpu", "")], cost=30.0)
+        platform, attempts, waited, _ = bench_probe.wait_for_tpu()
+        assert platform == "tpu" and attempts == 3
+        assert len(clock.sleeps) == 2   # slept between attempts only
+
+    def test_budget_exhaustion_returns_none(self, monkeypatch):
+        monkeypatch.setattr(bench_probe, "PROBE_BUDGET", 60.0)
+        _patch_probe(monkeypatch, [("", "")] * 10, cost=30.0)
+        platform, attempts, waited, _ = bench_probe.wait_for_tpu()
+        assert platform is None
+        assert attempts <= 3
+
+    def test_wall_time_does_not_overshoot_budget(self, monkeypatch):
+        """The sleep keeps at least a useful probe of budget, and the
+        per-probe timeout clamps to the remainder — total wall time
+        stays within budget + one clamped probe, never budget +
+        PROBE_TIMEOUT."""
+        monkeypatch.setattr(bench_probe, "PROBE_BUDGET", 100.0)
+        monkeypatch.setattr(bench_probe, "PROBE_TIMEOUT", 70.0)
+        clock, calls = _patch_probe(monkeypatch, [("", "")] * 10,
+                                    cost=30.0)
+        bench_probe.wait_for_tpu()
+        assert clock.t <= 100.0 + bench_probe._MIN_USEFUL_PROBE
+        # the clamp actually reached probe_once
+        assert all(c <= 70.0 for c in calls)
+
+    def test_two_crashes_abort_early(self, monkeypatch):
+        monkeypatch.setattr(bench_probe, "PROBE_BUDGET", 10_000.0)
+        clock, _ = _patch_probe(
+            monkeypatch,
+            [("", "probe crashed rc=1: boom"),
+             ("", "probe crashed rc=1: boom")], cost=5.0)
+        platform, attempts, waited, err = bench_probe.wait_for_tpu()
+        assert platform is None and attempts == 2
+        assert "boom" in err
+        assert clock.t < 60         # did not burn the huge budget
+
+    def test_hang_resets_crash_counter(self, monkeypatch):
+        """crash, hang, crash is NOT two consecutive crashes — a mix
+        means the env may be flaky, keep probing."""
+        monkeypatch.setattr(bench_probe, "PROBE_BUDGET", 500.0)
+        _patch_probe(
+            monkeypatch,
+            [("", "probe crashed rc=1: x"), ("", ""),
+             ("", "probe crashed rc=1: x"), ("tpu", "")], cost=20.0)
+        platform, attempts, _, _ = bench_probe.wait_for_tpu()
+        assert platform == "tpu" and attempts == 4
+
+
+class TestProbeOnce:
+    def test_crash_reports_stderr_tail(self):
+        """A probe child that CRASHES (vs hangs) surfaces its stderr —
+        real subprocess, broken env via a poisoned jax module."""
+        import subprocess
+        real_popen = subprocess.Popen
+
+        def poisoned(cmd, **kw):
+            return real_popen(
+                [sys.executable, "-c",
+                 "import sys; print('dies', file=sys.stderr); "
+                 "sys.exit(1)"], **kw)
+
+        orig = bench_probe.subprocess.Popen
+        bench_probe.subprocess.Popen = poisoned
+        try:
+            platform, err = bench_probe.probe_once(timeout=30)
+        finally:
+            bench_probe.subprocess.Popen = orig
+        assert platform == ""
+        assert "crashed" in err and "dies" in err
+
+    def test_success_parses_last_line(self):
+        import subprocess
+        real_popen = subprocess.Popen
+
+        def fake(cmd, **kw):
+            return real_popen(
+                [sys.executable, "-c", "print('noise'); print('cpu')"],
+                **kw)
+
+        orig = bench_probe.subprocess.Popen
+        bench_probe.subprocess.Popen = fake
+        try:
+            platform, err = bench_probe.probe_once(timeout=30)
+        finally:
+            bench_probe.subprocess.Popen = orig
+        assert platform == "cpu" and err == ""
+
+
+class TestSigtermHandler:
+    def test_default_claim_single_emit(self, monkeypatch):
+        import signal as signal_mod
+        installed = {}
+        monkeypatch.setattr(
+            bench_probe.signal, "signal",
+            lambda sig, h: installed.setdefault(sig, h))
+        writes = []
+        exits = []
+        monkeypatch.setattr(bench_probe.os, "write",
+                            lambda fd, b: writes.append((fd, b)))
+        monkeypatch.setattr(bench_probe.os, "_exit",
+                            lambda rc: exits.append(rc))
+        bench_probe.install_sigterm_handler(
+            lambda signum: f"killed:{signum}\n".encode())
+        handler = installed[signal_mod.SIGTERM]
+        handler(15, None)
+        handler(15, None)     # second delivery: no second line
+        assert writes == [(1, b"killed:15\n")]
+        assert exits == [3, 3]
+
+    def test_claim_none_returns_without_exit(self, monkeypatch):
+        import signal as signal_mod
+        installed = {}
+        monkeypatch.setattr(
+            bench_probe.signal, "signal",
+            lambda sig, h: installed.setdefault(sig, h))
+        exits = []
+        monkeypatch.setattr(bench_probe.os, "_exit",
+                            lambda rc: exits.append(rc))
+        seen = []
+        bench_probe.install_sigterm_handler(
+            lambda signum: b"x\n",
+            try_claim=lambda signum: seen.append(signum) or None)
+        installed[signal_mod.SIGTERM](15, None)
+        assert exits == [] and seen == [15]
+
+    def test_handler_kills_inflight_probe_child(self, monkeypatch):
+        import signal as signal_mod
+        installed = {}
+        monkeypatch.setattr(
+            bench_probe.signal, "signal",
+            lambda sig, h: installed.setdefault(sig, h))
+        monkeypatch.setattr(bench_probe.os, "_exit", lambda rc: None)
+        monkeypatch.setattr(bench_probe.os, "write", lambda fd, b: None)
+
+        class Child:
+            killed = False
+
+            def kill(self):
+                Child.killed = True
+
+        bench_probe._probe_child = Child()
+        try:
+            bench_probe.install_sigterm_handler(lambda s: b"x\n")
+            installed[signal_mod.SIGTERM](15, None)
+        finally:
+            bench_probe._probe_child = None
+        assert Child.killed

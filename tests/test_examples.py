@@ -96,3 +96,7 @@ def test_embedding_persistence(tmp_path):
     resumed, reloaded = _run("embedding_persistence", tmpdir=str(tmp_path))
     assert resumed.epochs_trained == 6
     assert reloaded.get_label_vector("DOC_park") is not None
+
+
+def test_text_annotation():
+    _run("text_annotation")
