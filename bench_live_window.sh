@@ -26,11 +26,11 @@ run() {
   timeout "${T:-900}" "$@" 2>&1 | tail -4 | tee -a "$LOG"
 }
 
-# 1. headline record (default env = best-known config)
-run "bench.py headline" python bench.py
-# 2. fused-bottleneck A/B (VERDICT r4 task 1)
-run "bench.py BENCH_FUSE=2" env BENCH_FUSE=2 python bench.py
-# 3. speculation re-measure with a memorized model (task 5)
+# 1. headline + fused-vs-unfused A/B in ONE invocation (BENCH_FUSE
+#    unset on TPU runs both legs and reports the winner with both
+#    numbers — same-moment paired comparison; T sized for two legs)
+T=1700 run "bench.py headline A/B" python bench.py
+# 2. speculation re-measure with a memorized model (task 5)
 run "specdec" python bench_all.py specdec
 # 4. word2vec with the double-buffered uploader (task 6) — 3 runs for a median
 run "word2vec #1" python bench_all.py word2vec
@@ -46,4 +46,6 @@ run "converge resnet unfused" python bench_all.py converge_resnet
 run "converge resnet fused" env BENCH_FUSE=2 python bench_all.py converge_resnet
 # 7. entries that missed round-3's sweep
 run "window attention" python bench_all.py window
-run "headline confirm" python bench.py
+# single-leg confirm (stability check vs step 1's unfused leg; A/B
+# already done — don't burn a second fused compile)
+run "headline confirm" env BENCH_FUSE=0 python bench.py

@@ -89,8 +89,11 @@ class TestWaitForTpu:
                                     cost=30.0)
         bench_probe.wait_for_tpu()
         assert clock.t <= 100.0 + bench_probe._MIN_USEFUL_PROBE
-        # the clamp actually reached probe_once
-        assert all(c <= 70.0 for c in calls)
+        # the remaining-budget clamp actually reached probe_once: with
+        # budget 100 / cost 30 / sleep 20 the exact schedule is probe@0
+        # (remaining 100 -> 70), probe@50 (remaining 50), probe@85
+        # (remaining 15) — deleting the clamp would yield [70, 70, 70]
+        assert calls == [70.0, 50.0, 15.0]
 
     def test_two_crashes_abort_early(self, monkeypatch):
         monkeypatch.setattr(bench_probe, "PROBE_BUDGET", 10_000.0)
@@ -214,3 +217,50 @@ class TestSigtermHandler:
         finally:
             bench_probe._probe_child = None
         assert Child.killed
+
+
+class TestBenchAbPartial:
+    """bench.py A/B partial preservation: a completed unfused leg must
+    survive a hang/kill in the optional fused leg as a REAL record."""
+
+    @pytest.fixture(autouse=True)
+    def _bench(self):
+        import bench
+        importlib.reload(bench)
+        self.bench = bench
+        yield
+        self.bench._partial.clear()
+
+    def test_term_line_without_partial_is_failure(self):
+        import json
+        line = json.loads(self.bench._term_line(15).decode())
+        assert line["value"] is None and line["error"] == "killed"
+
+    def test_term_line_with_partial_carries_real_number(self):
+        import json
+        self.bench._partial.update(
+            value=2650.0, vs=13.25, platform="tpu",
+            extra={"unfused_img_s": 2650.0, "plan": "unfused"})
+        line = json.loads(self.bench._term_line(15).decode())
+        assert line["value"] == 2650.0
+        assert line["plan"] == "unfused"
+        assert "killed" in line["ab_incomplete"]
+
+    def test_watchdog_path_emits_partial(self, capsys):
+        import json
+        self.bench._partial.update(
+            value=2650.0, vs=13.25, platform="tpu",
+            extra={"plan": "unfused"})
+        emitted, had = self.bench._emit_partial_or_fail(
+            "tpu-unavailable", "device hang mid-run")
+        assert emitted and had
+        line = json.loads(capsys.readouterr().out.strip())
+        assert line["value"] == 2650.0
+        assert "tpu-unavailable" in line["ab_incomplete"]
+
+    def test_single_emission_partial_then_nothing(self, capsys):
+        self.bench._partial.update(value=1.0, vs=0.005, platform="tpu",
+                                   extra={})
+        assert self.bench._emit_partial_or_fail("x", "y")[0]
+        assert not self.bench._emit_partial_or_fail("x", "y")[0]
+        assert len(capsys.readouterr().out.strip().splitlines()) == 1
