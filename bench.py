@@ -31,7 +31,9 @@ BENCH_ALLOW_CPU=1 permits
 running on a CPU backend (smoke tests with tiny shapes only);
 BENCH_PLATFORM switches the jax platform via jax.config;
 BENCH_INIT_TIMEOUT backend-init watchdog seconds (default 120);
-BENCH_TOTAL_TIMEOUT whole-run watchdog seconds (default 1800);
+BENCH_TOTAL_TIMEOUT PER-LEG watchdog seconds (default 1800) — an A/B
+run resets the deadline for its second (fused) leg, so an external
+timeout wrapper must budget up to ~2x this for A/B invocations;
 Probe knobs (BENCH_PROBE_BUDGET/TIMEOUT/INTERVAL): see bench_probe.py —
 the loop retries killable subprocess probes until one answers "tpu", so
 a live window that opens minutes after launch still lands a record

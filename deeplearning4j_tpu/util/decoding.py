@@ -983,28 +983,16 @@ def beam_search(net, seed_ids, steps: int, vocab_size: int,
             parents, tokens, scores = np.zeros(W, np.int64), top, \
                 logp[0][top]
             first = False
+            beams = [beams[p] + [int(t)] for p, t in zip(parents,
+                                                         tokens)]
+            alive, stop_now = _beam_finish(tokens, scores, alive, beams,
+                                           stop_tokens, finished, W)
         else:
-            total = scores[:, None] + logp
-            total[~alive] = -np.inf     # finished slots never extend
-            flat = np.argsort(total.ravel())[::-1][:W]
-            parents, tokens = np.divmod(flat, V)
-            scores = total.ravel()[flat]
-        beams = [beams[p] + [int(t)] for p, t in zip(parents, tokens)]
-        if stop_tokens:
-            alive = np.ones(W, bool)
-            for w, t in enumerate(tokens):
-                if int(t) in stop_tokens and np.isfinite(scores[w]):
-                    finished.append((beams[w], float(scores[w])))
-                    alive[w] = False
-            if not alive.any():
-                break
-            if finished:
-                # log-prob totals only decrease as hypotheses extend, so
-                # once no live beam exceeds the best finished score the
-                # winner is already known
-                best_fin = max(sc for _, sc in finished)
-                if scores[alive].max() <= best_fin:
-                    break
+            parents, tokens, scores, alive, beams, stop_now = \
+                _beam_update(logp, scores, alive, beams, stop_tokens,
+                             finished, W, V)
+        if stop_now:
+            break
         more = i + 1 < steps and (max_length is None
                                   or len(beams[0]) < max_length)
         if more:
@@ -1017,6 +1005,203 @@ def beam_search(net, seed_ids, steps: int, vocab_size: int,
             tok = np.zeros(Wb, np.int64)
             tok[:W] = tokens
             out = net.rnn_time_step(_one_hot(tok[:, None], V))
+    live = [(beams[w], float(scores[w])) for w in range(W)
+            if alive[w] and np.isfinite(scores[w])]
+    pool = finished if finished else live
+    if not pool:
+        pool = [(beams[w], float(scores[w])) for w in range(W)]
+    best_seq, best_score = max(pool, key=lambda bs: bs[1])
+    return best_seq, best_score
+
+
+def _beam_finish(tokens, scores, alive, beams, stop_set, finished, W):
+    """The finishing/early-stop tail of one beam step (EOS hypotheses
+    move to `finished`, their slots die; the search is decided when
+    nothing live can beat the best finished). Shared by beam_search's
+    both branches and speculative_beam_search so the rule has exactly
+    one copy. Returns (alive, stop)."""
+    stop = False
+    if stop_set:
+        alive = np.ones(W, bool)
+        for w, t in enumerate(tokens):
+            if int(t) in stop_set and np.isfinite(scores[w]):
+                finished.append((beams[w], float(scores[w])))
+                alive[w] = False
+        if not alive.any():
+            stop = True
+        elif finished:
+            best_fin = max(sc for _, sc in finished)
+            if scores[alive].max() <= best_fin:
+                stop = True
+    return alive, stop
+
+
+def _beam_update(logp, scores, alive, beams, stop_set, finished, W, V):
+    """One beam-search scoring update (total/-inf masking, flat top-W,
+    then _beam_finish) — the ONLY copy of the rule: beam_search's loop
+    body and speculative_beam_search's host-side reconstruction both
+    call it, so the speculative replay applies the same rule by
+    construction. Returns (parents, tokens, scores, alive, beams, stop).
+    Dtype note: `scores` stays the logp dtype (float32 from the net) —
+    accumulation dtype is part of the parity contract."""
+    total = scores[:, None] + logp
+    total[~alive] = -np.inf             # finished slots never extend
+    flat = np.argsort(total.ravel())[::-1][:W]
+    parents, tokens = np.divmod(flat, V)
+    scores = total.ravel()[flat]
+    beams = [beams[p] + [int(t)] for p, t in zip(parents, tokens)]
+    alive, stop = _beam_finish(tokens, scores, alive, beams, stop_set,
+                               finished, W)
+    return parents, tokens, scores, alive, beams, stop
+
+
+def speculative_beam_search(net, draft, seed_ids, steps: int,
+                            vocab_size: int,
+                            beam_width: int = 4,
+                            gamma: int = 4,
+                            max_length: Optional[int] = None,
+                            prime_chunk_max: Optional[int] = None,
+                            stop_tokens=()
+                            ) -> Tuple[List[int], float]:
+    """Beam search accelerated by speculation — the last edge of the
+    serving matrix (beam × speculative). Output EQUALS beam_search's
+    (sequence, score) exactly (test-pinned); the target runs once per
+    round instead of once per step.
+
+    Structure: `draft` (a host proposer callable `(ids, gamma) ->
+    proposals`, e.g. prompt_lookup_proposer — zero extra dispatches)
+    proposes a continuation for EVERY beam; one batched target forward
+    scores each beam's pending token plus all its proposals; the
+    host-side walk then replays the exact beam-update rule
+    (_beam_update) step by step from the verify logits. A drafted step
+    is accepted while the true update extends each beam with its own
+    proposal (identity parents, drafted tokens, nothing finishing) —
+    the collective beam state advances exactly as drafted, so every
+    row's cache is already correct. The first divergence applies the
+    TRUE update from the same verify logits (no extra dispatch), the
+    uniform over-consumed tail rewinds (scalar rewind_stream_state —
+    composes with windowed rolling caches), and the corrected tokens
+    ride the next round's verify chunk as the per-beam pending front.
+
+    Acceptance is collective — beam reordering anywhere rejects the
+    round's remainder — so speculation pays off on peaky/repetitive
+    workloads where each beam confidently extends itself (extraction,
+    quoting, memorized serving); elsewhere it degrades to plain beam's
+    one-dispatch-per-step with identical output. Finished-slot rounds
+    (EOS) also degrade gracefully: a dead slot makes identity parents
+    impossible, so rounds commit one corrected step each, still never
+    exceeding plain beam's dispatch count (+1 worst case).
+
+    ref: the reference's beam decoding lives in its seq2seq examples;
+    speculative verification is the Leviathan et al. 2023 scheme with
+    the acceptance rule adapted from token-match to beam-state-match.
+    """
+    from deeplearning4j_tpu.nn.conf.layers import (check_rewindable,
+                                                   rewind_stream_state)
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    if not callable(draft) or hasattr(draft, "rnn_time_step"):
+        raise TypeError(
+            "speculative_beam_search drafts with a host proposer "
+            "callable (ids, gamma) -> proposals; model drafts would "
+            "need a beam-synchronized draft stream (not implemented)")
+    V = vocab_size
+    _check_seed(seed_ids, steps, max_length)
+    check_rewindable(net, gamma)
+    stop_set = set(stop_tokens)
+    W = min(beam_width, V)
+    Wb = _width_bucket(W)
+    net.rnn_clear_previous_state()
+
+    out = _prime(net, seed_ids, V, prime_chunk_max)
+    reorder_stream_state(net, np.zeros(Wb, np.int64))
+    logp0 = np.log(np.clip(_probs(out)[0, :, -1], 1e-12, None))
+
+    # first expansion: top-W first tokens of beam 0 (identical to
+    # beam_search's `first` branch, incl. _beam_finish and the float32
+    # score dtype — accumulation dtype is part of the parity contract);
+    # the chosen tokens become the per-beam pending front of round 1
+    top = np.argsort(logp0)[::-1][:W]
+    beams = [list(seed_ids) + [int(t)] for t in top]
+    scores = logp0[top]
+    alive = np.ones(W, bool)
+    finished = []
+    pending = top.astype(np.int64)      # [W] committed, not yet consumed
+    committed = 1
+    want = steps
+    if max_length is not None:
+        want = min(want, max_length - len(seed_ids))
+    alive, stop_now = _beam_finish(top, scores, alive, beams, stop_set,
+                                   finished, W)
+    decided = committed >= want or stop_now
+
+    while not decided:
+        # draft per live beam; collective acceptance needs a common
+        # depth, so g is the shortest proposal list (0 => pure
+        # correction round, one dispatch per token — plain beam's rate)
+        g = min(gamma, want - committed - 1)
+        proposals = None
+        if g > 0 and alive.all():
+            plists = [[int(t) for t in draft(beams[w], g)][:g]
+                      for w in range(W)]
+            g = min(len(p) for p in plists)
+            if g > 0:
+                proposals = np.asarray([p[:g] for p in plists],
+                                       np.int64)          # [W, g]
+        if proposals is None:
+            g = 0
+
+        chunk = np.zeros((Wb, 1 + g), np.int64)
+        chunk[:W, 0] = pending
+        if g:
+            chunk[:W, 1:] = proposals
+        out = net.rnn_time_step(_one_hot(chunk, V))
+        tp = _probs(out)                                   # [Wb, V, 1+g]
+
+        accepted = 0
+        stop_now = False
+        parents = tokens = None
+        for j in range(g + 1):
+            if committed >= want:
+                break
+            logp = np.log(np.clip(tp[:W, :, j], 1e-12, None))
+            parents, tokens, scores, alive, beams, stop_now = \
+                _beam_update(logp, scores, alive, beams, stop_set,
+                             finished, W, V)
+            committed += 1
+            if stop_now:
+                break
+            if (j < g
+                    and np.array_equal(parents, np.arange(W))
+                    and np.array_equal(tokens, proposals[:, j])
+                    and alive.all()):
+                accepted += 1
+                parents = tokens = None   # state advanced as drafted
+                continue
+            break                         # divergence or bonus applied
+
+        # drop the over-consumed drafted tail (uniform across rows: the
+        # accepted prefix advanced every cache identically)
+        over = g - accepted
+        if over:
+            rewind_stream_state(net, over)
+        if committed >= want or stop_now:
+            break
+        if parents is not None:
+            # correction/bonus step came from the true update: align
+            # caches to the new beam assignment; tokens become pending
+            pp = np.arange(Wb, dtype=np.int64)
+            pp[:W] = parents
+            if not np.array_equal(pp, np.arange(Wb)):
+                reorder_stream_state(net, pp)
+            pending = np.zeros(W, np.int64)
+            pending[:] = tokens
+        else:
+            # full acceptance with no bonus room (committed cap hit
+            # mid-walk): nothing pending — should not happen because the
+            # walk always ends with a true update or the cap
+            raise AssertionError("round ended without a pending front")
+
     live = [(beams[w], float(scores[w])) for w in range(W)
             if alive[w] and np.isfinite(scores[w])]
     pool = finished if finished else live
