@@ -873,29 +873,21 @@ def beam_search_batch(net, prompts, steps: int, vocab_size: int,
                 top = np.argsort(logp[0])[::-1][:W]
                 parents, tokens = np.zeros(W, np.int64), top
                 scores[b] = logp[0][top]
+                beams[b] = [beams[b][p] + [int(t)]
+                            for p, t in zip(parents, tokens)]
+                alive[b], stop_now = _beam_finish(
+                    tokens, scores[b], alive[b], beams[b], stop_tokens,
+                    finished[b], W)
             else:
-                total = scores[b][:, None] + logp
-                total[~alive[b]] = -np.inf
-                flat = np.argsort(total.ravel())[::-1][:W]
-                parents, tokens = np.divmod(flat, V)
-                scores[b] = total.ravel()[flat]
-            beams[b] = [beams[b][p] + [int(t)]
-                        for p, t in zip(parents, tokens)]
+                # the shared rule (_beam_update) per prompt — one copy
+                # across beam_search / beam_search_batch / speculative
+                parents, tokens, scores[b], alive[b], beams[b], \
+                    stop_now = _beam_update(
+                        logp, scores[b], alive[b], beams[b],
+                        stop_tokens, finished[b], W, V)
             all_parents[b], all_tokens[b] = parents, tokens
-            if stop_tokens:
-                alive[b] = np.ones(W, bool)
-                for w, t in enumerate(tokens):
-                    if int(t) in stop_tokens and \
-                            np.isfinite(scores[b][w]):
-                        finished[b].append((beams[b][w],
-                                            float(scores[b][w])))
-                        alive[b][w] = False
-                if not alive[b].any():
-                    searching[b] = False
-                elif finished[b]:
-                    best_fin = max(sc for _, sc in finished[b])
-                    if scores[b][alive[b]].max() <= best_fin:
-                        searching[b] = False
+            if stop_now:
+                searching[b] = False
             # max_length reached AFTER this extension: stop eagerly so a
             # fully-capped batch skips the trailing decode dispatch
             if searching[b] and max_length is not None and \
@@ -1068,9 +1060,13 @@ def speculative_beam_search(net, draft, seed_ids, steps: int,
     (sequence, score) exactly (test-pinned); the target runs once per
     round instead of once per step.
 
-    Structure: `draft` (a host proposer callable `(ids, gamma) ->
-    proposals`, e.g. prompt_lookup_proposer — zero extra dispatches)
-    proposes a continuation for EVERY beam; one batched target forward
+    Structure: `draft` proposes a continuation for EVERY beam — either
+    a host proposer callable `(ids, gamma) -> proposals` (e.g.
+    prompt_lookup_proposer, zero extra dispatches) or a same-vocab
+    streaming net (beam-synchronized greedy model draft: it streams the
+    same W-row batch, mirroring every feed/rewind/reorder, and costs g
+    draft dispatches per round — wins when the target's forward is much
+    more expensive than the draft's); one batched target forward
     scores each beam's pending token plus all its proposals; the
     host-side walk then replays the exact beam-update rule
     (_beam_update) step by step from the verify logits. A drafted step
@@ -1100,14 +1096,17 @@ def speculative_beam_search(net, draft, seed_ids, steps: int,
                                                    rewind_stream_state)
     if gamma < 1:
         raise ValueError(f"gamma must be >= 1, got {gamma}")
-    if not callable(draft) or hasattr(draft, "rnn_time_step"):
+    if not hasattr(draft, "rnn_time_step") and not callable(draft):
         raise TypeError(
-            "speculative_beam_search drafts with a host proposer "
-            "callable (ids, gamma) -> proposals; model drafts would "
-            "need a beam-synchronized draft stream (not implemented)")
+            "draft must be a streaming net (beam-synchronized greedy "
+            "model draft) or a host proposer callable "
+            "(ids, gamma) -> proposals")
     V = vocab_size
     _check_seed(seed_ids, steps, max_length)
     check_rewindable(net, gamma)
+    draft_is_fn = not hasattr(draft, "rnn_time_step")
+    if not draft_is_fn:
+        check_rewindable(draft, gamma)
     stop_set = set(stop_tokens)
     W = min(beam_width, V)
     Wb = _width_bucket(W)
@@ -1116,6 +1115,13 @@ def speculative_beam_search(net, draft, seed_ids, steps: int,
     out = _prime(net, seed_ids, V, prime_chunk_max)
     reorder_stream_state(net, np.zeros(Wb, np.int64))
     logp0 = np.log(np.clip(_probs(out)[0, :, -1], 1e-12, None))
+    if not draft_is_fn:
+        # the draft streams the SAME beam batch, mirroring every feed,
+        # rewind and reorder, so its caches always hold the committed
+        # beam prefixes (the beam-synchronized draft stream)
+        draft.rnn_clear_previous_state()
+        _prime(draft, seed_ids, V, prime_chunk_max)
+        reorder_stream_state(draft, np.zeros(Wb, np.int64))
 
     # first expansion: top-W first tokens of beam 0 (identical to
     # beam_search's `first` branch, incl. _beam_finish and the float32
@@ -1142,14 +1148,37 @@ def speculative_beam_search(net, draft, seed_ids, steps: int,
         g = min(gamma, want - committed - 1)
         proposals = None
         if g > 0 and alive.all():
-            plists = [[int(t) for t in draft(beams[w], g)][:g]
-                      for w in range(W)]
-            g = min(len(p) for p in plists)
-            if g > 0:
-                proposals = np.asarray([p[:g] for p in plists],
-                                       np.int64)          # [W, g]
+            if draft_is_fn:
+                plists = [[int(t) for t in draft(beams[w], g)][:g]
+                          for w in range(W)]
+                g = min(len(p) for p in plists)
+                if g > 0:
+                    proposals = np.asarray([p[:g] for p in plists],
+                                           np.int64)      # [W, g]
+            else:
+                # greedy model draft: feed pending, then each argmax —
+                # the draft consumes 1+g tokens exactly like the target
+                # and rewinds/reorders with it below
+                tok = np.zeros(Wb, np.int64)
+                tok[:W] = pending
+                out_d = draft.rnn_time_step(_one_hot(tok[:, None], V))
+                props = []
+                for _ in range(g):
+                    nxt = _probs(out_d)[:W, :, -1].argmax(axis=1)
+                    props.append(nxt.astype(np.int64))
+                    tok = np.zeros(Wb, np.int64)
+                    tok[:W] = nxt
+                    out_d = draft.rnn_time_step(
+                        _one_hot(tok[:, None], V))
+                proposals = np.stack(props, axis=1)       # [W, g]
         if proposals is None:
             g = 0
+            if not draft_is_fn:
+                # correction-only round: the draft still consumes the
+                # pending front to stay position-synchronized
+                tok = np.zeros(Wb, np.int64)
+                tok[:W] = pending
+                draft.rnn_time_step(_one_hot(tok[:, None], V))
 
         chunk = np.zeros((Wb, 1 + g), np.int64)
         chunk[:W, 0] = pending
@@ -1161,9 +1190,10 @@ def speculative_beam_search(net, draft, seed_ids, steps: int,
         accepted = 0
         stop_now = False
         parents = tokens = None
+        # invariant: committed + g + 1 <= want (g was clamped to
+        # want - committed - 1 and only shrinks), so every walk step
+        # below is within the budget
         for j in range(g + 1):
-            if committed >= want:
-                break
             logp = np.log(np.clip(tp[:W, :, j], 1e-12, None))
             parents, tokens, scores, alive, beams, stop_now = \
                 _beam_update(logp, scores, alive, beams, stop_set,
@@ -1185,22 +1215,21 @@ def speculative_beam_search(net, draft, seed_ids, steps: int,
         over = g - accepted
         if over:
             rewind_stream_state(net, over)
+            if not draft_is_fn:
+                rewind_stream_state(draft, over)
         if committed >= want or stop_now:
             break
-        if parents is not None:
-            # correction/bonus step came from the true update: align
-            # caches to the new beam assignment; tokens become pending
-            pp = np.arange(Wb, dtype=np.int64)
-            pp[:W] = parents
-            if not np.array_equal(pp, np.arange(Wb)):
-                reorder_stream_state(net, pp)
-            pending = np.zeros(W, np.int64)
-            pending[:] = tokens
-        else:
-            # full acceptance with no bonus room (committed cap hit
-            # mid-walk): nothing pending — should not happen because the
-            # walk always ends with a true update or the cap
-            raise AssertionError("round ended without a pending front")
+        # the walk always ends with a true update (the j == g bonus
+        # step can't take the accept branch), so parents/tokens are set:
+        # align caches to the new beam assignment; tokens become pending
+        pp = np.arange(Wb, dtype=np.int64)
+        pp[:W] = parents
+        if not np.array_equal(pp, np.arange(Wb)):
+            reorder_stream_state(net, pp)
+            if not draft_is_fn:
+                reorder_stream_state(draft, pp)
+        pending = np.zeros(W, np.int64)
+        pending[:] = tokens
 
     live = [(beams[w], float(scores[w])) for w in range(W)
             if alive[w] and np.isfinite(scores[w])]

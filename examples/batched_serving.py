@@ -13,6 +13,9 @@ decode stack, all on one warm model:
    `speculative_sample` exactly.
 3. `beam_search_batch` — the [prompts × beams] grid rides the batch
    axis; one dispatch per step serves every prompt's whole beam.
+4. `speculative_beam_search` — beam × speculation: drafted
+   continuations for every beam verified in ONE batched forward per
+   round, output equal to plain beam search exactly.
 
 Run: python examples/batched_serving.py
 """
@@ -64,7 +67,23 @@ def main(steps: int = 12, beam_width: int = 3):
         assert seq == solo_seq
     print(f"batched beam ({beam_width} beams x {len(prompts)} prompts "
           "on one batch axis) == per-prompt beam")
-    return {"batched": batched, "speculative": spec, "beams": beams}
+
+    # 4. beam x speculation: the matrix's last edge — one batched
+    # verify per round replays the exact beam-update rule host-side
+    from deeplearning4j_tpu.util.decoding import (
+        beam_search, speculative_beam_search)
+    net.rnn_clear_previous_state()
+    sb_seq, sb_score = speculative_beam_search(
+        net, prompt_lookup_proposer(3), prompts[0], steps=steps,
+        vocab_size=V, beam_width=beam_width, gamma=3)
+    net.rnn_clear_previous_state()
+    pb_seq, pb_score = beam_search(net, prompts[0], steps=steps,
+                                   beam_width=beam_width, vocab_size=V)
+    assert sb_seq == pb_seq
+    print("speculative beam == plain beam "
+          f"(score {sb_score:.3f}, drafted rounds verified in batch)")
+    return {"batched": batched, "speculative": spec, "beams": beams,
+            "spec_beam": (sb_seq, sb_score)}
 
 
 if __name__ == "__main__":

@@ -561,9 +561,42 @@ class TestSpeculativeBeam:
         assert got[1] == pytest.approx(got_plain[1], rel=1e-6)
         assert spec < plain, (spec, plain)
 
-    def test_draft_must_be_callable(self):
+    def test_model_draft_equals_plain_beam(self):
+        """A streaming-net draft (beam-synchronized greedy stream)
+        yields the same plain-beam output — the draft only changes how
+        proposals are made, never what is committed."""
+        target = _tfm(layers=2, embed=32, seed=1)
+        draft = _tfm(layers=1, embed=16, seed=999)
+        tnet, dnet = target.init(), draft.init()
+        seed = [1, 2, 3, 1, 2, 3]
+        want = decoding.beam_search(tnet, seed, steps=8, vocab_size=12,
+                                    beam_width=3)
+        tnet.rnn_clear_previous_state()
+        got = decoding.speculative_beam_search(
+            tnet, dnet, seed, steps=8, vocab_size=12, beam_width=3,
+            gamma=3)
+        assert got[0] == want[0]
+        assert got[1] == pytest.approx(want[1], rel=1e-6)
+
+    def test_model_draft_windowed_equals_plain_beam(self):
+        """Model draft + windowed target: both streams rewind the
+        rolling caches uniformly each round."""
+        target = _tfm(layers=1, embed=32, seed=4, window=6, cache=64)
+        draft = _tfm(layers=1, embed=16, seed=99, window=5, cache=64)
+        tnet, dnet = target.init(), draft.init()
+        seed = [2, 4, 2, 4, 2]
+        want = decoding.beam_search(tnet, seed, steps=8, vocab_size=12,
+                                    beam_width=2)
+        tnet.rnn_clear_previous_state()
+        got = decoding.speculative_beam_search(
+            tnet, dnet, seed, steps=8, vocab_size=12, beam_width=2,
+            gamma=3)
+        assert got[0] == want[0]
+        assert got[1] == pytest.approx(want[1], rel=1e-6)
+
+    def test_draft_must_be_net_or_callable(self):
         model = _tfm(layers=1, embed=16, seed=3)
         net = model.init()
-        with pytest.raises(TypeError, match="host proposer"):
+        with pytest.raises(TypeError, match="streaming net"):
             decoding.speculative_beam_search(
-                net, net, [1, 2], steps=4, vocab_size=12)
+                net, 42, [1, 2], steps=4, vocab_size=12)
