@@ -226,6 +226,15 @@ def _templates() -> List[Tagged]:
         if i % 3:
             sent.append(dot)
         out.append(sent)
+    # Pron Modal Verb-base, UNPUNCTUATED 3-token fragments: without
+    # these, no training sentence ever ENDS in a bare verb, so
+    # `nothing-follows` + t1=MD still resolves to "." for unseen verbs
+    # ("it can jump" -> jump/.)
+    for i in range(21):
+        pr = _PRONS[(i * 3 + 1) % len(_PRONS)]
+        m = _MODALS[(i * 2 + 1) % len(_MODALS)]
+        vb = _VERBS_B[i % len(_VERBS_B)]
+        out.append([pr, m, vb])
     # Pron was/were Verb-ing Det Noun .  (PRP aux progressive)
     prons = [("he", "PRP"), ("she", "PRP"), ("it", "PRP"),
              ("they", "PRP"), ("we", "PRP")]

@@ -90,6 +90,18 @@ class TestAnnotatorIntegration:
     def test_default_tagger_cached(self):
         assert default_tagger() is default_tagger()
 
+    def test_unpunctuated_fragments(self):
+        """Fragments without trailing punctuation must not collapse the
+        final word to "." (regression: a corpus where no sentence ended
+        in a bare verb taught `nothing-follows => .`, breaking
+        test_annotation's 'it can jump' — this pins the cross-file
+        contract next to the corpus it depends on)."""
+        t = default_tagger()
+        assert t.tag(["it", "can", "jump"]) == ["PRP", "MD", "VB"]
+        assert t.tag(["she", "must", "decide"]) == ["PRP", "MD", "VB"]
+        tags = t.tag(["the", "teacher", "opens", "the", "window"])
+        assert tags == ["DT", "NN", "VBZ", "DT", "NN"]
+
     def test_full_corpus_training_tags_unseen_morphology(self):
         t = default_tagger()
         # regular morphology on words never in the corpus
