@@ -142,6 +142,19 @@ _H = [
     "Should/MD the/DT committee/NN approve/VB the/DT plan/NN ?/.",
     "Could/MD your/PRP$ sister/NN drive/VB us/PRP home/NN ?/.",
     "Did/VBD the/DT driver/NN stop/VB at/IN the/DT light/NN ?/.",
+    # adverb-final fragments + common time adverbs (unpunctuated ends
+    # must cover non-verb finals too)
+    "We/PRP should/MD leave/VB now/RB",
+    "You/PRP must/MD stop/VB immediately/RB",
+    "He/PRP will/MD arrive/VB soon/RB",
+    "She/PRP might/MD come/VB later/RB",
+    "They/PRP can/MD start/VB today/NN",
+    "I/PRP will/MD call/VB you/PRP tomorrow/NN",
+    "Do/VB it/PRP again/RB",
+    "Come/VB here/RB",
+    "The/DT store/NN is/VBZ open/JJ now/RB ./.",
+    "He/PRP is/VBZ busy/JJ now/RB ,/, but/CC free/JJ later/RB ./.",
+    "Everything/NN looks/VBZ fine/JJ so/RB far/RB ./.",
     # prenominal participles (CD/DT + VBN + NNS)
     "Three/CD stolen/VBN cars/NNS were/VBD found/VBN ./.",
     "The/DT fallen/VBN leaves/NNS covered/VBD the/DT path/NN ./.",

@@ -101,6 +101,10 @@ class TestAnnotatorIntegration:
         assert t.tag(["she", "must", "decide"]) == ["PRP", "MD", "VB"]
         tags = t.tag(["the", "teacher", "opens", "the", "window"])
         assert tags == ["DT", "NN", "VBZ", "DT", "NN"]
+        # adverb-final fragments (the "." attractor has more than one
+        # part of speech to swallow)
+        assert t.tag(["we", "should", "leave", "now"]) == \
+            ["PRP", "MD", "VB", "RB"]
 
     def test_full_corpus_training_tags_unseen_morphology(self):
         t = default_tagger()
