@@ -4,9 +4,9 @@ The reference's CJK analyzers ship multi-megabyte system dictionaries
 (deeplearning4j-nlp-japanese bundles the kuromoji/IPADIC data,
 deeplearning4j-nlp-chinese the ansj/jieba tables) — most of their 19.6k
 LoC + resources is dictionary data. This module is the zero-egress
-counterpart: a hand-curated core-vocabulary dictionary (~1295 Chinese
-words with relative frequencies, ~4026 Japanese entries with POS — the
-round-3..5 expansions generate frequency-weighted conjugated surfaces
+counterpart: a hand-curated core-vocabulary dictionary (~1475 Chinese
+words with relative frequencies, ~4254 Japanese entries with POS — the
+round-3..5b expansions generate frequency-weighted conjugated surfaces
 for curated verb, i/na-adjective, suru-noun, counter and keigo lists:
 core + extended paradigms (progressive, potential, passive, causative,
 volitional, conditionals, imperative), the stand-in for IPADIC's
@@ -118,6 +118,16 @@ _ZH_BUCKETS = (
     # round-5 chengyu (classic 4-char idioms, lattice stress cases)
     (900, "一心一意 三心二意 四面八方 五颜六色 七上八下 十全十美 百发百中 千方百计 万无一失 半途而废 画蛇添足 守株待兔 井底之蛙 亡羊补牢 对牛弹琴 狐假虎威 掩耳盗铃 杯弓蛇影 刻舟求剑 自相矛盾"),
     (800, "理所当然 迫不及待 情不自禁 恍然大悟 全力以赴 聚精会神 专心致志 一丝不苟 精益求精 持之以恒 再接再厉 勇往直前 坚持不懈 脚踏实地 实话实说 将心比心 设身处地 风和日丽 阳光明媚 春暖花开"),
+    # round-5b breadth: everyday vocabulary tier 2
+    (2600, "早晨 夜晚 半夜 凌晨 周末 假期 节日 生日 纪念日 日子 年底 月底 季节 日期 钟头 刹那 瞬间 片刻 从此 至今"),
+    (2400, "客人 主人 大人 小孩 青年 老人 男人 女人 男孩 女孩 婴儿 夫妻 情侣 伙伴 队友 对手 陌生人 熟人 本人 人们"),
+    (2200, "墙壁 地板 天花板 阳台 车库 地下室 院子 栅栏 家具 沙发 地毯 窗帘 镜子 抽屉 柜子 架子 灯泡 插座 开关 水管"),
+    (2000, "毛巾 牙刷 牙膏 肥皂 洗发水 梳子 剪刀 针线 锤子 钉子 螺丝 胶水 绳子 袋子 瓶子 罐子 盖子 把手 轮子 电池"),
+    (2000, "驾驶证 驾照 车牌 地铁 公交车 出租车 自行车 摩托车 卡车 船只 地图 路口 红绿灯 人行道 高速公路 隧道 加油站 车祸 堵车 车速"),
+    (1800, "胳膊 手臂 手腕 脚趾 膝盖 肩膀 脖子 腰部 皮肤 骨头 肌肉 血液 大脑 神经 嗓子 牙齿 舌头 眉毛 胡子 指甲"),
+    (1800, "雷雨 闪电 彩虹 雾气 霜冻 冰雹 微风 大风 暴雨 晴天 阴天 雨天 雪花 气温 湿度 预报 降温 升温 干旱 洪水"),
+    (1600, "钢琴 吉他 小提琴 鼓 笛子 乐器 画笔 颜料 相机 镜头 棋盘 扑克 玩具 拼图 风筝 气球 礼品 奖品 奖杯 证书"),
+    (1600, "感冒药 退烧药 创可贴 绷带 体温计 血压 脉搏 症状 过敏 咳嗽 头疼 牙疼 肚子疼 发炎 受伤 骨折 康复 预防 疫苗 体检"),
 )
 
 ZH_FREQ = {}
@@ -456,6 +466,14 @@ for _num, _nw in _JA_COUNTER_NUMS:
             JA_ENTRIES[_surface] = (_f, "名詞")
 
 
+def _ja_upsert(surface, freq, pos):
+    """Insert/raise a JA_ENTRIES row (max frequency wins, POS follows
+    the winning entry) — the ONE copy of the merge rule for all the
+    round-5 sections below."""
+    if surface not in JA_ENTRIES or JA_ENTRIES[surface][0] < freq:
+        JA_ENTRIES[surface] = (freq, pos)
+
+
 # --- Japanese extended verb paradigms (round-5 expansion) --------------
 #
 # IPADIC prices every inflected surface; the round-3 generator covered
@@ -513,9 +531,9 @@ def _conjugate_ext(dict_form: str, kind: str):
 
 for _dict_form, _freq, _kind in _JA_VERBS:
     for _surface, _form in _conjugate_ext(_dict_form, _kind).items():
-        _f = max(100, int(_freq * _EXT_FORM_WEIGHTS[_form]))
-        if _surface not in JA_ENTRIES or JA_ENTRIES[_surface][0] < _f:
-            JA_ENTRIES[_surface] = (_f, "動詞")
+        _ja_upsert(_surface,
+                   max(100, int(_freq * _EXT_FORM_WEIGHTS[_form])),
+                   "動詞")
 
 
 # --- Japanese keigo (round-5 expansion) --------------------------------
@@ -569,10 +587,8 @@ for _surface, _freq in _JA_KEIGO_ARU5:
     for _sfx, _w in (("", 1.0), ("います", 0.8), ("いました", 0.5),
                      ("いませ", 0.3), ("って", 0.5), ("った", 0.4),
                      ("らない", 0.15)):
-        _f = max(100, int(_freq * _w))
         _s = _surface if _sfx == "" else _base + _sfx
-        if _s not in JA_ENTRIES or JA_ENTRIES[_s][0] < _f:
-            JA_ENTRIES[_s] = (_f, "動詞")
+        _ja_upsert(_s, max(100, int(_freq * _w)), "動詞")
 
 for _dict_form, _freq, _kind in _JA_KEIGO_VERBS:
     for _surface, _form in _conjugate(_dict_form, _kind).items():
@@ -581,12 +597,10 @@ for _dict_form, _freq, _kind in _JA_KEIGO_VERBS:
             JA_ENTRIES[_surface] = (_f, "動詞")
 
 for _surface, _freq in _JA_KEIGO_FIXED:
-    if _surface not in JA_ENTRIES or JA_ENTRIES[_surface][0] < _freq:
-        JA_ENTRIES[_surface] = (_freq, "感動詞")
+    _ja_upsert(_surface, _freq, "感動詞")
 
 for _surface, _freq in _JA_HONORIFIC_NOUNS:
-    if _surface not in JA_ENTRIES or JA_ENTRIES[_surface][0] < _freq:
-        JA_ENTRIES[_surface] = (_freq, "名詞")
+    _ja_upsert(_surface, _freq, "名詞")
 
 
 # --- Japanese grammar formulae (round-5) -------------------------------
@@ -608,5 +622,60 @@ _JA_GRAMMAR = (
 )
 
 for _surface, _freq in _JA_GRAMMAR:
-    if _surface not in JA_ENTRIES or JA_ENTRIES[_surface][0] < _freq:
-        JA_ENTRIES[_surface] = (_freq, "助詞")
+    _ja_upsert(_surface, _freq, "助詞")
+
+
+# --- Breadth expansion (round-5b): everyday vocabulary -----------------
+# The largest remaining gap vs IPADIC/ansj is plain vocabulary breadth;
+# these bands extend nouns/adverbs/adjectives with the next tier of
+# everyday words (same rank-bucketed weighting as the core bands).
+
+_JA_EXTRA_NOUNS = (
+    (3000, "今日 明日 昨日 今年 去年 来年 今月 先月 来月 今週 先週 来週"),
+    (2800, "朝 昼 夜 夕方 午前 午後 週末 平日 休日 祝日 誕生日 記念日"),
+    (2500, "家 部屋 台所 風呂 庭 玄関 窓 壁 床 屋根 階段 廊下"),
+    (2500, "駅 空港 港 道 橋 信号 交差点 駐車場 停留所 地下鉄 新幹線 切符"),
+    (2200, "会社 工場 事務所 会議 仕事 給料 残業 出張 休憩 退職 面接 名刺"),
+    (2200, "学校 大学 教室 授業 宿題 試験 成績 先生 学生 生徒 卒業式 入学式"),
+    (2000, "朝ご飯 昼ご飯 晩ご飯 野菜 果物 肉 魚 卵 米 パン 麺 スープ"),
+    (2000, "水 湯 茶 牛乳 ジュース ビール 酒 砂糖 塩 醤油 味噌 油"),
+    (1800, "頭 顔 目 耳 鼻 口 手 足 腕 指 背中 お腹"),
+    (1800, "天気 雨 雪 風 雲 空 太陽 月 星 気温 台風 地震"),
+    (1600, "音楽 映画 写真 絵 歌 踊り 本 新聞 雑誌 手紙 葉書 切手"),
+    (1600, "病気 風邪 熱 薬 病院 医者 看護師 注射 手術 検査 保険 健康"),
+    (1500, "服 シャツ ズボン スカート 靴 靴下 帽子 眼鏡 時計 鞄 財布 傘"),
+    (1500, "犬 猫 鳥 魚類 馬 牛 豚 羊 兎 象 虎 猿"),
+)
+
+for _freq, _words in _JA_EXTRA_NOUNS:
+    for _w in _words.split():
+        _ja_upsert(_w, _freq, "名詞")
+
+_JA_EXTRA_ADVERBS = (
+    (4000, "とても もっと たくさん 少し ちょっと すぐ まだ もう"),
+    (3000, "やっと きっと たぶん 全然 必ず 多分 本当に 特に"),
+    (2500, "いつも ときどき たまに よく あまり ほとんど そろそろ なかなか"),
+    (2000, "ゆっくり はっきり しっかり ちゃんと だんだん どんどん わざと うっかり"),
+)
+
+for _freq, _words in _JA_EXTRA_ADVERBS:
+    for _w in _words.split():
+        _ja_upsert(_w, _freq, "副詞")
+
+# extra i-adjectives through the same conjugation generator
+_JA_EXTRA_I_ADJ = (  # additions ONLY — the core _JA_I_ADJECTIVES list
+    # stays the single source of truth for its own words
+    ("寂しい", 1500), ("眠い", 1500), ("痛い", 1800), ("怖い", 1800),
+    ("恥ずかしい", 1200), ("珍しい", 1200), ("素晴らしい", 1500),
+    ("不味い", 800), ("甘い", 1500), ("辛い", 1500), ("苦い", 1000),
+    ("深い", 1200), ("浅い", 800), ("固い", 1000), ("柔らかい", 1000),
+    ("細い", 1000), ("太い", 1000), ("眩しい", 600), ("優しい", 1800),
+    ("厳しい", 1500), ("激しい", 1200), ("詳しい", 1200),
+    ("正しい", 1500), ("等しい", 600),
+)
+
+for _dict_form, _freq in _JA_EXTRA_I_ADJ:
+    for _surface, _form in _conjugate_i_adj(_dict_form).items():
+        _ja_upsert(_surface,
+                   max(100, int(_freq * _ADJ_FORM_WEIGHTS[_form])),
+                   "形容詞")
