@@ -64,6 +64,23 @@ _emit_lock = threading.Lock()
 _emitted = False
 
 
+def _metrics_snapshot():
+    """Compact telemetry-registry snapshot for the record: phase spans,
+    jit compile counts, HBM high-water marks. Never raises and never
+    initializes a backend — it must survive every failure path,
+    including tpu-unavailable before jax ever came up. The gauge-refresh
+    wait is capped well under any external kill grace period: this runs
+    inside _emit, and a memory_stats() hang over a dead tunnel must not
+    stall the guaranteed result line (the measure path refreshes gauges
+    while the backend is known-alive, so the snapshot here is current on
+    the success path even with the refresh wait expiring)."""
+    try:
+        from deeplearning4j_tpu.monitoring.exporters import metrics_snapshot
+        return metrics_snapshot(refresh_timeout=0.5)
+    except Exception:  # noqa: BLE001 — the record beats the snapshot
+        return {}
+
+
 def _emit(value, vs_baseline, **extra):
     """Print the single JSON result line. First caller wins — the
     watchdog thread and the main thread can race at the deadline, and
@@ -74,6 +91,7 @@ def _emit(value, vs_baseline, **extra):
         if _emitted:
             return False
         _emitted = True
+        extra.setdefault("metrics", _metrics_snapshot())
         print(json.dumps({"metric": METRIC, "value": value,
                           "unit": "images/sec",
                           "vs_baseline": vs_baseline, **extra}), flush=True)
@@ -103,6 +121,19 @@ def _emit_partial_or_fail(kind, detail):
     return _fail(kind, detail), False
 
 
+def _signal_safe_metrics():
+    """Registry-only snapshot for the SIGTERM line: no runtime-gauge
+    refresh and no fresh imports (either could block inside a signal
+    handler) — the registry is read only if telemetry already started.
+    A killed live-TPU run is exactly the record whose phase spans and
+    compile counts we can least afford to lose."""
+    try:
+        mod = sys.modules.get("deeplearning4j_tpu.monitoring.metrics")
+        return mod.global_registry().snapshot_compact() if mod else {}
+    except Exception:  # noqa: BLE001 — the killed line beats the snapshot
+        return {}
+
+
 def _term_line(signum):
     detail = (f"killed by signal {signum} (external timeout) "
               "before completion")
@@ -111,11 +142,12 @@ def _term_line(signum):
             "metric": METRIC, "value": _partial["value"],
             "unit": "images/sec", "vs_baseline": _partial["vs"],
             "platform": _partial["platform"], **_partial["extra"],
-            "ab_incomplete": f"killed: {detail}"[:200]}) + "\n").encode()
+            "ab_incomplete": f"killed: {detail}"[:200],
+            "metrics": _signal_safe_metrics()}) + "\n").encode()
     return (json.dumps({
         "metric": METRIC, "value": None, "unit": "images/sec",
         "vs_baseline": None, "error": "killed",
-        "detail": detail}) + "\n").encode()
+        "detail": detail, "metrics": _signal_safe_metrics()}) + "\n").encode()
 
 
 def _term_claim(signum):
@@ -187,6 +219,14 @@ def main():
             # starts, so env overrides are dead — jax.config is the only
             # working switch (smoke tests: BENCH_PLATFORM=cpu)
             jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+        try:
+            # telemetry on before any compile happens: the registry
+            # snapshot in the record then carries per-fn jit compile
+            # counts and phase spans for the whole run
+            from deeplearning4j_tpu import monitoring
+            monitoring.ensure_started()
+        except Exception:  # noqa: BLE001 — telemetry must not block a bench
+            pass
         devices = jax.devices()
     except Exception as e:  # "Unable to initialize backend ..." and kin
         backend_up.set()
@@ -230,21 +270,39 @@ def main():
         labels = {net.conf.network_outputs[0]: jnp.asarray(y)}
         key = jax.random.PRNGKey(0)
 
-        params, state, upd = net.params, net.state, net.updater_state
-        for _ in range(WARMUP):
-            params, state, upd, loss = step(params, state, upd, inputs,
-                                            labels, key, None, None)
-        # sync on a scalar device->host fetch: it cannot complete before the
-        # whole chained computation has (block_until_ready on donated buffers
-        # returns early on the tunneled platform and under-measures wildly)
-        float(loss)
+        try:
+            from deeplearning4j_tpu.monitoring.tracing import span
+        except Exception:  # noqa: BLE001 — telemetry must not cost the
+            from contextlib import nullcontext as span  # result line
 
-        t0 = time.perf_counter()
-        for _ in range(STEPS):
-            params, state, upd, loss = step(params, state, upd, inputs,
-                                            labels, key, None, None)
-        float(loss)
-        return BATCH * STEPS / (time.perf_counter() - t0)
+        params, state, upd = net.params, net.state, net.updater_state
+        with span("bench_warmup"):  # compile + warmup, visible in "metrics"
+            for _ in range(WARMUP):
+                params, state, upd, loss = step(params, state, upd, inputs,
+                                                labels, key, None, None)
+            # sync on a scalar device->host fetch: it cannot complete before
+            # the whole chained computation has (block_until_ready on donated
+            # buffers returns early on the tunneled platform and
+            # under-measures wildly)
+            float(loss)
+
+        with span("bench_measure"):
+            t0 = time.perf_counter()
+            for _ in range(STEPS):
+                params, state, upd, loss = step(params, state, upd, inputs,
+                                                labels, key, None, None)
+            float(loss)
+            dt = time.perf_counter() - t0
+        try:
+            # the float(loss) sync just proved the backend alive: refresh
+            # HBM/RSS gauges NOW so the record's snapshot carries the
+            # run's high-water marks without _emit having to wait on a
+            # possibly-dead tunnel later
+            from deeplearning4j_tpu.monitoring import runtime
+            runtime.refresh()
+        except Exception:  # noqa: BLE001 — gauges are best-effort
+            pass
+        return BATCH * STEPS / dt
 
     try:
         # BENCH_FUSE: 0 unfused, 1 bn→act→conv plan, 2 full fused-

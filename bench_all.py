@@ -36,14 +36,33 @@ import bench_probe
 
 _print_lock = threading.Lock()
 _pending_kill = [None]   # killed-line bytes parked by a mid-print SIGTERM
+_prev_metrics_snap = [None]  # full registry snapshot at the last record
+
+
+def _signal_safe_metrics():
+    """Registry DELTA since the last record, for the killed line — the
+    telemetry of exactly the bench that was killed. No runtime-gauge
+    refresh and no fresh imports (either could block inside a signal
+    handler): the registry is read only if telemetry already started."""
+    try:
+        mmod = sys.modules.get("deeplearning4j_tpu.monitoring.metrics")
+        emod = sys.modules.get("deeplearning4j_tpu.monitoring.exporters")
+        if mmod and emod:
+            return emod.snapshot_delta_compact(
+                _prev_metrics_snap[0], mmod.global_registry().snapshot())
+        return mmod.global_registry().snapshot_compact() if mmod else {}
+    except Exception:  # noqa: BLE001 — the killed line beats the snapshot
+        return {}
 
 
 def _killed_line(signum):
     """The one place the killed record is built — the SIGTERM handler
     and the parked-kill path must emit byte-identical lines."""
-    return (_fail_line(
+    d = json.loads(_fail_line(
         "killed", f"killed by signal {signum} (external timeout) "
-        "before completion") + "\n").encode()
+        "before completion"))
+    d["metrics"] = _signal_safe_metrics()
+    return (json.dumps(d) + "\n").encode()
 
 
 def _print_line(s, flush=True):
@@ -51,7 +70,27 @@ def _print_line(s, flush=True):
     tell 'mid-print' (don't interleave/truncate — let it finish) from
     'safe to emit the killed line'. A SIGTERM that lands mid-print is
     PARKED, not dropped: once this line is safely out, emit the killed
-    record and honor the termination."""
+    record and honor the termination.
+
+    Every record also picks up a telemetry-registry DELTA here — the
+    increment since the previous record (phase spans, jit compiles;
+    gauges stay point-in-time) — so the Nth bench's "metrics" carries
+    only its own telemetry, not the cumulative totals of every earlier
+    bench in the process. One choke point instead of twenty call
+    sites."""
+    try:
+        d = json.loads(s)
+        if isinstance(d, dict) and "metrics" not in d:
+            from deeplearning4j_tpu.monitoring.exporters import (
+                refresh_runtime_bounded, snapshot_delta_compact)
+            from deeplearning4j_tpu.monitoring.metrics import global_registry
+            refresh_runtime_bounded(0.5)
+            cur = global_registry().snapshot()
+            d["metrics"] = snapshot_delta_compact(_prev_metrics_snap[0], cur)
+            _prev_metrics_snap[0] = cur
+            s = json.dumps(d)
+    except Exception:  # noqa: BLE001 — the record beats the snapshot
+        pass
     with _print_lock:
         print(s, flush=flush)
     if _pending_kill[0] is not None:
@@ -305,8 +344,10 @@ def bench_scaling():
                 "dryrun_multichip(8); print('ok')")],
             capture_output=True, text=True, timeout=900)
         ok = r.returncode == 0 and "ok" in r.stdout
+        # the work ran in a subprocess: the parent registry has nothing to
+        # say about it, so pre-empt _print_line's snapshot stamping
         _print_line(json.dumps({"metric": "scaling_8dev", "value": 1.0 if ok else 0.0,
-                          "unit": "dryrun_ok(virtual)"}), flush=True)
+                          "unit": "dryrun_ok(virtual)", "metrics": {}}), flush=True)
         return
     import jax.numpy as jnp
     import numpy as np
@@ -796,6 +837,12 @@ if __name__ == "__main__":
                 perr or f"no TPU backend answered {attempts} probes "
                 f"over {waited:.0f}s (last saw: {platform!r})"))
             sys.exit(3)
+    try:
+        # count jit compiles + declare span series before any bench runs
+        from deeplearning4j_tpu import monitoring
+        monitoring.ensure_started()
+    except Exception:  # noqa: BLE001 — telemetry must not block a bench
+        pass
     names = sys.argv[1:] or ["resnet", "lstm", "lenet", "vgg16",
                              "inception", "attention", "transformer",
                              "scaling", "word2vec"]

@@ -1,0 +1,188 @@
+"""Device/runtime gauges and the jit-recompilation watcher.
+
+Three signal families, all landing in the shared registry:
+
+- per-device HBM from ``device.memory_stats()`` (bytes_in_use /
+  peak_bytes_in_use / bytes_limit) — the peak gauge is the HBM
+  high-water mark the bench records care about;
+- host RSS, reusing ``ui/stats._current_rss_mb``;
+- ``jax.jit`` cache misses counted PER FUNCTION NAME, so a per-iteration
+  retrace (shape churn, stale jit key) shows up as a climbing
+  ``dl4jtpu_jit_compiles_total{fn=...}`` instead of a silent 10x slowdown.
+
+The recompile watcher taps the DEBUG-level "Compiling <fn> ..." records
+that jax._src.interpreters.pxla logs on every tracing-cache miss. The
+handler is non-propagating so enabling DEBUG on that logger does not spray
+compile logs to the user's handlers; records at WARNING+ (the
+``jax_log_compiles=True`` case) are forwarded upstream unchanged.
+
+Everything here degrades gracefully: no jax import at module load, no
+backend initialization ever (a scrape must never be the thing that first
+touches — and hangs on — the accelerator; see ui/server.py's same guard).
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import sys
+import threading
+from typing import Optional
+
+from deeplearning4j_tpu.monitoring.metrics import (
+    MetricsRegistry, global_registry)
+
+COMPILE_COUNTER = "dl4jtpu_jit_compiles_total"
+COMPILE_SECONDS = "dl4jtpu_jit_compile_seconds"
+
+_JAX_COMPILE_LOGGER = "jax._src.interpreters.pxla"
+_COMPILE_RE = re.compile(r"^Compiling ([^\s]+) ")
+
+
+def _backend_initialized() -> bool:
+    """True only if a jax backend ALREADY exists — never triggers init
+    (the tunneled TPU platform hangs rather than erroring when down)."""
+    if "jax" not in sys.modules:
+        return False
+    try:
+        from jax._src import xla_bridge as xb
+        return bool(getattr(xb, "_backends", None))
+    except Exception:  # noqa: BLE001 — private API moved: skip gauges
+        return False
+
+
+def update_host_gauges(registry: Optional[MetricsRegistry] = None) -> None:
+    from deeplearning4j_tpu.ui.stats import _current_rss_mb
+    rss = _current_rss_mb()
+    if rss is not None:
+        r = registry or global_registry()
+        r.gauge("dl4jtpu_host_rss_mb",
+                "Host resident set size (MB)").set(rss)
+
+
+def update_device_gauges(registry: Optional[MetricsRegistry] = None) -> None:
+    if not _backend_initialized():
+        return
+    import jax
+    r = registry or global_registry()
+    in_use = r.gauge("dl4jtpu_device_bytes_in_use",
+                     "Device memory currently allocated", ("device",))
+    peak = r.gauge("dl4jtpu_device_peak_bytes_in_use",
+                   "Device memory high-water mark", ("device",))
+    limit = r.gauge("dl4jtpu_device_bytes_limit",
+                    "Device memory capacity", ("device",))
+    try:
+        devices = jax.devices()
+    except Exception:  # noqa: BLE001 — backend died under us
+        return
+    for d in devices:
+        try:
+            ms = d.memory_stats()
+        except Exception:  # noqa: BLE001 — CPU backends return None/raise
+            ms = None
+        if not ms:
+            continue
+        name = f"{d.platform}:{d.id}"
+        for key, gauge in (("bytes_in_use", in_use),
+                           ("peak_bytes_in_use", peak),
+                           ("bytes_limit", limit)):
+            if key in ms:
+                gauge.set(float(ms[key]), device=name)
+
+
+def refresh(registry: Optional[MetricsRegistry] = None) -> None:
+    """Bring point-in-time gauges current (called on every scrape)."""
+    update_host_gauges(registry)
+    update_device_gauges(registry)
+
+
+class RecompileWatcher(logging.Handler):
+    """Counts jax.jit tracing-cache misses per function name."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        super().__init__(level=logging.DEBUG)
+        self._registry = registry or global_registry()
+        self._prev_level: Optional[int] = None
+        self._prev_propagate: Optional[bool] = None
+        self._installed = False
+
+    def counter(self):
+        return self._registry.counter(
+            COMPILE_COUNTER,
+            "jax.jit tracing-cache misses (compiles) per function name",
+            ("fn",))
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            msg = record.getMessage()
+            m = _COMPILE_RE.match(msg)
+            if m:
+                self.counter().inc(fn=m.group(1))
+            # keep jax_log_compiles=True user-visible despite propagate=False
+            if record.levelno >= logging.WARNING and self._prev_propagate:
+                logging.getLogger("jax").handle(record)
+        except Exception:  # noqa: BLE001 — a watcher must never break a compile
+            pass
+
+    def install(self) -> "RecompileWatcher":
+        if self._installed:
+            return self
+        self.counter()  # declare the series before the first compile
+        lg = logging.getLogger(_JAX_COMPILE_LOGGER)
+        self._prev_level = lg.level
+        self._prev_propagate = lg.propagate
+        lg.addHandler(self)
+        lg.setLevel(logging.DEBUG)
+        lg.propagate = False
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        lg = logging.getLogger(_JAX_COMPILE_LOGGER)
+        lg.removeHandler(self)
+        lg.setLevel(self._prev_level)
+        lg.propagate = self._prev_propagate
+        self._installed = False
+
+
+_default_watcher: Optional[RecompileWatcher] = None
+_duration_listener_registered = False
+_lock = threading.Lock()
+
+
+def _register_compile_duration_listener(
+        registry: Optional[MetricsRegistry] = None) -> None:
+    """Route backend-compile durations into a histogram. jax.monitoring
+    offers no per-listener unregister, so this is once-per-process —
+    the first installer's registry wins, matching the default-watcher
+    rule in install_recompile_watcher."""
+    global _duration_listener_registered
+    if _duration_listener_registered:
+        return
+    try:
+        import jax.monitoring as jm
+    except Exception:  # noqa: BLE001 — no jax here
+        return
+    hist = (registry or global_registry()).histogram(
+        COMPILE_SECONDS, "XLA backend compile durations")
+
+    def _on_duration(event: str, duration: float, **kw) -> None:
+        if event == "/jax/core/compile/backend_compile_duration":
+            hist.observe(duration)
+
+    jm.register_event_duration_secs_listener(_on_duration)
+    _duration_listener_registered = True
+
+
+def install_recompile_watcher(
+        registry: Optional[MetricsRegistry] = None) -> RecompileWatcher:
+    """Idempotent process-wide default watcher (fit loops and bench
+    drivers call this; the first call wins)."""
+    global _default_watcher
+    with _lock:
+        if _default_watcher is None:
+            _default_watcher = RecompileWatcher(registry).install()
+            _register_compile_duration_listener(registry)
+        return _default_watcher

@@ -1,0 +1,157 @@
+"""Nestable span tracing bridged to both the metrics registry and XPlane.
+
+    with span("forward"):
+        ...
+
+records the wall-clock duration into the `dl4jtpu_span_seconds{span=...}`
+histogram of the global registry AND emits a `jax.profiler.TraceAnnotation`
+so the same region lines up with XPlane traces captured by
+`optimize.profiler.ProfilerListener` (TensorBoard/xprof shows the span as
+a named host-side slice inside the trace window).
+
+Spans nest via a thread-local stack (`current_path()` returns e.g.
+"iteration/forward"); the histogram label stays the LEAF name so series
+cardinality is bounded by the set of span names, not call paths.
+
+`set_enabled(False)` turns spans into no-ops (for overhead-sensitive
+loops); `set_phase_detail(True)` switches the fit loops from the single
+fused train step (span "step") to split forward/backward/update steps so
+the per-phase histograms carry real device timings — see
+MultiLayerNetwork._get_phase_steps for the cost tradeoff.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from deeplearning4j_tpu.monitoring.metrics import (
+    MetricsRegistry, global_registry)
+
+SPAN_HISTOGRAM = "dl4jtpu_span_seconds"
+SPAN_ERRORS = "dl4jtpu_span_errors_total"
+
+#: the phase names the fit loops emit; declared eagerly so the /metrics
+#: exposition always carries all per-phase series (etl/forward/backward/
+#: update populate per the phase-detail mode, "step" is the fused step)
+DEFAULT_SPANS = ("etl", "forward", "backward", "update", "step", "listener")
+
+_tls = threading.local()
+_enabled = True
+_phase_detail = os.environ.get(
+    "DL4JTPU_PHASE_DETAIL", "0").strip().lower() not in (
+    "0", "", "false", "no", "off")
+
+# jax.profiler.TraceAnnotation, resolved lazily: the metrics side of a
+# span must work in processes where jax never imported (bench failure
+# paths). None = unresolved, False = unavailable.
+_annotation_cls = None
+
+
+def _get_annotation_cls():
+    global _annotation_cls
+    if _annotation_cls is None:
+        try:
+            from jax.profiler import TraceAnnotation
+            _annotation_cls = TraceAnnotation
+        except Exception:  # noqa: BLE001 — no jax: spans still time
+            _annotation_cls = False
+    return _annotation_cls
+
+
+def set_enabled(flag: bool) -> None:
+    global _enabled
+    _enabled = bool(flag)
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def set_phase_detail(flag: bool) -> None:
+    """True: fit loops run split forward/backward/update jitted steps so
+    those spans measure real device time (3 dispatches, residuals
+    materialized at the seams). False (default): the single fused step
+    keeps maximum XLA fusion and records under span "step"."""
+    global _phase_detail
+    _phase_detail = bool(flag)
+
+
+def phase_detail() -> bool:
+    return _phase_detail
+
+
+def current_path() -> str:
+    """Slash-joined stack of open spans on this thread ("" outside any)."""
+    return "/".join(getattr(_tls, "stack", ()))
+
+
+def span_histogram(registry: Optional[MetricsRegistry] = None):
+    r = registry or global_registry()
+    return r.histogram(
+        SPAN_HISTOGRAM,
+        "Wall-clock seconds of named training-loop spans "
+        "(host-side; aligns with XPlane TraceAnnotations)", ("span",))
+
+
+def record_span(name: str, seconds: float,
+                registry: Optional[MetricsRegistry] = None) -> None:
+    """Directly record a span observation (used by TrainingStats and any
+    timer that measured the interval itself)."""
+    span_histogram(registry).observe(seconds, span=name)
+
+
+def declare_default_spans(registry: Optional[MetricsRegistry] = None) -> None:
+    h = span_histogram(registry)
+    for name in DEFAULT_SPANS:
+        h.labels(span=name)
+
+
+class span:
+    """Context manager: time a region into the registry + XPlane."""
+
+    __slots__ = ("name", "registry", "_t0", "_ann")
+
+    def __init__(self, name: str,
+                 registry: Optional[MetricsRegistry] = None):
+        self.name = name
+        self.registry = registry
+
+    def __enter__(self):
+        if not _enabled:
+            self._t0 = None
+            return self
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        stack.append(self.name)
+        self._ann = None
+        cls = _get_annotation_cls()
+        if cls:
+            try:
+                self._ann = cls(self.name)
+                self._ann.__enter__()
+            except Exception:  # noqa: BLE001 — annotation is best-effort
+                self._ann = None
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._t0 is None:
+            return False
+        dt = time.perf_counter() - self._t0
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(exc_type, exc, tb)
+            except Exception:  # noqa: BLE001
+                pass
+        _tls.stack.pop()
+        r = self.registry or global_registry()
+        span_histogram(r).observe(dt, span=self.name)
+        if exc_type is not None:
+            r.counter(SPAN_ERRORS,
+                      "Spans that exited via an exception",
+                      ("span",)).inc(span=self.name)
+        return False

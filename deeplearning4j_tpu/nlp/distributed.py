@@ -25,10 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax >= 0.8
-    from jax import shard_map
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
+from deeplearning4j_tpu.util.jax_compat import shard_map
 
 
 def make_distributed_glove_step(mesh: Mesh, data_axis: str = "data"):

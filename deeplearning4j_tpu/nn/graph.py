@@ -11,6 +11,7 @@ LOOP_* workspaces (:100-126) are replaced by XLA buffer assignment + donation.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -26,6 +27,10 @@ from deeplearning4j_tpu.nn.conf.layers import (
     stream_capacity)
 from deeplearning4j_tpu.nn.conf.network import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.updater import normalize_gradients
+from deeplearning4j_tpu.monitoring import ensure_started
+from deeplearning4j_tpu.monitoring.listener import maybe_record_fit_iteration
+from deeplearning4j_tpu.monitoring.tracing import phase_detail, span
+from deeplearning4j_tpu.optimize.listeners import close_listeners
 
 log = logging.getLogger(__name__)
 
@@ -694,6 +699,40 @@ class ComputationGraph:
             self._jit_cache[key] = jax.jit(step, donate_argnums=(0, 2))
         return self._jit_cache[key]
 
+    def _get_phase_steps(self, carry_rnn: bool):
+        """Split train step for span phase detail — the ComputationGraph
+        twin of MultiLayerNetwork._get_phase_steps (see its docstring for
+        the vjp-across-jit pattern and the fusion-cost tradeoff)."""
+        if getattr(self, "_quantized", False):
+            raise RuntimeError(
+                "this network was quantized for inference "
+                "(quantize_for_inference) — int8 weights have no "
+                "gradient path; train the fp checkpoint and re-quantize")
+        key = ("phase", carry_rnn, self.conf.dtype)
+        if key not in self._jit_cache:
+            conf = self.conf
+
+            def fwd(params, state, inputs, labels, rng, fmasks, lmasks):
+                loss, vjp_fn, new_state = jax.vjp(
+                    lambda p: self._loss(p, state, inputs, labels, rng,
+                                         fmasks, lmasks, train=True,
+                                         carry_rnn=carry_rnn),
+                    params, has_aux=True)
+                return loss, new_state, vjp_fn
+
+            def bwd(vjp_fn, loss):
+                (grads,) = vjp_fn(jnp.ones_like(loss))
+                return normalize_gradients(grads, conf.gradient_normalization,
+                                           conf.gradient_normalization_threshold)
+
+            def upd(params, grads, upd_state):
+                steps, new_upd = conf.updater.update(grads, upd_state, params)
+                return _tree_sub(params, steps), new_upd
+
+            self._jit_cache[key] = (jax.jit(fwd), jax.jit(bwd),
+                                    jax.jit(upd, donate_argnums=(1, 2)))
+        return self._jit_cache[key]
+
     def _next_rng(self):
         self._rng, sub = jax.random.split(self._rng)
         return sub
@@ -704,6 +743,7 @@ class ComputationGraph:
         keyed by input/output names (MultiDataSet equivalent)."""
         if not self._initialized:
             self.init()
+        ensure_started()
         if labels is not None:
             it = ArrayDataSetIterator(data, labels, batch_size)
         elif isinstance(data, DataSet):
@@ -712,37 +752,64 @@ class ComputationGraph:
         else:
             it = data
 
-        for _ in range(epochs):
-            for lst in self.listeners:
-                lst.on_epoch_start(self, self.epoch_count)
-            for ds in it:
-                self._fit_batch(ds)
-            # completed-epoch ordering: see multilayer.py fit
-            epoch_idx = self.epoch_count
-            self.epoch_count += 1
-            for lst in self.listeners:
-                lst.on_epoch_end(self, epoch_idx)
+        try:
+            for _ in range(epochs):
+                for lst in self.listeners:
+                    lst.on_epoch_start(self, self.epoch_count)
+                for ds in it:
+                    self._fit_batch(ds)
+                # completed-epoch ordering: see multilayer.py fit
+                epoch_idx = self.epoch_count
+                self.epoch_count += 1
+                for lst in self.listeners:
+                    lst.on_epoch_end(self, epoch_idx)
+        finally:
+            close_listeners(self.listeners)
         return self
 
     def _fit_batch(self, ds: DataSet):
-        step = self._get_train_step(False)
-        rng = self._next_rng()
-        inputs = self._as_input_dict(ds.features)
-        labels = {self.conf.network_outputs[0]: jnp.asarray(ds.labels)} \
-            if not isinstance(ds.labels, dict) else \
-            {k: jnp.asarray(v) for k, v in ds.labels.items()}
-        fmasks = self._as_mask_dict(ds.features_mask)
-        lmasks = self._as_mask_dict(ds.labels_mask,
-                                    default_key=self.conf.network_outputs[0])
-        self.params, self.state, self.updater_state, loss = step(
-            self.params, self.state, self.updater_state, inputs, labels, rng,
-            fmasks, lmasks)
-        self.score_value = float(loss)
-        for lst in self.listeners:
-            if hasattr(lst, "record_batch"):
-                lst.record_batch(ds.num_examples())
-            lst.iteration_done(self, self.iteration_count, self.score_value)
+        t0 = time.perf_counter()
+        # listener parity with MultiLayerNetwork._fit_batch: viz listeners
+        # (needs_batch_features) get the raw batch stashed here too
+        if any(getattr(l, "needs_batch_features", False)
+               for l in self.listeners):
+            self._last_batch_features = ds.features
+        with span("etl"):
+            rng = self._next_rng()
+            inputs = self._as_input_dict(ds.features)
+            labels = {self.conf.network_outputs[0]: jnp.asarray(ds.labels)} \
+                if not isinstance(ds.labels, dict) else \
+                {k: jnp.asarray(v) for k, v in ds.labels.items()}
+            fmasks = self._as_mask_dict(ds.features_mask)
+            lmasks = self._as_mask_dict(ds.labels_mask,
+                                        default_key=self.conf.network_outputs[0])
+        if phase_detail() and not getattr(self, "_quantized", False):
+            fwd, bwd, upd = self._get_phase_steps(False)
+            with span("forward"):
+                loss, new_state, vjp_fn = fwd(self.params, self.state, inputs,
+                                              labels, rng, fmasks, lmasks)
+                self.score_value = float(loss)
+            with span("backward"):
+                grads = jax.block_until_ready(bwd(vjp_fn, loss))
+            with span("update"):
+                self.params, self.updater_state = jax.block_until_ready(
+                    upd(self.params, grads, self.updater_state))
+            self.state = new_state
+        else:
+            step = self._get_train_step(False)
+            with span("step"):
+                self.params, self.state, self.updater_state, loss = step(
+                    self.params, self.state, self.updater_state, inputs,
+                    labels, rng, fmasks, lmasks)
+                self.score_value = float(loss)
+        with span("listener"):
+            for lst in self.listeners:
+                if hasattr(lst, "record_batch"):
+                    lst.record_batch(ds.num_examples())
+                lst.iteration_done(self, self.iteration_count, self.score_value)
         self.iteration_count += 1
+        maybe_record_fit_iteration(self, ds.num_examples(),
+                                   time.perf_counter() - t0)
 
     # ------------------------------------------------------------------
     # inference

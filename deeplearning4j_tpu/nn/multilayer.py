@@ -37,6 +37,10 @@ from deeplearning4j_tpu.nn.conf.layers import (
 )
 from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.updater import normalize_gradients
+from deeplearning4j_tpu.monitoring import ensure_started
+from deeplearning4j_tpu.monitoring.listener import maybe_record_fit_iteration
+from deeplearning4j_tpu.monitoring.tracing import phase_detail, span
+from deeplearning4j_tpu.optimize.listeners import close_listeners
 
 log = logging.getLogger(__name__)
 
@@ -274,6 +278,49 @@ class MultiLayerNetwork:
             self._jit_cache[key] = jax.jit(step, donate_argnums=(0, 2))
         return self._jit_cache[key]
 
+    def _get_phase_steps(self, carry_rnn: bool):
+        """Split train step for span phase detail
+        (monitoring.set_phase_detail): forward (vjp residuals), backward
+        (vjp apply + grad normalization), update (updater + constraints)
+        as three jitted calls, so the forward/backward/update spans carry
+        real device timings. Same math as _get_train_step —
+        value_and_grad IS vjp — but the seams cost cross-phase XLA fusion
+        and materialize the residuals, so the fused step stays the
+        default for production throughput."""
+        if getattr(self, "_quantized", False):
+            raise RuntimeError(
+                "this network was quantized for inference "
+                "(quantize_for_inference) — int8 weights have no "
+                "gradient path; train the fp checkpoint and re-quantize")
+        key = ("phase", carry_rnn, self.conf.dtype)
+        if key not in self._jit_cache:
+            conf = self.conf
+
+            def fwd(params, state, x, y, rng, fmask, lmask):
+                loss, vjp_fn, new_state = jax.vjp(
+                    lambda p: self._loss(p, state, x, y, rng, fmask, lmask,
+                                         train=True, carry_rnn=carry_rnn),
+                    params, has_aux=True)
+                return loss, new_state, vjp_fn
+
+            def bwd(vjp_fn, loss):
+                (grads,) = vjp_fn(jnp.ones_like(loss))
+                return normalize_gradients(grads, conf.gradient_normalization,
+                                           conf.gradient_normalization_threshold)
+
+            def upd(params, grads, upd_state):
+                steps, new_upd = conf.updater.update(grads, upd_state, params)
+                new_params = _tree_sub(params, steps)
+                if any(getattr(l, "constraints", None) for l in self.layers):
+                    from deeplearning4j_tpu.nn.conf.constraints import \
+                        apply_constraints
+                    new_params = apply_constraints(self.layers, new_params)
+                return new_params, new_upd
+
+            self._jit_cache[key] = (jax.jit(fwd), jax.jit(bwd),
+                                    jax.jit(upd, donate_argnums=(1, 2)))
+        return self._jit_cache[key]
+
     def _get_output_fn(self, train: bool, carry_rnn: bool,
                        stream: bool = False, padded: bool = False):
         # the process-wide stream-cache sharding config is part of the
@@ -327,6 +374,7 @@ class MultiLayerNetwork:
         """
         if not self._initialized:
             self.init()
+        ensure_started()
         if labels is not None:
             it: DataSetIterator = ArrayDataSetIterator(data, labels, batch_size)
         elif isinstance(data, DataSet):
@@ -335,41 +383,65 @@ class MultiLayerNetwork:
         else:
             it = data
 
-        for epoch in range(epochs):
-            for lst in self.listeners:
-                lst.on_epoch_start(self, self.epoch_count)
-            for ds in it:
-                if self.conf.tbptt and ds.features.ndim == 3:
-                    self._fit_tbptt(ds)
-                else:
-                    self._fit_batch(ds)
-            # increment BEFORE listeners fire: a CheckpointListener save in
-            # on_epoch_end must record this epoch as COMPLETED, or resume
-            # re-trains it (off-by-one). Listeners still receive the
-            # pre-increment epoch index.
-            epoch_idx = self.epoch_count
-            self.epoch_count += 1
-            for lst in self.listeners:
-                lst.on_epoch_end(self, epoch_idx)
+        try:
+            for epoch in range(epochs):
+                for lst in self.listeners:
+                    lst.on_epoch_start(self, self.epoch_count)
+                for ds in it:
+                    if self.conf.tbptt and ds.features.ndim == 3:
+                        self._fit_tbptt(ds)
+                    else:
+                        self._fit_batch(ds)
+                # increment BEFORE listeners fire: a CheckpointListener save
+                # in on_epoch_end must record this epoch as COMPLETED, or
+                # resume re-trains it (off-by-one). Listeners still receive
+                # the pre-increment epoch index.
+                epoch_idx = self.epoch_count
+                self.epoch_count += 1
+                for lst in self.listeners:
+                    lst.on_epoch_end(self, epoch_idx)
+        finally:
+            close_listeners(self.listeners)
         return self
 
     def _fit_batch(self, ds: DataSet, carry_rnn: bool = False):
-        step = self._get_train_step(carry_rnn)
-        rng = self._next_rng()
+        t0 = time.perf_counter()
         if any(getattr(l, "needs_batch_features", False)
                for l in self.listeners):
             self._last_batch_features = ds.features  # for viz listeners
-        fmask = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
-        lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
-        self.params, self.state, self.updater_state, loss = step(
-            self.params, self.state, self.updater_state,
-            jnp.asarray(ds.features), jnp.asarray(ds.labels), rng, fmask, lmask)
-        self.score_value = float(loss)
-        for lst in self.listeners:
-            if hasattr(lst, "record_batch"):
-                lst.record_batch(ds.num_examples())
-            lst.iteration_done(self, self.iteration_count, self.score_value)
+        with span("etl"):
+            rng = self._next_rng()
+            fmask = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
+            lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+            x = jnp.asarray(ds.features)
+            y = jnp.asarray(ds.labels)
+        if phase_detail() and not getattr(self, "_quantized", False):
+            fwd, bwd, upd = self._get_phase_steps(carry_rnn)
+            with span("forward"):
+                loss, new_state, vjp_fn = fwd(self.params, self.state, x, y,
+                                              rng, fmask, lmask)
+                self.score_value = float(loss)
+            with span("backward"):
+                grads = jax.block_until_ready(bwd(vjp_fn, loss))
+            with span("update"):
+                self.params, self.updater_state = jax.block_until_ready(
+                    upd(self.params, grads, self.updater_state))
+            self.state = new_state
+        else:
+            step = self._get_train_step(carry_rnn)
+            with span("step"):
+                self.params, self.state, self.updater_state, loss = step(
+                    self.params, self.state, self.updater_state,
+                    x, y, rng, fmask, lmask)
+                self.score_value = float(loss)
+        with span("listener"):
+            for lst in self.listeners:
+                if hasattr(lst, "record_batch"):
+                    lst.record_batch(ds.num_examples())
+                lst.iteration_done(self, self.iteration_count, self.score_value)
         self.iteration_count += 1
+        maybe_record_fit_iteration(self, ds.num_examples(),
+                                   time.perf_counter() - t0)
 
     def _fit_tbptt(self, ds: DataSet):
         """Truncated BPTT: split the sequence into tbptt_fwd_length chunks,

@@ -33,6 +33,25 @@ class TrainingListener:
     def on_backward_pass(self, model):
         pass
 
+    def close(self):
+        """Release held resources (open traces, files). Invoked from the
+        fit loops' finally — i.e. also when fit() raises — and must be
+        safe to call repeatedly."""
+        pass
+
+
+def close_listeners(listeners) -> None:
+    """Best-effort close() of every listener — the fit loops call this
+    from their finally so a fit that raises (or ends inside a profiler
+    window) never leaks listener resources like an open XPlane trace."""
+    for lst in listeners:
+        close = getattr(lst, "close", None)
+        if callable(close):
+            try:
+                close()
+            except Exception:  # noqa: BLE001 — cleanup best-effort
+                log.warning("listener close() failed", exc_info=True)
+
 
 class ScoreIterationListener(TrainingListener):
     """Log score every N iterations (ref: ScoreIterationListener.java)."""
@@ -95,16 +114,27 @@ class CollectScoresIterationListener(TrainingListener):
 
 
 class TimeIterationListener(TrainingListener):
-    """Estimate remaining time (ref: TimeIterationListener.java)."""
+    """Estimate remaining time (ref: TimeIterationListener.java).
+
+    The clock starts LAZILY on the first iteration_done, not at
+    construction: any setup time between building the listener and
+    calling fit() (data download, jit compile of unrelated models) must
+    not inflate the per-iteration estimate."""
 
     def __init__(self, total_iterations: int):
         self.total = total_iterations
-        self.start = time.perf_counter()
+        self.start: Optional[float] = None
+        self._first_iteration: Optional[int] = None
 
     def iteration_done(self, model, iteration, score):
-        elapsed = time.perf_counter() - self.start
-        if iteration > 0:
-            remaining = elapsed / iteration * (self.total - iteration)
+        now = time.perf_counter()
+        if self.start is None:
+            self.start = now
+            self._first_iteration = iteration
+            return
+        done = iteration - self._first_iteration
+        if done > 0:
+            remaining = (now - self.start) / done * (self.total - iteration)
             log.info("Remaining time estimate: %.1fs", remaining)
 
 

@@ -46,17 +46,29 @@ class ProfilerListener(TrainingListener):
             self._stop_at = iteration + self.num_iterations
             return
         if self._active and iteration >= self._stop_at:
-            jax.profiler.stop_trace()
-            self._active = False
-            self._done = True
-            log.info("profiler trace written to %s", self.log_dir)
+            self._stop()
 
     def on_epoch_end(self, model, epoch: int):
         # never leave a trace open across epochs
-        if self._active:
+        self._stop()
+
+    def close(self):
+        """Invoked from the fit loops' finally: a fit() that raises or
+        ends before _stop_at must not leak an open XPlane trace.
+        Idempotent — repeated close() (or close() after the epoch
+        boundary already stopped the trace) is a no-op."""
+        self._stop()
+
+    def _stop(self):
+        if not self._active:
+            return
+        self._active = False
+        self._done = True
+        try:
             jax.profiler.stop_trace()
-            self._active = False
-            self._done = True
+            log.info("profiler trace written to %s", self.log_dir)
+        except Exception:  # noqa: BLE001 — closing a dead trace must not
+            log.warning("stop_trace failed", exc_info=True)  # mask fit errors
 
 
 class TimingListener(TrainingListener):

@@ -26,10 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax >= 0.8
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from deeplearning4j_tpu.util.jax_compat import shard_map
 
 
 def shard_stage_params(stage_params: list, mesh: Mesh, axis: str = "pipe"):

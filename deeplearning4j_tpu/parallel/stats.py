@@ -30,8 +30,14 @@ class PhaseEvent:
 @dataclass
 class TrainingStats:
     """Collects (phase, start, duration) events
-    (ref: CommonSparkTrainingStats collects per-phase timing lists)."""
+    (ref: CommonSparkTrainingStats collects per-phase timing lists).
+
+    Every completed phase ALSO lands in the process-wide metrics registry
+    (`dl4jtpu_span_seconds{span=<phase>}`) so ParallelWrapper timings show
+    up at /metrics alongside the fit-loop spans; set `registry` to target
+    a non-global MetricsRegistry."""
     events: List[PhaseEvent] = field(default_factory=list)
+    registry: Optional[object] = None
     _open: Dict[str, float] = field(default_factory=dict)
 
     def start_phase(self, phase: str) -> None:
@@ -42,6 +48,8 @@ class TrainingStats:
         if t0 is not None:
             now = time.perf_counter()
             self.events.append(PhaseEvent(phase, t0, (now - t0) * 1000.0))
+            from deeplearning4j_tpu.monitoring.tracing import record_span
+            record_span(phase, now - t0, self.registry)
 
     class _Timer:
         def __init__(self, stats, phase):

@@ -27,10 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax >= 0.8
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from deeplearning4j_tpu.util.jax_compat import shard_map
 
 from deeplearning4j_tpu.parallel.sequence import blockwise_attention
 

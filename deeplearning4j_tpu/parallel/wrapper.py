@@ -27,6 +27,7 @@ The reference's worker thread pool, device pinning (attachThreadToDevice
 from __future__ import annotations
 
 import logging
+import time
 from functools import partial
 from typing import Any, Dict, Optional
 
@@ -34,11 +35,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
+from deeplearning4j_tpu.util.jax_compat import shard_map
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator, AsyncDataSetIterator
+from deeplearning4j_tpu.monitoring.listener import maybe_record_fit_iteration
 from deeplearning4j_tpu.nn.updater import normalize_gradients
+from deeplearning4j_tpu.optimize.listeners import close_listeners
 from deeplearning4j_tpu.parallel.mesh import default_mesh
 
 log = logging.getLogger(__name__)
@@ -125,10 +128,14 @@ class ParallelWrapper:
         return jax.device_put(tree, sh)
 
     def _timer(self, phase: str):
-        """Phase timer; no-op when stats collection is off."""
-        from contextlib import nullcontext
-        return self.stats.time_phase(phase) if self.stats is not None \
-            else nullcontext()
+        """Phase timer. With collect_stats the TrainingStats event list
+        records (and forwards to the metrics registry itself); otherwise a
+        monitoring span lands the phase directly in the registry — either
+        way every ParallelWrapper phase shows up at /metrics."""
+        if self.stats is not None:
+            return self.stats.time_phase(phase)
+        from deeplearning4j_tpu.monitoring.tracing import span
+        return span(phase)
 
     def _stash_batch_for_viz(self, ds: DataSet):
         m = self.model
@@ -143,6 +150,7 @@ class ParallelWrapper:
         """One global SPMD step: inputs sharded, params replicated — the
         jitted step from the wrapped model works unchanged, XLA partitions
         it and inserts the ICI allreduce."""
+        t0 = time.perf_counter()
         m = self.model
         step = m._get_train_step(False)
         rng = m._next_rng()
@@ -171,6 +179,8 @@ class ParallelWrapper:
                     lst.record_batch(self._effective_examples(ds))
                 lst.iteration_done(m, m.iteration_count, m.score_value)
         m.iteration_count += 1
+        maybe_record_fit_iteration(m, self._effective_examples(ds),
+                                   time.perf_counter() - t0)
 
     # ------------------------------------------------------------------
     # averaging mode (parity with ParameterAveraging semantics)
@@ -231,6 +241,7 @@ class ParallelWrapper:
     def _fit_round_averaging(self, batches):
         """Consume `averaging_frequency * n_devices` microbatches as one
         round (ref: ParameterAveragingTrainingMaster split sizing :287-298)."""
+        t0 = time.perf_counter()
         m = self.model
         self._stash_batch_for_viz(batches[-1])
         freq = len(batches) // self.n_devices
@@ -253,16 +264,26 @@ class ParallelWrapper:
                 m.params, m.state, m.updater_state, jnp.asarray(xs),
                 jnp.asarray(ys), jnp.asarray(rngs))
             m.score_value = float(loss)
+        round_examples = sum(b.num_examples() for b in batches)
         with self._timer("listener"):
             for lst in m.listeners:
+                if hasattr(lst, "record_batch"):
+                    # the whole round's examples: a MetricsListener (or
+                    # PerformanceListener) must see the true throughput,
+                    # not zero samples per round
+                    lst.record_batch(round_examples)
                 lst.iteration_done(m, m.iteration_count, m.score_value)
         m.iteration_count += freq
+        maybe_record_fit_iteration(m, round_examples,
+                                   time.perf_counter() - t0, n_batches=freq)
 
     # ------------------------------------------------------------------
     def fit(self, data, labels=None, epochs: int = 1, batch_size: int = 32):
         """Train across the mesh (ref: ParallelWrapper.fit :468). The
         iterator is wrapped in async prefetch like the reference's
         ADSI-per-device feeding."""
+        from deeplearning4j_tpu.monitoring import ensure_started
+        ensure_started()
         m = self.model
         if labels is not None:
             it = ArrayDataSetIterator(data, labels, batch_size)
@@ -271,27 +292,30 @@ class ParallelWrapper:
         else:
             it = data
 
-        for _ in range(epochs):
-            src = AsyncDataSetIterator(it, prefetch=self.prefetch_buffer) \
-                if self.prefetch_buffer else it
-            averaging = self.training_mode == "averaging"
-            round_size = self.averaging_frequency * self.n_devices
-            pend = []
-            src_it = iter(src)
-            while True:
-                with self._timer("etl"):
-                    ds = next(src_it, None)
-                if ds is None:
-                    break
-                if averaging:
-                    pend.append(ds)
-                    if len(pend) == round_size:
-                        self._fit_round_averaging(pend)  # times itself
-                        pend = []
-                else:
-                    self._fit_batch_allreduce(ds)  # times itself
-            # trailing partial averaging round: fall back to allreduce steps
-            for ds in pend:
-                self._fit_batch_allreduce(ds)
-            m.epoch_count += 1
+        try:
+            for _ in range(epochs):
+                src = AsyncDataSetIterator(it, prefetch=self.prefetch_buffer) \
+                    if self.prefetch_buffer else it
+                averaging = self.training_mode == "averaging"
+                round_size = self.averaging_frequency * self.n_devices
+                pend = []
+                src_it = iter(src)
+                while True:
+                    with self._timer("etl"):
+                        ds = next(src_it, None)
+                    if ds is None:
+                        break
+                    if averaging:
+                        pend.append(ds)
+                        if len(pend) == round_size:
+                            self._fit_round_averaging(pend)  # times itself
+                            pend = []
+                    else:
+                        self._fit_batch_allreduce(ds)  # times itself
+                # trailing partial averaging round: allreduce steps
+                for ds in pend:
+                    self._fit_batch_allreduce(ds)
+                m.epoch_count += 1
+        finally:
+            close_listeners(m.listeners)
         return m

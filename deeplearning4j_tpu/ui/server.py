@@ -326,6 +326,19 @@ class _Handler(BaseHTTPRequestHandler):
                   urllib.parse.parse_qs(query).items()}
         if path in ("/", "/train", "/train/overview.html"):
             return self._html(_PAGE)
+        # Prometheus scrape endpoint: the global telemetry registry
+        # (monitoring/) in text exposition format. Runtime gauges
+        # (RSS/HBM) refresh per scrape but never initialize a backend —
+        # same rule as the system tab below.
+        if path == "/metrics":
+            from deeplearning4j_tpu.monitoring import exporters
+            body = exporters.render_prometheus().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", exporters.CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if path == "/chart.js":
             body = _CHART_JS.encode()
             self.send_response(200)

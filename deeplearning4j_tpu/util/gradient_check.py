@@ -17,6 +17,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.util.jax_compat import enable_x64
+
 log = logging.getLogger(__name__)
 
 DEFAULT_EPS = 1e-5
@@ -39,7 +41,7 @@ def check_gradients_fn(loss_fn, params, eps: float = DEFAULT_EPS,
     are meaningless in float32 (the reference runs on float64 ND4J arrays,
     GradientCheckUtil.java:112 requires DataBuffer.Type.DOUBLE).
     """
-    with jax.enable_x64(True):
+    with enable_x64(True):
         return _check_gradients_fn_x64(loss_fn, params, eps, max_rel_error,
                                        min_abs_error, max_per_param, seed,
                                        print_failures)
@@ -52,6 +54,11 @@ def _check_gradients_fn_x64(loss_fn, params, eps, max_rel_error,
     analytic = jax.grad(loss_fn)(params)
     flat_p, treedef = jax.tree_util.tree_flatten(params)
     flat_g = treedef.flatten_up_to(analytic)
+    # one compile, thousands of perturbed evaluations: the eager per-eval
+    # dispatch dominates check time otherwise (2 * max_per_param * n_params
+    # full forward passes)
+    jitted_loss = jax.jit(lambda flat: loss_fn(
+        jax.tree_util.tree_unflatten(treedef, flat)))
     rng = np.random.default_rng(seed)
     ok = True
     for pi, (p, g) in enumerate(zip(flat_p, flat_g)):
@@ -71,7 +78,7 @@ def _check_gradients_fn_x64(loss_fn, params, eps, max_rel_error,
                 p_mod[idx] = v
                 flat2 = list(flat_p)
                 flat2[pi] = jnp.asarray(p_mod)
-                return float(loss_fn(jax.tree_util.tree_unflatten(treedef, flat2)))
+                return float(jitted_loss(flat2))
 
             plus = eval_at(orig + eps)
             minus = eval_at(orig - eps)
@@ -100,7 +107,7 @@ def check_gradients(net, ds, eps: float = DEFAULT_EPS,
 
     if not net._initialized:
         net.init()
-    with jax.enable_x64(True):
+    with enable_x64(True):
         return _check_gradients_x64(net, ds, eps, max_rel_error,
                                     min_abs_error, max_per_param, seed)
 

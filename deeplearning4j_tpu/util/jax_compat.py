@@ -1,0 +1,29 @@
+"""Version-compat shims for jax APIs that moved between releases.
+
+`shard_map` graduated from `jax.experimental.shard_map` to `jax.shard_map`
+(and its replication-check kwarg was renamed `check_rep` -> `check_vma`).
+Call sites across parallel/ and nlp/ use the modern spelling; this module
+makes that spelling work on older runtimes too.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    def shard_map(f, **kw):
+        kw["check_rep"] = kw.pop("check_vma", False)
+        return _shard_map_legacy(f, **kw)
+
+# enable_x64 likewise graduated from jax.experimental to the jax namespace
+try:  # jax >= 0.7
+    from jax import enable_x64
+except ImportError:
+    from jax.experimental import enable_x64 as _enable_x64_legacy
+
+    def enable_x64(new_val: bool = True):
+        return _enable_x64_legacy(new_val)
+
+__all__ = ["shard_map", "enable_x64"]

@@ -7,6 +7,12 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
+# tier-1 observability lane: the telemetry subsystem (monitoring/) gates
+# everything else — run it first, fast and standalone, so a broken
+# /metrics or a fit path that started retracing fails the run in seconds
+# (includes the no-new-retraces guard: instrumentation must not recompile)
+python -m pytest tests/test_monitoring.py -q -p no:cacheprovider
+
 python -m pytest tests/ -q --junitxml=/tmp/dl4jtpu_junit.xml "$@"
 
 # only a FULL unfiltered run may overwrite the committed tally — a

@@ -3,7 +3,12 @@ every sampler draws from must stay a distribution under any filter
 combination."""
 
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property tests need hypothesis; the container "
+    "image may not ship it — skip rather than fail collection")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from deeplearning4j_tpu.util.decoding import filter_probs
 
