@@ -1,0 +1,22 @@
+"""tpulint — AST static analysis for JAX/TPU anti-patterns.
+
+The static half of the performance-defect story (the PR 1 monitoring
+subsystem is the runtime half): catches host syncs in fit hot loops,
+tracer leaks, recompile hazards, f64 promotion, unlocked cross-thread
+mutation, and hygiene defects at review time, before they reach a TPU.
+
+CLI:   python -m deeplearning4j_tpu.analysis [paths] \
+           [--format=text|json] [--baseline=PATH] [--write-baseline]
+API:   scan_paths(paths) -> List[Finding]
+Suppress inline with `# tpulint: disable=<rule-id>` (same line, or a
+standalone comment on the line above carrying the justification).
+"""
+
+from deeplearning4j_tpu.analysis.core import (  # noqa: F401
+    Finding, ModuleInfo, Rule, scan_file, scan_paths)
+from deeplearning4j_tpu.analysis.cli import main  # noqa: F401
+from deeplearning4j_tpu.analysis.rules import (  # noqa: F401
+    ALL_RULES, RULES_BY_ID)
+
+__all__ = ["Finding", "ModuleInfo", "Rule", "scan_file", "scan_paths",
+           "main", "ALL_RULES", "RULES_BY_ID"]

@@ -1,0 +1,83 @@
+"""Baseline handling: grandfathered findings that don't fail the lane.
+
+The committed `TPULINT_BASELINE.json` records the fingerprints of
+findings that were judged acceptable when the analyzer landed (host-side
+f64 math in the t-SNE plotter, etc.). A scan is clean when every finding
+is consumed by a baseline entry; anything beyond the recorded count is
+NEW and fails CI. Fingerprints hash (rule, path, normalized source
+line), not line numbers, so edits elsewhere in a file don't churn the
+baseline — but touching a baselined line itself re-opens the finding,
+which is the desired ratchet.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+from typing import Dict, List, Sequence, Tuple
+
+from deeplearning4j_tpu.analysis.core import Finding
+
+BASELINE_NAME = "TPULINT_BASELINE.json"
+BASELINE_VERSION = 1
+
+
+def repo_root() -> str:
+    """The directory holding the package (where the baseline lives)."""
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def default_baseline_path() -> str:
+    for cand in (os.path.join(os.getcwd(), BASELINE_NAME),
+                 os.path.join(repo_root(), BASELINE_NAME)):
+        if os.path.exists(cand):
+            return cand
+    return os.path.join(repo_root(), BASELINE_NAME)
+
+
+def load_baseline(path: str) -> Dict[str, dict]:
+    """fingerprint -> entry ({rule, path, count, snippet})."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return dict(data.get("findings", {}))
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    entries: Dict[str, dict] = {}
+    for f_ in findings:
+        fp = f_.fingerprint()
+        if fp in entries:
+            entries[fp]["count"] += 1
+        else:
+            entries[fp] = {"rule": f_.rule, "path": f_.path,
+                           "count": 1, "snippet": f_.snippet}
+    payload = {"version": BASELINE_VERSION,
+               "tool": "tpulint",
+               "findings": dict(sorted(entries.items()))}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+def split_new(findings: Sequence[Finding], baseline: Dict[str, dict]
+              ) -> Tuple[List[Finding], int, List[str]]:
+    """Partition findings into (new, baselined_count, stale_fingerprints).
+
+    Stale fingerprints — baseline entries no longer observed — are
+    reported so the baseline can ratchet down as debt is paid."""
+    budget = Counter({fp: e.get("count", 1) for fp, e in baseline.items()})
+    new: List[Finding] = []
+    matched = 0
+    for f_ in findings:
+        fp = f_.fingerprint()
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+            matched += 1
+        else:
+            new.append(f_)
+    stale = sorted(fp for fp, left in budget.items() if left > 0)
+    return new, matched, stale

@@ -1,0 +1,132 @@
+"""tpulint CLI: `python -m deeplearning4j_tpu.analysis [paths] ...`.
+
+Exit codes: 0 = clean against the baseline, 1 = new findings (or parse
+errors), 2 = usage error. `--format=json` emits a machine round-trippable
+report for the CI lane; `--write-baseline` (re)grandfathers the current
+scan.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from deeplearning4j_tpu.analysis import baseline as bl
+from deeplearning4j_tpu.analysis.core import Finding, scan_paths
+from deeplearning4j_tpu.analysis.rules import ALL_RULES, RULES_BY_ID
+
+
+def _default_paths() -> List[str]:
+    """Scan the installed package when no path is given."""
+    return [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m deeplearning4j_tpu.analysis",
+        description="tpulint: AST analyzer for JAX/TPU anti-patterns "
+                    "(host syncs in hot loops, tracer leaks, recompile "
+                    "hazards, f64 promotion, unlocked thread state, "
+                    "hygiene).")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to scan (default: the "
+                        "deeplearning4j_tpu package)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", metavar="PATH",
+                   help=f"baseline file (default: {bl.BASELINE_NAME} in "
+                        f"cwd, then the repo root)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline: every finding is new")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write the current findings as the new baseline "
+                        "and exit 0")
+    p.add_argument("--rules", metavar="ID[,ID...]",
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print rule ids and descriptions, then exit")
+    return p
+
+
+def _select_rules(spec: Optional[str]):
+    if not spec:
+        return ALL_RULES
+    ids = [s.strip() for s in spec.split(",") if s.strip()]
+    unknown = [i for i in ids if i not in RULES_BY_ID]
+    if unknown:
+        raise ValueError(
+            f"tpulint: unknown rule id(s): {', '.join(unknown)} "
+            f"(see --list-rules)")
+    return [RULES_BY_ID[i] for i in ids]
+
+
+def _emit_text(new: List[Finding], matched: int, stale: List[str],
+               total: int) -> None:
+    for f_ in new:
+        print(f_.render())
+    bits = [f"{total} finding(s)", f"{len(new)} new",
+            f"{matched} baselined"]
+    if stale:
+        bits.append(f"{len(stale)} stale baseline entr"
+                    f"{'y' if len(stale) == 1 else 'ies'} "
+                    f"(re-run --write-baseline to ratchet down)")
+    print("tpulint: " + ", ".join(bits))
+
+
+def _emit_json(new: List[Finding], matched: int, stale: List[str],
+               total: int, root: str) -> None:
+    print(json.dumps({
+        "tool": "tpulint",
+        "root": root,
+        "total": total,
+        "baselined": matched,
+        "stale_baseline": stale,
+        "new": [f_.to_dict() for f_ in new],
+    }, indent=2))
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = build_parser()
+    try:
+        args = parser.parse_args(argv)
+        rules = _select_rules(args.rules)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    except SystemExit as e:  # argparse already printed help/usage
+        return 0 if e.code in (0, None) else 2
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            print(f"{r.id:24s} [{r.severity}] {r.description}")
+        return 0
+
+    baseline_path = args.baseline or bl.default_baseline_path()
+    # paths in findings/baseline are relative to the baseline's directory
+    # so the report is stable no matter where the scan is launched from
+    root = os.path.dirname(os.path.abspath(baseline_path)) or os.getcwd()
+    paths = args.paths or _default_paths()
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"tpulint: no such path(s): {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+
+    findings = scan_paths(paths, rules=rules, root=root)
+
+    if args.write_baseline:
+        bl.write_baseline(baseline_path, findings)
+        print(f"tpulint: wrote {len(findings)} finding(s) to "
+              f"{baseline_path}")
+        return 0
+
+    baseline = {} if args.no_baseline else bl.load_baseline(baseline_path)
+    new, matched, stale = bl.split_new(findings, baseline)
+
+    if args.format == "json":
+        _emit_json(new, matched, stale, len(findings), root)
+    else:
+        _emit_text(new, matched, stale, len(findings))
+    return 1 if new else 0

@@ -1,0 +1,252 @@
+"""tpulint core: findings, module model, suppressions, and the scan engine.
+
+The analyzer is the static half of the performance-observability story:
+PR 1's runtime recompile watcher catches dispatch pathologies *while they
+happen*; tpulint catches the same classes of defect *at review time* by
+walking the AST — host syncs in fit hot paths, tracer leaks out of jitted
+functions, recompile hazards, f64 promotion, unlocked cross-thread state,
+and plain hygiene. Rules are pure functions over a `ModuleInfo` (parsed
+tree + import-alias resolution + parent links); the engine handles file
+discovery, inline suppressions, and severity plumbing. No third-party
+dependencies — stdlib `ast` only, so the lint lane runs anywhere the
+package imports.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import os
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARNING = "warning"
+
+#: rule id reserved for files the engine itself cannot parse
+PARSE_ERROR_RULE = "parse-error"
+
+_SUPPRESS_RE = re.compile(r"#\s*tpulint:\s*disable=([A-Za-z0-9_\-, ]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str
+    severity: str
+    path: str  # posix-style path relative to the scan root
+    line: int
+    message: str
+    snippet: str = ""
+
+    def fingerprint(self) -> str:
+        """Location-tolerant identity for baseline matching: rule + path +
+        whitespace-normalized source line. Line numbers are deliberately
+        excluded so unrelated edits above a grandfathered finding don't
+        invalidate the baseline."""
+        norm = re.sub(r"\s+", "", self.snippet)
+        raw = f"{self.rule}|{self.path}|{norm}".encode("utf-8")
+        return hashlib.sha1(raw).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["fingerprint"] = self.fingerprint()
+        return d
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}"
+        out = f"{loc}: [{self.rule}] {self.severity}: {self.message}"
+        if self.snippet:
+            out += f"\n    {self.snippet}"
+        return out
+
+
+def _parse_suppressions(source: str) -> Dict[int, Set[str]]:
+    """Map line number -> set of suppressed rule ids ('all' wildcards).
+
+    `# tpulint: disable=rule-a,rule-b` at the end of a code line suppresses
+    on that line; on a standalone comment line it suppresses the next
+    non-blank, non-comment line (so multi-rule suppressions can carry a
+    justification sentence alongside).
+    """
+    out: Dict[int, Set[str]] = {}
+    pending: Set[str] = set()
+    for lineno, line in enumerate(source.splitlines(), 1):
+        stripped = line.strip()
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            if stripped.startswith("#"):
+                pending |= rules
+                continue
+            out.setdefault(lineno, set()).update(rules)
+        if stripped and not stripped.startswith("#"):
+            if pending:
+                out.setdefault(lineno, set()).update(pending)
+                pending = set()
+    return out
+
+
+class ModuleInfo:
+    """A parsed module plus the cross-cutting facts every rule needs:
+    parent links, enclosing-scope queries, and import-alias resolution
+    (`jnp.asarray` -> `jax.numpy.asarray` regardless of local spelling)."""
+
+    def __init__(self, path: str, rel_path: str, source: str):
+        self.path = path
+        self.rel_path = rel_path.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)  # SyntaxError propagates to the engine
+        self.suppressions = _parse_suppressions(source)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        # local name -> canonical dotted prefix ("np" -> "numpy",
+        # "jnp" -> "jax.numpy", "jit" -> "jax.jit")
+        self.aliases: Dict[str, str] = {}
+        self._collect_imports()
+
+    # -- imports ------------------------------------------------------
+    def _collect_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = \
+                        a.name if a.asname else a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.aliases[a.asname or a.name] = \
+                        f"{node.module}.{a.name}"
+
+    def imports_module(self, root: str) -> bool:
+        """True if any import resolves under the dotted prefix `root`."""
+        for canon in self.aliases.values():
+            if canon == root or canon.startswith(root + "."):
+                return True
+        return False
+
+    # -- name resolution ----------------------------------------------
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted name for a Name/Attribute chain, resolving
+        import aliases at the root; None for non-static expressions."""
+        parts: List[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        root = self.aliases.get(cur.id, cur.id)
+        parts.append(root)
+        return ".".join(reversed(parts))
+
+    # -- tree queries -------------------------------------------------
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def enclosing_functions(self, node: ast.AST) -> List[ast.AST]:
+        """Enclosing FunctionDef/AsyncFunctionDef nodes, innermost first."""
+        return [a for a in self.ancestors(node)
+                if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    def inside_loop(self, node: ast.AST,
+                    within: Optional[ast.AST] = None) -> bool:
+        """True if a for/while/comprehension sits between `node` and
+        `within` (or the nearest enclosing function when omitted)."""
+        loops = (ast.For, ast.AsyncFor, ast.While, ast.ListComp,
+                 ast.SetComp, ast.DictComp, ast.GeneratorExp)
+        for a in self.ancestors(node):
+            if within is not None and a is within:
+                return False
+            if within is None and isinstance(
+                    a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return False
+            if isinstance(a, loops):
+                return True
+        return False
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()[:160]
+        return ""
+
+    def segment(self, node: ast.AST) -> str:
+        return ast.get_source_segment(self.source, node) or ""
+
+
+class Rule:
+    """Base class: subclasses set `id`/`severity`/`description` and yield
+    findings from `check(module)`."""
+
+    id: str = ""
+    severity: str = SEVERITY_WARNING
+    description: str = ""
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, mod: ModuleInfo, node, message: str) -> Finding:
+        line = node if isinstance(node, int) else getattr(node, "lineno", 0)
+        return Finding(self.id, self.severity, mod.rel_path, line,
+                       message, mod.line_text(line))
+
+
+# ---------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------
+def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__"
+                                     and not d.startswith("."))
+                for f in sorted(filenames):
+                    if f.endswith(".py"):
+                        yield os.path.join(dirpath, f)
+
+
+def scan_file(path: str, rules: Sequence[Rule],
+              root: Optional[str] = None) -> List[Finding]:
+    rel = os.path.relpath(path, root) if root else path
+    rel = rel.replace(os.sep, "/")
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    try:
+        mod = ModuleInfo(path, rel, source)
+    except SyntaxError as e:
+        return [Finding(PARSE_ERROR_RULE, SEVERITY_ERROR, rel,
+                        e.lineno or 0, f"cannot parse: {e.msg}")]
+    findings: List[Finding] = []
+    for rule in rules:
+        for f_ in rule.check(mod):
+            suppressed = mod.suppressions.get(f_.line, ())
+            if f_.rule in suppressed or "all" in suppressed:
+                continue
+            findings.append(f_)
+    findings.sort(key=lambda f_: (f_.path, f_.line, f_.rule))
+    return findings
+
+
+def scan_paths(paths: Sequence[str], rules: Optional[Sequence[Rule]] = None,
+               root: Optional[str] = None) -> List[Finding]:
+    """Scan files/directories with the given rules (default: all)."""
+    if rules is None:
+        from deeplearning4j_tpu.analysis.rules import ALL_RULES
+        rules = ALL_RULES
+    out: List[Finding] = []
+    for path in iter_python_files(paths):
+        out.extend(scan_file(path, rules, root=root))
+    return out

@@ -1,0 +1,33 @@
+"""tpulint rule registry.
+
+Rule families (ISSUE 2): host-sync, tracer-leak, recompile-hazard,
+dtype-promotion, concurrency, hygiene. Adding a rule = subclass
+`analysis.core.Rule`, instantiate it here.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from deeplearning4j_tpu.analysis.core import Rule
+from deeplearning4j_tpu.analysis.rules.host_sync import HostSyncRule
+from deeplearning4j_tpu.analysis.rules.tracer_leak import TracerLeakRule
+from deeplearning4j_tpu.analysis.rules.recompile import RecompileHazardRule
+from deeplearning4j_tpu.analysis.rules.dtype import DtypePromotionRule
+from deeplearning4j_tpu.analysis.rules.concurrency import ThreadSharedStateRule
+from deeplearning4j_tpu.analysis.rules.hygiene import (
+    BareExceptRule, MutableDefaultRule)
+
+ALL_RULES: List[Rule] = [
+    HostSyncRule(),
+    TracerLeakRule(),
+    RecompileHazardRule(),
+    DtypePromotionRule(),
+    ThreadSharedStateRule(),
+    BareExceptRule(),
+    MutableDefaultRule(),
+]
+
+RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in ALL_RULES}
+
+__all__ = ["ALL_RULES", "RULES_BY_ID"]

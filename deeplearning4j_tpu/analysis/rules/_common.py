@@ -1,0 +1,95 @@
+"""Shared AST helpers for rules that reason about jit-staged functions."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from deeplearning4j_tpu.analysis.core import ModuleInfo
+
+#: call targets that stage a Python function for tracing: assigning
+#: tracers to Python state inside any of these leaks, and value-dependent
+#: branches inside any of these concretize
+_TRACING_WRAPPERS = ("jit", "pmap", "shard_map")
+
+
+def _is_tracing_wrapper(mod: ModuleInfo, node: ast.AST) -> bool:
+    """True for expressions like `jax.jit`, `jit` (from-imported), or
+    `partial(jax.jit, ...)` used as decorator or wrapper callee."""
+    if isinstance(node, ast.Call):
+        # @partial(jax.jit, static_argnums=...) / functools.partial(...)
+        fn = mod.resolve(node.func)
+        if fn is not None and fn.rsplit(".", 1)[-1] == "partial" and node.args:
+            return _is_tracing_wrapper(mod, node.args[0])
+        node = node.func
+    name = mod.resolve(node)
+    if name is None:
+        return False
+    return name.rsplit(".", 1)[-1] in _TRACING_WRAPPERS
+
+
+def jit_call_static_names(mod: ModuleInfo,
+                         call: ast.Call) -> Tuple[Set[int], Set[str]]:
+    """Static argnums/argnames declared on a jit(...) call, when literal."""
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        val = kw.value
+        if kw.arg == "static_argnums":
+            if isinstance(val, ast.Constant) and isinstance(val.value, int):
+                nums.add(val.value)
+            elif isinstance(val, (ast.Tuple, ast.List)):
+                nums.update(e.value for e in val.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, int))
+        elif kw.arg == "static_argnames":
+            if isinstance(val, ast.Constant) and isinstance(val.value, str):
+                names.add(val.value)
+            elif isinstance(val, (ast.Tuple, ast.List)):
+                names.update(e.value for e in val.elts
+                             if isinstance(e, ast.Constant)
+                             and isinstance(e.value, str))
+    return nums, names
+
+
+def collect_jit_functions(
+        mod: ModuleInfo) -> Dict[ast.FunctionDef, Optional[ast.Call]]:
+    """FunctionDefs staged for tracing in this module: decorated with a
+    tracing wrapper, or named as the wrapped argument of a `jax.jit(f)` /
+    `partial(jax.jit, ...)(f)`-style call. Maps each def to the jit call
+    that wraps it (None when the decorator form carries no call)."""
+    defs_by_name: Dict[str, List[ast.FunctionDef]] = {}
+    out: Dict[ast.FunctionDef, Optional[ast.Call]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+            for dec in node.decorator_list:
+                if _is_tracing_wrapper(mod, dec):
+                    out[node] = dec if isinstance(dec, ast.Call) else None
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not _is_tracing_wrapper(mod, node.func):
+            continue
+        for arg in node.args[:1]:
+            if isinstance(arg, ast.Name):
+                for fd in defs_by_name.get(arg.id, ()):  # same-module defs
+                    out.setdefault(fd, node)
+    return out
+
+
+def traced_param_names(mod: ModuleInfo, fn: ast.FunctionDef,
+                       jit_call: Optional[ast.Call]) -> Set[str]:
+    """Parameter names of a jitted function that carry tracers (all
+    params minus `self` and declared static args)."""
+    args = fn.args
+    ordered = [a.arg for a in (args.posonlyargs + args.args)]
+    names = set(ordered + [a.arg for a in args.kwonlyargs])
+    names.discard("self")
+    if jit_call is not None:
+        nums, static_names = jit_call_static_names(mod, jit_call)
+        names -= static_names
+        for i in nums:
+            if 0 <= i < len(ordered):
+                names.discard(ordered[i])
+    return names

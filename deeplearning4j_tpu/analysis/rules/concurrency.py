@@ -1,0 +1,94 @@
+"""unlocked-thread-state: shared state mutated from a thread target
+without a visible lock.
+
+The serving/ETL surfaces (`parallel/`, async iterators, streaming) run
+background `threading.Thread`s. A target function that assigns `self.*`
+or module globals without holding a lock races its owner thread — the
+classic lost-update on counters, caches, and queues-by-hand. The rule
+looks for mutations inside thread-target functions that are not wrapped
+in a `with <something lock-like>:` block; `queue.Queue`/`Event`-mediated
+handoffs (the sanctioned pattern) don't trip it because they mutate no
+shared attribute.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Set
+
+from deeplearning4j_tpu.analysis.core import (
+    Finding, ModuleInfo, Rule, SEVERITY_WARNING)
+
+_LOCKISH = re.compile(r"lock|mutex|cond|sem", re.IGNORECASE)
+
+
+def _thread_targets(mod: ModuleInfo) -> Set[str]:
+    """Names of functions/methods handed to threading.Thread(target=...)."""
+    out: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if mod.resolve(node.func) != "threading.Thread":
+            continue
+        for kw in node.keywords:
+            if kw.arg != "target":
+                continue
+            if isinstance(kw.value, ast.Name):
+                out.add(kw.value.id)
+            elif isinstance(kw.value, ast.Attribute):
+                out.add(kw.value.attr)
+    return out
+
+
+def _under_lock(mod: ModuleInfo, node: ast.AST, fn: ast.AST) -> bool:
+    for a in mod.ancestors(node):
+        if a is fn:
+            return False
+        if isinstance(a, ast.With):
+            for item in a.items:
+                if _LOCKISH.search(mod.segment(item.context_expr)):
+                    return True
+    return False
+
+
+class ThreadSharedStateRule(Rule):
+    id = "unlocked-thread-state"
+    severity = SEVERITY_WARNING
+    description = ("thread-target function mutates self.*/global state "
+                   "without a visible lock")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        targets = _thread_targets(mod)
+        if not targets:
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name not in targets:
+                continue
+            globals_: Set[str] = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Global):
+                    globals_.update(sub.names)
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.AugAssign):
+                    tgts = [sub.target]
+                elif isinstance(sub, ast.Assign):
+                    tgts = sub.targets
+                else:
+                    continue
+                for t in tgts:
+                    leaked = None
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        leaked = f"self.{t.attr}"
+                    elif isinstance(t, ast.Name) and t.id in globals_:
+                        leaked = f"global '{t.id}'"
+                    if leaked and not _under_lock(mod, sub, node):
+                        yield self.finding(
+                            mod, sub,
+                            f"thread target '{node.name}' mutates {leaked} "
+                            f"without holding a lock; guard it or hand off "
+                            f"through a Queue/Event")

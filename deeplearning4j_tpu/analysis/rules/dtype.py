@@ -1,0 +1,70 @@
+"""dtype-promotion: float64 creeping into jax program modules.
+
+TPUs execute f64 in slow software emulation (or jax silently truncates
+to f32 with `jax_enable_x64` off, masking the intent). Either way a
+float64 literal or dtype in a module that builds jax computations is a
+hazard — except in the finite-difference gradient checker, whose whole
+point is f64 reference arithmetic, and the central x64 shim in
+util/jax_compat that gates it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from deeplearning4j_tpu.analysis.core import (
+    Finding, ModuleInfo, Rule, SEVERITY_WARNING)
+
+_EXEMPT_PATH_PARTS = ("gradient_check", "jax_compat")
+_F64_OWNERS = ("numpy", "jax.numpy", "jax")
+
+
+def _is_exempt(mod: ModuleInfo) -> bool:
+    return any(part in mod.rel_path for part in _EXEMPT_PATH_PARTS)
+
+
+class DtypePromotionRule(Rule):
+    id = "dtype-promotion"
+    severity = SEVERITY_WARNING
+    description = ("float64 dtype in a jax-importing module outside the "
+                   "gradient checker risks x64 emulation or silent "
+                   "truncation")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        if _is_exempt(mod) or not mod.imports_module("jax"):
+            return
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Attribute) and node.attr == "float64":
+                owner = mod.resolve(node.value)
+                if owner in _F64_OWNERS:
+                    yield self.finding(
+                        mod, node,
+                        f"{owner}.float64 in a jax module: f64 emulates "
+                        f"slowly on TPU (or truncates silently with x64 "
+                        f"off); keep device math in f32/bf16")
+            elif isinstance(node, ast.keyword) and node.arg == "dtype" \
+                    and isinstance(node.value, ast.Constant) \
+                    and node.value.value == "float64":
+                yield self.finding(
+                    mod, node.value,
+                    "dtype='float64' in a jax module: keep device math "
+                    "in f32/bf16")
+            elif isinstance(node, ast.Call):
+                fn = mod.resolve(node.func)
+                if fn and fn.endswith("config.update") and node.args \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and node.args[0].value == "jax_enable_x64":
+                    yield self.finding(
+                        mod, node,
+                        "jax_enable_x64 toggled outside util/jax_compat: "
+                        "route through the central shim so the flag can't "
+                        "leak into production paths")
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "astype" and node.args \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and node.args[0].value == "float64":
+                    yield self.finding(
+                        mod, node,
+                        ".astype('float64') in a jax module: keep device "
+                        "math in f32/bf16")

@@ -1,0 +1,112 @@
+"""host-sync-in-hot-loop: device->host synchronization inside fit/serve
+hot paths.
+
+JAX dispatch is asynchronous: the Python thread should race ahead
+enqueueing steps while the accelerator executes. Any host materialization
+of a device value — `.item()`, `float()`, `np.asarray`, `device_get`,
+`block_until_ready` — inside the per-batch path stalls that pipeline to
+one-batch-at-a-time lockstep, the exact failure mode the dispatch-
+pipelining literature (cuDNN-era stacks) warns about. Keep the steady
+state sync-free; materialize lazily, periodically, or after the final
+batch.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from deeplearning4j_tpu.analysis.core import (
+    Finding, ModuleInfo, Rule, SEVERITY_ERROR)
+
+#: function bodies that ARE the per-batch hot path: any sync in them runs
+#: once per training batch even though the loop lives in the caller
+_PER_BATCH_FN = re.compile(
+    r"^(_fit\w*|partial_fit|train_on_batch|_train_batch\w*|train_step|_step)$")
+
+#: functions where only code lexically inside a loop is hot
+_LOOP_FN = re.compile(r"^(fit|train|predict|_serve_loop)$")
+
+_SYNC_CALLS = {
+    "jax.device_get": "copies device values to host",
+    "jax.block_until_ready": "blocks dispatch until the device drains",
+    "numpy.asarray": "forces a device->host transfer",
+    "numpy.array": "forces a device->host transfer",
+}
+
+_SYNC_METHODS = {
+    "item": "materializes a device scalar on host",
+    "tolist": "materializes a device array on host",
+    "block_until_ready": "blocks dispatch until the device drains",
+}
+
+
+_HOST_CONTAINERS = (ast.List, ast.ListComp, ast.Tuple, ast.Set,
+                    ast.SetComp, ast.GeneratorExp, ast.Dict, ast.DictComp)
+
+
+def _scalar_cast_is_benign(arg: ast.AST) -> bool:
+    """float()/int() of literals, len()/range() results, or shape metadata
+    is host arithmetic, not a device sync."""
+    if isinstance(arg, ast.Constant):
+        return True
+    for sub in ast.walk(arg):
+        if isinstance(sub, ast.Attribute) and sub.attr in ("shape", "ndim",
+                                                           "size", "dtype"):
+            return True
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name) \
+                and sub.func.id in ("len", "range", "perf_counter"):
+            return True
+    return False
+
+
+class HostSyncRule(Rule):
+    id = "host-sync-in-hot-loop"
+    severity = SEVERITY_ERROR
+    description = ("device->host sync (.item()/float()/np.asarray/"
+                   "device_get/block_until_ready) inside a fit/serve hot "
+                   "path serializes async dispatch")
+
+    def _classify(self, mod: ModuleInfo, node: ast.Call):
+        resolved = mod.resolve(node.func)
+        if resolved in _SYNC_CALLS:
+            # np.asarray of a literal host container builds a host array
+            # from host data — no device value can be involved
+            if resolved.startswith("numpy.") and node.args \
+                    and isinstance(node.args[0], _HOST_CONTAINERS):
+                return None, None
+            return f"{resolved}()", _SYNC_CALLS[resolved]
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _SYNC_METHODS and not node.args:
+            return f".{node.func.attr}()", _SYNC_METHODS[node.func.attr]
+        if resolved in ("float", "int") and len(node.args) == 1 \
+                and not node.keywords \
+                and not _scalar_cast_is_benign(node.args[0]):
+            return f"{resolved}()", "materializes a device scalar on host"
+        return None, None
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        if not mod.imports_module("jax"):
+            return  # pure-host module: np.asarray/float() cannot sync
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            what, why = self._classify(mod, node)
+            if what is None:
+                continue
+            for fn in mod.enclosing_functions(node):
+                if _PER_BATCH_FN.match(fn.name):
+                    hot, where = True, f"per-batch path '{fn.name}'"
+                elif _LOOP_FN.match(fn.name) and mod.inside_loop(node,
+                                                                 within=fn):
+                    hot, where = True, f"loop in '{fn.name}'"
+                else:
+                    continue
+                if hot:
+                    yield self.finding(
+                        mod, node,
+                        f"{what} in {where} {why}; keep the steady state "
+                        f"sync-free (defer to access / every N batches / "
+                        f"after the final batch)")
+                    break

@@ -1,0 +1,57 @@
+"""Hygiene rules: bare `except:` and mutable default arguments.
+
+Small, classic, and disproportionately painful in an accelerator
+codebase: a bare except swallows `KeyboardInterrupt` in a fit loop that
+takes hours, and a mutable default on a layer/config constructor aliases
+state across every model built in the process.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from deeplearning4j_tpu.analysis.core import (
+    Finding, ModuleInfo, Rule, SEVERITY_WARNING)
+
+
+class BareExceptRule(Rule):
+    id = "bare-except"
+    severity = SEVERITY_WARNING
+    description = ("bare `except:` swallows KeyboardInterrupt/SystemExit; "
+                   "catch Exception or narrower")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                yield self.finding(
+                    mod, node,
+                    "bare `except:` also catches KeyboardInterrupt and "
+                    "SystemExit; use `except Exception:` or narrower")
+
+
+class MutableDefaultRule(Rule):
+    id = "mutable-default-arg"
+    severity = SEVERITY_WARNING
+    description = "mutable default argument is shared across all calls"
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            defaults = list(node.args.defaults) + \
+                [d for d in node.args.kw_defaults if d is not None]
+            for d in defaults:
+                bad = None
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    bad = {ast.List: "list", ast.Dict: "dict",
+                           ast.Set: "set"}[type(d)]
+                elif isinstance(d, ast.Call) and isinstance(d.func, ast.Name) \
+                        and d.func.id in ("list", "dict", "set", "bytearray"):
+                    bad = d.func.id
+                if bad:
+                    yield self.finding(
+                        mod, d,
+                        f"mutable default ({bad}) on '{node.name}' is "
+                        f"evaluated once and shared across calls; default "
+                        f"to None and create inside")

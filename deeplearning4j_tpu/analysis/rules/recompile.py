@@ -1,0 +1,137 @@
+"""recompile-hazard: patterns that defeat jit's compilation cache.
+
+Three statically visible shapes of the same disease (the runtime half —
+counting actual recompiles — is the PR 1 jit watcher):
+
+- `jax.jit(...)` lexically inside a loop builds a fresh wrapper (and a
+  fresh cache) per iteration, so nothing is ever a cache hit;
+- unhashable `static_argnums`/`static_argnames` specs (list literals)
+  and non-literal specs that may vary call-to-call;
+- value-dependent Python control flow (`if x > 0:`, f-strings on traced
+  params) inside a staged function either concretizes the tracer or
+  recompiles per value when the arg is marked static.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from deeplearning4j_tpu.analysis.core import (
+    Finding, ModuleInfo, Rule, SEVERITY_WARNING)
+from deeplearning4j_tpu.analysis.rules._common import (
+    _is_tracing_wrapper, collect_jit_functions, traced_param_names)
+
+_BENIGN_TEST_CALLS = ("len", "isinstance", "getattr", "hasattr",
+                      "callable", "issubclass")
+
+
+class _TracedNameFinder(ast.NodeVisitor):
+    """Collect bare traced-param Names in an expression, skipping
+    attribute access (x.shape / x.ndim are static metadata) and calls
+    that are concrete at trace time."""
+
+    def __init__(self, params: Set[str]):
+        self.params = params
+        self.hits: Set[str] = set()
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        pass  # metadata access: static under tracing
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in _BENIGN_TEST_CALLS:
+            return
+        for arg in node.args:
+            self.visit(arg)
+        for kw in node.keywords:
+            self.visit(kw.value)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if node.id in self.params:
+            self.hits.add(node.id)
+
+
+def _test_is_identity_check(test: ast.AST) -> bool:
+    return isinstance(test, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops)
+
+
+class RecompileHazardRule(Rule):
+    id = "recompile-hazard"
+    severity = SEVERITY_WARNING
+    description = ("jit-in-loop, unstable static_argnums, or value-"
+                   "dependent Python control flow on traced args defeats "
+                   "the jit cache")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        yield from self._jit_in_loop(mod)
+        yield from self._static_specs(mod)
+        yield from self._traced_branches(mod)
+
+    # -- jit built inside a loop --------------------------------------
+    def _jit_in_loop(self, mod: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            # classify the whole Call so `jit(f)(x)` counts the
+            # construction once, not also the immediate invocation
+            if isinstance(node, ast.Call) \
+                    and _is_tracing_wrapper(mod, node) \
+                    and mod.inside_loop(node):
+                yield self.finding(
+                    mod, node,
+                    "jit wrapper constructed inside a loop: each iteration "
+                    "gets a fresh compilation cache, so every call "
+                    "retraces; hoist the jit out of the loop")
+
+    # -- static_argnums hygiene ---------------------------------------
+    def _static_specs(self, mod: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and _is_tracing_wrapper(mod, node)):
+                continue
+            for kw in node.keywords:
+                if kw.arg not in ("static_argnums", "static_argnames"):
+                    continue
+                val = kw.value
+                if isinstance(val, (ast.List, ast.ListComp, ast.Set,
+                                    ast.SetComp, ast.Dict, ast.DictComp)):
+                    yield self.finding(
+                        mod, node,
+                        f"{kw.arg} given as an unhashable container "
+                        f"literal; use a tuple of ints/strs so the spec "
+                        f"itself is cacheable")
+                elif not isinstance(val, (ast.Constant, ast.Tuple)):
+                    yield self.finding(
+                        mod, node,
+                        f"{kw.arg} computed at call time ({type(val).__name__}); "
+                        f"a spec that varies call-to-call recompiles per "
+                        f"value — prefer a literal tuple")
+
+    # -- value-dependent control flow in staged functions -------------
+    def _traced_branches(self, mod: ModuleInfo) -> Iterator[Finding]:
+        for fn, jit_call in collect_jit_functions(mod).items():
+            params = traced_param_names(mod, fn, jit_call)
+            if not params:
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.If, ast.While)):
+                    if _test_is_identity_check(node.test):
+                        continue  # `x is None` is concrete at trace time
+                    finder = _TracedNameFinder(params)
+                    finder.visit(node.test)
+                    for name in sorted(finder.hits):
+                        yield self.finding(
+                            mod, node,
+                            f"branch on traced arg '{name}' in staged "
+                            f"'{fn.name}': concretization error, or one "
+                            f"recompile per value if marked static; use "
+                            f"lax.cond/jnp.where")
+                elif isinstance(node, ast.FormattedValue):
+                    finder = _TracedNameFinder(params)
+                    finder.visit(node.value)
+                    for name in sorted(finder.hits):
+                        yield self.finding(
+                            mod, node,
+                            f"f-string on traced arg '{name}' in staged "
+                            f"'{fn.name}' captures the tracer repr at "
+                            f"trace time, not the runtime value")

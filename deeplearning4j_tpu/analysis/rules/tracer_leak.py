@@ -1,0 +1,62 @@
+"""tracer-leak: traced values escaping a jit-staged function into Python
+state.
+
+Assigning to `self.*` or a `global` inside a function that jax traces
+stores a Tracer object, not an array: the side effect happens once at
+trace time, silently goes stale across calls, and raises
+`UnexpectedTracerError` the moment the leaked value is used in a later
+trace. Thread state through the function's return value instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from deeplearning4j_tpu.analysis.core import (
+    Finding, ModuleInfo, Rule, SEVERITY_ERROR)
+from deeplearning4j_tpu.analysis.rules._common import collect_jit_functions
+
+
+def _global_names(fn: ast.FunctionDef) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Global):
+            out.update(node.names)
+    return out
+
+
+class TracerLeakRule(Rule):
+    id = "tracer-leak"
+    severity = SEVERITY_ERROR
+    description = ("assignment to self.*/global inside a jit/pmap/"
+                   "shard_map-staged function stores a Tracer, not an "
+                   "array")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        for fn in collect_jit_functions(mod):
+            globals_ = _global_names(fn)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.AugAssign):
+                    targets = [node.target]
+                elif isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    targets = [node.target]
+                else:
+                    continue
+                for t in targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        yield self.finding(
+                            mod, node,
+                            f"assignment to self.{t.attr} inside traced "
+                            f"'{fn.name}' leaks a Tracer into Python state; "
+                            f"return the value instead")
+                    elif isinstance(t, ast.Name) and t.id in globals_:
+                        yield self.finding(
+                            mod, node,
+                            f"assignment to global '{t.id}' inside traced "
+                            f"'{fn.name}' leaks a Tracer into Python state; "
+                            f"return the value instead")

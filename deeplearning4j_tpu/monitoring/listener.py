@@ -50,17 +50,58 @@ def record_fit_iteration(model, n_examples: int, score: float,
                     ("model",)).set(n_examples / seconds, model=name)
 
 
+#: cadence (in iterations) of score-gauge publication by the auto-hook.
+#: Reading `model.score_value` forces a device->host sync, so doing it
+#: every batch would serialize dispatch (tpulint: host-sync-in-hot-loop);
+#: counters/throughput stay per-batch (host floats, free), the score
+#: lands every Nth iteration and once more at the end of fit.
+_SCORE_PUBLISH_EVERY = 25
+
+
+def set_score_publish_interval(n: int) -> int:
+    """Set the auto-hook's score cadence; returns the previous value."""
+    global _SCORE_PUBLISH_EVERY
+    prev, _SCORE_PUBLISH_EVERY = _SCORE_PUBLISH_EVERY, max(1, int(n))
+    return prev
+
+
 def maybe_record_fit_iteration(model, n_examples: int,
                                seconds: Optional[float],
                                n_batches: int = 1) -> None:
     """Default fit-loop hook: records into the global registry unless the
-    model carries an explicit MetricsListener (which then owns publishing)."""
+    model carries an explicit MetricsListener (which then owns publishing).
+    The score is read (= synced) only on the publish cadence; other
+    gauges cost nothing."""
     if any(isinstance(l, MetricsListener)
            for l in getattr(model, "listeners", ())):
         return
-    record_fit_iteration(model, n_examples,
-                         getattr(model, "score_value", float("nan")),
-                         seconds, n_batches=n_batches)
+    it = getattr(model, "iteration_count", 0)
+    score = None
+    if it == 1 or it % _SCORE_PUBLISH_EVERY == 0:
+        score = getattr(model, "score_value", None)
+    record_fit_iteration(model, n_examples, score, seconds,
+                         n_batches=n_batches)
+
+
+def finalize_fit_telemetry(model) -> None:
+    """End-of-fit barrier: ONE deliberate host sync after the last batch.
+
+    Blocks on the final params (so deferred dispatch errors surface
+    inside fit, not at some later read) and publishes the terminal score
+    gauge that the lazy per-batch path skipped. This is the 'final batch'
+    sync the fit loops are allowed to keep."""
+    import jax
+
+    params = getattr(model, "params", None)
+    if params is not None:
+        jax.block_until_ready(params)
+    if any(isinstance(l, MetricsListener)
+           for l in getattr(model, "listeners", ())):
+        return  # explicit listener owns publishing
+    # terminal score gauge via the shared publish path (0 batches/examples:
+    # only the nan-guarded score gauge actually lands)
+    record_fit_iteration(model, 0, getattr(model, "score_value", None),
+                         None, n_batches=0)
 
 
 class MetricsListener(TrainingListener):

@@ -168,7 +168,10 @@ class Glove(SequenceVectors):
                             jnp.asarray(logX[sel]), jnp.asarray(fX[sel]),
                             jnp.asarray(valid),
                             jnp.float32(self.learning_rate))
-                total += float(loss)
-            self.loss_history.append(total / max(1, n))
+                # device-side accumulation: no per-batch host sync
+                total = total + loss
+            # one sync per EPOCH (bounded, feeds loss_history's floats)
+            # tpulint: disable=host-sync-in-hot-loop
+            self.loss_history.append(float(total) / max(1, n))
         self.epochs_trained = e1
         return self

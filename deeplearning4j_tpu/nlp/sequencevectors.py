@@ -584,6 +584,8 @@ class SequenceVectors:
         toks = np.asarray([t for s in seqs for t in s], dtype=np.str_)
         index_of = self.vocab.index_of
         names = [vw.word for vw in self.vocab.vocab_words()]
+        # host python list of vocab words, not a device value
+        # tpulint: disable=host-sync-in-hot-loop
         name_arr = np.asarray(names, dtype=np.str_)
         vidx = np.asarray([index_of(w) for w in names], np.int32)
         order = np.argsort(name_arr)
@@ -596,7 +598,10 @@ class SequenceVectors:
         else:           # empty vocab: every token is OOV (silent no-op fit)
             corpus = np.full(len(toks), -1, np.int32)
         keep = self._keep_probs()
-        # per-sequence alpha: the numpy path's words_seen schedule
+        # per-sequence alpha: the numpy path's words_seen schedule.
+        # `lens`/`self._rng` here are HOST numpy state (native word2vec
+        # path, no device values) — the int() casts below cannot sync.
+        # tpulint: disable=host-sync-in-hot-loop
         total_words = int(lens.sum()) * max(1, self.epochs)
         sg = self.algo == "skipgram"
         # bound host memory: generate per SHARD of sequences (~1M corpus
@@ -606,7 +611,7 @@ class SequenceVectors:
         shards = [0]
         acc = 0
         for si in range(len(seqs)):
-            acc += int(lens[si])
+            acc += int(lens[si])  # tpulint: disable=host-sync-in-hot-loop
             if acc >= shard_words:
                 shards.append(si + 1)
                 acc = 0
@@ -615,6 +620,8 @@ class SequenceVectors:
         if e1 is None:
             e1 = self.epochs
         for epoch in range(e0, e1):
+            # host numpy schedule arithmetic, not a device sync
+            # tpulint: disable=host-sync-in-hot-loop
             seen = int(lens.sum()) * epoch + np.cumsum(lens)
             seq_alpha = np.maximum(
                 self.min_learning_rate,
@@ -622,6 +629,8 @@ class SequenceVectors:
                 * (1.0 - np.minimum(1.0, seen / max(1, total_words)))
             ).astype(np.float32)
             for _ in range(self.iterations):
+                # host np.random draw, not a device sync
+                # tpulint: disable=host-sync-in-hot-loop
                 seed = int(self._rng.integers(2 ** 63))
                 for s0, s1 in zip(shards[:-1], shards[1:]):
                     sub_off = offsets[s0:s1 + 1] - offsets[s0]

@@ -26,9 +26,11 @@ from deeplearning4j_tpu.nn.conf.layers import (
     STREAM_STATE_KEYS, BaseOutputLayerConf, CenterLossOutputLayer,
     stream_capacity)
 from deeplearning4j_tpu.nn.conf.network import ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.score import LazyScore
 from deeplearning4j_tpu.nn.updater import normalize_gradients
 from deeplearning4j_tpu.monitoring import ensure_started
-from deeplearning4j_tpu.monitoring.listener import maybe_record_fit_iteration
+from deeplearning4j_tpu.monitoring.listener import (
+    finalize_fit_telemetry, maybe_record_fit_iteration)
 from deeplearning4j_tpu.monitoring.tracing import phase_detail, span
 from deeplearning4j_tpu.optimize.listeners import close_listeners
 
@@ -42,7 +44,7 @@ def _tree_sub(params, steps):
     return jax.tree_util.tree_map(lambda p, s: p - s, params, steps)
 
 
-class ComputationGraph:
+class ComputationGraph(LazyScore):
     """DAG network with fit/output/evaluate (ref: ComputationGraph.java)."""
 
     def __init__(self, conf: ComputationGraphConfiguration):
@@ -763,6 +765,8 @@ class ComputationGraph:
                 self.epoch_count += 1
                 for lst in self.listeners:
                     lst.on_epoch_end(self, epoch_idx)
+            # one allowed sync, after the final batch (see multilayer.fit)
+            finalize_fit_telemetry(self)
         finally:
             close_listeners(self.listeners)
         return self
@@ -784,16 +788,16 @@ class ComputationGraph:
             lmasks = self._as_mask_dict(ds.labels_mask,
                                         default_key=self.conf.network_outputs[0])
         if phase_detail() and not getattr(self, "_quantized", False):
+            # dispatch-time spans, no device barrier: see multilayer.py
             fwd, bwd, upd = self._get_phase_steps(False)
             with span("forward"):
                 loss, new_state, vjp_fn = fwd(self.params, self.state, inputs,
                                               labels, rng, fmasks, lmasks)
-                self.score_value = float(loss)
             with span("backward"):
-                grads = jax.block_until_ready(bwd(vjp_fn, loss))
+                grads = bwd(vjp_fn, loss)
             with span("update"):
-                self.params, self.updater_state = jax.block_until_ready(
-                    upd(self.params, grads, self.updater_state))
+                self.params, self.updater_state = upd(
+                    self.params, grads, self.updater_state)
             self.state = new_state
         else:
             step = self._get_train_step(False)
@@ -801,12 +805,16 @@ class ComputationGraph:
                 self.params, self.state, self.updater_state, loss = step(
                     self.params, self.state, self.updater_state, inputs,
                     labels, rng, fmasks, lmasks)
-                self.score_value = float(loss)
+        # raw device scalar: float() (the host sync) deferred to access
+        self.score_value = loss
         with span("listener"):
             for lst in self.listeners:
                 if hasattr(lst, "record_batch"):
                     lst.record_batch(ds.num_examples())
-                lst.iteration_done(self, self.iteration_count, self.score_value)
+                # raw score, NOT the float property: listeners that use the
+                # score sync at their own cadence, the rest never sync
+                lst.iteration_done(self, self.iteration_count,
+                                   self._score_raw)
         self.iteration_count += 1
         maybe_record_fit_iteration(self, ds.num_examples(),
                                    time.perf_counter() - t0)

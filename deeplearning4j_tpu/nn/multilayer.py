@@ -36,9 +36,11 @@ from deeplearning4j_tpu.nn.conf.layers import (
     FrozenLayer,
 )
 from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.score import LazyScore
 from deeplearning4j_tpu.nn.updater import normalize_gradients
 from deeplearning4j_tpu.monitoring import ensure_started
-from deeplearning4j_tpu.monitoring.listener import maybe_record_fit_iteration
+from deeplearning4j_tpu.monitoring.listener import (
+    finalize_fit_telemetry, maybe_record_fit_iteration)
 from deeplearning4j_tpu.monitoring.tracing import phase_detail, span
 from deeplearning4j_tpu.optimize.listeners import close_listeners
 
@@ -49,7 +51,7 @@ def _tree_sub(params, steps):
     return jax.tree_util.tree_map(lambda p, s: p - s, params, steps)
 
 
-class MultiLayerNetwork:
+class MultiLayerNetwork(LazyScore):
     """Sequential network with fit/output/evaluate (ref: MultiLayerNetwork.java)."""
 
     def __init__(self, conf: MultiLayerConfiguration):
@@ -400,6 +402,9 @@ class MultiLayerNetwork:
                 self.epoch_count += 1
                 for lst in self.listeners:
                     lst.on_epoch_end(self, epoch_idx)
+            # the steady-state loop above never blocks on the device; the
+            # one allowed sync is here, after the final batch
+            finalize_fit_telemetry(self)
         finally:
             close_listeners(self.listeners)
         return self
@@ -416,16 +421,18 @@ class MultiLayerNetwork:
             x = jnp.asarray(ds.features)
             y = jnp.asarray(ds.labels)
         if phase_detail() and not getattr(self, "_quantized", False):
+            # spans time DISPATCH per phase (async: the device may still
+            # be executing) — no block_until_ready here, the fit loop's
+            # steady state must never stall the pipeline
             fwd, bwd, upd = self._get_phase_steps(carry_rnn)
             with span("forward"):
                 loss, new_state, vjp_fn = fwd(self.params, self.state, x, y,
                                               rng, fmask, lmask)
-                self.score_value = float(loss)
             with span("backward"):
-                grads = jax.block_until_ready(bwd(vjp_fn, loss))
+                grads = bwd(vjp_fn, loss)
             with span("update"):
-                self.params, self.updater_state = jax.block_until_ready(
-                    upd(self.params, grads, self.updater_state))
+                self.params, self.updater_state = upd(
+                    self.params, grads, self.updater_state)
             self.state = new_state
         else:
             step = self._get_train_step(carry_rnn)
@@ -433,12 +440,16 @@ class MultiLayerNetwork:
                 self.params, self.state, self.updater_state, loss = step(
                     self.params, self.state, self.updater_state,
                     x, y, rng, fmask, lmask)
-                self.score_value = float(loss)
+        # raw device scalar: float() (the host sync) deferred to access
+        self.score_value = loss
         with span("listener"):
             for lst in self.listeners:
                 if hasattr(lst, "record_batch"):
                     lst.record_batch(ds.num_examples())
-                lst.iteration_done(self, self.iteration_count, self.score_value)
+                # raw score, NOT the float property: listeners that use the
+                # score sync at their own cadence, the rest never sync
+                lst.iteration_done(self, self.iteration_count,
+                                   self._score_raw)
         self.iteration_count += 1
         maybe_record_fit_iteration(self, ds.num_examples(),
                                    time.perf_counter() - t0)

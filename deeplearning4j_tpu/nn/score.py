@@ -1,0 +1,36 @@
+"""Lazy score materialization for the fit hot paths.
+
+The per-batch `self.score_value = float(loss)` the fit loops used to do
+is a device->host sync on every batch: it stalls jax's async dispatch
+pipeline to one-batch-at-a-time lockstep (tpulint rule
+host-sync-in-hot-loop). Instead the loops now assign the RAW device
+scalar; `float()` — the sync — happens only when somebody actually reads
+`.score_value` (a listener, early stopping, a test) and the result is
+cached so repeated reads cost one sync total. Training with no score
+consumers never blocks on the loss at all.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class LazyScore:
+    """Mixin providing a `score_value` float property backed by a raw
+    (possibly device-resident) `_score_raw` slot."""
+
+    _score_raw: Any = float("nan")
+
+    @property
+    def score_value(self) -> float:
+        raw = self._score_raw
+        if not isinstance(raw, float):
+            raw = float(raw)  # the one deliberate host sync, then cached
+            self._score_raw = raw
+        return raw
+
+    @score_value.setter
+    def score_value(self, value: Any) -> None:
+        """Accepts a float or a raw device scalar; conversion is deferred
+        to the next read."""
+        self._score_raw = value

@@ -16,7 +16,12 @@ log = logging.getLogger(__name__)
 
 
 class TrainingListener:
-    """Observer of the training loop (ref: optimize/api/TrainingListener.java)."""
+    """Observer of the training loop (ref: optimize/api/TrainingListener.java).
+
+    `score` may arrive as a RAW device scalar, not a Python float: the fit
+    loops never sync on the loss (see nn/score.py). `float(score)` works
+    either way — call it only at your reporting cadence, because on a
+    device value it is a host sync."""
 
     def iteration_done(self, model, iteration: int, score: float):
         pass
@@ -62,7 +67,7 @@ class ScoreIterationListener(TrainingListener):
 
     def iteration_done(self, model, iteration, score):
         if iteration % self.print_iterations == 0:
-            self.printer(f"Score at iteration {iteration} is {score}")
+            self.printer(f"Score at iteration {iteration} is {float(score)}")
 
 
 class PerformanceListener(TrainingListener):

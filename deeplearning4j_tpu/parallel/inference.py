@@ -54,7 +54,13 @@ class ParallelInference:
         self.max_batch_size = max_batch_size
         self.batch_timeout = batch_timeout_ms / 1000.0
         self.inference_mode = inference_mode
-        self._shutdown = False
+        # stop signal is an Event (atomic, visible cross-thread), not a
+        # bare bool mutated from the caller thread
+        self._stop = threading.Event()
+        # ONE lock serializes every model touch: the wrapped model is not
+        # thread-safe (output() mutates _jit_cache and _rng), and callers
+        # may race the batching worker via output_direct()/sequential mode
+        self._seq_lock = threading.Lock()
         if inference_mode == "batched":
             self._queue: "queue.Queue[_Request]" = \
                 queue.Queue(maxsize=queue_limit)
@@ -67,7 +73,6 @@ class ParallelInference:
             # single-stream latency is one dispatch, not dispatch+timeout
             self._queue = None
             self._worker = None
-            self._seq_lock = threading.Lock()
 
     # ------------------------------------------------------------------
     def _run_batch(self, x: np.ndarray):
@@ -77,11 +82,14 @@ class ParallelInference:
             pad = self.n_devices - rem
             x = np.concatenate([x, np.repeat(x[-1:], pad, axis=0)], axis=0)
         sh = NamedSharding(self.mesh, P("data", *([None] * (x.ndim - 1))))
-        out = self.model.output(jax.device_put(x, sh))
+        with self._seq_lock:
+            out = self.model.output(jax.device_put(x, sh))
+        # host materialization is the serving response contract here, not
+        # a pipeline stall: the caller blocks on this result by design
         return np.asarray(out)[:n]
 
     def _serve_loop(self):
-        while not self._shutdown:
+        while not self._stop.is_set():
             try:
                 first = self._queue.get(timeout=0.1)
             except queue.Empty:
@@ -118,11 +126,18 @@ class ParallelInference:
         (ref: ParallelInference.output :97-121)."""
         x = np.asarray(x)
         if self.inference_mode == "sequential":
-            with self._seq_lock:
-                return self._run_batch(x)
+            return self._run_batch(x)  # _run_batch holds the model lock
+        if self._stop.is_set():
+            raise RuntimeError("ParallelInference shut down")
         req = _Request(x)
         self._queue.put(req)
-        req.event.wait()
+        # stop-aware wait: a request enqueued after shutdown()'s drain pass
+        # has no worker left to answer it, so don't block on the event
+        # unconditionally — the poll only ever loops on a dead server
+        while not req.event.wait(0.2):
+            if self._stop.is_set() and not (
+                    self._worker is not None and self._worker.is_alive()):
+                raise RuntimeError("ParallelInference shut down")
         if isinstance(req.result, Exception):
             raise req.result
         return req.result
@@ -132,4 +147,18 @@ class ParallelInference:
         return self._run_batch(np.asarray(x))
 
     def shutdown(self):
-        self._shutdown = True
+        """Stop the batching worker and wait for it to drain (bounded by
+        one poll interval + the in-flight batch). Requests still queued
+        when the worker exits are failed over to their waiters — nobody
+        blocks forever on a dead server."""
+        self._stop.set()
+        if self._worker is not None and self._worker.is_alive():
+            self._worker.join(timeout=5.0)
+        if self._queue is not None:
+            while True:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                req.result = RuntimeError("ParallelInference shut down")
+                req.event.set()

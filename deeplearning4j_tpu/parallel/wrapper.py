@@ -39,7 +39,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from deeplearning4j_tpu.util.jax_compat import shard_map
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator, AsyncDataSetIterator
-from deeplearning4j_tpu.monitoring.listener import maybe_record_fit_iteration
+from deeplearning4j_tpu.monitoring.listener import (
+    finalize_fit_telemetry, maybe_record_fit_iteration)
 from deeplearning4j_tpu.nn.updater import normalize_gradients
 from deeplearning4j_tpu.optimize.listeners import close_listeners
 from deeplearning4j_tpu.parallel.mesh import default_mesh
@@ -172,12 +173,13 @@ class ParallelWrapper:
                 m.params, m.state, m.updater_state, loss = step(
                     m.params, m.state, m.updater_state, inputs, labels, rng,
                     fmasks, lmasks)
-            m.score_value = float(loss)
+            m.score_value = loss  # raw device scalar, float() on access
         with self._timer("listener"):
             for lst in m.listeners:
                 if hasattr(lst, "record_batch"):
                     lst.record_batch(self._effective_examples(ds))
-                lst.iteration_done(m, m.iteration_count, m.score_value)
+                # raw score: see multilayer's listener loop
+                lst.iteration_done(m, m.iteration_count, m._score_raw)
         m.iteration_count += 1
         maybe_record_fit_iteration(m, self._effective_examples(ds),
                                    time.perf_counter() - t0)
@@ -255,15 +257,18 @@ class ParallelWrapper:
         xs = xs.reshape((freq, self.n_devices * xs.shape[2]) + xs.shape[3:])
         ys = ys.reshape((freq, self.n_devices * ys.shape[2]) + ys.shape[3:])
         # one rng per (scan step, shard): [freq, n_dev, 2], shard axis = 1
-        rngs = np.asarray(jax.random.split(m._next_rng(), freq * self.n_devices))
-        rngs = rngs.reshape(freq, self.n_devices, -1)
+        # (reshaped on device — round-tripping the keys through numpy was
+        # a host sync in the per-round hot path)
+        rngs = jax.random.split(
+            m._next_rng(), freq * self.n_devices
+        ).reshape(freq, self.n_devices, -1)
         step = self._get_averaging_step()
         with self._timer("step"):
             m.state = _strip_rnn_state(m.state)
             m.params, m.state, m.updater_state, loss = step(
                 m.params, m.state, m.updater_state, jnp.asarray(xs),
                 jnp.asarray(ys), jnp.asarray(rngs))
-            m.score_value = float(loss)
+            m.score_value = loss  # raw device scalar, float() on access
         round_examples = sum(b.num_examples() for b in batches)
         with self._timer("listener"):
             for lst in m.listeners:
@@ -272,7 +277,8 @@ class ParallelWrapper:
                     # PerformanceListener) must see the true throughput,
                     # not zero samples per round
                     lst.record_batch(round_examples)
-                lst.iteration_done(m, m.iteration_count, m.score_value)
+                # raw score: see multilayer's listener loop
+                lst.iteration_done(m, m.iteration_count, m._score_raw)
         m.iteration_count += freq
         maybe_record_fit_iteration(m, round_examples,
                                    time.perf_counter() - t0, n_batches=freq)
@@ -316,6 +322,8 @@ class ParallelWrapper:
                 for ds in pend:
                     self._fit_batch_allreduce(ds)
                 m.epoch_count += 1
+            # one allowed sync, after the final batch (see multilayer.fit)
+            finalize_fit_telemetry(m)
         finally:
             close_listeners(m.listeners)
         return m

@@ -7,6 +7,21 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
+# tier-1 lint lane: tpulint static analysis (analysis/). Pure-AST, runs
+# in ~1s with no devices; any finding beyond the committed
+# TPULINT_BASELINE.json (new host sync in a fit loop, tracer leak,
+# recompile hazard, f64 promotion, unlocked thread state, hygiene) exits
+# nonzero and fails the run before a single test executes.
+tpulint_out="$(mktemp -t tpulint.XXXXXX.json)"
+if ! python -m deeplearning4j_tpu.analysis deeplearning4j_tpu \
+        --format=json --baseline=TPULINT_BASELINE.json \
+        > "$tpulint_out"; then
+  echo "tpulint: NEW findings (see $tpulint_out):" >&2
+  python -m deeplearning4j_tpu.analysis deeplearning4j_tpu \
+      --baseline=TPULINT_BASELINE.json >&2 || true
+  exit 1
+fi
+
 # tier-1 observability lane: the telemetry subsystem (monitoring/) gates
 # everything else — run it first, fast and standalone, so a broken
 # /metrics or a fit path that started retracing fails the run in seconds

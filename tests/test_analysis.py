@@ -1,0 +1,477 @@
+"""tpulint (deeplearning4j_tpu/analysis): per-rule positive/negative
+fixtures, inline suppressions, baseline round-trip, CLI contract, and the
+self-scan gate that keeps the repo clean beyond the committed baseline."""
+
+import json
+import os
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from deeplearning4j_tpu.analysis import baseline as bl
+from deeplearning4j_tpu.analysis.cli import main
+from deeplearning4j_tpu.analysis.core import scan_file, scan_paths
+from deeplearning4j_tpu.analysis.rules import ALL_RULES, RULES_BY_ID
+
+REPO = Path(__file__).resolve().parents[1]
+PKG = REPO / "deeplearning4j_tpu"
+
+
+def _scan_snippet(tmp_path, source, name="mod.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    return scan_file(str(p), ALL_RULES, root=str(tmp_path))
+
+
+def _rules_of(findings):
+    return sorted(f.rule for f in findings)
+
+
+# ---------------------------------------------------------------------
+# rule: host-sync-in-hot-loop
+# ---------------------------------------------------------------------
+class TestHostSyncRule:
+    def test_positive_float_and_block_in_per_batch_path(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import jax
+
+            class Net:
+                def _fit_batch(self, ds):
+                    loss = self.step(ds)
+                    self.score = float(loss)
+                    jax.block_until_ready(self.params)
+        """)
+        assert _rules_of(fs) == ["host-sync-in-hot-loop"] * 2
+
+    def test_positive_item_and_device_get_in_fit_loop(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import jax
+
+            def fit(model, batches):
+                for b in batches:
+                    loss = model.step(b)
+                    print(loss.item())
+                    jax.device_get(loss)
+        """)
+        assert _rules_of(fs) == ["host-sync-in-hot-loop"] * 2
+
+    def test_negative_outside_hot_path_or_loop(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import jax
+
+            def fit(model, b):
+                loss = model.step(b)      # no loop at this level
+                return float(loss)
+
+            def score(model, b):
+                return float(model.loss(b))
+        """)
+        assert fs == []
+
+    def test_negative_module_without_jax_is_exempt(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import numpy as np
+
+            def fit(stats, batches):
+                for b in batches:
+                    stats.append(float(np.mean(b)))
+        """)
+        assert fs == []
+
+    def test_negative_benign_scalar_casts_and_host_literals(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import jax
+            import numpy as np
+
+            def _fit_batch(self, ds, seqs):
+                n = int(ds.features.shape[0])
+                m = float(len(seqs))
+                lens = np.asarray([len(s) for s in seqs])
+                return n, m, lens
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------
+# rule: tracer-leak
+# ---------------------------------------------------------------------
+class TestTracerLeakRule:
+    def test_positive_self_assign_in_decorated_jit(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import jax
+
+            class M:
+                @jax.jit
+                def step(self, x):
+                    self.cache = x * 2
+                    return x
+        """)
+        assert _rules_of(fs) == ["tracer-leak"]
+
+    def test_positive_global_assign_in_wrapped_fn(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import jax
+
+            _LAST = None
+
+            def step(x):
+                global _LAST
+                _LAST = x * 2
+                return x
+
+            fast_step = jax.jit(step)
+        """)
+        assert _rules_of(fs) == ["tracer-leak"]
+
+    def test_negative_unjitted_function_may_mutate(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import jax
+
+            class M:
+                def record(self, x):
+                    self.cache = x * 2
+                    return x
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------
+# rule: recompile-hazard
+# ---------------------------------------------------------------------
+class TestRecompileHazardRule:
+    def test_positive_jit_in_loop(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import jax
+
+            def run(fns, x):
+                for f in fns:
+                    y = jax.jit(f)(x)
+                return y
+        """)
+        assert _rules_of(fs) == ["recompile-hazard"]
+
+    def test_positive_list_static_argnums(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import jax
+
+            def f(x, n):
+                return x * n
+
+            g = jax.jit(f, static_argnums=[1])
+        """)
+        assert _rules_of(fs) == ["recompile-hazard"]
+
+    def test_positive_branch_on_traced_arg(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+        """)
+        assert _rules_of(fs) == ["recompile-hazard"]
+
+    def test_negative_static_arg_branch_and_none_check(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, static_argnames=("train",))
+            def f(x, mask, train):
+                if train:                 # static: fine
+                    x = x * 2
+                if mask is None:          # identity check: fine
+                    return x
+                if x.shape[0] > 4:        # shape metadata: fine
+                    return x + 1
+                return x
+        """)
+        assert fs == []
+
+    def test_negative_cached_jit_outside_loop(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import jax
+
+            def get_step(cache, fn):
+                if "step" not in cache:
+                    cache["step"] = jax.jit(fn, static_argnums=(2,))
+                return cache["step"]
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------
+# rule: dtype-promotion
+# ---------------------------------------------------------------------
+class TestDtypePromotionRule:
+    def test_positive_np_float64_in_jax_module(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import jax.numpy as jnp
+            import numpy as np
+
+            def prep(x):
+                return jnp.asarray(np.asarray(x, np.float64))
+        """)
+        assert _rules_of(fs) == ["dtype-promotion"]
+
+    def test_positive_enable_x64_outside_shim(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import jax
+
+            jax.config.update("jax_enable_x64", True)
+        """)
+        assert _rules_of(fs) == ["dtype-promotion"]
+
+    def test_negative_no_jax_import(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import numpy as np
+
+            def stats(x):
+                return np.asarray(x, np.float64).mean()
+        """)
+        assert fs == []
+
+    def test_negative_gradient_check_module_exempt(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import jax.numpy as jnp
+            import numpy as np
+
+            def check(p):
+                return jnp.asarray(p, jnp.float64)
+        """, name="gradient_check.py")
+        assert fs == []
+
+
+# ---------------------------------------------------------------------
+# rule: unlocked-thread-state
+# ---------------------------------------------------------------------
+class TestThreadSharedStateRule:
+    def test_positive_unlocked_self_mutation_in_target(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import threading
+
+            class Server:
+                def start(self):
+                    self._t = threading.Thread(target=self._loop)
+                    self._t.start()
+
+                def _loop(self):
+                    self.count = 0
+                    while True:
+                        self.count += 1
+        """)
+        assert _rules_of(fs) == ["unlocked-thread-state"] * 2
+
+    def test_negative_mutation_under_lock(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import threading
+
+            class Server:
+                def start(self):
+                    self._lock = threading.Lock()
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    while True:
+                        with self._lock:
+                            self.count = 1
+        """)
+        assert fs == []
+
+    def test_negative_queue_handoff_untouched(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import queue
+            import threading
+
+            class Server:
+                def start(self):
+                    self.q = queue.Queue()
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    while True:
+                        item = self.q.get()
+                        item.event.set()
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------
+# rules: hygiene
+# ---------------------------------------------------------------------
+class TestHygieneRules:
+    def test_positive_bare_except_and_mutable_default(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            def load(path, cache={}):
+                try:
+                    return cache[path]
+                except:
+                    return None
+        """)
+        assert _rules_of(fs) == ["bare-except", "mutable-default-arg"]
+
+    def test_negative_typed_except_and_none_default(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            def load(path, cache=None):
+                try:
+                    return (cache or {})[path]
+                except KeyError:
+                    return None
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------
+class TestSuppression:
+    SRC = """
+        import jax
+
+        def _fit_batch(self, ds):
+            loss = self.step(ds)
+            self.score = float(loss)  # tpulint: disable=host-sync-in-hot-loop
+            # justified: final-batch barrier
+            # tpulint: disable=host-sync-in-hot-loop
+            jax.block_until_ready(self.params)
+    """
+
+    def test_inline_and_next_line_suppressions(self, tmp_path):
+        assert _scan_snippet(tmp_path, self.SRC) == []
+
+    def test_unsuppressed_sibling_still_fires(self, tmp_path):
+        fs = _scan_snippet(tmp_path, self.SRC + """
+            def _fit_other(self, ds):
+                return float(self.step(ds))
+        """)
+        assert _rules_of(fs) == ["host-sync-in-hot-loop"]
+
+    def test_disable_all_wildcard(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import jax
+
+            def _fit_batch(self, ds):
+                return float(self.step(ds))  # tpulint: disable=all
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------
+# baseline round-trip + CLI
+# ---------------------------------------------------------------------
+BAD_SRC = """
+import jax
+
+def _fit_batch(self, ds):
+    return float(self.step(ds))
+"""
+
+
+class TestBaselineAndCli:
+    def test_baseline_roundtrip(self, tmp_path):
+        mod = tmp_path / "m.py"
+        mod.write_text(BAD_SRC)
+        findings = scan_paths([str(mod)], root=str(tmp_path))
+        assert _rules_of(findings) == ["host-sync-in-hot-loop"]
+
+        bpath = tmp_path / bl.BASELINE_NAME
+        bl.write_baseline(str(bpath), findings)
+        again = scan_paths([str(mod)], root=str(tmp_path))
+        new, matched, stale = bl.split_new(again, bl.load_baseline(str(bpath)))
+        assert new == [] and matched == 1 and stale == []
+
+        # a NEW violation is not absorbed by the old baseline
+        mod.write_text(BAD_SRC + "\n\ndef _fit_more(self, ds):\n"
+                       "    return float(self.step(ds))\n")
+        third = scan_paths([str(mod)], root=str(tmp_path))
+        new, matched, stale = bl.split_new(third, bl.load_baseline(str(bpath)))
+        assert matched == 1 and len(new) == 1
+
+    def test_baseline_stale_entries_reported(self, tmp_path):
+        mod = tmp_path / "m.py"
+        mod.write_text(BAD_SRC)
+        findings = scan_paths([str(mod)], root=str(tmp_path))
+        bpath = tmp_path / bl.BASELINE_NAME
+        bl.write_baseline(str(bpath), findings)
+        mod.write_text("import jax\n")  # debt paid off
+        new, matched, stale = bl.split_new(
+            scan_paths([str(mod)], root=str(tmp_path)),
+            bl.load_baseline(str(bpath)))
+        assert new == [] and matched == 0 and len(stale) == 1
+
+    def test_cli_json_exit_codes(self, tmp_path, capsys):
+        mod = tmp_path / "m.py"
+        mod.write_text(BAD_SRC)
+        rc = main([str(mod), "--format", "json",
+                   "--baseline", str(tmp_path / bl.BASELINE_NAME)])
+        report = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert report["total"] == 1 and len(report["new"]) == 1
+        assert report["new"][0]["rule"] == "host-sync-in-hot-loop"
+
+        rc = main([str(mod), "--write-baseline",
+                   "--baseline", str(tmp_path / bl.BASELINE_NAME)])
+        capsys.readouterr()
+        assert rc == 0
+        rc = main([str(mod), "--format", "json",
+                   "--baseline", str(tmp_path / bl.BASELINE_NAME)])
+        report = json.loads(capsys.readouterr().out)
+        assert rc == 0 and report["new"] == [] and report["baselined"] == 1
+
+    def test_cli_rule_selection_and_errors(self, tmp_path, capsys):
+        mod = tmp_path / "m.py"
+        mod.write_text("try:\n    pass\nexcept:\n    pass\n")
+        rc = main([str(mod), "--no-baseline", "--rules", "bare-except"])
+        capsys.readouterr()
+        assert rc == 1
+        rc = main([str(mod), "--no-baseline", "--rules", "mutable-default-arg"])
+        capsys.readouterr()
+        assert rc == 0
+        assert main([str(mod), "--rules", "no-such-rule"]) == 2
+        assert main([str(tmp_path / "missing.py")]) == 2
+        assert main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule in ALL_RULES:
+            assert rule.id in out
+
+    def test_parse_error_is_a_new_finding(self, tmp_path, capsys):
+        mod = tmp_path / "m.py"
+        mod.write_text("def broken(:\n")
+        rc = main([str(mod), "--format", "json", "--no-baseline"])
+        report = json.loads(capsys.readouterr().out)
+        assert rc == 1 and report["new"][0]["rule"] == "parse-error"
+
+
+# ---------------------------------------------------------------------
+# the gate: repo must scan clean against the committed baseline
+# ---------------------------------------------------------------------
+class TestSelfScan:
+    def test_repo_has_zero_non_baselined_findings(self, capsys):
+        rc = main([str(PKG), "--format", "json",
+                   "--baseline", str(REPO / bl.BASELINE_NAME)])
+        report = json.loads(capsys.readouterr().out)
+        assert report["new"] == [], (
+            "new tpulint findings (fix them, suppress with justification, "
+            "or — for pre-existing debt only — re-baseline):\n" +
+            "\n".join(f"{f['path']}:{f['line']} [{f['rule']}] {f['message']}"
+                      for f in report["new"]))
+        assert rc == 0
+
+    def test_committed_baseline_has_no_stale_entries(self, capsys):
+        rc = main([str(PKG), "--format", "json",
+                   "--baseline", str(REPO / bl.BASELINE_NAME)])
+        report = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert report["stale_baseline"] == [], (
+            "baseline entries no longer observed — ratchet down with "
+            "--write-baseline")
+
+    def test_every_rule_family_is_registered(self):
+        assert {r.id for r in ALL_RULES} == {
+            "host-sync-in-hot-loop", "tracer-leak", "recompile-hazard",
+            "dtype-promotion", "unlocked-thread-state", "bare-except",
+            "mutable-default-arg"}
+        assert RULES_BY_ID["host-sync-in-hot-loop"].severity == "error"
