@@ -20,7 +20,10 @@ Failure modes are still one JSON line, distinguished by "error":
   - "bench-crash": the benchmark code itself raised. value is null.
 Exit code 0 only for a real measurement.
 
-Env knobs: BENCH_BATCH/IMAGE/WARMUP/STEPS shapes; BENCH_FUSE pins the
+Env knobs: BENCH_BATCH/IMAGE/WARMUP/STEPS shapes; BENCH_SCAN_STEPS=K
+runs the fused K-step lax.scan train step (K optimizer steps per
+Python->XLA dispatch; every record carries steps_per_dispatch /
+dispatches / prefetch_h2d_bytes either way); BENCH_FUSE pins the
 execution plan (0 unfused, 1 bn→act→conv — measured SLOWER, PERF.md
 round 3 — 2 full fused-bottleneck chain). BENCH_FUSE UNSET on a real
 TPU runs the fused-vs-unfused A/B in this one invocation and reports
@@ -56,8 +59,22 @@ IMAGE = int(os.environ.get("BENCH_IMAGE", "224"))
 CLASSES = 1000
 WARMUP = int(os.environ.get("BENCH_WARMUP", "5"))
 STEPS = int(os.environ.get("BENCH_STEPS", "30"))
+# fused multi-step dispatch (ISSUE 3): K optimizer steps per Python->XLA
+# round-trip via the lax.scan train step. 1 = the per-batch step.
+SCAN_STEPS = max(1, int(os.environ.get("BENCH_SCAN_STEPS", "1")))
 INIT_TIMEOUT = float(os.environ.get("BENCH_INIT_TIMEOUT", "120"))
 TOTAL_TIMEOUT = float(os.environ.get("BENCH_TOTAL_TIMEOUT", "1800"))
+
+
+def _prefetch_bytes():
+    """H2D bytes moved by DevicePrefetchIterator stages this process
+    (0.0 when the pipeline never ran). Registry-only read — safe on
+    every failure path."""
+    try:
+        from deeplearning4j_tpu.pipeline.prefetch import prefetch_bytes_total
+        return prefetch_bytes_total()
+    except Exception:  # noqa: BLE001 — the record beats the gauge
+        return 0.0
 
 
 _emit_lock = threading.Lock()
@@ -92,6 +109,12 @@ def _emit(value, vs_baseline, **extra):
             return False
         _emitted = True
         extra.setdefault("metrics", _metrics_snapshot())
+        # dispatch-overhead fields in EVERY record (failure records get
+        # the knob values + 0 dispatches) so the bench trajectory shows
+        # the fused-dispatch / prefetch win
+        extra.setdefault("steps_per_dispatch", SCAN_STEPS)
+        extra.setdefault("dispatches", 0)
+        extra.setdefault("prefetch_h2d_bytes", _prefetch_bytes())
         print(json.dumps({"metric": METRIC, "value": value,
                           "unit": "images/sec",
                           "vs_baseline": vs_baseline, **extra}), flush=True)
@@ -244,7 +267,10 @@ def main():
 
     def _measure(fuse):
         """One full measurement of the given execution plan. Fresh model
-        + jit cache each call; returns images/sec."""
+        + jit cache each call; returns (images/sec, dispatch count of
+        the measured loop). With BENCH_SCAN_STEPS=K>1 the measured unit
+        is the fused K-step lax.scan dispatch (K optimizer steps, one
+        Python->XLA round-trip)."""
         import jax.numpy as jnp
         import numpy as np
 
@@ -265,10 +291,20 @@ def main():
         y = np.zeros((BATCH, CLASSES), np.float32)
         y[np.arange(BATCH), rng.integers(0, CLASSES, BATCH)] = 1.0
 
-        step = net._get_train_step(False)
-        inputs = {net.conf.network_inputs[0]: jnp.asarray(x)}
-        labels = {net.conf.network_outputs[0]: jnp.asarray(y)}
-        key = jax.random.PRNGKey(0)
+        k = SCAN_STEPS
+        if k > 1:
+            step = net._get_scan_train_step(k)
+            inputs = {net.conf.network_inputs[0]:
+                      jnp.stack([jnp.asarray(x)] * k)}
+            labels = {net.conf.network_outputs[0]:
+                      jnp.stack([jnp.asarray(y)] * k)}
+            key = jax.random.split(jax.random.PRNGKey(0), k)
+        else:
+            step = net._get_train_step(False)
+            inputs = {net.conf.network_inputs[0]: jnp.asarray(x)}
+            labels = {net.conf.network_outputs[0]: jnp.asarray(y)}
+            key = jax.random.PRNGKey(0)
+        n_disp = max(1, STEPS // k)
 
         try:
             from deeplearning4j_tpu.monitoring.tracing import span
@@ -283,15 +319,16 @@ def main():
             # sync on a scalar device->host fetch: it cannot complete before
             # the whole chained computation has (block_until_ready on donated
             # buffers returns early on the tunneled platform and
-            # under-measures wildly)
-            float(loss)
+            # under-measures wildly). ravel()[-1]: the scan step returns
+            # the per-step loss VECTOR.
+            float(loss.ravel()[-1])
 
         with span("bench_measure"):
             t0 = time.perf_counter()
-            for _ in range(STEPS):
+            for _ in range(n_disp):
                 params, state, upd, loss = step(params, state, upd, inputs,
                                                 labels, key, None, None)
-            float(loss)
+            float(loss.ravel()[-1])
             dt = time.perf_counter() - t0
         try:
             # the float(loss) sync just proved the backend alive: refresh
@@ -302,7 +339,7 @@ def main():
             runtime.refresh()
         except Exception:  # noqa: BLE001 — gauges are best-effort
             pass
-        return BATCH * STEPS / dt
+        return BATCH * k * n_disp / dt, n_disp
 
     try:
         # BENCH_FUSE: 0 unfused, 1 bn→act→conv plan, 2 full fused-
@@ -322,8 +359,8 @@ def main():
         ab = (fuse_env is None and ab_env != "0"
               and (platform == "tpu" or ab_env == "force"))
 
-        img_s = _measure(fuse_levels.get(fuse_env or "0"))
-        extra = {}
+        img_s, n_disp = _measure(fuse_levels.get(fuse_env or "0"))
+        extra = {"steps_per_dispatch": SCAN_STEPS, "dispatches": n_disp}
         if ab:
             extra["unfused_img_s"] = round(img_s, 2)
             # park the completed measurement + grant the fused leg its
@@ -336,7 +373,7 @@ def main():
                 extra={**extra, "plan": "unfused", **probe_info})
             deadline_box[0] = time.monotonic() + TOTAL_TIMEOUT
             try:
-                fused_img_s = _measure("bottleneck")
+                fused_img_s, _ = _measure("bottleneck")
                 extra["fused_img_s"] = round(fused_img_s, 2)
                 # same-moment paired comparison (run-to-run spread is
                 # ±10-15%; require a clear win to report the fused plan)

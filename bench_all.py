@@ -38,6 +38,23 @@ _print_lock = threading.Lock()
 _pending_kill = [None]   # killed-line bytes parked by a mid-print SIGTERM
 _prev_metrics_snap = [None]  # full registry snapshot at the last record
 
+# fused multi-step dispatch (ISSUE 3): BENCH_SCAN_STEPS=K swaps the
+# per-batch train step for the K-step lax.scan step in every train
+# bench; each record carries steps_per_dispatch / dispatches /
+# prefetch_h2d_bytes so the trajectory shows the dispatch-overhead win.
+_SCAN_STEPS = max(1, int(os.environ.get("BENCH_SCAN_STEPS", "1")))
+_dispatches = [0]        # train-step dispatches issued (see _sync_time)
+_prev_dispatches = [0]   # ... at the last record
+_prev_prefetch_bytes = [0.0]
+
+
+def _prefetch_bytes_total():
+    try:
+        from deeplearning4j_tpu.pipeline.prefetch import prefetch_bytes_total
+        return prefetch_bytes_total()
+    except Exception:  # noqa: BLE001 — the record beats the gauge
+        return 0.0
+
 
 def _signal_safe_metrics():
     """Registry DELTA since the last record, for the killed line — the
@@ -88,6 +105,16 @@ def _print_line(s, flush=True):
             cur = global_registry().snapshot()
             d["metrics"] = snapshot_delta_compact(_prev_metrics_snap[0], cur)
             _prev_metrics_snap[0] = cur
+            # dispatch-overhead fields, delta'd like the metrics snapshot:
+            # this record's train-step dispatches and prefetch H2D bytes
+            d.setdefault("steps_per_dispatch", _SCAN_STEPS)
+            d.setdefault("dispatches",
+                         _dispatches[0] - _prev_dispatches[0])
+            _prev_dispatches[0] = _dispatches[0]
+            pb = _prefetch_bytes_total()
+            d.setdefault("prefetch_h2d_bytes",
+                         round(pb - _prev_prefetch_bytes[0]))
+            _prev_prefetch_bytes[0] = pb
             s = json.dumps(d)
     except Exception:  # noqa: BLE001 — the record beats the snapshot
         pass
@@ -98,17 +125,42 @@ def _print_line(s, flush=True):
         os._exit(3)
 
 
-def _sync_time(step, args, steps):
+def _sync_time(step, args, steps, measured=True):
     """Chained steps; sync via scalar fetch (donated buffers make
     block_until_ready unreliable over the tunneled platform). Returns
-    (elapsed, args_after) so donated state threads into the next call."""
+    (elapsed, args_after) so donated state threads into the next call.
+    ravel()[-1]: the K-step scan step returns the per-step loss VECTOR;
+    the last element syncs the whole chain either way. `measured=False`
+    (warmup legs) keeps the record's "dispatches" field aligned with
+    the dispatches the throughput value was computed from (bench.py
+    counts the same way)."""
     out = None
     t0 = time.perf_counter()
     for _ in range(steps):
         out = step(*args)
         args = (out[0], out[1], out[2]) + args[3:]
-    float(out[3])
+    if measured:
+        _dispatches[0] += steps
+    float(out[3].ravel()[-1])
     return time.perf_counter() - t0, args
+
+
+def _fused_step(net, args):
+    """BENCH_SCAN_STEPS=K>1: swap the per-batch train step for the
+    fused K-step lax.scan step, replicating the benchmark batch K times
+    along the scan axis. Returns (step, args, k) — throughput callers
+    multiply their per-dispatch work by k."""
+    k = _SCAN_STEPS
+    if k == 1:
+        return net._get_train_step(False), args, 1
+    import jax
+    import jax.numpy as jnp
+    p, s, u, x, y, key = args[:6]
+    stack = lambda t: jax.tree_util.tree_map(  # noqa: E731
+        lambda a: jnp.stack([a] * k), t)
+    return (net._get_scan_train_step(k),
+            (p, s, u, stack(x), stack(y),
+             jax.random.split(key, k)) + args[6:], k)
 
 
 def bench_resnet():
@@ -127,15 +179,16 @@ def bench_resnet():
     x = jnp.asarray(rng.standard_normal((B, 3, 224, 224)).astype(np.float32))
     y = np.zeros((B, 1000), np.float32)
     y[np.arange(B), rng.integers(0, 1000, B)] = 1.0
-    step = net._get_train_step(False)
     inputs = {net.conf.network_inputs[0]: x}
     labels = {net.conf.network_outputs[0]: jnp.asarray(y)}
     key = jax.random.PRNGKey(0)
     args = (net.params, net.state, net.updater_state, inputs, labels, key,
             None, None)
-    _, args = _sync_time(step, args, 3)  # warmup
+    step, args, k = _fused_step(net, args)
+    _, args = _sync_time(step, args, 3, measured=False)  # warmup
     dt, _ = _sync_time(step, args, 10)
-    _print_line(json.dumps({"metric": "resnet50_train", "value": round(B * 10 / dt, 1),
+    _print_line(json.dumps({"metric": "resnet50_train",
+                      "value": round(B * k * 10 / dt, 1),
                       "unit": "images/sec"}), flush=True)
 
 
@@ -157,13 +210,14 @@ def bench_lstm():
     x = np.zeros((B, V, T), np.float32)
     x[np.arange(B)[:, None], ids, np.arange(T)[None, :]] = 1.0
     y = np.roll(x, -1, axis=2)
-    step = net._get_train_step(False)
     key = jax.random.PRNGKey(0)
     args = (net.params, net.state, net.updater_state, jnp.asarray(x),
             jnp.asarray(y), key, None, None)
-    _, args = _sync_time(step, args, 3)
+    step, args, k = _fused_step(net, args)
+    _, args = _sync_time(step, args, 3, measured=False)  # warmup
     dt, _ = _sync_time(step, args, 10)
-    _print_line(json.dumps({"metric": "lstm_train", "value": round(B * T * 10 / dt, 1),
+    _print_line(json.dumps({"metric": "lstm_train",
+                      "value": round(B * T * k * 10 / dt, 1),
                       "unit": "tokens/sec"}), flush=True)
 
 
@@ -180,13 +234,14 @@ def bench_lenet():
     x = rng.standard_normal((B, 1, 28, 28)).astype(np.float32)
     y = np.zeros((B, 10), np.float32)
     y[np.arange(B), rng.integers(0, 10, B)] = 1.0
-    step = net._get_train_step(False)
     key = jax.random.PRNGKey(0)
     args = (net.params, net.state, net.updater_state, jnp.asarray(x),
             jnp.asarray(y), key, None, None)
-    _, args = _sync_time(step, args, 3)
+    step, args, k = _fused_step(net, args)
+    _, args = _sync_time(step, args, 3, measured=False)  # warmup
     dt, _ = _sync_time(step, args, 20)
-    _print_line(json.dumps({"metric": "lenet_train", "value": round(B * 20 / dt, 1),
+    _print_line(json.dumps({"metric": "lenet_train",
+                      "value": round(B * k * 20 / dt, 1),
                       "unit": "images/sec"}), flush=True)
 
 
@@ -206,7 +261,6 @@ def bench_vgg16():
     x = jnp.asarray(rng.standard_normal((B, 3, 224, 224)).astype(np.float32))
     y = np.zeros((B, 1000), np.float32)
     y[np.arange(B), rng.integers(0, 1000, B)] = 1.0
-    step = net._get_train_step(False)
     key = jax.random.PRNGKey(0)
     if hasattr(net.conf, "network_inputs"):  # graph
         args = (net.params, net.state, net.updater_state,
@@ -216,9 +270,11 @@ def bench_vgg16():
     else:
         args = (net.params, net.state, net.updater_state, x,
                 jnp.asarray(y), key, None, None)
-    _, args = _sync_time(step, args, 3)
+    step, args, k = _fused_step(net, args)
+    _, args = _sync_time(step, args, 3, measured=False)  # warmup
     dt, _ = _sync_time(step, args, 10)
-    _print_line(json.dumps({"metric": "vgg16_train", "value": round(B * 10 / dt, 1),
+    _print_line(json.dumps({"metric": "vgg16_train",
+                      "value": round(B * k * 10 / dt, 1),
                       "unit": "images/sec"}), flush=True)
 
 
@@ -324,7 +380,7 @@ def bench_transformer():
     args = (net.params, net.state, net.updater_state,
             {net.conf.network_inputs[0]: jnp.asarray(x)},
             {net.conf.network_outputs[0]: jnp.asarray(y)}, key, None, None)
-    _, args = _sync_time(step, args, 3)
+    _, args = _sync_time(step, args, 3, measured=False)  # warmup
     dt, _ = _sync_time(step, args, 10)
     _print_line(json.dumps({"metric": f"transformer_train_T{T}",
                       "value": round(B * T * 10 / dt, 1),

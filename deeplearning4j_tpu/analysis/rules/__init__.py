@@ -1,8 +1,8 @@
 """tpulint rule registry.
 
-Rule families (ISSUE 2): host-sync, tracer-leak, recompile-hazard,
-dtype-promotion, concurrency, hygiene. Adding a rule = subclass
-`analysis.core.Rule`, instantiate it here.
+Rule families: host-sync, device-transfer (ISSUE 3), tracer-leak,
+recompile-hazard, dtype-promotion, concurrency, hygiene. Adding a rule =
+subclass `analysis.core.Rule`, instantiate it here.
 """
 
 from __future__ import annotations
@@ -11,6 +11,8 @@ from typing import Dict, List
 
 from deeplearning4j_tpu.analysis.core import Rule
 from deeplearning4j_tpu.analysis.rules.host_sync import HostSyncRule
+from deeplearning4j_tpu.analysis.rules.device_transfer import (
+    DeviceTransferRule)
 from deeplearning4j_tpu.analysis.rules.tracer_leak import TracerLeakRule
 from deeplearning4j_tpu.analysis.rules.recompile import RecompileHazardRule
 from deeplearning4j_tpu.analysis.rules.dtype import DtypePromotionRule
@@ -20,6 +22,7 @@ from deeplearning4j_tpu.analysis.rules.hygiene import (
 
 ALL_RULES: List[Rule] = [
     HostSyncRule(),
+    DeviceTransferRule(),
     TracerLeakRule(),
     RecompileHazardRule(),
     DtypePromotionRule(),

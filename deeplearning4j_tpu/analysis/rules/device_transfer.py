@@ -1,0 +1,75 @@
+"""device-transfer-in-hot-loop: synchronous host->device staging inside
+fit/epoch hot paths.
+
+`jnp.asarray` / `jnp.array` / `jax.device_put` on host data inside the
+per-batch path stages the H2D copy on the CONSUMER thread: the fit loop
+blocks preparing batch N+1's transfer while the device sits between
+steps — serial transfer/compute instead of the overlap the hardware
+supports. The device-side pipeline stage
+(`pipeline.prefetch.DevicePrefetchIterator`) moves the copy into a
+bounded background worker so it overlaps compute; this rule flags the
+pattern that stage exists to remove. Remnants that are justified — the
+jit-boundary copy of the unprefetched compat path — live in
+TPULINT_BASELINE.json or carry an inline suppression with the why.
+
+Heat model matches host-sync-in-hot-loop: function bodies that ARE the
+per-batch path (`_fit*`, `partial_fit`, ...) are hot everywhere; in
+`fit`/`train`-shaped functions only code lexically inside a loop is hot.
+Literal-constant arguments (e.g. ``jnp.asarray(3)``) are exempt — a
+scalar constant is not a batch transfer.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from deeplearning4j_tpu.analysis.core import (
+    Finding, ModuleInfo, Rule, SEVERITY_WARNING)
+from deeplearning4j_tpu.analysis.rules.host_sync import (
+    _LOOP_FN, _PER_BATCH_FN)
+
+_TRANSFER_CALLS = {
+    "jax.numpy.asarray": "jnp.asarray",
+    "jax.numpy.array": "jnp.array",
+    "jax.device_put": "jax.device_put",
+}
+
+
+class DeviceTransferRule(Rule):
+    id = "device-transfer-in-hot-loop"
+    severity = SEVERITY_WARNING
+    description = ("jnp.asarray/jax.device_put on host data inside a "
+                   "fit/epoch loop stages the H2D copy on the consumer "
+                   "thread; prefetch it (pipeline.DevicePrefetchIterator) "
+                   "so the transfer overlaps device compute")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        if not mod.imports_module("jax"):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = mod.resolve(node.func)
+            label = _TRANSFER_CALLS.get(resolved)
+            if label is None:
+                continue
+            # a literal scalar/constant is shape plumbing, not a batch
+            if node.args and isinstance(node.args[0], ast.Constant):
+                continue
+            for fn in mod.enclosing_functions(node):
+                if _PER_BATCH_FN.match(fn.name):
+                    where = f"per-batch path '{fn.name}'"
+                elif _LOOP_FN.match(fn.name) and mod.inside_loop(node,
+                                                                 within=fn):
+                    where = f"loop in '{fn.name}'"
+                else:
+                    continue
+                yield self.finding(
+                    mod, node,
+                    f"{label}() in {where} stages a host->device copy on "
+                    f"the consumer thread each batch; move it into a "
+                    f"device prefetch stage "
+                    f"(pipeline.DevicePrefetchIterator) so the transfer "
+                    f"overlaps compute")
+                break

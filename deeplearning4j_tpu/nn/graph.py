@@ -32,16 +32,15 @@ from deeplearning4j_tpu.monitoring import ensure_started
 from deeplearning4j_tpu.monitoring.listener import (
     finalize_fit_telemetry, maybe_record_fit_iteration)
 from deeplearning4j_tpu.monitoring.tracing import phase_detail, span
+from deeplearning4j_tpu.nn.multilayer import _strip_stream_state, _tree_sub
 from deeplearning4j_tpu.optimize.listeners import close_listeners
+from deeplearning4j_tpu.pipeline.padding import (
+    group_signature, num_real_examples, pad_batch, with_example_weights)
 
 log = logging.getLogger(__name__)
 
 
 from deeplearning4j_tpu.nn.compute import f32_head as _f32_head  # noqa: E402
-
-
-def _tree_sub(params, steps):
-    return jax.tree_util.tree_map(lambda p, s: p - s, params, steps)
 
 
 class ComputationGraph(LazyScore):
@@ -63,6 +62,9 @@ class ComputationGraph(LazyScore):
         self._vertex_input_types: Dict[str, List[InputType]] = {}
         self.fuse_bn_act_conv = False
         self._fusion_cache = None
+        # listener capability flags, hoisted to fit-loop setup (None =
+        # not inside fit(): _fit_batch recomputes for direct callers)
+        self._stash_features: Optional[bool] = None
 
     # ------------------------------------------------------------------
     # bn→act→conv1x1 fusion (execution-plan optimization, see
@@ -701,6 +703,43 @@ class ComputationGraph(LazyScore):
             self._jit_cache[key] = jax.jit(step, donate_argnums=(0, 2))
         return self._jit_cache[key]
 
+    def _get_scan_train_step(self, k: int):
+        """Fused multi-step dispatch — the ComputationGraph twin of
+        MultiLayerNetwork._get_scan_train_step: K optimizer updates in
+        one jitted, buffer-donating lax.scan over stacked (dict-keyed)
+        batches, returning the per-step loss vector."""
+        if getattr(self, "_quantized", False):
+            raise RuntimeError(
+                "this network was quantized for inference "
+                "(quantize_for_inference) — int8 weights have no "
+                "gradient path; train the fp checkpoint and re-quantize")
+        key = ("scan", k, self.conf.dtype)
+        if key not in self._jit_cache:
+            conf = self.conf
+
+            def stepk(params, state, upd_state, xs, ys, rngs, fmasks, lmasks):
+                def one(carry, inp):
+                    p, s, u = carry
+                    ins, lbs, rng, fm, lm = inp
+                    (loss, s2), grads = jax.value_and_grad(
+                        lambda pp: self._loss(pp, s, ins, lbs, rng, fm, lm,
+                                              train=True),
+                        has_aux=True)(p)
+                    grads = normalize_gradients(
+                        grads, conf.gradient_normalization,
+                        conf.gradient_normalization_threshold)
+                    steps, u2 = conf.updater.update(grads, u, p)
+                    return (_tree_sub(p, steps), _strip_stream_state(s2),
+                            u2), loss
+
+                (p, s, u), losses = jax.lax.scan(
+                    one, (params, _strip_stream_state(state), upd_state),
+                    (xs, ys, rngs, fmasks, lmasks))
+                return p, s, u, losses
+
+            self._jit_cache[key] = jax.jit(stepk, donate_argnums=(0, 2))
+        return self._jit_cache[key]
+
     def _get_phase_steps(self, carry_rnn: bool):
         """Split train step for span phase detail — the ComputationGraph
         twin of MultiLayerNetwork._get_phase_steps (see its docstring for
@@ -739,10 +778,20 @@ class ComputationGraph(LazyScore):
         self._rng, sub = jax.random.split(self._rng)
         return sub
 
-    def fit(self, data, labels=None, epochs: int = 1, batch_size: int = 32):
+    def fit(self, data, labels=None, epochs: int = 1, batch_size: int = 32,
+            *, steps_per_dispatch: int = 1, prefetch: int = 0,
+            pad_tail: Optional[bool] = None):
         """Train (ref: ComputationGraph.fit :837). Accepts a DataSetIterator
         (single-input/single-output), a DataSet, (features, labels), or dicts
-        keyed by input/output names (MultiDataSet equivalent)."""
+        keyed by input/output names (MultiDataSet equivalent).
+
+        `steps_per_dispatch` / `prefetch` / `pad_tail` are the fused
+        multi-step dispatch and device-prefetch knobs — see
+        MultiLayerNetwork.fit and ARCHITECTURE.md "Input pipeline &
+        fused dispatch". Tail padding is skipped for feature-masked
+        batches without an explicit labels mask: there the loss falls
+        back to the PROPAGATED feature mask (see _loss), which a
+        synthesized example-weight mask would shadow."""
         if not self._initialized:
             self.init()
         ensure_started()
@@ -753,13 +802,27 @@ class ComputationGraph(LazyScore):
                                       data.features_mask, data.labels_mask)
         else:
             it = data
-
+        k = max(1, int(steps_per_dispatch))
+        pad = (k > 1) if pad_tail is None else bool(pad_tail)
+        if prefetch:
+            from deeplearning4j_tpu.pipeline.prefetch import \
+                DevicePrefetchIterator
+            # pad in the worker, BEFORE the transfer (padding a
+            # device-resident batch in the fit loop would be a D2H
+            # round-trip); pad_when carries the mask-shadowing
+            # exemption the loop below applies to unprefetched batches
+            it = DevicePrefetchIterator(
+                it, prefetch=prefetch, pad_to="auto" if pad else None,
+                pad_when=lambda ds: ds.labels is not None and (
+                    ds.labels_mask is not None or ds.features_mask is None))
+        # listener capability scan hoisted out of the per-batch path
+        self._stash_features = any(getattr(l, "needs_batch_features", False)
+                                   for l in self.listeners)
         try:
             for _ in range(epochs):
                 for lst in self.listeners:
                     lst.on_epoch_start(self, self.epoch_count)
-                for ds in it:
-                    self._fit_batch(ds)
+                self._fit_epoch(it, k, pad)
                 # completed-epoch ordering: see multilayer.py fit
                 epoch_idx = self.epoch_count
                 self.epoch_count += 1
@@ -768,18 +831,118 @@ class ComputationGraph(LazyScore):
             # one allowed sync, after the final batch (see multilayer.fit)
             finalize_fit_telemetry(self)
         finally:
+            self._stash_features = None
             close_listeners(self.listeners)
         return self
+
+    def _fit_epoch(self, it, k: int, pad: bool):
+        """One pass over the iterator — the graph twin of
+        MultiLayerNetwork._fit_epoch: pad ragged batches to the
+        canonical row count when `pad` and fuse runs of `k`
+        same-signature batches into single scan dispatches; anything
+        unfusable falls back to the per-batch step."""
+        canon = None
+        group: List[DataSet] = []
+        sig = None
+
+        def flush():
+            nonlocal sig
+            if len(group) == k:
+                self._fit_group(group)
+            else:
+                for b in group:
+                    self._fit_batch(b)
+            group.clear()
+            sig = None
+
+        for ds in it:
+            if canon is None:
+                canon = ds.num_examples()
+            # feature-masked batches without an explicit labels mask use
+            # the PROPAGATED mask in _loss; a synthesized example-weight
+            # mask would shadow it, so those stay unpadded
+            if pad and ds.labels is not None and (
+                    ds.labels_mask is not None or ds.features_mask is None):
+                if ds.num_examples() < canon:
+                    ds = pad_batch(ds, canon)
+                ds = with_example_weights(ds)
+            if k == 1:
+                self._fit_batch(ds)
+                continue
+            s = group_signature(ds)
+            if group and s != sig:
+                flush()
+            sig = s
+            group.append(ds)
+            if len(group) == k:
+                flush()
+        flush()
+
+    def _fit_group(self, group: Sequence[DataSet]):
+        """One fused K-step scan dispatch over stacked dict-keyed
+        batches; listeners fire per logical step with lazy loss slices
+        (see MultiLayerNetwork._fit_group)."""
+        t0 = time.perf_counter()
+        k = len(group)
+        out0 = self.conf.network_outputs[0]
+        with span("etl"):
+            rngs = jnp.stack([self._next_rng() for _ in range(k)])
+            ins = [self._as_input_dict(b.features) for b in group]
+            lbs = [{out0: b.labels} if not isinstance(b.labels, dict)
+                   else b.labels for b in group]
+            fms = [self._as_mask_dict(b.features_mask) for b in group]
+            lms = [self._as_mask_dict(b.labels_mask, default_key=out0)
+                   for b in group]
+
+            def stack_dicts(ds_list):
+                if ds_list[0] is None:
+                    return None
+                return {kk: jnp.stack([d[kk] for d in ds_list])
+                        for kk in ds_list[0]}
+
+            xs = stack_dicts(ins)
+            ys = stack_dicts(lbs)
+            fmasks = stack_dicts(fms)
+            lmasks = stack_dicts(lms)
+        step = self._get_scan_train_step(k)
+        with span("step"):
+            self.params, self.state, self.updater_state, losses = step(
+                self.params, self.state, self.updater_state,
+                xs, ys, rngs, fmasks, lmasks)
+        # raw device scalar: float() (the host sync) deferred to access
+        self.score_value = losses[-1]
+        with span("listener"):
+            for i, b in enumerate(group):
+                loss_i = losses[i]  # lazy device slice, no sync
+                if self._stash_features:
+                    # per LOGICAL step, so viz listeners pair each
+                    # iteration_done with its own batch's features
+                    self._last_batch_features = b.features
+                for lst in self.listeners:
+                    if hasattr(lst, "record_batch"):
+                        lst.record_batch(num_real_examples(b))
+                    lst.iteration_done(self, self.iteration_count, loss_i)
+                self.iteration_count += 1
+        maybe_record_fit_iteration(
+            self, sum(num_real_examples(b) for b in group),
+            time.perf_counter() - t0, n_batches=k)
 
     def _fit_batch(self, ds: DataSet):
         t0 = time.perf_counter()
         # listener parity with MultiLayerNetwork._fit_batch: viz listeners
         # (needs_batch_features) get the raw batch stashed here too
-        if any(getattr(l, "needs_batch_features", False)
-               for l in self.listeners):
+        stash = self._stash_features
+        if stash is None:  # direct call outside fit(): no hoisted scan
+            stash = any(getattr(l, "needs_batch_features", False)
+                        for l in self.listeners)
+        if stash:
             self._last_batch_features = ds.features
         with span("etl"):
             rng = self._next_rng()
+            # jnp.asarray here is the jit-boundary copy of the
+            # UNPREFETCHED compat path (baselined for tpulint
+            # device-transfer-in-hot-loop): fit(prefetch=N) moves these
+            # H2D copies into the background pipeline stage
             inputs = self._as_input_dict(ds.features)
             labels = {self.conf.network_outputs[0]: jnp.asarray(ds.labels)} \
                 if not isinstance(ds.labels, dict) else \
@@ -808,15 +971,18 @@ class ComputationGraph(LazyScore):
         # raw device scalar: float() (the host sync) deferred to access
         self.score_value = loss
         with span("listener"):
+            # num_real_examples: a padded tail batch reports its true
+            # row count to throughput stats, not the bucket size
+            n_real = num_real_examples(ds)
             for lst in self.listeners:
                 if hasattr(lst, "record_batch"):
-                    lst.record_batch(ds.num_examples())
+                    lst.record_batch(n_real)
                 # raw score, NOT the float property: listeners that use the
                 # score sync at their own cadence, the rest never sync
                 lst.iteration_done(self, self.iteration_count,
                                    self._score_raw)
         self.iteration_count += 1
-        maybe_record_fit_iteration(self, ds.num_examples(),
+        maybe_record_fit_iteration(self, n_real,
                                    time.perf_counter() - t0)
 
     # ------------------------------------------------------------------

@@ -43,12 +43,27 @@ from deeplearning4j_tpu.monitoring.listener import (
     finalize_fit_telemetry, maybe_record_fit_iteration)
 from deeplearning4j_tpu.monitoring.tracing import phase_detail, span
 from deeplearning4j_tpu.optimize.listeners import close_listeners
+from deeplearning4j_tpu.pipeline.padding import (
+    group_signature, num_real_examples, pad_batch, with_example_weights)
 
 log = logging.getLogger(__name__)
 
 
 def _tree_sub(params, steps):
     return jax.tree_util.tree_map(lambda p, s: p - s, params, steps)
+
+
+def _strip_stream_state(state):
+    """Drop transient streaming carries (RNN h/c, attention KV caches —
+    STREAM_STATE_KEYS) from a state pytree. The fused lax.scan fit path
+    needs the carry structure identical on every step, and non-carry
+    training already ignores these keys at read (_forward strips them),
+    so the scan path keeps them out of the carry entirely — same rule
+    ParallelWrapper's averaging scan applies."""
+    return {k: ({kk: vv for kk, vv in v.items()
+                 if kk not in STREAM_STATE_KEYS}
+                if isinstance(v, dict) else v)
+            for k, v in state.items()}
 
 
 class MultiLayerNetwork(LazyScore):
@@ -67,6 +82,9 @@ class MultiLayerNetwork(LazyScore):
         self._rng = None
         self._jit_cache: Dict[Any, Any] = {}
         self._initialized = False
+        # listener capability flags, hoisted to fit-loop setup (None =
+        # not inside fit(): _fit_batch recomputes for direct callers)
+        self._stash_features: Optional[bool] = None
 
     # ------------------------------------------------------------------
     # init
@@ -280,6 +298,52 @@ class MultiLayerNetwork(LazyScore):
             self._jit_cache[key] = jax.jit(step, donate_argnums=(0, 2))
         return self._jit_cache[key]
 
+    def _get_scan_train_step(self, k: int):
+        """Fused multi-step dispatch: K optimizer steps in ONE jitted,
+        buffer-donating call via lax.scan over stacked batches
+        ([K, B, ...]), returning the per-step loss vector as a single
+        device array. Each scan iteration is exactly the _get_train_step
+        body, so K Python→XLA round-trips (and K listener-side dispatch
+        gaps) collapse into one — the micro-batch fusion μ-cuDNN applies
+        to framework overhead (PAPERS.md). Streaming carries are
+        stripped from the scanned state (see _strip_stream_state)."""
+        if getattr(self, "_quantized", False):
+            raise RuntimeError(
+                "this network was quantized for inference "
+                "(quantize_for_inference) — int8 weights have no "
+                "gradient path; train the fp checkpoint and re-quantize")
+        key = ("scan", k, self.conf.dtype)
+        if key not in self._jit_cache:
+            conf = self.conf
+
+            def stepk(params, state, upd_state, xs, ys, rngs, fmasks, lmasks):
+                def one(carry, inp):
+                    p, s, u = carry
+                    x, y, rng, fm, lm = inp
+                    (loss, s2), grads = jax.value_and_grad(
+                        lambda pp: self._loss(pp, s, x, y, rng, fm, lm,
+                                              train=True, carry_rnn=False),
+                        has_aux=True)(p)
+                    grads = normalize_gradients(
+                        grads, conf.gradient_normalization,
+                        conf.gradient_normalization_threshold)
+                    steps, u2 = conf.updater.update(grads, u, p)
+                    p2 = _tree_sub(p, steps)
+                    if any(getattr(l, "constraints", None)
+                           for l in self.layers):
+                        from deeplearning4j_tpu.nn.conf.constraints import \
+                            apply_constraints
+                        p2 = apply_constraints(self.layers, p2)
+                    return (p2, _strip_stream_state(s2), u2), loss
+
+                (p, s, u), losses = jax.lax.scan(
+                    one, (params, _strip_stream_state(state), upd_state),
+                    (xs, ys, rngs, fmasks, lmasks))
+                return p, s, u, losses
+
+            self._jit_cache[key] = jax.jit(stepk, donate_argnums=(0, 2))
+        return self._jit_cache[key]
+
     def _get_phase_steps(self, carry_rnn: bool):
         """Split train step for span phase detail
         (monitoring.set_phase_detail): forward (vjp residuals), backward
@@ -369,10 +433,28 @@ class MultiLayerNetwork(LazyScore):
         self._rng, sub = jax.random.split(self._rng)
         return sub
 
-    def fit(self, data, labels=None, epochs: int = 1, batch_size: int = 32):
+    def fit(self, data, labels=None, epochs: int = 1, batch_size: int = 32,
+            *, steps_per_dispatch: int = 1, prefetch: int = 0,
+            pad_tail: Optional[bool] = None):
         """Train (ref: MultiLayerNetwork.fit(DataSetIterator) :1156).
 
         Accepts a DataSetIterator, a DataSet, or (features, labels) arrays.
+
+        Dispatch-overhead knobs (pipeline/ — see ARCHITECTURE.md "Input
+        pipeline & fused dispatch"):
+
+        - ``steps_per_dispatch=K``: fuse K optimizer steps into one
+          jitted lax.scan dispatch (_get_scan_train_step). Listeners
+          still fire once per LOGICAL step, receiving a lazy slice of
+          the per-step loss vector (no sync unless they float() it).
+          Epoch-trailing groups smaller than K run per-batch.
+        - ``prefetch=N``: stage batches through DevicePrefetchIterator
+          so H2D transfer overlaps compute, N batches deep.
+        - ``pad_tail``: pad the ragged last batch to the canonical batch
+          shape with an example-weight mask folded into the loss (exact
+          for row-wise layers; approximate under batch-stat layers like
+          BatchNormalization — pipeline/padding.py). Defaults to ON when
+          steps_per_dispatch > 1, OFF otherwise.
         """
         if not self._initialized:
             self.init()
@@ -384,16 +466,25 @@ class MultiLayerNetwork(LazyScore):
                                       data.features_mask, data.labels_mask)
         else:
             it = data
-
+        k = max(1, int(steps_per_dispatch))
+        pad = (k > 1) if pad_tail is None else bool(pad_tail)
+        if prefetch:
+            from deeplearning4j_tpu.pipeline.prefetch import \
+                DevicePrefetchIterator
+            # pad in the worker, BEFORE the transfer (padding a
+            # device-resident batch in the fit loop would be a D2H
+            # round-trip)
+            it = DevicePrefetchIterator(
+                it, prefetch=prefetch, pad_to="auto" if pad else None,
+                pad_when=lambda ds: ds.labels is not None)
+        # listener capability scan hoisted out of the per-batch path
+        self._stash_features = any(getattr(l, "needs_batch_features", False)
+                                   for l in self.listeners)
         try:
             for epoch in range(epochs):
                 for lst in self.listeners:
                     lst.on_epoch_start(self, self.epoch_count)
-                for ds in it:
-                    if self.conf.tbptt and ds.features.ndim == 3:
-                        self._fit_tbptt(ds)
-                    else:
-                        self._fit_batch(ds)
+                self._fit_epoch(it, k, pad)
                 # increment BEFORE listeners fire: a CheckpointListener save
                 # in on_epoch_end must record this epoch as COMPLETED, or
                 # resume re-trains it (off-by-one). Listeners still receive
@@ -406,16 +497,109 @@ class MultiLayerNetwork(LazyScore):
             # one allowed sync is here, after the final batch
             finalize_fit_telemetry(self)
         finally:
+            self._stash_features = None
             close_listeners(self.listeners)
         return self
 
+    def _fit_epoch(self, it, k: int, pad: bool):
+        """One pass over the iterator: pad ragged batches to the
+        canonical (first-batch) row count when `pad`, and fuse runs of
+        `k` same-signature batches into single scan dispatches when
+        k > 1. Anything unfusable (tbptt sequences, signature changes,
+        the trailing partial group) falls back to the per-batch step."""
+        canon = None
+        group: List[DataSet] = []
+        sig = None
+
+        def flush():
+            nonlocal sig
+            if len(group) == k:
+                self._fit_group(group)
+            else:
+                for b in group:
+                    self._fit_batch(b)
+            group.clear()
+            sig = None
+
+        for ds in it:
+            if self.conf.tbptt and ds.features.ndim == 3:
+                flush()
+                self._fit_tbptt(ds)
+                continue
+            if canon is None:
+                canon = ds.num_examples()
+            if pad and ds.labels is not None:
+                if ds.num_examples() < canon:
+                    ds = pad_batch(ds, canon)
+                # every batch carries an example-weight mask so the padded
+                # tail shares the full batches' jit signature (exact:
+                # ones-masked mean == plain mean)
+                ds = with_example_weights(ds)
+            if k == 1:
+                self._fit_batch(ds)
+                continue
+            s = group_signature(ds)
+            if group and s != sig:
+                flush()
+            sig = s
+            group.append(ds)
+            if len(group) == k:
+                flush()
+        flush()
+
+    def _fit_group(self, group: Sequence[DataSet]):
+        """Dispatch one fused K-step scan over stacked batches. Listeners
+        fire per logical step with a LAZY slice of the device loss
+        vector — the sync-free steady-state contract holds."""
+        t0 = time.perf_counter()
+        k = len(group)
+        with span("etl"):
+            rngs = jnp.stack([self._next_rng() for _ in range(k)])
+            # jnp.stack is a device-side concat for prefetched (already
+            # device-resident) batches and one fused H2D copy otherwise
+            xs = jnp.stack([b.features for b in group])
+            ys = jnp.stack([b.labels for b in group])
+            fmasks = None if group[0].features_mask is None else \
+                jnp.stack([b.features_mask for b in group])
+            lmasks = None if group[0].labels_mask is None else \
+                jnp.stack([b.labels_mask for b in group])
+        step = self._get_scan_train_step(k)
+        with span("step"):
+            self.params, self.state, self.updater_state, losses = step(
+                self.params, self.state, self.updater_state,
+                xs, ys, rngs, fmasks, lmasks)
+        # raw device scalar: float() (the host sync) deferred to access
+        self.score_value = losses[-1]
+        with span("listener"):
+            for i, b in enumerate(group):
+                loss_i = losses[i]  # lazy device slice, no sync
+                if self._stash_features:
+                    # per LOGICAL step, so viz listeners pair each
+                    # iteration_done with its own batch's features
+                    self._last_batch_features = b.features
+                for lst in self.listeners:
+                    if hasattr(lst, "record_batch"):
+                        lst.record_batch(num_real_examples(b))
+                    lst.iteration_done(self, self.iteration_count, loss_i)
+                self.iteration_count += 1
+        maybe_record_fit_iteration(
+            self, sum(num_real_examples(b) for b in group),
+            time.perf_counter() - t0, n_batches=k)
+
     def _fit_batch(self, ds: DataSet, carry_rnn: bool = False):
         t0 = time.perf_counter()
-        if any(getattr(l, "needs_batch_features", False)
-               for l in self.listeners):
+        stash = self._stash_features
+        if stash is None:  # direct call outside fit(): no hoisted scan
+            stash = any(getattr(l, "needs_batch_features", False)
+                        for l in self.listeners)
+        if stash:
             self._last_batch_features = ds.features  # for viz listeners
         with span("etl"):
             rng = self._next_rng()
+            # jnp.asarray here is the jit-boundary copy of the
+            # UNPREFETCHED compat path (baselined for tpulint
+            # device-transfer-in-hot-loop): fit(prefetch=N) moves these
+            # H2D copies into the background pipeline stage
             fmask = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
             lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
             x = jnp.asarray(ds.features)
@@ -443,15 +627,18 @@ class MultiLayerNetwork(LazyScore):
         # raw device scalar: float() (the host sync) deferred to access
         self.score_value = loss
         with span("listener"):
+            # num_real_examples: a padded tail batch reports its true
+            # row count to throughput stats, not the bucket size
+            n_real = num_real_examples(ds)
             for lst in self.listeners:
                 if hasattr(lst, "record_batch"):
-                    lst.record_batch(ds.num_examples())
+                    lst.record_batch(n_real)
                 # raw score, NOT the float property: listeners that use the
                 # score sync at their own cadence, the rest never sync
                 lst.iteration_done(self, self.iteration_count,
                                    self._score_raw)
         self.iteration_count += 1
-        maybe_record_fit_iteration(self, ds.num_examples(),
+        maybe_record_fit_iteration(self, n_real,
                                    time.perf_counter() - t0)
 
     def _fit_tbptt(self, ds: DataSet):

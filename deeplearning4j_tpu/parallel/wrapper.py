@@ -64,12 +64,24 @@ class ParallelWrapper:
                  averaging_frequency: int = 5,
                  prefetch_buffer: int = 2,
                  report_score_after_averaging: bool = True,
-                 collect_stats: bool = False):
+                 collect_stats: bool = False,
+                 steps_per_dispatch: int = 1,
+                 device_prefetch: bool = False):
         self.model = model
         self.mesh = mesh if mesh is not None else default_mesh()
         self.training_mode = training_mode
         self.averaging_frequency = max(1, averaging_frequency)
         self.prefetch_buffer = prefetch_buffer
+        #: allreduce mode: fuse K same-shape batches into one lax.scan
+        #: dispatch of the wrapped model's scan train step (SPMD: batch
+        #: axis 1 sharded over the mesh). Epoch tails fall back to the
+        #: per-batch allreduce step.
+        self.steps_per_dispatch = max(1, int(steps_per_dispatch))
+        #: replace the host-side AsyncDataSetIterator stage with a
+        #: DevicePrefetchIterator that lands batches PRE-SHARDED on the
+        #: mesh (NamedSharding over "data"), so the H2D copy overlaps
+        #: compute instead of happening inside the fit step.
+        self.device_prefetch = bool(device_prefetch)
         self.n_devices = int(np.prod(self.mesh.devices.shape))
         self._jit_cache: Dict[Any, Any] = {}
         self._warned_small_batch = False
@@ -83,9 +95,9 @@ class ParallelWrapper:
             model.init()
 
     # ------------------------------------------------------------------
-    def _shard_batch(self, arr):
-        """Make the batch divisible by n_devices and device_put sharded on
-        the data axis. Non-divisible remainders are DROPPED (the reference
+    def _host_trim(self, arr):
+        """Host half of batch sharding: make the batch divisible by
+        n_devices. Non-divisible remainders are DROPPED (the reference
         drops/queues leftovers rather than duplicating examples —
         duplicate-padding would silently over-weight the repeated sample in
         the gradient). Batches smaller than the mesh still pad by repetition
@@ -113,12 +125,56 @@ class ParallelWrapper:
                 pad = self.n_devices - n
                 arr = np.concatenate(
                     [arr, np.repeat(arr[-1:], pad, axis=0)], axis=0)
+        return arr
+
+    def _trim_batch(self, ds: DataSet) -> DataSet:
+        """DataSet-level _host_trim (DevicePrefetchIterator transform:
+        the worker trims before the background device_put). Stashes the
+        pre-transform effective count so listener/throughput stats match
+        the unprefetched path (a below-mesh batch padded by repetition
+        must still report its REAL rows)."""
+        out = DataSet(
+            self._host_trim(ds.features),
+            None if ds.labels is None else self._host_trim(ds.labels),
+            None if ds.features_mask is None
+            else self._host_trim(ds.features_mask),
+            None if ds.labels_mask is None
+            else self._host_trim(ds.labels_mask))
+        out.real_examples = self._effective_examples(ds)
+        return out
+
+    def _shard_batch(self, arr):
+        """Trim to mesh divisibility and device_put sharded on the data
+        axis. Batches already staged by the device-prefetch pipeline
+        (committed jax.Arrays, pre-trimmed and pre-sharded by the
+        worker) pass through untouched — np.asarray on them would be a
+        D2H round-trip."""
+        if isinstance(arr, jax.Array):
+            return arr
+        arr = self._host_trim(arr)
         sh = NamedSharding(self.mesh, P("data", *([None] * (arr.ndim - 1))))
         return jax.device_put(arr, sh)
 
+    def _shard_stack(self, arrs):
+        """Stack K same-shape batches to [K, B, ...] sharded
+        P(None, "data", ...) for the fused scan step. Device-resident
+        (prefetched) batches stack on device; host batches trim and
+        transfer as ONE put."""
+        if isinstance(arrs[0], jax.Array):
+            return jnp.stack(arrs)
+        a = np.stack([self._host_trim(x) for x in arrs])
+        sh = NamedSharding(self.mesh,
+                           P(None, "data", *([None] * (a.ndim - 2))))
+        return jax.device_put(a, sh)
+
     def _effective_examples(self, ds: DataSet) -> int:
         """Examples that actually contribute to the step after the
-        divisibility trim (listener stats must not count dropped rows)."""
+        divisibility trim (listener stats must not count dropped or
+        repetition-padded rows). Prefetched batches carry the count
+        computed BEFORE the worker's trim/pad (see _trim_batch)."""
+        pre = getattr(ds, "real_examples", None)
+        if pre is not None:
+            return int(pre)
         n = ds.num_examples()
         if n >= self.n_devices:
             return (n // self.n_devices) * self.n_devices
@@ -140,8 +196,13 @@ class ParallelWrapper:
 
     def _stash_batch_for_viz(self, ds: DataSet):
         m = self.model
-        if any(getattr(l, "needs_batch_features", False)
-               for l in m.listeners):
+        # hoisted capability flag (set at fit start); falls back to the
+        # per-call scan when the batch path is driven directly
+        stash = getattr(m, "_stash_features", None)
+        if stash is None:
+            stash = any(getattr(l, "needs_batch_features", False)
+                        for l in m.listeners)
+        if stash:
             m._last_batch_features = ds.features
 
     # ------------------------------------------------------------------
@@ -183,6 +244,52 @@ class ParallelWrapper:
         m.iteration_count += 1
         maybe_record_fit_iteration(m, self._effective_examples(ds),
                                    time.perf_counter() - t0)
+
+    def _fit_group_allreduce(self, batches):
+        """Fused multi-step SPMD dispatch: K batches stacked to
+        [K, B, ...] (batch axis sharded over the mesh) through the
+        wrapped model's scan train step — K allreduce steps, ONE
+        Python→XLA round-trip. Listeners fire per logical step with
+        lazy slices of the per-step loss vector."""
+        t0 = time.perf_counter()
+        m = self.model
+        k = len(batches)
+        step = m._get_scan_train_step(k)
+        with self._timer("step"):
+            rngs = jnp.stack([m._next_rng() for _ in range(k)])
+            xs = self._shard_stack([b.features for b in batches])
+            ys = self._shard_stack([b.labels for b in batches])
+            fm = None if batches[0].features_mask is None else \
+                self._shard_stack([b.features_mask for b in batches])
+            lm = None if batches[0].labels_mask is None else \
+                self._shard_stack([b.labels_mask for b in batches])
+            from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+            if isinstance(m, MultiLayerNetwork):
+                m.params, m.state, m.updater_state, losses = step(
+                    m.params, m.state, m.updater_state, xs, ys, rngs, fm, lm)
+            else:
+                inputs = {m.conf.network_inputs[0]: xs}
+                labels = {m.conf.network_outputs[0]: ys}
+                fms = None if fm is None else {m.conf.network_inputs[0]: fm}
+                lms = None if lm is None else {m.conf.network_outputs[0]: lm}
+                m.params, m.state, m.updater_state, losses = step(
+                    m.params, m.state, m.updater_state, inputs, labels,
+                    rngs, fms, lms)
+            m.score_value = losses[-1]  # raw device scalar
+        with self._timer("listener"):
+            for i, b in enumerate(batches):
+                loss_i = losses[i]  # lazy device slice, no sync
+                # per LOGICAL step, so viz listeners pair each
+                # iteration_done with its own batch's features
+                self._stash_batch_for_viz(b)
+                for lst in m.listeners:
+                    if hasattr(lst, "record_batch"):
+                        lst.record_batch(self._effective_examples(b))
+                    lst.iteration_done(m, m.iteration_count, loss_i)
+                m.iteration_count += 1
+        maybe_record_fit_iteration(
+            m, sum(self._effective_examples(b) for b in batches),
+            time.perf_counter() - t0, n_batches=k)
 
     # ------------------------------------------------------------------
     # averaging mode (parity with ParameterAveraging semantics)
@@ -287,8 +394,13 @@ class ParallelWrapper:
     def fit(self, data, labels=None, epochs: int = 1, batch_size: int = 32):
         """Train across the mesh (ref: ParallelWrapper.fit :468). The
         iterator is wrapped in async prefetch like the reference's
-        ADSI-per-device feeding."""
+        ADSI-per-device feeding — host-side by default, or the
+        device-side pipeline stage when ``device_prefetch=True``
+        (batches land pre-trimmed and pre-sharded on the mesh). With
+        ``steps_per_dispatch=K``, allreduce mode fuses runs of K
+        same-shape batches into single scan dispatches."""
         from deeplearning4j_tpu.monitoring import ensure_started
+        from deeplearning4j_tpu.pipeline.padding import group_signature
         ensure_started()
         m = self.model
         if labels is not None:
@@ -297,14 +409,34 @@ class ParallelWrapper:
             it = ArrayDataSetIterator(data.features, data.labels, batch_size)
         else:
             it = data
-
+        # listener capability scan hoisted out of the per-batch path
+        m._stash_features = any(getattr(l, "needs_batch_features", False)
+                                for l in m.listeners)
         try:
             for _ in range(epochs):
-                src = AsyncDataSetIterator(it, prefetch=self.prefetch_buffer) \
-                    if self.prefetch_buffer else it
+                # device prefetch serves the allreduce (SPMD) path only:
+                # the averaging round builds its [freq, dev*B] stack
+                # host-side, so pre-sharded device batches would force a
+                # D2H gather per round, and the divisibility trim would
+                # silently drop rows the averaging path trains on
+                if self.device_prefetch and \
+                        self.training_mode != "averaging":
+                    from deeplearning4j_tpu.pipeline.prefetch import \
+                        DevicePrefetchIterator
+                    src = DevicePrefetchIterator(
+                        it, prefetch=max(1, self.prefetch_buffer),
+                        mesh=self.mesh, data_axis="data",
+                        transform=self._trim_batch)
+                elif self.prefetch_buffer:
+                    src = AsyncDataSetIterator(it,
+                                               prefetch=self.prefetch_buffer)
+                else:
+                    src = it
                 averaging = self.training_mode == "averaging"
                 round_size = self.averaging_frequency * self.n_devices
+                k = self.steps_per_dispatch
                 pend = []
+                group, sig = [], None
                 src_it = iter(src)
                 while True:
                     with self._timer("etl"):
@@ -316,14 +448,27 @@ class ParallelWrapper:
                         if len(pend) == round_size:
                             self._fit_round_averaging(pend)  # times itself
                             pend = []
+                    elif k > 1:
+                        s = group_signature(ds)
+                        if group and s != sig:
+                            for b in group:  # unfusable run: per-batch
+                                self._fit_batch_allreduce(b)
+                            group = []
+                        sig = s
+                        group.append(ds)
+                        if len(group) == k:
+                            self._fit_group_allreduce(group)  # times itself
+                            group = []
                     else:
                         self._fit_batch_allreduce(ds)  # times itself
-                # trailing partial averaging round: allreduce steps
-                for ds in pend:
+                # trailing partial averaging round / scan group:
+                # allreduce per-batch steps
+                for ds in pend + group:
                     self._fit_batch_allreduce(ds)
                 m.epoch_count += 1
             # one allowed sync, after the final batch (see multilayer.fit)
             finalize_fit_telemetry(m)
         finally:
+            m._stash_features = None
             close_listeners(m.listeners)
         return m

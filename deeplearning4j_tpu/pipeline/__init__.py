@@ -1,0 +1,32 @@
+"""Input pipeline: device-side prefetch + tail-batch shape bucketing.
+
+The stages feeding the fused multi-step fit path (ISSUE 3):
+
+- `prefetch.DevicePrefetchIterator` — a bounded background stage that
+  `jax.device_put`s batches ahead of the consumer so H2D transfer
+  overlaps device compute (double/triple buffered; optional
+  NamedSharding for the mesh path), with queue-depth / bytes-moved
+  telemetry in the global metrics registry.
+- `padding.pad_batch` / `padding.with_example_weights` — pad the ragged
+  last batch of an epoch to the canonical batch shape with an
+  example-weight mask folded into the loss, so a whole fit shares ONE
+  compiled train-step shape (exact for row-wise layers; see padding.py
+  for the BatchNorm caveat).
+
+The fit loops (`nn/multilayer.py`, `nn/graph.py`, `parallel/wrapper.py`)
+wire both under ``fit(..., steps_per_dispatch=K, prefetch=depth)``.
+"""
+
+from deeplearning4j_tpu.pipeline.padding import (  # noqa: F401
+    example_weight_mask, group_signature, num_real_examples, pad_batch,
+    with_example_weights)
+from deeplearning4j_tpu.pipeline.prefetch import (  # noqa: F401
+    PREFETCH_BATCHES, PREFETCH_BYTES, PREFETCH_DEPTH,
+    DevicePrefetchIterator, prefetch_bytes_total)
+
+__all__ = [
+    "DevicePrefetchIterator", "PREFETCH_BATCHES", "PREFETCH_BYTES",
+    "PREFETCH_DEPTH", "example_weight_mask", "group_signature",
+    "num_real_examples", "pad_batch", "prefetch_bytes_total",
+    "with_example_weights",
+]

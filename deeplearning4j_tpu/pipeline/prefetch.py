@@ -1,0 +1,220 @@
+"""Device-side input pipeline: H2D prefetch ahead of the consumer.
+
+`AsyncDataSetIterator` (datasets/iterators.py — the seed's port of
+DL4J's ADSI) overlaps host ETL with device compute, but the host→device
+copy itself still happens synchronously at dispatch: the fit loop's
+`jnp.asarray(ds.features)` stages the transfer on the consumer thread
+while the accelerator idles. `DevicePrefetchIterator` moves the copy
+into a bounded background stage: a worker thread calls
+`jax.device_put` (optionally with a `NamedSharding` for the mesh path)
+`prefetch` batches ahead of the consumer, so the transfer for batches
+N+1..N+depth overlaps the compute of batch N — double/triple buffering
+by queue depth, the device-side half DL4J's MagicQueue did with
+device-affinity host buffers.
+
+The stop/sentinel/error protocol is deliberately IDENTICAL to
+AsyncDataSetIterator (tested for parity): bounded `put` with a stop
+check so an abandoned consumer can't pin the worker, a sentinel that
+carries end-of-stream, and base-iterator exceptions re-raised in the
+consumer.
+
+Telemetry (global metrics registry, monitoring/):
+
+- ``dl4jtpu_prefetch_queue_depth`` (gauge): batches currently staged on
+  device ahead of the consumer.
+- ``dl4jtpu_prefetch_h2d_bytes_total`` (counter): bytes handed to
+  `jax.device_put` by prefetch stages — the bench records carry this so
+  the perf trajectory shows how much transfer left the dispatch path.
+- ``dl4jtpu_prefetch_batches_total`` (counter): batches transferred.
+
+jax is imported lazily (first use) so constructing the iterator — or
+importing this module from a bench failure path — never initializes a
+backend.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, List, Optional, Union
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+from deeplearning4j_tpu.monitoring.metrics import (
+    MetricsRegistry, global_registry)
+from deeplearning4j_tpu.pipeline.padding import num_real_examples, pad_batch
+
+PREFETCH_DEPTH = "dl4jtpu_prefetch_queue_depth"
+PREFETCH_BYTES = "dl4jtpu_prefetch_h2d_bytes_total"
+PREFETCH_BATCHES = "dl4jtpu_prefetch_batches_total"
+
+__all__ = ["DevicePrefetchIterator", "PREFETCH_BATCHES", "PREFETCH_BYTES",
+           "PREFETCH_DEPTH", "prefetch_bytes_total"]
+
+
+def _nbytes(x) -> int:
+    if x is None:
+        return 0
+    if isinstance(x, dict):
+        return sum(_nbytes(v) for v in x.values())
+    n = getattr(x, "nbytes", None)
+    return int(n) if n is not None else 0
+
+
+def prefetch_bytes_total(registry: Optional[MetricsRegistry] = None) -> float:
+    """Total H2D bytes moved by prefetch stages this process (0.0 before
+    any ran). Pure registry read — safe on bench failure paths."""
+    r = registry or global_registry()
+    c = r.get(PREFETCH_BYTES)
+    if c is None:
+        return 0.0
+    try:
+        return float(c.value())
+    except Exception:  # noqa: BLE001 — a metrics read must never raise here
+        return 0.0
+
+
+class DevicePrefetchIterator(DataSetIterator):
+    """Background device-transfer stage over a base DataSetIterator.
+
+    Args:
+        base: the host-side iterator to consume.
+        prefetch: queue depth — how many batches may sit transferred (or
+            in flight) ahead of the consumer. 2 = double buffering.
+        mesh / data_axis: when given, every array is placed with
+            ``NamedSharding(mesh, P(data_axis, None, ...))`` so the
+            batch lands pre-sharded for SPMD fit loops (ParallelWrapper
+            allreduce mode) instead of being resharded at dispatch.
+        transform: optional host-side ``DataSet -> DataSet`` hook run in
+            the worker before the transfer (e.g. the wrapper's
+            mesh-divisibility trim).
+        pad_to: tail-batch bucketing in the pipeline stage: an int pads
+            every smaller batch to that row count (``pipeline.padding``
+            mask semantics); ``"auto"`` uses the first batch of each
+            pass as the canonical size. Padding here — BEFORE the
+            transfer — keeps the fit loop from ever padding
+            device-resident arrays (a D2H round-trip).
+        pad_when: optional host-side predicate gating `pad_to` per
+            batch (e.g. ComputationGraph's mask-shadowing exemption);
+            batches it rejects pass through ragged.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, base: DataSetIterator, prefetch: int = 2,
+                 mesh=None, data_axis: str = "data",
+                 transform: Optional[Callable[[DataSet], DataSet]] = None,
+                 pad_to: Union[int, str, None] = None,
+                 pad_when: Optional[Callable[[DataSet], bool]] = None,
+                 registry: Optional[MetricsRegistry] = None):
+        if prefetch < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {prefetch}")
+        if pad_to is not None and pad_to != "auto" and int(pad_to) < 1:
+            raise ValueError(f"pad_to must be >= 1 or 'auto', got {pad_to}")
+        self.base = base
+        self.prefetch = prefetch
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.transform = transform
+        self.pad_to = pad_to
+        self.pad_when = pad_when
+        self._registry = registry
+        self._last_thread: Optional[threading.Thread] = None
+
+    def reset(self):
+        self.base.reset()
+
+    # ------------------------------------------------------------------
+    def _sharding_for(self, arr):
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        spec = P(self.data_axis, *([None] * (np.ndim(arr) - 1)))
+        return NamedSharding(self.mesh, spec)
+
+    def _put(self, x):
+        """jax.device_put, dict-aware; the one H2D call of the stage."""
+        if x is None:
+            return None
+        if isinstance(x, dict):
+            return {k: self._put(v) for k, v in x.items()}
+        import jax
+        return jax.device_put(x, self._sharding_for(x))
+
+    def _stage(self, ds: DataSet) -> DataSet:
+        out = DataSet(self._put(ds.features), self._put(ds.labels),
+                      self._put(ds.features_mask), self._put(ds.labels_mask))
+        out.real_examples = num_real_examples(ds)
+        return out
+
+    # ------------------------------------------------------------------
+    def __iter__(self):
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        err: List[BaseException] = []
+        stop = threading.Event()
+        r = self._registry or global_registry()
+        depth = r.gauge(PREFETCH_DEPTH,
+                        "Batches staged on device ahead of the consumer")
+        h2d_bytes = r.counter(PREFETCH_BYTES,
+                              "Host->device bytes moved by prefetch stages")
+        batches = r.counter(PREFETCH_BATCHES,
+                            "Batches transferred by prefetch stages")
+        # canonical row count for this pass ("auto" resolves per pass so
+        # a re-iterated epoch re-locks onto its own first batch)
+        target = [self.pad_to if isinstance(self.pad_to, int) else None]
+
+        def worker():
+            try:
+                for ds in self.base:
+                    if self.transform is not None:
+                        ds = self.transform(ds)
+                    if self.pad_to is not None:
+                        if target[0] is None:
+                            target[0] = ds.num_examples()
+                        if ds.num_examples() < target[0] and (
+                                self.pad_when is None or self.pad_when(ds)):
+                            ds = pad_batch(ds, target[0])
+                    n = _nbytes(ds.features) + _nbytes(ds.labels) + \
+                        _nbytes(ds.features_mask) + _nbytes(ds.labels_mask)
+                    dev = self._stage(ds)
+                    h2d_bytes.inc(n)
+                    batches.inc()
+                    # bounded put with a stop check so an abandoned
+                    # consumer (early break) can't pin the worker forever
+                    while not stop.is_set():
+                        try:
+                            q.put(dev, timeout=0.1)
+                            depth.set(q.qsize())
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+            except BaseException as e:  # surface worker errors to consumer
+                err.append(e)
+            finally:
+                while not stop.is_set():
+                    try:
+                        q.put(self._SENTINEL, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+
+        t = threading.Thread(target=worker, daemon=True,
+                             name="device-prefetch")
+        self._last_thread = t
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                depth.set(q.qsize())
+                if item is self._SENTINEL:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            # generator closed (break/GC): release the worker thread
+            stop.set()

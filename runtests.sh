@@ -28,6 +28,12 @@ fi
 # (includes the no-new-retraces guard: instrumentation must not recompile)
 python -m pytest tests/test_monitoring.py -q -p no:cacheprovider
 
+# tier-1 input-pipeline lane: device prefetch + fused multi-step
+# dispatch (pipeline/, fit(steps_per_dispatch=K)) — the fused-vs-unfused
+# equivalence and zero-retrace-after-warmup contracts fail fast here
+# before the full suite runs
+python -m pytest tests/test_input_pipeline.py -q -p no:cacheprovider
+
 python -m pytest tests/ -q --junitxml=/tmp/dl4jtpu_junit.xml "$@"
 
 # only a FULL unfiltered run may overwrite the committed tally — a

@@ -94,6 +94,77 @@ class TestHostSyncRule:
 
 
 # ---------------------------------------------------------------------
+# rule: device-transfer-in-hot-loop
+# ---------------------------------------------------------------------
+class TestDeviceTransferRule:
+    def test_positive_asarray_and_device_put_in_per_batch_path(self,
+                                                               tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+
+            class Net:
+                def _fit_batch(self, ds):
+                    x = jnp.asarray(ds.features)
+                    y = jax.device_put(ds.labels)
+                    return self.step(x, y)
+        """)
+        assert _rules_of(fs) == ["device-transfer-in-hot-loop"] * 2
+
+    def test_positive_jnp_array_in_fit_loop(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import jax.numpy as jnp
+
+            def fit(model, batches):
+                for b in batches:
+                    model.step(jnp.array(b.features))
+        """)
+        assert _rules_of(fs) == ["device-transfer-in-hot-loop"]
+
+    def test_negative_outside_hot_path_and_constants(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+
+            def prepare(ds):
+                # not a fit/epoch hot path: staging here is fine
+                return jnp.asarray(ds.features)
+
+            class Net:
+                def _fit_batch(self, ds):
+                    pad = jnp.asarray(3)  # literal scalar, not a batch
+                    return self.step(ds, pad)
+
+            def fit(model, x):
+                x = jax.device_put(x)  # once, before the loop
+                for _ in range(3):
+                    model.step(x)
+        """)
+        assert fs == []
+
+    def test_negative_module_without_jax_is_exempt(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            def _fit_batch(self, ds):
+                return jnp.asarray(ds.features)
+        """)
+        assert fs == []
+
+    def test_suppression_and_baseline_cover_jit_boundary_remnants(
+            self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import jax.numpy as jnp
+
+            class Net:
+                def _fit_batch(self, ds):
+                    # compat path when prefetch is off
+                    # tpulint: disable=device-transfer-in-hot-loop
+                    x = jnp.asarray(ds.features)
+                    return self.step(x)
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------
 # rule: tracer-leak
 # ---------------------------------------------------------------------
 class TestTracerLeakRule:
@@ -471,7 +542,10 @@ class TestSelfScan:
 
     def test_every_rule_family_is_registered(self):
         assert {r.id for r in ALL_RULES} == {
-            "host-sync-in-hot-loop", "tracer-leak", "recompile-hazard",
+            "host-sync-in-hot-loop", "device-transfer-in-hot-loop",
+            "tracer-leak", "recompile-hazard",
             "dtype-promotion", "unlocked-thread-state", "bare-except",
             "mutable-default-arg"}
         assert RULES_BY_ID["host-sync-in-hot-loop"].severity == "error"
+        assert RULES_BY_ID["device-transfer-in-hot-loop"].severity == \
+            "warning"

@@ -1,0 +1,404 @@
+"""Input pipeline + fused multi-step dispatch (ISSUE 3).
+
+Three contracts:
+
+- `DevicePrefetchIterator` (pipeline/prefetch.py): ordering, reset /
+  re-iteration, early-`break` worker cleanup, and error-propagation
+  parity with the host-side `AsyncDataSetIterator` it extends.
+- Tail-batch shape bucketing (pipeline/padding.py): the padded batch's
+  example-weight mask makes score AND parameter updates exactly the
+  unpadded math.
+- `fit(..., steps_per_dispatch=K)`: the lax.scan-fused K-step path
+  trains allclose-identical to the per-batch loop for
+  MultiLayerNetwork, ComputationGraph and ParallelWrapper (incl. a
+  ragged tail), fires listeners once per LOGICAL step, and — the
+  acceptance bar — adds ZERO retraces after warmup across a 2-epoch
+  fit (PR 1 recompile watcher).
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitoring
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import (
+    ArrayDataSetIterator, AsyncDataSetIterator, DataSetIterator)
+from deeplearning4j_tpu.monitoring import runtime
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (
+    DenseLayer, LSTM, OutputLayer, RnnOutputLayer)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater import Adam, Sgd
+from deeplearning4j_tpu.optimize.listeners import (
+    CollectScoresIterationListener, TrainingListener)
+from deeplearning4j_tpu.pipeline import (
+    DevicePrefetchIterator, PREFETCH_BATCHES, PREFETCH_BYTES,
+    PREFETCH_DEPTH, example_weight_mask, num_real_examples, pad_batch,
+    prefetch_bytes_total, with_example_weights)
+
+RNG = np.random.default_rng(11)
+
+
+def xor_data(n=72):
+    x = RNG.random((n, 2)).astype(np.float32)
+    y_bit = ((x[:, 0] > 0.5) ^ (x[:, 1] > 0.5)).astype(int)
+    y = np.zeros((n, 2), np.float32)
+    y[np.arange(n), y_bit] = 1.0
+    return x, y
+
+
+def mlp(seed=42, updater=None):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(seed)
+            .updater(updater or Adam(learning_rate=0.01))
+            .weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=2, loss="mcxent", activation="softmax"))
+            .set_input_type(InputType.feed_forward(2))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def small_graph(seed=42):
+    b = (NeuralNetConfiguration.Builder()
+         .seed(seed)
+         .updater(Adam(learning_rate=0.01))
+         .weight_init("xavier")
+         .graph_builder()
+         .add_inputs("in")
+         .add_layer("d", DenseLayer(n_out=16, activation="relu"), "in")
+         .add_layer("out", OutputLayer(n_out=2, loss="mcxent",
+                                       activation="softmax"), "d")
+         .set_outputs("out")
+         .set_input_types(InputType.feed_forward(2)))
+    return ComputationGraph(b.build()).init()
+
+
+def lstm_net(seed=42):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(seed)
+            .updater(Adam(learning_rate=0.01))
+            .weight_init("xavier")
+            .list()
+            .layer(LSTM(n_out=8))
+            .layer(RnnOutputLayer(n_out=3, loss="mcxent",
+                                  activation="softmax"))
+            .set_input_type(InputType.recurrent(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def params_allclose(a, b, rtol=1e-5, atol=1e-6):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=rtol, atol=atol)
+
+
+class _FailingIterator(DataSetIterator):
+    """Yields one good batch, then raises — for error-propagation parity."""
+
+    def __init__(self):
+        x, y = xor_data(8)
+        self.good = DataSet(x, y)
+
+    def __iter__(self):
+        yield self.good
+        raise ValueError("decoder exploded")
+
+
+# ---------------------------------------------------------------------
+# DevicePrefetchIterator contract
+# ---------------------------------------------------------------------
+class TestDevicePrefetchIterator:
+    def test_order_values_and_device_residency(self):
+        x, y = xor_data(50)
+        base = ArrayDataSetIterator(x, y, 16)
+        pre = DevicePrefetchIterator(base, prefetch=2)
+        got = list(pre)
+        ref = list(base)
+        assert len(got) == len(ref) == 4  # 16,16,16,2
+        for g, r in zip(got, ref):
+            assert isinstance(g.features, jax.Array)
+            np.testing.assert_array_equal(np.asarray(g.features), r.features)
+            np.testing.assert_array_equal(np.asarray(g.labels), r.labels)
+
+    def test_reiteration_and_reset_delegate(self):
+        x, y = xor_data(32)
+        base = ArrayDataSetIterator(x, y, 16)
+        pre = DevicePrefetchIterator(base, prefetch=2)
+        first = [np.asarray(d.features) for d in pre]
+        pre.reset()
+        second = [np.asarray(d.features) for d in pre]
+        assert len(first) == len(second) == 2
+        for a, b in zip(first, second):
+            np.testing.assert_array_equal(a, b)
+
+    def test_early_break_releases_worker_thread(self):
+        x, y = xor_data(64)
+        pre = DevicePrefetchIterator(ArrayDataSetIterator(x, y, 4),
+                                     prefetch=1)
+        for _ in pre:
+            break  # abandon with the worker mid-stream
+        t = pre._last_thread
+        assert t is not None
+        t.join(timeout=5.0)
+        assert not t.is_alive(), "early break left the prefetch worker alive"
+
+    def test_error_propagation_parity_with_async_iterator(self):
+        with pytest.raises(ValueError, match="decoder exploded"):
+            list(AsyncDataSetIterator(_FailingIterator(), prefetch=2))
+        with pytest.raises(ValueError, match="decoder exploded"):
+            list(DevicePrefetchIterator(_FailingIterator(), prefetch=2))
+
+    def test_good_batches_before_error_still_arrive(self):
+        got = []
+        with pytest.raises(ValueError, match="decoder exploded"):
+            for ds in DevicePrefetchIterator(_FailingIterator(), prefetch=2):
+                got.append(ds)
+        assert len(got) == 1 and got[0].num_examples() == 8
+
+    def test_pad_to_auto_buckets_the_tail(self):
+        x, y = xor_data(40)  # 32 + ragged 8
+        pre = DevicePrefetchIterator(ArrayDataSetIterator(x, y, 32),
+                                     prefetch=2, pad_to="auto")
+        got = list(pre)
+        assert [d.num_examples() for d in got] == [32, 32]
+        tail = got[1]
+        assert num_real_examples(tail) == 8
+        lm = np.asarray(tail.labels_mask)
+        np.testing.assert_array_equal(lm[:8], 1.0)
+        np.testing.assert_array_equal(lm[8:], 0.0)
+
+    def test_telemetry_counters_advance(self):
+        r = monitoring.global_registry()
+        x, y = xor_data(48)
+        b0 = prefetch_bytes_total()
+        n0 = r.counter(PREFETCH_BATCHES).value()
+        list(DevicePrefetchIterator(ArrayDataSetIterator(x, y, 16),
+                                    prefetch=2))
+        moved = prefetch_bytes_total() - b0
+        assert moved >= x.nbytes + y.nbytes
+        assert r.counter(PREFETCH_BATCHES).value() - n0 == 3
+        assert r.get(PREFETCH_DEPTH) is not None
+        assert r.get(PREFETCH_BYTES) is not None
+
+    def test_invalid_depth_rejected(self):
+        x, y = xor_data(8)
+        with pytest.raises(ValueError):
+            DevicePrefetchIterator(ArrayDataSetIterator(x, y, 4), prefetch=0)
+
+
+# ---------------------------------------------------------------------
+# tail-batch padding semantics
+# ---------------------------------------------------------------------
+class TestTailPadding:
+    def test_pad_batch_shapes_and_mask(self):
+        x, y = xor_data(10)
+        ds = pad_batch(DataSet(x, y), 16)
+        assert ds.features.shape == (16, 2) and ds.labels.shape == (16, 2)
+        assert num_real_examples(ds) == 10
+        np.testing.assert_array_equal(ds.labels_mask[:10], 1.0)
+        np.testing.assert_array_equal(ds.labels_mask[10:], 0.0)
+        # padded rows replicate a REAL row (finite activations, masked)
+        np.testing.assert_array_equal(np.asarray(ds.features[10:]),
+                                      np.broadcast_to(x[0], (6, 2)))
+
+    def test_example_weight_mask_layouts(self):
+        assert example_weight_mask(np.zeros((5, 3))).shape == (5,)
+        assert example_weight_mask(np.zeros((5, 3, 7))).shape == (5, 7)
+        d = example_weight_mask({"a": np.zeros((4, 2))})
+        assert d["a"].shape == (4,)
+
+    def test_padded_score_equals_unpadded(self):
+        net = mlp()
+        x, y = xor_data(10)
+        s_plain = net.score(DataSet(x, y))
+        padded = pad_batch(DataSet(x, y), 16)
+        s_pad = net.score(padded)
+        assert s_pad == pytest.approx(s_plain, rel=1e-6)
+
+    def test_ones_mask_is_the_plain_mean(self):
+        net = mlp()
+        x, y = xor_data(16)
+        s_plain = net.score(DataSet(x, y))
+        s_ones = net.score(with_example_weights(DataSet(x, y)))
+        assert s_ones == pytest.approx(s_plain, rel=1e-6)
+
+    def test_padded_update_matches_unpadded(self):
+        """One padded _fit_batch steps params exactly like the ragged
+        batch (gradients of masked rows are exactly zero)."""
+        x, y = xor_data(10)
+        n1, n2 = mlp(), mlp()
+        n1._fit_batch(DataSet(x, y))
+        n2._fit_batch(pad_batch(DataSet(x, y), 16))
+        params_allclose(n1.params, n2.params)
+
+
+# ---------------------------------------------------------------------
+# fused K-step dispatch equivalence
+# ---------------------------------------------------------------------
+class TestFusedDispatchEquivalence:
+    def _fit_pair(self, make_net, k, n=72, batch=16, epochs=2):
+        x, y = xor_data(n)
+        n1, n2 = make_net(), make_net()
+        c1, c2 = (CollectScoresIterationListener(),
+                  CollectScoresIterationListener())
+        n1.set_listeners(c1)
+        n2.set_listeners(c2)
+        n1.fit(x, y, epochs=epochs, batch_size=batch)
+        n2.fit(x, y, epochs=epochs, batch_size=batch, steps_per_dispatch=k)
+        return n1, n2, c1, c2
+
+    def test_multilayer_scan_matches_per_batch_with_ragged_tail(self):
+        # 72 = 4*16 + 8: the tail is padded+masked on the fused path
+        n1, n2, c1, c2 = self._fit_pair(mlp, k=3)
+        assert len(c1.scores) == len(c2.scores) == 10
+        np.testing.assert_allclose([s for _, s in c1.scores],
+                                   [s for _, s in c2.scores],
+                                   rtol=1e-5, atol=1e-6)
+        params_allclose(n1.params, n2.params)
+
+    def test_multilayer_k2_divisible_epoch(self):
+        n1, n2, c1, c2 = self._fit_pair(mlp, k=2, n=64)
+        np.testing.assert_allclose([s for _, s in c1.scores],
+                                   [s for _, s in c2.scores],
+                                   rtol=1e-5, atol=1e-6)
+        params_allclose(n1.params, n2.params)
+
+    def test_graph_scan_matches_per_batch_with_ragged_tail(self):
+        n1, n2, c1, c2 = self._fit_pair(small_graph, k=3)
+        np.testing.assert_allclose([s for _, s in c1.scores],
+                                   [s for _, s in c2.scores],
+                                   rtol=1e-5, atol=1e-6)
+        params_allclose(n1.params, n2.params)
+
+    def test_sequence_net_scan_matches_per_batch(self):
+        """Stateful (LSTM) layers: stream carries are stripped from the
+        scan carry; params/losses still match the per-batch loop."""
+        x = RNG.standard_normal((24, 4, 5)).astype(np.float32)
+        cls = RNG.integers(0, 3, (24, 5))
+        y = np.zeros((24, 3, 5), np.float32)
+        y[np.arange(24)[:, None], cls, np.arange(5)[None, :]] = 1.0
+        n1, n2 = lstm_net(), lstm_net()
+        n1.fit(x, y, epochs=2, batch_size=8)
+        n2.fit(x, y, epochs=2, batch_size=8, steps_per_dispatch=3)
+        params_allclose(n1.params, n2.params)
+
+    def test_prefetched_fused_fit_matches(self):
+        x, y = xor_data(72)
+        n1, n2 = mlp(), mlp()
+        n1.fit(x, y, epochs=2, batch_size=16)
+        n2.fit(x, y, epochs=2, batch_size=16, steps_per_dispatch=3,
+               prefetch=2)
+        params_allclose(n1.params, n2.params)
+
+    def test_wrapper_scan_and_device_prefetch_match(self):
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+        x, y = xor_data(64)
+        w1 = ParallelWrapper(mlp(updater=Sgd(0.1)))
+        w2 = ParallelWrapper(mlp(updater=Sgd(0.1)), steps_per_dispatch=2)
+        w3 = ParallelWrapper(mlp(updater=Sgd(0.1)), steps_per_dispatch=2,
+                             device_prefetch=True)
+        for w in (w1, w2, w3):
+            w.fit(x, y, epochs=2, batch_size=16)
+        params_allclose(w1.model.params, w2.model.params)
+        params_allclose(w1.model.params, w3.model.params)
+
+
+# ---------------------------------------------------------------------
+# listener cadence on the fused path
+# ---------------------------------------------------------------------
+class _CadenceListener(TrainingListener):
+    def __init__(self):
+        self.iterations = []
+        self.batch_sizes = []
+
+    def record_batch(self, n):
+        self.batch_sizes.append(n)
+
+    def iteration_done(self, model, iteration, score):
+        self.iterations.append(iteration)
+
+
+class TestListenerCadence:
+    def test_listeners_fire_per_logical_step_with_real_counts(self):
+        x, y = xor_data(40)  # 16, 16, ragged 8
+        net = mlp()
+        lst = _CadenceListener()
+        net.set_listeners(lst)
+        net.fit(x, y, epochs=1, batch_size=16, steps_per_dispatch=2)
+        assert lst.iterations == [0, 1, 2]
+        # the padded tail reports its REAL row count, not the bucket
+        assert lst.batch_sizes == [16, 16, 8]
+        assert net.iteration_count == 3
+
+    def test_viz_stash_tracks_each_logical_step(self):
+        """needs_batch_features listeners must see THEIR step's batch on
+        the fused path, not the last batch of the dispatch group."""
+        class VizListener(TrainingListener):
+            needs_batch_features = True
+
+            def __init__(self):
+                self.first_rows = []
+
+            def iteration_done(self, model, iteration, score):
+                self.first_rows.append(
+                    np.asarray(model._last_batch_features[0]).copy())
+
+        x, y = xor_data(48)  # 3 full batches of 16
+        net = mlp()
+        lst = VizListener()
+        net.set_listeners(lst)
+        net.fit(x, y, epochs=1, batch_size=16, steps_per_dispatch=3)
+        assert len(lst.first_rows) == 3
+        for i, row in enumerate(lst.first_rows):
+            np.testing.assert_array_equal(row, x[16 * i])
+
+    def test_stash_flag_restored_after_fit(self):
+        x, y = xor_data(16)
+        net = mlp()
+        net.fit(x, y, epochs=1, batch_size=16)
+        assert net._stash_features is None  # direct _fit_batch still works
+        net._fit_batch(DataSet(x, y))
+
+
+# ---------------------------------------------------------------------
+# acceptance: zero retraces after warmup (PR 1 recompile watcher)
+# ---------------------------------------------------------------------
+def _compile_total():
+    c = monitoring.global_registry().get(runtime.COMPILE_COUNTER)
+    return 0.0 if c is None else c.total()
+
+
+class TestNoRetraceAcrossEpochs:
+    def test_fused_fit_with_ragged_tail_compiles_once(self):
+        monitoring.ensure_started()
+        x, y = xor_data(72)  # ragged tail every epoch
+        net = mlp()
+        net.fit(x, y, epochs=1, batch_size=16, steps_per_dispatch=3)
+        warm = _compile_total()
+        net.fit(x, y, epochs=2, batch_size=16, steps_per_dispatch=3)
+        assert _compile_total() == warm, (
+            "fused fit retraced after warmup — per-epoch recompile "
+            "regression")
+
+    def test_padded_k1_fit_shares_one_signature(self):
+        """pad_tail=True at K=1: full batches and the padded tail share
+        ONE compiled per-batch step (every batch carries the
+        example-weight mask)."""
+        monitoring.ensure_started()
+        x, y = xor_data(72)
+        net = mlp()
+        net.fit(x, y, epochs=1, batch_size=16, pad_tail=True)
+        warm = _compile_total()
+        net.fit(x, y, epochs=2, batch_size=16, pad_tail=True)
+        assert _compile_total() == warm
