@@ -1,8 +1,9 @@
 """tpulint rule registry.
 
 Rule families: host-sync, device-transfer (ISSUE 3), tracer-leak,
-recompile-hazard, dtype-promotion, concurrency, hygiene. Adding a rule =
-subclass `analysis.core.Rule`, instantiate it here.
+recompile-hazard, dtype-promotion, concurrency, hygiene, retry
+(ISSUE 4). Adding a rule = subclass `analysis.core.Rule`, instantiate
+it here.
 """
 
 from __future__ import annotations
@@ -19,6 +20,7 @@ from deeplearning4j_tpu.analysis.rules.dtype import DtypePromotionRule
 from deeplearning4j_tpu.analysis.rules.concurrency import ThreadSharedStateRule
 from deeplearning4j_tpu.analysis.rules.hygiene import (
     BareExceptRule, MutableDefaultRule)
+from deeplearning4j_tpu.analysis.rules.retry_loop import UnboundedRetryRule
 
 ALL_RULES: List[Rule] = [
     HostSyncRule(),
@@ -29,6 +31,7 @@ ALL_RULES: List[Rule] = [
     ThreadSharedStateRule(),
     BareExceptRule(),
     MutableDefaultRule(),
+    UnboundedRetryRule(),
 ]
 
 RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in ALL_RULES}

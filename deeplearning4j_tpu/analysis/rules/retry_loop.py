@@ -1,0 +1,114 @@
+"""unbounded-retry: a retry loop that can spin forever.
+
+The shape ``while True: try: ... except ...: time.sleep(k)`` turns a
+dead dependency into a hung process: no attempt ceiling, usually no
+backoff, and on a serving or ETL thread it pins the worker exactly when
+the operator needs it to fail loudly. The resilience layer's
+``resilience.retry.retry_call`` is the sanctioned replacement — bounded
+attempts, exponential backoff with jitter, and retry metrics.
+
+A loop is flagged when ALL of:
+
+- it is a ``while`` with a constant-true test (``while True:`` /
+  ``while 1:``) — condition-bounded loops (``while attempt < n``,
+  ``while not stop.is_set()``) and ``for`` loops over ``range`` are
+  bounded by construction;
+- it calls ``time.sleep`` somewhere in its body (the hallmark of a
+  wait-and-try-again loop, as opposed to a consumer poll);
+- it contains an exception handler that swallows and loops — no
+  ``raise``, ``break``, or ``return`` anywhere in the handler, which is
+  precisely the missing attempt bound (a handler that re-raises after
+  ``if attempts > limit`` is the bound). Only handlers whose NEAREST
+  enclosing loop is the while-True itself count: a bounded inner
+  ``for attempt in range(n)`` retry nested inside a legitimate daemon
+  loop belongs to the ``for``, not the daemon loop.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from deeplearning4j_tpu.analysis.core import (
+    Finding, ModuleInfo, Rule, SEVERITY_WARNING)
+
+
+def _const_true(test: ast.AST) -> bool:
+    return isinstance(test, ast.Constant) and bool(test.value)
+
+
+def _calls_sleep(mod: ModuleInfo, node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and \
+                mod.resolve(sub.func) == "time.sleep":
+            return True
+    return False
+
+
+def _bounds_the_loop(mod: ModuleInfo, stmt: ast.AST,
+                     handler: ast.ExceptHandler) -> bool:
+    """True if `stmt` actually bounds the retry loop the handler serves:
+    a ``break`` that exits the retry loop itself (not a nested for), a
+    ``return`` from the loop's own function (not a nested def), a
+    ``raise`` that propagates (not one inside a nested try that may
+    swallow it locally). Ownership = nothing of the capturing kind
+    between the statement and the handler."""
+    if isinstance(stmt, ast.Break):
+        blockers = (ast.For, ast.While, ast.AsyncFor)
+    elif isinstance(stmt, ast.Return):
+        blockers = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+    elif isinstance(stmt, ast.Raise):
+        blockers = (ast.Try,)
+    else:
+        return False
+    for a in mod.ancestors(stmt):
+        if a is handler:
+            return True
+        if isinstance(a, blockers):
+            return False
+    return False
+
+
+def _swallowing_handler(mod: ModuleInfo, loop: ast.While):
+    """First except handler BELONGING TO `loop` (nearest enclosing loop
+    is `loop` itself — a handler inside a nested bounded ``for`` is that
+    loop's business) with no raise/break/return that bounds the loop."""
+    for sub in ast.walk(loop):
+        if not isinstance(sub, ast.ExceptHandler):
+            continue
+        nearest = None
+        for a in mod.ancestors(sub):
+            if isinstance(a, (ast.For, ast.While, ast.AsyncFor)):
+                nearest = a
+                break
+        if nearest is not loop:
+            continue
+        if not any(_bounds_the_loop(mod, s, sub)
+                   for body in sub.body for s in ast.walk(body)):
+            return sub
+    return None
+
+
+class UnboundedRetryRule(Rule):
+    id = "unbounded-retry"
+    severity = SEVERITY_WARNING
+    description = ("while-True retry loop with time.sleep but no attempt "
+                   "bound; use resilience.retry.retry_call (bounded "
+                   "backoff + jitter)")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.While) or not _const_true(node.test):
+                continue
+            if not _calls_sleep(mod, node):
+                continue
+            handler = _swallowing_handler(mod, node)
+            if handler is None:
+                continue
+            yield self.finding(
+                mod, node,
+                "unbounded retry: `while True` + time.sleep with an "
+                "except handler that never raises/breaks — a dead "
+                "dependency hangs this thread forever; bound it with "
+                "resilience.retry.retry_call (max_attempts + "
+                "exponential backoff + jitter)")

@@ -26,14 +26,34 @@ import numpy as np
 from deeplearning4j_tpu.datasets.dataset import DataSet, one_hot as _one_hot
 from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
 from deeplearning4j_tpu.native import read_idx, u8_to_f32
+from deeplearning4j_tpu.resilience.retry import RetryPolicy, retry_call
 
 DEFAULT_DATA_DIR = os.environ.get(
     "DL4J_TPU_DATA_DIR", os.path.expanduser("~/.dl4jtpu/data"))
+
+#: dataset acquisition IO (decompress/read off a possibly-remote mount)
+#: retries transient OS errors with bounded backoff — the zero-egress
+#: stand-in for the reference fetchers' download retry
+_IO_RETRY = RetryPolicy(max_attempts=3, base_delay=0.1, max_delay=1.0,
+                        retry_on=(OSError,))
 
 MNIST_FILES = {
     True: ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
     False: ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
 }
+
+
+def _decompress(gz: str, path: str) -> None:
+    # decompress to a temp name then rename: an interrupted extraction
+    # must not leave a truncated file at the final path
+    tmp = path + f".tmp.{os.getpid()}"
+    try:
+        with gzip.open(gz, "rb") as fin, open(tmp, "wb") as fout:
+            shutil.copyfileobj(fin, fout)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 def _resolve(data_dir: Optional[str], name: str) -> str:
@@ -44,16 +64,11 @@ def _resolve(data_dir: Optional[str], name: str) -> str:
         return path
     gz = path + ".gz"
     if os.path.exists(gz):
-        # decompress to a temp name then rename: an interrupted extraction
-        # must not leave a truncated file at the final path
-        tmp = path + f".tmp.{os.getpid()}"
-        try:
-            with gzip.open(gz, "rb") as fin, open(tmp, "wb") as fout:
-                shutil.copyfileobj(fin, fout)
-            os.replace(tmp, path)
-        finally:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
+        # transient IO (slow NFS mount, a concurrent extractor racing the
+        # rename) retries with backoff; a genuinely bad archive still
+        # raises after the bounded attempts
+        retry_call(_decompress, gz, path, policy=_IO_RETRY,
+                   op="dataset-decompress")
         return path
     raise FileNotFoundError(
         f"dataset file {name!r} not found under {base!r}. This build is "

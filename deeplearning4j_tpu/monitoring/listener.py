@@ -95,6 +95,10 @@ def finalize_fit_telemetry(model) -> None:
     params = getattr(model, "params", None)
     if params is not None:
         jax.block_until_ready(params)
+    # settle the non-finite sentinel's pending flags (resilience/): the
+    # bad/skipped-step counters must be current once fit returns
+    from deeplearning4j_tpu.resilience.sentinel import flush_accounting
+    flush_accounting(model)
     if any(isinstance(l, MetricsListener)
            for l in getattr(model, "listeners", ())):
         return  # explicit listener owns publishing

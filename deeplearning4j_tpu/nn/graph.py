@@ -36,6 +36,8 @@ from deeplearning4j_tpu.nn.multilayer import _strip_stream_state, _tree_sub
 from deeplearning4j_tpu.optimize.listeners import close_listeners
 from deeplearning4j_tpu.pipeline.padding import (
     group_signature, num_real_examples, pad_batch, with_example_weights)
+from deeplearning4j_tpu.resilience.sentinel import (
+    apply_step, effective_policy, guard_updates, tree_finite)
 
 log = logging.getLogger(__name__)
 
@@ -65,6 +67,9 @@ class ComputationGraph(LazyScore):
         # listener capability flags, hoisted to fit-loop setup (None =
         # not inside fit(): _fit_batch recomputes for direct callers)
         self._stash_features: Optional[bool] = None
+        # non-finite sentinel policy override (None = process default;
+        # see resilience/sentinel.py)
+        self.nonfinite_policy: Optional[str] = None
 
     # ------------------------------------------------------------------
     # bn→act→conv1x1 fusion (execution-plan optimization, see
@@ -680,13 +685,16 @@ class ComputationGraph(LazyScore):
     # ------------------------------------------------------------------
     # training
     # ------------------------------------------------------------------
-    def _get_train_step(self, carry_rnn: bool):
+    def _get_train_step(self, carry_rnn: bool, policy: str = "off"):
+        """One jitted step — sentinel semantics as in
+        MultiLayerNetwork._get_train_step (5-tuple with a raw ok-flag
+        when policy != "off"; "skip" where-zeroes bad updates)."""
         if getattr(self, "_quantized", False):
             raise RuntimeError(
                 "this network was quantized for inference "
                 "(quantize_for_inference) — int8 weights have no "
                 "gradient path; train the fp checkpoint and re-quantize")
-        key = ("train", carry_rnn, self.conf.dtype)
+        key = ("train", carry_rnn, self.conf.dtype, policy)
         if key not in self._jit_cache:
             conf = self.conf
 
@@ -695,25 +703,33 @@ class ComputationGraph(LazyScore):
                     lambda p: self._loss(p, state, inputs, labels, rng, fmasks,
                                          lmasks, train=True, carry_rnn=carry_rnn),
                     has_aux=True)(params)
+                ok = None if policy == "off" else tree_finite(loss, grads)
                 grads = normalize_gradients(grads, conf.gradient_normalization,
                                             conf.gradient_normalization_threshold)
                 steps, new_upd = conf.updater.update(grads, upd_state, params)
-                return _tree_sub(params, steps), new_state, new_upd, loss
+                new_params = _tree_sub(params, steps)
+                if policy == "off":
+                    return new_params, new_state, new_upd, loss
+                new_params, new_upd, new_state = guard_updates(
+                    ok, policy, (new_params, params),
+                    (new_upd, upd_state), (new_state, state))
+                return new_params, new_state, new_upd, loss, ok
 
             self._jit_cache[key] = jax.jit(step, donate_argnums=(0, 2))
         return self._jit_cache[key]
 
-    def _get_scan_train_step(self, k: int):
+    def _get_scan_train_step(self, k: int, policy: str = "off"):
         """Fused multi-step dispatch — the ComputationGraph twin of
         MultiLayerNetwork._get_scan_train_step: K optimizer updates in
         one jitted, buffer-donating lax.scan over stacked (dict-keyed)
-        batches, returning the per-step loss vector."""
+        batches, returning the per-step loss vector (plus the per-step
+        sentinel ok-flags when policy != "off")."""
         if getattr(self, "_quantized", False):
             raise RuntimeError(
                 "this network was quantized for inference "
                 "(quantize_for_inference) — int8 weights have no "
                 "gradient path; train the fp checkpoint and re-quantize")
-        key = ("scan", k, self.conf.dtype)
+        key = ("scan", k, self.conf.dtype, policy)
         if key not in self._jit_cache:
             conf = self.conf
 
@@ -725,31 +741,42 @@ class ComputationGraph(LazyScore):
                         lambda pp: self._loss(pp, s, ins, lbs, rng, fm, lm,
                                               train=True),
                         has_aux=True)(p)
+                    ok = None if policy == "off" else \
+                        tree_finite(loss, grads)
                     grads = normalize_gradients(
                         grads, conf.gradient_normalization,
                         conf.gradient_normalization_threshold)
                     steps, u2 = conf.updater.update(grads, u, p)
-                    return (_tree_sub(p, steps), _strip_stream_state(s2),
-                            u2), loss
+                    p2 = _tree_sub(p, steps)
+                    s2 = _strip_stream_state(s2)
+                    if policy != "off":
+                        p2, u2, s2 = guard_updates(
+                            ok, policy, (p2, p), (u2, u), (s2, s))
+                    out = loss if policy == "off" else (loss, ok)
+                    return (p2, s2, u2), out
 
-                (p, s, u), losses = jax.lax.scan(
+                (p, s, u), out = jax.lax.scan(
                     one, (params, _strip_stream_state(state), upd_state),
                     (xs, ys, rngs, fmasks, lmasks))
-                return p, s, u, losses
+                if policy == "off":
+                    return p, s, u, out
+                losses, oks = out
+                return p, s, u, losses, oks
 
             self._jit_cache[key] = jax.jit(stepk, donate_argnums=(0, 2))
         return self._jit_cache[key]
 
-    def _get_phase_steps(self, carry_rnn: bool):
+    def _get_phase_steps(self, carry_rnn: bool, policy: str = "off"):
         """Split train step for span phase detail — the ComputationGraph
         twin of MultiLayerNetwork._get_phase_steps (see its docstring for
-        the vjp-across-jit pattern and the fusion-cost tradeoff)."""
+        the vjp-across-jit pattern, the fusion-cost tradeoff, and the
+        debug-path sentinel caveat)."""
         if getattr(self, "_quantized", False):
             raise RuntimeError(
                 "this network was quantized for inference "
                 "(quantize_for_inference) — int8 weights have no "
                 "gradient path; train the fp checkpoint and re-quantize")
-        key = ("phase", carry_rnn, self.conf.dtype)
+        key = ("phase", carry_rnn, self.conf.dtype, policy)
         if key not in self._jit_cache:
             conf = self.conf
 
@@ -766,9 +793,16 @@ class ComputationGraph(LazyScore):
                 return normalize_gradients(grads, conf.gradient_normalization,
                                            conf.gradient_normalization_threshold)
 
-            def upd(params, grads, upd_state):
+            def upd(params, grads, upd_state, loss, state, new_state):
                 steps, new_upd = conf.updater.update(grads, upd_state, params)
-                return _tree_sub(params, steps), new_upd
+                new_params = _tree_sub(params, steps)
+                if policy == "off":
+                    return new_params, new_upd, new_state
+                ok = tree_finite(loss, grads)
+                new_params, new_upd, new_state = guard_updates(
+                    ok, policy, (new_params, params),
+                    (new_upd, upd_state), (new_state, state))
+                return new_params, new_upd, new_state, ok
 
             self._jit_cache[key] = (jax.jit(fwd), jax.jit(bwd),
                                     jax.jit(upd, donate_argnums=(1, 2)))
@@ -904,11 +938,14 @@ class ComputationGraph(LazyScore):
             ys = stack_dicts(lbs)
             fmasks = stack_dicts(fms)
             lmasks = stack_dicts(lms)
-        step = self._get_scan_train_step(k)
+        policy = effective_policy(self)
+        step = self._get_scan_train_step(k, policy)
         with span("step"):
-            self.params, self.state, self.updater_state, losses = step(
-                self.params, self.state, self.updater_state,
-                xs, ys, rngs, fmasks, lmasks)
+            # apply_step absorbs the [K] sentinel flag vector (recorded
+            # lazily — accounting syncs at its own cadence)
+            self.params, self.state, self.updater_state, losses = \
+                apply_step(self, policy, step, self.params, self.state,
+                           self.updater_state, xs, ys, rngs, fmasks, lmasks)
         # raw device scalar: float() (the host sync) deferred to access
         self.score_value = losses[-1]
         with span("listener"):
@@ -950,24 +987,26 @@ class ComputationGraph(LazyScore):
             fmasks = self._as_mask_dict(ds.features_mask)
             lmasks = self._as_mask_dict(ds.labels_mask,
                                         default_key=self.conf.network_outputs[0])
+        policy = effective_policy(self)
         if phase_detail() and not getattr(self, "_quantized", False):
             # dispatch-time spans, no device barrier: see multilayer.py
-            fwd, bwd, upd = self._get_phase_steps(False)
+            fwd, bwd, upd = self._get_phase_steps(False, policy)
             with span("forward"):
                 loss, new_state, vjp_fn = fwd(self.params, self.state, inputs,
                                               labels, rng, fmasks, lmasks)
             with span("backward"):
                 grads = bwd(vjp_fn, loss)
             with span("update"):
-                self.params, self.updater_state = upd(
-                    self.params, grads, self.updater_state)
-            self.state = new_state
+                self.params, self.updater_state, self.state = apply_step(
+                    self, policy, upd, self.params, grads,
+                    self.updater_state, loss, self.state, new_state)
         else:
-            step = self._get_train_step(False)
+            step = self._get_train_step(False, policy)
             with span("step"):
-                self.params, self.state, self.updater_state, loss = step(
-                    self.params, self.state, self.updater_state, inputs,
-                    labels, rng, fmasks, lmasks)
+                self.params, self.state, self.updater_state, loss = \
+                    apply_step(self, policy, step, self.params, self.state,
+                               self.updater_state, inputs, labels, rng,
+                               fmasks, lmasks)
         # raw device scalar: float() (the host sync) deferred to access
         self.score_value = loss
         with span("listener"):

@@ -45,6 +45,8 @@ from deeplearning4j_tpu.monitoring.tracing import phase_detail, span
 from deeplearning4j_tpu.optimize.listeners import close_listeners
 from deeplearning4j_tpu.pipeline.padding import (
     group_signature, num_real_examples, pad_batch, with_example_weights)
+from deeplearning4j_tpu.resilience.sentinel import (
+    apply_step, effective_policy, guard_updates, tree_finite)
 
 log = logging.getLogger(__name__)
 
@@ -85,6 +87,9 @@ class MultiLayerNetwork(LazyScore):
         # listener capability flags, hoisted to fit-loop setup (None =
         # not inside fit(): _fit_batch recomputes for direct callers)
         self._stash_features: Optional[bool] = None
+        # non-finite sentinel policy override (None = process default;
+        # see resilience/sentinel.py)
+        self.nonfinite_policy: Optional[str] = None
 
     # ------------------------------------------------------------------
     # init
@@ -268,15 +273,22 @@ class MultiLayerNetwork(LazyScore):
     # ------------------------------------------------------------------
     # jitted steps (cached per (carry_rnn, mask presence) signature)
     # ------------------------------------------------------------------
-    def _get_train_step(self, carry_rnn: bool):
+    def _get_train_step(self, carry_rnn: bool, policy: str = "off"):
+        """One jitted optimizer step. With the non-finite sentinel
+        (policy "skip"/"record" — resilience/sentinel.py) the step also
+        returns a raw device ok-flag, and under "skip" a bad step
+        applies a where-zeroed update: params/opt-state/BN-stats keep
+        their pre-step values, all on device, no host sync. Returns a
+        4-tuple under "off" (the pre-resilience contract bench.py and
+        the distributed workers rely on), a 5-tuple otherwise."""
         if getattr(self, "_quantized", False):
             raise RuntimeError(
                 "this network was quantized for inference "
                 "(quantize_for_inference) — int8 weights have no "
                 "gradient path; train the fp checkpoint and re-quantize")
         # conf.dtype is baked into the trace: key it (stale compiled
-        # steps would silently keep the old precision)
-        key = ("train", carry_rnn, self.conf.dtype)
+        # steps would silently keep the old precision); ditto policy
+        key = ("train", carry_rnn, self.conf.dtype, policy)
         if key not in self._jit_cache:
             conf = self.conf
 
@@ -285,6 +297,9 @@ class MultiLayerNetwork(LazyScore):
                     lambda p: self._loss(p, state, x, y, rng, fmask, lmask,
                                          train=True, carry_rnn=carry_rnn),
                     has_aux=True)(params)
+                # sentinel reads RAW grads: normalization (clipping)
+                # must not mask an Inf by rescaling it
+                ok = None if policy == "off" else tree_finite(loss, grads)
                 grads = normalize_gradients(grads, conf.gradient_normalization,
                                             conf.gradient_normalization_threshold)
                 steps, new_upd = conf.updater.update(grads, upd_state, params)
@@ -293,12 +308,17 @@ class MultiLayerNetwork(LazyScore):
                     from deeplearning4j_tpu.nn.conf.constraints import \
                         apply_constraints
                     new_params = apply_constraints(self.layers, new_params)
-                return new_params, new_state, new_upd, loss
+                if policy == "off":
+                    return new_params, new_state, new_upd, loss
+                new_params, new_upd, new_state = guard_updates(
+                    ok, policy, (new_params, params),
+                    (new_upd, upd_state), (new_state, state))
+                return new_params, new_state, new_upd, loss, ok
 
             self._jit_cache[key] = jax.jit(step, donate_argnums=(0, 2))
         return self._jit_cache[key]
 
-    def _get_scan_train_step(self, k: int):
+    def _get_scan_train_step(self, k: int, policy: str = "off"):
         """Fused multi-step dispatch: K optimizer steps in ONE jitted,
         buffer-donating call via lax.scan over stacked batches
         ([K, B, ...]), returning the per-step loss vector as a single
@@ -306,13 +326,19 @@ class MultiLayerNetwork(LazyScore):
         body, so K Python→XLA round-trips (and K listener-side dispatch
         gaps) collapse into one — the micro-batch fusion μ-cuDNN applies
         to framework overhead (PAPERS.md). Streaming carries are
-        stripped from the scanned state (see _strip_stream_state)."""
+        stripped from the scanned state (see _strip_stream_state).
+
+        With the non-finite sentinel on (policy != "off") each scan
+        iteration checks its own loss/grads and (under "skip") zeroes
+        its own update, so one poisoned batch cannot corrupt the other
+        K-1 fused steps; the per-step ok-flags come back as a [K] device
+        vector alongside the losses."""
         if getattr(self, "_quantized", False):
             raise RuntimeError(
                 "this network was quantized for inference "
                 "(quantize_for_inference) — int8 weights have no "
                 "gradient path; train the fp checkpoint and re-quantize")
-        key = ("scan", k, self.conf.dtype)
+        key = ("scan", k, self.conf.dtype, policy)
         if key not in self._jit_cache:
             conf = self.conf
 
@@ -324,6 +350,8 @@ class MultiLayerNetwork(LazyScore):
                         lambda pp: self._loss(pp, s, x, y, rng, fm, lm,
                                               train=True, carry_rnn=False),
                         has_aux=True)(p)
+                    ok = None if policy == "off" else \
+                        tree_finite(loss, grads)
                     grads = normalize_gradients(
                         grads, conf.gradient_normalization,
                         conf.gradient_normalization_threshold)
@@ -334,17 +362,25 @@ class MultiLayerNetwork(LazyScore):
                         from deeplearning4j_tpu.nn.conf.constraints import \
                             apply_constraints
                         p2 = apply_constraints(self.layers, p2)
-                    return (p2, _strip_stream_state(s2), u2), loss
+                    s2 = _strip_stream_state(s2)
+                    if policy != "off":
+                        p2, u2, s2 = guard_updates(
+                            ok, policy, (p2, p), (u2, u), (s2, s))
+                    out = loss if policy == "off" else (loss, ok)
+                    return (p2, s2, u2), out
 
-                (p, s, u), losses = jax.lax.scan(
+                (p, s, u), out = jax.lax.scan(
                     one, (params, _strip_stream_state(state), upd_state),
                     (xs, ys, rngs, fmasks, lmasks))
-                return p, s, u, losses
+                if policy == "off":
+                    return p, s, u, out
+                losses, oks = out
+                return p, s, u, losses, oks
 
             self._jit_cache[key] = jax.jit(stepk, donate_argnums=(0, 2))
         return self._jit_cache[key]
 
-    def _get_phase_steps(self, carry_rnn: bool):
+    def _get_phase_steps(self, carry_rnn: bool, policy: str = "off"):
         """Split train step for span phase detail
         (monitoring.set_phase_detail): forward (vjp residuals), backward
         (vjp apply + grad normalization), update (updater + constraints)
@@ -352,13 +388,19 @@ class MultiLayerNetwork(LazyScore):
         real device timings. Same math as _get_train_step —
         value_and_grad IS vjp — but the seams cost cross-phase XLA fusion
         and materialize the residuals, so the fused step stays the
-        default for production throughput."""
+        default for production throughput.
+
+        Sentinel caveat on this debug path: the flag is computed from
+        the NORMALIZED grads (the raw ones live only inside bwd) — the
+        fused step, which tests the raw grads, is the exact-semantics
+        path. The state leg (BN running stats) IS guarded: upd receives
+        the pre/post state and where-selects it with params/opt."""
         if getattr(self, "_quantized", False):
             raise RuntimeError(
                 "this network was quantized for inference "
                 "(quantize_for_inference) — int8 weights have no "
                 "gradient path; train the fp checkpoint and re-quantize")
-        key = ("phase", carry_rnn, self.conf.dtype)
+        key = ("phase", carry_rnn, self.conf.dtype, policy)
         if key not in self._jit_cache:
             conf = self.conf
 
@@ -374,14 +416,20 @@ class MultiLayerNetwork(LazyScore):
                 return normalize_gradients(grads, conf.gradient_normalization,
                                            conf.gradient_normalization_threshold)
 
-            def upd(params, grads, upd_state):
+            def upd(params, grads, upd_state, loss, state, new_state):
                 steps, new_upd = conf.updater.update(grads, upd_state, params)
                 new_params = _tree_sub(params, steps)
                 if any(getattr(l, "constraints", None) for l in self.layers):
                     from deeplearning4j_tpu.nn.conf.constraints import \
                         apply_constraints
                     new_params = apply_constraints(self.layers, new_params)
-                return new_params, new_upd
+                if policy == "off":
+                    return new_params, new_upd, new_state
+                ok = tree_finite(loss, grads)
+                new_params, new_upd, new_state = guard_updates(
+                    ok, policy, (new_params, params),
+                    (new_upd, upd_state), (new_state, state))
+                return new_params, new_upd, new_state, ok
 
             self._jit_cache[key] = (jax.jit(fwd), jax.jit(bwd),
                                     jax.jit(upd, donate_argnums=(1, 2)))
@@ -563,11 +611,14 @@ class MultiLayerNetwork(LazyScore):
                 jnp.stack([b.features_mask for b in group])
             lmasks = None if group[0].labels_mask is None else \
                 jnp.stack([b.labels_mask for b in group])
-        step = self._get_scan_train_step(k)
+        policy = effective_policy(self)
+        step = self._get_scan_train_step(k, policy)
         with span("step"):
-            self.params, self.state, self.updater_state, losses = step(
-                self.params, self.state, self.updater_state,
-                xs, ys, rngs, fmasks, lmasks)
+            # apply_step absorbs the [K] sentinel flag vector (recorded
+            # lazily — accounting syncs at its own cadence)
+            self.params, self.state, self.updater_state, losses = \
+                apply_step(self, policy, step, self.params, self.state,
+                           self.updater_state, xs, ys, rngs, fmasks, lmasks)
         # raw device scalar: float() (the host sync) deferred to access
         self.score_value = losses[-1]
         with span("listener"):
@@ -604,26 +655,27 @@ class MultiLayerNetwork(LazyScore):
             lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
             x = jnp.asarray(ds.features)
             y = jnp.asarray(ds.labels)
+        policy = effective_policy(self)
         if phase_detail() and not getattr(self, "_quantized", False):
             # spans time DISPATCH per phase (async: the device may still
             # be executing) — no block_until_ready here, the fit loop's
             # steady state must never stall the pipeline
-            fwd, bwd, upd = self._get_phase_steps(carry_rnn)
+            fwd, bwd, upd = self._get_phase_steps(carry_rnn, policy)
             with span("forward"):
                 loss, new_state, vjp_fn = fwd(self.params, self.state, x, y,
                                               rng, fmask, lmask)
             with span("backward"):
                 grads = bwd(vjp_fn, loss)
             with span("update"):
-                self.params, self.updater_state = upd(
-                    self.params, grads, self.updater_state)
-            self.state = new_state
+                self.params, self.updater_state, self.state = apply_step(
+                    self, policy, upd, self.params, grads,
+                    self.updater_state, loss, self.state, new_state)
         else:
-            step = self._get_train_step(carry_rnn)
+            step = self._get_train_step(carry_rnn, policy)
             with span("step"):
-                self.params, self.state, self.updater_state, loss = step(
-                    self.params, self.state, self.updater_state,
-                    x, y, rng, fmask, lmask)
+                self.params, self.state, self.updater_state, loss = \
+                    apply_step(self, policy, step, self.params, self.state,
+                               self.updater_state, x, y, rng, fmask, lmask)
         # raw device scalar: float() (the host sync) deferred to access
         self.score_value = loss
         with span("listener"):

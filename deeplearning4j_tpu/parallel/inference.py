@@ -7,28 +7,72 @@ queue; here ONE jitted forward serves the whole mesh — large batches are
 sharded across devices (XLA SPMD), and a background batching thread provides
 the same dynamic request-coalescing (InferenceMode.BATCHED, :52) for many
 small concurrent requests.
+
+Serving robustness (resilience layer):
+
+- **Deadlines**: ``output(x, timeout=s)`` bounds the request end-to-end
+  on the host side — queue admission, coalescing wait, and result wait
+  all draw from one budget; expiry raises ``InferenceTimeout`` and
+  increments ``dl4jtpu_serving_deadline_exceeded_total``. The device
+  dispatch itself is not preempted (XLA programs run to completion) —
+  an abandoned request's result is simply dropped.
+- **Queue-full policy**: ``queue_policy="block"`` (default — callers
+  wait for space, bounded by their deadline) or ``"fail_fast"``
+  (``ServingQueueFull`` immediately; the load-shedding mode a
+  latency-SLO front end wants).
+- **Health/readiness**: ``health()`` plus registry gauges
+  ``dl4jtpu_serving_healthy`` / ``dl4jtpu_serving_ready`` /
+  ``dl4jtpu_serving_queue_depth`` (scrape-time callbacks — a crashed
+  worker flips them with no event needed) and request/error counters.
+- **No hung callers**: a model exception fails every coalesced waiter
+  with the original error; a dying worker thread fail-fasts everything
+  queued; requests arriving after shutdown are refused.
 """
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
+import time
+import weakref
 from typing import Any, List, Optional
 
 import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu.monitoring.metrics import (
+    MetricsRegistry, global_registry)
 from deeplearning4j_tpu.parallel.mesh import default_mesh
+
+log = logging.getLogger(__name__)
+
+SERVING_HEALTHY = "dl4jtpu_serving_healthy"
+SERVING_READY = "dl4jtpu_serving_ready"
+SERVING_QUEUE_DEPTH = "dl4jtpu_serving_queue_depth"
+SERVING_REQUESTS = "dl4jtpu_serving_requests_total"
+SERVING_ERRORS = "dl4jtpu_serving_errors_total"
+SERVING_DEADLINE_EXCEEDED = "dl4jtpu_serving_deadline_exceeded_total"
+SERVING_QUEUE_REJECTED = "dl4jtpu_serving_queue_rejected_total"
+
+
+class InferenceTimeout(TimeoutError):
+    """A per-request deadline expired before a result was ready."""
+
+
+class ServingQueueFull(RuntimeError):
+    """fail_fast admission control rejected a request (queue at limit)."""
 
 
 class _Request:
-    __slots__ = ("x", "event", "result")
+    __slots__ = ("x", "event", "result", "abandoned")
 
     def __init__(self, x):
         self.x = x
         self.event = threading.Event()
         self.result = None
+        self.abandoned = False  # deadline expired; worker may skip it
 
 
 class ParallelInference:
@@ -41,11 +85,16 @@ class ParallelInference:
 
     def __init__(self, model, mesh=None, max_batch_size: int = 64,
                  queue_limit: int = 64, batch_timeout_ms: float = 2.0,
-                 inference_mode: str = "batched"):
+                 inference_mode: str = "batched",
+                 queue_policy: str = "block",
+                 registry: Optional[MetricsRegistry] = None):
         if inference_mode not in ("batched", "sequential"):
             raise ValueError(
                 f"inference_mode must be 'batched' or 'sequential', got "
                 f"{inference_mode!r} (ref: ParallelInference.InferenceMode)")
+        if queue_policy not in ("block", "fail_fast"):
+            raise ValueError(f"queue_policy must be 'block' or 'fail_fast', "
+                             f"got {queue_policy!r}")
         self.model = model
         if not model._initialized:
             model.init()
@@ -54,6 +103,8 @@ class ParallelInference:
         self.max_batch_size = max_batch_size
         self.batch_timeout = batch_timeout_ms / 1000.0
         self.inference_mode = inference_mode
+        self.queue_policy = queue_policy
+        self._registry = registry
         # stop signal is an Event (atomic, visible cross-thread), not a
         # bare bool mutated from the caller thread
         self._stop = threading.Event()
@@ -73,74 +124,237 @@ class ParallelInference:
             # single-stream latency is one dispatch, not dispatch+timeout
             self._queue = None
             self._worker = None
+        self._register_health_gauges()
 
     # ------------------------------------------------------------------
-    def _run_batch(self, x: np.ndarray):
+    # health / readiness
+    # ------------------------------------------------------------------
+    def is_healthy(self) -> bool:
+        """The serving loop can still produce results."""
+        if self._stop.is_set():
+            return False
+        if self.inference_mode == "sequential":
+            return True
+        return self._worker is not None and self._worker.is_alive()
+
+    def is_ready(self) -> bool:
+        """Healthy AND able to admit a request right now."""
+        if not self.is_healthy():
+            return False
+        return self._queue is None or not self._queue.full()
+
+    def queue_depth(self) -> int:
+        return 0 if self._queue is None else self._queue.qsize()
+
+    def health(self) -> dict:
+        """Readiness-probe payload (the UIServer /metrics companion)."""
+        return {"healthy": self.is_healthy(), "ready": self.is_ready(),
+                "queue_depth": self.queue_depth(),
+                "mode": self.inference_mode}
+
+    def _register_health_gauges(self) -> None:
+        r = self._registry or global_registry()
+        name = type(self.model).__name__
+        # labeled counter handles resolved ONCE: the hot path must not
+        # re-enter the registry's get-or-create lock per request
+        self._counter_handles = {
+            metric: r.counter(metric, help, ("model",)).labels(model=name)
+            for metric, help in (
+                (SERVING_REQUESTS, "Serving requests received"),
+                (SERVING_ERRORS, "Serving requests failed by model errors"),
+                (SERVING_DEADLINE_EXCEEDED,
+                 "Requests that outlived their deadline"),
+                (SERVING_QUEUE_REJECTED,
+                 "Requests rejected by fail_fast admission"),
+            )}
+        # scrape-time callbacks: a crashed worker flips healthy/ready on
+        # the next scrape with no event having fired. One serving stack
+        # per model class per registry; a newer instance takes the series.
+        # The callbacks hold a WEAK ref — a registry series must not pin
+        # a shut-down server (and its device params) alive forever; a
+        # collected instance scrapes as down/empty.
+        ref = weakref.ref(self)
+
+        def probe(fn, default=0.0):
+            def read():
+                inst = ref()
+                return default if inst is None else float(fn(inst))
+            return read
+
+        r.gauge(SERVING_HEALTHY, "Serving loop alive (1) or down (0)",
+                ("model",)).set_function(
+            probe(lambda s: 1.0 if s.is_healthy() else 0.0), model=name)
+        r.gauge(SERVING_READY, "Serving admitting requests (1) or not (0)",
+                ("model",)).set_function(
+            probe(lambda s: 1.0 if s.is_ready() else 0.0), model=name)
+        r.gauge(SERVING_QUEUE_DEPTH, "Requests waiting in the batching "
+                "queue", ("model",)).set_function(
+            probe(lambda s: s.queue_depth()), model=name)
+
+    def _counter(self, metric: str) -> None:
+        self._counter_handles[metric].inc()
+
+    # ------------------------------------------------------------------
+    def _run_batch(self, x: np.ndarray, deadline: Optional[float] = None):
         n = x.shape[0]
         rem = n % self.n_devices
         if rem:
             pad = self.n_devices - rem
             x = np.concatenate([x, np.repeat(x[-1:], pad, axis=0)], axis=0)
         sh = NamedSharding(self.mesh, P("data", *([None] * (x.ndim - 1))))
-        with self._seq_lock:
+        if deadline is None:
+            acquired = self._seq_lock.acquire()
+        else:
+            # the lock wait (another caller's dispatch) draws from the
+            # request budget; the device program itself runs to completion
+            acquired = self._seq_lock.acquire(
+                timeout=max(0.0, deadline - time.monotonic()))
+        if not acquired:
+            self._counter(SERVING_DEADLINE_EXCEEDED)
+            raise InferenceTimeout(
+                "deadline expired waiting for the model lock")
+        try:
             out = self.model.output(jax.device_put(x, sh))
+        finally:
+            self._seq_lock.release()
         # host materialization is the serving response contract here, not
         # a pipeline stall: the caller blocks on this result by design
         return np.asarray(out)[:n]
 
     def _serve_loop(self):
-        while not self._stop.is_set():
-            try:
-                first = self._queue.get(timeout=0.1)
-            except queue.Empty:
-                continue
-            batch: List[_Request] = [first]
-            total = first.x.shape[0]
-            # coalesce whatever arrives within the timeout window
-            deadline = self.batch_timeout
-            while total < self.max_batch_size:
+        try:
+            while not self._stop.is_set():
                 try:
-                    nxt = self._queue.get(timeout=deadline)
-                    batch.append(nxt)
-                    total += nxt.x.shape[0]
+                    first = self._queue.get(timeout=0.1)
                 except queue.Empty:
-                    break
-            x = np.concatenate([r.x for r in batch], axis=0)
+                    continue
+                batch: List[_Request] = [first]
+                total = first.x.shape[0]
+                # coalesce whatever arrives within the timeout window
+                deadline = self.batch_timeout
+                while total < self.max_batch_size:
+                    try:
+                        nxt = self._queue.get(timeout=deadline)
+                        batch.append(nxt)
+                        total += nxt.x.shape[0]
+                    except queue.Empty:
+                        break
+                # deadline-expired waiters are gone; don't burn a
+                # dispatch on a batch nobody is waiting for
+                batch = [r for r in batch if not r.abandoned]
+                if not batch:
+                    continue
+                try:
+                    # assembly INSIDE the guard: one malformed request
+                    # (mismatched shapes) fails ITS batch's waiters, it
+                    # must not kill the serving loop for everyone after
+                    x = np.concatenate([r.x for r in batch], axis=0)
+                    out = self._run_batch(x)
+                    s = 0
+                    for r in batch:
+                        k = r.x.shape[0]
+                        r.result = out[s:s + k]
+                        s += k
+                except Exception as e:  # propagate to all waiters
+                    self._counter(SERVING_ERRORS)
+                    for r in batch:
+                        r.result = e
+                for r in batch:
+                    r.event.set()
+        finally:
+            # worker exiting for ANY reason (shutdown or crash): nothing
+            # will answer the queue anymore — fail leftovers fast rather
+            # than letting callers block to their deadlines
+            self._stop.set()
+            self._fail_pending(RuntimeError("ParallelInference worker "
+                                            "stopped"))
+
+    def _fail_pending(self, exc: Exception) -> None:
+        if self._queue is None:
+            return
+        while True:
             try:
-                out = self._run_batch(x)
-                s = 0
-                for r in batch:
-                    k = r.x.shape[0]
-                    r.result = out[s:s + k]
-                    s += k
-            except Exception as e:  # propagate to all waiters
-                for r in batch:
-                    r.result = e
-            for r in batch:
-                r.event.set()
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            req.result = exc
+            req.event.set()
 
     # ------------------------------------------------------------------
-    def output(self, x) -> np.ndarray:
+    def output(self, x, timeout: Optional[float] = None) -> np.ndarray:
         """Synchronous inference through the batching queue, or immediate
         one-at-a-time execution in SEQUENTIAL mode
-        (ref: ParallelInference.output :97-121)."""
+        (ref: ParallelInference.output :97-121).
+
+        ``timeout`` (seconds) is the per-request deadline; None preserves
+        the wait-forever contract. On expiry raises InferenceTimeout."""
         x = np.asarray(x)
+        self._counter(SERVING_REQUESTS)
+        deadline = None if timeout is None else time.monotonic() + timeout
         if self.inference_mode == "sequential":
-            return self._run_batch(x)  # _run_batch holds the model lock
+            if self._stop.is_set():
+                raise RuntimeError("ParallelInference shut down")
+            try:
+                return self._run_batch(x, deadline)  # takes the model lock
+            except InferenceTimeout:
+                raise  # already counted as a deadline, not a model error
+            except Exception:
+                self._counter(SERVING_ERRORS)
+                raise
         if self._stop.is_set():
             raise RuntimeError("ParallelInference shut down")
         req = _Request(x)
-        self._queue.put(req)
+        self._enqueue(req, deadline)
         # stop-aware wait: a request enqueued after shutdown()'s drain pass
         # has no worker left to answer it, so don't block on the event
         # unconditionally — the poll only ever loops on a dead server
-        while not req.event.wait(0.2):
-            if self._stop.is_set() and not (
-                    self._worker is not None and self._worker.is_alive()):
+        # poll clamped to the remaining budget: a 20ms deadline must be
+        # enforced at ~20ms, not at the end of a full 200ms poll
+        while not req.event.wait(
+                0.2 if deadline is None else
+                max(0.001, min(0.2, deadline - time.monotonic()))):
+            if deadline is not None and time.monotonic() >= deadline:
+                req.abandoned = True
+                self._counter(SERVING_DEADLINE_EXCEEDED)
+                raise InferenceTimeout(
+                    f"no result within {timeout:g}s "
+                    f"(queue_depth={self.queue_depth()})")
+            # give up only when the worker is GONE: during a graceful
+            # shutdown (_stop set, worker draining its in-flight batch)
+            # the result is still coming and must be delivered
+            if not (self._worker is not None and self._worker.is_alive()) \
+                    and not req.event.is_set():
                 raise RuntimeError("ParallelInference shut down")
         if isinstance(req.result, Exception):
             raise req.result
         return req.result
+
+    def _enqueue(self, req: _Request, deadline: Optional[float]) -> None:
+        if self.queue_policy == "fail_fast":
+            try:
+                self._queue.put_nowait(req)
+            except queue.Full:
+                self._counter(SERVING_QUEUE_REJECTED)
+                raise ServingQueueFull(
+                    f"batching queue at limit "
+                    f"({self._queue.maxsize} requests)") from None
+            return
+        # block policy: wait for space, bounded by the deadline (forever
+        # with none — the legacy contract)
+        while True:
+            budget = 0.2 if deadline is None else \
+                min(0.2, deadline - time.monotonic())
+            if budget <= 0:
+                self._counter(SERVING_DEADLINE_EXCEEDED)
+                raise InferenceTimeout(
+                    "deadline expired waiting for queue space")
+            try:
+                self._queue.put(req, timeout=budget)
+                return
+            except queue.Full:
+                if self._stop.is_set():
+                    raise RuntimeError("ParallelInference shut down") \
+                        from None
 
     def output_direct(self, x) -> np.ndarray:
         """Bypass the queue: one big sharded batch (for bulk scoring)."""
@@ -154,11 +368,4 @@ class ParallelInference:
         self._stop.set()
         if self._worker is not None and self._worker.is_alive():
             self._worker.join(timeout=5.0)
-        if self._queue is not None:
-            while True:
-                try:
-                    req = self._queue.get_nowait()
-                except queue.Empty:
-                    break
-                req.result = RuntimeError("ParallelInference shut down")
-                req.event.set()
+        self._fail_pending(RuntimeError("ParallelInference shut down"))

@@ -44,6 +44,8 @@ from deeplearning4j_tpu.monitoring.listener import (
 from deeplearning4j_tpu.nn.updater import normalize_gradients
 from deeplearning4j_tpu.optimize.listeners import close_listeners
 from deeplearning4j_tpu.parallel.mesh import default_mesh
+from deeplearning4j_tpu.resilience.sentinel import (
+    apply_step, effective_policy, guard_updates, tree_finite)
 
 log = logging.getLogger(__name__)
 
@@ -214,7 +216,8 @@ class ParallelWrapper:
         it and inserts the ICI allreduce."""
         t0 = time.perf_counter()
         m = self.model
-        step = m._get_train_step(False)
+        policy = effective_policy(m)
+        step = m._get_train_step(False, policy)
         rng = m._next_rng()
         self._stash_batch_for_viz(ds)
         with self._timer("step"):
@@ -224,16 +227,15 @@ class ParallelWrapper:
             lmask = None if ds.labels_mask is None else self._shard_batch(ds.labels_mask)
             from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
             if isinstance(m, MultiLayerNetwork):
-                m.params, m.state, m.updater_state, loss = step(
-                    m.params, m.state, m.updater_state, x, y, rng, fmask, lmask)
+                args = (x, y, rng, fmask, lmask)
             else:
                 inputs = {m.conf.network_inputs[0]: x}
                 labels = {m.conf.network_outputs[0]: y}
                 fmasks = None if fmask is None else {m.conf.network_inputs[0]: fmask}
                 lmasks = None if lmask is None else {m.conf.network_outputs[0]: lmask}
-                m.params, m.state, m.updater_state, loss = step(
-                    m.params, m.state, m.updater_state, inputs, labels, rng,
-                    fmasks, lmasks)
+                args = (inputs, labels, rng, fmasks, lmasks)
+            m.params, m.state, m.updater_state, loss = apply_step(
+                m, policy, step, m.params, m.state, m.updater_state, *args)
             m.score_value = loss  # raw device scalar, float() on access
         with self._timer("listener"):
             for lst in m.listeners:
@@ -254,7 +256,8 @@ class ParallelWrapper:
         t0 = time.perf_counter()
         m = self.model
         k = len(batches)
-        step = m._get_scan_train_step(k)
+        policy = effective_policy(m)
+        step = m._get_scan_train_step(k, policy)
         with self._timer("step"):
             rngs = jnp.stack([m._next_rng() for _ in range(k)])
             xs = self._shard_stack([b.features for b in batches])
@@ -265,16 +268,15 @@ class ParallelWrapper:
                 self._shard_stack([b.labels_mask for b in batches])
             from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
             if isinstance(m, MultiLayerNetwork):
-                m.params, m.state, m.updater_state, losses = step(
-                    m.params, m.state, m.updater_state, xs, ys, rngs, fm, lm)
+                args = (xs, ys, rngs, fm, lm)
             else:
                 inputs = {m.conf.network_inputs[0]: xs}
                 labels = {m.conf.network_outputs[0]: ys}
                 fms = None if fm is None else {m.conf.network_inputs[0]: fm}
                 lms = None if lm is None else {m.conf.network_outputs[0]: lm}
-                m.params, m.state, m.updater_state, losses = step(
-                    m.params, m.state, m.updater_state, inputs, labels,
-                    rngs, fms, lms)
+                args = (inputs, labels, rngs, fms, lms)
+            m.params, m.state, m.updater_state, losses = apply_step(
+                m, policy, step, m.params, m.state, m.updater_state, *args)
             m.score_value = losses[-1]  # raw device scalar
         with self._timer("listener"):
             for i, b in enumerate(batches):
@@ -294,9 +296,10 @@ class ParallelWrapper:
     # ------------------------------------------------------------------
     # averaging mode (parity with ParameterAveraging semantics)
     # ------------------------------------------------------------------
-    def _get_averaging_step(self):
-        if "avg" in self._jit_cache:
-            return self._jit_cache["avg"]
+    def _get_averaging_step(self, policy: str = "off"):
+        key = ("avg", policy)
+        if key in self._jit_cache:
+            return self._jit_cache[key]
         m = self.model
         conf = m.conf
         mesh = self.mesh
@@ -305,7 +308,10 @@ class ParallelWrapper:
 
         def local_round(params, state, upd_state, xs, ys, rngs):
             """Runs on ONE shard: `freq` sequential local steps over the
-            leading microbatch axis, then cross-shard param average."""
+            leading microbatch axis, then cross-shard param average. The
+            non-finite sentinel skips per (shard, local step): a shard
+            whose microbatch NaNs contributes its PRE-step params to the
+            average instead of a poisoned tree."""
 
             def one(carry, inp):
                 p, s, u = carry
@@ -314,14 +320,20 @@ class ParallelWrapper:
                 (loss, s2), grads = jax.value_and_grad(
                     lambda pp: m._loss(pp, s, x, y, rng, None, None, train=True),
                     has_aux=True)(p)
+                ok = None if policy == "off" else tree_finite(loss, grads)
                 grads = normalize_gradients(grads, conf.gradient_normalization,
                                             conf.gradient_normalization_threshold)
                 steps, u2 = conf.updater.update(grads, u, p)
                 p2 = jax.tree_util.tree_map(lambda a, b: a - b, p, steps)
-                return (p2, _strip_rnn_state(s2), u2), loss
+                s2 = _strip_rnn_state(s2)
+                if policy != "off":
+                    p2, u2, s2 = guard_updates(
+                        ok, policy, (p2, p), (u2, u), (s2, s))
+                out = loss if policy == "off" else (loss, ok)
+                return (p2, s2, u2), out
 
-            (p_f, s_f, u_f), losses = jax.lax.scan(one, (params, state, upd_state),
-                                                   (xs, ys, rngs))
+            (p_f, s_f, u_f), out = jax.lax.scan(one, (params, state, upd_state),
+                                                (xs, ys, rngs))
             s_f = _strip_rnn_state(s_f)
             # parameter averaging across the mesh (ref: averageModels :339)
             p_avg = jax.tree_util.tree_map(lambda a: jax.lax.pmean(a, "data"), p_f)
@@ -330,22 +342,30 @@ class ParallelWrapper:
                 if jnp.issubdtype(a.dtype, jnp.integer) else jax.lax.pmean(a, "data"),
                 u_f)
             s_avg = jax.tree_util.tree_map(lambda a: jax.lax.pmean(a, "data"), s_f)
-            return p_avg, s_avg, u_avg, jnp.mean(losses)
+            if policy == "off":
+                return p_avg, s_avg, u_avg, jnp.mean(out)
+            losses, oks = out
+            # per-local-step flag, ANDed over shards (replicated output)
+            oks_all = jax.lax.pmin(oks.astype(jnp.int32), "data")
+            return p_avg, s_avg, u_avg, jnp.mean(losses), oks_all
 
         def rep(x):
             return jax.tree_util.tree_map(lambda _: P(), x)
 
         def rounds(params, state, upd_state, xs, ys, rngs):
+            outs = (rep(params), rep(state), rep(upd_state), P())
+            if policy != "off":
+                outs = outs + (P(),)
             fn = shard_map(
                 local_round, mesh=mesh,
                 in_specs=(rep(params), rep(state), rep(upd_state),
                           P(None, "data"), P(None, "data"), P(None, "data")),
-                out_specs=(rep(params), rep(state), rep(upd_state), P()),
+                out_specs=outs,
                 check_vma=False)
             return fn(params, state, upd_state, xs, ys, rngs)
 
-        self._jit_cache["avg"] = jax.jit(rounds)
-        return self._jit_cache["avg"]
+        self._jit_cache[key] = jax.jit(rounds)
+        return self._jit_cache[key]
 
     def _fit_round_averaging(self, batches):
         """Consume `averaging_frequency * n_devices` microbatches as one
@@ -369,12 +389,13 @@ class ParallelWrapper:
         rngs = jax.random.split(
             m._next_rng(), freq * self.n_devices
         ).reshape(freq, self.n_devices, -1)
-        step = self._get_averaging_step()
+        policy = effective_policy(m)
+        step = self._get_averaging_step(policy)
         with self._timer("step"):
             m.state = _strip_rnn_state(m.state)
-            m.params, m.state, m.updater_state, loss = step(
-                m.params, m.state, m.updater_state, jnp.asarray(xs),
-                jnp.asarray(ys), jnp.asarray(rngs))
+            m.params, m.state, m.updater_state, loss = apply_step(
+                m, policy, step, m.params, m.state, m.updater_state,
+                jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(rngs))
             m.score_value = loss  # raw device scalar, float() on access
         round_examples = sum(b.num_examples() for b in batches)
         with self._timer("listener"):

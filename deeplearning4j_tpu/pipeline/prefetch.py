@@ -34,6 +34,7 @@ backend.
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 from typing import Callable, List, Optional, Union
@@ -45,6 +46,9 @@ from deeplearning4j_tpu.datasets.iterators import DataSetIterator
 from deeplearning4j_tpu.monitoring.metrics import (
     MetricsRegistry, global_registry)
 from deeplearning4j_tpu.pipeline.padding import num_real_examples, pad_batch
+from deeplearning4j_tpu.resilience.retry import RetryPolicy, retry_call
+
+log = logging.getLogger(__name__)
 
 PREFETCH_DEPTH = "dl4jtpu_prefetch_queue_depth"
 PREFETCH_BYTES = "dl4jtpu_prefetch_h2d_bytes_total"
@@ -52,6 +56,17 @@ PREFETCH_BATCHES = "dl4jtpu_prefetch_batches_total"
 
 __all__ = ["DevicePrefetchIterator", "PREFETCH_BATCHES", "PREFETCH_BYTES",
            "PREFETCH_DEPTH", "prefetch_bytes_total"]
+
+
+class _BaseIteratorDead(Exception):
+    """A generator-backed base died on an error: retrying can never
+    succeed. Deliberately NOT a typical retry_on type, so the retry
+    layer propagates it immediately instead of burning its backoff
+    budget on a corpse."""
+
+    def __init__(self, original: BaseException):
+        super().__init__(repr(original))
+        self.original = original
 
 
 def _nbytes(x) -> int:
@@ -99,6 +114,11 @@ class DevicePrefetchIterator(DataSetIterator):
         pad_when: optional host-side predicate gating `pad_to` per
             batch (e.g. ComputationGraph's mask-shadowing exemption);
             batches it rejects pass through ragged.
+        retry: optional ``resilience.retry.RetryPolicy`` — the worker
+            retries a failed base-iterator pull (``policy.retry_on``
+            exceptions only) with bounded backoff before surfacing the
+            error, so a transiently flaky input source (remote FS
+            hiccup, a lock-contended reader) doesn't kill the epoch.
     """
 
     _SENTINEL = object()
@@ -108,6 +128,7 @@ class DevicePrefetchIterator(DataSetIterator):
                  transform: Optional[Callable[[DataSet], DataSet]] = None,
                  pad_to: Union[int, str, None] = None,
                  pad_when: Optional[Callable[[DataSet], bool]] = None,
+                 retry: Optional[RetryPolicy] = None,
                  registry: Optional[MetricsRegistry] = None):
         if prefetch < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {prefetch}")
@@ -120,8 +141,20 @@ class DevicePrefetchIterator(DataSetIterator):
         self.transform = transform
         self.pad_to = pad_to
         self.pad_when = pad_when
+        self.retry = retry
         self._registry = registry
         self._last_thread: Optional[threading.Thread] = None
+        # most recent worker error of the most recent pass (a list cell so
+        # the worker thread appends instead of assigning shared state);
+        # consult it when a pass ended early after an abandoned consumer
+        self._err_holder: List[BaseException] = []
+
+    @property
+    def last_worker_error(self) -> Optional[BaseException]:
+        """Error that killed the most recent pass's worker, if any —
+        ALSO set when the consumer was already gone, so an error can
+        never vanish silently (worker-shutdown audit)."""
+        return self._err_holder[0] if self._err_holder else None
 
     def reset(self):
         self.base.reset()
@@ -153,6 +186,7 @@ class DevicePrefetchIterator(DataSetIterator):
     def __iter__(self):
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         err: List[BaseException] = []
+        self._err_holder = err  # publish THIS pass's error slot
         stop = threading.Event()
         r = self._registry or global_registry()
         depth = r.gauge(PREFETCH_DEPTH,
@@ -164,10 +198,52 @@ class DevicePrefetchIterator(DataSetIterator):
         # canonical row count for this pass ("auto" resolves per pass so
         # a re-iterated epoch re-locks onto its own first batch)
         target = [self.pad_to if isinstance(self.pad_to, int) else None]
+        _done = object()
 
         def worker():
+            delivered = False  # sentinel actually enqueued
             try:
-                for ds in self.base:
+                import types
+
+                it = iter(self.base)
+                # only GENERATORS die on their first error; an object
+                # iterator that raised can legitimately continue — or
+                # legitimately end — on the next pull
+                gen_backed = isinstance(it, types.GeneratorType)
+                failed: List[BaseException] = []
+
+                def pull():
+                    # StopIteration must not hit the retry layer (a
+                    # retry_on of Exception would "retry" end-of-stream)
+                    try:
+                        ds = next(it)
+                    except StopIteration:
+                        if failed and gen_backed:
+                            # a generator-backed base dies on its first
+                            # error: this StopIteration is the corpse,
+                            # not a clean end-of-stream — surface the
+                            # original failure (non-retryably: further
+                            # attempts can never succeed) instead of
+                            # silently truncating the epoch
+                            raise _BaseIteratorDead(failed[0]) from None
+                        return _done
+                    except BaseException as e:
+                        failed.append(e)
+                        raise
+                    failed.clear()
+                    return ds
+
+                while True:
+                    if self.retry is None:
+                        ds = pull()
+                    else:
+                        try:
+                            ds = retry_call(pull, policy=self.retry,
+                                            op="prefetch-pull")
+                        except _BaseIteratorDead as e:
+                            raise e.original from None
+                    if ds is _done:
+                        break
                     if self.transform is not None:
                         ds = self.transform(ds)
                     if self.pad_to is not None:
@@ -198,9 +274,17 @@ class DevicePrefetchIterator(DataSetIterator):
                 while not stop.is_set():
                     try:
                         q.put(self._SENTINEL, timeout=0.1)
+                        delivered = True
                         break
                     except queue.Full:
                         continue
+                if err and not delivered:
+                    # consumer left before the error could be handed over
+                    # (stop beat the sentinel put): the guarantee is that
+                    # no worker error ever vanishes — it stays readable on
+                    # last_worker_error and lands in the log
+                    log.warning("prefetch worker error after consumer "
+                                "detached: %r", err[0])
 
         t = threading.Thread(target=worker, daemon=True,
                              name="device-prefetch")
@@ -208,7 +292,32 @@ class DevicePrefetchIterator(DataSetIterator):
         t.start()
         try:
             while True:
-                item = q.get()
+                try:
+                    # bounded get + liveness check: if the worker died in
+                    # a way that lost its sentinel (full queue + abandoned
+                    # pass), the consumer must not block forever
+                    item = q.get(timeout=0.2)
+                except queue.Empty:
+                    if not t.is_alive():
+                        # worker exited between our timeout and this
+                        # check — it may have staged tail batches (and
+                        # the sentinel) in that gap; drain them before
+                        # settling, or the epoch silently loses batches
+                        drained = []
+                        while True:
+                            try:
+                                tail = q.get_nowait()
+                            except queue.Empty:
+                                break
+                            if tail is self._SENTINEL:
+                                break
+                            drained.append(tail)
+                        for tail in drained:
+                            yield tail
+                        if err:
+                            raise err[0]
+                        return  # worker gone, stream fully drained
+                    continue
                 depth.set(q.qsize())
                 if item is self._SENTINEL:
                     if err:

@@ -1,0 +1,32 @@
+"""Resilience layer: staying up when the path is unhappy.
+
+PR 3 made the hot path fast (fused scan dispatch, sync-free steady
+state) — and therefore brittle: one NaN batch silently corrupts K fused
+optimizer steps, a prefetch-worker exception kills the epoch, and a
+serving request has no deadline. This subsystem is the counterweight
+(SURVEY §5: the reference has essentially no fault tolerance beyond
+Spark task retry):
+
+- ``sentinel``: on-device non-finite detection folded into the train
+  step — a bad step applies a where-zeroed update with zero host syncs,
+  surfaced lazily as ``dl4jtpu_bad_steps_total`` /
+  ``dl4jtpu_skipped_updates_total``.
+- ``watchdog``: divergence detection (K consecutive bad steps, loss
+  blowup vs a trailing window) that triggers
+  ``util.recovery.FaultTolerantTrainer`` rollback to the last GOOD
+  checkpoint with optional LR backoff.
+- ``retry``: bounded exponential backoff with jitter — the one
+  sanctioned retry loop shape (tpulint rule ``unbounded-retry`` flags
+  hand-rolled unbounded ones).
+- ``chaos``: deterministic fault injectors over any DataSetIterator for
+  proving the above actually recovers (tests/test_resilience.py).
+
+See ARCHITECTURE.md "Resilience".
+"""
+
+from deeplearning4j_tpu.resilience.retry import RetryPolicy, retry_call
+from deeplearning4j_tpu.resilience.sentinel import (
+    effective_policy, set_default_nonfinite_policy)
+
+__all__ = ["RetryPolicy", "retry_call", "effective_policy",
+           "set_default_nonfinite_policy"]

@@ -1,0 +1,197 @@
+"""Deterministic fault injection for resilience testing.
+
+Each injector wraps any ``DataSetIterator`` (including
+``DevicePrefetchIterator`` — or sits UNDER one, in which case the fault
+fires inside the prefetch worker thread, which is exactly how you test
+worker-death delivery). Faults are counted in GLOBAL batch order across
+epochs/passes so "kill at batch 7" means the 8th batch the training run
+ever pulls, wherever the epoch boundary falls; with ``once=True`` (the
+default) the fault fires a single time and the stream then behaves
+normally — the shape every recovery test needs (fail once, prove the
+stack completes anyway).
+
+Injectors are plain iterator OBJECTS, not generators: raising out of
+``__next__`` does not end the stream, so a retry layer
+(``resilience.retry`` in the prefetch worker) can call ``next()`` again
+and receive the SAME batch the failed attempt would have produced —
+transient-flake semantics with numerics identical to a fault-free run.
+
+Catalog:
+
+- ``RaiseOnBatch``: raise an arbitrary exception before the Nth batch
+  (flaky ETL, a dead shard, a poisoned record batch decode).
+- ``NaNPoisonIterator``: replace the Nth batch's features (or labels)
+  with NaN/Inf — the sentinel's adversary.
+- ``LatencyIterator``: sleep before delivering selected batches (H2D /
+  ETL stall; exercises prefetch-depth headroom and serving deadlines).
+- ``PreemptionIterator``: ``SimulatedPreemption`` after N batches — the
+  SIGTERM-style mid-epoch kill for checkpoint-restart tests.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterator, Optional, Sequence, Union
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+
+__all__ = ["ChaosIterator", "InjectedFault", "LatencyIterator",
+           "NaNPoisonIterator", "PreemptionIterator", "RaiseOnBatch",
+           "SimulatedPreemption"]
+
+
+class InjectedFault(RuntimeError):
+    """Default exception planted by RaiseOnBatch."""
+
+
+class SimulatedPreemption(RuntimeError):
+    """SIGTERM-style mid-epoch kill (the TPU-preemption stand-in)."""
+
+
+class ChaosIterator(DataSetIterator):
+    """Base injector: global batch counting, once-latch, reset passthrough.
+
+    Subclasses override ``before_batch`` (may raise; the underlying batch
+    is NOT consumed, so a retry re-delivers it) and/or ``transform``
+    (rewrites the batch about to be yielded).
+    """
+
+    def __init__(self, base: DataSetIterator, once: bool = True):
+        self.base = base
+        self.once = once
+        self.batches_seen = 0
+        self.faults_fired = 0
+
+    def reset(self):
+        self.base.reset()
+
+    # -- override points ------------------------------------------------
+    def before_batch(self, index: int) -> None:
+        """Called with the global index of the batch ABOUT to be pulled."""
+
+    def transform(self, ds: DataSet, index: int) -> DataSet:
+        return ds
+
+    # -- plumbing -------------------------------------------------------
+    def _fire(self) -> bool:
+        """Latch: True if a fault may fire now (respects `once`)."""
+        if self.once and self.faults_fired:
+            return False
+        self.faults_fired += 1
+        return True
+
+    def __iter__(self) -> Iterator[DataSet]:
+        return _Cursor(self)
+
+
+class _Cursor:
+    """Non-generator iterator so an injected raise doesn't end the pass."""
+
+    def __init__(self, chaos: ChaosIterator):
+        self._chaos = chaos
+        self._it = iter(chaos.base)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> DataSet:
+        c = self._chaos
+        c.before_batch(c.batches_seen)  # may raise; batch not yet consumed
+        ds = next(self._it)
+        out = c.transform(ds, c.batches_seen)
+        c.batches_seen += 1
+        return out
+
+
+class RaiseOnBatch(ChaosIterator):
+    """Raise before delivering global batch `n` (0-based).
+
+    ``exc`` is an exception factory (class or zero-arg callable); with
+    ``once=False`` every pull of batch-index ``n + k*period`` fails
+    (period=0 repeats the same index forever — pair with a bounded
+    retry to prove exhaustion raises)."""
+
+    def __init__(self, base: DataSetIterator, n: int,
+                 exc: Callable[[], BaseException] = InjectedFault,
+                 once: bool = True, period: int = 0):
+        super().__init__(base, once=once)
+        self.n = int(n)
+        self.exc = exc
+        self.period = int(period)
+
+    def before_batch(self, index: int) -> None:
+        hit = index == self.n or (
+            self.period > 0 and index > self.n
+            and (index - self.n) % self.period == 0)
+        if hit and self._fire():
+            raise self.exc()
+
+
+class NaNPoisonIterator(ChaosIterator):
+    """Replace batch `n`'s features (or labels) with a non-finite value.
+
+    The batch keeps its shape/mask signature, so the fused scan path
+    groups it like any other batch — which is the point: prove the
+    sentinel skips it INSIDE a fused dispatch."""
+
+    def __init__(self, base: DataSetIterator,
+                 n: Union[int, Sequence[int]] = 0,
+                 field: str = "features", value: float = np.nan):
+        super().__init__(base, once=False)
+        if field not in ("features", "labels"):
+            raise ValueError(f"field must be features|labels, got {field!r}")
+        self.targets = {int(n)} if isinstance(n, (int, np.integer)) \
+            else {int(i) for i in n}
+        self.field = field
+        self.value = value
+
+    def _poison(self, arr):
+        if arr is None:
+            return None
+        if isinstance(arr, dict):
+            return {k: self._poison(v) for k, v in arr.items()}
+        out = np.array(arr, dtype=np.asarray(arr).dtype, copy=True)
+        out[...] = self.value
+        return out
+
+    def transform(self, ds: DataSet, index: int) -> DataSet:
+        if index not in self.targets:
+            return ds
+        f, l = ds.features, ds.labels
+        if self.field == "features":
+            f = self._poison(f)
+        else:
+            l = self._poison(l)
+        out = DataSet(f, l, ds.features_mask, ds.labels_mask)
+        real = getattr(ds, "real_examples", None)
+        if real is not None:
+            out.real_examples = real
+        return out
+
+
+class LatencyIterator(ChaosIterator):
+    """Sleep before delivering selected batches (every batch when
+    ``every=1``): the H2D/ETL-stall injector."""
+
+    def __init__(self, base: DataSetIterator, seconds: float,
+                 every: int = 1, start: int = 0):
+        super().__init__(base, once=False)
+        self.seconds = float(seconds)
+        self.every = max(1, int(every))
+        self.start = int(start)
+
+    def before_batch(self, index: int) -> None:
+        if index >= self.start and (index - self.start) % self.every == 0:
+            time.sleep(self.seconds)
+
+
+class PreemptionIterator(RaiseOnBatch):
+    """SIGTERM-style kill: SimulatedPreemption before global batch `n`,
+    once — rerunning the fit (FaultTolerantTrainer restart) proceeds
+    normally from wherever its checkpoint restored."""
+
+    def __init__(self, base: DataSetIterator, n: int):
+        super().__init__(base, n, exc=SimulatedPreemption, once=True)

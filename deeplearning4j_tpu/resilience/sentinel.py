@@ -1,0 +1,284 @@
+"""On-device non-finite sentinel: detect and skip poisoned updates.
+
+A single NaN/Inf batch (bad input, overflowing LR, a dropout-free fp16
+edge) poisons params forever — and on the PR 3 fused path it silently
+corrupts ALL K optimizer steps of a scan dispatch. The sentinel folds
+detection INTO the jitted train step so the skip costs no host sync:
+
+    ok  = isfinite(loss) & all(isfinite(g) for g in grad leaves)
+    p'  = where(ok, p - update, p)        # the zeroed-update math
+    u'  = where(ok, u_next, u)            # optimizer state too
+    s'  = where(ok, s_next, s)            # and BN running stats
+
+``where(ok, new, old)`` with a traced scalar ``ok`` is a device select —
+when the step is bad the update is exactly zero (params bit-equal to the
+pre-step values), when it is good the math is bit-equal to the
+sentinel-free step. Grads are tested BEFORE gradient normalization, so a
+clipping rule can't mask an Inf by rescaling it.
+
+The flag is returned from the step as a raw device bool (on the scan
+path: a [K] vector, one per fused step) and accumulated host-side by
+``SentinelAccounting`` WITHOUT synchronizing: the fit loops append the
+raw flag per logical step; at cadence (every ``flush_every`` steps) the
+accounting settles only flags whose computation already finished
+(non-blocking ``is_ready``), and everything else waits for the
+sanctioned sync points — watchdog cadence, checkpoint save, end of
+fit. Steady state stays sync-free (the tests/test_input_pipeline.py
+guards hold with the sentinel enabled).
+
+Metrics (global registry, labeled by model class):
+
+- ``dl4jtpu_bad_steps_total``: steps whose loss or raw grads were
+  non-finite.
+- ``dl4jtpu_skipped_updates_total``: bad steps whose update was zeroed
+  (== bad steps under the default "skip" policy; 0 under "record").
+- ``dl4jtpu_consecutive_bad_steps`` (gauge): current run length — the
+  divergence watchdog's primary signal.
+
+Policies (``set_default_nonfinite_policy`` / ``net.nonfinite_policy``):
+``"skip"`` (default) zeroes bad updates, ``"record"`` counts but applies
+them (debugging: watch a divergence happen), ``"off"`` removes the
+sentinel from the trace entirely (the pre-resilience step, kept for
+benchmarks that want the raw step unchanged).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.monitoring.metrics import (
+    MetricsRegistry, global_registry)
+
+BAD_STEPS = "dl4jtpu_bad_steps_total"
+SKIPPED_UPDATES = "dl4jtpu_skipped_updates_total"
+CONSECUTIVE_BAD = "dl4jtpu_consecutive_bad_steps"
+
+POLICIES = ("skip", "record", "off")
+
+_DEFAULT_POLICY = "skip"
+
+_MISSING = object()
+
+__all__ = ["BAD_STEPS", "CONSECUTIVE_BAD", "POLICIES", "SKIPPED_UPDATES",
+           "SentinelAccounting", "accounting_for", "effective_policy",
+           "flush_accounting", "record_step_flags",
+           "set_default_nonfinite_policy", "tree_finite", "where_finite"]
+
+
+def set_default_nonfinite_policy(policy: str) -> str:
+    """Set the process-wide default policy; returns the previous value."""
+    global _DEFAULT_POLICY
+    if policy not in POLICIES:
+        raise ValueError(f"policy must be one of {POLICIES}, got {policy!r}")
+    prev, _DEFAULT_POLICY = _DEFAULT_POLICY, policy
+    return prev
+
+
+def effective_policy(model=None) -> str:
+    """Policy for a model: its ``nonfinite_policy`` attribute if set,
+    else the process default."""
+    p = getattr(model, "nonfinite_policy", None)
+    if p is None:
+        return _DEFAULT_POLICY
+    if p not in POLICIES:
+        raise ValueError(f"nonfinite_policy must be one of {POLICIES}, "
+                         f"got {p!r}")
+    return p
+
+
+# ---------------------------------------------------------------------------
+# traced helpers (called inside jitted train steps)
+# ---------------------------------------------------------------------------
+def tree_finite(loss, grads):
+    """Traced: scalar bool — loss and EVERY raw-gradient leaf finite."""
+    import jax
+    import jax.numpy as jnp
+
+    ok = jnp.all(jnp.isfinite(loss))
+    for leaf in jax.tree_util.tree_leaves(grads):
+        ok = ok & jnp.all(jnp.isfinite(leaf))
+    return ok
+
+
+def where_finite(ok, new, old):
+    """Traced: ``new`` where ``ok`` else ``old``, merged structurally.
+
+    Leaves ``new`` carries that ``old`` lacks (an RNN h/c carry
+    materializing on the first tbptt chunk) — or whose shape changed
+    (a growing cache) — have no pre-step value to fall back to; on a
+    bad step they fall back to ZEROS, the absent-carry semantic the
+    layers use, so a poisoned first chunk cannot smuggle a NaN carry
+    past the skip."""
+    import jax.numpy as jnp
+
+    def merge(n, o):
+        if isinstance(n, dict):
+            o_map = o if isinstance(o, dict) else {}
+            return {k: merge(v, o_map.get(k, _MISSING))
+                    for k, v in n.items()}
+        if n is None:
+            return n
+        if o is _MISSING or o is None or \
+                getattr(n, "shape", None) != getattr(o, "shape", None):
+            return jnp.where(ok, n, jnp.zeros_like(n))
+        return jnp.where(ok, n, o)
+
+    return merge(new, old)
+
+
+# ---------------------------------------------------------------------------
+# host-side lazy accounting
+# ---------------------------------------------------------------------------
+class SentinelAccounting:
+    """Accumulates raw device flags; materializes at cadence.
+
+    ``record`` appends without syncing. The cadence flush settles only
+    flags whose device computation has ALREADY finished (``is_ready``,
+    non-blocking), so the fit thread never waits on an in-flight step
+    for accounting — the sanctioned sync points (watchdog cadence,
+    checkpoint save, end of fit) force-flush the remainder. Host
+    counters and registry metrics update on flush. The fit loop thread
+    owns record/flush ordering; the lock only guards against concurrent
+    observers (watchdog listeners, scrapes)."""
+
+    def __init__(self, model_name: str, flush_every: int = 25,
+                 registry: Optional[MetricsRegistry] = None):
+        self.model_name = model_name
+        self.flush_every = max(1, int(flush_every))
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._pending: List[Tuple[Any, bool]] = []
+        self.total_steps = 0
+        self.bad_steps = 0
+        self.skipped_updates = 0
+        self.consecutive_bad = 0
+
+    def record(self, flags: Any, skipped: bool) -> None:
+        """Queue one step's (or one fused group's [K]) raw ok-flag(s);
+        at `flush_every` pending entries, settle the ones whose device
+        computation already FINISHED (non-blocking — the fit thread
+        never waits on an in-flight step for accounting)."""
+        with self._lock:
+            self._pending.append((flags, skipped))
+            due = len(self._pending) >= self.flush_every
+        if due:
+            self.flush(force=False)
+
+    @staticmethod
+    def _is_ready(flags: Any) -> bool:
+        ready = getattr(flags, "is_ready", None)
+        if ready is None:
+            return True  # host value (numpy/bool): nothing to wait on
+        try:
+            return bool(ready())
+        except Exception:  # noqa: BLE001 — readiness probe must not raise
+            return True
+
+    def flush(self, force: bool = True) -> None:
+        """Materialize pending flags and publish. ``force=False`` (the
+        fit-loop cadence path) settles only the longest prefix whose
+        arrays are already ready — zero added steady-state stalls; the
+        sanctioned sync points (watchdog cadence, checkpoint save, end
+        of fit) use the default force=True. A hard cap of
+        4*flush_every pending entries backpressures regardless."""
+        with self._lock:
+            if force or len(self._pending) >= 4 * self.flush_every:
+                pending, self._pending = self._pending, []
+            else:
+                n = 0
+                while n < len(self._pending) and \
+                        self._is_ready(self._pending[n][0]):
+                    n += 1
+                pending, self._pending = (self._pending[:n],
+                                          self._pending[n:])
+        if not pending:
+            return
+        new_bad = new_skipped = new_total = 0
+        consecutive = None
+        for flags, skipped in pending:
+            oks = np.asarray(flags).ravel()
+            for ok in oks:
+                new_total += 1
+                if bool(ok):
+                    consecutive = 0
+                else:
+                    new_bad += 1
+                    consecutive = (self.consecutive_bad
+                                   if consecutive is None else consecutive) + 1
+                    if skipped:
+                        new_skipped += 1
+        with self._lock:
+            self.total_steps += new_total
+            self.bad_steps += new_bad
+            self.skipped_updates += new_skipped
+            if consecutive is not None:
+                self.consecutive_bad = consecutive
+        r = self._registry or global_registry()
+        if new_bad:
+            r.counter(BAD_STEPS,
+                      "Train steps with a non-finite loss or gradient",
+                      ("model",)).inc(new_bad, model=self.model_name)
+        if new_skipped:
+            r.counter(SKIPPED_UPDATES,
+                      "Non-finite updates zeroed by the sentinel",
+                      ("model",)).inc(new_skipped, model=self.model_name)
+        r.gauge(CONSECUTIVE_BAD,
+                "Current run of consecutive non-finite train steps",
+                ("model",)).set(self.consecutive_bad, model=self.model_name)
+
+    def reset_window(self) -> None:
+        """Drop pending flags and the consecutive-bad run (rollback just
+        restored a good state); lifetime totals stay."""
+        with self._lock:
+            self._pending = []
+            self.consecutive_bad = 0
+
+
+def accounting_for(model) -> SentinelAccounting:
+    """Get-or-create the model's accounting (stored on the model)."""
+    acct = getattr(model, "_sentinel_accounting", None)
+    if acct is None:
+        acct = SentinelAccounting(type(model).__name__)
+        model._sentinel_accounting = acct
+    return acct
+
+
+def record_step_flags(model, flags: Any, policy: str) -> None:
+    """Fit-loop hook: queue a step's raw flag(s) — NO host sync here."""
+    if policy == "off" or flags is None:
+        return
+    accounting_for(model).record(flags, skipped=(policy == "skip"))
+
+
+def guard_updates(ok, policy: str, *pairs):
+    """Traced: apply the skip-policy select to ``(new, old)`` pairs —
+    the ONE place the zeroed-update triple lives, so every step builder
+    (per-batch, scan, phase, averaging) shares identical skip
+    semantics. Under "record" the new values pass through unguarded."""
+    if policy != "skip":
+        return tuple(n for n, _ in pairs)
+    return tuple(where_finite(ok, n, o) for n, o in pairs)
+
+
+def apply_step(model, policy: str, step, *args):
+    """Call a jitted train step and absorb its sentinel flag: under
+    policy "off" the step's legacy tuple passes through unchanged;
+    otherwise the trailing raw ok-flag(s) are recorded (lazily, no
+    sync) and the remaining tuple returned — so every fit-loop call
+    site unpacks ONE shape regardless of policy."""
+    out = step(*args)
+    if policy == "off":
+        return out
+    record_step_flags(model, out[-1], policy)
+    return out[:-1]
+
+
+def flush_accounting(model) -> Optional[SentinelAccounting]:
+    """Flush if the model has accounting (end-of-fit / watchdog cadence)."""
+    acct = getattr(model, "_sentinel_accounting", None)
+    if acct is not None:
+        acct.flush()
+    return acct

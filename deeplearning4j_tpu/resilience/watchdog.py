@@ -1,0 +1,110 @@
+"""Divergence watchdog: turn a slow-motion training collapse into a
+catchable event.
+
+The sentinel (resilience/sentinel.py) makes a single poisoned batch
+harmless, but two failure modes survive it: a PERSISTENT source of bad
+steps (every batch NaNs — e.g. an LR so hot the loss overflows each
+step, so skipping leaves params frozen forever), and a numeric
+divergence that stays finite while the loss runs away. The watchdog is
+a TrainingListener that checks both at its own cadence and raises
+``DivergenceError`` — which ``util.recovery.FaultTolerantTrainer``
+catches to roll back to the last GOOD checkpoint (optionally with LR
+backoff) instead of burning the remaining epochs on a corpse.
+
+Checks (every ``check_every`` iterations — the listener's one sanctioned
+sync point, same contract as a score printer):
+
+- **consecutive bad steps** >= ``max_consecutive_bad`` (from the
+  sentinel accounting, flushed here);
+- **loss blowup**: current score exceeds
+  ``median + blowup_factor * max(|median|, abs_floor)`` over the last
+  ``window`` cadence-sampled finite scores (needs at least
+  ``min_history`` samples, so a noisy warmup can't false-trigger). The
+  additive-around-the-median form keeps the check live for objectives
+  whose loss is near zero or negative (log-likelihoods), where a naive
+  ``factor * median`` ratio would be inert.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import deque
+from statistics import median
+from typing import Optional
+
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+from deeplearning4j_tpu.resilience import sentinel
+
+log = logging.getLogger(__name__)
+
+__all__ = ["DivergenceError", "DivergenceWatchdog"]
+
+
+class DivergenceError(RuntimeError):
+    """Training diverged (persistent bad steps or loss blowup).
+
+    ``limit`` (blowup trigger only) is the score threshold that fired —
+    the rollback path uses it to skip checkpoints whose recorded score
+    was already past it (saved mid-divergence)."""
+
+    def __init__(self, message: str, iteration: Optional[int] = None,
+                 limit: Optional[float] = None):
+        super().__init__(message)
+        self.iteration = iteration
+        self.limit = limit
+
+
+class DivergenceWatchdog(TrainingListener):
+    def __init__(self, max_consecutive_bad: int = 5,
+                 blowup_factor: float = 25.0, window: int = 20,
+                 min_history: int = 5, check_every: int = 10,
+                 abs_floor: float = 0.1):
+        if max_consecutive_bad < 1:
+            raise ValueError("max_consecutive_bad must be >= 1")
+        if blowup_factor <= 1.0:
+            raise ValueError("blowup_factor must be > 1")
+        if abs_floor <= 0.0:
+            raise ValueError("abs_floor must be > 0")
+        self.max_consecutive_bad = max_consecutive_bad
+        self.blowup_factor = blowup_factor
+        self.abs_floor = abs_floor
+        self.min_history = max(2, min_history)
+        self.check_every = max(1, check_every)
+        self._scores = deque(maxlen=max(self.min_history, window))
+        self._ticks = 0
+
+    def reset(self) -> None:
+        """Forget history (called after a rollback restored good state)."""
+        self._scores.clear()
+        self._ticks = 0
+
+    def iteration_done(self, model, iteration: int, score) -> None:
+        self._ticks += 1
+        if self._ticks % self.check_every:
+            return
+        # cadence sync #1: materialize pending sentinel flags
+        acct = sentinel.flush_accounting(model)
+        if acct is not None and \
+                acct.consecutive_bad >= self.max_consecutive_bad:
+            raise DivergenceError(
+                f"{acct.consecutive_bad} consecutive non-finite train "
+                f"steps (threshold {self.max_consecutive_bad}) — the "
+                f"input or the step size is persistently poisoned",
+                iteration=iteration)
+        # cadence sync #2: the score (lazy device scalar until floated)
+        s = float(score)
+        if s != s or s in (float("inf"), float("-inf")):
+            return  # non-finite scores are the sentinel counter's job
+        if len(self._scores) >= self.min_history:
+            base = median(self._scores)
+            # additive around the median: stays live for near-zero and
+            # NEGATIVE losses, matches factor*median for positive ones
+            limit = base + self.blowup_factor * max(abs(base),
+                                                    self.abs_floor)
+            if s > limit:
+                raise DivergenceError(
+                    f"loss {s:.4g} blew past the divergence limit "
+                    f"{limit:.4g} (trailing-window median {base:.4g}, "
+                    f"factor {self.blowup_factor:g})",
+                    iteration=iteration, limit=limit)
+        self._scores.append(s)

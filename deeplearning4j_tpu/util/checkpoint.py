@@ -51,11 +51,38 @@ def _net_state_tree(net) -> Dict[str, Any]:
     }
 
 
+def _sentinel_status(net) -> Dict[str, Any]:
+    """Health tag for a checkpoint: flush the net's non-finite sentinel
+    accounting (resilience/sentinel.py) and report whether the state
+    being saved is GOOD (no live run of bad steps), plus the score at
+    save time — the divergence-rollback path uses it to rewind past
+    saves taken after a FINITE loss blowup, which the bad-step flag
+    alone cannot see. A save is itself a full host materialization, so
+    the flush/score syncs are free here."""
+    from deeplearning4j_tpu.resilience.sentinel import flush_accounting
+    acct = flush_accounting(net)
+    score = getattr(net, "score_value", None)
+    try:
+        score = None if score is None or score != score else float(score)
+    except (TypeError, ValueError):
+        score = None
+    if acct is None:  # sentinel never ran: nothing says this is bad
+        return {"good": True, "bad_steps": 0, "consecutive_bad": 0,
+                "score": score}
+    return {"good": acct.consecutive_bad == 0,
+            "bad_steps": acct.bad_steps,
+            "consecutive_bad": acct.consecutive_bad,
+            "score": score}
+
+
 def save_checkpoint(net, path: str, step: Optional[int] = None) -> str:
     """Write a sharded checkpoint of the network's full training state.
 
     Returns the checkpoint directory. Config JSON is stored alongside so
-    ``load_checkpoint`` can rebuild the network object.
+    ``load_checkpoint`` can rebuild the network object. Each step dir
+    carries a ``resilience.json`` health tag (sentinel state at save
+    time) so rollback (util/recovery.py) can target the last GOOD
+    checkpoint instead of the newest — which may already be poisoned.
     """
     if not _HAVE_ORBAX:
         raise RuntimeError("orbax is not available")
@@ -66,6 +93,10 @@ def save_checkpoint(net, path: str, step: Optional[int] = None) -> str:
         shutil.rmtree(step_dir)
     with ocp.PyTreeCheckpointer() as ckptr:
         ckptr.save(step_dir, _net_state_tree(net))
+    if step is not None:
+        # tag lives NEXT TO the step dir (orbax owns the dir's contents)
+        with open(_tag_path(path, step), "w") as f:
+            json.dump(_sentinel_status(net), f)
     meta = {"model_class": type(net).__name__,
             "config": net.conf.to_json()}
     with open(os.path.join(path, "config.json"), "w") as f:
@@ -131,12 +162,46 @@ def list_checkpoints(path: str):
         return []
     steps = []
     for name in os.listdir(path):
-        if name.startswith("step_"):
+        if name.startswith("step_") and not name.endswith(".json"):
             try:
                 steps.append(int(name.split("_", 1)[1]))
             except ValueError:
                 continue
     return sorted(steps)
+
+
+def _tag_path(path: str, step: int) -> str:
+    """Canonical location of a step's resilience health tag."""
+    return os.path.join(os.path.abspath(path),
+                        f"step_{step}.resilience.json")
+
+
+def delete_checkpoint(path: str, step: int) -> None:
+    """Remove a step dir AND its health tag (the two must never drift
+    apart — a stale tag would be read as the status of a future save
+    reusing the step number)."""
+    shutil.rmtree(os.path.join(os.path.abspath(path), f"step_{step}"),
+                  ignore_errors=True)
+    try:
+        os.unlink(_tag_path(path, step))
+    except OSError:
+        pass
+
+
+def checkpoint_status(path: str, step: int) -> Dict[str, Any]:
+    """The resilience tag written beside a step dir; untagged (pre-
+    resilience) checkpoints count as good."""
+    try:
+        with open(_tag_path(path, step)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {"good": True}
+
+
+def list_good_checkpoints(path: str):
+    """Steps whose saved state the sentinel tagged GOOD, ascending."""
+    return [s for s in list_checkpoints(path)
+            if checkpoint_status(path, s).get("good", True)]
 
 
 class CheckpointListener(TrainingListener):
@@ -165,6 +230,5 @@ class CheckpointListener(TrainingListener):
         save_checkpoint(model, self.path, step=step)
         steps = list_checkpoints(self.path)
         for old in steps[:-self.keep_last]:
-            shutil.rmtree(os.path.join(self.path, f"step_{old}"),
-                          ignore_errors=True)
+            delete_checkpoint(self.path, old)
         log.info("checkpoint saved at step %d (%s)", step, self.path)

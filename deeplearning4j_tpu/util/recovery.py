@@ -1,4 +1,4 @@
-"""Fault-tolerant training: checkpoint-based automatic restart.
+"""Fault-tolerant training: checkpoint-based automatic restart + rollback.
 
 SURVEY §5 ("Failure/elastic recovery"): the reference has essentially no
 fault tolerance beyond Spark task retry; on TPU the idiomatic equivalent
@@ -19,6 +19,21 @@ that loop:
   between attempts — the single-host analogue of an elastic scheduler
   relaunching a preempted worker.
 
+Divergence handling (resilience/): with ``watch_divergence=True`` a
+``DivergenceWatchdog`` listener rides along and raises
+``DivergenceError`` when the non-finite sentinel reports K consecutive
+bad steps or the loss blows past its trailing window. The restart path
+then restores the newest checkpoint that PREDATES the divergence:
+GOOD-tagged by the sentinel, and — for a finite loss blowup, which
+every bad-step tag misses — with a recorded save-time score still under
+the watchdog limit that fired; when nothing qualifies it falls back to
+the newest save of any tag (a finite on-disk state beats the diverged
+in-memory tree). It then optionally multiplies the learning rate by
+``lr_backoff`` (< 1) before resuming — the classic "rewind and cool
+down" divergence recovery — and clears the net's jit cache so the new
+LR actually traces (the updater bakes its float into the compiled
+step).
+
 The exact resume==straight-run invariant holds for EPOCH-BOUNDARY
 checkpoints (save_every_epoch=True, the default — the state tree incl.
 the RNG stream restores exactly; tests/test_recovery.py). Iteration-based
@@ -33,9 +48,15 @@ from __future__ import annotations
 import logging
 from typing import Optional, Tuple, Type
 
+from deeplearning4j_tpu.monitoring.metrics import global_registry
+from deeplearning4j_tpu.resilience.watchdog import (
+    DivergenceError, DivergenceWatchdog)
 from deeplearning4j_tpu.util.checkpoint import (
-    CheckpointListener, list_checkpoints, restore_checkpoint,
+    CheckpointListener, checkpoint_status, delete_checkpoint,
+    list_checkpoints, list_good_checkpoints, restore_checkpoint,
 )
+
+RESTARTS = "dl4jtpu_training_restarts_total"
 
 log = logging.getLogger(__name__)
 
@@ -45,11 +66,20 @@ class FaultTolerantTrainer:
                  save_every_n_iterations: Optional[int] = None,
                  save_every_epoch: bool = True, keep_last: int = 3,
                  max_restarts: int = 2,
-                 retry_on: Tuple[Type[BaseException], ...] = (RuntimeError,)):
+                 retry_on: Tuple[Type[BaseException], ...] = (RuntimeError,),
+                 watch_divergence: bool = False,
+                 watchdog: Optional[DivergenceWatchdog] = None,
+                 lr_backoff: Optional[float] = None):
+        if lr_backoff is not None and not 0.0 < lr_backoff < 1.0:
+            raise ValueError(f"lr_backoff must be in (0, 1), "
+                             f"got {lr_backoff}")
         self.net = net
         self.dir = checkpoint_dir
         self.max_restarts = max_restarts
         self.retry_on = retry_on
+        self.lr_backoff = lr_backoff
+        self.watchdog = watchdog if watchdog is not None else (
+            DivergenceWatchdog() if watch_divergence else None)
         self._listener = CheckpointListener(
             checkpoint_dir, save_every_n_iterations=save_every_n_iterations,
             save_every_epoch=save_every_epoch, keep_last=keep_last)
@@ -60,26 +90,99 @@ class FaultTolerantTrainer:
                 "continuation, not bit-exact — see module docstring)")
 
     # -- recovery ---------------------------------------------------------
-    def resume_if_possible(self) -> Optional[int]:
-        """Restore the newest checkpoint if one exists; returns the
-        restored step or None (fresh start)."""
-        steps = list_checkpoints(self.dir)
+    def resume_if_possible(self, only_good: bool = False) -> Optional[int]:
+        """Restore the newest checkpoint (with ``only_good``, the newest
+        one the sentinel tagged GOOD); returns the restored step or None
+        (fresh start)."""
+        steps = (list_good_checkpoints(self.dir) if only_good
+                 else list_checkpoints(self.dir))
         if not steps:
             return None
         step = steps[-1]
         restore_checkpoint(self.net, self.dir, step=step)
-        log.info("resumed from checkpoint step %d (epoch %d)", step,
-                 self.net.epoch_count)
+        log.info("resumed from checkpoint step %d (epoch %d)%s", step,
+                 self.net.epoch_count,
+                 " [last good]" if only_good else "")
         return step
+
+    def _pick_rollback_step(self, cause: BaseException) -> Optional[int]:
+        """Newest checkpoint that predates the divergence: GOOD-tagged
+        (no live bad-step run), and — for a FINITE loss blowup, where
+        every tag says good — with a recorded score still under the
+        watchdog limit that fired. Falls back to the newest checkpoint
+        of any tag (a finite on-disk state beats the diverged in-memory
+        tree) when nothing qualifies."""
+        good = list_good_checkpoints(self.dir)
+        limit = getattr(cause, "limit", None)
+        if limit is not None:
+            def saved_score(s):
+                v = checkpoint_status(self.dir, s).get("score")
+                # explicit None check: 0.0 is a real (and fine) score
+                return -float("inf") if v is None else v
+
+            pre = [s for s in good if saved_score(s) <= limit]
+            if pre:
+                return pre[-1]
+        if good:
+            return good[-1]
+        steps = list_checkpoints(self.dir)
+        return steps[-1] if steps else None
+
+    def _rollback(self, cause: BaseException) -> Optional[int]:
+        """Divergence recovery: restore the last pre-divergence state,
+        cool the LR, reset the watchdog/sentinel windows so stale
+        history can't immediately re-trigger."""
+        step = self._pick_rollback_step(cause)
+        if step is not None:
+            restore_checkpoint(self.net, self.dir, step=step)
+            log.info("rolled back to checkpoint step %d (epoch %d)",
+                     step, self.net.epoch_count)
+            # drop the mid-divergence saves BEYOND the rewind point:
+            # left on disk, a later transient restart would restore the
+            # newest (diverged) one, and keep-last pruning — which keeps
+            # the HIGHEST steps — would evict the fresh post-rollback
+            # saves while preserving the poisoned ones
+            for stale in list_checkpoints(self.dir):
+                if stale > step:
+                    delete_checkpoint(self.dir, stale)
+                    log.info("pruned post-divergence checkpoint step %d",
+                             stale)
+        if self.lr_backoff is not None:
+            upd = self.net.conf.updater
+            upd.learning_rate *= self.lr_backoff
+            # the compiled steps baked the old LR in as a constant
+            self.net._jit_cache.clear()
+            log.warning("divergence (%s): learning rate backed off to %g",
+                        cause, upd.learning_rate)
+        self._reset_windows()
+        return step
+
+    def _reset_windows(self) -> None:
+        """Forget watchdog/sentinel history after ANY restore: the score
+        window sampled the pre-restore trajectory, and a rewound (older,
+        higher-loss) state compared against it would spuriously re-trip
+        the blowup check on a healthy run."""
+        acct = getattr(self.net, "_sentinel_accounting", None)
+        if acct is not None:
+            acct.reset_window()
+        if self.watchdog is not None:
+            self.watchdog.reset()
 
     # -- training ---------------------------------------------------------
     def fit(self, data, labels=None, epochs: int = 1, batch_size: int = 32):
         """Train to `epochs` TOTAL epochs (counting any epochs already in
         the restored state), restarting from the latest checkpoint on
-        transient failures up to `max_restarts` times."""
-        if self._listener not in getattr(self.net, "listeners", []):
+        transient failures — or the latest GOOD checkpoint on divergence
+        — up to `max_restarts` times."""
+        listeners = getattr(self.net, "listeners", [])
+        if self._listener not in listeners:
             self.net.add_listener(self._listener)
+        if self.watchdog is not None and self.watchdog not in listeners:
+            self.net.add_listener(self.watchdog)
         self.resume_if_possible()
+        # divergence handling is this class's explicit contract — it must
+        # work even when retry_on was narrowed to, say, (OSError,)
+        catch = (DivergenceError,) + tuple(self.retry_on)
         attempts = 0
         while True:
             remaining = epochs - self.net.epoch_count
@@ -96,14 +199,33 @@ class FaultTolerantTrainer:
                     self._listener._save(self.net,
                                          self.net.iteration_count)
                 return self.net
-            except self.retry_on as e:
+            except catch as e:
                 attempts += 1
                 if attempts > self.max_restarts:
                     log.error("giving up after %d restarts", attempts - 1)
                     raise
+                global_registry().counter(
+                    RESTARTS, "In-process training restarts from checkpoint",
+                    ("cause",)).inc(
+                    cause="divergence" if isinstance(e, DivergenceError)
+                    else "transient")
                 log.warning("training failed (%s); restart %d/%d from "
                             "latest checkpoint", e, attempts,
                             self.max_restarts)
-                if self.resume_if_possible() is None:
+                if isinstance(e, DivergenceError):
+                    restored = self._rollback(e)
+                    if restored is None and self.lr_backoff is None:
+                        # nothing to rewind to and nothing changed:
+                        # refitting the diverged in-memory state would
+                        # burn every remaining restart on guaranteed
+                        # re-divergence — fail now, actionably
+                        log.error("divergence with no checkpoint to "
+                                  "roll back to and no lr_backoff "
+                                  "configured — not retrying")
+                        raise
+                else:
+                    restored = self.resume_if_possible()
+                    self._reset_windows()
+                if restored is None:
                     log.warning("no checkpoint yet — restarting from "
                                 "current in-memory state")

@@ -34,6 +34,12 @@ python -m pytest tests/test_monitoring.py -q -p no:cacheprovider
 # before the full suite runs
 python -m pytest tests/test_input_pipeline.py -q -p no:cacheprovider
 
+# tier-1 resilience lane: the chaos suite (resilience/) — non-finite
+# sentinel skip/rollback on all three fit loops, prefetch-worker death
+# and mid-epoch kill recovery, divergence rollback, serving deadlines.
+# The unhappy paths must stay green before the full suite runs.
+python -m pytest tests/test_resilience.py -q -p no:cacheprovider
+
 python -m pytest tests/ -q --junitxml=/tmp/dl4jtpu_junit.xml "$@"
 
 # only a FULL unfiltered run may overwrite the committed tally — a

@@ -40,6 +40,10 @@ def main() -> None:
 
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", True)
+    # the default XLA CPU client has no cross-process collectives
+    # ("Multiprocess computations aren't implemented on the CPU backend");
+    # the gloo-backed client implements them over localhost TCP
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P

@@ -396,6 +396,139 @@ class TestHygieneRules:
 
 
 # ---------------------------------------------------------------------
+# rule: unbounded-retry
+# ---------------------------------------------------------------------
+class TestUnboundedRetryRule:
+    def test_positive_while_true_sleep_swallowing_except(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import time
+
+            def poll(fetch):
+                while True:
+                    try:
+                        return fetch()
+                    except ConnectionError:
+                        time.sleep(1.0)
+        """)
+        assert _rules_of(fs) == ["unbounded-retry"]
+
+    def test_positive_sleep_outside_handler_still_counts(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import time
+
+            def wait_for(ready):
+                while True:
+                    try:
+                        if ready():
+                            return
+                    except OSError:
+                        pass
+                    time.sleep(0.5)
+        """)
+        assert _rules_of(fs) == ["unbounded-retry"]
+
+    def test_negative_bounded_attempts_via_raise(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import time
+
+            def fetch_with_cap(fetch, limit=5):
+                attempts = 0
+                while True:
+                    try:
+                        return fetch()
+                    except ConnectionError:
+                        attempts += 1
+                        if attempts >= limit:
+                            raise
+                        time.sleep(0.1 * attempts)
+        """)
+        assert fs == []
+
+    def test_negative_for_range_and_condition_loops(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import time
+
+            def bounded(fetch):
+                for attempt in range(5):
+                    try:
+                        return fetch()
+                    except ConnectionError:
+                        time.sleep(0.1)
+
+            def stoppable(fetch, stop):
+                while not stop.is_set():
+                    try:
+                        return fetch()
+                    except ConnectionError:
+                        time.sleep(0.1)
+        """)
+        assert fs == []
+
+    def test_positive_nested_escape_does_not_bound(self, tmp_path):
+        # the break exits only the inner for, the return lives in a
+        # nested def, and the raise is swallowed by an inner try: none
+        # of them bounds the retry — still unbounded
+        fs = _scan_snippet(tmp_path, """
+            import time
+
+            def poll(fetch, alts, probe):
+                while True:
+                    try:
+                        fetch()
+                    except OSError:
+                        for alt in alts:
+                            probe(alt)
+                            break
+                        def cb():
+                            return None
+                        try:
+                            raise ValueError("inner")
+                        except ValueError:
+                            pass
+                        time.sleep(1.0)
+        """)
+        assert _rules_of(fs) == ["unbounded-retry"]
+
+    def test_negative_bounded_inner_retry_in_daemon_loop(self, tmp_path):
+        # the handler belongs to the bounded inner for, not the daemon
+        # while-True — the retry IS bounded by construction
+        fs = _scan_snippet(tmp_path, """
+            import time
+
+            def daemon(poll):
+                while True:
+                    for attempt in range(3):
+                        try:
+                            poll()
+                            break
+                        except OSError:
+                            time.sleep(1.0)
+        """)
+        assert fs == []
+
+    def test_negative_sleep_without_retry_shape(self, tmp_path):
+        # a poll loop that never swallows exceptions is pacing, not retry
+        fs = _scan_snippet(tmp_path, """
+            import time
+
+            def heartbeat(send):
+                while True:
+                    send()
+                    time.sleep(30.0)
+        """)
+        assert fs == []
+
+    def test_repo_retry_helper_is_clean(self):
+        """The sanctioned helper itself (bounded for-loop) must not trip
+        its own rule."""
+        from deeplearning4j_tpu.analysis.rules.retry_loop import (
+            UnboundedRetryRule)
+        fs = scan_paths([str(PKG / "resilience" / "retry.py")],
+                        [UnboundedRetryRule()], root=str(REPO))
+        assert fs == []
+
+
+# ---------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------
 class TestSuppression:
@@ -545,7 +678,7 @@ class TestSelfScan:
             "host-sync-in-hot-loop", "device-transfer-in-hot-loop",
             "tracer-leak", "recompile-hazard",
             "dtype-promotion", "unlocked-thread-state", "bare-except",
-            "mutable-default-arg"}
+            "mutable-default-arg", "unbounded-retry"}
         assert RULES_BY_ID["host-sync-in-hot-loop"].severity == "error"
         assert RULES_BY_ID["device-transfer-in-hot-loop"].severity == \
             "warning"

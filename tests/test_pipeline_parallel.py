@@ -102,7 +102,10 @@ class TestPipelineApply:
             return l, jax.tree.map(lambda a, b: a - 0.2 * b, stages, g)
 
         l0, stages = step(stages)
-        for _ in range(30):
+        # 100 steps: the seed-9 draw under the x64 test env sits right at
+        # ~0.5x after 30 steps — leave margin so the bar tests "SGD
+        # trains", not the luck of one RNG draw
+        for _ in range(100):
             l, stages = step(stages)
         assert float(l) < float(l0) * 0.5
 
