@@ -35,7 +35,6 @@ import logging
 import queue
 import threading
 import time
-import weakref
 from typing import Any, List, Optional
 
 import jax
@@ -43,26 +42,18 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.monitoring.metrics import (
-    MetricsRegistry, global_registry)
+    MetricsRegistry)
 from deeplearning4j_tpu.parallel.mesh import default_mesh
+# canonical serving error types + metric names live in serving/ (shared
+# with GenerationEngine); re-exported here for back-compat
+from deeplearning4j_tpu.serving.errors import (  # noqa: F401
+    InferenceTimeout, ServingQueueFull)
+from deeplearning4j_tpu.serving.health import (  # noqa: F401
+    SERVING_DEADLINE_EXCEEDED, SERVING_ERRORS, SERVING_HEALTHY,
+    SERVING_QUEUE_DEPTH, SERVING_QUEUE_REJECTED, SERVING_READY,
+    SERVING_REQUESTS, register_serving_metrics)
 
 log = logging.getLogger(__name__)
-
-SERVING_HEALTHY = "dl4jtpu_serving_healthy"
-SERVING_READY = "dl4jtpu_serving_ready"
-SERVING_QUEUE_DEPTH = "dl4jtpu_serving_queue_depth"
-SERVING_REQUESTS = "dl4jtpu_serving_requests_total"
-SERVING_ERRORS = "dl4jtpu_serving_errors_total"
-SERVING_DEADLINE_EXCEEDED = "dl4jtpu_serving_deadline_exceeded_total"
-SERVING_QUEUE_REJECTED = "dl4jtpu_serving_queue_rejected_total"
-
-
-class InferenceTimeout(TimeoutError):
-    """A per-request deadline expired before a result was ready."""
-
-
-class ServingQueueFull(RuntimeError):
-    """fail_fast admission control rejected a request (queue at limit)."""
 
 
 class _Request:
@@ -153,43 +144,12 @@ class ParallelInference:
                 "mode": self.inference_mode}
 
     def _register_health_gauges(self) -> None:
-        r = self._registry or global_registry()
-        name = type(self.model).__name__
-        # labeled counter handles resolved ONCE: the hot path must not
-        # re-enter the registry's get-or-create lock per request
-        self._counter_handles = {
-            metric: r.counter(metric, help, ("model",)).labels(model=name)
-            for metric, help in (
-                (SERVING_REQUESTS, "Serving requests received"),
-                (SERVING_ERRORS, "Serving requests failed by model errors"),
-                (SERVING_DEADLINE_EXCEEDED,
-                 "Requests that outlived their deadline"),
-                (SERVING_QUEUE_REJECTED,
-                 "Requests rejected by fail_fast admission"),
-            )}
-        # scrape-time callbacks: a crashed worker flips healthy/ready on
-        # the next scrape with no event having fired. One serving stack
-        # per model class per registry; a newer instance takes the series.
-        # The callbacks hold a WEAK ref — a registry series must not pin
-        # a shut-down server (and its device params) alive forever; a
-        # collected instance scrapes as down/empty.
-        ref = weakref.ref(self)
-
-        def probe(fn, default=0.0):
-            def read():
-                inst = ref()
-                return default if inst is None else float(fn(inst))
-            return read
-
-        r.gauge(SERVING_HEALTHY, "Serving loop alive (1) or down (0)",
-                ("model",)).set_function(
-            probe(lambda s: 1.0 if s.is_healthy() else 0.0), model=name)
-        r.gauge(SERVING_READY, "Serving admitting requests (1) or not (0)",
-                ("model",)).set_function(
-            probe(lambda s: 1.0 if s.is_ready() else 0.0), model=name)
-        r.gauge(SERVING_QUEUE_DEPTH, "Requests waiting in the batching "
-                "queue", ("model",)).set_function(
-            probe(lambda s: s.queue_depth()), model=name)
+        # the shared serving-telemetry path (serving/health.py): counter
+        # handles resolved ONCE (the hot path must not re-enter the
+        # registry's get-or-create lock per request) and weakref
+        # scrape-time health gauges — one code path with GenerationEngine
+        self._counter_handles = register_serving_metrics(
+            self, type(self.model).__name__, self._registry)
 
     def _counter(self, metric: str) -> None:
         self._counter_handles[metric].inc()

@@ -40,7 +40,27 @@ from deeplearning4j_tpu.datasets.iterators import DataSetIterator
 
 __all__ = ["ChaosIterator", "InjectedFault", "LatencyIterator",
            "NaNPoisonIterator", "PreemptionIterator", "RaiseOnBatch",
-           "SimulatedPreemption"]
+           "SimulatedPreemption", "fire"]
+
+
+def fire(injector, index: int) -> None:
+    """Drive an injector OUTSIDE an iterator pipeline.
+
+    The serving engine counts its own events — one "batch" per prefill
+    admission or decode dispatch — and fires the injector's
+    ``before_batch(index)`` (which may raise or sleep) exactly like
+    ``_Cursor`` does for iterator-wrapped faults, advancing the
+    injector's global count on success. Pass any ``ChaosIterator``
+    constructed with ``base=None`` (the base is only touched by
+    iteration, which request-level use never does), or a bare callable
+    ``(index) -> None``. None is a no-op."""
+    if injector is None:
+        return
+    if not hasattr(injector, "before_batch"):
+        injector(index)
+        return
+    injector.before_batch(index)
+    injector.batches_seen = max(injector.batches_seen, index + 1)
 
 
 class InjectedFault(RuntimeError):
@@ -57,6 +77,10 @@ class ChaosIterator(DataSetIterator):
     Subclasses override ``before_batch`` (may raise; the underlying batch
     is NOT consumed, so a retry re-delivers it) and/or ``transform``
     (rewrites the batch about to be yielded).
+
+    ``base`` may be None for request-level (non-iterator) use: the
+    serving engine drives ``before_batch`` directly through ``fire()``,
+    one event per prefill admission or decode dispatch.
     """
 
     def __init__(self, base: DataSetIterator, once: bool = True):

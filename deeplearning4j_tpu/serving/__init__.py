@@ -1,0 +1,26 @@
+"""Serving: continuous-batching generation behind admission control.
+
+The generation counterpart of ``parallel.ParallelInference`` (which
+coalesces fixed-shape classification batches): a ``GenerationEngine``
+owns a fixed S-slot streaming-state arena, admits requests into free
+slots mid-flight (prefill via the shared width-bucketed padded prime),
+advances ALL active slots with one canonical jitted decode dispatch per
+step, retires each request individually (stop token / length /
+capacity / deadline / cancel), and streams tokens back through
+per-request ``GenerationStream`` handles. Admission control (bounded
+priority queue, ``block`` | ``fail_fast``), per-request deadlines, and
+the shared ``dl4jtpu_serving_*`` telemetry ride around it.
+
+See ARCHITECTURE.md "Serving engine".
+"""
+
+from deeplearning4j_tpu.serving.engine import GenerationEngine  # noqa: F401
+from deeplearning4j_tpu.serving.errors import (  # noqa: F401
+    EngineShutdown, InferenceTimeout, RequestCancelled, ServingQueueFull)
+from deeplearning4j_tpu.serving.request import (  # noqa: F401
+    GenerationRequest, GenerationStream)
+from deeplearning4j_tpu.serving.scheduler import AdmissionQueue  # noqa: F401
+
+__all__ = ["AdmissionQueue", "EngineShutdown", "GenerationEngine",
+           "GenerationRequest", "GenerationStream", "InferenceTimeout",
+           "RequestCancelled", "ServingQueueFull"]

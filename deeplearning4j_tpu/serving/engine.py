@@ -1,0 +1,651 @@
+"""Continuous-batching generation engine over a slot-based KV arena.
+
+The one-shot batch decoders (``util/decoding.sample_stream_batch``)
+stall a serving batch on its slowest request and re-dispatch from
+scratch per call. This engine decomposes the serving batch into
+independently admitted/retired micro-units (the μ-batching lever,
+arXiv:1804.04806) while keeping the dispatch loop free of per-request
+shape work (the framework-overhead lesson of arXiv:2001.04206):
+
+- **Slot arena**: the net's carried streaming state (attention KV
+  caches, LSTM h/c) lives at a fixed batch of S slots — ONE canonical
+  ``[S, V, 1]`` decode dispatch advances every active request per step,
+  so after warmup the steady state never retraces regardless of request
+  mix. Per-slot positions ride the per-row ``kv_pos`` vector the
+  batched-speculation machinery introduced; free slots idle harmlessly
+  (their writes drop, their outputs are discarded).
+- **Admission mid-flight**: a request prefills at batch 1 through the
+  shared ``_prime_padded`` width buckets (one left-padded dispatch, one
+  jit shape per power-of-two bucket) into a detached state that ONE
+  jitted scatter joins to the arena at its slot — running requests
+  never wait for a newcomer's prompt.
+- **Retirement per request**: stop-token / length / capacity /
+  deadline / cancellation free the slot immediately (host bookkeeping
+  only — no device op); the next queued request takes it on the same
+  step.
+- **Streaming**: tokens stream to a per-request ``GenerationStream``
+  handle as each dispatch retires — TTFT is queue-wait + one prefill,
+  not a batch drain.
+
+Greedy (top_k=1) per-request outputs are bit-identical to one-shot
+``sample_stream`` with the same rng (test-pinned): the arena feeds each
+request exactly the token sequence a dedicated stream would, row
+independence makes the math per-slot, and each request draws from its
+OWN rng in generation order.
+
+Exactness conditions are ``sample_stream_batch``'s: recurrent (LSTM)
+state or attention with rope / no positions. Models with LEARNED
+positional tables are rejected at construction (``pos_offset`` is a
+scalar shared across the batch — it cannot track per-slot positions).
+
+Chaos/resilience seams (tests/test_serving_engine.py drives these with
+``resilience/chaos.py`` injectors): ``prefill_chaos`` fires before each
+admission's prefill — a raise fails THAT request only, the arena is
+restored untouched; ``decode_chaos`` fires before each decode dispatch
+INSIDE the optional ``decode_retry`` RetryPolicy — a transient
+mid-stream preemption is retried with numerics identical to a
+fault-free run (the fault fires before any state mutates).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.monitoring.metrics import (
+    MetricsRegistry, global_registry)
+from deeplearning4j_tpu.nn.conf.layers import (
+    BATCHED_STREAM_KEYS, PositionalEmbeddingLayer, stream_capacity)
+from deeplearning4j_tpu.resilience.chaos import fire as _fire_chaos
+from deeplearning4j_tpu.resilience.retry import RetryPolicy, retry_call
+from deeplearning4j_tpu.serving.errors import (
+    EngineShutdown, InferenceTimeout, RequestCancelled, ServingQueueFull)
+from deeplearning4j_tpu.serving.health import (
+    SERVING_ACTIVE_SLOTS, SERVING_DEADLINE_EXCEEDED, SERVING_ERRORS,
+    SERVING_QUEUE_REJECTED, SERVING_QUEUE_WAIT, SERVING_REQUESTS,
+    SERVING_TOKENS, SERVING_TPOT, SERVING_TTFT, register_serving_metrics,
+    scrape_probe)
+from deeplearning4j_tpu.serving.request import (
+    GenerationRequest, GenerationStream)
+from deeplearning4j_tpu.serving.scheduler import AdmissionQueue
+from deeplearning4j_tpu.util.decoding import (
+    _check_seed, _stream_layers, draw, prime_prompt, step_tokens,
+    stop_reason)
+
+log = logging.getLogger(__name__)
+
+#: stream-state keys the admission scatter writes into the arena row
+#: (kv_mask is deliberately absent: engine prefill is packed/maskless,
+#: so per-slot validity is carried by kv_pos alone)
+_SCATTER_KEYS = frozenset(BATCHED_STREAM_KEYS | {"kv_pos", "kv_abs"}) \
+    - {"kv_mask"}
+
+
+@jax.jit
+def _scatter_rows(arena, primed, slot):
+    """Join one primed request's stream state into the arena at `slot`:
+    batch-leading leaves take the primed row 0, per-row counters
+    (kv_pos [S] <- scalar, kv_abs [S, L] <- [L]) take the primed value.
+    One trace per net structure — `slot` rides as a traced scalar."""
+    out = []
+    for a, p in zip(arena, primed):
+        out.append(a.at[slot].set(p[0] if p.ndim == a.ndim else p))
+    return out
+
+
+class GenerationEngine:
+    """Continuous-batching generation over a fixed S-slot arena.
+
+    Drive it manually (``submit()`` then ``step()`` /
+    ``run_until_idle()`` — deterministic single-threaded serving, the
+    test/bench shape) or start the background loop (``start()`` /
+    ``shutdown()``) and consume ``GenerationStream`` handles from any
+    thread.
+    """
+
+    def __init__(self, net, vocab_size: int, slots: int = 8,
+                 queue_limit: int = 64, queue_policy: str = "block",
+                 prime_padded: bool = True,
+                 registry: Optional[MetricsRegistry] = None,
+                 name: Optional[str] = None,
+                 prefill_chaos=None, decode_chaos=None,
+                 decode_retry: Optional[RetryPolicy] = None):
+        if not hasattr(net, "rnn_time_step"):
+            raise TypeError("GenerationEngine needs a streaming net "
+                            "(rnn_time_step / rnn_clear_previous_state)")
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        if vocab_size < 1:
+            raise ValueError(f"vocab_size must be >= 1, got {vocab_size}")
+        if getattr(net, "_initialized", True) is False:
+            net.init()
+        layers = list(_stream_layers(net))
+        for l in layers:
+            if isinstance(l, PositionalEmbeddingLayer):
+                raise ValueError(
+                    "continuous batching needs per-slot positions: "
+                    "learned positional tables carry a shared pos_offset "
+                    "(use a rope, position-free, or recurrent model)")
+        net_inputs = getattr(getattr(net, "conf", None),
+                             "network_inputs", None)
+        if net_inputs is not None and len(net_inputs) != 1:
+            raise ValueError("GenerationEngine serves single-input "
+                             "decoder graphs only")
+        self.net = net
+        self.V = int(vocab_size)
+        self.slots = int(slots)
+        self._cap = stream_capacity(layers)
+        self._prime_padded = bool(prime_padded)
+        self._label = name or f"engine:{type(net).__name__}"
+        self._graph_vertices = tuple(
+            n for n, v in (getattr(net.conf, "vertices", None) or {}).items()
+            if getattr(getattr(v, "layer", None), "supports_streaming",
+                       False)) if hasattr(net, "conf") else ()
+        self._pending = AdmissionQueue(queue_limit, queue_policy)
+        self._slots: List[Optional[GenerationRequest]] = [None] * slots
+        self._row_pos = np.zeros(slots, np.int64)
+        self._arena_ready = False
+        self._merge_keys = None
+        self._admissions = 0
+        self._dispatches = 0
+        self._prefill_chaos = prefill_chaos
+        self._decode_chaos = decode_chaos
+        self._decode_retry = decode_retry
+        self._stop = threading.Event()
+        self._worker: Optional[threading.Thread] = None
+        self._broken: Optional[BaseException] = None
+        # ONE lock serializes every arena/net touch: step() may run from
+        # the background loop while warmup/manual drivers call in
+        self._lock = threading.RLock()
+        net.rnn_clear_previous_state()     # the engine owns the stream
+        self._register_metrics(registry)
+
+    # ------------------------------------------------------------------
+    # telemetry
+    # ------------------------------------------------------------------
+    def _register_metrics(self, registry) -> None:
+        r = registry or global_registry()
+        self._handles = register_serving_metrics(self, self._label,
+                                                 registry)
+        lab = dict(model=self._label)
+        self._tokens = r.counter(
+            SERVING_TOKENS, "Tokens generated by the serving engine",
+            ("model",)).labels(**lab)
+        self._ttft_hist = r.histogram(
+            SERVING_TTFT, "Seconds from submit to first token",
+            ("model",)).labels(**lab)
+        self._tpot_hist = r.histogram(
+            SERVING_TPOT, "Seconds between consecutive tokens of one "
+            "request", ("model",)).labels(**lab)
+        self._queue_wait_hist = r.histogram(
+            SERVING_QUEUE_WAIT, "Seconds a request waited for admission",
+            ("model",)).labels(**lab)
+        r.gauge(SERVING_ACTIVE_SLOTS, "Arena slots holding an active "
+                "request", ("model",)).set_function(
+            scrape_probe(self, lambda s: s.active_slots()),
+            model=self._label)
+
+    # ------------------------------------------------------------------
+    # health / readiness (the ParallelInference probe contract)
+    # ------------------------------------------------------------------
+    def is_healthy(self) -> bool:
+        if self._broken is not None or self._stop.is_set():
+            return False
+        if self._worker is not None and not self._worker.is_alive():
+            return False
+        return True
+
+    def is_ready(self) -> bool:
+        return self.is_healthy() and not self._pending.full()
+
+    def queue_depth(self) -> int:
+        return self._pending.depth()
+
+    def active_slots(self) -> int:
+        return sum(r is not None for r in self._slots)
+
+    def health(self) -> dict:
+        return {"healthy": self.is_healthy(), "ready": self.is_ready(),
+                "queue_depth": self.queue_depth(),
+                "active_slots": self.active_slots(),
+                "slots": self.slots}
+
+    # ------------------------------------------------------------------
+    # submission
+    # ------------------------------------------------------------------
+    def submit(self, prompt, steps: int, *, temperature: float = 1.0,
+               top_k: Optional[int] = None, top_p: Optional[float] = None,
+               stop_tokens=(), rng=None, timeout: Optional[float] = None,
+               priority: int = 0,
+               max_length: Optional[int] = None) -> GenerationStream:
+        """Queue one prompt for up to `steps` generated tokens; returns
+        its streaming handle immediately (admission happens on a later
+        ``step()``). Arguments mirror ``sample_stream`` — same rng, same
+        stop semantics, `max_length` defaulting to the net's streaming
+        capacity — plus serving controls: `timeout` (end-to-end deadline
+        in seconds; expiry anywhere — queued or mid-generation — fails
+        the handle with InferenceTimeout and frees the slot) and
+        `priority` (higher admitted first)."""
+        if self._broken is not None:
+            raise EngineShutdown("GenerationEngine is broken: "
+                                 f"{self._broken!r}")
+        if self._stop.is_set():
+            raise EngineShutdown("GenerationEngine shut down")
+        prompt = [int(t) for t in prompt]
+        if max_length is None:
+            max_length = self._cap
+        _check_seed(prompt, steps, max_length)
+        if self._cap is not None and len(prompt) > self._cap:
+            raise ValueError(
+                f"prompt of {len(prompt)} tokens exceeds the net's "
+                f"streaming capacity ({self._cap})")
+        self._handles[SERVING_REQUESTS].inc()
+        deadline = None if timeout is None else \
+            time.monotonic() + float(timeout)
+        req = GenerationRequest(
+            prompt, steps, temperature=temperature, top_k=top_k,
+            top_p=top_p, stop_tokens=stop_tokens, rng=rng,
+            max_length=max_length, deadline=deadline, priority=priority)
+        try:
+            self._pending.submit(req)
+        except ServingQueueFull:
+            self._handles[SERVING_QUEUE_REJECTED].inc()
+            raise
+        except InferenceTimeout:
+            self._handles[SERVING_DEADLINE_EXCEEDED].inc()
+            raise
+        return req.handle
+
+    # ------------------------------------------------------------------
+    # the dispatch cycle
+    # ------------------------------------------------------------------
+    def step(self) -> bool:
+        """One engine cycle: expire/cancel, admit into free slots, one
+        decode dispatch over the arena, sample + stream + retire.
+        Returns whether any progress was made (False = idle)."""
+        with self._lock:
+            if self._stop.is_set() or self._broken is not None:
+                return False
+            now = time.monotonic()
+            progress = self._reap(now) > 0
+            progress = self._admit_ready(now) > 0 or progress
+            active = [s for s, r in enumerate(self._slots)
+                      if r is not None]
+            if not active:
+                return progress
+            try:
+                probs = self._dispatch_step()
+            except Exception as e:  # noqa: BLE001 — fail waiters, not hang
+                self._handles[SERVING_ERRORS].inc()
+                self._break(e)
+                return False
+            now = time.monotonic()
+            for s in active:
+                req = self._slots[s]
+                if req is None:        # retired by the capacity guard
+                    continue
+                tok = draw(probs[s], req.temperature, req.rng,
+                           top_k=req.top_k, top_p=req.top_p)
+                if req.last_token_t is not None:
+                    self._tpot_hist.observe(now - req.last_token_t)
+                req.last_token_t = now
+                req.handle._push(tok)
+                self._tokens.inc()
+                reason = stop_reason(tok, len(req.handle._ids), req.want,
+                                     req.stop_tokens)
+                if reason:
+                    self._retire(s, reason)
+                else:
+                    req.pending_token = tok
+            return True
+
+    def run_until_idle(self, max_steps: int = 1_000_000) -> int:
+        """Manually drive ``step()`` until nothing is active or
+        admissible (single-threaded serving: tests, warmup, offline
+        drains). Returns the number of cycles taken."""
+        n = 0
+        while self.step():
+            n += 1
+            if n >= max_steps:
+                raise RuntimeError(f"engine still busy after {n} steps")
+        return n
+
+    def _reap(self, now: float) -> int:
+        """Retire expired/cancelled requests, ACTIVE (frees their slots
+        — a slow consumer cannot squat the arena) and QUEUED (a full
+        arena must not defer a queued request's deadline until a slot
+        happens to free)."""
+        n = 0
+        for req in self._pending.reap(now):
+            n += 1
+            if req.handle.cancelled:
+                req.handle._fail(RequestCancelled(
+                    "request cancelled while queued"), reason="cancelled")
+            else:
+                self._handles[SERVING_DEADLINE_EXCEEDED].inc()
+                req.handle._fail(InferenceTimeout(
+                    "deadline expired in the admission queue"))
+        for s, req in enumerate(self._slots):
+            if req is None:
+                continue
+            if req.handle.cancelled:
+                self._retire(s, "cancelled",
+                             RequestCancelled("request cancelled"))
+                n += 1
+            elif req.deadline is not None and now >= req.deadline:
+                self._handles[SERVING_DEADLINE_EXCEEDED].inc()
+                self._retire(s, "error", InferenceTimeout(
+                    "deadline expired mid-generation "
+                    f"({len(req.handle._ids) - len(req.prompt)} tokens "
+                    "streamed)"))
+                n += 1
+        return n
+
+    def _admit_ready(self, now: float) -> int:
+        """Fill free slots from the admission queue in priority order."""
+        n = 0
+        while None in self._slots:
+            req = self._pending.pop()
+            if req is None:
+                break
+            n += 1
+            if req.handle.cancelled:
+                req.handle._fail(RequestCancelled(
+                    "request cancelled while queued"), reason="cancelled")
+                continue
+            if req.deadline is not None and now >= req.deadline:
+                self._handles[SERVING_DEADLINE_EXCEEDED].inc()
+                req.handle._fail(InferenceTimeout(
+                    "deadline expired in the admission queue"))
+                continue
+            req.handle.queue_wait_s = now - req.submit_t
+            self._queue_wait_hist.observe(req.handle.queue_wait_s)
+            self._admit_one(req, self._slots.index(None))
+        return n
+
+    def _admit_one(self, req: GenerationRequest, slot: int) -> None:
+        """Prefill `req` at batch 1 and join it to the arena at `slot`.
+        A prefill failure fails THAT request only: the arena state is
+        restored untouched, so in-flight requests are unaffected."""
+        net = self.net
+        saved_state = dict(net.state)
+        saved_acct = self._save_accounting()
+        try:
+            _fire_chaos(self._prefill_chaos, self._admissions)
+            net.rnn_clear_previous_state()
+            p0 = prime_prompt(net, req.prompt, self.V,
+                              padded=self._prime_padded)
+            primed_pos = self._net_pos(net)
+        except Exception as e:  # noqa: BLE001 — per-request failure domain
+            net.state = saved_state
+            self._restore_accounting(saved_acct)
+            self._admissions += 1
+            self._handles[SERVING_ERRORS].inc()
+            req.handle._fail(e)
+            return
+        self._admissions += 1
+        primed_state = dict(net.state)
+        tok = draw(p0, req.temperature, req.rng,
+                   top_k=req.top_k, top_p=req.top_p)
+        now = time.monotonic()
+        req.handle.ttft_s = now - req.submit_t
+        self._ttft_hist.observe(req.handle.ttft_s)
+        req.last_token_t = now
+        req.handle._push(tok)
+        self._tokens.inc()
+        reason = stop_reason(tok, len(req.handle._ids), req.want,
+                             req.stop_tokens)
+        if reason is None and self._cap is not None \
+                and primed_pos >= self._cap:
+            reason = "capacity"    # prompt filled the stream: no room
+        if reason:
+            # one-token request: never enters the arena at all
+            net.state = saved_state
+            self._restore_accounting(saved_acct)
+            req.handle._finish(reason)
+            return
+        if not self._arena_ready:
+            saved_state = self._build_arena(primed_state, saved_state)
+            self._arena_ready = True
+        net.state = self._merge(saved_state, primed_state, slot)
+        self._slots[slot] = req
+        self._row_pos[slot] = primed_pos
+        req.pending_token = tok
+        self._sync_accounting()
+
+    def _dispatch_step(self):
+        """ONE jitted decode dispatch advancing every active slot (free
+        rows feed token 0; their outputs are discarded, their writes
+        drop). Slots at streaming capacity retire first — they cannot
+        consume another position."""
+        if self._cap is not None:
+            for s, req in enumerate(self._slots):
+                if req is not None and self._row_pos[s] >= self._cap:
+                    self._retire(s, "capacity")
+        toks = np.zeros(self.slots, np.int64)
+        for s, req in enumerate(self._slots):
+            if req is not None:
+                toks[s] = req.pending_token
+        if not any(r is not None for r in self._slots):
+            return None     # everything retired at the capacity guard
+        self._sync_accounting()
+
+        def once():
+            # chaos INSIDE the retried callable: the fault fires before
+            # any state mutates, so a retried dispatch is numerically
+            # identical to a fault-free one
+            _fire_chaos(self._decode_chaos, self._dispatches)
+            return step_tokens(self.net, toks, self.V)
+
+        probs = (retry_call(once, policy=self._decode_retry,
+                            op="serving_decode")
+                 if self._decode_retry is not None else once())
+        self._dispatches += 1
+        for s, req in enumerate(self._slots):
+            if req is not None:
+                self._row_pos[s] += 1
+        self._sync_accounting()
+        return probs
+
+    def _retire(self, slot: int, reason: str,
+                exc: Optional[BaseException] = None) -> None:
+        """Free `slot` immediately — host bookkeeping only, no device
+        op: the row's stale cache is invisible (its writes drop, its
+        output is discarded) until the next admission overwrites it."""
+        req = self._slots[slot]
+        self._slots[slot] = None
+        self._row_pos[slot] = 0
+        if exc is not None:
+            req.handle._fail(exc, reason)
+        else:
+            req.handle._finish(reason)
+
+    # ------------------------------------------------------------------
+    # arena state plumbing
+    # ------------------------------------------------------------------
+    def _build_arena(self, primed_state, base_state):
+        """First-admission skeleton: every stream key of the primed
+        structure broadcast to S zeroed rows (kv_abs rows start -1 =
+        empty, matching a fresh rolling cache), per-row kv_pos vector at
+        0. Free rows are inert: nothing reads them until a scatter
+        overwrites them."""
+        S = self.slots
+        arena = {}
+        for name, s in primed_state.items():
+            if not isinstance(s, dict):
+                arena[name] = s
+                continue
+            if "kv_mask" in s:
+                raise RuntimeError(
+                    "engine prefill must be maskless (packed padded "
+                    "priming) — a kv_mask in the primed state means the "
+                    "stream was primed with an explicit mask")
+            d = dict(base_state.get(name, {}) if isinstance(
+                base_state.get(name), dict) else {})
+            d.update({k: v for k, v in s.items()
+                      if k not in _SCATTER_KEYS})
+            for k, v in s.items():
+                if k not in _SCATTER_KEYS:
+                    continue
+                v = jnp.asarray(v)
+                if k == "kv_pos":
+                    d[k] = jnp.zeros((S,), v.dtype)
+                elif k == "kv_abs":
+                    d[k] = jnp.full((S,) + v.shape, -1, v.dtype)
+                else:                      # batch-leading cache/state
+                    d[k] = jnp.zeros((S,) + v.shape[1:], v.dtype)
+            arena[name] = d
+        return arena
+
+    def _merge(self, arena_state, primed_state, slot: int):
+        if self._merge_keys is None:
+            self._merge_keys = [
+                (n, k) for n in sorted(primed_state)
+                if isinstance(primed_state[n], dict)
+                for k in sorted(primed_state[n])
+                if k in _SCATTER_KEYS]
+        arena_leaves = [arena_state[n][k] for n, k in self._merge_keys]
+        primed_leaves = [primed_state[n][k] for n, k in self._merge_keys]
+        new_leaves = _scatter_rows(arena_leaves, primed_leaves,
+                                   np.int32(slot))
+        out = {n: (dict(v) if isinstance(v, dict) else v)
+               for n, v in arena_state.items()}
+        for (n, k), leaf in zip(self._merge_keys, new_leaves):
+            out[n][k] = leaf
+        return out
+
+    @staticmethod
+    def _net_pos(net) -> int:
+        pm = getattr(net, "_stream_pos_map", None)
+        if pm:
+            return int(max(pm.values()))
+        return int(getattr(net, "_stream_pos", 0) or 0)
+
+    def _save_accounting(self):
+        net = self.net
+        pm = getattr(net, "_stream_pos_map", None)
+        return (getattr(net, "_stream_pos", 0),
+                getattr(net, "_stream_pos_rows", None),
+                dict(pm) if pm is not None else None)
+
+    def _restore_accounting(self, saved) -> None:
+        pos, rows, pmap = saved
+        net = self.net
+        net._stream_pos = pos
+        net._stream_pos_rows = rows
+        if pmap is not None:
+            net._stream_pos_map = pmap
+
+    def _sync_accounting(self) -> None:
+        """Engine-owned host position mirrors: active rows carry their
+        true positions, free rows pin to 0 so an idle slot can never
+        trip the stream-budget guard while its device-side counter
+        coasts (those writes drop harmlessly)."""
+        net = self.net
+        mask = np.array([r is not None for r in self._slots])
+        rows = np.where(mask, self._row_pos, 0).astype(np.int64)
+        pos = int(rows.max()) if mask.any() else 0
+        net._stream_pos = pos
+        net._stream_pos_rows = rows
+        if self._graph_vertices:
+            net._stream_pos_map = {n: pos for n in self._graph_vertices}
+
+    # ------------------------------------------------------------------
+    # warmup
+    # ------------------------------------------------------------------
+    def warmup(self, max_prompt_len: Optional[int] = None,
+               steps: int = 2) -> "GenerationEngine":
+        """Compile every canonical serving shape before traffic: one
+        synthetic greedy request per power-of-two prime bucket up to
+        bucket(max_prompt_len) (default: the net's streaming capacity),
+        driven to completion. Warms the per-bucket prefill, the
+        scatter-join, and the [S, V, 1] decode dispatch, so staggered
+        admissions of ANY prompt length <= max_prompt_len afterwards
+        cause zero retraces (the PR 3 acceptance bar)."""
+        if self._worker is not None and self._worker.is_alive():
+            raise RuntimeError("warm up before start(): warmup drives "
+                               "step() manually")
+        cap = self._cap
+        top = max_prompt_len
+        if top is None:
+            top = (cap - 1) if cap is not None else 64
+        top = max(1, int(top))
+        lens, n = [], 1
+        while n <= top:
+            lens.append(n)
+            n *= 2
+        if top not in lens:
+            lens.append(top)      # a non-pow2 top primes at bucket(top)
+        if cap is not None:
+            lens = sorted({min(v, cap - 1) for v in lens})
+        tok = 1 if self.V > 1 else 0
+        for v in lens:
+            # drain per bucket: warmup must not depend on queue_limit
+            # headroom (block policy would deadlock, fail_fast would
+            # reject, with more buckets than queue slots)
+            h = self.submit([tok] * v, steps=steps, top_k=1,
+                            rng=np.random.default_rng(0))
+            self.run_until_idle()
+            h.result(timeout=0)
+        return self
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def start(self) -> "GenerationEngine":
+        """Run the dispatch loop on a background thread (the serving
+        deployment shape; manual ``step()`` still works for warmup)."""
+        if self._stop.is_set():
+            raise EngineShutdown("GenerationEngine shut down")
+        if self._worker is not None and self._worker.is_alive():
+            return self
+        self._worker = threading.Thread(target=self._engine_loop,
+                                        daemon=True)
+        self._worker.start()
+        return self
+
+    def _engine_loop(self):
+        try:
+            while not self._stop.is_set():
+                if not self.step():
+                    self._pending.wait(0.02)
+        except Exception as e:  # noqa: BLE001 — strand no waiters
+            log.exception("GenerationEngine loop died")
+            self._break(e)
+
+    def _break(self, exc: BaseException) -> None:
+        """Terminal failure: fail every in-flight and queued request
+        with the original error and refuse new work. A broken arena is
+        not resumable (the failed dispatch may or may not have consumed
+        positions)."""
+        with self._lock:
+            self._broken = exc
+            # stop the loop too: with the queue closed, wait() returns
+            # immediately — a broken engine must park, not busy-spin
+            self._stop.set()
+            for s, req in enumerate(self._slots):
+                if req is not None:
+                    self._retire(s, "error", exc)
+            for req in self._pending.close():
+                req.handle._fail(exc)
+
+    def shutdown(self) -> None:
+        """Stop the loop and fail everything still in flight — nobody
+        blocks forever on a dead server (the ParallelInference
+        contract). Idempotent."""
+        self._stop.set()
+        for req in self._pending.close():
+            req.handle._fail(EngineShutdown("GenerationEngine shut down"))
+        if self._worker is not None and self._worker.is_alive():
+            self._worker.join(timeout=5.0)
+        with self._lock:
+            for s, req in enumerate(self._slots):
+                if req is not None:
+                    self._retire(s, "error", EngineShutdown(
+                        "GenerationEngine shut down"))

@@ -1,0 +1,30 @@
+"""Shared serving error types.
+
+One vocabulary of serving failures for every serving component —
+``ParallelInference`` (coalesced fixed-shape classification batches) and
+``GenerationEngine`` (continuous-batching generation) raise the SAME
+exceptions for the same conditions, so a front end's error handling is
+written once. ``parallel.inference`` re-exports these names for
+back-compat with pre-serving/ imports.
+"""
+
+from __future__ import annotations
+
+__all__ = ["EngineShutdown", "InferenceTimeout", "RequestCancelled",
+           "ServingQueueFull"]
+
+
+class InferenceTimeout(TimeoutError):
+    """A per-request deadline expired before a result was ready."""
+
+
+class ServingQueueFull(RuntimeError):
+    """fail_fast admission control rejected a request (queue at limit)."""
+
+
+class RequestCancelled(RuntimeError):
+    """The caller cancelled a request before it finished."""
+
+
+class EngineShutdown(RuntimeError):
+    """The serving component stopped before this request finished."""

@@ -1,0 +1,91 @@
+"""Shared serving telemetry registration.
+
+Every serving component publishes the same ``dl4jtpu_serving_*`` series
+through ONE code path (this module) instead of per-component copies:
+request/error/deadline/rejection counters with their handles resolved
+once (the hot path must not re-enter the registry's get-or-create lock
+per request), and scrape-time health gauges holding a WEAK reference —
+a registry series must not pin a shut-down server (and its device
+params) alive forever; a collected instance scrapes as down/empty.
+
+``ParallelInference`` and ``GenerationEngine`` both register here; the
+``model`` label value distinguishes their series (the engine prefixes
+``engine:``).
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Dict, Optional
+
+from deeplearning4j_tpu.monitoring.metrics import (
+    MetricsRegistry, global_registry)
+
+SERVING_HEALTHY = "dl4jtpu_serving_healthy"
+SERVING_READY = "dl4jtpu_serving_ready"
+SERVING_QUEUE_DEPTH = "dl4jtpu_serving_queue_depth"
+SERVING_REQUESTS = "dl4jtpu_serving_requests_total"
+SERVING_ERRORS = "dl4jtpu_serving_errors_total"
+SERVING_DEADLINE_EXCEEDED = "dl4jtpu_serving_deadline_exceeded_total"
+SERVING_QUEUE_REJECTED = "dl4jtpu_serving_queue_rejected_total"
+
+#: continuous-batching engine extras (engine.py registers these)
+SERVING_ACTIVE_SLOTS = "dl4jtpu_serving_active_slots"
+SERVING_TOKENS = "dl4jtpu_serving_tokens_total"
+SERVING_TTFT = "dl4jtpu_serving_ttft_seconds"
+SERVING_TPOT = "dl4jtpu_serving_tpot_seconds"
+SERVING_QUEUE_WAIT = "dl4jtpu_serving_queue_wait_seconds"
+
+_COUNTERS = (
+    (SERVING_REQUESTS, "Serving requests received"),
+    (SERVING_ERRORS, "Serving requests failed by model errors"),
+    (SERVING_DEADLINE_EXCEEDED, "Requests that outlived their deadline"),
+    (SERVING_QUEUE_REJECTED, "Requests rejected by fail_fast admission"),
+)
+
+
+def scrape_probe(component, fn, default: float = 0.0):
+    """Scrape-time gauge callback over a WEAK reference to `component`:
+    reads ``fn(component)`` at collection time, `default` once the
+    component is collected. The one probe shape every serving gauge
+    uses — fix it here, every component's gauges follow."""
+    ref = weakref.ref(component)
+
+    def read():
+        inst = ref()
+        return default if inst is None else float(fn(inst))
+    return read
+
+
+def register_serving_metrics(component, model: str,
+                             registry: Optional[MetricsRegistry] = None
+                             ) -> Dict[str, object]:
+    """Register the shared serving series for `component` and return its
+    resolved counter handles ``{metric name: handle}``.
+
+    `component` must expose ``is_healthy()`` / ``is_ready()`` /
+    ``queue_depth()``; the healthy/ready/queue-depth gauges are
+    scrape-time callbacks over a weakref to it, so a crashed worker
+    flips them on the next scrape with no event having fired. One
+    serving stack per `model` label value per registry; a newer
+    instance takes over the series.
+    """
+    r = registry or global_registry()
+    handles = {
+        metric: r.counter(metric, help, ("model",)).labels(model=model)
+        for metric, help in _COUNTERS}
+    r.gauge(SERVING_HEALTHY, "Serving loop alive (1) or down (0)",
+            ("model",)).set_function(
+        scrape_probe(component,
+                     lambda s: 1.0 if s.is_healthy() else 0.0),
+        model=model)
+    r.gauge(SERVING_READY, "Serving admitting requests (1) or not (0)",
+            ("model",)).set_function(
+        scrape_probe(component,
+                     lambda s: 1.0 if s.is_ready() else 0.0),
+        model=model)
+    r.gauge(SERVING_QUEUE_DEPTH,
+            "Requests waiting in the admission queue",
+            ("model",)).set_function(
+        scrape_probe(component, lambda s: s.queue_depth()), model=model)
+    return handles

@@ -29,52 +29,162 @@ def _probs(out) -> np.ndarray:
     return np.asarray(out[0] if isinstance(out, (list, tuple)) else out)
 
 
-def filter_probs(probs, temperature: float,
-                 top_k: Optional[int] = None,
-                 top_p: Optional[float] = None) -> np.ndarray:
+def filter_probs(probs, temperature,
+                 top_k=None, top_p=None) -> np.ndarray:
     """The sampling distribution actually drawn from: temperature
     rescales first, then `top_k` keeps exactly the k most probable
     tokens, then `top_p` (nucleus) keeps the smallest prefix of the
     sorted distribution whose mass reaches p (always at least one
     token); survivors renormalize. Shared by draw() and the
     speculative-decoding acceptance rule (which needs the filtered
-    distributions themselves, not just a sample)."""
-    logits = np.log(np.clip(probs, 1e-9, None)) / temperature
-    p = np.exp(logits - logits.max())
-    p /= p.sum()
+    distributions themselves, not just a sample).
+
+    `probs` is one row [V] or a batch [B, V]. For a batch,
+    `temperature`/`top_k`/`top_p` may each be a scalar (shared) or a
+    [B] array (PER-ROW — one serving arena can hold requests with
+    mixed sampling configs). Per-row `top_k`/`top_p` entries <= 0
+    disable that filter for that row; per-row temperature entries must
+    be positive. The single-row form is the batch form at B=1, so
+    batched filtering is row-for-row identical to the scalar path
+    (test-pinned)."""
+    probs = np.asarray(probs)
+    if probs.ndim == 1:
+        return _filter_rows(probs[None, :], temperature, top_k, top_p)[0]
+    if probs.ndim != 2:
+        raise ValueError(f"probs must be [V] or [B, V], got shape "
+                         f"{probs.shape}")
+    return _filter_rows(probs, temperature, top_k, top_p)
+
+
+def _row_array(v, B: int, name: str):
+    """Validate a scalar-or-[B] sampling parameter; returns (array or
+    None, is_per_row)."""
+    if v is None:
+        return None, False
+    a = np.asarray(v)
+    if a.ndim == 0:
+        return a, False
+    if a.shape != (B,):
+        raise ValueError(f"{name} must be a scalar or one value per row "
+                         f"({a.shape} != ({B},))")
+    return a, True
+
+
+def _filter_rows(p2, temperature, top_k, top_p):
+    """Vectorized filter over [B, V] rows (see filter_probs)."""
+    B, V = p2.shape
+    logits = np.log(np.clip(p2, 1e-9, None))
+    t, t_rows = _row_array(temperature, B, "temperature")
+    if t_rows:
+        if (np.asarray(t) <= 0).any():
+            raise ValueError("per-row temperature entries must be > 0")
+        logits = logits / t.astype(logits.dtype)[:, None]
+    else:
+        logits = logits / t.astype(logits.dtype)
+    p = np.exp(logits - logits.max(axis=-1, keepdims=True))
+    p = p / p.sum(axis=-1, keepdims=True)
     if top_k is not None:
-        if top_k < 1:
-            raise ValueError(f"top_k must be >= 1, got {top_k}")
-        if top_k < len(p):
-            # exactly k indices (a value threshold would keep every token
-            # TIED with the kth — e.g. a clipped flat tail — and sample
-            # the whole vocab precisely when users reach for top_k)
-            keep_idx = np.argpartition(p, -top_k)[-top_k:]
-            mask = np.zeros_like(p, dtype=bool)
-            mask[keep_idx] = True
-            p = np.where(mask, p, 0.0)
-            p /= p.sum()
+        k, k_rows = _row_array(top_k, B, "top_k")
+        if not k_rows and int(k) < 1:
+            raise ValueError(f"top_k must be >= 1, got {int(k)}")
+        krow = (np.where(k > 0, k, V) if k_rows
+                else np.full(B, int(k))).astype(np.int64)
+        row_on = krow < V
+        if row_on.any():
+            # exactly k indices per row (a value threshold would keep
+            # every token TIED with the kth — e.g. a clipped flat tail —
+            # and sample the whole vocab precisely when users reach for
+            # top_k). This runs once per sampled token on the serving
+            # hot path, so stay O(V): partition out the top kmax
+            # candidates, sort only that slice, then cut each row at its
+            # own k. Off rows bypass bit-exactly: keep all, divide by 1.
+            kmax = int(krow[row_on].max())
+            part = np.argpartition(p, V - kmax, axis=-1)[:, V - kmax:]
+            vals = np.take_along_axis(p, part, axis=-1)
+            order = np.take_along_axis(
+                part, np.argsort(vals, axis=-1)[:, ::-1], axis=-1)
+            keep = np.zeros((B, V), bool)
+            np.put_along_axis(
+                keep, order,
+                np.arange(kmax)[None, :] < krow[:, None], axis=-1)
+            keep |= ~row_on[:, None]
+            p = np.where(keep, p, 0.0)
+            denom = np.where(row_on, p.sum(axis=-1), 1.0)
+            p = p / denom[:, None]
     if top_p is not None:
-        if not 0.0 < top_p <= 1.0:
-            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-        order = np.argsort(p)[::-1]
-        csum = np.cumsum(p[order])
-        # smallest prefix reaching top_p, never empty
-        cut = int(np.searchsorted(csum, top_p)) + 1
-        keep = np.zeros_like(p, dtype=bool)
-        keep[order[:cut]] = True
-        p = np.where(keep, p, 0.0)
-        p /= p.sum()
+        tp, tp_rows = _row_array(top_p, B, "top_p")
+        tp = np.asarray(tp, np.float64)
+        if tp_rows:
+            if (tp > 1.0).any():
+                raise ValueError(f"top_p entries must be <= 1, got "
+                                 f"{tp.max()}")
+            row_on = tp > 0                           # <= 0: filter off
+            prow = np.where(row_on, tp, 1.0)
+        else:
+            if not 0.0 < float(tp) <= 1.0:
+                raise ValueError(f"top_p must be in (0, 1], got "
+                                 f"{float(tp)}")
+            row_on = np.ones(B, bool)
+            prow = np.full(B, float(tp))
+        if row_on.any():
+            order = np.argsort(p, axis=-1)[:, ::-1]
+            ps = np.take_along_axis(p, order, axis=-1)
+            csum = np.cumsum(ps, axis=-1)
+            # keep the smallest prefix whose mass reaches p, never
+            # empty: a sorted token survives iff the mass STRICTLY
+            # BEFORE it is under top_p (the exact searchsorted-left
+            # rule, shifted-cumsum form). Off rows bypass bit-exactly.
+            before = np.concatenate(
+                [np.zeros((B, 1), csum.dtype), csum[:, :-1]], axis=1)
+            keep = np.zeros((B, V), bool)
+            np.put_along_axis(keep, order, before < prow[:, None],
+                              axis=-1)
+            keep |= ~row_on[:, None]
+            p = np.where(keep, p, 0.0)
+            denom = np.where(row_on, p.sum(axis=-1), 1.0)
+            p = p / denom[:, None]
     return p
 
 
-def draw(probs, temperature: float, rng: np.random.Generator,
-         top_k: Optional[int] = None,
-         top_p: Optional[float] = None) -> int:
-    """Sample one token id from a softmax distribution (the single draw
+def per_row_param(v, b: int):
+    """Row `b`'s value of a scalar-or-per-row `top_k`/`top_p` parameter
+    (per-row array entries <= 0 mean the filter is off for that row —
+    returned as None, the scalar-API spelling of off)."""
+    if v is None:
+        return None
+    a = np.asarray(v)
+    if a.ndim == 0:
+        return v
+    x = a[b]
+    if x <= 0:
+        return None
+    return int(x) if np.issubdtype(a.dtype, np.integer) else float(x)
+
+
+def draw(probs, temperature, rng,
+         top_k=None, top_p=None):
+    """Sample token ids from softmax distributions (the single draw
     implementation shared by every sampler); see filter_probs for the
-    temperature/top_k/top_p semantics. top_k=1 is greedy decoding
-    regardless of temperature."""
+    temperature/top_k/top_p semantics (incl. the per-row array forms).
+    top_k=1 is greedy decoding regardless of temperature.
+
+    One row [V] returns an int. A batch [B, V] returns a list of ints;
+    `rng` is then either one Generator (consumed row-major) or a
+    sequence of one Generator per row — independent per-request
+    streams. (The serving engine itself draws row-by-row through the
+    single-row form so each request's rng consumption is positionally
+    identical to its one-shot sample_stream run; both forms share ONE
+    filter kernel, `_filter_rows`.)"""
+    probs = np.asarray(probs)
+    if probs.ndim == 2:
+        p = filter_probs(probs, temperature, top_k, top_p)
+        rngs = (list(rng) if isinstance(rng, (list, tuple))
+                else [rng] * len(p))
+        if len(rngs) != len(p):
+            raise ValueError(f"need one rng per row "
+                             f"({len(rngs)} != {len(p)})")
+        return [int(r.choice(p.shape[1], p=row))
+                for r, row in zip(rngs, p)]
     p = filter_probs(probs, temperature, top_k, top_p)
     return int(rng.choice(len(p), p=p))
 
@@ -201,6 +311,45 @@ def _prime_padded(net, ids, vocab: int, chunk_max: int = None):
     return net.rnn_time_step(x, pad_left=pad)
 
 
+def prime_prompt(net, ids, vocab_size: int, padded: bool = False,
+                 chunk_max: Optional[int] = None) -> np.ndarray:
+    """Prefill: feed the whole prompt through the carried streaming
+    state and return the next-token distribution [V]. `padded=True`
+    primes in ONE left-padded bucketed dispatch (_prime_padded);
+    otherwise chunked priming (_prime) — exactness is identical, pinned
+    by the padded-prime tests. Does NOT clear previous state: the
+    caller owns the stream lifecycle (sample_stream clears first; the
+    serving engine primes into a fresh state it then joins to its slot
+    arena)."""
+    out = (_prime_padded(net, ids, vocab_size, chunk_max) if padded
+           else _prime(net, ids, vocab_size, chunk_max))
+    return _probs(out)[0, :, -1]
+
+
+def step_tokens(net, tokens, vocab_size: int) -> np.ndarray:
+    """One incremental decode step for a batch of rows: feed one token
+    per row in a single dispatch, return the next-token distributions
+    [B, V]. The per-step unit shared by sample_stream (B=1),
+    sample_stream_batch, and the serving engine's slot arena (B=S,
+    canonical shape, zero retraces after the first step)."""
+    out = net.rnn_time_step(
+        _one_hot(np.asarray(tokens, np.int64)[:, None], vocab_size))
+    return _probs(out)[:, :, -1]
+
+
+def stop_reason(token: int, n_ids: int, want: int,
+                stop_set) -> Optional[str]:
+    """Why generation ends after appending `token` as the n_ids-th id
+    (None = keep going). EOS wins over length when both hit — the stop
+    token is kept as the final id either way. The single copy of the
+    retirement rule shared by sample_stream and the serving engine."""
+    if token in stop_set:
+        return "stop"
+    if n_ids >= want:
+        return "length"
+    return None
+
+
 def sample_stream(net, seed_ids, steps: int, vocab_size: int,
                   temperature: float = 1.0,
                   rng: Optional[np.random.Generator] = None,
@@ -224,22 +373,21 @@ def sample_stream(net, seed_ids, steps: int, vocab_size: int,
     rng = rng or np.random.default_rng(0)
     stop_tokens = set(stop_tokens)
     ids = list(seed_ids)
+    want = len(ids) + steps
+    if max_length is not None:
+        want = min(want, max_length)
     net.rnn_clear_previous_state()
-    out = (_prime_padded(net, ids, vocab_size, prime_chunk_max)
-           if prime_padded
-           else _prime(net, ids, vocab_size, prime_chunk_max))
+    p = prime_prompt(net, ids, vocab_size, padded=prime_padded,
+                     chunk_max=prime_chunk_max)
     for i in range(steps):
         if max_length is not None and len(ids) >= max_length:
             break
-        nxt = draw(_probs(out)[0, :, -1], temperature, rng,
-                   top_k=top_k, top_p=top_p)
+        nxt = draw(p, temperature, rng, top_k=top_k, top_p=top_p)
         ids.append(nxt)
-        if nxt in stop_tokens:
+        if stop_reason(nxt, len(ids), want, stop_tokens):
             break
-        if i + 1 < steps and (max_length is None
-                              or len(ids) < max_length):
-            out = net.rnn_time_step(_one_hot(np.asarray([[nxt]]),
-                                             vocab_size))
+        if i + 1 < steps:
+            p = step_tokens(net, [nxt], vocab_size)[0]
     return ids
 
 
@@ -294,6 +442,11 @@ def sample_stream_batch(net, prompts, steps: int, vocab_size: int,
     equal-length prompts (pads would shift the table lookups) —
     enforced here.
 
+    `temperature`/`top_k`/`top_p` may each be PER-ROW [B] arrays (see
+    filter_probs): one batch serves prompts with mixed sampling
+    configs. Per-row top_k/top_p entries <= 0 switch that filter off
+    for that row.
+
     The batch shares stream positions: every row consumes the padded
     prompt length plus one position per step, so rows stop early (with
     fewer than `steps` tokens) when the net's smallest streaming
@@ -308,19 +461,29 @@ def sample_stream_batch(net, prompts, steps: int, vocab_size: int,
     for p in prompts:
         _check_seed(p, steps, max_length)
     B, V = len(prompts), vocab_size
+    for name, v in (("temperature", temperature), ("top_k", top_k),
+                    ("top_p", top_p)):
+        _row_array(v, B, name)         # validate per-row shapes early
+    temp_rows = np.ndim(temperature) > 0
+    if temp_rows and (np.asarray(temperature) <= 0).any():
+        raise ValueError("per-row temperature entries must be > 0")
     out, T, B, Bb, cap = _batch_prime(net, prompts, V)
+    probs = _probs(out)[:, :, -1]                           # [Bb, V]
     ids = [list(p) for p in prompts]
     stopped = [False] * B
     done = (lambda b: stopped[b] or (max_length is not None
                                      and len(ids[b]) >= max_length))
     for i in range(steps):
-        probs = _probs(out)[:, :, -1]                       # [Bb, V]
         tok = np.zeros(Bb, np.int64)
         for b in range(B):
             if done(b):
                 continue
-            tok[b] = draw(probs[b], temperature, rng,
-                          top_k=top_k, top_p=top_p)
+            tok[b] = draw(
+                probs[b],
+                float(np.asarray(temperature)[b]) if temp_rows
+                else temperature,
+                rng, top_k=per_row_param(top_k, b),
+                top_p=per_row_param(top_p, b))
             ids[b].append(int(tok[b]))
             if tok[b] in stop_tokens:
                 stopped[b] = True
@@ -329,7 +492,7 @@ def sample_stream_batch(net, prompts, steps: int, vocab_size: int,
         if i + 1 < steps:
             if cap is not None and T + i + 1 > cap:
                 break                  # shared stream positions full
-            out = net.rnn_time_step(_one_hot(tok[:, None], V))
+            probs = step_tokens(net, tok, V)
     return ids
 
 

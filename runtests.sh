@@ -40,6 +40,12 @@ python -m pytest tests/test_input_pipeline.py -q -p no:cacheprovider
 # The unhappy paths must stay green before the full suite runs.
 python -m pytest tests/test_resilience.py -q -p no:cacheprovider
 
+# tier-1 serving lane: the continuous-batching engine (serving/) — the
+# engine-vs-one-shot bit-exactness contract, slot lifecycle, admission
+# control/deadlines, chaos isolation, and the zero-retraces-after-warmup
+# guard across staggered admissions
+python -m pytest tests/test_serving_engine.py -q -p no:cacheprovider
+
 python -m pytest tests/ -q --junitxml=/tmp/dl4jtpu_junit.xml "$@"
 
 # only a FULL unfiltered run may overwrite the committed tally — a

@@ -111,3 +111,29 @@ class TestBatchDecode:
                                       temperature=0.8,
                                       rng=np.random.default_rng(3))
         assert a == b
+
+    def test_per_row_sampling_params(self):
+        """One batch serves mixed sampling configs: per-row
+        temperature/top_k/top_p arrays (top_k entry 0 = filter off for
+        that row); the greedy row still equals per-prompt greedy."""
+        model = _rope_model()
+        net = model.init()
+        prompts = [[1, 2, 3], [4, 5], [6, 7, 8]]
+        temps = np.array([1.0, 0.7, 1.2])
+        ks = np.array([1, 3, 0])
+        got = model.sample_stream_batch(net, prompts, steps=5,
+                                        temperature=temps, top_k=ks,
+                                        rng=np.random.default_rng(4))
+        again = model.sample_stream_batch(net, prompts, steps=5,
+                                          temperature=temps, top_k=ks,
+                                          rng=np.random.default_rng(4))
+        assert got == again                       # deterministic
+        greedy = model.sample_stream(net, prompts[0], steps=5, top_k=1)
+        assert got[0] == greedy                   # top_k=1 row is greedy
+
+    def test_per_row_param_length_validated(self):
+        model = _rope_model()
+        net = model.init()
+        with pytest.raises(ValueError, match="top_k"):
+            model.sample_stream_batch(net, [[1, 2], [3, 4]], steps=2,
+                                      top_k=np.array([1, 2, 3]))

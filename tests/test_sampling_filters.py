@@ -4,7 +4,7 @@
 import numpy as np
 import pytest
 
-from deeplearning4j_tpu.util.decoding import draw
+from deeplearning4j_tpu.util.decoding import draw, filter_probs
 from deeplearning4j_tpu.zoo import TextGenerationTransformer
 
 
@@ -94,3 +94,64 @@ class TestEntryPoints:
         ids = model.sample_stream(net, [1, 2, 3], steps=5, top_p=0.9,
                                   rng=np.random.default_rng(1))
         assert len(ids) == 8 and all(0 <= i < 12 for i in ids)
+
+
+class TestPerRowFilters:
+    """Vectorized batch filtering (filter_probs/draw over [B, V] with
+    per-row temperature/top_k/top_p) == the scalar path row for row."""
+
+    def _batch(self, B=6, V=32, seed=0):
+        rng = np.random.default_rng(seed)
+        p = rng.random((B, V))
+        return p / p.sum(axis=-1, keepdims=True)
+
+    def test_batch_equals_scalar_rows_shared_params(self):
+        p = self._batch()
+        got = filter_probs(p, 0.8, top_k=5, top_p=0.9)
+        for b in range(len(p)):
+            want = filter_probs(p[b], 0.8, top_k=5, top_p=0.9)
+            np.testing.assert_array_equal(got[b], want)
+
+    def test_batch_equals_scalar_rows_per_row_params(self):
+        p = self._batch()
+        temps = np.array([0.5, 0.8, 1.0, 1.3, 2.0, 0.7])
+        ks = np.array([1, 3, 0, 8, 0, 2])      # 0 = top_k off
+        ps = np.array([0.0, 0.9, 0.5, 0.0, 0.99, 1.0])  # 0 = off
+        got = filter_probs(p, temps, top_k=ks, top_p=ps)
+        for b in range(len(p)):
+            want = filter_probs(
+                p[b], float(temps[b]),
+                top_k=int(ks[b]) if ks[b] > 0 else None,
+                top_p=float(ps[b]) if ps[b] > 0 else None)
+            np.testing.assert_array_equal(got[b], want)
+
+    def test_per_row_off_entries_leave_row_unfiltered(self):
+        p = self._batch(B=2, V=8)
+        got = filter_probs(p, 1.0, top_k=np.array([2, 0]))
+        assert (got[0] > 0).sum() == 2
+        assert (got[1] > 0).sum() == 8
+
+    def test_draw_batch_with_per_row_rngs(self):
+        p = self._batch(B=4, V=16, seed=3)
+        rngs = [np.random.default_rng(b) for b in range(4)]
+        got = draw(p, 1.0, rngs, top_k=np.array([1, 4, 0, 2]))
+        want = [draw(p[b], 1.0, np.random.default_rng(b),
+                     top_k=[1, 4, None, 2][b])
+                for b in range(4)]
+        assert got == want
+
+    def test_greedy_rows_are_argmax(self):
+        p = self._batch(B=3, V=10, seed=5)
+        got = draw(p, 2.0, np.random.default_rng(0), top_k=1)
+        assert got == list(p.argmax(axis=-1))
+
+    def test_batch_validation(self):
+        p = self._batch(B=3, V=8)
+        with pytest.raises(ValueError, match="temperature"):
+            filter_probs(p, np.array([1.0, 1.0]))       # wrong length
+        with pytest.raises(ValueError, match="> 0"):
+            filter_probs(p, np.array([1.0, -1.0, 1.0]))
+        with pytest.raises(ValueError, match="top_p"):
+            filter_probs(p, 1.0, top_p=np.array([0.5, 1.5, 0.5]))
+        with pytest.raises(ValueError, match="rng per row"):
+            draw(p, 1.0, [np.random.default_rng(0)])

@@ -1,0 +1,515 @@
+"""Continuous-batching generation engine (serving/): per-request outputs
+bit-identical to one-shot sample_stream, slot lifecycle, admission
+control, chaos coverage, and the zero-retraces-after-warmup guard."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitoring
+from deeplearning4j_tpu.monitoring import runtime
+from deeplearning4j_tpu.monitoring.metrics import MetricsRegistry
+from deeplearning4j_tpu.resilience import chaos
+from deeplearning4j_tpu.resilience.retry import RetryPolicy
+from deeplearning4j_tpu.serving import (
+    EngineShutdown, GenerationEngine, InferenceTimeout, RequestCancelled,
+    ServingQueueFull)
+from deeplearning4j_tpu.serving.health import (
+    SERVING_ACTIVE_SLOTS, SERVING_DEADLINE_EXCEEDED, SERVING_HEALTHY,
+    SERVING_REQUESTS, SERVING_TTFT)
+from deeplearning4j_tpu.zoo import (
+    TextGenerationLSTM, TextGenerationTransformer)
+
+V = 12
+PROMPTS = [[1, 2, 3, 4, 5], [6, 7], [8, 9, 10, 1], [2, 4, 6], [3],
+           [5, 5, 9]]
+
+
+@pytest.fixture(scope="module")
+def rope_model():
+    return TextGenerationTransformer(vocab_size=V, embed_dim=16,
+                                     n_heads=2, n_layers=2,
+                                     max_length=32, positional="rope")
+
+
+@pytest.fixture(scope="module")
+def rope_net(rope_model):
+    return rope_model.init()
+
+
+@pytest.fixture(scope="module")
+def lstm_model():
+    return TextGenerationLSTM(vocab_size=10, hidden=12, layers=1,
+                              max_length=40)
+
+
+@pytest.fixture(scope="module")
+def lstm_net(lstm_model):
+    return lstm_model.init()
+
+
+def drain(engine, handles):
+    engine.run_until_idle()
+    return [h.result(timeout=0) for h in handles]
+
+
+# ---------------------------------------------------------------------
+# parity: continuous batching == one-shot sample_stream per request
+# ---------------------------------------------------------------------
+class TestEngineParity:
+    def test_greedy_staggered_matches_one_shot(self, rope_model,
+                                               rope_net):
+        """Mixed-length prompts admitted mid-flight into 2 slots (so
+        slots are reused several times) — every request's output equals
+        its own one-shot sample_stream run, bit for bit."""
+        eng = GenerationEngine(rope_net, V, slots=2)
+        hs = []
+        for i, p in enumerate(PROMPTS[:2]):
+            hs.append(eng.submit(p, steps=7, top_k=1,
+                                 rng=np.random.default_rng(i)))
+        eng.step()
+        eng.step()             # requests 2.. join while 0/1 are decoding
+        for i, p in enumerate(PROMPTS[2:], start=2):
+            hs.append(eng.submit(p, steps=7, top_k=1,
+                                 rng=np.random.default_rng(i)))
+            eng.step()
+        got = drain(eng, hs)
+        for i, p in enumerate(PROMPTS):
+            want = rope_model.sample_stream(
+                rope_net, p, steps=7, top_k=1,
+                rng=np.random.default_rng(i))
+            assert got[i] == want, p
+            assert hs[i].finish_reason == "length"
+
+    def test_greedy_matches_one_shot_lstm(self, lstm_model, lstm_net):
+        prompts = [[1, 2, 3, 4], [5, 6], [7, 8, 9]]
+        eng = GenerationEngine(lstm_net, 10, slots=2)
+        hs = [eng.submit(p, steps=5, top_k=1,
+                         rng=np.random.default_rng(i))
+              for i, p in enumerate(prompts)]
+        got = drain(eng, hs)
+        for i, p in enumerate(prompts):
+            want = lstm_model.sample_stream(
+                lstm_net, p, steps=5, top_k=1,
+                rng=np.random.default_rng(i))
+            assert got[i] == want, p
+
+    def test_mixed_sampling_configs_share_one_arena(self, rope_model,
+                                                    rope_net):
+        """Requests with different temperature/top_k/top_p configs ride
+        the same arena; each still matches its one-shot run exactly
+        (per-request rngs consumed in generation order)."""
+        cfgs = [dict(temperature=0.7, top_k=3),
+                dict(temperature=1.2, top_p=0.9),
+                dict(top_k=1),
+                dict(temperature=0.9)]
+        eng = GenerationEngine(rope_net, V, slots=4)
+        hs = [eng.submit([1 + i, 2, 3], steps=6,
+                         rng=np.random.default_rng(10 + i), **c)
+              for i, c in enumerate(cfgs)]
+        got = drain(eng, hs)
+        for i, c in enumerate(cfgs):
+            want = rope_model.sample_stream(
+                rope_net, [1 + i, 2, 3], steps=6,
+                rng=np.random.default_rng(10 + i), **c)
+            assert got[i] == want, c
+
+    def test_chunked_prime_matches_too(self, rope_model, rope_net):
+        eng = GenerationEngine(rope_net, V, slots=2, prime_padded=False)
+        hs = [eng.submit(p, steps=4, top_k=1,
+                         rng=np.random.default_rng(i))
+              for i, p in enumerate(PROMPTS[:3])]
+        got = drain(eng, hs)
+        for i, p in enumerate(PROMPTS[:3]):
+            assert got[i] == rope_model.sample_stream(
+                rope_net, p, steps=4, top_k=1,
+                rng=np.random.default_rng(i))
+
+
+# ---------------------------------------------------------------------
+# slot lifecycle
+# ---------------------------------------------------------------------
+class TestSlotLifecycle:
+    def test_slot_reuse_after_retirement(self, rope_net):
+        """6 requests through 2 slots: occupancy never exceeds S and
+        every request completes (slots are freed and re-filled)."""
+        eng = GenerationEngine(rope_net, V, slots=2)
+        hs = [eng.submit(p, steps=3 + i, top_k=1)
+              for i, p in enumerate(PROMPTS)]
+        peak = 0
+        while eng.step():
+            peak = max(peak, eng.active_slots())
+        assert peak == 2
+        assert all(h.done for h in hs)
+        assert eng.active_slots() == 0
+
+    def test_stop_tokens_retire_individually(self, rope_model, rope_net):
+        """A row drawing its stop token retires (stop kept as final id,
+        EOS semantics) while other rows continue — each row equal to its
+        one-shot run with the same stops."""
+        ref = [rope_model.sample_stream(rope_net, p, steps=12, top_k=1,
+                                        rng=np.random.default_rng(i))
+               for i, p in enumerate(PROMPTS[:3])]
+        # pick a stop token that actually appears mid-generation
+        stop = ref[0][len(PROMPTS[0]) + 1]
+        eng = GenerationEngine(rope_net, V, slots=3)
+        hs = [eng.submit(p, steps=12, top_k=1, stop_tokens=(stop,),
+                         rng=np.random.default_rng(i))
+              for i, p in enumerate(PROMPTS[:3])]
+        got = drain(eng, hs)
+        for i, p in enumerate(PROMPTS[:3]):
+            want = rope_model.sample_stream(
+                rope_net, p, steps=12, top_k=1, stop_tokens=(stop,),
+                rng=np.random.default_rng(i))
+            assert got[i] == want
+        assert hs[0].finish_reason == "stop"
+
+    def test_capacity_retires_gracefully(self):
+        """A request allowed past the net's streaming capacity retires
+        with reason 'capacity' instead of crashing the arena."""
+        model = TextGenerationTransformer(vocab_size=V, embed_dim=16,
+                                          n_heads=2, n_layers=1,
+                                          max_length=16,
+                                          positional="rope")
+        net = model.init()
+        eng = GenerationEngine(net, V, slots=2)
+        h = eng.submit([1, 2, 3, 4], steps=30, top_k=1, max_length=24)
+        eng.run_until_idle()
+        assert h.finish_reason == "capacity"
+        assert len(h.result(timeout=0)) == 17  # 16 positions + 1 draw
+
+    def test_cancel_frees_slot(self, rope_net):
+        eng = GenerationEngine(rope_net, V, slots=1)
+        h1 = eng.submit([1, 2, 3], steps=50, top_k=1)
+        h2 = eng.submit([4, 5], steps=3, top_k=1)
+        eng.step()
+        assert eng.active_slots() == 1
+        h1.cancel()
+        eng.run_until_idle()
+        with pytest.raises(RequestCancelled):
+            h1.result(timeout=0)
+        assert h1.finish_reason == "cancelled"
+        assert h2.finish_reason == "length"
+
+
+# ---------------------------------------------------------------------
+# admission control + deadlines
+# ---------------------------------------------------------------------
+class TestAdmissionControl:
+    def test_fail_fast_rejects_at_limit(self, rope_net):
+        eng = GenerationEngine(rope_net, V, slots=1, queue_limit=1,
+                               queue_policy="fail_fast")
+        eng.submit([1, 2], steps=40, top_k=1)
+        eng.step()                       # occupies the slot
+        eng.submit([3, 4], steps=3, top_k=1)
+        with pytest.raises(ServingQueueFull):
+            eng.submit([5, 6], steps=3, top_k=1)
+        eng.shutdown()
+
+    def test_block_bounded_by_deadline(self, rope_net):
+        eng = GenerationEngine(rope_net, V, slots=1, queue_limit=1,
+                               queue_policy="block")
+        eng.submit([1, 2], steps=40, top_k=1)
+        eng.step()                               # occupies the slot
+        eng.submit([3, 4], steps=3, top_k=1)     # fills the backlog
+        t0 = time.monotonic()
+        with pytest.raises(InferenceTimeout):
+            eng.submit([5, 6], steps=3, top_k=1, timeout=0.05)
+        assert time.monotonic() - t0 < 2.0
+        eng.shutdown()
+
+    def test_block_admits_when_space_frees(self, rope_net):
+        eng = GenerationEngine(rope_net, V, slots=1, queue_limit=1)
+        eng.submit([1, 2], steps=3, top_k=1)
+        eng.step()                               # occupies the slot
+        eng.submit([5, 6], steps=3, top_k=1)     # backlog full
+        h2_box = {}
+
+        def blocked_submit():
+            h2_box["h"] = eng.submit([3, 4], steps=3, top_k=1)
+
+        t = threading.Thread(target=blocked_submit, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        assert t.is_alive()              # still blocked on admission
+        eng.run_until_idle()             # drains the queue
+        t.join(timeout=5.0)
+        assert not t.is_alive()
+        eng.run_until_idle()
+        assert h2_box["h"].finish_reason == "length"
+
+    def test_priority_classes(self, rope_net):
+        """With one slot busy, a later high-priority request is admitted
+        before an earlier low-priority one."""
+        eng = GenerationEngine(rope_net, V, slots=1)
+        eng.submit([1, 2], steps=6, top_k=1)
+        eng.step()                       # blocker takes the slot
+        h_low = eng.submit([3, 4], steps=2, top_k=1, priority=0)
+        h_high = eng.submit([5, 6], steps=2, top_k=1, priority=5)
+        while not (h_low.done and h_high.done):
+            eng.step()
+        assert h_high.queue_wait_s <= h_low.queue_wait_s
+
+    def test_deadline_expires_in_queue(self, rope_net):
+        eng = GenerationEngine(rope_net, V, slots=1)
+        eng.submit([1, 2], steps=30, top_k=1)
+        eng.step()
+        h = eng.submit([3, 4], steps=3, top_k=1, timeout=0.01)
+        time.sleep(0.03)
+        eng.run_until_idle()
+        with pytest.raises(InferenceTimeout):
+            h.result(timeout=0)
+
+    def test_queued_deadline_fires_while_arena_full(self, rope_net):
+        """A queued request's deadline is enforced on every step, not
+        deferred until a slot happens to free: with the single slot
+        pinned by a long request, the queued request times out at its
+        deadline while the blocker is still generating."""
+        eng = GenerationEngine(rope_net, V, slots=1)
+        blocker = eng.submit([1, 2], steps=25, top_k=1)
+        eng.step()
+        h = eng.submit([3, 4], steps=3, top_k=1, timeout=0.01)
+        time.sleep(0.03)
+        eng.step()                       # arena still full — reap runs
+        assert h.done and not blocker.done
+        with pytest.raises(InferenceTimeout):
+            h.result(timeout=0)
+        eng.run_until_idle()
+        assert blocker.finish_reason == "length"
+
+    def test_deadline_mid_generation_frees_slot(self, rope_net):
+        """The PR 4 deadline contract on the engine: expiry mid-stream
+        fails the handle AND frees the slot for the next request."""
+        eng = GenerationEngine(rope_net, V, slots=1)
+        h1 = eng.submit([1, 2, 3], steps=50, top_k=1, timeout=0.01)
+        h2 = eng.submit([4, 5], steps=3, top_k=1,
+                        rng=np.random.default_rng(9))
+        eng.step()                       # h1 admitted, starts decoding
+        time.sleep(0.03)
+        eng.run_until_idle()
+        with pytest.raises(InferenceTimeout):
+            h1.result(timeout=0)
+        assert len(h1.generated) >= 1    # it DID stream before expiring
+        assert h2.finish_reason == "length"
+        assert eng.active_slots() == 0
+
+    def test_submit_after_shutdown_refused(self, rope_net):
+        eng = GenerationEngine(rope_net, V, slots=1)
+        eng.shutdown()
+        with pytest.raises(EngineShutdown):
+            eng.submit([1, 2], steps=2)
+
+
+# ---------------------------------------------------------------------
+# chaos coverage (satellite): resilience/chaos.py injectors drive the
+# engine; surviving requests complete identically to an unperturbed run
+# ---------------------------------------------------------------------
+class TestChaosServing:
+    def _run(self, rope_net, **kw):
+        eng = GenerationEngine(rope_net, V, slots=2, **kw)
+        hs = [eng.submit(p, steps=5, top_k=1,
+                         rng=np.random.default_rng(i))
+              for i, p in enumerate(PROMPTS[:3])]
+        eng.run_until_idle()
+        return eng, hs
+
+    def test_prefill_raise_isolates_the_victim(self, rope_net):
+        _, base = self._run(rope_net)
+        base_out = [h.result(timeout=0) for h in base]
+        eng, hs = self._run(rope_net,
+                            prefill_chaos=chaos.RaiseOnBatch(None, n=1))
+        with pytest.raises(chaos.InjectedFault):
+            hs[1].result(timeout=0)
+        assert hs[0].result(timeout=0) == base_out[0]
+        assert hs[2].result(timeout=0) == base_out[2]
+        assert eng.is_healthy()          # one bad request != a dead engine
+
+    def test_latency_spike_changes_nothing(self, rope_net):
+        _, base = self._run(rope_net)
+        base_out = [h.result(timeout=0) for h in base]
+        _, hs = self._run(rope_net, prefill_chaos=chaos.LatencyIterator(
+            None, seconds=0.02, every=2))
+        assert [h.result(timeout=0) for h in hs] == base_out
+
+    def test_midstream_preemption_retried_identically(self, rope_net):
+        """SimulatedPreemption before a mid-stream decode dispatch, with
+        a RetryPolicy: the retried dispatch is numerically identical (the
+        fault fires before any state mutates), so every request's output
+        equals the unperturbed run."""
+        _, base = self._run(rope_net)
+        base_out = [h.result(timeout=0) for h in base]
+        _, hs = self._run(
+            rope_net,
+            decode_chaos=chaos.PreemptionIterator(None, n=2),
+            decode_retry=RetryPolicy(
+                max_attempts=3, base_delay=0.001,
+                retry_on=(chaos.SimulatedPreemption,)))
+        assert [h.result(timeout=0) for h in hs] == base_out
+
+    def test_unretried_preemption_fails_fast(self, rope_net):
+        """No retry policy: a decode fault is terminal — every in-flight
+        handle fails with the original error (nobody hangs), the engine
+        reports unhealthy and refuses new work."""
+        eng, hs = self._run(
+            rope_net, decode_chaos=chaos.PreemptionIterator(None, n=1))
+        for h in hs:
+            if h.finish_reason == "error":
+                with pytest.raises(chaos.SimulatedPreemption):
+                    h.result(timeout=0)
+        assert not eng.is_healthy()
+        with pytest.raises(EngineShutdown):
+            eng.submit([1, 2], steps=2)
+
+
+# ---------------------------------------------------------------------
+# streaming handles
+# ---------------------------------------------------------------------
+class TestStreamingHandles:
+    def test_tokens_stream_incrementally(self, rope_net):
+        """Tokens become visible per dispatch, not at request end —
+        time-to-first-token is one prefill away from admission."""
+        eng = GenerationEngine(rope_net, V, slots=1)
+        h = eng.submit([1, 2, 3], steps=6, top_k=1)
+        eng.step()        # admission (prefill = token 1) + one dispatch
+        assert len(h.generated) == 2
+        assert not h.done
+        eng.step()                       # one decode dispatch: token 3
+        assert len(h.generated) == 3
+        eng.run_until_idle()
+        assert h.done and len(h.generated) == 6
+        assert h.ttft_s is not None and h.queue_wait_s is not None
+
+    def test_iterator_yields_then_ends(self, rope_model, rope_net):
+        eng = GenerationEngine(rope_net, V, slots=1).start()
+        try:
+            h = eng.submit([1, 2, 3], steps=5, top_k=1,
+                           rng=np.random.default_rng(0))
+            toks = list(h)               # blocks until retirement
+            want = rope_model.sample_stream(
+                rope_net, [1, 2, 3], steps=5, top_k=1,
+                rng=np.random.default_rng(0))
+            assert [1, 2, 3] + toks == want
+        finally:
+            eng.shutdown()
+
+    def test_finished_stream_reiterates_without_blocking(self, rope_net):
+        """Iterating a finished handle a second time ends immediately
+        (no stranded consumer once the terminal sentinel is gone)."""
+        eng = GenerationEngine(rope_net, V, slots=1)
+        h = eng.submit([1, 2, 3], steps=4, top_k=1)
+        eng.run_until_idle()
+        first = list(h)
+        assert len(first) == 4
+        assert list(h) == []             # drained: ends, never blocks
+
+    def test_result_timeout(self, rope_net):
+        eng = GenerationEngine(rope_net, V, slots=1)
+        h = eng.submit([1, 2], steps=5, top_k=1)
+        with pytest.raises(InferenceTimeout):
+            h.result(timeout=0.01)       # nobody is stepping
+        eng.run_until_idle()
+        assert h.result(timeout=0)
+
+
+# ---------------------------------------------------------------------
+# threaded serving + shutdown semantics
+# ---------------------------------------------------------------------
+class TestThreadedEngine:
+    def test_threaded_equals_manual(self, rope_model, rope_net):
+        eng = GenerationEngine(rope_net, V, slots=2).start()
+        try:
+            hs = [eng.submit(p, steps=5, top_k=1,
+                             rng=np.random.default_rng(i))
+                  for i, p in enumerate(PROMPTS[:4])]
+            got = [h.result(timeout=30) for h in hs]
+        finally:
+            eng.shutdown()
+        for i, p in enumerate(PROMPTS[:4]):
+            assert got[i] == rope_model.sample_stream(
+                rope_net, p, steps=5, top_k=1,
+                rng=np.random.default_rng(i))
+
+    def test_shutdown_fails_inflight(self, rope_net):
+        eng = GenerationEngine(rope_net, V, slots=1)
+        h = eng.submit([1, 2], steps=500, top_k=1, max_length=None)
+        eng.step()
+        eng.shutdown()
+        with pytest.raises(EngineShutdown):
+            h.result(timeout=0)
+        assert not eng.is_healthy()
+
+
+# ---------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------
+class TestTelemetry:
+    def test_engine_serving_series(self, rope_net):
+        reg = MetricsRegistry()
+        eng = GenerationEngine(rope_net, V, slots=2, registry=reg,
+                               name="engine:test")
+        hs = [eng.submit(p, steps=3, top_k=1)
+              for p in PROMPTS[:3]]
+        eng.run_until_idle()
+        assert all(h.done for h in hs)
+        snap = reg.snapshot_compact()
+        assert snap[SERVING_REQUESTS + "{model=engine:test}"] == 3
+        assert snap[SERVING_ACTIVE_SLOTS + "{model=engine:test}"] == 0
+        assert snap[SERVING_HEALTHY + "{model=engine:test}"] == 1.0
+        assert snap[SERVING_TTFT + "{model=engine:test}"]["count"] == 3
+        eng.shutdown()
+        assert reg.snapshot_compact()[
+            SERVING_HEALTHY + "{model=engine:test}"] == 0.0
+
+    def test_deadline_counter(self, rope_net):
+        reg = MetricsRegistry()
+        eng = GenerationEngine(rope_net, V, slots=1, registry=reg,
+                               name="engine:ddl")
+        eng.submit([1, 2], steps=30, top_k=1)
+        eng.step()
+        h = eng.submit([3, 4], steps=3, top_k=1, timeout=0.01)
+        time.sleep(0.03)
+        eng.run_until_idle()
+        assert h.finish_reason == "error"
+        snap = reg.snapshot_compact()
+        assert snap[SERVING_DEADLINE_EXCEEDED
+                    + "{model=engine:ddl}"] == 1
+
+
+# ---------------------------------------------------------------------
+# acceptance: zero retraces after warmup across staggered admissions
+# ---------------------------------------------------------------------
+def _compile_total():
+    c = monitoring.global_registry().get(runtime.COMPILE_COUNTER)
+    return 0.0 if c is None else c.total()
+
+
+class TestNoRetraceAfterWarmup:
+    def test_staggered_admissions_compile_nothing_new(self):
+        """After warmup(), arbitrary staggered mixed-length admissions
+        hit only warm shapes: the per-bucket prefill, the one jitted
+        scatter-join, and the canonical [S, V, 1] decode dispatch (the
+        PR 3 acceptance bar, applied to serving)."""
+        monitoring.ensure_started()
+        model = TextGenerationTransformer(vocab_size=V, embed_dim=16,
+                                          n_heads=2, n_layers=2,
+                                          max_length=64,
+                                          positional="rope")
+        net = model.init()
+        eng = GenerationEngine(net, V, slots=4)
+        eng.warmup(max_prompt_len=16)
+        warm = _compile_total()
+        rng = np.random.default_rng(0)
+        hs = []
+        for i in range(10):
+            n = int(rng.integers(1, 16))
+            hs.append(eng.submit(list(rng.integers(1, V, n)),
+                                 steps=int(rng.integers(2, 10)),
+                                 top_k=1, rng=np.random.default_rng(i)))
+            eng.step()                   # staggered: admit mid-flight
+        eng.run_until_idle()
+        assert all(h.done for h in hs)
+        assert _compile_total() == warm, (
+            "serving retraced after warmup — slot arena shape "
+            "canonicalization regression")
