@@ -790,6 +790,142 @@ def bench_serve_continuous():
         "static_match_rows": match_rows}), flush=True)
 
 
+def bench_serve_paged():
+    """Serving engine v2 vs the PR 5 slot arena on the same staggered
+    mixed short/long trace: the paged engine runs 4x the slot engine's
+    admitted rows on a TOKEN budget equal to the slot arena's worst
+    case (slots x cache_length — paging spends the same HBM, it just
+    stops pinning it per slot), with the prefix cache fed by a shared
+    system prompt on half the requests. Records tokens/s, mean/p95
+    TTFT, peak admitted concurrency, and page utilization for both
+    paths, plus a speculative sub-leg (prompt-lookup draft over
+    repetitive prompts) with its measured acceptance rate.
+
+    The model is sized so a decode dispatch is LATENCY-bound rather
+    than FLOP-bound — the TPU serving regime, where a [32,V,1] step
+    costs about what an [8,V,1] step does and wider admission is free
+    throughput; a CPU-FLOP-bound model would instead just pay 4x the
+    arithmetic per step and bury the scheduling effect under matmul
+    time."""
+    import numpy as np
+    from deeplearning4j_tpu.monitoring.metrics import MetricsRegistry
+    from deeplearning4j_tpu.serving import (
+        GenerationEngine, PagedKVConfig, SpeculationConfig)
+    from deeplearning4j_tpu.serving.health import SERVING_SPEC_ACCEPTANCE
+    from deeplearning4j_tpu.util.decoding import prompt_lookup_proposer
+    from deeplearning4j_tpu.zoo import TextGenerationTransformer
+
+    V, R, STEPS, SLOTS, CONC = 512, 48, 24, 8, 32      # CONC = 4x SLOTS
+    STAGGER, PS, L = 0.02, 16, 256
+    model = TextGenerationTransformer(vocab_size=V, embed_dim=128,
+                                      n_heads=4, n_layers=3,
+                                      max_length=L, positional="rope")
+    net = model.init()
+    net.conf.dtype = "bfloat16"
+    rng = np.random.default_rng(0)
+    sys_prompt = list(rng.integers(1, V, 16))
+    prompts = []
+    for i in range(R):
+        if i % 4 == 3:                     # 25% long
+            p = list(rng.integers(1, V, int(rng.integers(48, 96))))
+        else:                              # 75% short
+            p = list(rng.integers(1, V, int(rng.integers(4, 16))))
+        if i % 2:                          # half share the system prompt
+            p = sys_prompt + p[:max(1, len(p) - 16)]
+        prompts.append(p)
+
+    def run(engine, label):
+        engine.warmup(max_prompt_len=112)
+        engine.start()
+        t0 = time.perf_counter()
+        handles, peak, peak_util = [], [0], [0.0]
+        pool_total = (engine.page_pool.usable
+                      if engine.page_pool is not None else 0)
+
+        def watch():
+            while not all(h.done for h in handles) or not handles:
+                peak[0] = max(peak[0], engine.active_slots())
+                if pool_total:
+                    # sample utilization LIVE: after the drain every
+                    # slot has released its pages and only prefix-cache
+                    # residue would remain
+                    peak_util[0] = max(
+                        peak_util[0],
+                        engine.page_pool.used_count() / pool_total)
+                if all(h.done for h in handles) and handles:
+                    return
+                time.sleep(0.002)
+
+        import threading
+        w = threading.Thread(target=watch, daemon=True)
+        w.start()
+        for i, p in enumerate(prompts):
+            while time.perf_counter() < t0 + i * STAGGER:
+                time.sleep(0.001)
+            handles.append(engine.submit(p, steps=STEPS, top_k=1,
+                                         rng=np.random.default_rng(i)))
+        outs = [h.result(timeout=600) for h in handles]
+        dt = time.perf_counter() - t0
+        w.join(timeout=5)
+        engine.shutdown()
+        gen = sum(len(o) - len(p) for o, p in zip(outs, prompts))
+        ttft = [h.ttft_s for h in handles]
+        return {f"{label}_tokens_per_sec": round(gen / dt, 1),
+                f"{label}_ttft_mean_ms":
+                    round(float(np.mean(ttft)) * 1e3, 1),
+                f"{label}_ttft_p95_ms":
+                    round(float(np.percentile(ttft, 95)) * 1e3, 1),
+                f"{label}_peak_active": peak[0],
+                f"{label}_page_util": (
+                    round(peak_util[0], 3) if pool_total else None)}
+
+    # token budget == the slot arena's worst case: SLOTS x L tokens
+    budget_pages = SLOTS * (L // PS)
+    rec = {"metric": "serve_paged", "unit": "tokens/sec",
+           "requests": R, "steps": STEPS, "stagger_ms": STAGGER * 1e3,
+           "slot_rows": SLOTS, "paged_rows": CONC, "page_size": PS,
+           "total_pages": budget_pages}
+    rec.update(run(GenerationEngine(net, V, slots=SLOTS, queue_limit=R),
+                   "slot"))
+    rec.update(run(GenerationEngine(
+        net, V, slots=CONC, queue_limit=R,
+        paging=PagedKVConfig(page_size=PS, total_pages=budget_pages)),
+        "paged"))
+    rec["value"] = rec["paged_tokens_per_sec"]
+    rec["admitted_concurrency_x"] = round(
+        rec["paged_peak_active"] / max(1, rec["slot_peak_active"]), 2)
+
+    # speculative sub-leg: repetitive prompts so prompt-lookup drafts
+    # actually land; acceptance rate from the engine's own histogram
+    reg = MetricsRegistry()
+    spec_prompts = [list(rng.integers(1, V, 6)) * 4 for _ in range(16)]
+    eng = GenerationEngine(
+        net, V, slots=SLOTS, queue_limit=len(spec_prompts),
+        registry=reg, name="engine:spec_bench",
+        paging=PagedKVConfig(page_size=PS, total_pages=budget_pages),
+        speculation=SpeculationConfig(draft=prompt_lookup_proposer(3),
+                                      gamma=4))
+    eng.warmup(max_prompt_len=32)
+    t0 = time.perf_counter()
+    hs = [eng.submit(p, steps=STEPS, top_k=1,
+                     rng=np.random.default_rng(i))
+          for i, p in enumerate(spec_prompts)]
+    eng.run_until_idle()
+    outs = [h.result(timeout=0) for h in hs]
+    dt = time.perf_counter() - t0
+    eng.shutdown()
+    gen = sum(len(o) - len(p) for o, p in zip(outs, spec_prompts))
+    hist = reg.snapshot_compact().get(
+        SERVING_SPEC_ACCEPTANCE + "{model=engine:spec_bench}", {})
+    rec["spec_tokens_per_sec"] = round(gen / dt, 1)
+    rec["spec_acceptance_rate"] = (
+        round(hist["sum"] / hist["count"], 3) if hist.get("count")
+        else None)
+    rec["spec_tokens_per_dispatch"] = round(gen / max(1, eng._dispatches
+                                                      ), 2)
+    _print_line(json.dumps(rec), flush=True)
+
+
 def _converge_run(net, x, y, steps, record_every):
     """Fixed-seed training loop recording the loss trajectory. Each
     recorded point is a scalar host fetch — a real sync (the tunneled
@@ -942,6 +1078,7 @@ ALL = {"resnet": bench_resnet, "lstm": bench_lstm, "lenet": bench_lenet,
        "decode": bench_decode, "specdec": bench_specdec,
        "specbatch": bench_specbatch,
        "serve_continuous": bench_serve_continuous,
+       "serve_paged": bench_serve_paged,
        "converge_lenet": bench_converge_lenet,
        "converge_resnet": bench_converge_resnet}
 

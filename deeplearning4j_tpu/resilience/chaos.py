@@ -39,8 +39,9 @@ from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterators import DataSetIterator
 
 __all__ = ["ChaosIterator", "InjectedFault", "LatencyIterator",
-           "NaNPoisonIterator", "PreemptionIterator", "RaiseOnBatch",
-           "SimulatedPreemption", "fire"]
+           "NaNPoisonIterator", "PageExhaustionInjector",
+           "PreemptionIterator", "RaiseOnBatch", "SimulatedPreemption",
+           "fire"]
 
 
 def fire(injector, index: int) -> None:
@@ -210,6 +211,36 @@ class LatencyIterator(ChaosIterator):
     def before_batch(self, index: int) -> None:
         if index >= self.start and (index - self.start) % self.every == 0:
             time.sleep(self.seconds)
+
+
+class PageExhaustionInjector(ChaosIterator):
+    """Force the serving engine's free KV-page pool down to
+    ``free_target`` pages before dispatch `n` (pass it as the engine's
+    ``decode_chaos``; one event per decode dispatch).
+
+    `pool` is the paged engine's ``PagePool`` (``engine.page_pool``):
+    the injector SEIZES free pages — it never touches allocated ones —
+    so active requests keep their pages and complete bit-identically to
+    an unperturbed run while new admissions head-block (or time
+    out / fail fast, per their deadline and queue policy) until
+    ``release()`` returns the seized pages. The graceful-degradation
+    proof every capacity incident wants: starvation must shed load,
+    never corrupt in-flight streams."""
+
+    def __init__(self, pool, n: int, free_target: int = 0,
+                 once: bool = True):
+        super().__init__(None, once=once)
+        self.pool = pool
+        self.n = int(n)
+        self.free_target = int(free_target)
+
+    def before_batch(self, index: int) -> None:
+        if index >= self.n and self._fire():
+            self.pool.seize(self.pool.free_count() - self.free_target)
+
+    def release(self) -> None:
+        """Return every seized page to the pool (the incident ends)."""
+        self.pool.restore()
 
 
 class PreemptionIterator(RaiseOnBatch):

@@ -11,16 +11,29 @@ per-request ``GenerationStream`` handles. Admission control (bounded
 priority queue, ``block`` | ``fail_fast``), per-request deadlines, and
 the shared ``dl4jtpu_serving_*`` telemetry ride around it.
 
-See ARCHITECTURE.md "Serving engine".
+Serving engine v2 layers on top: a block-paged KV arena
+(``PagedKVConfig`` — capacity as a token budget with per-slot page
+tables over one refcounted pool), a full-block prompt ``PrefixCache``
+(shared system prompts prime once), and in-engine speculative decoding
+(``SpeculationConfig`` — a host draft + one widened verify dispatch per
+step).
+
+See ARCHITECTURE.md "Serving engine" and "Paged KV, prefix cache &
+speculation".
 """
 
-from deeplearning4j_tpu.serving.engine import GenerationEngine  # noqa: F401
+from deeplearning4j_tpu.serving.engine import (  # noqa: F401
+    GenerationEngine, SpeculationConfig)
 from deeplearning4j_tpu.serving.errors import (  # noqa: F401
     EngineShutdown, InferenceTimeout, RequestCancelled, ServingQueueFull)
+from deeplearning4j_tpu.serving.paging import (  # noqa: F401
+    PagedKVConfig, PageExhausted, PagePool)
+from deeplearning4j_tpu.serving.prefix_cache import PrefixCache  # noqa: F401
 from deeplearning4j_tpu.serving.request import (  # noqa: F401
     GenerationRequest, GenerationStream)
 from deeplearning4j_tpu.serving.scheduler import AdmissionQueue  # noqa: F401
 
 __all__ = ["AdmissionQueue", "EngineShutdown", "GenerationEngine",
            "GenerationRequest", "GenerationStream", "InferenceTimeout",
-           "RequestCancelled", "ServingQueueFull"]
+           "PagedKVConfig", "PageExhausted", "PagePool", "PrefixCache",
+           "RequestCancelled", "ServingQueueFull", "SpeculationConfig"]

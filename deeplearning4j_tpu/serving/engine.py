@@ -45,6 +45,29 @@ restored untouched; ``decode_chaos`` fires before each decode dispatch
 INSIDE the optional ``decode_retry`` RetryPolicy — a transient
 mid-stream preemption is retried with numerics identical to a
 fault-free run (the fault fires before any state mutates).
+
+Serving engine v2 extras, each orthogonal and composable:
+
+- ``paging=PagedKVConfig(...)`` rebuilds the arena's KV storage as
+  **block-paged** (``serving/paging.py``): capacity becomes a token
+  budget — admission checks the request's worst-case pages against the
+  free pool (head-of-line blocking when short; requests that can NEVER
+  fit are rejected at submit), retirement frees pages immediately, and
+  each dispatch wraps the same canonical decode in a jitted
+  gather/scatter round trip, so outputs stay bit-identical to the slot
+  arena (and to one-shot ``sample_stream``). With
+  ``prefix_cache=True`` (default) shared full-block prompt prefixes
+  prime once (``serving/prefix_cache.py``): later requests map the
+  cached pages and prefill only their suffix.
+- ``speculation=SpeculationConfig(draft, gamma)`` folds the
+  ``speculative_sample`` machinery into the decode loop: per step the
+  host `draft` proposes up to gamma tokens per active slot and ONE
+  widened ``[S, V, 1+gamma]`` verify dispatch scores them all; each
+  row's accept/reject walk (``util.decoding.accept_proposals``) commits
+  accepted+1 tokens and a per-row ``rewind_stream_state`` drops the
+  rejected positions — greedy outputs stay bit-identical to plain
+  ``sample_stream`` (every committed token is the argmax chain), and
+  the target's sampling distribution is exactly preserved.
 """
 
 from __future__ import annotations
@@ -52,7 +75,8 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import List, Optional
+from dataclasses import dataclass
+from typing import Callable, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -61,22 +85,28 @@ import numpy as np
 from deeplearning4j_tpu.monitoring.metrics import (
     MetricsRegistry, global_registry)
 from deeplearning4j_tpu.nn.conf.layers import (
-    BATCHED_STREAM_KEYS, PositionalEmbeddingLayer, stream_capacity)
+    BATCHED_STREAM_KEYS, PositionalEmbeddingLayer, check_rewindable,
+    rewind_stream_state, stream_capacity)
 from deeplearning4j_tpu.resilience.chaos import fire as _fire_chaos
 from deeplearning4j_tpu.resilience.retry import RetryPolicy, retry_call
 from deeplearning4j_tpu.serving.errors import (
     EngineShutdown, InferenceTimeout, RequestCancelled, ServingQueueFull)
 from deeplearning4j_tpu.serving.health import (
     SERVING_ACTIVE_SLOTS, SERVING_DEADLINE_EXCEEDED, SERVING_ERRORS,
+    SERVING_KV_PAGES_TOTAL, SERVING_KV_PAGES_USED, SERVING_PREFIX_HITS,
+    SERVING_PREFIX_MISSES, SERVING_PREFIX_REUSED_TOKENS,
     SERVING_QUEUE_REJECTED, SERVING_QUEUE_WAIT, SERVING_REQUESTS,
-    SERVING_TOKENS, SERVING_TPOT, SERVING_TTFT, register_serving_metrics,
-    scrape_probe)
+    SERVING_SPEC_ACCEPTANCE, SERVING_TOKENS, SERVING_TPOT, SERVING_TTFT,
+    register_serving_metrics, scrape_probe)
+from deeplearning4j_tpu.serving.paging import (
+    PagedKVConfig, PagePool, gather_pages, pages_needed, scatter_pages)
+from deeplearning4j_tpu.serving.prefix_cache import PrefixCache
 from deeplearning4j_tpu.serving.request import (
     GenerationRequest, GenerationStream)
 from deeplearning4j_tpu.serving.scheduler import AdmissionQueue
 from deeplearning4j_tpu.util.decoding import (
-    _check_seed, _stream_layers, draw, prime_prompt, step_tokens,
-    stop_reason)
+    _check_seed, _stream_layers, accept_proposals, draw, filter_probs,
+    prime_prompt, step_tokens, stop_reason, verify_tokens)
 
 log = logging.getLogger(__name__)
 
@@ -85,6 +115,35 @@ log = logging.getLogger(__name__)
 #: so per-slot validity is carried by kv_pos alone)
 _SCATTER_KEYS = frozenset(BATCHED_STREAM_KEYS | {"kv_pos", "kv_abs"}) \
     - {"kv_mask"}
+
+
+@dataclass
+class SpeculationConfig:
+    """In-engine speculative decoding knobs.
+
+    `draft` is a HOST proposer callable ``(ids, gamma) -> proposals``
+    (e.g. ``util.decoding.prompt_lookup_proposer()``): zero extra
+    device dispatches, applied per active slot each step. `gamma` caps
+    proposals per slot per step; the verify dispatch is the fixed
+    ``[S, V, 1+gamma]`` widened shape regardless of how many proposals
+    each row actually made (short rows pad with dummies that causality
+    hides and the per-row rewind drops). Model-based drafting (a second
+    net with its own arena) stays on the one-shot
+    ``speculative_sample`` path."""
+
+    draft: Callable
+    gamma: int = 4
+
+    def __post_init__(self):
+        if self.gamma < 1:
+            raise ValueError(f"gamma must be >= 1, got {self.gamma}")
+        if hasattr(self.draft, "rnn_time_step") or \
+                not callable(self.draft):
+            raise TypeError(
+                "in-engine speculation takes a host proposer callable "
+                "(ids, gamma) -> proposals, e.g. "
+                "util.decoding.prompt_lookup_proposer(); model-based "
+                "drafting stays on the one-shot speculative_sample path")
 
 
 @jax.jit
@@ -115,7 +174,9 @@ class GenerationEngine:
                  registry: Optional[MetricsRegistry] = None,
                  name: Optional[str] = None,
                  prefill_chaos=None, decode_chaos=None,
-                 decode_retry: Optional[RetryPolicy] = None):
+                 decode_retry: Optional[RetryPolicy] = None,
+                 paging: Optional[PagedKVConfig] = None,
+                 speculation: Optional[SpeculationConfig] = None):
         if not hasattr(net, "rnn_time_step"):
             raise TypeError("GenerationEngine needs a streaming net "
                             "(rnn_time_step / rnn_clear_previous_state)")
@@ -152,6 +213,53 @@ class GenerationEngine:
         self._row_pos = np.zeros(slots, np.int64)
         self._arena_ready = False
         self._merge_keys = None
+        # -- block-paged KV arena (serving/paging.py) ------------------
+        self._paging = paging
+        self._pool: Optional[PagePool] = None
+        self._prefix: Optional[PrefixCache] = None
+        self._page_store = None            # device pools, per paged leaf
+        self._paged_keys = None            # [(layer name, kv_k|kv_v)]
+        self._page_tables: List[List[int]] = [[] for _ in range(slots)]
+        if paging is not None:
+            kv_layers = [l for l in layers
+                         if getattr(l, "supports_streaming", False)
+                         and getattr(l, "cache_length", 0)]
+            if not kv_layers:
+                raise ValueError(
+                    "block-paged KV needs attention KV streaming state "
+                    "(a layer with cache_length > 0) — a pure-recurrent "
+                    "net has no per-token pages to manage")
+            if any(getattr(l, "window", None) for l in kv_layers):
+                raise ValueError(
+                    "rolling (windowed) caches are not pageable: their "
+                    "modular slot reuse has no stable token->page map "
+                    "(use the slot arena, or a non-windowed model)")
+            lens = {int(l.cache_length) for l in kv_layers}
+            if len(lens) != 1:
+                raise ValueError(
+                    f"block-paged KV needs one shared cache_length "
+                    f"across attention layers, got {sorted(lens)}")
+            self._L = lens.pop()
+            self._ps = paging.page_size
+            self._n_max = -(-self._L // self._ps)
+            usable = paging.resolve_pages(slots, self._n_max)
+            self._pool = PagePool(usable + 1, self._ps)  # +1: null page
+            if paging.prefix_cache:
+                if any(getattr(l, "carries_recurrent_state", False)
+                       for l in layers):
+                    raise ValueError(
+                        "the prefix cache reuses position-indexed KV "
+                        "pages only; recurrent h/c state is a function "
+                        "of the whole prefix and lives outside the "
+                        "pages — construct with "
+                        "PagedKVConfig(prefix_cache=False)")
+                self._prefix = PrefixCache(self._pool)
+        # -- in-engine speculation (SpeculationConfig) -----------------
+        self._speculation = speculation
+        if speculation is not None:
+            # rewind up to the full uniform chunk (gamma + 1 — a free
+            # row keeps nothing); fails fast for LSTMs / tight windows
+            check_rewindable(net, speculation.gamma + 1)
         self._admissions = 0
         self._dispatches = 0
         self._prefill_chaos = prefill_chaos
@@ -190,6 +298,31 @@ class GenerationEngine:
                 "request", ("model",)).set_function(
             scrape_probe(self, lambda s: s.active_slots()),
             model=self._label)
+        if self._pool is not None:
+            r.gauge(SERVING_KV_PAGES_TOTAL, "Allocatable KV pages in "
+                    "the paged arena's pool", ("model",)).set_function(
+                scrape_probe(self, lambda s: s._pool.usable),
+                model=self._label)
+            r.gauge(SERVING_KV_PAGES_USED, "KV pages currently held by "
+                    "slots or the prefix cache", ("model",)).set_function(
+                scrape_probe(self, lambda s: s._pool.used_count()),
+                model=self._label)
+        if self._prefix is not None:
+            self._prefix_hits = r.counter(
+                SERVING_PREFIX_HITS, "Admissions that reused >= 1 "
+                "cached prefix block", ("model",)).labels(**lab)
+            self._prefix_misses = r.counter(
+                SERVING_PREFIX_MISSES, "Admissions that reused no "
+                "cached prefix block", ("model",)).labels(**lab)
+            self._prefix_reused = r.counter(
+                SERVING_PREFIX_REUSED_TOKENS, "Prompt tokens whose "
+                "prefill was skipped via cached pages",
+                ("model",)).labels(**lab)
+        if self._speculation is not None:
+            self._spec_accept_hist = r.histogram(
+                SERVING_SPEC_ACCEPTANCE, "Per-slot fraction of draft "
+                "proposals accepted by a verify dispatch",
+                ("model",)).labels(**lab)
 
     # ------------------------------------------------------------------
     # health / readiness (the ParallelInference probe contract)
@@ -211,10 +344,34 @@ class GenerationEngine:
         return sum(r is not None for r in self._slots)
 
     def health(self) -> dict:
-        return {"healthy": self.is_healthy(), "ready": self.is_ready(),
-                "queue_depth": self.queue_depth(),
-                "active_slots": self.active_slots(),
-                "slots": self.slots}
+        out = {"healthy": self.is_healthy(), "ready": self.is_ready(),
+               "queue_depth": self.queue_depth(),
+               "active_slots": self.active_slots(),
+               "slots": self.slots}
+        if self._pool is not None:
+            out["kv_pages"] = {"total": self._pool.usable,
+                               "used": self._pool.used_count(),
+                               "free": self._pool.free_count(),
+                               "page_size": self._pool.page_size}
+        if self._prefix is not None:
+            out["prefix_cache"] = {"entries": len(self._prefix),
+                                   "hits": self._prefix.hits,
+                                   "misses": self._prefix.misses,
+                                   "reused_tokens":
+                                       self._prefix.reused_tokens}
+        if self._speculation is not None:
+            out["speculation"] = {"gamma": self._speculation.gamma}
+        return out
+
+    @property
+    def page_pool(self) -> Optional[PagePool]:
+        """The paged arena's pool (None in slot-arena mode) — the
+        chaos seam resilience.chaos.PageExhaustionInjector drives."""
+        return self._pool
+
+    @property
+    def prefix_cache(self) -> Optional[PrefixCache]:
+        return self._prefix
 
     # ------------------------------------------------------------------
     # submission
@@ -245,6 +402,29 @@ class GenerationEngine:
             raise ValueError(
                 f"prompt of {len(prompt)} tokens exceeds the net's "
                 f"streaming capacity ({self._cap})")
+        want = len(prompt) + int(steps)
+        if max_length is not None:
+            want = min(want, int(max_length))
+        if self._speculation is not None and self._cap is not None \
+                and want > self._cap - self._speculation.gamma + 1:
+            raise ValueError(
+                f"prompt + steps ({want} ids) needs speculative "
+                f"headroom: every verify dispatch transiently consumes "
+                f"1 + gamma positions, so in-engine speculation serves "
+                f"at most capacity - gamma + 1 = "
+                f"{self._cap - self._speculation.gamma + 1} ids")
+        if self._pool is not None:
+            # admission-time capacity check: a request whose worst case
+            # can NEVER fit the page budget is rejected here, not
+            # admitted and retired mid-stream on capacity
+            store = self._store_positions(want)
+            if pages_needed(store, self._ps) > self._pool.usable:
+                raise ValueError(
+                    f"prompt + steps would hold {store} KV positions "
+                    f"({pages_needed(store, self._ps)} pages of "
+                    f"{self._ps} tokens) but the pool has only "
+                    f"{self._pool.usable} pages total — the request "
+                    f"can never be admitted")
         self._handles[SERVING_REQUESTS].inc()
         deadline = None if timeout is None else \
             time.monotonic() + float(timeout)
@@ -267,8 +447,9 @@ class GenerationEngine:
     # ------------------------------------------------------------------
     def step(self) -> bool:
         """One engine cycle: expire/cancel, admit into free slots, one
-        decode dispatch over the arena, sample + stream + retire.
-        Returns whether any progress was made (False = idle)."""
+        decode (or widened speculative verify) dispatch over the arena,
+        sample + stream + retire. Returns whether any progress was made
+        (False = idle)."""
         with self._lock:
             if self._stop.is_set() or self._broken is not None:
                 return False
@@ -280,30 +461,106 @@ class GenerationEngine:
             if not active:
                 return progress
             try:
-                probs = self._dispatch_step()
+                if self._speculation is not None:
+                    self._step_speculative(active)
+                else:
+                    self._step_plain(active)
             except Exception as e:  # noqa: BLE001 — fail waiters, not hang
                 self._handles[SERVING_ERRORS].inc()
                 self._break(e)
                 return False
-            now = time.monotonic()
+            return True
+
+    def _step_plain(self, active) -> None:
+        """One canonical [S, V, 1] decode dispatch + one draw per row."""
+        probs = self._dispatch_step()
+        now = time.monotonic()
+        for s in active:
+            req = self._slots[s]
+            if req is None:        # retired by the capacity guard
+                continue
+            tok = draw(probs[s], req.temperature, req.rng,
+                       top_k=req.top_k, top_p=req.top_p)
+            if req.last_token_t is not None:
+                self._tpot_hist.observe(now - req.last_token_t)
+            req.last_token_t = now
+            req.handle._push(tok)
+            self._tokens.inc()
+            reason = stop_reason(tok, len(req.handle._ids), req.want,
+                                 req.stop_tokens)
+            if reason:
+                self._retire(s, reason)
+            else:
+                req.pending_token = tok
+
+    def _step_speculative(self, active) -> None:
+        """One widened [S, V, 1+gamma] verify dispatch: the host draft
+        proposes per slot, the target scores pending + proposals in ONE
+        forward, each row commits its accepted prefix + one
+        replacement/bonus token (the shared rejection rule), and a
+        per-row rewind drops the rejected positions — accepted tokens
+        advance multiple positions per engine step."""
+        spec = self._speculation
+        k = spec.gamma
+        if self._cap is not None:
             for s in active:
-                req = self._slots[s]
-                if req is None:        # retired by the capacity guard
-                    continue
-                tok = draw(probs[s], req.temperature, req.rng,
-                           top_k=req.top_k, top_p=req.top_p)
+                if self._slots[s] is not None \
+                        and self._row_pos[s] >= self._cap:
+                    self._retire(s, "capacity")
+        chunk = np.zeros((self.slots, 1 + k), np.int64)
+        props: List[List[int]] = [[] for _ in range(self.slots)]
+        q_dists = [None] * self.slots
+        riders = []
+        for s, req in enumerate(self._slots):
+            if req is None:
+                continue
+            riders.append(s)
+            g = min(k, req.want - len(req.handle._ids))
+            p = [int(t) for t in spec.draft(list(req.handle._ids), g)][:g]
+            props[s] = p
+            q_dists[s] = [None] * len(p)   # deterministic = one-hot draft
+            chunk[s, 0] = req.pending_token
+            chunk[s, 1:1 + len(p)] = p
+        if not riders:
+            return                 # everything retired at the guard
+        self._sync_accounting()
+        tp = self._run_dispatch(
+            lambda: verify_tokens(self.net, chunk, self.V))
+        now = time.monotonic()
+        amounts = np.full(self.slots, 1 + k, np.int32)  # free rows: all
+        for s in riders:
+            req = self._slots[s]
+            g = len(props[s])
+            p_dists = [filter_probs(tp[s, :, j], req.temperature,
+                                    req.top_k, req.top_p)
+                       for j in range(g)]
+            p_bonus = filter_probs(tp[s, :, g], req.temperature,
+                                   req.top_k, req.top_p)
+            accepted, nxt = accept_proposals(props[s], p_dists,
+                                             q_dists[s], p_bonus,
+                                             req.rng)
+            if g:
+                self._spec_accept_hist.observe(accepted / g)
+            committed = props[s][:accepted] + [nxt]
+            self._row_pos[s] += 1 + accepted
+            amounts[s] = k - accepted
+            reason = None
+            for tok in committed:
                 if req.last_token_t is not None:
                     self._tpot_hist.observe(now - req.last_token_t)
                 req.last_token_t = now
                 req.handle._push(tok)
                 self._tokens.inc()
-                reason = stop_reason(tok, len(req.handle._ids), req.want,
-                                     req.stop_tokens)
+                reason = stop_reason(tok, len(req.handle._ids),
+                                     req.want, req.stop_tokens)
                 if reason:
-                    self._retire(s, reason)
-                else:
-                    req.pending_token = tok
-            return True
+                    break
+            if reason:
+                self._retire(s, reason)
+            else:
+                req.pending_token = committed[-1]
+        rewind_stream_state(self.net, amounts)
+        self._sync_accounting()
 
     def run_until_idle(self, max_steps: int = 1_000_000) -> int:
         """Manually drive ``step()`` until nothing is active or
@@ -347,11 +604,36 @@ class GenerationEngine:
                 n += 1
         return n
 
+    def _store_positions(self, want: int) -> int:
+        """KV positions a request of `want` total ids holds at worst:
+        the final drawn token never re-enters the cache, and the
+        stream-capacity guard retires a row before it can pass `cap`.
+        The ONE formula behind submit()'s never-fits rejection, the
+        head-of-line admission gate, and the page reservation — they
+        must agree or a request could pass submit yet never admit."""
+        return want - 1 if self._cap is None else min(want - 1,
+                                                      self._cap)
+
+    def _pages_admissible(self, req: GenerationRequest) -> bool:
+        """Worst-case page check for the head-of-line request: admit
+        only when its full reservation fits the free pool plus what the
+        prefix cache could evict. Conservative — a prefix hit may need
+        fewer fresh pages — so admission never over-commits; pages free
+        as active requests retire, so a fitting-in-principle head
+        always eventually admits."""
+        store = self._store_positions(req.want)
+        avail = self._pool.free_count() + (
+            self._prefix.evictable_pages() if self._prefix is not None
+            else 0)
+        return pages_needed(store, self._ps) <= avail
+
     def _admit_ready(self, now: float) -> int:
-        """Fill free slots from the admission queue in priority order."""
+        """Fill free slots from the admission queue in priority order
+        (paged mode: while the head request's pages fit)."""
         n = 0
+        gate = self._pages_admissible if self._pool is not None else None
         while None in self._slots:
-            req = self._pending.pop()
+            req = self._pending.pop(admissible=gate)
             if req is None:
                 break
             n += 1
@@ -369,22 +651,90 @@ class GenerationEngine:
             self._admit_one(req, self._slots.index(None))
         return n
 
+    def _alloc_request_pages(self, req: GenerationRequest):
+        """Reserve the request's worst-case pages: look up the longest
+        cached full-block prefix (mapped shared, refcount++), evict
+        unmapped cache entries if the fresh allocation falls short, and
+        allocate the rest. Returns ``(table, hit_len)`` — the slot's
+        block-ordered page table and how many prompt tokens the cached
+        pages already cover."""
+        hit_len, shared = 0, []
+        if self._prefix is not None:
+            if self._page_store is not None:
+                hit_len, shared = self._prefix.lookup(req.prompt)
+            else:
+                self._prefix.misses += 1   # nothing cached before the
+            (self._prefix_hits if shared  # first arena build
+             else self._prefix_misses).inc()
+            if hit_len:
+                self._prefix_reused.inc(hit_len)
+        store = self._store_positions(req.want)
+        need_new = pages_needed(store, self._ps) - len(shared)
+        # retain the shared pages BEFORE evicting: a deep shortfall must
+        # not reclaim the very blocks this admission is about to map
+        for p in shared:
+            self._pool.retain(p)
+        try:
+            short = need_new - self._pool.free_count()
+            if short > 0 and self._prefix is not None:
+                self._prefix.evict(short)
+            fresh = self._pool.alloc(need_new)  # PageExhausted only
+        except Exception:                       # under a chaos seize
+            for p in shared:
+                self._pool.release(p)
+            raise
+        return shared + fresh, hit_len
+
+    def _install_prefix(self, table, hit_len: int) -> None:
+        """Seed the detached prefill state with the cached prefix: the
+        mapped pages gather into a batch-1 dense view, kv_pos starts at
+        the block boundary, and the host position mirrors follow — the
+        suffix prime then continues the stream exactly as if the prefix
+        had just been primed."""
+        net = self.net
+        row = np.zeros((1, self._n_max), np.int32)
+        n_hit = hit_len // self._ps
+        row[0, :n_hit] = table[:n_hit]
+        dense = gather_pages(self._page_store, row, length=self._L)
+        pos = jnp.asarray(hit_len, jnp.int32)
+        for (n, k), leaf in zip(self._paged_keys, dense):
+            cur = net.state.get(n)
+            cur = dict(cur) if isinstance(cur, dict) else {}
+            cur[k] = leaf
+            cur["kv_pos"] = pos
+            net.state[n] = cur
+        net._stream_pos = hit_len
+        net._stream_pos_rows = None
+        if self._graph_vertices:
+            net._stream_pos_map = {n: hit_len
+                                   for n in self._graph_vertices}
+
     def _admit_one(self, req: GenerationRequest, slot: int) -> None:
         """Prefill `req` at batch 1 and join it to the arena at `slot`.
         A prefill failure fails THAT request only: the arena state is
-        restored untouched, so in-flight requests are unaffected."""
+        restored untouched (and the request's pages released), so
+        in-flight requests are unaffected."""
         net = self.net
         saved_state = dict(net.state)
         saved_acct = self._save_accounting()
+        table, hit_len = [], 0
         try:
+            if self._pool is not None:
+                table, hit_len = self._alloc_request_pages(req)
             _fire_chaos(self._prefill_chaos, self._admissions)
             net.rnn_clear_previous_state()
-            p0 = prime_prompt(net, req.prompt, self.V,
-                              padded=self._prime_padded)
+            if hit_len:
+                self._install_prefix(table, hit_len)
+                p0 = prime_prompt(net, req.prompt[hit_len:], self.V,
+                                  padded=self._prime_padded)
+            else:
+                p0 = prime_prompt(net, req.prompt, self.V,
+                                  padded=self._prime_padded)
             primed_pos = self._net_pos(net)
         except Exception as e:  # noqa: BLE001 — per-request failure domain
             net.state = saved_state
             self._restore_accounting(saved_acct)
+            self._release_pages(table)
             self._admissions += 1
             self._handles[SERVING_ERRORS].inc()
             req.handle._fail(e)
@@ -408,16 +758,64 @@ class GenerationEngine:
             # one-token request: never enters the arena at all
             net.state = saved_state
             self._restore_accounting(saved_acct)
+            self._release_pages(table)
             req.handle._finish(reason)
             return
         if not self._arena_ready:
+            if self._pool is not None:
+                self._init_page_store(primed_state)
             saved_state = self._build_arena(primed_state, saved_state)
             self._arena_ready = True
         net.state = self._merge(saved_state, primed_state, slot)
+        if self._pool is not None:
+            self._scatter_primed_pages(primed_state, table)
+            self._page_tables[slot] = table
+            if self._prefix is not None:
+                self._prefix.insert(req.prompt, table)
         self._slots[slot] = req
         self._row_pos[slot] = primed_pos
         req.pending_token = tok
         self._sync_accounting()
+
+    def _release_pages(self, table) -> None:
+        for p in table:
+            self._pool.release(p)
+
+    def _init_page_store(self, primed_state) -> None:
+        """First-admission pool build: one device page array per paged
+        leaf (kv_k/kv_v of every attention layer), sized
+        [total_pages, Hkv, page_size, D] in the leaf's dtype."""
+        keys, store = [], []
+        for n in sorted(primed_state):
+            s = primed_state[n]
+            if not isinstance(s, dict):
+                continue
+            for k in ("kv_k", "kv_v"):
+                if k not in s:
+                    continue
+                v = jnp.asarray(s[k])      # [1, Hkv, L, D]
+                if v.shape[2] != self._L:
+                    raise RuntimeError(
+                        f"paged leaf {n}.{k} carries length "
+                        f"{v.shape[2]} != cache_length {self._L}")
+                keys.append((n, k))
+                store.append(jnp.zeros(
+                    (self._pool.total_pages, v.shape[1], self._ps,
+                     v.shape[3]), v.dtype))
+        if not keys:
+            raise RuntimeError("paged mode found no kv_k/kv_v leaves "
+                               "in the primed stream state")
+        self._paged_keys = keys
+        self._page_store = store
+
+    def _scatter_primed_pages(self, primed_state, table) -> None:
+        """Commit the primed batch-1 KV into the slot's pages (one
+        jitted scatter; shared prefix pages are rewritten with the
+        identical bytes they were gathered from)."""
+        row = np.zeros((1, self._n_max), np.int32)
+        row[0, :len(table)] = table
+        dense = [primed_state[n][k] for n, k in self._paged_keys]
+        self._page_store = scatter_pages(self._page_store, dense, row)
 
     def _dispatch_step(self):
         """ONE jitted decode dispatch advancing every active slot (free
@@ -435,23 +833,66 @@ class GenerationEngine:
         if not any(r is not None for r in self._slots):
             return None     # everything retired at the capacity guard
         self._sync_accounting()
-
-        def once():
-            # chaos INSIDE the retried callable: the fault fires before
-            # any state mutates, so a retried dispatch is numerically
-            # identical to a fault-free one
-            _fire_chaos(self._decode_chaos, self._dispatches)
-            return step_tokens(self.net, toks, self.V)
-
-        probs = (retry_call(once, policy=self._decode_retry,
-                            op="serving_decode")
-                 if self._decode_retry is not None else once())
-        self._dispatches += 1
+        probs = self._run_dispatch(
+            lambda: step_tokens(self.net, toks, self.V))
         for s, req in enumerate(self._slots):
             if req is not None:
                 self._row_pos[s] += 1
         self._sync_accounting()
         return probs
+
+    def _run_dispatch(self, fn):
+        """The ONE paged/chaos/retry wrapper around a decode or verify
+        dispatch: gather the dense view from the pool, run `fn` with
+        the chaos hook INSIDE the retried callable (the fault fires
+        before any state mutates, so a retried dispatch is numerically
+        identical to a fault-free one), then commit the updated view
+        back BEFORE any retirement the outputs trigger can free
+        pages."""
+        table = self._paged_gather() if self._pool is not None else None
+
+        def once():
+            _fire_chaos(self._decode_chaos, self._dispatches)
+            return fn()
+
+        out = (retry_call(once, policy=self._decode_retry,
+                          op="serving_decode")
+               if self._decode_retry is not None else once())
+        if table is not None:
+            self._paged_scatter(table)
+        self._dispatches += 1
+        return out
+
+    # ------------------------------------------------------------------
+    # the paged pool <-> dense-view round trip
+    # ------------------------------------------------------------------
+    def _tables_np(self) -> np.ndarray:
+        t = np.zeros((self.slots, self._n_max), np.int32)
+        for s, pages in enumerate(self._page_tables):
+            t[s, :len(pages)] = pages
+        return t
+
+    def _paged_gather(self) -> np.ndarray:
+        """Materialize the dense per-slot KV view from the pool into
+        ``net.state`` for the coming dispatch; returns the page table it
+        was gathered through (the scatter must use the same snapshot)."""
+        table = self._tables_np()
+        dense = gather_pages(self._page_store, table, length=self._L)
+        st = dict(self.net.state)
+        for (n, k), leaf in zip(self._paged_keys, dense):
+            d = dict(st[n])
+            d[k] = leaf
+            st[n] = d
+        self.net.state = st
+        return table
+
+    def _paged_scatter(self, table: np.ndarray) -> None:
+        """Commit the dispatch's updated dense KV back to the mapped
+        pages (donated in-place pool update). Must run before any
+        retirement triggered by the dispatch's outputs — freed pages
+        may be re-allocated at the next admission."""
+        dense = [self.net.state[n][k] for n, k in self._paged_keys]
+        self._page_store = scatter_pages(self._page_store, dense, table)
 
     def _retire(self, slot: int, reason: str,
                 exc: Optional[BaseException] = None) -> None:
@@ -461,6 +902,13 @@ class GenerationEngine:
         req = self._slots[slot]
         self._slots[slot] = None
         self._row_pos[slot] = 0
+        if self._pool is not None:
+            # pages return to the pool immediately; blocks the prefix
+            # cache also references stay resident at the cache's own
+            # refcount, warm for the next request sharing them
+            for p in self._page_tables[slot]:
+                self._pool.release(p)
+            self._page_tables[slot] = []
         if exc is not None:
             req.handle._fail(exc, reason)
         else:
@@ -505,11 +953,14 @@ class GenerationEngine:
 
     def _merge(self, arena_state, primed_state, slot: int):
         if self._merge_keys is None:
+            # paged leaves join through the page scatter, not the dense
+            # arena (their dense view is rebuilt from the pool per step)
+            excl = {"kv_k", "kv_v"} if self._pool is not None else set()
             self._merge_keys = [
                 (n, k) for n in sorted(primed_state)
                 if isinstance(primed_state[n], dict)
                 for k in sorted(primed_state[n])
-                if k in _SCATTER_KEYS]
+                if k in _SCATTER_KEYS and k not in excl]
         arena_leaves = [arena_state[n][k] for n, k in self._merge_keys]
         primed_leaves = [primed_state[n][k] for n, k in self._merge_keys]
         new_leaves = _scatter_rows(arena_leaves, primed_leaves,
@@ -584,15 +1035,49 @@ class GenerationEngine:
             lens.append(top)      # a non-pow2 top primes at bucket(top)
         if cap is not None:
             lens = sorted({min(v, cap - 1) for v in lens})
+        if self._speculation is not None and cap is not None:
+            room = cap - self._speculation.gamma + 1 - steps
+            lens = sorted({max(1, min(v, room)) for v in lens})
         tok = 1 if self.V > 1 else 0
-        for v in lens:
-            # drain per bucket: warmup must not depend on queue_limit
+
+        def drive(prompt):
+            # drain per request: warmup must not depend on queue_limit
             # headroom (block policy would deadlock, fail_fast would
             # reject, with more buckets than queue slots)
-            h = self.submit([tok] * v, steps=steps, top_k=1,
+            h = self.submit(prompt, steps=steps, top_k=1,
                             rng=np.random.default_rng(0))
             self.run_until_idle()
             h.result(timeout=0)
+
+        # fresh pass: every prime bucket from an empty stream. The
+        # prefix cache is bypassed so one bucket's blocks cannot short-
+        # circuit a longer bucket's fresh-prime shape out of the warm set
+        prefix, self._prefix = self._prefix, None
+        try:
+            for v in lens:
+                drive([tok] * v)
+        finally:
+            self._prefix = prefix
+        top = max(lens)        # post-clamp envelope (capacity, spec)
+        if prefix is not None and top > self._ps:
+            # prefix pass: warm the hit path — the [1, n_max] page
+            # gather plus every WITH-PREFIX suffix-prime bucket a
+            # cached-hit admission can reach. Seed one base block
+            # (token 0 — disjoint from the fresh pass), then hit it
+            # with suffixes covering each bucket; suffix leads cycle
+            # the vocab so iterations don't chain-hit each other.
+            ps = self._ps
+            room = top - ps
+            sfx, n = [], 1
+            while n <= room:
+                sfx.append(n)
+                n *= 2
+            if room not in sfx:
+                sfx.append(room)
+            drive([0] * (ps + 1))          # seed: caches the base block
+            for j, b in enumerate(sorted(set(sfx))):
+                lead = 1 + j % (self.V - 1) if self.V > 1 else 0
+                drive([0] * ps + [lead] * b)
         return self
 
     # ------------------------------------------------------------------

@@ -36,6 +36,16 @@ SERVING_TTFT = "dl4jtpu_serving_ttft_seconds"
 SERVING_TPOT = "dl4jtpu_serving_tpot_seconds"
 SERVING_QUEUE_WAIT = "dl4jtpu_serving_queue_wait_seconds"
 
+#: block-paged KV arena + prefix cache + in-engine speculation (engine
+#: registers these only in the matching mode)
+SERVING_KV_PAGES_TOTAL = "dl4jtpu_serving_kv_pages_total"
+SERVING_KV_PAGES_USED = "dl4jtpu_serving_kv_pages_used"
+SERVING_PREFIX_HITS = "dl4jtpu_serving_prefix_cache_hits_total"
+SERVING_PREFIX_MISSES = "dl4jtpu_serving_prefix_cache_misses_total"
+SERVING_PREFIX_REUSED_TOKENS = \
+    "dl4jtpu_serving_prefix_cache_reused_tokens_total"
+SERVING_SPEC_ACCEPTANCE = "dl4jtpu_serving_spec_acceptance_ratio"
+
 _COUNTERS = (
     (SERVING_REQUESTS, "Serving requests received"),
     (SERVING_ERRORS, "Serving requests failed by model errors"),

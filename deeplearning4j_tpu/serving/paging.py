@@ -1,0 +1,211 @@
+"""Block-paged KV storage for the generation engine (vLLM-style).
+
+PR 5's arena sized every slot for the worst-case sequence, so admitted
+concurrency was capped at S and a short request stranded the HBM of the
+positions it never used. Here the authoritative KV storage is a **page
+pool**: per attention leaf, a ``[P, Hkv, page_size, D]`` array of
+fixed-size token pages, plus one per-slot **page table** mapping the
+slot's token blocks to pool pages. Capacity becomes a *token* budget
+(the µ-cuDNN memory-budget decomposition applied to serving state):
+
+- admission checks ``prompt_len + max_new_tokens`` against **free
+  pages**, not free slots — short requests hold few pages, so a pool
+  sized like the old S-slot arena admits far more short requests;
+- retirement returns the slot's pages to the pool immediately (host
+  list ops — no device work);
+- pages are refcounted, so the prefix cache can map one physical page
+  into many slots' tables read-only (``serving/prefix_cache.py``).
+
+The per-step dispatch keeps PR 5's canonical shape: a jitted
+``gather_pages`` materializes the active slots' dense ``[S, Hkv, L, D]``
+view from the pool, the ONE decode (or widened verify) dispatch runs
+over it unchanged — bit-identical math to the slot arena, since valid
+positions gather the exact bytes the arena would hold — and a jitted
+donated ``scatter_pages`` commits the updated view back to the mapped
+pages. Three fixed-shape dispatches per step, zero retraces after
+warmup. (Fusing the gather into the attention kernel itself — true
+paged attention — is the Pallas ``kernels/`` roadmap item; this module
+is the allocation/accounting layer it will slot under.)
+
+Page 0 is the reserved **null page**: table entries beyond a slot's
+allocation point at it, so gathers read garbage that position-validity
+masks (``kv_pos``) keep invisible, and colliding scatter writes land
+harmlessly where nothing is ever read.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["PagePool", "PageExhausted", "PagedKVConfig", "gather_pages",
+           "pages_needed", "scatter_pages"]
+
+
+class PageExhausted(RuntimeError):
+    """The pool cannot satisfy an allocation (admission should have
+    head-blocked — reaching this mid-admission is an engine bug, except
+    under chaos-seized pools)."""
+
+
+@dataclass
+class PagedKVConfig:
+    """Knobs for the block-paged arena.
+
+    ``page_size`` tokens per page; capacity comes from ``total_pages``
+    or ``total_tokens`` (whichever is given — ``total_tokens`` rounds
+    down to whole pages), defaulting to the old slot arena's worst case
+    (slots × ceil(L / page_size)) so switching paging on never shrinks
+    capacity. ``prefix_cache`` enables shared-prompt page reuse."""
+
+    page_size: int = 8
+    total_pages: Optional[int] = None
+    total_tokens: Optional[int] = None
+    prefix_cache: bool = True
+
+    def __post_init__(self):
+        if self.page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got "
+                             f"{self.page_size}")
+        if self.total_pages is not None and self.total_pages < 1:
+            raise ValueError(f"total_pages must be >= 1, got "
+                             f"{self.total_pages}")
+        if self.total_tokens is not None and \
+                self.total_tokens < self.page_size:
+            raise ValueError(
+                f"total_tokens {self.total_tokens} is less than one "
+                f"page ({self.page_size} tokens)")
+
+    def resolve_pages(self, slots: int, n_max: int) -> int:
+        if self.total_pages is not None:
+            return int(self.total_pages)
+        if self.total_tokens is not None:
+            return int(self.total_tokens) // self.page_size
+        return int(slots) * int(n_max)
+
+
+def pages_needed(total_tokens: int, page_size: int) -> int:
+    """Pages a request holding `total_tokens` KV positions needs. The
+    final drawn token is never fed back (the request retires on it), so
+    a request of want = prompt + steps ids stores want - 1 positions —
+    callers pass that."""
+    return max(1, -(-int(total_tokens) // int(page_size)))
+
+
+class PagePool:
+    """Host-side page accounting: free list, per-page refcounts, and the
+    chaos seize/restore seam. Deterministic: pages allocate in LIFO
+    order, so a replayed trace maps the same physical pages.
+
+    Refcount protocol: ``alloc`` hands out pages at refcount 1 (the
+    allocating slot's reference); ``retain``/``release`` adjust for
+    additional holders (the prefix cache, other slots mapping a shared
+    page); a page returns to the free list when its count hits 0."""
+
+    def __init__(self, total_pages: int, page_size: int):
+        if total_pages < 2:
+            raise ValueError(
+                f"need >= 2 pages (page 0 is the reserved null page), "
+                f"got {total_pages}")
+        self.page_size = int(page_size)
+        self.total_pages = int(total_pages)
+        #: allocatable pages (page 0 reserved)
+        self.usable = self.total_pages - 1
+        self._free: List[int] = list(range(self.total_pages - 1, 0, -1))
+        self._ref = [0] * self.total_pages
+        self._seized: List[int] = []
+
+    # -- accounting ----------------------------------------------------
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def used_count(self) -> int:
+        return self.usable - len(self._free) - len(self._seized)
+
+    def refcount(self, page: int) -> int:
+        return self._ref[page]
+
+    # -- allocation ----------------------------------------------------
+    def alloc(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise PageExhausted(
+                f"need {n} pages, {len(self._free)} free "
+                f"(pool of {self.usable})")
+        out = [self._free.pop() for _ in range(n)]
+        for p in out:
+            self._ref[p] = 1
+        return out
+
+    def retain(self, page: int) -> None:
+        if self._ref[page] < 1:
+            raise ValueError(f"retain of unallocated page {page}")
+        self._ref[page] += 1
+
+    def release(self, page: int) -> None:
+        if self._ref[page] < 1:
+            raise ValueError(f"release of unallocated page {page}")
+        self._ref[page] -= 1
+        if self._ref[page] == 0:
+            self._free.append(page)
+
+    # -- chaos seam (resilience.chaos.PageExhaustionInjector) ----------
+    def seize(self, n: int) -> List[int]:
+        """Remove `n` free pages from circulation (fault injection: a
+        neighbouring tenant / fragmentation eating the pool). Seized
+        pages are not 'used' — they are simply gone until restore()."""
+        n = max(0, min(int(n), len(self._free)))
+        taken = [self._free.pop() for _ in range(n)]
+        self._seized.extend(taken)
+        return taken
+
+    def restore(self, pages=None) -> None:
+        """Return seized pages (default: all of them) to the free list."""
+        back = list(self._seized) if pages is None else list(pages)
+        for p in back:
+            self._seized.remove(p)
+            self._free.append(p)
+
+
+# ---------------------------------------------------------------------------
+# the jitted pool <-> dense-view round trip
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("length",))
+def gather_pages(pools, table, *, length: int):
+    """Materialize the dense per-slot view from the pool: for each leaf
+    ``[P, Hkv, ps, D]``, gather ``table`` ([S, n_max] page ids, 0 =
+    null) into ``[S, Hkv, n_max*ps, D]`` and slice to the layer cache
+    length. Unmapped blocks read the null page — garbage the kv_pos
+    validity masks keep invisible."""
+    out = []
+    for pool in pools:
+        _, h, _, d = pool.shape
+        g = pool[table]                      # [S, n, Hkv, ps, D]
+        g = jnp.moveaxis(g, 2, 1)            # [S, Hkv, n, ps, D]
+        out.append(g.reshape(g.shape[0], h, -1, d)[:, :, :length, :])
+    return out
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def scatter_pages(pools, dense, table):
+    """Commit the updated dense views back to their mapped pages
+    (donated: the pool buffer is updated in place). Only pages in
+    `table` are written; free pages and unmapped cache entries keep
+    their bytes. Duplicate page ids (prefix-shared blocks) collide with
+    bit-identical values — the dense view was gathered from the same
+    page and decode never rewrites old positions — so write order is
+    immaterial. Blocks past a slot's allocation write the null page."""
+    out = []
+    for pool, d in zip(pools, dense):
+        _, h, ps, dd = pool.shape
+        s, n = table.shape
+        pad = n * ps - d.shape[2]
+        dp = jnp.pad(d, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        dp = dp.reshape(s, h, n, ps, dd)
+        dp = jnp.moveaxis(dp, 2, 1)          # [S, n, Hkv, ps, D]
+        out.append(pool.at[table].set(dp.astype(pool.dtype)))
+    return out

@@ -1,0 +1,145 @@
+"""Prompt prefix cache over the block-paged KV pool.
+
+Prompts sharing a leading token-block sequence (the canonical case: one
+system prompt in front of every request) should prime once. Each cache
+entry maps a *full-block prefix* to the pool page holding its last
+block's K/V. The key is ``(parent entry id, block tokens)`` — parent
+ids are unique forever (monotonic, never reused), so the key pins the
+ENTIRE prefix exactly without storing it: the entry for blocks [0..k]
+is only reachable through the chain of k matches before it, a lookup
+walks block by block from the root (parent id 0) and stops at the
+first miss, and a stale child whose parent was evicted can never be
+re-reached (no later entry ever takes the old parent's id). Keys cost
+O(page_size) per block instead of the O(prefix) cumulative-tuple
+alternative, which goes quadratic on long system prompts.
+
+On a hit the engine maps the matched pages straight into the new slot's
+page table (refcount++ — physically shared, read-only by convention)
+and prefills ONLY the suffix from the block boundary: TTFT drops from
+full-prompt prefill to queue-wait + suffix prefill. The first partial
+block past the match gets a fresh page the suffix prefill fills —
+copy-on-extend: a slot never writes into a shared page, because writes
+land at positions >= its prompt end and full prompt blocks end at or
+before it. At least one suffix token is always re-primed (a lookup
+never matches past ``prompt_len - 1``) so the admission draw always has
+a freshly computed next-token distribution.
+
+Exactness: a cached page holds exactly the K/V bytes a full prefill
+would compute for those positions — causal attention makes prefix K/V
+a function of the prefix tokens alone — so cache-on output is
+bit-identical to cache-off (test-pinned). Recurrent (LSTM h/c) state is
+a function of the whole prefix but lives OUTSIDE the pages, so the
+engine refuses to enable the cache for nets carrying recurrent
+streaming state.
+
+Eviction: entries are LRU-ordered (a lookup touches every matched
+level, parents before children, so a chain ages coherently); an entry
+is evictable once no slot maps its page (pool refcount 1 — the cache's
+own reference). Under page pressure the engine asks for the shortfall;
+``evict`` walks oldest-first and frees what it can. Evicting a parent
+strands its children unreachable — they simply age out next.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import List, Sequence, Tuple
+
+from deeplearning4j_tpu.serving.paging import PagePool
+
+__all__ = ["PrefixCache"]
+
+
+class PrefixCache:
+    """Full-block prompt prefix cache over a :class:`PagePool`."""
+
+    #: root parent id — entry ids start at 1 and are never reused
+    _ROOT = 0
+
+    def __init__(self, pool: PagePool):
+        self._pool = pool
+        self._ps = pool.page_size
+        #: (parent entry id, block token tuple) -> (page id, entry id)
+        self._entries: "OrderedDict[tuple, Tuple[int, int]]" = \
+            OrderedDict()
+        self._next_id = 1
+        self.hits = 0          # requests that reused >= 1 block
+        self.misses = 0        # requests that reused none
+        self.reused_tokens = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def _block(self, prompt, i: int) -> tuple:
+        return tuple(prompt[i * self._ps:(i + 1) * self._ps])
+
+    # ------------------------------------------------------------------
+    def lookup(self, prompt: Sequence[int]) -> Tuple[int, List[int]]:
+        """Longest cached full-block prefix of `prompt`, capped so at
+        least one prompt token remains for the suffix prefill. Returns
+        ``(n_tokens_matched, page_ids)`` and counts a hit/miss; the
+        caller owns retaining the returned pages."""
+        limit = (len(prompt) - 1) // self._ps    # usable full blocks
+        pages: List[int] = []
+        parent = self._ROOT
+        for i in range(limit):
+            key = (parent, self._block(prompt, i))
+            ent = self._entries.get(key)
+            if ent is None:
+                break
+            self._entries.move_to_end(key)   # LRU touch, parent first
+            pages.append(ent[0])
+            parent = ent[1]
+        if pages:
+            self.hits += 1
+            self.reused_tokens += len(pages) * self._ps
+        else:
+            self.misses += 1
+        return len(pages) * self._ps, pages
+
+    def insert(self, prompt: Sequence[int], table: Sequence[int]) -> None:
+        """Register every full block of a just-prefilled prompt whose
+        page the slot owns (`table` = the slot's block-ordered pages).
+        Existing entries are touched, new ones take a cache reference on
+        the slot's page — the page then outlives the request (refcount
+        drops to the cache's 1 at retirement) and stays warm until
+        evicted."""
+        parent = self._ROOT
+        for i in range(len(prompt) // self._ps):
+            key = (parent, self._block(prompt, i))
+            ent = self._entries.get(key)
+            if ent is not None:
+                self._entries.move_to_end(key)
+                parent = ent[1]
+                continue
+            page = table[i]
+            self._pool.retain(page)
+            ent_id = self._next_id
+            self._next_id += 1
+            self._entries[key] = (page, ent_id)
+            parent = ent_id
+
+    # ------------------------------------------------------------------
+    def evictable_pages(self) -> int:
+        """Pages reclaimable right now (entries no slot maps)."""
+        return sum(1 for p, _ in self._entries.values()
+                   if self._pool.refcount(p) == 1)
+
+    def evict(self, n_pages: int) -> int:
+        """Free up to `n_pages` pages, oldest entries first, skipping
+        entries still mapped by an active slot. Returns pages freed."""
+        freed = 0
+        for key in list(self._entries):
+            if freed >= n_pages:
+                break
+            page = self._entries[key][0]
+            if self._pool.refcount(page) != 1:
+                continue                     # a slot still maps it
+            del self._entries[key]
+            self._pool.release(page)
+            freed += 1
+        return freed
+
+    def clear(self) -> int:
+        """Drop every unmapped entry (shutdown / tests)."""
+        return self.evict(len(self._entries))

@@ -89,12 +89,23 @@ class AdmissionQueue:
                 self._cond.notify_all()
             return dead
 
-    def pop(self) -> Optional[GenerationRequest]:
+    def pop(self, admissible=None) -> Optional[GenerationRequest]:
         """Highest-priority queued request, or None (non-blocking).
         Deadline/cancellation checks belong to the engine's admission
-        step, which fails the popped request's handle itself."""
+        step, which fails the popped request's handle itself.
+
+        `admissible(req)` (optional) is consulted on the HEAD request
+        only: False leaves it queued and returns None — the paged
+        engine's head-of-line block when the head needs more free pages
+        than exist, so admission order stays FIFO-per-priority instead
+        of starving big requests behind a stream of small ones (pages
+        free as active requests retire, so the head always eventually
+        fits; requests that can NEVER fit are rejected at submit)."""
         with self._cond:
             if not self._heap:
+                return None
+            if admissible is not None and \
+                    not admissible(self._heap[0][2]):
                 return None
             _, _, req = heapq.heappop(self._heap)
             self._cond.notify_all()      # wake blocked submitters

@@ -337,6 +337,58 @@ def step_tokens(net, tokens, vocab_size: int) -> np.ndarray:
     return _probs(out)[:, :, -1]
 
 
+def verify_tokens(net, chunks, vocab_size: int) -> np.ndarray:
+    """One widened verify forward for a batch of token chunks: feed
+    `chunks` [B, W] (W = 1 + gamma for engine speculation) in a single
+    dispatch and return ALL per-position next-token distributions
+    [B, V, W]. The speculative counterpart of step_tokens — position j's
+    row is the distribution AFTER consuming chunk[:, :j+1]; causality
+    makes trailing dummy tokens invisible to earlier positions, so a
+    fixed-width chunk serves rows with fewer real proposals (the
+    uniform-chunk trick of speculative_sample_batch)."""
+    out = net.rnn_time_step(
+        _one_hot(np.asarray(chunks, np.int64), vocab_size))
+    return _probs(out)
+
+
+def accept_proposals(proposals, p_dists, q_dists, p_bonus, rng
+                     ) -> Tuple[int, int]:
+    """The Leviathan et al. 2023 rejection walk, extracted as the ONE
+    acceptance rule shared by speculative_sample,
+    speculative_sample_batch, and the serving engine's in-engine
+    speculation: accept proposal i with prob min(1, p_i[d]/q_i[d]); on
+    the first rejection draw the replacement from the clipped residual
+    max(p_i - q_i, 0) (falling back to p_i when q subsumes p); with
+    every proposal accepted draw the bonus token from `p_bonus` (the
+    target's distribution one past the proposals). Returns
+    ``(accepted, next_token)`` — the committed tokens are
+    ``proposals[:accepted] + [next_token]`` and the target's sampling
+    distribution is exactly preserved.
+
+    A ``q_dists`` entry of None means the proposer was DETERMINISTIC —
+    a one-hot draft at the proposal under the rejection rule — handled
+    without materializing the [V] one-hot: q_i[d] == 1, and the
+    rejection residual is p_i with entry d zeroed. rng consumption
+    order (one uniform per walked proposal, then exactly one choice) is
+    part of the contract: per-row engine speculation must consume each
+    request's rng identically to a per-prompt run."""
+    for i, d in enumerate(proposals):
+        p_i, q_i = p_dists[i], q_dists[i]
+        qd = 1.0 if q_i is None else float(q_i[d])
+        if rng.random() < min(1.0, float(p_i[d]) / max(qd, 1e-12)):
+            continue
+        if q_i is None:
+            resid = np.array(p_i)
+            resid[d] = 0.0
+        else:
+            resid = np.maximum(p_i - q_i, 0.0)
+        total = resid.sum()
+        if total <= 0:            # p subsumed by q: fall back to p_i
+            resid, total = p_i, p_i.sum()
+        return i, int(rng.choice(len(resid), p=resid / total))
+    return len(proposals), int(rng.choice(len(p_bonus), p=p_bonus))
+
+
 def stop_reason(token: int, n_ids: int, want: int,
                 stop_set) -> Optional[str]:
     """Why generation ends after appending `token` as the n_ids-th id
@@ -584,11 +636,8 @@ def speculative_sample(net, draft, seed_ids, steps: int,
             proposals = [int(t) for t in draft(ids, g)][:g]
             g = len(proposals)
             # deterministic proposer == one-hot draft distribution
-            q_dists = []
-            for d in proposals:
-                one = np.zeros(V)
-                one[d] = 1.0
-                q_dists.append(one)
+            # (None entries — accept_proposals' materialization-free path)
+            q_dists = [None] * g
         else:
             proposals, q_dists = [], []
             if pending is not None:
@@ -640,29 +689,11 @@ def speculative_sample(net, draft, seed_ids, steps: int,
             for i in range(g - 1)]
         p_bonus = filter_probs(tp[:, off + g - 1], temperature,
                                top_k, top_p)
-        # --- standard acceptance walk ---------------------------------
-        accepted = 0
-        replacement = None
-        for i, d in enumerate(proposals):
-            p_i, q_i = p_dists[i], q_dists[i]
-            if rng.random() < min(1.0, float(p_i[d]) /
-                                  max(float(q_i[d]), 1e-12)):
-                accepted += 1
-            else:
-                resid = np.maximum(p_i - q_i, 0.0)
-                total = resid.sum()
-                if total <= 0:        # p subsumed by q: fall back to p_i
-                    resid, total = p_i, p_i.sum()
-                replacement = int(rng.choice(V, p=resid / total))
-                break
+        # --- standard acceptance walk (the shared rejection rule) -----
+        accepted, nxt = accept_proposals(proposals, p_dists, q_dists,
+                                         p_bonus, rng)
         base = len(ids)
         ids.extend(proposals[:accepted])
-        if replacement is None:
-            # every proposal accepted: bonus token from the target's
-            # distribution one past the proposals
-            nxt = int(rng.choice(V, p=p_bonus))
-        else:
-            nxt = replacement
         ids.append(nxt)
         if stop_set:
             cut = _stop_cut(base)
@@ -843,10 +874,7 @@ def speculative_sample_batch(net, draft, prompts, steps: int,
                     continue
                 props = [int(x) for x in draft(ids[b], min(g, room[b]))]
                 proposals[b] = props[:min(g, room[b])]
-                for d in proposals[b]:
-                    one = np.zeros(V)
-                    one[d] = 1.0
-                    q_dists[b].append(one)
+                q_dists[b] = [None] * len(proposals[b])
         else:
             # rounds >= 2: one dispatch consumes every row's pending
             # token into the draft cache (round 1 has no pendings — the
@@ -930,24 +958,10 @@ def speculative_sample_batch(net, draft, prompts, steps: int,
                 for i in range(g_b - 1)]
             p_bonus = filter_probs(tp[:, off + g_b - 1], temperature,
                                    top_k, top_p)
-            accepted = 0
-            replacement = None
-            for i, d in enumerate(proposals[b]):
-                p_i, q_i = p_dists[i], q_dists[b][i]
-                if rngs[b].random() < min(1.0, float(p_i[d]) /
-                                          max(float(q_i[d]), 1e-12)):
-                    accepted += 1
-                else:
-                    resid = np.maximum(p_i - q_i, 0.0)
-                    total = resid.sum()
-                    if total <= 0:
-                        resid, total = p_i, p_i.sum()
-                    replacement = int(rngs[b].choice(V, p=resid / total))
-                    break
+            accepted, nxt = accept_proposals(proposals[b], p_dists,
+                                             q_dists[b], p_bonus, rngs[b])
             base = len(ids[b])
             ids[b].extend(proposals[b][:accepted])
-            nxt = (int(rngs[b].choice(V, p=p_bonus))
-                   if replacement is None else replacement)
             ids[b].append(nxt)
             rew[b] = chunk_len - off - accepted
             draft_keep[b] = accepted

@@ -46,6 +46,13 @@ python -m pytest tests/test_resilience.py -q -p no:cacheprovider
 # guard across staggered admissions
 python -m pytest tests/test_serving_engine.py -q -p no:cacheprovider
 
+# tier-1 serving-v2 lane: the block-paged KV arena, prefix cache, and
+# in-engine speculation — paged==slot-arena==one-shot bit-exactness,
+# token-budget admission (incl. the oversized-request submit rejection),
+# page lifecycle/eviction, chaos page exhaustion, and zero retraces
+# with every mode on
+python -m pytest tests/test_serving_paged.py -q -p no:cacheprovider
+
 python -m pytest tests/ -q --junitxml=/tmp/dl4jtpu_junit.xml "$@"
 
 # only a FULL unfiltered run may overwrite the committed tally — a
