@@ -1070,6 +1070,86 @@ def bench_converge_resnet():
     _converge_report("resnet", traj, steps, {"fuse": str(fuse)})
 
 
+def bench_checkpoint_stall():
+    """Durability tax, measured (ISSUE 7): per-step fit overhead with
+    checkpointing off / sync / async at a fixed cadence. The async claim
+    — "the fit loop blocks only for the device→host snapshot" — becomes
+    a number: stall ms per save for each mode, plus bytes committed and
+    the steps/s delta vs checkpointing off. Same net, same seed, same
+    synthetic stream in all three legs."""
+    import shutil
+    import tempfile
+
+    from deeplearning4j_tpu.datasets.iterators import \
+        BenchmarkDataSetIterator
+    from deeplearning4j_tpu.monitoring.metrics import global_registry
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updater import Adam
+    from deeplearning4j_tpu.resilience.durable import CKPT_BYTES
+    from deeplearning4j_tpu.util.checkpoint import CheckpointListener
+
+    steps = int(os.environ.get("BENCH_CKPT_STEPS", "60"))
+    cadence = int(os.environ.get("BENCH_CKPT_EVERY", "10"))
+    width = int(os.environ.get("BENCH_CKPT_WIDTH", "512"))
+
+    def build():
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(7).updater(Adam(0.001)).list()
+                .layer(DenseLayer(n_out=width, activation="relu"))
+                .layer(DenseLayer(n_out=width, activation="relu"))
+                .layer(OutputLayer(n_out=10, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.feed_forward(256))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def bytes_total():
+        c = global_registry().get(CKPT_BYTES)
+        return 0.0 if c is None else c.total()
+
+    def leg(mode):
+        it = BenchmarkDataSetIterator((64, 256), 10, steps)
+        net = build()
+        ckdir = tempfile.mkdtemp(prefix=f"bench_ckpt_{mode}_")
+        lst = None
+        if mode != "off":
+            lst = CheckpointListener(ckdir, save_every_n_iterations=cadence,
+                                     keep_last=2, async_save=(mode == "async"))
+            net.set_listeners(lst)
+        net.fit(it, epochs=1, batch_size=64)  # warmup epoch: traces compile
+        b0, t0 = bytes_total(), time.perf_counter()
+        it2 = BenchmarkDataSetIterator((64, 256), 10, steps)
+        net.fit(it2, epochs=1, batch_size=64)
+        elapsed = time.perf_counter() - t0
+        if lst is not None:
+            lst.flush(timeout=120)
+            lst.close()
+        saves = max(1, steps // cadence) if mode != "off" else 0
+        shutil.rmtree(ckdir, ignore_errors=True)
+        return {"elapsed_s": round(elapsed, 4),
+                "steps_per_s": round(steps / elapsed, 2),
+                "saves": saves,
+                "ckpt_bytes": int(bytes_total() - b0)}
+
+    res = {m: leg(m) for m in ("off", "sync", "async")}
+    for m in ("sync", "async"):
+        extra = res[m]["elapsed_s"] - res["off"]["elapsed_s"]
+        res[m]["stall_ms_per_save"] = round(
+            max(0.0, extra) / res[m]["saves"] * 1000.0, 3)
+        res[m]["steps_per_s_delta_pct"] = round(
+            100.0 * (res[m]["steps_per_s"] / res["off"]["steps_per_s"] - 1),
+            2)
+    _print_line(json.dumps({
+        "metric": "checkpoint_stall",
+        "value": res["async"]["stall_ms_per_save"],
+        "unit": "ms_per_save_async",
+        "steps": steps, "cadence": cadence,
+        "sync_stall_ms_per_save": res["sync"]["stall_ms_per_save"],
+        "modes": res}))
+
+
 ALL = {"resnet": bench_resnet, "lstm": bench_lstm, "lenet": bench_lenet,
        "vgg16": bench_vgg16, "inception": bench_keras_inception,
        "attention": bench_attention, "transformer": bench_transformer,
@@ -1079,6 +1159,7 @@ ALL = {"resnet": bench_resnet, "lstm": bench_lstm, "lenet": bench_lenet,
        "specbatch": bench_specbatch,
        "serve_continuous": bench_serve_continuous,
        "serve_paged": bench_serve_paged,
+       "checkpoint_stall": bench_checkpoint_stall,
        "converge_lenet": bench_converge_lenet,
        "converge_resnet": bench_converge_resnet}
 

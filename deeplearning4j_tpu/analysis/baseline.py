@@ -58,9 +58,15 @@ def write_baseline(path: str, findings: Sequence[Finding]) -> None:
     payload = {"version": BASELINE_VERSION,
                "tool": "tpulint",
                "findings": dict(sorted(entries.items()))}
-    with open(path, "w", encoding="utf-8") as f:
+    # tmp + rename (stdlib-only — this package must import anywhere):
+    # a crash mid-write must not leave CI gating on a torn baseline
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
         json.dump(payload, f, indent=2, sort_keys=False)
         f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 def split_new(findings: Sequence[Finding], baseline: Dict[str, dict]

@@ -21,6 +21,8 @@ from deeplearning4j_tpu.analysis.rules.concurrency import ThreadSharedStateRule
 from deeplearning4j_tpu.analysis.rules.hygiene import (
     BareExceptRule, MutableDefaultRule)
 from deeplearning4j_tpu.analysis.rules.retry_loop import UnboundedRetryRule
+from deeplearning4j_tpu.analysis.rules.state_write import (
+    NonAtomicStateWriteRule)
 
 ALL_RULES: List[Rule] = [
     HostSyncRule(),
@@ -32,6 +34,7 @@ ALL_RULES: List[Rule] = [
     BareExceptRule(),
     MutableDefaultRule(),
     UnboundedRetryRule(),
+    NonAtomicStateWriteRule(),
 ]
 
 RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in ALL_RULES}

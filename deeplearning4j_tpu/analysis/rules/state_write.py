@@ -1,0 +1,142 @@
+"""non-atomic-state-write: state serialized straight onto its final path.
+
+``open(path, "w"/"wb")`` + ``json.dump``/``pickle.dump`` (or
+``f.write(json.dumps(...))``, or a ``zipfile.ZipFile(path, "w")`` model
+save) truncates the ONLY copy of the state before the new bytes are
+durable: a crash mid-write — preemption, disk-full, SIGKILL — leaves a
+torn file where a loadable one used to be, and the next load fails (or
+worse, half-parses). The sanctioned shape is tmp-in-same-dir → flush →
+fsync → ``os.replace`` — ``resilience.durable.atomic_write_json`` /
+``atomic_write_bytes`` for JSON/blob state, or writing the zip/npz to a
+tmp path and renaming it into place.
+
+A write is flagged when ALL of:
+
+- the sink is ``open(path, "w"|"wb")`` (append-mode sinks are logs, not
+  replace-writes) or ``zipfile.ZipFile(path, "w")``;
+- serialized STATE flows into it: ``json.dump(obj, f)``,
+  ``pickle.dump(obj, f)``, ``f.write(json.dumps(...))`` anywhere in the
+  ``with`` body — or, for ZipFile, the zip itself (a whole-model
+  archive is state by construction);
+- the target path shows no sign of the tmp-rename idiom: any ``tmp`` in
+  the path expression (``tmp = path + ".tmp"``, ``mktemp``,
+  ``tmp_path``) marks the write as the tmp half of an atomic replace
+  and exempts it.
+
+``resilience/durable.py`` — the helper the rule points at — is exempt
+wholesale. Plain-text report/HTML exports (``f.write(html)``) are out of
+scope: losing a report to a crash is an inconvenience, not a recovery
+failure.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from deeplearning4j_tpu.analysis.core import (
+    Finding, ModuleInfo, Rule, SEVERITY_WARNING)
+
+_DUMPERS = {"json.dump", "pickle.dump"}
+_SERIALIZERS = {"json.dumps", "pickle.dumps"}
+
+
+def _call_mode(call: ast.Call, default: str = "r") -> Optional[str]:
+    """The literal mode of an open()/ZipFile() call; None when dynamic."""
+    arg = None
+    if len(call.args) >= 2:
+        arg = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            arg = kw.value
+    if arg is None:
+        return default
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    return None
+
+
+def _is_tmp_target(mod: ModuleInfo, call: ast.Call) -> bool:
+    """True when the path expression carries the tmp-rename idiom."""
+    if not call.args:
+        return False
+    seg = mod.segment(call.args[0]).lower()
+    return "tmp" in seg
+
+
+def _dump_into(mod: ModuleInfo, with_node: ast.With,
+               handle: Optional[str]) -> Optional[ast.AST]:
+    """First statement in the with-body that serializes state into the
+    opened handle."""
+    for sub in ast.walk(with_node):
+        if not isinstance(sub, ast.Call):
+            continue
+        name = mod.resolve(sub.func)
+        if name in _DUMPERS:
+            sink = None
+            if len(sub.args) >= 2:
+                sink = sub.args[1]
+            for kw in sub.keywords:
+                if kw.arg in ("fp", "file"):
+                    sink = kw.value
+            if handle is None or (isinstance(sink, ast.Name)
+                                  and sink.id == handle):
+                return sub
+        # f.write(json.dumps(...) [+ ...])
+        if isinstance(sub.func, ast.Attribute) and sub.func.attr == "write" \
+                and isinstance(sub.func.value, ast.Name) \
+                and (handle is None or sub.func.value.id == handle):
+            for inner in ast.walk(sub):
+                if isinstance(inner, ast.Call) and \
+                        mod.resolve(inner.func) in _SERIALIZERS:
+                    return sub
+    return None
+
+
+class NonAtomicStateWriteRule(Rule):
+    id = "non-atomic-state-write"
+    severity = SEVERITY_WARNING
+    description = ("state serialized directly onto its final path "
+                   "(open(w/wb)+json/pickle.dump or ZipFile(path,'w')); "
+                   "a crash mid-write destroys the only copy — use the "
+                   "tmp-write-fsync-rename helper "
+                   "(resilience.durable.atomic_write_*)")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        if mod.rel_path.endswith("resilience/durable.py"):
+            return  # the atomic helper itself
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.With):
+                continue
+            for item in node.items:
+                call = item.context_expr
+                if not isinstance(call, ast.Call):
+                    continue
+                name = mod.resolve(call.func)
+                if name == "open":
+                    if _call_mode(call) not in ("w", "wb"):
+                        continue
+                    if _is_tmp_target(mod, call):
+                        continue
+                    handle = item.optional_vars.id \
+                        if isinstance(item.optional_vars, ast.Name) else None
+                    hit = _dump_into(mod, node, handle)
+                    if hit is not None:
+                        yield self.finding(
+                            mod, hit,
+                            "state dumped straight onto its final path: "
+                            "a crash mid-write leaves a torn file where "
+                            "a loadable one was — write tmp-in-same-dir "
+                            "then fsync + os.replace (resilience.durable"
+                            ".atomic_write_json/_bytes)")
+                elif name == "zipfile.ZipFile":
+                    if _call_mode(call) != "w":
+                        continue
+                    if _is_tmp_target(mod, call):
+                        continue
+                    yield self.finding(
+                        mod, call,
+                        "model zip written straight onto its final "
+                        "path: a crash mid-write destroys the previous "
+                        "save — build the archive at a tmp path and "
+                        "os.replace it into place")

@@ -25,7 +25,20 @@ from deeplearning4j_tpu.datasets.dataset import DataSet
 
 class DataSetIterator:
     """Iterator protocol matching the reference's DataSetIterator semantics
-    (reset + iteration)."""
+    (reset + iteration).
+
+    Durable-cursor protocol (optional — resilience/durable.py): iterators
+    that can resume a pass exactly implement
+
+    - ``state() -> {"epoch": int, "pos": int}``: the consumer-visible
+      position — pass index and batches already yielded this pass;
+    - ``restore_state(state)``: the NEXT ``__iter__`` runs pass
+      ``state["epoch"]`` (same shuffle order as an uninterrupted run)
+      skipping the first ``state["pos"]`` batches.
+
+    Checkpoint-based preemption recovery uses it to resume a fit killed
+    mid-epoch bit-identical to a straight run; iterators without it fall
+    back to approximate continuation (the interrupted epoch replays)."""
 
     def reset(self):
         pass
@@ -72,22 +85,54 @@ class ArrayDataSetIterator(DataSetIterator):
         self.shuffle = shuffle
         self._seed = seed
         self._epoch = 0
+        self._pos = 0           # batches yielded in the current pass
+        self._in_pass = False
+        self._resume = None     # (epoch, pos) pending from restore_state
+
+    def state(self):
+        """Durable cursor (see DataSetIterator docstring): deterministic
+        given (seed, epoch), so restoring it replays the exact remaining
+        batches — shuffled passes included. A pending restore IS the
+        cursor until the next pass consumes it."""
+        if self._resume is not None:
+            return {"epoch": self._resume[0], "pos": self._resume[1]}
+        if self._in_pass:
+            return {"epoch": self._epoch - 1, "pos": self._pos}
+        return {"epoch": self._epoch, "pos": 0}
+
+    def restore_state(self, state):
+        self._resume = (int(state.get("epoch", 0)),
+                        int(state.get("pos", 0)))
 
     def __iter__(self):
+        if self._resume is not None:
+            epoch, start = self._resume
+            self._resume = None
+        else:
+            epoch, start = self._epoch, 0
         n = _num_examples(self.features)
         idx = np.arange(n)
         if self.shuffle:
-            rng = np.random.default_rng(self._seed + self._epoch)
+            rng = np.random.default_rng(self._seed + epoch)
             rng.shuffle(idx)
-        self._epoch += 1
-        for s in range(0, n, self.batch_size):
+        self._epoch = epoch + 1
+        self._in_pass = True
+        self._pos = start
+        for bi, s in enumerate(range(0, n, self.batch_size)):
+            if bi < start:
+                continue
             sel = idx[s:s + self.batch_size]
+            # pos advances BEFORE the yield: while the consumer holds
+            # batch bi, the cursor already counts it as handed out — the
+            # dispatch-boundary checkpoint has fully applied its update
+            self._pos = bi + 1
             yield DataSet(
                 _take(self.features, sel),
                 _take(self.labels, sel),
                 _take(self.features_mask, sel),
                 _take(self.labels_mask, sel),
             )
+        self._in_pass = False
 
 
 class ExistingDataSetIterator(DataSetIterator):

@@ -57,12 +57,15 @@ class GraphVectors:
 
     def save(self, path: str) -> None:
         """Text format: vertex index + components per line
-        (ref: GraphVectorSerializer.writeGraphVectors)."""
-        with open(path, "w") as f:
-            f.write(json.dumps({"num_vertices": self.num_vertices,
-                                "vector_size": self.vector_size}) + "\n")
-            for i, row in enumerate(self.vectors):
-                f.write(str(i) + " " + " ".join(f"{x:.8g}" for x in row) + "\n")
+        (ref: GraphVectorSerializer.writeGraphVectors). Written
+        atomically (tmp + fsync + rename) so a crash can't tear the
+        only copy of the embedding."""
+        from deeplearning4j_tpu.resilience.durable import atomic_write_text
+        lines = [json.dumps({"num_vertices": self.num_vertices,
+                             "vector_size": self.vector_size})]
+        for i, row in enumerate(self.vectors):
+            lines.append(str(i) + " " + " ".join(f"{x:.8g}" for x in row))
+        atomic_write_text(path, "\n".join(lines) + "\n")
 
     @classmethod
     def load(cls, path: str) -> "GraphVectors":

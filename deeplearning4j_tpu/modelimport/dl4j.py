@@ -925,7 +925,10 @@ def save_dl4j_format(net, path: str) -> None:
         conf_d = mlc_to_dl4j_json(net.conf)
     flat = params_to_flat_items(items, net.params, net.state)
     conf_d["iterationCount"] = int(net.iteration_count)
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+    # atomic: zip assembled at a tmp path, renamed onto `path` on success
+    from deeplearning4j_tpu.resilience.durable import atomic_replace_path
+    with atomic_replace_path(path) as _tmp, \
+            zipfile.ZipFile(_tmp, "w", zipfile.ZIP_DEFLATED) as zf:
         zf.writestr("configuration.json", json.dumps(conf_d, indent=2))
         zf.writestr("coefficients.bin",
                     write_nd4j_array(flat.astype(np.float32)))

@@ -46,4 +46,10 @@ def ensure_started() -> None:
         from deeplearning4j_tpu.monitoring import runtime
         runtime.install_recompile_watcher()
         declare_default_spans()
+        # checkpoint durability series (resilience/durable.py): declared
+        # up front so a scrape taken before the first save shows the
+        # full schema alongside the span series
+        from deeplearning4j_tpu.resilience.durable import (
+            declare_checkpoint_series)
+        declare_checkpoint_series()
         _started = True

@@ -182,9 +182,12 @@ class PerceptronPosTagger:
     # -- serialization -----------------------------------------------------
 
     def save(self, path: str) -> None:
-        with open(path, "w") as f:
-            json.dump({"weights": self.weights, "classes": self.classes,
-                       "tagdict": self.tagdict}, f)
+        # atomic (tmp + fsync + rename): a crash mid-save must not tear
+        # the only copy of the trained weights
+        from deeplearning4j_tpu.resilience.durable import atomic_write_json
+        atomic_write_json(path, {"weights": self.weights,
+                                 "classes": self.classes,
+                                 "tagdict": self.tagdict})
 
     @classmethod
     def load(cls, path: str) -> "PerceptronPosTagger":

@@ -190,7 +190,11 @@ def write_full_model(sv: SequenceVectors, path: str) -> None:
     for w in words:
         lines.append(encode_b64(w.word) + " "
                      + " ".join(_fmt(v) for v in syn0[w.index]))
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+    # atomic: zip assembled at a tmp path, renamed onto `path` on success
+    # — a crash mid-save can't destroy an existing model archive
+    from deeplearning4j_tpu.resilience.durable import atomic_replace_path
+    with atomic_replace_path(path) as _tmp, \
+            zipfile.ZipFile(_tmp, "w", zipfile.ZIP_DEFLATED) as zf:
         zf.writestr("syn0.txt", "\n".join(lines))
         zf.writestr("syn1.txt",
                     _rows_txt(sv.syn1) if sv.syn1 is not None else "")

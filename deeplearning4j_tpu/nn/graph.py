@@ -36,6 +36,8 @@ from deeplearning4j_tpu.nn.multilayer import _strip_stream_state, _tree_sub
 from deeplearning4j_tpu.optimize.listeners import close_listeners
 from deeplearning4j_tpu.pipeline.padding import (
     group_signature, num_real_examples, pad_batch, with_example_weights)
+from deeplearning4j_tpu.resilience.durable import (
+    capture_cursor_pass, consume_restored_cursor, dispatch_boundary)
 from deeplearning4j_tpu.resilience.sentinel import (
     apply_step, effective_policy, guard_updates, tree_finite)
 
@@ -70,6 +72,13 @@ class ComputationGraph(LazyScore):
         # non-finite sentinel policy override (None = process default;
         # see resilience/sentinel.py)
         self.nonfinite_policy: Optional[str] = None
+        # durable-state plumbing (resilience/durable.py) — see
+        # MultiLayerNetwork.__init__
+        self._dispatched_in_epoch = 0
+        self._canon_in_epoch: Optional[int] = None
+        self._restored_pipeline_state: Optional[Dict[str, Any]] = None
+        self._cursor_pass: Optional[int] = None  # pass index mid-fit
+        self._preemption_guard = None
 
     # ------------------------------------------------------------------
     # bn→act→conv1x1 fusion (execution-plan optimization, see
@@ -836,6 +845,10 @@ class ComputationGraph(LazyScore):
                                       data.features_mask, data.labels_mask)
         else:
             it = data
+        if it is not data:
+            # align the internal iterator's pass counter with the
+            # absolute epoch count — see MultiLayerNetwork.fit
+            it.restore_state({"epoch": self.epoch_count, "pos": 0})
         k = max(1, int(steps_per_dispatch))
         pad = (k > 1) if pad_tail is None else bool(pad_tail)
         if prefetch:
@@ -852,6 +865,9 @@ class ComputationGraph(LazyScore):
         # listener capability scan hoisted out of the per-batch path
         self._stash_features = any(getattr(l, "needs_batch_features", False)
                                    for l in self.listeners)
+        # restored data-pipeline cursor: see MultiLayerNetwork.fit
+        consume_restored_cursor(self, it)
+        capture_cursor_pass(self, it)
         try:
             for _ in range(epochs):
                 for lst in self.listeners:
@@ -860,12 +876,16 @@ class ComputationGraph(LazyScore):
                 # completed-epoch ordering: see multilayer.py fit
                 epoch_idx = self.epoch_count
                 self.epoch_count += 1
+                self._dispatched_in_epoch = 0
+                self._canon_in_epoch = None
+                self._cursor_pass += 1
                 for lst in self.listeners:
                     lst.on_epoch_end(self, epoch_idx)
             # one allowed sync, after the final batch (see multilayer.fit)
             finalize_fit_telemetry(self)
         finally:
             self._stash_features = None
+            self._cursor_pass = None
             close_listeners(self.listeners)
         return self
 
@@ -874,24 +894,33 @@ class ComputationGraph(LazyScore):
         MultiLayerNetwork._fit_epoch: pad ragged batches to the
         canonical row count when `pad` and fuse runs of `k`
         same-signature batches into single scan dispatches; anything
-        unfusable falls back to the per-batch step."""
-        canon = None
+        unfusable falls back to the per-batch step.
+
+        Dispatch boundaries + cursor counters: see
+        MultiLayerNetwork._fit_epoch."""
+        canon = self._canon_in_epoch
         group: List[DataSet] = []
         sig = None
 
         def flush():
             nonlocal sig
+            if not group:
+                sig = None
+                return
             if len(group) == k:
                 self._fit_group(group)
             else:
                 for b in group:
                     self._fit_batch(b)
+            self._dispatched_in_epoch += len(group)
             group.clear()
             sig = None
+            dispatch_boundary(self)
 
         for ds in it:
             if canon is None:
                 canon = ds.num_examples()
+                self._canon_in_epoch = canon
             # feature-masked batches without an explicit labels mask use
             # the PROPAGATED mask in _loss; a synthesized example-weight
             # mask would shadow it, so those stay unpadded
@@ -902,6 +931,8 @@ class ComputationGraph(LazyScore):
                 ds = with_example_weights(ds)
             if k == 1:
                 self._fit_batch(ds)
+                self._dispatched_in_epoch += 1
+                dispatch_boundary(self)
                 continue
             s = group_signature(ds)
             if group and s != sig:

@@ -45,6 +45,8 @@ from deeplearning4j_tpu.monitoring.tracing import phase_detail, span
 from deeplearning4j_tpu.optimize.listeners import close_listeners
 from deeplearning4j_tpu.pipeline.padding import (
     group_signature, num_real_examples, pad_batch, with_example_weights)
+from deeplearning4j_tpu.resilience.durable import (
+    capture_cursor_pass, consume_restored_cursor, dispatch_boundary)
 from deeplearning4j_tpu.resilience.sentinel import (
     apply_step, effective_policy, guard_updates, tree_finite)
 
@@ -90,6 +92,15 @@ class MultiLayerNetwork(LazyScore):
         # non-finite sentinel policy override (None = process default;
         # see resilience/sentinel.py)
         self.nonfinite_policy: Optional[str] = None
+        # durable-state plumbing (resilience/durable.py): the data-
+        # pipeline cursor a checkpoint captures (batches DISPATCHED this
+        # epoch + the canonical pad width), a restored cursor awaiting
+        # application at the next fit, and the armed preemption guard
+        self._dispatched_in_epoch = 0
+        self._canon_in_epoch: Optional[int] = None
+        self._restored_pipeline_state: Optional[Dict[str, Any]] = None
+        self._cursor_pass: Optional[int] = None  # pass index mid-fit
+        self._preemption_guard = None
 
     # ------------------------------------------------------------------
     # init
@@ -514,6 +525,13 @@ class MultiLayerNetwork(LazyScore):
                                       data.features_mask, data.labels_mask)
         else:
             it = data
+        if it is not data:
+            # internally-built iterator: align its pass counter with the
+            # ABSOLUTE epoch count, so shuffle orders are a function of
+            # the global epoch (a fresh per-fit iterator replays the
+            # same stream an uninterrupted single fit would produce —
+            # what makes checkpoint cursors transplant across fits)
+            it.restore_state({"epoch": self.epoch_count, "pos": 0})
         k = max(1, int(steps_per_dispatch))
         pad = (k > 1) if pad_tail is None else bool(pad_tail)
         if prefetch:
@@ -528,6 +546,13 @@ class MultiLayerNetwork(LazyScore):
         # listener capability scan hoisted out of the per-batch path
         self._stash_features = any(getattr(l, "needs_batch_features", False)
                                    for l in self.listeners)
+        # a restored checkpoint's data-pipeline cursor fast-forwards the
+        # iterator so a mid-epoch resume continues at the exact batch an
+        # uninterrupted run would see next (resilience/durable.py);
+        # _cursor_pass pins the iterator's OWN pass index (the shuffle
+        # seed) for the duration of each pass
+        consume_restored_cursor(self, it)
+        capture_cursor_pass(self, it)
         try:
             for epoch in range(epochs):
                 for lst in self.listeners:
@@ -539,6 +564,9 @@ class MultiLayerNetwork(LazyScore):
                 # the pre-increment epoch index.
                 epoch_idx = self.epoch_count
                 self.epoch_count += 1
+                self._dispatched_in_epoch = 0
+                self._canon_in_epoch = None
+                self._cursor_pass += 1
                 for lst in self.listeners:
                     lst.on_epoch_end(self, epoch_idx)
             # the steady-state loop above never blocks on the device; the
@@ -546,6 +574,7 @@ class MultiLayerNetwork(LazyScore):
             finalize_fit_telemetry(self)
         finally:
             self._stash_features = None
+            self._cursor_pass = None
             close_listeners(self.listeners)
         return self
 
@@ -554,28 +583,43 @@ class MultiLayerNetwork(LazyScore):
         canonical (first-batch) row count when `pad`, and fuse runs of
         `k` same-signature batches into single scan dispatches when
         k > 1. Anything unfusable (tbptt sequences, signature changes,
-        the trailing partial group) falls back to the per-batch step."""
-        canon = None
+        the trailing partial group) falls back to the per-batch step.
+
+        After every dispatch fully retires, ``dispatch_boundary`` runs:
+        deferred checkpoint-cadence saves and a pending preemption are
+        honored THERE, where params/counters/RNG/cursor are mutually
+        consistent. ``_dispatched_in_epoch``/``_canon_in_epoch`` feed
+        the checkpoint's data-pipeline cursor (a resumed fit re-enters
+        here with both restored by consume_restored_cursor)."""
+        canon = self._canon_in_epoch
         group: List[DataSet] = []
         sig = None
 
         def flush():
             nonlocal sig
+            if not group:
+                sig = None
+                return
             if len(group) == k:
                 self._fit_group(group)
             else:
                 for b in group:
                     self._fit_batch(b)
+            self._dispatched_in_epoch += len(group)
             group.clear()
             sig = None
+            dispatch_boundary(self)
 
         for ds in it:
             if self.conf.tbptt and ds.features.ndim == 3:
                 flush()
                 self._fit_tbptt(ds)
+                self._dispatched_in_epoch += 1
+                dispatch_boundary(self)
                 continue
             if canon is None:
                 canon = ds.num_examples()
+                self._canon_in_epoch = canon
             if pad and ds.labels is not None:
                 if ds.num_examples() < canon:
                     ds = pad_batch(ds, canon)
@@ -585,6 +629,8 @@ class MultiLayerNetwork(LazyScore):
                 ds = with_example_weights(ds)
             if k == 1:
                 self._fit_batch(ds)
+                self._dispatched_in_epoch += 1
+                dispatch_boundary(self)
                 continue
             s = group_signature(ds)
             if group and s != sig:

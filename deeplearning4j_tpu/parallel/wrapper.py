@@ -44,6 +44,8 @@ from deeplearning4j_tpu.monitoring.listener import (
 from deeplearning4j_tpu.nn.updater import normalize_gradients
 from deeplearning4j_tpu.optimize.listeners import close_listeners
 from deeplearning4j_tpu.parallel.mesh import default_mesh
+from deeplearning4j_tpu.resilience.durable import (
+    capture_cursor_pass, consume_restored_cursor, dispatch_boundary)
 from deeplearning4j_tpu.resilience.sentinel import (
     apply_step, effective_policy, guard_updates, tree_finite)
 
@@ -430,9 +432,18 @@ class ParallelWrapper:
             it = ArrayDataSetIterator(data.features, data.labels, batch_size)
         else:
             it = data
+        if it is not data:
+            # align the internal iterator's pass counter with the
+            # absolute epoch count — see MultiLayerNetwork.fit
+            it.restore_state({"epoch": m.epoch_count, "pos": 0})
         # listener capability scan hoisted out of the per-batch path
         m._stash_features = any(getattr(l, "needs_batch_features", False)
                                 for l in m.listeners)
+        # restored data-pipeline cursor applies to the BASE iterator —
+        # the per-epoch prefetch wrapper below is a fresh 1:1 stage each
+        # pass, so fast-forwarding the base fast-forwards the stream
+        consume_restored_cursor(m, it)
+        capture_cursor_pass(m, it)
         try:
             for _ in range(epochs):
                 # device prefetch serves the allreduce (SPMD) path only:
@@ -468,28 +479,41 @@ class ParallelWrapper:
                         pend.append(ds)
                         if len(pend) == round_size:
                             self._fit_round_averaging(pend)  # times itself
+                            m._dispatched_in_epoch += round_size
+                            dispatch_boundary(m)
                             pend = []
                     elif k > 1:
                         s = group_signature(ds)
                         if group and s != sig:
                             for b in group:  # unfusable run: per-batch
                                 self._fit_batch_allreduce(b)
+                                m._dispatched_in_epoch += 1
+                                dispatch_boundary(m)
                             group = []
                         sig = s
                         group.append(ds)
                         if len(group) == k:
                             self._fit_group_allreduce(group)  # times itself
+                            m._dispatched_in_epoch += k
+                            dispatch_boundary(m)
                             group = []
                     else:
                         self._fit_batch_allreduce(ds)  # times itself
+                        m._dispatched_in_epoch += 1
+                        dispatch_boundary(m)
                 # trailing partial averaging round / scan group:
                 # allreduce per-batch steps
                 for ds in pend + group:
                     self._fit_batch_allreduce(ds)
+                    m._dispatched_in_epoch += 1
+                    dispatch_boundary(m)
                 m.epoch_count += 1
+                m._dispatched_in_epoch = 0
+                m._cursor_pass += 1
             # one allowed sync, after the final batch (see multilayer.fit)
             finalize_fit_telemetry(m)
         finally:
             m._stash_features = None
+            m._cursor_pass = None
             close_listeners(m.listeners)
         return m

@@ -148,6 +148,14 @@ class DevicePrefetchIterator(DataSetIterator):
         # the worker thread appends instead of assigning shared state);
         # consult it when a pass ended early after an abandoned consumer
         self._err_holder: List[BaseException] = []
+        # durable-cursor bookkeeping: CONSUMER-side position (the worker
+        # pulls ahead of the fit loop, so the base iterator's own
+        # counters overstate what training actually consumed)
+        self._pass_index = 0
+        self._consumed = 0
+        self._resume_pos = 0
+        self._resume_armed = False
+        self._in_pass = False
 
     @property
     def last_worker_error(self) -> Optional[BaseException]:
@@ -158,6 +166,43 @@ class DevicePrefetchIterator(DataSetIterator):
 
     def reset(self):
         self.base.reset()
+
+    # -- durable cursor (see datasets.iterators.DataSetIterator) --------
+    def state(self):
+        """Consumer-visible cursor: batches the FIT LOOP pulled, not the
+        (further ahead) batches the worker staged — the difference is
+        exactly the prefetch depth, which must be re-transferred on
+        resume, not skipped."""
+        if self._resume_armed:
+            return {"epoch": self._pass_index, "pos": self._resume_pos}
+        if self._in_pass:
+            return {"epoch": self._pass_index - 1, "pos": self._consumed}
+        # between (or before any) passes: the BASE owns the pass index —
+        # a fresh wrapper's local counter is 0 even when the base was
+        # aligned/advanced to a later epoch, and the next pass seeds its
+        # shuffle from the base's counter (see __iter__)
+        state_fn = getattr(self.base, "state", None)
+        if state_fn is not None:
+            try:
+                return {"epoch": int(state_fn()["epoch"]), "pos": 0}
+            except Exception:  # noqa: BLE001 — cursor read is best-effort
+                pass
+        return {"epoch": self._pass_index, "pos": 0}
+
+    def restore_state(self, state):
+        """Delegates to the base iterator (the stage is a 1:1 per-batch
+        transform, so consumer position == base position); requires the
+        base to support the cursor protocol."""
+        restore = getattr(self.base, "restore_state", None)
+        if restore is None:
+            raise NotImplementedError(
+                f"prefetch base {type(self.base).__name__} has no "
+                f"restore_state(): cannot fast-forward exactly")
+        restore(state)
+        self._pass_index = int(state.get("epoch", 0))
+        self._resume_pos = int(state.get("pos", 0))
+        self._resume_armed = True
+        self._in_pass = False
 
     # ------------------------------------------------------------------
     def _sharding_for(self, arr):
@@ -187,6 +232,26 @@ class DevicePrefetchIterator(DataSetIterator):
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         err: List[BaseException] = []
         self._err_holder = err  # publish THIS pass's error slot
+        # cursor bookkeeping: a restored pass starts mid-stream; an
+        # UNRESTORED pass takes its index from the BASE iterator's own
+        # cursor when it exposes one — the base drives the shuffle seed,
+        # and its passes need not start at 0 (fit aligns internal
+        # iterators to the absolute epoch count)
+        if self._resume_armed:
+            self._resume_armed = False
+            start_pass = self._pass_index
+        else:
+            start_pass = self._pass_index
+            state_fn = getattr(self.base, "state", None)
+            if state_fn is not None:
+                try:
+                    start_pass = int(state_fn()["epoch"])
+                except Exception:  # noqa: BLE001 — labeling is best-effort
+                    pass
+        self._consumed = self._resume_pos
+        self._resume_pos = 0
+        self._pass_index = start_pass + 1
+        self._in_pass = True
         stop = threading.Event()
         r = self._registry or global_registry()
         depth = r.gauge(PREFETCH_DEPTH,
@@ -313,16 +378,20 @@ class DevicePrefetchIterator(DataSetIterator):
                                 break
                             drained.append(tail)
                         for tail in drained:
+                            self._consumed += 1
                             yield tail
                         if err:
                             raise err[0]
+                        self._in_pass = False
                         return  # worker gone, stream fully drained
                     continue
                 depth.set(q.qsize())
                 if item is self._SENTINEL:
                     if err:
                         raise err[0]
+                    self._in_pass = False
                     return
+                self._consumed += 1
                 yield item
         finally:
             # generator closed (break/GC): release the worker thread

@@ -20,13 +20,23 @@ Spark task retry):
   hand-rolled unbounded ones).
 - ``chaos``: deterministic fault injectors over any DataSetIterator for
   proving the above actually recovers (tests/test_resilience.py).
+- ``durable``: crash-consistent state IO — atomic tmp→fsync→rename
+  writes, checksummed checkpoint dirs, the bounded async checkpoint
+  writer, the SIGTERM PreemptionGuard + dispatch-boundary hook, and the
+  multi-process shard/COMMIT protocol (util/checkpoint.py is built on
+  it; tests/test_durable.py is its chaos suite).
 
-See ARCHITECTURE.md "Resilience".
+See ARCHITECTURE.md "Resilience" and "Durable state".
 """
 
+from deeplearning4j_tpu.resilience.durable import (
+    AsyncCheckpointWriter, CheckpointError, CorruptCheckpointError,
+    PreemptionExit, PreemptionGuard)
 from deeplearning4j_tpu.resilience.retry import RetryPolicy, retry_call
 from deeplearning4j_tpu.resilience.sentinel import (
     effective_policy, set_default_nonfinite_policy)
 
-__all__ = ["RetryPolicy", "retry_call", "effective_policy",
+__all__ = ["AsyncCheckpointWriter", "CheckpointError",
+           "CorruptCheckpointError", "PreemptionExit", "PreemptionGuard",
+           "RetryPolicy", "retry_call", "effective_policy",
            "set_default_nonfinite_policy"]

@@ -40,8 +40,8 @@ from deeplearning4j_tpu.datasets.iterators import DataSetIterator
 
 __all__ = ["ChaosIterator", "InjectedFault", "LatencyIterator",
            "NaNPoisonIterator", "PageExhaustionInjector",
-           "PreemptionIterator", "RaiseOnBatch", "SimulatedPreemption",
-           "fire"]
+           "PreemptionIterator", "ProcessKillInjector", "RaiseOnBatch",
+           "SimulatedPreemption", "fire"]
 
 
 def fire(injector, index: int) -> None:
@@ -250,3 +250,38 @@ class PreemptionIterator(RaiseOnBatch):
 
     def __init__(self, base: DataSetIterator, n: int):
         super().__init__(base, n, exc=SimulatedPreemption, once=True)
+
+
+class ProcessKillInjector(ChaosIterator):
+    """HARD kill: send a real signal (default SIGKILL — no handlers, no
+    finally blocks, no atexit) to this process before global batch `n`.
+
+    The adversary of the crash-consistent checkpoint format: run a fit
+    in a SUBPROCESS with this injector in its pipeline, then prove from
+    the parent that every checkpoint committed before the kill is intact
+    and loadable, and that a FaultTolerantTrainer resume completes the
+    run (tests/test_durable.py). Unlike PreemptionIterator this is not
+    catchable, and unlike PreemptionGuard (SIGTERM → drain + emergency
+    save) nothing gets to run — it validates durability of what was
+    ALREADY on disk, not orderly shutdown.
+
+    With ``delay`` the signal is sent that many seconds after batch `n`
+    is reached — landing the kill MID-save when the cadence is arranged
+    so a save is in flight."""
+
+    def __init__(self, base: DataSetIterator, n: int,
+                 sig: int = 9, delay: float = 0.0):
+        super().__init__(base, once=True)
+        self.n = int(n)
+        self.sig = int(sig)
+        self.delay = float(delay)
+
+    def before_batch(self, index: int) -> None:
+        if index >= self.n and self._fire():
+            import os
+            if self.delay:
+                time.sleep(self.delay)
+            os.kill(os.getpid(), self.sig)
+            # SIGKILL never returns; a catchable sig may — give the
+            # handler a beat before the stream continues
+            time.sleep(0.5)

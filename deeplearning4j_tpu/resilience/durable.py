@@ -1,0 +1,873 @@
+"""Durable state: crash-consistent writes, async checkpointing, and a
+distributed commit protocol.
+
+SURVEY's L1 reference surface (ModelSerializer + checkpoint-based
+recovery) assumed a process that dies politely. Production training does
+not: a preemption SIGKILLs mid-save, a disk fills halfway through a
+rename, a worker dies between writing its shard and the job committing
+the step. This module is the format/IO layer the checkpoint stack
+(util/checkpoint.py, util/recovery.py) is built on, with four
+guarantees:
+
+1. **Atomicity** — every file and every checkpoint directory is written
+   tmp → flush → fsync → ``os.replace`` (+ parent-directory fsync), so a
+   kill at ANY byte offset leaves either the old state or the new state,
+   never a torn hybrid. A checkpoint step directory only ever EXISTS
+   committed: its contents are assembled under a tmp name and renamed
+   into place in one atomic step.
+2. **Integrity** — a MANIFEST.json inside each checkpoint dir carries a
+   format version and a per-leaf crc32 checksum (over dtype, shape, and
+   raw bytes), so a reader can prove the bytes it is about to load are
+   the bytes that were written — and fall back to an older intact step
+   instead of crashing on (or silently loading) corruption.
+3. **Asynchrony** — ``AsyncCheckpointWriter`` runs serialize+write on a
+   bounded background thread with backpressure, so the fit loop blocks
+   only for the device→host snapshot. Errors never vanish: they surface
+   on ``health()``, ``last_error``, and the failure counter.
+4. **Distributed commit** — in multi-process training each worker writes
+   its own shard dir; rank 0 publishes an atomic COMMIT marker only
+   after every shard is present and verified. Resume selects the highest
+   *fully committed* step, so a worker dying between shard write and
+   commit can never produce a half-checkpoint that restores.
+
+``PreemptionGuard`` + ``dispatch_boundary`` turn SIGTERM into an orderly
+exit: finish the in-flight dispatch, emergency-save a consistent
+snapshot (params/opt-state/RNG/data-pipeline cursor all aligned at the
+step boundary), and raise ``PreemptionExit``.
+
+Telemetry (global metrics registry):
+
+- ``dl4jtpu_checkpoint_save_seconds`` (histogram, labeled mode=sync|async)
+- ``dl4jtpu_checkpoint_bytes_total`` (counter)
+- ``dl4jtpu_checkpoint_inflight`` (gauge): queued + in-progress async saves
+- ``dl4jtpu_checkpoint_failures_total`` (counter)
+- ``dl4jtpu_checkpoint_corrupt_skipped_total`` (counter): integrity
+  fallbacks taken at restore/rollback time.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import queue
+import shutil
+import signal
+import threading
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.monitoring.metrics import (
+    MetricsRegistry, global_registry)
+
+log = logging.getLogger(__name__)
+
+FORMAT_VERSION = 1
+MANIFEST_NAME = "MANIFEST.json"
+DATA_NAME = "data.npz"
+COMMIT_NAME = "COMMIT.json"
+_TMP_PREFIX = ".tmp-"
+
+CKPT_SAVE_SECONDS = "dl4jtpu_checkpoint_save_seconds"
+CKPT_BYTES = "dl4jtpu_checkpoint_bytes_total"
+CKPT_INFLIGHT = "dl4jtpu_checkpoint_inflight"
+CKPT_FAILURES = "dl4jtpu_checkpoint_failures_total"
+CKPT_CORRUPT_SKIPPED = "dl4jtpu_checkpoint_corrupt_skipped_total"
+
+__all__ = [
+    "AsyncCheckpointWriter", "CKPT_BYTES", "CKPT_CORRUPT_SKIPPED",
+    "CKPT_FAILURES", "CKPT_INFLIGHT", "CKPT_SAVE_SECONDS",
+    "CheckpointError", "CorruptCheckpointError", "FORMAT_VERSION",
+    "PreemptionExit", "PreemptionGuard", "atomic_replace_path",
+    "atomic_write_bytes",
+    "atomic_write_json", "atomic_write_text", "commit_marker_path",
+    "capture_cursor_pass", "consume_restored_cursor",
+    "declare_checkpoint_series",
+    "dispatch_boundary",
+    "latest_committed_step", "list_committed_steps", "publish_commit",
+    "read_commit", "read_state_dir", "shard_dir_name", "verify_state_dir",
+    "write_checkpoint_dir", "write_shard",
+]
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint could not be written (IO failure, timeout on the
+    distributed barrier, ...)."""
+
+
+class CorruptCheckpointError(CheckpointError):
+    """On-disk checkpoint bytes failed integrity verification (missing
+    manifest, version mismatch, checksum mismatch, torn file)."""
+
+
+def declare_checkpoint_series(registry: Optional[MetricsRegistry] = None):
+    """Get-or-create the checkpoint telemetry series so a scrape taken
+    before the first save already shows the schema. Returns
+    (save_seconds, bytes_total, inflight, failures, corrupt_skipped)."""
+    r = registry or global_registry()
+    return (
+        r.histogram(CKPT_SAVE_SECONDS,
+                    "Wall time of one checkpoint serialize+write",
+                    ("mode",)),
+        r.counter(CKPT_BYTES, "Bytes committed to checkpoint storage"),
+        r.gauge(CKPT_INFLIGHT,
+                "Async checkpoint saves queued or in progress"),
+        r.counter(CKPT_FAILURES, "Checkpoint saves that raised"),
+        r.counter(CKPT_CORRUPT_SKIPPED,
+                  "Corrupt/torn checkpoints skipped at restore time"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# crash-injection seam (tests only): called with a label at each durability
+# milestone of a checkpoint-dir write, so the chaos suite can prove that a
+# kill at ANY point leaves the previously-committed state intact.
+# ---------------------------------------------------------------------------
+_crash_hook: Optional[Callable[[str], None]] = None
+
+
+def _maybe_crash(point: str) -> None:
+    if _crash_hook is not None:
+        _crash_hook(point)
+
+
+# ---------------------------------------------------------------------------
+# atomic file primitives
+# ---------------------------------------------------------------------------
+def _fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY so a just-renamed entry survives power loss.
+    Best-effort: not every filesystem supports opening directories."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """tmp-in-same-dir → write → flush → fsync → os.replace → dir fsync.
+    A reader never observes a partial file; a crash leaves either the
+    old content or the new content."""
+    path = os.path.abspath(path)
+    d = os.path.dirname(path)
+    tmp = os.path.join(d, f"{_TMP_PREFIX}{os.path.basename(path)}."
+                          f"{os.getpid()}.{threading.get_ident()}")
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(d)
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    atomic_write_bytes(path, text.encode("utf-8"))
+
+
+def atomic_write_json(path: str, obj: Any) -> None:
+    atomic_write_bytes(path, (json.dumps(obj, sort_keys=True) + "\n")
+                       .encode("utf-8"))
+
+
+@contextlib.contextmanager
+def atomic_replace_path(path: str):
+    """For writers that need a real filesystem path (zipfile, np.save):
+    yields a tmp path in the same directory; on clean exit the tmp file
+    is fsynced and atomically renamed onto ``path`` (+ dir fsync), on
+    error it is removed. Either the old file or the complete new file
+    survives a crash — never a torn hybrid."""
+    path = os.path.abspath(path)
+    d = os.path.dirname(path)
+    tmp = os.path.join(d, f"{_TMP_PREFIX}{os.path.basename(path)}."
+                          f"{os.getpid()}.{threading.get_ident()}")
+    try:
+        yield tmp
+        fd = os.open(tmp, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(d)
+
+
+# ---------------------------------------------------------------------------
+# tree <-> flat arrays (nested-dict state trees; leaves = arrays/scalars)
+# ---------------------------------------------------------------------------
+def _flatten_tree(tree: Any, prefix: str = "") -> Tuple[Any, Dict[str, Any]]:
+    """Returns (skeleton, leaves). The skeleton mirrors the dict nesting
+    with leaf positions replaced by ``{"__leaf__": key}`` (or
+    ``{"__none__": true}`` for None), JSON-serializable; ``leaves`` maps
+    key -> array-like."""
+    if isinstance(tree, dict):
+        skel, leaves = {}, {}
+        for k in sorted(tree):
+            s, l = _flatten_tree(tree[k], f"{prefix}{k}/")
+            skel[k] = s
+            leaves.update(l)
+        return skel, leaves
+    if tree is None:
+        return {"__none__": True}, {}
+    key = prefix.rstrip("/")
+    return {"__leaf__": key}, {key: tree}
+
+
+def _unflatten_tree(skel: Any, leaves: Dict[str, np.ndarray]) -> Any:
+    if isinstance(skel, dict):
+        if skel.get("__none__"):
+            return None
+        if "__leaf__" in skel:
+            return leaves[skel["__leaf__"]]
+        return {k: _unflatten_tree(v, leaves) for k, v in skel.items()}
+    raise CorruptCheckpointError(f"malformed tree skeleton node: {skel!r}")
+
+
+def _leaf_checksum(arr: np.ndarray) -> str:
+    """crc32 over dtype + shape + raw bytes (C-order)."""
+    a = np.ascontiguousarray(arr)
+    h = zlib.crc32(str(a.dtype).encode())
+    h = zlib.crc32(str(a.shape).encode(), h)
+    h = zlib.crc32(a.tobytes(), h)
+    return f"{h:08x}"
+
+
+def snapshot_tree(tree: Any) -> Any:
+    """Materialize a (possibly device-resident) state tree as host numpy
+    arrays — the ONLY part of a save the fit loop must block for."""
+    def conv(x):
+        if isinstance(x, dict):
+            return {k: conv(v) for k, v in x.items()}
+        if x is None:
+            return None
+        return np.asarray(x)
+    return conv(tree)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint directory format
+# ---------------------------------------------------------------------------
+def _npz_key(key: str) -> str:
+    # np.savez forbids "/" only on some paths; keys are restored from the
+    # manifest skeleton anyway, so a reversible escape is all we need
+    return key.replace("/", "|")
+
+
+def write_checkpoint_dir(final_dir: str, tree: Any,
+                         extras: Optional[Dict[str, Any]] = None,
+                         registry: Optional[MetricsRegistry] = None) -> int:
+    """Write one committed checkpoint directory (data.npz +
+    MANIFEST.json with per-leaf checksums) atomically: everything is
+    assembled under a tmp sibling and renamed into place, so
+    ``final_dir`` only ever exists fully written. Returns bytes written.
+
+    If ``final_dir`` already exists (same-step re-save; the step=None
+    "latest" path rewrites one dir every save) it is replaced via
+    aside-rename: the old dir is renamed aside, the new one renamed in,
+    then the aside copy removed. A kill between the two renames leaves
+    BOTH copies on disk — the aside survivor under a
+    ``step_N.replaced.<pid>.<tid>`` name that listings skip but sweep
+    never deletes, recoverable by renaming it back; an in-process
+    failure rolls the aside copy back automatically.
+    """
+    final_dir = os.path.abspath(final_dir)
+    parent = os.path.dirname(final_dir)
+    os.makedirs(parent, exist_ok=True)
+    tmp_dir = os.path.join(parent, f"{_TMP_PREFIX}{os.path.basename(final_dir)}"
+                                   f".{os.getpid()}.{threading.get_ident()}")
+    host = snapshot_tree(tree)
+    skel, leaves = _flatten_tree(host)
+    aside = None
+    try:
+        os.makedirs(tmp_dir)
+        data_path = os.path.join(tmp_dir, DATA_NAME)
+        # savez straight into the file handle: no BytesIO staging, so a
+        # save's peak host memory is the snapshot itself, not 3x it
+        with open(data_path, "wb") as f:
+            np.savez(f, **{_npz_key(k): np.asarray(v)
+                           for k, v in leaves.items()})
+            f.flush()
+            os.fsync(f.fileno())
+        data_bytes = os.path.getsize(data_path)
+        _maybe_crash("data-written")
+        manifest = {
+            "format_version": FORMAT_VERSION,
+            "tree": skel,
+            "leaves": {k: {"checksum": _leaf_checksum(np.asarray(v)),
+                           "dtype": str(np.asarray(v).dtype),
+                           "shape": list(np.asarray(v).shape)}
+                       for k, v in leaves.items()},
+            "extras": extras or {},
+        }
+        mbytes = (json.dumps(manifest, sort_keys=True) + "\n").encode()
+        with open(os.path.join(tmp_dir, MANIFEST_NAME), "wb") as f:
+            f.write(mbytes)
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync_dir(tmp_dir)
+        _maybe_crash("pre-rename")
+        if os.path.exists(final_dir):
+            # replacing an existing step (same-step re-save, the
+            # step=None "latest" path): move the old copy ASIDE first —
+            # a crash between the two renames leaves both copies on
+            # disk (the aside name is deliberately NOT tmp-prefixed so
+            # sweep_tmp_dirs never reclaims it; an operator can rename
+            # it back), instead of the old rmtree-then-rename shape
+            # whose crash window destroyed the only copy
+            aside = os.path.join(parent,
+                                 f"{os.path.basename(final_dir)}.replaced."
+                                 f"{os.getpid()}.{threading.get_ident()}")
+            os.rename(final_dir, aside)
+            _maybe_crash("mid-replace")
+            os.replace(tmp_dir, final_dir)
+            shutil.rmtree(aside, ignore_errors=True)
+            aside = None
+        else:
+            os.replace(tmp_dir, final_dir)
+        _fsync_dir(parent)
+        _maybe_crash("post-rename")
+    except BaseException:
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+        # an in-process failure mid-replace: put the old copy back
+        if aside is not None and os.path.exists(aside) and \
+                not os.path.exists(final_dir):
+            try:
+                os.rename(aside, final_dir)
+            except OSError:
+                pass
+        raise
+    n = data_bytes + len(mbytes)
+    declare_checkpoint_series(registry)[1].inc(n)
+    return n
+
+
+def read_manifest(step_dir: str) -> Dict[str, Any]:
+    mpath = os.path.join(step_dir, MANIFEST_NAME)
+    try:
+        with open(mpath, "r", encoding="utf-8") as f:
+            m = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CorruptCheckpointError(
+            f"unreadable manifest at {mpath}: {e}") from e
+    v = m.get("format_version")
+    if v != FORMAT_VERSION:
+        raise CorruptCheckpointError(
+            f"{mpath}: format version {v!r} != supported {FORMAT_VERSION}")
+    return m
+
+
+def _read_leaves(step_dir: str, manifest: Dict[str, Any],
+                 verify: bool = True) -> Dict[str, np.ndarray]:
+    dpath = os.path.join(step_dir, DATA_NAME)
+    try:
+        with np.load(dpath, allow_pickle=False) as z:
+            raw = {k: z[_npz_key(k)] for k in manifest["leaves"]}
+    except Exception as e:  # noqa: BLE001 — torn bytes raise anything
+        # (BadZipFile, EOFError, zlib.error, KeyError, ...): ANY failure
+        # to produce the manifest's leaves is corruption by definition
+        raise CorruptCheckpointError(f"torn/unreadable {dpath}: {e}") from e
+    if verify:
+        for k, meta in manifest["leaves"].items():
+            got = _leaf_checksum(raw[k])
+            if got != meta["checksum"]:
+                raise CorruptCheckpointError(
+                    f"{dpath}: checksum mismatch on leaf {k!r} "
+                    f"({got} != recorded {meta['checksum']})")
+    return raw
+
+
+def read_state_dir(step_dir: str, verify: bool = True
+                   ) -> Tuple[Any, Dict[str, Any]]:
+    """Load (tree, manifest) from a committed checkpoint dir, verifying
+    every leaf checksum by default. Raises CorruptCheckpointError on any
+    integrity failure — callers decide whether to fall back."""
+    manifest = read_manifest(step_dir)
+    leaves = _read_leaves(step_dir, manifest, verify=verify)
+    return _unflatten_tree(manifest["tree"], leaves), manifest
+
+
+def verify_state_dir(step_dir: str) -> bool:
+    """True iff the dir is a committed checkpoint whose bytes all pass
+    their checksums."""
+    try:
+        manifest = read_manifest(step_dir)
+        _read_leaves(step_dir, manifest, verify=True)
+        return True
+    except CorruptCheckpointError:
+        return False
+
+
+def sweep_tmp_dirs(path: str) -> int:
+    """Remove leftover tmp artifacts from crashed writers under a
+    checkpoint root (safe anytime: committed state never lives under a
+    tmp name). Returns the number removed."""
+    if not os.path.isdir(path):
+        return 0
+    n = 0
+    for name in os.listdir(path):
+        if name.startswith(_TMP_PREFIX):
+            full = os.path.join(path, name)
+            if os.path.isdir(full):
+                shutil.rmtree(full, ignore_errors=True)
+            else:
+                try:
+                    os.unlink(full)
+                except OSError:
+                    continue
+            n += 1
+    return n
+
+
+# ---------------------------------------------------------------------------
+# async writer
+# ---------------------------------------------------------------------------
+class AsyncCheckpointWriter:
+    """Bounded background writer: the fit loop hands over an
+    already-snapshotted (host-resident) state and returns immediately;
+    serialize + write + rename + prune run here, strictly in submission
+    order (single worker). ``submit`` BLOCKS when ``max_pending`` jobs
+    are already queued — backpressure, so a slow disk throttles saving
+    instead of accumulating unbounded host snapshots.
+
+    Failures do not kill training: the job's exception lands on
+    ``last_error``, increments ``dl4jtpu_checkpoint_failures_total``,
+    flips ``health()["healthy"]`` until a later save succeeds, and — by
+    construction (write-to-tmp) — leaves every previously committed
+    checkpoint untouched.
+    """
+
+    def __init__(self, max_pending: int = 2,
+                 registry: Optional[MetricsRegistry] = None):
+        if max_pending < 1:
+            raise ValueError(f"max_pending must be >= 1, got {max_pending}")
+        self._registry = registry
+        self._q: "queue.Queue" = queue.Queue(maxsize=max_pending)
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._outstanding = 0  # submitted, not yet finished (under _lock)
+        self._idle = threading.Event()
+        self._idle.set()
+        self.last_error: Optional[BaseException] = None
+        self.failures = 0
+        self.completed = 0
+        (self._save_hist, _, self._inflight, self._fail_counter, _
+         ) = declare_checkpoint_series(registry)
+
+    # -- worker ----------------------------------------------------------
+    def _ensure_thread(self) -> None:
+        with self._lock:
+            t = self._thread
+            if t is None or not t.is_alive():
+                t = threading.Thread(target=self._run, daemon=True,
+                                     name="checkpoint-writer")
+                self._thread = t
+                t.start()
+
+    def _run(self) -> None:
+        while True:
+            fn, label, is_save = self._q.get()
+            t0 = time.perf_counter()
+            try:
+                fn()
+                with self._lock:
+                    self.completed += 1
+                    if is_save:
+                        # a clean SAVE clears the unhealthy latch; a
+                        # successful housekeeping job (prune) says
+                        # nothing about whether saves are landing
+                        self.last_error = None
+                if is_save:
+                    self._save_hist.observe(time.perf_counter() - t0,
+                                            mode="async")
+            except BaseException as e:  # noqa: BLE001 — surfaced, never lost
+                with self._lock:
+                    self.failures += 1
+                    self.last_error = e
+                self._fail_counter.inc()
+                log.warning("async checkpoint save %s failed: %r", label, e)
+            finally:
+                self._inflight.dec()
+                with self._lock:
+                    self._outstanding -= 1
+                    if self._outstanding == 0:
+                        self._idle.set()
+
+    # -- public ----------------------------------------------------------
+    def submit(self, fn: Callable[[], None], label: str = "save",
+               is_save: bool = True) -> None:
+        """Queue a write job (runs in submission order). Blocks when the
+        queue is full — the sanctioned backpressure point. Housekeeping
+        jobs (``is_save=False``: pruning) neither clear the unhealthy
+        latch nor count toward save telemetry."""
+        self._ensure_thread()
+        with self._lock:
+            self._outstanding += 1
+            self._idle.clear()
+        self._inflight.inc()
+        try:
+            self._q.put((fn, label, is_save))
+        except BaseException:
+            self._inflight.dec()
+            with self._lock:
+                self._outstanding -= 1
+                if self._outstanding == 0:
+                    self._idle.set()
+            raise
+
+    def flush(self, timeout: Optional[float] = None) -> bool:
+        """Wait until every submitted job has finished. Returns False on
+        timeout."""
+        t = self._thread
+        if t is None or not t.is_alive():
+            return True
+        return self._idle.wait(timeout)
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Drain pending jobs. The worker THREAD is deliberately left
+        parked on its queue: it is a daemon (dies with the process,
+        costs nothing idle), the writer stays usable for the next fit
+        (close runs from listener close(), which fires at the end of
+        EVERY fit), and stopping a possibly-wedged worker to start a
+        fresh one later would put two workers on one queue — breaking
+        the FIFO save→prune ordering CheckpointListener's
+        never-evict-the-predecessor guarantee rests on."""
+        self.flush(timeout)
+
+    def health(self) -> Dict[str, Any]:
+        with self._lock:
+            pending = self._q.qsize()
+            return {
+                "healthy": self.last_error is None,
+                "pending": pending,
+                "completed": self.completed,
+                "failures": self.failures,
+                "last_error": None if self.last_error is None
+                else repr(self.last_error),
+            }
+
+
+# ---------------------------------------------------------------------------
+# preemption guard + the fit-loop dispatch boundary
+# ---------------------------------------------------------------------------
+class PreemptionExit(SystemExit):
+    """Raised at the first dispatch boundary after a preemption signal,
+    AFTER the emergency checkpoint is durable. SystemExit subclass: the
+    fit loops' finally blocks run (listeners closed), and an unhandled
+    propagation exits the process with ``code``."""
+
+    def __init__(self, step: int, checkpoint_dir: str, code: int = 0):
+        super().__init__(code)
+        self.step = step
+        self.checkpoint_dir = checkpoint_dir
+
+
+class PreemptionGuard:
+    """SIGTERM → finish the in-flight dispatch → emergency-save → exit.
+
+    The signal handler only sets a flag; the fit loops poll it at every
+    dispatch boundary (``dispatch_boundary``), where params/opt-state/
+    RNG/iterator cursor are mutually consistent, and perform a
+    synchronous save there — so the emergency checkpoint resumes
+    bit-identical to an uninterrupted run.
+
+        guard = PreemptionGuard(net, ckpt_dir)        # installs SIGTERM
+        try:
+            net.fit(it, epochs=10)
+        except PreemptionExit:
+            ...                                        # saved; exit soon
+
+    ``trigger()`` arms the guard programmatically (tests / external
+    preemption notices). ``writer`` (an AsyncCheckpointWriter) is
+    flushed before the emergency save so in-flight periodic saves land
+    first.
+    """
+
+    def __init__(self, net, checkpoint_dir: str,
+                 signals: Tuple[int, ...] = (signal.SIGTERM,),
+                 writer: Optional[AsyncCheckpointWriter] = None,
+                 exit_code: int = 0, install: bool = True):
+        self.net = net
+        self.checkpoint_dir = checkpoint_dir
+        self.signals = tuple(signals)
+        self.writer = writer
+        self.exit_code = exit_code
+        self.triggered = False
+        self.saved_step: Optional[int] = None
+        self._prev: Dict[int, Any] = {}
+        self._installed = False
+        net._preemption_guard = self
+        if install:
+            self.install()
+
+    # -- signal plumbing -------------------------------------------------
+    def _handler(self, signum, frame):  # noqa: ARG002 — signal signature
+        self.triggered = True
+
+    def install(self) -> "PreemptionGuard":
+        try:
+            for s in self.signals:
+                self._prev[s] = signal.signal(s, self._handler)
+            self._installed = True
+        except ValueError:
+            # not the main thread: signals can't be installed here —
+            # trigger() remains the arming path
+            log.warning("PreemptionGuard: not on main thread, signal "
+                        "handler not installed (use trigger())")
+        return self
+
+    def uninstall(self) -> None:
+        if self._installed:
+            for s, prev in self._prev.items():
+                try:
+                    signal.signal(s, prev)
+                except (ValueError, OSError):
+                    pass
+            self._installed = False
+        if getattr(self.net, "_preemption_guard", None) is self:
+            self.net._preemption_guard = None
+
+    def __enter__(self) -> "PreemptionGuard":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    def trigger(self) -> None:
+        """Arm the guard as if the signal had arrived."""
+        self.triggered = True
+
+    # -- the boundary action ---------------------------------------------
+    def handle(self, net) -> None:
+        """Called at a dispatch boundary. No-op unless triggered; else
+        emergency-save (sync, durable before return) and raise
+        PreemptionExit."""
+        if not self.triggered:
+            return
+        if self.writer is not None:
+            self.writer.flush()
+        from deeplearning4j_tpu.util.checkpoint import (
+            save_checkpoint, verify_checkpoint)
+        # also drain any listener writers on this net: their cadence
+        # save for THIS boundary may still be queued
+        for lst in getattr(net, "listeners", ()):
+            w = getattr(lst, "writer", None)
+            if isinstance(w, AsyncCheckpointWriter):
+                w.flush()
+        step = int(net.iteration_count)
+        if not verify_checkpoint(self.checkpoint_dir, step):
+            # skip when a cadence save at this very boundary already
+            # committed the step: re-saving an EXISTING step routes
+            # through write_checkpoint_dir's delete-then-rename
+            # replacement window — the one place a follow-up SIGKILL
+            # could destroy a just-committed checkpoint
+            save_checkpoint(net, self.checkpoint_dir, step=step)
+        self.saved_step = step
+        log.warning("preemption: emergency checkpoint at step %d (%s); "
+                    "exiting", step, self.checkpoint_dir)
+        raise PreemptionExit(step, self.checkpoint_dir, self.exit_code)
+
+
+def dispatch_boundary(net) -> None:
+    """The fit loops' per-dispatch consistency point: called after a
+    train dispatch fully retired (params advanced, iteration_count
+    incremented, listeners fired). Two jobs:
+
+    1. run deferred cadence saves — listeners exposing
+       ``on_dispatch_boundary`` (CheckpointListener) save HERE, where
+       params, counters, RNG stream, and the data-pipeline cursor are
+       mutually consistent (on the fused-scan path, iteration_done
+       fires mid-group when params already hold the post-group state —
+       saving there would stitch a torn logical snapshot);
+    2. honor a pending preemption (PreemptionGuard.handle).
+    """
+    for lst in getattr(net, "listeners", ()):
+        hook = getattr(lst, "on_dispatch_boundary", None)
+        if hook is not None:
+            hook(net)
+    guard = getattr(net, "_preemption_guard", None)
+    if guard is not None:
+        guard.handle(net)
+
+
+def consume_restored_cursor(net, it) -> int:
+    """Apply a restored checkpoint's data-pipeline cursor to the fit
+    iterator (called once, at fit setup). Fast-forwards ``it`` to the
+    batch AFTER the last dispatched one — pass index restored too, so
+    shuffle orders line up with an uninterrupted run — and re-arms the
+    net's dispatch counters. Returns the restored mid-epoch position
+    (0 = epoch-boundary resume).
+
+    Iterators without the ``state()/restore_state()`` protocol degrade
+    to the classic approximate continuation (the interrupted epoch's
+    consumed batches are replayed); a warning says so."""
+    cur = getattr(net, "_restored_pipeline_state", None)
+    net._restored_pipeline_state = None
+    net._canon_in_epoch = None
+    net._dispatched_in_epoch = 0
+    if not cur:
+        return 0
+    pos = int(cur.get("pos", 0) or 0)
+    epoch = int(cur.get("epoch", 0) or 0)
+    restore = getattr(it, "restore_state", None)
+    if restore is None:
+        if pos:
+            log.warning(
+                "restored checkpoint carries a mid-epoch data cursor "
+                "(epoch %d, batch %d) but %s has no restore_state(): "
+                "resuming with the interrupted epoch replayed "
+                "(approximate continuation, not bit-exact)",
+                epoch, pos, type(it).__name__)
+        return 0
+    try:
+        restore({"epoch": epoch, "pos": pos})
+    except NotImplementedError as e:
+        if pos:
+            log.warning("data-pipeline cursor restore unsupported (%s); "
+                        "approximate continuation", e)
+        return 0
+    net._dispatched_in_epoch = pos
+    canon = cur.get("canon")
+    net._canon_in_epoch = None if canon is None else int(canon)
+    return pos
+
+
+def capture_cursor_pass(net, it) -> None:
+    """Pin the pass index the upcoming epoch will run (fit-loop setup /
+    epoch rollover). Read from the iterator's own cursor when it has one
+    — its counter drives the shuffle seed — and held fixed on the net
+    for the whole pass, so a save at ANY dispatch boundary (including
+    the trailing-group flush, which fires after the generator already
+    rolled the iterator's cursor to the next pass) stamps a pass index
+    consistent with ``_dispatched_in_epoch``."""
+    pass_idx = net.epoch_count
+    state_fn = getattr(it, "state", None)
+    if state_fn is not None:
+        try:
+            pass_idx = int(state_fn()["epoch"])
+        except Exception:  # noqa: BLE001 — cursor capture is best-effort
+            pass
+    net._cursor_pass = int(pass_idx)
+
+
+# ---------------------------------------------------------------------------
+# distributed commit protocol
+# ---------------------------------------------------------------------------
+def shard_dir_name(rank: int) -> str:
+    return f"shard_{int(rank)}"
+
+
+def commit_marker_path(step_dir: str) -> str:
+    return os.path.join(step_dir, COMMIT_NAME)
+
+
+def write_shard(step_dir: str, rank: int, tree: Any,
+                extras: Optional[Dict[str, Any]] = None) -> str:
+    """Write this worker's shard of a distributed checkpoint (atomic,
+    checksummed). The shard dir's existence doubles as the worker's
+    arrival marker for the commit barrier."""
+    sdir = os.path.join(os.path.abspath(step_dir), shard_dir_name(rank))
+    write_checkpoint_dir(sdir, tree, extras=extras)
+    return sdir
+
+
+def publish_commit(step_dir: str, step: int, world: int,
+                   timeout: float = 60.0, poll: float = 0.05) -> None:
+    """Rank 0's half of the barrier: wait for every shard to be present
+    AND intact, then atomically publish the COMMIT marker. A worker that
+    died between shard write and barrier → timeout → CheckpointError,
+    and the step stays uncommitted (resume ignores it)."""
+    step_dir = os.path.abspath(step_dir)
+    deadline = time.monotonic() + timeout
+    missing = list(range(world))
+    while missing:
+        missing = [r for r in missing
+                   if not os.path.exists(os.path.join(
+                       step_dir, shard_dir_name(r), MANIFEST_NAME))]
+        if not missing:
+            break
+        if time.monotonic() > deadline:
+            raise CheckpointError(
+                f"distributed checkpoint step {step}: shards {missing} "
+                f"never arrived within {timeout}s — step NOT committed")
+        time.sleep(poll)
+    bad = [r for r in range(world)
+           if not verify_state_dir(os.path.join(step_dir,
+                                                shard_dir_name(r)))]
+    if bad:
+        raise CheckpointError(
+            f"distributed checkpoint step {step}: shards {bad} failed "
+            f"integrity verification — step NOT committed")
+    atomic_write_json(commit_marker_path(step_dir), {
+        "format_version": FORMAT_VERSION, "step": int(step),
+        "world": int(world), "shards": [shard_dir_name(r)
+                                        for r in range(world)],
+    })
+
+
+def wait_commit(step_dir: str, timeout: float = 60.0,
+                poll: float = 0.05) -> Dict[str, Any]:
+    """Non-zero ranks' half of the barrier: block until rank 0 published
+    the COMMIT marker (or raise on timeout)."""
+    deadline = time.monotonic() + timeout
+    while True:
+        c = read_commit(step_dir)
+        if c is not None:
+            return c
+        if time.monotonic() > deadline:
+            raise CheckpointError(
+                f"no COMMIT marker appeared under {step_dir} within "
+                f"{timeout}s")
+        time.sleep(poll)
+
+
+def read_commit(step_dir: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(commit_marker_path(step_dir), "r", encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def list_committed_steps(path: str) -> List[int]:
+    """Steps under a distributed checkpoint root whose COMMIT marker is
+    present and readable, ascending. Uncommitted step dirs (a worker
+    died pre-commit) are invisible here by construction."""
+    if not os.path.isdir(path):
+        return []
+    steps = []
+    for name in os.listdir(path):
+        if not name.startswith("step_"):
+            continue
+        try:
+            s = int(name.split("_", 1)[1])
+        except ValueError:
+            continue
+        if read_commit(os.path.join(path, name)) is not None:
+            steps.append(s)
+    return sorted(steps)
+
+
+def latest_committed_step(path: str) -> Optional[int]:
+    steps = list_committed_steps(path)
+    return steps[-1] if steps else None

@@ -78,6 +78,21 @@ class DivergenceWatchdog(TrainingListener):
         self._scores.clear()
         self._ticks = 0
 
+    # -- durable state (checkpointed via util/checkpoint extras) --------
+    def durable_state(self) -> dict:
+        """The trailing score window + cadence phase, so a
+        preemption-exact resume re-arms the blowup check with the SAME
+        history an uninterrupted run would hold (an empty window after
+        resume would silently disable the check for min_history
+        cadences)."""
+        return {"scores": [float(s) for s in self._scores],
+                "ticks": int(self._ticks)}
+
+    def restore_durable_state(self, state: dict) -> None:
+        self._scores = deque((float(s) for s in state.get("scores", ())),
+                             maxlen=self._scores.maxlen)
+        self._ticks = int(state.get("ticks", 0))
+
     def iteration_done(self, model, iteration: int, score) -> None:
         self._ticks += 1
         if self._ticks % self.check_every:

@@ -83,13 +83,17 @@ def write_model(model, path: str, save_updater: bool = True) -> None:
         "epoch_count": model.epoch_count,
         "framework": "deeplearning4j_tpu",
     }
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-        zf.writestr(CONFIG_JSON, model.conf.to_json())
-        zf.writestr("meta.json", json.dumps(meta))
-        _write_tree(zf, "params", model.params)
-        _write_tree(zf, "state", model.state)
-        if save_updater:
-            _write_tree(zf, "updater", model.updater_state)
+    # atomic: the zip is assembled at a tmp path and renamed into place,
+    # so a crash mid-save can't destroy an existing model file
+    from deeplearning4j_tpu.resilience.durable import atomic_replace_path
+    with atomic_replace_path(path) as tmp:
+        with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as zf:
+            zf.writestr(CONFIG_JSON, model.conf.to_json())
+            zf.writestr("meta.json", json.dumps(meta))
+            _write_tree(zf, "params", model.params)
+            _write_tree(zf, "state", model.state)
+            if save_updater:
+                _write_tree(zf, "updater", model.updater_state)
 
 
 NORMALIZER_JSON = "normalizer.json"

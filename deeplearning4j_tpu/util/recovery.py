@@ -34,13 +34,19 @@ down" divergence recovery — and clears the net's jit cache so the new
 LR actually traces (the updater bakes its float into the compiled
 step).
 
-The exact resume==straight-run invariant holds for EPOCH-BOUNDARY
-checkpoints (save_every_epoch=True, the default — the state tree incl.
-the RNG stream restores exactly; tests/test_recovery.py). Iteration-based
-checkpoints (save_every_n_iterations without epoch saves) give
-approximate continuation: the interrupted epoch's already-consumed
-batches are replayed on resume — standard practice, but not bit-equal to
-an uninterrupted run; fit() logs a warning in that configuration.
+The exact resume==straight-run invariant (tests/test_recovery.py,
+tests/test_durable.py) holds for epoch-boundary checkpoints always, and
+for mid-epoch (iteration-cadence or preemption-emergency) checkpoints
+whenever the data iterator supports the durable-cursor protocol
+(state()/restore_state() — ArrayDataSetIterator and
+DevicePrefetchIterator do): the checkpoint captures the RNG stream and
+the dispatched-batch cursor, and resume fast-forwards the stream to the
+exact next batch. Iterators without the protocol degrade to the classic
+approximate continuation (the interrupted epoch's consumed batches
+replay); a warning says so at restore time. Every recovery decision
+re-VERIFIES checkpoint checksums first (resilience/durable.py format)
+and skips torn/corrupt candidates with a warning + counter instead of
+restoring garbage or raising mid-recovery.
 """
 
 from __future__ import annotations
@@ -49,6 +55,7 @@ import logging
 from typing import Optional, Tuple, Type
 
 from deeplearning4j_tpu.monitoring.metrics import global_registry
+from deeplearning4j_tpu.resilience.durable import declare_checkpoint_series
 from deeplearning4j_tpu.resilience.watchdog import (
     DivergenceError, DivergenceWatchdog)
 from deeplearning4j_tpu.util.checkpoint import (
@@ -69,7 +76,8 @@ class FaultTolerantTrainer:
                  retry_on: Tuple[Type[BaseException], ...] = (RuntimeError,),
                  watch_divergence: bool = False,
                  watchdog: Optional[DivergenceWatchdog] = None,
-                 lr_backoff: Optional[float] = None):
+                 lr_backoff: Optional[float] = None,
+                 async_save: bool = False):
         if lr_backoff is not None and not 0.0 < lr_backoff < 1.0:
             raise ValueError(f"lr_backoff must be in (0, 1), "
                              f"got {lr_backoff}")
@@ -82,59 +90,108 @@ class FaultTolerantTrainer:
             DivergenceWatchdog() if watch_divergence else None)
         self._listener = CheckpointListener(
             checkpoint_dir, save_every_n_iterations=save_every_n_iterations,
-            save_every_epoch=save_every_epoch, keep_last=keep_last)
+            save_every_epoch=save_every_epoch, keep_last=keep_last,
+            async_save=async_save)
         if not save_every_epoch:
             log.warning(
-                "iteration-only checkpoints: resume replays the "
-                "interrupted epoch's consumed batches (approximate "
-                "continuation, not bit-exact — see module docstring)")
+                "iteration-only checkpoints: exact mid-epoch resume "
+                "needs an iterator with the state()/restore_state() "
+                "cursor protocol; others replay the interrupted epoch's "
+                "consumed batches (approximate continuation)")
+
+    def flush(self, timeout: Optional[float] = None) -> bool:
+        """Wait for pending async checkpoint writes to be durable —
+        every recovery decision (resume, rollback, prune) flushes first
+        so it reasons about on-disk state, not an in-flight save."""
+        return self._listener.flush(timeout)
+
+    def health(self) -> dict:
+        """Ops surface: checkpoint-writer health (async failures land
+        here instead of killing training) + restart posture."""
+        return {"checkpoint_writer": self._listener.health(),
+                "checkpoint_dir": self.dir,
+                "max_restarts": self.max_restarts}
 
     # -- recovery ---------------------------------------------------------
+    def _try_restore(self, step: int) -> bool:
+        """Restore one candidate, treating corruption as a skip (warning
+        + counter), never a raise mid-recovery. Tags are written BEFORE
+        any later corruption can be known and disk bytes rot
+        independently of them, so every restore checksum-verifies the
+        bytes it loads — inside the one read, not as a separate
+        full-file pre-verification pass."""
+        from deeplearning4j_tpu.resilience.durable import \
+            CorruptCheckpointError
+        try:
+            restore_checkpoint(self.net, self.dir, step=step)
+            return True
+        except CorruptCheckpointError as e:
+            log.warning("checkpoint step %d failed integrity "
+                        "verification (%s); skipping it for recovery",
+                        step, e)
+            declare_checkpoint_series()[4].inc()
+            return False
+
     def resume_if_possible(self, only_good: bool = False) -> Optional[int]:
-        """Restore the newest checkpoint (with ``only_good``, the newest
-        one the sentinel tagged GOOD); returns the restored step or None
-        (fresh start)."""
+        """Restore the newest INTACT checkpoint (with ``only_good``, the
+        newest one the sentinel tagged GOOD — verified, since a tag
+        predates whatever corrupted the bytes); returns the restored
+        step or None (fresh start). Verification happens inside the
+        single restore read, so each candidate's bytes are read once,
+        not twice."""
+        self.flush()
         steps = (list_good_checkpoints(self.dir) if only_good
                  else list_checkpoints(self.dir))
-        if not steps:
-            return None
-        step = steps[-1]
-        restore_checkpoint(self.net, self.dir, step=step)
-        log.info("resumed from checkpoint step %d (epoch %d)%s", step,
-                 self.net.epoch_count,
-                 " [last good]" if only_good else "")
-        return step
+        for step in reversed(steps):
+            if not self._try_restore(step):
+                continue
+            log.info("resumed from checkpoint step %d (epoch %d)%s", step,
+                     self.net.epoch_count,
+                     " [last good]" if only_good else "")
+            return step
+        return None
 
-    def _pick_rollback_step(self, cause: BaseException) -> Optional[int]:
-        """Newest checkpoint that predates the divergence: GOOD-tagged
-        (no live bad-step run), and — for a FINITE loss blowup, where
-        every tag says good — with a recorded score still under the
-        watchdog limit that fired. Falls back to the newest checkpoint
-        of any tag (a finite on-disk state beats the diverged in-memory
-        tree) when nothing qualifies."""
+    def _rollback_candidates(self, cause: BaseException) -> list:
+        """Rollback priority order, newest-first within each tier:
+        GOOD-tagged saves with a recorded score under the watchdog limit
+        that fired (a FINITE blowup poisons saves every tag calls good),
+        then any GOOD-tagged save, then any save at all (a finite
+        on-disk state beats the diverged in-memory tree). Chosen from
+        tags/scores alone — integrity is verified lazily by the restore
+        attempt itself, so rollback reads each candidate at most once
+        instead of pre-checksumming every checkpoint on disk."""
         good = list_good_checkpoints(self.dir)
         limit = getattr(cause, "limit", None)
+        ordered: list = []
         if limit is not None:
             def saved_score(s):
                 v = checkpoint_status(self.dir, s).get("score")
                 # explicit None check: 0.0 is a real (and fine) score
                 return -float("inf") if v is None else v
 
-            pre = [s for s in good if saved_score(s) <= limit]
-            if pre:
-                return pre[-1]
-        if good:
-            return good[-1]
-        steps = list_checkpoints(self.dir)
-        return steps[-1] if steps else None
+            ordered += [s for s in reversed(good) if saved_score(s) <= limit]
+        ordered += [s for s in reversed(good) if s not in ordered]
+        ordered += [s for s in reversed(list_checkpoints(self.dir))
+                    if s not in ordered]
+        return ordered
+
+    def _pick_rollback_step(self, cause: BaseException) -> Optional[int]:
+        """The tag/score policy's first choice (no integrity read — the
+        restore attempt in _rollback verifies lazily)."""
+        cands = self._rollback_candidates(cause)
+        return cands[0] if cands else None
 
     def _rollback(self, cause: BaseException) -> Optional[int]:
-        """Divergence recovery: restore the last pre-divergence state,
-        cool the LR, reset the watchdog/sentinel windows so stale
+        """Divergence recovery: restore the best intact pre-divergence
+        state, cool the LR, reset the watchdog/sentinel windows so stale
         history can't immediately re-trigger."""
-        step = self._pick_rollback_step(cause)
+        self.flush()
+        step = None
+        for cand in self._rollback_candidates(cause):
+            if self._try_restore(cand):
+                step = cand
+                break
         if step is not None:
-            restore_checkpoint(self.net, self.dir, step=step)
             log.info("rolled back to checkpoint step %d (epoch %d)",
                      step, self.net.epoch_count)
             # drop the mid-divergence saves BEYOND the rewind point:
@@ -194,10 +251,15 @@ class FaultTolerantTrainer:
                              batch_size=batch_size)
                 # terminal checkpoint so a later run resumes cleanly
                 # (skip when the epoch-end listener just wrote this step)
+                self.flush()
                 steps = list_checkpoints(self.dir)
                 if not steps or steps[-1] != self.net.iteration_count:
                     self._listener._save(self.net,
                                          self.net.iteration_count)
+                    # the terminal save must be DURABLE before fit
+                    # returns: an async submit alone rides a daemon
+                    # thread that dies with the process
+                    self.flush()
                 return self.net
             except catch as e:
                 attempts += 1

@@ -40,6 +40,13 @@ python -m pytest tests/test_input_pipeline.py -q -p no:cacheprovider
 # The unhappy paths must stay green before the full suite runs.
 python -m pytest tests/test_resilience.py -q -p no:cacheprovider
 
+# tier-1 durability lane: crash-consistent checkpointing (resilience/
+# durable.py + util/checkpoint.py) — torn-write/kill-during-save
+# fallbacks, async-writer failure surfacing, pruning/tag lifecycle, and
+# the preemption-exact resume pins (bit-identical params/score
+# trajectory on per-batch, fused-scan, and ParallelWrapper fits)
+python -m pytest tests/test_durable.py -q -m 'not slow' -p no:cacheprovider
+
 # tier-1 serving lane: the continuous-batching engine (serving/) — the
 # engine-vs-one-shot bit-exactness contract, slot lifecycle, admission
 # control/deadlines, chaos isolation, and the zero-retraces-after-warmup

@@ -1,0 +1,239 @@
+"""Worker process for durable-training chaos tests (tests/test_durable.py).
+
+Three modes, all spawned as REAL OS processes so the kill semantics are
+genuine (no in-process simulation):
+
+- ``sigterm <ckpt_dir> <out_json>``: trains with a PreemptionGuard
+  installed and sends itself a real SIGTERM mid-epoch (from a listener,
+  so the timing is deterministic). The guard finishes the in-flight
+  dispatch, emergency-saves, and raises PreemptionExit → the worker
+  records the saved step and exits with code 17. The parent then
+  resumes from the emergency checkpoint and proves the continuation is
+  bit-identical to an uninterrupted run.
+
+- ``kill9 <ckpt_dir> <kill_at>``: trains with a periodic
+  CheckpointListener and a ProcessKillInjector that SIGKILLs the
+  process before global batch ``kill_at`` — nothing gets to run, not
+  even atexit. The parent proves every checkpoint committed before the
+  kill is intact (checksum-verified) and that a FaultTolerantTrainer
+  resume completes the run.
+
+- ``dist <coord> <nproc> <pid> <local_dev> <ckpt_dir>``: the
+  two-process gloo harness (same bring-up as distributed_worker.py)
+  exercising the distributed commit protocol: both ranks train the same
+  SPMD program, commit step 1 together, then rank 1 DIES between
+  writing its step-2 shard and the barrier. Rank 0's commit times out
+  and publishes NO marker — the parent proves resume selects step 1,
+  the highest fully committed step.
+
+The net/data builders live here and are imported by the parent test, so
+worker and parent train the SAME deterministic run by construction.
+"""
+
+import json
+import os
+import signal
+import sys
+
+
+def configure_jax(device_count: int = 8):
+    """Match tests/conftest.py — cross-process bit-identity requires
+    identical platform/x64/device-count configuration. The dist mode
+    passes 4 local devices per process (the proven gloo-harness shape
+    from tests/distributed_worker.py: 2 procs x 4 = one 8-device mesh)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count="
+                    f"{device_count}").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+
+def build_net(seed: int = 3):
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updater import Adam
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(seed).updater(Adam(0.01)).list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=2, loss="mcxent", activation="softmax"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def build_data(n: int = 64, seed: int = 0):
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 4)).astype(np.float32)
+    y = np.zeros((n, 2), np.float32)
+    y[np.arange(n), (x[:, 0] > 0).astype(int)] = 1.0
+    return x, y
+
+
+def params_digest(net):
+    """Order-stable fingerprint of the full param tree (exact bytes)."""
+    import hashlib
+    import numpy as np
+    h = hashlib.sha256()
+    for lname in sorted(net.params):
+        for pname in sorted(net.params[lname]):
+            h.update(np.ascontiguousarray(
+                np.asarray(net.params[lname][pname])).tobytes())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+def run_sigterm(ckpt_dir: str, out_json: str) -> None:
+    from deeplearning4j_tpu.optimize.listeners import TrainingListener
+    from deeplearning4j_tpu.resilience.durable import (
+        PreemptionExit, PreemptionGuard)
+
+    class SelfSigterm(TrainingListener):
+        """A real SIGTERM, deterministically mid-epoch (iteration 6 of
+        a 4-batch epoch = epoch 1, batch 2)."""
+
+        def __init__(self, at: int):
+            self.at = at
+            self.sent = False
+
+        def iteration_done(self, model, iteration, score):
+            if not self.sent and iteration + 1 == self.at:
+                self.sent = True
+                os.kill(os.getpid(), signal.SIGTERM)
+
+    net = build_net()
+    x, y = build_data()
+    net.add_listener(SelfSigterm(6))
+    PreemptionGuard(net, ckpt_dir)  # installs the SIGTERM handler
+    try:
+        net.fit(x, y, epochs=4, batch_size=16)
+    except PreemptionExit as e:
+        with open(out_json, "w") as f:
+            json.dump({"saved_step": e.step,
+                       "iteration": net.iteration_count,
+                       "epoch": net.epoch_count}, f)
+        sys.exit(17)
+    with open(out_json, "w") as f:
+        json.dump({"completed": True}, f)
+    sys.exit(0)
+
+
+def run_kill9(ckpt_dir: str, kill_at: int) -> None:
+    from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+    from deeplearning4j_tpu.resilience.chaos import ProcessKillInjector
+    from deeplearning4j_tpu.util.checkpoint import CheckpointListener
+
+    net = build_net()
+    x, y = build_data()
+    it = ProcessKillInjector(ArrayDataSetIterator(x, y, 16), n=kill_at)
+    net.set_listeners(CheckpointListener(ckpt_dir,
+                                         save_every_n_iterations=2,
+                                         keep_last=100))
+    net.fit(it, epochs=10, batch_size=16)  # SIGKILL lands mid-fit
+    sys.exit(5)  # unreachable unless the injector failed to fire
+
+
+def run_dist(coord: str, nproc: int, pid: int, local_dev: int,
+             ckpt_dir: str) -> None:
+    import jax
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deeplearning4j_tpu.parallel import distributed as dist
+    from deeplearning4j_tpu.resilience.durable import (
+        CheckpointError, snapshot_tree, write_shard)
+    from deeplearning4j_tpu.util.checkpoint import (
+        _net_state_tree, save_distributed_checkpoint)
+
+    dist.initialize(dist.VoidConfiguration(
+        coordinator_address=coord, num_processes=nproc, process_id=pid))
+    assert dist.process_count() == nproc
+
+    assert jax.local_device_count() == local_dev
+    net = build_net(seed=4)
+    x, y = build_data(seed=7)
+    x, y = x[:16], y[:16]  # proven harness shape: 8 rows per rank
+    local_n = dist.host_local_batch(x.shape[0])
+    lo = pid * local_n
+    mesh = dist.global_mesh()
+    rep = NamedSharding(mesh, P())
+    params = jax.device_put(net.params, rep)
+    state = jax.device_put(net.state, rep)
+    upd = jax.device_put(net.updater_state, rep)
+    step_fn = net._get_train_step(False)
+
+    def train(k):
+        nonlocal params, state, upd
+        for _ in range(k):
+            gx = dist.make_global_array(x[lo:lo + local_n], mesh)
+            gy = dist.make_global_array(y[lo:lo + local_n], mesh)
+            params, state, upd, _loss = step_fn(params, state, upd, gx, gy,
+                                                net._next_rng(), None, None)
+        net.params, net.state, net.updater_state = params, state, upd
+
+    train(3)
+    net.iteration_count = 3
+    # step 1: the happy path — both ranks arrive, rank 0 commits
+    save_distributed_checkpoint(net, ckpt_dir, step=1, rank=pid,
+                                world=nproc, timeout=120)
+    train(2)
+    net.iteration_count = 5
+    # step 2: the chaos — BOTH shards get written, but the committer
+    # "dies" between its shard write and publishing the COMMIT marker,
+    # so the step is fully present on disk yet never committed. Only
+    # the marker protocol distinguishes it from a durable step. The
+    # death is simulated at the PROTOCOL level (rank 0 simply never
+    # publishes): what recovery sees on disk is byte-identical to a real
+    # pre-marker crash, while both processes stay alive to the final
+    # rendezvous — a rank exiting while its peer still holds a live
+    # coordination-service agent makes jax abort the survivor (SIGABRT),
+    # which is exactly the cross-process cascade the ON-DISK protocol
+    # exists to survive, not something this test should re-trigger.
+    from deeplearning4j_tpu.resilience.durable import wait_commit
+    write_shard(os.path.join(ckpt_dir, "step_2"), pid,
+                snapshot_tree(_net_state_tree(net)))
+    if pid == 0:
+        sys.stdout.write("rank0: step-2 shard written, commit marker "
+                         "withheld (simulated pre-marker death)\n")
+    else:
+        try:
+            wait_commit(os.path.join(ckpt_dir, "step_2"), timeout=5)
+            sys.stdout.write("rank1: UNEXPECTED commit of step 2\n")
+            sys.stdout.flush()
+            os._exit(1)
+        except CheckpointError:
+            sys.stdout.write("rank1: no COMMIT marker appeared, "
+                             "as expected\n")
+    sys.stdout.flush()
+    # rendezvous so neither process exits before the other is done
+    import time as _time
+    open(os.path.join(ckpt_dir, f"done_{pid}"), "w").close()
+    deadline = _time.monotonic() + 60
+    other = os.path.join(ckpt_dir, f"done_{1 - pid}")
+    while not os.path.exists(other) and _time.monotonic() < deadline:
+        _time.sleep(0.1)
+    dist.shutdown()
+    sys.exit(0)
+
+
+def main() -> None:
+    mode = sys.argv[1]
+    configure_jax(int(sys.argv[5]) if mode == "dist" else 8)
+    if mode == "sigterm":
+        run_sigterm(sys.argv[2], sys.argv[3])
+    elif mode == "kill9":
+        run_kill9(sys.argv[2], int(sys.argv[3]))
+    elif mode == "dist":
+        run_dist(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+                 int(sys.argv[5]), sys.argv[6])
+    else:
+        raise SystemExit(f"unknown mode {mode!r}")
+
+
+if __name__ == "__main__":
+    main()

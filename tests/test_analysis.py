@@ -529,6 +529,110 @@ class TestUnboundedRetryRule:
 
 
 # ---------------------------------------------------------------------
+# rule: non-atomic-state-write
+# ---------------------------------------------------------------------
+class TestNonAtomicStateWriteRule:
+    def test_positive_json_dump_onto_final_path(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import json
+
+            def save(path, state):
+                with open(path, "w") as f:
+                    json.dump(state, f)
+        """)
+        assert _rules_of(fs) == ["non-atomic-state-write"]
+
+    def test_positive_pickle_dump_wb(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import pickle
+
+            def save(path, model):
+                with open(path, "wb") as fh:
+                    pickle.dump(model, fh)
+        """)
+        assert _rules_of(fs) == ["non-atomic-state-write"]
+
+    def test_positive_write_json_dumps(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import json
+
+            def save(path, header, rows):
+                with open(path, "w") as f:
+                    f.write(json.dumps(header) + "\\n")
+                    for r in rows:
+                        f.write(r + "\\n")
+        """)
+        assert _rules_of(fs) == ["non-atomic-state-write"]
+
+    def test_positive_zipfile_model_save(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import zipfile
+
+            def save(path, blob):
+                with zipfile.ZipFile(path, "w") as zf:
+                    zf.writestr("model.bin", blob)
+        """)
+        assert _rules_of(fs) == ["non-atomic-state-write"]
+
+    def test_negative_tmp_rename_idiom(self, tmp_path):
+        # the sanctioned shape: dump to a tmp path, os.replace into place
+        fs = _scan_snippet(tmp_path, """
+            import json
+            import os
+
+            def save(path, state):
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(state, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+        """)
+        assert fs == []
+
+    def test_negative_append_sink_and_reads(self, tmp_path):
+        # append-mode sinks are logs (JSONL exporters), not replace-
+        # writes; reads and report-text writes are out of scope
+        fs = _scan_snippet(tmp_path, """
+            import json
+
+            def log(path, rec):
+                with open(path, "a") as f:
+                    f.write(json.dumps(rec) + "\\n")
+
+            def load(path):
+                with open(path) as f:
+                    return json.load(f)
+
+            def report(path, html):
+                with open(path, "w") as f:
+                    f.write(html)
+        """)
+        assert fs == []
+
+    def test_repo_atomic_helper_is_exempt(self):
+        from deeplearning4j_tpu.analysis.rules.state_write import (
+            NonAtomicStateWriteRule)
+        fs = scan_paths([str(PKG / "resilience" / "durable.py")],
+                        [NonAtomicStateWriteRule()], root=str(REPO))
+        assert fs == []
+
+    def test_repo_state_writers_are_clean(self):
+        """The satellite fix set: every state writer the rule flagged
+        when it landed now goes through the tmp-rename idiom."""
+        from deeplearning4j_tpu.analysis.rules.state_write import (
+            NonAtomicStateWriteRule)
+        targets = ["util/checkpoint.py", "util/model_serializer.py",
+                   "nlp/serializer.py", "nlp/pos_tagger.py",
+                   "graph/deepwalk.py", "modelimport/dl4j.py",
+                   "analysis/baseline.py", "eval/serde.py",
+                   "eval/tools.py", "ui/storage.py"]
+        fs = scan_paths([str(PKG / t) for t in targets],
+                        [NonAtomicStateWriteRule()], root=str(REPO))
+        assert fs == []
+
+
+# ---------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------
 class TestSuppression:
@@ -678,7 +782,8 @@ class TestSelfScan:
             "host-sync-in-hot-loop", "device-transfer-in-hot-loop",
             "tracer-leak", "recompile-hazard",
             "dtype-promotion", "unlocked-thread-state", "bare-except",
-            "mutable-default-arg", "unbounded-retry"}
+            "mutable-default-arg", "unbounded-retry",
+            "non-atomic-state-write"}
         assert RULES_BY_ID["host-sync-in-hot-loop"].severity == "error"
         assert RULES_BY_ID["device-transfer-in-hot-loop"].severity == \
             "warning"

@@ -1,0 +1,1060 @@
+"""Durable-training chaos suite (resilience/durable.py + the rewritten
+util/checkpoint.py).
+
+The acceptance bars this file pins:
+
+- kill/truncate at ANY point during a save leaves the newest
+  previously-committed checkpoint intact and loadable (checksum-
+  verified), and restore transparently falls back to it;
+- a preempted fit (SIGTERM → dispatch-boundary emergency save → exit)
+  resumed from its checkpoint produces BIT-IDENTICAL params/opt-state/
+  score trajectory to an uninterrupted run on all three fit loops —
+  per-batch, fused lax.scan, and ParallelWrapper — with zero new jit
+  retraces after the resume warmup dispatch;
+- async checkpointing never blocks the fit loop beyond the device→host
+  snapshot, surfaces failures into health()/telemetry instead of
+  crashing training, and never deletes the predecessor of a failed
+  save;
+- multi-process checkpoints are only visible once rank 0's COMMIT
+  marker is durable — a worker dying between shard write and commit
+  leaves resume on the previous committed step.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+import durable_worker as dw  # shared deterministic net/data builders
+
+from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+from deeplearning4j_tpu.monitoring.metrics import global_registry
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+from deeplearning4j_tpu.resilience import durable
+from deeplearning4j_tpu.resilience.durable import (
+    CKPT_BYTES, CKPT_CORRUPT_SKIPPED, CKPT_FAILURES, CKPT_SAVE_SECONDS,
+    AsyncCheckpointWriter, CheckpointError, CorruptCheckpointError,
+    PreemptionExit, PreemptionGuard, read_commit, sweep_tmp_dirs)
+from deeplearning4j_tpu.util.checkpoint import (
+    CheckpointListener, delete_checkpoint, list_checkpoints,
+    restore_checkpoint, restore_distributed_checkpoint, save_checkpoint,
+    save_distributed_checkpoint, verify_checkpoint)
+from deeplearning4j_tpu.util.recovery import FaultTolerantTrainer
+
+WORKER = os.path.join(os.path.dirname(__file__), "durable_worker.py")
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+def assert_tree_equal(a, b, path="<root>"):
+    """EXACT (bitwise) equality of two state trees."""
+    if isinstance(a, dict) or isinstance(b, dict):
+        assert isinstance(a, dict) and isinstance(b, dict), path
+        assert sorted(a) == sorted(b), f"{path}: keys differ"
+        for k in a:
+            assert_tree_equal(a[k], b[k], f"{path}/{k}")
+        return
+    if a is None or b is None:
+        assert a is None and b is None, path
+        return
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                  err_msg=f"tree leaf {path} differs")
+
+
+def _truncate(path, keep_ratio=0.5):
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(1, int(size * keep_ratio)))
+
+
+def _flip_byte(path, offset_ratio=0.5, span=128):
+    """Corrupt a contiguous span in place (same size, same structure):
+    a span wider than npz's 64-byte entry alignment cannot hide entirely
+    in inter-entry padding, so either a checksum or the container parse
+    must catch it."""
+    with open(path, "r+b") as f:
+        data = bytearray(f.read())
+        at = int(len(data) * offset_ratio)
+        for i in range(at, min(len(data), at + span)):
+            data[i] ^= 0xFF
+        f.seek(0)
+        f.write(data)
+
+
+def _counter(name):
+    c = global_registry().get(name)
+    return 0.0 if c is None else c.total()
+
+
+def _compile_total():
+    from deeplearning4j_tpu.monitoring import runtime
+    c = global_registry().get(runtime.COMPILE_COUNTER)
+    return 0.0 if c is None else c.total()
+
+
+class ScoreTrace(TrainingListener):
+    """Collects the exact per-iteration score (the bit-identity probe)."""
+
+    def __init__(self):
+        self.scores = []
+
+    def iteration_done(self, model, iteration, score):
+        self.scores.append(float(score))
+
+
+class TriggerAt(TrainingListener):
+    """Arms a PreemptionGuard during iteration `at-1`'s listener pass —
+    the guard then fires at the NEXT dispatch boundary, i.e. after
+    exactly `at` logical steps have been dispatched (deterministic,
+    including inside fused groups)."""
+
+    def __init__(self, guard, at):
+        self.guard = guard
+        self.at = at
+
+    def iteration_done(self, model, iteration, score):
+        if iteration + 1 == self.at:
+            self.guard.trigger()
+
+
+class CompileTrace(TrainingListener):
+    def __init__(self):
+        self.totals = []
+
+    def iteration_done(self, model, iteration, score):
+        self.totals.append(_compile_total())
+
+
+def _spawn(args):
+    repo_root = os.path.dirname(os.path.dirname(WORKER))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # the worker forces its own device count
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, WORKER] + [str(a) for a in args],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=repo_root)
+
+
+# ---------------------------------------------------------------------------
+# format: atomicity + integrity
+# ---------------------------------------------------------------------------
+class TestAtomicFormat:
+    def test_manifest_carries_version_and_per_leaf_checksums(self, tmp_path):
+        net = dw.build_net()
+        x, y = dw.build_data()
+        net.fit(x, y, epochs=1, batch_size=16)
+        ck = str(tmp_path)
+        save_checkpoint(net, ck, step=1)
+        with open(tmp_path / "step_1" / "MANIFEST.json") as f:
+            m = json.load(f)
+        assert m["format_version"] == durable.FORMAT_VERSION
+        assert m["leaves"], "no leaf checksums recorded"
+        for meta in m["leaves"].values():
+            assert set(meta) == {"checksum", "dtype", "shape"}
+        assert verify_checkpoint(ck, 1)
+
+    def test_torn_data_falls_back_to_newest_intact(self, tmp_path):
+        net = dw.build_net()
+        x, y = dw.build_data()
+        net.fit(x, y, epochs=1, batch_size=16)
+        ck = str(tmp_path)
+        save_checkpoint(net, ck, step=1)
+        p1 = {k: np.asarray(v) for k, v in net.params["0"].items()}
+        net.fit(x, y, epochs=1, batch_size=16)
+        save_checkpoint(net, ck, step=2)
+        _truncate(tmp_path / "step_2" / "data.npz")  # the torn write
+        assert not verify_checkpoint(ck, 2)
+        assert verify_checkpoint(ck, 1)
+
+        # explicit step: the caller asked for those bytes — raise
+        with pytest.raises(CorruptCheckpointError):
+            restore_checkpoint(dw.build_net(), ck, step=2)
+
+        # newest-intact fallback, with the skip counter bumped
+        before = _counter(CKPT_CORRUPT_SKIPPED)
+        fresh = dw.build_net()
+        restore_checkpoint(fresh, ck)
+        assert _counter(CKPT_CORRUPT_SKIPPED) == before + 1
+        assert fresh.epoch_count == 1
+        for k, v in p1.items():
+            np.testing.assert_array_equal(np.asarray(fresh.params["0"][k]), v)
+
+    def test_bitflip_detected_by_checksum(self, tmp_path):
+        net = dw.build_net()
+        x, y = dw.build_data()
+        net.fit(x, y, epochs=1, batch_size=16)
+        ck = str(tmp_path)
+        save_checkpoint(net, ck, step=1)
+        _flip_byte(tmp_path / "step_1" / "data.npz", 0.7)
+        assert not verify_checkpoint(ck, 1)
+        with pytest.raises(CorruptCheckpointError):
+            restore_checkpoint(dw.build_net(), ck, step=1)
+
+    def test_garbage_manifest_detected(self, tmp_path):
+        net = dw.build_net()
+        x, y = dw.build_data()
+        net.fit(x, y, epochs=1, batch_size=16)
+        ck = str(tmp_path)
+        save_checkpoint(net, ck, step=1)
+        (tmp_path / "step_1" / "MANIFEST.json").write_text("{ torn")
+        assert not verify_checkpoint(ck, 1)
+
+    def test_tmp_dirs_invisible_and_sweepable(self, tmp_path):
+        net = dw.build_net()
+        x, y = dw.build_data()
+        net.fit(x, y, epochs=1, batch_size=16)
+        ck = str(tmp_path)
+        save_checkpoint(net, ck, step=1)
+        litter = tmp_path / ".tmp-step_2.999.1"
+        litter.mkdir()
+        (litter / "data.npz").write_bytes(b"partial")
+        assert list_checkpoints(ck) == [1]  # crash litter never lists
+        assert sweep_tmp_dirs(ck) == 1
+        assert not litter.exists()
+        assert verify_checkpoint(ck, 1)
+
+
+class _Kill(BaseException):
+    """Stands in for the process dying — not an Exception, so nothing
+    between the crash point and the test can swallow it."""
+
+
+class TestCrashDuringSave:
+    """The acceptance bar: kill at ANY durability milestone of a save
+    leaves the newest previously-committed checkpoint intact."""
+
+    @pytest.mark.parametrize("point", ["data-written", "pre-rename"])
+    def test_kill_before_commit_preserves_predecessor(self, tmp_path,
+                                                      monkeypatch, point):
+        net = dw.build_net()
+        x, y = dw.build_data()
+        net.fit(x, y, epochs=1, batch_size=16)
+        ck = str(tmp_path)
+        save_checkpoint(net, ck, step=1)
+        p1 = {k: np.asarray(v) for k, v in net.params["0"].items()}
+        net.fit(x, y, epochs=1, batch_size=16)
+
+        def crash(label):
+            if label == point:
+                raise _Kill(label)
+
+        monkeypatch.setattr(durable, "_crash_hook", crash)
+        with pytest.raises(_Kill):
+            save_checkpoint(net, ck, step=2)
+        monkeypatch.setattr(durable, "_crash_hook", None)
+
+        assert list_checkpoints(ck) == [1]  # step 2 never became visible
+        assert verify_checkpoint(ck, 1)
+        fresh = dw.build_net()
+        restore_checkpoint(fresh, ck)
+        for k, v in p1.items():
+            np.testing.assert_array_equal(np.asarray(fresh.params["0"][k]), v)
+
+    def test_same_step_replace_never_loses_both_copies(self, tmp_path,
+                                                       monkeypatch):
+        """Re-saving an existing step (the step=None 'latest' path does
+        this every save) swaps via aside-rename: a kill between the two
+        renames must leave a survivor on disk — the old rmtree-then-
+        rename shape destroyed the only copy in that window."""
+        net = dw.build_net()
+        x, y = dw.build_data()
+        net.fit(x, y, epochs=1, batch_size=16)
+        ck = str(tmp_path)
+        save_checkpoint(net, ck)  # writes "latest"
+        net.fit(x, y, epochs=1, batch_size=16)
+
+        def crash(label):
+            if label == "mid-replace":
+                raise _Kill(label)
+
+        monkeypatch.setattr(durable, "_crash_hook", crash)
+        with pytest.raises(_Kill):
+            save_checkpoint(net, ck)
+        monkeypatch.setattr(durable, "_crash_hook", None)
+        # in-process failure: the aside copy was rolled back into place
+        assert durable.verify_state_dir(str(tmp_path / "latest"))
+        fresh = dw.build_net()
+        restore_checkpoint(fresh, ck)
+        assert fresh.epoch_count == 1  # the OLD committed state
+        # sweep never touches a .replaced survivor (none should remain
+        # here, and no tmp litter either)
+        assert sweep_tmp_dirs(ck) == 0
+        # and a clean re-save replaces without leaving an aside behind
+        save_checkpoint(net, ck)
+        assert durable.verify_state_dir(str(tmp_path / "latest"))
+        assert not [n for n in os.listdir(ck) if ".replaced." in n]
+
+    def test_writer_close_keeps_single_worker(self):
+        """close() leaves the worker parked instead of stopping it — a
+        stop/respawn cycle could put two workers on one queue and break
+        the FIFO save→prune ordering."""
+        w = AsyncCheckpointWriter(max_pending=2)
+        w.submit(lambda: None)
+        assert w.flush(10)
+        w.close()
+        t1 = w._thread
+        assert t1 is not None and t1.is_alive()
+        order = []
+        w.submit(lambda: order.append("a"))
+        w.submit(lambda: order.append("b"))
+        assert w.flush(10) and order == ["a", "b"]
+        assert w._thread is t1  # same single worker
+        w.close()
+
+    def test_kill_after_rename_means_committed(self, tmp_path, monkeypatch):
+        net = dw.build_net()
+        x, y = dw.build_data()
+        net.fit(x, y, epochs=1, batch_size=16)
+        ck = str(tmp_path)
+        save_checkpoint(net, ck, step=1)
+        net.fit(x, y, epochs=1, batch_size=16)
+
+        def crash(label):
+            if label == "post-rename":
+                raise _Kill(label)
+
+        monkeypatch.setattr(durable, "_crash_hook", crash)
+        with pytest.raises(_Kill):
+            save_checkpoint(net, ck, step=2)
+        monkeypatch.setattr(durable, "_crash_hook", None)
+        # the rename IS the commit point: past it, the step is durable
+        assert list_checkpoints(ck) == [1, 2]
+        assert verify_checkpoint(ck, 2)
+        fresh = dw.build_net()
+        restore_checkpoint(fresh, ck)
+        assert fresh.epoch_count == 2
+
+
+# ---------------------------------------------------------------------------
+# async writer
+# ---------------------------------------------------------------------------
+class TestAsyncWriter:
+    def test_async_saves_land_durable_and_ordered(self, tmp_path):
+        net = dw.build_net()
+        x, y = dw.build_data()
+        ck = str(tmp_path)
+        lst = CheckpointListener(ck, save_every_n_iterations=2,
+                                 keep_last=2, async_save=True)
+        net.set_listeners(lst)
+        bytes_before = _counter(CKPT_BYTES)
+        net.fit(x, y, epochs=4, batch_size=16)  # 16 iterations
+        assert lst.flush(timeout=30)
+        steps = list_checkpoints(ck)
+        assert len(steps) == 2 and steps[-1] == 16  # keep_last pruned
+        assert all(verify_checkpoint(ck, s) for s in steps)
+        assert _counter(CKPT_BYTES) > bytes_before
+        h = global_registry().get(CKPT_SAVE_SECONDS)
+        assert h is not None and h.count(mode="async") > 0
+        assert lst.health()["healthy"]
+        lst.close()
+
+    def test_failure_surfaces_in_health_not_in_fit(self, tmp_path,
+                                                   monkeypatch):
+        net = dw.build_net()
+        x, y = dw.build_data()
+        ck = str(tmp_path)
+        lst = CheckpointListener(ck, save_every_n_iterations=4,
+                                 keep_last=5, async_save=True)
+        net.set_listeners(lst)
+        net.fit(x, y, epochs=1, batch_size=16)  # saves step 4
+        assert lst.flush(timeout=30)
+        assert list_checkpoints(ck) == [4]
+
+        fails = []
+
+        def crash(label):
+            if label == "data-written" and not fails:
+                fails.append(label)
+                raise OSError("disk full (injected)")
+
+        fail_before = _counter(CKPT_FAILURES)
+        monkeypatch.setattr(durable, "_crash_hook", crash)
+        net.fit(x, y, epochs=1, batch_size=16)  # save step 8 fails async
+        assert lst.flush(timeout=30)
+        monkeypatch.setattr(durable, "_crash_hook", None)
+
+        # the fit completed; the failure is VISIBLE, the predecessor is
+        # untouched, and nothing pruned it
+        assert fails, "injected failure never fired"
+        assert _counter(CKPT_FAILURES) == fail_before + 1
+        h = lst.health()
+        assert not h["healthy"] and "disk full" in h["last_error"]
+        assert list_checkpoints(ck) == [4]
+        assert verify_checkpoint(ck, 4)
+
+        # a later clean save restores health
+        net.fit(x, y, epochs=1, batch_size=16)
+        assert lst.flush(timeout=30)
+        assert lst.health()["healthy"]
+        assert list_checkpoints(ck)[-1] == 12
+        lst.close()
+
+    def test_writer_backpressure_bounded(self):
+        w = AsyncCheckpointWriter(max_pending=1)
+        import threading
+        import time as _t
+        gate = threading.Event()
+        w.submit(lambda: gate.wait(10), label="slow")
+        t0 = _t.perf_counter()
+
+        def release():
+            _t.sleep(0.3)
+            gate.set()
+
+        threading.Thread(target=release, daemon=True).start()
+        w.submit(lambda: None, label="queued")  # fills the queue
+        w.submit(lambda: None, label="blocked")  # must BLOCK until drain
+        assert _t.perf_counter() - t0 >= 0.2
+        assert w.flush(10)
+        assert w.health()["healthy"]
+        w.close()
+
+
+# ---------------------------------------------------------------------------
+# pruning / tag lifecycle (satellite regressions)
+# ---------------------------------------------------------------------------
+class TestPruningLifecycle:
+    def test_keep_last_never_orphans_tags_or_manifests(self, tmp_path):
+        net = dw.build_net()
+        x, y = dw.build_data()
+        ck = str(tmp_path)
+        net.set_listeners(CheckpointListener(ck, save_every_n_iterations=2,
+                                             keep_last=2))
+        net.fit(x, y, epochs=4, batch_size=16)
+        steps = set(list_checkpoints(ck))
+        assert len(steps) == 2
+        # every surviving artifact belongs to a surviving step: no
+        # orphan health tags, no orphan dirs, no tmp litter
+        for name in os.listdir(ck):
+            if name.endswith(".resilience.json"):
+                assert int(name.split("_")[1].split(".")[0]) in steps
+            elif name.startswith("step_"):
+                assert int(name.split("_", 1)[1]) in steps
+            else:
+                assert name == "config.json", f"unexpected artifact {name}"
+        for s in steps:
+            assert os.path.exists(os.path.join(ck, f"step_{s}.resilience"
+                                                   f".json"))
+            assert verify_checkpoint(ck, s)
+
+    def test_sync_save_failure_keeps_predecessor(self, tmp_path,
+                                                 monkeypatch):
+        net = dw.build_net()
+        x, y = dw.build_data()
+        ck = str(tmp_path)
+        lst = CheckpointListener(ck, save_every_n_iterations=1, keep_last=1)
+        net.set_listeners(lst)
+        net.fit(x, y, epochs=1, batch_size=64)  # one iteration → step 1
+
+        def crash(label):
+            raise OSError("injected write failure")
+
+        monkeypatch.setattr(durable, "_crash_hook", crash)
+        with pytest.raises(OSError):
+            net.fit(x, y, epochs=1, batch_size=64)
+        monkeypatch.setattr(durable, "_crash_hook", None)
+        # keep_last=1 + failed replacement: the predecessor SURVIVES —
+        # pruning only ever runs after a successful commit
+        assert list_checkpoints(ck) == [1]
+        assert verify_checkpoint(ck, 1)
+
+    def test_delete_checkpoint_removes_dir_and_tag(self, tmp_path):
+        net = dw.build_net()
+        x, y = dw.build_data()
+        net.fit(x, y, epochs=1, batch_size=16)
+        ck = str(tmp_path)
+        save_checkpoint(net, ck, step=7)
+        assert os.path.exists(tmp_path / "step_7.resilience.json")
+        delete_checkpoint(ck, 7)
+        assert not os.path.exists(tmp_path / "step_7")
+        assert not os.path.exists(tmp_path / "step_7.resilience.json")
+
+
+# ---------------------------------------------------------------------------
+# iterator cursor protocol
+# ---------------------------------------------------------------------------
+class TestIteratorCursor:
+    def test_array_iterator_exact_fast_forward(self):
+        x, y = dw.build_data(n=96)
+        a = ArrayDataSetIterator(x, y, 16, shuffle=True, seed=9)
+        seen = []
+        for pass_idx in range(2):
+            for ds in a:
+                seen.append(ds.features)
+        # replay pass 1 from batch 2 on a FRESH iterator
+        b = ArrayDataSetIterator(x, y, 16, shuffle=True, seed=9)
+        b.restore_state({"epoch": 1, "pos": 2})
+        replay = [ds.features for ds in b]
+        assert len(replay) == 4  # 6 batches per pass, skipped 2
+        for got, want in zip(replay, seen[6 + 2:]):
+            np.testing.assert_array_equal(got, want)
+
+    def test_array_iterator_state_midpass(self):
+        x, y = dw.build_data(n=64)
+        it = ArrayDataSetIterator(x, y, 16)
+        assert it.state() == {"epoch": 0, "pos": 0}
+        g = iter(it)
+        next(g)
+        next(g)
+        assert it.state() == {"epoch": 0, "pos": 2}
+        for _ in g:
+            pass
+        assert it.state() == {"epoch": 1, "pos": 0}
+
+    def test_prefetch_delegates_cursor_to_base(self):
+        from deeplearning4j_tpu.pipeline.prefetch import \
+            DevicePrefetchIterator
+        x, y = dw.build_data(n=96)
+        base = ArrayDataSetIterator(x, y, 16)
+        pf = DevicePrefetchIterator(base, prefetch=2)
+        first = [np.asarray(ds.features) for ds in pf]
+        assert pf.state() == {"epoch": 1, "pos": 0}
+        pf2 = DevicePrefetchIterator(ArrayDataSetIterator(x, y, 16),
+                                     prefetch=2)
+        pf2.restore_state({"epoch": 0, "pos": 4})
+        tail = [np.asarray(ds.features) for ds in pf2]
+        assert len(tail) == 2
+        np.testing.assert_array_equal(tail[0], first[4])
+        np.testing.assert_array_equal(tail[1], first[5])
+
+    def test_prefetch_without_base_support_refuses(self):
+        from deeplearning4j_tpu.datasets.iterators import \
+            ExistingDataSetIterator
+        from deeplearning4j_tpu.pipeline.prefetch import \
+            DevicePrefetchIterator
+        pf = DevicePrefetchIterator(ExistingDataSetIterator([]), prefetch=1)
+        with pytest.raises(NotImplementedError):
+            pf.restore_state({"epoch": 0, "pos": 1})
+
+
+# ---------------------------------------------------------------------------
+# preemption-exact resume: the bit-identity pins
+# ---------------------------------------------------------------------------
+def _interrupt_and_resume(make_net, fit_kwargs, ck, kill_at,
+                          total_epochs=4, make_iter=None, wrapper=False):
+    """Run straight vs (interrupted at `kill_at` dispatched steps →
+    emergency save → fresh-net resume); returns both (net, scores)."""
+    x, y = dw.build_data()
+
+    def fit(net, epochs, trace, extra=None):
+        listeners = [trace] + (extra or [])
+        for l in listeners:
+            net.add_listener(l)
+        target = net if not wrapper else __import__(
+            "deeplearning4j_tpu.parallel.wrapper",
+            fromlist=["ParallelWrapper"]).ParallelWrapper(net)
+        data = make_iter() if make_iter is not None else None
+        try:
+            if data is not None:
+                target.fit(data, epochs=epochs, **fit_kwargs)
+            else:
+                target.fit(x, y, epochs=epochs, **fit_kwargs)
+        finally:
+            for l in listeners:
+                net.listeners.remove(l)
+
+    # straight run
+    a = make_net()
+    tr_a = ScoreTrace()
+    fit(a, total_epochs, tr_a)
+
+    # interrupted run: guard fires at the boundary after `kill_at` steps
+    b = make_net()
+    tr_b = ScoreTrace()
+    guard = PreemptionGuard(b, ck, install=False)
+    with pytest.raises(PreemptionExit) as exc:
+        fit(b, total_epochs, tr_b, extra=[TriggerAt(guard, kill_at)])
+    assert exc.value.step == b.iteration_count
+    guard.uninstall()
+
+    # fresh process stand-in: new net object, restore, continue
+    c = make_net()
+    restore_checkpoint(c, ck)
+    assert c.iteration_count == b.iteration_count
+    tr_c = ScoreTrace()
+    fit(c, total_epochs - c.epoch_count, tr_c)
+
+    scores_resumed = tr_b.scores + tr_c.scores
+    assert scores_resumed == tr_a.scores, (
+        "score trajectory diverged after resume")
+    assert c.iteration_count == a.iteration_count
+    assert c.epoch_count == a.epoch_count
+    assert_tree_equal(a.params, c.params)
+    assert_tree_equal(a.updater_state, c.updater_state)
+    return a, c
+
+
+class TestResumeExactness:
+    def test_per_batch_resume_bit_identical(self, tmp_path):
+        _interrupt_and_resume(dw.build_net, {"batch_size": 16},
+                              str(tmp_path), kill_at=6)
+
+    def test_fused_scan_resume_bit_identical_zero_retraces(self, tmp_path):
+        from deeplearning4j_tpu import monitoring
+        monitoring.ensure_started()
+        x, y = dw.build_data()
+        kwargs = {"batch_size": 16, "steps_per_dispatch": 2}
+        a, c = _interrupt_and_resume(dw.build_net, kwargs,
+                                     str(tmp_path), kill_at=6)
+        # zero NEW retraces after the resume warmup: re-run the resumed
+        # net — every signature must already be compiled
+        warm = _compile_total()
+        c.fit(x, y, epochs=2, **kwargs)
+        assert _compile_total() == warm, (
+            "resumed net retraced after warmup")
+
+    def test_resume_midgroup_trigger_lands_on_boundary(self, tmp_path):
+        # killing at logical step 5 (inside the (4,5) fused group) must
+        # save at the GROUP boundary: iteration_count divisible by K
+        x, y = dw.build_data()
+        b = dw.build_net()
+        guard = PreemptionGuard(b, str(tmp_path), install=False)
+        b.add_listener(TriggerAt(guard, 5))
+        with pytest.raises(PreemptionExit) as exc:
+            b.fit(x, y, epochs=4, batch_size=16, steps_per_dispatch=2)
+        assert exc.value.step == 6  # boundary after the fused (4,5) group
+        assert b.iteration_count == 6
+
+    def test_dropout_rng_stream_resumes_exact(self, tmp_path):
+        from deeplearning4j_tpu.nn.conf import (InputType,
+                                                NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.dropout import Dropout
+        from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                       OutputLayer)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.updater import Adam
+
+        def dnet():
+            return MultiLayerNetwork(
+                (NeuralNetConfiguration.Builder()
+                 .seed(11).updater(Adam(0.01)).list()
+                 .layer(DenseLayer(n_out=16, activation="relu",
+                                   dropout=Dropout(0.5)))
+                 .layer(OutputLayer(n_out=2, loss="mcxent",
+                                    activation="softmax"))
+                 .set_input_type(InputType.feed_forward(4))
+                 .build())).init()
+
+        _interrupt_and_resume(dnet, {"batch_size": 16}, str(tmp_path),
+                              kill_at=6)
+
+    def test_shuffled_iterator_resumes_exact(self, tmp_path):
+        x, y = dw.build_data()
+        _interrupt_and_resume(
+            dw.build_net, {"batch_size": 16}, str(tmp_path), kill_at=6,
+            make_iter=lambda: ArrayDataSetIterator(x, y, 16, shuffle=True,
+                                                   seed=13))
+
+    def test_prefetch_pipeline_resumes_exact(self, tmp_path):
+        _interrupt_and_resume(dw.build_net,
+                              {"batch_size": 16, "prefetch": 2},
+                              str(tmp_path), kill_at=6)
+
+    def test_graph_resume_bit_identical(self, tmp_path):
+        from deeplearning4j_tpu.nn.conf import (InputType,
+                                                NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                       OutputLayer)
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.updater import Adam
+
+        def gnet():
+            conf = (NeuralNetConfiguration.Builder()
+                    .seed(5).updater(Adam(0.01))
+                    .graph_builder()
+                    .add_inputs("in")
+                    .set_input_types(InputType.feed_forward(4))
+                    .add_layer("d", DenseLayer(n_out=6, activation="tanh"),
+                               "in")
+                    .add_layer("out", OutputLayer(n_out=2, loss="mcxent",
+                                                  activation="softmax"),
+                               "d")
+                    .set_outputs("out")
+                    .build())
+            return ComputationGraph(conf).init()
+
+        _interrupt_and_resume(gnet, {"batch_size": 16}, str(tmp_path),
+                              kill_at=6)
+
+    def test_parallel_wrapper_resume_bit_identical(self, tmp_path):
+        _interrupt_and_resume(dw.build_net, {"batch_size": 16},
+                              str(tmp_path), kill_at=6, wrapper=True)
+
+    def test_prefetch_on_pretrained_net_resumes_exact(self, tmp_path):
+        """Regression: a fresh DevicePrefetchIterator's pre-pass state()
+        must follow the BASE iterator's cursor — its own counter is 0
+        even when fit aligned the base to a later epoch, and
+        capture_cursor_pass reads state() before the first batch."""
+        x, y = dw.build_data()
+        kwargs = {"batch_size": 16, "prefetch": 2}
+        a = dw.build_net()
+        a.fit(x, y, epochs=2, **kwargs)  # pre-training: epoch_count 2
+        tr_a = ScoreTrace()
+        a.add_listener(tr_a)
+        a.fit(x, y, epochs=2, **kwargs)
+
+        b = dw.build_net()
+        b.fit(x, y, epochs=2, **kwargs)
+        tr_b = ScoreTrace()
+        b.add_listener(tr_b)
+        guard = PreemptionGuard(b, str(tmp_path), install=False)
+        b.add_listener(TriggerAt(guard, 14))  # mid-pass 3
+        with pytest.raises(PreemptionExit):
+            b.fit(x, y, epochs=2, **kwargs)
+        guard.uninstall()
+        # the emergency cursor must carry the ABSOLUTE pass index, not
+        # the fresh wrapper's local 0
+        from deeplearning4j_tpu.resilience.durable import read_manifest
+        m = read_manifest(str(tmp_path / f"step_{b.iteration_count}"))
+        assert m["extras"]["pipeline"]["epoch"] == 3
+
+        c = dw.build_net()
+        restore_checkpoint(c, str(tmp_path))
+        tr_c = ScoreTrace()
+        c.add_listener(tr_c)
+        c.fit(x, y, epochs=4 - c.epoch_count, **kwargs)
+        assert tr_b.scores + tr_c.scores == tr_a.scores
+        assert_tree_equal(a.params, c.params)
+
+    def test_trailing_group_cadence_save_resumes_exact(self, tmp_path):
+        """Regression: the end-of-epoch trailing-group flush fires its
+        dispatch boundary AFTER the generator exhausted the iterator
+        (whose cursor then reads next-pass); the saved cursor must still
+        pair the CURRENT pass with the full dispatch count — the torn
+        pairing {next_pass, all_dispatched} made resume skip an entire
+        epoch."""
+        x, y = dw.build_data(n=80)  # 5 batches of 16: trailing group @K=2
+        kwargs = {"batch_size": 16, "steps_per_dispatch": 2}
+        a = dw.build_net()
+        a.fit(x, y, epochs=2, **kwargs)
+
+        b = dw.build_net()
+        b.set_listeners(CheckpointListener(str(tmp_path),
+                                           save_every_n_iterations=5,
+                                           keep_last=10))
+        b.fit(x, y, epochs=1, **kwargs)  # cadence save at trailing flush
+        assert 5 in list_checkpoints(str(tmp_path))
+
+        c = dw.build_net()
+        restore_checkpoint(c, str(tmp_path), step=5)
+        c.fit(x, y, epochs=2 - c.epoch_count, **kwargs)
+        assert c.epoch_count == 2
+        assert c.iteration_count == a.iteration_count
+        assert_tree_equal(a.params, c.params)
+
+    def test_fresh_shuffled_iterator_on_pretrained_net_resumes_exact(
+            self, tmp_path):
+        """Regression: the cursor must record the ITERATOR's own pass
+        index (its shuffle seed), not the net's absolute epoch_count —
+        a fresh per-fit iterator on a net with prior training starts at
+        pass 0 while epoch_count is already 2."""
+        x, y = dw.build_data()
+
+        def second_fit_iter():
+            return ArrayDataSetIterator(x, y, 16, shuffle=True, seed=21)
+
+        # straight: pretrain 2 epochs, then 2 more on a fresh shuffled
+        # iterator
+        a = dw.build_net()
+        a.fit(x, y, epochs=2, batch_size=16)
+        tr_a = ScoreTrace()
+        a.add_listener(tr_a)
+        a.fit(second_fit_iter(), epochs=2, batch_size=16)
+        a.listeners.remove(tr_a)
+
+        # interrupted mid-second-fit (pass 1 of the NEW iterator,
+        # epoch_count 3) → emergency save → fresh net + fresh iterator
+        b = dw.build_net()
+        b.fit(x, y, epochs=2, batch_size=16)
+        tr_b = ScoreTrace()
+        b.add_listener(tr_b)
+        guard = PreemptionGuard(b, str(tmp_path), install=False)
+        b.add_listener(TriggerAt(guard, 14))  # iteration 14 = pass 1 b2
+        with pytest.raises(PreemptionExit):
+            b.fit(second_fit_iter(), epochs=2, batch_size=16)
+        guard.uninstall()
+
+        c = dw.build_net()
+        restore_checkpoint(c, str(tmp_path))
+        tr_c = ScoreTrace()
+        c.add_listener(tr_c)
+        c.fit(second_fit_iter(), epochs=4 - c.epoch_count, batch_size=16)
+        assert tr_b.scores + tr_c.scores == tr_a.scores
+        assert_tree_equal(a.params, c.params)
+
+    def test_terminal_async_save_durable_before_fit_returns(self,
+                                                            tmp_path):
+        """Regression: FaultTolerantTrainer's terminal checkpoint rides
+        the async writer — fit must not return until it is on disk (a
+        daemon writer thread dies with the process)."""
+        x, y = dw.build_data()
+        net = dw.build_net()
+        t = FaultTolerantTrainer(net, str(tmp_path),
+                                 save_every_n_iterations=3,
+                                 save_every_epoch=False, async_save=True)
+        t.fit(x, y, epochs=2, batch_size=16)
+        # NO flush here: the terminal step must already be durable
+        steps = list_checkpoints(str(tmp_path))
+        assert steps and steps[-1] == net.iteration_count
+        assert verify_checkpoint(str(tmp_path), steps[-1])
+
+    def test_lr_backoff_survives_process_death(self, tmp_path):
+        net = dw.build_net()
+        x, y = dw.build_data()
+        net.fit(x, y, epochs=1, batch_size=16)
+        net.conf.updater.learning_rate *= 0.25  # a runtime backoff
+        cooled = net.conf.updater.learning_rate
+        save_checkpoint(net, str(tmp_path), step=4)
+        fresh = dw.build_net()  # fresh conf carries the ORIGINAL lr
+        assert fresh.conf.updater.learning_rate != cooled
+        restore_checkpoint(fresh, str(tmp_path))
+        assert fresh.conf.updater.learning_rate == cooled
+
+    def test_watchdog_window_survives_resume(self, tmp_path):
+        from deeplearning4j_tpu.resilience.watchdog import \
+            DivergenceWatchdog
+        net = dw.build_net()
+        x, y = dw.build_data()
+        wd = DivergenceWatchdog(check_every=1)
+        net.add_listener(wd)
+        net.fit(x, y, epochs=2, batch_size=16)
+        assert len(wd._scores) > 0
+        save_checkpoint(net, str(tmp_path), step=8)
+        fresh = dw.build_net()
+        wd2 = DivergenceWatchdog(check_every=1)
+        fresh.add_listener(wd2)
+        restore_checkpoint(fresh, str(tmp_path))
+        assert list(wd2._scores) == list(wd._scores)
+        assert wd2._ticks == wd._ticks
+
+
+# ---------------------------------------------------------------------------
+# recovery integrity (satellite: only_good re-verification)
+# ---------------------------------------------------------------------------
+class TestRecoveryIntegrity:
+    def _two_step_dir(self, tmp_path):
+        net = dw.build_net()
+        x, y = dw.build_data()
+        net.fit(x, y, epochs=1, batch_size=16)
+        ck = str(tmp_path)
+        save_checkpoint(net, ck, step=4)
+        net.fit(x, y, epochs=1, batch_size=16)
+        save_checkpoint(net, ck, step=8)
+        return ck
+
+    def test_resume_only_good_skips_corrupt_with_counter(self, tmp_path):
+        ck = self._two_step_dir(tmp_path)
+        _truncate(tmp_path / "step_8" / "data.npz")
+        # the tag still says GOOD — it predates the corruption
+        from deeplearning4j_tpu.util.checkpoint import checkpoint_status
+        assert checkpoint_status(ck, 8).get("good", True)
+        before = _counter(CKPT_CORRUPT_SKIPPED)
+        t = FaultTolerantTrainer(dw.build_net(), ck)
+        step = t.resume_if_possible(only_good=True)
+        assert step == 4
+        assert _counter(CKPT_CORRUPT_SKIPPED) == before + 1
+
+    def test_rollback_target_reverified(self, tmp_path):
+        from deeplearning4j_tpu.resilience.watchdog import DivergenceError
+        ck = self._two_step_dir(tmp_path)
+        _flip_byte(tmp_path / "step_8" / "data.npz", 0.6)
+        net = dw.build_net()
+        t = FaultTolerantTrainer(net, ck)
+        # the newest good-tagged save is torn: rollback must fall
+        # through to the older intact one instead of restoring garbage
+        assert t._rollback(DivergenceError("boom")) == 4
+        assert net.iteration_count == 4
+
+    def test_all_corrupt_resumes_fresh(self, tmp_path):
+        ck = self._two_step_dir(tmp_path)
+        _truncate(tmp_path / "step_4" / "data.npz")
+        _truncate(tmp_path / "step_8" / "data.npz")
+        t = FaultTolerantTrainer(dw.build_net(), ck)
+        assert t.resume_if_possible() is None  # fresh start, no raise
+
+    def test_trainer_health_exposes_writer(self, tmp_path):
+        t = FaultTolerantTrainer(dw.build_net(), str(tmp_path),
+                                 async_save=True)
+        h = t.health()
+        assert h["checkpoint_writer"]["healthy"]
+        assert h["checkpoint_dir"] == str(tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# distributed commit protocol (in-process halves; gloo harness below)
+# ---------------------------------------------------------------------------
+class TestDistributedCommitLocal:
+    def _trained(self):
+        net = dw.build_net()
+        x, y = dw.build_data()
+        net.fit(x, y, epochs=1, batch_size=16)
+        return net
+
+    def test_commit_published_only_after_all_shards(self, tmp_path):
+        net = self._trained()
+        ck = str(tmp_path)
+        # rank 1 writes first (no commit authority), then rank 0
+        save_distributed_checkpoint(net, ck, step=1, rank=1, world=2,
+                                    wait=False)
+        assert read_commit(os.path.join(ck, "step_1")) is None
+        save_distributed_checkpoint(net, ck, step=1, rank=0, world=2,
+                                    timeout=10)
+        assert read_commit(os.path.join(ck, "step_1"))["world"] == 2
+        assert durable.latest_committed_step(ck) == 1
+
+    def test_missing_shard_times_out_without_marker(self, tmp_path):
+        net = self._trained()
+        ck = str(tmp_path)
+        with pytest.raises(CheckpointError):
+            save_distributed_checkpoint(net, ck, step=1, rank=0, world=2,
+                                        timeout=0.4)
+        assert read_commit(os.path.join(ck, "step_1")) is None
+        assert durable.latest_committed_step(ck) is None
+
+    def test_resume_selects_highest_committed(self, tmp_path):
+        net = self._trained()
+        ck = str(tmp_path)
+        save_distributed_checkpoint(net, ck, step=1, rank=1, world=2,
+                                    wait=False)
+        save_distributed_checkpoint(net, ck, step=1, rank=0, world=2)
+        p1 = {k: np.asarray(v) for k, v in net.params["0"].items()}
+        x, y = dw.build_data()
+        net.fit(x, y, epochs=1, batch_size=16)
+        # step 2: both shards written, NO commit marker (rank 0 died)
+        from deeplearning4j_tpu.util.checkpoint import _net_state_tree
+        for r in (0, 1):
+            durable.write_shard(os.path.join(ck, "step_2"), r,
+                                durable.snapshot_tree(_net_state_tree(net)))
+        fresh = dw.build_net()
+        got = restore_distributed_checkpoint(fresh, ck, rank=0, world=2)
+        assert got == 1
+        for k, v in p1.items():
+            np.testing.assert_array_equal(np.asarray(fresh.params["0"][k]),
+                                          v)
+
+    def test_corrupt_committed_shard_falls_back(self, tmp_path):
+        net = self._trained()
+        ck = str(tmp_path)
+        for step in (1, 2):
+            save_distributed_checkpoint(net, ck, step=step, rank=1,
+                                        world=2, wait=False)
+            save_distributed_checkpoint(net, ck, step=step, rank=0,
+                                        world=2)
+        _truncate(tmp_path / "step_2" / "shard_0" / "data.npz")
+        fresh = dw.build_net()
+        assert restore_distributed_checkpoint(fresh, ck, rank=0,
+                                              world=2) == 1
+        # rank 1's shard of step 2 is fine — IT still restores step 2
+        fresh1 = dw.build_net()
+        assert restore_distributed_checkpoint(fresh1, ck, rank=1,
+                                              world=2) == 2
+
+
+# ---------------------------------------------------------------------------
+# real-process chaos (slow lane)
+# ---------------------------------------------------------------------------
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+class TestSubprocessChaos:
+    def test_sigterm_emergency_save_then_exact_resume(self, tmp_path):
+        ck = str(tmp_path / "ck")
+        out = str(tmp_path / "out.json")
+        p = _spawn(["sigterm", ck, out])
+        log_text, _ = p.communicate(timeout=300)
+        assert p.returncode == 17, f"worker did not preempt:\n{log_text}"
+        with open(out) as f:
+            rec = json.load(f)
+        assert rec["saved_step"] == 6  # boundary after the SIGTERM
+        assert verify_checkpoint(ck, 6)
+
+        # resume in THIS process and compare to an uninterrupted run
+        a = dw.build_net()
+        x, y = dw.build_data()
+        a.fit(x, y, epochs=4, batch_size=16)
+        c = dw.build_net()
+        restore_checkpoint(c, ck)
+        assert c.iteration_count == 6
+        c.fit(x, y, epochs=4 - c.epoch_count, batch_size=16)
+        assert dw.params_digest(a) == dw.params_digest(c), (
+            "SIGTERM-resumed run is not bit-identical to a straight run")
+
+    def test_sigkill_leaves_checkpoints_loadable_and_resumable(self,
+                                                               tmp_path):
+        ck = str(tmp_path / "ck")
+        p = _spawn(["kill9", ck, 9])
+        log_text, _ = p.communicate(timeout=300)
+        assert p.returncode == -signal.SIGKILL, (
+            f"worker was not SIGKILLed:\n{log_text}")
+        steps = list_checkpoints(ck)
+        assert steps, "no checkpoint committed before the kill"
+        for s in steps:
+            assert verify_checkpoint(ck, s), f"step {s} torn by SIGKILL"
+        # recovery completes the run from the newest intact checkpoint
+        x, y = dw.build_data()
+        net = dw.build_net()
+        t = FaultTolerantTrainer(net, ck, save_every_epoch=True)
+        t.fit(x, y, epochs=6, batch_size=16)
+        assert net.epoch_count == 6
+
+    def test_two_process_commit_marker_recovery(self, tmp_path):
+        # the gloo TCP transport occasionally aborts a rank outright on
+        # this oversubscribed CPU box (EnforceNotMet preamble race /
+        # coordination-heartbeat starvation → SIGABRT cascade) — an
+        # infra crash BEFORE the scenario under test even runs. Retry
+        # those bounded times; a genuine protocol failure (a worker
+        # exiting 1 after observing the wrong commit state) never
+        # retries.
+        for attempt in range(3):
+            ck = str(tmp_path / f"ck{attempt}")
+            os.makedirs(ck)
+            coord = f"127.0.0.1:{_free_port()}"
+            procs = [_spawn(["dist", coord, 2, pid, 4, ck])
+                     for pid in (0, 1)]
+            logs = []
+            for p in procs:
+                try:
+                    out, _ = p.communicate(timeout=300)
+                except subprocess.TimeoutExpired:
+                    for q in procs:
+                        q.kill()
+                    pytest.fail("distributed durable worker timed out")
+                logs.append(out)
+            if all(p.returncode == 0 for p in procs):
+                break
+            assert all(p.returncode != 1 for p in procs), (
+                "commit-protocol assertion failed in a worker:\n"
+                + "\n".join(logs))
+            assert attempt < 2, (
+                "workers kept dying on transport crashes:\n"
+                + "\n".join(logs))
+
+        # step 2 has BOTH shards on disk but no marker: invisible
+        assert durable.verify_state_dir(os.path.join(ck, "step_2",
+                                                     "shard_0"))
+        assert read_commit(os.path.join(ck, "step_2")) is None
+        assert durable.latest_committed_step(ck) == 1
+
+        # both ranks resume from step 1, with identical (replicated) state
+        nets = []
+        for r in (0, 1):
+            n = dw.build_net(seed=4)
+            assert restore_distributed_checkpoint(n, ck, rank=r,
+                                                  world=2) == 1
+            assert n.iteration_count == 3
+            nets.append(n)
+        assert dw.params_digest(nets[0]) == dw.params_digest(nets[1])
