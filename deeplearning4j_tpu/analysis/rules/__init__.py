@@ -23,6 +23,8 @@ from deeplearning4j_tpu.analysis.rules.hygiene import (
 from deeplearning4j_tpu.analysis.rules.retry_loop import UnboundedRetryRule
 from deeplearning4j_tpu.analysis.rules.state_write import (
     NonAtomicStateWriteRule)
+from deeplearning4j_tpu.analysis.rules.world_snapshot import (
+    WorldSnapshotRule)
 
 ALL_RULES: List[Rule] = [
     HostSyncRule(),
@@ -35,6 +37,7 @@ ALL_RULES: List[Rule] = [
     MutableDefaultRule(),
     UnboundedRetryRule(),
     NonAtomicStateWriteRule(),
+    WorldSnapshotRule(),
 ]
 
 RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in ALL_RULES}

@@ -1,0 +1,105 @@
+"""stale-world-snapshot: world-topology reads captured at import time.
+
+``jax.process_count()`` / ``jax.process_index()`` / ``jax.device_count()``
+(and friends) answer "what does the CURRENT runtime look like" — under
+elastic membership (resilience/elastic.py) the answer changes every
+re-mesh: a survivor tears down jax.distributed, re-initializes with a
+new world size, and its process id is re-assigned. A value captured at
+module scope (``WORLD = jax.process_count()``), in a class body, or in a
+function's default argument is evaluated ONCE at import/definition time
+and silently wrong for the rest of the process after the first re-mesh —
+the worst kind of wrong: shard math that still adds up, on the wrong
+rows.
+
+Flagged: a call to one of the world-topology reads whose evaluation
+happens at import/definition time —
+
+- at module scope or class-body scope (no enclosing function), or
+- inside the default-argument expressions of a module/class-level
+  ``def`` or ``lambda`` (defaults evaluate when the definition runs,
+  not per call).
+
+Call-time reads — inside a function body, a method, a lambda body —
+are exactly right (``parallel/distributed.py``'s helpers re-read the
+runtime on every call) and never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from deeplearning4j_tpu.analysis.core import (
+    Finding, ModuleInfo, Rule, SEVERITY_WARNING)
+
+#: world-topology reads whose value a re-mesh invalidates
+_WORLD_READS = {
+    "jax.process_count",
+    "jax.process_index",
+    "jax.device_count",
+    "jax.local_device_count",
+    "jax.devices",
+    "jax.local_devices",
+    "deeplearning4j_tpu.parallel.distributed.process_count",
+    "deeplearning4j_tpu.parallel.distributed.process_index",
+}
+
+
+def _nearest_function(mod: ModuleInfo,
+                      node: ast.AST) -> Optional[ast.AST]:
+    for a in mod.ancestors(node):
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            return a
+    return None
+
+
+def _in_defaults(fn: ast.AST, node: ast.AST) -> bool:
+    """True if ``node`` sits in the default-argument expressions of
+    ``fn`` (evaluated at definition time, not call time)."""
+    args = getattr(fn, "args", None)
+    if args is None:
+        return False
+    for d in list(args.defaults) + [d for d in args.kw_defaults
+                                    if d is not None]:
+        for sub in ast.walk(d):
+            if sub is node:
+                return True
+    return False
+
+
+class WorldSnapshotRule(Rule):
+    id = "stale-world-snapshot"
+    severity = SEVERITY_WARNING
+    description = ("jax.process_count()/process_index()/device_count() "
+                   "captured at module/class scope or in argument "
+                   "defaults — stale after an elastic re-mesh; read the "
+                   "runtime at call time instead")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        if not (mod.imports_module("jax") or
+                mod.imports_module("deeplearning4j_tpu.parallel")):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = mod.resolve(node.func)
+            if name not in _WORLD_READS:
+                continue
+            fn = _nearest_function(mod, node)
+            if fn is None:
+                where = "module/class scope"
+            elif _in_defaults(fn, node) \
+                    and _nearest_function(mod, fn) is None:
+                # defaults evaluate when the def/lambda expression runs
+                # — import time for a module/class-level definition
+                where = "argument defaults"
+            else:
+                continue  # call-time read: correct
+            yield self.finding(
+                mod, node,
+                f"`{name}()` captured at {where}: evaluated once at "
+                f"import/definition time and stale after the first "
+                f"elastic re-mesh (world size and process ids change "
+                f"per membership generation) — move the read to call "
+                f"time")

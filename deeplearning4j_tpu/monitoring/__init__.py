@@ -52,4 +52,9 @@ def ensure_started() -> None:
         from deeplearning4j_tpu.resilience.durable import (
             declare_checkpoint_series)
         declare_checkpoint_series()
+        # elastic membership series (resilience/elastic.py): a scrape on
+        # a never-re-meshed fleet still shows generation/member gauges
+        from deeplearning4j_tpu.resilience.elastic import (
+            declare_elastic_series)
+        declare_elastic_series()
         _started = True

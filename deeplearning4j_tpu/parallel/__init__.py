@@ -13,6 +13,10 @@ from deeplearning4j_tpu.parallel.mesh import (  # noqa: F401
 )
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper  # noqa: F401
 from deeplearning4j_tpu.parallel.inference import ParallelInference  # noqa: F401
+from deeplearning4j_tpu.parallel.elastic import (  # noqa: F401
+    ElasticConfig,
+    ElasticTrainer,
+)
 from deeplearning4j_tpu.parallel.pipeline import (  # noqa: F401
     pipeline_apply,
     pipeline_train_step,

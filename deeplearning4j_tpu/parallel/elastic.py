@@ -1,0 +1,571 @@
+"""ElasticTrainer: multi-host SPMD training that survives host loss.
+
+The missing half of ROADMAP item 2 over PR 7's durable substrate. The
+single-generation multi-host story (tests/distributed_worker.py) is:
+``jax.distributed.initialize`` → global mesh → every host feeds its
+shard → XLA allreduces. That world is rigid — one lost host SIGABRTs
+every peer via the coordination service, and the job is gone. This
+trainer wraps the same SPMD step in the elastic membership loop
+(resilience/elastic.py):
+
+    establish generation ──▶ restore from latest_committed_step
+         ▲                         │
+         │                         ▼
+    agree gen N+1 ◀── detect ◀── train shard / heartbeat / commit
+    (tear down,        (lease expiry, hung or failed
+     re-initialize,     allreduce, commit timeout,
+     re-mesh)           join lease at a commit boundary)
+
+Key invariants:
+
+- **Every survivor resumes from ``latest_committed_step``** after a
+  re-mesh. Params are replicated, so any committed shard restores the
+  full state; nothing a dead generation computed past its last commit
+  survives — which is exactly what makes the survivor's continuation
+  bit-identical to a fresh single(world)-process run resumed from the
+  same committed step (the gloo suite pins this by sha256).
+- **Scale-in is detected asynchronously** (a lost host can't be halfway
+  through dispatching), via lease expiry before dispatch or via the
+  dispatch watchdog: a peer SIGKILLed mid-allreduce leaves the
+  collective hung (or erroring), the watchdog fires, and the ledger
+  confirms who died. An error/timeout WITHOUT a confirmed loss
+  re-raises — it was a real failure, not membership.
+- **Scale-out is decided at commit boundaries only**, and ONLY by the
+  generation's process 0, which publishes the successor record BEFORE
+  the COMMIT marker. Every rank checks for a successor right after the
+  commit barrier — the barrier is the fleet's existing rendezvous, so
+  all ranks leave the generation at the same step and nobody dispatches
+  an allreduce a departed peer will never join (the deadlock a
+  per-step, per-rank join check would invite).
+- **Deterministic sharding**: a host's rows are a pure function of
+  (step, global batch, generation record) — ``host_shard_bounds``'s
+  largest-even-split over the batch-cycling schedule — so any
+  membership can recompute who feeds what with no negotiation.
+
+The trainer owns a bare train-step loop (the scale-out shape of
+tests/durable_worker.py), not the listener-rich ``net.fit``: elastic
+membership is about the fleet around the step, and the canonical step
+function (``net._get_train_step``) is shared with every other fit path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.resilience.chaos import fire
+from deeplearning4j_tpu.resilience.durable import (
+    CommitTimeoutError, latest_committed_step, read_commit)
+from deeplearning4j_tpu.resilience.elastic import (
+    GenerationDead, GenerationRecord, LeaseLedger, MembershipChanged,
+    agree_next_generation, declare_elastic_series, detect_membership,
+    free_port)
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class ElasticConfig:
+    """Knobs for one elastic training job (all hosts must agree on
+    everything except ``rank``)."""
+
+    ledger_root: str  # shared dir for leases + generation records
+    checkpoint_dir: str  # shared dir for distributed commits
+    rank: int  # this host's stable GLOBAL rank
+    #: gen-0 membership (global ranks). Rank min(bootstrap_members)
+    #: publishes generation 0; everyone else adopts it. A host NOT in
+    #: the bootstrap set joins by lease (scale-out).
+    bootstrap_members: Sequence[int] = (0,)
+    #: "host:port" for generation 0 (later generations pick their own);
+    #: None = loopback + a free port (single-host/test fleets).
+    bootstrap_coordinator: Optional[str] = None
+    lease_ttl: float = 5.0
+    heartbeat_interval: Optional[float] = None  # default ttl/3
+    #: watchdog around each allreduce dispatch: a hung collective past
+    #: this is treated as a membership event (confirmed via the ledger)
+    dispatch_timeout: float = 30.0
+    #: grace to wait for a lease to expire when a dispatch ERRORS before
+    #: the ttl has had time to pass (gloo reports a died peer's closed
+    #: socket in milliseconds); None = lease_ttl + 1
+    confirm_grace: Optional[float] = None
+    remesh_timeout: float = 60.0
+    publish_stagger: float = 0.25
+    commit_every: int = 10
+    commit_timeout: float = 60.0
+    advertise_host: str = "127.0.0.1"
+
+    def __post_init__(self):
+        if self.commit_every < 1:
+            raise ValueError("commit_every must be >= 1")
+        if int(self.rank) < 0:
+            raise ValueError("rank must be >= 0")
+
+
+class ElasticTrainer:
+    """Train a (seed-identical on every host) network across an elastic
+    multi-host fleet; see the module docstring for the protocol.
+
+    ``step_chaos`` is the chaos seam (one ``chaos.fire`` event per
+    global step BEFORE its dispatch): ``HostLossInjector`` /
+    ``LeaseStallInjector`` plug in here for the gloo kill/hang suites.
+    """
+
+    def __init__(self, net, config: ElasticConfig, step_chaos=None):
+        self.net = net
+        self.config = config
+        self.step_chaos = step_chaos
+        self.ledger = LeaseLedger(
+            config.ledger_root, config.rank, ttl=config.lease_ttl,
+            interval=config.heartbeat_interval,
+            advertise_host=config.advertise_host)
+        self.record: Optional[GenerationRecord] = None
+        self.remeshes = 0
+        self.last_remesh_seconds: Optional[float] = None
+        self.last_restored_step: Optional[int] = None
+        self._step = 0
+        self._runtime_live = False  # jax.distributed currently up
+        self._dirty = False  # a previous generation's backend existed
+        (self._g_generation, self._g_members, self._c_remesh,
+         self._c_lost, self._h_remesh) = declare_elastic_series()
+        if not net._initialized:
+            net.init()
+
+    # ------------------------------------------------------------------
+    # membership / runtime lifecycle
+    # ------------------------------------------------------------------
+    def _establish(self) -> GenerationRecord:
+        """Adopt (or bootstrap) the current generation; joiners wait for
+        admission. Returns an activated record."""
+        cfg = self.config
+        rec = self.ledger.latest_generation()
+        if rec is None:
+            members = sorted(int(m) for m in cfg.bootstrap_members)
+            if cfg.rank == members[0]:
+                coord = cfg.bootstrap_coordinator or \
+                    f"{cfg.advertise_host}:{free_port(cfg.advertise_host)}"
+                rec = self.ledger.publish_generation(GenerationRecord(
+                    generation=0, members=members, coordinator=coord,
+                    published_by=cfg.rank))
+            else:
+                rec = self.ledger.wait_for_generation(
+                    0, timeout=cfg.remesh_timeout)
+        while not rec.contains(cfg.rank):
+            # a join request is just our heartbeat being alive: wait for
+            # the incumbents to fold us into a successor generation
+            log.info("rank %d waiting for admission past generation %d",
+                     cfg.rank, rec.generation)
+            rec = self.ledger.wait_for_generation(
+                rec.generation + 1, timeout=cfg.remesh_timeout)
+        self._activate(rec)
+        return rec
+
+    def _host_park_net(self) -> None:
+        """Materialize the net's training state as host numpy: every
+        device array created before a backend reset is dead after it —
+        this must run BEFORE any backend rebuild, whether the previous
+        backend was a dead generation's or the implicit single-process
+        one ``net.init()`` built before the first generation came up."""
+        from deeplearning4j_tpu.resilience.durable import snapshot_tree
+        net = self.net
+        net.params = snapshot_tree(net.params)
+        net.state = snapshot_tree(net.state)
+        net.updater_state = snapshot_tree(net.updater_state)
+        if getattr(net, "_rng", None) is not None:
+            net._rng = np.asarray(net._rng)
+
+    def _activate(self, rec: GenerationRecord) -> None:
+        """Bring the jax runtime up for a generation. world=1 runs with
+        no coordination service at all — the whole point of scale-in
+        surviving the coordinator's death."""
+        from deeplearning4j_tpu.parallel import distributed as dist
+        cfg = self.config
+        pid = rec.process_id_of(cfg.rank)
+        if rec.world > 1:
+            # the backend (even a fresh process's: net.init() built a
+            # single-process one) predates this generation's
+            # coordination service — park state on host, rebuild
+            self._host_park_net()
+            dist.reset_backend(collectives="gloo")
+            self._dirty = True
+            dist.elastic_initialize(rec.coordinator, rec.world, pid,
+                                    initialization_timeout=cfg.remesh_timeout)
+            self._runtime_live = True
+        if self._dirty:
+            # compiled steps traced against a previous backend's devices;
+            # a never-reset world-of-one keeps its warm cache (steady
+            # state stays zero-retrace, and so does a later fit_steps
+            # call on an already-activated world — hence the reset below)
+            cache = getattr(self.net, "_jit_cache", None)
+            if cache is not None:
+                cache.clear()
+            self._dirty = False
+        self.record = rec
+        self.ledger.heartbeat(rec.generation)
+        self._g_generation.set(rec.generation)
+        self._g_members.set(rec.world)
+        log.info("rank %d active in generation %d: world=%d process_id=%d "
+                 "coordinator=%s", cfg.rank, rec.generation, rec.world,
+                 pid, rec.coordinator)
+
+    def _teardown(self) -> None:
+        """Leave the current generation's runtime behind (never blocks
+        on remote state — the peers may be dead)."""
+        from deeplearning4j_tpu.parallel import distributed as dist
+        self._host_park_net()
+        if self._runtime_live:
+            dist.teardown_dead_generation()
+            self._runtime_live = False
+        else:
+            # world-of-one: no coordination service, but compiled traces
+            # and device arrays still bind the old backend
+            dist.reset_backend(collectives="none")
+        self._dirty = True
+
+    def _remesh(self, prev: GenerationRecord,
+                event: MembershipChanged) -> GenerationRecord:
+        """The one re-mesh path for scale-in AND scale-out: tear down,
+        agree on the successor, activate it."""
+        cfg = self.config
+        t0 = time.perf_counter()
+        if event.lost_ranks:
+            self._c_lost.inc(len(event.lost_ranks))
+        log.warning("re-mesh (%s): %s", event.cause, event)
+        self._teardown()
+        rec = prev
+        deadline = time.monotonic() + cfg.remesh_timeout
+        while True:
+            rec = agree_next_generation(self.ledger, rec,
+                                        stagger=cfg.publish_stagger,
+                                        timeout=cfg.remesh_timeout)
+            if not rec.contains(cfg.rank):
+                # the fleet re-meshed WITHOUT us (our lease looked dead
+                # — e.g. heartbeats stalled behind a slow disk). Our
+                # live lease is already a join request; wait to be folded
+                # into a later generation instead of fighting this one.
+                log.warning("excluded from generation %d; waiting for "
+                            "re-admission", rec.generation)
+                rec = self.ledger.wait_for_generation(
+                    rec.generation + 1,
+                    timeout=max(0.0, deadline - time.monotonic()))
+                continue
+            # a successor published by a member that died before anyone
+            # could adopt it (e.g. the committer between record and
+            # marker) is dead on arrival: bump again rather than hanging
+            # initialize on a dead coordinator
+            delta = detect_membership(self.ledger, rec)
+            if not delta.lost:
+                break
+            log.warning("generation %d dead on arrival (lost %s); "
+                        "bumping again", rec.generation, delta.lost)
+        self._activate(rec)
+        self.remeshes += 1
+        self.last_remesh_seconds = time.perf_counter() - t0
+        self._c_remesh.inc(cause=event.cause)
+        self._h_remesh.observe(self.last_remesh_seconds)
+        return rec
+
+    # ------------------------------------------------------------------
+    # detection helpers
+    # ------------------------------------------------------------------
+    def _confirm_loss(self, rec: GenerationRecord,
+                      reason: str) -> Optional[MembershipChanged]:
+        """A dispatch or commit failed/timed out: is it membership? Poll
+        the ledger up to the confirm grace for an expired member lease —
+        gloo reports a dead peer's closed socket in milliseconds, long
+        before the lease ttl can elapse. Also watch for a SUCCESSOR
+        generation: a peer that (wrongly — e.g. this host's heartbeat
+        writes stalled behind a slow disk) declared US dead has already
+        re-meshed without us, our collective will never complete, and
+        the way back in is the join path, not a retry. No confirmed
+        loss and no successor → None (the failure was real; the caller
+        re-raises it)."""
+        cfg = self.config
+        grace = cfg.confirm_grace if cfg.confirm_grace is not None \
+            else cfg.lease_ttl + 1.0
+        deadline = time.monotonic() + grace
+        while True:
+            delta = detect_membership(self.ledger, rec)
+            if delta.lost:
+                return GenerationDead(rec.generation, delta.lost, reason,
+                                      joined=delta.joined)
+            nxt = self.ledger.read_generation(rec.generation + 1)
+            if nxt is not None:
+                return MembershipChanged(
+                    rec.generation,
+                    f"peers moved to generation {nxt.generation} "
+                    f"({reason})", joined=delta.joined)
+            if time.monotonic() > deadline:
+                return None
+            time.sleep(min(0.1, cfg.lease_ttl / 4))
+
+    def _check_scale_in(self, rec: GenerationRecord) -> None:
+        """Pre-dispatch lease check: only LOSSES act here (join admission
+        is a commit-boundary decision by process 0 — see module doc). An
+        expired lease is re-read once after a beat before it counts: a
+        heartbeat briefly stalled behind a slow disk recovers on its
+        next write, and a false scale-in costs the whole fleet a
+        re-mesh."""
+        delta = detect_membership(self.ledger, rec)
+        if not delta.lost:
+            return
+        time.sleep(min(0.3, self.config.lease_ttl / 4))
+        delta = detect_membership(self.ledger, rec)
+        if delta.lost:
+            raise GenerationDead(rec.generation, delta.lost,
+                                 "lease expired", joined=delta.joined)
+
+    def _check_successor(self, rec: GenerationRecord) -> None:
+        """Post-commit check: process 0 published a successor record
+        (scale-out admission) before the COMMIT marker, so every rank
+        that passed the barrier is guaranteed to see it."""
+        nxt = self.ledger.read_generation(rec.generation + 1)
+        if nxt is not None:
+            joined = [m for m in nxt.members if not rec.contains(m)]
+            raise MembershipChanged(rec.generation,
+                                    "successor generation published",
+                                    joined=joined)
+
+    # ------------------------------------------------------------------
+    # the train loop
+    # ------------------------------------------------------------------
+    def fit_steps(self, x, y, n_steps: int,
+                  global_batch_size: Optional[int] = None):
+        """Train ``n_steps`` global SPMD steps over a deterministic
+        batch-cycling schedule of (x, y), surviving any number of
+        membership changes. Returns the net with final params applied.
+
+        Every host passes the SAME full (x, y) (the Spark-RDD analogue:
+        the dataset is addressable everywhere; which rows a host
+        *materializes on device* is its shard of the current
+        generation). ``global_batch_size`` defaults to ``len(x)`` and
+        must divide it."""
+        from deeplearning4j_tpu import monitoring
+        monitoring.ensure_started()
+        x = np.asarray(x)
+        y = np.asarray(y)
+        gbs = int(global_batch_size or x.shape[0])
+        if x.shape[0] % gbs:
+            raise ValueError(f"global batch {gbs} must divide the "
+                             f"dataset ({x.shape[0]} rows)")
+        self.ledger.start()
+        try:
+            rec = self._establish()
+            while True:
+                try:
+                    self._run_generation(rec, x, y, int(n_steps), gbs)
+                    return self.net
+                except MembershipChanged as e:
+                    rec = self._remesh(rec, e)
+        finally:
+            self.ledger.stop()
+
+    def _restore_committed(self, rec: GenerationRecord) -> int:
+        """Resume from ``latest_committed_step`` (0 = fresh start).
+        Params are replicated, so this generation's process id picks its
+        old shard when one exists and any intact shard (0) otherwise —
+        a joiner that never wrote a shard restores the fleet's state all
+        the same."""
+        from deeplearning4j_tpu.util.checkpoint import (
+            restore_distributed_checkpoint)
+        cfg = self.config
+        step = latest_committed_step(cfg.checkpoint_dir)
+        if step is None:
+            self.last_restored_step = None
+            return 0
+        import os
+        commit = read_commit(os.path.join(cfg.checkpoint_dir,
+                                          f"step_{step}")) or {}
+        cw = int(commit.get("world", rec.world))
+        pid = rec.process_id_of(cfg.rank)
+        shard = pid if pid < cw else 0
+        restored = restore_distributed_checkpoint(
+            self.net, cfg.checkpoint_dir, rank=shard, world=cw, step=step)
+        self.last_restored_step = restored
+        log.info("rank %d restored committed step %d (shard %d of "
+                 "world %d)", cfg.rank, restored, shard, cw)
+        return int(restored)
+
+    def _run_generation(self, rec: GenerationRecord, x, y,
+                        n_steps: int, gbs: int) -> None:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from deeplearning4j_tpu.parallel import distributed as dist
+        cfg = self.config
+        net = self.net
+        pid = rec.process_id_of(cfg.rank)
+        start = self._restore_committed(rec)
+        if start >= n_steps:
+            return
+        mesh = dist.global_mesh()
+        rep = NamedSharding(mesh, P())
+
+        def replicate(tree):
+            """Replicated placement WITHOUT a broadcast: every process
+            holds the same host values by construction (same seed, or
+            the same committed checkpoint), so each assembles the
+            replicated array from its local copy. A multi-host
+            ``jax.device_put(tree, P())`` would instead emit one async
+            broadcast collective per leaf with no data dependencies
+            between them — two processes can execute those in different
+            orders and cross the gloo streams (observed as
+            ``op.preamble.length <= op.nbytes`` aborts at generation
+            startup)."""
+            return jax.tree_util.tree_map(
+                lambda a: jax.make_array_from_process_local_data(
+                    rep, np.ascontiguousarray(a)), tree)
+
+        params = replicate(net.params)
+        state = replicate(net.state)
+        upd = replicate(net.updater_state)
+        step_fn = net._get_train_step(False)
+        # NamedSharding refuses an axis the mesh doesn't divide evenly,
+        # so each generation trains on the largest per-device-even prefix
+        # of the batch window (the ParallelWrapper._host_trim rule:
+        # remainders are DROPPED, loudly — an elastic fleet must absorb
+        # a 4→3 re-mesh, not crash on 16 % 3). eff is a pure function of
+        # (gbs, generation record): every member computes the same trim.
+        n_dev = int(np.prod(mesh.devices.shape))
+        eff = (gbs // n_dev) * n_dev
+        if eff == 0:
+            raise ValueError(
+                f"global batch {gbs} smaller than the generation's "
+                f"{n_dev} devices — nothing to shard")
+        if eff != gbs:
+            log.warning(
+                "generation %d: global batch %d not divisible by its %d "
+                "devices; training on the first %d rows of each batch "
+                "window this generation", rec.generation, gbs, n_dev, eff)
+        lo, hi = dist.host_shard_bounds(eff, rank=pid, world=rec.world)
+        n_rows = x.shape[0]
+
+        def _sync_net(step: int) -> None:
+            net.params, net.state, net.updater_state = params, state, upd
+            net.iteration_count = int(step)
+
+        for step in range(start, n_steps):
+            self._step = step
+            fire(self.step_chaos, step)
+            self._check_scale_in(rec)
+            b0 = (step * gbs) % n_rows
+            gx = dist.make_global_array(x[b0 + lo:b0 + hi], mesh)
+            gy = dist.make_global_array(y[b0 + lo:b0 + hi], mesh)
+            rng = net._next_rng()
+            out = self._dispatch_watched(
+                rec, lambda: jax.block_until_ready(
+                    step_fn(params, state, upd, gx, gy, rng, None, None)))
+            params, state, upd, loss = out
+            net.score_value = loss
+            if (step + 1) % cfg.commit_every == 0 or step + 1 == n_steps:
+                _sync_net(step + 1)
+                self._commit(rec, step + 1)
+                self._check_successor(rec)
+        _sync_net(n_steps)
+
+    def _dispatch_watched(self, rec: GenerationRecord, dispatch):
+        """Run one allreduce dispatch under the watchdog. A peer that
+        dies mid-collective leaves the dispatch hung (gloo may also
+        surface a closed-socket error) — map both onto the ledger:
+        confirmed loss → GenerationDead; otherwise the failure is real
+        and propagates. The hung thread is abandoned (daemon); the
+        teardown that follows drops the backend it is blocked in."""
+        cfg = self.config
+        result: Dict[str, Any] = {}
+        done = threading.Event()
+
+        def _run():
+            try:
+                result["out"] = dispatch()
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                result["err"] = e
+            done.set()
+
+        t = threading.Thread(target=_run, daemon=True,
+                             name="elastic-dispatch")
+        t.start()
+        if not done.wait(cfg.dispatch_timeout):
+            dead = self._confirm_loss(
+                rec, f"allreduce hung > {cfg.dispatch_timeout}s")
+            if dead is not None:
+                raise dead
+            raise TimeoutError(
+                f"dispatch exceeded {cfg.dispatch_timeout}s with every "
+                f"member lease live — not a membership event")
+        if "err" in result:
+            dead = self._confirm_loss(
+                rec, f"allreduce failed: {result['err']!r}")
+            if dead is not None:
+                raise dead from result["err"]
+            raise result["err"]
+        return result["out"]
+
+    def _commit(self, rec: GenerationRecord, step: int) -> None:
+        """Distributed commit at a step boundary; process 0 additionally
+        folds pending join leases into a successor generation record,
+        published BEFORE the COMMIT marker (see _check_successor)."""
+        from deeplearning4j_tpu.util.checkpoint import (
+            save_distributed_checkpoint)
+        from deeplearning4j_tpu.resilience.elastic import (
+            plan_next_generation)
+        cfg = self.config
+        pid = rec.process_id_of(cfg.rank)
+        try:
+            if pid == 0:
+                # write our shard + barrier on the others, but delay the
+                # marker until the scale-out decision is on disk
+                save_distributed_checkpoint(
+                    self.net, cfg.checkpoint_dir, step=step, rank=0,
+                    world=rec.world, timeout=cfg.commit_timeout,
+                    wait=False, publish=False)
+                delta = detect_membership(self.ledger, rec)
+                if delta.joined:
+                    lease = self.ledger.read_lease(
+                        min(set(delta.joined) | set(rec.members))) or {}
+                    self.ledger.publish_generation(plan_next_generation(
+                        rec, sorted(set(rec.members) | set(delta.joined)),
+                        cfg.rank,
+                        advertise_host=lease.get("host") or
+                        cfg.advertise_host))
+                from deeplearning4j_tpu.resilience.durable import (
+                    publish_commit)
+                import os
+                publish_commit(os.path.join(cfg.checkpoint_dir,
+                                            f"step_{step}"),
+                               step=step, world=rec.world,
+                               timeout=cfg.commit_timeout)
+            else:
+                save_distributed_checkpoint(
+                    self.net, cfg.checkpoint_dir, step=step, rank=pid,
+                    world=rec.world, timeout=cfg.commit_timeout,
+                    wait=True)
+        except CommitTimeoutError as e:
+            dead = self._confirm_loss(rec, f"commit barrier timeout "
+                                           f"at step {step}")
+            if dead is not None:
+                raise dead from e
+            raise
+        log.info("rank %d committed step %d (generation %d)",
+                 cfg.rank, step, rec.generation)
+
+    # ------------------------------------------------------------------
+    def health(self) -> Dict[str, Any]:
+        """Ops surface: current membership + re-mesh history (the
+        dl4jtpu_elastic_* series carry the same facts registry-side)."""
+        rec = self.record
+        return {
+            "rank": self.config.rank,
+            "generation": None if rec is None else rec.generation,
+            "world": None if rec is None else rec.world,
+            "members": None if rec is None else list(rec.members),
+            "process_id": None if rec is None
+            else rec.process_id_of(self.config.rank),
+            "step": self._step,
+            "remeshes": self.remeshes,
+            "last_remesh_seconds": self.last_remesh_seconds,
+            "last_restored_step": self.last_restored_step,
+            "lease_stalled": self.ledger.stalled,
+        }

@@ -25,18 +25,33 @@ Spark task retry):
   writer, the SIGTERM PreemptionGuard + dispatch-boundary hook, and the
   multi-process shard/COMMIT protocol (util/checkpoint.py is built on
   it; tests/test_durable.py is its chaos suite).
+- ``elastic``: the membership layer over the durable substrate — a
+  filesystem lease ledger with monotonically numbered membership
+  generations, failure detection (lease expiry = death AND hang), and
+  the split-brain-safe successor-generation agreement
+  ``parallel.ElasticTrainer`` re-meshes from. A lost host becomes a
+  chaos event the fleet absorbs: survivors tear down jax.distributed,
+  re-initialize the new world, and resume bit-exactly from
+  ``latest_committed_step``.
 
-See ARCHITECTURE.md "Resilience" and "Durable state".
+See ARCHITECTURE.md "Resilience", "Durable state" and "Elastic
+membership".
 """
 
 from deeplearning4j_tpu.resilience.durable import (
-    AsyncCheckpointWriter, CheckpointError, CorruptCheckpointError,
+    AsyncCheckpointWriter, CheckpointError, CommitTimeoutError,
+    CorruptCheckpointError,
     PreemptionExit, PreemptionGuard)
+from deeplearning4j_tpu.resilience.elastic import (
+    GenerationDead, GenerationRecord, LeaseLedger, MembershipChanged)
 from deeplearning4j_tpu.resilience.retry import RetryPolicy, retry_call
 from deeplearning4j_tpu.resilience.sentinel import (
     effective_policy, set_default_nonfinite_policy)
 
 __all__ = ["AsyncCheckpointWriter", "CheckpointError",
-           "CorruptCheckpointError", "PreemptionExit", "PreemptionGuard",
+           "CommitTimeoutError",
+           "CorruptCheckpointError", "GenerationDead", "GenerationRecord",
+           "LeaseLedger", "MembershipChanged",
+           "PreemptionExit", "PreemptionGuard",
            "RetryPolicy", "retry_call", "effective_policy",
            "set_default_nonfinite_policy"]

@@ -38,7 +38,8 @@ import numpy as np
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterators import DataSetIterator
 
-__all__ = ["ChaosIterator", "InjectedFault", "LatencyIterator",
+__all__ = ["ChaosIterator", "HostLossInjector", "InjectedFault",
+           "LatencyIterator", "LeaseStallInjector",
            "NaNPoisonIterator", "PageExhaustionInjector",
            "PreemptionIterator", "ProcessKillInjector", "RaiseOnBatch",
            "SimulatedPreemption", "fire"]
@@ -285,3 +286,82 @@ class ProcessKillInjector(ChaosIterator):
             # SIGKILL never returns; a catchable sig may — give the
             # handler a beat before the stream continues
             time.sleep(0.5)
+
+
+class HostLossInjector(ProcessKillInjector):
+    """RANK-TARGETED host loss: SIGKILL this process at global batch
+    ``n`` — but only when this process IS the targeted rank.
+
+    The multi-host adversary of the elastic membership layer
+    (resilience/elastic.py): every rank of a fleet runs the SAME
+    training script with the same injector config ("kill rank 1 at
+    batch 5"), exactly one process dies, and the survivors must detect
+    the expired lease, re-mesh, and resume from the committed step
+    (tests/test_elastic_multiprocess.py). ``rank`` is the process's own
+    stable GLOBAL rank (the lease identity — pass it explicitly; reading
+    ``jax.process_index()`` here would be a per-generation id that
+    changes across re-meshes). Drive it from an iterator pipeline like
+    any ChaosIterator, or request-level via ``chaos.fire`` with
+    ``base=None`` (one event per global training step — the
+    ElasticTrainer's ``step_chaos`` seam).
+
+    ``kill`` is the action seam (defaults to ``os.kill(getpid(), sig)``)
+    so single-process tests can prove the rank gating without dying."""
+
+    def __init__(self, base: Optional[DataSetIterator], n: int,
+                 target_rank: int, rank: int, sig: int = 9,
+                 delay: float = 0.0,
+                 kill: Optional[Callable[[int], None]] = None):
+        super().__init__(base, n, sig=sig, delay=delay)
+        self.target_rank = int(target_rank)
+        self.rank = int(rank)
+        self._kill = kill
+
+    def before_batch(self, index: int) -> None:
+        if self.rank != self.target_rank:
+            return  # not this host's day
+        if index >= self.n and self._fire():
+            if self.delay:
+                time.sleep(self.delay)
+            if self._kill is not None:
+                self._kill(self.sig)
+                return
+            import os
+            os.kill(os.getpid(), self.sig)
+            time.sleep(0.5)  # catchable-signal grace, as ProcessKill
+
+
+class LeaseStallInjector(ChaosIterator):
+    """Freeze a host's lease heartbeats WITHOUT killing the process at
+    global batch ``n`` — the hung-host simulation.
+
+    Death and hang must be testable separately: a SIGKILLed host stops
+    heartbeating because it is gone; a host wedged in a driver call (or
+    livelocked) stops heartbeating while its process — and any collective
+    it is half-way through — lives on. Peers see the identical signal
+    (an expired lease) and must re-mesh without it, which is exactly
+    what this injector proves. ``ledger`` is the process's own
+    ``LeaseLedger``; ``release()`` (or ``duration`` seconds) un-freezes
+    so recovery-of-the-hung-host scenarios can rejoin."""
+
+    def __init__(self, ledger, n: int, base: Optional[DataSetIterator]
+                 = None, once: bool = True,
+                 duration: Optional[float] = None):
+        super().__init__(base, once=once)
+        self.ledger = ledger
+        self.n = int(n)
+        self.duration = duration
+        self._stall_t0: Optional[float] = None
+
+    def before_batch(self, index: int) -> None:
+        if self._stall_t0 is not None and self.duration is not None and \
+                time.monotonic() >= self._stall_t0 + self.duration:
+            self.release()
+        if index >= self.n and self._fire():
+            self._stall_t0 = time.monotonic()
+            self.ledger.stall()
+
+    def release(self) -> None:
+        """Un-freeze the heartbeats (the hung host came back)."""
+        self._stall_t0 = None
+        self.ledger.resume()

@@ -57,7 +57,7 @@ import signal
 import threading
 import time
 import zlib
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -77,11 +77,14 @@ CKPT_BYTES = "dl4jtpu_checkpoint_bytes_total"
 CKPT_INFLIGHT = "dl4jtpu_checkpoint_inflight"
 CKPT_FAILURES = "dl4jtpu_checkpoint_failures_total"
 CKPT_CORRUPT_SKIPPED = "dl4jtpu_checkpoint_corrupt_skipped_total"
+CKPT_COMMIT_TIMEOUTS = "dl4jtpu_checkpoint_commit_timeouts_total"
 
 __all__ = [
-    "AsyncCheckpointWriter", "CKPT_BYTES", "CKPT_CORRUPT_SKIPPED",
+    "AsyncCheckpointWriter", "CKPT_BYTES", "CKPT_COMMIT_TIMEOUTS",
+    "CKPT_CORRUPT_SKIPPED",
     "CKPT_FAILURES", "CKPT_INFLIGHT", "CKPT_SAVE_SECONDS",
-    "CheckpointError", "CorruptCheckpointError", "FORMAT_VERSION",
+    "CheckpointError", "CommitTimeoutError", "CorruptCheckpointError",
+    "FORMAT_VERSION",
     "PreemptionExit", "PreemptionGuard", "atomic_replace_path",
     "atomic_write_bytes",
     "atomic_write_json", "atomic_write_text", "commit_marker_path",
@@ -104,10 +107,33 @@ class CorruptCheckpointError(CheckpointError):
     manifest, version mismatch, checksum mismatch, torn file)."""
 
 
+class CommitTimeoutError(CheckpointError):
+    """The distributed commit barrier timed out: shards never arrived
+    (rank 0, ``missing_ranks`` known) or the COMMIT marker never
+    appeared (non-zero ranks — the committer itself may have died).
+
+    Typed, with the step and the missing ranks attached, so an elastic
+    detector can tell "the committer/a shard-writer died" (cross-check
+    the lease ledger, declare the generation dead) from "the disk is
+    slow" (retry with a longer timeout) instead of pattern-matching a
+    message string. Counted in
+    ``dl4jtpu_checkpoint_commit_timeouts_total``."""
+
+    def __init__(self, message: str, step: int,
+                 missing_ranks: Optional[Sequence[int]] = None,
+                 timeout: Optional[float] = None):
+        super().__init__(message)
+        self.step = int(step)
+        self.missing_ranks = None if missing_ranks is None \
+            else sorted(int(r) for r in missing_ranks)
+        self.timeout = timeout
+
+
 def declare_checkpoint_series(registry: Optional[MetricsRegistry] = None):
     """Get-or-create the checkpoint telemetry series so a scrape taken
     before the first save already shows the schema. Returns
-    (save_seconds, bytes_total, inflight, failures, corrupt_skipped)."""
+    (save_seconds, bytes_total, inflight, failures, corrupt_skipped,
+    commit_timeouts)."""
     r = registry or global_registry()
     return (
         r.histogram(CKPT_SAVE_SECONDS,
@@ -119,6 +145,8 @@ def declare_checkpoint_series(registry: Optional[MetricsRegistry] = None):
         r.counter(CKPT_FAILURES, "Checkpoint saves that raised"),
         r.counter(CKPT_CORRUPT_SKIPPED,
                   "Corrupt/torn checkpoints skipped at restore time"),
+        r.counter(CKPT_COMMIT_TIMEOUTS,
+                  "Distributed commit barriers that timed out"),
     )
 
 
@@ -471,8 +499,8 @@ class AsyncCheckpointWriter:
         self.last_error: Optional[BaseException] = None
         self.failures = 0
         self.completed = 0
-        (self._save_hist, _, self._inflight, self._fail_counter, _
-         ) = declare_checkpoint_series(registry)
+        (self._save_hist, _, self._inflight, self._fail_counter,
+         *_rest) = declare_checkpoint_series(registry)
 
     # -- worker ----------------------------------------------------------
     def _ensure_thread(self) -> None:
@@ -795,8 +823,10 @@ def publish_commit(step_dir: str, step: int, world: int,
                    timeout: float = 60.0, poll: float = 0.05) -> None:
     """Rank 0's half of the barrier: wait for every shard to be present
     AND intact, then atomically publish the COMMIT marker. A worker that
-    died between shard write and barrier → timeout → CheckpointError,
-    and the step stays uncommitted (resume ignores it)."""
+    died between shard write and barrier → timeout →
+    ``CommitTimeoutError`` carrying the step + missing ranks (the
+    elastic detector's "who died mid-commit" signal), and the step stays
+    uncommitted (resume ignores it)."""
     step_dir = os.path.abspath(step_dir)
     deadline = time.monotonic() + timeout
     missing = list(range(world))
@@ -807,9 +837,11 @@ def publish_commit(step_dir: str, step: int, world: int,
         if not missing:
             break
         if time.monotonic() > deadline:
-            raise CheckpointError(
+            declare_checkpoint_series()[5].inc()
+            raise CommitTimeoutError(
                 f"distributed checkpoint step {step}: shards {missing} "
-                f"never arrived within {timeout}s — step NOT committed")
+                f"never arrived within {timeout}s — step NOT committed",
+                step=step, missing_ranks=missing, timeout=timeout)
         time.sleep(poll)
     bad = [r for r in range(world)
            if not verify_state_dir(os.path.join(step_dir,
@@ -826,18 +858,36 @@ def publish_commit(step_dir: str, step: int, world: int,
 
 
 def wait_commit(step_dir: str, timeout: float = 60.0,
-                poll: float = 0.05) -> Dict[str, Any]:
+                poll: float = 0.05,
+                world: Optional[int] = None) -> Dict[str, Any]:
     """Non-zero ranks' half of the barrier: block until rank 0 published
-    the COMMIT marker (or raise on timeout)."""
+    the COMMIT marker. Timeout raises ``CommitTimeoutError`` — the
+    committer (or a shard-writer it was waiting on) may be dead, which
+    an elastic caller distinguishes from slow disk by cross-checking the
+    lease ledger. With ``world`` the error names the ranks whose shards
+    are absent on disk (rank 0 among the missing ⇒ the committer itself
+    never finished its shard)."""
+    step_dir = os.path.abspath(step_dir)
     deadline = time.monotonic() + timeout
     while True:
         c = read_commit(step_dir)
         if c is not None:
             return c
         if time.monotonic() > deadline:
-            raise CheckpointError(
+            tail = os.path.basename(step_dir).rsplit("_", 1)[-1]
+            step = int(tail) if tail.isdigit() else -1
+            missing = None
+            if world is not None:
+                missing = [r for r in range(int(world))
+                           if not os.path.exists(os.path.join(
+                               step_dir, shard_dir_name(r),
+                               MANIFEST_NAME))]
+            declare_checkpoint_series()[5].inc()
+            raise CommitTimeoutError(
                 f"no COMMIT marker appeared under {step_dir} within "
-                f"{timeout}s")
+                f"{timeout}s" + (f" (shards absent: {missing})"
+                                 if missing else ""),
+                step=step, missing_ranks=missing, timeout=timeout)
         time.sleep(poll)
 
 

@@ -1,0 +1,543 @@
+"""Elastic membership: lease ledger, membership generations, failure
+detection, and the re-mesh plan.
+
+PR 7 made multi-host training *durable*: the distributed commit protocol
+guarantees that resume only ever sees fully committed steps, whatever a
+worker's death tore mid-save. This module is the scheduler half ROADMAP
+item 2 names: *detect* the lost host, agree on the new membership, and
+hand every survivor a plan it can re-mesh from — without asking the very
+coordination service whose death IS the failure mode. jax.distributed's
+gRPC coordination service reacts to a lost peer by terminating every
+other task (client.h: "Terminating process because the JAX distributed
+service detected fatal errors"), so the membership layer must live
+OUTSIDE it. It lives on the filesystem instead, on the same atomic
+tmp→fsync→rename primitives util/checkpoint.py's crash-consistent
+format is built on (the shared dir is the one dependency every host
+already has — it is where the checkpoints live):
+
+- **Leases** (``lease_<rank>.json``): every host heartbeats a lease
+  under its GLOBAL rank — a stable identity that survives re-meshes,
+  unlike the per-generation contiguous process id jax needs. A lease
+  older than ``ttl`` is expired; an expired member is a lost host. A
+  live lease from a NON-member is a join request (a preempted host came
+  back). Both are just membership deltas — scale-in and scale-out
+  through one code path.
+- **Generations** (``gen_<n>.json``): a monotonically numbered
+  membership record: the sorted global-rank member list (list index =
+  the member's contiguous jax process id) and the coordinator address
+  for ``jax.distributed.initialize``. Generation files are immutable and
+  EXCLUSIVE-created (``os.link``, which fails on an existing name,
+  unlike the overwriting ``os.replace``): when two survivors race to
+  publish generation N+1, exactly one record wins and both adopt it —
+  the split-brain tiebreak. Publication order is staggered by survivor
+  rank so the LOWEST surviving rank publishes first by construction;
+  the link-race is the safety net, not the mechanism.
+- **Detection** (``detect_membership``): lost = members whose lease
+  expired; joined = live non-members. A hung collective (peer SIGKILLed
+  mid-allreduce simply never arrives — the dispatch blocks forever) and
+  a peer that died politely both surface the same way: its lease stops.
+  The trainer wraps every allreduce dispatch in a watchdog timeout and
+  maps BOTH a timeout and a collective error onto a ledger check —
+  ``GenerationDead`` only if the ledger confirms a lost member,
+  otherwise the error was real and re-raises.
+
+``parallel/elastic.py``'s ``ElasticTrainer`` drives the full loop:
+heartbeat → detect → tear down jax.distributed → adopt generation N+1 →
+re-initialize → re-mesh → resume every survivor bit-exactly from
+``latest_committed_step``.
+
+Telemetry (global registry; declared by ``declare_elastic_series``):
+
+- ``dl4jtpu_elastic_generation`` (gauge): current membership generation
+- ``dl4jtpu_elastic_members`` (gauge): live member count
+- ``dl4jtpu_elastic_remesh_total`` (counter, labeled cause=scale_in|
+  scale_out): completed re-meshes
+- ``dl4jtpu_elastic_lost_hosts_total`` (counter): members declared dead
+- ``dl4jtpu_elastic_remesh_seconds`` (histogram): detection→resumed
+  latency of each re-mesh
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from deeplearning4j_tpu.monitoring.metrics import (
+    MetricsRegistry, global_registry)
+
+log = logging.getLogger(__name__)
+
+
+def _write_json_atomic_nosync(path: str, obj) -> None:
+    """tmp → os.replace, NO fsync: a reader never sees a torn file (the
+    rename is atomic), but the write is not crash-durable — exactly
+    right for a lease, whose only meaning is "I was alive when I wrote
+    this". A lease lost to power failure describes a host that is dead
+    anyway, while an fsync per heartbeat (~seconds on overlay/network
+    filesystems) would starve the beat interval the ttl depends on.
+    Generation records — which must never be un-published — go through
+    the fsynced exclusive-create in ``publish_generation`` instead."""
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(obj, f, sort_keys=True)
+    os.replace(tmp, path)
+
+ELASTIC_GENERATION = "dl4jtpu_elastic_generation"
+ELASTIC_MEMBERS = "dl4jtpu_elastic_members"
+ELASTIC_REMESH = "dl4jtpu_elastic_remesh_total"
+ELASTIC_LOST_HOSTS = "dl4jtpu_elastic_lost_hosts_total"
+ELASTIC_REMESH_SECONDS = "dl4jtpu_elastic_remesh_seconds"
+
+_GEN_PREFIX = "gen_"
+_LEASE_PREFIX = "lease_"
+
+__all__ = [
+    "ELASTIC_GENERATION", "ELASTIC_LOST_HOSTS", "ELASTIC_MEMBERS",
+    "ELASTIC_REMESH", "ELASTIC_REMESH_SECONDS", "GenerationDead",
+    "GenerationRecord", "LeaseLedger", "MembershipChanged",
+    "MembershipDelta",
+    "agree_next_generation", "declare_elastic_series", "detect_membership",
+    "free_port", "plan_next_generation",
+]
+
+
+def declare_elastic_series(registry: Optional[MetricsRegistry] = None):
+    """Get-or-create the elastic telemetry series (schema visible before
+    the first re-mesh). Returns (generation, members, remesh_total,
+    lost_hosts_total, remesh_seconds)."""
+    r = registry or global_registry()
+    remesh = r.counter(ELASTIC_REMESH, "Completed re-meshes", ("cause",))
+    lost = r.counter(ELASTIC_LOST_HOSTS, "Members declared dead")
+    for cause in ("scale_in", "scale_out"):
+        # touch both children so the series renders (at 0) on a fleet
+        # that has never re-meshed; same for the unlabeled counter
+        remesh.labels(cause=cause)
+    lost.inc(0)
+    return (
+        r.gauge(ELASTIC_GENERATION, "Current membership generation"),
+        r.gauge(ELASTIC_MEMBERS, "Members in the current generation"),
+        remesh,
+        lost,
+        r.histogram(ELASTIC_REMESH_SECONDS,
+                    "Re-mesh latency, detection to resumed"),
+    )
+
+
+class MembershipChanged(RuntimeError):
+    """The membership this generation was built on no longer matches the
+    ledger: tear down the current world and re-mesh. Scale-in (a lost
+    member — see ``GenerationDead``) and scale-out (a join lease from a
+    returning host) raise through this one signal so both travel the
+    same re-mesh path."""
+
+    def __init__(self, generation: int, reason: str,
+                 lost: Sequence[int] = (), joined: Sequence[int] = ()):
+        self.generation = int(generation)
+        self.lost_ranks = sorted(int(r) for r in lost)
+        self.joined_ranks = sorted(int(r) for r in joined)
+        self.reason = reason
+        parts = []
+        if self.lost_ranks:
+            parts.append(f"lost ranks {self.lost_ranks}")
+        if self.joined_ranks:
+            parts.append(f"join requests from ranks {self.joined_ranks}")
+        super().__init__(
+            f"generation {generation} membership changed: "
+            f"{', '.join(parts) or 'no delta'} ({reason})")
+
+    @property
+    def cause(self) -> str:
+        """Metrics label: losses dominate (a simultaneous loss+join
+        re-mesh is a scale-in event that happens to admit someone)."""
+        return "scale_in" if self.lost_ranks else "scale_out"
+
+
+class GenerationDead(MembershipChanged):
+    """The current membership generation lost at least one member: every
+    survivor must tear down the old world and re-mesh."""
+
+    def __init__(self, generation: int, lost_ranks: Sequence[int],
+                 reason: str, joined: Sequence[int] = ()):
+        super().__init__(generation, reason, lost=lost_ranks,
+                         joined=joined)
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerationRecord:
+    """One immutable membership generation. ``members`` is the sorted
+    list of GLOBAL ranks; a member's index in the list is its contiguous
+    jax process id for this generation (so process 0 — the coordinator —
+    is always the lowest surviving global rank)."""
+
+    generation: int
+    members: Sequence[int]
+    coordinator: str  # "host:port" for jax.distributed.initialize
+    published_by: int  # global rank of the publisher
+
+    @property
+    def world(self) -> int:
+        return len(self.members)
+
+    def contains(self, rank: int) -> bool:
+        return int(rank) in self.members
+
+    def process_id_of(self, rank: int) -> int:
+        """Contiguous process id of a global rank in this generation."""
+        try:
+            return self.members.index(int(rank))
+        except ValueError:
+            raise KeyError(f"rank {rank} is not a member of "
+                           f"generation {self.generation}") from None
+
+    def to_dict(self) -> Dict:
+        return {"generation": int(self.generation),
+                "members": [int(m) for m in self.members],
+                "coordinator": self.coordinator,
+                "published_by": int(self.published_by)}
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "GenerationRecord":
+        members = sorted(int(m) for m in d["members"])
+        if not members:
+            raise ValueError("generation record with no members")
+        return cls(generation=int(d["generation"]), members=members,
+                   coordinator=str(d.get("coordinator", "")),
+                   published_by=int(d.get("published_by", members[0])))
+
+
+@dataclasses.dataclass(frozen=True)
+class MembershipDelta:
+    """What the ledger says changed relative to a generation record."""
+
+    lost: Sequence[int]  # members whose lease expired
+    joined: Sequence[int]  # live non-members (join requests)
+
+    def __bool__(self) -> bool:
+        return bool(self.lost or self.joined)
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    """A currently-free TCP port on ``host`` for the next generation's
+    coordinator. Best-effort (bind+close race), which is fine: a publish
+    that loses the port race fails initialize and triggers the next
+    generation bump rather than corrupting anything."""
+    s = socket.socket()
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class LeaseLedger:
+    """Filesystem lease ledger + generation log for ONE host (identified
+    by its stable global rank) under a shared directory.
+
+    Heartbeats are atomic whole-file writes (tmp→rename — atomic for
+    readers, deliberately NOT fsynced: see ``_write_json_atomic_nosync``),
+    so a reader never sees a torn lease; the liveness clock is the
+    reader's wall clock against the writer's stamped ``ts`` (same-host
+    tests are exact; multi-host deployments need the usual loosely-synced
+    clocks every lease system assumes, with ``ttl`` >> clock skew).
+
+    ``stall()`` freezes the background heartbeat WITHOUT killing
+    anything — the hung-host simulation ``LeaseStallInjector`` drives
+    (detection-without-death must be testable separately from death).
+    """
+
+    def __init__(self, root: str, rank: int, ttl: float = 5.0,
+                 interval: Optional[float] = None,
+                 advertise_host: str = "127.0.0.1"):
+        if ttl <= 0:
+            raise ValueError(f"ttl must be > 0, got {ttl}")
+        self.root = os.path.abspath(root)
+        self.rank = int(rank)
+        self.ttl = float(ttl)
+        self.interval = float(interval) if interval is not None \
+            else self.ttl / 3.0
+        self.advertise_host = advertise_host
+        self.beat = 0
+        self.generation: Optional[int] = None  # stamped into each beat
+        self._stalled = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        os.makedirs(self.root, exist_ok=True)
+
+    # -- paths -----------------------------------------------------------
+    def _lease_path(self, rank: int) -> str:
+        return os.path.join(self.root, f"{_LEASE_PREFIX}{int(rank)}.json")
+
+    def _gen_path(self, generation: int) -> str:
+        return os.path.join(self.root, f"{_GEN_PREFIX}{int(generation)}.json")
+
+    # -- heartbeats ------------------------------------------------------
+    def heartbeat(self, generation: Optional[int] = None) -> None:
+        """Write one lease beat (no-op while stalled). ``generation``
+        updates the sticky per-ledger generation stamp the background
+        thread keeps beating with — after a re-mesh one
+        ``heartbeat(new_gen)`` re-stamps the stream."""
+        if generation is not None:
+            self.generation = int(generation)
+        if self._stalled.is_set():
+            return
+        self.beat += 1
+        _write_json_atomic_nosync(self._lease_path(self.rank), {
+            "rank": self.rank, "beat": self.beat, "ts": time.time(),
+            "generation": self.generation,
+            "host": self.advertise_host,
+        })
+
+    def start(self, generation: Optional[int] = None) -> "LeaseLedger":
+        """Heartbeat immediately, then keep beating from a daemon thread
+        every ``interval`` seconds until ``stop()``."""
+        self.heartbeat(generation)
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+
+        def _run():
+            while not self._stop.wait(self.interval):
+                try:
+                    self.heartbeat()
+                except OSError as e:  # pragma: no cover - disk trouble
+                    log.warning("lease heartbeat failed: %s", e)
+
+        self._thread = threading.Thread(
+            target=_run, daemon=True, name=f"lease-rank{self.rank}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2 * self.interval + 1)
+        self._thread = None
+
+    def stall(self) -> None:
+        """Freeze heartbeats (the process stays alive — the hung-host
+        signal: peers see this rank's lease expire)."""
+        self._stalled.set()
+
+    def resume(self) -> None:
+        self._stalled.clear()
+
+    @property
+    def stalled(self) -> bool:
+        return self._stalled.is_set()
+
+    def withdraw(self) -> None:
+        """Remove this rank's lease (orderly leave: peers see the rank
+        gone at the next check instead of waiting out the ttl)."""
+        try:
+            os.unlink(self._lease_path(self.rank))
+        except OSError:
+            pass
+
+    # -- reads -----------------------------------------------------------
+    def read_lease(self, rank: int) -> Optional[Dict]:
+        try:
+            with open(self._lease_path(rank), "r", encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def read_leases(self) -> Dict[int, Dict]:
+        out: Dict[int, Dict] = {}
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return out
+        for name in names:
+            if not (name.startswith(_LEASE_PREFIX) and
+                    name.endswith(".json")):
+                continue
+            try:
+                rank = int(name[len(_LEASE_PREFIX):-len(".json")])
+            except ValueError:
+                continue
+            lease = self.read_lease(rank)
+            if lease is not None:
+                out[rank] = lease
+        return out
+
+    def lease_age(self, rank: int,
+                  now: Optional[float] = None) -> Optional[float]:
+        lease = self.read_lease(rank)
+        if lease is None:
+            return None
+        return (time.time() if now is None else now) - float(lease["ts"])
+
+    def live_ranks(self, now: Optional[float] = None) -> List[int]:
+        """Ranks whose lease is younger than ttl (a missing lease is
+        simply not live)."""
+        now = time.time() if now is None else now
+        return sorted(r for r, lease in self.read_leases().items()
+                      if now - float(lease["ts"]) <= self.ttl)
+
+    # -- generations -----------------------------------------------------
+    def read_generation(self, generation: int) -> Optional[GenerationRecord]:
+        try:
+            with open(self._gen_path(generation), "r",
+                      encoding="utf-8") as f:
+                return GenerationRecord.from_dict(json.load(f))
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def latest_generation(self) -> Optional[GenerationRecord]:
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return None
+        best = -1
+        for name in names:
+            if not (name.startswith(_GEN_PREFIX) and
+                    name.endswith(".json")):
+                continue
+            try:
+                n = int(name[len(_GEN_PREFIX):-len(".json")])
+            except ValueError:
+                continue
+            best = max(best, n)
+        return None if best < 0 else self.read_generation(best)
+
+    def publish_generation(self, record: GenerationRecord
+                           ) -> GenerationRecord:
+        """EXCLUSIVE-create the generation file; if a record for that
+        generation already exists (a concurrent publisher won the race),
+        the existing record is returned — callers always converge on the
+        single on-disk truth."""
+        final = self._gen_path(record.generation)
+        tmp = f"{final}.tmp.{os.getpid()}.{threading.get_ident()}"
+        payload = (json.dumps(record.to_dict(), sort_keys=True) + "\n"
+                   ).encode("utf-8")
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        try:
+            os.link(tmp, final)  # atomic, FAILS if final exists
+        except FileExistsError:
+            existing = self.read_generation(record.generation)
+            if existing is not None:
+                log.info("generation %d already published by rank %d; "
+                         "adopting", existing.generation,
+                         existing.published_by)
+                return existing
+            return record  # torn loser file: our payload is the record
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        return record
+
+    def wait_for_generation(self, min_generation: int, timeout: float,
+                            poll: float = 0.05) -> GenerationRecord:
+        """Block until a generation >= ``min_generation`` is published
+        (non-publishers during a re-mesh; joiners waiting for admission)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            rec = self.latest_generation()
+            if rec is not None and rec.generation >= min_generation:
+                return rec
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"no generation >= {min_generation} published under "
+                    f"{self.root} within {timeout}s")
+            time.sleep(poll)
+
+
+# ---------------------------------------------------------------------------
+# detection + planning
+# ---------------------------------------------------------------------------
+def detect_membership(ledger: LeaseLedger,
+                      record: GenerationRecord) -> MembershipDelta:
+    """Compare the lease ledger against a generation record.
+
+    ``lost``: members whose lease expired (or vanished) — the failure
+    signal, whether the host died (SIGKILL mid-allreduce), hung (frozen
+    heartbeat thread), or left politely (withdrawn lease). ``joined``:
+    live leases from non-members — rejoin requests. The caller's own
+    rank is never in ``lost`` (a host that can run this code is alive
+    even if its own heartbeat thread wedged)."""
+    live = set(ledger.live_ranks())
+    lost = [r for r in record.members
+            if r not in live and r != ledger.rank]
+    joined = [r for r in sorted(live) if not record.contains(r)]
+    return MembershipDelta(lost=lost, joined=joined)
+
+
+def plan_next_generation(prev: GenerationRecord, live: Sequence[int],
+                         publisher: int,
+                         coordinator: Optional[str] = None,
+                         advertise_host: str = "127.0.0.1"
+                         ) -> GenerationRecord:
+    """The re-mesh plan: generation N+1 over the live rank set, with
+    contiguous process ids re-assigned by sorted global rank and the
+    coordinator on the lowest survivor (= new process 0). Scale-in and
+    scale-out are the same computation — ``live`` is just whatever the
+    ledger says is alive now."""
+    members = sorted(set(int(r) for r in live))
+    if not members:
+        raise ValueError("cannot plan a generation with no live members")
+    if coordinator is None:
+        coordinator = f"{advertise_host}:{free_port(advertise_host)}"
+    return GenerationRecord(generation=prev.generation + 1,
+                            members=members, coordinator=coordinator,
+                            published_by=int(publisher))
+
+
+def agree_next_generation(ledger: LeaseLedger, prev: GenerationRecord,
+                          stagger: float = 0.25,
+                          timeout: float = 30.0) -> GenerationRecord:
+    """Converge every survivor of a dead generation on ONE successor
+    record.
+
+    Only surviving MEMBERS of ``prev`` may publish (a joiner waits to be
+    admitted — it has no standing to re-plan a membership it never
+    belonged to). Each survivor waits ``stagger`` seconds per survivor
+    ranked below it, polling for an existing record the whole time, so
+    the lowest surviving rank publishes first by construction and higher
+    ranks only step up if everything below them died between detection
+    and publish. Two survivors racing through the stagger anyway is
+    settled by ``publish_generation``'s exclusive create: one record
+    wins, both return it.
+
+    The fresh ``live_ranks`` read here (not the one that declared the
+    generation dead) is what folds scale-in and scale-out into one step:
+    a join lease that appeared during detection rides into the same
+    successor generation."""
+    if not prev.contains(ledger.rank):
+        return ledger.wait_for_generation(prev.generation + 1,
+                                          timeout=timeout)
+    live = set(ledger.live_ranks())
+    live.add(ledger.rank)  # this code running IS liveness
+    survivors = sorted(r for r in live if prev.contains(r))
+    my_turn = time.monotonic() + stagger * survivors.index(ledger.rank)
+    while time.monotonic() < my_turn:
+        rec = ledger.read_generation(prev.generation + 1)
+        if rec is not None:
+            return rec
+        time.sleep(min(0.05, stagger))
+    rec = ledger.read_generation(prev.generation + 1)
+    if rec is not None:
+        return rec
+    # the new process 0 is the lowest live rank: the coordinator must
+    # live on ITS host (from its lease). The port is picked by the
+    # publisher — correct when publisher and lowest rank share a host
+    # (always true on the test fleet); multi-host deployments should
+    # derive a deterministic per-generation port instead.
+    lease = ledger.read_lease(min(live)) or {}
+    plan = plan_next_generation(
+        prev, sorted(live), ledger.rank,
+        advertise_host=lease.get("host") or ledger.advertise_host)
+    # single attempt: publish_generation always returns the on-disk
+    # truth — our plan if the exclusive create won, the racing winner's
+    # record otherwise
+    return ledger.publish_generation(plan)

@@ -51,7 +51,8 @@ import numpy as np
 
 from deeplearning4j_tpu.optimize.listeners import TrainingListener
 from deeplearning4j_tpu.resilience.durable import (
-    AsyncCheckpointWriter, CorruptCheckpointError, MANIFEST_NAME,
+    AsyncCheckpointWriter, CommitTimeoutError, CorruptCheckpointError,
+    MANIFEST_NAME,
     atomic_write_json, declare_checkpoint_series, publish_commit,
     read_commit, read_state_dir, shard_dir_name, snapshot_tree,
     verify_state_dir, wait_commit, write_checkpoint_dir, write_shard)
@@ -59,7 +60,8 @@ from deeplearning4j_tpu.resilience.durable import (
 log = logging.getLogger(__name__)
 
 __all__ = [
-    "CheckpointListener", "checkpoint_status", "delete_checkpoint",
+    "CheckpointListener", "CommitTimeoutError", "checkpoint_status",
+    "delete_checkpoint",
     "list_checkpoints", "list_good_checkpoints", "load_checkpoint",
     "restore_checkpoint", "restore_distributed_checkpoint",
     "save_checkpoint", "save_distributed_checkpoint", "verify_checkpoint",
@@ -428,7 +430,8 @@ def save_distributed_checkpoint(net, path: str, step: int,
                                 rank: Optional[int] = None,
                                 world: Optional[int] = None,
                                 timeout: float = 60.0,
-                                wait: bool = True) -> str:
+                                wait: bool = True,
+                                publish: bool = True) -> str:
     """Multi-process checkpoint: every worker writes its own shard dir
     (atomic + checksummed) under ``step_N/``; rank 0 then waits for all
     shards, verifies them, and atomically publishes the COMMIT marker.
@@ -438,7 +441,13 @@ def save_distributed_checkpoint(net, path: str, step: int,
     A worker dying between shard write and commit leaves the step
     UNCOMMITTED (rank 0 times out, raises, and writes no marker) —
     resume via ``restore_distributed_checkpoint`` only ever selects
-    fully committed steps."""
+    fully committed steps.
+
+    ``publish=False`` (rank 0 only) writes the shard and config but
+    leaves the marker to the caller (``resilience.durable
+    .publish_commit``): the elastic trainer sequences a membership
+    decision between shard arrival and the marker so every rank that
+    passes the commit barrier is guaranteed to observe it."""
     rank, world = _dist_rank_world(rank, world)
     path = os.path.abspath(path)
     step_dir = os.path.join(path, f"step_{int(step)}")
@@ -448,13 +457,14 @@ def save_distributed_checkpoint(net, path: str, step: int,
     extras["world"] = world
     sdir = write_shard(step_dir, rank, host_tree, extras=extras)
     if rank == 0:
-        publish_commit(step_dir, step=int(step), world=world,
-                       timeout=timeout)
         meta = {"model_class": type(net).__name__,
                 "config": net.conf.to_json()}
         atomic_write_json(os.path.join(path, "config.json"), meta)
+        if publish:
+            publish_commit(step_dir, step=int(step), world=world,
+                           timeout=timeout)
     elif wait:
-        wait_commit(step_dir, timeout=timeout)
+        wait_commit(step_dir, timeout=timeout, world=world)
     return sdir
 
 

@@ -47,6 +47,15 @@ python -m pytest tests/test_resilience.py -q -p no:cacheprovider
 # trajectory on per-batch, fused-scan, and ParallelWrapper fits)
 python -m pytest tests/test_durable.py -q -m 'not slow' -p no:cacheprovider
 
+# tier-1 elastic lane: the membership layer (resilience/elastic.py +
+# parallel/elastic.py) — lease ledger liveness/expiry/stall, generation
+# agreement incl. the split-brain exclusive-create tiebreak, elastic
+# shard re-assignment math, rank-targeted chaos injectors, typed commit
+# timeouts, and the world-of-one ElasticTrainer loop (commit cadence,
+# telemetry, zero retraces). The multi-process kill/rejoin proofs run in
+# the slow suite (tests/test_elastic_multiprocess.py, pytest -m slow).
+python -m pytest tests/test_elastic.py -q -p no:cacheprovider
+
 # tier-1 serving lane: the continuous-batching engine (serving/) — the
 # engine-vs-one-shot bit-exactness contract, slot lifecycle, admission
 # control/deadlines, chaos isolation, and the zero-retraces-after-warmup

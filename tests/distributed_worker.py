@@ -77,8 +77,11 @@ def main() -> None:
     gy[np.arange(16), rng.integers(0, 3, 16)] = 1.0
     local_n = dist.host_local_batch(16)
     assert local_n == 16 // nproc
-    lo = pid * local_n
-    x_local, y_local = gx[lo:lo + local_n], gy[lo:lo + local_n]
+    # bounds helper, not pid * local_n: correct for ANY split, including
+    # the elastic largest-even-split where shards differ by one
+    lo, hi = dist.host_shard_bounds(16)
+    assert hi - lo == local_n
+    x_local, y_local = gx[lo:hi], gy[lo:hi]
 
     mesh = dist.global_mesh()
     assert int(np.prod(mesh.devices.shape)) == nproc * local_dev

@@ -158,8 +158,7 @@ def run_dist(coord: str, nproc: int, pid: int, local_dev: int,
     net = build_net(seed=4)
     x, y = build_data(seed=7)
     x, y = x[:16], y[:16]  # proven harness shape: 8 rows per rank
-    local_n = dist.host_local_batch(x.shape[0])
-    lo = pid * local_n
+    lo, hi = dist.host_shard_bounds(x.shape[0])
     mesh = dist.global_mesh()
     rep = NamedSharding(mesh, P())
     params = jax.device_put(net.params, rep)
@@ -170,8 +169,8 @@ def run_dist(coord: str, nproc: int, pid: int, local_dev: int,
     def train(k):
         nonlocal params, state, upd
         for _ in range(k):
-            gx = dist.make_global_array(x[lo:lo + local_n], mesh)
-            gy = dist.make_global_array(y[lo:lo + local_n], mesh)
+            gx = dist.make_global_array(x[lo:hi], mesh)
+            gy = dist.make_global_array(y[lo:hi], mesh)
             params, state, upd, _loss = step_fn(params, state, upd, gx, gy,
                                                 net._next_rng(), None, None)
         net.params, net.state, net.updater_state = params, state, upd

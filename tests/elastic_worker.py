@@ -1,0 +1,215 @@
+"""Worker process for the elastic multi-host chaos tests
+(tests/test_elastic_multiprocess.py).
+
+Two modes, spawned as REAL OS processes over the gloo CPU collectives
+(the proven localhost stand-in for DCN):
+
+- ``elastic``: one rank of an elastic fleet. Runs ``ElasticTrainer``
+  end to end — lease heartbeats, membership generations, distributed
+  commits — optionally carrying a ``HostLossInjector`` ("SIGKILL rank K
+  at global step N": every rank runs the same config, exactly one
+  dies). A killed rank's survivors must detect the loss, re-mesh, and
+  finish; a re-spawned rank (same global rank, fresh process) must be
+  admitted at a commit boundary and catch up. Writes digest + health +
+  compile counts to ``--out`` BEFORE the done-file rendezvous, exits
+  via os._exit(0) (the zombie runtimes from dead generations must never
+  see interpreter teardown), and the generation's process 0 exits LAST
+  (a leader socket closing early abors followers still polling it).
+
+- ``solo``: the reference leg for the kill test — a fresh
+  single-process run (same 4-device config as one elastic host) that
+  restores the SAME committed step the survivor re-meshed from and
+  trains the remaining steps with the same deterministic schedule. The
+  survivor's post-re-mesh params must match this digest BIT-EXACTLY.
+
+Net/data builders are shared with tests/durable_worker.py so every
+process trains the same deterministic run by construction.
+"""
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+sys.path.insert(0, _repo_root())
+
+from tests.durable_worker import (  # noqa: E402
+    build_data, build_net, configure_jax, params_digest)
+
+
+def _compile_total() -> float:
+    from deeplearning4j_tpu import monitoring
+    from deeplearning4j_tpu.monitoring import runtime
+    c = monitoring.global_registry().get(runtime.COMPILE_COUNTER)
+    return 0.0 if c is None else c.total()
+
+
+def _elastic_metric_names() -> list:
+    from deeplearning4j_tpu import monitoring
+    snap = monitoring.metrics_snapshot()
+    return sorted({k.split("{")[0] for k in snap
+                   if k.startswith("dl4jtpu_elastic")})
+
+
+class StepChaos:
+    """Per-step seam: optional throttle (so a rejoiner has a live fleet
+    to join) + any number of chaos injectors."""
+
+    def __init__(self, injectors, throttle: float = 0.0):
+        self.injectors = list(injectors)
+        self.throttle = float(throttle)
+
+    def __call__(self, index: int) -> None:
+        from deeplearning4j_tpu.resilience.chaos import fire
+        if self.throttle:
+            time.sleep(self.throttle)
+        for inj in self.injectors:
+            fire(inj, index)
+
+
+def run_elastic(args) -> None:
+    from deeplearning4j_tpu.parallel.elastic import (
+        ElasticConfig, ElasticTrainer)
+    from deeplearning4j_tpu.resilience.chaos import HostLossInjector
+
+    net = build_net(seed=4)
+    x, y = build_data(n=64, seed=7)
+    members = tuple(int(m) for m in args.members.split(","))
+    cfg = ElasticConfig(
+        ledger_root=args.ledger, checkpoint_dir=args.ckpt,
+        rank=args.rank, bootstrap_members=members,
+        bootstrap_coordinator=args.coord,
+        # ttl sized for this harness's worst-observed fsync stalls (a
+        # heartbeat stuck behind a dirty-page flush must not read as a
+        # death); the dispatch watchdog still out-waits it, so a real
+        # SIGKILL is confirmed on the first check after the hang fires
+        lease_ttl=4.0, dispatch_timeout=6.0, confirm_grace=6.0,
+        remesh_timeout=60.0, publish_stagger=0.3,
+        commit_every=args.commit_every, commit_timeout=60.0)
+    injectors = []
+    if args.kill_rank >= 0:
+        injectors.append(HostLossInjector(
+            None, n=args.kill_step, target_rank=args.kill_rank,
+            rank=args.rank))
+    tr = ElasticTrainer(net, cfg,
+                        step_chaos=StepChaos(injectors, args.throttle))
+    c0 = _compile_total()
+    tr.fit_steps(x, y, args.steps, global_batch_size=args.gbs)
+    c1 = _compile_total()
+    digest1 = params_digest(net)
+    restored1 = tr.last_restored_step
+    health1 = tr.health()
+    digest2 = None
+    c2 = c1
+    if args.extend_steps:
+        # steady-state extension on the SAME activated world: must reuse
+        # the post-re-mesh trace (zero new compiles — the acceptance pin)
+        tr.fit_steps(x, y, args.steps + args.extend_steps,
+                     global_batch_size=args.gbs)
+        c2 = _compile_total()
+        digest2 = params_digest(net)
+    out = {
+        "rank": args.rank,
+        "digest": digest1,
+        "digest_extended": digest2,
+        "iteration": int(net.iteration_count),
+        "restored_step": restored1,
+        "health": health1,
+        "compiles": [c0, c1, c2],
+        "elastic_series": _elastic_metric_names(),
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f)
+    _rendezvous(args)
+    os._exit(0)
+
+
+def _rendezvous(args) -> None:
+    """Done-file barrier, leader (lowest expected rank) exits LAST: a
+    follower still long-polling the coordination service aborts if the
+    leader's socket closes first."""
+    if not args.done_ranks:
+        return
+    ranks = sorted(int(r) for r in args.done_ranks.split(","))
+    open(os.path.join(args.ledger, f"done_{args.rank}"), "w").close()
+    deadline = time.monotonic() + 60
+    others = [r for r in ranks if r != args.rank]
+    while others and time.monotonic() < deadline:
+        others = [r for r in others if not os.path.exists(
+            os.path.join(args.ledger, f"done_{r}"))]
+        time.sleep(0.1)
+    if args.rank == ranks[0]:
+        time.sleep(1.5)  # leader lingers until followers are gone
+
+
+def run_solo(args) -> None:
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from deeplearning4j_tpu.parallel import distributed as dist
+    from deeplearning4j_tpu.util.checkpoint import (
+        restore_distributed_checkpoint)
+
+    net = build_net(seed=4)
+    x, y = build_data(n=64, seed=7)
+    restored = restore_distributed_checkpoint(
+        net, args.ckpt, rank=0, world=1, step=args.restore_step)
+    assert restored == args.restore_step, restored
+    mesh = dist.global_mesh()
+    rep = NamedSharding(mesh, P())
+    params = jax.device_put(net.params, rep)
+    state = jax.device_put(net.state, rep)
+    upd = jax.device_put(net.updater_state, rep)
+    step_fn = net._get_train_step(False)
+    gbs = args.gbs
+    for step in range(args.restore_step, args.steps):
+        b0 = (step * gbs) % x.shape[0]
+        gx = dist.make_global_array(x[b0:b0 + gbs], mesh)
+        gy = dist.make_global_array(y[b0:b0 + gbs], mesh)
+        params, state, upd, _loss = step_fn(
+            params, state, upd, gx, gy, net._next_rng(), None, None)
+    net.params, net.state, net.updater_state = params, state, upd
+    with open(args.out, "w") as f:
+        json.dump({"digest": params_digest(net),
+                   "restored_step": restored}, f)
+    os._exit(0)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("mode", choices=("elastic", "solo"))
+    p.add_argument("--rank", type=int, default=0)
+    p.add_argument("--members", default="0")
+    p.add_argument("--coord", default=None)
+    p.add_argument("--ledger", required=False)
+    p.add_argument("--ckpt", required=True)
+    p.add_argument("--out", required=True)
+    p.add_argument("--steps", type=int, required=True)
+    p.add_argument("--gbs", type=int, default=16)
+    p.add_argument("--commit-every", type=int, default=2)
+    p.add_argument("--kill-rank", type=int, default=-1)
+    p.add_argument("--kill-step", type=int, default=-1)
+    p.add_argument("--throttle", type=float, default=0.0)
+    p.add_argument("--extend-steps", type=int, default=0)
+    p.add_argument("--restore-step", type=int, default=0)
+    p.add_argument("--done-ranks", default="")
+    p.add_argument("--local-devices", type=int, default=4)
+    args = p.parse_args()
+    logging.basicConfig(
+        stream=sys.stdout, level=logging.INFO,
+        format=f"[rank{args.rank} %(asctime)s] %(name)s: %(message)s")
+    configure_jax(args.local_devices)
+    if args.mode == "elastic":
+        run_elastic(args)
+    else:
+        run_solo(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -633,6 +633,104 @@ class TestNonAtomicStateWriteRule:
 
 
 # ---------------------------------------------------------------------
+# rule: stale-world-snapshot
+# ---------------------------------------------------------------------
+class TestWorldSnapshotRule:
+    def test_positive_module_scope_snapshot(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import jax
+
+            WORLD = jax.process_count()
+            MY_RANK = jax.process_index()
+        """)
+        assert _rules_of(fs) == ["stale-world-snapshot"] * 2
+
+    def test_positive_class_scope_and_aliased(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            from jax import device_count
+
+            class Trainer:
+                n_devices = device_count()
+        """)
+        assert _rules_of(fs) == ["stale-world-snapshot"]
+
+    def test_positive_argument_default(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import jax
+
+            def shard(batch, world=jax.process_count()):
+                return batch // world
+        """)
+        assert _rules_of(fs) == ["stale-world-snapshot"]
+
+    def test_positive_lambda_default_is_definition_time(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import jax
+
+            pick = lambda xs, w=jax.process_count(): xs[:w]
+        """)
+        assert _rules_of(fs) == ["stale-world-snapshot"]
+
+    def test_positive_distributed_wrapper_snapshot(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            from deeplearning4j_tpu.parallel import distributed as dist
+
+            RANK = dist.process_index()
+        """)
+        assert _rules_of(fs) == ["stale-world-snapshot"]
+
+    def test_negative_call_time_reads(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import jax
+
+            def shard(batch):
+                return batch // jax.process_count()
+
+            class Trainer:
+                def world(self):
+                    return jax.process_count()
+
+            pick = lambda xs: xs[jax.process_index()]
+        """)
+        assert fs == []
+
+    def test_negative_nested_def_default_is_call_time(self, tmp_path):
+        # the inner def's defaults evaluate when the OUTER runs — a
+        # per-call event, not an import-time snapshot
+        fs = _scan_snippet(tmp_path, """
+            import jax
+
+            def make_sharder():
+                def shard(b, world=jax.process_count()):
+                    return b // world
+                return shard
+        """)
+        assert fs == []
+
+    def test_negative_unrelated_module_scope_calls(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import os
+
+            N = os.cpu_count()
+
+            def device_count():
+                return 1
+
+            M = device_count()
+        """)
+        assert fs == []
+
+    def test_repo_world_reads_are_call_time(self):
+        """Repo self-scan for this rule specifically: every world read
+        in the runtime-facing modules happens at call time (the elastic
+        re-mesh contract)."""
+        from deeplearning4j_tpu.analysis.rules.world_snapshot import (
+            WorldSnapshotRule)
+        fs = scan_paths([str(PKG)], [WorldSnapshotRule()], root=str(REPO))
+        assert fs == []
+
+
+# ---------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------
 class TestSuppression:
@@ -783,7 +881,7 @@ class TestSelfScan:
             "tracer-leak", "recompile-hazard",
             "dtype-promotion", "unlocked-thread-state", "bare-except",
             "mutable-default-arg", "unbounded-retry",
-            "non-atomic-state-write"}
+            "non-atomic-state-write", "stale-world-snapshot"}
         assert RULES_BY_ID["host-sync-in-hot-loop"].severity == "error"
         assert RULES_BY_ID["device-transfer-in-hot-loop"].severity == \
             "warning"
