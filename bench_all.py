@@ -926,6 +926,140 @@ def bench_serve_paged():
     _print_line(json.dumps(rec), flush=True)
 
 
+def bench_serve_chaos():
+    """Serving survivability under fire: the staggered serve_continuous
+    trace with (a) a mid-run injected decode fault — supervised
+    recovery vs the legacy fail-all — and (b) an overload burst beyond
+    queue capacity — SLO shedding vs admit-everything. Records the
+    recovered-request count, p95 TTFT with/without recovery (the
+    no-recovery column counts only requests that got ANY output), and
+    goodput (requests finishing inside their deadline per second) with
+    and without shedding. The survivability claim as numbers: a fault
+    costs a rebuild, not the batch; shedding keeps admitted requests'
+    latency flat instead of letting everyone breach together."""
+    import numpy as np
+    from deeplearning4j_tpu.resilience import chaos
+    from deeplearning4j_tpu.resilience.retry import RestartBudget
+    from deeplearning4j_tpu.serving import (
+        EngineSupervisor, GenerationEngine, OverloadConfig,
+        ServingOverloaded)
+    from deeplearning4j_tpu.zoo import TextGenerationTransformer
+
+    V, R, STEPS, SLOTS = 512, 24, 24, 4
+    STAGGER = 0.02
+    model = TextGenerationTransformer(vocab_size=V, embed_dim=128,
+                                      n_heads=4, n_layers=3,
+                                      max_length=128, positional="rope")
+    net = model.init()
+    net.conf.dtype = "bfloat16"
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, V, int(n)))
+               for n in rng.integers(6, 20, R)]
+
+    def trace(supervised: bool):
+        """The same staggered trace; a FaultBurstInjector kills one
+        mid-run decode dispatch. Supervised: arena rebuild, everyone
+        finishes. Unsupervised: the legacy fail-all."""
+        eng = GenerationEngine(
+            net, V, slots=SLOTS, queue_limit=R,
+            supervisor=(EngineSupervisor(budget=RestartBudget(3, 60.0))
+                        if supervised else None))
+        eng.warmup(max_prompt_len=32)
+        # arm the fault AFTER warmup so it lands ~30 dispatches into
+        # real traffic (warmup consumes dispatch indices too)
+        eng._decode_chaos = chaos.FaultBurstInjector(
+            n=eng._dispatches + 30, k=1)
+        eng.start()
+        t0 = time.perf_counter()
+        handles = []
+        for i, p in enumerate(prompts):
+            while time.perf_counter() < t0 + i * STAGGER:
+                time.sleep(0.001)
+            try:
+                handles.append(eng.submit(p, steps=STEPS, top_k=1,
+                                          rng=np.random.default_rng(i)))
+            except Exception:  # noqa: BLE001 — fail-all refuses late submits
+                handles.append(None)
+        done, failed = 0, 0
+        ttft = []
+        for h in handles:
+            if h is None:
+                failed += 1
+                continue
+            try:
+                h.result(timeout=600)
+                done += 1
+                ttft.append(h.ttft_s)
+            except Exception:  # noqa: BLE001 — the fail-all path
+                failed += 1
+                if h.ttft_s is not None:
+                    ttft.append(h.ttft_s)
+        dt = time.perf_counter() - t0
+        sup = eng._supervisor
+        rec = {
+            "completed": done, "failed": failed,
+            "wall_s": round(dt, 2),
+            "ttft_p95_ms": (round(float(np.percentile(ttft, 95)) * 1e3,
+                                  1) if ttft else None),
+            "rebuilds": sup.rebuilds if sup else 0,
+            "recovered_requests": sup.recovered_requests if sup else 0,
+        }
+        eng.shutdown()
+        return rec
+
+    def overload_burst(shedding: bool):
+        """2x-capacity burst of deadline-carrying requests: shedding
+        (tight SLO + early rejection) vs admit-everything. Goodput =
+        requests that finished INSIDE their deadline, per second."""
+        ov = OverloadConfig(queue_wait_slo_s=0.3, min_samples=4,
+                            breach_window=8, shed_to_depth=SLOTS,
+                            early_reject=True) if shedding else None
+        eng = GenerationEngine(net, V, slots=SLOTS, queue_limit=4 * R,
+                               overload=ov)
+        eng.warmup(max_prompt_len=32)
+        eng.start()
+        t0 = time.perf_counter()
+        handles, shed = [], 0
+        for i, p in enumerate(prompts * 2):       # the burst: 2x trace
+            try:
+                handles.append((eng.submit(
+                    p, steps=STEPS, top_k=1, timeout=8.0,
+                    rng=np.random.default_rng(i)), i))
+            except ServingOverloaded:
+                shed += 1
+        good, late, ttft = 0, 0, []
+        for h, i in handles:
+            try:
+                h.result(timeout=600)
+                good += 1
+                ttft.append(h.ttft_s)
+            except ServingOverloaded:
+                shed += 1
+            except Exception:  # noqa: BLE001 — deadline expiries
+                late += 1
+                if h.ttft_s is not None:   # admitted, prefilled, missed
+                    ttft.append(h.ttft_s)
+        dt = time.perf_counter() - t0
+        eng.shutdown()
+        return {
+            "goodput_req_per_s": round(good / dt, 2),
+            "good": good, "deadline_missed": late, "shed": shed,
+            "admitted_ttft_p95_ms": (
+                round(float(np.percentile(ttft, 95)) * 1e3, 1)
+                if ttft else None),
+        }
+
+    rec = {"metric": "serve_chaos", "unit": "requests_recovered",
+           "requests": R, "steps": STEPS, "slots": SLOTS,
+           "stagger_ms": STAGGER * 1e3,
+           "recovery": trace(supervised=True),
+           "fail_all": trace(supervised=False),
+           "shedding": overload_burst(shedding=True),
+           "no_shedding": overload_burst(shedding=False)}
+    rec["value"] = rec["recovery"]["recovered_requests"]
+    _print_line(json.dumps(rec), flush=True)
+
+
 def _converge_run(net, x, y, steps, record_every):
     """Fixed-seed training loop recording the loss trajectory. Each
     recorded point is a scalar host fetch — a real sync (the tunneled
@@ -1159,6 +1293,7 @@ ALL = {"resnet": bench_resnet, "lstm": bench_lstm, "lenet": bench_lenet,
        "specbatch": bench_specbatch,
        "serve_continuous": bench_serve_continuous,
        "serve_paged": bench_serve_paged,
+       "serve_chaos": bench_serve_chaos,
        "checkpoint_stall": bench_checkpoint_stall,
        "converge_lenet": bench_converge_lenet,
        "converge_resnet": bench_converge_resnet}

@@ -20,6 +20,8 @@ from deeplearning4j_tpu.analysis.rules.dtype import DtypePromotionRule
 from deeplearning4j_tpu.analysis.rules.concurrency import ThreadSharedStateRule
 from deeplearning4j_tpu.analysis.rules.hygiene import (
     BareExceptRule, MutableDefaultRule)
+from deeplearning4j_tpu.analysis.rules.lock_dispatch import (
+    LockHeldAcrossDispatchRule)
 from deeplearning4j_tpu.analysis.rules.retry_loop import UnboundedRetryRule
 from deeplearning4j_tpu.analysis.rules.state_write import (
     NonAtomicStateWriteRule)
@@ -33,6 +35,7 @@ ALL_RULES: List[Rule] = [
     RecompileHazardRule(),
     DtypePromotionRule(),
     ThreadSharedStateRule(),
+    LockHeldAcrossDispatchRule(),
     BareExceptRule(),
     MutableDefaultRule(),
     UnboundedRetryRule(),

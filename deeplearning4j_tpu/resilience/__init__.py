@@ -44,7 +44,8 @@ from deeplearning4j_tpu.resilience.durable import (
     PreemptionExit, PreemptionGuard)
 from deeplearning4j_tpu.resilience.elastic import (
     GenerationDead, GenerationRecord, LeaseLedger, MembershipChanged)
-from deeplearning4j_tpu.resilience.retry import RetryPolicy, retry_call
+from deeplearning4j_tpu.resilience.retry import (
+    RestartBudget, RetryPolicy, retry_call)
 from deeplearning4j_tpu.resilience.sentinel import (
     effective_policy, set_default_nonfinite_policy)
 
@@ -53,5 +54,6 @@ __all__ = ["AsyncCheckpointWriter", "CheckpointError",
            "CorruptCheckpointError", "GenerationDead", "GenerationRecord",
            "LeaseLedger", "MembershipChanged",
            "PreemptionExit", "PreemptionGuard",
-           "RetryPolicy", "retry_call", "effective_policy",
+           "RestartBudget", "RetryPolicy", "retry_call",
+           "effective_policy",
            "set_default_nonfinite_policy"]

@@ -38,14 +38,14 @@ import numpy as np
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterators import DataSetIterator
 
-__all__ = ["ChaosIterator", "HostLossInjector", "InjectedFault",
-           "LatencyIterator", "LeaseStallInjector",
+__all__ = ["ChaosIterator", "FaultBurstInjector", "HostLossInjector",
+           "InjectedFault", "LatencyIterator", "LeaseStallInjector",
            "NaNPoisonIterator", "PageExhaustionInjector",
            "PreemptionIterator", "ProcessKillInjector", "RaiseOnBatch",
-           "SimulatedPreemption", "fire"]
+           "RequestFaultInjector", "SimulatedPreemption", "fire"]
 
 
-def fire(injector, index: int) -> None:
+def fire(injector, index: int, ctx=None) -> None:
     """Drive an injector OUTSIDE an iterator pipeline.
 
     The serving engine counts its own events — one "batch" per prefill
@@ -55,13 +55,22 @@ def fire(injector, index: int) -> None:
     injector's global count on success. Pass any ``ChaosIterator``
     constructed with ``base=None`` (the base is only touched by
     iteration, which request-level use never does), or a bare callable
-    ``(index) -> None``. None is a no-op."""
+    ``(index) -> None``. None is a no-op.
+
+    ``ctx`` carries the event's subject when the seam has one (the
+    serving engine passes the ``GenerationRequest`` being admitted):
+    injectors that define ``before_event(index, ctx)`` (e.g.
+    :class:`RequestFaultInjector`) receive it and can target faults at
+    specific requests; index-only injectors ignore it."""
     if injector is None:
         return
     if not hasattr(injector, "before_batch"):
         injector(index)
         return
-    injector.before_batch(index)
+    if hasattr(injector, "before_event"):
+        injector.before_event(index, ctx)
+    else:
+        injector.before_batch(index)
     injector.batches_seen = max(injector.batches_seen, index + 1)
 
 
@@ -153,6 +162,73 @@ class RaiseOnBatch(ChaosIterator):
             self.period > 0 and index > self.n
             and (index - self.n) % self.period == 0)
         if hit and self._fire():
+            raise self.exc()
+
+
+class FaultBurstInjector(ChaosIterator):
+    """A BURST of exactly `k` faults starting at event `n`, then clear.
+
+    The once-latch generalized to a count: every event at index >= `n`
+    raises until `k` faults have fired (optionally only while the index
+    stays inside ``[n, n + window)``), after which the stream behaves
+    normally forever. Built to drive the serving supervisor's
+    escalation-vs-recovery boundary deterministically: a burst of
+    ``k <= budget`` decode faults must be ridden out with every request
+    completing bit-identically, while ``k > budget`` within the budget
+    window must escalate to the terminal fail-all state. Works
+    request-level (``base=None`` via ``chaos.fire``) or wrapping an
+    iterator.
+
+    Note the count is FAULTS FIRED, not event indices: a seam whose
+    index only advances on success (the engine's dispatch counter)
+    re-presents the same index after each fault, and an index-based
+    burst would fire forever."""
+
+    def __init__(self, base: Optional[DataSetIterator] = None,
+                 n: int = 0, k: int = 3,
+                 exc: Callable[[], BaseException] = InjectedFault,
+                 window: Optional[int] = None):
+        super().__init__(base, once=False)
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self.n = int(n)
+        self.k = int(k)
+        self.exc = exc
+        self.window = None if window is None else int(window)
+
+    def before_batch(self, index: int) -> None:
+        if index < self.n:
+            return
+        if self.window is not None and index >= self.n + self.window:
+            return
+        if self.faults_fired < self.k:
+            self.faults_fired += 1
+            raise self.exc()
+
+
+class RequestFaultInjector(ChaosIterator):
+    """Fault targeted at specific REQUESTS rather than event indices.
+
+    The serving seams (prefill admission, the pop-to-seat window) pass
+    the ``GenerationRequest`` being processed as the event context;
+    ``match(request)`` picks the victim(s) — by prompt, priority,
+    deadline, identity, whatever the test needs — independent of where
+    in the admission order the request lands (an index-keyed injector
+    breaks as soon as admission order shifts under load). ``once=True``
+    (default) faults the first match only."""
+
+    def __init__(self, match: Callable[[object], bool],
+                 exc: Callable[[], BaseException] = InjectedFault,
+                 base: Optional[DataSetIterator] = None,
+                 once: bool = True):
+        super().__init__(base, once=once)
+        self.match = match
+        self.exc = exc
+
+    def before_event(self, index: int, ctx) -> None:
+        if ctx is None:
+            return
+        if self.match(ctx) and self._fire():
             raise self.exc()
 
 

@@ -40,8 +40,8 @@ RETRY_EXHAUSTED = "dl4jtpu_retry_exhausted_total"
 
 log = logging.getLogger(__name__)
 
-__all__ = ["RETRIES", "RETRY_EXHAUSTED", "RetryPolicy", "retry_call",
-           "retryable"]
+__all__ = ["RETRIES", "RETRY_EXHAUSTED", "RestartBudget", "RetryPolicy",
+           "retry_call", "retryable"]
 
 
 @dataclass(frozen=True)
@@ -115,6 +115,58 @@ def retry_call(fn: Callable, *args,
                      name, attempt, p.max_attempts, e, d)
             sleep(d)
     raise AssertionError("unreachable")  # pragma: no cover
+
+
+class RestartBudget:
+    """Sliding-window restart budget: at most ``max_restarts``
+    acquisitions per ``window_s`` seconds.
+
+    The windowed sibling of :class:`RetryPolicy`'s attempt bound, for
+    *whole-component* restarts (a serving-engine arena rebuild, a
+    trainer re-mesh) where what must be bounded is the restart RATE,
+    not a per-operation attempt count: a single fault burst should be
+    ridden out, but a component restarting forever is a crash loop that
+    must escalate to its terminal failure mode instead of masking a
+    persistent fault. Old acquisitions age out, so an incident per hour
+    never exhausts a per-minute budget. ``clock`` is injectable for
+    deterministic tests. ``try_acquire`` callers serialize (the engine
+    holds its step lock across recovery), but ``remaining()`` is read
+    from lock-free health/metrics probes and therefore never mutates:
+    only ``try_acquire`` prunes, so a concurrent probe cannot drop a
+    just-recorded restart and leak the budget."""
+
+    def __init__(self, max_restarts: int = 3, window_s: float = 60.0,
+                 clock: Callable[[], float] = time.monotonic):
+        if max_restarts < 0:
+            raise ValueError(
+                f"max_restarts must be >= 0, got {max_restarts}")
+        if window_s <= 0:
+            raise ValueError(f"window_s must be > 0, got {window_s}")
+        self.max_restarts = int(max_restarts)
+        self.window_s = float(window_s)
+        self._clock = clock
+        self._acquired: list = []
+
+    def _prune(self, now: float) -> None:
+        cut = now - self.window_s
+        self._acquired = [t for t in self._acquired if t > cut]
+
+    def remaining(self) -> int:
+        """Restarts still allowed in the current window. Non-mutating:
+        counts live entries against a snapshot of the list."""
+        cut = self._clock() - self.window_s
+        live = sum(1 for t in list(self._acquired) if t > cut)
+        return self.max_restarts - live
+
+    def try_acquire(self) -> bool:
+        """Consume one restart if the window has room; False means the
+        budget is exhausted and the caller must escalate."""
+        now = self._clock()
+        self._prune(now)
+        if len(self._acquired) >= self.max_restarts:
+            return False
+        self._acquired.append(now)
+        return True
 
 
 def retryable(policy: Optional[RetryPolicy] = None,

@@ -18,22 +18,36 @@ tables over one refcounted pool), a full-block prompt ``PrefixCache``
 (``SpeculationConfig`` — a host draft + one widened verify dispatch per
 step).
 
-See ARCHITECTURE.md "Serving engine" and "Paged KV, prefix cache &
-speculation".
+The survivability layer keeps all of it up under faults and load:
+``EngineSupervisor`` (request-preserving arena rebuilds from the
+host-side ledger, budgeted restarts, escalation to fail-all),
+``OverloadConfig``/``OverloadController`` (SLO-breach shedding,
+deadline-based early rejection, the page-pressure brownout ladder),
+and ``GenerationEngine.drain()`` (the clean restart handoff).
+
+See ARCHITECTURE.md "Serving engine", "Paged KV, prefix cache &
+speculation", and "Serving survivability".
 """
 
 from deeplearning4j_tpu.serving.engine import (  # noqa: F401
     GenerationEngine, SpeculationConfig)
 from deeplearning4j_tpu.serving.errors import (  # noqa: F401
-    EngineShutdown, InferenceTimeout, RequestCancelled, ServingQueueFull)
+    EngineShutdown, InferenceTimeout, RequestCancelled,
+    ServingOverloaded, ServingQueueFull)
+from deeplearning4j_tpu.serving.overload import (  # noqa: F401
+    OverloadConfig, OverloadController)
 from deeplearning4j_tpu.serving.paging import (  # noqa: F401
     PagedKVConfig, PageExhausted, PagePool)
 from deeplearning4j_tpu.serving.prefix_cache import PrefixCache  # noqa: F401
 from deeplearning4j_tpu.serving.request import (  # noqa: F401
     GenerationRequest, GenerationStream)
 from deeplearning4j_tpu.serving.scheduler import AdmissionQueue  # noqa: F401
+from deeplearning4j_tpu.serving.supervisor import (  # noqa: F401
+    EngineSupervisor)
 
-__all__ = ["AdmissionQueue", "EngineShutdown", "GenerationEngine",
-           "GenerationRequest", "GenerationStream", "InferenceTimeout",
+__all__ = ["AdmissionQueue", "EngineShutdown", "EngineSupervisor",
+           "GenerationEngine", "GenerationRequest", "GenerationStream",
+           "InferenceTimeout", "OverloadConfig", "OverloadController",
            "PagedKVConfig", "PageExhausted", "PagePool", "PrefixCache",
-           "RequestCancelled", "ServingQueueFull", "SpeculationConfig"]
+           "RequestCancelled", "ServingOverloaded", "ServingQueueFull",
+           "SpeculationConfig"]

@@ -68,6 +68,26 @@ Serving engine v2 extras, each orthogonal and composable:
   rejected positions — greedy outputs stay bit-identical to plain
   ``sample_stream`` (every committed token is the argmax chain), and
   the target's sampling distribution is exactly preserved.
+
+Survivability (PR 9, ARCHITECTURE.md "Serving survivability"):
+
+- ``supervisor=EngineSupervisor(...)`` replaces the terminal
+  fail-all with request-preserving recovery: a step-cycle fault
+  quarantines the arena and rebuilds it from the host-side ledger,
+  re-admitting every in-flight request bit-identically; a windowed
+  ``RestartBudget`` bounds the rebuild rate and escalates to the
+  original ``_break`` when exhausted.
+- ``overload=OverloadConfig(...)`` adds SLO-aware admission control:
+  sustained-breach shedding of low-priority queued work
+  (``ServingOverloaded``), deadline-based early rejection at submit,
+  and the page-pressure brownout ladder (reduced gamma → speculation
+  off → prefix-cache inserts off, auto-restoring).
+- ``drain(timeout)`` stops admission and finishes the actives — the
+  clean handoff point for planned restarts.
+- ``seat_chaos`` fires in the pop-to-seat admission window (the
+  handoff seam the supervisor also covers); ``prefill_chaos`` /
+  ``seat_chaos`` receive the request as event context, so
+  ``resilience.chaos.RequestFaultInjector`` can target named victims.
 """
 
 from __future__ import annotations
@@ -90,14 +110,20 @@ from deeplearning4j_tpu.nn.conf.layers import (
 from deeplearning4j_tpu.resilience.chaos import fire as _fire_chaos
 from deeplearning4j_tpu.resilience.retry import RetryPolicy, retry_call
 from deeplearning4j_tpu.serving.errors import (
-    EngineShutdown, InferenceTimeout, RequestCancelled, ServingQueueFull)
+    EngineShutdown, InferenceTimeout, RequestCancelled,
+    ServingOverloaded, ServingQueueFull)
 from deeplearning4j_tpu.serving.health import (
-    SERVING_ACTIVE_SLOTS, SERVING_DEADLINE_EXCEEDED, SERVING_ERRORS,
-    SERVING_KV_PAGES_TOTAL, SERVING_KV_PAGES_USED, SERVING_PREFIX_HITS,
-    SERVING_PREFIX_MISSES, SERVING_PREFIX_REUSED_TOKENS,
-    SERVING_QUEUE_REJECTED, SERVING_QUEUE_WAIT, SERVING_REQUESTS,
+    SERVING_ACTIVE_SLOTS, SERVING_BROWNOUT_LEVEL,
+    SERVING_DEADLINE_EXCEEDED, SERVING_DRAINING, SERVING_EARLY_REJECTED,
+    SERVING_ERRORS, SERVING_KV_PAGES_TOTAL, SERVING_KV_PAGES_USED,
+    SERVING_PREFIX_HITS, SERVING_PREFIX_MISSES,
+    SERVING_PREFIX_REUSED_TOKENS, SERVING_QUEUE_REJECTED,
+    SERVING_QUEUE_WAIT, SERVING_REQUESTS, SERVING_SHED,
     SERVING_SPEC_ACCEPTANCE, SERVING_TOKENS, SERVING_TPOT, SERVING_TTFT,
     register_serving_metrics, scrape_probe)
+from deeplearning4j_tpu.serving.overload import (
+    BROWNOUT_NO_PREFIX_INSERTS, BROWNOUT_NO_SPECULATION,
+    BROWNOUT_REDUCED_GAMMA, OverloadConfig, OverloadController)
 from deeplearning4j_tpu.serving.paging import (
     PagedKVConfig, PagePool, gather_pages, pages_needed, scatter_pages)
 from deeplearning4j_tpu.serving.prefix_cache import PrefixCache
@@ -173,10 +199,12 @@ class GenerationEngine:
                  prime_padded: bool = True,
                  registry: Optional[MetricsRegistry] = None,
                  name: Optional[str] = None,
-                 prefill_chaos=None, decode_chaos=None,
+                 prefill_chaos=None, decode_chaos=None, seat_chaos=None,
                  decode_retry: Optional[RetryPolicy] = None,
                  paging: Optional[PagedKVConfig] = None,
-                 speculation: Optional[SpeculationConfig] = None):
+                 speculation: Optional[SpeculationConfig] = None,
+                 supervisor=None,
+                 overload=None):
         if not hasattr(net, "rnn_time_step"):
             raise TypeError("GenerationEngine needs a streaming net "
                             "(rnn_time_step / rnn_clear_previous_state)")
@@ -264,7 +292,22 @@ class GenerationEngine:
         self._dispatches = 0
         self._prefill_chaos = prefill_chaos
         self._decode_chaos = decode_chaos
+        self._seat_chaos = seat_chaos
         self._decode_retry = decode_retry
+        # -- survivability (serving/supervisor.py, serving/overload.py)
+        self._supervisor = supervisor
+        if isinstance(overload, OverloadConfig):
+            overload = OverloadController(overload)
+        self._overload: Optional[OverloadController] = overload
+        if overload is not None:
+            overload._bind(self)
+        self._brownout = 0
+        self._draining = False
+        #: the pop-to-seat handoff window: a request popped from the
+        #: admission queue but not yet seated in a slot lives here so a
+        #: fault in that window can fail (or recover) it instead of
+        #: stranding its handle with no terminal event
+        self._seating: Optional[GenerationRequest] = None
         self._stop = threading.Event()
         self._worker: Optional[threading.Thread] = None
         self._broken: Optional[BaseException] = None
@@ -323,6 +366,26 @@ class GenerationEngine:
                 SERVING_SPEC_ACCEPTANCE, "Per-slot fraction of draft "
                 "proposals accepted by a verify dispatch",
                 ("model",)).labels(**lab)
+        r.gauge(SERVING_DRAINING, "Engine draining: admission stopped, "
+                "actives finishing (1) or serving normally (0)",
+                ("model",)).set_function(
+            scrape_probe(self, lambda s: 1.0 if s._draining else 0.0),
+            model=self._label)
+        if self._supervisor is not None:
+            self._supervisor._bind(self, registry)
+        if self._overload is not None:
+            self._shed_counter = r.counter(
+                SERVING_SHED, "Queued requests shed under a sustained "
+                "SLO breach", ("model",)).labels(**lab)
+            self._early_rejected = r.counter(
+                SERVING_EARLY_REJECTED, "Submits refused because their "
+                "deadline provably cannot be met",
+                ("model",)).labels(**lab)
+            r.gauge(SERVING_BROWNOUT_LEVEL, "Brownout ladder rung: 0 "
+                    "off, 1 reduced gamma, 2 speculation off, 3 prefix "
+                    "inserts off", ("model",)).set_function(
+                scrape_probe(self, lambda s: float(s._brownout)),
+                model=self._label)
 
     # ------------------------------------------------------------------
     # health / readiness (the ParallelInference probe contract)
@@ -335,7 +398,8 @@ class GenerationEngine:
         return True
 
     def is_ready(self) -> bool:
-        return self.is_healthy() and not self._pending.full()
+        return self.is_healthy() and not self._draining \
+            and not self._pending.full()
 
     def queue_depth(self) -> int:
         return self._pending.depth()
@@ -361,6 +425,17 @@ class GenerationEngine:
                                        self._prefix.reused_tokens}
         if self._speculation is not None:
             out["speculation"] = {"gamma": self._speculation.gamma}
+        if self._draining:
+            out["draining"] = True
+        if self._supervisor is not None:
+            out["supervisor"] = self._supervisor.health()
+        if self._overload is not None:
+            out["overload"] = {
+                "brownout_level": self._brownout,
+                "shed_total": self._overload.shed_total,
+                "early_rejected_total":
+                    self._overload.early_rejected_total,
+            }
         return out
 
     @property
@@ -394,6 +469,9 @@ class GenerationEngine:
                                  f"{self._broken!r}")
         if self._stop.is_set():
             raise EngineShutdown("GenerationEngine shut down")
+        if self._draining:
+            raise EngineShutdown("GenerationEngine draining — submit "
+                                 "to the replacement instance")
         prompt = [int(t) for t in prompt]
         if max_length is None:
             max_length = self._cap
@@ -432,6 +510,12 @@ class GenerationEngine:
             prompt, steps, temperature=temperature, top_k=top_k,
             top_p=top_p, stop_tokens=stop_tokens, rng=rng,
             max_length=max_length, deadline=deadline, priority=priority)
+        if self._overload is not None:
+            reason = self._overload.reject_at_submit(
+                self, req, time.monotonic())
+            if reason is not None:
+                self._early_rejected.inc()
+                raise ServingOverloaded(reason)
         try:
             self._pending.submit(req)
         except ServingQueueFull:
@@ -446,30 +530,67 @@ class GenerationEngine:
     # the dispatch cycle
     # ------------------------------------------------------------------
     def step(self) -> bool:
-        """One engine cycle: expire/cancel, admit into free slots, one
-        decode (or widened speculative verify) dispatch over the arena,
-        sample + stream + retire. Returns whether any progress was made
-        (False = idle)."""
+        """One engine cycle: expire/cancel, shed under overload, admit
+        into free slots, one decode (or widened speculative verify)
+        dispatch over the arena, sample + stream + retire. Returns
+        whether any progress was made (False = idle).
+
+        The WHOLE cycle past reaping is one failure domain: a fault
+        anywhere — the pop-to-seat admission window included, not just
+        the dispatch — lands in one place where the supervisor (if any)
+        can quarantine + rebuild the arena from the request ledger;
+        without one (or with the restart budget exhausted) the engine
+        falls to the terminal ``_break`` fail-all."""
         with self._lock:
             if self._stop.is_set() or self._broken is not None:
                 return False
             now = time.monotonic()
             progress = self._reap(now) > 0
-            progress = self._admit_ready(now) > 0 or progress
-            active = [s for s, r in enumerate(self._slots)
-                      if r is not None]
-            if not active:
-                return progress
             try:
+                if self._overload is not None:
+                    progress = self._apply_overload(now) or progress
+                if not self._draining:
+                    progress = self._admit_ready(now) > 0 or progress
+                active = [s for s, r in enumerate(self._slots)
+                          if r is not None]
+                if not active:
+                    return progress
                 if self._speculation is not None:
                     self._step_speculative(active)
                 else:
                     self._step_plain(active)
             except Exception as e:  # noqa: BLE001 — fail waiters, not hang
                 self._handles[SERVING_ERRORS].inc()
+                if self._recover(e):
+                    return True
                 self._break(e)
                 return False
             return True
+
+    def _recover(self, exc: BaseException) -> bool:
+        """Hand a step-cycle fault to the supervisor (if any): True =
+        the arena was rebuilt and every in-flight request re-admitted
+        bit-identically, keep serving."""
+        if self._supervisor is None:
+            return False
+        cause = ("admission_fault" if self._seating is not None
+                 else "decode_fault")
+        return self._supervisor.on_dispatch_fault(self, exc, cause)
+
+    def _apply_overload(self, now: float) -> bool:
+        """One overload-control tick: shed queued work under a
+        sustained SLO breach, refresh the brownout rung from page
+        pressure. Host-only; runs before admission so a shed victim is
+        never admitted on the same step."""
+        ov = self._overload
+        victims = ov.shed(self)
+        for req in victims:
+            self._shed_counter.inc()
+            req.handle._fail(ServingOverloaded(
+                "shed from the admission queue under a sustained "
+                "latency-SLO breach (lowest-priority first)"))
+        self._brownout = ov.brownout_level(self)
+        return bool(victims)
 
     def _step_plain(self, active) -> None:
         """One canonical [S, V, 1] decode dispatch + one draw per row."""
@@ -502,6 +623,14 @@ class GenerationEngine:
         advance multiple positions per engine step."""
         spec = self._speculation
         k = spec.gamma
+        # brownout ladder: a reduced (or zero) gamma pads the SAME
+        # widened [S, V, 1+gamma] dispatch with fewer real proposals —
+        # feature degradation with zero shape changes, zero retraces
+        g_cap = k
+        if self._brownout >= BROWNOUT_NO_SPECULATION:
+            g_cap = 0
+        elif self._brownout >= BROWNOUT_REDUCED_GAMMA:
+            g_cap = self._overload.brownout_gamma(k)
         if self._cap is not None:
             for s in active:
                 if self._slots[s] is not None \
@@ -515,8 +644,11 @@ class GenerationEngine:
             if req is None:
                 continue
             riders.append(s)
-            g = min(k, req.want - len(req.handle._ids))
-            p = [int(t) for t in spec.draft(list(req.handle._ids), g)][:g]
+            g = min(g_cap, req.want - len(req.handle._ids))
+            # g <= 0 (brownout rung 2+, or one token wanted): don't pay
+            # the host draft — the rung exists to SHED host/device work
+            p = ([int(t) for t in spec.draft(list(req.handle._ids), g)][:g]
+                 if g > 0 else [])
             props[s] = p
             q_dists[s] = [None] * len(p)   # deterministic = one-hot draft
             chunk[s, 0] = req.pending_token
@@ -629,27 +761,53 @@ class GenerationEngine:
 
     def _admit_ready(self, now: float) -> int:
         """Fill free slots from the admission queue in priority order
-        (paged mode: while the head request's pages fit)."""
+        (paged mode: while the head request's pages fit).
+
+        Every popped request is pinned to ``self._seating`` until it is
+        seated in a slot or its handle carries a terminal event: the
+        pop-to-seat window is otherwise invisible to both the slot scan
+        and the queue drain, and a fault inside it (arena join, the
+        admission draw, a chaos hook) would strand the handle with no
+        terminal event — callers blocked forever on a request the
+        engine no longer knows about."""
         n = 0
         gate = self._pages_admissible if self._pool is not None else None
         while None in self._slots:
             req = self._pending.pop(admissible=gate)
             if req is None:
                 break
+            self._seating = req
             n += 1
-            if req.handle.cancelled:
-                req.handle._fail(RequestCancelled(
-                    "request cancelled while queued"), reason="cancelled")
+            if self._fail_if_dead(req, now, "in the admission queue"):
+                self._seating = None
                 continue
-            if req.deadline is not None and now >= req.deadline:
-                self._handles[SERVING_DEADLINE_EXCEEDED].inc()
-                req.handle._fail(InferenceTimeout(
-                    "deadline expired in the admission queue"))
-                continue
+            _fire_chaos(self._seat_chaos, self._admissions, ctx=req)
             req.handle.queue_wait_s = now - req.submit_t
             self._queue_wait_hist.observe(req.handle.queue_wait_s)
+            if self._overload is not None:
+                self._overload.observe_queue_wait(req.handle.queue_wait_s)
             self._admit_one(req, self._slots.index(None))
+            self._seating = None
         return n
+
+    def _fail_if_dead(self, req, now: float, where: str) -> bool:
+        """Give `req` its terminal event if it was cancelled or its
+        deadline has passed (or it already carries one); True means the
+        caller must skip it. The ONE cancel/deadline gate shared by the
+        admission pop and the rebuild's re-admissions, so the recovery
+        path can never drift from the admission path's semantics."""
+        if req.handle.done:
+            return True
+        if req.handle.cancelled:
+            req.handle._fail(RequestCancelled(
+                f"request cancelled {where}"), reason="cancelled")
+            return True
+        if req.deadline is not None and now >= req.deadline:
+            self._handles[SERVING_DEADLINE_EXCEEDED].inc()
+            req.handle._fail(InferenceTimeout(
+                f"deadline expired {where}"))
+            return True
+        return False
 
     def _alloc_request_pages(self, req: GenerationRequest):
         """Reserve the request's worst-case pages: look up the longest
@@ -709,58 +867,79 @@ class GenerationEngine:
             net._stream_pos_map = {n: hit_len
                                    for n in self._graph_vertices}
 
-    def _admit_one(self, req: GenerationRequest, slot: int) -> None:
+    def _admit_one(self, req: GenerationRequest, slot: int,
+                   readmit: bool = False) -> None:
         """Prefill `req` at batch 1 and join it to the arena at `slot`.
         A prefill failure fails THAT request only: the arena state is
         restored untouched (and the request's pages released), so
-        in-flight requests are unaffected."""
+        in-flight requests are unaffected.
+
+        ``readmit=True`` is the supervisor's recovery path: the request
+        already streamed tokens before the arena was quarantined, so
+        the prime feeds ``ids[:-1]`` (prompt + committed tokens minus
+        the pending one — exactly what the lost arena row had consumed)
+        and NOTHING else happens: no draw (the rng must stay at its
+        fault-time position), no token push, no TTFT/queue-wait
+        observation, no prefill chaos (the request already cleared
+        admission once). The next dispatch recomputes the identical
+        next-token distribution, so the stream continues bit-identical
+        to an unperturbed run."""
         net = self.net
         saved_state = dict(net.state)
         saved_acct = self._save_accounting()
+        prime_ids = req.handle._ids[:-1] if readmit else req.prompt
         table, hit_len = [], 0
         try:
             if self._pool is not None:
                 table, hit_len = self._alloc_request_pages(req)
-            _fire_chaos(self._prefill_chaos, self._admissions)
+            if not readmit:
+                _fire_chaos(self._prefill_chaos, self._admissions,
+                            ctx=req)
             net.rnn_clear_previous_state()
             if hit_len:
                 self._install_prefix(table, hit_len)
-                p0 = prime_prompt(net, req.prompt[hit_len:], self.V,
+                p0 = prime_prompt(net, prime_ids[hit_len:], self.V,
                                   padded=self._prime_padded)
             else:
-                p0 = prime_prompt(net, req.prompt, self.V,
+                p0 = prime_prompt(net, prime_ids, self.V,
                                   padded=self._prime_padded)
             primed_pos = self._net_pos(net)
         except Exception as e:  # noqa: BLE001 — per-request failure domain
             net.state = saved_state
             self._restore_accounting(saved_acct)
             self._release_pages(table)
-            self._admissions += 1
+            if not readmit:
+                self._admissions += 1
             self._handles[SERVING_ERRORS].inc()
             req.handle._fail(e)
             return
-        self._admissions += 1
         primed_state = dict(net.state)
-        tok = draw(p0, req.temperature, req.rng,
-                   top_k=req.top_k, top_p=req.top_p)
-        now = time.monotonic()
-        req.handle.ttft_s = now - req.submit_t
-        self._ttft_hist.observe(req.handle.ttft_s)
-        req.last_token_t = now
-        req.handle._push(tok)
-        self._tokens.inc()
-        reason = stop_reason(tok, len(req.handle._ids), req.want,
-                             req.stop_tokens)
-        if reason is None and self._cap is not None \
-                and primed_pos >= self._cap:
-            reason = "capacity"    # prompt filled the stream: no room
-        if reason:
-            # one-token request: never enters the arena at all
-            net.state = saved_state
-            self._restore_accounting(saved_acct)
-            self._release_pages(table)
-            req.handle._finish(reason)
-            return
+        if readmit:
+            tok = req.handle._ids[-1]    # pending, drawn pre-fault
+        else:
+            self._admissions += 1
+            tok = draw(p0, req.temperature, req.rng,
+                       top_k=req.top_k, top_p=req.top_p)
+            now = time.monotonic()
+            req.handle.ttft_s = now - req.submit_t
+            self._ttft_hist.observe(req.handle.ttft_s)
+            if self._overload is not None:
+                self._overload.observe_ttft(req.handle.ttft_s, now)
+            req.last_token_t = now
+            req.handle._push(tok)
+            self._tokens.inc()
+            reason = stop_reason(tok, len(req.handle._ids), req.want,
+                                 req.stop_tokens)
+            if reason is None and self._cap is not None \
+                    and primed_pos >= self._cap:
+                reason = "capacity"  # prompt filled the stream: no room
+            if reason:
+                # one-token request: never enters the arena at all
+                net.state = saved_state
+                self._restore_accounting(saved_acct)
+                self._release_pages(table)
+                req.handle._finish(reason)
+                return
         if not self._arena_ready:
             if self._pool is not None:
                 self._init_page_store(primed_state)
@@ -770,7 +949,8 @@ class GenerationEngine:
         if self._pool is not None:
             self._scatter_primed_pages(primed_state, table)
             self._page_tables[slot] = table
-            if self._prefix is not None:
+            if self._prefix is not None \
+                    and self._brownout < BROWNOUT_NO_PREFIX_INSERTS:
                 self._prefix.insert(req.prompt, table)
         self._slots[slot] = req
         self._row_pos[slot] = primed_pos
@@ -780,6 +960,85 @@ class GenerationEngine:
     def _release_pages(self, table) -> None:
         for p in table:
             self._pool.release(p)
+
+    # ------------------------------------------------------------------
+    # supervised recovery (serving/supervisor.py drives this)
+    # ------------------------------------------------------------------
+    def _quarantine_rebuild(self) -> int:
+        """Drop the (possibly poisoned) device arena WHOLESALE and
+        rebuild it from the host-side request ledger: fresh page pool +
+        page tables + prefix cache (re-seeded by the re-primes), fresh
+        arena skeleton on first re-admission, every surviving request
+        re-primed from prompt + committed tokens with its pending token
+        and untouched rng — each stream continues bit-identical to an
+        unperturbed run. Returns the number of survivors re-admitted.
+        Runs under the step lock (the supervisor is called from the
+        step cycle's failure path).
+
+        The rebuild reuses the warm prefill buckets and the compiled
+        arena scatter/gather shapes, so after a full-envelope
+        ``warmup()`` a recovery compiles nothing new (test-pinned)."""
+        survivors = [(s, r) for s, r in enumerate(self._slots)
+                     if r is not None]
+        seating, self._seating = self._seating, None
+        self._slots = [None] * self.slots
+        self._row_pos = np.zeros(self.slots, np.int64)
+        self._arena_ready = False
+        self._merge_keys = None
+        if self._pool is not None:
+            # fresh pool: the old one's refcounts may be mid-mutation
+            # from the failed cycle (and chaos seizures die with it)
+            self._pool = PagePool(self._pool.total_pages, self._ps)
+            self._prefix = (PrefixCache(self._pool)
+                            if self._prefix is not None else None)
+            self._page_store = None
+            self._paged_keys = None
+            self._page_tables = [[] for _ in range(self.slots)]
+        self.net.rnn_clear_previous_state()
+        self._sync_accounting()
+        if self._overload is not None:
+            # the replacement pool starts fresh: recompute the rung so
+            # the re-primes aren't gated by pre-fault page pressure
+            # (rung 3 would silently skip re-seeding the prefix cache)
+            self._brownout = self._overload.brownout_level(self)
+        now = time.monotonic()
+        n = 0
+        try:
+            for slot, req in survivors:
+                if self._fail_if_dead(req, now, "during recovery"):
+                    continue
+                self._admit_one(req, slot, readmit=True)
+                if self._slots[slot] is req:
+                    n += 1
+            if seating is not None and self._fail_if_dead(
+                    seating, now, "during recovery"):
+                seating = None
+            if seating is not None:
+                # the pop-to-seat window survivor: re-primed if it
+                # already streamed tokens, freshly admitted otherwise
+                free = self._slots.index(None)  # its pop guarantees one
+                already = len(seating.handle._ids) > len(seating.prompt)
+                self._admit_one(seating, free, readmit=already)
+                if self._slots[free] is seating or (
+                        seating.handle.done
+                        and seating.handle.error is None):
+                    n += 1                   # seated, or finished clean
+        except BaseException as e:
+            # a fault raised mid-rebuild must strand nobody: the slots
+            # and _seating were cleared up front, so the escalation
+            # _break can no longer see survivors that didn't make it
+            # back in — fail every unseated, unresolved handle HERE,
+            # then let the supervisor escalate (seated survivors get
+            # their terminal event from _break's slot scan)
+            seated = {id(r) for r in self._slots if r is not None}
+            for _, req in survivors:
+                if id(req) not in seated and not req.handle.done:
+                    req.handle._fail(e)
+            if seating is not None and id(seating) not in seated \
+                    and not seating.handle.done:
+                seating.handle._fail(e)
+            raise
+        return n
 
     def _init_page_store(self, primed_state) -> None:
         """First-admission pool build: one device page array per paged
@@ -1078,6 +1337,10 @@ class GenerationEngine:
             for j, b in enumerate(sorted(set(sfx))):
                 lead = 1 + j % (self.V - 1) if self.V > 1 else 0
                 drive([0] * ps + [lead] * b)
+        if self._overload is not None:
+            # warmup TTFTs carry compile time — real traffic must not
+            # inherit them as breach evidence or an admission rate
+            self._overload.reset_observations()
         return self
 
     # ------------------------------------------------------------------
@@ -1099,7 +1362,12 @@ class GenerationEngine:
         try:
             while not self._stop.is_set():
                 if not self.step():
-                    self._pending.wait(0.02)
+                    if self._draining:
+                        # the queue is closed while draining: wait()
+                        # would return immediately and busy-spin
+                        time.sleep(0.02)
+                    else:
+                        self._pending.wait(0.02)
         except Exception as e:  # noqa: BLE001 — strand no waiters
             log.exception("GenerationEngine loop died")
             self._break(e)
@@ -1108,17 +1376,58 @@ class GenerationEngine:
         """Terminal failure: fail every in-flight and queued request
         with the original error and refuse new work. A broken arena is
         not resumable (the failed dispatch may or may not have consumed
-        positions)."""
+        positions). With a supervisor this is the ESCALATION state —
+        recovery already declined (budget exhausted / rebuild failed)."""
         with self._lock:
             self._broken = exc
             # stop the loop too: with the queue closed, wait() returns
             # immediately — a broken engine must park, not busy-spin
             self._stop.set()
+            if self._seating is not None:
+                # popped but never seated: fail it here or nobody will
+                req, self._seating = self._seating, None
+                if not req.handle.done:
+                    req.handle._fail(exc)
             for s, req in enumerate(self._slots):
                 if req is not None:
                     self._retire(s, "error", exc)
             for req in self._pending.close():
                 req.handle._fail(exc)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Stop admission and finish the actives: the clean handoff
+        point for a planned restart (config rollout, re-shard, binary
+        upgrade). New submits are refused (``EngineShutdown``), queued
+        never-prefilled requests fail immediately with the same (their
+        callers resubmit to the replacement instance — cheaper than
+        making them wait out a drain they cannot benefit from), and
+        every ACTIVE request runs to its natural retirement: work
+        already prefilled is work worth finishing.
+
+        Works under the background loop (waits for it to finish the
+        actives) or in manual mode (drives ``step()`` itself). Returns
+        True when the arena emptied within `timeout` (None = wait
+        forever); False on timeout or a broken/shut-down engine — the
+        handoff then needs the supervisor's escalation story, not a
+        clean restart."""
+        self._draining = True
+        for req in self._pending.close():
+            req.handle._fail(EngineShutdown(
+                "GenerationEngine draining — resubmit to the "
+                "replacement instance"))
+        deadline = None if timeout is None else \
+            time.monotonic() + float(timeout)
+        threaded = self._worker is not None and self._worker.is_alive()
+        while self.active_slots() > 0 and self._broken is None \
+                and not self._stop.is_set():
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            if threaded:
+                time.sleep(0.005)
+            elif not self.step():
+                break
+        return self.active_slots() == 0 and self._broken is None \
+            and not self._stop.is_set()
 
     def shutdown(self) -> None:
         """Stop the loop and fail everything still in flight — nobody
@@ -1130,6 +1439,11 @@ class GenerationEngine:
         if self._worker is not None and self._worker.is_alive():
             self._worker.join(timeout=5.0)
         with self._lock:
+            if self._seating is not None:
+                req, self._seating = self._seating, None
+                if not req.handle.done:
+                    req.handle._fail(EngineShutdown(
+                        "GenerationEngine shut down"))
             for s, req in enumerate(self._slots):
                 if req is not None:
                     self._retire(s, "error", EngineShutdown(

@@ -11,7 +11,7 @@ back-compat with pre-serving/ imports.
 from __future__ import annotations
 
 __all__ = ["EngineShutdown", "InferenceTimeout", "RequestCancelled",
-           "ServingQueueFull"]
+           "ServingOverloaded", "ServingQueueFull"]
 
 
 class InferenceTimeout(TimeoutError):
@@ -28,3 +28,11 @@ class RequestCancelled(RuntimeError):
 
 class EngineShutdown(RuntimeError):
     """The serving component stopped before this request finished."""
+
+
+class ServingOverloaded(RuntimeError):
+    """SLO-aware overload control refused this request: either shed from
+    the queue under a sustained latency-SLO breach, or rejected at
+    submit because its deadline provably cannot be met given the current
+    queue estimate (early rejection beats wasted prefill). Retryable
+    against a less-loaded replica, or later with backoff."""

@@ -46,6 +46,17 @@ SERVING_PREFIX_REUSED_TOKENS = \
     "dl4jtpu_serving_prefix_cache_reused_tokens_total"
 SERVING_SPEC_ACCEPTANCE = "dl4jtpu_serving_spec_acceptance_ratio"
 
+#: survivability layer (supervisor.py / overload.py register these)
+SERVING_ENGINE_REBUILDS = "dl4jtpu_serving_engine_rebuilds_total"
+SERVING_ENGINE_ESCALATIONS = \
+    "dl4jtpu_serving_engine_escalations_total"
+SERVING_RECOVERED_REQUESTS = \
+    "dl4jtpu_serving_recovered_requests_total"
+SERVING_SHED = "dl4jtpu_serving_shed_total"
+SERVING_EARLY_REJECTED = "dl4jtpu_serving_early_rejected_total"
+SERVING_BROWNOUT_LEVEL = "dl4jtpu_serving_brownout_level"
+SERVING_DRAINING = "dl4jtpu_serving_draining"
+
 _COUNTERS = (
     (SERVING_REQUESTS, "Serving requests received"),
     (SERVING_ERRORS, "Serving requests failed by model errors"),

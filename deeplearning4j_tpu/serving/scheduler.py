@@ -45,6 +45,41 @@ class AdmissionQueue:
         with self._cond:
             return len(self._heap) >= self.limit
 
+    def depth_ahead(self, priority: int) -> int:
+        """Queued requests that would be admitted BEFORE a new request
+        of `priority`: every strictly-higher class plus the whole
+        equal-priority class (admission is FIFO within a class, so an
+        arriving request queues behind all of its peers). The overload
+        controller's queue-position estimate for deadline-based early
+        rejection."""
+        with self._cond:
+            return sum(1 for item in self._heap
+                       if item[2].priority >= priority)
+
+    def shed_lowest(self, keep: int) -> List[GenerationRequest]:
+        """Remove (and return) queued requests until at most `keep`
+        remain, victimizing the LOWEST priority class first and, within
+        a class, the most recent arrival first (the request that would
+        have waited longest sheds first — earlier arrivals have the
+        most sunk queue-wait and the best chance of admission before
+        their deadline). The engine fails the returned handles with
+        ``ServingOverloaded``; the queue never touches handles
+        itself."""
+        with self._cond:
+            n = len(self._heap) - max(0, int(keep))
+            if n <= 0:
+                return []
+            # victims: ascending priority, then descending arrival seq
+            order = sorted(self._heap,
+                           key=lambda it: (-it[0], -it[1]))
+            victims = order[:n]
+            gone = {id(it[2]) for it in victims}
+            self._heap = [it for it in self._heap
+                          if id(it[2]) not in gone]
+            heapq.heapify(self._heap)
+            self._cond.notify_all()      # wake blocked submitters
+            return [it[2] for it in victims]
+
     def submit(self, req: GenerationRequest) -> None:
         """Enqueue under the admission policy. ``block`` waits for space
         bounded by the request's deadline (forever with none — the

@@ -62,6 +62,12 @@ python -m pytest tests/test_elastic.py -q -p no:cacheprovider
 # guard across staggered admissions
 python -m pytest tests/test_serving_engine.py -q -p no:cacheprovider
 
+# tier-1 serving-survivability lane: supervised recovery (bit-identical
+# continuation after arena rebuilds), restart-budget escalation,
+# SLO shedding / early rejection / brownout, draining, and the
+# pop-to-seat window regression (serving/supervisor.py, overload.py).
+python -m pytest tests/test_serving_supervisor.py -q -p no:cacheprovider
+
 # tier-1 serving-v2 lane: the block-paged KV arena, prefix cache, and
 # in-engine speculation — paged==slot-arena==one-shot bit-exactness,
 # token-budget admission (incl. the oversized-request submit rejection),

@@ -396,6 +396,152 @@ class TestHygieneRules:
 
 
 # ---------------------------------------------------------------------
+# rule: lock-held-across-dispatch
+# ---------------------------------------------------------------------
+class TestLockHeldAcrossDispatchRule:
+    def test_positive_jitted_and_syncs_under_lock(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import threading
+            import jax
+            from functools import partial
+
+            @jax.jit
+            def _dispatch(x):
+                return x + 1
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def _donate(x):
+                return x * 2
+
+            class Engine:
+                def step(self, x):
+                    with self._lock:
+                        y = _dispatch(x)
+                        z = _donate(x)
+                        w = self.net.rnn_time_step(x)
+                        jax.device_get(y)
+                        y.block_until_ready()
+                    return y
+        """)
+        assert _rules_of(fs) == ["lock-held-across-dispatch"] * 5
+
+    def test_positive_known_dispatch_helpers(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import threading
+            from deeplearning4j_tpu.util.decoding import step_tokens
+            from deeplearning4j_tpu.serving.paging import gather_pages
+
+            class Engine:
+                def step(self, toks):
+                    with self._lock:
+                        view = gather_pages(self.pools, self.table,
+                                            length=8)
+                        return step_tokens(self.net, toks, 12)
+        """)
+        assert _rules_of(fs) == ["lock-held-across-dispatch"] * 2
+
+    def test_negative_snapshot_under_lock_dispatch_outside(self,
+                                                           tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import threading
+            import jax
+
+            @jax.jit
+            def _dispatch(x):
+                return x + 1
+
+            class Engine:
+                def step(self, x):
+                    with self._lock:
+                        snap = dict(self.state)   # host-only under lock
+                    return _dispatch(snap)        # dispatch outside
+        """)
+        assert fs == []
+
+    def test_negative_condition_wait_is_the_queue_idiom(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import threading
+            import jax
+
+            @jax.jit
+            def _dispatch(x):
+                return x + 1
+
+            class Q:
+                def pop(self, x):
+                    with self._cond:
+                        self._cond.wait(0.1)
+                        return _dispatch(x)       # cond, not a lock
+        """)
+        assert fs == []
+
+    def test_negative_lock_in_outer_function_not_this_scope(self,
+                                                            tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import threading
+            import jax
+
+            @jax.jit
+            def _dispatch(x):
+                return x + 1
+
+            def outer(self, x):
+                with self._lock:
+                    def cb():
+                        return _dispatch(x)       # runs LATER, unlocked
+                    self.cb = cb
+        """)
+        assert fs == []
+
+    def test_negative_lambda_defined_under_lock_runs_later(self,
+                                                           tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import threading
+            import jax
+
+            @jax.jit
+            def _dispatch(x):
+                return x + 1
+
+            def outer(self, x):
+                with self._lock:
+                    self.cb = lambda: _dispatch(x)  # deferred, unlocked
+        """)
+        assert fs == []
+
+    def test_inline_suppression(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import threading
+            import jax
+
+            @jax.jit
+            def _dispatch(x):
+                return x + 1
+
+            class Engine:
+                def step(self, x):
+                    with self._lock:
+                        # single-threaded dispatcher: submit/health
+                        # read lock-free, so only step() waits here
+                        # tpulint: disable=lock-held-across-dispatch
+                        return _dispatch(x)
+        """)
+        assert fs == []
+
+    def test_repo_serving_parallel_hot_paths_are_clean(self):
+        """The serving engine keeps submit/health/metrics OFF its step
+        lock and its dispatches behind method seams that snapshot
+        first; the repo carries no lexical lock-held dispatch (any
+        future justified hold must carry an inline suppression)."""
+        from deeplearning4j_tpu.analysis.rules.lock_dispatch import (
+            LockHeldAcrossDispatchRule)
+        fs = scan_paths([str(PKG / "serving"), str(PKG / "parallel"),
+                         str(PKG / "nn"), str(PKG / "pipeline")],
+                        [LockHeldAcrossDispatchRule()], root=str(REPO))
+        assert fs == []
+
+
+# ---------------------------------------------------------------------
 # rule: unbounded-retry
 # ---------------------------------------------------------------------
 class TestUnboundedRetryRule:
@@ -881,7 +1027,8 @@ class TestSelfScan:
             "tracer-leak", "recompile-hazard",
             "dtype-promotion", "unlocked-thread-state", "bare-except",
             "mutable-default-arg", "unbounded-retry",
-            "non-atomic-state-write", "stale-world-snapshot"}
+            "non-atomic-state-write", "stale-world-snapshot",
+            "lock-held-across-dispatch"}
         assert RULES_BY_ID["host-sync-in-hot-loop"].severity == "error"
         assert RULES_BY_ID["device-transfer-in-hot-loop"].severity == \
             "warning"

@@ -1,0 +1,748 @@
+"""Serving survivability (serving/supervisor.py, serving/overload.py,
+engine recovery/drain): request-preserving arena rebuilds bit-identical
+to an unperturbed run (greedy + sampled, slot + paged arenas, prefix
+cache + speculation on), restart-budget escalation to the terminal
+fail-all, the pop-to-seat handoff window, SLO shedding, deadline-based
+early rejection, the brownout ladder, draining, and the
+zero-retraces-after-recovery guard."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitoring
+from deeplearning4j_tpu.monitoring import runtime
+from deeplearning4j_tpu.monitoring.metrics import MetricsRegistry
+from deeplearning4j_tpu.resilience import chaos
+from deeplearning4j_tpu.resilience.retry import RestartBudget
+from deeplearning4j_tpu.serving import (
+    AdmissionQueue, EngineShutdown, EngineSupervisor, GenerationEngine,
+    GenerationRequest, InferenceTimeout, OverloadConfig, PagedKVConfig,
+    RequestCancelled, ServingOverloaded, SpeculationConfig)
+from deeplearning4j_tpu.serving.health import (
+    SERVING_BROWNOUT_LEVEL, SERVING_DRAINING,
+    SERVING_ENGINE_ESCALATIONS, SERVING_ENGINE_REBUILDS,
+    SERVING_RECOVERED_REQUESTS, SERVING_SHED)
+from deeplearning4j_tpu.serving.overload import OverloadController
+from deeplearning4j_tpu.util.decoding import prompt_lookup_proposer
+from deeplearning4j_tpu.zoo import TextGenerationTransformer
+
+V = 12
+PROMPTS = [[1, 2, 3, 4, 5], [6, 7], [8, 9, 10, 1], [2, 4, 6]]
+
+
+@pytest.fixture(scope="module")
+def rope_net():
+    return TextGenerationTransformer(vocab_size=V, embed_dim=16,
+                                     n_heads=2, n_layers=2,
+                                     max_length=32,
+                                     positional="rope").init()
+
+
+def _run(net, prompts=None, steps=5, sampled=False, n_slots=2, **kw):
+    """Drive a trace to completion on a fresh engine; returns
+    (engine, handles)."""
+    eng = GenerationEngine(net, V, slots=n_slots, **kw)
+    hs = []
+    for i, p in enumerate(prompts or PROMPTS[:3]):
+        s = (dict(temperature=1.3, top_p=0.9) if sampled
+             else dict(top_k=1))
+        hs.append(eng.submit(p, steps=steps,
+                             rng=np.random.default_rng(i), **s))
+    eng.run_until_idle()
+    return eng, hs
+
+
+def _outs(handles):
+    return [h.result(timeout=0) for h in handles]
+
+
+# ---------------------------------------------------------------------
+# the acceptance bar: a mid-stream decode fault recovers every in-flight
+# request bit-identical to an unperturbed run
+# ---------------------------------------------------------------------
+class TestSupervisedRecovery:
+    def test_greedy_slot_arena_recovers_bit_identical(self, rope_net):
+        _, base = _run(rope_net)
+        want = _outs(base)
+        sup = EngineSupervisor(budget=RestartBudget(3, 60.0))
+        eng, hs = _run(rope_net, supervisor=sup,
+                       decode_chaos=chaos.FaultBurstInjector(n=2, k=1))
+        assert _outs(hs) == want
+        assert eng.is_healthy()
+        assert sup.rebuilds == 1 and sup.recovered_requests >= 1
+        assert sup.escalations == 0
+
+    def test_sampled_recovers_bit_identical(self, rope_net):
+        """The rng fast-forward is implicit: the per-request Generator
+        lives host-side and a failed dispatch never drew from it, so
+        re-priming from prompt + committed tokens continues SAMPLED
+        streams exactly (not just greedy argmax chains)."""
+        _, base = _run(rope_net, sampled=True)
+        want = _outs(base)
+        eng, hs = _run(rope_net, sampled=True,
+                       supervisor=EngineSupervisor(),
+                       decode_chaos=chaos.FaultBurstInjector(n=2, k=1))
+        assert _outs(hs) == want
+        assert eng.is_healthy()
+
+    def test_paged_with_prefix_cache_recovers(self, rope_net):
+        """Paged arena + prefix cache on: the rebuild re-creates pool,
+        page tables, AND the prefix cache (re-seeded by the re-primes),
+        and outputs stay bit-identical. Shared leading blocks make the
+        post-rebuild re-primes exercise the cache-hit path too."""
+        shared = [3, 1, 2, 0] * 2             # two full 4-token blocks
+        prompts = [shared + [5], shared + [7, 8], [9, 9]]
+        cfg = dict(prompts=prompts, paging=PagedKVConfig(page_size=4))
+        _, base = _run(rope_net, **cfg)
+        want = _outs(base)
+        sup = EngineSupervisor()
+        eng, hs = _run(rope_net, supervisor=sup,
+                       decode_chaos=chaos.FaultBurstInjector(n=3, k=1),
+                       **cfg)
+        assert _outs(hs) == want
+        assert eng.is_healthy() and sup.rebuilds == 1
+        # fresh pool: no page leaked through the rebuild
+        assert eng.page_pool.used_count() == len(eng.prefix_cache)
+
+    def test_speculative_recovery(self, rope_net):
+        cfg = dict(paging=PagedKVConfig(page_size=4),
+                   speculation=SpeculationConfig(
+                       draft=prompt_lookup_proposer(2), gamma=2))
+        _, base = _run(rope_net, **cfg)
+        want = _outs(base)
+        eng, hs = _run(rope_net, supervisor=EngineSupervisor(),
+                       decode_chaos=chaos.FaultBurstInjector(n=2, k=1),
+                       **cfg)
+        assert _outs(hs) == want
+        assert eng.is_healthy()
+
+    def test_rebuild_refreshes_brownout_and_reseeds_prefix(self,
+                                                           rope_net):
+        """Pre-fault page pressure (rung 3: no prefix inserts) must not
+        gate the rebuild's re-primes: the replacement pool starts
+        fresh, so the rung is recomputed before re-admission and the
+        prefix cache IS re-seeded by the shared leading blocks."""
+        shared = [3, 1, 2, 0] * 2            # two full 4-token blocks
+        prompts = [shared + [5], shared + [7, 8]]
+        cfg = dict(prompts=prompts, paging=PagedKVConfig(page_size=4))
+        _, base = _run(rope_net, **cfg)
+        want = _outs(base)
+        eng = GenerationEngine(rope_net, V, slots=2,
+                               supervisor=EngineSupervisor(),
+                               overload=OverloadConfig(),
+                               paging=PagedKVConfig(page_size=4))
+        hs = [eng.submit(p, steps=5, top_k=1,
+                         rng=np.random.default_rng(i))
+              for i, p in enumerate(prompts)]
+        eng.step()                           # seated, cache seeded
+        pool = eng.page_pool
+        pool.seize(pool.free_count())        # total pressure: rung 3
+        eng.step()
+        assert eng._brownout == 3
+        eng._decode_chaos = chaos.FaultBurstInjector(k=1)
+        eng.run_until_idle()                 # fault -> rebuild
+        assert eng._brownout == 0            # fresh pool: recomputed
+        assert len(eng.prefix_cache) > 0     # re-seeded, not skipped
+        assert _outs(hs) == want
+
+    def test_expired_survivor_fails_at_rebuild_not_readmitted(
+            self, rope_net):
+        """A survivor whose deadline passes during fault handling must
+        not pay a re-prefill or inflate the recovered count: the
+        rebuild fails it with InferenceTimeout (mirroring the
+        queue-pop check) instead of re-admitting it for the next
+        step's reap to kill one rebuild later."""
+        sup = EngineSupervisor()
+        eng = GenerationEngine(rope_net, V, slots=1, supervisor=sup)
+        h = eng.submit(PROMPTS[0], steps=20, top_k=1, timeout=60.0)
+        eng.step()                           # seated, mid-stream
+
+        def expire_then_fault():
+            eng._slots[0].deadline = time.monotonic() - 1.0
+            return chaos.InjectedFault()
+        eng._decode_chaos = chaos.FaultBurstInjector(
+            k=1, exc=expire_then_fault)
+        eng.run_until_idle()
+        with pytest.raises(InferenceTimeout):
+            h.result(timeout=2.0)
+        assert eng.is_healthy()              # rebuild itself succeeded
+        assert sup.rebuilds == 1 and sup.recovered_requests == 0
+
+    def test_fault_mid_rebuild_strands_no_waiters(self, rope_net):
+        """A fault raised from INSIDE the rebuild's re-admission (the
+        supervised escalation path) must still give every survivor a
+        terminal event: slots were cleared up front, so without the
+        rebuild's own cleanup the escalation _break could no longer
+        see the not-yet-readmitted survivors and their callers hung."""
+        sup = EngineSupervisor()
+        eng = GenerationEngine(rope_net, V, slots=2, supervisor=sup,
+                               decode_chaos=chaos.FaultBurstInjector(
+                                   n=1, k=1))
+        hs = [eng.submit(p, steps=6, top_k=1) for p in PROMPTS[:2]]
+        orig_admit = eng._admit_one
+        state = {"readmits": 0}
+
+        def flaky_admit(req, slot, readmit=False):
+            if readmit:
+                state["readmits"] += 1
+                if state["readmits"] == 2:   # second survivor's seat
+                    raise RuntimeError("device died mid-rebuild")
+            return orig_admit(req, slot, readmit=readmit)
+        eng._admit_one = flaky_admit
+        eng.run_until_idle()
+        assert all(h.done for h in hs), "a survivor was stranded"
+        assert not eng.is_healthy()
+        assert sup.rebuilds == 0 and sup.escalations == 1
+
+    def test_overload_controller_binds_one_engine(self, rope_net):
+        """A pre-built OverloadController carries one engine's SLO
+        evidence; wiring it into a second engine must raise, not
+        silently cross-contaminate shedding decisions."""
+        ctl = OverloadController(OverloadConfig())
+        GenerationEngine(rope_net, V, slots=1, overload=ctl)
+        with pytest.raises(ValueError, match="one OverloadController"):
+            GenerationEngine(rope_net, V, slots=1, overload=ctl)
+
+    def test_multi_fault_burst_within_budget(self, rope_net):
+        """K consecutive faults with budget >= K: every fault costs one
+        rebuild, every request still completes identically."""
+        _, base = _run(rope_net, steps=7)
+        want = _outs(base)
+        sup = EngineSupervisor(budget=RestartBudget(3, 60.0))
+        eng, hs = _run(rope_net, steps=7, supervisor=sup,
+                       decode_chaos=chaos.FaultBurstInjector(n=1, k=3))
+        assert _outs(hs) == want
+        assert sup.rebuilds == 3 and eng.is_healthy()
+
+    def test_rebuild_telemetry_and_health(self, rope_net):
+        reg = MetricsRegistry()
+        sup = EngineSupervisor()
+        eng, hs = _run(rope_net, registry=reg, name="engine:sup",
+                       supervisor=sup,
+                       decode_chaos=chaos.FaultBurstInjector(n=2, k=1))
+        assert all(h.done for h in hs)
+        snap = reg.snapshot_compact()
+        assert snap[SERVING_ENGINE_REBUILDS
+                    + "{cause=decode_fault,model=engine:sup}"] == 1
+        assert snap[SERVING_RECOVERED_REQUESTS
+                    + "{model=engine:sup}"] >= 1
+        h = eng.health()
+        assert h["supervisor"]["rebuilds"] == 1
+        assert h["supervisor"]["last_cause"] == "decode_fault"
+
+
+class TestEscalation:
+    def test_budget_exhaustion_escalates_to_fail_all(self, rope_net):
+        """More faults than budget: the supervisor escalates to the
+        PR 5 terminal state — every in-flight handle fails with the
+        original error, health flips, submits are refused."""
+        reg = MetricsRegistry()
+        sup = EngineSupervisor(budget=RestartBudget(2, 60.0))
+        eng, hs = _run(rope_net, supervisor=sup, registry=reg,
+                       name="engine:esc",
+                       decode_chaos=chaos.FaultBurstInjector(n=1, k=10))
+        assert not eng.is_healthy()
+        assert sup.escalations == 1
+        snap = reg.snapshot_compact()
+        assert snap[SERVING_ENGINE_ESCALATIONS
+                    + "{model=engine:esc}"] == 1
+        # escalations are NOT rebuilds: the rebuild counter counts only
+        # the 2 budgeted rebuilds that actually happened
+        assert snap[SERVING_ENGINE_REBUILDS
+                    + "{cause=decode_fault,model=engine:esc}"] == 2
+        for h in hs:
+            assert h.done
+            with pytest.raises(chaos.InjectedFault):
+                h.result(timeout=0)
+        with pytest.raises(EngineShutdown):
+            eng.submit([1, 2], steps=2)
+
+    def test_zero_budget_means_legacy_fail_all(self, rope_net):
+        """RestartBudget(0): supervision configured but disabled — the
+        first fault is terminal, exactly the unsupervised behavior."""
+        sup = EngineSupervisor(budget=RestartBudget(0, 60.0))
+        eng, _ = _run(rope_net, supervisor=sup,
+                      decode_chaos=chaos.FaultBurstInjector(n=1, k=1))
+        assert not eng.is_healthy() and sup.rebuilds == 0
+
+    def test_window_expiry_restores_budget(self):
+        t = [0.0]
+        b = RestartBudget(2, 10.0, clock=lambda: t[0])
+        assert b.try_acquire() and b.try_acquire()
+        assert not b.try_acquire()
+        t[0] = 10.5                       # the window slides past both
+        assert b.remaining() == 2
+        assert b.try_acquire()
+
+    def test_remaining_never_mutates(self):
+        """remaining() is read from lock-free health/metrics probes
+        racing the step thread's try_acquire — it must count without
+        reassigning the ledger (a probe-time prune could drop a
+        just-recorded restart and leak the crash-loop bound)."""
+        t = [0.0]
+        b = RestartBudget(2, 10.0, clock=lambda: t[0])
+        assert b.try_acquire()
+        ledger = b._acquired
+        t[0] = 10.5                       # entry aged out of the window
+        assert b.remaining() == 2
+        assert b._acquired is ledger and ledger == [0.0]
+
+
+# ---------------------------------------------------------------------
+# satellite: the pop-to-seat handoff window
+# ---------------------------------------------------------------------
+class TestSeatWindow:
+    def test_seat_fault_fails_terminally_without_supervisor(self,
+                                                            rope_net):
+        """A request popped from the queue but not yet seated must get
+        a terminal event when the engine breaks in that window — before
+        the fix it was stranded in neither the slot scan nor the queue
+        drain and its caller hung forever."""
+        eng = GenerationEngine(rope_net, V, slots=1,
+                               seat_chaos=chaos.RaiseOnBatch(None, n=1))
+        h0 = eng.submit(PROMPTS[0], steps=4, top_k=1)
+        h1 = eng.submit(PROMPTS[1], steps=4, top_k=1)
+        eng.run_until_idle()
+        with pytest.raises(chaos.InjectedFault):
+            h1.result(timeout=2.0)       # bounded: a hang fails loudly
+        assert not eng.is_healthy()
+        assert h0.done
+
+    def test_seat_fault_recovers_with_supervisor(self, rope_net):
+        _, base = _run(rope_net)
+        want = _outs(base)
+        sup = EngineSupervisor()
+        eng, hs = _run(rope_net, supervisor=sup, n_slots=1,
+                       seat_chaos=chaos.RaiseOnBatch(None, n=1))
+        assert _outs(hs) == want
+        assert eng.is_healthy()
+        assert sup.last_cause == "admission_fault"
+
+    def test_cancelled_seating_request_not_readmitted(self, rope_net):
+        """A request cancelled inside the pop-to-seat window must not
+        be re-admitted by the rebuild — no prefill dispatch for a dead
+        stream, not counted recovered; it resolves RequestCancelled."""
+        def cancel_then_fault(r):
+            r.handle.cancel()
+            return True
+        sup = EngineSupervisor()
+        eng = GenerationEngine(
+            rope_net, V, slots=1, supervisor=sup,
+            seat_chaos=chaos.RequestFaultInjector(match=cancel_then_fault))
+        h = eng.submit(PROMPTS[0], steps=4, top_k=1)
+        eng.run_until_idle()
+        with pytest.raises(RequestCancelled):
+            h.result(timeout=2.0)
+        assert eng.is_healthy()           # rebuild succeeded regardless
+        assert sup.rebuilds == 1 and sup.recovered_requests == 0
+
+    def test_request_targeted_fault_selection(self, rope_net):
+        """RequestFaultInjector picks its victim by request content, not
+        admission index — robust to admission-order shifts."""
+        _, base = _run(rope_net)
+        want = _outs(base)
+        inj = chaos.RequestFaultInjector(
+            match=lambda r: r.prompt == PROMPTS[1])
+        eng, hs = _run(rope_net, prefill_chaos=inj)
+        with pytest.raises(chaos.InjectedFault):
+            hs[1].result(timeout=0)
+        assert hs[0].result(timeout=0) == want[0]
+        assert hs[2].result(timeout=0) == want[2]
+        assert eng.is_healthy()          # prefill domain: victim only
+
+
+# ---------------------------------------------------------------------
+# overload control
+# ---------------------------------------------------------------------
+class TestEarlyRejection:
+    def test_injected_eta_rejects_deterministically(self, rope_net):
+        """deadline < now + eta is refused AT SUBMIT with the typed
+        error; the same request without a deadline (or with slack) is
+        admitted — pinned with an injected estimator so the decision is
+        a pure function of its inputs."""
+        ov = OverloadConfig(queue_eta=lambda e, r, now: 10.0)
+        eng = GenerationEngine(rope_net, V, slots=1, overload=ov)
+        with pytest.raises(ServingOverloaded):
+            eng.submit([1, 2], steps=2, top_k=1, timeout=1.0)
+        h = eng.submit([1, 2], steps=2, top_k=1, timeout=60.0)
+        h2 = eng.submit([3, 4], steps=2, top_k=1)      # no deadline
+        eng.run_until_idle()
+        assert h.result(timeout=0) and h2.result(timeout=0)
+        assert eng.health()["overload"]["early_rejected_total"] == 1
+
+    def test_no_rejection_before_rate_calibrates(self, rope_net):
+        """The default estimator never rejects on ignorance: with no
+        observed admissions there is no rate, so a tight deadline is
+        admitted (and reaped by the normal deadline machinery)."""
+        ov = OverloadConfig(min_samples=2)
+        eng = GenerationEngine(rope_net, V, slots=1, overload=ov)
+        h = eng.submit([1, 2], steps=2, top_k=1, timeout=30.0)
+        eng.run_until_idle()
+        assert h.result(timeout=0)
+
+
+class TestShedding:
+    def test_sustained_breach_sheds_lowest_priority_first(self,
+                                                          rope_net):
+        """Breach evidence in the window + queue beyond servable depth:
+        the lowest-priority youngest queued work sheds with
+        ServingOverloaded; higher classes survive and complete."""
+        ov = OverloadConfig(ttft_slo_s=0.001, min_samples=2,
+                            breach_window=4, shed_to_depth=2)
+        eng = GenerationEngine(rope_net, V, slots=1, overload=ov,
+                               queue_limit=16)
+        for _ in range(4):                 # deterministic breach
+            eng._overload.observe_ttft(1.0, time.monotonic())
+        hi = eng.submit([1, 2], steps=4, top_k=1, priority=5)
+        mid = eng.submit([3, 4], steps=4, top_k=1, priority=1)
+        lo1 = eng.submit([5, 6], steps=4, top_k=1, priority=0)
+        lo2 = eng.submit([7, 8], steps=4, top_k=1, priority=0)
+        eng.step()       # shed (depth 4 -> 2: both lows), then admit hi
+        for h in (lo1, lo2):
+            with pytest.raises(ServingOverloaded):
+                h.result(timeout=2.0)
+        eng.run_until_idle()
+        assert hi.result(timeout=0) and mid.result(timeout=0)
+        assert eng._overload.shed_total == 2
+
+    def test_no_shedding_without_breach(self, rope_net):
+        ov = OverloadConfig(ttft_slo_s=1000.0, min_samples=1,
+                            shed_to_depth=1)
+        eng, hs = _run(rope_net, prompts=PROMPTS, n_slots=1,
+                       overload=ov, queue_limit=16)
+        assert all(h.error is None for h in hs)
+        assert eng._overload.shed_total == 0
+
+    def test_shed_resets_breach_window(self, rope_net):
+        """One burst of slow admissions must not bleed the queue dry
+        forever: a shed round clears the evidence window, so the next
+        round needs fresh post-shed samples."""
+        ov = OverloadConfig(ttft_slo_s=0.001, min_samples=2,
+                            breach_window=4, shed_to_depth=0)
+        eng = GenerationEngine(rope_net, V, slots=1, overload=ov,
+                               queue_limit=16)
+        ctl = eng._overload
+        for _ in range(4):
+            ctl.observe_ttft(1.0, time.monotonic())
+        eng.submit([1, 2], steps=2, top_k=1)
+        victims = ctl.shed(eng)
+        assert len(victims) == 1
+        eng.submit([3, 4], steps=2, top_k=1)
+        assert ctl.shed(eng) == []        # window cleared: no evidence
+
+
+class TestBrownout:
+    def _spec_engine(self, rope_net, fracs=(0.5, 0.3, 0.1)):
+        return GenerationEngine(
+            rope_net, V, slots=2,
+            overload=OverloadConfig(brownout_enter_fracs=fracs),
+            paging=PagedKVConfig(page_size=4),
+            speculation=SpeculationConfig(
+                draft=prompt_lookup_proposer(2), gamma=2))
+
+    def test_ladder_escalates_and_restores(self, rope_net):
+        """Free-page pressure walks the ladder up (gamma drop -> spec
+        off -> no prefix inserts) and back down when pressure clears —
+        feature degradation, never availability loss: the active
+        request completes either way."""
+        eng = self._spec_engine(rope_net)
+        pool = eng.page_pool
+        h = eng.submit([1, 2, 3], steps=10, top_k=1)
+        eng.step()
+        assert eng._brownout == 0
+        pool.seize(pool.free_count() - int(0.35 * pool.usable))
+        eng.step()
+        assert eng._brownout == 1         # reduced gamma
+        pool.seize(pool.free_count() - int(0.05 * pool.usable))
+        eng.step()
+        assert eng._brownout == 3         # spec off + no prefix inserts
+        pool.restore()
+        eng.step()
+        assert eng._brownout == 0         # pressure cleared: restored
+        eng.run_until_idle()
+        assert h.result(timeout=0)
+
+    def test_hysteresis_holds_level_near_threshold(self, rope_net):
+        eng = self._spec_engine(rope_net)
+        pool = eng.page_pool
+        ctl = eng._overload
+        pool.seize(pool.free_count() - int(0.45 * pool.usable))
+        assert ctl.brownout_level(eng) == 1
+        # restore to just above the enter threshold but inside the
+        # hysteresis margin: the rung must HOLD
+        pool.restore()
+        pool.seize(pool.free_count() - int(0.55 * pool.usable))
+        assert ctl.brownout_level(eng) == 1
+        pool.restore()                     # fully clear
+        assert ctl.brownout_level(eng) == 0
+
+    def test_release_reachable_when_margin_overflows_one(self, rope_net):
+        """enter_frac + clear_margin > 1.0 must not latch the rung
+        forever: the release point caps at 1.0, so a fully free pool
+        always restores."""
+        eng = self._spec_engine(rope_net, fracs=(0.95, 0.5, 0.1))
+        pool = eng.page_pool
+        ctl = eng._overload
+        pool.seize(pool.free_count() - int(0.9 * pool.usable))
+        assert ctl.brownout_level(eng) == 1
+        pool.restore()                     # free_frac == 1.0 exactly
+        assert ctl.brownout_level(eng) == 0
+
+    def test_negative_clear_margin_rejected(self):
+        with pytest.raises(ValueError, match="brownout_clear_margin"):
+            OverloadConfig(brownout_clear_margin=-0.1)
+
+    def test_brownout_stops_prefix_inserts(self, rope_net):
+        eng = GenerationEngine(
+            rope_net, V, slots=1,
+            overload=OverloadConfig(brownout_enter_fracs=(0.9, 0.85,
+                                                          0.8)),
+            paging=PagedKVConfig(page_size=2))
+        pool = eng.page_pool
+        pool.seize(int(pool.free_count() - 0.5 * pool.usable))
+        h = eng.submit([1, 2, 3, 4, 5], steps=2, top_k=1)
+        eng.run_until_idle()
+        assert h.result(timeout=0)
+        assert len(eng.prefix_cache) == 0  # rung 3: inserts off
+        pool.restore()
+        h2 = eng.submit([1, 2, 3, 4, 5], steps=2, top_k=1)
+        eng.run_until_idle()
+        h2.result(timeout=0)
+        assert len(eng.prefix_cache) > 0   # restored: inserts resume
+
+    def test_greedy_outputs_unchanged_under_brownout(self, rope_net):
+        """Brownout degrades throughput levers only: greedy outputs are
+        the argmax chain with or without speculation, so a mid-stream
+        rung change never changes tokens."""
+        cfg = dict(paging=PagedKVConfig(page_size=4),
+                   speculation=SpeculationConfig(
+                       draft=prompt_lookup_proposer(2), gamma=2))
+        _, base = _run(rope_net, **cfg)
+        want = _outs(base)
+        eng = GenerationEngine(
+            rope_net, V, slots=2,
+            overload=OverloadConfig(brownout_enter_fracs=(0.99, 0.98,
+                                                          0.97)),
+            **cfg)
+        # pool almost exhausted from the start: permanent deep brownout
+        hs = [eng.submit(p, steps=5, top_k=1,
+                         rng=np.random.default_rng(i))
+              for i, p in enumerate(PROMPTS[:3])]
+        eng.run_until_idle()
+        assert _outs(hs) == want
+
+
+# ---------------------------------------------------------------------
+# draining
+# ---------------------------------------------------------------------
+class TestDrain:
+    def test_drain_finishes_actives_fails_queued(self, rope_net):
+        eng = GenerationEngine(rope_net, V, slots=1)
+        act = eng.submit(PROMPTS[0], steps=6, top_k=1,
+                         rng=np.random.default_rng(0))
+        queued = eng.submit(PROMPTS[1], steps=6, top_k=1)
+        eng.step()                        # seat the first
+        assert eng.drain(timeout=60.0)
+        assert act.done and act.error is None
+        assert len(act.generated) == 6    # ran to natural retirement
+        with pytest.raises(EngineShutdown):
+            queued.result(timeout=0)
+        with pytest.raises(EngineShutdown):
+            eng.submit([1], steps=1)
+        assert not eng.is_ready()
+        assert eng.health()["draining"] is True
+        assert eng.active_slots() == 0    # the clean handoff point
+
+    def test_drain_under_background_loop(self, rope_net):
+        eng = GenerationEngine(rope_net, V, slots=2).start()
+        try:
+            hs = [eng.submit(p, steps=5, top_k=1,
+                             rng=np.random.default_rng(i))
+                  for i, p in enumerate(PROMPTS[:2])]
+            t0 = time.monotonic()
+            while eng.active_slots() < 2 and not all(h.done
+                                                     for h in hs):
+                assert time.monotonic() - t0 < 60, "never admitted"
+                time.sleep(0.005)        # drain fails QUEUED work; the
+            assert eng.drain(timeout=60.0)  # test wants actives finish
+            for h in hs:
+                assert h.result(timeout=0)
+        finally:
+            eng.shutdown()
+
+    def test_drain_timeout_reports_false(self, rope_net):
+        eng = GenerationEngine(rope_net, V, slots=1)
+        eng.submit([1, 2], steps=500, top_k=1, max_length=None)
+        eng.step()
+        assert eng.drain(timeout=0.0) is False   # active still seated
+        assert eng.active_slots() == 1
+
+    def test_draining_gauge(self, rope_net):
+        reg = MetricsRegistry()
+        eng = GenerationEngine(rope_net, V, slots=1, registry=reg,
+                               name="engine:drain")
+        key = SERVING_DRAINING + "{model=engine:drain}"
+        assert reg.snapshot_compact()[key] == 0.0
+        eng.drain(timeout=1.0)
+        assert reg.snapshot_compact()[key] == 1.0
+
+
+# ---------------------------------------------------------------------
+# satellite: AdmissionQueue close-drain + shed primitives
+# ---------------------------------------------------------------------
+class TestAdmissionQueueCloseDrain:
+    def test_concurrent_blocked_submitters_all_get_terminal_error(self):
+        """Blocked `submit` callers on a full queue: close() must wake
+        every one with EngineShutdown — none may hang, none may slip
+        into a closed queue."""
+        q = AdmissionQueue(limit=1, policy="block")
+        q.submit(GenerationRequest([1], 1))       # fill the bound
+        results = []
+        n = 6
+
+        def blocked_submit(i):
+            try:
+                q.submit(GenerationRequest([i], 1))
+                results.append(("in", i))
+            except EngineShutdown:
+                results.append(("shutdown", i))
+            except Exception as e:  # noqa: BLE001 — recorded for assert
+                results.append((type(e).__name__, i))
+
+        ts = [threading.Thread(target=blocked_submit, args=(i,))
+              for i in range(n)]
+        for t in ts:
+            t.start()
+        time.sleep(0.15)                  # let them all park
+        drained = q.close()
+        for t in ts:
+            t.join(timeout=5.0)
+        assert not any(t.is_alive() for t in ts), "a submitter hung"
+        assert len(drained) == 1
+        assert sorted(r[0] for r in results) == ["shutdown"] * n
+
+    def test_shed_lowest_victim_order(self):
+        q = AdmissionQueue(limit=16)
+        rs = [GenerationRequest([i], 1, priority=p)
+              for i, p in enumerate([2, 0, 0, 1, 0])]
+        for r in rs:
+            q.submit(r)
+        victims = q.shed_lowest(keep=2)
+        # lowest class (0) youngest-first: seq 4, 2, then the third
+        # shed comes from class 1
+        assert victims == [rs[4], rs[2], rs[3]] or \
+            victims == [rs[4], rs[2], rs[1]]
+        assert len(victims) == 3 and q.depth() == 2
+        assert q.shed_lowest(keep=5) == []
+
+    def test_depth_ahead_counts_peers_and_better(self):
+        q = AdmissionQueue(limit=16)
+        for p in (0, 1, 1, 3):
+            q.submit(GenerationRequest([1], 1, priority=p))
+        assert q.depth_ahead(2) == 1      # only the 3
+        assert q.depth_ahead(1) == 3      # both 1s (peers) + the 3
+        assert q.depth_ahead(0) == 4
+
+
+# ---------------------------------------------------------------------
+# chaos injector units
+# ---------------------------------------------------------------------
+class TestInjectors:
+    def test_fault_burst_fires_k_then_clears(self):
+        inj = chaos.FaultBurstInjector(n=2, k=3)
+        chaos.fire(inj, 0)
+        chaos.fire(inj, 1)                # below n: clean
+        for i in range(3):
+            with pytest.raises(chaos.InjectedFault):
+                chaos.fire(inj, 2)        # same index re-presented
+        chaos.fire(inj, 2)                # burst spent: clean forever
+        chaos.fire(inj, 7)
+        assert inj.faults_fired == 3
+
+    def test_fault_burst_window_bounds_indices(self):
+        inj = chaos.FaultBurstInjector(n=2, k=5, window=2)
+        with pytest.raises(chaos.InjectedFault):
+            chaos.fire(inj, 2)
+        with pytest.raises(chaos.InjectedFault):
+            chaos.fire(inj, 3)
+        chaos.fire(inj, 4)                # outside [2, 4): clean
+        assert inj.faults_fired == 2
+
+    def test_request_targeted_once_latch(self):
+        inj = chaos.RequestFaultInjector(match=lambda r: r == "victim")
+        chaos.fire(inj, 0, ctx="bystander")
+        with pytest.raises(chaos.InjectedFault):
+            chaos.fire(inj, 1, ctx="victim")
+        chaos.fire(inj, 2, ctx="victim")  # once=True: latched
+        chaos.fire(inj, 3, ctx=None)      # indexed seams: no-op
+
+
+# ---------------------------------------------------------------------
+# acceptance: zero retraces after recovery (post full-envelope warmup)
+# ---------------------------------------------------------------------
+def _compile_total():
+    c = monitoring.global_registry().get(runtime.COMPILE_COUNTER)
+    return 0.0 if c is None else c.total()
+
+
+class TestNoRetraceAfterRecovery:
+    def test_recovery_compiles_nothing_new(self):
+        """After a full-envelope warmup(), a mid-stream fault + arena
+        rebuild + survivor re-prime + continued decode hits only warm
+        shapes: re-primes land in the warmed prefill buckets, the arena
+        skeleton/scatter/decode reuse their compiled signatures
+        (recompile-watcher-pinned, the PR 3 bar applied to recovery)."""
+        monitoring.ensure_started()
+        net = TextGenerationTransformer(vocab_size=V, embed_dim=16,
+                                        n_heads=2, n_layers=2,
+                                        max_length=32,
+                                        positional="rope").init()
+        sup = EngineSupervisor()
+        eng = GenerationEngine(net, V, slots=2, supervisor=sup)
+        eng.warmup()          # default: every bucket up to capacity
+        warm = _compile_total()
+        # armed AFTER warmup: the fault must land mid-traffic, past the
+        # compile-count snapshot (warmup consumes dispatch indices too)
+        eng._decode_chaos = chaos.FaultBurstInjector(
+            n=eng._dispatches + 3, k=1)
+        hs = [eng.submit(p, steps=6, top_k=1,
+                         rng=np.random.default_rng(i))
+              for i, p in enumerate(PROMPTS[:3])]
+        eng.run_until_idle()
+        assert all(h.done for h in hs)
+        assert sup.rebuilds == 1
+        assert _compile_total() == warm, (
+            "recovery retraced after warmup — the rebuild must reuse "
+            "the warm prefill buckets and arena dispatch shapes")
+
+    def test_paged_recovery_compiles_nothing_new(self):
+        """Same bar for the paged arena with the prefix cache on: the
+        rebuilt pool/page-store/prefix plumbing reuses the compiled
+        gather/scatter signatures, and post-rebuild re-primes (fresh
+        AND prefix-hit suffix buckets) stay inside the warmed set."""
+        monitoring.ensure_started()
+        net = TextGenerationTransformer(vocab_size=V, embed_dim=16,
+                                        n_heads=2, n_layers=2,
+                                        max_length=32,
+                                        positional="rope").init()
+        sup = EngineSupervisor()
+        eng = GenerationEngine(
+            net, V, slots=2, supervisor=sup,
+            paging=PagedKVConfig(page_size=4))
+        eng.warmup()
+        warm = _compile_total()
+        eng._decode_chaos = chaos.FaultBurstInjector(
+            n=eng._dispatches + 3, k=1)
+        shared = [3, 1, 2, 0] * 2           # two cached full blocks
+        hs = [eng.submit(p, steps=6, top_k=1,
+                         rng=np.random.default_rng(i))
+              for i, p in enumerate([shared + [5], shared + [7, 8],
+                                     [9, 9]])]
+        eng.run_until_idle()
+        assert all(h.done for h in hs)
+        assert sup.rebuilds == 1
+        assert _compile_total() == warm, (
+            "paged recovery retraced after warmup")
