@@ -797,9 +797,17 @@ def bench_serve_paged():
     case (slots x cache_length — paging spends the same HBM, it just
     stops pinning it per slot), with the prefix cache fed by a shared
     system prompt on half the requests. Records tokens/s, mean/p95
-    TTFT, peak admitted concurrency, and page utilization for both
-    paths, plus a speculative sub-leg (prompt-lookup draft over
+    TTFT, p95 TPOT, peak admitted concurrency, and page utilization for
+    both paths, plus a speculative sub-leg (prompt-lookup draft over
     repetitive prompts) with its measured acceptance rate.
+
+    PR 10 A/B leg: the paged engine runs the SAME trace twice — the
+    direct paged-decode path (kernel on TPU, XLA-fallback elsewhere; no
+    per-step gather/scatter round trip) vs the legacy round trip
+    (``direct=False``) — and records kv-bytes-moved per generated token
+    for both, ASSERTING the round-trip elimination: the direct path's
+    per-token KV traffic must be well under the round trip's
+    O(2·S·L)-per-step accounting.
 
     The model is sized so a decode dispatch is LATENCY-bound rather
     than FLOP-bound — the TPU serving regime, where a [32,V,1] step
@@ -834,11 +842,15 @@ def bench_serve_paged():
             p = sys_prompt + p[:max(1, len(p) - 16)]
         prompts.append(p)
 
+    import threading
+
     def run(engine, label):
         engine.warmup(max_prompt_len=112)
         engine.start()
         t0 = time.perf_counter()
         handles, peak, peak_util = [], [0], [0.0]
+        tpot, consumers = [], []
+        tpot_lock = threading.Lock()
         pool_total = (engine.page_pool.usable
                       if engine.page_pool is not None else 0)
 
@@ -856,28 +868,53 @@ def bench_serve_paged():
                     return
                 time.sleep(0.002)
 
-        import threading
+        def consume(h):
+            # exact host-side inter-token gaps (TPOT) per stream — the
+            # engine's own histogram only keeps count/sum
+            last = None
+            for _ in h:
+                now = time.perf_counter()
+                if last is not None:
+                    with tpot_lock:
+                        tpot.append(now - last)
+                last = now
+
         w = threading.Thread(target=watch, daemon=True)
         w.start()
         for i, p in enumerate(prompts):
             while time.perf_counter() < t0 + i * STAGGER:
                 time.sleep(0.001)
-            handles.append(engine.submit(p, steps=STEPS, top_k=1,
-                                         rng=np.random.default_rng(i)))
+            h = engine.submit(p, steps=STEPS, top_k=1,
+                              rng=np.random.default_rng(i))
+            handles.append(h)
+            c = threading.Thread(target=consume, args=(h,), daemon=True)
+            c.start()
+            consumers.append(c)
         outs = [h.result(timeout=600) for h in handles]
         dt = time.perf_counter() - t0
         w.join(timeout=5)
+        for c in consumers:
+            c.join(timeout=5)
         engine.shutdown()
         gen = sum(len(o) - len(p) for o, p in zip(outs, prompts))
         ttft = [h.ttft_s for h in handles]
-        return {f"{label}_tokens_per_sec": round(gen / dt, 1),
-                f"{label}_ttft_mean_ms":
-                    round(float(np.mean(ttft)) * 1e3, 1),
-                f"{label}_ttft_p95_ms":
-                    round(float(np.percentile(ttft, 95)) * 1e3, 1),
-                f"{label}_peak_active": peak[0],
-                f"{label}_page_util": (
-                    round(peak_util[0], 3) if pool_total else None)}
+        out = {f"{label}_tokens_per_sec": round(gen / dt, 1),
+               f"{label}_ttft_mean_ms":
+                   round(float(np.mean(ttft)) * 1e3, 1),
+               f"{label}_ttft_p95_ms":
+                   round(float(np.percentile(ttft, 95)) * 1e3, 1),
+               f"{label}_tpot_p95_ms": (
+                   round(float(np.percentile(tpot, 95)) * 1e3, 2)
+                   if tpot else None),
+               f"{label}_peak_active": peak[0],
+               f"{label}_page_util": (
+                   round(peak_util[0], 3) if pool_total else None)}
+        kvt = engine.health().get("kv_traffic")
+        if kvt:
+            out[f"{label}_decode_path"] = kvt["decode_path"]
+            out[f"{label}_kv_bytes_per_token"] = round(
+                kvt["bytes_moved_total"] / max(1, gen), 1)
+        return out
 
     # token budget == the slot arena's worst case: SLOTS x L tokens
     budget_pages = SLOTS * (L // PS)
@@ -891,9 +928,27 @@ def bench_serve_paged():
         net, V, slots=CONC, queue_limit=R,
         paging=PagedKVConfig(page_size=PS, total_pages=budget_pages)),
         "paged"))
+    # A/B: the SAME trace through the legacy gather/scatter round trip
+    # (direct=False) — kernel/direct-vs-roundtrip is the PR 10 claim
+    rec.update(run(GenerationEngine(
+        net, V, slots=CONC, queue_limit=R,
+        paging=PagedKVConfig(page_size=PS, total_pages=budget_pages,
+                             direct=False)),
+        "paged_rt"))
     rec["value"] = rec["paged_tokens_per_sec"]
     rec["admitted_concurrency_x"] = round(
         rec["paged_peak_active"] / max(1, rec["slot_peak_active"]), 2)
+    rec["kv_bytes_per_token_x"] = round(
+        rec["paged_rt_kv_bytes_per_token"]
+        / max(1.0, rec["paged_kv_bytes_per_token"]), 2)
+    # the acceptance assertion: the full-arena round trip is GONE from
+    # the steady-state step. The XLA fallback still materializes the
+    # mapped view once inside the dispatch (the scatter half is
+    # eliminated → < 0.7x incl. prefill commits); the kernel path reads
+    # only live pages (O(active context) → < 0.5x)
+    lim = 0.5 if rec["paged_decode_path"] == "direct-pallas" else 0.7
+    assert rec["paged_kv_bytes_per_token"] < \
+        lim * rec["paged_rt_kv_bytes_per_token"], rec
 
     # speculative sub-leg: repetitive prompts so prompt-lookup drafts
     # actually land; acceptance rate from the engine's own histogram
@@ -923,6 +978,10 @@ def bench_serve_paged():
         else None)
     rec["spec_tokens_per_dispatch"] = round(gen / max(1, eng._dispatches
                                                       ), 2)
+    spec_kvt = eng.health()["kv_traffic"]
+    rec["spec_decode_path"] = spec_kvt["decode_path"]
+    rec["spec_kv_bytes_per_token"] = round(
+        spec_kvt["bytes_moved_total"] / max(1, gen), 1)
     _print_line(json.dumps(rec), flush=True)
 
 

@@ -17,11 +17,23 @@ per-batch path (`_fit*`, `partial_fit`, ...) are hot everywhere; in
 `fit`/`train`-shaped functions only code lexically inside a loop is hot.
 Literal-constant arguments (e.g. ``jnp.asarray(3)``) are exempt — a
 scalar constant is not a batch transfer.
+
+Serving extension (PR 10): per-STEP paths are hot too — `step`,
+`_step_*`, `_dispatch_step`, `_run_dispatch`, `_decode_step` method
+bodies, the decode-loop shape where the engine used to rebuild and
+re-upload the whole [S, n_max] page table every generated token even
+when no table had changed. The fix shape this rule points at is the
+engine's cached-table path: stage the transfer in a cache helper
+outside the hot names and invalidate it on MUTATION, so steady-state
+steps re-upload nothing. Only top-level (method) bodies count: a
+nested ``def step(carry, ...)`` is a jitted/scan body whose
+``jnp.asarray`` is a trace-time constant, not a per-step H2D copy.
 """
 
 from __future__ import annotations
 
 import ast
+import re
 from typing import Iterator
 
 from deeplearning4j_tpu.analysis.core import (
@@ -34,6 +46,12 @@ _TRANSFER_CALLS = {
     "jax.numpy.array": "jnp.array",
     "jax.device_put": "jax.device_put",
 }
+
+#: serving per-step hot paths (the decode dispatch cycle): hot only as
+#: TOP-LEVEL function/method bodies — nested defs with these names are
+#: jit/scan step bodies where a transfer is a trace-time constant
+_PER_STEP_FN = re.compile(
+    r"^(step|_step_\w+|_dispatch_step|_run_dispatch|_decode_step)$")
 
 
 class DeviceTransferRule(Rule):
@@ -58,18 +76,32 @@ class DeviceTransferRule(Rule):
             if node.args and isinstance(node.args[0], ast.Constant):
                 continue
             for fn in mod.enclosing_functions(node):
+                per_step = False
                 if _PER_BATCH_FN.match(fn.name):
                     where = f"per-batch path '{fn.name}'"
+                elif _PER_STEP_FN.match(fn.name) and \
+                        not mod.enclosing_functions(fn):
+                    per_step = True
+                    where = f"per-step path '{fn.name}'"
                 elif _LOOP_FN.match(fn.name) and mod.inside_loop(node,
                                                                  within=fn):
                     where = f"loop in '{fn.name}'"
                 else:
                     continue
-                yield self.finding(
-                    mod, node,
-                    f"{label}() in {where} stages a host->device copy on "
-                    f"the consumer thread each batch; move it into a "
-                    f"device prefetch stage "
-                    f"(pipeline.DevicePrefetchIterator) so the transfer "
-                    f"overlaps compute")
+                if per_step:
+                    yield self.finding(
+                        mod, node,
+                        f"{label}() in {where} re-stages a host->device "
+                        f"copy every decode step even when the host data "
+                        f"did not change; cache the device array outside "
+                        f"the step and invalidate it on mutation (the "
+                        f"serving engine's cached page-table path)")
+                else:
+                    yield self.finding(
+                        mod, node,
+                        f"{label}() in {where} stages a host->device "
+                        f"copy on the consumer thread each batch; move "
+                        f"it into a device prefetch stage "
+                        f"(pipeline.DevicePrefetchIterator) so the "
+                        f"transfer overlaps compute")
                 break

@@ -35,10 +35,12 @@ import numpy as np
 
 #: per-layer state keys carried only by the streaming rnn_time_step path
 #: (stripped on ordinary forwards; cleared by rnn_clear_previous_state):
-#: LSTM h/c, attention KV cache, positional-embedding offset
+#: LSTM h/c, attention KV cache, positional-embedding offset, and the
+#: direct-paged-decode view (pool pair + page table) the serving engine
+#: installs around its decode dispatches (serving/paged_kernel.py)
 STREAM_STATE_KEYS = frozenset(
     {"h", "c", "kv_k", "kv_v", "kv_pos", "kv_abs", "kv_mask",
-     "pos_offset"})
+     "pos_offset", "kv_page_k", "kv_page_v", "kv_page_table"})
 
 #: streaming-state keys whose LEADING axis is the batch dimension (beam
 #: search gathers these when pruning beams; kv_pos/kv_abs/pos_offset are
@@ -208,6 +210,41 @@ def set_stream_cache_sharding(mesh, axis: str = "data") -> None:
     work unchanged; SURVEY §5 long-context)."""
     global _STREAM_CACHE_SHARDING
     _STREAM_CACHE_SHARDING = None if mesh is None else (mesh, axis)
+
+
+#: the direct paged-decode implementation the streaming attention layer
+#: dispatches when a page table rides the state: ("xla", False) folds the
+#: pool[table] gather into the attention op (any backend); ("pallas", i)
+#: runs the serving/paged_kernel.py paged-attention kernel (i = interpret
+#: mode, for CPU exactness tests). Module-level like
+#: _STREAM_CACHE_SHARDING — part of every streaming jit key, so flipping
+#: it retraces instead of silently reusing the other impl's trace.
+_PAGED_DECODE_IMPL: Tuple[str, bool] = ("xla", False)
+
+
+def set_paged_decode_impl(impl: str, interpret: bool = False) -> None:
+    """Select the direct paged-decode attention implementation
+    (process-wide, like set_stream_cache_sharding): ``"xla"`` — the
+    any-backend fallback where the attention reads K/V through the page
+    table with the gather folded into the dispatch; ``"pallas"`` — the
+    TPU paged-attention kernel (``interpret=True`` emulates it on CPU
+    for exactness tests). The serving engine sets this from
+    ``PagedKVConfig.decode_impl`` at construction."""
+    if impl not in ("xla", "pallas"):
+        raise ValueError(f"paged decode impl must be 'xla' or 'pallas', "
+                         f"got {impl!r}")
+    global _PAGED_DECODE_IMPL
+    _PAGED_DECODE_IMPL = (impl, bool(interpret))
+
+
+def paged_decode_impl() -> Tuple[str, bool]:
+    """The LIVE (impl, interpret) pair direct paged dispatches run
+    under right now. Process-wide: a later engine's construction can
+    flip it, retracing every direct engine's next dispatch onto the
+    new impl — consumers that model per-impl behavior (the engine's
+    KV-traffic accounting, health()) must read this, not a
+    construction-time snapshot."""
+    return _PAGED_DECODE_IMPL
 
 
 def _shard_cache(x, n_lead: int):
@@ -1149,6 +1186,12 @@ class SelfAttentionLayer(FeedForwardLayerConf):
                 "SelfAttentionLayer streaming needs cache_length > 0")
         if not self.causal:
             raise ValueError("streaming decode requires causal=True")
+        if state.get("kv_page_table") is not None:
+            # direct paged decode: the serving engine installed the page
+            # pool + table in place of a dense cache — read through the
+            # table, append one token per row in place
+            return self._stream_attend_paged(q, k, v, state, mask=mask,
+                                             pad_left=pad_left)
         n, _, t, d = q.shape
         hkv = k.shape[1]                 # cache holds n_kv_heads heads
         L = self.cache_length
@@ -1245,6 +1288,91 @@ class SelfAttentionLayer(FeedForwardLayerConf):
         out = {**state, "kv_k": kc, "kv_v": vc, "kv_pos": pos + n_new}
         if km is not None:
             out["kv_mask"] = km
+        return o, out
+
+    def _stream_attend_paged(self, q, k, v, state, mask=None,
+                             pad_left=None):
+        """Direct paged decode: K/V live in the block-paged pool
+        (``kv_page_k``/``kv_page_v`` — [P, Hkv, page_size, D]) and the
+        per-row page table (``kv_page_table`` — [N, n_max], 0 = null
+        page), installed by the serving engine around its decode
+        dispatches. The chunk's new tokens append with ONE
+        [N, T, Hkv, D] scatter at each row's ``(page, offset)`` — an
+        O(one-token) write, vs the legacy full-arena scatter_pages —
+        then the queries attend against the pool through the table:
+
+        - ``"xla"`` impl (any backend): the ``pool[table]`` gather is
+          folded into this dispatch and feeds the SAME
+          ``_grouped_attend`` the dense arena runs — outputs are
+          bit-identical to the slot arena by construction (valid
+          positions hold the exact bytes the dense cache would; masked
+          positions are finite garbage ``-1e30`` hides, the dense
+          path's own idle-slot argument).
+        - ``"pallas"`` impl: serving/paged_kernel.py — the table is a
+          scalar-prefetched index map, so only live pages are read
+          (O(active context), the true paged-attention read path);
+          width T = 1 + gamma runs the same kernel for the widened
+          speculative verify dispatch.
+
+        Contract (the engine's decode shape): per-row ``kv_pos``
+        vector, packed maskless chunks, no rolling window. Appends past
+        a row's allocation or capacity route to the null page 0 —
+        transient speculative overflow (rewound before it is ever
+        visible) and idle-slot coasting both land where nothing reads.
+        Prefix-shared read-only blocks are safe by block alignment: a
+        row appends only at positions ≥ its own fresh blocks."""
+        if mask is not None or pad_left is not None:
+            raise ValueError(
+                "direct paged decode is packed/maskless (the engine's "
+                "decode dispatch shape) — masked or left-padded chunks "
+                "must prime through the dense path")
+        if self.window is not None:
+            raise ValueError("rolling (windowed) caches are not "
+                             "pageable (no stable token->page map)")
+        kp, vp = state["kv_page_k"], state["kv_page_v"]
+        table = state["kv_page_table"]
+        pos = state.get("kv_pos")
+        if pos is None or getattr(pos, "ndim", 0) < 1:
+            raise ValueError(
+                "direct paged decode needs the per-row kv_pos vector "
+                "(the engine arena carries one; a scalar-position "
+                "stream has no per-slot pages to address)")
+        n, hkv, t, d = k.shape
+        L = self.cache_length
+        ps = kp.shape[2]
+        n_blk = table.shape[1]
+        q_pos = pos[:, None] + jnp.arange(t, dtype=pos.dtype)   # [N, T]
+        if self.rope:
+            q = self._rope(q, q_pos)
+            k = self._rope(k, q_pos)
+        # -- O(one-token) append at (page, offset) ---------------------
+        blk = jnp.clip(q_pos // ps, 0, n_blk - 1).astype(jnp.int32)
+        page = jnp.take_along_axis(table, blk, axis=1)
+        page = jnp.where(q_pos < L, page, 0)    # past capacity: null
+        off = (q_pos % ps).astype(jnp.int32)
+        kp = kp.at[page, :, off, :].set(
+            k.transpose(0, 2, 1, 3).astype(kp.dtype))
+        vp = vp.at[page, :, off, :].set(
+            v.transpose(0, 2, 1, 3).astype(vp.dtype))
+        impl, interpret = _PAGED_DECODE_IMPL
+        if impl == "pallas":
+            from deeplearning4j_tpu.serving.paged_kernel import (
+                paged_attention)
+            reps = self.n_heads // hkv
+            qg = q.reshape(n, hkv, reps * t, d)
+            o = paged_attention(qg, kp, vp, table,
+                                (pos + t).astype(jnp.int32),
+                                query_width=t, interpret=interpret)
+            o = o.reshape(n, self.n_heads, t, d)
+        else:
+            kd = jnp.moveaxis(kp[table], 2, 1
+                              ).reshape(n, hkv, n_blk * ps, d)[:, :, :L]
+            vd = jnp.moveaxis(vp[table], 2, 1
+                              ).reshape(n, hkv, n_blk * ps, d)[:, :, :L]
+            valid = jnp.arange(L)[None, None, :] <= q_pos[..., None]
+            o = self._grouped_attend(q, kd, vd, valid)
+        out = {**state, "kv_page_k": kp, "kv_page_v": vp,
+               "kv_pos": pos + t}
         return o, out
 
     def _stream_mask_update(self, state, mask, n, t, L, *, fresh, write):

@@ -1101,7 +1101,8 @@ class ComputationGraph(LazyScore):
         return e
 
 
-    def rnn_time_step(self, *inputs, masks=None, pad_left=None):
+    def rnn_time_step(self, *inputs, masks=None, pad_left=None,
+                      donate_state=False):
         """Stateful streaming inference over the graph, carrying RNN h/c in
         self.state across calls (ref: ComputationGraph.rnnTimeStep).
         `masks` maps network-input name -> this chunk's [N, T] key mask
@@ -1113,12 +1114,17 @@ class ComputationGraph(LazyScore):
         with packed accounting — pads never enter caches nor consume
         streaming positions, so any prompt length primes in one dispatch
         at a bucketed shape (see MultiLayerNetwork.rnn_time_step)."""
-        # stream-cache sharding config keys the cache: flipping the
-        # process-wide setting retraces for every net on next use
+        # stream-cache sharding / paged-decode impl configs key the
+        # cache: flipping the process-wide setting retraces for every
+        # net on next use. donate_state (TPU/GPU only — a no-op on CPU)
+        # aliases the carried state buffers into the dispatch: the
+        # serving engine's direct-paged decode sets it so the page
+        # pools update in place (see MultiLayerNetwork.rnn_time_step).
         from deeplearning4j_tpu.nn.conf import layers as _L
         padded = pad_left is not None
-        key = ("rnn_step", padded, self.conf.dtype,
-               _L._STREAM_CACHE_SHARDING)
+        donate = donate_state and jax.default_backend() != "cpu"
+        key = ("rnn_step", padded, donate, self.conf.dtype,
+               _L._STREAM_CACHE_SHARDING, _L._PAGED_DECODE_IMPL)
         if key not in self._jit_cache:
             if padded:
                 def fwd(params, state, ins, rng, pad):
@@ -1135,7 +1141,8 @@ class ComputationGraph(LazyScore):
                     return [_f32_head(acts[o]) for o in
                             self.conf.network_outputs], new_state
 
-            self._jit_cache[key] = jax.jit(fwd)
+            self._jit_cache[key] = jax.jit(
+                fwd, donate_argnums=(1,) if donate else ())
         if len(inputs) == 1 and isinstance(inputs[0], dict):
             ins = self._as_input_dict(inputs[0])
         else:

@@ -447,14 +447,22 @@ class MultiLayerNetwork(LazyScore):
         return self._jit_cache[key]
 
     def _get_output_fn(self, train: bool, carry_rnn: bool,
-                       stream: bool = False, padded: bool = False):
+                       stream: bool = False, padded: bool = False,
+                       donate: bool = False):
         # the process-wide stream-cache sharding config is part of the
         # key: flipping it retraces the step for EVERY net on next use
-        # (a stale compiled step would silently keep the old layout)
+        # (a stale compiled step would silently keep the old layout);
+        # same for the paged-decode impl (xla fallback vs pallas kernel)
         from deeplearning4j_tpu.nn.compute import f32_head as head
         from deeplearning4j_tpu.nn.conf import layers as _L
-        key = ("out", train, carry_rnn, stream, padded, self.conf.dtype,
-               _L._STREAM_CACHE_SHARDING if stream else None)
+        # donation only means anything where XLA aliases buffers; on CPU
+        # it would just warn, so resolve it off there and share the
+        # non-donating trace
+        donate = donate and jax.default_backend() != "cpu"
+        key = ("out", train, carry_rnn, stream, padded, donate,
+               self.conf.dtype,
+               _L._STREAM_CACHE_SHARDING if stream else None,
+               _L._PAGED_DECODE_IMPL if stream else None)
         if key not in self._jit_cache:
             if padded:
                 # left-padded packed chunk: pad count is a TRACED scalar,
@@ -471,7 +479,8 @@ class MultiLayerNetwork(LazyScore):
                         carry_rnn=carry_rnn, stream=stream)
                     return head(acts[-1]), new_state
 
-            self._jit_cache[key] = jax.jit(fwd)
+            self._jit_cache[key] = jax.jit(
+                fwd, donate_argnums=(1,) if donate else ())
         return self._jit_cache[key]
 
     def _get_score_fn(self):
@@ -811,7 +820,8 @@ class MultiLayerNetwork(LazyScore):
     # ------------------------------------------------------------------
     # RNN streaming state (ref: rnnTimeStep :~2300, rnnClearPreviousState)
     # ------------------------------------------------------------------
-    def rnn_time_step(self, x, mask=None, pad_left=None):
+    def rnn_time_step(self, x, mask=None, pad_left=None,
+                      donate_state=False):
         """Stateful streaming inference: feeds one (or more) timesteps,
         carrying h/c (and attention KV caches) across calls
         (ref: rnnTimeStep). `mask` is this chunk's [N, T] key mask for
@@ -824,7 +834,14 @@ class MultiLayerNetwork(LazyScore):
         arbitrary-length prompt primes in ONE dispatch at a bucketed
         shape (util/decoding pads to a power of two) with results
         identical to unpadded chunked priming. The pad count rides the
-        jit as a traced scalar — one compiled shape per bucket."""
+        jit as a traced scalar — one compiled shape per bucket.
+
+        `donate_state=True` donates the carried state's buffers to the
+        dispatch (TPU/GPU; a no-op on CPU): the serving engine's
+        direct-paged decode path sets it so the page pools update IN
+        PLACE (the O(one-token) append) instead of being copied each
+        step. The caller must hold no references to the pre-call state
+        leaves — the returned state is the only live copy."""
         x = jnp.asarray(x)
         if pad_left is not None:
             if mask is not None:
@@ -835,13 +852,15 @@ class MultiLayerNetwork(LazyScore):
                                  f"chunk of {x.shape[-1]} positions")
             new_pos = check_stream_budget(self, x.shape[-1], self.layers,
                                           pad=pad_left)
-            fn = self._get_output_fn(False, True, stream=True, padded=True)
+            fn = self._get_output_fn(False, True, stream=True, padded=True,
+                                     donate=donate_state)
             out, new_state = fn(self.params, self.state, x,
                                 jax.random.PRNGKey(0),
                                 jnp.asarray(pad_left, jnp.int32))
         else:
             new_pos = check_stream_budget(self, x.shape[-1], self.layers)
-            fn = self._get_output_fn(False, True, stream=True)
+            fn = self._get_output_fn(False, True, stream=True,
+                                     donate=donate_state)
             out, new_state = fn(self.params, self.state, x,
                                 jax.random.PRNGKey(0),
                                 None if mask is None else jnp.asarray(mask))

@@ -53,12 +53,18 @@ Serving engine v2 extras, each orthogonal and composable:
   budget — admission checks the request's worst-case pages against the
   free pool (head-of-line blocking when short; requests that can NEVER
   fit are rejected at submit), retirement frees pages immediately, and
-  each dispatch wraps the same canonical decode in a jitted
-  gather/scatter round trip, so outputs stay bit-identical to the slot
-  arena (and to one-shot ``sample_stream``). With
-  ``prefix_cache=True`` (default) shared full-block prompt prefixes
-  prime once (``serving/prefix_cache.py``): later requests map the
-  cached pages and prefill only their suffix.
+  decode runs DIRECTLY on the page pool by default (``direct=True``):
+  the attention step reads K/V through the per-slot page table (XLA
+  fallback, or the ``serving/paged_kernel.py`` Pallas paged-attention
+  kernel) and the new token appends with an O(one-token) in-dispatch
+  write — no per-step gather/scatter round trip (``direct=False``
+  keeps the legacy round trip as the bench A/B baseline). Outputs stay
+  bit-identical to the slot arena (and to one-shot ``sample_stream``)
+  on every path. With ``prefix_cache=True`` (default) shared
+  full-block prompt prefixes prime once (``serving/prefix_cache.py``):
+  later requests map the cached pages and prefill only their suffix.
+  ``dl4jtpu_serving_kv_bytes_moved_total`` prices the KV path in use;
+  see ARCHITECTURE.md "Paged decode fast path".
 - ``speculation=SpeculationConfig(draft, gamma)`` folds the
   ``speculative_sample`` machinery into the decode loop: per step the
   host `draft` proposes up to gamma tokens per active slot and ONE
@@ -106,7 +112,8 @@ from deeplearning4j_tpu.monitoring.metrics import (
     MetricsRegistry, global_registry)
 from deeplearning4j_tpu.nn.conf.layers import (
     BATCHED_STREAM_KEYS, PositionalEmbeddingLayer, check_rewindable,
-    rewind_stream_state, stream_capacity)
+    paged_decode_impl, rewind_stream_state, set_paged_decode_impl,
+    stream_capacity)
 from deeplearning4j_tpu.resilience.chaos import fire as _fire_chaos
 from deeplearning4j_tpu.resilience.retry import RetryPolicy, retry_call
 from deeplearning4j_tpu.serving.errors import (
@@ -114,9 +121,10 @@ from deeplearning4j_tpu.serving.errors import (
     ServingOverloaded, ServingQueueFull)
 from deeplearning4j_tpu.serving.health import (
     SERVING_ACTIVE_SLOTS, SERVING_BROWNOUT_LEVEL,
-    SERVING_DEADLINE_EXCEEDED, SERVING_DRAINING, SERVING_EARLY_REJECTED,
-    SERVING_ERRORS, SERVING_KV_PAGES_TOTAL, SERVING_KV_PAGES_USED,
-    SERVING_PREFIX_HITS, SERVING_PREFIX_MISSES,
+    SERVING_DEADLINE_EXCEEDED, SERVING_DISPATCH_LATENCY,
+    SERVING_DRAINING, SERVING_EARLY_REJECTED, SERVING_ERRORS,
+    SERVING_KV_BYTES_MOVED, SERVING_KV_PAGES_TOTAL,
+    SERVING_KV_PAGES_USED, SERVING_PREFIX_HITS, SERVING_PREFIX_MISSES,
     SERVING_PREFIX_REUSED_TOKENS, SERVING_QUEUE_REJECTED,
     SERVING_QUEUE_WAIT, SERVING_REQUESTS, SERVING_SHED,
     SERVING_SPEC_ACCEPTANCE, SERVING_TOKENS, SERVING_TPOT, SERVING_TTFT,
@@ -124,6 +132,8 @@ from deeplearning4j_tpu.serving.health import (
 from deeplearning4j_tpu.serving.overload import (
     BROWNOUT_NO_PREFIX_INSERTS, BROWNOUT_NO_SPECULATION,
     BROWNOUT_REDUCED_GAMMA, OverloadConfig, OverloadController)
+from deeplearning4j_tpu.serving.paged_kernel import (
+    paged_attention_supported)
 from deeplearning4j_tpu.serving.paging import (
     PagedKVConfig, PagePool, gather_pages, pages_needed, scatter_pages)
 from deeplearning4j_tpu.serving.prefix_cache import PrefixCache
@@ -248,6 +258,33 @@ class GenerationEngine:
         self._page_store = None            # device pools, per paged leaf
         self._paged_keys = None            # [(layer name, kv_k|kv_v)]
         self._page_tables: List[List[int]] = [[] for _ in range(slots)]
+        #: direct paged decode (no gather/scatter round trip) + its
+        #: resolved attention impl ("xla" | "pallas"); see
+        #: ARCHITECTURE.md "Paged decode fast path"
+        self._direct = False
+        self._decode_impl: Optional[str] = None
+        #: cached [S, n_max] page table — np + device copies, rebuilt
+        #: only after a table MUTATION (admit/retire/rebuild), not per
+        #: step (the host used to rebuild and re-upload it every step
+        #: even when nothing changed)
+        self._tables_cache: Optional[np.ndarray] = None
+        self._table_dev_cache = None
+        self._tables_layer_cache = None    # per-layer copies (donation)
+        #: modeled KV bytes moved by the pool<->dispatch paths (see
+        #: serving/health.SERVING_KV_BYTES_MOVED)
+        self._kv_bytes_total = 0
+        self._tok_bytes = 0                # per-position bytes, all leaves
+        #: whether direct dispatches actually donate state buffers
+        #: (rnn_time_step resolves donation off on CPU — there the
+        #: pre-dispatch table/pool references stay valid)
+        self._state_donated = jax.default_backend() != "cpu"
+        #: host mirror of the dispatch-latency histogram (health())
+        self._dispatch_s_total = 0.0
+        #: a retirement freed a slot whose DEVICE kv_pos keeps coasting
+        #: (+1 per dispatch): the next direct install zeroes free rows'
+        #: positions so an idle slot that once held a long context
+        #: doesn't defeat the kernel's dead-block skip forever
+        self._kv_pos_dirty = False
         if paging is not None:
             kv_layers = [l for l in layers
                          if getattr(l, "supports_streaming", False)
@@ -272,6 +309,22 @@ class GenerationEngine:
             self._n_max = -(-self._L // self._ps)
             usable = paging.resolve_pages(slots, self._n_max)
             self._pool = PagePool(usable + 1, self._ps)  # +1: null page
+            self._direct = bool(paging.direct)
+            if self._direct:
+                impl = paging.decode_impl
+                if impl == "auto":
+                    # the kernel path needs TPU-tileable shapes; the
+                    # XLA fallback serves everything else (and CPU)
+                    ok = all(paged_attention_supported(
+                        (0, 0, self._ps, l.n_out // l.n_heads), 1)
+                        for l in kv_layers)
+                    impl = ("pallas" if jax.default_backend() == "tpu"
+                            and ok else "xla")
+                # process-wide like stream-cache sharding: part of the
+                # streaming jit key, so engines with different impls
+                # retrace rather than silently sharing a trace
+                set_paged_decode_impl(impl, paging.kernel_interpret)
+                self._decode_impl = impl
             if paging.prefix_cache:
                 if any(getattr(l, "carries_recurrent_state", False)
                        for l in layers):
@@ -294,6 +347,13 @@ class GenerationEngine:
         self._decode_chaos = decode_chaos
         self._seat_chaos = seat_chaos
         self._decode_retry = decode_retry
+        #: donate state into direct dispatches ONLY without a retry
+        #: policy: a retried attempt would re-run against donated,
+        #: already-consumed buffers. With decode_retry set, direct mode
+        #: pays a pool copy per step (on TPU/GPU) for retryability —
+        #: the retry-exactness contract (the fault fires before any
+        #: state mutates) then holds exactly as on the legacy path.
+        self._donate = self._direct and decode_retry is None
         # -- survivability (serving/supervisor.py, serving/overload.py)
         self._supervisor = supervisor
         if isinstance(overload, OverloadConfig):
@@ -337,6 +397,16 @@ class GenerationEngine:
         self._queue_wait_hist = r.histogram(
             SERVING_QUEUE_WAIT, "Seconds a request waited for admission",
             ("model",)).labels(**lab)
+        self._dispatch_hist = r.histogram(
+            SERVING_DISPATCH_LATENCY, "Wall seconds per decode/verify "
+            "dispatch cycle (paged modes include the KV path around it)",
+            ("model",)).labels(**lab)
+        if self._pool is not None:
+            self._kv_bytes = r.counter(
+                SERVING_KV_BYTES_MOVED, "Modeled bytes the KV path "
+                "moves between the page pool and the dispatch (legacy: "
+                "full gather+scatter round trip; direct: in-dispatch "
+                "read + one-token append)", ("model",)).labels(**lab)
         r.gauge(SERVING_ACTIVE_SLOTS, "Arena slots holding an active "
                 "request", ("model",)).set_function(
             scrape_probe(self, lambda s: s.active_slots()),
@@ -411,12 +481,26 @@ class GenerationEngine:
         out = {"healthy": self.is_healthy(), "ready": self.is_ready(),
                "queue_depth": self.queue_depth(),
                "active_slots": self.active_slots(),
-               "slots": self.slots}
+               "slots": self.slots,
+               "decode_dispatch": {
+                   "count": self._dispatches,
+                   "mean_ms": round(
+                       self._dispatch_s_total * 1e3
+                       / max(1, self._dispatches), 3)}}
         if self._pool is not None:
             out["kv_pages"] = {"total": self._pool.usable,
                                "used": self._pool.used_count(),
                                "free": self._pool.free_count(),
                                "page_size": self._pool.page_size}
+            out["kv_traffic"] = {
+                # the LIVE impl: another engine's construction can flip
+                # the process-wide setting — report what dispatches
+                # actually run, not the construction-time resolution
+                "decode_path": (f"direct-{self._live_impl()}"
+                                if self._direct else "roundtrip"),
+                "bytes_moved_total": self._kv_bytes_total,
+                "dispatches": self._dispatches,
+            }
         if self._prefix is not None:
             out["prefix_cache"] = {"entries": len(self._prefix),
                                    "hits": self._prefix.hits,
@@ -657,7 +741,9 @@ class GenerationEngine:
             return                 # everything retired at the guard
         self._sync_accounting()
         tp = self._run_dispatch(
-            lambda: verify_tokens(self.net, chunk, self.V))
+            lambda: verify_tokens(self.net, chunk, self.V,
+                                  donate_state=self._donate),
+            width=1 + k)
         now = time.monotonic()
         amounts = np.full(self.slots, 1 + k, np.int32)  # free rows: all
         for s in riders:
@@ -854,6 +940,7 @@ class GenerationEngine:
         n_hit = hit_len // self._ps
         row[0, :n_hit] = table[:n_hit]
         dense = gather_pages(self._page_store, row, length=self._L)
+        self._kv_traffic(self._L * self._tok_bytes)   # one-row gather
         pos = jnp.asarray(hit_len, jnp.int32)
         for (n, k), leaf in zip(self._paged_keys, dense):
             cur = net.state.get(n)
@@ -949,6 +1036,7 @@ class GenerationEngine:
         if self._pool is not None:
             self._scatter_primed_pages(primed_state, table)
             self._page_tables[slot] = table
+            self._invalidate_tables()
             if self._prefix is not None \
                     and self._brownout < BROWNOUT_NO_PREFIX_INSERTS:
                 self._prefix.insert(req.prompt, table)
@@ -994,6 +1082,8 @@ class GenerationEngine:
             self._page_store = None
             self._paged_keys = None
             self._page_tables = [[] for _ in range(self.slots)]
+            self._invalidate_tables()
+            self._kv_pos_dirty = False   # the rebuilt state is fresh
         self.net.rnn_clear_previous_state()
         self._sync_accounting()
         if self._overload is not None:
@@ -1066,6 +1156,11 @@ class GenerationEngine:
                                "in the primed stream state")
         self._paged_keys = keys
         self._page_store = store
+        # per-token KV bytes summed over leaves — the unit of the
+        # modeled kv-bytes-moved accounting
+        self._tok_bytes = sum(
+            int(p.shape[1]) * int(p.shape[3]) * p.dtype.itemsize
+            for p in store)
 
     def _scatter_primed_pages(self, primed_state, table) -> None:
         """Commit the primed batch-1 KV into the slot's pages (one
@@ -1075,6 +1170,7 @@ class GenerationEngine:
         row[0, :len(table)] = table
         dense = [primed_state[n][k] for n, k in self._paged_keys]
         self._page_store = scatter_pages(self._page_store, dense, row)
+        self._kv_traffic(self._L * self._tok_bytes)   # one-row commit
 
     def _dispatch_step(self):
         """ONE jitted decode dispatch advancing every active slot (free
@@ -1093,49 +1189,202 @@ class GenerationEngine:
             return None     # everything retired at the capacity guard
         self._sync_accounting()
         probs = self._run_dispatch(
-            lambda: step_tokens(self.net, toks, self.V))
+            lambda: step_tokens(self.net, toks, self.V,
+                                donate_state=self._donate))
         for s, req in enumerate(self._slots):
             if req is not None:
                 self._row_pos[s] += 1
         self._sync_accounting()
         return probs
 
-    def _run_dispatch(self, fn):
+    def _run_dispatch(self, fn, width: int = 1):
         """The ONE paged/chaos/retry wrapper around a decode or verify
-        dispatch: gather the dense view from the pool, run `fn` with
-        the chaos hook INSIDE the retried callable (the fault fires
-        before any state mutates, so a retried dispatch is numerically
-        identical to a fault-free one), then commit the updated view
-        back BEFORE any retirement the outputs trigger can free
-        pages."""
-        table = self._paged_gather() if self._pool is not None else None
+        dispatch (`width` = appended positions per row: 1 plain,
+        1 + gamma speculative), with the chaos hook INSIDE the retried
+        callable (the fault fires before any state mutates, so a
+        retried dispatch is numerically identical to a fault-free one).
+
+        Paged modes differ in what moves around `fn`:
+
+        - DIRECT (the fast path): the pool + cached page tables are
+          installed into ``net.state`` as references — the dispatch
+          itself reads K/V through the table and appends the new
+          tokens' K/V in place (O(one-token) write); afterwards the
+          updated pool references are extracted back. Nothing is
+          materialized densely, nothing is scattered back.
+        - legacy round trip (``PagedKVConfig(direct=False)``, the bench
+          A/B baseline): gather the dense view from the pool, run the
+          dispatch over it, commit the updated view back BEFORE any
+          retirement the outputs trigger can free pages.
+
+        Every cycle lands in the dispatch-latency histogram and the
+        modeled KV traffic in the kv-bytes-moved counter."""
+        direct = self._pool is not None and self._direct
+        table = None
+        if direct:
+            self._install_paged_state()
+        elif self._pool is not None:
+            table = self._paged_gather()
 
         def once():
             _fire_chaos(self._decode_chaos, self._dispatches)
             return fn()
 
+        t0 = time.perf_counter()
         out = (retry_call(once, policy=self._decode_retry,
                           op="serving_decode")
                if self._decode_retry is not None else once())
-        if table is not None:
+        if direct:
+            self._extract_paged_state()
+        elif table is not None:
             self._paged_scatter(table)
+        dt = time.perf_counter() - t0
+        self._dispatch_s_total += dt
+        self._dispatch_hist.observe(dt)
+        if self._pool is not None:
+            self._kv_traffic(self._kv_dispatch_bytes(width))
         self._dispatches += 1
         return out
 
     # ------------------------------------------------------------------
-    # the paged pool <-> dense-view round trip
+    # the paged pool <-> dispatch plumbing (direct view / legacy round
+    # trip) + cached page tables
     # ------------------------------------------------------------------
-    def _tables_np(self) -> np.ndarray:
-        t = np.zeros((self.slots, self._n_max), np.int32)
-        for s, pages in enumerate(self._page_tables):
-            t[s, :len(pages)] = pages
-        return t
+    def _live_impl(self) -> Optional[str]:
+        """The impl direct dispatches run under RIGHT NOW — the
+        process-wide setting, which a later engine's construction can
+        flip (retracing this engine's next dispatch onto the new
+        path). ``self._decode_impl`` records only what THIS engine
+        resolved at construction."""
+        return paged_decode_impl()[0] if self._direct else None
 
-    def _paged_gather(self) -> np.ndarray:
-        """Materialize the dense per-slot KV view from the pool into
-        ``net.state`` for the coming dispatch; returns the page table it
-        was gathered through (the scatter must use the same snapshot)."""
-        table = self._tables_np()
+    def _invalidate_tables(self) -> None:
+        """Drop the cached [S, n_max] table snapshots — call after ANY
+        page-table mutation (admit / retire / rebuild). Between
+        mutations every dispatch reuses the same host array and device
+        upload(s): steady-state decode re-uploads nothing."""
+        self._tables_cache = None
+        self._table_dev_cache = None
+        self._tables_layer_cache = None
+
+    def _tables_np(self) -> np.ndarray:
+        if self._tables_cache is None:
+            t = np.zeros((self.slots, self._n_max), np.int32)
+            for s, pages in enumerate(self._page_tables):
+                t[s, :len(pages)] = pages
+            self._tables_cache = t
+        return self._tables_cache
+
+    def _table_dev(self):
+        """One shared device copy of the table (the legacy round trip's
+        gather/scatter argument)."""
+        if self._table_dev_cache is None:
+            self._table_dev_cache = jnp.asarray(self._tables_np())
+        return self._table_dev_cache
+
+    def _tables_dev_per_layer(self):
+        """Device table copies, one DISTINCT buffer per paged layer:
+        the direct path donates the whole state pytree on TPU, and
+        donation must never see the same buffer at two leaves."""
+        if self._tables_layer_cache is None:
+            tnp = self._tables_np()
+            self._tables_layer_cache = {
+                n: jnp.asarray(tnp)
+                for n in dict.fromkeys(n for n, _ in self._paged_keys)}
+        return self._tables_layer_cache
+
+    def _install_paged_state(self) -> None:
+        """Install the paged decode view for the coming dispatch: each
+        paged layer's state dict gains the pool pair + its page table
+        (the paged state protocol —
+        ``SelfAttentionLayer._stream_attend_paged``). Pure reference
+        plumbing: no bytes move here, and the table device upload
+        happens only on the first dispatch after a mutation."""
+        tables = self._tables_dev_per_layer()
+        st = dict(self.net.state)
+        for (n, k), pool in zip(self._paged_keys, self._page_store):
+            d = dict(st[n])
+            d["kv_page_k" if k == "kv_k" else "kv_page_v"] = pool
+            d["kv_page_table"] = tables[n]
+            st[n] = d
+        if self._kv_pos_dirty:
+            # a retirement left free rows' device kv_pos coasting:
+            # without a reset a once-long idle slot keeps its stale
+            # length forever (the kernel would scan its dead blocks
+            # every step, and the modeled bytes would drift from the
+            # real reads). One tiny [S] where per layer, only on the
+            # first dispatch after a retirement — free rows' appends
+            # already route to the null page, so zeroing their
+            # positions changes nothing any live request reads.
+            free = jnp.asarray([r is None for r in self._slots])
+            for n in dict.fromkeys(n for n, _ in self._paged_keys):
+                d = st[n]
+                d["kv_pos"] = jnp.where(free, 0, d["kv_pos"])
+            self._kv_pos_dirty = False
+        self.net.state = st
+
+    def _extract_paged_state(self) -> None:
+        """Pull the (appended-to) pools back out of ``net.state`` after
+        a direct dispatch, and refresh the per-layer table cache from
+        the returned leaves — under donation the pre-dispatch buffers
+        are consumed, so the returned references are the only live
+        copies."""
+        st = dict(self.net.state)
+        store = [st[n]["kv_page_k" if k == "kv_k" else "kv_page_v"]
+                 for n, k in self._paged_keys]
+        tables = {}
+        for n in dict.fromkeys(n for n, _ in self._paged_keys):
+            d = dict(st[n])
+            tables[n] = d.pop("kv_page_table")
+            d.pop("kv_page_k", None)
+            d.pop("kv_page_v", None)
+            st[n] = d
+        self._page_store = store
+        if self._state_donated and self._donate:
+            # donation consumed the installed buffers: the returned
+            # (pass-through) table leaves are the only live copies
+            self._tables_layer_cache = tables
+        self.net.state = st
+
+    # -- modeled KV traffic (serving/health.SERVING_KV_BYTES_MOVED) ----
+    def _kv_traffic(self, nbytes: int) -> None:
+        if nbytes:
+            self._kv_bytes_total += int(nbytes)
+            self._kv_bytes.inc(int(nbytes))
+
+    def _kv_dispatch_bytes(self, width: int) -> int:
+        """Bytes the KV path moves around ONE dispatch, modeled from
+        the path in use (summed over attention leaves; reads + writes):
+
+        - legacy round trip: the gather materializes the full dense
+          [S, L] view and the scatter writes it all back — 2·S·L
+          positions regardless of live context.
+        - direct-xla: the folded gather still materializes the mapped
+          [S, L] view once inside the dispatch (S·L reads), but the
+          write is the one-token append (S·width).
+        - direct-pallas: only LIVE pages are read (the table-indexed
+          block specs skip dead blocks to the null page) — sum of each
+          active row's page-rounded context — plus the append.
+        """
+        if self._tok_bytes == 0:
+            return 0
+        S, L, ps = self.slots, self._L, self._ps
+        if not self._direct:
+            return 2 * S * L * self._tok_bytes
+        append = S * width * self._tok_bytes
+        if self._live_impl() == "pallas":
+            live = sum(
+                min(-(-int(self._row_pos[s] + width) // ps) * ps, L)
+                for s, r in enumerate(self._slots) if r is not None)
+            return live * self._tok_bytes + append
+        return S * L * self._tok_bytes + append
+
+    def _paged_gather(self):
+        """Legacy round trip: materialize the dense per-slot KV view
+        from the pool into ``net.state`` for the coming dispatch;
+        returns the (cached) device page table it was gathered through
+        (the scatter must use the same snapshot)."""
+        table = self._table_dev()
         dense = gather_pages(self._page_store, table, length=self._L)
         st = dict(self.net.state)
         for (n, k), leaf in zip(self._paged_keys, dense):
@@ -1145,11 +1394,11 @@ class GenerationEngine:
         self.net.state = st
         return table
 
-    def _paged_scatter(self, table: np.ndarray) -> None:
-        """Commit the dispatch's updated dense KV back to the mapped
-        pages (donated in-place pool update). Must run before any
-        retirement triggered by the dispatch's outputs — freed pages
-        may be re-allocated at the next admission."""
+    def _paged_scatter(self, table) -> None:
+        """Legacy round trip: commit the dispatch's updated dense KV
+        back to the mapped pages (donated in-place pool update). Must
+        run before any retirement triggered by the dispatch's outputs —
+        freed pages may be re-allocated at the next admission."""
         dense = [self.net.state[n][k] for n, k in self._paged_keys]
         self._page_store = scatter_pages(self._page_store, dense, table)
 
@@ -1168,6 +1417,8 @@ class GenerationEngine:
             for p in self._page_tables[slot]:
                 self._pool.release(p)
             self._page_tables[slot] = []
+            self._invalidate_tables()
+            self._kv_pos_dirty = True
         if exc is not None:
             req.handle._fail(exc, reason)
         else:
@@ -1181,7 +1432,13 @@ class GenerationEngine:
         structure broadcast to S zeroed rows (kv_abs rows start -1 =
         empty, matching a fresh rolling cache), per-row kv_pos vector at
         0. Free rows are inert: nothing reads them until a scatter
-        overwrites them."""
+        overwrites them.
+
+        DIRECT paged mode drops the dense kv_k/kv_v leaves entirely:
+        the pool is the only KV storage (no [S, Hkv, L, D] arena copy
+        exists to allocate, gather into, or scatter from — the memory
+        half of the round-trip elimination); the per-dispatch paged
+        view rides in via _install_paged_state instead."""
         S = self.slots
         arena = {}
         for name, s in primed_state.items():
@@ -1200,6 +1457,8 @@ class GenerationEngine:
             for k, v in s.items():
                 if k not in _SCATTER_KEYS:
                     continue
+                if self._direct and k in ("kv_k", "kv_v"):
+                    continue        # the page pool IS the KV storage
                 v = jnp.asarray(v)
                 if k == "kv_pos":
                     d[k] = jnp.zeros((S,), v.dtype)

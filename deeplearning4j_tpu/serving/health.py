@@ -46,6 +46,16 @@ SERVING_PREFIX_REUSED_TOKENS = \
     "dl4jtpu_serving_prefix_cache_reused_tokens_total"
 SERVING_SPEC_ACCEPTANCE = "dl4jtpu_serving_spec_acceptance_ratio"
 
+#: KV-traffic accounting for the paged decode paths (engine registers
+#: these in paged mode): bytes the KV round trip MOVES per dispatch —
+#: modeled host-side from the path in use (legacy round trip:
+#: gather + scatter of the full dense view; direct-xla: one in-dispatch
+#: gather + the one-token append; direct-pallas: live pages read + the
+#: one-token append) — plus the per-step decode dispatch latency. The
+#: round-trip elimination is a number here, not a claim.
+SERVING_KV_BYTES_MOVED = "dl4jtpu_serving_kv_bytes_moved_total"
+SERVING_DISPATCH_LATENCY = "dl4jtpu_serving_decode_dispatch_seconds"
+
 #: survivability layer (supervisor.py / overload.py register these)
 SERVING_ENGINE_REBUILDS = "dl4jtpu_serving_engine_rebuilds_total"
 SERVING_ENGINE_ESCALATIONS = \

@@ -16,16 +16,22 @@ slot's token blocks to pool pages. Capacity becomes a *token* budget
 - pages are refcounted, so the prefix cache can map one physical page
   into many slots' tables read-only (``serving/prefix_cache.py``).
 
-The per-step dispatch keeps PR 5's canonical shape: a jitted
-``gather_pages`` materializes the active slots' dense ``[S, Hkv, L, D]``
-view from the pool, the ONE decode (or widened verify) dispatch runs
-over it unchanged — bit-identical math to the slot arena, since valid
-positions gather the exact bytes the arena would hold — and a jitted
-donated ``scatter_pages`` commits the updated view back to the mapped
-pages. Three fixed-shape dispatches per step, zero retraces after
-warmup. (Fusing the gather into the attention kernel itself — true
-paged attention — is the Pallas ``kernels/`` roadmap item; this module
-is the allocation/accounting layer it will slot under.)
+The per-step dispatch is DIRECT by default (PR 10, ``direct=True``):
+the attention step reads K/V straight through the page table (XLA
+fallback folds the ``pool[table]`` gather into the dispatch; the
+``serving/paged_kernel.py`` Pallas kernel reads only live pages via
+scalar-prefetched tables) and the new token's K/V appends with an
+O(one-token) in-dispatch write — one fixed-shape dispatch per step,
+nothing materialized densely, zero retraces after warmup (see
+ARCHITECTURE.md "Paged decode fast path"). ``direct=False`` keeps the
+legacy round trip this module's ``gather_pages``/``scatter_pages``
+implement — a jitted gather materializes the active slots' dense
+``[S, Hkv, L, D]`` view, the ONE decode (or widened verify) dispatch
+runs over it unchanged, and a jitted donated scatter commits the
+updated view back — the bench A/B baseline, bit-identical math either
+way since valid positions carry the exact bytes the slot arena would
+hold. (``gather_pages`` also still serves the prefix cache's one-row
+prefill installs.)
 
 Page 0 is the reserved **null page**: table entries beyond a slot's
 allocation point at it, so gathers read garbage that position-validity
@@ -60,17 +66,36 @@ class PagedKVConfig:
     or ``total_tokens`` (whichever is given — ``total_tokens`` rounds
     down to whole pages), defaulting to the old slot arena's worst case
     (slots × ceil(L / page_size)) so switching paging on never shrinks
-    capacity. ``prefix_cache`` enables shared-prompt page reuse."""
+    capacity. ``prefix_cache`` enables shared-prompt page reuse.
+
+    ``direct`` (default) makes decode operate DIRECTLY on the page
+    pool: the attention step reads K/V through the page table and the
+    new token appends with an O(one-token) in-dispatch write — no
+    per-step gather/scatter round trip (ARCHITECTURE.md "Paged decode
+    fast path"). ``direct=False`` keeps the legacy round trip (the
+    bench A/B baseline). ``decode_impl`` selects the direct read path:
+    ``"xla"`` (any backend — the gather folds into the dispatch),
+    ``"pallas"`` (the serving/paged_kernel.py TPU paged-attention
+    kernel; ``kernel_interpret=True`` emulates it on CPU for exactness
+    tests), or ``"auto"`` (pallas on TPU when the shapes pass the
+    kernel gate, xla otherwise)."""
 
     page_size: int = 8
     total_pages: Optional[int] = None
     total_tokens: Optional[int] = None
     prefix_cache: bool = True
+    direct: bool = True
+    decode_impl: str = "auto"
+    kernel_interpret: bool = False
 
     def __post_init__(self):
         if self.page_size < 1:
             raise ValueError(f"page_size must be >= 1, got "
                              f"{self.page_size}")
+        if self.decode_impl not in ("auto", "xla", "pallas"):
+            raise ValueError(
+                f"decode_impl must be 'auto', 'xla' or 'pallas', got "
+                f"{self.decode_impl!r}")
         if self.total_pages is not None and self.total_pages < 1:
             raise ValueError(f"total_pages must be >= 1, got "
                              f"{self.total_pages}")

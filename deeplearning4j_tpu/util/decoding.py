@@ -326,18 +326,28 @@ def prime_prompt(net, ids, vocab_size: int, padded: bool = False,
     return _probs(out)[0, :, -1]
 
 
-def step_tokens(net, tokens, vocab_size: int) -> np.ndarray:
+def step_tokens(net, tokens, vocab_size: int,
+                donate_state: bool = False) -> np.ndarray:
     """One incremental decode step for a batch of rows: feed one token
     per row in a single dispatch, return the next-token distributions
     [B, V]. The per-step unit shared by sample_stream (B=1),
     sample_stream_batch, and the serving engine's slot arena (B=S,
-    canonical shape, zero retraces after the first step)."""
+    canonical shape, zero retraces after the first step).
+
+    ``donate_state=True`` is the paged-state protocol: the serving
+    engine's direct-paged decode installs the KV page pools in
+    ``net.state`` and donates them into the dispatch, so the one-token
+    append updates the pool IN PLACE (TPU/GPU; a no-op on CPU). The
+    caller must treat the pre-call state as consumed — the state the
+    net carries after the call is the only live copy."""
     out = net.rnn_time_step(
-        _one_hot(np.asarray(tokens, np.int64)[:, None], vocab_size))
+        _one_hot(np.asarray(tokens, np.int64)[:, None], vocab_size),
+        donate_state=donate_state)
     return _probs(out)[:, :, -1]
 
 
-def verify_tokens(net, chunks, vocab_size: int) -> np.ndarray:
+def verify_tokens(net, chunks, vocab_size: int,
+                  donate_state: bool = False) -> np.ndarray:
     """One widened verify forward for a batch of token chunks: feed
     `chunks` [B, W] (W = 1 + gamma for engine speculation) in a single
     dispatch and return ALL per-position next-token distributions
@@ -345,9 +355,12 @@ def verify_tokens(net, chunks, vocab_size: int) -> np.ndarray:
     row is the distribution AFTER consuming chunk[:, :j+1]; causality
     makes trailing dummy tokens invisible to earlier positions, so a
     fixed-width chunk serves rows with fewer real proposals (the
-    uniform-chunk trick of speculative_sample_batch)."""
+    uniform-chunk trick of speculative_sample_batch). `donate_state`
+    follows step_tokens' paged-state protocol — the widened chunk runs
+    the same paged append/attend path at width W."""
     out = net.rnn_time_step(
-        _one_hot(np.asarray(chunks, np.int64), vocab_size))
+        _one_hot(np.asarray(chunks, np.int64), vocab_size),
+        donate_state=donate_state)
     return _probs(out)
 
 

@@ -75,6 +75,14 @@ python -m pytest tests/test_serving_supervisor.py -q -p no:cacheprovider
 # with every mode on
 python -m pytest tests/test_serving_paged.py -q -p no:cacheprovider
 
+# tier-1 paged-kernel lane: the direct paged-decode fast path
+# (serving/paged_kernel.py + the engine's install/extract seam) — the
+# Pallas paged-attention kernel vs its dense-gather reference, engine
+# bit-exactness on BOTH direct impls (XLA fallback + interpret-mode
+# kernel), cached-table invariants, KV-traffic telemetry, supervisor
+# recovery re-entering the direct path, zero retraces with the kernel on
+python -m pytest tests/test_serving_paged_kernel.py -q -p no:cacheprovider
+
 python -m pytest tests/ -q --junitxml=/tmp/dl4jtpu_junit.xml "$@"
 
 # only a FULL unfiltered run may overwrite the committed tally — a

@@ -163,6 +163,63 @@ class TestDeviceTransferRule:
         """)
         assert fs == []
 
+    def test_positive_per_step_table_rebuild(self, tmp_path):
+        """The serving decode-loop shape this rule grew to catch: the
+        host rebuilds and re-uploads the full page table every step
+        even when nothing changed."""
+        fs = _scan_snippet(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+
+            class Engine:
+                def _dispatch_step(self):
+                    table = jnp.asarray(self._tables_np())
+                    return self._decode(self.pool[table])
+
+                def step(self):
+                    t = jax.device_put(self._tables_np())
+                    return self._decode(t)
+        """)
+        assert _rules_of(fs) == ["device-transfer-in-hot-loop"] * 2
+        assert any("per-step path" in f.message for f in fs)
+
+    def test_negative_cached_table_path(self, tmp_path):
+        """The engine's cached-table fix shape: the transfer lives in a
+        cache helper OUTSIDE the per-step names, rebuilt only after an
+        invalidating mutation — steady-state steps re-upload nothing."""
+        fs = _scan_snippet(tmp_path, """
+            import jax.numpy as jnp
+
+            class Engine:
+                def _tables_dev(self):
+                    if self._cache is None:
+                        self._cache = jnp.asarray(self._tables_np())
+                    return self._cache
+
+                def _invalidate_tables(self):
+                    self._cache = None
+
+                def _dispatch_step(self):
+                    return self._decode(self.pool[self._tables_dev()])
+        """)
+        assert fs == []
+
+    def test_negative_nested_step_is_jit_body(self, tmp_path):
+        """A nested ``def step(...)`` is a jitted/scan body — its
+        jnp.asarray is a trace-time constant, not a per-step H2D."""
+        fs = _scan_snippet(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+
+            class Net:
+                def _get_train_step(self):
+                    def step(params, batch):
+                        decay = jnp.asarray(self.decay_schedule)
+                        return params, decay
+                    return jax.jit(step)
+        """)
+        assert fs == []
+
 
 # ---------------------------------------------------------------------
 # rule: tracer-leak

@@ -165,8 +165,10 @@ class TestPaddedPrimeServing:
 
     def _padded_traces(self, net):
         from deeplearning4j_tpu.nn.conf import layers as L
-        fn = net._jit_cache.get(("rnn_step", True, net.conf.dtype,
-                                 L._STREAM_CACHE_SHARDING))
+        fn = net._jit_cache.get(("rnn_step", True, False,
+                                 net.conf.dtype,
+                                 L._STREAM_CACHE_SHARDING,
+                                 L._PAGED_DECODE_IMPL))
         assert fn is not None, "rnn_step jit key drifted from the tests"
         return fn._cache_size()
 

@@ -270,3 +270,79 @@ class TestWindowKernel:
         with pytest.raises(ValueError, match="causal"):
             flash_attention(q, k, v, causal=False, window=32,
                             interpret=True)
+
+
+class TestDecodeShapes:
+    """Decode-shaped queries (PR 10, the serving fast path): a width-1
+    or width-1+gamma query block attending a long KV prefix as banded
+    attention with q_offset = Tk - W — the flash-kernel shape the
+    engine's dispatch family maps onto (the paged pool variant lives in
+    serving/paged_kernel.py, pinned by its own suite; this pins the
+    dense-KV kernel at the same query widths)."""
+
+    @staticmethod
+    def _decode_ref(q, k, v, W):
+        # query w sits at absolute position Tk - W + w
+        Tk = k.shape[2]
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) / np.sqrt(q.shape[-1])
+        qpos = Tk - W + jnp.arange(W)
+        valid = jnp.arange(Tk)[None, :] <= qpos[:, None]
+        s = jnp.where(valid[None, None], s, -1e30)
+        return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1),
+                          v.astype(jnp.float32))
+
+    @pytest.mark.parametrize("W", [1, 5])
+    def test_decode_width_matches_reference(self, W):
+        from deeplearning4j_tpu.nn.layers.pallas_attention import (
+            flash_attention_lse)
+        rng = np.random.default_rng(11)
+        B, H, Tk, D = 2, 2, 384, 64
+        q = jnp.asarray(rng.standard_normal((B, H, W, D)) * 0.5,
+                        jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, H, Tk, D)) * 0.5,
+                        jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, H, Tk, D)) * 0.5,
+                        jnp.float32)
+        out, lse = flash_attention_lse(q, k, v, causal=True,
+                                       q_offset=Tk - W,
+                                       block_q=128, block_k=128,
+                                       interpret=True)
+        ref = self._decode_ref(q, k, v, W)
+        assert out.shape == (B, H, W, D)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+        # the lse is finite and real for every decode row (the ring /
+        # cross-chunk combine contract holds at decode widths too)
+        assert np.isfinite(np.asarray(lse)).all()
+
+    def test_decode_width_sees_only_past(self):
+        """Poison the keys strictly after the LAST query's position
+        with large FINITE garbage (the kernel's masking contract — the
+        dense arena's idle-slot argument: masked scores go to -1e30
+        before the softmax, and zero probabilities annihilate finite
+        values exactly): a decode-shaped block must not read them."""
+        from deeplearning4j_tpu.nn.layers.pallas_attention import (
+            flash_attention_lse)
+        rng = np.random.default_rng(13)
+        B, H, Tk, D, W = 1, 2, 256, 64, 3
+        q = jnp.asarray(rng.standard_normal((B, H, W, D)), jnp.float32)
+        k = np.asarray(rng.standard_normal((B, H, Tk, D)), np.float32)
+        v = np.asarray(rng.standard_normal((B, H, Tk, D)), np.float32)
+        # run the appended chunk mid-sequence: keys past off+W are
+        # visible to NO real query row
+        off = 100
+        kp, vp = k.copy(), v.copy()
+        kp[:, :, off + W:] = 1e6
+        vp[:, :, off + W:] = 1e6
+        a, _ = flash_attention_lse(jnp.asarray(q),
+                                   jnp.asarray(k[:, :, :off + W]),
+                                   jnp.asarray(v[:, :, :off + W]),
+                                   causal=True, q_offset=off,
+                                   block_q=128, block_k=128,
+                                   interpret=True)
+        b, _ = flash_attention_lse(jnp.asarray(q), jnp.asarray(kp),
+                                   jnp.asarray(vp), causal=True,
+                                   q_offset=off, block_q=128,
+                                   block_k=128, interpret=True)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-6)

@@ -909,7 +909,8 @@ class TestBucketedDecoding:
     def _stream_traces(self, net):
         from deeplearning4j_tpu.nn.conf import layers as L
         fn = net._jit_cache.get(
-            ("rnn_step", False, net.conf.dtype, L._STREAM_CACHE_SHARDING))
+            ("rnn_step", False, False, net.conf.dtype,
+             L._STREAM_CACHE_SHARDING, L._PAGED_DECODE_IMPL))
         assert fn is not None, "rnn_step jit key drifted from the tests"
         return fn._cache_size()
 
