@@ -24,12 +24,19 @@ Env knobs: BENCH_BATCH/IMAGE/WARMUP/STEPS shapes; BENCH_SCAN_STEPS=K
 runs the fused K-step lax.scan train step (K optimizer steps per
 Python->XLA dispatch; every record carries steps_per_dispatch /
 dispatches / prefetch_h2d_bytes either way); BENCH_FUSE pins the
-execution plan (0 unfused, 1 bn→act→conv — measured SLOWER, PERF.md
-round 3 — 2 full fused-bottleneck chain). BENCH_FUSE UNSET on a real
+execution plan — DEPRECATED spelling kept for driver back-compat, now
+delegating to the production execution_plan API (tuning/plan.py, the
+same seam `net.fit(..., execution_plan=...)` resolves): 0 -> "xla",
+2/"bottleneck" -> "fused", "auto" -> store-resolved; 1 keeps the
+legacy bn→act→conv plan (measured SLOWER, PERF.md round 3).
+BENCH_FUSE UNSET on a real
 TPU runs the fused-vs-unfused A/B in this one invocation and reports
 the winning plan, with both numbers in the record (BENCH_AB=0 disables
 — the driver's end-of-round capture may be the only live window, so
-the A/B rides it automatically);
+the A/B rides it automatically); BENCH_CALIBRATE=1 additionally
+records the A/B verdict into the kernel-crossover store
+(KERNEL_CROSSOVER.json) via the per-shape calibration harness, so the
+one live window teaches every future "auto" run;
 BENCH_ALLOW_CPU=1 permits
 running on a CPU backend (smoke tests with tiny shapes only);
 BENCH_PLATFORM switches the jax platform via jax.config;
@@ -188,6 +195,16 @@ def _term_claim(signum):
 
 
 def main():
+    global _emitted
+    # module-state reset: main() can run more than once in-process
+    # (regression tests drive it directly), and a stale parked record
+    # or emitted flag from a previous invocation must never become —
+    # or suppress — THIS run's result line (the parked-record
+    # invariant: only a measurement completed in this run may be
+    # emitted for it)
+    with _emit_lock:
+        _emitted = False
+    _partial.clear()
     bench_probe.install_sigterm_handler(_term_line, _term_claim)
 
     probe_info = {}
@@ -265,8 +282,10 @@ def main():
               "(set BENCH_ALLOW_CPU=1 for smoke tests)")
         return 3
 
-    def _measure(fuse):
-        """One full measurement of the given execution plan. Fresh model
+    def _measure(plan):
+        """One full measurement of the given execution plan ("xla",
+        "fused", "auto" through the production tuning/plan.py seam;
+        "bn_act_conv" keeps the legacy fuse=True path). Fresh model
         + jit cache each call; returns (images/sec, dispatch count of
         the measured loop). With BENCH_SCAN_STEPS=K>1 the measured unit
         is the fused K-step lax.scan dispatch (K optimizer steps, one
@@ -279,12 +298,20 @@ def main():
 
         # NHWC internal layout: profile-driven (see PERF.md) — BN stat
         # reductions and channel work are lane-aligned, ~9% over NCHW.
+        kw = ({"fuse": True} if plan == "bn_act_conv"
+              else {"execution_plan": plan})
         model = ResNet50(num_classes=CLASSES, height=IMAGE, width=IMAGE,
                          updater=Nesterovs(0.1, momentum=0.9),
                          data_format=os.environ.get("BENCH_FORMAT", "NHWC"),
-                         fuse=fuse)
+                         **kw)
         net = model.init()
         net.conf.dtype = "bfloat16"  # MXU path, fp32 master params + accum
+        if plan != "bn_act_conv":
+            # re-resolve under the bench dtype: the crossover keys (and
+            # the stem's VMEM gate) are dtype-keyed, and conf.dtype was
+            # just flipped to bf16 after the zoo init resolved at f32
+            from deeplearning4j_tpu.tuning.plan import apply_execution_plan
+            apply_execution_plan(net, plan)
 
         rng = np.random.default_rng(0)
         x = rng.standard_normal((BATCH, 3, IMAGE, IMAGE)).astype(np.float32)
@@ -342,39 +369,56 @@ def main():
         return BATCH * k * n_disp / dt, n_disp
 
     try:
-        # BENCH_FUSE: 0 unfused, 1 bn→act→conv plan, 2 full fused-
-        # bottleneck Pallas chain (nn/layers/bottleneck.py). UNSET on a
+        # BENCH_FUSE (deprecated spelling, kept for driver back-compat —
+        # values now delegate to the execution_plan API): 0 -> "xla",
+        # 1 -> legacy bn→act→conv plan, 2/"bottleneck" -> "fused",
+        # "auto" -> store-resolved. UNSET on a
         # real TPU runs the fused-vs-unfused A/B in one invocation and
         # reports the winner (both numbers in the record) — the driver
         # runs plain `python bench.py`, and with the tunnel down for
         # rounds 2-5 the driver's own end-of-round capture may be the
         # only live window there is; the A/B must not need a second one.
         fuse_env = os.environ.get("BENCH_FUSE")
-        fuse_levels = {"0": False, "1": True,
-                       "2": "bottleneck", "bottleneck": "bottleneck"}
+        fuse_levels = {"0": "xla", "1": "bn_act_conv",
+                       "2": "fused", "bottleneck": "fused",
+                       "auto": "auto"}
         if fuse_env is not None and fuse_env not in fuse_levels:
-            raise ValueError(f"BENCH_FUSE={fuse_env!r}: expected 0, 1, 2 "
-                             "or 'bottleneck'")
+            raise ValueError(f"BENCH_FUSE={fuse_env!r}: expected 0, 1, 2, "
+                             "'bottleneck' or 'auto'")
         ab_env = os.environ.get("BENCH_AB", "1")
         ab = (fuse_env is None and ab_env != "0"
               and (platform == "tpu" or ab_env == "force"))
+        calibrate = os.environ.get("BENCH_CALIBRATE") == "1"
 
         img_s, n_disp = _measure(fuse_levels.get(fuse_env or "0"))
         extra = {"steps_per_dispatch": SCAN_STEPS, "dispatches": n_disp}
+
+        def _park(value, plan_name):
+            """Park the best-completed measurement + grant the NEXT
+            optional leg its own deadline: a hang/kill in an optional
+            leg must emit this real number, not a null record."""
+            _partial.update(
+                value=round(value, 2),
+                vs=round(value / DL4J_CUDA_REF_IMG_S, 3),
+                platform=platform,
+                extra={**extra, "plan": plan_name, **probe_info})
+            deadline_box[0] = time.monotonic() + TOTAL_TIMEOUT
+
         if ab:
             extra["unfused_img_s"] = round(img_s, 2)
-            # park the completed measurement + grant the fused leg its
-            # own deadline: a hang/kill in the OPTIONAL leg must emit
-            # this real number, not a null record
-            _partial.update(
-                value=round(img_s, 2),
-                vs=round(img_s / DL4J_CUDA_REF_IMG_S, 3),
-                platform=platform,
-                extra={**extra, "plan": "unfused", **probe_info})
-            deadline_box[0] = time.monotonic() + TOTAL_TIMEOUT
+            _park(img_s, "unfused")
             try:
-                fused_img_s, _ = _measure("bottleneck")
+                fused_img_s, _ = _measure("fused")
                 extra["fused_img_s"] = round(fused_img_s, 2)
+                if calibrate:
+                    # whole-model paired verdict for the record; the
+                    # per-shape store entries come from the harness
+                    # below. img/s already amortizes the K-step scan,
+                    # so ms per OPTIMIZER STEP is batch/img_s — no
+                    # SCAN_STEPS factor
+                    extra["ab_ms_per_step"] = {
+                        "fused": round(BATCH * 1e3 / fused_img_s, 3),
+                        "unfused": round(BATCH * 1e3 / img_s, 3)}
                 # same-moment paired comparison (run-to-run spread is
                 # ±10-15%; require a clear win to report the fused plan)
                 if fused_img_s > 1.03 * img_s:
@@ -385,6 +429,30 @@ def main():
             except Exception as e:  # mosaic lowering etc.: keep unfused
                 extra["fused_error"] = repr(e)[:200]
                 extra["plan"] = "unfused"
+        if calibrate:
+            # per-shape kernel-vs-fallback micro-calibration into the
+            # committed store — one live window teaches every future
+            # "auto" resolution. Runs as its OWN parked leg: a hang or
+            # crash here must never destroy the completed measurement.
+            _park(img_s, extra.get("plan", fuse_levels.get(
+                fuse_env or "0")))
+            try:
+                from deeplearning4j_tpu.tuning import (
+                    calibrate_training_kernels, default_store, winner)
+                from deeplearning4j_tpu.zoo import ResNet50
+                from deeplearning4j_tpu.nn.updater import Nesterovs
+                net = ResNet50(
+                    num_classes=CLASSES, height=IMAGE, width=IMAGE,
+                    updater=Nesterovs(0.1, momentum=0.9),
+                    data_format="NHWC").init()
+                net.conf.dtype = "bfloat16"
+                entries = calibrate_training_kernels(
+                    net, batch_size=min(BATCH, 16),
+                    store=default_store(), persist=True)
+                extra["calibrated"] = {k: winner(v)
+                                       for k, v in entries.items()}
+            except Exception as e:  # noqa: BLE001 — record beats store
+                extra["calibrate_error"] = repr(e)[:200]
 
         run_done.set()
         if not _emit(round(img_s, 2), round(img_s / DL4J_CUDA_REF_IMG_S, 3),

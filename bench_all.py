@@ -387,6 +387,87 @@ def bench_transformer():
                       "unit": "tokens/sec"}), flush=True)
 
 
+def bench_train_plan():
+    """Execution-plan A/B/A over the SAME zoo ResNet50 code path users
+    run (`execution_plan=` on the builder / fit loops, tuning/plan.py):
+    "xla" vs "fused" vs "auto". Tokens of truth for the next live
+    window: per-plan img/s, the per-step HBM-traffic model the fused
+    plan removes, which blocks/stem each plan engaged, and — with
+    BENCH_CALIBRATE=1 — the per-shape store decisions the run wrote
+    (KERNEL_CROSSOVER.json), so "auto" stops being a guess the moment
+    one window measures it. Env: BENCH_PLAN_BATCH/IMAGE/CLASSES size
+    the model (CPU smoke shrinks them), BENCH_PLAN_STEPS the loop."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning4j_tpu.tuning import (
+        calibrate_training_kernels, default_store,
+        modeled_train_step_traffic, winner)
+    from deeplearning4j_tpu.zoo import ResNet50
+    from deeplearning4j_tpu.nn.updater import Nesterovs
+
+    B = int(os.environ.get("BENCH_PLAN_BATCH",
+                           os.environ.get("BENCH_BATCH", "128")))
+    IMG = int(os.environ.get("BENCH_PLAN_IMAGE",
+                             os.environ.get("BENCH_IMAGE", "224")))
+    NC = int(os.environ.get("BENCH_PLAN_CLASSES", "1000"))
+    STEPS = int(os.environ.get("BENCH_PLAN_STEPS", "10"))
+    calibrate = os.environ.get("BENCH_CALIBRATE") == "1"
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((B, 3, IMG, IMG)).astype(np.float32)
+    y = np.zeros((B, NC), np.float32)
+    y[np.arange(B), rng.integers(0, NC, B)] = 1.0
+    rec = {"metric": "train_plan", "unit": "images/sec",
+           "batch": B, "image": IMG, "steps": STEPS}
+
+    def leg(plan):
+        from deeplearning4j_tpu.tuning.plan import apply_execution_plan
+        net = ResNet50(num_classes=NC, height=IMG, width=IMG,
+                       updater=Nesterovs(0.1, momentum=0.9),
+                       data_format="NHWC",
+                       execution_plan=plan).init()
+        net.conf.dtype = "bfloat16"
+        # re-resolve under bf16 (the crossover keys + stem gate are
+        # dtype-keyed; zoo init resolved before the dtype flip)
+        resolution = apply_execution_plan(net, plan)
+        step, args, k = _fused_step(net, (
+            net.params, net.state, net.updater_state,
+            {net.conf.network_inputs[0]: jnp.asarray(x)},
+            {net.conf.network_outputs[0]: jnp.asarray(y)},
+            jax.random.PRNGKey(0), None, None))
+        _, args = _sync_time(step, args, 2, measured=False)   # warmup
+        dt, _ = _sync_time(step, args, STEPS)
+        return (round(B * k * STEPS / dt, 1),
+                {"blocks": resolution["blocks"],
+                 "stem": resolution["stem"],
+                 "level": str(resolution["level"])}, net)
+
+    if calibrate:
+        # calibrate FIRST so this very run's "auto" leg resolves from
+        # fresh measured entries (the live-window workflow)
+        net = ResNet50(num_classes=NC, height=IMG, width=IMG,
+                       updater=Nesterovs(0.1, momentum=0.9),
+                       data_format="NHWC").init()
+        net.conf.dtype = "bfloat16"
+        entries = calibrate_training_kernels(
+            net, batch_size=min(B, 16), store=default_store(),
+            persist=True)
+        rec["store_decisions"] = {k: winner(v)
+                                 for k, v in entries.items()}
+    last_net = None
+    for plan in ("xla", "fused", "auto"):
+        img_s, info, last_net = leg(plan)
+        rec[f"{plan}_img_s"] = img_s
+        rec[f"{plan}_resolved"] = info
+    # per-step HBM-traffic model (what the fused plan removes) priced
+    # against the measured numbers — read off the last leg's net
+    # (candidates are plan-independent; no fourth model build)
+    rec["hbm_model_bytes_per_step"] = modeled_train_step_traffic(
+        last_net, B)
+    rec["value"] = rec["fused_img_s"]
+    _print_line(json.dumps(rec), flush=True)
+
+
 def bench_scaling():
     import jax
     virtual = jax.device_count() < 8
@@ -950,6 +1031,27 @@ def bench_serve_paged():
     assert rec["paged_kv_bytes_per_token"] < \
         lim * rec["paged_rt_kv_bytes_per_token"], rec
 
+    if os.environ.get("BENCH_CALIBRATE") == "1" and \
+            rec["paged_decode_path"] == "direct-pallas":
+        # record the decode-side crossover (PERF.md: "record the
+        # crossover so auto can learn it"): the kernel leg above vs a
+        # forced direct-xla leg on the SAME trace, per-token ms into
+        # the committed store. Only meaningful where the kernel
+        # actually resolved (a CPU backend never runs it).
+        eng = GenerationEngine(
+            net, V, slots=CONC, queue_limit=R,
+            paging=PagedKVConfig(page_size=PS,
+                                 total_pages=budget_pages,
+                                 decode_impl="xla"))
+        rec.update(run(eng, "paged_xla"))
+        from deeplearning4j_tpu.tuning import default_store
+        store = default_store()
+        store.record(eng._decode_key,
+                     1e3 / rec["paged_tokens_per_sec"],
+                     1e3 / rec["paged_xla_tokens_per_sec"])
+        store.save()
+        rec["store_decode_recorded"] = eng._decode_key
+
     # speculative sub-leg: repetitive prompts so prompt-lookup drafts
     # actually land; acceptance rate from the engine's own histogram
     reg = MetricsRegistry()
@@ -1350,6 +1452,7 @@ ALL = {"resnet": bench_resnet, "lstm": bench_lstm, "lenet": bench_lenet,
        "window": bench_window_attention, "quant": bench_quant,
        "decode": bench_decode, "specdec": bench_specdec,
        "specbatch": bench_specbatch,
+       "train_plan": bench_train_plan,
        "serve_continuous": bench_serve_continuous,
        "serve_paged": bench_serve_paged,
        "serve_chaos": bench_serve_chaos,

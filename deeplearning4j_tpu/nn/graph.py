@@ -66,6 +66,16 @@ class ComputationGraph(LazyScore):
         self._vertex_input_types: Dict[str, List[InputType]] = {}
         self.fuse_bn_act_conv = False
         self._fusion_cache = None
+        # execution-plan refinements (tuning/plan.py): restrict the
+        # bottleneck plan to a chosen block subset and/or engage the
+        # fused space-to-depth stem (nn/layers/stem.py)
+        self._fusion_only = None
+        self._fuse_stem = False
+        # the matchers' VMEM gates consult conf.dtype, so both plan
+        # caches are dtype-stamped: flipping dtype after construction
+        # (the bench builds at f32 then sets bf16) recomputes them
+        self._fusion_dtype = None
+        self._candidates_cache = None
         # listener capability flags, hoisted to fit-loop setup (None =
         # not inside fit(): _fit_batch recomputes for direct callers)
         self._stash_features: Optional[bool] = None
@@ -85,20 +95,37 @@ class ComputationGraph(LazyScore):
     # nn/layers/fused.py — params/state stay keyed by the original vertex
     # names, so serialization/import/transfer are unaffected)
     # ------------------------------------------------------------------
-    def set_fusion(self, enabled=True):
+    def set_fusion(self, enabled=True, *, stem=False, only=None):
         """Select the fused execution plan: False (unfused — the
         measured-best default), True (bn→act→1×1-conv groups,
         nn/layers/fused.py), or "bottleneck" (whole identity-bottleneck
         chains through the Pallas kernel cascade,
         nn/layers/bottleneck.py). Changes how eligible chains execute,
         not what they compute (equivalence is test-pinned); jitted steps
-        are rebuilt."""
+        are rebuilt only when the resolved plan actually changes, so
+        re-resolving the same plan per fit() call never retraces.
+
+        ``only`` (bottleneck level) restricts fusion to the named block
+        output vertices — the per-shape "auto" resolution seam
+        (tuning/plan.py): the crossover store decides block by block and
+        passes the winners here. ``stem`` additionally engages the fused
+        space-to-depth stem (nn/layers/stem.py) on a matching
+        pad→7×7/2-conv→BN→relu→3×3/2-maxpool chain."""
         if enabled not in (False, True, "bottleneck"):
             raise ValueError(
                 f"unknown fusion level {enabled!r}: expected False, True "
                 "or 'bottleneck'")
-        if enabled != self.fuse_bn_act_conv:
+        if stem and enabled != "bottleneck":
+            raise ValueError(
+                "stem=True rides the 'bottleneck' fusion level (the "
+                "fused-kernel execution plan)")
+        only = None if only is None else frozenset(only)
+        sig = (enabled, bool(stem), only)
+        if sig != (self.fuse_bn_act_conv, self._fuse_stem,
+                   self._fusion_only):
             self.fuse_bn_act_conv = enabled
+            self._fuse_stem = bool(stem)
+            self._fusion_only = only
             self._jit_cache.clear()
             self._fusion_cache = None
         return self
@@ -117,11 +144,18 @@ class ComputationGraph(LazyScore):
         identity (the Pallas kernel's fast set)."""
         if not self.fuse_bn_act_conv:
             return {}, {}, {}
-        if self._fusion_cache is not None:
-            return self._fusion_cache
+        if self._fusion_cache is not None and \
+                self._fusion_dtype == self.conf.dtype:
+            return self._fusion_cache[:3]
+        self._fusion_dtype = self.conf.dtype
         if self.fuse_bn_act_conv == "bottleneck":
-            self._fusion_cache = ({}, *self._bottleneck_fusion())
-            return self._fusion_cache
+            skip, bplan = self._bottleneck_fusion(self._fusion_only)
+            splan = self._stem_fusion() if self._fuse_stem else {}
+            for out_name, group in splan.items():
+                for m in group["members"]:
+                    skip[m] = out_name
+            self._fusion_cache = ({}, skip, bplan, splan)
+            return self._fusion_cache[:3]
         from deeplearning4j_tpu.nn.conf.layers import (
             ActivationLayer, BatchNormalization, ConvolutionLayer)
         consumers, layer_of = self._fusion_graph_view()
@@ -164,8 +198,8 @@ class ComputationGraph(LazyScore):
             skip[bn_name] = nxt
             if act_vertex is not None:
                 skip[act_vertex] = nxt
-        self._fusion_cache = (plan, skip, {})
-        return self._fusion_cache
+        self._fusion_cache = (plan, skip, {}, {})
+        return self._fusion_cache[:3]
 
     def _fusion_graph_view(self):
         """Shared matcher scaffolding for the fusion plans: the
@@ -190,7 +224,14 @@ class ComputationGraph(LazyScore):
 
         return consumers, layer_of
 
-    def _bottleneck_fusion(self):
+    def _stem_plan(self):
+        """splan for the fused space-to-depth stem: output (pool) vertex
+        name → group. Populated only at level "bottleneck" with
+        stem=True (set_fusion)."""
+        self._fusion()          # populate the cache
+        return self._fusion_cache[3] if self._fusion_cache else {}
+
+    def _bottleneck_fusion(self, only=None):
         """(skip, bplan) for fuse level "bottleneck": bplan maps the
         final relu vertex of each IDENTITY bottleneck (conv1x1→bn→relu→
         conv3x3→bn→relu→conv1x1→bn→add(x)→relu, all stride 1, identity
@@ -198,7 +239,8 @@ class ComputationGraph(LazyScore):
         intermediate to that output vertex. Anything unmatched — entry
         blocks, other strides/layouts — runs unfused
         (nn/layers/bottleneck.py holds the kernels + eligibility
-        rationale)."""
+        rationale). ``only`` (a set of output-vertex names) keeps just
+        the named blocks — the per-shape "auto" plan resolution."""
         from deeplearning4j_tpu.nn.conf.graph_conf import ElementWiseVertex
         from deeplearning4j_tpu.nn.conf.layers import (
             ActivationLayer, BatchNormalization, ConvolutionLayer)
@@ -339,10 +381,17 @@ class ComputationGraph(LazyScore):
                     self.conf.dtype or "float32",
                     stride=stride[0], has_skip=bool(skip_group)):
                 continue
+            if only is not None and out_name not in only:
+                continue
             group = {"src": src, "conv_a": ca_name, "bn_a": bn_a,
                      "conv_b": cb_name, "bn_b": bn_b, "conv_c": cc_name,
                      "bn_c": bn_c_name, "add": add_name,
-                     "stride": stride[0], **skip_group}
+                     "stride": stride[0],
+                     # shape metadata for the crossover fingerprint
+                     # (tuning/plan.py) — unused by the apply path
+                     "h": it.height, "w": it.width, "cin": it.channels,
+                     "cmid": conv_a.n_out, "cout": conv_c.n_out,
+                     **skip_group}
             members = [ca_name, bn_a, cb_name, bn_b, cc_name, bn_c_name,
                        add_name] + list(skip_group.values())
             if act_a:
@@ -355,6 +404,129 @@ class ComputationGraph(LazyScore):
             for m in members:
                 skip[m] = out_name
         return skip, bplan
+
+    def _stem_fusion(self):
+        """splan for the fused space-to-depth stem (nn/layers/stem.py):
+        maps the maxpool vertex closing a
+        [ZeroPadding(3,3,3,3) →] 7×7/2 pad-3 conv → BN → relu →
+        3×3/2 pad-1 max-pool chain (NHWC, no bias, single consumers) to
+        its vertex group. At most one chain matches (the stem consumes
+        a network input resolution); everything else runs unfused."""
+        from deeplearning4j_tpu.nn.conf.layers import (
+            ActivationLayer, BatchNormalization, ConvolutionLayer,
+            SubsamplingLayer, ZeroPaddingLayer)
+        from deeplearning4j_tpu.nn.layers.stem import fused_stem_supported
+        consumers, layer_of = self._fusion_graph_view()
+
+        def sole_consumer(n):
+            c = consumers.get(n, [])
+            return c[0] if len(c) == 1 else None
+
+        def chain_next(n):
+            c = sole_consumer(n)
+            if c is None or self.conf.vertex_inputs.get(c, []) != [n]:
+                return None
+            return c
+
+        splan: Dict[str, Dict[str, Any]] = {}
+        for cv_name in self._topo:
+            conv = layer_of(cv_name, ConvolutionLayer)
+            if (conv is None or tuple(conv.kernel) != (7, 7)
+                    or tuple(conv.stride) != (2, 2)
+                    or tuple(conv.dilation) != (1, 1)
+                    or conv.has_bias
+                    or conv.activation not in (None, "identity")
+                    or conv.data_format != "NHWC"
+                    or conv.convolution_mode != "truncate"):
+                continue
+            srcs = self.conf.vertex_inputs.get(cv_name, [])
+            if len(srcs) != 1:
+                continue
+            members = [cv_name]
+            pad_name = pre_vertex = None
+            outputs = set(self.conf.network_outputs)
+            if tuple(conv.padding) == (0, 0):
+                # ZeroPadding(3,3,3,3) form (the zoo ResNet50 layout).
+                # Matched by hand rather than layer_of: the pad vertex
+                # legitimately carries the graph's input preprocessor
+                # (FeedForwardToCnn), which the fused group absorbs.
+                pad_name = srcs[0]
+                pv = self.conf.vertices.get(pad_name)
+                padl = pv.layer if (
+                    isinstance(pv, LayerVertex)
+                    and type(pv.layer) is ZeroPaddingLayer
+                    and pad_name not in outputs
+                    and not pv.layer.dropout) else None
+                if (padl is None or tuple(padl._pads()) != (3, 3, 3, 3)
+                        or padl.data_format != "NHWC"
+                        or chain_next(pad_name) != cv_name):
+                    continue
+                if pv.preprocessor is not None:
+                    pre_vertex = pad_name
+                pin = self.conf.vertex_inputs.get(pad_name, [])
+                if len(pin) != 1:
+                    continue
+                src = pin[0]
+                it = self._vertex_input_types[pad_name][0]
+                members.append(pad_name)
+            elif tuple(conv.padding) == (3, 3):
+                src = srcs[0]
+                it = self._vertex_input_types[cv_name][0]
+            else:
+                continue
+            if it.kind != "cnn":
+                continue
+            bn_name = chain_next(cv_name)
+            bn = bn_name and layer_of(bn_name, BatchNormalization)
+            if bn is None or \
+                    len(self.conf.vertex_inputs.get(bn_name, [])) != 1:
+                continue
+            members.append(bn_name)
+            nxt = chain_next(bn_name)
+            act = bn.activation or "identity"
+            if nxt is not None:
+                al = layer_of(nxt, ActivationLayer)
+                if al is not None and act == "identity":
+                    members.append(nxt)
+                    act = al.activation
+                    nxt = chain_next(nxt)
+            if act != "relu" or nxt is None:
+                continue
+            pool = layer_of(nxt, SubsamplingLayer)
+            if (pool is None or pool.pooling_type.lower() != "max"
+                    or tuple(pool.kernel) != (3, 3)
+                    or tuple(pool.stride) != (2, 2)
+                    or tuple(pool.padding) != (1, 1)
+                    or pool.convolution_mode != "truncate"
+                    or pool.data_format != "NHWC"):
+                continue
+            if not fused_stem_supported(
+                    (1, it.height, it.width, it.channels), conv.n_out,
+                    self.conf.dtype or "float32"):
+                continue
+            splan[nxt] = {"src": src, "conv": cv_name, "bn": bn_name,
+                          "pre_vertex": pre_vertex,
+                          "h": it.height, "w": it.width,
+                          "cin": it.channels, "cout": conv.n_out,
+                          "members": members}
+        return splan
+
+    def fusion_candidates(self):
+        """Everything the fused execution plans COULD engage on this
+        graph, independent of the currently selected plan: (bottleneck
+        block groups, stem groups), each with the shape metadata the
+        crossover fingerprints need (tuning/plan.py resolves
+        ``execution_plan="auto"`` per candidate from the store). Pure
+        read — no plan state is touched and no jitted step rebuilt;
+        memoised per conf.dtype (the graph is fixed after construction
+        but the VMEM gates are dtype-dependent), so per-fit plan
+        re-resolution never re-walks the matchers."""
+        cache = getattr(self, "_candidates_cache", None)
+        if cache is None or cache[0] != self.conf.dtype:
+            _, bplan = self._bottleneck_fusion(None)
+            self._candidates_cache = (self.conf.dtype, bplan,
+                                      self._stem_fusion())
+        return self._candidates_cache[1:]
 
     # ------------------------------------------------------------------
     def _infer_types(self) -> Dict[str, InputType]:
@@ -432,6 +604,7 @@ class ComputationGraph(LazyScore):
         # to f32 (f32_head)
         params, inputs = self._cast_compute(params, inputs)
         fused_plan, fused_skip, bneck_plan = self._fusion()
+        stem_plan = self._stem_plan()
         acts: Dict[str, Any] = dict(inputs)
         masks: Dict[str, Any] = dict(fmasks or {})
         if pad is not None:
@@ -461,6 +634,13 @@ class ComputationGraph(LazyScore):
             if name in bneck_plan:
                 self._apply_fused_bottleneck(
                     name, bneck_plan[name], params, state, new_state,
+                    acts, train=train)
+                masks[name] = v.output_mask(
+                    in_masks, self._vertex_input_types[name])
+                continue
+            if name in stem_plan:
+                self._apply_fused_stem(
+                    name, stem_plan[name], params, state, new_state,
                     acts, train=train)
                 masks[name] = v.output_mask(
                     in_masks, self._vertex_input_types[name])
@@ -592,6 +772,45 @@ class ComputationGraph(LazyScore):
             if ws is not None:
                 new_state[group["bn_skip"]] = {"mean": new_stats[6],
                                                "var": new_stats[7]}
+        new_state[out_name] = state.get(out_name, {})
+
+    def _apply_fused_stem(self, out_name, group, params, state,
+                          new_state, acts, *, train):
+        """Execute the fused space-to-depth stem group (see
+        nn/layers/stem.py): reads the raw network input activation,
+        writes the pooled output into acts[out_name] and the stem BN's
+        running stats into new_state; params/state stay keyed by the
+        original vertex names (serialization/import unaffected)."""
+        from deeplearning4j_tpu.nn.layers.bottleneck import BnParams
+        from deeplearning4j_tpu.nn.layers.stem import fused_stem
+        x = acts[group["src"]]
+        if group.get("pre_vertex"):
+            # the absorbed pad vertex's input preprocessor (e.g.
+            # FeedForwardToCnn under the NHWC internal layout) still
+            # runs — the kernel sees the same NHWC image the unfused
+            # chain would
+            x = self.conf.vertices[group["pre_vertex"]] \
+                .preprocessor.apply(x, None)
+        bn = self.conf.vertices[group["bn"]].layer
+        p = params.get(group["bn"], {})
+        s = state.get(group["bn"], {})
+        nf = s["mean"].shape[0]
+        gamma = p.get("gamma", jnp.full((nf,), bn.gamma, x.dtype))
+        beta = p.get("beta", jnp.full((nf,), bn.beta, x.dtype))
+        # same precision chain as the bottleneck plumbing: running stats
+        # round through x.dtype so both execution plans train identical
+        # persistent state under bf16
+        bnp = BnParams(
+            gamma=gamma.astype(x.dtype), beta=beta.astype(x.dtype),
+            running_mean=s["mean"].astype(x.dtype).astype(jnp.float32),
+            running_var=s["var"].astype(x.dtype).astype(jnp.float32))
+        out, (nm, nv) = fused_stem(
+            x, params[group["conv"]]["W"], bnp, train=train,
+            eps=bn.eps, decay=bn.decay,
+            interpret=jax.default_backend() != "tpu")
+        acts[out_name] = out
+        if train:
+            new_state[group["bn"]] = {"mean": nm, "var": nv}
         new_state[out_name] = state.get(out_name, {})
 
     def _as_mask_dict(self, masks, default_key=None) -> Optional[Dict[str, Any]]:
@@ -823,10 +1042,20 @@ class ComputationGraph(LazyScore):
 
     def fit(self, data, labels=None, epochs: int = 1, batch_size: int = 32,
             *, steps_per_dispatch: int = 1, prefetch: int = 0,
-            pad_tail: Optional[bool] = None):
+            pad_tail: Optional[bool] = None,
+            execution_plan: Optional[str] = None):
         """Train (ref: ComputationGraph.fit :837). Accepts a DataSetIterator
         (single-input/single-output), a DataSet, (features, labels), or dicts
         keyed by input/output names (MultiDataSet equivalent).
+
+        ``execution_plan`` ("auto" | "fused" | "xla") selects how the
+        eligible fused chains (bottleneck blocks, the space-to-depth
+        stem) execute — "auto" resolves per shape from the measured
+        kernel-crossover store with the XLA plan as the uncalibrated
+        default (tuning/plan.py). Resolution happens ONCE here;
+        re-resolving the same plan never rebuilds jitted steps, so the
+        zero-retrace contract holds. None leaves an explicitly
+        set_fusion'd plan untouched.
 
         `steps_per_dispatch` / `prefetch` / `pad_tail` are the fused
         multi-step dispatch and device-prefetch knobs — see
@@ -838,6 +1067,9 @@ class ComputationGraph(LazyScore):
         if not self._initialized:
             self.init()
         ensure_started()
+        if execution_plan is not None:
+            from deeplearning4j_tpu.tuning.plan import apply_execution_plan
+            apply_execution_plan(self, execution_plan)
         if labels is not None:
             it = ArrayDataSetIterator(data, labels, batch_size)
         elif isinstance(data, DataSet):

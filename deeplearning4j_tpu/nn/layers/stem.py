@@ -1,0 +1,544 @@
+"""Fused ResNet stem: space-to-depth 7×7/2 conv + BN + ReLU + 3×3/2
+maxpool as Pallas kernels with a recompute backward.
+
+Why (PERF.md round 5): with all 16 bottleneck blocks fused, the
+remaining per-step HBM items outside the residual blocks are the STEM —
+the BN stats/normalize and pool passes each re-traverse the 112×112×64
+activation (~205 MB/pass at batch 128). The raw 7×7 conv is also
+MXU-hostile: its im2col contraction is K = 7·7·3 = 147 taps of width 3.
+Space-to-depth fixes both at once:
+
+- the input reorders 224×224×3 → 112×112×12 (2×2 pixel phases become
+  channels), so the 7×7/2 conv becomes a 4×4/1 conv whose im2col
+  contraction is **K = 4·4·12 = 192** — one MXU-shaped matmul per
+  image instead of 49 skinny taps;
+- the conv kernel emits per-channel Σ/Σ² as its epilogue (batch stats
+  cost zero extra traffic, the bottleneck.py pattern);
+- BN-normalize + ReLU + the 3×3/2 maxpool run as ONE output-stage pass
+  (read y, write the pooled 56×56×64) — the normalized activation is
+  never materialized to HBM;
+- the backward mirrors the bottleneck recompute pattern: pool/ReLU
+  backward recomputes z from the saved raw conv output and emits the
+  BN-backward sums as its epilogue; the dW pass rebuilds the im2col
+  from the input; dx is the transposed 4×4 correlation in
+  space-to-depth coordinates, un-shuffled back to pixels.
+
+Per-step stem HBM traffic drops from ~6 full traversals of the 112²×64
+activation (XLA plan: conv write, stats read, normalize read+write,
+pool read fwd; plus the BN reductions and pool backward re-reads) to
+~3 (conv write + one fused output-stage read fwd; one recompute read +
+one dy round trip bwd).
+
+Expected ceiling is ~2% of step time (PERF.md round 5) and the round-3
+lesson — pallas_call boundaries can cost more than the saved traffic —
+applies with full force, so this plan is NEVER engaged statically: the
+graph runs it only when the kernel-crossover store
+(tuning/crossover.py) holds a calibrated entry saying it wins on this
+hardware. The exactness contract is the same as bottleneck.py's:
+``interpret=True`` runs the identical kernels on CPU, pinned against
+``reference_stem`` (the jnp composition with the unfused layer
+semantics).
+
+Maxpool tie note: the kernels send gradient to EVERY position equal to
+the window max; XLA's reduce_window VJP picks one. Ties are
+measure-zero for continuous activations and do not occur in the pinned
+tests' random data.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from deeplearning4j_tpu.nn.layers.bottleneck import (
+    _VMEM_BUDGET, BnParams, _affine, _bcast_spec, _finalize_stats,
+    _img_spec)
+
+__all__ = ["fused_stem", "fused_stem_supported", "reference_stem",
+           "stem_geometry", "stem_weight_s2d"]
+
+
+def stem_geometry(h: int, w: int) -> dict:
+    """Static geometry of the stem at input [*, h, w, *] (NHWC): the
+    7×7/2 conv pads 3 (the reference's ZeroPadding(3,3,3,3) +
+    pad-0 conv), the pool is 3×3/2 pad 1. Space-to-depth needs the
+    padded extent even, so the bottom/right zero pad extends to 5 (even
+    h) / 4 (odd h) — the extra rows are zeros the 7-tap kernel never
+    weights (taps 7 of the zero-extended 8×8 kernel are zero)."""
+    pad_b = 5 if h % 2 == 0 else 4
+    pad_r = 5 if w % 2 == 0 else 4
+    hp, wp = h + 3 + pad_b, w + 3 + pad_r
+    hs, ws = hp // 2, wp // 2
+    ho, wo = (h - 1) // 2 + 1, (w - 1) // 2 + 1        # conv out
+    po, pw = (ho - 1) // 2 + 1, (wo - 1) // 2 + 1      # pool out
+    return {"pad_b": pad_b, "pad_r": pad_r, "hp": hp, "wp": wp,
+            "hs": hs, "ws": ws, "ho": ho, "wo": wo, "po": po, "pw": pw}
+
+
+def stem_weight_s2d(w4: jax.Array) -> jax.Array:
+    """OIHW conv weight [K, C, 7, 7] → the space-to-depth contraction
+    matrix [64·C, K]. Row index (i·4+j)·4C + (pi·2+pj)·C + c pairs tap
+    (i, j) of the 4×4 s2d conv with pixel phase (pi, pj): original tap
+    (a, b) = (2i+pi, 2j+pj) of the zero-extended 8×8 kernel. XLA folds
+    this rearrangement into its one-time weight-prep copy."""
+    k, c = w4.shape[0], w4.shape[1]
+    w8 = jnp.pad(w4, ((0, 0), (0, 0), (0, 1), (0, 1)))   # [K,C,8,8]
+    w8 = w8.reshape(k, c, 4, 2, 4, 2)                    # [K,C,i,pi,j,pj]
+    return w8.transpose(2, 4, 3, 5, 1, 0).reshape(64 * c, k)
+
+
+def _stem_vmem(h: int, w: int, c: int, k: int, bpe: int) -> int:
+    """Max per-grid-step VMEM estimate over the five stem passes (one
+    full image per step; fp32 where the kernels accumulate)."""
+    g = stem_geometry(h, w)
+    hp, wp, hs, ws = g["hp"], g["wp"], g["hs"], g["ws"]
+    ho, wo, po, pw = g["ho"], g["wo"], g["po"], g["pw"]
+    kdim = 64 * c
+    x_b, pad_b = h * w * c * bpe, hp * wp * c * 4
+    y_b, dz_b = ho * wo * k * bpe, ho * wo * k * bpe
+    fwd_conv = (x_b + 2 * pad_b                    # x + padded f32 + s2d
+                + ho * wo * kdim * bpe             # im2col, model dtype
+                + ho * wo * k * (4 + bpe)          # fp32 acc + stored y
+                + kdim * k * bpe)
+    fwd_pool = (y_b + 2 * (ho + 2) * (wo + 2) * k * 4   # z + padded z
+                + po * pw * k * bpe)
+    bwd_pool = (y_b + po * pw * k * bpe                 # y + g
+                + (ho + 2) * (wo + 2) * k * (bpe + 4)   # zc pad + dz acc
+                + ho * wo * k * 4                       # z0 / relu mask
+                + dz_b)
+    bwd_dw = (x_b + 2 * pad_b + y_b + dz_b
+              + ho * wo * k * (4 + bpe)                 # dy f32 + stored
+              + kdim * k * (bpe + 4))                   # w + fp32 dW
+    bwd_dx = (ho * wo * k * bpe                         # dy in
+              + (hs + 3) * (ws + 3) * k * 4             # dy padded f32
+              + hs * ws * 4 * c * 4                     # dx in s2d, f32
+              + hp * wp * c * 4 + x_b                   # un-s2d + dx out
+              + kdim * k * bpe)
+    return max(fwd_conv, fwd_pool, bwd_pool, bwd_dw, bwd_dx)
+
+
+def fused_stem_supported(x_shape, n_out: int, dtype) -> bool:
+    """VMEM gate (the bottleneck pattern): every pass must hold one full
+    image + its working set. NHWC [N, H, W, C] input; H, W ≥ 7 (the
+    7-tap conv must see real pixels)."""
+    if len(x_shape) != 4:
+        return False
+    _, h, w, c = x_shape
+    if h < 7 or w < 7:
+        return False
+    if isinstance(dtype, str) and dtype in ("bf16", "bfloat16"):
+        dtype = jnp.bfloat16
+    bpe = jnp.dtype(dtype).itemsize
+    return _stem_vmem(int(h), int(w), int(c), int(n_out), bpe) \
+        <= _VMEM_BUDGET
+
+
+# ---------------------------------------------------------------------------
+# in-kernel space-to-depth helpers (shared by fwd conv and dW passes)
+# ---------------------------------------------------------------------------
+
+
+def _s2d_image(xf, g):
+    """[h, w, c] fp32 → padded s2d grid [hs, ws, 4c] (pixel phases as
+    channels, phase-major ordering matching stem_weight_s2d rows)."""
+    c = xf.shape[2]
+    p = jnp.pad(xf, ((3, g["pad_b"]), (3, g["pad_r"]), (0, 0)))
+    return p.reshape(g["hs"], 2, g["ws"], 2, c) \
+        .transpose(0, 2, 1, 3, 4).reshape(g["hs"], g["ws"], 4 * c)
+
+
+def _im2col(s, g):
+    """s2d grid [hs, ws, 4c] → im2col [ho·wo, 192-ish] with tap-major
+    column blocks: the whole 7×7/2 conv is ONE K = 64·C contraction."""
+    ho, wo = g["ho"], g["wo"]
+    cols = [s[i:i + ho, j:j + wo, :].reshape(ho * wo, s.shape[2])
+            for i in range(4) for j in range(4)]
+    return jnp.concatenate(cols, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# forward kernels
+# ---------------------------------------------------------------------------
+
+
+def _stem_conv_kernel(x_ref, w_ref, o_ref, s1_ref, s2_ref, *, g):
+    """One image: y = s2d-conv(x) as one [ho·wo, 64C]·[64C, K] matmul,
+    with the Σy / Σy² channel epilogue accumulated across the grid."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        s1_ref[...] = jnp.zeros_like(s1_ref)
+        s2_ref[...] = jnp.zeros_like(s2_ref)
+
+    _, h, w, c = x_ref.shape
+    k = w_ref.shape[1]
+    xf = x_ref[...].reshape(h, w, c).astype(jnp.float32)
+    ic = _im2col(_s2d_image(xf, g), g).astype(w_ref.dtype)
+    out = lax.dot_general(ic, w_ref[...], (((1,), (0,)), ((), ())),
+                          preferred_element_type=jnp.float32)
+    o_ref[...] = out.astype(o_ref.dtype).reshape(1, g["ho"], g["wo"], k)
+    # stats of the STORED (dtype-rounded) output — the consumer
+    # normalizes the rounded tensor (bottleneck.py contract)
+    of = o_ref[...].reshape(g["ho"] * g["wo"], k).astype(jnp.float32)
+    s1_ref[...] += jnp.sum(of, axis=0, keepdims=True)
+    s2_ref[...] += jnp.sum(of * of, axis=0, keepdims=True)
+
+
+def _pool_windows(zp, po, pw):
+    """The nine strided 3×3/2 window views of a (+1-padded) image."""
+    return [zp[i:i + 2 * po - 1:2, j:j + 2 * pw - 1:2, :]
+            for i in range(3) for j in range(3)]
+
+
+def _stem_pool_kernel(y_ref, aff_ref, o_ref, *, g):
+    """One image of the fused output stage: normalize + ReLU + 3×3/2
+    maxpool in one read of y — z never reaches HBM. aff rows [2, K]
+    fp32: (sc, bb)."""
+    _, ho, wo, k = y_ref.shape
+    po, pw = g["po"], g["pw"]
+    yf = y_ref[...].reshape(ho, wo, k).astype(jnp.float32)
+    z = jnp.maximum(yf * aff_ref[0][None, None, :]
+                    + aff_ref[1][None, None, :], 0.0)
+    zp = jnp.pad(z, ((1, 1), (1, 1), (0, 0)),
+                 constant_values=-jnp.inf)
+    m = _pool_windows(zp, po, pw)
+    out = functools.reduce(jnp.maximum, m)
+    o_ref[...] = out.astype(o_ref.dtype).reshape(1, po, pw, k)
+
+
+# ---------------------------------------------------------------------------
+# backward kernels — pool/relu (+sums), then dW/dy, then dx
+# ---------------------------------------------------------------------------
+
+
+def _stem_bwd_pool_kernel(y_ref, g_ref, aff_ref, dz_ref, sums_ref, *, g):
+    """One image: pool backward + ReLU mask, recomputing z from the raw
+    conv output, with the BN-backward sums (Σdz0, Σdz0·ŷ) as the
+    epilogue. aff rows [4, K] fp32: (sc, bb, inv, mu)."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        sums_ref[...] = jnp.zeros_like(sums_ref)
+
+    _, ho, wo, k = y_ref.shape
+    po, pw = g["po"], g["pw"]
+    yf = y_ref[...].reshape(ho, wo, k).astype(jnp.float32)
+    z0 = yf * aff_ref[0][None, None, :] + aff_ref[1][None, None, :]
+    # max-position recompute in the MODEL dtype: the stored pooled
+    # output (and the reference's pooling input) are rounded values, so
+    # the window-max comparisons must see the same rounding — max
+    # commutes with monotone rounding, so the selected positions match
+    # the forward pass (and under f32 this is exactly z)
+    zc = jnp.maximum(z0, 0.0).astype(y_ref.dtype)
+    zp = jnp.pad(zc, ((1, 1), (1, 1), (0, 0)),
+                 constant_values=-jnp.inf)
+    wins = _pool_windows(zp, po, pw)
+    m = functools.reduce(jnp.maximum, wins)
+    gf = g_ref[...].reshape(po, pw, k).astype(jnp.float32)
+    acc = jnp.zeros((ho + 2, wo + 2, k), jnp.float32)
+    for t, win in enumerate(wins):
+        i_, j_ = divmod(t, 3)
+        v = jnp.where(win == m, gf, 0.0)            # [po, pw, k]
+        # interleave to stride-2 positions (2r, 2c), then shift by the
+        # window offset — pad+reshape, no scatter (bottleneck pattern)
+        v2 = jnp.pad(v.reshape(po, 1, pw, 1, k),
+                     ((0, 0), (0, 1), (0, 0), (0, 1), (0, 0)))
+        v2 = v2.reshape(2 * po, 2 * pw, k)[:2 * po - 1, :2 * pw - 1, :]
+        acc += jnp.pad(v2, ((i_, ho + 2 - (2 * po - 1) - i_),
+                            (j_, wo + 2 - (2 * pw - 1) - j_), (0, 0)))
+    dz = acc[1:1 + ho, 1:1 + wo, :]
+    dz0 = jnp.where(z0 > 0, dz, 0.0)
+    dz_ref[...] = dz0.astype(dz_ref.dtype).reshape(1, ho, wo, k)
+    # sums over the STORED (rounded) dz0: the dW/dx passes consume the
+    # rounded tensor, so m1/m2 must describe the same values
+    dzs = dz_ref[...].reshape(ho * wo, k).astype(jnp.float32)
+    yhat = (yf.reshape(ho * wo, k) - aff_ref[3][None, :]) \
+        * aff_ref[2][None, :]
+    sums_ref[0:1, :] += jnp.sum(dzs, axis=0, keepdims=True)
+    sums_ref[1:2, :] += jnp.sum(dzs * yhat, axis=0, keepdims=True)
+
+
+def _stem_bwd_dw_kernel(x_ref, y_ref, dz_ref, aff_ref, dy_ref, dw_ref,
+                        *, g):
+    """One image: BN backward dy = sc·(dz0 − m1 − ŷ·m2), then the
+    per-tap dW epilogue (s2d window ⊗ dy), dW accumulated across the
+    grid. aff rows [6, K] fp32: (sc, bb, inv, mu, m1, m2). dy is stored
+    (model dtype) for the dx pass — the one extra round trip the
+    two-pass backward costs."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        dw_ref[...] = jnp.zeros_like(dw_ref)
+
+    _, h, w, c = x_ref.shape
+    k = y_ref.shape[3]
+    ho, wo = g["ho"], g["wo"]
+    yf = y_ref[...].reshape(ho * wo, k).astype(jnp.float32)
+    dzf = dz_ref[...].reshape(ho * wo, k).astype(jnp.float32)
+    sc = aff_ref[0][None, :]
+    inv = aff_ref[2][None, :]
+    mu = aff_ref[3][None, :]
+    m1 = aff_ref[4][None, :]
+    m2 = aff_ref[5][None, :]
+    dy = sc * (dzf - m1 - (yf - mu) * inv * m2)
+    dy_ref[...] = dy.astype(dy_ref.dtype).reshape(1, ho, wo, k)
+    xf = x_ref[...].reshape(h, w, c).astype(jnp.float32)
+    s = _s2d_image(xf, g)
+    c4 = s.shape[2]
+    dyt = dy_ref[...].reshape(ho * wo, k)   # rounded, as the dx pass sees
+    for t in range(16):
+        i_, j_ = divmod(t, 4)
+        win = s[i_:i_ + ho, j_:j_ + wo, :].reshape(ho * wo, c4)
+        dw_ref[t * c4:(t + 1) * c4, :] += lax.dot_general(
+            win.astype(y_ref.dtype), dyt,
+            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+
+def _stem_bwd_dx_kernel(dy_ref, w_ref, dx_ref, *, g):
+    """One image: dx as the transposed 4×4 correlation in s2d
+    coordinates (dS[u,v] = Σ_taps dy[u−i, v−j]·W_tapᵀ), un-shuffled back
+    to pixel space and cropped to the unpadded input."""
+    _, ho, wo, k = dy_ref.shape
+    hs, ws = g["hs"], g["ws"]
+    c4 = w_ref.shape[0] // 16
+    c = c4 // 4
+    dyp = jnp.pad(dy_ref[...].reshape(ho, wo, k).astype(jnp.float32),
+                  ((3, hs - ho), (3, ws - wo), (0, 0)))
+    acc = jnp.zeros((hs * ws, c4), jnp.float32)
+    for t in range(16):
+        i_, j_ = divmod(t, 4)
+        gs = dyp[3 - i_:3 - i_ + hs, 3 - j_:3 - j_ + ws, :] \
+            .reshape(hs * ws, k)
+        acc += lax.dot_general(
+            gs.astype(w_ref.dtype), w_ref[t * c4:(t + 1) * c4, :],
+            (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    # reverse the s2d shuffle: [hs, ws, pi, pj, c] → [2hs, 2ws, c]
+    p = acc.reshape(hs, ws, 2, 2, c).transpose(0, 2, 1, 3, 4) \
+        .reshape(2 * hs, 2 * ws, c)
+    h, w = dx_ref.shape[1], dx_ref.shape[2]
+    dx_ref[...] = p[3:3 + h, 3:3 + w, :].astype(dx_ref.dtype) \
+        .reshape(1, h, w, c)
+
+
+# ---------------------------------------------------------------------------
+# pallas_call dispatchers
+# ---------------------------------------------------------------------------
+
+
+def _conv_stats(x, w, g, interpret):
+    n, h, wd, c = x.shape
+    k = w.shape[1]
+    ho, wo = g["ho"], g["wo"]
+    out, s1, s2 = pl.pallas_call(
+        functools.partial(_stem_conv_kernel, g=g),
+        grid=(n,),
+        in_specs=[_img_spec(h, wd, c), _bcast_spec(w.shape[0], k)],
+        out_specs=[_img_spec(ho, wo, k), _bcast_spec(1, k),
+                   _bcast_spec(1, k)],
+        out_shape=[jax.ShapeDtypeStruct((n, ho, wo, k), x.dtype),
+                   jax.ShapeDtypeStruct((1, k), jnp.float32),
+                   jax.ShapeDtypeStruct((1, k), jnp.float32)],
+        interpret=interpret,
+    )(x, w)
+    return out, s1[0], s2[0]
+
+
+def _pool(y, sc, bb, g, interpret):
+    n, ho, wo, k = y.shape
+    aff = jnp.stack([sc, bb]).astype(jnp.float32)
+    return pl.pallas_call(
+        functools.partial(_stem_pool_kernel, g=g),
+        grid=(n,),
+        in_specs=[_img_spec(ho, wo, k), _bcast_spec(2, k)],
+        out_specs=_img_spec(g["po"], g["pw"], k),
+        out_shape=jax.ShapeDtypeStruct((n, g["po"], g["pw"], k),
+                                       y.dtype),
+        interpret=interpret,
+    )(y, aff)
+
+
+def _bwd_pool(y, gout, aff, g, interpret):
+    n, ho, wo, k = y.shape
+    dz, sums = pl.pallas_call(
+        functools.partial(_stem_bwd_pool_kernel, g=g),
+        grid=(n,),
+        in_specs=[_img_spec(ho, wo, k), _img_spec(g["po"], g["pw"], k),
+                  _bcast_spec(4, k)],
+        out_specs=[_img_spec(ho, wo, k), _bcast_spec(2, k)],
+        out_shape=[jax.ShapeDtypeStruct((n, ho, wo, k), y.dtype),
+                   jax.ShapeDtypeStruct((2, k), jnp.float32)],
+        interpret=interpret,
+    )(y, gout, aff)
+    return dz, sums
+
+
+def _bwd_dw(x, y, dz, aff, w_shape, g, interpret):
+    n, h, wd, c = x.shape
+    k = y.shape[3]
+    ho, wo = g["ho"], g["wo"]
+    dy, dw = pl.pallas_call(
+        functools.partial(_stem_bwd_dw_kernel, g=g),
+        grid=(n,),
+        in_specs=[_img_spec(h, wd, c), _img_spec(ho, wo, k),
+                  _img_spec(ho, wo, k), _bcast_spec(6, k)],
+        out_specs=[_img_spec(ho, wo, k), _bcast_spec(*w_shape)],
+        out_shape=[jax.ShapeDtypeStruct((n, ho, wo, k), x.dtype),
+                   jax.ShapeDtypeStruct(w_shape, jnp.float32)],
+        interpret=interpret,
+    )(x, y, dz, aff)
+    return dy, dw
+
+
+def _bwd_dx(dy, w, x_shape, g, interpret):
+    n, h, wd, c = x_shape
+    ho, wo = g["ho"], g["wo"]
+    k = dy.shape[3]
+    return pl.pallas_call(
+        functools.partial(_stem_bwd_dx_kernel, g=g),
+        grid=(n,),
+        in_specs=[_img_spec(ho, wo, k), _bcast_spec(*w.shape)],
+        out_specs=_img_spec(h, wd, c),
+        out_shape=jax.ShapeDtypeStruct((n, h, wd, c), dy.dtype),
+        interpret=interpret,
+    )(dy, w)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp orchestration
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _stem_core(cfg, x, w, gamma, beta):
+    """cfg = (eps, interpret). Returns (out, batch_stats2). Stat
+    cotangents are ignored (running averages only — the bottleneck.py
+    contract)."""
+    out, res = _stem_fwd_impl(cfg, x, w, gamma, beta)
+    return out, res[2]
+
+
+def _stem_fwd_impl(cfg, x, w, gamma, beta):
+    eps, interpret = cfg
+    n, h, wd, _ = x.shape
+    g = stem_geometry(h, wd)
+    y, s1, s2 = _conv_stats(x, w, g, interpret)
+    mu, var = _finalize_stats(s1, s2, n * g["ho"] * g["wo"])
+    sc, bb, _inv = _affine(gamma, beta, mu, var, eps)
+    out = _pool(y, sc, bb, g, interpret)
+    return out, (x, y, (mu, var))
+
+
+def _stem_vjp_fwd(cfg, x, w, gamma, beta):
+    out, res = _stem_fwd_impl(cfg, x, w, gamma, beta)
+    return (out, res[2]), res + ((w, gamma, beta),)
+
+
+def _stem_vjp_bwd(cfg, res, cts):
+    eps, interpret = cfg
+    gout, _stat_cts = cts
+    x, y, (mu, var), (w, gamma, beta) = res
+    n, h, wd, _ = x.shape
+    g = stem_geometry(h, wd)
+    count = n * g["ho"] * g["wo"]
+    sc, bb, inv = _affine(gamma, beta, mu, var, eps)
+    k = y.shape[3]
+    aff_p = jnp.stack([sc, bb, inv, mu]).astype(jnp.float32)
+    dz0, sums = _bwd_pool(y, gout.astype(y.dtype), aff_p, g, interpret)
+    m1, m2 = sums[0] / count, sums[1] / count
+    dgamma, dbeta = sums[1], sums[0]
+    aff_k = jnp.stack([sc, bb, inv, mu, m1, m2]).astype(jnp.float32)
+    dy, dw = _bwd_dw(x, y, dz0, aff_k, tuple(w.shape), g, interpret)
+    dx = _bwd_dx(dy, w, x.shape, g, interpret)
+    return (dx, dw.astype(w.dtype), dgamma.astype(gamma.dtype),
+            dbeta.astype(beta.dtype))
+
+
+_stem_core.defvjp(_stem_vjp_fwd, _stem_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# public entry + reference oracle
+# ---------------------------------------------------------------------------
+
+
+def fused_stem(
+    x: jax.Array,
+    w: jax.Array, bn: BnParams,
+    *,
+    train: bool,
+    eps: float = 1e-5,
+    decay: float = 0.9,
+    interpret: bool = False,
+) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
+    """The fused ResNet stem. x [N,H,W,C] NHWC raw input; w is the
+    OIHW conv weight [K,C,7,7] (rearranged internally — the param
+    pytree keeps the serialization layout, like the bottleneck
+    plumbing). Semantics: zero-pad 3 → 7×7/2 conv (no bias) → BN →
+    ReLU → 3×3/2 pad-1 maxpool.
+
+    Returns (out [N,H//4,W//4-ish,K], new running (mean, var)) with the
+    same decay rounding as layers.BatchNormalization (bottleneck.py
+    ``_decayed`` contract). Inference uses running stats."""
+    ws = stem_weight_s2d(w)
+
+    def _decayed(old, new):
+        return (decay * old.astype(x.dtype) + (1.0 - decay) * new) \
+            .astype(jnp.float32)
+
+    if train:
+        out, (mu, var) = _stem_core((eps, interpret), x, ws,
+                                    bn.gamma, bn.beta)
+        return out, (_decayed(bn.running_mean, mu),
+                     _decayed(bn.running_var, var))
+    g = stem_geometry(x.shape[1], x.shape[2])
+    sc, bb, _ = _affine(bn.gamma.astype(jnp.float32),
+                        bn.beta.astype(jnp.float32),
+                        bn.running_mean, bn.running_var, eps)
+    y, _, _ = _conv_stats(x, ws, g, interpret)
+    out = _pool(y, sc, bb, g, interpret)
+    return out, (bn.running_mean, bn.running_var)
+
+
+def reference_stem(x, w, bn: BnParams, *, train, eps=1e-5, decay=0.9):
+    """Unfused jnp composition with IDENTICAL semantics — the
+    equivalence oracle (autodiff supplies its backward): pad-3 7×7/2
+    conv, one-pass BN, ReLU, 3×3/2 pad-1 maxpool — exactly the layer
+    chain the ResNet50 zoo graph builds."""
+    xp = jnp.pad(x, ((0, 0), (3, 3), (3, 3), (0, 0)))
+    # f32 inputs rather than preferred_element_type: identical math
+    # (bf16-valued products are exact in f32, accumulation f32 either
+    # way — the reference_bottleneck precision pattern), and the conv
+    # transpose rule keeps matching dtypes under AD
+    y = lax.conv_general_dilated(
+        xp.astype(jnp.float32),
+        w.transpose(2, 3, 1, 0).astype(jnp.float32), (2, 2), "VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC")).astype(x.dtype)
+    yf = y.astype(jnp.float32)
+    if train:
+        mean = jnp.mean(yf, axis=(0, 1, 2))
+        var = jnp.maximum(
+            jnp.mean(yf * yf, axis=(0, 1, 2)) - mean * mean, 0.0)
+    else:
+        mean, var = bn.running_mean, bn.running_var
+    inv = lax.rsqrt(var + eps)
+    z = (yf - mean) * inv * bn.gamma.astype(jnp.float32) \
+        + bn.beta.astype(jnp.float32)
+    z = jnp.maximum(z, 0.0).astype(x.dtype)
+    out = lax.reduce_window(
+        z, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+        [(0, 0), (1, 1), (1, 1), (0, 0)])
+    new_mean = decay * bn.running_mean.astype(x.dtype) \
+        .astype(jnp.float32) + (1 - decay) * mean
+    new_var = decay * bn.running_var.astype(x.dtype) \
+        .astype(jnp.float32) + (1 - decay) * var
+    if not train:
+        new_mean, new_var = bn.running_mean, bn.running_var
+    return out, (new_mean, new_var)

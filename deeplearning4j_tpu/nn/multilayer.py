@@ -503,10 +503,17 @@ class MultiLayerNetwork(LazyScore):
 
     def fit(self, data, labels=None, epochs: int = 1, batch_size: int = 32,
             *, steps_per_dispatch: int = 1, prefetch: int = 0,
-            pad_tail: Optional[bool] = None):
+            pad_tail: Optional[bool] = None,
+            execution_plan: Optional[str] = None):
         """Train (ref: MultiLayerNetwork.fit(DataSetIterator) :1156).
 
         Accepts a DataSetIterator, a DataSet, or (features, labels) arrays.
+
+        ``execution_plan`` ("auto" | "fused" | "xla", tuning/plan.py)
+        selects how eligible chains execute — resolved ONCE here, never
+        inside a step builder. Sequential nets have no fused graph
+        chains, so every plan runs the XLA step; the kwarg validates
+        and keeps the fit-loop API uniform across the step builders.
 
         Dispatch-overhead knobs (pipeline/ — see ARCHITECTURE.md "Input
         pipeline & fused dispatch"):
@@ -527,6 +534,9 @@ class MultiLayerNetwork(LazyScore):
         if not self._initialized:
             self.init()
         ensure_started()
+        if execution_plan is not None:
+            from deeplearning4j_tpu.tuning.plan import apply_execution_plan
+            apply_execution_plan(self, execution_plan)
         if labels is not None:
             it: DataSetIterator = ArrayDataSetIterator(data, labels, batch_size)
         elif isinstance(data, DataSet):

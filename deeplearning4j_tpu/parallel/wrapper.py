@@ -414,18 +414,36 @@ class ParallelWrapper:
                                    time.perf_counter() - t0, n_batches=freq)
 
     # ------------------------------------------------------------------
-    def fit(self, data, labels=None, epochs: int = 1, batch_size: int = 32):
+    def fit(self, data, labels=None, epochs: int = 1, batch_size: int = 32,
+            *, execution_plan=None):
         """Train across the mesh (ref: ParallelWrapper.fit :468). The
         iterator is wrapped in async prefetch like the reference's
         ADSI-per-device feeding — host-side by default, or the
         device-side pipeline stage when ``device_prefetch=True``
         (batches land pre-trimmed and pre-sharded on the mesh). With
         ``steps_per_dispatch=K``, allreduce mode fuses runs of K
-        same-shape batches into single scan dispatches."""
+        same-shape batches into single scan dispatches.
+
+        ``execution_plan`` ("auto" | "fused" | "xla") resolves the fused
+        training-kernel plan onto the wrapped model ONCE per fit, same
+        seam as the single-device fit loops (tuning/plan.py)."""
         from deeplearning4j_tpu.monitoring import ensure_started
         from deeplearning4j_tpu.pipeline.padding import group_signature
         ensure_started()
         m = self.model
+        if execution_plan is not None:
+            from deeplearning4j_tpu.tuning.plan import apply_execution_plan
+            sig0 = (getattr(m, "fuse_bn_act_conv", None),
+                    getattr(m, "_fuse_stem", None),
+                    getattr(m, "_fusion_only", None))
+            apply_execution_plan(m, execution_plan)
+            if sig0 != (getattr(m, "fuse_bn_act_conv", None),
+                        getattr(m, "_fuse_stem", None),
+                        getattr(m, "_fusion_only", None)):
+                # averaging mode traces m._loss into the WRAPPER's
+                # cache — a changed plan must rebuild it (allreduce
+                # mode uses the model's cache, which set_fusion clears)
+                self._jit_cache.clear()
         if labels is not None:
             it = ArrayDataSetIterator(data, labels, batch_size)
         elif isinstance(data, DataSet):

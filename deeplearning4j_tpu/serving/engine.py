@@ -263,6 +263,7 @@ class GenerationEngine:
         #: ARCHITECTURE.md "Paged decode fast path"
         self._direct = False
         self._decode_impl: Optional[str] = None
+        self._decode_key: Optional[str] = None
         #: cached [S, n_max] page table — np + device copies, rebuilt
         #: only after a table MUTATION (admit/retire/rebuild), not per
         #: step (the host used to rebuild and re-upload it every step
@@ -311,15 +312,33 @@ class GenerationEngine:
             self._pool = PagePool(usable + 1, self._ps)  # +1: null page
             self._direct = bool(paging.direct)
             if self._direct:
+                from deeplearning4j_tpu.tuning.plan import (
+                    decode_key_for_engine, resolve_decode_impl)
+                l0 = kv_layers[0]
+                #: the crossover fingerprint of this engine's decode
+                #: shape — what "auto" consults and what a calibrating
+                #: bench records (tuning/crossover.py)
+                self._decode_key = decode_key_for_engine(
+                    self._ps, l0.n_out // l0.n_heads,
+                    getattr(l0, "n_kv_heads", None) or l0.n_heads,
+                    self._L,
+                    getattr(net.conf, "dtype", None) or "float32")
                 impl = paging.decode_impl
                 if impl == "auto":
-                    # the kernel path needs TPU-tileable shapes; the
-                    # XLA fallback serves everything else (and CPU)
+                    # ELIGIBILITY is the static gate (unchanged): the
+                    # kernel path needs TPU-tileable shapes and a TPU
+                    # backend; the XLA fallback serves everything else.
+                    # The CHOICE among eligible impls comes from the
+                    # measured kernel-crossover store when a calibrated
+                    # entry for this (page_size, head_dim, L) exists —
+                    # PERF.md: "record the crossover so auto can learn
+                    # it". No entry → the kernel (the PR 10 default).
                     ok = all(paged_attention_supported(
                         (0, 0, self._ps, l.n_out // l.n_heads), 1)
                         for l in kv_layers)
-                    impl = ("pallas" if jax.default_backend() == "tpu"
-                            and ok else "xla")
+                    eligible = jax.default_backend() == "tpu" and ok
+                    impl = resolve_decode_impl(eligible,
+                                               self._decode_key)
                 # process-wide like stream-cache sharding: part of the
                 # streaming jit key, so engines with different impls
                 # retrace rather than silently sharing a trace

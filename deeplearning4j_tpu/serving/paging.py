@@ -77,8 +77,11 @@ class PagedKVConfig:
     ``"xla"`` (any backend — the gather folds into the dispatch),
     ``"pallas"`` (the serving/paged_kernel.py TPU paged-attention
     kernel; ``kernel_interpret=True`` emulates it on CPU for exactness
-    tests), or ``"auto"`` (pallas on TPU when the shapes pass the
-    kernel gate, xla otherwise)."""
+    tests), or ``"auto"`` (eligibility: pallas needs TPU + shapes that
+    pass the kernel gate, xla otherwise; among eligible impls the
+    measured kernel-crossover store makes the choice when a calibrated
+    entry exists for this shape — tuning/crossover.py — with the
+    kernel as the uncalibrated default)."""
 
     page_size: int = 8
     total_pages: Optional[int] = None

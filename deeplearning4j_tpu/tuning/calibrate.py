@@ -1,0 +1,146 @@
+"""The explicit calibration harness: fill the crossover store from a
+live window.
+
+``calibrate_training_kernels(net)`` walks the net's fusion candidates
+(every distinct bottleneck-block shape + the stem), builds
+representative tensors at each shape, and times the fused kernel chain
+against its exact-semantics XLA fallback — fwd+bwd through jit, synced
+— recording each paired measurement into the store. One call on a live
+TPU window writes the entries every later ``execution_plan="auto"``
+(and ``decode_impl="auto"``) resolution reads; PERF.md lists the exact
+commands for the next window.
+
+On a non-TPU backend the kernels run in interpret mode — the timings
+are meaningless as TPU predictions, which is exactly why store entries
+carry platform + device kind and a CPU-calibrated entry never decides
+a TPU run. Calibrating on CPU is still useful in tests (it exercises
+the full record/resolve loop) and harmless in production (the entries
+only ever match an identical platform).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from deeplearning4j_tpu.tuning.crossover import (
+    KernelCrossoverStore, default_store)
+from deeplearning4j_tpu.tuning.plan import (
+    _block_key, _net_dtype, _stem_key)
+
+log = logging.getLogger(__name__)
+
+
+def _jdtype(dtype: str):
+    import jax.numpy as jnp
+    return jnp.bfloat16 if dtype in ("bfloat16", "bf16") else jnp.float32
+
+
+def calibrate_training_kernels(
+        net, *, batch_size: int = 8,
+        store: Optional[KernelCrossoverStore] = None,
+        warmup: int = 1, iters: int = 3, persist: bool = False,
+        include_stem: bool = True) -> dict:
+    """Measure kernel-vs-fallback for every distinct fusable shape on
+    ``net`` and record the results. Returns {key: entry}."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.nn.layers.bottleneck import (
+        BnParams, fused_bottleneck, reference_bottleneck)
+    from deeplearning4j_tpu.nn.layers.stem import (
+        fused_stem, reference_stem)
+
+    store = default_store() if store is None else store
+    dtype = _net_dtype(net)
+    jdt = _jdtype(dtype)
+    interpret = jax.default_backend() != "tpu"
+    if not hasattr(net, "fusion_candidates"):
+        return {}
+    bcands, scands = net.fusion_candidates()
+    rng = np.random.default_rng(0)
+
+    def arr(*shape, scale=1.0):
+        return jnp.asarray(
+            rng.standard_normal(shape).astype(np.float32) * scale, jdt)
+
+    def bn_of(c):
+        return BnParams(gamma=jnp.ones((c,), jdt),
+                        beta=jnp.zeros((c,), jdt),
+                        running_mean=jnp.zeros((c,), jnp.float32),
+                        running_var=jnp.ones((c,), jnp.float32))
+
+    results = {}
+    seen = set()
+    for grp in bcands.values():
+        key = _block_key(grp, dtype)
+        if key in seen:
+            continue
+        seen.add(key)
+        h, w, cin = grp["h"], grp["w"], grp["cin"]
+        cmid, cout = grp["cmid"], grp["cout"]
+        stride = grp.get("stride", 1)
+        has_skip = "conv_skip" in grp
+        x = arr(batch_size, h, w, cin)
+        wa = arr(cin, cmid, scale=0.1)
+        wb = arr(9, cmid, cmid, scale=0.05)
+        wc = arr(cmid, cout, scale=0.1)
+        ws = arr(cin, cout, scale=0.1) if has_skip else None
+        bns = (bn_of(cmid), bn_of(cmid), bn_of(cout))
+        bn_s = bn_of(cout) if has_skip else None
+
+        def loss(fn, kw):
+            def f(args):
+                out, _ = fn(args[0], args[1], bns[0], args[2], bns[1],
+                            args[3], bns[2], w_skip=args[4],
+                            bn_skip=bn_s, stride=stride, train=True,
+                            **kw)
+                return jnp.sum(out.astype(jnp.float32))
+            return jax.jit(jax.grad(f))
+
+        gk = loss(fused_bottleneck, {"interpret": interpret})
+        gf = loss(reference_bottleneck, {})
+        args = (x, wa, wb, wc, ws)
+        results[key] = store.calibrate(
+            key, lambda: gk(args), lambda: gf(args),
+            warmup=warmup, iters=iters)
+        log.info("calibrated %s: kernel %.3fms vs fallback %.3fms",
+                 key, results[key]["kernel_ms"],
+                 results[key]["fallback_ms"])
+    if include_stem:
+        for grp in scands.values():
+            key = _stem_key(grp, dtype)
+            if key in seen:
+                continue
+            seen.add(key)
+            x = arr(batch_size, grp["h"], grp["w"], grp["cin"])
+            w7 = arr(grp["cout"], grp["cin"], 7, 7, scale=0.1)
+            bnp = bn_of(grp["cout"])
+
+            def sloss(fn, kw):
+                def f(args):
+                    out, _ = fn(args[0], args[1], bnp, train=True, **kw)
+                    return jnp.sum(out.astype(jnp.float32))
+                return jax.jit(jax.grad(f))
+
+            gk = sloss(fused_stem, {"interpret": interpret})
+            gf = sloss(reference_stem, {})
+            args = (x, w7)
+            results[key] = store.calibrate(
+                key, lambda: gk(args), lambda: gf(args),
+                warmup=warmup, iters=iters)
+            log.info("calibrated %s: kernel %.3fms vs fallback %.3fms",
+                     key, results[key]["kernel_ms"],
+                     results[key]["fallback_ms"])
+    if persist and results:
+        try:
+            store.save()
+        except OSError as e:
+            # a read-only install dir must not discard a completed
+            # calibration run — the measurements are in the returned
+            # (and in-memory) store either way
+            log.warning("kernel-crossover store not persisted to %s "
+                        "(%s); pass a writable path via "
+                        "KernelCrossoverStore(path=...)", store.path, e)
+    return results

@@ -45,7 +45,11 @@ class ZooModel:
         """Build + initialize the network (ref: ZooModel.init()).
 
         Pass `data_format="NHWC"` to the model constructor to run the CNN
-        stack in the TPU-fast internal layout (public API stays NCHW)."""
+        stack in the TPU-fast internal layout (public API stays NCHW).
+        Pass `execution_plan="auto"|"fused"|"xla"` to resolve the fused
+        training-kernel plan at build time (tuning/plan.py — the same
+        seam `net.fit(..., execution_plan=...)` resolves per fit), so a
+        zoo model and a bench model run the SAME code path."""
         conf = self.conf()
         fmt = self.kwargs.get("data_format")
         if fmt:
@@ -59,16 +63,25 @@ class ZooModel:
                     f"{type(self).__name__}: fuse=True needs a "
                     "ComputationGraph model (the bn→act→conv fusion plan "
                     "is a graph execution feature)")
-            return MultiLayerNetwork(conf).init()
+            return self._maybe_fuse(MultiLayerNetwork(conf).init())
         from deeplearning4j_tpu.nn.graph import ComputationGraph
         return self._maybe_fuse(ComputationGraph(conf).init())
 
     def _maybe_fuse(self, net):
-        """Apply the model's fuse kwarg to a freshly built/restored net
-        (graphs only — restore paths must honor it too). fuse=True
+        """Apply the model's fuse/execution_plan kwargs to a freshly
+        built/restored net (restore paths must honor them too). fuse=True
         selects the bn→act→conv plan, fuse="bottleneck" the full
-        fused-bottleneck plan (nn/layers/bottleneck.py)."""
+        fused-bottleneck plan (nn/layers/bottleneck.py) — the legacy
+        direct switches. execution_plan goes through the plan-resolution
+        seam (tuning/plan.py) instead: "fused" engages every eligible
+        chain, "auto" resolves per shape from the measured crossover
+        store, "xla" pins the unfused graph."""
         level = self.kwargs.get("fuse", False)
+        plan = self.kwargs.get("execution_plan")
+        if level and plan:
+            raise ValueError(
+                f"{type(self).__name__}: fuse= and execution_plan= are "
+                "mutually exclusive (execution_plan supersedes fuse)")
         if level:
             if not hasattr(net, "set_fusion"):
                 raise ValueError(
@@ -76,6 +89,9 @@ class ZooModel:
                     "ComputationGraph model (restored checkpoint is a "
                     f"{type(net).__name__})")
             net.set_fusion(level)
+        elif plan:
+            from deeplearning4j_tpu.tuning.plan import apply_execution_plan
+            apply_execution_plan(net, plan)
         return net
 
     def init_pretrained(self, flavor: str = "imagenet",

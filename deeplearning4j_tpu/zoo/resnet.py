@@ -29,7 +29,11 @@ class ResNet50(ZooModel):
         # own fusion of the unfused graph beats the hand prologue/kernel,
         # whose pallas_call boundary blocks cross-op fusion (PERF.md r3).
         # Equivalence stays pinned by tests/test_fused.py; pass fuse=True
-        # to enable.
+        # to enable. The production switch is execution_plan=
+        # "auto"|"fused"|"xla" (tuning/plan.py): "fused" runs the full
+        # bottleneck kernel cascade (nn/layers/bottleneck.py) + the
+        # store-gated space-to-depth stem, "auto" resolves per shape
+        # from the measured kernel-crossover store.
         kw.setdefault("fuse", False)
         super().__init__(num_classes, seed, **kw)
         self.height, self.width, self.channels = height, width, channels

@@ -83,6 +83,15 @@ python -m pytest tests/test_serving_paged.py -q -p no:cacheprovider
 # recovery re-entering the direct path, zero retraces with the kernel on
 python -m pytest tests/test_serving_paged_kernel.py -q -p no:cacheprovider
 
+# tier-1 autotune/execution-plan lane: the kernel-crossover store +
+# plan resolution (tuning/) and the fused space-to-depth stem — store
+# lifecycle (roundtrip/ratchet/prune/platform guard), fused==xla fit
+# equivalence with the sentinel ON (per-batch + K-step scan), zero
+# retraces on plan re-resolution, decode-impl eligibility-vs-choice,
+# stem kernel exactness, and the bench parked-record invariant
+python -m pytest tests/test_autotune.py tests/test_stem_fused.py -q \
+    -p no:cacheprovider
+
 python -m pytest tests/ -q --junitxml=/tmp/dl4jtpu_junit.xml "$@"
 
 # only a FULL unfiltered run may overwrite the committed tally — a

@@ -319,6 +319,66 @@ class TestRecompileHazardRule:
         """)
         assert fs == []
 
+    def test_positive_env_read_in_jit_building_step_builder(
+            self, tmp_path):
+        """ISSUE 11: os.environ resolved inside a step-builder body —
+        the value bakes into the trace but sits in no jit key, so a
+        flip keeps the stale compiled step (the BENCH_FUSE class)."""
+        fs = _scan_snippet(tmp_path, """
+            import os
+            import jax
+
+            class Net:
+                def _get_train_step(self, carry):
+                    fused = os.environ.get("MY_FUSE") == "1"
+
+                    def step(p, x):
+                        return p * x if fused else p + x
+
+                    return jax.jit(step)
+        """)
+        assert _rules_of(fs) == ["recompile-hazard"]
+        assert "os.environ read inside step-builder" in fs[0].message
+
+    def test_positive_env_read_in_plan_resolution_name(self, tmp_path):
+        """Name-matched plan-resolution seams are flagged even when the
+        jit construction lives in a helper they call."""
+        fs = _scan_snippet(tmp_path, """
+            import os
+
+            def resolve_plan(net):
+                return os.getenv("MY_PLAN", "xla")
+        """)
+        assert _rules_of(fs) == ["recompile-hazard"]
+
+    def test_positive_env_subscript_in_step_builder(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import os
+            import jax
+
+            def _get_output_fn(net):
+                impl = os.environ["MY_IMPL"]
+                return jax.jit(lambda x: x)
+        """)
+        assert _rules_of(fs) == ["recompile-hazard"]
+
+    def test_negative_env_read_outside_builders(self, tmp_path):
+        """Env reads at module scope or in ordinary config functions are
+        someone else's business — only trace-building bodies retrace."""
+        fs = _scan_snippet(tmp_path, """
+            import os
+            import jax
+
+            DEFAULT_DIR = os.environ.get("MY_DATA_DIR", "/tmp")
+
+            def load_config():
+                return os.environ.get("MY_MODE", "prod")
+
+            def get_step(cache, fn):
+                return jax.jit(fn)
+        """)
+        assert fs == []
+
     def test_negative_cached_jit_outside_loop(self, tmp_path):
         fs = _scan_snippet(tmp_path, """
             import jax

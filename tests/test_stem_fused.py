@@ -1,0 +1,248 @@
+"""Fused space-to-depth stem (nn/layers/stem.py): kernel-vs-reference
+exactness (interpret mode — the CPU oracle contract every Pallas path
+in this repo carries), the BN-stat epilogue, the fused maxpool output
+stage, the VMEM gate, and the graph matcher + store-gated engagement.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from deeplearning4j_tpu.nn.layers.bottleneck import BnParams
+from deeplearning4j_tpu.nn.layers import stem as stem_mod
+from deeplearning4j_tpu.nn.layers.stem import (
+    fused_stem, fused_stem_supported, reference_stem, stem_geometry,
+    stem_weight_s2d)
+from deeplearning4j_tpu.tuning import KernelCrossoverStore
+from deeplearning4j_tpu.tuning.plan import _stem_key
+
+
+def mk(h=16, w=16, n=3, c=3, k=8, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((n, h, w, c)).astype(np.float32),
+                    dtype)
+    w7 = jnp.asarray(
+        rng.standard_normal((k, c, 7, 7)).astype(np.float32) * 0.1,
+        dtype)
+    bn = BnParams(
+        gamma=jnp.asarray(1 + 0.2 * rng.standard_normal(k)
+                          .astype(np.float32), dtype),
+        beta=jnp.asarray(0.1 * rng.standard_normal(k)
+                         .astype(np.float32), dtype),
+        running_mean=jnp.asarray(0.05 * rng.standard_normal(k),
+                                 jnp.float32),
+        running_var=jnp.asarray(1 + 0.1 * rng.random(k), jnp.float32))
+    return x, w7, bn
+
+
+class TestGeometry:
+    def test_resnet50_shape(self):
+        g = stem_geometry(224, 224)
+        assert (g["ho"], g["wo"]) == (112, 112)
+        assert (g["po"], g["pw"]) == (56, 56)
+        assert g["hs"] == 116          # 232/2: the s2d grid
+
+    def test_odd_sizes(self):
+        g = stem_geometry(17, 19)
+        assert g["ho"] == 9 and g["wo"] == 10
+        assert (g["hp"] % 2, g["wp"] % 2) == (0, 0)
+
+    def test_weight_transform_shape_and_zero_taps(self):
+        _, w7, _ = mk(k=8)
+        ws = stem_weight_s2d(w7)
+        assert ws.shape == (16 * 4 * 3, 8)   # K = 4·4 taps × 4 phases × C
+        # tap rows sourcing the zero-extended 8th kernel row/col are 0
+        w8 = np.zeros((8, 8))
+        w8[:7, :7] = 1
+        zero_rows = sum(1 for i in range(4) for j in range(4)
+                        for pi in range(2) for pj in range(2)
+                        if w8[2 * i + pi, 2 * j + pj] == 0)
+        got_zero = int(np.sum(np.all(np.asarray(ws) == 0, axis=1)))
+        assert got_zero == zero_rows * 3
+
+
+class TestKernelExactness:
+    @pytest.mark.parametrize("h,w", [(16, 16), (17, 19), (8, 8)])
+    @pytest.mark.parametrize("train", [True, False])
+    def test_forward_and_stats_vs_reference(self, h, w, train):
+        x, w7, bn = mk(h=h, w=w)
+        of, sf = fused_stem(x, w7, bn, train=train, interpret=True)
+        orf, srf = reference_stem(x, w7, bn, train=train)
+        np.testing.assert_allclose(np.asarray(of), np.asarray(orf),
+                                   atol=2e-5, rtol=2e-5)
+        for a, b in zip(sf, srf):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5, rtol=1e-5)
+
+    def test_bf16_forward_bit_exact(self):
+        x, w7, bn = mk(dtype=jnp.bfloat16)
+        of, _ = fused_stem(x, w7, bn, train=True, interpret=True)
+        orf, _ = reference_stem(x, w7, bn, train=True)
+        np.testing.assert_array_equal(
+            np.asarray(of, np.float32), np.asarray(orf, np.float32))
+
+    @pytest.mark.parametrize("h,w", [(16, 16), (17, 19)])
+    def test_gradients_vs_reference(self, h, w):
+        x, w7, bn = mk(h=h, w=w)
+        g = jnp.asarray(np.random.default_rng(1).standard_normal(
+            stem_geometry(h, w)["po"] * stem_geometry(h, w)["pw"] * 8 * 3
+        ).astype(np.float32).reshape(
+            3, stem_geometry(h, w)["po"], stem_geometry(h, w)["pw"], 8))
+
+        def loss(args, fn, kw):
+            out, _ = fn(args[0], args[1],
+                        BnParams(args[2], args[3], bn.running_mean,
+                                 bn.running_var), train=True, **kw)
+            return jnp.sum(out * g)
+
+        gf = jax.grad(loss)((x, w7, bn.gamma, bn.beta), fused_stem,
+                            {"interpret": True})
+        gr = jax.grad(loss)((x, w7, bn.gamma, bn.beta), reference_stem,
+                            {})
+        for a, b, nm in zip(gf, gr, ("x", "w", "gamma", "beta")):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=3e-4, rtol=3e-4,
+                err_msg=f"grad({nm}) h={h} w={w}")
+
+    def test_running_stat_decay_matches_bottleneck_contract(self):
+        x, w7, bn = mk()
+        _, (nm, nv) = fused_stem(x, w7, bn, train=True, interpret=True,
+                                 decay=0.7)
+        _, (rm, rv) = reference_stem(x, w7, bn, train=True, decay=0.7)
+        np.testing.assert_allclose(np.asarray(nm), np.asarray(rm),
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(nv), np.asarray(rv),
+                                   atol=1e-6)
+
+    def test_inference_leaves_running_stats(self):
+        x, w7, bn = mk()
+        _, (nm, nv) = fused_stem(x, w7, bn, train=False, interpret=True)
+        np.testing.assert_array_equal(np.asarray(nm),
+                                      np.asarray(bn.running_mean))
+        np.testing.assert_array_equal(np.asarray(nv),
+                                      np.asarray(bn.running_var))
+
+
+class TestMaxpoolFusion:
+    def test_pool_stage_matches_reduce_window(self):
+        """The fused output stage (normalize+relu+pool in one pass)
+        against lax.reduce_window on the identical normalized input."""
+        from jax import lax
+        rng = np.random.default_rng(2)
+        y = jnp.asarray(rng.standard_normal((2, 9, 11, 8))
+                        .astype(np.float32))
+        sc = jnp.asarray(1 + 0.1 * rng.standard_normal(8)
+                         .astype(np.float32))
+        bb = jnp.asarray(0.1 * rng.standard_normal(8)
+                         .astype(np.float32))
+        g = stem_geometry(17, 21)     # ho=9, wo=11
+        assert (g["ho"], g["wo"]) == (9, 11)
+        out = stem_mod._pool(y, sc, bb, g, True)
+        z = jnp.maximum(y * sc + bb, 0.0)
+        ref = lax.reduce_window(z, -jnp.inf, lax.max, (1, 3, 3, 1),
+                                (1, 2, 2, 1),
+                                [(0, 0), (1, 1), (1, 1), (0, 0)])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-6)
+
+
+class TestSupportGate:
+    def test_production_shape_bf16_passes(self):
+        assert fused_stem_supported((128, 224, 224, 3), 64, "bfloat16")
+
+    def test_f32_224_exceeds_vmem(self):
+        # the fp32 im2col alone blows the budget — bf16 is the
+        # production path; f32 runs the unfused graph
+        assert not fused_stem_supported((128, 224, 224, 3), 64,
+                                        "float32")
+
+    def test_tiny_and_malformed(self):
+        assert fused_stem_supported((4, 16, 16, 3), 8, "float32")
+        assert not fused_stem_supported((4, 4, 4, 3), 8, "float32")
+        assert not fused_stem_supported((16, 16, 3), 8, "float32")
+
+
+class TestGraphIntegration:
+    def _nets(self):
+        from test_autotune import tiny_resnet_graph
+        return tiny_resnet_graph(), tiny_resnet_graph()
+
+    def test_matcher_finds_the_stem_chain(self):
+        net, _ = self._nets()
+        net.set_fusion("bottleneck", stem=True)
+        splan = net._stem_plan()
+        assert list(splan) == ["stem_pool"]
+        grp = splan["stem_pool"]
+        assert grp["src"] == "input" and grp["conv"] == "stem_conv"
+        assert grp["pre_vertex"] == "stem_pad"   # absorbed preprocessor
+        _, skip, _ = net._fusion()
+        for m in ("stem_pad", "stem_conv", "stem_bn", "stem_act"):
+            assert skip[m] == "stem_pool"
+
+    def test_stem_requires_bottleneck_level(self):
+        net, _ = self._nets()
+        with pytest.raises(ValueError):
+            net.set_fusion(True, stem=True)
+
+    def test_nchw_not_matched(self):
+        from deeplearning4j_tpu.zoo import ResNet50
+        net = ResNet50(num_classes=10, height=64, width=64).init()
+        net.set_fusion("bottleneck", stem=True)
+        assert not net._stem_plan()
+
+    def test_fused_graph_matches_unfused_fit(self):
+        net_u, net_f = self._nets()
+        net_f.set_fusion("bottleneck", stem=True)
+        assert net_f._stem_plan()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((4, 3, 16, 16)).astype(np.float32)
+        y = np.zeros((4, 5), np.float32)
+        y[np.arange(4), rng.integers(0, 5, 4)] = 1.0
+        np.testing.assert_allclose(np.asarray(net_u.output(x)),
+                                   np.asarray(net_f.output(x)),
+                                   atol=1e-6, rtol=1e-6)
+        for i in range(3):
+            losses = []
+            for net in (net_u, net_f):
+                step = net._get_train_step(False)
+                inputs = {net.conf.network_inputs[0]: jnp.asarray(x)}
+                labels = {net.conf.network_outputs[0]: jnp.asarray(y)}
+                p, s, u, loss = step(net.params, net.state,
+                                     net.updater_state, inputs, labels,
+                                     jax.random.PRNGKey(i), None, None)
+                net.params, net.state, net.updater_state = p, s, u
+                losses.append(float(loss))
+            assert losses[0] == pytest.approx(losses[1], rel=1e-5,
+                                              abs=1e-6)
+        for a, b in zip(jax.tree_util.tree_leaves(net_u.params),
+                        jax.tree_util.tree_leaves(net_f.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5, rtol=1e-4)
+        # the stem BN's running stats trained identically (state parity)
+        np.testing.assert_allclose(
+            np.asarray(net_u.state["stem_bn"]["mean"]),
+            np.asarray(net_f.state["stem_bn"]["mean"]),
+            atol=1e-5, rtol=1e-5)
+
+    def test_engaged_only_when_store_says_win(self):
+        """The ISSUE 11 safety contract: the stem NEVER engages on a
+        static guess — execution_plan='fused' leaves it off until a
+        calibrated entry says the kernel wins."""
+        from deeplearning4j_tpu.tuning import apply_execution_plan
+        net, _ = self._nets()
+        empty = KernelCrossoverStore(path="/nonexistent/none")
+        apply_execution_plan(net, "fused", store=empty)
+        assert not net._stem_plan()
+        _, sc = net.fusion_candidates()
+        win = KernelCrossoverStore(path="/nonexistent/none")
+        win.record(_stem_key(sc["stem_pool"], "float32"), 1.0, 3.0)
+        apply_execution_plan(net, "fused", store=win)
+        assert list(net._stem_plan()) == ["stem_pool"]
