@@ -1,15 +1,22 @@
-"""tpulint — AST static analysis for JAX/TPU anti-patterns.
+"""tpulint — whole-program AST static analysis for JAX/TPU
+anti-patterns.
 
 The static half of the performance-defect story (the PR 1 monitoring
-subsystem is the runtime half): catches host syncs in fit hot loops,
-tracer leaks, recompile hazards, f64 promotion, unlocked cross-thread
-mutation, and hygiene defects at review time, before they reach a TPU.
+subsystem is the runtime half): catches host syncs and device transfers
+in fit/serve hot paths — including ones reached through helper calls
+(the ProjectInfo/CallGraph layer, ISSUE 13) — donation use-after-consume
+(the PR 10 decode_retry class), jit-key drift, tracer leaks, recompile
+hazards, f64 promotion, unlocked cross-thread mutation, and hygiene
+defects at review time, before they reach a TPU.
 
 CLI:   python -m deeplearning4j_tpu.analysis [paths] \
-           [--format=text|json] [--baseline=PATH] [--write-baseline]
+           [--format=text|json] [--baseline=PATH] [--diff REF] \
+           [--rule ID] [--update-baseline [--allow-grandfather]]
 API:   scan_paths(paths) -> List[Finding]
 Suppress inline with `# tpulint: disable=<rule-id>` (same line, or a
-standalone comment on the line above carrying the justification).
+standalone comment on the line above carrying the justification); a
+suppression at a helper's effect line also stops interprocedural
+propagation to its callers.
 """
 
 from deeplearning4j_tpu.analysis.core import (  # noqa: F401

@@ -1,3 +1,13 @@
+"""``python -m deeplearning4j_tpu.analysis`` — the tpulint entry point.
+
+Exit-code contract (also printed by ``--help``):
+  0  clean (no new findings, no stale baseline entries)
+  1  gate failure (new findings incl. parse errors, stale baseline
+     entries, or a refused ``--update-baseline``)
+  2  usage error (unknown rule, missing path, bad ``--diff`` ref, or
+     baseline writes combined with ``--diff`` / a rule subset)
+"""
+
 import sys
 
 from deeplearning4j_tpu.analysis.cli import main

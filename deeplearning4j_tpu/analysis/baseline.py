@@ -17,7 +17,7 @@ import os
 from collections import Counter
 from typing import Dict, List, Sequence, Tuple
 
-from deeplearning4j_tpu.analysis.core import Finding
+from deeplearning4j_tpu.analysis.core import Finding, SEVERITY_ERROR
 
 BASELINE_NAME = "TPULINT_BASELINE.json"
 BASELINE_VERSION = 1
@@ -67,6 +67,34 @@ def write_baseline(path: str, findings: Sequence[Finding]) -> None:
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+
+
+def update_baseline(path: str, findings: Sequence[Finding],
+                    allow_grandfather: bool = False) -> List[Finding]:
+    """The hardened ratchet (`--update-baseline`): rewrite the baseline
+    from the current scan — which silently DROPS stale entries (debt
+    paid off ratchets down for free) — but REFUSE to add entries for
+    findings at severity error unless `allow_grandfather` is passed.
+    Grandfathering an error-severity finding is a deliberate reviewed
+    decision, not a side effect of refreshing the file.
+
+    Returns the refused findings (non-empty means nothing was written);
+    an empty list means the baseline was updated."""
+    if not allow_grandfather:
+        budget = Counter({fp: e.get("count", 1)
+                          for fp, e in load_baseline(path).items()})
+        refused: List[Finding] = []
+        for f_ in findings:
+            fp = f_.fingerprint()
+            if budget.get(fp, 0) > 0:
+                budget[fp] -= 1   # already grandfathered: re-recording ok
+                continue
+            if f_.severity == SEVERITY_ERROR:
+                refused.append(f_)
+        if refused:
+            return refused
+    write_baseline(path, findings)
+    return []
 
 
 def split_new(findings: Sequence[Finding], baseline: Dict[str, dict]

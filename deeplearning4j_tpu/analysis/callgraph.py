@@ -1,0 +1,213 @@
+"""Call graph + bounded-depth per-function effect summaries.
+
+Each project function gets a `FunctionSummary`: the effects its OWN body
+performs (host sync, device transfer, donating dispatch — classified by
+the same predicates the local rules use, so the interprocedural story
+can never disagree with the lexical one) plus the project calls it
+makes. `reaches()` answers "does this callee, within N call hops,
+perform effect X?" with the shortest evidence chain, which promoted
+rules render into their call-site messages.
+
+Design points:
+
+- Effects belong to their INNERMOST enclosing function: a nested
+  ``def step(...)`` inside a builder is its own summary node, so a
+  trace-time constant in a jit body never bleeds into the builder's
+  summary.
+- Inline suppressions in the CALLEE kill propagation: a justified
+  ``# tpulint: disable=host-sync-in-hot-loop`` on the helper's sync line
+  means callers don't get flagged for it either — one suppression per
+  contract, not one per caller.
+- Depth is bounded (`MAX_DEPTH` call hops) and cycles are cut by a
+  visited set, so a recursive pair of modules costs one visit each.
+- Resolution is static-name-only (see project.py soundness caveats):
+  dynamic dispatch breaks the chain, making this an under-approximation.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from collections import deque
+from typing import Dict, FrozenSet, Iterator, List, Optional, Sequence, Tuple
+
+from deeplearning4j_tpu.analysis.core import ModuleInfo
+from deeplearning4j_tpu.analysis.project import (
+    ProjectInfo, iter_functions)
+
+#: call-hop bound for transitive summaries: effects more than this many
+#: resolved calls below a hot call site are not attributed to it
+MAX_DEPTH = 3
+
+EFFECT_HOST_SYNC = "host_sync"
+EFFECT_DEVICE_TRANSFER = "device_transfer"
+EFFECT_DONATING_DISPATCH = "donating_dispatch"
+
+#: effect kind -> rule id whose inline suppression kills propagation
+_SUPPRESSING_RULE = {
+    EFFECT_HOST_SYNC: "host-sync-in-hot-loop",
+    EFFECT_DEVICE_TRANSFER: "device-transfer-in-hot-loop",
+    EFFECT_DONATING_DISPATCH: "donation-use-after-consume",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Effect:
+    kind: str
+    line: int
+    what: str    # e.g. "jax.device_get()"
+    why: str     # one-phrase consequence, from the classifying rule
+    path: str    # rel path of the module owning the effect
+
+
+@dataclasses.dataclass
+class FunctionSummary:
+    module: str                        # dotted module name
+    qualname: str
+    node: ast.AST
+    effects: List[Effect]
+    calls: List[Tuple[str, int]]       # (callee key, call line)
+
+    @property
+    def key(self) -> str:
+        return f"{self.module}:{self.qualname}"
+
+
+def _memo_guarded(mod: ModuleInfo, call: ast.Call) -> bool:
+    """True when a call's result feeds a memoized slot: the nearest
+    enclosing assignment's target also appears in an enclosing ``if``
+    test of the ``is None`` / ``not in`` shape — the cached-table /
+    cached-jit idiom, where the effect runs once per invalidation, not
+    once per caller invocation. Such effects are NOT propagated to
+    callers (the steady state is effect-free by construction)."""
+    from deeplearning4j_tpu.analysis.rules._common import norm_source
+
+    assign = None
+    for anc in mod.ancestors(call):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        if isinstance(anc, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            assign = anc
+            break
+    if assign is None:
+        return False
+    targets = assign.targets if isinstance(assign, ast.Assign) \
+        else [assign.target]
+    target_txt = {norm_source(t) for t in targets}
+    for anc in mod.ancestors(assign):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        if isinstance(anc, ast.If):
+            test = norm_source(anc.test)
+            if any(t and t in test for t in target_txt) \
+                    and ("isNone" in test or "notin" in test):
+                return True
+    return False
+
+
+def own_body_nodes(fn: ast.AST) -> Iterator[ast.AST]:
+    """A function's own body, nested defs/lambdas excluded (they are
+    separate summary nodes). Thin façade over the shared walker."""
+    from deeplearning4j_tpu.analysis.rules._common import walk_no_defs
+    return walk_no_defs(fn, include_self=False)
+
+
+class CallGraph:
+    """Per-function summaries over a ProjectInfo + bounded reachability."""
+
+    def __init__(self, project: ProjectInfo, max_depth: int = MAX_DEPTH):
+        self.project = project
+        self.max_depth = max_depth
+        self.summaries: Dict[str, FunctionSummary] = {}
+        for mod_name, mod in project.modules.items():
+            self._summarize_module(mod_name, mod)
+
+    # -- construction --------------------------------------------------
+    def _summarize_module(self, mod_name: str, mod: ModuleInfo) -> None:
+        # lazy imports: the rule modules import core, not callgraph
+        from deeplearning4j_tpu.analysis.rules.host_sync import (
+            classify_sync)
+        from deeplearning4j_tpu.analysis.rules.device_transfer import (
+            classify_transfer)
+        from deeplearning4j_tpu.analysis.rules.donation import (
+            classify_donating_call, module_donation_map)
+
+        uses_jax = mod.imports_module("jax")
+        donation_map = module_donation_map(mod)
+        for qualname, fn in iter_functions(mod):
+            effects: List[Effect] = []
+            calls: List[Tuple[str, int]] = []
+            for node in own_body_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                line = getattr(node, "lineno", 0)
+                if uses_jax:
+                    what, why = classify_sync(mod, node, strong_only=True)
+                    if what is not None and not self._suppressed(
+                            mod, line, EFFECT_HOST_SYNC) \
+                            and not _memo_guarded(mod, node):
+                        effects.append(Effect(
+                            EFFECT_HOST_SYNC, line, what, why,
+                            mod.rel_path))
+                    what, why = classify_transfer(mod, node)
+                    if what is not None and not self._suppressed(
+                            mod, line, EFFECT_DEVICE_TRANSFER) \
+                            and not _memo_guarded(mod, node):
+                        effects.append(Effect(
+                            EFFECT_DEVICE_TRANSFER, line, what, why,
+                            mod.rel_path))
+                don = classify_donating_call(mod, node, donation_map,
+                                             project=self.project)
+                if don is not None and not self._suppressed(
+                        mod, line, EFFECT_DONATING_DISPATCH):
+                    effects.append(Effect(
+                        EFFECT_DONATING_DISPATCH, line, don.label,
+                        "consumes its donated argument buffers",
+                        mod.rel_path))
+                target = self.project.resolve_call(mod, node)
+                if target is not None:
+                    calls.append((f"{target[0]}:{target[1]}", line))
+            s = FunctionSummary(mod_name, qualname, fn, effects, calls)
+            self.summaries[s.key] = s
+
+    @staticmethod
+    def _suppressed(mod: ModuleInfo, line: int, kind: str) -> bool:
+        sup = mod.suppressions.get(line, ())
+        return _SUPPRESSING_RULE[kind] in sup or "all" in sup
+
+    # -- queries -------------------------------------------------------
+    def summary(self, key: str) -> Optional[FunctionSummary]:
+        return self.summaries.get(key)
+
+    def reaches(self, key: str, kinds: FrozenSet[str],
+                max_depth: Optional[int] = None
+                ) -> Optional[Tuple[Effect, Tuple[str, ...]]]:
+        """Shortest evidence that `key` performs one of `kinds` within
+        the hop bound: (effect, chain-of-keys ending at the owner).
+        BFS, so the returned chain is minimal; within one depth, code
+        order wins. None when nothing is reachable."""
+        if max_depth is None:
+            max_depth = self.max_depth
+        if key not in self.summaries:
+            return None
+        queue = deque([(key, (key,), 1)])
+        seen = {key}
+        while queue:
+            k, chain, depth = queue.popleft()
+            for eff in self.summaries[k].effects:
+                if eff.kind in kinds:
+                    return eff, chain
+            if depth >= max_depth:
+                continue
+            for callee, _line in self.summaries[k].calls:
+                if callee in self.summaries and callee not in seen:
+                    seen.add(callee)
+                    queue.append((callee, chain + (callee,), depth + 1))
+        return None
+
+    @staticmethod
+    def render_chain(chain: Sequence[str], effect: Effect) -> str:
+        """Human form of an evidence chain for rule messages:
+        ``a.helper -> b.deeper (jax.device_get() at pkg/b.py:12)``."""
+        names = " -> ".join(k.split(":", 1)[1] or k for k in chain)
+        return f"{names} ({effect.what} at {effect.path}:{effect.line})"

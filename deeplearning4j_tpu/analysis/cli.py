@@ -1,9 +1,27 @@
 """tpulint CLI: `python -m deeplearning4j_tpu.analysis [paths] ...`.
 
-Exit codes: 0 = clean against the baseline, 1 = new findings (or parse
-errors), 2 = usage error. `--format=json` emits a machine round-trippable
-report for the CI lane; `--write-baseline` (re)grandfathers the current
-scan.
+Exit-code contract (also in --help):
+  0  clean — no new findings, no stale baseline entries
+  1  gate failure — new findings (incl. parse errors), stale baseline
+     entries (debt paid off but not ratcheted), or a refused
+     --update-baseline (error-severity additions need
+     --allow-grandfather)
+  2  usage error — unknown rule id, missing path, bad --diff ref, or
+     --write-baseline/--update-baseline under --diff or a rule subset
+     (a partial scan must never become the baseline)
+
+`--diff <ref>` is the CI-lane mode: rules run ONLY on modules changed
+vs the merge-base with <ref> (working tree included, untracked files
+counted as fully changed) PLUS their reverse-import closure bounded by
+the callgraph depth — a changed callee that grew an effect surfaces
+its interprocedural finding in an UNCHANGED caller, so importers must
+be scanned too. The gate stays O(impacted diff) while the ProjectInfo
+layer spans the whole tree, so a changed caller keeps seeing unchanged
+callees' summaries. Baseline matching and staleness are restricted to
+the scanned modules.
+`--format=json` emits a machine round-trippable report (interprocedural
+findings carry their callee `chain`); the full scan plus
+TPULINT_BASELINE.json ratchet stays the nightly/verify path.
 """
 
 from __future__ import annotations
@@ -11,12 +29,25 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
+import subprocess
 import sys
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from deeplearning4j_tpu.analysis import baseline as bl
-from deeplearning4j_tpu.analysis.core import Finding, scan_paths
+from deeplearning4j_tpu.analysis.core import (
+    Finding, iter_python_files, scan_paths)
+from deeplearning4j_tpu.analysis.project import ProjectInfo
 from deeplearning4j_tpu.analysis.rules import ALL_RULES, RULES_BY_ID
+
+_EPILOG = """\
+exit codes:
+  0  clean: no new findings and no stale baseline entries
+  1  gate failure: new findings (incl. parse errors), stale baseline
+     entries, or a refused --update-baseline
+  2  usage error: unknown rule, missing path, bad --diff ref, or
+     baseline writes combined with --diff / a rule subset
+"""
 
 
 def _default_paths() -> List[str]:
@@ -27,10 +58,14 @@ def _default_paths() -> List[str]:
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m deeplearning4j_tpu.analysis",
-        description="tpulint: AST analyzer for JAX/TPU anti-patterns "
-                    "(host syncs in hot loops, tracer leaks, recompile "
-                    "hazards, f64 promotion, unlocked thread state, "
-                    "hygiene).")
+        description="tpulint: whole-program AST analyzer for JAX/TPU "
+                    "anti-patterns (host syncs / device transfers in hot "
+                    "paths — incl. through helper calls, donation "
+                    "use-after-consume, jit-key drift, tracer leaks, "
+                    "recompile hazards, f64 promotion, unlocked thread "
+                    "state, hygiene).",
+        epilog=_EPILOG,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument("paths", nargs="*",
                    help="files/directories to scan (default: the "
                         "deeplearning4j_tpu package)")
@@ -41,29 +76,153 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-baseline", action="store_true",
                    help="ignore any baseline: every finding is new")
     p.add_argument("--write-baseline", action="store_true",
-                   help="write the current findings as the new baseline "
-                        "and exit 0")
+                   help="overwrite the baseline with the current "
+                        "findings and exit 0 (unguarded; prefer "
+                        "--update-baseline)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="ratchet the baseline from the current scan: "
+                        "stale entries drop, but ADDING error-severity "
+                        "findings is refused without --allow-grandfather")
+    p.add_argument("--allow-grandfather", action="store_true",
+                   help="let --update-baseline grandfather error-"
+                        "severity findings (a reviewed decision)")
+    p.add_argument("--diff", metavar="REF",
+                   help="scan only modules changed vs the merge-base "
+                        "with REF (working tree included); the project "
+                        "layer still spans everything")
     p.add_argument("--rules", metavar="ID[,ID...]",
                    help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--rule", metavar="ID", action="append", default=[],
+                   help="run a single rule (repeatable; combines with "
+                        "--rules)")
     p.add_argument("--list-rules", action="store_true",
                    help="print rule ids and descriptions, then exit")
     return p
 
 
-def _select_rules(spec: Optional[str]):
-    if not spec:
+def _select_rules(spec: Optional[str], singles: Sequence[str]):
+    ids = [s.strip() for s in (spec or "").split(",") if s.strip()]
+    ids += [s.strip() for s in singles if s.strip()]
+    if not ids:
         return ALL_RULES
-    ids = [s.strip() for s in spec.split(",") if s.strip()]
     unknown = [i for i in ids if i not in RULES_BY_ID]
     if unknown:
         raise ValueError(
             f"tpulint: unknown rule id(s): {', '.join(unknown)} "
             f"(see --list-rules)")
-    return [RULES_BY_ID[i] for i in ids]
+    seen: Dict[str, None] = {}
+    for i in ids:
+        seen.setdefault(i)
+    return [RULES_BY_ID[i] for i in seen]
 
 
+# ---------------------------------------------------------------------
+# --diff plumbing
+# ---------------------------------------------------------------------
+_HUNK_RE = re.compile(r"^@@ -\d+(?:,\d+)? \+(\d+)(?:,(\d+))? @@")
+
+
+def _git(root: str, *args: str) -> str:
+    proc = subprocess.run(["git", "-C", root, *args],
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(proc.stderr.strip()
+                           or f"git {' '.join(args)} failed")
+    return proc.stdout
+
+
+def diff_changed_py(root: str, ref: str
+                    ) -> Tuple[Set[str], Dict[str, List[Tuple[int, int]]]]:
+    """(changed .py ABSOLUTE paths, root-relative path -> added/changed
+    line ranges) for the working tree vs the merge-base with `ref`.
+    git emits repo-toplevel-relative paths, which need not coincide
+    with the baseline-dir `root` findings are keyed on — so files are
+    resolved against the toplevel and ranges re-keyed against `root`.
+    Untracked (not-yet-added) .py files count as fully changed; deleted
+    files are naturally absent (nothing to scan)."""
+    top = _git(root, "rev-parse", "--show-toplevel").strip()
+    try:
+        base = _git(top, "merge-base", ref, "HEAD").strip()
+    except RuntimeError:
+        # ref exists but shares no history (shallow clones): diff
+        # straight against it
+        base = _git(top, "rev-parse", "--verify",
+                    f"{ref}^{{commit}}").strip()
+
+    def rel_to_root(git_path: str) -> str:
+        return os.path.relpath(os.path.join(top, git_path),
+                               root).replace(os.sep, "/")
+
+    files: Set[str] = set()
+    ranges: Dict[str, List[Tuple[int, int]]] = {}
+    for f in _git(top, "-c", "diff.noprefix=false", "diff",
+                  "--no-ext-diff", "--name-only", base,
+                  "--", "*.py").splitlines():
+        if f.strip():
+            files.add(os.path.abspath(os.path.join(top, f)))
+    # a brand-new module is invisible to `git diff <base>` until added:
+    # treat untracked .py files as changed end to end
+    for f in _git(top, "ls-files", "--others", "--exclude-standard",
+                  "--", "*.py").splitlines():
+        if f.strip():
+            files.add(os.path.abspath(os.path.join(top, f)))
+            ranges.setdefault(rel_to_root(f), []).append((1, 10 ** 9))
+    current: Optional[str] = None
+    # user diff config (noprefix/mnemonicPrefix/external drivers) must
+    # not change the parseable hunk format the range extraction expects
+    for line in _git(top, "-c", "diff.noprefix=false",
+                     "-c", "diff.mnemonicPrefix=false", "diff",
+                     "--no-ext-diff", "--unified=0", base,
+                     "--", "*.py").splitlines():
+        if line.startswith("+++ b/"):
+            current = rel_to_root(line[6:].strip())
+        elif line.startswith("@@") and current is not None:
+            m = _HUNK_RE.match(line)
+            if m:
+                start = int(m.group(1))
+                count = int(m.group(2)) if m.group(2) is not None else 1
+                if count > 0:
+                    ranges.setdefault(current, []).append(
+                        (start, start + count - 1))
+    return files, ranges
+
+
+def _on_changed_line(f_: Finding,
+                     ranges: Dict[str, List[Tuple[int, int]]]) -> bool:
+    return any(a <= f_.line <= b for a, b in ranges.get(f_.path, ()))
+
+
+def _importer_closure(project: ProjectInfo, root: str,
+                      changed: Set[str]) -> Set[str]:
+    """Absolute paths of modules that (transitively, up to the
+    callgraph depth bound) import a changed module: where a changed
+    callee's new effect surfaces as an interprocedural finding."""
+    from deeplearning4j_tpu.analysis.callgraph import MAX_DEPTH
+    importers: Dict[str, Set[str]] = {}
+    for mod_name, deps in project.import_graph().items():
+        for dep in deps:
+            importers.setdefault(dep, set()).add(mod_name)
+    frontier = {project.by_rel_path[rel]
+                for rel in (os.path.relpath(f, root).replace(os.sep, "/")
+                            for f in changed)
+                if rel in project.by_rel_path}
+    seen: Set[str] = set()
+    for _ in range(MAX_DEPTH):
+        frontier = {imp for m in frontier
+                    for imp in importers.get(m, ())} - seen
+        if not frontier:
+            break
+        seen |= frontier
+    return {os.path.abspath(os.path.join(
+                root, project.modules[m].rel_path)) for m in seen}
+
+
+# ---------------------------------------------------------------------
+# reports
+# ---------------------------------------------------------------------
 def _emit_text(new: List[Finding], matched: int, stale: List[str],
-               total: int) -> None:
+               total: int, scanned: int, total_modules: int,
+               diff_ref: Optional[str]) -> None:
     for f_ in new:
         print(f_.render())
     bits = [f"{total} finding(s)", f"{len(new)} new",
@@ -71,19 +230,33 @@ def _emit_text(new: List[Finding], matched: int, stale: List[str],
     if stale:
         bits.append(f"{len(stale)} stale baseline entr"
                     f"{'y' if len(stale) == 1 else 'ies'} "
-                    f"(re-run --write-baseline to ratchet down)")
+                    f"(HARD failure — ratchet with --update-baseline)")
     print("tpulint: " + ", ".join(bits))
+    scope = f"diff vs {diff_ref}" if diff_ref else "full scan"
+    print(f"tpulint: scanned {scanned} of {total_modules} modules "
+          f"({scope})")
 
 
 def _emit_json(new: List[Finding], matched: int, stale: List[str],
-               total: int, root: str) -> None:
+               total: int, root: str, scanned: int, total_modules: int,
+               diff_ref: Optional[str],
+               ranges: Dict[str, List[Tuple[int, int]]]) -> None:
+    out = []
+    for f_ in new:
+        d = f_.to_dict()
+        if diff_ref is not None:
+            d["on_changed_line"] = _on_changed_line(f_, ranges)
+        out.append(d)
     print(json.dumps({
         "tool": "tpulint",
         "root": root,
         "total": total,
         "baselined": matched,
         "stale_baseline": stale,
-        "new": [f_.to_dict() for f_ in new],
+        "scanned_modules": scanned,
+        "total_modules": total_modules,
+        "diff_base": diff_ref,
+        "new": out,
     }, indent=2))
 
 
@@ -91,7 +264,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = build_parser()
     try:
         args = parser.parse_args(argv)
-        rules = _select_rules(args.rules)
+        rules = _select_rules(args.rules, args.rule)
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 2
@@ -100,8 +273,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     if args.list_rules:
         for r in ALL_RULES:
-            print(f"{r.id:24s} [{r.severity}] {r.description}")
+            print(f"{r.id:28s} [{r.severity}] {r.description}")
         return 0
+
+    if args.write_baseline or args.update_baseline:
+        # a partial scan must never become the baseline: it would wipe
+        # every out-of-scope grandfathered entry
+        if args.diff:
+            print("tpulint: refusing to (re)write the baseline from a "
+                  "--diff scan: a partial scan must never become the "
+                  "baseline", file=sys.stderr)
+            return 2
+        if len(rules) != len(ALL_RULES):
+            print("tpulint: refusing to (re)write the baseline from a "
+                  "rule-subset scan (--rule/--rules): the other rules' "
+                  "grandfathered entries would be wiped", file=sys.stderr)
+            return 2
 
     baseline_path = args.baseline or bl.default_baseline_path()
     # paths in findings/baseline are relative to the baseline's directory
@@ -114,19 +301,72 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               file=sys.stderr)
         return 2
 
-    findings = scan_paths(paths, rules=rules, root=root)
+    project = ProjectInfo.build(paths, root)
+    total_modules = len(project.modules)
+
+    only_files: Optional[Set[str]] = None
+    ranges: Dict[str, List[Tuple[int, int]]] = {}
+    if args.diff:
+        try:
+            changed, ranges = diff_changed_py(root, args.diff)
+        except RuntimeError as e:
+            print(f"tpulint: --diff {args.diff}: {e}", file=sys.stderr)
+            return 2
+        # impact closure: a changed CALLEE that grew an effect produces
+        # its interprocedural finding in an UNCHANGED caller, so the
+        # scan set must include the reverse-import closure of the
+        # changed modules — bounded by the callgraph depth (each call
+        # hop crosses at most one import edge)
+        only_files = set(changed) | _importer_closure(project, root,
+                                                      changed)
+    scanned_files = [p for p in iter_python_files(paths)
+                     if only_files is None
+                     or os.path.abspath(p) in only_files]
+    scanned = len(scanned_files)
+
+    findings = scan_paths(paths, rules=rules, root=root, project=project,
+                          files=scanned_files)
 
     if args.write_baseline:
         bl.write_baseline(baseline_path, findings)
         print(f"tpulint: wrote {len(findings)} finding(s) to "
               f"{baseline_path}")
         return 0
+    if args.update_baseline:
+        refused = bl.update_baseline(baseline_path, findings,
+                                     allow_grandfather=args.allow_grandfather)
+        if refused:
+            print("tpulint: --update-baseline refused — these findings "
+                  "are at severity error and would be newly "
+                  "grandfathered (fix them, or pass --allow-grandfather "
+                  "after review):", file=sys.stderr)
+            for f_ in refused:
+                print("  " + f_.render().splitlines()[0], file=sys.stderr)
+            return 1
+        print(f"tpulint: ratcheted baseline to {len(findings)} "
+              f"finding(s) at {baseline_path}")
+        return 0
 
     baseline = {} if args.no_baseline else bl.load_baseline(baseline_path)
+    if only_files is not None:
+        # a diff scan sees only changed modules: entries for unscanned
+        # modules are out of scope, not stale
+        scanned_rel = {os.path.relpath(p, root).replace(os.sep, "/")
+                       for p in scanned_files}
+        baseline = {fp: e for fp, e in baseline.items()
+                    if e.get("path") in scanned_rel}
+    if len(rules) != len(ALL_RULES):
+        # a rule-subset run leaves the other rules' entries out of
+        # scope, not stale
+        selected = {r.id for r in rules}
+        baseline = {fp: e for fp, e in baseline.items()
+                    if e.get("rule") in selected}
     new, matched, stale = bl.split_new(findings, baseline)
 
     if args.format == "json":
-        _emit_json(new, matched, stale, len(findings), root)
+        _emit_json(new, matched, stale, len(findings), root, scanned,
+                   total_modules, args.diff, ranges)
     else:
-        _emit_text(new, matched, stale, len(findings))
-    return 1 if new else 0
+        _emit_text(new, matched, stale, len(findings), scanned,
+                   total_modules, args.diff)
+    return 1 if (new or stale) else 0

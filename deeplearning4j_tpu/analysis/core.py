@@ -32,7 +32,15 @@ _SUPPRESS_RE = re.compile(r"#\s*tpulint:\s*disable=([A-Za-z0-9_\-, ]+)")
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
-    """One rule violation at a source location."""
+    """One rule violation at a source location.
+
+    `chain` carries the interprocedural evidence for promoted findings
+    (the callee chain from the flagged call site down to the function
+    owning the effect, rendered ``module.qualname`` per hop, with the
+    effect site appended) — empty for purely lexical findings. It is
+    display/JSON payload only and deliberately NOT part of the
+    fingerprint: refactoring an intermediate helper must not churn the
+    baseline while the contract violation is unchanged."""
 
     rule: str
     severity: str
@@ -40,6 +48,7 @@ class Finding:
     line: int
     message: str
     snippet: str = ""
+    chain: Tuple[str, ...] = ()
 
     def fingerprint(self) -> str:
         """Location-tolerant identity for baseline matching: rule + path +
@@ -52,6 +61,7 @@ class Finding:
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
+        d["chain"] = list(self.chain)
         d["fingerprint"] = self.fingerprint()
         return d
 
@@ -60,6 +70,8 @@ class Finding:
         out = f"{loc}: [{self.rule}] {self.severity}: {self.message}"
         if self.snippet:
             out += f"\n    {self.snippet}"
+        if self.chain:
+            out += f"\n    via: {' -> '.join(self.chain)}"
         return out
 
 
@@ -109,6 +121,16 @@ class ModuleInfo:
         # "jnp" -> "jax.numpy", "jit" -> "jax.jit")
         self.aliases: Dict[str, str] = {}
         self._collect_imports()
+        #: memo for derived per-module facts (donation maps, jit-staged
+        #: function sets, ...): several rules need the same expensive
+        #: whole-tree walks, and a module is immutable once parsed
+        self._facts: Dict[str, object] = {}
+
+    def fact(self, key: str, compute):
+        """Memoized derived fact: `compute(self)` runs once per module."""
+        if key not in self._facts:
+            self._facts[key] = compute(self)
+        return self._facts[key]
 
     # -- imports ------------------------------------------------------
     def _collect_imports(self) -> None:
@@ -186,7 +208,13 @@ class ModuleInfo:
 
 class Rule:
     """Base class: subclasses set `id`/`severity`/`description` and yield
-    findings from `check(module)`."""
+    findings from `check(module)`.
+
+    Project-aware rules additionally define
+    ``check_project(module, project)`` — the engine calls it (instead of
+    `check`) whenever a whole-program `ProjectInfo` is available, so the
+    same rule object degrades gracefully to its lexical behavior on a
+    bare single-file scan."""
 
     id: str = ""
     severity: str = SEVERITY_WARNING
@@ -195,10 +223,11 @@ class Rule:
     def check(self, mod: ModuleInfo) -> Iterator[Finding]:
         raise NotImplementedError
 
-    def finding(self, mod: ModuleInfo, node, message: str) -> Finding:
+    def finding(self, mod: ModuleInfo, node, message: str,
+                chain: Tuple[str, ...] = ()) -> Finding:
         line = node if isinstance(node, int) else getattr(node, "lineno", 0)
         return Finding(self.id, self.severity, mod.rel_path, line,
-                       message, mod.line_text(line))
+                       message, mod.line_text(line), chain)
 
 
 # ---------------------------------------------------------------------
@@ -219,19 +248,26 @@ def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
 
 
 def scan_file(path: str, rules: Sequence[Rule],
-              root: Optional[str] = None) -> List[Finding]:
+              root: Optional[str] = None,
+              project=None) -> List[Finding]:
     rel = os.path.relpath(path, root) if root else path
     rel = rel.replace(os.sep, "/")
-    with open(path, "r", encoding="utf-8") as f:
-        source = f.read()
-    try:
-        mod = ModuleInfo(path, rel, source)
-    except SyntaxError as e:
-        return [Finding(PARSE_ERROR_RULE, SEVERITY_ERROR, rel,
-                        e.lineno or 0, f"cannot parse: {e.msg}")]
+    mod = project.module_for_path(rel) if project is not None else None
+    if mod is None:
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        try:
+            mod = ModuleInfo(path, rel, source)
+        except SyntaxError as e:
+            return [Finding(PARSE_ERROR_RULE, SEVERITY_ERROR, rel,
+                            e.lineno or 0, f"cannot parse: {e.msg}")]
     findings: List[Finding] = []
     for rule in rules:
-        for f_ in rule.check(mod):
+        checker = getattr(rule, "check_project", None)
+        it = checker(mod, project) if (checker is not None
+                                       and project is not None) \
+            else rule.check(mod)
+        for f_ in it:
             suppressed = mod.suppressions.get(f_.line, ())
             if f_.rule in suppressed or "all" in suppressed:
                 continue
@@ -241,12 +277,29 @@ def scan_file(path: str, rules: Sequence[Rule],
 
 
 def scan_paths(paths: Sequence[str], rules: Optional[Sequence[Rule]] = None,
-               root: Optional[str] = None) -> List[Finding]:
-    """Scan files/directories with the given rules (default: all)."""
+               root: Optional[str] = None, project=None,
+               files: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Scan files/directories with the given rules (default: all).
+
+    A whole-program `ProjectInfo` is built over `paths` once (parse
+    shared with the per-file scan) so project-aware rules see cross-
+    module facts; pass `project` to reuse one already built. `files`
+    (an explicit pre-computed subset of the walk) is the diff lane's
+    O(diff) seam: rules run only on those modules while the project
+    layer still spans everything, so a changed caller keeps seeing
+    unchanged callees' summaries.
+
+    `root` defaults to the cwd and is applied to BOTH the project layer
+    and the per-file scan — the two must key modules by the same
+    relative paths or cross-module resolution silently degrades."""
     if rules is None:
         from deeplearning4j_tpu.analysis.rules import ALL_RULES
         rules = ALL_RULES
+    root = root or os.getcwd()
+    if project is None:
+        from deeplearning4j_tpu.analysis.project import ProjectInfo
+        project = ProjectInfo.build(paths, root)
     out: List[Finding] = []
-    for path in iter_python_files(paths):
-        out.extend(scan_file(path, rules, root=root))
+    for path in (files if files is not None else iter_python_files(paths)):
+        out.extend(scan_file(path, rules, root=root, project=project))
     return out

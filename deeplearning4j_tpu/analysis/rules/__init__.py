@@ -1,9 +1,13 @@
 """tpulint rule registry.
 
-Rule families: host-sync, device-transfer (ISSUE 3), tracer-leak,
-recompile-hazard, dtype-promotion, concurrency, hygiene, retry
-(ISSUE 4). Adding a rule = subclass `analysis.core.Rule`, instantiate
-it here.
+Rule families: host-sync + device-transfer (ISSUE 3; interprocedurally
+promoted in ISSUE 13), tracer-leak, recompile-hazard, dtype-promotion,
+concurrency, hygiene, retry (ISSUE 4), state-write (ISSUE 7),
+world-snapshot (ISSUE 8), lock-dispatch (ISSUE 9), and the ISSUE 13
+exactness-contract families: donation-use-after-consume and
+jit-key-drift. Adding a rule = subclass `analysis.core.Rule`
+(optionally with a ``check_project`` for whole-program facts),
+instantiate it here.
 """
 
 from __future__ import annotations
@@ -27,10 +31,15 @@ from deeplearning4j_tpu.analysis.rules.state_write import (
     NonAtomicStateWriteRule)
 from deeplearning4j_tpu.analysis.rules.world_snapshot import (
     WorldSnapshotRule)
+from deeplearning4j_tpu.analysis.rules.donation import (
+    DonationUseAfterConsumeRule)
+from deeplearning4j_tpu.analysis.rules.jit_key import JitKeyDriftRule
 
 ALL_RULES: List[Rule] = [
     HostSyncRule(),
     DeviceTransferRule(),
+    DonationUseAfterConsumeRule(),
+    JitKeyDriftRule(),
     TracerLeakRule(),
     RecompileHazardRule(),
     DtypePromotionRule(),

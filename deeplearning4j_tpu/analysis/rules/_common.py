@@ -3,9 +3,43 @@
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Optional, Set, Tuple
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
 
 from deeplearning4j_tpu.analysis.core import ModuleInfo
+
+
+def walk_no_defs(node: ast.AST,
+                 include_self: bool = True) -> Iterator[ast.AST]:
+    """Walk an AST WITHOUT descending into nested function / lambda
+    definitions — those are separate analysis scopes (and often
+    jit-staged bodies with different semantics). `include_self=False`
+    walks a function's own body (the def node itself excluded)."""
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Lambda)):
+        if include_self:
+            return
+    elif include_self:
+        yield node
+    for child in ast.iter_child_nodes(node):
+        yield from walk_no_defs(child, include_self=True)
+
+
+def module_calls(mod: ModuleInfo) -> List[ast.Call]:
+    """Every Call node in the module, in walk order (memoized): the
+    hot-loop rules and their interprocedural promotions iterate calls
+    several times per scan."""
+    return mod.fact("all_calls", lambda m: [
+        n for n in ast.walk(m.tree) if isinstance(n, ast.Call)])
+
+
+def norm_source(node: ast.AST) -> str:
+    """Whitespace-stripped source form of a node, for textual matching
+    (memo-guard targets, jit-key flow)."""
+    try:
+        return re.sub(r"\s+", "", ast.unparse(node))
+    except Exception:  # pragma: no cover - unparse is total on 3.9+
+        return ""
 
 #: call targets that stage a Python function for tracing: assigning
 #: tracers to Python state inside any of these leaks, and value-dependent
@@ -57,7 +91,35 @@ def collect_jit_functions(
     """FunctionDefs staged for tracing in this module: decorated with a
     tracing wrapper, or named as the wrapped argument of a `jax.jit(f)` /
     `partial(jax.jit, ...)(f)`-style call. Maps each def to the jit call
-    that wraps it (None when the decorator form carries no call)."""
+    that wraps it (None when the decorator form carries no call).
+    Memoized per module."""
+    return mod.fact("jit_functions", _compute_jit_functions)
+
+
+def tracing_calls(mod: ModuleInfo) -> List[ast.Call]:
+    """Every tracing-wrapper construction in the module (memoized):
+    rules that only need "does this function build a jit?" intersect
+    these with ancestry instead of re-walking subtrees."""
+    return mod.fact("tracing_calls", lambda m: [
+        n for n in ast.walk(m.tree)
+        if isinstance(n, ast.Call) and _is_tracing_wrapper(m, n)])
+
+
+def functions_building_jit(mod: ModuleInfo) -> Set[ast.AST]:
+    """Function defs that lexically contain a tracing-wrapper
+    construction anywhere in their subtree (memoized)."""
+
+    def compute(m: ModuleInfo) -> Set[ast.AST]:
+        out: Set[ast.AST] = set()
+        for call in tracing_calls(m):
+            out.update(m.enclosing_functions(call))
+        return out
+
+    return mod.fact("functions_building_jit", compute)
+
+
+def _compute_jit_functions(
+        mod: ModuleInfo) -> Dict[ast.FunctionDef, Optional[ast.Call]]:
     defs_by_name: Dict[str, List[ast.FunctionDef]] = {}
     out: Dict[ast.FunctionDef, Optional[ast.Call]] = {}
     for node in ast.walk(mod.tree):

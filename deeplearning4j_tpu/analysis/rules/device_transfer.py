@@ -28,18 +28,27 @@ outside the hot names and invalidate it on MUTATION, so steady-state
 steps re-upload nothing. Only top-level (method) bodies count: a
 nested ``def step(carry, ...)`` is a jitted/scan body whose
 ``jnp.asarray`` is a trace-time constant, not a per-step H2D copy.
+
+Interprocedural promotion (ISSUE 13): with a `ProjectInfo`, a call in a
+hot region whose resolved callee transitively stages an H2D copy
+(bounded depth, analysis/callgraph.py) is flagged at the call site with
+the callee chain — the cached-table helpers stay exempt because the
+cache-hit path means the transfer is NOT per-step; when a cache helper
+is hit every step because invalidation is wrong, that is a runtime
+(PR 1 watcher) story, not a lexical one.
 """
 
 from __future__ import annotations
 
 import ast
 import re
-from typing import Iterator
+from typing import Iterator, Optional, Tuple
 
 from deeplearning4j_tpu.analysis.core import (
     Finding, ModuleInfo, Rule, SEVERITY_WARNING)
 from deeplearning4j_tpu.analysis.rules.host_sync import (
-    _LOOP_FN, _PER_BATCH_FN)
+    _LOOP_FN, _PER_BATCH_FN, is_hot_named)
+from deeplearning4j_tpu.analysis.rules._common import module_calls
 
 _TRANSFER_CALLS = {
     "jax.numpy.asarray": "jnp.asarray",
@@ -54,54 +63,104 @@ _PER_STEP_FN = re.compile(
     r"^(step|_step_\w+|_dispatch_step|_run_dispatch|_decode_step)$")
 
 
+def classify_transfer(mod: ModuleInfo,
+                      node: ast.Call) -> Tuple[Optional[str],
+                                               Optional[str]]:
+    """(label, why) when a call stages a synchronous H2D copy of
+    non-constant data, else (None, None). Shared with the call-graph
+    effect summaries."""
+    resolved = mod.resolve(node.func)
+    label = _TRANSFER_CALLS.get(resolved)
+    if label is None:
+        return None, None
+    # a literal scalar/constant is shape plumbing, not a batch
+    if node.args and isinstance(node.args[0], ast.Constant):
+        return None, None
+    return f"{label}()", "stages a host->device copy on the caller"
+
+
+def hot_transfer_region(mod: ModuleInfo,
+                        node: ast.AST) -> Optional[Tuple[str, bool]]:
+    """(where, is_per_step) for the transfer heat model, or None."""
+    for fn in mod.enclosing_functions(node):
+        if _PER_BATCH_FN.match(fn.name):
+            return f"per-batch path '{fn.name}'", False
+        if _PER_STEP_FN.match(fn.name) and not mod.enclosing_functions(fn):
+            return f"per-step path '{fn.name}'", True
+        if _LOOP_FN.match(fn.name) and mod.inside_loop(node, within=fn):
+            return f"loop in '{fn.name}'", False
+    return None
+
+
 class DeviceTransferRule(Rule):
     id = "device-transfer-in-hot-loop"
     severity = SEVERITY_WARNING
     description = ("jnp.asarray/jax.device_put on host data inside a "
                    "fit/epoch loop stages the H2D copy on the consumer "
                    "thread; prefetch it (pipeline.DevicePrefetchIterator) "
-                   "so the transfer overlaps device compute")
+                   "so the transfer overlaps device compute — including "
+                   "transfers reached through helper calls (project mode)")
 
     def check(self, mod: ModuleInfo) -> Iterator[Finding]:
         if not mod.imports_module("jax"):
             return
-        for node in ast.walk(mod.tree):
-            if not isinstance(node, ast.Call):
-                continue
-            resolved = mod.resolve(node.func)
-            label = _TRANSFER_CALLS.get(resolved)
+        for node in module_calls(mod):
+            label, _why = classify_transfer(mod, node)
             if label is None:
                 continue
-            # a literal scalar/constant is shape plumbing, not a batch
-            if node.args and isinstance(node.args[0], ast.Constant):
+            region = hot_transfer_region(mod, node)
+            if region is None:
                 continue
-            for fn in mod.enclosing_functions(node):
-                per_step = False
-                if _PER_BATCH_FN.match(fn.name):
-                    where = f"per-batch path '{fn.name}'"
-                elif _PER_STEP_FN.match(fn.name) and \
-                        not mod.enclosing_functions(fn):
-                    per_step = True
-                    where = f"per-step path '{fn.name}'"
-                elif _LOOP_FN.match(fn.name) and mod.inside_loop(node,
-                                                                 within=fn):
-                    where = f"loop in '{fn.name}'"
-                else:
-                    continue
-                if per_step:
-                    yield self.finding(
-                        mod, node,
-                        f"{label}() in {where} re-stages a host->device "
-                        f"copy every decode step even when the host data "
-                        f"did not change; cache the device array outside "
-                        f"the step and invalidate it on mutation (the "
-                        f"serving engine's cached page-table path)")
-                else:
-                    yield self.finding(
-                        mod, node,
-                        f"{label}() in {where} stages a host->device "
-                        f"copy on the consumer thread each batch; move "
-                        f"it into a device prefetch stage "
-                        f"(pipeline.DevicePrefetchIterator) so the "
-                        f"transfer overlaps compute")
-                break
+            where, per_step = region
+            if per_step:
+                yield self.finding(
+                    mod, node,
+                    f"{label} in {where} re-stages a host->device "
+                    f"copy every decode step even when the host data "
+                    f"did not change; cache the device array outside "
+                    f"the step and invalidate it on mutation (the "
+                    f"serving engine's cached page-table path)")
+            else:
+                yield self.finding(
+                    mod, node,
+                    f"{label} in {where} stages a host->device "
+                    f"copy on the consumer thread each batch; move "
+                    f"it into a device prefetch stage "
+                    f"(pipeline.DevicePrefetchIterator) so the "
+                    f"transfer overlaps compute")
+
+    # -- interprocedural promotion -------------------------------------
+    def check_project(self, mod: ModuleInfo, project) -> Iterator[Finding]:
+        yield from self.check(mod)
+        if project is None:
+            return
+        from deeplearning4j_tpu.analysis.callgraph import (
+            EFFECT_DEVICE_TRANSFER)
+        cg = project.callgraph
+        kinds = frozenset({EFFECT_DEVICE_TRANSFER})
+        for node in module_calls(mod):
+            if classify_transfer(mod, node)[0] is not None:
+                continue
+            region = hot_transfer_region(mod, node)
+            if region is None:
+                continue
+            where, _per_step = region
+            target = project.resolve_call(mod, node)
+            if target is None:
+                continue
+            mod_name, qual = target
+            last = qual.rsplit(".", 1)[-1]
+            if is_hot_named(last) or _PER_STEP_FN.match(last):
+                continue  # the callee body is hot itself: flagged there
+            evidence = cg.reaches(f"{mod_name}:{qual}", kinds)
+            if evidence is None:
+                continue
+            effect, chain = evidence
+            yield self.finding(
+                mod, node,
+                f"call to '{qual}' in {where} reaches a host->device "
+                f"transfer: {cg.render_chain(chain, effect)}; stage it "
+                f"once outside the hot path (or prefetch it) so the "
+                f"copy overlaps compute",
+                chain=chain + (f"{effect.what} at "
+                               f"{effect.path}:{effect.line}",))

@@ -1,0 +1,565 @@
+"""donation-use-after-consume: a donated buffer read after the dispatch
+that consumed it.
+
+`donate_argnums` / the streaming `donate_state=True` protocol hand an
+argument's buffers to XLA for in-place reuse: after the dispatch the
+Python-side array is DELETED — touching it again is an error on real
+accelerators (and silently fine on CPU, which is exactly why review
+keeps missing it). The PR 10 `decode_retry` bug was this class: a
+retried dispatch re-ran against state buffers its first attempt had
+already consumed. The repo's contract (serving/engine.py `_donate`):
+donation and re-execution are mutually exclusive — a consumed value must
+be reassigned from the dispatch result before ANY later read, return, or
+re-dispatch on every path.
+
+Three statically checkable shapes:
+
+1. sequence — a name (or ``self.attr`` chain) passed at a donated
+   position is loaded, returned, or re-dispatched later in the same
+   function on some path that did not unconditionally reassign it first;
+2. loop — a donating dispatch inside a for/while whose consumed argument
+   is never rebound in the loop body: iteration 2 re-reads the buffer
+   iteration 1 consumed;
+3. retried callable — a donating dispatch (including literal
+   ``donate_state=True``) inside a nested def/lambda handed to a
+   ``retry``-shaped call: every retry attempt after the first re-runs
+   against consumed buffers (the PR 10 shape; fix like the engine —
+   donation OFF whenever a retry policy is configured, or re-stage
+   inputs per attempt).
+
+Donating callables are recognized from ``@partial(jax.jit,
+donate_argnums=...)`` decorations and ``g = jax.jit(f,
+donate_argnums=...)`` module assignments, locally and — with a
+`ProjectInfo` — across module boundaries through import aliases.
+Dynamic aliasing (jits stored in dicts, passed as parameters) is out of
+scope: under-approximate, never noisy.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple, Union
+
+from deeplearning4j_tpu.analysis.core import (
+    Finding, ModuleInfo, Rule, SEVERITY_ERROR)
+from deeplearning4j_tpu.analysis.rules._common import (
+    _is_tracing_wrapper, walk_no_defs as _walk_no_defs)
+
+#: call names that re-run their callable argument (the retry shape)
+_RETRY_NAME = re.compile(r"retry", re.IGNORECASE)
+
+#: sentinel for donate_state=True dispatches (no positional key tracked:
+#: the consumed buffers are the callee's internal streaming state)
+STATE = "state"
+
+
+@dataclasses.dataclass(frozen=True)
+class DonatingCall:
+    label: str                                  # display name of the callee
+    positions: Union[FrozenSet[int], str]       # donated argnums, or STATE
+
+
+def _literal_argnums(val: ast.AST) -> Optional[FrozenSet[int]]:
+    if isinstance(val, ast.Constant) and isinstance(val.value, int):
+        return frozenset({val.value})
+    if isinstance(val, (ast.Tuple, ast.List)):
+        out = {e.value for e in val.elts
+               if isinstance(e, ast.Constant) and isinstance(e.value, int)}
+        return frozenset(out) if out else None
+    return None
+
+
+def _donating_jit_call(mod: ModuleInfo,
+                       call: ast.Call) -> Optional[FrozenSet[int]]:
+    """donate_argnums of a `jax.jit(...)`/`partial(jax.jit, ...)` call
+    expression, when literal and non-empty."""
+    if not (isinstance(call, ast.Call) and _is_tracing_wrapper(mod, call)):
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            return _literal_argnums(kw.value)
+    return None
+
+
+def module_donation_map(mod: ModuleInfo) -> Dict[str, FrozenSet[int]]:
+    """key -> donated positions for every statically visible donating
+    callable at MODULE scope: decorated defs and ``name = jax.jit(f,
+    donate_argnums=...)`` bindings. Class members are keyed
+    ``Class.name`` ONLY and nested (function-local) callables are NOT
+    recorded here at all — either form of bare-name sharing would let
+    an unrelated same-named callable inherit donation (an
+    error-severity false positive). Function-local donating callables
+    come from `function_donation_map`. Memoized per module."""
+    return mod.fact("donation_map", _compute_donation_map)
+
+
+def _scope_donations(mod: ModuleInfo, scope,
+                     cls_prefix: str,
+                     out: Dict[str, FrozenSet[int]],
+                     recurse_classes: bool) -> None:
+    for node in scope:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    nums = _donating_jit_call(mod, dec)
+                    if nums:
+                        out[f"{cls_prefix}{node.name}"] = nums
+            # nested defs are a narrower scope: not recorded here
+        elif isinstance(node, ast.ClassDef) and recurse_classes:
+            _scope_donations(mod, node.body, f"{node.name}.", out, True)
+        elif isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call):
+            nums = _donating_jit_call(mod, node.value)
+            if nums:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[f"{cls_prefix}{t.id}"] = nums
+
+
+def _compute_donation_map(mod: ModuleInfo) -> Dict[str, FrozenSet[int]]:
+    out: Dict[str, FrozenSet[int]] = {}
+    _scope_donations(mod, mod.tree.body, "", out, recurse_classes=True)
+    return out
+
+
+def function_donation_map(mod: ModuleInfo,
+                          fn: ast.AST) -> Dict[str, FrozenSet[int]]:
+    """Donating callables bound in `fn`'s own body (its immediate
+    nested defs and local jit-assignments) — visible to calls within
+    `fn` only; deeper nested defs are their own scope."""
+    out: Dict[str, FrozenSet[int]] = {}
+    _scope_donations(mod, fn.body, "", out, recurse_classes=False)
+    return out
+
+
+def classify_donating_call(mod: ModuleInfo, call: ast.Call,
+                           donation_map: Dict[str, FrozenSet[int]],
+                           project=None) -> Optional[DonatingCall]:
+    """DonatingCall when `call` dispatches a donating jit (local map,
+    cross-module via project, or a literal ``donate_state=True``)."""
+    for kw in call.keywords:
+        if kw.arg == "donate_state" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is True:
+            label = _callee_label(call)
+            return DonatingCall(label, STATE)
+    func = call.func
+    if isinstance(func, ast.Name) and func.id in donation_map:
+        return DonatingCall(func.id, donation_map[func.id])
+    canonical = mod.resolve(func)
+    if canonical is not None and project is not None:
+        resolved = project.resolve_name(canonical)
+        if resolved is not None and resolved[1]:
+            target_mod = project.modules.get(resolved[0])
+            if target_mod is not None and target_mod is not mod:
+                dmap = _project_donation_map(project, resolved[0],
+                                             target_mod)
+                # exact qualname only: a bare-name fallback would let
+                # B.step inherit A.step's donation (error-severity FP)
+                nums = dmap.get(resolved[1])
+                if nums:
+                    return DonatingCall(resolved[1], nums)
+    return None
+
+
+def _project_donation_map(project, mod_name: str,
+                          mod: ModuleInfo) -> Dict[str, FrozenSet[int]]:
+    cache = getattr(project, "_donation_maps", None)
+    if cache is None:
+        cache = {}
+        project._donation_maps = cache
+    if mod_name not in cache:
+        cache[mod_name] = module_donation_map(mod)
+    return cache[mod_name]
+
+
+def _callee_label(call: ast.Call) -> str:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return "<dispatch>"
+
+
+def _key_of(node: ast.AST) -> Optional[str]:
+    """Dotted key for a Name / self-rooted Attribute chain, else None."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if not isinstance(cur, ast.Name):
+        return None
+    parts.append(cur.id)
+    return ".".join(reversed(parts))
+
+
+def _loads_of(node: ast.AST, key: str) -> Optional[ast.AST]:
+    """First Load of `key` inside `node` (nested defs excluded)."""
+    for sub in _walk_no_defs(node):
+        if isinstance(sub, ast.Name) and "." not in key \
+                and sub.id == key and isinstance(sub.ctx, ast.Load):
+            return sub
+        if isinstance(sub, ast.Attribute) and "." in key \
+                and isinstance(sub.ctx, ast.Load) \
+                and _key_of(sub) == key:
+            return sub
+    return None
+
+
+def _target_is_key(t: ast.AST, key: str) -> bool:
+    if isinstance(t, ast.Tuple):
+        return any(_target_is_key(e, key) for e in t.elts)
+    return _key_of(t) == key
+
+
+class _PathScan:
+    """Ordered use-before-kill scan over statement blocks."""
+
+    def scan_block(self, stmts: List[ast.stmt],
+                   key: str) -> Tuple[Optional[ast.AST], bool]:
+        """(first use, killed-on-all-paths) for a statement sequence."""
+        for s in stmts:
+            use, killed = self.scan_stmt(s, key)
+            if use is not None:
+                return use, False
+            if killed:
+                return None, True
+        return None, False
+
+    def scan_stmt(self, s: ast.stmt,
+                  key: str) -> Tuple[Optional[ast.AST], bool]:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            return None, False
+        if isinstance(s, ast.Assign):
+            use = _loads_of(s.value, key)
+            if use is None:
+                for t in s.targets:  # a[key] = ... reads key
+                    for sub in ast.walk(t):
+                        if isinstance(sub, (ast.Subscript, ast.Call)):
+                            use = _loads_of(sub, key)
+                            if use is not None:
+                                break
+                    if use is not None:
+                        break
+            killed = any(_target_is_key(t, key) for t in s.targets)
+            return use, (killed and use is None)
+        if isinstance(s, ast.AnnAssign):
+            use = _loads_of(s.value, key) if s.value is not None else None
+            return use, (use is None and _target_is_key(s.target, key))
+        if isinstance(s, ast.AugAssign):
+            if _target_is_key(s.target, key):
+                return s.target, False  # read-modify-write: a use
+            return _loads_of(s.value, key), False
+        if isinstance(s, ast.If):
+            use = _loads_of(s.test, key)
+            if use is not None:
+                return use, False
+            u1, k1 = self.scan_block(s.body, key)
+            u2, k2 = self.scan_block(s.orelse, key)
+            use = u1 if u1 is not None else u2
+            return use, (use is None and k1 and k2 and bool(s.orelse))
+        if isinstance(s, (ast.For, ast.AsyncFor)):
+            use = _loads_of(s.iter, key)
+            if use is not None:
+                return use, False
+            if _target_is_key(s.target, key):
+                return None, False  # rebound each iteration
+            u, _k = self.scan_block(s.body, key)
+            if u is None:
+                u, _k = self.scan_block(s.orelse, key)
+            return u, False  # loop may run zero times: never a kill
+        if isinstance(s, ast.While):
+            use = _loads_of(s.test, key)
+            if use is not None:
+                return use, False
+            u, _k = self.scan_block(s.body, key)
+            return u, False
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            killed = False
+            for item in s.items:
+                use = _loads_of(item.context_expr, key)
+                if use is not None:
+                    return use, False
+                if item.optional_vars is not None \
+                        and _target_is_key(item.optional_vars, key):
+                    killed = True
+            if killed:
+                return None, True
+            return self.scan_block(s.body, key)
+        if isinstance(s, ast.Try):
+            u_body, k_body = self.scan_block(s.body, key)
+            if u_body is not None:
+                return u_body, False
+            handlers_ok = True
+            for h in self.handlers_of(s):
+                u, k = self.scan_block(h.body, key)
+                if u is not None:
+                    return u, False
+                # a handler path needs no kill if it cannot fall
+                # through (raise/return/continue/break terminal)
+                if not (k or self._terminates(h.body)):
+                    handlers_ok = False
+            u_else, k_else = self.scan_block(s.orelse, key)
+            if u_else is not None:
+                return u_else, False
+            u_fin, k_fin = self.scan_block(s.finalbody, key)
+            if u_fin is not None:
+                return u_fin, False
+            if k_fin:
+                return None, True   # finally runs on every path
+            # the success path kills via the body or its else; the
+            # exception path needs every handler to kill or be unable
+            # to fall through (the exception may have fired BEFORE the
+            # body's kill completed)
+            return None, ((k_body or k_else) and handlers_ok)
+
+        # Return / Expr / Raise / Assert / Delete / ...
+        return _loads_of(s, key), False
+
+    @staticmethod
+    def handlers_of(s: ast.Try):
+        return s.handlers
+
+    @staticmethod
+    def _terminates(stmts: List[ast.stmt]) -> bool:
+        return bool(stmts) and isinstance(
+            stmts[-1], (ast.Raise, ast.Return, ast.Continue, ast.Break))
+
+
+def _iter_blocks(fn: ast.AST) -> Iterator[List[ast.stmt]]:
+    """Every statement list lexically inside `fn` (nested defs excluded),
+    outermost first."""
+    queue: List[List[ast.stmt]] = [fn.body]
+    while queue:
+        block = queue.pop(0)
+        yield block
+        for s in block:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(s, attr, None)
+                if sub:
+                    queue.append(sub)
+            for h in getattr(s, "handlers", []):
+                queue.append(h.body)
+
+
+class DonationUseAfterConsumeRule(Rule):
+    id = "donation-use-after-consume"
+    severity = SEVERITY_ERROR
+    description = ("a value passed to a donate_argnums/donate_state=True "
+                   "dispatch is read, returned, or re-dispatched after "
+                   "the dispatch consumed its buffers (the PR 10 "
+                   "decode_retry class)")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        yield from self.check_project(mod, None)
+
+    def check_project(self, mod: ModuleInfo, project) -> Iterator[Finding]:
+        from deeplearning4j_tpu.analysis.project import iter_functions
+        dmap = module_donation_map(mod)
+        scanner = _PathScan()
+        for _qual, fn in iter_functions(mod):
+            # names bound in enclosing functions are visible here
+            # (closure scoping), innermost binding shadowing outward
+            merged = dict(dmap)
+            for scope in reversed(list(mod.enclosing_functions(fn))):
+                merged.update(function_donation_map(mod, scope))
+            merged.update(function_donation_map(mod, fn))
+            yield from self._check_function(mod, fn, merged, scanner,
+                                            project)
+
+    # -- per-function shapes -------------------------------------------
+    def _check_function(self, mod: ModuleInfo, fn: ast.AST,
+                        dmap: Dict[str, FrozenSet[int]],
+                        scanner: _PathScan, project) -> Iterator[Finding]:
+        flagged_keys: Set[str] = set()
+        donating: List[Tuple[ast.Call, DonatingCall, ast.stmt]] = []
+        # shape 1: sequence scan per block. Only SIMPLE statements are
+        # consumption points here: a donating call nested in a compound
+        # statement is processed when its own (inner) block comes up, so
+        # a rebinding inside the compound (``for x in xs: state =
+        # step(state, x)``) cannot be misread as a use-after-consume by
+        # the outer sequence. Calls in compound HEADERS (an ``if
+        # step(...):`` test) are out of scope — documented
+        # under-approximation.
+        simple = (ast.Assign, ast.AnnAssign, ast.AugAssign, ast.Expr,
+                  ast.Return, ast.Raise, ast.Assert, ast.Delete)
+        for block in _iter_blocks(fn):
+            for i, stmt in enumerate(block):
+                if not isinstance(stmt, simple):
+                    continue
+                for call in self._calls_in(stmt):
+                    don = classify_donating_call(mod, call, dmap,
+                                                 project=project)
+                    if don is None:
+                        continue
+                    donating.append((call, don, stmt))
+                    if don.positions == STATE:
+                        continue
+                    for pos in sorted(don.positions):
+                        if pos >= len(call.args):
+                            continue
+                        key = _key_of(call.args[pos])
+                        if key is None or key in flagged_keys:
+                            continue
+                        if self._stmt_rebinds(stmt, key):
+                            continue  # x = dispatch(x): the refresh idiom
+                        use, _killed = scanner.scan_block(
+                            block[i + 1:], key)
+                        if use is not None:
+                            flagged_keys.add(key)
+                            yield self.finding(
+                                mod, use,
+                                f"'{key}' read after being donated to "
+                                f"{don.label}() (donate_argnums={pos}): "
+                                f"the dispatch consumed its buffers — "
+                                f"reassign '{key}' from the dispatch "
+                                f"result before any later use, or copy "
+                                f"before donating")
+        # shape 2: re-dispatch in a loop without rebinding
+        for call, don, _stmt in donating:
+            if don.positions == STATE:
+                continue
+            loop = self._innermost_loop(mod, call, fn)
+            if loop is None:
+                continue
+            for pos in sorted(don.positions):
+                if pos >= len(call.args):
+                    continue
+                key = _key_of(call.args[pos])
+                if key is None or key in flagged_keys:
+                    continue
+                if not self._rebound_in(loop, key):
+                    flagged_keys.add(key)
+                    yield self.finding(
+                        mod, call,
+                        f"donating dispatch {don.label}() re-reads "
+                        f"'{key}' on the next loop iteration: the first "
+                        f"iteration consumed its buffers and '{key}' is "
+                        f"never rebound in the loop body")
+        # shape 3: donating dispatch inside a retried callable
+        yield from self._retry_shape(mod, fn, dmap, project)
+
+    # -- helpers -------------------------------------------------------
+    @staticmethod
+    def _calls_in(stmt: ast.stmt) -> Iterator[ast.Call]:
+        for sub in _walk_no_defs(stmt):
+            if isinstance(sub, ast.Call):
+                yield sub
+
+    @staticmethod
+    def _stmt_rebinds(stmt: ast.stmt, key: str) -> bool:
+        if isinstance(stmt, ast.Assign):
+            return any(_target_is_key(t, key) for t in stmt.targets)
+        if isinstance(stmt, ast.AnnAssign):
+            return _target_is_key(stmt.target, key)
+        return False
+
+    @staticmethod
+    def _innermost_loop(mod: ModuleInfo, node: ast.AST,
+                        fn: ast.AST) -> Optional[ast.AST]:
+        for anc in mod.ancestors(node):
+            if anc is fn:
+                return None
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return None  # nested def: a different execution context
+            if isinstance(anc, (ast.For, ast.AsyncFor, ast.While)):
+                return anc
+        return None
+
+    @staticmethod
+    def _rebound_in(loop: ast.AST, key: str) -> bool:
+        for sub in _walk_no_defs(loop):
+            if isinstance(sub, ast.Assign) \
+                    and any(_target_is_key(t, key) for t in sub.targets):
+                return True
+            if isinstance(sub, (ast.For, ast.AsyncFor)) \
+                    and _target_is_key(sub.target, key):
+                return True
+            if isinstance(sub, ast.withitem) \
+                    and sub.optional_vars is not None \
+                    and _target_is_key(sub.optional_vars, key):
+                return True
+        return False
+
+    def _retry_shape(self, mod: ModuleInfo, fn: ast.AST,
+                     dmap: Dict[str, FrozenSet[int]],
+                     project) -> Iterator[Finding]:
+        # nested callables defined anywhere in this function
+        nested: Dict[str, ast.AST] = {}
+        for child in ast.walk(fn):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and child is not fn:
+                nested[child.name] = child
+        for call in self._calls_in_fn(fn):
+            name = _callee_label(call)
+            if not _RETRY_NAME.search(name):
+                continue
+            for arg in list(call.args) + [kw.value for kw in call.keywords]:
+                cand: Optional[ast.AST] = None
+                if isinstance(arg, ast.Lambda):
+                    cand = arg
+                elif isinstance(arg, ast.Name) and arg.id in nested:
+                    cand = nested[arg.id]
+                if cand is None:
+                    continue
+                don = self._donating_inside(mod, cand, dmap, project)
+                if don is None:
+                    continue
+                don_call, don_info = don
+                yield self.finding(
+                    mod, call,
+                    f"donating dispatch {don_info.label}() (line "
+                    f"{don_call.lineno}) runs inside a callable passed "
+                    f"to {name}(): a retried attempt re-runs against "
+                    f"buffers the first attempt already consumed (the "
+                    f"PR 10 decode_retry bug) — disable donation "
+                    f"whenever a retry policy is configured, or "
+                    f"re-stage the donated inputs per attempt",
+                    chain=(f"{name}() at {mod.rel_path}:{call.lineno}",
+                           f"{don_info.label}() at "
+                           f"{mod.rel_path}:{don_call.lineno}"))
+                break
+
+    @staticmethod
+    def _calls_in_fn(fn: ast.AST) -> Iterator[ast.Call]:
+        for sub in _walk_no_defs(fn, include_self=False):
+            if isinstance(sub, ast.Call):
+                yield sub
+
+    def _donating_inside(self, mod: ModuleInfo, callable_node: ast.AST,
+                         dmap, project):
+        """A donating dispatch lexically inside a nested callable (its
+        own further-nested defs excluded), or reached through one
+        resolved project call (bounded: retries wrap thin closures)."""
+        body = callable_node.body
+        stmts = body if isinstance(body, list) else None
+        subs: List[ast.AST] = []
+        if stmts is not None:
+            for stmt in stmts:
+                subs.extend(_walk_no_defs(stmt))
+        else:  # Lambda: body is a bare expression
+            subs.extend(_walk_no_defs(body))
+        for sub in subs:
+            if not isinstance(sub, ast.Call):
+                continue
+            don = classify_donating_call(mod, sub, dmap, project=project)
+            if don is not None:
+                return sub, don
+            if project is not None:
+                target = project.resolve_call(mod, sub)
+                if target is not None:
+                    ev = project.callgraph.reaches(
+                        f"{target[0]}:{target[1]}",
+                        frozenset({"donating_dispatch"}), max_depth=2)
+                    if ev is not None:
+                        eff, _chain = ev
+                        return sub, DonatingCall(eff.what, frozenset())
+        return None

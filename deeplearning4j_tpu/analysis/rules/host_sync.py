@@ -9,16 +9,28 @@ one-batch-at-a-time lockstep, the exact failure mode the dispatch-
 pipelining literature (cuDNN-era stacks) warns about. Keep the steady
 state sync-free; materialize lazily, periodically, or after the final
 batch.
+
+Interprocedural promotion (ISSUE 13): the lexical check only sees syncs
+spelled INSIDE the hot body, but the ones that survive review hide two
+helper calls down. With a `ProjectInfo` available, a call in a hot
+region whose resolved callee (bounded-depth, see analysis/callgraph.py)
+transitively performs a sync is flagged AT THE CALL SITE with the callee
+chain in the message — the caller owns the hot loop, so the caller's
+line is where the fix (hoist / defer / cadence) lands. A justified
+inline suppression on the callee's sync line kills propagation for
+every caller; callees that are themselves hot-named are skipped here
+(they get their own body finding instead of one per caller).
 """
 
 from __future__ import annotations
 
 import ast
 import re
-from typing import Iterator
+from typing import Iterator, Optional, Tuple
 
 from deeplearning4j_tpu.analysis.core import (
     Finding, ModuleInfo, Rule, SEVERITY_ERROR)
+from deeplearning4j_tpu.analysis.rules._common import module_calls
 
 #: function bodies that ARE the per-batch hot path: any sync in them runs
 #: once per training batch even though the loop lives in the caller
@@ -61,52 +73,111 @@ def _scalar_cast_is_benign(arg: ast.AST) -> bool:
     return False
 
 
+def classify_sync(mod: ModuleInfo, node: ast.Call,
+                  strong_only: bool = False
+                  ) -> Tuple[Optional[str], Optional[str]]:
+    """(what, why) when a call is a device->host sync, (None, None)
+    otherwise. Shared by the lexical rule and the call-graph effect
+    summaries so both halves agree on what a sync IS.
+
+    `strong_only=True` (the summary mode) keeps only the unambiguous
+    signals — device_get / block_until_ready / .item() / .tolist() /
+    np.asarray — and drops the bare ``float()``/``int()`` cast
+    heuristic: inside a hot body the common operand is a device loss,
+    but across arbitrary helper bodies a float cast is usually plain
+    host arithmetic, and propagating that guess to every caller would
+    drown the signal."""
+    resolved = mod.resolve(node.func)
+    if resolved in _SYNC_CALLS:
+        # np.asarray of a literal host container builds a host array
+        # from host data — no device value can be involved
+        if resolved.startswith("numpy.") and node.args \
+                and isinstance(node.args[0], _HOST_CONTAINERS):
+            return None, None
+        return f"{resolved}()", _SYNC_CALLS[resolved]
+    if isinstance(node.func, ast.Attribute) \
+            and node.func.attr in _SYNC_METHODS and not node.args:
+        return f".{node.func.attr}()", _SYNC_METHODS[node.func.attr]
+    if not strong_only and resolved in ("float", "int") \
+            and len(node.args) == 1 and not node.keywords \
+            and not _scalar_cast_is_benign(node.args[0]):
+        return f"{resolved}()", "materializes a device scalar on host"
+    return None, None
+
+
+def hot_region(mod: ModuleInfo, node: ast.AST) -> Optional[str]:
+    """The hot region a node sits in (fit/serve heat model), or None:
+    per-batch-named bodies are hot everywhere; in fit/train-shaped
+    functions only code lexically inside a loop is hot."""
+    for fn in mod.enclosing_functions(node):
+        if _PER_BATCH_FN.match(fn.name):
+            return f"per-batch path '{fn.name}'"
+        if _LOOP_FN.match(fn.name) and mod.inside_loop(node, within=fn):
+            return f"loop in '{fn.name}'"
+    return None
+
+
+def is_hot_named(name: str) -> bool:
+    return bool(_PER_BATCH_FN.match(name) or _LOOP_FN.match(name))
+
+
 class HostSyncRule(Rule):
     id = "host-sync-in-hot-loop"
     severity = SEVERITY_ERROR
     description = ("device->host sync (.item()/float()/np.asarray/"
                    "device_get/block_until_ready) inside a fit/serve hot "
-                   "path serializes async dispatch")
+                   "path serializes async dispatch — including syncs "
+                   "reached through helper calls (project mode)")
 
     def _classify(self, mod: ModuleInfo, node: ast.Call):
-        resolved = mod.resolve(node.func)
-        if resolved in _SYNC_CALLS:
-            # np.asarray of a literal host container builds a host array
-            # from host data — no device value can be involved
-            if resolved.startswith("numpy.") and node.args \
-                    and isinstance(node.args[0], _HOST_CONTAINERS):
-                return None, None
-            return f"{resolved}()", _SYNC_CALLS[resolved]
-        if isinstance(node.func, ast.Attribute) \
-                and node.func.attr in _SYNC_METHODS and not node.args:
-            return f".{node.func.attr}()", _SYNC_METHODS[node.func.attr]
-        if resolved in ("float", "int") and len(node.args) == 1 \
-                and not node.keywords \
-                and not _scalar_cast_is_benign(node.args[0]):
-            return f"{resolved}()", "materializes a device scalar on host"
-        return None, None
+        return classify_sync(mod, node)
 
     def check(self, mod: ModuleInfo) -> Iterator[Finding]:
         if not mod.imports_module("jax"):
             return  # pure-host module: np.asarray/float() cannot sync
-        for node in ast.walk(mod.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in module_calls(mod):
             what, why = self._classify(mod, node)
             if what is None:
                 continue
-            for fn in mod.enclosing_functions(node):
-                if _PER_BATCH_FN.match(fn.name):
-                    hot, where = True, f"per-batch path '{fn.name}'"
-                elif _LOOP_FN.match(fn.name) and mod.inside_loop(node,
-                                                                 within=fn):
-                    hot, where = True, f"loop in '{fn.name}'"
-                else:
-                    continue
-                if hot:
-                    yield self.finding(
-                        mod, node,
-                        f"{what} in {where} {why}; keep the steady state "
-                        f"sync-free (defer to access / every N batches / "
-                        f"after the final batch)")
-                    break
+            where = hot_region(mod, node)
+            if where is None:
+                continue
+            yield self.finding(
+                mod, node,
+                f"{what} in {where} {why}; keep the steady state "
+                f"sync-free (defer to access / every N batches / "
+                f"after the final batch)")
+
+    # -- interprocedural promotion -------------------------------------
+    def check_project(self, mod: ModuleInfo, project) -> Iterator[Finding]:
+        yield from self.check(mod)
+        if project is None:
+            return
+        from deeplearning4j_tpu.analysis.callgraph import EFFECT_HOST_SYNC
+        cg = project.callgraph
+        kinds = frozenset({EFFECT_HOST_SYNC})
+        for node in module_calls(mod):
+            if classify_sync(mod, node)[0] is not None:
+                continue  # lexical finding already covers it
+            where = hot_region(mod, node)
+            if where is None:
+                continue
+            target = project.resolve_call(mod, node)
+            if target is None:
+                continue
+            mod_name, qual = target
+            if is_hot_named(qual.rsplit(".", 1)[-1]):
+                continue  # the callee body is hot itself: flagged there
+            evidence = cg.reaches(f"{mod_name}:{qual}", kinds)
+            if evidence is None:
+                continue
+            effect, chain = evidence
+            yield self.finding(
+                mod, node,
+                f"call to '{qual}' in {where} reaches a device->host "
+                f"sync: {cg.render_chain(chain, effect)} — "
+                f"{effect.why}; hoist the sync out of the hot path or "
+                f"run it at a cadence (suppress at the callee's sync "
+                f"line if the contract is deliberate)",
+                chain=chain + (f"{effect.what} at "
+                               f"{effect.path}:{effect.line}",))

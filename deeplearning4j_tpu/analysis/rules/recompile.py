@@ -9,20 +9,16 @@ counting actual recompiles — is the PR 1 jit watcher):
   and non-literal specs that may vary call-to-call;
 - value-dependent Python control flow (`if x > 0:`, f-strings on traced
   params) inside a staged function either concretizes the tracer or
-  recompiles per value when the arg is marked static;
-- `os.environ` reads inside a step-builder / plan-resolution body: the
-  env value is baked into the trace at build time but is NOT part of
-  any jit key, so flipping it mid-process silently keeps the stale
-  compiled step — or, when callers key caches on it, retraces on every
-  flip. The BENCH_FUSE→execution_plan migration removed exactly this
-  class; plans resolve from explicit arguments at the API boundary
-  (tuning/plan.py), never from env inside a builder.
+  recompiles per value when the arg is marked static.
+
+The PR 11 env-read-in-step-builder check moved to `jit-key-drift`
+(rules/jit_key.py), which generalizes it to every kind of process-wide
+mutable state read outside the jit cache key.
 """
 
 from __future__ import annotations
 
 import ast
-import re
 from typing import Iterator, Set
 
 from deeplearning4j_tpu.analysis.core import (
@@ -72,17 +68,10 @@ class RecompileHazardRule(Rule):
                    "dependent Python control flow on traced args defeats "
                    "the jit cache")
 
-    #: function names that ARE plan-resolution / step-builder seams even
-    #: when the jit construction lives in a helper they call
-    _STEP_BUILDER_NAME = re.compile(
-        r"^(_get_\w*_(step|steps|fn)|resolve_\w+|apply_execution_plan"
-        r"|set_fusion\w*)$")
-
     def check(self, mod: ModuleInfo) -> Iterator[Finding]:
         yield from self._jit_in_loop(mod)
         yield from self._static_specs(mod)
         yield from self._traced_branches(mod)
-        yield from self._env_in_step_builders(mod)
 
     # -- jit built inside a loop --------------------------------------
     def _jit_in_loop(self, mod: ModuleInfo) -> Iterator[Finding]:
@@ -122,48 +111,6 @@ class RecompileHazardRule(Rule):
                         f"a spec that varies call-to-call recompiles per "
                         f"value — prefer a literal tuple")
 
-    # -- env reads inside step-builder / plan-resolution bodies -------
-    @staticmethod
-    def _is_env_read(mod: ModuleInfo, node: ast.AST) -> bool:
-        if isinstance(node, ast.Call):
-            fn = mod.resolve(node.func)
-            if fn == "os.getenv":
-                return True
-            if isinstance(node.func, ast.Attribute) \
-                    and node.func.attr == "get" \
-                    and mod.resolve(node.func.value) == "os.environ":
-                return True
-        if isinstance(node, ast.Subscript) \
-                and mod.resolve(node.value) == "os.environ":
-            return True
-        return False
-
-    def _env_in_step_builders(self, mod: ModuleInfo) -> Iterator[Finding]:
-        seen: Set[int] = set()   # env-read nodes already reported (a
-        # nested jit-building closure inside a named builder is walked
-        # from both functions — one finding per read, not two)
-        for fn in ast.walk(mod.tree):
-            if not isinstance(fn, (ast.FunctionDef,
-                                   ast.AsyncFunctionDef)):
-                continue
-            named = bool(self._STEP_BUILDER_NAME.match(fn.name))
-            builds_jit = any(
-                isinstance(n, ast.Call) and _is_tracing_wrapper(mod, n)
-                for n in ast.walk(fn))
-            if not (named or builds_jit):
-                continue
-            for n in ast.walk(fn):
-                if self._is_env_read(mod, n) and id(n) not in seen:
-                    seen.add(id(n))
-                    yield self.finding(
-                        mod, n,
-                        f"os.environ read inside step-builder "
-                        f"'{fn.name}': the value bakes into the trace "
-                        f"but is not part of any jit key — flipping it "
-                        f"keeps a stale compiled step (or retraces per "
-                        f"flip); resolve it to an explicit argument at "
-                        f"the API boundary")
-                    break  # one finding per builder is enough signal
     def _traced_branches(self, mod: ModuleInfo) -> Iterator[Finding]:
         for fn, jit_call in collect_jit_functions(mod).items():
             params = traced_param_names(mod, fn, jit_call)

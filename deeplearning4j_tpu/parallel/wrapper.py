@@ -106,6 +106,10 @@ class ParallelWrapper:
         duplicate-padding would silently over-weight the repeated sample in
         the gradient). Batches smaller than the mesh still pad by repetition
         as the only way to occupy every device; that case is logged once."""
+        # host-only by caller contract: _shard_batch/_shard_stack return
+        # device (prefetched) arrays untouched before reaching this, so
+        # this asarray never sees a device value
+        # tpulint: disable=host-sync-in-hot-loop
         arr = np.asarray(arr)
         n = arr.shape[0]
         rem = n % self.n_devices
@@ -157,6 +161,9 @@ class ParallelWrapper:
             return arr
         arr = self._host_trim(arr)
         sh = NamedSharding(self.mesh, P("data", *([None] * (arr.ndim - 1))))
+        # the SPMD jit-boundary copy of the UNPREFETCHED compat path:
+        # fit(device_prefetch=True) moves this into the background worker
+        # tpulint: disable=device-transfer-in-hot-loop
         return jax.device_put(arr, sh)
 
     def _shard_stack(self, arrs):
@@ -169,6 +176,9 @@ class ParallelWrapper:
         a = np.stack([self._host_trim(x) for x in arrs])
         sh = NamedSharding(self.mesh,
                            P(None, "data", *([None] * (a.ndim - 2))))
+        # same unprefetched-compat jit-boundary copy as _shard_batch,
+        # fused to ONE put for the K-step group
+        # tpulint: disable=device-transfer-in-hot-loop
         return jax.device_put(a, sh)
 
     def _effective_examples(self, ds: DataSet) -> int:

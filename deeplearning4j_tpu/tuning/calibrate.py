@@ -52,6 +52,10 @@ def calibrate_training_kernels(
     from deeplearning4j_tpu.nn.layers.stem import (
         fused_stem, reference_stem)
 
+    # the store is this harness's OUTPUT sink (measurements are written
+    # into it), not a knob baked into a cached trace: every timed jit
+    # here is built fresh per call and discarded
+    # tpulint: disable=jit-key-drift
     store = default_store() if store is None else store
     dtype = _net_dtype(net)
     jdt = _jdtype(dtype)

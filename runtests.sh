@@ -7,20 +7,32 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
-# tier-1 lint lane: tpulint static analysis (analysis/). Pure-AST, runs
-# in ~1s with no devices; any finding beyond the committed
-# TPULINT_BASELINE.json (new host sync in a fit loop, tracer leak,
-# recompile hazard, f64 promotion, unlocked thread state, hygiene) exits
-# nonzero and fails the run before a single test executes.
-tpulint_out="$(mktemp -t tpulint.XXXXXX.json)"
+# tier-1 lint lane: tpulint whole-program static analysis (analysis/).
+# Pure-AST, no devices. O(diff) by default: rules run only on modules
+# changed vs the merge-base with $TPULINT_BASE (default origin/main,
+# working tree included) while the ProjectInfo layer still spans the
+# full tree, so interprocedural findings in changed callers see
+# unchanged callees' summaries. TPULINT_FULL=1 — the nightly/verify
+# path — or a missing base ref falls back to the full scan. Either way
+# the TPULINT_BASELINE.json ratchet gates (new findings AND stale
+# baseline entries are hard failures), and the scanned-module count is
+# printed so the O(diff) behavior stays observable.
+tpulint_base="${TPULINT_BASE:-origin/main}"
+tpulint_args=()
+if [ "${TPULINT_FULL:-0}" != "1" ] \
+    && git rev-parse --verify -q "${tpulint_base}^{commit}" >/dev/null; then
+  tpulint_args+=(--diff "$tpulint_base")
+fi
+tpulint_out="$(mktemp -t tpulint.XXXXXX.txt)"
 if ! python -m deeplearning4j_tpu.analysis deeplearning4j_tpu \
-        --format=json --baseline=TPULINT_BASELINE.json \
-        > "$tpulint_out"; then
-  echo "tpulint: NEW findings (see $tpulint_out):" >&2
-  python -m deeplearning4j_tpu.analysis deeplearning4j_tpu \
-      --baseline=TPULINT_BASELINE.json >&2 || true
+        --baseline=TPULINT_BASELINE.json \
+        ${tpulint_args[@]+"${tpulint_args[@]}"} \
+        > "$tpulint_out" 2>&1; then
+  echo "tpulint: gate FAILED (new findings or stale baseline):" >&2
+  cat "$tpulint_out" >&2
   exit 1
 fi
+tail -n 2 "$tpulint_out"   # findings summary + scanned-module count
 
 # tier-1 observability lane: the telemetry subsystem (monitoring/) gates
 # everything else — run it first, fast and standalone, so a broken

@@ -319,11 +319,38 @@ class TestRecompileHazardRule:
         """)
         assert fs == []
 
+    def test_negative_cached_jit_outside_loop(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import jax
+
+            def get_step(cache, fn):
+                if "step" not in cache:
+                    cache["step"] = jax.jit(fn, static_argnums=(2,))
+                return cache["step"]
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------
+# rule: jit-key-drift (ISSUE 13 — generalizes PR 11's env-read case)
+# ---------------------------------------------------------------------
+def _scan_project(tmp_path, files, rules=None):
+    """Write a multi-module fixture project and scan it whole-program
+    (ProjectInfo built over the directory)."""
+    for name, src in files.items():
+        p = tmp_path / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return scan_paths([str(tmp_path)], rules=rules, root=str(tmp_path))
+
+
+class TestJitKeyDriftRule:
     def test_positive_env_read_in_jit_building_step_builder(
             self, tmp_path):
-        """ISSUE 11: os.environ resolved inside a step-builder body —
-        the value bakes into the trace but sits in no jit key, so a
-        flip keeps the stale compiled step (the BENCH_FUSE class)."""
+        """ISSUE 11 (migrated from recompile-hazard): os.environ
+        resolved inside a step-builder body — the value bakes into the
+        trace but sits in no jit key, so a flip keeps the stale
+        compiled step (the BENCH_FUSE class)."""
         fs = _scan_snippet(tmp_path, """
             import os
             import jax
@@ -337,7 +364,7 @@ class TestRecompileHazardRule:
 
                     return jax.jit(step)
         """)
-        assert _rules_of(fs) == ["recompile-hazard"]
+        assert _rules_of(fs) == ["jit-key-drift"]
         assert "os.environ read inside step-builder" in fs[0].message
 
     def test_positive_env_read_in_plan_resolution_name(self, tmp_path):
@@ -349,7 +376,7 @@ class TestRecompileHazardRule:
             def resolve_plan(net):
                 return os.getenv("MY_PLAN", "xla")
         """)
-        assert _rules_of(fs) == ["recompile-hazard"]
+        assert _rules_of(fs) == ["jit-key-drift"]
 
     def test_positive_env_subscript_in_step_builder(self, tmp_path):
         fs = _scan_snippet(tmp_path, """
@@ -360,7 +387,7 @@ class TestRecompileHazardRule:
                 impl = os.environ["MY_IMPL"]
                 return jax.jit(lambda x: x)
         """)
-        assert _rules_of(fs) == ["recompile-hazard"]
+        assert _rules_of(fs) == ["jit-key-drift"]
 
     def test_negative_env_read_outside_builders(self, tmp_path):
         """Env reads at module scope or in ordinary config functions are
@@ -379,15 +406,757 @@ class TestRecompileHazardRule:
         """)
         assert fs == []
 
-    def test_negative_cached_jit_outside_loop(self, tmp_path):
+    def test_positive_mutable_global_unkeyed(self, tmp_path):
+        """A set_*-seam module global read in a jit-building body
+        without entering the cache key: the trace bakes it in."""
         fs = _scan_snippet(tmp_path, """
             import jax
 
-            def get_step(cache, fn):
-                if "step" not in cache:
-                    cache["step"] = jax.jit(fn, static_argnums=(2,))
-                return cache["step"]
+            _IMPL = "xla"
+
+            def set_impl(v):
+                global _IMPL
+                _IMPL = v
+
+            def build_step(net):
+                impl = _IMPL
+                def step(p):
+                    return p if impl == "xla" else -p
+                return jax.jit(step)
         """)
+        assert _rules_of(fs) == ["jit-key-drift"]
+        assert "mutable global" in fs[0].message
+
+    def test_negative_mutable_global_in_cache_key(self, tmp_path):
+        """The sanctioned pattern (the repo's _STREAM_CACHE_SHARDING /
+        _PAGED_DECODE_IMPL idiom): the read lands in the jit cache key,
+        so flipping the seam retraces instead of staling."""
+        fs = _scan_snippet(tmp_path, """
+            import jax
+
+            _IMPL = "xla"
+
+            def set_impl(v):
+                global _IMPL
+                _IMPL = v
+
+            def build_step(net, cache):
+                key = ("step", _IMPL)
+                if key not in cache:
+                    impl = _IMPL  # same global, keyed above: exempt
+                    def step(p):
+                        return p if impl == "xla" else -p
+                    cache[key] = jax.jit(step)
+                return cache[key]
+        """)
+        assert fs == []
+
+    def test_negative_immutable_global_is_config(self, tmp_path):
+        """A module constant nobody rebinds via ``global`` is
+        configuration, not process-wide mutable state."""
+        fs = _scan_snippet(tmp_path, """
+            import jax
+
+            _DEFAULT = "xla"
+
+            def build_step(net):
+                impl = _DEFAULT
+                def step(p):
+                    return p if impl == "xla" else -p
+                return jax.jit(step)
+        """)
+        assert fs == []
+
+    def test_positive_cross_module_accessor(self, tmp_path):
+        """A builder calling another module's accessor over a mutable
+        global: flagged through the project layer."""
+        fs = _scan_project(tmp_path, {
+            "seam.py": """
+                _IMPL = ("xla", False)
+
+                def set_impl(v):
+                    global _IMPL
+                    _IMPL = (v, False)
+
+                def impl():
+                    return _IMPL
+            """,
+            "net.py": """
+                import jax
+                from seam import impl
+
+                def _get_decode_fn(net):
+                    mode = impl()
+                    def step(x):
+                        return x
+                    return jax.jit(step)
+            """,
+        })
+        assert _rules_of(fs) == ["jit-key-drift"]
+        assert "accessor 'impl()'" in fs[0].message
+
+    def test_negative_cross_module_accessor_keyed(self, tmp_path):
+        fs = _scan_project(tmp_path, {
+            "seam.py": """
+                _IMPL = ("xla", False)
+
+                def set_impl(v):
+                    global _IMPL
+                    _IMPL = (v, False)
+
+                def impl():
+                    return _IMPL
+            """,
+            "net.py": """
+                import jax
+                from seam import impl
+
+                def _get_decode_fn(net, cache):
+                    key = ("decode", impl())
+                    if key not in cache:
+                        cache[key] = jax.jit(lambda x: x)
+                    return cache[key]
+            """,
+        })
+        assert fs == []
+
+    def test_positive_construction_snapshot(self, tmp_path):
+        """The PR 10 health-accounting shape: __init__ snapshots a
+        process-wide accessor onto self while dispatches follow the
+        LIVE setting."""
+        fs = _scan_project(tmp_path, {
+            "seam.py": """
+                _IMPL = "xla"
+
+                def set_impl(v):
+                    global _IMPL
+                    _IMPL = v
+
+                def impl():
+                    return _IMPL
+            """,
+            "engine.py": """
+                from seam import impl
+
+                class Engine:
+                    def __init__(self):
+                        self._impl = impl()
+            """,
+        })
+        assert _rules_of(fs) == ["jit-key-drift"]
+        assert "construction-time snapshot" in fs[0].message
+
+    def test_negative_snapshot_in_owning_module_and_set_call(
+            self, tmp_path):
+        """The seam's own module wiring its default, and a WRITE through
+        the set_* seam, are the documented pattern."""
+        fs = _scan_project(tmp_path, {
+            "seam.py": """
+                _IMPL = "xla"
+
+                def set_impl(v):
+                    global _IMPL
+                    _IMPL = v
+
+                def impl():
+                    return _IMPL
+
+                class Local:
+                    def __init__(self):
+                        self._impl = impl()
+            """,
+            "engine.py": """
+                from seam import set_impl
+
+                class Engine:
+                    def __init__(self, impl_name):
+                        set_impl(impl_name)
+                        self._impl = impl_name
+            """,
+        })
+        assert fs == []
+
+
+# ---------------------------------------------------------------------
+# rule: donation-use-after-consume (ISSUE 13 — the PR 10 class)
+# ---------------------------------------------------------------------
+class TestDonationRule:
+    DONATING = """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(state, x):
+            return state + x
+    """
+
+    def test_positive_read_after_donate(self, tmp_path):
+        fs = _scan_snippet(tmp_path, self.DONATING + """
+            def run(state, x):
+                out = step(state, x)
+                return state + out
+        """)
+        assert _rules_of(fs) == ["donation-use-after-consume"]
+        assert "'state'" in fs[0].message
+
+    def test_positive_redispatch_after_donate(self, tmp_path):
+        fs = _scan_snippet(tmp_path, self.DONATING + """
+            def run(state, x):
+                a = step(state, x)
+                b = step(state, x)
+                return a, b
+        """)
+        assert _rules_of(fs) == ["donation-use-after-consume"]
+
+    def test_positive_self_attr_chain(self, tmp_path):
+        fs = _scan_snippet(tmp_path, self.DONATING + """
+            class Net:
+                def run(self, x):
+                    out = step(self._state, x)
+                    return self._state
+        """)
+        assert _rules_of(fs) == ["donation-use-after-consume"]
+
+    def test_positive_use_on_unreassigned_branch(self, tmp_path):
+        # the else path reaches the read with the buffer consumed:
+        # "any non-reassigned path" is the contract
+        fs = _scan_snippet(tmp_path, self.DONATING + """
+            def run(state, x, cond):
+                out = step(state, x)
+                if cond:
+                    state = out
+                return state
+        """)
+        assert _rules_of(fs) == ["donation-use-after-consume"]
+
+    def test_negative_reassigned_from_result(self, tmp_path):
+        fs = _scan_snippet(tmp_path, self.DONATING + """
+            def run(state, x):
+                state = step(state, x)
+                return state
+
+            def run_loop(state, xs):
+                for x in xs:
+                    state = step(state, x)
+                return state
+        """)
+        assert fs == []
+
+    def test_negative_killed_on_all_paths(self, tmp_path):
+        fs = _scan_snippet(tmp_path, self.DONATING + """
+            def run(state, x, cond):
+                out = step(state, x)
+                if cond:
+                    state = out
+                else:
+                    state = out * 2
+                return state
+        """)
+        assert fs == []
+
+    def test_positive_loop_redispatch_without_rebind(self, tmp_path):
+        fs = _scan_snippet(tmp_path, self.DONATING + """
+            def run(state, xs):
+                for x in xs:
+                    out = step(state, x)
+                return out
+        """)
+        assert _rules_of(fs) == ["donation-use-after-consume"]
+        assert "next loop iteration" in fs[0].message
+
+    def test_positive_retry_shape_pr10_regression(self, tmp_path):
+        """The minimized PR 10 decode_retry bug: a donate_state=True
+        dispatch inside the retried callable — a retried attempt re-runs
+        against consumed buffers. The fix shape (engine._donate) is
+        donation OFF whenever a retry policy is configured."""
+        fs = _scan_snippet(tmp_path, """
+            import jax
+            from mylib.retry import retry_call
+
+            class Engine:
+                def _dispatch_step(self, toks):
+                    def once():
+                        return self.net.rnn_time_step(
+                            toks, donate_state=True)
+                    return retry_call(once, policy=self._decode_retry)
+        """)
+        assert "donation-use-after-consume" in _rules_of(fs)
+        f = [x for x in fs if x.rule == "donation-use-after-consume"][0]
+        assert "retried" in f.message and "decode_retry" in f.message
+        assert f.chain  # callee chain rides into --json
+
+    def test_positive_retry_shape_donate_argnums_lambda(self, tmp_path):
+        fs = _scan_snippet(tmp_path, self.DONATING + """
+            def run(state, x, retry_call, policy):
+                return retry_call(lambda: step(state, x), policy)
+        """)
+        assert "donation-use-after-consume" in _rules_of(fs)
+
+    def test_negative_retry_without_donation(self, tmp_path):
+        """The FIXED engine shape: donation resolved off when a retry
+        policy exists (donate_state is a non-literal expression), so
+        the retried callable consumes nothing."""
+        fs = _scan_snippet(tmp_path, """
+            import jax
+            from mylib.retry import retry_call
+
+            class Engine:
+                def _dispatch_step(self, toks):
+                    def once():
+                        return self.net.rnn_time_step(
+                            toks, donate_state=self._donate)
+                    return retry_call(once, policy=self._decode_retry)
+        """)
+        assert fs == []
+
+    def test_cross_module_donating_jit(self, tmp_path):
+        """Import-alias resolution: the donating jit lives in another
+        module (the serving/paging.py scatter_pages shape)."""
+        fs = _scan_project(tmp_path, {
+            "paging.py": """
+                import jax
+                from functools import partial
+
+                @partial(jax.jit, donate_argnums=(0,))
+                def scatter(pool, dense):
+                    return pool + dense
+            """,
+            "engine.py": """
+                import jax
+                from paging import scatter
+
+                def commit(pool, dense):
+                    out = scatter(pool, dense)
+                    return pool
+            """,
+        })
+        assert _rules_of(fs) == ["donation-use-after-consume"]
+
+    def test_negative_same_named_nested_def_not_donating(self, tmp_path):
+        """A plain nested ``def step`` in one function must not inherit
+        donation from an unrelated function's donating nested ``step``
+        (function-local scoping of the donation map)."""
+        fs = _scan_snippet(tmp_path, """
+            import jax
+            from functools import partial
+
+            def builder():
+                @partial(jax.jit, donate_argnums=(0,))
+                def step(state, x):
+                    return state + x
+                return step
+
+            def other(state, xs):
+                def step(s, x):
+                    return s
+                out = step(state, xs)
+                return state
+        """)
+        assert fs == []
+
+    def test_positive_nested_donating_def_in_own_scope(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import jax
+            from functools import partial
+
+            def run(state, x):
+                @partial(jax.jit, donate_argnums=(0,))
+                def step(s, v):
+                    return s + v
+                out = step(state, x)
+                return state
+        """)
+        assert _rules_of(fs) == ["donation-use-after-consume"]
+
+    def test_negative_try_except_rebuild_kills(self, tmp_path):
+        """A reassignment inside try whose handler cannot fall through
+        (bare raise) kills on every continuing path — the repo's
+        recovery-path shape."""
+        fs = _scan_snippet(tmp_path, self.DONATING + """
+            def run(state, x, rebuild):
+                out = step(state, x)
+                try:
+                    state = rebuild(out)
+                except Exception:
+                    raise
+                return state
+        """)
+        assert fs == []
+
+    def test_positive_try_handler_falls_through_unkilled(self, tmp_path):
+        fs = _scan_snippet(tmp_path, self.DONATING + """
+            def run(state, x, rebuild, log):
+                out = step(state, x)
+                try:
+                    state = rebuild(out)
+                except Exception:
+                    log("rebuild failed")
+                return state
+        """)
+        assert _rules_of(fs) == ["donation-use-after-consume"]
+
+    def test_negative_same_named_plain_method_not_donating(self,
+                                                           tmp_path):
+        """A plain B.step must not inherit donation from an unrelated
+        donating A.step through a bare-name collision (class members
+        are keyed Class.name only)."""
+        fs = _scan_project(tmp_path, {
+            "lib.py": """
+                import jax
+                from functools import partial
+
+                class A:
+                    @partial(jax.jit, donate_argnums=(0,))
+                    def step(state, x):
+                        return state + x
+
+                class B:
+                    def step(self, b, state):
+                        return b
+            """,
+            "use.py": """
+                import jax
+                from lib import B
+
+                def run(b, state):
+                    out = B.step(b, state)
+                    return b
+            """,
+        })
+        assert fs == []
+
+    def test_negative_module_assigned_wrapper_refresh(self, tmp_path):
+        """``g = jax.jit(f, donate_argnums=...)`` binding form + the
+        refresh idiom stays clean."""
+        fs = _scan_snippet(tmp_path, """
+            import jax
+
+            def _upd(opt, grads):
+                return opt
+
+            fast_upd = jax.jit(_upd, donate_argnums=(0,))
+
+            def run(opt, grads):
+                opt = fast_upd(opt, grads)
+                return opt
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------
+# ProjectInfo / CallGraph (ISSUE 13 tentpole plumbing)
+# ---------------------------------------------------------------------
+class TestProjectInfo:
+    def _build(self, tmp_path, files):
+        from deeplearning4j_tpu.analysis.project import ProjectInfo
+        for name, src in files.items():
+            p = tmp_path / name
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(textwrap.dedent(src))
+        return ProjectInfo.build([str(tmp_path)], root=str(tmp_path))
+
+    def test_module_naming_and_packages(self, tmp_path):
+        proj = self._build(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/sub/__init__.py": "",
+            "pkg/sub/mod.py": "def f():\n    return 1\n",
+            "top.py": "X = 1\n",
+        })
+        assert set(proj.modules) == {"pkg", "pkg.sub", "pkg.sub.mod",
+                                     "top"}
+        assert proj.resolve_name("pkg.sub.mod.f") == ("pkg.sub.mod", "f")
+
+    def test_cross_module_alias_resolution(self, tmp_path):
+        proj = self._build(tmp_path, {
+            "b.py": "def helper(x):\n    return x\n",
+            "a.py": "import b as bee\n\ndef g(x):\n"
+                    "    return bee.helper(x)\n",
+        })
+        mod = proj.modules["a"]
+        import ast as _ast
+        call = next(n for n in _ast.walk(mod.tree)
+                    if isinstance(n, _ast.Call))
+        assert proj.resolve_call(mod, call) == ("b", "helper")
+
+    def test_reexport_chain_resolution(self, tmp_path):
+        proj = self._build(tmp_path, {
+            "b.py": "def helper(x):\n    return x\n",
+            "c.py": "from b import helper\n",
+            "a.py": "from c import helper\n\ndef g(x):\n"
+                    "    return helper(x)\n",
+        })
+        assert proj.resolve_name("c.helper") == ("b", "helper")
+        mod = proj.modules["a"]
+        import ast as _ast
+        call = next(n for n in _ast.walk(mod.tree)
+                    if isinstance(n, _ast.Call))
+        assert proj.resolve_call(mod, call) == ("b", "helper")
+
+    def test_reexport_cycle_is_bounded(self, tmp_path):
+        proj = self._build(tmp_path, {
+            "a.py": "from b import thing\n",
+            "b.py": "from a import thing\n",
+        })
+        assert proj.resolve_name("a.thing") is None  # no hang, no def
+
+    def test_import_graph(self, tmp_path):
+        proj = self._build(tmp_path, {
+            "a.py": "import b\nimport os\n",
+            "b.py": "import c\n",
+            "c.py": "",
+        })
+        g = proj.import_graph()
+        assert g["a"] == {"b"} and g["b"] == {"c"} and g["c"] == set()
+
+
+class TestCallGraph:
+    def _graph(self, tmp_path, files):
+        from deeplearning4j_tpu.analysis.project import ProjectInfo
+        for name, src in files.items():
+            (tmp_path / name).write_text(textwrap.dedent(src))
+        proj = ProjectInfo.build([str(tmp_path)], root=str(tmp_path))
+        return proj.callgraph
+
+    def test_direct_effect_summary(self, tmp_path):
+        cg = self._graph(tmp_path, {"m.py": """
+            import jax
+
+            def helper(x):
+                return jax.device_get(x)
+        """})
+        ev = cg.reaches("m:helper", frozenset({"host_sync"}))
+        assert ev is not None
+        effect, chain = ev
+        assert effect.what == "jax.device_get()" and chain == ("m:helper",)
+
+    def test_bounded_depth_cutoff(self, tmp_path):
+        src = """
+            import jax
+
+            def h1(x):
+                return h2(x)
+
+            def h2(x):
+                return h3(x)
+
+            def h3(x):
+                return h4(x)
+
+            def h4(x):
+                return jax.device_get(x)
+        """
+        cg = self._graph(tmp_path, {"m.py": src})
+        # h2 -> h3 -> h4: three hops, within the bound
+        assert cg.reaches("m:h2", frozenset({"host_sync"})) is not None
+        # h1 -> h2 -> h3 -> h4: four hops, beyond MAX_DEPTH=3
+        assert cg.reaches("m:h1", frozenset({"host_sync"})) is None
+
+    def test_cycle_between_modules_terminates(self, tmp_path):
+        cg = self._graph(tmp_path, {
+            "a.py": """
+                import jax
+                import b
+
+                def fa(x):
+                    return b.fb(x)
+            """,
+            "b.py": """
+                import jax
+                import a
+
+                def fb(x):
+                    a.fa(x)
+                    return jax.device_get(x)
+            """,
+        })
+        ev = cg.reaches("a:fa", frozenset({"host_sync"}))
+        assert ev is not None and ev[1] == ("a:fa", "b:fb")
+
+    def test_callee_suppression_kills_propagation(self, tmp_path):
+        cg = self._graph(tmp_path, {"m.py": """
+            import jax
+
+            def helper(x):
+                # contract: the ONE sanctioned end-of-fit barrier
+                # tpulint: disable=host-sync-in-hot-loop
+                return jax.device_get(x)
+        """})
+        assert cg.reaches("m:helper", frozenset({"host_sync"})) is None
+
+    def test_memo_guarded_transfer_not_an_effect(self, tmp_path):
+        """The cached-table idiom: a transfer behind an ``is None``
+        memo guard runs once per invalidation, not per call."""
+        cg = self._graph(tmp_path, {"m.py": """
+            import jax.numpy as jnp
+
+            class E:
+                def tables(self):
+                    if self._cache is None:
+                        self._cache = jnp.asarray(self._np())
+                    return self._cache
+
+                def fresh(self):
+                    return jnp.asarray(self._np())
+        """})
+        assert cg.reaches("m:E.tables",
+                          frozenset({"device_transfer"})) is None
+        assert cg.reaches("m:E.fresh",
+                          frozenset({"device_transfer"})) is not None
+
+
+# ---------------------------------------------------------------------
+# interprocedural promotion of the hot-loop rules (ISSUE 13 tentpole)
+# ---------------------------------------------------------------------
+class TestInterproceduralHostSync:
+    def test_helper_sync_flagged_at_call_site_with_chain(self, tmp_path):
+        fs = _scan_project(tmp_path, {
+            "util.py": """
+                import jax
+
+                def materialize(x):
+                    return jax.device_get(x)
+            """,
+            "net.py": """
+                import jax
+                from util import materialize
+
+                def fit(model, batches):
+                    for b in batches:
+                        loss = model.step(b)
+                        materialize(loss)
+            """,
+        })
+        assert _rules_of(fs) == ["host-sync-in-hot-loop"]
+        f = fs[0]
+        assert f.path == "net.py" and "materialize" in f.message
+        assert f.chain and "util.py" in f.chain[-1]
+
+    def test_two_hop_chain_through_self_method(self, tmp_path):
+        fs = _scan_project(tmp_path, {
+            "net.py": """
+                import jax
+
+                class Net:
+                    def _materialize(self, x):
+                        return jax.device_get(x)
+
+                    def _publish(self, x):
+                        return self._materialize(x)
+
+                    def _fit_batch(self, ds):
+                        loss = self.step(ds)
+                        self._publish(loss)
+            """,
+        })
+        assert _rules_of(fs) == ["host-sync-in-hot-loop"]
+        assert "Net._publish" in fs[0].message \
+            and "Net._materialize" in fs[0].message
+
+    def test_negative_clean_helper_and_cold_call_site(self, tmp_path):
+        fs = _scan_project(tmp_path, {
+            "util.py": """
+                import jax
+
+                def shapes(x):
+                    return x.shape
+
+                def materialize(x):
+                    return jax.device_get(x)
+            """,
+            "net.py": """
+                import jax
+                from util import materialize, shapes
+
+                def fit(model, batches):
+                    for b in batches:
+                        shapes(b)          # clean helper: no finding
+                    return materialize(model.params)  # after the loop
+            """,
+        })
+        assert fs == []
+
+    def test_negative_hot_named_callee_not_doubled(self, tmp_path):
+        """A helper that is itself hot-named gets its own body finding;
+        the call site must not add a second one."""
+        fs = _scan_project(tmp_path, {
+            "net.py": """
+                import jax
+
+                class Net:
+                    def _fit_batch(self, ds):
+                        return float(self.step(ds))
+
+                    def fit(self, batches):
+                        for b in batches:
+                            self._fit_batch(b)
+            """,
+        })
+        assert _rules_of(fs) == ["host-sync-in-hot-loop"]
+        assert fs[0].line != 0 and "float()" in fs[0].message
+
+    def test_callee_suppression_covers_every_caller(self, tmp_path):
+        fs = _scan_project(tmp_path, {
+            "util.py": """
+                import jax
+
+                def cadence_flush(x):
+                    # sanctioned: runs every N batches by contract
+                    # tpulint: disable=host-sync-in-hot-loop
+                    return jax.device_get(x)
+            """,
+            "net.py": """
+                import jax
+                from util import cadence_flush
+
+                def fit(model, batches):
+                    for b in batches:
+                        cadence_flush(model.score)
+            """,
+        })
+        assert fs == []
+
+
+class TestInterproceduralDeviceTransfer:
+    def test_helper_transfer_flagged_at_call_site(self, tmp_path):
+        fs = _scan_project(tmp_path, {
+            "stage.py": """
+                import jax
+                import jax.numpy as jnp
+
+                def to_device(x):
+                    return jnp.asarray(x)
+            """,
+            "net.py": """
+                import jax
+                from stage import to_device
+
+                class Net:
+                    def _fit_batch(self, ds):
+                        x = to_device(ds.features)
+                        return self.step(x)
+            """,
+        })
+        assert _rules_of(fs) == ["device-transfer-in-hot-loop"]
+        assert "to_device" in fs[0].message and fs[0].chain
+
+    def test_negative_memo_guarded_cache_helper(self, tmp_path):
+        """The engine's cached-table shape: the helper's transfer sits
+        behind an is-None memo guard — steady-state calls are free."""
+        fs = _scan_project(tmp_path, {
+            "net.py": """
+                import jax
+                import jax.numpy as jnp
+
+                class Engine:
+                    def _tables_dev(self):
+                        if self._cache is None:
+                            self._cache = jnp.asarray(self._np())
+                        return self._cache
+
+                    def _dispatch_step(self):
+                        return self._decode(self._tables_dev())
+            """,
+        })
         assert fs == []
 
 
@@ -531,10 +1300,10 @@ class TestLockHeldAcrossDispatchRule:
                 return x * 2
 
             class Engine:
-                def step(self, x):
+                def step(self, x, scratch):
                     with self._lock:
                         y = _dispatch(x)
-                        z = _donate(x)
+                        z = _donate(scratch)  # scratch never reused
                         w = self.net.rnn_time_step(x)
                         jax.device_get(y)
                         y.block_until_ready()
@@ -1113,6 +1882,246 @@ class TestBaselineAndCli:
         report = json.loads(capsys.readouterr().out)
         assert rc == 1 and report["new"][0]["rule"] == "parse-error"
 
+    def test_single_rule_flag_and_baseline_scope(self, tmp_path, capsys):
+        """--rule runs one rule; baseline entries of unselected rules
+        are out of scope, not stale."""
+        mod = tmp_path / "m.py"
+        mod.write_text(BAD_SRC)
+        bpath = tmp_path / bl.BASELINE_NAME
+        bl.write_baseline(str(bpath),
+                          scan_paths([str(mod)], root=str(tmp_path)))
+        rc = main([str(mod), "--rule", "bare-except",
+                   "--format", "json", "--baseline", str(bpath)])
+        report = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert report["stale_baseline"] == [] and report["total"] == 0
+        rc = main([str(mod), "--rule", "host-sync-in-hot-loop",
+                   "--format", "json", "--baseline", str(bpath)])
+        report = json.loads(capsys.readouterr().out)
+        assert rc == 0 and report["baselined"] == 1
+
+    def test_stale_baseline_is_a_hard_failure(self, tmp_path, capsys):
+        """ISSUE 13 ratchet hardening: paid-off debt must be ratcheted
+        out of the baseline, or the lane fails."""
+        mod = tmp_path / "m.py"
+        mod.write_text(BAD_SRC)
+        bpath = tmp_path / bl.BASELINE_NAME
+        bl.write_baseline(str(bpath),
+                          scan_paths([str(mod)], root=str(tmp_path)))
+        mod.write_text("import jax\n")  # debt paid off
+        rc = main([str(mod), "--format", "json",
+                   "--baseline", str(bpath)])
+        report = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert report["new"] == [] and len(report["stale_baseline"]) == 1
+
+    def test_update_baseline_refuses_error_severity(self, tmp_path,
+                                                    capsys):
+        """--update-baseline will not silently grandfather an
+        error-severity finding; --allow-grandfather is the reviewed
+        escape hatch, and warning-severity additions pass freely."""
+        mod = tmp_path / "m.py"
+        mod.write_text(BAD_SRC)   # host-sync: severity error
+        bpath = tmp_path / bl.BASELINE_NAME
+        rc = main([str(mod), "--update-baseline",
+                   "--baseline", str(bpath)])
+        capsys.readouterr()
+        assert rc == 1 and not bpath.exists()
+        rc = main([str(mod), "--update-baseline", "--allow-grandfather",
+                   "--baseline", str(bpath)])
+        capsys.readouterr()
+        assert rc == 0 and bpath.exists()
+        # ratchet down once the debt is paid: stale entry drops
+        mod.write_text(BAD_SRC)
+        rc = main([str(mod), "--update-baseline",
+                   "--baseline", str(bpath)])
+        capsys.readouterr()
+        assert rc == 0  # unchanged content: nothing newly grandfathered
+        # a WARNING-severity addition needs no flag
+        mod.write_text(
+            "import jax\nimport jax.numpy as jnp\n\n\n"
+            "class Net:\n    def _fit_batch(self, ds):\n"
+            "        return self.step(jnp.asarray(ds.features))\n")
+        rc = main([str(mod), "--update-baseline",
+                   "--baseline", str(bpath)])
+        out = capsys.readouterr()
+        assert rc == 0, out.err
+        data = json.loads(bpath.read_text())
+        assert all(e["rule"] == "device-transfer-in-hot-loop"
+                   for e in data["findings"].values())
+
+
+# ---------------------------------------------------------------------
+# --diff: the O(diff) CI gate (ISSUE 13) against a synthetic repo
+# ---------------------------------------------------------------------
+import shutil
+import subprocess
+
+
+@pytest.mark.skipif(shutil.which("git") is None, reason="git required")
+class TestDiffMode:
+    CLEAN = "import jax\n\n\ndef prep(x):\n    return x\n"
+
+    def _git(self, repo, *args):
+        subprocess.run(
+            ["git", "-C", str(repo), "-c", "user.email=t@t",
+             "-c", "user.name=t", *args],
+            check=True, capture_output=True)
+
+    def _repo(self, tmp_path):
+        """Three clean modules, committed; b.py then gains a violation
+        in the working tree (the diff includes uncommitted changes)."""
+        repo = tmp_path / "r"
+        repo.mkdir()
+        for name in ("a.py", "b.py", "c.py"):
+            (repo / name).write_text(self.CLEAN)
+        self._git(repo, "init", "-q")
+        self._git(repo, "add", "-A")
+        self._git(repo, "commit", "-qm", "seed")
+        (repo / "b.py").write_text(
+            self.CLEAN + "\n\ndef _fit_batch(self, ds):\n"
+            "    return float(self.step(ds))\n")
+        return repo
+
+    def test_diff_scans_only_changed_modules(self, tmp_path, capsys):
+        repo = self._repo(tmp_path)
+        rc = main([str(repo), "--format", "json", "--diff", "HEAD",
+                   "--baseline", str(repo / bl.BASELINE_NAME)])
+        report = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert report["scanned_modules"] == 1
+        assert report["total_modules"] == 3
+        assert report["diff_base"] == "HEAD"
+        assert [f["rule"] for f in report["new"]] == \
+            ["host-sync-in-hot-loop"]
+        assert report["new"][0]["path"] == "b.py"
+        assert report["new"][0]["on_changed_line"] is True
+
+    def test_diff_respects_baseline_without_stale_noise(self, tmp_path,
+                                                        capsys):
+        """A grandfathered finding in an UNCHANGED module is out of the
+        diff's scope (not stale); one in the CHANGED module still
+        absorbs its finding."""
+        repo = self._repo(tmp_path)
+        # plant a violation in c.py too and baseline the full scan
+        (repo / "c.py").write_text(
+            self.CLEAN + "\n\ndef _fit_other(self, ds):\n"
+            "    return float(self.step(ds))\n")
+        findings = scan_paths([str(repo)], root=str(repo))
+        bpath = repo / bl.BASELINE_NAME
+        bl.write_baseline(str(bpath), findings)
+        self._git(repo, "add", "-A")
+        self._git(repo, "commit", "-qm", "grandfathered")
+        # new working-tree violation in b.py only
+        (repo / "b.py").write_text(
+            (repo / "b.py").read_text() +
+            "\n\ndef _fit_more(self, ds):\n"
+            "    return self.params.block_until_ready()\n")
+        rc = main([str(repo), "--format", "json", "--diff", "HEAD",
+                   "--baseline", str(bpath)])
+        report = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert report["scanned_modules"] == 1   # b.py only: O(diff)
+        assert report["stale_baseline"] == []   # c.py is out of scope
+        assert report["baselined"] == 1         # b.py's old finding
+        assert [f["rule"] for f in report["new"]] == \
+            ["host-sync-in-hot-loop"]
+        # the full scan reproduces the identical grandfathered set:
+        # fingerprint-for-fingerprint, plus the same single new finding
+        rc = main([str(repo), "--format", "json",
+                   "--baseline", str(bpath)])
+        full = json.loads(capsys.readouterr().out)
+        assert full["scanned_modules"] == 3
+        assert full["baselined"] == 2 and full["stale_baseline"] == []
+        assert [f["fingerprint"] for f in full["new"]] == \
+            [f["fingerprint"] for f in report["new"]]
+
+    def test_diff_refuses_baseline_writes_and_bad_ref(self, tmp_path,
+                                                      capsys):
+        repo = self._repo(tmp_path)
+        bpath = repo / bl.BASELINE_NAME
+        assert main([str(repo), "--diff", "HEAD", "--write-baseline",
+                     "--baseline", str(bpath)]) == 2
+        assert main([str(repo), "--diff", "HEAD", "--update-baseline",
+                     "--baseline", str(bpath)]) == 2
+        assert main([str(repo), "--diff", "no-such-ref",
+                     "--baseline", str(bpath)]) == 2
+        capsys.readouterr()
+
+    def test_rule_subset_refuses_baseline_writes(self, tmp_path, capsys):
+        """A rule-subset scan must never become the baseline either —
+        it would wipe every other rule's grandfathered entries."""
+        repo = self._repo(tmp_path)
+        bpath = repo / bl.BASELINE_NAME
+        assert main([str(repo), "--rule", "bare-except",
+                     "--write-baseline", "--baseline", str(bpath)]) == 2
+        assert main([str(repo), "--rules", "bare-except",
+                     "--update-baseline", "--baseline", str(bpath)]) == 2
+        assert not bpath.exists()
+        capsys.readouterr()
+
+    def test_diff_with_baseline_below_repo_toplevel(self, tmp_path,
+                                                    capsys):
+        """git emits toplevel-relative paths; a baseline anchored in a
+        subdirectory must not make the diff scan silently empty."""
+        repo = self._repo(tmp_path)
+        sub = repo / "ci"
+        sub.mkdir()
+        rc = main([str(repo), "--format", "json", "--diff", "HEAD",
+                   "--baseline", str(sub / bl.BASELINE_NAME)])
+        report = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert report["scanned_modules"] == 1
+        assert [f["rule"] for f in report["new"]] == \
+            ["host-sync-in-hot-loop"]
+        assert report["new"][0]["on_changed_line"] is True
+
+    def test_changed_callee_flags_unchanged_caller(self, tmp_path,
+                                                   capsys):
+        """The impact closure: a changed CALLEE growing an effect
+        surfaces its interprocedural finding in an UNCHANGED caller —
+        the diff scan must include the reverse-import closure."""
+        repo = tmp_path / "r2"
+        repo.mkdir()
+        (repo / "helper.py").write_text(
+            "import jax\n\n\ndef summarize(x):\n    return x\n")
+        (repo / "train.py").write_text(
+            "import jax\nfrom helper import summarize\n\n\n"
+            "def fit(model, batches):\n    for b in batches:\n"
+            "        summarize(model.step(b))\n")
+        (repo / "leaf.py").write_text(self.CLEAN)
+        self._git(repo, "init", "-q")
+        self._git(repo, "add", "-A")
+        self._git(repo, "commit", "-qm", "seed")
+        # the helper grows a sync; train.py is untouched
+        (repo / "helper.py").write_text(
+            "import jax\n\n\ndef summarize(x):\n"
+            "    return jax.device_get(x)\n")
+        rc = main([str(repo), "--format", "json", "--diff", "HEAD",
+                   "--baseline", str(repo / bl.BASELINE_NAME)])
+        report = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert report["scanned_modules"] == 2   # helper + its importer
+        assert [f["path"] for f in report["new"]] == ["train.py"]
+        assert report["new"][0]["rule"] == "host-sync-in-hot-loop"
+        assert report["new"][0]["chain"]
+
+    def test_untracked_new_module_is_scanned(self, tmp_path, capsys):
+        """A brand-new module is invisible to `git diff <base>` until
+        added — the gate must still scan it (fully changed)."""
+        repo = self._repo(tmp_path)
+        (repo / "b.py").write_text(self.CLEAN)  # undo the tracked change
+        (repo / "fresh.py").write_text(
+            "import jax\n\n\ndef _fit_batch(self, ds):\n"
+            "    return float(self.step(ds))\n")
+        rc = main([str(repo), "--format", "json", "--diff", "HEAD",
+                   "--baseline", str(repo / bl.BASELINE_NAME)])
+        report = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert report["scanned_modules"] == 1
+        assert report["new"][0]["path"] == "fresh.py"
+        assert report["new"][0]["on_changed_line"] is True
+
 
 # ---------------------------------------------------------------------
 # the gate: repo must scan clean against the committed baseline
@@ -1145,7 +2154,11 @@ class TestSelfScan:
             "dtype-promotion", "unlocked-thread-state", "bare-except",
             "mutable-default-arg", "unbounded-retry",
             "non-atomic-state-write", "stale-world-snapshot",
-            "lock-held-across-dispatch"}
+            "lock-held-across-dispatch",
+            "donation-use-after-consume", "jit-key-drift"}
         assert RULES_BY_ID["host-sync-in-hot-loop"].severity == "error"
         assert RULES_BY_ID["device-transfer-in-hot-loop"].severity == \
             "warning"
+        assert RULES_BY_ID["donation-use-after-consume"].severity == \
+            "error"
+        assert RULES_BY_ID["jit-key-drift"].severity == "warning"
