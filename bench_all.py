@@ -1221,6 +1221,131 @@ def bench_serve_chaos():
     _print_line(json.dumps(rec), flush=True)
 
 
+def bench_serve_fleet():
+    """The serving fleet (ISSUE 14): a staggered mixed trace with three
+    shared system-prompt families over 1 -> 2 -> 3 replicas (p95 TTFT
+    should stay flat as replicas join — the fleet absorbs the same
+    trace with less queueing), a kill-one-replica-mid-trace sub-leg at
+    3 replicas (every request completes; migrated-request count
+    recorded), an affinity-on vs affinity-off A/B at 2 replicas
+    (aggregate prefix-cache hit-rate delta — affinity routes families
+    where their blocks are warm), and the zero-retraces-after-warmup
+    delta across the whole 3-replica trace including migration."""
+    import numpy as np
+    from deeplearning4j_tpu import monitoring
+    from deeplearning4j_tpu.monitoring import runtime
+    from deeplearning4j_tpu.monitoring.metrics import MetricsRegistry
+    from deeplearning4j_tpu.serving import (
+        FleetConfig, FleetRouter, GenerationEngine, PagedKVConfig)
+    from deeplearning4j_tpu.zoo import TextGenerationTransformer
+
+    # the trace must OVERLOAD one replica (deep queue at 2 slots) so
+    # the fleet's measured effect is queue relief; on this shared-CPU
+    # A/B the replicas also contend for cores, which real fleets
+    # (one chip per replica) don't — the flat-TTFT acceptance
+    # adjudicates on a live-chip window (PERF.md "ISSUE 14")
+    V, R, STEPS, SLOTS, PS = 256, 24, 24, 2, 8
+    STAGGER = 0.005
+    model_kw = dict(vocab_size=V, embed_dim=64, n_heads=4, n_layers=2,
+                    max_length=64, positional="rope")
+    rng = np.random.default_rng(0)
+    families = [list(rng.integers(1, V, 2 * PS)) for _ in range(3)]
+    prompts = [families[i % 3] + list(rng.integers(1, V,
+                                                   int(rng.integers(2, 8))))
+               for i in range(R)]
+
+    def factory(made):
+        """Engine factory recording every engine it built into `made`
+        — the dead-replica-inclusive aggregation base (a killed
+        replica's prefix hits must still count in the trace totals
+        after the router drops it from health())."""
+        def make(rid):
+            net = TextGenerationTransformer(**model_kw).init()
+            net.conf.dtype = "bfloat16"
+            eng = GenerationEngine(
+                net, V, slots=SLOTS, queue_limit=R,
+                paging=PagedKVConfig(page_size=PS))
+            made.append(eng)
+            return eng
+        return make
+
+    def compile_total():
+        c = monitoring.global_registry().get(runtime.COMPILE_COUNTER)
+        return 0.0 if c is None else c.total()
+
+    def trace(n_replicas, affinity=True, kill=False):
+        reg = MetricsRegistry()
+        engines = []
+        fleet = FleetRouter(
+            factory(engines), replicas=n_replicas,
+            config=FleetConfig(affinity=affinity), registry=reg,
+            name=f"bench{n_replicas}")
+        fleet.warmup(max_prompt_len=32)
+        warm = compile_total()
+        fleet.start()
+        t0 = time.perf_counter()
+        handles = []
+        killed_at = None
+        for i, p in enumerate(prompts):
+            while time.perf_counter() < t0 + i * STAGGER:
+                time.sleep(0.001)
+            if kill and i == R // 2:
+                victim = max(fleet.replicas(),
+                             key=lambda r: r.engine.active_slots())
+                victim.engine._stop.set()   # simulated process death
+                killed_at = i
+            handles.append(fleet.submit(p, steps=STEPS, top_k=1,
+                                        rng=np.random.default_rng(i)))
+        done, ttft = 0, []
+        for h in handles:
+            try:
+                h.result(timeout=600)
+                done += 1
+                if h.ttft_s is not None:
+                    ttft.append(h.ttft_s)
+            except Exception:  # noqa: BLE001 — count completions
+                pass
+        dt = time.perf_counter() - t0
+        gen = sum(len(h.ids) - len(h.prompt) for h in handles if h.done)
+        # aggregate over every engine the trace created — health()
+        # still answers on a killed replica, and its pre-death hits
+        # belong in the totals
+        healths = [e.health() for e in engines]
+        hits = sum(h["prefix_cache"]["hits"] for h in healths)
+        misses = sum(h["prefix_cache"]["misses"] for h in healths)
+        rec = {
+            "completed": done, "wall_s": round(dt, 2),
+            "tokens_per_sec": round(gen / dt, 1),
+            "ttft_p95_ms": (round(float(np.percentile(ttft, 95)) * 1e3,
+                                  1) if ttft else None),
+            "prefix_hit_rate": round(hits / max(1, hits + misses), 3),
+            "retraces_after_warmup": compile_total() - warm,
+        }
+        if kill:
+            rec.update({"killed_at_request": killed_at,
+                        "migrations": fleet.migrations,
+                        "migrated_requests": fleet.migrated_requests,
+                        "replicas_left": len(fleet.replicas())})
+        fleet.shutdown()
+        return rec
+
+    by_size = {n: trace(n) for n in (1, 2, 3)}
+    kill_rec = trace(3, kill=True)
+    no_aff = trace(2, affinity=False)
+    rec = {"metric": "serve_fleet", "unit": "requests_completed",
+           "requests": R, "steps": STEPS,
+           "slots_per_replica": SLOTS, "stagger_ms": STAGGER * 1e3,
+           "families": len(families),
+           "replicas": {str(n): by_size[n] for n in by_size},
+           "kill_mid_trace": kill_rec,
+           "affinity_off_2x": no_aff,
+           "affinity_hit_rate_delta": round(
+               by_size[2]["prefix_hit_rate"]
+               - no_aff["prefix_hit_rate"], 3)}
+    rec["value"] = kill_rec["completed"]
+    _print_line(json.dumps(rec), flush=True)
+
+
 def _converge_run(net, x, y, steps, record_every):
     """Fixed-seed training loop recording the loss trajectory. Each
     recorded point is a scalar host fetch — a real sync (the tunneled
@@ -1456,6 +1581,7 @@ ALL = {"resnet": bench_resnet, "lstm": bench_lstm, "lenet": bench_lenet,
        "serve_continuous": bench_serve_continuous,
        "serve_paged": bench_serve_paged,
        "serve_chaos": bench_serve_chaos,
+       "serve_fleet": bench_serve_fleet,
        "checkpoint_stall": bench_checkpoint_stall,
        "converge_lenet": bench_converge_lenet,
        "converge_resnet": bench_converge_resnet}

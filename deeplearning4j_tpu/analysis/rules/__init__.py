@@ -3,11 +3,12 @@
 Rule families: host-sync + device-transfer (ISSUE 3; interprocedurally
 promoted in ISSUE 13), tracer-leak, recompile-hazard, dtype-promotion,
 concurrency, hygiene, retry (ISSUE 4), state-write (ISSUE 7),
-world-snapshot (ISSUE 8), lock-dispatch (ISSUE 9), and the ISSUE 13
+world-snapshot (ISSUE 8), lock-dispatch (ISSUE 9), the ISSUE 13
 exactness-contract families: donation-use-after-consume and
-jit-key-drift. Adding a rule = subclass `analysis.core.Rule`
-(optionally with a ``check_project`` for whole-program facts),
-instantiate it here.
+jit-key-drift, and replica-state (ISSUE 14 — the fleet layer reads
+engines only through public accessors). Adding a rule = subclass
+`analysis.core.Rule` (optionally with a ``check_project`` for
+whole-program facts), instantiate it here.
 """
 
 from __future__ import annotations
@@ -34,6 +35,8 @@ from deeplearning4j_tpu.analysis.rules.world_snapshot import (
 from deeplearning4j_tpu.analysis.rules.donation import (
     DonationUseAfterConsumeRule)
 from deeplearning4j_tpu.analysis.rules.jit_key import JitKeyDriftRule
+from deeplearning4j_tpu.analysis.rules.replica_state import (
+    ReplicaLocalStateInRouterRule)
 
 ALL_RULES: List[Rule] = [
     HostSyncRule(),
@@ -50,6 +53,7 @@ ALL_RULES: List[Rule] = [
     UnboundedRetryRule(),
     NonAtomicStateWriteRule(),
     WorldSnapshotRule(),
+    ReplicaLocalStateInRouterRule(),
 ]
 
 RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in ALL_RULES}

@@ -1,0 +1,65 @@
+"""replica-local-state-in-router: fleet code probing engine internals.
+
+The fleet layer (``serving/fleet/``) makes placement, migration, and
+scaling decisions ABOUT engines while those engines' step loops run
+concurrently. Engine-internal mutable state — ``_slots``, ``_pending``,
+``_pool``, ``_seating``, ``_page_tables`` — is guarded by the ENGINE's
+lock and mutates mid-step: a router reading it directly races the step
+cycle (a half-updated slot scan scores a phantom load), and couples the
+fleet to internals the next refactors (prefill/decode disaggregation,
+sharded replicas) will move. The sanctioned seams are the public
+accessors — ``health()``, ``queue_snapshot()``, ``is_healthy()`` /
+``is_ready()`` / ``queue_depth()`` / ``active_slots()``, and the
+request-ledger trio ``export_ledger()`` / ``admit_from_ledger()`` /
+``detach_ledger()`` — which take the engine lock and hand back
+immutable copies.
+
+The rule is structural rather than name-listed: inside a
+``serving/fleet/`` module, ANY read of a single-underscore attribute on
+an object other than ``self``/``cls`` is a foreign-private probe and is
+flagged (dunders exempt). That catches tomorrow's private attribute as
+well as today's, and keeps the fleet layer honest about its own
+abstractions — private state of fleet classes is reached through
+``self``, everything else through a public seam.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from deeplearning4j_tpu.analysis.core import (
+    Finding, ModuleInfo, Rule, SEVERITY_WARNING)
+
+#: the path fragment that scopes the rule to the fleet layer
+_FLEET_PATH = "serving/fleet/"
+
+
+class ReplicaLocalStateInRouterRule(Rule):
+    id = "replica-local-state-in-router"
+    severity = SEVERITY_WARNING
+    description = ("fleet router/autoscale/migration code reading "
+                   "engine-internal (foreign private) mutable state "
+                   "instead of the public health()/queue_snapshot()/"
+                   "ledger accessors")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        if _FLEET_PATH not in mod.rel_path:
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            attr = node.attr
+            if not attr.startswith("_") or attr.startswith("__"):
+                continue
+            base = node.value
+            if isinstance(base, ast.Name) and base.id in ("self", "cls"):
+                continue
+            yield self.finding(
+                mod, node,
+                f"foreign private state `.{attr}` read in fleet code — "
+                f"engine internals are lock-guarded and mid-step "
+                f"mutable; go through the public accessors "
+                f"(health(), queue_snapshot(), export_ledger()/"
+                f"admit_from_ledger()/detach_ledger()) or carry a "
+                f"justified suppression")

@@ -27,6 +27,15 @@ Serving robustness (resilience layer):
 - **No hung callers**: a model exception fails every coalesced waiter
   with the original error; a dying worker thread fail-fasts everything
   queued; requests arriving after shutdown are refused.
+- **Fleet-backed mode** (``replicas=[model2, ...]``): extra model
+  replicas (identically parameterized — the serving-fleet homogeneity
+  contract) each get their own dispatch lock and, in batched mode,
+  their own serving worker draining the SHARED queue — coalesced
+  batches run concurrently across replicas instead of serializing on
+  one model lock, and a single crashed worker degrades capacity
+  instead of failing the pool (fail-all happens only when the LAST
+  worker exits). The generation-side analog is
+  ``serving.fleet.FleetRouter``.
 """
 
 from __future__ import annotations
@@ -78,7 +87,8 @@ class ParallelInference:
                  queue_limit: int = 64, batch_timeout_ms: float = 2.0,
                  inference_mode: str = "batched",
                  queue_policy: str = "block",
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 replicas=()):
         if inference_mode not in ("batched", "sequential"):
             raise ValueError(
                 f"inference_mode must be 'batched' or 'sequential', got "
@@ -87,8 +97,13 @@ class ParallelInference:
             raise ValueError(f"queue_policy must be 'block' or 'fail_fast', "
                              f"got {queue_policy!r}")
         self.model = model
-        if not model._initialized:
-            model.init()
+        # fleet-backed mode: model + replicas, each with its own lock
+        # (and, batched, its own worker). Replica 0 is the primary —
+        # output_direct() and all single-model back-compat paths use it.
+        self._models = [model] + list(replicas)
+        for m in self._models:
+            if not m._initialized:
+                m.init()
         self.mesh = mesh if mesh is not None else default_mesh()
         self.n_devices = int(np.prod(self.mesh.devices.shape))
         self.max_batch_size = max_batch_size
@@ -99,21 +114,32 @@ class ParallelInference:
         # stop signal is an Event (atomic, visible cross-thread), not a
         # bare bool mutated from the caller thread
         self._stop = threading.Event()
-        # ONE lock serializes every model touch: the wrapped model is not
-        # thread-safe (output() mutates _jit_cache and _rng), and callers
-        # may race the batching worker via output_direct()/sequential mode
-        self._seq_lock = threading.Lock()
+        # ONE lock PER MODEL serializes every touch of it: a wrapped
+        # model is not thread-safe (output() mutates _jit_cache and
+        # _rng), and callers may race the batching workers via
+        # output_direct()/sequential mode. _seq_lock stays as the
+        # primary's alias (pre-fleet name).
+        self._locks = [threading.Lock() for _ in self._models]
+        self._seq_lock = self._locks[0]
+        self._rr = 0                       # sequential-mode round robin
+        self._rr_lock = threading.Lock()
         if inference_mode == "batched":
             self._queue: "queue.Queue[_Request]" = \
                 queue.Queue(maxsize=queue_limit)
-            self._worker = threading.Thread(target=self._serve_loop,
-                                            daemon=True)
-            self._worker.start()
+            self._live_workers = len(self._models)
+            self._workers = [
+                threading.Thread(target=self._serve_loop, args=(i,),
+                                 daemon=True)
+                for i in range(len(self._models))]
+            for w in self._workers:
+                w.start()
+            self._worker = self._workers[0]    # back-compat alias
         else:
             # SEQUENTIAL (ParallelInference.java:136-216): each request
             # runs immediately, one at a time — no coalescing window, so
             # single-stream latency is one dispatch, not dispatch+timeout
             self._queue = None
+            self._workers = []
             self._worker = None
         self._register_health_gauges()
 
@@ -121,12 +147,13 @@ class ParallelInference:
     # health / readiness
     # ------------------------------------------------------------------
     def is_healthy(self) -> bool:
-        """The serving loop can still produce results."""
+        """The serving loop can still produce results (fleet-backed:
+        at least one replica worker is still draining the queue)."""
         if self._stop.is_set():
             return False
         if self.inference_mode == "sequential":
             return True
-        return self._worker is not None and self._worker.is_alive()
+        return any(w.is_alive() for w in self._workers)
 
     def is_ready(self) -> bool:
         """Healthy AND able to admit a request right now."""
@@ -139,9 +166,14 @@ class ParallelInference:
 
     def health(self) -> dict:
         """Readiness-probe payload (the UIServer /metrics companion)."""
-        return {"healthy": self.is_healthy(), "ready": self.is_ready(),
-                "queue_depth": self.queue_depth(),
-                "mode": self.inference_mode}
+        out = {"healthy": self.is_healthy(), "ready": self.is_ready(),
+               "queue_depth": self.queue_depth(),
+               "mode": self.inference_mode,
+               "replicas": len(self._models)}
+        if self.inference_mode == "batched":
+            out["live_workers"] = sum(
+                1 for w in self._workers if w.is_alive())
+        return out
 
     def _register_health_gauges(self) -> None:
         # the shared serving-telemetry path (serving/health.py): counter
@@ -155,19 +187,21 @@ class ParallelInference:
         self._counter_handles[metric].inc()
 
     # ------------------------------------------------------------------
-    def _run_batch(self, x: np.ndarray, deadline: Optional[float] = None):
+    def _run_batch(self, x: np.ndarray, deadline: Optional[float] = None,
+                   idx: int = 0):
         n = x.shape[0]
         rem = n % self.n_devices
         if rem:
             pad = self.n_devices - rem
             x = np.concatenate([x, np.repeat(x[-1:], pad, axis=0)], axis=0)
         sh = NamedSharding(self.mesh, P("data", *([None] * (x.ndim - 1))))
+        lock = self._locks[idx]
         if deadline is None:
-            acquired = self._seq_lock.acquire()
+            acquired = lock.acquire()
         else:
             # the lock wait (another caller's dispatch) draws from the
             # request budget; the device program itself runs to completion
-            acquired = self._seq_lock.acquire(
+            acquired = lock.acquire(
                 timeout=max(0.0, deadline - time.monotonic()))
         if not acquired:
             self._counter(SERVING_DEADLINE_EXCEEDED)
@@ -178,15 +212,15 @@ class ParallelInference:
             # sharded put IS the request's one staging step, not a
             # missed prefetch (there is no iterator to prefetch from)
             # tpulint: disable=device-transfer-in-hot-loop
-            out = self.model.output(jax.device_put(x, sh))
+            out = self._models[idx].output(jax.device_put(x, sh))
         finally:
-            self._seq_lock.release()
+            lock.release()
         # host materialization is the serving response contract here, not
         # a pipeline stall: the caller blocks on this result by design
         # tpulint: disable=host-sync-in-hot-loop
         return np.asarray(out)[:n]
 
-    def _serve_loop(self):
+    def _serve_loop(self, idx: int = 0):
         try:
             while not self._stop.is_set():
                 try:
@@ -214,25 +248,38 @@ class ParallelInference:
                     # (mismatched shapes) fails ITS batch's waiters, it
                     # must not kill the serving loop for everyone after
                     x = np.concatenate([r.x for r in batch], axis=0)
-                    out = self._run_batch(x)
+                    out = self._run_batch(x, idx=idx)
                     s = 0
                     for r in batch:
                         k = r.x.shape[0]
                         r.result = out[s:s + k]
                         s += k
-                except Exception as e:  # propagate to all waiters
+                except BaseException as e:  # propagate to all waiters
                     self._counter(SERVING_ERRORS)
                     for r in batch:
                         r.result = e
+                        r.event.set()
+                    if not isinstance(e, Exception):
+                        # a worker-killing signal: die AFTER answering
+                        # this batch's waiters — with replica workers
+                        # still alive they would otherwise block
+                        # forever on a batch nobody holds
+                        raise
+                    continue
                 for r in batch:
                     r.event.set()
         finally:
-            # worker exiting for ANY reason (shutdown or crash): nothing
-            # will answer the queue anymore — fail leftovers fast rather
-            # than letting callers block to their deadlines
-            self._stop.set()
-            self._fail_pending(RuntimeError("ParallelInference worker "
-                                            "stopped"))
+            # worker exiting for ANY reason (shutdown or crash): with
+            # replica workers still draining the queue this is a
+            # capacity loss, not an outage — only the LAST worker out
+            # fail-fasts the leftovers (nobody would answer them)
+            with self._rr_lock:
+                self._live_workers -= 1
+                last = self._live_workers <= 0
+            if last:
+                self._stop.set()
+                self._fail_pending(RuntimeError(
+                    "ParallelInference worker stopped"))
 
     def _fail_pending(self, exc: Exception) -> None:
         if self._queue is None:
@@ -259,8 +306,14 @@ class ParallelInference:
         if self.inference_mode == "sequential":
             if self._stop.is_set():
                 raise RuntimeError("ParallelInference shut down")
+            with self._rr_lock:
+                # fleet-backed: spread immediate dispatches round-robin
+                # over the replica locks so concurrent sequential
+                # callers don't serialize on one model
+                idx = self._rr % len(self._models)
+                self._rr += 1
             try:
-                return self._run_batch(x, deadline)  # takes the model lock
+                return self._run_batch(x, deadline, idx=idx)
             except InferenceTimeout:
                 raise  # already counted as a deadline, not a model error
             except Exception:
@@ -284,13 +337,13 @@ class ParallelInference:
                 raise InferenceTimeout(
                     f"no result within {timeout:g}s "
                     f"(queue_depth={self.queue_depth()})")
-            # give up only when the worker is GONE: during a graceful
-            # shutdown (_stop set, worker draining its in-flight batch)
+            # give up only when EVERY worker is GONE: during a graceful
+            # shutdown (_stop set, workers draining in-flight batches)
             # the result is still coming and must be delivered
-            if not (self._worker is not None and self._worker.is_alive()) \
+            if not any(w.is_alive() for w in self._workers) \
                     and not req.event.is_set():
                 raise RuntimeError("ParallelInference shut down")
-        if isinstance(req.result, Exception):
+        if isinstance(req.result, BaseException):
             raise req.result
         return req.result
 
@@ -331,6 +384,7 @@ class ParallelInference:
         when the worker exits are failed over to their waiters — nobody
         blocks forever on a dead server."""
         self._stop.set()
-        if self._worker is not None and self._worker.is_alive():
-            self._worker.join(timeout=5.0)
+        for w in self._workers:
+            if w.is_alive():
+                w.join(timeout=5.0)
         self._fail_pending(RuntimeError("ParallelInference shut down"))

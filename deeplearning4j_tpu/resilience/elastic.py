@@ -252,11 +252,17 @@ class LeaseLedger:
 
     def __init__(self, root: str, rank: int, ttl: float = 5.0,
                  interval: Optional[float] = None,
-                 advertise_host: str = "127.0.0.1"):
+                 advertise_host: str = "127.0.0.1",
+                 role: Optional[str] = None):
         if ttl <= 0:
             raise ValueError(f"ttl must be > 0, got {ttl}")
         self.root = os.path.abspath(root)
         self.rank = int(rank)
+        #: optional membership role stamped into every beat ("train"
+        #: ranks vs "serving" replicas can share one ledger directory;
+        #: ``live_ranks(role=...)`` filters to one population so a
+        #: serving fleet never counts a training rank as a replica)
+        self.role = role
         self.ttl = float(ttl)
         self.interval = float(interval) if interval is not None \
             else self.ttl / 3.0
@@ -286,11 +292,14 @@ class LeaseLedger:
         if self._stalled.is_set():
             return
         self.beat += 1
-        _write_json_atomic_nosync(self._lease_path(self.rank), {
+        lease = {
             "rank": self.rank, "beat": self.beat, "ts": time.time(),
             "generation": self.generation,
             "host": self.advertise_host,
-        })
+        }
+        if self.role is not None:
+            lease["role"] = self.role
+        _write_json_atomic_nosync(self._lease_path(self.rank), lease)
 
     def start(self, generation: Optional[int] = None) -> "LeaseLedger":
         """Heartbeat immediately, then keep beating from a daemon thread
@@ -373,12 +382,16 @@ class LeaseLedger:
             return None
         return (time.time() if now is None else now) - float(lease["ts"])
 
-    def live_ranks(self, now: Optional[float] = None) -> List[int]:
+    def live_ranks(self, now: Optional[float] = None,
+                   role: Optional[str] = None) -> List[int]:
         """Ranks whose lease is younger than ttl (a missing lease is
-        simply not live)."""
+        simply not live). ``role`` restricts to leases stamped with
+        that role (pre-role leases carry none and match only the
+        unfiltered read) — the serving-replica filter."""
         now = time.time() if now is None else now
         return sorted(r for r, lease in self.read_leases().items()
-                      if now - float(lease["ts"]) <= self.ttl)
+                      if now - float(lease["ts"]) <= self.ttl
+                      and (role is None or lease.get("role") == role))
 
     # -- generations -----------------------------------------------------
     def read_generation(self, generation: int) -> Optional[GenerationRecord]:

@@ -25,29 +25,44 @@ host-side ledger, budgeted restarts, escalation to fail-all),
 deadline-based early rejection, the page-pressure brownout ladder),
 and ``GenerationEngine.drain()`` (the clean restart handoff).
 
+The fleet layer (``serving/fleet``) composes N engine replicas behind
+one ``FleetRouter``: prefix-affinity placement, ledger-based live
+migration (``RequestLedgerEntry`` — the supervisor's rebuild payload
+made public, so recovery and migration share one engine code path),
+and signal-driven autoscaling with hysteresis.
+
 See ARCHITECTURE.md "Serving engine", "Paged KV, prefix cache &
-speculation", and "Serving survivability".
+speculation", "Serving survivability", and "Serving fleet".
 """
 
 from deeplearning4j_tpu.serving.engine import (  # noqa: F401
     GenerationEngine, SpeculationConfig)
 from deeplearning4j_tpu.serving.errors import (  # noqa: F401
-    EngineShutdown, InferenceTimeout, RequestCancelled,
-    ServingOverloaded, ServingQueueFull)
+    EngineShutdown, InferenceTimeout, NoReplicaAvailable,
+    RequestCancelled, ServingOverloaded, ServingQueueFull)
 from deeplearning4j_tpu.serving.overload import (  # noqa: F401
     OverloadConfig, OverloadController)
 from deeplearning4j_tpu.serving.paging import (  # noqa: F401
     PagedKVConfig, PageExhausted, PagePool)
 from deeplearning4j_tpu.serving.prefix_cache import PrefixCache  # noqa: F401
 from deeplearning4j_tpu.serving.request import (  # noqa: F401
-    GenerationRequest, GenerationStream)
-from deeplearning4j_tpu.serving.scheduler import AdmissionQueue  # noqa: F401
+    GenerationRequest, GenerationStream, LEDGER_VERSION,
+    RequestLedgerEntry)
+from deeplearning4j_tpu.serving.scheduler import (  # noqa: F401
+    AdmissionQueue, QueueSnapshot)
 from deeplearning4j_tpu.serving.supervisor import (  # noqa: F401
     EngineSupervisor)
+from deeplearning4j_tpu.serving.fleet import (  # noqa: F401
+    AutoscaleConfig, FleetAutoscaler, FleetConfig, FleetMembership,
+    FleetReplica, FleetRouter, FleetSignals, MigrationReport)
 
-__all__ = ["AdmissionQueue", "EngineShutdown", "EngineSupervisor",
-           "GenerationEngine", "GenerationRequest", "GenerationStream",
-           "InferenceTimeout", "OverloadConfig", "OverloadController",
-           "PagedKVConfig", "PageExhausted", "PagePool", "PrefixCache",
-           "RequestCancelled", "ServingOverloaded", "ServingQueueFull",
-           "SpeculationConfig"]
+__all__ = ["AdmissionQueue", "AutoscaleConfig", "EngineShutdown",
+           "EngineSupervisor", "FleetAutoscaler", "FleetConfig",
+           "FleetMembership", "FleetReplica", "FleetRouter",
+           "FleetSignals", "GenerationEngine", "GenerationRequest",
+           "GenerationStream", "InferenceTimeout", "LEDGER_VERSION",
+           "MigrationReport", "NoReplicaAvailable", "OverloadConfig",
+           "OverloadController", "PagedKVConfig", "PageExhausted",
+           "PagePool", "PrefixCache", "QueueSnapshot",
+           "RequestCancelled", "RequestLedgerEntry",
+           "ServingOverloaded", "ServingQueueFull", "SpeculationConfig"]

@@ -90,6 +90,11 @@ Survivability (PR 9, ARCHITECTURE.md "Serving survivability"):
   off → prefix-cache inserts off, auto-restoring).
 - ``drain(timeout)`` stops admission and finishes the actives — the
   clean handoff point for planned restarts.
+- the request-ledger seam (``export_ledger`` / ``admit_from_ledger`` /
+  ``detach_ledger``): every in-flight request exports as a versioned
+  ``RequestLedgerEntry`` and re-admits bit-identically on this or ANY
+  other replica — the one rebuild path the supervisor's quarantine and
+  ``serving/fleet``'s live migration both ride.
 - ``seat_chaos`` fires in the pop-to-seat admission window (the
   handoff seam the supervisor also covers); ``prefill_chaos`` /
   ``seat_chaos`` receive the request as event context, so
@@ -138,7 +143,7 @@ from deeplearning4j_tpu.serving.paging import (
     PagedKVConfig, PagePool, gather_pages, pages_needed, scatter_pages)
 from deeplearning4j_tpu.serving.prefix_cache import PrefixCache
 from deeplearning4j_tpu.serving.request import (
-    GenerationRequest, GenerationStream)
+    GenerationRequest, GenerationStream, RequestLedgerEntry)
 from deeplearning4j_tpu.serving.scheduler import AdmissionQueue
 from deeplearning4j_tpu.util.decoding import (
     _check_seed, _stream_layers, accept_proposals, draw, filter_probs,
@@ -897,7 +902,11 @@ class GenerationEngine:
             self._queue_wait_hist.observe(req.handle.queue_wait_s)
             if self._overload is not None:
                 self._overload.observe_queue_wait(req.handle.queue_wait_s)
-            self._admit_one(req, self._slots.index(None))
+            # a popped request that already streamed tokens is a ledger
+            # survivor riding the queue (migration / requeue overflow):
+            # re-prime it instead of fresh-admitting
+            self._admit_one(req, self._slots.index(None),
+                            readmit=req.streamed)
             self._seating = None
         return n
 
@@ -1090,10 +1099,16 @@ class GenerationEngine:
 
         The rebuild reuses the warm prefill buckets and the compiled
         arena scatter/gather shapes, so after a full-envelope
-        ``warmup()`` a recovery compiles nothing new (test-pinned)."""
-        survivors = [(s, r) for s, r in enumerate(self._slots)
-                     if r is not None]
-        seating, self._seating = self._seating, None
+        ``warmup()`` a recovery compiles nothing new (test-pinned).
+
+        Survivors travel as :class:`RequestLedgerEntry` records through
+        the same ``export_ledger`` capture fleet migration uses — ONE
+        rebuild payload, not two hand-synced copies — including the
+        pop-to-seat ``_seating`` request (at most S entries total: a
+        seating request implies a free slot, so sequential free-slot
+        assignment below always finds room)."""
+        entries = self.export_ledger()      # actives + _seating
+        self._seating = None
         self._slots = [None] * self.slots
         self._row_pos = np.zeros(self.slots, np.int64)
         self._arena_ready = False
@@ -1119,24 +1134,17 @@ class GenerationEngine:
         now = time.monotonic()
         n = 0
         try:
-            for slot, req in survivors:
+            for entry in entries:
+                req = entry.request
                 if self._fail_if_dead(req, now, "during recovery"):
                     continue
-                self._admit_one(req, slot, readmit=True)
-                if self._slots[slot] is req:
-                    n += 1
-            if seating is not None and self._fail_if_dead(
-                    seating, now, "during recovery"):
-                seating = None
-            if seating is not None:
-                # the pop-to-seat window survivor: re-primed if it
-                # already streamed tokens, freshly admitted otherwise
-                free = self._slots.index(None)  # its pop guarantees one
-                already = len(seating.handle._ids) > len(seating.prompt)
-                self._admit_one(seating, free, readmit=already)
-                if self._slots[free] is seating or (
-                        seating.handle.done
-                        and seating.handle.error is None):
+                # a streamed survivor re-primes (no draw, rng untouched);
+                # a never-streamed one — the pop-to-seat window request —
+                # admits fresh and may even finish clean (one-token)
+                slot = self._slots.index(None)
+                self._admit_one(req, slot, readmit=req.streamed)
+                if self._slots[slot] is req or (
+                        req.handle.done and req.handle.error is None):
                     n += 1                   # seated, or finished clean
         except BaseException as e:
             # a fault raised mid-rebuild must strand nobody: the slots
@@ -1146,14 +1154,181 @@ class GenerationEngine:
             # then let the supervisor escalate (seated survivors get
             # their terminal event from _break's slot scan)
             seated = {id(r) for r in self._slots if r is not None}
-            for _, req in survivors:
-                if id(req) not in seated and not req.handle.done:
-                    req.handle._fail(e)
-            if seating is not None and id(seating) not in seated \
-                    and not seating.handle.done:
-                seating.handle._fail(e)
+            for entry in entries:
+                if id(entry.request) not in seated \
+                        and not entry.request.handle.done:
+                    entry.request.handle._fail(e)
             raise
         return n
+
+    # ------------------------------------------------------------------
+    # the request-ledger seam (serving/request.RequestLedgerEntry):
+    # ONE export/re-admit path shared by supervisor recovery (above)
+    # and fleet migration (serving/fleet/migration.py)
+    # ------------------------------------------------------------------
+    def export_ledger(self, include_queued: bool = False
+                      ) -> List[RequestLedgerEntry]:
+        """Snapshot every in-flight request as a versioned ledger
+        entry: active slots (in slot order), the pop-to-seat
+        ``_seating`` request if the export lands inside that window
+        (the same visibility ``_break`` gained in PR 9 — without it a
+        migration would strand the popped handle forever), and,
+        with ``include_queued``, the admission queue in admission
+        order. Non-mutating; safe on a stopped/broken engine (the
+        dead-replica export path)."""
+        with self._lock:
+            entries = [RequestLedgerEntry.capture(r, "active")
+                       for r in self._slots if r is not None]
+            if self._seating is not None:
+                entries.append(RequestLedgerEntry.capture(
+                    self._seating, "seating"))
+            if include_queued:
+                entries.extend(
+                    RequestLedgerEntry.capture(r, "queued")
+                    for r in self._pending.peek_all())
+            return entries
+
+    def admit_from_ledger(self, entries, where: str = "during migration"
+                          ) -> int:
+        """Re-admit exported ledger entries on THIS engine: streamed
+        survivors re-prime from ``ids[:-1]`` with their pending token
+        and untouched rng (the supervisor-recovery semantics — the
+        stream continues bit-identically), never-streamed entries admit
+        fresh. Entries that no longer fit a free slot ride the
+        admission queue (force-requeued past the limit: survivors were
+        already admitted once). Returns how many requests this engine
+        took over; dead entries (cancelled / expired, or already
+        carrying a terminal event) are resolved and skipped."""
+        with self._lock:
+            if self._broken is not None:
+                raise EngineShutdown("GenerationEngine is broken: "
+                                     f"{self._broken!r}")
+            if self._stop.is_set():
+                raise EngineShutdown("GenerationEngine shut down")
+            if self._draining:
+                raise EngineShutdown("GenerationEngine draining — "
+                                     "migrate to another replica")
+            now = time.monotonic()
+            n = 0
+            for entry in entries:
+                req = entry.request
+                if self._fail_if_dead(req, now, where):
+                    continue
+                if self._pool is not None:
+                    store = self._store_positions(req.want)
+                    if pages_needed(store, self._ps) > self._pool.usable:
+                        # heterogeneous-pool edge: this replica can
+                        # NEVER hold the request — fail it the way
+                        # submit() would have, don't head-of-line block
+                        req.handle._fail(ValueError(
+                            f"migrated request holds {store} KV "
+                            f"positions but this replica's pool has "
+                            f"only {self._pool.usable} pages"))
+                        continue
+                free = (self._slots.index(None)
+                        if None in self._slots else None)
+                if free is not None and (
+                        self._pool is None
+                        or self._pages_admissible(req)):
+                    self._admit_one(req, free, readmit=req.streamed)
+                    if self._slots[free] is req or (
+                            req.handle.done
+                            and req.handle.error is None):
+                        n += 1
+                else:
+                    self._pending.requeue(req)
+                    n += 1
+            return n
+
+    def detach_ledger(self, lock_timeout: Optional[float] = None
+                      ) -> List[RequestLedgerEntry]:
+        """Export EVERYTHING in flight (actives + seating + queue) and
+        release it from this engine WITHOUT terminal events: the
+        requests live on wherever the entries are re-admitted. The
+        planned-handoff half of live migration — scale-in drains
+        through this instead of waiting out ``drain()``'s natural
+        retirements — and equally the post-mortem export off a dead
+        replica (works under ``_stop``; a broken engine already failed
+        its handles, so its export is empty). The engine is left
+        draining with an empty arena, a fresh-released page pool, and a
+        closed queue: terminal for this replica.
+
+        The queued entries come from ``close()``'s drain — the SAME
+        atomic removal that refuses later submits — so a request that
+        squeezes through the unlocked ``submit()`` draining check
+        while the detach runs is either in the export or refused,
+        never silently dropped.
+
+        ``lock_timeout`` bounds the engine-lock wait: a replica whose
+        step thread wedged INSIDE a dispatch still holds the lock, and
+        a caller migrating it off lease-expiry must not deadlock on it
+        (raises ``TimeoutError``; the wedged engine's streams cannot
+        be exported from outside the lock)."""
+        if lock_timeout is not None:
+            if not self._lock.acquire(timeout=lock_timeout):
+                raise TimeoutError(
+                    f"engine lock not released within {lock_timeout:g}s "
+                    f"— a wedged dispatch still holds it; its ledger "
+                    f"cannot be exported")
+        else:
+            self._lock.acquire()
+        try:
+            self._draining = True
+            entries = self.export_ledger()      # actives + seating
+            self._seating = None
+            for s, req in enumerate(self._slots):
+                if req is None:
+                    continue
+                self._slots[s] = None
+                self._row_pos[s] = 0
+                if self._pool is not None:
+                    for p in self._page_tables[s]:
+                        self._pool.release(p)
+                    self._page_tables[s] = []
+            if self._pool is not None:
+                self._invalidate_tables()
+                self._kv_pos_dirty = True
+            entries.extend(RequestLedgerEntry.capture(r, "queued")
+                           for r in self._pending.close())
+            self._sync_accounting()
+            return entries
+        finally:
+            self._lock.release()
+
+    def detach_queued(self, max_n: Optional[int] = None
+                      ) -> List[RequestLedgerEntry]:
+        """Export and remove queued (never-prefilled) requests, highest
+        admission priority first, up to `max_n` (None = all) — the
+        overload-rebalance payload: queued work moves for free (no warm
+        KV to abandon, no re-prefill debt), actives stay where their
+        cache is. The queue stays open; the engine keeps serving."""
+        with self._lock:
+            entries = []
+            while max_n is None or len(entries) < max_n:
+                req = self._pending.pop()
+                if req is None:
+                    break
+                entries.append(RequestLedgerEntry.capture(req, "queued"))
+            return entries
+
+    def queue_snapshot(self):
+        """Non-mutating admission-queue view (per-priority depths +
+        oldest wait) — the router's placement-scoring accessor; see
+        ``serving.scheduler.QueueSnapshot``."""
+        return self._pending.snapshot()
+
+    def load_stats(self) -> dict:
+        """The narrow placement-scoring payload (what the fleet
+        router's hot submit path reads per candidate): slots, occupied
+        slots, queue depth, and the free-page fraction (1.0 unpaged) —
+        without constructing the full ``health()`` observability dict."""
+        free = 1.0
+        if self._pool is not None and self._pool.usable:
+            free = self._pool.free_count() / self._pool.usable
+        return {"slots": self.slots,
+                "active_slots": self.active_slots(),
+                "queue_depth": self.queue_depth(),
+                "free_page_frac": free}
 
     def _init_page_store(self, primed_state) -> None:
         """First-admission pool build: one device page array per paged

@@ -10,8 +10,8 @@ back-compat with pre-serving/ imports.
 
 from __future__ import annotations
 
-__all__ = ["EngineShutdown", "InferenceTimeout", "RequestCancelled",
-           "ServingOverloaded", "ServingQueueFull"]
+__all__ = ["EngineShutdown", "InferenceTimeout", "NoReplicaAvailable",
+           "RequestCancelled", "ServingOverloaded", "ServingQueueFull"]
 
 
 class InferenceTimeout(TimeoutError):
@@ -28,6 +28,13 @@ class RequestCancelled(RuntimeError):
 
 class EngineShutdown(RuntimeError):
     """The serving component stopped before this request finished."""
+
+
+class NoReplicaAvailable(EngineShutdown):
+    """The fleet router found no healthy replica to take a request (or
+    to re-admit a migrated one). Subclasses :class:`EngineShutdown` so
+    single-engine error handling written against the engine contract
+    sees the same failure class behind a router."""
 
 
 class ServingOverloaded(RuntimeError):

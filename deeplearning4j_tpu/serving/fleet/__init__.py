@@ -1,0 +1,29 @@
+"""Serving fleet: multi-replica router, live migration, autoscaling.
+
+One ``FleetRouter`` fronts N ``GenerationEngine`` replicas behind the
+familiar submit/stream API: placement routes by prefix-cache affinity
+(requests sharing a system-prompt block land where their pages are
+warm) with a least-loaded fallback scored from public engine accessors;
+live migration moves in-flight requests between replicas as
+``RequestLedgerEntry`` records — the PR 9 rebuild payload made public —
+so every stream continues bit-identically after a replica death,
+drain, or rebalance; and a signal-driven autoscaler turns the existing
+queue/page-pressure/brownout signals into hysteresis-guarded
+scale-out/in, draining through migration on the way down. Replica
+membership rides the PR 8 elastic lease ledger in replica mode
+(``role="serving"``). See ARCHITECTURE.md "Serving fleet".
+"""
+
+from deeplearning4j_tpu.serving.fleet.autoscale import (  # noqa: F401
+    AutoscaleConfig, FleetAutoscaler, FleetSignals)
+from deeplearning4j_tpu.serving.fleet.membership import (  # noqa: F401
+    REPLICA_ROLE, FleetMembership)
+from deeplearning4j_tpu.serving.fleet.migration import (  # noqa: F401
+    MigrationReport, readmit_entries)
+from deeplearning4j_tpu.serving.fleet.router import (  # noqa: F401
+    FleetConfig, FleetReplica, FleetRouter)
+
+__all__ = ["AutoscaleConfig", "FleetAutoscaler", "FleetConfig",
+           "FleetMembership", "FleetReplica", "FleetRouter",
+           "FleetSignals", "MigrationReport", "REPLICA_ROLE",
+           "readmit_entries"]

@@ -1,0 +1,168 @@
+"""Signal-driven fleet autoscaling with hysteresis.
+
+The autoscaler is pure host-side POLICY over signals the serving stack
+already emits — aggregate queue depth (``AdmissionQueue.snapshot``),
+arena occupancy and free-page fraction (``engine.health()``), and the
+overload controller's brownout rung / breach evidence
+(``health()["overload"]``). It never touches an engine: the router
+collects a :class:`FleetSignals` snapshot per tick, the autoscaler
+returns ``"out"`` / ``"in"`` / ``None``, and the router executes
+(factory-spawn on scale-out, ledger migration + shutdown on scale-in).
+
+Hysteresis is double: a decision needs the condition SUSTAINED for N
+consecutive ticks (``out_ticks`` / ``in_ticks`` — one slow request
+must not buy a replica), and any action opens a ``cooldown_s`` window
+during which no further action fires (the replica just added needs
+time to absorb load before the signals are believed again). An
+oscillating load trace therefore produces zero actions unless one
+phase outlasts the streak requirement — the no-flapping contract the
+fleet parity suite pins.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+__all__ = ["AutoscaleConfig", "FleetAutoscaler", "FleetSignals"]
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetSignals:
+    """One tick's aggregate fleet observation (collected by the router
+    from public engine accessors only)."""
+
+    replicas: int
+    slots: int                  # total arena slots across replicas
+    active: int                 # occupied slots across replicas
+    queued: int                 # aggregate admission-queue depth
+    free_page_frac: Optional[float]  # min over replicas; None unpaged
+    brownout_max: int           # worst brownout rung across replicas
+
+    @property
+    def queue_per_slot(self) -> float:
+        return self.queued / max(1, self.slots)
+
+    @property
+    def active_frac(self) -> float:
+        return self.active / max(1, self.slots)
+
+    @classmethod
+    def collect(cls, healths: List[dict],
+                queue_depths: List[int]) -> "FleetSignals":
+        """Aggregate per-replica ``engine.health()`` payloads + queue
+        depths into one fleet observation."""
+        free = None
+        brownout = 0
+        for h in healths:
+            kv = h.get("kv_pages")
+            if kv and kv.get("total"):
+                f = kv["free"] / kv["total"]
+                free = f if free is None else min(free, f)
+            ov = h.get("overload")
+            if ov:
+                brownout = max(brownout, int(ov["brownout_level"]))
+        return cls(replicas=len(healths),
+                   slots=sum(h["slots"] for h in healths),
+                   active=sum(h["active_slots"] for h in healths),
+                   queued=sum(queue_depths),
+                   free_page_frac=free, brownout_max=brownout)
+
+
+@dataclasses.dataclass
+class AutoscaleConfig:
+    """Knobs for :class:`FleetAutoscaler`.
+
+    Scale OUT when any pressure signal holds for ``out_ticks``
+    consecutive ticks: aggregate queued work above
+    ``out_queue_per_slot`` per slot, the worst free-page fraction under
+    ``out_free_page_frac`` (the page-pressure signal the brownout
+    ladder also reads — browning out masks the pressure, a new replica
+    removes it), or a brownout rung at/above ``out_brownout_level``.
+
+    Scale IN when the fleet is demonstrably idle for ``in_ticks``
+    ticks: queue near-empty (below ``in_queue_per_slot``) AND mean slot
+    occupancy under ``in_active_frac`` — and only down to
+    ``min_replicas``. Scale-in is deliberately slower to earn than
+    scale-out (longer streak): releasing a warm replica costs its
+    prefix cache and a migration.
+
+    ``cooldown_s`` gates BOTH directions after any action."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    out_queue_per_slot: float = 1.0
+    out_free_page_frac: float = 0.10
+    out_brownout_level: int = 2
+    in_queue_per_slot: float = 0.05
+    in_active_frac: float = 0.35
+    out_ticks: int = 3
+    in_ticks: int = 6
+    cooldown_s: float = 5.0
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError(f"min_replicas must be >= 1, got "
+                             f"{self.min_replicas}")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"max_replicas ({self.max_replicas}) must be >= "
+                f"min_replicas ({self.min_replicas})")
+        if self.out_ticks < 1 or self.in_ticks < 1:
+            raise ValueError("out_ticks/in_ticks must be >= 1")
+        if self.cooldown_s < 0:
+            raise ValueError(f"cooldown_s must be >= 0, got "
+                             f"{self.cooldown_s}")
+
+
+class FleetAutoscaler:
+    """Streak + cooldown hysteresis over :class:`FleetSignals`."""
+
+    def __init__(self, config: Optional[AutoscaleConfig] = None):
+        self.config = config if config is not None else AutoscaleConfig()
+        self._out_streak = 0
+        self._in_streak = 0
+        self._last_action_t: Optional[float] = None
+        self.decisions = 0
+
+    # -- the per-tick condition tests ----------------------------------
+    def _pressure(self, s: FleetSignals) -> bool:
+        c = self.config
+        if s.queue_per_slot > c.out_queue_per_slot:
+            return True
+        if s.free_page_frac is not None \
+                and s.free_page_frac < c.out_free_page_frac:
+            return True
+        return s.brownout_max >= c.out_brownout_level
+
+    def _idle(self, s: FleetSignals) -> bool:
+        c = self.config
+        return (s.queue_per_slot <= c.in_queue_per_slot
+                and s.active_frac < c.in_active_frac)
+
+    def decide(self, signals: FleetSignals, now: float) -> Optional[str]:
+        """One autoscale tick: ``"out"`` / ``"in"`` / ``None``. Streaks
+        update every tick; a decision fires only once its streak
+        reaches the threshold OUTSIDE the cooldown window, and firing
+        resets both streaks (fresh post-action evidence required)."""
+        c = self.config
+        self._out_streak = self._out_streak + 1 \
+            if self._pressure(signals) else 0
+        self._in_streak = self._in_streak + 1 \
+            if self._idle(signals) else 0
+        if self._last_action_t is not None \
+                and now - self._last_action_t < c.cooldown_s:
+            return None
+        if self._out_streak >= c.out_ticks \
+                and signals.replicas < c.max_replicas:
+            self._out_streak = self._in_streak = 0
+            self._last_action_t = now
+            self.decisions += 1
+            return "out"
+        if self._in_streak >= c.in_ticks \
+                and signals.replicas > c.min_replicas:
+            self._out_streak = self._in_streak = 0
+            self._last_action_t = now
+            self.decisions += 1
+            return "in"
+        return None

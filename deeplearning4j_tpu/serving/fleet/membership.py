@@ -1,0 +1,165 @@
+"""Fleet replica membership: replica-role leases + generation records.
+
+The serving fleet tracks replicas the way PR 8's elastic trainer tracks
+training ranks — and on the SAME primitives (``resilience/elastic.py``):
+every replica heartbeats a lease under its replica id with
+``role="serving"`` stamped into each beat (a training rank and a
+serving replica can share one ledger directory without miscounting each
+other), an expired lease is a dead replica, and every membership change
+(join, death, scale-in) publishes an immutable, monotonically numbered
+``GenerationRecord`` through the same fsynced exclusive-create path the
+trainer's split-brain tiebreak uses. The generation number is the
+router's fencing token: telemetry, migration reports, and a future
+multi-router deployment all agree on "which fleet was that" by
+generation, not by wall clock.
+
+Filesystem membership is OPTIONAL (``root=None``): an in-process fleet
+(tests, single-host serving) detects death through
+``engine.is_healthy()`` alone and keeps a process-local generation
+counter; pointing ``root`` at a shared directory adds the lease
+machinery a multi-process deployment needs — including detection of a
+replica whose PROCESS died (its engine object unreachable, its lease
+simply stops beating).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from deeplearning4j_tpu.resilience.elastic import (
+    GenerationRecord, LeaseLedger)
+
+log = logging.getLogger(__name__)
+
+__all__ = ["FleetMembership", "REPLICA_ROLE"]
+
+#: the lease role serving replicas beat with (train ranks carry none
+#: or their own role; live_ranks(role=REPLICA_ROLE) sees only replicas)
+REPLICA_ROLE = "serving"
+
+
+class FleetMembership:
+    """Replica lease + generation bookkeeping for one fleet router.
+
+    ``join(rid)`` starts a heartbeating lease for a replica,
+    ``leave(rid)`` withdraws it (orderly scale-in: peers see the
+    replica gone at the next read instead of waiting out the ttl), and
+    ``expired(rids)`` reports which tracked replicas' leases lapsed —
+    the death signal for a replica whose process stopped beating even
+    though the router cannot observe its engine. ``publish(members)``
+    bumps the generation and (with a root) writes the generation
+    record.
+
+    Thread-safe: the router's poll loop and submit path may consult it
+    concurrently.
+    """
+
+    def __init__(self, root: Optional[str] = None, ttl: float = 2.0,
+                 role: str = REPLICA_ROLE):
+        self.root = root
+        self.ttl = float(ttl)
+        self.role = role
+        self._mu = threading.Lock()
+        self._leases: Dict[int, LeaseLedger] = {}
+        self._reader: Optional[LeaseLedger] = None
+        self.generation = 0
+        if root is not None:
+            # a read/publish-only ledger: rank -1 never heartbeats, so
+            # no lease file ever claims the router itself is a replica
+            self._reader = LeaseLedger(root, rank=-1, ttl=self.ttl,
+                                       role=role)
+            latest = self._reader.latest_generation()
+            if latest is not None:
+                self.generation = latest.generation
+
+    @property
+    def enabled(self) -> bool:
+        """Whether filesystem leases back this membership (False = the
+        in-process mode: engine health is the only death signal)."""
+        return self._reader is not None
+
+    # -- replica lifecycle ---------------------------------------------
+    def join(self, rid: int) -> None:
+        """Start heartbeating a lease for replica `rid` (no-op without
+        a root)."""
+        if self._reader is None:
+            return
+        with self._mu:
+            if rid in self._leases:
+                return
+            lease = LeaseLedger(self.root, rank=int(rid), ttl=self.ttl,
+                                role=self.role)
+            lease.start(self.generation)
+            self._leases[rid] = lease
+
+    def leave(self, rid: int) -> None:
+        """Withdraw and stop replica `rid`'s lease (orderly leave)."""
+        with self._mu:
+            lease = self._leases.pop(rid, None)
+        if lease is not None:
+            lease.stop()
+            lease.withdraw()
+
+    def lease(self, rid: int) -> Optional[LeaseLedger]:
+        """The heartbeating lease for `rid` (None without a root) —
+        the chaos seam: ``lease.stall()`` simulates a hung replica."""
+        with self._mu:
+            return self._leases.get(rid)
+
+    # -- death detection -----------------------------------------------
+    def expired(self, rids: Sequence[int]) -> List[int]:
+        """Tracked replicas among `rids` whose lease lapsed (empty
+        without a root: lease expiry is then not a signal)."""
+        if self._reader is None:
+            return []
+        live = set(self._reader.live_ranks(role=self.role))
+        return [r for r in rids if r not in live]
+
+    # -- generations ----------------------------------------------------
+    def publish(self, members: Sequence[int], publisher: int = -1) -> int:
+        """Advance the fleet generation over the given member set and
+        (with a root) publish the record. An empty member set still
+        bumps the local generation — the fleet-of-zero moment mid
+        scale-from-death — but publishes nothing (generation records
+        are non-empty by contract). Returns the new generation."""
+        with self._mu:
+            self.generation += 1
+            gen = self.generation
+        members = sorted(int(m) for m in members)
+        if self._reader is not None and members:
+            while True:
+                rec = GenerationRecord(generation=gen, members=members,
+                                       coordinator="",
+                                       published_by=int(publisher))
+                adopted = self._reader.publish_generation(rec)
+                if adopted.to_dict() == rec.to_dict():
+                    break
+                # lost the exclusive-create race: the on-disk record at
+                # this number is ANOTHER publisher's fleet view —
+                # publish_generation returns it with the SAME number,
+                # so converging means re-publishing OUR member set at
+                # its successor, not adopting its membership
+                gen = adopted.generation + 1
+            with self._mu:
+                self.generation = gen
+            for lease in list(self._leases.values()):
+                lease.heartbeat(gen)       # re-stamp the beat stream
+        return gen
+
+    def record(self) -> Optional[GenerationRecord]:
+        """The latest on-disk generation record (None without a root
+        or before the first publish)."""
+        if self._reader is None:
+            return None
+        return self._reader.latest_generation()
+
+    def stop(self) -> None:
+        """Stop every lease thread (shutdown); leases are withdrawn so
+        a later reader doesn't wait out the ttl."""
+        with self._mu:
+            leases, self._leases = dict(self._leases), {}
+        for lease in leases.values():
+            lease.stop()
+            lease.withdraw()

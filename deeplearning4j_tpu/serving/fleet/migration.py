@@ -1,0 +1,137 @@
+"""Ledger-based live request migration between replicas.
+
+A replica's in-flight requests are fully described by its HOST-side
+request ledger — the PR 9 rebuild payload, public since
+``serving/request.RequestLedgerEntry``: prompt, committed ids (last =
+the pending token), per-request rng at its exact draw position, and
+sampling config. Migration is therefore the supervisor's quarantine
+pointed at a DIFFERENT engine: export the source's ledger
+(``detach_ledger`` — everything in flight, no terminal events, source
+left empty and draining), place each entry with the router's own
+placement function (so a migrated stream lands where its prefix is
+warm), and ``admit_from_ledger`` on the target — streamed survivors
+re-prime ``ids[:-1]`` with their pending token and untouched rng, so
+every stream continues bit-identically to an unperturbed run
+(test-pinned, greedy and sampled).
+
+Three triggers, one mechanism:
+
+- **planned** (scale-in / rollout): the full ``detach_ledger`` export —
+  actives move instead of waiting out ``drain()``'s natural
+  retirements;
+- **death** (lease expiry or ``is_healthy()`` down): the same export
+  runs post-mortem — the ledger is host memory and outlives the device
+  arena; a replica that reached its terminal ``_break`` already failed
+  its handles and exports empty (fail-all happened before the fleet
+  could act);
+- **overload rebalance**: only the QUEUED (never-prefilled) tail moves
+  (``detach_queued``) — queued work migrates for free while actives
+  keep their warm KV.
+
+Entries that find no live target are failed with
+:class:`~deeplearning4j_tpu.serving.errors.NoReplicaAvailable` — a
+terminal event on every path, nobody blocks on a dead fleet.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Callable, Dict, List, Optional, Sequence
+
+from deeplearning4j_tpu.serving.errors import (
+    EngineShutdown, NoReplicaAvailable)
+from deeplearning4j_tpu.serving.request import RequestLedgerEntry
+
+log = logging.getLogger(__name__)
+
+__all__ = ["MigrationReport", "readmit_entries"]
+
+#: migration cause labels (the ``dl4jtpu_fleet_migrations_total`` label
+#: vocabulary; also stamped into every report)
+CAUSE_DEATH = "death"
+CAUSE_SCALE_IN = "scale_in"
+CAUSE_OVERLOAD = "overload"
+
+
+@dataclasses.dataclass
+class MigrationReport:
+    """What one migration did: per-target re-admission counts, entries
+    resolved dead on the way (cancel/deadline — they get their terminal
+    event during re-admission, same as the supervisor's recovery), and
+    entries failed because no replica could take them."""
+
+    cause: str
+    source: Optional[int] = None
+    exported: int = 0
+    admitted: int = 0
+    resolved_dead: int = 0
+    failed: int = 0
+    per_target: Dict[int, int] = dataclasses.field(default_factory=dict)
+
+    @property
+    def moved(self) -> int:
+        return self.admitted
+
+
+def readmit_entries(entries: Sequence[RequestLedgerEntry],
+                    place: Callable,
+                    cause: str,
+                    source: Optional[int] = None) -> MigrationReport:
+    """Re-admit exported ledger entries across live replicas.
+
+    ``place(prompt, exclude)`` is the router's placement function —
+    it returns a replica (an object with ``rid`` and ``engine``) or
+    raises :class:`NoReplicaAvailable`; affinity applies, so a stream
+    whose system-prompt block is cached on a survivor goes home to it.
+    A target that turns out shut down mid-migration is excluded and the
+    entry re-placed; entries nobody can take are failed terminally."""
+    report = MigrationReport(cause=cause, source=source,
+                             exported=len(entries))
+    for entry in entries:
+        req = entry.request
+        if req.handle.done:
+            report.resolved_dead += 1
+            continue
+        exclude: set = set()
+        while True:
+            try:
+                rep = place(req.prompt, exclude)
+            except NoReplicaAvailable as e:
+                entry.resolve(e)
+                report.failed += 1
+                break
+            try:
+                took = rep.engine.admit_from_ledger(
+                    [entry], where=f"during {cause} migration")
+            except EngineShutdown:
+                # the target died/drained between placement and
+                # admission: never hand it back the same entry
+                exclude.add(rep.rid)
+                continue
+            except BaseException as e:  # noqa: BLE001 — strand nobody
+                # a post-prime admission fault on the target (arena
+                # build/merge — past _admit_one's per-request prefill
+                # domain): resolve THIS entry terminally and keep
+                # migrating the rest. The source is already empty, so
+                # an aborted migration would leave every remaining
+                # entry owned by no engine with no terminal event; the
+                # target's own supervisor/step path owns its arena
+                # health from here.
+                entry.resolve(e)
+                report.failed += 1
+                break
+            if took:
+                report.admitted += took
+                report.per_target[rep.rid] = \
+                    report.per_target.get(rep.rid, 0) + took
+            elif req.handle.done:
+                report.resolved_dead += 1   # cancel/deadline resolved
+            break
+    if report.exported:
+        log.info(
+            "fleet migration (%s) from replica %s: %d exported, "
+            "%d re-admitted %s, %d resolved dead, %d unplaceable",
+            cause, source, report.exported, report.admitted,
+            dict(report.per_target), report.resolved_dead, report.failed)
+    return report

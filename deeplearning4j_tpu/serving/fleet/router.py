@@ -1,0 +1,601 @@
+"""FleetRouter: N GenerationEngine replicas behind one submit API.
+
+The single-engine stack (PRs 5–10) serves one device; the
+millions-of-users story needs N replicas behind a router — the L7
+analog of DL4J's worker-pooled ParallelInference, built TPU-native
+from parts the repo already proved out:
+
+- **Placement** scores replicas by PREFIX-CACHE AFFINITY first: the
+  fingerprint of a prompt's leading full block (the system-prompt
+  block, sized to the replicas' KV page size) maps to the replica that
+  last served it, so requests sharing a system prompt land where their
+  prefix pages are warm and prime only their suffix. On an affinity
+  miss (or an unavailable owner) placement falls back to least-loaded:
+  ``score = (queue_depth + active_slots) / slots − w · free_page_frac``
+  over the PUBLIC accessors only (``health()``, ``queue_snapshot()``)
+  — the tpulint rule ``replica-local-state-in-router`` holds the fleet
+  layer to that seam.
+- **Live migration** (``serving/fleet/migration.py``) moves in-flight
+  requests between replicas as request-ledger entries
+  (``RequestLedgerEntry`` — the PR 9 rebuild payload made public), so
+  every stream continues bit-identically on its new replica. Triggers:
+  replica death (``is_healthy()`` down, or lease expiry through the
+  replica-mode membership ledger), planned scale-in, and sustained
+  overload (queued tail rebalanced to an idle replica).
+- **Autoscaling** (``serving/fleet/autoscale.py``) turns the existing
+  overload/page-pressure/queue signals into scale-out (factory-spawn a
+  replica) and scale-in (migrate, then retire the emptiest replica)
+  decisions with streak+cooldown hysteresis.
+
+Replicas are assumed HOMOGENEOUS — the ``factory(rid)`` callable
+returns engines over identically-parameterized nets (same checkpoint,
+same config), which is what makes placement a pure performance choice:
+any replica produces bit-identical tokens for any request, so routed
+output == single-engine output == one-shot ``sample_stream``
+(test-pinned, greedy and sampled, kill-a-replica included).
+
+Drive it manually (``submit()`` + ``step()``/``run_until_idle()`` —
+the deterministic test/bench shape; ``poll()`` runs detection/scaling
+explicitly) or ``start()`` the replicas' background loops plus the
+router's poll thread. See ARCHITECTURE.md "Serving fleet".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Tuple
+
+from deeplearning4j_tpu.monitoring.metrics import (
+    MetricsRegistry, global_registry)
+from deeplearning4j_tpu.serving.errors import (
+    EngineShutdown, NoReplicaAvailable, ServingOverloaded,
+    ServingQueueFull)
+from deeplearning4j_tpu.serving.fleet import migration as mig
+from deeplearning4j_tpu.serving.fleet.autoscale import (
+    AutoscaleConfig, FleetAutoscaler, FleetSignals)
+from deeplearning4j_tpu.serving.fleet.membership import FleetMembership
+from deeplearning4j_tpu.serving.health import (
+    FLEET_AFFINITY_HITS, FLEET_AFFINITY_MISSES, FLEET_DEAD_REPLICAS,
+    FLEET_GENERATION, FLEET_MIGRATED_REQUESTS, FLEET_MIGRATIONS,
+    FLEET_REPLICAS, FLEET_ROUTED, FLEET_SCALE_EVENTS, scrape_probe)
+
+log = logging.getLogger(__name__)
+
+__all__ = ["FleetConfig", "FleetReplica", "FleetRouter"]
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """Router knobs.
+
+    ``affinity`` routes by the leading-block fingerprint;
+    ``affinity_block`` is the fingerprint length in tokens (default:
+    the replicas' KV page size, so the fingerprint is exactly one
+    cacheable block; 16 when unpaged) and ``affinity_capacity`` bounds
+    the fingerprint→replica map (LRU). ``free_weight`` is the
+    free-page-fraction weight in the least-loaded score.
+
+    ``rebalance_queue_wait_s`` arms overload rebalancing: when a
+    replica's oldest queued request has waited at least this long AND
+    another replica scores at least ``rebalance_load_margin`` lower,
+    the queued tail migrates there (None disables). ``membership_root``
+    + ``lease_ttl_s`` enable filesystem replica leases
+    (``serving/fleet/membership.py``); ``poll_interval_s`` paces the
+    started router's poll thread."""
+
+    affinity: bool = True
+    affinity_block: Optional[int] = None
+    affinity_capacity: int = 512
+    free_weight: float = 0.5
+    rebalance_queue_wait_s: Optional[float] = None
+    rebalance_load_margin: float = 0.5
+    membership_root: Optional[str] = None
+    lease_ttl_s: float = 2.0
+    poll_interval_s: float = 0.25
+
+    def __post_init__(self):
+        if self.affinity_block is not None and self.affinity_block < 1:
+            raise ValueError(f"affinity_block must be >= 1, got "
+                             f"{self.affinity_block}")
+        if self.affinity_capacity < 1:
+            raise ValueError(f"affinity_capacity must be >= 1, got "
+                             f"{self.affinity_capacity}")
+
+
+class FleetReplica:
+    """One replica: a stable id + its engine. Public by design — the
+    fleet layer reads engines through their public accessors only."""
+
+    def __init__(self, rid: int, engine):
+        self.rid = rid
+        self.engine = engine
+
+    def __repr__(self):
+        return f"FleetReplica(rid={self.rid})"
+
+
+class FleetRouter:
+    """Prefix-affinity router over N homogeneous engine replicas."""
+
+    def __init__(self, factory: Callable, replicas: int = 1,
+                 config: Optional[FleetConfig] = None,
+                 autoscale: Optional[AutoscaleConfig] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 name: str = "fleet"):
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        self._factory = factory
+        self.config = config if config is not None else FleetConfig()
+        self._autoscaler = (FleetAutoscaler(autoscale)
+                            if autoscale is not None else None)
+        if self._autoscaler is not None \
+                and replicas < self._autoscaler.config.min_replicas:
+            replicas = self._autoscaler.config.min_replicas
+        self._label = name
+        self.membership = FleetMembership(self.config.membership_root,
+                                          ttl=self.config.lease_ttl_s)
+        self._mu = threading.RLock()
+        self._replicas: "OrderedDict[int, FleetReplica]" = OrderedDict()
+        self._next_rid = 0
+        #: leading-block fingerprint -> owning replica id (LRU-bounded)
+        self._affinity: "OrderedDict[Tuple, int]" = OrderedDict()
+        self._block: Optional[int] = self.config.affinity_block
+        self._started = False
+        self._stop = threading.Event()
+        self._poll_thread: Optional[threading.Thread] = None
+        self.migrations = 0
+        self.migrated_requests = 0
+        self.scale_events = 0
+        self._register_metrics(registry)
+        for _ in range(replicas):
+            self._add_replica()
+
+    # ------------------------------------------------------------------
+    # telemetry
+    # ------------------------------------------------------------------
+    def _register_metrics(self, registry) -> None:
+        r = registry or global_registry()
+        lab = dict(fleet=self._label)
+        r.gauge(FLEET_REPLICAS, "Live replicas behind the fleet router",
+                ("fleet",)).set_function(
+            scrape_probe(self, lambda s: len(s.replicas())), **lab)
+        r.gauge(FLEET_GENERATION, "Fleet membership generation",
+                ("fleet",)).set_function(
+            scrape_probe(self, lambda s: s.membership.generation), **lab)
+        self._routed = r.counter(
+            FLEET_ROUTED, "Requests routed, by replica",
+            ("fleet", "replica"))
+        self._affinity_hits = r.counter(
+            FLEET_AFFINITY_HITS, "Placements that followed a warm "
+            "prefix-affinity mapping", ("fleet",)).labels(**lab)
+        self._affinity_misses = r.counter(
+            FLEET_AFFINITY_MISSES, "Placements that fell back to "
+            "least-loaded scoring", ("fleet",)).labels(**lab)
+        self._migrations_c = r.counter(
+            FLEET_MIGRATIONS, "Live migrations, by cause",
+            ("fleet", "cause"))
+        for cause in (mig.CAUSE_DEATH, mig.CAUSE_SCALE_IN,
+                      mig.CAUSE_OVERLOAD):
+            self._migrations_c.labels(fleet=self._label, cause=cause)
+        self._migrated_c = r.counter(
+            FLEET_MIGRATED_REQUESTS, "Requests re-admitted on another "
+            "replica by live migration", ("fleet",)).labels(**lab)
+        self._dead_c = r.counter(
+            FLEET_DEAD_REPLICAS, "Replicas declared dead (health down "
+            "or lease expired)", ("fleet",)).labels(**lab)
+        self._scale_c = r.counter(
+            FLEET_SCALE_EVENTS, "Autoscaler actions, by direction",
+            ("fleet", "direction"))
+        for d in ("out", "in"):
+            self._scale_c.labels(fleet=self._label, direction=d)
+
+    # ------------------------------------------------------------------
+    # replica lifecycle
+    # ------------------------------------------------------------------
+    def _add_replica(self, direction: Optional[str] = None
+                     ) -> FleetReplica:
+        with self._mu:
+            rid = self._next_rid
+            self._next_rid += 1
+        engine = self._factory(rid)
+        rep = FleetReplica(rid, engine)
+        with self._mu:
+            self._replicas[rid] = rep
+            members = list(self._replicas)
+        self.membership.join(rid)
+        self.membership.publish(members, publisher=rid)
+        if self._started:
+            engine.start()
+        if direction is not None:
+            self.scale_events += 1
+            self._scale_c.labels(fleet=self._label,
+                                 direction=direction).inc()
+        log.info("fleet %s: replica %d joined (generation %d, %d live)",
+                 self._label, rid, self.membership.generation,
+                 len(members))
+        return rep
+
+    def _drop_replica(self, rep: FleetReplica) -> None:
+        with self._mu:
+            self._replicas.pop(rep.rid, None)
+            members = list(self._replicas)
+            # drop the dead owner's affinity mappings: the next request
+            # per fingerprint re-places (and re-warms) on a survivor
+            stale = [fp for fp, rid in self._affinity.items()
+                     if rid == rep.rid]
+            for fp in stale:
+                del self._affinity[fp]
+        self.membership.leave(rep.rid)
+        self.membership.publish(members)
+
+    def replicas(self) -> List[FleetReplica]:
+        with self._mu:
+            return list(self._replicas.values())
+
+    def replica(self, rid: int) -> Optional[FleetReplica]:
+        with self._mu:
+            return self._replicas.get(rid)
+
+    # ------------------------------------------------------------------
+    # placement
+    # ------------------------------------------------------------------
+    def _default_block(self) -> int:
+        """Fingerprint block length: the replicas' KV page size (one
+        cacheable block — affinity tracks exactly what the prefix cache
+        can reuse), 16 tokens unpaged. Resolved once from the first
+        replica's health payload."""
+        if self._block is None:
+            block = 16
+            for rep in self.replicas():
+                kv = rep.engine.health().get("kv_pages")
+                if kv:
+                    block = int(kv["page_size"])
+                break
+            self._block = block
+        return self._block
+
+    def _fingerprint(self, prompt) -> Optional[Tuple]:
+        """The leading full block of the prompt, or None when it has no
+        full block to share (too short to ever hit the prefix cache)."""
+        if not self.config.affinity:
+            return None
+        bs = self._default_block()
+        if len(prompt) <= bs:
+            return None
+        return tuple(prompt[:bs])
+
+    def _score(self, rep: FleetReplica) -> float:
+        """Least-loaded placement score (lower = better): occupancy +
+        queue backlog per slot, discounted by free KV headroom. Reads
+        the engine's narrow ``load_stats()`` payload — the hot submit
+        path must not build the full health() dict per candidate."""
+        s = rep.engine.load_stats()
+        load = (s["queue_depth"] + s["active_slots"]) \
+            / max(1, s["slots"])
+        return load - self.config.free_weight * s["free_page_frac"]
+
+    def _place(self, prompt, exclude=()) -> FleetReplica:
+        """Pick the replica for `prompt`: the affinity owner when it is
+        live and admitting, else the best-scoring live replica (and the
+        fingerprint adopts it). Raises NoReplicaAvailable when nothing
+        healthy remains."""
+        with self._mu:
+            cands = [r for r in self._replicas.values()
+                     if r.rid not in exclude and r.engine.is_healthy()]
+            if not cands:
+                raise NoReplicaAvailable(
+                    f"fleet {self._label}: no healthy replica "
+                    f"(generation {self.membership.generation})")
+            ready = [r for r in cands if r.engine.is_ready()] or cands
+            fp = self._fingerprint(prompt)
+            if fp is not None:
+                rid = self._affinity.get(fp)
+                if rid is not None:
+                    rep = self._replicas.get(rid)
+                    if rep is not None and rep in ready:
+                        self._affinity.move_to_end(fp)
+                        self._affinity_hits.inc()
+                        return rep
+            best = min(ready, key=self._score)
+            if fp is not None:
+                self._affinity[fp] = best.rid
+                self._affinity.move_to_end(fp)
+                while len(self._affinity) > self.config.affinity_capacity:
+                    self._affinity.popitem(last=False)
+                self._affinity_misses.inc()
+            return best
+
+    # ------------------------------------------------------------------
+    # the submit/stream API (mirrors GenerationEngine.submit)
+    # ------------------------------------------------------------------
+    def submit(self, prompt, steps: int, **kw):
+        """Route one prompt to a replica and submit it there; returns
+        the replica engine's ``GenerationStream`` handle (same contract
+        as ``GenerationEngine.submit``). A replica that refuses —
+        drained/broken (``EngineShutdown``), queue-full, or
+        overload-rejecting — is excluded and the request re-placed;
+        only when EVERY live replica refuses does the last refusal
+        propagate."""
+        prompt = [int(t) for t in prompt]
+        exclude: set = set()
+        last: Optional[BaseException] = None
+        while True:
+            try:
+                rep = self._place(prompt, exclude)
+            except NoReplicaAvailable:
+                if last is not None:
+                    raise last
+                raise
+            try:
+                handle = rep.engine.submit(prompt, steps, **kw)
+            except (EngineShutdown, ServingQueueFull,
+                    ServingOverloaded) as e:
+                exclude.add(rep.rid)
+                last = e
+                continue
+            self._routed.labels(fleet=self._label,
+                                replica=str(rep.rid)).inc()
+            return handle
+
+    # ------------------------------------------------------------------
+    # detection / rebalance / scaling (the poll cycle)
+    # ------------------------------------------------------------------
+    def poll(self, now: Optional[float] = None) -> dict:
+        """One control-plane cycle: declare dead replicas (health down
+        or lease expired) and migrate their ledgers to survivors;
+        rebalance a sustained queue backlog onto an idle replica; run
+        one autoscaler tick. Returns a summary dict (tests/bench
+        introspection)."""
+        now = time.monotonic() if now is None else now
+        out = {"dead": [], "migrated": 0, "rebalanced": 0,
+               "respawned": [], "scaled": None}
+        reps = self.replicas()
+        expired = set(self.membership.expired([r.rid for r in reps]))
+        dead = [rep for rep in reps
+                if not rep.engine.is_healthy() or rep.rid in expired]
+        if dead and self._autoscaler is not None:
+            # re-establish the autoscaler's floor BEFORE migrating, so
+            # the dead replicas' ledgers have somewhere to land — else
+            # losing the last replica would fail every in-flight stream
+            # and brick the fleet (signals over zero replicas can never
+            # read as pressure, so scale-out would never fire again)
+            floor = self._autoscaler.config.min_replicas
+            for _ in range(max(0, floor - (len(reps) - len(dead)))):
+                out["respawned"].append(self._add_replica().rid)
+        for rep in dead:
+            out["dead"].append(rep.rid)
+            self._dead_c.inc()
+            report = self._migrate_from(rep, mig.CAUSE_DEATH)
+            out["migrated"] += report.admitted
+        if self.config.rebalance_queue_wait_s is not None:
+            out["rebalanced"] = self._rebalance()
+        if self._autoscaler is not None:
+            out["scaled"] = self._autoscale_tick(now)
+        return out
+
+    def _migrate_from(self, rep: FleetReplica,
+                      cause: str) -> mig.MigrationReport:
+        """Export `rep`'s whole ledger, drop it from the fleet, and
+        re-admit every entry through placement (affinity first — a
+        migrated stream goes where its prefix is warm).
+
+        The export waits on the replica's engine lock only BOUNDEDLY:
+        a lease-expired replica may be hung INSIDE a dispatch with the
+        lock held, and the poll thread is the whole control plane — it
+        must not deadlock on one wedged engine. On timeout the replica
+        is dropped from routing with nothing exported (a wedged
+        in-process engine's streams cannot be reached from outside its
+        lock; a multi-process deployment re-admits from persisted
+        ledger payloads or client resubmission)."""
+        try:
+            entries = rep.engine.detach_ledger(lock_timeout=5.0)
+        except TimeoutError:
+            log.error(
+                "fleet %s: replica %d is wedged (engine lock held "
+                "through the detach timeout) — dropping it from "
+                "routing with its ledger unexported", self._label,
+                rep.rid)
+            self._drop_replica(rep)
+            self.migrations += 1
+            self._migrations_c.labels(fleet=self._label,
+                                      cause=cause).inc()
+            return mig.MigrationReport(cause=cause, source=rep.rid)
+        self._drop_replica(rep)
+        report = mig.readmit_entries(entries, self._place, cause,
+                                     source=rep.rid)
+        self.migrations += 1
+        self.migrated_requests += report.admitted
+        self._migrations_c.labels(fleet=self._label, cause=cause).inc()
+        self._migrated_c.inc(report.admitted)
+        rep.engine.shutdown()     # nothing in flight: a clean stop
+        return report
+
+    def _rebalance(self) -> int:
+        """Overload rebalance: a replica whose oldest queued request
+        outwaited the threshold hands its queued tail to a replica
+        scoring at least the margin lower. Actives never move here —
+        their KV is warm where they sit. At most ONE source per poll
+        cycle: moved requests keep their original submit times, so a
+        same-cycle second pass would read the target as instantly
+        overloaded and ping-pong the tail straight back."""
+        moved = 0
+        for rep in self.replicas():
+            if not rep.engine.is_healthy():
+                continue
+            snap = rep.engine.queue_snapshot()
+            if not snap.depth or snap.oldest_wait_s is None or \
+                    snap.oldest_wait_s < self.config.rebalance_queue_wait_s:
+                continue
+            src_score = self._score(rep)
+            # a target must be able to actually SEAT moved work (free
+            # slots and an empty queue), and the move is CAPPED at its
+            # free-slot count: migrated requests keep their original
+            # submit times, so handing a target more than it can seat
+            # would read as an over-threshold source on the NEXT poll
+            # and bounce the tail straight back — cross-cycle ping-pong
+            scored = []
+            for r in self.replicas():
+                if r.rid == rep.rid or not r.engine.is_healthy() \
+                        or not r.engine.is_ready():
+                    continue
+                stats = r.engine.load_stats()
+                if stats["queue_depth"] == 0 \
+                        and stats["active_slots"] < stats["slots"]:
+                    scored.append((self._score(r), r,
+                                   stats["slots"]
+                                   - stats["active_slots"]))
+            if not scored:
+                continue
+            score_best, best, free_slots = min(scored,
+                                               key=lambda t: t[0])
+            if src_score - score_best \
+                    < self.config.rebalance_load_margin:
+                continue
+            entries = rep.engine.detach_queued(max_n=free_slots)
+            if not entries:
+                continue
+            # the detached tail goes to the VALIDATED target, not back
+            # through affinity-first placement — a fingerprint mapping
+            # to some third, loaded replica would force-requeue there
+            # and re-create the ping-pong the cap exists to prevent
+            # (placement is only the fallback if `best` dies mid-move)
+            report = mig.readmit_entries(
+                entries, lambda p, ex, _t=best, _skip=rep.rid:
+                (_t if _t.rid not in ex and _t.engine.is_healthy()
+                 else self._place(p, set(ex) | {_skip})),
+                mig.CAUSE_OVERLOAD, source=rep.rid)
+            self.migrations += 1
+            self.migrated_requests += report.admitted
+            self._migrations_c.labels(fleet=self._label,
+                                      cause=mig.CAUSE_OVERLOAD).inc()
+            self._migrated_c.inc(report.admitted)
+            moved += report.admitted
+            break
+        return moved
+
+    def _signals(self) -> FleetSignals:
+        reps = [r for r in self.replicas() if r.engine.is_healthy()]
+        return FleetSignals.collect(
+            [r.engine.health() for r in reps],
+            [r.engine.queue_snapshot().depth for r in reps])
+
+    def _autoscale_tick(self, now: float) -> Optional[str]:
+        decision = self._autoscaler.decide(self._signals(), now)
+        if decision == "out":
+            self._add_replica(direction="out")
+        elif decision == "in":
+            self.scale_in()
+        return decision
+
+    # ------------------------------------------------------------------
+    # explicit scaling (the autoscaler's executors, also public API)
+    # ------------------------------------------------------------------
+    def scale_out(self) -> FleetReplica:
+        """Add one replica via the factory (counted as a scale event)."""
+        return self._add_replica(direction="out")
+
+    def scale_in(self, rid: Optional[int] = None
+                 ) -> Optional[mig.MigrationReport]:
+        """Retire one replica — by id, or the best-scoring (emptiest:
+        cheapest migration, coldest cache to lose) — draining it
+        through ledger migration onto the survivors. Refuses to retire
+        the last replica."""
+        with self._mu:
+            live = [r for r in self._replicas.values()
+                    if r.engine.is_healthy()]
+            if rid is not None:
+                rep = self._replicas.get(rid)
+            else:
+                rep = min(live, key=self._score) if live else None
+            # the victim's ledger needs a HEALTHY survivor to land on:
+            # counting registered replicas would let a scale-in retire
+            # the only live replica while a dead one pads the count —
+            # migration would then fail every in-flight stream
+            if rep is None or not any(r.rid != rep.rid for r in live):
+                return None
+        report = self._migrate_from(rep, mig.CAUSE_SCALE_IN)
+        self.scale_events += 1
+        self._scale_c.labels(fleet=self._label, direction="in").inc()
+        return report
+
+    # ------------------------------------------------------------------
+    # drive (manual mode) / lifecycle
+    # ------------------------------------------------------------------
+    def step(self) -> bool:
+        """One manual cycle over every replica (the deterministic
+        test/bench shape). Returns whether any replica made progress."""
+        progress = False
+        for rep in self.replicas():
+            progress = rep.engine.step() or progress
+        return progress
+
+    def run_until_idle(self, max_steps: int = 1_000_000) -> int:
+        """Drive ``step()`` until the whole fleet is idle, polling the
+        control plane whenever progress stalls (so a dead replica's
+        migration — or an autoscale action — can resume the trace)."""
+        n = 0
+        while True:
+            if not self.step():
+                self.poll()
+                if not self.step():
+                    return n
+            n += 1
+            if n >= max_steps:
+                raise RuntimeError(f"fleet still busy after {n} steps")
+
+    def warmup(self, **kw) -> "FleetRouter":
+        """Warm every replica (manual mode only; see
+        ``GenerationEngine.warmup``). Replicas added later by the
+        autoscaler should be warmed by the factory instead."""
+        for rep in self.replicas():
+            rep.engine.warmup(**kw)
+        return self
+
+    def start(self) -> "FleetRouter":
+        """Deployment shape: every replica's background loop plus the
+        router's poll thread."""
+        self._started = True
+        self._stop.clear()
+        for rep in self.replicas():
+            rep.engine.start()
+        if self._poll_thread is None or not self._poll_thread.is_alive():
+            def _run():
+                while not self._stop.wait(self.config.poll_interval_s):
+                    try:
+                        self.poll()
+                    except Exception:   # noqa: BLE001 — keep polling
+                        log.exception("fleet poll cycle failed")
+            self._poll_thread = threading.Thread(
+                target=_run, daemon=True, name=f"fleet-{self._label}")
+            self._poll_thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        """Stop the poll thread, every replica, and the membership
+        leases. Replica engines fail their in-flight work with
+        ``EngineShutdown`` (the no-hung-callers contract)."""
+        self._stop.set()
+        t = self._poll_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=2 * self.config.poll_interval_s + 1)
+        for rep in self.replicas():
+            rep.engine.shutdown()
+        self.membership.stop()
+
+    # ------------------------------------------------------------------
+    # observability
+    # ------------------------------------------------------------------
+    def health(self) -> dict:
+        reps = self.replicas()
+        return {
+            "replicas": {r.rid: r.engine.health() for r in reps},
+            "generation": self.membership.generation,
+            "affinity_entries": len(self._affinity),
+            "migrations": self.migrations,
+            "migrated_requests": self.migrated_requests,
+            "scale_events": self.scale_events,
+        }

@@ -56,6 +56,20 @@ SERVING_SPEC_ACCEPTANCE = "dl4jtpu_serving_spec_acceptance_ratio"
 SERVING_KV_BYTES_MOVED = "dl4jtpu_serving_kv_bytes_moved_total"
 SERVING_DISPATCH_LATENCY = "dl4jtpu_serving_decode_dispatch_seconds"
 
+#: fleet layer (serving/fleet/router.py registers these): multi-replica
+#: routing, prefix-affinity placement, ledger migration, autoscaling.
+#: ``fleet`` labels distinguish routers; ``replica`` / ``cause`` /
+#: ``direction`` label the per-series dimensions.
+FLEET_REPLICAS = "dl4jtpu_fleet_replicas"
+FLEET_GENERATION = "dl4jtpu_fleet_generation"
+FLEET_ROUTED = "dl4jtpu_fleet_routed_total"
+FLEET_AFFINITY_HITS = "dl4jtpu_fleet_affinity_hits_total"
+FLEET_AFFINITY_MISSES = "dl4jtpu_fleet_affinity_misses_total"
+FLEET_MIGRATIONS = "dl4jtpu_fleet_migrations_total"
+FLEET_MIGRATED_REQUESTS = "dl4jtpu_fleet_migrated_requests_total"
+FLEET_DEAD_REPLICAS = "dl4jtpu_fleet_dead_replicas_total"
+FLEET_SCALE_EVENTS = "dl4jtpu_fleet_scale_events_total"
+
 #: survivability layer (supervisor.py / overload.py register these)
 SERVING_ENGINE_REBUILDS = "dl4jtpu_serving_engine_rebuilds_total"
 SERVING_ENGINE_ESCALATIONS = \

@@ -1,22 +1,38 @@
-"""Generation requests and their streaming response handles.
+"""Generation requests, streaming handles, and the request ledger.
 
 A submitted prompt becomes a ``GenerationRequest`` (the engine-side
 descriptor riding the admission queue and a slot) paired with a
 ``GenerationStream`` (the caller-side handle): tokens stream into the
 handle as each decode dispatch retires, so time-to-first-token is one
 prefill away from admission instead of a whole batch away.
+
+``RequestLedgerEntry`` is the PUBLIC, versioned form of the PR 9
+insight that the host side already holds everything needed to rebuild
+any in-flight request bit-identically: the prompt, the committed token
+ids (whose last element is the pending, not-yet-fed token), the
+per-request numpy ``Generator`` (advanced exactly once per draw, never
+by the device), and the sampling config. Supervisor recovery
+(``EngineSupervisor``) and fleet migration (``serving/fleet``) both
+move requests as ledger entries through ONE engine code path
+(``GenerationEngine.export_ledger`` / ``admit_from_ledger``) instead
+of two hand-synced copies of the rebuild payload.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import queue
 import threading
 import time
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import numpy as np
 
 from deeplearning4j_tpu.serving.errors import InferenceTimeout
+
+#: format version stamped into every exported ledger entry; bump on any
+#: change to the payload fields or their meaning
+LEDGER_VERSION = 1
 
 _DONE = object()     # terminal queue sentinel
 
@@ -153,3 +169,134 @@ class GenerationRequest:
         self.submit_t = time.monotonic()
         self.pending_token: Optional[int] = None
         self.last_token_t: Optional[float] = None
+
+    @property
+    def streamed(self) -> bool:
+        """Whether any token has streamed: THE re-admission mode switch
+        (re-prime ``ids[:-1]`` with the pending token vs a fresh
+        admission) — one definition for the admission pop, the
+        supervisor rebuild, and ``admit_from_ledger``. A fresh request
+        can never read True before its admission draw (tokens only
+        appear at admission)."""
+        return len(self.handle._ids) > len(self.prompt)
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestLedgerEntry:
+    """One in-flight request as an exportable ledger record.
+
+    ``ids`` is the capture-time snapshot of prompt + committed tokens;
+    when the request has streamed at all, ``ids[-1]`` is the PENDING
+    token (drawn but never yet fed to the model), so a re-admission
+    re-primes ``ids[:-1]`` and the next dispatch recomputes exactly the
+    distribution the unperturbed run would have seen. ``phase`` records
+    where the request lived at export: ``active`` (seated in a slot),
+    ``seating`` (the pop-to-seat handoff window — the request the
+    PR 9 audit made visible to ``_break`` and the export must carry the
+    same way), or ``queued`` (never prefilled).
+
+    The entry carries the LIVE ``GenerationRequest`` — its
+    ``GenerationStream`` handle is the caller's, so an in-process
+    re-admission (supervisor rebuild, fleet migration) continues the
+    stream the caller is already consuming. :meth:`payload` /
+    :meth:`from_payload` are the serialized form for a cross-process
+    handoff: everything bit-exactness needs travels (rng bit-generator
+    state included), but the reconstructed request has a FRESH handle —
+    the original caller's stream cannot cross a process boundary.
+    """
+
+    version: int
+    request: GenerationRequest
+    ids: Tuple[int, ...]
+    phase: str
+
+    @classmethod
+    def capture(cls, request: GenerationRequest,
+                phase: str) -> "RequestLedgerEntry":
+        return cls(LEDGER_VERSION, request,
+                   tuple(request.handle._ids), phase)
+
+    @property
+    def streamed(self) -> bool:
+        """Whether the request had streamed any token at CAPTURE time
+        (the serialized counterpart of ``GenerationRequest.streamed``,
+        which re-admission consults on the live request)."""
+        return len(self.ids) > len(self.request.prompt)
+
+    def resolve(self, exc: BaseException) -> None:
+        """Terminally fail the carried request (no-op if it already has
+        a terminal event) — the ledger holder's obligation when no
+        engine can re-admit an entry: every exported request must end
+        in a terminal event on SOME path, or its caller blocks forever."""
+        if not self.request.handle.done:
+            self.request.handle._fail(exc)
+
+    @staticmethod
+    def _jsonable(obj):
+        """Recursively strip numpy types from an rng state dict: the
+        default PCG64 state is plain ints, but e.g. MT19937 carries an
+        ndarray key — the wire form must survive json.dumps for ANY
+        Generator a caller submitted with (the state setters accept
+        the list form back)."""
+        if isinstance(obj, dict):
+            return {k: RequestLedgerEntry._jsonable(v)
+                    for k, v in obj.items()}
+        if isinstance(obj, np.ndarray):
+            return obj.tolist()
+        if isinstance(obj, np.integer):
+            return int(obj)
+        return obj
+
+    def payload(self) -> dict:
+        """JSON-able form of everything a bit-identical continuation
+        needs on another host. Deadlines travel as REMAINING budget
+        (monotonic clocks don't cross processes); ``None`` stays None."""
+        req = self.request
+        remaining = None if req.deadline is None else \
+            req.deadline - time.monotonic()
+        return {
+            "version": self.version,
+            "phase": self.phase,
+            "prompt": list(req.prompt),
+            "ids": list(self.ids),
+            "want": req.want,
+            "temperature": req.temperature,
+            "top_k": req.top_k,
+            "top_p": req.top_p,
+            "stop_tokens": sorted(req.stop_tokens),
+            "priority": req.priority,
+            "deadline_remaining_s": remaining,
+            "rng_state": self._jsonable(req.rng.bit_generator.state),
+        }
+
+    @classmethod
+    def from_payload(cls, payload: dict) -> "RequestLedgerEntry":
+        """Rebuild an admissible entry from :meth:`payload`. The rng is
+        restored bit-exactly (same bit-generator type + state), the
+        committed ids are replayed into a fresh handle, and the pending
+        token is restored — ``admit_from_ledger`` then continues the
+        stream exactly as an in-process entry would."""
+        version = int(payload["version"])
+        if version > LEDGER_VERSION:
+            raise ValueError(
+                f"ledger entry version {version} is newer than this "
+                f"build understands ({LEDGER_VERSION})")
+        state = payload["rng_state"]
+        bit_gen = getattr(np.random, state["bit_generator"])()
+        bit_gen.state = state
+        prompt = [int(t) for t in payload["prompt"]]
+        remaining = payload.get("deadline_remaining_s")
+        deadline = None if remaining is None else \
+            time.monotonic() + float(remaining)
+        req = GenerationRequest(
+            prompt, int(payload["want"]) - len(prompt),
+            temperature=payload["temperature"],
+            top_k=payload["top_k"], top_p=payload["top_p"],
+            stop_tokens=payload["stop_tokens"],
+            rng=np.random.Generator(bit_gen), deadline=deadline,
+            priority=int(payload["priority"]))
+        ids = [int(t) for t in payload["ids"]]
+        if len(ids) > len(prompt):
+            req.handle._ids = list(ids)
+            req.pending_token = ids[-1]
+        return cls(version, req, tuple(ids), str(payload["phase"]))

@@ -11,14 +11,29 @@ arrival order breaks ties (stable FIFO per class).
 
 from __future__ import annotations
 
+import dataclasses
 import heapq
 import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from deeplearning4j_tpu.serving.errors import (
     EngineShutdown, InferenceTimeout, ServingQueueFull)
 from deeplearning4j_tpu.serving.request import GenerationRequest
+
+
+@dataclasses.dataclass(frozen=True)
+class QueueSnapshot:
+    """Non-mutating view of the admission queue for PLACEMENT scoring:
+    total depth, per-priority depths, and the oldest enqueue's age. The
+    fleet router reads this (via ``GenerationEngine.queue_snapshot``)
+    instead of lock-probing queue internals — one immutable copy taken
+    under the queue lock, safe to score against while the engine keeps
+    admitting."""
+
+    depth: int
+    per_priority: Dict[int, int]
+    oldest_wait_s: Optional[float]
 
 
 class AdmissionQueue:
@@ -44,6 +59,43 @@ class AdmissionQueue:
     def full(self) -> bool:
         with self._cond:
             return len(self._heap) >= self.limit
+
+    def snapshot(self, now: Optional[float] = None) -> QueueSnapshot:
+        """One consistent, non-mutating placement view: total depth,
+        per-priority class depths, and how long the oldest queued
+        request has waited (None when empty). Reads only — no pop, no
+        LRU touch, no notify."""
+        now = time.monotonic() if now is None else now
+        with self._cond:
+            per: Dict[int, int] = {}
+            oldest: Optional[float] = None
+            for _, _, req in self._heap:
+                per[req.priority] = per.get(req.priority, 0) + 1
+                if oldest is None or req.submit_t < oldest:
+                    oldest = req.submit_t
+            return QueueSnapshot(
+                depth=len(self._heap), per_priority=per,
+                oldest_wait_s=None if oldest is None else now - oldest)
+
+    def peek_all(self) -> List[GenerationRequest]:
+        """Queued requests in admission order (priority desc, FIFO
+        within a class) WITHOUT removing them — the ledger-export view."""
+        with self._cond:
+            return [req for _, _, req in
+                    sorted(self._heap, key=lambda it: (it[0], it[1]))]
+
+    def requeue(self, req: GenerationRequest) -> None:
+        """Force-enqueue bypassing the limit and the closed flag: the
+        re-admission path for ledger survivors (supervisor rebuild
+        overflow, fleet migration). Survivors were already admitted
+        once — dropping them at a full queue would turn a recovery into
+        a failure — and the transient over-limit is bounded by the
+        SOURCE's queue bound. Priority ordering is preserved; FIFO
+        order within a class restarts at requeue order."""
+        with self._cond:
+            heapq.heappush(self._heap, (-req.priority, self._seq, req))
+            self._seq += 1
+            self._cond.notify_all()
 
     def depth_ahead(self, priority: int) -> int:
         """Queued requests that would be admitted BEFORE a new request
@@ -154,12 +206,15 @@ class AdmissionQueue:
                 self._cond.wait(timeout)
 
     def close(self) -> List[GenerationRequest]:
-        """Refuse new submissions and drain everything queued (the
-        engine fails the drained handles — nobody blocks on a dead
-        server)."""
+        """Refuse new submissions and drain everything queued, in
+        admission order (priority desc, FIFO within a class — the
+        ledger-export path re-admits the drained list head-first on
+        another replica, so heap-internal order would invert
+        priorities there; the fail-everything callers don't care)."""
         with self._cond:
             self._closed = True
-            drained = [req for _, _, req in self._heap]
+            drained = [req for _, _, req in
+                       sorted(self._heap, key=lambda it: (it[0], it[1]))]
             self._heap.clear()
             self._cond.notify_all()
             return drained

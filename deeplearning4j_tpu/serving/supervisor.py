@@ -27,6 +27,13 @@ reuses the warm prefill buckets, the arena skeleton rebuild reuses
 the compiled scatter/gather shapes, so a recovery after a
 full-envelope ``warmup()`` compiles nothing new.
 
+Since ISSUE 14 the rebuild payload is the PUBLIC, versioned
+``serving/request.RequestLedgerEntry`` and the quarantine travels the
+same ``export_ledger`` → re-admit path the serving fleet's live
+migration uses (``serving/fleet/migration.py``) — supervisor recovery
+is cross-replica migration pointed back at the same engine, one code
+path instead of two hand-synced copies.
+
 Restarts are BUDGETED (``resilience.retry.RestartBudget``): a fault
 burst inside the window is ridden out, but exhausting the budget means
 the fault is persistent — masking it with eternal rebuilds would turn

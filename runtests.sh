@@ -95,6 +95,15 @@ python -m pytest tests/test_serving_paged.py -q -p no:cacheprovider
 # recovery re-entering the direct path, zero retraces with the kernel on
 python -m pytest tests/test_serving_paged_kernel.py -q -p no:cacheprovider
 
+# tier-1 serving-fleet lane: the multi-replica router (serving/fleet/)
+# — routed == single-engine bit-exactness (greedy + sampled),
+# kill-a-replica mid-trace with bit-identical continuation on the
+# survivor, the request-ledger export/import seam (incl. the versioned
+# cross-process payload), prefix-affinity placement, overload
+# rebalance, autoscaler hysteresis, replica-mode membership leases,
+# and zero retraces after warmup including post-migration re-admits
+python -m pytest tests/test_serving_fleet.py -q -p no:cacheprovider
+
 # tier-1 autotune/execution-plan lane: the kernel-crossover store +
 # plan resolution (tuning/) and the fused space-to-depth stem — store
 # lifecycle (roundtrip/ratchet/prune/platform guard), fused==xla fit

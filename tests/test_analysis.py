@@ -1763,6 +1763,90 @@ class TestWorldSnapshotRule:
 
 
 # ---------------------------------------------------------------------
+# rule: replica-local-state-in-router (ISSUE 14)
+# ---------------------------------------------------------------------
+class TestReplicaStateRule:
+    def _scan_fleet(self, tmp_path, source,
+                    name="serving/fleet/router.py"):
+        p = tmp_path / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(source))
+        return scan_file(str(p), ALL_RULES, root=str(tmp_path))
+
+    def test_positive_engine_internals_in_router(self, tmp_path):
+        fs = self._scan_fleet(tmp_path, """
+            def score(rep):
+                load = len(rep.engine._slots)
+                depth = rep.engine._pending.depth()
+                return load + depth
+        """)
+        assert _rules_of(fs) == ["replica-local-state-in-router"] * 2
+
+    def test_positive_seating_and_pool_probes(self, tmp_path):
+        fs = self._scan_fleet(tmp_path, """
+            def dead_requests(engine):
+                out = []
+                if engine._seating is not None:
+                    out.append(engine._seating)
+                return out, engine.page_pool._free
+        """, name="serving/fleet/migration.py")
+        assert _rules_of(fs) == ["replica-local-state-in-router"] * 3
+
+    def test_negative_public_accessors(self, tmp_path):
+        fs = self._scan_fleet(tmp_path, """
+            def score(rep, cfg):
+                h = rep.engine.health()
+                snap = rep.engine.queue_snapshot()
+                load = (snap.depth + h["active_slots"]) / h["slots"]
+                return load if rep.engine.is_ready() else 1e9
+
+            def migrate(src, dst):
+                entries = src.engine.detach_ledger()
+                return dst.engine.admit_from_ledger(entries)
+        """)
+        assert fs == []
+
+    def test_negative_own_private_state_via_self(self, tmp_path):
+        fs = self._scan_fleet(tmp_path, """
+            class Router:
+                def __init__(self):
+                    self._replicas = {}
+                    self._affinity = {}
+
+                def drop(self, rid):
+                    self._replicas.pop(rid, None)
+        """)
+        assert fs == []
+
+    def test_negative_outside_fleet_modules(self, tmp_path):
+        """The engine's OWN modules (and everything else) may touch
+        their internals — the rule scopes to serving/fleet/ only."""
+        fs = self._scan_fleet(tmp_path, """
+            def rebuild(engine):
+                return [r for r in engine._slots if r is not None]
+        """, name="serving/engine_helper.py")
+        assert "replica-local-state-in-router" not in _rules_of(fs)
+
+    def test_inline_suppression(self, tmp_path):
+        fs = self._scan_fleet(tmp_path, """
+            def peek(engine):
+                # test-only chaos seam, justified
+                return engine._slots  # tpulint: disable=replica-local-state-in-router
+        """)
+        assert _rules_of(fs) == []
+
+    def test_repo_fleet_layer_is_clean(self):
+        """The shipped fleet layer holds to its own contract: no
+        foreign private reads — placement, migration, and autoscaling
+        go through public engine accessors only."""
+        from deeplearning4j_tpu.analysis.rules.replica_state import (
+            ReplicaLocalStateInRouterRule)
+        fs = scan_paths([str(PKG / "serving" / "fleet")],
+                        [ReplicaLocalStateInRouterRule()], root=str(REPO))
+        assert fs == []
+
+
+# ---------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------
 class TestSuppression:
@@ -2155,7 +2239,8 @@ class TestSelfScan:
             "mutable-default-arg", "unbounded-retry",
             "non-atomic-state-write", "stale-world-snapshot",
             "lock-held-across-dispatch",
-            "donation-use-after-consume", "jit-key-drift"}
+            "donation-use-after-consume", "jit-key-drift",
+            "replica-local-state-in-router"}
         assert RULES_BY_ID["host-sync-in-hot-loop"].severity == "error"
         assert RULES_BY_ID["device-transfer-in-hot-loop"].severity == \
             "warning"

@@ -142,6 +142,93 @@ class TestParallelInference:
         pi.shutdown()
 
 
+class TestParallelInferenceFleet:
+    """Fleet-backed mode (ISSUE 14): identically-seeded model replicas
+    behind one queue — same outputs, concurrent workers, and a single
+    worker loss degrades capacity instead of failing the pool."""
+
+    def test_fleet_matches_single_model(self):
+        net, net2 = make_net(), make_net()
+        pi = ParallelInference(net, max_batch_size=32, replicas=[net2])
+        x, _ = data(24)
+        out = pi.output(x)
+        np.testing.assert_allclose(out, np.asarray(net.output(x)),
+                                   rtol=1e-5)
+        h = pi.health()
+        assert h["replicas"] == 2 and h["live_workers"] == 2
+        pi.shutdown()
+
+    def test_concurrent_requests_spread_over_replicas(self):
+        import threading
+        net, net2 = make_net(), make_net()
+        pi = ParallelInference(net, max_batch_size=8,
+                               batch_timeout_ms=5, replicas=[net2])
+        x, _ = data(40)
+        results = {}
+
+        def worker(i):
+            results[i] = pi.output(x[i * 4:(i + 1) * 4])
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(10)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        direct = np.asarray(net.output(x))
+        for i in range(10):
+            np.testing.assert_allclose(
+                results[i], direct[i * 4:(i + 1) * 4], rtol=1e-5)
+        pi.shutdown()
+
+    def test_sequential_fleet_round_robins(self):
+        net, net2 = make_net(), make_net()
+        pi = ParallelInference(net, inference_mode="sequential",
+                               replicas=[net2])
+        x, _ = data(8)
+        a, b = pi.output(x), pi.output(x)   # replica 0 then replica 1
+        np.testing.assert_allclose(a, b, rtol=1e-5)
+        assert pi.health()["replicas"] == 2
+        pi.shutdown()
+
+    def test_one_dead_worker_degrades_not_fails(self):
+        """Actually kill one worker (a worker-killing BaseException in
+        its dispatch): the dying worker answers its in-flight batch's
+        waiters on the way down, the pool stays healthy, and later
+        requests keep serving through the survivor — the pre-fleet
+        behavior (ANY worker exit = fail-all) would fail this."""
+        net, net2 = make_net(), make_net()
+        pi = ParallelInference(net, max_batch_size=4,
+                               batch_timeout_ms=1, replicas=[net2])
+        orig = pi._run_batch
+
+        def boom(x, deadline=None, idx=0):
+            if idx == 1:
+                raise SystemExit("replica 1 worker dies")
+            return orig(x, deadline, idx)
+
+        pi._run_batch = boom
+        x, _ = data(8)
+        direct = np.asarray(net.output(x))
+        deaths = 0
+        for _ in range(100):            # until worker 1 pops a batch
+            try:
+                np.testing.assert_allclose(pi.output(x, timeout=10.0),
+                                           direct, rtol=1e-5)
+            except SystemExit:
+                deaths += 1             # the killing batch's waiter
+                                        # was answered, not stranded
+            if pi.health()["live_workers"] == 1:
+                break
+        assert deaths == 1
+        assert pi.health()["live_workers"] == 1
+        assert pi.is_healthy()          # degraded, not failed
+        # the survivor still serves
+        np.testing.assert_allclose(pi.output(x, timeout=10.0), direct,
+                                   rtol=1e-5)
+        pi.shutdown()
+
+
 class TestDistributedBackend:
     """parallel.distributed multi-host utilities, exercised in their
     single-process mode on the 8-virtual-device mesh (the reference tests

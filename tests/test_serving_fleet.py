@@ -1,0 +1,667 @@
+"""Serving fleet (serving/fleet/ + the engine request-ledger seam):
+routed == single-engine bit-exact for greedy AND sampled traces,
+kill-a-replica mid-trace with every stream completing bit-identically
+on the survivor (health-down and lease-expiry detection), ledger
+export/import incl. the pop-to-seat `_seating` gap and the versioned
+cross-process payload roundtrip, AdmissionQueue.snapshot placement
+views, prefix-affinity routing with per-replica cache-hit evidence,
+overload rebalance of the queued tail, autoscaler hysteresis (no
+flapping under an oscillating load trace), replica-mode membership
+leases/generations, and zero retraces per replica after warmup
+including post-migration re-admits."""
+
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitoring
+from deeplearning4j_tpu.monitoring import runtime
+from deeplearning4j_tpu.monitoring.metrics import MetricsRegistry
+from deeplearning4j_tpu.serving import (
+    AdmissionQueue, AutoscaleConfig, EngineShutdown, FleetAutoscaler,
+    FleetConfig, FleetMembership, FleetRouter, FleetSignals,
+    GenerationEngine, GenerationRequest, LEDGER_VERSION,
+    NoReplicaAvailable, PagedKVConfig, RequestLedgerEntry)
+from deeplearning4j_tpu.serving.fleet.membership import REPLICA_ROLE
+from deeplearning4j_tpu.resilience.elastic import LeaseLedger
+from deeplearning4j_tpu.zoo import TextGenerationTransformer
+
+V = 12
+PROMPTS = [[1, 2, 3, 4, 5], [6, 7], [8, 9, 10, 1], [2, 4, 6]]
+
+
+def _net(max_length=32):
+    """A fresh net with the FIXED default seed: every call yields
+    bit-identical params — the fleet homogeneity contract."""
+    return TextGenerationTransformer(vocab_size=V, embed_dim=16,
+                                     n_heads=2, n_layers=2,
+                                     max_length=max_length,
+                                     positional="rope").init()
+
+
+def _factory(**engine_kw):
+    def make(rid):
+        return GenerationEngine(_net(), V, slots=2, **engine_kw)
+    return make
+
+
+def _submit_all(target, prompts=None, steps=5, sampled=False):
+    hs = []
+    for i, p in enumerate(prompts if prompts is not None
+                          else PROMPTS):
+        kw = (dict(temperature=1.3, top_p=0.9) if sampled
+              else dict(top_k=1))
+        hs.append(target.submit(p, steps=steps,
+                                rng=np.random.default_rng(i), **kw))
+    return hs
+
+
+def _single_engine_outputs(prompts=None, steps=5, sampled=False,
+                           slots=4, **engine_kw):
+    eng = GenerationEngine(_net(), V, slots=slots, **engine_kw)
+    hs = _submit_all(eng, prompts, steps, sampled)
+    eng.run_until_idle()
+    return [h.result(timeout=0) for h in hs]
+
+
+# ---------------------------------------------------------------------
+# the engine request-ledger seam (the supervisor/migration shared path)
+# ---------------------------------------------------------------------
+class TestRequestLedger:
+    def test_export_phases_and_version(self):
+        eng = GenerationEngine(_net(), V, slots=2)
+        hs = _submit_all(eng, steps=6)
+        for _ in range(2):
+            eng.step()                  # 2 seated, 2 queued
+        entries = eng.export_ledger(include_queued=True)
+        assert [e.version for e in entries] == [LEDGER_VERSION] * 4
+        assert [e.phase for e in entries] == \
+            ["active", "active", "queued", "queued"]
+        assert all(e.streamed for e in entries if e.phase == "active")
+        assert not any(e.streamed for e in entries if e.phase == "queued")
+        # non-mutating: the engine still finishes everything
+        assert eng.export_ledger() == eng.export_ledger()
+        eng.run_until_idle()
+        assert all(h.done for h in hs)
+
+    def test_export_includes_the_seating_window(self):
+        """The pop-to-seat `_seating` request is part of the export —
+        the PR 9 audit gap, closed the same way for migration."""
+        eng = GenerationEngine(_net(), V, slots=2)
+        req = GenerationRequest([1, 2], 3, top_k=1)
+        eng._seating = req
+        entries = eng.export_ledger()
+        assert [e.phase for e in entries] == ["seating"]
+        assert entries[0].request is req
+        eng._seating = None
+
+    def test_detach_releases_without_terminal_events(self):
+        eng = GenerationEngine(_net(), V, slots=2,
+                               paging=PagedKVConfig(page_size=4))
+        hs = _submit_all(eng, steps=6)
+        eng.step()
+        entries = eng.detach_ledger()
+        assert len(entries) == 4
+        assert not any(h.done for h in hs)       # nobody failed
+        assert eng.active_slots() == 0 and eng.queue_depth() == 0
+        # every slot page returned (prefix-cache refs may stay resident)
+        assert eng.page_pool.used_count() == len(eng.prefix_cache)
+        assert eng.health()["draining"] is True
+        with pytest.raises(EngineShutdown):
+            eng.submit([1], steps=1)
+
+    def test_admit_from_ledger_continues_bit_identical(self):
+        """Export mid-trace from engine A, re-admit on a fresh engine
+        B: every stream continues bit-identically (greedy and sampled)
+        — the supervisor-recovery exactness, across engines."""
+        for sampled in (False, True):
+            want = _single_engine_outputs(steps=6, sampled=sampled)
+            a = GenerationEngine(_net(), V, slots=4)
+            hs = _submit_all(a, steps=6, sampled=sampled)
+            for _ in range(2):
+                a.step()
+            entries = a.detach_ledger()
+            b = GenerationEngine(_net(), V, slots=4)
+            assert b.admit_from_ledger(entries) == 4
+            b.run_until_idle()
+            assert [h.result(timeout=0) for h in hs] == want
+
+    def test_admit_overflow_rides_the_queue(self):
+        """More survivors than free slots: the overflow requeues (past
+        the limit if needed) and admits as slots free — nobody drops."""
+        a = GenerationEngine(_net(), V, slots=4)
+        hs = _submit_all(a, steps=6)
+        a.step()
+        entries = a.detach_ledger()
+        b = GenerationEngine(_net(), V, slots=1, queue_limit=1)
+        assert b.admit_from_ledger(entries) == 4
+        assert b.queue_depth() == 3           # 1 seated, 3 riding
+        b.run_until_idle()
+        assert [h.result(timeout=0) for h in hs] == \
+            _single_engine_outputs(steps=6)
+
+    def test_admit_refused_while_draining_or_broken(self):
+        b = GenerationEngine(_net(), V, slots=2)
+        b.drain(timeout=0.1)
+        with pytest.raises(EngineShutdown):
+            b.admit_from_ledger([])
+
+    def test_payload_roundtrip_is_bit_identical(self):
+        """The serialized (cross-process) ledger form: rng state,
+        pending token, and committed ids survive payload() ->
+        from_payload(), and the rebuilt request's continuation matches
+        the unperturbed run exactly — sampled, so the rng state is
+        load-bearing."""
+        want = _single_engine_outputs(steps=6, sampled=True)
+        a = GenerationEngine(_net(), V, slots=4)
+        hs = _submit_all(a, steps=6, sampled=True)
+        for _ in range(2):
+            a.step()
+        payloads = [e.payload() for e in a.detach_ledger()]
+        import json
+        payloads = json.loads(json.dumps(payloads))  # wire-safe
+        entries = [RequestLedgerEntry.from_payload(p) for p in payloads]
+        b = GenerationEngine(_net(), V, slots=4)
+        b.admit_from_ledger(entries)
+        b.run_until_idle()
+        # fresh handles (the originals cannot cross a process): compare
+        # the rebuilt streams' final ids against the unperturbed run
+        got = sorted(e.request.handle.result(timeout=0)
+                     for e in entries)
+        assert got == sorted(want)
+        assert all(not h.done for h in hs)   # originals untouched here
+
+    def test_payload_version_gate(self):
+        p = RequestLedgerEntry.capture(
+            GenerationRequest([1, 2], 2), "queued").payload()
+        p["version"] = LEDGER_VERSION + 1
+        with pytest.raises(ValueError, match="newer"):
+            RequestLedgerEntry.from_payload(p)
+
+    def test_payload_json_safe_for_any_generator(self):
+        """submit() accepts ANY numpy Generator; the wire form must
+        survive json for non-default bit generators too (MT19937's
+        state carries an ndarray key) and restore to the same draw
+        stream."""
+        import json
+        req = GenerationRequest(
+            [1, 2], 4, rng=np.random.Generator(np.random.MT19937(5)))
+        req.rng.random()                  # advance off the seed state
+        entry = RequestLedgerEntry.capture(req, "queued")
+        wire = json.loads(json.dumps(entry.payload()))
+        back = RequestLedgerEntry.from_payload(wire)
+        assert back.request.rng.random() == req.rng.random()
+
+
+# ---------------------------------------------------------------------
+# AdmissionQueue.snapshot (the router's placement view)
+# ---------------------------------------------------------------------
+class TestQueueSnapshot:
+    def test_depths_ages_and_nonmutation(self):
+        q = AdmissionQueue(limit=8)
+        t0 = time.monotonic()
+        reqs = [GenerationRequest([1], 1, priority=p)
+                for p in (0, 1, 1, 2)]
+        for r in reqs:
+            q.submit(r)
+        snap = q.snapshot(now=t0 + 1.0)
+        assert snap.depth == 4
+        assert snap.per_priority == {0: 1, 1: 2, 2: 1}
+        assert snap.oldest_wait_s == pytest.approx(1.0, abs=0.2)
+        assert q.depth() == 4                  # nothing popped
+        assert [r.priority for r in q.peek_all()] == [2, 1, 1, 0]
+        assert q.depth() == 4                  # peek is non-mutating
+        assert q.snapshot().per_priority == snap.per_priority
+
+    def test_empty_snapshot(self):
+        snap = AdmissionQueue().snapshot()
+        assert snap.depth == 0 and snap.per_priority == {}
+        assert snap.oldest_wait_s is None
+
+    def test_requeue_bypasses_limit(self):
+        q = AdmissionQueue(limit=1, policy="fail_fast")
+        q.submit(GenerationRequest([1], 1))
+        q.requeue(GenerationRequest([2], 1, priority=5))
+        assert q.depth() == 2
+        assert q.pop().priority == 5           # ordering preserved
+
+
+# ---------------------------------------------------------------------
+# acceptance: routed == single-engine bit-exact
+# ---------------------------------------------------------------------
+class TestFleetParity:
+    @pytest.mark.parametrize("sampled", [False, True])
+    def test_fleet_matches_single_engine(self, sampled):
+        want = _single_engine_outputs(sampled=sampled)
+        fleet = FleetRouter(_factory(), replicas=2,
+                            registry=MetricsRegistry())
+        hs = _submit_all(fleet, sampled=sampled)
+        fleet.run_until_idle()
+        assert [h.result(timeout=0) for h in hs] == want
+        # the trace actually spread over both replicas
+        spread = {rid for rid, h in fleet.health()["replicas"].items()}
+        assert len(spread) == 2
+        fleet.shutdown()
+
+    def test_paged_fleet_matches_one_shot(self):
+        want = _single_engine_outputs(
+            paging=PagedKVConfig(page_size=4))
+        fleet = FleetRouter(
+            _factory(paging=PagedKVConfig(page_size=4)), replicas=3,
+            registry=MetricsRegistry())
+        hs = _submit_all(fleet)
+        fleet.run_until_idle()
+        assert [h.result(timeout=0) for h in hs] == want
+        fleet.shutdown()
+
+
+# ---------------------------------------------------------------------
+# acceptance: kill a replica mid-trace, streams continue bit-identical
+# ---------------------------------------------------------------------
+class TestKillReplica:
+    @pytest.mark.parametrize("sampled", [False, True])
+    def test_mid_trace_death_continues_bit_identical(self, sampled):
+        want = _single_engine_outputs(steps=8, sampled=sampled)
+        reg = MetricsRegistry()
+        fleet = FleetRouter(_factory(), replicas=2, registry=reg)
+        hs = _submit_all(fleet, steps=8, sampled=sampled)
+        for _ in range(2):
+            fleet.step()               # both replicas mid-stream
+        victim = fleet.replicas()[0]
+        assert victim.engine.active_slots() > 0
+        victim.engine._stop.set()      # simulated process death
+        fleet.run_until_idle()         # poll detects + migrates
+        assert [h.result(timeout=0) for h in hs] == want
+        assert fleet.migrations == 1
+        assert fleet.migrated_requests >= 1
+        assert len(fleet.replicas()) == 1
+        assert victim.rid not in fleet.health()["replicas"]
+        fleet.shutdown()
+
+    def test_death_with_queued_requests_migrates_them_too(self):
+        """Active AND queued requests on the dead replica move: the
+        whole host-side ledger survives the device, not just slots."""
+        want = _single_engine_outputs(steps=6)
+        fleet = FleetRouter(_factory(), replicas=2,
+                            registry=MetricsRegistry())
+        hs = _submit_all(fleet, steps=6)
+        fleet.step()
+        victim = max(fleet.replicas(),
+                     key=lambda r: r.engine.queue_depth()
+                     + r.engine.active_slots())
+        victim.engine._stop.set()
+        fleet.run_until_idle()
+        assert [h.result(timeout=0) for h in hs] == want
+        fleet.shutdown()
+
+    def test_lease_expiry_detects_a_hung_replica(self, tmp_path):
+        """Death via the membership ledger: the engine object still
+        answers is_healthy() (a hung process would too) but its lease
+        stopped beating — the fleet declares it dead and migrates."""
+        # ttl must outlast scheduler stalls under a loaded suite (the
+        # healthy replica's heartbeat daemon must never miss a window)
+        cfg = FleetConfig(membership_root=str(tmp_path),
+                          lease_ttl_s=0.6)
+        fleet = FleetRouter(_factory(), replicas=2, config=cfg,
+                            registry=MetricsRegistry())
+        want = _single_engine_outputs(steps=6)
+        hs = _submit_all(fleet, steps=6)
+        fleet.step()
+        victim = fleet.replicas()[0]
+        fleet.membership.lease(victim.rid).stall()
+        time.sleep(1.0)                # let the lease lapse
+        out = fleet.poll()
+        assert out["dead"] == [victim.rid]
+        fleet.run_until_idle()
+        assert [h.result(timeout=0) for h in hs] == want
+        assert len(fleet.replicas()) == 1
+        fleet.shutdown()
+
+    def test_all_replicas_dead_raises_no_replica(self):
+        fleet = FleetRouter(_factory(), replicas=1,
+                            registry=MetricsRegistry())
+        fleet.replicas()[0].engine._stop.set()
+        with pytest.raises(NoReplicaAvailable):
+            fleet.submit([1, 2], steps=2, top_k=1)
+        fleet.shutdown()
+
+    def test_last_replica_death_respawns_to_the_autoscaler_floor(self):
+        """With an autoscaler configured, losing the LAST replica is a
+        respawn + migration, not a bricked fleet: poll re-establishes
+        min_replicas BEFORE migrating so the dead replica's ledger
+        lands on the replacement and every stream continues
+        bit-identically."""
+        want = _single_engine_outputs(steps=8)
+        fleet = FleetRouter(_factory(), replicas=1,
+                            autoscale=AutoscaleConfig(min_replicas=1,
+                                                      max_replicas=2),
+                            registry=MetricsRegistry())
+        hs = _submit_all(fleet, steps=8)
+        for _ in range(2):
+            fleet.step()
+        fleet.replicas()[0].engine._stop.set()
+        out = fleet.poll()
+        assert len(out["respawned"]) == 1 and out["migrated"] >= 1
+        assert len(fleet.replicas()) == 1
+        fleet.run_until_idle()
+        assert [h.result(timeout=0) for h in hs] == want
+        # the respawned fleet keeps serving new work too
+        h = fleet.submit([1, 2, 3], steps=3, top_k=1,
+                         rng=np.random.default_rng(9))
+        fleet.run_until_idle()
+        assert h.result(timeout=0)
+        fleet.shutdown()
+
+
+# ---------------------------------------------------------------------
+# prefix-affinity placement
+# ---------------------------------------------------------------------
+class TestAffinityPlacement:
+    def test_shared_system_prompts_route_to_one_replica(self):
+        """Two prompt families (distinct leading blocks): each family
+        sticks to ONE replica after its first placement, so that
+        replica's prefix cache serves every later family member."""
+        sys_a, sys_b = [3, 1, 2, 0], [7, 8, 9, 10]
+        prompts = []
+        for i in range(4):
+            prompts.append(sys_a + [5 + (i % 3)])
+            prompts.append(sys_b + [1 + (i % 3)])
+        reg = MetricsRegistry()
+        fleet = FleetRouter(
+            _factory(paging=PagedKVConfig(page_size=4), queue_limit=16),
+            replicas=2, registry=reg)
+        hs = _submit_all(fleet, prompts=prompts, steps=4)
+        fleet.run_until_idle()
+        assert all(h.result(timeout=0) for h in hs)
+        snap = reg.snapshot_compact()
+        hits = snap.get(
+            "dl4jtpu_fleet_affinity_hits_total{fleet=fleet}", 0)
+        assert hits == len(prompts) - 2      # all but the 2 first-seen
+        # per-replica evidence: BOTH replicas' prefix caches served
+        # their family (hits >= 1 on each)
+        per = [h["prefix_cache"]["hits"]
+               for h in fleet.health()["replicas"].values()]
+        assert all(v >= 1 for v in per) and len(per) == 2
+        # family members routed consistently
+        routed_a = snap.get(
+            "dl4jtpu_fleet_routed_total{fleet=fleet,replica=0}", 0)
+        routed_b = snap.get(
+            "dl4jtpu_fleet_routed_total{fleet=fleet,replica=1}", 0)
+        assert routed_a == routed_b == len(prompts) / 2
+        fleet.shutdown()
+
+    def test_affinity_off_spreads_by_load(self):
+        fleet = FleetRouter(
+            _factory(), replicas=2, config=FleetConfig(affinity=False),
+            registry=MetricsRegistry())
+        hs = _submit_all(fleet)
+        fleet.run_until_idle()
+        assert all(h.done for h in hs)
+        assert len(fleet.health()["replicas"]) == 2
+        assert fleet.health()["affinity_entries"] == 0
+        fleet.shutdown()
+
+    def test_dead_owner_affinity_remaps(self):
+        """After the affinity owner dies, the fingerprint re-places on
+        a survivor instead of pointing at a ghost."""
+        sys_a = [3, 1, 2, 0, 4]
+        fleet = FleetRouter(_factory(), replicas=2,
+                            registry=MetricsRegistry())
+        h0 = fleet.submit(sys_a + [5], steps=3, top_k=1,
+                          rng=np.random.default_rng(0))
+        owner = next(r for r in fleet.replicas()
+                     if r.engine.queue_depth()
+                     or r.engine.active_slots())
+        fleet.run_until_idle()
+        owner.engine._stop.set()
+        fleet.poll()
+        h1 = fleet.submit(sys_a + [7], steps=3, top_k=1,
+                          rng=np.random.default_rng(1))
+        fleet.run_until_idle()
+        assert h0.result(timeout=0) and h1.result(timeout=0)
+        fleet.shutdown()
+
+
+# ---------------------------------------------------------------------
+# overload rebalance (queued tail moves, actives stay)
+# ---------------------------------------------------------------------
+class TestOverloadRebalance:
+    def test_queued_tail_moves_to_idle_replica(self):
+        want = _single_engine_outputs(
+            prompts=[[3, 1, 2, 0, i + 1] for i in range(6)], steps=4)
+        cfg = FleetConfig(rebalance_queue_wait_s=0.0, affinity_block=4)
+        fleet = FleetRouter(_factory(queue_limit=16), replicas=2,
+                            config=cfg, registry=MetricsRegistry())
+        # affinity pins every submit to one replica -> deep queue there
+        prompts = [[3, 1, 2, 0, i + 1] for i in range(6)]
+        hs = _submit_all(fleet, prompts=prompts, steps=4)
+        loaded = max(fleet.replicas(),
+                     key=lambda r: r.engine.queue_depth())
+        assert loaded.engine.queue_depth() >= 4
+        moved = fleet.poll()["rebalanced"]
+        assert moved >= 1
+        other = next(r for r in fleet.replicas()
+                     if r.rid != loaded.rid)
+        assert other.engine.queue_depth() + other.engine.active_slots() \
+            >= moved
+        fleet.run_until_idle()
+        assert [h.result(timeout=0) for h in hs] == want
+        fleet.shutdown()
+
+    def test_no_rebalance_without_margin(self):
+        cfg = FleetConfig(rebalance_queue_wait_s=0.0,
+                          rebalance_load_margin=100.0,
+                          affinity_block=4)
+        fleet = FleetRouter(_factory(queue_limit=16), replicas=2,
+                            config=cfg, registry=MetricsRegistry())
+        hs = _submit_all(fleet, prompts=[[3, 1, 2, 0, i + 1]
+                                         for i in range(6)], steps=4)
+        assert fleet.poll()["rebalanced"] == 0
+        fleet.run_until_idle()
+        assert all(h.done for h in hs)
+        fleet.shutdown()
+
+
+# ---------------------------------------------------------------------
+# autoscaler: hysteresis (pure policy) + end-to-end scaling
+# ---------------------------------------------------------------------
+def _sig(replicas=1, slots=2, active=0, queued=0, free=None, brown=0):
+    return FleetSignals(replicas=replicas, slots=slots, active=active,
+                        queued=queued, free_page_frac=free,
+                        brownout_max=brown)
+
+
+class TestAutoscalerHysteresis:
+    def test_oscillating_load_never_flaps(self):
+        """A load trace alternating pressure/idle every tick can never
+        sustain either streak: ZERO actions over the whole trace."""
+        asc = FleetAutoscaler(AutoscaleConfig(
+            max_replicas=4, out_ticks=3, in_ticks=3, cooldown_s=0.0))
+        for t in range(60):
+            s = _sig(replicas=2, queued=8 if t % 2 else 0,
+                     active=2 if t % 2 else 0)
+            assert asc.decide(s, now=float(t)) is None
+        assert asc.decisions == 0
+
+    def test_sustained_pressure_scales_out_once_per_cooldown(self):
+        asc = FleetAutoscaler(AutoscaleConfig(
+            max_replicas=4, out_ticks=3, cooldown_s=10.0))
+        got = [asc.decide(_sig(replicas=2, queued=8), now=float(t))
+               for t in range(9)]
+        assert got.count("out") == 1        # once, then cooldown gates
+        assert got[2] == "out"              # on the 3rd consecutive tick
+
+    def test_page_pressure_and_brownout_are_out_signals(self):
+        asc = FleetAutoscaler(AutoscaleConfig(out_ticks=1,
+                                              cooldown_s=0.0))
+        assert asc.decide(_sig(free=0.05), now=0.0) == "out"
+        asc2 = FleetAutoscaler(AutoscaleConfig(out_ticks=1,
+                                               cooldown_s=0.0))
+        assert asc2.decide(_sig(brown=2), now=0.0) == "out"
+
+    def test_idle_scales_in_only_down_to_min(self):
+        asc = FleetAutoscaler(AutoscaleConfig(
+            min_replicas=1, in_ticks=2, cooldown_s=0.0))
+        assert asc.decide(_sig(replicas=2), now=0.0) is None
+        assert asc.decide(_sig(replicas=2), now=1.0) == "in"
+        asc2 = FleetAutoscaler(AutoscaleConfig(
+            min_replicas=1, in_ticks=1, cooldown_s=0.0))
+        assert asc2.decide(_sig(replicas=1), now=0.0) is None  # at min
+
+    def test_action_resets_streaks(self):
+        asc = FleetAutoscaler(AutoscaleConfig(out_ticks=2,
+                                              cooldown_s=0.0))
+        assert asc.decide(_sig(queued=8), now=0.0) is None
+        assert asc.decide(_sig(queued=8), now=1.0) == "out"
+        # pressure persists but the streak restarted post-action
+        assert asc.decide(_sig(replicas=2, queued=8), now=2.0) is None
+
+
+class TestFleetScaling:
+    def test_pressure_scales_out_and_idle_scales_in(self):
+        made = []
+
+        def factory(rid):
+            made.append(rid)
+            return GenerationEngine(_net(), V, slots=2, queue_limit=32)
+
+        fleet = FleetRouter(
+            factory, replicas=1,
+            autoscale=AutoscaleConfig(min_replicas=1, max_replicas=2,
+                                      out_ticks=2, in_ticks=2,
+                                      cooldown_s=0.0),
+            registry=MetricsRegistry())
+        prompts = [[1 + i % 9, 2, 3] for i in range(8)]
+        hs = _submit_all(fleet, prompts=prompts, steps=4)
+        fleet.poll()
+        assert fleet.poll()["scaled"] == "out"      # sustained 2 ticks
+        assert len(fleet.replicas()) == 2 and made == [0, 1]
+        fleet.run_until_idle()
+        assert all(h.result(timeout=0) for h in hs)
+        # run_until_idle's trailing poll already banked an idle tick;
+        # the next poll(s) complete the in-streak
+        scaled = [fleet.poll()["scaled"] for _ in range(2)]
+        assert "in" in scaled
+        assert len(fleet.replicas()) == 1
+        assert fleet.scale_events == 2
+        fleet.shutdown()
+
+    def test_scale_in_migrates_in_flight_work(self):
+        want = _single_engine_outputs(steps=8)
+        fleet = FleetRouter(_factory(), replicas=2,
+                            registry=MetricsRegistry())
+        hs = _submit_all(fleet, steps=8)
+        for _ in range(2):
+            fleet.step()
+        report = fleet.scale_in()                   # planned drain
+        assert report is not None and report.cause == "scale_in"
+        assert len(fleet.replicas()) == 1
+        fleet.run_until_idle()
+        assert [h.result(timeout=0) for h in hs] == want
+        fleet.shutdown()
+
+    def test_scale_in_refuses_last_replica(self):
+        fleet = FleetRouter(_factory(), replicas=1,
+                            registry=MetricsRegistry())
+        assert fleet.scale_in() is None
+        assert len(fleet.replicas()) == 1
+        fleet.shutdown()
+
+
+# ---------------------------------------------------------------------
+# replica-mode membership (leases + generations)
+# ---------------------------------------------------------------------
+class TestFleetMembership:
+    def test_leases_carry_the_replica_role(self, tmp_path):
+        m = FleetMembership(str(tmp_path), ttl=5.0)
+        m.join(0)
+        m.join(1)
+        lease = m.lease(0)
+        assert lease.role == REPLICA_ROLE
+        assert sorted(lease.live_ranks(role=REPLICA_ROLE)) == [0, 1]
+        assert m.expired([0, 1]) == []
+        m.stop()
+
+    def test_train_role_leases_are_not_replicas(self, tmp_path):
+        """A training rank sharing the ledger dir is never counted as
+        a serving replica (and vice versa) — the role filter."""
+        trainer = LeaseLedger(str(tmp_path), rank=7, ttl=5.0)
+        trainer.heartbeat()
+        m = FleetMembership(str(tmp_path), ttl=5.0)
+        m.join(0)
+        assert m.lease(0).live_ranks(role=REPLICA_ROLE) == [0]
+        assert 7 in m.lease(0).live_ranks()         # unfiltered sees it
+        assert m.expired([0]) == []
+        m.stop()
+
+    def test_expiry_and_generations(self, tmp_path):
+        m = FleetMembership(str(tmp_path), ttl=0.5)
+        m.join(0)
+        m.join(1)
+        g1 = m.publish([0, 1])
+        m.lease(1).stall()
+        time.sleep(0.8)
+        assert m.expired([0, 1]) == [1]
+        m.leave(1)
+        g2 = m.publish([0])
+        assert g2 == g1 + 1
+        rec = m.record()
+        assert rec.generation == g2 and list(rec.members) == [0]
+        m.stop()
+
+    def test_publish_race_republishes_at_the_successor(self, tmp_path):
+        """Two routers sharing a root: the exclusive-create loser must
+        RE-PUBLISH its own member set at the winner's successor — the
+        on-disk record at the contested number describes the winner's
+        fleet, not a membership the loser can adopt."""
+        a = FleetMembership(str(tmp_path), ttl=5.0)
+        b = FleetMembership(str(tmp_path), ttl=5.0)
+        assert a.publish([0]) == 1
+        assert b.publish([7]) == 2        # lost gen 1, converged at 2
+        rec = b.record()
+        assert rec.generation == 2 and list(rec.members) == [7]
+        a.stop()
+        b.stop()
+
+    def test_in_process_mode_without_root(self):
+        m = FleetMembership(None)
+        m.join(0)
+        assert not m.enabled and m.expired([0]) == []
+        g = m.publish([0])
+        assert g == 1 and m.record() is None
+        m.stop()
+
+
+# ---------------------------------------------------------------------
+# acceptance: zero retraces per replica after warmup, incl. the
+# post-migration re-admits
+# ---------------------------------------------------------------------
+def _compile_total():
+    c = monitoring.global_registry().get(runtime.COMPILE_COUNTER)
+    return 0.0 if c is None else c.total()
+
+
+class TestNoRetraceAfterMigration:
+    def test_kill_and_migrate_compile_nothing_new(self):
+        """Full-envelope warmup on every replica, then a mid-trace
+        kill + migration: the survivor's re-primes land in its warm
+        prefill buckets and the continued decode reuses the compiled
+        arena shapes — zero retraces across the whole episode (the
+        PR 3 bar, applied to the fleet)."""
+        monitoring.ensure_started()
+        fleet = FleetRouter(_factory(), replicas=2,
+                            registry=MetricsRegistry())
+        fleet.warmup()              # every bucket up to capacity
+        warm = _compile_total()
+        hs = _submit_all(fleet, steps=6)
+        for _ in range(2):
+            fleet.step()
+        fleet.replicas()[0].engine._stop.set()
+        fleet.run_until_idle()
+        assert all(h.result(timeout=0) for h in hs)
+        assert fleet.migrations == 1
+        assert _compile_total() == warm, (
+            "fleet migration retraced after warmup — re-admits must "
+            "reuse the survivor's warm prefill buckets and arena shapes")
+        fleet.shutdown()
