@@ -805,7 +805,8 @@ def bench_serve_continuous():
     decoding is pinned by the f32 tier-1 suite). Reports tokens/s and
     mean/p95 time-to-first-token for both."""
     import numpy as np
-    from deeplearning4j_tpu.serving import GenerationEngine
+    from deeplearning4j_tpu.serving import (
+        GenerationEngine, ttft_attribution)
     from deeplearning4j_tpu.zoo import TextGenerationTransformer
 
     V, R, STEPS, SLOTS = 2048, 16, 32, 8
@@ -868,7 +869,11 @@ def bench_serve_continuous():
         "static_ttft_p95_ms": round(p95(ttft_static) * 1e3, 1),
         "requests": R, "slots": SLOTS, "steps": STEPS,
         "stagger_ms": STAGGER * 1e3,
-        "static_match_rows": match_rows}), flush=True)
+        "static_match_rows": match_rows,
+        # where the engine's TTFT went, from the request traces
+        # (ISSUE 15): queue wait vs prefill vs placement residue
+        "ttft_attribution": ttft_attribution(
+            [h.trace() for h in handles])}), flush=True)
 
 
 def bench_serve_paged():
@@ -897,9 +902,11 @@ def bench_serve_paged():
     arithmetic per step and bury the scheduling effect under matmul
     time."""
     import numpy as np
+    from deeplearning4j_tpu.monitoring.events import set_events_enabled
     from deeplearning4j_tpu.monitoring.metrics import MetricsRegistry
     from deeplearning4j_tpu.serving import (
-        GenerationEngine, PagedKVConfig, SpeculationConfig)
+        GenerationEngine, PagedKVConfig, SpeculationConfig,
+        ttft_attribution)
     from deeplearning4j_tpu.serving.health import SERVING_SPEC_ACCEPTANCE
     from deeplearning4j_tpu.util.decoding import prompt_lookup_proposer
     from deeplearning4j_tpu.zoo import TextGenerationTransformer
@@ -989,7 +996,9 @@ def bench_serve_paged():
                    if tpot else None),
                f"{label}_peak_active": peak[0],
                f"{label}_page_util": (
-                   round(peak_util[0], 3) if pool_total else None)}
+                   round(peak_util[0], 3) if pool_total else None),
+               f"{label}_ttft_attribution": ttft_attribution(
+                   [h.trace() for h in handles])}
         kvt = engine.health().get("kv_traffic")
         if kvt:
             out[f"{label}_decode_path"] = kvt["decode_path"]
@@ -1016,6 +1025,23 @@ def bench_serve_paged():
         paging=PagedKVConfig(page_size=PS, total_pages=budget_pages,
                              direct=False)),
         "paged_rt"))
+    # tracing overhead A/B (ISSUE 15): the SAME paged trace with the
+    # structured-event layer disabled — request tracing is ON by
+    # default, so its cost must be within run noise (≤2% is the
+    # acceptance band; recorded, with the delta, either way)
+    prev_enabled = set_events_enabled(False)
+    try:
+        rec.update(run(GenerationEngine(
+            net, V, slots=CONC, queue_limit=R,
+            paging=PagedKVConfig(page_size=PS,
+                                 total_pages=budget_pages)),
+            "paged_notrace"))
+    finally:
+        set_events_enabled(prev_enabled)
+    rec["tracing_overhead_frac"] = round(
+        1.0 - rec["paged_tokens_per_sec"]
+        / max(1e-9, rec["paged_notrace_tokens_per_sec"]), 4)
+
     rec["value"] = rec["paged_tokens_per_sec"]
     rec["admitted_concurrency_x"] = round(
         rec["paged_peak_active"] / max(1, rec["slot_peak_active"]), 2)
@@ -1103,7 +1129,7 @@ def bench_serve_chaos():
     from deeplearning4j_tpu.resilience.retry import RestartBudget
     from deeplearning4j_tpu.serving import (
         EngineSupervisor, GenerationEngine, OverloadConfig,
-        ServingOverloaded)
+        ServingOverloaded, ttft_attribution)
     from deeplearning4j_tpu.zoo import TextGenerationTransformer
 
     V, R, STEPS, SLOTS = 512, 24, 24, 4
@@ -1164,6 +1190,11 @@ def bench_serve_chaos():
                                   1) if ttft else None),
             "rebuilds": sup.rebuilds if sup else 0,
             "recovered_requests": sup.recovered_requests if sup else 0,
+            # trace-derived attribution incl. rebuild counts: the
+            # recovery column shows its rebuilds here, the fail-all
+            # column its truncated TTFT window
+            "ttft_attribution": ttft_attribution(
+                [h.trace() for h in handles if h is not None]),
         }
         eng.shutdown()
         return rec
@@ -1236,7 +1267,8 @@ def bench_serve_fleet():
     from deeplearning4j_tpu.monitoring import runtime
     from deeplearning4j_tpu.monitoring.metrics import MetricsRegistry
     from deeplearning4j_tpu.serving import (
-        FleetConfig, FleetRouter, GenerationEngine, PagedKVConfig)
+        FleetConfig, FleetRouter, GenerationEngine, PagedKVConfig,
+        ttft_attribution)
     from deeplearning4j_tpu.zoo import TextGenerationTransformer
 
     # the trace must OVERLOAD one replica (deep queue at 2 slots) so
@@ -1320,6 +1352,11 @@ def bench_serve_fleet():
                                   1) if ttft else None),
             "prefix_hit_rate": round(hits / max(1, hits + misses), 3),
             "retraces_after_warmup": compile_total() - warm,
+            # per-request trace decomposition: at 1 replica the queue
+            # term dominates; added replicas should move queue wait,
+            # not prefill — the attribution names which
+            "ttft_attribution": ttft_attribution(
+                [h.trace() for h in handles]),
         }
         if kill:
             rec.update({"killed_at_request": killed_at,

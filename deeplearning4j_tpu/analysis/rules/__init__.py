@@ -5,8 +5,10 @@ promoted in ISSUE 13), tracer-leak, recompile-hazard, dtype-promotion,
 concurrency, hygiene, retry (ISSUE 4), state-write (ISSUE 7),
 world-snapshot (ISSUE 8), lock-dispatch (ISSUE 9), the ISSUE 13
 exactness-contract families: donation-use-after-consume and
-jit-key-drift, and replica-state (ISSUE 14 — the fleet layer reads
-engines only through public accessors). Adding a rule = subclass
+jit-key-drift, replica-state (ISSUE 14 — the fleet layer reads
+engines only through public accessors), and wall-clock (ISSUE 15 —
+clock reads inside traced/step-builder bodies bake trace-time
+constants). Adding a rule = subclass
 `analysis.core.Rule` (optionally with a ``check_project`` for
 whole-program facts), instantiate it here.
 """
@@ -37,6 +39,8 @@ from deeplearning4j_tpu.analysis.rules.donation import (
 from deeplearning4j_tpu.analysis.rules.jit_key import JitKeyDriftRule
 from deeplearning4j_tpu.analysis.rules.replica_state import (
     ReplicaLocalStateInRouterRule)
+from deeplearning4j_tpu.analysis.rules.wall_clock import (
+    WallClockInTracedBodyRule)
 
 ALL_RULES: List[Rule] = [
     HostSyncRule(),
@@ -54,6 +58,7 @@ ALL_RULES: List[Rule] = [
     NonAtomicStateWriteRule(),
     WorldSnapshotRule(),
     ReplicaLocalStateInRouterRule(),
+    WallClockInTracedBodyRule(),
 ]
 
 RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in ALL_RULES}

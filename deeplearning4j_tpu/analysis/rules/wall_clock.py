@@ -1,0 +1,89 @@
+"""wall-clock-in-traced-body: host clock reads baked into a trace.
+
+The ISSUE 15 event layer put ``time.time()`` / ``time.monotonic()`` /
+``time.perf_counter()`` calls all over the serving and resilience hot
+paths — which is fine exactly because those paths are HOST code. The
+same call inside a TRACED body is a silent bug: jit stages the Python
+function once, the clock is read once at trace time, and the "current
+time" the compiled step computes with is a frozen constant from the
+day it compiled (the temporal cousin of jit-key-drift's stale-global
+class). The failure is invisible — no error, no retrace, just every
+subsequent dispatch reasoning about a timestamp that never advances.
+
+Two flagged shapes, both innermost-scope-resolved so ordinary host
+code around a dispatch stays clean:
+
+1. a clock read whose innermost enclosing function is jit-STAGED
+   (``@jax.jit``-decorated or wrapped by a ``jit(f)`` call) — the read
+   happens at trace time, full stop;
+2. a clock read whose innermost enclosing function lexically CONSTRUCTS
+   a jit (or is step-builder-named, the ``_get_*_step`` /
+   ``resolve_*`` family): build-time code runs once, so the value is a
+   per-build constant any nested traced closure would freeze.
+
+A clock read inside a nested def that is NOT itself staged or
+jit-building (e.g. a retry thunk defined inside a dispatch wrapper) is
+runtime host code and is exempt — the innermost scope decides.
+Measure-around-the-dispatch timing (``t0 = perf_counter()`` BEFORE the
+jitted call, outside any staged body) is the sanctioned idiom and
+never fires.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from deeplearning4j_tpu.analysis.core import (
+    Finding, ModuleInfo, Rule, SEVERITY_WARNING)
+from deeplearning4j_tpu.analysis.rules._common import (
+    collect_jit_functions, functions_building_jit)
+from deeplearning4j_tpu.analysis.rules.jit_key import STEP_BUILDER_NAME
+
+#: clock calls whose value is only meaningful when read at RUN time
+_CLOCK_FNS = frozenset({
+    "time.time", "time.monotonic", "time.perf_counter",
+    "time.time_ns", "time.monotonic_ns", "time.perf_counter_ns",
+    "time.process_time", "time.process_time_ns",
+})
+
+
+class WallClockInTracedBodyRule(Rule):
+    id = "wall-clock-in-traced-body"
+    severity = SEVERITY_WARNING
+    description = ("time.time()/time.monotonic()/perf_counter() inside "
+                   "a jit-staged or jit-constructing (step-builder) "
+                   "body: the clock is read once at trace/build time "
+                   "and the compiled step carries a frozen timestamp "
+                   "constant forever after")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        staged = collect_jit_functions(mod)      # traced bodies
+        builders = functions_building_jit(mod)   # build-time bodies
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = mod.resolve(node.func)
+            if target not in _CLOCK_FNS:
+                continue
+            enclosing = mod.enclosing_functions(node)
+            if not enclosing:
+                continue          # module scope: import-time host code
+            fn = enclosing[0]     # INNERMOST scope decides
+            if fn in staged:
+                yield self.finding(
+                    mod, node,
+                    f"`{target}()` inside jit-staged '{fn.name}': the "
+                    f"clock is read once at trace time and every "
+                    f"compiled dispatch reuses that frozen value — "
+                    f"read the clock OUTSIDE the staged body and pass "
+                    f"the result in as an argument")
+            elif fn in builders or STEP_BUILDER_NAME.match(fn.name):
+                yield self.finding(
+                    mod, node,
+                    f"`{target}()` inside jit-constructing "
+                    f"'{fn.name}': build-time code runs once, so this "
+                    f"timestamp is a per-build constant any traced "
+                    f"closure it reaches would bake in — move the read "
+                    f"to the per-call path (or pass timestamps as step "
+                    f"arguments)")

@@ -22,6 +22,9 @@ import threading
 
 from deeplearning4j_tpu.monitoring.metrics import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, global_registry)
+from deeplearning4j_tpu.monitoring.events import (  # noqa: F401
+    Event, EventLog, emit, events_enabled, global_event_log,
+    set_events_enabled)
 from deeplearning4j_tpu.monitoring.tracing import (  # noqa: F401
     current_path, declare_default_spans, is_enabled, phase_detail,
     record_span, set_enabled, set_phase_detail, span)
@@ -57,4 +60,9 @@ def ensure_started() -> None:
         from deeplearning4j_tpu.resilience.elastic import (
             declare_elastic_series)
         declare_elastic_series()
+        # structured-event series (events.py): the ring depth gauge and
+        # dropped counter render before the first event fires
+        from deeplearning4j_tpu.monitoring.events import (
+            declare_event_series)
+        declare_event_series()
         _started = True

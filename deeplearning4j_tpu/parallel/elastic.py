@@ -58,6 +58,7 @@ from typing import Any, Dict, Optional, Sequence
 
 import numpy as np
 
+from deeplearning4j_tpu.monitoring.events import emit as emit_event
 from deeplearning4j_tpu.resilience.chaos import fire
 from deeplearning4j_tpu.resilience.durable import (
     CommitTimeoutError, latest_committed_step, read_commit)
@@ -267,6 +268,10 @@ class ElasticTrainer:
         self.last_remesh_seconds = time.perf_counter() - t0
         self._c_remesh.inc(cause=event.cause)
         self._h_remesh.observe(self.last_remesh_seconds)
+        emit_event("resilience", "remesh", cause=event.cause,
+                   generation=rec.generation, world=len(rec.members),
+                   lost=sorted(event.lost_ranks or ()),
+                   seconds=round(self.last_remesh_seconds, 3))
         return rec
 
     # ------------------------------------------------------------------

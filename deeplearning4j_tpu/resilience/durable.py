@@ -61,6 +61,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from deeplearning4j_tpu.monitoring.events import emit as emit_event
 from deeplearning4j_tpu.monitoring.metrics import (
     MetricsRegistry, global_registry)
 
@@ -711,6 +712,8 @@ class PreemptionGuard:
             # could destroy a just-committed checkpoint
             save_checkpoint(net, self.checkpoint_dir, step=step)
         self.saved_step = step
+        emit_event("resilience", "preemption", step=step,
+                   checkpoint_dir=self.checkpoint_dir)
         log.warning("preemption: emergency checkpoint at step %d (%s); "
                     "exiting", step, self.checkpoint_dir)
         raise PreemptionExit(step, self.checkpoint_dir, self.exit_code)
@@ -855,6 +858,8 @@ def publish_commit(step_dir: str, step: int, world: int,
         "world": int(world), "shards": [shard_dir_name(r)
                                         for r in range(world)],
     })
+    emit_event("resilience", "checkpoint_commit", step=int(step),
+               world=int(world))
 
 
 def wait_commit(step_dir: str, timeout: float = 60.0,

@@ -32,6 +32,8 @@ from collections import deque
 from statistics import median
 from typing import Optional
 
+from deeplearning4j_tpu.monitoring import flightrecorder
+from deeplearning4j_tpu.monitoring.events import emit as emit_event
 from deeplearning4j_tpu.optimize.listeners import TrainingListener
 from deeplearning4j_tpu.resilience import sentinel
 
@@ -101,11 +103,13 @@ class DivergenceWatchdog(TrainingListener):
         acct = sentinel.flush_accounting(model)
         if acct is not None and \
                 acct.consecutive_bad >= self.max_consecutive_bad:
-            raise DivergenceError(
+            err = DivergenceError(
                 f"{acct.consecutive_bad} consecutive non-finite train "
                 f"steps (threshold {self.max_consecutive_bad}) — the "
                 f"input or the step size is persistently poisoned",
                 iteration=iteration)
+            self._flight(err, iteration, kind="bad_steps")
+            raise err
         # cadence sync #2: the score (lazy device scalar until floated)
         s = float(score)
         if s != s or s in (float("inf"), float("-inf")):
@@ -117,9 +121,26 @@ class DivergenceWatchdog(TrainingListener):
             limit = base + self.blowup_factor * max(abs(base),
                                                     self.abs_floor)
             if s > limit:
-                raise DivergenceError(
+                err = DivergenceError(
                     f"loss {s:.4g} blew past the divergence limit "
                     f"{limit:.4g} (trailing-window median {base:.4g}, "
                     f"factor {self.blowup_factor:g})",
                     iteration=iteration, limit=limit)
+                self._flight(err, iteration, kind="blowup",
+                             score=s, limit=limit)
+                raise err
         self._scores.append(s)
+
+    def _flight(self, err: DivergenceError, iteration: Optional[int],
+                **extra) -> None:
+        """Timeline event + post-mortem artifact at the raise site —
+        FaultTolerantTrainer may roll the process state back seconds
+        later, and the diverging trajectory (score window + recent ops
+        events) is exactly what the rollback erases."""
+        emit_event("resilience", "divergence", iteration=iteration,
+                   error=str(err), **extra)
+        flightrecorder.maybe_dump(
+            "divergence", error=err,
+            extra={"iteration": iteration,
+                   "score_window": [float(s) for s in self._scores],
+                   **extra})

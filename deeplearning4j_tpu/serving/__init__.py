@@ -47,7 +47,7 @@ from deeplearning4j_tpu.serving.paging import (  # noqa: F401
 from deeplearning4j_tpu.serving.prefix_cache import PrefixCache  # noqa: F401
 from deeplearning4j_tpu.serving.request import (  # noqa: F401
     GenerationRequest, GenerationStream, LEDGER_VERSION,
-    RequestLedgerEntry)
+    RequestLedgerEntry, RequestTrace, ttft_attribution)
 from deeplearning4j_tpu.serving.scheduler import (  # noqa: F401
     AdmissionQueue, QueueSnapshot)
 from deeplearning4j_tpu.serving.supervisor import (  # noqa: F401
@@ -64,5 +64,6 @@ __all__ = ["AdmissionQueue", "AutoscaleConfig", "EngineShutdown",
            "MigrationReport", "NoReplicaAvailable", "OverloadConfig",
            "OverloadController", "PagedKVConfig", "PageExhausted",
            "PagePool", "PrefixCache", "QueueSnapshot",
-           "RequestCancelled", "RequestLedgerEntry",
-           "ServingOverloaded", "ServingQueueFull", "SpeculationConfig"]
+           "RequestCancelled", "RequestLedgerEntry", "RequestTrace",
+           "ServingOverloaded", "ServingQueueFull", "SpeculationConfig",
+           "ttft_attribution"]

@@ -106,6 +106,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Callable, List, Optional
 
@@ -113,6 +114,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.monitoring import flightrecorder
+from deeplearning4j_tpu.monitoring.events import emit as emit_event
 from deeplearning4j_tpu.monitoring.metrics import (
     MetricsRegistry, global_registry)
 from deeplearning4j_tpu.nn.conf.layers import (
@@ -146,8 +149,8 @@ from deeplearning4j_tpu.serving.request import (
     GenerationRequest, GenerationStream, RequestLedgerEntry)
 from deeplearning4j_tpu.serving.scheduler import AdmissionQueue
 from deeplearning4j_tpu.util.decoding import (
-    _check_seed, _stream_layers, accept_proposals, draw, filter_probs,
-    prime_prompt, step_tokens, stop_reason, verify_tokens)
+    _check_seed, _stream_layers, _width_bucket, accept_proposals, draw,
+    filter_probs, prime_prompt, step_tokens, stop_reason, verify_tokens)
 
 log = logging.getLogger(__name__)
 
@@ -251,6 +254,11 @@ class GenerationEngine:
             n for n, v in (getattr(net.conf, "vertices", None) or {}).items()
             if getattr(getattr(v, "layer", None), "supports_streaming",
                        False)) if hasattr(net, "conf") else ()
+        #: PUBLIC replica identity, set by a fleet router at join time
+        #: (replicas built by one factory share the default model
+        #: label, so traces/timeline need the rid to tell them apart);
+        #: None outside a fleet
+        self.replica_tag: Optional[int] = None
         self._pending = AdmissionQueue(queue_limit, queue_policy)
         self._slots: List[Optional[GenerationRequest]] = [None] * slots
         self._row_pos = np.zeros(slots, np.int64)
@@ -392,6 +400,15 @@ class GenerationEngine:
         #: fault in that window can fail (or recover) it instead of
         #: stranding its handle with no terminal event
         self._seating: Optional[GenerationRequest] = None
+        #: traces of recently retired requests — the flight recorder's
+        #: "last N requests" context when the engine breaks (in-flight
+        #: requests' traces are read live off the slots)
+        self._recent_traces = deque(maxlen=16)
+        #: this engine's own recent lifecycle events (mirrored from the
+        #: global ring at emit time): health() reads THIS, not a full
+        #: ring scan — health() sits on polled paths (the autoscaler
+        #: reads every replica's health per tick)
+        self._own_events = deque(maxlen=10)
         self._stop = threading.Event()
         self._worker: Optional[threading.Thread] = None
         self._broken: Optional[BaseException] = None
@@ -484,6 +501,36 @@ class GenerationEngine:
     # ------------------------------------------------------------------
     # health / readiness (the ParallelInference probe contract)
     # ------------------------------------------------------------------
+    @property
+    def label(self) -> str:
+        """The model label this engine's telemetry/events carry — the
+        public identity the fleet layer (and the event timeline) keys
+        on."""
+        return self._label
+
+    @property
+    def trace_identity(self) -> str:
+        """The identity request traces record per lifecycle event: the
+        model label, rid-suffixed when a fleet router stamped
+        ``replica_tag`` (factory-built replicas share the label, and a
+        migrated trace must name BOTH sides of its hop)."""
+        if self.replica_tag is None:
+            return self._label
+        return f"{self._label}#r{self.replica_tag}"
+
+    def _emit_serving_event(self, name: str, **attrs) -> None:
+        """Publish one serving-lifecycle event under this engine's
+        trace identity (rid-suffixed in a fleet — label-sharing
+        replicas must not blend their timelines) and mirror it into
+        the bounded per-engine tail ``health()`` serves. The
+        supervisor emits its rebuild/escalate events through this too,
+        so one engine's recovery history lives in one place."""
+        ev = emit_event("serving", name, engine=self.trace_identity,
+                        **attrs)
+        if ev is not None:
+            self._own_events.append({"name": ev.name, "wall": ev.wall,
+                                     "attrs": dict(ev.attrs)})
+
     def is_healthy(self) -> bool:
         if self._broken is not None or self._stop.is_set():
             return False
@@ -544,6 +591,10 @@ class GenerationEngine:
                 "early_rejected_total":
                     self._overload.early_rejected_total,
             }
+        # recent lifecycle events (bounded, non-mutating, O(1)): the
+        # per-engine mirror, not a global-ring scan — health() runs on
+        # polled paths (every autoscaler tick reads every replica)
+        out["last_events"] = list(self._own_events)
         return out
 
     @property
@@ -623,6 +674,8 @@ class GenerationEngine:
                 self, req, time.monotonic())
             if reason is not None:
                 self._early_rejected.inc()
+                req.trace.record("early_reject", reason=reason)
+                self._emit_serving_event("early_reject")
                 raise ServingOverloaded(reason)
         try:
             self._pending.submit(req)
@@ -700,10 +753,17 @@ class GenerationEngine:
         victims = ov.shed(self)
         for req in victims:
             self._shed_counter.inc()
+            req.trace.record("shed", engine=self.trace_identity)
             req.handle._fail(ServingOverloaded(
                 "shed from the admission queue under a sustained "
                 "latency-SLO breach (lowest-priority first)"))
+        if victims:
+            self._emit_serving_event("shed", victims=len(victims))
+        prev = self._brownout
         self._brownout = ov.brownout_level(self)
+        if self._brownout != prev:
+            self._emit_serving_event("brownout", level=self._brownout,
+                                     prev=prev)
         return bool(victims)
 
     def _step_plain(self, active) -> None:
@@ -720,6 +780,7 @@ class GenerationEngine:
                 self._tpot_hist.observe(now - req.last_token_t)
             req.last_token_t = now
             req.handle._push(tok)
+            req.trace.rollup(1)
             self._tokens.inc()
             reason = stop_reason(tok, len(req.handle._ids), req.want,
                                  req.stop_tokens)
@@ -790,6 +851,8 @@ class GenerationEngine:
             if g:
                 self._spec_accept_hist.observe(accepted / g)
             committed = props[s][:accepted] + [nxt]
+            req.trace.rollup(len(committed), accepted=accepted,
+                             proposed=g)
             self._row_pos[s] += 1 + accepted
             amounts[s] = k - accepted
             reason = None
@@ -898,6 +961,7 @@ class GenerationEngine:
                 self._seating = None
                 continue
             _fire_chaos(self._seat_chaos, self._admissions, ctx=req)
+            req.trace.record("queue_pop", engine=self.trace_identity)
             req.handle.queue_wait_s = now - req.submit_t
             self._queue_wait_hist.observe(req.handle.queue_wait_s)
             if self._overload is not None:
@@ -1017,6 +1081,12 @@ class GenerationEngine:
                 _fire_chaos(self._prefill_chaos, self._admissions,
                             ctx=req)
             net.rnn_clear_previous_state()
+            fed = len(prime_ids) - hit_len
+            req.trace.record(
+                "prefill_start", engine=self.trace_identity, width=fed,
+                bucket=(_width_bucket(max(1, fed))
+                        if self._prime_padded else None),
+                prefix_hit=hit_len, readmit=readmit)
             if hit_len:
                 self._install_prefix(table, hit_len)
                 p0 = prime_prompt(net, prime_ids[hit_len:], self.V,
@@ -1024,6 +1094,7 @@ class GenerationEngine:
             else:
                 p0 = prime_prompt(net, prime_ids, self.V,
                                   padded=self._prime_padded)
+            req.trace.record("prefill_end")
             primed_pos = self._net_pos(net)
         except Exception as e:  # noqa: BLE001 — per-request failure domain
             net.state = saved_state
@@ -1033,10 +1104,12 @@ class GenerationEngine:
                 self._admissions += 1
             self._handles[SERVING_ERRORS].inc()
             req.handle._fail(e)
+            self._recent_traces.append(req.trace)
             return
         primed_state = dict(net.state)
         if readmit:
             tok = req.handle._ids[-1]    # pending, drawn pre-fault
+            req.trace.record("readmit", engine=self.trace_identity)
         else:
             self._admissions += 1
             tok = draw(p0, req.temperature, req.rng,
@@ -1047,6 +1120,7 @@ class GenerationEngine:
             if self._overload is not None:
                 self._overload.observe_ttft(req.handle.ttft_s, now)
             req.last_token_t = now
+            req.trace.record("first_token", engine=self.trace_identity)
             req.handle._push(tok)
             self._tokens.inc()
             reason = stop_reason(tok, len(req.handle._ids), req.want,
@@ -1060,6 +1134,7 @@ class GenerationEngine:
                 self._restore_accounting(saved_acct)
                 self._release_pages(table)
                 req.handle._finish(reason)
+                self._recent_traces.append(req.trace)
                 return
         if not self._arena_ready:
             if self._pool is not None:
@@ -1077,6 +1152,7 @@ class GenerationEngine:
         self._slots[slot] = req
         self._row_pos[slot] = primed_pos
         req.pending_token = tok
+        req.trace.record("seat", engine=self.trace_identity, slot=slot)
         self._sync_accounting()
 
     def _release_pages(self, table) -> None:
@@ -1141,6 +1217,7 @@ class GenerationEngine:
                 # a streamed survivor re-primes (no draw, rng untouched);
                 # a never-streamed one — the pop-to-seat window request —
                 # admits fresh and may even finish clean (one-token)
+                req.trace.record("rebuild", engine=self.trace_identity)
                 slot = self._slots.index(None)
                 self._admit_one(req, slot, readmit=req.streamed)
                 if self._slots[slot] is req or (
@@ -1236,6 +1313,7 @@ class GenerationEngine:
                             and req.handle.error is None):
                         n += 1
                 else:
+                    req.trace.record("requeue", engine=self.trace_identity)
                     self._pending.requeue(req)
                     n += 1
             return n
@@ -1629,6 +1707,7 @@ class GenerationEngine:
             req.handle._fail(exc, reason)
         else:
             req.handle._finish(reason)
+        self._recent_traces.append(req.trace)
 
     # ------------------------------------------------------------------
     # arena state plumbing
@@ -1840,6 +1919,16 @@ class GenerationEngine:
             log.exception("GenerationEngine loop died")
             self._break(e)
 
+    def _flight_traces(self) -> list:
+        """The flight recorder's request context: in-flight traces
+        (slots + the pop-to-seat window) first, then recently retired
+        ones — newest history the post-mortem most wants."""
+        traces = [r.trace for r in self._slots if r is not None]
+        if self._seating is not None:
+            traces.append(self._seating.trace)
+        traces.extend(reversed(self._recent_traces))
+        return traces
+
     def _break(self, exc: BaseException) -> None:
         """Terminal failure: fail every in-flight and queued request
         with the original error and refuse new work. A broken arena is
@@ -1851,6 +1940,15 @@ class GenerationEngine:
             # stop the loop too: with the queue closed, wait() returns
             # immediately — a broken engine must park, not busy-spin
             self._stop.set()
+            self._emit_serving_event("break", error=repr(exc))
+            # post-mortem artifact BEFORE the handles are failed and
+            # the queue drained — the bundle must show the state the
+            # fault found, not the rubble _break leaves. Best-effort
+            # and rate-limited inside maybe_dump.
+            flightrecorder.maybe_dump(
+                "engine_break", error=exc, health=self.health(),
+                queue=self._pending.snapshot(),
+                traces=self._flight_traces())
             if self._seating is not None:
                 # popped but never seated: fail it here or nobody will
                 req, self._seating = self._seating, None
@@ -1879,6 +1977,7 @@ class GenerationEngine:
         handoff then needs the supervisor's escalation story, not a
         clean restart."""
         self._draining = True
+        self._emit_serving_event("drain")
         for req in self._pending.close():
             req.handle._fail(EngineShutdown(
                 "GenerationEngine draining — resubmit to the "

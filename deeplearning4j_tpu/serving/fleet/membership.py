@@ -28,6 +28,7 @@ import logging
 import threading
 from typing import Dict, List, Optional, Sequence
 
+from deeplearning4j_tpu.monitoring.events import emit as emit_event
 from deeplearning4j_tpu.resilience.elastic import (
     GenerationRecord, LeaseLedger)
 
@@ -146,6 +147,8 @@ class FleetMembership:
                 self.generation = gen
             for lease in list(self._leases.values()):
                 lease.heartbeat(gen)       # re-stamp the beat stream
+        emit_event("fleet", "generation", generation=gen,
+                   members=list(members), publisher=int(publisher))
         return gen
 
     def record(self) -> Optional[GenerationRecord]:

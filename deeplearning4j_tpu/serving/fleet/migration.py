@@ -125,6 +125,12 @@ def readmit_entries(entries: Sequence[RequestLedgerEntry],
                 report.admitted += took
                 report.per_target[rep.rid] = \
                     report.per_target.get(rep.rid, 0) + took
+                # the hop, on the request's OWN trace: a migrated
+                # stream's post-mortem must name both replicas even
+                # after the source object is gone. Recorded after the
+                # target accepted (a refused target is not a hop).
+                req.trace.record("migrate", source=source,
+                                 target=rep.rid, cause=cause)
             elif req.handle.done:
                 report.resolved_dead += 1   # cancel/deadline resolved
             break

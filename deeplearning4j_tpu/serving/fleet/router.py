@@ -49,6 +49,9 @@ import time
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
 
+from deeplearning4j_tpu.monitoring import flightrecorder
+from deeplearning4j_tpu.monitoring.events import (
+    emit as emit_event, global_event_log)
 from deeplearning4j_tpu.monitoring.metrics import (
     MetricsRegistry, global_registry)
 from deeplearning4j_tpu.serving.errors import (
@@ -150,6 +153,10 @@ class FleetRouter:
         self.migrations = 0
         self.migrated_requests = 0
         self.scale_events = 0
+        #: every replica trace identity ("label#rN") ever fronted,
+        #: dead ones included — the timeline filter must keep showing
+        #: a dead replica's serving events after the router dropped it
+        self._engine_labels: set = set()
         self._register_metrics(registry)
         for _ in range(replicas):
             self._add_replica()
@@ -202,10 +209,15 @@ class FleetRouter:
             rid = self._next_rid
             self._next_rid += 1
         engine = self._factory(rid)
+        # factory-built replicas share the default model label: stamp
+        # the rid so request traces name WHICH replica served them
+        # (engine.trace_identity -> "label#rN")
+        engine.replica_tag = rid
         rep = FleetReplica(rid, engine)
         with self._mu:
             self._replicas[rid] = rep
             members = list(self._replicas)
+            self._engine_labels.add(engine.trace_identity)
         self.membership.join(rid)
         self.membership.publish(members, publisher=rid)
         if self._started:
@@ -214,6 +226,11 @@ class FleetRouter:
             self.scale_events += 1
             self._scale_c.labels(fleet=self._label,
                                  direction=direction).inc()
+            emit_event("fleet", "scale_out", fleet=self._label,
+                       replica=rid)
+        emit_event("fleet", "replica_join", fleet=self._label,
+                   replica=rid, generation=self.membership.generation,
+                   live=len(members))
         log.info("fleet %s: replica %d joined (generation %d, %d live)",
                  self._label, rid, self.membership.generation,
                  len(members))
@@ -326,7 +343,11 @@ class FleetRouter:
         while True:
             try:
                 rep = self._place(prompt, exclude)
-            except NoReplicaAvailable:
+            except NoReplicaAvailable as e:
+                flightrecorder.maybe_dump(
+                    "no_replica", error=last if last is not None else e,
+                    health=self.health(),
+                    extra={"excluded": sorted(exclude)})
                 if last is not None:
                     raise last
                 raise
@@ -369,6 +390,9 @@ class FleetRouter:
         for rep in dead:
             out["dead"].append(rep.rid)
             self._dead_c.inc()
+            emit_event("fleet", "replica_dead", fleet=self._label,
+                       replica=rep.rid,
+                       lease_expired=rep.rid in expired)
             report = self._migrate_from(rep, mig.CAUSE_DEATH)
             out["migrated"] += report.admitted
         if self.config.rebalance_queue_wait_s is not None:
@@ -403,6 +427,9 @@ class FleetRouter:
             self.migrations += 1
             self._migrations_c.labels(fleet=self._label,
                                       cause=cause).inc()
+            emit_event("fleet", "migration", fleet=self._label,
+                       source=rep.rid, cause=cause, wedged=True,
+                       exported=0, admitted=0)
             return mig.MigrationReport(cause=cause, source=rep.rid)
         self._drop_replica(rep)
         report = mig.readmit_entries(entries, self._place, cause,
@@ -411,6 +438,20 @@ class FleetRouter:
         self.migrated_requests += report.admitted
         self._migrations_c.labels(fleet=self._label, cause=cause).inc()
         self._migrated_c.inc(report.admitted)
+        emit_event("fleet", "migration", fleet=self._label,
+                   source=rep.rid, cause=cause,
+                   exported=report.exported, admitted=report.admitted,
+                   failed=report.failed,
+                   targets={str(k): v
+                            for k, v in report.per_target.items()})
+        if report.failed:
+            # in-flight work just died for want of a replica: the same
+            # post-mortem trigger as a submit-side NoReplicaAvailable
+            flightrecorder.maybe_dump(
+                "no_replica", health=self.health(),
+                traces=[e.request.trace for e in entries],
+                extra={"cause": cause, "source": rep.rid,
+                       "failed": report.failed})
         rep.engine.shutdown()     # nothing in flight: a clean stop
         return report
 
@@ -473,6 +514,9 @@ class FleetRouter:
             self._migrations_c.labels(fleet=self._label,
                                       cause=mig.CAUSE_OVERLOAD).inc()
             self._migrated_c.inc(report.admitted)
+            emit_event("fleet", "rebalance", fleet=self._label,
+                       source=rep.rid, target=best.rid,
+                       moved=report.admitted)
             moved += report.admitted
             break
         return moved
@@ -484,7 +528,12 @@ class FleetRouter:
             [r.engine.queue_snapshot().depth for r in reps])
 
     def _autoscale_tick(self, now: float) -> Optional[str]:
-        decision = self._autoscaler.decide(self._signals(), now)
+        signals = self._signals()
+        decision = self._autoscaler.decide(signals, now)
+        if decision is not None:
+            emit_event("fleet", "autoscale", fleet=self._label,
+                       decision=decision, replicas=signals.replicas,
+                       queued=signals.queued, active=signals.active)
         if decision == "out":
             self._add_replica(direction="out")
         elif decision == "in":
@@ -520,6 +569,8 @@ class FleetRouter:
         report = self._migrate_from(rep, mig.CAUSE_SCALE_IN)
         self.scale_events += 1
         self._scale_c.labels(fleet=self._label, direction="in").inc()
+        emit_event("fleet", "scale_in", fleet=self._label,
+                   replica=rep.rid, moved=report.admitted)
         return report
 
     # ------------------------------------------------------------------
@@ -589,6 +640,27 @@ class FleetRouter:
     # ------------------------------------------------------------------
     # observability
     # ------------------------------------------------------------------
+    def timeline(self, n: Optional[int] = 100) -> List:
+        """This fleet's slice of the process-wide ops timeline, oldest
+        first: the router's own ``fleet`` events plus the ``serving``
+        lifecycle events of every replica it ever fronted (dead ones
+        included — a post-mortem needs the victim's last brownout, not
+        just the migration that buried it). Non-mutating snapshot of
+        the bounded ring; no lock is held while filtering."""
+        with self._mu:
+            labels = set(self._engine_labels)
+        out = []
+        for e in global_event_log().tail(None):
+            if e.category == "fleet" \
+                    and e.attrs.get("fleet") == self._label:
+                out.append(e)
+            elif e.category == "serving" \
+                    and e.attrs.get("engine") in labels:
+                out.append(e)
+        if n is not None:
+            out = out[-n:]
+        return out
+
     def health(self) -> dict:
         reps = self.replicas()
         return {
@@ -598,4 +670,9 @@ class FleetRouter:
             "migrations": self.migrations,
             "migrated_requests": self.migrated_requests,
             "scale_events": self.scale_events,
+            # bounded recent-timeline tail: a live probe sees the last
+            # few control-plane actions without the JSONL sink
+            "last_events": [
+                {"category": e.category, "name": e.name, "wall": e.wall,
+                 "attrs": dict(e.attrs)} for e in self.timeline(10)],
         }

@@ -16,6 +16,19 @@ by the device), and the sampling config. Supervisor recovery
 move requests as ledger entries through ONE engine code path
 (``GenerationEngine.export_ledger`` / ``admit_from_ledger``) instead
 of two hand-synced copies of the rebuild payload.
+
+``RequestTrace`` (ISSUE 15) is the per-request observability half of
+the same insight: every lifecycle transition a request goes through —
+submit, queue pop, prefill (with its jit bucket), seat, first token,
+periodic decode rollups, shed / early rejection, migration hops,
+supervisor re-admissions, retirement — lands as a timestamped record
+on the request's handle, so "why was THIS request slow" decomposes
+into queue wait vs prefill vs decode vs recovery instead of being one
+opaque TTFT histogram sample. Traces are host-side, bounded, and ride
+the ledger payload across replicas (LEDGER_VERSION 2; v1 payloads
+still admit, trace-less), so a migrated stream's history survives the
+hop. ``ttft_attribution`` aggregates a window of traces into the
+queue/prefill/placement decomposition the bench serve legs record.
 """
 
 from __future__ import annotations
@@ -24,17 +37,251 @@ import dataclasses
 import queue
 import threading
 import time
-from typing import List, Optional, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
+from deeplearning4j_tpu.monitoring.events import events_enabled
 from deeplearning4j_tpu.serving.errors import InferenceTimeout
 
 #: format version stamped into every exported ledger entry; bump on any
-#: change to the payload fields or their meaning
-LEDGER_VERSION = 1
+#: change to the payload fields or their meaning.
+#: v1: prompt/ids/rng/config.  v2 (ISSUE 15): + the request trace.
+LEDGER_VERSION = 2
 
 _DONE = object()     # terminal queue sentinel
+
+#: decode progress lands on a trace as ROLLUPS — one record per this
+#: many committed tokens (plus a flush at retirement) — never one
+#: record per token: a 4k-token stream is ~128 trace records, not 4k
+TRACE_ROLLUP_EVERY = 32
+#: per-trace record cap; overflow drops (counted) rather than growing
+TRACE_MAX_RECORDS = 256
+
+
+class RequestTrace:
+    """Bounded host-side trace of one request's lifecycle.
+
+    Records are small dicts ``{"event", "t", ...attrs}`` with ``t`` =
+    wall-clock ``time.time()`` (wall, not monotonic, deliberately: a
+    trace crosses process boundaries inside the ledger payload, and
+    monotonic clocks do not). Thread-safe — the submit caller, the
+    engine step thread, and a fleet poll thread may all touch one
+    request. All methods are no-ops while
+    ``monitoring.events.set_events_enabled(False)`` holds, except reads.
+
+    ``breakdown()`` is the attribution contract: where did this
+    request's wall time go — queue wait, prefill, decode — and how many
+    migration hops / supervisor rebuilds did it survive.
+    """
+
+    __slots__ = ("records", "dropped", "_pend_tokens", "_pend_accepted",
+                 "_pend_proposed", "_mu")
+
+    def __init__(self, records: Optional[List[Dict[str, Any]]] = None,
+                 dropped: int = 0):
+        self.records: List[Dict[str, Any]] = records if records is not None \
+            else []
+        self.dropped = int(dropped)
+        self._pend_tokens = 0
+        self._pend_accepted = 0
+        self._pend_proposed = 0
+        self._mu = threading.Lock()
+
+    # -- write side (engine / router / migration) ----------------------
+    def record(self, event: str, **attrs) -> None:
+        if not events_enabled():
+            return
+        rec = {"event": event, "t": time.time()}
+        rec.update(attrs)
+        with self._mu:
+            if len(self.records) >= TRACE_MAX_RECORDS:
+                if event == "decode":
+                    self.dropped += 1
+                    return
+                # lifecycle records (retire, migrate, rebuild, ...)
+                # outrank decode-progress history: evict the oldest
+                # rollup so a very long stream still ends with its
+                # retirement cause and hops on the trace
+                for i, r in enumerate(self.records):
+                    if r["event"] == "decode":
+                        del self.records[i]
+                        self.dropped += 1
+                        break
+                else:
+                    self.dropped += 1
+                    return
+            self.records.append(rec)
+
+    def rollup(self, tokens: int, accepted: Optional[int] = None,
+               proposed: Optional[int] = None) -> None:
+        """Accumulate decode progress; emits one ``decode`` record per
+        ``TRACE_ROLLUP_EVERY`` committed tokens (the no-per-token-spam
+        contract). Speculative steps pass accepted/proposed counts."""
+        if not events_enabled():
+            return
+        with self._mu:
+            self._pend_tokens += int(tokens)
+            if accepted is not None:
+                self._pend_accepted += int(accepted)
+            if proposed is not None:
+                self._pend_proposed += int(proposed)
+            flush = self._pend_tokens >= TRACE_ROLLUP_EVERY
+        if flush:
+            self.flush_rollup()
+
+    def flush_rollup(self) -> None:
+        """Materialize any pending rollup (retirement / export calls
+        this so a short stream still shows its decode record)."""
+        with self._mu:
+            n = self._pend_tokens
+            acc, prop = self._pend_accepted, self._pend_proposed
+            self._pend_tokens = 0
+            self._pend_accepted = self._pend_proposed = 0
+        if n:
+            extra = {}
+            if prop:
+                extra = {"accepted": acc, "proposed": prop}
+            self.record("decode", tokens=n, **extra)
+
+    # -- read side -----------------------------------------------------
+    def events(self) -> List[Dict[str, Any]]:
+        """Snapshot of the trace records (oldest first)."""
+        with self._mu:
+            return [dict(r) for r in self.records]
+
+    def replicas(self) -> List[str]:
+        """Engine labels this request was ever seated (or re-primed)
+        on, in first-seen order — a migrated stream lists both sides of
+        the hop."""
+        seen: List[str] = []
+        for r in self.events():
+            eng = r.get("engine")
+            if eng is not None and eng not in seen:
+                seen.append(eng)
+        return seen
+
+    def breakdown(self) -> Dict[str, Any]:
+        """Decompose the trace into the attribution dict:
+
+        - ``queue_wait_s``: sum over every enqueue→pop span (a request
+          can ride a queue more than once — requeue, migration);
+          ``queue_wait_ttft_s`` is the subset accrued BEFORE the first
+          token (what TTFT attribution may count — a migrated active
+          stream's target-queue wait is recovery cost, not
+          time-to-first-token);
+        - ``prefill_s``: sum over prefill_start→prefill_end spans
+          (re-prime prefills after a rebuild/migration included;
+          ``prefill_ttft_s`` is the pre-first-token subset);
+        - ``decode_s``: first token → retirement, MINUS any prefill
+          spans inside that window (re-primes are recovery cost, not
+          decode) — so the components partition the request's life;
+        - ``migrations`` / ``rebuilds``: hop and re-admission counts;
+        - ``ttft_s``: submit → first token when both were traced.
+        """
+        evs = self.events()
+        out: Dict[str, Any] = {"queue_wait_s": 0.0,
+                               "queue_wait_ttft_s": 0.0,
+                               "prefill_s": 0.0, "prefill_ttft_s": 0.0,
+                               "decode_s": None, "migrations": 0,
+                               "rebuilds": 0, "ttft_s": None}
+        enq_t: Optional[float] = None
+        pre_t: Optional[float] = None
+        submit_t: Optional[float] = None
+        first_t: Optional[float] = None
+        end_t: Optional[float] = None
+        re_prefill = 0.0
+        for r in evs:
+            ev, t = r["event"], r["t"]
+            if ev == "submit":
+                submit_t = t
+                enq_t = t
+            elif ev in ("requeue", "migrate"):
+                if ev == "migrate":
+                    out["migrations"] += 1
+                enq_t = t
+            elif ev == "queue_pop":
+                if enq_t is not None:
+                    span = max(0.0, t - enq_t)
+                    out["queue_wait_s"] += span
+                    if first_t is None:
+                        out["queue_wait_ttft_s"] += span
+                    enq_t = None
+            elif ev == "prefill_start":
+                pre_t = t
+            elif ev == "prefill_end":
+                if pre_t is not None:
+                    span = max(0.0, t - pre_t)
+                    out["prefill_s"] += span
+                    if first_t is not None:
+                        re_prefill += span
+                    else:
+                        out["prefill_ttft_s"] += span
+                    pre_t = None
+            elif ev == "first_token":
+                if first_t is None:
+                    first_t = t
+            elif ev == "rebuild":
+                out["rebuilds"] += 1
+            elif ev == "retire":
+                end_t = t
+        if submit_t is not None and first_t is not None:
+            out["ttft_s"] = max(0.0, first_t - submit_t)
+        if first_t is not None and end_t is not None:
+            out["decode_s"] = max(0.0, end_t - first_t - re_prefill)
+        return out
+
+    # -- the ledger wire form ------------------------------------------
+    def to_payload(self) -> dict:
+        self.flush_rollup()
+        with self._mu:
+            return {"records": [dict(r) for r in self.records],
+                    "dropped": self.dropped}
+
+    @classmethod
+    def from_payload(cls, payload: Optional[dict]) -> "RequestTrace":
+        if not payload:
+            return cls()
+        return cls(records=[dict(r) for r in payload.get("records", ())],
+                   dropped=int(payload.get("dropped", 0)))
+
+
+def ttft_attribution(traces: Iterable[RequestTrace]) -> Dict[str, Any]:
+    """Aggregate a window of request traces into the TTFT attribution
+    dict the bench serve legs stamp into every record: mean observed
+    TTFT decomposed into queue wait + prefill + placement residue
+    ("other": submit-side routing, admission bookkeeping, the dispatch
+    the first token rode). Traces without a first token (shed, early
+    rejected, failed pre-prefill) are excluded from the TTFT means but
+    counted. All values are SECONDS; the caller renders units."""
+    n = n_ttft = 0
+    ttft = queue_w = prefill = 0.0
+    migrations = rebuilds = 0
+    for tr in traces:
+        b = tr.breakdown()
+        n += 1
+        migrations += b["migrations"]
+        rebuilds += b["rebuilds"]
+        if b["ttft_s"] is None:
+            continue
+        n_ttft += 1
+        ttft += b["ttft_s"]
+        # only queue wait accrued BEFORE the first token counts toward
+        # TTFT — a migrated stream's later target-queue ride is
+        # recovery cost, not admission latency
+        q = min(b["queue_wait_ttft_s"], b["ttft_s"])
+        queue_w += q
+        # prefill inside the TTFT window only (re-primes come later)
+        prefill += min(b["prefill_ttft_s"], max(0.0, b["ttft_s"] - q))
+    if n_ttft == 0:
+        return {"requests": n, "with_ttft": 0}
+    other = max(0.0, (ttft - queue_w - prefill) / n_ttft)
+    return {"requests": n, "with_ttft": n_ttft,
+            "ttft_mean_s": round(ttft / n_ttft, 6),
+            "queue_wait_mean_s": round(queue_w / n_ttft, 6),
+            "prefill_mean_s": round(prefill / n_ttft, 6),
+            "other_mean_s": round(other, 6),
+            "migrations": migrations, "rebuilds": rebuilds}
 
 
 class GenerationStream:
@@ -65,6 +312,12 @@ class GenerationStream:
         #: engine; None until known)
         self.ttft_s: Optional[float] = None
         self.queue_wait_s: Optional[float] = None
+        self._trace = RequestTrace()
+
+    def trace(self) -> RequestTrace:
+        """This request's lifecycle trace (live — it keeps growing
+        until retirement; ``breakdown()`` any time)."""
+        return self._trace
 
     # -- engine side ---------------------------------------------------
     def _push(self, token: int) -> None:
@@ -73,6 +326,10 @@ class GenerationStream:
 
     def _finish(self, reason: str) -> None:
         self.finish_reason = reason
+        self._trace.flush_rollup()
+        self._trace.record("retire", reason=reason,
+                           **({"error": repr(self._error)}
+                              if self._error is not None else {}))
         self._done.set()
         self._q.put(_DONE)
 
@@ -169,6 +426,14 @@ class GenerationRequest:
         self.submit_t = time.monotonic()
         self.pending_token: Optional[int] = None
         self.last_token_t: Optional[float] = None
+        self.handle._trace.record("submit", prompt_len=len(self.prompt),
+                                  steps=self.steps,
+                                  priority=self.priority)
+
+    @property
+    def trace(self) -> RequestTrace:
+        """The handle's lifecycle trace (engine-side shorthand)."""
+        return self.handle._trace
 
     @property
     def streamed(self) -> bool:
@@ -250,7 +515,11 @@ class RequestLedgerEntry:
     def payload(self) -> dict:
         """JSON-able form of everything a bit-identical continuation
         needs on another host. Deadlines travel as REMAINING budget
-        (monotonic clocks don't cross processes); ``None`` stays None."""
+        (monotonic clocks don't cross processes); ``None`` stays None.
+        Since v2 the request's lifecycle trace travels too (wall-clock
+        timestamps — the one clock that crosses processes), so a
+        migrated stream's post-mortem shows its whole history, hops
+        included."""
         req = self.request
         remaining = None if req.deadline is None else \
             req.deadline - time.monotonic()
@@ -267,6 +536,7 @@ class RequestLedgerEntry:
             "priority": req.priority,
             "deadline_remaining_s": remaining,
             "rng_state": self._jsonable(req.rng.bit_generator.state),
+            "trace": req.handle._trace.to_payload(),
         }
 
     @classmethod
@@ -275,7 +545,9 @@ class RequestLedgerEntry:
         restored bit-exactly (same bit-generator type + state), the
         committed ids are replayed into a fresh handle, and the pending
         token is restored — ``admit_from_ledger`` then continues the
-        stream exactly as an in-process entry would."""
+        stream exactly as an in-process entry would. v1 payloads (no
+        trace) still admit cleanly: the continuation starts a fresh
+        trace with an import marker instead of refusing the request."""
         version = int(payload["version"])
         if version > LEDGER_VERSION:
             raise ValueError(
@@ -299,4 +571,13 @@ class RequestLedgerEntry:
         if len(ids) > len(prompt):
             req.handle._ids = list(ids)
             req.pending_token = ids[-1]
+        trace_payload = payload.get("trace")
+        if trace_payload:
+            req.handle._trace = RequestTrace.from_payload(trace_payload)
+        else:
+            # a v1 (trace-less) payload: keep the fresh trace the
+            # request constructor started, marked so attribution knows
+            # this history begins at the import boundary
+            req.handle._trace.record("imported",
+                                     payload_version=version)
         return cls(version, req, tuple(ids), str(payload["phase"]))

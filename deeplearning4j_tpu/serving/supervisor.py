@@ -52,6 +52,7 @@ import logging
 import time
 from typing import Optional
 
+from deeplearning4j_tpu.monitoring import flightrecorder
 from deeplearning4j_tpu.monitoring.metrics import (
     MetricsRegistry, global_registry)
 from deeplearning4j_tpu.resilience.retry import RestartBudget
@@ -134,6 +135,7 @@ class EngineSupervisor:
         if not self.budget.try_acquire():
             self.escalations += 1
             self._escalated.inc()
+            self._escalation_telemetry(engine, exc, "budget_exhausted")
             log.error(
                 "serving supervisor: restart budget exhausted "
                 "(%d rebuilds / %.0fs window) — escalating %r to "
@@ -145,6 +147,7 @@ class EngineSupervisor:
         except Exception:  # noqa: BLE001 — a failed rebuild must escalate
             self.escalations += 1
             self._escalated.inc()
+            self._escalation_telemetry(engine, exc, "rebuild_failed")
             log.exception(
                 "serving supervisor: arena rebuild failed — escalating "
                 "the original fault %r to fail-all", exc)
@@ -154,12 +157,31 @@ class EngineSupervisor:
         self.last_rebuild_t = time.monotonic()
         self._rebuild_handles[cause].inc()
         self._recovered.inc(survivors)
+        engine._emit_serving_event(
+            "rebuild", cause=cause, survivors=survivors,
+            budget_remaining=self.budget.remaining())
         log.warning(
             "serving supervisor: quarantined arena after %s (%r); "
             "rebuilt and re-admitted %d in-flight request(s) "
             "(%d budget restart(s) left)", cause, exc, survivors,
             self.budget.remaining())
         return True
+
+    def _escalation_telemetry(self, engine, exc: BaseException,
+                              why: str) -> None:
+        """Timeline event + flight-record artifact at the moment the
+        supervisor gives up — the last look at the arena before
+        ``_break`` fails every handle (its own dump, fired next, is
+        deduped by the per-trigger rate limit but kept as a distinct
+        trigger for the unsupervised case)."""
+        engine._emit_serving_event("escalate", why=why,
+                                   error=repr(exc))
+        flightrecorder.maybe_dump(
+            "supervisor_escalation", error=exc,
+            health=engine.health(),
+            queue=engine.queue_snapshot(),
+            traces=engine._flight_traces(),
+            extra={"why": why, "supervisor": self.health()})
 
     # -- observability -------------------------------------------------
     def health(self) -> dict:

@@ -339,6 +339,23 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        # the structured ops timeline (monitoring/events.py), JSON:
+        # ?n=<count> bounds the tail, ?category=<serving|fleet|...>
+        # filters. The ring is snapshotted under its lock and serialized
+        # OUTSIDE it — a slow client can never stall an emitter.
+        if path == "/events":
+            from deeplearning4j_tpu.monitoring import events as ev
+            elog = ev.global_event_log()
+            try:
+                n = max(0, int(params.get("n", 200)))
+            except ValueError:
+                return self._json({"error": "n must be an integer"}, 400)
+            tail = elog.tail(n, category=params.get("category"))
+            return self._json({
+                "depth": elog.depth(),
+                "dropped": elog.dropped_total,
+                "enabled": ev.events_enabled(),
+                "events": [e.as_dict() for e in tail]})
         if path == "/chart.js":
             body = _CHART_JS.encode()
             self.send_response(200)

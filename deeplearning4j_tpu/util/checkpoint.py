@@ -49,6 +49,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.monitoring.events import emit as emit_event
 from deeplearning4j_tpu.optimize.listeners import TrainingListener
 from deeplearning4j_tpu.resilience.durable import (
     AsyncCheckpointWriter, CommitTimeoutError, CorruptCheckpointError,
@@ -195,6 +196,8 @@ def save_checkpoint(net, path: str, step: Optional[int] = None,
             # fallback of record
             atomic_write_json(_tag_path(path, step), status)
         atomic_write_json(os.path.join(path, "config.json"), meta)
+        emit_event("resilience", "checkpoint_save", step=step,
+                   mode="async" if writer is not None else "sync")
 
     if writer is not None:
         writer.submit(_write, label=os.path.basename(step_dir))

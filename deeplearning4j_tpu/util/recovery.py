@@ -54,6 +54,7 @@ from __future__ import annotations
 import logging
 from typing import Optional, Tuple, Type
 
+from deeplearning4j_tpu.monitoring.events import emit as emit_event
 from deeplearning4j_tpu.monitoring.metrics import global_registry
 from deeplearning4j_tpu.resilience.durable import declare_checkpoint_series
 from deeplearning4j_tpu.resilience.watchdog import (
@@ -192,6 +193,8 @@ class FaultTolerantTrainer:
                 step = cand
                 break
         if step is not None:
+            emit_event("resilience", "rollback", step=step,
+                       cause=repr(cause))
             log.info("rolled back to checkpoint step %d (epoch %d)",
                      step, self.net.epoch_count)
             # drop the mid-divergence saves BEYOND the rewind point:
@@ -271,6 +274,10 @@ class FaultTolerantTrainer:
                     ("cause",)).inc(
                     cause="divergence" if isinstance(e, DivergenceError)
                     else "transient")
+                emit_event(
+                    "resilience", "restart", attempt=attempts,
+                    cause=("divergence" if isinstance(e, DivergenceError)
+                           else "transient"), error=repr(e))
                 log.warning("training failed (%s); restart %d/%d from "
                             "latest checkpoint", e, attempts,
                             self.max_restarts)

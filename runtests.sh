@@ -40,6 +40,13 @@ tail -n 2 "$tpulint_out"   # findings summary + scanned-module count
 # (includes the no-new-retraces guard: instrumentation must not recompile)
 python -m pytest tests/test_monitoring.py -q -p no:cacheprovider
 
+# tier-1 events lane: the structured event log, per-request tracing,
+# and the fault flight recorder (monitoring/events.py, flightrecorder.py,
+# serving RequestTrace) — ring bounds/drops + thread safety, breakdown /
+# TTFT-attribution math, flight dumps on an injected decode fault, and
+# the zero-retraces-with-tracing-ON guard
+python -m pytest tests/test_events.py -q -p no:cacheprovider
+
 # tier-1 input-pipeline lane: device prefetch + fused multi-step
 # dispatch (pipeline/, fit(steps_per_dispatch=K)) — the fused-vs-unfused
 # equivalence and zero-retrace-after-warmup contracts fail fast here

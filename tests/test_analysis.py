@@ -1847,6 +1847,135 @@ class TestReplicaStateRule:
 
 
 # ---------------------------------------------------------------------
+# rule: wall-clock-in-traced-body (ISSUE 15)
+# ---------------------------------------------------------------------
+class TestWallClockRule:
+    def test_positive_clock_in_jit_staged_body(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import time
+            import jax
+
+            @jax.jit
+            def step(x):
+                t0 = time.time()          # frozen at trace time
+                return x + t0
+        """)
+        assert "wall-clock-in-traced-body" in _rules_of(fs)
+
+    def test_positive_clock_in_wrapped_function(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import time
+            import jax
+
+            def raw(x):
+                return x * time.perf_counter()
+
+            fast = jax.jit(raw)
+        """)
+        assert "wall-clock-in-traced-body" in _rules_of(fs)
+
+    def test_positive_clock_in_step_builder_body(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import time
+            import jax
+
+            def _get_train_step(self):
+                started = time.monotonic()   # per-build constant
+
+                @jax.jit
+                def step(p, batch):
+                    return p, started
+                return step
+        """)
+        # one in the builder body; the staged closure reads a captured
+        # name, not the clock, so exactly one finding
+        assert _rules_of(fs).count("wall-clock-in-traced-body") == 1
+
+    def test_positive_aliased_import(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            from time import perf_counter as clock
+            import jax
+
+            def resolve_plan(net):
+                jax.jit(lambda x: x)
+                return clock()
+        """)
+        assert "wall-clock-in-traced-body" in _rules_of(fs)
+
+    def test_negative_measure_around_the_dispatch(self, tmp_path):
+        """The sanctioned idiom: clock reads AROUND a jitted call, in
+        plain host code — never flagged."""
+        fs = _scan_snippet(tmp_path, """
+            import time
+
+            def _run_dispatch(self, fn):
+                t0 = time.perf_counter()
+                out = fn()
+                self._hist.observe(time.perf_counter() - t0)
+                return out
+
+            def step(self):
+                now = time.monotonic()
+                self._reap(now)
+        """)
+        assert "wall-clock-in-traced-body" not in _rules_of(fs)
+
+    def test_negative_nested_runtime_thunk_is_host_code(self, tmp_path):
+        """A nested def that is neither staged nor jit-building (a
+        retry thunk) runs at call time — the innermost scope decides."""
+        fs = _scan_snippet(tmp_path, """
+            import time
+            import jax
+
+            def _get_retry_step(self):
+                step = jax.jit(self._raw)
+
+                def once():
+                    t0 = time.monotonic()
+                    out = step(t0)
+                    return out, time.monotonic() - t0
+                return once
+        """)
+        assert "wall-clock-in-traced-body" not in _rules_of(fs)
+
+    def test_negative_module_scope_read(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import time
+            import jax
+
+            _T0 = time.time()   # import-time host constant, explicit
+
+            @jax.jit
+            def step(x):
+                return x
+        """)
+        assert "wall-clock-in-traced-body" not in _rules_of(fs)
+
+    def test_inline_suppression(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import time
+            import jax
+
+            @jax.jit
+            def step(x):
+                # build stamp, deliberately frozen
+                t0 = time.time()  # tpulint: disable=wall-clock-in-traced-body
+                return x + t0
+        """)
+        assert "wall-clock-in-traced-body" not in _rules_of(fs)
+
+    def test_repo_self_scan_clean(self):
+        """The instrumented serving/resilience/monitoring hot paths
+        read clocks only in host code — the shipped tree carries zero
+        findings (and zero baseline entries) for this rule."""
+        from deeplearning4j_tpu.analysis.rules.wall_clock import (
+            WallClockInTracedBodyRule)
+        fs = scan_paths([str(PKG)], [WallClockInTracedBodyRule()],
+                        root=str(REPO))
+        assert fs == []
+
+
+# ---------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------
 class TestSuppression:
@@ -2240,7 +2369,8 @@ class TestSelfScan:
             "non-atomic-state-write", "stale-world-snapshot",
             "lock-held-across-dispatch",
             "donation-use-after-consume", "jit-key-drift",
-            "replica-local-state-in-router"}
+            "replica-local-state-in-router",
+            "wall-clock-in-traced-body"}
         assert RULES_BY_ID["host-sync-in-hot-loop"].severity == "error"
         assert RULES_BY_ID["device-transfer-in-hot-loop"].severity == \
             "warning"

@@ -1,0 +1,493 @@
+"""Structured events, request tracing, and the fault flight recorder
+(ISSUE 15): ring-buffer bounds/drops + thread safety, the tracing
+enable switch, RequestTrace rollup cadence / breakdown math / payload
+roundtrip, ttft_attribution on a synthetic trace set, flight-recorder
+dumps on an injected decode fault (rate-limited, atomic, readable
+back), the /metrics + /events export surfaces, and the
+zero-retraces-with-tracing-ON guard (instrumentation must never add a
+jit input)."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitoring
+from deeplearning4j_tpu.monitoring import flightrecorder, runtime
+from deeplearning4j_tpu.monitoring.events import (
+    EVENTS_DEPTH, EVENTS_DROPPED, EventLog, emit, global_event_log,
+    set_events_enabled)
+from deeplearning4j_tpu.monitoring.exporters import (
+    metrics_snapshot, render_prometheus)
+from deeplearning4j_tpu.monitoring.metrics import MetricsRegistry
+from deeplearning4j_tpu.resilience import chaos
+from deeplearning4j_tpu.serving import (
+    EngineSupervisor, GenerationEngine, RequestTrace, ttft_attribution)
+from deeplearning4j_tpu.serving.request import (
+    TRACE_MAX_RECORDS, TRACE_ROLLUP_EVERY)
+from deeplearning4j_tpu.zoo import TextGenerationTransformer
+
+V = 12
+
+
+def _net(max_length=32):
+    return TextGenerationTransformer(vocab_size=V, embed_dim=16,
+                                     n_heads=2, n_layers=2,
+                                     max_length=max_length,
+                                     positional="rope").init()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_flight(tmp_path):
+    """Every test gets its own flight dir + reset rate limits, and
+    tracing restored ON afterwards (it is the process default)."""
+    flightrecorder.set_flight_dir(str(tmp_path / "flight"))
+    flightrecorder.reset_for_tests()
+    yield
+    set_events_enabled(True)
+    flightrecorder.set_flight_dir(None)
+    flightrecorder.reset_for_tests()
+
+
+# ---------------------------------------------------------------------
+# the ring buffer
+# ---------------------------------------------------------------------
+class TestEventLog:
+    def test_ring_bounds_and_dropped_counter(self):
+        reg = MetricsRegistry()
+        log = EventLog(capacity=8, registry=reg)
+        log.declare_series(reg)
+        for i in range(20):
+            log.emit("t", "e", i=i)
+        assert log.depth() == 8
+        assert log.dropped_total == 12
+        assert [e.attrs["i"] for e in log.tail()] == list(range(12, 20))
+        snap = reg.snapshot_compact()
+        assert snap[EVENTS_DROPPED] == 12.0
+        assert snap[EVENTS_DEPTH] == 8.0
+
+    def test_tail_filters_category_and_attrs(self):
+        log = EventLog(capacity=32)
+        log.emit("a", "x", k=1)
+        log.emit("b", "y", k=1)
+        log.emit("a", "z", k=2)
+        assert [e.name for e in log.tail(category="a")] == ["x", "z"]
+        assert [e.name for e in log.tail(match={"k": 1})] == ["x", "y"]
+        assert [e.name for e in log.tail(1, category="a")] == ["z"]
+        assert log.tail(0) == []           # not the [-0:] whole-ring slip
+
+    def test_events_are_monotonic_and_timestamped(self):
+        log = EventLog(capacity=4)
+        a = log.emit("t", "one")
+        b = log.emit("t", "two")
+        assert b.seq == a.seq + 1
+        assert b.mono >= a.mono and b.wall > 0
+
+    def test_thread_safety_no_loss_no_crash(self):
+        log = EventLog(capacity=64)
+
+        def hammer(tid):
+            for i in range(500):
+                log.emit("t", "e", tid=tid, i=i)
+
+        threads = [threading.Thread(target=hammer, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert log.depth() == 64
+        assert log.depth() + log.dropped_total == log.total_emitted \
+            == 8 * 500
+
+    def test_disable_switch_silences_emit_and_trace(self):
+        log = EventLog(capacity=8)
+        prev = set_events_enabled(False)
+        try:
+            assert prev is True       # tracing is ON by default
+            assert log.emit("t", "e") is None
+            assert log.depth() == 0
+            tr = RequestTrace()
+            tr.record("submit")
+            tr.rollup(100)
+            assert tr.events() == []
+        finally:
+            set_events_enabled(True)
+        assert log.emit("t", "e") is not None
+
+    def test_jsonl_sink(self, tmp_path):
+        log = EventLog(capacity=8)
+        path = str(tmp_path / "events.jsonl")
+        log.attach_jsonl(path)
+        log.emit("t", "one", k=1)
+        log.emit("t", "two")
+        log.attach_jsonl(None)
+        log.emit("t", "three")      # detached: not written
+        lines = [json.loads(l) for l in open(path)]
+        assert [l["name"] for l in lines] == ["one", "two"]
+        assert lines[0]["attrs"] == {"k": 1}
+
+    def test_global_log_exported_at_metrics(self):
+        monitoring.ensure_started()
+        emit("test", "export_probe")
+        text = render_prometheus()
+        assert EVENTS_DEPTH in text
+        assert EVENTS_DROPPED in text
+        snap = metrics_snapshot()
+        assert EVENTS_DEPTH in snap and EVENTS_DROPPED in snap
+
+
+# ---------------------------------------------------------------------
+# request traces
+# ---------------------------------------------------------------------
+def _mk_trace(submit=0.0, pop=2.0, pre0=2.0, pre1=2.5, first=2.6,
+              retire=5.0, hops=()):
+    """Synthetic trace with controlled wall timestamps."""
+    tr = RequestTrace()
+    tr.records.append({"event": "submit", "t": submit})
+    tr.records.append({"event": "queue_pop", "t": pop, "engine": "a"})
+    tr.records.append({"event": "prefill_start", "t": pre0,
+                       "engine": "a"})
+    tr.records.append({"event": "prefill_end", "t": pre1})
+    tr.records.append({"event": "first_token", "t": first,
+                       "engine": "a"})
+    for t, (src, dst) in hops:
+        tr.records.append({"event": "migrate", "t": t, "source": src,
+                           "target": dst, "cause": "death"})
+        tr.records.append({"event": "queue_pop", "t": t + 0.1,
+                           "engine": f"r{dst}"})
+        tr.records.append({"event": "prefill_start", "t": t + 0.1,
+                           "engine": f"r{dst}", "readmit": True})
+        tr.records.append({"event": "prefill_end", "t": t + 0.3})
+        tr.records.append({"event": "readmit", "t": t + 0.3,
+                           "engine": f"r{dst}"})
+    tr.records.append({"event": "retire", "t": retire,
+                       "reason": "stop"})
+    return tr
+
+
+class TestRequestTrace:
+    def test_breakdown_math(self):
+        b = _mk_trace().breakdown()
+        assert b["queue_wait_s"] == pytest.approx(2.0)
+        assert b["prefill_s"] == pytest.approx(0.5)
+        assert b["ttft_s"] == pytest.approx(2.6)
+        assert b["decode_s"] == pytest.approx(5.0 - 2.6)
+        assert b["migrations"] == 0 and b["rebuilds"] == 0
+
+    def test_breakdown_with_migration_hop(self):
+        tr = _mk_trace(hops=[(3.0, (0, 1))])
+        b = tr.breakdown()
+        assert b["migrations"] == 1
+        # the hop's re-prime prefill (0.2s) is recovery, not decode
+        assert b["prefill_s"] == pytest.approx(0.5 + 0.2)
+        assert b["decode_s"] == pytest.approx(5.0 - 2.6 - 0.2)
+        # the hop's requeue span counts as TOTAL queue wait, but not
+        # toward the TTFT window (the first token already streamed)
+        assert b["queue_wait_s"] == pytest.approx(2.0 + 0.1)
+        assert b["queue_wait_ttft_s"] == pytest.approx(2.0)
+        assert tr.replicas() == ["a", "r1"]
+
+    def test_attribution_excludes_post_first_token_queue_rides(self):
+        """A migrated active stream's target-queue wait is recovery
+        cost, not admission latency: TTFT attribution must not let it
+        swallow the whole TTFT (min(total_queue, ttft) did)."""
+        tr = _mk_trace(hops=[(3.0, (0, 1))])
+        a = ttft_attribution([tr])
+        assert a["queue_wait_mean_s"] == pytest.approx(2.0)
+        assert a["prefill_mean_s"] == pytest.approx(0.5)
+        assert a["migrations"] == 1
+
+    def test_rollup_cadence_not_per_token(self):
+        tr = RequestTrace()
+        for _ in range(3 * TRACE_ROLLUP_EVERY + 5):
+            tr.rollup(1)
+        decode = [r for r in tr.events() if r["event"] == "decode"]
+        assert len(decode) == 3
+        assert all(r["tokens"] == TRACE_ROLLUP_EVERY for r in decode)
+        tr.flush_rollup()
+        decode = [r for r in tr.events() if r["event"] == "decode"]
+        assert len(decode) == 4 and decode[-1]["tokens"] == 5
+
+    def test_speculative_rollup_carries_acceptance(self):
+        tr = RequestTrace()
+        tr.rollup(3, accepted=2, proposed=4)
+        tr.flush_rollup()
+        d = [r for r in tr.events() if r["event"] == "decode"][0]
+        assert d == {"event": d["event"], "t": d["t"], "tokens": 3,
+                     "accepted": 2, "proposed": 4}
+
+    def test_record_cap_drops_counted(self):
+        tr = RequestTrace()
+        for i in range(TRACE_MAX_RECORDS + 40):
+            tr.record("x", i=i)
+        assert len(tr.events()) == TRACE_MAX_RECORDS
+        assert tr.dropped == 40
+
+    def test_lifecycle_records_outrank_rollups_at_the_cap(self):
+        """A very long stream fills the cap with decode rollups; the
+        retirement cause (and a migration hop) must still land —
+        rollup history is what gets evicted, counted as dropped."""
+        tr = RequestTrace()
+        tr.record("submit")
+        for _ in range(TRACE_MAX_RECORDS):
+            tr.record("decode", tokens=32)
+        assert len(tr.events()) == TRACE_MAX_RECORDS
+        tr.record("migrate", source=0, target=1, cause="death")
+        tr.record("retire", reason="stop")
+        evs = [r["event"] for r in tr.events()]
+        assert evs[0] == "submit" and evs[-1] == "retire"
+        assert "migrate" in evs
+        assert len(tr.events()) == TRACE_MAX_RECORDS
+        assert tr.dropped == 1 + 2   # the overflow rollup + 2 evictions
+        # pure-lifecycle overflow (nothing evictable) still drops safely
+        tr2 = RequestTrace()
+        for i in range(TRACE_MAX_RECORDS + 3):
+            tr2.record("rebuild")
+        assert len(tr2.events()) == TRACE_MAX_RECORDS
+        assert tr2.dropped == 3
+
+    def test_payload_roundtrip(self):
+        tr = _mk_trace(hops=[(3.0, (0, 1))])
+        tr.dropped = 2
+        back = RequestTrace.from_payload(
+            json.loads(json.dumps(tr.to_payload())))
+        assert back.events() == tr.events()
+        assert back.dropped == 2
+        assert back.breakdown() == tr.breakdown()
+
+    def test_ttft_attribution_synthetic_set(self):
+        traces = [
+            _mk_trace(),                              # ttft 2.6
+            _mk_trace(pop=1.0, pre0=1.0, pre1=1.2,
+                      first=1.3),                     # ttft 1.3
+            RequestTrace(),                           # never admitted
+        ]
+        traces[2].records.append({"event": "submit", "t": 0.0})
+        traces[2].records.append({"event": "shed", "t": 4.0})
+        a = ttft_attribution(traces)
+        assert a["requests"] == 3 and a["with_ttft"] == 2
+        assert a["ttft_mean_s"] == pytest.approx((2.6 + 1.3) / 2)
+        assert a["queue_wait_mean_s"] == pytest.approx((2.0 + 1.0) / 2)
+        assert a["prefill_mean_s"] == pytest.approx((0.5 + 0.2) / 2)
+        # the components never exceed the observed TTFT
+        assert a["queue_wait_mean_s"] + a["prefill_mean_s"] \
+            + a["other_mean_s"] == pytest.approx(a["ttft_mean_s"])
+
+    def test_attribution_of_empty_window(self):
+        assert ttft_attribution([]) == {"requests": 0, "with_ttft": 0}
+
+
+# ---------------------------------------------------------------------
+# the engine's trace instrumentation (live)
+# ---------------------------------------------------------------------
+class TestEngineTracing:
+    def test_lifecycle_events_in_order(self):
+        eng = GenerationEngine(_net(), V, slots=2)
+        h = eng.submit([1, 2, 3], steps=4, top_k=1,
+                       rng=np.random.default_rng(0))
+        eng.run_until_idle()
+        h.result(timeout=0)
+        names = [r["event"] for r in h.trace().events()]
+        assert names[0] == "submit"
+        for ev in ("queue_pop", "prefill_start", "prefill_end",
+                   "first_token", "seat", "retire"):
+            assert ev in names
+        assert names.index("queue_pop") < names.index("prefill_start") \
+            < names.index("first_token")
+        pre = [r for r in h.trace().events()
+               if r["event"] == "prefill_start"][0]
+        assert pre["width"] == 3 and pre["bucket"] == 4
+        b = h.trace().breakdown()
+        assert b["ttft_s"] is not None and b["decode_s"] is not None
+
+    def test_supervisor_rebuild_lands_on_trace_and_timeline(self):
+        eng = GenerationEngine(
+            _net(), V, slots=2, supervisor=EngineSupervisor(),
+            decode_chaos=chaos.FaultBurstInjector(n=2, k=1))
+        h = eng.submit([1, 2, 3], steps=6, top_k=1,
+                       rng=np.random.default_rng(0))
+        eng.run_until_idle()
+        h.result(timeout=0)
+        names = [r["event"] for r in h.trace().events()]
+        assert "rebuild" in names and "readmit" in names
+        assert h.trace().breakdown()["rebuilds"] == 1
+        # the ops timeline saw the rebuild, and health() tails it
+        tl = global_event_log().tail(
+            category="serving", match={"engine": eng.label})
+        assert any(e.name == "rebuild" for e in tl)
+        assert any(e["name"] == "rebuild"
+                   for e in eng.health()["last_events"])
+
+    def test_label_sharing_replicas_keep_separate_event_tails(self):
+        """Two factory-built engines share the default model label;
+        with router-style replica tags their lifecycle events carry
+        DISTINCT identities and each health() tail shows only its own
+        history (the autoscaler reads these per tick — O(1), not a
+        ring scan)."""
+        a, b = GenerationEngine(_net(), V), GenerationEngine(_net(), V)
+        assert a.label == b.label
+        a.replica_tag, b.replica_tag = 0, 1
+        assert a.trace_identity != b.trace_identity
+        a.drain(timeout=0.1)
+        assert [e["name"] for e in a.health()["last_events"]] == ["drain"]
+        assert b.health()["last_events"] == []
+        tl = global_event_log().tail(category="serving",
+                                     match={"engine": a.trace_identity})
+        assert any(e.name == "drain" for e in tl)
+
+    def test_retire_reason_recorded_on_every_path(self):
+        eng = GenerationEngine(_net(), V, slots=2)
+        h = eng.submit([1, 2], steps=3, top_k=1,
+                       rng=np.random.default_rng(0), timeout=0.0)
+        eng.step()                      # reaped: deadline expired
+        with pytest.raises(Exception):
+            h.result(timeout=0)
+        retire = [r for r in h.trace().events()
+                  if r["event"] == "retire"]
+        assert retire and retire[0]["reason"] == "error"
+        assert "InferenceTimeout" in retire[0]["error"]
+
+
+# ---------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------
+class TestFlightRecorder:
+    def test_dump_on_injected_decode_fault(self):
+        """An unsupervised decode fault -> _break -> one artifact with
+        the header, the ops-timeline tail, and the in-flight traces."""
+        eng = GenerationEngine(
+            _net(), V, slots=2,
+            decode_chaos=chaos.FaultBurstInjector(n=1, k=1))
+        h = eng.submit([1, 2, 3], steps=6, top_k=1,
+                       rng=np.random.default_rng(0))
+        eng.run_until_idle()
+        with pytest.raises(chaos.InjectedFault):
+            h.result(timeout=1)
+        path = flightrecorder.last_record_path()
+        assert path is not None and os.path.exists(path)
+        rec = flightrecorder.read_record(path)
+        assert rec["header"]["trigger"] == "engine_break"
+        assert "InjectedFault" in rec["header"]["error"]
+        assert rec["header"]["health"]["healthy"] is False
+        assert rec["traces"], "in-flight request traces must be bundled"
+        evs = [r["event"] for r in rec["traces"][0]["records"]]
+        assert "submit" in evs and "first_token" in evs
+        # no torn sibling left behind
+        assert not [f for f in os.listdir(os.path.dirname(path))
+                    if not f.endswith(".jsonl")]
+
+    def test_supervisor_escalation_dumps_with_supervisor_context(self):
+        from deeplearning4j_tpu.resilience.retry import RestartBudget
+        eng = GenerationEngine(
+            _net(), V, slots=2,
+            supervisor=EngineSupervisor(budget=RestartBudget(0)),
+            decode_chaos=chaos.FaultBurstInjector(n=1, k=1))
+        h = eng.submit([1, 2, 3], steps=6, top_k=1,
+                       rng=np.random.default_rng(0))
+        eng.run_until_idle()
+        with pytest.raises(chaos.InjectedFault):
+            h.result(timeout=1)
+        # escalation dumps first, then _break dumps its own (distinct
+        # triggers, both budgeted) — find the escalation artifact
+        d = flightrecorder.flight_dir()
+        esc = [f for f in os.listdir(d)
+               if f.startswith("flight_supervisor_escalation")]
+        assert len(esc) == 1
+        rec = flightrecorder.read_record(os.path.join(d, esc[0]))
+        assert rec["header"]["trigger"] == "supervisor_escalation"
+        assert rec["header"]["extra"]["why"] == "budget_exhausted"
+        assert rec["header"]["extra"]["supervisor"]["escalations"] == 1
+
+    def test_rate_limit_and_process_cap(self):
+        p1 = flightrecorder.maybe_dump("t1", error=RuntimeError("x"))
+        assert p1 is not None
+        assert flightrecorder.maybe_dump("t1") is None   # rate-limited
+        assert flightrecorder.maybe_dump("t2") is not None  # distinct
+        flightrecorder.reset_for_tests()
+        for i in range(flightrecorder.MAX_DUMPS_PER_PROCESS + 5):
+            flightrecorder.maybe_dump(f"u{i}")
+        dumps = [f for f in os.listdir(flightrecorder.flight_dir())
+                 if f.startswith("flight_u")]
+        assert len(dumps) == flightrecorder.MAX_DUMPS_PER_PROCESS
+
+    def test_event_tail_and_trace_budget(self):
+        for i in range(flightrecorder.MAX_EVENTS + 100):
+            emit("test", "budget_filler", i=i)
+        traces = [RequestTrace() for _ in
+                  range(flightrecorder.MAX_TRACES + 4)]
+        path = flightrecorder.maybe_dump("budget", traces=traces)
+        rec = flightrecorder.read_record(path)
+        assert len(rec["events"]) <= flightrecorder.MAX_EVENTS
+        assert len(rec["traces"]) == flightrecorder.MAX_TRACES
+
+    def test_never_raises_even_with_unwritable_dir(self):
+        flightrecorder.set_flight_dir("/proc/definitely/not/writable")
+        assert flightrecorder.maybe_dump("t", error=ValueError()) is None
+
+    def test_failed_dumps_refund_the_process_budget(self, tmp_path):
+        """A transiently unwritable dir must not permanently kill the
+        recorder: failed dumps give their process-cap slot back (the
+        per-trigger rate stamp stays, bounding the retry rate)."""
+        flightrecorder.set_flight_dir("/proc/definitely/not/writable")
+        for i in range(flightrecorder.MAX_DUMPS_PER_PROCESS + 8):
+            assert flightrecorder.maybe_dump(f"fail{i}") is None
+        flightrecorder.set_flight_dir(str(tmp_path / "recovered"))
+        assert flightrecorder.maybe_dump("after_recovery") is not None
+
+
+# ---------------------------------------------------------------------
+# the overhead contract: tracing ON adds zero retraces
+# ---------------------------------------------------------------------
+def _compile_total():
+    c = monitoring.global_registry().get(runtime.COMPILE_COUNTER)
+    return 0.0 if c is None else c.total()
+
+
+class TestNoRetraceWithTracingOn:
+    def test_staggered_traffic_compiles_nothing_new(self):
+        monitoring.ensure_started()
+        assert monitoring.events_enabled()      # ON by default
+        eng = GenerationEngine(_net(), V, slots=2)
+        eng.warmup(max_prompt_len=8)
+        warm = _compile_total()
+        hs = []
+        for i, p in enumerate(([1, 2], [3, 4, 5, 6], [7], [8, 9, 10])):
+            hs.append(eng.submit(p, steps=5, top_k=1,
+                                 rng=np.random.default_rng(i)))
+            eng.step()
+        eng.run_until_idle()
+        for h in hs:
+            h.result(timeout=0)
+            assert h.trace().breakdown()["ttft_s"] is not None
+        assert _compile_total() == warm, (
+            "request tracing must not introduce jit inputs or retraces")
+
+
+# ---------------------------------------------------------------------
+# the /events endpoint (beside /metrics)
+# ---------------------------------------------------------------------
+class TestEventsEndpoint:
+    def test_events_json_beside_metrics(self):
+        import urllib.request
+        from deeplearning4j_tpu.ui import UIServer
+        server = UIServer(port=0)
+        emit("test", "endpoint_probe", k=1)
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            with urllib.request.urlopen(base + "/events?n=50") as r:
+                out = json.loads(r.read())
+            assert out["enabled"] is True
+            assert out["depth"] >= 1
+            assert any(e["name"] == "endpoint_probe"
+                       for e in out["events"])
+            with urllib.request.urlopen(
+                    base + "/events?category=nope") as r:
+                assert json.loads(r.read())["events"] == []
+            with urllib.request.urlopen(base + "/metrics") as r:
+                assert EVENTS_DEPTH in r.read().decode()
+        finally:
+            server.stop()

@@ -179,6 +179,53 @@ class TestRequestLedger:
         with pytest.raises(ValueError, match="newer"):
             RequestLedgerEntry.from_payload(p)
 
+    def test_v1_traceless_payload_admits_cleanly(self):
+        """Backward compatibility across the LEDGER_VERSION 2 bump: a
+        v1 payload (no trace key) must admit and continue exactly as
+        before — the trace layer starts fresh with an import marker
+        instead of refusing the request."""
+        want = _single_engine_outputs(steps=6, sampled=True)
+        a = GenerationEngine(_net(), V, slots=4)
+        hs = _submit_all(a, steps=6, sampled=True)
+        for _ in range(2):
+            a.step()
+        payloads = [e.payload() for e in a.detach_ledger()]
+        for p in payloads:                 # shape of a pre-ISSUE-15 peer
+            del p["trace"]
+            p["version"] = 1
+        entries = [RequestLedgerEntry.from_payload(p) for p in payloads]
+        b = GenerationEngine(_net(), V, slots=4)
+        assert b.admit_from_ledger(entries) == 4
+        b.run_until_idle()
+        got = sorted(e.request.handle.result(timeout=0)
+                     for e in entries)
+        assert got == sorted(want)
+        assert not any(h.done for h in hs)
+        for e in entries:
+            evs = [r["event"] for r in
+                   e.request.handle.trace().events()]
+            assert "imported" in evs       # fresh trace, marked
+
+    def test_payload_carries_the_trace_across_the_wire(self):
+        """v2 payloads ship the request trace: a cross-process
+        continuation keeps the source-side history (submit, first
+        token) instead of starting blind."""
+        a = GenerationEngine(_net(), V, slots=4)
+        hs = _submit_all(a, steps=6)
+        a.step()
+        import json
+        payloads = json.loads(json.dumps(
+            [e.payload() for e in a.detach_ledger()]))
+        assert all(p["version"] == LEDGER_VERSION for p in payloads)
+        entries = [RequestLedgerEntry.from_payload(p) for p in payloads]
+        streamed = [e for e in entries if e.streamed]
+        assert streamed
+        for e in streamed:
+            evs = [r["event"] for r in
+                   e.request.handle.trace().events()]
+            assert evs[0] == "submit" and "first_token" in evs
+        assert hs  # originals keep their own (local) traces untouched
+
     def test_payload_json_safe_for_any_generator(self):
         """submit() accepts ANY numpy Generator; the wire form must
         survive json for non-default bit generators too (MT19937's
@@ -277,6 +324,33 @@ class TestKillReplica:
         assert fleet.migrated_requests >= 1
         assert len(fleet.replicas()) == 1
         assert victim.rid not in fleet.health()["replicas"]
+        # the migrated requests' traces record the hop: source ->
+        # surviving replica, both engines in the replica list, and the
+        # breakdown counts exactly one migration (tracing is ON by
+        # default — nothing here enabled it)
+        survivor = fleet.replicas()[0]
+        migrated = [h for h in hs
+                    if h.trace().breakdown()["migrations"] >= 1]
+        assert len(migrated) == fleet.migrated_requests
+        for h in migrated:
+            hop = [r for r in h.trace().events()
+                   if r["event"] == "migrate"][0]
+            assert hop["source"] == victim.rid
+            assert hop["target"] == survivor.rid
+            assert hop["cause"] == "death"
+            # both replicas by DISTINCT identity: factory-built
+            # engines share the model label, so the router's rid
+            # stamp (trace_identity = "label#rN") is what makes the
+            # hop visible in the replica list
+            assert victim.engine.trace_identity != \
+                survivor.engine.trace_identity
+            assert set(h.trace().replicas()) >= {
+                victim.engine.trace_identity,
+                survivor.engine.trace_identity}
+        # the ops timeline shows the death + migration sequence
+        tl = [(e.category, e.name) for e in fleet.timeline()]
+        assert ("fleet", "replica_dead") in tl
+        assert ("fleet", "migration") in tl
         fleet.shutdown()
 
     def test_death_with_queued_requests_migrates_them_too(self):
