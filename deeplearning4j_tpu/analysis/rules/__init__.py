@@ -3,7 +3,9 @@
 Rule families: host-sync + device-transfer (ISSUE 3; interprocedurally
 promoted in ISSUE 13), tracer-leak, recompile-hazard, dtype-promotion,
 concurrency, hygiene, retry (ISSUE 4), state-write (ISSUE 7),
-world-snapshot (ISSUE 8), lock-dispatch (ISSUE 9), the ISSUE 13
+world-snapshot (ISSUE 8), lock-dispatch (ISSUE 9),
+int8-promotion-in-dispatch (ISSUE 18 — quantized-pool reads must
+explicitly widen before arithmetic), the ISSUE 13
 exactness-contract families: donation-use-after-consume and
 jit-key-drift, replica-state (ISSUE 14 — the fleet layer reads
 engines only through public accessors), and wall-clock (ISSUE 15 —
@@ -23,7 +25,8 @@ from deeplearning4j_tpu.analysis.rules.device_transfer import (
     DeviceTransferRule)
 from deeplearning4j_tpu.analysis.rules.tracer_leak import TracerLeakRule
 from deeplearning4j_tpu.analysis.rules.recompile import RecompileHazardRule
-from deeplearning4j_tpu.analysis.rules.dtype import DtypePromotionRule
+from deeplearning4j_tpu.analysis.rules.dtype import (
+    DtypePromotionRule, Int8PromotionRule)
 from deeplearning4j_tpu.analysis.rules.concurrency import ThreadSharedStateRule
 from deeplearning4j_tpu.analysis.rules.hygiene import (
     BareExceptRule, MutableDefaultRule)
@@ -50,6 +53,7 @@ ALL_RULES: List[Rule] = [
     TracerLeakRule(),
     RecompileHazardRule(),
     DtypePromotionRule(),
+    Int8PromotionRule(),
     ThreadSharedStateRule(),
     LockHeldAcrossDispatchRule(),
     BareExceptRule(),

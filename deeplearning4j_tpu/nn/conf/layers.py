@@ -38,9 +38,14 @@ import numpy as np
 #: LSTM h/c, attention KV cache, positional-embedding offset, and the
 #: direct-paged-decode view (pool pair + page table) the serving engine
 #: installs around its decode dispatches (serving/paged_kernel.py)
+#: (kv_page_scale_k/v: the int8 pool's [P, Hkv] amax-scale sidecars —
+#: serving/quant.py; kv_page_prime: the engine's prime-through-the-
+#: pool marker — its presence routes a prefill chunk through the
+#: paged path on the folded-gather read, see _stream_attend_paged)
 STREAM_STATE_KEYS = frozenset(
     {"h", "c", "kv_k", "kv_v", "kv_pos", "kv_abs", "kv_mask",
-     "pos_offset", "kv_page_k", "kv_page_v", "kv_page_table"})
+     "pos_offset", "kv_page_k", "kv_page_v", "kv_page_table",
+     "kv_page_scale_k", "kv_page_scale_v", "kv_page_prime"})
 
 #: streaming-state keys whose LEADING axis is the batch dimension (beam
 #: search gathers these when pruning beams; kv_pos/kv_abs/pos_offset are
@@ -1324,8 +1329,31 @@ class SelfAttentionLayer(FeedForwardLayerConf):
         transient speculative overflow (rewound before it is ever
         visible) and idle-slot coasting both land where nothing reads.
         Prefix-shared read-only blocks are safe by block alignment: a
-        row appends only at positions ≥ its own fresh blocks."""
-        if mask is not None or pad_left is not None:
+        row appends only at positions ≥ its own fresh blocks.
+
+        Two state-structure extensions ride the same dispatch (both
+        Python-level — pytree structure keys the jit cache, so each
+        combination is its own trace and the plain bf16 decode graph
+        is untouched):
+
+        - ``kv_page_prime`` present: this chunk is the engine's
+          PRIME-THROUGH-THE-POOL prefill (batch 1, the int8 path —
+          quantize-once means the prompt's pool bytes must be written
+          by the same quantized append the decode steps use, never
+          densely primed and converted). ``pad_left`` is then allowed
+          with the dense path's packed accounting, pads and
+          prefix-shared positions route to the null page
+          (``q_pos < pos``), and the read is FORCED onto the folded
+          XLA gather regardless of the live impl — the kernel's
+          uniform-width causality has no notion of packed pads, and a
+          rebuild's re-prime must retrace the identical read math.
+        - ``kv_page_scale_k``/``_v`` present: the pool is int8 with
+          per-(page, head) amax-scale sidecars (serving/quant.py) —
+          appends quantize under the page base's scale, reads
+          dequantize in the gather (XLA) or in VMEM (the kernel, with
+          scales riding the scalar prefetch)."""
+        prime = state.get("kv_page_prime") is not None
+        if mask is not None or (pad_left is not None and not prime):
             raise ValueError(
                 "direct paged decode is packed/maskless (the engine's "
                 "decode dispatch shape) — masked or left-padded chunks "
@@ -1335,6 +1363,8 @@ class SelfAttentionLayer(FeedForwardLayerConf):
                              "pageable (no stable token->page map)")
         kp, vp = state["kv_page_k"], state["kv_page_v"]
         table = state["kv_page_table"]
+        ksc = state.get("kv_page_scale_k")
+        quant = ksc is not None
         pos = state.get("kv_pos")
         if pos is None or getattr(pos, "ndim", 0) < 1:
             raise ValueError(
@@ -1345,38 +1375,87 @@ class SelfAttentionLayer(FeedForwardLayerConf):
         L = self.cache_length
         ps = kp.shape[2]
         n_blk = table.shape[1]
-        q_pos = pos[:, None] + jnp.arange(t, dtype=pos.dtype)   # [N, T]
+        if prime and pad_left is not None:
+            # packed pad accounting, the dense prime's (_stream_attend):
+            # pads take q_pos = pos - 1 and never advance the stream
+            m0 = jnp.arange(t) >= pad_left                  # [T] valid
+            cum = jnp.cumsum(m0.astype(pos.dtype))
+            q_pos = pos[:, None] + (cum - 1)[None, :]       # [N, T]
+            n_new = cum[-1]
+            chunk0 = pad_left
+        else:
+            q_pos = pos[:, None] + jnp.arange(t, dtype=pos.dtype)
+            n_new = t
+            chunk0 = 0
         if self.rope:
-            q = self._rope(q, q_pos)
-            k = self._rope(k, q_pos)
+            abs_pos = jnp.maximum(q_pos, 0) if prime else q_pos
+            q = self._rope(q, abs_pos)
+            k = self._rope(k, abs_pos)
         # -- O(one-token) append at (page, offset) ---------------------
         blk = jnp.clip(q_pos // ps, 0, n_blk - 1).astype(jnp.int32)
         page = jnp.take_along_axis(table, blk, axis=1)
         page = jnp.where(q_pos < L, page, 0)    # past capacity: null
+        if prime:
+            # pads (q_pos = pos - 1) and prefix-shared positions
+            # (q_pos < pos = the hit length) must not write real pages:
+            # route them to the null page like past-capacity appends
+            page = jnp.where(q_pos >= pos[:, None], page, 0)
         off = (q_pos % ps).astype(jnp.int32)
-        kp = kp.at[page, :, off, :].set(
-            k.transpose(0, 2, 1, 3).astype(kp.dtype))
-        vp = vp.at[page, :, off, :].set(
-            v.transpose(0, 2, 1, 3).astype(vp.dtype))
+        kt = k.transpose(0, 2, 1, 3)                    # [N, T, Hkv, D]
+        vt = v.transpose(0, 2, 1, 3)
+        if quant:
+            from deeplearning4j_tpu.serving.quant import quantize_chunk
+            vsc = state["kv_page_scale_v"]
+            writable = q_pos < L
+            if prime:
+                writable = writable & (q_pos >= pos[:, None])
+            kq, ksc = quantize_chunk(kt, ksc, page, q_pos, pos,
+                                     writable, page_size=ps,
+                                     chunk0=chunk0)
+            vq, vsc = quantize_chunk(vt, vsc, page, q_pos, pos,
+                                     writable, page_size=ps,
+                                     chunk0=chunk0)
+            kp = kp.at[page, :, off, :].set(kq)
+            vp = vp.at[page, :, off, :].set(vq)
+        else:
+            kp = kp.at[page, :, off, :].set(kt.astype(kp.dtype))
+            vp = vp.at[page, :, off, :].set(vt.astype(vp.dtype))
         impl, interpret = _PAGED_DECODE_IMPL
-        if impl == "pallas":
+        if impl == "pallas" and not prime:
             from deeplearning4j_tpu.serving.paged_kernel import (
                 paged_attention)
             reps = self.n_heads // hkv
             qg = q.reshape(n, hkv, reps * t, d)
             o = paged_attention(qg, kp, vp, table,
                                 (pos + t).astype(jnp.int32),
-                                query_width=t, interpret=interpret)
+                                query_width=t, interpret=interpret,
+                                k_scales=ksc if quant else None,
+                                v_scales=vsc if quant else None)
             o = o.reshape(n, self.n_heads, t, d)
         else:
-            kd = jnp.moveaxis(kp[table], 2, 1
+            kg = kp[table]                    # [N, n_blk, Hkv, ps, D]
+            vg = vp[table]
+            if quant:
+                # dequant folded into the gather: q * sigma is exact
+                # (power-of-two sigma, serving/quant.py), so a page
+                # reads back the same values on every dispatch
+                kg = kg.astype(jnp.float32) * \
+                    ksc[table][:, :, :, None, None]
+                vg = vg.astype(jnp.float32) * \
+                    vsc[table][:, :, :, None, None]
+                kg = kg.astype(q.dtype)
+                vg = vg.astype(q.dtype)
+            kd = jnp.moveaxis(kg, 2, 1
                               ).reshape(n, hkv, n_blk * ps, d)[:, :, :L]
-            vd = jnp.moveaxis(vp[table], 2, 1
+            vd = jnp.moveaxis(vg, 2, 1
                               ).reshape(n, hkv, n_blk * ps, d)[:, :, :L]
             valid = jnp.arange(L)[None, None, :] <= q_pos[..., None]
             o = self._grouped_attend(q, kd, vd, valid)
         out = {**state, "kv_page_k": kp, "kv_page_v": vp,
-               "kv_pos": pos + t}
+               "kv_pos": pos + n_new}
+        if quant:
+            out["kv_page_scale_k"] = ksc
+            out["kv_page_scale_v"] = vsc
         return o, out
 
     def _stream_mask_update(self, state, mask, n, t, L, *, fresh, write):

@@ -302,7 +302,15 @@ class PageExhaustionInjector(ChaosIterator):
     out / fail fast, per their deadline and queue policy) until
     ``release()`` returns the seized pages. The graceful-degradation
     proof every capacity incident wants: starvation must shed load,
-    never corrupt in-flight streams."""
+    never corrupt in-flight streams.
+
+    Quantized pools (``kv_dtype="int8"``) need no special handling:
+    seizure is host-side page-id accounting, so the int8 pool bytes and
+    the per-page scale sidecar rows never move — a seized page's scales
+    simply sit unreferenced until the id is reallocated and the next
+    prime/append rewrites both. The bit-identical-actives guarantee
+    therefore holds unchanged under quantization (pinned in
+    tests/test_serving_quant.py)."""
 
     def __init__(self, pool, n: int, free_target: int = 0,
                  once: bool = True):

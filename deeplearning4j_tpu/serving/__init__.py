@@ -16,7 +16,12 @@ Serving engine v2 layers on top: a block-paged KV arena
 tables over one refcounted pool), a full-block prompt ``PrefixCache``
 (shared system prompts prime once), and in-engine speculative decoding
 (``SpeculationConfig`` — a host draft + one widened verify dispatch per
-step).
+step). ``PagedKVConfig(kv_dtype="int8")`` makes the pool's
+authoritative KV storage quantized (``serving/quant.py`` — per-page
+power-of-two amax scales, dequantize-on-read in both direct decode
+impls, a pinned accuracy envelope vs bf16, ~2x pages under a
+``total_bytes=`` budget; ``"auto"`` opts in only through a calibrated
+crossover entry).
 
 The survivability layer keeps all of it up under faults and load:
 ``EngineSupervisor`` (request-preserving arena rebuilds from the

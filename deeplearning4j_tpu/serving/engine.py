@@ -277,6 +277,15 @@ class GenerationEngine:
         self._direct = False
         self._decode_impl: Optional[str] = None
         self._decode_key: Optional[str] = None
+        #: the pool's authoritative storage precision ("bf16" = the
+        #: net's native leaf dtype, "int8" = serving/quant.py) and the
+        #: int8 plumbing: per-leaf [P, Hkv] scale sidecars + the
+        #: (name, Hkv, head_dim) layer map the eager store builds from
+        self._kv_dtype = "bf16"
+        self._quant_key: Optional[str] = None
+        self._scale_store = None
+        self._quant_dims = None
+        self._scale_row_bytes = 0          # per-dispatch scale read unit
         #: cached [S, n_max] page table — np + device copies, rebuilt
         #: only after a table MUTATION (admit/retire/rebuild), not per
         #: step (the host used to rebuild and re-upload it every step
@@ -321,13 +330,54 @@ class GenerationEngine:
             self._L = lens.pop()
             self._ps = paging.page_size
             self._n_max = -(-self._L // self._ps)
-            usable = paging.resolve_pages(slots, self._n_max)
+            # -- kv_dtype resolution (before pool sizing: a byte
+            # budget and the impl eligibility both depend on it) -----
+            l0 = kv_layers[0]
+            native_dtype = getattr(net.conf, "dtype", None) or "float32"
+            recurrent = any(getattr(l, "carries_recurrent_state", False)
+                            for l in layers)
+            kv_dtype = getattr(paging, "kv_dtype", "bf16")
+            if kv_dtype != "bf16":
+                from deeplearning4j_tpu.tuning.plan import (
+                    quant_key_for_engine, resolve_kv_dtype)
+                #: the paged_decode_quant crossover fingerprint — what
+                #: kv_dtype="auto" consults and a calibrating bench
+                #: records (tuning/crossover.py)
+                self._quant_key = quant_key_for_engine(
+                    self._ps, l0.n_out // l0.n_heads,
+                    getattr(l0, "n_kv_heads", None) or l0.n_heads,
+                    self._L, native_dtype)
+            if kv_dtype == "auto":
+                # eligibility is the static gate (direct paged decode,
+                # no recurrent h/c); the CHOICE needs a calibrated,
+                # platform-matching paged_decode_quant entry that says
+                # int8 won — uncalibrated runs stay bf16 (quantization
+                # is an accuracy trade, opted into by measurement)
+                kv_dtype = resolve_kv_dtype(
+                    bool(paging.direct) and not recurrent,
+                    self._quant_key)
+            if kv_dtype == "int8" and recurrent:
+                raise ValueError(
+                    "kv_dtype='int8' quantizes position-indexed KV "
+                    "pages only; recurrent h/c state is a function of "
+                    "the whole prefix and cannot re-prime through the "
+                    "paged path (use kv_dtype='bf16', or a pure-"
+                    "attention model)")
+            self._kv_dtype = kv_dtype
+            if paging.total_bytes is not None:
+                from deeplearning4j_tpu.serving.quant import (
+                    kv_page_bytes)
+                dims = self._paged_layer_dims()
+                usable = paging.resolve_pages_bytes(kv_page_bytes(
+                    [(h, d) for _, h, d in dims], self._ps, kv_dtype,
+                    native_dtype))
+            else:
+                usable = paging.resolve_pages(slots, self._n_max)
             self._pool = PagePool(usable + 1, self._ps)  # +1: null page
             self._direct = bool(paging.direct)
             if self._direct:
                 from deeplearning4j_tpu.tuning.plan import (
                     decode_key_for_engine, resolve_decode_impl)
-                l0 = kv_layers[0]
                 #: the crossover fingerprint of this engine's decode
                 #: shape — what "auto" consults and what a calibrating
                 #: bench records (tuning/crossover.py)
@@ -347,7 +397,8 @@ class GenerationEngine:
                     # PERF.md: "record the crossover so auto can learn
                     # it". No entry → the kernel (the PR 10 default).
                     ok = all(paged_attention_supported(
-                        (0, 0, self._ps, l.n_out // l.n_heads), 1)
+                        (0, 0, self._ps, l.n_out // l.n_heads), 1,
+                        kv_dtype=self._kv_dtype)
                         for l in kv_layers)
                     eligible = jax.default_backend() == "tpu" and ok
                     impl = resolve_decode_impl(eligible,
@@ -367,6 +418,14 @@ class GenerationEngine:
                         "pages — construct with "
                         "PagedKVConfig(prefix_cache=False)")
                 self._prefix = PrefixCache(self._pool)
+            if self._kv_dtype == "int8":
+                # EAGER store build (bf16 builds lazily from the first
+                # primed state): int8 prefill itself writes through
+                # the paged path — quantize-once means the pools must
+                # exist BEFORE the first prime, so they are sized from
+                # the layer configs instead of a primed pytree
+                self._quant_dims = self._paged_layer_dims()
+                self._init_quant_store()
         # -- in-engine speculation (SpeculationConfig) -----------------
         self._speculation = speculation
         if speculation is not None:
@@ -569,6 +628,7 @@ class GenerationEngine:
                 # actually run, not the construction-time resolution
                 "decode_path": (f"direct-{self._live_impl()}"
                                 if self._direct else "roundtrip"),
+                "kv_dtype": self._kv_dtype,
                 "bytes_moved_total": self._kv_bytes_total,
                 "dispatches": self._dispatches,
             }
@@ -1087,7 +1147,16 @@ class GenerationEngine:
                 bucket=(_width_bucket(max(1, fed))
                         if self._prime_padded else None),
                 prefix_hit=hit_len, readmit=readmit)
-            if hit_len:
+            if self._kv_dtype == "int8":
+                # the int8 prime runs THROUGH the paged path (quantize-
+                # once: the prompt's pool bytes must come from the same
+                # quantized append the decode steps run) — a prefix hit
+                # just starts kv_pos past the shared pages, no dense
+                # gather/scatter round trip
+                self._install_prime_paged_state(table, hit_len)
+                p0 = prime_prompt(net, prime_ids[hit_len:], self.V,
+                                  padded=self._prime_padded)
+            elif hit_len:
                 self._install_prefix(table, hit_len)
                 p0 = prime_prompt(net, prime_ids[hit_len:], self.V,
                                   padded=self._prime_padded)
@@ -1107,6 +1176,14 @@ class GenerationEngine:
             self._recent_traces.append(req.trace)
             return
         primed_state = dict(net.state)
+        if self._kv_dtype == "int8":
+            # pools/scales come back out of the prime's state AFTER the
+            # snapshot: every early-exit below (failure already returned;
+            # one-token finish; dead-request skip) leaves the store
+            # exactly as the prime left it — the prime wrote the
+            # request's pages in place, and a one-token finish releases
+            # those pages right here via _release_pages
+            primed_state = self._extract_prime_paged_state(primed_state)
         if readmit:
             tok = req.handle._ids[-1]    # pending, drawn pre-fault
             req.trace.record("readmit", engine=self.trace_identity)
@@ -1137,13 +1214,20 @@ class GenerationEngine:
                 self._recent_traces.append(req.trace)
                 return
         if not self._arena_ready:
-            if self._pool is not None:
+            if self._pool is not None and self._page_store is None:
                 self._init_page_store(primed_state)
             saved_state = self._build_arena(primed_state, saved_state)
             self._arena_ready = True
         net.state = self._merge(saved_state, primed_state, slot)
         if self._pool is not None:
-            self._scatter_primed_pages(primed_state, table)
+            if self._kv_dtype == "int8":
+                # the prime already wrote the pool in place (quantize-
+                # once) — no dense→paged scatter; charge its pool
+                # traffic: the folded-gather prime read the whole
+                # context per chunk and appended `fed` tokens
+                self._kv_traffic((self._L + fed) * self._tok_bytes)
+            else:
+                self._scatter_primed_pages(primed_state, table)
             self._page_tables[slot] = table
             self._invalidate_tables()
             if self._prefix is not None \
@@ -1196,10 +1280,17 @@ class GenerationEngine:
             self._prefix = (PrefixCache(self._pool)
                             if self._prefix is not None else None)
             self._page_store = None
+            self._scale_store = None
             self._paged_keys = None
             self._page_tables = [[] for _ in range(self.slots)]
             self._invalidate_tables()
             self._kv_pos_dirty = False   # the rebuilt state is fresh
+            if self._kv_dtype == "int8":
+                # fresh zeroed pools + scales BEFORE the re-primes:
+                # int8 prefill writes through the paged path, so the
+                # store must exist (bf16 rebuilds it lazily from the
+                # first re-primed state)
+                self._init_quant_store()
         self.net.rnn_clear_previous_state()
         self._sync_accounting()
         if self._overload is not None:
@@ -1443,6 +1534,105 @@ class GenerationEngine:
             int(p.shape[1]) * int(p.shape[3]) * p.dtype.itemsize
             for p in store)
 
+    def _paged_layer_dims(self):
+        """(state name, Hkv, head_dim) per paged attention layer,
+        sorted by state name — the SAME (name, leaf) order
+        _init_page_store derives from a primed state (sorted() over
+        the state keys), so the eager int8 store and the lazy bf16
+        store address identical leaves."""
+        named = [(str(i), l) for i, l in
+                 enumerate(getattr(self.net, "layers", None) or [])]
+        vertices = getattr(getattr(self.net, "conf", None),
+                           "vertices", None) or {}
+        named += [(n, v.layer) for n, v in vertices.items()
+                  if getattr(v, "layer", None) is not None]
+        out = []
+        for n, l in named:
+            if getattr(l, "supports_streaming", False) \
+                    and getattr(l, "cache_length", 0):
+                hkv = getattr(l, "n_kv_heads", None) or l.n_heads
+                out.append((n, int(hkv), int(l.n_out // l.n_heads)))
+        return sorted(out)
+
+    def _init_quant_store(self) -> None:
+        """Eager int8 pool + scale-sidecar build (serving/quant.py):
+        zeroed [P, Hkv, ps, D] int8 pools and [P, Hkv] f32 scales, two
+        leaves (k, v) per attention layer. Runs at construction and
+        again after a quarantine rebuild dropped the old store."""
+        from deeplearning4j_tpu.serving.quant import pool_leaves
+        self._paged_keys = [(n, k) for n, _, _ in self._quant_dims
+                            for k in ("kv_k", "kv_v")]
+        self._page_store, self._scale_store = pool_leaves(
+            self._pool.total_pages, self._ps,
+            [(h, d) for _, h, d in self._quant_dims])
+        self._tok_bytes = sum(2 * h * d                  # int8: 1 B/el
+                              for _, h, d in self._quant_dims)
+        self._scale_row_bytes = sum(2 * h * 4
+                                    for _, h, _ in self._quant_dims)
+
+    def _install_prime_paged_state(self, table, hit_len: int) -> None:
+        """Arm the detached batch-1 prefill to run THROUGH the paged
+        path (the int8 prime: quantize-once forbids priming densely
+        and converting — the prompt's pool bytes must come from the
+        same quantized append the decode steps run, so a rebuild's
+        re-prime reproduces them bit-identically). Installs the whole
+        pools + scale sidecars, the request's one-row table, kv_pos at
+        the prefix hit length, and the ``kv_page_prime`` marker that
+        forces the folded-gather read and unlocks packed (pad_left)
+        accounting in ``_stream_attend_paged``. On a prefix hit the
+        suffix prime attends the shared pages in place — no dense
+        gather, no page re-scatter (``_install_prefix``'s round trip
+        has no int8 equivalent)."""
+        net = self.net
+        row = np.zeros((1, self._n_max), np.int32)
+        row[0, :len(table)] = table
+        # admission-time (per-prime) uploads, not the decode loop
+        # tpulint: disable=device-transfer-in-hot-loop
+        row_dev = jnp.asarray(row)
+        pos = jnp.full((1,), hit_len, jnp.int32)
+        marker = jnp.zeros((), jnp.int32)
+        st = dict(net.state)
+        for i, (n, k) in enumerate(self._paged_keys):
+            cur = st.get(n)
+            d = dict(cur) if isinstance(cur, dict) else {}
+            d["kv_page_k" if k == "kv_k" else "kv_page_v"] = \
+                self._page_store[i]
+            d["kv_page_scale_k" if k == "kv_k"
+              else "kv_page_scale_v"] = self._scale_store[i]
+            d["kv_page_table"] = row_dev
+            d["kv_page_prime"] = marker
+            d["kv_pos"] = pos
+            st[n] = d
+        net.state = st
+        net._stream_pos = hit_len
+        net._stream_pos_rows = None
+        if self._graph_vertices:
+            net._stream_pos_map = {n: hit_len
+                                   for n in self._graph_vertices}
+
+    def _extract_prime_paged_state(self, primed_state):
+        """Take the primed pools/scales back out of the prime's state
+        snapshot (they are the authoritative store now — prime
+        dispatches do not donate, so on failure the engine's pre-prime
+        references were still valid and nothing was committed).
+        Returns the cleaned state the arena build/merge sees: paged
+        view keys stripped, the [1] kv_pos vector kept for the slot
+        scatter."""
+        out = {n: (dict(v) if isinstance(v, dict) else v)
+               for n, v in primed_state.items()}
+        store, scales = [], []
+        for n, k in self._paged_keys:
+            d = out[n]
+            store.append(d.pop("kv_page_k" if k == "kv_k"
+                               else "kv_page_v"))
+            scales.append(d.pop("kv_page_scale_k" if k == "kv_k"
+                                else "kv_page_scale_v"))
+            d.pop("kv_page_table", None)
+            d.pop("kv_page_prime", None)
+        self._page_store = store
+        self._scale_store = scales
+        return out
+
     def _scatter_primed_pages(self, primed_state, table) -> None:
         """Commit the primed batch-1 KV into the slot's pages (one
         jitted scatter; shared prefix pages are rewritten with the
@@ -1583,9 +1773,13 @@ class GenerationEngine:
         happens only on the first dispatch after a mutation."""
         tables = self._tables_dev_per_layer()
         st = dict(self.net.state)
-        for (n, k), pool in zip(self._paged_keys, self._page_store):
+        for i, ((n, k), pool) in enumerate(zip(self._paged_keys,
+                                               self._page_store)):
             d = dict(st[n])
             d["kv_page_k" if k == "kv_k" else "kv_page_v"] = pool
+            if self._scale_store is not None:
+                d["kv_page_scale_k" if k == "kv_k"
+                  else "kv_page_scale_v"] = self._scale_store[i]
             d["kv_page_table"] = tables[n]
             st[n] = d
         if self._kv_pos_dirty:
@@ -1616,12 +1810,21 @@ class GenerationEngine:
         st = dict(self.net.state)
         store = [st[n]["kv_page_k" if k == "kv_k" else "kv_page_v"]
                  for n, k in self._paged_keys]
+        if self._scale_store is not None:
+            # under donation the returned scale leaves are likewise the
+            # only live copies (base-token appends rewrite scale rows)
+            self._scale_store = [
+                st[n]["kv_page_scale_k" if k == "kv_k"
+                      else "kv_page_scale_v"]
+                for n, k in self._paged_keys]
         tables = {}
         for n in dict.fromkeys(n for n, _ in self._paged_keys):
             d = dict(st[n])
             tables[n] = d.pop("kv_page_table")
             d.pop("kv_page_k", None)
             d.pop("kv_page_v", None)
+            d.pop("kv_page_scale_k", None)
+            d.pop("kv_page_scale_v", None)
             st[n] = d
         self._page_store = store
         if self._state_donated and self._donate:
@@ -1649,6 +1852,12 @@ class GenerationEngine:
         - direct-pallas: only LIVE pages are read (the table-indexed
           block specs skip dead blocks to the null page) — sum of each
           active row's page-rounded context — plus the append.
+
+        int8 adds the scale-sidecar reads (one f32 row per page per
+        leaf): the xla gather folds the whole ``scales[table]`` view
+        (S·n_max rows), the kernel prefetches one row per live page.
+        Tiny next to the halved pool bytes — but the model is exact,
+        so the test pins both terms.
         """
         if self._tok_bytes == 0:
             return 0
@@ -1660,8 +1869,10 @@ class GenerationEngine:
             live = sum(
                 min(-(-int(self._row_pos[s] + width) // ps) * ps, L)
                 for s, r in enumerate(self._slots) if r is not None)
-            return live * self._tok_bytes + append
-        return S * L * self._tok_bytes + append
+            return (live * self._tok_bytes + append
+                    + (live // ps) * self._scale_row_bytes)
+        return (S * L * self._tok_bytes + append
+                + S * self._n_max * self._scale_row_bytes)
 
     def _paged_gather(self):
         """Legacy round trip: materialize the dense per-slot KV view

@@ -119,8 +119,70 @@ def _decode_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
                        ).astype(o_ref.dtype)
 
 
+def _decode_kernel_quant(tbl_ref, len_ref, ks_ref, vs_ref, q_ref, k_ref,
+                         v_ref, o_ref, acc_scr, m_scr, l_scr, *, ps, qw,
+                         nb, scale):
+    """The int8-pool variant of _decode_kernel: K/V blocks arrive in
+    VMEM as int8 (the DMA moves half the bytes — the real win, not
+    just the model's), with the per-(page, head) amax scales riding
+    the scalar prefetch (ks/vs: [P, Hkv] float32 in SMEM, indexed by
+    the very page id the table prefetch routed this block through).
+    Dequantization folds into the existing fp32 math for free: the
+    K scale multiplies the score block alongside 1/sqrt(d), and the
+    V scale multiplies the block's pv contribution before it enters
+    the accumulator — per-page-constant scales commute with both
+    dots, so this IS dequant(int8) attention, not an approximation
+    of it."""
+    s, h, b = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+
+    @pl.when(b == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[s]
+
+    @pl.when(b * ps < length)
+    def _compute():
+        page = tbl_ref[s, b]
+        sk = ks_ref[page, h]
+        sv = vs_ref[page, h]
+        qb = q_ref[0, 0].astype(jnp.float32)          # [reps*W, D]
+        # int8 operands are EXPLICITLY widened before any arithmetic
+        # (the int8-promotion-in-dispatch lint contract): the dot runs
+        # in fp32, the page's scale rides the existing score scaling
+        sblk = jax.lax.dot_general(
+            qb, k_ref[0, 0].astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * (scale * sk)
+        rw = qb.shape[0]
+        kpos = b * ps + jax.lax.broadcasted_iota(jnp.int32, (rw, ps), 1)
+        w = jax.lax.broadcasted_iota(jnp.int32, (rw, ps), 0) % qw
+        valid = kpos <= length - qw + w
+        sblk = jnp.where(valid, sblk, NEG_INF)
+        m_prev = m_scr[:][:, :1]
+        l_prev = l_scr[:][:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(sblk, axis=1, keepdims=True))
+        p = jnp.exp(sblk - m_new) * valid.astype(jnp.float32)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v_ref[0, 0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * sv      # [reps*W, D]
+        acc_scr[:] = acc_scr[:] * corr + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(b == nb - 1)
+    def _finish():
+        o_ref[0, 0] = (acc_scr[:] / jnp.maximum(l_scr[:][:, :1], 1e-30)
+                       ).astype(o_ref.dtype)
+
+
 def paged_attention(q, k_pool, v_pool, table, lengths, *, query_width: int,
-                    interpret: bool = False):
+                    interpret: bool = False, k_scales=None,
+                    v_scales=None):
     """Paged-attention decode over the block-paged KV pool.
 
     - ``q``: ``[S, Hkv, reps*W, D]`` — queries grouped by kv head (GQA:
@@ -135,6 +197,11 @@ def paged_attention(q, k_pool, v_pool, table, lengths, *, query_width: int,
       dead blocks all route there).
     - ``lengths``: ``[S]`` int32 valid KV positions per row INCLUDING
       the appended chunk (engine: ``kv_pos + W``).
+    - ``k_scales`` / ``v_scales``: ``[P, Hkv]`` float32 — the int8
+      pool's per-(page, head) amax-scale sidecars (serving/quant.py).
+      Passing them selects the quantized kernel: pools must be int8,
+      blocks DMA at half the bytes, and dequantization happens in
+      VMEM with the scales riding the scalar-prefetch refs.
 
     Returns ``[S, Hkv, reps*W, D]`` in ``q.dtype`` (fp32 accumulation).
     Free/garbage rows produce finite garbage the engine discards — the
@@ -147,23 +214,46 @@ def paged_attention(q, k_pool, v_pool, table, lengths, *, query_width: int,
     if qw < 1 or rw % qw:
         raise ValueError(f"query rows {rw} not divisible by "
                          f"query_width {qw}")
-    kernel = functools.partial(_decode_kernel, ps=ps, qw=qw, nb=nb,
-                               scale=float(1.0 / np.sqrt(d)))
+    quant = k_scales is not None or v_scales is not None
+    if quant and (k_scales is None or v_scales is None):
+        raise ValueError("k_scales and v_scales travel together")
+    if quant and k_pool.dtype != jnp.int8:
+        raise ValueError(
+            f"scale sidecars describe an int8 pool, got "
+            f"{k_pool.dtype}")
+    scale = float(1.0 / np.sqrt(d))
+    if quant:
+        kernel = functools.partial(_decode_kernel_quant, ps=ps, qw=qw,
+                                   nb=nb, scale=scale)
+        n_pref = 4
+        pref = (jnp.asarray(table, jnp.int32),
+                jnp.asarray(lengths, jnp.int32),
+                jnp.asarray(k_scales, jnp.float32),
+                jnp.asarray(v_scales, jnp.float32))
+    else:
+        kernel = functools.partial(_decode_kernel, ps=ps, qw=qw, nb=nb,
+                                   scale=scale)
+        n_pref = 2
+        pref = (jnp.asarray(table, jnp.int32),
+                jnp.asarray(lengths, jnp.int32))
+
+    def _q_map(s, h, b, tbl, *_):
+        return (s, h, 0, 0)
+
+    def _pool_map(s, h, b, tbl, *_):
+        # the page table IS the index map: block b of row s loads
+        # pool page table[s, b] — the paged read path, fused
+        return (tbl[s, b], h, 0, 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=n_pref,
         grid=(S, hkv, nb),
         in_specs=[
-            pl.BlockSpec((1, 1, rw, d),
-                         lambda s, h, b, tbl, ln: (s, h, 0, 0)),
-            # the page table IS the index map: block b of row s loads
-            # pool page table[s, b] — the paged read path, fused
-            pl.BlockSpec((1, 1, ps, d),
-                         lambda s, h, b, tbl, ln: (tbl[s, b], h, 0, 0)),
-            pl.BlockSpec((1, 1, ps, d),
-                         lambda s, h, b, tbl, ln: (tbl[s, b], h, 0, 0)),
+            pl.BlockSpec((1, 1, rw, d), _q_map),
+            pl.BlockSpec((1, 1, ps, d), _pool_map),
+            pl.BlockSpec((1, 1, ps, d), _pool_map),
         ],
-        out_specs=pl.BlockSpec((1, 1, rw, d),
-                               lambda s, h, b, tbl, ln: (s, h, 0, 0)),
+        out_specs=pl.BlockSpec((1, 1, rw, d), _q_map),
         scratch_shapes=[pltpu.VMEM((rw, d), jnp.float32),
                         pltpu.VMEM((rw, 128), jnp.float32),
                         pltpu.VMEM((rw, 128), jnp.float32)],
@@ -172,20 +262,24 @@ def paged_attention(q, k_pool, v_pool, table, lengths, *, query_width: int,
         kernel, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, hkv, rw, d), q.dtype),
         interpret=interpret,
-    )(jnp.asarray(table, jnp.int32), jnp.asarray(lengths, jnp.int32),
-      q, k_pool, v_pool)
+    )(*pref, q, k_pool, v_pool)
 
 
 def paged_attention_supported(pool_shape: Tuple[int, ...],
-                              query_rows: int) -> bool:
+                              query_rows: int, *,
+                              kv_dtype: str = "bf16") -> bool:
     """Shape gate for the REAL-CHIP kernel path (mirrors
     flash_attention_supported): head dim lane-tileable, page rows
-    sublane-tileable. Interpret mode (CPU tests) has no such limits —
-    this gate only decides the ``decode_impl="auto"`` resolution on a
-    TPU backend."""
+    sublane-tileable. An int8 pool tightens both (the int8 minimum
+    tile is (32, 128) vs fp32's (8, 128) — a page block must still be
+    a whole tile multiple). Interpret mode (CPU tests) has no such
+    limits — this gate only decides the ``decode_impl="auto"``
+    resolution on a TPU backend."""
     if len(pool_shape) != 4:
         return False
     _, _, ps, d = pool_shape
+    if kv_dtype == "int8":
+        return d in (128, 256) and ps % 32 == 0 and query_rows >= 1
     return d in (64, 128, 256) and ps % 8 == 0 and query_rows >= 1
 
 

@@ -62,11 +62,26 @@ class PageExhausted(RuntimeError):
 class PagedKVConfig:
     """Knobs for the block-paged arena.
 
-    ``page_size`` tokens per page; capacity comes from ``total_pages``
-    or ``total_tokens`` (whichever is given — ``total_tokens`` rounds
-    down to whole pages), defaulting to the old slot arena's worst case
-    (slots × ceil(L / page_size)) so switching paging on never shrinks
-    capacity. ``prefix_cache`` enables shared-prompt page reuse.
+    ``page_size`` tokens per page; capacity comes from ``total_pages``,
+    ``total_tokens`` or ``total_bytes`` (whichever is given —
+    ``total_tokens`` rounds down to whole pages; ``total_bytes`` is a
+    BYTE budget the engine divides by the per-page cost of the net's kv
+    leaves incl. any int8 scale sidecar, so the same budget admits ~2x
+    the pages under ``kv_dtype="int8"``), defaulting to the old slot
+    arena's worst case (slots × ceil(L / page_size)) so switching
+    paging on never shrinks capacity. ``prefix_cache`` enables
+    shared-prompt page reuse.
+
+    ``kv_dtype`` selects the pool's authoritative storage precision:
+    ``"bf16"`` (default) keeps the net's native leaf dtype — the name
+    of the unquantized path, not a cast; ``"int8"`` stores symmetric
+    per-(page, kv-head) int8 with a ``[P, Hkv]`` amax-scale sidecar
+    per leaf (``serving/quant.py`` — quantize-once on write,
+    dequantize-on-read in both decode impls; requires ``direct=True``:
+    the legacy dense round trip has no quantized read path);
+    ``"auto"`` consults the measured ``paged_decode_quant`` crossover
+    entry for this engine's shape (tuning/plan.resolve_kv_dtype) —
+    uncalibrated runs stay bf16.
 
     ``direct`` (default) makes decode operate DIRECTLY on the page
     pool: the attention step reads K/V through the page table and the
@@ -86,10 +101,12 @@ class PagedKVConfig:
     page_size: int = 8
     total_pages: Optional[int] = None
     total_tokens: Optional[int] = None
+    total_bytes: Optional[int] = None
     prefix_cache: bool = True
     direct: bool = True
     decode_impl: str = "auto"
     kernel_interpret: bool = False
+    kv_dtype: str = "bf16"
 
     def __post_init__(self):
         if self.page_size < 1:
@@ -99,6 +116,21 @@ class PagedKVConfig:
             raise ValueError(
                 f"decode_impl must be 'auto', 'xla' or 'pallas', got "
                 f"{self.decode_impl!r}")
+        if self.kv_dtype not in ("bf16", "int8", "auto"):
+            raise ValueError(
+                f"kv_dtype must be 'bf16', 'int8' or 'auto', got "
+                f"{self.kv_dtype!r}")
+        if self.kv_dtype != "bf16" and not self.direct:
+            raise ValueError(
+                "kv_dtype='int8'/'auto' needs direct=True: the legacy "
+                "gather/scatter round trip materializes the dense view "
+                "in the net dtype and has no quantized read path")
+        given = [k for k in ("total_pages", "total_tokens",
+                             "total_bytes")
+                 if getattr(self, k) is not None]
+        if len(given) > 1:
+            raise ValueError(
+                f"give at most one capacity knob, got {given}")
         if self.total_pages is not None and self.total_pages < 1:
             raise ValueError(f"total_pages must be >= 1, got "
                              f"{self.total_pages}")
@@ -107,6 +139,20 @@ class PagedKVConfig:
             raise ValueError(
                 f"total_tokens {self.total_tokens} is less than one "
                 f"page ({self.page_size} tokens)")
+        if self.total_bytes is not None and self.total_bytes < 1:
+            raise ValueError(f"total_bytes must be >= 1, got "
+                             f"{self.total_bytes}")
+
+    def resolve_pages_bytes(self, page_bytes: int) -> int:
+        """Pages the ``total_bytes`` budget buys at ``page_bytes`` per
+        page (the engine computes page_bytes from the net's kv leaves
+        via quant.kv_page_bytes — scale sidecars included)."""
+        n = int(self.total_bytes) // max(1, int(page_bytes))
+        if n < 1:
+            raise ValueError(
+                f"total_bytes {self.total_bytes} buys no page "
+                f"({page_bytes} bytes/page)")
+        return n
 
     def resolve_pages(self, slots: int, n_max: int) -> int:
         if self.total_pages is not None:

@@ -34,7 +34,7 @@ from typing import Optional
 
 from deeplearning4j_tpu.tuning.crossover import (
     KernelCrossoverStore, bottleneck_fingerprint, decode_fingerprint,
-    default_store, stem_fingerprint)
+    default_store, quant_fingerprint, stem_fingerprint)
 
 log = logging.getLogger(__name__)
 
@@ -143,6 +143,31 @@ def decode_key_for_engine(page_size: int, head_dim: int,
                           dtype) -> str:
     return decode_fingerprint(page_size, head_dim, n_kv_heads,
                               cache_length, dtype)
+
+
+def resolve_kv_dtype(eligible: bool, key: str, *,
+                     store: Optional[KernelCrossoverStore] = None
+                     ) -> str:
+    """``kv_dtype="auto"`` resolution for the int8 KV page pool.
+    ``eligible`` is the engine's static gate (direct paged decode, no
+    recurrent h/c state) — eligibility says int8 *can* serve this net;
+    only a measurement says it *should*. Uncalibrated (or platform-
+    mismatched — the store's lookup already refuses a CPU-calibrated
+    entry on TPU) runs stay on bf16: quantization is an accuracy
+    trade, so unlike the decode-impl default it must be OPTED INTO by
+    a calibrated win ("kernel" = the int8 leg measured faster)."""
+    if not eligible:
+        return "bf16"
+    store = default_store() if store is None else store
+    return ("int8" if store.choose(key, default="fallback") == "kernel"
+            else "bf16")
+
+
+def quant_key_for_engine(page_size: int, head_dim: int,
+                         n_kv_heads: int, cache_length: int,
+                         dtype) -> str:
+    return quant_fingerprint(page_size, head_dim, n_kv_heads,
+                             cache_length, dtype)
 
 
 # ---------------------------------------------------------------------------

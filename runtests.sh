@@ -102,6 +102,17 @@ python -m pytest tests/test_serving_paged.py -q -p no:cacheprovider
 # recovery re-entering the direct path, zero retraces with the kernel on
 python -m pytest tests/test_serving_paged_kernel.py -q -p no:cacheprovider
 
+# tier-1 quant lane: the int8 KV page pool (serving/quant.py +
+# kv_dtype="int8") — quantization-primitive exactness (power-of-two
+# scales, round-trip <= sigma/2, bf16-exact dequant), the pinned
+# accuracy ENVELOPE vs bf16 (divergence-step + MAE, never bit-parity),
+# int8-vs-ITSELF bitwise pins (prefix hit==miss, rebuild, migration,
+# speculation on/off, run-to-run, xla==kernel), the halved per-dispatch
+# byte model on both impls, capacity doubling under total_bytes,
+# kv_dtype="auto" crossover resolution, chaos exhaustion on a quantized
+# pool, and zero retraces with int8+prefix+speculation stacked
+python -m pytest tests/test_serving_quant.py -q -p no:cacheprovider
+
 # tier-1 serving-fleet lane: the multi-replica router (serving/fleet/)
 # — routed == single-engine bit-exactness (greedy + sampled),
 # kill-a-replica mid-trace with bit-identical continuation on the

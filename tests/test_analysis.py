@@ -1203,6 +1203,66 @@ class TestDtypePromotionRule:
 
 
 # ---------------------------------------------------------------------
+# rule: int8-promotion-in-dispatch (ISSUE 18)
+# ---------------------------------------------------------------------
+class TestInt8PromotionRule:
+    def test_positive_binop_on_int8_local(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import jax.numpy as jnp
+
+            def dequant(x, sigma):
+                q = x.astype(jnp.int8)
+                return q * sigma
+        """)
+        assert _rules_of(fs) == ["int8-promotion-in-dispatch"]
+        assert "'q'" in fs[0].message
+
+    def test_positive_int8_into_dot(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import jax.numpy as jnp
+
+            def score(q, k_ref):
+                kq = jnp.asarray(k_ref, dtype=jnp.int8)
+                return jnp.dot(q, kq)
+        """)
+        assert _rules_of(fs) == ["int8-promotion-in-dispatch"]
+        assert "dot" in fs[0].message
+
+    def test_negative_explicit_widen_before_math(self, tmp_path):
+        """The quant-kernel contract shape: every int8 read widens
+        through .astype before touching arithmetic."""
+        fs = _scan_snippet(tmp_path, """
+            import jax.numpy as jnp
+
+            def dequant(x, sigma):
+                q = x.astype(jnp.int8)
+                return q.astype(jnp.float32) * sigma
+        """)
+        assert fs == []
+
+    def test_negative_rebinding_clears_the_taint(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import jax.numpy as jnp
+
+            def roundtrip(x, sigma):
+                q = x.astype(jnp.int8)
+                q = q.astype(jnp.float32)
+                return q * sigma
+        """)
+        assert fs == []
+
+    def test_negative_no_jax_import(self, tmp_path):
+        fs = _scan_snippet(tmp_path, """
+            import numpy as np
+
+            def pack(x):
+                q = x.astype(np.int8)
+                return q * 2
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------
 # rule: unlocked-thread-state
 # ---------------------------------------------------------------------
 class TestThreadSharedStateRule:
@@ -2364,7 +2424,8 @@ class TestSelfScan:
         assert {r.id for r in ALL_RULES} == {
             "host-sync-in-hot-loop", "device-transfer-in-hot-loop",
             "tracer-leak", "recompile-hazard",
-            "dtype-promotion", "unlocked-thread-state", "bare-except",
+            "dtype-promotion", "int8-promotion-in-dispatch",
+            "unlocked-thread-state", "bare-except",
             "mutable-default-arg", "unbounded-retry",
             "non-atomic-state-write", "stale-world-snapshot",
             "lock-held-across-dispatch",
