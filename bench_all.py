@@ -1438,6 +1438,172 @@ def bench_serve_fleet():
     _print_line(json.dumps(rec), flush=True)
 
 
+def bench_serve_fleet_procs():
+    """Cross-process serving fleet (ISSUE 19): the serve_fleet trace
+    over 1 -> 2 -> 3 replica PROCESSES (real fleet_worker subprocesses,
+    shared-fs transport, out-of-process router), plus a kill -9 sub-leg
+    at 3 processes. Adjudicates on MECHANISM only — every request
+    completes at every size, the kill-one leg completes all 24/24 on
+    survivors, and each replica runs under its own pid (its own
+    interpreter and GIL — the per-process independence an in-process
+    fleet cannot have). tok/s and p95 TTFT are recorded for live-window
+    comparison but NEVER asserted: on shared CPU the replica processes
+    contend for the same cores (PERF.md "ISSUE 19")."""
+    import shutil
+    import subprocess
+    import tempfile
+    import textwrap
+    import threading
+
+    import numpy as np
+    from deeplearning4j_tpu.monitoring.metrics import MetricsRegistry
+    from deeplearning4j_tpu.serving import ProcessFleetRouter
+    from deeplearning4j_tpu.serving.fleet import FleetConfig
+    from deeplearning4j_tpu.serving.fleet import worker as fleet_worker
+
+    V, R, STEPS, PS = 256, 24, 24, 8
+    STAGGER, TTL = 0.005, 1.0
+    rng = np.random.default_rng(0)
+    families = [list(rng.integers(1, V, 2 * PS)) for _ in range(3)]
+    prompts = [families[i % 3] + list(rng.integers(1, V,
+                                                   int(rng.integers(2, 8))))
+               for i in range(R)]
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+
+    def write_builder(dirpath):
+        # the worker builder, self-contained: every process builds a
+        # bit-identical engine (fixed init seed) — same shape as the
+        # in-process serve_fleet leg's factory
+        with open(os.path.join(dirpath, "procfleet_builder.py"),
+                  "w") as f:
+            f.write(textwrap.dedent('''
+                def build(rid):
+                    from deeplearning4j_tpu.serving import (
+                        GenerationEngine, PagedKVConfig)
+                    from deeplearning4j_tpu.zoo import (
+                        TextGenerationTransformer)
+                    net = TextGenerationTransformer(
+                        vocab_size=256, embed_dim=64, n_heads=4,
+                        n_layers=2, max_length=64,
+                        positional="rope").init()
+                    net.conf.dtype = "bfloat16"
+                    return GenerationEngine(
+                        net, 256, slots=2, queue_limit=24,
+                        paging=PagedKVConfig(page_size=8))
+            '''))
+
+    def trace(n_procs, kill=False):
+        td = tempfile.mkdtemp(prefix="procfleet_")
+        root = os.path.join(td, "fleet")
+        write_builder(td)
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = td + os.pathsep + repo_root \
+            + os.pathsep + env.get("PYTHONPATH", "")
+        procs, logs = {}, {}
+        for rid in range(n_procs):
+            logs[rid] = open(os.path.join(td, f"agent{rid}.log"), "w")
+            procs[rid] = fleet_worker.spawn(
+                root, rid, "procfleet_builder:build", warmup=True,
+                ttl=TTL, env=env, cwd=repo_root, stdout=logs[rid],
+                stderr=subprocess.STDOUT)
+        router = ProcessFleetRouter(
+            root, config=FleetConfig(lease_ttl_s=TTL),
+            registry=MetricsRegistry(), name=f"procbench{n_procs}")
+        try:
+            deadline = time.monotonic() + 600
+            while router.live_replicas() != list(range(n_procs)):
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"agents never came up: {router.live_replicas()}")
+                time.sleep(0.1)
+            pids = sorted(st["pid"] for st
+                          in router.status.read_all().values())
+            router.start()
+            handles, submit_t, first_t = [], {}, {}
+            stop = threading.Event()
+
+            def watch():     # TTFT observer: first RELAYED token
+                while not stop.is_set():
+                    now = time.perf_counter()
+                    for h in list(handles):
+                        if id(h) not in first_t and h.generated:
+                            first_t[id(h)] = now
+                    time.sleep(0.001)
+
+            watcher = threading.Thread(target=watch, daemon=True)
+            watcher.start()
+            t0 = time.perf_counter()
+            killed_at = victim = None
+            for i, p in enumerate(prompts):
+                while time.perf_counter() < t0 + i * STAGGER:
+                    time.sleep(0.001)
+                if kill and i == R // 2:
+                    placed = [rid for rid, _
+                              in router.assignments().values()]
+                    victim = max(set(placed) or {0}, key=placed.count)
+                    procs[victim].kill()    # SIGKILL: a real corpse
+                    procs[victim].wait(timeout=30)
+                    killed_at = i
+                h = router.submit(p, steps=STEPS, top_k=1,
+                                  rng=np.random.default_rng(i))
+                submit_t[id(h)] = time.perf_counter()
+                handles.append(h)
+            done = 0
+            for h in handles:
+                try:
+                    h.result(timeout=600)
+                    done += 1
+                except Exception:  # noqa: BLE001 — count completions
+                    pass
+            dt = time.perf_counter() - t0
+            stop.set()
+            watcher.join(timeout=2)
+            gen = sum(len(h.generated) for h in handles if h.done)
+            ttft = [first_t[k] - submit_t[k] for k in first_t]
+            rec = {"completed": done, "wall_s": round(dt, 2),
+                   "tokens_per_sec": round(gen / dt, 1),
+                   "ttft_p95_ms": (round(float(
+                       np.percentile(ttft, 95)) * 1e3, 1)
+                       if ttft else None),
+                   # one OS process (own pid, own GIL) per replica
+                   "pids": pids,
+                   "distinct_pids": len(set(pids)) == n_procs
+                   and os.getpid() not in pids}
+            if kill:
+                rec.update({"killed_at_request": killed_at,
+                            "victim": victim,
+                            "dead_replicas": router.dead_replicas,
+                            "replaced_requests":
+                                router.replaced_requests,
+                            "replicas_left":
+                                len(router.live_replicas())})
+            return rec
+        finally:
+            try:
+                router.shutdown(stop_agents=True)
+            except Exception:  # noqa: BLE001 — teardown must not mask
+                pass
+            for rid, proc in procs.items():
+                try:
+                    proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                logs[rid].close()
+            shutil.rmtree(td, ignore_errors=True)
+
+    by_size = {n: trace(n) for n in (1, 2, 3)}
+    kill_rec = trace(3, kill=True)
+    rec = {"metric": "serve_fleet_procs", "unit": "requests_completed",
+           "requests": R, "steps": STEPS, "stagger_ms": STAGGER * 1e3,
+           "lease_ttl_s": TTL,
+           "processes": {str(n): by_size[n] for n in by_size},
+           "kill_mid_trace": kill_rec}
+    rec["value"] = kill_rec["completed"]
+    _print_line(json.dumps(rec), flush=True)
+
+
 def _converge_run(net, x, y, steps, record_every):
     """Fixed-seed training loop recording the loss trajectory. Each
     recorded point is a scalar host fetch — a real sync (the tunneled
@@ -1674,6 +1840,7 @@ ALL = {"resnet": bench_resnet, "lstm": bench_lstm, "lenet": bench_lenet,
        "serve_paged": bench_serve_paged,
        "serve_chaos": bench_serve_chaos,
        "serve_fleet": bench_serve_fleet,
+       "serve_fleet_procs": bench_serve_fleet_procs,
        "checkpoint_stall": bench_checkpoint_stall,
        "converge_lenet": bench_converge_lenet,
        "converge_resnet": bench_converge_resnet}

@@ -62,8 +62,11 @@ DEFAULT_CAPACITY = 2048
 #: early_reject/brownout), ``fleet`` (router: replica_join/replica_dead/
 #: migration/rebalance/scale_out/scale_in/autoscale/generation),
 #: ``resilience`` (remesh/checkpoint_save/checkpoint_commit/rollback/
-#: restart/preemption/divergence), ``flight`` (recorder dumps).
-KNOWN_CATEGORIES = ("serving", "fleet", "resilience", "flight")
+#: restart/preemption/divergence), ``flight`` (recorder dumps),
+#: ``transport`` (cross-process fleet mailbox/journal: admit/revoke/
+#: duplicate/quarantine/nack/replace — serving/fleet/transport.py).
+KNOWN_CATEGORIES = ("serving", "fleet", "resilience", "flight",
+                    "transport")
 
 
 class Event:

@@ -26,6 +26,10 @@ Catalog:
   ETL stall; exercises prefetch-depth headroom and serving deadlines).
 - ``PreemptionIterator``: ``SimulatedPreemption`` after N batches — the
   SIGTERM-style mid-epoch kill for checkpoint-restart tests.
+- Mailbox injectors (``MailboxInjector`` subclasses — torn, duplicate,
+  delayed delivery): faults on the cross-process serving fleet's
+  command transport (``serving/fleet/transport.Mailbox(chaos=...)``),
+  plus ``LeaseStallInjector`` for the stalled-lease-but-alive replica.
 """
 
 from __future__ import annotations
@@ -38,11 +42,14 @@ import numpy as np
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterators import DataSetIterator
 
-__all__ = ["ChaosIterator", "FaultBurstInjector", "HostLossInjector",
-           "InjectedFault", "LatencyIterator", "LeaseStallInjector",
+__all__ = ["ChaosIterator", "DelayedDeliveryInjector",
+           "DuplicateDeliveryInjector", "FaultBurstInjector",
+           "HostLossInjector", "InjectedFault", "LatencyIterator",
+           "LeaseStallInjector", "MailboxInjector",
            "NaNPoisonIterator", "PageExhaustionInjector",
            "PreemptionIterator", "ProcessKillInjector", "RaiseOnBatch",
-           "RequestFaultInjector", "SimulatedPreemption", "fire"]
+           "RequestFaultInjector", "SimulatedPreemption",
+           "TornCommandInjector", "fire"]
 
 
 def fire(injector, index: int, ctx=None) -> None:
@@ -449,3 +456,121 @@ class LeaseStallInjector(ChaosIterator):
         """Un-freeze the heartbeats (the hung host came back)."""
         self._stall_t0 = None
         self.ledger.resume()
+
+
+# ----------------------------------------------------------------------
+# transport chaos (the cross-process serving fleet's mailbox seam)
+# ----------------------------------------------------------------------
+
+class MailboxInjector:
+    """Base for transport-level faults on the cross-process fleet's
+    command mailbox (``serving/fleet/transport.Mailbox(chaos=...)``).
+
+    The mailbox calls ``on_send(dirpath, name, data)`` with the
+    serialized command BEFORE its normal atomic-rename write; returning
+    True means the injector took over (or withheld) delivery, False
+    means deliver normally. Sends are counted so faults target "the
+    Nth command this mailbox ever carried", with the same once-latch
+    semantics as the iterator injectors — fault once, then behave.
+
+    Subclasses override :meth:`inject`. All of them attack the
+    TRANSPORT, never the agent: the delivery contract under test is
+    that a torn file quarantines (poll loop survives), a duplicate
+    deduplicates (admission idempotent by ``(request id, attempt)``),
+    and a delayed command is simply late (at-least-once, unordered)."""
+
+    def __init__(self, n: int = 0, once: bool = True):
+        self.n = int(n)
+        self.once = once
+        self.sends_seen = 0
+        self.faults_fired = 0
+
+    def _fire(self) -> bool:
+        if self.once and self.faults_fired:
+            return False
+        self.faults_fired += 1
+        return True
+
+    def on_send(self, dirpath: str, name: str, data: bytes) -> bool:
+        idx = self.sends_seen
+        self.sends_seen += 1
+        if idx >= self.n and self._fire():
+            return self.inject(dirpath, name, data)
+        return False
+
+    def inject(self, dirpath: str, name: str, data: bytes) -> bool:
+        raise NotImplementedError
+
+
+class TornCommandInjector(MailboxInjector):
+    """Deliver a TORN command file: the first ``frac`` of the payload
+    bytes written straight to the final name — no tmp file, no rename,
+    no fsync — exactly the artifact a crashed copy tool (or a sender
+    killed mid-write on a filesystem without atomic rename) leaves
+    behind. The receiving agent must quarantine it and keep polling;
+    the command itself is LOST, which is why every command is safe to
+    re-send (at-least-once + dedupe)."""
+
+    def __init__(self, n: int = 0, frac: float = 0.5,
+                 keep_bytes: Optional[int] = None, once: bool = True):
+        super().__init__(n=n, once=once)
+        self.frac = float(frac)
+        self.keep_bytes = keep_bytes
+
+    def inject(self, dirpath: str, name: str, data: bytes) -> bool:
+        import os
+        cut = self.keep_bytes if self.keep_bytes is not None \
+            else max(1, int(len(data) * self.frac))
+        with open(os.path.join(dirpath, name), "wb") as f:
+            f.write(data[:cut])
+        return True
+
+
+class DuplicateDeliveryInjector(MailboxInjector):
+    """Deliver the SAME command twice (two atomic files, distinct
+    names): the at-least-once failure mode a sender that died between
+    "wrote the file" and "recorded that it wrote it" produces on
+    re-send. The agent's ``(request id, attempt)`` dedupe must make the
+    second copy a counted no-op — admitting a request twice would
+    double-serve it."""
+
+    def inject(self, dirpath: str, name: str, data: bytes) -> bool:
+        import os
+        from deeplearning4j_tpu.resilience.durable import (
+            atomic_write_bytes)
+        atomic_write_bytes(os.path.join(dirpath, name), data)
+        # the duplicate sorts right after the original and still
+        # matches the mailbox's cmd_*.json consume filter
+        atomic_write_bytes(
+            os.path.join(dirpath, name[:-len(".json")] + "_dup.json"),
+            data)
+        return True
+
+
+class DelayedDeliveryInjector(MailboxInjector):
+    """WITHHOLD matching commands until :meth:`release` — the
+    slow-shared-filesystem / delayed-visibility simulation. Ordering is
+    a courtesy in the mailbox contract, so a late command must admit
+    exactly as a prompt one (possibly after the router already
+    re-placed the request elsewhere, in which case the stale
+    ``attempt`` fence makes the late admission journal events the
+    relay ignores)."""
+
+    def __init__(self, n: int = 0, once: bool = True):
+        super().__init__(n=n, once=once)
+        self.held: list = []
+
+    def inject(self, dirpath: str, name: str, data: bytes) -> bool:
+        self.held.append((dirpath, name, data))
+        return True
+
+    def release(self) -> int:
+        """Deliver every withheld command (atomically); returns how
+        many were released."""
+        import os
+        from deeplearning4j_tpu.resilience.durable import (
+            atomic_write_bytes)
+        held, self.held = self.held, []
+        for dirpath, name, data in held:
+            atomic_write_bytes(os.path.join(dirpath, name), data)
+        return len(held)

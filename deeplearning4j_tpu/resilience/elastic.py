@@ -253,7 +253,8 @@ class LeaseLedger:
     def __init__(self, root: str, rank: int, ttl: float = 5.0,
                  interval: Optional[float] = None,
                  advertise_host: str = "127.0.0.1",
-                 role: Optional[str] = None):
+                 role: Optional[str] = None,
+                 extra: Optional[Dict] = None):
         if ttl <= 0:
             raise ValueError(f"ttl must be > 0, got {ttl}")
         self.root = os.path.abspath(root)
@@ -263,6 +264,11 @@ class LeaseLedger:
         #: ``live_ranks(role=...)`` filters to one population so a
         #: serving fleet never counts a training rank as a replica)
         self.role = role
+        #: optional JSON-able advertisement merged into every beat —
+        #: how a cross-process fleet agent publishes its pid (and any
+        #: other discovery payload) to an out-of-process router that
+        #: can only observe the shared filesystem
+        self.extra = dict(extra) if extra else None
         self.ttl = float(ttl)
         self.interval = float(interval) if interval is not None \
             else self.ttl / 3.0
@@ -299,6 +305,8 @@ class LeaseLedger:
         }
         if self.role is not None:
             lease["role"] = self.role
+        if self.extra:
+            lease.update(self.extra)
         _write_json_atomic_nosync(self._lease_path(self.rank), lease)
 
     def start(self, generation: Optional[int] = None) -> "LeaseLedger":
@@ -392,6 +400,17 @@ class LeaseLedger:
         return sorted(r for r, lease in self.read_leases().items()
                       if now - float(lease["ts"]) <= self.ttl
                       and (role is None or lease.get("role") == role))
+
+    def live_leases(self, now: Optional[float] = None,
+                    role: Optional[str] = None) -> Dict[int, Dict]:
+        """Live ranks WITH their latest beat payloads (role-filtered
+        like ``live_ranks``) — the discovery read an out-of-process
+        fleet router uses: the beat carries each agent's advertised
+        ``extra`` payload (pid etc.) alongside liveness."""
+        now = time.time() if now is None else now
+        return {r: lease for r, lease in self.read_leases().items()
+                if now - float(lease["ts"]) <= self.ttl
+                and (role is None or lease.get("role") == role)}
 
     # -- generations -----------------------------------------------------
     def read_generation(self, generation: int) -> Optional[GenerationRecord]:

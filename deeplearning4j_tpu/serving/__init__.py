@@ -59,7 +59,8 @@ from deeplearning4j_tpu.serving.supervisor import (  # noqa: F401
     EngineSupervisor)
 from deeplearning4j_tpu.serving.fleet import (  # noqa: F401
     AutoscaleConfig, FleetAutoscaler, FleetConfig, FleetMembership,
-    FleetReplica, FleetRouter, FleetSignals, MigrationReport)
+    FleetReplica, FleetRouter, FleetSignals, MigrationReport,
+    ProcessFleetRouter, ReplicaAgent)
 
 __all__ = ["AdmissionQueue", "AutoscaleConfig", "EngineShutdown",
            "EngineSupervisor", "FleetAutoscaler", "FleetConfig",
@@ -68,7 +69,8 @@ __all__ = ["AdmissionQueue", "AutoscaleConfig", "EngineShutdown",
            "GenerationStream", "InferenceTimeout", "LEDGER_VERSION",
            "MigrationReport", "NoReplicaAvailable", "OverloadConfig",
            "OverloadController", "PagedKVConfig", "PageExhausted",
-           "PagePool", "PrefixCache", "QueueSnapshot",
+           "PagePool", "PrefixCache", "ProcessFleetRouter",
+           "QueueSnapshot", "ReplicaAgent",
            "RequestCancelled", "RequestLedgerEntry", "RequestTrace",
            "ServingOverloaded", "ServingQueueFull", "SpeculationConfig",
            "ttft_attribution"]

@@ -104,6 +104,7 @@ Survivability (PR 9, ARCHITECTURE.md "Serving survivability"):
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from collections import deque
@@ -609,6 +610,11 @@ class GenerationEngine:
 
     def health(self) -> dict:
         out = {"healthy": self.is_healthy(), "ready": self.is_ready(),
+               # identity for multi-engine / multi-PROCESS probes: a
+               # /health dump or an agent status file must say which
+               # replica (and whose pid) this payload describes
+               "label": self.trace_identity,
+               "pid": os.getpid(),
                "queue_depth": self.queue_depth(),
                "active_slots": self.active_slots(),
                "slots": self.slots,

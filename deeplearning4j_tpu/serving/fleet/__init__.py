@@ -12,18 +12,35 @@ queue/page-pressure/brownout signals into hysteresis-guarded
 scale-out/in, draining through migration on the way down. Replica
 membership rides the PR 8 elastic lease ledger in replica mode
 (``role="serving"``). See ARCHITECTURE.md "Serving fleet".
+
+The CROSS-PROCESS shape puts each replica in its own OS process: a
+``ReplicaAgent`` (``agent.py``, spawned by the ``worker.py``
+entrypoint) wraps one engine behind a lease heartbeat
+(``role="replica"``), a shared-filesystem command mailbox, and an
+append-only stream journal (``transport.py``); a ``ProcessFleetRouter``
+discovers agents through the leases alone, submits by mailing ledger
+payloads, relays journal events into local stream handles, and
+re-places a dead replica's work onto survivors with no cooperation from
+the corpse — ``kill -9`` survivable by construction. See
+ARCHITECTURE.md "Cross-process fleet".
 """
 
+from deeplearning4j_tpu.serving.fleet.agent import (  # noqa: F401
+    ReplicaAgent)
 from deeplearning4j_tpu.serving.fleet.autoscale import (  # noqa: F401
     AutoscaleConfig, FleetAutoscaler, FleetSignals)
 from deeplearning4j_tpu.serving.fleet.membership import (  # noqa: F401
-    REPLICA_ROLE, FleetMembership)
+    AGENT_ROLE, REPLICA_ROLE, FleetMembership)
 from deeplearning4j_tpu.serving.fleet.migration import (  # noqa: F401
     MigrationReport, readmit_entries)
 from deeplearning4j_tpu.serving.fleet.router import (  # noqa: F401
-    FleetConfig, FleetReplica, FleetRouter)
+    FleetConfig, FleetReplica, FleetRouter, ProcessFleetRouter)
+from deeplearning4j_tpu.serving.fleet.transport import (  # noqa: F401
+    AgentStatus, JournalReader, JournalWriter, Mailbox, fleet_paths)
 
-__all__ = ["AutoscaleConfig", "FleetAutoscaler", "FleetConfig",
-           "FleetMembership", "FleetReplica", "FleetRouter",
-           "FleetSignals", "MigrationReport", "REPLICA_ROLE",
-           "readmit_entries"]
+__all__ = ["AGENT_ROLE", "AgentStatus", "AutoscaleConfig",
+           "FleetAutoscaler", "FleetConfig", "FleetMembership",
+           "FleetReplica", "FleetRouter", "FleetSignals",
+           "JournalReader", "JournalWriter", "Mailbox",
+           "MigrationReport", "ProcessFleetRouter", "REPLICA_ROLE",
+           "ReplicaAgent", "fleet_paths", "readmit_entries"]

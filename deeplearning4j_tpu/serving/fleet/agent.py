@@ -1,0 +1,312 @@
+"""ReplicaAgent: one GenerationEngine as its own fleet process.
+
+The cross-process fleet's replica half: an agent wraps ONE engine and
+exposes it to an out-of-process router purely through the shared
+filesystem —
+
+- a **lease heartbeat** stamped ``role="replica"``
+  (``membership.AGENT_ROLE``) through the same
+  ``resilience/elastic.py`` ledger the elastic trainer's ranks beat
+  on, advertising the agent's pid; an expired lease IS the death
+  signal (a ``kill -9``'d process simply stops beating — there is no
+  cooperative shutdown path to rely on);
+- a **mailbox consumer**: admission/migration commands carry
+  ``RequestLedgerEntry.payload()`` wire forms, deduped by
+  ``(request id, attempt)`` — at-least-once delivery made effectively
+  exactly-once — and admitted through the ONE engine re-admission
+  path (``admit_from_ledger``: streamed entries re-prime
+  ``ids[:-1]`` with their pending token and restored rng, fresh
+  entries admit normally). Undecodable command files are quarantined
+  by the mailbox, never crashing this loop;
+- a **journal publisher**: after every engine step the agent writes
+  one ``tok`` line per progressed request — the step's new tokens,
+  their absolute indices, and the request's post-step rng state (one
+  line = one consistency unit) — plus ``done``/``nack`` lines, which
+  the router relays into the caller's local ``GenerationStream``
+  handles.
+
+The agent drives ``engine.step()`` from its OWN loop (never
+``engine.start()``): between steps the engine is quiescent, so the
+(committed ids, rng state) pair each journal line snapshots is exactly
+consistent — the property that makes a survivor's re-prime
+bit-identical. Telemetry rides the shared ``dl4jtpu_fleet_transport_*``
+series and the ``transport`` event category.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Dict, Optional
+
+from deeplearning4j_tpu.monitoring.events import emit as emit_event
+from deeplearning4j_tpu.monitoring.metrics import (
+    MetricsRegistry, global_registry)
+from deeplearning4j_tpu.serving.fleet import transport
+from deeplearning4j_tpu.serving.fleet.membership import (
+    AGENT_ROLE, FleetMembership)
+from deeplearning4j_tpu.serving.health import (
+    FLEET_TRANSPORT_COMMANDS, FLEET_TRANSPORT_DUPLICATES,
+    FLEET_TRANSPORT_QUARANTINED)
+from deeplearning4j_tpu.serving.request import (
+    RequestLedgerEntry, rng_state_payload)
+
+log = logging.getLogger(__name__)
+
+__all__ = ["ReplicaAgent"]
+
+
+class _Tracked:
+    """One in-flight request the agent journals progress for."""
+
+    __slots__ = ("request", "attempt", "emitted")
+
+    def __init__(self, request, attempt: int, emitted: int):
+        self.request = request
+        self.attempt = int(attempt)
+        self.emitted = int(emitted)     # generated tokens journaled
+
+
+class ReplicaAgent:
+    """One engine + lease + mailbox + journal = one fleet process.
+
+    Drive it with :meth:`run` (the worker entrypoint's loop) or
+    manually with :meth:`poll_once` + :meth:`step` (the deterministic
+    in-process test shape — same transport mechanics, no subprocess).
+    """
+
+    def __init__(self, engine, root: str, rid: int, *,
+                 ttl: float = 2.0,
+                 status_interval_s: float = 0.1,
+                 registry: Optional[MetricsRegistry] = None,
+                 label: str = "fleet"):
+        self.engine = engine
+        self.rid = int(rid)
+        self.root = root
+        paths = transport.fleet_paths(root)
+        engine.replica_tag = self.rid
+        self.membership = FleetMembership(
+            paths["leases"], ttl=ttl, role=AGENT_ROLE,
+            extra={"pid": os.getpid()})
+        self.mailbox = transport.Mailbox(root, self.rid)
+        self.journal = transport.JournalWriter(root, self.rid)
+        self.status = transport.AgentStatus(root)
+        self.status_interval_s = float(status_interval_s)
+        self._last_status_t = 0.0
+        self._label = label
+        self._inflight: Dict[str, _Tracked] = {}
+        self._seen: set = set()          # (request id, attempt) dedupe
+        self._shutdown = False
+        self.duplicates = 0
+        self.commands = 0
+        #: compile count recorded by :meth:`mark_warm` — the status
+        #: file reports compiles SINCE warmup, the cross-process form
+        #: of the zero-retrace pin (a parent test can't read a child's
+        #: in-process counter)
+        self._warm_compiles: Optional[float] = None
+        r = registry or global_registry()
+        lab = dict(fleet=self._label, replica=str(self.rid))
+        self._cmd_c = r.counter(
+            FLEET_TRANSPORT_COMMANDS, "Mailbox commands consumed, "
+            "by kind", ("fleet", "replica", "kind"))
+        self._dup_c = r.counter(
+            FLEET_TRANSPORT_DUPLICATES, "Duplicate deliveries dropped "
+            "by request-id dedupe", ("fleet", "replica")).labels(**lab)
+        self._quar_c = r.counter(
+            FLEET_TRANSPORT_QUARANTINED, "Torn/undecodable command "
+            "files quarantined", ("fleet", "replica")).labels(**lab)
+        self._quarantined_seen = 0
+        self.membership.join(self.rid)
+        self.write_status()
+
+    # -- the zero-retrace bookkeeping ----------------------------------
+    @staticmethod
+    def _compile_total() -> float:
+        from deeplearning4j_tpu.monitoring import runtime
+        c = global_registry().get(runtime.COMPILE_COUNTER)
+        return 0.0 if c is None else c.total()
+
+    def mark_warm(self) -> None:
+        """Record the post-warmup compile count; the status file then
+        advertises ``compiles_since_warm`` (must stay 0 — re-primes
+        land in warm buckets)."""
+        self._warm_compiles = self._compile_total()
+
+    # -- status advertisement ------------------------------------------
+    def status_payload(self) -> dict:
+        out = {"rid": self.rid, "pid": os.getpid(),
+               "ts": time.time(),
+               "healthy": self.engine.is_healthy(),
+               "ready": self.engine.is_ready(),
+               "load": self.engine.load_stats(),
+               "inflight": len(self._inflight),
+               "commands": self.commands,
+               "duplicates": self.duplicates,
+               "quarantined": len(self.mailbox.quarantined())}
+        kv = self.engine.health().get("kv_pages")
+        if kv:
+            out["kv_page_size"] = kv["page_size"]
+        if self._warm_compiles is not None:
+            out["compiles_since_warm"] = \
+                self._compile_total() - self._warm_compiles
+        return out
+
+    def write_status(self, force: bool = True) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_status_t \
+                < self.status_interval_s:
+            return
+        self._last_status_t = now
+        self.status.write(self.rid, self.status_payload())
+
+    # -- the command loop ----------------------------------------------
+    def poll_once(self) -> int:
+        """Consume every pending mailbox command; returns how many were
+        processed. Never raises on bad input — a torn command was
+        quarantined by the mailbox before this sees it."""
+        before = len(self.mailbox.quarantined())
+        cmds = self.mailbox.receive()
+        newly_quarantined = len(self.mailbox.quarantined()) - before
+        if newly_quarantined > 0:
+            self._quar_c.inc(newly_quarantined)
+            emit_event("transport", "quarantine", replica=self.rid,
+                       count=newly_quarantined)
+        for _, cmd in cmds:
+            self.commands += 1
+            kind = str(cmd.get("kind"))
+            self._cmd_c.labels(fleet=self._label,
+                               replica=str(self.rid), kind=kind).inc()
+            if kind == transport.CMD_ADMIT:
+                self._handle_admit(cmd)
+            elif kind == transport.CMD_REVOKE:
+                self._handle_revoke(cmd)
+            elif kind == transport.CMD_SHUTDOWN:
+                self._shutdown = True
+            else:
+                log.warning("agent %d: unknown command kind %r "
+                            "ignored", self.rid, kind)
+        return len(cmds)
+
+    def _handle_admit(self, cmd: dict) -> None:
+        req_id = str(cmd.get("req"))
+        attempt = int(cmd.get("attempt", 0))
+        key = (req_id, attempt)
+        if key in self._seen:
+            # at-least-once delivery: the SAME (request, attempt) may
+            # arrive twice; admission must be idempotent
+            self.duplicates += 1
+            self._dup_c.inc()
+            emit_event("transport", "duplicate", replica=self.rid,
+                       req=req_id, attempt=attempt)
+            return
+        self._seen.add(key)
+        try:
+            entry = RequestLedgerEntry.from_payload(cmd["entry"])
+        except (KeyError, ValueError, TypeError) as e:
+            # a well-formed envelope around a bad payload: nack it so
+            # the router resolves the caller instead of hanging
+            self.journal.append([{"kind": transport.EV_NACK,
+                                  "req": req_id, "attempt": attempt,
+                                  "error": repr(e)}])
+            emit_event("transport", "nack", replica=self.rid,
+                       req=req_id, error=repr(e))
+            return
+        req = entry.request
+        rec = _Tracked(req, attempt,
+                       emitted=len(req.handle.generated))
+        try:
+            self.engine.admit_from_ledger(
+                [entry], where="over the fleet transport")
+        except Exception as e:      # noqa: BLE001 — nack, never crash
+            # EngineShutdown (draining/broken) or any admission fault:
+            # the router re-places on another replica; the agent's
+            # poll loop must survive every command
+            self.journal.append([{"kind": transport.EV_NACK,
+                                  "req": req_id, "attempt": attempt,
+                                  "error": repr(e)}])
+            emit_event("transport", "nack", replica=self.rid,
+                       req=req_id, error=repr(e))
+            return
+        emit_event("transport", "admit", replica=self.rid, req=req_id,
+                   attempt=attempt, streamed=entry.streamed)
+        self._inflight[req_id] = rec
+        if req.handle.done:
+            # resolved during admission (expired deadline, cancel):
+            # publish the terminal event right away
+            self.publish_progress()
+
+    def _handle_revoke(self, cmd: dict) -> None:
+        req_id = str(cmd.get("req"))
+        attempt = int(cmd.get("attempt", 0))
+        rec = self._inflight.get(req_id)
+        if rec is None or rec.attempt != attempt:
+            return                      # stale fence: nothing to do
+        rec.request.handle.cancel()
+        emit_event("transport", "revoke", replica=self.rid,
+                   req=req_id, attempt=attempt)
+
+    # -- the journal publisher -----------------------------------------
+    def publish_progress(self) -> int:
+        """Journal every tracked request's new tokens (absolute
+        indices + post-step rng state, one line per request) and any
+        retirements; returns the number of events written."""
+        events = []
+        done_ids = []
+        for req_id, rec in self._inflight.items():
+            handle = rec.request.handle
+            gen = handle.generated
+            if len(gen) > rec.emitted:
+                events.append({
+                    "kind": transport.EV_TOK, "req": req_id,
+                    "attempt": rec.attempt, "start": rec.emitted,
+                    "toks": gen[rec.emitted:],
+                    "rng": rng_state_payload(rec.request.rng)})
+                rec.emitted = len(gen)
+            if handle.done:
+                err = handle.error
+                events.append({
+                    "kind": transport.EV_DONE, "req": req_id,
+                    "attempt": rec.attempt,
+                    "reason": handle.finish_reason,
+                    "error": None if err is None else repr(err)})
+                done_ids.append(req_id)
+        for req_id in done_ids:
+            del self._inflight[req_id]
+        return self.journal.append(events)
+
+    # -- driving -------------------------------------------------------
+    def step(self) -> bool:
+        """One engine cycle + journal flush (the in-process drive)."""
+        progressed = self.engine.step()
+        self.publish_progress()
+        self.write_status(force=False)
+        return progressed
+
+    def run(self, idle_sleep_s: float = 0.005,
+            step_delay_s: float = 0.0) -> None:
+        """The worker-process main loop: poll the mailbox, step the
+        engine, publish, until a ``shutdown`` command arrives.
+        `step_delay_s` throttles progressing steps — the kill-mid-trace
+        tests' pacing knob (a tiny warm model otherwise finishes a
+        whole trace inside one observer poll interval)."""
+        while not self._shutdown:
+            handled = self.poll_once()
+            progressed = self.step()
+            if progressed and step_delay_s > 0:
+                time.sleep(step_delay_s)
+            if not handled and not progressed:
+                time.sleep(idle_sleep_s)
+        self.close()
+
+    def close(self) -> None:
+        """Orderly leave: withdraw the lease, flush status, shut the
+        engine down. (A crash never runs this — that is the point.)"""
+        self._shutdown = True
+        try:
+            self.write_status()
+        except OSError:
+            pass
+        self.membership.stop()
+        self.journal.close()
+        self.engine.shutdown()

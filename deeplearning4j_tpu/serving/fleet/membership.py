@@ -34,11 +34,18 @@ from deeplearning4j_tpu.resilience.elastic import (
 
 log = logging.getLogger(__name__)
 
-__all__ = ["FleetMembership", "REPLICA_ROLE"]
+__all__ = ["AGENT_ROLE", "FleetMembership", "REPLICA_ROLE"]
 
 #: the lease role serving replicas beat with (train ranks carry none
 #: or their own role; live_ranks(role=REPLICA_ROLE) sees only replicas)
 REPLICA_ROLE = "serving"
+
+#: the lease role CROSS-PROCESS replica agents beat with
+#: (``serving/fleet/agent.py``): one OS process per replica, discovered
+#: by an out-of-process router purely through the lease ledger —
+#: distinct from REPLICA_ROLE so an in-process fleet and a process
+#: fleet can share one ledger directory without miscounting each other
+AGENT_ROLE = "replica"
 
 
 class FleetMembership:
@@ -58,10 +65,14 @@ class FleetMembership:
     """
 
     def __init__(self, root: Optional[str] = None, ttl: float = 2.0,
-                 role: str = REPLICA_ROLE):
+                 role: str = REPLICA_ROLE,
+                 extra: Optional[Dict] = None):
         self.root = root
         self.ttl = float(ttl)
         self.role = role
+        #: advertisement merged into every joined lease's beats (a
+        #: cross-process agent publishes its pid here)
+        self.extra = dict(extra) if extra else None
         self._mu = threading.Lock()
         self._leases: Dict[int, LeaseLedger] = {}
         self._reader: Optional[LeaseLedger] = None
@@ -91,7 +102,7 @@ class FleetMembership:
             if rid in self._leases:
                 return
             lease = LeaseLedger(self.root, rank=int(rid), ttl=self.ttl,
-                                role=self.role)
+                                role=self.role, extra=self.extra)
             lease.start(self.generation)
             self._leases[rid] = lease
 
@@ -108,6 +119,22 @@ class FleetMembership:
         the chaos seam: ``lease.stall()`` simulates a hung replica."""
         with self._mu:
             return self._leases.get(rid)
+
+    # -- discovery (the out-of-process router's membership read) -------
+    def live_ranks(self) -> List[int]:
+        """Ranks with a live lease in this membership's role (empty
+        without a root) — how a router that holds NO engine references
+        discovers which replica agents exist at all."""
+        if self._reader is None:
+            return []
+        return self._reader.live_ranks(role=self.role)
+
+    def live_leases(self) -> Dict[int, Dict]:
+        """Live ranks with their latest beat payloads (advertised
+        ``extra`` fields included; empty without a root)."""
+        if self._reader is None:
+            return {}
+        return self._reader.live_leases(role=self.role)
 
     # -- death detection -----------------------------------------------
     def expired(self, rids: Sequence[int]) -> List[int]:

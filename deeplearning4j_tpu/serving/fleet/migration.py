@@ -45,13 +45,23 @@ from deeplearning4j_tpu.serving.request import RequestLedgerEntry
 
 log = logging.getLogger(__name__)
 
-__all__ = ["MigrationReport", "readmit_entries"]
+__all__ = ["MigrationReport", "readmit_entries", "record_hop"]
 
 #: migration cause labels (the ``dl4jtpu_fleet_migrations_total`` label
 #: vocabulary; also stamped into every report)
 CAUSE_DEATH = "death"
 CAUSE_SCALE_IN = "scale_in"
 CAUSE_OVERLOAD = "overload"
+
+
+def record_hop(request, source, target, cause: str) -> None:
+    """Stamp one migration hop on the request's OWN trace: a migrated
+    stream's post-mortem must name both replicas even after the source
+    object (or source PROCESS) is gone. One helper shared by the
+    in-process re-admission path and the cross-process router's
+    re-placement, so the trace vocabulary cannot fork."""
+    request.trace.record("migrate", source=source, target=target,
+                         cause=cause)
 
 
 @dataclasses.dataclass
@@ -125,12 +135,9 @@ def readmit_entries(entries: Sequence[RequestLedgerEntry],
                 report.admitted += took
                 report.per_target[rep.rid] = \
                     report.per_target.get(rep.rid, 0) + took
-                # the hop, on the request's OWN trace: a migrated
-                # stream's post-mortem must name both replicas even
-                # after the source object is gone. Recorded after the
-                # target accepted (a refused target is not a hop).
-                req.trace.record("migrate", source=source,
-                                 target=rep.rid, cause=cause)
+                # recorded after the target accepted (a refused
+                # target is not a hop)
+                record_hop(req, source, rep.rid, cause)
             elif req.handle.done:
                 report.resolved_dead += 1   # cancel/deadline resolved
             break

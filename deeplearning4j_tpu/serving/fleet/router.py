@@ -46,6 +46,7 @@ import dataclasses
 import logging
 import threading
 import time
+import uuid
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -55,20 +56,26 @@ from deeplearning4j_tpu.monitoring.events import (
 from deeplearning4j_tpu.monitoring.metrics import (
     MetricsRegistry, global_registry)
 from deeplearning4j_tpu.serving.errors import (
-    EngineShutdown, NoReplicaAvailable, ServingOverloaded,
-    ServingQueueFull)
+    EngineShutdown, InferenceTimeout, NoReplicaAvailable,
+    RequestCancelled, ServingOverloaded, ServingQueueFull)
 from deeplearning4j_tpu.serving.fleet import migration as mig
+from deeplearning4j_tpu.serving.fleet import transport
 from deeplearning4j_tpu.serving.fleet.autoscale import (
     AutoscaleConfig, FleetAutoscaler, FleetSignals)
-from deeplearning4j_tpu.serving.fleet.membership import FleetMembership
+from deeplearning4j_tpu.serving.fleet.membership import (
+    AGENT_ROLE, FleetMembership)
 from deeplearning4j_tpu.serving.health import (
     FLEET_AFFINITY_HITS, FLEET_AFFINITY_MISSES, FLEET_DEAD_REPLICAS,
     FLEET_GENERATION, FLEET_MIGRATED_REQUESTS, FLEET_MIGRATIONS,
-    FLEET_REPLICAS, FLEET_ROUTED, FLEET_SCALE_EVENTS, scrape_probe)
+    FLEET_RELAYED_TOKENS, FLEET_REPLACED_REQUESTS, FLEET_REPLICAS,
+    FLEET_ROUTED, FLEET_SCALE_EVENTS, scrape_probe)
+from deeplearning4j_tpu.serving.request import (
+    GenerationRequest, RequestLedgerEntry)
 
 log = logging.getLogger(__name__)
 
-__all__ = ["FleetConfig", "FleetReplica", "FleetRouter"]
+__all__ = ["FleetConfig", "FleetReplica", "FleetRouter",
+           "ProcessFleetRouter"]
 
 
 @dataclasses.dataclass
@@ -675,4 +682,537 @@ class FleetRouter:
             "last_events": [
                 {"category": e.category, "name": e.name, "wall": e.wall,
                  "attrs": dict(e.attrs)} for e in self.timeline(10)],
+        }
+
+
+# ----------------------------------------------------------------------
+# the cross-process router
+# ----------------------------------------------------------------------
+
+class _RouteRecord:
+    """Router-side bookkeeping for one outstanding cross-process
+    request: the LOCAL ``GenerationRequest`` (its handle is the
+    caller's stream, and every relayed token accumulates in it — which
+    makes it the router's authoritative committed-ids record, usable
+    for re-placement with NO cooperation from a dead replica), the
+    serving replica + ``attempt`` fence, and the last journaled
+    post-step rng state (the other half of the re-prime pair)."""
+
+    __slots__ = ("request", "req_id", "rid", "attempt", "rng_state",
+                 "excluded", "revoked")
+
+    def __init__(self, request: GenerationRequest, req_id: str):
+        self.request = request
+        self.req_id = req_id
+        self.rid: Optional[int] = None
+        self.attempt = 0
+        self.rng_state: Optional[dict] = None
+        self.excluded: set = set()   # rids that NACKed this request
+        self.revoked = False         # caller-cancel already forwarded
+
+
+#: remote failure reconstruction: a journaled ``done`` event carries
+#: ``repr(error)``; the relay rebuilds the matching serving error type
+#: so a caller's except clauses work identically cross-process
+_REMOTE_ERRORS = {cls.__name__: cls for cls in
+                  (EngineShutdown, InferenceTimeout,
+                   NoReplicaAvailable, RequestCancelled,
+                   ServingOverloaded, ServingQueueFull)}
+
+
+def _rebuild_error(text: Optional[str]) -> Optional[BaseException]:
+    """``repr(exc)`` from a journal event -> a raisable exception of
+    the same serving type (RuntimeError for anything unrecognized —
+    the message still carries the original repr's payload)."""
+    if text is None:
+        return None
+    name, _, rest = text.partition("(")
+    msg = rest[:-1] if rest.endswith(")") else rest
+    if len(msg) >= 2 and msg[0] in "'\"" and msg[-1] == msg[0]:
+        msg = msg[1:-1]
+    return _REMOTE_ERRORS.get(name, RuntimeError)(msg)
+
+
+class ProcessFleetRouter:
+    """Out-of-process fleet router: replicas are OS processes, reached
+    only through the shared filesystem.
+
+    The :class:`FleetRouter` holds engine references; this router holds
+    NONE. Each replica is a ``serving/fleet/agent.ReplicaAgent`` in its
+    own process (``serving/fleet/worker.py`` entrypoint), and the
+    router's whole view of the fleet is
+
+    - **discovery**: live lease ranks stamped ``role="replica"``
+      (``membership.AGENT_ROLE``) in ``<root>/leases/`` — a replica
+      that was ``kill -9``'d simply stops beating;
+    - **placement**: the agents' atomic-rename status files (load,
+      health, KV page size) score the same affinity-first /
+      least-loaded formula as the in-process router;
+    - **submit**: a LOCAL ``GenerationRequest`` is built (its handle is
+      what the caller iterates), captured as a
+      ``RequestLedgerEntry.payload()`` and written into the chosen
+      agent's mailbox as an ``admit`` command (atomic rename;
+      at-least-once — the agent dedupes by ``(request id, attempt)``);
+    - **relay**: agent journals stream committed-token batches back;
+      :meth:`relay` pushes them into the local handles
+      (``relay_token`` — index-deduped, so a re-placed survivor
+      re-emitting an overlap is harmless) and adopts each line's
+      post-step rng state;
+    - **death -> re-place**: an expired lease (or an unhealthy status)
+      declares the replica dead; its outstanding requests are
+      re-captured FROM THE LOCAL HANDLES (committed ids) + the last
+      journaled rng state, fenced with ``attempt+1`` (a revoke goes to
+      the old mailbox first, so a stalled-lease-but-ALIVE process
+      cancels instead of double-serving), and re-admitted on survivors
+      through the same PR 13 re-prime path — every stream completes
+      bit-identically to an unperturbed single-engine run, with no
+      cooperation from the corpse (test-pinned, ``kill -9`` included).
+
+    Drive it manually (:meth:`relay` / :meth:`poll` — deterministic
+    tests drive the agents in-process too) or :meth:`start` the poll
+    thread against real worker processes."""
+
+    def __init__(self, root: str, *,
+                 config: Optional[FleetConfig] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 name: str = "procfleet",
+                 chaos: Optional[object] = None):
+        self.root = root
+        self.config = config if config is not None else FleetConfig()
+        self._label = name
+        #: mailbox chaos seam, forwarded to every send-side Mailbox
+        #: (resilience/chaos.py transport injectors)
+        self.chaos = chaos
+        paths = transport.fleet_paths(root)
+        self.membership = FleetMembership(
+            paths["leases"], ttl=self.config.lease_ttl_s,
+            role=AGENT_ROLE)
+        self.status = transport.AgentStatus(root)
+        self.journal = transport.JournalReader(root)
+        self._mu = threading.RLock()
+        self._mail: Dict[int, transport.Mailbox] = {}
+        self._routes: Dict[str, _RouteRecord] = {}
+        self._affinity: "OrderedDict[Tuple, int]" = OrderedDict()
+        self._block: Optional[int] = self.config.affinity_block
+        self._stop = threading.Event()
+        self._poll_thread: Optional[threading.Thread] = None
+        self.replaced_requests = 0
+        self.dead_replicas = 0
+        self._register_metrics(registry)
+
+    # ------------------------------------------------------------------
+    # telemetry
+    # ------------------------------------------------------------------
+    def _register_metrics(self, registry) -> None:
+        r = registry or global_registry()
+        lab = dict(fleet=self._label)
+        r.gauge(FLEET_REPLICAS, "Live replicas behind the fleet router",
+                ("fleet",)).set_function(
+            scrape_probe(self, lambda s: len(s.live_replicas())), **lab)
+        r.gauge(FLEET_GENERATION, "Fleet membership generation",
+                ("fleet",)).set_function(
+            scrape_probe(self, lambda s: s.membership.generation), **lab)
+        self._routed = r.counter(
+            FLEET_ROUTED, "Requests routed, by replica",
+            ("fleet", "replica"))
+        self._affinity_hits = r.counter(
+            FLEET_AFFINITY_HITS, "Placements that followed a warm "
+            "prefix-affinity mapping", ("fleet",)).labels(**lab)
+        self._affinity_misses = r.counter(
+            FLEET_AFFINITY_MISSES, "Placements that fell back to "
+            "least-loaded scoring", ("fleet",)).labels(**lab)
+        self._dead_c = r.counter(
+            FLEET_DEAD_REPLICAS, "Replicas declared dead (health down "
+            "or lease expired)", ("fleet",)).labels(**lab)
+        self._relayed_c = r.counter(
+            FLEET_RELAYED_TOKENS, "Committed tokens relayed from agent "
+            "journals into local stream handles", ("fleet",)
+        ).labels(**lab)
+        self._replaced_c = r.counter(
+            FLEET_REPLACED_REQUESTS, "In-flight requests re-placed "
+            "onto a survivor after replica death or nack",
+            ("fleet",)).labels(**lab)
+
+    # ------------------------------------------------------------------
+    # discovery + placement (status files instead of engine accessors)
+    # ------------------------------------------------------------------
+    def _mailbox(self, rid: int) -> transport.Mailbox:
+        with self._mu:
+            box = self._mail.get(rid)
+            if box is None:
+                box = transport.Mailbox(self.root, rid,
+                                        chaos=self.chaos)
+                self._mail[rid] = box
+            return box
+
+    def live_replicas(self) -> List[int]:
+        """Replica agents with a live lease — the discovery read (no
+        engine references anywhere in this router)."""
+        return sorted(self.membership.live_ranks())
+
+    def _candidates(self, exclude) -> List[Tuple[int, dict]]:
+        statuses = self.status.read_all()
+        out = []
+        for rid in self.live_replicas():
+            if rid in exclude:
+                continue
+            st = statuses.get(rid)
+            # no status yet = still booting; unhealthy = don't place
+            if st is None or not st.get("healthy", False):
+                continue
+            out.append((rid, st))
+        return out
+
+    def _default_block(self) -> int:
+        """Affinity fingerprint length: the agents' advertised KV page
+        size (16 when unpaged/unknown). Resolved once a status exists,
+        like the in-process router resolves it from the first
+        replica's health payload."""
+        if self._block is None:
+            statuses = sorted(self.status.read_all().items())
+            if statuses:
+                self._block = int(
+                    statuses[0][1].get("kv_page_size", 16))
+        return self._block if self._block is not None else 16
+
+    def _fingerprint(self, prompt) -> Optional[Tuple]:
+        if not self.config.affinity:
+            return None
+        bs = self._default_block()
+        if len(prompt) <= bs:
+            return None
+        return tuple(prompt[:bs])
+
+    def _score(self, st: dict) -> float:
+        """The in-process router's least-loaded formula over a STATUS
+        payload: occupancy + backlog per slot, discounted by free KV
+        headroom (``load`` is the agent's ``load_stats()`` echo)."""
+        load = st.get("load") or {}
+        occ = (load.get("queue_depth", 0) + load.get("active_slots", 0)) \
+            / max(1, load.get("slots", 1))
+        return occ - self.config.free_weight \
+            * load.get("free_page_frac", 0.0)
+
+    def _place(self, prompt, exclude=()) -> int:
+        """Pick the replica id for `prompt`: affinity owner when live
+        and routable, else best status score (rid breaks score ties —
+        the choice must be deterministic across router restarts).
+        Raises NoReplicaAvailable when nothing routable remains."""
+        with self._mu:
+            cands = self._candidates(exclude)
+            if not cands:
+                raise NoReplicaAvailable(
+                    f"fleet {self._label}: no routable replica agent "
+                    f"(live {self.live_replicas()}, "
+                    f"excluded {sorted(exclude)})")
+            ready = [c for c in cands if c[1].get("ready")] or cands
+            fp = self._fingerprint(prompt)
+            if fp is not None:
+                rid = self._affinity.get(fp)
+                if rid is not None and any(r == rid for r, _ in ready):
+                    self._affinity.move_to_end(fp)
+                    self._affinity_hits.inc()
+                    return rid
+            best = min(ready,
+                       key=lambda c: (self._score(c[1]), c[0]))[0]
+            if fp is not None:
+                self._affinity[fp] = best
+                self._affinity.move_to_end(fp)
+                while len(self._affinity) \
+                        > self.config.affinity_capacity:
+                    self._affinity.popitem(last=False)
+                self._affinity_misses.inc()
+            return best
+
+    # ------------------------------------------------------------------
+    # the submit/stream API (mirrors GenerationEngine.submit)
+    # ------------------------------------------------------------------
+    def submit(self, prompt, steps: int, *, temperature: float = 1.0,
+               top_k: Optional[int] = None,
+               top_p: Optional[float] = None,
+               stop_tokens=(), rng=None,
+               timeout: Optional[float] = None, priority: int = 0):
+        """Route one prompt to a replica PROCESS; returns a local
+        ``GenerationStream`` the relay feeds (same caller contract as
+        ``GenerationEngine.submit`` — iterate it, ``result()`` it,
+        ``cancel()`` it). The deadline stays anchored on THIS process's
+        monotonic clock; the wire form carries remaining budget."""
+        prompt = [int(t) for t in prompt]
+        deadline = None if timeout is None else \
+            time.monotonic() + float(timeout)
+        req = GenerationRequest(
+            prompt, steps, temperature=temperature, top_k=top_k,
+            top_p=top_p, stop_tokens=stop_tokens, rng=rng,
+            deadline=deadline, priority=priority)
+        rid = self._place(prompt)
+        rec = _RouteRecord(req, uuid.uuid4().hex)
+        with self._mu:
+            self._routes[rec.req_id] = rec
+        self._send_to(rec, rid)
+        return req.handle
+
+    def _send_to(self, rec: _RouteRecord, rid: int) -> None:
+        """Capture the LOCAL request as a ledger payload and mail it to
+        `rid` under the record's current attempt fence."""
+        rec.rid = rid
+        phase = "active" if rec.request.streamed else "queued"
+        entry = RequestLedgerEntry.capture(rec.request, phase)
+        self._mailbox(rid).send({
+            "kind": transport.CMD_ADMIT, "req": rec.req_id,
+            "attempt": rec.attempt, "entry": entry.payload()})
+        self._routed.labels(fleet=self._label,
+                            replica=str(rid)).inc()
+        emit_event("transport", "route", fleet=self._label,
+                   replica=rid, req=rec.req_id, attempt=rec.attempt,
+                   streamed=entry.streamed)
+
+    # ------------------------------------------------------------------
+    # the relay (journal -> local handles)
+    # ------------------------------------------------------------------
+    def relay(self) -> int:
+        """Drain every agent journal and apply the events to the local
+        stream handles; forward any caller-side cancels as revoke
+        commands. Returns the number of events applied."""
+        with self._mu:
+            rids = {rec.rid for rec in self._routes.values()
+                    if rec.rid is not None}
+        rids.update(self.live_replicas())
+        n = 0
+        for rid in sorted(rids):
+            for ev in self.journal.poll(rid):
+                n += 1
+                self._apply_event(rid, ev)
+        self._propagate_cancels()
+        return n
+
+    def _apply_event(self, rid: int, ev: dict) -> None:
+        req_id = str(ev.get("req"))
+        attempt = int(ev.get("attempt", 0))
+        with self._mu:
+            rec = self._routes.get(req_id)
+        if rec is None or rec.rid != rid or rec.attempt != attempt:
+            return    # stale fence: a revoked attempt kept talking
+        handle = rec.request.handle
+        kind = ev.get("kind")
+        if kind == transport.EV_TOK:
+            start = int(ev.get("start", 0))
+            toks = [int(t) for t in ev.get("toks", ())]
+            for i, tok in enumerate(toks):
+                # absolute-index dedupe: a survivor bit-identically
+                # regenerating tokens the corpse already published
+                # re-emits an overlap; only the tip extends the handle
+                if start + i == len(handle.generated):
+                    handle.relay_token(tok)
+                    self._relayed_c.inc()
+            if start + len(toks) == len(handle.generated):
+                # this line's post-step rng matches OUR tip exactly:
+                # adopt it as the re-prime state for a later death
+                rec.rng_state = ev.get("rng")
+        elif kind == transport.EV_DONE:
+            handle.relay_finish(str(ev.get("reason") or "stop"),
+                                error=_rebuild_error(ev.get("error")))
+            with self._mu:
+                self._routes.pop(req_id, None)
+        elif kind == transport.EV_NACK:
+            # the target refused the admission (shutting down, or a
+            # payload it could not decode): try the rest of the fleet,
+            # excluding every nacker so a persistent refusal converges
+            # on NoReplicaAvailable instead of ping-ponging
+            rec.excluded.add(rid)
+            emit_event("transport", "nack", fleet=self._label,
+                       replica=rid, req=req_id, error=ev.get("error"))
+            self._replace_record(rec, rec.excluded,
+                                 cause=mig.CAUSE_DEATH, source=rid)
+
+    def _propagate_cancels(self) -> None:
+        with self._mu:
+            recs = [r for r in self._routes.values()
+                    if r.request.handle.cancelled and not r.revoked
+                    and not r.request.handle.done
+                    and r.rid is not None]
+            for rec in recs:
+                rec.revoked = True
+        for rec in recs:
+            self._mailbox(rec.rid).send({
+                "kind": transport.CMD_REVOKE, "req": rec.req_id,
+                "attempt": rec.attempt})
+
+    # ------------------------------------------------------------------
+    # death detection -> corpse-free re-placement
+    # ------------------------------------------------------------------
+    def poll(self) -> dict:
+        """One control-plane cycle: relay pending journal events, then
+        declare dead agents (lease expired, or status-unhealthy) and
+        re-place their outstanding requests onto survivors. Returns a
+        summary dict (tests/bench introspection)."""
+        out = {"dead": [], "replaced": 0}
+        self.relay()
+        with self._mu:
+            routed = sorted({rec.rid for rec in self._routes.values()
+                             if rec.rid is not None})
+        if not routed:
+            return out
+        live = set(self.membership.live_ranks())
+        statuses = self.status.read_all()
+        for rid in routed:
+            st = statuses.get(rid)
+            unhealthy = st is not None and not st.get("healthy", True)
+            if rid in live and not unhealthy:
+                continue
+            out["dead"].append(rid)
+            self.dead_replicas += 1
+            self._dead_c.inc()
+            emit_event("fleet", "replica_dead", fleet=self._label,
+                       replica=rid, lease_expired=rid not in live)
+            out["replaced"] += self._replace_from(rid)
+        return out
+
+    def _replace_from(self, rid: int) -> int:
+        """Re-place every route on dead replica `rid` — using only
+        state on THIS side of the transport (local handles + journaled
+        rng), because the corpse cannot be asked for anything."""
+        # drain the corpse's journal FIRST: every committed token it
+        # managed to publish narrows the regeneration window, and the
+        # last tok line's rng state is exactly the re-prime state
+        for ev in self.journal.poll(rid):
+            self._apply_event(rid, ev)
+        with self._mu:
+            victims = [rec for rec in self._routes.values()
+                       if rec.rid == rid]
+            # drop the dead owner's affinity mappings: the next request
+            # per fingerprint re-places (and re-warms) on a survivor
+            stale = [fp for fp, owner in self._affinity.items()
+                     if owner == rid]
+            for fp in stale:
+                del self._affinity[fp]
+        n = 0
+        box = self._mailbox(rid)
+        for rec in victims:
+            # fence FIRST: a stalled-lease-but-ALIVE process must stop
+            # serving the old attempt before a survivor starts the new
+            # one — its engine cancels on the revoke, and the relay
+            # ignores anything it still journals at the old attempt
+            box.send({"kind": transport.CMD_REVOKE,
+                      "req": rec.req_id, "attempt": rec.attempt})
+            n += self._replace_record(rec, {rid} | rec.excluded,
+                                      cause=mig.CAUSE_DEATH,
+                                      source=rid)
+        return n
+
+    def _replace_record(self, rec: _RouteRecord, exclude,
+                        cause: str, source) -> int:
+        req = rec.request
+        if req.handle.done:
+            with self._mu:
+                self._routes.pop(rec.req_id, None)
+            return 0
+        state = rec.rng_state
+        if state is not None:
+            # the LOCAL request's rng never advanced (the remote copy
+            # did the drawing): restore the last journaled post-step
+            # state so the capture below re-primes bit-identically —
+            # (committed ids from the handle, rng from the journal)
+            # is exactly the consistency unit one journal line carries
+            req.rng.bit_generator.state = state
+        try:
+            rid = self._place(req.prompt, exclude)
+        except NoReplicaAvailable as e:
+            # nobody can take it: terminal event on the local handle —
+            # every outstanding stream ends on SOME path
+            req.handle.relay_finish("error", e)
+            with self._mu:
+                self._routes.pop(rec.req_id, None)
+            return 0
+        rec.attempt += 1
+        self._send_to(rec, rid)
+        mig.record_hop(req, source, rid, cause)
+        self.replaced_requests += 1
+        self._replaced_c.inc()
+        emit_event("transport", "replace", fleet=self._label,
+                   req=rec.req_id, source=source, target=rid,
+                   cause=cause, attempt=rec.attempt)
+        return 1
+
+    # ------------------------------------------------------------------
+    # drive / lifecycle
+    # ------------------------------------------------------------------
+    def step(self) -> bool:
+        """One relay cycle (manual drive — the agents are stepped by
+        their own processes, or by the test in-process); True while
+        any relay event was applied."""
+        return self.relay() > 0
+
+    def outstanding(self) -> int:
+        with self._mu:
+            return len(self._routes)
+
+    def assignments(self) -> Dict[str, Tuple[int, int]]:
+        """Outstanding request id -> (replica, attempt) snapshot."""
+        with self._mu:
+            return {req_id: (rec.rid, rec.attempt)
+                    for req_id, rec in self._routes.items()}
+
+    def start(self) -> "ProcessFleetRouter":
+        """Background drive: relay + death-check at poll cadence."""
+        self._stop.clear()
+        if self._poll_thread is None \
+                or not self._poll_thread.is_alive():
+            def _run():
+                while not self._stop.wait(self.config.poll_interval_s):
+                    try:
+                        self.poll()
+                    except Exception:   # noqa: BLE001 — keep polling
+                        log.exception(
+                            "process-fleet poll cycle failed")
+            self._poll_thread = threading.Thread(
+                target=_run, daemon=True,
+                name=f"procfleet-{self._label}")
+            self._poll_thread.start()
+        return self
+
+    def shutdown(self, stop_agents: bool = False) -> None:
+        """Stop the poll thread and resolve every still-outstanding
+        local handle with ``EngineShutdown`` (the no-hung-callers
+        contract). With `stop_agents` the live agents are mailed a
+        ``shutdown`` command too (the orderly whole-fleet stop — a
+        ``kill -9`` test never gets this)."""
+        self._stop.set()
+        t = self._poll_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=2 * self.config.poll_interval_s + 1)
+        if stop_agents:
+            for rid in self.live_replicas():
+                try:
+                    self._mailbox(rid).send(
+                        {"kind": transport.CMD_SHUTDOWN})
+                except OSError:
+                    pass
+        try:
+            self.relay()    # last drain: keep what already finished
+        except OSError:
+            pass
+        with self._mu:
+            recs, self._routes = list(self._routes.values()), {}
+        for rec in recs:
+            rec.request.handle.relay_finish(
+                "error", EngineShutdown(
+                    "fleet router shut down with the request still "
+                    "in flight"))
+        self.membership.stop()
+
+    # ------------------------------------------------------------------
+    # observability
+    # ------------------------------------------------------------------
+    def health(self) -> dict:
+        with self._mu:
+            affinity_entries = len(self._affinity)
+        return {
+            "live_replicas": self.live_replicas(),
+            "statuses": self.status.read_all(),
+            "generation": self.membership.generation,
+            "outstanding": self.outstanding(),
+            "replaced_requests": self.replaced_requests,
+            "dead_replicas": self.dead_replicas,
+            "journal_corrupt_lines": self.journal.corrupt,
+            "affinity_entries": affinity_entries,
         }

@@ -1,0 +1,355 @@
+"""Shared-filesystem transport for the cross-process serving fleet.
+
+The in-process fleet (PR 13) moves requests between replicas as
+``RequestLedgerEntry`` objects through direct engine references. This
+module gives the ledger's versioned JSON wire form
+(``RequestLedgerEntry.payload()``) a TRANSPORT, so a replica can be its
+own OS process and the router can live in another one, with nothing
+shared but a filesystem:
+
+- **Mailbox** (``<root>/mail/<rid>/``): the router→agent command
+  channel. One JSON file per command, written atomic-rename through
+  the ``resilience/durable.py`` primitives, so an agent (or a reader
+  that raced a ``kill -9``) never observes a torn command through the
+  NORMAL write path. Delivery is at-least-once: a writer that dies
+  between "wrote the file" and "recorded that it wrote the file" may
+  re-send, so every command carries the request id (+ an ``attempt``
+  fence) and the agent dedupes. A file that IS unreadable — a crashed
+  copy tool, a chaos-injected torn write — is moved to
+  ``quarantine/``, never crashing the poll loop and never re-read.
+- **StreamJournal** (``<root>/journal/agent_<rid>.jsonl``): the
+  agent→router event channel — an append-only JSONL stream of
+  committed-token batches and retirements. Each ``tok`` line carries
+  one request's NEW tokens for one engine step, their absolute indices
+  among the generated tokens, and the request's post-step rng state:
+  one line is one atomic consistency unit, so a line torn by
+  ``kill -9`` mid-append loses a whole (ids, rng) pair — the previous
+  line is still consistent, and a re-prime from it regenerates the
+  lost tokens bit-identically (the router's index dedupe drops any
+  overlap a survivor re-emits).
+- **status files** (``<root>/status/agent_<rid>.json``): each agent's
+  periodically refreshed load/health advertisement (atomic-rename),
+  which is how an out-of-process router scores placement without
+  ``load_stats()`` engine references.
+
+Layout under one fleet root::
+
+    <root>/leases/    lease_<rid>.json       (resilience/elastic.py)
+    <root>/mail/<rid>/cmd_*.json             router -> agent commands
+    <root>/mail/<rid>/quarantine/            torn/undecodable commands
+    <root>/journal/agent_<rid>.jsonl         agent -> router events
+    <root>/status/agent_<rid>.json           agent load advertisement
+
+Command envelope (the mailbox payload)::
+
+    {"kind": "admit",  "req": <id>, "attempt": <n>, "entry": <payload>}
+    {"kind": "revoke", "req": <id>, "attempt": <n>}   # fence a stale serve
+    {"kind": "shutdown"}
+
+``entry`` is exactly ``RequestLedgerEntry.payload()`` — the versioned
+wire form; nothing here re-encodes request state. Journal events::
+
+    {"kind": "tok",  "req": r, "attempt": a, "start": i,
+     "toks": [...], "rng": <bit-generator state>}
+    {"kind": "done", "req": r, "attempt": a, "reason": <finish_reason>,
+     "error": <repr or None>}
+    {"kind": "nack", "req": r, "attempt": a, "error": <repr>}
+
+See ARCHITECTURE.md "Cross-process fleet".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from deeplearning4j_tpu.resilience.durable import atomic_write_json
+
+__all__ = ["AgentStatus", "JournalReader", "JournalWriter", "Mailbox",
+           "fleet_paths"]
+
+#: command kinds the mailbox carries
+CMD_ADMIT = "admit"
+CMD_REVOKE = "revoke"
+CMD_SHUTDOWN = "shutdown"
+
+#: journal event kinds
+EV_TOK = "tok"
+EV_DONE = "done"
+EV_NACK = "nack"
+
+_CMD_PREFIX = "cmd_"
+_QUARANTINE = "quarantine"
+
+
+def fleet_paths(root: str) -> Dict[str, str]:
+    """The shared-root layout, resolved in ONE place: every component
+    (agent, router, worker entrypoint, tests) derives paths from here
+    so the on-disk contract cannot drift per caller."""
+    root = os.path.abspath(root)
+    return {
+        "root": root,
+        "leases": os.path.join(root, "leases"),
+        "mail": os.path.join(root, "mail"),
+        "journal": os.path.join(root, "journal"),
+        "status": os.path.join(root, "status"),
+    }
+
+
+class Mailbox:
+    """One replica agent's command directory.
+
+    The ROUTER holds a send-side Mailbox per discovered agent; the
+    AGENT holds the receive side for its own rid. Writers never touch
+    files in place: every send is a tmp-write + ``os.replace`` through
+    ``resilience/durable.atomic_write_json``, and names embed a
+    (wall-ns, pid, per-process seq) triple so concurrent senders never
+    collide and a sort-by-name read approximates send order. Order is a
+    courtesy, not a contract — dedupe + the ``attempt`` fence carry
+    correctness.
+    """
+
+    _seq_mu = threading.Lock()
+    _seq = 0
+
+    def __init__(self, root: str, rid: int,
+                 chaos: Optional[object] = None):
+        self.rid = int(rid)
+        self.path = os.path.join(fleet_paths(root)["mail"], str(self.rid))
+        self.quarantine_path = os.path.join(self.path, _QUARANTINE)
+        #: transport chaos seam (resilience/chaos.py mailbox
+        #: injectors): ``chaos.on_send(dirpath, name, data) -> bool``,
+        #: True = the injector handled (or withheld) delivery
+        self.chaos = chaos
+        os.makedirs(self.quarantine_path, exist_ok=True)
+
+    # -- send side (router) --------------------------------------------
+    @classmethod
+    def _next_name(cls) -> str:
+        with cls._seq_mu:
+            cls._seq += 1
+            seq = cls._seq
+        return (f"{_CMD_PREFIX}{time.time_ns():020d}_"
+                f"{os.getpid()}_{seq:06d}.json")
+
+    def send(self, cmd: dict) -> str:
+        """Deliver one command (atomic rename); returns the file name.
+        With a chaos injector attached the injector may take over the
+        delivery (torn write, duplication, delay)."""
+        name = self._next_name()
+        if self.chaos is not None:
+            data = (json.dumps(cmd, sort_keys=True) + "\n").encode()
+            if self.chaos.on_send(self.path, name, data):
+                return name
+        atomic_write_json(os.path.join(self.path, name), cmd)
+        return name
+
+    # -- receive side (agent) ------------------------------------------
+    def receive(self, max_n: Optional[int] = None
+                ) -> List[Tuple[str, dict]]:
+        """Consume pending commands in name order: parse, unlink,
+        return ``(name, command)`` pairs. An unreadable/undecodable
+        file is MOVED to ``quarantine/`` (counted by the agent's
+        telemetry) — a torn command must never crash the poll loop,
+        and must never be re-read as if it might heal."""
+        try:
+            names = sorted(n for n in os.listdir(self.path)
+                           if n.startswith(_CMD_PREFIX)
+                           and n.endswith(".json"))
+        except OSError:
+            return []
+        out: List[Tuple[str, dict]] = []
+        for name in names:
+            if max_n is not None and len(out) >= max_n:
+                break
+            path = os.path.join(self.path, name)
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    cmd = json.load(f)
+                if not isinstance(cmd, dict) or "kind" not in cmd:
+                    raise ValueError("command is not an envelope dict")
+            except (OSError, ValueError) as e:
+                self._quarantine(name, repr(e))
+                continue
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            out.append((name, cmd))
+        return out
+
+    def _quarantine(self, name: str, why: str) -> None:
+        try:
+            os.replace(os.path.join(self.path, name),
+                       os.path.join(self.quarantine_path, name))
+        except OSError:
+            try:
+                os.unlink(os.path.join(self.path, name))
+            except OSError:
+                pass
+        # a breadcrumb beside the quarantined file, for post-mortems
+        try:
+            atomic_write_json(
+                os.path.join(self.quarantine_path, name + ".why"),
+                {"name": name, "why": why})
+        except OSError:
+            pass
+
+    def quarantined(self) -> List[str]:
+        """Names of quarantined command files (oldest first)."""
+        try:
+            return sorted(n for n in os.listdir(self.quarantine_path)
+                          if n.startswith(_CMD_PREFIX)
+                          and n.endswith(".json"))
+        except OSError:
+            return []
+
+    def pending(self) -> int:
+        """Commands delivered but not yet consumed."""
+        try:
+            return sum(1 for n in os.listdir(self.path)
+                       if n.startswith(_CMD_PREFIX)
+                       and n.endswith(".json"))
+        except OSError:
+            return 0
+
+
+def _journal_path(root: str, rid: int) -> str:
+    return os.path.join(fleet_paths(root)["journal"],
+                        f"agent_{int(rid)}.jsonl")
+
+
+class JournalWriter:
+    """The agent side of the stream journal: append-only JSONL.
+
+    One ``append(events)`` call writes each event as one line and
+    flushes once — a ``kill -9`` can tear at most the LAST line, which
+    the reader simply never consumes (it only advances past complete
+    lines). Deliberately not fsynced per line: the journal's loss
+    bound is "whatever the page cache held", and the re-prime path
+    regenerates anything lost bit-identically from the last line that
+    did land.
+    """
+
+    def __init__(self, root: str, rid: int):
+        self.path = _journal_path(root, rid)
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        self._f = open(self.path, "a", encoding="utf-8")
+
+    def append(self, events: List[dict]) -> int:
+        if not events:
+            return 0
+        buf = "".join(json.dumps(ev, sort_keys=True) + "\n"
+                      for ev in events)
+        self._f.write(buf)
+        self._f.flush()
+        return len(events)
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except OSError:
+            pass
+
+
+class JournalReader:
+    """The router side: tail every agent's journal, complete lines
+    only. Per-rid byte offsets advance past each consumed line's
+    newline; a torn tail (no trailing newline yet — mid-append, or a
+    ``kill -9`` artifact) stays unconsumed forever without blocking
+    the lines before it. An undecodable COMPLETE line is skipped and
+    counted (``corrupt``) — one bad record must not wedge the relay.
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+        self._offsets: Dict[int, int] = {}
+        self.corrupt = 0
+
+    def poll(self, rid: int) -> List[dict]:
+        """New complete events from agent `rid`'s journal since the
+        last poll (empty when the file does not exist yet)."""
+        path = _journal_path(self.root, rid)
+        off = self._offsets.get(int(rid), 0)
+        try:
+            with open(path, "rb") as f:
+                f.seek(off)
+                chunk = f.read()
+        except OSError:
+            return []
+        if not chunk:
+            return []
+        # consume only up to the last complete line
+        end = chunk.rfind(b"\n")
+        if end < 0:
+            return []
+        complete, consumed = chunk[:end + 1], end + 1
+        self._offsets[int(rid)] = off + consumed
+        out: List[dict] = []
+        for line in complete.splitlines():
+            if not line.strip():
+                continue
+            try:
+                ev = json.loads(line)
+                if not isinstance(ev, dict) or "kind" not in ev:
+                    raise ValueError("journal line is not an event")
+            except ValueError:
+                self.corrupt += 1
+                continue
+            out.append(ev)
+        return out
+
+
+class AgentStatus:
+    """Atomic-rename status advertisement, both directions.
+
+    The agent calls :meth:`write` each poll cycle with its
+    ``load_stats()``/health payload; the router calls :meth:`read` /
+    :meth:`read_all` to score placement. Always a whole-file replace —
+    a reader never sees a half-written status."""
+
+    def __init__(self, root: str):
+        self.path = fleet_paths(root)["status"]
+        os.makedirs(self.path, exist_ok=True)
+
+    def _status_path(self, rid: int) -> str:
+        return os.path.join(self.path, f"agent_{int(rid)}.json")
+
+    def write(self, rid: int, payload: dict) -> None:
+        atomic_write_json(self._status_path(rid), payload)
+
+    def read(self, rid: int) -> Optional[dict]:
+        try:
+            with open(self._status_path(rid), "r",
+                      encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def read_all(self) -> Dict[int, dict]:
+        out: Dict[int, dict] = {}
+        try:
+            names = os.listdir(self.path)
+        except OSError:
+            return out
+        for name in names:
+            if not (name.startswith("agent_") and
+                    name.endswith(".json")):
+                continue
+            try:
+                rid = int(name[len("agent_"):-len(".json")])
+            except ValueError:
+                continue
+            payload = self.read(rid)
+            if payload is not None:
+                out[rid] = payload
+        return out
+
+    def clear(self, rid: int) -> None:
+        try:
+            os.unlink(self._status_path(rid))
+        except OSError:
+            pass

@@ -70,6 +70,20 @@ FLEET_MIGRATED_REQUESTS = "dl4jtpu_fleet_migrated_requests_total"
 FLEET_DEAD_REPLICAS = "dl4jtpu_fleet_dead_replicas_total"
 FLEET_SCALE_EVENTS = "dl4jtpu_fleet_scale_events_total"
 
+#: cross-process fleet transport (serving/fleet/transport.py +
+#: agent.py register these): shared-fs mailbox command traffic at the
+#: agent (``kind`` labels admit/revoke/shutdown), at-least-once
+#: duplicates dropped by request-id dedupe, torn command files moved
+#: to quarantine instead of crashing the poll loop, and the journal
+#: token events the router relayed into local stream handles.
+FLEET_TRANSPORT_COMMANDS = "dl4jtpu_fleet_transport_commands_total"
+FLEET_TRANSPORT_DUPLICATES = \
+    "dl4jtpu_fleet_transport_duplicates_total"
+FLEET_TRANSPORT_QUARANTINED = \
+    "dl4jtpu_fleet_transport_quarantined_total"
+FLEET_RELAYED_TOKENS = "dl4jtpu_fleet_relayed_tokens_total"
+FLEET_REPLACED_REQUESTS = "dl4jtpu_fleet_replaced_requests_total"
+
 #: survivability layer (supervisor.py / overload.py register these)
 SERVING_ENGINE_REBUILDS = "dl4jtpu_serving_engine_rebuilds_total"
 SERVING_ENGINE_ESCALATIONS = \

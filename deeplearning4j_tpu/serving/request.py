@@ -51,6 +51,17 @@ LEDGER_VERSION = 2
 
 _DONE = object()     # terminal queue sentinel
 
+
+def rng_state_payload(rng) -> dict:
+    """JSON-able snapshot of a numpy ``Generator``'s bit-generator
+    state — the per-token consistency record the cross-process stream
+    journal carries (``serving/fleet/transport.py``): a re-placement
+    re-primes from (committed ids, this state) and continues
+    bit-identically. Same normalization as the full ledger payload
+    (``RequestLedgerEntry.payload``'s ``rng_state`` field); the state
+    setter accepts the list form back."""
+    return RequestLedgerEntry._jsonable(rng.bit_generator.state)
+
 #: decode progress lands on a trace as ROLLUPS — one record per this
 #: many committed tokens (plus a flush at retirement) — never one
 #: record per token: a 4k-token stream is ~128 trace records, not 4k
@@ -337,6 +348,30 @@ class GenerationStream:
         self._error = exc
         self._finish(reason)
 
+    # -- relay side (cross-process fleet transport) --------------------
+    def relay_token(self, token: int) -> None:
+        """Public engine-side push for a TRANSPORT RELAY: the
+        out-of-process fleet router plays the engine's role for a
+        handle whose real engine lives in another process, pushing each
+        journaled committed token into the local stream
+        (``serving/fleet/transport.py``). Identical semantics to the
+        in-process engine push — the caller's iterator/result() cannot
+        tell a relayed stream from a local one."""
+        self._push(token)
+
+    def relay_finish(self, reason: str,
+                     error: Optional[BaseException] = None) -> None:
+        """Transport-relay terminal event: finish (or fail) the local
+        handle when the remote replica journals the request's
+        retirement. No-op if the handle already has a terminal event
+        (duplicate journal delivery must stay idempotent)."""
+        if self._done.is_set():
+            return
+        if error is not None:
+            self._fail(error, reason)
+        else:
+            self._finish(reason)
+
     # -- caller side ---------------------------------------------------
     @property
     def done(self) -> bool:
@@ -558,6 +593,12 @@ class RequestLedgerEntry:
         bit_gen.state = state
         prompt = [int(t) for t in payload["prompt"]]
         remaining = payload.get("deadline_remaining_s")
+        # deadline re-anchoring contract (test-pinned): the wire form
+        # carries REMAINING budget and the deadline is re-anchored on
+        # the RECEIVER's monotonic clock — sender/receiver wall-clock
+        # skew can neither extend nor prematurely expire a migrated
+        # request. An already-expired budget (remaining < 0) stays
+        # expired: the deadline lands in the receiver's past.
         deadline = None if remaining is None else \
             time.monotonic() + float(remaining)
         req = GenerationRequest(

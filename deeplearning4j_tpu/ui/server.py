@@ -356,6 +356,29 @@ class _Handler(BaseHTTPRequestHandler):
                 "dropped": elog.dropped_total,
                 "enabled": ev.events_enabled(),
                 "events": [e.as_dict() for e in tail]})
+        # liveness/health probe beside /metrics and /events: every
+        # attached health probe (an engine's or fleet router's
+        # ``health()`` callable) dumped as JSON, HTTP 200 only while
+        # every component reports healthy (503 otherwise — so a load
+        # balancer can act on the status code without parsing)
+        if path == "/health":
+            probes = getattr(self.server, "health_probes", {})
+            components, ok = {}, True
+            for name, probe in sorted(probes.items()):
+                try:
+                    payload = probe()
+                except Exception as e:  # noqa: BLE001 — report, don't die
+                    components[name] = {"error": repr(e)}
+                    ok = False
+                    continue
+                components[name] = payload
+                healthy = payload.get("healthy") \
+                    if isinstance(payload, dict) else None
+                if healthy is False:
+                    ok = False
+            return self._json(
+                {"healthy": ok, "components": components},
+                200 if ok else 503)
         if path == "/chart.js":
             body = _CHART_JS.encode()
             self.send_response(200)
@@ -632,6 +655,7 @@ class UIServer:
         self._httpd.remote_enabled = False
         self._httpd.tsne_sessions = {}
         self._httpd.activation_sessions = {}
+        self._httpd.health_probes = {}
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
@@ -651,6 +675,17 @@ class UIServer:
     def detach(self, storage: StatsStorage) -> None:
         if storage in self._httpd.storages:
             self._httpd.storages.remove(storage)
+
+    def attach_health(self, name: str, probe) -> None:
+        """Register a component under the ``/health`` endpoint:
+        `probe` is a zero-arg callable returning a JSON-able dict (an
+        engine's or fleet router's ``health()``). A dict carrying
+        ``healthy: False`` — or a probe that raises — turns the
+        endpoint's status into 503."""
+        self._httpd.health_probes[name] = probe
+
+    def detach_health(self, name: str) -> None:
+        self._httpd.health_probes.pop(name, None)
 
     def upload_tsne(self, coords, labels=None,
                     session_id: str = "uploaded") -> None:

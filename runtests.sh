@@ -122,6 +122,19 @@ python -m pytest tests/test_serving_quant.py -q -p no:cacheprovider
 # and zero retraces after warmup including post-migration re-admits
 python -m pytest tests/test_serving_fleet.py -q -p no:cacheprovider
 
+# tier-1 fleet-transport lane: the CROSS-PROCESS fleet's shared-fs
+# transport (serving/fleet/transport.py, agent.py, ProcessFleetRouter)
+# driven in-process for determinism — mailbox/journal/status protocol
+# (atomic sends, torn tails unconsumed, quarantine + breadcrumb),
+# (request id, attempt) dedupe under duplicate/torn/delayed chaos
+# injectors, deadline re-anchoring on the receiver's clock, relayed
+# streams bit-exact vs single engine (greedy + sampled), dead-agent
+# re-placement with revoke+attempt fencing (no double-serve), zero
+# retraces, and the /health endpoint. The REAL-subprocess form (spawn
+# 3 workers, genuine kill -9, sha256 pin) is tests/test_fleet_procs.py
+# in the slow suite.
+python -m pytest tests/test_fleet_transport.py -q -p no:cacheprovider
+
 # tier-1 autotune/execution-plan lane: the kernel-crossover store +
 # plan resolution (tuning/) and the fused space-to-depth stem — store
 # lifecycle (roundtrip/ratchet/prune/platform guard), fused==xla fit

@@ -1,0 +1,22 @@
+"""Engine builder for the cross-process fleet worker tests/bench.
+
+``serving/fleet/worker.py`` spawns replica processes with
+``--builder tests.fleet_proc_builder:build``: every process calls
+:func:`build` and gets a bit-identically parameterized engine (fixed
+default init seed, same constructor args) — the homogeneous-replica
+contract that makes any replica continue any stream bit-exactly.
+"""
+
+V = 12
+
+
+def net():
+    from deeplearning4j_tpu.zoo import TextGenerationTransformer
+    return TextGenerationTransformer(
+        vocab_size=V, embed_dim=16, n_heads=2, n_layers=2,
+        max_length=64, positional="rope").init()
+
+
+def build(rid):
+    from deeplearning4j_tpu.serving import GenerationEngine
+    return GenerationEngine(net(), V, slots=4)

@@ -1,0 +1,197 @@
+"""REAL cross-process serving fleet: replica agents as genuine OS
+processes (``serving/fleet/worker.py`` entrypoint), an out-of-process
+``ProcessFleetRouter`` in the test process, and a genuine ``kill -9``
+on one replica mid-trace.
+
+Nothing runs on the victim afterwards — no close(), no flush, no
+cooperative handoff; its lease simply stops beating. The router must
+detect the death, re-place the victim's in-flight streams onto
+survivors from ITS OWN state (relayed committed ids + journaled rng),
+and every stream — greedy and sampled — must complete sha256-identical
+to an unperturbed single-engine run, with zero compiles on the
+survivors after their warmup (the re-primes land in warm buckets).
+
+Tier-1 pins the same transport mechanics deterministically in-process
+(tests/test_fleet_transport.py); this suite is the end-to-end proof
+that they hold across real process boundaries, real SIGKILL, and the
+shared filesystem as the only channel.
+"""
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.serving import GenerationEngine, ProcessFleetRouter
+from deeplearning4j_tpu.serving.fleet import FleetConfig
+from deeplearning4j_tpu.serving.fleet import worker
+
+from tests.fleet_proc_builder import V, net
+
+pytestmark = pytest.mark.slow
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PROMPTS = [[1, 2, 3, 4, 5], [6, 7], [8, 9, 10, 1],
+           [2, 4, 6], [3, 5, 7, 9], [10, 9, 8]]
+STEPS = 48
+TTL = 1.0
+
+
+def _spawn(root, rid, log_path):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    log = open(log_path, "w")
+    # throttled steps: a warm tiny model would otherwise finish a
+    # whole 48-step trace inside one observer poll interval, leaving
+    # the kill nothing to land in the middle of
+    proc = worker.spawn(str(root), rid, "tests.fleet_proc_builder:build",
+                        warmup=True, ttl=TTL, throttle=0.05,
+                        env=env, cwd=REPO_ROOT,
+                        stdout=log, stderr=subprocess.STDOUT)
+    proc._log_file = log        # keep the fd alive with the Popen
+    return proc
+
+
+def _wait(cond, timeout, what, procs=()):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        for p in procs:
+            assert p.poll() is None, (
+                f"worker pid {p.pid} died early (rc {p.returncode}) "
+                f"while waiting for: {what}\n{_log_of(p)}")
+        assert time.monotonic() < deadline, f"timed out: {what}"
+        time.sleep(0.05)
+
+
+def _log_of(proc):
+    try:
+        proc._log_file.flush()
+        with open(proc._log_file.name) as f:
+            return f.read()
+    except OSError:
+        return "<no log>"
+
+
+def _submit_all(target):
+    """Half greedy, half sampled — per-request rngs seeded by index so
+    the fleet run and the reference run draw identically."""
+    hs = []
+    for i, p in enumerate(PROMPTS):
+        kw = (dict(top_k=1) if i % 2 == 0
+              else dict(temperature=1.3, top_p=0.9))
+        hs.append(target.submit(p, steps=STEPS,
+                                rng=np.random.default_rng(i), **kw))
+    return hs
+
+
+def _digest(handles):
+    return hashlib.sha256(
+        json.dumps([h.ids for h in handles]).encode()).hexdigest()
+
+
+def _reference_digest():
+    """The unperturbed run: ONE in-process engine, same net params
+    (fixed init seed), same requests."""
+    eng = GenerationEngine(net(), V, slots=8)
+    hs = _submit_all(eng)
+    while not all(h.done for h in hs):
+        eng.step()
+    d = _digest(hs)
+    eng.shutdown()
+    return d
+
+
+def test_kill9_one_replica_streams_complete_bit_exact(tmp_path):
+    root = str(tmp_path / "fleet")
+    procs = {rid: _spawn(root, rid, tmp_path / f"agent{rid}.log")
+             for rid in range(3)}
+    router = ProcessFleetRouter(
+        root, config=FleetConfig(lease_ttl_s=TTL))
+    try:
+        # discovery: workers import jax + warm up before their lease
+        # goes live, so give them real time
+        _wait(lambda: router.live_replicas() == [0, 1, 2], 300,
+              "all 3 agent leases live", procs=list(procs.values()))
+        statuses = router.status.read_all()
+        pids = {st["pid"] for st in statuses.values()}
+        assert len(pids) == 3 and os.getpid() not in pids, (
+            "each replica must be its OWN process (own GIL, own "
+            f"engine): {pids}")
+
+        hs = _submit_all(router)
+
+        # mid-trace targeting: a replica currently serving a stream
+        # that has committed tokens but is nowhere near done
+        def _mid_trace_rids():
+            router.relay()
+            out = {}
+            for req_id, (rid, _) in router.assignments().items():
+                h = router._routes[req_id].request.handle
+                if not h.done and 2 <= len(h.generated) <= STEPS // 2:
+                    out.setdefault(rid, 0)
+                    out[rid] += 1
+            return out
+
+        _wait(lambda: bool(_mid_trace_rids()), 120,
+              "a replica serving a mid-trace stream",
+              procs=list(procs.values()))
+        assert not all(h.done for h in hs)
+
+        # kill -9 the busiest such replica: a real SIGKILL — no
+        # handlers, no finally blocks, nothing runs on the victim
+        # afterwards
+        cands = _mid_trace_rids() or \
+            {rid: 1 for rid, _ in router.assignments().values()}
+        victim = max(cands, key=lambda r: (cands[r], -r))
+        procs[victim].kill()
+        procs[victim].wait(timeout=30)
+        assert procs[victim].returncode == -9
+
+        # the router detects the silent death (lease expiry) and
+        # re-places onto survivors; every stream still completes
+        _wait(lambda: (router.poll(), )
+              and all(h.done for h in hs),
+              240, "all streams complete after the kill",
+              procs=[p for r, p in procs.items() if r != victim])
+        assert all(h.error is None for h in hs), \
+            [repr(h.error) for h in hs]
+        assert victim in [r for r in (0, 1, 2)
+                          if r not in router.live_replicas()]
+        assert router.replaced_requests >= 1, \
+            "the kill must have landed while requests were in flight"
+        assert all(len(h.generated) == STEPS for h in hs), (
+            "token-count drift: the relay's index dedupe must drop "
+            "every overlap a survivor re-emitted")
+
+        # THE acceptance pin: sha256-identical to the unperturbed
+        # single-engine run — greedy and sampled, kill included
+        assert _digest(hs) == _reference_digest()
+
+        # zero retraces on the survivors: the re-primed continuations
+        # landed in buckets their warmup already compiled
+        statuses = router.status.read_all()
+        for rid in (r for r in (0, 1, 2) if r != victim):
+            assert statuses[rid]["compiles_since_warm"] == 0, (
+                f"survivor {rid} retraced after warmup:\n"
+                f"{_log_of(procs[rid])}")
+
+        # orderly whole-fleet stop for the survivors
+        router.shutdown(stop_agents=True)
+        for rid, proc in procs.items():
+            if rid == victim:
+                continue
+            proc.wait(timeout=60)
+            assert proc.returncode == 0, _log_of(proc)
+    finally:
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+            proc._log_file.close()

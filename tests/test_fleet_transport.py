@@ -1,0 +1,541 @@
+"""Cross-process fleet transport (serving/fleet/transport.py, agent.py,
+ProcessFleetRouter) — the tier-1 IN-PROCESS lane: every transport
+mechanic the real-subprocess suite (tests/test_fleet_procs.py, slow)
+relies on, pinned deterministically without spawning anything.
+
+Covers: the mailbox/journal/status wire protocol (atomic sends, torn
+tails never consumed, corrupt lines skipped), the (request id, attempt)
+dedupe making at-least-once delivery effectively exactly-once, torn
+commands quarantined without crashing the agent poll loop, delayed
+delivery admitting late, router-relayed streams bit-exact vs a single
+engine (greedy AND sampled), dead-replica re-placement with NO
+cooperation from the corpse (bit-identical completion + zero retraces
+after warmup), the stalled-lease-but-ALIVE replica fenced by revoke +
+attempt so nothing double-serves, the deadline re-anchoring contract
+(receiver's monotonic clock; wall skew can neither extend nor
+prematurely expire), and the /health endpoint beside /metrics."""
+
+import copy
+import functools
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitoring
+from deeplearning4j_tpu.monitoring import runtime
+from deeplearning4j_tpu.monitoring.metrics import MetricsRegistry
+from deeplearning4j_tpu.resilience.chaos import (
+    DelayedDeliveryInjector, DuplicateDeliveryInjector,
+    TornCommandInjector)
+from deeplearning4j_tpu.serving import (
+    GenerationEngine, GenerationRequest, ProcessFleetRouter,
+    ReplicaAgent, RequestLedgerEntry)
+from deeplearning4j_tpu.serving.fleet import (
+    AGENT_ROLE, AgentStatus, FleetConfig, JournalReader, JournalWriter,
+    Mailbox, fleet_paths)
+from deeplearning4j_tpu.serving.fleet import transport
+from deeplearning4j_tpu.zoo import TextGenerationTransformer
+
+V = 12
+PROMPTS = [[1, 2, 3, 4, 5], [6, 7], [8, 9, 10, 1], [2, 4, 6]]
+
+
+_NET_TEMPLATE = {}
+
+
+def _net(max_length=32):
+    """Fixed default seed: every call yields bit-identical params —
+    the homogeneous-replica contract the worker builder relies on.
+    Init once per shape and deep-copy the template: the params stay
+    bit-identical while the lane skips the repeated weight init."""
+    if max_length not in _NET_TEMPLATE:
+        _NET_TEMPLATE[max_length] = TextGenerationTransformer(
+            vocab_size=V, embed_dim=16, n_heads=2, n_layers=2,
+            max_length=max_length, positional="rope").init()
+    return copy.deepcopy(_NET_TEMPLATE[max_length])
+
+
+_ENGINE_POOL = []
+
+
+def _engine(**kw):
+    """Default-config engines are pooled across tests: per-engine jit
+    closures dominate the lane's wall-clock, and a drained engine
+    (every slot free, queue empty) is indistinguishable from a fresh
+    one — the bit-exactness pins below would catch it if not."""
+    if not kw and _ENGINE_POOL:
+        return _ENGINE_POOL.pop()
+    return GenerationEngine(_net(), V, slots=4, **kw)
+
+
+def _recycle(eng):
+    stats = eng.load_stats()
+    if stats["active_slots"] == 0 and stats["queue_depth"] == 0:
+        _ENGINE_POOL.append(eng)
+    else:
+        eng.shutdown()
+
+
+def _retire(*agents):
+    """Orderly agent teardown (ReplicaAgent.close() step for step)
+    except the engine is recycled when provably idle instead of shut
+    down. Victim engines killed mid-trace hold in-flight slots and
+    fall through to a real shutdown."""
+    for a in agents:
+        a._shutdown = True
+        try:
+            a.write_status()
+        except OSError:
+            pass
+        a.membership.stop()
+        a.journal.close()
+        _recycle(a.engine)
+
+
+def _submit_all(target, steps=5, sampled=False):
+    hs = []
+    for i, p in enumerate(PROMPTS):
+        kw = (dict(temperature=1.3, top_p=0.9) if sampled
+              else dict(top_k=1))
+        hs.append(target.submit(p, steps=steps,
+                                rng=np.random.default_rng(i), **kw))
+    return hs
+
+
+@functools.lru_cache(maxsize=None)
+def _reference_ids(steps=5, sampled=False):
+    """Single-engine golden trace, computed once per (steps, sampled)
+    and shared across tests — callers compare against it, never mutate
+    it."""
+    ref = _engine()
+    hs = _submit_all(ref, steps=steps, sampled=sampled)
+    while not all(h.done for h in hs):
+        ref.step()
+    out = [h.ids for h in hs]
+    _recycle(ref)
+    return out
+
+
+def _drive(router, agents, handles, max_cycles=400):
+    for _ in range(max_cycles):
+        for a in agents:
+            a.poll_once()
+            a.step()
+        router.relay()
+        if all(h.done for h in handles):
+            return
+    raise AssertionError(
+        f"streams never completed: {[h.done for h in handles]}")
+
+
+def _compile_total():
+    c = monitoring.global_registry().get(runtime.COMPILE_COUNTER)
+    return 0.0 if c is None else c.total()
+
+
+# ---------------------------------------------------------------------
+# the wire protocol: mailbox, journal, status files
+# ---------------------------------------------------------------------
+class TestTransportProtocol:
+    def test_fleet_paths_layout(self, tmp_path):
+        p = fleet_paths(str(tmp_path))
+        assert p["leases"].endswith("leases")
+        assert p["mail"].endswith("mail")
+        assert p["journal"].endswith("journal")
+        assert p["status"].endswith("status")
+
+    def test_mailbox_roundtrip_in_send_order(self, tmp_path):
+        tx = Mailbox(str(tmp_path), 0)
+        rx = Mailbox(str(tmp_path), 0)
+        for i in range(5):
+            tx.send({"kind": "admit", "req": f"r{i}", "attempt": 0})
+        assert rx.pending() == 5
+        got = rx.receive()
+        assert [c["req"] for _, c in got] == [f"r{i}" for i in range(5)]
+        assert rx.pending() == 0 and rx.receive() == []
+        assert rx.quarantined() == []
+
+    def test_mailbox_skips_tmp_files(self, tmp_path):
+        """A crashed atomic writer's .tmp- leftover is neither consumed
+        nor quarantined — only cmd_*.json names are commands."""
+        box = Mailbox(str(tmp_path), 0)
+        with open(os.path.join(box.path, ".tmp-cmd_x.json"), "w") as f:
+            f.write("{half")
+        assert box.receive() == [] and box.quarantined() == []
+
+    def test_undecodable_command_quarantined_with_breadcrumb(
+            self, tmp_path):
+        box = Mailbox(str(tmp_path), 0)
+        name = "cmd_00000000000000000001_1_000001.json"
+        with open(os.path.join(box.path, name), "w") as f:
+            f.write('{"kind": "admit", "entry":')   # torn mid-write
+        assert box.receive() == []
+        assert box.quarantined() == [name]
+        why = os.path.join(box.quarantine_path, name + ".why")
+        assert os.path.exists(why)
+        # and it is never re-read as if it might heal
+        assert box.receive() == [] and box.quarantined() == [name]
+
+    def test_journal_roundtrip_and_torn_tail(self, tmp_path):
+        w = JournalWriter(str(tmp_path), 3)
+        r = JournalReader(str(tmp_path))
+        w.append([{"kind": "tok", "req": "a", "attempt": 0,
+                   "start": 0, "toks": [1, 2]}])
+        assert [e["toks"] for e in r.poll(3)] == [[1, 2]]
+        # a torn tail (kill -9 mid-append: no trailing newline) is
+        # never consumed — and never blocks the lines before it
+        with open(w.path, "a") as f:
+            f.write('{"kind": "tok", "req": "a", "at')
+        assert r.poll(3) == []
+        with open(w.path, "a") as f:
+            f.write('tempt": 0, "start": 2, "toks": [3]}\n')
+        assert [e["start"] for e in r.poll(3)] == [2]
+        w.close()
+
+    def test_journal_corrupt_complete_line_skipped_and_counted(
+            self, tmp_path):
+        w = JournalWriter(str(tmp_path), 1)
+        r = JournalReader(str(tmp_path))
+        with open(w.path, "a") as f:
+            f.write("not json at all\n")
+        w.append([{"kind": "done", "req": "a", "attempt": 0,
+                   "reason": "stop", "error": None}])
+        evs = r.poll(1)
+        assert [e["kind"] for e in evs] == ["done"]
+        assert r.corrupt == 1
+        w.close()
+
+    def test_status_file_roundtrip(self, tmp_path):
+        st = AgentStatus(str(tmp_path))
+        st.write(0, {"rid": 0, "healthy": True})
+        st.write(2, {"rid": 2, "healthy": False})
+        assert st.read(0)["healthy"] is True
+        assert set(st.read_all()) == {0, 2}
+        st.clear(0)
+        assert st.read(0) is None
+
+
+# ---------------------------------------------------------------------
+# satellite: the deadline re-anchoring contract
+# ---------------------------------------------------------------------
+class TestDeadlineReanchor:
+    def test_remaining_budget_reanchors_on_receiver_clock(self):
+        """`from_payload` deadlines re-anchor against the RECEIVER's
+        monotonic clock: the wire form carries remaining budget, so
+        sender/receiver wall-clock skew cannot extend the deadline."""
+        req = GenerationRequest([1, 2, 3], 4,
+                                deadline=time.monotonic() + 30.0)
+        payload = RequestLedgerEntry.capture(req, "queued").payload()
+        assert 29.0 < payload["deadline_remaining_s"] <= 30.0
+        # simulate arbitrary wall skew: the payload is pure budget, so
+        # whatever wall time says, the rebuilt deadline is receiver-now
+        # + remaining
+        t0 = time.monotonic()
+        rebuilt = RequestLedgerEntry.from_payload(payload)
+        left = rebuilt.request.deadline - t0
+        assert 28.5 < left <= 30.0, left
+
+    def test_expired_budget_stays_expired(self):
+        """Negative remaining budget lands the deadline in the
+        receiver's past — skew can't resurrect an expired request."""
+        req = GenerationRequest([1, 2, 3], 4,
+                                deadline=time.monotonic() + 30.0)
+        payload = RequestLedgerEntry.capture(req, "queued").payload()
+        payload["deadline_remaining_s"] = -1.0
+        rebuilt = RequestLedgerEntry.from_payload(payload)
+        assert rebuilt.request.deadline < time.monotonic()
+
+    def test_no_deadline_travels_as_none(self):
+        req = GenerationRequest([1, 2, 3], 4)
+        payload = RequestLedgerEntry.capture(req, "queued").payload()
+        assert payload["deadline_remaining_s"] is None
+        assert RequestLedgerEntry.from_payload(payload) \
+            .request.deadline is None
+
+
+# ---------------------------------------------------------------------
+# router relay == single engine, bit-exact
+# ---------------------------------------------------------------------
+class TestRouterRelay:
+    @pytest.mark.parametrize("sampled", [False, True],
+                             ids=["greedy", "sampled"])
+    def test_relayed_streams_bit_exact(self, tmp_path, sampled):
+        """Submit through the out-of-process router (in-process agents
+        for determinism): every relayed stream is bit-identical to the
+        single-engine run — the caller cannot tell the transport is
+        there."""
+        root = str(tmp_path)
+        agents = [ReplicaAgent(_engine(), root, rid, ttl=10.0,
+                               registry=MetricsRegistry())
+                  for rid in range(2)]
+        router = ProcessFleetRouter(
+            root, config=FleetConfig(lease_ttl_s=10.0),
+            registry=MetricsRegistry())
+        assert router.live_replicas() == [0, 1]
+        hs = _submit_all(router, sampled=sampled)
+        _drive(router, agents, hs)
+        assert [h.ids for h in hs] == _reference_ids(sampled=sampled)
+        assert router.outstanding() == 0
+        router.shutdown()
+        _retire(*agents)
+
+    def test_duplicate_admission_is_idempotent(self, tmp_path):
+        """At-least-once delivery: the SAME admit arrives twice (chaos
+        duplicates every send); the agent's (request id, attempt)
+        dedupe admits once, counts the duplicate, and the stream is
+        still bit-exact."""
+        root = str(tmp_path)
+        agent = ReplicaAgent(_engine(), root, 0, ttl=10.0,
+                             registry=MetricsRegistry())
+        router = ProcessFleetRouter(
+            root, config=FleetConfig(lease_ttl_s=10.0),
+            registry=MetricsRegistry(),
+            chaos=DuplicateDeliveryInjector(once=False))
+        hs = _submit_all(router)
+        _drive(router, [agent], hs)
+        assert [h.ids for h in hs] == _reference_ids()
+        assert agent.duplicates == len(PROMPTS)
+        router.shutdown()
+        _retire(agent)
+
+    def test_torn_command_quarantined_never_crashes_agent(
+            self, tmp_path):
+        """A torn command file (non-atomic writer died mid-write) is
+        quarantined by the poll loop — which keeps serving: the router
+        re-sends (at-least-once) and the SECOND copy admits."""
+        root = str(tmp_path)
+        agent = ReplicaAgent(_engine(), root, 0, ttl=10.0,
+                             registry=MetricsRegistry())
+        router = ProcessFleetRouter(
+            root, config=FleetConfig(lease_ttl_s=10.0),
+            registry=MetricsRegistry(),
+            chaos=TornCommandInjector(once=True))
+        h = router.submit(PROMPTS[0], 5, top_k=1)
+        assert agent.poll_once() == 0      # torn: quarantined, no admit
+        assert len(agent.mailbox.quarantined()) == 1
+        # the command is LOST — at-least-once delivery means the
+        # sender may re-send the SAME (request, attempt) safely
+        rec_id, (rid, _) = next(iter(router.assignments().items()))
+        router._send_to(router._routes[rec_id], rid)
+        _drive(router, [agent], [h])
+        assert h.done and h.error is None
+        assert h.ids == _reference_ids()[0]
+        router.shutdown()
+        _retire(agent)
+
+    def test_delayed_delivery_admits_late(self, tmp_path):
+        root = str(tmp_path)
+        agent = ReplicaAgent(_engine(), root, 0, ttl=10.0,
+                             registry=MetricsRegistry())
+        delay = DelayedDeliveryInjector(once=True)
+        router = ProcessFleetRouter(
+            root, config=FleetConfig(lease_ttl_s=10.0),
+            registry=MetricsRegistry(), chaos=delay)
+        h = router.submit(PROMPTS[0], 5, top_k=1)
+        for _ in range(3):
+            agent.poll_once()
+            agent.step()
+            router.relay()
+        assert not h.done and len(delay.held) == 1
+        assert delay.release() == 1
+        _drive(router, [agent], [h])
+        assert h.done and h.error is None
+        router.shutdown()
+        _retire(agent)
+
+
+# ---------------------------------------------------------------------
+# death -> corpse-free re-placement (the kill -9 mechanics, in-process)
+# ---------------------------------------------------------------------
+class TestDeathReplacement:
+    @pytest.mark.parametrize("sampled", [False, True],
+                             ids=["greedy", "sampled"])
+    def test_dead_agent_replaced_bit_exact(self, tmp_path, sampled):
+        """Mid-trace death (the in-process kill -9 stand-in: the agent
+        stops stepping AND stops beating): the router re-places its
+        requests onto the survivor from LOCAL state only — committed
+        ids from the relayed handles + the last journaled rng — and
+        every stream completes bit-identically to the unperturbed
+        single-engine run."""
+        root = str(tmp_path)
+        victim = ReplicaAgent(_engine(), root, 0, ttl=0.3,
+                              registry=MetricsRegistry())
+        survivor = ReplicaAgent(_engine(), root, 1, ttl=0.3,
+                                registry=MetricsRegistry())
+        router = ProcessFleetRouter(
+            root, config=FleetConfig(lease_ttl_s=0.3),
+            registry=MetricsRegistry())
+        hs = _submit_all(router, steps=8, sampled=sampled)
+        for _ in range(3):                  # mid-trace on both
+            victim.poll_once(); survivor.poll_once()
+            victim.step(); survivor.step()
+            router.relay()
+        assert any(rid == 0 for rid, _ in router.assignments().values())
+        before = {h: len(h.generated) for h in hs}
+        assert any(before.values()), "kill must land mid-trace"
+        # kill -9: nothing on the victim runs from here — no close(),
+        # no export, no cooperation; the lease just stops beating
+        victim.membership.lease(0).stall()
+        time.sleep(0.45)
+        out = router.poll()
+        assert out["dead"] == [0]
+        assert out["replaced"] >= 1
+        _drive(router, [survivor], hs)
+        assert [h.ids for h in hs] == _reference_ids(steps=8,
+                                                     sampled=sampled)
+        # exactly steps tokens each: the dedupe dropped every overlap
+        # the survivor re-emitted
+        assert all(len(h.generated) == 8 for h in hs)
+        assert router.replaced_requests == out["replaced"]
+        router.shutdown()
+        _retire(survivor)
+
+    def test_zero_retraces_after_warmup_including_replacement(
+            self, tmp_path):
+        """The PR 3 bar, cross-process form: warmed replicas serve the
+        whole episode — staggered admits, a death, re-primes on the
+        survivor — with zero new compiles."""
+        monitoring.ensure_started()
+        root = str(tmp_path)
+        engines = [_engine().warmup(), _engine().warmup()]
+        victim = ReplicaAgent(engines[0], root, 0, ttl=0.3,
+                              registry=MetricsRegistry())
+        survivor = ReplicaAgent(engines[1], root, 1, ttl=0.3,
+                                registry=MetricsRegistry())
+        for a in (victim, survivor):
+            a.mark_warm()
+        router = ProcessFleetRouter(
+            root, config=FleetConfig(lease_ttl_s=0.3),
+            registry=MetricsRegistry())
+        warm = _compile_total()
+        hs = _submit_all(router, steps=6)
+        for _ in range(2):
+            victim.poll_once(); survivor.poll_once()
+            victim.step(); survivor.step()
+            router.relay()
+        victim.membership.lease(0).stall()
+        time.sleep(0.45)
+        router.poll()
+        _drive(router, [survivor], hs)
+        assert all(h.error is None for h in hs)
+        assert _compile_total() == warm, (
+            "cross-process re-placement retraced after warmup — "
+            "re-primes must land in the survivor's warm buckets")
+        assert survivor.status_payload()["compiles_since_warm"] == 0
+        router.shutdown()
+        _retire(survivor)
+
+    def test_stalled_lease_but_alive_replica_never_double_serves(
+            self, tmp_path):
+        """The hung-host case: the lease stalls but the PROCESS keeps
+        serving. The router revokes (old attempt) before re-placing
+        (attempt+1); the stale server cancels on the revoke, its
+        late journal events are fenced off by attempt, and the caller
+        sees exactly one stream's worth of tokens — bit-exact, no
+        duplicates."""
+        root = str(tmp_path)
+        stale = ReplicaAgent(_engine(), root, 0, ttl=0.3,
+                             registry=MetricsRegistry())
+        survivor = ReplicaAgent(_engine(), root, 1, ttl=0.3,
+                                registry=MetricsRegistry())
+        router = ProcessFleetRouter(
+            root, config=FleetConfig(lease_ttl_s=0.3),
+            registry=MetricsRegistry())
+        hs = _submit_all(router, steps=8)
+        for _ in range(3):
+            stale.poll_once(); survivor.poll_once()
+            stale.step(); survivor.step()
+            router.relay()
+        victims = [r for r, _ in router.assignments().values()
+                   if r == 0]
+        assert victims, "nothing landed on the stalling replica"
+        stale.membership.lease(0).stall()   # hung heartbeats, live host
+        time.sleep(0.45)
+        out = router.poll()
+        assert out["dead"] == [0]
+        # BOTH keep stepping: the stale one keeps serving (and keeps
+        # journaling at the old attempt) until its poll sees the revoke
+        _drive(router, [stale, survivor], hs)
+        assert [h.ids for h in hs] == _reference_ids(steps=8)
+        assert all(len(h.generated) == 8 for h in hs), (
+            "double-serving: a stale replica's tokens crossed the "
+            "attempt fence")
+        # and the stale agent actually processed the revoke: nothing
+        # of the re-placed work is still in flight there
+        for _ in range(10):
+            stale.poll_once(); stale.step()
+        assert stale.status_payload()["inflight"] == 0
+        router.shutdown()
+        _retire(stale, survivor)
+
+
+# ---------------------------------------------------------------------
+# satellite: /health endpoint beside /metrics and /events
+# ---------------------------------------------------------------------
+class TestHealthEndpoint:
+    def test_health_json_and_status_codes(self):
+        import urllib.error
+        import urllib.request
+        from deeplearning4j_tpu.ui import UIServer
+        server = UIServer(port=0)
+        eng = _engine()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            server.attach_health("engine", eng.health)
+            with urllib.request.urlopen(base + "/health") as r:
+                assert r.status == 200
+                out = json.loads(r.read())
+            assert out["healthy"] is True
+            comp = out["components"]["engine"]
+            assert comp["healthy"] is True
+            assert comp["pid"] == os.getpid()
+            assert comp["label"] == eng.trace_identity
+            # an unhealthy component flips the endpoint to 503 (so a
+            # load balancer can act on the status code alone)
+            server.attach_health("probe", lambda: {"healthy": False})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(base + "/health")
+            assert ei.value.code == 503
+            assert json.loads(ei.value.read())["healthy"] is False
+            server.detach_health("probe")
+            with urllib.request.urlopen(base + "/health") as r:
+                assert r.status == 200
+        finally:
+            _recycle(eng)
+            server.stop()
+
+
+# ---------------------------------------------------------------------
+# the agent's lease role: process fleets and in-process fleets coexist
+# ---------------------------------------------------------------------
+class TestAgentMembership:
+    def test_agent_role_is_distinct_from_serving_role(self, tmp_path):
+        from deeplearning4j_tpu.serving.fleet import REPLICA_ROLE
+        assert AGENT_ROLE != REPLICA_ROLE
+        root = str(tmp_path)
+        agent = ReplicaAgent(_engine(), root, 0, ttl=10.0,
+                             registry=MetricsRegistry())
+        leases = agent.membership.live_leases()
+        assert leases[0]["role"] == AGENT_ROLE
+        assert leases[0]["pid"] == os.getpid()
+        # a serving-role reader must NOT count the agent
+        from deeplearning4j_tpu.resilience.elastic import LeaseLedger
+        reader = LeaseLedger(fleet_paths(root)["leases"], rank=-1,
+                             ttl=10.0)
+        assert reader.live_ranks(role="serving") == []
+        assert reader.live_ranks(role=AGENT_ROLE) == [0]
+        _retire(agent)
+
+    def test_status_advertises_load_and_identity(self, tmp_path):
+        root = str(tmp_path)
+        agent = ReplicaAgent(_engine(), root, 0, ttl=10.0,
+                             registry=MetricsRegistry())
+        st = AgentStatus(root).read(0)
+        assert st["rid"] == 0 and st["pid"] == os.getpid()
+        assert st["healthy"] is True
+        assert set(st["load"]) == {"slots", "active_slots",
+                                   "queue_depth", "free_page_frac"}
+        _retire(agent)
