@@ -1604,6 +1604,158 @@ def bench_serve_fleet_procs():
     _print_line(json.dumps(rec), flush=True)
 
 
+def bench_serve_disagg():
+    """Disaggregated prefill/decode serving (ISSUE 20): one mixed
+    long/short-prompt trace served twice — unified (a single engine)
+    and disaggregated (a ``role="prefill"`` agent + 2 decode replicas,
+    KV pages shipped through the content-addressed page store, decode
+    placement by page locality) — in-process and deterministic.
+    Adjudicates on MECHANISM only, per PERF.md's CPU-noise policy:
+    ``bit_exact`` (every stream identical across the two modes),
+    ``prefill_routed`` == the long-prompt count, and
+    ``decode_fresh_prefill_blocks`` == 0 (zero store misses — every
+    shipped-prefix request re-primes from imported or locally-held
+    pages, executing ZERO full-block prefill steps on a decode
+    replica). Page-ship bytes and store hit/miss counts ride in every
+    record; tok/s and wall_s are recorded for live-window comparison
+    but NEVER asserted."""
+    import copy
+    import shutil
+    import tempfile
+
+    import numpy as np
+    from deeplearning4j_tpu.serving import (
+        GenerationEngine, PagedKVConfig, PageStore, PrefillAgent,
+        ProcessFleetRouter, ReplicaAgent)
+    from deeplearning4j_tpu.serving.fleet import FleetConfig
+    from deeplearning4j_tpu.zoo import TextGenerationTransformer
+
+    V, R, STEPS, PS, TTL = 256, 24, 16, 8, 30.0
+    rng = np.random.default_rng(0)
+    # 3 shared prompt families (system prompts), each 3 full KV blocks;
+    # 2 of every 3 requests are long (family + a short unique tail),
+    # the rest short enough that no usable full block exists
+    families = [list(rng.integers(1, V, 3 * PS)) for _ in range(3)]
+    prompts = []
+    for i in range(R):
+        if i % 3 == 2:
+            prompts.append(list(rng.integers(
+                1, V, int(rng.integers(3, PS)))))
+        else:
+            prompts.append(families[i % 3] + list(rng.integers(
+                1, V, int(rng.integers(1, 5)))))
+    n_long = sum(1 for p in prompts if (len(p) - 1) // PS >= 1)
+    net = TextGenerationTransformer(
+        vocab_size=V, embed_dim=64, n_heads=4, n_layers=2,
+        max_length=64, positional="rope").init()
+
+    def engine():
+        return GenerationEngine(
+            copy.deepcopy(net), V, slots=4, queue_limit=R,
+            paging=PagedKVConfig(page_size=PS, total_pages=96))
+
+    def submit_all(target):
+        hs = []
+        for i, p in enumerate(prompts):
+            kw = (dict(top_k=1) if i % 2 == 0
+                  else dict(temperature=1.3, top_p=0.9))
+            hs.append(target.submit(
+                p, steps=STEPS, rng=np.random.default_rng(i), **kw))
+        return hs
+
+    # -- unified leg: ONE engine, same requests ------------------------
+    eng = engine()
+    t0 = time.perf_counter()
+    hs = submit_all(eng)
+    while not all(h.done for h in hs):
+        eng.step()
+    uni_dt = time.perf_counter() - t0
+    uni_ids = [h.ids for h in hs]
+    uni_gen = sum(len(h.generated) for h in hs)
+    eng.shutdown()
+
+    # -- disagg leg: prefill pool + decode pool + page store -----------
+    td = tempfile.mkdtemp(prefix="disagg_")
+    store = PageStore(td)
+    pre = PrefillAgent(engine(), store, td, 10, ttl=TTL)
+    decs = []
+    for rid in range(2):
+        e = engine()
+        # lazy bf16 pools materialize at the first surviving prime;
+        # one tiny unique-token request makes imports live from the
+        # very first real admission (what --warmup gives a worker)
+        h = e.submit([V - 1 - rid], steps=2, top_k=1,
+                     rng=np.random.default_rng(10_000 + rid))
+        while not h.done:
+            e.step()
+        decs.append(ReplicaAgent(e, td, rid, ttl=TTL,
+                                 page_store=store, import_pages=True))
+    for a in decs:
+        a.write_status()
+    pre.write_status()
+    router = ProcessFleetRouter(
+        td, config=FleetConfig(disagg=True, lease_ttl_s=TTL),
+        name="disaggbench")
+    try:
+        t0 = time.perf_counter()
+        hs = submit_all(router)
+        deadline = t0 + 600
+        while not all(h.done for h in hs):
+            if time.perf_counter() > deadline:
+                raise RuntimeError(
+                    f"disagg leg stalled: "
+                    f"{sum(h.done for h in hs)}/{R} done")
+            pre.poll_once()
+            for a in decs:
+                a.poll_once()
+                a.step()
+                a.publish_progress()
+                a.write_status()
+            router.relay()
+        dis_dt = time.perf_counter() - t0
+        dis_gen = sum(len(h.generated) for h in hs)
+        health = router.health()
+        rec = {"metric": "serve_disagg", "unit": "requests_completed",
+               "requests": R, "steps": STEPS, "page_size": PS,
+               "long_prompts": n_long,
+               "completed": sum(1 for h in hs if h.done
+                                and h.error is None),
+               # THE adjudicated mechanism pins
+               "bit_exact": [h.ids for h in hs] == uni_ids,
+               "prefill_routed": health["prefill_routed"],
+               "locality_hits": health["locality_hits"],
+               "decode_fresh_prefill_blocks":
+                   sum(a.store_misses for a in decs),
+               # page-ship accounting, in every record
+               "store": {"published": store.published,
+                         "publish_bytes": store.publish_bytes,
+                         "hits": sum(a.store_hits for a in decs),
+                         "misses": sum(a.store_misses for a in decs),
+                         "imported": sum(a.pages_imported
+                                         for a in decs),
+                         "import_bytes": sum(a.import_bytes
+                                             for a in decs),
+                         "quarantined": store.corrupt},
+               # live-window comparison only — NEVER asserted on CPU
+               "unified": {"wall_s": round(uni_dt, 2),
+                           "tokens_per_sec": round(uni_gen / uni_dt,
+                                                   1)},
+               "disagg": {"wall_s": round(dis_dt, 2),
+                          "tokens_per_sec": round(dis_gen / dis_dt,
+                                                  1)}}
+        rec["value"] = rec["completed"]
+        _print_line(json.dumps(rec), flush=True)
+    finally:
+        try:
+            router.shutdown()
+        except Exception:  # noqa: BLE001 — teardown must not mask
+            pass
+        pre.close()
+        for a in decs:
+            a.close()
+        shutil.rmtree(td, ignore_errors=True)
+
+
 def _converge_run(net, x, y, steps, record_every):
     """Fixed-seed training loop recording the loss trajectory. Each
     recorded point is a scalar host fetch — a real sync (the tunneled
@@ -1841,6 +1993,7 @@ ALL = {"resnet": bench_resnet, "lstm": bench_lstm, "lenet": bench_lenet,
        "serve_chaos": bench_serve_chaos,
        "serve_fleet": bench_serve_fleet,
        "serve_fleet_procs": bench_serve_fleet_procs,
+       "serve_disagg": bench_serve_disagg,
        "checkpoint_stall": bench_checkpoint_stall,
        "converge_lenet": bench_converge_lenet,
        "converge_resnet": bench_converge_resnet}

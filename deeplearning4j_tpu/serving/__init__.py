@@ -60,7 +60,7 @@ from deeplearning4j_tpu.serving.supervisor import (  # noqa: F401
 from deeplearning4j_tpu.serving.fleet import (  # noqa: F401
     AutoscaleConfig, FleetAutoscaler, FleetConfig, FleetMembership,
     FleetReplica, FleetRouter, FleetSignals, MigrationReport,
-    ProcessFleetRouter, ReplicaAgent)
+    PageStore, PrefillAgent, ProcessFleetRouter, ReplicaAgent)
 
 __all__ = ["AdmissionQueue", "AutoscaleConfig", "EngineShutdown",
            "EngineSupervisor", "FleetAutoscaler", "FleetConfig",
@@ -69,8 +69,8 @@ __all__ = ["AdmissionQueue", "AutoscaleConfig", "EngineShutdown",
            "GenerationStream", "InferenceTimeout", "LEDGER_VERSION",
            "MigrationReport", "NoReplicaAvailable", "OverloadConfig",
            "OverloadController", "PagedKVConfig", "PageExhausted",
-           "PagePool", "PrefixCache", "ProcessFleetRouter",
-           "QueueSnapshot", "ReplicaAgent",
+           "PagePool", "PageStore", "PrefillAgent", "PrefixCache",
+           "ProcessFleetRouter", "QueueSnapshot", "ReplicaAgent",
            "RequestCancelled", "RequestLedgerEntry", "RequestTrace",
            "ServingOverloaded", "ServingQueueFull", "SpeculationConfig",
            "ttft_attribution"]

@@ -144,10 +144,13 @@ from deeplearning4j_tpu.serving.overload import (
 from deeplearning4j_tpu.serving.paged_kernel import (
     paged_attention_supported)
 from deeplearning4j_tpu.serving.paging import (
-    PagedKVConfig, PagePool, gather_pages, pages_needed, scatter_pages)
-from deeplearning4j_tpu.serving.prefix_cache import PrefixCache
+    PagedKVConfig, PagePool, gather_pages, pages_needed, scatter_pages,
+    set_page)
+from deeplearning4j_tpu.serving.prefix_cache import (
+    ROOT_DIGEST, PrefixCache, chain_digests)
 from deeplearning4j_tpu.serving.request import (
-    GenerationRequest, GenerationStream, RequestLedgerEntry)
+    GenerationRequest, GenerationStream, RequestLedgerEntry,
+    rng_state_payload)
 from deeplearning4j_tpu.serving.scheduler import AdmissionQueue
 from deeplearning4j_tpu.util.decoding import (
     _check_seed, _stream_layers, _width_bucket, accept_proposals, draw,
@@ -272,6 +275,12 @@ class GenerationEngine:
         self._page_store = None            # device pools, per paged leaf
         self._paged_keys = None            # [(layer name, kv_k|kv_v)]
         self._page_tables: List[List[int]] = [[] for _ in range(slots)]
+        #: fleet page-shipping hook (serving/fleet/agent.py sets it):
+        #: called as ``page_publisher(prompt, table)`` right after a
+        #: prefix-cache insert, under the engine lock — typically a
+        #: closure over :meth:`export_prefix_chain`. Failures are
+        #: swallowed: publishing is best-effort, admission is not.
+        self.page_publisher: Optional[Callable] = None
         #: direct paged decode (no gather/scatter round trip) + its
         #: resolved attention impl ("xla" | "pallas"); see
         #: ARCHITECTURE.md "Paged decode fast path"
@@ -1239,6 +1248,12 @@ class GenerationEngine:
             if self._prefix is not None \
                     and self._brownout < BROWNOUT_NO_PREFIX_INSERTS:
                 self._prefix.insert(req.prompt, table)
+                if self.page_publisher is not None:
+                    try:
+                        self.page_publisher(req.prompt, list(table))
+                    except Exception:   # noqa: BLE001 — best-effort
+                        log.exception("fleet page publish failed; "
+                                      "admission unaffected")
         self._slots[slot] = req
         self._row_pos[slot] = primed_pos
         req.pending_token = tok
@@ -1504,6 +1519,238 @@ class GenerationEngine:
                 "active_slots": self.active_slots(),
                 "queue_depth": self.queue_depth(),
                 "free_page_frac": free}
+
+    # ------------------------------------------------------------------
+    # disaggregated prefill/decode (serving/fleet/pages.py rides these)
+    # ------------------------------------------------------------------
+    def prefix_held_blocks(self, prompt) -> int:
+        """Leading full `prompt` blocks already in the prefix cache
+        (pure probe — no stats, no LRU touch); 0 without a cache."""
+        with self._lock:
+            if self._prefix is None:
+                return 0
+            return self._prefix.held_blocks(prompt)
+
+    def pages_importable(self) -> bool:
+        """True once :meth:`import_prefix_chain` can actually map
+        shipped pages: the device pools exist (the bf16 pools
+        materialize lazily at the FIRST prime — warmup or real
+        traffic — because their dtype is the net's, discoverable only
+        from a primed state) and prefix inserts aren't browned out.
+        Agents probe this before touching the store: a fresh un-warmed
+        replica's first admission primes normally and materializes the
+        pools; every admission after imports."""
+        with self._lock:
+            return (self._pool is not None
+                    and self._prefix is not None
+                    and self._page_store is not None
+                    and self._brownout < BROWNOUT_NO_PREFIX_INSERTS)
+
+    def prefix_digests(self, limit: Optional[int] = None) -> List[str]:
+        """Chain digests of cached prefix blocks, LRU order (most
+        recent last) — the page-locality advertisement an agent puts in
+        its status file."""
+        with self._lock:
+            if self._prefix is None:
+                return []
+            return self._prefix.digests(limit)
+
+    def export_prefix_chain(self, prompt, table, store) -> dict:
+        """Publish every FULL block of a just-primed `prompt` to the
+        fleet page store: per block, each paged leaf's page (plus its
+        int8 scale row) is read back and shipped under the block's
+        chain digest. Content addressing makes this idempotent —
+        already-present digests are skipped without a device read.
+        Returns ``{"digests", "published", "bytes"}``."""
+        with self._lock:
+            out = {"digests": [], "published": 0, "bytes": 0}
+            if self._pool is None or self._page_store is None:
+                return out
+            ps = self._ps
+            n_full = len(prompt) // ps
+            if not n_full:
+                return out
+            digs = chain_digests(prompt, ps)
+            for i in range(n_full):
+                out["digests"].append(digs[i])
+                if store.has(digs[i], self._kv_dtype):
+                    continue
+                page = table[i]
+                arrays = []
+                for j, (n, k) in enumerate(self._paged_keys):
+                    arrays.append(
+                        (n, k, "kv",
+                         np.asarray(self._page_store[j][page])))
+                    if self._scale_store is not None:
+                        arrays.append(
+                            (n, k, "scale",
+                             np.asarray(self._scale_store[j][page])))
+                if store.publish(
+                        digs[i],
+                        parent=ROOT_DIGEST if i == 0 else digs[i - 1],
+                        tokens=prompt[i * ps:(i + 1) * ps],
+                        kv_dtype=self._kv_dtype, page_size=ps,
+                        arrays=arrays):
+                    out["published"] += 1
+                    out["bytes"] += sum(a.nbytes for *_, a in arrays)
+            return out
+
+    def import_prefix_chain(self, prompt, start_block: int,
+                            blocks) -> dict:
+        """Map verified store entries (``PageStore.load`` results for
+        `prompt`'s chain digests, starting at block index
+        `start_block` — the first block NOT already cached locally)
+        into the local pool + prefix cache. Each entry gets a fresh
+        page written through the jitted single-page scatter (warmup
+        precompiles it), then one ``PrefixCache.insert`` registers the
+        whole run — after which an admission of this prompt takes a
+        plain prefix hit and primes only the suffix, exactly as if the
+        blocks had been primed here. Any shape/dtype/token mismatch
+        stops the import at the blocks already validated (the suffix
+        simply primes fresh — exactness never depends on the import).
+        Returns ``{"blocks", "tokens", "bytes"}`` actually mapped."""
+        with self._lock:
+            out = {"blocks": 0, "tokens": 0, "bytes": 0}
+            if (self._pool is None or self._prefix is None
+                    or self._page_store is None
+                    or self._brownout >= BROWNOUT_NO_PREFIX_INSERTS):
+                return out
+            ps = self._ps
+            new_pages: List[int] = []
+            for bi, entry in enumerate(blocks):
+                b = start_block + bi
+                lo, hi = b * ps, (b + 1) * ps
+                if (entry.get("page_size") != ps or hi > len(prompt)
+                        or list(entry.get("tokens", ())) !=
+                        [int(t) for t in prompt[lo:hi]]):
+                    break
+                if not self._pool.free_count():
+                    self._prefix.evict(1)
+                try:
+                    page = self._pool.alloc(1)[0]
+                except Exception:   # noqa: BLE001 — PageExhausted et al
+                    break
+                arrs = {(n, k, role): a
+                        for n, k, role, a in entry["arrays"]}
+                writes = []
+                ok = True
+                for j, (n, k) in enumerate(self._paged_keys):
+                    a = arrs.get((n, k, "kv"))
+                    pool_j = self._page_store[j]
+                    if (a is None
+                            or tuple(a.shape) != tuple(pool_j.shape[1:])
+                            or a.dtype != pool_j.dtype):
+                        ok = False
+                        break
+                    writes.append((j, a, False))
+                    if self._scale_store is not None:
+                        sa = arrs.get((n, k, "scale"))
+                        sp = self._scale_store[j]
+                        if (sa is None
+                                or tuple(sa.shape) != tuple(sp.shape[1:])
+                                or sa.dtype != sp.dtype):
+                            ok = False
+                            break
+                        writes.append((j, sa, True))
+                if not ok:
+                    self._pool.release(page)
+                    break
+                idx = jnp.asarray(page, jnp.int32)
+                # import-time (per shipped block) uploads, not the
+                # decode loop
+                # tpulint: disable=device-transfer-in-hot-loop
+                for j, a, is_scale in writes:
+                    tgt = (self._scale_store if is_scale
+                           else self._page_store)
+                    tgt[j] = set_page(tgt[j], idx, jnp.asarray(a))
+                    out["bytes"] += a.nbytes
+                new_pages.append(page)
+            if new_pages:
+                covered = (start_block + len(new_pages)) * ps
+                # [0]-padding for the already-held leading blocks: the
+                # insert only reads table[i] for MISSING entries, and
+                # blocks < start_block are present by construction
+                self._prefix.insert(
+                    [int(t) for t in prompt[:covered]],
+                    [0] * start_block + new_pages)
+                for p in new_pages:
+                    self._pool.release(p)   # insert retained: the
+                out["blocks"] = len(new_pages)  # cache is sole owner
+                out["tokens"] = len(new_pages) * ps
+                self._kv_traffic(out["tokens"] * self._tok_bytes)
+            return out
+
+    def prefill_publish(self, req: GenerationRequest, store) -> dict:
+        """The PrefillAgent admission (serving/fleet/prefill.py): prime
+        `req` through the normal admission path — prefix hits, the
+        first-token draw, TTFT observation, prefix-cache insert all
+        included — publish its full-block pages to `store`, then
+        DETACH the slot instead of decoding. The prefix cache keeps the
+        pages warm (and advertised); the returned record carries what
+        the router needs to hand the stream to a decode replica:
+        the drawn first token, the post-draw rng (the decode re-prime
+        must not re-draw), the chain digests, and whether the request
+        already finished (one-token requests never leave this engine).
+        Raises on admission failure (no slot / prefill fault) — the
+        agent nacks, the router degrades to unified placement."""
+        with self._lock:
+            if self._broken is not None:
+                raise EngineShutdown("GenerationEngine is broken: "
+                                     f"{self._broken!r}")
+            if self._stop.is_set():
+                raise EngineShutdown("GenerationEngine shut down")
+            if self._draining:
+                raise EngineShutdown("GenerationEngine draining — "
+                                     "prefill elsewhere")
+            now = time.monotonic()
+            if self._fail_if_dead(req, now, "at prefill admission"):
+                err = req.handle.error
+                return {"done": True,
+                        "reason": req.handle.finish_reason,
+                        "error": None if err is None else repr(err),
+                        "token": None, "rng": None, "digests": [],
+                        "published": 0, "bytes": 0}
+            free = (self._slots.index(None)
+                    if None in self._slots else None)
+            if free is None or (self._pool is not None
+                                and not self._pages_admissible(req)):
+                raise ServingOverloaded(
+                    "prefill replica has no free slot/pages")
+            self._admit_one(req, free, readmit=False)
+            if req.handle.error is not None:
+                raise req.handle.error
+            pub = {"digests": [], "published": 0, "bytes": 0}
+            if self._slots[free] is req:
+                pub = self.export_prefix_chain(
+                    req.prompt, self._page_tables[free]
+                    if self._pool is not None else [], store)
+                self._detach_slot(free)
+            req.trace.record("prefill_publish",
+                             engine=self.trace_identity,
+                             blocks=len(pub["digests"]),
+                             published=pub["published"])
+            return {"done": req.handle.done,
+                    "reason": req.handle.finish_reason,
+                    "error": None,
+                    "token": int(req.handle._ids[-1]),
+                    "rng": rng_state_payload(req.rng),
+                    "digests": pub["digests"],
+                    "published": pub["published"],
+                    "bytes": pub["bytes"]}
+
+    def _detach_slot(self, slot: int) -> None:
+        """Release one seated request WITHOUT a terminal event (the
+        per-slot slice of ``detach_ledger``): the prefill flow seats,
+        publishes, and lets the stream live on at a decode replica."""
+        self._slots[slot] = None
+        self._row_pos[slot] = 0
+        if self._pool is not None:
+            for p in self._page_tables[slot]:
+                self._pool.release(p)
+            self._page_tables[slot] = []
+            self._invalidate_tables()
+            self._kv_pos_dirty = True
+        self._sync_accounting()
 
     def _init_page_store(self, primed_state) -> None:
         """First-admission pool build: one device page array per paged
@@ -2101,6 +2348,22 @@ class GenerationEngine:
             for j, b in enumerate(sorted(set(sfx))):
                 lead = 1 + j % (self.V - 1) if self.V > 1 else 0
                 drive([0] * ps + [lead] * b)
+        if self._pool is not None and self._page_store is not None:
+            # precompile the fleet page-ship seam for every pool leaf
+            # by round-tripping the null page (zeros out, zeros back):
+            # the export-side one-page gather and the import-side
+            # jitted single-page scatter (paging.set_page) both land in
+            # the compile cache here, so a later store import/publish
+            # causes zero retraces — page-import admissions stay under
+            # the same zero-retrace pin as everything else
+            idx = jnp.asarray(0, jnp.int32)
+            stores = [self._page_store]
+            if self._scale_store is not None:
+                stores.append(self._scale_store)
+            for pools in stores:
+                for j, pool in enumerate(pools):
+                    z = np.zeros_like(np.asarray(pool[0]))
+                    pools[j] = set_page(pool, idx, jnp.asarray(z))
         if self._overload is not None:
             # warmup TTFTs carry compile time — real traffic must not
             # inherit them as breach evidence or an admission rate

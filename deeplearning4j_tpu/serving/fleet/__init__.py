@@ -23,6 +23,18 @@ payloads, relays journal events into local stream handles, and
 re-places a dead replica's work onto survivors with no cooperation from
 the corpse — ``kill -9`` survivable by construction. See
 ARCHITECTURE.md "Cross-process fleet".
+
+DISAGGREGATED serving splits the cross-process fleet by role: prompts
+long enough to ship are mailed to a ``PrefillAgent``
+(``role="prefill"``), which primes through the ordinary admission
+path, publishes the prompt's full-block KV pages to a
+content-addressed fleet ``PageStore`` (``pages.py``), and journals the
+first token + rng state; the router relays that token and re-places
+the stream on a decode replica scored by PAGE LOCALITY (advertised
+prefix-chain digests), whose admission imports the shipped pages and
+primes only the suffix — bit-identical to unified serving, with
+prefill FLOPs off the decode replicas entirely. See ARCHITECTURE.md
+"Disaggregated serving".
 """
 
 from deeplearning4j_tpu.serving.fleet.agent import (  # noqa: F401
@@ -30,9 +42,13 @@ from deeplearning4j_tpu.serving.fleet.agent import (  # noqa: F401
 from deeplearning4j_tpu.serving.fleet.autoscale import (  # noqa: F401
     AutoscaleConfig, FleetAutoscaler, FleetSignals)
 from deeplearning4j_tpu.serving.fleet.membership import (  # noqa: F401
-    AGENT_ROLE, REPLICA_ROLE, FleetMembership)
+    AGENT_ROLE, PREFILL_ROLE, REPLICA_ROLE, FleetMembership)
 from deeplearning4j_tpu.serving.fleet.migration import (  # noqa: F401
     MigrationReport, readmit_entries)
+from deeplearning4j_tpu.serving.fleet.pages import (  # noqa: F401
+    PageStore)
+from deeplearning4j_tpu.serving.fleet.prefill import (  # noqa: F401
+    PrefillAgent)
 from deeplearning4j_tpu.serving.fleet.router import (  # noqa: F401
     FleetConfig, FleetReplica, FleetRouter, ProcessFleetRouter)
 from deeplearning4j_tpu.serving.fleet.transport import (  # noqa: F401
@@ -42,5 +58,6 @@ __all__ = ["AGENT_ROLE", "AgentStatus", "AutoscaleConfig",
            "FleetAutoscaler", "FleetConfig", "FleetMembership",
            "FleetReplica", "FleetRouter", "FleetSignals",
            "JournalReader", "JournalWriter", "Mailbox",
-           "MigrationReport", "ProcessFleetRouter", "REPLICA_ROLE",
+           "MigrationReport", "PREFILL_ROLE", "PageStore",
+           "PrefillAgent", "ProcessFleetRouter", "REPLICA_ROLE",
            "ReplicaAgent", "fleet_paths", "readmit_entries"]

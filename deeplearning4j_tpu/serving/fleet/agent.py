@@ -47,8 +47,12 @@ from deeplearning4j_tpu.serving.fleet import transport
 from deeplearning4j_tpu.serving.fleet.membership import (
     AGENT_ROLE, FleetMembership)
 from deeplearning4j_tpu.serving.health import (
+    FLEET_PAGE_SHIP_BYTES, FLEET_PAGE_STORE_HITS,
+    FLEET_PAGE_STORE_MISSES, FLEET_PAGES_IMPORTED,
+    FLEET_PAGES_PUBLISHED, FLEET_PAGES_QUARANTINED,
     FLEET_TRANSPORT_COMMANDS, FLEET_TRANSPORT_DUPLICATES,
     FLEET_TRANSPORT_QUARANTINED)
+from deeplearning4j_tpu.serving.prefix_cache import chain_digests
 from deeplearning4j_tpu.serving.request import (
     RequestLedgerEntry, rng_state_payload)
 
@@ -80,7 +84,10 @@ class ReplicaAgent:
                  ttl: float = 2.0,
                  status_interval_s: float = 0.1,
                  registry: Optional[MetricsRegistry] = None,
-                 label: str = "fleet"):
+                 label: str = "fleet",
+                 page_store=None, import_pages: bool = True,
+                 publish_pages: bool = False,
+                 advertise_digests: int = 32):
         self.engine = engine
         self.rid = int(rid)
         self.root = root
@@ -98,8 +105,29 @@ class ReplicaAgent:
         self._inflight: Dict[str, _Tracked] = {}
         self._seen: set = set()          # (request id, attempt) dedupe
         self._shutdown = False
+        self._drain_requested = False
         self.duplicates = 0
         self.commands = 0
+        #: fleet page-store seam (serving/fleet/pages.py): with a
+        #: store, admission probes it for shipped prefix blocks before
+        #: priming (``import_pages``) and prefix-cache inserts publish
+        #: back (``publish_pages``) — either side is independently
+        #: optional; both are best-effort (a store fault degrades to a
+        #: fresh prefill, never a failed admission)
+        self._page_store = page_store
+        self._import_pages = bool(import_pages)
+        self._advertise_digests = int(advertise_digests)
+        kv = engine.health().get("kv_pages")
+        self._ps = kv["page_size"] if kv else None
+        self._kv_dtype = (engine.health()
+                          .get("kv_traffic", {}).get("kv_dtype"))
+        self.store_hits = 0
+        self.store_misses = 0
+        self.pages_imported = 0
+        self.import_bytes = 0
+        self.pages_published = 0
+        self.publish_bytes = 0
+        self._store_corrupt_seen = 0
         #: compile count recorded by :meth:`mark_warm` — the status
         #: file reports compiles SINCE warmup, the cross-process form
         #: of the zero-retrace pin (a parent test can't read a child's
@@ -117,6 +145,45 @@ class ReplicaAgent:
             FLEET_TRANSPORT_QUARANTINED, "Torn/undecodable command "
             "files quarantined", ("fleet", "replica")).labels(**lab)
         self._quarantined_seen = 0
+        self._hit_c = r.counter(
+            FLEET_PAGE_STORE_HITS, "Page-store probes that found a "
+            "shipped prefix block", ("fleet", "replica")).labels(**lab)
+        self._miss_c = r.counter(
+            FLEET_PAGE_STORE_MISSES, "Page-store probes that missed",
+            ("fleet", "replica")).labels(**lab)
+        self._imp_c = r.counter(
+            FLEET_PAGES_IMPORTED, "Shipped KV pages mapped into the "
+            "local pool", ("fleet", "replica")).labels(**lab)
+        self._pub_c = r.counter(
+            FLEET_PAGES_PUBLISHED, "KV pages published to the fleet "
+            "store", ("fleet", "replica")).labels(**lab)
+        self._ship_c = r.counter(
+            FLEET_PAGE_SHIP_BYTES, "Page bytes moved through the "
+            "store, by direction", ("fleet", "replica", "direction"))
+        self._squar_c = r.counter(
+            FLEET_PAGES_QUARANTINED, "Torn/mismatched store entries "
+            "quarantined", ("fleet", "replica")).labels(**lab)
+        if page_store is not None and publish_pages:
+            # bind the private pieces here, where `self` access is the
+            # sanctioned seam — the closure itself only touches public
+            # agent surface
+            ship_pub = self._ship_c.labels(
+                fleet=self._label, replica=str(self.rid),
+                direction="publish")
+            def _publish(prompt, table, _agent=self, _store=page_store,
+                         _pub_c=self._pub_c, _ship_pub=ship_pub):
+                res = _agent.engine.export_prefix_chain(
+                    prompt, table, _store)
+                if res["published"]:
+                    _agent.pages_published += res["published"]
+                    _agent.publish_bytes += res["bytes"]
+                    _pub_c.inc(res["published"])
+                    _ship_pub.inc(res["bytes"])
+                    emit_event("transport", "page_publish",
+                               replica=_agent.rid,
+                               blocks=res["published"],
+                               bytes=res["bytes"])
+            engine.page_publisher = _publish
         self.membership.join(self.rid)
         self.write_status()
 
@@ -137,6 +204,7 @@ class ReplicaAgent:
     def status_payload(self) -> dict:
         out = {"rid": self.rid, "pid": os.getpid(),
                "ts": time.time(),
+               "role": "replica",
                "healthy": self.engine.is_healthy(),
                "ready": self.engine.is_ready(),
                "load": self.engine.load_stats(),
@@ -147,6 +215,21 @@ class ReplicaAgent:
         kv = self.engine.health().get("kv_pages")
         if kv:
             out["kv_page_size"] = kv["page_size"]
+            # page-locality advertisement: the digests of cached
+            # prefix blocks, LRU order — the router scores decode
+            # placement by the longest leading run of a prompt's chain
+            # found here
+            out["prefix_digests"] = self.engine.prefix_digests(
+                self._advertise_digests)
+        if self._page_store is not None:
+            out["page_store"] = {
+                "hits": self.store_hits,
+                "misses": self.store_misses,
+                "imported": self.pages_imported,
+                "import_bytes": self.import_bytes,
+                "published": self.pages_published,
+                "publish_bytes": self.publish_bytes,
+                "quarantined": self._page_store.corrupt}
         if self._warm_compiles is not None:
             out["compiles_since_warm"] = \
                 self._compile_total() - self._warm_compiles
@@ -215,6 +298,12 @@ class ReplicaAgent:
         req = entry.request
         rec = _Tracked(req, attempt,
                        emitted=len(req.handle.generated))
+        if self._page_store is not None and self._import_pages:
+            try:
+                self._import_shipped_prefix(req)
+            except Exception:   # noqa: BLE001 — import is best-effort
+                log.exception("agent %d: page import failed; admitting "
+                              "with a fresh prefill", self.rid)
         try:
             self.engine.admit_from_ledger(
                 [entry], where="over the fleet transport")
@@ -235,6 +324,56 @@ class ReplicaAgent:
             # resolved during admission (expired deadline, cancel):
             # publish the terminal event right away
             self.publish_progress()
+
+    def _import_shipped_prefix(self, req) -> None:
+        """Pre-admission store probe: compute the prompt's chain
+        digests, skip the blocks the local prefix cache already holds,
+        load the rest from the store (verified — a torn entry
+        quarantines and reads as a miss), and map them into the pool.
+        The admission that follows then takes an ordinary prefix-cache
+        hit and primes only the suffix: ZERO full-block prefill steps
+        run here for shipped blocks. A partial chain (store miss
+        mid-run) imports the leading run it did find."""
+        if self._ps is None or not self.engine.pages_importable():
+            # un-warmed bf16 pools materialize at the first prime —
+            # that admission goes fresh, everything after imports
+            return
+        prompt = req.prompt
+        limit = (len(prompt) - 1) // self._ps   # usable full blocks
+        if limit <= 0:
+            return
+        held = self.engine.prefix_held_blocks(prompt)
+        if held >= limit:
+            return                  # everything useful is local
+        digs = chain_digests(prompt, self._ps)
+        blocks = []
+        for i in range(held, limit):
+            entry = self._page_store.load(digs[i], self._kv_dtype)
+            if entry is None:
+                self.store_misses += 1
+                self._miss_c.inc()
+                break
+            self.store_hits += 1
+            self._hit_c.inc()
+            blocks.append(entry)
+        newq = self._page_store.corrupt - self._store_corrupt_seen
+        if newq > 0:
+            self._store_corrupt_seen = self._page_store.corrupt
+            self._squar_c.inc(newq)
+            emit_event("transport", "page_quarantine",
+                       replica=self.rid, count=newq)
+        if not blocks:
+            return
+        res = self.engine.import_prefix_chain(prompt, held, blocks)
+        if res["blocks"]:
+            self.pages_imported += res["blocks"]
+            self.import_bytes += res["bytes"]
+            self._imp_c.inc(res["blocks"])
+            self._ship_c.labels(fleet=self._label,
+                                replica=str(self.rid),
+                                direction="import").inc(res["bytes"])
+            emit_event("transport", "page_import", replica=self.rid,
+                       blocks=res["blocks"], bytes=res["bytes"])
 
     def _handle_revoke(self, cmd: dict) -> None:
         req_id = str(cmd.get("req"))
@@ -283,14 +422,66 @@ class ReplicaAgent:
         self.write_status(force=False)
         return progressed
 
+    # -- graceful scale-in ---------------------------------------------
+    def request_drain(self) -> None:
+        """Async-signal-safe drain request (the worker entrypoint's
+        SIGTERM handler calls ONLY this): sets a flag the run loop acts
+        on between steps — the handler itself must not touch the
+        journal or the engine mid-dispatch."""
+        self._drain_requested = True
+
+    def drain(self) -> None:
+        """Planned scale-in, no corpse protocol needed: stop taking
+        commands, journal every committed (ids, rng) consistency unit
+        FIRST, then nack each in-flight request — the router's normal
+        nack path re-places every stream on a survivor bit-exactly
+        (re-prime from exactly the journaled state, in order BEFORE
+        the nack in this rid's journal stream). Finally withdraw the
+        lease and shut down: peers see an orderly leave at their next
+        read instead of waiting out the lease TTL."""
+        self._shutdown = True
+        try:
+            # the engine is quiescent between agent-driven steps, so
+            # this snapshot is the complete committed state
+            self.publish_progress()
+            events = []
+            # admissions still sitting unread in the mailbox never
+            # started — hand them back too, or they hang forever
+            for _, cmd in self.mailbox.receive():
+                if str(cmd.get("kind")) != transport.CMD_ADMIT:
+                    continue
+                events.append({"kind": transport.EV_NACK,
+                               "req": str(cmd.get("req")),
+                               "attempt": int(cmd.get("attempt", 0)),
+                               "error": "replica draining (planned "
+                                        "scale-in)"})
+                emit_event("transport", "drain_nack", replica=self.rid,
+                           req=str(cmd.get("req")))
+            for req_id, rec in self._inflight.items():
+                events.append({"kind": transport.EV_NACK,
+                               "req": req_id, "attempt": rec.attempt,
+                               "error": "replica draining (planned "
+                                        "scale-in)"})
+                emit_event("transport", "drain_nack", replica=self.rid,
+                           req=req_id)
+            if events:
+                self.journal.append(events)
+            self._inflight.clear()
+            emit_event("transport", "drain", replica=self.rid,
+                       requeued=len(events))
+        finally:
+            self.close()
+
     def run(self, idle_sleep_s: float = 0.005,
             step_delay_s: float = 0.0) -> None:
         """The worker-process main loop: poll the mailbox, step the
-        engine, publish, until a ``shutdown`` command arrives.
-        `step_delay_s` throttles progressing steps — the kill-mid-trace
-        tests' pacing knob (a tiny warm model otherwise finishes a
-        whole trace inside one observer poll interval)."""
+        engine, publish, until a ``shutdown`` command arrives (or a
+        drain request — SIGTERM — hands every stream back through the
+        ledger first)."""
         while not self._shutdown:
+            if self._drain_requested:
+                self.drain()
+                return
             handled = self.poll_once()
             progressed = self.step()
             if progressed and step_delay_s > 0:

@@ -34,7 +34,8 @@ from deeplearning4j_tpu.resilience.elastic import (
 
 log = logging.getLogger(__name__)
 
-__all__ = ["AGENT_ROLE", "FleetMembership", "REPLICA_ROLE"]
+__all__ = ["AGENT_ROLE", "FleetMembership", "PREFILL_ROLE",
+           "REPLICA_ROLE"]
 
 #: the lease role serving replicas beat with (train ranks carry none
 #: or their own role; live_ranks(role=REPLICA_ROLE) sees only replicas)
@@ -46,6 +47,14 @@ REPLICA_ROLE = "serving"
 #: distinct from REPLICA_ROLE so an in-process fleet and a process
 #: fleet can share one ledger directory without miscounting each other
 AGENT_ROLE = "replica"
+
+#: the lease role PREFILL-ONLY agents beat with
+#: (``serving/fleet/prefill.py``): disaggregated serving's prefill
+#: pool — same ledger, same transport, no decode slots. Replica ids
+#: are a SINGLE namespace across roles (leases, mailboxes, journal
+#: streams, and status files all key on rid alone), so a deployment
+#: must assign prefill agents rids disjoint from decode replicas.
+PREFILL_ROLE = "prefill"
 
 
 class FleetMembership:

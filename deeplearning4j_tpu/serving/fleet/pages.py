@@ -1,0 +1,338 @@
+"""Content-addressed KV page store on the fleet root — the channel
+that ships primed prefix pages between OS processes.
+
+The journal moves tokens; this moves KV. One store entry is one FULL
+prompt block's K/V for every paged layer, named by its prefix-chain
+digest (``prefix_cache.block_digest`` chained from ``ROOT_DIGEST``):
+the digest pins the ENTIRE token prefix, so on a homogeneous fleet —
+same net, same page size, same kv_dtype — the bytes a prefill replica
+publishes under a digest are bit-identical to what the importing decode
+replica would have primed itself. That identity is the whole exactness
+argument for disaggregation: importing a page is not an approximation
+of local prefill, it IS local prefill's output, moved.
+
+On-disk contract (mirrors the mailbox):
+
+- ``<root>/pages/pg_<kvdtype>_<digest>.bin`` — the raw page bytes,
+  every leaf's ``np.ndarray.tobytes()`` concatenated in manifest
+  order. int8 entries interleave the per-(page, kv-head) amax-scale
+  sidecar rows (``role: "scale"``) after each quantized leaf.
+- ``<root>/pages/pg_<kvdtype>_<digest>.json`` — the manifest:
+  ``{version, digest, parent, tokens, kv_dtype, page_size, checksum,
+  nbytes, created, leaves: [{name, leaf, role, shape, dtype, offset,
+  nbytes}]}``. ``checksum`` is the sha256 hex of the complete bin.
+
+Writers are atomic-rename only (tmp + ``os.replace``), and the
+manifest lands AFTER its bin — a visible manifest implies a fully
+renamed bin, so a reader never races a half-written entry. Every load
+re-verifies checksum, sizes, and shapes anyway (a crashed writer, bit
+rot, or chaos injection can still tear files): ANY mismatch moves both
+files into ``pages/quarantine/`` with a ``.why`` breadcrumb — exactly
+the mailbox contract — and the load returns None, which callers treat
+as a store miss (fresh prefill; bit-exact by construction, just
+slower). A torn file can delay disaggregation, never corrupt a stream.
+
+The kv_dtype lives in the FILENAME, not the digest: locality
+advertisements stay dtype-agnostic, while a mixed fleet can never
+import bytes quantized for a different pool. Content addressing also
+dedupes publishes fleet-wide — ``has(digest)`` before write means N
+replicas priming the same system prompt ship it once.
+
+Entries are plain copies (imports copy into the local pool; nothing
+maps store files), so ``sweep`` — TTL by mtime plus an LRU-ish
+max-entries cap — can delete any entry at any time without a refcount
+protocol. A concurrent reader that loses the race gets a miss.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.resilience.durable import (atomic_write_bytes,
+                                                   atomic_write_json)
+from deeplearning4j_tpu.serving.fleet.transport import fleet_paths
+
+__all__ = ["PageStore", "STORE_VERSION"]
+
+STORE_VERSION = 1
+
+_PAGE_PREFIX = "pg_"
+_QUARANTINE = "quarantine"
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    """Rebuild a dtype from its manifest name. Non-numpy-native names
+    (bfloat16) resolve through ml_dtypes — the same registry jax uses,
+    so ``np.frombuffer`` round-trips bf16 leaves bit-exactly."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+class PageStore:
+    """The fleet-shared KV page tier rooted at ``<root>/pages/``."""
+
+    def __init__(self, root: str):
+        self.path = fleet_paths(root)["pages"]
+        self.quarantine_path = os.path.join(self.path, _QUARANTINE)
+        os.makedirs(self.quarantine_path, exist_ok=True)
+        self._lock = threading.Lock()
+        # observability (scraped into agent status + /metrics)
+        self.published = 0          # entries this process wrote
+        self.publish_bytes = 0      # bin bytes this process wrote
+        self.dedup_skips = 0        # publishes skipped: already present
+        self.corrupt = 0            # entries quarantined on load
+
+    # -- naming --------------------------------------------------------
+    def _stem(self, kv_dtype: str, digest: str) -> str:
+        return f"{_PAGE_PREFIX}{kv_dtype}_{digest}"
+
+    def _bin_path(self, kv_dtype: str, digest: str) -> str:
+        return os.path.join(self.path,
+                            self._stem(kv_dtype, digest) + ".bin")
+
+    def _manifest_path(self, kv_dtype: str, digest: str) -> str:
+        return os.path.join(self.path,
+                            self._stem(kv_dtype, digest) + ".json")
+
+    # -- write side (prefill replicas / publishing decoders) ----------
+    def has(self, digest: str, kv_dtype: str) -> bool:
+        return os.path.exists(self._manifest_path(kv_dtype, digest))
+
+    def publish(self, digest: str, *, parent: str,
+                tokens: Sequence[int], kv_dtype: str, page_size: int,
+                arrays: Sequence[Tuple[str, str, str, np.ndarray]]
+                ) -> bool:
+        """Write one block entry: `arrays` is ``[(layer name, leaf key,
+        role "kv"|"scale", ndarray), ...]`` in a deterministic order.
+        Returns False (and writes nothing) if the entry already exists
+        — content addressing makes re-publish a no-op, so concurrent
+        publishers across the fleet are safe without coordination (the
+        losing ``os.replace`` just rewrites identical bytes)."""
+        if self.has(digest, kv_dtype):
+            self.dedup_skips += 1
+            return False
+        leaves: List[dict] = []
+        chunks: List[bytes] = []
+        off = 0
+        for name, leaf, role, arr in arrays:
+            arr = np.ascontiguousarray(arr)
+            raw = arr.tobytes()
+            leaves.append({
+                "name": name, "leaf": leaf, "role": role,
+                "shape": list(arr.shape), "dtype": arr.dtype.name,
+                "offset": off, "nbytes": len(raw),
+            })
+            chunks.append(raw)
+            off += len(raw)
+        blob = b"".join(chunks)
+        manifest = {
+            "version": STORE_VERSION,
+            "digest": digest,
+            "parent": parent,
+            "tokens": [int(t) for t in tokens],
+            "kv_dtype": kv_dtype,
+            "page_size": int(page_size),
+            "checksum": hashlib.sha256(blob).hexdigest(),
+            "nbytes": len(blob),
+            "created": time.time(),
+            "leaves": leaves,
+        }
+        with self._lock:
+            # bin first, manifest second: a visible manifest implies a
+            # complete bin. A crash between the two leaves an orphan
+            # bin that sweep() reaps (no manifest -> never loaded).
+            atomic_write_bytes(self._bin_path(kv_dtype, digest), blob)
+            atomic_write_json(self._manifest_path(kv_dtype, digest),
+                              manifest)
+        self.published += 1
+        self.publish_bytes += len(blob)
+        return True
+
+    # -- read side (importing decode replicas) -------------------------
+    def load(self, digest: str, kv_dtype: str) -> Optional[dict]:
+        """Verified load: returns ``{"digest", "parent", "tokens",
+        "page_size", "nbytes", "arrays": [(name, leaf, role, ndarray),
+        ...]}`` or None on miss OR on any integrity failure (failure
+        quarantines the entry — it will never be offered again)."""
+        mpath = self._manifest_path(kv_dtype, digest)
+        try:
+            with open(mpath, "r", encoding="utf-8") as f:
+                manifest = json.load(f)
+        except FileNotFoundError:
+            return None
+        except (OSError, ValueError) as e:
+            self._quarantine(kv_dtype, digest,
+                             f"undecodable manifest: {e!r}")
+            return None
+        try:
+            with open(self._bin_path(kv_dtype, digest), "rb") as f:
+                blob = f.read()
+        except OSError as e:
+            self._quarantine(kv_dtype, digest,
+                             f"unreadable page bin: {e!r}")
+            return None
+        why = self._verify(manifest, blob, digest, kv_dtype)
+        if why is not None:
+            self._quarantine(kv_dtype, digest, why)
+            return None
+        arrays: List[Tuple[str, str, str, np.ndarray]] = []
+        for lf in manifest["leaves"]:
+            raw = blob[lf["offset"]:lf["offset"] + lf["nbytes"]]
+            arr = np.frombuffer(raw, dtype=_resolve_dtype(lf["dtype"]))
+            arrays.append((lf["name"], lf["leaf"], lf["role"],
+                           arr.reshape(lf["shape"])))
+        return {
+            "digest": digest,
+            "parent": manifest["parent"],
+            "tokens": list(manifest["tokens"]),
+            "page_size": int(manifest["page_size"]),
+            "nbytes": int(manifest["nbytes"]),
+            "arrays": arrays,
+        }
+
+    def _verify(self, manifest: dict, blob: bytes, digest: str,
+                kv_dtype: str) -> Optional[str]:
+        """None if the entry is intact, else the quarantine reason."""
+        try:
+            if int(manifest["version"]) != STORE_VERSION:
+                return (f"version {manifest['version']} != "
+                        f"{STORE_VERSION}")
+            if manifest["digest"] != digest:
+                return "manifest digest != filename digest"
+            if manifest["kv_dtype"] != kv_dtype:
+                return "manifest kv_dtype != filename kv_dtype"
+            if len(blob) != int(manifest["nbytes"]):
+                return (f"bin is {len(blob)} bytes, manifest says "
+                        f"{manifest['nbytes']} (torn write?)")
+            if hashlib.sha256(blob).hexdigest() != manifest["checksum"]:
+                return "checksum mismatch"
+            off = 0
+            for lf in manifest["leaves"]:
+                if int(lf["offset"]) != off:
+                    return f"leaf {lf['name']}/{lf['leaf']} offset gap"
+                dt = _resolve_dtype(lf["dtype"])
+                want = int(np.prod(lf["shape"])) * dt.itemsize
+                if int(lf["nbytes"]) != want:
+                    return (f"leaf {lf['name']}/{lf['leaf']} shape "
+                            f"{lf['shape']} x {lf['dtype']} needs "
+                            f"{want} bytes, manifest says "
+                            f"{lf['nbytes']}")
+                off += int(lf["nbytes"])
+            if off != len(blob):
+                return "leaves do not tile the bin"
+        except (KeyError, TypeError, ValueError) as e:
+            return f"malformed manifest: {e!r}"
+        return None
+
+    def _quarantine(self, kv_dtype: str, digest: str, why: str) -> None:
+        self.corrupt += 1
+        stem = self._stem(kv_dtype, digest)
+        for ext in (".json", ".bin"):
+            try:
+                os.replace(os.path.join(self.path, stem + ext),
+                           os.path.join(self.quarantine_path,
+                                        stem + ext))
+            except OSError:
+                try:
+                    os.unlink(os.path.join(self.path, stem + ext))
+                except OSError:
+                    pass
+        # a breadcrumb beside the quarantined files, for post-mortems
+        try:
+            atomic_write_json(
+                os.path.join(self.quarantine_path, stem + ".why"),
+                {"name": stem, "why": why})
+        except OSError:
+            pass
+
+    def quarantined(self) -> List[str]:
+        """Stems of quarantined entries (sorted)."""
+        try:
+            return sorted(n[:-len(".why")]
+                          for n in os.listdir(self.quarantine_path)
+                          if n.startswith(_PAGE_PREFIX)
+                          and n.endswith(".why"))
+        except OSError:
+            return []
+
+    # -- enumeration / retention ---------------------------------------
+    def digests(self, kv_dtype: Optional[str] = None) -> List[str]:
+        """Digests with a visible manifest (any dtype, or one)."""
+        out = []
+        try:
+            names = os.listdir(self.path)
+        except OSError:
+            return out
+        for n in names:
+            if not (n.startswith(_PAGE_PREFIX) and n.endswith(".json")):
+                continue
+            stem = n[len(_PAGE_PREFIX):-len(".json")]
+            dt, _, dig = stem.partition("_")
+            if dig and (kv_dtype is None or dt == kv_dtype):
+                out.append(dig)
+        return out
+
+    def entries(self) -> int:
+        return sum(1 for n in os.listdir(self.path)
+                   if n.startswith(_PAGE_PREFIX)
+                   and n.endswith(".json"))
+
+    def sweep(self, ttl_s: Optional[float] = None,
+              max_entries: Optional[int] = None) -> int:
+        """Retention pass: drop entries older than `ttl_s` (manifest
+        mtime), then oldest-first down to `max_entries`; orphan bins
+        (no manifest — a writer died between renames) always go.
+        Returns entries removed. Safe against concurrent readers —
+        worst case they take a miss and prefill fresh."""
+        now = time.time()
+        removed = 0
+        try:
+            names = os.listdir(self.path)
+        except OSError:
+            return 0
+        manifests: List[Tuple[float, str]] = []   # (mtime, stem)
+        stems = set()
+        for n in names:
+            if n.startswith(_PAGE_PREFIX) and n.endswith(".json"):
+                stem = n[:-len(".json")]
+                stems.add(stem)
+                try:
+                    manifests.append(
+                        (os.path.getmtime(os.path.join(self.path, n)),
+                         stem))
+                except OSError:
+                    pass
+        for n in names:
+            if (n.startswith(_PAGE_PREFIX) and n.endswith(".bin")
+                    and n[:-len(".bin")] not in stems):
+                try:
+                    os.unlink(os.path.join(self.path, n))
+                except OSError:
+                    pass
+        manifests.sort()
+        drop: List[str] = []
+        if ttl_s is not None:
+            drop.extend(s for mt, s in manifests if now - mt > ttl_s)
+        if max_entries is not None and len(manifests) > max_entries:
+            keep_from = len(manifests) - max_entries
+            drop.extend(s for _, s in manifests[:keep_from])
+        for stem in dict.fromkeys(drop):     # dedupe, keep order
+            # manifest FIRST so a concurrent reader can't see a
+            # manifest whose bin we already deleted
+            for ext in (".json", ".bin"):
+                try:
+                    os.unlink(os.path.join(self.path, stem + ext))
+                except OSError:
+                    pass
+            removed += 1
+        return removed

@@ -1,0 +1,246 @@
+"""PrefillAgent: a prefill-only fleet process (DistServe-style role
+split).
+
+The disaggregated fleet's prefill half: same lease ledger, same
+mailbox/journal/status transport as ``ReplicaAgent``, but a
+``role="prefill"`` lease (``membership.PREFILL_ROLE``) and NO decode
+loop — the agent consumes ``CMD_PREFILL`` commands, primes each
+request through the engine's ordinary admission path
+(``engine.prefill_publish``: prefix hits, the first-token draw, the
+prefix-cache insert all included), publishes the prompt's full-block
+KV pages to the fleet page store (``serving/fleet/pages.py``), detaches
+the slot, and journals ONE ``EV_PREFILLED`` line carrying the drawn
+first token, the post-draw rng state, and the published chain digests.
+The router relays the token, adopts the rng, and re-places the stream
+on a decode replica scored by page locality — whose admission imports
+the shipped pages and primes only the suffix WITHOUT drawing (the
+streamed-readmit path), so the disaggregated stream is bit-identical
+to the unified one.
+
+Prefill FLOPs therefore never run on a decode replica's dispatch
+thread: long prompts stop stealing decode TPOT, which is the entire
+point. A prefill failure nacks (the router degrades that request to
+unified placement); a dead prefill process is just an expired lease
+(the router routes around it). Replica ids share ONE namespace with
+decode agents — deployments must keep them disjoint.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Optional
+
+from deeplearning4j_tpu.monitoring.events import emit as emit_event
+from deeplearning4j_tpu.monitoring.metrics import (
+    MetricsRegistry, global_registry)
+from deeplearning4j_tpu.serving.fleet import transport
+from deeplearning4j_tpu.serving.fleet.membership import (
+    FleetMembership, PREFILL_ROLE)
+from deeplearning4j_tpu.serving.health import (
+    FLEET_PAGE_SHIP_BYTES, FLEET_PAGES_PUBLISHED, FLEET_PREFILLS,
+    FLEET_TRANSPORT_COMMANDS, FLEET_TRANSPORT_DUPLICATES,
+    FLEET_TRANSPORT_QUARANTINED)
+from deeplearning4j_tpu.serving.request import RequestLedgerEntry
+
+log = logging.getLogger(__name__)
+
+__all__ = ["PrefillAgent"]
+
+
+class PrefillAgent:
+    """One prefill-only engine + lease + mailbox + journal process.
+
+    Drive with :meth:`run` (worker entrypoint) or :meth:`poll_once`
+    (the deterministic in-process test shape).
+    """
+
+    def __init__(self, engine, store, root: str, rid: int, *,
+                 ttl: float = 2.0,
+                 status_interval_s: float = 0.1,
+                 registry: Optional[MetricsRegistry] = None,
+                 label: str = "fleet"):
+        self.engine = engine
+        self.store = store
+        self.rid = int(rid)
+        self.root = root
+        paths = transport.fleet_paths(root)
+        engine.replica_tag = self.rid
+        self.membership = FleetMembership(
+            paths["leases"], ttl=ttl, role=PREFILL_ROLE,
+            extra={"pid": os.getpid()})
+        self.mailbox = transport.Mailbox(root, self.rid)
+        self.journal = transport.JournalWriter(root, self.rid)
+        self.status = transport.AgentStatus(root)
+        self.status_interval_s = float(status_interval_s)
+        self._last_status_t = 0.0
+        self._label = label
+        self._seen: set = set()          # (request id, attempt) dedupe
+        self._shutdown = False
+        self.commands = 0
+        self.duplicates = 0
+        self.prefills = 0
+        self.published = 0
+        self.publish_bytes = 0
+        self._warm_compiles: Optional[float] = None
+        r = registry or global_registry()
+        lab = dict(fleet=self._label, replica=str(self.rid))
+        self._cmd_c = r.counter(
+            FLEET_TRANSPORT_COMMANDS, "Mailbox commands consumed, "
+            "by kind", ("fleet", "replica", "kind"))
+        self._dup_c = r.counter(
+            FLEET_TRANSPORT_DUPLICATES, "Duplicate deliveries dropped "
+            "by request-id dedupe", ("fleet", "replica")).labels(**lab)
+        self._quar_c = r.counter(
+            FLEET_TRANSPORT_QUARANTINED, "Torn/undecodable command "
+            "files quarantined", ("fleet", "replica")).labels(**lab)
+        self._prefill_c = r.counter(
+            FLEET_PREFILLS, "CMD_PREFILL admissions served",
+            ("fleet", "replica")).labels(**lab)
+        self._pub_c = r.counter(
+            FLEET_PAGES_PUBLISHED, "KV pages published to the fleet "
+            "store", ("fleet", "replica")).labels(**lab)
+        self._ship_c = r.counter(
+            FLEET_PAGE_SHIP_BYTES, "Page bytes moved through the "
+            "store, by direction", ("fleet", "replica", "direction"))
+        self._quarantined_seen = 0
+        self.membership.join(self.rid)
+        self.write_status()
+
+    # -- the zero-retrace bookkeeping ----------------------------------
+    @staticmethod
+    def _compile_total() -> float:
+        from deeplearning4j_tpu.monitoring import runtime
+        c = global_registry().get(runtime.COMPILE_COUNTER)
+        return 0.0 if c is None else c.total()
+
+    def mark_warm(self) -> None:
+        self._warm_compiles = self._compile_total()
+
+    # -- status advertisement ------------------------------------------
+    def status_payload(self) -> dict:
+        out = {"rid": self.rid, "pid": os.getpid(),
+               "ts": time.time(),
+               "role": "prefill",
+               "healthy": self.engine.is_healthy(),
+               "ready": self.engine.is_ready(),
+               "load": self.engine.load_stats(),
+               "inflight": 0,
+               "commands": self.commands,
+               "duplicates": self.duplicates,
+               "prefills": self.prefills,
+               "published": self.published,
+               "publish_bytes": self.publish_bytes,
+               "quarantined": len(self.mailbox.quarantined())}
+        kv = self.engine.health().get("kv_pages")
+        if kv:
+            out["kv_page_size"] = kv["page_size"]
+        if self._warm_compiles is not None:
+            out["compiles_since_warm"] = \
+                self._compile_total() - self._warm_compiles
+        return out
+
+    def write_status(self, force: bool = True) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_status_t \
+                < self.status_interval_s:
+            return
+        self._last_status_t = now
+        self.status.write(self.rid, self.status_payload())
+
+    # -- the command loop ----------------------------------------------
+    def poll_once(self) -> int:
+        before = len(self.mailbox.quarantined())
+        cmds = self.mailbox.receive()
+        newly_quarantined = len(self.mailbox.quarantined()) - before
+        if newly_quarantined > 0:
+            self._quar_c.inc(newly_quarantined)
+            emit_event("transport", "quarantine", replica=self.rid,
+                       count=newly_quarantined)
+        for _, cmd in cmds:
+            self.commands += 1
+            kind = str(cmd.get("kind"))
+            self._cmd_c.labels(fleet=self._label,
+                               replica=str(self.rid), kind=kind).inc()
+            if kind == transport.CMD_PREFILL:
+                self._handle_prefill(cmd)
+            elif kind == transport.CMD_SHUTDOWN:
+                self._shutdown = True
+            elif kind == transport.CMD_REVOKE:
+                pass    # nothing decodes here; prefill is one-shot
+            else:
+                log.warning("prefill agent %d: unknown command kind "
+                            "%r ignored", self.rid, kind)
+        return len(cmds)
+
+    def _handle_prefill(self, cmd: dict) -> None:
+        req_id = str(cmd.get("req"))
+        attempt = int(cmd.get("attempt", 0))
+        key = (req_id, attempt)
+        if key in self._seen:
+            self.duplicates += 1
+            self._dup_c.inc()
+            emit_event("transport", "duplicate", replica=self.rid,
+                       req=req_id, attempt=attempt)
+            return
+        self._seen.add(key)
+        try:
+            entry = RequestLedgerEntry.from_payload(cmd["entry"])
+            rec = self.engine.prefill_publish(entry.request, self.store)
+        except Exception as e:  # noqa: BLE001 — nack, never crash
+            self.journal.append([{"kind": transport.EV_NACK,
+                                  "req": req_id, "attempt": attempt,
+                                  "error": repr(e)}])
+            emit_event("transport", "nack", replica=self.rid,
+                       req=req_id, error=repr(e))
+            return
+        self.prefills += 1
+        self._prefill_c.inc()
+        if rec["published"]:
+            self.published += rec["published"]
+            self.publish_bytes += rec["bytes"]
+            self._pub_c.inc(rec["published"])
+            self._ship_c.labels(fleet=self._label,
+                                replica=str(self.rid),
+                                direction="publish").inc(rec["bytes"])
+        self.journal.append([{"kind": transport.EV_PREFILLED,
+                              "req": req_id, "attempt": attempt,
+                              "tok": rec["token"], "rng": rec["rng"],
+                              "done": rec["done"],
+                              "reason": rec["reason"],
+                              "error": rec["error"],
+                              "digests": rec["digests"],
+                              "published": rec["published"],
+                              "bytes": rec["bytes"]}])
+        emit_event("transport", "prefilled", replica=self.rid,
+                   req=req_id, attempt=attempt,
+                   blocks=len(rec["digests"]), done=rec["done"])
+
+    # -- driving -------------------------------------------------------
+    def request_drain(self) -> None:
+        """Signal-safe planned-stop request (the worker's SIGTERM
+        handler): prefill is one-shot per command and holds no streams,
+        so drain is just an orderly stop — finish the current poll,
+        write a final status, withdraw the lease, exit."""
+        self._shutdown = True
+
+    def run(self, idle_sleep_s: float = 0.005) -> None:
+        """Worker main loop: poll the mailbox until shutdown. No
+        engine stepping — this role never decodes."""
+        while not self._shutdown:
+            handled = self.poll_once()
+            self.write_status(force=False)
+            if not handled:
+                time.sleep(idle_sleep_s)
+        self.close()
+
+    def close(self) -> None:
+        self._shutdown = True
+        try:
+            self.write_status()
+        except OSError:
+            pass
+        self.membership.stop()
+        self.journal.close()
+        self.engine.shutdown()

@@ -63,12 +63,13 @@ from deeplearning4j_tpu.serving.fleet import transport
 from deeplearning4j_tpu.serving.fleet.autoscale import (
     AutoscaleConfig, FleetAutoscaler, FleetSignals)
 from deeplearning4j_tpu.serving.fleet.membership import (
-    AGENT_ROLE, FleetMembership)
+    AGENT_ROLE, PREFILL_ROLE, FleetMembership)
 from deeplearning4j_tpu.serving.health import (
     FLEET_AFFINITY_HITS, FLEET_AFFINITY_MISSES, FLEET_DEAD_REPLICAS,
     FLEET_GENERATION, FLEET_MIGRATED_REQUESTS, FLEET_MIGRATIONS,
     FLEET_RELAYED_TOKENS, FLEET_REPLACED_REQUESTS, FLEET_REPLICAS,
-    FLEET_ROUTED, FLEET_SCALE_EVENTS, scrape_probe)
+    FLEET_ROUTED, FLEET_SCALE_EVENTS, FLEET_TRANSPORT_CORRUPT_LINES,
+    scrape_probe)
 from deeplearning4j_tpu.serving.request import (
     GenerationRequest, RequestLedgerEntry)
 
@@ -95,7 +96,18 @@ class FleetConfig:
     the queued tail migrates there (None disables). ``membership_root``
     + ``lease_ttl_s`` enable filesystem replica leases
     (``serving/fleet/membership.py``); ``poll_interval_s`` paces the
-    started router's poll thread."""
+    started router's poll thread.
+
+    ``disagg`` (ProcessFleetRouter only) enables DistServe-style
+    prefill/decode separation: prompts holding at least
+    ``disagg_min_prompt_blocks`` USABLE full KV blocks (a block the
+    suffix-prime rule lets an admission actually reuse — i.e.
+    ``(len(prompt) - 1) // page_size`` blocks) route to the
+    ``role="prefill"`` lease pool first; the prefilled stream then
+    lands on the decode replica whose advertised prefix digests cover
+    the longest leading run of the prompt's chain (page locality).
+    Short prompts, an empty prefill pool, and every prefill failure
+    keep/return to the unified direct path."""
 
     affinity: bool = True
     affinity_block: Optional[int] = None
@@ -106,6 +118,8 @@ class FleetConfig:
     membership_root: Optional[str] = None
     lease_ttl_s: float = 2.0
     poll_interval_s: float = 0.25
+    disagg: bool = False
+    disagg_min_prompt_blocks: int = 1
 
     def __post_init__(self):
         if self.affinity_block is not None and self.affinity_block < 1:
@@ -114,6 +128,9 @@ class FleetConfig:
         if self.affinity_capacity < 1:
             raise ValueError(f"affinity_capacity must be >= 1, got "
                              f"{self.affinity_capacity}")
+        if self.disagg_min_prompt_blocks < 1:
+            raise ValueError(f"disagg_min_prompt_blocks must be >= 1, "
+                             f"got {self.disagg_min_prompt_blocks}")
 
 
 class FleetReplica:
@@ -699,7 +716,7 @@ class _RouteRecord:
     post-step rng state (the other half of the re-prime pair)."""
 
     __slots__ = ("request", "req_id", "rid", "attempt", "rng_state",
-                 "excluded", "revoked")
+                 "excluded", "revoked", "phase")
 
     def __init__(self, request: GenerationRequest, req_id: str):
         self.request = request
@@ -709,6 +726,9 @@ class _RouteRecord:
         self.rng_state: Optional[dict] = None
         self.excluded: set = set()   # rids that NACKed this request
         self.revoked = False         # caller-cancel already forwarded
+        #: routing phase (observability): "direct" unified placement,
+        #: "prefill" awaiting EV_PREFILLED, "decode" handed off
+        self.phase = "direct"
 
 
 #: remote failure reconstruction: a journaled ``done`` event carries
@@ -787,6 +807,12 @@ class ProcessFleetRouter:
         self.membership = FleetMembership(
             paths["leases"], ttl=self.config.lease_ttl_s,
             role=AGENT_ROLE)
+        #: the prefill pool's discovery view (same lease dir, disjoint
+        #: role stamp) — empty-pool reads make disagg degrade to
+        #: unified placement instead of failing
+        self.prefill_membership = FleetMembership(
+            paths["leases"], ttl=self.config.lease_ttl_s,
+            role=PREFILL_ROLE)
         self.status = transport.AgentStatus(root)
         self.journal = transport.JournalReader(root)
         self._mu = threading.RLock()
@@ -798,6 +824,9 @@ class ProcessFleetRouter:
         self._poll_thread: Optional[threading.Thread] = None
         self.replaced_requests = 0
         self.dead_replicas = 0
+        self.prefill_routed = 0
+        self.locality_hits = 0
+        self._corrupt_seen = 0
         self._register_metrics(registry)
 
     # ------------------------------------------------------------------
@@ -832,6 +861,10 @@ class ProcessFleetRouter:
             FLEET_REPLACED_REQUESTS, "In-flight requests re-placed "
             "onto a survivor after replica death or nack",
             ("fleet",)).labels(**lab)
+        self._corrupt_c = r.counter(
+            FLEET_TRANSPORT_CORRUPT_LINES, "Torn/undecodable journal "
+            "lines skipped by the relay's reader",
+            ("fleet",)).labels(**lab)
 
     # ------------------------------------------------------------------
     # discovery + placement (status files instead of engine accessors)
@@ -859,6 +892,11 @@ class ProcessFleetRouter:
             st = statuses.get(rid)
             # no status yet = still booting; unhealthy = don't place
             if st is None or not st.get("healthy", False):
+                continue
+            # defensive: the rid namespace is shared across roles, so a
+            # misconfigured deployment could leak a prefill agent's
+            # status here — never decode on one
+            if st.get("role") == "prefill":
                 continue
             out.append((rid, st))
         return out
@@ -944,17 +982,71 @@ class ProcessFleetRouter:
             prompt, steps, temperature=temperature, top_k=top_k,
             top_p=top_p, stop_tokens=stop_tokens, rng=rng,
             deadline=deadline, priority=priority)
-        rid = self._place(prompt)
         rec = _RouteRecord(req, uuid.uuid4().hex)
         with self._mu:
             self._routes[rec.req_id] = rec
-        self._send_to(rec, rid)
+        prefill_rid = self._place_prefill(prompt) \
+            if self.config.disagg else None
+        if prefill_rid is not None:
+            self._send_prefill(rec, prefill_rid)
+        else:
+            self._send_to(rec, self._place(prompt))
         return req.handle
+
+    # -- the disaggregated path (prefill pool first, then decode) ------
+    def _prefill_candidates(self) -> List[Tuple[int, dict]]:
+        """Routable prefill agents: live ``role="prefill"`` lease plus
+        a healthy status file (the pool's analogue of
+        :meth:`_candidates`)."""
+        statuses = self.status.read_all()
+        out = []
+        for rid in sorted(self.prefill_membership.live_ranks()):
+            st = statuses.get(rid)
+            if st is None or not st.get("healthy", False):
+                continue
+            out.append((rid, st))
+        return out
+
+    def _place_prefill(self, prompt) -> Optional[int]:
+        """Pick a prefill agent for `prompt`, or None when the request
+        should go direct: short prompts (fewer USABLE full blocks than
+        ``disagg_min_prompt_blocks`` — the last token is always primed
+        by decode, hence ``(len - 1) // block``) ship nothing worth the
+        hop, and an empty/unhealthy pool degrades to unified placement
+        rather than queueing behind a ghost."""
+        blocks = (len(prompt) - 1) // self._default_block()
+        if blocks < self.config.disagg_min_prompt_blocks:
+            return None
+        with self._mu:
+            cands = self._prefill_candidates()
+            if not cands:
+                return None
+            ready = [c for c in cands if c[1].get("ready")] or cands
+            return min(ready,
+                       key=lambda c: (self._score(c[1]), c[0]))[0]
+
+    def _send_prefill(self, rec: _RouteRecord, rid: int) -> None:
+        """Mail the request to prefill agent `rid` as a
+        ``CMD_PREFILL``; the stream stays parked on this record until
+        the agent's ``EV_PREFILLED`` (first token + rng + page digests)
+        hands it off to a decode replica."""
+        rec.rid = rid
+        rec.phase = "prefill"
+        entry = RequestLedgerEntry.capture(rec.request, "queued")
+        self._mailbox(rid).send({
+            "kind": transport.CMD_PREFILL, "req": rec.req_id,
+            "attempt": rec.attempt, "entry": entry.payload()})
+        self.prefill_routed += 1
+        self._routed.labels(fleet=self._label,
+                            replica=str(rid)).inc()
+        emit_event("transport", "route_prefill", fleet=self._label,
+                   replica=rid, req=rec.req_id, attempt=rec.attempt)
 
     def _send_to(self, rec: _RouteRecord, rid: int) -> None:
         """Capture the LOCAL request as a ledger payload and mail it to
         `rid` under the record's current attempt fence."""
         rec.rid = rid
+        rec.phase = "decode" if rec.request.streamed else "direct"
         phase = "active" if rec.request.streamed else "queued"
         entry = RequestLedgerEntry.capture(rec.request, phase)
         self._mailbox(rid).send({
@@ -977,11 +1069,19 @@ class ProcessFleetRouter:
             rids = {rec.rid for rec in self._routes.values()
                     if rec.rid is not None}
         rids.update(self.live_replicas())
+        rids.update(self.prefill_membership.live_ranks())
         n = 0
         for rid in sorted(rids):
             for ev in self.journal.poll(rid):
                 n += 1
                 self._apply_event(rid, ev)
+        # promote freshly detected torn/undecodable journal lines from
+        # the reader's bare attribute into the metrics registry (the
+        # health() field stays — dashboards scrape, probes poll)
+        newc = self.journal.corrupt - self._corrupt_seen
+        if newc > 0:
+            self._corrupt_seen = self.journal.corrupt
+            self._corrupt_c.inc(newc)
         self._propagate_cancels()
         return n
 
@@ -1013,6 +1113,8 @@ class ProcessFleetRouter:
                                 error=_rebuild_error(ev.get("error")))
             with self._mu:
                 self._routes.pop(req_id, None)
+        elif kind == transport.EV_PREFILLED:
+            self._apply_prefilled(rec, rid, ev)
         elif kind == transport.EV_NACK:
             # the target refused the admission (shutting down, or a
             # payload it could not decode): try the rest of the fleet,
@@ -1023,6 +1125,79 @@ class ProcessFleetRouter:
                        replica=rid, req=req_id, error=ev.get("error"))
             self._replace_record(rec, rec.excluded,
                                  cause=mig.CAUSE_DEATH, source=rid)
+
+    def _apply_prefilled(self, rec: _RouteRecord, rid: int,
+                         ev: dict) -> None:
+        """Prefill handoff: relay the drawn first token, adopt the
+        post-draw rng, then re-place the (now streamed) request on a
+        decode replica scored by page locality. The decode admission
+        re-primes ``ids[:-1]`` — exactly the prompt — against the
+        shipped pages, so nothing is drawn twice and the stream stays
+        bit-identical to unified serving."""
+        handle = rec.request.handle
+        tok = ev.get("tok")
+        if tok is not None and not handle.generated:
+            handle.relay_token(int(tok))
+            self._relayed_c.inc()
+        if ev.get("rng") is not None:
+            rec.rng_state = ev.get("rng")
+        if ev.get("done"):
+            # the whole request finished inside prefill (stop token on
+            # the first draw, or a one-step request)
+            handle.relay_finish(str(ev.get("reason") or "stop"),
+                                error=_rebuild_error(ev.get("error")))
+            with self._mu:
+                self._routes.pop(rec.req_id, None)
+            return
+        req = rec.request
+        if rec.rng_state is not None:
+            req.rng.bit_generator.state = rec.rng_state
+        digests = [str(d) for d in ev.get("digests") or ()]
+        try:
+            target = self._place_by_locality(req.prompt, digests,
+                                             rec.excluded)
+        except NoReplicaAvailable as e:
+            handle.relay_finish("error", e)
+            with self._mu:
+                self._routes.pop(rec.req_id, None)
+            return
+        # attempt bump fences out anything the prefill agent might
+        # still journal under the old attempt
+        rec.attempt += 1
+        self._send_to(rec, target)
+        emit_event("transport", "prefill_handoff", fleet=self._label,
+                   req=rec.req_id, source=rid, target=target,
+                   blocks=len(digests))
+
+    def _place_by_locality(self, prompt, digests, exclude) -> int:
+        """Decode placement for a prefilled stream: longest leading run
+        of the shipped chain digests already sitting in a candidate's
+        advertised prefix cache wins (those pages re-prime without a
+        store read); score + rid break ties, so with no holder anywhere
+        this degrades to plain least-loaded placement."""
+        with self._mu:
+            cands = self._candidates(exclude)
+            if not cands:
+                raise NoReplicaAvailable(
+                    f"fleet {self._label}: no routable decode replica "
+                    f"for prefilled stream (live "
+                    f"{self.live_replicas()}, "
+                    f"excluded {sorted(exclude)})")
+            ready = [c for c in cands if c[1].get("ready")] or cands
+
+            def key(c):
+                advset = set(c[1].get("prefix_digests") or ())
+                run = 0
+                for d in digests:
+                    if d not in advset:
+                        break
+                    run += 1
+                return (-run, self._score(c[1]), c[0])
+
+            best = min(ready, key=key)
+            if digests and -key(best)[0] > 0:
+                self.locality_hits += 1
+            return best[0]
 
     def _propagate_cancels(self) -> None:
         with self._mu:
@@ -1053,6 +1228,10 @@ class ProcessFleetRouter:
         if not routed:
             return out
         live = set(self.membership.live_ranks())
+        # a request parked on a prefill agent is routed to a rid the
+        # decode membership view does NOT cover — union the pool's
+        # live set or every healthy prefill agent reads as dead
+        live |= set(self.prefill_membership.live_ranks())
         statuses = self.status.read_all()
         for rid in routed:
             st = statuses.get(rid)
@@ -1181,7 +1360,9 @@ class ProcessFleetRouter:
         if t is not None and t.is_alive():
             t.join(timeout=2 * self.config.poll_interval_s + 1)
         if stop_agents:
-            for rid in self.live_replicas():
+            stops = set(self.live_replicas())
+            stops |= set(self.prefill_membership.live_ranks())
+            for rid in sorted(stops):
                 try:
                     self._mailbox(rid).send(
                         {"kind": transport.CMD_SHUTDOWN})
@@ -1199,6 +1380,7 @@ class ProcessFleetRouter:
                     "fleet router shut down with the request still "
                     "in flight"))
         self.membership.stop()
+        self.prefill_membership.stop()
 
     # ------------------------------------------------------------------
     # observability
@@ -1208,11 +1390,15 @@ class ProcessFleetRouter:
             affinity_entries = len(self._affinity)
         return {
             "live_replicas": self.live_replicas(),
+            "prefill_replicas":
+                sorted(self.prefill_membership.live_ranks()),
             "statuses": self.status.read_all(),
             "generation": self.membership.generation,
             "outstanding": self.outstanding(),
             "replaced_requests": self.replaced_requests,
             "dead_replicas": self.dead_replicas,
+            "prefill_routed": self.prefill_routed,
+            "locality_hits": self.locality_hits,
             "journal_corrupt_lines": self.journal.corrupt,
             "affinity_entries": affinity_entries,
         }

@@ -75,11 +75,18 @@ __all__ = ["AgentStatus", "JournalReader", "JournalWriter", "Mailbox",
 CMD_ADMIT = "admit"
 CMD_REVOKE = "revoke"
 CMD_SHUTDOWN = "shutdown"
+#: prefill-only admission (disaggregated mode): prime + publish KV
+#: pages + the pending first token, do NOT decode (prefill.py)
+CMD_PREFILL = "prefill"
 
 #: journal event kinds
 EV_TOK = "tok"
 EV_DONE = "done"
 EV_NACK = "nack"
+#: a prefill replica finished priming: carries the first token, the
+#: post-draw rng, and the published page digests (router hands the
+#: stream to a decode replica scored by page locality)
+EV_PREFILLED = "prefilled"
 
 _CMD_PREFIX = "cmd_"
 _QUARANTINE = "quarantine"
@@ -96,6 +103,7 @@ def fleet_paths(root: str) -> Dict[str, str]:
         "mail": os.path.join(root, "mail"),
         "journal": os.path.join(root, "journal"),
         "status": os.path.join(root, "status"),
+        "pages": os.path.join(root, "pages"),
     }
 
 

@@ -83,6 +83,29 @@ FLEET_TRANSPORT_QUARANTINED = \
     "dl4jtpu_fleet_transport_quarantined_total"
 FLEET_RELAYED_TOKENS = "dl4jtpu_fleet_relayed_tokens_total"
 FLEET_REPLACED_REQUESTS = "dl4jtpu_fleet_replaced_requests_total"
+#: journal lines that were complete (newline-terminated) yet
+#: undecodable — real transport corruption, distinct from the torn
+#: tail a crashed writer leaves (which is silently retried). The
+#: router promotes ``JournalReader.corrupt`` through this counter so
+#: /metrics and flight-recorder bundles see it, not just ``health()``.
+FLEET_TRANSPORT_CORRUPT_LINES = \
+    "dl4jtpu_fleet_transport_corrupt_lines_total"
+
+#: disaggregated prefill/decode (serving/fleet/pages.py, prefill.py;
+#: the agent and router register these): the content-addressed KV page
+#: store on the fleet root. ``published``/``ship_bytes`` count store
+#: writes, ``imported`` counts pages a decode replica mapped into its
+#: pool instead of re-priming, hits/misses count store probes at
+#: admission, ``quarantined`` counts torn/mismatched entries moved
+#: aside, ``prefills`` counts CMD_PREFILL admissions a prefill replica
+#: served.
+FLEET_PAGES_PUBLISHED = "dl4jtpu_fleet_pages_published_total"
+FLEET_PAGES_IMPORTED = "dl4jtpu_fleet_pages_imported_total"
+FLEET_PAGE_STORE_HITS = "dl4jtpu_fleet_page_store_hits_total"
+FLEET_PAGE_STORE_MISSES = "dl4jtpu_fleet_page_store_misses_total"
+FLEET_PAGES_QUARANTINED = "dl4jtpu_fleet_pages_quarantined_total"
+FLEET_PAGE_SHIP_BYTES = "dl4jtpu_fleet_page_ship_bytes_total"
+FLEET_PREFILLS = "dl4jtpu_fleet_prefills_total"
 
 #: survivability layer (supervisor.py / overload.py register these)
 SERVING_ENGINE_REBUILDS = "dl4jtpu_serving_engine_rebuilds_total"

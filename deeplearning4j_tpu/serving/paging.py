@@ -49,7 +49,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["PagePool", "PageExhausted", "PagedKVConfig", "gather_pages",
-           "pages_needed", "scatter_pages"]
+           "pages_needed", "scatter_pages", "set_page"]
 
 
 class PageExhausted(RuntimeError):
@@ -262,6 +262,17 @@ def gather_pages(pools, table, *, length: int):
         g = jnp.moveaxis(g, 2, 1)            # [S, Hkv, n, ps, D]
         out.append(g.reshape(g.shape[0], h, -1, d)[:, :, :length, :])
     return out
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def set_page(pool, idx, leaf):
+    """Write ONE page's block into `pool` at dynamic index `idx`
+    (donated: updated in place). The fleet page-import write: a shipped
+    ``[Hkv, ps, D]`` KV block (or ``[Hkv]`` scale row) lands in the
+    local pool without a dense round trip. `idx` is a traced scalar so
+    every page of a pool shares one compiled scatter — warmup primes it
+    by writing zeros to the null page."""
+    return pool.at[idx].set(leaf.astype(pool.dtype))
 
 
 @partial(jax.jit, donate_argnums=(0,))

@@ -42,12 +42,47 @@ strands its children unreachable — they simply age out next.
 
 from __future__ import annotations
 
+import hashlib
 from collections import OrderedDict
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 from deeplearning4j_tpu.serving.paging import PagePool
 
-__all__ = ["PrefixCache"]
+__all__ = ["PrefixCache", "ROOT_DIGEST", "block_digest", "chain_digests"]
+
+#: the chain root every prefix digest descends from — a fixed tag, not
+#: an empty string, so a digest can never collide with "no parent"
+ROOT_DIGEST = hashlib.sha256(b"dl4jtpu/prefix-chain-root").hexdigest()
+
+
+def block_digest(parent: str, tokens: Sequence[int]) -> str:
+    """Content address of one full token block GIVEN its parent's
+    digest: ``H(parent | token csv)``. Chaining the parent in makes the
+    digest pin the ENTIRE prefix, exactly like the cache's
+    ``(parent id, block)`` keys pin it — two prompts share a digest iff
+    they share every token up to and including this block. This is the
+    fleet-wide identity of a KV page (``serving/fleet/pages.py``): any
+    replica of a homogeneous fleet computes the same digest for the
+    same prefix, so a page primed anywhere names the bytes everywhere."""
+    h = hashlib.sha256()
+    h.update(parent.encode("ascii"))
+    h.update(b"|")
+    h.update(",".join(str(int(t)) for t in tokens).encode("ascii"))
+    return h.hexdigest()
+
+
+def chain_digests(prompt: Sequence[int], page_size: int) -> List[str]:
+    """Digest chain for every FULL block of `prompt` (block i's entry
+    is the digest of blocks [0..i]) — a pure function of the tokens,
+    computable by a router that holds no pages at all (page-locality
+    scoring) and by an importing agent before it touches the store."""
+    out: List[str] = []
+    parent = ROOT_DIGEST
+    for i in range(len(prompt) // page_size):
+        parent = block_digest(
+            parent, prompt[i * page_size:(i + 1) * page_size])
+        out.append(parent)
+    return out
 
 
 class PrefixCache:
@@ -59,8 +94,12 @@ class PrefixCache:
     def __init__(self, pool: PagePool):
         self._pool = pool
         self._ps = pool.page_size
-        #: (parent entry id, block token tuple) -> (page id, entry id)
-        self._entries: "OrderedDict[tuple, Tuple[int, int]]" = \
+        #: (parent entry id, block token tuple) ->
+        #:     (page id, entry id, chain digest)
+        #: the digest is the entry's fleet-wide content address
+        #: (``block_digest`` chained from ``ROOT_DIGEST``) — carried so
+        #: status files can advertise held prefixes without re-hashing
+        self._entries: "OrderedDict[tuple, Tuple[int, int, str]]" = \
             OrderedDict()
         self._next_id = 1
         self.hits = 0          # requests that reused >= 1 block
@@ -105,25 +144,56 @@ class PrefixCache:
         drops to the cache's 1 at retirement) and stays warm until
         evicted."""
         parent = self._ROOT
+        parent_digest = ROOT_DIGEST
         for i in range(len(prompt) // self._ps):
-            key = (parent, self._block(prompt, i))
+            block = self._block(prompt, i)
+            key = (parent, block)
             ent = self._entries.get(key)
             if ent is not None:
                 self._entries.move_to_end(key)
                 parent = ent[1]
+                parent_digest = ent[2]
                 continue
             page = table[i]
             self._pool.retain(page)
             ent_id = self._next_id
             self._next_id += 1
-            self._entries[key] = (page, ent_id)
+            parent_digest = block_digest(parent_digest, block)
+            self._entries[key] = (page, ent_id, parent_digest)
             parent = ent_id
+
+    # ------------------------------------------------------------------
+    def held_blocks(self, prompt: Sequence[int]) -> int:
+        """Leading full blocks of `prompt` already cached, WITHOUT
+        touching LRU order or hit/miss stats — a pure probe for the
+        fleet import path to decide which store blocks it still needs
+        (capped like ``lookup`` so a full-prompt match never counts)."""
+        limit = (len(prompt) - 1) // self._ps
+        parent = self._ROOT
+        held = 0
+        for i in range(limit):
+            ent = self._entries.get((parent, self._block(prompt, i)))
+            if ent is None:
+                break
+            parent = ent[1]
+            held += 1
+        return held
+
+    def digests(self, limit: Optional[int] = None) -> List[str]:
+        """Chain digests of cached entries in LRU order (most recently
+        used LAST), optionally capped to the `limit` most recent —
+        what a replica advertises in its status file so the router can
+        score page locality."""
+        digs = [ent[2] for ent in self._entries.values()]
+        if limit is not None and len(digs) > limit:
+            digs = digs[-limit:]
+        return digs
 
     # ------------------------------------------------------------------
     def evictable_pages(self) -> int:
         """Pages reclaimable right now (entries no slot maps)."""
-        return sum(1 for p, _ in self._entries.values()
-                   if self._pool.refcount(p) == 1)
+        return sum(1 for ent in self._entries.values()
+                   if self._pool.refcount(ent[0]) == 1)
 
     def evict(self, n_pages: int) -> int:
         """Free up to `n_pages` pages, oldest entries first, skipping

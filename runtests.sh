@@ -34,37 +34,57 @@ if ! python -m deeplearning4j_tpu.analysis deeplearning4j_tpu \
 fi
 tail -n 2 "$tpulint_out"   # findings summary + scanned-module count
 
+# per-lane wall-clock accounting: every tier-1 lane (and the full
+# suite) runs through `lane <name> <cmd...>`; the summary prints at the
+# end so a lane that quietly doubled its budget is visible in every run
+lane_names=()
+lane_secs=()
+lane() {
+  local name="$1"; shift
+  local t0=$SECONDS
+  "$@"
+  lane_names+=("$name")
+  lane_secs+=("$((SECONDS - t0))")
+}
+print_lane_summary() {
+  echo "tier-1 lane wall-clock:"
+  local i
+  for i in "${!lane_names[@]}"; do
+    printf '  %-18s %5ss\n' "${lane_names[$i]}" "${lane_secs[$i]}"
+  done
+}
+
 # tier-1 observability lane: the telemetry subsystem (monitoring/) gates
 # everything else — run it first, fast and standalone, so a broken
 # /metrics or a fit path that started retracing fails the run in seconds
 # (includes the no-new-retraces guard: instrumentation must not recompile)
-python -m pytest tests/test_monitoring.py -q -p no:cacheprovider
+lane monitoring python -m pytest tests/test_monitoring.py -q -p no:cacheprovider
 
 # tier-1 events lane: the structured event log, per-request tracing,
 # and the fault flight recorder (monitoring/events.py, flightrecorder.py,
 # serving RequestTrace) — ring bounds/drops + thread safety, breakdown /
 # TTFT-attribution math, flight dumps on an injected decode fault, and
 # the zero-retraces-with-tracing-ON guard
-python -m pytest tests/test_events.py -q -p no:cacheprovider
+lane events python -m pytest tests/test_events.py -q -p no:cacheprovider
 
 # tier-1 input-pipeline lane: device prefetch + fused multi-step
 # dispatch (pipeline/, fit(steps_per_dispatch=K)) — the fused-vs-unfused
 # equivalence and zero-retrace-after-warmup contracts fail fast here
 # before the full suite runs
-python -m pytest tests/test_input_pipeline.py -q -p no:cacheprovider
+lane input-pipeline python -m pytest tests/test_input_pipeline.py -q -p no:cacheprovider
 
 # tier-1 resilience lane: the chaos suite (resilience/) — non-finite
 # sentinel skip/rollback on all three fit loops, prefetch-worker death
 # and mid-epoch kill recovery, divergence rollback, serving deadlines.
 # The unhappy paths must stay green before the full suite runs.
-python -m pytest tests/test_resilience.py -q -p no:cacheprovider
+lane resilience python -m pytest tests/test_resilience.py -q -p no:cacheprovider
 
 # tier-1 durability lane: crash-consistent checkpointing (resilience/
 # durable.py + util/checkpoint.py) — torn-write/kill-during-save
 # fallbacks, async-writer failure surfacing, pruning/tag lifecycle, and
 # the preemption-exact resume pins (bit-identical params/score
 # trajectory on per-batch, fused-scan, and ParallelWrapper fits)
-python -m pytest tests/test_durable.py -q -m 'not slow' -p no:cacheprovider
+lane durability python -m pytest tests/test_durable.py -q -m 'not slow' -p no:cacheprovider
 
 # tier-1 elastic lane: the membership layer (resilience/elastic.py +
 # parallel/elastic.py) — lease ledger liveness/expiry/stall, generation
@@ -73,26 +93,26 @@ python -m pytest tests/test_durable.py -q -m 'not slow' -p no:cacheprovider
 # timeouts, and the world-of-one ElasticTrainer loop (commit cadence,
 # telemetry, zero retraces). The multi-process kill/rejoin proofs run in
 # the slow suite (tests/test_elastic_multiprocess.py, pytest -m slow).
-python -m pytest tests/test_elastic.py -q -p no:cacheprovider
+lane elastic python -m pytest tests/test_elastic.py -q -p no:cacheprovider
 
 # tier-1 serving lane: the continuous-batching engine (serving/) — the
 # engine-vs-one-shot bit-exactness contract, slot lifecycle, admission
 # control/deadlines, chaos isolation, and the zero-retraces-after-warmup
 # guard across staggered admissions
-python -m pytest tests/test_serving_engine.py -q -p no:cacheprovider
+lane serving python -m pytest tests/test_serving_engine.py -q -p no:cacheprovider
 
 # tier-1 serving-survivability lane: supervised recovery (bit-identical
 # continuation after arena rebuilds), restart-budget escalation,
 # SLO shedding / early rejection / brownout, draining, and the
 # pop-to-seat window regression (serving/supervisor.py, overload.py).
-python -m pytest tests/test_serving_supervisor.py -q -p no:cacheprovider
+lane supervisor python -m pytest tests/test_serving_supervisor.py -q -p no:cacheprovider
 
 # tier-1 serving-v2 lane: the block-paged KV arena, prefix cache, and
 # in-engine speculation — paged==slot-arena==one-shot bit-exactness,
 # token-budget admission (incl. the oversized-request submit rejection),
 # page lifecycle/eviction, chaos page exhaustion, and zero retraces
 # with every mode on
-python -m pytest tests/test_serving_paged.py -q -p no:cacheprovider
+lane paged python -m pytest tests/test_serving_paged.py -q -p no:cacheprovider
 
 # tier-1 paged-kernel lane: the direct paged-decode fast path
 # (serving/paged_kernel.py + the engine's install/extract seam) — the
@@ -100,7 +120,7 @@ python -m pytest tests/test_serving_paged.py -q -p no:cacheprovider
 # bit-exactness on BOTH direct impls (XLA fallback + interpret-mode
 # kernel), cached-table invariants, KV-traffic telemetry, supervisor
 # recovery re-entering the direct path, zero retraces with the kernel on
-python -m pytest tests/test_serving_paged_kernel.py -q -p no:cacheprovider
+lane paged-kernel python -m pytest tests/test_serving_paged_kernel.py -q -p no:cacheprovider
 
 # tier-1 quant lane: the int8 KV page pool (serving/quant.py +
 # kv_dtype="int8") — quantization-primitive exactness (power-of-two
@@ -111,7 +131,7 @@ python -m pytest tests/test_serving_paged_kernel.py -q -p no:cacheprovider
 # byte model on both impls, capacity doubling under total_bytes,
 # kv_dtype="auto" crossover resolution, chaos exhaustion on a quantized
 # pool, and zero retraces with int8+prefix+speculation stacked
-python -m pytest tests/test_serving_quant.py -q -p no:cacheprovider
+lane quant python -m pytest tests/test_serving_quant.py -q -p no:cacheprovider
 
 # tier-1 serving-fleet lane: the multi-replica router (serving/fleet/)
 # — routed == single-engine bit-exactness (greedy + sampled),
@@ -120,7 +140,7 @@ python -m pytest tests/test_serving_quant.py -q -p no:cacheprovider
 # cross-process payload), prefix-affinity placement, overload
 # rebalance, autoscaler hysteresis, replica-mode membership leases,
 # and zero retraces after warmup including post-migration re-admits
-python -m pytest tests/test_serving_fleet.py -q -p no:cacheprovider
+lane fleet python -m pytest tests/test_serving_fleet.py -q -p no:cacheprovider
 
 # tier-1 fleet-transport lane: the CROSS-PROCESS fleet's shared-fs
 # transport (serving/fleet/transport.py, agent.py, ProcessFleetRouter)
@@ -133,7 +153,21 @@ python -m pytest tests/test_serving_fleet.py -q -p no:cacheprovider
 # retraces, and the /health endpoint. The REAL-subprocess form (spawn
 # 3 workers, genuine kill -9, sha256 pin) is tests/test_fleet_procs.py
 # in the slow suite.
-python -m pytest tests/test_fleet_transport.py -q -p no:cacheprovider
+lane fleet-transport python -m pytest tests/test_fleet_transport.py -q -p no:cacheprovider
+
+# tier-1 disagg lane: disaggregated prefill/decode serving
+# (serving/fleet/pages.py, prefill.py, the router's disagg mode) —
+# content-addressed KV page store chaos (torn bin / torn manifest /
+# checksum flip each quarantined, never imported), bf16+int8 page
+# roundtrips pinned bitwise, disagg == unified stream bit-exactness
+# (greedy + sampled), page-locality decode placement, the fleet-shared
+# prefix tier, graceful-drain nack/re-place, every degradation edge
+# (short prompt, empty/dead prefill pool, prefill nack, corrupt store
+# entry), and zero retraces on the page-import path after warmup. The
+# real-subprocess SIGTERM drain (exit 0) is in tests/test_fleet_procs.py
+# in the slow suite.
+lane disagg python -m pytest tests/test_fleet_pages.py tests/test_fleet_disagg.py -q \
+    -p no:cacheprovider
 
 # tier-1 autotune/execution-plan lane: the kernel-crossover store +
 # plan resolution (tuning/) and the fused space-to-depth stem — store
@@ -141,10 +175,10 @@ python -m pytest tests/test_fleet_transport.py -q -p no:cacheprovider
 # equivalence with the sentinel ON (per-batch + K-step scan), zero
 # retraces on plan re-resolution, decode-impl eligibility-vs-choice,
 # stem kernel exactness, and the bench parked-record invariant
-python -m pytest tests/test_autotune.py tests/test_stem_fused.py -q \
+lane autotune python -m pytest tests/test_autotune.py tests/test_stem_fused.py -q \
     -p no:cacheprovider
 
-python -m pytest tests/ -q --junitxml=/tmp/dl4jtpu_junit.xml "$@"
+lane full-suite python -m pytest tests/ -q --junitxml=/tmp/dl4jtpu_junit.xml "$@"
 
 # only a FULL unfiltered run may overwrite the committed tally — a
 # filtered subset (-k/-m/--lf/extra paths) must not masquerade as the
@@ -192,3 +226,5 @@ fn, args = ge.entry()
 jax.jit(fn).lower(*args)
 print("entry points OK")
 EOF
+
+print_lane_summary

@@ -20,3 +20,13 @@ def net():
 def build(rid):
     from deeplearning4j_tpu.serving import GenerationEngine
     return GenerationEngine(net(), V, slots=4)
+
+
+def build_paged(rid):
+    """Paged-KV builder for the disaggregated fleet: page shipping
+    requires a page pool on BOTH roles (prefill exports pages, decode
+    imports them). Same net, same seed — homogeneous by contract."""
+    from deeplearning4j_tpu.serving import GenerationEngine, PagedKVConfig
+    return GenerationEngine(
+        net(), V, slots=4,
+        paging=PagedKVConfig(page_size=8, total_pages=64))

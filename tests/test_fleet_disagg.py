@@ -1,0 +1,589 @@
+"""Disaggregated prefill/decode serving — the tier-1 in-process lane:
+KV page shipping over the fleet transport (``serving/fleet/pages.py``,
+``prefill.py``), page-locality routing, the fleet-shared prefix tier,
+and every degradation edge, pinned deterministically without spawning
+processes.
+
+THE acceptance pin: a disaggregated stream — prompt prefilled on a
+``role="prefill"`` agent, KV pages shipped through the content-
+addressed store, first token + rng handed off through the journal,
+decode admission importing the pages and priming only the suffix — is
+bit-identical to the same stream served unified, greedy AND sampled,
+bf16 AND int8 pools. Mechanism counters (store hits, pages imported,
+prefill routes) are asserted alongside, so the exactness never
+silently degrades into "fresh prefill everywhere" (which would also
+pass a pure token comparison). Degradations — short prompts, an empty
+or dead prefill pool, a prefill nack, a corrupted store entry — each
+fall back to unified serving bit-exactly.
+
+Also here: the graceful SIGTERM drain (in-process half — the worker
+run-loop flag, progress-then-nack ordering, lease withdrawal; the
+real-subprocess exit-0 half lives in tests/test_fleet_procs.py) and
+the journal corrupt-line metric promotion."""
+
+import copy
+import itertools
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitoring
+from deeplearning4j_tpu.monitoring import runtime
+from deeplearning4j_tpu.monitoring.metrics import MetricsRegistry
+from deeplearning4j_tpu.serving import (
+    GenerationEngine, PagedKVConfig, PageStore, PrefillAgent,
+    ProcessFleetRouter, ReplicaAgent)
+from deeplearning4j_tpu.serving.fleet import (
+    AGENT_ROLE, FleetConfig, FleetMembership, JournalWriter,
+    fleet_paths)
+from deeplearning4j_tpu.serving.health import (
+    FLEET_TRANSPORT_CORRUPT_LINES)
+from deeplearning4j_tpu.serving.prefix_cache import chain_digests
+from deeplearning4j_tpu.zoo import TextGenerationTransformer
+
+V = 12
+PS = 4
+TTL = 30.0          # leases never expire mid-test unless withdrawn
+STEPS = 6
+
+_NET_TEMPLATE = {}
+
+
+def _net():
+    if "net" not in _NET_TEMPLATE:
+        _NET_TEMPLATE["net"] = TextGenerationTransformer(
+            vocab_size=V, embed_dim=16, n_heads=2, n_layers=2,
+            max_length=32, positional="rope").init()
+    return copy.deepcopy(_NET_TEMPLATE["net"])
+
+
+_ENGINE_POOL = {"bf16": [], "int8": []}
+
+
+def _engine(kv="bf16"):
+    """Paged engines pooled per kv_dtype (same rationale as the
+    transport lane: jit closures dominate wall-clock, and a drained
+    engine is indistinguishable from a fresh one — each test uses
+    DISTINCT prompts, so a warm prefix cache can't fake a store hit)."""
+    if _ENGINE_POOL[kv]:
+        return _ENGINE_POOL[kv].pop()
+    return GenerationEngine(
+        _net(), V, slots=4,
+        paging=PagedKVConfig(page_size=PS, total_pages=32,
+                             kv_dtype=kv))
+
+
+def _recycle(eng):
+    eng.page_publisher = None
+    stats = eng.load_stats()
+    if (eng.is_healthy() and stats["active_slots"] == 0
+            and stats["queue_depth"] == 0):
+        _ENGINE_POOL[getattr(eng, "_kv_dtype", "bf16")].append(eng)
+    else:
+        eng.shutdown()
+
+
+def _materialize(eng):
+    """One tiny 2-step prime: the bf16 device pools build lazily at
+    the first SURVIVING admission (dtype comes from the primed state),
+    and imports are skipped until they exist — exactly what --warmup
+    gives a production worker."""
+    if eng.pages_importable():
+        return
+    h = eng.submit([V - 1], steps=2, top_k=1,
+                   rng=np.random.default_rng(99))
+    while not h.done:
+        eng.step()
+    assert eng.pages_importable()
+
+
+_UNIQ = itertools.count(0)
+
+
+def _prompts():
+    """Two long (block-shippable) + two short prompts, made globally
+    unique by two leading tokens so pooled engines' warm prefix caches
+    never alias across tests."""
+    c = next(_UNIQ)
+    lead = [1 + c % (V - 1), 1 + (c // (V - 1)) % (V - 1)]
+    long_a = lead + [3, 4, 5, 6, 7, 8, 9, 10, 11, 1, 2]      # 13 toks
+    long_b = lead + [9, 8, 7, 6, 5, 4, 3, 2, 1, 10]          # 12 toks
+    return [long_a, long_b, lead, lead + [5]]
+
+
+def _submit_all(target, prompts, sampled=False, steps=STEPS):
+    hs = []
+    for i, p in enumerate(prompts):
+        kw = (dict(temperature=1.3, top_p=0.9) if sampled
+              else dict(top_k=1))
+        hs.append(target.submit(p, steps=steps,
+                                rng=np.random.default_rng(i), **kw))
+    return hs
+
+
+def _reference_ids(prompts, sampled=False, kv="bf16", steps=STEPS):
+    ref = _engine(kv)
+    hs = _submit_all(ref, prompts, sampled=sampled, steps=steps)
+    while not all(h.done for h in hs):
+        ref.step()
+    out = [h.ids for h in hs]
+    _recycle(ref)
+    return out
+
+
+def _retire(*agents):
+    """Transport-lane agent retirement: orderly close minus the engine
+    shutdown (recycled when provably idle)."""
+    for a in agents:
+        a._shutdown = True
+        try:
+            a.write_status()
+        except OSError:
+            pass
+        a.membership.stop()
+        a.journal.close()
+        _recycle(a.engine)
+
+
+def _mk_fleet(root, kv="bf16", n_dec=2, with_prefill=True,
+              publish=False, config=None):
+    """store + (optional) prefill agent rid 10 + decode agents rid
+    0..n-1 + a disagg router. Prefill rids start at 10: the rid
+    namespace is SHARED across roles."""
+    store = PageStore(root)
+    pre = None
+    if with_prefill:
+        pre = PrefillAgent(_engine(kv), store, root, 10, ttl=TTL)
+    decs = []
+    for rid in range(n_dec):
+        e = _engine(kv)
+        if kv == "bf16":
+            _materialize(e)
+        decs.append(ReplicaAgent(e, root, rid, ttl=TTL,
+                                 page_store=store, import_pages=True,
+                                 publish_pages=publish))
+    for a in decs:
+        a.write_status()
+    if pre is not None:
+        pre.write_status()
+    router = ProcessFleetRouter(
+        root, config=config or FleetConfig(disagg=True,
+                                           lease_ttl_s=TTL))
+    return store, pre, decs, router
+
+
+def _drive(router, pre, decs, handles, max_cycles=400):
+    for _ in range(max_cycles):
+        if pre is not None:
+            pre.poll_once()
+        for a in decs:
+            a.poll_once()
+            a.step()
+            a.publish_progress()
+            a.write_status()
+        router.relay()
+        if all(h.done for h in handles):
+            return
+    raise AssertionError(
+        f"streams never completed: {[h.done for h in handles]}")
+
+
+def _teardown(router, pre, decs):
+    router.shutdown()
+    if pre is not None:
+        _retire(pre)
+    _retire(*decs)
+
+
+# ---------------------------------------------------------------------
+# THE acceptance pin: disagg == unified, with the mechanism live
+# ---------------------------------------------------------------------
+class TestDisaggBitExact:
+    @pytest.mark.parametrize("kv", ["bf16", "int8"])
+    @pytest.mark.parametrize("sampled", [False, True],
+                             ids=["greedy", "sampled"])
+    def test_disagg_matches_unified(self, tmp_path, kv, sampled):
+        prompts = _prompts()
+        ref = _reference_ids(prompts, sampled=sampled, kv=kv)
+        store, pre, decs, router = _mk_fleet(str(tmp_path), kv=kv)
+        try:
+            hs = _submit_all(router, prompts, sampled=sampled)
+            _drive(router, pre, decs, hs)
+            assert all(h.error is None for h in hs)
+            assert [h.ids for h in hs] == ref
+            # the MECHANISM pins: both long prompts went through the
+            # prefill pool, their pages shipped, and the decode side
+            # imported every usable block — zero full-block prefill
+            # steps ran on a decode replica for shipped prefixes
+            assert router.health()["prefill_routed"] == 2
+            assert pre.prefills == 2
+            assert pre.published >= 5          # 3 + 2-or-3 full blocks
+            want = sum((len(p) - 1) // PS for p in prompts)
+            assert sum(a.store_hits for a in decs) == want
+            assert sum(a.store_misses for a in decs) == 0
+            assert sum(a.pages_imported for a in decs) == want
+            assert sum(a.import_bytes for a in decs) > 0
+        finally:
+            _teardown(router, pre, decs)
+
+    def test_short_prompts_never_touch_the_pool(self, tmp_path):
+        store, pre, decs, router = _mk_fleet(str(tmp_path), n_dec=1)
+        try:
+            prompts = _prompts()
+            hs = _submit_all(router, [prompts[2], prompts[3]])
+            _drive(router, pre, decs, hs)
+            assert all(h.error is None for h in hs)
+            assert router.health()["prefill_routed"] == 0
+            assert pre.prefills == 0 and store.published == 0
+        finally:
+            _teardown(router, pre, decs)
+
+
+# ---------------------------------------------------------------------
+# page-locality routing
+# ---------------------------------------------------------------------
+class TestLocalityRouting:
+    def test_decode_placement_prefers_the_page_holder(self, tmp_path):
+        """Replica 1 already holds the prompt's blocks (advertised as
+        prefix-chain digests in its status); after prefill the stream
+        must land there — beating replica 0, which plain least-loaded
+        rid-tiebreak scoring would have picked."""
+        prompts = _prompts()
+        long_p = prompts[0]
+        store, pre, decs, router = _mk_fleet(str(tmp_path))
+        try:
+            # warm replica 1's prefix cache with the prompt's blocks
+            warm = decs[1].engine.submit(
+                long_p, steps=2, top_k=1, rng=np.random.default_rng(7))
+            while not warm.done:
+                decs[1].engine.step()
+            for a in decs:
+                a.write_status()
+            st = router.status.read_all()[1]
+            assert len(st["prefix_digests"]) >= len(long_p) // PS
+
+            h = router.submit(long_p, steps=STEPS, top_k=1,
+                              rng=np.random.default_rng(0))
+            # prefill, then the handoff decision
+            pre.poll_once()
+            router.relay()
+            (rid, _), = [v for v in router.assignments().values()]
+            assert rid == 1, "handoff ignored page locality"
+            assert router.health()["locality_hits"] == 1
+            _drive(router, pre, decs, [h])
+            assert h.error is None
+            # served from the local pages: no store reads at all
+            assert decs[1].store_hits == 0
+        finally:
+            _teardown(router, pre, decs)
+
+
+# ---------------------------------------------------------------------
+# the fleet-shared prefix tier (no prefill pool involved)
+# ---------------------------------------------------------------------
+class TestSharedPrefixTier:
+    def test_publish_on_one_replica_import_on_another(self, tmp_path):
+        """``publish_pages`` turns every prefix-cache insert into a
+        store publish: replica 0 serves a prompt, is retired, and a
+        LATER replica 1 imports the blocks replica 0 left in the tier
+        — the system prompt outlives its first server."""
+        prompts = _prompts()
+        long_p = prompts[0]
+        ref = _reference_ids([long_p])
+        root = str(tmp_path)
+        store = PageStore(root)
+        e0 = _engine()
+        _materialize(e0)
+        a0 = ReplicaAgent(e0, root, 0, ttl=TTL, page_store=store,
+                          import_pages=True, publish_pages=True)
+        a0.write_status()
+        router = ProcessFleetRouter(
+            root, config=FleetConfig(lease_ttl_s=TTL))
+        h = router.submit(long_p, steps=STEPS, top_k=1,
+                          rng=np.random.default_rng(0))
+        _drive(router, None, [a0], [h])
+        assert h.ids == ref[0]
+        assert a0.pages_published >= 3 and store.published >= 3
+        router.shutdown()
+        # take replica 1's engine BEFORE retiring replica 0, so the
+        # pool can't hand us back replica 0's warm prefix cache and
+        # fake the cross-replica import
+        e1 = _engine()
+        _retire(a0)
+        _materialize(e1)
+        a1 = ReplicaAgent(e1, root, 1, ttl=TTL, page_store=store,
+                          import_pages=True)
+        a1.write_status()
+        router2 = ProcessFleetRouter(
+            root, config=FleetConfig(lease_ttl_s=TTL))
+        h2 = router2.submit(long_p, steps=STEPS, top_k=1,
+                            rng=np.random.default_rng(0))
+        _drive(router2, None, [a1], [h2])
+        assert h2.ids == ref[0]
+        # the pin: replica 1 PRIMED NOTHING for the shipped blocks
+        want = (len(long_p) - 1) // PS
+        assert a1.store_hits == want
+        assert a1.pages_imported == want
+        router2.shutdown()
+        _retire(a1)
+
+
+# ---------------------------------------------------------------------
+# degradation: every disagg failure lands on unified, bit-exactly
+# ---------------------------------------------------------------------
+class TestDegradation:
+    def test_empty_prefill_pool_serves_unified(self, tmp_path):
+        prompts = _prompts()
+        ref = _reference_ids(prompts)
+        store, pre, decs, router = _mk_fleet(str(tmp_path),
+                                             with_prefill=False)
+        try:
+            hs = _submit_all(router, prompts)
+            _drive(router, None, decs, hs)
+            assert [h.ids for h in hs] == ref
+            assert router.health()["prefill_routed"] == 0
+        finally:
+            _teardown(router, None, decs)
+
+    def test_dead_prefill_mid_flight_replaces_onto_decode(
+            self, tmp_path):
+        """The prefill agent takes the command and dies before serving
+        it (lease withdrawn, journal silent): the router's ordinary
+        death path re-places the request as a unified admission."""
+        prompts = _prompts()
+        long_p = prompts[0]
+        ref = _reference_ids([long_p])
+        store, pre, decs, router = _mk_fleet(str(tmp_path))
+        try:
+            h = router.submit(long_p, steps=STEPS, top_k=1,
+                              rng=np.random.default_rng(0))
+            assert router.health()["prefill_routed"] == 1
+            pre.membership.stop()          # dies without polling
+            summary = router.poll()
+            assert 10 in summary["dead"]
+            _drive(router, None, decs, [h])
+            assert h.error is None and h.ids == ref[0]
+            assert router.replaced_requests >= 1
+        finally:
+            router.shutdown()
+            pre.journal.close()
+            _recycle(pre.engine)
+            _retire(*decs)
+
+    def test_prefill_nack_replaces_onto_decode(self, tmp_path):
+        """A prefill agent that cannot serve (engine shut down) nacks;
+        the router excludes it and the decode replica serves fresh."""
+        prompts = _prompts()
+        long_p = prompts[0]
+        ref = _reference_ids([long_p])
+        store, pre, decs, router = _mk_fleet(str(tmp_path), n_dec=1)
+        try:
+            pre.engine.shutdown()
+            h = router.submit(long_p, steps=STEPS, top_k=1,
+                              rng=np.random.default_rng(0))
+            pre.poll_once()                # -> EV_NACK
+            router.relay()                 # replace before completion
+            (rec,) = router._routes.values()
+            assert rec.rid != 10 and 10 in rec.excluded
+            _drive(router, None, decs, [h])
+            assert h.error is None and h.ids == ref[0]
+        finally:
+            router.shutdown()
+            _retire(pre)       # engine already down; retire tolerates
+            _retire(*decs)
+
+    @pytest.mark.parametrize("corrupt", ["torn_bin", "torn_manifest",
+                                         "checksum"])
+    def test_corrupt_store_entry_falls_back_bit_exact(self, tmp_path,
+                                                      corrupt):
+        """Chaos lands between publish and import: the poisoned block
+        quarantines, the decode replica imports only the intact
+        leading run and prefills the rest fresh — the stream cannot
+        tell the difference."""
+        prompts = _prompts()
+        long_p = prompts[0]
+        ref = _reference_ids([long_p])
+        store, pre, decs, router = _mk_fleet(str(tmp_path), n_dec=1)
+        try:
+            h = router.submit(long_p, steps=STEPS, top_k=1,
+                              rng=np.random.default_rng(0))
+            pre.poll_once()                # publish + EV_PREFILLED
+            digs = chain_digests(long_p, PS)
+            bpath = store._bin_path("bf16", digs[1])
+            mpath = store._manifest_path("bf16", digs[1])
+            if corrupt == "torn_bin":
+                blob = open(bpath, "rb").read()
+                with open(bpath, "wb") as f:
+                    f.write(blob[: len(blob) // 2])
+            elif corrupt == "torn_manifest":
+                raw = open(mpath).read()
+                with open(mpath, "w") as f:
+                    f.write(raw[: len(raw) // 3])
+            else:
+                blob = bytearray(open(bpath, "rb").read())
+                blob[3] ^= 0xFF
+                with open(bpath, "wb") as f:
+                    f.write(bytes(blob))
+            _drive(router, pre, decs, [h])
+            assert h.error is None and h.ids == ref[0]
+            a = decs[0]
+            assert a.store_hits == 1       # block 0 imported...
+            assert a.store_misses == 1     # ...block 1 quarantined
+            assert a.pages_imported == 1
+            assert store.corrupt == 1
+            assert store.quarantined() == [store._stem("bf16",
+                                                       digs[1])]
+        finally:
+            _teardown(router, pre, decs)
+
+
+# ---------------------------------------------------------------------
+# satellite: graceful drain (the in-process half)
+# ---------------------------------------------------------------------
+class TestGracefulDrain:
+    def test_drain_nacks_inflight_and_streams_complete_bit_exact(
+            self, tmp_path):
+        prompts = _prompts()
+        ref = _reference_ids(prompts, steps=8)
+        store, pre, decs, router = _mk_fleet(
+            str(tmp_path), with_prefill=False,
+            config=FleetConfig(lease_ttl_s=TTL))
+        try:
+            hs = _submit_all(router, prompts, steps=8)
+            # run until SOME replica is genuinely mid-trace
+            victim_rid = None
+            for _ in range(200):
+                for a in decs:
+                    a.poll_once()
+                    a.step()
+                    a.publish_progress()
+                    a.write_status()
+                router.relay()
+                mid = [r.rid for r in router._routes.values()
+                       if not r.request.handle.done
+                       and len(r.request.handle.generated) >= 2]
+                if mid:
+                    victim_rid = mid[0]
+                    break
+                if all(h.done for h in hs):
+                    break
+            assert victim_rid is not None, \
+                "nothing left in flight to drain"
+            victim = decs[victim_rid]
+            survivor = decs[1 - victim_rid]
+            assert len(victim._inflight) > 0
+
+            # SIGTERM path: flag via the signal-safe hook, acted on at
+            # the run-loop top (run() returns after the drain)
+            victim.request_drain()
+            victim.run(idle_sleep_s=0)
+            assert victim_rid not in router.membership.live_ranks(), \
+                "drain must withdraw the lease"
+
+            _drive(router, None, [survivor], hs)
+            assert all(h.error is None for h in hs)
+            assert [h.ids for h in hs] == ref
+            assert router.replaced_requests >= 1
+        finally:
+            router.shutdown()
+            for a in decs:
+                a.journal.close()   # victim: close() already ran
+                a.membership.stop()
+            _recycle(decs[0].engine)
+            _recycle(decs[1].engine)
+
+    def test_prefill_agent_drain_stops_run_loop(self, tmp_path):
+        store = PageStore(str(tmp_path))
+        pre = PrefillAgent(_engine(), store, str(tmp_path), 10,
+                           ttl=TTL)
+        pre.request_drain()
+        pre.run(idle_sleep_s=0)            # returns immediately
+        assert 10 not in pre.membership.live_ranks()
+
+
+# ---------------------------------------------------------------------
+# satellite: journal corrupt-line promotion to /metrics
+# ---------------------------------------------------------------------
+class TestCorruptLineMetric:
+    def test_relay_promotes_corrupt_lines_to_counter(self, tmp_path):
+        root = str(tmp_path)
+        reg = MetricsRegistry()
+        router = ProcessFleetRouter(
+            root, config=FleetConfig(lease_ttl_s=TTL), registry=reg)
+        m = FleetMembership(fleet_paths(root)["leases"], ttl=TTL,
+                            role=AGENT_ROLE)
+        m.join(0)
+        try:
+            w = JournalWriter(root, 0)
+            with open(w.path, "a") as f:
+                f.write("definitely not json\n")
+            w.append([{"kind": "done", "req": "nobody", "attempt": 0,
+                       "reason": "stop", "error": None}])
+            w.close()
+            router.relay()
+            c = reg.get(FLEET_TRANSPORT_CORRUPT_LINES)
+            assert c is not None and c.total() == 1
+            # the health() field is kept alongside the metric
+            assert router.health()["journal_corrupt_lines"] == 1
+            # idempotent: a second relay must not double-count
+            router.relay()
+            assert c.total() == 1
+        finally:
+            m.stop()
+            router.shutdown()
+
+
+# ---------------------------------------------------------------------
+# zero retraces: the page-ship seam lands in warm buckets
+# ---------------------------------------------------------------------
+class TestZeroRetrace:
+    def test_import_admissions_cause_zero_compiles_after_warmup(
+            self, tmp_path):
+        prompts = _prompts()
+        long_a, long_b = prompts[0], prompts[1]
+        pre_eng = GenerationEngine(
+            _net(), V, slots=4,
+            paging=PagedKVConfig(page_size=PS, total_pages=32))
+        dec_eng = GenerationEngine(
+            _net(), V, slots=4,
+            paging=PagedKVConfig(page_size=PS, total_pages=32))
+        pre_eng.warmup()
+        dec_eng.warmup()
+        root = str(tmp_path)
+        store = PageStore(root)
+        pre = PrefillAgent(pre_eng, store, root, 10, ttl=TTL)
+        dec = ReplicaAgent(dec_eng, root, 0, ttl=TTL,
+                           page_store=store, import_pages=True)
+        pre.mark_warm()
+        dec.mark_warm()
+        dec.write_status()
+        pre.write_status()
+        router = ProcessFleetRouter(
+            root, config=FleetConfig(disagg=True, lease_ttl_s=TTL))
+        try:
+            c = monitoring.global_registry().get(
+                runtime.COMPILE_COUNTER)
+            base = 0.0 if c is None else c.total()
+            hs = [router.submit(long_a, steps=STEPS, top_k=1,
+                                rng=np.random.default_rng(0)),
+                  router.submit(long_b, steps=STEPS, temperature=1.3,
+                                top_p=0.9,
+                                rng=np.random.default_rng(1))]
+            _drive(router, pre, [dec], hs)
+            assert all(h.error is None for h in hs)
+            assert dec.pages_imported > 0, \
+                "the pin is vacuous unless imports actually ran"
+            c = monitoring.global_registry().get(
+                runtime.COMPILE_COUNTER)
+            total = 0.0 if c is None else c.total()
+            assert total - base == 0, (
+                f"{total - base} retraces after warmup on the "
+                "page-import path")
+            assert router.status.read_all()[0][
+                "compiles_since_warm"] == 0
+        finally:
+            router.shutdown()
+            pre.close()
+            dec.close()

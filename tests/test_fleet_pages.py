@@ -1,0 +1,295 @@
+"""Content-addressed KV page store (serving/fleet/pages.py) — the
+disaggregated fleet's page-shipping tier, pinned at the protocol level
+with no engines anywhere: publish/load roundtrips are BITWISE (bf16
+through the ml_dtypes registry, int8 with f32 scale sidecars), content
+addressing dedupes re-publishes, and every torn-file shape — truncated
+bin, undecodable manifest, flipped checksum byte — quarantines with a
+``.why`` breadcrumb and reads as a miss forever after (never imported,
+never re-offered). The fleet-level consequence (a corrupt entry
+degrades that admission to a fresh prefill, bit-exactly) is pinned in
+tests/test_fleet_disagg.py with real engines."""
+
+import json
+import os
+
+import ml_dtypes
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.serving import PageStore
+from deeplearning4j_tpu.serving.fleet.pages import STORE_VERSION
+from deeplearning4j_tpu.serving.prefix_cache import (
+    ROOT_DIGEST, block_digest, chain_digests)
+
+PS = 4
+
+
+def _bf16_arrays(seed=0):
+    """Two paged leaves in bfloat16 — the shape class the bf16 pools
+    ship ([Hkv, page_size, D] per page)."""
+    rng = np.random.default_rng(seed)
+    return [
+        ("attn0", "kv_k", "kv",
+         rng.normal(size=(2, PS, 8)).astype(ml_dtypes.bfloat16)),
+        ("attn0", "kv_v", "kv",
+         rng.normal(size=(2, PS, 8)).astype(ml_dtypes.bfloat16)),
+    ]
+
+
+def _int8_arrays(seed=0):
+    """Quantized leaves + their f32 amax-scale sidecar rows."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for k in ("kv_k", "kv_v"):
+        out.append(("attn0", k, "kv",
+                    rng.integers(-127, 128, size=(2, PS, 8),
+                                 dtype=np.int8)))
+        out.append(("attn0", k, "scale",
+                    rng.normal(size=(2,)).astype(np.float32)))
+    return out
+
+
+def _publish_one(store, arrays, kv_dtype, tokens=(1, 2, 3, 4)):
+    dig = block_digest(ROOT_DIGEST, tokens)
+    assert store.publish(dig, parent=ROOT_DIGEST, tokens=tokens,
+                         kv_dtype=kv_dtype, page_size=PS,
+                         arrays=arrays)
+    return dig
+
+
+# ---------------------------------------------------------------------
+# the digest chain
+# ---------------------------------------------------------------------
+class TestChainDigests:
+    def test_chain_covers_full_blocks_only(self):
+        assert chain_digests([1, 2, 3], PS) == []
+        assert len(chain_digests([1, 2, 3, 4], PS)) == 1
+        assert len(chain_digests(list(range(9)), PS)) == 2
+
+    def test_digest_pins_entire_prefix(self):
+        """Block 1's digest chains through block 0's: changing ANY
+        earlier token changes every later digest — the property that
+        makes a digest hit imply bit-identical priming history."""
+        a = chain_digests([1, 2, 3, 4, 5, 6, 7, 8], PS)
+        b = chain_digests([9, 2, 3, 4, 5, 6, 7, 8], PS)
+        assert a[0] != b[0] and a[1] != b[1]
+        # same prefix, same digests — content addressing is stable
+        assert a == chain_digests([1, 2, 3, 4, 5, 6, 7, 8], PS)
+
+    def test_chain_parent_linkage(self):
+        digs = chain_digests([1, 2, 3, 4, 5, 6, 7, 8], PS)
+        assert digs[0] == block_digest(ROOT_DIGEST, [1, 2, 3, 4])
+        assert digs[1] == block_digest(digs[0], [5, 6, 7, 8])
+
+
+# ---------------------------------------------------------------------
+# bitwise roundtrips
+# ---------------------------------------------------------------------
+class TestRoundtrip:
+    @pytest.mark.parametrize("kv_dtype,mk", [
+        ("bf16", _bf16_arrays), ("int8", _int8_arrays)])
+    def test_publish_load_bitwise(self, tmp_path, kv_dtype, mk):
+        store = PageStore(str(tmp_path))
+        arrays = mk()
+        dig = _publish_one(store, arrays, kv_dtype)
+        got = store.load(dig, kv_dtype)
+        assert got is not None
+        assert got["tokens"] == [1, 2, 3, 4]
+        assert got["page_size"] == PS
+        assert got["parent"] == ROOT_DIGEST
+        assert len(got["arrays"]) == len(arrays)
+        for (n, k, role, a), (gn, gk, grole, ga) in zip(arrays,
+                                                        got["arrays"]):
+            assert (n, k, role) == (gn, gk, grole)
+            assert a.dtype == ga.dtype and a.shape == ga.shape
+            # THE pin: the bytes that come back are the bytes that
+            # went in — importing a page IS the publisher's prefill
+            # output, moved
+            assert a.tobytes() == ga.tobytes()
+
+    def test_content_addressing_dedupes(self, tmp_path):
+        store = PageStore(str(tmp_path))
+        dig = _publish_one(store, _bf16_arrays(), "bf16")
+        assert store.publish(dig, parent=ROOT_DIGEST,
+                             tokens=[1, 2, 3, 4], kv_dtype="bf16",
+                             page_size=PS,
+                             arrays=_bf16_arrays()) is False
+        assert store.published == 1 and store.dedup_skips == 1
+        assert store.entries() == 1
+
+    def test_kv_dtype_lives_in_filename_not_digest(self, tmp_path):
+        """A digest published under bf16 must read as a MISS under
+        int8 — a mixed fleet can never import bytes quantized for a
+        different pool — while the digest itself stays dtype-agnostic
+        for locality advertisements."""
+        store = PageStore(str(tmp_path))
+        dig = _publish_one(store, _bf16_arrays(), "bf16")
+        assert store.has(dig, "bf16")
+        assert not store.has(dig, "int8")
+        assert store.load(dig, "int8") is None
+        assert store.corrupt == 0          # a miss, not a fault
+        assert store.digests("bf16") == [dig]
+        assert store.digests("int8") == []
+
+    def test_second_store_instance_sees_entries(self, tmp_path):
+        """The store is shared filesystem state: another process's
+        PageStore over the same root reads what this one wrote."""
+        dig = _publish_one(PageStore(str(tmp_path)), _bf16_arrays(),
+                           "bf16")
+        other = PageStore(str(tmp_path))
+        got = other.load(dig, "bf16")
+        assert got is not None and got["tokens"] == [1, 2, 3, 4]
+
+
+# ---------------------------------------------------------------------
+# satellite: chaos — every torn shape quarantines, none imports
+# ---------------------------------------------------------------------
+class TestChaos:
+    def _paths(self, store, dig, kv="bf16"):
+        return (store._bin_path(kv, dig), store._manifest_path(kv, dig))
+
+    def test_torn_bin_quarantined_never_imported(self, tmp_path):
+        store = PageStore(str(tmp_path))
+        dig = _publish_one(store, _bf16_arrays(), "bf16")
+        bpath, _ = self._paths(store, dig)
+        blob = open(bpath, "rb").read()
+        with open(bpath, "wb") as f:
+            f.write(blob[:len(blob) // 2])    # kill -9 mid-write
+        assert store.load(dig, "bf16") is None
+        assert store.corrupt == 1
+        stem = store._stem("bf16", dig)
+        assert store.quarantined() == [stem]
+        why = json.load(open(os.path.join(store.quarantine_path,
+                                          stem + ".why")))
+        assert "torn" in why["why"] or "bytes" in why["why"]
+        # never re-offered as if it might heal
+        assert not store.has(dig, "bf16")
+        assert store.load(dig, "bf16") is None
+        assert store.corrupt == 1
+
+    def test_truncated_manifest_quarantined(self, tmp_path):
+        store = PageStore(str(tmp_path))
+        dig = _publish_one(store, _int8_arrays(), "int8")
+        _, mpath = self._paths(store, dig, "int8")
+        raw = open(mpath).read()
+        with open(mpath, "w") as f:
+            f.write(raw[:len(raw) // 3])
+        assert store.load(dig, "int8") is None
+        assert store.corrupt == 1
+        assert store.quarantined() == [store._stem("int8", dig)]
+        assert store.load(dig, "int8") is None
+
+    def test_checksum_mismatch_quarantined(self, tmp_path):
+        """Bit rot: sizes all line up, one payload byte flipped — only
+        the checksum catches it."""
+        store = PageStore(str(tmp_path))
+        dig = _publish_one(store, _bf16_arrays(), "bf16")
+        bpath, _ = self._paths(store, dig)
+        blob = bytearray(open(bpath, "rb").read())
+        blob[7] ^= 0xFF
+        with open(bpath, "wb") as f:
+            f.write(bytes(blob))
+        assert store.load(dig, "bf16") is None
+        assert store.corrupt == 1
+        stem = store._stem("bf16", dig)
+        why = json.load(open(os.path.join(store.quarantine_path,
+                                          stem + ".why")))
+        assert "checksum" in why["why"]
+
+    def test_manifest_shape_size_mismatch_quarantined(self, tmp_path):
+        """A manifest whose leaf geometry cannot tile its bin is
+        rejected before any frombuffer touches it."""
+        store = PageStore(str(tmp_path))
+        dig = _publish_one(store, _bf16_arrays(), "bf16")
+        _, mpath = self._paths(store, dig)
+        man = json.load(open(mpath))
+        man["leaves"][0]["shape"] = [2, PS, 16]    # lies about D
+        with open(mpath, "w") as f:
+            json.dump(man, f)
+        assert store.load(dig, "bf16") is None
+        assert store.corrupt == 1
+
+    def test_version_skew_quarantined(self, tmp_path):
+        store = PageStore(str(tmp_path))
+        dig = _publish_one(store, _bf16_arrays(), "bf16")
+        _, mpath = self._paths(store, dig)
+        man = json.load(open(mpath))
+        man["version"] = STORE_VERSION + 1
+        with open(mpath, "w") as f:
+            json.dump(man, f)
+        assert store.load(dig, "bf16") is None
+        assert store.corrupt == 1
+
+    def test_quarantine_does_not_block_other_entries(self, tmp_path):
+        store = PageStore(str(tmp_path))
+        bad = _publish_one(store, _bf16_arrays(0), "bf16",
+                           tokens=(1, 2, 3, 4))
+        good = _publish_one(store, _bf16_arrays(1), "bf16",
+                            tokens=(5, 6, 7, 8))
+        bpath, _ = self._paths(store, bad)
+        with open(bpath, "wb") as f:
+            f.write(b"x")
+        assert store.load(bad, "bf16") is None
+        got = store.load(good, "bf16")
+        assert got is not None and got["tokens"] == [5, 6, 7, 8]
+
+
+# ---------------------------------------------------------------------
+# retention
+# ---------------------------------------------------------------------
+class TestSweep:
+    def test_ttl_sweep(self, tmp_path):
+        store = PageStore(str(tmp_path))
+        old = _publish_one(store, _bf16_arrays(0), "bf16",
+                           tokens=(1, 2, 3, 4))
+        _, mpath = self._stamp(store, old, age=100.0)
+        new = _publish_one(store, _bf16_arrays(1), "bf16",
+                           tokens=(5, 6, 7, 8))
+        assert store.sweep(ttl_s=50.0) == 1
+        assert not store.has(old, "bf16") and store.has(new, "bf16")
+
+    def test_max_entries_drops_oldest(self, tmp_path):
+        store = PageStore(str(tmp_path))
+        digs = []
+        for i in range(4):
+            digs.append(_publish_one(store, _bf16_arrays(i), "bf16",
+                                     tokens=(i, i, i, i)))
+            self._stamp(store, digs[-1], age=40.0 - 10 * i)
+        assert store.sweep(max_entries=2) == 2
+        kept = set(store.digests())
+        assert kept == set(digs[2:])
+
+    def test_orphan_bin_reaped(self, tmp_path):
+        """A writer that died between the bin rename and the manifest
+        rename leaves a loadable-by-nobody bin; sweep deletes it."""
+        store = PageStore(str(tmp_path))
+        with open(os.path.join(store.path, "pg_bf16_deadbeef.bin"),
+                  "wb") as f:
+            f.write(b"orphan")
+        assert store.sweep() == 0          # not an entry, still reaped
+        assert not os.path.exists(
+            os.path.join(store.path, "pg_bf16_deadbeef.bin"))
+
+    def test_sweep_manifest_before_bin(self, tmp_path):
+        """After a sweep there is never a manifest without its bin —
+        the readable-manifest-implies-complete-bin invariant holds
+        through deletion too (modulo the reader-miss race the docstring
+        licenses)."""
+        store = PageStore(str(tmp_path))
+        dig = _publish_one(store, _bf16_arrays(), "bf16")
+        self._stamp(store, dig, age=100.0)
+        assert store.sweep(ttl_s=1.0) == 1
+        assert store.entries() == 0
+        assert store.load(dig, "bf16") is None
+        assert store.corrupt == 0          # a miss, not a quarantine
+
+    @staticmethod
+    def _stamp(store, dig, age):
+        bpath = store._bin_path("bf16", dig)
+        mpath = store._manifest_path("bf16", dig)
+        import time
+        t = time.time() - age
+        for p in (bpath, mpath):
+            if os.path.exists(p):
+                os.utime(p, (t, t))
+        return bpath, mpath

@@ -195,3 +195,67 @@ def test_kill9_one_replica_streams_complete_bit_exact(tmp_path):
             if proc.poll() is None:
                 proc.kill()
             proc._log_file.close()
+
+
+def test_sigterm_drains_gracefully_exit_zero_bit_exact(tmp_path):
+    """Planned scale-in: SIGTERM (``proc.terminate()``) instead of
+    SIGKILL. The worker must NOT die mid-write — it stops admitting,
+    journals final progress for every in-flight stream, hands each
+    back through the ledger as a nack, withdraws its lease, and exits
+    0. The router re-places from the nacks (no lease-expiry wait), and
+    every stream completes sha256-identical to the unperturbed run."""
+    root = str(tmp_path / "fleet")
+    procs = {rid: _spawn(root, rid, tmp_path / f"agent{rid}.log")
+             for rid in range(2)}
+    router = ProcessFleetRouter(
+        root, config=FleetConfig(lease_ttl_s=TTL))
+    try:
+        _wait(lambda: router.live_replicas() == [0, 1], 300,
+              "both agent leases live", procs=list(procs.values()))
+
+        hs = _submit_all(router)
+
+        def _mid_trace_rids():
+            router.relay()
+            out = {}
+            for req_id, (rid, _) in router.assignments().items():
+                h = router._routes[req_id].request.handle
+                if not h.done and 2 <= len(h.generated) <= STEPS // 2:
+                    out.setdefault(rid, 0)
+                    out[rid] += 1
+            return out
+
+        _wait(lambda: bool(_mid_trace_rids()), 120,
+              "a replica serving a mid-trace stream",
+              procs=list(procs.values()))
+        cands = _mid_trace_rids() or \
+            {rid: 1 for rid, _ in router.assignments().values()}
+        victim = max(cands, key=lambda r: (cands[r], -r))
+        survivor = 1 - victim
+
+        procs[victim].terminate()          # SIGTERM — the drain path
+        procs[victim].wait(timeout=120)
+        assert procs[victim].returncode == 0, (
+            "graceful drain must exit 0, got "
+            f"{procs[victim].returncode}\n{_log_of(procs[victim])}")
+        # the lease is withdrawn by the drain itself, not expiry
+        assert victim not in router.live_replicas()
+
+        _wait(lambda: (router.relay(), ) and all(h.done for h in hs),
+              240, "all streams complete after the drain",
+              procs=[procs[survivor]])
+        assert all(h.error is None for h in hs), \
+            [repr(h.error) for h in hs]
+        assert router.replaced_requests >= 1, \
+            "the drain must have handed back in-flight streams"
+        assert all(len(h.generated) == STEPS for h in hs)
+        assert _digest(hs) == _reference_digest()
+
+        router.shutdown(stop_agents=True)
+        procs[survivor].wait(timeout=60)
+        assert procs[survivor].returncode == 0, _log_of(procs[survivor])
+    finally:
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+            proc._log_file.close()
